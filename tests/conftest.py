@@ -1,0 +1,13 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax loads.
+
+Mirrors the reference's embedded-cluster test strategy (SURVEY.md §4: every
+test spins a hermetic in-process store); here the "cluster" is 8 virtual XLA
+CPU devices so multi-chip sharding paths run without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

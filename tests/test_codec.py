@@ -1,0 +1,143 @@
+"""Codec / tablecodec / chunk foundations (reference test model:
+util/codec/codec_test.go, tablecodec/tablecodec_test.go)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tidb_tpu import tablecodec
+from tidb_tpu.sqltypes import (
+    decimal_to_str, new_decimal_type, new_int_type, new_string_type,
+    parse_date_str, parse_datetime_str, str_to_decimal,
+)
+from tidb_tpu.utils import codec
+from tidb_tpu.utils.chunk import Chunk, Column, concat_chunks
+
+
+def test_int_roundtrip_and_order():
+    vals = [-(2**63), -12345, -1, 0, 1, 7, 2**63 - 1]
+    encs = [codec.encode_key([v]) for v in vals]
+    for v, e in zip(vals, encs):
+        assert codec.decode_key(e) == [v]
+    assert encs == sorted(encs)
+
+
+def test_bytes_roundtrip_and_order():
+    vals = [b"", b"a", b"abc", b"abc\x00", b"abcdefgh", b"abcdefgh\x00", b"b"]
+    encs = [codec.encode_key([v]) for v in vals]
+    for v, e in zip(vals, encs):
+        assert codec.decode_key(e) == [v]
+    assert encs == sorted(encs)
+
+
+def test_float_order():
+    vals = [-1e300, -2.5, -0.0, 0.0, 1e-10, 3.14, 1e300]
+    encs = [codec.encode_key([v]) for v in vals]
+    assert encs == sorted(encs)
+    for v, e in zip(vals, encs):
+        assert codec.decode_key(e) == [v]
+
+
+def test_mixed_key_roundtrip():
+    key = codec.encode_key([None, 42, b"hello", 2.5])
+    assert codec.decode_key(key) == [None, 42, b"hello", 2.5]
+
+
+def test_random_int_order():
+    rng = random.Random(7)
+    vals = sorted(rng.randrange(-2**62, 2**62) for _ in range(500))
+    encs = [codec.encode_key([v]) for v in vals]
+    assert encs == sorted(encs)
+
+
+def test_record_key():
+    k = tablecodec.record_key(45, 99)
+    assert tablecodec.decode_record_key(k) == (45, 99)
+    start, end = tablecodec.table_range(45)
+    assert start <= k < end
+    # keys from other tables fall outside
+    assert not (start <= tablecodec.record_key(46, 0) < end)
+
+
+def test_record_key_handle_order():
+    ks = [tablecodec.record_key(1, h) for h in [-5, -1, 0, 1, 100, 10**12]]
+    assert ks == sorted(ks)
+
+
+def test_index_key_roundtrip():
+    k = tablecodec.index_key(45, 2, [b"abc", 7], handle=5)
+    vals = tablecodec.decode_index_values(k)
+    assert vals == [b"abc", 7, 5]
+
+
+def test_row_codec_roundtrip():
+    row = {1: 42, 2: None, 3: b"hello", 4: 2.75, 5: -1}
+    data = tablecodec.encode_row(list(row), list(row.values()))
+    assert tablecodec.decode_row(data) == row
+
+
+def test_varint():
+    buf = bytearray()
+    for v in [0, 1, -1, 300, -300, 2**40, -(2**40)]:
+        codec.write_varint(buf, v)
+    pos = 0
+    for v in [0, 1, -1, 300, -300, 2**40, -(2**40)]:
+        got, pos = codec.read_varint(bytes(buf), pos)
+        assert got == v
+
+
+def test_decimal_parse_render():
+    assert str_to_decimal("123.45", 2) == 12345
+    assert str_to_decimal("-0.05", 2) == -5
+    assert str_to_decimal("1.005", 2) == 101  # half-up
+    assert str_to_decimal("-1.005", 2) == -101
+    assert str_to_decimal("1e2", 2) == 10000
+    assert str_to_decimal("1.5e-1", 2) == 15
+    assert decimal_to_str(12345, 2) == "123.45"
+    assert decimal_to_str(-5, 2) == "-0.05"
+    assert decimal_to_str(42, 0) == "42"
+
+
+def test_date_parse():
+    assert parse_date_str("1970-01-01") == 0
+    assert parse_date_str("1970-01-02") == 1
+    assert parse_date_str("1995-03-15") == 9204
+    assert parse_datetime_str("1970-01-01 00:00:01") == 1_000_000
+
+
+def test_chunk_basics():
+    ft_i = new_int_type()
+    ft_s = new_string_type()
+    ft_d = new_decimal_type(10, 2)
+    ch = Chunk.from_rows([ft_i, ft_s, ft_d],
+                         [(1, b"a", 150), (None, b"bb", -5), (3, None, None)])
+    assert ch.num_rows == 3
+    assert ch.row(0) == (1, b"a", 150)
+    assert ch.row(1) == (None, b"bb", -5)
+    assert ch.row(2) == (3, None, None)
+    disp = ch.to_display_rows()
+    assert disp[0] == ("1", "a", "1.50")
+    assert disp[1] == (None, "bb", "-0.05")
+
+    filtered = ch.filter(np.array([True, False, True]))
+    assert filtered.num_rows == 2
+    assert filtered.row(1) == (3, None, None)
+
+    cc = concat_chunks([ch, filtered])
+    assert cc.num_rows == 5
+
+
+def test_column_dict_encode():
+    ft = new_string_type()
+    col = Column.from_values(ft, [b"x", b"y", b"x", b"z"])
+    codes, uniq = col.dict_encode()
+    assert [uniq[c] for c in codes] == [b"x", b"y", b"x", b"z"]
+
+
+def test_column_prefix64_order():
+    ft = new_string_type()
+    vals = [b"", b"a", b"ab", b"b", b"zzzzzzzzz"]
+    col = Column.from_values(ft, vals)
+    p = col.prefix64()
+    assert list(p) == sorted(p)

@@ -1,0 +1,19 @@
+"""tpu-htap: a TPU-native distributed SQL engine with TiDB's capability surface.
+
+Architecture (see SURVEY.md §7): the control plane — MySQL-dialect parser,
+cost-based planner, MVCC transactions, online DDL, catalog — runs host-side in
+Python (C++ for the hot codecs/storage in later rounds); the data plane
+executes columnar batches as JAX/XLA kernels, with ``shard_map`` collectives
+over ICI/DCN taking the role of the reference's MPP exchanges
+(reference: planner/core/fragment.go, store/copr/mpp.go) and coprocessor
+fan-out (reference: store/copr/coprocessor.go).
+
+Import side effect: enables jax x64 so decimal aggregation (scaled int64) is
+exact on device — the north star requires bit-exact parity (BASELINE.md).
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

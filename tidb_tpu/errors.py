@@ -1,0 +1,147 @@
+"""Centralized error classes with MySQL error codes.
+
+Mirrors the role of the reference's ``errno/`` + ``util/dbterror``
+(reference: errno/errcode.go, util/dbterror/terror.go): every user-visible
+error carries a MySQL errno + SQL state so the protocol layer and tests can
+match on codes, not strings.
+"""
+
+
+class ErrCode:
+    # Subset of MySQL error codes used across the engine (reference: errno/errcode.go).
+    DupEntry = 1062
+    NoSuchTable = 1146
+    BadDB = 1049
+    DBCreateExists = 1007
+    DBDropExists = 1008
+    TableExists = 1050
+    BadTable = 1051
+    BadField = 1054
+    NonUniq = 1052
+    ParseError = 1064
+    UnknownSystemVariable = 1193
+    WrongValueCountOnRow = 1136
+    BadNull = 1048
+    NoDefaultValue = 1364
+    DataTooLong = 1406
+    DataOutOfRange = 1264
+    TruncatedWrongValue = 1292
+    DivisionByZero = 1365
+    LockWaitTimeout = 1205
+    DeadlockDetected = 1213
+    WrongFieldSpec = 1063
+    DupKeyName = 1061
+    KeyDoesNotExist = 1176
+    CantDropFieldOrKey = 1091
+    UnknownTable = 1109
+    NoPermission = 1142
+    AccessDenied = 1045
+    WrongDBName = 1102
+    WrongTableName = 1103
+    WrongColumnName = 1166
+    InvalidGroupFuncUse = 1111
+    MixOfGroupFuncAndFields = 1140
+    FieldNotInGroupBy = 1055
+    UnknownColumn = 1054
+    OperandColumns = 1241
+    SubqueryMoreThan1Row = 1242
+    WrongNumberOfColumnsInSelect = 1222
+    CantReopenTable = 1137
+    WrongAutoKey = 1075
+    MultiplePriKey = 1068
+    TooManyKeys = 1069
+    UnsupportedDDL = 8214
+    InfoSchemaExpired = 8027
+    InfoSchemaChanged = 8028
+    WriteConflict = 9007
+    TxnRetryable = 8002
+    LazyUniquenessCheckFailure = 8147
+    ResolveLockTimeout = 9004
+    GCTooEarly = 9006
+    UnsupportedType = 8003
+    QueryInterrupted = 1317
+    MemExceedThreshold = 8001
+    OOMKill = 8175
+
+
+class TiDBError(Exception):
+    """Base error: carries MySQL errno + sqlstate for the wire protocol."""
+
+    code = 1105  # ER_UNKNOWN_ERROR
+    sqlstate = "HY000"
+
+    def __init__(self, msg="", code=None):
+        super().__init__(msg)
+        if code is not None:
+            self.code = code
+        self.msg = msg
+
+    def __str__(self):
+        return self.msg or self.__class__.__name__
+
+
+class ParseError(TiDBError):
+    code = ErrCode.ParseError
+    sqlstate = "42000"
+
+
+class SchemaError(TiDBError):
+    code = ErrCode.NoSuchTable
+    sqlstate = "42S02"
+
+
+class ColumnError(TiDBError):
+    code = ErrCode.BadField
+    sqlstate = "42S22"
+
+
+class DupEntryError(TiDBError):
+    code = ErrCode.DupEntry
+    sqlstate = "23000"
+
+
+class WriteConflictError(TiDBError):
+    code = ErrCode.WriteConflict
+    sqlstate = "HY000"
+
+
+class LockedError(TiDBError):
+    """Key is locked by another transaction (reference: kv lock errors)."""
+
+    code = ErrCode.LockWaitTimeout
+    sqlstate = "HY000"
+
+    def __init__(self, msg="", key=None, lock_ts=0):
+        super().__init__(msg)
+        self.key = key
+        self.lock_ts = lock_ts
+
+
+class DeadlockError(TiDBError):
+    code = ErrCode.DeadlockDetected
+    sqlstate = "40001"
+
+
+class TypeError_(TiDBError):
+    code = ErrCode.TruncatedWrongValue
+    sqlstate = "22007"
+
+
+class OutOfRangeError(TiDBError):
+    code = ErrCode.DataOutOfRange
+    sqlstate = "22003"
+
+
+class PrivilegeError(TiDBError):
+    code = ErrCode.NoPermission
+    sqlstate = "42000"
+
+
+class QueryInterruptedError(TiDBError):
+    code = ErrCode.QueryInterrupted
+    sqlstate = "70100"
+
+
+class MemoryQuotaExceeded(TiDBError):
+    code = ErrCode.MemExceedThreshold
+    sqlstate = "HY000"

@@ -1,0 +1,348 @@
+"""SQL type system (reference: types/ — FieldType, Datum, MyDecimal, Time).
+
+Design difference from the reference: values are stored *columnar-first*.
+The per-value "Datum" of the reference becomes plain Python values at the
+edges (parser literals, row codec, protocol) and numpy arrays inside the
+engine. Physical device representations are chosen for TPU friendliness:
+
+- integers            -> int64   (unsigned carried in int64, flag-checked)
+- DECIMAL(p<=18, s)   -> scaled int64 ("scale" in FieldType); exact sums on
+                         device use int64 accumulators (x64 enabled)
+- FLOAT/DOUBLE        -> float32/float64
+- DATE                -> int32 days since 1970-01-01
+- DATETIME/TIMESTAMP  -> int64 microseconds since epoch (naive / UTC)
+- TIME (duration)     -> int64 microseconds
+- CHAR/VARCHAR/BLOB   -> host: numpy object array of bytes; device:
+                         dictionary codes (int32) or padded u8 matrices
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field
+
+# ---------------------------------------------------------------------------
+# MySQL protocol type codes (reference: parser/mysql/type.go)
+# ---------------------------------------------------------------------------
+TYPE_DECIMAL = 0x00
+TYPE_TINY = 0x01
+TYPE_SHORT = 0x02
+TYPE_LONG = 0x03
+TYPE_FLOAT = 0x04
+TYPE_DOUBLE = 0x05
+TYPE_NULL = 0x06
+TYPE_TIMESTAMP = 0x07
+TYPE_LONGLONG = 0x08
+TYPE_INT24 = 0x09
+TYPE_DATE = 0x0A
+TYPE_DURATION = 0x0B
+TYPE_DATETIME = 0x0C
+TYPE_YEAR = 0x0D
+TYPE_NEWDATE = 0x0E
+TYPE_VARCHAR = 0x0F
+TYPE_BIT = 0x10
+TYPE_JSON = 0xF5
+TYPE_NEWDECIMAL = 0xF6
+TYPE_ENUM = 0xF7
+TYPE_SET = 0xF8
+TYPE_TINY_BLOB = 0xF9
+TYPE_MEDIUM_BLOB = 0xFA
+TYPE_LONG_BLOB = 0xFB
+TYPE_BLOB = 0xFC
+TYPE_VAR_STRING = 0xFD
+TYPE_STRING = 0xFE
+TYPE_GEOMETRY = 0xFF
+
+INT_TYPES = frozenset({TYPE_TINY, TYPE_SHORT, TYPE_INT24, TYPE_LONG, TYPE_LONGLONG, TYPE_YEAR, TYPE_BIT})
+FLOAT_TYPES = frozenset({TYPE_FLOAT, TYPE_DOUBLE})
+STRING_TYPES = frozenset({
+    TYPE_VARCHAR, TYPE_VAR_STRING, TYPE_STRING, TYPE_BLOB, TYPE_TINY_BLOB,
+    TYPE_MEDIUM_BLOB, TYPE_LONG_BLOB, TYPE_ENUM, TYPE_SET, TYPE_JSON,
+})
+TIME_TYPES = frozenset({TYPE_DATE, TYPE_NEWDATE, TYPE_DATETIME, TYPE_TIMESTAMP})
+
+_TYPE_NAMES = {
+    TYPE_TINY: "tinyint", TYPE_SHORT: "smallint", TYPE_INT24: "mediumint",
+    TYPE_LONG: "int", TYPE_LONGLONG: "bigint", TYPE_FLOAT: "float",
+    TYPE_DOUBLE: "double", TYPE_NEWDECIMAL: "decimal", TYPE_VARCHAR: "varchar",
+    TYPE_STRING: "char", TYPE_VAR_STRING: "varchar", TYPE_BLOB: "text",
+    TYPE_DATE: "date", TYPE_NEWDATE: "date", TYPE_DATETIME: "datetime",
+    TYPE_TIMESTAMP: "timestamp", TYPE_DURATION: "time", TYPE_YEAR: "year",
+    TYPE_JSON: "json", TYPE_BIT: "bit", TYPE_NULL: "null",
+    TYPE_ENUM: "enum", TYPE_SET: "set",
+}
+
+# Column flags (reference: parser/mysql/const.go)
+FLAG_NOT_NULL = 1
+FLAG_PRI_KEY = 2
+FLAG_UNIQUE_KEY = 4
+FLAG_MULTIPLE_KEY = 8
+FLAG_UNSIGNED = 32
+FLAG_BINARY = 128
+FLAG_AUTO_INCREMENT = 512
+
+# Integer ranges by type code (signed_min, signed_max, unsigned_max)
+INT_RANGES = {
+    TYPE_TINY: (-128, 127, 255),
+    TYPE_SHORT: (-32768, 32767, 65535),
+    TYPE_INT24: (-8388608, 8388607, 16777215),
+    TYPE_LONG: (-2147483648, 2147483647, 4294967295),
+    TYPE_LONGLONG: (-(2**63), 2**63 - 1, 2**64 - 1),
+    TYPE_YEAR: (1901, 2155, 2155),
+    TYPE_BIT: (0, 2**63 - 1, 2**64 - 1),
+}
+
+UNSPECIFIED_LENGTH = -1
+DEFAULT_DIV_PRECISION_INCREMENT = 4  # reference: mysql div_precision_increment
+MAX_DECIMAL_SCALE = 30
+MAX_DECIMAL_WIDTH = 65
+
+
+@dataclass
+class FieldType:
+    """Column type descriptor (reference: parser/types/field_type.go)."""
+
+    tp: int = TYPE_NULL
+    flen: int = UNSPECIFIED_LENGTH
+    decimal: int = UNSPECIFIED_LENGTH  # scale for DECIMAL / fsp for time types
+    flag: int = 0
+    charset: str = "utf8mb4"
+    collate: str = "utf8mb4_bin"
+    elems: tuple = ()  # enum/set elements
+
+    @property
+    def is_unsigned(self) -> bool:
+        return bool(self.flag & FLAG_UNSIGNED)
+
+    @property
+    def not_null(self) -> bool:
+        return bool(self.flag & FLAG_NOT_NULL)
+
+    @property
+    def scale(self) -> int:
+        if self.tp == TYPE_NEWDECIMAL:
+            return 0 if self.decimal in (None, UNSPECIFIED_LENGTH) else self.decimal
+        return 0
+
+    def type_name(self) -> str:
+        return _TYPE_NAMES.get(self.tp, "unknown")
+
+    def sql_string(self) -> str:
+        """Render as DDL type string, e.g. ``decimal(15,2)`` (reference: parser/types restore)."""
+        name = self.type_name()
+        if self.tp == TYPE_NEWDECIMAL:
+            p = self.flen if self.flen != UNSPECIFIED_LENGTH else 10
+            s = self.decimal if self.decimal != UNSPECIFIED_LENGTH else 0
+            name = f"decimal({p},{s})"
+        elif self.tp in (TYPE_VARCHAR, TYPE_VAR_STRING) and self.flen != UNSPECIFIED_LENGTH:
+            name = f"varchar({self.flen})"
+        elif self.tp == TYPE_STRING and self.flen != UNSPECIFIED_LENGTH:
+            name = f"char({self.flen})"
+        elif self.tp in INT_TYPES and self.flen not in (None, UNSPECIFIED_LENGTH):
+            name = f"{name}({self.flen})"
+        if self.is_unsigned:
+            name += " unsigned"
+        return name
+
+    def clone(self) -> "FieldType":
+        return FieldType(self.tp, self.flen, self.decimal, self.flag,
+                         self.charset, self.collate, self.elems)
+
+
+def new_int_type(tp=TYPE_LONGLONG, unsigned=False) -> FieldType:
+    ft = FieldType(tp=tp)
+    if unsigned:
+        ft.flag |= FLAG_UNSIGNED
+    return ft
+
+
+def new_decimal_type(precision=10, scale=0) -> FieldType:
+    return FieldType(tp=TYPE_NEWDECIMAL, flen=precision, decimal=scale)
+
+
+def new_string_type(flen=UNSPECIFIED_LENGTH, tp=TYPE_VARCHAR) -> FieldType:
+    return FieldType(tp=tp, flen=flen)
+
+
+def new_double_type() -> FieldType:
+    return FieldType(tp=TYPE_DOUBLE)
+
+
+def new_date_type() -> FieldType:
+    return FieldType(tp=TYPE_DATE)
+
+
+def new_datetime_type(fsp=0) -> FieldType:
+    return FieldType(tp=TYPE_DATETIME, decimal=fsp)
+
+
+# ---------------------------------------------------------------------------
+# Scalar value helpers. Internal scalar conventions ("datum" at the edges):
+#   int/bool -> int ; DECIMAL -> ("dec", scaled_int, scale) tuple is avoided —
+#   decimals are plain Python ints at a known column scale, or Decimal-like
+#   strings at the parser edge. DATE -> int days; DATETIME -> int micros.
+#   strings -> bytes. NULL -> None.
+# ---------------------------------------------------------------------------
+
+_EPOCH = _dt.date(1970, 1, 1)
+_EPOCH_DT = _dt.datetime(1970, 1, 1)
+
+POW10 = [10 ** i for i in range(38)]
+
+
+def date_to_days(y: int, m: int, d: int) -> int:
+    return (_dt.date(y, m, d) - _EPOCH).days
+
+
+def days_to_date(days: int) -> _dt.date:
+    return _EPOCH + _dt.timedelta(days=int(days))
+
+
+def datetime_to_micros(dt: _dt.datetime) -> int:
+    delta = dt - _EPOCH_DT
+    return (delta.days * 86400 + delta.seconds) * 1_000_000 + delta.microseconds
+
+
+def micros_to_datetime(us: int) -> _dt.datetime:
+    return _EPOCH_DT + _dt.timedelta(microseconds=int(us))
+
+
+def parse_date_str(s: str) -> int:
+    """'1995-03-15' -> days since epoch. Raises ValueError on bad input."""
+    parts = s.strip().split("-")
+    if len(parts) != 3:
+        raise ValueError(f"invalid date literal: {s!r}")
+    return date_to_days(int(parts[0]), int(parts[1]), int(parts[2]))
+
+
+def parse_datetime_str(s: str) -> int:
+    """'1995-03-15 10:30:00[.ffffff]' -> micros since epoch."""
+    s = s.strip()
+    if " " in s or "T" in s:
+        sep = " " if " " in s else "T"
+        d, t = s.split(sep, 1)
+    else:
+        d, t = s, "00:00:00"
+    y, m, dd = (int(x) for x in d.split("-"))
+    frac = 0
+    if "." in t:
+        t, fs = t.split(".", 1)
+        frac = int((fs + "000000")[:6])
+    hh, mm, ss = (int(x) for x in (t.split(":") + ["0", "0"])[:3])
+    return datetime_to_micros(_dt.datetime(y, m, dd, hh, mm, ss, frac))
+
+
+def dec_round_div(num: int, den: int) -> int:
+    """Round-half-away-from-zero integer division (MySQL decimal rounding,
+    reference: types/mydecimal.go Round)."""
+    if den == 0:
+        raise ZeroDivisionError("decimal division by zero")
+    neg = (num < 0) != (den < 0)
+    num, den = abs(num), abs(den)
+    q, r = divmod(num, den)
+    if r * 2 >= den:
+        q += 1
+    return -q if neg else q
+
+
+def dec_rescale(v: int, from_scale: int, to_scale: int) -> int:
+    """Change scale of a scaled-int decimal with MySQL half-up rounding."""
+    if to_scale == from_scale:
+        return v
+    if to_scale > from_scale:
+        return v * POW10[to_scale - from_scale]
+    return dec_round_div(v, POW10[from_scale - to_scale])
+
+
+def str_to_decimal(s: str, scale: int) -> int:
+    """Parse a decimal literal to a scaled int at `scale` (half-up rounding)."""
+    s = s.strip()
+    neg = s.startswith("-")
+    if s and s[0] in "+-":
+        s = s[1:]
+    if "e" in s or "E" in s:
+        # scientific notation: go through float-free expansion
+        mant, exp = s.lower().split("e")
+        exp = int(exp)
+        if "." in mant:
+            ip, fp = mant.split(".", 1)
+        else:
+            ip, fp = mant, ""
+        digits = (ip + fp) or "0"
+        point = len(ip) + exp
+        if point >= len(digits):
+            digits += "0" * (point - len(digits))
+            ip, fp = digits, ""
+        elif point <= 0:
+            ip, fp = "0", "0" * (-point) + digits
+        else:
+            ip, fp = digits[:point], digits[point:]
+    elif "." in s:
+        ip, fp = s.split(".", 1)
+    else:
+        ip, fp = s, ""
+    ip = ip or "0"
+    fp = fp or ""
+    v = int(ip) * POW10[scale] if scale < len(POW10) else int(ip) * 10 ** scale
+    if fp:
+        if len(fp) <= scale:
+            v += int(fp) * POW10[scale - len(fp)]
+        else:
+            keep, rest = fp[:scale], fp[scale:]
+            v += int(keep) if keep else 0
+            if rest and int(rest[0]) >= 5:
+                v += 1
+    return -v if neg else v
+
+
+def decimal_to_str(v: int, scale: int) -> str:
+    """Render a scaled-int decimal as MySQL does (fixed scale, no exponent)."""
+    if scale <= 0:
+        return str(v)
+    neg = v < 0
+    v = abs(v)
+    ip, fp = divmod(v, POW10[scale])
+    s = f"{ip}.{fp:0{scale}d}"
+    return "-" + s if neg else s
+
+
+def format_value(val, ft: FieldType):
+    """Render an internal value as the MySQL text-protocol string (or None)."""
+    if val is None:
+        return None
+    tp = ft.tp
+    if tp == TYPE_NEWDECIMAL:
+        return decimal_to_str(int(val), ft.scale)
+    if tp in INT_TYPES:
+        if ft.is_unsigned and val < 0:
+            return str(int(val) + 2**64)
+        return str(int(val))
+    if tp in FLOAT_TYPES:
+        f = float(val)
+        if f == int(f) and abs(f) < 1e15:
+            return str(int(f))
+        return repr(f)
+    if tp in (TYPE_DATE, TYPE_NEWDATE):
+        return days_to_date(val).isoformat()
+    if tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        dt = micros_to_datetime(val)
+        fsp = ft.decimal if ft.decimal not in (None, UNSPECIFIED_LENGTH) else 0
+        base = dt.strftime("%Y-%m-%d %H:%M:%S")
+        if fsp > 0:
+            base += "." + f"{dt.microsecond:06d}"[:fsp]
+        return base
+    if tp == TYPE_DURATION:
+        us = int(val)
+        neg = us < 0
+        us = abs(us)
+        ss, us_ = divmod(us, 1_000_000)
+        hh, rem = divmod(ss, 3600)
+        mm, ss = divmod(rem, 60)
+        s = f"{'-' if neg else ''}{hh:02d}:{mm:02d}:{ss:02d}"
+        fsp = ft.decimal if ft.decimal not in (None, UNSPECIFIED_LENGTH) else 0
+        if fsp > 0:
+            s += "." + f"{us_:06d}"[:fsp]
+        return s
+    if isinstance(val, bytes):
+        return val.decode("utf-8", "replace")
+    return str(val)

@@ -1,0 +1,151 @@
+"""Table/index key-value layout + row value codec.
+
+Key layout mirrors the reference (tablecodec/tablecodec.go:49-51,86,104):
+
+    record key:  t{tableID}_r{handle}          (ints memcomparable-encoded)
+    index key:   t{tableID}_i{indexID}{vals...}[{handle}]
+
+Row values use a compact varint format playing the role of row format v2
+(reference: util/rowcodec/common.go): sorted column IDs, per-column type tag.
+Values are *internal* representations (decimal already scaled-int, dates as
+day numbers), so decode is allocation-light and columnar assembly is a
+straight loop.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .utils import codec
+
+TABLE_PREFIX = b"t"
+RECORD_SEP = b"_r"
+INDEX_SEP = b"_i"
+META_PREFIX = b"m"
+
+
+def _enc_i64(v: int) -> bytes:
+    return struct.pack(">Q", (v & 0xFFFFFFFFFFFFFFFF) ^ 0x8000000000000000)
+
+
+def _dec_i64(b: bytes) -> int:
+    (u,) = struct.unpack(">Q", b)
+    v = u ^ 0x8000000000000000
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def record_prefix(table_id: int) -> bytes:
+    return TABLE_PREFIX + _enc_i64(table_id) + RECORD_SEP
+
+
+def record_key(table_id: int, handle: int) -> bytes:
+    return record_prefix(table_id) + _enc_i64(handle)
+
+
+def decode_record_key(key: bytes):
+    """-> (table_id, handle); raises ValueError if not a record key."""
+    if not key.startswith(TABLE_PREFIX) or key[9:11] != RECORD_SEP:
+        raise ValueError("not a record key")
+    return _dec_i64(key[1:9]), _dec_i64(key[11:19])
+
+
+def index_prefix(table_id: int, index_id: int) -> bytes:
+    return TABLE_PREFIX + _enc_i64(table_id) + INDEX_SEP + _enc_i64(index_id)
+
+
+def index_key(table_id: int, index_id: int, values, handle: int | None = None) -> bytes:
+    """Unique index leaves handle out of the key (stored in value); non-unique
+    appends it for uniqueness (reference: tablecodec EncodeIndexSeekKey)."""
+    key = index_prefix(table_id, index_id) + codec.encode_key(values)
+    if handle is not None:
+        buf = bytearray()
+        codec.encode_int(buf, handle)
+        key += bytes(buf)
+    return key
+
+
+def decode_index_values(key: bytes):
+    """Strip the prefix, decode datums (last may be the handle)."""
+    return codec.decode_key(key[19:])
+
+
+def table_range(table_id: int):
+    """Whole-table record range [start, end)."""
+    return record_prefix(table_id), record_prefix(table_id) + b"\xff" * 9
+
+
+def index_range(table_id: int, index_id: int):
+    p = index_prefix(table_id, index_id)
+    return p, p + b"\xff" * 16
+
+
+# -- row value codec --------------------------------------------------------
+
+_T_NULL = 0
+_T_INT = 1
+_T_FLOAT = 2
+_T_BYTES = 3
+
+ROW_VERSION = 128  # row format version tag (reference: rowcodec CodecVer=128)
+
+
+def encode_row(col_ids, values) -> bytes:
+    """Encode parallel lists of column IDs and internal values."""
+    buf = bytearray([ROW_VERSION])
+    pairs = sorted(zip(col_ids, values))
+    codec.write_uvarint(buf, len(pairs))
+    for cid, v in pairs:
+        codec.write_uvarint(buf, cid)
+        if v is None:
+            buf.append(_T_NULL)
+        elif isinstance(v, bool):
+            buf.append(_T_INT)
+            codec.write_varint(buf, int(v))
+        elif isinstance(v, int):
+            buf.append(_T_INT)
+            codec.write_varint(buf, v)
+        elif isinstance(v, float):
+            buf.append(_T_FLOAT)
+            buf += struct.pack("<d", v)
+        elif isinstance(v, (bytes, bytearray)):
+            buf.append(_T_BYTES)
+            codec.write_uvarint(buf, len(v))
+            buf += v
+        elif isinstance(v, str):
+            b = v.encode("utf-8")
+            buf.append(_T_BYTES)
+            codec.write_uvarint(buf, len(b))
+            buf += b
+        else:
+            raise TypeError(f"cannot encode row datum {type(v)}")
+    return bytes(buf)
+
+
+def decode_row(data: bytes) -> dict:
+    """-> {col_id: value}."""
+    if not data:
+        return {}
+    if data[0] != ROW_VERSION:
+        raise ValueError(f"bad row version {data[0]}")
+    pos = 1
+    n, pos = codec.read_uvarint(data, pos)
+    out = {}
+    for _ in range(n):
+        cid, pos = codec.read_uvarint(data, pos)
+        tag = data[pos]
+        pos += 1
+        if tag == _T_NULL:
+            out[cid] = None
+        elif tag == _T_INT:
+            v, pos = codec.read_varint(data, pos)
+            out[cid] = v
+        elif tag == _T_FLOAT:
+            (out[cid],) = struct.unpack("<d", data[pos:pos + 8])
+            pos += 8
+        elif tag == _T_BYTES:
+            ln, pos = codec.read_uvarint(data, pos)
+            out[cid] = bytes(data[pos:pos + ln])
+            pos += ln
+        else:
+            raise ValueError(f"bad row tag {tag}")
+    return out

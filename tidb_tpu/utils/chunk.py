@@ -1,0 +1,196 @@
+"""Columnar batch format — the engine's unit of data flow.
+
+Plays the role of the reference's ``util/chunk/chunk.go`` (Arrow-like
+Chunk/Column with null bitmaps), redesigned for TPU friendliness: fixed-width
+columns are numpy arrays that transfer to device as-is (int64/float64/float32/
+int32), nulls are boolean masks (not packed bitmaps — XLA wants bool vectors),
+and strings live host-side as object arrays of ``bytes`` with helpers to
+produce device encodings (dictionary codes, padded u8 matrices, or 64-bit
+order-preserving prefixes).
+
+Executors stream these batches Volcano-style (reference: executor/executor.go
+Next(ctx, *chunk.Chunk)); device operators consume/produce the array parts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..sqltypes import (
+    FieldType, INT_TYPES, FLOAT_TYPES, STRING_TYPES,
+    TYPE_NEWDECIMAL, TYPE_DATE, TYPE_NEWDATE, TYPE_DATETIME, TYPE_TIMESTAMP,
+    TYPE_DURATION, TYPE_FLOAT, TYPE_NULL, TYPE_JSON, format_value,
+)
+
+#: default rows per chunk flowing through the host pipeline
+#: (reference: sessionctx/variable DefMaxChunkSize=1024; larger here because
+#: device dispatch overhead favors bigger batches)
+DEFAULT_CHUNK_SIZE = 65536
+
+
+def np_dtype_for(ft: FieldType):
+    """numpy physical dtype for a field type; object means host-only bytes."""
+    tp = ft.tp
+    if tp in INT_TYPES or tp == TYPE_NEWDECIMAL or tp == TYPE_DURATION:
+        return np.int64
+    if tp == TYPE_FLOAT:
+        return np.float32
+    if tp in FLOAT_TYPES:
+        return np.float64
+    if tp in (TYPE_DATE, TYPE_NEWDATE):
+        return np.int32
+    if tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        return np.int64
+    if tp in STRING_TYPES or tp == TYPE_JSON:
+        return object
+    if tp == TYPE_NULL:
+        return object
+    return object
+
+
+class Column:
+    """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
+
+    __slots__ = ("ftype", "data", "nulls")
+
+    def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
+        self.ftype = ftype
+        self.data = data
+        if nulls is None:
+            nulls = np.zeros(len(data), dtype=bool)
+        self.nulls = nulls
+
+    def __len__(self):
+        return len(self.data)
+
+    @classmethod
+    def from_values(cls, ftype: FieldType, values) -> "Column":
+        """Build from python values (None = NULL)."""
+        dt = np_dtype_for(ftype)
+        n = len(values)
+        nulls = np.fromiter((v is None for v in values), dtype=bool, count=n)
+        if dt is object:
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                if v is None:
+                    data[i] = b""
+                elif isinstance(v, str):
+                    data[i] = v.encode("utf-8")
+                else:
+                    data[i] = bytes(v)
+        else:
+            data = np.zeros(n, dtype=dt)
+            for i, v in enumerate(values):
+                if v is not None:
+                    data[i] = v
+        return cls(ftype, data, nulls)
+
+    def value_at(self, i: int):
+        """Internal python value at row i (None for NULL)."""
+        if self.nulls[i]:
+            return None
+        v = self.data[i]
+        if isinstance(v, np.generic):
+            return v.item()
+        return v
+
+    def take(self, idx: np.ndarray) -> "Column":
+        return Column(self.ftype, self.data[idx], self.nulls[idx])
+
+    def slice(self, start: int, end: int) -> "Column":
+        return Column(self.ftype, self.data[start:end], self.nulls[start:end])
+
+    def is_device_friendly(self) -> bool:
+        return self.data.dtype != object
+
+    # -- string device encodings -------------------------------------------
+
+    def dict_encode(self):
+        """Factorize a bytes column → (codes int32, uniques object array).
+
+        Dictionary encoding is how string group-by/join keys reach the TPU:
+        the kernel sees int32 codes; the dictionary stays host-side.
+        """
+        uniques, codes = np.unique(self.data.astype(object), return_inverse=True)
+        return codes.astype(np.int32), uniques
+
+    def prefix64(self) -> np.ndarray:
+        """Order-preserving uint64 of the first 8 bytes of each value —
+        enough to sort/compare most real keys on device; ties are broken
+        host-side."""
+        n = len(self.data)
+        out = np.zeros(n, dtype=np.uint64)
+        for i in range(n):
+            b = self.data[i][:8]
+            out[i] = int.from_bytes(b.ljust(8, b"\0"), "big")
+        return out
+
+
+class Chunk:
+    """A batch of rows in columnar layout."""
+
+    __slots__ = ("columns",)
+
+    def __init__(self, columns: list[Column]):
+        self.columns = columns
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def __len__(self):
+        return self.num_rows
+
+    @classmethod
+    def from_rows(cls, ftypes: list[FieldType], rows) -> "Chunk":
+        cols = []
+        for ci, ft in enumerate(ftypes):
+            cols.append(Column.from_values(ft, [r[ci] for r in rows]))
+        return cls(cols)
+
+    @classmethod
+    def empty(cls, ftypes: list[FieldType]) -> "Chunk":
+        return cls([Column.from_values(ft, []) for ft in ftypes])
+
+    def row(self, i: int) -> tuple:
+        return tuple(c.value_at(i) for c in self.columns)
+
+    def to_rows(self) -> list[tuple]:
+        return [self.row(i) for i in range(self.num_rows)]
+
+    def take(self, idx: np.ndarray) -> "Chunk":
+        return Chunk([c.take(idx) for c in self.columns])
+
+    def slice(self, start: int, end: int) -> "Chunk":
+        return Chunk([c.slice(start, end) for c in self.columns])
+
+    def filter(self, mask: np.ndarray) -> "Chunk":
+        idx = np.nonzero(mask)[0]
+        return self.take(idx)
+
+    def to_display_rows(self) -> list[tuple]:
+        """Rows rendered as MySQL text protocol strings (None for NULL)."""
+        out = []
+        for i in range(self.num_rows):
+            out.append(tuple(
+                format_value(c.value_at(i), c.ftype) for c in self.columns
+            ))
+        return out
+
+
+def concat_chunks(chunks: list[Chunk]) -> Chunk:
+    """Concatenate non-empty list of chunks with identical schemas."""
+    if len(chunks) == 1:
+        return chunks[0]
+    first = chunks[0]
+    cols = []
+    for ci in range(first.num_cols):
+        datas = [c.columns[ci].data for c in chunks]
+        nulls = [c.columns[ci].nulls for c in chunks]
+        cols.append(Column(first.columns[ci].ftype,
+                           np.concatenate(datas), np.concatenate(nulls)))
+    return Chunk(cols)

@@ -1,0 +1,176 @@
+"""Order-preserving (memcomparable) datum codec.
+
+Byte-compatible in spirit with the reference's ``util/codec/codec.go``
+(flags: NIL=0x00, BYTES=0x01, INT=0x03, UINT=0x04, FLOAT=0x05): encoded keys
+compare byte-wise in the same order as their decoded values, which is what
+makes range scans over the KV store work. Not wire-identical to the
+reference (we are not speaking to a real TiKV), but the same design.
+"""
+
+from __future__ import annotations
+
+import struct
+
+NIL_FLAG = 0x00
+BYTES_FLAG = 0x01
+COMPACT_BYTES_FLAG = 0x02
+INT_FLAG = 0x03
+UINT_FLAG = 0x04
+FLOAT_FLAG = 0x05
+MAX_FLAG = 0xFA
+
+_SIGN_MASK = 0x8000000000000000
+_ENC_GROUP_SIZE = 8
+_ENC_MARKER = 0xFF
+_ENC_PAD = 0x00
+
+
+def encode_int(buf: bytearray, v: int) -> None:
+    """Sign-flipped big-endian int64 (reference: util/codec/number.go EncodeInt)."""
+    buf.append(INT_FLAG)
+    buf += struct.pack(">Q", (v & 0xFFFFFFFFFFFFFFFF) ^ _SIGN_MASK)
+
+
+def encode_uint(buf: bytearray, v: int) -> None:
+    buf.append(UINT_FLAG)
+    buf += struct.pack(">Q", v & 0xFFFFFFFFFFFFFFFF)
+
+
+def encode_float(buf: bytearray, f: float) -> None:
+    """IEEE bits, sign-flip transform for total order (reference: util/codec/float.go)."""
+    buf.append(FLOAT_FLAG)
+    u = struct.unpack(">Q", struct.pack(">d", f))[0]
+    if u & _SIGN_MASK:
+        u = ~u & 0xFFFFFFFFFFFFFFFF
+    else:
+        u |= _SIGN_MASK
+    buf += struct.pack(">Q", u)
+
+
+def encode_bytes(buf: bytearray, data: bytes) -> None:
+    """Group-of-8 escape encoding (reference: util/codec/bytes.go EncodeBytes):
+    data is chopped into 8-byte groups, each padded with 0x00 and followed by
+    a marker byte 0xFF - pad_count, preserving byte-wise order."""
+    buf.append(BYTES_FLAG)
+    i = 0
+    n = len(data)
+    while True:
+        group = data[i:i + _ENC_GROUP_SIZE]
+        pad = _ENC_GROUP_SIZE - len(group)
+        buf += group
+        buf += bytes([_ENC_PAD]) * pad
+        buf.append(_ENC_MARKER - pad)
+        i += _ENC_GROUP_SIZE
+        if pad > 0 or i > n:
+            break
+        if i == n:
+            # full group boundary: emit one more empty group so "abc" < "abc\x00"
+            buf += bytes([_ENC_PAD]) * _ENC_GROUP_SIZE
+            buf.append(_ENC_MARKER - _ENC_GROUP_SIZE)
+            break
+
+
+def encode_nil(buf: bytearray) -> None:
+    buf.append(NIL_FLAG)
+
+
+def encode_max(buf: bytearray) -> None:
+    buf.append(MAX_FLAG)
+
+
+def decode_one(data: bytes, pos: int):
+    """Decode one datum at pos; returns (value, new_pos). NULL -> None."""
+    flag = data[pos]
+    pos += 1
+    if flag == NIL_FLAG:
+        return None, pos
+    if flag == INT_FLAG:
+        (u,) = struct.unpack(">Q", data[pos:pos + 8])
+        v = u ^ _SIGN_MASK
+        if v >= 1 << 63:
+            v -= 1 << 64
+        return v, pos + 8
+    if flag == UINT_FLAG:
+        (u,) = struct.unpack(">Q", data[pos:pos + 8])
+        return u, pos + 8
+    if flag == FLOAT_FLAG:
+        (u,) = struct.unpack(">Q", data[pos:pos + 8])
+        if u & _SIGN_MASK:
+            u &= ~_SIGN_MASK & 0xFFFFFFFFFFFFFFFF
+        else:
+            u = ~u & 0xFFFFFFFFFFFFFFFF
+        return struct.unpack(">d", struct.pack(">Q", u))[0], pos + 8
+    if flag == BYTES_FLAG:
+        out = bytearray()
+        while True:
+            group = data[pos:pos + _ENC_GROUP_SIZE]
+            marker = data[pos + _ENC_GROUP_SIZE]
+            pos += _ENC_GROUP_SIZE + 1
+            pad = _ENC_MARKER - marker
+            out += group[:_ENC_GROUP_SIZE - pad]
+            if pad > 0:
+                break
+        return bytes(out), pos
+    raise ValueError(f"unknown codec flag {flag:#x}")
+
+
+def encode_key(values) -> bytes:
+    """Encode a tuple of python values into one memcomparable key."""
+    buf = bytearray()
+    for v in values:
+        if v is None:
+            encode_nil(buf)
+        elif isinstance(v, bool):
+            encode_int(buf, int(v))
+        elif isinstance(v, int):
+            encode_int(buf, v)
+        elif isinstance(v, float):
+            encode_float(buf, v)
+        elif isinstance(v, (bytes, bytearray)):
+            encode_bytes(buf, bytes(v))
+        elif isinstance(v, str):
+            encode_bytes(buf, v.encode("utf-8"))
+        else:
+            raise TypeError(f"cannot encode key datum of type {type(v)}")
+    return bytes(buf)
+
+
+def decode_key(data: bytes):
+    """Decode a memcomparable key back into a list of values."""
+    out = []
+    pos = 0
+    while pos < len(data):
+        v, pos = decode_one(data, pos)
+        out.append(v)
+    return out
+
+
+# -- varint helpers (row values, non-memcomparable) -------------------------
+
+def write_uvarint(buf: bytearray, v: int) -> None:
+    while v >= 0x80:
+        buf.append((v & 0x7F) | 0x80)
+        v >>= 7
+    buf.append(v)
+
+
+def read_uvarint(data: bytes, pos: int):
+    shift = 0
+    v = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if b < 0x80:
+            return v, pos
+        shift += 7
+
+
+def write_varint(buf: bytearray, v: int) -> None:
+    # zigzag
+    write_uvarint(buf, (v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1)
+
+
+def read_varint(data: bytes, pos: int):
+    u, pos = read_uvarint(data, pos)
+    return ((u >> 1) ^ -(u & 1)), pos
