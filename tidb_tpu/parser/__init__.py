@@ -1,0 +1,13 @@
+"""MySQL-dialect SQL frontend (reference: parser/ — a standalone module with
+a 13.8k-line yacc grammar). Here: a hand-written lexer + Pratt/recursive-
+descent parser producing a dataclass AST with SQL restore and digest.
+
+Grammar scope grows with the engine; the yacc approach of the reference is
+replaced by recursive descent because the dialect subset is curated, error
+messages matter, and there is no build step.
+"""
+
+from .parser import Parser, parse, parse_one
+from .digester import normalize, digest
+
+__all__ = ["Parser", "parse", "parse_one", "normalize", "digest"]

@@ -1,0 +1,779 @@
+"""AST node definitions with SQL restore (reference: parser/ast/ — dml.go,
+ddl.go, expressions.go; Node.Restore). Nodes are plain dataclasses; the
+visitor of the reference becomes ad-hoc traversal in the planner."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..sqltypes import FieldType
+
+
+class Node:
+    def restore(self) -> str:
+        raise NotImplementedError(type(self).__name__)
+
+    def __repr__(self):
+        try:
+            return f"<{type(self).__name__} {self.restore()}>"
+        except Exception:
+            return f"<{type(self).__name__}>"
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+class ExprNode(Node):
+    pass
+
+
+@dataclass(repr=False)
+class Literal(ExprNode):
+    """Constant literal. kind: int|dec|float|str|null|bool|date|time|hex.
+    `val` keeps the lexical value (dec keeps text to preserve scale)."""
+    kind: str
+    val: object
+
+    def restore(self):
+        if self.kind == "null":
+            return "NULL"
+        if self.kind == "str":
+            return "'" + str(self.val).replace("\\", "\\\\").replace("'", "\\'") + "'"
+        if self.kind == "bool":
+            return "TRUE" if self.val else "FALSE"
+        if self.kind in ("date", "time", "datetime"):
+            kw = {"date": "DATE", "time": "TIME", "datetime": "TIMESTAMP"}[self.kind]
+            return f"{kw} '{self.val}'"
+        return str(self.val)
+
+
+@dataclass(repr=False)
+class ColumnName(ExprNode):
+    name: str
+    table: str = ""
+    schema: str = ""
+
+    def restore(self):
+        parts = [p for p in (self.schema, self.table, self.name) if p]
+        return ".".join(f"`{p}`" for p in parts)
+
+
+@dataclass(repr=False)
+class ParamMarker(ExprNode):
+    index: int = 0
+
+    def restore(self):
+        return "?"
+
+
+@dataclass(repr=False)
+class VariableExpr(ExprNode):
+    name: str
+    is_system: bool = False
+    scope: str = ""  # "", "global", "session"
+    value: Optional[ExprNode] = None  # for @v := expr
+
+    def restore(self):
+        if self.is_system:
+            pre = f"@@{self.scope}." if self.scope else "@@"
+            return pre + self.name
+        return "@" + self.name
+
+
+@dataclass(repr=False)
+class BinaryOp(ExprNode):
+    op: str  # lowercase: and or xor + - * / div mod % = <=> < > <= >= != like & | ^ << >>
+    left: ExprNode
+    right: ExprNode
+
+    def restore(self):
+        return f"({self.left.restore()} {self.op.upper()} {self.right.restore()})"
+
+
+@dataclass(repr=False)
+class UnaryOp(ExprNode):
+    op: str  # - not ~ !
+    operand: ExprNode
+
+    def restore(self):
+        return f"({self.op.upper()} {self.operand.restore()})"
+
+
+@dataclass(repr=False)
+class IsNullExpr(ExprNode):
+    expr: ExprNode
+    negated: bool = False
+
+    def restore(self):
+        return f"({self.expr.restore()} IS {'NOT ' if self.negated else ''}NULL)"
+
+
+@dataclass(repr=False)
+class IsTruthExpr(ExprNode):
+    expr: ExprNode
+    truth: bool = True
+    negated: bool = False
+
+    def restore(self):
+        return f"({self.expr.restore()} IS {'NOT ' if self.negated else ''}{'TRUE' if self.truth else 'FALSE'})"
+
+
+@dataclass(repr=False)
+class BetweenExpr(ExprNode):
+    expr: ExprNode
+    low: ExprNode
+    high: ExprNode
+    negated: bool = False
+
+    def restore(self):
+        return (f"({self.expr.restore()} {'NOT ' if self.negated else ''}BETWEEN "
+                f"{self.low.restore()} AND {self.high.restore()})")
+
+
+@dataclass(repr=False)
+class InExpr(ExprNode):
+    expr: ExprNode
+    items: list = field(default_factory=list)  # list[ExprNode] OR [SubqueryExpr]
+    negated: bool = False
+
+    def restore(self):
+        inner = ", ".join(e.restore() for e in self.items)
+        return f"({self.expr.restore()} {'NOT ' if self.negated else ''}IN ({inner}))"
+
+
+@dataclass(repr=False)
+class LikeExpr(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    negated: bool = False
+    escape: str = "\\"
+
+    def restore(self):
+        return f"({self.expr.restore()} {'NOT ' if self.negated else ''}LIKE {self.pattern.restore()})"
+
+
+@dataclass(repr=False)
+class RegexpExpr(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    negated: bool = False
+
+    def restore(self):
+        return f"({self.expr.restore()} {'NOT ' if self.negated else ''}REGEXP {self.pattern.restore()})"
+
+
+@dataclass(repr=False)
+class CaseExpr(ExprNode):
+    operand: Optional[ExprNode]
+    whens: list = field(default_factory=list)  # [(cond, result)]
+    else_: Optional[ExprNode] = None
+
+    def restore(self):
+        s = "CASE"
+        if self.operand:
+            s += " " + self.operand.restore()
+        for c, r in self.whens:
+            s += f" WHEN {c.restore()} THEN {r.restore()}"
+        if self.else_:
+            s += " ELSE " + self.else_.restore()
+        return s + " END"
+
+
+@dataclass(repr=False)
+class FuncCall(ExprNode):
+    name: str  # lowercase
+    args: list = field(default_factory=list)
+
+    def restore(self):
+        return f"{self.name.upper()}({', '.join(a.restore() for a in self.args)})"
+
+
+@dataclass(repr=False)
+class AggregateFunc(ExprNode):
+    name: str  # count sum avg min max group_concat bit_or bit_and var_pop stddev_pop
+    args: list = field(default_factory=list)
+    distinct: bool = False
+
+    def restore(self):
+        inner = "*" if not self.args else ", ".join(a.restore() for a in self.args)
+        return f"{self.name.upper()}({'DISTINCT ' if self.distinct else ''}{inner})"
+
+
+@dataclass(repr=False)
+class WindowFunc(ExprNode):
+    name: str
+    args: list = field(default_factory=list)
+    partition_by: list = field(default_factory=list)
+    order_by: list = field(default_factory=list)  # [ByItem]
+    frame: object = None
+
+    def restore(self):
+        s = f"{self.name.upper()}({', '.join(a.restore() for a in self.args)}) OVER ("
+        if self.partition_by:
+            s += "PARTITION BY " + ", ".join(e.restore() for e in self.partition_by)
+        if self.order_by:
+            s += " ORDER BY " + ", ".join(b.restore() for b in self.order_by)
+        return s + ")"
+
+
+@dataclass(repr=False)
+class SubqueryExpr(ExprNode):
+    query: "SelectStmt"
+
+    def restore(self):
+        return f"({self.query.restore()})"
+
+
+@dataclass(repr=False)
+class ExistsExpr(ExprNode):
+    query: SubqueryExpr
+    negated: bool = False
+
+    def restore(self):
+        return f"({'NOT ' if self.negated else ''}EXISTS {self.query.restore()})"
+
+
+@dataclass(repr=False)
+class CompareSubquery(ExprNode):
+    """expr op ANY/ALL (subquery)"""
+    op: str
+    expr: ExprNode
+    query: SubqueryExpr
+    quantifier: str = "any"  # any | all
+
+    def restore(self):
+        return f"({self.expr.restore()} {self.op.upper()} {self.quantifier.upper()} {self.query.restore()})"
+
+
+@dataclass(repr=False)
+class RowExpr(ExprNode):
+    items: list = field(default_factory=list)
+
+    def restore(self):
+        return "(" + ", ".join(e.restore() for e in self.items) + ")"
+
+
+@dataclass(repr=False)
+class CastExpr(ExprNode):
+    expr: ExprNode
+    ftype: FieldType
+
+    def restore(self):
+        return f"CAST({self.expr.restore()} AS {self.ftype.sql_string()})"
+
+
+@dataclass(repr=False)
+class IntervalExpr(ExprNode):
+    value: ExprNode
+    unit: str  # day month year hour minute second week quarter microsecond
+
+    def restore(self):
+        return f"INTERVAL {self.value.restore()} {self.unit.upper()}"
+
+
+@dataclass(repr=False)
+class DefaultExpr(ExprNode):
+    col: Optional[ColumnName] = None
+
+    def restore(self):
+        return "DEFAULT"
+
+
+@dataclass(repr=False)
+class StarExpr(ExprNode):
+    table: str = ""
+    schema: str = ""
+
+    def restore(self):
+        pre = ".".join(f"`{p}`" for p in (self.schema, self.table) if p)
+        return (pre + "." if pre else "") + "*"
+
+
+# ---------------------------------------------------------------------------
+# Table references
+# ---------------------------------------------------------------------------
+
+@dataclass(repr=False)
+class TableName(Node):
+    name: str
+    schema: str = ""
+    as_name: str = ""
+    index_hints: list = field(default_factory=list)
+    partition_names: list = field(default_factory=list)
+
+    def restore(self):
+        s = (f"`{self.schema}`." if self.schema else "") + f"`{self.name}`"
+        if self.as_name:
+            s += f" AS `{self.as_name}`"
+        return s
+
+
+@dataclass(repr=False)
+class SubqueryTable(Node):
+    query: "SelectStmt"
+    as_name: str = ""
+
+    def restore(self):
+        return f"({self.query.restore()}) AS `{self.as_name}`"
+
+
+@dataclass(repr=False)
+class Join(Node):
+    left: Node
+    right: Node
+    kind: str = "inner"  # inner | left | right | cross
+    on: Optional[ExprNode] = None
+    using: list = field(default_factory=list)
+
+    def restore(self):
+        k = {"inner": "JOIN", "cross": "CROSS JOIN",
+             "left": "LEFT JOIN", "right": "RIGHT JOIN"}[self.kind]
+        s = f"{self.left.restore()} {k} {self.right.restore()}"
+        if self.on is not None:
+            s += f" ON {self.on.restore()}"
+        elif self.using:
+            s += " USING (" + ", ".join(f"`{c}`" for c in self.using) + ")"
+        return s
+
+
+# ---------------------------------------------------------------------------
+# Statements
+# ---------------------------------------------------------------------------
+
+class StmtNode(Node):
+    pass
+
+
+@dataclass(repr=False)
+class ByItem(Node):
+    expr: ExprNode
+    desc: bool = False
+
+    def restore(self):
+        return self.expr.restore() + (" DESC" if self.desc else "")
+
+
+@dataclass(repr=False)
+class Limit(Node):
+    count: Optional[ExprNode] = None
+    offset: Optional[ExprNode] = None
+
+    def restore(self):
+        s = "LIMIT "
+        if self.offset is not None:
+            s += f"{self.offset.restore()}, "
+        return s + self.count.restore()
+
+
+@dataclass(repr=False)
+class SelectField(Node):
+    expr: ExprNode
+    as_name: str = ""
+
+    def restore(self):
+        s = self.expr.restore()
+        if self.as_name:
+            s += f" AS `{self.as_name}`"
+        return s
+
+
+@dataclass(repr=False)
+class SelectStmt(StmtNode):
+    fields: list = field(default_factory=list)       # [SelectField]
+    from_: Optional[Node] = None
+    where: Optional[ExprNode] = None
+    group_by: list = field(default_factory=list)     # [ByItem]
+    having: Optional[ExprNode] = None
+    order_by: list = field(default_factory=list)     # [ByItem]
+    limit: Optional[Limit] = None
+    distinct: bool = False
+    for_update: bool = False
+    lock_in_share_mode: bool = False
+
+    def restore(self):
+        s = "SELECT " + ("DISTINCT " if self.distinct else "")
+        s += ", ".join(f.restore() for f in self.fields)
+        if self.from_ is not None:
+            s += " FROM " + self.from_.restore()
+        if self.where is not None:
+            s += " WHERE " + self.where.restore()
+        if self.group_by:
+            s += " GROUP BY " + ", ".join(b.restore() for b in self.group_by)
+        if self.having is not None:
+            s += " HAVING " + self.having.restore()
+        if self.order_by:
+            s += " ORDER BY " + ", ".join(b.restore() for b in self.order_by)
+        if self.limit is not None:
+            s += " " + self.limit.restore()
+        if self.for_update:
+            s += " FOR UPDATE"
+        return s
+
+
+@dataclass(repr=False)
+class SetOprStmt(StmtNode):
+    """UNION / UNION ALL / INTERSECT / EXCEPT chain."""
+    selects: list = field(default_factory=list)   # [SelectStmt]
+    ops: list = field(default_factory=list)       # ["union"|"union all"|...] len-1
+    order_by: list = field(default_factory=list)
+    limit: Optional[Limit] = None
+
+    def restore(self):
+        parts = [self.selects[0].restore()]
+        for op, sel in zip(self.ops, self.selects[1:]):
+            parts.append(op.upper())
+            parts.append(sel.restore())
+        s = " ".join(parts)
+        if self.order_by:
+            s += " ORDER BY " + ", ".join(b.restore() for b in self.order_by)
+        if self.limit:
+            s += " " + self.limit.restore()
+        return s
+
+
+@dataclass(repr=False)
+class InsertStmt(StmtNode):
+    table: TableName = None
+    columns: list = field(default_factory=list)       # [str]
+    values: list = field(default_factory=list)        # [[ExprNode]]
+    select: Optional[SelectStmt] = None
+    is_replace: bool = False
+    ignore: bool = False
+    on_duplicate: list = field(default_factory=list)  # [(ColumnName, ExprNode)]
+
+    def restore(self):
+        verb = "REPLACE" if self.is_replace else "INSERT"
+        s = f"{verb} {'IGNORE ' if self.ignore else ''}INTO {self.table.restore()}"
+        if self.columns:
+            s += " (" + ", ".join(f"`{c}`" for c in self.columns) + ")"
+        if self.select is not None:
+            s += " " + self.select.restore()
+        else:
+            rows = ", ".join("(" + ", ".join(e.restore() for e in row) + ")"
+                             for row in self.values)
+            s += " VALUES " + rows
+        if self.on_duplicate:
+            s += " ON DUPLICATE KEY UPDATE " + ", ".join(
+                f"{c.restore()}={e.restore()}" for c, e in self.on_duplicate)
+        return s
+
+
+@dataclass(repr=False)
+class UpdateStmt(StmtNode):
+    table: Node = None
+    assignments: list = field(default_factory=list)  # [(ColumnName, ExprNode)]
+    where: Optional[ExprNode] = None
+    order_by: list = field(default_factory=list)
+    limit: Optional[Limit] = None
+
+    def restore(self):
+        s = f"UPDATE {self.table.restore()} SET "
+        s += ", ".join(f"{c.restore()}={e.restore()}" for c, e in self.assignments)
+        if self.where is not None:
+            s += " WHERE " + self.where.restore()
+        if self.order_by:
+            s += " ORDER BY " + ", ".join(b.restore() for b in self.order_by)
+        if self.limit:
+            s += " " + self.limit.restore()
+        return s
+
+
+@dataclass(repr=False)
+class DeleteStmt(StmtNode):
+    table: Node = None
+    where: Optional[ExprNode] = None
+    order_by: list = field(default_factory=list)
+    limit: Optional[Limit] = None
+
+    def restore(self):
+        s = f"DELETE FROM {self.table.restore()}"
+        if self.where is not None:
+            s += " WHERE " + self.where.restore()
+        if self.order_by:
+            s += " ORDER BY " + ", ".join(b.restore() for b in self.order_by)
+        if self.limit:
+            s += " " + self.limit.restore()
+        return s
+
+
+# -- DDL --------------------------------------------------------------------
+
+@dataclass(repr=False)
+class ColumnDef(Node):
+    name: str
+    ftype: FieldType = None
+    options: dict = field(default_factory=dict)
+    # options keys: not_null, null, primary, unique, auto_increment,
+    #               default (ExprNode), comment (str), on_update (ExprNode)
+
+    def restore(self):
+        s = f"`{self.name}` {self.ftype.sql_string()}"
+        if self.options.get("not_null"):
+            s += " NOT NULL"
+        if self.options.get("auto_increment"):
+            s += " AUTO_INCREMENT"
+        if "default" in self.options:
+            s += f" DEFAULT {self.options['default'].restore()}"
+        if self.options.get("primary"):
+            s += " PRIMARY KEY"
+        if self.options.get("unique"):
+            s += " UNIQUE"
+        return s
+
+
+@dataclass(repr=False)
+class Constraint(Node):
+    kind: str  # primary | unique | index | fulltext | foreign
+    name: str = ""
+    columns: list = field(default_factory=list)  # [(colname, length|None)]
+    ref: object = None
+
+    def restore(self):
+        cols = ", ".join(f"`{c}`" for c, _ in self.columns)
+        if self.kind == "primary":
+            return f"PRIMARY KEY ({cols})"
+        if self.kind == "unique":
+            return f"UNIQUE KEY `{self.name}` ({cols})"
+        return f"KEY `{self.name}` ({cols})"
+
+
+@dataclass(repr=False)
+class CreateTableStmt(StmtNode):
+    table: TableName = None
+    columns: list = field(default_factory=list)      # [ColumnDef]
+    constraints: list = field(default_factory=list)  # [Constraint]
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)      # engine, charset, auto_increment, comment
+    like: Optional[TableName] = None
+    select: Optional[SelectStmt] = None
+
+    def restore(self):
+        s = "CREATE TABLE "
+        if self.if_not_exists:
+            s += "IF NOT EXISTS "
+        s += self.table.restore()
+        if self.like is not None:
+            return s + f" LIKE {self.like.restore()}"
+        items = [c.restore() for c in self.columns] + [c.restore() for c in self.constraints]
+        s += " (" + ", ".join(items) + ")"
+        return s
+
+
+@dataclass(repr=False)
+class DropTableStmt(StmtNode):
+    tables: list = field(default_factory=list)
+    if_exists: bool = False
+    is_view: bool = False
+
+    def restore(self):
+        return (f"DROP {'VIEW' if self.is_view else 'TABLE'} "
+                + ("IF EXISTS " if self.if_exists else "")
+                + ", ".join(t.restore() for t in self.tables))
+
+
+@dataclass(repr=False)
+class TruncateTableStmt(StmtNode):
+    table: TableName = None
+
+    def restore(self):
+        return f"TRUNCATE TABLE {self.table.restore()}"
+
+
+@dataclass(repr=False)
+class CreateDatabaseStmt(StmtNode):
+    name: str = ""
+    if_not_exists: bool = False
+
+    def restore(self):
+        return "CREATE DATABASE " + ("IF NOT EXISTS " if self.if_not_exists else "") + f"`{self.name}`"
+
+
+@dataclass(repr=False)
+class DropDatabaseStmt(StmtNode):
+    name: str = ""
+    if_exists: bool = False
+
+    def restore(self):
+        return "DROP DATABASE " + ("IF EXISTS " if self.if_exists else "") + f"`{self.name}`"
+
+
+@dataclass(repr=False)
+class CreateIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableName = None
+    columns: list = field(default_factory=list)
+    unique: bool = False
+    if_not_exists: bool = False
+
+    def restore(self):
+        return (f"CREATE {'UNIQUE ' if self.unique else ''}INDEX `{self.index_name}` "
+                f"ON {self.table.restore()} ("
+                + ", ".join(f"`{c}`" for c, _ in self.columns) + ")")
+
+
+@dataclass(repr=False)
+class DropIndexStmt(StmtNode):
+    index_name: str = ""
+    table: TableName = None
+    if_exists: bool = False
+
+    def restore(self):
+        return f"DROP INDEX `{self.index_name}` ON {self.table.restore()}"
+
+
+@dataclass(repr=False)
+class AlterTableStmt(StmtNode):
+    table: TableName = None
+    specs: list = field(default_factory=list)
+    # spec: ("add_column", ColumnDef, pos) | ("drop_column", name)
+    #     | ("add_index", Constraint) | ("drop_index", name)
+    #     | ("modify_column", ColumnDef) | ("change_column", old, ColumnDef)
+    #     | ("rename", TableName) | ("add_primary", Constraint) | ("drop_primary",)
+    #     | ("auto_increment", int)
+
+    def restore(self):
+        return f"ALTER TABLE {self.table.restore()} ..."
+
+
+@dataclass(repr=False)
+class RenameTableStmt(StmtNode):
+    pairs: list = field(default_factory=list)  # [(TableName, TableName)]
+
+    def restore(self):
+        return "RENAME TABLE " + ", ".join(
+            f"{a.restore()} TO {b.restore()}" for a, b in self.pairs)
+
+
+# -- simple statements ------------------------------------------------------
+
+@dataclass(repr=False)
+class UseStmt(StmtNode):
+    db: str = ""
+
+    def restore(self):
+        return f"USE `{self.db}`"
+
+
+@dataclass(repr=False)
+class SetStmt(StmtNode):
+    # items: [(scope, name, ExprNode)] scope in {"session","global","user"}
+    items: list = field(default_factory=list)
+
+    def restore(self):
+        return "SET " + ", ".join(f"{s + '.' if s not in ('', 'user') else ''}{n}={e.restore()}"
+                                  for s, n, e in self.items)
+
+
+@dataclass(repr=False)
+class ShowStmt(StmtNode):
+    kind: str = ""   # databases|tables|columns|create_table|variables|index|processlist|status|engines|charset|collation|warnings|schemas|table_status
+    target: object = None
+    db: str = ""
+    like: Optional[ExprNode] = None
+    where: Optional[ExprNode] = None
+    full: bool = False
+    global_scope: bool = False
+
+    def restore(self):
+        return f"SHOW {self.kind.upper()}"
+
+
+@dataclass(repr=False)
+class ExplainStmt(StmtNode):
+    stmt: StmtNode = None
+    analyze: bool = False
+    format: str = "row"
+
+    def restore(self):
+        return f"EXPLAIN {'ANALYZE ' if self.analyze else ''}{self.stmt.restore()}"
+
+
+@dataclass(repr=False)
+class BeginStmt(StmtNode):
+    pessimistic: bool = None  # None = session default
+
+    def restore(self):
+        return "START TRANSACTION"
+
+
+@dataclass(repr=False)
+class CommitStmt(StmtNode):
+    def restore(self):
+        return "COMMIT"
+
+
+@dataclass(repr=False)
+class RollbackStmt(StmtNode):
+    def restore(self):
+        return "ROLLBACK"
+
+
+@dataclass(repr=False)
+class AnalyzeTableStmt(StmtNode):
+    tables: list = field(default_factory=list)
+
+    def restore(self):
+        return "ANALYZE TABLE " + ", ".join(t.restore() for t in self.tables)
+
+
+@dataclass(repr=False)
+class PrepareStmt(StmtNode):
+    name: str = ""
+    sql: object = None  # str literal or user variable name
+
+    def restore(self):
+        return f"PREPARE `{self.name}` FROM ..."
+
+
+@dataclass(repr=False)
+class ExecuteStmt(StmtNode):
+    name: str = ""
+    using: list = field(default_factory=list)  # [user var names]
+
+    def restore(self):
+        return f"EXECUTE `{self.name}`"
+
+
+@dataclass(repr=False)
+class DeallocateStmt(StmtNode):
+    name: str = ""
+
+    def restore(self):
+        return f"DEALLOCATE PREPARE `{self.name}`"
+
+
+@dataclass(repr=False)
+class AdminStmt(StmtNode):
+    kind: str = ""  # check_table | show_ddl | show_ddl_jobs | cancel_ddl_jobs
+    tables: list = field(default_factory=list)
+    job_ids: list = field(default_factory=list)
+
+    def restore(self):
+        return f"ADMIN {self.kind.upper()}"
+
+
+@dataclass(repr=False)
+class FlushStmt(StmtNode):
+    kind: str = ""
+
+    def restore(self):
+        return f"FLUSH {self.kind.upper()}"
+
+
+@dataclass(repr=False)
+class KillStmt(StmtNode):
+    conn_id: int = 0
+    query_only: bool = False
+
+    def restore(self):
+        return f"KILL {'QUERY ' if self.query_only else ''}{self.conn_id}"
+
+
+@dataclass(repr=False)
+class TraceStmt(StmtNode):
+    stmt: StmtNode = None
+
+    def restore(self):
+        return f"TRACE {self.stmt.restore()}"

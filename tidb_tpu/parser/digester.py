@@ -1,0 +1,49 @@
+"""SQL normalization + digest (reference: parser/digester.go NormalizeDigest):
+literals become '?', whitespace collapses, keywords lowercase. The digest keys
+plan cache, statement summary, and plan binding."""
+
+from __future__ import annotations
+
+import hashlib
+
+from .lexer import (
+    EOF, IDENT, NUM_DEC, NUM_FLOAT, NUM_INT, OP, PARAM, QIDENT, STRING,
+    SYSVAR, USERVAR, tokenize,
+)
+
+
+def normalize(sql: str) -> str:
+    try:
+        toks = tokenize(sql)
+    except Exception:
+        return sql.strip().lower()
+    out = []
+    prev_lit = False
+    for t in toks:
+        if t.kind == EOF:
+            break
+        if t.kind in (NUM_INT, NUM_DEC, NUM_FLOAT, STRING, PARAM):
+            # collapse IN (?, ?, ?) lists into (...)
+            if prev_lit:
+                continue
+            out.append("?")
+            prev_lit = True
+            continue
+        if t.kind == OP and t.val == "," and prev_lit:
+            continue
+        prev_lit = False
+        if t.kind == IDENT:
+            out.append(t.val.lower())
+        elif t.kind == QIDENT:
+            out.append(t.val.lower())
+        elif t.kind == SYSVAR:
+            out.append("@@" + t.val.lower())
+        elif t.kind == USERVAR:
+            out.append("@" + t.val.lower())
+        else:
+            out.append(str(t.val))
+    return " ".join(out)
+
+
+def digest(sql: str) -> str:
+    return hashlib.sha256(normalize(sql).encode()).hexdigest()

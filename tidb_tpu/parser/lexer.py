@@ -1,0 +1,193 @@
+"""SQL lexer (reference: parser/lexer.go, hand-written there too)."""
+
+from __future__ import annotations
+
+from ..errors import ParseError
+
+# token kinds
+EOF = "eof"
+IDENT = "ident"          # possibly-quoted identifier
+QIDENT = "qident"        # backquoted — never a keyword
+NUM_INT = "int"
+NUM_DEC = "dec"          # decimal literal (has . or small exponent) — text kept
+NUM_FLOAT = "float"
+STRING = "str"
+OP = "op"
+PARAM = "param"          # ? placeholder
+SYSVAR = "sysvar"        # @@name / @@global.name
+USERVAR = "uservar"      # @name
+
+_OPS = [
+    "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
+    "+", "-", "*", "/", "%", "(", ")", ",", ".", ";", "=", "<", ">",
+    "~", "^", "&", "|", "!",
+]
+
+
+class Token:
+    __slots__ = ("kind", "val", "pos")
+
+    def __init__(self, kind, val, pos):
+        self.kind = kind
+        self.val = val
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.val!r})"
+
+
+def tokenize(sql: str) -> list[Token]:
+    toks = []
+    i = 0
+    n = len(sql)
+    while i < n:
+        c = sql[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        # comments
+        if c == "#" or (c == "-" and sql[i:i + 3] in ("-- ", "--\t", "--\n") or sql[i:i + 2] == "--" and (i + 2 == n or sql[i + 2] in " \t\r\n")):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql[i:i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise ParseError("unterminated comment")
+            # executable comment /*! ... */ — treat contents as SQL? keep simple: skip
+            i = j + 2
+            continue
+        # strings
+        if c in ("'", '"'):
+            val, i = _scan_string(sql, i, c)
+            toks.append(Token(STRING, val, i))
+            continue
+        if c == "`":
+            j = i + 1
+            out = []
+            while j < n:
+                if sql[j] == "`":
+                    if sql[j + 1:j + 2] == "`":
+                        out.append("`")
+                        j += 2
+                        continue
+                    break
+                out.append(sql[j])
+                j += 1
+            else:
+                raise ParseError("unterminated identifier")
+            toks.append(Token(QIDENT, "".join(out), i))
+            i = j + 1
+            continue
+        # numbers
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            tok, i = _scan_number(sql, i)
+            toks.append(tok)
+            continue
+        # hex literal 0x / x'..'
+        if c in "xX" and sql[i + 1:i + 2] == "'":
+            j = sql.find("'", i + 2)
+            if j < 0:
+                raise ParseError("unterminated hex literal")
+            toks.append(Token(NUM_INT, int(sql[i + 2:j] or "0", 16), i))
+            i = j + 1
+            continue
+        # identifiers / keywords
+        if c.isalpha() or c == "_" or c == "$" or ord(c) > 127:
+            j = i + 1
+            while j < n and (sql[j].isalnum() or sql[j] in "_$" or ord(sql[j]) > 127):
+                j += 1
+            toks.append(Token(IDENT, sql[i:j], i))
+            i = j
+            continue
+        if c == "?":
+            toks.append(Token(PARAM, "?", i))
+            i += 1
+            continue
+        if c == "@":
+            if sql[i + 1:i + 2] == "@":
+                j = i + 2
+                while j < n and (sql[j].isalnum() or sql[j] in "_.$"):
+                    j += 1
+                toks.append(Token(SYSVAR, sql[i + 2:j], i))
+                i = j
+            else:
+                j = i + 1
+                while j < n and (sql[j].isalnum() or sql[j] in "_.$"):
+                    j += 1
+                toks.append(Token(USERVAR, sql[i + 1:j], i))
+                i = j
+            continue
+        # operators
+        for op in _OPS:
+            if sql.startswith(op, i):
+                toks.append(Token(OP, op, i))
+                i += len(op)
+                break
+        else:
+            raise ParseError(f"unexpected character {c!r} at position {i}")
+    toks.append(Token(EOF, None, n))
+    return toks
+
+
+def _scan_string(sql: str, i: int, quote: str):
+    j = i + 1
+    out = []
+    n = len(sql)
+    while j < n:
+        c = sql[j]
+        if c == "\\" and j + 1 < n:
+            e = sql[j + 1]
+            out.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0",
+                        "b": "\b", "Z": "\x1a", "\\": "\\", "'": "'",
+                        '"': '"', "%": "\\%", "_": "\\_"}.get(e, e))
+            j += 2
+            continue
+        if c == quote:
+            if sql[j + 1:j + 2] == quote:  # '' escape
+                out.append(quote)
+                j += 2
+                continue
+            # adjacent string literals concatenate: 'a' 'b' -> 'ab'
+            k = j + 1
+            while k < n and sql[k] in " \t\r\n":
+                k += 1
+            if k < n and sql[k] == quote:
+                j = k + 1
+                continue
+            return "".join(out), j + 1
+        out.append(c)
+        j += 1
+    raise ParseError("unterminated string")
+
+
+def _scan_number(sql: str, i: int):
+    n = len(sql)
+    j = i
+    if sql.startswith("0x", i) or sql.startswith("0X", i):
+        j = i + 2
+        while j < n and sql[j] in "0123456789abcdefABCDEF":
+            j += 1
+        return Token(NUM_INT, int(sql[i + 2:j], 16), i), j
+    seen_dot = False
+    seen_exp = False
+    while j < n:
+        c = sql[j]
+        if c.isdigit():
+            j += 1
+        elif c == "." and not seen_dot and not seen_exp:
+            seen_dot = True
+            j += 1
+        elif c in "eE" and not seen_exp and j + 1 < n and (sql[j + 1].isdigit() or sql[j + 1] in "+-" and j + 2 < n and sql[j + 2].isdigit()):
+            seen_exp = True
+            j += 1
+            if sql[j] in "+-":
+                j += 1
+        else:
+            break
+    text = sql[i:j]
+    if seen_exp:
+        return Token(NUM_FLOAT, float(text), i), j
+    if seen_dot:
+        return Token(NUM_DEC, text, i), j
+    return Token(NUM_INT, int(text), i), j
