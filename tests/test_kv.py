@@ -1,0 +1,212 @@
+"""MVCC / txn / meta tests (reference test model:
+store/mockstore/unistore/tikv/mvcc_test.go, meta/meta_test.go)."""
+
+import pytest
+
+from tidb_tpu.errors import DeadlockError, LockedError, WriteConflictError
+from tidb_tpu.kv import new_store
+from tidb_tpu.meta import Meta
+from tidb_tpu.model import DBInfo, TableInfo, ColumnInfo, Job
+from tidb_tpu.infoschema import build_infoschema
+from tidb_tpu.sqltypes import new_int_type
+
+
+def test_txn_put_get_commit():
+    s = new_store()
+    txn = s.begin()
+    txn.put(b"a", b"1")
+    txn.put(b"b", b"2")
+    assert txn.get(b"a") == b"1"  # read own writes
+    commit_ts = txn.commit()
+    snap = s.get_snapshot()
+    assert snap.get(b"a") == b"1"
+    assert snap.scan(b"a", b"c") == [(b"a", b"1"), (b"b", b"2")]
+    # snapshot before commit sees nothing
+    old = s.get_snapshot(commit_ts - 1)
+    assert old.get(b"a") is None
+
+
+def test_txn_delete_and_tombstone():
+    s = new_store()
+    t1 = s.begin()
+    t1.put(b"k", b"v")
+    t1.commit()
+    t2 = s.begin()
+    t2.delete(b"k")
+    assert t2.get(b"k") is None
+    t2.commit()
+    assert s.get_snapshot().get(b"k") is None
+
+
+def test_write_conflict():
+    s = new_store()
+    t1 = s.begin()
+    t2 = s.begin()
+    t1.put(b"k", b"1")
+    t2.put(b"k", b"2")
+    t1.commit()
+    with pytest.raises(WriteConflictError):
+        t2.commit()
+    # t2's data must not be visible
+    assert s.get_snapshot().get(b"k") == b"1"
+
+
+def test_rollback():
+    s = new_store()
+    t = s.begin()
+    t.put(b"k", b"v")
+    t.rollback()
+    assert s.get_snapshot().get(b"k") is None
+    # same txn cannot commit after rollback
+    t2 = s.begin()
+    t2.put(b"k", b"v2")
+    t2.commit()
+    assert s.get_snapshot().get(b"k") == b"v2"
+
+
+def test_locked_read_blocked():
+    s = new_store()
+    t1 = s.begin()
+    t1.put(b"k", b"v")
+    muts = [(b"k", 0, b"v")]
+    s.mvcc.prewrite(muts, b"k", t1.start_ts)
+    # another reader with ts > lock start blocks
+    snap = s.get_snapshot()
+    with pytest.raises(LockedError):
+        snap.get(b"k")
+    # resolve as rollback, read proceeds
+    s.mvcc.resolve_lock(b"k", committed=False)
+    assert snap.get(b"k") is None
+
+
+def test_pessimistic_lock_conflict():
+    s = new_store()
+    t1 = s.begin()
+    t2 = s.begin()
+    t1.lock_keys([b"k"], t1.start_ts)
+    with pytest.raises(LockedError):
+        t2.lock_keys([b"k"], t2.start_ts)
+    t1.rollback()
+    t2.lock_keys([b"k"], s.next_ts())
+    t2.commit()
+
+
+def test_deadlock_detect():
+    s = new_store()
+    t1 = s.begin()
+    t2 = s.begin()
+    t1.lock_keys([b"a"], t1.start_ts)
+    t2.lock_keys([b"b"], t2.start_ts)
+    with pytest.raises(LockedError):
+        t2.lock_keys([b"a"], t2.start_ts)
+    with pytest.raises(DeadlockError):
+        t1.lock_keys([b"b"], t1.start_ts)
+
+
+def test_mvcc_versions_and_gc():
+    s = new_store()
+    for i in range(5):
+        t = s.begin()
+        t.put(b"k", str(i).encode())
+        t.commit()
+    snap = s.get_snapshot()
+    assert snap.get(b"k") == b"4"
+    assert len(s.mvcc.map.vals[b"k"]) == 5
+    s.mvcc.gc(s.next_ts())
+    assert len(s.mvcc.map.vals[b"k"]) == 1
+    assert s.get_snapshot().get(b"k") == b"4"
+
+
+def test_raw_and_delete_range():
+    s = new_store()
+    s.mvcc.raw_batch_put([(b"a", b"1"), (b"b", b"2"), (b"c", b"3")])
+    assert s.get_snapshot().get(b"b") == b"2"
+    s.mvcc.raw_delete_range(b"a", b"c")
+    snap = s.get_snapshot()
+    assert snap.get(b"a") is None
+    assert snap.get(b"c") == b"3"
+
+
+def test_region_split():
+    s = new_store()
+    assert len(s.mvcc.regions) == 1
+    s.mvcc.split_region(b"m")
+    assert len(s.mvcc.regions) == 2
+    rs = s.mvcc.regions_in_range(b"a", b"z")
+    assert len(rs) == 2
+    rs2 = s.mvcc.regions_in_range(b"n", b"z")
+    assert len(rs2) == 1
+
+
+def test_tso_monotonic():
+    s = new_store()
+    prev = 0
+    for _ in range(1000):
+        ts = s.next_ts()
+        assert ts > prev
+        prev = ts
+
+
+def test_meta_catalog_roundtrip():
+    s = new_store()
+    txn = s.begin()
+    m = Meta(txn)
+    db_id = m.gen_global_id()
+    m.create_database(DBInfo(id=db_id, name="test"))
+    tid = m.gen_global_id()
+    tbl = TableInfo(id=tid, name="t", columns=[
+        ColumnInfo(id=1, name="a", offset=0, ftype=new_int_type())])
+    m.create_table(db_id, tbl)
+    m.bump_schema_version()
+    txn.commit()
+
+    txn2 = s.begin()
+    m2 = Meta(txn2)
+    infos = build_infoschema(m2)
+    assert infos.version == 1
+    assert infos.schema_by_name("test").id == db_id
+    t = infos.table_by_name("test", "t")
+    assert t.id == tid and t.columns[0].name == "a"
+    assert infos.table_by_id(tid)[1].name == "t"
+    txn2.rollback()
+
+
+def test_meta_ddl_queue():
+    s = new_store()
+    txn = s.begin()
+    m = Meta(txn)
+    j1 = Job(id=m.gen_job_id(), type="create_table", schema_id=1)
+    j2 = Job(id=m.gen_job_id(), type="add_index", schema_id=1)
+    m.enqueue_job(j1)
+    m.enqueue_job(j2)
+    assert m.peek_job().id == j1.id
+    j1.state = 4
+    m.finish_job(j1)
+    assert m.peek_job().id == j2.id
+    m.finish_job(j2)
+    assert m.peek_job() is None
+    assert [j.id for j in m.history_jobs()] == [j1.id, j2.id]
+    txn.commit()
+
+
+def test_meta_autoid_batch():
+    s = new_store()
+    txn = s.begin()
+    m = Meta(txn)
+    base, end = m.alloc_autoid_batch(7, 100)
+    assert (base, end) == (1, 101)
+    base2, _ = m.alloc_autoid_batch(7, 100)
+    assert base2 == 101
+    txn.commit()
+
+
+def test_membuffer_savepoint():
+    s = new_store()
+    t = s.begin()
+    t.put(b"a", b"1")
+    sp = t.membuf.savepoint()
+    t.put(b"a", b"2")
+    t.put(b"b", b"3")
+    t.membuf.rollback_to(sp)
+    assert t.get(b"a") == b"1"
+    assert t.get(b"b") is None

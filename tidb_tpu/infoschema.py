@@ -1,0 +1,57 @@
+"""Immutable schema snapshot + builder (reference: infoschema/ — version-keyed
+snapshot of name→table maps loaded from meta; information_schema virtual
+tables are registered by the executor's memtable readers)."""
+
+from __future__ import annotations
+
+from .errors import SchemaError, ColumnError, ErrCode
+from .meta import Meta
+from .model import DBInfo, TableInfo
+
+
+class InfoSchema:
+    """Immutable snapshot at a schema version."""
+
+    def __init__(self, version: int):
+        self.version = version
+        self.dbs: dict[str, DBInfo] = {}
+        self.tables: dict[str, dict[str, TableInfo]] = {}  # db -> name -> info
+        self.by_id: dict[int, tuple[DBInfo, TableInfo]] = {}
+
+    def schema_by_name(self, name: str):
+        return self.dbs.get(name.lower())
+
+    def schema_names(self):
+        return sorted(self.dbs)
+
+    def table_by_name(self, db: str, table: str) -> TableInfo:
+        t = self.tables.get(db.lower(), {}).get(table.lower())
+        if t is None:
+            if db.lower() not in self.dbs:
+                raise SchemaError(f"Unknown database '{db}'", code=ErrCode.BadDB)
+            raise SchemaError(f"Table '{db}.{table}' doesn't exist")
+        return t
+
+    def has_table(self, db: str, table: str) -> bool:
+        return table.lower() in self.tables.get(db.lower(), {})
+
+    def table_by_id(self, tid: int):
+        return self.by_id.get(tid)
+
+    def tables_in_schema(self, db: str):
+        return sorted(self.tables.get(db.lower(), {}).values(), key=lambda t: t.name)
+
+
+def build_infoschema(meta: Meta) -> InfoSchema:
+    """Full load (reference: domain/domain.go:110 loadInfoSchema; the diff
+    loader of the reference is an optimization this snapshot rebuild skips —
+    schema counts are tiny compared to data)."""
+    infos = InfoSchema(meta.schema_version())
+    for db in meta.list_databases():
+        infos.dbs[db.name.lower()] = db
+        tmap = {}
+        for tbl in meta.list_tables(db.id):
+            tmap[tbl.name.lower()] = tbl
+            infos.by_id[tbl.id] = (db, tbl)
+        infos.tables[db.name.lower()] = tmap
+    return infos

@@ -1,0 +1,15 @@
+"""Storage-engine-agnostic KV abstraction (reference: kv/kv.go —
+Storage/Transaction/Snapshot/Iterator/MemBuffer interfaces).
+
+The embedded store lives in ``mvcc.py`` (the reference's unistore role,
+store/mockstore/unistore/tikv/mvcc.go). A later round replaces the Python
+sorted-map internals with the C++ engine behind the same interface.
+"""
+
+from .mvcc import MVCCStore, Lock, TSOracle, Region
+from .store import Storage, Snapshot, Transaction, MemBuffer, new_store
+
+__all__ = [
+    "MVCCStore", "Lock", "TSOracle", "Region",
+    "Storage", "Snapshot", "Transaction", "MemBuffer", "new_store",
+]

@@ -1,0 +1,357 @@
+"""Embedded MVCC store — the reference's unistore role
+(store/mockstore/unistore/tikv/mvcc.go: Prewrite :596, Commit :907).
+
+Percolator-style two-phase commit over an in-process sorted map:
+
+- ``prewrite``  locks every mutated key (primary first, conceptually) after
+  checking write conflicts (any commit newer than start_ts) and foreign locks.
+- ``commit``    converts locks into versions at commit_ts.
+- ``rollback``  removes locks and writes a rollback marker.
+
+Reads at a timestamp see the newest version with commit_ts <= ts and raise
+``LockedError`` on a conflicting lock (caller resolves; in-process that means
+checking txn liveness and cleaning up, reference: resolveLocks).
+
+Region abstraction included so the executor can fan out range scans the way
+cop tasks split by region (reference: store/copr/coprocessor.go:170); splits
+are metadata-only here since data lives in one process.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+import time
+
+from ..errors import LockedError, WriteConflictError, DeadlockError
+
+OP_PUT = 0
+OP_DEL = 1
+OP_LOCK = 2  # lock-only record (SELECT FOR UPDATE)
+OP_ROLLBACK = 3
+
+
+class TSOracle:
+    """Timestamp oracle (the PD TSO role, reference: tidb-server/main.go:74).
+
+    Hybrid physical/logical like TiDB: ts = physical_ms << 18 | logical.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last_phys = 0
+        self._logical = 0
+
+    def next_ts(self) -> int:
+        with self._lock:
+            phys = int(time.time() * 1000)
+            if phys <= self._last_phys:
+                phys = self._last_phys
+                self._logical += 1
+            else:
+                self._last_phys = phys
+                self._logical = 0
+            if self._logical >= (1 << 18):
+                self._last_phys = phys + 1
+                self._logical = 0
+                phys += 1
+            return (phys << 18) | self._logical
+
+
+class Lock:
+    __slots__ = ("start_ts", "primary", "op", "value", "ttl")
+
+    def __init__(self, start_ts, primary, op, value=None, ttl=3000):
+        self.start_ts = start_ts
+        self.primary = primary
+        self.op = op
+        self.value = value
+        self.ttl = ttl
+
+
+class Region:
+    """Key-range shard (reference: ~100MiB Regions; here metadata for
+    parallel scan fan-out)."""
+
+    _ids = itertools.count(2)
+
+    def __init__(self, start: bytes, end: bytes, region_id=None):
+        self.id = region_id if region_id is not None else next(Region._ids)
+        self.start = start
+        self.end = end  # b"" means +inf
+
+    def contains(self, key: bytes) -> bool:
+        return self.start <= key and (not self.end or key < self.end)
+
+    def __repr__(self):
+        return f"Region({self.id}, {self.start!r}..{self.end!r})"
+
+
+class _SortedMap:
+    """Sorted key → version-chain map. Python list + bisect now; the C++
+    engine replaces this class behind the same five methods."""
+
+    def __init__(self):
+        self.keys: list[bytes] = []
+        self.vals: dict[bytes, list] = {}  # key -> [(commit_ts desc, start_ts, op, value)]
+
+    def insert_version(self, key: bytes, commit_ts: int, start_ts: int, op: int, value):
+        chain = self.vals.get(key)
+        if chain is None:
+            bisect.insort(self.keys, key)
+            self.vals[key] = chain = []
+        # newest first; commits arrive in increasing ts so prepend is O(chain)
+        chain.insert(0, (commit_ts, start_ts, op, value))
+
+    def read(self, key: bytes, ts: int):
+        """newest version with commit_ts <= ts -> (op, value) or None."""
+        chain = self.vals.get(key)
+        if not chain:
+            return None
+        for commit_ts, _start, op, value in chain:
+            if commit_ts <= ts and op != OP_ROLLBACK:
+                return (op, value)
+        return None
+
+    def range_keys(self, start: bytes, end: bytes):
+        lo = bisect.bisect_left(self.keys, start)
+        hi = bisect.bisect_left(self.keys, end) if end else len(self.keys)
+        return self.keys[lo:hi]
+
+    def has_commit_after(self, key: bytes, ts: int):
+        """-> commit_ts of any non-rollback commit with commit_ts > ts, else 0.
+        Also reports a rollback marker of this very start_ts."""
+        chain = self.vals.get(key)
+        if not chain:
+            return 0
+        for commit_ts, _start, op, _value in chain:
+            if commit_ts > ts:
+                if op != OP_ROLLBACK:
+                    return commit_ts
+            else:
+                break
+        return 0
+
+    def has_rollback(self, key: bytes, start_ts: int) -> bool:
+        chain = self.vals.get(key)
+        if not chain:
+            return False
+        return any(st == start_ts and op == OP_ROLLBACK for _c, st, op, _v in chain)
+
+
+class MVCCStore:
+    """The embedded transactional store. Thread-safe via a coarse RLock —
+    single-process control plane; scan hot paths hand out columnar data
+    through the columnar cache, not per-key reads."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.map = _SortedMap()
+        self.locks: dict[bytes, Lock] = {}
+        self.tso = TSOracle()
+        self.regions: list[Region] = [Region(b"", b"", region_id=1)]
+        self.safe_point = 0  # GC safe point (reference: store/gcworker)
+        # deadlock detection: start_ts -> start_ts it waits for
+        self._waits: dict[int, int] = {}
+        # table write watermark for columnar-cache invalidation
+        self.table_versions: dict[int, int] = {}
+
+    # -- transactional API --------------------------------------------------
+
+    def prewrite(self, mutations, primary: bytes, start_ts: int):
+        """mutations: [(key, op, value)] with op in {OP_PUT, OP_DEL, OP_LOCK}."""
+        with self._lock:
+            for key, op, value in mutations:
+                lock = self.locks.get(key)
+                if lock is not None and lock.start_ts != start_ts:
+                    raise LockedError(f"key locked by txn {lock.start_ts}",
+                                      key=key, lock_ts=lock.start_ts)
+                conflict = self.map.has_commit_after(key, start_ts)
+                if conflict:
+                    raise WriteConflictError(
+                        f"write conflict: key committed at {conflict} > start {start_ts}")
+                if self.map.has_rollback(key, start_ts):
+                    raise WriteConflictError("transaction already rolled back")
+            for key, op, value in mutations:
+                self.locks[key] = Lock(start_ts, primary, op, value)
+
+    def commit(self, keys, start_ts: int, commit_ts: int):
+        with self._lock:
+            for key in keys:
+                lock = self.locks.get(key)
+                if lock is None or lock.start_ts != start_ts:
+                    # already committed (idempotent) or rolled back
+                    if self.map.has_rollback(key, start_ts):
+                        raise WriteConflictError("txn rolled back before commit")
+                    continue
+                del self.locks[key]
+                if lock.op != OP_LOCK:
+                    self.map.insert_version(key, commit_ts, start_ts, lock.op, lock.value)
+
+    def rollback(self, keys, start_ts: int):
+        with self._lock:
+            for key in keys:
+                lock = self.locks.get(key)
+                if lock is not None and lock.start_ts == start_ts:
+                    del self.locks[key]
+                self.map.insert_version(key, start_ts, start_ts, OP_ROLLBACK, None)
+            self._waits.pop(start_ts, None)
+
+    def acquire_pessimistic_lock(self, keys, primary: bytes, start_ts: int,
+                                 for_update_ts: int):
+        """Pessimistic lock: conflict check against for_update_ts
+        (reference: unistore PessimisticLock)."""
+        with self._lock:
+            for key in keys:
+                lock = self.locks.get(key)
+                if lock is not None and lock.start_ts != start_ts:
+                    self._check_deadlock(start_ts, lock.start_ts)
+                    raise LockedError(f"key locked by txn {lock.start_ts}",
+                                      key=key, lock_ts=lock.start_ts)
+                conflict = self.map.has_commit_after(key, for_update_ts)
+                if conflict:
+                    raise WriteConflictError(
+                        f"pessimistic conflict at {conflict} > for_update {for_update_ts}")
+            for key in keys:
+                if key not in self.locks:
+                    self.locks[key] = Lock(start_ts, primary, OP_LOCK)
+
+    def _check_deadlock(self, waiter: int, holder: int):
+        """Wait-for graph cycle check (reference: unistore/tikv/detector.go)."""
+        self._waits[waiter] = holder
+        seen = {waiter}
+        cur = holder
+        while cur in self._waits:
+            cur = self._waits[cur]
+            if cur in seen:
+                self._waits.pop(waiter, None)
+                raise DeadlockError("deadlock detected")
+            seen.add(cur)
+
+    def clear_wait(self, start_ts: int):
+        with self._lock:
+            self._waits.pop(start_ts, None)
+
+    def resolve_lock(self, key: bytes, committed: bool, commit_ts: int = 0):
+        """Resolve an orphan lock after checking its txn status
+        (reference: GC worker resolveLocks)."""
+        with self._lock:
+            lock = self.locks.get(key)
+            if lock is None:
+                return
+            if committed:
+                self.commit([key], lock.start_ts, commit_ts)
+            else:
+                self.rollback([key], lock.start_ts)
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, key: bytes, ts: int, own_start_ts: int = 0):
+        with self._lock:
+            lock = self.locks.get(key)
+            if (lock is not None and lock.start_ts != own_start_ts
+                    and lock.op != OP_LOCK and lock.start_ts < ts):
+                raise LockedError("read blocked by lock", key=key, lock_ts=lock.start_ts)
+            res = self.map.read(key, ts)
+            if res is None:
+                return None
+            op, value = res
+            return value if op == OP_PUT else None
+
+    def scan(self, start: bytes, end: bytes, ts: int, limit: int = 0,
+             own_start_ts: int = 0):
+        """-> [(key, value)] of live versions at ts, ascending."""
+        with self._lock:
+            out = []
+            for key in self.map.range_keys(start, end):
+                lock = self.locks.get(key)
+                if (lock is not None and lock.start_ts != own_start_ts
+                        and lock.op != OP_LOCK and lock.start_ts < ts):
+                    raise LockedError("scan blocked by lock", key=key,
+                                      lock_ts=lock.start_ts)
+                res = self.map.read(key, ts)
+                if res is not None and res[0] == OP_PUT:
+                    out.append((key, res[1]))
+                    if limit and len(out) >= limit:
+                        break
+            return out
+
+    # -- raw (non-transactional, bootstrap/bulk-load/meta fast path) --------
+
+    def raw_put(self, key: bytes, value: bytes, commit_ts: int | None = None):
+        with self._lock:
+            ts = commit_ts if commit_ts is not None else self.tso.next_ts()
+            self.map.insert_version(key, ts, ts, OP_PUT, value)
+
+    def raw_batch_put(self, pairs, commit_ts: int | None = None):
+        with self._lock:
+            ts = commit_ts if commit_ts is not None else self.tso.next_ts()
+            for key, value in pairs:
+                self.map.insert_version(key, ts, ts, OP_PUT, value)
+
+    def raw_delete_range(self, start: bytes, end: bytes):
+        """Physical unversioned removal (reference: gc_delete_range for
+        dropped tables/indexes)."""
+        with self._lock:
+            for key in list(self.map.range_keys(start, end)):
+                self.map.vals.pop(key, None)
+            lo = bisect.bisect_left(self.map.keys, start)
+            hi = bisect.bisect_left(self.map.keys, end) if end else len(self.map.keys)
+            del self.map.keys[lo:hi]
+
+    # -- GC -----------------------------------------------------------------
+
+    def gc(self, safe_point: int):
+        """Drop versions older than the newest one <= safe_point
+        (reference: store/gcworker/gc_worker.go:619 runGCJob)."""
+        with self._lock:
+            self.safe_point = max(self.safe_point, safe_point)
+            empty = []
+            for key, chain in self.map.vals.items():
+                keep = []
+                passed = False
+                for ver in chain:
+                    if ver[0] > safe_point:
+                        keep.append(ver)
+                    elif not passed:
+                        passed = True
+                        if ver[2] == OP_PUT:
+                            keep.append(ver)
+                    # older than first visible-at-safepoint: drop
+                chain[:] = keep
+                if not chain:
+                    empty.append(key)
+            for key in empty:
+                del self.map.vals[key]
+                idx = bisect.bisect_left(self.map.keys, key)
+                if idx < len(self.map.keys) and self.map.keys[idx] == key:
+                    del self.map.keys[idx]
+
+    # -- regions ------------------------------------------------------------
+
+    def split_region(self, split_key: bytes):
+        with self._lock:
+            for i, r in enumerate(self.regions):
+                if r.contains(split_key) and r.start != split_key:
+                    new = Region(split_key, r.end)
+                    r.end = split_key
+                    self.regions.insert(i + 1, new)
+                    return new
+            return None
+
+    def regions_in_range(self, start: bytes, end: bytes):
+        out = []
+        for r in self.regions:
+            if (not r.end or r.end > start) and (not end or r.start < end):
+                out.append(r)
+        return out
+
+    # -- table write watermarks (columnar cache invalidation) ---------------
+
+    def bump_table_version(self, table_id: int):
+        with self._lock:
+            self.table_versions[table_id] = self.table_versions.get(table_id, 0) + 1
+
+    def table_version(self, table_id: int) -> int:
+        return self.table_versions.get(table_id, 0)

@@ -1,0 +1,191 @@
+"""Storage / Transaction / Snapshot over the MVCC store.
+
+Plays the role of tikv/client-go/v2 (2PC driver) + kv/kv.go interfaces: the
+transaction accumulates mutations in a MemBuffer (reference: kv.MemBuffer)
+and commits via Percolator 2PC against the embedded store. In-process there
+is no RPC; the commit protocol is kept (prewrite → TSO → commit) because DDL
+/ txn semantics and the test matrix depend on its failure modes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..errors import TiDBError, WriteConflictError
+from .mvcc import MVCCStore, OP_DEL, OP_LOCK, OP_PUT
+
+_MISSING = object()
+
+
+class MemBuffer:
+    """Ordered txn-local write buffer with savepoints ("staging" in the
+    reference, kv/memdb). dict + sorted view on demand."""
+
+    def __init__(self):
+        self._data: dict[bytes, bytes | None] = {}  # None = tombstone
+        self._ops: list[tuple[bytes, bytes | None]] = []  # undo log for savepoints
+
+    def put(self, key: bytes, value: bytes):
+        self._ops.append((key, self._data.get(key, _MISSING)))
+        self._data[key] = value
+
+    def delete(self, key: bytes):
+        self._ops.append((key, self._data.get(key, _MISSING)))
+        self._data[key] = None
+
+    def get(self, key: bytes, default=_MISSING):
+        return self._data.get(key, default)
+
+    def __contains__(self, key):
+        return key in self._data
+
+    def __len__(self):
+        return len(self._data)
+
+    def savepoint(self) -> int:
+        return len(self._ops)
+
+    def rollback_to(self, sp: int):
+        while len(self._ops) > sp:
+            key, old = self._ops.pop()
+            if old is _MISSING:
+                del self._data[key]
+            else:
+                self._data[key] = old
+
+    def items_sorted(self):
+        return sorted(self._data.items())
+
+    def range_items(self, start: bytes, end: bytes):
+        return [(k, v) for k, v in self.items_sorted()
+                if k >= start and (not end or k < end)]
+
+
+class Snapshot:
+    """Point-in-time read view (reference: kv.Snapshot)."""
+
+    def __init__(self, store: "Storage", ts: int, own_start_ts: int = 0):
+        self.store = store
+        self.ts = ts
+        self.own_start_ts = own_start_ts
+
+    def get(self, key: bytes):
+        return self.store.mvcc.get(key, self.ts, own_start_ts=self.own_start_ts)
+
+    def batch_get(self, keys):
+        return {k: v for k in keys
+                if (v := self.store.mvcc.get(k, self.ts, own_start_ts=self.own_start_ts)) is not None}
+
+    def scan(self, start: bytes, end: bytes, limit: int = 0):
+        return self.store.mvcc.scan(start, end, self.ts, limit=limit,
+                                    own_start_ts=self.own_start_ts)
+
+
+class Transaction:
+    """Buffered txn with 2PC commit (reference: kv.Transaction + client-go)."""
+
+    def __init__(self, store: "Storage", start_ts: int):
+        self.store = store
+        self.start_ts = start_ts
+        self.membuf = MemBuffer()
+        self.snapshot = Snapshot(store, start_ts, own_start_ts=start_ts)
+        self.valid = True
+        self.locked_keys: set[bytes] = set()
+        self.touched_tables: set[int] = set()
+        self.for_update_ts = start_ts
+
+    # reads see own writes first (union of membuffer and snapshot,
+    # reference: executor/union_scan.go does this at executor level too)
+    def get(self, key: bytes):
+        v = self.membuf.get(key, _MISSING)
+        if v is not _MISSING:
+            return v
+        return self.snapshot.get(key)
+
+    def scan(self, start: bytes, end: bytes):
+        snap = dict(self.snapshot.scan(start, end))
+        for k, v in self.membuf.range_items(start, end):
+            if v is None:
+                snap.pop(k, None)
+            else:
+                snap[k] = v
+        return sorted(snap.items())
+
+    def put(self, key: bytes, value: bytes):
+        self.membuf.put(key, value)
+
+    def delete(self, key: bytes):
+        self.membuf.delete(key)
+
+    def lock_keys(self, keys, for_update_ts: int):
+        self.for_update_ts = max(self.for_update_ts, for_update_ts)
+        primary = next(iter(keys), None)
+        if primary is None:
+            return
+        self.store.mvcc.acquire_pessimistic_lock(
+            list(keys), primary, self.start_ts, for_update_ts)
+        self.locked_keys.update(keys)
+
+    def commit(self) -> int:
+        """2PC: prewrite all → get commit_ts → commit. Returns commit_ts."""
+        if not self.valid:
+            raise TiDBError("transaction is not valid")
+        self.valid = False
+        muts = []
+        for key, value in self.membuf.items_sorted():
+            if value is None:
+                muts.append((key, OP_DEL, None))
+            else:
+                muts.append((key, OP_PUT, value))
+        for key in self.locked_keys:
+            if key not in self.membuf:
+                muts.append((key, OP_LOCK, None))
+        if not muts:
+            self.store.mvcc.clear_wait(self.start_ts)
+            return self.start_ts
+        primary = muts[0][0]
+        try:
+            self.store.mvcc.prewrite(muts, primary, self.start_ts)
+        except Exception:
+            self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
+            raise
+        commit_ts = self.store.next_ts()
+        self.store.mvcc.commit([m[0] for m in muts], self.start_ts, commit_ts)
+        self.store.mvcc.clear_wait(self.start_ts)
+        for tid in self.touched_tables:
+            self.store.mvcc.bump_table_version(tid)
+        return commit_ts
+
+    def rollback(self):
+        if not self.valid:
+            return
+        self.valid = False
+        keys = [k for k, _ in self.membuf.items_sorted()] + list(self.locked_keys)
+        if keys:
+            self.store.mvcc.rollback(keys, self.start_ts)
+        self.store.mvcc.clear_wait(self.start_ts)
+
+
+class Storage:
+    """Process-wide storage handle (reference: kv.Storage)."""
+
+    def __init__(self):
+        self.mvcc = MVCCStore()
+        self._lock = threading.Lock()
+
+    def next_ts(self) -> int:
+        return self.mvcc.tso.next_ts()
+
+    def begin(self, start_ts: int | None = None) -> Transaction:
+        return Transaction(self, start_ts if start_ts is not None else self.next_ts())
+
+    def get_snapshot(self, ts: int | None = None) -> Snapshot:
+        return Snapshot(self, ts if ts is not None else self.next_ts())
+
+    def current_version(self) -> int:
+        return self.next_ts()
+
+
+def new_store() -> Storage:
+    """reference: store.New("unistore://...")"""
+    return Storage()

@@ -1,0 +1,208 @@
+"""KV-encoded catalog persistence (reference: meta/meta.go + structure/ —
+schema metadata under the ``m`` prefix, DDL job queues, id allocators,
+schema version counter)."""
+
+from __future__ import annotations
+
+import json
+
+from .errors import SchemaError, TiDBError, ErrCode
+from .model import DBInfo, Job, TableInfo
+
+M = b"m"
+KEY_NEXT_GLOBAL_ID = M + b":next_gid"
+KEY_SCHEMA_VERSION = M + b":schema_version"
+KEY_DB_PREFIX = M + b":db:"          # m:db:{id} -> DBInfo json
+KEY_DBS = M + b":dbs"                # json list of db ids
+KEY_TABLE_PREFIX = M + b":tbl:"      # m:tbl:{db_id}:{tid} -> TableInfo json
+KEY_TABLES_OF = M + b":tbls:"        # m:tbls:{db_id} -> json list of table ids
+KEY_DDL_JOB_QUEUE = M + b":ddl_jobs"         # json list of pending job jsons
+KEY_DDL_HISTORY = M + b":ddl_history:"       # m:ddl_history:{job_id} -> job json
+KEY_DDL_NEXT_JOB_ID = M + b":ddl_next_job_id"
+KEY_AUTOID_PREFIX = M + b":autoid:"  # m:autoid:{tid} -> int
+KEY_BOOTSTRAP = M + b":bootstrapped"
+KEY_STATS_PREFIX = M + b":stats:"    # m:stats:{tid} -> stats json
+
+
+class Meta:
+    """All methods operate through a kv Transaction (or anything with
+    get/put/scan), mirroring the reference's meta.Meta-over-txn design."""
+
+    def __init__(self, txn):
+        self.txn = txn
+
+    # -- low-level ----------------------------------------------------------
+
+    def _get_json(self, key: bytes, default):
+        v = self.txn.get(key)
+        if v is None:
+            return default
+        return json.loads(v)
+
+    def _put_json(self, key: bytes, obj):
+        self.txn.put(key, json.dumps(obj).encode())
+
+    # -- id allocation ------------------------------------------------------
+
+    def gen_global_id(self) -> int:
+        nid = self._get_json(KEY_NEXT_GLOBAL_ID, 1)
+        self._put_json(KEY_NEXT_GLOBAL_ID, nid + 1)
+        return nid
+
+    def gen_global_ids(self, n: int):
+        nid = self._get_json(KEY_NEXT_GLOBAL_ID, 1)
+        self._put_json(KEY_NEXT_GLOBAL_ID, nid + n)
+        return list(range(nid, nid + n))
+
+    # -- schema version -----------------------------------------------------
+
+    def schema_version(self) -> int:
+        return self._get_json(KEY_SCHEMA_VERSION, 0)
+
+    def bump_schema_version(self) -> int:
+        v = self.schema_version() + 1
+        self._put_json(KEY_SCHEMA_VERSION, v)
+        return v
+
+    # -- databases ----------------------------------------------------------
+
+    def list_databases(self):
+        ids = self._get_json(KEY_DBS, [])
+        out = []
+        for did in ids:
+            d = self._get_json(KEY_DB_PREFIX + str(did).encode(), None)
+            if d is not None:
+                out.append(DBInfo.from_json(d))
+        return out
+
+    def get_database(self, db_id: int):
+        d = self._get_json(KEY_DB_PREFIX + str(db_id).encode(), None)
+        return DBInfo.from_json(d) if d else None
+
+    def create_database(self, db: DBInfo):
+        ids = self._get_json(KEY_DBS, [])
+        if db.id in ids:
+            raise TiDBError(f"database id {db.id} exists", code=ErrCode.DBCreateExists)
+        ids.append(db.id)
+        self._put_json(KEY_DBS, ids)
+        self._put_json(KEY_DB_PREFIX + str(db.id).encode(), db.to_json())
+        self._put_json(KEY_TABLES_OF + str(db.id).encode(), [])
+
+    def drop_database(self, db_id: int):
+        ids = self._get_json(KEY_DBS, [])
+        if db_id in ids:
+            ids.remove(db_id)
+            self._put_json(KEY_DBS, ids)
+        self.txn.delete(KEY_DB_PREFIX + str(db_id).encode())
+        self.txn.delete(KEY_TABLES_OF + str(db_id).encode())
+
+    # -- tables -------------------------------------------------------------
+
+    def list_tables(self, db_id: int):
+        tids = self._get_json(KEY_TABLES_OF + str(db_id).encode(), [])
+        out = []
+        for tid in tids:
+            t = self._get_json(_tbl_key(db_id, tid), None)
+            if t is not None:
+                out.append(TableInfo.from_json(t))
+        return out
+
+    def get_table(self, db_id: int, table_id: int):
+        t = self._get_json(_tbl_key(db_id, table_id), None)
+        return TableInfo.from_json(t) if t else None
+
+    def create_table(self, db_id: int, tbl: TableInfo):
+        key = KEY_TABLES_OF + str(db_id).encode()
+        tids = self._get_json(key, None)
+        if tids is None:
+            raise SchemaError(f"database id {db_id} not found")
+        if tbl.id in tids:
+            raise TiDBError(f"table id {tbl.id} exists", code=ErrCode.TableExists)
+        tids.append(tbl.id)
+        self._put_json(key, tids)
+        self._put_json(_tbl_key(db_id, tbl.id), tbl.to_json())
+
+    def update_table(self, db_id: int, tbl: TableInfo):
+        self._put_json(_tbl_key(db_id, tbl.id), tbl.to_json())
+
+    def drop_table(self, db_id: int, table_id: int):
+        key = KEY_TABLES_OF + str(db_id).encode()
+        tids = self._get_json(key, [])
+        if table_id in tids:
+            tids.remove(table_id)
+            self._put_json(key, tids)
+        self.txn.delete(_tbl_key(db_id, table_id))
+
+    # -- auto increment -----------------------------------------------------
+
+    def autoid(self, table_id: int) -> int:
+        return self._get_json(KEY_AUTOID_PREFIX + str(table_id).encode(), 1)
+
+    def set_autoid(self, table_id: int, v: int):
+        self._put_json(KEY_AUTOID_PREFIX + str(table_id).encode(), v)
+
+    def alloc_autoid_batch(self, table_id: int, n: int):
+        """Batched allocation (reference: meta/autoid/autoid.go:132 — sessions
+        cache a batch to avoid a meta txn per row)."""
+        base = self.autoid(table_id)
+        self.set_autoid(table_id, base + n)
+        return base, base + n
+
+    # -- DDL job queue (reference: meta DDLJobQueue + HistoryJob) -----------
+
+    def gen_job_id(self) -> int:
+        nid = self._get_json(KEY_DDL_NEXT_JOB_ID, 1)
+        self._put_json(KEY_DDL_NEXT_JOB_ID, nid + 1)
+        return nid
+
+    def enqueue_job(self, job: Job):
+        q = self._get_json(KEY_DDL_JOB_QUEUE, [])
+        q.append(job.to_json())
+        self._put_json(KEY_DDL_JOB_QUEUE, q)
+
+    def peek_job(self):
+        q = self._get_json(KEY_DDL_JOB_QUEUE, [])
+        return Job.from_json(q[0]) if q else None
+
+    def update_job(self, job: Job):
+        q = self._get_json(KEY_DDL_JOB_QUEUE, [])
+        for i, s in enumerate(q):
+            if Job.from_json(s).id == job.id:
+                q[i] = job.to_json()
+                self._put_json(KEY_DDL_JOB_QUEUE, q)
+                return
+        raise TiDBError(f"ddl job {job.id} not in queue")
+
+    def finish_job(self, job: Job):
+        q = self._get_json(KEY_DDL_JOB_QUEUE, [])
+        q = [s for s in q if Job.from_json(s).id != job.id]
+        self._put_json(KEY_DDL_JOB_QUEUE, q)
+        self.txn.put(KEY_DDL_HISTORY + str(job.id).encode(), job.to_json().encode())
+
+    def history_jobs(self):
+        out = []
+        for _k, v in self.txn.scan(KEY_DDL_HISTORY, KEY_DDL_HISTORY + b"\xff"):
+            out.append(Job.from_json(v.decode()))
+        out.sort(key=lambda j: j.id)
+        return out
+
+    def queued_jobs(self):
+        return [Job.from_json(s) for s in self._get_json(KEY_DDL_JOB_QUEUE, [])]
+
+    # -- bootstrap flag / stats --------------------------------------------
+
+    def bootstrapped(self) -> int:
+        return self._get_json(KEY_BOOTSTRAP, 0)
+
+    def set_bootstrapped(self, version: int):
+        self._put_json(KEY_BOOTSTRAP, version)
+
+    def stats(self, table_id: int):
+        return self._get_json(KEY_STATS_PREFIX + str(table_id).encode(), None)
+
+    def set_stats(self, table_id: int, obj):
+        self._put_json(KEY_STATS_PREFIX + str(table_id).encode(), obj)
+
+
+def _tbl_key(db_id: int, tid: int) -> bytes:
+    return KEY_TABLE_PREFIX + f"{db_id}:{tid}".encode()

@@ -1,0 +1,217 @@
+"""Schema model objects shared by catalog, DDL, planner
+(reference: parser/model/model.go — DBInfo/TableInfo/ColumnInfo/IndexInfo/Job
+and the F1 schema states)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .sqltypes import FieldType
+
+
+class SchemaState:
+    """F1 online-schema-change states (reference: parser/model/model.go:33)."""
+    NONE = 0
+    DELETE_ONLY = 1
+    WRITE_ONLY = 2
+    WRITE_REORG = 3
+    PUBLIC = 4
+    DELETE_REORG = 5
+
+    NAMES = {0: "none", 1: "delete only", 2: "write only",
+             3: "write reorganization", 4: "public", 5: "delete reorganization"}
+
+
+@dataclass
+class ColumnInfo:
+    id: int = 0
+    name: str = ""
+    offset: int = 0
+    ftype: FieldType = None
+    state: int = SchemaState.PUBLIC
+    default_value: object = None  # internal-representation value or None
+    has_default: bool = False
+    comment: str = ""
+    hidden: bool = False
+
+    def to_json(self):
+        ft = self.ftype
+        return {
+            "id": self.id, "name": self.name, "offset": self.offset,
+            "tp": ft.tp, "flen": ft.flen, "decimal": ft.decimal,
+            "flag": ft.flag, "charset": ft.charset, "collate": ft.collate,
+            "elems": list(ft.elems),
+            "state": self.state, "default": _enc(self.default_value),
+            "has_default": self.has_default, "comment": self.comment,
+            "hidden": self.hidden,
+        }
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(
+            id=d["id"], name=d["name"], offset=d["offset"],
+            ftype=FieldType(tp=d["tp"], flen=d["flen"], decimal=d["decimal"],
+                            flag=d["flag"], charset=d["charset"],
+                            collate=d["collate"], elems=tuple(d["elems"])),
+            state=d["state"], default_value=_dec(d["default"]),
+            has_default=d["has_default"], comment=d.get("comment", ""),
+            hidden=d.get("hidden", False),
+        )
+
+
+@dataclass
+class IndexColumn:
+    name: str = ""
+    offset: int = 0
+    length: int = -1  # prefix length or -1
+
+
+@dataclass
+class IndexInfo:
+    id: int = 0
+    name: str = ""
+    columns: list = field(default_factory=list)  # [IndexColumn]
+    unique: bool = False
+    primary: bool = False
+    state: int = SchemaState.PUBLIC
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name, "unique": self.unique,
+                "primary": self.primary, "state": self.state,
+                "columns": [{"name": c.name, "offset": c.offset, "length": c.length}
+                            for c in self.columns]}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(id=d["id"], name=d["name"], unique=d["unique"],
+                   primary=d["primary"], state=d["state"],
+                   columns=[IndexColumn(c["name"], c["offset"], c["length"])
+                            for c in d["columns"]])
+
+
+@dataclass
+class TableInfo:
+    id: int = 0
+    name: str = ""
+    columns: list = field(default_factory=list)   # [ColumnInfo]
+    indexes: list = field(default_factory=list)   # [IndexInfo]
+    state: int = SchemaState.PUBLIC
+    pk_is_handle: bool = False      # int PK stored as the row handle
+    pk_col_id: int = 0
+    auto_increment: int = 1
+    max_col_id: int = 0
+    max_idx_id: int = 0
+    comment: str = ""
+    update_ts: int = 0
+
+    def public_columns(self):
+        return [c for c in self.columns if c.state == SchemaState.PUBLIC]
+
+    def writable_columns(self):
+        return [c for c in self.columns if c.state >= SchemaState.WRITE_ONLY]
+
+    def find_column(self, name: str):
+        lname = name.lower()
+        for c in self.columns:
+            if c.name.lower() == lname:
+                return c
+        return None
+
+    def find_index(self, name: str):
+        lname = name.lower()
+        for i in self.indexes:
+            if i.name.lower() == lname:
+                return i
+        return None
+
+    def to_json(self):
+        return {
+            "id": self.id, "name": self.name, "state": self.state,
+            "pk_is_handle": self.pk_is_handle, "pk_col_id": self.pk_col_id,
+            "auto_increment": self.auto_increment,
+            "max_col_id": self.max_col_id, "max_idx_id": self.max_idx_id,
+            "comment": self.comment, "update_ts": self.update_ts,
+            "columns": [c.to_json() for c in self.columns],
+            "indexes": [i.to_json() for i in self.indexes],
+        }
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(
+            id=d["id"], name=d["name"], state=d["state"],
+            pk_is_handle=d["pk_is_handle"], pk_col_id=d["pk_col_id"],
+            auto_increment=d["auto_increment"], max_col_id=d["max_col_id"],
+            max_idx_id=d["max_idx_id"], comment=d.get("comment", ""),
+            update_ts=d.get("update_ts", 0),
+            columns=[ColumnInfo.from_json(c) for c in d["columns"]],
+            indexes=[IndexInfo.from_json(i) for i in d["indexes"]],
+        )
+
+
+@dataclass
+class DBInfo:
+    id: int = 0
+    name: str = ""
+    state: int = SchemaState.PUBLIC
+    charset: str = "utf8mb4"
+    collate: str = "utf8mb4_bin"
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name, "state": self.state,
+                "charset": self.charset, "collate": self.collate}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(**d)
+
+
+# -- DDL job (reference: parser/model/ddl.go model.Job) ----------------------
+
+class JobState:
+    NONE = 0
+    RUNNING = 1
+    ROLLINGBACK = 2
+    ROLLBACK_DONE = 3
+    DONE = 4
+    CANCELLED = 5
+    SYNCED = 6
+
+    NAMES = {0: "none", 1: "running", 2: "rollingback", 3: "rollback done",
+             4: "done", 5: "cancelled", 6: "synced"}
+
+
+@dataclass
+class Job:
+    id: int = 0
+    type: str = ""          # create_table | add_index | ...
+    schema_id: int = 0
+    table_id: int = 0
+    state: int = JobState.NONE
+    schema_state: int = SchemaState.NONE
+    args: dict = field(default_factory=dict)
+    error: str = ""
+    row_count: int = 0      # backfill progress
+    reorg_handle: int = 0   # backfill checkpoint (reference: ddl/reorg.go)
+    schema_version: int = 0
+    start_ts: int = 0
+
+    def to_json(self):
+        return json.dumps(self.__dict__, default=_enc)
+
+    @classmethod
+    def from_json(cls, s):
+        d = json.loads(s)
+        return cls(**d)
+
+
+def _enc(v):
+    if isinstance(v, bytes):
+        return {"__b__": v.hex()}
+    return v
+
+
+def _dec(v):
+    if isinstance(v, dict) and "__b__" in v:
+        return bytes.fromhex(v["__b__"])
+    return v
