@@ -1,0 +1,113 @@
+"""Regression tests for review findings: rollback-marker ordering, GC vs
+rollback markers, multi-way join reorder, session txn cleanup."""
+
+import pytest
+
+from tidb_tpu.errors import WriteConflictError
+from tidb_tpu.kv import new_store
+from tidb_tpu.kv.mvcc import OP_PUT, OP_ROLLBACK
+from tidb_tpu.testkit import TestKit
+
+
+def test_rollback_marker_does_not_hide_newer_commit():
+    """A rollback at an old start_ts must not mask a newer commit from
+    write-conflict checks (lost update)."""
+    s = new_store()
+    t_old = s.begin()          # start_ts = T0
+    t_commit = s.begin()
+    t_commit.put(b"k", b"v100")
+    t_commit.commit()          # commits at T2 > T0
+    # the old txn aborts, writing a rollback marker at its old start_ts
+    s.mvcc.rollback([b"k"], t_old.start_ts)
+    # a mid-age txn must STILL see the newer commit as a conflict
+    t_mid = s.begin()
+    chain = s.mvcc.map.vals[b"k"]
+    assert [op for _c, _s, op, _v in chain].count(OP_ROLLBACK) == 1
+    assert s.mvcc.map.has_commit_after(b"k", t_old.start_ts) > 0
+    with pytest.raises(WriteConflictError):
+        s.mvcc.prewrite([(b"k", OP_PUT, b"lost")], b"k", t_old.start_ts)
+    assert s.get_snapshot().get(b"k") == b"v100"
+
+
+def test_chain_stays_sorted_desc():
+    s = new_store()
+    tss = []
+    for i in range(3):
+        t = s.begin()
+        t.put(b"k", str(i).encode())
+        tss.append(t.start_ts)
+        t.commit()
+    # rollback marker at the OLDEST start_ts lands in sorted position
+    s.mvcc.rollback([b"k"], tss[0])
+    chain = s.mvcc.map.vals[b"k"]
+    commit_tss = [c for c, _s, _o, _v in chain]
+    assert commit_tss == sorted(commit_tss, reverse=True)
+
+
+def test_gc_keeps_live_put_under_rollback_marker():
+    """GC must not treat a rollback marker as the visible version."""
+    s = new_store()
+    t = s.begin()
+    t.put(b"k", b"v1")
+    t.commit()
+    t2 = s.begin()
+    s.mvcc.rollback([b"k"], t2.start_ts)  # newer rollback marker
+    s.mvcc.gc(s.next_ts())
+    assert s.get_snapshot().get(b"k") == b"v1"
+
+
+def test_three_way_join_reorder():
+    """>=3-table comma joins crashed with RecursionError before the fix."""
+    tk = TestKit()
+    tk.must_exec("create table a (x int)")
+    tk.must_exec("create table b (x int, y int)")
+    tk.must_exec("create table c (y int, z int)")
+    tk.must_exec("insert into a values (1),(2)")
+    tk.must_exec("insert into b values (1,10),(2,20)")
+    tk.must_exec("insert into c values (10,100),(20,200),(30,300)")
+    tk.must_query(
+        "select a.x, c.z from a, b, c where a.x=b.x and b.y=c.y order by a.x"
+    ).check([("1", "100"), ("2", "200")])
+    # five-way
+    tk.must_exec("create table d (z int, w int)")
+    tk.must_exec("create table e (w int)")
+    tk.must_exec("insert into d values (100,7),(200,8)")
+    tk.must_exec("insert into e values (7)")
+    tk.must_query(
+        "select a.x from a, b, c, d, e where a.x=b.x and b.y=c.y "
+        "and c.z=d.z and d.w=e.w"
+    ).check([("1",)])
+
+
+def test_session_recovers_from_internal_error():
+    """Non-TiDBError escaping a statement must not leave a dangling txn."""
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("insert into t values (1)")
+    import tidb_tpu.executor.dml as dml
+    orig = dml.InsertExec.execute
+    def boom(self):
+        self.session.txn_for_write()
+        raise ValueError("synthetic executor crash")
+    dml.InsertExec.execute = boom
+    try:
+        with pytest.raises(ValueError):
+            tk.session.execute("insert into t values (2)")
+    finally:
+        dml.InsertExec.execute = orig
+    assert tk.session.txn is None  # no dangling txn
+    tk.must_exec("insert into t values (3)")
+    tk.must_query("select a from t order by a").check([("1",), ("3",)])
+
+
+def test_membuffer_sorted_invariant():
+    s = new_store()
+    t = s.begin()
+    for k in [b"c", b"a", b"b", b"a"]:
+        t.put(k, b"v")
+    assert [k for k, _ in t.membuf.items_sorted()] == [b"a", b"b", b"c"]
+    sp = t.membuf.savepoint()
+    t.put(b"0", b"v")
+    t.membuf.rollback_to(sp)
+    assert [k for k, _ in t.membuf.items_sorted()] == [b"a", b"b", b"c"]
+    assert t.membuf.range_items(b"b", b"c") == [(b"b", b"v")]

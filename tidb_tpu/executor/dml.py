@@ -1,0 +1,377 @@
+"""DML executors (reference: executor/insert.go, replace.go, update.go,
+delete.go + batch_checker.go)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import DupEntryError, TiDBError, ErrCode
+from ..expression import ExprBuilder, Schema, ColumnRef, Column as ExprColumn
+from ..parser import ast
+from ..sqltypes import FLAG_AUTO_INCREMENT, TYPE_LONGLONG, FieldType
+from ..table import Table, cast_value, convert_internal
+from ..tablecodec import record_key
+from .exec_select import eval_conds_mask
+from ..ops import host
+
+
+class DMLResult:
+    def __init__(self, affected=0, last_insert_id=0):
+        self.affected = affected
+        self.last_insert_id = last_insert_id
+
+
+def _resolve_table(session, tn: ast.TableName):
+    db = tn.schema or session.current_db()
+    info = session.infoschema().table_by_name(db, tn.name)
+    return db, info
+
+
+def _col_default(session, info, col):
+    if col.has_default:
+        return col.default_value
+    if col.ftype.not_null:
+        return _MISSING
+    return None
+
+
+_MISSING = object()
+
+
+class InsertExec:
+    def __init__(self, session, stmt: ast.InsertStmt):
+        self.session = session
+        self.stmt = stmt
+
+    def execute(self) -> DMLResult:
+        sess = self.session
+        stmt = self.stmt
+        db, info = _resolve_table(sess, stmt.table)
+        cols = info.public_columns()
+        by_name = {c.name.lower(): c for c in cols}
+        if stmt.columns:
+            target_cols = []
+            for name in stmt.columns:
+                c = by_name.get(name.lower())
+                if c is None:
+                    raise TiDBError(f"Unknown column '{name}' in 'field list'",
+                                    code=ErrCode.BadField)
+                target_cols.append(c)
+        else:
+            target_cols = cols
+
+        # rows carry (value, src_ftype) pairs so scaled decimals / date units
+        # convert correctly into the column representation
+        rows = []
+        if stmt.select is not None:
+            result = sess.run_query(stmt.select)
+            fts = result.ftypes
+            if result.chunk is not None and result.chunk.num_cols != len(target_cols):
+                raise TiDBError("Column count doesn't match value count",
+                                code=ErrCode.WrongValueCountOnRow)
+            for r in result.internal_rows:
+                rows.append([(v, ft) for v, ft in zip(r, fts)])
+        else:
+            b = ExprBuilder(Schema([]), sess.expr_ctx())
+            for value_row in stmt.values:
+                if len(value_row) != len(target_cols):
+                    raise TiDBError(
+                        f"Column count doesn't match value count at row 1",
+                        code=ErrCode.WrongValueCountOnRow)
+                vals = []
+                for node, col in zip(value_row, target_cols):
+                    if isinstance(node, ast.DefaultExpr):
+                        vals.append(_DEFAULT)
+                    else:
+                        e = b.build(node)
+                        vals.append((e.eval_scalar(), e.ftype))
+                rows.append(vals)
+
+        txn = sess.txn_for_write()
+        tbl = Table(info, txn)
+        affected = 0
+        last_id = 0
+        auto_col = next((c for c in cols if c.ftype.flag & FLAG_AUTO_INCREMENT
+                         or (info.pk_is_handle and c.id == info.pk_col_id)), None)
+        for raw in rows:
+            row = {}
+            for node_v, col in zip(raw, target_cols):
+                if node_v is _DEFAULT:
+                    continue
+                v, src_ft = node_v
+                row[col.id] = (convert_internal(v, src_ft, col.ftype)
+                               if v is not None else None)
+            # fill defaults for unspecified columns
+            for col in cols:
+                if col.id in row:
+                    continue
+                if auto_col is not None and col.id == auto_col.id:
+                    continue
+                d = _col_default(sess, info, col)
+                if d is _MISSING:
+                    if col.ftype.flag & FLAG_AUTO_INCREMENT:
+                        continue
+                    raise TiDBError(f"Field '{col.name}' doesn't have a default value",
+                                    code=ErrCode.NoDefaultValue)
+                row[col.id] = d
+            # auto-increment / handle
+            if auto_col is not None:
+                v = row.get(auto_col.id)
+                if v is None or (v == 0 and auto_col.ftype.flag & FLAG_AUTO_INCREMENT):
+                    v = sess.alloc_autoid(info.id)
+                    row[auto_col.id] = v
+                    last_id = v
+                else:
+                    # explicit value: rebase the allocator past it
+                    # (reference: meta/autoid Rebase)
+                    sess.rebase_autoid(info.id, int(v) + 1)
+            # NOT NULL checks
+            for col in cols:
+                if col.ftype.not_null and row.get(col.id) is None:
+                    raise TiDBError(f"Column '{col.name}' cannot be null",
+                                    code=ErrCode.BadNull)
+            handle = (row[info.pk_col_id] if info.pk_is_handle
+                      else sess.alloc_autoid(info.id))
+            try:
+                tbl.add_record(row, handle)
+                affected += 1
+            except DupEntryError:
+                if stmt.ignore:
+                    continue
+                if stmt.is_replace:
+                    affected += self._replace_conflicts(tbl, row, handle)
+                    tbl.add_record(row, handle, check_dup=False)
+                    affected += 1
+                    continue
+                if stmt.on_duplicate:
+                    affected += self._on_dup_update(tbl, info, row, handle)
+                    continue
+                raise
+        sess.finish_dml()
+        return DMLResult(affected=affected, last_insert_id=last_id)
+
+    def _replace_conflicts(self, tbl, row, handle):
+        """Delete every row this one conflicts with (reference: replace.go)."""
+        removed = 0
+        info = tbl.info
+        old = tbl.get_row(handle)
+        if old is not None:
+            tbl.remove_record(old, handle)
+            removed += 1
+        for idx in info.indexes:
+            if not idx.unique:
+                continue
+            vals = tbl._index_values(idx, row)
+            if any(v is None for v in vals):
+                continue
+            h = tbl.index_lookup(idx, vals)
+            if h is not None and h != handle:
+                old = tbl.get_row(h)
+                if old is not None:
+                    tbl.remove_record(old, h)
+                    removed += 1
+        return removed
+
+    def _on_dup_update(self, tbl, info, row, handle):
+        """reference: insert.go ON DUPLICATE KEY UPDATE path."""
+        sess = self.session
+        conflict_handle = None
+        if info.pk_is_handle and tbl.get_row(handle) is not None:
+            conflict_handle = handle
+        else:
+            for idx in info.indexes:
+                if not idx.unique:
+                    continue
+                vals = tbl._index_values(idx, row)
+                if any(v is None for v in vals):
+                    continue
+                h = tbl.index_lookup(idx, vals)
+                if h is not None:
+                    conflict_handle = h
+                    break
+        if conflict_handle is None:
+            tbl.add_record(row, handle, check_dup=False)
+            return 1
+        old = tbl.get_row(conflict_handle)
+        cols = info.public_columns()
+        refs = [ColumnRef(c.name, info.name, "", c.ftype) for c in cols]
+        from ..utils.chunk import Chunk as _Chunk, Column as _Col
+        import numpy as _np
+        # one-row chunk of the existing row for expression evaluation
+        from ..table import rows_to_chunk
+        chunk = rows_to_chunk(info, cols, [conflict_handle], [old])
+        b = ExprBuilder(Schema(refs), sess.expr_ctx())
+        new_row = dict(old)
+        for cn, expr_node in self.stmt.on_duplicate:
+            col = info.find_column(cn.name)
+            if col is None:
+                raise TiDBError(f"Unknown column '{cn.name}'", code=ErrCode.BadField)
+            # VALUES(col) refers to the to-be-inserted value
+            e_node = _rewrite_values_func(expr_node, row, info)
+            e = b.build(e_node)
+            data, nulls = e.eval(chunk)
+            v = None if nulls[0] else data[0]
+            if isinstance(v, _np.generic):
+                v = v.item()
+            new_row[col.id] = (convert_internal(v, e.ftype, col.ftype)
+                               if v is not None else None)
+        tbl.update_record(old, new_row, conflict_handle)
+        return 2
+
+
+_DEFAULT = object()
+
+
+def _rewrite_values_func(node, row, info):
+    if isinstance(node, ast.FuncCall) and node.name == "values" and node.args:
+        cn = node.args[0]
+        col = info.find_column(cn.name)
+        if col is not None:
+            v = row.get(col.id)
+            if v is None:
+                return ast.Literal("null", None)
+            if isinstance(v, bytes):
+                return ast.Literal("str", v.decode("utf-8", "replace"))
+            if isinstance(v, float):
+                return ast.Literal("float", v)
+            return ast.Literal("int", int(v))
+    if isinstance(node, ast.BinaryOp):
+        return ast.BinaryOp(op=node.op,
+                            left=_rewrite_values_func(node.left, row, info),
+                            right=_rewrite_values_func(node.right, row, info))
+    return node
+
+
+class UpdateExec:
+    def __init__(self, session, stmt: ast.UpdateStmt):
+        self.session = session
+        self.stmt = stmt
+
+    def execute(self) -> DMLResult:
+        sess = self.session
+        stmt = self.stmt
+        if not isinstance(stmt.table, ast.TableName):
+            raise TiDBError("multi-table UPDATE not supported yet")
+        db, info = _resolve_table(sess, stmt.table)
+        alias = stmt.table.as_name or stmt.table.name
+        txn = sess.txn_for_write()
+        tbl = Table(info, txn)
+        cols = info.public_columns()
+        chunk = tbl.scan_columnar(col_infos=cols, with_handle=True)
+        handles = chunk.columns[-1].data
+        data_chunk = type(chunk)(chunk.columns[:-1])
+        refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
+        schema = Schema(refs)
+        b = ExprBuilder(schema, sess.expr_ctx())
+        mask = np.ones(data_chunk.num_rows, dtype=bool)
+        if stmt.where is not None:
+            cond = b.build(stmt.where)
+            d, n = cond.eval(data_chunk)
+            mask = (d != 0) & ~n
+        sel = np.nonzero(mask)[0]
+        if stmt.order_by:
+            keys = []
+            descs = []
+            for bi in stmt.order_by:
+                e = b.build(bi.expr)
+                dd, nn = e.eval(data_chunk)
+                keys.append((dd[sel], nn[sel]))
+                descs.append(bi.desc)
+            order = host.sort_indices(keys, descs)
+            sel = sel[order]
+        if stmt.limit is not None:
+            count = int(b.build(stmt.limit.count).eval_scalar())
+            sel = sel[:count]
+        # evaluate all assignment expressions over selected rows at once
+        sub = data_chunk.take(sel)
+        assigns = []
+        for cn, expr_node in stmt.assignments:
+            col = info.find_column(cn.name)
+            if col is None:
+                raise TiDBError(f"Unknown column '{cn.name}' in 'field list'",
+                                code=ErrCode.BadField)
+            if isinstance(expr_node, ast.DefaultExpr):
+                vals = [_col_default(sess, info, col)] * len(sel)
+                nulls = [v is None for v in vals]
+                assigns.append((col, vals, nulls, col.ftype))
+                continue
+            e = b.build(expr_node)
+            d, n = e.eval(sub)
+            assigns.append((col, d, n, e.ftype))
+        affected = 0
+        for i, row_pos in enumerate(sel):
+            handle = int(handles[row_pos])
+            old = tbl.get_row(handle)
+            if old is None:
+                continue
+            new_row = dict(old)
+            changed = False
+            for col, d, n, src_ft in assigns:
+                v = None if n[i] else d[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                if v is None and col.ftype.not_null:
+                    raise TiDBError(f"Column '{col.name}' cannot be null",
+                                    code=ErrCode.BadNull)
+                nv = convert_internal(v, src_ft, col.ftype) if v is not None else None
+                if new_row.get(col.id) != nv:
+                    new_row[col.id] = nv
+                    changed = True
+            if not changed:
+                continue
+            if info.pk_is_handle and new_row.get(info.pk_col_id) != handle:
+                # pk change: delete + insert under new handle
+                new_handle = new_row[info.pk_col_id]
+                tbl.remove_record(old, handle)
+                tbl.add_record(new_row, new_handle)
+            else:
+                tbl.update_record(old, new_row, handle)
+            affected += 1
+        sess.finish_dml()
+        return DMLResult(affected=affected)
+
+
+class DeleteExec:
+    def __init__(self, session, stmt: ast.DeleteStmt):
+        self.session = session
+        self.stmt = stmt
+
+    def execute(self) -> DMLResult:
+        sess = self.session
+        stmt = self.stmt
+        db, info = _resolve_table(sess, stmt.table)
+        alias = stmt.table.as_name or stmt.table.name
+        txn = sess.txn_for_write()
+        tbl = Table(info, txn)
+        cols = info.public_columns()
+        chunk = tbl.scan_columnar(col_infos=cols, with_handle=True)
+        handles = chunk.columns[-1].data
+        data_chunk = type(chunk)(chunk.columns[:-1])
+        refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
+        b = ExprBuilder(Schema(refs), sess.expr_ctx())
+        mask = np.ones(data_chunk.num_rows, dtype=bool)
+        if stmt.where is not None:
+            d, n = b.build(stmt.where).eval(data_chunk)
+            mask = (d != 0) & ~n
+        sel = np.nonzero(mask)[0]
+        if stmt.order_by:
+            keys, descs = [], []
+            for bi in stmt.order_by:
+                e = b.build(bi.expr)
+                dd, nn = e.eval(data_chunk)
+                keys.append((dd[sel], nn[sel]))
+                descs.append(bi.desc)
+            sel = sel[host.sort_indices(keys, descs)]
+        if stmt.limit is not None:
+            count = int(b.build(stmt.limit.count).eval_scalar())
+            sel = sel[:count]
+        affected = 0
+        for row_pos in sel:
+            handle = int(handles[row_pos])
+            old = tbl.get_row(handle)
+            if old is None:
+                continue
+            tbl.remove_record(old, handle)
+            affected += 1
+        sess.finish_dml()
+        return DMLResult(affected=affected)

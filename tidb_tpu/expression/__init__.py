@@ -1,0 +1,20 @@
+"""Expression layer (reference: expression/ — tree, vectorized eval,
+builtin registry, aggregation descriptors)."""
+
+from .core import (
+    Column, Constant, Expression, ScalarFunc, const_null, phys_kind,
+    K_DEC, K_FLOAT, K_INT, K_STR, K_DATE, like_to_regex,
+)
+from .builder import (
+    ColumnRef, ExprBuilder, Schema, build_in_set, infer_arith_type,
+    literal_to_constant, unify_types,
+)
+from .aggregation import AggFuncDesc, infer_agg_type
+
+__all__ = [
+    "Column", "Constant", "Expression", "ScalarFunc", "const_null",
+    "phys_kind", "K_DEC", "K_FLOAT", "K_INT", "K_STR", "K_DATE",
+    "like_to_regex", "ColumnRef", "ExprBuilder", "Schema", "build_in_set",
+    "infer_arith_type", "literal_to_constant", "unify_types",
+    "AggFuncDesc", "infer_agg_type",
+]

@@ -1,0 +1,60 @@
+"""Aggregate function descriptors (reference: expression/aggregation/ —
+AggFuncDesc with partial/final modes; the actual group computation lives in
+the executor (host numpy) and ops/ (device kernels))."""
+
+from __future__ import annotations
+
+from ..errors import TiDBError
+from ..sqltypes import (
+    DEFAULT_DIV_PRECISION_INCREMENT, FLAG_NOT_NULL, MAX_DECIMAL_SCALE,
+    TYPE_DOUBLE, TYPE_LONGLONG, TYPE_NEWDECIMAL, TYPE_VARCHAR, FieldType,
+)
+from .core import Expression, phys_kind, K_DEC, K_FLOAT, K_STR
+
+SUPPORTED_AGGS = {"count", "sum", "avg", "min", "max", "group_concat",
+                  "bit_and", "bit_or", "bit_xor", "stddev_pop", "var_pop",
+                  "stddev_samp", "var_samp", "approx_count_distinct",
+                  "first_row"}
+
+
+def infer_agg_type(name: str, arg: Expression | None) -> FieldType:
+    if name in ("count", "approx_count_distinct", "bit_and", "bit_or", "bit_xor"):
+        return FieldType(tp=TYPE_LONGLONG, flag=FLAG_NOT_NULL)
+    if name == "group_concat":
+        return FieldType(tp=TYPE_VARCHAR)
+    if name in ("min", "max", "first_row"):
+        return arg.ftype.clone()
+    k = phys_kind(arg.ftype)
+    if name == "sum":
+        if k == K_FLOAT or k == K_STR:
+            return FieldType(tp=TYPE_DOUBLE)
+        if k == K_DEC:
+            return FieldType(tp=TYPE_NEWDECIMAL, flen=38, decimal=arg.ftype.scale)
+        return FieldType(tp=TYPE_NEWDECIMAL, flen=38, decimal=0)
+    if name == "avg":
+        if k == K_FLOAT or k == K_STR:
+            return FieldType(tp=TYPE_DOUBLE)
+        s = arg.ftype.scale if k == K_DEC else 0
+        return FieldType(tp=TYPE_NEWDECIMAL, flen=38,
+                         decimal=min(s + DEFAULT_DIV_PRECISION_INCREMENT,
+                                     MAX_DECIMAL_SCALE))
+    if name in ("stddev_pop", "var_pop", "stddev_samp", "var_samp"):
+        return FieldType(tp=TYPE_DOUBLE)
+    raise TiDBError(f"unsupported aggregate {name}")
+
+
+class AggFuncDesc:
+    """name + argument expressions over the agg input schema + distinct."""
+
+    __slots__ = ("name", "args", "distinct", "ftype")
+
+    def __init__(self, name: str, args: list, distinct: bool = False):
+        if name not in SUPPORTED_AGGS:
+            raise TiDBError(f"unsupported aggregate function {name.upper()}")
+        self.name = name
+        self.args = args
+        self.distinct = distinct
+        self.ftype = infer_agg_type(name, args[0] if args else None)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"

@@ -1,0 +1,573 @@
+"""AST → Expression building with name resolution + MySQL type inference
+(reference: planner/core/expression_rewriter.go)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import ColumnError, TiDBError, ErrCode
+from ..parser import ast
+from ..sqltypes import (
+    DEFAULT_DIV_PRECISION_INCREMENT, FLOAT_TYPES, INT_TYPES, MAX_DECIMAL_SCALE,
+    STRING_TYPES, TYPE_DATE, TYPE_DATETIME, TYPE_DOUBLE, TYPE_DURATION,
+    TYPE_LONGLONG, TYPE_NEWDATE, TYPE_NEWDECIMAL, TYPE_NULL, TYPE_TIMESTAMP,
+    TYPE_VARCHAR, FieldType, UNSPECIFIED_LENGTH, parse_date_str,
+    parse_datetime_str, str_to_decimal,
+)
+from .core import (
+    Column, Constant, Expression, K_DATE, K_DEC, K_FLOAT, K_INT, K_STR,
+    ScalarFunc, const_null, like_to_regex, phys_kind,
+)
+
+_BOOL_FT = FieldType(tp=TYPE_LONGLONG)
+
+_OP_MAP = {
+    "+": "add", "-": "sub", "*": "mul", "/": "div", "div": "intdiv",
+    "mod": "mod", "%": "mod",
+    "=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge",
+    "<=>": "nulleq", "and": "and", "or": "or", "xor": "xor",
+}
+
+
+class ColumnRef:
+    """One name-resolvable output column of a plan node."""
+
+    __slots__ = ("name", "table", "db", "ftype", "uid")
+
+    def __init__(self, name, table, db, ftype, uid=0):
+        self.name = name.lower() if name else ""
+        self.table = table.lower() if table else ""
+        self.db = db.lower() if db else ""
+        self.ftype = ftype
+        self.uid = uid
+
+    def __repr__(self):
+        return f"{self.table + '.' if self.table else ''}{self.name}"
+
+
+class Schema:
+    def __init__(self, refs: list[ColumnRef]):
+        self.refs = refs
+
+    def __len__(self):
+        return len(self.refs)
+
+    def find(self, cn: ast.ColumnName):
+        name = cn.name.lower()
+        table = cn.table.lower() if cn.table else ""
+        db = cn.schema.lower() if cn.schema else ""
+        matches = []
+        for i, r in enumerate(self.refs):
+            if r.name != name:
+                continue
+            if table and r.table != table:
+                continue
+            if db and r.db and r.db != db:
+                continue
+            matches.append(i)
+        if not matches:
+            return None
+        if len(matches) > 1:
+            # same table+name appearing twice is ambiguous; from different
+            # tables without qualifier also ambiguous
+            raise ColumnError(f"Column '{cn.name}' in field list is ambiguous",
+                              code=ErrCode.NonUniq)
+        return matches[0]
+
+    def concat(self, other: "Schema") -> "Schema":
+        return Schema(self.refs + other.refs)
+
+
+def unify_types(fts: list[FieldType]) -> FieldType:
+    """Result type for CASE/COALESCE/UNION column merging."""
+    fts = [ft for ft in fts if ft.tp != TYPE_NULL]
+    if not fts:
+        return FieldType(tp=TYPE_NULL)
+    kinds = [phys_kind(ft) for ft in fts]
+    if all(k == K_STR for k in kinds):
+        return FieldType(tp=TYPE_VARCHAR)
+    if any(k == K_STR for k in kinds):
+        return FieldType(tp=TYPE_VARCHAR)
+    if any(k == K_FLOAT for k in kinds):
+        return FieldType(tp=TYPE_DOUBLE)
+    if any(k == K_DEC for k in kinds):
+        s = max(ft.scale for ft in fts if phys_kind(ft) == K_DEC)
+        return FieldType(tp=TYPE_NEWDECIMAL, flen=30, decimal=s)
+    tps = {ft.tp for ft in fts}
+    if tps <= {TYPE_DATE, TYPE_NEWDATE}:
+        return FieldType(tp=TYPE_DATE)
+    if tps <= {TYPE_DATE, TYPE_NEWDATE, TYPE_DATETIME, TYPE_TIMESTAMP}:
+        return FieldType(tp=TYPE_DATETIME)
+    return FieldType(tp=TYPE_LONGLONG)
+
+
+def infer_arith_type(op: str, lft: FieldType, rft: FieldType) -> FieldType:
+    lk, rk = phys_kind(lft), phys_kind(rft)
+    if op in ("eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or",
+              "xor", "in", "like", "not"):
+        return _BOOL_FT.clone()
+    if op == "intdiv":
+        return FieldType(tp=TYPE_LONGLONG)
+    float_in = (K_FLOAT in (lk, rk)) or (K_STR in (lk, rk))
+    if op == "div":
+        if float_in:
+            return FieldType(tp=TYPE_DOUBLE)
+        s1 = lft.scale if lk == K_DEC else 0
+        return FieldType(tp=TYPE_NEWDECIMAL, flen=30,
+                         decimal=min(s1 + DEFAULT_DIV_PRECISION_INCREMENT,
+                                     MAX_DECIMAL_SCALE))
+    if float_in:
+        return FieldType(tp=TYPE_DOUBLE)
+    if K_DEC in (lk, rk):
+        s1 = lft.scale if lk == K_DEC else 0
+        s2 = rft.scale if rk == K_DEC else 0
+        if op == "mul":
+            s = min(s1 + s2, MAX_DECIMAL_SCALE)
+        else:
+            s = max(s1, s2)
+        return FieldType(tp=TYPE_NEWDECIMAL, flen=30, decimal=s)
+    if op == "mod":
+        return FieldType(tp=TYPE_LONGLONG)
+    return FieldType(tp=TYPE_LONGLONG)
+
+
+def literal_to_constant(lit: ast.Literal) -> Constant:
+    k = lit.kind
+    if k == "null":
+        return const_null()
+    if k == "int":
+        return Constant(int(lit.val), FieldType(tp=TYPE_LONGLONG))
+    if k == "float":
+        return Constant(float(lit.val), FieldType(tp=TYPE_DOUBLE))
+    if k == "dec":
+        text = str(lit.val)
+        frac = text.split(".", 1)[1] if "." in text else ""
+        scale = min(len(frac), MAX_DECIMAL_SCALE)
+        return Constant(str_to_decimal(text, scale),
+                        FieldType(tp=TYPE_NEWDECIMAL, flen=30, decimal=scale))
+    if k == "str":
+        v = lit.val
+        return Constant(v.encode() if isinstance(v, str) else v,
+                        FieldType(tp=TYPE_VARCHAR))
+    if k == "date":
+        return Constant(parse_date_str(str(lit.val)), FieldType(tp=TYPE_DATE))
+    if k == "datetime":
+        return Constant(parse_datetime_str(str(lit.val)),
+                        FieldType(tp=TYPE_DATETIME))
+    if k == "time":
+        from ..table import cast_value
+        return Constant(cast_value(str(lit.val), FieldType(tp=TYPE_DURATION)),
+                        FieldType(tp=TYPE_DURATION))
+    raise TiDBError(f"unknown literal kind {k}")
+
+
+# result type computation for scalar functions
+_STR_FUNCS = {"concat", "concat_ws", "upper", "lower", "substring", "trim",
+              "ltrim", "rtrim", "replace", "left", "right", "reverse",
+              "repeat", "lpad", "rpad", "date_format", "hex", "md5", "sha1"}
+_INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
+              "dayofmonth", "hour", "minute", "second", "quarter", "week",
+              "dayofweek", "dayofyear", "extract", "datediff", "sign",
+              "ascii", "instr", "isnull", "istrue", "isfalse", "found_rows",
+              "row_count", "last_insert_id", "connection_id", "crc32"}
+_FLOAT_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "pow", "power", "rand",
+                "radians", "degrees", "sin", "cos", "tan", "atan", "asin",
+                "acos", "pi"}
+
+
+class ExprBuilder:
+    """Builds expressions against a schema. `ctx` (optional) provides:
+    - eval_subquery(select_ast) -> (list of row tuples, [FieldType])
+    - get_sysvar(name, scope) -> str value
+    - get_uservar(name) -> value
+    """
+
+    def __init__(self, schema: Schema, ctx=None, allow_agg=False):
+        self.schema = schema
+        self.ctx = ctx
+        self.allow_agg = allow_agg
+
+    def build(self, node: ast.ExprNode) -> Expression:
+        m = getattr(self, "_b_" + type(node).__name__, None)
+        if m is None:
+            raise TiDBError(f"unsupported expression {type(node).__name__}")
+        return m(node)
+
+    # -- leaves -------------------------------------------------------------
+
+    def _b_Literal(self, node):
+        return literal_to_constant(node)
+
+    def _b_ColumnName(self, node):
+        idx = self.schema.find(node)
+        if idx is None:
+            raise ColumnError(f"Unknown column '{node.name}' in 'field list'")
+        r = self.schema.refs[idx]
+        return Column(idx, r.ftype, name=r.name)
+
+    def _b_ParamMarker(self, node):
+        if self.ctx is not None and getattr(self.ctx, "params", None) is not None:
+            try:
+                v = self.ctx.params[node.index]
+            except IndexError:
+                raise TiDBError("missing prepared statement parameter")
+            return _python_value_to_constant(v)
+        raise TiDBError("parameter marker outside prepared statement")
+
+    def _b_VariableExpr(self, node):
+        if self.ctx is None:
+            raise TiDBError("variables not available in this context")
+        if node.is_system:
+            v = self.ctx.get_sysvar(node.name, node.scope or "session")
+            return Constant(v.encode() if isinstance(v, str) else v,
+                            FieldType(tp=TYPE_VARCHAR))
+        if node.value is not None:
+            val_expr = self.build(node.value)
+            v = val_expr.eval_scalar()
+            self.ctx.set_uservar(node.name, v)
+            return val_expr
+        return _python_value_to_constant(self.ctx.get_uservar(node.name))
+
+    def _b_DefaultExpr(self, node):
+        raise TiDBError("DEFAULT is only valid in INSERT/UPDATE")
+
+    # -- operators ----------------------------------------------------------
+
+    def _b_BinaryOp(self, node):
+        op = _OP_MAP.get(node.op)
+        if op is None:
+            if node.op in ("&", "|", "^", "<<", ">>"):
+                return self._bitop(node)
+            raise TiDBError(f"unsupported operator {node.op}")
+        l = self.build(node.left)
+        r = self.build(node.right)
+        ft = infer_arith_type(op, l.ftype, r.ftype)
+        return ScalarFunc(op, [l, r], ft)
+
+    def _bitop(self, node):
+        l = self.build(node.left)
+        r = self.build(node.right)
+        opname = {"&": "bitand", "|": "bitor", "^": "bitxor",
+                  "<<": "shl", ">>": "shr"}[node.op]
+        return ScalarFunc(opname, [l, r], FieldType(tp=TYPE_LONGLONG))
+
+    def _b_UnaryOp(self, node):
+        operand = self.build(node.operand)
+        if node.op == "-":
+            ft = operand.ftype.clone()
+            if phys_kind(ft) == K_STR:
+                ft = FieldType(tp=TYPE_DOUBLE)
+            return ScalarFunc("neg", [operand], ft)
+        if node.op == "not":
+            return ScalarFunc("not", [operand], _BOOL_FT.clone())
+        if node.op == "~":
+            return ScalarFunc("bitneg", [operand], FieldType(tp=TYPE_LONGLONG))
+        raise TiDBError(f"unsupported unary op {node.op}")
+
+    def _b_IsNullExpr(self, node):
+        e = ScalarFunc("isnull", [self.build(node.expr)], _BOOL_FT.clone())
+        if node.negated:
+            return ScalarFunc("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _b_IsTruthExpr(self, node):
+        op = "istrue" if node.truth else "isfalse"
+        e = ScalarFunc(op, [self.build(node.expr)], _BOOL_FT.clone())
+        if node.negated:
+            return ScalarFunc("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _b_BetweenExpr(self, node):
+        e = self.build(node.expr)
+        lo = self.build(node.low)
+        hi = self.build(node.high)
+        ge = ScalarFunc("ge", [e, lo], _BOOL_FT.clone())
+        le = ScalarFunc("le", [e, hi], _BOOL_FT.clone())
+        res = ScalarFunc("and", [ge, le], _BOOL_FT.clone())
+        if node.negated:
+            return ScalarFunc("not", [res], _BOOL_FT.clone())
+        return res
+
+    def _b_InExpr(self, node):
+        target = self.build(node.expr)
+        if len(node.items) == 1 and isinstance(node.items[0], ast.SubqueryExpr):
+            rows, fts = self._run_subquery(node.items[0].query)
+            if fts and len(fts) != 1:
+                raise TiDBError("Operand should contain 1 column(s)",
+                                code=ErrCode.OperandColumns)
+            sub_ft = fts[0] if fts else target.ftype
+            e = build_in_set(target, [r[0] for r in rows], sub_ft)
+        else:
+            items = [self.build(i) for i in node.items]
+            consts = all(isinstance(i, Constant) for i in items)
+            kinds = {phys_kind(i.ftype) for i in items if i.value is not None}
+            if consts and (phys_kind(target.ftype) == K_STR) == (kinds <= {K_STR}):
+                vals, vft = [], unify_types(
+                    [i.ftype for i in items if i.value is not None] or [target.ftype])
+                from ..table import convert_internal
+                for i in items:
+                    vals.append(None if i.value is None
+                                else convert_internal(i.value, i.ftype, vft))
+                e = build_in_set(target, vals, vft)
+            else:
+                e = ScalarFunc("in", [target] + items, _BOOL_FT.clone())
+        if node.negated:
+            return ScalarFunc("not", [e], _BOOL_FT.clone())
+        return e
+
+    def _b_LikeExpr(self, node):
+        e = self.build(node.expr)
+        pat = self.build(node.pattern)
+        extra = None
+        if isinstance(pat, Constant) and pat.value is not None:
+            esc = node.escape.encode() if isinstance(node.escape, str) else node.escape
+            extra = like_to_regex(pat.value, esc or b"\\")
+        res = ScalarFunc("like", [e, pat], _BOOL_FT.clone(), extra=extra)
+        if node.negated:
+            return ScalarFunc("not", [res], _BOOL_FT.clone())
+        return res
+
+    def _b_RegexpExpr(self, node):
+        e = self.build(node.expr)
+        pat = self.build(node.pattern)
+        res = ScalarFunc("regexp", [e, pat], _BOOL_FT.clone())
+        if node.negated:
+            return ScalarFunc("not", [res], _BOOL_FT.clone())
+        return res
+
+    def _b_CaseExpr(self, node):
+        args = []
+        result_fts = []
+        for cond, res in node.whens:
+            if node.operand is not None:
+                c = ast.BinaryOp(op="=", left=node.operand, right=cond)
+            else:
+                c = cond
+            args.append(self.build(c))
+            r = self.build(res)
+            args.append(r)
+            result_fts.append(r.ftype)
+        if node.else_ is not None:
+            e = self.build(node.else_)
+            args.append(e)
+            result_fts.append(e.ftype)
+        ft = unify_types(result_fts)
+        return ScalarFunc("case", args, ft)
+
+    def _b_CastExpr(self, node):
+        e = self.build(node.expr)
+        return ScalarFunc("cast", [e], node.ftype.clone())
+
+    def _b_RowExpr(self, node):
+        raise TiDBError("row expressions not supported in this context")
+
+    def _b_SubqueryExpr(self, node):
+        rows, fts = self._run_subquery(node.query)
+        if len(rows) > 1:
+            raise TiDBError("Subquery returns more than 1 row",
+                            code=ErrCode.SubqueryMoreThan1Row)
+        if fts and len(fts) != 1:
+            raise TiDBError("Operand should contain 1 column(s)",
+                            code=ErrCode.OperandColumns)
+        if not rows:
+            return const_null()
+        v = rows[0][0]
+        return Constant(v, fts[0]) if v is not None else const_null()
+
+    def _b_ExistsExpr(self, node):
+        rows, _ = self._run_subquery(node.query.query, limit_one=True)
+        v = 1 if rows else 0
+        if node.negated:
+            v = 1 - v
+        return Constant(v, _BOOL_FT.clone())
+
+    def _b_CompareSubquery(self, node):
+        rows, fts = self._run_subquery(node.query.query)
+        vals = [r[0] for r in rows]
+        target = self.build(node.expr)
+        op = _OP_MAP[node.op]
+        if node.quantifier == "any":
+            if op == "eq":
+                return build_in_set(target, vals)
+            agg = "min" if op in ("gt", "ge") else "max"
+        else:  # all
+            if op == "ne":
+                e = build_in_set(target, vals)
+                return ScalarFunc("not", [e], _BOOL_FT.clone())
+            agg = "max" if op in ("gt", "ge") else "min"
+        if not vals:
+            return Constant(1 if node.quantifier == "all" else 0, _BOOL_FT.clone())
+        non_null = [v for v in vals if v is not None]
+        if not non_null:
+            return const_null()
+        pick = min(non_null) if agg == "min" else max(non_null)
+        return ScalarFunc(op, [target, Constant(pick, fts[0])], _BOOL_FT.clone())
+
+    def _b_AggregateFunc(self, node):
+        raise TiDBError("Invalid use of group function",
+                        code=ErrCode.InvalidGroupFuncUse)
+
+    def _b_WindowFunc(self, node):
+        raise TiDBError("window function not valid here")
+
+    def _b_IntervalExpr(self, node):
+        raise TiDBError("INTERVAL is only valid in date arithmetic")
+
+    def _b_StarExpr(self, node):
+        raise TiDBError("'*' not valid here")
+
+    # -- function calls -----------------------------------------------------
+
+    def _b_FuncCall(self, node):
+        name = node.name
+        if name in ("date_add", "date_sub", "adddate", "subdate"):
+            sign = 1 if name in ("date_add", "adddate") else -1
+            src = self.build(node.args[0])
+            iv = node.args[1]
+            if isinstance(iv, ast.IntervalExpr):
+                unit = iv.unit
+                val = self.build(iv.value)
+            else:
+                unit = "day"
+                val = self.build(iv)
+            if unit in ("microsecond", "second", "minute", "hour",
+                        "second_microsecond", "minute_second", "hour_minute"):
+                out_ft = FieldType(tp=TYPE_DATETIME)
+            else:
+                out_ft = (FieldType(tp=TYPE_DATE)
+                          if src.ftype.tp in (TYPE_DATE, TYPE_NEWDATE)
+                          else FieldType(tp=src.ftype.tp if src.ftype.tp in
+                                         (TYPE_DATETIME, TYPE_TIMESTAMP) else TYPE_DATETIME))
+            return ScalarFunc("date_arith", [src, val], out_ft, extra=(unit, sign))
+        if name == "extract":
+            unit = node.args[0].val
+            e = self.build(node.args[1])
+            return ScalarFunc("extract", [Constant(str(unit).encode(), FieldType(tp=TYPE_VARCHAR)), e],
+                              FieldType(tp=TYPE_LONGLONG), extra=str(unit))
+        if name in ("now", "current_timestamp", "sysdate", "curdate",
+                    "current_date", "curtime", "utc_timestamp"):
+            import datetime as _dt
+            from ..sqltypes import datetime_to_micros, date_to_days
+            now = self.ctx.now() if self.ctx is not None and hasattr(self.ctx, "now") else _dt.datetime.now()
+            if name in ("curdate", "current_date"):
+                return Constant(date_to_days(now.year, now.month, now.day),
+                                FieldType(tp=TYPE_DATE))
+            return Constant(datetime_to_micros(now), FieldType(tp=TYPE_DATETIME))
+        if name == "database":
+            db = self.ctx.current_db() if self.ctx is not None else ""
+            return (Constant(db.encode(), FieldType(tp=TYPE_VARCHAR))
+                    if db else const_null())
+        if name == "version":
+            return Constant(b"8.0.11-tpu-htap", FieldType(tp=TYPE_VARCHAR))
+        if name == "user" or name == "current_user":
+            u = self.ctx.current_user() if self.ctx is not None else "root@%"
+            return Constant(u.encode(), FieldType(tp=TYPE_VARCHAR))
+        if name in ("if",):
+            args = [self.build(a) for a in node.args]
+            ft = unify_types([args[1].ftype, args[2].ftype])
+            return ScalarFunc("if", args, ft)
+        if name in ("ifnull", "coalesce"):
+            args = [self.build(a) for a in node.args]
+            ft = unify_types([a.ftype for a in args])
+            return ScalarFunc("coalesce", args, ft)
+        if name == "nullif":
+            args = [self.build(a) for a in node.args]
+            return ScalarFunc("nullif", args, args[0].ftype.clone())
+        if name in ("greatest", "least"):
+            args = [self.build(a) for a in node.args]
+            ft = unify_types([a.ftype for a in args])
+            return ScalarFunc(name, args, ft)
+        if name in ("truncate",):
+            args = [self.build(a) for a in node.args]
+            nd = args[1].value if isinstance(args[1], Constant) else 0
+            src_ft = args[0].ftype
+            if phys_kind(src_ft) == K_DEC:
+                ft = FieldType(tp=TYPE_NEWDECIMAL, flen=30, decimal=max(min(nd, src_ft.scale), 0))
+            else:
+                ft = src_ft.clone()
+            return ScalarFunc("round", args, ft)  # close enough for now
+        if name == "round":
+            args = [self.build(a) for a in node.args]
+            nd = 0
+            if len(args) > 1 and isinstance(args[1], Constant) and args[1].value is not None:
+                nd = int(args[1].value)
+            src_ft = args[0].ftype
+            if phys_kind(src_ft) == K_DEC:
+                ft = FieldType(tp=TYPE_NEWDECIMAL, flen=30,
+                               decimal=max(min(nd, src_ft.scale), 0))
+            elif phys_kind(src_ft) == K_FLOAT:
+                ft = FieldType(tp=TYPE_DOUBLE)
+            else:
+                ft = FieldType(tp=TYPE_LONGLONG)
+            return ScalarFunc("round", args, ft)
+        if name in ("abs", "ceil", "ceiling", "floor"):
+            args = [self.build(a) for a in node.args]
+            src_ft = args[0].ftype
+            if name == "abs":
+                ft = src_ft.clone()
+            else:
+                ft = FieldType(tp=TYPE_LONGLONG)
+            op = {"ceiling": "ceil"}.get(name, name)
+            return ScalarFunc(op, args, ft)
+        args = [self.build(a) for a in node.args]
+        if name in _STR_FUNCS:
+            ft = FieldType(tp=TYPE_VARCHAR)
+        elif name in _INT_FUNCS:
+            ft = FieldType(tp=TYPE_LONGLONG)
+        elif name in _FLOAT_FUNCS:
+            ft = FieldType(tp=TYPE_DOUBLE)
+        elif name == "date":
+            ft = FieldType(tp=TYPE_DATE)
+        else:
+            raise TiDBError(f"unsupported function {name.upper()}")
+        op = {"power": "pow", "substr": "substring"}.get(name, name)
+        return ScalarFunc(op, args, ft)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _run_subquery(self, select, limit_one=False):
+        if self.ctx is None or not hasattr(self.ctx, "eval_subquery"):
+            raise TiDBError("subqueries not available in this context")
+        return self.ctx.eval_subquery(select, limit_one=limit_one)
+
+
+def build_in_set(target: Expression, values, values_ft: FieldType = None) -> ScalarFunc:
+    """IN against a materialized value list (semi-join materialization for
+    uncorrelated IN-subqueries, reference: planner rewrites these to
+    semi-joins — here the hash set *is* the join). The target is coerced to a
+    comparison type unified with the value list's type."""
+    if values_ft is None:
+        values_ft = target.ftype
+    common = unify_types([target.ftype, values_ft])
+    has_null = any(v is None for v in values)
+    non_null = [v for v in values if v is not None]
+    k = phys_kind(common)
+    from ..table import convert_internal
+    conv = [convert_internal(v, values_ft, common) for v in non_null]
+    if k == K_STR:
+        vals = set(v if isinstance(v, bytes) else str(v).encode() for v in conv)
+    elif k == K_FLOAT:
+        vals = np.array([float(v) for v in conv], dtype=np.float64)
+    else:
+        vals = np.array([int(v) for v in conv], dtype=np.int64)
+    cmp_target = target
+    if (phys_kind(target.ftype), target.ftype.scale) != (k, common.scale):
+        cmp_target = ScalarFunc("cast", [target], common)
+    return ScalarFunc("in_set", [cmp_target], _BOOL_FT.clone(),
+                      extra=(vals, has_null))
+
+
+def _python_value_to_constant(v):
+    if v is None:
+        return const_null()
+    if isinstance(v, bool):
+        return Constant(int(v), FieldType(tp=TYPE_LONGLONG))
+    if isinstance(v, int):
+        return Constant(v, FieldType(tp=TYPE_LONGLONG))
+    if isinstance(v, float):
+        return Constant(v, FieldType(tp=TYPE_DOUBLE))
+    if isinstance(v, str):
+        return Constant(v.encode(), FieldType(tp=TYPE_VARCHAR))
+    if isinstance(v, bytes):
+        return Constant(v, FieldType(tp=TYPE_VARCHAR))
+    raise TiDBError(f"cannot convert {type(v)} to constant")

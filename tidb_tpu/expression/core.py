@@ -1,0 +1,1204 @@
+"""Expression tree with vectorized evaluation
+(reference: expression/expression.go — Column/Constant/ScalarFunction with
+VecEval*; the 281 hand+generated vec builtins collapse here into numpy
+ufunc compositions, which is also exactly the trace a jax kernel records).
+
+Evaluation contract: ``expr.eval(chunk) -> (data, nulls)`` where data is a
+numpy array in the column's physical representation (see utils/chunk.py) and
+nulls is a bool mask. Decimals are scaled int64 at ``expr.ftype.scale``.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from ..errors import TiDBError
+from ..sqltypes import (
+    DEFAULT_DIV_PRECISION_INCREMENT, FLOAT_TYPES, INT_TYPES, POW10,
+    STRING_TYPES, TYPE_DATE, TYPE_DATETIME, TYPE_DOUBLE, TYPE_DURATION,
+    TYPE_JSON, TYPE_LONG, TYPE_LONGLONG, TYPE_NEWDATE, TYPE_NEWDECIMAL,
+    TYPE_NULL, TYPE_TIMESTAMP, TYPE_VARCHAR, TYPE_YEAR, FieldType,
+    UNSPECIFIED_LENGTH, days_to_date, micros_to_datetime,
+)
+from ..utils.chunk import Chunk, np_dtype_for
+
+# physical kinds
+K_INT = "i"      # int64 (ints, year, duration-us, datetime-us)
+K_DEC = "d"      # scaled int64
+K_FLOAT = "f"    # float64/float32
+K_STR = "s"      # object array of bytes
+K_DATE = "t"     # int32 days
+
+
+def phys_kind(ft: FieldType) -> str:
+    tp = ft.tp
+    if tp == TYPE_NEWDECIMAL:
+        return K_DEC
+    if tp in FLOAT_TYPES:
+        return K_FLOAT
+    if tp in STRING_TYPES or tp == TYPE_JSON:
+        return K_STR
+    if tp in (TYPE_DATE, TYPE_NEWDATE):
+        return K_DATE
+    return K_INT
+
+
+class Expression:
+    ftype: FieldType = None
+
+    def eval(self, chunk: Chunk):
+        raise NotImplementedError
+
+    def eval_scalar(self, row=None):
+        """Evaluate as a constant (no column refs) -> python value."""
+        data, nulls = self.eval(_EMPTY_ONE)
+        if nulls[0]:
+            return None
+        v = data[0]
+        return v.item() if isinstance(v, np.generic) else v
+
+    def columns_used(self, acc: set):
+        pass
+
+    def transform_columns(self, fn):
+        """Return a copy with every Column node replaced by fn(col)."""
+        return self
+
+    def __repr__(self):
+        return f"<{type(self).__name__}>"
+
+
+class Column(Expression):
+    """Reference to the idx-th column of the input chunk."""
+
+    def __init__(self, idx: int, ftype: FieldType, name: str = ""):
+        self.idx = idx
+        self.ftype = ftype
+        self.name = name
+
+    def eval(self, chunk: Chunk):
+        col = chunk.columns[self.idx]
+        return col.data, col.nulls
+
+    def columns_used(self, acc: set):
+        acc.add(self.idx)
+
+    def transform_columns(self, fn):
+        return fn(self)
+
+    def __repr__(self):
+        return f"Col#{self.idx}({self.name})"
+
+
+class Constant(Expression):
+    def __init__(self, value, ftype: FieldType):
+        self.value = value
+        self.ftype = ftype
+
+    def eval(self, chunk: Chunk):
+        n = chunk.num_rows if chunk.num_cols else 1
+        dt = np_dtype_for(self.ftype)
+        if self.value is None:
+            if dt is object:
+                data = np.full(n, b"", dtype=object)
+            else:
+                data = np.zeros(n, dtype=dt)
+            return data, np.ones(n, dtype=bool)
+        if dt is object:
+            data = np.full(n, self.value, dtype=object)
+        else:
+            data = np.full(n, self.value, dtype=dt)
+        return data, np.zeros(n, dtype=bool)
+
+    def __repr__(self):
+        return f"Const({self.value})"
+
+
+_EMPTY_ONE = Chunk([])
+
+
+def const_null() -> Constant:
+    return Constant(None, FieldType(tp=TYPE_NULL))
+
+
+class ScalarFunc(Expression):
+    def __init__(self, op: str, args: list, ftype: FieldType, extra=None):
+        self.op = op
+        self.args = args
+        self.ftype = ftype
+        self.extra = extra  # op-specific payload (e.g. IN value set, LIKE regex)
+
+    def eval(self, chunk: Chunk):
+        fn = _DISPATCH.get(self.op)
+        if fn is None:
+            raise TiDBError(f"unsupported scalar function {self.op}")
+        return fn(self, chunk)
+
+    def columns_used(self, acc: set):
+        for a in self.args:
+            a.columns_used(acc)
+
+    def transform_columns(self, fn):
+        return ScalarFunc(self.op, [a.transform_columns(fn) for a in self.args],
+                          self.ftype, self.extra)
+
+    def __repr__(self):
+        return f"{self.op}({', '.join(map(repr, self.args))})"
+
+
+# ---------------------------------------------------------------------------
+# eval helpers
+# ---------------------------------------------------------------------------
+
+def _as_float(data, ft: FieldType):
+    k = phys_kind(ft)
+    if k == K_DEC:
+        return data.astype(np.float64) / float(POW10[ft.scale])
+    if k == K_STR:
+        out = np.zeros(len(data), dtype=np.float64)
+        for i, b in enumerate(data):
+            try:
+                out[i] = float(b) if b else 0.0
+            except ValueError:
+                m = re.match(rb"\s*-?\d+(\.\d+)?", b)
+                out[i] = float(m.group(0)) if m else 0.0
+        return out
+    return data.astype(np.float64)
+
+
+def _as_decimal(data, ft: FieldType, to_scale: int):
+    """-> scaled int64 at to_scale."""
+    k = phys_kind(ft)
+    if k == K_DEC:
+        diff = to_scale - ft.scale
+        if diff == 0:
+            return data.astype(np.int64)
+        if diff > 0:
+            return data.astype(np.int64) * POW10[diff]
+        return _div_round(data.astype(np.int64), POW10[-diff])
+    if k == K_FLOAT:
+        return np.round(data * POW10[to_scale]).astype(np.int64)
+    if k == K_STR:
+        f = _as_float(data, ft)
+        return np.round(f * POW10[to_scale]).astype(np.int64)
+    return data.astype(np.int64) * POW10[to_scale]
+
+
+def _div_round(num, den):
+    """Vectorized round-half-away-from-zero division (MySQL decimal rounding)."""
+    num = num.astype(np.int64)
+    if np.isscalar(den) or getattr(den, "shape", ()) == ():
+        den = np.int64(den)
+    sign = np.where((num < 0) != (den < 0), -1, 1)
+    a = np.abs(num)
+    d = np.abs(den)
+    d_safe = np.where(d == 0, 1, d)
+    q = (2 * a + d_safe) // (2 * d_safe)
+    return sign * q
+
+
+def _num_common(sf: ScalarFunc, chunk: Chunk):
+    """Evaluate two args, coerce to a common numeric kind.
+    -> (kind, lhs, rhs, nulls, scale)"""
+    l, r = sf.args
+    ld, ln = l.eval(chunk)
+    rd, rn = r.eval(chunk)
+    nulls = ln | rn
+    lk, rk = phys_kind(l.ftype), phys_kind(r.ftype)
+    # temporal vs string: parse the string as the temporal type (MySQL
+    # compares a DATE column against '1995-04-01' as dates, not floats)
+    if lk in (K_DATE,) or l.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        if rk == K_STR:
+            rd, extra_null = _cast_to(rd, rn, r.ftype, l.ftype)
+            nulls = nulls | extra_null
+            return _num_common_resume(l.ftype, l.ftype, ld, rd, nulls)
+    if rk in (K_DATE,) or r.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        if lk == K_STR:
+            ld, extra_null = _cast_to(ld, ln, l.ftype, r.ftype)
+            nulls = nulls | extra_null
+            return _num_common_resume(r.ftype, r.ftype, ld, rd, nulls)
+    # date/datetime mixing: promote DATE (days) to DATETIME (micros)
+    if lk == K_DATE and r.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        ld = ld.astype(np.int64) * 86_400_000_000
+        lk = K_INT
+    if rk == K_DATE and l.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        rd = rd.astype(np.int64) * 86_400_000_000
+        rk = K_INT
+    if lk == K_DATE:
+        lk = K_INT
+    if rk == K_DATE:
+        rk = K_INT
+    if lk == K_STR and rk == K_STR:
+        return K_STR, ld, rd, nulls, 0
+    if K_FLOAT in (lk, rk) or K_STR in (lk, rk):
+        return K_FLOAT, _as_float(ld, l.ftype), _as_float(rd, r.ftype), nulls, 0
+    if K_DEC in (lk, rk):
+        s = max(l.ftype.scale if lk == K_DEC else 0,
+                r.ftype.scale if rk == K_DEC else 0)
+        return K_DEC, _as_decimal(ld, l.ftype, s), _as_decimal(rd, r.ftype, s), nulls, s
+    return K_INT, ld.astype(np.int64), rd.astype(np.int64), nulls, 0
+
+
+def _num_common_resume(lft, rft, ld, rd, nulls):
+    """Both sides now share a temporal type: compare as int64."""
+    return K_INT, ld.astype(np.int64), rd.astype(np.int64), nulls, 0
+
+
+def _bool_out(mask, nulls):
+    return mask.astype(np.int64), nulls
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+
+def _eval_add(sf, chunk):
+    return _arith(sf, chunk, "add")
+
+
+def _eval_sub(sf, chunk):
+    return _arith(sf, chunk, "sub")
+
+
+def _eval_mul(sf, chunk):
+    return _arith(sf, chunk, "mul")
+
+
+def _arith(sf, chunk, which):
+    l, r = sf.args
+    ld, ln = l.eval(chunk)
+    rd, rn = r.eval(chunk)
+    nulls = ln | rn
+    out_ft = sf.ftype
+    k = phys_kind(out_ft)
+    if k == K_FLOAT:
+        a = _as_float(ld, l.ftype)
+        b = _as_float(rd, r.ftype)
+        return {"add": a + b, "sub": a - b, "mul": a * b}[which], nulls
+    if k == K_DEC:
+        s = out_ft.scale
+        if which == "mul":
+            a = _as_decimal(ld, l.ftype, l.ftype.scale if phys_kind(l.ftype) == K_DEC else 0)
+            b = _as_decimal(rd, r.ftype, r.ftype.scale if phys_kind(r.ftype) == K_DEC else 0)
+            prod = a * b  # scale = s1 + s2 == out scale
+            return prod, nulls
+        a = _as_decimal(ld, l.ftype, s)
+        b = _as_decimal(rd, r.ftype, s)
+        return (a + b) if which == "add" else (a - b), nulls
+    # ints (incl date arithmetic handled by date_add, not here)
+    a = ld.astype(np.int64)
+    b = rd.astype(np.int64)
+    return {"add": a + b, "sub": a - b, "mul": a * b}[which], nulls
+
+
+def _eval_div(sf, chunk):
+    l, r = sf.args
+    ld, ln = l.eval(chunk)
+    rd, rn = r.eval(chunk)
+    nulls = ln | rn
+    out_ft = sf.ftype
+    if phys_kind(out_ft) == K_FLOAT:
+        a = _as_float(ld, l.ftype)
+        b = _as_float(rd, r.ftype)
+        zero = b == 0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            res = np.where(zero, 0.0, a / np.where(zero, 1.0, b))
+        return res, nulls | zero
+    # decimal division: out scale = s1 + 4
+    s1 = l.ftype.scale if phys_kind(l.ftype) == K_DEC else 0
+    s2 = r.ftype.scale if phys_kind(r.ftype) == K_DEC else 0
+    sr = out_ft.scale
+    a = _as_decimal(ld, l.ftype, s1).astype(object)  # python ints: no overflow
+    b = _as_decimal(rd, r.ftype, s2)
+    zero = b == 0
+    shift = POW10[sr + s2 - s1]
+    num = a * shift
+    den = np.where(zero, 1, b).astype(object)
+    sign = np.where((num < 0) != (den < 0), -1, 1)
+    q = (2 * np.abs(num) + den) // (2 * den)
+    res = (sign * q)
+    res = np.array([int(x) for x in res], dtype=np.int64)
+    return res, nulls | zero
+
+
+def _eval_intdiv(sf, chunk):
+    kind, a, b, nulls, s = _num_common(sf, chunk)
+    if kind == K_FLOAT:
+        zero = b == 0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            res = np.where(zero, 0, np.floor_divide(a, np.where(zero, 1.0, b)))
+        return res.astype(np.int64), nulls | zero
+    zero = b == 0
+    bb = np.where(zero, 1, b)
+    q = np.abs(a.astype(np.int64)) // np.abs(bb)
+    res = np.where((a < 0) != (b < 0), -q, q)  # truncate toward zero (MySQL DIV)
+    return res.astype(np.int64), nulls | zero
+
+
+def _eval_mod(sf, chunk):
+    kind, a, b, nulls, s = _num_common(sf, chunk)
+    zero = b == 0
+    bb = np.where(zero, 1, b)
+    if kind == K_FLOAT:
+        res = np.where(zero, 0.0, np.fmod(a, bb))
+        return res, nulls | zero
+    res = np.fmod(a.astype(np.int64), bb.astype(np.int64))
+    return res, nulls | zero
+
+
+def _eval_neg(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    if phys_kind(sf.args[0].ftype) == K_STR:
+        return -_as_float(d, sf.args[0].ftype), n
+    return -d, n
+
+
+# ---------------------------------------------------------------------------
+# comparison / logic
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b,
+}
+
+
+def _make_cmp(name):
+    def _f(sf, chunk):
+        kind, a, b, nulls, _s = _num_common(sf, chunk)
+        mask = _CMP[name](a, b)
+        return _bool_out(mask & ~nulls, nulls)
+    return _f
+
+
+def _eval_nulleq(sf, chunk):  # <=>
+    kind, a, b, nulls, _s = _num_common(sf, chunk)
+    l, r = sf.args
+    _ld, ln = l.eval(chunk)
+    _rd, rn = r.eval(chunk)
+    eq = (a == b) & ~ln & ~rn
+    both_null = ln & rn
+    return (eq | both_null).astype(np.int64), np.zeros(len(eq), dtype=bool)
+
+
+def _eval_and(sf, chunk):
+    ld, ln = sf.args[0].eval(chunk)
+    rd, rn = sf.args[1].eval(chunk)
+    lt = _truth(ld, sf.args[0].ftype)
+    rt = _truth(rd, sf.args[1].ftype)
+    lf = ~lt & ~ln
+    rf = ~rt & ~rn
+    res = lt & rt & ~ln & ~rn
+    nulls = ~(lf | rf) & (ln | rn)  # false dominates null
+    return res.astype(np.int64), nulls
+
+
+def _eval_or(sf, chunk):
+    ld, ln = sf.args[0].eval(chunk)
+    rd, rn = sf.args[1].eval(chunk)
+    lt = _truth(ld, sf.args[0].ftype) & ~ln
+    rt = _truth(rd, sf.args[1].ftype) & ~rn
+    res = lt | rt
+    nulls = ~res & (ln | rn)  # true dominates null
+    return res.astype(np.int64), nulls
+
+
+def _eval_xor(sf, chunk):
+    ld, ln = sf.args[0].eval(chunk)
+    rd, rn = sf.args[1].eval(chunk)
+    res = _truth(ld, sf.args[0].ftype) ^ _truth(rd, sf.args[1].ftype)
+    nulls = ln | rn
+    return res.astype(np.int64), nulls
+
+
+def _eval_not(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    return (~_truth(d, sf.args[0].ftype)).astype(np.int64), n
+
+
+def _truth(data, ft: FieldType):
+    k = phys_kind(ft)
+    if k == K_STR:
+        return _as_float(data, ft) != 0
+    return data != 0
+
+
+def _eval_isnull(sf, chunk):
+    _d, n = sf.args[0].eval(chunk)
+    return n.astype(np.int64), np.zeros(len(n), dtype=bool)
+
+
+def _eval_istrue(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    return (_truth(d, sf.args[0].ftype) & ~n).astype(np.int64), np.zeros(len(n), dtype=bool)
+
+
+def _eval_isfalse(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    return (~_truth(d, sf.args[0].ftype) & ~n).astype(np.int64), np.zeros(len(n), dtype=bool)
+
+
+# -- IN: extra = None (args form) -------------------------------------------
+
+def _eval_in(sf, chunk):
+    target = sf.args[0]
+    td, tn = target.eval(chunk)
+    tk = phys_kind(target.ftype)
+    any_null_item = False
+    mask = np.zeros(len(td), dtype=bool)
+    # coerce every item pairwise like a comparison
+    for item in sf.args[1:]:
+        pair = ScalarFunc("eq", [target, item], FieldType(tp=TYPE_LONGLONG))
+        d, n = pair.eval(chunk)
+        if isinstance(item, Constant) and item.value is None:
+            any_null_item = True
+        mask |= (d != 0) & ~n
+    nulls = tn | (~mask & any_null_item)
+    return mask.astype(np.int64), nulls
+
+
+def _eval_in_set(sf, chunk):
+    """IN with a prebuilt value set (subquery materialization).
+    extra = (np.ndarray of values | set of bytes, contains_null: bool)."""
+    target = sf.args[0]
+    td, tn = target.eval(chunk)
+    values, has_null = sf.extra
+    k = phys_kind(target.ftype)
+    if k == K_STR:
+        mask = np.fromiter((b in values for b in td), dtype=bool, count=len(td))
+    else:
+        mask = np.isin(np.asarray(td), values)
+    nulls = tn | (~mask & has_null)
+    return mask.astype(np.int64), nulls
+
+
+# -- LIKE -------------------------------------------------------------------
+
+def like_to_regex(pattern: bytes, escape: bytes = b"\\") -> re.Pattern:
+    out = [b"^"]
+    i = 0
+    esc = escape[:1]
+    while i < len(pattern):
+        c = pattern[i:i + 1]
+        if c == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1:i + 2]))
+            i += 2
+            continue
+        if c == b"%":
+            out.append(b".*")
+        elif c == b"_":
+            out.append(b".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    out.append(b"$")
+    return re.compile(b"".join(out), re.DOTALL | re.IGNORECASE)
+
+
+def _eval_like(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    pat = sf.args[1]
+    if isinstance(pat, Constant) and sf.extra is not None:
+        rx = sf.extra
+        pd = None
+        pn = np.zeros(len(d), dtype=bool)
+    else:
+        pd, pn = pat.eval(chunk)
+        rx = None
+    nulls = n | pn
+    out = np.zeros(len(d), dtype=bool)
+    if rx is not None:
+        for i, b in enumerate(d):
+            if not nulls[i]:
+                out[i] = rx.match(b if isinstance(b, bytes) else str(b).encode()) is not None
+    else:
+        for i, b in enumerate(d):
+            if not nulls[i]:
+                out[i] = like_to_regex(pd[i]).match(b) is not None
+    return out.astype(np.int64), nulls
+
+
+def _eval_regexp(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    pd, pn = sf.args[1].eval(chunk)
+    nulls = n | pn
+    out = np.zeros(len(d), dtype=bool)
+    for i, b in enumerate(d):
+        if not nulls[i]:
+            out[i] = re.search(pd[i], b) is not None
+    return out.astype(np.int64), nulls
+
+
+# -- CASE / IF / COALESCE ---------------------------------------------------
+
+def _cast_to(data, nulls, from_ft, to_ft):
+    """Coerce evaluated (data,nulls) into to_ft's physical representation."""
+    fk, tk = phys_kind(from_ft), phys_kind(to_ft)
+    if from_ft.tp == TYPE_NULL:
+        dt = np_dtype_for(to_ft)
+        if dt is object:
+            return np.full(len(data), b"", dtype=object), nulls
+        return np.zeros(len(data), dtype=dt), nulls
+    if tk == K_STR:
+        if fk == K_STR:
+            return data, nulls
+        from ..sqltypes import format_value
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            s = format_value(data[i].item() if isinstance(data[i], np.generic) else data[i], from_ft)
+            out[i] = (s or "").encode()
+        return out, nulls
+    if tk == K_FLOAT:
+        return _as_float(data, from_ft), nulls
+    if tk == K_DEC:
+        return _as_decimal(data, from_ft, to_ft.scale), nulls
+    if tk == K_DATE:
+        if fk == K_DATE:
+            return data.astype(np.int32), nulls
+        if from_ft.tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+            return (data // 86_400_000_000).astype(np.int32), nulls
+        if fk == K_STR:
+            from ..sqltypes import parse_date_str
+            out = np.zeros(len(data), dtype=np.int32)
+            bad = np.zeros(len(data), dtype=bool)
+            for i, b in enumerate(data):
+                if nulls[i]:
+                    continue
+                try:
+                    out[i] = parse_date_str(b.decode())
+                except Exception:
+                    bad[i] = True
+            return out, nulls | bad
+        return data.astype(np.int32), nulls
+    # K_INT targets
+    if to_ft.tp in (TYPE_DATETIME, TYPE_TIMESTAMP) and fk == K_DATE:
+        return data.astype(np.int64) * 86_400_000_000, nulls
+    if to_ft.tp in (TYPE_DATETIME, TYPE_TIMESTAMP) and fk == K_STR:
+        from ..sqltypes import parse_datetime_str
+        out = np.zeros(len(data), dtype=np.int64)
+        bad = np.zeros(len(data), dtype=bool)
+        for i, b in enumerate(data):
+            if nulls[i]:
+                continue
+            try:
+                out[i] = parse_datetime_str(b.decode())
+            except Exception:
+                bad[i] = True
+        return out, nulls | bad
+    if fk == K_DEC:
+        return _div_round(data, POW10[from_ft.scale]).astype(np.int64), nulls
+    if fk == K_FLOAT:
+        return np.round(data).astype(np.int64), nulls
+    if fk == K_STR:
+        return np.round(_as_float(data, from_ft)).astype(np.int64), nulls
+    return data.astype(np.int64), nulls
+
+
+def _eval_case(sf, chunk):
+    """args: [cond1, res1, cond2, res2, ..., else?] (search form prebuilt)."""
+    n_rows = chunk.num_rows
+    args = sf.args
+    has_else = len(args) % 2 == 1
+    pairs = (len(args) - (1 if has_else else 0)) // 2
+    dt = np_dtype_for(sf.ftype)
+    if dt is object:
+        out = np.full(n_rows, b"", dtype=object)
+    else:
+        out = np.zeros(n_rows, dtype=dt)
+    out_nulls = np.ones(n_rows, dtype=bool)
+    decided = np.zeros(n_rows, dtype=bool)
+    for p in range(pairs):
+        cd, cn = args[2 * p].eval(chunk)
+        cond = _truth(cd, args[2 * p].ftype) & ~cn & ~decided
+        if cond.any():
+            rd, rn = args[2 * p + 1].eval(chunk)
+            rd, rn = _cast_to(rd, rn, args[2 * p + 1].ftype, sf.ftype)
+            out[cond] = rd[cond]
+            out_nulls[cond] = rn[cond]
+        decided |= cond
+    if has_else:
+        rest = ~decided
+        if rest.any():
+            rd, rn = args[-1].eval(chunk)
+            rd, rn = _cast_to(rd, rn, args[-1].ftype, sf.ftype)
+            out[rest] = rd[rest]
+            out_nulls[rest] = rn[rest]
+    return out, out_nulls
+
+
+def _eval_if(sf, chunk):
+    cond, a, b = sf.args
+    return _eval_case(ScalarFunc("case", [cond, a, b], sf.ftype), chunk)
+
+
+def _eval_coalesce(sf, chunk):
+    n_rows = chunk.num_rows
+    dt = np_dtype_for(sf.ftype)
+    out = (np.full(n_rows, b"", dtype=object) if dt is object
+           else np.zeros(n_rows, dtype=dt))
+    out_nulls = np.ones(n_rows, dtype=bool)
+    remaining = np.ones(n_rows, dtype=bool)
+    for a in sf.args:
+        if not remaining.any():
+            break
+        d, n = a.eval(chunk)
+        d, n = _cast_to(d, n, a.ftype, sf.ftype)
+        take = remaining & ~n
+        out[take] = d[take]
+        out_nulls[take] = False
+        remaining &= n
+    return out, out_nulls
+
+
+def _eval_nullif(sf, chunk):
+    eq = ScalarFunc("eq", sf.args, FieldType(tp=TYPE_LONGLONG))
+    d, n = eq.eval(chunk)
+    vd, vn = sf.args[0].eval(chunk)
+    iseq = (d != 0) & ~n
+    return vd, vn | iseq
+
+
+def _eval_cast(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    return _cast_to(d, n, sf.args[0].ftype, sf.ftype)
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+def _str_args(sf, chunk):
+    out = []
+    nulls = None
+    for a in sf.args:
+        d, n = a.eval(chunk)
+        d, n = _cast_to(d, n, a.ftype, FieldType(tp=TYPE_VARCHAR))
+        out.append(d)
+        nulls = n if nulls is None else (nulls | n)
+    return out, nulls
+
+
+def _eval_concat(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    n_rows = len(ds[0])
+    out = np.empty(n_rows, dtype=object)
+    for i in range(n_rows):
+        out[i] = b"".join(d[i] for d in ds)
+    return out, nulls
+
+
+def _eval_concat_ws(sf, chunk):
+    ds, _ = _str_args(sf, chunk)
+    seps = ds[0]
+    _d0, sep_null = sf.args[0].eval(chunk)
+    n_rows = len(seps)
+    out = np.empty(n_rows, dtype=object)
+    # NULL args are skipped (not propagated) for concat_ws
+    arg_nulls = [a.eval(chunk)[1] for a in sf.args[1:]]
+    for i in range(n_rows):
+        parts = [d[i] for j, d in enumerate(ds[1:]) if not arg_nulls[j][i]]
+        out[i] = seps[i].join(parts)
+    return out, sep_null
+
+
+def _eval_upper(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    out = np.array([b.upper() for b in ds[0]], dtype=object)
+    return out, nulls
+
+
+def _eval_lower(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    out = np.array([b.lower() for b in ds[0]], dtype=object)
+    return out, nulls
+
+
+def _eval_length(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    return np.array([len(b) for b in ds[0]], dtype=np.int64), nulls
+
+
+def _eval_char_length(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    return np.array([len(b.decode("utf-8", "replace")) for b in ds[0]],
+                    dtype=np.int64), nulls
+
+
+def _int_arg(sf_arg, chunk):
+    d, n = sf_arg.eval(chunk)
+    d, n = _cast_to(d, n, sf_arg.ftype, FieldType(tp=TYPE_LONGLONG))
+    return d, n
+
+
+def _eval_substring(sf, chunk):
+    sd, sn = sf.args[0].eval(chunk)
+    sd, sn = _cast_to(sd, sn, sf.args[0].ftype, FieldType(tp=TYPE_VARCHAR))
+    pos, pn = _int_arg(sf.args[1], chunk)
+    nulls = sn | pn
+    if len(sf.args) > 2:
+        ln, lnn = _int_arg(sf.args[2], chunk)
+        nulls = nulls | lnn
+    else:
+        ln = None
+    out = np.empty(len(sd), dtype=object)
+    for i in range(len(sd)):
+        s = sd[i]
+        p = int(pos[i])
+        if p > 0:
+            start = p - 1
+        elif p < 0:
+            start = max(len(s) + p, 0)
+        else:
+            out[i] = b""
+            continue
+        if ln is not None:
+            l = int(ln[i])
+            out[i] = s[start:start + l] if l > 0 else b""
+        else:
+            out[i] = s[start:]
+    return out, nulls
+
+
+def _eval_trim(sf, chunk):
+    # args: [str, direction-const, remstr?]
+    sd, sn = sf.args[0].eval(chunk)
+    sd, sn = _cast_to(sd, sn, sf.args[0].ftype, FieldType(tp=TYPE_VARCHAR))
+    direction = sf.args[1].value if len(sf.args) > 1 else b"both"
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    rem = b" "
+    rem_nulls = None
+    if len(sf.args) > 2:
+        rd, rem_nulls = sf.args[2].eval(chunk)
+        rem = None
+    out = np.empty(len(sd), dtype=object)
+    for i in range(len(sd)):
+        s = sd[i]
+        r = rem if rem is not None else rd[i]
+        if direction in ("both", "leading"):
+            while s.startswith(r) and r:
+                s = s[len(r):]
+        if direction in ("both", "trailing"):
+            while s.endswith(r) and r:
+                s = s[:-len(r)]
+        out[i] = s
+    nulls = sn if rem_nulls is None else (sn | rem_nulls)
+    return out, nulls
+
+
+def _eval_ltrim(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    return np.array([b.lstrip(b" ") for b in ds[0]], dtype=object), nulls
+
+
+def _eval_rtrim(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    return np.array([b.rstrip(b" ") for b in ds[0]], dtype=object), nulls
+
+
+def _eval_replace(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    out = np.array([a.replace(b, c) for a, b, c in zip(*ds)], dtype=object)
+    return out, nulls
+
+
+def _eval_locate(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    return np.array([h.find(nd) + 1 for nd, h in zip(ds[0], ds[1])],
+                    dtype=np.int64), nulls
+
+
+def _eval_left(sf, chunk):
+    sd, sn = sf.args[0].eval(chunk)
+    sd, sn = _cast_to(sd, sn, sf.args[0].ftype, FieldType(tp=TYPE_VARCHAR))
+    nd, nn = _int_arg(sf.args[1], chunk)
+    out = np.array([s[:max(int(k), 0)] for s, k in zip(sd, nd)], dtype=object)
+    return out, sn | nn
+
+
+def _eval_right(sf, chunk):
+    sd, sn = sf.args[0].eval(chunk)
+    sd, sn = _cast_to(sd, sn, sf.args[0].ftype, FieldType(tp=TYPE_VARCHAR))
+    nd, nn = _int_arg(sf.args[1], chunk)
+    out = np.array([s[-int(k):] if int(k) > 0 else b"" for s, k in zip(sd, nd)],
+                   dtype=object)
+    return out, sn | nn
+
+
+def _eval_reverse(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    return np.array([b[::-1] for b in ds[0]], dtype=object), nulls
+
+
+def _eval_repeat(sf, chunk):
+    sd, sn = sf.args[0].eval(chunk)
+    sd, sn = _cast_to(sd, sn, sf.args[0].ftype, FieldType(tp=TYPE_VARCHAR))
+    nd, nn = _int_arg(sf.args[1], chunk)
+    out = np.array([s * max(int(k), 0) for s, k in zip(sd, nd)], dtype=object)
+    return out, sn | nn
+
+
+def _eval_lpad(sf, chunk):
+    ds, nulls = _str_args(sf, chunk)
+    nd, nn = _int_arg(sf.args[1], chunk)
+    out = np.empty(len(ds[0]), dtype=object)
+    for i in range(len(ds[0])):
+        s, total, pad = ds[0][i], int(nd[i]), ds[2][i]
+        if total <= len(s):
+            out[i] = s[:total]
+        elif pad:
+            need = total - len(s)
+            out[i] = (pad * (need // len(pad) + 1))[:need] + s
+        else:
+            out[i] = b"" if total > len(s) else s[:total]
+    return out, nulls | nn
+
+
+# ---------------------------------------------------------------------------
+# date/time
+# ---------------------------------------------------------------------------
+
+def _to_dateparts(sf_arg, chunk):
+    """-> (list of datetime.date/datetime or None)."""
+    d, n = sf_arg.eval(chunk)
+    ft = sf_arg.ftype
+    k = phys_kind(ft)
+    out = []
+    if k == K_DATE:
+        for i in range(len(d)):
+            out.append(None if n[i] else days_to_date(int(d[i])))
+    elif ft.tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        for i in range(len(d)):
+            out.append(None if n[i] else micros_to_datetime(int(d[i])))
+    elif k == K_STR:
+        from ..sqltypes import parse_datetime_str
+        for i in range(len(d)):
+            if n[i]:
+                out.append(None)
+            else:
+                try:
+                    out.append(micros_to_datetime(parse_datetime_str(d[i].decode())))
+                except Exception:
+                    out.append(None)
+    else:
+        for i in range(len(d)):
+            out.append(None)
+    return out
+
+
+def _date_part(fn):
+    def _f(sf, chunk):
+        parts = _to_dateparts(sf.args[0], chunk)
+        out = np.zeros(len(parts), dtype=np.int64)
+        nulls = np.zeros(len(parts), dtype=bool)
+        for i, p in enumerate(parts):
+            if p is None:
+                nulls[i] = True
+            else:
+                out[i] = fn(p)
+        return out, nulls
+    return _f
+
+
+_EXTRACT_FNS = {
+    "year": lambda p: p.year,
+    "month": lambda p: p.month,
+    "day": lambda p: p.day,
+    "hour": lambda p: getattr(p, "hour", 0),
+    "minute": lambda p: getattr(p, "minute", 0),
+    "second": lambda p: getattr(p, "second", 0),
+    "microsecond": lambda p: getattr(p, "microsecond", 0),
+    "quarter": lambda p: (p.month - 1) // 3 + 1,
+    "week": lambda p: p.isocalendar()[1],
+    "year_month": lambda p: p.year * 100 + p.month,
+}
+
+
+def _eval_extract(sf, chunk):
+    unit = sf.extra
+    fn = _EXTRACT_FNS.get(unit)
+    if fn is None:
+        raise TiDBError(f"unsupported EXTRACT unit {unit}")
+    return _date_part(fn)(ScalarFunc(sf.op, [sf.args[1]], sf.ftype), chunk)
+
+
+_UNIT_TO_US = {
+    "microsecond": 1, "second": 1_000_000, "minute": 60_000_000,
+    "hour": 3_600_000_000, "day": 86_400_000_000, "week": 7 * 86_400_000_000,
+}
+
+
+def _eval_date_arith(sf, chunk):
+    """date_add/date_sub. args=[date_expr, interval_value]; extra=(unit, sign)."""
+    unit, sign = sf.extra
+    vd, vn = _int_arg(sf.args[1], chunk)
+    delta = vd.astype(np.int64) * sign
+    src = sf.args[0]
+    out_ft = sf.ftype
+    if unit in _UNIT_TO_US:
+        if phys_kind(out_ft) == K_DATE:
+            dd, dn = src.eval(chunk)
+            dd, dn = _cast_to(dd, dn, src.ftype, FieldType(tp=TYPE_DATE))
+            return (dd.astype(np.int64) + delta * _UNIT_TO_US[unit] // 86_400_000_000).astype(np.int32), dn | vn
+        dd, dn = src.eval(chunk)
+        dd, dn = _cast_to(dd, dn, src.ftype, FieldType(tp=TYPE_DATETIME))
+        return dd + delta * _UNIT_TO_US[unit], dn | vn
+    # month/quarter/year arithmetic needs calendars
+    parts = _to_dateparts(src, chunk)
+    months = {"month": 1, "quarter": 3, "year": 12}[unit]
+    out_is_date = phys_kind(out_ft) == K_DATE
+    out = np.zeros(len(parts), dtype=np.int32 if out_is_date else np.int64)
+    nulls = vn.copy()
+    import datetime as _dt
+    from ..sqltypes import date_to_days, datetime_to_micros
+    for i, p in enumerate(parts):
+        if p is None:
+            nulls[i] = True
+            continue
+        total = p.year * 12 + (p.month - 1) + int(delta[i]) * months
+        y, m = divmod(total, 12)
+        m += 1
+        day = min(p.day, _days_in_month(y, m))
+        if out_is_date:
+            out[i] = date_to_days(y, m, day)
+        else:
+            hh = getattr(p, "hour", 0)
+            mm = getattr(p, "minute", 0)
+            ss = getattr(p, "second", 0)
+            us = getattr(p, "microsecond", 0)
+            out[i] = datetime_to_micros(_dt.datetime(y, m, day, hh, mm, ss, us))
+    return out, nulls
+
+
+def _days_in_month(y, m):
+    import calendar
+    return calendar.monthrange(y, m)[1]
+
+
+def _eval_datediff(sf, chunk):
+    a = ScalarFunc("cast", [sf.args[0]], FieldType(tp=TYPE_DATE))
+    b = ScalarFunc("cast", [sf.args[1]], FieldType(tp=TYPE_DATE))
+    ad, an = a.eval(chunk)
+    bd, bn = b.eval(chunk)
+    return (ad.astype(np.int64) - bd.astype(np.int64)), an | bn
+
+
+def _eval_date(sf, chunk):
+    return _eval_cast(ScalarFunc("cast", sf.args, FieldType(tp=TYPE_DATE)), chunk)
+
+
+def _eval_date_format(sf, chunk):
+    parts = _to_dateparts(sf.args[0], chunk)
+    fd, fn_ = sf.args[1].eval(chunk)
+    out = np.empty(len(parts), dtype=object)
+    nulls = fn_.copy()
+    for i, p in enumerate(parts):
+        if p is None or nulls[i]:
+            out[i] = b""
+            nulls[i] = True
+            continue
+        out[i] = _mysql_date_format(p, fd[i].decode())
+    return out, nulls
+
+
+_FMT_MAP = {
+    "Y": "%Y", "y": "%y", "m": "%m", "d": "%d", "H": "%H", "i": "%M",
+    "s": "%S", "S": "%S", "f": "%f", "M": "%B", "b": "%b", "W": "%A",
+    "a": "%a", "j": "%j", "T": "%H:%M:%S", "e": "%d",
+}
+
+
+def _mysql_date_format(p, fmt: str) -> bytes:
+    out = []
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            spec = fmt[i + 1]
+            if spec in _FMT_MAP:
+                out.append(p.strftime(_FMT_MAP[spec]))
+            elif spec == "%":
+                out.append("%")
+            else:
+                out.append(spec)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out).encode()
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+def _eval_abs(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    return np.abs(d), n
+
+
+def _float_fn(fn):
+    def _f(sf, chunk):
+        d, n = sf.args[0].eval(chunk)
+        f = _as_float(d, sf.args[0].ftype)
+        with np.errstate(all="ignore"):
+            res = fn(f)
+        bad = ~np.isfinite(res)
+        return np.where(bad, 0.0, res), n | bad
+    return _f
+
+
+def _eval_round(sf, chunk):
+    src = sf.args[0]
+    nd = 0
+    if len(sf.args) > 1:
+        c = sf.args[1]
+        nd = int(c.value) if isinstance(c, Constant) else 0
+    d, n = src.eval(chunk)
+    k = phys_kind(src.ftype)
+    if k == K_DEC:
+        s = src.ftype.scale
+        if nd >= s:
+            return d, n
+        scaled = _div_round(d, POW10[s - nd])
+        if phys_kind(sf.ftype) == K_DEC and sf.ftype.scale == nd:
+            return scaled, n
+        return scaled * POW10[sf.ftype.scale - nd] if phys_kind(sf.ftype) == K_DEC else scaled, n
+    if k == K_FLOAT:
+        return np.round(d, nd), n
+    if nd >= 0:
+        return d, n
+    p = POW10[-nd]
+    return _div_round(d, p) * p, n
+
+
+def _eval_ceil(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    k = phys_kind(sf.args[0].ftype)
+    if k == K_DEC:
+        s = sf.args[0].ftype.scale
+        p = POW10[s]
+        return -((-d) // p), n
+    if k == K_FLOAT:
+        return np.ceil(d).astype(np.int64), n
+    return d.astype(np.int64), n
+
+
+def _eval_floor(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    k = phys_kind(sf.args[0].ftype)
+    if k == K_DEC:
+        p = POW10[sf.args[0].ftype.scale]
+        return d // p, n
+    if k == K_FLOAT:
+        return np.floor(d).astype(np.int64), n
+    return d.astype(np.int64), n
+
+
+def _eval_sign(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    f = _as_float(d, sf.args[0].ftype)
+    return np.sign(f).astype(np.int64), n
+
+
+def _eval_pow(sf, chunk):
+    kind, a, b, nulls, _ = _num_common(sf, chunk)
+    af = a.astype(np.float64) if kind != K_FLOAT else a
+    bf = b.astype(np.float64) if kind != K_FLOAT else b
+    with np.errstate(all="ignore"):
+        res = np.power(af, bf)
+    return res, nulls
+
+
+def _int_binop(fn):
+    def _f(sf, chunk):
+        kind, a, b, nulls, _s = _num_common(sf, chunk)
+        ai = a.astype(np.int64) if kind != K_FLOAT else np.round(a).astype(np.int64)
+        bi = b.astype(np.int64) if kind != K_FLOAT else np.round(b).astype(np.int64)
+        return fn(ai, bi), nulls
+    return _f
+
+
+def _eval_bitneg(sf, chunk):
+    d, n = sf.args[0].eval(chunk)
+    return ~d.astype(np.int64), n
+
+
+def _eval_greatest(sf, chunk):
+    return _minmax(sf, chunk, np.maximum)
+
+
+def _eval_least(sf, chunk):
+    return _minmax(sf, chunk, np.minimum)
+
+
+def _minmax(sf, chunk, fn):
+    acc = None
+    nulls = None
+    for a in sf.args:
+        d, n = a.eval(chunk)
+        d, n = _cast_to(d, n, a.ftype, sf.ftype)
+        acc = d if acc is None else fn(acc, d)
+        nulls = n if nulls is None else (nulls | n)
+    return acc, nulls
+
+
+# ---------------------------------------------------------------------------
+# dispatch table
+# ---------------------------------------------------------------------------
+
+_DISPATCH = {
+    "add": _eval_add, "sub": _eval_sub, "mul": _eval_mul, "div": _eval_div,
+    "intdiv": _eval_intdiv, "mod": _eval_mod, "neg": _eval_neg,
+    "eq": _make_cmp("eq"), "ne": _make_cmp("ne"), "lt": _make_cmp("lt"),
+    "le": _make_cmp("le"), "gt": _make_cmp("gt"), "ge": _make_cmp("ge"),
+    "nulleq": _eval_nulleq,
+    "and": _eval_and, "or": _eval_or, "xor": _eval_xor, "not": _eval_not,
+    "isnull": _eval_isnull, "istrue": _eval_istrue, "isfalse": _eval_isfalse,
+    "in": _eval_in, "in_set": _eval_in_set,
+    "like": _eval_like, "regexp": _eval_regexp,
+    "case": _eval_case, "if": _eval_if, "coalesce": _eval_coalesce,
+    "ifnull": _eval_coalesce, "nullif": _eval_nullif, "cast": _eval_cast,
+    "concat": _eval_concat, "concat_ws": _eval_concat_ws,
+    "upper": _eval_upper, "lower": _eval_lower,
+    "length": _eval_length, "char_length": _eval_char_length,
+    "substring": _eval_substring, "trim": _eval_trim,
+    "ltrim": _eval_ltrim, "rtrim": _eval_rtrim,
+    "replace": _eval_replace, "locate": _eval_locate,
+    "left": _eval_left, "right": _eval_right, "reverse": _eval_reverse,
+    "repeat": _eval_repeat, "lpad": _eval_lpad,
+    "year": _date_part(_EXTRACT_FNS["year"]),
+    "month": _date_part(_EXTRACT_FNS["month"]),
+    "dayofmonth": _date_part(_EXTRACT_FNS["day"]),
+    "day": _date_part(_EXTRACT_FNS["day"]),
+    "hour": _date_part(_EXTRACT_FNS["hour"]),
+    "minute": _date_part(_EXTRACT_FNS["minute"]),
+    "second": _date_part(_EXTRACT_FNS["second"]),
+    "quarter": _date_part(_EXTRACT_FNS["quarter"]),
+    "week": _date_part(_EXTRACT_FNS["week"]),
+    "dayofweek": _date_part(lambda p: p.isoweekday() % 7 + 1),
+    "dayofyear": _date_part(lambda p: p.timetuple().tm_yday),
+    "extract": _eval_extract,
+    "date_arith": _eval_date_arith,
+    "datediff": _eval_datediff, "date": _eval_date,
+    "date_format": _eval_date_format,
+    "abs": _eval_abs, "round": _eval_round, "ceil": _eval_ceil,
+    "floor": _eval_floor, "sign": _eval_sign, "pow": _eval_pow,
+    "sqrt": _float_fn(np.sqrt), "exp": _float_fn(np.exp),
+    "ln": _float_fn(np.log), "log2": _float_fn(np.log2),
+    "log10": _float_fn(np.log10),
+    "greatest": _eval_greatest, "least": _eval_least,
+    "bitand": _int_binop(lambda a, b: a & b),
+    "bitor": _int_binop(lambda a, b: a | b),
+    "bitxor": _int_binop(lambda a, b: a ^ b),
+    "shl": _int_binop(lambda a, b: a << np.clip(b, 0, 63)),
+    "shr": _int_binop(lambda a, b: a >> np.clip(b, 0, 63)),
+    "bitneg": _eval_bitneg,
+}
+
+
+def supported_scalar_ops():
+    return set(_DISPATCH)

@@ -101,8 +101,13 @@ class _SortedMap:
         if chain is None:
             bisect.insort(self.keys, key)
             self.vals[key] = chain = []
-        # newest first; commits arrive in increasing ts so prepend is O(chain)
-        chain.insert(0, (commit_ts, start_ts, op, value))
+        # keep strictly descending commit_ts order: rollback markers carry an
+        # *old* start_ts and must not land at the head above newer commits
+        # (has_commit_after/read rely on the ordering)
+        i = 0
+        while i < len(chain) and chain[i][0] > commit_ts:
+            i += 1
+        chain.insert(i, (commit_ts, start_ts, op, value))
 
     def read(self, key: bytes, ts: int):
         """newest version with commit_ts <= ts -> (op, value) or None."""
@@ -121,16 +126,15 @@ class _SortedMap:
 
     def has_commit_after(self, key: bytes, ts: int):
         """-> commit_ts of any non-rollback commit with commit_ts > ts, else 0.
-        Also reports a rollback marker of this very start_ts."""
+        Rollback markers above ts are skipped, not treated as commits."""
         chain = self.vals.get(key)
         if not chain:
             return 0
         for commit_ts, _start, op, _value in chain:
-            if commit_ts > ts:
-                if op != OP_ROLLBACK:
-                    return commit_ts
-            else:
+            if commit_ts <= ts:
                 break
+            if op != OP_ROLLBACK:
+                return commit_ts
         return 0
 
     def has_rollback(self, key: bytes, start_ts: int) -> bool:
@@ -310,12 +314,14 @@ class MVCCStore:
             empty = []
             for key, chain in self.map.vals.items():
                 keep = []
-                passed = False
+                kept_visible = False
                 for ver in chain:
                     if ver[0] > safe_point:
                         keep.append(ver)
-                    elif not passed:
-                        passed = True
+                    elif ver[2] == OP_ROLLBACK:
+                        continue  # stale marker: never counts as the visible version
+                    elif not kept_visible:
+                        kept_visible = True
                         if ver[2] == OP_PUT:
                             keep.append(ver)
                     # older than first visible-at-safepoint: drop

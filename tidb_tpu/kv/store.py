@@ -19,19 +19,27 @@ _MISSING = object()
 
 class MemBuffer:
     """Ordered txn-local write buffer with savepoints ("staging" in the
-    reference, kv/memdb). dict + sorted view on demand."""
+    reference, kv/memdb). dict + bisect-maintained sorted key list so range
+    probes (txn_dirty, scans) are O(log n + k), not a full re-sort."""
 
     def __init__(self):
+        import bisect as _b
+        self._bisect = _b
         self._data: dict[bytes, bytes | None] = {}  # None = tombstone
+        self._keys: list[bytes] = []                # sorted keys present
         self._ops: list[tuple[bytes, bytes | None]] = []  # undo log for savepoints
 
-    def put(self, key: bytes, value: bytes):
+    def _write(self, key: bytes, value):
         self._ops.append((key, self._data.get(key, _MISSING)))
+        if key not in self._data:
+            self._bisect.insort(self._keys, key)
         self._data[key] = value
 
+    def put(self, key: bytes, value: bytes):
+        self._write(key, value)
+
     def delete(self, key: bytes):
-        self._ops.append((key, self._data.get(key, _MISSING)))
-        self._data[key] = None
+        self._write(key, None)
 
     def get(self, key: bytes, default=_MISSING):
         return self._data.get(key, default)
@@ -50,15 +58,19 @@ class MemBuffer:
             key, old = self._ops.pop()
             if old is _MISSING:
                 del self._data[key]
+                i = self._bisect.bisect_left(self._keys, key)
+                if i < len(self._keys) and self._keys[i] == key:
+                    del self._keys[i]
             else:
                 self._data[key] = old
 
     def items_sorted(self):
-        return sorted(self._data.items())
+        return [(k, self._data[k]) for k in self._keys]
 
     def range_items(self, start: bytes, end: bytes):
-        return [(k, v) for k, v in self.items_sorted()
-                if k >= start and (not end or k < end)]
+        lo = self._bisect.bisect_left(self._keys, start)
+        hi = self._bisect.bisect_left(self._keys, end) if end else len(self._keys)
+        return [(k, self._data[k]) for k in self._keys[lo:hi]]
 
 
 class Snapshot:

@@ -1,0 +1,10 @@
+"""Operator kernels.
+
+``host.py``  — numpy reference implementations (always available, also the
+               parity oracle for tests).
+``device.py`` — JAX/XLA kernels for the TPU path (sort-based aggregation via
+               segment_sum, two-pass sort-merge hash join), mirroring the
+               host signatures so the executor can switch engines per-operator
+               (the reference's root/cop/mpp task model becomes host/tpu,
+               SURVEY.md §7 step 5).
+"""

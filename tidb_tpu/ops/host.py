@@ -1,0 +1,214 @@
+"""Host (numpy) operator kernels: factorize / group-agg / join / sort.
+
+These are the CPU executor the TPU path is benchmarked against, and the
+fallback for types the device cannot hold (arbitrary bytes). The algorithms
+are deliberately the same shape as the device kernels (sort-based grouping,
+sort + searchsorted joins) so host/device parity is structural.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def factorize_column(data: np.ndarray, nulls: np.ndarray):
+    """-> int64 codes with NULL = -1, plus unique count."""
+    if data.dtype == object:
+        # bytes keys: factorize via np.unique on object array
+        uniques, inv = np.unique(data, return_inverse=True)
+        codes = inv.astype(np.int64)
+    else:
+        uniques, inv = np.unique(data, return_inverse=True)
+        codes = inv.astype(np.int64)
+    codes = np.where(nulls, np.int64(-1), codes)
+    return codes, len(uniques)
+
+
+def combine_keys(columns):
+    """columns: [(data, nulls)] -> single int64 key per row (collision-free
+    via mixed-radix over factorized codes). NULLs are distinct group values
+    (SQL GROUP BY treats NULLs as equal)."""
+    if not columns:
+        return np.zeros(0, dtype=np.int64)
+    n = len(columns[0][0])
+    acc = np.zeros(n, dtype=np.int64)
+    for data, nulls in columns:
+        codes, card = factorize_column(data, nulls)
+        acc = acc * np.int64(card + 1) + (codes + 1)
+    return acc
+
+
+def group_ids(key_columns):
+    """-> (gid per row int64, n_groups, first_row_index per group)."""
+    combined = combine_keys(key_columns)
+    uniques, first_idx, inv = np.unique(combined, return_index=True,
+                                        return_inverse=True)
+    return inv.astype(np.int64), len(uniques), first_idx
+
+
+def seg_sum_int(gids, n_groups, values, nulls):
+    acc = np.zeros(n_groups, dtype=np.int64)
+    v = np.where(nulls, 0, values.astype(np.int64))
+    np.add.at(acc, gids, v)
+    return acc
+
+
+def seg_sum_float(gids, n_groups, values, nulls):
+    acc = np.zeros(n_groups, dtype=np.float64)
+    v = np.where(nulls, 0.0, values.astype(np.float64))
+    np.add.at(acc, gids, v)
+    return acc
+
+
+def seg_count(gids, n_groups, nulls=None):
+    if nulls is None:
+        return np.bincount(gids, minlength=n_groups).astype(np.int64)
+    return np.bincount(gids[~nulls], minlength=n_groups).astype(np.int64)
+
+
+def seg_min(gids, n_groups, values, nulls):
+    if values.dtype == object:
+        out = np.empty(n_groups, dtype=object)
+        seen = np.zeros(n_groups, dtype=bool)
+        for i in range(len(values)):
+            if nulls[i]:
+                continue
+            g = gids[i]
+            if not seen[g] or values[i] < out[g]:
+                out[g] = values[i]
+                seen[g] = True
+        for g in range(n_groups):
+            if not seen[g]:
+                out[g] = b""
+        return out, ~seen
+    big = _max_sentinel(values.dtype)
+    acc = np.full(n_groups, big, dtype=values.dtype)
+    v = np.where(nulls, big, values)
+    np.minimum.at(acc, gids, v)
+    empty = acc == big
+    return acc, empty
+
+
+def seg_max(gids, n_groups, values, nulls):
+    if values.dtype == object:
+        out = np.empty(n_groups, dtype=object)
+        seen = np.zeros(n_groups, dtype=bool)
+        for i in range(len(values)):
+            if nulls[i]:
+                continue
+            g = gids[i]
+            if not seen[g] or values[i] > out[g]:
+                out[g] = values[i]
+                seen[g] = True
+        for g in range(n_groups):
+            if not seen[g]:
+                out[g] = b""
+        return out, ~seen
+    small = _min_sentinel(values.dtype)
+    acc = np.full(n_groups, small, dtype=values.dtype)
+    v = np.where(nulls, small, values)
+    np.maximum.at(acc, gids, v)
+    empty = acc == small
+    return acc, empty
+
+
+def _max_sentinel(dt):
+    if np.issubdtype(dt, np.floating):
+        return np.inf
+    return np.iinfo(dt).max
+
+
+def _min_sentinel(dt):
+    if np.issubdtype(dt, np.floating):
+        return -np.inf
+    return np.iinfo(dt).min
+
+
+# ---------------------------------------------------------------------------
+# joins (reference: executor/join.go hash join build/probe — here sort-based
+# with identical output semantics)
+# ---------------------------------------------------------------------------
+
+def join_match(build_keys, probe_keys):
+    """Equi-join matcher.
+
+    build_keys / probe_keys: [(data, nulls)] parallel key column lists.
+    Returns (probe_idx, build_idx): row-index pairs for every match.
+    NULL keys never match (SQL equality).
+    """
+    nb = len(build_keys[0][0]) if build_keys else 0
+    npr = len(probe_keys[0][0]) if probe_keys else 0
+    if nb == 0 or npr == 0:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    # factorize over the concatenation so codes agree across sides
+    b_null = np.zeros(nb, dtype=bool)
+    p_null = np.zeros(npr, dtype=bool)
+    acc_b = np.zeros(nb, dtype=np.int64)
+    acc_p = np.zeros(npr, dtype=np.int64)
+    for (bd, bn), (pd, pn) in zip(build_keys, probe_keys):
+        both = np.concatenate([_norm(bd), _norm(pd)])
+        codes, card = factorize_column(both, np.concatenate([bn, pn]))
+        acc_b = acc_b * np.int64(card + 1) + (codes[:nb] + 1)
+        acc_p = acc_p * np.int64(card + 1) + (codes[nb:] + 1)
+        b_null |= bn
+        p_null |= pn
+    # sort build side, binary search probe rows
+    order = np.argsort(acc_b, kind="stable")
+    sorted_b = acc_b[order]
+    lo = np.searchsorted(sorted_b, acc_p, side="left")
+    hi = np.searchsorted(sorted_b, acc_p, side="right")
+    cnt = hi - lo
+    cnt = np.where(p_null, 0, cnt)
+    total = int(cnt.sum())
+    probe_idx = np.repeat(np.arange(npr, dtype=np.int64), cnt)
+    # offsets within each probe row's match range
+    starts = np.repeat(lo, cnt)
+    cum = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+    within = np.arange(total, dtype=np.int64) - np.repeat(cum, cnt)
+    build_idx = order[starts + within]
+    # drop null-key build rows (they were factorized as -1+1=0 codes which
+    # can't collide with real codes because codes start at 1)
+    keep = ~b_null[build_idx]
+    return probe_idx[keep], build_idx[keep]
+
+
+def _norm(data):
+    return data
+
+
+def semi_mask(build_keys, probe_keys):
+    """-> bool mask over probe rows: has >=1 match."""
+    pi, _bi = join_match(build_keys, probe_keys)
+    npr = len(probe_keys[0][0])
+    mask = np.zeros(npr, dtype=bool)
+    mask[pi] = True
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# sort / topn
+# ---------------------------------------------------------------------------
+
+def sort_indices(key_columns, descs, nulls_first=True):
+    """key_columns: [(data, nulls)] in major-to-minor order; descs: [bool].
+    MySQL: NULLs sort first ASC, last DESC. -> permutation indices."""
+    n = len(key_columns[0][0])
+    keys = []
+    # np.lexsort takes minor-to-major
+    for (data, nulls), desc in zip(reversed(key_columns), reversed(descs)):
+        if data.dtype == object:
+            # factorize preserves order for bytes
+            uniq, inv = np.unique(data, return_inverse=True)
+            d = inv.astype(np.int64)
+        else:
+            d = data
+        if desc:
+            if np.issubdtype(np.asarray(d).dtype, np.floating):
+                d = -d.astype(np.float64)
+            else:
+                d = -d.astype(np.int64)
+        keys.append(np.where(nulls, 0, d))
+        # null rank key: ASC -> nulls first (0), non-null 1; DESC -> nulls last
+        null_rank = np.where(nulls, 0 if not desc else 1, 1 if not desc else 0)
+        keys.append(null_rank)
+    return np.lexsort(keys)

@@ -1,0 +1,21 @@
+"""Planner (reference: planner/ — AST → logical plan → optimized plan).
+
+Round-1 shape: rule-based logical optimization (predicate pushdown, equi-join
+extraction, greedy join reorder, column pruning, constant folding) and a thin
+logical→physical mapping done in the executor builder (hash agg / hash join /
+topn). The cost-based physical search over a {host, tpu, tpu-mpp} task model
+(the reference's root/cop/mpp, planner/core/task.go) grows on top of this.
+"""
+
+from .logical import (
+    Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, MemSource,
+    Projection, Selection, SetOp, Sort, TopN, Window,
+)
+from .builder import PlanBuilder
+from .optimizer import optimize
+
+__all__ = [
+    "Aggregation", "DataSource", "Dual", "Join", "Limit", "LogicalPlan",
+    "MemSource", "Projection", "Selection", "SetOp", "Sort", "TopN", "Window",
+    "PlanBuilder", "optimize",
+]

@@ -1,0 +1,478 @@
+"""AST → logical plan (reference: planner/core/logical_plan_builder.go +
+planbuilder.go; aggregate extraction mirrors buildAggregation, star expansion
+mirrors unfoldWildStar, order-by alias rules mirror resolveByItems)."""
+
+from __future__ import annotations
+
+from ..errors import ColumnError, SchemaError, TiDBError, ErrCode
+from ..expression import (
+    AggFuncDesc, Column, ColumnRef, Constant, ExprBuilder, Schema, unify_types,
+)
+from ..expression.core import ScalarFunc
+from ..parser import ast
+from ..sqltypes import TYPE_LONGLONG, FieldType
+from .logical import (
+    Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, MemSource,
+    Projection, Selection, SetOp, Sort, TopN, Window,
+)
+
+_BOOL_FT = FieldType(tp=TYPE_LONGLONG)
+
+
+def split_cnf(expr):
+    """Split a built expression on AND (reference: expression.SplitCNFItems)."""
+    if isinstance(expr, ScalarFunc) and expr.op == "and":
+        return split_cnf(expr.args[0]) + split_cnf(expr.args[1])
+    return [expr]
+
+
+def collect_aggs(node, out):
+    """Collect AggregateFunc AST nodes (deduplicated by restore text)."""
+    if node is None:
+        return
+    if isinstance(node, ast.AggregateFunc):
+        key = node.restore()
+        if key not in out:
+            out[key] = node
+        return  # nested aggs are invalid anyway
+    for child in _ast_children(node):
+        collect_aggs(child, out)
+
+
+def _ast_children(node):
+    if isinstance(node, ast.BinaryOp):
+        return [node.left, node.right]
+    if isinstance(node, ast.UnaryOp):
+        return [node.operand]
+    if isinstance(node, (ast.IsNullExpr, ast.IsTruthExpr)):
+        return [node.expr]
+    if isinstance(node, ast.BetweenExpr):
+        return [node.expr, node.low, node.high]
+    if isinstance(node, ast.InExpr):
+        return [node.expr] + [i for i in node.items if isinstance(i, ast.ExprNode)]
+    if isinstance(node, (ast.LikeExpr, ast.RegexpExpr)):
+        return [node.expr, node.pattern]
+    if isinstance(node, ast.CaseExpr):
+        out = []
+        if node.operand:
+            out.append(node.operand)
+        for c, r in node.whens:
+            out += [c, r]
+        if node.else_:
+            out.append(node.else_)
+        return out
+    if isinstance(node, (ast.FuncCall, ast.AggregateFunc)):
+        return list(node.args)
+    if isinstance(node, ast.CastExpr):
+        return [node.expr]
+    if isinstance(node, ast.IntervalExpr):
+        return [node.value]
+    if isinstance(node, ast.RowExpr):
+        return list(node.items)
+    return []
+
+
+class AggExprBuilder(ExprBuilder):
+    """Resolves expressions over an Aggregation's output: group exprs and agg
+    funcs map to output columns; bare columns not in GROUP BY become implicit
+    first_row aggregates (MySQL non-ONLY_FULL_GROUP_BY behavior)."""
+
+    def __init__(self, agg: Aggregation, child_schema: Schema, expr_map, ctx):
+        super().__init__(agg.schema, ctx)
+        self.agg = agg
+        self.child_schema = child_schema
+        self.expr_map = expr_map  # restore text -> output idx
+
+    def build(self, node):
+        key = node.restore() if isinstance(node, ast.ExprNode) else None
+        if key is not None and key in self.expr_map:
+            idx = self.expr_map[key]
+            return Column(idx, self.agg.schema.refs[idx].ftype,
+                          name=self.agg.schema.refs[idx].name)
+        return super().build(node)
+
+    def _b_ColumnName(self, node):
+        idx = self.schema.find(node)
+        if idx is not None:
+            r = self.schema.refs[idx]
+            return Column(idx, r.ftype, name=r.name)
+        # implicit first_row over a non-grouped column
+        cidx = self.child_schema.find(node)
+        if cidx is None:
+            raise ColumnError(f"Unknown column '{node.name}' in 'field list'")
+        cref = self.child_schema.refs[cidx]
+        arg = Column(cidx, cref.ftype, name=cref.name)
+        desc = AggFuncDesc("first_row", [arg])
+        self.agg.aggs.append(desc)
+        self.agg.schema.refs.append(
+            ColumnRef(cref.name, cref.table, cref.db, desc.ftype))
+        idx = len(self.agg.schema.refs) - 1
+        self.expr_map[node.restore()] = idx
+        return Column(idx, desc.ftype, name=cref.name)
+
+    def _b_AggregateFunc(self, node):
+        raise TiDBError("aggregate not extracted — nested aggregates are invalid",
+                        code=ErrCode.InvalidGroupFuncUse)
+
+
+class PlanBuilder:
+    """ctx provides: infoschema(), current_db(), eval_subquery(sel, limit_one),
+    get_sysvar/set_uservar/get_uservar, mem_table_rows(db, name)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    # -- entry points -------------------------------------------------------
+
+    def build(self, stmt):
+        if isinstance(stmt, ast.SelectStmt):
+            return self.build_select(stmt)
+        if isinstance(stmt, ast.SetOprStmt):
+            return self.build_set_op(stmt)
+        raise TiDBError(f"cannot plan {type(stmt).__name__}")
+
+    def build_set_op(self, stmt: ast.SetOprStmt):
+        children = [self.build_select(s) for s in stmt.selects]
+        ncols = len(children[0].schema)
+        for c in children[1:]:
+            if len(c.schema) != ncols:
+                raise TiDBError(
+                    "The used SELECT statements have a different number of columns",
+                    code=ErrCode.WrongNumberOfColumnsInSelect)
+        # unify column types; names come from the first select
+        refs = []
+        for i in range(ncols):
+            ft = unify_types([c.schema.refs[i].ftype for c in children])
+            r0 = children[0].schema.refs[i]
+            refs.append(ColumnRef(r0.name, "", "", ft))
+        schema = Schema(refs)
+        plan = children[0]
+        kinds = {"union all": "union_all", "union": "union",
+                 "intersect": "intersect", "except": "except",
+                 "intersect all": "intersect", "except all": "except"}
+        for op, nxt in zip(stmt.ops, children[1:]):
+            plan = SetOp([plan, nxt], kinds[op], schema)
+        if stmt.order_by or stmt.limit:
+            plan = self._apply_order_limit(plan, stmt.order_by, stmt.limit,
+                                           ExprBuilder(plan.schema, self.ctx), [])
+        return plan
+
+    # -- FROM ---------------------------------------------------------------
+
+    def build_from(self, node):
+        if node is None:
+            return Dual()
+        if isinstance(node, ast.TableName):
+            return self._build_table(node)
+        if isinstance(node, ast.SubqueryTable):
+            sub = self.build(node.query)
+            alias = node.as_name or ""
+            refs = [ColumnRef(r.name, alias, "", r.ftype) for r in sub.schema.refs]
+            sub2 = Projection(sub, [Column(i, r.ftype, name=r.name)
+                                    for i, r in enumerate(sub.schema.refs)],
+                              Schema(refs))
+            return sub2
+        if isinstance(node, ast.Join):
+            return self._build_join(node)
+        raise TiDBError(f"unsupported FROM item {type(node).__name__}")
+
+    def _build_table(self, tn: ast.TableName):
+        db = tn.schema or self.ctx.current_db()
+        if not db:
+            raise SchemaError("No database selected", code=ErrCode.BadDB)
+        alias = tn.as_name or tn.name
+        if db.lower() in ("information_schema", "performance_schema", "metrics_schema"):
+            cols, rows_fn = self.ctx.mem_table(db.lower(), tn.name.lower())
+            refs = [ColumnRef(name, alias, db, ft) for name, ft in cols]
+            return MemSource(db, tn.name.lower(), Schema(refs), rows_fn)
+        info = self.ctx.infoschema().table_by_name(db, tn.name)
+        cols = info.public_columns()
+        refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
+        return DataSource(db, info, cols, Schema(refs), alias=alias)
+
+    def _build_join(self, jn: ast.Join):
+        left = self.build_from(jn.left)
+        right = self.build_from(jn.right)
+        kind = jn.kind
+        if kind == "right":
+            left, right = right, left
+            kind = "left"
+        schema = left.schema.concat(right.schema)
+        join = Join(left, right, "inner" if kind == "cross" else kind, schema)
+        conds = []
+        if jn.on is not None:
+            b = ExprBuilder(schema, self.ctx)
+            conds = split_cnf(b.build(jn.on))
+        elif jn.using:
+            names = jn.using
+            if names == ["*natural*"]:
+                lnames = {r.name for r in left.schema.refs}
+                names = [r.name for r in right.schema.refs if r.name in lnames]
+            b = ExprBuilder(schema, self.ctx)
+            for name in names:
+                conds.append(b.build(ast.BinaryOp(
+                    op="=",
+                    left=ast.ColumnName(name=name, table=_schema_table(left.schema, name)),
+                    right=ast.ColumnName(name=name, table=_schema_table(right.schema, name)))))
+        self._attach_join_conds(join, conds)
+        return join
+
+    def _attach_join_conds(self, join: Join, conds):
+        nl = len(join.left.schema)
+        for cond in conds:
+            used = set()
+            cond.columns_used(used)
+            left_only = all(i < nl for i in used)
+            right_only = all(i >= nl for i in used)
+            if (isinstance(cond, ScalarFunc) and cond.op == "eq"
+                    and not left_only and not right_only):
+                lhs, rhs = cond.args
+                lu, ru = set(), set()
+                lhs.columns_used(lu)
+                rhs.columns_used(ru)
+                if all(i < nl for i in lu) and all(i >= nl for i in ru):
+                    join.left_keys.append(lhs)
+                    join.right_keys.append(_shift(rhs, -nl))
+                    continue
+                if all(i < nl for i in ru) and all(i >= nl for i in lu):
+                    join.left_keys.append(rhs)
+                    join.right_keys.append(_shift(lhs, -nl))
+                    continue
+            if join.kind == "inner" and left_only:
+                join.children[0] = Selection(join.left, [cond])
+            elif join.kind == "inner" and right_only:
+                join.children[1] = Selection(join.right, [_shift(cond, -nl)])
+            else:
+                join.other_conds.append(cond)
+
+    # -- SELECT -------------------------------------------------------------
+
+    def build_select(self, sel: ast.SelectStmt) -> LogicalPlan:
+        plan = self.build_from(sel.from_)
+        from_schema = plan.schema
+
+        if sel.where is not None:
+            b = ExprBuilder(from_schema, self.ctx)
+            conds = split_cnf(b.build(sel.where))
+            plan = Selection(plan, conds)
+
+        # -- aggregate detection
+        agg_map = {}
+        for f in sel.fields:
+            if not isinstance(f.expr, ast.StarExpr):
+                collect_aggs(f.expr, agg_map)
+        collect_aggs(sel.having, agg_map)
+        for bi in sel.order_by:
+            collect_aggs(bi.expr, agg_map)
+        has_agg = bool(agg_map) or bool(sel.group_by)
+
+        alias_map = {}  # select alias -> field index (after building)
+        hidden = 0
+
+        if has_agg:
+            plan, expr_builder = self._build_aggregation(plan, sel, agg_map)
+        else:
+            expr_builder = ExprBuilder(plan.schema, self.ctx)
+
+        # -- star expansion + select expr building
+        fields = []
+        for f in sel.fields:
+            if isinstance(f.expr, ast.StarExpr):
+                if has_agg:
+                    raise TiDBError("SELECT * with GROUP BY is not supported")
+                for i, r in enumerate(expr_builder.schema.refs):
+                    if f.expr.table and r.table != f.expr.table.lower():
+                        continue
+                    fields.append((Column(i, r.ftype, name=r.name), r.name))
+                continue
+            e = expr_builder.build(f.expr)
+            name = f.as_name or _derive_name(f.expr)
+            fields.append((e, name))
+
+        for i, (_, name) in enumerate(fields):
+            alias_map.setdefault(name.lower(), i)
+
+        # -- having (after select aliases are known; may reference them)
+        if sel.having is not None:
+            cond = self._build_having(sel.having, expr_builder, fields, alias_map)
+            plan = Selection(plan, split_cnf(cond))
+
+        proj_exprs = [e for e, _ in fields]
+        proj_names = [n for _, n in fields]
+        visible = len(proj_exprs)
+
+        # -- order by: resolve against output aliases/positions, else add
+        # hidden columns computed from the pre-projection schema
+        sort_items = []
+        for bi in sel.order_by:
+            idx = self._resolve_by_item(bi.expr, fields, alias_map, expr_builder)
+            if idx is not None:
+                sort_items.append((idx, bi.desc))
+            else:
+                e = expr_builder.build(bi.expr)
+                match = None
+                for i, pe in enumerate(proj_exprs):
+                    if repr(pe) == repr(e):
+                        match = i
+                        break
+                if match is None:
+                    proj_exprs.append(e)
+                    proj_names.append(f"__sort_{len(proj_exprs)}")
+                    match = len(proj_exprs) - 1
+                sort_items.append((match, bi.desc))
+
+        refs = [ColumnRef(n, "", "", e.ftype) for e, n in zip(proj_exprs, proj_names)]
+        plan = Projection(plan, proj_exprs, Schema(refs))
+
+        if sel.distinct:
+            plan = self._build_distinct(plan, visible)
+
+        by = [(Column(i, plan.schema.refs[i].ftype), d) for i, d in sort_items]
+        plan = self._apply_order_limit_built(plan, by, sel.limit)
+
+        if len(proj_exprs) > visible:
+            trim_refs = plan.schema.refs[:visible]
+            plan = Projection(plan, [Column(i, r.ftype, name=r.name)
+                                     for i, r in enumerate(trim_refs)],
+                              Schema(list(trim_refs)))
+        return plan
+
+    def _build_aggregation(self, plan, sel, agg_map):
+        child_schema = plan.schema
+        b = ExprBuilder(child_schema, self.ctx)
+        group_exprs = []
+        expr_map = {}
+        refs = []
+        for bi in sel.group_by:
+            node = bi.expr
+            # positional GROUP BY 2 and alias refs
+            if isinstance(node, ast.Literal) and node.kind == "int":
+                pos = int(node.val) - 1
+                if pos < 0 or pos >= len(sel.fields):
+                    raise TiDBError(f"Unknown column '{node.val}' in 'group statement'")
+                node = sel.fields[pos].expr
+            elif isinstance(node, ast.ColumnName) and not node.table:
+                if child_schema.find(node) is None:
+                    for f in sel.fields:
+                        if f.as_name and f.as_name.lower() == node.name.lower():
+                            node = f.expr
+                            break
+            e = b.build(node)
+            group_exprs.append(e)
+            key = node.restore()
+            expr_map[key] = len(refs)
+            if isinstance(e, Column):
+                r = child_schema.refs[e.idx]
+                refs.append(ColumnRef(r.name, r.table, r.db, r.ftype))
+            else:
+                refs.append(ColumnRef(key, "", "", e.ftype))
+        aggs = []
+        for key, node in agg_map.items():
+            args = [b.build(a) for a in node.args]
+            name = node.name
+            if name == "count" and not args:
+                args = [Constant(1, FieldType(tp=TYPE_LONGLONG))]
+            if name in ("std", "stddev"):
+                name = "stddev_pop"
+            if name == "variance":
+                name = "var_pop"
+            desc = AggFuncDesc(name, args, distinct=node.distinct)
+            expr_map[key] = len(refs)
+            aggs.append(desc)
+            refs.append(ColumnRef(key, "", "", desc.ftype))
+        agg = Aggregation(plan, group_exprs, aggs, Schema(refs))
+        return agg, AggExprBuilder(agg, child_schema, expr_map, self.ctx)
+
+    def _build_having(self, having, expr_builder, fields, alias_map):
+        # rewrite bare alias references to the built select expressions
+        if isinstance(having, ast.ColumnName) and not having.table:
+            i = alias_map.get(having.name.lower())
+            if i is not None and expr_builder.schema.find(having) is None:
+                return fields[i][0]
+        try:
+            return expr_builder.build(having)
+        except ColumnError:
+            rewritten = _substitute_aliases(having, alias_map, fields)
+            if rewritten is not None:
+                return rewritten
+            raise
+
+    def _build_distinct(self, plan, visible):
+        group = [Column(i, r.ftype) for i, r in enumerate(plan.schema.refs)]
+        aggs = []
+        refs = [ColumnRef(r.name, r.table, r.db, r.ftype) for r in plan.schema.refs]
+        return Aggregation(plan, group, aggs, Schema(refs))
+
+    def _resolve_by_item(self, node, fields, alias_map, expr_builder):
+        if isinstance(node, ast.Literal) and node.kind == "int":
+            pos = int(node.val) - 1
+            if pos < 0 or pos >= len(fields):
+                raise TiDBError(f"Unknown column '{node.val}' in 'order clause'")
+            return pos
+        if isinstance(node, ast.ColumnName) and not node.table:
+            # output alias wins only if not resolvable in the source schema?
+            # MySQL: ORDER BY prefers select aliases for bare names.
+            i = alias_map.get(node.name.lower())
+            if i is not None:
+                return i
+        return None
+
+    def _apply_order_limit_built(self, plan, by, limit):
+        offset, count = self._limit_values(limit)
+        if by:
+            if count is not None:
+                return TopN(plan, by, offset or 0, count)
+            return Sort(plan, by)
+        if count is not None:
+            return Limit(plan, offset or 0, count)
+        return plan
+
+    def _apply_order_limit(self, plan, order_by, limit, b, _fields):
+        by = []
+        for bi in order_by:
+            node = bi.expr
+            if isinstance(node, ast.Literal) and node.kind == "int":
+                pos = int(node.val) - 1
+                by.append((Column(pos, plan.schema.refs[pos].ftype), bi.desc))
+            else:
+                by.append((b.build(node), bi.desc))
+        return self._apply_order_limit_built(plan, by, limit)
+
+    def _limit_values(self, limit):
+        if limit is None:
+            return None, None
+        b = ExprBuilder(Schema([]), self.ctx)
+        count = b.build(limit.count).eval_scalar() if limit.count is not None else None
+        offset = b.build(limit.offset).eval_scalar() if limit.offset is not None else 0
+        return int(offset or 0), (int(count) if count is not None else None)
+
+
+def _shift(expr, delta):
+    return expr.transform_columns(
+        lambda c: Column(c.idx + delta, c.ftype, name=c.name))
+
+
+def _schema_table(schema: Schema, colname: str):
+    for r in schema.refs:
+        if r.name == colname.lower():
+            return r.table
+    return ""
+
+
+def _derive_name(node) -> str:
+    if isinstance(node, ast.ColumnName):
+        return node.name
+    r = node.restore()
+    return r if len(r) <= 64 else r[:64]
+
+
+def _substitute_aliases(node, alias_map, fields):
+    """HAVING alias substitution fallback — only simple comparisons."""
+    if isinstance(node, ast.BinaryOp):
+        for side in ("left", "right"):
+            sub = getattr(node, side)
+            if isinstance(sub, ast.ColumnName) and not sub.table:
+                i = alias_map.get(sub.name.lower())
+                if i is not None:
+                    pass
+    return None

@@ -1,0 +1,199 @@
+"""Logical plan operators (reference: planner/core/logical_plans.go)."""
+
+from __future__ import annotations
+
+from ..expression import Schema
+
+
+class LogicalPlan:
+    def __init__(self, children, schema: Schema):
+        self.children = children
+        self.schema = schema
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def explain_name(self):
+        return type(self).__name__
+
+    def explain_info(self):
+        return ""
+
+
+class DataSource(LogicalPlan):
+    """Columnar table scan (reference: planner/core DataSource →
+    PhysicalTableReader; the cop-pushdown boundary becomes host↔TPU)."""
+
+    def __init__(self, db_name, table_info, col_infos, schema, alias=""):
+        super().__init__([], schema)
+        self.db_name = db_name
+        self.table_info = table_info
+        self.col_infos = col_infos      # ColumnInfo list parallel to schema
+        self.alias = alias
+        self.pushed_conds = []          # filters evaluated at scan
+
+    def explain_name(self):
+        return "TableScan"
+
+    def explain_info(self):
+        s = f"table:{self.alias or self.table_info.name}"
+        if self.pushed_conds:
+            s += ", filter:" + " AND ".join(repr(c) for c in self.pushed_conds)
+        return s
+
+
+class MemSource(LogicalPlan):
+    """information_schema / memtable source (reference: infoschema/tables.go)."""
+
+    def __init__(self, db_name, table_name, schema, rows_fn):
+        super().__init__([], schema)
+        self.db_name = db_name
+        self.table_name = table_name
+        self.rows_fn = rows_fn  # () -> list of row tuples (internal values)
+
+    def explain_name(self):
+        return "MemTableScan"
+
+    def explain_info(self):
+        return f"table:{self.table_name}"
+
+
+class Dual(LogicalPlan):
+    """One-row, zero-column source (SELECT without FROM)."""
+
+    def __init__(self):
+        super().__init__([], Schema([]))
+
+    def explain_name(self):
+        return "TableDual"
+
+
+class Selection(LogicalPlan):
+    def __init__(self, child, conds):
+        super().__init__([child], child.schema)
+        self.conds = conds
+
+    def explain_info(self):
+        return " AND ".join(repr(c) for c in self.conds)
+
+
+class Projection(LogicalPlan):
+    def __init__(self, child, exprs, schema):
+        super().__init__([child], schema)
+        self.exprs = exprs
+
+    def explain_info(self):
+        return ", ".join(repr(e) for e in self.exprs)
+
+
+class Join(LogicalPlan):
+    """kinds: inner | left | right | semi | anti | leftouter_semi."""
+
+    def __init__(self, left, right, kind, schema):
+        super().__init__([left, right], schema)
+        self.kind = kind
+        self.left_keys = []    # exprs over left schema
+        self.right_keys = []   # exprs over right schema
+        self.other_conds = []  # exprs over concat schema, applied post-match
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def explain_name(self):
+        return "HashJoin" if self.left_keys else "NestedLoopJoin"
+
+    def explain_info(self):
+        s = self.kind
+        if self.left_keys:
+            pairs = ", ".join(f"{l!r}=={r!r}" for l, r in
+                              zip(self.left_keys, self.right_keys))
+            s += f", equal:[{pairs}]"
+        if self.other_conds:
+            s += ", other:" + " AND ".join(repr(c) for c in self.other_conds)
+        return s
+
+
+class Aggregation(LogicalPlan):
+    def __init__(self, child, group_exprs, aggs, schema):
+        super().__init__([child], schema)
+        self.group_exprs = group_exprs
+        self.aggs = aggs  # [AggFuncDesc]
+
+    def explain_name(self):
+        return "HashAgg"
+
+    def explain_info(self):
+        return (f"group by:[{', '.join(map(repr, self.group_exprs))}], "
+                f"funcs:[{', '.join(map(repr, self.aggs))}]")
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child, by):  # by: [(expr, desc)]
+        super().__init__([child], child.schema)
+        self.by = by
+
+    def explain_info(self):
+        return ", ".join(f"{e!r}{' desc' if d else ''}" for e, d in self.by)
+
+
+class TopN(LogicalPlan):
+    def __init__(self, child, by, offset, count):
+        super().__init__([child], child.schema)
+        self.by = by
+        self.offset = offset
+        self.count = count
+
+    def explain_info(self):
+        return (", ".join(f"{e!r}{' desc' if d else ''}" for e, d in self.by)
+                + f", offset:{self.offset}, count:{self.count}")
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child, offset, count):
+        super().__init__([child], child.schema)
+        self.offset = offset
+        self.count = count
+
+    def explain_info(self):
+        return f"offset:{self.offset}, count:{self.count}"
+
+
+class SetOp(LogicalPlan):
+    """kinds: union | union_all | intersect | except."""
+
+    def __init__(self, children, kind, schema):
+        super().__init__(children, schema)
+        self.kind = kind
+
+    def explain_name(self):
+        return {"union": "Union", "union_all": "UnionAll",
+                "intersect": "Intersect", "except": "Except"}[self.kind]
+
+
+class Window(LogicalPlan):
+    def __init__(self, child, funcs, partition_exprs, order_by, schema):
+        super().__init__([child], schema)
+        self.funcs = funcs              # [(name, [arg exprs])]
+        self.partition_exprs = partition_exprs
+        self.order_by = order_by        # [(expr, desc)]
+
+    def explain_name(self):
+        return "Window"
+
+
+def explain_tree(plan: LogicalPlan, depth=0, out=None):
+    """Render the plan as EXPLAIN rows (id, info)."""
+    if out is None:
+        out = []
+    prefix = ("  " * depth + "└─") if depth else ""
+    info = plan.explain_info()
+    out.append((prefix + plan.explain_name(), info))
+    for c in plan.children:
+        explain_tree(c, depth + 1, out)
+    return out

@@ -1,0 +1,447 @@
+"""Logical optimization rules (reference: planner/core/optimizer.go:73-91 —
+the rule list; here: predicate pushdown, equi-join extraction + greedy join
+reorder, column pruning; constant folding happens at expression build time)."""
+
+from __future__ import annotations
+
+from ..expression import Column, Schema
+from ..expression.core import ScalarFunc
+from .logical import (
+    Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, MemSource,
+    Projection, Selection, SetOp, Sort, TopN, Window,
+)
+
+
+def optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
+    plan = push_down_predicates(plan, [])
+    plan = reorder_joins(plan, ctx)
+    plan = prune_columns(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown (reference: rule_predicate_push_down.go)
+# ---------------------------------------------------------------------------
+
+def push_down_predicates(plan, conds):
+    """conds: expressions over plan's output schema pushed from above.
+    Returns a plan that incorporates them as low as possible."""
+    if isinstance(plan, Selection):
+        return push_down_predicates(plan.child, conds + plan.conds)
+    if isinstance(plan, Join):
+        return _ppd_join(plan, conds)
+    if isinstance(plan, DataSource):
+        if conds:
+            plan.pushed_conds.extend(conds)
+        return plan
+    if isinstance(plan, Projection):
+        pushable, kept = [], []
+        for c in conds:
+            used = set()
+            c.columns_used(used)
+            if all(isinstance(plan.exprs[i], Column) for i in used):
+                pushable.append(c.transform_columns(
+                    lambda col: plan.exprs[col.idx]))
+            else:
+                kept.append(c)
+        plan.children[0] = push_down_predicates(plan.child, pushable)
+        return _wrap(plan, kept)
+    if isinstance(plan, Aggregation):
+        n_group = len(plan.group_exprs)
+        pushable, kept = [], []
+        for c in conds:
+            used = set()
+            c.columns_used(used)
+            if used and all(i < n_group for i in used):
+                pushable.append(c.transform_columns(
+                    lambda col: plan.group_exprs[col.idx]))
+            else:
+                kept.append(c)
+        plan.children[0] = push_down_predicates(plan.child, pushable)
+        return _wrap(plan, kept)
+    if isinstance(plan, Sort):
+        plan.children[0] = push_down_predicates(plan.child, conds)
+        return plan
+    # Limit/TopN/SetOp/Window/MemSource/Dual: cannot push through
+    plan.children = [push_down_predicates(c, []) for c in plan.children]
+    return _wrap(plan, conds)
+
+
+def _ppd_join(join: Join, conds):
+    nl = len(join.left.schema)
+    left_conds, right_conds, kept = [], [], []
+    for cond in conds:
+        used = set()
+        cond.columns_used(used)
+        left_only = all(i < nl for i in used)
+        right_only = used and all(i >= nl for i in used)
+        if join.kind == "inner":
+            if left_only:
+                left_conds.append(cond)
+            elif right_only:
+                right_conds.append(_shift(cond, -nl))
+            elif _is_equi(cond, nl):
+                lhs, rhs = _equi_sides(cond, nl)
+                join.left_keys.append(lhs)
+                join.right_keys.append(rhs)
+            else:
+                join.other_conds.append(cond)
+        elif join.kind == "left":
+            if left_only:
+                left_conds.append(cond)
+            else:
+                kept.append(cond)  # filters null-extended rows: stay above
+        elif join.kind in ("semi", "anti"):
+            if left_only:
+                left_conds.append(cond)
+            else:
+                kept.append(cond)
+        else:
+            kept.append(cond)
+    join.children[0] = push_down_predicates(join.left, left_conds)
+    join.children[1] = push_down_predicates(join.right, right_conds)
+    return _wrap(join, kept)
+
+
+def _is_equi(cond, nl):
+    if not (isinstance(cond, ScalarFunc) and cond.op == "eq"):
+        return False
+    lu, ru = set(), set()
+    cond.args[0].columns_used(lu)
+    cond.args[1].columns_used(ru)
+    if not lu or not ru:
+        return False
+    return ((all(i < nl for i in lu) and all(i >= nl for i in ru)) or
+            (all(i < nl for i in ru) and all(i >= nl for i in lu)))
+
+
+def _equi_sides(cond, nl):
+    lu = set()
+    cond.args[0].columns_used(lu)
+    if all(i < nl for i in lu):
+        return cond.args[0], _shift(cond.args[1], -nl)
+    return cond.args[1], _shift(cond.args[0], -nl)
+
+
+def _shift(expr, delta):
+    return expr.transform_columns(
+        lambda c: Column(c.idx + delta, c.ftype, name=c.name))
+
+
+def _wrap(plan, conds):
+    return Selection(plan, conds) if conds else plan
+
+
+# ---------------------------------------------------------------------------
+# join reorder (reference: rule_join_reorder.go — greedy variant)
+# ---------------------------------------------------------------------------
+
+def reorder_joins(plan, ctx):
+    if isinstance(plan, Join) and plan.kind == "inner":
+        items, conds = [], []
+        _flatten_join(plan, items, conds, 0)
+        if len(items) > 2:
+            # reorder inside each leaf first; the greedy result is final —
+            # recursing into its spine would flatten and reorder forever
+            items = [(off, reorder_joins(p, ctx)) for off, p in items]
+            new = _greedy_join(items, conds, ctx)
+            if new is not None:
+                return new
+    plan.children = [reorder_joins(c, ctx) for c in plan.children]
+    return plan
+
+
+def _flatten_join(plan, items, conds, offset):
+    """Collect inner-join leaves and all conds in *global* column indices.
+    Returns width of this subtree."""
+    if isinstance(plan, Join) and plan.kind == "inner":
+        lw = _flatten_join(plan.left, items, conds, offset)
+        rw = _flatten_join(plan.right, items, conds, offset + lw)
+        for lk, rk in zip(plan.left_keys, plan.right_keys):
+            conds.append(("eq", _shift(lk, offset), _shift(rk, offset + lw)))
+        for oc in plan.other_conds:
+            conds.append(("other", _shift_join_cond(oc, offset, lw), None))
+        return lw + rw
+    items.append((offset, plan))
+    return len(plan.schema)
+
+
+def _shift_join_cond(expr, offset, lw):
+    # other_conds are over the join's concat schema: left part [0,lw) shifts
+    # by offset; right part shifts by offset too (contiguous in global space)
+    return _shift(expr, offset)
+
+
+def _est_rows(plan, ctx):
+    if isinstance(plan, DataSource):
+        n = 1000
+        if ctx is not None and hasattr(ctx, "table_rows"):
+            n = max(ctx.table_rows(plan.table_info.id), 1)
+        for _ in plan.pushed_conds:
+            n = max(n // 4, 1)
+        return n
+    if isinstance(plan, Selection):
+        return max(_est_rows(plan.child, ctx) // 4, 1)
+    if isinstance(plan, Aggregation):
+        return max(_est_rows(plan.child, ctx) // 8, 1)
+    if isinstance(plan, (Limit, TopN)):
+        base = _est_rows(plan.child, ctx)
+        return min(base, plan.count or base)
+    if isinstance(plan, Join):
+        return max(_est_rows(plan.left, ctx), _est_rows(plan.right, ctx))
+    if plan.children:
+        return _est_rows(plan.children[0], ctx)
+    return 1
+
+
+def _greedy_join(items, conds, ctx):
+    """items: [(global_offset, plan)]; conds: [("eq", l, r) | ("other", e, None)]
+    in global indices. Greedy smallest-first join ordering."""
+    n = len(items)
+    sizes = [_est_rows(p, ctx) for _off, p in items]
+    widths = [len(p.schema) for _off, p in items]
+    # map global index -> (item, inner_idx)
+    g2item = {}
+    for it, (off, p) in enumerate(items):
+        for i in range(widths[it]):
+            g2item[off + i] = (it, i)
+
+    def cond_items(e):
+        used = set()
+        e.columns_used(used)
+        return {g2item[g][0] for g in used}, used
+
+    remaining = set(range(n))
+    start = min(remaining, key=lambda i: sizes[i])
+    remaining.discard(start)
+    joined = {start}
+    # current layout: list of item ids in concat order; plan built so far
+    layout = [start]
+    cur = items[start][1]
+    pend = [(kind, a, b) for kind, a, b in conds]
+
+    def gmap(g):
+        it, inner = g2item[g]
+        pos = 0
+        for lid in layout:
+            if lid == it:
+                return pos + inner
+            pos += widths[lid]
+        raise KeyError(g)
+
+    while remaining:
+        # candidates connected via an eq cond
+        cand_scores = {}
+        for kind, a, b in pend:
+            if kind != "eq":
+                continue
+            ia, _ = cond_items(a)
+            ib, _ = cond_items(b)
+            if ia <= joined and len(ib) == 1:
+                (c,) = ib
+                if c in remaining:
+                    cand_scores.setdefault(c, 0)
+            if ib <= joined and len(ia) == 1:
+                (c,) = ia
+                if c in remaining:
+                    cand_scores.setdefault(c, 0)
+        if cand_scores:
+            nxt = min(cand_scores, key=lambda i: sizes[i])
+        else:
+            nxt = min(remaining, key=lambda i: sizes[i])
+        remaining.discard(nxt)
+        right = items[nxt][1]
+        new_joined = joined | {nxt}
+        schema = Schema(cur.schema.refs + right.schema.refs)
+        j = Join(cur, right, "inner", schema)
+        lw = len(cur.schema)
+
+        def gmap_new(g, _nxt=nxt, _lw=lw):
+            it, inner = g2item[g]
+            if it == _nxt:
+                return _lw + inner
+            return gmap(g)
+
+        consumed = []
+        for ci, (kind, a, b) in enumerate(pend):
+            if kind == "eq":
+                ia, _ua = cond_items(a)
+                ib, _ub = cond_items(b)
+                if not (ia | ib) <= new_joined:
+                    continue
+                if ia <= joined and ib == {nxt}:
+                    lk, rk = a, b
+                elif ib <= joined and ia == {nxt}:
+                    lk, rk = b, a
+                else:
+                    # both sides now available but spanning: post-join filter
+                    from ..sqltypes import FieldType, TYPE_LONGLONG
+                    e = ScalarFunc("eq", [_remap_final(a, gmap_new),
+                                          _remap_final(b, gmap_new)],
+                                   FieldType(tp=TYPE_LONGLONG))
+                    j.other_conds.append(e)
+                    consumed.append(ci)
+                    continue
+                j.left_keys.append(_remap_final(lk, gmap))
+                j.right_keys.append(_remap_inner(rk, g2item, nxt))
+                consumed.append(ci)
+            else:
+                ia, _ = cond_items(a)
+                if ia <= new_joined and not ia <= joined:
+                    j.other_conds.append(_remap_final(a, gmap_new))
+                    consumed.append(ci)
+        pend = [c for i, c in enumerate(pend) if i not in set(consumed)]
+        layout.append(nxt)
+        joined = new_joined
+        cur = j
+        sizes.append(0)
+    # leftover conds (e.g. left-only ones missed) -> selection on top
+    leftovers = []
+    for kind, a, b in pend:
+        if kind == "eq":
+            from ..sqltypes import FieldType, TYPE_LONGLONG
+            e = ScalarFunc("eq", [_remap_final(a, gmap), _remap_final(b, gmap)],
+                           FieldType(tp=TYPE_LONGLONG))
+            leftovers.append(e)
+        else:
+            leftovers.append(_remap_final(a, gmap))
+    if leftovers:
+        cur = Selection(cur, leftovers)
+    # restore original column order with a projection
+    orig_order = []
+    for off, p in items:
+        for i in range(len(p.schema)):
+            orig_order.append(off + i)
+    perm = [gmap(g) for g in sorted(orig_order)]
+    refs = [cur.schema.refs[i] for i in perm]
+    exprs = [Column(i, cur.schema.refs[i].ftype, name=cur.schema.refs[i].name)
+             for i in perm]
+    return Projection(cur, exprs, Schema(refs))
+
+
+def _remap_inner(expr, g2item, item_id):
+    """Remap global indices to positions inside one item (the join's right)."""
+    return expr.transform_columns(
+        lambda c: Column(g2item[c.idx][1], c.ftype, name=c.name))
+
+
+def _remap_final(expr, gmap):
+    return expr.transform_columns(
+        lambda c: Column(gmap(c.idx), c.ftype, name=c.name))
+
+
+# ---------------------------------------------------------------------------
+# column pruning (reference: rule_column_pruning.go)
+# ---------------------------------------------------------------------------
+
+def prune_columns(plan):
+    new_plan, _mapping = _prune(plan, set(range(len(plan.schema))))
+    return new_plan
+
+
+def _prune(plan, needed):
+    """Returns (new_plan, mapping old_idx -> new_idx). `needed` may not cover
+    all outputs; nodes narrow their schemas accordingly."""
+    if isinstance(plan, DataSource):
+        used = set(needed)
+        for c in plan.pushed_conds:
+            c.columns_used(used)
+        keep = sorted(used) if used else [0] if plan.schema.refs else []
+        if not keep and plan.col_infos:
+            keep = [0]  # scans need at least one column for row count
+        mapping = {old: i for i, old in enumerate(keep)}
+        plan.col_infos = [plan.col_infos[i] for i in keep]
+        plan.schema = Schema([plan.schema.refs[i] for i in keep])
+        plan.pushed_conds = [_remap_cols(c, mapping) for c in plan.pushed_conds]
+        return plan, mapping
+    if isinstance(plan, MemSource) or isinstance(plan, Dual):
+        return plan, {i: i for i in range(len(plan.schema))}
+    if isinstance(plan, Selection):
+        child_needed = set(needed)
+        for c in plan.conds:
+            c.columns_used(child_needed)
+        plan.children[0], mapping = _prune(plan.child, child_needed)
+        plan.conds = [_remap_cols(c, mapping) for c in plan.conds]
+        plan.schema = plan.child.schema
+        return plan, mapping
+    if isinstance(plan, Projection):
+        keep = sorted(needed)
+        child_needed = set()
+        kept_exprs = [plan.exprs[i] for i in keep]
+        for e in kept_exprs:
+            e.columns_used(child_needed)
+        plan.children[0], cmap = _prune(plan.child, child_needed)
+        plan.exprs = [_remap_cols(e, cmap) for e in kept_exprs]
+        plan.schema = Schema([plan.schema.refs[i] for i in keep])
+        return plan, {old: i for i, old in enumerate(keep)}
+    if isinstance(plan, Aggregation):
+        n_group = len(plan.group_exprs)
+        keep_aggs = [i for i in range(len(plan.aggs))
+                     if (n_group + i) in needed]
+        child_needed = set()
+        for e in plan.group_exprs:
+            e.columns_used(child_needed)
+        kept_descs = [plan.aggs[i] for i in keep_aggs]
+        for d in kept_descs:
+            for a in d.args:
+                a.columns_used(child_needed)
+        plan.children[0], cmap = _prune(plan.child, child_needed)
+        plan.group_exprs = [_remap_cols(e, cmap) for e in plan.group_exprs]
+        for d in kept_descs:
+            d.args = [_remap_cols(a, cmap) for a in d.args]
+        plan.aggs = kept_descs
+        keep = list(range(n_group)) + [n_group + i for i in keep_aggs]
+        plan.schema = Schema([plan.schema.refs[i] for i in keep])
+        return plan, {old: i for i, old in enumerate(keep)}
+    if isinstance(plan, Join):
+        nl = len(plan.left.schema)
+        child_needed = set(needed)
+        for e in plan.other_conds:
+            e.columns_used(child_needed)
+        lneed = {i for i in child_needed if i < nl}
+        rneed = {i - nl for i in child_needed if i >= nl}
+        for e in plan.left_keys:
+            e.columns_used(lneed)
+        for e in plan.right_keys:
+            e.columns_used(rneed)
+        plan.children[0], lmap = _prune(plan.left, lneed)
+        plan.children[1], rmap = _prune(plan.right, rneed)
+        new_nl = len(plan.left.schema)
+        mapping = {}
+        for old, new in lmap.items():
+            mapping[old] = new
+        for old, new in rmap.items():
+            mapping[old + nl] = new + new_nl
+        plan.left_keys = [_remap_cols(e, lmap) for e in plan.left_keys]
+        plan.right_keys = [_remap_cols(e, rmap) for e in plan.right_keys]
+        plan.other_conds = [_remap_cols(e, mapping) for e in plan.other_conds]
+        plan.schema = plan.left.schema.concat(plan.right.schema)
+        return plan, mapping
+    if isinstance(plan, (Sort, TopN)):
+        child_needed = set(needed)
+        for e, _d in plan.by:
+            e.columns_used(child_needed)
+        plan.children[0], mapping = _prune(plan.child, child_needed)
+        plan.by = [(_remap_cols(e, mapping), d) for e, d in plan.by]
+        plan.schema = plan.child.schema
+        return plan, mapping
+    if isinstance(plan, Limit):
+        plan.children[0], mapping = _prune(plan.child, needed)
+        plan.schema = plan.child.schema
+        return plan, mapping
+    if isinstance(plan, SetOp):
+        # children must keep identical layouts: prune nothing
+        new_children = []
+        for c in plan.children:
+            nc, _m = _prune(c, set(range(len(c.schema))))
+            new_children.append(nc)
+        plan.children = new_children
+        return plan, {i: i for i in range(len(plan.schema))}
+    # unknown: no pruning
+    plan.children = [(_prune(c, set(range(len(c.schema))))[0]) for c in plan.children]
+    return plan, {i: i for i in range(len(plan.schema))}
+
+
+def _remap_cols(expr, mapping):
+    return expr.transform_columns(
+        lambda c: Column(mapping[c.idx], c.ftype, name=c.name))

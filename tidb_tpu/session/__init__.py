@@ -1,0 +1,3 @@
+from .session import Session, Domain, Result, bootstrap_domain, new_session
+
+__all__ = ["Session", "Domain", "Result", "bootstrap_domain", "new_session"]

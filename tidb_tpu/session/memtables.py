@@ -1,0 +1,183 @@
+"""information_schema virtual tables (reference: infoschema/tables.go — 75+
+memtables; the core set here, growing with the engine)."""
+
+from __future__ import annotations
+
+from ..errors import SchemaError
+from ..sqltypes import TYPE_LONGLONG, TYPE_VARCHAR, FieldType
+
+_S = FieldType(tp=TYPE_VARCHAR)
+_I = FieldType(tp=TYPE_LONGLONG)
+
+
+def mem_table(session, db: str, name: str):
+    """-> ([(col_name, ftype)], rows_fn)."""
+    fn = _TABLES.get((db, name))
+    if fn is None:
+        raise SchemaError(f"Table '{db}.{name}' doesn't exist")
+    return fn(session)
+
+
+def _schemata(session):
+    cols = [("catalog_name", _S), ("schema_name", _S),
+            ("default_character_set_name", _S),
+            ("default_collation_name", _S)]
+
+    def rows():
+        out = [(b"def", b"information_schema", b"utf8mb4", b"utf8mb4_bin")]
+        for n in session.infoschema().schema_names():
+            out.append((b"def", n.encode(), b"utf8mb4", b"utf8mb4_bin"))
+        return out
+    return cols, rows
+
+
+def _tables(session):
+    cols = [("table_catalog", _S), ("table_schema", _S), ("table_name", _S),
+            ("table_type", _S), ("engine", _S), ("table_rows", _I),
+            ("auto_increment", _I), ("tidb_table_id", _I)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                nrows = session.expr_ctx().table_rows(t.id)
+                out.append((b"def", dbn.encode(), t.name.encode(),
+                            b"BASE TABLE", b"tpu-htap", nrows,
+                            t.auto_increment, t.id))
+        return out
+    return cols, rows
+
+
+def _columns(session):
+    cols = [("table_catalog", _S), ("table_schema", _S), ("table_name", _S),
+            ("column_name", _S), ("ordinal_position", _I),
+            ("is_nullable", _S), ("data_type", _S), ("column_type", _S),
+            ("column_key", _S)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                for i, c in enumerate(t.public_columns(), 1):
+                    out.append((b"def", dbn.encode(), t.name.encode(),
+                                c.name.encode(), i,
+                                b"NO" if c.ftype.not_null else b"YES",
+                                c.ftype.type_name().encode(),
+                                c.ftype.sql_string().encode(), b""))
+        return out
+    return cols, rows
+
+
+def _statistics(session):
+    cols = [("table_schema", _S), ("table_name", _S), ("non_unique", _I),
+            ("index_name", _S), ("seq_in_index", _I), ("column_name", _S)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                if t.pk_is_handle:
+                    pk = next((c.name for c in t.columns
+                               if c.id == t.pk_col_id), "")
+                    out.append((dbn.encode(), t.name.encode(), 0,
+                                b"PRIMARY", 1, pk.encode()))
+                for idx in t.indexes:
+                    for seq, ic in enumerate(idx.columns, 1):
+                        out.append((dbn.encode(), t.name.encode(),
+                                    0 if idx.unique else 1,
+                                    idx.name.encode(), seq, ic.name.encode()))
+        return out
+    return cols, rows
+
+
+def _engines(session):
+    cols = [("engine", _S), ("support", _S), ("comment", _S)]
+
+    def rows():
+        return [(b"tpu-htap", b"DEFAULT", b"TPU-native HTAP engine")]
+    return cols, rows
+
+
+def _processlist(session):
+    cols = [("id", _I), ("user", _S), ("host", _S), ("db", _S),
+            ("command", _S), ("time", _I), ("state", _S), ("info", _S)]
+
+    def rows():
+        return [(session.conn_id, session.user.encode(), b"localhost",
+                 session.current_db().encode(), b"Query", 0, b"", b"")]
+    return cols, rows
+
+
+def _tidb_indexes(session):
+    cols = [("table_schema", _S), ("table_name", _S), ("key_name", _S),
+            ("column_name", _S), ("index_id", _I)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                for idx in t.indexes:
+                    for ic in idx.columns:
+                        out.append((dbn.encode(), t.name.encode(),
+                                    idx.name.encode(), ic.name.encode(),
+                                    idx.id))
+        return out
+    return cols, rows
+
+
+def _character_sets(session):
+    cols = [("character_set_name", _S), ("default_collate_name", _S),
+            ("description", _S), ("maxlen", _I)]
+
+    def rows():
+        return [(b"utf8mb4", b"utf8mb4_bin", b"UTF-8 Unicode", 4),
+                (b"binary", b"binary", b"Binary pseudo charset", 1)]
+    return cols, rows
+
+
+def _collations(session):
+    cols = [("collation_name", _S), ("character_set_name", _S), ("id", _I),
+            ("is_default", _S), ("is_compiled", _S), ("sortlen", _I)]
+
+    def rows():
+        return [(b"utf8mb4_bin", b"utf8mb4", 46, b"Yes", b"Yes", 1),
+                (b"binary", b"binary", 63, b"Yes", b"Yes", 1)]
+    return cols, rows
+
+
+def _key_column_usage(session):
+    cols = [("constraint_name", _S), ("table_schema", _S), ("table_name", _S),
+            ("column_name", _S), ("ordinal_position", _I)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                for idx in t.indexes:
+                    if not idx.unique:
+                        continue
+                    cname = b"PRIMARY" if idx.primary else idx.name.encode()
+                    for seq, ic in enumerate(idx.columns, 1):
+                        out.append((cname, dbn.encode(), t.name.encode(),
+                                    ic.name.encode(), seq))
+        return out
+    return cols, rows
+
+
+_TABLES = {
+    ("information_schema", "schemata"): _schemata,
+    ("information_schema", "tables"): _tables,
+    ("information_schema", "columns"): _columns,
+    ("information_schema", "statistics"): _statistics,
+    ("information_schema", "engines"): _engines,
+    ("information_schema", "processlist"): _processlist,
+    ("information_schema", "tidb_indexes"): _tidb_indexes,
+    ("information_schema", "character_sets"): _character_sets,
+    ("information_schema", "collations"): _collations,
+    ("information_schema", "key_column_usage"): _key_column_usage,
+}

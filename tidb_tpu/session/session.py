@@ -1,0 +1,568 @@
+"""Session + Domain (reference: session/session.go ExecuteStmt loop,
+domain/domain.go per-process runtime singleton).
+
+The Domain owns the store, the schema cache, the columnar cache and the DDL
+executor; Sessions own variables, the current txn and the statement loop:
+parse → plan → optimize → execute, with lazy autocommit transactions
+(reference: session/txn.go LazyTxn)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+import time
+
+import numpy as np
+
+from ..errors import (ErrCode, SchemaError, TiDBError, WriteConflictError)
+from ..infoschema import InfoSchema, build_infoschema
+from ..meta import Meta
+from ..model import DBInfo
+from ..parser import Parser, ast, digest as sql_digest
+from ..planner import PlanBuilder, optimize
+from ..planner.logical import explain_tree
+from ..sqltypes import (TYPE_LONGLONG, TYPE_VARCHAR, FieldType, format_value)
+from ..utils.chunk import Chunk
+from . import sysvars as sv
+
+
+class Domain:
+    """reference: domain/domain.go — schema cache + background machinery."""
+
+    def __init__(self, store):
+        from ..storage import ColumnarCache
+        self.store = store
+        self.columnar_cache = ColumnarCache(store)
+        self._schema_lock = threading.Lock()
+        self._infoschema: InfoSchema | None = None
+        self.global_vars: dict[str, str] = {}
+        self.stats: dict[int, dict] = {}      # table_id -> stats blob
+        self.ddl_lock = threading.RLock()     # single-owner DDL (owner role)
+        self.reload_schema()
+
+    def reload_schema(self):
+        """reference: domain.Reload — full load on version change."""
+        txn = self.store.begin()
+        try:
+            m = Meta(txn)
+            infos = build_infoschema(m)
+        finally:
+            txn.rollback()
+        with self._schema_lock:
+            self._infoschema = infos
+
+    def infoschema(self) -> InfoSchema:
+        with self._schema_lock:
+            return self._infoschema
+
+    def load_stats(self):
+        txn = self.store.begin()
+        try:
+            m = Meta(txn)
+            for db in m.list_databases():
+                for t in m.list_tables(db.id):
+                    s = m.stats(t.id)
+                    if s:
+                        self.stats[t.id] = s
+        finally:
+            txn.rollback()
+
+
+class Result:
+    """Query result: column names + the result chunk."""
+
+    def __init__(self, names=None, chunk: Chunk | None = None, affected=0,
+                 last_insert_id=0, warnings=None):
+        self.names = names or []
+        self.chunk = chunk
+        self.affected = affected
+        self.last_insert_id = last_insert_id
+        self.warnings = warnings or []
+
+    @property
+    def internal_rows(self):
+        return self.chunk.to_rows() if self.chunk is not None else []
+
+    @property
+    def rows(self):
+        """Display rows (MySQL text protocol strings)."""
+        return self.chunk.to_display_rows() if self.chunk is not None else []
+
+    @property
+    def ftypes(self):
+        return [c.ftype for c in self.chunk.columns] if self.chunk is not None else []
+
+
+class _ExprCtx:
+    """Context handed to ExprBuilder (sysvars, subqueries, time)."""
+
+    def __init__(self, session):
+        self.session = session
+        self.params = None
+
+    def eval_subquery(self, select, limit_one=False):
+        res = self.session.run_query(select)
+        fts = res.ftypes
+        rows = res.internal_rows
+        if limit_one:
+            rows = rows[:1]
+        return rows, fts
+
+    def get_sysvar(self, name, scope):
+        return self.session.get_sysvar(name, scope)
+
+    def get_uservar(self, name):
+        return self.session.user_vars.get(name)
+
+    def set_uservar(self, name, value):
+        self.session.user_vars[name] = value
+
+    def current_db(self):
+        return self.session.current_db()
+
+    def current_user(self):
+        return self.session.user
+
+    def now(self):
+        return _dt.datetime.now()
+
+    # planner hooks
+    def infoschema(self):
+        return self.session.infoschema()
+
+    def mem_table(self, db, name):
+        from .memtables import mem_table
+        return mem_table(self.session, db, name)
+
+    def table_rows(self, table_id):
+        s = self.session.domain.stats.get(table_id)
+        if s:
+            return s.get("row_count", 1000)
+        entry = self.session.domain.columnar_cache._entries.get(table_id)
+        if entry is not None:
+            return max(entry.nrows, 1)
+        return 1000
+
+
+class Session:
+    """reference: session.session — one connection's state."""
+
+    _next_conn_id = [1]
+
+    def __init__(self, domain: Domain):
+        self.domain = domain
+        self.store = domain.store
+        self._db = "test"
+        self.session_vars: dict[str, str] = {}
+        self.user_vars: dict[str, object] = {}
+        self.txn = None            # explicit or statement txn
+        self.explicit_txn = False
+        self.user = "root@%"
+        self.parser = Parser()
+        self.last_insert_id = 0
+        self.affected_rows = 0
+        self.warnings: list[str] = []
+        self.prepared: dict[str, str] = {}
+        self.conn_id = Session._next_conn_id[0]
+        Session._next_conn_id[0] += 1
+        self._expr_ctx = _ExprCtx(self)
+        from ..ddl import DDLExecutor
+        self.ddl = DDLExecutor(self)
+
+    # -- variables ----------------------------------------------------------
+
+    def get_sysvar(self, name, scope="session"):
+        reg = sv.get_registry().get(name)
+        if scope == "global":
+            if name in self.domain.global_vars:
+                return self.domain.global_vars[name]
+        else:
+            if name in self.session_vars:
+                return self.session_vars[name]
+            if name in self.domain.global_vars:
+                return self.domain.global_vars[name]
+        if reg is None:
+            raise TiDBError(f"Unknown system variable '{name}'",
+                            code=ErrCode.UnknownSystemVariable)
+        return reg.default
+
+    def set_sysvar(self, name, value, scope="session"):
+        reg = sv.get_registry().get(name)
+        if reg is None:
+            raise TiDBError(f"Unknown system variable '{name}'",
+                            code=ErrCode.UnknownSystemVariable)
+        v = reg.validate(value) if value is not None else reg.default
+        if scope == "global":
+            self.domain.global_vars[name] = v
+        else:
+            self.session_vars[name] = v
+
+    def autocommit(self) -> bool:
+        return self.get_sysvar("autocommit") == "ON"
+
+    def current_db(self) -> str:
+        return self._db
+
+    def infoschema(self) -> InfoSchema:
+        return self.domain.infoschema()
+
+    def expr_ctx(self):
+        return self._expr_ctx
+
+    # -- txn management (reference: session/txn.go LazyTxn) ------------------
+
+    def txn_for_read(self):
+        if self.txn is not None and self.txn.valid:
+            return self.txn
+        # read-only statement txn: snapshot view, nothing to commit
+        return self.store.begin()
+
+    def txn_for_write(self):
+        if self.txn is None or not self.txn.valid:
+            self.txn = self.store.begin()
+            if not self.explicit_txn and not self.autocommit():
+                self.explicit_txn = True
+        return self.txn
+
+    def txn_dirty(self, table_id) -> bool:
+        """True if the current txn holds uncommitted writes for this table
+        (forces the union-scan read path)."""
+        if self.txn is None or not self.txn.valid:
+            return False
+        if table_id in self.txn.touched_tables:
+            return True
+        if len(self.txn.membuf) == 0:
+            return False
+        from .. import tablecodec
+        start, end = tablecodec.table_range(table_id)
+        return bool(self.txn.membuf.range_items(start, end))
+
+    def finish_dml(self):
+        """Autocommit boundary after a DML statement."""
+        if self.explicit_txn:
+            return
+        if self.autocommit() and self.txn is not None and self.txn.valid:
+            self._commit_txn()
+
+    def _commit_txn(self):
+        txn, self.txn = self.txn, None
+        try:
+            txn.commit()
+        except Exception:
+            raise
+        finally:
+            for tid in txn.touched_tables:
+                self.domain.columnar_cache.invalidate(tid)
+
+    def begin(self):
+        if self.txn is not None and self.txn.valid:
+            self._commit_txn()
+        self.txn = self.store.begin()
+        self.explicit_txn = True
+
+    def commit(self):
+        self.explicit_txn = False
+        if self.txn is not None and self.txn.valid:
+            self._commit_txn()
+        else:
+            self.txn = None
+
+    def rollback(self):
+        self.explicit_txn = False
+        if self.txn is not None and self.txn.valid:
+            self.txn.rollback()
+        self.txn = None
+
+    def alloc_autoid(self, table_id, n=1) -> int:
+        """Independent meta txn for id allocation
+        (reference: meta/autoid — batched, outside the user txn)."""
+        for _attempt in range(20):
+            txn = self.store.begin()
+            try:
+                m = Meta(txn)
+                base, _end = m.alloc_autoid_batch(table_id, n)
+                txn.commit()
+                return base
+            except WriteConflictError:
+                txn.rollback()
+                continue
+            except Exception:
+                txn.rollback()
+                raise
+        raise TiDBError("autoid allocation conflict")
+
+    def rebase_autoid(self, table_id, new_base: int):
+        for _attempt in range(20):
+            txn = self.store.begin()
+            try:
+                m = Meta(txn)
+                if m.autoid(table_id) < new_base:
+                    m.set_autoid(table_id, new_base)
+                    txn.commit()
+                else:
+                    txn.rollback()
+                return
+            except WriteConflictError:
+                txn.rollback()
+                continue
+            except Exception:
+                txn.rollback()
+                raise
+
+    # -- columnar cache accessor used by executors ---------------------------
+
+    def columnar_cache(self):
+        return self.domain.columnar_cache
+
+    # -- statement loop ------------------------------------------------------
+
+    def execute(self, sql: str) -> list[Result]:
+        """reference: session.ExecuteStmt (session.go:1637)."""
+        stmts = self.parser.parse(sql)
+        return [self._execute_stmt(s) for s in stmts]
+
+    def _execute_stmt(self, stmt) -> Result:
+        self.warnings = []
+        try:
+            return self._dispatch(stmt)
+        except Exception:
+            # statement-level rollback of the autocommit txn — ANY escaping
+            # exception must not leave a stale txn dangling on the session
+            if not self.explicit_txn and self.txn is not None and self.txn.valid:
+                self.txn.rollback()
+                self.txn = None
+            raise
+
+    def _dispatch(self, stmt) -> Result:
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
+            return self.run_query(stmt)
+        if isinstance(stmt, ast.InsertStmt):
+            from ..executor.dml import InsertExec
+            r = InsertExec(self, stmt).execute()
+            self.last_insert_id = r.last_insert_id or self.last_insert_id
+            return Result(affected=r.affected, last_insert_id=r.last_insert_id)
+        if isinstance(stmt, ast.UpdateStmt):
+            from ..executor.dml import UpdateExec
+            r = UpdateExec(self, stmt).execute()
+            return Result(affected=r.affected)
+        if isinstance(stmt, ast.DeleteStmt):
+            from ..executor.dml import DeleteExec
+            r = DeleteExec(self, stmt).execute()
+            return Result(affected=r.affected)
+        if isinstance(stmt, ast.UseStmt):
+            if self.infoschema().schema_by_name(stmt.db) is None:
+                raise SchemaError(f"Unknown database '{stmt.db}'",
+                                  code=ErrCode.BadDB)
+            self._db = stmt.db
+            return Result()
+        if isinstance(stmt, ast.SetStmt):
+            return self._exec_set(stmt)
+        if isinstance(stmt, ast.BeginStmt):
+            self.begin()
+            return Result()
+        if isinstance(stmt, ast.CommitStmt):
+            self.commit()
+            return Result()
+        if isinstance(stmt, ast.RollbackStmt):
+            self.rollback()
+            return Result()
+        if isinstance(stmt, ast.ShowStmt):
+            from .show import exec_show
+            return exec_show(self, stmt)
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, ast.CreateDatabaseStmt):
+            self.ddl.create_database(stmt)
+            return Result()
+        if isinstance(stmt, ast.DropDatabaseStmt):
+            self.ddl.drop_database(stmt)
+            if self._db.lower() == stmt.name.lower():
+                self._db = ""
+            return Result()
+        if isinstance(stmt, ast.CreateTableStmt):
+            self.ddl.create_table(stmt)
+            return Result()
+        if isinstance(stmt, ast.DropTableStmt):
+            self.ddl.drop_table(stmt)
+            return Result()
+        if isinstance(stmt, ast.TruncateTableStmt):
+            self.ddl.truncate_table(stmt)
+            return Result()
+        if isinstance(stmt, ast.CreateIndexStmt):
+            self.ddl.create_index(stmt)
+            return Result()
+        if isinstance(stmt, ast.DropIndexStmt):
+            self.ddl.drop_index(stmt)
+            return Result()
+        if isinstance(stmt, ast.AlterTableStmt):
+            self.ddl.alter_table(stmt)
+            return Result()
+        if isinstance(stmt, ast.RenameTableStmt):
+            self.ddl.rename_table(stmt)
+            return Result()
+        if isinstance(stmt, ast.AnalyzeTableStmt):
+            return self._exec_analyze(stmt)
+        if isinstance(stmt, ast.AdminStmt):
+            return self._exec_admin(stmt)
+        if isinstance(stmt, ast.PrepareStmt):
+            sql = stmt.sql
+            if isinstance(sql, ast.VariableExpr):
+                v = self.user_vars.get(sql.name)
+                sql = v.decode() if isinstance(v, bytes) else str(v or "")
+            self.prepared[stmt.name] = sql
+            return Result()
+        if isinstance(stmt, ast.ExecuteStmt):
+            return self._exec_execute(stmt)
+        if isinstance(stmt, ast.DeallocateStmt):
+            self.prepared.pop(stmt.name, None)
+            return Result()
+        if isinstance(stmt, ast.FlushStmt):
+            return Result()
+        if isinstance(stmt, ast.KillStmt):
+            return Result()
+        if isinstance(stmt, ast.TraceStmt):
+            return self._dispatch(stmt.stmt)
+        raise TiDBError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- query path ----------------------------------------------------------
+
+    def plan_query(self, stmt):
+        builder = PlanBuilder(self._expr_ctx)
+        plan = builder.build(stmt)
+        return optimize(plan, self._expr_ctx)
+
+    def run_query(self, stmt) -> Result:
+        from ..executor import build_executor
+        plan = self.plan_query(stmt)
+        exe = build_executor(plan, self._exec_ctx())
+        chunk = exe.execute()
+        names = [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
+        return Result(names=names, chunk=chunk)
+
+    def _exec_ctx(self):
+        return self
+
+    # -- misc statements -----------------------------------------------------
+
+    def _exec_set(self, stmt: ast.SetStmt) -> Result:
+        from ..expression import ExprBuilder, Schema
+        b = ExprBuilder(Schema([]), self._expr_ctx)
+        for scope, name, node in stmt.items:
+            if scope == "user":
+                self.user_vars[name] = b.build(node).eval_scalar()
+                continue
+            if name == "names":
+                continue
+            if isinstance(node, ast.DefaultExpr):
+                self.set_sysvar(name, None, scope)
+                continue
+            v = b.build(node).eval_scalar()
+            if isinstance(v, bytes):
+                v = v.decode()
+            self.set_sysvar(name, v, scope)
+        return Result()
+
+    def _exec_explain(self, stmt: ast.ExplainStmt) -> Result:
+        inner = stmt.stmt
+        if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
+            raise TiDBError("EXPLAIN supports SELECT statements only for now")
+        plan = self.plan_query(inner)
+        if stmt.analyze:
+            t0 = time.time()
+            from ..executor import build_executor
+            exe = build_executor(plan, self._exec_ctx())
+            chunk = exe.execute()
+            elapsed = time.time() - t0
+        rows = []
+        for name, info in explain_tree(plan):
+            rows.append((name.encode(), info.encode()))
+        ft = FieldType(tp=TYPE_VARCHAR)
+        out = Chunk.from_rows([ft, ft], rows)
+        return Result(names=["id", "info"], chunk=out)
+
+    def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> Result:
+        """Collect basic stats (reference: executor/analyze.go; histograms
+        and sketches land with the stats module)."""
+        from ..statistics import analyze_table
+        for tn in stmt.tables:
+            db = tn.schema or self.current_db()
+            info = self.infoschema().table_by_name(db, tn.name)
+            analyze_table(self, info)
+        return Result()
+
+    def _exec_admin(self, stmt: ast.AdminStmt) -> Result:
+        if stmt.kind == "show_ddl_jobs":
+            txn = self.store.begin()
+            try:
+                m = Meta(txn)
+                jobs = m.history_jobs()[-20:]
+                jobs.reverse()
+            finally:
+                txn.rollback()
+            from ..model import JobState, SchemaState
+            ft_i = FieldType(tp=TYPE_LONGLONG)
+            ft_s = FieldType(tp=TYPE_VARCHAR)
+            rows = [(j.id, j.type.encode(),
+                     SchemaState.NAMES.get(j.schema_state, "?").encode(),
+                     j.schema_id, j.table_id, j.row_count,
+                     JobState.NAMES.get(j.state, "?").encode())
+                    for j in jobs]
+            chunk = Chunk.from_rows([ft_i, ft_s, ft_s, ft_i, ft_i, ft_i, ft_s], rows)
+            return Result(names=["job_id", "job_type", "schema_state",
+                                 "schema_id", "table_id", "row_count", "state"],
+                          chunk=chunk)
+        if stmt.kind == "check_table":
+            from ..executor.admin import check_table
+            for tn in stmt.tables:
+                db = tn.schema or self.current_db()
+                info = self.infoschema().table_by_name(db, tn.name)
+                check_table(self, info)
+            return Result()
+        raise TiDBError(f"unsupported ADMIN {stmt.kind}")
+
+    def _exec_execute(self, stmt: ast.ExecuteStmt) -> Result:
+        sql = self.prepared.get(stmt.name)
+        if sql is None:
+            raise TiDBError(f"Unknown prepared statement handler ({stmt.name})")
+        params = []
+        for uv in stmt.using:
+            params.append(self.user_vars.get(uv))
+        inner = self.parser.parse(sql)
+        if len(inner) != 1:
+            raise TiDBError("prepared statement must be a single statement")
+        self._expr_ctx.params = params
+        try:
+            return self._dispatch(inner[0])
+        finally:
+            self._expr_ctx.params = None
+
+
+BOOTSTRAP_VERSION = 1
+
+
+def bootstrap_domain(store=None) -> Domain:
+    """reference: session.BootstrapSession (session.go:2566) — creates system
+    databases and marks the bootstrap version."""
+    from ..kv import new_store
+    if store is None:
+        store = new_store()
+    txn = store.begin()
+    m = Meta(txn)
+    if m.bootstrapped() >= BOOTSTRAP_VERSION:
+        txn.rollback()
+        return Domain(store)
+    for db_name in ("mysql", "test"):
+        db = DBInfo(id=m.gen_global_id(), name=db_name)
+        m.create_database(db)
+    m.set_bootstrapped(BOOTSTRAP_VERSION)
+    m.bump_schema_version()
+    txn.commit()
+    d = Domain(store)
+    d.load_stats()
+    return d
+
+
+def new_session(domain: Domain | None = None) -> Session:
+    if domain is None:
+        domain = bootstrap_domain()
+    return Session(domain)

@@ -1,0 +1,208 @@
+"""SHOW statement execution (reference: executor/show.go)."""
+
+from __future__ import annotations
+
+from ..errors import TiDBError, ErrCode, SchemaError
+from ..expression import like_to_regex
+from ..model import SchemaState
+from ..parser import ast
+from ..sqltypes import TYPE_LONGLONG, TYPE_VARCHAR, FieldType
+from ..utils.chunk import Chunk
+from . import sysvars as sv
+
+_S = FieldType(tp=TYPE_VARCHAR)
+_I = FieldType(tp=TYPE_LONGLONG)
+
+
+def _match(like_pat, s: str) -> bool:
+    if like_pat is None:
+        return True
+    return like_to_regex(like_pat).match(s.encode()) is not None
+
+
+def exec_show(session, stmt: ast.ShowStmt):
+    from .session import Result
+    like = None
+    if stmt.like is not None:
+        from ..expression import ExprBuilder, Schema
+        v = ExprBuilder(Schema([]), session.expr_ctx()).build(stmt.like).eval_scalar()
+        like = v if isinstance(v, bytes) else str(v).encode()
+
+    if stmt.kind == "databases":
+        names = session.infoschema().schema_names()
+        names = [n for n in names if _match(like, n)]
+        names.append("information_schema") if "information_schema" not in names else None
+        names.sort()
+        rows = [(n.encode(),) for n in names if _match(like, n)]
+        return Result(names=["Database"], chunk=Chunk.from_rows([_S], rows))
+
+    if stmt.kind == "tables":
+        db = stmt.db or session.current_db()
+        infos = session.infoschema()
+        if infos.schema_by_name(db) is None:
+            raise SchemaError(f"Unknown database '{db}'", code=ErrCode.BadDB)
+        tables = [t.name for t in infos.tables_in_schema(db)]
+        rows = [(t.encode(),) for t in sorted(tables) if _match(like, t)]
+        return Result(names=[f"Tables_in_{db}"], chunk=Chunk.from_rows([_S], rows))
+
+    if stmt.kind == "columns":
+        tn = stmt.target
+        db = tn.schema or stmt.db or session.current_db()
+        info = session.infoschema().table_by_name(db, tn.name)
+        rows = []
+        for c in info.public_columns():
+            null = b"NO" if c.ftype.not_null else b"YES"
+            key = b""
+            if session  and info.pk_is_handle and c.id == info.pk_col_id:
+                key = b"PRI"
+            else:
+                for idx in info.indexes:
+                    if idx.columns and idx.columns[0].name.lower() == c.name.lower():
+                        key = b"PRI" if idx.primary else (b"UNI" if idx.unique else b"MUL")
+                        break
+            from ..sqltypes import format_value
+            default = (format_value(c.default_value, c.ftype) or "").encode() \
+                if c.has_default and c.default_value is not None else None
+            rows.append((c.name.encode(), c.ftype.sql_string().encode(),
+                         null, key, default, b""))
+        return Result(names=["Field", "Type", "Null", "Key", "Default", "Extra"],
+                      chunk=Chunk.from_rows([_S] * 6, rows))
+
+    if stmt.kind == "index":
+        tn = stmt.target
+        db = tn.schema or session.current_db()
+        info = session.infoschema().table_by_name(db, tn.name)
+        rows = []
+        if info.pk_is_handle:
+            pk = info.find_column_by_id(info.pk_col_id) if hasattr(info, 'find_column_by_id') else None
+            pkname = next((c.name for c in info.columns if c.id == info.pk_col_id), "")
+            rows.append((info.name.encode(), 0, b"PRIMARY", 1, pkname.encode()))
+        for idx in info.indexes:
+            for seq, ic in enumerate(idx.columns, 1):
+                rows.append((info.name.encode(), 0 if idx.unique else 1,
+                             idx.name.encode(), seq, ic.name.encode()))
+        return Result(names=["Table", "Non_unique", "Key_name", "Seq_in_index",
+                             "Column_name"],
+                      chunk=Chunk.from_rows([_S, _I, _S, _I, _S], rows))
+
+    if stmt.kind == "create_table":
+        tn = stmt.target
+        db = tn.schema or session.current_db()
+        info = session.infoschema().table_by_name(db, tn.name)
+        ddl = render_create_table(info)
+        return Result(names=["Table", "Create Table"],
+                      chunk=Chunk.from_rows([_S, _S],
+                                            [(info.name.encode(), ddl.encode())]))
+
+    if stmt.kind == "variables":
+        rows = []
+        reg = sv.get_registry()
+        for name in sorted(reg):
+            if not _match(like, name):
+                continue
+            scope = "global" if stmt.global_scope else "session"
+            try:
+                v = session.get_sysvar(name, scope)
+            except TiDBError:
+                v = reg[name].default
+            rows.append((name.encode(), str(v).encode()))
+        return Result(names=["Variable_name", "Value"],
+                      chunk=Chunk.from_rows([_S, _S], rows))
+
+    if stmt.kind == "status":
+        return Result(names=["Variable_name", "Value"],
+                      chunk=Chunk.from_rows([_S, _S], []))
+
+    if stmt.kind == "warnings":
+        rows = [(b"Warning", 1105, w.encode()) for w in session.warnings]
+        return Result(names=["Level", "Code", "Message"],
+                      chunk=Chunk.from_rows([_S, _I, _S], rows))
+
+    if stmt.kind == "errors":
+        return Result(names=["Level", "Code", "Message"],
+                      chunk=Chunk.from_rows([_S, _I, _S], []))
+
+    if stmt.kind == "engines":
+        rows = [(b"tpu-htap", b"DEFAULT",
+                 b"TPU-native HTAP storage engine", b"YES", b"YES", b"YES")]
+        return Result(names=["Engine", "Support", "Comment", "Transactions",
+                             "XA", "Savepoints"],
+                      chunk=Chunk.from_rows([_S] * 6, rows))
+
+    if stmt.kind == "charset":
+        rows = [(b"utf8mb4", b"UTF-8 Unicode", b"utf8mb4_bin", 4)]
+        return Result(names=["Charset", "Description", "Default collation",
+                             "Maxlen"],
+                      chunk=Chunk.from_rows([_S, _S, _S, _I], rows))
+
+    if stmt.kind == "collation":
+        rows = [(b"utf8mb4_bin", b"utf8mb4", 46, b"Yes", b"Yes", 1),
+                (b"binary", b"binary", 63, b"Yes", b"Yes", 1)]
+        return Result(names=["Collation", "Charset", "Id", "Default",
+                             "Compiled", "Sortlen"],
+                      chunk=Chunk.from_rows([_S, _S, _I, _S, _S, _I], rows))
+
+    if stmt.kind == "processlist":
+        rows = [(session.conn_id, session.user.encode(), b"localhost",
+                 session.current_db().encode(), b"Query", 0, b"", b"")]
+        return Result(names=["Id", "User", "Host", "db", "Command", "Time",
+                             "State", "Info"],
+                      chunk=Chunk.from_rows([_I, _S, _S, _S, _S, _I, _S, _S],
+                                            rows))
+
+    if stmt.kind == "grants":
+        rows = [(b"GRANT ALL PRIVILEGES ON *.* TO 'root'@'%'",)]
+        return Result(names=["Grants for root@%"],
+                      chunk=Chunk.from_rows([_S], rows))
+
+    if stmt.kind == "table_status":
+        db = stmt.db or session.current_db()
+        infos = session.infoschema()
+        rows = []
+        for t in infos.tables_in_schema(db):
+            rows.append((t.name.encode(), b"tpu-htap", 10, b"Fixed"))
+        return Result(names=["Name", "Engine", "Version", "Row_format"],
+                      chunk=Chunk.from_rows([_S, _S, _I, _S], rows))
+
+    if stmt.kind == "create_database":
+        name = stmt.db
+        return Result(names=["Database", "Create Database"],
+                      chunk=Chunk.from_rows([_S, _S],
+                                            [(name.encode(),
+                                              f"CREATE DATABASE `{name}`".encode())]))
+
+    raise TiDBError(f"unsupported SHOW {stmt.kind}")
+
+
+def render_create_table(info) -> str:
+    """reference: executor/show.go ConstructResultOfShowCreateTable."""
+    lines = []
+    for c in info.public_columns():
+        l = f"  `{c.name}` {c.ftype.sql_string()}"
+        if c.ftype.not_null:
+            l += " NOT NULL"
+        if c.has_default and c.default_value is not None:
+            from ..sqltypes import format_value, STRING_TYPES
+            v = format_value(c.default_value, c.ftype)
+            if c.ftype.tp in STRING_TYPES or not str(v).lstrip("-").isdigit():
+                l += f" DEFAULT '{v}'"
+            else:
+                l += f" DEFAULT {v}"
+        if info.pk_is_handle and c.id == info.pk_col_id:
+            pass
+        lines.append(l)
+    if info.pk_is_handle:
+        pkname = next((c.name for c in info.columns if c.id == info.pk_col_id), None)
+        if pkname:
+            lines.append(f"  PRIMARY KEY (`{pkname}`)")
+    for idx in info.indexes:
+        cols = ", ".join(f"`{ic.name}`" for ic in idx.columns)
+        if idx.primary:
+            lines.append(f"  PRIMARY KEY ({cols})")
+        elif idx.unique:
+            lines.append(f"  UNIQUE KEY `{idx.name}` ({cols})")
+        else:
+            lines.append(f"  KEY `{idx.name}` ({cols})")
+    body = ",\n".join(lines)
+    return (f"CREATE TABLE `{info.name}` (\n{body}\n) "
+            "ENGINE=tpu-htap DEFAULT CHARSET=utf8mb4")

@@ -1,0 +1,108 @@
+"""System variable registry (reference: sessionctx/variable/sysvar.go — 248
+registered variables; the registry pattern is kept, population grows with the
+engine)."""
+
+from __future__ import annotations
+
+from ..errors import TiDBError, ErrCode
+
+SCOPE_NONE = 0
+SCOPE_SESSION = 1
+SCOPE_GLOBAL = 2
+SCOPE_BOTH = 3
+
+
+class SysVar:
+    __slots__ = ("name", "scope", "default", "kind", "min", "max", "choices")
+
+    def __init__(self, name, scope=SCOPE_BOTH, default="", kind="str",
+                 vmin=None, vmax=None, choices=None):
+        self.name = name
+        self.scope = scope
+        self.default = default
+        self.kind = kind  # str | int | bool | enum | float
+        self.min = vmin
+        self.max = vmax
+        self.choices = choices
+
+    def validate(self, value):
+        v = value.decode() if isinstance(value, bytes) else str(value)
+        if self.kind == "bool":
+            u = v.upper()
+            if u in ("ON", "1", "TRUE"):
+                return "ON"
+            if u in ("OFF", "0", "FALSE"):
+                return "OFF"
+            raise TiDBError(f"Variable '{self.name}' can't be set to the value of '{v}'")
+        if self.kind == "int":
+            try:
+                i = int(v)
+            except ValueError:
+                raise TiDBError(f"Incorrect argument type to variable '{self.name}'")
+            if self.min is not None and i < self.min:
+                i = self.min
+            if self.max is not None and i > self.max:
+                i = self.max
+            return str(i)
+        if self.kind == "enum":
+            if self.choices and v.lower() not in self.choices:
+                raise TiDBError(f"Variable '{self.name}' can't be set to the value of '{v}'")
+            return v
+        return v
+
+
+_REGISTRY: dict[str, SysVar] = {}
+
+
+def register(var: SysVar):
+    _REGISTRY[var.name] = var
+
+
+def get_registry():
+    return _REGISTRY
+
+
+for _v in [
+    SysVar("autocommit", SCOPE_BOTH, "ON", "bool"),
+    SysVar("sql_mode", SCOPE_BOTH, "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES,"
+           "NO_ZERO_IN_DATE,NO_ZERO_DATE,ERROR_FOR_DIVISION_BY_ZERO,"
+           "NO_ENGINE_SUBSTITUTION"),
+    SysVar("max_execution_time", SCOPE_BOTH, "0", "int", 0),
+    SysVar("max_allowed_packet", SCOPE_BOTH, "67108864", "int", 1024),
+    SysVar("time_zone", SCOPE_BOTH, "SYSTEM"),
+    SysVar("tx_isolation", SCOPE_BOTH, "REPEATABLE-READ"),
+    SysVar("transaction_isolation", SCOPE_BOTH, "REPEATABLE-READ"),
+    SysVar("transaction_read_only", SCOPE_BOTH, "0", "bool"),
+    SysVar("character_set_client", SCOPE_BOTH, "utf8mb4"),
+    SysVar("character_set_connection", SCOPE_BOTH, "utf8mb4"),
+    SysVar("character_set_results", SCOPE_BOTH, "utf8mb4"),
+    SysVar("collation_connection", SCOPE_BOTH, "utf8mb4_bin"),
+    SysVar("names", SCOPE_SESSION, "utf8mb4"),
+    SysVar("wait_timeout", SCOPE_BOTH, "28800", "int", 0),
+    SysVar("interactive_timeout", SCOPE_BOTH, "28800", "int", 1),
+    SysVar("max_connections", SCOPE_GLOBAL, "0", "int", 0, 100000),
+    SysVar("version_comment", SCOPE_NONE, "tpu-htap"),
+    SysVar("port", SCOPE_NONE, "4000", "int"),
+    SysVar("socket", SCOPE_NONE, ""),
+    SysVar("datadir", SCOPE_NONE, "/tmp/tpu-htap"),
+    SysVar("last_insert_id", SCOPE_SESSION, "0", "int"),
+    SysVar("hostname", SCOPE_NONE, "localhost"),
+    # engine knobs (the tidb_* namespace of the reference)
+    SysVar("tidb_executor_engine", SCOPE_BOTH, "auto", "enum",
+           choices=("auto", "host", "tpu")),
+    SysVar("tidb_mem_quota_query", SCOPE_BOTH, str(1 << 30), "int", 0),
+    SysVar("tidb_max_chunk_size", SCOPE_BOTH, "65536", "int", 32),
+    SysVar("tidb_snapshot_isolation", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_build_stats_concurrency", SCOPE_BOTH, "4", "int", 1),
+    SysVar("tidb_distsql_scan_concurrency", SCOPE_BOTH, "15", "int", 1),
+    SysVar("tidb_executor_concurrency", SCOPE_BOTH, "5", "int", 1),
+    SysVar("tidb_txn_mode", SCOPE_BOTH, "pessimistic", "enum",
+           choices=("pessimistic", "optimistic")),
+    SysVar("tidb_retry_limit", SCOPE_BOTH, "10", "int", 0),
+    SysVar("tidb_enable_window_function", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_topn_push_down", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_mesh_shape", SCOPE_BOTH, "1", "str"),
+    SysVar("tidb_slow_log_threshold", SCOPE_BOTH, "300", "int", 0),
+    SysVar("tidb_record_plan_in_slow_log", SCOPE_BOTH, "ON", "bool"),
+]:
+    register(_v)

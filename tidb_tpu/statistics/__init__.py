@@ -1,0 +1,7 @@
+"""CBO statistics (reference: statistics/ — Histogram, CMSketch, TopN,
+FMSketch + handle). Round-1: row counts, per-column NDV/min/max/null counts
+persisted to meta; equal-depth histograms land with the cost model."""
+
+from .analyze import analyze_table
+
+__all__ = ["analyze_table"]

@@ -1,0 +1,35 @@
+"""ANALYZE TABLE (reference: executor/analyze.go + statistics/builder.go)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..meta import Meta
+
+
+def analyze_table(session, info):
+    entry = session.columnar_cache().get(info, session.store.begin())
+    stats = {"row_count": int(entry.nrows), "columns": {}}
+    for col_id, col in entry.columns.items():
+        nn = ~col.nulls
+        data = col.data[nn]
+        cs = {"null_count": int(col.nulls.sum())}
+        if len(data):
+            uniques = np.unique(data)
+            cs["ndv"] = int(len(uniques))
+            if data.dtype != object:
+                cs["min"] = float(data.min())
+                cs["max"] = float(data.max())
+        else:
+            cs["ndv"] = 0
+        stats["columns"][str(col_id)] = cs
+    txn = session.store.begin()
+    try:
+        m = Meta(txn)
+        m.set_stats(info.id, stats)
+        txn.commit()
+    except Exception:
+        txn.rollback()
+        raise
+    session.domain.stats[info.id] = stats
+    return stats

@@ -1,0 +1,7 @@
+"""Columnar storage layer on top of the row KV store — the TiFlash-replica
+role (reference: MPP reads columnar replicas; here a per-table columnar cache
+materialized from the MVCC row store and invalidated by write watermarks)."""
+
+from .columnar import ColumnarCache
+
+__all__ = ["ColumnarCache"]

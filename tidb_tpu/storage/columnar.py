@@ -1,0 +1,107 @@
+"""Per-table columnar snapshots.
+
+Scans are the hot read path of the analytical engine; decoding rows per query
+would drown the device in host work. The cache materializes a table once per
+write-watermark into column arrays (plus the handle column), and serves
+projections by column id. Bulk loaders (the Lightning role) can install
+columns directly, bypassing row encode/decode entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..model import TableInfo
+from ..sqltypes import TYPE_LONGLONG, FieldType
+from ..table import Table, rows_to_chunk
+from ..utils.chunk import Chunk, Column
+
+
+class _Entry:
+    __slots__ = ("version", "col_sig", "columns", "handles", "nrows")
+
+    def __init__(self, version, col_sig, columns, handles, nrows):
+        self.version = version
+        self.col_sig = col_sig
+        self.columns = columns  # {col_id: Column}
+        self.handles = handles  # np.int64 array
+        self.nrows = nrows
+
+
+class ColumnarCache:
+    def __init__(self, storage):
+        self.storage = storage
+        self._lock = threading.Lock()
+        self._entries: dict[int, _Entry] = {}
+
+    def invalidate(self, table_id: int):
+        with self._lock:
+            self._entries.pop(table_id, None)
+
+    def get(self, info: TableInfo, snapshot) -> _Entry:
+        """Materialized columns for the table at the current write watermark.
+        `snapshot` must be a kv view with .scan (Snapshot or Transaction)."""
+        tid = info.id
+        version = self.storage.mvcc.table_version(tid)
+        col_sig = tuple(c.id for c in info.public_columns())
+        with self._lock:
+            e = self._entries.get(tid)
+            if e is not None and e.version == version and e.col_sig == col_sig:
+                return e
+        e = self._build(info, snapshot, version, col_sig)
+        with self._lock:
+            self._entries[tid] = e
+        return e
+
+    def _build(self, info, snapshot, version, col_sig):
+        tbl = Table(info, snapshot)
+        cols = info.public_columns()
+        handles = []
+        rowdicts = []
+        for handle, row in tbl.iter_rows():
+            handles.append(handle)
+            rowdicts.append(row)
+        chunk = rows_to_chunk(info, cols, handles, rowdicts)
+        columns = {c.id: chunk.columns[i] for i, c in enumerate(cols)}
+        return _Entry(version, col_sig, columns,
+                      np.array(handles, dtype=np.int64), len(handles))
+
+    def install_bulk(self, info: TableInfo, columns: dict, handles: np.ndarray):
+        """Bulk-load path (the Lightning physical-import role): install
+        column arrays directly and mark the table version as current."""
+        tid = info.id
+        version = self.storage.mvcc.table_version(tid)
+        col_sig = tuple(c.id for c in info.public_columns())
+        e = _Entry(version, col_sig, columns, handles, len(handles))
+        with self._lock:
+            self._entries[tid] = e
+        return e
+
+    def project(self, entry: _Entry, col_infos, info: TableInfo) -> Chunk:
+        out = []
+        for c in col_infos:
+            col = entry.columns.get(c.id)
+            if col is None:
+                # column added after materialization: all default/null
+                from ..utils.chunk import np_dtype_for
+                dt = np_dtype_for(c.ftype)
+                n = entry.nrows
+                if c.default_value is not None:
+                    if dt is object:
+                        data = np.full(n, c.default_value, dtype=object)
+                    else:
+                        data = np.full(n, c.default_value, dtype=dt)
+                    nulls = np.zeros(n, dtype=bool)
+                else:
+                    data = (np.full(n, b"", dtype=object) if dt is object
+                            else np.zeros(n, dtype=dt))
+                    nulls = np.ones(n, dtype=bool)
+                col = Column(c.ftype, data, nulls)
+            out.append(col)
+        return Chunk(out)
+
+    def handle_column(self, entry: _Entry) -> Column:
+        return Column(FieldType(tp=TYPE_LONGLONG),
+                      entry.handles, np.zeros(entry.nrows, dtype=bool))

@@ -1,0 +1,336 @@
+"""Row-level table abstraction over KV (reference: table/tables/tables.go —
+AddRecord :643, UpdateRecord :331, RemoveRecord :1057; index maintenance in
+table/tables/index.go).
+
+Also home of the *columnar read path*: ``scan_columnar`` materializes a whole
+table (or a key range) into a Chunk, which is what feeds device kernels. The
+per-row KV codec is the transactional source of truth; the columnar cache on
+top (storage layer) is the TiFlash-replica analog.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import tablecodec
+from .errors import DupEntryError, TiDBError
+from .model import SchemaState, TableInfo
+from .sqltypes import (
+    FLAG_UNSIGNED, INT_RANGES, INT_TYPES, STRING_TYPES,
+    TYPE_DATE, TYPE_DATETIME, TYPE_DOUBLE, TYPE_DURATION, TYPE_FLOAT,
+    TYPE_JSON, TYPE_LONGLONG, TYPE_NEWDATE, TYPE_NEWDECIMAL, TYPE_TIMESTAMP,
+    FieldType, parse_date_str, parse_datetime_str, str_to_decimal, dec_rescale,
+)
+from .errors import OutOfRangeError, TypeError_
+from .utils.chunk import Chunk, Column, np_dtype_for
+
+
+def cast_value(v, ft: FieldType, truncate_as_error: bool = True):
+    """Convert a parser/protocol value into the internal representation for
+    column type `ft` (reference: table/column.go CastValue + types/convert.go).
+    """
+    if v is None:
+        return None
+    tp = ft.tp
+    if tp in INT_TYPES:
+        if isinstance(v, bool):
+            v = int(v)
+        elif isinstance(v, (bytes, str)):
+            s = v.decode() if isinstance(v, bytes) else v
+            try:
+                v = int(float(s)) if ("." in s or "e" in s.lower()) else int(s)
+            except ValueError:
+                if truncate_as_error:
+                    raise TypeError_(f"Truncated incorrect INTEGER value: '{s}'")
+                v = 0
+        elif isinstance(v, float):
+            v = int(round(v))
+        else:
+            v = int(v)
+        lo, hi, uhi = INT_RANGES.get(tp, INT_RANGES[TYPE_LONGLONG])
+        if ft.flag & FLAG_UNSIGNED:
+            if v < 0 or v > uhi:
+                raise OutOfRangeError(f"Out of range value for column")
+        elif v < lo or v > hi:
+            raise OutOfRangeError(f"Out of range value for column")
+        return v
+    if tp == TYPE_NEWDECIMAL:
+        scale = ft.scale
+        if isinstance(v, (bytes, str)):
+            s = v.decode() if isinstance(v, bytes) else v
+            try:
+                return str_to_decimal(s, scale)
+            except ValueError:
+                raise TypeError_(f"Truncated incorrect DECIMAL value: '{s}'")
+        if isinstance(v, float):
+            return str_to_decimal(repr(v), scale)
+        if isinstance(v, tuple) and len(v) == 2:  # (scaled, scale) internal
+            return dec_rescale(v[0], v[1], scale)
+        return int(v) * 10 ** scale
+    if tp in (TYPE_FLOAT, TYPE_DOUBLE):
+        if isinstance(v, (bytes, str)):
+            s = v.decode() if isinstance(v, bytes) else v
+            try:
+                return float(s)
+            except ValueError:
+                raise TypeError_(f"Truncated incorrect DOUBLE value: '{s}'")
+        return float(v)
+    if tp in (TYPE_DATE, TYPE_NEWDATE):
+        if isinstance(v, (bytes, str)):
+            s = v.decode() if isinstance(v, bytes) else v
+            try:
+                return parse_date_str(s)
+            except ValueError:
+                raise TypeError_(f"Incorrect DATE value: '{s}'")
+        return int(v)
+    if tp in (TYPE_DATETIME, TYPE_TIMESTAMP):
+        if isinstance(v, (bytes, str)):
+            s = v.decode() if isinstance(v, bytes) else v
+            try:
+                return parse_datetime_str(s)
+            except ValueError:
+                raise TypeError_(f"Incorrect DATETIME value: '{s}'")
+        return int(v)
+    if tp == TYPE_DURATION:
+        if isinstance(v, (bytes, str)):
+            s = v.decode() if isinstance(v, bytes) else v
+            neg = s.startswith("-")
+            if neg:
+                s = s[1:]
+            parts = s.split(":")
+            frac = 0
+            if "." in parts[-1]:
+                parts[-1], fs = parts[-1].split(".")
+                frac = int((fs + "000000")[:6])
+            parts = [int(p) for p in parts]
+            while len(parts) < 3:
+                parts.insert(0, 0)
+            us = (parts[0] * 3600 + parts[1] * 60 + parts[2]) * 1_000_000 + frac
+            return -us if neg else us
+        return int(v)
+    if tp in STRING_TYPES or tp == TYPE_JSON:
+        if isinstance(v, str):
+            b = v.encode("utf-8")
+        elif isinstance(v, (bytes, bytearray)):
+            b = bytes(v)
+        else:
+            b = str(v).encode()
+        if ft.flen not in (None, -1) and tp != TYPE_JSON and len(b) > max(ft.flen * 4, ft.flen):
+            # flen is chars; utf8 up to 4B/char — cheap conservative check
+            if len(b.decode("utf-8", "ignore")) > ft.flen:
+                raise TypeError_(f"Data too long for column")
+        return b
+    return v
+
+
+def convert_internal(v, src_ft: FieldType, dst_ft: FieldType):
+    """Convert an *internal* value (scaled decimal, day/micros ints) from one
+    field type to another — used when expression results flow into columns
+    (INSERT ... SELECT, UPDATE SET, reference: types/convert.go)."""
+    if v is None:
+        return None
+    from .expression.core import phys_kind, K_DEC, K_DATE
+    from .sqltypes import decimal_to_str
+    sk = phys_kind(src_ft)
+    if sk == K_DEC:
+        if dst_ft.tp == TYPE_NEWDECIMAL:
+            return dec_rescale(int(v), src_ft.scale, dst_ft.scale)
+        return cast_value(decimal_to_str(int(v), src_ft.scale), dst_ft)
+    src_dt = src_ft.tp in (TYPE_DATETIME, TYPE_TIMESTAMP)
+    dst_dt = dst_ft.tp in (TYPE_DATETIME, TYPE_TIMESTAMP)
+    src_d = src_ft.tp in (TYPE_DATE, TYPE_NEWDATE)
+    dst_d = dst_ft.tp in (TYPE_DATE, TYPE_NEWDATE)
+    if src_d and dst_dt:
+        return int(v) * 86_400_000_000
+    if src_dt and dst_d:
+        return int(v) // 86_400_000_000
+    if (src_d or src_dt) and not (dst_d or dst_dt):
+        from .sqltypes import format_value
+        return cast_value(format_value(int(v), src_ft), dst_ft)
+    return cast_value(v, dst_ft)
+
+
+class Table:
+    """Bound (TableInfo, txn) row operations."""
+
+    def __init__(self, info: TableInfo, txn):
+        self.info = info
+        self.txn = txn
+
+    # -- write path ---------------------------------------------------------
+
+    def add_record(self, row: dict, handle: int, check_dup: bool = True):
+        """row: {col_id: internal value}. Writes record + all index entries
+        into the txn membuffer (reference: tables.go:643 AddRecord)."""
+        info = self.info
+        key = tablecodec.record_key(info.id, handle)
+        if check_dup and info.pk_is_handle:
+            if self.txn.get(key) is not None:
+                raise DupEntryError(
+                    f"Duplicate entry '{handle}' for key 'PRIMARY'")
+        col_ids = [c.id for c in info.columns if c.state >= SchemaState.WRITE_ONLY and c.id in row]
+        values = [row.get(cid) for cid in col_ids]
+        self.txn.put(key, tablecodec.encode_row(col_ids, values))
+        for idx in info.indexes:
+            # delete-only / none-state indexes take deletes but not inserts
+            # (F1 state machine, reference: ddl/index.go:519-541)
+            if idx.state <= SchemaState.DELETE_ONLY:
+                continue
+            self._index_put(idx, row, handle, check_dup)
+        self.txn.touched_tables.add(info.id)
+
+    def _index_values(self, idx, row):
+        vals = []
+        for ic in idx.columns:
+            col = self.info.columns[ic.offset]
+            v = row.get(col.id)
+            if isinstance(v, (bytes, bytearray)) and ic.length > 0:
+                v = bytes(v)[:ic.length]
+            vals.append(v)
+        return vals
+
+    def _index_put(self, idx, row, handle, check_dup=True):
+        vals = self._index_values(idx, row)
+        if idx.unique and not any(v is None for v in vals):
+            key = tablecodec.index_key(self.info.id, idx.id, vals)
+            existing = self.txn.get(key)
+            if existing is not None and check_dup:
+                raise DupEntryError(
+                    "Duplicate entry '%s' for key '%s'" % (
+                        "-".join(_dup_str(v) for v in vals), idx.name))
+            self.txn.put(key, str(handle).encode())
+        else:
+            key = tablecodec.index_key(self.info.id, idx.id, vals, handle=handle)
+            self.txn.put(key, b"0")
+
+    def _index_delete(self, idx, row, handle):
+        vals = self._index_values(idx, row)
+        if idx.unique and not any(v is None for v in vals):
+            key = tablecodec.index_key(self.info.id, idx.id, vals)
+        else:
+            key = tablecodec.index_key(self.info.id, idx.id, vals, handle=handle)
+        self.txn.delete(key)
+
+    def remove_record(self, row: dict, handle: int):
+        self.txn.delete(tablecodec.record_key(self.info.id, handle))
+        for idx in self.info.indexes:
+            if idx.state >= SchemaState.DELETE_ONLY:
+                self._index_delete(idx, row, handle)
+        self.txn.touched_tables.add(self.info.id)
+
+    def update_record(self, old_row: dict, new_row: dict, handle: int):
+        info = self.info
+        col_ids = [c.id for c in info.columns if c.state >= SchemaState.WRITE_ONLY and c.id in new_row]
+        values = [new_row.get(cid) for cid in col_ids]
+        self.txn.put(tablecodec.record_key(info.id, handle),
+                     tablecodec.encode_row(col_ids, values))
+        for idx in info.indexes:
+            if idx.state < SchemaState.DELETE_ONLY:
+                continue
+            old_vals = self._index_values(idx, old_row)
+            new_vals = self._index_values(idx, new_row)
+            if old_vals != new_vals:
+                self._index_delete(idx, old_row, handle)
+                if idx.state > SchemaState.DELETE_ONLY:
+                    self._index_put(idx, new_row, handle)
+        self.txn.touched_tables.add(info.id)
+
+    # -- read path ----------------------------------------------------------
+
+    def get_row(self, handle: int):
+        data = self.txn.get(tablecodec.record_key(self.info.id, handle))
+        if data is None:
+            return None
+        return tablecodec.decode_row(data)
+
+    def iter_rows(self):
+        """-> iterator of (handle, {col_id: value})."""
+        start, end = tablecodec.table_range(self.info.id)
+        for key, value in self.txn.scan(start, end):
+            _tid, handle = tablecodec.decode_record_key(key)
+            yield handle, tablecodec.decode_row(value)
+
+    def index_lookup(self, idx, values):
+        """Unique-index point lookup -> handle or None."""
+        key = tablecodec.index_key(self.info.id, idx.id, values)
+        v = self.txn.get(key)
+        return int(v) if v is not None else None
+
+    def index_scan_handles(self, idx, lo_vals=None, hi_vals=None):
+        """Range scan on an index -> [handle] in index order."""
+        tid = self.info.id
+        start = (tablecodec.index_key(tid, idx.id, lo_vals)
+                 if lo_vals is not None else tablecodec.index_prefix(tid, idx.id))
+        if hi_vals is not None:
+            end = tablecodec.index_key(tid, idx.id, hi_vals) + b"\xff"
+        else:
+            end = tablecodec.index_prefix(tid, idx.id) + b"\xff" * 16
+        out = []
+        for key, value in self.txn.scan(start, end):
+            if idx.unique and value != b"0":
+                out.append(int(value))
+            else:
+                out.append(tablecodec.decode_index_values(key)[-1])
+        return out
+
+    def scan_columnar(self, col_infos=None, with_handle=False):
+        """Materialize visible rows into a Chunk (columnar assembly from the
+        row codec). col_infos: subset of ColumnInfo to project."""
+        info = self.info
+        cols = col_infos if col_infos is not None else info.public_columns()
+        handles = []
+        rowdicts = []
+        for handle, row in self.iter_rows():
+            handles.append(handle)
+            rowdicts.append(row)
+        return rows_to_chunk(info, cols, handles, rowdicts, with_handle)
+
+
+def rows_to_chunk(info: TableInfo, cols, handles, rowdicts, with_handle=False) -> Chunk:
+    n = len(rowdicts)
+    out = []
+    for c in cols:
+        dt = np_dtype_for(c.ftype)
+        nulls = np.zeros(n, dtype=bool)
+        # a column *absent* from a stored row (added by later DDL) takes the
+        # column's origin default; an explicit NULL is stored as None
+        default = c.default_value if c.has_default else None
+        if dt is object:
+            data = np.empty(n, dtype=object)
+            for i, rd in enumerate(rowdicts):
+                v = rd.get(c.id, _ABSENT)
+                if v is _ABSENT:
+                    v = default
+                if v is None:
+                    data[i] = b""
+                    nulls[i] = True
+                else:
+                    data[i] = v
+        else:
+            data = np.zeros(n, dtype=dt)
+            for i, rd in enumerate(rowdicts):
+                v = rd.get(c.id, _ABSENT)
+                if v is _ABSENT:
+                    v = default
+                if v is None:
+                    if info.pk_is_handle and c.id == info.pk_col_id:
+                        data[i] = handles[i]
+                    else:
+                        nulls[i] = True
+                else:
+                    data[i] = v
+        out.append(Column(c.ftype, data, nulls))
+    if with_handle:
+        ft = FieldType(tp=TYPE_LONGLONG)
+        out.append(Column(ft, np.array(handles, dtype=np.int64),
+                          np.zeros(n, dtype=bool)))
+    return Chunk(out)
+
+
+_ABSENT = object()
+
+
+def _dup_str(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return str(v)

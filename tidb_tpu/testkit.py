@@ -1,0 +1,56 @@
+"""TestKit — the reference's single most important testing idea
+(testkit/testkit.go:41): full-stack parse→plan→execute→MVCC tests against an
+embedded store, with MustExec / MustQuery().Check(...) assertions."""
+
+from __future__ import annotations
+
+from .session import Domain, bootstrap_domain, new_session
+
+
+class QueryResult:
+    def __init__(self, result):
+        self.result = result
+
+    @property
+    def rows(self):
+        return self.result.rows
+
+    def check(self, expected):
+        """expected: list of tuples of display strings (None for NULL)."""
+        got = [tuple(r) for r in self.result.rows]
+        exp = [tuple(r) for r in expected]
+        assert got == exp, f"\nexpected: {exp}\ngot:      {got}"
+
+    def check_unordered(self, expected):
+        got = sorted(map(tuple, self.result.rows), key=repr)
+        exp = sorted(map(tuple, expected), key=repr)
+        assert got == exp, f"\nexpected: {exp}\ngot:      {got}"
+
+    def sort(self):
+        self.result_rows = sorted(self.result.rows)
+        return self
+
+
+class TestKit:
+    def __init__(self, domain: Domain | None = None):
+        self.domain = domain or bootstrap_domain()
+        self.session = new_session(self.domain)
+
+    def must_exec(self, sql: str):
+        results = self.session.execute(sql)
+        return results[-1] if results else None
+
+    def must_query(self, sql: str) -> QueryResult:
+        results = self.session.execute(sql)
+        return QueryResult(results[-1])
+
+    def exec_error(self, sql: str) -> Exception:
+        try:
+            self.session.execute(sql)
+        except Exception as e:
+            return e
+        raise AssertionError(f"expected error for: {sql}")
+
+    def new_session(self) -> "TestKit":
+        """Second session over the same domain (multi-connection tests)."""
+        return TestKit(self.domain)

@@ -44,7 +44,8 @@ def np_dtype_for(ft: FieldType):
     if tp in STRING_TYPES or tp == TYPE_JSON:
         return object
     if tp == TYPE_NULL:
-        return object
+        # NULL literals: all-null int64 vector, coercible to any numeric kind
+        return np.int64
     return object
 
 
