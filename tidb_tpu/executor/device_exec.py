@@ -1,0 +1,257 @@
+"""Device (TPU) execution paths for the hot operators.
+
+The fused scan→filter→aggregate pipeline: when a HashAgg sits directly on a
+TableScan, the pushed-down filters, the aggregate input expressions and the
+grouping all compile into ONE jitted XLA program — the host only dict-encodes
+strings and reads back `capacity`-bounded results. This is the engine-side
+realization of the reference's coprocessor pushdown (the whole DAG executes
+storage-side there, device-side here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..errors import TiDBError
+from ..expression import phys_kind, K_DEC, K_FLOAT, K_STR, K_DATE
+from ..expression.core import Column as ExprColumn
+from ..ops import device as dev
+from ..ops.device import DeviceUnsupported
+from ..sqltypes import POW10
+from ..utils.chunk import Chunk, Column, np_dtype_for
+
+
+def engine_mode(ctx) -> str:
+    try:
+        return ctx.get_sysvar("tidb_executor_engine")
+    except Exception:
+        return "auto"
+
+
+def want_device(ctx, n_rows: int) -> bool:
+    mode = engine_mode(ctx)
+    if mode == "host":
+        return False
+    if mode == "tpu":
+        return True
+    return n_rows >= 65536  # auto: device dispatch overhead beneath this
+
+
+def device_agg(plan, chunk: Chunk, conds) -> Chunk:
+    """Fused filter+group+aggregate on device. Raises DeviceUnsupported to
+    trigger host fallback."""
+    n = chunk.num_rows
+    if n == 0:
+        raise DeviceUnsupported("empty input")
+    # device columns for everything referenced
+    used = set()
+    for e in plan.group_exprs:
+        e.columns_used(used)
+    for d in plan.aggs:
+        for a in d.args:
+            a.columns_used(used)
+    for c in conds:
+        c.columns_used(used)
+    dcols = {}
+    env = {}
+    for idx in used:
+        dc = dev.to_device_col(chunk.columns[idx])
+        dcols[idx] = dc
+        env[idx] = (dc.data, dc.nulls)
+    if not env:
+        raise DeviceUnsupported("no columns")
+
+    # filter mask
+    if conds:
+        mask = None
+        for c in conds:
+            f = dev.compile_expr(c, dcols)
+            d, nl = f(env)
+            m = (d != 0) & ~nl
+            mask = m if mask is None else (mask & m)
+    else:
+        mask = jnp.ones(n, dtype=bool)
+
+    # group keys: must evaluate to int-representable arrays
+    key_fns = []
+    key_meta = []  # (expr, dictionary or None)
+    for e in plan.group_exprs:
+        k = phys_kind(e.ftype)
+        if k == K_STR:
+            if not isinstance(e, ExprColumn):
+                raise DeviceUnsupported("string group key must be a column")
+            dc = dcols[e.idx]
+            key_meta.append((e, dc.dictionary))
+            key_fns.append(dev.compile_expr(e, dcols))
+        elif k == K_FLOAT:
+            raise DeviceUnsupported("float group keys")
+        else:
+            key_meta.append((e, None))
+            key_fns.append(dev.compile_expr(e, dcols))
+    key_cols = []
+    key_nulls = []
+    for f in key_fns:
+        d, nl = f(env)
+        key_cols.append(d.astype(jnp.int64))
+        key_nulls.append(nl)
+    if not key_cols:
+        # global aggregate: single group
+        key_cols = [jnp.zeros(n, dtype=jnp.int64)]
+        key_nulls = [jnp.zeros(n, dtype=bool)]
+
+    # aggregate value columns + op names; avg = sum + count pair
+    val_cols, val_nulls, agg_ops = [], [], []
+    slots = []  # per desc: ("plain", j) | ("avg", j_sum, j_cnt)
+    for desc in plan.aggs:
+        if desc.distinct:
+            raise DeviceUnsupported("distinct agg on device")
+        arg = desc.args[0] if desc.args else None
+        name = desc.name
+        if name == "count":
+            f = dev.compile_expr(arg, dcols)
+            d, nl = f(env)
+            val_cols.append(d.astype(jnp.int64))
+            val_nulls.append(nl)
+            agg_ops.append("count")
+            slots.append(("plain", len(val_cols) - 1))
+            continue
+        if name not in ("sum", "avg", "min", "max", "first_row"):
+            raise DeviceUnsupported(f"agg {name} on device")
+        k = phys_kind(arg.ftype)
+        if k == K_STR and name in ("min", "max", "first_row"):
+            if not isinstance(arg, ExprColumn):
+                raise DeviceUnsupported("string agg arg must be a column")
+            # dictionary from np.unique is sorted → code order == byte order
+            f = dev.compile_expr(arg, dcols)
+            d, nl = f(env)
+            val_cols.append(d.astype(jnp.int64))
+            val_nulls.append(nl)
+            agg_ops.append({"min": "min", "max": "max",
+                            "first_row": "first"}[name])
+            slots.append(("strcol", len(val_cols) - 1, arg.idx))
+            continue
+        if k == K_STR:
+            raise DeviceUnsupported("string sum/avg")
+        f = dev.compile_expr(arg, dcols)
+        d, nl = f(env)
+        is_float = d.dtype == jnp.float64
+        if name in ("min", "max", "first_row"):
+            val_cols.append(d)
+            val_nulls.append(nl)
+            agg_ops.append({"min": "min", "max": "max",
+                            "first_row": "first"}[name])
+            slots.append(("plain", len(val_cols) - 1))
+        elif name == "sum":
+            val_cols.append(d)
+            val_nulls.append(nl)
+            agg_ops.append("sum_f" if is_float else "sum_i")
+            slots.append(("plain", len(val_cols) - 1))
+        else:  # avg
+            val_cols.append(d)
+            val_nulls.append(nl)
+            agg_ops.append("sum_f" if is_float else "sum_i")
+            j_sum = len(val_cols) - 1
+            val_cols.append(d.astype(jnp.int64) if not is_float else d)
+            val_nulls.append(nl)
+            agg_ops.append("count")
+            slots.append(("avg", j_sum, len(val_cols) - 1))
+
+    est = _estimate_groups(plan, n)
+    capacity = dev.next_pow2(min(n, max(est, 16)))
+    while True:
+        out = dev._agg_kernel(tuple(key_cols), tuple(key_nulls),
+                              tuple(val_cols), tuple(val_nulls), mask,
+                              n_keys=len(key_cols), agg_ops=tuple(agg_ops),
+                              capacity=capacity)
+        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
+        ng = int(n_groups)
+        if ng <= capacity:
+            break
+        capacity = dev.next_pow2(ng)
+
+    # assemble host chunk
+    out_cols = []
+    for (e, dictionary), kd, kn in zip(key_meta, key_out, key_null_out):
+        kd = np.asarray(kd[:ng])
+        kn = np.asarray(kn[:ng])
+        if dictionary is not None:
+            data = np.empty(ng, dtype=object)
+            for i in range(ng):
+                data[i] = dictionary[kd[i]] if not kn[i] else b""
+            out_cols.append(Column(e.ftype, data, kn))
+        else:
+            dt = np_dtype_for(e.ftype)
+            out_cols.append(Column(e.ftype, kd.astype(dt), kn))
+    if not plan.group_exprs:
+        out_cols = []
+    for desc, slot in zip(plan.aggs, slots):
+        ft = desc.ftype
+        if slot[0] == "avg":
+            _tag, j_sum, j_cnt = slot
+            s = np.asarray(results[j_sum][:ng])
+            c = np.asarray(results[j_cnt][:ng])
+            nulls = np.asarray(result_nulls[j_sum][:ng])
+            if phys_kind(ft) == K_FLOAT:
+                vals = s / np.maximum(c, 1)
+                out_cols.append(Column(ft, vals, nulls))
+            else:
+                arg = desc.args[0]
+                s_arg = arg.ftype.scale if phys_kind(arg.ftype) == K_DEC else 0
+                shift = POW10[ft.scale - s_arg]
+                num = s.astype(object) * shift
+                den = np.maximum(c, 1).astype(object)
+                sign = np.where(num < 0, -1, 1)
+                q = (2 * np.abs(num) + den) // (2 * den)
+                vals = np.array([int(x) for x in sign * q], dtype=np.int64)
+                out_cols.append(Column(ft, vals, nulls))
+            continue
+        if slot[0] == "strcol":
+            _tag, j, col_idx = slot
+            codes = np.asarray(results[j][:ng])
+            nulls = np.asarray(result_nulls[j][:ng])
+            dictionary = dcols[col_idx].dictionary
+            data = np.empty(ng, dtype=object)
+            for i in range(ng):
+                data[i] = dictionary[codes[i]] if not nulls[i] else b""
+            out_cols.append(Column(ft, data, nulls))
+            continue
+        _tag, j = slot
+        vals = np.asarray(results[j][:ng])
+        nulls = np.asarray(result_nulls[j][:ng])
+        if desc.name == "count":
+            nulls = np.zeros(ng, dtype=bool)
+        dt = np_dtype_for(ft)
+        if dt is not object and vals.dtype != dt:
+            vals = vals.astype(dt)
+        out_cols.append(Column(ft, vals, nulls))
+    if not out_cols:
+        raise DeviceUnsupported("agg with no outputs")
+    return Chunk(out_cols)
+
+
+def _estimate_groups(plan, n):
+    est = 1
+    for e in plan.group_exprs:
+        est *= 64  # refined by stats-driven NDV once histograms land
+    return min(est if plan.group_exprs else 1, n)
+
+
+def device_join_keys(lkeys, rkeys):
+    """Combine multi-column join keys into single int64 codes host-side
+    (shared factorization), then match on device. Returns (li, ri)."""
+    nb = len(rkeys[0][0])
+    npr = len(lkeys[0][0])
+    from ..ops import host as hops
+    acc_b = np.zeros(nb, dtype=np.int64)
+    acc_p = np.zeros(npr, dtype=np.int64)
+    b_null = np.zeros(nb, dtype=bool)
+    p_null = np.zeros(npr, dtype=bool)
+    for (pd, pn), (bd, bn) in zip(lkeys, rkeys):
+        both = np.concatenate([bd, pd])
+        codes, card = hops.factorize_column(both, np.concatenate([bn, pn]))
+        acc_b = acc_b * np.int64(card + 1) + (codes[:nb] + 1)
+        acc_p = acc_p * np.int64(card + 1) + (codes[nb:] + 1)
+        b_null |= bn
+        p_null |= pn
+    return dev.device_join_match((acc_b, b_null), (acc_p, p_null))

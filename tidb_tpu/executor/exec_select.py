@@ -64,6 +64,19 @@ def eval_conds_mask(conds, chunk: Chunk) -> np.ndarray:
 
 
 class TableScanExec(QueryExecutor):
+    def execute_raw(self):
+        """-> (unfiltered chunk, pushed conds) for fused device pipelines."""
+        p = self.plan
+        txn = self.ctx.txn_for_read()
+        if self.ctx.txn_dirty(p.table_info.id):
+            from ..table import Table
+            tbl = Table(p.table_info, txn)
+            return tbl.scan_columnar(col_infos=p.col_infos), p.pushed_conds
+        entry = self.ctx.columnar_cache().get(p.table_info, txn)
+        return (self.ctx.columnar_cache().project(entry, p.col_infos,
+                                                  p.table_info),
+                p.pushed_conds)
+
     def execute(self):
         p = self.plan
         txn = self.ctx.txn_for_read()
@@ -124,7 +137,34 @@ class HashAggExec(QueryExecutor):
 
     def execute(self):
         p = self.plan
-        chunk = self.children[0].execute()
+        # fused device pipeline: HashAgg directly over a TableScan compiles
+        # scan-filter + grouping + aggregation into one XLA program
+        from .device_exec import want_device, device_agg, DeviceUnsupported
+        child = self.children[0]
+        conds = []
+        raw = None
+        if isinstance(child, TableScanExec):
+            raw, conds = child.execute_raw()
+        elif isinstance(child, SelectionExec) and isinstance(
+                child.children[0], TableScanExec):
+            raw, inner_conds = child.children[0].execute_raw()
+            conds = list(inner_conds) + list(child.plan.conds)
+        if raw is not None and want_device(self.ctx, raw.num_rows):
+            try:
+                return device_agg(p, raw, conds)
+            except DeviceUnsupported:
+                pass
+        if raw is not None:
+            # reuse the materialized chunk on the host path
+            chunk = raw
+            if conds:
+                chunk = chunk.filter(eval_conds_mask(conds, chunk))
+        else:
+            chunk = child.execute()
+        return self._execute_host(chunk)
+
+    def _execute_host(self, chunk):
+        p = self.plan
         n = chunk.num_rows
         group_cols = [e.eval(chunk) for e in p.group_exprs]
         if p.group_exprs:
@@ -299,13 +339,13 @@ class HashJoinExec(QueryExecutor):
         # right side, probe with the left (reference builds the smaller side;
         # side choice by size comes with the cost model)
         if p.kind == "inner":
-            li, ri = host.join_match(rkeys, lkeys)
+            li, ri = self._match(rkeys, lkeys)
             chunk = _combine(left, right, li, ri)
             if p.other_conds:
                 chunk = chunk.filter(eval_conds_mask(p.other_conds, chunk))
             return chunk
         if p.kind == "left":
-            li, ri = host.join_match(rkeys, lkeys)
+            li, ri = self._match(rkeys, lkeys)
             # li: left(probe) idx, ri: right(build) idx
             if p.other_conds:
                 cand = _combine(left, right, li, ri)
@@ -318,7 +358,7 @@ class HashJoinExec(QueryExecutor):
             chunk_u = _combine_left_nulls(left, right, un, p.right.schema)
             return concat_chunks([chunk_m, chunk_u])
         if p.kind in ("semi", "anti"):
-            li, ri = host.join_match(rkeys, lkeys)
+            li, ri = self._match(rkeys, lkeys)
             if p.other_conds:
                 cand = _combine(left, right, li, ri)
                 keep = eval_conds_mask(p.other_conds, cand)
@@ -329,6 +369,17 @@ class HashJoinExec(QueryExecutor):
                 mask = ~mask
             return left.filter(mask)
         raise TiDBError(f"unsupported join kind {p.kind}")
+
+    def _match(self, build_keys, probe_keys):
+        """Dispatch the match kernel to device or host by engine mode."""
+        from .device_exec import want_device, device_join_keys
+        n = max(len(build_keys[0][0]), len(probe_keys[0][0])) if build_keys else 0
+        if want_device(self.ctx, n):
+            try:
+                return device_join_keys(probe_keys, build_keys)
+            except Exception:
+                pass
+        return host.join_match(build_keys, probe_keys)
 
     def _coerce_key(self, expr, other, chunk):
         """Evaluate a join key, coercing decimals to a common scale with the

@@ -191,7 +191,7 @@ class ExprBuilder:
         m = getattr(self, "_b_" + type(node).__name__, None)
         if m is None:
             raise TiDBError(f"unsupported expression {type(node).__name__}")
-        return m(node)
+        return fold_constant(m(node))
 
     # -- leaves -------------------------------------------------------------
 
@@ -529,6 +529,28 @@ class ExprBuilder:
         if self.ctx is None or not hasattr(self.ctx, "eval_subquery"):
             raise TiDBError("subqueries not available in this context")
         return self.ctx.eval_subquery(select, limit_one=limit_one)
+
+
+_NONDETERMINISTIC = {"rand", "uuid", "sleep", "in_set"}
+
+
+def fold_constant(expr: Expression) -> Expression:
+    """Constant folding (reference: expression/constant_fold.go): a scalar
+    function whose args are all constants evaluates once at build time —
+    also what lets date arithmetic reach device kernels as scalars."""
+    if not isinstance(expr, ScalarFunc) or expr.op in _NONDETERMINISTIC:
+        return expr
+    if not expr.args or not all(isinstance(a, Constant) for a in expr.args):
+        return expr
+    try:
+        v = expr.eval_scalar()
+    except Exception:
+        return expr
+    if v is None:
+        c = const_null()
+        c.ftype = expr.ftype.clone()
+        return c
+    return Constant(v, expr.ftype.clone())
 
 
 def build_in_set(target: Expression, values, values_ft: FieldType = None) -> ScalarFunc:

@@ -1,0 +1,702 @@
+"""JAX/XLA device kernels — the TPU execution path.
+
+Design (SURVEY.md §7 step 6 + hard parts):
+- **Static shapes**: aggregation uses sort + segment_sum with a padded
+  group capacity; joins are two-pass (count on device, host reads the total,
+  expansion kernel with a static output size). This is the standard answer
+  to XLA's no-dynamic-shapes rule.
+- **Fusion**: a whole scan→filter→project→aggregate pipeline compiles into
+  ONE jitted program, so lineitem never round-trips to the host between
+  operators (the coprocessor-pushdown boundary of the reference becomes the
+  host↔device boundary).
+- **Exactness**: decimals stay scaled int64 end-to-end (x64 enabled);
+  sums are exact; decimal division uses round-half-away integer math.
+- **Strings**: dictionary codes (int32) computed host-side; equality /
+  IN constants are translated to codes before tracing.
+
+reference parity: executor/aggregate.go (hash agg) → sort-based segment
+aggregation; executor/join.go + hash_table.go → sort + searchsorted join;
+expression/*_vec.go → compile_expr tracing numpy-identical semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import TiDBError
+from ..expression.core import (
+    Column as ExprColumn, Constant, ScalarFunc, phys_kind,
+    K_DATE, K_DEC, K_FLOAT, K_INT, K_STR,
+)
+from ..sqltypes import POW10, TYPE_DATETIME, TYPE_TIMESTAMP
+
+
+class DeviceUnsupported(TiDBError):
+    """Raised during compilation when an expression/type can't run on
+    device; the executor falls back to the host kernels."""
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return max(p, 8)
+
+
+# ---------------------------------------------------------------------------
+# column transfer
+# ---------------------------------------------------------------------------
+
+class DeviceCol:
+    """Device representation of one column: data + null mask (+ dictionary
+    for strings; data holds int32 codes)."""
+
+    __slots__ = ("data", "nulls", "dictionary", "ftype")
+
+    def __init__(self, data, nulls, ftype, dictionary=None):
+        self.data = data
+        self.nulls = nulls
+        self.ftype = ftype
+        self.dictionary = dictionary
+
+
+def to_device_col(col) -> DeviceCol:
+    """utils.chunk.Column → DeviceCol. Strings are dict-encoded host-side."""
+    if col.data.dtype == object:
+        codes, uniq = col.dict_encode()
+        return DeviceCol(jnp.asarray(codes), jnp.asarray(col.nulls),
+                         col.ftype, dictionary=uniq)
+    return DeviceCol(jnp.asarray(col.data), jnp.asarray(col.nulls), col.ftype)
+
+
+# ---------------------------------------------------------------------------
+# expression → jax compiler
+# ---------------------------------------------------------------------------
+
+def compile_expr(expr, cols: dict):
+    """Build a traceable fn(env) -> (data, nulls) where env maps column idx
+    → (jnp data, jnp nulls). `cols` maps idx → DeviceCol (for dictionaries
+    and dtypes at compile time). Raises DeviceUnsupported when out of scope."""
+    if isinstance(expr, ExprColumn):
+        idx = expr.idx
+
+        def f(env):
+            return env[idx]
+        return f
+    if isinstance(expr, Constant):
+        return _compile_const(expr, cols)
+    if isinstance(expr, ScalarFunc):
+        return _compile_func(expr, cols)
+    raise DeviceUnsupported(f"cannot compile {type(expr).__name__} for device")
+
+
+def _compile_const(expr: Constant, cols):
+    v = expr.value
+    if v is None:
+        def f(env):
+            n = _env_n(env)
+            return jnp.zeros(n, dtype=jnp.int64), jnp.ones(n, dtype=bool)
+        return f
+    k = phys_kind(expr.ftype)
+    if k == K_STR:
+        raise DeviceUnsupported("bare string constants only valid in eq/in")
+    if k == K_FLOAT:
+        val = float(v)
+        dt = jnp.float64
+    else:
+        val = int(v)
+        dt = jnp.int64 if k != K_DATE else jnp.int32
+
+    def f(env):
+        n = _env_n(env)
+        return jnp.full(n, val, dtype=dt), jnp.zeros(n, dtype=bool)
+    return f
+
+
+def _env_n(env):
+    for d, _ in env.values():
+        return d.shape[0]
+    raise DeviceUnsupported("constant expression with no input columns")
+
+
+def _dec_scale(e):
+    return e.ftype.scale if phys_kind(e.ftype) == K_DEC else 0
+
+
+def _to_common_numeric(sf, cols):
+    """Compile both args of a binary numeric op to a common kind.
+    Returns (kind, fa, fb, scale)."""
+    a, b = sf.args
+    ka, kb = phys_kind(a.ftype), phys_kind(b.ftype)
+    fa = compile_expr(a, cols)
+    fb = compile_expr(b, cols)
+    # string equality via dictionary codes
+    if ka == K_STR or kb == K_STR:
+        raise DeviceUnsupported("string args only supported in eq/in paths")
+    if K_FLOAT in (ka, kb):
+        def wrap(f, e):
+            sc = _dec_scale(e)
+
+            def g(env):
+                d, n = f(env)
+                d = d.astype(jnp.float64)
+                if sc:
+                    d = d / POW10[sc]
+                return d, n
+            return g
+        return K_FLOAT, wrap(fa, a), wrap(fb, b), 0
+    if K_DEC in (ka, kb):
+        s = max(_dec_scale(a), _dec_scale(b))
+
+        def wrap(f, e):
+            sc = _dec_scale(e)
+
+            def g(env):
+                d, n = f(env)
+                d = d.astype(jnp.int64)
+                if s > sc:
+                    d = d * POW10[s - sc]
+                return d, n
+            return g
+        return K_DEC, wrap(fa, a), wrap(fb, b), s
+    # ints / dates / datetimes
+    promote_a = ka == K_DATE and b.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP)
+    promote_b = kb == K_DATE and a.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP)
+
+    def wrap(f, promote):
+        def g(env):
+            d, n = f(env)
+            d = d.astype(jnp.int64)
+            if promote:
+                d = d * 86_400_000_000
+            return d, n
+        return g
+    return K_INT, wrap(fa, promote_a), wrap(fb, promote_b), 0
+
+
+_CMP_OPS = {"eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+            "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+            "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b}
+
+
+def _compile_func(sf: ScalarFunc, cols):
+    op = sf.op
+    if op in _CMP_OPS:
+        # string vs constant → dictionary code comparison (eq/ne only)
+        a, b = sf.args
+        if phys_kind(a.ftype) == K_STR or phys_kind(b.ftype) == K_STR:
+            return _compile_str_cmp(sf, cols)
+        kind, fa, fb, _s = _to_common_numeric(sf, cols)
+        cmp = _CMP_OPS[op]
+
+        def f(env):
+            da, na = fa(env)
+            db, nb = fb(env)
+            return cmp(da, db).astype(jnp.int64), na | nb
+        return f
+    if op in ("add", "sub", "mul"):
+        out_k = phys_kind(sf.ftype)
+        if out_k == K_DEC and op == "mul":
+            fa = _compile_scaled(sf.args[0], cols, _dec_scale(sf.args[0]))
+            fb = _compile_scaled(sf.args[1], cols, _dec_scale(sf.args[1]))
+
+            def f(env):
+                da, na = fa(env)
+                db, nb = fb(env)
+                return da * db, na | nb
+            return f
+        if out_k == K_DEC:
+            s = sf.ftype.scale
+            fa = _compile_scaled(sf.args[0], cols, s)
+            fb = _compile_scaled(sf.args[1], cols, s)
+            fn = jnp.add if op == "add" else jnp.subtract
+
+            def f(env):
+                da, na = fa(env)
+                db, nb = fb(env)
+                return fn(da, db), na | nb
+            return f
+        kind, fa, fb, _s = _to_common_numeric(sf, cols)
+        fn = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}[op]
+
+        def f(env):
+            da, na = fa(env)
+            db, nb = fb(env)
+            return fn(da, db), na | nb
+        return f
+    if op == "div":
+        out_k = phys_kind(sf.ftype)
+        if out_k == K_FLOAT:
+            _k, fa, fb, _s = _to_common_numeric(sf, cols)
+
+            def f(env):
+                da, na = fa(env)
+                db, nb = fb(env)
+                zero = db == 0
+                safe = jnp.where(zero, 1.0, db)
+                return da / safe, na | nb | zero
+            return f
+        s1 = _dec_scale(sf.args[0])
+        s2 = _dec_scale(sf.args[1])
+        sr = sf.ftype.scale
+        fa = _compile_scaled(sf.args[0], cols, s1)
+        fb = _compile_scaled(sf.args[1], cols, s2)
+        shift = POW10[sr + s2 - s1]
+
+        def f(env):
+            da, na = fa(env)
+            db, nb = fb(env)
+            zero = db == 0
+            num = da * shift
+            den = jnp.where(zero, 1, db)
+            sign = jnp.where((num < 0) != (den < 0), -1, 1)
+            q = (2 * jnp.abs(num) + jnp.abs(den)) // (2 * jnp.abs(den))
+            return sign * q, na | nb | zero
+        return f
+    if op in ("and", "or"):
+        fa = compile_expr(sf.args[0], cols)
+        fb = compile_expr(sf.args[1], cols)
+        if op == "and":
+            def f(env):
+                da, na = fa(env)
+                db, nb = fb(env)
+                ta = (da != 0) & ~na
+                tb = (db != 0) & ~nb
+                fa_ = (da == 0) & ~na
+                fb_ = (db == 0) & ~nb
+                res = ta & tb
+                nulls = ~(fa_ | fb_) & (na | nb)
+                return res.astype(jnp.int64), nulls
+            return f
+
+        def f(env):
+            da, na = fa(env)
+            db, nb = fb(env)
+            ta = (da != 0) & ~na
+            tb = (db != 0) & ~nb
+            res = ta | tb
+            nulls = ~res & (na | nb)
+            return res.astype(jnp.int64), nulls
+        return f
+    if op == "not":
+        fa = compile_expr(sf.args[0], cols)
+
+        def f(env):
+            d, n = fa(env)
+            return (d == 0).astype(jnp.int64), n
+        return f
+    if op == "isnull":
+        fa = compile_expr(sf.args[0], cols)
+
+        def f(env):
+            _d, n = fa(env)
+            return n.astype(jnp.int64), jnp.zeros_like(n)
+        return f
+    if op == "neg":
+        fa = compile_expr(sf.args[0], cols)
+
+        def f(env):
+            d, n = fa(env)
+            return -d, n
+        return f
+    if op == "in_set":
+        target = sf.args[0]
+        values, has_null = sf.extra
+        if phys_kind(target.ftype) == K_STR:
+            return _compile_str_in(sf, cols)
+        fa = compile_expr(target, cols)
+        sorted_vals = jnp.asarray(np.sort(np.asarray(values)))
+
+        def f(env):
+            d, n = fa(env)
+            pos = jnp.searchsorted(sorted_vals, d)
+            pos = jnp.clip(pos, 0, len(sorted_vals) - 1)
+            hit = sorted_vals[pos] == d
+            nulls = n | (~hit & bool(has_null))
+            return hit.astype(jnp.int64), nulls
+        return f
+    if op == "case":
+        return _compile_case(sf, cols)
+    if op == "if":
+        return _compile_case(ScalarFunc("case", sf.args, sf.ftype), cols)
+    if op == "cast":
+        return _compile_cast(sf, cols)
+    if op == "coalesce":
+        fs = [compile_expr(a, cols) for a in sf.args]
+        tk = phys_kind(sf.ftype)
+        if tk == K_STR:
+            raise DeviceUnsupported("string coalesce")
+
+        def f(env):
+            out_d, out_n = fs[0](env)
+            out_d = _coerce_kind(out_d, sf.args[0], sf.ftype)
+            for fx, ax in zip(fs[1:], sf.args[1:]):
+                d, n = fx(env)
+                d = _coerce_kind(d, ax, sf.ftype)
+                out_d = jnp.where(out_n, d, out_d)
+                out_n = out_n & n
+            return out_d, out_n
+        return f
+    if op == "year":
+        fa = compile_expr(sf.args[0], cols)
+        if phys_kind(sf.args[0].ftype) != K_DATE:
+            raise DeviceUnsupported("year() on non-date for device")
+
+        def f(env):
+            d, n = fa(env)
+            y, _m, _dd = _civil_from_days(d.astype(jnp.int64))
+            return y, n
+        return f
+    if op == "month":
+        fa = compile_expr(sf.args[0], cols)
+        if phys_kind(sf.args[0].ftype) != K_DATE:
+            raise DeviceUnsupported("month() on non-date for device")
+
+        def f(env):
+            d, n = fa(env)
+            _y, m, _dd = _civil_from_days(d.astype(jnp.int64))
+            return m, n
+        return f
+    if op == "abs":
+        fa = compile_expr(sf.args[0], cols)
+
+        def f(env):
+            d, n = fa(env)
+            return jnp.abs(d), n
+        return f
+    raise DeviceUnsupported(f"scalar op {op} not available on device")
+
+
+def _civil_from_days(z):
+    """days-since-epoch → (y, m, d). Howard Hinnant's civil_from_days,
+    branch-free — pure integer ops, MXU-adjacent friendly."""
+    z = z + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _compile_scaled(e, cols, target_scale):
+    f = compile_expr(e, cols)
+    sc = _dec_scale(e)
+    k = phys_kind(e.ftype)
+    if k in (K_FLOAT, K_STR):
+        raise DeviceUnsupported("float→decimal on device")
+
+    def g(env):
+        d, n = f(env)
+        d = d.astype(jnp.int64)
+        if target_scale > sc:
+            d = d * POW10[target_scale - sc]
+        return d, n
+    return g
+
+
+def _coerce_kind(d, e, out_ft):
+    k, ok = phys_kind(e.ftype), phys_kind(out_ft)
+    if ok == K_FLOAT:
+        d = d.astype(jnp.float64)
+        if k == K_DEC:
+            d = d / POW10[e.ftype.scale]
+        return d
+    if ok == K_DEC:
+        d = d.astype(jnp.int64)
+        sc = _dec_scale(e)
+        if out_ft.scale > sc:
+            d = d * POW10[out_ft.scale - sc]
+        return d
+    return d.astype(jnp.int64)
+
+
+def _compile_case(sf, cols):
+    args = sf.args
+    has_else = len(args) % 2 == 1
+    pairs = (len(args) - (1 if has_else else 0)) // 2
+    if phys_kind(sf.ftype) == K_STR:
+        raise DeviceUnsupported("string CASE on device")
+    fs = [compile_expr(a, cols) for a in args]
+
+    def f(env):
+        n_rows = _env_n(env)
+        dt = jnp.float64 if phys_kind(sf.ftype) == K_FLOAT else jnp.int64
+        out = jnp.zeros(n_rows, dtype=dt)
+        out_n = jnp.ones(n_rows, dtype=bool)
+        decided = jnp.zeros(n_rows, dtype=bool)
+        for p in range(pairs):
+            cd, cn = fs[2 * p](env)
+            cond = (cd != 0) & ~cn & ~decided
+            rd, rn = fs[2 * p + 1](env)
+            rd = _coerce_kind(rd, args[2 * p + 1], sf.ftype)
+            out = jnp.where(cond, rd, out)
+            out_n = jnp.where(cond, rn, out_n)
+            decided = decided | cond
+        if has_else:
+            rd, rn = fs[-1](env)
+            rd = _coerce_kind(rd, args[-1], sf.ftype)
+            out = jnp.where(decided, out, rd)
+            out_n = jnp.where(decided, out_n, rn)
+        return out, out_n
+    return f
+
+
+def _compile_cast(sf, cols):
+    src = sf.args[0]
+    f = compile_expr(src, cols)
+    sk, tk = phys_kind(src.ftype), phys_kind(sf.ftype)
+    if K_STR in (sk, tk):
+        raise DeviceUnsupported("string casts on device")
+
+    def g(env):
+        d, n = f(env)
+        if tk == K_FLOAT:
+            d = d.astype(jnp.float64)
+            if sk == K_DEC:
+                d = d / POW10[src.ftype.scale]
+            return d, n
+        if tk == K_DEC:
+            if sk == K_DEC:
+                diff = sf.ftype.scale - src.ftype.scale
+                if diff >= 0:
+                    return d.astype(jnp.int64) * POW10[diff], n
+                den = POW10[-diff]
+                sign = jnp.where(d < 0, -1, 1)
+                q = (2 * jnp.abs(d) + den) // (2 * den)
+                return sign * q, n
+            if sk == K_FLOAT:
+                return jnp.round(d * POW10[sf.ftype.scale]).astype(jnp.int64), n
+            return d.astype(jnp.int64) * POW10[sf.ftype.scale], n
+        # int target
+        if sk == K_DEC:
+            den = POW10[src.ftype.scale]
+            sign = jnp.where(d < 0, -1, 1)
+            q = (2 * jnp.abs(d) + den) // (2 * den)
+            return sign * q, n
+        if sk == K_FLOAT:
+            return jnp.round(d).astype(jnp.int64), n
+        return d.astype(jnp.int64), n
+    return g
+
+
+def _str_code_for(const_val, dictionary):
+    """Host: map a bytes constant to its dictionary code (or -2 if absent —
+    never matches since codes are >= 0 and NULL is -1)."""
+    v = const_val if isinstance(const_val, bytes) else str(const_val).encode()
+    pos = np.searchsorted(dictionary, v)
+    if pos < len(dictionary) and dictionary[pos] == v:
+        return int(pos)
+    return -2
+
+
+def _compile_str_cmp(sf, cols):
+    a, b = sf.args
+    if sf.op not in ("eq", "ne"):
+        # ordering comparisons on dictionary codes are invalid unless the
+        # dictionary is sorted — np.unique IS sorted, so allow them
+        pass
+    if isinstance(a, ExprColumn) and isinstance(b, Constant):
+        col, const = a, b
+    elif isinstance(b, ExprColumn) and isinstance(a, Constant):
+        col, const = b, a
+        # flip comparison direction
+        sf = ScalarFunc({"lt": "gt", "gt": "lt", "le": "ge", "ge": "le"}.get(
+            sf.op, sf.op), [b, a], sf.ftype)
+    else:
+        if (isinstance(a, ExprColumn) and isinstance(b, ExprColumn)):
+            raise DeviceUnsupported("string col=col compare needs shared dict")
+        raise DeviceUnsupported("string comparison shape unsupported")
+    dc = cols.get(col.idx)
+    if dc is None or dc.dictionary is None:
+        raise DeviceUnsupported("no dictionary for string column")
+    if const.value is None:
+        def f(env):
+            n_rows = _env_n(env)
+            return (jnp.zeros(n_rows, dtype=jnp.int64),
+                    jnp.ones(n_rows, dtype=bool))
+        return f
+    # dictionary from np.unique is sorted → order-preserving codes
+    v = const.value if isinstance(const.value, bytes) else str(const.value).encode()
+    pos = int(np.searchsorted(dc.dictionary, v))
+    exact = pos < len(dc.dictionary) and dc.dictionary[pos] == v
+    code = pos if exact else pos - 0.5  # between codes for range compares
+    idx = col.idx
+    op = sf.op
+    cmp = _CMP_OPS[op]
+
+    def f(env):
+        d, n = env[idx]
+        res = cmp(d.astype(jnp.float64), code) if not exact else cmp(d, pos)
+        return res.astype(jnp.int64), n
+    return f
+
+
+def _compile_str_in(sf, cols):
+    target = sf.args[0]
+    values, has_null = sf.extra
+    if not isinstance(target, ExprColumn):
+        raise DeviceUnsupported("string IN target must be a column")
+    dc = cols.get(target.idx)
+    if dc is None or dc.dictionary is None:
+        raise DeviceUnsupported("no dictionary for string column")
+    codes = sorted(c for c in (_str_code_for(v, dc.dictionary) for v in values)
+                   if c >= 0)
+    code_arr = jnp.asarray(np.asarray(codes, dtype=np.int64)) if codes else None
+    idx = target.idx
+
+    def f(env):
+        d, n = env[idx]
+        if code_arr is None:
+            hit = jnp.zeros(d.shape[0], dtype=bool)
+        else:
+            pos = jnp.clip(jnp.searchsorted(code_arr, d), 0, len(codes) - 1)
+            hit = code_arr[pos] == d
+        nulls = n | (~hit & bool(has_null))
+        return hit.astype(jnp.int64), nulls
+    return f
+
+
+# ---------------------------------------------------------------------------
+# fused aggregation pipeline
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_keys", "agg_ops", "capacity"))
+def _agg_kernel(key_cols, key_nulls, val_cols, val_nulls, mask,
+                n_keys, agg_ops, capacity):
+    """One fused kernel: filter mask + group-by + aggregate.
+
+    Sort-based grouping (iterated stable argsort = lexsort) + segment_sum —
+    the XLA-native answer to the reference's hash tables: static shapes, no
+    data-dependent control flow. Filtered rows go to a trash segment at index
+    `capacity`; real groups occupy [0, capacity). If the data has more than
+    `capacity` groups the caller detects n_groups > capacity and retries
+    with a bigger static capacity (one extra compile, never wrong results).
+
+    key_cols: tuple of int64 arrays (dict codes / ints). agg_ops: tuple of
+    ("sum_i"|"sum_f"|"count"|"min"|"max"|"first") aligned with val_cols.
+    """
+    n = mask.shape[0]
+    trash = capacity
+    nseg = capacity + 1
+    # combined sort: minor-to-major stable argsort over keys, then kept-first
+    order = jnp.arange(n)
+    for i in range(n_keys - 1, -1, -1):
+        k = jnp.where(key_nulls[i], jnp.int64(-1), key_cols[i])
+        order = order[jnp.argsort(k[order], stable=True)]
+    order = order[jnp.argsort(~mask[order], stable=True)]
+    kept = jnp.sum(mask)
+    pos = jnp.arange(n)
+    in_range = pos < kept
+    # boundary flags on the sorted, kept prefix
+    is_new = jnp.zeros(n, dtype=bool).at[0].set(n > 0)
+    for i in range(n_keys):
+        k = jnp.where(key_nulls[i], jnp.int64(-1), key_cols[i])[order]
+        prev = jnp.concatenate([k[:1], k[:-1]])
+        is_new = is_new | (k != prev)
+    is_new = is_new & in_range
+    gid = jnp.cumsum(is_new.astype(jnp.int64)) - 1
+    n_groups = jnp.sum(is_new)
+    seg = jnp.where(in_range & (gid < capacity), gid, trash)
+    # representative row index per group (first in sort order)
+    rep = jnp.full(nseg, n, dtype=jnp.int64)
+    rep = rep.at[seg].min(jnp.where(in_range, order, n))
+    rep_safe = jnp.clip(rep[:capacity], 0, jnp.maximum(n - 1, 0))
+    key_out = tuple(k[rep_safe] for k in key_cols)
+    key_null_out = tuple(kn[rep_safe] for kn in key_nulls)
+    results = []
+    result_nulls = []
+    for j, opn in enumerate(agg_ops):
+        v = val_cols[j][order]
+        vn = val_nulls[j][order] | ~in_range
+        if opn == "count":
+            cnt = jax.ops.segment_sum((~vn).astype(jnp.int64), seg,
+                                      num_segments=nseg)[:capacity]
+            results.append(cnt)
+            result_nulls.append(jnp.zeros(capacity, dtype=bool))
+            continue
+        nonnull = jax.ops.segment_sum((~vn).astype(jnp.int64), seg,
+                                      num_segments=nseg)[:capacity]
+        if opn == "sum_i":
+            s = jax.ops.segment_sum(jnp.where(vn, 0, v.astype(jnp.int64)),
+                                    seg, num_segments=nseg)[:capacity]
+            results.append(s)
+        elif opn == "sum_f":
+            s = jax.ops.segment_sum(jnp.where(vn, 0.0, v.astype(jnp.float64)),
+                                    seg, num_segments=nseg)[:capacity]
+            results.append(s)
+        elif opn == "min":
+            big = (jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                   else jnp.iinfo(v.dtype).max)
+            s = jax.ops.segment_min(jnp.where(vn, big, v), seg,
+                                    num_segments=nseg)[:capacity]
+            results.append(s)
+        elif opn == "max":
+            small = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                     else jnp.iinfo(v.dtype).min)
+            s = jax.ops.segment_max(jnp.where(vn, small, v), seg,
+                                    num_segments=nseg)[:capacity]
+            results.append(s)
+        elif opn == "first":
+            results.append(val_cols[j][rep_safe])
+        else:
+            raise ValueError(opn)
+        result_nulls.append(nonnull == 0)
+    valid = jnp.arange(capacity) < n_groups
+    return key_out, key_null_out, tuple(results), tuple(result_nulls), n_groups, valid
+
+
+# ---------------------------------------------------------------------------
+# two-pass sort join kernels
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _join_count_kernel(build_key, probe_key, build_null, probe_null):
+    """Pass 1: sort build side, count matches per probe row."""
+    order = jnp.argsort(build_key, stable=True)
+    sb = build_key[order]
+    lo = jnp.searchsorted(sb, probe_key, side="left")
+    hi = jnp.searchsorted(sb, probe_key, side="right")
+    cnt = jnp.where(probe_null, 0, hi - lo)
+    return order, sb, lo, cnt
+
+
+@functools.partial(jax.jit, static_argnames=("total",))
+def _join_expand_kernel(order, lo, cnt, build_null, total):
+    """Pass 2 (static total): expand match pairs."""
+    cum = jnp.cumsum(cnt)
+    pos = jnp.arange(total, dtype=jnp.int64)
+    probe_idx = jnp.searchsorted(cum, pos, side="right")
+    base = jnp.where(probe_idx > 0, cum[jnp.clip(probe_idx - 1, 0, None)], 0)
+    within = pos - base
+    safe_probe = jnp.clip(probe_idx, 0, lo.shape[0] - 1)
+    bpos = lo[safe_probe] + within
+    build_idx = order[jnp.clip(bpos, 0, order.shape[0] - 1)]
+    keep = ~build_null[build_idx]
+    return probe_idx, build_idx, keep
+
+
+def device_join_match(build_keys, probe_keys):
+    """Mirror of ops.host.join_match with device kernels. build_keys /
+    probe_keys: [(np data int64, np nulls)] — pre-combined single key column
+    (caller combines multi-column keys via host factorization for now).
+    Returns numpy (probe_idx, build_idx)."""
+    bk, bn = build_keys
+    pk, pn = probe_keys
+    order, _sb, lo, cnt = _join_count_kernel(
+        jnp.asarray(bk), jnp.asarray(pk), jnp.asarray(bn), jnp.asarray(pn))
+    total = int(jnp.sum(cnt))
+    if total == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    probe_idx, build_idx, keep = _join_expand_kernel(
+        order, lo, cnt, jnp.asarray(bn), total)
+    keep = np.asarray(keep)
+    return np.asarray(probe_idx)[keep], np.asarray(build_idx)[keep]

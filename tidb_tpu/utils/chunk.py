@@ -52,7 +52,7 @@ def np_dtype_for(ft: FieldType):
 class Column:
     """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
 
-    __slots__ = ("ftype", "data", "nulls")
+    __slots__ = ("ftype", "data", "nulls", "_dict")
 
     def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
         self.ftype = ftype
@@ -60,6 +60,7 @@ class Column:
         if nulls is None:
             nulls = np.zeros(len(data), dtype=bool)
         self.nulls = nulls
+        self._dict = None  # cached (codes, uniques) for device encoding
 
     def __len__(self):
         return len(self.data)
@@ -110,10 +111,17 @@ class Column:
         """Factorize a bytes column → (codes int32, uniques object array).
 
         Dictionary encoding is how string group-by/join keys reach the TPU:
-        the kernel sees int32 codes; the dictionary stays host-side.
+        the kernel sees int32 codes; the dictionary stays host-side. Cached —
+        bulk loaders install the encoding directly via set_dict().
         """
-        uniques, codes = np.unique(self.data.astype(object), return_inverse=True)
-        return codes.astype(np.int32), uniques
+        if self._dict is None:
+            uniques, codes = np.unique(self.data.astype(object),
+                                       return_inverse=True)
+            self._dict = (codes.astype(np.int32), uniques)
+        return self._dict
+
+    def set_dict(self, codes: np.ndarray, uniques: np.ndarray):
+        self._dict = (codes.astype(np.int32), uniques)
 
     def prefix64(self) -> np.ndarray:
         """Order-preserving uint64 of the first 8 bytes of each value —
