@@ -1,0 +1,127 @@
+"""Driver benchmark: TPC-H Q1 (SF from BENCH_SF env, default 1) through the
+FULL SQL path — parse → plan → fused device kernel — on the real device,
+vs the host (numpy) executor as the reference-CPU stand-in.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import tidb_tpu  # noqa: F401  (x64 on)
+
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils.chunk import Column
+
+Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty,
+       avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc,
+       count(1) as count_order
+from lineitem
+where l_shipdate <= '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+def gen_lineitem(tk, sf: float):
+    """Synthetic lineitem with TPC-H-like distributions, bulk-installed via
+    the Lightning-role columnar loader (no per-row encode)."""
+    n = int(6_001_215 * sf)
+    rng = np.random.default_rng(42)
+    tk.must_exec("create database if not exists tpch")
+    tk.must_exec("use tpch")
+    tk.must_exec("""
+        create table lineitem (
+            l_orderkey bigint, l_quantity decimal(15,2),
+            l_extendedprice decimal(15,2), l_discount decimal(15,2),
+            l_tax decimal(15,2), l_returnflag varchar(1),
+            l_linestatus varchar(1), l_shipdate date)""")
+    info = tk.domain.infoschema().table_by_name("tpch", "lineitem")
+
+    orderkey = rng.integers(1, n, n)
+    qty = rng.integers(1, 51, n) * 100               # 1.00-50.00
+    price = rng.integers(900_00, 105_000_00, n)      # ~dbgen price range
+    disc = rng.integers(0, 11, n)                    # 0.00-0.10
+    tax = rng.integers(0, 9, n)                      # 0.00-0.08
+    # shipdate: 1992-01-01 .. 1998-12-01 in days-since-epoch
+    d0 = (np.datetime64("1992-01-01") - np.datetime64("1970-01-01")).astype(int)
+    d1 = (np.datetime64("1998-12-01") - np.datetime64("1970-01-01")).astype(int)
+    shipdate = rng.integers(d0, d1, n).astype(np.int32)
+    flag_codes = rng.integers(0, 3, n).astype(np.int32)
+    status_codes = rng.integers(0, 2, n).astype(np.int32)
+    flag_dict = np.array([b"A", b"N", b"R"], dtype=object)
+    status_dict = np.array([b"F", b"O"], dtype=object)
+
+    def strcol(codes, dictionary, ft):
+        c = Column(ft, dictionary[codes], np.zeros(n, dtype=bool))
+        c.set_dict(codes, dictionary)
+        return c
+
+    z = np.zeros(n, dtype=bool)
+    cols = {c.name: c for c in info.public_columns()}
+    data = {
+        "l_orderkey": orderkey, "l_quantity": qty, "l_extendedprice": price,
+        "l_discount": disc, "l_tax": tax, "l_shipdate": shipdate,
+    }
+    columns = {}
+    for name, arr in data.items():
+        c = cols[name]
+        columns[c.id] = Column(c.ftype, arr, z)
+    columns[cols["l_returnflag"].id] = strcol(
+        flag_codes, flag_dict, cols["l_returnflag"].ftype)
+    columns[cols["l_linestatus"].id] = strcol(
+        status_codes, status_dict, cols["l_linestatus"].ftype)
+    tk.domain.columnar_cache.install_bulk(
+        info, columns, np.arange(1, n + 1, dtype=np.int64))
+    return n
+
+
+def time_query(tk, sql, repeats=3):
+    best = float("inf")
+    rows = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        rows = tk.must_query(sql).rows
+        best = min(best, time.perf_counter() - t0)
+    return best, rows
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    tk = TestKit()
+    n = gen_lineitem(tk, sf)
+
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    time_query(tk, Q1, repeats=1)  # warmup: compile + columnar materialize
+    dev_t, dev_rows = time_query(tk, Q1, repeats=3)
+
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    host_t, host_rows = time_query(tk, Q1, repeats=1)
+
+    if dev_rows != host_rows:
+        print(json.dumps({"metric": "tpch_q1_parity", "value": 0,
+                          "unit": "bool", "vs_baseline": 0}))
+        sys.exit(1)
+
+    print(json.dumps({
+        "metric": f"tpch_q1_sf{sf:g}_device_rows_per_sec",
+        "value": round(n / dev_t),
+        "unit": "rows/s",
+        "vs_baseline": round(host_t / dev_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,3 +11,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The env var alone is not enough on machines where a TPU PJRT plugin (e.g.
+# the axon tunnel) is auto-discovered — it wins over JAX_PLATFORMS. The
+# config.update below is the authoritative override.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -169,6 +169,10 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
         if ng <= capacity:
             break
         capacity = dev.next_pow2(ng)
+    if ng == 0 and not plan.group_exprs:
+        # global aggregate over zero kept rows still yields ONE row
+        # (count=0, sum/min/max NULL) — host path has the special case
+        raise DeviceUnsupported("empty global aggregate")
 
     # assemble host chunk
     out_cols = []
@@ -176,9 +180,7 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
         kd = np.asarray(kd[:ng])
         kn = np.asarray(kn[:ng])
         if dictionary is not None:
-            data = np.empty(ng, dtype=object)
-            for i in range(ng):
-                data[i] = dictionary[kd[i]] if not kn[i] else b""
+            data = np.where(kn, b"", dictionary[np.clip(kd, 0, len(dictionary) - 1)])
             out_cols.append(Column(e.ftype, data, kn))
         else:
             dt = np_dtype_for(e.ftype)
@@ -211,9 +213,7 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
             codes = np.asarray(results[j][:ng])
             nulls = np.asarray(result_nulls[j][:ng])
             dictionary = dcols[col_idx].dictionary
-            data = np.empty(ng, dtype=object)
-            for i in range(ng):
-                data[i] = dictionary[codes[i]] if not nulls[i] else b""
+            data = np.where(nulls, b"", dictionary[np.clip(codes, 0, len(dictionary) - 1)])
             out_cols.append(Column(ft, data, nulls))
             continue
         _tag, j = slot

@@ -373,11 +373,12 @@ class HashJoinExec(QueryExecutor):
     def _match(self, build_keys, probe_keys):
         """Dispatch the match kernel to device or host by engine mode."""
         from .device_exec import want_device, device_join_keys
+        from .device_exec import DeviceUnsupported
         n = max(len(build_keys[0][0]), len(probe_keys[0][0])) if build_keys else 0
         if want_device(self.ctx, n):
             try:
                 return device_join_keys(probe_keys, build_keys)
-            except Exception:
+            except DeviceUnsupported:
                 pass
         return host.join_match(build_keys, probe_keys)
 

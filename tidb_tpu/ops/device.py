@@ -587,11 +587,14 @@ def _agg_kernel(key_cols, key_nulls, val_cols, val_nulls, mask,
     n = mask.shape[0]
     trash = capacity
     nseg = capacity + 1
-    # combined sort: minor-to-major stable argsort over keys, then kept-first
+    # combined sort: minor-to-major stable argsort over keys, then kept-first.
+    # Each key is the compound (null_flag, value) — null sorted as its own
+    # most-significant bit so a NULL never collides with any real value
+    # (NULL ≠ -1; mysql GROUP BY groups NULLs together but apart from values)
     order = jnp.arange(n)
     for i in range(n_keys - 1, -1, -1):
-        k = jnp.where(key_nulls[i], jnp.int64(-1), key_cols[i])
-        order = order[jnp.argsort(k[order], stable=True)]
+        order = order[jnp.argsort(key_cols[i][order], stable=True)]
+        order = order[jnp.argsort(key_nulls[i][order], stable=True)]
     order = order[jnp.argsort(~mask[order], stable=True)]
     kept = jnp.sum(mask)
     pos = jnp.arange(n)
@@ -599,9 +602,12 @@ def _agg_kernel(key_cols, key_nulls, val_cols, val_nulls, mask,
     # boundary flags on the sorted, kept prefix
     is_new = jnp.zeros(n, dtype=bool).at[0].set(n > 0)
     for i in range(n_keys):
-        k = jnp.where(key_nulls[i], jnp.int64(-1), key_cols[i])[order]
+        k = key_cols[i][order]
+        kn = key_nulls[i][order]
         prev = jnp.concatenate([k[:1], k[:-1]])
-        is_new = is_new | (k != prev)
+        prev_n = jnp.concatenate([kn[:1], kn[:-1]])
+        changed = jnp.where(kn | prev_n, kn != prev_n, k != prev)
+        is_new = is_new | changed
     is_new = is_new & in_range
     gid = jnp.cumsum(is_new.astype(jnp.int64)) - 1
     n_groups = jnp.sum(is_new)
@@ -646,7 +652,11 @@ def _agg_kernel(key_cols, key_nulls, val_cols, val_nulls, mask,
                                     num_segments=nseg)[:capacity]
             results.append(s)
         elif opn == "first":
+            # first row's own value AND null flag (mirrors host first_row;
+            # a NULL in the representative row must stay NULL)
             results.append(val_cols[j][rep_safe])
+            result_nulls.append(val_nulls[j][rep_safe])
+            continue
         else:
             raise ValueError(opn)
         result_nulls.append(nonnull == 0)

@@ -1,0 +1,23 @@
+"""Multi-chip parallel execution: device mesh + MPP-style distributed
+operators (reference: planner/core/fragment.go exchange fragments,
+store/copr/mpp.go task dispatch, unistore/cophandler/mpp_exec.go exchanges).
+
+The TPU-native translation: exchange senders/receivers become XLA
+collectives inside one shard_map-jitted program — hash-partition shuffles
+ride `all_to_all` over ICI, broadcast joins ride `all_gather`, final
+aggregation merges ride `psum`/`pmin`/`pmax`.
+"""
+
+from .mpp import (
+    make_mesh,
+    dist_agg_step,
+    dist_join_agg_step,
+    shard_batch,
+)
+
+__all__ = [
+    "make_mesh",
+    "dist_agg_step",
+    "dist_join_agg_step",
+    "shard_batch",
+]

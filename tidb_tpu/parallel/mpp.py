@@ -1,0 +1,231 @@
+"""MPP distributed operators over a jax.sharding.Mesh.
+
+Reference mapping:
+- fragment exchanges (planner/core/fragment.go:37,64; exchange types
+  PassThrough/Broadcast/Hash at store/copr/mpp.go) → XLA collectives inside
+  `shard_map`: hash exchange = `all_to_all`, broadcast = `all_gather`,
+  final merge = `psum` / `pmin` / `pmax`.
+- parallel partial/final hash aggregation (executor/aggregate.go:85-165)
+  → per-shard sort-based partial aggregation, `all_gather` of bounded
+  partial states, replicated final merge. One jitted program; no host hop
+  between partial and final.
+- shuffled hash join (planner/core/exhaust_physical_plans.go MPP joins)
+  → hash-partition both sides by key over the mesh via `all_to_all`,
+  local sort-join per shard, `psum` the joined aggregate.
+
+Everything is static-shape: partial states are `capacity`-bounded, shuffle
+buckets are `cap`-bounded with overflow *counted and reported* so the host
+can retry with a larger capacity (never silently wrong).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "part") -> Mesh:
+    """1-D device mesh over the partition axis. Regions (the reference's
+    ~100MiB shards) map to equal row-slices over this axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices}-device mesh but only {len(devs)} "
+                f"devices visible (platform {devs[0].platform}); for virtual "
+                "multi-chip set jax_platforms=cpu + "
+                "xla_force_host_platform_device_count")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def shard_batch(mesh: Mesh, *arrays, axis: str = "part"):
+    """Pad each 1-D array to a multiple of the mesh size and device_put it
+    sharded over the mesh. Returns (padded_arrays, valid_mask)."""
+    n_shards = mesh.shape[axis]
+    n = arrays[0].shape[0]
+    pad = (-n) % n_shards
+    spec = jax.sharding.NamedSharding(mesh, P(axis))
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        if pad:
+            a = np.concatenate([a, np.zeros(pad, dtype=a.dtype)])
+        out.append(jax.device_put(a, spec))
+    valid = np.ones(n + pad, dtype=bool)
+    if pad:
+        valid[n:] = False
+    return out, jax.device_put(valid, spec)
+
+
+# ---------------------------------------------------------------------------
+# local bounded sort-based aggregation (shared by partial and final stages)
+# ---------------------------------------------------------------------------
+
+def _local_agg(keys, valid, vals, kinds, capacity):
+    """Group `vals` by int64 `keys` (invalid rows ignored) into at most
+    `capacity` groups. Returns (group_keys[cap], outs tuple[cap],
+    out_valid[cap], n_groups). Pure traced code — static shapes only."""
+    n = keys.shape[0]
+    trash = capacity
+    nseg = capacity + 1
+    sort_key = jnp.where(valid, keys, jnp.iinfo(jnp.int64).max)
+    order = jnp.argsort(sort_key, stable=True)
+    sk = sort_key[order]
+    kept = jnp.sum(valid)
+    pos = jnp.arange(n)
+    in_range = pos < kept
+    prev = jnp.concatenate([sk[:1], sk[:-1]])
+    is_new = jnp.zeros(n, dtype=bool).at[0].set(n > 0) | (sk != prev)
+    is_new = is_new & in_range
+    gid = jnp.cumsum(is_new.astype(jnp.int64)) - 1
+    n_groups = jnp.sum(is_new)
+    seg = jnp.where(in_range & (gid < capacity), gid, trash)
+    # init with int64.min so negative keys survive the scatter-max
+    group_keys = jnp.full(nseg, jnp.iinfo(jnp.int64).min, dtype=jnp.int64)
+    group_keys = group_keys.at[seg].max(
+        jnp.where(in_range, sk, jnp.iinfo(jnp.int64).min))[:capacity]
+    outs = []
+    for v, kind in zip(vals, kinds):
+        sv = v[order]
+        if kind in ("sum", "count"):
+            z = jnp.where(in_range, sv, jnp.zeros((), dtype=sv.dtype))
+            outs.append(jax.ops.segment_sum(z, seg, num_segments=nseg)[:capacity])
+        elif kind == "min":
+            big = (jnp.inf if jnp.issubdtype(sv.dtype, jnp.floating)
+                   else jnp.iinfo(sv.dtype).max)
+            z = jnp.where(in_range, sv, big)
+            outs.append(jax.ops.segment_min(z, seg, num_segments=nseg)[:capacity])
+        elif kind == "max":
+            small = (-jnp.inf if jnp.issubdtype(sv.dtype, jnp.floating)
+                     else jnp.iinfo(sv.dtype).min)
+            z = jnp.where(in_range, sv, small)
+            outs.append(jax.ops.segment_max(z, seg, num_segments=nseg)[:capacity])
+        else:
+            raise ValueError(kind)
+    out_valid = jnp.arange(capacity) < jnp.minimum(n_groups, capacity)
+    return group_keys, tuple(outs), out_valid, n_groups
+
+
+def dist_agg_step(mesh: Mesh, kinds: tuple, capacity: int, axis: str = "part"):
+    """Build the jitted distributed group-by step (partial → all_gather →
+    final). Inputs are row-sharded over `axis`:
+        keys  int64[N]      group key codes
+        valid bool[N]       row mask (filter result & padding)
+        *vals               one array per aggregate, aligned with `kinds`
+    `kinds`: tuple of "sum" | "count" | "min" | "max" ("count" vals should
+    be 0/1 int64). Returns replicated
+    (group_keys[cap], outs, out_valid[cap], n_groups, overflowed).
+    """
+    in_specs = (P(axis), P(axis)) + tuple(P(axis) for _ in kinds)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=in_specs,
+        out_specs=(P(), tuple(P() for _ in kinds), P(), P(), P()),
+        check_vma=False)
+    def step(keys, valid, *vals):
+        # stage 1: per-shard partial aggregation into bounded state
+        pk, pouts, pvalid, png = _local_agg(keys, valid, vals, kinds, capacity)
+        # exchange: gather every shard's partial state (capacity * n_shards
+        # rows — tiny next to N), replicated final merge on every shard
+        gk = jax.lax.all_gather(pk, axis, tiled=True)
+        gvalid = jax.lax.all_gather(pvalid, axis, tiled=True)
+        gouts = tuple(jax.lax.all_gather(o, axis, tiled=True) for o in pouts)
+        # stage 2: min/max merge with same kind; partial sums re-sum
+        merge_kinds = tuple("sum" if k == "count" else k for k in kinds)
+        fk, fouts, fvalid, fng = _local_agg(gk, gvalid, gouts, merge_kinds,
+                                            capacity)
+        overflow = jnp.maximum(jnp.max(jax.lax.all_gather(png, axis)),
+                               fng) > capacity
+        return fk, fouts, fvalid, fng, overflow
+
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# hash-partition shuffle join (+ aggregate) over the mesh
+# ---------------------------------------------------------------------------
+
+def _bucketize(keys, vals, valid, n_dest, cap):
+    """Scatter rows into [n_dest, cap] hash buckets (dest = key mod n_dest).
+    Returns flattened (keys, vals tuple, valid, n_dropped)."""
+    n = keys.shape[0]
+    dest = jnp.where(valid, keys % n_dest, n_dest)
+    order = jnp.argsort(dest, stable=True)
+    sd = dest[order]
+    start = jnp.searchsorted(sd, jnp.arange(n_dest))
+    pos = jnp.arange(n) - start[jnp.clip(sd, 0, n_dest - 1)]
+    ok = (sd < n_dest) & (pos < cap)
+    slot = jnp.where(ok, sd * cap + pos, n_dest * cap)
+    size = n_dest * cap + 1
+    bk = jnp.zeros(size, dtype=keys.dtype).at[slot].set(
+        jnp.where(ok, keys[order], 0))[:-1]
+    bvalid = jnp.zeros(size, dtype=bool).at[slot].set(ok)[:-1]
+    bvals = tuple(
+        jnp.zeros(size, dtype=v.dtype).at[slot].set(
+            jnp.where(ok, v[order], jnp.zeros((), dtype=v.dtype)))[:-1]
+        for v in vals)
+    dropped = jnp.sum((sd < n_dest) & (pos >= cap))
+    return bk, bvals, bvalid, dropped
+
+
+def _exchange_hash(keys, vals, valid, axis, n_dest, cap):
+    """Hash-partition exchange: bucketize locally, all_to_all over ICI.
+    After this, every row on shard i satisfies key % n_shards == i."""
+    bk, bvals, bvalid, dropped = _bucketize(keys, vals, valid, n_dest, cap)
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
+                            split_axis=0, concat_axis=0, tiled=True)
+    return (a2a(bk), tuple(a2a(v) for v in bvals), a2a(bvalid), dropped)
+
+
+def dist_join_agg_step(mesh: Mesh, cap: int, axis: str = "part"):
+    """Build the jitted distributed shuffled-hash-join + aggregate step
+    (the MPP shuffle join fragment: Q3-shaped `SUM(probe_val *
+    matched_build_sum)` — e.g. revenue over lineitem ⋈ filtered orders).
+
+    Inputs row-sharded over `axis`:
+        bk int64[Nb], bv [Nb], bvalid bool[Nb]   build side (smaller table)
+        pk int64[Np], pv [Np], pvalid bool[Np]   probe side
+    Returns replicated (total, n_pairs, dropped) where
+        total  = Σ over join pairs of pv * bv
+        n_pairs = join cardinality
+        dropped = rows lost to bucket overflow (retry bigger cap if > 0)
+    """
+    n_shards = mesh.shape[axis]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis),) * 6,
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    def step(bk, bv, bvalid, pk, pv, pvalid):
+        bk2, (bv2,), bvalid2, bdrop = _exchange_hash(
+            bk, (bv,), bvalid, axis, n_shards, cap)
+        pk2, (pv2,), pvalid2, pdrop = _exchange_hash(
+            pk, (pv,), pvalid, axis, n_shards, cap)
+        # local sort join: per probe row, sum + count of matching build rows
+        sort_key = jnp.where(bvalid2, bk2, jnp.iinfo(jnp.int64).max)
+        order = jnp.argsort(sort_key)
+        sb = sort_key[order]
+        sv = jnp.where(bvalid2, bv2, jnp.zeros((), dtype=bv2.dtype))[order]
+        csum = jnp.concatenate([jnp.zeros(1, dtype=sv.dtype), jnp.cumsum(sv)])
+        ccnt = jnp.concatenate([
+            jnp.zeros(1, dtype=jnp.int64),
+            jnp.cumsum(bvalid2[order].astype(jnp.int64))])
+        lo = jnp.searchsorted(sb, pk2, side="left")
+        hi = jnp.searchsorted(sb, pk2, side="right")
+        match_sum = csum[hi] - csum[lo]
+        match_cnt = ccnt[hi] - ccnt[lo]
+        pz = jnp.where(pvalid2, pv2, jnp.zeros((), dtype=pv2.dtype))
+        total = jax.lax.psum(jnp.sum(pz * match_sum), axis)
+        pairs = jax.lax.psum(
+            jnp.sum(jnp.where(pvalid2, match_cnt, 0)), axis)
+        dropped = jax.lax.psum(bdrop + pdrop, axis)
+        return total, pairs, dropped
+
+    return jax.jit(step)

@@ -121,6 +121,16 @@ class Column:
         return self._dict
 
     def set_dict(self, codes: np.ndarray, uniques: np.ndarray):
+        """Install a pre-computed dictionary encoding (bulk-load path).
+
+        The dictionary MUST be sorted ascending: device string compare/IN/
+        min/max (ops/device.py) rely on code order == byte order, exactly
+        what np.unique produces. Reject anything else loudly."""
+        if len(uniques) > 1:
+            u = np.asarray(uniques, dtype=object)
+            if not all(u[i] < u[i + 1] for i in range(len(u) - 1)):
+                raise ValueError("set_dict requires a sorted, deduplicated "
+                                 "dictionary (np.unique order)")
         self._dict = (codes.astype(np.int32), uniques)
 
     def prefix64(self) -> np.ndarray:
