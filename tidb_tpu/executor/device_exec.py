@@ -10,7 +10,10 @@ storage-side there, device-side here).
 
 from __future__ import annotations
 
+import collections
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..errors import TiDBError
@@ -38,6 +41,84 @@ def want_device(ctx, n_rows: int) -> bool:
     return n_rows >= 65536  # auto: device dispatch overhead beneath this
 
 
+#: jitted fused pipelines keyed by plan signature — the whole
+#: filter→keys→values→aggregate program is ONE XLA computation, traced once
+#: and re-dispatched on later executions (reference analog: coprocessor DAG
+#: compiled per plan digest). LRU-bounded; each entry pins strong refs to
+#: the string dictionaries whose codes are baked into the traced program,
+#: which makes the id()-based key component sound: a live referenced object
+#: can never share its id with a newly allocated dictionary.
+_PIPE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_PIPE_CACHE_MAX = 256
+
+
+def _pipe_cache_get(key):
+    hit = _PIPE_CACHE.get(key)
+    if hit is None:
+        return None
+    _PIPE_CACHE.move_to_end(key)
+    return hit[0]
+
+
+def _pipe_cache_put(key, fn, dict_refs):
+    _PIPE_CACHE[key] = (fn, dict_refs)
+    if len(_PIPE_CACHE) > _PIPE_CACHE_MAX:
+        _PIPE_CACHE.popitem(last=False)
+
+
+def _expr_sig(e) -> str:
+    """Structural signature of an expression (type-aware; reprs alone drop
+    decimal scales, which change the traced program)."""
+    ft = e.ftype
+    base = f"{ft.tp}.{ft.scale}"
+    if isinstance(e, ExprColumn):
+        return f"c{e.idx}:{base}"
+    if not hasattr(e, "op"):  # Constant
+        return f"k{e.value!r}:{base}"
+    extra = f"|{e.extra!r}" if e.extra is not None else ""
+    return (f"{e.op}({','.join(_expr_sig(a) for a in e.args)}){extra}:{base}")
+
+
+def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
+                    capacity, pack):
+    """Close the compiled expression fns over one traceable program and jit
+    it: mask, keys, values and the aggregate all fuse into a single XLA
+    executable — no eager op dispatch between operators."""
+
+    def pipeline(env):
+        first = next(iter(env.values()))[0]
+        n = first.shape[0]
+        if cond_fns:
+            mask = None
+            for f in cond_fns:
+                d, nl = f(env)
+                m = (d != 0) & ~nl
+                mask = m if mask is None else (mask & m)
+        else:
+            mask = jnp.ones(n, dtype=bool)
+        key_cols, key_nulls = [], []
+        for f in key_fns:
+            d, nl = f(env)
+            key_cols.append(d.astype(jnp.int64))
+            key_nulls.append(nl)
+        if not key_cols:
+            key_cols = [jnp.zeros(n, dtype=jnp.int64)]
+            key_nulls = [jnp.zeros(n, dtype=bool)]
+        val_cols, val_nulls = [], []
+        for f, conv in val_plan:
+            d, nl = f(env)
+            if conv == "int":
+                d = d.astype(jnp.int64)
+            val_cols.append(d)
+            val_nulls.append(nl)
+        return dev._agg_impl(tuple(key_cols), tuple(key_nulls),
+                             tuple(val_cols), tuple(val_nulls), mask,
+                             n_keys=n_keys, agg_ops=agg_ops,
+                             capacity=capacity, pack=pack)
+
+    return jax.jit(pipeline)
+
+
 def device_agg(plan, chunk: Chunk, conds) -> Chunk:
     """Fused filter+group+aggregate on device. Raises DeviceUnsupported to
     trigger host fallback."""
@@ -62,18 +143,9 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
     if not env:
         raise DeviceUnsupported("no columns")
 
-    # filter mask
-    if conds:
-        mask = None
-        for c in conds:
-            f = dev.compile_expr(c, dcols)
-            d, nl = f(env)
-            m = (d != 0) & ~nl
-            mask = m if mask is None else (mask & m)
-    else:
-        mask = jnp.ones(n, dtype=bool)
+    # --- host-side planning only below (no device ops until dispatch) ---
+    cond_fns = [dev.compile_expr(c, dcols) for c in conds]
 
-    # group keys: must evaluate to int-representable arrays
     key_fns = []
     key_meta = []  # (expr, dictionary or None)
     for e in plan.group_exprs:
@@ -89,32 +161,24 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
         else:
             key_meta.append((e, None))
             key_fns.append(dev.compile_expr(e, dcols))
-    key_cols = []
-    key_nulls = []
-    for f in key_fns:
-        d, nl = f(env)
-        key_cols.append(d.astype(jnp.int64))
-        key_nulls.append(nl)
-    if not key_cols:
-        # global aggregate: single group
-        key_cols = [jnp.zeros(n, dtype=jnp.int64)]
-        key_nulls = [jnp.zeros(n, dtype=bool)]
+    n_keys = max(len(key_fns), 1)
+    if key_fns:
+        key_pack = _key_pack(plan.group_exprs, dcols)
+    else:
+        key_pack = ((1, 0),)
 
     # aggregate value columns + op names; avg = sum + count pair
-    val_cols, val_nulls, agg_ops = [], [], []
-    slots = []  # per desc: ("plain", j) | ("avg", j_sum, j_cnt)
+    val_plan, agg_ops = [], []
+    slots = []  # per desc: ("plain", j) | ("avg", j_sum, j_cnt) | ("strcol", j, col)
     for desc in plan.aggs:
         if desc.distinct:
             raise DeviceUnsupported("distinct agg on device")
         arg = desc.args[0] if desc.args else None
         name = desc.name
         if name == "count":
-            f = dev.compile_expr(arg, dcols)
-            d, nl = f(env)
-            val_cols.append(d.astype(jnp.int64))
-            val_nulls.append(nl)
+            val_plan.append((dev.compile_expr(arg, dcols), "int"))
             agg_ops.append("count")
-            slots.append(("plain", len(val_cols) - 1))
+            slots.append(("plain", len(val_plan) - 1))
             continue
         if name not in ("sum", "avg", "min", "max", "first_row"):
             raise DeviceUnsupported(f"agg {name} on device")
@@ -123,47 +187,55 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
             if not isinstance(arg, ExprColumn):
                 raise DeviceUnsupported("string agg arg must be a column")
             # dictionary from np.unique is sorted → code order == byte order
-            f = dev.compile_expr(arg, dcols)
-            d, nl = f(env)
-            val_cols.append(d.astype(jnp.int64))
-            val_nulls.append(nl)
+            val_plan.append((dev.compile_expr(arg, dcols), "int"))
             agg_ops.append({"min": "min", "max": "max",
                             "first_row": "first"}[name])
-            slots.append(("strcol", len(val_cols) - 1, arg.idx))
+            slots.append(("strcol", len(val_plan) - 1, arg.idx))
             continue
         if k == K_STR:
             raise DeviceUnsupported("string sum/avg")
         f = dev.compile_expr(arg, dcols)
-        d, nl = f(env)
-        is_float = d.dtype == jnp.float64
+        is_float = k == K_FLOAT
         if name in ("min", "max", "first_row"):
-            val_cols.append(d)
-            val_nulls.append(nl)
+            val_plan.append((f, "raw"))
             agg_ops.append({"min": "min", "max": "max",
                             "first_row": "first"}[name])
-            slots.append(("plain", len(val_cols) - 1))
+            slots.append(("plain", len(val_plan) - 1))
         elif name == "sum":
-            val_cols.append(d)
-            val_nulls.append(nl)
+            val_plan.append((f, "raw"))
             agg_ops.append("sum_f" if is_float else "sum_i")
-            slots.append(("plain", len(val_cols) - 1))
+            slots.append(("plain", len(val_plan) - 1))
         else:  # avg
-            val_cols.append(d)
-            val_nulls.append(nl)
+            val_plan.append((f, "raw"))
             agg_ops.append("sum_f" if is_float else "sum_i")
-            j_sum = len(val_cols) - 1
-            val_cols.append(d.astype(jnp.int64) if not is_float else d)
-            val_nulls.append(nl)
+            j_sum = len(val_plan) - 1
+            val_plan.append((f, "raw" if is_float else "int"))
             agg_ops.append("count")
-            slots.append(("avg", j_sum, len(val_cols) - 1))
+            slots.append(("avg", j_sum, len(val_plan) - 1))
 
+    sig_exprs = ";".join(
+        [_expr_sig(c) for c in conds] + ["|g|"] +
+        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
+        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
+         for d in plan.aggs] +
+        [str(id(dc.dictionary)) for dc in dcols.values()
+         if dc.dictionary is not None])
+
+    dict_refs = tuple(dc.dictionary for dc in dcols.values()
+                      if dc.dictionary is not None)
     est = _estimate_groups(plan, n)
     capacity = dev.next_pow2(min(n, max(est, 16)))
     while True:
-        out = dev._agg_kernel(tuple(key_cols), tuple(key_nulls),
-                              tuple(val_cols), tuple(val_nulls), mask,
-                              n_keys=len(key_cols), agg_ops=tuple(agg_ops),
-                              capacity=capacity)
+        key = (sig_exprs, capacity, key_pack, tuple(agg_ops))
+        fn = _pipe_cache_get(key)
+        if fn is None:
+            fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                                 tuple(agg_ops), capacity, key_pack)
+            _pipe_cache_put(key, fn, dict_refs)
+        # ONE batched device→host copy for the whole result tree: per-array
+        # reads pay full fabric round-trip latency each (~150ms over a
+        # remote-device tunnel), and there are a dozen small result arrays
+        out = jax.device_get(fn(env))
         key_out, key_null_out, results, result_nulls, n_groups, _valid = out
         ng = int(n_groups)
         if ng <= capacity:
@@ -228,6 +300,35 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
     if not out_cols:
         raise DeviceUnsupported("agg with no outputs")
     return Chunk(out_cols)
+
+
+_DATE_PACK = (24, 1 << 22)  # MySQL DATE days: [-354285, 2932896] + margin
+
+
+def _key_pack(group_exprs, dcols):
+    """Static (bits, offset) per group key when every key's value range is
+    known a priori — dict codes (cardinality = dictionary size) and DATE
+    days (bounded by MySQL's DATE domain). Enables the single-argsort
+    packed path in _agg_kernel. None when any key is unbounded or the
+    total exceeds 62 bits."""
+    pack = []
+    total = 0
+    for e in group_exprs:
+        k = phys_kind(e.ftype)
+        if k == K_STR and isinstance(e, ExprColumn):
+            dc = dcols.get(e.idx)
+            if dc is None or dc.dictionary is None:
+                return None
+            bits = max(int(len(dc.dictionary) + 1).bit_length(), 1)
+            pack.append((bits, 0))
+        elif k == K_DATE:
+            pack.append(_DATE_PACK)
+        else:
+            return None
+        total += pack[-1][0]
+    if total > 62:
+        return None
+    return tuple(pack)
 
 
 def _estimate_groups(plan, n):

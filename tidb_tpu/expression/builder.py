@@ -131,6 +131,36 @@ def infer_arith_type(op: str, lft: FieldType, rft: FieldType) -> FieldType:
     return FieldType(tp=TYPE_LONGLONG)
 
 
+def refine_cmp_const(e, other):
+    """Fold a comparison constant to the other side's physical type at plan
+    time (reference: expression/builtin_compare.go refineArgs). A string
+    constant compared with a temporal column becomes a date/datetime
+    constant ONCE — instead of parsing the string per row at eval time —
+    which also unlocks the device (TPU) compare path. Unparseable strings
+    are left alone (eval-time semantics then apply, warnings included)."""
+    if not isinstance(e, Constant) or e.value is None:
+        return e
+    if isinstance(other, Constant):
+        return e
+    if phys_kind(e.ftype) != K_STR:
+        return e
+    tk = other.ftype.tp
+    v = e.value.decode() if isinstance(e.value, bytes) else str(e.value)
+    try:
+        if tk in (TYPE_DATE, TYPE_NEWDATE):
+            return Constant(parse_date_str(v), FieldType(tp=TYPE_DATE))
+        if tk in (TYPE_DATETIME, TYPE_TIMESTAMP):
+            return Constant(parse_datetime_str(v),
+                            FieldType(tp=TYPE_DATETIME))
+        if phys_kind(other.ftype) in (K_INT, K_DEC, K_FLOAT):
+            # MySQL compares string vs numeric as double; only refine when
+            # the whole string parses (prefix-parse semantics stay at eval)
+            return Constant(float(v), FieldType(tp=TYPE_DOUBLE))
+    except (ValueError, TiDBError):
+        pass
+    return e
+
+
 def literal_to_constant(lit: ast.Literal) -> Constant:
     k = lit.kind
     if k == "null":
@@ -241,6 +271,8 @@ class ExprBuilder:
             raise TiDBError(f"unsupported operator {node.op}")
         l = self.build(node.left)
         r = self.build(node.right)
+        if op in ("eq", "ne", "lt", "le", "gt", "ge", "nulleq"):
+            l, r = refine_cmp_const(l, r), refine_cmp_const(r, l)
         ft = infer_arith_type(op, l.ftype, r.ftype)
         return ScalarFunc(op, [l, r], ft)
 
@@ -279,8 +311,8 @@ class ExprBuilder:
 
     def _b_BetweenExpr(self, node):
         e = self.build(node.expr)
-        lo = self.build(node.low)
-        hi = self.build(node.high)
+        lo = refine_cmp_const(self.build(node.low), e)
+        hi = refine_cmp_const(self.build(node.high), e)
         ge = ScalarFunc("ge", [e, lo], _BOOL_FT.clone())
         le = ScalarFunc("le", [e, hi], _BOOL_FT.clone())
         res = ScalarFunc("and", [ge, le], _BOOL_FT.clone())
@@ -298,7 +330,8 @@ class ExprBuilder:
             sub_ft = fts[0] if fts else target.ftype
             e = build_in_set(target, [r[0] for r in rows], sub_ft)
         else:
-            items = [self.build(i) for i in node.items]
+            items = [refine_cmp_const(self.build(i), target)
+                     for i in node.items]
             consts = all(isinstance(i, Constant) for i in items)
             kinds = {phys_kind(i.ftype) for i in items if i.value is not None}
             if consts and (phys_kind(target.ftype) == K_STR) == (kinds <= {K_STR}):

@@ -65,12 +65,23 @@ class DeviceCol:
 
 
 def to_device_col(col) -> DeviceCol:
-    """utils.chunk.Column → DeviceCol. Strings are dict-encoded host-side."""
+    """utils.chunk.Column → DeviceCol. Strings are dict-encoded host-side.
+
+    The device arrays are cached on the Column: a table's working set is
+    uploaded to HBM once per columnar-cache version and reused across
+    queries (the transfer — not the kernel — dominates when the device
+    sits across a fabric/tunnel)."""
+    if col._device is None:
+        if col.data.dtype == object:
+            codes, _uniq = col.dict_encode()
+            col._device = (jnp.asarray(codes), jnp.asarray(col.nulls))
+        else:
+            col._device = (jnp.asarray(col.data), jnp.asarray(col.nulls))
+    data, nulls = col._device
     if col.data.dtype == object:
-        codes, uniq = col.dict_encode()
-        return DeviceCol(jnp.asarray(codes), jnp.asarray(col.nulls),
-                         col.ftype, dictionary=uniq)
-    return DeviceCol(jnp.asarray(col.data), jnp.asarray(col.nulls), col.ftype)
+        _codes, uniq = col.dict_encode()
+        return DeviceCol(data, nulls, col.ftype, dictionary=uniq)
+    return DeviceCol(data, nulls, col.ftype)
 
 
 # ---------------------------------------------------------------------------
@@ -569,100 +580,157 @@ def _compile_str_in(sf, cols):
 # fused aggregation pipeline
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("n_keys", "agg_ops", "capacity"))
-def _agg_kernel(key_cols, key_nulls, val_cols, val_nulls, mask,
-                n_keys, agg_ops, capacity):
+def _seg_running(comb_val, is_new, z):
+    """Segmented running reduction: resets at every True in is_new. Classic
+    (flag, value) associative-scan operator — log-depth, fully vectorized,
+    no scatter (scatters serialize on TPU)."""
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, comb_val(va, vb))
+    _f, run = jax.lax.associative_scan(comb, (is_new, z))
+    return run
+
+
+def _group_spans(is_new, kept, n, capacity):
+    """Group boundary arithmetic shared by the single-chip kernel and the
+    MPP partial/final stages: starts from static-size nonzero, end_g = next
+    start (or kept for the last group). Returns (starts, ends, end_idx,
+    span_sum) where span_sum(z) = per-group sums of z via exclusive prefix
+    sums (exact for ints — two's-complement differences cancel; float sums
+    must use _seg_running instead to keep rounding error group-local)."""
+    (starts,) = jnp.nonzero(is_new, size=capacity, fill_value=n)
+    ends = jnp.minimum(jnp.concatenate(
+        [starts[1:], jnp.full(1, n, dtype=starts.dtype)]), kept)
+    end_idx = jnp.clip(ends - 1, 0, jnp.maximum(n - 1, 0))
+
+    def span_sum(z):
+        c = jnp.concatenate([jnp.zeros(1, dtype=z.dtype), jnp.cumsum(z)])
+        return c[ends] - c[jnp.minimum(starts, n)]
+
+    return starts, ends, end_idx, span_sum
+
+
+def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
+              n_keys, agg_ops, capacity, pack=None):
     """One fused kernel: filter mask + group-by + aggregate.
 
-    Sort-based grouping (iterated stable argsort = lexsort) + segment_sum —
-    the XLA-native answer to the reference's hash tables: static shapes, no
-    data-dependent control flow. Filtered rows go to a trash segment at index
-    `capacity`; real groups occupy [0, capacity). If the data has more than
-    `capacity` groups the caller detects n_groups > capacity and retries
-    with a bigger static capacity (one extra compile, never wrong results).
+    Sort-based grouping + boundary arithmetic — the XLA/TPU-native answer to
+    the reference's hash tables (executor/aggregate.go): static shapes, no
+    data-dependent control flow, and NO scatters (XLA lowers scatter-adds to
+    a serialized loop on TPU; sort + cumsum + gather are all parallel).
+    Per aggregate: exclusive-prefix-sum, then sum over a group = csum[end] -
+    csum[start]; min/max via segmented associative scan. Groups beyond
+    `capacity` are detected (n_groups > capacity) and the caller retries
+    with a bigger static capacity — one extra compile, never wrong results.
 
     key_cols: tuple of int64 arrays (dict codes / ints). agg_ops: tuple of
     ("sum_i"|"sum_f"|"count"|"min"|"max"|"first") aligned with val_cols.
+
+    pack: optional static tuple of (bits, offset) per key when every key's
+    value range fits a known bit width (dict codes, dates). All keys, their
+    null flags, and the filter mask then fold into ONE sort key — int32
+    when it fits (64-bit ALU ops are emulated pairs on TPU) — giving one
+    argsort instead of 2·n_keys+1. NULL packs as 0 (its own group);
+    filtered-out rows pack as the dtype max and sort last.
     """
     n = mask.shape[0]
-    trash = capacity
-    nseg = capacity + 1
-    # combined sort: minor-to-major stable argsort over keys, then kept-first.
-    # Each key is the compound (null_flag, value) — null sorted as its own
-    # most-significant bit so a NULL never collides with any real value
-    # (NULL ≠ -1; mysql GROUP BY groups NULLs together but apart from values)
-    order = jnp.arange(n)
-    for i in range(n_keys - 1, -1, -1):
-        order = order[jnp.argsort(key_cols[i][order], stable=True)]
-        order = order[jnp.argsort(key_nulls[i][order], stable=True)]
-    order = order[jnp.argsort(~mask[order], stable=True)]
     kept = jnp.sum(mask)
     pos = jnp.arange(n)
     in_range = pos < kept
-    # boundary flags on the sorted, kept prefix
-    is_new = jnp.zeros(n, dtype=bool).at[0].set(n > 0)
-    for i in range(n_keys):
-        k = key_cols[i][order]
-        kn = key_nulls[i][order]
-        prev = jnp.concatenate([k[:1], k[:-1]])
-        prev_n = jnp.concatenate([kn[:1], kn[:-1]])
-        changed = jnp.where(kn | prev_n, kn != prev_n, k != prev)
-        is_new = is_new | changed
-    is_new = is_new & in_range
-    gid = jnp.cumsum(is_new.astype(jnp.int64)) - 1
+    if pack is not None:
+        total_bits = sum(b for b, _o in pack)
+        dt = jnp.int32 if total_bits < 31 else jnp.int64
+        packed = jnp.zeros(n, dtype=dt)
+        for i, (bits, offset) in enumerate(pack):
+            v = jnp.where(key_nulls[i], jnp.zeros((), dtype=dt),
+                          key_cols[i].astype(dt)
+                          + jnp.asarray(offset + 1, dtype=dt))
+            packed = (packed << bits) | v
+        sort_val = jnp.where(mask, packed, jnp.iinfo(dt).max)
+        order = jnp.argsort(sort_val, stable=True)
+        sv = sort_val[order]
+        prev = jnp.concatenate([sv[:1], sv[:-1]])
+        is_new = (jnp.zeros(n, dtype=bool).at[0].set(n > 0) | (sv != prev))
+        is_new = is_new & in_range
+    else:
+        # combined sort: minor-to-major stable argsort over keys, then
+        # kept-first. Each key is the compound (null_flag, value) — null is
+        # its own most-significant bit so a NULL never collides with any
+        # real value (NULL ≠ -1; GROUP BY groups NULLs apart from values)
+        order = jnp.arange(n)
+        for i in range(n_keys - 1, -1, -1):
+            order = order[jnp.argsort(key_cols[i][order], stable=True)]
+            order = order[jnp.argsort(key_nulls[i][order], stable=True)]
+        order = order[jnp.argsort(~mask[order], stable=True)]
+        # boundary flags on the sorted, kept prefix
+        is_new = jnp.zeros(n, dtype=bool).at[0].set(n > 0)
+        for i in range(n_keys):
+            k = key_cols[i][order]
+            kn = key_nulls[i][order]
+            prev = jnp.concatenate([k[:1], k[:-1]])
+            prev_n = jnp.concatenate([kn[:1], kn[:-1]])
+            changed = jnp.where(kn | prev_n, kn != prev_n, k != prev)
+            is_new = is_new | changed
+        is_new = is_new & in_range
     n_groups = jnp.sum(is_new)
-    seg = jnp.where(in_range & (gid < capacity), gid, trash)
-    # representative row index per group (first in sort order)
-    rep = jnp.full(nseg, n, dtype=jnp.int64)
-    rep = rep.at[seg].min(jnp.where(in_range, order, n))
-    rep_safe = jnp.clip(rep[:capacity], 0, jnp.maximum(n - 1, 0))
+    # slots past n_groups hold garbage — callers slice [:n_groups] / mask
+    # with `valid`
+    starts, _ends, end_idx, span_sum = _group_spans(is_new, kept, n, capacity)
+    # representative row (first of group in sort order = first in original
+    # order for equal keys, since the sorts are stable)
+    rep_safe = jnp.clip(order[jnp.clip(starts, 0, jnp.maximum(n - 1, 0))],
+                        0, jnp.maximum(n - 1, 0))
     key_out = tuple(k[rep_safe] for k in key_cols)
     key_null_out = tuple(kn[rep_safe] for kn in key_nulls)
     results = []
     result_nulls = []
     for j, opn in enumerate(agg_ops):
-        v = val_cols[j][order]
-        vn = val_nulls[j][order] | ~in_range
-        if opn == "count":
-            cnt = jax.ops.segment_sum((~vn).astype(jnp.int64), seg,
-                                      num_segments=nseg)[:capacity]
-            results.append(cnt)
-            result_nulls.append(jnp.zeros(capacity, dtype=bool))
-            continue
-        nonnull = jax.ops.segment_sum((~vn).astype(jnp.int64), seg,
-                                      num_segments=nseg)[:capacity]
-        if opn == "sum_i":
-            s = jax.ops.segment_sum(jnp.where(vn, 0, v.astype(jnp.int64)),
-                                    seg, num_segments=nseg)[:capacity]
-            results.append(s)
-        elif opn == "sum_f":
-            s = jax.ops.segment_sum(jnp.where(vn, 0.0, v.astype(jnp.float64)),
-                                    seg, num_segments=nseg)[:capacity]
-            results.append(s)
-        elif opn == "min":
-            big = (jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
-                   else jnp.iinfo(v.dtype).max)
-            s = jax.ops.segment_min(jnp.where(vn, big, v), seg,
-                                    num_segments=nseg)[:capacity]
-            results.append(s)
-        elif opn == "max":
-            small = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
-                     else jnp.iinfo(v.dtype).min)
-            s = jax.ops.segment_max(jnp.where(vn, small, v), seg,
-                                    num_segments=nseg)[:capacity]
-            results.append(s)
-        elif opn == "first":
+        if opn == "first":
             # first row's own value AND null flag (mirrors host first_row;
             # a NULL in the representative row must stay NULL)
             results.append(val_cols[j][rep_safe])
             result_nulls.append(val_nulls[j][rep_safe])
             continue
+        v = val_cols[j][order]
+        vn = val_nulls[j][order] | ~in_range
+        nonnull = span_sum((~vn).astype(jnp.int64))
+        if opn == "count":
+            results.append(nonnull)
+            result_nulls.append(jnp.zeros(capacity, dtype=bool))
+            continue
+        if opn == "sum_i":
+            results.append(span_sum(jnp.where(vn, 0, v.astype(jnp.int64))))
+        elif opn == "sum_f":
+            # segmented scan, NOT prefix-sum differences: c[end]-c[start]
+            # carries the whole column's magnitude into each group's
+            # rounding error (catastrophic cancellation); the scan resets
+            # per group so error stays group-local
+            run = _seg_running(jnp.add, is_new,
+                               jnp.where(vn, 0.0, v.astype(jnp.float64)))
+            results.append(run[end_idx])
+        elif opn == "min":
+            big = (jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                   else jnp.iinfo(v.dtype).max)
+            run = _seg_running(jnp.minimum, is_new, jnp.where(vn, big, v))
+            results.append(run[end_idx])
+        elif opn == "max":
+            small = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                     else jnp.iinfo(v.dtype).min)
+            run = _seg_running(jnp.maximum, is_new, jnp.where(vn, small, v))
+            results.append(run[end_idx])
         else:
             raise ValueError(opn)
         result_nulls.append(nonnull == 0)
     valid = jnp.arange(capacity) < n_groups
     return key_out, key_null_out, tuple(results), tuple(result_nulls), n_groups, valid
 
+
+#: jitted standalone entry (graft entry / direct kernel callers); the SQL
+#: executor instead traces _agg_impl inside its own fused pipeline jit
+_agg_kernel = functools.partial(
+    jax.jit, static_argnames=("n_keys", "agg_ops", "capacity", "pack"))(
+        _agg_impl)
 
 # ---------------------------------------------------------------------------
 # two-pass sort join kernels

@@ -70,10 +70,18 @@ def shard_batch(mesh: Mesh, *arrays, axis: str = "part"):
 def _local_agg(keys, valid, vals, kinds, capacity):
     """Group `vals` by int64 `keys` (invalid rows ignored) into at most
     `capacity` groups. Returns (group_keys[cap], outs tuple[cap],
-    out_valid[cap], n_groups). Pure traced code — static shapes only."""
+    out_valid[cap], n_groups). Pure traced code — static shapes only.
+
+    Scatter-free (XLA serializes scatters on TPU): sort + boundary
+    cumsum/gather for sums, segmented associative scan for min/max — the
+    same scheme as ops/device._agg_impl, single-key variant.
+
+    Known limit: a genuine key equal to int64.max is used as the
+    invalid-row sentinel; group keys here are dict codes / hashes, which
+    never reach it."""
+    from ..ops.device import _group_spans, _seg_running
+
     n = keys.shape[0]
-    trash = capacity
-    nseg = capacity + 1
     sort_key = jnp.where(valid, keys, jnp.iinfo(jnp.int64).max)
     order = jnp.argsort(sort_key, stable=True)
     sk = sort_key[order]
@@ -83,29 +91,33 @@ def _local_agg(keys, valid, vals, kinds, capacity):
     prev = jnp.concatenate([sk[:1], sk[:-1]])
     is_new = jnp.zeros(n, dtype=bool).at[0].set(n > 0) | (sk != prev)
     is_new = is_new & in_range
-    gid = jnp.cumsum(is_new.astype(jnp.int64)) - 1
     n_groups = jnp.sum(is_new)
-    seg = jnp.where(in_range & (gid < capacity), gid, trash)
-    # init with int64.min so negative keys survive the scatter-max
-    group_keys = jnp.full(nseg, jnp.iinfo(jnp.int64).min, dtype=jnp.int64)
-    group_keys = group_keys.at[seg].max(
-        jnp.where(in_range, sk, jnp.iinfo(jnp.int64).min))[:capacity]
+    starts, _ends, end_idx, span_sum = _group_spans(is_new, kept, n, capacity)
+    safe = jnp.clip(starts, 0, jnp.maximum(n - 1, 0))
+    group_keys = sk[safe]
+
     outs = []
     for v, kind in zip(vals, kinds):
         sv = v[order]
         if kind in ("sum", "count"):
             z = jnp.where(in_range, sv, jnp.zeros((), dtype=sv.dtype))
-            outs.append(jax.ops.segment_sum(z, seg, num_segments=nseg)[:capacity])
+            if jnp.issubdtype(sv.dtype, jnp.floating):
+                # keep float rounding error group-local (see _group_spans)
+                outs.append(_seg_running(jnp.add, is_new, z)[end_idx])
+            else:
+                outs.append(span_sum(z))
         elif kind == "min":
             big = (jnp.inf if jnp.issubdtype(sv.dtype, jnp.floating)
                    else jnp.iinfo(sv.dtype).max)
-            z = jnp.where(in_range, sv, big)
-            outs.append(jax.ops.segment_min(z, seg, num_segments=nseg)[:capacity])
+            run = _seg_running(jnp.minimum, is_new,
+                               jnp.where(in_range, sv, big))
+            outs.append(run[end_idx])
         elif kind == "max":
             small = (-jnp.inf if jnp.issubdtype(sv.dtype, jnp.floating)
                      else jnp.iinfo(sv.dtype).min)
-            z = jnp.where(in_range, sv, small)
-            outs.append(jax.ops.segment_max(z, seg, num_segments=nseg)[:capacity])
+            run = _seg_running(jnp.maximum, is_new,
+                               jnp.where(in_range, sv, small))
+            outs.append(run[end_idx])
         else:
             raise ValueError(kind)
     out_valid = jnp.arange(capacity) < jnp.minimum(n_groups, capacity)

@@ -52,7 +52,7 @@ def np_dtype_for(ft: FieldType):
 class Column:
     """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
 
-    __slots__ = ("ftype", "data", "nulls", "_dict")
+    __slots__ = ("ftype", "data", "nulls", "_dict", "_device")
 
     def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
         self.ftype = ftype
@@ -60,7 +60,8 @@ class Column:
         if nulls is None:
             nulls = np.zeros(len(data), dtype=bool)
         self.nulls = nulls
-        self._dict = None  # cached (codes, uniques) for device encoding
+        self._dict = None    # cached (codes, uniques) for device encoding
+        self._device = None  # cached (jnp data, jnp nulls) resident in HBM
 
     def __len__(self):
         return len(self.data)
