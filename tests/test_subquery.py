@@ -1,0 +1,78 @@
+"""Correlated subqueries, quantified comparisons, and CTE edge cases
+(code-review round 2 regressions)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("create database subq")
+    tk.must_exec("use subq")
+    tk.must_exec("create table t1 (a bigint, k bigint)")
+    tk.must_exec("create table t2 (b decimal(10,2), k bigint)")
+    tk.must_exec("insert into t1 values (1, 1), (2, 1), (3, 2)")
+    tk.must_exec("insert into t2 values (1.00, 1), (2.50, 1), (3.00, 2)")
+    tk.must_exec("create table emp (id bigint, dept bigint, sal bigint)")
+    tk.must_exec("insert into emp values (1,10,100),(2,10,200),(3,20,50)")
+    return tk
+
+
+def test_correlated_in_decimal_vs_int(tk):
+    # scaled-decimal internals must unify with the int target (1 = 1.00)
+    r = tk.must_query(
+        "select a from t1 where a in (select b from t2 where t2.k = t1.k) "
+        "order by a")
+    r.check([("1",), ("3",)])
+
+
+def test_correlated_any_all(tk):
+    r = tk.must_query(
+        "select a from t1 where a > any (select b from t2 where t2.k = t1.k) "
+        "order by a")
+    r.check([("2",)])
+    r = tk.must_query(
+        "select a from t1 where a >= all (select b from t2 where t2.k = t1.k) "
+        "order by a")
+    r.check([("3",)])
+
+
+def test_correlated_in_agg_select_list(tk):
+    # outer ref inside the subquery's aggregated SELECT list / HAVING
+    r = tk.must_query(
+        "select id from emp e where exists (select count(*) from t1 "
+        "having count(*) > e.dept - 10) order by id")
+    r.check([("1",), ("2",)])  # count=3 > 0 for dept 10; 3 > 10 false for 20
+    r = tk.must_query(
+        "select (select max(b) + t1.a from t2) from t1 where a = 1")
+    r.check([("4.00",)])
+
+
+def test_recursive_cte_rejected(tk):
+    e = tk.exec_error(
+        "with recursive r as (select 1 as n union all "
+        "select n + 1 from r where n < 3) select * from r")
+    assert "Recursive CTE" in str(e)
+
+
+def test_cte_column_count_mismatch(tk):
+    e = tk.exec_error("with c (x, y) as (select 1) select x from c")
+    assert "different column counts" in str(e)
+
+
+def test_with_in_derived_table(tk):
+    r = tk.must_query(
+        "select * from (with x as (select 1 as a) select * from x) d")
+    r.check([("1",)])
+
+
+def test_uncorrelated_still_works(tk):
+    r = tk.must_query(
+        "select a from t1 where a in (select b from t2) order by a")
+    r.check([("1",), ("3",)])
+    r = tk.must_query(
+        "select a from t1 where exists (select * from t2 where b > 2.9) "
+        "order by a")
+    r.check([("1",), ("2",), ("3",)])

@@ -1,0 +1,481 @@
+"""All 22 TPC-H queries end-to-end on small generated data.
+
+Two assertions per query: it executes, and the host and device engines
+return identical rows (the parity requirement of the north-star benchmark).
+Data is random but deterministic; sizes are small enough for CI yet
+non-trivial (joins produce matches, filters pass rows)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+SF = 0.002  # ~120 orders, ~480 lineitems
+
+
+def _d(days):
+    base = np.datetime64("1992-01-01")
+    return str(base + np.timedelta64(int(days), "D"))
+
+
+@pytest.fixture(scope="module")
+def tk():
+    rng = np.random.default_rng(7)
+    tk = TestKit()
+    tk.must_exec("create database tpch_t")
+    tk.must_exec("use tpch_t")
+    tk.must_exec("""create table region (
+        r_regionkey bigint primary key, r_name varchar(25),
+        r_comment varchar(152))""")
+    tk.must_exec("""create table nation (
+        n_nationkey bigint primary key, n_name varchar(25),
+        n_regionkey bigint, n_comment varchar(152))""")
+    tk.must_exec("""create table supplier (
+        s_suppkey bigint primary key, s_name varchar(25),
+        s_address varchar(40), s_nationkey bigint, s_phone varchar(15),
+        s_acctbal decimal(15,2), s_comment varchar(101))""")
+    tk.must_exec("""create table part (
+        p_partkey bigint primary key, p_name varchar(55),
+        p_mfgr varchar(25), p_brand varchar(10), p_type varchar(25),
+        p_size bigint, p_container varchar(10),
+        p_retailprice decimal(15,2), p_comment varchar(23))""")
+    tk.must_exec("""create table partsupp (
+        ps_partkey bigint, ps_suppkey bigint, ps_availqty bigint,
+        ps_supplycost decimal(15,2), ps_comment varchar(199))""")
+    tk.must_exec("""create table customer (
+        c_custkey bigint primary key, c_name varchar(25),
+        c_address varchar(40), c_nationkey bigint, c_phone varchar(15),
+        c_acctbal decimal(15,2), c_mktsegment varchar(10),
+        c_comment varchar(117))""")
+    tk.must_exec("""create table orders (
+        o_orderkey bigint primary key, o_custkey bigint,
+        o_orderstatus varchar(1), o_totalprice decimal(15,2),
+        o_orderdate date, o_orderpriority varchar(15),
+        o_clerk varchar(15), o_shippriority bigint,
+        o_comment varchar(79))""")
+    tk.must_exec("""create table lineitem (
+        l_orderkey bigint, l_partkey bigint, l_suppkey bigint,
+        l_linenumber bigint, l_quantity decimal(15,2),
+        l_extendedprice decimal(15,2), l_discount decimal(15,2),
+        l_tax decimal(15,2), l_returnflag varchar(1),
+        l_linestatus varchar(1), l_shipdate date, l_commitdate date,
+        l_receiptdate date, l_shipinstruct varchar(25),
+        l_shipmode varchar(10), l_comment varchar(44))""")
+
+    regions = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+    for i, r in enumerate(regions):
+        tk.must_exec(f"insert into region values ({i}, '{r}', 'c{i}')")
+    nations = [("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1),
+               ("CANADA", 1), ("EGYPT", 4), ("ETHIOPIA", 0),
+               ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("CHINA", 2),
+               ("JAPAN", 2), ("KENYA", 0), ("MOROCCO", 0), ("PERU", 1),
+               ("ROMANIA", 3), ("SAUDI ARABIA", 4), ("VIETNAM", 2),
+               ("RUSSIA", 3), ("UNITED KINGDOM", 3), ("UNITED STATES", 1)]
+    for i, (nm, rk) in enumerate(nations):
+        tk.must_exec(f"insert into nation values ({i}, '{nm}', {rk}, 'x')")
+
+    n_supp, n_part, n_cust = 20, 40, 30
+    n_orders = int(150_000 * SF * 0.4) or 100
+    segments = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+                "HOUSEHOLD"]
+    brands = [f"Brand#{i}{j}" for i in (1, 2, 3, 4, 5) for j in (1, 2, 3)]
+    types_ = [f"{a} {b} {c}" for a in ("STANDARD", "SMALL", "MEDIUM",
+                                       "LARGE", "ECONOMY", "PROMO")
+              for b in ("ANODIZED", "BURNISHED", "PLATED")
+              for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")][:40]
+    containers = ["SM CASE", "SM BOX", "MED BAG", "MED BOX", "LG CASE",
+                  "LG BOX", "WRAP CASE", "JUMBO PKG"]
+    modes = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+    instr = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+    prios = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+
+    for i in range(n_supp):
+        bal = round(float(rng.uniform(-999, 9999)), 2)
+        comment = ("Customer Complaints xx" if i % 7 == 3 else f"s{i}")
+        tk.must_exec(
+            f"insert into supplier values ({i}, 'Supplier#{i:09d}', "
+            f"'addr{i}', {int(rng.integers(0, 20))}, "
+            f"'{int(rng.integers(10, 34))}-{i:07d}', {bal}, '{comment}')")
+    for i in range(n_part):
+        nm = f"{'forest ' if i % 5 == 0 else ''}thing {i}"
+        tk.must_exec(
+            f"insert into part values ({i}, '{nm}', 'Manufacturer#{i % 5 + 1}', "
+            f"'{brands[i % len(brands)]}', '{types_[i % len(types_)]}', "
+            f"{int(rng.integers(1, 50))}, '{containers[i % len(containers)]}', "
+            f"{round(float(rng.uniform(900, 2000)), 2)}, 'p{i}')")
+        for s in (i % n_supp, (i * 7 + 3) % n_supp):
+            tk.must_exec(
+                f"insert into partsupp values ({i}, {s}, "
+                f"{int(rng.integers(1, 9999))}, "
+                f"{round(float(rng.uniform(1, 1000)), 2)}, 'ps{i}_{s}')")
+    for i in range(n_cust):
+        tk.must_exec(
+            f"insert into customer values ({i}, 'Customer#{i:09d}', "
+            f"'caddr{i}', {int(rng.integers(0, 20))}, "
+            f"'{int(rng.integers(10, 34))}-{i:07d}', "
+            f"{round(float(rng.uniform(-999, 9999)), 2)}, "
+            f"'{segments[i % 5]}', 'c{i}')")
+
+    lineno = 0
+    for i in range(n_orders):
+        cust = int(rng.integers(0, n_cust))
+        odate = int(rng.integers(0, 2400))
+        status = "F" if odate < 1200 else "O"
+        tk.must_exec(
+            f"insert into orders values ({i}, {cust}, '{status}', "
+            f"{round(float(rng.uniform(1000, 400000)), 2)}, '{_d(odate)}', "
+            f"'{prios[i % 5]}', 'Clerk#{i % 10:09d}', 0, 'o{i}')")
+        for _l in range(int(rng.integers(1, 5))):
+            lineno += 1
+            part = int(rng.integers(0, n_part))
+            supp = (part + (lineno % 2) * 7 + (0 if lineno % 2 == 0 else 3)) % n_supp
+            sdate = odate + int(rng.integers(1, 120))
+            cdate = odate + int(rng.integers(30, 90))
+            rdate = sdate + int(rng.integers(1, 30))
+            rf = "R" if rng.random() < 0.3 else ("A" if rng.random() < 0.4
+                                                 else "N")
+            tk.must_exec(
+                f"insert into lineitem values ({i}, {part}, {supp}, "
+                f"{lineno}, {int(rng.integers(1, 51))}, "
+                f"{round(float(rng.uniform(901, 95000)), 2)}, "
+                f"0.0{int(rng.integers(0, 9))}, 0.0{int(rng.integers(0, 8))}, "
+                f"'{rf}', '{'F' if status == 'F' else 'O'}', '{_d(sdate)}', "
+                f"'{_d(cdate)}', '{_d(rdate)}', '{instr[lineno % 4]}', "
+                f"'{modes[lineno % 7]}', 'l{lineno}')")
+    return tk
+
+
+def both(tk, sql):
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    host = tk.must_query(sql).rows
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    dev = tk.must_query(sql).rows
+    tk.must_exec("set tidb_executor_engine = 'auto'")
+    assert host == dev, (f"engine divergence\nhost({len(host)}): "
+                         f"{host[:5]}\ntpu({len(dev)}): {dev[:5]}")
+    return host
+
+
+def test_q01(tk):
+    rows = both(tk, """
+        select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+            sum(l_extendedprice) as sum_base_price,
+            sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+            sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+            avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+            avg(l_discount) as avg_disc, count(*) as count_order
+        from lineitem where l_shipdate <= date_sub('1998-12-01', interval 90 day)
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus""")
+    assert rows
+
+
+def test_q02(tk):
+    both(tk, """
+        select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address,
+               s_phone, s_comment
+        from part, supplier, partsupp, nation, region
+        where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+          and p_size = 15 and p_type like '%BRASS'
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'EUROPE'
+          and ps_supplycost = (
+              select min(ps_supplycost)
+              from partsupp, supplier, nation, region
+              where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+                and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+                and r_name = 'EUROPE')
+        order by s_acctbal desc, n_name, s_name, p_partkey limit 100""")
+
+
+def test_q03(tk):
+    rows = both(tk, """
+        select l_orderkey,
+               sum(l_extendedprice * (1 - l_discount)) as revenue,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+          and l_orderkey = o_orderkey and o_orderdate < '1996-01-01'
+          and l_shipdate > '1994-06-01'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate limit 10""")
+    assert rows
+
+
+def test_q04(tk):
+    rows = both(tk, """
+        select o_orderpriority, count(*) as order_count from orders
+        where o_orderdate >= '1993-07-01'
+          and o_orderdate < date_add('1993-07-01', interval 3 month)
+          and exists (select * from lineitem where l_orderkey = o_orderkey
+                      and l_commitdate < l_receiptdate)
+        group by o_orderpriority order by o_orderpriority""")
+    assert rows is not None
+
+
+def test_q05(tk):
+    both(tk, """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+        from customer, orders, lineitem, supplier, nation, region
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'ASIA' and o_orderdate >= '1994-01-01'
+          and o_orderdate < date_add('1994-01-01', interval 1 year)
+        group by n_name order by revenue desc""")
+
+
+def test_q06(tk):
+    rows = both(tk, """
+        select sum(l_extendedprice * l_discount) as revenue from lineitem
+        where l_shipdate >= '1994-01-01'
+          and l_shipdate < date_add('1994-01-01', interval 1 year)
+          and l_discount between 0.02 and 0.08 and l_quantity < 24""")
+    assert len(rows) == 1
+
+
+def test_q07(tk):
+    both(tk, """
+        select supp_nation, cust_nation, l_year, sum(volume) as revenue
+        from (select n1.n_name as supp_nation, n2.n_name as cust_nation,
+                     year(l_shipdate) as l_year,
+                     l_extendedprice * (1 - l_discount) as volume
+              from supplier, lineitem, orders, customer,
+                   nation n1, nation n2
+              where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+                and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+                and c_nationkey = n2.n_nationkey
+                and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+                     or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+                and l_shipdate between '1995-01-01' and '1996-12-31'
+             ) as shipping
+        group by supp_nation, cust_nation, l_year
+        order by supp_nation, cust_nation, l_year""")
+
+
+def test_q08(tk):
+    both(tk, """
+        select o_year,
+               sum(case when nationx = 'BRAZIL' then volume else 0 end)
+                   / sum(volume) as mkt_share
+        from (select year(o_orderdate) as o_year,
+                     l_extendedprice * (1 - l_discount) as volume,
+                     n2.n_name as nationx
+              from part, supplier, lineitem, orders, customer,
+                   nation n1, nation n2, region
+              where p_partkey = l_partkey and s_suppkey = l_suppkey
+                and l_orderkey = o_orderkey and o_custkey = c_custkey
+                and c_nationkey = n1.n_nationkey
+                and n1.n_regionkey = r_regionkey and r_name = 'AMERICA'
+                and s_nationkey = n2.n_nationkey
+                and o_orderdate between '1995-01-01' and '1996-12-31'
+             ) as all_nations
+        group by o_year order by o_year""")
+
+
+def test_q09(tk):
+    both(tk, """
+        select nationx, o_year, sum(amount) as sum_profit
+        from (select n_name as nationx, year(o_orderdate) as o_year,
+                     l_extendedprice * (1 - l_discount)
+                     - ps_supplycost * l_quantity as amount
+              from part, supplier, lineitem, partsupp, orders, nation
+              where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+                and ps_partkey = l_partkey and p_partkey = l_partkey
+                and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+                and p_name like '%thing%'
+             ) as profit
+        group by nationx, o_year order by nationx, o_year desc""")
+
+
+def test_q10(tk):
+    both(tk, """
+        select c_custkey, c_name,
+               sum(l_extendedprice * (1 - l_discount)) as revenue,
+               c_acctbal, n_name, c_address, c_phone, c_comment
+        from customer, orders, lineitem, nation
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and o_orderdate >= '1993-10-01'
+          and o_orderdate < date_add('1993-10-01', interval 3 month)
+          and l_returnflag = 'R' and c_nationkey = n_nationkey
+        group by c_custkey, c_name, c_acctbal, c_phone, n_name,
+                 c_address, c_comment
+        order by revenue desc limit 20""")
+
+
+def test_q11(tk):
+    both(tk, """
+        select ps_partkey, sum(ps_supplycost * ps_availqty) as value_
+        from partsupp, supplier, nation
+        where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+          and n_name = 'GERMANY'
+        group by ps_partkey
+        having sum(ps_supplycost * ps_availqty) > (
+            select sum(ps_supplycost * ps_availqty) * 0.0001
+            from partsupp, supplier, nation
+            where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+              and n_name = 'GERMANY')
+        order by value_ desc""")
+
+
+def test_q12(tk):
+    both(tk, """
+        select l_shipmode,
+               sum(case when o_orderpriority = '1-URGENT'
+                        or o_orderpriority = '2-HIGH'
+                   then 1 else 0 end) as high_line_count,
+               sum(case when o_orderpriority <> '1-URGENT'
+                        and o_orderpriority <> '2-HIGH'
+                   then 1 else 0 end) as low_line_count
+        from orders, lineitem
+        where o_orderkey = l_orderkey and l_shipmode in ('MAIL', 'SHIP')
+          and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+          and l_receiptdate >= '1994-01-01'
+          and l_receiptdate < date_add('1994-01-01', interval 1 year)
+        group by l_shipmode order by l_shipmode""")
+
+
+def test_q13(tk):
+    both(tk, """
+        select c_count, count(*) as custdist
+        from (select c_custkey, count(o_orderkey) as c_count
+              from customer left outer join orders
+                on c_custkey = o_custkey
+                and o_comment not like '%special%requests%'
+              group by c_custkey) as c_orders
+        group by c_count order by custdist desc, c_count desc""")
+
+
+def test_q14(tk):
+    rows = both(tk, """
+        select 100.00 * sum(case when p_type like 'PROMO%'
+                            then l_extendedprice * (1 - l_discount)
+                            else 0 end)
+               / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+        from lineitem, part
+        where l_partkey = p_partkey and l_shipdate >= '1995-09-01'
+          and l_shipdate < date_add('1995-09-01', interval 1 month)""")
+    assert len(rows) == 1
+
+
+def test_q15(tk):
+    both(tk, """
+        with revenue0 as (
+            select l_suppkey as supplier_no,
+                   sum(l_extendedprice * (1 - l_discount)) as total_revenue
+            from lineitem
+            where l_shipdate >= '1996-01-01'
+              and l_shipdate < date_add('1996-01-01', interval 3 month)
+            group by l_suppkey)
+        select s_suppkey, s_name, s_address, s_phone, total_revenue
+        from supplier, revenue0
+        where s_suppkey = supplier_no
+          and total_revenue = (select max(total_revenue) from revenue0)
+        order by s_suppkey""")
+
+
+def test_q16(tk):
+    both(tk, """
+        select p_brand, p_type, p_size,
+               count(distinct ps_suppkey) as supplier_cnt
+        from partsupp, part
+        where p_partkey = ps_partkey and p_brand <> 'Brand#45'
+          and p_type not like 'MEDIUM%'
+          and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+          and ps_suppkey not in (select s_suppkey from supplier
+                                 where s_comment like '%Customer%Complaints%')
+        group by p_brand, p_type, p_size
+        order by supplier_cnt desc, p_brand, p_type, p_size""")
+
+
+def test_q17(tk):
+    rows = both(tk, """
+        select sum(l_extendedprice) / 7.0 as avg_yearly
+        from lineitem, part
+        where p_partkey = l_partkey and p_brand = 'Brand#23'
+          and p_container = 'MED BOX'
+          and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
+                            where l_partkey = p_partkey)""")
+    assert len(rows) == 1
+
+
+def test_q18(tk):
+    both(tk, """
+        select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity)
+        from customer, orders, lineitem
+        where o_orderkey in (select l_orderkey from lineitem
+                             group by l_orderkey
+                             having sum(l_quantity) > 100)
+          and c_custkey = o_custkey and o_orderkey = l_orderkey
+        group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        order by o_totalprice desc, o_orderdate limit 100""")
+
+
+def test_q19(tk):
+    both(tk, """
+        select sum(l_extendedprice * (1 - l_discount)) as revenue
+        from lineitem, part
+        where (p_partkey = l_partkey and p_brand = 'Brand#12'
+               and p_container in ('SM CASE', 'SM BOX')
+               and l_quantity >= 1 and l_quantity <= 11
+               and p_size between 1 and 5
+               and l_shipmode in ('AIR', 'REG AIR')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#23'
+               and p_container in ('MED BAG', 'MED BOX')
+               and l_quantity >= 10 and l_quantity <= 20
+               and p_size between 1 and 10
+               and l_shipmode in ('AIR', 'REG AIR')
+               and l_shipinstruct = 'DELIVER IN PERSON')""")
+
+
+def test_q20(tk):
+    both(tk, """
+        select s_name, s_address from supplier, nation
+        where s_suppkey in (
+            select ps_suppkey from partsupp
+            where ps_partkey in (select p_partkey from part
+                                 where p_name like 'forest%')
+              and ps_availqty > (
+                  select 0.5 * sum(l_quantity) from lineitem
+                  where l_partkey = ps_partkey and l_suppkey = ps_suppkey
+                    and l_shipdate >= '1994-01-01'
+                    and l_shipdate < date_add('1994-01-01', interval 1 year)))
+          and s_nationkey = n_nationkey and n_name = 'CANADA'
+        order by s_name""")
+
+
+def test_q21(tk):
+    both(tk, """
+        select s_name, count(*) as numwait
+        from supplier, lineitem l1, orders, nation
+        where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+          and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+          and exists (select * from lineitem l2
+                      where l2.l_orderkey = l1.l_orderkey
+                        and l2.l_suppkey <> l1.l_suppkey)
+          and not exists (select * from lineitem l3
+                          where l3.l_orderkey = l1.l_orderkey
+                            and l3.l_suppkey <> l1.l_suppkey
+                            and l3.l_receiptdate > l3.l_commitdate)
+          and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+        group by s_name order by numwait desc, s_name limit 100""")
+
+
+def test_q22(tk):
+    both(tk, """
+        select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+        from (select substring(c_phone, 1, 2) as cntrycode, c_acctbal
+              from customer
+              where substring(c_phone, 1, 2) in
+                    ('13', '31', '23', '29', '30', '18', '17')
+                and c_acctbal > (select avg(c_acctbal) from customer
+                                 where c_acctbal > 0.00
+                                   and substring(c_phone, 1, 2) in
+                                       ('13', '31', '23', '29', '30',
+                                        '18', '17'))
+                and not exists (select * from orders
+                                where o_custkey = c_custkey)
+             ) as custsale
+        group by cntrycode order by cntrycode""")

@@ -16,7 +16,7 @@ from ..sqltypes import (
 )
 from .core import (
     Column, Constant, Expression, K_DATE, K_DEC, K_FLOAT, K_INT, K_STR,
-    ScalarFunc, const_null, like_to_regex, phys_kind,
+    ScalarFunc, SubqueryApply, const_null, like_to_regex, phys_kind,
 )
 
 _BOOL_FT = FieldType(tp=TYPE_LONGLONG)
@@ -205,17 +205,48 @@ _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "pow", "power", "rand",
                 "acos", "pi"}
 
 
+class OuterScope:
+    """Name-resolution scope of an enclosing SELECT, used while building a
+    (potentially correlated) subquery. Two phases share the class:
+    - analysis: `bindings` is None; resolved outer columns are recorded in
+      `used` (idx → ftype) and the built plan is discarded.
+    - execution: `bindings` maps outer idx → the current outer row's value;
+      resolution yields that value as a typed Constant.
+    `parent` chains scopes for multi-level nesting."""
+
+    def __init__(self, schema: Schema, bindings=None, parent=None):
+        self.schema = schema
+        self.bindings = bindings
+        self.parent = parent
+        self.used: dict = {}  # idx -> ftype (analysis phase)
+
+    def resolve(self, node):
+        idx = self.schema.find(node)
+        if idx is not None:
+            ft = self.schema.refs[idx].ftype
+            if self.bindings is not None:
+                return Constant(self.bindings.get(idx), ft.clone())
+            self.used[idx] = ft
+            return Constant(None, ft.clone())
+        if self.parent is not None:
+            return self.parent.resolve(node)
+        return None
+
+
 class ExprBuilder:
     """Builds expressions against a schema. `ctx` (optional) provides:
     - eval_subquery(select_ast) -> (list of row tuples, [FieldType])
     - get_sysvar(name, scope) -> str value
     - get_uservar(name) -> value
+    `outer` (optional OuterScope) resolves columns of enclosing SELECTs —
+    the correlated-subquery path.
     """
 
-    def __init__(self, schema: Schema, ctx=None, allow_agg=False):
+    def __init__(self, schema: Schema, ctx=None, allow_agg=False, outer=None):
         self.schema = schema
         self.ctx = ctx
         self.allow_agg = allow_agg
+        self.outer = outer
 
     def build(self, node: ast.ExprNode) -> Expression:
         m = getattr(self, "_b_" + type(node).__name__, None)
@@ -231,6 +262,10 @@ class ExprBuilder:
     def _b_ColumnName(self, node):
         idx = self.schema.find(node)
         if idx is None:
+            if self.outer is not None:
+                e = self.outer.resolve(node)
+                if e is not None:
+                    return e
             raise ColumnError(f"Unknown column '{node.name}' in 'field list'")
         r = self.schema.refs[idx]
         return Column(idx, r.ftype, name=r.name)
@@ -323,7 +358,22 @@ class ExprBuilder:
     def _b_InExpr(self, node):
         target = self.build(node.expr)
         if len(node.items) == 1 and isinstance(node.items[0], ast.SubqueryExpr):
-            rows, fts = self._run_subquery(node.items[0].query)
+            sub_sel = node.items[0].query
+            scope, plan = self._try_analyze(sub_sel)
+            if scope is not None and scope.used:
+                if len(plan.schema) != 1:
+                    raise TiDBError("Operand should contain 1 column(s)",
+                                    code=ErrCode.OperandColumns)
+                e = self._make_apply(sub_sel, scope, "in", _BOOL_FT.clone(),
+                                     target=target,
+                                     sub_ft=plan.schema.refs[0].ftype)
+                if node.negated:
+                    return ScalarFunc("not", [e], _BOOL_FT.clone())
+                return e
+            if scope is not None:
+                rows, fts = self._eval_analyzed(plan, sub_sel)
+            else:
+                rows, fts = self._run_subquery(sub_sel)
             if fts and len(fts) != 1:
                 raise TiDBError("Operand should contain 1 column(s)",
                                 code=ErrCode.OperandColumns)
@@ -395,7 +445,17 @@ class ExprBuilder:
         raise TiDBError("row expressions not supported in this context")
 
     def _b_SubqueryExpr(self, node):
-        rows, fts = self._run_subquery(node.query)
+        scope, plan = self._try_analyze(node.query)
+        if scope is not None and scope.used:
+            if len(plan.schema) != 1:
+                raise TiDBError("Operand should contain 1 column(s)",
+                                code=ErrCode.OperandColumns)
+            return self._make_apply(node.query, scope, "scalar",
+                                    plan.schema.refs[0].ftype.clone())
+        if scope is not None:
+            rows, fts = self._eval_analyzed(plan, node.query)
+        else:
+            rows, fts = self._run_subquery(node.query)
         if len(rows) > 1:
             raise TiDBError("Subquery returns more than 1 row",
                             code=ErrCode.SubqueryMoreThan1Row)
@@ -408,14 +468,38 @@ class ExprBuilder:
         return Constant(v, fts[0]) if v is not None else const_null()
 
     def _b_ExistsExpr(self, node):
-        rows, _ = self._run_subquery(node.query.query, limit_one=True)
+        scope, plan = self._try_analyze(node.query.query)
+        if scope is not None and scope.used:
+            return self._make_apply(
+                node.query.query, scope,
+                "not_exists" if node.negated else "exists",
+                _BOOL_FT.clone(), limit_one=True)
+        if scope is not None:
+            rows, _ = self._eval_analyzed(plan, node.query.query,
+                                          limit_one=True)
+        else:
+            rows, _ = self._run_subquery(node.query.query, limit_one=True)
         v = 1 if rows else 0
         if node.negated:
             v = 1 - v
         return Constant(v, _BOOL_FT.clone())
 
     def _b_CompareSubquery(self, node):
-        rows, fts = self._run_subquery(node.query.query)
+        scope, plan = self._try_analyze(node.query.query)
+        if scope is not None and scope.used:
+            if len(plan.schema) != 1:
+                raise TiDBError("Operand should contain 1 column(s)",
+                                code=ErrCode.OperandColumns)
+            target = self.build(node.expr)
+            quant = "any" if node.quantifier == "any" else "all"
+            return self._make_apply(
+                node.query.query, scope, (quant, _OP_MAP[node.op]),
+                _BOOL_FT.clone(), target=target,
+                sub_ft=plan.schema.refs[0].ftype)
+        if scope is not None:
+            rows, fts = self._eval_analyzed(plan, node.query.query)
+        else:
+            rows, fts = self._run_subquery(node.query.query)
         vals = [r[0] for r in rows]
         target = self.build(node.expr)
         op = _OP_MAP[node.op]
@@ -561,7 +645,50 @@ class ExprBuilder:
     def _run_subquery(self, select, limit_one=False):
         if self.ctx is None or not hasattr(self.ctx, "eval_subquery"):
             raise TiDBError("subqueries not available in this context")
-        return self.ctx.eval_subquery(select, limit_one=limit_one)
+        return self.ctx.eval_subquery(select, limit_one=limit_one,
+                                      outer=self.outer)
+
+    def _try_analyze(self, select):
+        """Analysis pass for a subquery: build its plan with this SELECT's
+        schema as the outer scope; the scope records which outer columns the
+        subquery references (correlation). The plan is reused for execution
+        when no correlation was found (avoids planning twice)."""
+        if self.ctx is None or not hasattr(self.ctx, "analyze_subquery"):
+            return None, None
+        scope = OuterScope(self.schema, parent=self.outer)
+        plan = self.ctx.analyze_subquery(select, scope)
+        return scope, plan
+
+    def _eval_analyzed(self, plan, select, limit_one=False):
+        """Execute an uncorrelated subquery, reusing its analyzed plan when
+        the context supports it (the analysis build already ran any eager
+        nested subqueries — re-planning would run them twice)."""
+        if hasattr(self.ctx, "eval_built_plan"):
+            return self.ctx.eval_built_plan(plan, limit_one=limit_one)
+        return self._run_subquery(select, limit_one=limit_one)
+
+    def _make_apply(self, select, scope, mode, ftype, target=None,
+                    limit_one=False, sub_ft=None):
+        """Correlated subquery → Apply expression. The runner re-plans the
+        subquery per distinct binding of the referenced outer columns; the
+        outer chain (with any enclosing bindings) threads through so deeper
+        nesting keeps resolving."""
+        idxs = sorted(scope.used)
+        outer_cols = [Column(i, scope.used[i],
+                             name=self.schema.refs[i].name) for i in idxs]
+        ctx = self.ctx
+        parent = self.outer
+        schema = self.schema
+
+        def runner(key):
+            bindings = dict(zip(idxs, key))
+            rows, _fts = ctx.eval_subquery(
+                select, limit_one=limit_one,
+                outer=OuterScope(schema, bindings=bindings, parent=parent))
+            return rows
+
+        return SubqueryApply(runner, outer_cols, mode, ftype, target=target,
+                             sub_ft=sub_ft)
 
 
 _NONDETERMINISTIC = {"rand", "uuid", "sleep", "in_set"}

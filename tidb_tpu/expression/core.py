@@ -123,6 +123,151 @@ def const_null() -> Constant:
     return Constant(None, FieldType(tp=TYPE_NULL))
 
 
+class SubqueryApply(Expression):
+    """Correlated subquery evaluated per distinct outer binding — the
+    reference's Apply operator (planner/core/logical_plans.go LogicalApply,
+    executor/parallel_apply.go), realized as an expression: outer rows are
+    grouped by the values of the referenced outer columns, the subquery
+    re-runs once per distinct binding (memoized), results scatter back.
+
+    modes: 'scalar' (single-value subquery), 'exists'/'not_exists',
+    'in'/'not_in' (target expr membership), ('any'|'all', op) quantified
+    comparisons. `sub_ft` is the subquery's output column type — membership
+    and quantified compares coerce both sides to a unified type, matching
+    the uncorrelated build_in_set path."""
+
+    def __init__(self, runner, outer_cols, mode, ftype, target=None,
+                 sub_ft=None):
+        self.runner = runner          # fn(binding_tuple) -> list of row tuples
+        self.outer_cols = outer_cols  # [Column] over the LOCAL schema
+        self.mode = mode
+        self.ftype = ftype
+        self.target = target          # membership/compare target expr
+        self.sub_ft = sub_ft
+        self._cache = {}
+
+    def columns_used(self, acc: set):
+        for c in self.outer_cols:
+            c.columns_used(acc)
+        if self.target is not None:
+            self.target.columns_used(acc)
+
+    def transform_columns(self, fn):
+        e = SubqueryApply(self.runner,
+                          [c.transform_columns(fn) for c in self.outer_cols],
+                          self.mode, self.ftype,
+                          None if self.target is None
+                          else self.target.transform_columns(fn),
+                          sub_ft=self.sub_ft)
+        e._cache = self._cache
+        return e
+
+    def _coerce_pair(self):
+        """(convert_target, convert_sub) closures unifying both sides."""
+        from ..table import convert_internal
+        from .builder import unify_types  # late: avoid import cycle
+        common = unify_types([self.target.ftype, self.sub_ft or
+                              self.target.ftype])
+        tft = self.target.ftype
+        sft = self.sub_ft or tft
+
+        def conv_t(v):
+            return None if v is None else convert_internal(v, tft, common)
+
+        def conv_s(v):
+            return None if v is None else convert_internal(v, sft, common)
+
+        return conv_t, conv_s
+
+    def __repr__(self):
+        return f"apply:{self.mode}({', '.join(map(repr, self.outer_cols))})"
+
+    def _rows_for(self, key):
+        rows = self._cache.get(key, _MISSING)
+        if rows is _MISSING:
+            rows = self.runner(key)
+            self._cache[key] = rows
+        return rows
+
+    def eval(self, chunk: Chunk):
+        n = chunk.num_rows
+        pairs = [c.eval(chunk) for c in self.outer_cols]
+        dt = np_dtype_for(self.ftype)
+        data = (np.empty(n, dtype=object) if dt is object
+                else np.zeros(n, dtype=dt))
+        nulls = np.zeros(n, dtype=bool)
+        quant = isinstance(self.mode, tuple)
+        if self.mode in ("in", "not_in") or quant:
+            tdata, tnulls = self.target.eval(chunk)
+            conv_t, conv_s = self._coerce_pair()
+        neg = self.mode in ("not_exists", "not_in")
+        for i in range(n):
+            key = tuple(None if nu[i] else _as_py(d[i]) for d, nu in pairs)
+            rows = self._rows_for(key)
+            if quant:
+                data[i], nulls[i] = self._eval_quant(
+                    rows, None if tnulls[i] else conv_t(_as_py(tdata[i])),
+                    conv_s)
+            elif self.mode in ("exists", "not_exists"):
+                data[i] = int(bool(rows)) ^ int(neg)
+            elif self.mode == "scalar":
+                if len(rows) > 1:
+                    raise TiDBError("Subquery returns more than 1 row")
+                v = rows[0][0] if rows else None
+                if v is None:
+                    nulls[i] = True
+                else:
+                    data[i] = v
+            else:  # in / not_in: MySQL three-valued membership
+                vals = {conv_s(r[0]) for r in rows if r[0] is not None}
+                has_null = any(r[0] is None for r in rows)
+                if tnulls[i]:
+                    # NULL IN (non-empty) → NULL; NULL IN (empty) → false
+                    if rows:
+                        nulls[i] = True
+                    else:
+                        data[i] = int(neg)
+                    continue
+                tv = conv_t(_as_py(tdata[i]))
+                if tv in vals:
+                    data[i] = int(not neg)
+                elif has_null:
+                    nulls[i] = True
+                else:
+                    data[i] = int(neg)
+        return data, nulls
+
+    def _eval_quant(self, rows, tv, conv_s):
+        """Three-valued ANY/ALL comparison. tv None means NULL target.
+        Returns (value, is_null)."""
+        import operator as _op
+        kind, op = self.mode
+        cmp = {"eq": _op.eq, "ne": _op.ne, "lt": _op.lt, "le": _op.le,
+               "gt": _op.gt, "ge": _op.ge}[op]
+        if not rows:
+            return (0, False) if kind == "any" else (1, False)
+        if tv is None:
+            return 0, True
+        vals = [conv_s(r[0]) for r in rows]
+        has_null = any(v is None for v in vals)
+        hits = [cmp(tv, v) for v in vals if v is not None]
+        if kind == "any":
+            if any(hits):
+                return 1, False
+            return (0, True) if has_null else (0, False)
+        # all: false beats null beats true
+        if not all(hits):
+            return 0, False
+        return (0, True) if has_null else (1, False)
+
+
+_MISSING = object()
+
+
+def _as_py(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
 class ScalarFunc(Expression):
     def __init__(self, op: str, args: list, ftype: FieldType, extra=None):
         self.op = op

@@ -391,9 +391,17 @@ class SelectStmt(StmtNode):
     distinct: bool = False
     for_update: bool = False
     lock_in_share_mode: bool = False
+    with_ctes: list = field(default_factory=list)    # [(name, [cols], stmt)]
 
     def restore(self):
-        s = "SELECT " + ("DISTINCT " if self.distinct else "")
+        s = ""
+        if self.with_ctes:
+            parts = []
+            for name, cols, stmt in self.with_ctes:
+                c = f" ({', '.join(cols)})" if cols else ""
+                parts.append(f"`{name}`{c} AS ({stmt.restore()})")
+            s += "WITH " + ", ".join(parts) + " "
+        s += "SELECT " + ("DISTINCT " if self.distinct else "")
         s += ", ".join(f.restore() for f in self.fields)
         if self.from_ is not None:
             s += " FROM " + self.from_.restore()

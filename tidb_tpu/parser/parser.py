@@ -281,6 +281,29 @@ class Parser:
         return stmt
 
     def _parse_select_core(self) -> ast.SelectStmt:
+        ctes = []
+        if self._peek_kw("with"):
+            # non-recursive common table expressions (reference:
+            # parser.y WithClause; recursive CTEs go through util/cteutil —
+            # here the planner inlines each reference)
+            self.pos += 1
+            self._accept_kw("recursive")
+            while True:
+                name = self._ident()
+                cols = []
+                if self._accept_op("("):
+                    while True:
+                        cols.append(self._ident())
+                        if not self._accept_op(","):
+                            break
+                    self._expect_op(")")
+                self._expect_kw("as")
+                self._expect_op("(")
+                stmt = self._parse_select_or_union()
+                self._expect_op(")")
+                ctes.append((name, cols, stmt))
+                if not self._accept_op(","):
+                    break
         if self._accept_op("("):
             sel = self._parse_select_or_union()
             self._expect_op(")")
@@ -293,9 +316,12 @@ class Parser:
                 sel.order_by = self._parse_by_items()
             if self._peek_kw("limit"):
                 sel.limit = self._parse_limit()
+            if ctes:
+                sel.with_ctes = ctes + sel.with_ctes
             return sel
         self._expect_kw("select")
         sel = ast.SelectStmt()
+        sel.with_ctes = ctes
         # modifiers
         while True:
             if self._accept_kw("distinct") or self._accept_kw("distinctrow"):
@@ -451,7 +477,8 @@ class Parser:
 
     def _parse_table_factor(self):
         if self._accept_op("("):
-            if self._peek_kw("select") or self._peek_op("("):
+            if (self._peek_kw("select") or self._peek_kw("with")
+                    or self._peek_op("(")):
                 sub = self._parse_select_or_union()
                 self._expect_op(")")
                 as_name = ""

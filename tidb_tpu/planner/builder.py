@@ -72,13 +72,98 @@ def _ast_children(node):
     return []
 
 
+def _subst_select(sel, ctes):
+    """Inline WITH ctes (reference: non-recursive CTEs; parser.y WithClause):
+    every reference to a CTE name becomes a derived table over a deep copy
+    of its body. Inner WITH lists shadow outer ones; each body sees the
+    CTEs defined before it."""
+    import copy as _copy
+
+    if isinstance(sel, ast.SetOprStmt):
+        scope = dict(ctes)
+        first = sel.selects[0] if sel.selects else None
+        if first is not None and getattr(first, "with_ctes", None):
+            for name, cols, stmt in first.with_ctes:
+                body_scope = dict(scope)
+                body_scope[name.lower()] = _RECURSIVE
+                _subst_select(stmt, body_scope)
+                scope[name.lower()] = (cols, stmt)
+            first.with_ctes = []
+        for s in sel.selects:
+            _subst_select(s, scope)
+        return
+    scope = dict(ctes)
+    for name, cols, stmt in getattr(sel, "with_ctes", []) or []:
+        body_scope = dict(scope)
+        body_scope[name.lower()] = _RECURSIVE
+        _subst_select(stmt, body_scope)
+        scope[name.lower()] = (cols, stmt)
+    sel.with_ctes = []
+    if not scope:
+        return
+    if sel.from_ is not None:
+        sel.from_ = _subst_from(sel.from_, scope, _copy)
+    for f in sel.fields:
+        if not isinstance(f.expr, ast.StarExpr):
+            _subst_expr(f.expr, scope)
+    _subst_expr(sel.where, scope)
+    _subst_expr(sel.having, scope)
+    for bi in list(sel.group_by) + list(sel.order_by):
+        _subst_expr(bi.expr, scope)
+
+
+_RECURSIVE = object()  # sentinel: a CTE body referencing its own name
+
+
+def _subst_from(node, ctes, _copy):
+    if isinstance(node, ast.TableName):
+        if not node.schema and node.name.lower() in ctes:
+            if ctes[node.name.lower()] is _RECURSIVE:
+                raise TiDBError(
+                    f"Recursive CTE '{node.name}' is not supported")
+            cols, stmt = ctes[node.name.lower()]
+            body = _copy.deepcopy(stmt)
+            sub = ast.SubqueryTable(query=body,
+                                    as_name=node.as_name or node.name)
+            sub.col_renames = list(cols)
+            return sub
+        return node
+    if isinstance(node, ast.Join):
+        node.left = _subst_from(node.left, ctes, _copy)
+        node.right = _subst_from(node.right, ctes, _copy)
+        _subst_expr(node.on, ctes)
+        return node
+    if isinstance(node, ast.SubqueryTable):
+        _subst_select(node.query, ctes)
+        return node
+    return node
+
+
+def _subst_expr(node, ctes):
+    if node is None or not ctes:
+        return
+    if isinstance(node, ast.SubqueryExpr):
+        _subst_select(node.query, ctes)
+        return
+    if isinstance(node, ast.ExistsExpr):
+        _subst_select(node.query.query, ctes)
+        return
+    if isinstance(node, ast.CompareSubquery):
+        _subst_expr(node.expr, ctes)
+        _subst_select(node.query.query, ctes)
+        return
+    for c in _ast_children(node):
+        _subst_expr(c, ctes)
+
+
 class AggExprBuilder(ExprBuilder):
     """Resolves expressions over an Aggregation's output: group exprs and agg
     funcs map to output columns; bare columns not in GROUP BY become implicit
     first_row aggregates (MySQL non-ONLY_FULL_GROUP_BY behavior)."""
 
-    def __init__(self, agg: Aggregation, child_schema: Schema, expr_map, ctx):
-        super().__init__(agg.schema, ctx)
+    def __init__(self, agg: Aggregation, child_schema: Schema, expr_map, ctx,
+                 outer=None):
+        super().__init__(agg.schema, ctx, outer=outer)
         self.agg = agg
         self.child_schema = child_schema
         self.expr_map = expr_map  # restore text -> output idx
@@ -99,6 +184,10 @@ class AggExprBuilder(ExprBuilder):
         # implicit first_row over a non-grouped column
         cidx = self.child_schema.find(node)
         if cidx is None:
+            if self.outer is not None:
+                e = self.outer.resolve(node)
+                if e is not None:
+                    return e
             raise ColumnError(f"Unknown column '{node.name}' in 'field list'")
         cref = self.child_schema.refs[cidx]
         arg = Column(cidx, cref.ftype, name=cref.name)
@@ -119,15 +208,20 @@ class PlanBuilder:
     """ctx provides: infoschema(), current_db(), eval_subquery(sel, limit_one),
     get_sysvar/set_uservar/get_uservar, mem_table_rows(db, name)."""
 
-    def __init__(self, ctx):
+    def __init__(self, ctx, outer=None):
         self.ctx = ctx
+        self.outer = outer  # OuterScope of the enclosing SELECT (subqueries)
+        self.ctes = {}      # WITH name -> SelectStmt AST
 
     # -- entry points -------------------------------------------------------
 
     def build(self, stmt):
         if isinstance(stmt, ast.SelectStmt):
+            if stmt.with_ctes:
+                _subst_select(stmt, {})
             return self.build_select(stmt)
         if isinstance(stmt, ast.SetOprStmt):
+            _subst_select(stmt, {})
             return self.build_set_op(stmt)
         raise TiDBError(f"cannot plan {type(stmt).__name__}")
 
@@ -154,7 +248,7 @@ class PlanBuilder:
             plan = SetOp([plan, nxt], kinds[op], schema)
         if stmt.order_by or stmt.limit:
             plan = self._apply_order_limit(plan, stmt.order_by, stmt.limit,
-                                           ExprBuilder(plan.schema, self.ctx), [])
+                                           ExprBuilder(plan.schema, self.ctx, outer=self.outer), [])
         return plan
 
     # -- FROM ---------------------------------------------------------------
@@ -167,7 +261,16 @@ class PlanBuilder:
         if isinstance(node, ast.SubqueryTable):
             sub = self.build(node.query)
             alias = node.as_name or ""
-            refs = [ColumnRef(r.name, alias, "", r.ftype) for r in sub.schema.refs]
+            renames = getattr(node, "col_renames", None) or []
+            if renames and len(renames) != len(sub.schema.refs):
+                raise TiDBError(
+                    f"In definition of view, derived table or common table "
+                    f"expression, SELECT list and column names list have "
+                    f"different column counts")
+            refs = []
+            for i, r in enumerate(sub.schema.refs):
+                name = renames[i] if i < len(renames) else r.name
+                refs.append(ColumnRef(name, alias, "", r.ftype))
             sub2 = Projection(sub, [Column(i, r.ftype, name=r.name)
                                     for i, r in enumerate(sub.schema.refs)],
                               Schema(refs))
@@ -201,14 +304,14 @@ class PlanBuilder:
         join = Join(left, right, "inner" if kind == "cross" else kind, schema)
         conds = []
         if jn.on is not None:
-            b = ExprBuilder(schema, self.ctx)
+            b = ExprBuilder(schema, self.ctx, outer=self.outer)
             conds = split_cnf(b.build(jn.on))
         elif jn.using:
             names = jn.using
             if names == ["*natural*"]:
                 lnames = {r.name for r in left.schema.refs}
                 names = [r.name for r in right.schema.refs if r.name in lnames]
-            b = ExprBuilder(schema, self.ctx)
+            b = ExprBuilder(schema, self.ctx, outer=self.outer)
             for name in names:
                 conds.append(b.build(ast.BinaryOp(
                     op="=",
@@ -252,7 +355,7 @@ class PlanBuilder:
         from_schema = plan.schema
 
         if sel.where is not None:
-            b = ExprBuilder(from_schema, self.ctx)
+            b = ExprBuilder(from_schema, self.ctx, outer=self.outer)
             conds = split_cnf(b.build(sel.where))
             plan = Selection(plan, conds)
 
@@ -272,7 +375,7 @@ class PlanBuilder:
         if has_agg:
             plan, expr_builder = self._build_aggregation(plan, sel, agg_map)
         else:
-            expr_builder = ExprBuilder(plan.schema, self.ctx)
+            expr_builder = ExprBuilder(plan.schema, self.ctx, outer=self.outer)
 
         # -- star expansion + select expr building
         fields = []
@@ -339,7 +442,7 @@ class PlanBuilder:
 
     def _build_aggregation(self, plan, sel, agg_map):
         child_schema = plan.schema
-        b = ExprBuilder(child_schema, self.ctx)
+        b = ExprBuilder(child_schema, self.ctx, outer=self.outer)
         group_exprs = []
         expr_map = {}
         refs = []
@@ -381,7 +484,8 @@ class PlanBuilder:
             aggs.append(desc)
             refs.append(ColumnRef(key, "", "", desc.ftype))
         agg = Aggregation(plan, group_exprs, aggs, Schema(refs))
-        return agg, AggExprBuilder(agg, child_schema, expr_map, self.ctx)
+        return agg, AggExprBuilder(agg, child_schema, expr_map, self.ctx,
+                                   outer=self.outer)
 
     def _build_having(self, having, expr_builder, fields, alias_map):
         # rewrite bare alias references to the built select expressions
@@ -441,7 +545,7 @@ class PlanBuilder:
     def _limit_values(self, limit):
         if limit is None:
             return None, None
-        b = ExprBuilder(Schema([]), self.ctx)
+        b = ExprBuilder(Schema([]), self.ctx, outer=self.outer)
         count = b.build(limit.count).eval_scalar() if limit.count is not None else None
         offset = b.build(limit.offset).eval_scalar() if limit.offset is not None else 0
         return int(offset or 0), (int(count) if count is not None else None)

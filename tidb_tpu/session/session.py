@@ -100,13 +100,29 @@ class _ExprCtx:
         self.session = session
         self.params = None
 
-    def eval_subquery(self, select, limit_one=False):
-        res = self.session.run_query(select)
+    def eval_subquery(self, select, limit_one=False, outer=None):
+        res = self.session.run_query(select, outer=outer)
         fts = res.ftypes
         rows = res.internal_rows
         if limit_one:
             rows = rows[:1]
         return rows, fts
+
+    def eval_built_plan(self, plan, limit_one=False):
+        """Execute an already-built logical plan (uncorrelated subquery
+        whose analysis plan is reusable)."""
+        res = self.session.run_built_query(plan)
+        rows = res.internal_rows
+        if limit_one:
+            rows = rows[:1]
+        return rows, res.ftypes
+
+    def analyze_subquery(self, select, scope):
+        """Build (and discard) the subquery's logical plan with `scope` as
+        the outer name-resolution scope; correlation is recorded in
+        scope.used. Returns the plan (for output types)."""
+        builder = PlanBuilder(self, outer=scope)
+        return builder.build(select)
 
     def get_sysvar(self, name, scope):
         return self.session.get_sysvar(name, scope)
@@ -426,14 +442,22 @@ class Session:
 
     # -- query path ----------------------------------------------------------
 
-    def plan_query(self, stmt):
-        builder = PlanBuilder(self._expr_ctx)
+    def plan_query(self, stmt, outer=None):
+        builder = PlanBuilder(self._expr_ctx, outer=outer)
         plan = builder.build(stmt)
         return optimize(plan, self._expr_ctx)
 
-    def run_query(self, stmt) -> Result:
+    def run_built_query(self, logical_plan) -> Result:
         from ..executor import build_executor
-        plan = self.plan_query(stmt)
+        plan = optimize(logical_plan, self._expr_ctx)
+        exe = build_executor(plan, self._exec_ctx())
+        chunk = exe.execute()
+        names = [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
+        return Result(names=names, chunk=chunk)
+
+    def run_query(self, stmt, outer=None) -> Result:
+        from ..executor import build_executor
+        plan = self.plan_query(stmt, outer=outer)
         exe = build_executor(plan, self._exec_ctx())
         chunk = exe.execute()
         names = [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
