@@ -1,0 +1,145 @@
+"""MySQL protocol payloads: handshake, OK/ERR/EOF, column definitions and
+text resultset rows (reference: server/conn.go writeInitialHandshake /
+writeOK / writeError, server/column.go Dump, server/conn.go:2096
+writeResultset)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+from ..sqltypes import (
+    TYPE_DATE, TYPE_DATETIME, TYPE_DOUBLE, TYPE_FLOAT, TYPE_LONGLONG,
+    TYPE_NEWDECIMAL, TYPE_NULL, TYPE_TIMESTAMP, TYPE_VARCHAR,
+)
+from .packet import lenenc_int, lenenc_str
+
+PROTOCOL_VERSION = 10
+SERVER_VERSION = b"8.0.11-tidb-tpu"
+
+# capability flags (subset)
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_FOUND_ROWS = 0x2
+CLIENT_LONG_FLAG = 0x4
+CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_MULTI_STATEMENTS = 0x10000
+CLIENT_MULTI_RESULTS = 0x20000
+CLIENT_PLUGIN_AUTH = 0x80000
+
+SERVER_CAPABILITIES = (
+    CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG
+    | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS
+    | CLIENT_SECURE_CONNECTION | CLIENT_MULTI_STATEMENTS
+    | CLIENT_MULTI_RESULTS | CLIENT_PLUGIN_AUTH)
+
+SERVER_STATUS_AUTOCOMMIT = 0x2
+SERVER_MORE_RESULTS_EXISTS = 0x8
+
+# commands
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_FIELD_LIST = 0x04
+COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_CLOSE = 0x19
+
+CHARSET_UTF8MB4 = 255
+
+
+def native_password_hash(password: bytes, salt: bytes) -> bytes:
+    """mysql_native_password scramble: SHA1(pwd) XOR SHA1(salt+SHA1(SHA1(pwd)))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def build_handshake(conn_id: int, salt: bytes) -> bytes:
+    caps = SERVER_CAPABILITIES
+    out = bytes([PROTOCOL_VERSION]) + SERVER_VERSION + b"\x00"
+    out += struct.pack("<I", conn_id)
+    out += salt[:8] + b"\x00"
+    out += struct.pack("<H", caps & 0xFFFF)
+    out += bytes([CHARSET_UTF8MB4])
+    out += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+    out += struct.pack("<H", (caps >> 16) & 0xFFFF)
+    out += bytes([len(salt) + 1])
+    out += b"\x00" * 10
+    out += salt[8:] + b"\x00"
+    out += b"mysql_native_password\x00"
+    return out
+
+
+def build_ok(affected=0, last_insert_id=0, status=SERVER_STATUS_AUTOCOMMIT,
+             warnings=0) -> bytes:
+    return (b"\x00" + lenenc_int(affected) + lenenc_int(last_insert_id)
+            + struct.pack("<HH", status, warnings))
+
+
+def build_eof(status=SERVER_STATUS_AUTOCOMMIT, warnings=0) -> bytes:
+    return b"\xfe" + struct.pack("<HH", warnings, status)
+
+
+def build_err(code: int, message: str, state: bytes = b"HY000") -> bytes:
+    return (b"\xff" + struct.pack("<H", code) + b"#" + state
+            + message.encode("utf-8"))
+
+
+def new_salt() -> bytes:
+    # 20 printable bytes, no NULs (reference: util.RandomBuf)
+    out = bytearray(os.urandom(20))
+    for i, b in enumerate(out):
+        out[i] = 1 + (b % 125)
+    return bytes(out)
+
+
+def column_def(name: str, ftype, db: str = "", table: str = "") -> bytes:
+    """Protocol::ColumnDefinition41."""
+    tp = ftype.tp
+    flen = ftype.flen if ftype.flen and ftype.flen > 0 else 255
+    decimals = 0
+    charset = CHARSET_UTF8MB4
+    if tp in (TYPE_LONGLONG, TYPE_DOUBLE, TYPE_FLOAT, TYPE_NEWDECIMAL):
+        charset = 63  # binary
+        if tp == TYPE_NEWDECIMAL:
+            decimals = ftype.scale
+        flen = 21
+    elif tp in (TYPE_DATE, TYPE_DATETIME, TYPE_TIMESTAMP):
+        charset = 63
+        flen = 26
+    elif tp == TYPE_NULL:
+        charset = 63
+    out = lenenc_str(b"def")
+    out += lenenc_str(db.encode())
+    out += lenenc_str(table.encode())
+    out += lenenc_str(table.encode())
+    out += lenenc_str(name.encode())
+    out += lenenc_str(name.encode())
+    out += bytes([0x0C])
+    out += struct.pack("<H", charset)
+    out += struct.pack("<I", flen)
+    out += bytes([tp & 0xFF])
+    out += struct.pack("<H", ftype.flag)
+    out += bytes([decimals])
+    out += b"\x00\x00"
+    return out
+
+
+def text_row(row) -> bytes:
+    """One text-protocol row: display strings, NULL = 0xFB."""
+    out = b""
+    for v in row:
+        if v is None:
+            out += b"\xfb"
+        else:
+            out += lenenc_str(v.encode("utf-8") if isinstance(v, str)
+                              else bytes(v))
+    return out

@@ -1,0 +1,372 @@
+"""MySQL protocol server (reference: server/server.go accept loop +
+server/conn.go clientConn.Run dispatch loop / handshake at conn.go:256,810,
+resultset streaming at conn.go:2096).
+
+Threaded TCP server; each connection owns a Session over the shared
+Domain — the reference's per-conn goroutine becomes a thread. Prepared
+statements use the text protocol's execution path with '?' parameters
+substituted at EXECUTE time (binary row encoding is a follow-up)."""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from ..errors import TiDBError
+from ..session import new_session
+from . import protocol as P
+from .packet import (PacketIO, lenenc_int, read_lenenc_int, read_nul_str)
+
+
+class MySQLServer:
+    def __init__(self, domain, host="127.0.0.1", port=4000, users=None):
+        """users: optional {user: password} map; None accepts any login
+        (the bootstrap root@% with empty password behavior)."""
+        self.domain = domain
+        self.users = users
+        self._next_conn_id = 0
+        self._lock = threading.Lock()
+        self.connections = {}
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._handle_conn(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        """Graceful-ish shutdown (reference: server.go GracefulDown)."""
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- connection ---------------------------------------------------------
+
+    def _conn_id(self):
+        with self._lock:
+            self._next_conn_id += 1
+            return self._next_conn_id
+
+    def _handle_conn(self, sock: socket.socket):
+        io = PacketIO(sock)
+        conn_id = self._conn_id()
+        salt = P.new_salt()
+        io.write_packet(P.build_handshake(conn_id, salt))
+        try:
+            resp = io.read_packet()
+            user, db, auth = self._parse_handshake_response(resp)
+        except ConnectionError:
+            return
+        except Exception:
+            # garbage from a non-MySQL client (port scan, HTTP, TLS probe)
+            try:
+                io.write_packet(P.build_err(1043, "Bad handshake", b"08S01"))
+            except Exception:
+                pass
+            return
+        if not self._check_auth(user, auth, salt):
+            io.write_packet(P.build_err(
+                1045, f"Access denied for user '{user}'", b"28000"))
+            return
+        session = new_session(self.domain)
+        session.user = f"{user}@%"
+        if db:
+            try:
+                session.execute(f"use `{db}`")
+            except TiDBError as e:
+                io.write_packet(P.build_err(
+                    getattr(e, "code", 1049) or 1049, str(e)))
+                return
+        io.write_packet(P.build_ok())
+        self.connections[conn_id] = session
+        try:
+            self._command_loop(io, session)
+        finally:
+            self.connections.pop(conn_id, None)
+
+    def _parse_handshake_response(self, buf: bytes):
+        caps = struct.unpack_from("<I", buf, 0)[0]
+        pos = 4 + 4 + 1 + 23  # caps, max packet, charset, filler
+        user, pos = read_nul_str(buf, pos)
+        if caps & P.CLIENT_SECURE_CONNECTION:
+            alen = buf[pos]
+            pos += 1
+            auth = buf[pos:pos + alen]
+            pos += alen
+        else:
+            auth, pos = read_nul_str(buf, pos)
+        db = b""
+        if caps & P.CLIENT_CONNECT_WITH_DB and pos < len(buf):
+            db, pos = read_nul_str(buf, pos)
+        return user.decode(), db.decode(), auth
+
+    def _check_auth(self, user: str, auth: bytes, salt: bytes) -> bool:
+        if self.users is None:
+            return True
+        if user not in self.users:
+            return False
+        expected = P.native_password_hash(
+            self.users[user].encode(), salt)
+        return auth == expected
+
+    # -- command dispatch ---------------------------------------------------
+
+    def _command_loop(self, io: PacketIO, session):
+        stmts = {}  # stmt_id -> [sql, n_params, types]
+        next_stmt = 0
+        while True:
+            io.reset_seq()
+            try:
+                pkt = io.read_packet()
+            except ConnectionError:
+                return
+            if not pkt:
+                io.write_packet(P.build_err(1047, "empty command", b"08S01"))
+                continue
+            cmd, payload = pkt[0], pkt[1:]
+            try:
+                if cmd == P.COM_QUIT:
+                    return
+                elif cmd == P.COM_PING:
+                    io.write_packet(P.build_ok())
+                elif cmd == P.COM_INIT_DB:
+                    session.execute(f"use `{payload.decode()}`")
+                    io.write_packet(P.build_ok())
+                elif cmd == P.COM_QUERY:
+                    self._run_query(io, session, payload.decode("utf-8"))
+                elif cmd == P.COM_FIELD_LIST:
+                    io.write_packet(P.build_eof())
+                elif cmd == P.COM_STMT_PREPARE:
+                    sql = payload.decode("utf-8")
+                    next_stmt += 1
+                    sid = next_stmt
+                    n_params = _count_params(sql)
+                    stmts[sid] = [sql, n_params, None]
+                    out = (b"\x00" + struct.pack("<I", sid)
+                           + struct.pack("<H", 0)
+                           + struct.pack("<H", n_params)
+                           + b"\x00" + struct.pack("<H", 0))
+                    io.write_packet(out)
+                    for _ in range(n_params):
+                        io.write_packet(P.column_def(
+                            "?", _param_ftype()))
+                    if n_params:
+                        io.write_packet(P.build_eof())
+                elif cmd == P.COM_STMT_EXECUTE:
+                    self._stmt_execute(io, session, stmts, payload)
+                elif cmd == P.COM_STMT_CLOSE:
+                    stmts.pop(struct.unpack_from("<I", payload, 0)[0], None)
+                else:
+                    io.write_packet(P.build_err(
+                        1047, f"Unknown command {cmd:#x}", b"08S01"))
+            except TiDBError as e:
+                io.write_packet(P.build_err(
+                    getattr(e, "code", 1105) or 1105, str(e)))
+            except Exception as e:  # never kill the conn loop on a bug
+                io.write_packet(P.build_err(1105, f"internal: {e}"))
+
+    def _run_query(self, io, session, sql: str):
+        results = session.execute(sql)
+        if not results:
+            io.write_packet(P.build_ok())
+            return
+        for i, res in enumerate(results):
+            more = i < len(results) - 1
+            status = P.SERVER_STATUS_AUTOCOMMIT | (
+                P.SERVER_MORE_RESULTS_EXISTS if more else 0)
+            if res.chunk is None:
+                io.write_packet(P.build_ok(
+                    affected=res.affected,
+                    last_insert_id=res.last_insert_id, status=status))
+                continue
+            self._write_resultset(io, res, status)
+
+    def _write_resultset(self, io, res, status):
+        fts = res.ftypes
+        io.write_packet(lenenc_int(len(res.names)))
+        for name, ft in zip(res.names, fts):
+            io.write_packet(P.column_def(name, ft))
+        io.write_packet(P.build_eof(status=status))
+        for row in res.rows:
+            io.write_packet(P.text_row(row))
+        io.write_packet(P.build_eof(status=status))
+
+    def _stmt_execute(self, io, session, stmts, payload):
+        sid = struct.unpack_from("<I", payload, 0)[0]
+        if sid not in stmts:
+            io.write_packet(P.build_err(1243, "Unknown prepared statement"))
+            return
+        sql, n_params, bound_types = stmts[sid]
+        pos = 4 + 1 + 4  # id, flags, iteration count
+        args = []
+        if n_params:
+            nullmap_len = (n_params + 7) // 8
+            nullmap = payload[pos:pos + nullmap_len]
+            pos += nullmap_len
+            new_bound = payload[pos]
+            pos += 1
+            if new_bound:
+                types = []
+                for _ in range(n_params):
+                    types.append((payload[pos], payload[pos + 1]))
+                    pos += 2
+                stmts[sid][2] = types  # persist: later executes send no types
+            else:
+                types = bound_types
+            if not types:
+                raise TiDBError("prepared statement executed with no "
+                                "parameter types bound")
+            for i in range(n_params):
+                if nullmap[i // 8] & (1 << (i % 8)):
+                    args.append(None)
+                    continue
+                tp, flags = types[i]
+                v, pos = _decode_binary_value(payload, pos, tp, flags)
+                args.append(v)
+        io_sql = _substitute_params(sql, args)
+        self._run_query(io, session, io_sql)
+
+
+def _param_ftype():
+    from ..sqltypes import FieldType, TYPE_VARCHAR
+    return FieldType(tp=TYPE_VARCHAR)
+
+
+def _decode_binary_value(buf, pos, tp, flags=0):
+    """Binary protocol parameter decode (reference: server/conn_stmt.go
+    parseExecArgs)."""
+    unsigned = bool(flags & 0x80)
+    if tp == 0x01:                          # TINY
+        return struct.unpack_from("<B" if unsigned else "<b",
+                                  buf, pos)[0], pos + 1
+    if tp in (0x02, 0x0D):                  # SHORT / YEAR
+        return struct.unpack_from("<H" if unsigned else "<h",
+                                  buf, pos)[0], pos + 2
+    if tp in (0x03, 0x09):                  # LONG / INT24
+        return struct.unpack_from("<I" if unsigned else "<i",
+                                  buf, pos)[0], pos + 4
+    if tp == 0x08:                          # LONGLONG
+        return struct.unpack_from("<Q" if unsigned else "<q",
+                                  buf, pos)[0], pos + 8
+    if tp == 0x04:                          # FLOAT
+        return struct.unpack_from("<f", buf, pos)[0], pos + 4
+    if tp == 0x05:                          # DOUBLE
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tp == 0x06:                          # NULL
+        return None, pos
+    if tp in (0x07, 0x0A, 0x0C):            # TIMESTAMP / DATE / DATETIME
+        n = buf[pos]
+        pos += 1
+        f = buf[pos:pos + n]
+        pos += n
+        if n == 0:
+            return "0000-00-00", pos
+        y, mo, d = struct.unpack_from("<H", f, 0)[0], f[2], f[3]
+        if n == 4:
+            return f"{y:04d}-{mo:02d}-{d:02d}", pos
+        h, mi, sec = f[4], f[5], f[6]
+        if n == 7:
+            return f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{sec:02d}", pos
+        us = struct.unpack_from("<I", f, 7)[0]
+        return (f"{y:04d}-{mo:02d}-{d:02d} "
+                f"{h:02d}:{mi:02d}:{sec:02d}.{us:06d}"), pos
+    if tp == 0x0B:                          # TIME
+        n = buf[pos]
+        pos += 1
+        f = buf[pos:pos + n]
+        pos += n
+        if n == 0:
+            return "00:00:00", pos
+        sign = "-" if f[0] else ""
+        days = struct.unpack_from("<I", f, 1)[0]
+        h, mi, sec = f[5], f[6], f[7]
+        h += days * 24
+        base = f"{sign}{h:02d}:{mi:02d}:{sec:02d}"
+        if n > 8:
+            us = struct.unpack_from("<I", f, 8)[0]
+            base += f".{us:06d}"
+        return base, pos
+    n, pos = read_lenenc_int(buf, pos)
+    return buf[pos:pos + n], pos + n
+
+
+def _count_params(sql: str) -> int:
+    """Placeholders outside string literals — must agree with
+    _substitute_params' scanner or PREPARE advertises the wrong count."""
+    count = 0
+    in_str = None
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            if ch == "\\" and i + 1 < len(sql):
+                i += 2
+                continue
+            if ch == in_str:
+                in_str = None
+        elif ch in ("'", '"'):
+            in_str = ch
+        elif ch == "?":
+            count += 1
+        i += 1
+    return count
+
+
+def _substitute_params(sql: str, args):
+    """Inline EXECUTE parameters into the statement text ('?' placeholders
+    outside string literals), with proper quoting."""
+    out = []
+    it = iter(args)
+    in_str = None
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            if ch == "\\" and i + 1 < len(sql):
+                out.append(sql[i:i + 2])
+                i += 2
+                continue
+            if ch == in_str:
+                in_str = None
+            out.append(ch)
+        elif ch in ("'", '"'):
+            in_str = ch
+            out.append(ch)
+        elif ch == "?":
+            try:
+                v = next(it)
+            except StopIteration:
+                raise TiDBError("parameter count mismatch")
+            out.append(_quote_value(v))
+        else:
+            out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _quote_value(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, bytes):
+        v = v.decode("utf-8", "surrogateescape")
+    s = str(v).replace("\\", "\\\\").replace("'", "\\'")
+    return f"'{s}'"
