@@ -1,0 +1,446 @@
+// Native MVCC storage engine — the performance-critical core of the
+// embedded store (the reference's TiKV/unistore role is native Rust/Go;
+// here C++ behind a C ABI consumed via ctypes).
+//
+// Semantics mirror tidb_tpu/kv/mvcc.py exactly (which in turn mirrors
+// store/mockstore/unistore/tikv/mvcc.go: Prewrite :596, Commit :907):
+// Percolator 2PC with primary locks, write-conflict detection against
+// newer commits, rollback markers, pessimistic locks with wait-for-graph
+// deadlock detection (unistore/tikv/detector.go), snapshot reads/scans
+// that surface foreign locks, and safepoint GC (store/gcworker).
+//
+// Status codes shared with the Python wrapper:
+//   0 ok | 1 locked | 2 write conflict | 3 deadlock
+//   4 txn rolled back | 5 not found
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+enum Op : int32_t { OP_PUT = 0, OP_DEL = 1, OP_LOCK = 2, OP_ROLLBACK = 3 };
+
+enum Status : int32_t {
+  ST_OK = 0,
+  ST_LOCKED = 1,
+  ST_CONFLICT = 2,
+  ST_DEADLOCK = 3,
+  ST_ROLLED_BACK = 4,
+  ST_NOT_FOUND = 5,
+};
+
+struct Version {
+  uint64_t commit_ts;
+  uint64_t start_ts;
+  int32_t op;
+  bool has_value;
+  std::string value;
+};
+
+struct LockRec {
+  uint64_t start_ts;
+  int32_t op;
+  bool has_value;
+  std::string primary;
+  std::string value;
+};
+
+struct Engine {
+  std::mutex mu;
+  // key -> version chain, newest (highest commit_ts) first
+  std::map<std::string, std::vector<Version>> chains;
+  std::unordered_map<std::string, LockRec> locks;
+  std::unordered_map<uint64_t, uint64_t> waits;  // waiter -> holder
+
+  void insert_version(const std::string& key, uint64_t commit_ts,
+                      uint64_t start_ts, int32_t op, bool has_value,
+                      const char* val, int vlen) {
+    auto& chain = chains[key];
+    // strictly descending commit_ts; rollback markers carry an old
+    // start_ts and must not land above newer commits
+    size_t i = 0;
+    while (i < chain.size() && chain[i].commit_ts > commit_ts) i++;
+    Version v;
+    v.commit_ts = commit_ts;
+    v.start_ts = start_ts;
+    v.op = op;
+    v.has_value = has_value;
+    if (has_value && vlen > 0) v.value.assign(val, vlen);
+    chain.insert(chain.begin() + i, std::move(v));
+  }
+
+  // newest non-rollback version with commit_ts <= ts; nullptr if none
+  const Version* read(const std::string& key, uint64_t ts) {
+    auto it = chains.find(key);
+    if (it == chains.end()) return nullptr;
+    for (const auto& v : it->second) {
+      if (v.commit_ts <= ts && v.op != OP_ROLLBACK) return &v;
+    }
+    return nullptr;
+  }
+
+  uint64_t has_commit_after(const std::string& key, uint64_t ts) {
+    auto it = chains.find(key);
+    if (it == chains.end()) return 0;
+    for (const auto& v : it->second) {
+      if (v.commit_ts <= ts) break;
+      if (v.op != OP_ROLLBACK) return v.commit_ts;
+    }
+    return 0;
+  }
+
+  bool has_rollback(const std::string& key, uint64_t start_ts) {
+    auto it = chains.find(key);
+    if (it == chains.end()) return false;
+    for (const auto& v : it->second) {
+      if (v.start_ts == start_ts && v.op == OP_ROLLBACK) return true;
+    }
+    return false;
+  }
+};
+
+std::string mkstr(const char* p, int n) {
+  return std::string(p, p + (n > 0 ? n : 0));
+}
+
+// output buffer: caller frees via mvcc_buf_free
+char* alloc_out(const std::string& data, int64_t* out_len) {
+  *out_len = (int64_t)data.size();
+  char* buf = (char*)malloc(data.size() ? data.size() : 1);
+  if (!data.empty()) memcpy(buf, data.data(), data.size());
+  return buf;
+}
+
+void put_u32(std::string& s, uint32_t v) { s.append((char*)&v, 4); }
+
+}  // namespace
+
+extern "C" {
+
+void* mvcc_new() { return new Engine(); }
+
+void mvcc_delete(void* h) { delete (Engine*)h; }
+
+void mvcc_buf_free(char* p) { free(p); }
+
+// mutations: parallel arrays; vlens[i] < 0 means "no value" (DEL/LOCK)
+int32_t mvcc_prewrite(void* h, int32_t n, const char** keys,
+                      const int32_t* klens, const int32_t* ops,
+                      const char** vals, const int32_t* vlens,
+                      uint64_t start_ts, const char* primary, int32_t plen,
+                      uint64_t* out_ts, int32_t* out_idx) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int32_t i = 0; i < n; i++) {
+    std::string key = mkstr(keys[i], klens[i]);
+    auto it = e->locks.find(key);
+    if (it != e->locks.end() && it->second.start_ts != start_ts) {
+      *out_ts = it->second.start_ts;
+      *out_idx = i;
+      return ST_LOCKED;
+    }
+    uint64_t conflict = e->has_commit_after(key, start_ts);
+    if (conflict) {
+      *out_ts = conflict;
+      *out_idx = i;
+      return ST_CONFLICT;
+    }
+    if (e->has_rollback(key, start_ts)) {
+      *out_idx = i;
+      return ST_ROLLED_BACK;
+    }
+  }
+  for (int32_t i = 0; i < n; i++) {
+    LockRec l;
+    l.start_ts = start_ts;
+    l.op = ops[i];
+    l.primary = mkstr(primary, plen);
+    l.has_value = vlens[i] >= 0;
+    if (l.has_value && vlens[i] > 0) l.value.assign(vals[i], vlens[i]);
+    e->locks[mkstr(keys[i], klens[i])] = std::move(l);
+  }
+  return ST_OK;
+}
+
+int32_t mvcc_commit(void* h, int32_t n, const char** keys,
+                    const int32_t* klens, uint64_t start_ts,
+                    uint64_t commit_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int32_t i = 0; i < n; i++) {
+    std::string key = mkstr(keys[i], klens[i]);
+    auto it = e->locks.find(key);
+    if (it == e->locks.end() || it->second.start_ts != start_ts) {
+      // already committed (idempotent) or rolled back
+      if (e->has_rollback(key, start_ts)) return ST_ROLLED_BACK;
+      continue;
+    }
+    LockRec l = std::move(it->second);
+    e->locks.erase(it);
+    if (l.op != OP_LOCK) {
+      e->insert_version(key, commit_ts, start_ts, l.op, l.has_value,
+                        l.value.data(), (int)l.value.size());
+    }
+  }
+  return ST_OK;
+}
+
+void mvcc_rollback(void* h, int32_t n, const char** keys,
+                   const int32_t* klens, uint64_t start_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int32_t i = 0; i < n; i++) {
+    std::string key = mkstr(keys[i], klens[i]);
+    auto it = e->locks.find(key);
+    if (it != e->locks.end() && it->second.start_ts == start_ts)
+      e->locks.erase(it);
+    e->insert_version(key, start_ts, start_ts, OP_ROLLBACK, false, nullptr, 0);
+  }
+  e->waits.erase(start_ts);
+}
+
+int32_t mvcc_pessimistic_lock(void* h, int32_t n, const char** keys,
+                              const int32_t* klens, uint64_t start_ts,
+                              uint64_t for_update_ts, const char* primary,
+                              int32_t plen, uint64_t* out_ts,
+                              int32_t* out_idx) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int32_t i = 0; i < n; i++) {
+    std::string key = mkstr(keys[i], klens[i]);
+    auto it = e->locks.find(key);
+    if (it != e->locks.end() && it->second.start_ts != start_ts) {
+      uint64_t holder = it->second.start_ts;
+      // wait-for-graph cycle check (unistore/tikv/detector.go)
+      e->waits[start_ts] = holder;
+      std::unordered_set<uint64_t> seen{start_ts};
+      uint64_t cur = holder;
+      while (e->waits.count(cur)) {
+        cur = e->waits[cur];
+        if (seen.count(cur)) {
+          e->waits.erase(start_ts);
+          *out_ts = holder;
+          *out_idx = i;
+          return ST_DEADLOCK;
+        }
+        seen.insert(cur);
+      }
+      *out_ts = holder;
+      *out_idx = i;
+      return ST_LOCKED;
+    }
+    uint64_t conflict = e->has_commit_after(key, for_update_ts);
+    if (conflict) {
+      *out_ts = conflict;
+      *out_idx = i;
+      return ST_CONFLICT;
+    }
+  }
+  for (int32_t i = 0; i < n; i++) {
+    std::string key = mkstr(keys[i], klens[i]);
+    if (!e->locks.count(key)) {
+      LockRec l;
+      l.start_ts = start_ts;
+      l.op = OP_LOCK;
+      l.has_value = false;
+      l.primary = mkstr(primary, plen);
+      e->locks[key] = std::move(l);
+    }
+  }
+  return ST_OK;
+}
+
+void mvcc_clear_wait(void* h, uint64_t start_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  e->waits.erase(start_ts);
+}
+
+// 1 if locked (fills *start_ts), else 0
+int32_t mvcc_lock_info(void* h, const char* key, int32_t klen,
+                       uint64_t* start_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->locks.find(mkstr(key, klen));
+  if (it == e->locks.end()) return 0;
+  *start_ts = it->second.start_ts;
+  return 1;
+}
+
+int32_t mvcc_get(void* h, const char* key, int32_t klen, uint64_t ts,
+                 uint64_t own_start_ts, char** out, int64_t* out_len,
+                 uint64_t* lock_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string k = mkstr(key, klen);
+  auto it = e->locks.find(k);
+  if (it != e->locks.end() && it->second.start_ts != own_start_ts &&
+      it->second.op != OP_LOCK && it->second.start_ts < ts) {
+    *lock_ts = it->second.start_ts;
+    return ST_LOCKED;
+  }
+  const Version* v = e->read(k, ts);
+  if (v == nullptr || v->op != OP_PUT) return ST_NOT_FOUND;
+  *out = alloc_out(v->value, out_len);
+  return ST_OK;
+}
+
+// scan result buffer: repeated [u32 klen][key][u32 vlen][value]
+int32_t mvcc_scan(void* h, const char* start, int32_t slen, const char* end,
+                  int32_t elen, uint64_t ts, int64_t limit,
+                  uint64_t own_start_ts, char** out, int64_t* out_len,
+                  int64_t* out_n, uint64_t* lock_ts, char** lock_key,
+                  int64_t* lock_key_len) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string s = mkstr(start, slen);
+  std::string en = mkstr(end, elen);
+  std::string buf;
+  int64_t n = 0;
+  auto it = e->chains.lower_bound(s);
+  for (; it != e->chains.end(); ++it) {
+    if (elen > 0 && it->first >= en) break;
+    auto lk = e->locks.find(it->first);
+    if (lk != e->locks.end() && lk->second.start_ts != own_start_ts &&
+        lk->second.op != OP_LOCK && lk->second.start_ts < ts) {
+      *lock_ts = lk->second.start_ts;
+      *lock_key = alloc_out(it->first, lock_key_len);
+      return ST_LOCKED;
+    }
+    const Version* v = e->read(it->first, ts);
+    if (v != nullptr && v->op == OP_PUT) {
+      put_u32(buf, (uint32_t)it->first.size());
+      buf.append(it->first);
+      put_u32(buf, (uint32_t)v->value.size());
+      buf.append(v->value);
+      if (++n >= limit && limit > 0) break;
+    }
+  }
+  *out = alloc_out(buf, out_len);
+  *out_n = n;
+  return ST_OK;
+}
+
+void mvcc_raw_put(void* h, const char* key, int32_t klen, const char* val,
+                  int32_t vlen, uint64_t commit_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  e->insert_version(mkstr(key, klen), commit_ts, commit_ts, OP_PUT, true,
+                    val, vlen);
+}
+
+// whole batch under one lock: a concurrent snapshot either sees the full
+// batch or none of it (the Python engine holds its RLock across the batch)
+void mvcc_raw_batch_put(void* h, int32_t n, const char** keys,
+                        const int32_t* klens, const char** vals,
+                        const int32_t* vlens, uint64_t commit_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  for (int32_t i = 0; i < n; i++) {
+    e->insert_version(mkstr(keys[i], klens[i]), commit_ts, commit_ts,
+                      OP_PUT, true, vals[i], vlens[i]);
+  }
+}
+
+// check-then-commit/rollback of an orphan lock atomically (GC worker
+// resolveLocks); composing lock_info + commit from Python races with
+// concurrent rollbacks
+int32_t mvcc_resolve_lock(void* h, const char* key, int32_t klen,
+                          int32_t committed, uint64_t commit_ts) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string k = mkstr(key, klen);
+  auto it = e->locks.find(k);
+  if (it == e->locks.end()) return ST_OK;
+  uint64_t start_ts = it->second.start_ts;
+  if (committed) {
+    LockRec l = std::move(it->second);
+    e->locks.erase(it);
+    if (l.op != OP_LOCK) {
+      e->insert_version(k, commit_ts, start_ts, l.op, l.has_value,
+                        l.value.data(), (int)l.value.size());
+    }
+  } else {
+    e->locks.erase(it);
+    e->insert_version(k, start_ts, start_ts, OP_ROLLBACK, false, nullptr, 0);
+    e->waits.erase(start_ts);
+  }
+  return ST_OK;
+}
+
+void mvcc_raw_delete_range(void* h, const char* start, int32_t slen,
+                           const char* end, int32_t elen) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string s = mkstr(start, slen);
+  auto lo = e->chains.lower_bound(s);
+  auto hi = elen > 0 ? e->chains.lower_bound(mkstr(end, elen))
+                     : e->chains.end();
+  e->chains.erase(lo, hi);
+}
+
+void mvcc_gc(void* h, uint64_t safe_point) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->chains.begin();
+  while (it != e->chains.end()) {
+    std::vector<Version> keep;
+    bool kept_visible = false;
+    for (auto& v : it->second) {
+      if (v.commit_ts > safe_point) {
+        keep.push_back(std::move(v));
+      } else if (v.op == OP_ROLLBACK) {
+        continue;  // stale marker: never the visible version
+      } else if (!kept_visible) {
+        kept_visible = true;
+        if (v.op == OP_PUT) keep.push_back(std::move(v));
+      }
+      // older than first visible-at-safepoint: drop
+    }
+    if (keep.empty()) {
+      it = e->chains.erase(it);
+    } else {
+      it->second = std::move(keep);
+      ++it;
+    }
+  }
+}
+
+// chain introspection (reference: server/http_handler.go MVCC API):
+// repeated [u64 commit_ts][u64 start_ts][i32 op][u32 vlen][value]
+int32_t mvcc_chain_dump(void* h, const char* key, int32_t klen, char** out,
+                        int64_t* out_len, int64_t* out_n) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string buf;
+  int64_t n = 0;
+  auto it = e->chains.find(mkstr(key, klen));
+  if (it != e->chains.end()) {
+    for (const auto& v : it->second) {
+      buf.append((char*)&v.commit_ts, 8);
+      buf.append((char*)&v.start_ts, 8);
+      buf.append((char*)&v.op, 4);
+      uint32_t vlen = v.has_value ? (uint32_t)v.value.size() : 0;
+      put_u32(buf, vlen);
+      buf.append(v.value.data(), vlen);
+      n++;
+    }
+  }
+  *out = alloc_out(buf, out_len);
+  *out_n = n;
+  return ST_OK;
+}
+
+int64_t mvcc_key_count(void* h) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  return (int64_t)e->chains.size();
+}
+
+}  // extern "C"

@@ -334,6 +334,16 @@ class MVCCStore:
                 if idx < len(self.map.keys) and self.map.keys[idx] == key:
                     del self.map.keys[idx]
 
+    def key_count(self) -> int:
+        return len(self.map.keys)
+
+    def debug_chain(self, key: bytes):
+        """[(commit_ts, start_ts, op, value)] newest-first (reference:
+        the HTTP MVCC introspection API, server/http_handler.go)."""
+        with self._lock:
+            return [(c, s, op, v if op == OP_PUT else None)
+                    for c, s, op, v in self.map.vals.get(key, [])]
+
     # -- regions ------------------------------------------------------------
 
     def split_region(self, split_key: bytes):

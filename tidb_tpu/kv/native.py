@@ -1,0 +1,352 @@
+"""ctypes binding for the C++ MVCC engine (native/mvcc_engine.cpp) — the
+reference's native storage node role (TiKV is Rust; unistore emulates it in
+Go; here the embedded engine is C++ behind a C ABI).
+
+`NativeMVCCStore` is a drop-in for kv.mvcc.MVCCStore: same methods, same
+exceptions, same semantics (the C++ is a line-for-line port of the Python
+engine's logic). Control-plane metadata (TSO, regions, table watermarks)
+stays in Python — it is not on the hot path.
+
+The shared library builds on demand with g++ (cached next to the source);
+`load_engine()` returns None when no toolchain is available and the caller
+falls back to the Python engine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+from ..errors import DeadlockError, LockedError, TiDBError, WriteConflictError
+from .mvcc import OP_LOCK, OP_ROLLBACK, Region, TSOracle
+
+_ST_OK = 0
+_ST_LOCKED = 1
+_ST_CONFLICT = 2
+_ST_DEADLOCK = 3
+_ST_ROLLED_BACK = 4
+_ST_NOT_FOUND = 5
+
+_lib = None
+_lib_err = None
+_lib_lock = threading.Lock()
+
+
+def _native_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _build_lib(src: str, out: str):
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out, src]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_engine():
+    """Load (building if needed) the native engine; None if unavailable."""
+    global _lib, _lib_err
+    with _lib_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        src = os.path.join(_native_dir(), "mvcc_engine.cpp")
+        out = os.path.join(_native_dir(), "libmvcc_engine.so")
+        try:
+            if (not os.path.exists(out)
+                    or os.path.getmtime(out) < os.path.getmtime(src)):
+                _build_lib(src, out)
+            lib = ctypes.CDLL(out)
+        except Exception as e:  # no toolchain / bad build → python engine
+            _lib_err = e
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib):
+    c = ctypes
+    lib.mvcc_new.restype = c.c_void_p
+    lib.mvcc_delete.argtypes = [c.c_void_p]
+    lib.mvcc_buf_free.argtypes = [c.c_char_p]
+    lib.mvcc_prewrite.restype = c.c_int32
+    lib.mvcc_prewrite.argtypes = [
+        c.c_void_p, c.c_int32, c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+        c.POINTER(c.c_int32), c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+        c.c_uint64, c.c_char_p, c.c_int32, c.POINTER(c.c_uint64),
+        c.POINTER(c.c_int32)]
+    lib.mvcc_commit.restype = c.c_int32
+    lib.mvcc_commit.argtypes = [
+        c.c_void_p, c.c_int32, c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+        c.c_uint64, c.c_uint64]
+    lib.mvcc_rollback.argtypes = [
+        c.c_void_p, c.c_int32, c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+        c.c_uint64]
+    lib.mvcc_pessimistic_lock.restype = c.c_int32
+    lib.mvcc_pessimistic_lock.argtypes = [
+        c.c_void_p, c.c_int32, c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+        c.c_uint64, c.c_uint64, c.c_char_p, c.c_int32,
+        c.POINTER(c.c_uint64), c.POINTER(c.c_int32)]
+    lib.mvcc_clear_wait.argtypes = [c.c_void_p, c.c_uint64]
+    lib.mvcc_lock_info.restype = c.c_int32
+    lib.mvcc_lock_info.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                   c.POINTER(c.c_uint64)]
+    lib.mvcc_get.restype = c.c_int32
+    lib.mvcc_get.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int32, c.c_uint64, c.c_uint64,
+        c.POINTER(c.c_void_p), c.POINTER(c.c_int64), c.POINTER(c.c_uint64)]
+    lib.mvcc_scan.restype = c.c_int32
+    lib.mvcc_scan.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int32, c.c_char_p, c.c_int32,
+        c.c_uint64, c.c_int64, c.c_uint64, c.POINTER(c.c_void_p),
+        c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_uint64),
+        c.POINTER(c.c_void_p), c.POINTER(c.c_int64)]
+    lib.mvcc_raw_put.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                 c.c_char_p, c.c_int32, c.c_uint64]
+    lib.mvcc_raw_batch_put.argtypes = [
+        c.c_void_p, c.c_int32, c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
+        c.POINTER(c.c_char_p), c.POINTER(c.c_int32), c.c_uint64]
+    lib.mvcc_resolve_lock.restype = c.c_int32
+    lib.mvcc_resolve_lock.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                      c.c_int32, c.c_uint64]
+    lib.mvcc_raw_delete_range.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                          c.c_char_p, c.c_int32]
+    lib.mvcc_gc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.mvcc_chain_dump.restype = c.c_int32
+    lib.mvcc_chain_dump.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int32, c.POINTER(c.c_void_p),
+        c.POINTER(c.c_int64), c.POINTER(c.c_int64)]
+    lib.mvcc_key_count.restype = c.c_int64
+    lib.mvcc_key_count.argtypes = [c.c_void_p]
+
+
+def _take_buf(lib, ptr, length) -> bytes:
+    if not ptr:
+        return b""
+    data = ctypes.string_at(ptr, length)
+    lib.mvcc_buf_free(ctypes.cast(ptr, ctypes.c_char_p))
+    return data
+
+
+def _key_arrays(keys):
+    n = len(keys)
+    arr = (ctypes.c_char_p * n)(*keys)
+    lens = (ctypes.c_int32 * n)(*[len(k) for k in keys])
+    return n, arr, lens
+
+
+class NativeMVCCStore:
+    """Drop-in for kv.mvcc.MVCCStore backed by the C++ engine."""
+
+    def __init__(self):
+        self._lib = load_engine()
+        if self._lib is None:
+            raise TiDBError(f"native engine unavailable: {_lib_err}")
+        self._h = ctypes.c_void_p(self._lib.mvcc_new())
+        self.tso = TSOracle()
+        self.regions: list[Region] = [Region(b"", b"", region_id=1)]
+        self.safe_point = 0
+        self.table_versions: dict[int, int] = {}
+        self._meta_lock = threading.Lock()
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.mvcc_delete(h)
+
+    # -- transactional API --------------------------------------------------
+
+    def prewrite(self, mutations, primary: bytes, start_ts: int):
+        n = len(mutations)
+        keys = (ctypes.c_char_p * n)(*[m[0] for m in mutations])
+        klens = (ctypes.c_int32 * n)(*[len(m[0]) for m in mutations])
+        ops = (ctypes.c_int32 * n)(*[m[1] for m in mutations])
+        vals = (ctypes.c_char_p * n)(
+            *[m[2] if m[2] is not None else b"" for m in mutations])
+        vlens = (ctypes.c_int32 * n)(
+            *[len(m[2]) if m[2] is not None else -1 for m in mutations])
+        out_ts = ctypes.c_uint64()
+        out_idx = ctypes.c_int32()
+        st = self._lib.mvcc_prewrite(self._h, n, keys, klens, ops, vals,
+                                     vlens, start_ts, primary, len(primary),
+                                     ctypes.byref(out_ts),
+                                     ctypes.byref(out_idx))
+        if st == _ST_LOCKED:
+            raise LockedError(f"key locked by txn {out_ts.value}",
+                              key=mutations[out_idx.value][0],
+                              lock_ts=out_ts.value)
+        if st == _ST_CONFLICT:
+            raise WriteConflictError(
+                f"write conflict: key committed at {out_ts.value} "
+                f"> start {start_ts}")
+        if st == _ST_ROLLED_BACK:
+            raise WriteConflictError("transaction already rolled back")
+
+    def commit(self, keys, start_ts: int, commit_ts: int):
+        keys = list(keys)
+        n, arr, lens = _key_arrays(keys)
+        st = self._lib.mvcc_commit(self._h, n, arr, lens, start_ts, commit_ts)
+        if st == _ST_ROLLED_BACK:
+            raise WriteConflictError("txn rolled back before commit")
+
+    def rollback(self, keys, start_ts: int):
+        keys = list(keys)
+        n, arr, lens = _key_arrays(keys)
+        self._lib.mvcc_rollback(self._h, n, arr, lens, start_ts)
+
+    def acquire_pessimistic_lock(self, keys, primary: bytes, start_ts: int,
+                                 for_update_ts: int):
+        keys = list(keys)
+        n, arr, lens = _key_arrays(keys)
+        out_ts = ctypes.c_uint64()
+        out_idx = ctypes.c_int32()
+        st = self._lib.mvcc_pessimistic_lock(
+            self._h, n, arr, lens, start_ts, for_update_ts, primary,
+            len(primary), ctypes.byref(out_ts), ctypes.byref(out_idx))
+        if st == _ST_DEADLOCK:
+            raise DeadlockError("deadlock detected")
+        if st == _ST_LOCKED:
+            raise LockedError(f"key locked by txn {out_ts.value}",
+                              key=keys[out_idx.value], lock_ts=out_ts.value)
+        if st == _ST_CONFLICT:
+            raise WriteConflictError(
+                f"pessimistic conflict at {out_ts.value} "
+                f"> for_update {for_update_ts}")
+
+    def clear_wait(self, start_ts: int):
+        self._lib.mvcc_clear_wait(self._h, start_ts)
+
+    def resolve_lock(self, key: bytes, committed: bool, commit_ts: int = 0):
+        # single atomic engine call: check + commit/rollback under the
+        # engine mutex (composing lock_info + commit here would race)
+        self._lib.mvcc_resolve_lock(self._h, key, len(key),
+                                    1 if committed else 0, commit_ts)
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, key: bytes, ts: int, own_start_ts: int = 0):
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        lock_ts = ctypes.c_uint64()
+        st = self._lib.mvcc_get(self._h, key, len(key), ts, own_start_ts,
+                                ctypes.byref(out), ctypes.byref(out_len),
+                                ctypes.byref(lock_ts))
+        if st == _ST_LOCKED:
+            raise LockedError("read blocked by lock", key=key,
+                              lock_ts=lock_ts.value)
+        if st == _ST_NOT_FOUND:
+            return None
+        return _take_buf(self._lib, out.value, out_len.value)
+
+    def scan(self, start: bytes, end: bytes, ts: int, limit: int = 0,
+             own_start_ts: int = 0):
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        out_n = ctypes.c_int64()
+        lock_ts = ctypes.c_uint64()
+        lock_key = ctypes.c_void_p()
+        lock_key_len = ctypes.c_int64()
+        st = self._lib.mvcc_scan(
+            self._h, start, len(start), end, len(end), ts, limit,
+            own_start_ts, ctypes.byref(out), ctypes.byref(out_len),
+            ctypes.byref(out_n), ctypes.byref(lock_ts),
+            ctypes.byref(lock_key), ctypes.byref(lock_key_len))
+        if st == _ST_LOCKED:
+            k = _take_buf(self._lib, lock_key.value, lock_key_len.value)
+            raise LockedError("scan blocked by lock", key=k,
+                              lock_ts=lock_ts.value)
+        buf = _take_buf(self._lib, out.value, out_len.value)
+        res = []
+        pos = 0
+        for _ in range(out_n.value):
+            (klen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            k = buf[pos:pos + klen]
+            pos += klen
+            (vlen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            v = buf[pos:pos + vlen]
+            pos += vlen
+            res.append((k, v))
+        return res
+
+    # -- raw ----------------------------------------------------------------
+
+    def raw_put(self, key: bytes, value: bytes, commit_ts: int | None = None):
+        ts = commit_ts if commit_ts is not None else self.tso.next_ts()
+        self._lib.mvcc_raw_put(self._h, key, len(key), value, len(value), ts)
+
+    def raw_batch_put(self, pairs, commit_ts: int | None = None):
+        ts = commit_ts if commit_ts is not None else self.tso.next_ts()
+        pairs = list(pairs)
+        n = len(pairs)
+        if n == 0:
+            return
+        keys = (ctypes.c_char_p * n)(*[k for k, _v in pairs])
+        klens = (ctypes.c_int32 * n)(*[len(k) for k, _v in pairs])
+        vals = (ctypes.c_char_p * n)(*[v for _k, v in pairs])
+        vlens = (ctypes.c_int32 * n)(*[len(v) for _k, v in pairs])
+        self._lib.mvcc_raw_batch_put(self._h, n, keys, klens, vals, vlens, ts)
+
+    def raw_delete_range(self, start: bytes, end: bytes):
+        self._lib.mvcc_raw_delete_range(self._h, start, len(start),
+                                        end, len(end))
+
+    # -- GC -----------------------------------------------------------------
+
+    def gc(self, safe_point: int):
+        self.safe_point = max(self.safe_point, safe_point)
+        self._lib.mvcc_gc(self._h, safe_point)
+
+    def key_count(self) -> int:
+        return self._lib.mvcc_key_count(self._h)
+
+    def debug_chain(self, key: bytes):
+        """[(commit_ts, start_ts, op, value)] newest-first (reference:
+        the HTTP MVCC introspection API, server/http_handler.go)."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        out_n = ctypes.c_int64()
+        self._lib.mvcc_chain_dump(self._h, key, len(key), ctypes.byref(out),
+                                  ctypes.byref(out_len), ctypes.byref(out_n))
+        buf = _take_buf(self._lib, out.value, out_len.value)
+        res = []
+        pos = 0
+        for _ in range(out_n.value):
+            commit_ts, start_ts, op, vlen = struct.unpack_from(
+                "<QQiI", buf, pos)
+            pos += 24
+            v = buf[pos:pos + vlen]
+            pos += vlen
+            res.append((commit_ts, start_ts, op,
+                        v if op == 0 else None))
+        return res
+
+    # -- regions / table watermarks (python control plane) ------------------
+
+    def split_region(self, split_key: bytes):
+        with self._meta_lock:
+            for i, r in enumerate(self.regions):
+                if r.contains(split_key) and r.start != split_key:
+                    new = Region(split_key, r.end)
+                    r.end = split_key
+                    self.regions.insert(i + 1, new)
+                    return new
+            return None
+
+    def regions_in_range(self, start: bytes, end: bytes):
+        out = []
+        for r in self.regions:
+            if (not r.end or r.end > start) and (not end or r.start < end):
+                out.append(r)
+        return out
+
+    def bump_table_version(self, table_id: int):
+        with self._meta_lock:
+            self.table_versions[table_id] = \
+                self.table_versions.get(table_id, 0) + 1
+
+    def table_version(self, table_id: int) -> int:
+        return self.table_versions.get(table_id, 0)

@@ -179,10 +179,15 @@ class Transaction:
 
 
 class Storage:
-    """Process-wide storage handle (reference: kv.Storage)."""
+    """Process-wide storage handle (reference: kv.Storage).
 
-    def __init__(self):
-        self.mvcc = MVCCStore()
+    backend: "native" (C++ engine, native/mvcc_engine.cpp), "python"
+    (kv/mvcc.py), or "auto" (native when buildable, else python) — the
+    reference's store registry role (store.Register/New)."""
+
+    def __init__(self, backend: str = "auto"):
+        self.mvcc = _new_engine(backend)
+        self.backend = type(self.mvcc).__name__
         self._lock = threading.Lock()
 
     def next_ts(self) -> int:
@@ -198,6 +203,18 @@ class Storage:
         return self.next_ts()
 
 
-def new_store() -> Storage:
+def _new_engine(backend: str):
+    import os
+    if backend == "auto":  # env only decides the unspecified case;
+        backend = os.environ.get("TIDB_TPU_KV_ENGINE", "auto")
+    if backend == "python":
+        return MVCCStore()
+    from .native import NativeMVCCStore, load_engine
+    if backend == "native":
+        return NativeMVCCStore()
+    return NativeMVCCStore() if load_engine() is not None else MVCCStore()
+
+
+def new_store(backend: str = "auto") -> Storage:
     """reference: store.New("unistore://...")"""
-    return Storage()
+    return Storage(backend=backend)

@@ -4,8 +4,9 @@ resultset streaming at conn.go:2096).
 
 Threaded TCP server; each connection owns a Session over the shared
 Domain — the reference's per-conn goroutine becomes a thread. Prepared
-statements use the text protocol's execution path with '?' parameters
-substituted at EXECUTE time (binary row encoding is a follow-up)."""
+statements parse once at PREPARE ('?' lexes to real ParamMarker nodes)
+and bind decoded binary parameters through the session's parameter
+pathway at EXECUTE (binary row encoding is a follow-up)."""
 
 from __future__ import annotations
 
@@ -157,8 +158,10 @@ class MySQLServer:
                     sql = payload.decode("utf-8")
                     next_stmt += 1
                     sid = next_stmt
-                    n_params = _count_params(sql)
-                    stmts[sid] = [sql, n_params, None]
+                    # parse ONCE: '?' are real ParamMarker nodes, so the
+                    # count follows SQL lexing (strings/comments excluded)
+                    ast_stmt, n_params = session.prepare(sql)
+                    stmts[sid] = [ast_stmt, n_params, None]
                     out = (b"\x00" + struct.pack("<I", sid)
                            + struct.pack("<H", 0)
                            + struct.pack("<H", n_params)
@@ -213,7 +216,7 @@ class MySQLServer:
         if sid not in stmts:
             io.write_packet(P.build_err(1243, "Unknown prepared statement"))
             return
-        sql, n_params, bound_types = stmts[sid]
+        ast_stmt, n_params, bound_types = stmts[sid]
         pos = 4 + 1 + 4  # id, flags, iteration count
         args = []
         if n_params:
@@ -240,8 +243,14 @@ class MySQLServer:
                 tp, flags = types[i]
                 v, pos = _decode_binary_value(payload, pos, tp, flags)
                 args.append(v)
-        io_sql = _substitute_params(sql, args)
-        self._run_query(io, session, io_sql)
+        res = session.execute_prepared(ast_stmt, args)
+        status = P.SERVER_STATUS_AUTOCOMMIT
+        if res.chunk is None:
+            io.write_packet(P.build_ok(
+                affected=res.affected,
+                last_insert_id=res.last_insert_id, status=status))
+        else:
+            self._write_resultset(io, res, status)
 
 
 def _param_ftype():
@@ -307,66 +316,3 @@ def _decode_binary_value(buf, pos, tp, flags=0):
     return buf[pos:pos + n], pos + n
 
 
-def _count_params(sql: str) -> int:
-    """Placeholders outside string literals — must agree with
-    _substitute_params' scanner or PREPARE advertises the wrong count."""
-    count = 0
-    in_str = None
-    i = 0
-    while i < len(sql):
-        ch = sql[i]
-        if in_str:
-            if ch == "\\" and i + 1 < len(sql):
-                i += 2
-                continue
-            if ch == in_str:
-                in_str = None
-        elif ch in ("'", '"'):
-            in_str = ch
-        elif ch == "?":
-            count += 1
-        i += 1
-    return count
-
-
-def _substitute_params(sql: str, args):
-    """Inline EXECUTE parameters into the statement text ('?' placeholders
-    outside string literals), with proper quoting."""
-    out = []
-    it = iter(args)
-    in_str = None
-    i = 0
-    while i < len(sql):
-        ch = sql[i]
-        if in_str:
-            if ch == "\\" and i + 1 < len(sql):
-                out.append(sql[i:i + 2])
-                i += 2
-                continue
-            if ch == in_str:
-                in_str = None
-            out.append(ch)
-        elif ch in ("'", '"'):
-            in_str = ch
-            out.append(ch)
-        elif ch == "?":
-            try:
-                v = next(it)
-            except StopIteration:
-                raise TiDBError("parameter count mismatch")
-            out.append(_quote_value(v))
-        else:
-            out.append(ch)
-        i += 1
-    return "".join(out)
-
-
-def _quote_value(v) -> str:
-    if v is None:
-        return "NULL"
-    if isinstance(v, (int, float)):
-        return repr(v)
-    if isinstance(v, bytes):
-        v = v.decode("utf-8", "surrogateescape")
-    s = str(v).replace("\\", "\\\\").replace("'", "\\'")
-    return f"'{s}'"

@@ -337,6 +337,25 @@ class Session:
         stmts = self.parser.parse(sql)
         return [self._execute_stmt(s) for s in stmts]
 
+    def prepare(self, sql: str):
+        """Binary-protocol PREPARE: parse once, return (stmt_ast,
+        param_count) — '?' markers are real ParamMarker nodes, so the count
+        follows SQL lexing (comments/identifiers/strings excluded).
+        reference: server/driver_tidb.go Prepare."""
+        stmts = self.parser.parse(sql)
+        if len(stmts) != 1:
+            raise TiDBError("prepared statement must be a single statement")
+        return stmts[0], self.parser.param_count
+
+    def execute_prepared(self, stmt_ast, params: list) -> Result:
+        """Binary-protocol EXECUTE over a pre-parsed statement with bound
+        parameters (reference: server/conn_stmt.go handleStmtExecute)."""
+        self._expr_ctx.params = list(params)
+        try:
+            return self._execute_stmt(stmt_ast)
+        finally:
+            self._expr_ctx.params = None
+
     def _execute_stmt(self, stmt) -> Result:
         self.warnings = []
         try:
