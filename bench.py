@@ -50,7 +50,7 @@ def gen_lineitem(tk, sf: float):
             l_linestatus varchar(1), l_shipdate date)""")
     info = tk.domain.infoschema().table_by_name("tpch", "lineitem")
 
-    orderkey = rng.integers(1, n, n)
+    orderkey = rng.integers(1, max(int(1_500_000 * sf), 2), n)
     qty = rng.integers(1, 51, n) * 100               # 1.00-50.00
     price = rng.integers(900_00, 105_000_00, n)      # ~dbgen price range
     disc = rng.integers(0, 11, n)                    # 0.00-0.10
@@ -86,6 +86,70 @@ def gen_lineitem(tk, sf: float):
     tk.domain.columnar_cache.install_bulk(
         info, columns, np.arange(1, n + 1, dtype=np.int64))
     return n
+
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < '1995-03-15'
+  and l_shipdate > '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+
+def gen_orders_customer(tk, sf: float):
+    """customer + orders with TPC-H-like sizes; lineitem l_orderkey values
+    must already be in [1, n_orders] (gen_lineitem draws them that way)."""
+    n_cust = int(150_000 * sf)
+    n_orders = int(1_500_000 * sf)
+    rng = np.random.default_rng(7)
+    tk.must_exec("""
+        create table customer (
+            c_custkey bigint, c_mktsegment varchar(10))""")
+    tk.must_exec("""
+        create table orders (
+            o_orderkey bigint, o_custkey bigint, o_orderdate date,
+            o_shippriority bigint)""")
+    segs = np.array([b"AUTOMOBILE", b"BUILDING", b"FURNITURE",
+                     b"MACHINERY", b"HOUSEHOLD"], dtype=object)
+    d0 = (np.datetime64("1992-01-01") - np.datetime64("1970-01-01")).astype(int)
+    d1 = (np.datetime64("1998-08-02") - np.datetime64("1970-01-01")).astype(int)
+
+    info = tk.domain.infoschema().table_by_name("tpch", "customer")
+    cols = {c.name: c for c in info.public_columns()}
+    z = np.zeros(n_cust, dtype=bool)
+    seg_codes = rng.integers(0, 5, n_cust).astype(np.int32)
+    seg_col = Column(cols["c_mktsegment"].ftype, segs[seg_codes], z)
+    # set_dict requires sorted uniques; map codes through argsort
+    order = np.argsort(segs)
+    remap = np.empty_like(order)
+    remap[order] = np.arange(5)
+    seg_col.set_dict(remap[seg_codes], segs[order])
+    tk.domain.columnar_cache.install_bulk(info, {
+        cols["c_custkey"].id: Column(cols["c_custkey"].ftype,
+                                     np.arange(1, n_cust + 1), z),
+        cols["c_mktsegment"].id: seg_col,
+    }, np.arange(1, n_cust + 1, dtype=np.int64))
+
+    info = tk.domain.infoschema().table_by_name("tpch", "orders")
+    cols = {c.name: c for c in info.public_columns()}
+    z = np.zeros(n_orders, dtype=bool)
+    tk.domain.columnar_cache.install_bulk(info, {
+        cols["o_orderkey"].id: Column(cols["o_orderkey"].ftype,
+                                      np.arange(1, n_orders + 1), z),
+        cols["o_custkey"].id: Column(cols["o_custkey"].ftype,
+                                     rng.integers(1, n_cust + 1, n_orders), z),
+        cols["o_orderdate"].id: Column(
+            cols["o_orderdate"].ftype,
+            rng.integers(d0, d1, n_orders).astype(np.int32), z),
+        cols["o_shippriority"].id: Column(
+            cols["o_shippriority"].ftype,
+            np.zeros(n_orders, dtype=np.int64), z),
+    }, np.arange(1, n_orders + 1, dtype=np.int64))
+    return n_orders
 
 
 def time_query(tk, sql, repeats=3):

@@ -69,14 +69,19 @@ def _pipe_cache_put(key, fn, dict_refs):
 def _expr_sig(e) -> str:
     """Structural signature of an expression (type-aware; reprs alone drop
     decimal scales, which change the traced program)."""
+    from ..expression.core import Constant as _Const, ScalarFunc as _SF
     ft = e.ftype
     base = f"{ft.tp}.{ft.scale}"
     if isinstance(e, ExprColumn):
         return f"c{e.idx}:{base}"
-    if not hasattr(e, "op"):  # Constant
+    if isinstance(e, _Const):
         return f"k{e.value!r}:{base}"
-    extra = f"|{e.extra!r}" if e.extra is not None else ""
-    return (f"{e.op}({','.join(_expr_sig(a) for a in e.args)}){extra}:{base}")
+    if isinstance(e, _SF):
+        extra = f"|{e.extra!r}" if e.extra is not None else ""
+        return (f"{e.op}({','.join(_expr_sig(a) for a in e.args)})"
+                f"{extra}:{base}")
+    # apply-subqueries etc. never run on device
+    raise DeviceUnsupported(f"{type(e).__name__} in device fragment")
 
 
 def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
@@ -94,11 +99,12 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
                 d, nl = f(env)
                 m = (d != 0) & ~nl
                 mask = m if mask is None else (mask & m)
+            mask = jnp.broadcast_to(mask, (n,))
         else:
             mask = jnp.ones(n, dtype=bool)
         key_cols, key_nulls = [], []
         for f in key_fns:
-            d, nl = f(env)
+            d, nl = dev.broadcast_1d(*f(env), n)
             key_cols.append(d.astype(jnp.int64))
             key_nulls.append(nl)
         if not key_cols:
@@ -106,7 +112,7 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
             key_nulls = [jnp.zeros(n, dtype=bool)]
         val_cols, val_nulls = [], []
         for f, conv in val_plan:
-            d, nl = f(env)
+            d, nl = dev.broadcast_1d(*f(env), n)
             if conv == "int":
                 d = d.astype(jnp.int64)
             val_cols.append(d)
@@ -145,7 +151,51 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
 
     # --- host-side planning only below (no device ops until dispatch) ---
     cond_fns = [dev.compile_expr(c, dcols) for c in conds]
+    (key_fns, key_meta, key_pack, val_plan, agg_ops,
+     slots) = _plan_agg(plan, dcols)
+    n_keys = max(len(key_fns), 1)
 
+    sig_exprs = ";".join(
+        [_expr_sig(c) for c in conds] + ["|g|"] +
+        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
+        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
+         for d in plan.aggs] +
+        [str(id(dc.dictionary)) for dc in dcols.values()
+         if dc.dictionary is not None])
+
+    dict_refs = tuple(dc.dictionary for dc in dcols.values()
+                      if dc.dictionary is not None)
+    est = _estimate_groups(plan, n)
+    capacity = dev.next_pow2(min(n, max(est, 16)))
+    while True:
+        key = (sig_exprs, capacity, key_pack, tuple(agg_ops))
+        fn = _pipe_cache_get(key)
+        if fn is None:
+            fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                                 tuple(agg_ops), capacity, key_pack)
+            _pipe_cache_put(key, fn, dict_refs)
+        # ONE batched device→host copy for the whole result tree: per-array
+        # reads pay full fabric round-trip latency each (~150ms over a
+        # remote-device tunnel), and there are a dozen small result arrays
+        out = jax.device_get(fn(env))
+        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
+        ng = int(n_groups)
+        if ng <= capacity:
+            break
+        capacity = dev.next_pow2(ng)
+    if ng == 0 and not plan.group_exprs:
+        # global aggregate over zero kept rows still yields ONE row
+        # (count=0, sum/min/max NULL) — host path has the special case
+        raise DeviceUnsupported("empty global aggregate")
+    return _assemble_agg(plan, key_meta, slots, dcols,
+                         (key_out, key_null_out, results, result_nulls), ng)
+
+
+def _plan_agg(plan, dcols):
+    """Host-side agg planning shared by the scan-agg pipeline and the join
+    fragment: compile group keys and aggregate inputs against `dcols`
+    (global column idx → DeviceCol). Returns
+    (key_fns, key_meta, key_pack, val_plan, agg_ops, slots)."""
     key_fns = []
     key_meta = []  # (expr, dictionary or None)
     for e in plan.group_exprs:
@@ -161,7 +211,6 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
         else:
             key_meta.append((e, None))
             key_fns.append(dev.compile_expr(e, dcols))
-    n_keys = max(len(key_fns), 1)
     if key_fns:
         key_pack = _key_pack(plan.group_exprs, dcols)
     else:
@@ -212,41 +261,12 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
             val_plan.append((f, "raw" if is_float else "int"))
             agg_ops.append("count")
             slots.append(("avg", j_sum, len(val_plan) - 1))
+    return key_fns, key_meta, key_pack, val_plan, agg_ops, slots
 
-    sig_exprs = ";".join(
-        [_expr_sig(c) for c in conds] + ["|g|"] +
-        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
-        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
-         for d in plan.aggs] +
-        [str(id(dc.dictionary)) for dc in dcols.values()
-         if dc.dictionary is not None])
 
-    dict_refs = tuple(dc.dictionary for dc in dcols.values()
-                      if dc.dictionary is not None)
-    est = _estimate_groups(plan, n)
-    capacity = dev.next_pow2(min(n, max(est, 16)))
-    while True:
-        key = (sig_exprs, capacity, key_pack, tuple(agg_ops))
-        fn = _pipe_cache_get(key)
-        if fn is None:
-            fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
-                                 tuple(agg_ops), capacity, key_pack)
-            _pipe_cache_put(key, fn, dict_refs)
-        # ONE batched device→host copy for the whole result tree: per-array
-        # reads pay full fabric round-trip latency each (~150ms over a
-        # remote-device tunnel), and there are a dozen small result arrays
-        out = jax.device_get(fn(env))
-        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
-        ng = int(n_groups)
-        if ng <= capacity:
-            break
-        capacity = dev.next_pow2(ng)
-    if ng == 0 and not plan.group_exprs:
-        # global aggregate over zero kept rows still yields ONE row
-        # (count=0, sum/min/max NULL) — host path has the special case
-        raise DeviceUnsupported("empty global aggregate")
-
-    # assemble host chunk
+def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
+    """Device agg outputs (already copied to host) → result Chunk."""
+    key_out, key_null_out, results, result_nulls = out_host
     out_cols = []
     for (e, dictionary), kd, kn in zip(key_meta, key_out, key_null_out):
         kd = np.asarray(kd[:ng])

@@ -154,6 +154,21 @@ class HashAggExec(QueryExecutor):
                 return device_agg(p, raw, conds)
             except DeviceUnsupported:
                 pass
+        # join fragment: HashAgg over an (inner equi-)join tree of scans
+        # fuses scans+filters+joins+aggregate into one device program
+        if raw is None:
+            from .device_join import device_join_agg
+            join_child, agg_conds = child, []
+            if isinstance(child, SelectionExec) and isinstance(
+                    child.children[0], HashJoinExec):
+                join_child = child.children[0]
+                agg_conds = list(child.plan.conds)
+            if isinstance(join_child, HashJoinExec):
+                try:
+                    return device_join_agg(p, agg_conds, join_child,
+                                           self.ctx)
+                except DeviceUnsupported:
+                    pass
         if raw is not None:
             # reuse the materialized chunk on the host path
             chunk = raw

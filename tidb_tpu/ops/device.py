@@ -106,11 +106,15 @@ def compile_expr(expr, cols: dict):
 
 
 def _compile_const(expr: Constant, cols):
+    """Constants trace as 0-d arrays so they broadcast against whichever
+    column they meet — in a multi-table fragment the env holds arrays of
+    several lengths, so sizing a constant from 'the first env entry' would
+    be wrong. Consumers needing full-length arrays (group keys, aggregate
+    inputs, join keys) broadcast explicitly via broadcast_1d."""
     v = expr.value
     if v is None:
         def f(env):
-            n = _env_n(env)
-            return jnp.zeros(n, dtype=jnp.int64), jnp.ones(n, dtype=bool)
+            return jnp.zeros((), dtype=jnp.int64), jnp.ones((), dtype=bool)
         return f
     k = phys_kind(expr.ftype)
     if k == K_STR:
@@ -123,15 +127,18 @@ def _compile_const(expr: Constant, cols):
         dt = jnp.int64 if k != K_DATE else jnp.int32
 
     def f(env):
-        n = _env_n(env)
-        return jnp.full(n, val, dtype=dt), jnp.zeros(n, dtype=bool)
+        return jnp.asarray(val, dtype=dt), jnp.zeros((), dtype=bool)
     return f
 
 
-def _env_n(env):
-    for d, _ in env.values():
-        return d.shape[0]
-    raise DeviceUnsupported("constant expression with no input columns")
+def broadcast_1d(d, nl, n):
+    """Expand 0-d (constant) results to length n where a full array is
+    structurally required."""
+    if d.ndim == 0:
+        d = jnp.broadcast_to(d, (n,))
+    if nl.ndim == 0:
+        nl = jnp.broadcast_to(nl, (n,))
+    return d, nl
 
 
 def _dec_scale(e):
@@ -439,11 +446,12 @@ def _compile_case(sf, cols):
     fs = [compile_expr(a, cols) for a in args]
 
     def f(env):
-        n_rows = _env_n(env)
+        # scalar seeds broadcast up against whichever condition/result
+        # array they meet (constants are 0-d — see _compile_const)
         dt = jnp.float64 if phys_kind(sf.ftype) == K_FLOAT else jnp.int64
-        out = jnp.zeros(n_rows, dtype=dt)
-        out_n = jnp.ones(n_rows, dtype=bool)
-        decided = jnp.zeros(n_rows, dtype=bool)
+        out = jnp.zeros((), dtype=dt)
+        out_n = jnp.ones((), dtype=bool)
+        decided = jnp.zeros((), dtype=bool)
         for p in range(pairs):
             cd, cn = fs[2 * p](env)
             cond = (cd != 0) & ~cn & ~decided
@@ -531,9 +539,8 @@ def _compile_str_cmp(sf, cols):
         raise DeviceUnsupported("no dictionary for string column")
     if const.value is None:
         def f(env):
-            n_rows = _env_n(env)
-            return (jnp.zeros(n_rows, dtype=jnp.int64),
-                    jnp.ones(n_rows, dtype=bool))
+            return (jnp.zeros((), dtype=jnp.int64),
+                    jnp.ones((), dtype=bool))
         return f
     # dictionary from np.unique is sorted → order-preserving codes
     v = const.value if isinstance(const.value, bytes) else str(const.value).encode()
