@@ -59,7 +59,7 @@ class MiniClient:
         self.io.write_packet(bytes([P.COM_QUERY]) + sql.encode())
         return self._read_result()
 
-    def _read_result(self):
+    def _read_result(self, binary=False):
         first = self.io.read_packet()
         if first[0] == 0x00:
             affected, pos = read_lenenc_int(first, 1)
@@ -68,7 +68,7 @@ class MiniClient:
             code = struct.unpack_from("<H", first, 1)[0]
             return "err", (code, first[9:].decode())
         ncols, _ = read_lenenc_int(first, 0)
-        cols = []
+        cols, types = [], []
         for _ in range(ncols):
             pkt = self.io.read_packet()
             pos = 0
@@ -77,6 +77,8 @@ class MiniClient:
                 v, pos = read_lenenc_str(pkt, pos)
                 vals.append(v)
             cols.append(vals[4].decode())  # name
+            # fixed-length tail: 0x0C, charset(2), collen(4), type(1), ...
+            types.append(pkt[pos + 1 + 2 + 4])
         eof = self.io.read_packet()
         assert eof[0] == 0xFE
         rows = []
@@ -84,17 +86,85 @@ class MiniClient:
             pkt = self.io.read_packet()
             if pkt[0] == 0xFE and len(pkt) < 9:
                 break
-            pos = 0
-            row = []
-            for _ in range(ncols):
-                if pkt[pos] == 0xFB:
-                    row.append(None)
-                    pos += 1
-                else:
-                    v, pos = read_lenenc_str(pkt, pos)
-                    row.append(v.decode())
-            rows.append(tuple(row))
+            rows.append(self._decode_binary_row(pkt, types) if binary
+                        else self._decode_text_row(pkt, ncols))
         return "rows", (cols, rows)
+
+    def _decode_text_row(self, pkt, ncols):
+        pos = 0
+        row = []
+        for _ in range(ncols):
+            if pkt[pos] == 0xFB:
+                row.append(None)
+                pos += 1
+            else:
+                v, pos = read_lenenc_str(pkt, pos)
+                row.append(v.decode())
+        return tuple(row)
+
+    def _decode_binary_row(self, pkt, types):
+        """Protocol::BinaryResultsetRow → display strings (to compare with
+        text-protocol expectations)."""
+        assert pkt[0] == 0x00, "binary row must start with 0x00 header"
+        n = len(types)
+        bitmap_len = (n + 9) // 8
+        bitmap = pkt[1:1 + bitmap_len]
+        pos = 1 + bitmap_len
+        row = []
+        for i, tp in enumerate(types):
+            bit = i + 2
+            if bitmap[bit // 8] & (1 << (bit % 8)):
+                row.append(None)
+                continue
+            if tp == 0x01:
+                row.append(str(struct.unpack_from("<b", pkt, pos)[0]))
+                pos += 1
+            elif tp in (0x02, 0x0D):
+                row.append(str(struct.unpack_from("<h", pkt, pos)[0]))
+                pos += 2
+            elif tp in (0x03, 0x09):
+                row.append(str(struct.unpack_from("<i", pkt, pos)[0]))
+                pos += 4
+            elif tp == 0x08:
+                row.append(str(struct.unpack_from("<q", pkt, pos)[0]))
+                pos += 8
+            elif tp == 0x04:
+                row.append(repr(struct.unpack_from("<f", pkt, pos)[0]))
+                pos += 4
+            elif tp == 0x05:
+                row.append(repr(struct.unpack_from("<d", pkt, pos)[0]))
+                pos += 8
+            elif tp in (0x07, 0x0A, 0x0C):
+                ln = pkt[pos]
+                f = pkt[pos + 1:pos + 1 + ln]
+                pos += 1 + ln
+                if ln == 0:
+                    row.append("0000-00-00")
+                    continue
+                y, mo, d = struct.unpack_from("<H", f, 0)[0], f[2], f[3]
+                s = f"{y:04d}-{mo:02d}-{d:02d}"
+                if ln >= 7:
+                    s += f" {f[4]:02d}:{f[5]:02d}:{f[6]:02d}"
+                if ln == 11:
+                    s += f".{struct.unpack_from('<I', f, 7)[0]:06d}"
+                row.append(s)
+            elif tp == 0x0B:  # TIME: sign, days, h, m, s [, us]
+                ln = pkt[pos]
+                f = pkt[pos + 1:pos + 1 + ln]
+                pos += 1 + ln
+                if ln == 0:
+                    row.append("00:00:00")
+                    continue
+                sign = "-" if f[0] else ""
+                days = struct.unpack_from("<I", f, 1)[0]
+                s = f"{sign}{days * 24 + f[5]:02d}:{f[6]:02d}:{f[7]:02d}"
+                if ln > 8:
+                    s += f".{struct.unpack_from('<I', f, 8)[0]:06d}"
+                row.append(s)
+            else:
+                v, pos = read_lenenc_str(pkt, pos)
+                row.append(v.decode())
+        return tuple(row)
 
     def prepare_execute(self, sql, args):
         self.io.reset_seq()
@@ -102,10 +172,15 @@ class MiniClient:
         resp = self.io.read_packet()
         assert resp[0] == 0x00, resp
         sid = struct.unpack_from("<I", resp, 1)[0]
+        n_cols = struct.unpack_from("<H", resp, 5)[0]
         n_params = struct.unpack_from("<H", resp, 7)[0]
         for _ in range(n_params):
             self.io.read_packet()
         if n_params:
+            self.io.read_packet()  # EOF
+        for _ in range(n_cols):
+            self.io.read_packet()  # column definitions (real count)
+        if n_cols:
             self.io.read_packet()  # EOF
         # execute
         self.io.reset_seq()
@@ -129,7 +204,7 @@ class MiniClient:
                     body += lenenc_str(str(a).encode())
             out += body
         self.io.write_packet(out)
-        return self._read_result()
+        return self._read_result(binary=True)
 
     def close(self):
         try:
@@ -203,6 +278,19 @@ def test_prepared_statement(server):
     kind, (cols, rows) = c.prepare_execute(
         "select a from t where a = ? or b = ?", [2, "x"])
     assert sorted(rows) == [("1",), ("2",)]
+    c.close()
+
+
+def test_prepared_binary_nulls_and_strings(server):
+    """EXECUTE results ride the binary protocol: NULL via the bitmap at
+    offset 2, ints as 8-byte LE, strings as lenenc."""
+    c = MiniClient(server.port, db="srv")
+    kind, (cols, rows) = c.prepare_execute(
+        "select a, b from t where a = ?", [2])
+    assert rows == [("2", None)]
+    kind, (cols, rows) = c.prepare_execute(
+        "select b, a from t order by a", [])
+    assert rows == [("x", "1"), (None, "2")]
     c.close()
 
 

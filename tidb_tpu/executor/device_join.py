@@ -125,14 +125,20 @@ def _global_dcols(leaves):
 def _join_expand(bk, bvalid, pk, pvalid, cap):
     """Static-capacity inner equi-join expansion. Returns (probe_slot,
     build_slot, valid, overflow): slot arrays index the *input relations*
-    (length cap; garbage where ~valid)."""
+    (length cap; garbage where ~valid).
+
+    Join keys are arbitrary user int64 columns, so invalid rows are pushed
+    behind ALL valid rows by a (validity, key) lexsort and the searchsorted
+    bounds are clamped to the valid prefix — a plain int64.max sentinel
+    would interleave genuine max-valued keys with padding and overcount."""
     nb = bk.shape[0]
     npr = pk.shape[0]
-    sort_key = jnp.where(bvalid, bk, jnp.iinfo(jnp.int64).max)
-    order = jnp.argsort(sort_key)
-    sb = sort_key[order]
-    lo = jnp.searchsorted(sb, pk, side="left")
-    hi = jnp.searchsorted(sb, pk, side="right")
+    nb_valid = jnp.sum(bvalid)
+    order = jnp.lexsort((bk, ~bvalid))  # valid-first, then key-sorted
+    in_prefix = jnp.arange(nb) < nb_valid
+    sb = jnp.where(in_prefix, bk[order], jnp.iinfo(jnp.int64).max)
+    lo = jnp.minimum(jnp.searchsorted(sb, pk, side="left"), nb_valid)
+    hi = jnp.minimum(jnp.searchsorted(sb, pk, side="right"), nb_valid)
     cnt = jnp.where(pvalid, hi - lo, 0)
     cum = jnp.concatenate([jnp.zeros(1, dtype=cnt.dtype), jnp.cumsum(cnt)])
     total = cum[-1]

@@ -18,6 +18,7 @@ import ctypes
 import os
 import struct
 import subprocess
+import tempfile
 import threading
 
 from ..errors import DeadlockError, LockedError, TiDBError, WriteConflictError
@@ -41,8 +42,23 @@ def _native_dir():
 
 
 def _build_lib(src: str, out: str):
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out, src]
-    subprocess.run(cmd, check=True, capture_output=True)
+    """Compile to a temp file in the same dir, then os.rename() into place:
+    rename is atomic, so a concurrent process never dlopens a partially
+    written .so (g++ writes its output file in place)."""
+    fd, tmp = tempfile.mkstemp(
+        suffix=".so.tmp", dir=os.path.dirname(out))
+    os.close(fd)
+    try:
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp, src]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.rename(tmp, out)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_engine():

@@ -76,15 +76,14 @@ def _local_agg(keys, valid, vals, kinds, capacity):
     cumsum/gather for sums, segmented associative scan for min/max — the
     same scheme as ops/device._agg_impl, single-key variant.
 
-    Known limit: a genuine key equal to int64.max is used as the
-    invalid-row sentinel; group keys here are dict codes / hashes, which
-    never reach it."""
+    Sorts by (validity, key) — valid rows occupy the first `kept` sorted
+    positions for ANY key domain, including a genuine int64.max key (the
+    old single-key sentinel scheme interleaved such keys with padding)."""
     from ..ops.device import _group_spans, _seg_running
 
     n = keys.shape[0]
-    sort_key = jnp.where(valid, keys, jnp.iinfo(jnp.int64).max)
-    order = jnp.argsort(sort_key, stable=True)
-    sk = sort_key[order]
+    order = jnp.lexsort((keys, ~valid))  # valid-first, then key-sorted
+    sk = keys[order]
     kept = jnp.sum(valid)
     pos = jnp.arange(n)
     in_range = pos < kept

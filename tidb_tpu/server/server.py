@@ -23,10 +23,13 @@ from .packet import (PacketIO, lenenc_int, read_lenenc_int, read_nul_str)
 
 class MySQLServer:
     def __init__(self, domain, host="127.0.0.1", port=4000, users=None):
-        """users: optional {user: password} map; None accepts any login
-        (the bootstrap root@% with empty password behavior)."""
+        """users: optional {user: password} map. Default (None) is the
+        bootstrap behavior: root with empty password ONLY — accepting any
+        credential pair would hand full SQL access to anything that can
+        reach the port. Pass users={} to explicitly accept any login
+        (hermetic tests)."""
         self.domain = domain
-        self.users = users
+        self.users = {"root": ""} if users is None else users
         self._next_conn_id = 0
         self._lock = threading.Lock()
         self.connections = {}
@@ -119,8 +122,8 @@ class MySQLServer:
         return user.decode(), db.decode(), auth
 
     def _check_auth(self, user: str, auth: bytes, salt: bytes) -> bool:
-        if self.users is None:
-            return True
+        if self.users == {}:
+            return True  # explicit opt-in: accept any login
         if user not in self.users:
             return False
         expected = P.native_password_hash(
@@ -161,9 +164,11 @@ class MySQLServer:
                     # parse ONCE: '?' are real ParamMarker nodes, so the
                     # count follows SQL lexing (strings/comments excluded)
                     ast_stmt, n_params = session.prepare(sql)
+                    col_names, col_fts = session.prepared_schema(
+                        ast_stmt, n_params)
                     stmts[sid] = [ast_stmt, n_params, None]
                     out = (b"\x00" + struct.pack("<I", sid)
-                           + struct.pack("<H", 0)
+                           + struct.pack("<H", len(col_names))
                            + struct.pack("<H", n_params)
                            + b"\x00" + struct.pack("<H", 0))
                     io.write_packet(out)
@@ -171,6 +176,10 @@ class MySQLServer:
                         io.write_packet(P.column_def(
                             "?", _param_ftype()))
                     if n_params:
+                        io.write_packet(P.build_eof())
+                    for name, ft in zip(col_names, col_fts):
+                        io.write_packet(P.column_def(name, ft))
+                    if col_names:
                         io.write_packet(P.build_eof())
                 elif cmd == P.COM_STMT_EXECUTE:
                     self._stmt_execute(io, session, stmts, payload)
@@ -201,14 +210,21 @@ class MySQLServer:
                 continue
             self._write_resultset(io, res, status)
 
-    def _write_resultset(self, io, res, status):
+    def _write_resultset(self, io, res, status, binary=False):
+        """binary=True after COM_STMT_EXECUTE: the binary protocol requires
+        Protocol::BinaryResultsetRow, not text rows (reference:
+        server/conn_stmt.go handleStmtExecute → writeResultset(binary))."""
         fts = res.ftypes
         io.write_packet(lenenc_int(len(res.names)))
         for name, ft in zip(res.names, fts):
             io.write_packet(P.column_def(name, ft))
         io.write_packet(P.build_eof(status=status))
-        for row in res.rows:
-            io.write_packet(P.text_row(row))
+        if binary:
+            for row in res.rows:
+                io.write_packet(P.binary_row(row, fts))
+        else:
+            for row in res.rows:
+                io.write_packet(P.text_row(row))
         io.write_packet(P.build_eof(status=status))
 
     def _stmt_execute(self, io, session, stmts, payload):
@@ -250,7 +266,7 @@ class MySQLServer:
                 affected=res.affected,
                 last_insert_id=res.last_insert_id, status=status))
         else:
-            self._write_resultset(io, res, status)
+            self._write_resultset(io, res, status, binary=True)
 
 
 def _param_ftype():

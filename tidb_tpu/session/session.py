@@ -68,6 +68,11 @@ class Domain:
             txn.rollback()
 
 
+def _schema_names(plan):
+    """Output column names for a plan's schema (anonymous → col_i)."""
+    return [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
+
+
 class Result:
     """Query result: column names + the result chunk."""
 
@@ -347,6 +352,23 @@ class Session:
             raise TiDBError("prepared statement must be a single statement")
         return stmts[0], self.parser.param_count
 
+    def prepared_schema(self, stmt_ast, n_params: int = 0):
+        """Best-effort output schema (names, ftypes) for a prepared
+        statement, derived by planning with NULL-bound parameters — the
+        COM_STMT_PREPARE response must advertise the real column count
+        (reference: server/conn_stmt.go writePrepare). Returns ([], [])
+        for non-resultset statements or when planning needs real values."""
+        if not isinstance(stmt_ast, (ast.SelectStmt, ast.SetOprStmt)):
+            return [], []
+        self._expr_ctx.params = [None] * n_params
+        try:
+            plan = self.plan_query(stmt_ast)
+            return _schema_names(plan), [r.ftype for r in plan.schema.refs]
+        except Exception:
+            return [], []
+        finally:
+            self._expr_ctx.params = None
+
     def execute_prepared(self, stmt_ast, params: list) -> Result:
         """Binary-protocol EXECUTE over a pre-parsed statement with bound
         parameters (reference: server/conn_stmt.go handleStmtExecute)."""
@@ -471,7 +493,7 @@ class Session:
         plan = optimize(logical_plan, self._expr_ctx)
         exe = build_executor(plan, self._exec_ctx())
         chunk = exe.execute()
-        names = [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
+        names = _schema_names(plan)
         return Result(names=names, chunk=chunk)
 
     def run_query(self, stmt, outer=None) -> Result:
@@ -479,7 +501,7 @@ class Session:
         plan = self.plan_query(stmt, outer=outer)
         exe = build_executor(plan, self._exec_ctx())
         chunk = exe.execute()
-        names = [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
+        names = _schema_names(plan)
         return Result(names=names, chunk=chunk)
 
     def _exec_ctx(self):
