@@ -18,12 +18,13 @@ def _d(days):
     return str(base + np.timedelta64(int(days), "D"))
 
 
-@pytest.fixture(scope="module")
-def tk():
+def make_tpch_tk(db="tpch_t"):
+    """Build a TestKit with the full small TPC-H dataset loaded (shared
+    with the MPP-engine parity tests in test_mpp_sql.py)."""
     rng = np.random.default_rng(7)
     tk = TestKit()
-    tk.must_exec("create database tpch_t")
-    tk.must_exec("use tpch_t")
+    tk.must_exec(f"create database {db}")
+    tk.must_exec(f"use {db}")
     tk.must_exec("""create table region (
         r_regionkey bigint primary key, r_name varchar(25),
         r_comment varchar(152))""")
@@ -143,6 +144,11 @@ def tk():
                 f"'{_d(cdate)}', '{_d(rdate)}', '{instr[lineno % 4]}', "
                 f"'{modes[lineno % 7]}', 'l{lineno}')")
     return tk
+
+
+@pytest.fixture(scope="module")
+def tk():
+    return make_tpch_tk()
 
 
 def both(tk, sql):

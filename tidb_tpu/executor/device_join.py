@@ -47,11 +47,12 @@ class _Leaf:
 
 
 class _JoinNode:
-    def __init__(self, left, right, left_key, right_key, other_conds, offset):
+    def __init__(self, left, right, left_keys, right_keys, other_conds,
+                 offset):
         self.left = left
         self.right = right
-        self.left_key = left_key      # expr over left subtree schema
-        self.right_key = right_key    # expr over right subtree schema
+        self.left_keys = left_keys    # exprs over left subtree schema
+        self.right_keys = right_keys  # exprs over right subtree schema
         self.other_conds = other_conds
         self.offset = offset
         self.ncols = left.ncols + right.ncols
@@ -83,17 +84,19 @@ def collect_tree(node):
             p = n.plan
             if p.kind != "inner":
                 raise DeviceUnsupported("only inner joins in device fragment")
-            if len(p.left_keys) != 1:
-                raise DeviceUnsupported("single-key joins only")
+            if not p.left_keys:
+                raise DeviceUnsupported(
+                    "cartesian join (no equi keys) in device fragment")
             left = walk(n.children[0], offset)
             right = walk(n.children[1], offset + left.ncols)
-            lk, rk = p.left_keys[0], p.right_keys[0]
-            kl, kr = phys_kind(lk.ftype), phys_kind(rk.ftype)
-            if K_STR in (kl, kr) or K_FLOAT in (kl, kr):
-                raise DeviceUnsupported("string/float join keys")
-            if (lk.ftype.scale or 0) != (rk.ftype.scale or 0):
-                raise DeviceUnsupported("mismatched decimal key scales")
-            jn = _JoinNode(left, right, lk, rk, list(p.other_conds), offset)
+            for lk, rk in zip(p.left_keys, p.right_keys):
+                kl, kr = phys_kind(lk.ftype), phys_kind(rk.ftype)
+                if K_STR in (kl, kr) or K_FLOAT in (kl, kr):
+                    raise DeviceUnsupported("string/float join keys")
+                if (lk.ftype.scale or 0) != (rk.ftype.scale or 0):
+                    raise DeviceUnsupported("mismatched decimal key scales")
+            jn = _JoinNode(left, right, list(p.left_keys),
+                           list(p.right_keys), list(p.other_conds), offset)
             joins.append(jn)
             return jn
         raise DeviceUnsupported(
@@ -152,6 +155,51 @@ def _join_expand(bk, bvalid, pk, pvalid, cap):
     return pi, bi, valid, total > cap
 
 
+def _combined_join_keys(lkds, lknulls, lvalid, rkds, rknulls, rvalid):
+    """Fold multi-column equi-join keys into ONE int64 key per side using
+    DATA-DEPENDENT range packing: per key column, [min, max] over both
+    sides' valid rows gives a span; combined = Σ (kᵢ - mnᵢ)·Π spanⱼ.
+    Dynamic VALUES are free under jit (only shapes must be static), so no
+    host round trip and no host-side factorization (reference: hash join
+    builds a multi-column hash key, executor/join.go:192).
+
+    Returns (pk, pvalid, bk, bvalid, span_ovf) — span_ovf is a traced
+    flag set when Π span exceeds int64 (caller must fall back, not
+    retry)."""
+    pvalid, bvalid = lvalid, rvalid
+    for nl in lknulls:
+        pvalid = pvalid & ~nl
+    for nl in rknulls:
+        bvalid = bvalid & ~nl
+    if len(lkds) == 1:
+        return (lkds[0].astype(jnp.int64), pvalid,
+                rkds[0].astype(jnp.int64), bvalid,
+                jnp.zeros((), dtype=bool))
+    big = jnp.iinfo(jnp.int64).max
+    small = jnp.iinfo(jnp.int64).min
+    pk = jnp.zeros(lvalid.shape[0], dtype=jnp.int64)
+    bk = jnp.zeros(rvalid.shape[0], dtype=jnp.int64)
+    total = jnp.ones((), dtype=jnp.float64)
+    for lk, rk in zip(lkds, rkds):
+        lk = lk.astype(jnp.int64)
+        rk = rk.astype(jnp.int64)
+        mn = jnp.minimum(jnp.min(jnp.where(pvalid, lk, big)),
+                         jnp.min(jnp.where(bvalid, rk, big)))
+        mx = jnp.maximum(jnp.max(jnp.where(pvalid, lk, small)),
+                         jnp.max(jnp.where(bvalid, rk, small)))
+        mn = jnp.minimum(mn, mx)  # both-empty guard
+        # guard span in float64 FIRST: `mx - mn + 1` wraps in int64 when a
+        # key column spans more than half the int64 range, which would
+        # collapse the span to 1 and silently defeat the overflow flag
+        span_f = jnp.maximum(
+            mx.astype(jnp.float64) - mn.astype(jnp.float64) + 1.0, 1.0)
+        total = total * span_f
+        span = jnp.maximum(mx - mn + 1, 1)
+        pk = pk * span + jnp.where(pvalid, lk - mn, 0)
+        bk = bk * span + jnp.where(bvalid, rk - mn, 0)
+    return pk, pvalid, bk, bvalid, total > jnp.asarray(2.0**62)
+
+
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                      capacity, key_pack, agg_meta):
     """Build the jitted end-to-end program. caps: per-join static
@@ -170,10 +218,10 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
          for c in leaf.conds] for leaf in leaves]
     # key/other-cond/agg expressions are compiled against global offsets
     for jn in joins:
-        jn._lk_fn = dev.compile_expr(_shift_expr(jn.left_key, jn.left.offset),
-                                     dcols)
-        jn._rk_fn = dev.compile_expr(
-            _shift_expr(jn.right_key, jn.right.offset), dcols)
+        jn._lk_fns = [dev.compile_expr(_shift_expr(k, jn.left.offset), dcols)
+                      for k in jn.left_keys]
+        jn._rk_fns = [dev.compile_expr(_shift_expr(k, jn.right.offset), dcols)
+                      for k in jn.right_keys]
         jn._oc_fns = [dev.compile_expr(_shift_expr(c, jn.offset), dcols)
                       for c in jn.other_conds]
     cond_fns = [dev.compile_expr(c, dcols) for c in agg_conds]
@@ -195,6 +243,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
             return {leaf.leaf_id: jnp.arange(n)}, mask
 
         overflows = []
+        span_ovfs = []
 
         def gather_env(idxmap, valid, node):
             """env of gathered (relation-space) columns for `node`'s
@@ -218,13 +267,17 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
             ridx, rvalid = eval_node(node.right)
             lenv = gather_env(lidx, lvalid, node.left)
             renv = gather_env(ridx, rvalid, node.right)
-            pk_d, pk_n = dev.broadcast_1d(*node._lk_fn(lenv),
-                                          lvalid.shape[0])
-            bk_d, bk_n = dev.broadcast_1d(*node._rk_fn(renv),
-                                          rvalid.shape[0])
+            lkds, lknulls = zip(*[
+                dev.broadcast_1d(*f(lenv), lvalid.shape[0])
+                for f in node._lk_fns])
+            rkds, rknulls = zip(*[
+                dev.broadcast_1d(*f(renv), rvalid.shape[0])
+                for f in node._rk_fns])
+            pk_d, pvalid, bk_d, bvalid, sovf = _combined_join_keys(
+                lkds, lknulls, lvalid, rkds, rknulls, rvalid)
+            span_ovfs.append(sovf)
             pi, bi, valid, ovf = _join_expand(
-                bk_d.astype(jnp.int64), rvalid & ~bk_n,
-                pk_d.astype(jnp.int64), lvalid & ~pk_n, node.cap)
+                bk_d, bvalid, pk_d, pvalid, node.cap)
             overflows.append(ovf)
             idxmap = {k: v[pi] for k, v in lidx.items()}
             idxmap.update({k: v[bi] for k, v in ridx.items()})
@@ -262,7 +315,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                                 n_keys=len(key_cols),
                                 agg_ops=tuple(agg_ops),
                                 capacity=capacity, pack=key_pack)
-        return agg_out, tuple(overflows)
+        return agg_out, tuple(overflows), tuple(span_ovfs)
 
     return jax.jit(run)
 
@@ -320,7 +373,10 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
             fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
                                   caps, capacity, key_pack, agg_meta)
             _pipe_cache_put(key, fn, dict_refs)
-        out, overflows = jax.device_get(fn(env))
+        out, overflows, span_ovfs = jax.device_get(fn(env))
+        if any(bool(s) for s in span_ovfs):
+            raise DeviceUnsupported(
+                "multi-key join value ranges exceed int64 packing")
         key_out, key_null_out, results, result_nulls, n_groups, _valid = out
         ng = int(n_groups)
         retry = False
@@ -350,8 +406,9 @@ def fragment_sig(leaves, joins, agg_conds, agg_plan):
             if c.data.dtype == object:
                 parts.append(str(id(c.dict_encode()[1])))
     for jn in joins:
-        parts.append(f"J{jn.offset}:{_expr_sig(jn.left_key)}="
-                     f"{_expr_sig(jn.right_key)}|"
+        keys = ",".join(f"{_expr_sig(lk)}={_expr_sig(rk)}"
+                        for lk, rk in zip(jn.left_keys, jn.right_keys))
+        parts.append(f"J{jn.offset}:{keys}|"
                      + ";".join(_expr_sig(c) for c in jn.other_conds))
     parts.append("|c|" + ";".join(_expr_sig(c) for c in agg_conds))
     parts.append("|g|" + ";".join(_expr_sig(e) for e in agg_plan.group_exprs))

@@ -131,6 +131,37 @@ class ProjectionExec(QueryExecutor):
         return Chunk(cols)
 
 
+def _inline_agg_projection(p, proj_exec):
+    """HashAgg over a pure Projection: substitute the projection's
+    expressions into the agg's group keys and aggregate arguments so the
+    fused device/MPP fragment detectors see the scan/join underneath (the
+    reference pushes such projections into the cop/MPP DAG —
+    planner/core/plan_to_pb.go; here the fragment compiler fuses them).
+    Returns (rewritten_agg_plan, projection_child) or None."""
+    import copy
+    exprs = proj_exec.plan.exprs
+
+    def sub(c):
+        return exprs[c.idx]
+
+    try:
+        new_groups = [e.transform_columns(sub) for e in p.group_exprs]
+        new_aggs = []
+        for d in p.aggs:
+            nd = object.__new__(type(d))
+            nd.name = d.name
+            nd.args = [a.transform_columns(sub) for a in d.args]
+            nd.distinct = d.distinct
+            nd.ftype = d.ftype
+            new_aggs.append(nd)
+    except Exception:
+        return None
+    p2 = copy.copy(p)
+    p2.group_exprs = new_groups
+    p2.aggs = new_aggs
+    return p2, proj_exec.children[0]
+
+
 class HashAggExec(QueryExecutor):
     """Group-by aggregation (reference: executor/aggregate.go parallel hash
     agg; here single kernel call — parallelism comes from the device)."""
@@ -141,6 +172,13 @@ class HashAggExec(QueryExecutor):
         # scan-filter + grouping + aggregation into one XLA program
         from .device_exec import want_device, device_agg, DeviceUnsupported
         child = self.children[0]
+        # look through pure projections (they fuse into the fragment)
+        eff_p = p
+        while isinstance(child, ProjectionExec):
+            r = _inline_agg_projection(eff_p, child)
+            if r is None:
+                break
+            eff_p, child = r
         conds = []
         raw = None
         if isinstance(child, TableScanExec):
@@ -149,33 +187,48 @@ class HashAggExec(QueryExecutor):
                 child.children[0], TableScanExec):
             raw, inner_conds = child.children[0].execute_raw()
             conds = list(inner_conds) + list(child.plan.conds)
-        if raw is not None and want_device(self.ctx, raw.num_rows):
-            try:
-                return device_agg(p, raw, conds)
-            except DeviceUnsupported:
-                pass
-        # join fragment: HashAgg over an (inner equi-)join tree of scans
-        # fuses scans+filters+joins+aggregate into one device program
+        join_child, agg_conds = child, []
         if raw is None:
-            from .device_join import device_join_agg
-            join_child, agg_conds = child, []
             if isinstance(child, SelectionExec) and isinstance(
                     child.children[0], HashJoinExec):
                 join_child = child.children[0]
                 agg_conds = list(child.plan.conds)
-            if isinstance(join_child, HashJoinExec):
-                try:
-                    return device_join_agg(p, agg_conds, join_child,
-                                           self.ctx)
-                except DeviceUnsupported:
-                    pass
-        if raw is not None:
-            # reuse the materialized chunk on the host path
+        # MPP: the same fused fragment, SPMD over the session's device mesh
+        # (partition-parallel partial agg / broadcast join + collectives)
+        from .mpp_exec import mpp_mesh, mpp_agg, mpp_join_agg
+        mesh = mpp_mesh(self.ctx)
+        if mesh is not None:
+            try:
+                if raw is not None:
+                    return mpp_agg(eff_p, raw, conds, self.ctx, mesh)
+                if isinstance(join_child, HashJoinExec):
+                    return mpp_join_agg(eff_p, agg_conds, join_child,
+                                        self.ctx, mesh)
+            except DeviceUnsupported:
+                pass
+        if raw is not None and want_device(self.ctx, raw.num_rows):
+            try:
+                return device_agg(eff_p, raw, conds)
+            except DeviceUnsupported:
+                pass
+        # join fragment: HashAgg over an (inner equi-)join tree of scans
+        # fuses scans+filters+joins+aggregate into one device program
+        if raw is None and isinstance(join_child, HashJoinExec):
+            from .device_join import device_join_agg
+            try:
+                return device_join_agg(eff_p, agg_conds, join_child,
+                                       self.ctx)
+            except DeviceUnsupported:
+                pass
+        if raw is not None and eff_p is p:
+            # reuse the materialized chunk on the host path (only valid
+            # when no projection was inlined: self.plan's expressions are
+            # written against the ORIGINAL child schema)
             chunk = raw
             if conds:
                 chunk = chunk.filter(eval_conds_mask(conds, chunk))
         else:
-            chunk = child.execute()
+            chunk = self.children[0].execute()
         return self._execute_host(chunk)
 
     def _execute_host(self, chunk):

@@ -386,7 +386,54 @@ def _compile_func(sf: ScalarFunc, cols):
             d, n = fa(env)
             return jnp.abs(d), n
         return f
+    if op in ("like", "regexp"):
+        return _compile_str_pattern(sf, cols)
     raise DeviceUnsupported(f"scalar op {op} not available on device")
+
+
+def _compile_str_pattern(sf, cols):
+    """LIKE / REGEXP on a dict-encoded string column against a constant
+    pattern: evaluate the predicate HOST-SIDE over the (small, distinct)
+    dictionary once, then the device op is a boolean table lookup by code
+    — dictionary pushdown (the reference evaluates LIKE per row over raw
+    bytes, expression/builtin_like.go; per distinct value beats per row)."""
+    from ..expression.core import like_to_regex
+    import re as _re
+    target, pat = sf.args[0], sf.args[1]
+    if not isinstance(target, ExprColumn) or phys_kind(target.ftype) != K_STR:
+        raise DeviceUnsupported(f"{sf.op} target must be a string column")
+    if not isinstance(pat, Constant):
+        raise DeviceUnsupported(f"{sf.op} pattern must be a constant")
+    dc = cols.get(target.idx)
+    if dc is None or dc.dictionary is None:
+        raise DeviceUnsupported("no dictionary for string column")
+    if pat.value is None:
+        def f(env):
+            return jnp.zeros((), dtype=jnp.int64), jnp.ones((), dtype=bool)
+        return f
+    pv = (pat.value if isinstance(pat.value, bytes)
+          else str(pat.value).encode())
+    if sf.op == "like":
+        # sf.extra carries the escape-aware regex the builder compiled
+        # (LIKE ... ESCAPE '!'); rebuilding here would drop the escape
+        rx = sf.extra if sf.extra is not None else like_to_regex(pv)
+        match = rx.match
+    else:
+        rx = _re.compile(pv)
+        match = rx.search
+    nd = len(dc.dictionary)
+    bits = np.zeros(nd, dtype=bool)
+    for i, v in enumerate(dc.dictionary):
+        b = v if isinstance(v, bytes) else str(v).encode()
+        bits[i] = match(b) is not None
+    lut = jnp.asarray(bits)
+    idx = target.idx
+
+    def f(env):
+        d, n = env[idx]
+        hit = lut[jnp.clip(d, 0, nd - 1)]
+        return hit.astype(jnp.int64), n
+    return f
 
 
 def _civil_from_days(z):

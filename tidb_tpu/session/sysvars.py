@@ -89,7 +89,8 @@ for _v in [
     SysVar("hostname", SCOPE_NONE, "localhost"),
     # engine knobs (the tidb_* namespace of the reference)
     SysVar("tidb_executor_engine", SCOPE_BOTH, "auto", "enum",
-           choices=("auto", "host", "tpu")),
+           choices=("auto", "host", "tpu", "tpu-mpp")),
+    SysVar("tidb_mpp_devices", SCOPE_BOTH, "0", "int", 0),
     SysVar("tidb_mem_quota_query", SCOPE_BOTH, str(1 << 30), "int", 0),
     SysVar("tidb_max_chunk_size", SCOPE_BOTH, "65536", "int", 32),
     SysVar("tidb_snapshot_isolation", SCOPE_BOTH, "ON", "bool"),
