@@ -64,10 +64,40 @@ def eval_conds_mask(conds, chunk: Chunk) -> np.ndarray:
 
 
 class TableScanExec(QueryExecutor):
+    def _access_chunk(self, txn):
+        """Row fetch via the planner-chosen access path (PointGet /
+        IndexLookUp): KV seeks through the txn (membuffer-aware, so
+        uncommitted writes are visible — reference executor/point_get.go
+        + union_scan.go), assembled into a Chunk. The pushed conds stay
+        as post-filters, so path choice never changes semantics."""
+        from ..table import Table, rows_to_chunk
+        p = self.plan
+        tbl = Table(p.table_info, txn)
+        kind = p.access[0]
+        if kind == "point_pk":
+            handles = [p.access[1]]
+        elif kind == "point_index":
+            _k, idx, vals = p.access
+            h = tbl.index_lookup(idx, vals)
+            handles = [] if h is None else [h]
+        else:
+            _k, idx, lo, hi = p.access
+            handles = tbl.index_scan_handles(idx, lo_vals=lo, hi_vals=hi)
+        rowdicts = []
+        kept = []
+        for h in handles:
+            row = tbl.get_row(h)
+            if row is not None:
+                kept.append(h)
+                rowdicts.append(row)
+        return rows_to_chunk(p.table_info, p.col_infos, kept, rowdicts)
+
     def execute_raw(self):
         """-> (unfiltered chunk, pushed conds) for fused device pipelines."""
         p = self.plan
         txn = self.ctx.txn_for_read()
+        if p.access is not None:
+            return self._access_chunk(txn), p.pushed_conds
         if self.ctx.txn_dirty(p.table_info.id):
             from ..table import Table
             tbl = Table(p.table_info, txn)
@@ -80,7 +110,9 @@ class TableScanExec(QueryExecutor):
     def execute(self):
         p = self.plan
         txn = self.ctx.txn_for_read()
-        if self.ctx.txn_dirty(p.table_info.id):
+        if p.access is not None:
+            chunk = self._access_chunk(txn)
+        elif self.ctx.txn_dirty(p.table_info.id):
             # union-scan path (reference: executor/union_scan.go): txn has
             # uncommitted writes on this table — materialize through the txn
             # (and never let dirty data into the shared columnar cache)

@@ -1,0 +1,159 @@
+"""Physical access-path selection: PointGet / IndexLookUp / full columnar
+scan, chosen by cost (reference: planner/core/find_best_task.go:359
+physical search over access paths, point_get_plan.go:467 TryFastPlan,
+executor/point_get.go, executor/distsql.go IndexLookUp).
+
+The task model is {host-seek, tpu-scan}: index paths materialize a small
+row set via row-at-a-time KV seeks (host), the full scan feeds the fused
+vectorized device pipeline. Costing: seeks pay a per-row decode constant,
+the scan pays a per-row vectorized constant — index wins only when the
+consumed predicates are selective enough (estimated from ANALYZE
+histograms/TopN, statistics/selectivity.py).
+
+Access descriptors stored on DataSource.access:
+    ("point_pk", handle)               pk_is_handle eq const
+    ("point_index", idx, vals)         unique index, all columns eq-bound
+    ("index_range", idx, lo, hi, nc)   eq-prefix (+ one range col); lo/hi
+                                       are index value tuples or None
+All pushed conds stay as post-filters — the index only pre-selects
+candidate handles, so boundary/visibility semantics never depend on the
+path taken.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..model import SchemaState
+from ..statistics.selectivity import _col_const, estimate_selectivity
+from .logical import DataSource
+
+#: cost constants: per-row KV seek+decode vs per-row vectorized scan
+SEEK_COST = 8.0
+SEEK_BASE = 30.0
+SCAN_ROW_COST = 1.0
+
+
+def choose_access_paths(plan, ctx):
+    if isinstance(plan, DataSource):
+        _choose(plan, ctx)
+    for c in plan.children:
+        choose_access_paths(c, ctx)
+    return plan
+
+
+def _int_like(v):
+    return isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+
+
+def _choose(ds: DataSource, ctx):
+    ds.access = None
+    ds.access_est = None
+    info = ds.table_info
+    if not ds.pushed_conds:
+        return
+    # classify pushed conds: eq consts and range bounds per schema idx
+    eq, rngs, by_idx = {}, {}, {}
+    for c in ds.pushed_conds:
+        cc = _col_const(c)
+        if cc is None:
+            continue
+        col, v, op = cc
+        if v is None:
+            continue
+        if op == "eq":
+            eq.setdefault(col.idx, v)
+            by_idx.setdefault(col.idx, []).append(c)
+        elif op in ("lt", "le", "gt", "ge") and isinstance(v, (int, float)):
+            rngs.setdefault(col.idx, []).append((op, v))
+            by_idx.setdefault(col.idx, []).append(c)
+    if not eq and not rngs:
+        return
+    name2idx = {ci.name: i for i, ci in enumerate(ds.col_infos)}
+
+    # 1. PointGet on the integer primary key stored as the row handle
+    if info.pk_is_handle:
+        pk_idx = next((i for i, ci in enumerate(ds.col_infos)
+                       if ci.id == info.pk_col_id), None)
+        if pk_idx is not None and pk_idx in eq and _int_like(eq[pk_idx]):
+            ds.access = ("point_pk", int(eq[pk_idx]))
+            ds.access_est = 1
+            return
+
+    # 2. PointGet via a unique index with every column eq-bound
+    for idx in info.indexes:
+        if idx.state != SchemaState.PUBLIC or not idx.unique:
+            continue
+        vals = []
+        for icol in idx.columns:
+            i = name2idx.get(icol.name)
+            if i is None or i not in eq:
+                break
+            vals.append(eq[i])
+        else:
+            if vals:
+                ds.access = ("point_index", idx, vals)
+                ds.access_est = 1
+                return
+
+    # 3. cost-based index range scan vs full columnar scan
+    stats = (ctx.table_stats(info.id)
+             if ctx is not None and hasattr(ctx, "table_stats") else None)
+    n = max((stats or {}).get("row_count", 0), 1)
+    if stats is None or n < 2:
+        return  # no stats → pseudo costing favors the vectorized scan
+    best = None
+    for idx in info.indexes:
+        if idx.state != SchemaState.PUBLIC:
+            continue
+        prefix, consumed = [], []
+        for icol in idx.columns:
+            i = name2idx.get(icol.name)
+            if i is not None and i in eq:
+                prefix.append(eq[i])
+                consumed.extend(by_idx[i])
+            else:
+                break
+        lo_b = hi_b = None
+        npos = len(prefix)
+        if npos < len(idx.columns):
+            i = name2idx.get(idx.columns[npos].name)
+            if i is not None and i in rngs:
+                for op, v in rngs[i]:
+                    if op in ("gt", "ge"):
+                        lo_b = v if lo_b is None else max(lo_b, v)
+                    else:
+                        hi_b = v if hi_b is None else min(hi_b, v)
+                consumed.extend(by_idx[i])
+        if not prefix and lo_b is None and hi_b is None:
+            continue
+        sel = estimate_selectivity(stats, ds.col_infos, consumed)
+        est_rows = max(n * sel, 1.0)
+        cost = SEEK_BASE + est_rows * SEEK_COST
+        if best is None or cost < best[0]:
+            lo = (prefix + ([_idx_bound(lo_b)] if lo_b is not None else [])
+                  ) or None
+            hi = (prefix + ([_idx_bound(hi_b)] if hi_b is not None else [])
+                  ) or None
+            if lo_b is None and prefix:
+                lo = list(prefix)
+            if hi_b is None and prefix:
+                hi = list(prefix)
+            best = (cost, ("index_range", idx, lo, hi), est_rows)
+    if best is None:
+        return
+    cost_full = n * SCAN_ROW_COST
+    if best[0] < cost_full:
+        ds.access = best[1]
+        ds.access_est = int(best[2])
+
+
+def _idx_bound(v):
+    """Range bound → index-codec value (floats from histograms/consts may
+    bound an int column; truncate toward -inf so the inclusive scan keeps
+    every candidate — post-filters trim exactly)."""
+    if isinstance(v, float) and float(v).is_integer():
+        return int(v)
+    if isinstance(v, float):
+        return int(np.floor(v))
+    return v

@@ -32,12 +32,29 @@ class DataSource(LogicalPlan):
         self.col_infos = col_infos      # ColumnInfo list parallel to schema
         self.alias = alias
         self.pushed_conds = []          # filters evaluated at scan
+        self.access = None              # planner/access.py descriptor
+        self.access_est = None          # estimated rows via the access path
 
     def explain_name(self):
+        if self.access is not None:
+            kind = self.access[0]
+            if kind in ("point_pk", "point_index"):
+                return "PointGet"
+            return "IndexLookUp"
         return "TableScan"
 
     def explain_info(self):
         s = f"table:{self.alias or self.table_info.name}"
+        if self.access is not None:
+            kind = self.access[0]
+            if kind == "point_pk":
+                s += f", handle:{self.access[1]}"
+            elif kind == "point_index":
+                s += f", index:{self.access[1].name}"
+            else:
+                _k, idx, lo, hi = self.access
+                s += (f", index:{idx.name}, range:[{lo},{hi}]"
+                      f", est_rows:{self.access_est}")
         if self.pushed_conds:
             s += ", filter:" + " AND ".join(repr(c) for c in self.pushed_conds)
         return s

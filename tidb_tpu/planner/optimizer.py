@@ -13,9 +13,11 @@ from .logical import (
 
 
 def optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
+    from .access import choose_access_paths
     plan = push_down_predicates(plan, [])
     plan = reorder_joins(plan, ctx)
     plan = prune_columns(plan)
+    plan = choose_access_paths(plan, ctx)
     return plan
 
 
@@ -177,6 +179,12 @@ def _est_rows(plan, ctx):
         n = 1000
         if ctx is not None and hasattr(ctx, "table_rows"):
             n = max(ctx.table_rows(plan.table_info.id), 1)
+        stats = (ctx.table_stats(plan.table_info.id)
+                 if ctx is not None and hasattr(ctx, "table_stats") else None)
+        if stats is not None and plan.pushed_conds:
+            from ..statistics.selectivity import estimate_selectivity
+            return max(int(n * estimate_selectivity(
+                stats, plan.col_infos, plan.pushed_conds)), 1)
         for _ in plan.pushed_conds:
             n = max(n // 4, 1)
         return n

@@ -164,6 +164,11 @@ class _ExprCtx:
             return max(entry.nrows, 1)
         return 1000
 
+    def table_stats(self, table_id):
+        """ANALYZE statistics blob for CBO (planner/access.py,
+        join-reorder cardinality), or None before ANALYZE."""
+        return self.session.domain.stats.get(table_id)
+
 
 class Session:
     """reference: session.session — one connection's state."""

@@ -1,4 +1,11 @@
-"""ANALYZE TABLE (reference: executor/analyze.go + statistics/builder.go)."""
+"""ANALYZE TABLE (reference: executor/analyze.go + statistics/builder.go).
+
+Builds, per column: null count, NDV, min/max, TopN (most frequent values
+with exact counts — reference statistics/cmsketch.go:503 TopN), and an
+equal-depth histogram (bucket upper bounds + cumulative counts —
+reference statistics/histogram.go:50). The whole pass is vectorized
+numpy over the columnar cache (the reference samples per region; here
+the column is already materialized host-side)."""
 
 from __future__ import annotations
 
@@ -6,23 +13,55 @@ import numpy as np
 
 from ..meta import Meta
 
+HIST_BUCKETS = 64
+TOPN_SIZE = 8
+
+
+def _val_key(v):
+    """JSON-able representation of an internal value for TopN matching."""
+    if isinstance(v, (bytes, bytearray)):
+        return v.decode("utf-8", "surrogateescape")
+    if isinstance(v, (np.integer, int)):
+        return int(v)
+    return float(v)
+
+
+def _column_stats(col):
+    nn = ~col.nulls
+    data = col.data[nn]
+    cs = {"null_count": int(col.nulls.sum())}
+    if not len(data):
+        cs["ndv"] = 0
+        return cs
+    uniques, counts = np.unique(data, return_counts=True)
+    cs["ndv"] = int(len(uniques))
+    # TopN: exact counts for the most frequent values
+    k = min(TOPN_SIZE, len(uniques))
+    top = np.argpartition(counts, -k)[-k:]
+    top = top[np.argsort(counts[top])[::-1]]
+    cs["topn"] = [[_val_key(uniques[i]), int(counts[i])] for i in top]
+    if data.dtype != object:
+        vals = data.astype(np.float64)
+        cs["min"] = float(vals.min())
+        cs["max"] = float(vals.max())
+        # equal-depth histogram over the sorted column: bucket upper
+        # bounds at quantile positions + cumulative counts
+        nb = min(HIST_BUCKETS, len(uniques))
+        if nb >= 2:
+            sv = np.sort(vals)
+            pos = ((np.arange(1, nb + 1) * len(sv)) // nb) - 1
+            bounds = sv[pos]
+            cum = np.searchsorted(sv, bounds, side="right")
+            cs["hist"] = {"bounds": [float(b) for b in bounds],
+                          "cum": [int(c) for c in cum]}
+    return cs
+
 
 def analyze_table(session, info):
     entry = session.columnar_cache().get(info, session.store.begin())
     stats = {"row_count": int(entry.nrows), "columns": {}}
     for col_id, col in entry.columns.items():
-        nn = ~col.nulls
-        data = col.data[nn]
-        cs = {"null_count": int(col.nulls.sum())}
-        if len(data):
-            uniques = np.unique(data)
-            cs["ndv"] = int(len(uniques))
-            if data.dtype != object:
-                cs["min"] = float(data.min())
-                cs["max"] = float(data.max())
-        else:
-            cs["ndv"] = 0
-        stats["columns"][str(col_id)] = cs
+        stats["columns"][str(col_id)] = _column_stats(col)
     txn = session.store.begin()
     try:
         m = Meta(txn)

@@ -1,0 +1,153 @@
+"""Predicate selectivity estimation over ANALYZE statistics
+(reference: statistics/selectivity.go Selectivity + histogram.go
+BetweenRowCount/EqualRowCount).
+
+Estimates use, in order of preference: exact TopN counts for equality,
+equal-depth histogram mass for ranges (linear interpolation inside a
+bucket), and NDV/default fallbacks. Conjuncts multiply with a floor —
+the reference's independence assumption."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..expression.core import Column as ExprColumn, Constant, ScalarFunc
+
+#: fallback selectivity for predicates we cannot decompose
+#: (reference: planner/core/stats.go selectionFactor = 0.8)
+DEFAULT_SEL = 0.8
+EQ_DEFAULT_SEL = 0.01
+RANGE_DEFAULT_SEL = 0.33
+FLOOR = 1e-7
+
+
+def _cs(stats, col_id):
+    return (stats or {}).get("columns", {}).get(str(col_id))
+
+
+def _const_key(v):
+    if isinstance(v, (bytes, bytearray)):
+        return v.decode("utf-8", "surrogateescape")
+    if isinstance(v, bool):
+        return int(v)
+    return v
+
+
+def _col_const(cond):
+    """cmp(col, const) / cmp(const, col) → (col, const_value, op) with the
+    comparison normalized to column-on-the-left; None when not that shape."""
+    if not isinstance(cond, ScalarFunc) or len(cond.args) != 2:
+        return None
+    a, b = cond.args
+    flip = {"lt": "gt", "gt": "lt", "le": "ge", "ge": "le"}
+    if isinstance(a, ExprColumn) and isinstance(b, Constant):
+        return a, b.value, cond.op
+    if isinstance(b, ExprColumn) and isinstance(a, Constant):
+        return b, a.value, flip.get(cond.op, cond.op)
+    return None
+
+
+def _eq_sel(cs, n, v):
+    """Selectivity of col = v given column stats."""
+    key = _const_key(v)
+    topn = cs.get("topn") or []
+    topn_cnt = 0
+    for tv, tc in topn:
+        topn_cnt += tc
+        if tv == key:
+            return tc / n
+    ndv = max(cs.get("ndv", 0), 1)
+    rest = max(n - topn_cnt - cs.get("null_count", 0), 0)
+    rest_ndv = max(ndv - len(topn), 1)
+    return max(rest / rest_ndv, 0.0) / n
+
+
+def _range_mass(cs, n, v, op):
+    """Fraction of rows with col OP v from the histogram (cum counts with
+    linear interpolation inside the containing bucket)."""
+    hist = cs.get("hist")
+    if hist is None:
+        lo, hi = cs.get("min"), cs.get("max")
+        if lo is None or hi is None or not isinstance(v, (int, float)):
+            return RANGE_DEFAULT_SEL
+        if hi <= lo:
+            span = 1.0
+        else:
+            span = (float(v) - lo) / (hi - lo)
+        frac_lt = min(max(span, 0.0), 1.0)
+        return frac_lt if op in ("lt", "le") else 1.0 - frac_lt
+    bounds = np.asarray(hist["bounds"], dtype=np.float64)
+    cum = np.asarray(hist["cum"], dtype=np.float64)
+    total = cum[-1] if len(cum) else 1.0
+    if total <= 0:
+        return 0.0
+    x = float(v)
+    i = int(np.searchsorted(bounds, x, side="left"))
+    if i >= len(bounds):
+        frac_le = 1.0
+    else:
+        hi_cum = cum[i]
+        lo_cum = cum[i - 1] if i > 0 else 0.0
+        lo_b = bounds[i - 1] if i > 0 else cs.get("min", bounds[0])
+        hi_b = bounds[i]
+        if hi_b <= lo_b:
+            within = 1.0
+        else:
+            within = min(max((x - lo_b) / (hi_b - lo_b), 0.0), 1.0)
+        frac_le = (lo_cum + within * (hi_cum - lo_cum)) / total
+    if op in ("lt", "le"):
+        return frac_le
+    return 1.0 - frac_le
+
+
+def cond_selectivity(stats, col_infos, cond):
+    """Selectivity of one predicate over a DataSource's schema."""
+    n = max((stats or {}).get("row_count", 0), 1)
+    if isinstance(cond, ScalarFunc) and cond.op == "and":
+        return (cond_selectivity(stats, col_infos, cond.args[0])
+                * cond_selectivity(stats, col_infos, cond.args[1]))
+    if isinstance(cond, ScalarFunc) and cond.op == "or":
+        s = (cond_selectivity(stats, col_infos, cond.args[0])
+             + cond_selectivity(stats, col_infos, cond.args[1]))
+        return min(s, 1.0)
+    if isinstance(cond, ScalarFunc) and cond.op == "in_set":
+        t = cond.args[0]
+        if isinstance(t, ExprColumn) and t.idx < len(col_infos):
+            cs = _cs(stats, col_infos[t.idx].id)
+            values = cond.extra[0] if cond.extra else []
+            if cs:
+                return min(sum(_eq_sel(cs, n, v) for v in values), 1.0)
+            return min(EQ_DEFAULT_SEL * max(len(values), 1), 1.0)
+        return DEFAULT_SEL
+    cc = _col_const(cond)
+    if cc is None:
+        return DEFAULT_SEL
+    col, v, op = cc
+    if v is None:
+        return 0.0 if op != "ne" else 1.0
+    if col.idx >= len(col_infos):
+        return DEFAULT_SEL
+    cs = _cs(stats, col_infos[col.idx].id)
+    if cs is None:
+        return (EQ_DEFAULT_SEL if op == "eq"
+                else RANGE_DEFAULT_SEL if op in ("lt", "le", "gt", "ge")
+                else DEFAULT_SEL)
+    null_frac = cs.get("null_count", 0) / n
+    if op == "eq":
+        return _eq_sel(cs, n, v)
+    if op == "ne":
+        return max(1.0 - _eq_sel(cs, n, v) - null_frac, 0.0)
+    if op in ("lt", "le", "gt", "ge"):
+        if not isinstance(v, (int, float)):
+            return RANGE_DEFAULT_SEL
+        return max(_range_mass(cs, n, v, op) - (
+            null_frac if op in ("gt", "ge") else 0.0), 0.0)
+    return DEFAULT_SEL
+
+
+def estimate_selectivity(stats, col_infos, conds) -> float:
+    """Combined selectivity of a conjunction of predicates."""
+    sel = 1.0
+    for c in conds:
+        sel *= cond_selectivity(stats, col_infos, c)
+    return max(min(sel, 1.0), FLOOR)
