@@ -27,6 +27,8 @@ from ..utils.chunk import Chunk, Column, concat_chunks, np_dtype_for
 class QueryExecutor:
     """Base: execute() -> Chunk whose columns parallel plan.schema."""
 
+    stats = None  # RuntimeStatsColl when EXPLAIN ANALYZE collects
+
     def __init__(self, plan, ctx, children):
         self.plan = plan
         self.ctx = ctx
@@ -35,13 +37,23 @@ class QueryExecutor:
     def execute(self) -> Chunk:
         raise NotImplementedError
 
+    def annotate(self, **kv):
+        """Record engine/extra info for EXPLAIN ANALYZE (no-op otherwise)."""
+        if self.stats is not None:
+            self.stats.annotate(self.plan, **kv)
 
-def build_executor(plan, ctx) -> QueryExecutor:
+
+def build_executor(plan, ctx, stats=None) -> QueryExecutor:
     cls = _MAP.get(type(plan))
     if cls is None:
         raise TiDBError(f"no executor for {type(plan).__name__}")
-    children = [build_executor(c, ctx) for c in plan.children]
-    return cls(plan, ctx, children)
+    children = [build_executor(c, ctx, stats) for c in plan.children]
+    exe = cls(plan, ctx, children)
+    if stats is not None:
+        from .execdetails import timed_execute
+        exe.stats = stats
+        exe.execute = timed_execute(exe, stats)
+    return exe
 
 
 def eval_expr_to_column(expr, chunk: Chunk) -> Column:
@@ -232,15 +244,21 @@ class HashAggExec(QueryExecutor):
         if mesh is not None:
             try:
                 if raw is not None:
-                    return mpp_agg(eff_p, raw, conds, self.ctx, mesh)
+                    out = mpp_agg(eff_p, raw, conds, self.ctx, mesh)
+                    self._mark_fragment("tpu-mpp", raw.num_rows)
+                    return out
                 if isinstance(join_child, HashJoinExec):
-                    return mpp_join_agg(eff_p, agg_conds, join_child,
-                                        self.ctx, mesh)
+                    out = mpp_join_agg(eff_p, agg_conds, join_child,
+                                       self.ctx, mesh)
+                    self._mark_fragment("tpu-mpp", None)
+                    return out
             except DeviceUnsupported:
                 pass
         if raw is not None and want_device(self.ctx, raw.num_rows):
             try:
-                return device_agg(eff_p, raw, conds)
+                out = device_agg(eff_p, raw, conds)
+                self._mark_fragment("tpu", raw.num_rows)
+                return out
             except DeviceUnsupported:
                 pass
         # join fragment: HashAgg over an (inner equi-)join tree of scans
@@ -248,20 +266,39 @@ class HashAggExec(QueryExecutor):
         if raw is None and isinstance(join_child, HashJoinExec):
             from .device_join import device_join_agg
             try:
-                return device_join_agg(eff_p, agg_conds, join_child,
-                                       self.ctx)
+                out = device_join_agg(eff_p, agg_conds, join_child,
+                                      self.ctx)
+                self._mark_fragment("tpu", None)
+                return out
             except DeviceUnsupported:
                 pass
         if raw is not None and eff_p is p:
             # reuse the materialized chunk on the host path (only valid
             # when no projection was inlined: self.plan's expressions are
             # written against the ORIGINAL child schema)
+            self._mark_fragment("host", raw.num_rows)
             chunk = raw
             if conds:
                 chunk = chunk.filter(eval_conds_mask(conds, chunk))
         else:
             chunk = self.children[0].execute()
         return self._execute_host(chunk)
+
+    def _mark_fragment(self, engine: str, scan_rows):
+        """EXPLAIN ANALYZE annotation for a fused device fragment: the whole
+        subtree below this HashAgg ran as ONE XLA program (the cop-task
+        execution info analog, reference util/execdetails CopRuntimeStats)."""
+        if self.stats is None:
+            return
+        self.annotate(engine=engine)
+
+        def walk(p):
+            for c in p.children:
+                self.stats.annotate(c, fused=f"into {engine} fragment")
+                if scan_rows is not None and isinstance(c, DataSource):
+                    self.stats.annotate(c, scan_rows=scan_rows)
+                walk(c)
+        walk(self.plan)
 
     def _execute_host(self, chunk):
         p = self.plan

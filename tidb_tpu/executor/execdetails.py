@@ -1,0 +1,92 @@
+"""Per-operator runtime statistics for EXPLAIN ANALYZE (reference:
+util/execdetails/execdetails.go RuntimeStatsColl + executor/explain.go).
+
+Executors are wrapped at build time (executor/__init__ build_executor): each
+`execute()` call records inclusive wall time and output rows keyed by the
+plan node's identity; fused device paths additionally annotate which engine
+ran the fragment and the compile-vs-execute split (the TPU analog of the
+reference's cop-task execution info)."""
+
+from __future__ import annotations
+
+import time
+
+
+class OpStats:
+    __slots__ = ("rows", "time_s", "loops", "extra", "mem_bytes")
+
+    def __init__(self):
+        self.rows = 0
+        self.time_s = 0.0
+        self.loops = 0
+        self.extra = {}
+        self.mem_bytes = 0
+
+    def exec_info(self) -> str:
+        # loops == 0 means the operator never ran standalone (it was fused
+        # into a parent device fragment) — show only the annotations
+        parts = ([f"time:{_fmt_dur(self.time_s)}", f"loops:{self.loops}"]
+                 if self.loops else [])
+        for k, v in self.extra.items():
+            parts.append(f"{k}:{v}")
+        return ", ".join(parts)
+
+
+def _fmt_dur(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s * 1e6:.0f}µs"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"
+
+
+class RuntimeStatsColl:
+    """plan-node-id -> OpStats (reference: execdetails.RuntimeStatsColl)."""
+
+    def __init__(self):
+        self._stats: dict[int, OpStats] = {}
+
+    def get(self, plan) -> OpStats:
+        st = self._stats.get(id(plan))
+        if st is None:
+            st = self._stats[id(plan)] = OpStats()
+        return st
+
+    def has(self, plan) -> bool:
+        return id(plan) in self._stats
+
+    def record(self, plan, rows: int, elapsed: float, mem_bytes: int = 0):
+        st = self.get(plan)
+        st.rows += rows
+        st.time_s += elapsed
+        st.loops += 1
+        st.mem_bytes = max(st.mem_bytes, mem_bytes)
+
+    def annotate(self, plan, **kv):
+        self.get(plan).extra.update(
+            {k: v for k, v in kv.items() if v is not None})
+
+
+def timed_execute(exe, stats: RuntimeStatsColl):
+    """Wrap an executor instance's execute() to record inclusive wall time
+    + output rows (TiDB's EXPLAIN ANALYZE `time` is likewise inclusive of
+    children)."""
+    inner = exe.execute
+
+    def run():
+        t0 = time.perf_counter()
+        chunk = inner()
+        el = time.perf_counter() - t0
+        mem = chunk.mem_bytes() if hasattr(chunk, "mem_bytes") else 0
+        stats.record(exe.plan, chunk.num_rows, el, mem)
+        return chunk
+
+    return run

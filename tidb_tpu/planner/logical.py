@@ -204,13 +204,17 @@ class Window(LogicalPlan):
         return "Window"
 
 
-def explain_tree(plan: LogicalPlan, depth=0, out=None):
-    """Render the plan as EXPLAIN rows (id, info)."""
+def explain_nodes(plan: LogicalPlan, depth=0, out=None):
+    """Flatten the plan as (rendered id, info, node) rows."""
     if out is None:
         out = []
     prefix = ("  " * depth + "└─") if depth else ""
-    info = plan.explain_info()
-    out.append((prefix + plan.explain_name(), info))
+    out.append((prefix + plan.explain_name(), plan.explain_info(), plan))
     for c in plan.children:
-        explain_tree(c, depth + 1, out)
+        explain_nodes(c, depth + 1, out)
     return out
+
+
+def explain_tree(plan: LogicalPlan, depth=0, out=None):
+    """Render the plan as EXPLAIN rows (id, info)."""
+    return [(name, info) for name, info, _ in explain_nodes(plan, depth)]

@@ -104,6 +104,7 @@ class MySQLServer:
             self._command_loop(io, session)
         finally:
             self.connections.pop(conn_id, None)
+            session.close()
 
     def _parse_handshake_response(self, buf: bytes):
         caps = struct.unpack_from("<I", buf, 0)[0]

@@ -6,8 +6,11 @@ from __future__ import annotations
 from ..errors import SchemaError
 from ..sqltypes import TYPE_LONGLONG, TYPE_VARCHAR, FieldType
 
+from ..sqltypes import TYPE_DOUBLE
+
 _S = FieldType(tp=TYPE_VARCHAR)
 _I = FieldType(tp=TYPE_LONGLONG)
+_F = FieldType(tp=TYPE_DOUBLE)
 
 
 def mem_table(session, db: str, name: str):
@@ -106,8 +109,68 @@ def _processlist(session):
             ("command", _S), ("time", _I), ("state", _S), ("info", _S)]
 
     def rows():
-        return [(session.conn_id, session.user.encode(), b"localhost",
-                 session.current_db().encode(), b"Query", 0, b"", b"")]
+        import time as _t
+        out = []
+        for s in list(session.domain.sessions.values()):
+            running = s.current_sql is not None
+            out.append((
+                s.conn_id, s.user.encode(), b"localhost",
+                s.current_db().encode(),
+                b"Query" if running else b"Sleep",
+                int(_t.time() - s.stmt_start) if running else 0,
+                b"autocommit" if s.txn is None else b"in transaction",
+                (s.current_sql or "").encode()))
+        return out
+    return cols, rows
+
+
+def _slow_query(session):
+    """reference: executor/slow_query.go reading the slow log back as SQL."""
+    cols = [("time", _S), ("user", _S), ("db", _S), ("query_time", _F),
+            ("digest", _S), ("query", _S), ("result_rows", _I),
+            ("succ", _I), ("plan", _S)]
+
+    def rows():
+        import datetime as _dt
+        out = []
+        for it in list(session.domain.observe.slow_queries):
+            ts = _dt.datetime.fromtimestamp(it.ts).strftime(
+                "%Y-%m-%d %H:%M:%S.%f")
+            out.append((ts.encode(), it.user.encode(), it.db.encode(),
+                        it.duration_s, it.digest.encode(), it.sql.encode(),
+                        it.rows, 1 if it.succ else 0, it.plan.encode()))
+        return out
+    return cols, rows
+
+
+def _statements_summary(session):
+    """reference: util/stmtsummary/statement_summary.go."""
+    cols = [("digest", _S), ("exec_count", _I), ("sum_latency", _F),
+            ("max_latency", _F), ("min_latency", _F), ("avg_latency", _F),
+            ("sum_result_rows", _I), ("err_count", _I),
+            ("schema_name", _S), ("digest_text", _S)]
+
+    def rows():
+        out = []
+        for st in list(session.domain.observe.stmt_summary.values()):
+            avg = st.sum_latency / st.exec_count if st.exec_count else 0.0
+            out.append((st.digest.encode(), st.exec_count, st.sum_latency,
+                        st.max_latency,
+                        0.0 if st.min_latency == float("inf")
+                        else st.min_latency,
+                        avg, st.sum_rows, st.err_count,
+                        st.db.encode(), st.sample_sql.encode()))
+        return out
+    return cols, rows
+
+
+def _metrics(session):
+    """Flat counter registry snapshot (reference: metrics/metrics.go)."""
+    cols = [("name", _S), ("value", _I)]
+
+    def rows():
+        return [(k.encode(), v) for k, v in
+                sorted(session.domain.observe.counters.items())]
     return cols, rows
 
 
@@ -180,4 +243,8 @@ _TABLES = {
     ("information_schema", "character_sets"): _character_sets,
     ("information_schema", "collations"): _collations,
     ("information_schema", "key_column_usage"): _key_column_usage,
+    ("information_schema", "slow_query"): _slow_query,
+    ("information_schema", "statements_summary"): _statements_summary,
+    ("information_schema", "cluster_slow_query"): _slow_query,
+    ("information_schema", "metrics"): _metrics,
 }

@@ -1,0 +1,95 @@
+"""Observability state shared by all sessions of a Domain: slow-query log,
+statement summary and a metrics registry.
+
+Reference roles: slow log (`executor/slow_query.go` + SlowLogFormat in
+sessionctx/variable/session.go), statement summary
+(`util/stmtsummary/statement_summary.go`), Prometheus metrics
+(`metrics/metrics.go:169`). All three are fed from one hook in the
+session statement loop and read back through information_schema memtables,
+keeping the reference's "observability is SQL-queryable" property."""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+class SlowQueryItem:
+    __slots__ = ("ts", "user", "db", "duration_s", "digest", "sql",
+                 "rows", "succ", "plan")
+
+    def __init__(self, ts, user, db, duration_s, digest, sql, rows, succ,
+                 plan=""):
+        self.ts = ts
+        self.user = user
+        self.db = db
+        self.duration_s = duration_s
+        self.digest = digest
+        self.sql = sql
+        self.rows = rows
+        self.succ = succ
+        self.plan = plan
+
+
+class StmtSummary:
+    """Per-digest aggregate (reference: stmtSummaryByDigest)."""
+
+    __slots__ = ("digest", "sample_sql", "db", "exec_count", "sum_latency",
+                 "max_latency", "min_latency", "sum_rows", "first_seen",
+                 "last_seen", "err_count")
+
+    def __init__(self, digest, sample_sql, db):
+        self.digest = digest
+        self.sample_sql = sample_sql
+        self.db = db
+        self.exec_count = 0
+        self.sum_latency = 0.0
+        self.max_latency = 0.0
+        self.min_latency = float("inf")
+        self.sum_rows = 0
+        self.first_seen = time.time()
+        self.last_seen = self.first_seen
+        self.err_count = 0
+
+    def add(self, latency_s, rows, succ):
+        self.exec_count += 1
+        self.sum_latency += latency_s
+        self.max_latency = max(self.max_latency, latency_s)
+        self.min_latency = min(self.min_latency, latency_s)
+        self.sum_rows += rows
+        self.last_seen = time.time()
+        if not succ:
+            self.err_count += 1
+
+
+class Observability:
+    def __init__(self, slow_log_cap=1024, summary_cap=512):
+        self._lock = threading.Lock()
+        self.slow_queries = collections.deque(maxlen=slow_log_cap)
+        self.stmt_summary: "collections.OrderedDict[str, StmtSummary]" = \
+            collections.OrderedDict()
+        self._summary_cap = summary_cap
+        # metrics: flat counter/gauge registry (reference: metrics/metrics.go)
+        self.counters = collections.Counter()
+
+    def inc(self, name, n=1):
+        with self._lock:
+            self.counters[name] += n
+
+    def observe_stmt(self, *, user, db, sql, digest, latency_s, rows, succ,
+                     slow_threshold_s, plan=""):
+        with self._lock:
+            st = self.stmt_summary.get(digest)
+            if st is None:
+                while len(self.stmt_summary) >= self._summary_cap:
+                    self.stmt_summary.popitem(last=False)
+                st = self.stmt_summary[digest] = StmtSummary(digest, sql, db)
+            st.add(latency_s, rows, succ)
+            self.counters["executor_statement_total"] += 1
+            if not succ:
+                self.counters["executor_statement_error_total"] += 1
+            if latency_s >= slow_threshold_s:
+                self.slow_queries.append(SlowQueryItem(
+                    time.time(), user, db, latency_s, digest, sql, rows,
+                    succ, plan))

@@ -31,6 +31,7 @@ class Domain:
 
     def __init__(self, store):
         from ..storage import ColumnarCache
+        from .observe import Observability
         self.store = store
         self.columnar_cache = ColumnarCache(store)
         self._schema_lock = threading.Lock()
@@ -38,6 +39,8 @@ class Domain:
         self.global_vars: dict[str, str] = {}
         self.stats: dict[int, dict] = {}      # table_id -> stats blob
         self.ddl_lock = threading.RLock()     # single-owner DDL (owner role)
+        self.observe = Observability()        # slow log + stmt summary + metrics
+        self.sessions: dict[int, "Session"] = {}  # conn_id -> live session
         self.reload_schema()
 
     def reload_schema(self):
@@ -194,6 +197,13 @@ class Session:
         self._expr_ctx = _ExprCtx(self)
         from ..ddl import DDLExecutor
         self.ddl = DDLExecutor(self)
+        self.current_sql: str | None = None   # processlist info
+        self.stmt_start = 0.0
+        domain.sessions[self.conn_id] = self
+
+    def close(self):
+        """Drop the session from the domain registry (processlist)."""
+        self.domain.sessions.pop(self.conn_id, None)
 
     # -- variables ----------------------------------------------------------
 
@@ -385,8 +395,17 @@ class Session:
 
     def _execute_stmt(self, stmt) -> Result:
         self.warnings = []
+        t0 = time.perf_counter()
         try:
-            return self._dispatch(stmt)
+            sql = stmt.restore()
+        except Exception:
+            sql = type(stmt).__name__
+        self.current_sql = sql
+        self.stmt_start = time.time()
+        res = None
+        try:
+            res = self._dispatch(stmt)
+            return res
         except Exception:
             # statement-level rollback of the autocommit txn — ANY escaping
             # exception must not leave a stale txn dangling on the session
@@ -394,6 +413,19 @@ class Session:
                 self.txn.rollback()
                 self.txn = None
             raise
+        finally:
+            self.current_sql = None
+            el = time.perf_counter() - t0
+            try:
+                thr_ms = int(self.get_sysvar("tidb_slow_log_threshold"))
+                rows = (res.affected if res is not None and res.chunk is None
+                        else (res.chunk.num_rows if res is not None else 0))
+                self.domain.observe.observe_stmt(
+                    user=self.user, db=self._db, sql=sql,
+                    digest=sql_digest(sql), latency_s=el, rows=rows,
+                    succ=res is not None, slow_threshold_s=thr_ms / 1000.0)
+            except Exception:
+                pass  # observability must never fail the statement
 
     def _dispatch(self, stmt) -> Result:
         if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
@@ -537,18 +569,34 @@ class Session:
         if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
             raise TiDBError("EXPLAIN supports SELECT statements only for now")
         plan = self.plan_query(inner)
-        if stmt.analyze:
-            t0 = time.time()
-            from ..executor import build_executor
-            exe = build_executor(plan, self._exec_ctx())
-            chunk = exe.execute()
-            elapsed = time.time() - t0
-        rows = []
-        for name, info in explain_tree(plan):
-            rows.append((name.encode(), info.encode()))
         ft = FieldType(tp=TYPE_VARCHAR)
-        out = Chunk.from_rows([ft, ft], rows)
-        return Result(names=["id", "info"], chunk=out)
+        if not stmt.analyze:
+            rows = [(name.encode(), info.encode())
+                    for name, info in explain_tree(plan)]
+            return Result(names=["id", "info"],
+                          chunk=Chunk.from_rows([ft, ft], rows))
+        # EXPLAIN ANALYZE: run with a RuntimeStatsColl wired through the
+        # executor tree (reference: util/execdetails + executor/explain.go)
+        from ..executor import build_executor
+        from ..executor.execdetails import RuntimeStatsColl, _fmt_bytes
+        from ..planner.logical import explain_nodes
+        coll = RuntimeStatsColl()
+        exe = build_executor(plan, self._exec_ctx(), stats=coll)
+        exe.execute()
+        rows = []
+        for name, info, node in explain_nodes(plan):
+            if coll.has(node):
+                st = coll.get(node)
+                act = str(st.rows) if st.loops else "-"
+                einfo = st.exec_info()
+                mem = _fmt_bytes(st.mem_bytes) if st.mem_bytes else "N/A"
+            else:
+                act, einfo, mem = "-", "-", "N/A"
+            rows.append((name.encode(), act.encode(), einfo.encode(),
+                         info.encode(), mem.encode()))
+        out = Chunk.from_rows([ft] * 5, rows)
+        return Result(names=["id", "actRows", "execution info",
+                             "operator info", "memory"], chunk=out)
 
     def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> Result:
         """Collect basic stats (reference: executor/analyze.go; histograms

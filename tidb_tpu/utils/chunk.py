@@ -192,6 +192,18 @@ class Chunk:
         idx = np.nonzero(mask)[0]
         return self.take(idx)
 
+    def mem_bytes(self) -> int:
+        """Approximate resident bytes (reference: chunk.Chunk MemoryUsage —
+        feeds the memory tracker and EXPLAIN ANALYZE's memory column)."""
+        total = 0
+        for c in self.columns:
+            if c.data.dtype == object:
+                total += sum(len(v) + 49 for v in c.data)  # bytes + obj header
+            else:
+                total += c.data.nbytes
+            total += c.nulls.nbytes
+        return total
+
     def to_display_rows(self) -> list[tuple]:
         """Rows rendered as MySQL text protocol strings (None for NULL)."""
         out = []
