@@ -115,6 +115,12 @@ class TableScanExec(QueryExecutor):
             tbl = Table(p.table_info, txn)
             return tbl.scan_columnar(col_infos=p.col_infos), p.pushed_conds
         entry = self.ctx.columnar_cache().get(p.table_info, txn)
+        if entry is None:
+            # reader snapshot predates the cache watermark (old read view
+            # in an explicit txn): scan through the snapshot directly
+            from ..table import Table
+            tbl = Table(p.table_info, txn)
+            return tbl.scan_columnar(col_infos=p.col_infos), p.pushed_conds
         return (self.ctx.columnar_cache().project(entry, p.col_infos,
                                                   p.table_info),
                 p.pushed_conds)
@@ -133,8 +139,13 @@ class TableScanExec(QueryExecutor):
             chunk = tbl.scan_columnar(col_infos=p.col_infos)
         else:
             entry = self.ctx.columnar_cache().get(p.table_info, txn)
-            chunk = self.ctx.columnar_cache().project(entry, p.col_infos,
-                                                      p.table_info)
+            if entry is None:  # old read view: scan through the snapshot
+                from ..table import Table
+                chunk = Table(p.table_info, txn).scan_columnar(
+                    col_infos=p.col_infos)
+            else:
+                chunk = self.ctx.columnar_cache().project(
+                    entry, p.col_infos, p.table_info)
         if p.pushed_conds:
             mask = eval_conds_mask(p.pushed_conds, chunk)
             chunk = chunk.filter(mask)

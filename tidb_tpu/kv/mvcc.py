@@ -160,6 +160,7 @@ class MVCCStore:
         self._waits: dict[int, int] = {}
         # table write watermark for columnar-cache invalidation
         self.table_versions: dict[int, int] = {}
+        self.table_version_ts: dict[int, int] = {}
 
     # -- transactional API --------------------------------------------------
 
@@ -365,9 +366,20 @@ class MVCCStore:
 
     # -- table write watermarks (columnar cache invalidation) ---------------
 
-    def bump_table_version(self, table_id: int):
+    def bump_table_version(self, table_id: int, commit_ts: int = 0) -> int:
         with self._lock:
-            self.table_versions[table_id] = self.table_versions.get(table_id, 0) + 1
+            v = self.table_versions.get(table_id, 0) + 1
+            self.table_versions[table_id] = v
+            if commit_ts:
+                self.table_version_ts[table_id] = commit_ts
+            return v
 
     def table_version(self, table_id: int) -> int:
         return self.table_versions.get(table_id, 0)
+
+    def table_version_info(self, table_id: int) -> tuple[int, int]:
+        """(version, commit_ts of the last bump) — readers with snapshot ts
+        older than that commit_ts must not be served the cached columns."""
+        with self._lock:
+            return (self.table_versions.get(table_id, 0),
+                    self.table_version_ts.get(table_id, 0))

@@ -165,6 +165,7 @@ class NativeMVCCStore:
         self.regions: list[Region] = [Region(b"", b"", region_id=1)]
         self.safe_point = 0
         self.table_versions: dict[int, int] = {}
+        self.table_version_ts: dict[int, int] = {}
         self._meta_lock = threading.Lock()
 
     def __del__(self):
@@ -359,10 +360,20 @@ class NativeMVCCStore:
                 out.append(r)
         return out
 
-    def bump_table_version(self, table_id: int):
+    def bump_table_version(self, table_id: int, commit_ts: int = 0) -> int:
         with self._meta_lock:
-            self.table_versions[table_id] = \
-                self.table_versions.get(table_id, 0) + 1
+            v = self.table_versions.get(table_id, 0) + 1
+            self.table_versions[table_id] = v
+            if commit_ts:
+                self.table_version_ts[table_id] = commit_ts
+            return v
 
     def table_version(self, table_id: int) -> int:
         return self.table_versions.get(table_id, 0)
+
+    def table_version_info(self, table_id: int) -> tuple[int, int]:
+        """(version, commit_ts of the last bump) — readers with snapshot ts
+        older than that commit_ts must not be served the cached columns."""
+        with self._meta_lock:
+            return (self.table_versions.get(table_id, 0),
+                    self.table_version_ts.get(table_id, 0))

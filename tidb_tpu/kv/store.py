@@ -104,6 +104,7 @@ class Transaction:
         self.valid = True
         self.locked_keys: set[bytes] = set()
         self.touched_tables: set[int] = set()
+        self.committed_versions: dict[int, int] = {}  # tid -> post-commit ver
         self.for_update_ts = start_ts
 
     # reads see own writes first (union of membuffer and snapshot,
@@ -165,7 +166,8 @@ class Transaction:
         self.store.mvcc.commit([m[0] for m in muts], self.start_ts, commit_ts)
         self.store.mvcc.clear_wait(self.start_ts)
         for tid in self.touched_tables:
-            self.store.mvcc.bump_table_version(tid)
+            self.committed_versions[tid] = \
+                self.store.mvcc.bump_table_version(tid, commit_ts)
         return commit_ts
 
     def rollback(self):

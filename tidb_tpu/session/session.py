@@ -40,7 +40,11 @@ class Domain:
         self.stats: dict[int, dict] = {}      # table_id -> stats blob
         self.ddl_lock = threading.RLock()     # single-owner DDL (owner role)
         self.observe = Observability()        # slow log + stmt summary + metrics
-        self.sessions: dict[int, "Session"] = {}  # conn_id -> live session
+        # conn_id -> live session, weakly: embedded users who never close()
+        # must not leak ghost processlist rows (the server path still calls
+        # Session.close() for prompt removal)
+        import weakref
+        self.sessions = weakref.WeakValueDictionary()
         self.reload_schema()
 
     def reload_schema(self):
@@ -282,13 +286,43 @@ class Session:
 
     def _commit_txn(self):
         txn, self.txn = self.txn, None
+        from .. import tablecodec
+        cache = self.domain.columnar_cache
+        # capture per-table record mutations BEFORE commit (the membuffer
+        # survives commit, but collecting first keeps failure paths simple)
+        deltas: dict[int, list] | None = {}
+        try:
+            for tid in txn.touched_tables:
+                pre = tablecodec.record_prefix(tid)
+                muts = []
+                for k, v in txn.membuf.range_items(pre, pre + b"\xff" * 9):
+                    try:
+                        _t, h = tablecodec.decode_record_key(k)
+                    except ValueError:
+                        continue
+                    muts.append((h, v))
+                deltas[tid] = muts
+        except Exception:
+            deltas = None
         try:
             txn.commit()
         except Exception:
+            # failed commit mutated nothing: rolled back, version not bumped
             raise
-        finally:
-            for tid in txn.touched_tables:
-                self.domain.columnar_cache.invalidate(tid)
+        # commit succeeded: maintain the columnar cache incrementally
+        # (reference analog: TiFlash applies raft log deltas, not rebuilds)
+        infos = self.infoschema()
+        for tid in txn.touched_tables:
+            newv = txn.committed_versions.get(tid)
+            found = infos.table_by_id(tid)
+            info = found[1] if found is not None else None
+            if deltas is None or info is None or newv is None:
+                cache.invalidate(tid)
+                continue
+            try:
+                cache.apply_delta(info, deltas[tid], newv)
+            except Exception:
+                cache.invalidate(tid)
 
     def begin(self):
         if self.txn is not None and self.txn.valid:

@@ -58,10 +58,18 @@ def _column_stats(col):
 
 
 def analyze_table(session, info):
-    entry = session.columnar_cache().get(info, session.store.begin())
-    stats = {"row_count": int(entry.nrows), "columns": {}}
-    for col_id, col in entry.columns.items():
-        stats["columns"][str(col_id)] = _column_stats(col)
+    cache = session.columnar_cache()
+    cols = info.public_columns()
+    entry = cache.get(info, session.store.begin())
+    if entry is not None:
+        chunk = cache.project(entry, cols, info)
+    else:  # unreachable with a fresh snapshot, but never skip ANALYZE
+        from ..table import Table
+        chunk = Table(info, session.store.begin()).scan_columnar(
+            col_infos=cols)
+    stats = {"row_count": int(chunk.num_rows), "columns": {}}
+    for ci, col in zip(cols, chunk.columns):
+        stats["columns"][str(ci.id)] = _column_stats(col)
     txn = session.store.begin()
     try:
         m = Meta(txn)
