@@ -159,3 +159,21 @@ def test_cold_cache_build_inside_old_txn_not_poisoned(tk):
     # must not have been installed as current
     tk2.must_query("select count(*) from d").check([("21",)])
     tk.must_query("select count(*) from d").check([("21",)])
+
+
+def test_view_immutable_after_commit(tk):
+    """COW: a view captured before a commit keeps its row set — closes the
+    get→project window where in-place deltas would leak newer rows."""
+    tk.must_query("select count(*) from d")
+    info = tk.session.infoschema().table_by_name("test", "d")
+    cache = tk.session.domain.columnar_cache
+    view = cache.get(info, tk.session.store.begin())
+    before = view.nrows
+    tk.must_exec("insert into d values (950, 1, 'post-view')")
+    tk.must_exec("delete from d where a = 1")
+    assert view.nrows == before
+    chunk = cache.project(view, info.public_columns(), info)
+    assert chunk.num_rows == before
+    # while the entry's CURRENT view advanced
+    fresh = cache.get(info, tk.session.store.begin())
+    assert fresh.nrows == before  # +1 insert -1 delete

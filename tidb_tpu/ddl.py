@@ -32,25 +32,31 @@ class DDLExecutor:
 
     def _run_job(self, fn, job_type, schema_id=0, table_id=0, args=None):
         """Enqueue + synchronously execute a DDL job in its own txn
-        (reference: ddl/ddl.go:551 doDDLJob + ddl_worker.go handleDDLJobQueue)."""
+        (reference: ddl/ddl.go:551 doDDLJob + ddl_worker.go
+        handleDDLJobQueue). Serialized against the async online-DDL worker
+        via the domain DDL lock — both rewrite the meta job-queue key, and
+        interleaving (e.g. DROP INDEX racing an in-flight ADD INDEX state
+        machine) must not happen."""
         store = self.session.store
-        txn = store.begin()
-        m = Meta(txn)
-        job = Job(id=m.gen_job_id(), type=job_type, schema_id=schema_id,
-                  table_id=table_id, args=args or {}, start_ts=txn.start_ts)
-        m.enqueue_job(job)
-        try:
-            fn(m, job)
-            job.state = JobState.SYNCED
-            job.schema_state = SchemaState.PUBLIC
-            job.schema_version = m.bump_schema_version()
-            m.finish_job(job)
-            txn.commit()
-        except Exception:
-            txn.rollback()
-            raise
-        self.session.domain.reload_schema()
-        return job
+        with self.session.domain.ddl_lock:
+            txn = store.begin()
+            m = Meta(txn)
+            job = Job(id=m.gen_job_id(), type=job_type, schema_id=schema_id,
+                      table_id=table_id, args=args or {},
+                      start_ts=txn.start_ts)
+            m.enqueue_job(job)
+            try:
+                fn(m, job)
+                job.state = JobState.SYNCED
+                job.schema_state = SchemaState.PUBLIC
+                job.schema_version = m.bump_schema_version()
+                m.finish_job(job)
+                txn.commit()
+            except Exception:
+                txn.rollback()
+                raise
+            self.session.domain.reload_schema()
+            return job
 
     # -- statements ---------------------------------------------------------
 
@@ -155,6 +161,10 @@ class DDLExecutor:
         self._run_job(fn, "truncate_table", schema_id=db.id, table_id=tbl.id)
 
     def create_index(self, stmt: ast.CreateIndexStmt):
+        """ADD INDEX runs ONLINE: the session enqueues a job and the domain's
+        DDL worker walks delete-only → write-only → write-reorg → public with
+        checkpointed batched backfill (tidb_tpu/ddl_worker.py; reference:
+        ddl/index.go:519-541, ddl/backfilling.go:142)."""
         sess = self.session
         db_name = stmt.table.schema or sess.current_db()
         infos = sess.infoschema()
@@ -165,16 +175,33 @@ class DDLExecutor:
                 return
             raise TiDBError(f"Duplicate key name '{stmt.index_name}'",
                             code=ErrCode.DupKeyName)
+        for cname, _len in stmt.columns:
+            if tbl.find_column(cname) is None:
+                raise TiDBError(f"Key column '{cname}' doesn't exist in table",
+                                code=ErrCode.KeyDoesNotExist)
+        job = self.enqueue_job(
+            "add_index", schema_id=db.id, table_id=tbl.id,
+            args={"index_name": stmt.index_name,
+                  "unique": bool(stmt.unique),
+                  "columns": [[c, l] for c, l in stmt.columns]})
+        sess.domain.ddl_worker.run_job(job.id)
 
-        def fn(m, job):
-            t = m.get_table(db.id, tbl.id)
-            idx = _build_index_info(t, stmt.index_name, stmt.columns,
-                                    stmt.unique, m)
-            t.indexes.append(idx)
-            m.update_table(db.id, t)
-            job.args = {"index": idx.name}
-            self._backfill_index(t, idx)
-        self._run_job(fn, "add_index", schema_id=db.id, table_id=tbl.id)
+    def enqueue_job(self, job_type, schema_id=0, table_id=0, args=None) -> Job:
+        """Enqueue a job for the async worker (reference: ddl.go:551
+        doDDLJob's enqueue half)."""
+        store = self.session.store
+        txn = store.begin()
+        try:
+            m = Meta(txn)
+            job = Job(id=m.gen_job_id(), type=job_type, schema_id=schema_id,
+                      table_id=table_id, args=args or {},
+                      start_ts=txn.start_ts)
+            m.enqueue_job(job)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        return job
 
     def drop_index(self, stmt: ast.DropIndexStmt):
         sess = self.session
@@ -315,21 +342,6 @@ class DDLExecutor:
                                                  pfx + tablecodec.INDEX_SEP + b"\xff" * 17)
         self.session.domain.columnar_cache.invalidate(table_id)
 
-    def _backfill_index(self, tbl_info, idx):
-        """Backfill existing rows (reference: ddl/backfilling.go — batched
-        snapshot scan writing index KVs; single batch here)."""
-        from .table import Table
-        from .errors import DupEntryError
-        store = self.session.store
-        txn = store.begin()
-        t = Table(tbl_info, txn)
-        try:
-            for handle, row in t.iter_rows():
-                t._index_put(idx, row, handle)
-            txn.commit()
-        except Exception:
-            txn.rollback()
-            raise
 
 
 def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:

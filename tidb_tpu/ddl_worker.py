@@ -1,0 +1,374 @@
+"""Online DDL worker — the F1 schema-state machine with an async owner
+worker and checkpointed backfill.
+
+Reference: ddl/ddl_worker.go:155,502,728 (owner loop + runDDLJob),
+ddl/index.go:519-541 (none → delete-only → write-only → write-reorganization
+→ public), ddl/backfilling.go:142,290 (batched snapshot backfill with the
+progress handle checkpointed in the job), ddl/rollingback.go (unique-key
+violation rolls the index add back), ddl/callback.go (test hooks between
+states).
+
+Single-process adaptation: the schema cache is one Domain, so a state
+transition commits + reloads the domain schema instead of waiting 2×lease
+for peers; everything else — job queue in the meta KV, per-transition schema
+versions, batch txns that atomically advance the checkpoint, concurrent DML
+maintaining the index according to its state — keeps the reference shape.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import tablecodec
+from .errors import DupEntryError, TiDBError, WriteConflictError
+from .meta import Meta
+from .model import Job, JobState, SchemaState
+from .table import Table
+
+MIN_HANDLE = -(1 << 63)
+DEFAULT_REORG_BATCH = 256
+
+
+class DDLWorker:
+    """The DDL owner role: drains the meta job queue in a background thread;
+    sessions enqueue and block on completion (reference: doDDLJob blocks,
+    the owner executes)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self.hooks = []           # [(event:str, job:Job) -> None]
+        self.batch_size = DEFAULT_REORG_BATCH
+        self._thread = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._done: dict[int, tuple[threading.Event, str | None]] = {}
+        self._lock = threading.Lock()
+
+    # -- hooks (reference: ddl/callback.go) ---------------------------------
+
+    def on_event(self, fn):
+        self.hooks.append(fn)
+
+    def _fire(self, event: str, job: Job):
+        for fn in list(self.hooks):
+            fn(event, job)
+
+    # -- session-facing API --------------------------------------------------
+
+    def run_job(self, job_id: int, timeout: float = 120.0):
+        """Wake the worker and block until the job finishes; re-raise its
+        terminal error in the caller (reference: ddl.go:551 doDDLJob).
+
+        The waiter registers AFTER the job is already visible in the queue,
+        so the worker may finish it before _signal has anyone to notify —
+        the wait loop therefore also polls the queue and falls back to the
+        job's recorded history error."""
+        import time as _time
+        ev = threading.Event()
+        with self._lock:
+            self._done[job_id] = (ev, None)
+        self._ensure_thread()
+        self._wake.set()
+        deadline = _time.monotonic() + timeout
+        err = None
+        while True:
+            if ev.wait(timeout=0.05):
+                with self._lock:
+                    _ev, err = self._done.pop(job_id)
+                break
+            if not self._is_queued(job_id):
+                with self._lock:
+                    self._done.pop(job_id, None)
+                err = self._job_error(job_id)
+                break
+            if _time.monotonic() > deadline:
+                with self._lock:
+                    self._done.pop(job_id, None)
+                raise TiDBError(f"DDL job {job_id} timed out")
+        if err:
+            if "Duplicate entry" in err:
+                raise DupEntryError(err)
+            raise TiDBError(err)
+
+    def _is_queued(self, job_id: int) -> bool:
+        txn = self.domain.store.begin()
+        try:
+            return any(j.id == job_id for j in Meta(txn).queued_jobs())
+        finally:
+            txn.rollback()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="ddl-worker", daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            try:
+                self.run_pending()
+            except Exception:
+                pass  # job-level errors are recorded on the job itself
+
+    # -- queue processing ----------------------------------------------------
+
+    def run_pending(self):
+        """Drain the queue (each step is its own txn; re-entrant)."""
+        with self.domain.ddl_lock:
+            while True:
+                job = self._peek()
+                if job is None:
+                    return
+                try:
+                    self._run_one(job)
+                except Exception as e:
+                    self._fail_job(job, str(e))
+                    self._signal(job.id, str(e))
+
+    def _peek(self):
+        txn = self.domain.store.begin()
+        try:
+            return Meta(txn).peek_job()
+        finally:
+            txn.rollback()
+
+    def _run_one(self, job: Job):
+        if job.type == "add_index":
+            while not self.step_add_index(job.id):
+                pass
+            self._signal(job.id, self._job_error(job.id))
+        else:
+            raise TiDBError(f"worker cannot run job type {job.type}")
+
+    def _signal(self, job_id: int, err: str | None):
+        with self._lock:
+            ent = self._done.get(job_id)
+            if ent is not None:
+                self._done[job_id] = (ent[0], err)
+                ent[0].set()
+
+    def _job_error(self, job_id: int) -> str | None:
+        txn = self.domain.store.begin()
+        try:
+            for j in Meta(txn).history_jobs():
+                if j.id == job_id:
+                    return j.error or None
+        finally:
+            txn.rollback()
+        return None
+
+    def _fail_job(self, job: Job, err: str):
+        """Terminal failure: cancel the job AND undo any half-built schema
+        object — a non-public index left behind would be unreadable yet
+        maintained by every DML forever, and would block a retry by name
+        (reference: ddl/rollingback.go)."""
+        txn = self.domain.store.begin()
+        idx_id = None
+        try:
+            m = Meta(txn)
+            if job.type == "add_index":
+                t = m.get_table(job.schema_id, job.table_id)
+                if t is not None:
+                    name = job.args.get("index_name", "")
+                    idx = t.find_index(name)
+                    if idx is not None and idx.state != SchemaState.PUBLIC:
+                        idx_id = idx.id
+                        t.indexes = [i for i in t.indexes if i.id != idx.id]
+                        m.update_table(job.schema_id, t)
+                        m.bump_schema_version()
+            job.state = JobState.CANCELLED
+            job.error = err
+            m.finish_job(job)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+        if idx_id is not None:
+            start, end = tablecodec.index_range(job.table_id, idx_id)
+            self.domain.store.mvcc.raw_delete_range(start, end)
+        self.domain.reload_schema()
+
+    # -- ADD INDEX state machine (reference: ddl/index.go:519-541) ----------
+
+    def step_add_index(self, job_id: int) -> bool:
+        """One state transition (or one backfill batch). Returns True when
+        the job has reached a terminal state. Public so tests can interleave
+        DML between arbitrary states and simulate crashes mid-backfill."""
+        store = self.domain.store
+        txn = store.begin()
+        m = Meta(txn)
+        job = next((j for j in m.queued_jobs() if j.id == job_id), None)
+        if job is None:
+            txn.rollback()
+            return True  # finished (or cancelled) already
+        t = m.get_table(job.schema_id, job.table_id)
+        if t is None:
+            self._cancel_locked(m, job, "table dropped during DDL")
+            txn.commit()
+            self.domain.reload_schema()
+            return True
+        name = job.args["index_name"]
+        idx = t.find_index(name)
+        try:
+            if idx is None:
+                # none → delete-only: the index object appears; DML removes
+                # stale entries but does not write new ones
+                from .ddl import _build_index_info
+                idx = _build_index_info(
+                    t, name, [(c, l) for c, l in job.args["columns"]],
+                    bool(job.args.get("unique")), m)
+                idx.state = SchemaState.DELETE_ONLY
+                t.indexes.append(idx)
+                return self._transition(m, txn, job, t,
+                                        SchemaState.DELETE_ONLY)
+            if idx.state == SchemaState.DELETE_ONLY:
+                idx.state = SchemaState.WRITE_ONLY
+                return self._transition(m, txn, job, t,
+                                        SchemaState.WRITE_ONLY)
+            if idx.state == SchemaState.WRITE_ONLY:
+                idx.state = SchemaState.WRITE_REORG
+                job.reorg_handle = MIN_HANDLE
+                return self._transition(m, txn, job, t,
+                                        SchemaState.WRITE_REORG)
+            if idx.state == SchemaState.WRITE_REORG:
+                txn.rollback()  # backfill batches run their own txns
+                return self._backfill_batch(job, t, idx)
+            txn.rollback()
+            return True
+        except Exception:
+            if txn.valid:
+                txn.rollback()
+            raise
+
+    def _transition(self, m: Meta, txn, job: Job, t, new_state: int) -> bool:
+        m.update_table(job.schema_id, t)
+        job.state = JobState.RUNNING
+        job.schema_state = new_state
+        job.schema_version = m.bump_schema_version()
+        m.update_job(job)
+        txn.commit()
+        self.domain.reload_schema()
+        self._fire(SchemaState.NAMES.get(new_state, str(new_state)), job)
+        return False
+
+    def _backfill_batch(self, job: Job, t, idx) -> bool:
+        """One checkpointed batch (reference: backfilling.go:290): scan
+        records after the checkpoint handle, write their index KVs, and
+        advance the checkpoint — all in ONE txn, so a crash between batches
+        loses nothing and repeats nothing."""
+        store = self.domain.store
+        for _attempt in range(20):
+            txn = store.begin()
+            try:
+                m = Meta(txn)
+                cur = next((j for j in m.queued_jobs() if j.id == job.id),
+                           None)
+                if cur is None:
+                    txn.rollback()
+                    return True
+                job = cur
+                start = (tablecodec.record_prefix(t.id)
+                         if job.reorg_handle == MIN_HANDLE else
+                         tablecodec.record_key(t.id, job.reorg_handle) + b"\x00")
+                end = tablecodec.record_prefix(t.id) + b"\xff" * 9
+                items = txn.snapshot.scan(start, end, limit=self.batch_size)
+                if not items:
+                    return self._finish_reorg(m, txn, job, t, idx)
+                tbl = Table(t, txn)
+                last = job.reorg_handle
+                for key, value in items:
+                    _tid, handle = tablecodec.decode_record_key(key)
+                    row = tablecodec.decode_row(value)
+                    self._backfill_put(txn, tbl, idx, row, handle)
+                    last = handle
+                job.reorg_handle = last
+                job.row_count += len(items)
+                m.update_job(job)
+                txn.commit()
+                self._fire("reorg_batch", job)
+                return False
+            except WriteConflictError:
+                txn.rollback()
+                continue  # concurrent DML touched a scanned row: retry batch
+            except DupEntryError as e:
+                txn.rollback()
+                self._rollback_index(job, t, idx, str(e))
+                return True
+            except Exception:
+                if txn.valid:
+                    txn.rollback()
+                raise
+        raise TiDBError("backfill batch: too many write conflicts")
+
+    @staticmethod
+    def _backfill_put(txn, tbl: Table, idx, row, handle):
+        """Write one backfilled index entry. Concurrent DML (the index is
+        write-only+) may have written this row's entry already — same handle
+        is fine (idempotent), a different handle is a real uniqueness
+        violation (reference: index backfill's mergeDupKey handling)."""
+        vals = tbl._index_values(idx, row)
+        if idx.unique and not any(v is None for v in vals):
+            key = tablecodec.index_key(tbl.info.id, idx.id, vals)
+            existing = txn.get(key)
+            if existing is not None:
+                if tablecodec.decode_index_handle(existing) != handle:
+                    raise DupEntryError(
+                        "Duplicate entry '%s' for key '%s'" % (
+                            "-".join(str(v) for v in vals), idx.name))
+                return
+            txn.put(key, tablecodec.encode_index_handle(handle))
+        else:
+            key = tablecodec.index_key(tbl.info.id, idx.id, vals,
+                                       handle=handle)
+            txn.put(key, tablecodec.INDEX_VALUE_MARKER)
+
+    def _finish_reorg(self, m: Meta, txn, job: Job, t, idx) -> bool:
+        idx.state = SchemaState.PUBLIC
+        m.update_table(job.schema_id, t)
+        job.state = JobState.SYNCED
+        job.schema_state = SchemaState.PUBLIC
+        job.schema_version = m.bump_schema_version()
+        m.finish_job(job)
+        txn.commit()
+        self.domain.reload_schema()
+        self._fire("public", job)
+        return True
+
+    def _rollback_index(self, job: Job, t, idx, err: str):
+        """Unique violation during backfill: remove the half-built index
+        (reference: ddl/rollingback.go convertAddIdxJob2RollbackJob)."""
+        store = self.domain.store
+        txn = store.begin()
+        try:
+            m = Meta(txn)
+            cur_t = m.get_table(job.schema_id, job.table_id)
+            if cur_t is not None:
+                cur_t.indexes = [i for i in cur_t.indexes if i.id != idx.id]
+                m.update_table(job.schema_id, cur_t)
+            job.state = JobState.ROLLBACK_DONE
+            job.error = err
+            job.schema_state = SchemaState.NONE
+            job.schema_version = m.bump_schema_version()
+            m.finish_job(job)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        start, end = tablecodec.index_range(t.id, idx.id)
+        store.mvcc.raw_delete_range(start, end)
+        self.domain.reload_schema()
+        self._fire("rollback_done", job)
+
+    def _cancel_locked(self, m: Meta, job: Job, err: str):
+        job.state = JobState.CANCELLED
+        job.error = err
+        m.finish_job(job)

@@ -754,9 +754,10 @@ class DeallocateStmt(StmtNode):
 
 @dataclass(repr=False)
 class AdminStmt(StmtNode):
-    kind: str = ""  # check_table | show_ddl | show_ddl_jobs | cancel_ddl_jobs
+    kind: str = ""  # check_table | check_index | show_ddl | show_ddl_jobs | cancel_ddl_jobs
     tables: list = field(default_factory=list)
     job_ids: list = field(default_factory=list)
+    index_name: str = ""
 
     def restore(self):
         return f"ADMIN {self.kind.upper()}"

@@ -1710,6 +1710,11 @@ class Parser:
     def _parse_admin(self):
         self._expect_kw("admin")
         if self._accept_kw("check"):
+            if self._accept_kw("index"):
+                tn = self._parse_table_name()
+                idx_name = self._ident()
+                return ast.AdminStmt(kind="check_index", tables=[tn],
+                                     index_name=idx_name)
             self._expect_kw("table")
             tables = [self._parse_table_name()]
             while self._accept_op(","):

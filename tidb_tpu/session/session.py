@@ -45,6 +45,8 @@ class Domain:
         # Session.close() for prompt removal)
         import weakref
         self.sessions = weakref.WeakValueDictionary()
+        from ..ddl_worker import DDLWorker
+        self.ddl_worker = DDLWorker(self)   # async online-DDL owner worker
         self.reload_schema()
 
     def reload_schema(self):
@@ -669,6 +671,13 @@ class Session:
                 db = tn.schema or self.current_db()
                 info = self.infoschema().table_by_name(db, tn.name)
                 check_table(self, info)
+            return Result()
+        if stmt.kind == "check_index":
+            from ..executor.admin import check_index
+            tn = stmt.tables[0]
+            db = tn.schema or self.current_db()
+            info = self.infoschema().table_by_name(db, tn.name)
+            check_index(self, info, stmt.index_name)
             return Result()
         raise TiDBError(f"unsupported ADMIN {stmt.kind}")
 

@@ -7,7 +7,15 @@ applying each commit's row mutations as a delta — appended row versions plus
 tombstones over older ones — compacting periodically. This is the TiFlash
 delta-tree role (stable layer + delta layer + background merge) rather than
 the rebuild-on-version-bump v1: a single-row write no longer re-decodes the
-table. Bulk loaders (the Lightning role) can still install columns directly,
+table.
+
+Concurrency: readers receive an immutable ``_View`` (copy-on-write row set);
+``apply_delta`` never mutates arrays a view references — it builds the next
+view and swaps it in. A reader that obtained a view before a commit keeps
+reading exactly its row set, closing the get→project window that an
+in-place delta would leak post-snapshot rows through.
+
+Bulk loaders (the Lightning role) can still install columns directly,
 bypassing row encode/decode entirely.
 """
 
@@ -38,108 +46,108 @@ class _Seg:
         self.columns = columns    # {col_id: Column}
 
 
-class _Entry:
-    __slots__ = ("version", "col_sig", "columns", "handles", "base_live",
-                 "base_all_live", "segs", "delta_pos", "nrows",
-                 "_merged", "_merged_handles", "_base_idx", "lock")
+class _View:
+    """An immutable row-set snapshot: base layer + delta segments. The only
+    mutable state is the lazily-built merge cache, guarded by its own lock
+    (merging twice is harmless; mutating rows a reader holds is not)."""
 
-    def __init__(self, version, col_sig, columns, handles, nrows):
-        self.lock = threading.Lock()   # per-entry: merge/apply/compact
-        self.version = version
-        self.col_sig = col_sig
-        self.columns = columns    # base layer {col_id: Column}
-        self.handles = handles    # base handles, ASCENDING (KV scan order)
-        self.base_live = None     # lazily created bool mask (None = all live)
-        self.base_all_live = True
-        self.segs: list[_Seg] = []
-        self.delta_pos: dict[int, tuple[int, int]] = {}  # handle->(seg,pos)
-        self.nrows = nrows        # live row count across base + delta
-        self._merged = {}         # col_id -> merged Column cache
-        self._merged_handles = None
-        self._base_idx = None     # cached np.nonzero(base_live)
+    __slots__ = ("columns", "handles", "base_live", "segs", "nrows",
+                 "lock", "_merged", "_merged_handles", "_base_idx")
 
-    # -- invariant helpers --------------------------------------------------
-
-    def delta_rows(self) -> int:
-        return sum(len(s.handles) for s in self.segs)
-
-    def _invalidate_merge(self):
+    def __init__(self, columns, handles, base_live, segs, nrows):
+        self.columns = columns      # base layer {col_id: Column}
+        self.handles = handles      # base handles, ASCENDING (KV scan order)
+        self.base_live = base_live  # bool mask or None (= all live)
+        self.segs = segs            # tuple[_Seg]
+        self.nrows = nrows          # live rows across base + delta
+        self.lock = threading.Lock()
         self._merged = {}
         self._merged_handles = None
         self._base_idx = None
 
+    def delta_rows(self) -> int:
+        return sum(len(s.handles) for s in self.segs)
+
     def _base_indices(self):
-        if self.base_all_live:
+        if self.base_live is None:
             return None  # whole base
         if self._base_idx is None:
             self._base_idx = np.nonzero(self.base_live)[0]
         return self._base_idx
 
-    def _tombstone(self, h: int) -> bool:
-        """Mark any live occurrence of handle h dead. True if one existed."""
-        pos = self.delta_pos.pop(h, None)
-        if pos is not None:
-            seg, i = pos
-            if self.segs[seg].live[i]:
-                self.segs[seg].live[i] = False
-                self.nrows -= 1
-                return True
-        i = int(np.searchsorted(self.handles, h))
-        if i < len(self.handles) and self.handles[i] == h:
-            if self.base_live is None:
-                self.base_live = np.ones(len(self.handles), dtype=bool)
-            if self.base_live[i]:
-                self.base_live[i] = False
-                self.base_all_live = False
-                self.nrows -= 1
-                return True
-        return False
-
-    def merged_column(self, col_id: int, fallback_fn) -> Column:
-        """Column over live rows: base[live] ++ seg0[live] ++ ... Cached
-        until the next delta so repeated scans after one write stay
-        zero-decode AND zero-copy."""
-        col = self._merged.get(col_id)
-        if col is not None:
+    def merged_column(self, col_id: int) -> Column | None:
+        """Column over live rows: base[live] ++ seg0[live] ++ ... Cached, so
+        repeated scans after one write are zero-decode AND zero-copy."""
+        with self.lock:
+            col = self._merged.get(col_id)
+            if col is not None:
+                return col
+            base = self.columns.get(col_id)
+            if base is None:
+                return None
+            if not self.segs and self.base_live is None:
+                self._merged[col_id] = base
+                return base
+            idx = self._base_indices()
+            datas = [base.data if idx is None else base.data[idx]]
+            nulls = [base.nulls if idx is None else base.nulls[idx]]
+            for s in self.segs:
+                sc = s.columns[col_id]
+                if s.live.all():
+                    datas.append(sc.data)
+                    nulls.append(sc.nulls)
+                else:
+                    li = np.nonzero(s.live)[0]
+                    datas.append(sc.data[li])
+                    nulls.append(sc.nulls[li])
+            col = Column(base.ftype, np.concatenate(datas),
+                         np.concatenate(nulls))
+            self._merged[col_id] = col
             return col
-        base = self.columns.get(col_id)
-        if base is None:
-            return fallback_fn(col_id)
-        if not self.segs and self.base_all_live:
-            self._merged[col_id] = base
-            return base
-        idx = self._base_indices()
-        datas, nulls = [], []
-        d = base.data if idx is None else base.data[idx]
-        n = base.nulls if idx is None else base.nulls[idx]
-        datas.append(d)
-        nulls.append(n)
-        for s in self.segs:
-            sc = s.columns[col_id]
-            if s.live.all():
-                datas.append(sc.data)
-                nulls.append(sc.nulls)
-            else:
-                li = np.nonzero(s.live)[0]
-                datas.append(sc.data[li])
-                nulls.append(sc.nulls[li])
-        col = Column(base.ftype, np.concatenate(datas), np.concatenate(nulls))
-        self._merged[col_id] = col
-        return col
 
     def merged_handles(self) -> np.ndarray:
-        if self._merged_handles is not None:
+        with self.lock:
+            if self._merged_handles is not None:
+                return self._merged_handles
+            if not self.segs and self.base_live is None:
+                self._merged_handles = self.handles
+                return self.handles
+            idx = self._base_indices()
+            parts = [self.handles if idx is None else self.handles[idx]]
+            for s in self.segs:
+                parts.append(s.handles if s.live.all()
+                             else s.handles[np.nonzero(s.live)[0]])
+            self._merged_handles = np.concatenate(parts)
             return self._merged_handles
-        if not self.segs and self.base_all_live:
-            self._merged_handles = self.handles
-            return self.handles
-        idx = self._base_indices()
-        parts = [self.handles if idx is None else self.handles[idx]]
-        for s in self.segs:
-            parts.append(s.handles if s.live.all()
-                         else s.handles[np.nonzero(s.live)[0]])
-        self._merged_handles = np.concatenate(parts)
-        return self._merged_handles
+
+
+class _Entry:
+    """Cache slot for one table: the current view + apply bookkeeping."""
+
+    __slots__ = ("version", "col_sig", "view", "lock", "delta_pos")
+
+    def __init__(self, version, col_sig, view):
+        self.version = version
+        self.col_sig = col_sig
+        self.view = view
+        self.lock = threading.Lock()     # serializes apply/compact
+        self.delta_pos: dict[int, tuple[int, int]] = {}  # handle->(seg,pos)
+
+    # passthroughs kept for tests/introspection
+    @property
+    def handles(self):
+        return self.view.handles
+
+    @property
+    def segs(self):
+        return self.view.segs
+
+    @property
+    def nrows(self):
+        return self.view.nrows
+
+    def delta_rows(self):
+        return self.view.delta_rows()
 
 
 class ColumnarCache:
@@ -152,9 +160,10 @@ class ColumnarCache:
         with self._lock:
             self._entries.pop(table_id, None)
 
-    def get(self, info: TableInfo, snapshot) -> _Entry | None:
-        """Materialized columns for the table at the current write watermark.
-        `snapshot` must be a kv view with .scan (Snapshot or Transaction).
+    def get(self, info: TableInfo, snapshot) -> _View | None:
+        """The table's materialized row set at the current write watermark,
+        as an immutable view. `snapshot` must be a kv read view with .scan
+        (Snapshot or Transaction).
 
         Returns None when the reader's snapshot ts predates the last commit
         the cache reflects (an explicit txn holding an old read view after
@@ -171,7 +180,7 @@ class ColumnarCache:
         with self._lock:
             e = self._entries.get(tid)
             if e is not None and e.version == version and e.col_sig == col_sig:
-                return e
+                return e.view
         # build from the caller's snapshot: reader_ts >= last_commit_ts, so
         # it sees exactly the content of `version` (a commit racing in is
         # invisible to this ts; if the version counter advanced meanwhile,
@@ -186,9 +195,9 @@ class ColumnarCache:
                 self._entries[tid] = e
             else:
                 e = cur
-        return e
+        return e.view
 
-    def _build(self, info, snapshot, version, col_sig):
+    def _build(self, info, snapshot, version, col_sig) -> _Entry:
         tbl = Table(info, snapshot)
         cols = info.public_columns()
         handles = []
@@ -198,14 +207,16 @@ class ColumnarCache:
             rowdicts.append(row)
         chunk = rows_to_chunk(info, cols, handles, rowdicts)
         columns = {c.id: chunk.columns[i] for i, c in enumerate(cols)}
-        return _Entry(version, col_sig, columns,
-                      np.array(handles, dtype=np.int64), len(handles))
+        view = _View(columns, np.array(handles, dtype=np.int64),
+                     None, (), len(handles))
+        return _Entry(version, col_sig, view)
 
     # -- delta maintenance (reference analog: TiFlash delta tree;
     #    v1 behavior was rebuild-on-invalidate) ------------------------------
 
     def apply_delta(self, info: TableInfo, muts, new_version: int):
-        """Apply one committed txn's record mutations.
+        """Apply one committed txn's record mutations by building the next
+        view copy-on-write (readers holding the old view are unaffected).
 
         muts: [(handle, encoded_row_bytes | None)] — None is a delete.
         new_version: the table version this commit produced; the entry must
@@ -222,57 +233,84 @@ class ColumnarCache:
                 self.invalidate(tid)
                 return
             try:
-                self._apply_locked(e, info, muts)
+                new_view = self._next_view(e, info, muts)
             except Exception:
                 self.invalidate(tid)
                 return
+            if new_view.delta_rows() > max(_COMPACT_MIN,
+                                           len(new_view.handles)
+                                           // _COMPACT_FRAC):
+                new_view = self._compact(new_view, col_sig)
+                e.delta_pos = {}
+            e.view = new_view
             e.version = new_version
-            if e.delta_rows() > max(_COMPACT_MIN,
-                                    len(e.handles) // _COMPACT_FRAC):
-                self._compact_locked(e, info)
 
-    def _apply_locked(self, e: _Entry, info: TableInfo, muts):
+    def _next_view(self, e: _Entry, info: TableInfo, muts) -> _View:
         from .. import tablecodec
+        v = e.view
+        base_live = v.base_live
+        base_copied = False
+        segs = list(v.segs)
+        seg_copied: set[int] = set()
+        nrows = v.nrows
+
+        def tombstone(h: int):
+            nonlocal base_live, base_copied, nrows
+            pos = e.delta_pos.pop(h, None)
+            if pos is not None:
+                si, i = pos
+                if segs[si].live[i]:
+                    if si not in seg_copied:
+                        s = segs[si]
+                        segs[si] = _Seg(s.handles, s.live.copy(), s.columns)
+                        seg_copied.add(si)
+                    segs[si].live[i] = False
+                    nrows -= 1
+                    return
+            i = int(np.searchsorted(v.handles, h))
+            if i < len(v.handles) and v.handles[i] == h:
+                if base_live is None:
+                    base_live = np.ones(len(v.handles), dtype=bool)
+                    base_copied = True
+                elif not base_copied:
+                    base_live = base_live.copy()
+                    base_copied = True
+                if base_live[i]:
+                    base_live[i] = False
+                    nrows -= 1
+
         up_handles, up_rows = [], []
         for h, val in muts:
-            e._tombstone(h)
+            tombstone(h)
             if val is not None:
                 up_handles.append(h)
                 up_rows.append(tablecodec.decode_row(val))
-        e._invalidate_merge()
-        if not up_handles:
-            return
-        cols = info.public_columns()
-        chunk = rows_to_chunk(info, cols, up_handles, up_rows)
-        seg_cols = {c.id: chunk.columns[i] for i, c in enumerate(cols)}
-        seg = _Seg(np.array(up_handles, dtype=np.int64),
-                   np.ones(len(up_handles), dtype=bool), seg_cols)
-        e.segs.append(seg)
-        si = len(e.segs) - 1
-        for i, h in enumerate(up_handles):
-            e.delta_pos[h] = (si, i)
-        e.nrows += len(up_handles)
+        if up_handles:
+            cols = info.public_columns()
+            chunk = rows_to_chunk(info, cols, up_handles, up_rows)
+            seg_cols = {c.id: chunk.columns[i] for i, c in enumerate(cols)}
+            segs.append(_Seg(np.array(up_handles, dtype=np.int64),
+                             np.ones(len(up_handles), dtype=bool), seg_cols))
+            si = len(segs) - 1
+            for i, h in enumerate(up_handles):
+                e.delta_pos[h] = (si, i)
+            nrows += len(up_handles)
+        return _View(v.columns, v.handles, base_live, tuple(segs), nrows)
 
-    def _compact_locked(self, e: _Entry, info: TableInfo):
+    @staticmethod
+    def _compact(view: _View, col_sig) -> _View:
         """Merge delta into a new handle-sorted base (memcpy-level: no row
-        decode). Restores the sorted-handles invariant _tombstone relies on."""
-        handles = e.merged_handles()
+        decode). Restores the sorted-handles invariant tombstone relies on."""
+        handles = view.merged_handles()
         order = np.argsort(handles, kind="stable")
         new_cols = {}
-        for cid in e.col_sig:
-            col = e.merged_column(cid, lambda _cid: None)
+        for cid in col_sig:
+            col = view.merged_column(cid)
             if col is None:
                 continue  # base predates this column; project() defaults it
             new_cols[cid] = Column(col.ftype, col.data[order],
                                    col.nulls[order])
-        e.handles = handles[order]
-        e.columns = new_cols
-        e.base_live = None
-        e.base_all_live = True
-        e.segs = []
-        e.delta_pos = {}
-        e.nrows = len(e.handles)
-        e._invalidate_merge()
+        return _View(new_cols, handles[order], None, (), len(handles))
 
     def install_bulk(self, info: TableInfo, columns: dict, handles: np.ndarray):
         """Bulk-load path (the Lightning physical-import role): install
@@ -280,25 +318,24 @@ class ColumnarCache:
         tid = info.id
         version = self.storage.mvcc.table_version(tid)
         col_sig = tuple(c.id for c in info.public_columns())
-        e = _Entry(version, col_sig, columns, handles, len(handles))
+        e = _Entry(version, col_sig,
+                   _View(columns, handles, None, (), len(handles)))
         with self._lock:
             self._entries[tid] = e
-        return e
+        return e.view
 
-    def project(self, entry: _Entry, col_infos, info: TableInfo) -> Chunk:
+    def project(self, view: _View, col_infos, info: TableInfo) -> Chunk:
         out = []
-        with entry.lock:  # per-entry: scans of other tables stay parallel
-            for c in col_infos:
-                col = entry.merged_column(c.id, lambda cid: None)
-                if col is None:
-                    # column added after materialization: all default/null
-                    col = _default_column(c, entry.nrows)
-                out.append(col)
+        for c in col_infos:
+            col = view.merged_column(c.id)
+            if col is None:
+                # column added after materialization: all default/null
+                col = _default_column(c, view.nrows)
+            out.append(col)
         return Chunk(out)
 
-    def handle_column(self, entry: _Entry) -> Column:
-        with entry.lock:
-            h = entry.merged_handles()
+    def handle_column(self, view: _View) -> Column:
+        h = view.merged_handles()
         return Column(FieldType(tp=TYPE_LONGLONG),
                       h, np.zeros(len(h), dtype=bool))
 

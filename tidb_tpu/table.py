@@ -198,10 +198,10 @@ class Table:
                 raise DupEntryError(
                     "Duplicate entry '%s' for key '%s'" % (
                         "-".join(_dup_str(v) for v in vals), idx.name))
-            self.txn.put(key, str(handle).encode())
+            self.txn.put(key, tablecodec.encode_index_handle(handle))
         else:
             key = tablecodec.index_key(self.info.id, idx.id, vals, handle=handle)
-            self.txn.put(key, b"0")
+            self.txn.put(key, tablecodec.INDEX_VALUE_MARKER)
 
     def _index_delete(self, idx, row, handle):
         vals = self._index_values(idx, row)
@@ -254,7 +254,7 @@ class Table:
         """Unique-index point lookup -> handle or None."""
         key = tablecodec.index_key(self.info.id, idx.id, values)
         v = self.txn.get(key)
-        return int(v) if v is not None else None
+        return tablecodec.decode_index_handle(v) if v is not None else None
 
     def index_scan_handles(self, idx, lo_vals=None, hi_vals=None):
         """Range scan on an index -> [handle] in index order."""
@@ -267,10 +267,9 @@ class Table:
             end = tablecodec.index_prefix(tid, idx.id) + b"\xff" * 16
         out = []
         for key, value in self.txn.scan(start, end):
-            if idx.unique and value != b"0":
-                out.append(int(value))
-            else:
-                out.append(tablecodec.decode_index_values(key)[-1])
+            h = tablecodec.decode_index_handle(value)
+            out.append(h if h is not None
+                       else tablecodec.decode_index_values(key)[-1])
         return out
 
     def scan_columnar(self, col_infos=None, with_handle=False):

@@ -49,6 +49,24 @@ def decode_record_key(key: bytes):
     return _dec_i64(key[1:9]), _dec_i64(key[11:19])
 
 
+#: unique-index entries (no handle in the key) store the handle in the value
+#: as b"u<decimal>"; handle-suffixed entries store the b"0" marker. The "u"
+#: tag disambiguates handle 0 from the marker (reference: tablecodec encodes
+#: the handle as a fixed 8-byte value — same role, printable here).
+INDEX_VALUE_MARKER = b"0"
+
+
+def encode_index_handle(handle: int) -> bytes:
+    return b"u%d" % handle
+
+
+def decode_index_handle(value: bytes):
+    """-> handle int for a unique entry value, None for the b"0" marker."""
+    if value[:1] == b"u":
+        return int(value[1:])
+    return None
+
+
 def index_prefix(table_id: int, index_id: int) -> bytes:
     return TABLE_PREFIX + _enc_i64(table_id) + INDEX_SEP + _enc_i64(index_id)
 
