@@ -188,20 +188,23 @@ class DDLExecutor:
 
     def enqueue_job(self, job_type, schema_id=0, table_id=0, args=None) -> Job:
         """Enqueue a job for the async worker (reference: ddl.go:551
-        doDDLJob's enqueue half)."""
+        doDDLJob's enqueue half). Under the domain DDL lock: the queue is
+        one meta KV key also rewritten by the worker's transition/batch
+        txns — unserialized writers would abort each other on conflict."""
         store = self.session.store
-        txn = store.begin()
-        try:
-            m = Meta(txn)
-            job = Job(id=m.gen_job_id(), type=job_type, schema_id=schema_id,
-                      table_id=table_id, args=args or {},
-                      start_ts=txn.start_ts)
-            m.enqueue_job(job)
-            txn.commit()
-        except Exception:
-            txn.rollback()
-            raise
-        return job
+        with self.session.domain.ddl_lock:
+            txn = store.begin()
+            try:
+                m = Meta(txn)
+                job = Job(id=m.gen_job_id(), type=job_type,
+                          schema_id=schema_id, table_id=table_id,
+                          args=args or {}, start_ts=txn.start_ts)
+                m.enqueue_job(job)
+                txn.commit()
+            except Exception:
+                txn.rollback()
+                raise
+            return job
 
     def drop_index(self, stmt: ast.DropIndexStmt):
         sess = self.session

@@ -242,7 +242,13 @@ class DDLWorker:
             if idx.state == SchemaState.WRITE_REORG:
                 txn.rollback()  # backfill batches run their own txns
                 return self._backfill_batch(job, t, idx)
-            txn.rollback()
+            # unexpected state (e.g. a racing CREATE INDEX already drove an
+            # index of this name to PUBLIC): the job MUST leave the queue,
+            # or run_pending would peek it forever
+            self._cancel_locked(
+                m, job, f"Duplicate key name '{name}'")
+            txn.commit()
+            self.domain.reload_schema()
             return True
         except Exception:
             if txn.valid:

@@ -205,6 +205,7 @@ class Session:
         self.ddl = DDLExecutor(self)
         self.current_sql: str | None = None   # processlist info
         self.stmt_start = 0.0
+        self.mem_tracker = None               # per-statement quota tracker
         domain.sessions[self.conn_id] = self
 
     def close(self):
@@ -438,6 +439,14 @@ class Session:
             sql = type(stmt).__name__
         self.current_sql = sql
         self.stmt_start = time.time()
+        # per-statement memory quota (reference: stmtctx MemTracker under
+        # the session tracker; tidb_mem_quota_query)
+        from ..utils.memory import MemTracker
+        try:
+            quota = int(self.get_sysvar("tidb_mem_quota_query"))
+        except Exception:
+            quota = 0
+        self.mem_tracker = MemTracker(f"conn{self.conn_id}", quota)
         res = None
         try:
             res = self._dispatch(stmt)

@@ -122,16 +122,27 @@ class _View:
 
 
 class _Entry:
-    """Cache slot for one table: the current view + apply bookkeeping."""
+    """Cache slot for one table: the current (version, view) pair + apply
+    bookkeeping. The pair is published as ONE tuple reference (`vv`): a
+    reader loading it can never observe a new view with the old version —
+    that mismatch would pass get()'s version check while leaking the next
+    commit's rows."""
 
-    __slots__ = ("version", "col_sig", "view", "lock", "delta_pos")
+    __slots__ = ("vv", "col_sig", "lock", "delta_pos")
 
     def __init__(self, version, col_sig, view):
-        self.version = version
+        self.vv = (version, view)        # atomic ref swap on publish
         self.col_sig = col_sig
-        self.view = view
         self.lock = threading.Lock()     # serializes apply/compact
         self.delta_pos: dict[int, tuple[int, int]] = {}  # handle->(seg,pos)
+
+    @property
+    def version(self):
+        return self.vv[0]
+
+    @property
+    def view(self):
+        return self.vv[1]
 
     # passthroughs kept for tests/introspection
     @property
@@ -179,8 +190,10 @@ class ColumnarCache:
         col_sig = tuple(c.id for c in info.public_columns())
         with self._lock:
             e = self._entries.get(tid)
-            if e is not None and e.version == version and e.col_sig == col_sig:
-                return e.view
+            if e is not None:
+                ever, eview = e.vv  # one load: version+view are consistent
+                if ever == version and e.col_sig == col_sig:
+                    return eview
         # build from the caller's snapshot: reader_ts >= last_commit_ts, so
         # it sees exactly the content of `version` (a commit racing in is
         # invisible to this ts; if the version counter advanced meanwhile,
@@ -242,8 +255,7 @@ class ColumnarCache:
                                            // _COMPACT_FRAC):
                 new_view = self._compact(new_view, col_sig)
                 e.delta_pos = {}
-            e.view = new_view
-            e.version = new_version
+            e.vv = (new_version, new_view)  # atomic publish
 
     def _next_view(self, e: _Entry, info: TableInfo, muts) -> _View:
         from .. import tablecodec

@@ -1,0 +1,96 @@
+"""Hierarchical memory tracking with an action chain (reference:
+util/memory/tracker.go:54 — session→statement→operator trackers — and
+util/memory/action.go — on quota breach run spill actions, then cancel).
+
+Executors consume approximate chunk bytes into the statement tracker.
+Crossing the quota first runs registered spill actions (operators that can
+move state to disk); if the overshoot persists, the query is cancelled with
+the reference's "Out Of Memory Quota!" error."""
+
+from __future__ import annotations
+
+import threading
+
+from ..errors import TiDBError
+
+
+class MemQuotaExceeded(TiDBError):
+    pass
+
+
+class MemTracker:
+    """One node of the tracker tree. consume() bubbles to the root; any
+    ancestor with a limit enforces it."""
+
+    def __init__(self, label: str, limit: int = 0, parent: "MemTracker | None" = None):
+        self.label = label
+        self.limit = limit            # 0 = unlimited
+        self.parent = parent
+        self.consumed = 0
+        self.max_consumed = 0
+        self._actions = []            # [(priority, fn)] fn() -> freed bytes
+        self._lock = threading.Lock()
+
+    def child(self, label: str, limit: int = 0) -> "MemTracker":
+        return MemTracker(label, limit, parent=self)
+
+    def register_spill(self, fn, priority: int = 0):
+        """fn() -> bytes freed. Higher priority runs first (reference:
+        actionForSpill before actionForHardLimit)."""
+        with self._lock:
+            self._actions.append((priority, fn))
+            self._actions.sort(key=lambda p: -p[0])
+
+    def unregister_spill(self, fn):
+        with self._lock:
+            self._actions = [(p, f) for p, f in self._actions if f is not fn]
+
+    def consume(self, n: int):
+        node = self
+        while node is not None:
+            with node._lock:
+                node.consumed += n
+                node.max_consumed = max(node.max_consumed, node.consumed)
+            if node.limit and node.consumed > node.limit:
+                node._on_exceed()
+            node = node.parent
+
+    def release(self, n: int):
+        self.consume(-n)
+
+    def _on_exceed(self):
+        # 1. spill actions anywhere in the subtree may free memory
+        for _prio, fn in list(self._actions):
+            if self.consumed <= self.limit:
+                return
+            try:
+                freed = fn() or 0
+            except MemQuotaExceeded:
+                raise
+            except Exception:
+                freed = 0
+            if freed:
+                self.release(freed)
+        if self.consumed > self.limit:
+            # 2. cancel (reference: PanicOnExceed / action.go)
+            raise MemQuotaExceeded(
+                f"Out Of Memory Quota! [{self.label}] consumed "
+                f"{self.consumed} bytes, quota {self.limit} bytes")
+
+    def remaining(self) -> int:
+        if not self.limit:
+            return 1 << 62
+        return max(self.limit - self.consumed, 0)
+
+
+def approx_chunk_bytes(chunk) -> int:
+    """Cheap per-chunk estimate (exact byte-walks over object columns are
+    O(rows) Python work — too hot for per-operator tracking)."""
+    total = 0
+    for c in chunk.columns:
+        if c.data.dtype == object:
+            total += 48 * len(c.data)  # pointer + typical small bytes
+        else:
+            total += c.data.nbytes
+        total += c.nulls.nbytes
+    return total
