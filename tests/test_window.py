@@ -1,0 +1,197 @@
+"""Window function execution (reference: executor/window.go; default frame
+semantics: with ORDER BY = RANGE UNBOUNDED PRECEDING..CURRENT ROW, peers
+included; without = whole partition)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table w (id int primary key, g varchar(8), v int)")
+    rows = [(1, "a", 10), (2, "a", 20), (3, "a", 20), (4, "a", 40),
+            (5, "b", 5), (6, "b", 15), (7, "c", 7)]
+    vals = ",".join(f"({i},'{g}',{v})" for i, g, v in rows)
+    tk.must_exec(f"insert into w values {vals}")
+    return tk
+
+
+def test_row_number(tk):
+    tk.must_query(
+        "select id, row_number() over (partition by g order by v, id) "
+        "from w order by id").check([
+            ("1", "1"), ("2", "2"), ("3", "3"), ("4", "4"),
+            ("5", "1"), ("6", "2"), ("7", "1")])
+
+
+def test_rank_and_dense_rank_with_ties(tk):
+    tk.must_query(
+        "select id, rank() over (partition by g order by v), "
+        "dense_rank() over (partition by g order by v) "
+        "from w order by id").check([
+            ("1", "1", "1"), ("2", "2", "2"), ("3", "2", "2"),
+            ("4", "4", "3"), ("5", "1", "1"), ("6", "2", "2"),
+            ("7", "1", "1")])
+
+
+def test_running_sum_peer_aware(tk):
+    # ties (v=20 twice in partition a) are peers: both rows see the sum
+    # through the end of the peer group
+    tk.must_query(
+        "select id, sum(v) over (partition by g order by v) "
+        "from w order by id").check([
+            ("1", "10"), ("2", "50"), ("3", "50"), ("4", "90"),
+            ("5", "5"), ("6", "20"), ("7", "7")])
+
+
+def test_partition_aggregate_without_order(tk):
+    tk.must_query(
+        "select id, sum(v) over (partition by g), "
+        "count(*) over (partition by g) from w order by id").check([
+            ("1", "90", "4"), ("2", "90", "4"), ("3", "90", "4"),
+            ("4", "90", "4"), ("5", "20", "2"), ("6", "20", "2"),
+            ("7", "7", "1")])
+
+
+def test_global_window_no_partition(tk):
+    tk.must_query(
+        "select id, count(*) over () from w where id <= 3 order by id"
+    ).check([("1", "3"), ("2", "3"), ("3", "3")])
+
+
+def test_lead_lag(tk):
+    tk.must_query(
+        "select id, lag(v) over (partition by g order by id), "
+        "lead(v, 1, -1) over (partition by g order by id) "
+        "from w order by id").check([
+            ("1", None, "20"), ("2", "10", "20"), ("3", "20", "40"),
+            ("4", "20", "-1"), ("5", None, "15"), ("6", "5", "-1"),
+            ("7", None, "-1")])
+
+
+def test_first_last_value(tk):
+    tk.must_query(
+        "select id, first_value(v) over (partition by g order by id), "
+        "last_value(v) over (partition by g) from w order by id").check([
+            ("1", "10", "40"), ("2", "10", "40"), ("3", "10", "40"),
+            ("4", "10", "40"), ("5", "5", "15"), ("6", "5", "15"),
+            ("7", "7", "7")])
+
+
+def test_min_max_running(tk):
+    tk.must_query(
+        "select id, min(v) over (partition by g order by id), "
+        "max(v) over (partition by g order by id) from w order by id"
+    ).check([
+        ("1", "10", "10"), ("2", "10", "20"), ("3", "10", "20"),
+        ("4", "10", "40"), ("5", "5", "5"), ("6", "5", "15"),
+        ("7", "7", "7")])
+
+
+def test_ntile(tk):
+    tk.must_query(
+        "select id, ntile(2) over (order by id) from w order by id").check([
+            ("1", "1"), ("2", "1"), ("3", "1"), ("4", "1"),
+            ("5", "2"), ("6", "2"), ("7", "2")])
+
+
+def test_avg_window(tk):
+    r = tk.must_query(
+        "select id, avg(v) over (partition by g) from w "
+        "where g = 'b' order by id")
+    assert [row[1] for row in r.rows] == ["10", "10"]
+
+
+def test_window_over_aggregate(tk):
+    """Windows evaluate over the grouped rows (SQL eval order)."""
+    tk.must_query(
+        "select g, sum(v), rank() over (order by sum(v) desc) "
+        "from w group by g order by g").check([
+            ("a", "90", "1"), ("b", "20", "2"), ("c", "7", "3")])
+
+
+def test_window_in_expression(tk):
+    tk.must_query(
+        "select id, row_number() over (order by id) * 10 from w "
+        "where id <= 2 order by id").check([("1", "10"), ("2", "20")])
+
+
+def test_multiple_specs_stack(tk):
+    tk.must_query(
+        "select id, row_number() over (partition by g order by id), "
+        "count(*) over () from w where id >= 6 order by id").check([
+            ("6", "1", "2"), ("7", "1", "2")])
+
+
+def test_window_explain_shows_node(tk):
+    rows = tk.must_query(
+        "explain select row_number() over (order by v) from w").rows
+    assert any("Window" in r[0] for r in rows)
+
+
+def test_rows_frame_sliding_sum(tk):
+    tk.must_query(
+        "select id, sum(v) over (order by id rows between 1 preceding "
+        "and current row) from w where g = 'a' order by id").check([
+            ("1", "10"), ("2", "30"), ("3", "40"), ("4", "60")])
+
+
+def test_rows_frame_centered(tk):
+    tk.must_query(
+        "select id, count(*) over (order by id rows between 1 preceding "
+        "and 1 following) from w where g = 'a' order by id").check([
+            ("1", "2"), ("2", "3"), ("3", "3"), ("4", "2")])
+
+
+def test_rows_frame_whole_partition_range(tk):
+    tk.must_query(
+        "select id, sum(v) over (partition by g order by id range between "
+        "unbounded preceding and unbounded following) from w order by id"
+    ).check([("1", "90"), ("2", "90"), ("3", "90"), ("4", "90"),
+             ("5", "20"), ("6", "20"), ("7", "7")])
+
+
+def test_rows_frame_first_last_value(tk):
+    tk.must_query(
+        "select id, first_value(v) over (order by id rows between "
+        "1 preceding and current row), last_value(v) over (order by id "
+        "rows between current row and 1 following) "
+        "from w where g = 'a' order by id").check([
+            ("1", "10", "20"), ("2", "10", "20"),
+            ("3", "20", "40"), ("4", "20", "40")])
+
+
+def test_range_offset_frame_rejected(tk):
+    e = tk.exec_error(
+        "select sum(v) over (order by id range between 1 preceding "
+        "and current row) from w")
+    assert "RANGE frames" in str(e)
+
+
+def test_ntile_zero_rejected(tk):
+    e = tk.exec_error("select ntile(0) over (order by id) from w")
+    assert "Incorrect arguments" in str(e)
+
+
+def test_nth_value_zero_rejected(tk):
+    e = tk.exec_error("select nth_value(v, 0) over (order by id) from w")
+    assert "Incorrect arguments" in str(e)
+
+
+def test_frames_distinct_in_dedup(tk):
+    """Same function text with different frames must produce different
+    columns."""
+    tk.must_query(
+        "select sum(v) over (order by id rows between 1 preceding and "
+        "current row), sum(v) over (order by id rows between current row "
+        "and 1 following) from w where g = 'b' order by id").check([
+            ("5", "20"), ("20", "15")])
+
+
+def test_explain_analyze_streamed_child_stats(tk):
+    rows = tk.must_query(
+        "explain analyze select v from w order by v").rows
+    scan = next(r for r in rows if "TableScan" in r[0])
+    assert scan[1].isdigit() and int(scan[1]) == 7

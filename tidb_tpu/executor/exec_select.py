@@ -779,8 +779,240 @@ class SetOpExec(QueryExecutor):
 
 
 class WindowExec(QueryExecutor):
+    """Window functions (reference: executor/window.go). Rows sort by
+    (partition, order); functions compute vectorized within each partition
+    slice over the default frame: with ORDER BY, RANGE UNBOUNDED PRECEDING
+    .. CURRENT ROW (peer-aware); without, the whole partition."""
+
     def execute(self):
-        raise TiDBError("window functions not supported yet")
+        p = self.plan
+        chunk = self.children[0].execute()
+        n = chunk.num_rows
+        if n == 0:
+            cols = list(chunk.columns)
+            for f in p.funcs:
+                dt = np_dtype_for(f.ftype)
+                data = (np.empty(0, dtype=object) if dt is object
+                        else np.zeros(0, dtype=dt))
+                cols.append(Column(f.ftype, data, np.zeros(0, dtype=bool)))
+            return Chunk(cols)
+        if p.partition_exprs:
+            pk = [e.eval(chunk) for e in p.partition_exprs]
+            gids, _ng, _fi = host.group_ids(pk)
+        else:
+            gids = np.zeros(n, dtype=np.int64)
+        order_keys = [(e.eval(chunk), d) for e, d in p.order_by]
+        keys = [(gids, np.zeros(n, dtype=bool))] + [k for k, _ in order_keys]
+        descs = [False] + [d for _, d in order_keys]
+        idx = host.sort_indices(keys, descs)
+        sgids = gids[idx]
+        starts = np.nonzero(np.r_[True, sgids[1:] != sgids[:-1]])[0]
+        bounds = np.r_[starts, n]
+        # peer-group change flags (equal order keys are peers)
+        peer_change = np.r_[True, sgids[1:] != sgids[:-1]]
+        for (data, nulls), _d in order_keys:
+            ds, ns = data[idx], nulls[idx]
+            peer_change[1:] |= (ds[1:] != ds[:-1]) | (ns[1:] != ns[:-1])
+        inv = np.empty(n, dtype=np.int64)
+        inv[idx] = np.arange(n)
+        out_cols = list(chunk.columns)
+        has_order = bool(order_keys)
+        for f in p.funcs:
+            vals, nulls = _window_func(f, chunk, idx, bounds, peer_change,
+                                       has_order)
+            out_cols.append(Column(f.ftype, vals[inv], nulls[inv]))
+        return Chunk(out_cols)
+
+
+def _frame_edges(frame, m, pos):
+    """Per-row [start, end] row indexes for an explicit ROWS frame, plus an
+    empty-frame mask (e.g. 2 PRECEDING AND 1 PRECEDING at row 0)."""
+    _unit, lo, hi = frame
+
+    def edge(b):
+        kind, nn = b
+        if kind == "unbounded_preceding":
+            return np.zeros(m, dtype=np.int64)
+        if kind == "unbounded_following":
+            return np.full(m, m - 1, dtype=np.int64)
+        if kind == "current":
+            return pos
+        if kind == "preceding":
+            return pos - nn
+        return pos + nn
+
+    s_raw, e_raw = edge(lo), edge(hi)
+    empty = (e_raw < s_raw) | (e_raw < 0) | (s_raw > m - 1)
+    return (np.clip(s_raw, 0, m - 1), np.clip(e_raw, 0, m - 1), empty)
+
+
+def _window_func(f, chunk, idx, bounds, peer_change, has_order):
+    """Compute one window function in sorted order → (vals, nulls) arrays
+    parallel to idx. Vectorized within each partition slice."""
+    n = len(idx)
+    name = f.name
+    args = []
+    for a in f.args:
+        d, nl = a.eval(chunk)
+        if len(d) != n:  # scalar constants broadcast
+            d = np.broadcast_to(d, (n,)) if len(d) == 1 else np.resize(d, n)
+            nl = np.broadcast_to(nl, (n,)) if len(nl) == 1 else np.resize(nl, n)
+        args.append((np.asarray(d)[idx], np.asarray(nl)[idx]))
+    dt = np_dtype_for(f.ftype)
+    out = (np.empty(n, dtype=object) if dt is object
+           else np.zeros(n, dtype=dt))
+    if dt is object:
+        out[:] = b""
+    out_nulls = np.zeros(n, dtype=bool)
+
+    def const_int(i, default):
+        if len(f.args) <= i:
+            return default
+        d, nl = args[i]
+        return default if (len(d) == 0 or nl[0]) else int(d[0])
+
+    for pi in range(len(bounds) - 1):
+        lo, hi = int(bounds[pi]), int(bounds[pi + 1])
+        m = hi - lo
+        pc = peer_change[lo:hi].copy()
+        pc[0] = True
+        pg = np.cumsum(pc) - 1
+        pe = np.searchsorted(pg, pg, side="right") - 1  # peer-group end
+        pos = np.arange(m)
+        if name == "row_number":
+            out[lo:hi] = pos + 1
+        elif name == "rank":
+            out[lo:hi] = np.searchsorted(pg, pg, side="left") + 1
+        elif name == "dense_rank":
+            out[lo:hi] = pg + 1
+        elif name == "percent_rank":
+            first = np.searchsorted(pg, pg, side="left")
+            out[lo:hi] = first / (m - 1) if m > 1 else np.zeros(m)
+        elif name == "cume_dist":
+            out[lo:hi] = (pe + 1) / m
+        elif name == "ntile":
+            k = const_int(0, 1)
+            if k < 1:
+                raise TiDBError("Incorrect arguments to ntile")
+            q, r = divmod(m, k)
+            if q == 0:
+                out[lo:hi] = pos + 1
+            else:
+                cut = r * (q + 1)
+                out[lo:hi] = np.where(
+                    pos < cut, pos // (q + 1), r + (pos - cut) // q) + 1
+        elif name in ("lead", "lag"):
+            d, nl = args[0]
+            d, nl = d[lo:hi], nl[lo:hi]
+            off = const_int(1, 1)
+            src = pos + off if name == "lead" else pos - off
+            ok = (src >= 0) & (src < m)
+            safe = np.clip(src, 0, m - 1)
+            if len(f.args) > 2:
+                dd, dn = args[2]
+                out[lo:hi] = np.where(ok, d[safe], dd[lo:hi])
+                out_nulls[lo:hi] = np.where(ok, nl[safe], dn[lo:hi])
+            else:
+                out[lo:hi] = np.where(ok, d[safe], out[lo:hi])
+                out_nulls[lo:hi] = np.where(ok, nl[safe], True)
+        elif name == "first_value":
+            d, nl = args[0]
+            if f.frame is not None:
+                ds, ns = d[lo:hi], nl[lo:hi]
+                fs, _fe, emp = _frame_edges(f.frame, m, pos)
+                out[lo:hi] = ds[fs]
+                out_nulls[lo:hi] = ns[fs] | emp
+            else:
+                out[lo:hi] = d[lo]
+                out_nulls[lo:hi] = nl[lo]
+        elif name == "last_value":
+            d, nl = args[0]
+            d, nl = d[lo:hi], nl[lo:hi]
+            if f.frame is not None:
+                _fs, fe, emp = _frame_edges(f.frame, m, pos)
+                out[lo:hi] = d[fe]
+                out_nulls[lo:hi] = nl[fe] | emp
+            else:
+                src = pe if has_order else np.full(m, m - 1)
+                out[lo:hi] = d[src]
+                out_nulls[lo:hi] = nl[src]
+        elif name == "nth_value":
+            d, nl = args[0]
+            d, nl = d[lo:hi], nl[lo:hi]
+            k = const_int(1, 1)
+            if k < 1:
+                raise TiDBError("Incorrect arguments to nth_value")
+            if f.frame is not None:
+                fs, fe, emp = _frame_edges(f.frame, m, pos)
+                tgt = fs + (k - 1)
+                ok = ~emp & (tgt <= fe)
+                safe = np.clip(tgt, 0, m - 1)
+                out[lo:hi] = np.where(ok, d[safe], out[lo:hi])
+                out_nulls[lo:hi] = np.where(ok, nl[safe], True)
+            else:
+                end = pe if has_order else np.full(m, m - 1)
+                ok = (k - 1) <= end
+                src = min(k - 1, m - 1)
+                out[lo:hi] = np.where(ok, d[src], out[lo:hi])
+                out_nulls[lo:hi] = np.where(ok, nl[src], True)
+        elif name in ("count", "sum", "avg"):
+            d, nl = args[0]
+            d, nl = d[lo:hi], nl[lo:hi]
+            k = phys_kind(f.args[0].ftype)
+            if name == "avg" or k == K_FLOAT or k == K_STR:
+                from ..expression.core import _as_float
+                vals = np.where(nl, 0.0, _as_float(d, f.args[0].ftype))
+            else:
+                vals = np.where(nl, 0, d.astype(np.int64))
+            cs0 = np.concatenate([[vals.dtype.type(0)], np.cumsum(vals)])
+            cnt0 = np.concatenate([[0], np.cumsum(~nl)])
+            if f.frame is not None:
+                fs, fe, emp = _frame_edges(f.frame, m, pos)
+                total = cs0[fe + 1] - cs0[fs]
+                nonnull = cnt0[fe + 1] - cnt0[fs]
+                nonnull = np.where(emp, 0, nonnull)
+                total = np.where(emp, 0, total)
+            else:
+                at = pe if has_order else np.full(m, m - 1)
+                total, nonnull = cs0[at + 1], cnt0[at + 1]
+            if name == "count":
+                out[lo:hi] = nonnull
+            elif name == "avg":
+                out[lo:hi] = total / np.maximum(nonnull, 1)
+                out_nulls[lo:hi] = nonnull == 0
+            else:
+                out[lo:hi] = total
+                out_nulls[lo:hi] = nonnull == 0
+        elif name in ("min", "max"):
+            d, nl = args[0]
+            d, nl = d[lo:hi], nl[lo:hi]
+            at = pe if has_order else np.full(m, m - 1)
+            cnt = np.cumsum(~nl)
+            if d.dtype == object:
+                run = np.empty(m, dtype=object)
+                best = None
+                for i in range(m):
+                    v = None if nl[i] else d[i]
+                    if v is not None and (best is None or
+                                          (v < best if name == "min"
+                                           else v > best)):
+                        best = v
+                    run[i] = best if best is not None else b""
+                out[lo:hi] = run[at]
+            else:
+                if np.issubdtype(d.dtype, np.floating):
+                    sent = np.inf if name == "min" else -np.inf
+                else:
+                    info = np.iinfo(d.dtype)
+                    sent = info.max if name == "min" else info.min
+                masked = np.where(nl, sent, d)
+                acc = (np.minimum.accumulate(masked) if name == "min"
+                       else np.maximum.accumulate(masked))
+                out[lo:hi] = acc[at]
+            out_nulls[lo:hi] = cnt[at] == 0
+        else:
+            raise TiDBError(f"unsupported window function {name}")
+    return out, out_nulls
 
 
 _MAP = {

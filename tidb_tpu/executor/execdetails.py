@@ -76,10 +76,12 @@ class RuntimeStatsColl:
 
 
 def timed_execute(exe, stats: RuntimeStatsColl):
-    """Wrap an executor instance's execute() to record inclusive wall time
-    + output rows (TiDB's EXPLAIN ANALYZE `time` is likewise inclusive of
-    children)."""
+    """Wrap an executor instance's execute() (and execute_stream(): the
+    sort/topN consumers pull children chunk-at-a-time and would otherwise
+    bypass the wrapper) to record inclusive wall time + output rows (TiDB's
+    EXPLAIN ANALYZE `time` is likewise inclusive of children)."""
     inner = exe.execute
+    inner_stream = exe.execute_stream
 
     def run():
         t0 = time.perf_counter()
@@ -89,4 +91,17 @@ def timed_execute(exe, stats: RuntimeStatsColl):
         stats.record(exe.plan, chunk.num_rows, el, mem)
         return chunk
 
+    def run_stream(batch_rows):
+        it = inner_stream(batch_rows)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                chunk = next(it)
+            except StopIteration:
+                return
+            el = time.perf_counter() - t0
+            stats.record(exe.plan, chunk.num_rows, el)
+            yield chunk
+
+    exe.execute_stream = run_stream
     return run

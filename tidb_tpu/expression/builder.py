@@ -525,6 +525,13 @@ class ExprBuilder:
                         code=ErrCode.InvalidGroupFuncUse)
 
     def _b_WindowFunc(self, node):
+        # the planner's window stage registers each OVER() expression's
+        # output column here (planner/builder.py _build_window)
+        wm = getattr(self, "window_map", None)
+        if wm is not None:
+            col = wm.get(node.restore())
+            if col is not None:
+                return col
         raise TiDBError("window function not valid here")
 
     def _b_IntervalExpr(self, node):

@@ -215,6 +215,18 @@ class WindowFunc(ExprNode):
             s += "PARTITION BY " + ", ".join(e.restore() for e in self.partition_by)
         if self.order_by:
             s += " ORDER BY " + ", ".join(b.restore() for b in self.order_by)
+        if self.frame is not None:
+            # frame participates in dedup: same func text with different
+            # frames must NOT share one window output column
+            unit, lo, hi = self.frame
+            def bnd(b):
+                kind, n = b
+                return {"unbounded_preceding": "UNBOUNDED PRECEDING",
+                        "unbounded_following": "UNBOUNDED FOLLOWING",
+                        "current": "CURRENT ROW",
+                        "preceding": f"{n} PRECEDING",
+                        "following": f"{n} FOLLOWING"}[kind]
+            s += f" {unit.upper()} BETWEEN {bnd(lo)} AND {bnd(hi)}"
         return s + ")"
 
 

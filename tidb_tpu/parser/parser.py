@@ -931,18 +931,36 @@ class Parser:
         if self._accept_kw("order"):
             self._expect_kw("by")
             wf.order_by = self._parse_by_items()
-        # frame spec: ROWS/RANGE BETWEEN ... — parse & discard tokens up to ")"
-        depth = 0
-        while not (depth == 0 and self._peek_op(")")):
-            if self._peek_op("("):
-                depth += 1
-            elif self._peek_op(")"):
-                depth -= 1
-            elif self._cur().kind == EOF:
-                raise ParseError("unterminated OVER clause")
+        if self._peek_kw("rows") or self._peek_kw("range"):
+            unit = self._cur().val.lower()
             self.pos += 1
+            if self._accept_kw("between"):
+                lo = self._parse_frame_bound()
+                self._expect_kw("and")
+                hi = self._parse_frame_bound()
+            else:
+                lo = self._parse_frame_bound()
+                hi = ("current", 0)
+            wf.frame = (unit, lo, hi)
         self._expect_op(")")
         return wf
+
+    def _parse_frame_bound(self):
+        """-> (kind, n): unbounded_preceding | preceding | current |
+        following | unbounded_following."""
+        if self._accept_kw("unbounded"):
+            if self._accept_kw("preceding"):
+                return ("unbounded_preceding", 0)
+            self._expect_kw("following")
+            return ("unbounded_following", 0)
+        if self._accept_kw("current"):
+            self._expect_kw("row")
+            return ("current", 0)
+        n = self._int_lit()
+        if self._accept_kw("preceding"):
+            return ("preceding", n)
+        self._expect_kw("following")
+        return ("following", n)
 
     def _parse_cast_type(self) -> FieldType:
         name = self._ident().lower()

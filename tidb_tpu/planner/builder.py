@@ -39,6 +39,60 @@ def collect_aggs(node, out):
         collect_aggs(child, out)
 
 
+def _window_ftype(name, args):
+    """Output type per window function (reference:
+    expression/aggregation/window_func.go)."""
+    from ..sqltypes import TYPE_DOUBLE
+    if name in ("row_number", "rank", "dense_rank", "ntile", "count"):
+        return FieldType(tp=TYPE_LONGLONG)
+    if name in ("percent_rank", "cume_dist", "avg"):
+        return FieldType(tp=TYPE_DOUBLE)
+    if name in ("lead", "lag", "first_value", "last_value", "nth_value",
+                "min", "max"):
+        if not args:
+            raise TiDBError(f"window function {name} requires an argument")
+        return args[0].ftype
+    if name == "sum":
+        return AggFuncDesc("sum", [args[0]]).ftype
+    raise TiDBError(f"unsupported window function {name}")
+
+
+_RANKERS = {"row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+            "ntile", "lead", "lag"}
+
+
+def _normalize_frame(frame, name):
+    """Validate an explicit frame clause. Default frame → None; explicit
+    ROWS frames are executed; RANGE frames with offsets are rejected rather
+    than silently computed with default-frame semantics."""
+    if frame is None or name in _RANKERS:  # rankers ignore frames (SQL std)
+        return None
+    unit, lo, hi = frame
+    if (lo, hi) == (("unbounded_preceding", 0), ("current", 0)):
+        return None  # the default frame
+    if unit == "range":
+        if (lo, hi) == (("unbounded_preceding", 0),
+                        ("unbounded_following", 0)):
+            return ("rows", lo, hi)  # whole partition: unit-independent
+        raise TiDBError("RANGE frames with offsets are not supported yet")
+    if name in ("min", "max"):
+        raise TiDBError(f"{name} with an explicit frame is not supported yet")
+    return ("rows", lo, hi)
+
+
+def collect_windows(node, out):
+    """Collect WindowFunc AST nodes (deduplicated by restore text)."""
+    if node is None:
+        return
+    if isinstance(node, ast.WindowFunc):
+        key = node.restore()
+        if key not in out:
+            out[key] = node
+        return
+    for child in _ast_children(node):
+        collect_windows(child, out)
+
+
 def _ast_children(node):
     if isinstance(node, ast.BinaryOp):
         return [node.left, node.right]
@@ -377,6 +431,26 @@ class PlanBuilder:
         else:
             expr_builder = ExprBuilder(plan.schema, self.ctx, outer=self.outer)
 
+        # -- window functions: evaluate over the post-agg/post-having rows
+        # (reference: planner/core/logical_plan_builder.go buildWindowFunctions)
+        win_map = {}
+        for f in sel.fields:
+            if not isinstance(f.expr, ast.StarExpr):
+                collect_windows(f.expr, win_map)
+        for bi in sel.order_by:
+            collect_windows(bi.expr, win_map)
+        having_applied = False
+        if win_map:
+            if sel.having is not None:
+                # HAVING filters before windows compute (SQL eval order);
+                # bare-alias refs are resolved later in the normal path and
+                # cannot be supported here
+                cond = expr_builder.build(sel.having)
+                plan = Selection(plan, split_cnf(cond))
+                having_applied = True
+            plan, expr_builder = self._build_window(plan, expr_builder,
+                                                    win_map)
+
         # -- star expansion + select expr building
         fields = []
         for f in sel.fields:
@@ -396,7 +470,7 @@ class PlanBuilder:
             alias_map.setdefault(name.lower(), i)
 
         # -- having (after select aliases are known; may reference them)
-        if sel.having is not None:
+        if sel.having is not None and not having_applied:
             cond = self._build_having(sel.having, expr_builder, fields, alias_map)
             plan = Selection(plan, split_cnf(cond))
 
@@ -486,6 +560,40 @@ class PlanBuilder:
         agg = Aggregation(plan, group_exprs, aggs, Schema(refs))
         return agg, AggExprBuilder(agg, child_schema, expr_map, self.ctx,
                                    outer=self.outer)
+
+    def _build_window(self, plan, b, win_map):
+        """Group the collected OVER() expressions by (partition, order)
+        spec; one Window node per spec, stacked. The builder `b` gains a
+        window_map so select-field building resolves each WindowFunc to its
+        appended output column (reference: logical_plan_builder.go
+        groupWindowFuncs)."""
+        from .logical import WinFuncDesc, Window
+        groups = {}
+        for key, node in win_map.items():
+            spec = (tuple(e.restore() for e in node.partition_by),
+                    tuple((bi.expr.restore(), bi.desc)
+                          for bi in node.order_by))
+            groups.setdefault(spec, []).append((key, node))
+        if not hasattr(b, "window_map"):
+            b.window_map = {}
+        for _spec, items in groups.items():
+            part = [b.build(e) for e in items[0][1].partition_by]
+            order = [(b.build(bi.expr), bi.desc)
+                     for bi in items[0][1].order_by]
+            funcs = []
+            refs = list(plan.schema.refs)
+            for key, node in items:
+                args = [b.build(a) for a in node.args]
+                name = node.name.lower()
+                if name == "count" and not args:  # count(*) over (...)
+                    args = [Constant(1, FieldType(tp=TYPE_LONGLONG))]
+                ft = _window_ftype(name, args)
+                frame = _normalize_frame(node.frame, name)
+                b.window_map[key] = Column(len(refs), ft, name=key)
+                funcs.append(WinFuncDesc(name, args, ft, frame))
+                refs.append(ColumnRef(key, "", "", ft))
+            plan = Window(plan, funcs, part, order, Schema(refs))
+        return plan, b
 
     def _build_having(self, having, expr_builder, fields, alias_map):
         # rewrite bare alias references to the built select expressions

@@ -193,15 +193,47 @@ class SetOp(LogicalPlan):
                 "intersect": "Intersect", "except": "Except"}[self.kind]
 
 
+class WinFuncDesc:
+    """One window function over the node's (partition, order) spec.
+    frame: None (default frame) or ("rows", (kind, n), (kind, n))."""
+
+    __slots__ = ("name", "args", "ftype", "frame")
+
+    def __init__(self, name, args, ftype, frame=None):
+        self.name = name
+        self.args = args          # built exprs over the child schema
+        self.ftype = ftype
+        self.frame = frame
+
+    def __repr__(self):
+        s = f"{self.name}({', '.join(map(repr, self.args))})"
+        if self.frame is not None:
+            s += f" {self.frame[0]}[{self.frame[1]}..{self.frame[2]}]"
+        return s
+
+
 class Window(LogicalPlan):
+    """One OVER() spec; stacked Window nodes handle differing specs in one
+    query (reference: planner/core/logical_plans.go LogicalWindow)."""
+
     def __init__(self, child, funcs, partition_exprs, order_by, schema):
         super().__init__([child], schema)
-        self.funcs = funcs              # [(name, [arg exprs])]
+        self.funcs = funcs              # [WinFuncDesc]
         self.partition_exprs = partition_exprs
         self.order_by = order_by        # [(expr, desc)]
 
     def explain_name(self):
         return "Window"
+
+    def explain_info(self):
+        s = ", ".join(map(repr, self.funcs))
+        if self.partition_exprs:
+            s += " partition by:[" + ", ".join(
+                map(repr, self.partition_exprs)) + "]"
+        if self.order_by:
+            s += " order by:[" + ", ".join(
+                f"{e!r}{' desc' if d else ''}" for e, d in self.order_by) + "]"
+        return s
 
 
 def explain_nodes(plan: LogicalPlan, depth=0, out=None):
