@@ -1,0 +1,304 @@
+"""Extended builtin functions (reference: expression/builtin.go registry;
+these are the long-tail scalar builtins added toward the 281-function
+surface). One assertion per function, driven through full SQL."""
+
+import pytest
+
+from tidb_tpu.expression.core import supported_scalar_ops
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    return TestKit()
+
+
+def q1(tk, expr):
+    """SELECT <expr> → single display string (None for NULL)."""
+    return tk.must_query(f"select {expr}").rows[0][0]
+
+
+def test_registry_size():
+    # VERDICT round-1 target: >= 150 registered builtins
+    assert len(supported_scalar_ops()) >= 150
+
+
+# -- string ------------------------------------------------------------------
+
+def test_ascii(tk):
+    assert q1(tk, "ascii('Az')") == "65"
+
+def test_ord(tk):
+    assert q1(tk, "ord('A')") == "65"
+
+def test_bin(tk):
+    assert q1(tk, "bin(12)") == "1100"
+
+def test_oct(tk):
+    assert q1(tk, "oct(12)") == "14"
+
+def test_hex_str_and_int(tk):
+    assert q1(tk, "hex('abc')") == "616263"
+    assert q1(tk, "hex(255)") == "FF"
+
+def test_unhex(tk):
+    assert q1(tk, "unhex('616263')") == "abc"
+
+def test_md5(tk):
+    assert q1(tk, "md5('abc')") == "900150983cd24fb0d6963f7d28e17f72"
+
+def test_sha1(tk):
+    assert q1(tk, "sha1('abc')") == "a9993e364706816aba3e25717850c26c9cd0d89d"
+
+def test_sha2(tk):
+    assert q1(tk, "sha2('abc', 256)").startswith("ba7816bf8f01cfea")
+
+def test_crc32(tk):
+    assert q1(tk, "crc32('abc')") == "891568578"
+
+def test_instr(tk):
+    assert q1(tk, "instr('foobarbar', 'bar')") == "4"
+
+def test_rpad(tk):
+    assert q1(tk, "rpad('hi', 5, '?')") == "hi???"
+    assert q1(tk, "rpad('hi', 1, '?')") == "h"
+
+def test_elt(tk):
+    assert q1(tk, "elt(2, 'a', 'b', 'c')") == "b"
+    assert q1(tk, "elt(9, 'a')") is None
+
+def test_field(tk):
+    assert q1(tk, "field('b', 'a', 'b', 'c')") == "2"
+
+def test_find_in_set(tk):
+    assert q1(tk, "find_in_set('b', 'a,b,c')") == "2"
+    assert q1(tk, "find_in_set('x', 'a,b,c')") == "0"
+
+def test_format(tk):
+    assert q1(tk, "format(1234567.891, 2)") == "1,234,567.89"
+
+def test_insert(tk):
+    assert q1(tk, "insert('Quadratic', 3, 4, 'What')") == "QuWhattic"
+
+def test_strcmp(tk):
+    assert q1(tk, "strcmp('a', 'b')") == "-1"
+    assert q1(tk, "strcmp('b', 'b')") == "0"
+
+def test_substring_index(tk):
+    assert q1(tk, "substring_index('www.mysql.com', '.', 2)") == "www.mysql"
+    assert q1(tk, "substring_index('www.mysql.com', '.', -2)") == "mysql.com"
+
+def test_base64_roundtrip(tk):
+    assert q1(tk, "to_base64('abc')") == "YWJj"
+    assert q1(tk, "from_base64('YWJj')") == "abc"
+
+def test_quote(tk):
+    assert q1(tk, "quote(\"it's\")") == "'it\\'s'"
+
+def test_space(tk):
+    assert q1(tk, "space(3)") == "   "
+
+def test_char_fn(tk):
+    assert q1(tk, "char(77, 121)") == "My"
+
+def test_bit_length(tk):
+    assert q1(tk, "bit_length('abc')") == "24"
+
+def test_conv(tk):
+    assert q1(tk, "conv('ff', 16, 10)") == "255"
+    assert q1(tk, "conv(10, 10, 2)") == "1010"
+
+def test_soundex(tk):
+    assert q1(tk, "soundex('Robert')") == "R163"
+
+def test_lcase_ucase_mid(tk):
+    assert q1(tk, "ucase('ab')") == "AB"
+    assert q1(tk, "lcase('AB')") == "ab"
+    assert q1(tk, "mid('abcdef', 2, 3)") == "bcd"
+
+
+# -- math --------------------------------------------------------------------
+
+def test_trig(tk):
+    assert q1(tk, "round(sin(0), 4)") == "0"
+    assert q1(tk, "round(cos(0), 4)") == "1"
+    assert q1(tk, "round(tan(0), 4)") == "0"
+    assert q1(tk, "round(atan(1) * 4, 4)") == "3.1416"
+    assert q1(tk, "round(atan2(1, 1) * 4, 4)") == "3.1416"
+    assert q1(tk, "round(asin(1) * 2, 4)") == "3.1416"
+    assert q1(tk, "round(acos(0) * 2, 4)") == "3.1416"
+
+def test_cot(tk):
+    assert q1(tk, "round(cot(1), 4)") == "0.6421"
+
+def test_pi(tk):
+    assert q1(tk, "round(pi(), 4)") == "3.1416"
+
+def test_radians_degrees(tk):
+    assert q1(tk, "round(degrees(pi()), 2)") == "180"
+    assert q1(tk, "round(radians(180) - pi(), 6)") == "0"
+
+def test_log(tk):
+    assert q1(tk, "round(log(2, 8), 4)") == "3"
+    assert q1(tk, "round(log(exp(1)), 4)") == "1"
+    assert q1(tk, "log(-1)") is None
+
+def test_bit_count(tk):
+    assert q1(tk, "bit_count(7)") == "3"
+
+def test_asin_out_of_range_null(tk):
+    assert q1(tk, "asin(2)") is None
+
+
+# -- date / time -------------------------------------------------------------
+
+def test_from_unixtime(tk):
+    assert q1(tk, "from_unixtime(0)") == "1970-01-01 00:00:00"
+
+def test_unix_timestamp(tk):
+    assert q1(tk, "unix_timestamp('1970-01-02 00:00:00')") == "86400"
+
+def test_time_to_sec(tk):
+    assert q1(tk, "time_to_sec('01:00:05')") == "3605"
+
+def test_sec_to_time(tk):
+    assert q1(tk, "sec_to_time(3605)") == "01:00:05"
+
+def test_makedate(tk):
+    assert q1(tk, "makedate(2011, 32)") == "2011-02-01"
+
+def test_maketime(tk):
+    assert q1(tk, "maketime(12, 15, 30)") == "12:15:30"
+
+def test_last_day(tk):
+    assert q1(tk, "last_day('2024-02-05')") == "2024-02-29"
+
+def test_dayname_monthname(tk):
+    assert q1(tk, "dayname('2024-01-01')") == "Monday"
+    assert q1(tk, "monthname('2024-01-01')") == "January"
+
+def test_weekday(tk):
+    assert q1(tk, "weekday('2024-01-01')") == "0"  # Monday
+
+def test_weekofyear(tk):
+    assert q1(tk, "weekofyear('2024-01-04')") == "1"
+
+def test_yearweek(tk):
+    assert q1(tk, "yearweek('2024-01-04')") == "202401"
+
+def test_to_days_from_days(tk):
+    days = q1(tk, "to_days('2024-01-01')")
+    assert q1(tk, f"from_days({days})") == "2024-01-01"
+
+def test_period_add_diff(tk):
+    assert q1(tk, "period_add(202312, 2)") == "202402"
+    assert q1(tk, "period_diff(202402, 202312)") == "2"
+
+def test_str_to_date(tk):
+    assert q1(tk, "str_to_date('01,5,2013', '%d,%m,%Y')") == "2013-05-01"
+
+def test_timestampdiff(tk):
+    assert q1(tk, "timestampdiff(day, '2024-01-01', '2024-02-01')") == "31"
+    assert q1(tk, "timestampdiff(month, '2023-01-15', '2024-03-16')") == "14"
+    assert q1(tk, "timestampdiff(year, '2020-06-01', '2024-05-31')") == "3"
+
+def test_addtime_subtime(tk):
+    assert q1(tk, "addtime('01:00:00', '00:30:30')") == "01:30:30"
+    assert q1(tk, "subtime('01:00:00', '00:30:30')") == "00:29:30"
+
+def test_microsecond(tk):
+    assert q1(tk, "microsecond('2024-01-01 10:00:00')") == "0"
+
+
+# -- JSON --------------------------------------------------------------------
+
+def test_json_extract(tk):
+    assert q1(tk, "json_extract('{\"a\": {\"b\": 2}}', '$.a.b')") == "2"
+    assert q1(tk, "json_extract('[1, 2, 3]', '$[1]')") == "2"
+
+def test_json_unquote(tk):
+    assert q1(tk, "json_unquote('\"abc\"')") == "abc"
+
+def test_json_valid(tk):
+    assert q1(tk, "json_valid('{\"a\": 1}')") == "1"
+    assert q1(tk, "json_valid('nope{')") == "0"
+
+def test_json_length(tk):
+    assert q1(tk, "json_length('[1, 2, 3]')") == "3"
+
+def test_json_type(tk):
+    assert q1(tk, "json_type('[1]')") == "ARRAY"
+    assert q1(tk, "json_type('{}')") == "OBJECT"
+
+def test_json_object_array(tk):
+    assert q1(tk, "json_object('k', 1)") == '{"k": 1}'
+    assert q1(tk, "json_array(1, 'a')") == '[1, "a"]'
+
+def test_json_keys(tk):
+    assert q1(tk, "json_keys('{\"a\": 1, \"b\": 2}')") == '["a", "b"]'
+
+def test_json_contains(tk):
+    assert q1(tk, "json_contains('[1, 2, 3]', '2')") == "1"
+    assert q1(tk, "json_contains('[1, 2, 3]', '9')") == "0"
+
+
+# -- network / misc ----------------------------------------------------------
+
+def test_inet_aton_ntoa(tk):
+    assert q1(tk, "inet_aton('10.0.5.9')") == "167773449"
+    assert q1(tk, "inet_ntoa(167773449)") == "10.0.5.9"
+
+def test_is_ipv4(tk):
+    assert q1(tk, "is_ipv4('10.0.5.9')") == "1"
+    assert q1(tk, "is_ipv4('10.0.5.256')") == "0"
+
+def test_is_ipv6(tk):
+    assert q1(tk, "is_ipv6('::1')") == "1"
+    assert q1(tk, "is_ipv6('10.0.0.1')") == "0"
+
+def test_uuid_shape(tk):
+    v = q1(tk, "uuid()")
+    assert len(v) == 36 and v.count("-") == 4
+
+def test_connection_id(tk):
+    assert int(q1(tk, "connection_id()")) > 0
+
+def test_null_propagation(tk):
+    assert q1(tk, "md5(NULL)") is None
+    assert q1(tk, "instr(NULL, 'a')") is None
+    assert q1(tk, "rpad('a', -1, 'x')") is None
+
+
+def test_functions_over_table_rows(tk):
+    """Builtins evaluate per-row over real columns, not just constants."""
+    tk.must_exec("create table bx (a int primary key, s varchar(20))")
+    tk.must_exec("insert into bx values (1, 'hello'), (2, 'WORLD'), (3, null)")
+    tk.must_query(
+        "select a, upper(s), instr(s, 'o'), md5(s) is null from bx "
+        "order by a").check([
+            ("1", "HELLO", "5", "0"),
+            ("2", "WORLD", "0", "0"),
+            ("3", None, None, "1")])
+
+
+def test_review_regressions(tk):
+    # MySQL day-number epoch
+    assert q1(tk, "to_days('1970-01-01')") == "719528"
+    assert q1(tk, "from_days(719528)") == "1970-01-01"
+    # NULL args to non-propagating builtins return NULL / skip, not crash
+    assert q1(tk, "elt(null, 'a', 'b')") is None
+    assert q1(tk, "char(65, null, 66)") == "AB"
+    assert q1(tk, "field(null, 'a')") == "0"
+    assert q1(tk, "json_array(1, null)") == "[1, null]"
+    # zero-arg unix_timestamp works
+    assert int(q1(tk, "unix_timestamp()")) > 1_700_000_000
+
+
+def test_rand_seeded_varies_per_row(tk):
+    tk.must_exec("create table rnd (a int primary key)")
+    tk.must_exec("insert into rnd values (1),(2),(3),(4)")
+    r = tk.must_query("select rand(3) from rnd")
+    vals = [row[0] for row in r.rows]
+    assert len(set(vals)) > 1, "seeded rand constant across rows"
+    r2 = tk.must_query("select rand(3) from rnd")
+    assert vals == [row[0] for row in r2.rows], "seeded rand not repeatable"

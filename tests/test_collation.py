@@ -1,0 +1,80 @@
+"""Case-insensitive collation (utf8mb4_general_ci) — comparisons, GROUP BY,
+DISTINCT, ORDER BY, joins, LIKE (reference: util/collate/collate.go)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec(
+        "create table ci (id int primary key, "
+        "s varchar(20) collate utf8mb4_general_ci, b varchar(20))")
+    tk.must_exec(
+        "insert into ci values (1,'Apple','Apple'), (2,'APPLE','APPLE'), "
+        "(3,'banana','banana'), (4,'Banana','Banana'), (5,'cherry','cherry')")
+    return tk
+
+
+def test_ci_equality(tk):
+    tk.must_query("select id from ci where s = 'apple' order by id").check(
+        [("1",), ("2",)])
+    # the binary column stays exact
+    tk.must_query("select id from ci where b = 'apple'").check([])
+
+
+def test_ci_group_by_merges_case_variants(tk):
+    r = tk.must_query("select count(*) from ci group by s order by 1")
+    assert [row[0] for row in r.rows] == ["1", "2", "2"]
+    # binary column keeps them apart
+    r = tk.must_query("select count(*) from ci group by b order by 1")
+    assert [row[0] for row in r.rows] == ["1"] * 5
+
+
+def test_ci_distinct(tk):
+    r = tk.must_query("select distinct s from ci")
+    assert len(r.rows) == 3
+
+
+def test_ci_order_by(tk):
+    r = tk.must_query("select id from ci order by s, id")
+    # case-insensitive: Apple/APPLE < banana/Banana < cherry
+    assert [row[0] for row in r.rows] == ["1", "2", "3", "4", "5"]
+
+
+def test_ci_join_keys(tk):
+    tk.must_exec("create table ref (s varchar(20) collate utf8mb4_general_ci,"
+                 " v int)")
+    tk.must_exec("insert into ref values ('APPLE', 100), ('BANANA', 200)")
+    r = tk.must_query(
+        "select ci.id, ref.v from ci, ref where ci.s = ref.s order by ci.id")
+    assert [tuple(x) for x in r.rows] == [
+        ("1", "100"), ("2", "100"), ("3", "200"), ("4", "200")]
+
+
+def test_ci_like(tk):
+    tk.must_query("select id from ci where s like 'app%' order by id").check(
+        [("1",), ("2",)])
+    tk.must_query("select id from ci where b like 'app%'").check([])
+
+
+def test_ci_comparison_operators(tk):
+    tk.must_query(
+        "select count(*) from ci where s < 'BANANA'").check([("2",)])
+
+
+def test_ci_show_and_binary_defaults(tk):
+    # unspecified collation stays binary-compatible default
+    r = tk.must_query("select count(distinct b) from ci")
+    assert r.rows[0][0] == "5"
+
+
+def test_ci_device_fallback_parity(tk):
+    """Force the device engine: _ci columns must fall back to host and
+    still produce case-insensitive results."""
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    r = tk.must_query("select count(*) from ci group by s order by 1")
+    assert [row[0] for row in r.rows] == ["1", "2", "2"]
+    tk.must_exec("set tidb_executor_engine = 'auto'")

@@ -195,3 +195,13 @@ def test_explain_analyze_streamed_child_stats(tk):
         "explain analyze select v from w order by v").rows
     scan = next(r for r in rows if "TableScan" in r[0])
     assert scan[1].isdigit() and int(scan[1]) == 7
+
+
+def test_rows_unbounded_not_peer_aware(tk):
+    """ROWS UNBOUNDED PRECEDING..CURRENT ROW is row-exact even with tied
+    order keys (unlike the peer-aware default RANGE frame)."""
+    tk.must_query(
+        "select id, sum(v) over (partition by g order by v rows between "
+        "unbounded preceding and current row) from w where g = 'a' "
+        "order by v, id").check([
+            ("1", "10"), ("2", "30"), ("3", "50"), ("4", "90")])

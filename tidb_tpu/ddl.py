@@ -361,6 +361,8 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
             v = e.eval_scalar()
             default = cast_value(v, cd.ftype) if v is not None else None
             has_default = True
+        if "collate" in cd.options:
+            cd.ftype.collate = cd.options["collate"]
         ci = ColumnInfo(id=tbl.max_col_id, name=cd.name, offset=off,
                         ftype=cd.ftype, default_value=default,
                         has_default=has_default,

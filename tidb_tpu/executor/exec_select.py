@@ -68,6 +68,14 @@ def build_executor(plan, ctx, stats=None) -> QueryExecutor:
     return exe
 
 
+def _collate_eval(expr, chunk):
+    """Evaluate a sort/partition key with collation-aware transform:
+    _ci string keys order by their case-folded sort key."""
+    d, nl = expr.eval(chunk)
+    from ..utils.collate import key_for_compare
+    return key_for_compare(d, expr.ftype), nl
+
+
 def eval_expr_to_column(expr, chunk: Chunk) -> Column:
     data, nulls = expr.eval(chunk)
     if data.dtype != object:
@@ -365,7 +373,10 @@ class HashAggExec(QueryExecutor):
         n = chunk.num_rows
         group_cols = [e.eval(chunk) for e in p.group_exprs]
         if p.group_exprs:
-            gids, n_groups, first_idx = host.group_ids(group_cols)
+            from ..utils.collate import key_for_compare
+            key_cols = [(key_for_compare(d, e.ftype), nl)
+                        for (d, nl), e in zip(group_cols, p.group_exprs)]
+            gids, n_groups, first_idx = host.group_ids(key_cols)
         else:
             gids = np.zeros(n, dtype=np.int64)
             n_groups = 1 if n > 0 else 0
@@ -600,6 +611,10 @@ class HashJoinExec(QueryExecutor):
             return _as_float(data, expr.ftype), nulls
         if data.dtype == np.int32:
             return data.astype(np.int64), nulls
+        if k1 == K_STR:
+            from ..utils.collate import is_ci, sort_key_array
+            if is_ci(expr.ftype.collate) or is_ci(other.ftype.collate):
+                return sort_key_array(data), nulls
         return data, nulls
 
     def _nested_loop(self, left, right):
@@ -650,7 +665,7 @@ class SortExec(QueryExecutor):
     def _sort_chunk(self, chunk):
         if chunk.num_rows == 0:
             return chunk
-        keys = [(e.eval(chunk), d) for e, d in self.plan.by]
+        keys = [(_collate_eval(e, chunk), d) for e, d in self.plan.by]
         idx = host.sort_indices([k for k, _ in keys], [d for _, d in keys])
         return chunk.take(idx)
 
@@ -726,7 +741,7 @@ class TopNExec(QueryExecutor):
             if chunk.num_rows == 0:
                 continue
             cand = chunk if best is None else concat_chunks([best, chunk])
-            keys = [(e.eval(cand), d) for e, d in p.by]
+            keys = [(_collate_eval(e, cand), d) for e, d in p.by]
             idx = host.sort_indices([kk for kk, _ in keys],
                                     [d for _, d in keys])
             best = cand.take(idx[:k])
@@ -797,11 +812,11 @@ class WindowExec(QueryExecutor):
                 cols.append(Column(f.ftype, data, np.zeros(0, dtype=bool)))
             return Chunk(cols)
         if p.partition_exprs:
-            pk = [e.eval(chunk) for e in p.partition_exprs]
+            pk = [_collate_eval(e, chunk) for e in p.partition_exprs]
             gids, _ng, _fi = host.group_ids(pk)
         else:
             gids = np.zeros(n, dtype=np.int64)
-        order_keys = [(e.eval(chunk), d) for e, d in p.order_by]
+        order_keys = [(_collate_eval(e, chunk), d) for e, d in p.order_by]
         keys = [(gids, np.zeros(n, dtype=bool))] + [k for k, _ in order_keys]
         descs = [False] + [d for _, d in order_keys]
         idx = host.sort_indices(keys, descs)

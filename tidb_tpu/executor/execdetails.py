@@ -103,5 +103,9 @@ def timed_execute(exe, stats: RuntimeStatsColl):
             stats.record(exe.plan, chunk.num_rows, el)
             yield chunk
 
-    exe.execute_stream = run_stream
+    # wrap the stream only for real streaming overrides: the base-class
+    # execute_stream delegates to execute(), which is already the wrapped
+    # run() — wrapping both would double-count rows/time/loops
+    if "execute_stream" in type(exe).__dict__:
+        exe.execute_stream = run_stream
     return run

@@ -194,15 +194,29 @@ def literal_to_constant(lit: ast.Literal) -> Constant:
 # result type computation for scalar functions
 _STR_FUNCS = {"concat", "concat_ws", "upper", "lower", "substring", "trim",
               "ltrim", "rtrim", "replace", "left", "right", "reverse",
-              "repeat", "lpad", "rpad", "date_format", "hex", "md5", "sha1"}
+              "repeat", "lpad", "rpad", "date_format", "hex", "md5", "sha1",
+              "bin", "oct", "unhex", "sha2", "elt", "insert",
+              "substring_index", "to_base64", "from_base64", "quote",
+              "space", "char", "conv", "soundex", "format",
+              "sec_to_time", "makedate", "maketime", "last_day", "dayname",
+              "monthname", "str_to_date", "addtime", "subtime",
+              "from_unixtime", "from_days",
+              "json_extract", "json_unquote", "json_type", "json_object",
+              "json_array", "json_keys", "inet_ntoa", "uuid"}
 _INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
               "dayofmonth", "hour", "minute", "second", "quarter", "week",
               "dayofweek", "dayofyear", "extract", "datediff", "sign",
               "ascii", "instr", "isnull", "istrue", "isfalse", "found_rows",
-              "row_count", "last_insert_id", "connection_id", "crc32"}
+              "row_count", "last_insert_id", "connection_id", "crc32",
+              "ord", "strcmp", "field", "find_in_set", "bit_length",
+              "bit_count", "unix_timestamp", "time_to_sec", "weekday",
+              "weekofyear", "yearweek", "to_days", "period_add",
+              "period_diff", "microsecond", "timestampdiff",
+              "json_valid", "json_length", "json_contains",
+              "is_ipv4", "is_ipv6", "inet_aton", "sleep"}
 _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "pow", "power", "rand",
                 "radians", "degrees", "sin", "cos", "tan", "atan", "asin",
-                "acos", "pi"}
+                "acos", "pi", "atan2", "cot", "log"}
 
 
 class OuterScope:
@@ -586,6 +600,35 @@ class ExprBuilder:
         if name == "user" or name == "current_user":
             u = self.ctx.current_user() if self.ctx is not None else "root@%"
             return Constant(u.encode(), FieldType(tp=TYPE_VARCHAR))
+        if name == "unix_timestamp" and not node.args:
+            import datetime as _dt2
+            now = (self.ctx.now() if self.ctx is not None
+                   and hasattr(self.ctx, "now") else _dt2.datetime.now())
+            return Constant(int(now.timestamp()),
+                            FieldType(tp=TYPE_LONGLONG))
+        if name in ("connection_id", "found_rows", "row_count",
+                    "last_insert_id") and not node.args:
+            sess = getattr(self.ctx, "session", None)
+            v = {"connection_id": getattr(sess, "conn_id", 0),
+                 "found_rows": getattr(sess, "found_rows", 0),
+                 "row_count": getattr(sess, "affected_rows", 0),
+                 "last_insert_id": getattr(sess, "last_insert_id", 0),
+                 }[name]
+            return Constant(int(v or 0), FieldType(tp=TYPE_LONGLONG))
+        if name in ("charset", "collation"):
+            args = [self.build(a) for a in node.args]
+            v = b"binary" if name == "collation" else b"utf8mb4"
+            if args and args[0].ftype.tp in (TYPE_VARCHAR,):
+                v = (args[0].ftype.collate or "utf8mb4_bin").encode() \
+                    if name == "collation" else b"utf8mb4"
+            return Constant(v, FieldType(tp=TYPE_VARCHAR))
+        if name == "any_value" and node.args:
+            return self.build(node.args[0])
+        if name in ("lcase", "ucase", "mid"):
+            node = ast.FuncCall(
+                name={"lcase": "lower", "ucase": "upper",
+                      "mid": "substring"}[name], args=node.args)
+            return self._b_FuncCall(node)
         if name in ("if",):
             args = [self.build(a) for a in node.args]
             ft = unify_types([args[1].ftype, args[2].ftype])

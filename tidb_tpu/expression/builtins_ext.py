@@ -1,0 +1,586 @@
+"""Extended builtin function library (reference: expression/builtin.go:573
+registry — 281 functions; this module grows the engine's dispatch table
+toward it: string, math, date/time, JSON and network/misc functions).
+
+Implementation style: row-wise Python kernels behind a tiny spec-driven
+adapter (`_pyfn`). These are host-side scalar builtins — the vectorized hot
+path (comparisons, arithmetic, LIKE, date parts) stays in core.py and the
+device compiler; functions here are the long tail where per-row Python cost
+is acceptable (reference analog: builtinXxxSig.evalString row loops, which
+are likewise scalar)."""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import calendar
+import datetime as _dt
+import hashlib
+import json as _json
+import math
+import struct
+import zlib
+
+import numpy as np
+
+from ..sqltypes import (FieldType, TYPE_LONGLONG, TYPE_VARCHAR, TYPE_DOUBLE,
+                        TYPE_DATE, TYPE_DATETIME)
+from .core import (_DISPATCH, _as_float, _cast_to, _to_dateparts)
+
+_S = FieldType(tp=TYPE_VARCHAR)
+_I = FieldType(tp=TYPE_LONGLONG)
+
+
+def _conv_arg(a, chunk, kind):
+    d, n = a.eval(chunk)
+    if kind == "s":
+        d, n = _cast_to(d, n, a.ftype, _S)
+    elif kind == "i":
+        d, n = _cast_to(d, n, a.ftype, _I)
+    elif kind == "f":
+        d = _as_float(d, a.ftype)
+    elif kind == "d":  # datetime parts (datetime|None objects)
+        d = _to_dateparts(a, chunk)
+        n = np.array([p is None for p in d]) | n
+    return d, n
+
+
+def _pyfn(spec, fn, out="s", null_propagate=True):
+    """Adapter: convert args per `spec` ('s' bytes, 'i' int, 'f' float,
+    'd' datetime, 'r' raw), run `fn` per row, box the result. fn returning
+    None yields NULL. spec may be longer than the actual args (optionals);
+    a trailing '*' repeats the previous kind."""
+
+    def ev(sf, chunk):
+        kinds = []
+        si = 0
+        for _a in sf.args:
+            k = spec[si] if si < len(spec) else kinds[-1]
+            if k == "*":
+                k = kinds[-1]
+            kinds.append(k)
+            if si < len(spec) - 1 or (si < len(spec) and spec[si] != "*"):
+                si += 1
+        arrs, nls = [], []
+        for a, k in zip(sf.args, kinds):
+            d, n2 = _conv_arg(a, chunk, k)
+            arrs.append(d)
+            nls.append(n2)
+        m = max((len(x) for x in arrs), default=chunk.num_rows)
+        nulls = np.zeros(m, dtype=bool)
+        if null_propagate:
+            for n2 in nls:
+                nulls = nulls | n2
+        if out == "s":
+            data = np.full(m, b"", dtype=object)
+        elif out == "i":
+            data = np.zeros(m, dtype=np.int64)
+        elif out == "f":
+            data = np.zeros(m, dtype=np.float64)
+        else:
+            data = np.full(m, b"", dtype=object)
+        for i in range(m):
+            if nulls[i]:
+                continue
+            try:
+                if null_propagate:
+                    v = fn(*[arr[i] for arr in arrs])
+                else:
+                    v = fn(*[None if nl[i] else arr[i]
+                             for arr, nl in zip(arrs, nls)])
+            except (ValueError, OverflowError, ZeroDivisionError,
+                    ArithmeticError, binascii.Error, KeyError, IndexError,
+                    struct.error, UnicodeDecodeError, TypeError,
+                    AttributeError):
+                v = None
+            if v is None:
+                nulls[i] = True
+            else:
+                data[i] = v
+        return data, nulls
+
+    return ev
+
+
+def _u(b: bytes) -> str:
+    return b.decode("utf-8", "replace")
+
+
+# -- string ------------------------------------------------------------------
+
+def _soundex(b):
+    s = "".join(ch for ch in _u(b).upper() if ch.isalpha())
+    if not s:
+        return b""
+    codes = {**dict.fromkeys("BFPV", "1"), **dict.fromkeys("CGJKQSXZ", "2"),
+             **dict.fromkeys("DT", "3"), "L": "4",
+             **dict.fromkeys("MN", "5"), "R": "6"}
+    out = [s[0]]
+    last = codes.get(s[0], "")
+    for ch in s[1:]:
+        c = codes.get(ch, "")
+        if c and c != last:
+            out.append(c)
+        last = c
+    return ("".join(out) + "000")[:4].encode()
+
+
+def _substring_index(s, delim, count):
+    if not delim:
+        return b""
+    parts = s.split(delim)
+    if count > 0:
+        return delim.join(parts[:count])
+    if count < 0:
+        return delim.join(parts[count:])
+    return b""
+
+
+def _format_num(v, nd):
+    nd = max(int(nd), 0)
+    return f"{v:,.{nd}f}".encode()
+
+
+def _insert_fn(s, pos, ln, news):
+    if pos < 1 or pos > len(s):
+        return s
+    return s[:pos - 1] + news + s[pos - 1 + max(ln, 0):]
+
+
+def _conv_base(s, from_b, to_b):
+    try:
+        v = int(_u(s).strip() or "0", int(from_b))
+    except ValueError:
+        v = 0
+    to_b = int(to_b)
+    neg = v < 0
+    v = abs(v)
+    digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    if v == 0:
+        return b"0"
+    out = ""
+    while v:
+        v, r = divmod(v, abs(to_b))
+        out = digits[r] + out
+    return (("-" if neg and to_b < 0 else "") + out).encode()
+
+
+_STRING_FUNCS = {
+    "ascii": _pyfn("s", lambda s: s[0] if s else 0, out="i"),
+    "ord": _pyfn("s", lambda s: int.from_bytes(
+        s[:max(1, (s[0] >> 4 == 0xF) * 4 or (s[0] >> 5 == 7) * 3
+               or (s[0] >> 6 == 3) * 2 or 1)], "big") if s else 0, out="i"),
+    "bin": _pyfn("i", lambda v: format(v & (2**64 - 1) if v < 0 else v,
+                                       "b").encode()),
+    "oct": _pyfn("i", lambda v: format(v & (2**64 - 1) if v < 0 else v,
+                                       "o").encode()),
+    "unhex": _pyfn("s", lambda s: binascii.unhexlify(
+        (b"0" + s) if len(s) % 2 else s)),
+    "md5": _pyfn("s", lambda s: hashlib.md5(s).hexdigest().encode()),
+    "sha1": _pyfn("s", lambda s: hashlib.sha1(s).hexdigest().encode()),
+    "sha2": _pyfn("si", lambda s, n: hashlib.new(
+        {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384",
+         512: "sha512"}[int(n)], s).hexdigest().encode()),
+    "crc32": _pyfn("s", lambda s: zlib.crc32(s) & 0xFFFFFFFF, out="i"),
+    "instr": _pyfn("ss", lambda s, sub: s.find(sub) + 1, out="i"),
+    "rpad": _pyfn("sis", lambda s, n, pad:
+                  None if n < 0 else
+                  (s[:n] if len(s) >= n else
+                   (s + pad * n)[:n] if pad else None)),
+    "elt": _pyfn("is*", lambda n, *ss:
+                 ss[n - 1] if n is not None and 1 <= n <= len(ss) else None,
+                 null_propagate=False),
+    "field": _pyfn("ss*", lambda t, *ss:
+                   0 if t is None else
+                   next((i + 1 for i, s in enumerate(ss) if s == t), 0),
+                   out="i", null_propagate=False),
+    "find_in_set": _pyfn("ss", lambda t, st:
+                         ([b""] + st.split(b",")).index(t)
+                         if t in st.split(b",") else 0, out="i"),
+    "format": _pyfn("fi", _format_num),
+    "insert": _pyfn("siis", _insert_fn),
+    "strcmp": _pyfn("ss", lambda a, b: (a > b) - (a < b), out="i"),
+    "substring_index": _pyfn("ssi", _substring_index),
+    "to_base64": _pyfn("s", lambda s: base64.b64encode(s)),
+    "from_base64": _pyfn("s", lambda s: base64.b64decode(s, validate=True)),
+    "quote": _pyfn("s", lambda s: b"'" + s.replace(b"\\", b"\\\\")
+                   .replace(b"'", b"\\'") + b"'"),
+    "space": _pyfn("i", lambda n: b" " * min(max(n, 0), 1 << 20)),
+    "char": _pyfn("i*", lambda *vs: b"".join(
+        int(v).to_bytes(max((int(v).bit_length() + 7) // 8, 1), "big")
+        for v in vs if v is not None), null_propagate=False),
+    "bit_length": _pyfn("s", lambda s: 8 * len(s), out="i"),
+    "conv": _pyfn("sii", _conv_base),
+    "soundex": _pyfn("s", _soundex),
+    "hex": _pyfn("r", lambda v: (binascii.hexlify(v).upper() if
+                                 isinstance(v, (bytes, bytearray)) else
+                                 format(int(v) & (2**64 - 1), "X").encode())),
+}
+
+
+# -- math --------------------------------------------------------------------
+
+def _math1(fn):
+    return _pyfn("f", lambda v: _finite(fn(v)), out="f")
+
+
+def _finite(v):
+    return v if v is not None and math.isfinite(v) else None
+
+
+_MATH_FUNCS = {
+    "sin": _math1(math.sin), "cos": _math1(math.cos),
+    "tan": _math1(math.tan),
+    "asin": _math1(lambda v: math.asin(v) if -1 <= v <= 1 else None),
+    "acos": _math1(lambda v: math.acos(v) if -1 <= v <= 1 else None),
+    "atan": _math1(math.atan),
+    "cot": _math1(lambda v: 1.0 / math.tan(v) if math.tan(v) != 0 else None),
+    "atan2": _pyfn("ff", lambda a, b: math.atan2(a, b), out="f"),
+    "radians": _math1(math.radians), "degrees": _math1(math.degrees),
+    "pi": _pyfn("", lambda: math.pi, out="f"),
+    "rand": None,  # replaced below: needs one RNG per CALL, not per row
+    "log": _pyfn("ff", lambda a, *b:
+                 _finite(math.log(b[0], a) if b else math.log(a))
+                 if a > 0 and (not b or b[0] > 0) else None,
+                 out="f", null_propagate=False),
+    "exp": _math1(lambda v: math.exp(v) if v < 700 else None),
+    "bit_count": _pyfn("i", lambda v: bin(int(v) & (2**64 - 1)).count("1"),
+                       out="i"),
+}
+
+
+def _eval_rand(sf, chunk):
+    """rand([seed]): one RNG per evaluation — a seeded call yields MySQL's
+    repeatable-but-varying per-row sequence, not one constant."""
+    n = chunk.num_rows
+    if sf.args:
+        d, nl = _conv_arg(sf.args[0], chunk, "i")
+        seed = int(d[0]) if len(d) and not nl[0] else 0
+        rng = np.random.default_rng(seed)
+    else:
+        rng = np.random.default_rng()
+    return rng.random(n), np.zeros(n, dtype=bool)
+
+
+_MATH_FUNCS["rand"] = _eval_rand
+
+
+# -- date / time -------------------------------------------------------------
+
+_EPOCH = _dt.datetime(1970, 1, 1)
+
+
+def _from_unixtime(ts):
+    try:
+        return (_EPOCH + _dt.timedelta(seconds=int(ts))
+                ).strftime("%Y-%m-%d %H:%M:%S").encode()
+    except OverflowError:
+        return None
+
+
+def _parse_time_b(b):
+    """HH:MM:SS[.f] / HHH:MM:SS → seconds (sign-aware)."""
+    s = _u(b).strip()
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    parts = s.split(":")
+    if len(parts) == 1:
+        v = float(parts[0] or 0)
+        h, rem = divmod(int(v), 10000)
+        mnt, sec = divmod(rem, 100)
+        total = h * 3600 + mnt * 60 + sec
+    else:
+        nums = [float(p or 0) for p in parts[:3]] + [0.0] * (3 - len(parts))
+        total = nums[0] * 3600 + nums[1] * 60 + nums[2]
+    return -total if neg else total
+
+
+def _sec_to_time(v):
+    neg = v < 0
+    v = abs(int(v))
+    h, rem = divmod(v, 3600)
+    mnt, sec = divmod(rem, 60)
+    return f"{'-' if neg else ''}{h:02d}:{mnt:02d}:{sec:02d}".encode()
+
+
+_STRPTIME_MAP = {
+    "%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%m", "%d": "%d", "%e": "%d",
+    "%H": "%H", "%k": "%H", "%h": "%I", "%I": "%I", "%i": "%M", "%s": "%S",
+    "%S": "%S", "%p": "%p", "%M": "%B", "%b": "%b", "%j": "%j",
+    "%W": "%A", "%a": "%a", "%T": "%H:%M:%S", "%%": "%%",
+}
+
+
+def _str_to_date(s, fmt):
+    pyfmt = ""
+    f = _u(fmt)
+    i = 0
+    while i < len(f):
+        if f[i] == "%" and i + 1 < len(f):
+            tok = f[i:i + 2]
+            pyfmt += _STRPTIME_MAP.get(tok, tok[1])
+            i += 2
+        else:
+            pyfmt += f[i]
+            i += 1
+    try:
+        dt = _dt.datetime.strptime(_u(s).strip(), pyfmt)
+    except ValueError:
+        return None
+    if ("%H" in pyfmt or "%I" in pyfmt or "%M" in pyfmt or "%S" in pyfmt):
+        return dt.strftime("%Y-%m-%d %H:%M:%S").encode()
+    return dt.strftime("%Y-%m-%d").encode()
+
+
+_DATE_FUNCS = {
+    "from_unixtime": _pyfn("i", _from_unixtime),
+    "unix_timestamp": _pyfn("d", lambda p: int(
+        (p - _EPOCH).total_seconds()), out="i"),
+    "time_to_sec": _pyfn("s", lambda b: int(_parse_time_b(b)), out="i"),
+    "sec_to_time": _pyfn("i", _sec_to_time),
+    "makedate": _pyfn("ii", lambda y, d: (
+        _dt.date(int(y), 1, 1) + _dt.timedelta(days=int(d) - 1)
+    ).strftime("%Y-%m-%d").encode() if d > 0 else None),
+    "maketime": _pyfn("iii", lambda h, m, s:
+                      f"{h:02d}:{m:02d}:{s:02d}".encode()
+                      if 0 <= m < 60 and 0 <= s < 60 else None),
+    "last_day": _pyfn("d", lambda p: p.replace(
+        day=calendar.monthrange(p.year, p.month)[1]
+    ).strftime("%Y-%m-%d").encode()),
+    "dayname": _pyfn("d", lambda p: p.strftime("%A").encode()),
+    "monthname": _pyfn("d", lambda p: p.strftime("%B").encode()),
+    "weekday": _pyfn("d", lambda p: p.weekday(), out="i"),
+    "weekofyear": _pyfn("d", lambda p: p.isocalendar()[1], out="i"),
+    "yearweek": _pyfn("d", lambda p: p.isocalendar()[0] * 100
+                      + p.isocalendar()[1], out="i"),
+    # MySQL day numbers count from year 0: python ordinal (0001-01-01=1)
+    # is 365 behind
+    "to_days": _pyfn("d", lambda p: p.toordinal() + 365, out="i"),
+    "from_days": _pyfn("i", lambda n: _dt.date.fromordinal(
+        int(n) - 365).strftime("%Y-%m-%d").encode() if n > 730 else None),
+    "period_add": _pyfn("ii", lambda p, n: (lambda y, m:
+                        ((y * 12 + m - 1 + int(n)) // 12) * 100
+                        + ((y * 12 + m - 1 + int(n)) % 12) + 1)(
+                            int(p) // 100, int(p) % 100), out="i"),
+    "period_diff": _pyfn("ii", lambda a, b:
+                         (int(a) // 100 * 12 + int(a) % 100)
+                         - (int(b) // 100 * 12 + int(b) % 100), out="i"),
+    "str_to_date": _pyfn("ss", _str_to_date),
+    "microsecond": _pyfn("d", lambda p: getattr(p, "microsecond", 0),
+                         out="i"),
+    "addtime": _pyfn("ss", lambda a, b: _sec_to_time(
+        _parse_time_b(a) + _parse_time_b(b))),
+    "subtime": _pyfn("ss", lambda a, b: _sec_to_time(
+        _parse_time_b(a) - _parse_time_b(b))),
+    "timestampdiff": _pyfn("sdd", lambda unit, a, b: _tsdiff(
+        _u(unit).lower(), a, b), out="i"),
+}
+
+
+def _tsdiff(unit, a, b):
+    delta = b - a
+    if unit == "second":
+        return int(delta.total_seconds())
+    if unit == "minute":
+        return int(delta.total_seconds() // 60)
+    if unit == "hour":
+        return int(delta.total_seconds() // 3600)
+    if unit == "day":
+        return delta.days
+    if unit == "week":
+        return delta.days // 7
+    months = (b.year - a.year) * 12 + (b.month - a.month)
+    if (b.day, getattr(b, "hour", 0)) < (a.day, getattr(a, "hour", 0)):
+        months -= 1
+    if unit == "month":
+        return months
+    if unit == "quarter":
+        return months // 3
+    if unit == "year":
+        return months // 12
+    return None
+
+
+# -- JSON --------------------------------------------------------------------
+
+def _json_load(b):
+    return _json.loads(b.decode("utf-8"))
+
+
+def _json_dump(v) -> bytes:
+    return _json.dumps(v, separators=(", ", ": "), ensure_ascii=False
+                       ).encode()
+
+
+def _json_path_get(doc, path: bytes):
+    """Subset of MySQL JSON path: $, .key, ."quoted", [n], [*], .*."""
+    p = _u(path).strip()
+    if not p.startswith("$"):
+        return None, False
+    i = 1
+    cur = [doc]
+    while i < len(p):
+        if p[i] == ".":
+            i += 1
+            if i < len(p) and p[i] == "*":
+                i += 1
+                nxt = []
+                for c in cur:
+                    if isinstance(c, dict):
+                        nxt.extend(c.values())
+                cur = nxt
+                continue
+            if i < len(p) and p[i] == '"':
+                j = p.index('"', i + 1)
+                key = p[i + 1:j]
+                i = j + 1
+            else:
+                j = i
+                while j < len(p) and p[j] not in ".[":
+                    j += 1
+                key = p[i:j]
+                i = j
+            cur = [c[key] for c in cur if isinstance(c, dict) and key in c]
+        elif p[i] == "[":
+            j = p.index("]", i)
+            tok = p[i + 1:j].strip()
+            i = j + 1
+            if tok == "*":
+                nxt = []
+                for c in cur:
+                    if isinstance(c, list):
+                        nxt.extend(c)
+                cur = nxt
+            else:
+                n = int(tok)
+                cur = [c[n] for c in cur
+                       if isinstance(c, list) and -len(c) <= n < len(c)]
+        else:
+            return None, False
+    if not cur:
+        return None, False
+    return (cur[0] if len(cur) == 1 else cur), True
+
+
+def _json_extract(doc_b, *paths):
+    doc = _json_load(doc_b)
+    vals = []
+    for p in paths:
+        v, ok = _json_path_get(doc, p)
+        if ok:
+            vals.append(v)
+    if not vals:
+        return None
+    return _json_dump(vals[0] if len(paths) == 1 and len(vals) == 1
+                      else vals)
+
+
+def _json_type(b):
+    v = _json_load(b)
+    return {dict: b"OBJECT", list: b"ARRAY", str: b"STRING", bool: b"BOOLEAN",
+            int: b"INTEGER", float: b"DOUBLE",
+            type(None): b"NULL"}[type(v)]
+
+
+_JSON_FUNCS = {
+    "json_extract": _pyfn("ss*", _json_extract),
+    "json_unquote": _pyfn("s", lambda b: (
+        _json_load(b).encode() if b[:1] == b'"' else b)),
+    "json_valid": _pyfn("s", lambda b: _json_valid(b), out="i"),
+    "json_length": _pyfn("s", lambda b: (
+        lambda v: len(v) if isinstance(v, (dict, list)) else 1)(
+            _json_load(b)), out="i"),
+    "json_type": _pyfn("s", _json_type),
+    "json_object": _pyfn("ss*", lambda *kv: _json_dump(
+        {_u(kv[i]): _try_json(kv[i + 1]) for i in range(0, len(kv), 2)})),
+    "json_array": _pyfn("s*", lambda *vs: _json_dump(
+        [_try_json(v) for v in vs]), null_propagate=False),
+    "json_keys": _pyfn("s", lambda b: (
+        lambda v: _json_dump(list(v.keys())) if isinstance(v, dict)
+        else None)(_json_load(b))),
+    "json_contains": _pyfn("ss", lambda doc, cand: int(
+        _json_contains(_json_load(doc), _json_load(cand))), out="i"),
+}
+
+
+def _json_valid(b) -> int:
+    try:
+        _json_load(b)
+        return 1
+    except (ValueError, UnicodeDecodeError):
+        return 0
+
+
+def _try_json(b):
+    if b is None:
+        return None  # SQL NULL → JSON null
+    try:
+        return _json_load(b)
+    except Exception:
+        return _u(b)
+
+
+def _json_contains(doc, cand):
+    if isinstance(doc, list):
+        if isinstance(cand, list):
+            return all(_json_contains(doc, c) for c in cand)
+        return any(_json_contains(d, cand) for d in doc) or doc == cand
+    if isinstance(doc, dict) and isinstance(cand, dict):
+        return all(k in doc and _json_contains(doc[k], v)
+                   for k, v in cand.items())
+    return doc == cand
+
+
+# -- network / misc ----------------------------------------------------------
+
+def _inet_aton(b):
+    parts = _u(b).split(".")
+    if not 1 <= len(parts) <= 4:
+        return None
+    try:
+        nums = [int(p) for p in parts]
+    except ValueError:
+        return None
+    if any(not 0 <= n <= 255 for n in nums[:-1]) or nums[-1] < 0:
+        return None
+    v = 0
+    for n in nums[:-1]:
+        v = (v << 8) | n
+    shift = 8 * (4 - len(parts) + 1)
+    if nums[-1] >= (1 << shift):
+        return None
+    return (v << shift) | nums[-1]
+
+
+def _is_ipv6(b):
+    import ipaddress
+    try:
+        return int(isinstance(ipaddress.ip_address(_u(b)),
+                              ipaddress.IPv6Address))
+    except ValueError:
+        return 0
+
+
+_MISC_FUNCS = {
+    "is_ipv4": _pyfn("s", lambda b: int(_inet_aton(b) is not None
+                                        and _u(b).count(".") == 3), out="i"),
+    "is_ipv6": _pyfn("s", _is_ipv6),
+    "inet_aton": _pyfn("s", _inet_aton, out="i"),
+    "inet_ntoa": _pyfn("i", lambda v: ".".join(
+        str((int(v) >> s) & 0xFF) for s in (24, 16, 8, 0)).encode()
+        if 0 <= int(v) <= 0xFFFFFFFF else None),
+    "sleep": _pyfn("f", lambda v: __import__("time").sleep(
+        min(max(v, 0), 5)) or 0, out="i"),
+    "uuid": _pyfn("", lambda: str(__import__("uuid").uuid4()).encode()),
+}
+
+
+def register_all():
+    for table in (_STRING_FUNCS, _MATH_FUNCS, _DATE_FUNCS, _JSON_FUNCS,
+                  _MISC_FUNCS):
+        for name, fn in table.items():
+            _DISPATCH.setdefault(name, fn)
+
+
+register_all()

@@ -376,6 +376,9 @@ def _num_common(sf: ScalarFunc, chunk: Chunk):
     if rk == K_DATE:
         rk = K_INT
     if lk == K_STR and rk == K_STR:
+        from ..utils.collate import is_ci, sort_key_array
+        if is_ci(l.ftype.collate) or is_ci(r.ftype.collate):
+            return K_STR, sort_key_array(ld), sort_key_array(rd), nulls, 0
         return K_STR, ld, rd, nulls, 0
     if K_FLOAT in (lk, rk) or K_STR in (lk, rk):
         return K_FLOAT, _as_float(ld, l.ftype), _as_float(rd, r.ftype), nulls, 0
@@ -640,13 +643,18 @@ def like_to_regex(pattern: bytes, escape: bytes = b"\\") -> re.Pattern:
             out.append(re.escape(c))
         i += 1
     out.append(b"$")
-    return re.compile(b"".join(out), re.DOTALL | re.IGNORECASE)
+    # case sensitivity follows the collation (utf8mb4_bin default =
+    # sensitive; _ci callers pass case-folded operands) — reference:
+    # builtinLikeSig uses the collator, not an ignore-case matcher
+    return re.compile(b"".join(out), re.DOTALL)
 
 
 def _eval_like(sf, chunk):
     d, n = sf.args[0].eval(chunk)
     pat = sf.args[1]
-    if isinstance(pat, Constant) and sf.extra is not None:
+    from ..utils.collate import is_ci, sort_key
+    ci = is_ci(sf.args[0].ftype.collate)
+    if isinstance(pat, Constant) and sf.extra is not None and not ci:
         rx = sf.extra
         pd = None
         pn = np.zeros(len(d), dtype=bool)
@@ -660,9 +668,15 @@ def _eval_like(sf, chunk):
             if not nulls[i]:
                 out[i] = rx.match(b if isinstance(b, bytes) else str(b).encode()) is not None
     else:
+        rx_cache: dict = {}  # compile once per distinct pattern, not per row
         for i, b in enumerate(d):
             if not nulls[i]:
-                out[i] = like_to_regex(pd[i]).match(b) is not None
+                p = sort_key(pd[i]) if ci else pd[i]
+                v = sort_key(b) if ci else b
+                rx2 = rx_cache.get(p)
+                if rx2 is None:
+                    rx2 = rx_cache[p] = like_to_regex(p)
+                out[i] = rx2.match(v) is not None
     return out.astype(np.int64), nulls
 
 
@@ -1347,3 +1361,8 @@ _DISPATCH = {
 
 def supported_scalar_ops():
     return set(_DISPATCH)
+
+
+# extended builtin library registers itself into _DISPATCH (import must stay
+# at the bottom: builtins_ext pulls helpers defined above)
+from . import builtins_ext as _builtins_ext  # noqa: E402,F401

@@ -73,6 +73,11 @@ def to_device_col(col) -> DeviceCol:
     sits across a fabric/tunnel)."""
     if col._device is None:
         if col.data.dtype == object:
+            from ..utils.collate import is_ci
+            if is_ci(col.ftype.collate):
+                # dict codes are byte-ordered; _ci semantics need the
+                # case-folded sort key — host path handles those columns
+                raise DeviceUnsupported("case-insensitive collation column")
             codes, _uniq = col.dict_encode()
             col._device = (jnp.asarray(codes), jnp.asarray(col.nulls))
         else:

@@ -862,6 +862,15 @@ class Parser:
             self._expect_op(")")
             return self._parse_over(ast.WindowFunc(name=fname, args=args))
         # special argument syntaxes
+        if fname == "timestampdiff":
+            unit = self._ident().lower()
+            self._expect_op(",")
+            a = self._parse_expr()
+            self._expect_op(",")
+            b = self._parse_expr()
+            self._expect_op(")")
+            return ast.FuncCall(name="timestampdiff",
+                                args=[ast.Literal("str", unit), a, b])
         if fname == "extract":
             unit = self._ident().lower()
             self._expect_kw("from")
@@ -1290,7 +1299,9 @@ class Parser:
                 if o == "character":
                     self._expect_kw("set")
                 self._accept_op("=")
-                self._ident()
+                ident = self._ident()
+                if o == "collate":
+                    col.options["collate"] = ident.lower()
             elif o == "references":
                 self.pos += 1
                 self._parse_table_name()
@@ -1401,7 +1412,9 @@ class Parser:
                 if w == "character":
                     self._expect_kw("set")
                     self._ident()
-                elif w in ("charset", "collate"):
+                elif w == "collate":
+                    ft.collate = self._ident().lower()
+                elif w == "charset":
                     self._ident()
             return ft
         if name in ("text", "tinytext", "mediumtext", "longtext", "blob",
@@ -1415,7 +1428,9 @@ class Parser:
                 self.pos += 1
                 if w == "character":
                     self._expect_kw("set")
-                self._ident()
+                ident = self._ident()
+                if w == "collate":
+                    ft.collate = ident.lower()
             return ft
         if name == "date":
             ft.tp = TYPE_DATE
@@ -1456,7 +1471,9 @@ class Parser:
                 self.pos += 1
                 if w == "character":
                     self._expect_kw("set")
-                self._ident()
+                ident = self._ident()
+                if w == "collate":
+                    ft.collate = ident.lower()
             return ft
         raise ParseError(f"unsupported data type {name!r}")
 

@@ -68,8 +68,10 @@ def _normalize_frame(frame, name):
     if frame is None or name in _RANKERS:  # rankers ignore frames (SQL std)
         return None
     unit, lo, hi = frame
-    if (lo, hi) == (("unbounded_preceding", 0), ("current", 0)):
-        return None  # the default frame
+    if (unit == "range"
+            and (lo, hi) == (("unbounded_preceding", 0), ("current", 0))):
+        return None  # exactly the default frame (peer-aware); the ROWS
+        # spelling is NOT equivalent when order keys tie — keep it explicit
     if unit == "range":
         if (lo, hi) == (("unbounded_preceding", 0),
                         ("unbounded_following", 0)):

@@ -1,0 +1,43 @@
+"""Collation support (reference: util/collate/collate.go — binary,
+utf8mb4_general_ci, utf8mb4_unicode_ci collators behind sort keys).
+
+Case-insensitive collations compare by a precomputed sort key; this engine
+implements the general_ci family as upper-cased UTF-8 (the dominant effect
+of MySQL's general_ci weight table: simple per-character case folding;
+unicode_ci's multi-char expansions are approximated the same way, which
+matches general_ci exactly and unicode_ci for the common plane). The sort
+key transform is applied wherever string ordering/equality feeds a kernel:
+comparisons, GROUP BY/DISTINCT keys, join keys, ORDER BY, and window
+partition/order keys. Device fragments decline _ci columns (dict codes are
+byte-ordered) and fall back to the host path."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_ci(collate: str | None) -> bool:
+    return bool(collate) and collate.endswith("_ci")
+
+
+def needs_ci(ftype) -> bool:
+    from ..expression import phys_kind, K_STR
+    return phys_kind(ftype) == K_STR and is_ci(ftype.collate)
+
+
+def sort_key(b: bytes) -> bytes:
+    return b.decode("utf-8", "replace").upper().encode("utf-8")
+
+
+def sort_key_array(data: np.ndarray) -> np.ndarray:
+    out = np.empty(len(data), dtype=object)
+    for i, b in enumerate(data):
+        out[i] = sort_key(b) if isinstance(b, (bytes, bytearray)) else b
+    return out
+
+
+def key_for_compare(data: np.ndarray, ftype) -> np.ndarray:
+    """data unchanged for binary collations; sort keys for _ci."""
+    if needs_ci(ftype):
+        return sort_key_array(data)
+    return data
