@@ -1,0 +1,199 @@
+"""Privileges / RBAC: grant tables, CREATE USER / GRANT / REVOKE,
+RequestVerification on statements, wire auth against mysql.user
+(reference: privilege/privileges/cache.go:1069, executor/grant.go)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session import Session
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 2), (3, 4)")
+    return tk
+
+
+def _as_user(tk, user, host="%"):
+    s = Session(tk.session.domain)
+    s.user = f"{user}@{host}"
+    return s
+
+
+def test_grant_tables_bootstrap(tk):
+    r = tk.must_query(
+        "select user, host, select_priv, super_priv from mysql.user")
+    assert ("root", "%", "Y", "Y") in {tuple(x) for x in r.rows}
+
+
+def test_create_user_and_deny_by_default(tk):
+    tk.must_exec("create user 'bob'@'%' identified by 'pw1'")
+    bob = _as_user(tk, "bob")
+    with pytest.raises(TiDBError) as ei:
+        bob.execute("select * from t")
+    assert "denied" in str(ei.value)
+    # and writes too
+    with pytest.raises(TiDBError):
+        bob.execute("insert into t values (9, 9)")
+    with pytest.raises(TiDBError):
+        bob.execute("drop table t")
+
+
+def test_grant_table_level_select(tk):
+    tk.must_exec("create user 'bob'@'%'")
+    tk.must_exec("grant select on test.t to 'bob'@'%'")
+    bob = _as_user(tk, "bob")
+    r = bob.execute("select count(*) from t")[0]
+    assert r.rows == [("2",)]
+    with pytest.raises(TiDBError):
+        bob.execute("insert into t values (9, 9)")
+
+
+def test_grant_db_level(tk):
+    tk.must_exec("create user 'carl'@'%'")
+    tk.must_exec("grant select, insert on test.* to 'carl'@'%'")
+    carl = _as_user(tk, "carl")
+    carl.execute("insert into t values (9, 9)")
+    assert carl.execute("select count(*) from t")[0].rows == [("3",)]
+    with pytest.raises(TiDBError):
+        carl.execute("delete from t where a = 9")
+
+
+def test_grant_global_all(tk):
+    tk.must_exec("create user 'admin2'@'%'")
+    tk.must_exec("grant all on *.* to 'admin2'@'%'")
+    a = _as_user(tk, "admin2")
+    a.execute("create table t2 (x int primary key)")
+    a.execute("insert into t2 values (1)")
+    a.execute("drop table t2")
+
+
+def test_revoke(tk):
+    tk.must_exec("create user 'bob'@'%'")
+    tk.must_exec("grant select on test.* to 'bob'@'%'")
+    bob = _as_user(tk, "bob")
+    bob.execute("select * from t")
+    tk.must_exec("revoke select on test.* from 'bob'@'%'")
+    with pytest.raises(TiDBError):
+        bob.execute("select * from t")
+
+
+def test_drop_user(tk):
+    tk.must_exec("create user 'gone'@'%'")
+    tk.must_exec("drop user 'gone'@'%'")
+    r = tk.must_query("select count(*) from mysql.user where user = 'gone'")
+    assert r.rows == [("0",)]
+    e = tk.exec_error("drop user 'gone'@'%'")
+    assert "DROP USER failed" in str(e)
+
+
+def test_show_grants(tk):
+    tk.must_exec("create user 'bob'@'%'")
+    tk.must_exec("grant select on test.t to 'bob'@'%'")
+    tk.must_exec("grant insert on test.* to 'bob'@'%'")
+    r = tk.must_query("show grants for 'bob'@'%'")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "ON test.t" in text and "ON test.*" in text
+    r = tk.must_query("show grants")  # current user = root
+    assert "ALL PRIVILEGES" in r.rows[0][0]
+
+
+def test_grantee_cannot_grant(tk):
+    tk.must_exec("create user 'bob'@'%'")
+    tk.must_exec("grant select on test.* to 'bob'@'%'")
+    bob = _as_user(tk, "bob")
+    with pytest.raises(TiDBError):
+        bob.execute("grant select on test.* to 'bob'@'%'")
+    with pytest.raises(TiDBError):
+        bob.execute("create user 'eve'@'%'")
+
+
+def test_explain_analyze_checked(tk):
+    tk.must_exec("create user 'bob'@'%'")
+    bob = _as_user(tk, "bob")
+    with pytest.raises(TiDBError):
+        bob.execute("explain analyze select * from t")
+
+
+def test_information_schema_open(tk):
+    tk.must_exec("create user 'bob'@'%'")
+    bob = _as_user(tk, "bob")
+    bob.execute("select * from information_schema.tables")
+    bob.execute("show databases")
+
+
+def test_wire_auth_against_grant_tables(tk):
+    import sys
+    sys.path.insert(0, "tests")
+    from test_server import MiniClient
+    from tidb_tpu.server import MySQLServer
+    tk.must_exec("create user 'wire'@'%' identified by 'sekret'")
+    tk.must_exec("grant select on test.* to 'wire'@'%'")
+    srv = MySQLServer(tk.session.domain, port=0).start()
+    try:
+        c = MiniClient(srv.port, user="wire", password="sekret")
+        kind, payload = c.query("select count(*) from test.t")
+        assert kind == "rows" and payload[1] == [("2",)]
+        # wrong password rejected
+        with pytest.raises(AssertionError):
+            MiniClient(srv.port, user="wire", password="nope")
+        # root with empty password still works
+        MiniClient(srv.port, user="root", password="")
+    finally:
+        srv.shutdown()
+
+
+def test_alter_user_password(tk):
+    tk.must_exec("create user 'pw'@'%' identified by 'old'")
+    tk.must_exec("alter user 'pw'@'%' identified by 'new'")
+    priv = tk.session.domain.priv
+    from tidb_tpu.server import protocol as P
+    salt = b"s" * 20
+    resp = P.native_password_hash(b"new", salt)
+    assert priv.check_password_response("pw", salt, resp)
+    resp_old = P.native_password_hash(b"old", salt)
+    assert not priv.check_password_response("pw", salt, resp_old)
+
+
+def test_grant_in_explicit_txn_effective(tk):
+    """GRANT implicitly commits the open txn and reloads from committed
+    state (review regression)."""
+    tk.must_exec("create user 'txu'@'%'")
+    tk.must_exec("begin")
+    tk.must_exec("insert into t values (50, 50)")
+    tk.must_exec("grant select on test.t to 'txu'@'%'")
+    u = _as_user(tk, "txu")
+    u.execute("select * from t")  # effective immediately
+    # the pre-GRANT insert was implicitly committed too
+    assert tk.must_query("select count(*) from t where a = 50"
+                         ).rows == [("1",)]
+
+
+def test_update_with_read_only_subquery(tk):
+    tk.must_exec("create table src (x int primary key)")
+    tk.must_exec("insert into src values (7)")
+    tk.must_exec("create user 'upd'@'%'")
+    tk.must_exec("grant select, update on test.t to 'upd'@'%'")
+    tk.must_exec("grant select on test.src to 'upd'@'%'")
+    u = _as_user(tk, "upd")
+    u.execute("update t set b = (select max(x) from src) where a = 1")
+    assert tk.must_query("select b from t where a = 1").rows == [("7",)]
+
+
+def test_revoke_usage_noop(tk):
+    tk.must_exec("create user 'ru'@'%'")
+    tk.must_exec("revoke usage on *.* from 'ru'@'%'")  # must not crash
+
+
+def test_localhost_scoped_user(tk):
+    tk.must_exec("create user 'loc'@'localhost' identified by 'pw'")
+    priv = tk.session.domain.priv
+    from tidb_tpu.server import protocol as P
+    salt = b"x" * 20
+    resp = P.native_password_hash(b"pw", salt)
+    rec = priv.check_password_response("loc", salt, resp, host="127.0.0.1")
+    assert rec is not None and rec.host == "localhost"
+    assert priv.check_password_response("loc", salt, resp, host="8.8.8.8") is None

@@ -35,7 +35,10 @@ class ErrCode:
     CantDropFieldOrKey = 1091
     UnknownTable = 1109
     NoPermission = 1142
+    TableaccessDenied = 1142
+    DBaccessDenied = 1044
     AccessDenied = 1045
+    CannotUser = 1396
     WrongDBName = 1102
     WrongTableName = 1103
     WrongColumnName = 1166

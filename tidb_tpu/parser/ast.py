@@ -687,6 +687,62 @@ class SetStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class CreateUserStmt(StmtNode):
+    users: list = field(default_factory=list)  # [(user, host, password|None)]
+    if_not_exists: bool = False
+
+    def restore(self):
+        return "CREATE USER " + ", ".join(
+            f"'{u}'@'{h}'" for u, h, _p in self.users)
+
+
+@dataclass(repr=False)
+class DropUserStmt(StmtNode):
+    users: list = field(default_factory=list)  # [(user, host)]
+    if_exists: bool = False
+
+    def restore(self):
+        return "DROP USER " + ", ".join(
+            f"'{u}'@'{h}'" for u, h in self.users)
+
+
+@dataclass(repr=False)
+class AlterUserStmt(StmtNode):
+    users: list = field(default_factory=list)  # [(user, host, password)]
+    if_exists: bool = False
+
+    def restore(self):
+        return "ALTER USER"
+
+
+@dataclass(repr=False)
+class GrantStmt(StmtNode):
+    privs: list = field(default_factory=list)   # ["select", ...] or ["all"]
+    db: str = ""                                # "*" = global
+    table: str = ""                             # "*" = whole db
+    users: list = field(default_factory=list)   # [(user, host, password|None)]
+    with_grant: bool = False
+
+    def restore(self):
+        return (f"GRANT {', '.join(p.upper() for p in self.privs)} "
+                f"ON {self.db}.{self.table} TO " + ", ".join(
+                    f"'{u}'@'{h}'" for u, h, _p in self.users))
+
+
+@dataclass(repr=False)
+class RevokeStmt(StmtNode):
+    privs: list = field(default_factory=list)
+    db: str = ""
+    table: str = ""
+    users: list = field(default_factory=list)   # [(user, host)]
+
+    def restore(self):
+        return (f"REVOKE {', '.join(p.upper() for p in self.privs)} "
+                f"ON {self.db}.{self.table} FROM " + ", ".join(
+                    f"'{u}'@'{h}'" for u, h in self.users))
+
+
+@dataclass(repr=False)
 class ShowStmt(StmtNode):
     kind: str = ""   # databases|tables|columns|create_table|variables|index|processlist|status|engines|charset|collation|warnings|schemas|table_status
     target: object = None

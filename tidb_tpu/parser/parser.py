@@ -199,6 +199,10 @@ class Parser:
             return ast.AnalyzeTableStmt(tables=tables)
         if kw == "admin":
             return self._parse_admin()
+        if kw == "grant":
+            return self._parse_grant()
+        if kw == "revoke":
+            return self._parse_revoke()
         if kw == "prepare":
             self.pos += 1
             name = self._ident()
@@ -1129,8 +1133,117 @@ class Parser:
 
     # -- DDL ----------------------------------------------------------------
 
+    def _parse_user_spec(self):
+        """'u'@'h' | 'u' | u@h | CURRENT_USER() → (user, host)."""
+        t = self._cur()
+        if t.kind in (STRING, IDENT, QIDENT):
+            user = t.val
+            self.pos += 1
+        else:
+            raise ParseError(f"expected user near {self._near()}")
+        host = "%"
+        t = self._cur()
+        if t.kind == USERVAR:
+            self.pos += 1
+            if t.val:
+                host = t.val
+            else:
+                h = self._cur()
+                if h.kind in (STRING, IDENT, QIDENT):
+                    host = h.val
+                    self.pos += 1
+        return user, host
+
+    def _parse_user_with_auth(self):
+        user, host = self._parse_user_spec()
+        pw = None
+        if self._accept_kw("identified"):
+            if self._accept_kw("with"):
+                self._ident()  # auth plugin name
+                if not self._peek_kw("by") and not self._peek_kw("as"):
+                    return user, host, pw
+            if self._accept_kw("by") or self._accept_kw("as"):
+                t = self._cur()
+                if t.kind == STRING:
+                    pw = t.val.decode() if isinstance(t.val, bytes) else t.val
+                    self.pos += 1
+        return user, host, pw
+
+    _PRIV_WORDS = {"select", "insert", "update", "delete", "create", "drop",
+                   "index", "alter", "super", "grant", "references",
+                   "execute", "process", "reload", "trigger", "usage"}
+
+    def _parse_priv_list(self):
+        privs = []
+        if self._accept_kw("all"):
+            self._accept_kw("privileges")
+            return ["all"]
+        while True:
+            w = self._ident().lower()
+            if w not in self._PRIV_WORDS:
+                raise ParseError(f"unknown privilege '{w}'")
+            if w == "grant":
+                self._expect_kw("option")
+            privs.append(w)
+            if not self._accept_op(","):
+                break
+        return privs
+
+    def _parse_grant_target(self):
+        """ON *.* | db.* | db.tbl | tbl → (db, table)."""
+        if self._accept_op("*"):
+            self._expect_op(".")
+            self._expect_op("*")
+            return "*", "*"
+        name = self._ident()
+        if self._accept_op("."):
+            if self._accept_op("*"):
+                return name, "*"
+            return name, self._ident()
+        return "", name  # current db
+
+    def _parse_grant(self):
+        self._expect_kw("grant")
+        privs = self._parse_priv_list()
+        self._expect_kw("on")
+        self._accept_kw("table")
+        db, table = self._parse_grant_target()
+        self._expect_kw("to")
+        users = [self._parse_user_with_auth()]
+        while self._accept_op(","):
+            users.append(self._parse_user_with_auth())
+        with_grant = False
+        if self._accept_kw("with"):
+            self._expect_kw("grant")
+            self._expect_kw("option")
+            with_grant = True
+        return ast.GrantStmt(privs=privs, db=db, table=table, users=users,
+                             with_grant=with_grant)
+
+    def _parse_revoke(self):
+        self._expect_kw("revoke")
+        privs = self._parse_priv_list()
+        self._expect_kw("on")
+        self._accept_kw("table")
+        db, table = self._parse_grant_target()
+        self._expect_kw("from")
+        users = [self._parse_user_spec()]
+        while self._accept_op(","):
+            users.append(self._parse_user_spec())
+        return ast.RevokeStmt(privs=privs, db=db, table=table, users=users)
+
     def _parse_create(self):
         self._expect_kw("create")
+        if self._accept_kw("user"):
+            ine = False
+            if self._accept_kw("if"):
+                self._expect_kw("not")
+                self._expect_kw("exists")
+                ine = True
+            users = [self._parse_user_with_auth()]
+            while self._accept_op(","):
+                users.append(self._parse_user_with_auth())
+            return ast.CreateUserStmt(users=users, if_not_exists=ine)
         if self._accept_kw("database") or self._accept_kw("schema"):
             ine = False
             if self._accept_kw("if"):
@@ -1479,6 +1592,15 @@ class Parser:
 
     def _parse_drop(self):
         self._expect_kw("drop")
+        if self._accept_kw("user"):
+            ie = False
+            if self._accept_kw("if"):
+                self._expect_kw("exists")
+                ie = True
+            users = [self._parse_user_spec()]
+            while self._accept_op(","):
+                users.append(self._parse_user_spec())
+            return ast.DropUserStmt(users=users, if_exists=ie)
         if self._accept_kw("database") or self._accept_kw("schema"):
             ie = False
             if self._accept_kw("if"):
@@ -1507,6 +1629,15 @@ class Parser:
 
     def _parse_alter(self):
         self._expect_kw("alter")
+        if self._accept_kw("user"):
+            ie = False
+            if self._accept_kw("if"):
+                self._expect_kw("exists")
+                ie = True
+            users = [self._parse_user_with_auth()]
+            while self._accept_op(","):
+                users.append(self._parse_user_with_auth())
+            return ast.AlterUserStmt(users=users, if_exists=ie)
         self._expect_kw("table")
         stmt = ast.AlterTableStmt(table=self._parse_table_name())
         while True:
@@ -1717,6 +1848,8 @@ class Parser:
             stmt.kind = "charset"
         elif self._accept_kw("grants"):
             stmt.kind = "grants"
+            if self._accept_kw("for"):
+                stmt.target = self._parse_user_spec()
         else:
             raise ParseError(f"unsupported SHOW near {self._near()}")
         if self._accept_kw("like"):

@@ -1,0 +1,217 @@
+"""MySQL-compatible privilege system (reference: privilege/privileges/
+cache.go — grant tables mysql.user / mysql.db / mysql.tables_priv loaded
+into an in-memory cache; RequestVerification at cache.go:1069; GRANT/REVOKE
+execute as DML on the grant tables + cache reload, executor/grant.go).
+
+The grant tables are REAL tables created at bootstrap (SQL-queryable like
+the reference), and this module keeps the fast lookup cache in sync."""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from .errors import TiDBError, ErrCode
+
+#: column order of the per-level priv flags
+PRIVS = ("select", "insert", "update", "delete", "create", "drop",
+         "index", "alter", "super", "grant")
+DB_PRIVS = PRIVS[:8]  # db/table level: no super
+
+BOOTSTRAP_SQL = [
+    """create table if not exists mysql.user (
+        host varchar(255), user varchar(32),
+        authentication_string varchar(128),
+        select_priv varchar(1), insert_priv varchar(1),
+        update_priv varchar(1), delete_priv varchar(1),
+        create_priv varchar(1), drop_priv varchar(1),
+        index_priv varchar(1), alter_priv varchar(1),
+        super_priv varchar(1), grant_priv varchar(1),
+        primary key (host, user))""",
+    """create table if not exists mysql.db (
+        host varchar(255), db varchar(64), user varchar(32),
+        select_priv varchar(1), insert_priv varchar(1),
+        update_priv varchar(1), delete_priv varchar(1),
+        create_priv varchar(1), drop_priv varchar(1),
+        index_priv varchar(1), alter_priv varchar(1),
+        primary key (host, db, user))""",
+    """create table if not exists mysql.tables_priv (
+        host varchar(255), db varchar(64), user varchar(32),
+        table_name varchar(64), table_priv varchar(255),
+        primary key (host, db, user, table_name))""",
+]
+
+ROOT_ROW = ("insert into mysql.user values ('%', 'root', '', "
+            + ", ".join(["'Y'"] * 10) + ")")
+
+
+def mysql_native_hash(password: str) -> str:
+    """MySQL native_password storage format *HEX(SHA1(SHA1(pw)))."""
+    if not password:
+        return ""
+    h = hashlib.sha1(hashlib.sha1(password.encode()).digest()).hexdigest()
+    return "*" + h.upper()
+
+
+class UserRecord:
+    __slots__ = ("host", "user", "auth", "privs")
+
+    def __init__(self, host, user, auth, privs):
+        self.host = host
+        self.user = user
+        self.auth = auth          # *HEX or "" (empty password)
+        self.privs = privs        # set of global privs
+
+
+class PrivManager:
+    """In-memory cache over the grant tables (reference:
+    privileges.MySQLPrivilege)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self._lock = threading.Lock()
+        self.users: list[UserRecord] = []
+        self.dbs: list[tuple] = []        # (host, db, user, set(privs))
+        self.tables: list[tuple] = []     # (host, db, user, table, set)
+        self.enabled = False  # flips on once the grant tables exist
+
+    # -- load (reference: cache.go LoadAll) ---------------------------------
+
+    def load(self):
+        try:
+            infos = self.domain.infoschema()
+            if infos.table_by_name("mysql", "user") is None:
+                return
+        except Exception:
+            return
+        users, dbs, tables = [], [], []
+        txn = self.domain.store.begin()
+        try:
+            from .table import Table
+            uinfo = infos.table_by_name("mysql", "user")
+            for _h, row in Table(uinfo, txn).iter_rows():
+                vals = _row_strs(uinfo, row)
+                privs = {p for p, v in zip(PRIVS, vals[3:13]) if v == "Y"}
+                users.append(UserRecord(vals[0], vals[1], vals[2], privs))
+            dinfo = infos.table_by_name("mysql", "db")
+            for _h, row in Table(dinfo, txn).iter_rows():
+                vals = _row_strs(dinfo, row)
+                privs = {p for p, v in zip(DB_PRIVS, vals[3:11]) if v == "Y"}
+                dbs.append((vals[0], vals[1], vals[2], privs))
+            tinfo = infos.table_by_name("mysql", "tables_priv")
+            for _h, row in Table(tinfo, txn).iter_rows():
+                vals = _row_strs(tinfo, row)
+                privs = {p.strip().lower()
+                         for p in vals[4].split(",") if p.strip()}
+                tables.append((vals[0], vals[1], vals[2], vals[3], privs))
+        finally:
+            txn.rollback()
+        with self._lock:
+            self.users, self.dbs, self.tables = users, dbs, tables
+            self.enabled = True
+
+    # -- auth (reference: privileges.ConnectionVerification) ---------------
+
+    def match_user(self, user: str, host: str = "%") -> UserRecord | None:
+        """Most-specific host wins: exact host, then localhost aliases,
+        then the '%' wildcard (reference: cache.go connectionVerification
+        host matching)."""
+        with self._lock:
+            exact = [u for u in self.users if u.user == user]
+        candidates = [host]
+        if host in ("127.0.0.1", "::1", "localhost"):
+            candidates += ["localhost", "127.0.0.1"]
+        for h in candidates:
+            for u in exact:
+                if u.host == h:
+                    return u
+        for u in exact:
+            if u.host == "%":
+                return u
+        return None
+
+    def check_password_response(self, user, salt, response,
+                                host: str = "%") -> "UserRecord | None":
+        """Validate a mysql_native_password challenge response against the
+        stored *HEX(SHA1(SHA1(pw))) hash: response ^ SHA1(salt+stored)
+        must SHA1 to the stored hash. Returns the matched record (its host
+        scopes the session's privileges) or None."""
+        rec = self.match_user(user, host)
+        if rec is None:
+            return None
+        if not rec.auth:
+            return rec if not response else None  # empty password
+        stored = bytes.fromhex(rec.auth[1:])
+        mix = hashlib.sha1(salt + stored).digest()
+        if len(response) != len(mix):
+            return None
+        stage1 = bytes(a ^ b for a, b in zip(response, mix))
+        return rec if hashlib.sha1(stage1).digest() == stored else None
+
+    # -- verification (reference: cache.go:1069 RequestVerification) --------
+
+    def verify(self, user_at_host: str, db: str, table: str, priv: str):
+        if not self.enabled:
+            return
+        user, _, host = user_at_host.partition("@")
+        rec = self.match_user(user, host or "%")
+        if rec is not None and ("super" in rec.privs or priv in rec.privs):
+            return
+        dbl = (db or "").lower()
+        if dbl in ("information_schema", "performance_schema",
+                   "metrics_schema") and priv == "select":
+            return
+        hostv = host or "%"
+
+        def host_ok(row_host):
+            return row_host == "%" or row_host == hostv
+        with self._lock:
+            for h, d, u, privs in self.dbs:
+                if (u == user and host_ok(h) and d.lower() == dbl
+                        and priv in privs):
+                    return
+            for h, d, u, t, privs in self.tables:
+                if (u == user and host_ok(h) and d.lower() == dbl
+                        and t.lower() == (table or "").lower()
+                        and priv in privs):
+                    return
+        raise TiDBError(
+            f"{priv.upper()} command denied to user '{user}'@'{host or '%'}'"
+            f" for table '{db}.{table}'" if table else
+            f"{priv.upper()} command denied to user '{user}'@'{host or '%'}'",
+            code=ErrCode.TableaccessDenied)
+
+    def grants_for(self, user: str, host: str = "%") -> list[str]:
+        """SHOW GRANTS lines (reference: privileges.ShowGrants)."""
+        out = []
+        rec = self.match_user(user, host)
+        if rec is not None:
+            if set(PRIVS).issubset(rec.privs):
+                g = ["ALL PRIVILEGES"]
+            else:
+                g = [p.upper() for p in PRIVS[:9] if p in rec.privs] \
+                    or ["USAGE"]
+            line = f"GRANT {', '.join(g)} ON *.* TO '{user}'@'{rec.host}'"
+            if "grant" in rec.privs:
+                line += " WITH GRANT OPTION"
+            out.append(line)
+        with self._lock:
+            for h, d, u, privs in self.dbs:
+                if u == user and privs:
+                    out.append(f"GRANT {', '.join(p.upper() for p in sorted(privs))} "
+                               f"ON {d}.* TO '{user}'@'{h}'")
+            for h, d, u, t, privs in self.tables:
+                if u == user and privs:
+                    out.append(f"GRANT {', '.join(p.upper() for p in sorted(privs))} "
+                               f"ON {d}.{t} TO '{user}'@'{h}'")
+        return out
+
+
+def _row_strs(info, row: dict) -> list[str]:
+    out = []
+    for c in info.public_columns():
+        v = row.get(c.id)
+        if isinstance(v, (bytes, bytearray)):
+            v = v.decode("utf-8", "replace")
+        out.append("" if v is None else str(v))
+    return out

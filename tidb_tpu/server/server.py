@@ -23,13 +23,12 @@ from .packet import (PacketIO, lenenc_int, read_lenenc_int, read_nul_str)
 
 class MySQLServer:
     def __init__(self, domain, host="127.0.0.1", port=4000, users=None):
-        """users: optional {user: password} map. Default (None) is the
-        bootstrap behavior: root with empty password ONLY — accepting any
-        credential pair would hand full SQL access to anything that can
-        reach the port. Pass users={} to explicitly accept any login
-        (hermetic tests)."""
+        """users: optional static {user: password} map override. Default
+        (None) authenticates against the mysql.user grant tables (falling
+        back to empty-password root when the domain has no grant tables).
+        Pass users={} to explicitly accept any login (hermetic tests)."""
         self.domain = domain
-        self.users = {"root": ""} if users is None else users
+        self.users = users
         self._next_conn_id = 0
         self._lock = threading.Lock()
         self.connections = {}
@@ -85,12 +84,17 @@ class MySQLServer:
             except Exception:
                 pass
             return
-        if not self._check_auth(user, auth, salt):
+        try:
+            peer = sock.getpeername()[0]
+        except OSError:
+            peer = "%"
+        matched_host = self._check_auth(user, auth, salt, peer)
+        if matched_host is None:
             io.write_packet(P.build_err(
                 1045, f"Access denied for user '{user}'", b"28000"))
             return
         session = new_session(self.domain)
-        session.user = f"{user}@%"
+        session.user = f"{user}@{matched_host}"
         if db:
             try:
                 session.execute(f"use `{db}`")
@@ -122,14 +126,24 @@ class MySQLServer:
             db, pos = read_nul_str(buf, pos)
         return user.decode(), db.decode(), auth
 
-    def _check_auth(self, user: str, auth: bytes, salt: bytes) -> bool:
+    def _check_auth(self, user: str, auth: bytes, salt: bytes,
+                    peer: str = "%") -> str | None:
+        """-> the matched account's host scope, or None on rejection."""
         if self.users == {}:
-            return True  # explicit opt-in: accept any login
-        if user not in self.users:
-            return False
-        expected = P.native_password_hash(
-            self.users[user].encode(), salt)
-        return auth == expected
+            return "%"  # explicit opt-in: accept any login
+        if self.users is not None:
+            if user not in self.users:
+                return None
+            expected = P.native_password_hash(
+                self.users[user].encode(), salt)
+            return "%" if auth == expected else None
+        # grant tables (reference: privileges.ConnectionVerification)
+        priv = getattr(self.domain, "priv", None)
+        if priv is not None and priv.enabled:
+            rec = priv.check_password_response(user, salt[:20], auth, peer)
+            return rec.host if rec is not None else None
+        # no grant tables: bootstrap behavior, empty-password root only
+        return "%" if (user == "root" and not auth) else None
 
     # -- command dispatch ---------------------------------------------------
 

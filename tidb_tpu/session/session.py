@@ -47,6 +47,8 @@ class Domain:
         self.sessions = weakref.WeakValueDictionary()
         from ..ddl_worker import DDLWorker
         self.ddl_worker = DDLWorker(self)   # async online-DDL owner worker
+        from ..privilege import PrivManager
+        self.priv = PrivManager(self)       # grant-table cache (RBAC)
         self.reload_schema()
 
     def reload_schema(self):
@@ -206,6 +208,7 @@ class Session:
         self.current_sql: str | None = None   # processlist info
         self.stmt_start = 0.0
         self.mem_tracker = None               # per-statement quota tracker
+        self._internal = 0                    # >0: internal SQL, skip priv
         domain.sessions[self.conn_id] = self
 
     def close(self):
@@ -326,6 +329,16 @@ class Session:
                 cache.apply_delta(info, deltas[tid], newv)
             except Exception:
                 cache.invalidate(tid)
+
+    def _implicit_commit(self):
+        """DDL and account-management statements implicitly commit the
+        active transaction first (reference: MySQL implicit commit;
+        session.go runs DDL outside the user txn)."""
+        self.explicit_txn = False
+        if self.txn is not None and self.txn.valid:
+            self._commit_txn()
+        else:
+            self.txn = None
 
     def begin(self):
         if self.txn is not None and self.txn.valid:
@@ -473,6 +486,23 @@ class Session:
                 pass  # observability must never fail the statement
 
     def _dispatch(self, stmt) -> Result:
+        if self.domain.priv.enabled and not self._internal:
+            from ..priv_check import check_stmt_privileges
+            check_stmt_privileges(self, stmt)
+        if isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt,
+                             ast.AlterUserStmt, ast.GrantStmt,
+                             ast.RevokeStmt)):
+            # implicit commit: the grant-table writes and the cache reload
+            # must see committed state, not the open txn's snapshot
+            self._implicit_commit()
+            from ..executor import priv_exec
+            fn = {ast.CreateUserStmt: priv_exec.create_user,
+                  ast.DropUserStmt: priv_exec.drop_user,
+                  ast.AlterUserStmt: priv_exec.alter_user,
+                  ast.GrantStmt: priv_exec.grant,
+                  ast.RevokeStmt: priv_exec.revoke}[type(stmt)]
+            fn(self, stmt)
+            return Result()
         if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
             return self.run_query(stmt)
         if isinstance(stmt, ast.InsertStmt):
@@ -505,6 +535,12 @@ class Session:
         if isinstance(stmt, ast.RollbackStmt):
             self.rollback()
             return Result()
+        if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
+                             ast.CreateTableStmt, ast.DropTableStmt,
+                             ast.TruncateTableStmt, ast.CreateIndexStmt,
+                             ast.DropIndexStmt, ast.AlterTableStmt,
+                             ast.RenameTableStmt)):
+            self._implicit_commit()  # DDL implicitly commits (MySQL rule)
         if isinstance(stmt, ast.ShowStmt):
             from .show import exec_show
             return exec_show(self, stmt)
@@ -707,27 +743,55 @@ class Session:
             self._expr_ctx.params = None
 
 
-BOOTSTRAP_VERSION = 1
+BOOTSTRAP_VERSION = 2  # v2: grant tables (mysql.user/db/tables_priv)
 
 
 def bootstrap_domain(store=None) -> Domain:
     """reference: session.BootstrapSession (session.go:2566) — creates system
-    databases and marks the bootstrap version."""
+    databases, the grant tables + root user, and marks the bootstrap
+    version (versioned like bootstrap.go's upgrade chain)."""
     from ..kv import new_store
     if store is None:
         store = new_store()
     txn = store.begin()
     m = Meta(txn)
-    if m.bootstrapped() >= BOOTSTRAP_VERSION:
+    ver = m.bootstrapped()
+    if ver >= BOOTSTRAP_VERSION:
         txn.rollback()
-        return Domain(store)
-    for db_name in ("mysql", "test"):
-        db = DBInfo(id=m.gen_global_id(), name=db_name)
-        m.create_database(db)
-    m.set_bootstrapped(BOOTSTRAP_VERSION)
-    m.bump_schema_version()
+        d = Domain(store)
+        d.priv.load()
+        return d
+    if ver < 1:
+        for db_name in ("mysql", "test"):
+            db = DBInfo(id=m.gen_global_id(), name=db_name)
+            m.create_database(db)
+        m.bump_schema_version()
     txn.commit()
     d = Domain(store)
+    if ver < 2:
+        # grant tables + root@% with all privileges (bootstrap.go:1739).
+        # The bootstrap version is only marked AFTER this succeeds: a crash
+        # mid-way re-runs the (idempotent) step instead of permanently
+        # skipping it and silently disabling the privilege system
+        from ..privilege import BOOTSTRAP_SQL, ROOT_ROW
+        s = Session(d)
+        s._internal = 1
+        try:
+            for sql in BOOTSTRAP_SQL:
+                s.execute(sql)
+            if not s.execute("select 1 from mysql.user where user = 'root'"
+                             )[-1].rows:
+                s.execute(ROOT_ROW)
+        finally:
+            s.close()
+    txn = store.begin()
+    try:
+        Meta(txn).set_bootstrapped(BOOTSTRAP_VERSION)
+        txn.commit()
+    except Exception:
+        txn.rollback()
+        raise
+    d.priv.load()
     d.load_stats()
     return d
 

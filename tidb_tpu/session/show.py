@@ -151,8 +151,16 @@ def exec_show(session, stmt: ast.ShowStmt):
                                             rows))
 
     if stmt.kind == "grants":
-        rows = [(b"GRANT ALL PRIVILEGES ON *.* TO 'root'@'%'",)]
-        return Result(names=["Grants for root@%"],
+        if stmt.target is not None:
+            user, host = stmt.target
+        else:
+            user, _, host = session.user.partition("@")
+            host = host or "%"
+        lines = session.domain.priv.grants_for(user, host)
+        if not lines:
+            lines = [f"GRANT USAGE ON *.* TO '{user}'@'{host}'"]
+        rows = [(ln.encode(),) for ln in lines]
+        return Result(names=[f"Grants for {user}@{host}"],
                       chunk=Chunk.from_rows([_S], rows))
 
     if stmt.kind == "table_status":
