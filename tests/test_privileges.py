@@ -197,3 +197,72 @@ def test_localhost_scoped_user(tk):
     rec = priv.check_password_response("loc", salt, resp, host="127.0.0.1")
     assert rec is not None and rec.host == "localhost"
     assert priv.check_password_response("loc", salt, resp, host="8.8.8.8") is None
+
+
+def test_grant_cannot_escalate(tk):
+    """Grant-option-only accounts cannot grant privileges they lack."""
+    tk.must_exec("create user 'esc'@'%'")
+    tk.must_exec("grant select on test.* to 'esc'@'%' with grant option")
+    # give grant option at global level too (directly via grant tables)
+    tk.must_exec("update mysql.user set grant_priv = 'Y' "
+                 "where user = 'esc'")
+    tk.session.domain.priv.load()
+    esc = _as_user(tk, "esc")
+    with pytest.raises(TiDBError):
+        esc.execute("grant all on *.* to 'esc'@'%'")
+    with pytest.raises(TiDBError):
+        esc.execute("grant insert on test.* to 'esc'@'%'")
+    # but CAN grant what it holds
+    tk.must_exec("create user 'peer'@'%'")
+    esc.execute("grant select on test.* to 'peer'@'%'")
+
+
+def test_rename_table_checked(tk):
+    tk.must_exec("create user 'ren'@'%'")
+    ren = _as_user(tk, "ren")
+    with pytest.raises(TiDBError):
+        ren.execute("rename table t to stolen")
+    assert tk.session.infoschema().has_table("test", "t")
+
+
+def test_deeply_nested_fails_closed(tk):
+    tk.must_exec("create user 'deep'@'%'")
+    deep = _as_user(tk, "deep")
+    q = "select * from t"
+    for _ in range(80):
+        q = f"select * from ({q}) x"
+    with pytest.raises(TiDBError):
+        deep.execute(q)
+
+
+def test_show_grants_other_user_denied(tk):
+    tk.must_exec("create user 'nosy'@'%'")
+    nosy = _as_user(tk, "nosy")
+    with pytest.raises(TiDBError):
+        nosy.execute("show grants for 'root'@'%'")
+    nosy.execute("show grants")  # own grants always visible
+
+
+def test_db_level_denial_error_code(tk):
+    tk.must_exec("create user 'dbu'@'%'")
+    u = _as_user(tk, "dbu")
+    with pytest.raises(TiDBError) as ei:
+        u.execute("create database offlimits")
+    assert getattr(ei.value, "code", None) == 1044
+
+
+def test_join_and_derived_sources_checked(tk):
+    """Join trees and derived tables are real read sources (regression:
+    the AST walker skipped non-Stmt/Expr nodes, leaving them unchecked)."""
+    tk.must_exec("create table t2 (a int primary key)")
+    tk.must_exec("insert into t2 values (1)")
+    tk.must_exec("create user 'jn'@'%'")
+    tk.must_exec("grant select on test.t2 to 'jn'@'%'")
+    jn = _as_user(tk, "jn")
+    with pytest.raises(TiDBError):
+        jn.execute("select * from t2 join t on t2.a = t.a")
+    with pytest.raises(TiDBError):
+        jn.execute("select * from (select * from t) x")
+    with pytest.raises(TiDBError):
+        jn.execute("select * from t2, t")
+    jn.execute("select * from (select * from t2) x")

@@ -1,0 +1,139 @@
+"""Server process entry: flags → config layering, HTTP status API,
+graceful startup/shutdown (reference: tidb-server/main.go,
+server/http_status.go)."""
+
+import json
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from tidb_tpu.config import Config, load_config
+from tidb_tpu.server.main import build_arg_parser, resolve_config
+
+
+def test_config_defaults():
+    cfg = Config()
+    assert cfg.port == 4000 and cfg.status.status_port == 10080
+
+
+def test_config_toml_and_flag_override(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("""
+host = "0.0.0.0"
+port = 4567
+[performance]
+mem-quota-query = 123456
+executor-engine = "host"
+[status]
+status-port = 9999
+""")
+    args = build_arg_parser().parse_args(
+        ["--config", str(p), "--port", "5000"])
+    cfg = resolve_config(args)
+    assert cfg.host == "0.0.0.0"
+    assert cfg.port == 5000  # CLI wins over file
+    assert cfg.performance.mem_quota_query == 123456
+    assert cfg.performance.executor_engine == "host"
+    assert cfg.status.status_port == 9999
+
+
+def test_config_strict_rejects_unknown(tmp_path):
+    p = tmp_path / "bad.toml"
+    p.write_text("nonsense = 1\n")
+    with pytest.raises(ValueError):
+        load_config(str(p), strict=True)
+    # non-strict only warns
+    load_config(str(p), strict=False)
+
+
+@pytest.fixture()
+def running_server():
+    """The pieces run_server composes, on ephemeral ports (run_server
+    itself installs signal handlers, which only work on the main thread)."""
+    from tidb_tpu.kv import new_store
+    from tidb_tpu.session import bootstrap_domain
+    from tidb_tpu.server.server import MySQLServer
+    from tidb_tpu.server.http_status import StatusServer
+    domain = bootstrap_domain(new_store())
+    sql = MySQLServer(domain, port=0).start()
+    status = StatusServer(domain, sql, port=0).start()
+    yield domain, sql, status
+    status.shutdown()
+    sql.shutdown()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_status_api(running_server):
+    domain, sql, status = running_server
+    code, body = _get(status.port, "/status")
+    assert code == 200
+    st = json.loads(body)
+    assert st["version"].endswith("tpu-htap") and "kv_engine" in st
+
+    from tidb_tpu.session import new_session
+    s = new_session(domain)
+    s.execute("create table st (a int primary key)")
+    s.execute("insert into st values (1)")
+    s.execute("create index i_a on st (a)")
+
+    code, body = _get(status.port, "/schema")
+    assert "test" in json.loads(body)
+    code, body = _get(status.port, "/schema/test")
+    assert "st" in json.loads(body)
+    code, body = _get(status.port, "/schema/test/st")
+    tbl = json.loads(body)
+    assert tbl["name"] == "st"
+
+    code, body = _get(status.port, "/ddl/history")
+    hist = json.loads(body)
+    assert any(j["type"] == "add_index" and j["state"] == "synced"
+               for j in hist)
+
+    code, body = _get(status.port, "/metrics")
+    assert "executor_statement_total" in body
+    assert "server_connections" in body
+
+    code, body = _get(status.port, "/regions")
+    assert json.loads(body)
+
+    # 404 for unknown path
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        _get(status.port, "/nope")
+
+
+def test_wire_and_status_together(running_server):
+    domain, sql, status = running_server
+    from test_server import MiniClient
+    c = MiniClient(sql.port)
+    c.query("create table wt (a int primary key)")
+    c.query("insert into wt values (7)")
+    kind, payload = c.query("select a from wt")
+    assert payload[1] == [("7",)]
+    code, body = _get(status.port, "/schema/test")
+    assert "wt" in json.loads(body)
+
+
+def test_version_flag(capsys):
+    from tidb_tpu.server.main import main
+    assert main(["--version"]) == 0
+    assert "tpu-htap" in capsys.readouterr().out
+
+
+def test_config_check_mode(tmp_path, capsys):
+    from tidb_tpu.server.main import main
+    p = tmp_path / "ok.toml"
+    p.write_text("port = 4001\n")
+    assert main(["--config", str(p), "--config-check"]) == 0
+    bad = tmp_path / "bad.toml"
+    bad.write_text("bogus = true\n")
+    assert main(["--config", str(bad), "--config-check"]) == 1

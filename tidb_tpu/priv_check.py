@@ -10,8 +10,13 @@ from .parser import ast
 def _collect_tables(node, out, _depth=0):
     """Every ast.TableName reachable from the statement (FROM clauses,
     subqueries, DML targets)."""
-    if node is None or _depth > 40:
+    if node is None:
         return
+    if _depth > 200:
+        # a security sweep must fail CLOSED: a statement nested deeply
+        # enough to exceed the guard is rejected, never silently unchecked
+        from .errors import TiDBError
+        raise TiDBError("statement too deeply nested for privilege check")
     if isinstance(node, ast.TableName):
         out.append(node)
         return
@@ -19,8 +24,11 @@ def _collect_tables(node, out, _depth=0):
         for v in node:
             _collect_tables(v, out, _depth + 1)
         return
+    # walk EVERY ast.Node: Join / SubqueryTable / table sources subclass
+    # Node directly, not StmtNode/ExprNode — a narrower guard would skip
+    # join trees and derived tables entirely (fail-open)
     fields = getattr(node, "__dataclass_fields__", None)
-    if fields is None or not isinstance(node, (ast.StmtNode, ast.ExprNode)):
+    if fields is None or not isinstance(node, ast.Node):
         return
     for name in fields:
         _collect_tables(getattr(node, name), out, _depth + 1)
@@ -86,9 +94,29 @@ def check_stmt_privileges(session, stmt):
         priv.verify(user, stmt.name, "", "create")
     elif isinstance(stmt, ast.DropDatabaseStmt):
         priv.verify(user, stmt.name, "", "drop")
+    elif isinstance(stmt, ast.RenameTableStmt):
+        for old, new in stmt.pairs:
+            priv.verify(user, old.schema or session.current_db(),
+                        old.name, "alter")
+            priv.verify(user, old.schema or session.current_db(),
+                        old.name, "drop")
+            priv.verify(user, new.schema or session.current_db(),
+                        new.name, "create")
+    elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
+        # WITH GRANT OPTION lets you grant only privileges you HOLD at
+        # that level (reference: executor/grant.go checks ActivePrivileges)
+        priv.verify(user, "mysql", "user", "grant")
+        from .privilege import PRIVS
+        names = [p for p in PRIVS if p != "grant"] \
+            if "all" in stmt.privs else stmt.privs
+        gdb = "" if stmt.db == "*" else (stmt.db or session.current_db())
+        gtable = "" if stmt.table == "*" else stmt.table
+        for p in names:
+            if p in ("usage", "grant"):
+                continue
+            priv.verify(user, gdb, gtable, p)
     elif isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt,
-                           ast.AlterUserStmt, ast.GrantStmt,
-                           ast.RevokeStmt)):
+                           ast.AlterUserStmt)):
         priv.verify(user, "mysql", "user", "grant")
     elif isinstance(stmt, ast.ExplainStmt):
         # EXPLAIN ANALYZE executes the inner statement — same read checks

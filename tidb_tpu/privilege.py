@@ -175,11 +175,16 @@ class PrivManager:
                         and t.lower() == (table or "").lower()
                         and priv in privs):
                     return
+        if table:
+            raise TiDBError(
+                f"{priv.upper()} command denied to user "
+                f"'{user}'@'{hostv}' for table '{db}.{table}'",
+                code=ErrCode.TableaccessDenied)
         raise TiDBError(
-            f"{priv.upper()} command denied to user '{user}'@'{host or '%'}'"
-            f" for table '{db}.{table}'" if table else
-            f"{priv.upper()} command denied to user '{user}'@'{host or '%'}'",
-            code=ErrCode.TableaccessDenied)
+            f"Access denied for user '{user}'@'{hostv}' to database "
+            f"'{db}'" if db else
+            f"{priv.upper()} command denied to user '{user}'@'{hostv}'",
+            code=ErrCode.DBaccessDenied if db else ErrCode.AccessDenied)
 
     def grants_for(self, user: str, host: str = "%") -> list[str]:
         """SHOW GRANTS lines (reference: privileges.ShowGrants)."""
@@ -195,13 +200,16 @@ class PrivManager:
             if "grant" in rec.privs:
                 line += " WITH GRANT OPTION"
             out.append(line)
+        acct_host = rec.host if rec is not None else host
         with self._lock:
+            # scope to the ACCOUNT (user, host) — never mix grants that
+            # belong to a same-named user at a different host
             for h, d, u, privs in self.dbs:
-                if u == user and privs:
+                if u == user and h == acct_host and privs:
                     out.append(f"GRANT {', '.join(p.upper() for p in sorted(privs))} "
                                f"ON {d}.* TO '{user}'@'{h}'")
             for h, d, u, t, privs in self.tables:
-                if u == user and privs:
+                if u == user and h == acct_host and privs:
                     out.append(f"GRANT {', '.join(p.upper() for p in sorted(privs))} "
                                f"ON {d}.{t} TO '{user}'@'{h}'")
         return out

@@ -1,0 +1,135 @@
+"""HTTP status API (reference: server/http_status.go:194-240 routes +
+http_handler.go introspection): /status, /schema, /ddl/history, /metrics
+(Prometheus text format), /settings, /regions."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..meta import Meta
+from ..model import JobState, SchemaState
+
+
+class StatusServer:
+    def __init__(self, domain, sql_server=None, host="127.0.0.1", port=10080):
+        self.domain = domain
+        self.sql_server = sql_server
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    outer._route(self)
+                except Exception as e:  # introspection must not kill the server
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, req):
+        path = req.path.rstrip("/") or "/"
+        if path == "/status":
+            return self._json(req, self._status())
+        if path == "/metrics":
+            return self._text(req, self._metrics())
+        if path == "/schema":
+            return self._json(req, list(self.domain.infoschema().schema_names()))
+        if path.startswith("/schema/"):
+            return self._schema(req, path[len("/schema/"):])
+        if path == "/ddl/history":
+            return self._json(req, self._ddl_history())
+        if path == "/settings":
+            return self._json(req, dict(self.domain.global_vars))
+        if path == "/regions":
+            return self._json(req, [
+                {"id": r.id, "start": r.start.hex(), "end": r.end.hex()}
+                for r in self.domain.store.mvcc.regions])
+        req.send_response(404)
+        req.end_headers()
+
+    def _json(self, req, obj):
+        body = json.dumps(obj, indent=1, default=str).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _text(self, req, s: str):
+        body = s.encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "text/plain; version=0.0.4")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    # -- payloads ------------------------------------------------------------
+
+    def _status(self):
+        return {
+            "version": "8.0.11-tpu-htap",
+            "connections": len(self.domain.sessions),
+            "kv_engine": self.domain.store.backend,
+        }
+
+    def _metrics(self):
+        """Prometheus text exposition of the domain counters (reference:
+        metrics/metrics.go registry served on the status port)."""
+        lines = []
+        for name, val in sorted(self.domain.observe.counters.items()):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {val}")
+        lines.append("# TYPE server_connections gauge")
+        lines.append(f"server_connections {len(self.domain.sessions)}")
+        return "\n".join(lines) + "\n"
+
+    def _schema(self, req, rest: str):
+        infos = self.domain.infoschema()
+        parts = rest.split("/")
+        if len(parts) == 1:
+            tables = [t.name for t in infos.tables_in_schema(parts[0])]
+            return self._json(req, tables)
+        tbl = infos.table_by_name(parts[0], parts[1])
+        if tbl is None:
+            req.send_response(404)
+            req.end_headers()
+            return
+        payload = tbl.to_json()
+        if isinstance(payload, str):
+            payload = json.loads(payload)
+        return self._json(req, payload)
+
+    def _ddl_history(self):
+        txn = self.domain.store.begin()
+        try:
+            jobs = Meta(txn).history_jobs()[-50:]
+        finally:
+            txn.rollback()
+        return [{
+            "id": j.id, "type": j.type,
+            "state": JobState.NAMES.get(j.state, "?"),
+            "schema_state": SchemaState.NAMES.get(j.schema_state, "?"),
+            "table_id": j.table_id, "row_count": j.row_count,
+            "err": j.error,
+        } for j in jobs]

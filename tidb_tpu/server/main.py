@@ -1,0 +1,121 @@
+"""Server process entry point (reference: tidb-server/main.go:164 — flags →
+config, store + domain bootstrap, MySQL wire server + HTTP status server,
+signal-driven graceful shutdown).
+
+Run:  python -m tidb_tpu.server [--port 4000] [--config cfg.toml]
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tidb-tpu-server",
+        description="TPU-native MySQL-compatible HTAP server")
+    p.add_argument("--host", default=None, help="listen host")
+    p.add_argument("-P", "--port", type=int, default=None,
+                   help="MySQL protocol port (default 4000)")
+    p.add_argument("--status-host", default=None)
+    p.add_argument("--status-port", type=int, default=None,
+                   help="HTTP status port (default 10080; -1 disables)")
+    p.add_argument("--store", default=None,
+                   help="kv engine: auto | native | python")
+    p.add_argument("--config", default=None, help="TOML config file")
+    p.add_argument("--config-check", action="store_true",
+                   help="validate the config file and exit")
+    p.add_argument("-V", "--version", action="store_true")
+    return p
+
+
+def resolve_config(args):
+    from ..config import load_config
+    cfg = load_config(args.config, strict=args.config_check)
+    # CLI flags override the file (reference: main.go overrideConfig)
+    if args.host is not None:
+        cfg.host = args.host
+    if args.port is not None:
+        cfg.port = args.port
+    if args.status_host is not None:
+        cfg.status.status_host = args.status_host
+    if args.status_port is not None:
+        if args.status_port < 0:
+            cfg.status.report_status = False
+        else:
+            cfg.status.status_port = args.status_port
+    if args.store is not None:
+        cfg.store = args.store
+    return cfg
+
+
+def run_server(cfg, ready_event: threading.Event | None = None):
+    """Bootstrap and serve until SIGINT/SIGTERM. Returns the exit code."""
+    from ..kv import new_store
+    from ..session import bootstrap_domain
+    from .server import MySQLServer
+    from .http_status import StatusServer
+
+    store = new_store(backend=cfg.store)
+    domain = bootstrap_domain(store)
+    for name, val in (
+            ("tidb_mem_quota_query", str(cfg.performance.mem_quota_query)),
+            ("tidb_executor_engine", cfg.performance.executor_engine),
+            ("tidb_mesh_shape", cfg.performance.mesh_shape),
+            ("tidb_slow_log_threshold",
+             str(cfg.performance.slow_log_threshold_ms))):
+        domain.global_vars[name] = val
+    if cfg.security.skip_grant_table:
+        domain.priv.enabled = False
+
+    sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port).start()
+    status_srv = None
+    if cfg.status.report_status:
+        status_srv = StatusServer(domain, sql_srv,
+                                  host=cfg.status.status_host,
+                                  port=cfg.status.status_port).start()
+    print(f"[tidb-tpu] SQL listening on {cfg.host}:{sql_srv.port}"
+          + (f", status on :{status_srv.port}" if status_srv else ""),
+          file=sys.stderr, flush=True)
+
+    stop = threading.Event()
+
+    def on_signal(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    if ready_event is not None:
+        ready_event.set()
+    stop.wait()
+    # graceful: stop accepting, close status, drain (reference:
+    # server.go GracefulDown)
+    print("[tidb-tpu] shutting down", file=sys.stderr, flush=True)
+    if status_srv is not None:
+        status_srv.shutdown()
+    sql_srv.shutdown()
+    domain.ddl_worker.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    if args.version:
+        print("tidb-tpu-server 8.0.11-tpu-htap")
+        return 0
+    try:
+        cfg = resolve_config(args)
+    except (ValueError, OSError) as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 1
+    if args.config_check:
+        print("config OK")
+        return 0
+    return run_server(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -766,6 +766,9 @@ def bootstrap_domain(store=None) -> Domain:
             db = DBInfo(id=m.gen_global_id(), name=db_name)
             m.create_database(db)
         m.bump_schema_version()
+        # mark v1 with the same txn: a crash before v2 completes must not
+        # re-run this step (create_database dedups by id, not name)
+        m.set_bootstrapped(1)
     txn.commit()
     d = Domain(store)
     if ver < 2:

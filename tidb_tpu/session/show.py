@@ -151,11 +151,16 @@ def exec_show(session, stmt: ast.ShowStmt):
                                             rows))
 
     if stmt.kind == "grants":
+        cur_user, _, cur_host = session.user.partition("@")
         if stmt.target is not None:
             user, host = stmt.target
+            if (user, host) != (cur_user, cur_host or "%"):
+                # another account's grants: requires read access to the
+                # grant tables (reference: ShowGrants SELECT on mysql.*)
+                session.domain.priv.verify(session.user, "mysql", "user",
+                                           "select")
         else:
-            user, _, host = session.user.partition("@")
-            host = host or "%"
+            user, host = cur_user, cur_host or "%"
         lines = session.domain.priv.grants_for(user, host)
         if not lines:
             lines = [f"GRANT USAGE ON *.* TO '{user}'@'{host}'"]
