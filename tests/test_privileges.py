@@ -266,3 +266,29 @@ def test_join_and_derived_sources_checked(tk):
     with pytest.raises(TiDBError):
         jn.execute("select * from t2, t")
     jn.execute("select * from (select * from t2) x")
+
+
+def test_db_scoped_grant_option_delegates(tk):
+    """WITH GRANT OPTION at db level lets the holder grant held privileges
+    within that db — and nowhere else (review regression)."""
+    tk.must_exec("create user 'dlg'@'%'")
+    tk.must_exec("create user 'peer2'@'%'")
+    tk.must_exec("grant select on test.* to 'dlg'@'%' with grant option")
+    r = tk.must_query("show grants for 'dlg'@'%'")
+    assert any("WITH GRANT OPTION" in row[0] for row in r.rows)
+    dlg = _as_user(tk, "dlg")
+    dlg.execute("grant select on test.* to 'peer2'@'%'")
+    peer = _as_user(tk, "peer2")
+    peer.execute("select * from t")
+    # cannot grant outside the held scope or privs
+    with pytest.raises(TiDBError):
+        dlg.execute("grant insert on test.* to 'peer2'@'%'")
+    with pytest.raises(TiDBError):
+        dlg.execute("grant select on *.* to 'peer2'@'%'")
+
+
+def test_deep_or_chain_not_rejected(tk):
+    """Expression depth must not trip the privilege walker (regression:
+    the recursive walker's depth cap failed closed on ORM-style chains)."""
+    cond = " or ".join(f"a = {i}" for i in range(400))
+    tk.must_query(f"select count(*) from t where {cond}")

@@ -50,11 +50,13 @@ def test_correlated_in_agg_select_list(tk):
     r.check([("4.00",)])
 
 
-def test_recursive_cte_rejected(tk):
-    e = tk.exec_error(
+def test_recursive_cte_supported(tk):
+    # round-1 rejected these; they now evaluate by fixpoint
+    # (tests/test_recursive_cte.py covers the full matrix)
+    tk.must_query(
         "with recursive r as (select 1 as n union all "
-        "select n + 1 from r where n < 3) select * from r")
-    assert "Recursive CTE" in str(e)
+        "select n + 1 from r where n < 3) select * from r order by n"
+    ).check([("1",), ("2",), ("3",)])
 
 
 def test_cte_column_count_mismatch(tk):

@@ -54,8 +54,11 @@ class Config:
                     unknown.append(prefix + k)
                     continue
                 cur = getattr(obj, key)
-                if dataclasses.is_dataclass(cur) and isinstance(v, dict):
-                    fill(cur, v, prefix + k + ".")
+                if dataclasses.is_dataclass(cur):
+                    if isinstance(v, dict):
+                        fill(cur, v, prefix + k + ".")
+                    else:  # scalar assigned to a [section]: invalid
+                        unknown.append(f"{prefix}{k} (expected a table)")
                 else:
                     setattr(obj, key, type(cur)(v) if cur is not None else v)
 

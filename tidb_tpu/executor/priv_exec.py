@@ -100,6 +100,8 @@ def grant(session, stmt):
                           f"where {cond}")
         elif stmt.table == "*":                # database level
             privs = _expand(stmt.privs, DB_PRIVS)
+            if stmt.with_grant:
+                privs = privs + ["grant"]
             r = _internal(session,
                           f"select 1 from mysql.db where {cond} and "
                           f"db = '{_esc(db)}'")
@@ -116,6 +118,8 @@ def grant(session, stmt):
                           f"{cond} and db = '{_esc(db)}'")
         else:                                  # table level
             privs = _expand(stmt.privs, DB_PRIVS)
+            if stmt.with_grant:
+                privs = privs + ["grant"]
             tcond = f"{cond} and db = '{_esc(db)}' and " \
                     f"table_name = '{_esc(stmt.table)}'"
             r = _internal(session,
@@ -150,6 +154,8 @@ def revoke(session, stmt):
         elif stmt.table == "*":
             sets = [f"{p}_priv = 'N'"
                     for p in _expand(stmt.privs, DB_PRIVS)]
+            if "all" in stmt.privs:
+                sets.append("grant_priv = 'N'")
             if sets:
                 _internal(session,
                           f"update mysql.db set {', '.join(sets)} where "

@@ -332,6 +332,20 @@ class SubqueryTable(Node):
 
 
 @dataclass(repr=False)
+class RecursiveCTETable(Node):
+    """A FROM reference to a recursive CTE: body is the full UNION whose
+    self-referencing branches iterate (reference: executor/cte.go)."""
+    name: str
+    cols: list = field(default_factory=list)
+    query: "SetOprStmt" = None
+    as_name: str = ""
+
+    def restore(self):
+        return f"`{self.name}`" + (f" AS `{self.as_name}`"
+                                   if self.as_name else "")
+
+
+@dataclass(repr=False)
 class Join(Node):
     left: Node
     right: Node
@@ -404,6 +418,7 @@ class SelectStmt(StmtNode):
     for_update: bool = False
     lock_in_share_mode: bool = False
     with_ctes: list = field(default_factory=list)    # [(name, [cols], stmt)]
+    with_recursive: bool = False
 
     def restore(self):
         s = ""
@@ -412,7 +427,8 @@ class SelectStmt(StmtNode):
             for name, cols, stmt in self.with_ctes:
                 c = f" ({', '.join(cols)})" if cols else ""
                 parts.append(f"`{name}`{c} AS ({stmt.restore()})")
-            s += "WITH " + ", ".join(parts) + " "
+            s += ("WITH RECURSIVE " if self.with_recursive else "WITH ") \
+                + ", ".join(parts) + " "
         s += "SELECT " + ("DISTINCT " if self.distinct else "")
         s += ", ".join(f.restore() for f in self.fields)
         if self.from_ is not None:

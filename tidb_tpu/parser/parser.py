@@ -286,12 +286,13 @@ class Parser:
 
     def _parse_select_core(self) -> ast.SelectStmt:
         ctes = []
+        recursive = False
         if self._peek_kw("with"):
-            # non-recursive common table expressions (reference:
-            # parser.y WithClause; recursive CTEs go through util/cteutil —
-            # here the planner inlines each reference)
+            # common table expressions (reference: parser.y WithClause);
+            # RECURSIVE gates fixpoint evaluation — without it a CTE body
+            # naming itself refers to the outer scope / real table
             self.pos += 1
-            self._accept_kw("recursive")
+            recursive = self._accept_kw("recursive")
             while True:
                 name = self._ident()
                 cols = []
@@ -322,10 +323,12 @@ class Parser:
                 sel.limit = self._parse_limit()
             if ctes:
                 sel.with_ctes = ctes + sel.with_ctes
+                sel.with_recursive = sel.with_recursive or recursive
             return sel
         self._expect_kw("select")
         sel = ast.SelectStmt()
         sel.with_ctes = ctes
+        sel.with_recursive = recursive
         # modifiers
         while True:
             if self._accept_kw("distinct") or self._accept_kw("distinctrow"):

@@ -139,21 +139,33 @@ def _subst_select(sel, ctes):
         scope = dict(ctes)
         first = sel.selects[0] if sel.selects else None
         if first is not None and getattr(first, "with_ctes", None):
+            rec_flag = getattr(first, "with_recursive", False)
             for name, cols, stmt in first.with_ctes:
                 body_scope = dict(scope)
-                body_scope[name.lower()] = _RECURSIVE
+                if rec_flag:
+                    body_scope[name.lower()] = _RECURSIVE
                 _subst_select(stmt, body_scope)
-                scope[name.lower()] = (cols, stmt)
+                if rec_flag and _references_cte(stmt, name):
+                    scope[name.lower()] = _RecursiveDef(cols, stmt)
+                else:
+                    scope[name.lower()] = (cols, stmt)
             first.with_ctes = []
         for s in sel.selects:
             _subst_select(s, scope)
         return
     scope = dict(ctes)
+    rec_flag = getattr(sel, "with_recursive", False)
     for name, cols, stmt in getattr(sel, "with_ctes", []) or []:
         body_scope = dict(scope)
-        body_scope[name.lower()] = _RECURSIVE
+        if rec_flag:
+            # only WITH RECURSIVE makes the name visible to its own body;
+            # otherwise a self-name refers to the outer scope / real table
+            body_scope[name.lower()] = _RECURSIVE
         _subst_select(stmt, body_scope)
-        scope[name.lower()] = (cols, stmt)
+        if rec_flag and _references_cte(stmt, name):
+            scope[name.lower()] = _RecursiveDef(cols, stmt)
+        else:
+            scope[name.lower()] = (cols, stmt)
     sel.with_ctes = []
     if not scope:
         return
@@ -171,13 +183,45 @@ def _subst_select(sel, ctes):
 _RECURSIVE = object()  # sentinel: a CTE body referencing its own name
 
 
+class _RecursiveDef:
+    """A CTE whose body references its own name: kept whole; each outer
+    reference becomes a RecursiveCTETable for fixpoint evaluation."""
+
+    __slots__ = ("cols", "stmt")
+
+    def __init__(self, cols, stmt):
+        self.cols = cols
+        self.stmt = stmt
+
+
+def _references_cte(stmt, name: str) -> bool:
+    """Does the (already-substituted) body still reference `name` in a
+    FROM position? Self-references were left as bare TableNames."""
+    from ..priv_check import _collect_tables
+    tabs = []
+    _collect_tables(stmt, tabs)
+    return any(not t.schema and t.name.lower() == name.lower()
+               for t in tabs)
+
+
 def _subst_from(node, ctes, _copy):
     if isinstance(node, ast.TableName):
         if not node.schema and node.name.lower() in ctes:
-            if ctes[node.name.lower()] is _RECURSIVE:
-                raise TiDBError(
-                    f"Recursive CTE '{node.name}' is not supported")
-            cols, stmt = ctes[node.name.lower()]
+            entry = ctes[node.name.lower()]
+            if entry is _RECURSIVE:
+                # a self-reference inside the CTE's own body: left intact;
+                # the fixpoint executor binds it per iteration
+                return node
+            if isinstance(entry, _RecursiveDef):
+                body = _copy.deepcopy(entry.stmt)
+                if not isinstance(body, ast.SetOprStmt):
+                    raise TiDBError(
+                        f"Recursive CTE '{node.name}' must be a UNION of a "
+                        f"seed part and a recursive part")
+                return ast.RecursiveCTETable(
+                    name=node.name.lower(), cols=list(entry.cols),
+                    query=body, as_name=node.as_name or node.name)
+            cols, stmt = entry
             body = _copy.deepcopy(stmt)
             sub = ast.SubqueryTable(query=body,
                                     as_name=node.as_name or node.name)
@@ -333,9 +377,100 @@ class PlanBuilder:
             return sub2
         if isinstance(node, ast.Join):
             return self._build_join(node)
+        if isinstance(node, ast.RecursiveCTETable):
+            return self._build_recursive_cte(node)
         raise TiDBError(f"unsupported FROM item {type(node).__name__}")
 
+    def _build_recursive_cte(self, node: ast.RecursiveCTETable):
+        """Fixpoint evaluation of WITH RECURSIVE (reference:
+        executor/cte.go:60 — seed into the result table, iterate the
+        recursive part against the previous iteration until empty, dedup
+        for UNION DISTINCT, bounded by cte_max_recursion_depth)."""
+        body = node.query
+        seeds, recs = [], []
+        for s in body.selects:
+            (recs if _references_cte(s, node.name) else seeds).append(s)
+        if not seeds:
+            raise TiDBError(f"Recursive CTE '{node.name}' has no "
+                            f"non-recursive seed part")
+        distinct = any(op == "union" for op in body.ops)
+        ctx = self.ctx
+        if not hasattr(ctx, "eval_subquery"):
+            raise TiDBError("recursive CTE not available in this context")
+        rows, fts = [], None
+        names = list(node.cols)
+        for s in seeds:
+            r, f = ctx.eval_subquery(s)
+            rows.extend(r)
+            fts = fts or f
+            if not names:
+                names = [fld.as_name or _derive_name(fld.expr)
+                         for fld in s.fields]
+        if names and fts is not None and len(names) != len(fts):
+            raise TiDBError(
+                "In definition of view, derived table or common table "
+                "expression, SELECT list and column names list have "
+                "different column counts")
+        seen = set(map(tuple, rows)) if distinct else None
+        if distinct:
+            rows = list(dict.fromkeys(map(tuple, rows)))
+        try:
+            limit = int(ctx.get_sysvar("cte_max_recursion_depth", "session"))
+        except Exception:
+            limit = 1000
+        bindings = getattr(ctx, "cte_bindings", None)
+        if bindings is None:
+            bindings = ctx.cte_bindings = {}
+        key = node.name.lower()
+        prev = bindings.get(key)
+        work = list(rows)
+        try:
+            for _it in range(limit):
+                if not work:
+                    break
+                bindings[key] = (names, fts, work)
+                new_rows = []
+                for s in recs:
+                    r, _f = ctx.eval_subquery(s)
+                    new_rows.extend(r)
+                if distinct:
+                    fresh = []
+                    for r in map(tuple, new_rows):
+                        if r not in seen:
+                            seen.add(r)
+                            fresh.append(r)
+                    new_rows = fresh
+                if not new_rows:
+                    break
+                rows.extend(new_rows)
+                work = new_rows
+            else:
+                raise TiDBError(
+                    f"Recursive query aborted after {limit} iterations. "
+                    f"Try increasing @@cte_max_recursion_depth")
+        finally:
+            if prev is None:
+                bindings.pop(key, None)
+            else:
+                bindings[key] = prev
+        alias = node.as_name or node.name
+        refs = [ColumnRef(n, alias, "", ft) for n, ft in zip(names, fts)]
+        result = [tuple(r) for r in rows]
+        return MemSource("", node.name, Schema(refs), lambda: result)
+
     def _build_table(self, tn: ast.TableName):
+        # an in-flight recursive CTE iteration binds its name to the
+        # previous iteration's rows (reference: cteutil working table)
+        bindings = getattr(self.ctx, "cte_bindings", None)
+        if bindings and not tn.schema:
+            bound = bindings.get(tn.name.lower())
+            if bound is not None:
+                names, fts, rows = bound
+                alias = tn.as_name or tn.name
+                refs = [ColumnRef(n, alias, "", ft)
+                        for n, ft in zip(names, fts)]
+                frozen = [tuple(r) for r in rows]
+                return MemSource("", tn.name, Schema(refs), lambda: frozen)
         db = tn.schema or self.ctx.current_db()
         if not db:
             raise SchemaError("No database selected", code=ErrCode.BadDB)

@@ -7,31 +7,30 @@ from __future__ import annotations
 from .parser import ast
 
 
-def _collect_tables(node, out, _depth=0):
+def _collect_tables(node, out):
     """Every ast.TableName reachable from the statement (FROM clauses,
-    subqueries, DML targets)."""
-    if node is None:
-        return
-    if _depth > 200:
-        # a security sweep must fail CLOSED: a statement nested deeply
-        # enough to exceed the guard is rejected, never silently unchecked
-        from .errors import TiDBError
-        raise TiDBError("statement too deeply nested for privilege check")
-    if isinstance(node, ast.TableName):
-        out.append(node)
-        return
-    if isinstance(node, (list, tuple)):
-        for v in node:
-            _collect_tables(v, out, _depth + 1)
-        return
-    # walk EVERY ast.Node: Join / SubqueryTable / table sources subclass
-    # Node directly, not StmtNode/ExprNode — a narrower guard would skip
-    # join trees and derived tables entirely (fail-open)
-    fields = getattr(node, "__dataclass_fields__", None)
-    if fields is None or not isinstance(node, ast.Node):
-        return
-    for name in fields:
-        _collect_tables(getattr(node, name), out, _depth + 1)
+    subqueries, DML targets). Iterative worklist: no recursion limit to
+    fail open past (deep ORM-generated OR-chains are legitimate) and none
+    to blow the Python stack."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if n is None:
+            continue
+        if isinstance(n, ast.TableName):
+            out.append(n)
+            continue
+        if isinstance(n, (list, tuple)):
+            stack.extend(n)
+            continue
+        # walk EVERY ast.Node: Join / SubqueryTable / table sources
+        # subclass Node directly, not StmtNode/ExprNode — a narrower guard
+        # would skip join trees and derived tables entirely (fail-open)
+        fields = getattr(n, "__dataclass_fields__", None)
+        if fields is None or not isinstance(n, ast.Node):
+            continue
+        for name in fields:
+            stack.append(getattr(n, name))
 
 
 def check_stmt_privileges(session, stmt):
@@ -103,14 +102,15 @@ def check_stmt_privileges(session, stmt):
             priv.verify(user, new.schema or session.current_db(),
                         new.name, "create")
     elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
-        # WITH GRANT OPTION lets you grant only privileges you HOLD at
-        # that level (reference: executor/grant.go checks ActivePrivileges)
-        priv.verify(user, "mysql", "user", "grant")
+        # the grant option AND every granted privilege must be HELD at the
+        # target level (reference: executor/grant.go ActivePrivileges) —
+        # db/table-scoped grant option delegates only within its scope
         from .privilege import PRIVS
-        names = [p for p in PRIVS if p != "grant"] \
-            if "all" in stmt.privs else stmt.privs
         gdb = "" if stmt.db == "*" else (stmt.db or session.current_db())
         gtable = "" if stmt.table == "*" else stmt.table
+        priv.verify(user, gdb, gtable, "grant")
+        names = [p for p in PRIVS if p != "grant"] \
+            if "all" in stmt.privs else stmt.privs
         for p in names:
             if p in ("usage", "grant"):
                 continue

@@ -16,7 +16,9 @@ from .errors import TiDBError, ErrCode
 #: column order of the per-level priv flags
 PRIVS = ("select", "insert", "update", "delete", "create", "drop",
          "index", "alter", "super", "grant")
-DB_PRIVS = PRIVS[:8]  # db/table level: no super
+#: db/table level: no super, but grant option IS level-scoped
+#: (reference: mysql.db has Grant_priv; tables_priv lists 'Grant')
+DB_PRIVS = PRIVS[:8] + ("grant",)
 
 BOOTSTRAP_SQL = [
     """create table if not exists mysql.user (
@@ -34,6 +36,7 @@ BOOTSTRAP_SQL = [
         update_priv varchar(1), delete_priv varchar(1),
         create_priv varchar(1), drop_priv varchar(1),
         index_priv varchar(1), alter_priv varchar(1),
+        grant_priv varchar(1),
         primary key (host, db, user))""",
     """create table if not exists mysql.tables_priv (
         host varchar(255), db varchar(64), user varchar(32),
@@ -73,7 +76,8 @@ class PrivManager:
         self.users: list[UserRecord] = []
         self.dbs: list[tuple] = []        # (host, db, user, set(privs))
         self.tables: list[tuple] = []     # (host, db, user, table, set)
-        self.enabled = False  # flips on once the grant tables exist
+        self.enabled = False   # flips on once the grant tables exist
+        self.disabled = False  # sticky skip-grant-table mode (config)
 
     # -- load (reference: cache.go LoadAll) ---------------------------------
 
@@ -96,7 +100,7 @@ class PrivManager:
             dinfo = infos.table_by_name("mysql", "db")
             for _h, row in Table(dinfo, txn).iter_rows():
                 vals = _row_strs(dinfo, row)
-                privs = {p for p, v in zip(DB_PRIVS, vals[3:11]) if v == "Y"}
+                privs = {p for p, v in zip(DB_PRIVS, vals[3:12]) if v == "Y"}
                 dbs.append((vals[0], vals[1], vals[2], privs))
             tinfo = infos.table_by_name("mysql", "tables_priv")
             for _h, row in Table(tinfo, txn).iter_rows():
@@ -108,7 +112,7 @@ class PrivManager:
             txn.rollback()
         with self._lock:
             self.users, self.dbs, self.tables = users, dbs, tables
-            self.enabled = True
+            self.enabled = not self.disabled
 
     # -- auth (reference: privileges.ConnectionVerification) ---------------
 
@@ -201,17 +205,23 @@ class PrivManager:
                 line += " WITH GRANT OPTION"
             out.append(line)
         acct_host = rec.host if rec is not None else host
+
+        def line(privs, target, h):
+            names = sorted(p for p in privs if p != "grant")
+            s = (f"GRANT {', '.join(p.upper() for p in names) or 'USAGE'} "
+                 f"ON {target} TO '{user}'@'{h}'")
+            if "grant" in privs:
+                s += " WITH GRANT OPTION"
+            return s
         with self._lock:
             # scope to the ACCOUNT (user, host) — never mix grants that
             # belong to a same-named user at a different host
             for h, d, u, privs in self.dbs:
                 if u == user and h == acct_host and privs:
-                    out.append(f"GRANT {', '.join(p.upper() for p in sorted(privs))} "
-                               f"ON {d}.* TO '{user}'@'{h}'")
+                    out.append(line(privs, f"{d}.*", h))
             for h, d, u, t, privs in self.tables:
                 if u == user and h == acct_host and privs:
-                    out.append(f"GRANT {', '.join(p.upper() for p in sorted(privs))} "
-                               f"ON {d}.{t} TO '{user}'@'{h}'")
+                    out.append(line(privs, f"{d}.{t}", h))
         return out
 
 

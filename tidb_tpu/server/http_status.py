@@ -48,7 +48,7 @@ class StatusServer:
     # -- routing -------------------------------------------------------------
 
     def _route(self, req):
-        path = req.path.rstrip("/") or "/"
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/status":
             return self._json(req, self._status())
         if path == "/metrics":
@@ -108,6 +108,10 @@ class StatusServer:
         infos = self.domain.infoschema()
         parts = rest.split("/")
         if len(parts) == 1:
+            if infos.schema_by_name(parts[0]) is None:
+                req.send_response(404)
+                req.end_headers()
+                return
             tables = [t.name for t in infos.tables_in_schema(parts[0])]
             return self._json(req, tables)
         tbl = infos.table_by_name(parts[0], parts[1])

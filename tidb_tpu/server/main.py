@@ -69,6 +69,8 @@ def run_server(cfg, ready_event: threading.Event | None = None):
              str(cfg.performance.slow_log_threshold_ms))):
         domain.global_vars[name] = val
     if cfg.security.skip_grant_table:
+        # sticky: later priv.load() calls (GRANT etc.) must not re-enable
+        domain.priv.disabled = True
         domain.priv.enabled = False
 
     sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port).start()

@@ -104,6 +104,7 @@ for _v in [
     SysVar("tidb_enable_topn_push_down", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_mesh_shape", SCOPE_BOTH, "1", "str"),
     SysVar("tidb_slow_log_threshold", SCOPE_BOTH, "300", "int", 0),
+    SysVar("cte_max_recursion_depth", SCOPE_BOTH, "1000", "int", 0, 4294967295),
     SysVar("tidb_record_plan_in_slow_log", SCOPE_BOTH, "ON", "bool"),
 ]:
     register(_v)
