@@ -292,3 +292,22 @@ def test_deep_or_chain_not_rejected(tk):
     the recursive walker's depth cap failed closed on ORM-style chains)."""
     cond = " or ".join(f"a = {i}" for i in range(400))
     tk.must_query(f"select count(*) from t where {cond}")
+
+
+def test_db_scoped_grant_all_delegation(tk):
+    tk.must_exec("create user 'dba'@'%'")
+    tk.must_exec("create user 'peer3'@'%'")
+    tk.must_exec("grant all on test.* to 'dba'@'%' with grant option")
+    dba = _as_user(tk, "dba")
+    dba.execute("grant all on test.* to 'peer3'@'%'")  # no SUPER needed
+    peer = _as_user(tk, "peer3")
+    peer.execute("select * from t")
+    peer.execute("insert into t values (77, 77)")
+
+
+def test_table_level_revoke_all_clears_grant_option(tk):
+    tk.must_exec("create user 'tg'@'%'")
+    tk.must_exec("grant select on test.t to 'tg'@'%' with grant option")
+    tk.must_exec("revoke all on test.t from 'tg'@'%'")
+    r = tk.must_query("show grants for 'tg'@'%'")
+    assert not any("GRANT OPTION" in row[0] for row in r.rows)

@@ -111,3 +111,47 @@ def test_without_recursive_keyword_refers_to_real_table(tk):
     tk.must_query(
         "with rt as (select a from rt union all select 99) "
         "select a from rt order by a").check([("10",), ("20",), ("99",)])
+
+
+def test_limit_terminates_iteration(tk):
+    tk.must_query(
+        "with recursive s (n) as (select 1 union all "
+        "select n + 1 from s where n < 100 limit 5) "
+        "select count(*) from s").check([("5",)])
+
+
+def test_intersect_except_rejected(tk):
+    e = tk.exec_error(
+        "with recursive s (n) as (select 1 except select 1 union all "
+        "select n + 1 from s where n < 3) select * from s")
+    assert "UNION" in str(e)
+
+
+def test_depth_zero_with_unproductive_recursion(tk):
+    """An empty final step is termination, not a depth violation."""
+    tk.must_exec("set cte_max_recursion_depth = 0")
+    tk.must_query(
+        "with recursive s (n) as (select 1 union all "
+        "select n + 1 from s where n > 99) select * from s").check([("1",)])
+    tk.must_exec("set cte_max_recursion_depth = 1000")
+
+
+def test_multiple_references_single_materialization(tk, monkeypatch):
+    """k references to one recursive CTE run the fixpoint ONCE."""
+    import tidb_tpu.planner.builder as B
+    calls = {"n": 0}
+    orig = B.PlanBuilder._build_recursive_cte
+
+    def counting(self, node):
+        hit = getattr(self.ctx, "cte_results", {}).get(
+            (node.name, node.query.restore()))
+        if hit is None:
+            calls["n"] += 1
+        return orig(self, node)
+    monkeypatch.setattr(B.PlanBuilder, "_build_recursive_cte", counting)
+    tk.must_query(
+        "with recursive seq (n) as ("
+        "  select 1 union all select n + 1 from seq where n < 3) "
+        "select a.n from seq a, seq b where a.n = b.n order by a.n"
+    ).check([("1",), ("2",), ("3",)])
+    assert calls["n"] == 1

@@ -1,0 +1,267 @@
+"""Backup / restore + logical dump + checkpointed import — the BR,
+Dumpling and Lightning roles (reference: br/pkg/task/backup.go:221,
+restore.go:216, dumpling/export/dump.go, br/pkg/lightning/checkpoints/).
+
+Backup format (one directory per run):
+    backupmeta.json                 run metadata + per-table stats
+    {db}.{table}.schema.json       TableInfo (exact catalog state)
+    {db}.{table}.data.jsonl        rows as {"h": handle, "v": hex(rowcodec)}
+Row payloads reuse the engine's row codec, so restore is bit-exact —
+decimals, dates and binary collations round-trip without re-parsing.
+
+Dump format (mydumper-style, reference dumpling/export):
+    {db}.{table}-schema.sql        CREATE TABLE
+    {db}.{table}.sql | .csv        INSERT statements / CSV rows
+
+Import reads a dump directory with a progress checkpoint
+(_import_checkpoint.json) updated after every committed batch: a crashed
+import resumes at the first unfinished table/offset instead of redoing or
+duplicating work (reference: lightning checkpoints)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from . import tablecodec
+from .errors import TiDBError
+from .model import TableInfo
+from .table import Table
+
+BATCH = 2048
+
+
+# -- backup (reference: br/pkg/task/backup.go) -------------------------------
+
+def backup_database(session, db_name: str, dest: str) -> dict:
+    infos = session.infoschema()
+    if infos.schema_by_name(db_name) is None:
+        raise TiDBError(f"Unknown database '{db_name}'")
+    os.makedirs(dest, exist_ok=True)
+    txn = session.store.begin()  # one snapshot: a consistent backup
+    meta = {"db": db_name, "ts": txn.start_ts,
+            "created": time.strftime("%Y-%m-%d %H:%M:%S"), "tables": []}
+    try:
+        for info in infos.tables_in_schema(db_name):
+            base = os.path.join(dest, f"{db_name}.{info.name}")
+            with open(base + ".schema.json", "w") as f:
+                payload = info.to_json()
+                f.write(payload if isinstance(payload, str)
+                        else json.dumps(payload))
+            n = 0
+            with open(base + ".data.jsonl", "w") as f:
+                start, end = tablecodec.table_range(info.id)
+                rec_end = tablecodec.record_prefix(info.id) + b"\xff" * 9
+                for key, value in txn.scan(
+                        tablecodec.record_prefix(info.id), rec_end):
+                    _tid, h = tablecodec.decode_record_key(key)
+                    f.write(json.dumps({"h": h, "v": value.hex()}) + "\n")
+                    n += 1
+            meta["tables"].append({"name": info.name, "rows": n})
+    finally:
+        txn.rollback()
+    with open(os.path.join(dest, "backupmeta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return meta
+
+
+# -- restore (reference: br/pkg/task/restore.go) -----------------------------
+
+def restore_database(session, src: str, db_name: str | None = None) -> dict:
+    with open(os.path.join(src, "backupmeta.json")) as f:
+        meta = json.load(f)
+    target_db = db_name or meta["db"]
+    if session.infoschema().schema_by_name(target_db) is None:
+        session.execute(f"create database `{target_db}`")
+    restored = []
+    for t in meta["tables"]:
+        base = os.path.join(src, f"{meta['db']}.{t['name']}")
+        with open(base + ".schema.json") as f:
+            raw = f.read()
+        info = TableInfo.from_json(json.loads(raw)
+                                   if raw.lstrip().startswith("{")
+                                   else raw)
+        if session.infoschema().has_table(target_db, info.name):
+            raise TiDBError(f"table '{target_db}.{info.name}' already "
+                            f"exists; drop it before RESTORE")
+        _create_from_info(session, target_db, info)
+        new_info = session.infoschema().table_by_name(target_db, info.name)
+        n = _restore_rows(session, new_info, base + ".data.jsonl")
+        restored.append({"name": info.name, "rows": n})
+    return {"db": target_db, "tables": restored}
+
+
+def _create_from_info(session, db_name: str, info: TableInfo):
+    """Recreate the table from the backed-up TableInfo via the catalog
+    (new table id; column/index ids preserved from the source)."""
+    from .meta import Meta
+    ddl = session.ddl
+    with session.domain.ddl_lock:
+        txn = session.store.begin()
+        try:
+            m = Meta(txn)
+            db = next(d for d in m.list_databases()
+                      if d.name.lower() == db_name.lower())
+            clone = TableInfo.from_json(info.to_json())
+            clone.id = m.gen_global_id()
+            m.create_table(db.id, clone)
+            m.bump_schema_version()
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+    session.domain.reload_schema()
+
+
+def _restore_rows(session, info: TableInfo, path: str) -> int:
+    n = 0
+    batch = []
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            batch.append((rec["h"], bytes.fromhex(rec["v"])))
+            if len(batch) >= BATCH:
+                _write_batch(session, info, batch)
+                n += len(batch)
+                batch = []
+    if batch:
+        _write_batch(session, info, batch)
+        n += len(batch)
+    return n
+
+
+def _write_batch(session, info, batch):
+    txn = session.store.begin()
+    try:
+        tbl = Table(info, txn)
+        for handle, value in batch:
+            row = tablecodec.decode_row(value)
+            tbl.add_record(row, handle, check_dup=False)
+        txn.commit()
+    except Exception:
+        txn.rollback()
+        raise
+    session.domain.columnar_cache.invalidate(info.id)
+
+
+# -- logical dump (reference: dumpling/export/dump.go) ------------------------
+
+def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
+    if fmt not in ("sql", "csv"):
+        raise TiDBError("dump format must be 'sql' or 'csv'")
+    infos = session.infoschema()
+    if infos.schema_by_name(db_name) is None:
+        raise TiDBError(f"Unknown database '{db_name}'")
+    os.makedirs(dest, exist_ok=True)
+    out = {"db": db_name, "tables": []}
+    for info in infos.tables_in_schema(db_name):
+        base = os.path.join(dest, f"{db_name}.{info.name}")
+        create = session.execute(
+            f"show create table `{db_name}`.`{info.name}`")[-1].rows[0][1]
+        with open(base + "-schema.sql", "w") as f:
+            f.write(create + ";\n")
+        res = session.execute(
+            f"select * from `{db_name}`.`{info.name}`")[-1]
+        rows = res.rows  # display strings (None = NULL)
+        if fmt == "sql":
+            with open(base + ".sql", "w") as f:
+                for i in range(0, len(rows), 256):
+                    chunk = rows[i:i + 256]
+                    vals = ",\n".join(
+                        "(" + ", ".join(_sql_lit(v) for v in r) + ")"
+                        for r in chunk)
+                    f.write(f"INSERT INTO `{info.name}` VALUES\n{vals};\n")
+        else:
+            import csv
+            with open(base + ".csv", "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(res.names)
+                for r in rows:
+                    w.writerow(["\\N" if v is None else v for v in r])
+        out["tables"].append({"name": info.name, "rows": len(rows)})
+    with open(os.path.join(dest, "metadata.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    return out
+
+
+def _sql_lit(v) -> str:
+    if v is None:
+        return "NULL"
+    s = str(v)
+    try:
+        float(s)
+        return s
+    except ValueError:
+        pass
+    # newlines must be escaped or the ';\n' statement splitter would break
+    s = (s.replace("\\", "\\\\").replace("'", "\\'")
+         .replace("\n", "\\n").replace("\r", "\\r"))
+    return "'" + s + "'"
+
+
+# -- import with checkpoint/resume (reference: lightning checkpoints) ---------
+
+def import_dump(session, src: str, db_name: str | None = None,
+                crash_after_batches: int | None = None) -> dict:
+    """Load a dump directory produced by dump_database (sql format).
+    Progress is checkpointed per committed batch; re-running after a crash
+    resumes from the checkpoint. `crash_after_batches` is a test hook that
+    aborts mid-import (reference: failpoint-style injection)."""
+    with open(os.path.join(src, "metadata.json")) as f:
+        meta = json.load(f)
+    target_db = db_name or meta["db"]
+    if session.infoschema().schema_by_name(target_db) is None:
+        session.execute(f"create database `{target_db}`")
+    ckpt_path = os.path.join(src, "_import_checkpoint.json")
+    ckpt = {"done_tables": [], "table": None, "stmts_done": 0}
+    if os.path.exists(ckpt_path):
+        with open(ckpt_path) as f:
+            ckpt = json.load(f)
+    session.execute(f"use `{target_db}`")
+    batches = 0
+    for t in meta["tables"]:
+        name = t["name"]
+        if name in ckpt["done_tables"]:
+            continue
+        schema_file = os.path.join(src, f"{meta['db']}.{name}-schema.sql")
+        data_file = os.path.join(src, f"{meta['db']}.{name}.sql")
+        skip = ckpt["stmts_done"] if ckpt.get("table") == name else 0
+        if skip == 0 and not session.infoschema().has_table(target_db, name):
+            with open(schema_file) as f:
+                session.execute(f.read())
+        done = 0
+        with open(data_file) as f:
+            for stmt in _split_sql(f.read()):
+                done += 1
+                if done <= skip:
+                    continue
+                session.execute(stmt)
+                batches += 1
+                ckpt.update({"table": name, "stmts_done": done})
+                _write_ckpt(ckpt_path, ckpt)
+                if (crash_after_batches is not None
+                        and batches >= crash_after_batches):
+                    raise TiDBError("import aborted (injected crash)")
+        ckpt["done_tables"].append(name)
+        ckpt.update({"table": None, "stmts_done": 0})
+        _write_ckpt(ckpt_path, ckpt)
+    os.unlink(ckpt_path)
+    return {"db": target_db,
+            "tables": [t["name"] for t in meta["tables"]]}
+
+
+def _write_ckpt(path: str, ckpt: dict):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ckpt, f)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+
+
+def _split_sql(text: str):
+    """Split dump files on ';\n' statement boundaries (values never contain
+    that sequence: _sql_lit escapes newlines are impossible in display
+    strings, and the writer ends every statement with ';\\n')."""
+    for part in text.split(";\n"):
+        if part.strip():
+            yield part

@@ -169,6 +169,8 @@ def revoke(session, stmt):
             if r[-1].rows:
                 cur = {p for p in r[-1].rows[0][0].split(",") if p}
                 cur -= set(_expand(stmt.privs, DB_PRIVS))
+                if "all" in stmt.privs:
+                    cur.discard("grant")
                 if cur:
                     _internal(session,
                               f"update mysql.tables_priv set table_priv = "

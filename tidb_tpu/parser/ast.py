@@ -703,6 +703,19 @@ class SetStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class BRIEStmt(StmtNode):
+    """BACKUP DATABASE x TO 'dir' / RESTORE DATABASE x FROM 'dir'
+    (reference: executor/brie.go BRIE statements)."""
+    kind: str = ""      # backup | restore
+    db: str = ""
+    path: str = ""
+
+    def restore(self):
+        prep = "TO" if self.kind == "backup" else "FROM"
+        return f"{self.kind.upper()} DATABASE `{self.db}` {prep} '{self.path}'"
+
+
+@dataclass(repr=False)
 class CreateUserStmt(StmtNode):
     users: list = field(default_factory=list)  # [(user, host, password|None)]
     if_not_exists: bool = False

@@ -203,6 +203,17 @@ class Parser:
             return self._parse_grant()
         if kw == "revoke":
             return self._parse_revoke()
+        if kw in ("backup", "restore"):
+            self.pos += 1
+            self._expect_kw("database")
+            db = self._ident()
+            self._expect_kw("to" if kw == "backup" else "from")
+            t = self._cur()
+            if t.kind != STRING:
+                raise ParseError(f"expected path string near {self._near()}")
+            self.pos += 1
+            path = t.val.decode() if isinstance(t.val, bytes) else t.val
+            return ast.BRIEStmt(kind=kw, db=db, path=path)
         if kw == "prepare":
             self.pos += 1
             name = self._ident()
@@ -1310,7 +1321,14 @@ class Parser:
                 self._accept_op("=")
                 v = self._cur()
                 self.pos += 1
-                stmt.options[opt] = v.val
+                val = v.val
+                # hyphenated option values (ENGINE=tpu-htap) lex as
+                # ident '-' ident — stitch them back together
+                while (self._peek_op("-") and v.kind == IDENT
+                       and self.toks[self.pos + 1].kind == IDENT):
+                    self.pos += 1
+                    val = f"{val}-{self._ident()}"
+                stmt.options[opt] = val
             elif opt == "default":
                 self.pos += 1
             elif opt == "character":

@@ -387,6 +387,32 @@ class PlanBuilder:
         recursive part against the previous iteration until empty, dedup
         for UNION DISTINCT, bounded by cte_max_recursion_depth)."""
         body = node.query
+        ctx = self.ctx
+        if not hasattr(ctx, "eval_subquery"):
+            raise TiDBError("recursive CTE not available in this context")
+        # one materialization per (name, body) per statement: further
+        # references reuse it (reference: cteutil shared working table)
+        cache = getattr(ctx, "cte_results", None)
+        if cache is None:
+            cache = ctx.cte_results = {}
+        cache_key = (node.name, body.restore())
+        hit = cache.get(cache_key)
+        if hit is not None:
+            names, fts, result = hit
+            alias = node.as_name or node.name
+            refs = [ColumnRef(n, alias, "", ft)
+                    for n, ft in zip(names, fts)]
+            return MemSource("", node.name, Schema(refs), lambda: result)
+        if any(op not in ("union", "union all") for op in body.ops):
+            raise TiDBError("recursive CTE supports UNION [ALL] only")
+        if body.order_by:
+            raise TiDBError(
+                "ORDER BY inside a recursive CTE body is not supported")
+        cap = None
+        if body.limit is not None:
+            off, cnt = self._limit_values(body.limit)
+            if cnt is not None:
+                cap = (off or 0) + cnt  # LIMIT terminates the iteration
         seeds, recs = [], []
         for s in body.selects:
             (recs if _references_cte(s, node.name) else seeds).append(s)
@@ -394,9 +420,6 @@ class PlanBuilder:
             raise TiDBError(f"Recursive CTE '{node.name}' has no "
                             f"non-recursive seed part")
         distinct = any(op == "union" for op in body.ops)
-        ctx = self.ctx
-        if not hasattr(ctx, "eval_subquery"):
-            raise TiDBError("recursive CTE not available in this context")
         rows, fts = [], None
         names = list(node.cols)
         for s in seeds:
@@ -424,10 +447,11 @@ class PlanBuilder:
         key = node.name.lower()
         prev = bindings.get(key)
         work = list(rows)
+        if cap is not None and len(rows) >= cap:
+            rows, work = rows[:cap], []
+        it = 0
         try:
-            for _it in range(limit):
-                if not work:
-                    break
+            while work:
                 bindings[key] = (names, fts, work)
                 new_rows = []
                 for s in recs:
@@ -442,12 +466,18 @@ class PlanBuilder:
                     new_rows = fresh
                 if not new_rows:
                     break
+                # only a PRODUCTIVE iteration counts against the depth
+                # limit (an exhausted-but-empty final step is termination)
+                it += 1
+                if it > limit:
+                    raise TiDBError(
+                        f"Recursive query aborted after {limit} iterations."
+                        f" Try increasing @@cte_max_recursion_depth")
                 rows.extend(new_rows)
                 work = new_rows
-            else:
-                raise TiDBError(
-                    f"Recursive query aborted after {limit} iterations. "
-                    f"Try increasing @@cte_max_recursion_depth")
+                if cap is not None and len(rows) >= cap:
+                    rows = rows[:cap]
+                    break
         finally:
             if prev is None:
                 bindings.pop(key, None)
@@ -456,6 +486,7 @@ class PlanBuilder:
         alias = node.as_name or node.name
         refs = [ColumnRef(n, alias, "", ft) for n, ft in zip(names, fts)]
         result = [tuple(r) for r in rows]
+        cache[cache_key] = (names, fts, result)
         return MemSource("", node.name, Schema(refs), lambda: result)
 
     def _build_table(self, tn: ast.TableName):

@@ -105,11 +105,14 @@ def check_stmt_privileges(session, stmt):
         # the grant option AND every granted privilege must be HELD at the
         # target level (reference: executor/grant.go ActivePrivileges) —
         # db/table-scoped grant option delegates only within its scope
-        from .privilege import PRIVS
+        from .privilege import DB_PRIVS, PRIVS
         gdb = "" if stmt.db == "*" else (stmt.db or session.current_db())
         gtable = "" if stmt.table == "*" else stmt.table
         priv.verify(user, gdb, gtable, "grant")
-        names = [p for p in PRIVS if p != "grant"] \
+        # ALL expands to the privileges that EXIST at the target level —
+        # requiring SUPER for a db-scoped GRANT ALL would defeat delegation
+        level = PRIVS if (not gdb and not gtable) else DB_PRIVS
+        names = [p for p in level if p != "grant"] \
             if "all" in stmt.privs else stmt.privs
         for p in names:
             if p in ("usage", "grant"):
@@ -118,6 +121,8 @@ def check_stmt_privileges(session, stmt):
     elif isinstance(stmt, (ast.CreateUserStmt, ast.DropUserStmt,
                            ast.AlterUserStmt)):
         priv.verify(user, "mysql", "user", "grant")
+    elif isinstance(stmt, ast.BRIEStmt):
+        priv.verify(user, "", "", "super")  # BACKUP/RESTORE are super-only
     elif isinstance(stmt, ast.ExplainStmt):
         # EXPLAIN ANALYZE executes the inner statement — same read checks
         req_tables(stmt.stmt, "select")

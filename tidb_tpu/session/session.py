@@ -460,6 +460,7 @@ class Session:
         except Exception:
             quota = 0
         self.mem_tracker = MemTracker(f"conn{self.conn_id}", quota)
+        self._expr_ctx.cte_results = {}  # recursive-CTE cache, per stmt
         res = None
         try:
             res = self._dispatch(stmt)
@@ -595,6 +596,20 @@ class Session:
             return Result()
         if isinstance(stmt, ast.KillStmt):
             return Result()
+        if isinstance(stmt, ast.BRIEStmt):
+            self._implicit_commit()
+            from .. import br
+            from ..sqltypes import TYPE_LONGLONG, TYPE_VARCHAR
+            if stmt.kind == "backup":
+                meta = br.backup_database(self, stmt.db, stmt.path)
+            else:
+                meta = br.restore_database(self, stmt.path, stmt.db)
+            ft_s = FieldType(tp=TYPE_VARCHAR)
+            ft_i = FieldType(tp=TYPE_LONGLONG)
+            rows = [(t["name"].encode(), t["rows"])
+                    for t in meta["tables"]]
+            return Result(names=["table", "rows"],
+                          chunk=Chunk.from_rows([ft_s, ft_i], rows))
         if isinstance(stmt, ast.TraceStmt):
             return self._dispatch(stmt.stmt)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
@@ -743,7 +758,7 @@ class Session:
             self._expr_ctx.params = None
 
 
-BOOTSTRAP_VERSION = 2  # v2: grant tables (mysql.user/db/tables_priv)
+BOOTSTRAP_VERSION = 3  # v2: grant tables; v3: mysql.db grant_priv column
 
 
 def bootstrap_domain(store=None) -> Domain:
@@ -785,6 +800,18 @@ def bootstrap_domain(store=None) -> Domain:
             if not s.execute("select 1 from mysql.user where user = 'root'"
                              )[-1].rows:
                 s.execute(ROOT_ROW)
+        finally:
+            s.close()
+    elif ver < 3:
+        # v3 upgrade: db-scoped grant option column (versioned upgrade
+        # chain, reference: bootstrap.go upgradeToVerNN)
+        s = Session(d)
+        s._internal = 1
+        try:
+            info = d.infoschema().table_by_name("mysql", "db")
+            if info is not None and info.find_column("grant_priv") is None:
+                s.execute("alter table mysql.db add column "
+                          "grant_priv varchar(1) default 'N'")
         finally:
             s.close()
     txn = store.begin()
