@@ -1,0 +1,166 @@
+"""Auxiliary subsystems: auto-analyze stats worker, CMSketch estimates,
+TRACE statement, failpoint fault injection, sysvar breadth (reference:
+domain/domain.go:1270, statistics/cmsketch.go, executor/trace.go,
+pingcap/failpoint, sessionctx/variable/sysvar.go)."""
+
+import pytest
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.session.sysvars import get_registry
+from tidb_tpu.utils import failpoint
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("create table t (a int primary key, b int)")
+    return tk
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+# -- stats worker -------------------------------------------------------------
+
+def test_modify_counts_recorded(tk):
+    info = tk.session.infoschema().table_by_name("test", "t")
+    tk.must_exec("insert into t values (1, 1), (2, 2)")
+    tk.must_exec("update t set b = 5 where a = 1")
+    tk.must_exec("delete from t where a = 2")
+    w = tk.session.domain.stats_worker
+    assert w.modify_counts.get(info.id, 0) >= 4
+
+
+def test_auto_analyze_triggers(tk):
+    info = tk.session.infoschema().table_by_name("test", "t")
+    vals = ",".join(f"({i}, {i % 7})" for i in range(1500))
+    tk.must_exec(f"insert into t values {vals}")
+    w = tk.session.domain.stats_worker
+    done = w.run_once()
+    assert info.id in done
+    stats = tk.session.domain.stats[info.id]
+    assert stats["row_count"] == 1500
+    # churn below the ratio: no re-analyze
+    tk.must_exec("update t set b = 99 where a < 10")
+    assert info.id not in w.run_once()
+    # churn above the ratio (>50%): re-analyze
+    tk.must_exec("update t set b = b + 1 where a < 1000")
+    assert info.id in w.run_once()
+
+
+def test_auto_analyze_respects_toggle(tk):
+    tk.must_exec("set global tidb_enable_auto_analyze = OFF")
+    vals = ",".join(f"({i}, 1)" for i in range(1200))
+    tk.must_exec(f"insert into t values {vals}")
+    assert tk.session.domain.stats_worker.run_once() == []
+    tk.must_exec("set global tidb_enable_auto_analyze = ON")
+
+
+# -- CMSketch -----------------------------------------------------------------
+
+def test_cmsketch_point_estimate(tk):
+    # 20 heavy values (TopN captures 8) + long tail → sketch answers the
+    # tail with bounded overestimates
+    rows = []
+    rid = 0
+    for v in range(20):
+        for _ in range(50 - v):
+            rows.append((rid, v))
+            rid += 1
+    for v in range(100, 400):
+        rows.append((rid, v))
+        rid += 1
+    tk.must_exec("insert into t values " +
+                 ",".join(f"({a},{b})" for a, b in rows))
+    tk.must_exec("analyze table t")
+    info = tk.session.infoschema().table_by_name("test", "t")
+    cs = tk.session.domain.stats[info.id]["columns"][str(
+        next(c.id for c in info.columns if c.name == "b"))]
+    assert "cmsketch" in cs
+    from tidb_tpu.statistics.analyze import cm_query
+    est = cm_query(cs["cmsketch"], 150)  # tail value: true count 1
+    assert 1 <= est <= 10  # CM overestimates but stays near
+
+
+# -- TRACE --------------------------------------------------------------------
+
+def test_trace_select(tk):
+    tk.must_exec("insert into t values (1, 2), (3, 4)")
+    r = tk.must_query("trace select sum(b) from t")
+    ops = [row[0] for row in r.rows]
+    assert "trace.total" in ops
+    assert any("plan_query" in o for o in ops)
+    assert any("executor.run" in o for o in ops)
+    assert any("operator." in o for o in ops)
+
+
+# -- failpoints ---------------------------------------------------------------
+
+def test_failpoint_panic_between_prewrite_and_commit(tk):
+    """In-process failure after prewrite: locks are released, nothing is
+    committed, and the next writer proceeds cleanly."""
+    tk.must_exec("insert into t values (1, 1)")
+    failpoint.enable("txn-after-prewrite", "panic")
+    with pytest.raises(failpoint.FailpointError):
+        tk.must_exec("insert into t values (2, 2)")
+    failpoint.disable("txn-after-prewrite")
+    assert failpoint.hits("txn-after-prewrite") >= 1
+    tk.must_query("select count(*) from t").check([("1",)])
+    tk.must_exec("insert into t values (2, 22)")
+    tk.must_query("select b from t where a = 2").check([("22",)])
+    tk.must_query("select count(*) from t").check([("2",)])
+
+
+def test_failpoint_sleep_and_return(tk):
+    failpoint.enable("txn-before-prewrite", "sleep(0.01)")
+    tk.must_exec("insert into t values (5, 5)")  # just slower, still works
+    assert failpoint.hits("txn-before-prewrite") >= 1
+    failpoint.disable_all()
+    assert failpoint.inject("txn-before-prewrite") is None
+
+
+def test_failpoint_ddl_backfill(tk):
+    vals = ",".join(f"({i}, {i})" for i in range(50))
+    tk.must_exec(f"insert into t values {vals}")
+    failpoint.enable("ddl-backfill-batch", "sleep(0.001)")
+    tk.must_exec("create index i_b on t (b)")
+    assert failpoint.hits("ddl-backfill-batch") >= 1
+    tk.must_exec("admin check index t i_b")
+
+
+# -- sysvars ------------------------------------------------------------------
+
+def test_sysvar_registry_breadth(tk):
+    assert len(get_registry()) >= 140
+    # common client handshake reads work
+    r = tk.must_query(
+        "select @@version_comment, @@auto_increment_increment, "
+        "@@character_set_server, @@tidb_row_format_version")
+    assert r.rows[0][1] == "1"
+
+
+def test_show_variables_count(tk):
+    rows = tk.must_query("show variables").rows
+    assert len(rows) >= 140
+
+
+def test_trace_checked_for_privileges(tk):
+    from tidb_tpu.session import Session
+    tk.must_exec("create user 'tr'@'%'")
+    s = Session(tk.session.domain)
+    s.user = "tr@%"
+    with pytest.raises(TiDBError):
+        s.execute("trace select * from t")
+
+
+def test_cmsketch_int_float_keys_collide(tk):
+    from tidb_tpu.statistics.analyze import build_cmsketch, cm_query
+    import numpy as np
+    cm = build_cmsketch(np.array([2.0, 3.5]), np.array([20, 7]))
+    assert cm_query(cm, 2) == 20       # int query, float build
+    assert cm_query(cm, 2.0) == 20
+    assert cm_query(cm, 3.5) == 7

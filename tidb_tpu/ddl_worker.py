@@ -271,8 +271,10 @@ class DDLWorker:
         records after the checkpoint handle, write their index KVs, and
         advance the checkpoint — all in ONE txn, so a crash between batches
         loses nothing and repeats nothing."""
+        from .utils import failpoint
         store = self.domain.store
         for _attempt in range(20):
+            failpoint.inject("ddl-backfill-batch")
             txn = store.begin()
             try:
                 m = Meta(txn)

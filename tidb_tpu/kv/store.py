@@ -156,13 +156,24 @@ class Transaction:
         if not muts:
             self.store.mvcc.clear_wait(self.start_ts)
             return self.start_ts
+        from ..utils import failpoint
         primary = muts[0][0]
+        failpoint.inject("txn-before-prewrite")
         try:
             self.store.mvcc.prewrite(muts, primary, self.start_ts)
         except Exception:
             self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
             raise
-        commit_ts = self.store.next_ts()
+        # crash window: locks written, nothing committed. An IN-PROCESS
+        # failure here must release the locks (self.valid is already False,
+        # so the caller's rollback would no-op and orphan them); a real
+        # process crash instead leaves them for the resolve-lock path.
+        try:
+            failpoint.inject("txn-after-prewrite")
+            commit_ts = self.store.next_ts()
+        except BaseException:
+            self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
+            raise
         self.store.mvcc.commit([m[0] for m in muts], self.start_ts, commit_ts)
         self.store.mvcc.clear_wait(self.start_ts)
         for tid in self.touched_tables:

@@ -126,4 +126,7 @@ def check_stmt_privileges(session, stmt):
     elif isinstance(stmt, ast.ExplainStmt):
         # EXPLAIN ANALYZE executes the inner statement — same read checks
         req_tables(stmt.stmt, "select")
+    elif isinstance(stmt, ast.TraceStmt):
+        # TRACE SELECT executes the inner statement outside _dispatch
+        req_tables(stmt.stmt, "select")
     # SHOW / SET / admin / txn-control: unrestricted

@@ -73,6 +73,7 @@ def run_server(cfg, ready_event: threading.Event | None = None):
         domain.priv.disabled = True
         domain.priv.enabled = False
 
+    domain.stats_worker.start()  # auto-analyze loop (domain.go:1270 analog)
     sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port).start()
     status_srv = None
     if cfg.status.report_status:
@@ -100,6 +101,7 @@ def run_server(cfg, ready_event: threading.Event | None = None):
         status_srv.shutdown()
     sql_srv.shutdown()
     domain.ddl_worker.stop()
+    domain.stats_worker.stop()
     return 0
 
 

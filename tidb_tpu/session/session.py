@@ -49,6 +49,8 @@ class Domain:
         self.ddl_worker = DDLWorker(self)   # async online-DDL owner worker
         from ..privilege import PrivManager
         self.priv = PrivManager(self)       # grant-table cache (RBAC)
+        from ..statistics.worker import StatsWorker
+        self.stats_worker = StatsWorker(self)  # auto-analyze loop
         self.reload_schema()
 
     def reload_schema(self):
@@ -322,6 +324,9 @@ class Session:
             newv = txn.committed_versions.get(tid)
             found = infos.table_by_id(tid)
             info = found[1] if found is not None else None
+            if deltas is not None and tid in deltas:
+                # stats modify-count feed (reference: handle/update.go)
+                self.domain.stats_worker.record_delta(tid, len(deltas[tid]))
             if deltas is None or info is None or newv is None:
                 cache.invalidate(tid)
                 continue
@@ -611,7 +616,7 @@ class Session:
             return Result(names=["table", "rows"],
                           chunk=Chunk.from_rows([ft_s, ft_i], rows))
         if isinstance(stmt, ast.TraceStmt):
-            return self._dispatch(stmt.stmt)
+            return self._exec_trace(stmt)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
 
     # -- query path ----------------------------------------------------------
@@ -693,6 +698,49 @@ class Session:
         out = Chunk.from_rows([ft] * 5, rows)
         return Result(names=["id", "actRows", "execution info",
                              "operator info", "memory"], chunk=out)
+
+    def _exec_trace(self, stmt: ast.TraceStmt) -> Result:
+        """TRACE SELECT ... — renders the span tree of one execution as a
+        table (reference: executor/trace.go:50). Spans: plan build/optimize,
+        executor build, per-operator execution (from the runtime stats
+        collector), and the total."""
+        inner = stmt.stmt
+        if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
+            r = self._dispatch(inner)  # non-SELECT: run it, no spans
+            return r
+        from ..executor import build_executor
+        from ..executor.execdetails import RuntimeStatsColl, _fmt_dur
+        from ..planner.logical import explain_nodes
+        spans = []
+        t_total = time.perf_counter()
+        t0 = time.perf_counter()
+        plan = self.plan_query(inner)
+        spans.append(("session.plan_query", t0 - t_total,
+                      time.perf_counter() - t0))
+        coll = RuntimeStatsColl()
+        t0 = time.perf_counter()
+        exe = build_executor(plan, self._exec_ctx(), stats=coll)
+        spans.append(("executor.build", t0 - t_total,
+                      time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        exe.execute()
+        spans.append(("executor.run", t0 - t_total,
+                      time.perf_counter() - t0))
+        for name, _info, node in explain_nodes(plan):
+            if coll.has(node):
+                st = coll.get(node)
+                spans.append((f"  operator.{name.strip().replace('└─', '')}",
+                              None, st.time_s))
+        total = time.perf_counter() - t_total
+        ft = FieldType(tp=TYPE_VARCHAR)
+        rows = [(b"trace.total", b"0s", _fmt_dur(total).encode())]
+        for op, start, dur in spans:
+            rows.append((op.encode(),
+                         (_fmt_dur(start) if start is not None else "-"
+                          ).encode(),
+                         _fmt_dur(dur).encode()))
+        return Result(names=["operation", "startTS", "duration"],
+                      chunk=Chunk.from_rows([ft, ft, ft], rows))
 
     def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> Result:
         """Collect basic stats (reference: executor/analyze.go; histograms

@@ -105,6 +105,130 @@ for _v in [
     SysVar("tidb_mesh_shape", SCOPE_BOTH, "1", "str"),
     SysVar("tidb_slow_log_threshold", SCOPE_BOTH, "300", "int", 0),
     SysVar("cte_max_recursion_depth", SCOPE_BOTH, "1000", "int", 0, 4294967295),
+    SysVar("tidb_auto_analyze_ratio", SCOPE_GLOBAL, "0.5", "float"),
+    SysVar("tidb_enable_auto_analyze", SCOPE_GLOBAL, "ON", "bool"),
     SysVar("tidb_record_plan_in_slow_log", SCOPE_BOTH, "ON", "bool"),
+    # -- MySQL-compat breadth (reference: sysvar.go registers 248;
+    #    clients and ORMs read/SET these at connect time) ---------------
+    SysVar("auto_increment_increment", SCOPE_BOTH, "1", "int", 1, 65535),
+    SysVar("auto_increment_offset", SCOPE_BOTH, "1", "int", 1, 65535),
+    SysVar("block_encryption_mode", SCOPE_BOTH, "aes-128-ecb"),
+    SysVar("character_set_database", SCOPE_BOTH, "utf8mb4"),
+    SysVar("character_set_server", SCOPE_BOTH, "utf8mb4"),
+    SysVar("character_set_system", SCOPE_NONE, "utf8mb4"),
+    SysVar("collation_database", SCOPE_BOTH, "utf8mb4_bin"),
+    SysVar("collation_server", SCOPE_BOTH, "utf8mb4_bin"),
+    SysVar("default_week_format", SCOPE_BOTH, "0", "int", 0, 7),
+    SysVar("div_precision_increment", SCOPE_BOTH, "4", "int", 0, 30),
+    SysVar("foreign_key_checks", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("group_concat_max_len", SCOPE_BOTH, "1024", "int", 4),
+    SysVar("innodb_lock_wait_timeout", SCOPE_BOTH, "50", "int", 1),
+    SysVar("lc_time_names", SCOPE_BOTH, "en_US"),
+    SysVar("license", SCOPE_NONE, "Apache License 2.0"),
+    SysVar("lower_case_table_names", SCOPE_NONE, "2", "int", 0, 2),
+    SysVar("max_sort_length", SCOPE_BOTH, "1024", "int", 4),
+    SysVar("net_buffer_length", SCOPE_BOTH, "16384", "int", 1024),
+    SysVar("net_read_timeout", SCOPE_BOTH, "30", "int", 1),
+    SysVar("net_write_timeout", SCOPE_BOTH, "60", "int", 1),
+    SysVar("performance_schema", SCOPE_NONE, "OFF", "bool"),
+    SysVar("protocol_version", SCOPE_NONE, "10", "int"),
+    SysVar("query_cache_size", SCOPE_GLOBAL, "0", "int", 0),
+    SysVar("query_cache_type", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("read_only", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("sql_safe_updates", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("sql_select_limit", SCOPE_BOTH, str(2**64 - 1), "str"),
+    SysVar("system_time_zone", SCOPE_NONE, "UTC"),
+    SysVar("table_definition_cache", SCOPE_GLOBAL, "2000", "int", 400),
+    SysVar("thread_cache_size", SCOPE_GLOBAL, "9", "int", 0),
+    SysVar("tmp_table_size", SCOPE_BOTH, "16777216", "int", 1024),
+    SysVar("unique_checks", SCOPE_BOTH, "ON", "bool"),
+    SysVar("version", SCOPE_NONE, "8.0.11-tpu-htap"),
+    SysVar("version_compile_machine", SCOPE_NONE, "tpu"),
+    SysVar("version_compile_os", SCOPE_NONE, "Linux"),
+    SysVar("warning_count", SCOPE_SESSION, "0", "int"),
+    SysVar("error_count", SCOPE_SESSION, "0", "int"),
+    SysVar("default_authentication_plugin", SCOPE_GLOBAL,
+           "mysql_native_password"),
+    SysVar("init_connect", SCOPE_GLOBAL, ""),
+    SysVar("have_openssl", SCOPE_NONE, "DISABLED"),
+    SysVar("have_ssl", SCOPE_NONE, "DISABLED"),
+    SysVar("max_user_connections", SCOPE_BOTH, "0", "int", 0, 100000),
+    SysVar("max_prepared_stmt_count", SCOPE_GLOBAL, "16382", "int", -1),
+    SysVar("binlog_format", SCOPE_BOTH, "ROW"),
+    SysVar("log_bin", SCOPE_NONE, "OFF", "bool"),
+    SysVar("timestamp", SCOPE_SESSION, "0"),
+    SysVar("profiling", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("optimizer_switch", SCOPE_BOTH, "index_merge=on"),
+    # -- tidb_* engine knobs (reference names, same semantics) ----------
+    SysVar("tidb_allow_batch_cop", SCOPE_BOTH, "1", "int", 0, 2),
+    SysVar("tidb_allow_mpp", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_auto_analyze_start_time", SCOPE_GLOBAL, "00:00 +0000"),
+    SysVar("tidb_auto_analyze_end_time", SCOPE_GLOBAL, "23:59 +0000"),
+    SysVar("tidb_backoff_weight", SCOPE_BOTH, "2", "int", 1),
+    SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
+           str(100 * 1024 * 1024), "int", 0),
+    SysVar("tidb_checksum_table_concurrency", SCOPE_BOTH, "4", "int", 1),
+    SysVar("tidb_constraint_check_in_place", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_current_ts", SCOPE_SESSION, "0", "int"),
+    SysVar("tidb_ddl_error_count_limit", SCOPE_GLOBAL, "512", "int", 0),
+    SysVar("tidb_ddl_reorg_batch_size", SCOPE_GLOBAL, "256", "int", 32),
+    SysVar("tidb_ddl_reorg_worker_cnt", SCOPE_GLOBAL, "4", "int", 1),
+    SysVar("tidb_disable_txn_auto_retry", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_cascades_planner", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_chunk_rpc", SCOPE_SESSION, "ON", "bool"),
+    SysVar("tidb_enable_clustered_index", SCOPE_BOTH, "INT_ONLY"),
+    SysVar("tidb_enable_collect_execution_info", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_fast_analyze", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_index_merge", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_noop_functions", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_parallel_apply", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_slow_log", SCOPE_GLOBAL, "ON", "bool"),
+    SysVar("tidb_enable_stmt_summary", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_table_partition", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_vectorized_expression", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_force_priority", SCOPE_SESSION, "NO_PRIORITY"),
+    SysVar("tidb_general_log", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_hash_join_concurrency", SCOPE_BOTH, "5", "int", 1),
+    SysVar("tidb_hashagg_final_concurrency", SCOPE_BOTH, "5", "int", 1),
+    SysVar("tidb_hashagg_partial_concurrency", SCOPE_BOTH, "5", "int", 1),
+    SysVar("tidb_index_join_batch_size", SCOPE_BOTH, "25000", "int", 1),
+    SysVar("tidb_index_lookup_concurrency", SCOPE_BOTH, "4", "int", 1),
+    SysVar("tidb_index_lookup_size", SCOPE_BOTH, "20000", "int", 1),
+    SysVar("tidb_index_serial_scan_concurrency", SCOPE_BOTH, "1", "int", 1),
+    SysVar("tidb_init_chunk_size", SCOPE_BOTH, "32", "int", 1, 32),
+    SysVar("tidb_isolation_read_engines", SCOPE_SESSION, "tpu,host"),
+    SysVar("tidb_low_resolution_tso", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_max_delta_schema_count", SCOPE_GLOBAL, "1024", "int", 100),
+    SysVar("tidb_mem_oom_action", SCOPE_GLOBAL, "CANCEL", "enum",
+           choices=("cancel", "log")),
+    SysVar("tidb_mem_quota_apply_cache", SCOPE_BOTH,
+           str(32 << 20), "int", 0),
+    SysVar("tidb_opt_agg_push_down", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_opt_correlation_threshold", SCOPE_BOTH, "0.9", "float"),
+    SysVar("tidb_opt_distinct_agg_push_down", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_opt_insubq_to_join_and_agg", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_opt_join_reorder_threshold", SCOPE_BOTH, "0", "int", 0, 63),
+    SysVar("tidb_opt_write_row_id", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_projection_concurrency", SCOPE_BOTH, "-1", "int", -1),
+    SysVar("tidb_query_log_max_len", SCOPE_GLOBAL, "4096", "int", 0),
+    SysVar("tidb_read_staleness", SCOPE_SESSION, "0", "int"),
+    SysVar("tidb_replica_read", SCOPE_SESSION, "leader"),
+    SysVar("tidb_row_format_version", SCOPE_GLOBAL, "2", "int", 1, 2),
+    SysVar("tidb_scatter_region", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_skip_isolation_level_check", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_skip_utf8_check", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_slow_query_file", SCOPE_SESSION, ""),
+    SysVar("tidb_stmt_summary_max_stmt_count", SCOPE_GLOBAL, "3000",
+           "int", 1),
+    SysVar("tidb_store_limit", SCOPE_BOTH, "0", "int", 0),
+    SysVar("tidb_txn_assertion_level", SCOPE_BOTH, "FAST"),
+    SysVar("tidb_wait_split_region_finish", SCOPE_SESSION, "ON", "bool"),
+    SysVar("tidb_wait_split_region_timeout", SCOPE_SESSION, "300", "int", 1),
+    SysVar("tidb_window_concurrency", SCOPE_BOTH, "-1", "int", -1),
+    SysVar("tx_read_only", SCOPE_BOTH, "0", "bool"),
+    SysVar("sql_log_bin", SCOPE_SESSION, "ON", "bool"),
+    SysVar("sql_notes", SCOPE_BOTH, "ON", "bool"),
+    SysVar("sql_quote_show_create", SCOPE_BOTH, "ON", "bool"),
+    SysVar("sql_warnings", SCOPE_BOTH, "OFF", "bool"),
 ]:
     register(_v)

@@ -15,6 +15,39 @@ from ..meta import Meta
 
 HIST_BUCKETS = 64
 TOPN_SIZE = 8
+CM_DEPTH = 4
+CM_WIDTH = 512
+
+def _cm_indices(key) -> list[int]:
+    """One 128-bit hash per value; the depth row indices derive from its
+    halves (reference: cmsketch.go hashes once with murmur128 and mixes
+    h1 + i*h2). Numeric keys canonicalize so int 2 and float 2.0 collide
+    deliberately — query constants may arrive as either type."""
+    if isinstance(key, float) and key.is_integer():
+        key = int(key)
+    if isinstance(key, float):
+        key = key.hex()
+    import hashlib
+    digest = hashlib.blake2b(str(key).encode(), digest_size=16).digest()
+    h1 = int.from_bytes(digest[:8], "little")
+    h2 = int.from_bytes(digest[8:], "little") | 1
+    return [((h1 + d * h2) & 0xFFFFFFFFFFFFFFFF) % CM_WIDTH
+            for d in range(CM_DEPTH)]
+
+
+def build_cmsketch(values, counts) -> list[list[int]]:
+    """Count-min sketch over (distinct value, count) pairs (reference:
+    statistics/cmsketch.go:46): depth×width counters; lookup takes the
+    min across rows — an overestimate, never an underestimate."""
+    rows = [[0] * CM_WIDTH for _ in range(CM_DEPTH)]
+    for v, c in zip(values, counts):
+        for d, idx in enumerate(_cm_indices(_val_key(v))):
+            rows[d][idx] += int(c)
+    return rows
+
+
+def cm_query(cm: list[list[int]], key) -> int:
+    return min(row[idx] for row, idx in zip(cm, _cm_indices(key)))
 
 
 def _val_key(v):
@@ -40,6 +73,12 @@ def _column_stats(col):
     top = np.argpartition(counts, -k)[-k:]
     top = top[np.argsort(counts[top])[::-1]]
     cs["topn"] = [[_val_key(uniques[i]), int(counts[i])] for i in top]
+    # CMSketch over the non-TopN remainder: point estimates for values the
+    # TopN missed (reference: cmsketch.go TopN+CMSketch split)
+    top_set = set(top.tolist())
+    rest = [i for i in range(len(uniques)) if i not in top_set]
+    if rest:
+        cs["cmsketch"] = build_cmsketch(uniques[rest], counts[rest])
     if data.dtype != object:
         vals = data.astype(np.float64)
         cs["min"] = float(vals.min())

@@ -48,7 +48,9 @@ def _col_const(cond):
 
 
 def _eq_sel(cs, n, v):
-    """Selectivity of col = v given column stats."""
+    """Selectivity of col = v: exact TopN count, then CMSketch point
+    estimate, then uniform NDV fallback (reference: histogram.go
+    EqualRowCount over TopN+CMSketch)."""
     key = _const_key(v)
     topn = cs.get("topn") or []
     topn_cnt = 0
@@ -56,6 +58,14 @@ def _eq_sel(cs, n, v):
         topn_cnt += tc
         if tv == key:
             return tc / n
+    cm = cs.get("cmsketch")
+    if cm is not None:
+        from .analyze import cm_query
+        est = cm_query(cm, key)
+        if est > 0:
+            return min(est, n) / n
+        # sketch says absent: fall through to the NDV average (an absent
+        # value may still appear post-ANALYZE; never estimate zero)
     ndv = max(cs.get("ndv", 0), 1)
     rest = max(n - topn_cnt - cs.get("null_count", 0), 0)
     rest_ndv = max(ndv - len(topn), 1)

@@ -1,0 +1,101 @@
+"""Background statistics maintenance (reference: domain/domain.go:1270
+UpdateTableStatsLoop + statistics/handle/update.go — DML deltas feed
+modify counts; auto-analyze re-collects stats when a table churns past
+tidb_auto_analyze_ratio).
+
+Sessions record per-commit row deltas into the domain; the worker thread
+(or an explicit run_once() in tests) re-analyzes tables whose modified
+fraction exceeds the ratio."""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+AUTO_ANALYZE_MIN_ROWS = 1000
+
+
+class StatsWorker:
+    def __init__(self, domain):
+        self.domain = domain
+        self._lock = threading.Lock()
+        self.modify_counts: dict[int, int] = {}   # tid -> rows changed
+        self._thread = None
+        self._stop = threading.Event()
+        self.analyzed = collections.deque(maxlen=256)  # recent log (bounded)
+
+    # -- delta feed (called from the commit path) ----------------------------
+
+    def record_delta(self, table_id: int, n_rows: int):
+        if n_rows <= 0:
+            return
+        with self._lock:
+            self.modify_counts[table_id] = \
+                self.modify_counts.get(table_id, 0) + n_rows
+
+    # -- the loop (reference: updateStatsWorker) -----------------------------
+
+    def start(self, interval: float = 3.0):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.run_once()
+                except Exception:
+                    pass  # background maintenance must never crash the server
+        self._thread = threading.Thread(target=loop, name="stats-worker",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def run_once(self):
+        """One auto-analyze sweep; returns the table ids re-analyzed."""
+        dom = self.domain
+        try:
+            ratio = float(dom.global_vars.get("tidb_auto_analyze_ratio",
+                                              "0.5"))
+            enabled = dom.global_vars.get("tidb_enable_auto_analyze",
+                                          "ON") != "OFF"
+        except ValueError:
+            ratio, enabled = 0.5, True
+        if not enabled:
+            return []
+        with self._lock:
+            pending = dict(self.modify_counts)
+        done = []
+        infos = dom.infoschema()
+        for tid, modified in pending.items():
+            found = infos.table_by_id(tid)
+            if found is None:
+                with self._lock:
+                    self.modify_counts.pop(tid, None)
+                continue
+            _db, info = found
+            base = (dom.stats.get(tid) or {}).get("row_count", 0)
+            if base < AUTO_ANALYZE_MIN_ROWS and modified < AUTO_ANALYZE_MIN_ROWS:
+                continue
+            if modified < max(base, 1) * ratio:
+                continue
+            from .analyze import analyze_table
+            from ..session import Session
+            s = Session(dom)
+            s._internal = 1
+            try:
+                analyze_table(s, info)
+            finally:
+                s.close()
+            with self._lock:
+                self.modify_counts[tid] = \
+                    max(self.modify_counts.get(tid, 0) - modified, 0)
+            done.append(tid)
+            self.analyzed.append(tid)
+            dom.observe.inc("stats_auto_analyze_total")
+        return done

@@ -1,0 +1,74 @@
+"""Failpoint-style fault injection (reference: pingcap/failpoint, used in
+103 reference files; kv/fault_injection.go).
+
+Production code calls ``inject("name")`` at interesting points; tests
+activate behaviors with ``enable``:
+
+    failpoint.enable("commit-after-prewrite", "panic")     # raise
+    failpoint.enable("backfill-batch", "sleep(0.05)")
+    failpoint.enable("scan-rows", "return(7)")
+
+Disabled failpoints cost one dict lookup. ``inject`` returns the
+``return(...)`` payload (or None), raises FailpointError for ``panic``."""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+
+class FailpointError(Exception):
+    """Raised by an enabled `panic` failpoint."""
+
+
+_lock = threading.Lock()
+_active: dict[str, str] = {}
+_hits: dict[str, int] = {}
+
+
+def enable(name: str, action: str):
+    with _lock:
+        _active[name] = action
+        _hits[name] = 0
+
+
+def disable(name: str):
+    with _lock:
+        _active.pop(name, None)
+
+
+def disable_all():
+    with _lock:
+        _active.clear()
+
+
+def hits(name: str) -> int:
+    return _hits.get(name, 0)
+
+
+def inject(name: str):
+    action = _active.get(name)
+    if action is None:
+        return None
+    with _lock:
+        _hits[name] = _hits.get(name, 0) + 1
+    if action == "panic":
+        raise FailpointError(f"failpoint {name} triggered")
+    m = re.fullmatch(r"sleep\(([\d.]+)\)", action)
+    if m:
+        time.sleep(float(m.group(1)))
+        return None
+    m = re.fullmatch(r"return\((.*)\)", action)
+    if m:
+        raw = m.group(1)
+        try:
+            return int(raw)
+        except ValueError:
+            return raw.strip("'\"")
+    m = re.fullmatch(r"(\d+)\*panic", action)
+    if m:  # N*panic: raise for the first N hits, then no-op
+        if _hits.get(name, 0) <= int(m.group(1)):
+            raise FailpointError(f"failpoint {name} triggered")
+        return None
+    raise ValueError(f"unknown failpoint action {action!r}")
