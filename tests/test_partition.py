@@ -1,0 +1,285 @@
+"""Partitioned tables: RANGE/HASH/LIST routing, pruning, partition
+management DDL (reference: table/tables/partition.go,
+planner/core/rule_partition_processor.go, ddl/partition.go)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+def _explain_text(tk, sql):
+    return "\n".join(" ".join(str(c) for c in r)
+                     for r in tk.must_query("EXPLAIN " + sql).rows)
+
+
+class TestRangePartition:
+    def test_route_and_scan(self, tk):
+        tk.must_exec("""create table s (id int, amount int)
+            partition by range (amount) (
+              partition p0 values less than (100),
+              partition p1 values less than (200),
+              partition pmax values less than maxvalue)""")
+        tk.must_exec("insert into s values (1,50),(2,150),(3,250),(4,90)")
+        tk.must_query("select id from s order by id").check(
+            [("1",), ("2",), ("3",), ("4",)])
+        tk.must_query("select id from s partition (p0) order by id").check(
+            [("1",), ("4",)])
+        tk.must_query("select id from s partition (p1, pmax) order by id"
+                      ).check([("2",), ("3",)])
+
+    def test_no_partition_for_value(self, tk):
+        tk.must_exec("""create table s (a int) partition by range (a)
+            (partition p0 values less than (10))""")
+        e = tk.exec_error("insert into s values (10)")
+        assert "no partition" in str(e)
+
+    def test_pruning_eq_and_range(self, tk):
+        tk.must_exec("""create table s (id int, amount int)
+            partition by range (amount) (
+              partition p0 values less than (100),
+              partition p1 values less than (200),
+              partition pmax values less than maxvalue)""")
+        tk.must_exec("insert into s values (1,50),(2,150),(3,250)")
+        txt = _explain_text(tk, "select * from s where amount = 150")
+        assert "partition:p1" in txt
+        txt = _explain_text(tk, "select * from s where amount < 100")
+        assert "partition:p0" in txt and "p1" not in txt
+        txt = _explain_text(tk, "select * from s where amount >= 200")
+        assert "partition:pmax" in txt and "p0" not in txt
+        # results stay correct under pruning
+        tk.must_query("select count(*) from s where amount = 150").check(
+            [("1",)])
+        tk.must_query("select count(*) from s where amount < 100").check(
+            [("1",)])
+
+    def test_update_moves_row_between_partitions(self, tk):
+        tk.must_exec("""create table s (id int, amount int)
+            partition by range (amount) (
+              partition p0 values less than (100),
+              partition p1 values less than (200))""")
+        tk.must_exec("insert into s values (1, 50)")
+        tk.must_exec("update s set amount = 150 where id = 1")
+        tk.must_query("select count(*) from s partition (p0)").check([("0",)])
+        tk.must_query("select id from s partition (p1)").check([("1",)])
+
+    def test_null_routes_to_first(self, tk):
+        tk.must_exec("""create table s (a int) partition by range (a)
+            (partition p0 values less than (10),
+             partition p1 values less than (20))""")
+        tk.must_exec("insert into s values (null)")
+        tk.must_query("select count(*) from s partition (p0)").check([("1",)])
+
+    def test_year_func_partitioning(self, tk):
+        tk.must_exec("""create table o (d date, v int)
+            partition by range (year(d)) (
+              partition y94 values less than (1995),
+              partition y95 values less than (1996),
+              partition ymax values less than maxvalue)""")
+        tk.must_exec("insert into o values ('1994-03-01',1),"
+                     "('1995-07-01',2),('1999-01-01',3)")
+        tk.must_query("select count(*) from o partition (y95)").check(
+            [("1",)])
+        tk.must_query("select v from o where d = '1995-07-01'").check(
+            [("2",)])
+
+    def test_range_not_increasing_rejected(self, tk):
+        e = tk.exec_error("""create table s (a int) partition by range (a)
+            (partition p0 values less than (20),
+             partition p1 values less than (10))""")
+        assert "strictly increasing" in str(e)
+
+
+class TestHashPartition:
+    def test_route_and_point_read(self, tk):
+        tk.must_exec("""create table h (id int primary key, v int)
+            partition by hash (id) partitions 4""")
+        tk.must_exec("insert into h values (1,10),(2,20),(3,30),(4,40),(5,50)")
+        tk.must_query("select v from h where id = 3").check([("30",)])
+        tk.must_query("select count(*) from h").check([("5",)])
+
+    def test_rows_spread_across_partitions(self, tk):
+        tk.must_exec("""create table h (id int primary key)
+            partition by hash (id) partitions 2""")
+        tk.must_exec("insert into h values (1),(2),(3),(4)")
+        tk.must_query("select count(*) from h partition (p0)").check([("2",)])
+        tk.must_query("select count(*) from h partition (p1)").check([("2",)])
+
+
+class TestListPartition:
+    def test_route_and_null(self, tk):
+        tk.must_exec("""create table l (r int, v int)
+            partition by list (r) (
+              partition pa values in (1, 2),
+              partition pb values in (3, null))""")
+        tk.must_exec("insert into l values (1,1),(3,3),(null,9)")
+        tk.must_query("select count(*) from l partition (pb)").check(
+            [("2",)])
+        e = tk.exec_error("insert into l values (7,7)")
+        assert "no partition" in str(e)
+
+    def test_pruning_eq(self, tk):
+        tk.must_exec("""create table l (r int) partition by list (r) (
+            partition pa values in (1), partition pb values in (2))""")
+        txt = _explain_text(tk, "select * from l where r = 2")
+        assert "partition:pb" in txt
+
+
+class TestPartitionDDL:
+    def test_add_partition(self, tk):
+        tk.must_exec("""create table s (a int) partition by range (a)
+            (partition p0 values less than (10))""")
+        tk.must_exec("alter table s add partition "
+                     "(partition p1 values less than (20))")
+        tk.must_exec("insert into s values (15)")
+        tk.must_query("select count(*) from s partition (p1)").check(
+            [("1",)])
+        # after MAXVALUE: rejected
+        tk.must_exec("alter table s add partition "
+                     "(partition pm values less than maxvalue)")
+        e = tk.exec_error("alter table s add partition "
+                          "(partition px values less than (99))")
+        assert "strictly increasing" in str(e)
+
+    def test_drop_partition(self, tk):
+        tk.must_exec("""create table s (a int) partition by range (a)
+            (partition p0 values less than (10),
+             partition p1 values less than (20))""")
+        tk.must_exec("insert into s values (5), (15)")
+        tk.must_exec("alter table s drop partition p0")
+        tk.must_query("select a from s").check([("15",)])
+        e = tk.exec_error("alter table s drop partition p1")
+        assert "Cannot remove all partitions" in str(e)
+
+    def test_truncate_partition(self, tk):
+        tk.must_exec("""create table s (a int) partition by range (a)
+            (partition p0 values less than (10),
+             partition p1 values less than (20))""")
+        tk.must_exec("insert into s values (5), (15)")
+        tk.must_exec("alter table s truncate partition p0")
+        tk.must_query("select a from s").check([("15",)])
+
+    def test_unique_key_must_cover_partition_col(self, tk):
+        e = tk.exec_error("""create table bad (a int primary key, b int)
+            partition by range (b) (partition p0 values less than (10))""")
+        assert "PRIMARY KEY" in str(e)
+        e = tk.exec_error("""create table bad2 (a int, b int, unique key(a))
+            partition by hash (b) partitions 2""")
+        assert "UNIQUE INDEX" in str(e)
+
+    def test_show_create_table_includes_partitions(self, tk):
+        tk.must_exec("""create table s (a int) partition by range (a)
+            (partition p0 values less than (10))""")
+        ddl = tk.must_query("show create table s").rows[0][1]
+        if isinstance(ddl, bytes):
+            ddl = ddl.decode()
+        assert "PARTITION BY RANGE" in ddl and "`p0`" in ddl
+
+    def test_truncate_table_reallocates_partition_ids(self, tk):
+        tk.must_exec("""create table s (a int) partition by hash (a)
+            partitions 2""")
+        tk.must_exec("insert into s values (1),(2),(3)")
+        tk.must_exec("truncate table s")
+        tk.must_query("select count(*) from s").check([("0",)])
+        tk.must_exec("insert into s values (9)")
+        tk.must_query("select count(*) from s").check([("1",)])
+
+    def test_partition_mgmt_on_nonpartitioned(self, tk):
+        tk.must_exec("create table plain (a int)")
+        e = tk.exec_error("alter table plain drop partition p0")
+        assert "not partitioned" in str(e)
+        e = tk.exec_error("select * from plain partition (p0)")
+        assert "PARTITION" in str(e)
+
+
+class TestPartitionIndexes:
+    def test_add_index_backfills_all_partitions(self, tk):
+        """Regression: backfill must scan partition physical ids, not the
+        logical table id (which holds no rows)."""
+        tk.must_exec("""create table t (id int, v int)
+            partition by range (id) (
+              partition p0 values less than (10),
+              partition p1 values less than (20))""")
+        tk.must_exec("insert into t values (1, 100), (15, 200)")
+        tk.must_exec("alter table t add index iv (id)")
+        tk.must_query("select v from t where id = 1").check([("100",)])
+        tk.must_query("select v from t where id = 15").check([("200",)])
+
+    def test_unique_index_must_cover_partition_col(self, tk):
+        tk.must_exec("""create table t (id int, v int)
+            partition by range (id) (
+              partition p0 values less than (10),
+              partition p1 values less than (20))""")
+        e = tk.exec_error("alter table t add unique index uv (v)")
+        assert "partitioning function" in str(e)
+        # covering the partition column is fine
+        tk.must_exec("alter table t add unique index uid (id)")
+
+    def test_drop_index_cleans_partition_ranges(self, tk):
+        tk.must_exec("""create table t (id int, v int)
+            partition by hash (id) partitions 2""")
+        tk.must_exec("insert into t values (1,10),(2,20)")
+        tk.must_exec("alter table t add index iv (v)")
+        tk.must_exec("alter table t drop index iv")
+        # re-creating and using the index works (no stale entries)
+        tk.must_exec("alter table t add index iv (v)")
+        tk.must_query("select id from t where v = 20").check([("2",)])
+
+    def test_stats_delta_rolls_up_to_logical_table(self, tk):
+        tk.must_exec("""create table t (id int) partition by hash (id)
+            partitions 2""")
+        tk.must_exec("insert into t values (1),(2),(3)")
+        infos = tk.session.infoschema()
+        logical = infos.table_by_name("test", "t")
+        counts = tk.session.domain.stats_worker.modify_counts
+        assert counts.get(logical.id, 0) >= 3
+        for d in logical.partition.defs:
+            assert d.id not in counts
+
+
+class TestPartitionTxn:
+    def test_uncommitted_writes_visible_and_rollback(self, tk):
+        tk.must_exec("""create table h (id int primary key)
+            partition by hash (id) partitions 2""")
+        tk.must_exec("insert into h values (1),(2)")
+        tk.must_exec("begin")
+        tk.must_exec("insert into h values (3)")
+        tk.must_query("select count(*) from h").check([("3",)])
+        tk.must_exec("rollback")
+        tk.must_query("select count(*) from h").check([("2",)])
+
+    def test_isolation_across_sessions(self, tk):
+        tk.must_exec("""create table h (id int primary key)
+            partition by hash (id) partitions 2""")
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("begin")
+        tk.must_exec("insert into h values (1)")
+        tk2.must_query("select count(*) from h").check([("0",)])
+        tk.must_exec("commit")
+        tk2.must_query("select count(*) from h").check([("1",)])
+
+
+class TestPartitionAggDevicePath:
+    def test_group_by_over_partitions(self, tk):
+        tk.must_exec("""create table s (id int, grp int, amount int)
+            partition by range (amount) (
+              partition p0 values less than (100),
+              partition p1 values less than (200),
+              partition pmax values less than maxvalue)""")
+        rows = []
+        for i in range(300):
+            rows.append(f"({i}, {i % 3}, {i})")
+        tk.must_exec("insert into s values " + ",".join(rows))
+        tk.must_query(
+            "select grp, count(*), sum(amount) from s group by grp "
+            "order by grp").check(
+            [("0", "100", str(sum(range(0, 300, 3)))),
+             ("1", "100", str(sum(range(1, 300, 3)))),
+             ("2", "100", str(sum(range(2, 300, 3))))])

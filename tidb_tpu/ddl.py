@@ -86,7 +86,7 @@ class DDLExecutor:
         def fn(m, job):
             for t in m.list_tables(db.id):
                 m.drop_table(db.id, t.id)
-                self._delete_table_data(t.id)
+                self._delete_table_data(t)
             m.drop_database(db.id)
         self._run_job(fn, "drop_schema", schema_id=db.id)
 
@@ -138,7 +138,7 @@ class DDLExecutor:
 
             def fn(m, job, _db=db, _tbl=tbl):
                 m.drop_table(_db.id, _tbl.id)
-                self._delete_table_data(_tbl.id)
+                self._delete_table_data(_tbl)
             self._run_job(fn, "drop_table", schema_id=db.id, table_id=tbl.id)
 
     def truncate_table(self, stmt: ast.TruncateTableStmt):
@@ -153,8 +153,11 @@ class DDLExecutor:
             new_tbl = TableInfo.from_json(tbl.to_json())
             new_tbl.id = m.gen_global_id()
             new_tbl.auto_increment = 1
+            if new_tbl.partition is not None:
+                for d in new_tbl.partition.defs:
+                    d.id = m.gen_global_id()
             m.drop_table(db.id, tbl.id)
-            self._delete_table_data(tbl.id)
+            self._delete_table_data(tbl)
             m.create_table(db.id, new_tbl)
             m.set_autoid(new_tbl.id, 1)
             job.table_id = new_tbl.id
@@ -179,6 +182,16 @@ class DDLExecutor:
             if tbl.find_column(cname) is None:
                 raise TiDBError(f"Key column '{cname}' doesn't exist in table",
                                 code=ErrCode.KeyDoesNotExist)
+        if stmt.unique and tbl.partition is not None:
+            # per-partition dup checks make a unique key that misses the
+            # partition column unenforceable (reference: ddl/partition.go
+            # checkPartitionKeysConstraint; MySQL error 1503)
+            pcol = tbl.partition.col_name.lower()
+            if pcol not in {c.lower() for c, _l in stmt.columns}:
+                raise TiDBError(
+                    "A UNIQUE INDEX must include all columns in the table's "
+                    "partitioning function",
+                    code=ErrCode.UniqueKeyNeedAllFieldsInPf)
         job = self.enqueue_job(
             "add_index", schema_id=db.id, table_id=tbl.id,
             args={"index_name": stmt.index_name,
@@ -219,12 +232,14 @@ class DDLExecutor:
                             code=ErrCode.CantDropFieldOrKey)
 
         def fn(m, job):
+            from .partition import index_phys_ids
             t = m.get_table(db.id, tbl.id)
             idx = t.find_index(stmt.index_name)
             t.indexes = [i for i in t.indexes if i.id != idx.id]
             m.update_table(db.id, t)
-            start, end = tablecodec.index_range(t.id, idx.id)
-            sess.store.mvcc.raw_delete_range(start, end)
+            for pid in index_phys_ids(t):
+                start, end = tablecodec.index_range(pid, idx.id)
+                sess.store.mvcc.raw_delete_range(start, end)
         self._run_job(fn, "drop_index", schema_id=db.id, table_id=tbl.id)
 
     def alter_table(self, stmt: ast.AlterTableStmt):
@@ -259,6 +274,12 @@ class DDLExecutor:
                     m.set_autoid(tbl.id, _v)
                 self._run_job(fn, "auto_increment", schema_id=db.id,
                               table_id=tbl.id)
+            elif kind == "add_partition":
+                self._alter_add_partition(db, tbl, spec[1])
+            elif kind == "drop_partition":
+                self._alter_drop_partition(db, tbl, spec[1])
+            elif kind == "truncate_partition":
+                self._alter_truncate_partition(db, tbl, spec[1])
             else:
                 raise TiDBError(f"unsupported ALTER TABLE action {kind}",
                                 code=ErrCode.UnsupportedDDL)
@@ -334,16 +355,96 @@ class DDLExecutor:
         self._run_job(fn, "drop_column", schema_id=db.id, table_id=tbl.id)
         self.session.store.mvcc.bump_table_version(tbl.id)
 
+    # -- partition management (reference: ddl/partition.go) ------------------
+
+    def _alter_add_partition(self, db, tbl, defs):
+        from .partition import append_partition_def
+        if tbl.partition is None:
+            raise TiDBError("Partition management on a not partitioned table "
+                            "is not possible",
+                            code=ErrCode.PartitionMgmtOnNonpartitioned)
+        if tbl.partition.type == "hash":
+            raise TiDBError("ADD PARTITION requires a RANGE or LIST table",
+                            code=ErrCode.OnlyOnRangeListPartition)
+        col = tbl.find_column(tbl.partition.col_name)
+
+        def fn(m, job):
+            t = m.get_table(db.id, tbl.id)
+            for name, kind, values in defs:
+                append_partition_def(t.partition, col, name, kind, values,
+                                     m.gen_global_id)
+            m.update_table(db.id, t)
+        self._run_job(fn, "add_partition", schema_id=db.id, table_id=tbl.id)
+
+    def _alter_drop_partition(self, db, tbl, names):
+        if tbl.partition is None:
+            raise TiDBError("Partition management on a not partitioned table "
+                            "is not possible",
+                            code=ErrCode.PartitionMgmtOnNonpartitioned)
+        if tbl.partition.type == "hash":
+            raise TiDBError("DROP PARTITION requires a RANGE or LIST table",
+                            code=ErrCode.OnlyOnRangeListPartition)
+        dropped = []
+
+        def fn(m, job):
+            t = m.get_table(db.id, tbl.id)
+            tp = t.partition
+            for name in names:
+                d = tp.find_def(name)
+                if d is None:
+                    raise TiDBError(f"Error in list of partitions to DROP",
+                                    code=ErrCode.DropPartitionNonExistent)
+                if len(tp.defs) == 1:
+                    raise TiDBError(
+                        "Cannot remove all partitions, use DROP TABLE instead",
+                        code=ErrCode.DropLastPartition)
+                tp.defs.remove(d)
+                dropped.append(d)
+            m.update_table(db.id, t)
+        self._run_job(fn, "drop_partition", schema_id=db.id, table_id=tbl.id)
+        for d in dropped:
+            self._delete_table_data(d.id)
+
+    def _alter_truncate_partition(self, db, tbl, names):
+        if tbl.partition is None:
+            raise TiDBError("Partition management on a not partitioned table "
+                            "is not possible",
+                            code=ErrCode.PartitionMgmtOnNonpartitioned)
+        old_ids = []
+
+        def fn(m, job):
+            t = m.get_table(db.id, tbl.id)
+            tp = t.partition
+            for name in names:
+                d = tp.find_def(name)
+                if d is None:
+                    raise TiDBError(f"Unknown partition '{name}' in table "
+                                    f"'{t.name}'", code=ErrCode.UnknownPartition)
+                old_ids.append(d.id)
+                d.id = m.gen_global_id()
+            m.update_table(db.id, t)
+        self._run_job(fn, "truncate_partition", schema_id=db.id,
+                      table_id=tbl.id)
+        for oid in old_ids:
+            self._delete_table_data(oid)
+
     # -- internals ----------------------------------------------------------
 
-    def _delete_table_data(self, table_id):
-        """reference: ddl/delete_range.go — here immediate range delete."""
-        start, end = tablecodec.table_range(table_id)
-        self.session.store.mvcc.raw_delete_range(start, end)
-        pfx = tablecodec.TABLE_PREFIX + tablecodec._enc_i64(table_id)
-        self.session.store.mvcc.raw_delete_range(pfx + tablecodec.INDEX_SEP,
-                                                 pfx + tablecodec.INDEX_SEP + b"\xff" * 17)
-        self.session.domain.columnar_cache.invalidate(table_id)
+    def _delete_table_data(self, table_or_id):
+        """reference: ddl/delete_range.go — here immediate range delete.
+        Accepts a TableInfo (partitions cleaned too) or a bare physical id."""
+        ids = [table_or_id]
+        if isinstance(table_or_id, TableInfo):
+            ids = [table_or_id.id]
+            if table_or_id.partition is not None:
+                ids += [d.id for d in table_or_id.partition.defs]
+        for table_id in ids:
+            start, end = tablecodec.table_range(table_id)
+            self.session.store.mvcc.raw_delete_range(start, end)
+            pfx = tablecodec.TABLE_PREFIX + tablecodec._enc_i64(table_id)
+            self.session.store.mvcc.raw_delete_range(pfx + tablecodec.INDEX_SEP,
+                                                     pfx + tablecodec.INDEX_SEP + b"\xff" * 17)
+            self.session.domain.columnar_cache.invalidate(table_id)
 
 
 
@@ -416,6 +517,11 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
             tbl.auto_increment = int(stmt.options["auto_increment"])
         except (TypeError, ValueError):
             pass
+    if stmt.partition is not None:
+        from .partition import build_partition_info, check_partition_keys
+        tbl.partition = build_partition_info(stmt.partition, tbl,
+                                             m.gen_global_id)
+        check_partition_keys(tbl)
     return tbl
 
 
@@ -466,4 +572,7 @@ def _clone_table_info(src: TableInfo, new_name: str, m: Meta) -> TableInfo:
     t.id = m.gen_global_id()
     t.name = new_name
     t.auto_increment = 1
+    if t.partition is not None:
+        for d in t.partition.defs:
+            d.id = m.gen_global_id()
     return t

@@ -176,9 +176,12 @@ class DDLWorker:
         idx_id = None
         try:
             m = Meta(txn)
+            phys_ids = [job.table_id]
             if job.type == "add_index":
                 t = m.get_table(job.schema_id, job.table_id)
                 if t is not None:
+                    from .partition import index_phys_ids
+                    phys_ids = index_phys_ids(t)
                     name = job.args.get("index_name", "")
                     idx = t.find_index(name)
                     if idx is not None and idx.state != SchemaState.PUBLIC:
@@ -193,8 +196,9 @@ class DDLWorker:
         except Exception:
             txn.rollback()
         if idx_id is not None:
-            start, end = tablecodec.index_range(job.table_id, idx_id)
-            self.domain.store.mvcc.raw_delete_range(start, end)
+            for pid in phys_ids:
+                start, end = tablecodec.index_range(pid, idx_id)
+                self.domain.store.mvcc.raw_delete_range(start, end)
         self.domain.reload_schema()
 
     # -- ADD INDEX state machine (reference: ddl/index.go:519-541) ----------
@@ -270,9 +274,19 @@ class DDLWorker:
         """One checkpointed batch (reference: backfilling.go:290): scan
         records after the checkpoint handle, write their index KVs, and
         advance the checkpoint — all in ONE txn, so a crash between batches
-        loses nothing and repeats nothing."""
+        loses nothing and repeats nothing.
+
+        Partitioned tables backfill partition-by-partition: the checkpoint is
+        (args["reorg_part"], reorg_handle) and index entries are written
+        under each partition's physical id."""
         from .utils import failpoint
         store = self.domain.store
+        # physical scan targets: the table itself, or each partition
+        if t.partition is not None:
+            from .partition import partition_view
+            phys = [partition_view(t, d) for d in t.partition.defs]
+        else:
+            phys = [t]
         for _attempt in range(20):
             failpoint.inject("ddl-backfill-batch")
             txn = store.begin()
@@ -284,14 +298,26 @@ class DDLWorker:
                     txn.rollback()
                     return True
                 job = cur
-                start = (tablecodec.record_prefix(t.id)
+                part = int(job.args.get("reorg_part", 0))
+                if part >= len(phys):
+                    return self._finish_reorg(m, txn, job, t, idx)
+                pt = phys[part]
+                start = (tablecodec.record_prefix(pt.id)
                          if job.reorg_handle == MIN_HANDLE else
-                         tablecodec.record_key(t.id, job.reorg_handle) + b"\x00")
-                end = tablecodec.record_prefix(t.id) + b"\xff" * 9
+                         tablecodec.record_key(pt.id, job.reorg_handle) + b"\x00")
+                end = tablecodec.record_prefix(pt.id) + b"\xff" * 9
                 items = txn.snapshot.scan(start, end, limit=self.batch_size)
                 if not items:
+                    if part + 1 < len(phys):
+                        # this partition is drained: checkpoint to the next
+                        job.args["reorg_part"] = part + 1
+                        job.reorg_handle = MIN_HANDLE
+                        m.update_job(job)
+                        txn.commit()
+                        self._fire("reorg_batch", job)
+                        return False
                     return self._finish_reorg(m, txn, job, t, idx)
-                tbl = Table(t, txn)
+                tbl = Table(pt, txn)
                 last = job.reorg_handle
                 for key, value in items:
                     _tid, handle = tablecodec.decode_record_key(key)
@@ -371,8 +397,10 @@ class DDLWorker:
         except Exception:
             txn.rollback()
             raise
-        start, end = tablecodec.index_range(t.id, idx.id)
-        store.mvcc.raw_delete_range(start, end)
+        from .partition import index_phys_ids
+        for pid in index_phys_ids(t):
+            start, end = tablecodec.index_range(pid, idx.id)
+            store.mvcc.raw_delete_range(start, end)
         self.domain.reload_schema()
         self._fire("rollback_done", job)
 

@@ -65,6 +65,19 @@ class ErrCode:
     QueryInterrupted = 1317
     MemExceedThreshold = 8001
     OOMKill = 8175
+    # partitioned tables (MySQL partition error numbers)
+    PartitionsMustBeDefined = 1492
+    RangeNotIncreasing = 1493
+    SameNamePartition = 1517
+    DropLastPartition = 1508
+    DropPartitionNonExistent = 1507
+    NoPartitionForGivenValue = 1526
+    PartitionMgmtOnNonpartitioned = 1505
+    UniqueKeyNeedAllFieldsInPf = 1503
+    PartitionRequiresValues = 1479
+    PartitionFunctionIsNotAllowed = 1564
+    UnknownPartition = 1735
+    OnlyOnRangeListPartition = 1512
 
 
 class TiDBError(Exception):

@@ -104,7 +104,7 @@ class TableScanExec(QueryExecutor):
         as post-filters, so path choice never changes semantics."""
         from ..table import Table, rows_to_chunk
         p = self.plan
-        tbl = Table(p.table_info, txn)
+        tbl = Table(p.table_info, txn, parts=p.partitions)
         kind = p.access[0]
         if kind == "point_pk":
             handles = [p.access[1]]
@@ -124,12 +124,43 @@ class TableScanExec(QueryExecutor):
                 rowdicts.append(row)
         return rows_to_chunk(p.table_info, p.col_infos, kept, rowdicts)
 
+    def _scan_partitioned(self, txn):
+        """Concat per-partition chunks, each through the columnar cache keyed
+        by the partition's physical id (reference: PartitionedTable readers +
+        rule_partition_processor pruned access)."""
+        from ..partition import partition_view
+        from ..table import Table
+        p = self.plan
+        defs = (p.partitions if p.partitions is not None
+                else p.table_info.partition.defs)
+        chunks = []
+        for d in defs:
+            view = partition_view(p.table_info, d)
+            if self.ctx.txn_dirty(view.id):
+                chunks.append(Table(view, txn).scan_columnar(
+                    col_infos=p.col_infos))
+                continue
+            entry = self.ctx.columnar_cache().get(view, txn)
+            if entry is None:
+                chunks.append(Table(view, txn).scan_columnar(
+                    col_infos=p.col_infos))
+            else:
+                chunks.append(self.ctx.columnar_cache().project(
+                    entry, p.col_infos, view))
+        if not chunks:
+            fts = [c.ftype for c in p.col_infos]
+            return Chunk([Column(ft, np.empty(0, dtype=np_dtype_for(ft)),
+                                 np.zeros(0, dtype=bool)) for ft in fts])
+        return concat_chunks(chunks)
+
     def execute_raw(self):
         """-> (unfiltered chunk, pushed conds) for fused device pipelines."""
         p = self.plan
         txn = self.ctx.txn_for_read()
         if p.access is not None:
             return self._access_chunk(txn), p.pushed_conds
+        if p.table_info.partition is not None:
+            return self._scan_partitioned(txn), p.pushed_conds
         if self.ctx.txn_dirty(p.table_info.id):
             from ..table import Table
             tbl = Table(p.table_info, txn)
@@ -150,6 +181,8 @@ class TableScanExec(QueryExecutor):
         txn = self.ctx.txn_for_read()
         if p.access is not None:
             chunk = self._access_chunk(txn)
+        elif p.table_info.partition is not None:
+            chunk = self._scan_partitioned(txn)
         elif self.ctx.txn_dirty(p.table_info.id):
             # union-scan path (reference: executor/union_scan.go): txn has
             # uncommitted writes on this table — materialize through the txn
@@ -177,7 +210,8 @@ class TableScanExec(QueryExecutor):
         reference likewise leaves TiKV block cache outside the query quota)."""
         p = self.plan
         txn = self.ctx.txn_for_read()
-        if p.access is not None or self.ctx.txn_dirty(p.table_info.id):
+        if (p.access is not None or p.table_info.partition is not None
+                or self.ctx.txn_dirty(p.table_info.id)):
             yield self.execute()
             return
         entry = self.ctx.columnar_cache().get(p.table_info, txn)

@@ -17,6 +17,7 @@ class InfoSchema:
         self.dbs: dict[str, DBInfo] = {}
         self.tables: dict[str, dict[str, TableInfo]] = {}  # db -> name -> info
         self.by_id: dict[int, tuple[DBInfo, TableInfo]] = {}
+        self.part_by_id: dict[int, tuple] = {}  # pid -> (db, table, PartitionDef)
 
     def schema_by_name(self, name: str):
         return self.dbs.get(name.lower())
@@ -38,6 +39,11 @@ class InfoSchema:
     def table_by_id(self, tid: int):
         return self.by_id.get(tid)
 
+    def partition_by_id(self, pid: int):
+        """Partition physical id -> (DBInfo, logical TableInfo, PartitionDef),
+        or None (reference: infoschema TableByPartitionID)."""
+        return self.part_by_id.get(pid)
+
     def tables_in_schema(self, db: str):
         return sorted(self.tables.get(db.lower(), {}).values(), key=lambda t: t.name)
 
@@ -53,5 +59,8 @@ def build_infoschema(meta: Meta) -> InfoSchema:
         for tbl in meta.list_tables(db.id):
             tmap[tbl.name.lower()] = tbl
             infos.by_id[tbl.id] = (db, tbl)
+            if tbl.partition is not None:
+                for d in tbl.partition.defs:
+                    infos.part_by_id[d.id] = (db, tbl, d)
         infos.tables[db.name.lower()] = tmap
     return infos

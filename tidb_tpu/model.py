@@ -91,6 +91,62 @@ class IndexInfo:
 
 
 @dataclass
+class PartitionDef:
+    """One physical partition (reference: parser/model/model.go
+    PartitionDefinition). `id` is the partition's physical table id — row and
+    index keys for rows routed here use this id, not the logical table's."""
+    id: int = 0
+    name: str = ""
+    less_than: object = None     # RANGE: upper bound value or "MAXVALUE"
+    in_values: list = None       # LIST: accepted values (None encodes NULL)
+
+    def to_json(self):
+        return {"id": self.id, "name": self.name,
+                "less_than": _enc(self.less_than),
+                "in_values": (None if self.in_values is None
+                              else [_enc(v) for v in self.in_values])}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(id=d["id"], name=d["name"],
+                   less_than=_dec(d["less_than"]),
+                   in_values=(None if d["in_values"] is None
+                              else [_dec(v) for v in d["in_values"]]))
+
+
+@dataclass
+class PartitionInfo:
+    """reference: parser/model/model.go PartitionInfo (Type/Expr/Definitions).
+    The expr is restricted to a bare column or YEAR/MONTH/TO_DAYS(col) —
+    enough for the MySQL-typical layouts while keeping row routing a pure
+    function of one column's internal value."""
+    type: str = "range"          # range | hash | list
+    expr: str = ""               # restored SQL text of the partition expr
+    col_name: str = ""           # the column the expr reads
+    func: str = ""               # "" (bare column) | year | month | to_days
+    num: int = 0                 # hash partition count
+    defs: list = field(default_factory=list)   # [PartitionDef]
+
+    def find_def(self, name: str):
+        lname = name.lower()
+        for d in self.defs:
+            if d.name.lower() == lname:
+                return d
+        return None
+
+    def to_json(self):
+        return {"type": self.type, "expr": self.expr,
+                "col_name": self.col_name, "func": self.func, "num": self.num,
+                "defs": [d.to_json() for d in self.defs]}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(type=d["type"], expr=d["expr"], col_name=d["col_name"],
+                   func=d["func"], num=d["num"],
+                   defs=[PartitionDef.from_json(x) for x in d["defs"]])
+
+
+@dataclass
 class TableInfo:
     id: int = 0
     name: str = ""
@@ -104,6 +160,7 @@ class TableInfo:
     max_idx_id: int = 0
     comment: str = ""
     update_ts: int = 0
+    partition: PartitionInfo = None
 
     def public_columns(self):
         return [c for c in self.columns if c.state == SchemaState.PUBLIC]
@@ -134,6 +191,8 @@ class TableInfo:
             "comment": self.comment, "update_ts": self.update_ts,
             "columns": [c.to_json() for c in self.columns],
             "indexes": [i.to_json() for i in self.indexes],
+            "partition": (self.partition.to_json()
+                          if self.partition is not None else None),
         }
 
     @classmethod
@@ -146,6 +205,8 @@ class TableInfo:
             update_ts=d.get("update_ts", 0),
             columns=[ColumnInfo.from_json(c) for c in d["columns"]],
             indexes=[IndexInfo.from_json(i) for i in d["indexes"]],
+            partition=(PartitionInfo.from_json(d["partition"])
+                       if d.get("partition") else None),
         )
 
 

@@ -576,6 +576,33 @@ class Constraint(Node):
 
 
 @dataclass(repr=False)
+class PartitionOpt(Node):
+    """PARTITION BY clause (reference: parser/ast/ddl.go PartitionOptions).
+    defs: [(name, kind, values)] where kind is "less_than" (values a 1-list
+    of ExprNode or the string MAXVALUE) or "in" (values a list of ExprNode)."""
+    type: str = "range"            # range | hash | list
+    expr: "ExprNode" = None
+    num: int = 0                   # HASH ... PARTITIONS n
+    defs: list = field(default_factory=list)
+
+    def restore(self):
+        s = f"PARTITION BY {self.type.upper()} ({self.expr.restore()})"
+        if self.type == "hash":
+            return s + f" PARTITIONS {self.num}"
+        parts = []
+        for name, kind, values in self.defs:
+            if kind == "less_than":
+                v = values[0]
+                vs = v if isinstance(v, str) else f"({v.restore()})"
+                parts.append(f"PARTITION `{name}` VALUES LESS THAN {vs}")
+            else:
+                vs = ", ".join("NULL" if v is None else v.restore()
+                               for v in values)
+                parts.append(f"PARTITION `{name}` VALUES IN ({vs})")
+        return s + " (" + ", ".join(parts) + ")"
+
+
+@dataclass(repr=False)
 class CreateTableStmt(StmtNode):
     table: TableName = None
     columns: list = field(default_factory=list)      # [ColumnDef]
@@ -584,6 +611,7 @@ class CreateTableStmt(StmtNode):
     options: dict = field(default_factory=dict)      # engine, charset, auto_increment, comment
     like: Optional[TableName] = None
     select: Optional[SelectStmt] = None
+    partition: Optional[PartitionOpt] = None
 
     def restore(self):
         s = "CREATE TABLE "
@@ -594,6 +622,8 @@ class CreateTableStmt(StmtNode):
             return s + f" LIKE {self.like.restore()}"
         items = [c.restore() for c in self.columns] + [c.restore() for c in self.constraints]
         s += " (" + ", ".join(items) + ")"
+        if self.partition is not None:
+            s += " " + self.partition.restore()
         return s
 
 

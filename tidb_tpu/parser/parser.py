@@ -521,6 +521,16 @@ class Parser:
         if self._accept_op("."):
             schema, name = name, self._ident()
         tn = ast.TableName(name=name, schema=schema)
+        # explicit partition selection: t PARTITION (p0, p1)
+        if (self._peek_kw("partition")
+                and self.toks[self.pos + 1].kind == OP
+                and self.toks[self.pos + 1].val == "("):
+            self.pos += 1
+            self._expect_op("(")
+            tn.partition_names.append(self._ident())
+            while self._accept_op(","):
+                tn.partition_names.append(self._ident())
+            self._expect_op(")")
         if allow_alias:
             if self._accept_kw("as"):
                 tn.as_name = self._ident()
@@ -1338,9 +1348,83 @@ class Parser:
                 stmt.options["charset"] = self._ident()
             else:
                 break
+        if self._peek_kw("partition"):
+            stmt.partition = self._parse_partition_opt()
         if self._accept_kw("as") or self._peek_kw("select"):
             stmt.select = self._parse_select_or_union()
         return stmt
+
+    def _parse_partition_opt(self) -> ast.PartitionOpt:
+        """PARTITION BY RANGE|HASH|LIST [COLUMNS] (expr) ... (reference:
+        parser/parser.y PartitionOpt)."""
+        self._expect_kw("partition")
+        self._expect_kw("by")
+        popt = ast.PartitionOpt()
+        if self._accept_kw("range"):
+            popt.type = "range"
+        elif self._accept_kw("hash"):
+            popt.type = "hash"
+        elif self._accept_kw("list"):
+            popt.type = "list"
+        else:
+            raise ParseError("expected RANGE, HASH or LIST after PARTITION BY")
+        self._accept_kw("columns")  # COLUMNS(col) ≡ bare-column expr here
+        self._expect_op("(")
+        popt.expr = self._parse_expr()
+        self._expect_op(")")
+        if popt.type == "hash":
+            if self._accept_kw("partitions"):
+                popt.num = self._int_lit()
+            else:
+                popt.num = 1
+            return popt
+        self._expect_op("(")
+        while True:
+            popt.defs.append(self._parse_partition_def(popt.type))
+            if not self._accept_op(","):
+                break
+        self._expect_op(")")
+        return popt
+
+    def _parse_partition_def_any(self):
+        """Partition def in ALTER (type unknown until execution): peek at
+        VALUES LESS THAN vs VALUES IN."""
+        save = self.pos
+        self._expect_kw("partition")
+        self._ident()
+        self._expect_kw("values")
+        is_range = self._peek_kw("less")
+        self.pos = save
+        return self._parse_partition_def("range" if is_range else "list")
+
+    def _parse_partition_def(self, ptype):
+        self._expect_kw("partition")
+        name = self._ident()
+        self._expect_kw("values")
+        if ptype == "range":
+            self._expect_kw("less")
+            self._expect_kw("than")
+            if self._accept_kw("maxvalue"):
+                return (name, "less_than", ["MAXVALUE"])
+            self._expect_op("(")
+            if self._accept_kw("maxvalue"):
+                self._expect_op(")")
+                return (name, "less_than", ["MAXVALUE"])
+            v = self._parse_expr()
+            self._expect_op(")")
+            return (name, "less_than", [v])
+        self._expect_kw("in")
+        self._expect_op("(")
+        values = []
+        while True:
+            if self._accept_kw("null"):
+                values.append(None)
+            else:
+                values.append(self._parse_expr())
+            if not self._accept_op(","):
+                break
+        self._expect_op(")")
+        return (name, "in", values)
 
     def _parse_index_col(self):
         name = self._ident()
@@ -1663,7 +1747,17 @@ class Parser:
         stmt = ast.AlterTableStmt(table=self._parse_table_name())
         while True:
             if self._accept_kw("add"):
-                if self._accept_kw("column"):
+                if self._accept_kw("partition"):
+                    self._expect_op("(")
+                    defs = []
+                    while True:
+                        # partition type resolved at execution from the table
+                        defs.append(self._parse_partition_def_any())
+                        if not self._accept_op(","):
+                            break
+                    self._expect_op(")")
+                    stmt.specs.append(("add_partition", defs))
+                elif self._accept_kw("column"):
                     if self._accept_op("("):
                         while True:
                             cd = self._parse_table_item()
@@ -1688,7 +1782,12 @@ class Parser:
                     pos = self._parse_col_position()
                     stmt.specs.append(("add_column", cd, pos))
             elif self._accept_kw("drop"):
-                if self._accept_kw("column"):
+                if self._accept_kw("partition"):
+                    names = [self._ident()]
+                    while self._accept_op(","):
+                        names.append(self._ident())
+                    stmt.specs.append(("drop_partition", names))
+                elif self._accept_kw("column"):
                     stmt.specs.append(("drop_column", self._ident()))
                 elif self._accept_kw("index") or self._accept_kw("key"):
                     stmt.specs.append(("drop_index", self._ident()))
@@ -1720,6 +1819,12 @@ class Parser:
                     self._accept_kw("to")
                     self._accept_kw("as")
                     stmt.specs.append(("rename", self._parse_table_name()))
+            elif self._accept_kw("truncate"):
+                self._expect_kw("partition")
+                names = [self._ident()]
+                while self._accept_op(","):
+                    names.append(self._ident())
+                stmt.specs.append(("truncate_partition", names))
             elif self._accept_kw("auto_increment"):
                 self._accept_op("=")
                 stmt.specs.append(("auto_increment", self._int_lit()))

@@ -513,7 +513,22 @@ class PlanBuilder:
         info = self.ctx.infoschema().table_by_name(db, tn.name)
         cols = info.public_columns()
         refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
-        return DataSource(db, info, cols, Schema(refs), alias=alias)
+        ds = DataSource(db, info, cols, Schema(refs), alias=alias)
+        if tn.partition_names:
+            if info.partition is None:
+                raise TiDBError(
+                    f"PARTITION () clause on non partitioned table",
+                    code=ErrCode.PartitionMgmtOnNonpartitioned)
+            sel = []
+            for pn in tn.partition_names:
+                d = info.partition.find_def(pn)
+                if d is None:
+                    raise TiDBError(
+                        f"Unknown partition '{pn}' in table '{info.name}'",
+                        code=ErrCode.UnknownPartition)
+                sel.append(d)
+            ds.partitions = sel
+        return ds
 
     def _build_join(self, jn: ast.Join):
         left = self.build_from(jn.left)

@@ -34,6 +34,7 @@ class DataSource(LogicalPlan):
         self.pushed_conds = []          # filters evaluated at scan
         self.access = None              # planner/access.py descriptor
         self.access_est = None          # estimated rows via the access path
+        self.partitions = None          # [PartitionDef] to scan (None: not partitioned)
 
     def explain_name(self):
         if self.access is not None:
@@ -45,6 +46,13 @@ class DataSource(LogicalPlan):
 
     def explain_info(self):
         s = f"table:{self.alias or self.table_info.name}"
+        if self.table_info.partition is not None:
+            all_defs = self.table_info.partition.defs
+            sel = self.partitions if self.partitions is not None else all_defs
+            if len(sel) == len(all_defs):
+                s += ", partition:all"
+            else:
+                s += ", partition:" + ",".join(d.name for d in sel)
         if self.access is not None:
             kind = self.access[0]
             if kind == "point_pk":

@@ -17,7 +17,22 @@ def optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
     plan = push_down_predicates(plan, [])
     plan = reorder_joins(plan, ctx)
     plan = prune_columns(plan)
+    plan = prune_partitions_rule(plan)
     plan = choose_access_paths(plan, ctx)
+    return plan
+
+
+def prune_partitions_rule(plan: LogicalPlan) -> LogicalPlan:
+    """Partition pruning on pushed-down scan predicates (reference:
+    planner/core/rule_partition_processor.go)."""
+    if isinstance(plan, DataSource) and plan.table_info.partition is not None:
+        from ..partition import prune_partitions
+        if plan.partitions is None:
+            plan.partitions = list(plan.table_info.partition.defs)
+        plan.partitions = prune_partitions(plan.table_info, plan.partitions,
+                                           plan.pushed_conds)
+    for c in plan.children:
+        prune_partitions_rule(c)
     return plan
 
 

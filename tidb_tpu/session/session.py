@@ -324,9 +324,20 @@ class Session:
             newv = txn.committed_versions.get(tid)
             found = infos.table_by_id(tid)
             info = found[1] if found is not None else None
+            stats_tid = tid
+            if info is None:
+                # partition physical id: cache deltas apply to the partition
+                # view; stats modify-counts roll up to the logical table
+                part = infos.partition_by_id(tid)
+                if part is not None:
+                    from ..partition import partition_view
+                    _db, logical, pdef = part
+                    info = partition_view(logical, pdef)
+                    stats_tid = logical.id
             if deltas is not None and tid in deltas:
                 # stats modify-count feed (reference: handle/update.go)
-                self.domain.stats_worker.record_delta(tid, len(deltas[tid]))
+                self.domain.stats_worker.record_delta(stats_tid,
+                                                      len(deltas[tid]))
             if deltas is None or info is None or newv is None:
                 cache.invalidate(tid)
                 continue

@@ -217,5 +217,44 @@ def render_create_table(info) -> str:
         else:
             lines.append(f"  KEY `{idx.name}` ({cols})")
     body = ",\n".join(lines)
-    return (f"CREATE TABLE `{info.name}` (\n{body}\n) "
-            "ENGINE=tpu-htap DEFAULT CHARSET=utf8mb4")
+    s = (f"CREATE TABLE `{info.name}` (\n{body}\n) "
+         "ENGINE=tpu-htap DEFAULT CHARSET=utf8mb4")
+    if info.partition is not None:
+        s += "\n" + render_partition_clause(info)
+    return s
+
+
+def render_partition_clause(info) -> str:
+    """reference: show.go ConstructResultOfShowCreateTable partition tail."""
+    from ..partition import MAXVALUE
+    p = info.partition
+    if p.type == "hash":
+        return f"PARTITION BY HASH ({p.expr}) PARTITIONS {p.num}"
+    col = info.find_column(p.col_name)
+
+    def _fmt(v):
+        if v == MAXVALUE:
+            return "MAXVALUE"
+        if v is None:
+            return "NULL"
+        if p.func:
+            return str(v)
+        from ..sqltypes import format_value, STRING_TYPES
+        txt = format_value(v, col.ftype)
+        if isinstance(txt, bytes):
+            txt = txt.decode("utf-8", "replace")
+        if col.ftype.tp in STRING_TYPES or not str(txt).lstrip("-").isdigit():
+            return f"'{txt}'"
+        return str(txt)
+
+    parts = []
+    for d in p.defs:
+        if p.type == "range":
+            b = ("MAXVALUE" if d.less_than == MAXVALUE
+                 else f"({_fmt(d.less_than)})")
+            parts.append(f" PARTITION `{d.name}` VALUES LESS THAN {b}")
+        else:
+            vs = ", ".join(_fmt(v) for v in d.in_values)
+            parts.append(f" PARTITION `{d.name}` VALUES IN ({vs})")
+    return (f"PARTITION BY {p.type.upper()} ({p.expr})\n(" +
+            ",\n".join(parts) + ")")

@@ -151,17 +151,44 @@ def convert_internal(v, src_ft: FieldType, dst_ft: FieldType):
 
 
 class Table:
-    """Bound (TableInfo, txn) row operations."""
+    """Bound (TableInfo, txn) row operations.
 
-    def __init__(self, info: TableInfo, txn):
+    Partitioned tables (info.partition set) dispatch here too: writes route
+    to one partition's physical id by the partition function; point reads
+    search partitions in definition order (reference:
+    table/tables/partition.go PartitionedTable)."""
+
+    def __init__(self, info: TableInfo, txn, parts=None):
         self.info = info
         self.txn = txn
+        self._part_fn = None
+        self.parts = parts  # pruned PartitionDefs for reads (None = all)
+
+    # -- partition dispatch --------------------------------------------------
+
+    def _route(self, row: dict) -> "Table":
+        """Partition-routed physical Table for this row dict."""
+        from .partition import locate_partition, make_part_fn, partition_view
+        if self._part_fn is None:
+            self._part_fn = make_part_fn(self.info)
+        pdef = locate_partition(self.info.partition, self._part_fn(row))
+        return Table(partition_view(self.info, pdef), self.txn)
+
+    def partition_tables(self, defs=None):
+        """Physical Tables for each partition (or the given/pruned defs)."""
+        from .partition import partition_view
+        if defs is None:
+            defs = self.parts if self.parts is not None \
+                else self.info.partition.defs
+        return [Table(partition_view(self.info, d), self.txn) for d in defs]
 
     # -- write path ---------------------------------------------------------
 
     def add_record(self, row: dict, handle: int, check_dup: bool = True):
         """row: {col_id: internal value}. Writes record + all index entries
         into the txn membuffer (reference: tables.go:643 AddRecord)."""
+        if self.info.partition is not None:
+            return self._route(row).add_record(row, handle, check_dup)
         info = self.info
         key = tablecodec.record_key(info.id, handle)
         if check_dup and info.pk_is_handle:
@@ -212,6 +239,8 @@ class Table:
         self.txn.delete(key)
 
     def remove_record(self, row: dict, handle: int):
+        if self.info.partition is not None:
+            return self._route(row).remove_record(row, handle)
         self.txn.delete(tablecodec.record_key(self.info.id, handle))
         for idx in self.info.indexes:
             if idx.state >= SchemaState.DELETE_ONLY:
@@ -219,6 +248,15 @@ class Table:
         self.txn.touched_tables.add(self.info.id)
 
     def update_record(self, old_row: dict, new_row: dict, handle: int):
+        if self.info.partition is not None:
+            old_t = self._route(old_row)
+            new_t = self._route(new_row)
+            if old_t.info.id != new_t.info.id:
+                # row moves between partitions: delete + insert
+                old_t.remove_record(old_row, handle)
+                new_t.add_record(new_row, handle)
+                return
+            return old_t.update_record(old_row, new_row, handle)
         info = self.info
         col_ids = [c.id for c in info.columns if c.state >= SchemaState.WRITE_ONLY and c.id in new_row]
         values = [new_row.get(cid) for cid in col_ids]
@@ -238,6 +276,12 @@ class Table:
     # -- read path ----------------------------------------------------------
 
     def get_row(self, handle: int):
+        if self.info.partition is not None:
+            for pt in self.partition_tables():
+                row = pt.get_row(handle)
+                if row is not None:
+                    return row
+            return None
         data = self.txn.get(tablecodec.record_key(self.info.id, handle))
         if data is None:
             return None
@@ -245,6 +289,10 @@ class Table:
 
     def iter_rows(self):
         """-> iterator of (handle, {col_id: value})."""
+        if self.info.partition is not None:
+            for pt in self.partition_tables():
+                yield from pt.iter_rows()
+            return
         start, end = tablecodec.table_range(self.info.id)
         for key, value in self.txn.scan(start, end):
             _tid, handle = tablecodec.decode_record_key(key)
@@ -252,12 +300,23 @@ class Table:
 
     def index_lookup(self, idx, values):
         """Unique-index point lookup -> handle or None."""
+        if self.info.partition is not None:
+            for pt in self.partition_tables():
+                h = pt.index_lookup(idx, values)
+                if h is not None:
+                    return h
+            return None
         key = tablecodec.index_key(self.info.id, idx.id, values)
         v = self.txn.get(key)
         return tablecodec.decode_index_handle(v) if v is not None else None
 
     def index_scan_handles(self, idx, lo_vals=None, hi_vals=None):
         """Range scan on an index -> [handle] in index order."""
+        if self.info.partition is not None:
+            out = []
+            for pt in self.partition_tables():
+                out.extend(pt.index_scan_handles(idx, lo_vals, hi_vals))
+            return out
         tid = self.info.id
         start = (tablecodec.index_key(tid, idx.id, lo_vals)
                  if lo_vals is not None else tablecodec.index_prefix(tid, idx.id))
@@ -272,10 +331,16 @@ class Table:
                        else tablecodec.decode_index_values(key)[-1])
         return out
 
-    def scan_columnar(self, col_infos=None, with_handle=False):
+    def scan_columnar(self, col_infos=None, with_handle=False, parts=None):
         """Materialize visible rows into a Chunk (columnar assembly from the
-        row codec). col_infos: subset of ColumnInfo to project."""
+        row codec). col_infos: subset of ColumnInfo to project.
+        parts: for a partitioned table, the PartitionDefs to scan."""
         info = self.info
+        if info.partition is not None:
+            from .utils.chunk import concat_chunks
+            chunks = [pt.scan_columnar(col_infos, with_handle)
+                      for pt in self.partition_tables(parts)]
+            return concat_chunks(chunks)
         cols = col_infos if col_infos is not None else info.public_columns()
         handles = []
         rowdicts = []
