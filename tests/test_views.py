@@ -1,0 +1,167 @@
+"""Views: CREATE/DROP VIEW, planner expansion, SHOW integration
+(reference: ddl/ddl_api.go CreateView, planbuilder.go
+BuildDataSourceFromView, executor/show.go)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1,10),(2,20),(3,30)")
+    return tk
+
+
+class TestViewBasics:
+    def test_select_through_view(self, tk):
+        tk.must_exec("create view v as select a, b*2 from t where a > 1")
+        tk.must_query("select * from v order by 1").check(
+            [("2", "40"), ("3", "60")])
+
+    def test_explicit_column_list(self, tk):
+        tk.must_exec("create view v (x, y) as select a, b from t")
+        tk.must_query("select x, y from v where x = 1").check([("1", "10")])
+        e = tk.exec_error("create view v2 (x) as select a, b from t")
+        assert "column counts" in str(e)
+
+    def test_or_replace(self, tk):
+        tk.must_exec("create view v as select a from t")
+        e = tk.exec_error("create view v as select b from t")
+        assert "already exists" in str(e)
+        tk.must_exec("create or replace view v as select b from t")
+        tk.must_query("select * from v order by 1").check(
+            [("10",), ("20",), ("30",)])
+        # OR REPLACE cannot clobber a base table
+        e = tk.exec_error("create or replace view t as select 1")
+        assert "already exists" in str(e)
+
+    def test_view_over_view_and_joins(self, tk):
+        tk.must_exec("create view v (x, y) as select a, b from t")
+        tk.must_exec("create view v2 as select x+y as s from v")
+        tk.must_query("select s from v2 order by s").check(
+            [("11",), ("22",), ("33",)])
+        tk.must_query(
+            "select t.a, v.y from t, v where t.a = v.x and t.a = 2").check(
+            [("2", "20")])
+
+    def test_aggregating_view(self, tk):
+        tk.must_exec("create view agg as select count(*) as n, sum(b) as s "
+                     "from t")
+        tk.must_query("select n, s from agg").check([("3", "60")])
+
+    def test_view_sees_base_table_changes(self, tk):
+        tk.must_exec("create view v as select a from t")
+        tk.must_exec("insert into t values (4, 40)")
+        tk.must_query("select count(*) from v").check([("4",)])
+
+
+class TestViewDDL:
+    def test_drop_view_vs_drop_table(self, tk):
+        tk.must_exec("create view v as select a from t")
+        e = tk.exec_error("drop table v")
+        assert "use DROP VIEW" in str(e)
+        e = tk.exec_error("drop view t")
+        assert "is not VIEW" in str(e)
+        tk.must_exec("drop view v")
+        e = tk.exec_error("select * from v")
+        assert "doesn't exist" in str(e)
+        tk.must_exec("drop view if exists v")
+
+    def test_show_create_view_and_full_tables(self, tk):
+        tk.must_exec("create view v (x) as select a from t")
+        rows = tk.must_query("show create table v").rows
+        txt = rows[0][1]
+        if isinstance(txt, bytes):
+            txt = txt.decode()
+        assert txt.startswith("CREATE VIEW `v`")
+        got = {tuple(r) for r in tk.must_query("show full tables").rows}
+        assert ("t", "BASE TABLE") in got and ("v", "VIEW") in got
+
+    def test_view_is_not_dml_target(self, tk):
+        tk.must_exec("create view v as select a, b from t")
+        assert "not insertable" in str(
+            tk.exec_error("insert into v values (9, 9)"))
+        assert "not updatable" in str(
+            tk.exec_error("update v set a = 9"))
+        assert "not updatable" in str(
+            tk.exec_error("delete from v"))
+
+
+class TestViewEdgeCases:
+    def test_recursion_detected(self, tk):
+        tk.must_exec("create view v as select a from t")
+        tk.must_exec("create or replace view v as select a from v")
+        e = tk.exec_error("select * from v")
+        assert "recursion" in str(e)
+
+    def test_invalid_after_base_drop(self, tk):
+        tk.must_exec("create view v as select a from t")
+        tk.must_exec("drop table t")
+        e = tk.exec_error("select * from v")
+        assert "invalid" in str(e)
+
+    def test_definer_prefix_parses(self, tk):
+        tk.must_exec("create definer = 'root'@'%' sql security definer "
+                     "view v as select a from t")
+        tk.must_query("select count(*) from v").check([("3",)])
+
+    def test_view_resolves_against_creation_db(self, tk):
+        """Unqualified names in the view body bind to the creation-time db,
+        not the reader's current db."""
+        tk.must_exec("create view v as select a from t")
+        tk.must_exec("create database other")
+        tk.must_exec("use other")
+        tk.must_exec("create table t (a int)")  # decoy with same name
+        tk.must_exec("insert into t values (999)")
+        tk.must_query("select * from test.v order by 1").check(
+            [("1",), ("2",), ("3",)])
+
+    def test_view_body_never_correlates_with_outer_query(self, tk):
+        tk.must_exec("create view v as select a from t")
+        tk.must_exec("create table t2 (a int)")
+        tk.must_exec("insert into t2 values (7)")
+        # the view's `a` must come from t, not correlate to t2.a
+        tk.must_query(
+            "select (select max(a) from v) from t2").check([("3",)])
+
+    def test_duplicate_view_columns_rejected(self, tk):
+        e = tk.exec_error("create view v as select a, a from t")
+        assert "Duplicate column" in str(e)
+        e = tk.exec_error("create view v (x, x) as select a, b from t")
+        assert "Duplicate column" in str(e)
+
+    def test_update_delete_error_codes(self, tk):
+        tk.must_exec("create view v as select a from t")
+        assert tk.exec_error("insert into v values (1)").code == 1471
+        assert tk.exec_error("update v set a = 9").code == 1288
+        assert tk.exec_error("delete from v").code == 1288
+
+
+class TestViewPrivileges:
+    def test_create_view_requires_select_on_underlying(self, tk):
+        tk.must_exec("create user 'limited'@'%'")
+        tk.must_exec("create database mine")
+        tk.must_exec("grant create on mine.* to 'limited'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "limited@%"
+        e = tk2.exec_error(
+            "create view mine.v as select a from test.t")
+        assert "denied" in str(e).lower() or "priv" in str(e).lower()
+        tk.must_exec("grant select on test.* to 'limited'@'%'")
+        tk2.must_exec("create view mine.v as select a from test.t")
+
+
+class TestViewDumpRestore:
+    def test_logical_dump_skips_view_data(self, tk, tmp_path):
+        from tidb_tpu import br
+        tk.must_exec("create view v as select a from t")
+        out = br.dump_database(tk.session, "test", str(tmp_path / "d"))
+        vmeta = next(x for x in out["tables"] if x["name"] == "v")
+        assert vmeta.get("is_view") and vmeta["rows"] == 0
+        tk.must_exec("create database restored")
+        br.import_dump(tk.session, str(tmp_path / "d"), "restored")
+        tk.must_query("select count(*) from restored.t").check([("3",)])

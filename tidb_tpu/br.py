@@ -50,14 +50,19 @@ def backup_database(session, db_name: str, dest: str) -> dict:
                 f.write(payload if isinstance(payload, str)
                         else json.dumps(payload))
             n = 0
+            phys_ids = [info.id]
+            if info.partition is not None:
+                # rows live under partition physical ids; restore re-routes
+                # by value so the dump is just (handle, row) pairs
+                phys_ids = [d.id for d in info.partition.defs]
             with open(base + ".data.jsonl", "w") as f:
-                start, end = tablecodec.table_range(info.id)
-                rec_end = tablecodec.record_prefix(info.id) + b"\xff" * 9
-                for key, value in txn.scan(
-                        tablecodec.record_prefix(info.id), rec_end):
-                    _tid, h = tablecodec.decode_record_key(key)
-                    f.write(json.dumps({"h": h, "v": value.hex()}) + "\n")
-                    n += 1
+                for pid in phys_ids:
+                    rec_end = tablecodec.record_prefix(pid) + b"\xff" * 9
+                    for key, value in txn.scan(
+                            tablecodec.record_prefix(pid), rec_end):
+                        _tid, h = tablecodec.decode_record_key(key)
+                        f.write(json.dumps({"h": h, "v": value.hex()}) + "\n")
+                        n += 1
             meta["tables"].append({"name": info.name, "rows": n})
     finally:
         txn.rollback()
@@ -105,6 +110,10 @@ def _create_from_info(session, db_name: str, info: TableInfo):
                       if d.name.lower() == db_name.lower())
             clone = TableInfo.from_json(info.to_json())
             clone.id = m.gen_global_id()
+            if clone.partition is not None:
+                # fresh physical ids: the source table may still exist
+                for d in clone.partition.defs:
+                    d.id = m.gen_global_id()
             m.create_table(db.id, clone)
             m.bump_schema_version()
             txn.commit()
@@ -155,12 +164,20 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
         raise TiDBError(f"Unknown database '{db_name}'")
     os.makedirs(dest, exist_ok=True)
     out = {"db": db_name, "tables": []}
-    for info in infos.tables_in_schema(db_name):
+    # base tables first so view DDL (which plans its select) can resolve
+    # them on import; views carry schema only, never INSERT data
+    all_infos = sorted(infos.tables_in_schema(db_name),
+                       key=lambda t: (t.is_view, t.name))
+    for info in all_infos:
         base = os.path.join(dest, f"{db_name}.{info.name}")
         create = session.execute(
             f"show create table `{db_name}`.`{info.name}`")[-1].rows[0][1]
         with open(base + "-schema.sql", "w") as f:
             f.write(create + ";\n")
+        if info.is_view:
+            out["tables"].append({"name": info.name, "rows": 0,
+                                  "is_view": True})
+            continue
         res = session.execute(
             f"select * from `{db_name}`.`{info.name}`")[-1]
         rows = res.rows  # display strings (None = NULL)
@@ -230,6 +247,10 @@ def import_dump(session, src: str, db_name: str | None = None,
         if skip == 0 and not session.infoschema().has_table(target_db, name):
             with open(schema_file) as f:
                 session.execute(f.read())
+        if t.get("is_view"):
+            ckpt["done_tables"].append(name)
+            _write_ckpt(ckpt_path, ckpt)
+            continue
         done = 0
         with open(data_file) as f:
             for stmt in _split_sql(f.read()):

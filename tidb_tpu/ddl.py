@@ -118,6 +118,61 @@ class DDLExecutor:
             sess.execute(f"INSERT INTO `{db_name}`.`{stmt.table.name}` "
                          + stmt.select.restore())
 
+    def create_view(self, stmt: ast.CreateViewStmt):
+        """CREATE [OR REPLACE] VIEW: plan the defining select once to derive
+        the view's column names/types; store the select text in the catalog
+        (reference: ddl/ddl_api.go CreateView + planbuilder BuildDataSource
+        view expansion)."""
+        sess = self.session
+        db_name = stmt.view.schema or sess.current_db()
+        infos = sess.infoschema()
+        db = infos.schema_by_name(db_name)
+        if db is None:
+            raise SchemaError(f"Unknown database '{db_name}'",
+                              code=ErrCode.BadDB)
+        existing = None
+        if infos.has_table(db_name, stmt.view.name):
+            existing = infos.table_by_name(db_name, stmt.view.name)
+            if not existing.is_view:
+                raise SchemaError(f"Table '{stmt.view.name}' already exists",
+                                  code=ErrCode.TableExists)
+            if not stmt.or_replace:
+                raise SchemaError(f"Table '{stmt.view.name}' already exists",
+                                  code=ErrCode.TableExists)
+        plan = sess.plan_query(stmt.select)
+        names = [r.name or f"col_{i}" for i, r in enumerate(plan.schema.refs)]
+        if stmt.cols:
+            if len(stmt.cols) != len(names):
+                raise TiDBError(
+                    "View's SELECT and view's field list have different "
+                    "column counts", code=ErrCode.WrongValueCountOnRow)
+            names = list(stmt.cols)
+        seen = set()
+        for nm in names:
+            if nm.lower() in seen:
+                raise TiDBError(f"Duplicate column name '{nm}'",
+                                code=ErrCode.DupFieldName)
+            seen.add(nm.lower())
+        fts = [r.ftype for r in plan.schema.refs]
+
+        def fn(m, job):
+            if existing is not None:
+                m.drop_table(db.id, existing.id)
+            tbl = TableInfo(id=m.gen_global_id(), name=stmt.view.name)
+            for off, (nm, ft) in enumerate(zip(names, fts)):
+                tbl.max_col_id += 1
+                tbl.columns.append(ColumnInfo(id=tbl.max_col_id, name=nm,
+                                              offset=off, ftype=ft))
+            # "db" pins name resolution for the stored text: unqualified
+            # tables resolve against the creation-time database, not the
+            # reader's current db (reference: ViewInfo + MySQL semantics)
+            tbl.view = {"select": stmt.select.restore(), "cols": names,
+                        "definer": stmt.definer or sess.user,
+                        "db": sess.current_db() or db_name}
+            job.table_id = tbl.id
+            m.create_table(db.id, tbl)
+        self._run_job(fn, "create_view", schema_id=db.id)
+
     def drop_table(self, stmt: ast.DropTableStmt):
         sess = self.session
         infos = sess.infoschema()
@@ -127,18 +182,27 @@ class DDLExecutor:
             if not infos.has_table(db_name, tn.name):
                 missing.append(f"{db_name}.{tn.name}")
         if missing and not stmt.if_exists:
-            raise SchemaError(f"Unknown table '{', '.join(missing)}'",
-                              code=ErrCode.BadTable)
+            raise SchemaError(
+                f"Unknown {'view' if stmt.is_view else 'table'} "
+                f"'{', '.join(missing)}'", code=ErrCode.BadTable)
         for tn in stmt.tables:
             db_name = tn.schema or sess.current_db()
             if not infos.has_table(db_name, tn.name):
                 continue
             db = infos.schema_by_name(db_name)
             tbl = infos.table_by_name(db_name, tn.name)
+            if stmt.is_view and not tbl.is_view:
+                raise TiDBError(f"'{db_name}.{tn.name}' is not VIEW",
+                                code=ErrCode.WrongObject)
+            if not stmt.is_view and tbl.is_view:
+                raise TiDBError(
+                    f"'{db_name}.{tn.name}' is a view; use DROP VIEW",
+                    code=ErrCode.WrongObject)
 
             def fn(m, job, _db=db, _tbl=tbl):
                 m.drop_table(_db.id, _tbl.id)
-                self._delete_table_data(_tbl)
+                if not _tbl.is_view:
+                    self._delete_table_data(_tbl)
             self._run_job(fn, "drop_table", schema_id=db.id, table_id=tbl.id)
 
     def truncate_table(self, stmt: ast.TruncateTableStmt):

@@ -75,6 +75,12 @@ class ErrCode:
     PartitionMgmtOnNonpartitioned = 1505
     UniqueKeyNeedAllFieldsInPf = 1503
     PartitionRequiresValues = 1479
+    WrongObject = 1347
+    ViewRecursive = 1462
+    ViewInvalid = 1356
+    NonInsertableTable = 1471
+    NonUpdatableTable = 1288
+    DupFieldName = 1060
     PartitionFunctionIsNotAllowed = 1564
     UnknownPartition = 1735
     OnlyOnRangeListPartition = 1512

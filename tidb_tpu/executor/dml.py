@@ -21,9 +21,18 @@ class DMLResult:
         self.last_insert_id = last_insert_id
 
 
-def _resolve_table(session, tn: ast.TableName):
+def _resolve_table(session, tn: ast.TableName, dml="INSERT"):
     db = tn.schema or session.current_db()
     info = session.infoschema().table_by_name(db, tn.name)
+    if info.is_view:
+        # views are read-only (reference: TiDB views are non-updatable)
+        if dml == "INSERT":
+            raise TiDBError(
+                f"The target table {tn.name} of the {dml} is not "
+                "insertable-into", code=ErrCode.NonInsertableTable)
+        raise TiDBError(
+            f"The target table {tn.name} of the {dml} is not updatable",
+            code=ErrCode.NonUpdatableTable)
     return db, info
 
 
@@ -252,7 +261,7 @@ class UpdateExec:
         stmt = self.stmt
         if not isinstance(stmt.table, ast.TableName):
             raise TiDBError("multi-table UPDATE not supported yet")
-        db, info = _resolve_table(sess, stmt.table)
+        db, info = _resolve_table(sess, stmt.table, dml="UPDATE")
         alias = stmt.table.as_name or stmt.table.name
         txn = sess.txn_for_write()
         tbl = Table(info, txn)
@@ -339,7 +348,7 @@ class DeleteExec:
     def execute(self) -> DMLResult:
         sess = self.session
         stmt = self.stmt
-        db, info = _resolve_table(sess, stmt.table)
+        db, info = _resolve_table(sess, stmt.table, dml="DELETE")
         alias = stmt.table.as_name or stmt.table.name
         txn = sess.txn_for_write()
         tbl = Table(info, txn)

@@ -161,6 +161,13 @@ class TableInfo:
     comment: str = ""
     update_ts: int = 0
     partition: PartitionInfo = None
+    # view definition (reference: parser/model/model.go ViewInfo):
+    # {"select": sql_text, "cols": [names], "definer": str} or None
+    view: dict = None
+
+    @property
+    def is_view(self):
+        return self.view is not None
 
     def public_columns(self):
         return [c for c in self.columns if c.state == SchemaState.PUBLIC]
@@ -193,6 +200,7 @@ class TableInfo:
             "indexes": [i.to_json() for i in self.indexes],
             "partition": (self.partition.to_json()
                           if self.partition is not None else None),
+            "view": self.view,
         }
 
     @classmethod
@@ -207,6 +215,7 @@ class TableInfo:
             indexes=[IndexInfo.from_json(i) for i in d["indexes"]],
             partition=(PartitionInfo.from_json(d["partition"])
                        if d.get("partition") else None),
+            view=d.get("view"),
         )
 
 

@@ -628,6 +628,26 @@ class CreateTableStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class CreateViewStmt(StmtNode):
+    """CREATE [OR REPLACE] VIEW name [(cols)] AS select
+    (reference: parser/ast/ddl.go CreateViewStmt)."""
+    view: TableName = None
+    cols: list = field(default_factory=list)
+    select: object = None       # SelectStmt | SetOprStmt
+    or_replace: bool = False
+    definer: str = ""
+
+    def restore(self):
+        s = "CREATE "
+        if self.or_replace:
+            s += "OR REPLACE "
+        s += "VIEW " + self.view.restore()
+        if self.cols:
+            s += " (" + ", ".join(f"`{c}`" for c in self.cols) + ")"
+        return s + " AS " + self.select.restore()
+
+
+@dataclass(repr=False)
 class DropTableStmt(StmtNode):
     tables: list = field(default_factory=list)
     if_exists: bool = False

@@ -1258,6 +1258,45 @@ class Parser:
 
     def _parse_create(self):
         self._expect_kw("create")
+        or_replace = False
+        if self._accept_kw("or"):
+            self._expect_kw("replace")
+            or_replace = True
+        definer = ""
+        while True:
+            # swallow ALGORITHM=... / DEFINER=... / SQL SECURITY ... prefixes
+            if self._accept_kw("algorithm"):
+                self._accept_op("=")
+                self.pos += 1
+            elif self._accept_kw("definer"):
+                self._accept_op("=")
+                u, h = self._parse_user_spec()
+                definer = f"{u}@{h}"
+            elif self._peek_kws("sql", "security"):
+                self.pos += 2
+                self.pos += 1  # DEFINER | INVOKER
+            else:
+                break
+        if self._accept_kw("view"):
+            vn = self._parse_table_name()
+            cols = []
+            if self._accept_op("("):
+                cols.append(self._ident())
+                while self._accept_op(","):
+                    cols.append(self._ident())
+                self._expect_op(")")
+            self._expect_kw("as")
+            sel = self._parse_select_or_union()
+            # swallow WITH [CASCADED|LOCAL] CHECK OPTION
+            if self._accept_kw("with"):
+                self._accept_kw("cascaded")
+                self._accept_kw("local")
+                self._expect_kw("check")
+                self._expect_kw("option")
+            return ast.CreateViewStmt(view=vn, cols=cols, select=sel,
+                                      or_replace=or_replace, definer=definer)
+        if or_replace or definer:
+            raise ParseError("expected VIEW after CREATE OR REPLACE/DEFINER")
         if self._accept_kw("user"):
             ine = False
             if self._accept_kw("if"):

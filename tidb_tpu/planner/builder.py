@@ -19,6 +19,23 @@ from .logical import (
 _BOOL_FT = FieldType(tp=TYPE_LONGLONG)
 
 
+class _ViewCtx:
+    """Planner ctx proxy for view expansion: unqualified names inside the
+    view body resolve against the view's creation-time database. Everything
+    else delegates to the real session ctx; `_base_ctx` lets nested views
+    share one recursion-guard stack."""
+
+    def __init__(self, base, db):
+        self._base_ctx = base
+        self._db = db
+
+    def current_db(self):
+        return self._db
+
+    def __getattr__(self, name):
+        return getattr(self._base_ctx, name)
+
+
 def split_cnf(expr):
     """Split a built expression on AND (reference: expression.SplitCNFItems)."""
     if isinstance(expr, ScalarFunc) and expr.op == "and":
@@ -511,6 +528,8 @@ class PlanBuilder:
             refs = [ColumnRef(name, alias, db, ft) for name, ft in cols]
             return MemSource(db, tn.name.lower(), Schema(refs), rows_fn)
         info = self.ctx.infoschema().table_by_name(db, tn.name)
+        if info.is_view:
+            return self._expand_view(db, info, alias)
         cols = info.public_columns()
         refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
         ds = DataSource(db, info, cols, Schema(refs), alias=alias)
@@ -529,6 +548,49 @@ class PlanBuilder:
                 sel.append(d)
             ds.partitions = sel
         return ds
+
+    def _expand_view(self, db, info, alias):
+        """Inline a view's defining select as a subquery and rename its
+        output columns to the view's column list (reference: planbuilder.go
+        BuildDataSourceFromView)."""
+        from ..parser import parse
+        base = getattr(self.ctx, "_base_ctx", self.ctx)
+        stack = getattr(base, "_view_stack", None)
+        if stack is None:
+            stack = set()
+            try:
+                base._view_stack = stack
+            except AttributeError:
+                pass
+        if info.id in stack:
+            raise TiDBError(
+                f"`{db}`.`{info.name}` contains view recursion",
+                code=ErrCode.ViewRecursive)
+        stack.add(info.id)
+        try:
+            sel = parse(info.view["select"])[0]
+            # resolve against the view's creation-time db with no access to
+            # the enclosing query's scope (a view body never correlates)
+            vctx = _ViewCtx(base, info.view.get("db") or db)
+            sub = PlanBuilder(vctx, outer=None).build(sel)
+        except TiDBError as e:
+            if getattr(e, "code", None) == ErrCode.ViewRecursive:
+                raise
+            raise TiDBError(
+                f"View '{db}.{info.name}' references invalid table(s) or "
+                f"column(s): {e}", code=ErrCode.ViewInvalid)
+        finally:
+            stack.discard(info.id)
+        names = info.view["cols"]
+        if len(names) != len(sub.schema):
+            raise TiDBError(
+                f"View '{db}.{info.name}' is invalid (column count changed)",
+                code=ErrCode.ViewInvalid)
+        exprs = [Column(i, r.ftype, name=nm)
+                 for i, (r, nm) in enumerate(zip(sub.schema.refs, names))]
+        refs = [ColumnRef(nm, alias, db, r.ftype)
+                for r, nm in zip(sub.schema.refs, names)]
+        return Projection(sub, exprs, Schema(refs))
 
     def _build_join(self, jn: ast.Join):
         left = self.build_from(jn.left)

@@ -76,6 +76,12 @@ def check_stmt_privileges(session, stmt):
     elif isinstance(stmt, ast.CreateTableStmt):
         db = stmt.table.schema or session.current_db()
         priv.verify(user, db, stmt.table.name, "create")
+    elif isinstance(stmt, ast.CreateViewStmt):
+        priv.verify(user, stmt.view.schema or session.current_db(),
+                    stmt.view.name, "create")
+        # the definer must be able to read every underlying table
+        # (reference: MySQL requires SELECT on each column accessed)
+        req_tables(stmt.select, "select")
     elif isinstance(stmt, ast.DropTableStmt):
         for tn in stmt.tables:
             priv.verify(user, tn.schema or session.current_db(),

@@ -556,7 +556,7 @@ class Session:
                              ast.CreateTableStmt, ast.DropTableStmt,
                              ast.TruncateTableStmt, ast.CreateIndexStmt,
                              ast.DropIndexStmt, ast.AlterTableStmt,
-                             ast.RenameTableStmt)):
+                             ast.RenameTableStmt, ast.CreateViewStmt)):
             self._implicit_commit()  # DDL implicitly commits (MySQL rule)
         if isinstance(stmt, ast.ShowStmt):
             from .show import exec_show
@@ -573,6 +573,9 @@ class Session:
             return Result()
         if isinstance(stmt, ast.CreateTableStmt):
             self.ddl.create_table(stmt)
+            return Result()
+        if isinstance(stmt, ast.CreateViewStmt):
+            self.ddl.create_view(stmt)
             return Result()
         if isinstance(stmt, ast.DropTableStmt):
             self.ddl.drop_table(stmt)

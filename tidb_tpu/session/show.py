@@ -41,8 +41,14 @@ def exec_show(session, stmt: ast.ShowStmt):
         infos = session.infoschema()
         if infos.schema_by_name(db) is None:
             raise SchemaError(f"Unknown database '{db}'", code=ErrCode.BadDB)
-        tables = [t.name for t in infos.tables_in_schema(db)]
-        rows = [(t.encode(),) for t in sorted(tables) if _match(like, t)]
+        tbls = sorted(infos.tables_in_schema(db), key=lambda t: t.name)
+        if stmt.full:
+            rows = [(t.name.encode(),
+                     b"VIEW" if t.is_view else b"BASE TABLE")
+                    for t in tbls if _match(like, t.name)]
+            return Result(names=[f"Tables_in_{db}", "Table_type"],
+                          chunk=Chunk.from_rows([_S, _S], rows))
+        rows = [(t.name.encode(),) for t in tbls if _match(like, t.name)]
         return Result(names=[f"Tables_in_{db}"], chunk=Chunk.from_rows([_S], rows))
 
     if stmt.kind == "columns":
@@ -89,6 +95,13 @@ def exec_show(session, stmt: ast.ShowStmt):
         tn = stmt.target
         db = tn.schema or session.current_db()
         info = session.infoschema().table_by_name(db, tn.name)
+        if info.is_view:
+            cols = ", ".join(f"`{c}`" for c in info.view["cols"])
+            ddl = (f"CREATE VIEW `{info.name}` ({cols}) AS "
+                   + info.view["select"])
+            return Result(names=["View", "Create View"],
+                          chunk=Chunk.from_rows(
+                              [_S, _S], [(info.name.encode(), ddl.encode())]))
         ddl = render_create_table(info)
         return Result(names=["Table", "Create Table"],
                       chunk=Chunk.from_rows([_S, _S],
