@@ -145,6 +145,11 @@ int32_t mvcc_prewrite(void* h, int32_t n, const char** keys,
       *out_idx = i;
       return ST_LOCKED;
     }
+    if (it != e->locks.end() && it->second.op == OP_LOCK) {
+      // own pessimistic lock: conflict was checked against for_update_ts
+      // at lock-acquisition time (TiKV pessimistic-prewrite semantics)
+      continue;
+    }
     uint64_t conflict = e->has_commit_after(key, start_ts);
     if (conflict) {
       *out_ts = conflict;
@@ -441,6 +446,29 @@ int64_t mvcc_key_count(void* h) {
   Engine* e = (Engine*)h;
   std::lock_guard<std::mutex> g(e->mu);
   return (int64_t)e->chains.size();
+}
+
+// Locks whose start_ts <= max_ts, serialized as
+// [start_ts u64][klen u32][key][plen u32][primary] per entry — the GC
+// worker's resolveLocks scan (store/gcworker/gc_worker.go:1015).
+int32_t mvcc_scan_locks(void* h, uint64_t max_ts, char** out,
+                        int64_t* out_len, int64_t* out_n) {
+  Engine* e = (Engine*)h;
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string buf;
+  int64_t n = 0;
+  for (const auto& kv : e->locks) {
+    if (kv.second.start_ts > max_ts) continue;
+    buf.append((char*)&kv.second.start_ts, 8);
+    put_u32(buf, (uint32_t)kv.first.size());
+    buf.append(kv.first);
+    put_u32(buf, (uint32_t)kv.second.primary.size());
+    buf.append(kv.second.primary);
+    n++;
+  }
+  *out = alloc_out(buf, out_len);
+  *out_n = n;
+  return ST_OK;
 }
 
 }  // extern "C"

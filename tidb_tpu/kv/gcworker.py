@@ -1,0 +1,175 @@
+"""MVCC garbage collection worker (reference: store/gcworker/gc_worker.go —
+runGCJob :619, resolveLocks :1015, the safepoint lease in mysql.tidb).
+
+Each GC round:
+ 1. compute the safepoint: now - gc_life_time, floored at the oldest live
+    reader so an open snapshot never loses its versions;
+ 2. resolve locks abandoned before the safepoint (check the primary's
+    commit status via the version chain, then commit or roll back the
+    secondaries — Percolator crash recovery);
+ 3. drop version-chain entries older than the newest visible-at-safepoint
+    version in both MVCC engines.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def parse_duration(s: str) -> float:
+    """'10m0s' / '30m' / '1h10m' / '50s' → seconds (the Go duration syntax
+    used by tidb_gc_life_time)."""
+    s = s.strip().lower()
+    if not s:
+        raise ValueError("empty duration")
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 0.001}
+    total = 0.0
+    num = ""
+    i = 0
+    while i < len(s):
+        ch = s[i]
+        if ch.isdigit() or ch == ".":
+            num += ch
+            i += 1
+            continue
+        unit = ch
+        if ch == "m" and i + 1 < len(s) and s[i + 1] == "s":
+            unit = "ms"
+            i += 1
+        i += 1
+        if not num or unit not in units:
+            raise ValueError(f"bad duration {s!r}")
+        total += float(num) * units[unit]
+        num = ""
+    if num:  # bare number = seconds
+        total += float(num)
+    return total
+
+
+class GCWorker:
+    """Background safepoint GC (the store/gcworker role; leader election
+    collapses to the single in-process domain)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self._thread = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.safe_point = 0
+        self.last_run = 0.0
+        self.runs = 0
+        self.locks_resolved = 0
+
+    # -- config (reference: gc_worker.go loadDurationWithDefault) ------------
+
+    def life_time_s(self) -> float:
+        v = self.domain.global_vars.get("tidb_gc_life_time", "10m0s")
+        try:
+            return max(parse_duration(str(v)), 10.0)  # floor: 10s
+        except ValueError:
+            return 600.0
+
+    def run_interval_s(self) -> float:
+        v = self.domain.global_vars.get("tidb_gc_run_interval", "10m0s")
+        try:
+            return max(parse_duration(str(v)), 1.0)
+        except ValueError:
+            return 600.0
+
+    # -- one round -----------------------------------------------------------
+
+    def compute_safepoint(self) -> int:
+        """now - life_time as a TSO timestamp, floored at the oldest live
+        transaction so open snapshots keep their read views (reference:
+        gc_worker.go calcNewSafePoint + minStartTS guard)."""
+        now_ms = int(time.time() * 1000)
+        life_ms = int(self.life_time_s() * 1000)
+        sp = max(now_ms - life_ms, 0) << 18
+        min_start = self._min_active_start_ts()
+        if min_start is not None:
+            sp = min(sp, min_start - 1)
+        return max(sp, 0)
+
+    def _min_active_start_ts(self):
+        starts = [
+            s.txn.start_ts
+            for s in list(self.domain.sessions.values())
+            if getattr(s, "txn", None) is not None and s.txn.valid
+        ]
+        return min(starts) if starts else None
+
+    def run_once(self, safe_point: int | None = None) -> dict:
+        """One GC round; returns its summary (reference: runGCJob)."""
+        if str(self.domain.global_vars.get("tidb_gc_enable", "ON")
+               ).upper() in ("OFF", "0"):
+            return {"safe_point": self.safe_point, "skipped": True}
+        store = self.domain.store
+        sp = self.compute_safepoint() if safe_point is None else safe_point
+        if sp <= self.safe_point:
+            return {"safe_point": self.safe_point, "skipped": True}
+        resolved = self._resolve_stale_locks(sp)
+        store.mvcc.gc(sp)
+        with self._lock:
+            self.safe_point = sp
+            self.last_run = time.time()
+            self.runs += 1
+            self.locks_resolved += resolved
+        obs = getattr(self.domain, "observe", None)
+        if obs is not None:
+            obs.inc("gc_runs_total")
+            obs.inc("gc_locks_resolved_total", resolved)
+        return {"safe_point": sp, "resolved_locks": resolved,
+                "skipped": False}
+
+    def _resolve_stale_locks(self, safe_point: int) -> int:
+        """Percolator crash recovery for locks abandoned before the
+        safepoint: a committed primary means commit the secondary, a live
+        or absent primary record means roll back (reference:
+        gc_worker.go:1015 resolveLocks + legacyResolveLocks)."""
+        mvcc = self.domain.store.mvcc
+        n = 0
+        for key, start_ts, primary in mvcc.scan_locks(safe_point):
+            committed, commit_ts = self._primary_status(primary, start_ts)
+            mvcc.resolve_lock(key, committed, commit_ts)
+            n += 1
+        return n
+
+    def _primary_status(self, primary: bytes, start_ts: int):
+        """-> (committed, commit_ts) by inspecting the primary's version
+        chain (reference: client-go CheckTxnStatus)."""
+        for commit_ts, s_ts, _op, _v in self.domain.store.mvcc.debug_chain(
+                primary):
+            if s_ts == start_ts:
+                return True, commit_ts
+        return False, 0
+
+    # -- the loop ------------------------------------------------------------
+
+    def start(self, interval: float | None = None):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval or self.run_interval_s()):
+                try:
+                    self.run_once()
+                except Exception:
+                    pass  # background GC must never crash the server
+        self._thread = threading.Thread(target=loop, name="gc-worker",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"safe_point": self.safe_point, "last_run": self.last_run,
+                    "runs": self.runs, "locks_resolved": self.locks_resolved,
+                    "life_time_s": self.life_time_s(),
+                    "run_interval_s": self.run_interval_s()}

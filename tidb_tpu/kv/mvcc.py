@@ -172,6 +172,12 @@ class MVCCStore:
                 if lock is not None and lock.start_ts != start_ts:
                     raise LockedError(f"key locked by txn {lock.start_ts}",
                                       key=key, lock_ts=lock.start_ts)
+                if lock is not None and lock.op == OP_LOCK:
+                    # our own pessimistic lock: the conflict was already
+                    # checked against for_update_ts at lock time (reference:
+                    # TiKV pessimistic prewrite skips the write-conflict
+                    # check for DoPessimisticCheck keys)
+                    continue
                 conflict = self.map.has_commit_after(key, start_ts)
                 if conflict:
                     raise WriteConflictError(
@@ -306,6 +312,13 @@ class MVCCStore:
             del self.map.keys[lo:hi]
 
     # -- GC -----------------------------------------------------------------
+
+    def scan_locks(self, max_ts: int):
+        """[(key, start_ts, primary)] for locks with start_ts <= max_ts
+        (reference: gc_worker.go:1015 resolveLocks scan)."""
+        with self._lock:
+            return [(k, l.start_ts, l.primary)
+                    for k, l in self.locks.items() if l.start_ts <= max_ts]
 
     def gc(self, safe_point: int):
         """Drop versions older than the newest one <= safe_point

@@ -130,6 +130,11 @@ def _declare(lib):
     lib.mvcc_raw_delete_range.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
                                           c.c_char_p, c.c_int32]
     lib.mvcc_gc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.mvcc_scan_locks.restype = c.c_int32
+    lib.mvcc_scan_locks.argtypes = [c.c_void_p, c.c_uint64,
+                                    c.POINTER(c.c_void_p),
+                                    c.POINTER(c.c_int64),
+                                    c.POINTER(c.c_int64)]
     lib.mvcc_chain_dump.restype = c.c_int32
     lib.mvcc_chain_dump.argtypes = [
         c.c_void_p, c.c_char_p, c.c_int32, c.POINTER(c.c_void_p),
@@ -312,6 +317,31 @@ class NativeMVCCStore:
                                         end, len(end))
 
     # -- GC -----------------------------------------------------------------
+
+    def scan_locks(self, max_ts: int):
+        """[(key, start_ts, primary)] for locks with start_ts <= max_ts
+        (reference: gc_worker.go:1015 resolveLocks scan)."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        out_n = ctypes.c_int64()
+        self._lib.mvcc_scan_locks(self._h, max_ts, ctypes.byref(out),
+                                  ctypes.byref(out_len), ctypes.byref(out_n))
+        buf = _take_buf(self._lib, out.value, out_len.value)
+        res = []
+        pos = 0
+        for _ in range(out_n.value):
+            (start_ts,) = struct.unpack_from("<Q", buf, pos)
+            pos += 8
+            (klen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            key = buf[pos:pos + klen]
+            pos += klen
+            (plen,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            primary = buf[pos:pos + plen]
+            pos += plen
+            res.append((key, start_ts, primary))
+        return res
 
     def gc(self, safe_point: int):
         self.safe_point = max(self.safe_point, safe_point)

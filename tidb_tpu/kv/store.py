@@ -50,6 +50,10 @@ class MemBuffer:
     def __len__(self):
         return len(self._data)
 
+    def keys_since(self, sp: int) -> set:
+        """Keys written after savepoint sp (the statement's write set)."""
+        return {k for k, _prev in self._ops[sp:]}
+
     def savepoint(self) -> int:
         return len(self._ops)
 
@@ -138,6 +142,28 @@ class Transaction:
         self.store.mvcc.acquire_pessimistic_lock(
             list(keys), primary, self.start_ts, for_update_ts)
         self.locked_keys.update(keys)
+
+    def lock_keys_wait(self, keys, for_update_ts: int, timeout_s: float = 50.0):
+        """Pessimistic lock with blocking wait: poll while another txn holds
+        a lock, raising LockWaitTimeout past the deadline (reference:
+        client-go pessimistic lock waiting + innodb_lock_wait_timeout).
+        Deadlocks and write conflicts propagate immediately."""
+        import time as _time
+        from ..errors import LockedError, TiDBError, ErrCode
+        keys = list(keys)
+        if not keys:
+            return
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                self.lock_keys(keys, for_update_ts)
+                return
+            except LockedError:
+                if _time.monotonic() >= deadline:
+                    raise TiDBError(
+                        "Lock wait timeout exceeded; try restarting "
+                        "transaction", code=ErrCode.LockWaitTimeout)
+                _time.sleep(0.005)
 
     def commit(self) -> int:
         """2PC: prewrite all → get commit_ts → commit. Returns commit_ts."""

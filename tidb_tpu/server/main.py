@@ -74,6 +74,7 @@ def run_server(cfg, ready_event: threading.Event | None = None):
         domain.priv.enabled = False
 
     domain.stats_worker.start()  # auto-analyze loop (domain.go:1270 analog)
+    domain.gc_worker.start()     # MVCC safepoint GC (store/gcworker analog)
     sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port).start()
     status_srv = None
     if cfg.status.report_status:

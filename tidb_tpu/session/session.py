@@ -51,6 +51,8 @@ class Domain:
         self.priv = PrivManager(self)       # grant-table cache (RBAC)
         from ..statistics.worker import StatsWorker
         self.stats_worker = StatsWorker(self)  # auto-analyze loop
+        from ..kv.gcworker import GCWorker
+        self.gc_worker = GCWorker(self)        # MVCC safepoint GC
         self.reload_schema()
 
     def reload_schema(self):
@@ -196,6 +198,8 @@ class Session:
         self.user_vars: dict[str, object] = {}
         self.txn = None            # explicit or statement txn
         self.explicit_txn = False
+        self.txn_stmt_history = []  # DML asts for optimistic-commit retry
+        self._in_txn_retry = False
         self.user = "root@%"
         self.parser = Parser()
         self.last_insert_id = 0
@@ -361,16 +365,70 @@ class Session:
             self._commit_txn()
         self.txn = self.store.begin()
         self.explicit_txn = True
+        self.txn_stmt_history = []
 
     def commit(self):
         self.explicit_txn = False
+        history, self.txn_stmt_history = self.txn_stmt_history, []
         if self.txn is not None and self.txn.valid:
-            self._commit_txn()
+            try:
+                self._commit_txn()
+            except WriteConflictError:
+                if self._txn_retry_disabled() or not history:
+                    raise
+                self._retry_txn(history)
         else:
             self.txn = None
 
+    def _txn_retry_disabled(self) -> bool:
+        try:
+            v = str(self.get_sysvar("tidb_disable_txn_auto_retry"))
+        except Exception:
+            return True
+        return v.upper() in ("ON", "1", "TRUE")
+
+    def _retry_limit(self) -> int:
+        try:
+            return max(int(self.get_sysvar("tidb_retry_limit")), 0)
+        except Exception:
+            return 10
+
+    def _retry_txn(self, history):
+        """Optimistic-txn retry: replay the statement history on a fresh
+        snapshot and re-commit (reference: session.go:797 doCommitWithRetry
+        → retry with schema check)."""
+        limit = self._retry_limit()
+        last = None
+        for _attempt in range(max(limit, 1)):
+            self.txn = self.store.begin()
+            self._in_txn_retry = True
+            self.explicit_txn = True  # replayed DML must not autocommit
+            try:
+                for stmt in history:
+                    self._dispatch(stmt)
+                self.explicit_txn = False
+                self._commit_txn()
+                return
+            except WriteConflictError as e:
+                last = e
+                if self.txn is not None and self.txn.valid:
+                    self.txn.rollback()
+                self.txn = None
+                continue
+            except Exception:
+                if self.txn is not None and self.txn.valid:
+                    self.txn.rollback()
+                self.txn = None
+                raise
+            finally:
+                self._in_txn_retry = False
+                self.explicit_txn = False
+        raise last if last is not None else TiDBError(
+            "transaction retry failed", code=ErrCode.TxnRetryable)
+
     def rollback(self):
         self.explicit_txn = False
+        self.txn_stmt_history = []
         if self.txn is not None and self.txn.valid:
             self.txn.rollback()
         self.txn = None
@@ -521,19 +579,22 @@ class Session:
             fn(self, stmt)
             return Result()
         if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
+            if (getattr(stmt, "for_update", False)
+                    and (self.explicit_txn or not self.autocommit())):
+                return self._run_select_for_update(stmt)
             return self.run_query(stmt)
         if isinstance(stmt, ast.InsertStmt):
             from ..executor.dml import InsertExec
-            r = InsertExec(self, stmt).execute()
+            r = self._exec_dml(stmt, lambda: InsertExec(self, stmt).execute())
             self.last_insert_id = r.last_insert_id or self.last_insert_id
             return Result(affected=r.affected, last_insert_id=r.last_insert_id)
         if isinstance(stmt, ast.UpdateStmt):
             from ..executor.dml import UpdateExec
-            r = UpdateExec(self, stmt).execute()
+            r = self._exec_dml(stmt, lambda: UpdateExec(self, stmt).execute())
             return Result(affected=r.affected)
         if isinstance(stmt, ast.DeleteStmt):
             from ..executor.dml import DeleteExec
-            r = DeleteExec(self, stmt).execute()
+            r = self._exec_dml(stmt, lambda: DeleteExec(self, stmt).execute())
             return Result(affected=r.affected)
         if isinstance(stmt, ast.UseStmt):
             if self.infoschema().schema_by_name(stmt.db) is None:
@@ -632,6 +693,180 @@ class Session:
         if isinstance(stmt, ast.TraceStmt):
             return self._exec_trace(stmt)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
+
+    # -- DML execution with retry (reference: session.go:797
+    #    doCommitWithRetry + executor/adapter.go:435 pessimistic retry) -----
+
+    def _exec_dml(self, stmt, run):
+        """Run a DML executor with the transaction-mode-appropriate
+        conflict handling:
+        - explicit pessimistic txn: lock written keys per statement,
+          blocking on foreign locks; re-execute on a fresh for-update
+          snapshot when a conflicting commit slipped in;
+        - autocommit (implicit txn): retry the whole statement on commit
+          conflict up to tidb_retry_limit;
+        - explicit optimistic txn: record the statement for commit-time
+          replay (see _retry_txn)."""
+        if self.explicit_txn or not self.autocommit():
+            # explicit txn OR implicit txn (autocommit=0): the first DML
+            # must take the same path as the rest of the transaction
+            mode = ""
+            try:
+                mode = str(self.get_sysvar("tidb_txn_mode")).lower()
+            except Exception:
+                pass
+            if mode != "optimistic":
+                return self._exec_dml_pessimistic(run)
+            r = run()
+            if not self._in_txn_retry:
+                self.txn_stmt_history.append(stmt)
+            return r
+        from ..errors import LockedError
+        try:
+            wait_s = float(self.get_sysvar("innodb_lock_wait_timeout"))
+        except Exception:
+            wait_s = 50.0
+        deadline = time.monotonic() + wait_s
+        last = None
+        attempts = 0
+        while True:
+            try:
+                return run()
+            except WriteConflictError as e:
+                last = e
+                attempts += 1
+                if attempts > max(self._retry_limit(), 0):
+                    raise
+            except LockedError as e:
+                # a pessimistic txn holds the key: wait it out like the
+                # reference's lock-wait backoff (client-go)
+                last = e
+                if time.monotonic() >= deadline:
+                    raise TiDBError(
+                        "Lock wait timeout exceeded; try restarting "
+                        "transaction", code=ErrCode.LockWaitTimeout)
+                time.sleep(0.005)
+            if self.txn is not None and self.txn.valid:
+                self.txn.rollback()
+            self.txn = None
+
+    def _exec_dml_pessimistic(self, run):
+        """Pessimistic statement execution: read at a fresh for_update_ts,
+        buffer writes, then acquire pessimistic locks on the write set —
+        waiting out foreign locks; when a conflicting commit landed after
+        our for_update_ts, undo the statement's buffered writes and
+        re-execute on a newer snapshot (reference: adapter.go:435
+        handlePessimisticDML + UpdateForUpdateTS)."""
+        from ..errors import LockedError
+        from ..kv.store import Snapshot
+        txn = self.txn_for_write()
+        try:
+            wait_s = float(self.get_sysvar("innodb_lock_wait_timeout"))
+        except Exception:
+            wait_s = 50.0
+        orig_snapshot = txn.snapshot
+        deadline = time.monotonic() + wait_s
+        last = None
+        try:
+            while True:
+                sp = txn.membuf.savepoint()
+                for_update_ts = self.store.next_ts()
+                txn.snapshot = Snapshot(self.store, for_update_ts,
+                                        own_start_ts=txn.start_ts)
+                try:
+                    r = run()
+                except LockedError as e:
+                    # a foreign txn is mid-commit (prewrite locks visible
+                    # to our read): wait it out like the lock-wait path
+                    last = e
+                    txn.membuf.rollback_to(sp)
+                    if time.monotonic() >= deadline:
+                        raise TiDBError(
+                            "Lock wait timeout exceeded; try restarting "
+                            "transaction", code=ErrCode.LockWaitTimeout)
+                    time.sleep(0.005)
+                    continue
+                except Exception:
+                    txn.membuf.rollback_to(sp)
+                    raise
+                keys = txn.membuf.keys_since(sp)
+                try:
+                    txn.lock_keys_wait(
+                        keys, for_update_ts,
+                        timeout_s=max(deadline - time.monotonic(), 0.001))
+                    return r
+                except WriteConflictError as e:
+                    last = e
+                    txn.membuf.rollback_to(sp)
+                    if time.monotonic() >= deadline:
+                        raise
+                    continue
+        finally:
+            txn.snapshot = orig_snapshot
+
+    def _run_select_for_update(self, stmt):
+        """SELECT ... FOR UPDATE (reference: executor SelectLockExec):
+        read on a fresh for-update snapshot, pessimistically lock the
+        scanned rows of every base table (a conservative superset when
+        filters could not be pushed to the scan), and execute on that same
+        snapshot so the returned rows are the latest committed versions.
+        Retries with a newer snapshot when a conflicting commit slips
+        between snapshot and lock."""
+        from .. import tablecodec
+        from ..executor import build_executor
+        from ..executor.exec_select import eval_conds_mask
+        from ..kv.store import Snapshot
+        from ..planner.logical import DataSource
+        from ..table import Table
+        txn = self.txn_for_write()
+        plan = self.plan_query(stmt)
+        try:
+            wait_s = float(self.get_sysvar("innodb_lock_wait_timeout"))
+        except Exception:
+            wait_s = 50.0
+        orig_snapshot = txn.snapshot
+        last = None
+        try:
+            for _attempt in range(max(self._retry_limit(), 1)):
+                for_update_ts = self.store.next_ts()
+                txn.snapshot = Snapshot(self.store, for_update_ts,
+                                        own_start_ts=txn.start_ts)
+                keys = []
+
+                def walk(p):
+                    if isinstance(p, DataSource):
+                        tbl = Table(p.table_info, txn, parts=p.partitions)
+                        pts = (tbl.partition_tables()
+                               if p.table_info.partition is not None
+                               else [tbl])
+                        for pt in pts:
+                            chunk = pt.scan_columnar(col_infos=p.col_infos,
+                                                     with_handle=True)
+                            handles = chunk.columns[-1].data
+                            if p.pushed_conds:
+                                data = type(chunk)(chunk.columns[:-1])
+                                mask = eval_conds_mask(p.pushed_conds, data)
+                                handles = handles[mask]
+                            for h in handles:
+                                keys.append(tablecodec.record_key(
+                                    pt.info.id, int(h)))
+                    for c in p.children:
+                        walk(c)
+                walk(plan)
+                try:
+                    txn.lock_keys_wait(keys, for_update_ts,
+                                       timeout_s=wait_s)
+                except WriteConflictError as e:
+                    last = e
+                    continue
+                # rows are locked: execute on the same snapshot
+                exe = build_executor(plan, self._exec_ctx())
+                chunk = exe.execute()
+                return Result(names=_schema_names(plan), chunk=chunk)
+        finally:
+            txn.snapshot = orig_snapshot
+        raise last if last is not None else TiDBError(
+            "select-for-update retry failed", code=ErrCode.TxnRetryable)
 
     # -- query path ----------------------------------------------------------
 

@@ -108,6 +108,10 @@ for _v in [
     SysVar("tidb_auto_analyze_ratio", SCOPE_GLOBAL, "0.5", "float"),
     SysVar("tidb_enable_auto_analyze", SCOPE_GLOBAL, "ON", "bool"),
     SysVar("tidb_record_plan_in_slow_log", SCOPE_BOTH, "ON", "bool"),
+    # MVCC GC (reference: gc_worker.go gcLifeTimeKey/gcRunIntervalKey)
+    SysVar("tidb_gc_life_time", SCOPE_GLOBAL, "10m0s"),
+    SysVar("tidb_gc_run_interval", SCOPE_GLOBAL, "10m0s"),
+    SysVar("tidb_gc_enable", SCOPE_GLOBAL, "ON", "bool"),
     # -- MySQL-compat breadth (reference: sysvar.go registers 248;
     #    clients and ORMs read/SET these at connect time) ---------------
     SysVar("auto_increment_increment", SCOPE_BOTH, "1", "int", 1, 65535),
