@@ -1,0 +1,145 @@
+"""Plan bindings (reference: bindinfo/handle.go, planner/optimize.go:147-207
+binding match, mysql.bind_info)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t (id int primary key, a int, b int, key ia (a))")
+    tk.must_exec("insert into t values "
+                 + ",".join(f"({i},{i % 50},{i % 7})" for i in range(500)))
+    tk.must_exec("analyze table t")
+    return tk
+
+
+def _explain(tk, sql):
+    return "\n".join(" ".join(str(c) for c in r)
+                     for r in tk.must_query("EXPLAIN " + sql).rows)
+
+
+class TestIndexHints:
+    def test_force_index(self, tk):
+        txt = _explain(tk, "select * from t force index (ia) where a = 3")
+        assert "index:ia" in txt
+
+    def test_ignore_index(self, tk):
+        txt = _explain(tk, "select * from t ignore index (ia) where a = 3")
+        assert "IndexLookUp" not in txt and "TableScan" in txt
+
+    def test_use_index_restricts_candidates(self, tk):
+        tk.must_exec("alter table t add index ib (b)")
+        txt = _explain(tk, "select * from t use index (ib) where a = 3")
+        assert "index:ia" not in txt
+
+    def test_hint_survives_restore(self, tk):
+        from tidb_tpu.parser import parse
+        s = parse("select * from t force index (ia) where a = 3")[0]
+        assert "FORCE INDEX (`ia`)" in s.restore()
+
+
+class TestSessionBindings:
+    def test_binding_changes_plan_and_drops(self, tk):
+        tk.must_exec("create session binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        # literals normalize away: different constant still matches
+        assert "IndexLookUp" not in _explain(tk, "select * from t where a = 77")
+        rows = tk.must_query("show bindings").rows
+        assert len(rows) == 1 and "IGNORE INDEX" in str(rows[0][1])
+        tk.must_exec("drop session binding for select * from t where a = 3")
+        assert "IndexLookUp" in _explain(tk, "select * from t where a = 3")
+
+    def test_session_binding_is_session_local(self, tk):
+        tk.must_exec("create session binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        assert "IndexLookUp" in _explain(tk2, "select * from t where a = 3")
+
+
+class TestGlobalBindings:
+    def test_global_binding_applies_across_sessions(self, tk):
+        tk.must_exec("create global binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        assert "IndexLookUp" not in _explain(tk2, "select * from t where a = 9")
+        assert len(tk.must_query("show global bindings").rows) == 1
+        tk.must_exec("drop global binding for select * from t where a = 3")
+        assert "IndexLookUp" in _explain(tk2, "select * from t where a = 3")
+
+    def test_global_binding_persists_in_catalog(self, tk):
+        """A new BindHandle over the same store sees the binding (the
+        mysql.bind_info persistence role)."""
+        from tidb_tpu.bindinfo import BindHandle
+        tk.must_exec("create global binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t force index (ia) where a = 3")
+        fresh = BindHandle(tk.session.domain)
+        assert len(fresh.list()) == 1
+        tk.must_exec("drop global binding for select * from t where a = 3")
+
+    def test_session_binding_shadows_global(self, tk):
+        tk.must_exec("create global binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t force index (ia) where a = 3")
+        tk.must_exec("create session binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        assert "IndexLookUp" not in _explain(tk, "select * from t where a = 3")
+        tk.must_exec("drop session binding for select * from t where a = 3")
+        tk.must_exec("drop global binding for select * from t where a = 3")
+
+
+class TestBindingValidation:
+    def test_binding_without_hints_rejected(self, tk):
+        e = tk.exec_error("create session binding for "
+                          "select * from t where a = 3 using "
+                          "select * from t where a = 3")
+        assert "no index hints" in str(e)
+
+    def test_mismatched_statements_rejected(self, tk):
+        tk.must_exec("create table x (b int, key ib (b))")
+        e = tk.exec_error("create session binding for "
+                          "select * from t where a = 3 using "
+                          "select * from x use index (ib) where b = 2")
+        assert "different" in str(e)
+
+    def test_binding_scoped_to_database(self, tk):
+        """A binding created in one db must not hijack a same-named table
+        in another db."""
+        tk.must_exec("create global binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        tk.must_exec("create database otherdb")
+        tk.must_exec("use otherdb")
+        tk.must_exec("create table t (id int primary key, a int, key ia (a))")
+        tk.must_exec("insert into t values "
+                     + ",".join(f"({i},{i % 20})" for i in range(300)))
+        tk.must_exec("analyze table t")
+        assert "IndexLookUp" in _explain(tk, "select * from t where a = 3")
+        tk.must_exec("use test")
+        tk.must_exec("drop global binding for select * from t where a = 3")
+
+    def test_prepared_stmt_unaffected_after_drop(self, tk):
+        """Regression: binding hints must not persist on a cached prepared
+        AST after DROP BINDING."""
+        sess = tk.session
+        stmt_ast, _np = sess.prepare("select * from t where a = 3")
+        tk.must_exec("create session binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        sess.execute_prepared(stmt_ast, [])
+        tk.must_exec("drop session binding for select * from t where a = 3")
+        # re-plan of the SAME ast must use the index again
+        plan = sess.plan_query(stmt_ast)
+        from tidb_tpu.planner.logical import explain_tree
+        txt = "\n".join(f"{a} {b}" for a, b in explain_tree(plan))
+        assert "IndexLookUp" in txt

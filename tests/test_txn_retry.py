@@ -103,6 +103,47 @@ class TestPessimisticTxn:
         assert done
         tk.must_query("select v from t where id = 1").check([("111",)])
 
+    def test_timed_out_statement_leaves_no_writes(self, tk):
+        """Regression: a DML that failed with lock-wait-timeout must not
+        leave buffered writes that commit later."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("set session innodb_lock_wait_timeout = 1")
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = 0 where id = 1")
+        tk2.must_exec("begin")
+        e = tk2.exec_error("update t set v = 555 where id = 1")
+        assert e.code == 1205
+        tk.must_exec("rollback")
+        tk2.must_exec("commit")  # must NOT write 555
+        tk.must_query("select v from t where id = 1").check([("10",)])
+
+    def test_no_phantom_deadlock_after_timeout(self, tk):
+        """Regression: a timed-out waiter's wait-for edge is cleared, so a
+        later lock by the former holder cannot see a phantom cycle."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("set session innodb_lock_wait_timeout = 1")
+        tk.must_exec("begin")
+        tk.must_exec("update t set v = 0 where id = 1")   # A holds 1
+        tk2.must_exec("begin")
+        tk2.must_exec("update t set v = 0 where id = 2")  # B holds 2
+        e = tk2.exec_error("update t set v = 1 where id = 1")  # B waits, times out
+        assert e.code == 1205
+        # A touching row 2 must WAIT (B idle, not a deadlock); B releases
+        done = []
+
+        def a_side():
+            tk.must_exec("update t set v = 9 where id = 2")
+            tk.must_exec("commit")
+            done.append(True)
+        th = threading.Thread(target=a_side)
+        th.start()
+        time.sleep(0.15)
+        tk2.must_exec("rollback")
+        th.join(timeout=10)
+        assert done
+
     def test_lock_wait_timeout(self, tk):
         tk2 = tk.new_session()
         tk2.must_exec("use test")

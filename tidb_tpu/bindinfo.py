@@ -1,0 +1,145 @@
+"""SQL plan management — plan bindings (reference: bindinfo/handle.go
+BindHandle + planner/optimize.go:147-207 binding match).
+
+A binding pairs a normalized statement with a hinted variant of the same
+statement.  At plan time the optimizer looks up the current statement's
+normalized text; on a hit it transplants the binding's index hints onto the
+statement before optimization, so USE/FORCE/IGNORE INDEX choices apply
+without editing application SQL.  GLOBAL bindings persist in the catalog
+(the mysql.bind_info role); SESSION bindings live on the session.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .errors import TiDBError
+from .meta import Meta
+from .parser import ast, normalize, parse
+from .priv_check import _collect_tables
+
+
+def normalized_sql(stmt) -> str:
+    """Normalized text of a statement AST (literals → '?', lowercase)."""
+    return normalize(stmt.restore())
+
+
+def extract_hints(stmt) -> dict:
+    """{table_name_lower: [(verb, [index names])]} from every TableName in
+    the statement (the binding's transplantable payload)."""
+    tabs = []
+    _collect_tables(stmt, tabs)
+    out = {}
+    for tn in tabs:
+        if tn.index_hints:
+            out[tn.name.lower()] = list(tn.index_hints)
+    return out
+
+
+def apply_hints(stmt, hints: dict):
+    """Overwrite index hints on the statement's TableNames from a binding's
+    hint map (reference: BindHint in planner/optimize.go). Returns an undo
+    list [(TableName, original hints)] — callers must restore after
+    planning, or a cached prepared AST keeps the transplant forever."""
+    tabs = []
+    _collect_tables(stmt, tabs)
+    undo = []
+    for tn in tabs:
+        h = hints.get(tn.name.lower())
+        if h is not None:
+            undo.append((tn, tn.index_hints))
+            tn.index_hints = [(verb, list(names)) for verb, names in h]
+    return undo
+
+
+def undo_hints(undo):
+    for tn, hints in undo:
+        tn.index_hints = hints
+
+
+def binding_key(db: str, norm_sql: str) -> str:
+    """Bindings are scoped to the creating session's database — the same
+    normalized text against another db's same-named table must not match
+    (reference: bind_info's default_db column)."""
+    return f"{(db or '').lower()}\x00{norm_sql}"
+
+
+class BindHandle:
+    """Domain-level cache of GLOBAL bindings (reference: bindinfo
+    BindHandle with lease refresh; single process → explicit reload)."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self._lock = threading.Lock()
+        self.cache: dict[str, dict] = {}
+        self.load()
+
+    def load(self):
+        txn = self.domain.store.begin()
+        try:
+            binds = Meta(txn).list_bindings()
+        finally:
+            txn.rollback()
+        with self._lock:
+            self.cache = binds
+
+    def match(self, norm_sql: str):
+        with self._lock:
+            return self.cache.get(norm_sql)
+
+    def create(self, norm_sql: str, rec: dict):
+        txn = self.domain.store.begin()
+        try:
+            Meta(txn).set_binding(norm_sql, rec)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        with self._lock:
+            self.cache[norm_sql] = rec
+
+    def drop(self, norm_sql: str) -> bool:
+        txn = self.domain.store.begin()
+        try:
+            Meta(txn).del_binding(norm_sql)
+            txn.commit()
+        except Exception:
+            txn.rollback()
+            raise
+        with self._lock:
+            return self.cache.pop(norm_sql, None) is not None
+
+    def list(self):
+        with self._lock:
+            return dict(self.cache)
+
+
+def make_binding(original_stmt, bind_stmt, db: str = "") -> tuple[str, dict]:
+    """Validate a CREATE BINDING pair and build the stored record."""
+    norm_o = normalized_sql(original_stmt)
+    hints = extract_hints(bind_stmt)
+    if not hints:
+        raise TiDBError("the bound statement carries no index hints")
+    # the hinted statement must be the same query modulo hints (reference:
+    # bindinfo checks original/bind digest equality after hint stripping)
+    undo = apply_hints(bind_stmt, {t: [] for t in hints})
+    try:
+        norm_b_stripped = normalized_sql(bind_stmt)
+    finally:
+        undo_hints(undo)
+    if norm_b_stripped != norm_o:
+        raise TiDBError("the original SQL and the bind SQL are different")
+    rec = {"original": original_stmt.restore(),
+           "bind": bind_stmt.restore(),
+           "db": (db or "").lower(),
+           "hints": {t: [[v, list(n)] for v, n in hs]
+                     for t, hs in hints.items()},
+           "created": time.strftime("%Y-%m-%d %H:%M:%S"),
+           "status": "enabled"}
+    return binding_key(db, norm_o), rec
+
+
+def hints_from_record(rec: dict) -> dict:
+    return {t: [(v, list(n)) for v, n in hs]
+            for t, hs in rec.get("hints", {}).items()}

@@ -160,6 +160,10 @@ class Transaction:
                 return
             except LockedError:
                 if _time.monotonic() >= deadline:
+                    # drop our wait-for edge: a timed-out waiter is no
+                    # longer waiting, and a stale edge would make the
+                    # detector see phantom cycles for innocent sessions
+                    self.store.mvcc.clear_wait(self.start_ts)
                     raise TiDBError(
                         "Lock wait timeout exceeded; try restarting "
                         "transaction", code=ErrCode.LockWaitTimeout)

@@ -22,6 +22,7 @@ KEY_DDL_NEXT_JOB_ID = M + b":ddl_next_job_id"
 KEY_AUTOID_PREFIX = M + b":autoid:"  # m:autoid:{tid} -> int
 KEY_BOOTSTRAP = M + b":bootstrapped"
 KEY_STATS_PREFIX = M + b":stats:"    # m:stats:{tid} -> stats json
+KEY_BINDING_PREFIX = M + b":bind:"   # m:bind:{digest} -> binding json
 
 
 class Meta:
@@ -202,6 +203,21 @@ class Meta:
 
     def set_stats(self, table_id: int, obj):
         self._put_json(KEY_STATS_PREFIX + str(table_id).encode(), obj)
+
+    # -- plan bindings (reference: mysql.bind_info + bindinfo/handle.go) -----
+
+    def set_binding(self, digest: str, rec: dict):
+        self._put_json(KEY_BINDING_PREFIX + digest.encode(), rec)
+
+    def del_binding(self, digest: str):
+        self.txn.delete(KEY_BINDING_PREFIX + digest.encode())
+
+    def list_bindings(self) -> dict:
+        out = {}
+        for k, v in self.txn.scan(KEY_BINDING_PREFIX,
+                                  KEY_BINDING_PREFIX + b"\xff"):
+            out[k[len(KEY_BINDING_PREFIX):].decode()] = json.loads(v.decode())
+        return out
 
 
 def _tbl_key(db_id: int, tid: int) -> bytes:

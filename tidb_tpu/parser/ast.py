@@ -317,8 +317,14 @@ class TableName(Node):
 
     def restore(self):
         s = (f"`{self.schema}`." if self.schema else "") + f"`{self.name}`"
+        if self.partition_names:
+            s += " PARTITION (" + ", ".join(
+                f"`{p}`" for p in self.partition_names) + ")"
         if self.as_name:
             s += f" AS `{self.as_name}`"
+        for verb, names in self.index_hints:
+            s += (f" {verb.upper()} INDEX ("
+                  + ", ".join(f"`{n}`" for n in names) + ")")
         return s
 
 
@@ -645,6 +651,30 @@ class CreateViewStmt(StmtNode):
         if self.cols:
             s += " (" + ", ".join(f"`{c}`" for c in self.cols) + ")"
         return s + " AS " + self.select.restore()
+
+
+@dataclass(repr=False)
+class CreateBindingStmt(StmtNode):
+    """CREATE [GLOBAL|SESSION] BINDING FOR stmt USING hinted_stmt
+    (reference: parser/ast/misc.go CreateBindingStmt)."""
+    original: object = None
+    hinted: object = None
+    is_global: bool = False
+
+    def restore(self):
+        scope = "GLOBAL" if self.is_global else "SESSION"
+        return (f"CREATE {scope} BINDING FOR {self.original.restore()} "
+                f"USING {self.hinted.restore()}")
+
+
+@dataclass(repr=False)
+class DropBindingStmt(StmtNode):
+    original: object = None
+    is_global: bool = False
+
+    def restore(self):
+        scope = "GLOBAL" if self.is_global else "SESSION"
+        return f"DROP {scope} BINDING FOR {self.original.restore()}"
 
 
 @dataclass(repr=False)

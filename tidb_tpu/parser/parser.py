@@ -49,7 +49,7 @@ RESERVED_STOP = {
     "when", "else", "end", "for", "into", "values", "set", "using", "intersect",
     "except", "lock", "offset", "separator", "div", "mod", "regexp", "rlike",
     "collate", "interval", "exists", "select", "by", "with", "window", "over",
-    "duplicate", "partition",
+    "duplicate", "partition", "use", "force", "ignore",
 }
 
 
@@ -1258,6 +1258,18 @@ class Parser:
 
     def _parse_create(self):
         self._expect_kw("create")
+        if (self._peek_kw("binding")
+                or self._peek_kws("global", "binding")
+                or self._peek_kws("session", "binding")):
+            is_global = self._accept_kw("global")
+            self._accept_kw("session")
+            self._expect_kw("binding")
+            self._expect_kw("for")
+            orig = self._parse_select_or_union()
+            self._expect_kw("using")
+            hinted = self._parse_select_or_union()
+            return ast.CreateBindingStmt(original=orig, hinted=hinted,
+                                         is_global=is_global)
         or_replace = False
         if self._accept_kw("or"):
             self._expect_kw("replace")
@@ -1736,6 +1748,15 @@ class Parser:
 
     def _parse_drop(self):
         self._expect_kw("drop")
+        if (self._peek_kw("binding")
+                or self._peek_kws("global", "binding")
+                or self._peek_kws("session", "binding")):
+            is_global = self._accept_kw("global")
+            self._accept_kw("session")
+            self._expect_kw("binding")
+            self._expect_kw("for")
+            orig = self._parse_select_or_union()
+            return ast.DropBindingStmt(original=orig, is_global=is_global)
         if self._accept_kw("user"):
             ie = False
             if self._accept_kw("if"):
@@ -1962,7 +1983,9 @@ class Parser:
         glob = self._accept_kw("global")
         self._accept_kw("session")
         stmt = ast.ShowStmt(full=full, global_scope=glob)
-        if self._accept_kw("databases") or self._accept_kw("schemas"):
+        if self._accept_kw("bindings"):
+            stmt.kind = "bindings"
+        elif self._accept_kw("databases") or self._accept_kw("schemas"):
             stmt.kind = "databases"
         elif self._accept_kw("tables"):
             stmt.kind = "tables"

@@ -46,6 +46,26 @@ def _int_like(v):
     return isinstance(v, (int, np.integer)) and not isinstance(v, bool)
 
 
+def _hint_sets(ds):
+    """USE/FORCE/IGNORE INDEX hints → (allowed | None, excluded, forced)
+    (reference: planner/core accessPath hint pruning)."""
+    allowed, excluded = None, set()
+    forced = False
+    for verb, names in getattr(ds, "index_hints", []):
+        lnames = {n.lower() for n in names}
+        if verb in ("use", "force"):
+            allowed = (allowed or set()) | lnames
+            forced = forced or verb == "force"
+        elif verb == "ignore":
+            excluded |= lnames
+    return allowed, excluded, forced
+
+
+def _idx_allowed(idx, allowed, excluded):
+    n = idx.name.lower()
+    return (allowed is None or n in allowed) and n not in excluded
+
+
 def _choose(ds: DataSource, ctx):
     ds.access = None
     ds.access_est = None
@@ -69,6 +89,7 @@ def _choose(ds: DataSource, ctx):
             by_idx.setdefault(col.idx, []).append(c)
     if not eq and not rngs:
         return
+    allowed, excluded, forced = _hint_sets(ds)
     name2idx = {ci.name: i for i, ci in enumerate(ds.col_infos)}
 
     # 1. PointGet on the integer primary key stored as the row handle
@@ -83,6 +104,8 @@ def _choose(ds: DataSource, ctx):
     # 2. PointGet via a unique index with every column eq-bound
     for idx in info.indexes:
         if idx.state != SchemaState.PUBLIC or not idx.unique:
+            continue
+        if not _idx_allowed(idx, allowed, excluded):
             continue
         vals = []
         for icol in idx.columns:
@@ -100,11 +123,13 @@ def _choose(ds: DataSource, ctx):
     stats = (ctx.table_stats(info.id)
              if ctx is not None and hasattr(ctx, "table_stats") else None)
     n = max((stats or {}).get("row_count", 0), 1)
-    if stats is None or n < 2:
+    if (stats is None or n < 2) and not forced:
         return  # no stats → pseudo costing favors the vectorized scan
     best = None
     for idx in info.indexes:
         if idx.state != SchemaState.PUBLIC:
+            continue
+        if not _idx_allowed(idx, allowed, excluded):
             continue
         prefix, consumed = [], []
         for icol in idx.columns:
@@ -143,7 +168,7 @@ def _choose(ds: DataSource, ctx):
     if best is None:
         return
     cost_full = n * SCAN_ROW_COST
-    if best[0] < cost_full:
+    if forced or best[0] < cost_full:
         ds.access = best[1]
         ds.access_est = int(best[2])
 

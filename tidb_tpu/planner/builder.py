@@ -533,6 +533,7 @@ class PlanBuilder:
         cols = info.public_columns()
         refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
         ds = DataSource(db, info, cols, Schema(refs), alias=alias)
+        ds.index_hints = list(tn.index_hints)
         if tn.partition_names:
             if info.partition is None:
                 raise TiDBError(

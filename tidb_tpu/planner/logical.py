@@ -35,6 +35,7 @@ class DataSource(LogicalPlan):
         self.access = None              # planner/access.py descriptor
         self.access_est = None          # estimated rows via the access path
         self.partitions = None          # [PartitionDef] to scan (None: not partitioned)
+        self.index_hints = []           # [(use|force|ignore, [index names])]
 
     def explain_name(self):
         if self.access is not None:

@@ -54,6 +54,8 @@ class Domain:
         from ..kv.gcworker import GCWorker
         self.gc_worker = GCWorker(self)        # MVCC safepoint GC
         self.reload_schema()
+        from ..bindinfo import BindHandle
+        self.bind_handle = BindHandle(self)    # global plan bindings
 
     def reload_schema(self):
         """reference: domain.Reload — full load on version change."""
@@ -200,6 +202,8 @@ class Session:
         self.explicit_txn = False
         self.txn_stmt_history = []  # DML asts for optimistic-commit retry
         self._in_txn_retry = False
+        self.session_bindings: dict[str, dict] = {}  # SESSION plan bindings
+        self.binding_used = None   # normalized sql of the last matched binding
         self.user = "root@%"
         self.parser = Parser()
         self.last_insert_id = 0
@@ -638,6 +642,24 @@ class Session:
         if isinstance(stmt, ast.CreateViewStmt):
             self.ddl.create_view(stmt)
             return Result()
+        if isinstance(stmt, ast.CreateBindingStmt):
+            from ..bindinfo import make_binding
+            key, rec = make_binding(stmt.original, stmt.hinted,
+                                    db=self.current_db())
+            if stmt.is_global:
+                self.domain.bind_handle.create(key, rec)
+            else:
+                self.session_bindings[key] = rec
+            return Result()
+        if isinstance(stmt, ast.DropBindingStmt):
+            from ..bindinfo import binding_key, normalized_sql
+            key = binding_key(self.current_db(),
+                              normalized_sql(stmt.original))
+            if stmt.is_global:
+                self.domain.bind_handle.drop(key)
+            else:
+                self.session_bindings.pop(key, None)
+            return Result()
         if isinstance(stmt, ast.DropTableStmt):
             self.ddl.drop_table(stmt)
             return Result()
@@ -801,6 +823,11 @@ class Session:
                     if time.monotonic() >= deadline:
                         raise
                     continue
+                except Exception:
+                    # lock-wait timeout / deadlock: the statement failed —
+                    # its buffered writes must not survive to commit
+                    txn.membuf.rollback_to(sp)
+                    raise
         finally:
             txn.snapshot = orig_snapshot
 
@@ -836,20 +863,41 @@ class Session:
                 def walk(p):
                     if isinstance(p, DataSource):
                         tbl = Table(p.table_info, txn, parts=p.partitions)
-                        pts = (tbl.partition_tables()
-                               if p.table_info.partition is not None
-                               else [tbl])
-                        for pt in pts:
-                            chunk = pt.scan_columnar(col_infos=p.col_infos,
-                                                     with_handle=True)
-                            handles = chunk.columns[-1].data
-                            if p.pushed_conds:
-                                data = type(chunk)(chunk.columns[:-1])
-                                mask = eval_conds_mask(p.pushed_conds, data)
-                                handles = handles[mask]
+                        if (p.access is not None
+                                and p.table_info.partition is None):
+                            # drive from the chosen access path instead of
+                            # a full scan (reference: SelectLockExec locks
+                            # the reader's returned row keys)
+                            kind = p.access[0]
+                            if kind == "point_pk":
+                                handles = [p.access[1]]
+                            elif kind == "point_index":
+                                h = tbl.index_lookup(p.access[1],
+                                                     p.access[2])
+                                handles = [] if h is None else [h]
+                            else:
+                                _k, idx, lo, hi = p.access
+                                handles = tbl.index_scan_handles(
+                                    idx, lo_vals=lo, hi_vals=hi)
                             for h in handles:
                                 keys.append(tablecodec.record_key(
-                                    pt.info.id, int(h)))
+                                    p.table_info.id, int(h)))
+                        else:
+                            pts = (tbl.partition_tables()
+                                   if p.table_info.partition is not None
+                                   else [tbl])
+                            for pt in pts:
+                                chunk = pt.scan_columnar(
+                                    col_infos=p.col_infos, with_handle=True)
+                                handles = chunk.columns[-1].data
+                                if p.pushed_conds:
+                                    data = type(chunk)(chunk.columns[:-1])
+                                    mask = eval_conds_mask(p.pushed_conds,
+                                                           data)
+                                    handles = handles[mask]
+                                for h in handles:
+                                    keys.append(tablecodec.record_key(
+                                        pt.info.id, int(h)))
                     for c in p.children:
                         walk(c)
                 walk(plan)
@@ -871,9 +919,38 @@ class Session:
     # -- query path ----------------------------------------------------------
 
     def plan_query(self, stmt, outer=None):
-        builder = PlanBuilder(self._expr_ctx, outer=outer)
-        plan = builder.build(stmt)
-        return optimize(plan, self._expr_ctx)
+        undo = None
+        if outer is None and isinstance(stmt, (ast.SelectStmt,
+                                               ast.SetOprStmt)):
+            undo = self._apply_binding(stmt)
+        try:
+            builder = PlanBuilder(self._expr_ctx, outer=outer)
+            plan = builder.build(stmt)
+            return optimize(plan, self._expr_ctx)
+        finally:
+            if undo:
+                from ..bindinfo import undo_hints
+                # restore the AST: prepared statements re-plan the same
+                # object, and a dropped binding must stop applying
+                undo_hints(undo)
+
+    def _apply_binding(self, stmt):
+        """Plan-binding match at optimize time (reference:
+        planner/optimize.go:147-207): transplant the matched binding's
+        index hints onto the statement. Returns the undo list."""
+        from ..bindinfo import (apply_hints, binding_key, hints_from_record,
+                                normalized_sql)
+        try:
+            key = binding_key(self.current_db(), normalized_sql(stmt))
+        except Exception:
+            return None
+        rec = self.session_bindings.get(key)
+        if rec is None:
+            rec = self.domain.bind_handle.match(key)
+        if rec is not None and rec.get("status") == "enabled":
+            self.binding_used = key
+            return apply_hints(stmt, hints_from_record(rec))
+        return None
 
     def run_built_query(self, logical_plan) -> Result:
         from ..executor import build_executor

@@ -181,6 +181,20 @@ def exec_show(session, stmt: ast.ShowStmt):
         return Result(names=[f"Grants for {user}@{host}"],
                       chunk=Chunk.from_rows([_S], rows))
 
+    if stmt.kind == "bindings":
+        recs = (session.domain.bind_handle.list() if stmt.global_scope
+                else session.session_bindings)
+        rows = []
+        for norm in sorted(recs):
+            r = recs[norm]
+            rows.append((r["original"].encode(), r["bind"].encode(),
+                         r.get("db", "").encode(),
+                         r.get("status", "enabled").encode(),
+                         r.get("created", "").encode()))
+        return Result(names=["Original_sql", "Bind_sql", "Default_db",
+                             "Status", "Create_time"],
+                      chunk=Chunk.from_rows([_S] * 5, rows))
+
     if stmt.kind == "table_status":
         db = stmt.db or session.current_db()
         infos = session.infoschema()
