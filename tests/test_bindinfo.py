@@ -143,3 +143,41 @@ class TestBindingValidation:
         from tidb_tpu.planner.logical import explain_tree
         txt = "\n".join(f"{a} {b}" for a, b in explain_tree(plan))
         assert "IndexLookUp" in txt
+
+
+class TestBindingSelfJoin:
+    def test_per_occurrence_hints(self, tk):
+        """A self-join binding keeps different hints per occurrence."""
+        tk.must_exec("create session binding for "
+                     "select * from t a, t b where a.id = b.id and a.a = 1 "
+                     "using "
+                     "select * from t a force index (ia), "
+                     "t b ignore index (ia) "
+                     "where a.id = b.id and a.a = 1")
+        from tidb_tpu.bindinfo import hints_from_record
+        rec = next(iter(tk.session.session_bindings.values()))
+        verbs = [h[0][0] for _t, h in hints_from_record(rec) if h]
+        assert sorted(verbs) == ["force", "ignore"]  # both occurrences kept
+        # functional check: a (which carries the sargable filter) goes
+        # through ia; b stays a plain scan
+        txt = _explain(tk, "select * from t a, t b "
+                           "where a.id = b.id and a.a = 5")
+        assert txt.count("index:ia") == 1 and "table:a, index:ia" in txt
+        tk.must_exec("drop session binding for "
+                     "select * from t a, t b where a.id = b.id and a.a = 1")
+
+
+class TestBindingPrivileges:
+    def test_global_binding_requires_super(self, tk):
+        tk.must_exec("create user 'plain'@'%'")
+        tk.must_exec("grant select on test.* to 'plain'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "plain@%"
+        e = tk2.exec_error("create global binding for "
+                           "select * from t where a = 3 using "
+                           "select * from t ignore index (ia) where a = 3")
+        assert "denied" in str(e).lower()
+        # session-scope bindings are allowed for any user
+        tk2.must_exec("create session binding for "
+                      "select * from t where a = 3 using "
+                      "select * from t ignore index (ia) where a = 3")

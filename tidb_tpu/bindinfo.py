@@ -25,31 +25,31 @@ def normalized_sql(stmt) -> str:
     return normalize(stmt.restore())
 
 
-def extract_hints(stmt) -> dict:
-    """{table_name_lower: [(verb, [index names])]} from every TableName in
-    the statement (the binding's transplantable payload)."""
+def extract_hints(stmt) -> list:
+    """[(table_name_lower, [(verb, [index names])])] for every TableName in
+    AST traversal order — positional, so a self-join can carry different
+    hints per occurrence (reference: bindinfo matches hints by offset)."""
     tabs = []
     _collect_tables(stmt, tabs)
-    out = {}
-    for tn in tabs:
-        if tn.index_hints:
-            out[tn.name.lower()] = list(tn.index_hints)
-    return out
+    return [(tn.name.lower(), list(tn.index_hints)) for tn in tabs]
 
 
-def apply_hints(stmt, hints: dict):
-    """Overwrite index hints on the statement's TableNames from a binding's
-    hint map (reference: BindHint in planner/optimize.go). Returns an undo
-    list [(TableName, original hints)] — callers must restore after
-    planning, or a cached prepared AST keeps the transplant forever."""
+def apply_hints(stmt, hints: list):
+    """Overwrite index hints positionally on the statement's TableNames
+    from a binding's hint list (reference: BindHint in
+    planner/optimize.go). Both statements normalize identically, so their
+    traversal orders agree; names are still checked defensively. Returns
+    an undo list [(TableName, original hints)] — callers must restore
+    after planning, or a cached prepared AST keeps the transplant
+    forever."""
     tabs = []
     _collect_tables(stmt, tabs)
     undo = []
-    for tn in tabs:
-        h = hints.get(tn.name.lower())
-        if h is not None:
-            undo.append((tn, tn.index_hints))
-            tn.index_hints = [(verb, list(names)) for verb, names in h]
+    for tn, (name, h) in zip(tabs, hints):
+        if tn.name.lower() != name:
+            continue  # structure drifted: skip rather than mis-hint
+        undo.append((tn, tn.index_hints))
+        tn.index_hints = [(verb, list(names)) for verb, names in h]
     return undo
 
 
@@ -119,11 +119,11 @@ def make_binding(original_stmt, bind_stmt, db: str = "") -> tuple[str, dict]:
     """Validate a CREATE BINDING pair and build the stored record."""
     norm_o = normalized_sql(original_stmt)
     hints = extract_hints(bind_stmt)
-    if not hints:
+    if not any(h for _t, h in hints):
         raise TiDBError("the bound statement carries no index hints")
     # the hinted statement must be the same query modulo hints (reference:
     # bindinfo checks original/bind digest equality after hint stripping)
-    undo = apply_hints(bind_stmt, {t: [] for t in hints})
+    undo = apply_hints(bind_stmt, [(t, []) for t, _h in hints])
     try:
         norm_b_stripped = normalized_sql(bind_stmt)
     finally:
@@ -133,13 +133,16 @@ def make_binding(original_stmt, bind_stmt, db: str = "") -> tuple[str, dict]:
     rec = {"original": original_stmt.restore(),
            "bind": bind_stmt.restore(),
            "db": (db or "").lower(),
-           "hints": {t: [[v, list(n)] for v, n in hs]
-                     for t, hs in hints.items()},
+           "hints": [[t, [[v, list(n)] for v, n in hs]] for t, hs in hints],
            "created": time.strftime("%Y-%m-%d %H:%M:%S"),
            "status": "enabled"}
     return binding_key(db, norm_o), rec
 
 
-def hints_from_record(rec: dict) -> dict:
-    return {t: [(v, list(n)) for v, n in hs]
-            for t, hs in rec.get("hints", {}).items()}
+def hints_from_record(rec: dict) -> list:
+    h = rec.get("hints")
+    if isinstance(h, dict):  # legacy by-name record
+        return [(t, [(v, list(n)) for v, n in hs]) for t, hs in h.items()]
+    return [(t, [(v, list(n)) for v, n in hs]) for t, hs in h]
+
+

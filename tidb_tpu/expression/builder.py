@@ -202,7 +202,15 @@ _STR_FUNCS = {"concat", "concat_ws", "upper", "lower", "substring", "trim",
               "monthname", "str_to_date", "addtime", "subtime",
               "from_unixtime", "from_days",
               "json_extract", "json_unquote", "json_type", "json_object",
-              "json_array", "json_keys", "inet_ntoa", "uuid"}
+              "json_array", "json_keys", "json_set", "json_insert",
+              "json_replace", "json_remove", "json_array_append",
+              "json_merge_patch", "json_quote", "inet_ntoa", "uuid",
+              "regexp_replace", "regexp_substr", "aes_encrypt",
+              "aes_decrypt", "compress", "uncompress", "random_bytes",
+              "password", "make_set", "export_set", "timediff",
+              "timestampadd", "time", "timestamp", "time_format",
+              "get_format", "uuid_to_bin", "bin_to_uuid", "format_bytes",
+              "inet6_aton", "inet6_ntoa", "weight_string"}
 _INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
               "dayofmonth", "hour", "minute", "second", "quarter", "week",
               "dayofweek", "dayofyear", "extract", "datediff", "sign",
@@ -212,7 +220,10 @@ _INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
               "bit_count", "unix_timestamp", "time_to_sec", "weekday",
               "weekofyear", "yearweek", "to_days", "period_add",
               "period_diff", "microsecond", "timestampdiff",
-              "json_valid", "json_length", "json_contains",
+              "json_valid", "json_length", "json_contains", "json_depth",
+              "json_contains_path", "regexp_like", "regexp_instr",
+              "octet_length", "uncompressed_length", "uuid_short",
+              "is_uuid", "benchmark", "is_ipv4_compat", "is_ipv4_mapped",
               "is_ipv4", "is_ipv6", "inet_aton", "sleep"}
 _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "pow", "power", "rand",
                 "radians", "degrees", "sin", "cos", "tan", "atan", "asin",

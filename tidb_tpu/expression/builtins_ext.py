@@ -501,7 +501,211 @@ _JSON_FUNCS = {
         else None)(_json_load(b))),
     "json_contains": _pyfn("ss", lambda doc, cand: int(
         _json_contains(_json_load(doc), _json_load(cand))), out="i"),
+    "json_quote": _pyfn("s", lambda b: _json_dump(_u(b))),
 }
+
+
+def _json_depth(v) -> int:
+    if isinstance(v, dict):
+        return 1 + max((_json_depth(x) for x in v.values()), default=0)
+    if isinstance(v, list):
+        return 1 + max((_json_depth(x) for x in v), default=0)
+    return 1
+
+
+def _json_merge_patch_all(docs):
+    """RFC 7396 merge patch folded left over the args (reference:
+    types/json json_merge_patch)."""
+    out = docs[0]
+    for patch in docs[1:]:
+        out = _merge_patch(out, patch)
+    return out
+
+
+def _merge_patch(target, patch):
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+def _json_contains_path(doc_b, one_or_all, *paths):
+    doc = _json_load(doc_b)
+    mode = _u(one_or_all).lower()
+    hits = [_json_path_get(doc, p)[1] for p in paths]
+    if mode == "one":
+        return int(any(hits))
+    return int(all(hits))
+
+
+def _json_path_tokens(path: bytes):
+    """Parse a wildcard-free JSON path into [("key", k) | ("idx", n)]
+    (MySQL rejects wildcards in mutation paths too)."""
+    p = _u(path).strip()
+    if not p.startswith("$"):
+        return None
+    toks = []
+    i = 1
+    while i < len(p):
+        if p[i] == ".":
+            i += 1
+            if i < len(p) and p[i] == '"':
+                j = p.index('"', i + 1)
+                toks.append(("key", p[i + 1:j]))
+                i = j + 1
+            else:
+                j = i
+                while j < len(p) and p[j] not in ".[":
+                    j += 1
+                if p[i:j] == "*":
+                    return None
+                toks.append(("key", p[i:j]))
+                i = j
+        elif p[i] == "[":
+            j = p.index("]", i)
+            tok = p[i + 1:j].strip()
+            if tok == "*":
+                return None
+            toks.append(("idx", int(tok)))
+            i = j + 1
+        else:
+            return None
+    return toks
+
+
+def _to_json_value(v):
+    """SQL internal value → JSON value (reference: types/json CreateBinary
+    from a datum): strings become JSON strings, numbers numbers."""
+    if v is None:
+        return None
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v).decode("utf-8", "replace")
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    return str(v)
+
+
+def _json_modify(doc, toks, value, mode):
+    """Apply one (path, value) to doc. mode: set | insert | replace |
+    append (json_array_append) | remove (value ignored)."""
+    if toks is None:
+        raise ValueError("bad json path")
+    if not toks:  # path is "$"
+        if mode == "remove":
+            raise ValueError("cannot remove the root")
+        if mode == "append":
+            return doc + [value] if isinstance(doc, list) else [doc, value]
+        if mode == "insert":
+            return doc
+        return value
+    parent = doc
+    for kind, k in toks[:-1]:
+        if kind == "key":
+            if not isinstance(parent, dict) or k not in parent:
+                return doc  # missing intermediate: no-op (MySQL behavior)
+            parent = parent[k]
+        else:
+            if not isinstance(parent, list) or not (
+                    -len(parent) <= k < len(parent)):
+                return doc
+            parent = parent[k]
+    kind, k = toks[-1]
+    if kind == "key":
+        if not isinstance(parent, dict):
+            return doc
+        exists = k in parent
+        if mode == "remove":
+            parent.pop(k, None)
+        elif mode == "append":
+            if exists:
+                cur = parent[k]
+                parent[k] = (cur + [value] if isinstance(cur, list)
+                             else [cur, value])
+        elif (mode == "set" or (mode == "insert" and not exists)
+                or (mode == "replace" and exists)):
+            parent[k] = value
+    else:
+        if not isinstance(parent, list):
+            return doc
+        exists = -len(parent) <= k < len(parent)
+        if mode == "remove":
+            if exists:
+                del parent[k]
+        elif mode == "append":
+            if exists:
+                cur = parent[k]
+                parent[k] = (cur + [value] if isinstance(cur, list)
+                             else [cur, value])
+        elif mode == "replace":
+            if exists:
+                parent[k] = value
+        elif mode in ("set", "insert"):
+            if exists:
+                if mode == "set":
+                    parent[k] = value
+            else:
+                parent.append(value)
+    return doc
+
+
+def _json_mut_fn(mode, pairwise=True):
+    """Evaluator for json_set/insert/replace/array_append (doc, path, val,
+    ...) and json_remove (doc, path, ...)."""
+    def ev(sf, chunk):
+        doc_d, doc_n = _conv_arg(sf.args[0], chunk, "s")
+        rest = []
+        for i, a in enumerate(sf.args[1:]):
+            kind = "s" if (not pairwise or i % 2 == 0) else "r"
+            rest.append(_conv_arg(a, chunk, kind))
+        m = len(doc_d)
+        out = np.full(m, b"", dtype=object)
+        nulls = doc_n.copy()
+        step = 2 if pairwise else 1
+        for r in range(m):
+            if nulls[r]:
+                continue
+            try:
+                doc = _json_load(doc_d[r])
+                for pi in range(0, len(rest), step):
+                    pd, pn = rest[pi]
+                    if pn[r]:
+                        raise ValueError("null path")
+                    toks = _json_path_tokens(pd[r])
+                    if pairwise:
+                        vd, vn = rest[pi + 1]
+                        val = None if vn[r] else _to_json_value(vd[r])
+                    else:
+                        val = None
+                    doc = _json_modify(doc, toks, val, mode)
+                out[r] = _json_dump(doc)
+            except Exception:
+                nulls[r] = True
+        return out, nulls
+    return ev
+
+
+_JSON_FUNCS.update({
+    "json_set": _json_mut_fn("set"),
+    "json_insert": _json_mut_fn("insert"),
+    "json_replace": _json_mut_fn("replace"),
+    "json_array_append": _json_mut_fn("append"),
+    "json_remove": _json_mut_fn("remove", pairwise=False),
+    "json_depth": _pyfn("s", lambda b: _json_depth(_json_load(b)), out="i"),
+    "json_merge_patch": _pyfn("ss*", lambda *docs: _json_dump(
+        _json_merge_patch_all([_json_load(d) for d in docs]))),
+    "json_contains_path": _pyfn("sss*", _json_contains_path, out="i"),
+})
 
 
 def _json_valid(b) -> int:
@@ -576,9 +780,297 @@ _MISC_FUNCS = {
 }
 
 
+# -- regexp family (reference: expression/builtin_regexp.go; MySQL 8 ICU
+# regexes approximated with Python re) ---------------------------------------
+
+def _re(pat):
+    import re
+    return re.compile(_u(pat), re.DOTALL)
+
+
+def _regexp_substr(s, pat, pos=1, occ=1):
+    m = None
+    it = _re(pat).finditer(_u(s), int(pos) - 1)
+    for i, mm in enumerate(it, 1):
+        if i == int(occ):
+            m = mm
+            break
+    return m.group(0).encode() if m else None
+
+
+def _regexp_replace(s, pat, rep, pos=1, occ=0):
+    """pos: 1-based start; occ: 0 = replace all from pos, n = only the n-th
+    occurrence (reference: builtinRegexpReplace)."""
+    txt = _u(s)
+    head, tail = txt[:int(pos) - 1], txt[int(pos) - 1:]
+    r = _re(pat)
+    if int(occ) == 0:
+        return (head + r.sub(_u(rep), tail)).encode()
+    out = []
+    last = 0
+    for i, m in enumerate(r.finditer(tail), 1):
+        if i == int(occ):
+            out.append(tail[last:m.start()])
+            out.append(m.expand(_u(rep)))
+            last = m.end()
+            break
+    out.append(tail[last:])
+    return (head + "".join(out)).encode()
+
+
+def _regexp_instr(s, pat, pos=1, occ=1, ret=0):
+    for i, mm in enumerate(_re(pat).finditer(_u(s), int(pos) - 1), 1):
+        if i == int(occ):
+            return mm.end() + 1 if int(ret) else mm.start() + 1
+    return 0
+
+
+_REGEXP_FUNCS = {
+    "regexp_like": _pyfn("ss", lambda s, p: int(
+        _re(p).search(_u(s)) is not None), out="i"),
+    "regexp_replace": _pyfn("sssii", lambda s, p, r, pos=1, occ=0:
+                            _regexp_replace(s, p, r, pos, occ)),
+    "regexp_substr": _pyfn("ssii", _regexp_substr),
+    "regexp_instr": _pyfn("ssiii", _regexp_instr, out="i"),
+}
+
+
+# -- encryption / compression (reference: expression/builtin_encryption.go) --
+
+def _aes_key(key: bytes) -> bytes:
+    """MySQL aes key folding: XOR the key into a 16-byte buffer."""
+    out = bytearray(16)
+    for i, b in enumerate(key):
+        out[i % 16] ^= b
+    return bytes(out)
+
+
+def _aes_ecb(data: bytes, key: bytes, encrypt: bool):
+    # AES-128-ECB with PKCS7, implemented over the stdlib-free path: a
+    # pure-python AES would be slow and long; use hashlib-based fallback is
+    # wrong — so implement via the one-block primitives in `cryptography`
+    # if present, else a minimal pure-python AES core.
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+        c = Cipher(algorithms.AES(_aes_key(key)), modes.ECB())
+        if encrypt:
+            pad = 16 - len(data) % 16
+            data = data + bytes([pad]) * pad
+            e = c.encryptor()
+            return e.update(data) + e.finalize()
+        d = c.decryptor()
+        out = d.update(data) + d.finalize()
+        if not out or not 1 <= out[-1] <= 16:
+            return None
+        return out[:-out[-1]]
+    except ImportError:  # no cipher backend in this image: NULL like MySQL
+        return None      # does for malformed input (gated, not stubbed)
+
+
+def _compress(b: bytes) -> bytes:
+    import struct
+    import zlib
+    if not b:
+        return b""
+    return struct.pack("<I", len(b)) + zlib.compress(b)
+
+
+def _uncompress(b: bytes):
+    import zlib
+    if not b:
+        return b""
+    if len(b) < 5:
+        return None
+    try:
+        return zlib.decompress(b[4:])
+    except zlib.error:
+        return None
+
+
+_CRYPTO_FUNCS = {
+    "aes_encrypt": _pyfn("ss", lambda d, k: _aes_ecb(d, k, True)),
+    "aes_decrypt": _pyfn("ss", lambda d, k: _aes_ecb(d, k, False)),
+    "compress": _pyfn("s", _compress),
+    "uncompress": _pyfn("s", _uncompress),
+    "uncompressed_length": _pyfn("s", lambda b: (
+        0 if not b else int.from_bytes(b[:4], "little")), out="i"),
+    "random_bytes": _pyfn("i", lambda n: __import__("os").urandom(
+        min(max(int(n), 1), 1024))),
+    "password": _pyfn("s", lambda b: (
+        "*" + __import__("hashlib").sha1(__import__("hashlib").sha1(
+            b).digest()).hexdigest().upper()).encode()),
+}
+
+
+# -- extra string / time / uuid breadth --------------------------------------
+
+def _make_set(bits, *strs):
+    out = [(_u(s)) for i, s in enumerate(strs)
+           if s is not None and (int(bits) >> i) & 1]
+    return ",".join(out).encode()
+
+
+def _export_set(bits, on, off, sep=b",", width=64):
+    parts = [(_u(on) if (int(bits) >> i) & 1 else _u(off))
+             for i in range(min(int(width), 64))]
+    return _u(sep).join(parts).encode()
+
+
+def _time_or_dt_secs(b):
+    """Seconds for a TIME string, or epoch-seconds for a DATETIME/DATE
+    string (TIMEDIFF accepts both forms — reference: builtin_time.go)."""
+    s = _u(b).strip()
+    if "-" in s.lstrip("-"):
+        from ..sqltypes import parse_datetime_str
+        return parse_datetime_str(s) / 1_000_000
+    return _parse_time_b(b)
+
+
+def _timediff(a, b):
+    return _sec_to_time(_time_or_dt_secs(a) - _time_or_dt_secs(b))
+
+
+def _tsadd(unit, n, dt):
+    import datetime as _dtm
+    n = int(n)
+    if unit in ("microsecond", "second", "minute", "hour", "day", "week"):
+        mult = {"microsecond": 1e-6, "second": 1, "minute": 60,
+                "hour": 3600, "day": 86400, "week": 604800}[unit]
+        r = dt + _dtm.timedelta(seconds=n * mult)
+    else:
+        months = {"month": 1, "quarter": 3, "year": 12}[unit] * n
+        y = dt.year + (dt.month - 1 + months) // 12
+        m = (dt.month - 1 + months) % 12 + 1
+        import calendar
+        d = min(dt.day, calendar.monthrange(y, m)[1])
+        r = dt.replace(year=y, month=m, day=d)
+    return r.strftime("%Y-%m-%d %H:%M:%S").encode()
+
+
+_EXTRA_FUNCS = {
+    "octet_length": _pyfn("s", lambda b: len(b), out="i"),
+    "make_set": _pyfn("is*", _make_set, null_propagate=False),
+    "export_set": _pyfn("isssi", _export_set),
+    "timediff": _pyfn("ss", _timediff),
+    "timestampadd": _pyfn("sid", lambda unit, n, dt: _tsadd(
+        _u(unit).lower(), n, dt)),
+    "time": _pyfn("s", lambda b: (
+        _u(b).split(" ", 1)[1].encode() if " " in _u(b)
+        else _sec_to_time(_parse_time_b(b)))),
+    "timestamp": _pyfn("d", lambda dt: dt.strftime(
+        "%Y-%m-%d %H:%M:%S").encode()),
+    "time_format": _pyfn("ss", lambda t, f: _time_format(t, f)),
+    "get_format": _pyfn("ss", lambda k, r: _GET_FORMATS.get(
+        (_u(k).lower(), _u(r).lower()))),
+    "uuid_short": _pyfn("", lambda: _uuid_short(), out="i"),
+    "is_uuid": _pyfn("s", lambda b: _is_uuid(b), out="i"),
+    "uuid_to_bin": _pyfn("s", lambda b: (
+        __import__("uuid").UUID(_u(b)).bytes if _is_uuid(b) else None)),
+    "bin_to_uuid": _pyfn("s", lambda b: (
+        str(__import__("uuid").UUID(bytes=bytes(b))).encode()
+        if len(b) == 16 else None)),
+    "benchmark": _pyfn("if", lambda n, v: 0, out="i"),
+    "format_bytes": _pyfn("f", lambda v: _format_bytes(v)),
+    "inet6_aton": _pyfn("s", _inet6_aton := (lambda b: (
+        lambda ip: ip.packed if ip is not None else None)(
+        _ip_or_none(b)))),
+    "inet6_ntoa": _pyfn("s", lambda b: _inet6_ntoa(b)),
+    "is_ipv4_compat": _pyfn("s", lambda b: int(
+        len(b) == 16 and bytes(b[:12]) == b"\x00" * 12
+        and bytes(b[12:16]) != b"\x00\x00\x00\x00"), out="i"),
+    "is_ipv4_mapped": _pyfn("s", lambda b: int(
+        len(b) == 16 and bytes(b[:12]) == b"\x00" * 10 + b"\xff\xff"),
+        out="i"),
+    "weight_string": _pyfn("s", lambda b: b),  # binary collation weight
+}
+
+
+def _ip_or_none(b):
+    import ipaddress
+    try:
+        return ipaddress.ip_address(_u(b))
+    except ValueError:
+        return None
+
+
+def _inet6_ntoa(b):
+    import ipaddress
+    try:
+        if len(b) == 16:
+            return str(ipaddress.IPv6Address(bytes(b))).encode()
+        if len(b) == 4:
+            return str(ipaddress.IPv4Address(bytes(b))).encode()
+    except ipaddress.AddressValueError:
+        pass
+    return None
+
+
+_UUID_SHORT_STATE = [None]
+
+
+def _uuid_short():
+    import threading
+    import time as _t
+    if _UUID_SHORT_STATE[0] is None:
+        _UUID_SHORT_STATE[0] = [threading.Lock(), int(_t.time()) << 24]
+    lock, _v = _UUID_SHORT_STATE[0]
+    with lock:
+        _UUID_SHORT_STATE[0][1] += 1
+        return _UUID_SHORT_STATE[0][1]
+
+
+def _is_uuid(b) -> int:
+    import uuid as _uuid
+    try:
+        _uuid.UUID(_u(b))
+        return 1
+    except (ValueError, AttributeError):
+        return 0
+
+
+def _format_bytes(v: float):
+    for unit in ("bytes", "KiB", "MiB", "GiB", "TiB", "PiB"):
+        if abs(v) < 1024 or unit == "PiB":
+            if unit == "bytes":
+                return f"{int(v)} {unit}".encode()
+            return f"{v:.2f} {unit}".encode()
+        v /= 1024
+    return None
+
+
+def _time_format(t, f):
+    secs = _parse_time_b(t)
+    neg = secs < 0
+    v = abs(int(secs))
+    h, rem = divmod(v, 3600)
+    mnt, sec = divmod(rem, 60)
+    out = _u(f)
+    for k, s in (("%H", f"{h:02d}"), ("%k", str(h)), ("%i", f"{mnt:02d}"),
+                 ("%s", f"{sec:02d}"), ("%S", f"{sec:02d}"),
+                 ("%f", "000000"), ("%p", "AM" if h % 24 < 12 else "PM")):
+        out = out.replace(k, s)
+    return (("-" if neg else "") + out).encode()
+
+
+_GET_FORMATS = {
+    ("date", "iso"): b"%Y-%m-%d", ("date", "usa"): b"%m.%d.%Y",
+    ("date", "jis"): b"%Y-%m-%d", ("date", "eur"): b"%d.%m.%Y",
+    ("date", "internal"): b"%Y%m%d",
+    ("datetime", "iso"): b"%Y-%m-%d %H:%i:%s",
+    ("datetime", "usa"): b"%Y-%m-%d %H.%i.%s",
+    ("datetime", "jis"): b"%Y-%m-%d %H:%i:%s",
+    ("datetime", "eur"): b"%Y-%m-%d %H.%i.%s",
+    ("datetime", "internal"): b"%Y%m%d%H%i%s",
+    ("time", "iso"): b"%H:%i:%s", ("time", "usa"): b"%h:%i:%s %p",
+    ("time", "jis"): b"%H:%i:%s", ("time", "eur"): b"%H.%i.%s",
+    ("time", "internal"): b"%H%i%s",
+}
+
+
 def register_all():
     for table in (_STRING_FUNCS, _MATH_FUNCS, _DATE_FUNCS, _JSON_FUNCS,
-                  _MISC_FUNCS):
+                  _MISC_FUNCS, _REGEXP_FUNCS, _CRYPTO_FUNCS, _EXTRA_FUNCS):
         for name, fn in table.items():
             _DISPATCH.setdefault(name, fn)
 

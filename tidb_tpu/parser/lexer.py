@@ -18,7 +18,7 @@ SYSVAR = "sysvar"        # @@name / @@global.name
 USERVAR = "uservar"      # @name
 
 _OPS = [
-    "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
+    "->>", "->", "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
     "+", "-", "*", "/", "%", "(", ")", ",", ".", ";", "=", "<", ">",
     "~", "^", "&", "|", "!",
 ]

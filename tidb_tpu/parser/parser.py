@@ -582,6 +582,10 @@ class Parser:
                     bp = 10
                 elif op == "^":
                     bp = 11
+                elif op in ("->", "->>"):
+                    # JSON path extraction sugar (reference: parser.y
+                    # juxtaposed JSONExtract): col->'$.p' / col->>'$.p'
+                    bp = 12
                 else:
                     return lhs
                 if bp <= min_bp:
@@ -602,6 +606,12 @@ class Parser:
                     self._expect_op(")")
                     lhs = ast.CompareSubquery(op=norm, expr=lhs,
                                               query=ast.SubqueryExpr(sub), quantifier=quant)
+                    continue
+                if op in ("->", "->>"):
+                    rhs = self._parse_expr(bp)
+                    lhs = ast.FuncCall(name="json_extract", args=[lhs, rhs])
+                    if op == "->>":
+                        lhs = ast.FuncCall(name="json_unquote", args=[lhs])
                     continue
                 rhs = self._parse_expr(bp)
                 lhs = ast.BinaryOp(op=norm, left=lhs, right=rhs)
@@ -890,14 +900,21 @@ class Parser:
             self._expect_op(")")
             return self._parse_over(ast.WindowFunc(name=fname, args=args))
         # special argument syntaxes
-        if fname == "timestampdiff":
+        if fname == "get_format":
+            kind = self._ident().lower()
+            self._expect_op(",")
+            r = self._parse_expr()
+            self._expect_op(")")
+            return ast.FuncCall(name="get_format",
+                                args=[ast.Literal("str", kind), r])
+        if fname in ("timestampdiff", "timestampadd"):
             unit = self._ident().lower()
             self._expect_op(",")
             a = self._parse_expr()
             self._expect_op(",")
             b = self._parse_expr()
             self._expect_op(")")
-            return ast.FuncCall(name="timestampdiff",
+            return ast.FuncCall(name=fname,
                                 args=[ast.Literal("str", unit), a, b])
         if fname == "extract":
             unit = self._ident().lower()

@@ -129,6 +129,11 @@ def check_stmt_privileges(session, stmt):
         priv.verify(user, "mysql", "user", "grant")
     elif isinstance(stmt, ast.BRIEStmt):
         priv.verify(user, "", "", "super")  # BACKUP/RESTORE are super-only
+    elif isinstance(stmt, (ast.CreateBindingStmt, ast.DropBindingStmt)):
+        if stmt.is_global:
+            # global bindings steer every session's plans (reference:
+            # bindinfo requires SUPER for GLOBAL scope)
+            priv.verify(user, "", "", "super")
     elif isinstance(stmt, ast.ExplainStmt):
         # EXPLAIN ANALYZE executes the inner statement — same read checks
         req_tables(stmt.stmt, "select")

@@ -940,6 +940,7 @@ class Session:
         index hints onto the statement. Returns the undo list."""
         from ..bindinfo import (apply_hints, binding_key, hints_from_record,
                                 normalized_sql)
+        self.binding_used = None
         try:
             key = binding_key(self.current_db(), normalized_sql(stmt))
         except Exception:
