@@ -1,0 +1,172 @@
+"""Sequences + temporary tables (reference: ddl/sequence.go,
+meta/autoid SequenceAllocator, table/temptable)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+class TestSequence:
+    def test_nextval_lastval_setval(self, tk):
+        tk.must_exec("create sequence s start with 10 increment by 5")
+        tk.must_query("select nextval(s)").check([("10",)])
+        tk.must_query("select nextval(s)").check([("15",)])
+        tk.must_query("select lastval(s)").check([("15",)])
+        tk.must_query("select setval(s, 50)").check([("50",)])
+        tk.must_query("select nextval(s)").check([("55",)])
+
+    def test_lastval_is_session_local(self, tk):
+        tk.must_exec("create sequence s")
+        tk.must_query("select nextval(s)").check([("1",)])
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_query("select lastval(s)").check([(None,)])
+        # allocation is shared: the other session continues the stream
+        tk2.must_query("select nextval(s)").check([("2",)])
+
+    def test_exhaustion_and_cycle(self, tk):
+        tk.must_exec("create sequence small maxvalue 2")
+        tk.must_query("select nextval(small)").check([("1",)])
+        tk.must_query("select nextval(small)").check([("2",)])
+        e = tk.exec_error("select nextval(small)")
+        assert "run out" in str(e)
+        tk.must_exec("create sequence cyc maxvalue 2 cycle")
+        for want in ("1", "2", "1", "2"):
+            tk.must_query("select nextval(cyc)").check([(want,)])
+
+    def test_negative_increment(self, tk):
+        tk.must_exec("create sequence down start with 10 increment by -2 "
+                     "minvalue 1 maxvalue 10")
+        tk.must_query("select nextval(down)").check([("10",)])
+        tk.must_query("select nextval(down)").check([("8",)])
+
+    def test_descending_default_start_is_maxvalue(self, tk):
+        tk.must_exec("create sequence d increment by -1 minvalue -3 "
+                     "maxvalue -1")
+        tk.must_query("select nextval(d)").check([("-1",)])
+        tk.must_query("select nextval(d)").check([("-2",)])
+
+    def test_nextval_over_empty_table_returns_no_rows(self, tk):
+        tk.must_exec("create sequence s2")
+        tk.must_exec("create table empty_t (a int)")
+        assert tk.must_query("select nextval(s2) from empty_t").rows == []
+        # no value was burned
+        tk.must_query("select nextval(s2)").check([("1",)])
+
+    def test_sequence_in_insert(self, tk):
+        tk.must_exec("create sequence ids")
+        tk.must_exec("create table t (id int primary key, v int)")
+        tk.must_exec("insert into t values (nextval(ids), 100), "
+                     "(nextval(ids), 200)")
+        tk.must_query("select id, v from t order by id").check(
+            [("1", "100"), ("2", "200")])
+
+    def test_sequence_ddl_guards(self, tk):
+        tk.must_exec("create sequence s")
+        e = tk.exec_error("select * from s")
+        assert "SEQUENCE" in str(e)
+        e = tk.exec_error("drop sequence nosuch")
+        assert "Unknown SEQUENCE" in str(e)
+        tk.must_exec("drop sequence if exists nosuch")
+        tk.must_exec("create table plain (a int)")
+        e = tk.exec_error("drop sequence plain")
+        assert "is not SEQUENCE" in str(e)
+        tk.must_exec("drop sequence s")
+        e = tk.exec_error("select nextval(s)")
+        assert "doesn't exist" in str(e)
+
+    def test_show_create_sequence_and_persistence(self, tk):
+        tk.must_exec("create sequence s start with 5 maxvalue 50")
+        rows = tk.must_query("show create table s").rows
+        txt = rows[0][1]
+        if isinstance(txt, bytes):
+            txt = txt.decode()
+        assert txt.startswith("CREATE SEQUENCE") and "MAXVALUE 50" in txt
+        tk.must_query("select nextval(s)").check([("5",)])
+        # value survives a fresh session over the same store
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_query("select nextval(s)").check([("6",)])
+
+
+class TestTemporaryTable:
+    def test_basic_and_invisible_to_others(self, tk):
+        tk.must_exec("create temporary table tmp (a int, b int)")
+        tk.must_exec("insert into tmp values (1,2),(3,4)")
+        tk.must_query("select sum(a) from tmp").check([("4",)])
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        e = tk2.exec_error("select * from tmp")
+        assert "doesn't exist" in str(e)
+
+    def test_shadows_permanent_table(self, tk):
+        tk.must_exec("create table p (a int)")
+        tk.must_exec("insert into p values (1)")
+        tk.must_exec("create temporary table p (x int)")
+        tk.must_exec("insert into p values (99)")
+        tk.must_query("select x from p").check([("99",)])
+        # drop removes the temp copy first, revealing the permanent table
+        tk.must_exec("drop table p")
+        tk.must_query("select a from p").check([("1",)])
+
+    def test_update_delete_join(self, tk):
+        tk.must_exec("create temporary table tmp (id int primary key, v int)")
+        tk.must_exec("insert into tmp values (1,10),(2,20),(3,30)")
+        tk.must_exec("update tmp set v = v + 1 where id = 2")
+        tk.must_exec("delete from tmp where id = 3")
+        tk.must_query("select id, v from tmp order by id").check(
+            [("1", "10"), ("2", "21")])
+        tk.must_exec("create table base (id int, name varchar(10))")
+        tk.must_exec("insert into base values (1,'a'),(2,'b')")
+        tk.must_query(
+            "select b.name, t.v from base b, tmp t where b.id = t.id "
+            "order by b.name").check([("a", "10"), ("b", "21")])
+
+    def test_session_close_cleans_up(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("create temporary table tmp (a int)")
+        tk2.must_exec("insert into tmp values (1)")
+        info = tk2.session.infoschema().table_by_name("test", "tmp")
+        tk2.session.close()
+        from tidb_tpu import tablecodec
+        start, _ = tablecodec.table_range(info.id)
+        snap = tk.session.store.get_snapshot()
+        assert not snap.scan(start, start + b"\xff" * 9)
+
+    def test_drop_temporary_only_touches_temp(self, tk):
+        tk.must_exec("create table p (a int)")
+        tk.must_exec("create temporary table p (x int)")
+        tk.must_exec("drop temporary table p")
+        tk.must_query("select count(*) from p").check([("0",)])
+        # DROP TEMPORARY on a non-temp name errors (unless IF EXISTS)
+        e = tk.exec_error("drop temporary table p")
+        assert "Unknown table" in str(e)
+        tk.must_exec("drop temporary table if exists p")
+
+    def test_drop_view_never_touches_temp_shadow(self, tk):
+        tk.must_exec("create table b (a int)")
+        tk.must_exec("create view v as select a from b")
+        tk.must_exec("create temporary table v (x int)")
+        tk.must_exec("insert into v values (7)")
+        tk.must_exec("drop view v")
+        # the temp table survives; the view is gone
+        tk.must_query("select x from v").check([("7",)])
+        tk.must_exec("drop table v")
+        e = tk.exec_error("select * from v")
+        assert "doesn't exist" in str(e)
+
+    def test_temp_like_and_show_tables(self, tk):
+        tk.must_exec("create table src (a int, b varchar(5))")
+        tk.must_exec("create temporary table cp like src")
+        tk.must_exec("insert into cp values (1, 'x')")
+        tk.must_query("select b from cp").check([("x",)])
+        names = {r[0] for r in tk.must_query("show tables").rows}
+        assert "cp" in names and "src" in names

@@ -11,7 +11,7 @@ paths added with ADD INDEX backfill.
 from __future__ import annotations
 
 from .errors import SchemaError, TiDBError, ErrCode
-from .meta import Meta
+from .meta import KEY_SEQ_PREFIX, Meta
 from .model import (
     ColumnInfo, DBInfo, IndexColumn, IndexInfo, Job, JobState, SchemaState,
     TableInfo,
@@ -97,6 +97,8 @@ class DDLExecutor:
         db = infos.schema_by_name(db_name)
         if db is None:
             raise SchemaError(f"Unknown database '{db_name}'", code=ErrCode.BadDB)
+        if stmt.temporary:
+            return self._create_temporary(stmt, db_name)
         if infos.has_table(db_name, stmt.table.name):
             if stmt.if_not_exists:
                 return
@@ -173,9 +175,118 @@ class DDLExecutor:
             m.create_table(db.id, tbl)
         self._run_job(fn, "create_view", schema_id=db.id)
 
-    def drop_table(self, stmt: ast.DropTableStmt):
+    def _create_temporary(self, stmt: ast.CreateTableStmt, db_name: str):
+        """CREATE TEMPORARY TABLE: catalog entry lives only on the session
+        (reference: table/temptable — a local temp table shadows any
+        permanent table of the same name and vanishes with the session).
+        Rows use a real table id in the shared store, cleaned on drop."""
+        sess = self.session
+        key = (db_name.lower(), stmt.table.name.lower())
+        if key in sess.temp_tables:
+            if stmt.if_not_exists:
+                return
+            raise SchemaError(f"Table '{stmt.table.name}' already exists",
+                              code=ErrCode.TableExists)
+        store = sess.store
+        txn = store.begin()
+        try:
+            m = Meta(txn)
+            if stmt.like is not None:
+                src_db = stmt.like.schema or sess.current_db()
+                src = sess.infoschema().table_by_name(src_db, stmt.like.name)
+                tbl = _clone_table_info(src, stmt.table.name, m)
+            else:
+                tbl = build_table_info(stmt, m)
+            txn.commit()  # persists only the consumed global ids
+        except Exception:
+            txn.rollback()
+            raise
+        tbl.temporary = True
+        sess.temp_tables[key] = tbl
+        if stmt.select is not None:
+            sess.execute(f"INSERT INTO `{db_name}`.`{stmt.table.name}` "
+                         + stmt.select.restore())
+
+    def create_sequence(self, stmt: ast.CreateSequenceStmt):
+        """reference: ddl/sequence.go onCreateSequence — a sequence is a
+        row-less TableInfo whose value lives in the meta allocator."""
+        sess = self.session
+        db_name = stmt.name.schema or sess.current_db()
+        infos = sess.infoschema()
+        db = infos.schema_by_name(db_name)
+        if db is None:
+            raise SchemaError(f"Unknown database '{db_name}'",
+                              code=ErrCode.BadDB)
+        if infos.has_table(db_name, stmt.name.name):
+            if stmt.if_not_exists:
+                return
+            raise SchemaError(f"Table '{stmt.name.name}' already exists",
+                              code=ErrCode.TableExists)
+        o = stmt.options
+        inc = int(o.get("increment", 1)) or 1
+        lo = int(o.get("min", 1 if inc > 0 else -(1 << 62)))
+        hi = int(o.get("max", (1 << 62) if inc > 0 else -1))
+        # ascending sequences start at MINVALUE, descending at MAXVALUE
+        # (reference: ddl/sequence.go default start)
+        seq = {"start": int(o.get("start", lo if inc > 0 else hi)),
+               "increment": inc, "min": lo, "max": hi,
+               "cache": int(o.get("cache", 1000)),
+               "cycle": int(o.get("cycle", 0))}
+        if seq["min"] > seq["max"] or not (
+                seq["min"] <= seq["start"] <= seq["max"]):
+            raise TiDBError("Sequence values are conflicting",
+                            code=ErrCode.SequenceRunOut)
+
+        def fn(m, job):
+            tbl = TableInfo(id=m.gen_global_id(), name=stmt.name.name)
+            tbl.sequence = seq
+            job.table_id = tbl.id
+            m.create_table(db.id, tbl)
+        self._run_job(fn, "create_sequence", schema_id=db.id)
+
+    def drop_sequence(self, stmt: ast.DropSequenceStmt):
         sess = self.session
         infos = sess.infoschema()
+        for tn in stmt.sequences:
+            db_name = tn.schema or sess.current_db()
+            if not infos.has_table(db_name, tn.name):
+                if stmt.if_exists:
+                    continue
+                raise SchemaError(f"Unknown SEQUENCE: '{db_name}.{tn.name}'",
+                                  code=ErrCode.BadTable)
+            db = infos.schema_by_name(db_name)
+            tbl = infos.table_by_name(db_name, tn.name)
+            if not tbl.is_sequence:
+                raise TiDBError(f"'{db_name}.{tn.name}' is not SEQUENCE",
+                                code=ErrCode.WrongObjectSequence)
+
+            def fn(m, job, _db=db, _tbl=tbl):
+                m.drop_table(_db.id, _tbl.id)
+                m.txn.delete(KEY_SEQ_PREFIX + str(_tbl.id).encode())
+            self._run_job(fn, "drop_sequence", schema_id=db.id,
+                          table_id=tbl.id)
+
+    def drop_table(self, stmt: ast.DropTableStmt):
+        sess = self.session
+        # DROP VIEW resolves against the catalog only: a session temp table
+        # shadowing the name is never a view and must not hide it
+        infos = (sess.domain.infoschema() if stmt.is_view
+                 else sess.infoschema())
+        remaining = []
+        for tn in stmt.tables:
+            db_name = (tn.schema or sess.current_db()).lower()
+            key = (db_name, tn.name.lower())
+            if not stmt.is_view and key in sess.temp_tables:
+                sess.drop_temp_table(key)
+            elif not stmt.temporary:
+                remaining.append(tn)
+            elif not stmt.if_exists:
+                raise SchemaError(f"Unknown table '{tn.name}'",
+                                  code=ErrCode.BadTable)
+        stmt = ast.DropTableStmt(tables=remaining, if_exists=stmt.if_exists,
+                                 is_view=stmt.is_view)
+        if not remaining:
+            return
         missing = []
         for tn in stmt.tables:
             db_name = tn.schema or sess.current_db()

@@ -81,6 +81,8 @@ class ErrCode:
     NonInsertableTable = 1471
     NonUpdatableTable = 1288
     DupFieldName = 1060
+    SequenceRunOut = 4135
+    WrongObjectSequence = 1347
     PartitionFunctionIsNotAllowed = 1564
     UnknownPartition = 1735
     OnlyOnRangeListPartition = 1512

@@ -258,6 +258,52 @@ class OuterScope:
         return None
 
 
+class _SeqFunc(Expression):
+    """NEXTVAL/LASTVAL/SETVAL over a sequence object: allocation is a
+    session-level side effect per evaluated row (reference:
+    expression/builtin_other.go builtinSequence*)."""
+
+    def __init__(self, kind, session, info, val_expr=None):
+        self.kind = kind
+        self.session = session
+        self.info = info
+        self.val_expr = val_expr
+        self.ftype = FieldType(tp=TYPE_LONGLONG)
+        self.name = f"{kind}({info.name})"
+
+    def eval(self, chunk):
+        n = chunk.num_rows if chunk.num_cols else 1
+        data = np.zeros(n, dtype=np.int64)
+        nulls = np.zeros(n, dtype=bool)
+        if self.kind == "nextval":
+            for i in range(n):
+                data[i] = self.session.seq_next(self.info)
+        elif self.kind == "lastval":
+            v = self.session.seq_lastval.get(self.info.id)
+            if v is None:
+                nulls[:] = True
+            else:
+                data[:] = v
+        else:  # setval
+            vd, vn = self.val_expr.eval(chunk)
+            for i in range(n):
+                if vn[i]:
+                    nulls[i] = True
+                else:
+                    data[i] = self.session.seq_setval(self.info, int(vd[i]))
+        return data, nulls
+
+    def columns_used(self, acc):
+        if self.val_expr is not None:
+            self.val_expr.columns_used(acc)
+
+    def transform_columns(self, fn):
+        return self
+
+    def __repr__(self):
+        return self.name
+
+
 class ExprBuilder:
     """Builds expressions against a schema. `ctx` (optional) provides:
     - eval_subquery(select_ast) -> (list of row tuples, [FieldType])
@@ -617,6 +663,26 @@ class ExprBuilder:
                    and hasattr(self.ctx, "now") else _dt2.datetime.now())
             return Constant(int(now.timestamp()),
                             FieldType(tp=TYPE_LONGLONG))
+        if name in ("nextval", "lastval", "setval") and node.args:
+            sess = getattr(self.ctx, "session", None)
+            if sess is None:
+                raise TiDBError(f"{name} requires a session")
+            arg = node.args[0]
+            if isinstance(arg, ast.ColumnName):
+                db = arg.table or sess.current_db()
+                seq_name = arg.name
+            elif isinstance(arg, ast.Literal):
+                db, _, seq_name = str(arg.value).rpartition(".")
+                db = db or sess.current_db()
+            else:
+                raise TiDBError(f"bad sequence reference in {name}")
+            info = sess.infoschema().table_by_name(db, seq_name)
+            if not info.is_sequence:
+                raise TiDBError(f"'{db}.{seq_name}' is not SEQUENCE",
+                                code=ErrCode.WrongObjectSequence)
+            val = self.build(node.args[1]) if (name == "setval"
+                                              and len(node.args) > 1) else None
+            return _SeqFunc(name, sess, info, val)
         if name in ("connection_id", "found_rows", "row_count",
                     "last_insert_id") and not node.args:
             sess = getattr(self.ctx, "session", None)

@@ -23,6 +23,7 @@ KEY_AUTOID_PREFIX = M + b":autoid:"  # m:autoid:{tid} -> int
 KEY_BOOTSTRAP = M + b":bootstrapped"
 KEY_STATS_PREFIX = M + b":stats:"    # m:stats:{tid} -> stats json
 KEY_BINDING_PREFIX = M + b":bind:"   # m:bind:{digest} -> binding json
+KEY_SEQ_PREFIX = M + b":seq:"        # m:seq:{tid} -> last allocated value
 
 
 class Meta:
@@ -203,6 +204,35 @@ class Meta:
 
     def set_stats(self, table_id: int, obj):
         self._put_json(KEY_STATS_PREFIX + str(table_id).encode(), obj)
+
+    # -- sequences (reference: meta/autoid SequenceAllocator) ----------------
+
+    def sequence_value(self, table_id: int):
+        """Current (last-allocated) sequence value, or None if never used."""
+        return self._get_json(KEY_SEQ_PREFIX + str(table_id).encode(), None)
+
+    def set_sequence_value(self, table_id: int, v: int):
+        self._put_json(KEY_SEQ_PREFIX + str(table_id).encode(), v)
+
+    def sequence_next(self, table_id: int, seq: dict) -> int:
+        """Allocate the next value per the sequence definition; raises on
+        exhaustion unless CYCLE (reference: ddl/sequence.go + autoid)."""
+        inc = seq.get("increment", 1) or 1
+        lo = seq.get("min", 1 if inc > 0 else -(1 << 62))
+        hi = seq.get("max", (1 << 62) if inc > 0 else -1)
+        cur = self.sequence_value(table_id)
+        if cur is None:
+            nxt = seq.get("start", lo if inc > 0 else hi)
+        else:
+            nxt = cur + inc
+        if nxt > hi or nxt < lo:
+            if not seq.get("cycle"):
+                raise TiDBError(
+                    "Sequence has run out of range values",
+                    code=ErrCode.SequenceRunOut)
+            nxt = lo if inc > 0 else hi
+        self.set_sequence_value(table_id, nxt)
+        return nxt
 
     # -- plan bindings (reference: mysql.bind_info + bindinfo/handle.go) -----
 

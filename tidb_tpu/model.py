@@ -164,10 +164,18 @@ class TableInfo:
     # view definition (reference: parser/model/model.go ViewInfo):
     # {"select": sql_text, "cols": [names], "definer": str} or None
     view: dict = None
+    # sequence definition (reference: model.go SequenceInfo):
+    # {"start","increment","min","max","cache","cycle"} or None
+    sequence: dict = None
+    temporary: bool = False   # session-local table (table/temptable role)
 
     @property
     def is_view(self):
         return self.view is not None
+
+    @property
+    def is_sequence(self):
+        return self.sequence is not None
 
     def public_columns(self):
         return [c for c in self.columns if c.state == SchemaState.PUBLIC]
@@ -201,6 +209,8 @@ class TableInfo:
             "partition": (self.partition.to_json()
                           if self.partition is not None else None),
             "view": self.view,
+            "sequence": self.sequence,
+            "temporary": self.temporary,
         }
 
     @classmethod
@@ -216,6 +226,8 @@ class TableInfo:
             partition=(PartitionInfo.from_json(d["partition"])
                        if d.get("partition") else None),
             view=d.get("view"),
+            sequence=d.get("sequence"),
+            temporary=d.get("temporary", False),
         )
 
 

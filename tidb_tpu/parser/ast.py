@@ -618,9 +618,11 @@ class CreateTableStmt(StmtNode):
     like: Optional[TableName] = None
     select: Optional[SelectStmt] = None
     partition: Optional[PartitionOpt] = None
+    temporary: bool = False
 
     def restore(self):
-        s = "CREATE TABLE "
+        s = ("CREATE TEMPORARY TABLE " if self.temporary
+             else "CREATE TABLE ")
         if self.if_not_exists:
             s += "IF NOT EXISTS "
         s += self.table.restore()
@@ -678,10 +680,49 @@ class DropBindingStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class CreateSequenceStmt(StmtNode):
+    """reference: parser/ast/ddl.go CreateSequenceStmt + ddl/sequence.go."""
+    name: TableName = None
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)  # start/increment/min/max/cache/cycle
+
+    def restore(self):
+        s = "CREATE SEQUENCE "
+        if self.if_not_exists:
+            s += "IF NOT EXISTS "
+        s += self.name.restore()
+        o = self.options
+        if "start" in o:
+            s += f" START WITH {o['start']}"
+        if "increment" in o:
+            s += f" INCREMENT BY {o['increment']}"
+        if "min" in o:
+            s += f" MINVALUE {o['min']}"
+        if "max" in o:
+            s += f" MAXVALUE {o['max']}"
+        if "cache" in o:
+            s += f" CACHE {o['cache']}" if o["cache"] else " NOCACHE"
+        if o.get("cycle"):
+            s += " CYCLE"
+        return s
+
+
+@dataclass(repr=False)
+class DropSequenceStmt(StmtNode):
+    sequences: list = field(default_factory=list)
+    if_exists: bool = False
+
+    def restore(self):
+        return ("DROP SEQUENCE " + ("IF EXISTS " if self.if_exists else "")
+                + ", ".join(t.restore() for t in self.sequences))
+
+
+@dataclass(repr=False)
 class DropTableStmt(StmtNode):
     tables: list = field(default_factory=list)
     if_exists: bool = False
     is_view: bool = False
+    temporary: bool = False
 
     def restore(self):
         return (f"DROP {'VIEW' if self.is_view else 'TABLE'} "

@@ -1075,6 +1075,11 @@ class Parser:
         self.pos += 1
         return t.val
 
+    def _signed_int_lit(self) -> int:
+        neg = self._accept_op("-")
+        v = self._int_lit()
+        return -v if neg else v
+
     # -- INSERT / UPDATE / DELETE ------------------------------------------
 
     def _parse_insert(self) -> ast.InsertStmt:
@@ -1370,13 +1375,52 @@ class Parser:
                                        columns=cols, unique=unique, if_not_exists=ine)
         if unique:
             raise ParseError("expected INDEX after CREATE UNIQUE")
+        if self._accept_kw("sequence"):
+            ine = False
+            if self._accept_kw("if"):
+                self._expect_kw("not")
+                self._expect_kw("exists")
+                ine = True
+            seq = ast.CreateSequenceStmt(name=self._parse_table_name(),
+                                         if_not_exists=ine)
+            while True:
+                if self._accept_kw("start"):
+                    self._accept_kw("with")
+                    self._accept_op("=")
+                    seq.options["start"] = self._signed_int_lit()
+                elif self._accept_kw("increment"):
+                    self._accept_kw("by")
+                    self._accept_op("=")
+                    seq.options["increment"] = self._signed_int_lit()
+                elif self._accept_kw("minvalue"):
+                    self._accept_op("=")
+                    seq.options["min"] = self._signed_int_lit()
+                elif self._accept_kw("maxvalue"):
+                    self._accept_op("=")
+                    seq.options["max"] = self._signed_int_lit()
+                elif self._accept_kw("cache"):
+                    self._accept_op("=")
+                    seq.options["cache"] = self._signed_int_lit()
+                elif self._accept_kw("nocache"):
+                    seq.options["cache"] = 0
+                elif self._accept_kw("cycle"):
+                    seq.options["cycle"] = 1
+                elif self._accept_kw("nocycle"):
+                    seq.options["cycle"] = 0
+                elif self._accept_kw("no"):
+                    # NO MINVALUE / NO MAXVALUE / NO CYCLE / NO CACHE
+                    self.pos += 1
+                else:
+                    break
+            return seq
+        temporary = self._accept_kw("temporary")
         self._expect_kw("table")
         ine = False
         if self._accept_kw("if"):
             self._expect_kw("not")
             self._expect_kw("exists")
             ine = True
-        stmt = ast.CreateTableStmt(if_not_exists=ine)
+        stmt = ast.CreateTableStmt(if_not_exists=ine, temporary=temporary)
         stmt.table = self._parse_table_name()
         if self._accept_kw("like"):
             stmt.like = self._parse_table_name()
@@ -1797,7 +1841,17 @@ class Parser:
             iname = self._ident()
             self._expect_kw("on")
             return ast.DropIndexStmt(index_name=iname, table=self._parse_table_name(), if_exists=ie)
+        if self._accept_kw("sequence"):
+            ie = False
+            if self._accept_kw("if"):
+                self._expect_kw("exists")
+                ie = True
+            seqs = [self._parse_table_name()]
+            while self._accept_op(","):
+                seqs.append(self._parse_table_name())
+            return ast.DropSequenceStmt(sequences=seqs, if_exists=ie)
         is_view = self._accept_kw("view")
+        temporary = self._accept_kw("temporary")
         if not is_view:
             self._expect_kw("table")
         ie = False
@@ -1807,7 +1861,8 @@ class Parser:
         tables = [self._parse_table_name()]
         while self._accept_op(","):
             tables.append(self._parse_table_name())
-        return ast.DropTableStmt(tables=tables, if_exists=ie, is_view=is_view)
+        return ast.DropTableStmt(tables=tables, if_exists=ie, is_view=is_view,
+                                 temporary=temporary)
 
     def _parse_alter(self):
         self._expect_kw("alter")

@@ -530,6 +530,10 @@ class PlanBuilder:
         info = self.ctx.infoschema().table_by_name(db, tn.name)
         if info.is_view:
             return self._expand_view(db, info, alias)
+        if info.is_sequence:
+            raise TiDBError(
+                f"'{db}.{tn.name}' is a SEQUENCE; use NEXTVAL/LASTVAL",
+                code=ErrCode.WrongObjectSequence)
         cols = info.public_columns()
         refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
         ds = DataSource(db, info, cols, Schema(refs), alias=alias)

@@ -115,6 +115,42 @@ class Result:
         return [c.ftype for c in self.chunk.columns] if self.chunk is not None else []
 
 
+class _TempSchema:
+    """InfoSchema overlay: session temporary tables shadow same-named
+    catalog tables (reference: infoschema TemporaryTableAttachedInfoSchema)."""
+
+    def __init__(self, base: InfoSchema, temp: dict):
+        self._base = base
+        self._temp = temp
+
+    def table_by_name(self, db, table):
+        t = self._temp.get((db.lower(), table.lower()))
+        if t is not None:
+            return t
+        return self._base.table_by_name(db, table)
+
+    def has_table(self, db, table):
+        if (db.lower(), table.lower()) in self._temp:
+            return True
+        return self._base.has_table(db, table)
+
+    def table_by_id(self, tid):
+        for (db, _name), t in self._temp.items():
+            if t.id == tid:
+                return (self._base.schema_by_name(db), t)
+        return self._base.table_by_id(tid)
+
+    def tables_in_schema(self, db):
+        out = {t.name.lower(): t for t in self._base.tables_in_schema(db)}
+        for (d, name), t in self._temp.items():
+            if d == db.lower():
+                out[name] = t
+        return sorted(out.values(), key=lambda t: t.name)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
 class _ExprCtx:
     """Context handed to ExprBuilder (sysvars, subqueries, time)."""
 
@@ -204,6 +240,10 @@ class Session:
         self._in_txn_retry = False
         self.session_bindings: dict[str, dict] = {}  # SESSION plan bindings
         self.binding_used = None   # normalized sql of the last matched binding
+        # session-local temporary tables: (db, name) -> TableInfo
+        # (reference: table/temptable)
+        self.temp_tables: dict[tuple, object] = {}
+        self.seq_lastval: dict[int, int] = {}  # sequence id -> LASTVAL
         self.user = "root@%"
         self.parser = Parser()
         self.last_insert_id = 0
@@ -222,8 +262,19 @@ class Session:
         domain.sessions[self.conn_id] = self
 
     def close(self):
-        """Drop the session from the domain registry (processlist)."""
+        """Drop the session from the domain registry (processlist) and
+        clean up session-local temporary tables."""
+        for key in list(self.temp_tables):
+            try:
+                self.drop_temp_table(key)
+            except Exception:
+                pass
         self.domain.sessions.pop(self.conn_id, None)
+
+    def drop_temp_table(self, key):
+        info = self.temp_tables.pop(key, None)
+        if info is not None:
+            self.ddl._delete_table_data(info)
 
     # -- variables ----------------------------------------------------------
 
@@ -260,7 +311,10 @@ class Session:
         return self._db
 
     def infoschema(self) -> InfoSchema:
-        return self.domain.infoschema()
+        base = self.domain.infoschema()
+        if not self.temp_tables:
+            return base
+        return _TempSchema(base, self.temp_tables)
 
     def expr_ctx(self):
         return self._expr_ctx
@@ -455,6 +509,40 @@ class Session:
                 raise
         raise TiDBError("autoid allocation conflict")
 
+    def seq_next(self, info) -> int:
+        """NEXTVAL: allocate in an independent meta txn (reference:
+        meta/autoid SequenceAllocator — outside the user txn)."""
+        for _attempt in range(20):
+            txn = self.store.begin()
+            try:
+                m = Meta(txn)
+                v = m.sequence_next(info.id, info.sequence)
+                txn.commit()
+                self.seq_lastval[info.id] = v
+                return v
+            except WriteConflictError:
+                txn.rollback()
+                continue
+            except Exception:
+                txn.rollback()
+                raise
+        raise TiDBError("sequence allocation conflict")
+
+    def seq_setval(self, info, v: int) -> int:
+        for _attempt in range(20):
+            txn = self.store.begin()
+            try:
+                Meta(txn).set_sequence_value(info.id, int(v))
+                txn.commit()
+                return int(v)
+            except WriteConflictError:
+                txn.rollback()
+                continue
+            except Exception:
+                txn.rollback()
+                raise
+        raise TiDBError("sequence setval conflict")
+
     def rebase_autoid(self, table_id, new_base: int):
         for _attempt in range(20):
             txn = self.store.begin()
@@ -641,6 +729,12 @@ class Session:
             return Result()
         if isinstance(stmt, ast.CreateViewStmt):
             self.ddl.create_view(stmt)
+            return Result()
+        if isinstance(stmt, ast.CreateSequenceStmt):
+            self.ddl.create_sequence(stmt)
+            return Result()
+        if isinstance(stmt, ast.DropSequenceStmt):
+            self.ddl.drop_sequence(stmt)
             return Result()
         if isinstance(stmt, ast.CreateBindingStmt):
             from ..bindinfo import make_binding

@@ -95,6 +95,16 @@ def exec_show(session, stmt: ast.ShowStmt):
         tn = stmt.target
         db = tn.schema or session.current_db()
         info = session.infoschema().table_by_name(db, tn.name)
+        if info.is_sequence:
+            s = info.sequence
+            ddl = (f"CREATE SEQUENCE `{info.name}` START WITH {s['start']} "
+                   f"INCREMENT BY {s['increment']} MINVALUE {s['min']} "
+                   f"MAXVALUE {s['max']} "
+                   + (f"CACHE {s['cache']}" if s.get("cache") else "NOCACHE")
+                   + (" CYCLE" if s.get("cycle") else " NOCYCLE"))
+            return Result(names=["Sequence", "Create Sequence"],
+                          chunk=Chunk.from_rows(
+                              [_S, _S], [(info.name.encode(), ddl.encode())]))
         if info.is_view:
             cols = ", ".join(f"`{c}`" for c in info.view["cols"])
             ddl = (f"CREATE VIEW `{info.name}` ({cols}) AS "
