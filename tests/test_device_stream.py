@@ -1,0 +1,106 @@
+"""Streamed device aggregation: batched host→HBM transfers with on-device
+partial-state merge (the cop-iterator overlap analog, reference:
+store/copr/coprocessor.go:399; long-operand scaling per SURVEY §5)."""
+
+import random
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+N_ROWS = 20_000
+BATCH = 3_000  # forces 7 blocks
+
+
+def _rows_equal(a, b, float_cols=()):
+    """Row-set equality with ulp-tolerance on float columns (partial-sum
+    order legitimately changes the last digits)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        for i, (va, vb) in enumerate(zip(ra, rb)):
+            if i in float_cols:
+                if va is None or vb is None:
+                    if va != vb:
+                        return False
+                elif abs(float(va) - float(vb)) > 1e-9 * max(
+                        1.0, abs(float(va))):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table s (grp int, cat varchar(8), amount int, "
+                 "price double, d date)")
+    random.seed(7)
+    rows = []
+    for i in range(N_ROWS):
+        rows.append(f"({i % 13}, 'c{i % 5}', {i % 97}, "
+                    f"{round(random.random() * 10, 3)}, "
+                    f"'202{i % 3}-0{i % 9 + 1}-15')")
+    for lo in range(0, len(rows), 2000):
+        tk.must_exec("insert into s values " + ",".join(rows[lo:lo + 2000]))
+    return tk
+
+
+QUERY = ("select grp, cat, count(*), sum(amount), min(amount), max(amount), "
+         "avg(price) from s where amount > 10 group by grp, cat "
+         "order by grp, cat")
+
+
+class TestStreamedAgg:
+    def test_parity_stream_vs_whole_vs_host(self, tk):
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        tk.must_exec(f"set tidb_device_stream_rows = {BATCH}")
+        stream = tk.must_query(QUERY).rows
+        tk.must_exec("set tidb_device_stream_rows = 0")
+        whole = tk.must_query(QUERY).rows
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        host = tk.must_query(QUERY).rows
+        tk.must_exec("set tidb_executor_engine = 'auto'")
+        assert _rows_equal(stream, whole, float_cols={6})
+        assert _rows_equal(stream, host, float_cols={6})
+        assert len(stream) == 13 * 5
+
+    def test_stream_fragment_annotated(self, tk):
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        tk.must_exec(f"set tidb_device_stream_rows = {BATCH}")
+        txt = "\n".join(" ".join(map(str, r)) for r in
+                        tk.must_query("explain analyze " + QUERY).rows)
+        tk.must_exec("set tidb_executor_engine = 'auto'")
+        assert "tpu-stream" in txt
+
+    def test_global_agg_streams(self, tk):
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        tk.must_exec(f"set tidb_device_stream_rows = {BATCH}")
+        got = tk.must_query("select count(*), sum(amount) from s").rows
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        want = tk.must_query("select count(*), sum(amount) from s").rows
+        tk.must_exec("set tidb_executor_engine = 'auto'")
+        assert got == want
+
+    def test_date_group_key_streams(self, tk):
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        tk.must_exec(f"set tidb_device_stream_rows = {BATCH}")
+        got = tk.must_query("select d, count(*) from s group by d "
+                            "order by d").rows
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        want = tk.must_query("select d, count(*) from s group by d "
+                             "order by d").rows
+        tk.must_exec("set tidb_executor_engine = 'auto'")
+        assert got == want
+
+    def test_tail_batch_smaller_than_block(self, tk):
+        """N_ROWS % BATCH != 0: the tail block retraces and still merges."""
+        assert N_ROWS % BATCH != 0
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        tk.must_exec(f"set tidb_device_stream_rows = {BATCH}")
+        got = tk.must_query("select grp, count(*) from s group by grp "
+                            "order by grp").rows
+        tk.must_exec("set tidb_executor_engine = 'auto'")
+        assert sum(int(r[1]) for r in got) == N_ROWS

@@ -23,13 +23,28 @@ class TestSequence:
         tk.must_query("select nextval(s)").check([("55",)])
 
     def test_lastval_is_session_local(self, tk):
-        tk.must_exec("create sequence s")
+        tk.must_exec("create sequence s nocache")
         tk.must_query("select nextval(s)").check([("1",)])
         tk2 = tk.new_session()
         tk2.must_exec("use test")
         tk2.must_query("select lastval(s)").check([(None,)])
-        # allocation is shared: the other session continues the stream
+        # NOCACHE: the other session continues the stream exactly
         tk2.must_query("select nextval(s)").check([("2",)])
+
+    def test_cache_batches_per_session(self, tk):
+        """CACHE n: each session claims a batch; another session's NEXTVAL
+        skips past it (reference: sequence CACHE semantics)."""
+        tk.must_exec("create sequence cs cache 10")
+        tk.must_query("select nextval(cs)").check([("1",)])
+        tk.must_query("select nextval(cs)").check([("2",)])
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_query("select nextval(cs)").check([("11",)])
+        # first session keeps consuming its own batch
+        tk.must_query("select nextval(cs)").check([("3",)])
+        # SETVAL discards the cached batch
+        tk.must_query("select setval(cs, 100)").check([("100",)])
+        tk.must_query("select nextval(cs)").check([("101",)])
 
     def test_exhaustion_and_cycle(self, tk):
         tk.must_exec("create sequence small maxvalue 2")
@@ -82,6 +97,30 @@ class TestSequence:
         e = tk.exec_error("select nextval(s)")
         assert "doesn't exist" in str(e)
 
+    def test_sequence_by_string_name(self, tk):
+        tk.must_exec("create sequence sq")
+        tk.must_query("select nextval('sq')").check([("1",)])
+
+    def test_drop_table_on_sequence_rejected(self, tk):
+        tk.must_exec("create sequence sq")
+        e = tk.exec_error("drop table sq")
+        assert "use DROP SEQUENCE" in str(e)
+
+    def test_no_implicit_commit_for_temp_and_ddl_commits(self, tk):
+        tk.must_exec("create table base (a int)")
+        # CREATE TEMPORARY TABLE must NOT commit the open txn
+        tk.must_exec("begin")
+        tk.must_exec("insert into base values (1)")
+        tk.must_exec("create temporary table tt (x int)")
+        tk.must_exec("rollback")
+        tk.must_query("select count(*) from base").check([("0",)])
+        # CREATE SEQUENCE (a real DDL) DOES commit it
+        tk.must_exec("begin")
+        tk.must_exec("insert into base values (1)")
+        tk.must_exec("create sequence sq2")
+        tk.must_exec("rollback")
+        tk.must_query("select count(*) from base").check([("1",)])
+
     def test_show_create_sequence_and_persistence(self, tk):
         tk.must_exec("create sequence s start with 5 maxvalue 50")
         rows = tk.must_query("show create table s").rows
@@ -90,10 +129,6 @@ class TestSequence:
             txt = txt.decode()
         assert txt.startswith("CREATE SEQUENCE") and "MAXVALUE 50" in txt
         tk.must_query("select nextval(s)").check([("5",)])
-        # value survives a fresh session over the same store
-        tk2 = tk.new_session()
-        tk2.must_exec("use test")
-        tk2.must_query("select nextval(s)").check([("6",)])
 
 
 class TestTemporaryTable:
@@ -170,3 +205,24 @@ class TestTemporaryTable:
         tk.must_query("select b from cp").check([("x",)])
         names = {r[0] for r in tk.must_query("show tables").rows}
         assert "cp" in names and "src" in names
+
+    def test_truncate_temp_stays_session_local(self, tk):
+        """Regression: TRUNCATE on a temp table must not leak a catalog
+        entry visible to other sessions."""
+        tk.must_exec("create temporary table tt (a int)")
+        tk.must_exec("insert into tt values (1), (2)")
+        tk.must_exec("truncate table tt")
+        tk.must_query("select count(*) from tt").check([("0",)])
+        tk.must_exec("insert into tt values (3)")
+        tk.must_query("select a from tt").check([("3",)])
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        e = tk2.exec_error("select * from tt")
+        assert "doesn't exist" in str(e)
+
+    def test_alter_and_index_on_temp_rejected(self, tk):
+        tk.must_exec("create temporary table tt (a int)")
+        e = tk.exec_error("alter table tt add column b int")
+        assert "TEMPORARY" in str(e)
+        e = tk.exec_error("create index i on tt (a)")
+        assert "TEMPORARY" in str(e)

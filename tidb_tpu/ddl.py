@@ -309,6 +309,10 @@ class DDLExecutor:
                 raise TiDBError(
                     f"'{db_name}.{tn.name}' is a view; use DROP VIEW",
                     code=ErrCode.WrongObject)
+            if tbl.is_sequence:
+                raise TiDBError(
+                    f"'{db_name}.{tn.name}' is a sequence; use DROP "
+                    "SEQUENCE", code=ErrCode.WrongObjectSequence)
 
             def fn(m, job, _db=db, _tbl=tbl):
                 m.drop_table(_db.id, _tbl.id)
@@ -316,12 +320,26 @@ class DDLExecutor:
                     self._delete_table_data(_tbl)
             self._run_job(fn, "drop_table", schema_id=db.id, table_id=tbl.id)
 
+    def _temp_info(self, tn: ast.TableName):
+        sess = self.session
+        db_name = (tn.schema or sess.current_db()).lower()
+        return sess.temp_tables.get((db_name, tn.name.lower()))
+
     def truncate_table(self, stmt: ast.TruncateTableStmt):
         sess = self.session
+        tmp = self._temp_info(stmt.table)
+        if tmp is not None:
+            # session-local: just clear the rows (no catalog job — a job
+            # would leak the temp schema into the shared catalog)
+            self._delete_table_data(tmp)
+            return
         db_name = stmt.table.schema or sess.current_db()
         infos = sess.infoschema()
         db = infos.schema_by_name(db_name)
         tbl = infos.table_by_name(db_name, stmt.table.name)
+        if tbl.is_sequence:
+            raise TiDBError(f"'{db_name}.{stmt.table.name}' is not BASE "
+                            "TABLE", code=ErrCode.WrongObject)
 
         def fn(m, job):
             # new table id, same schema (reference: truncate allocates new id)
@@ -344,6 +362,9 @@ class DDLExecutor:
         checkpointed batched backfill (tidb_tpu/ddl_worker.py; reference:
         ddl/index.go:519-541, ddl/backfilling.go:142)."""
         sess = self.session
+        if self._temp_info(stmt.table) is not None:
+            raise TiDBError("CREATE INDEX on a TEMPORARY table is not "
+                            "supported", code=ErrCode.UnsupportedDDL)
         db_name = stmt.table.schema or sess.current_db()
         infos = sess.infoschema()
         db = infos.schema_by_name(db_name)
@@ -419,6 +440,9 @@ class DDLExecutor:
 
     def alter_table(self, stmt: ast.AlterTableStmt):
         sess = self.session
+        if self._temp_info(stmt.table) is not None:
+            raise TiDBError("ALTER TABLE on a TEMPORARY table is not "
+                            "supported", code=ErrCode.UnsupportedDDL)
         db_name = stmt.table.schema or sess.current_db()
         infos = sess.infoschema()
         db = infos.schema_by_name(db_name)

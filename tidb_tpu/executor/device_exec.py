@@ -125,13 +125,7 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
     return jax.jit(pipeline)
 
 
-def device_agg(plan, chunk: Chunk, conds) -> Chunk:
-    """Fused filter+group+aggregate on device. Raises DeviceUnsupported to
-    trigger host fallback."""
-    n = chunk.num_rows
-    if n == 0:
-        raise DeviceUnsupported("empty input")
-    # device columns for everything referenced
+def _agg_used_columns(plan, conds) -> set:
     used = set()
     for e in plan.group_exprs:
         e.columns_used(used)
@@ -140,6 +134,31 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
             a.columns_used(used)
     for c in conds:
         c.columns_used(used)
+    return used
+
+
+def _agg_sig(plan, conds, dcols) -> tuple:
+    """(signature string, dictionary refs) for the pipeline cache — shared
+    by the whole-table and streamed paths so their caches never diverge."""
+    sig = ";".join(
+        [_expr_sig(c) for c in conds] + ["|g|"] +
+        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
+        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
+         for d in plan.aggs] +
+        [str(id(dc.dictionary)) for dc in dcols.values()
+         if dc.dictionary is not None])
+    refs = tuple(dc.dictionary for dc in dcols.values()
+                 if dc.dictionary is not None)
+    return sig, refs
+
+
+def device_agg(plan, chunk: Chunk, conds) -> Chunk:
+    """Fused filter+group+aggregate on device. Raises DeviceUnsupported to
+    trigger host fallback."""
+    n = chunk.num_rows
+    if n == 0:
+        raise DeviceUnsupported("empty input")
+    used = _agg_used_columns(plan, conds)
     dcols = {}
     env = {}
     for idx in used:
@@ -154,17 +173,7 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
     (key_fns, key_meta, key_pack, val_plan, agg_ops,
      slots) = _plan_agg(plan, dcols)
     n_keys = max(len(key_fns), 1)
-
-    sig_exprs = ";".join(
-        [_expr_sig(c) for c in conds] + ["|g|"] +
-        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
-        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
-         for d in plan.aggs] +
-        [str(id(dc.dictionary)) for dc in dcols.values()
-         if dc.dictionary is not None])
-
-    dict_refs = tuple(dc.dictionary for dc in dcols.values()
-                      if dc.dictionary is not None)
+    sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
     est = _estimate_groups(plan, n)
     capacity = dev.next_pow2(min(n, max(est, 16)))
     while True:
@@ -356,6 +365,111 @@ def _estimate_groups(plan, n):
     for e in plan.group_exprs:
         est *= 64  # refined by stats-driven NDV once histograms land
     return min(est if plan.group_exprs else 1, n)
+
+
+_MERGE_OPS = {"count": "sum_i", "sum_i": "sum_i", "sum_f": "sum_f",
+              "min": "min", "max": "max", "first": "first"}
+
+
+def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int) -> Chunk:
+    """Streamed fused filter+group+aggregate: the input is cut into
+    `batch_rows` blocks; each block's columns transfer to HBM and run the
+    SAME jitted partial-agg program while the next block's transfer is
+    queued (async dispatch = the cop-iterator worker overlap, reference:
+    store/copr/coprocessor.go:399); per-block partial states stay on
+    device and one merge kernel + one device_get finish the query.
+
+    Device memory is bounded by batch_rows + n_blocks*capacity instead of
+    the full table — the long-operand scaling path (SURVEY §5)."""
+    n = chunk.num_rows
+    if n == 0:
+        raise DeviceUnsupported("empty input")
+    if batch_rows <= 0 or n <= batch_rows:
+        raise DeviceUnsupported("input fits one batch")
+    used = _agg_used_columns(plan, conds)
+    if not used:
+        raise DeviceUnsupported("no columns")
+
+    # full-column dictionaries (cached on the parent Column): batch slices
+    # share codes, so group keys agree across blocks
+    col_arrays = {}
+    dcols = {}
+    for idx in used:
+        col = chunk.columns[idx]
+        if col.data.dtype == object:
+            from ..utils.collate import is_ci
+            if is_ci(col.ftype.collate):
+                raise DeviceUnsupported("case-insensitive collation column")
+            codes, uniq = col.dict_encode()
+            col_arrays[idx] = (codes, col.nulls)
+            dcols[idx] = dev.DeviceCol(None, None, col.ftype,
+                                       dictionary=uniq)
+        else:
+            col_arrays[idx] = (col.data, col.nulls)
+            dcols[idx] = dev.DeviceCol(None, None, col.ftype)
+
+    cond_fns = [dev.compile_expr(c, dcols) for c in conds]
+    (key_fns, key_meta, key_pack, val_plan, agg_ops,
+     slots) = _plan_agg(plan, dcols)
+    n_keys = max(len(key_fns), 1)
+    merge_ops = tuple(_MERGE_OPS[op] for op in agg_ops)
+    sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
+
+    est = _estimate_groups(plan, n)
+    capacity = dev.next_pow2(min(batch_rows, max(est, 16)))
+    while True:
+        key = (sig_exprs, "stream", capacity, key_pack, tuple(agg_ops))
+        fn = _pipe_cache_get(key)
+        if fn is None:
+            fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                                 tuple(agg_ops), capacity, key_pack)
+            _pipe_cache_put(key, fn, dict_refs)
+        partials = []
+        for lo in range(0, n, batch_rows):
+            hi = min(lo + batch_rows, n)
+            # the asarray calls enqueue this block's host→HBM copies; the
+            # kernel dispatch below is async, so block k+1's transfer
+            # overlaps block k's compute
+            env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
+                   for idx, (d, nl) in col_arrays.items()}
+            partials.append(fn(env))
+        # one sync point: every block's group count
+        counts = jax.device_get([p[4] for p in partials])
+        if all(int(c) <= capacity for c in counts):
+            break
+        capacity = dev.next_pow2(max(int(c) for c in counts))
+
+    # merge partial states: valid partial slots re-aggregate by key
+    key_cat = tuple(
+        jnp.concatenate([p[0][k] for p in partials])
+        for k in range(n_keys))
+    key_null_cat = tuple(
+        jnp.concatenate([p[1][k] for p in partials])
+        for k in range(n_keys))
+    val_cat = tuple(
+        jnp.concatenate([p[2][j] for p in partials])
+        for j in range(len(val_plan)))
+    val_null_cat = tuple(
+        jnp.concatenate([p[3][j] for p in partials])
+        for j in range(len(val_plan)))
+    mask = jnp.concatenate([
+        jnp.arange(capacity) < p[4] for p in partials])
+    total = int(mask.shape[0])
+    merge_cap = dev.next_pow2(max(max(int(c) for c in counts), 16))
+    while True:
+        out = jax.device_get(dev._agg_impl(
+            key_cat, key_null_cat, val_cat, val_null_cat, mask,
+            n_keys=n_keys, agg_ops=merge_ops,
+            capacity=min(merge_cap, dev.next_pow2(total)), pack=key_pack))
+        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
+        ng = int(n_groups)
+        if ng <= merge_cap:
+            break
+        merge_cap = dev.next_pow2(ng)
+    if ng == 0 and not plan.group_exprs:
+        raise DeviceUnsupported("empty global aggregate")
+    return _assemble_agg(plan, key_meta, slots, dcols,
+                         (key_out, key_null_out, results, result_nulls), ng)
 
 
 def device_join_keys(lkeys, rkeys):

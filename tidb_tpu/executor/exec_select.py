@@ -353,6 +353,21 @@ class HashAggExec(QueryExecutor):
             except DeviceUnsupported:
                 pass
         if raw is not None and want_device(self.ctx, raw.num_rows):
+            # streamed pipeline when the input exceeds the batch bound:
+            # blocks transfer to HBM while the previous block computes
+            # (reference: the cop-iterator worker pool overlap)
+            try:
+                batch = int(self.ctx.get_sysvar("tidb_device_stream_rows"))
+            except Exception:
+                batch = 0
+            if batch > 0 and raw.num_rows > batch:
+                from .device_exec import device_agg_streaming
+                try:
+                    out = device_agg_streaming(eff_p, raw, conds, batch)
+                    self._mark_fragment("tpu-stream", raw.num_rows)
+                    return out
+                except DeviceUnsupported:
+                    pass
             try:
                 out = device_agg(eff_p, raw, conds)
                 self._mark_fragment("tpu", raw.num_rows)

@@ -672,7 +672,10 @@ class ExprBuilder:
                 db = arg.table or sess.current_db()
                 seq_name = arg.name
             elif isinstance(arg, ast.Literal):
-                db, _, seq_name = str(arg.value).rpartition(".")
+                v = arg.val
+                if isinstance(v, bytes):
+                    v = v.decode()
+                db, _, seq_name = str(v).rpartition(".")
                 db = db or sess.current_db()
             else:
                 raise TiDBError(f"bad sequence reference in {name}")

@@ -217,22 +217,35 @@ class Meta:
     def sequence_next(self, table_id: int, seq: dict) -> int:
         """Allocate the next value per the sequence definition; raises on
         exhaustion unless CYCLE (reference: ddl/sequence.go + autoid)."""
+        first, _count = self.sequence_next_batch(table_id, seq, 1)
+        return first
+
+    def sequence_next_batch(self, table_id: int, seq: dict,
+                            want: int) -> tuple:
+        """Claim up to `want` consecutive values in ONE meta write —
+        sessions cache the batch so NEXTVAL is not a meta txn per row
+        (reference: autoid SequenceAllocator + the CACHE option). Returns
+        (first, count); count < want when the range boundary clips the
+        batch. Raises on exhaustion unless CYCLE."""
         inc = seq.get("increment", 1) or 1
         lo = seq.get("min", 1 if inc > 0 else -(1 << 62))
         hi = seq.get("max", (1 << 62) if inc > 0 else -1)
         cur = self.sequence_value(table_id)
         if cur is None:
-            nxt = seq.get("start", lo if inc > 0 else hi)
+            first = seq.get("start", lo if inc > 0 else hi)
         else:
-            nxt = cur + inc
-        if nxt > hi or nxt < lo:
+            first = cur + inc
+        if first > hi or first < lo:
             if not seq.get("cycle"):
                 raise TiDBError(
                     "Sequence has run out of range values",
                     code=ErrCode.SequenceRunOut)
-            nxt = lo if inc > 0 else hi
-        self.set_sequence_value(table_id, nxt)
-        return nxt
+            first = lo if inc > 0 else hi
+        avail = (hi - first) // inc + 1 if inc > 0 else \
+            (first - lo) // (-inc) + 1
+        count = max(min(int(want), avail), 1)
+        self.set_sequence_value(table_id, first + (count - 1) * inc)
+        return first, count
 
     # -- plan bindings (reference: mysql.bind_info + bindinfo/handle.go) -----
 

@@ -1408,8 +1408,17 @@ class Parser:
                 elif self._accept_kw("nocycle"):
                     seq.options["cycle"] = 0
                 elif self._accept_kw("no"):
-                    # NO MINVALUE / NO MAXVALUE / NO CYCLE / NO CACHE
-                    self.pos += 1
+                    if self._accept_kw("cache"):
+                        seq.options["cache"] = 0
+                    elif self._accept_kw("cycle"):
+                        seq.options["cycle"] = 0
+                    elif (self._accept_kw("minvalue")
+                          or self._accept_kw("maxvalue")):
+                        pass  # keep the range defaults
+                    else:
+                        raise ParseError(
+                            "expected MINVALUE, MAXVALUE, CACHE or CYCLE "
+                            "after NO")
                 else:
                     break
             return seq

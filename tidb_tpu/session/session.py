@@ -244,6 +244,7 @@ class Session:
         # (reference: table/temptable)
         self.temp_tables: dict[tuple, object] = {}
         self.seq_lastval: dict[int, int] = {}  # sequence id -> LASTVAL
+        self.seq_cache: dict[int, tuple] = {}  # sequence id -> (next, left)
         self.user = "root@%"
         self.parser = Parser()
         self.last_insert_id = 0
@@ -510,25 +511,37 @@ class Session:
         raise TiDBError("autoid allocation conflict")
 
     def seq_next(self, info) -> int:
-        """NEXTVAL: allocate in an independent meta txn (reference:
-        meta/autoid SequenceAllocator — outside the user txn)."""
-        for _attempt in range(20):
-            txn = self.store.begin()
-            try:
-                m = Meta(txn)
-                v = m.sequence_next(info.id, info.sequence)
-                txn.commit()
-                self.seq_lastval[info.id] = v
-                return v
-            except WriteConflictError:
-                txn.rollback()
-                continue
-            except Exception:
-                txn.rollback()
-                raise
-        raise TiDBError("sequence allocation conflict")
+        """NEXTVAL: serve from the session's cached batch; refill with one
+        independent meta txn per CACHE values (reference: meta/autoid
+        SequenceAllocator — outside the user txn)."""
+        inc = info.sequence.get("increment", 1) or 1
+        st = self.seq_cache.get(info.id)
+        if st is None or st[1] <= 0:
+            k = max(int(info.sequence.get("cache", 1) or 1), 1)
+            for _attempt in range(20):
+                txn = self.store.begin()
+                try:
+                    m = Meta(txn)
+                    first, count = m.sequence_next_batch(info.id,
+                                                         info.sequence, k)
+                    txn.commit()
+                    st = (first, count)
+                    break
+                except WriteConflictError:
+                    txn.rollback()
+                    continue
+                except Exception:
+                    txn.rollback()
+                    raise
+            else:
+                raise TiDBError("sequence allocation conflict")
+        v, remaining = st
+        self.seq_cache[info.id] = (v + inc, remaining - 1)
+        self.seq_lastval[info.id] = v
+        return v
 
     def seq_setval(self, info, v: int) -> int:
+        self.seq_cache.pop(info.id, None)  # cached batch is now stale
         for _attempt in range(20):
             txn = self.store.begin()
             try:
@@ -709,8 +722,12 @@ class Session:
                              ast.CreateTableStmt, ast.DropTableStmt,
                              ast.TruncateTableStmt, ast.CreateIndexStmt,
                              ast.DropIndexStmt, ast.AlterTableStmt,
-                             ast.RenameTableStmt, ast.CreateViewStmt)):
-            self._implicit_commit()  # DDL implicitly commits (MySQL rule)
+                             ast.RenameTableStmt, ast.CreateViewStmt,
+                             ast.CreateSequenceStmt, ast.DropSequenceStmt)):
+            # DDL implicitly commits (MySQL rule) — EXCEPT CREATE/DROP
+            # TEMPORARY TABLE, which MySQL exempts explicitly
+            if not getattr(stmt, "temporary", False):
+                self._implicit_commit()
         if isinstance(stmt, ast.ShowStmt):
             from .show import exec_show
             return exec_show(self, stmt)

@@ -103,6 +103,10 @@ for _v in [
     SysVar("tidb_enable_window_function", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_enable_topn_push_down", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_mesh_shape", SCOPE_BOTH, "1", "str"),
+    # streamed device pipeline batch bound: bounds HBM + transfer memory
+    # for larger-than-memory inputs at the cost of re-transfer per run
+    # (0 = off: whole-table transfers, HBM-resident column cache)
+    SysVar("tidb_device_stream_rows", SCOPE_BOTH, "0", "int", 0),
     SysVar("tidb_slow_log_threshold", SCOPE_BOTH, "300", "int", 0),
     SysVar("cte_max_recursion_depth", SCOPE_BOTH, "1000", "int", 0, 4294967295),
     SysVar("tidb_auto_analyze_ratio", SCOPE_GLOBAL, "0.5", "float"),
