@@ -1,0 +1,129 @@
+"""LOCK/UNLOCK TABLES, FK metadata, cached tables (reference:
+ddl/table_lock.go, ddl/foreign_key.go, table/cache.go)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("insert into t values (1)")
+    return tk
+
+
+class TestTableLocks:
+    def test_write_lock_excludes_other_sessions(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("lock tables t write")
+        assert tk2.exec_error("select * from t").code == 8020
+        assert tk2.exec_error("insert into t values (2)").code == 8020
+        tk.must_query("select a from t").check([("1",)])  # owner reads
+        tk.must_exec("insert into t values (2)")          # owner writes
+        tk.must_exec("unlock tables")
+        tk2.must_query("select count(*) from t").check([("2",)])
+
+    def test_read_lock_allows_foreign_reads_blocks_writes(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("lock tables t read")
+        tk2.must_query("select a from t").check([("1",)])
+        assert tk2.exec_error("update t set a = 9").code == 8020
+        # the lock owner cannot write through its own READ lock
+        assert tk.exec_error("insert into t values (3)").code == 1099
+        tk.must_exec("unlock tables")
+
+    def test_locked_session_cannot_touch_unlocked_tables(self, tk):
+        tk.must_exec("create table other (b int)")
+        tk.must_exec("lock tables t read")
+        assert tk.exec_error("select * from other").code == 1100
+        tk.must_exec("unlock tables")
+        tk.must_query("select count(*) from other").check([("0",)])
+
+    def test_session_close_releases_locks(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("lock tables t write")
+        assert tk.exec_error("select * from t").code == 8020
+        tk2.session.close()
+        tk.must_query("select a from t").check([("1",)])
+
+    def test_insert_select_reads_read_locked_source(self, tk):
+        """Regression: source tables of INSERT...SELECT are reads, not
+        writes — a READ lock must not block them."""
+        tk.must_exec("create table src (a int)")
+        tk.must_exec("insert into src values (7)")
+        tk.must_exec("lock tables t write, src read")
+        tk.must_exec("insert into t select a from src")
+        tk.must_exec("unlock tables")
+        tk.must_query("select count(*) from t").check([("2",)])
+        # foreign READ lock on the source also permits the copy
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("lock tables src read")
+        tk.must_exec("insert into t select a from src")
+        tk2.must_exec("unlock tables")
+
+    def test_ddl_blocked_by_foreign_write_lock(self, tk):
+        """Regression: DROP/ALTER/CREATE INDEX respect LOCK TABLES."""
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("lock tables t write")
+        assert tk.exec_error("drop table t").code == 8020
+        assert tk.exec_error("alter table t add column z int").code == 8020
+        assert tk.exec_error("create index ia on t (a)").code == 8020
+        tk2.must_exec("unlock tables")
+        tk.must_exec("alter table t add column z int")
+
+    def test_conflicting_lock_acquisition_rejected(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk.must_exec("lock tables t read")
+        # a second READ lock coexists; WRITE does not
+        tk2.must_exec("lock tables t read")
+        tk2.must_exec("unlock tables")
+        assert tk2.exec_error("lock tables t write").code == 8020
+        tk.must_exec("unlock tables")
+
+
+class TestForeignKeyMetadata:
+    def test_fk_stored_and_rendered(self, tk):
+        tk.must_exec("create table parent (id int primary key)")
+        tk.must_exec(
+            "create table child (id int primary key, pid int, "
+            "constraint fk_p foreign key (pid) references parent (id) "
+            "on delete cascade on update set null)")
+        info = tk.session.infoschema().table_by_name("test", "child")
+        assert info.foreign_keys == [{
+            "name": "fk_p", "cols": ["pid"], "ref_table": "parent",
+            "ref_cols": ["id"], "on_delete": "cascade",
+            "on_update": "set null"}]
+        ddl = tk.must_query("show create table child").rows[0][1]
+        assert "FOREIGN KEY (`pid`) REFERENCES `parent` (`id`)" in ddl
+        assert "ON DELETE CASCADE" in ddl and "ON UPDATE SET NULL" in ddl
+
+    def test_fk_not_enforced_like_reference(self, tk):
+        """v5.x reference parity: FKs are metadata, not checks."""
+        tk.must_exec("create table p2 (id int primary key)")
+        tk.must_exec("create table c2 (pid int, "
+                     "foreign key (pid) references p2 (id))")
+        tk.must_exec("insert into c2 values (999)")  # no parent: accepted
+        tk.must_query("select count(*) from c2").check([("1",)])
+
+
+class TestCachedTables:
+    def test_cache_flag_and_ddl_guard(self, tk):
+        tk.must_exec("alter table t cache")
+        info = tk.session.infoschema().table_by_name("test", "t")
+        assert info.cached
+        assert tk.exec_error("alter table t add column c int").code == 8242
+        tk.must_exec("alter table t nocache")
+        tk.must_exec("alter table t add column c int")
+        # reads/writes work in both states
+        tk.must_exec("alter table t cache")
+        tk.must_exec("insert into t values (5, 6)")
+        tk.must_query("select count(*) from t").check([("2",)])

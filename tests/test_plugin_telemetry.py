@@ -1,0 +1,123 @@
+"""Plugin SPI (audit + authentication) and the local-only telemetry
+collector (reference: plugin/spi.go, plugin/audit.go, telemetry/)."""
+
+import json
+
+import pytest
+
+from tidb_tpu.plugin import (
+    EVENT_STMT, KIND_AUDIT, KIND_AUTHENTICATION, Plugin,
+)
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+class _Recorder(Plugin):
+    name = "recorder"
+    kind = KIND_AUDIT
+
+    def __init__(self):
+        self.events = []
+        self.inited = False
+
+    def on_init(self, domain):
+        self.inited = True
+
+    def on_general_event(self, session, sql, event_class):
+        self.events.append((event_class, sql))
+
+
+class _Gate(Plugin):
+    name = "gate"
+    kind = KIND_AUTHENTICATION
+
+    def __init__(self, allow):
+        self.allow = allow
+
+    def authenticate(self, user, host, auth_data):
+        if user == "gated":
+            return self.allow
+        return None
+
+
+class TestAuditPlugin:
+    def test_general_events_fire_per_statement(self, tk):
+        rec = _Recorder()
+        tk.session.domain.plugins.load(rec)
+        assert rec.inited
+        tk.must_exec("create table t (a int)")
+        tk.must_exec("insert into t values (1)")
+        tk.must_query("select * from t")
+        kinds = [e for e, _s in rec.events]
+        assert kinds.count(EVENT_STMT) >= 3
+        assert any("SELECT" in s.upper() for _e, s in rec.events)
+        tk.session.domain.plugins.unload("recorder")
+        n = len(rec.events)
+        tk.must_exec("insert into t values (2)")
+        assert len(rec.events) == n  # unloaded: no more events
+
+    def test_failing_plugin_never_breaks_statements(self, tk):
+        class Bomb(Plugin):
+            name = "bomb"
+            kind = KIND_AUDIT
+
+            def on_general_event(self, session, sql, event_class):
+                raise RuntimeError("boom")
+        tk.session.domain.plugins.load(Bomb())
+        tk.must_exec("create table t2 (a int)")  # must not raise
+        assert any("boom" in e for e in tk.session.domain.plugins.errors)
+        tk.session.domain.plugins.unload("bomb")
+
+    def test_show_plugins(self, tk):
+        tk.session.domain.plugins.load(_Recorder())
+        rows = {tuple(r[:3]) for r in tk.must_query("show plugins").rows}
+        assert ("recorder", "ACTIVE", "audit") in rows
+        tk.session.domain.plugins.unload("recorder")
+
+    def test_duplicate_load_rejected(self, tk):
+        tk.session.domain.plugins.load(_Recorder())
+        with pytest.raises(ValueError):
+            tk.session.domain.plugins.load(_Recorder())
+        tk.session.domain.plugins.unload("recorder")
+
+
+class TestAuthPlugin:
+    def test_plugin_decides_before_grant_tables(self, tk):
+        reg = tk.session.domain.plugins
+        reg.load(_Gate(allow=False))
+        assert reg.authenticate("gated", "h", b"") is False
+        assert reg.authenticate("other", "h", b"") is None  # falls through
+        reg.unload("gate")
+        reg.load(_Gate(allow=True))
+        assert reg.authenticate("gated", "h", b"") is True
+        reg.unload("gate")
+
+
+class TestTelemetry:
+    def test_disabled_by_default_no_report(self, tk):
+        tel = tk.session.domain.telemetry
+        assert tel.report_once() is None
+        assert tel.history == []
+
+    def test_enabled_collects_locally(self, tk):
+        tk.must_exec("create table t (a int)")
+        tk.must_exec("create view v as select a from t")
+        tk.must_exec("set global tidb_enable_telemetry = ON")
+        tel = tk.session.domain.telemetry
+        payload = tel.report_once()
+        assert payload is not None and len(tel.history) == 1
+        fu = payload["featureUsage"]
+        assert fu["tables"] >= 1 and fu["views"] >= 1
+        tk.must_exec("set global tidb_enable_telemetry = OFF")
+
+    def test_admin_show_telemetry(self, tk):
+        rows = tk.must_query("admin show telemetry").rows
+        assert rows[0][1] == "disabled"
+        payload = json.loads(rows[0][2])
+        assert "featureUsage" in payload and "cluster" in payload

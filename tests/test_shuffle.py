@@ -1,0 +1,79 @@
+"""Host shuffle repartitioner (reference: executor/shuffle.go:77
+ShuffleExec — hash-partitioned worker pipelines for window execution)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+N = 12_000
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table w (dep int, emp int, sal int)")
+    rows = [f"({i % 17}, {i}, {(i * 37) % 1000})" for i in range(N)]
+    for lo in range(0, len(rows), 2000):
+        tk.must_exec("insert into w values " + ",".join(rows[lo:lo + 2000]))
+    return tk
+
+
+QUERY = ("select dep, emp, sal, "
+         "rank() over (partition by dep order by sal desc), "
+         "sum(sal) over (partition by dep), "
+         "row_number() over (partition by dep order by emp) "
+         "from w order by dep, emp")
+
+
+class TestWindowShuffle:
+    def test_parallel_matches_serial(self, tk):
+        tk.must_exec("set tidb_shuffle_min_rows = 0")
+        tk.must_exec("set tidb_window_concurrency = 4")
+        par = tk.must_query(QUERY).rows
+        tk.must_exec("set tidb_window_concurrency = 1")
+        ser = tk.must_query(QUERY).rows
+        assert par == ser
+        assert len(par) == N
+
+    def test_explain_analyze_annotates_workers(self, tk):
+        tk.must_exec("set tidb_shuffle_min_rows = 0")
+        tk.must_exec("set tidb_window_concurrency = 3")
+        txt = "\n".join(" ".join(map(str, r)) for r in
+                        tk.must_query("explain analyze " + QUERY).rows)
+        assert "3 workers" in txt
+
+    def test_small_inputs_skip_shuffle(self, tk):
+        tk.must_exec("set tidb_shuffle_min_rows = 8192")
+        tk.must_exec("set tidb_window_concurrency = 4")
+        tk.must_exec("create table small (dep int, v int)")
+        tk.must_exec("insert into small values (1,1),(1,2),(2,3)")
+        txt = "\n".join(" ".join(map(str, r)) for r in tk.must_query(
+            "explain analyze select dep, sum(v) over (partition by dep) "
+            "from small").rows)
+        assert "workers" not in txt
+
+
+class TestShuffleUnit:
+    def test_rows_reassembled_in_input_order(self, tk):
+        from tidb_tpu.executor.shuffle import shuffle_execute
+        from tidb_tpu.utils.chunk import Chunk, Column
+        from tidb_tpu.sqltypes import FieldType, TYPE_LONGLONG
+        ft = FieldType(tp=TYPE_LONGLONG)
+        data = np.arange(1000, dtype=np.int64)
+        gids = data % 7
+        chunk = Chunk([Column(ft, data, np.zeros(1000, dtype=bool))])
+
+        def double(sub):
+            return Chunk([Column(ft, sub.columns[0].data * 2,
+                                 sub.columns[0].nulls)])
+        out = shuffle_execute(chunk, gids, 4, double)
+        assert (out.columns[0].data == data * 2).all()
+
+    def test_group_never_splits_across_shards(self, tk):
+        from tidb_tpu.executor.shuffle import shard_by_groups
+        gids = np.repeat(np.arange(50, dtype=np.int64), 20)
+        shards = shard_by_groups(gids, 4)
+        for g in range(50):
+            assert len(set(shards[gids == g])) == 1

@@ -447,6 +447,10 @@ class DDLExecutor:
         infos = sess.infoschema()
         db = infos.schema_by_name(db_name)
         tbl = infos.table_by_name(db_name, stmt.table.name)
+        if tbl.cached and any(s[0] != "cache" for s in stmt.specs):
+            # reference: cached tables must ALTER ... NOCACHE before DDL
+            raise TiDBError("'ALTER TABLE' is unsupported on cache tables",
+                            code=ErrCode.OptOnCacheTable)
         for spec in stmt.specs:
             kind = spec[0]
             if kind == "add_column":
@@ -472,6 +476,13 @@ class DDLExecutor:
                 def fn(m, job, _v=spec[1]):
                     m.set_autoid(tbl.id, _v)
                 self._run_job(fn, "auto_increment", schema_id=db.id,
+                              table_id=tbl.id)
+            elif kind == "cache":
+                def fn(m, job, _on=spec[1]):
+                    t = m.get_table(db.id, tbl.id)
+                    t.cached = _on
+                    m.update_table(db.id, t)
+                self._run_job(fn, "alter_cache", schema_id=db.id,
                               table_id=tbl.id)
             elif kind == "add_partition":
                 self._alter_add_partition(db, tbl, spec[1])
@@ -710,7 +721,18 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
                                     con.columns, con.kind == "unique", None)
             tbl.indexes.append(idx)
         elif con.kind == "foreign":
-            pass  # parsed, not enforced (reference default: FK not enforced)
+            # stored + rendered, not enforced — the v5.x reference default
+            # (ddl/foreign_key.go stores FKInfo; checks landed later)
+            ref = con.ref or {}
+            rt = ref.get("table")
+            tbl.foreign_keys.append({
+                "name": con.name or f"fk_{len(tbl.foreign_keys) + 1}",
+                "cols": [c for c, _l in con.columns],
+                "ref_table": rt.name if rt is not None else "",
+                "ref_cols": list(ref.get("columns", [])),
+                "on_delete": ref.get("on_delete", ""),
+                "on_update": ref.get("on_update", ""),
+            })
     if "auto_increment" in stmt.options:
         try:
             tbl.auto_increment = int(stmt.options["auto_increment"])

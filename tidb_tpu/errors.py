@@ -83,6 +83,10 @@ class ErrCode:
     DupFieldName = 1060
     SequenceRunOut = 4135
     WrongObjectSequence = 1347
+    TableLocked = 8020
+    TableNotLocked = 1100
+    TableNotLockedForWrite = 1099
+    OptOnCacheTable = 8242
     PartitionFunctionIsNotAllowed = 1564
     UnknownPartition = 1735
     OnlyOnRangeListPartition = 1512

@@ -862,9 +862,31 @@ class WindowExec(QueryExecutor):
             return Chunk(cols)
         if p.partition_exprs:
             pk = [_collate_eval(e, chunk) for e in p.partition_exprs]
-            gids, _ng, _fi = host.group_ids(pk)
-        else:
-            gids = np.zeros(n, dtype=np.int64)
+            gids, ng, _fi = host.group_ids(pk)
+            # ShuffleExec repartitioning (reference: executor/shuffle.go:77):
+            # hash partition groups onto worker shards; each shard runs the
+            # full sort+compute pipeline independently
+            try:
+                workers = int(self.ctx.get_sysvar("tidb_window_concurrency"))
+                min_rows = int(self.ctx.get_sysvar("tidb_shuffle_min_rows"))
+            except Exception:
+                workers, min_rows = 1, 1 << 63
+            if workers > 1 and n >= min_rows and ng >= workers:
+                from .shuffle import shuffle_execute
+                self.annotate(shuffle=f"{workers} workers")
+                return shuffle_execute(chunk, gids, workers, self._compute)
+            return self._compute(chunk, gids)
+        return self._compute(chunk)
+
+    def _compute(self, chunk: Chunk, gids=None) -> Chunk:
+        p = self.plan
+        n = chunk.num_rows
+        if gids is None:
+            if p.partition_exprs:
+                pk = [_collate_eval(e, chunk) for e in p.partition_exprs]
+                gids, _ng, _fi = host.group_ids(pk)
+            else:
+                gids = np.zeros(n, dtype=np.int64)
         order_keys = [(_collate_eval(e, chunk), d) for e, d in p.order_by]
         keys = [(gids, np.zeros(n, dtype=bool))] + [k for k, _ in order_keys]
         descs = [False] + [d for _, d in order_keys]

@@ -168,6 +168,11 @@ class TableInfo:
     # {"start","increment","min","max","cache","cycle"} or None
     sequence: dict = None
     temporary: bool = False   # session-local table (table/temptable role)
+    # FK metadata (reference: model.go FKInfo — stored + shown, not
+    # enforced, matching the v5.x reference default):
+    # [{"name","cols","ref_table","ref_cols","on_delete","on_update"}]
+    foreign_keys: list = field(default_factory=list)
+    cached: bool = False      # ALTER TABLE ... CACHE (table/cache.go role)
 
     @property
     def is_view(self):
@@ -211,6 +216,8 @@ class TableInfo:
             "view": self.view,
             "sequence": self.sequence,
             "temporary": self.temporary,
+            "foreign_keys": self.foreign_keys,
+            "cached": self.cached,
         }
 
     @classmethod
@@ -228,6 +235,8 @@ class TableInfo:
             view=d.get("view"),
             sequence=d.get("sequence"),
             temporary=d.get("temporary", False),
+            foreign_keys=d.get("foreign_keys", []),
+            cached=d.get("cached", False),
         )
 
 

@@ -680,6 +680,22 @@ class DropBindingStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class LockTablesStmt(StmtNode):
+    """LOCK TABLES t READ|WRITE, ... (reference: ddl/table_lock.go)."""
+    items: list = field(default_factory=list)  # [(TableName, "read"|"write")]
+
+    def restore(self):
+        return "LOCK TABLES " + ", ".join(
+            f"{tn.restore()} {m.upper()}" for tn, m in self.items)
+
+
+@dataclass(repr=False)
+class UnlockTablesStmt(StmtNode):
+    def restore(self):
+        return "UNLOCK TABLES"
+
+
+@dataclass(repr=False)
 class CreateSequenceStmt(StmtNode):
     """reference: parser/ast/ddl.go CreateSequenceStmt + ddl/sequence.go."""
     name: TableName = None

@@ -156,6 +156,28 @@ class Parser:
             self.pos += 1
             self._accept_kw("table")
             return ast.TruncateTableStmt(table=self._parse_table_name())
+        if kw == "lock":
+            self.pos += 1
+            if not (self._accept_kw("tables") or self._accept_kw("table")):
+                raise ParseError("expected TABLES after LOCK")
+            items = []
+            while True:
+                tn = self._parse_table_name()
+                if self._accept_kw("write"):
+                    mode = "write"
+                else:
+                    self._expect_kw("read")
+                    self._accept_kw("local")
+                    mode = "read"
+                items.append((tn, mode))
+                if not self._accept_op(","):
+                    break
+            return ast.LockTablesStmt(items=items)
+        if kw == "unlock":
+            self.pos += 1
+            if not (self._accept_kw("tables") or self._accept_kw("table")):
+                raise ParseError("expected TABLES after UNLOCK")
+            return ast.UnlockTablesStmt()
         if kw == "rename":
             self.pos += 1
             self._expect_kw("table")
@@ -1686,14 +1708,26 @@ class Parser:
             self._expect_kw("references")
             ref_table = self._parse_table_name()
             self._expect_op("(")
-            self._ident()
+            ref_cols = [self._ident()]
             while self._accept_op(","):
-                self._ident()
+                ref_cols.append(self._ident())
             self._expect_op(")")
+            actions = {}
             while self._accept_kw("on"):
-                self.pos += 1  # update|delete
-                self.pos += 1  # action
-            return ast.Constraint(kind="foreign", name=name, columns=cols, ref=ref_table)
+                which = self._ident().lower()  # update | delete
+                if self._accept_kw("set"):
+                    act = "set " + self._ident().lower()  # null | default
+                elif self._accept_kw("no"):
+                    self._expect_kw("action")
+                    act = "no action"
+                else:
+                    act = self._ident().lower()  # cascade | restrict
+                actions[which] = act
+            return ast.Constraint(
+                kind="foreign", name=name, columns=cols,
+                ref={"table": ref_table, "columns": ref_cols,
+                     "on_delete": actions.get("delete", ""),
+                     "on_update": actions.get("update", "")})
         raise ParseError(f"unsupported constraint near {self._near()}")
 
     def _parse_data_type(self) -> FieldType:
@@ -1960,6 +1994,10 @@ class Parser:
                     self._accept_kw("to")
                     self._accept_kw("as")
                     stmt.specs.append(("rename", self._parse_table_name()))
+            elif self._accept_kw("cache"):
+                stmt.specs.append(("cache", True))
+            elif self._accept_kw("nocache"):
+                stmt.specs.append(("cache", False))
             elif self._accept_kw("truncate"):
                 self._expect_kw("partition")
                 names = [self._ident()]
@@ -2066,6 +2104,8 @@ class Parser:
         stmt = ast.ShowStmt(full=full, global_scope=glob)
         if self._accept_kw("bindings"):
             stmt.kind = "bindings"
+        elif self._accept_kw("plugins"):
+            stmt.kind = "plugins"
         elif self._accept_kw("databases") or self._accept_kw("schemas"):
             stmt.kind = "databases"
         elif self._accept_kw("tables"):
@@ -2158,6 +2198,8 @@ class Parser:
                 tables.append(self._parse_table_name())
             return ast.AdminStmt(kind="check_table", tables=tables)
         if self._accept_kw("show"):
+            if self._accept_kw("telemetry"):
+                return ast.AdminStmt(kind="show_telemetry")
             self._expect_kw("ddl")
             if self._accept_kw("jobs"):
                 return ast.AdminStmt(kind="show_ddl_jobs")

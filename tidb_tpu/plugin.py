@@ -1,0 +1,124 @@
+"""Plugin framework: audit + authentication SPI (reference: plugin/spi.go:32
+Manifest, :66 sub-manifests; plugin/audit.go:78 AuditManifest; the audit hook
+fires from connection dispatch, server/conn.go:1094).
+
+Plugins here are Python objects registered on the domain (the reference
+loads .so manifests; the SPI shape — kind, version, lifecycle callbacks,
+event hooks — is the same). Hooks must never break statement execution:
+failures are recorded, not raised.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+KIND_AUDIT = "audit"
+KIND_AUTHENTICATION = "authentication"
+
+# audit event classes (reference: plugin/audit.go GeneralEvent classes)
+EVENT_CONNECT = "Connect"
+EVENT_DISCONNECT = "Disconnect"
+EVENT_STMT = "Statement"
+
+
+class Plugin:
+    """SPI base (reference: plugin.Manifest). Subclass and override the
+    hooks for the chosen kind."""
+
+    name = "plugin"
+    kind = KIND_AUDIT
+    version = 1
+
+    def on_init(self, domain):
+        pass
+
+    def on_shutdown(self, domain):
+        pass
+
+    # -- audit sub-manifest --------------------------------------------------
+
+    def on_general_event(self, session, sql: str, event_class: str):
+        pass
+
+    def on_connection_event(self, conn_info: dict, event: str):
+        pass
+
+    # -- authentication sub-manifest ----------------------------------------
+
+    def authenticate(self, user: str, host: str, auth_data) -> bool | None:
+        """Return True/False to decide, None to fall through to the grant
+        tables (reference: AuthenticationManifest.AuthenticateUser)."""
+        return None
+
+
+class PluginRegistry:
+    """Domain-level plugin set (reference: plugin.Load + plugin.Audit
+    iteration helpers)."""
+
+    _ERRORS_CAP = 64
+
+    def __init__(self, domain):
+        self.domain = domain
+        self._lock = threading.Lock()
+        self._plugins: dict[str, Plugin] = {}
+        self.errors: list[str] = []
+
+    def _record_error(self, msg: str):
+        with self._lock:
+            self.errors.append(msg)
+            del self.errors[:-self._ERRORS_CAP]  # bounded
+
+    def load(self, plugin: Plugin):
+        with self._lock:
+            if plugin.name in self._plugins:
+                raise ValueError(f"plugin '{plugin.name}' already loaded")
+            plugin.on_init(self.domain)
+            self._plugins[plugin.name] = plugin
+
+    def unload(self, name: str) -> bool:
+        with self._lock:
+            p = self._plugins.pop(name, None)
+        if p is None:
+            return False
+        try:
+            p.on_shutdown(self.domain)
+        except Exception as e:
+            self._record_error(f"{name}.on_shutdown: {e}")
+        return True
+
+    def list(self):
+        with self._lock:
+            return list(self._plugins.values())
+
+    def _each(self, kind):
+        with self._lock:
+            return [p for p in self._plugins.values() if p.kind == kind]
+
+    # -- hook fan-out (failures never break the statement) -------------------
+
+    def audit_general(self, session, sql: str, event_class: str):
+        for p in self._each(KIND_AUDIT):
+            try:
+                p.on_general_event(session, sql, event_class)
+            except Exception as e:
+                self._record_error(f"{p.name}.on_general_event: {e}")
+
+    def audit_connection(self, conn_info: dict, event: str):
+        for p in self._each(KIND_AUDIT):
+            try:
+                p.on_connection_event(conn_info, event)
+            except Exception as e:
+                self._record_error(f"{p.name}.on_connection_event: {e}")
+
+    def authenticate(self, user: str, host: str, auth_data) -> bool | None:
+        """First definitive answer wins; None = no auth plugin decided."""
+        for p in self._each(KIND_AUTHENTICATION):
+            try:
+                r = p.authenticate(user, host, auth_data)
+            except Exception as e:
+                self._record_error(f"{p.name}.authenticate: {e}")
+                continue
+            if r is not None:
+                return bool(r)
+        return None

@@ -88,13 +88,29 @@ class MySQLServer:
             peer = sock.getpeername()[0]
         except OSError:
             peer = "%"
-        matched_host = self._check_auth(user, auth, salt, peer)
+        # authentication plugins decide first (reference: plugin auth
+        # sub-manifest consulted before the grant tables)
+        plug = getattr(self.domain, "plugins", None)
+        decided = plug.authenticate(user, peer, auth) if plug else None
+        if decided is False:
+            matched_host = None
+        elif decided is True:
+            matched_host = "%"
+        else:
+            matched_host = self._check_auth(user, auth, salt, peer)
         if matched_host is None:
+            if plug:
+                plug.audit_connection({"user": user, "host": peer},
+                                      "ConnectionReject")
             io.write_packet(P.build_err(
                 1045, f"Access denied for user '{user}'", b"28000"))
             return
         session = new_session(self.domain)
         session.user = f"{user}@{matched_host}"
+        if plug:
+            plug.audit_connection(
+                {"user": user, "host": peer, "conn_id": session.conn_id},
+                "Connect")
         if db:
             try:
                 session.execute(f"use `{db}`")
@@ -108,6 +124,10 @@ class MySQLServer:
             self._command_loop(io, session)
         finally:
             self.connections.pop(conn_id, None)
+            if plug:
+                plug.audit_connection(
+                    {"user": user, "host": peer,
+                     "conn_id": session.conn_id}, "Disconnect")
             session.close()
 
     def _parse_handshake_response(self, buf: bytes):

@@ -56,6 +56,14 @@ class Domain:
         self.reload_schema()
         from ..bindinfo import BindHandle
         self.bind_handle = BindHandle(self)    # global plan bindings
+        from ..plugin import PluginRegistry
+        self.plugins = PluginRegistry(self)    # audit/auth plugin SPI
+        from ..telemetry import Telemetry
+        self.telemetry = Telemetry(self)       # local-only usage collector
+        # LOCK TABLES state (reference: ddl/table_lock.go, held in-memory
+        # per domain): (db, table) -> {"mode": read|write, conn_id: mode}
+        self.table_locks: dict[tuple, dict] = {}
+        self.table_locks_mu = threading.Lock()
 
     def reload_schema(self):
         """reference: domain.Reload — full load on version change."""
@@ -270,12 +278,113 @@ class Session:
                 self.drop_temp_table(key)
             except Exception:
                 pass
+        try:
+            self.unlock_tables()
+        except Exception:
+            pass
         self.domain.sessions.pop(self.conn_id, None)
 
     def drop_temp_table(self, key):
         info = self.temp_tables.pop(key, None)
         if info is not None:
             self.ddl._delete_table_data(info)
+
+    # -- LOCK TABLES (reference: ddl/table_lock.go + executor lock checks) --
+
+    def lock_tables(self, items):
+        """items: [(db, name, mode)]. All-or-nothing acquisition; an
+        existing foreign WRITE lock (or a foreign READ when WRITE is
+        wanted) rejects with 'Table is locked' (reference error 8020)."""
+        dom = self.domain
+        with dom.table_locks_mu:
+            for db, name, mode in items:
+                holders = dom.table_locks.get((db, name), {})
+                for cid, m in holders.items():
+                    if cid == self.conn_id:
+                        continue
+                    if m == "write" or mode == "write":
+                        raise TiDBError(
+                            f"Table '{name}' is locked by another session",
+                            code=ErrCode.TableLocked)
+            self._release_locks_locked()
+            for db, name, mode in items:
+                dom.table_locks.setdefault((db, name), {})[
+                    self.conn_id] = mode
+
+    def unlock_tables(self):
+        with self.domain.table_locks_mu:
+            self._release_locks_locked()
+
+    def _release_locks_locked(self):
+        dom = self.domain
+        for key in list(dom.table_locks):
+            dom.table_locks[key].pop(self.conn_id, None)
+            if not dom.table_locks[key]:
+                del dom.table_locks[key]
+
+    def _held_locks(self):
+        with self.domain.table_locks_mu:
+            return {k: v[self.conn_id]
+                    for k, v in self.domain.table_locks.items()
+                    if self.conn_id in v}
+
+    def check_table_locks(self, stmt):
+        """Statement-level LOCK TABLES enforcement (reference:
+        executor/adapter.go checkLockTables + MySQL semantics): a session
+        holding locks may only touch locked tables (writes need WRITE);
+        other sessions are blocked from WRITE-locked tables entirely and
+        from writing READ-locked ones."""
+        if not self.domain.table_locks:
+            return
+        from ..priv_check import _collect_tables
+        # only the DML/DDL TARGET is a write; source tables of
+        # INSERT...SELECT / subqueries are reads (MySQL semantics)
+        write_keys = set()
+        targets = []
+        if isinstance(stmt, (ast.InsertStmt, ast.TruncateTableStmt)):
+            targets = [stmt.table]
+        elif isinstance(stmt, (ast.UpdateStmt, ast.DeleteStmt)):
+            if isinstance(stmt.table, ast.TableName):
+                targets = [stmt.table]
+        elif isinstance(stmt, ast.DropTableStmt):
+            targets = list(stmt.tables)
+        elif isinstance(stmt, (ast.AlterTableStmt, ast.CreateIndexStmt,
+                               ast.DropIndexStmt)):
+            targets = [stmt.table]
+        elif isinstance(stmt, ast.RenameTableStmt):
+            targets = [old for old, _new in stmt.pairs]
+        for tn in targets:
+            write_keys.add(((tn.schema or self.current_db()).lower(),
+                            tn.name.lower()))
+        tabs = []
+        _collect_tables(stmt, tabs)
+        held = self._held_locks()
+        infos = self.infoschema()
+        for tn in tabs:
+            db = (tn.schema or self.current_db()).lower()
+            name = tn.name.lower()
+            if not db or not infos.has_table(db, tn.name):
+                continue
+            key = (db, name)
+            write = key in write_keys
+            with self.domain.table_locks_mu:
+                holders = dict(self.domain.table_locks.get(key, {}))
+            mine = holders.pop(self.conn_id, None)
+            foreign_write = any(m == "write" for m in holders.values())
+            foreign_read = bool(holders)
+            if foreign_write or (write and foreign_read):
+                raise TiDBError(f"Table '{tn.name}' is locked by another "
+                                "session", code=ErrCode.TableLocked)
+            if held:
+                if mine is None:
+                    raise TiDBError(
+                        f"Table '{tn.name}' was not locked with LOCK "
+                        "TABLES", code=ErrCode.TableNotLocked)
+                if write and mine != "write":
+                    raise TiDBError(
+                        f"Table '{tn.name}' was locked with a READ lock "
+                        "and can't be updated",
+                        code=ErrCode.TableNotLockedForWrite)
 
     # -- variables ----------------------------------------------------------
 
@@ -641,6 +750,11 @@ class Session:
         self.mem_tracker = MemTracker(f"conn{self.conn_id}", quota)
         self._expr_ctx.cte_results = {}  # recursive-CTE cache, per stmt
         res = None
+        # audit plugins observe every statement (reference: the audit hook
+        # in connection dispatch, server/conn.go:1094)
+        if self.domain.plugins.list():
+            from ..plugin import EVENT_STMT
+            self.domain.plugins.audit_general(self, sql, EVENT_STMT)
         try:
             res = self._dispatch(stmt)
             return res
@@ -683,6 +797,25 @@ class Session:
                   ast.RevokeStmt: priv_exec.revoke}[type(stmt)]
             fn(self, stmt)
             return Result()
+        if isinstance(stmt, (ast.LockTablesStmt, ast.UnlockTablesStmt)):
+            self._implicit_commit()  # LOCK/UNLOCK TABLES commit (MySQL)
+            if isinstance(stmt, ast.UnlockTablesStmt):
+                self.unlock_tables()
+                return Result()
+            items = []
+            infos = self.infoschema()
+            for tn, mode in stmt.items:
+                db = tn.schema or self.current_db()
+                infos.table_by_name(db, tn.name)  # must exist
+                items.append((db.lower(), tn.name.lower(), mode))
+            self.lock_tables(items)
+            return Result()
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt, ast.InsertStmt,
+                             ast.UpdateStmt, ast.DeleteStmt,
+                             ast.TruncateTableStmt, ast.DropTableStmt,
+                             ast.AlterTableStmt, ast.CreateIndexStmt,
+                             ast.DropIndexStmt, ast.RenameTableStmt)):
+            self.check_table_locks(stmt)
         if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
             if (getattr(stmt, "for_update", False)
                     and (self.explicit_txn or not self.autocommit())):
@@ -1191,6 +1324,17 @@ class Session:
         return Result()
 
     def _exec_admin(self, stmt: ast.AdminStmt) -> Result:
+        if stmt.kind == "show_telemetry":
+            # what WOULD be reported; collection never egresses (reference:
+            # ADMIN SHOW TELEMETRY, executor/telemetry.go)
+            from .. import telemetry as _tel
+            ft_s = FieldType(tp=TYPE_VARCHAR)
+            payload = self.domain.telemetry.preview()
+            status = b"enabled" if _tel.enabled(self.domain) else b"disabled"
+            return Result(names=["TRACKING_ID", "LAST_STATUS", "DATA_PREVIEW"],
+                          chunk=Chunk.from_rows(
+                              [ft_s, ft_s, ft_s],
+                              [(b"local-only", status, payload.encode())]))
         if stmt.kind == "show_ddl_jobs":
             txn = self.store.begin()
             try:

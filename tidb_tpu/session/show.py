@@ -205,6 +205,13 @@ def exec_show(session, stmt: ast.ShowStmt):
                              "Status", "Create_time"],
                       chunk=Chunk.from_rows([_S] * 5, rows))
 
+    if stmt.kind == "plugins":
+        rows = [(p.name.encode(), b"ACTIVE", p.kind.encode(),
+                 str(p.version).encode(), b"")
+                for p in session.domain.plugins.list()]
+        return Result(names=["Name", "Status", "Type", "Library", "License"],
+                      chunk=Chunk.from_rows([_S] * 5, rows))
+
     if stmt.kind == "table_status":
         db = stmt.db or session.current_db()
         infos = session.infoschema()
@@ -253,6 +260,16 @@ def render_create_table(info) -> str:
             lines.append(f"  UNIQUE KEY `{idx.name}` ({cols})")
         else:
             lines.append(f"  KEY `{idx.name}` ({cols})")
+    for fk in info.foreign_keys:
+        cols = ", ".join(f"`{c}`" for c in fk["cols"])
+        rcols = ", ".join(f"`{c}`" for c in fk["ref_cols"])
+        l = (f"  CONSTRAINT `{fk['name']}` FOREIGN KEY ({cols}) "
+             f"REFERENCES `{fk['ref_table']}` ({rcols})")
+        if fk.get("on_delete"):
+            l += f" ON DELETE {fk['on_delete'].upper()}"
+        if fk.get("on_update"):
+            l += f" ON UPDATE {fk['on_update'].upper()}"
+        lines.append(l)
     body = ",\n".join(lines)
     s = (f"CREATE TABLE `{info.name}` (\n{body}\n) "
          "ENGINE=tpu-htap DEFAULT CHARSET=utf8mb4")

@@ -116,6 +116,9 @@ for _v in [
     SysVar("tidb_gc_life_time", SCOPE_GLOBAL, "10m0s"),
     SysVar("tidb_gc_run_interval", SCOPE_GLOBAL, "10m0s"),
     SysVar("tidb_gc_enable", SCOPE_GLOBAL, "ON", "bool"),
+    # telemetry is local-only and OFF by default (reference default ON,
+    # but this build never egresses)
+    SysVar("tidb_enable_telemetry", SCOPE_GLOBAL, "OFF", "bool"),
     # -- MySQL-compat breadth (reference: sysvar.go registers 248;
     #    clients and ORMs read/SET these at connect time) ---------------
     SysVar("auto_increment_increment", SCOPE_BOTH, "1", "int", 1, 65535),
@@ -197,6 +200,9 @@ for _v in [
     SysVar("tidb_force_priority", SCOPE_SESSION, "NO_PRIORITY"),
     SysVar("tidb_general_log", SCOPE_GLOBAL, "OFF", "bool"),
     SysVar("tidb_hash_join_concurrency", SCOPE_BOTH, "5", "int", 1),
+    SysVar("tidb_window_concurrency", SCOPE_BOTH, "4", "int", 1),
+    # rows below which ShuffleExec-style host parallelism is skipped
+    SysVar("tidb_shuffle_min_rows", SCOPE_BOTH, "8192", "int", 0),
     SysVar("tidb_hashagg_final_concurrency", SCOPE_BOTH, "5", "int", 1),
     SysVar("tidb_hashagg_partial_concurrency", SCOPE_BOTH, "5", "int", 1),
     SysVar("tidb_index_join_batch_size", SCOPE_BOTH, "25000", "int", 1),

@@ -1,0 +1,80 @@
+"""Telemetry collector (reference: telemetry/telemetry.go:46,128 + data.go).
+
+The reference reports cluster/hardware/feature-usage payloads weekly to an
+external endpoint when enabled. Here the collector builds the SAME payload
+shape but NEVER leaves the process: reporting is disabled by default
+(tidb_enable_telemetry = OFF) and "reporting" appends to an in-memory
+history the operator can inspect via ADMIN SHOW TELEMETRY — the privacy
+default the task environment requires (zero egress)."""
+
+from __future__ import annotations
+
+import json
+import platform
+import threading
+import time
+
+
+def enabled(domain) -> bool:
+    v = str(domain.global_vars.get("tidb_enable_telemetry", "OFF"))
+    return v.upper() in ("ON", "1", "TRUE")
+
+
+def collect(domain) -> dict:
+    """Build the usage payload (reference: telemetry/data.go
+    generateTelemetryData: cluster info, hardware, feature usage)."""
+    infos = domain.infoschema()
+    n_tables = n_views = n_sequences = n_partitioned = 0
+    for db in infos.schema_names():
+        for t in infos.tables_in_schema(db):
+            if t.is_view:
+                n_views += 1
+            elif t.is_sequence:
+                n_sequences += 1
+            else:
+                n_tables += 1
+                if t.partition is not None:
+                    n_partitioned += 1
+    counters = dict(getattr(domain.observe, "counters", {}))
+    return {
+        "trackingID": f"tpu-htap-{id(domain) & 0xFFFF:04x}",
+        "reportTimestamp": int(time.time()),
+        "cluster": {
+            "storeBackend": domain.store.backend,
+            "schemaVersion": infos.version,
+        },
+        "hardware": {
+            "os": platform.system().lower(),
+            "arch": platform.machine(),
+        },
+        "featureUsage": {
+            "tables": n_tables, "views": n_views,
+            "sequences": n_sequences, "partitionedTables": n_partitioned,
+            "bindings": len(domain.bind_handle.list()),
+            "counters": counters,
+        },
+    }
+
+
+class Telemetry:
+    """Domain-held collector with the weekly-loop shape (reference:
+    domain/domain.go telemetry loop); report() is a local append."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self._lock = threading.Lock()
+        self.history: list[dict] = []
+
+    def report_once(self) -> dict | None:
+        if not enabled(self.domain):
+            return None
+        payload = collect(self.domain)
+        with self._lock:
+            self.history.append(payload)
+            del self.history[:-16]  # bounded
+        return payload
+
+    def preview(self) -> str:
+        """What WOULD be reported (ADMIN SHOW TELEMETRY), regardless of
+        the enable switch — the reference shows the payload on demand."""
+        return json.dumps(collect(self.domain), indent=1, sort_keys=True)
