@@ -80,6 +80,27 @@ class TestAuditPlugin:
         assert ("recorder", "ACTIVE", "audit") in rows
         tk.session.domain.plugins.unload("recorder")
 
+    def test_on_init_may_execute_sql(self, tk):
+        """Regression: on_init runs outside the registry lock, so a plugin
+        that bootstraps its own table must not deadlock."""
+        domain = tk.session.domain
+
+        class Boot(Plugin):
+            name = "boot"
+            kind = KIND_AUDIT
+
+            def on_init(self, dom):
+                from tidb_tpu.session import new_session
+                s = new_session(dom)
+                try:
+                    s.execute("use test")
+                    s.execute("create table if not exists audit_log (a int)")
+                finally:
+                    s.close()
+        domain.plugins.load(Boot())
+        tk.must_query("select count(*) from audit_log").check([("0",)])
+        domain.plugins.unload("boot")
+
     def test_duplicate_load_rejected(self, tk):
         tk.session.domain.plugins.load(_Recorder())
         with pytest.raises(ValueError):

@@ -1,0 +1,158 @@
+"""MODIFY/CHANGE COLUMN, AUTO_RANDOM, information_schema breadth
+(reference: ddl/column.go onModifyColumn, meta/autoid AUTO_RANDOM,
+infoschema/tables.go)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+class TestModifyColumn:
+    def test_widen_and_index_rebuild(self, tk):
+        tk.must_exec("create table t (id int primary key, v int, key iv (v))")
+        tk.must_exec("insert into t values (1, 100), (2, 200)")
+        tk.must_exec("alter table t modify column v bigint")
+        tk.must_query("select id from t where v = 200").check([("2",)])
+
+    def test_type_class_conversions(self, tk):
+        tk.must_exec("create table t (id int primary key, v int)")
+        tk.must_exec("insert into t values (1, 100)")
+        tk.must_exec("alter table t modify column v varchar(20)")
+        tk.must_query("select concat(v, '!') from t").check([("100!",)])
+        tk.must_exec("alter table t modify column v int")
+        tk.must_query("select v + 1 from t").check([("101",)])
+        tk.must_exec("alter table t modify column v decimal(10,2)")
+        tk.must_query("select v from t").check([("100.00",)])
+
+    def test_change_renames_and_retypes(self, tk):
+        tk.must_exec("create table t (id int primary key, s varchar(5))")
+        tk.must_exec("insert into t values (1, 'a')")
+        tk.must_exec("alter table t change column s name varchar(30)")
+        tk.must_query("select name from t").check([("a",)])
+        e = tk.exec_error("select s from t")
+        assert "Unknown column" in str(e)
+
+    def test_rename_follows_into_indexes_and_fks(self, tk):
+        """Regression: CHANGE COLUMN must update IndexColumn/FK names."""
+        tk.must_exec("create table parent (id int primary key)")
+        tk.must_exec("create table t (a int, b varchar(10), key ia (a), "
+                     "foreign key (a) references parent (id))")
+        tk.must_exec("insert into t values (1, 'x')")
+        tk.must_exec("alter table t change column a a2 bigint")
+        ddl = tk.must_query("show create table t").rows[0][1]
+        assert "KEY `ia` (`a2`)" in ddl
+        assert "FOREIGN KEY (`a2`)" in ddl
+        # the covering-index guard now sees the renamed column
+        e = tk.exec_error("alter table t drop column a2")
+        assert "covered by index" in str(e)
+        tk.must_query("select b from t where a2 = 1").check([("x",)])
+
+    def test_not_null_reorg_rejects_existing_nulls(self, tk):
+        tk.must_exec("create table t (a int)")
+        tk.must_exec("insert into t values (null), (1)")
+        e = tk.exec_error("alter table t modify column a int not null")
+        assert "NULL" in str(e)
+        # schema unchanged on failure
+        tk.must_query("select count(*) from t where a is null").check(
+            [("1",)])
+
+    def test_guards(self, tk):
+        tk.must_exec("create table t (id int primary key, v int)")
+        e = tk.exec_error("alter table t modify column id varchar(10)")
+        assert "integer" in str(e)
+        tk.must_exec("create table p (a int, b int) "
+                     "partition by hash (a) partitions 2")
+        e = tk.exec_error("alter table p modify column a bigint")
+        assert "partitioning" in str(e)
+
+    def test_partitioned_data_reorg(self, tk):
+        tk.must_exec("create table p (a int, b int) "
+                     "partition by hash (a) partitions 2")
+        tk.must_exec("insert into p values (1,10),(2,20),(3,30)")
+        tk.must_exec("alter table p modify column b varchar(8)")
+        tk.must_query("select b from p where a = 2").check([("20",)])
+        tk.must_query("select count(*) from p").check([("3",)])
+
+
+class TestAutoRandom:
+    def test_shard_bits_and_increment(self, tk):
+        tk.must_exec("create table ar (id bigint primary key auto_random(5), "
+                     "v int)")
+        tk.must_exec("insert into ar (v) values (1), (2), (3)")
+        ids = sorted(int(r[0]) for r in tk.must_query(
+            "select id from ar").rows)
+        assert len(set(ids)) == 3 and all(i > 0 for i in ids)
+        incr = sorted(i & ((1 << 58) - 1) for i in ids)
+        assert incr == [1, 2, 3]
+        ddl = tk.must_query("show create table ar").rows[0][1]
+        assert "AUTO_RANDOM(5)" in ddl
+
+    def test_requires_integer_primary_key(self, tk):
+        e = tk.exec_error("create table bad (id int, v bigint auto_random)")
+        assert "primary key" in str(e)
+
+    def test_table_level_primary_key_accepted(self, tk):
+        tk.must_exec("create table ar (id bigint auto_random(5), v int, "
+                     "primary key (id))")
+        tk.must_exec("insert into ar (v) values (1)")
+        assert int(tk.must_query("select id from ar").rows[0][0]) > 0
+
+    def test_explicit_value_rebases_increment_part(self, tk):
+        tk.must_exec("create table ar (id bigint primary key auto_random, "
+                     "v int)")
+        tk.must_exec("insert into ar values (100, 1)")
+        tk.must_exec("insert into ar (v) values (2)")
+        ids = [int(r[0]) for r in tk.must_query(
+            "select id from ar order by v").rows]
+        assert (ids[1] & ((1 << 58) - 1)) >= 101
+
+
+class TestInfoSchemaBreadth:
+    def test_partitions_views_sequences(self, tk):
+        tk.must_exec("create table p (a int) partition by range (a) "
+                     "(partition p0 values less than (10), "
+                     "partition p1 values less than maxvalue)")
+        tk.must_exec("create view vv as select a from p")
+        tk.must_exec("create sequence sq start with 3")
+        tk.must_query(
+            "select partition_name, partition_method from "
+            "information_schema.partitions where table_name = 'p' "
+            "order by partition_ordinal_position").check(
+            [("p0", "RANGE"), ("p1", "RANGE")])
+        tk.must_query("select table_name, view_definition from "
+                      "information_schema.views").check(
+            [("vv", "SELECT `a` FROM `p`")])
+        tk.must_query("select sequence_name, start, cycle from "
+                      "information_schema.sequences").check(
+            [("sq", "3", "0")])
+        tk.must_query("select table_type from information_schema.tables "
+                      "where table_name = 'vv'").check([("VIEW",)])
+
+    def test_constraints_tables(self, tk):
+        tk.must_exec("create table parent (id int primary key)")
+        tk.must_exec("create table c (a int, unique key ua (a), "
+                     "constraint myfk foreign key (a) references "
+                     "parent (id) on delete cascade)")
+        got = {tuple(r) for r in tk.must_query(
+            "select constraint_name, constraint_type from "
+            "information_schema.table_constraints "
+            "where table_name = 'c'").rows}
+        assert ("ua", "UNIQUE") in got and ("myfk", "FOREIGN KEY") in got
+        tk.must_query(
+            "select constraint_name, referenced_table_name, delete_rule "
+            "from information_schema.referential_constraints").check(
+            [("myfk", "parent", "CASCADE")])
+
+    def test_show_create_view_and_sequence_syntax(self, tk):
+        tk.must_exec("create table t (a int)")
+        tk.must_exec("create view vv as select a from t")
+        tk.must_exec("create sequence sq")
+        assert tk.must_query("show create view vv").rows
+        assert tk.must_query("show create sequence sq").rows

@@ -19,7 +19,7 @@ from .model import (
 from .parser import ast
 from .sqltypes import FLAG_PRI_KEY, FLAG_UNSIGNED, TYPE_LONGLONG
 from . import tablecodec
-from .table import cast_value
+from .table import cast_value, convert_internal
 
 
 class DDLExecutor:
@@ -467,9 +467,10 @@ class DDLExecutor:
             elif kind == "drop_index":
                 self.drop_index(ast.DropIndexStmt(index_name=spec[1],
                                                   table=stmt.table))
-            elif kind == "modify_column" or kind == "change_column":
-                raise TiDBError("ALTER TABLE MODIFY/CHANGE COLUMN not supported yet",
-                                code=ErrCode.UnsupportedDDL)
+            elif kind == "modify_column":
+                self._alter_modify_column(db, tbl, spec[1], None)
+            elif kind == "change_column":
+                self._alter_modify_column(db, tbl, spec[2], spec[1])
             elif kind == "rename":
                 self._alter_rename(db, tbl, spec[1])
             elif kind == "auto_increment":
@@ -545,6 +546,110 @@ class DDLExecutor:
             m.update_table(db.id, t)
         self._run_job(fn, "add_column", schema_id=db.id, table_id=tbl.id)
         self.session.store.mvcc.bump_table_version(tbl.id)
+
+    def _alter_modify_column(self, db, tbl, coldef, old_name):
+        """MODIFY/CHANGE COLUMN with a synchronous data reorg: every stored
+        row's value converts into the new representation, and indexes
+        covering the column are rebuilt (reference: ddl/column.go
+        onModifyColumn — the write-reorg for lossy changes)."""
+        from .expression.core import phys_kind
+        sess = self.session
+        target = old_name or coldef.name
+        col = tbl.find_column(target)
+        if col is None:
+            raise TiDBError(f"Unknown column '{target}' in '{tbl.name}'",
+                            code=ErrCode.BadField)
+        new_name = coldef.name
+        if (new_name.lower() != col.name.lower()
+                and tbl.find_column(new_name) is not None):
+            raise TiDBError(f"Duplicate column name '{new_name}'",
+                            code=ErrCode.WrongFieldSpec)
+        new_ft = coldef.ftype
+        if tbl.pk_is_handle and col.id == tbl.pk_col_id and not _is_int(
+                ColumnInfo(ftype=new_ft)):
+            raise TiDBError(
+                "Unsupported modify column: the handle primary key must "
+                "stay an integer type", code=ErrCode.UnsupportedDDL)
+        if (tbl.partition is not None
+                and tbl.partition.col_name.lower() == col.name.lower()):
+            raise TiDBError(
+                "Unsupported modify column: column is in the partitioning "
+                "function", code=ErrCode.UnsupportedDDL)
+        old_ft = col.ftype
+
+        def fn(m, job):
+            t = m.get_table(db.id, tbl.id)
+            c = t.find_column(target)
+            affected_idx = [i for i in t.indexes
+                            if any(ic.name.lower() == c.name.lower()
+                                   for ic in i.columns)]
+            txn = m.txn
+            phys = ([d.id for d in t.partition.defs]
+                    if t.partition is not None else [t.id])
+            same_repr = phys_kind(old_ft) == phys_kind(new_ft) and \
+                old_ft.scale == new_ft.scale
+            for pid in phys:
+                start, end = tablecodec.table_range(pid)
+                rows = []
+                for key, value in txn.scan(start, end):
+                    _tid, h = tablecodec.decode_record_key(key)
+                    rows.append((h, tablecodec.decode_row(value)))
+                for h, row in rows:
+                    cur = row.get(c.id)
+                    if cur is None and new_ft.not_null and not (
+                            t.pk_is_handle and c.id == t.pk_col_id):
+                        # existing NULLs make a NOT NULL reorg invalid
+                        # (reference: MySQL error 1265/1138)
+                        raise TiDBError(
+                            f"Invalid use of NULL value in column "
+                            f"'{new_name}'", code=ErrCode.TruncatedWrongValue)
+                    if cur is not None:
+                        row[c.id] = convert_internal(cur, old_ft, new_ft)
+                    if not same_repr or c.id in row:
+                        col_ids = list(row)
+                        txn.put(tablecodec.record_key(pid, h),
+                                tablecodec.encode_row(
+                                    col_ids, [row[i] for i in col_ids]))
+                # rebuild covering indexes under the new representation
+                for idx in affected_idx:
+                    s, e = tablecodec.index_range(pid, idx.id)
+                    for key, _v in txn.scan(s, e):
+                        txn.delete(key)
+                if affected_idx:
+                    from .table import Table as _Table
+                    from .partition import partition_view
+                    view = (partition_view(t, next(
+                        d for d in t.partition.defs if d.id == pid))
+                        if t.partition is not None else t)
+                    # apply the new schema before re-encoding entries
+                    vc = view.find_column(target)
+                    vc.ftype = new_ft
+                    pt = _Table(view, txn)
+                    vis = [view.find_index(idx.name) for idx in affected_idx]
+                    for h, row in rows:
+                        for vi in vis:
+                            pt._index_put(vi, row, h, check_dup=True)
+            if c.has_default and c.default_value is not None:
+                c.default_value = convert_internal(c.default_value, old_ft,
+                                                   new_ft)
+            old_cname = c.name
+            c.name = new_name
+            c.ftype = new_ft
+            if new_name.lower() != old_cname.lower():
+                # a rename must follow the column everywhere it is named
+                for idx in t.indexes:
+                    for ic in idx.columns:
+                        if ic.name.lower() == old_cname.lower():
+                            ic.name = new_name
+                for fk in t.foreign_keys:
+                    fk["cols"] = [new_name if cn.lower() == old_cname.lower()
+                                  else cn for cn in fk["cols"]]
+            m.update_table(db.id, t)
+        self._run_job(fn, "modify_column", schema_id=db.id, table_id=tbl.id)
+        self.session.store.mvcc.bump_table_version(tbl.id)
+        if tbl.partition is not None:
+            for d in tbl.partition.defs:
+                self.session.store.mvcc.bump_table_version(d.id)
 
     def _alter_drop_column(self, db, tbl, name):
         col = tbl.find_column(name)
@@ -663,6 +768,7 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
     from .expression import ExprBuilder, Schema as ESchema
     tbl = TableInfo(id=m.gen_global_id(), name=stmt.table.name)
     pk_count = 0
+    auto_random_req = None
     for off, cd in enumerate(stmt.columns):
         tbl.max_col_id += 1
         default = None
@@ -686,6 +792,8 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
             if not _is_int(ci):
                 raise TiDBError("Incorrect column specifier for AUTO_INCREMENT",
                                 code=ErrCode.WrongAutoKey)
+        if "auto_random" in cd.options:
+            auto_random_req = (ci, int(cd.options["auto_random"]))
         if cd.options.get("unique"):
             tbl.max_idx_id += 1
             tbl.indexes.append(IndexInfo(
@@ -733,6 +841,18 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
                 "on_delete": ref.get("on_delete", ""),
                 "on_update": ref.get("on_update", ""),
             })
+    if auto_random_req is not None:
+        # validated AFTER constraints so a table-level PRIMARY KEY (id)
+        # counts (reference: ddl_api.go autoRandomBits checks)
+        ci, bits = auto_random_req
+        if not (tbl.pk_is_handle and tbl.pk_col_id == ci.id):
+            raise TiDBError(
+                "Invalid auto random: auto_random is only for the "
+                "integer primary key column", code=ErrCode.WrongAutoKey)
+        if not 1 <= bits <= 15:
+            raise TiDBError("Invalid auto random: shard bits must be "
+                            "in [1, 15]", code=ErrCode.WrongAutoKey)
+        tbl.auto_random_bits = bits
     if "auto_increment" in stmt.options:
         try:
             tbl.auto_increment = int(stmt.options["auto_increment"])

@@ -128,12 +128,22 @@ class InsertExec:
                 v = row.get(auto_col.id)
                 if v is None or (v == 0 and auto_col.ftype.flag & FLAG_AUTO_INCREMENT):
                     v = sess.alloc_autoid(info.id)
+                    if info.auto_random_bits:
+                        # shard bits below the sign bit (reference:
+                        # meta/autoid AUTO_RANDOM layout)
+                        import random as _rnd
+                        shard = _rnd.getrandbits(info.auto_random_bits)
+                        v |= shard << (63 - info.auto_random_bits)
                     row[auto_col.id] = v
                     last_id = v
                 else:
                     # explicit value: rebase the allocator past it
-                    # (reference: meta/autoid Rebase)
-                    sess.rebase_autoid(info.id, int(v) + 1)
+                    # (reference: meta/autoid Rebase); auto_random strips
+                    # the shard bits so the increment part rebases sanely
+                    rv = int(v)
+                    if info.auto_random_bits and rv > 0:
+                        rv &= (1 << (63 - info.auto_random_bits)) - 1
+                    sess.rebase_autoid(info.id, rv + 1)
             # NOT NULL checks
             for col in cols:
                 if col.ftype.not_null and row.get(col.id) is None:

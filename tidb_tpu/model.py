@@ -173,6 +173,7 @@ class TableInfo:
     # [{"name","cols","ref_table","ref_cols","on_delete","on_update"}]
     foreign_keys: list = field(default_factory=list)
     cached: bool = False      # ALTER TABLE ... CACHE (table/cache.go role)
+    auto_random_bits: int = 0  # AUTO_RANDOM shard bits (meta/autoid)
 
     @property
     def is_view(self):
@@ -218,6 +219,7 @@ class TableInfo:
             "temporary": self.temporary,
             "foreign_keys": self.foreign_keys,
             "cached": self.cached,
+            "auto_random_bits": self.auto_random_bits,
         }
 
     @classmethod
@@ -237,6 +239,7 @@ class TableInfo:
             temporary=d.get("temporary", False),
             foreign_keys=d.get("foreign_keys", []),
             cached=d.get("cached", False),
+            auto_random_bits=d.get("auto_random_bits", 0),
         )
 
 

@@ -1638,6 +1638,13 @@ class Parser:
             elif o == "auto_increment":
                 self.pos += 1
                 col.options["auto_increment"] = True
+            elif o == "auto_random":
+                self.pos += 1
+                bits = 5
+                if self._accept_op("("):
+                    bits = self._int_lit()
+                    self._expect_op(")")
+                col.options["auto_random"] = bits
             elif o == "primary":
                 self.pos += 1
                 self._expect_kw("key")
@@ -2128,7 +2135,10 @@ class Parser:
             if self._accept_kw("from") or self._accept_kw("in"):
                 stmt.target = self._parse_table_name()
         elif self._accept_kw("create"):
-            if self._accept_kw("table"):
+            if (self._accept_kw("table") or self._accept_kw("view")
+                    or self._accept_kw("sequence")):
+                # views/sequences render their own DDL from the same
+                # handler (reference: ShowCreateView/ShowCreateSequence)
                 stmt.kind = "create_table"
                 stmt.target = self._parse_table_name()
             elif self._accept_kw("database"):

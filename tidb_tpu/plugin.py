@@ -70,10 +70,15 @@ class PluginRegistry:
             del self.errors[:-self._ERRORS_CAP]  # bounded
 
     def load(self, plugin: Plugin):
+        # on_init runs OUTSIDE the registry lock: an init that executes SQL
+        # re-enters via plugins.list() and would deadlock otherwise
         with self._lock:
             if plugin.name in self._plugins:
                 raise ValueError(f"plugin '{plugin.name}' already loaded")
-            plugin.on_init(self.domain)
+        plugin.on_init(self.domain)
+        with self._lock:
+            if plugin.name in self._plugins:
+                raise ValueError(f"plugin '{plugin.name}' already loaded")
             self._plugins[plugin.name] = plugin
 
     def unload(self, name: str) -> bool:

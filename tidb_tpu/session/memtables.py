@@ -44,10 +44,136 @@ def _tables(session):
         infos = session.infoschema()
         for dbn in infos.schema_names():
             for t in infos.tables_in_schema(dbn):
-                nrows = session.expr_ctx().table_rows(t.id)
+                ttype = (b"VIEW" if t.is_view
+                         else b"SEQUENCE" if t.is_sequence
+                         else b"BASE TABLE")
+                nrows = (session.expr_ctx().table_rows(t.id)
+                         if ttype == b"BASE TABLE" else 0)
                 out.append((b"def", dbn.encode(), t.name.encode(),
-                            b"BASE TABLE", b"tpu-htap", nrows,
+                            ttype, b"tpu-htap", nrows,
                             t.auto_increment, t.id))
+        return out
+    return cols, rows
+
+
+def _views(session):
+    cols = [("table_catalog", _S), ("table_schema", _S), ("table_name", _S),
+            ("view_definition", _S), ("definer", _S), ("security_type", _S)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                if t.is_view:
+                    out.append((b"def", dbn.encode(), t.name.encode(),
+                                t.view["select"].encode(),
+                                t.view.get("definer", "").encode(),
+                                b"DEFINER"))
+        return out
+    return cols, rows
+
+
+def _partitions(session):
+    cols = [("table_catalog", _S), ("table_schema", _S), ("table_name", _S),
+            ("partition_name", _S), ("partition_ordinal_position", _I),
+            ("partition_method", _S), ("partition_expression", _S),
+            ("partition_description", _S), ("tidb_partition_id", _I)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                p = t.partition
+                if p is None:
+                    continue
+                for pos, d in enumerate(p.defs, 1):
+                    if p.type == "range":
+                        desc = str(d.less_than)
+                    elif p.type == "list":
+                        desc = ",".join(str(v) for v in d.in_values)
+                    else:
+                        desc = ""
+                    out.append((b"def", dbn.encode(), t.name.encode(),
+                                d.name.encode(), pos,
+                                p.type.upper().encode(), p.expr.encode(),
+                                desc.encode(), d.id))
+        return out
+    return cols, rows
+
+
+def _sequences(session):
+    cols = [("table_catalog", _S), ("sequence_schema", _S),
+            ("sequence_name", _S), ("start", _I), ("increment", _I),
+            ("min_value", _I), ("max_value", _I), ("cache", _I),
+            ("cycle", _I)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                if t.is_sequence:
+                    s = t.sequence
+                    out.append((b"def", dbn.encode(), t.name.encode(),
+                                s["start"], s["increment"], s["min"],
+                                s["max"], s["cache"], s["cycle"]))
+        return out
+    return cols, rows
+
+
+def _table_constraints(session):
+    cols = [("constraint_catalog", _S), ("constraint_schema", _S),
+            ("constraint_name", _S), ("table_schema", _S),
+            ("table_name", _S), ("constraint_type", _S)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                if t.is_view or t.is_sequence:
+                    continue
+                db_b = dbn.encode()
+                if t.pk_is_handle:
+                    out.append((b"def", db_b, b"PRIMARY", db_b,
+                                t.name.encode(), b"PRIMARY KEY"))
+                for idx in t.indexes:
+                    if idx.primary:
+                        kind = b"PRIMARY KEY"
+                    elif idx.unique:
+                        kind = b"UNIQUE"
+                    else:
+                        continue
+                    out.append((b"def", db_b, idx.name.encode(), db_b,
+                                t.name.encode(), kind))
+                for fk in t.foreign_keys:
+                    out.append((b"def", db_b, fk["name"].encode(), db_b,
+                                t.name.encode(), b"FOREIGN KEY"))
+        return out
+    return cols, rows
+
+
+def _referential_constraints(session):
+    cols = [("constraint_catalog", _S), ("constraint_schema", _S),
+            ("constraint_name", _S), ("table_name", _S),
+            ("referenced_table_name", _S), ("update_rule", _S),
+            ("delete_rule", _S)]
+
+    def rows():
+        out = []
+        infos = session.infoschema()
+        for dbn in infos.schema_names():
+            for t in infos.tables_in_schema(dbn):
+                for fk in getattr(t, "foreign_keys", []):
+                    out.append((b"def", dbn.encode(), fk["name"].encode(),
+                                t.name.encode(),
+                                fk["ref_table"].encode(),
+                                (fk.get("on_update") or
+                                 "restrict").upper().encode(),
+                                (fk.get("on_delete") or
+                                 "restrict").upper().encode()))
         return out
     return cols, rows
 
@@ -247,4 +373,10 @@ _TABLES = {
     ("information_schema", "statements_summary"): _statements_summary,
     ("information_schema", "cluster_slow_query"): _slow_query,
     ("information_schema", "metrics"): _metrics,
+    ("information_schema", "views"): _views,
+    ("information_schema", "partitions"): _partitions,
+    ("information_schema", "sequences"): _sequences,
+    ("information_schema", "table_constraints"): _table_constraints,
+    ("information_schema", "referential_constraints"):
+        _referential_constraints,
 }

@@ -245,8 +245,9 @@ def render_create_table(info) -> str:
                 l += f" DEFAULT '{v}'"
             else:
                 l += f" DEFAULT {v}"
-        if info.pk_is_handle and c.id == info.pk_col_id:
-            pass
+        if (info.auto_random_bits and info.pk_is_handle
+                and c.id == info.pk_col_id):
+            l += f" /*T![auto_rand] AUTO_RANDOM({info.auto_random_bits}) */"
         lines.append(l)
     if info.pk_is_handle:
         pkname = next((c.name for c in info.columns if c.id == info.pk_col_id), None)
