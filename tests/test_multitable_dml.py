@@ -1,0 +1,125 @@
+"""Multi-table UPDATE/DELETE over joins (reference: executor/update.go +
+delete.go multi-table forms)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table emp (id int primary key, dept int, sal int)")
+    tk.must_exec("create table dept (id int primary key, bonus int)")
+    tk.must_exec("insert into emp values (1,10,100),(2,10,200),(3,20,300)")
+    tk.must_exec("insert into dept values (10, 5), (20, 7)")
+    return tk
+
+
+class TestMultiUpdate:
+    def test_join_update(self, tk):
+        tk.must_exec("update emp e, dept d set e.sal = e.sal + d.bonus "
+                     "where e.dept = d.id")
+        tk.must_query("select id, sal from emp order by id").check(
+            [("1", "105"), ("2", "205"), ("3", "307")])
+
+    def test_updates_both_tables(self, tk):
+        tk.must_exec("update emp e join dept d on e.dept = d.id "
+                     "set e.sal = 0, d.bonus = d.bonus * 10 where e.id = 1")
+        tk.must_query("select sal from emp where id = 1").check([("0",)])
+        tk.must_query("select bonus from dept where id = 10").check(
+            [("50",)])
+
+    def test_each_row_updated_once(self, tk):
+        """A target row matched by several join rows updates exactly once
+        (MySQL multi-table semantics)."""
+        tk.must_exec("update dept d, emp e set d.bonus = d.bonus + 1 "
+                     "where e.dept = d.id")
+        tk.must_query("select bonus from dept order by id").check(
+            [("6",), ("8",)])
+
+    def test_unqualified_column_resolves_uniquely(self, tk):
+        tk.must_exec("update emp e, dept d set sal = 1 where e.dept = d.id")
+        tk.must_query("select distinct sal from emp where dept in (10, 20)"
+                      ).check([("1",)])
+        # 'id' exists in both tables: ambiguous
+        e = tk.exec_error(
+            "update emp e, dept d set id = 1 where e.dept = d.id")
+        assert "ambiguous" in str(e)
+
+    def test_requires_pk_handle(self, tk):
+        tk.must_exec("create table nopk (a int)")
+        e = tk.exec_error("update nopk n, dept d set n.a = 1")
+        assert "primary key" in str(e)
+
+
+class TestMultiDelete:
+    def test_delete_target_from_join(self, tk):
+        tk.must_exec("delete e from emp e join dept d on e.dept = d.id "
+                     "where d.id = 10")
+        tk.must_query("select id from emp").check([("3",)])
+        tk.must_query("select count(*) from dept").check([("2",)])
+
+    def test_delete_from_using(self, tk):
+        tk.must_exec("delete from emp using emp, dept "
+                     "where emp.dept = dept.id and dept.bonus = 7")
+        tk.must_query("select id from emp order by id").check(
+            [("1",), ("2",)])
+
+    def test_delete_two_targets(self, tk):
+        tk.must_exec("delete e, d from emp e join dept d on e.dept = d.id "
+                     "where d.id = 20")
+        tk.must_query("select count(*) from emp").check([("2",)])
+        tk.must_query("select count(*) from dept").check([("1",)])
+
+    def test_rollback_covers_multi_dml(self, tk):
+        tk.must_exec("begin")
+        tk.must_exec("delete e, d from emp e join dept d on e.dept = d.id")
+        tk.must_query("select count(*) from emp").check([("0",)])
+        tk.must_exec("rollback")
+        tk.must_query("select count(*) from emp").check([("3",)])
+        tk.must_query("select count(*) from dept").check([("2",)])
+
+
+class TestMultiDMLLocksAndPrivs:
+    def test_multi_update_respects_foreign_read_lock(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("lock tables emp read")
+        e = tk.exec_error(
+            "update emp e, dept d set e.sal = 1 where e.dept = d.id")
+        assert e.code == 8020
+        tk2.must_exec("unlock tables")
+
+    def test_multi_delete_respects_foreign_read_lock(self, tk):
+        tk2 = tk.new_session()
+        tk2.must_exec("use test")
+        tk2.must_exec("lock tables emp read")
+        e = tk.exec_error(
+            "delete e from emp e join dept d on e.dept = d.id")
+        assert e.code == 8020
+        tk2.must_exec("unlock tables")
+
+    def test_aliased_delete_target_requires_delete_priv(self, tk):
+        tk.must_exec("create user 'ro'@'%'")
+        tk.must_exec("grant select on test.* to 'ro'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "ro@%"
+        e = tk2.exec_error(
+            "delete a from emp as a join dept d on a.dept = d.id")
+        assert "denied" in str(e).lower()
+        tk.must_query("select count(*) from emp").check([("3",)])
+
+    def test_multi_update_needs_update_only_on_set_targets(self, tk):
+        tk.must_exec("create user 'half'@'%'")
+        tk.must_exec("grant select on test.* to 'half'@'%'")
+        tk.must_exec("grant update on test.emp to 'half'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "half@%"
+        # only emp is a set-target: allowed despite no UPDATE on dept
+        tk2.must_exec("update emp e join dept d on e.dept = d.id "
+                      "set e.sal = 2 where d.id = 10")
+        e = tk2.exec_error("update emp e join dept d on e.dept = d.id "
+                           "set d.bonus = 0")
+        assert "denied" in str(e).lower()

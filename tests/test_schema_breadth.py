@@ -156,3 +156,26 @@ class TestInfoSchemaBreadth:
         tk.must_exec("create sequence sq")
         assert tk.must_query("show create view vv").rows
         assert tk.must_query("show create sequence sq").rows
+
+
+class TestModifyColumnEdges:
+    def test_not_null_accepts_absent_column_with_default(self, tk):
+        tk.must_exec("create table t (a int primary key)")
+        tk.must_exec("insert into t values (1)")
+        tk.must_exec("alter table t add column b int default 5")
+        tk.must_exec("alter table t modify column b int not null")
+        tk.must_query("select b from t").check([("5",)])
+
+    def test_modify_applies_new_default(self, tk):
+        tk.must_exec("create table t (id int primary key, v int default 1)")
+        tk.must_exec("alter table t modify column v int default 7")
+        tk.must_exec("insert into t (id) values (1)")
+        tk.must_query("select v from t").check([("7",)])
+
+    def test_rename_updates_other_tables_fk_refs(self, tk):
+        tk.must_exec("create table parent (id int primary key)")
+        tk.must_exec("create table child (a int, "
+                     "foreign key (a) references parent (id))")
+        tk.must_exec("alter table parent change column id pid bigint")
+        ddl = tk.must_query("show create table child").rows[0][1]
+        assert "REFERENCES `parent` (`pid`)" in ddl

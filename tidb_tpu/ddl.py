@@ -595,7 +595,15 @@ class DDLExecutor:
                     _tid, h = tablecodec.decode_record_key(key)
                     rows.append((h, tablecodec.decode_row(value)))
                 for h, row in rows:
-                    cur = row.get(c.id)
+                    if c.id not in row:
+                        # column added after this row was written: reads
+                        # apply the origin default — materialize it so the
+                        # reorg converts a real value, not a phantom NULL
+                        cur = c.default_value if c.has_default else None
+                        if cur is not None:
+                            row[c.id] = cur
+                    else:
+                        cur = row[c.id]
                     if cur is None and new_ft.not_null and not (
                             t.pk_is_handle and c.id == t.pk_col_id):
                         # existing NULLs make a NOT NULL reorg invalid
@@ -629,7 +637,16 @@ class DDLExecutor:
                     for h, row in rows:
                         for vi in vis:
                             pt._index_put(vi, row, h, check_dup=True)
-            if c.has_default and c.default_value is not None:
+            if "default" in coldef.options:
+                # a DEFAULT clause in the new definition replaces the old
+                # default (reference: column definition fully re-applies)
+                from .expression import ExprBuilder, Schema
+                e = ExprBuilder(Schema([])).build(coldef.options["default"])
+                v = e.eval_scalar()
+                c.default_value = (cast_value(v, new_ft)
+                                   if v is not None else None)
+                c.has_default = True
+            elif c.has_default and c.default_value is not None:
                 c.default_value = convert_internal(c.default_value, old_ft,
                                                    new_ft)
             old_cname = c.name
@@ -644,6 +661,20 @@ class DDLExecutor:
                 for fk in t.foreign_keys:
                     fk["cols"] = [new_name if cn.lower() == old_cname.lower()
                                   else cn for cn in fk["cols"]]
+                # ...including OTHER tables' FKs that reference it
+                for odb in m.list_databases():
+                    for ot in m.list_tables(odb.id):
+                        touched = False
+                        for fk in ot.foreign_keys:
+                            if fk["ref_table"].lower() != t.name.lower():
+                                continue
+                            nc = [new_name if cn.lower() == old_cname.lower()
+                                  else cn for cn in fk["ref_cols"]]
+                            if nc != fk["ref_cols"]:
+                                fk["ref_cols"] = nc
+                                touched = True
+                        if touched:
+                            m.update_table(odb.id, ot)
             m.update_table(db.id, t)
         self._run_job(fn, "modify_column", schema_id=db.id, table_id=tbl.id)
         self.session.store.mvcc.bump_table_version(tbl.id)

@@ -261,6 +261,173 @@ def _rewrite_values_func(node, row, info):
     return node
 
 
+def _from_aliases(session, from_node):
+    """alias(lower) -> (db, TableInfo) for every base table in a FROM tree
+    (multi-table DML target resolution)."""
+    from ..priv_check import _collect_tables
+    tabs = []
+    _collect_tables(from_node, tabs)
+    out = {}
+    infos = session.infoschema()
+    for tn in tabs:
+        db = tn.schema or session.current_db()
+        if not infos.has_table(db, tn.name):
+            continue
+        alias = (tn.as_name or tn.name).lower()
+        out[alias] = (db, infos.table_by_name(db, tn.name))
+    return out
+
+
+def _pk_ref(alias, info):
+    """ColumnName AST for the target's handle primary key; multi-table DML
+    addresses rows through it (reference: the reference threads row ids
+    through the join — here the int pk IS the handle)."""
+    if not info.pk_is_handle:
+        raise TiDBError(
+            f"multi-table DML requires an integer primary key on "
+            f"'{info.name}'", code=ErrCode.UnsupportedType)
+    pk = next(c for c in info.columns if c.id == info.pk_col_id)
+    return ast.ColumnName(name=pk.name, table=alias)
+
+
+class MultiUpdateExec:
+    """UPDATE over a join (reference: executor/update.go multi-table form):
+    evaluate all assignment expressions and each target's pk through one
+    join query, then apply per-row updates — each target row updated once
+    even when the join matches it repeatedly (MySQL semantics)."""
+
+    def __init__(self, session, stmt: ast.UpdateStmt):
+        self.session = session
+        self.stmt = stmt
+
+    def execute(self) -> DMLResult:
+        sess = self.session
+        stmt = self.stmt
+        aliases = _from_aliases(sess, stmt.table)
+
+        def target_alias(cn: ast.ColumnName) -> str:
+            if cn.table:
+                key = cn.table.lower()
+                if key not in aliases:
+                    raise TiDBError(f"Unknown table '{cn.table}'",
+                                    code=ErrCode.UnknownTable)
+                return key
+            hits = [a for a, (_db, info) in aliases.items()
+                    if info.find_column(cn.name) is not None]
+            if len(hits) != 1:
+                raise TiDBError(
+                    f"Column '{cn.name}' in field list is ambiguous",
+                    code=ErrCode.NonUniq)
+            return hits[0]
+
+        assign_alias = [target_alias(cn) for cn, _e in stmt.assignments]
+        targets = sorted(set(assign_alias))
+        for a in targets:
+            if aliases[a][1].is_view:
+                raise TiDBError(
+                    f"The target table {a} of the UPDATE is not updatable",
+                    code=ErrCode.NonUpdatableTable)
+        fields = [ast.SelectField(expr=e) for _c, e in stmt.assignments]
+        fields += [ast.SelectField(expr=_pk_ref(a, aliases[a][1]))
+                   for a in targets]
+        sel = ast.SelectStmt(fields=fields, from_=stmt.table,
+                             where=stmt.where)
+        res = sess.run_query(sel)
+        rows = res.internal_rows
+        fts = res.ftypes
+        n_assign = len(stmt.assignments)
+        txn = sess.txn_for_write()
+        seen = set()
+        affected = 0
+        for r in rows:
+            for ti, a in enumerate(targets):
+                handle = r[n_assign + ti]
+                if handle is None:
+                    continue
+                handle = int(handle)
+                if (a, handle) in seen:
+                    continue
+                seen.add((a, handle))
+                _db, info = aliases[a]
+                tbl = Table(info, txn)
+                old = tbl.get_row(handle)
+                if old is None:
+                    continue
+                new_row = dict(old)
+                changed = False
+                for ai, (cn, _e) in enumerate(stmt.assignments):
+                    if assign_alias[ai] != a:
+                        continue
+                    col = info.find_column(cn.name)
+                    if col is None:
+                        raise TiDBError(f"Unknown column '{cn.name}'",
+                                        code=ErrCode.BadField)
+                    v = r[ai]
+                    nv = (convert_internal(v, fts[ai], col.ftype)
+                          if v is not None else None)
+                    if nv is None and col.ftype.not_null:
+                        raise TiDBError(f"Column '{col.name}' cannot be null",
+                                        code=ErrCode.BadNull)
+                    if new_row.get(col.id) != nv:
+                        new_row[col.id] = nv
+                        changed = True
+                if not changed:
+                    continue
+                if info.pk_is_handle and new_row.get(info.pk_col_id) != handle:
+                    tbl.remove_record(old, handle)
+                    tbl.add_record(new_row, new_row[info.pk_col_id])
+                else:
+                    tbl.update_record(old, new_row, handle)
+                affected += 1
+        sess.finish_dml()
+        return DMLResult(affected=affected)
+
+
+class MultiDeleteExec:
+    """DELETE t1[, t2] FROM <join> (reference: executor/delete.go
+    multi-table form), rows addressed via each target's pk handle."""
+
+    def __init__(self, session, stmt: ast.DeleteStmt):
+        self.session = session
+        self.stmt = stmt
+
+    def execute(self) -> DMLResult:
+        sess = self.session
+        stmt = self.stmt
+        aliases = _from_aliases(sess, stmt.table)
+        targets = []
+        for tn in stmt.targets:
+            key = (tn.as_name or tn.name).lower()
+            if key not in aliases:
+                raise TiDBError(f"Unknown table '{tn.name}' in MULTI DELETE",
+                                code=ErrCode.UnknownTable)
+            targets.append(key)
+        fields = [ast.SelectField(expr=_pk_ref(a, aliases[a][1]))
+                  for a in targets]
+        sel = ast.SelectStmt(fields=fields, from_=stmt.table,
+                             where=stmt.where)
+        res = sess.run_query(sel)
+        txn = sess.txn_for_write()
+        seen = set()
+        affected = 0
+        for r in res.internal_rows:
+            for ti, a in enumerate(targets):
+                handle = r[ti]
+                if handle is None or (a, int(handle)) in seen:
+                    continue
+                handle = int(handle)
+                seen.add((a, handle))
+                _db, info = aliases[a]
+                tbl = Table(info, txn)
+                old = tbl.get_row(handle)
+                if old is None:
+                    continue
+                tbl.remove_record(old, handle)
+                affected += 1
+        sess.finish_dml()
+        return DMLResult(affected=affected)
+
+
 class UpdateExec:
     def __init__(self, session, stmt: ast.UpdateStmt):
         self.session = session
@@ -270,7 +437,7 @@ class UpdateExec:
         sess = self.session
         stmt = self.stmt
         if not isinstance(stmt.table, ast.TableName):
-            raise TiDBError("multi-table UPDATE not supported yet")
+            return MultiUpdateExec(sess, stmt).execute()
         db, info = _resolve_table(sess, stmt.table, dml="UPDATE")
         alias = stmt.table.as_name or stmt.table.name
         txn = sess.txn_for_write()
@@ -358,6 +525,8 @@ class DeleteExec:
     def execute(self) -> DMLResult:
         sess = self.session
         stmt = self.stmt
+        if stmt.targets:
+            return MultiDeleteExec(sess, stmt).execute()
         db, info = _resolve_table(sess, stmt.table, dml="DELETE")
         alias = stmt.table.as_name or stmt.table.name
         txn = sess.txn_for_write()

@@ -528,8 +528,15 @@ class DeleteStmt(StmtNode):
     where: Optional[ExprNode] = None
     order_by: list = field(default_factory=list)
     limit: Optional[Limit] = None
+    targets: list = field(default_factory=list)  # multi-table: [TableName]
 
     def restore(self):
+        if self.targets:
+            s = ("DELETE " + ", ".join(t.restore() for t in self.targets)
+                 + f" FROM {self.table.restore()}")
+            if self.where is not None:
+                s += " WHERE " + self.where.restore()
+            return s
         s = f"DELETE FROM {self.table.restore()}"
         if self.where is not None:
             s += " WHERE " + self.where.restore()

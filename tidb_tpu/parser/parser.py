@@ -1187,9 +1187,30 @@ class Parser:
 
     def _parse_delete(self) -> ast.DeleteStmt:
         self._expect_kw("delete")
-        self._expect_kw("from")
         stmt = ast.DeleteStmt()
-        stmt.table = self._parse_table_name(allow_alias=True)
+        if not self._peek_kw("from"):
+            # DELETE t1, t2 FROM <joins> ... (multi-table, targets first)
+            stmt.targets = [self._parse_table_name()]
+            while self._accept_op(","):
+                stmt.targets.append(self._parse_table_name())
+            self._expect_kw("from")
+            stmt.table = self._parse_table_refs()
+            if self._accept_kw("where"):
+                stmt.where = self._parse_expr()
+            return stmt
+        self._expect_kw("from")
+        first = self._parse_table_name(allow_alias=True)
+        if self._peek_op(",") or self._peek_kw("using"):
+            # DELETE FROM t1[, t2] USING <joins> ...
+            stmt.targets = [first]
+            while self._accept_op(","):
+                stmt.targets.append(self._parse_table_name())
+            self._expect_kw("using")
+            stmt.table = self._parse_table_refs()
+            if self._accept_kw("where"):
+                stmt.where = self._parse_expr()
+            return stmt
+        stmt.table = first
         if self._accept_kw("where"):
             stmt.where = self._parse_expr()
         if self._accept_kw("order"):

@@ -33,6 +33,20 @@ def _collect_tables(node, out):
             stack.append(getattr(n, name))
 
 
+def _alias_map(session, from_node):
+    """alias(lower) -> (db, table_name) for base tables of a FROM tree —
+    multi-table DML names its targets by alias."""
+    tabs = []
+    _collect_tables(from_node, tabs)
+    infos = session.infoschema()
+    out = {}
+    for tn in tabs:
+        db = tn.schema or session.current_db()
+        if db and infos.has_table(db, tn.name):
+            out[(tn.as_name or tn.name).lower()] = (db, tn.name)
+    return out
+
+
 def check_stmt_privileges(session, stmt):
     priv = session.domain.priv
     user = session.user
@@ -66,10 +80,42 @@ def check_stmt_privileges(session, stmt):
         if isinstance(stmt.table, ast.TableName):
             priv.verify(user, stmt.table.schema or session.current_db(),
                         stmt.table.name, "update")
+        else:
+            # multi-table form: UPDATE only on the exact set-target tables
+            # (resolved through their aliases); the rest of the join is a
+            # read
+            amap = _alias_map(session, stmt.table)
+            seen_t = set()
+            for cn, _e in stmt.assignments:
+                if cn.table and cn.table.lower() in amap:
+                    seen_t.add(amap[cn.table.lower()])
+                elif not cn.table:
+                    hits = [v for v in amap.values()]
+                    if len(amap) == 1:
+                        seen_t.add(hits[0])
+                    else:
+                        seen_t.update(hits)  # ambiguous: conservative
+            for db, name in seen_t:
+                priv.verify(user, db, name, "update")
+            req_tables(stmt.table, "select")
         req_tables(stmt.where, "select")
         req_tables(stmt.assignments, "select")
     elif isinstance(stmt, ast.DeleteStmt):
-        if isinstance(stmt.table, ast.TableName):
+        if stmt.targets:
+            # targets may be ALIASES of join tables: resolve before
+            # verifying, or an aliased target escapes the check entirely
+            amap = _alias_map(session, stmt.table)
+            for tn in stmt.targets:
+                key = (tn.as_name or tn.name).lower()
+                if key in amap:
+                    db, name = amap[key]
+                    priv.verify(user, db, name, "delete")
+                else:
+                    db = tn.schema or session.current_db()
+                    if db and infos.has_table(db, tn.name):
+                        priv.verify(user, db, tn.name, "delete")
+            req_tables(stmt.table, "select")
+        elif isinstance(stmt.table, ast.TableName):
             priv.verify(user, stmt.table.schema or session.current_db(),
                         stmt.table.name, "delete")
         req_tables(stmt.where, "select")

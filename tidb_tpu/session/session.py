@@ -344,8 +344,27 @@ class Session:
         if isinstance(stmt, (ast.InsertStmt, ast.TruncateTableStmt)):
             targets = [stmt.table]
         elif isinstance(stmt, (ast.UpdateStmt, ast.DeleteStmt)):
-            if isinstance(stmt.table, ast.TableName):
+            if isinstance(stmt.table, ast.TableName) and not getattr(
+                    stmt, "targets", None):
                 targets = [stmt.table]
+            else:
+                # multi-table form: resolve target aliases to base tables
+                from ..priv_check import _alias_map
+                amap = _alias_map(self, stmt.table)
+                if isinstance(stmt, ast.DeleteStmt):
+                    for tn in stmt.targets:
+                        key = (tn.as_name or tn.name).lower()
+                        if key in amap:
+                            db, name = amap[key]
+                            write_keys.add((db.lower(), name.lower()))
+                else:
+                    for cn, _e in stmt.assignments:
+                        if cn.table and cn.table.lower() in amap:
+                            db, name = amap[cn.table.lower()]
+                            write_keys.add((db.lower(), name.lower()))
+                        elif not cn.table:
+                            for db, name in amap.values():
+                                write_keys.add((db.lower(), name.lower()))
         elif isinstance(stmt, ast.DropTableStmt):
             targets = list(stmt.tables)
         elif isinstance(stmt, (ast.AlterTableStmt, ast.CreateIndexStmt,
