@@ -123,3 +123,23 @@ class TestMultiDMLLocksAndPrivs:
         e = tk2.exec_error("update emp e join dept d on e.dept = d.id "
                            "set d.bonus = 0")
         assert "denied" in str(e).lower()
+
+    def test_unqualified_set_needs_priv_on_owning_table_only(self, tk):
+        tk.must_exec("create user 'u2'@'%'")
+        tk.must_exec("grant select on test.* to 'u2'@'%'")
+        tk.must_exec("grant update on test.emp to 'u2'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "u2@%"
+        # sal exists only in emp: update priv on dept must not be needed
+        tk2.must_exec("update emp e, dept d set sal = 3 where e.dept = d.id")
+
+    def test_order_by_limit_rejected_in_multi_update(self, tk):
+        e = tk.exec_error("update emp e join dept d on e.dept = d.id "
+                          "set e.sal = 0 limit 1")
+        assert "Incorrect usage" in str(e)
+
+    def test_set_default_in_multi_update(self, tk):
+        tk.must_exec("create table wd (id int primary key, v int default 9)")
+        tk.must_exec("insert into wd values (1, 1)")
+        tk.must_exec("update wd w, dept d set w.v = default where w.id = 1")
+        tk.must_query("select v from wd").check([("9",)])

@@ -179,3 +179,22 @@ class TestModifyColumnEdges:
         tk.must_exec("alter table parent change column id pid bigint")
         ddl = tk.must_query("show create table child").rows[0][1]
         assert "REFERENCES `parent` (`pid`)" in ddl
+
+    def test_self_referencing_fk_rename(self, tk):
+        tk.must_exec("create table t (id int primary key, pid int, "
+                     "foreign key (pid) references t (id))")
+        tk.must_exec("alter table t change column id tid bigint")
+        ddl = tk.must_query("show create table t").rows[0][1]
+        assert "REFERENCES `t` (`tid`)" in ddl
+
+    def test_rename_never_touches_other_db_same_named_table(self, tk):
+        tk.must_exec("create table parent (id int primary key)")
+        tk.must_exec("create database otherdb2")
+        tk.must_exec("use otherdb2")
+        tk.must_exec("create table parent (id int primary key)")
+        tk.must_exec("create table child (a int, "
+                     "foreign key (a) references parent (id))")
+        tk.must_exec("use test")
+        tk.must_exec("alter table parent change column id pid bigint")
+        ddl = tk.must_query("show create table otherdb2.child").rows[0][1]
+        assert "REFERENCES `parent` (`id`)" in ddl

@@ -661,20 +661,31 @@ class DDLExecutor:
                 for fk in t.foreign_keys:
                     fk["cols"] = [new_name if cn.lower() == old_cname.lower()
                                   else cn for cn in fk["cols"]]
-                # ...including OTHER tables' FKs that reference it
-                for odb in m.list_databases():
-                    for ot in m.list_tables(odb.id):
-                        touched = False
-                        for fk in ot.foreign_keys:
-                            if fk["ref_table"].lower() != t.name.lower():
-                                continue
-                            nc = [new_name if cn.lower() == old_cname.lower()
-                                  else cn for cn in fk["ref_cols"]]
-                            if nc != fk["ref_cols"]:
-                                fk["ref_cols"] = nc
-                                touched = True
-                        if touched:
-                            m.update_table(odb.id, ot)
+                    # self-referencing FK: fix ref_cols on t's OWN object
+                    # (the same-db loop below skips t — a fresh copy there
+                    # would be clobbered by the final update_table(t))
+                    if fk["ref_table"].lower() == t.name.lower():
+                        fk["ref_cols"] = [
+                            new_name if cn.lower() == old_cname.lower()
+                            else cn for cn in fk["ref_cols"]]
+                # ...including OTHER tables' FKs in the SAME database that
+                # reference it (FK metadata stores no db qualifier;
+                # references resolve same-db, so other dbs' same-named
+                # tables must stay untouched)
+                for ot in m.list_tables(db.id):
+                    if ot.id == t.id:
+                        continue
+                    touched = False
+                    for fk in ot.foreign_keys:
+                        if fk["ref_table"].lower() != t.name.lower():
+                            continue
+                        nc = [new_name if cn.lower() == old_cname.lower()
+                              else cn for cn in fk["ref_cols"]]
+                        if nc != fk["ref_cols"]:
+                            fk["ref_cols"] = nc
+                            touched = True
+                    if touched:
+                        m.update_table(db.id, ot)
             m.update_table(db.id, t)
         self._run_job(fn, "modify_column", schema_id=db.id, table_id=tbl.id)
         self.session.store.mvcc.bump_table_version(tbl.id)

@@ -303,6 +303,11 @@ class MultiUpdateExec:
     def execute(self) -> DMLResult:
         sess = self.session
         stmt = self.stmt
+        if stmt.order_by or stmt.limit is not None:
+            # MySQL: "Incorrect usage of UPDATE and ORDER BY/LIMIT" for the
+            # multi-table form — silently over-updating would be worse
+            raise TiDBError("Incorrect usage of UPDATE and ORDER BY/LIMIT",
+                            code=ErrCode.ParseError)
         aliases = _from_aliases(sess, stmt.table)
 
         def target_alias(cn: ast.ColumnName) -> str:
@@ -327,7 +332,13 @@ class MultiUpdateExec:
                 raise TiDBError(
                     f"The target table {a} of the UPDATE is not updatable",
                     code=ErrCode.NonUpdatableTable)
-        fields = [ast.SelectField(expr=e) for _c, e in stmt.assignments]
+        # SET col = DEFAULT resolves from the column, not the join query
+        is_default = [isinstance(e, ast.DefaultExpr)
+                      for _c, e in stmt.assignments]
+        fields = [ast.SelectField(expr=(ast.Literal("null", None)
+                                        if isinstance(e, ast.DefaultExpr)
+                                        else e))
+                  for _c, e in stmt.assignments]
         fields += [ast.SelectField(expr=_pk_ref(a, aliases[a][1]))
                    for a in targets]
         sel = ast.SelectStmt(fields=fields, from_=stmt.table,
@@ -337,6 +348,7 @@ class MultiUpdateExec:
         fts = res.ftypes
         n_assign = len(stmt.assignments)
         txn = sess.txn_for_write()
+        tables = {a: Table(aliases[a][1], txn) for a in targets}
         seen = set()
         affected = 0
         for r in rows:
@@ -349,7 +361,7 @@ class MultiUpdateExec:
                     continue
                 seen.add((a, handle))
                 _db, info = aliases[a]
-                tbl = Table(info, txn)
+                tbl = tables[a]
                 old = tbl.get_row(handle)
                 if old is None:
                     continue
@@ -362,6 +374,17 @@ class MultiUpdateExec:
                     if col is None:
                         raise TiDBError(f"Unknown column '{cn.name}'",
                                         code=ErrCode.BadField)
+                    if is_default[ai]:
+                        d = _col_default(sess, info, col)
+                        nv = None if d is _MISSING else d
+                        if nv is None and col.ftype.not_null:
+                            raise TiDBError(
+                                f"Column '{col.name}' cannot be null",
+                                code=ErrCode.BadNull)
+                        if new_row.get(col.id) != nv:
+                            new_row[col.id] = nv
+                            changed = True
+                        continue
                     v = r[ai]
                     nv = (convert_internal(v, fts[ai], col.ftype)
                           if v is not None else None)
@@ -408,6 +431,7 @@ class MultiDeleteExec:
                              where=stmt.where)
         res = sess.run_query(sel)
         txn = sess.txn_for_write()
+        tables = {a: Table(aliases[a][1], txn) for a in targets}
         seen = set()
         affected = 0
         for r in res.internal_rows:
@@ -417,8 +441,7 @@ class MultiDeleteExec:
                     continue
                 handle = int(handle)
                 seen.add((a, handle))
-                _db, info = aliases[a]
-                tbl = Table(info, txn)
+                tbl = tables[a]
                 old = tbl.get_row(handle)
                 if old is None:
                     continue

@@ -653,12 +653,31 @@ def _seg_running(comb_val, is_new, z):
 
 def _group_spans(is_new, kept, n, capacity):
     """Group boundary arithmetic shared by the single-chip kernel and the
-    MPP partial/final stages: starts from static-size nonzero, end_g = next
+    MPP partial/final stages: starts from a top-k selection, end_g = next
     start (or kept for the last group). Returns (starts, ends, end_idx,
     span_sum) where span_sum(z) = per-group sums of z via exclusive prefix
     sums (exact for ints — two's-complement differences cancel; float sums
-    must use _seg_running instead to keep rounding error group-local)."""
-    (starts,) = jnp.nonzero(is_new, size=capacity, fill_value=n)
+    must use _seg_running instead to keep rounding error group-local).
+
+    Boundary positions come from top_k over flagged positions, NOT
+    jnp.nonzero(size=...): nonzero lowers to a serialized path on TPU
+    (~500ms at 6M rows vs ~40ms for top_k — measured 12x)."""
+    pos = jnp.arange(n, dtype=jnp.int32) if n < (1 << 31) else jnp.arange(n)
+    flagged = jnp.where(is_new, pos, jnp.asarray(n, dtype=pos.dtype))
+    # k is bounded by BOTH capacity and n: top_k(k > len) is a trace error,
+    # and n == 0 must yield all-fill starts exactly like nonzero did
+    k = min(capacity, n)
+    # top_k of the negated positions = the k smallest flagged positions,
+    # returned descending in -value ⇒ -result is already ascending;
+    # unflagged rows carry n and fill the tail exactly like nonzero's
+    # fill_value did
+    picked = -jax.lax.top_k(-flagged, k)[0] if k > 0 else flagged[:0]
+    if k < capacity:
+        starts = jnp.concatenate(
+            [picked, jnp.full(capacity - k, n, dtype=pos.dtype)]
+        ).astype(jnp.int64)
+    else:
+        starts = picked.astype(jnp.int64)
     ends = jnp.minimum(jnp.concatenate(
         [starts[1:], jnp.full(1, n, dtype=starts.dtype)]), kept)
     end_idx = jnp.clip(ends - 1, 0, jnp.maximum(n - 1, 0))
@@ -735,13 +754,46 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
     n_groups = jnp.sum(is_new)
     # slots past n_groups hold garbage — callers slice [:n_groups] / mask
     # with `valid`
-    starts, _ends, end_idx, span_sum = _group_spans(is_new, kept, n, capacity)
+    starts, ends, end_idx, span_sum = _group_spans(is_new, kept, n, capacity)
     # representative row (first of group in sort order = first in original
     # order for equal keys, since the sorts are stable)
     rep_safe = jnp.clip(order[jnp.clip(starts, 0, jnp.maximum(n - 1, 0))],
                         0, jnp.maximum(n - 1, 0))
     key_out = tuple(k[rep_safe] for k in key_cols)
     key_null_out = tuple(kn[rep_safe] for kn in key_nulls)
+    # -- batched count/sum_i path: ALL integer sums and their non-null
+    # counters fold into ONE (m, n) matrix — one axis-1 gather by `order`,
+    # one 2D cumsum, one boundary subtraction. Per-slot gathers+cumsums
+    # were the kernel's dominant cost (~135ms/slot at 6M rows vs ~30ms
+    # batched; measured on v5e over the serving fabric).
+    batch_rows = []          # rows of the (m, n) matrix, pre-sort order
+    slot_plan = {}           # j -> ("count", nn_row) | ("sum_i", nn_row, v_row)
+    nn_rows_by_src = {}      # id(val_nulls[j]) -> row (avg = sum+count over
+    #                          the same column: share one indicator row)
+    for j, opn in enumerate(agg_ops):
+        if opn not in ("count", "sum_i"):
+            continue
+        nn_row = nn_rows_by_src.get(id(val_nulls[j]))
+        if nn_row is None:
+            nn_row = len(batch_rows)
+            batch_rows.append((~(val_nulls[j] | ~mask)).astype(jnp.int64))
+            nn_rows_by_src[id(val_nulls[j])] = nn_row
+        if opn == "count":
+            slot_plan[j] = ("count", nn_row)
+        else:
+            v64 = val_cols[j].astype(jnp.int64)
+            v_row = len(batch_rows)
+            batch_rows.append(jnp.where(val_nulls[j] | ~mask, 0, v64))
+            slot_plan[j] = ("sum_i", nn_row, v_row)
+    spans2d = None
+    if batch_rows:
+        M = jnp.stack(batch_rows, axis=0)          # (m, n)
+        SM = jnp.take(M, order, axis=1)            # one gather
+        C = jnp.concatenate(
+            [jnp.zeros((M.shape[0], 1), dtype=jnp.int64),
+             jnp.cumsum(SM, axis=1)], axis=1)
+        spans2d = C[:, ends] - C[:, jnp.minimum(starts, n)]
+
     results = []
     result_nulls = []
     for j, opn in enumerate(agg_ops):
@@ -751,16 +803,20 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
             results.append(val_cols[j][rep_safe])
             result_nulls.append(val_nulls[j][rep_safe])
             continue
-        v = val_cols[j][order]
-        vn = val_nulls[j][order] | ~in_range
-        nonnull = span_sum((~vn).astype(jnp.int64))
         if opn == "count":
-            results.append(nonnull)
+            _tag, nn_row = slot_plan[j]
+            results.append(spans2d[nn_row])
             result_nulls.append(jnp.zeros(capacity, dtype=bool))
             continue
         if opn == "sum_i":
-            results.append(span_sum(jnp.where(vn, 0, v.astype(jnp.int64))))
-        elif opn == "sum_f":
+            _tag, nn_row, v_row = slot_plan[j]
+            results.append(spans2d[v_row])
+            result_nulls.append(spans2d[nn_row] == 0)
+            continue
+        v = val_cols[j][order]
+        vn = val_nulls[j][order] | ~in_range
+        nonnull = span_sum((~vn).astype(jnp.int64))
+        if opn == "sum_f":
             # segmented scan, NOT prefix-sum differences: c[end]-c[start]
             # carries the whole column's magnitude into each group's
             # rounding error (catastrophic cancellation); the scan resets

@@ -47,6 +47,30 @@ def _alias_map(session, from_node):
     return out
 
 
+def _update_targets(session, stmt, amap):
+    """Exact (db, table) set-targets of a multi-table UPDATE: qualified
+    columns name their table; an unqualified column resolves to the unique
+    join table carrying it (matching the executor's resolution), falling
+    back to all tables only when genuinely ambiguous."""
+    infos = session.infoschema()
+    out = set()
+    for cn, _e in stmt.assignments:
+        if cn.table and cn.table.lower() in amap:
+            out.add(amap[cn.table.lower()])
+            continue
+        if not cn.table:
+            hits = []
+            for db, name in amap.values():
+                info = infos.table_by_name(db, name)
+                if info.find_column(cn.name) is not None:
+                    hits.append((db, name))
+            if len(hits) == 1:
+                out.add(hits[0])
+            else:
+                out.update(amap.values())  # ambiguous: conservative
+    return out
+
+
 def check_stmt_privileges(session, stmt):
     priv = session.domain.priv
     user = session.user
@@ -85,17 +109,7 @@ def check_stmt_privileges(session, stmt):
             # (resolved through their aliases); the rest of the join is a
             # read
             amap = _alias_map(session, stmt.table)
-            seen_t = set()
-            for cn, _e in stmt.assignments:
-                if cn.table and cn.table.lower() in amap:
-                    seen_t.add(amap[cn.table.lower()])
-                elif not cn.table:
-                    hits = [v for v in amap.values()]
-                    if len(amap) == 1:
-                        seen_t.add(hits[0])
-                    else:
-                        seen_t.update(hits)  # ambiguous: conservative
-            for db, name in seen_t:
+            for db, name in _update_targets(session, stmt, amap):
                 priv.verify(user, db, name, "update")
             req_tables(stmt.table, "select")
         req_tables(stmt.where, "select")

@@ -358,13 +358,9 @@ class Session:
                             db, name = amap[key]
                             write_keys.add((db.lower(), name.lower()))
                 else:
-                    for cn, _e in stmt.assignments:
-                        if cn.table and cn.table.lower() in amap:
-                            db, name = amap[cn.table.lower()]
-                            write_keys.add((db.lower(), name.lower()))
-                        elif not cn.table:
-                            for db, name in amap.values():
-                                write_keys.add((db.lower(), name.lower()))
+                    from ..priv_check import _update_targets
+                    for db, name in _update_targets(self, stmt, amap):
+                        write_keys.add((db.lower(), name.lower()))
         elif isinstance(stmt, ast.DropTableStmt):
             targets = list(stmt.tables)
         elif isinstance(stmt, (ast.AlterTableStmt, ast.CreateIndexStmt,
