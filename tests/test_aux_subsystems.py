@@ -164,3 +164,18 @@ def test_cmsketch_int_float_keys_collide(tk):
     assert cm_query(cm, 2) == 20       # int query, float build
     assert cm_query(cm, 2.0) == 20
     assert cm_query(cm, 3.5) == 7
+
+
+def test_admin_checksum_table(tk):
+    """reference: executor/checksum.go + distsql.Checksum — stable,
+    order-independent, change-sensitive."""
+    tk.must_exec("create table ck (id int primary key, v varchar(8))")
+    tk.must_exec("insert into ck values (1,'a'),(2,'b')")
+    r1 = tk.must_query("admin checksum table ck").rows
+    assert int(r1[0][3]) == 2  # total_kvs
+    tk.must_exec("insert into ck values (3,'c')")
+    r2 = tk.must_query("admin checksum table ck").rows
+    assert r1[0][2] != r2[0][2]
+    tk.must_exec("delete from ck where id = 3")
+    r3 = tk.must_query("admin checksum table ck").rows
+    assert r1[0][2] == r3[0][2]

@@ -106,3 +106,54 @@ def test_explain_shows_estimates(tk):
     tk.must_exec("analyze table t")
     p = plan_of(tk, "select id from t where grp = 7")
     assert "idx_grp" in p and "est_rows" in p
+
+
+class TestBatchPointGet:
+    """reference: point_get_plan.go newBatchPointGetPlan +
+    executor/batch_point_get.go."""
+
+    @pytest.fixture()
+    def btk(self):
+        tk = TestKit()
+        tk.must_exec("use test")
+        tk.must_exec("create table bp (id int primary key, a int, "
+                     "v varchar(8), unique key ua (a))")
+        tk.must_exec("insert into bp values "
+                     + ",".join(f"({i},{i + 1000},'v{i}')"
+                                for i in range(200)))
+        tk.must_exec("analyze table bp")
+        return tk
+
+    def _explain(self, tk, q):
+        return "\n".join(" ".join(map(str, r))
+                         for r in tk.must_query("EXPLAIN " + q).rows)
+
+    def test_pk_in_list(self, btk):
+        txt = self._explain(btk, "select * from bp where id in (3, 7, 9)")
+        assert "BatchPointGet" in txt and "handles:3" in txt
+        btk.must_query("select v from bp where id in (3, 7, 9) "
+                       "order by id").check([("v3",), ("v7",), ("v9",)])
+
+    def test_unique_index_in_list(self, btk):
+        txt = self._explain(btk, "select * from bp where a in (1003, 1009)")
+        assert "BatchPointGet" in txt
+        btk.must_query("select id from bp where a in (1003, 1009) "
+                       "order by id").check([("3",), ("9",)])
+
+    def test_missing_keys_skip(self, btk):
+        btk.must_query("select count(*) from bp where id in (1, 99999)"
+                       ).check([("1",)])
+
+    def test_in_txn_sees_uncommitted(self, btk):
+        btk.must_exec("begin")
+        btk.must_exec("update bp set v = 'dirty' where id = 3")
+        btk.must_query("select v from bp where id in (3, 4) order by id"
+                       ).check([("dirty",), ("v4",)])
+        btk.must_exec("rollback")
+
+    def test_duplicate_in_values_return_one_row(self, btk):
+        """Regression: IN (3, 3) must not fetch the row twice."""
+        btk.must_query("select count(*) from bp where id in (3, 3)").check(
+            [("1",)])
+        btk.must_query("select count(*) from bp where a in (1003, 1003)"
+                       ).check([("1",)])

@@ -143,3 +143,17 @@ class TestGCWorkerLoop:
         st = gc.status()
         assert st["run_interval_s"] == 1.0
         tk.must_query("select v from t where id = 1").check([("2",)])
+
+
+class TestSafepointReadGuard:
+    def test_read_below_safepoint_rejected(self, tk):
+        """reference: store/driver ErrGCTooEarly (9006)."""
+        store = tk.session.store
+        old_ts = store.next_ts()
+        tk.session.domain.gc_worker.run_once(safe_point=store.next_ts())
+        import pytest as _pytest
+        from tidb_tpu.errors import TiDBError
+        with _pytest.raises(TiDBError) as ei:
+            store.begin(start_ts=old_ts)
+        assert ei.value.code == 9006
+        store.begin()  # fresh read views still fine

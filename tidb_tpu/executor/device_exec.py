@@ -111,8 +111,16 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
             key_cols = [jnp.zeros(n, dtype=jnp.int64)]
             key_nulls = [jnp.zeros(n, dtype=bool)]
         val_cols, val_nulls = [], []
+        # one eval per distinct compiled expr: AVG plans (sum, count) over
+        # the SAME fn — sharing the traced (d, nl) lets the kernel's
+        # identity-based null-row dedup fire and XLA CSE the value rows
+        evaled = {}
         for f, conv in val_plan:
-            d, nl = dev.broadcast_1d(*f(env), n)
+            hit = evaled.get(id(f))
+            if hit is None:
+                hit = dev.broadcast_1d(*f(env), n)
+                evaled[id(f)] = hit
+            d, nl = hit
             if conv == "int":
                 d = d.astype(jnp.int64)
             val_cols.append(d)

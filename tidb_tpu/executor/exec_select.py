@@ -95,6 +95,31 @@ def eval_conds_mask(conds, chunk: Chunk) -> np.ndarray:
     return mask
 
 
+def resolve_access_handles(tbl, access) -> list:
+    """Planner access descriptor → row handles, via the (partition-aware)
+    Table. ONE resolver shared by the read path and the SELECT FOR UPDATE
+    lock path — they must fetch/lock the same row set."""
+    kind = access[0]
+    if kind == "point_pk":
+        return [access[1]]
+    if kind == "point_index":
+        _k, idx, vals = access
+        h = tbl.index_lookup(idx, vals)
+        return [] if h is None else [h]
+    if kind == "batch_pk":
+        return list(access[1])
+    if kind == "batch_index":
+        _k, idx, values = access
+        out = []
+        for v in values:
+            h = tbl.index_lookup(idx, [v])
+            if h is not None:
+                out.append(h)
+        return out
+    _k, idx, lo, hi = access
+    return tbl.index_scan_handles(idx, lo_vals=lo, hi_vals=hi)
+
+
 class TableScanExec(QueryExecutor):
     def _access_chunk(self, txn):
         """Row fetch via the planner-chosen access path (PointGet /
@@ -105,16 +130,7 @@ class TableScanExec(QueryExecutor):
         from ..table import Table, rows_to_chunk
         p = self.plan
         tbl = Table(p.table_info, txn, parts=p.partitions)
-        kind = p.access[0]
-        if kind == "point_pk":
-            handles = [p.access[1]]
-        elif kind == "point_index":
-            _k, idx, vals = p.access
-            h = tbl.index_lookup(idx, vals)
-            handles = [] if h is None else [h]
-        else:
-            _k, idx, lo, hi = p.access
-            handles = tbl.index_scan_handles(idx, lo_vals=lo, hi_vals=hi)
+        handles = resolve_access_handles(tbl, p.access)
         rowdicts = []
         kept = []
         for h in handles:

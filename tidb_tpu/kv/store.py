@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 
-from ..errors import TiDBError, WriteConflictError
+from ..errors import ErrCode, TiDBError, WriteConflictError
 from .mvcc import MVCCStore, OP_DEL, OP_LOCK, OP_PUT
 
 _MISSING = object()
@@ -237,10 +237,24 @@ class Storage:
         return self.mvcc.tso.next_ts()
 
     def begin(self, start_ts: int | None = None) -> Transaction:
+        if start_ts is not None:
+            self._check_safepoint(start_ts)
         return Transaction(self, start_ts if start_ts is not None else self.next_ts())
 
     def get_snapshot(self, ts: int | None = None) -> Snapshot:
+        if ts is not None:
+            self._check_safepoint(ts)
         return Snapshot(self, ts if ts is not None else self.next_ts())
+
+    def _check_safepoint(self, ts: int):
+        """A read view below the GC safepoint would see a history that GC
+        already pruned (reference: store/driver checks GC safepoint and
+        returns ErrGCTooEarly 9006)."""
+        sp = getattr(self.mvcc, "safe_point", 0)
+        if sp and ts < sp:
+            raise TiDBError(
+                "GC life time is shorter than transaction duration",
+                code=ErrCode.GCTooEarly)
 
     def current_version(self) -> int:
         return self.next_ts()

@@ -2235,6 +2235,12 @@ class Parser:
             if self._accept_kw("jobs"):
                 return ast.AdminStmt(kind="show_ddl_jobs")
             return ast.AdminStmt(kind="show_ddl")
+        if self._accept_kw("checksum"):
+            self._expect_kw("table")
+            tables = [self._parse_table_name()]
+            while self._accept_op(","):
+                tables.append(self._parse_table_name())
+            return ast.AdminStmt(kind="checksum_table", tables=tables)
         if self._accept_kw("cancel"):
             self._expect_kw("ddl")
             self._expect_kw("jobs")

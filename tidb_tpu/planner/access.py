@@ -87,10 +87,11 @@ def _choose(ds: DataSource, ctx):
         elif op in ("lt", "le", "gt", "ge") and isinstance(v, (int, float)):
             rngs.setdefault(col.idx, []).append((op, v))
             by_idx.setdefault(col.idx, []).append(c)
-    if not eq and not rngs:
-        return
     allowed, excluded, forced = _hint_sets(ds)
     name2idx = {ci.name: i for i, ci in enumerate(ds.col_infos)}
+    if not eq and not rngs:
+        _choose_batch(ds, info, name2idx, allowed, excluded)
+        return
 
     # 1. PointGet on the integer primary key stored as the row handle
     if info.pk_is_handle:
@@ -118,6 +119,11 @@ def _choose(ds: DataSource, ctx):
                 ds.access = ("point_index", idx, vals)
                 ds.access_est = 1
                 return
+
+    # 2.5 BatchPointGet candidates exist alongside eq/range conds too
+    _choose_batch(ds, info, name2idx, allowed, excluded)
+    if ds.access is not None:
+        return
 
     # 3. cost-based index range scan vs full columnar scan
     stats = (ctx.table_stats(info.id)
@@ -171,6 +177,41 @@ def _choose(ds: DataSource, ctx):
     if forced or best[0] < cost_full:
         ds.access = best[1]
         ds.access_est = int(best[2])
+
+
+def _choose_batch(ds, info, name2idx, allowed, excluded):
+    """BatchPointGet: col IN (c1..cn) on the handle pk or a single-column
+    unique index (reference: planner/core/point_get_plan.go
+    newBatchPointGetPlan, executor/batch_point_get.go)."""
+    from ..expression.core import Column as _Col
+    from ..expression.core import ScalarFunc as _SF
+    for c in ds.pushed_conds:
+        if not (isinstance(c, _SF) and c.op == "in_set" and c.extra):
+            continue
+        t = c.args[0]
+        if not isinstance(t, _Col):
+            continue
+        # dict.fromkeys dedups while keeping first-seen order: IN (3, 3)
+        # must fetch the row ONCE (the post-filter passes every copy)
+        values = list(dict.fromkeys(
+            v.item() if isinstance(v, np.generic) else v
+            for v in c.extra[0]))
+        if not values or len(values) > 1024:
+            continue
+        if (info.pk_is_handle and t.idx < len(ds.col_infos)
+                and ds.col_infos[t.idx].id == info.pk_col_id
+                and all(_int_like(v) for v in values)):
+            ds.access = ("batch_pk", [int(v) for v in values])
+            ds.access_est = len(values)
+            return
+        for idx in info.indexes:
+            if (idx.state == SchemaState.PUBLIC and idx.unique
+                    and len(idx.columns) == 1
+                    and _idx_allowed(idx, allowed, excluded)
+                    and name2idx.get(idx.columns[0].name) == t.idx):
+                ds.access = ("batch_index", idx, values)
+                ds.access_est = len(values)
+                return
 
 
 def _idx_bound(v):

@@ -42,6 +42,8 @@ class DataSource(LogicalPlan):
             kind = self.access[0]
             if kind in ("point_pk", "point_index"):
                 return "PointGet"
+            if kind in ("batch_pk", "batch_index"):
+                return "BatchPointGet"
             return "IndexLookUp"
         return "TableScan"
 
@@ -60,6 +62,10 @@ class DataSource(LogicalPlan):
                 s += f", handle:{self.access[1]}"
             elif kind == "point_index":
                 s += f", index:{self.access[1].name}"
+            elif kind == "batch_pk":
+                s += f", handles:{len(self.access[1])}"
+            elif kind == "batch_index":
+                s += f", index:{self.access[1].name}, keys:{len(self.access[2])}"
             else:
                 _k, idx, lo, hi = self.access
                 s += (f", index:{idx.name}, range:[{lo},{hi}]"

@@ -1127,17 +1127,9 @@ class Session:
                             # drive from the chosen access path instead of
                             # a full scan (reference: SelectLockExec locks
                             # the reader's returned row keys)
-                            kind = p.access[0]
-                            if kind == "point_pk":
-                                handles = [p.access[1]]
-                            elif kind == "point_index":
-                                h = tbl.index_lookup(p.access[1],
-                                                     p.access[2])
-                                handles = [] if h is None else [h]
-                            else:
-                                _k, idx, lo, hi = p.access
-                                handles = tbl.index_scan_handles(
-                                    idx, lo_vals=lo, hi_vals=hi)
+                            from ..executor.exec_select import (
+                                resolve_access_handles)
+                            handles = resolve_access_handles(tbl, p.access)
                             for h in handles:
                                 keys.append(tablecodec.record_key(
                                     p.table_info.id, int(h)))
@@ -1350,6 +1342,39 @@ class Session:
                           chunk=Chunk.from_rows(
                               [ft_s, ft_s, ft_s],
                               [(b"local-only", status, payload.encode())]))
+        if stmt.kind == "checksum_table":
+            # order-independent table checksum over record KVs (reference:
+            # distsql.Checksum + executor/checksum.go; XOR of per-kv crcs
+            # commutes, so partition/scan order never matters)
+            import zlib
+            from .. import tablecodec
+            ft_s = FieldType(tp=TYPE_VARCHAR)
+            ft_i = FieldType(tp=TYPE_LONGLONG)
+            rows = []
+            txn = self.store.begin()
+            try:
+                for tn in stmt.tables:
+                    db = tn.schema or self.current_db()
+                    info = self.infoschema().table_by_name(db, tn.name)
+                    phys = ([d.id for d in info.partition.defs]
+                            if info.partition is not None else [info.id])
+                    acc = 0
+                    n_kvs = 0
+                    n_bytes = 0
+                    for pid in phys:
+                        start, end = tablecodec.table_range(pid)
+                        for k, v in txn.scan(start, end):
+                            acc ^= zlib.crc32(v, zlib.crc32(k))
+                            n_kvs += 1
+                            n_bytes += len(k) + len(v)
+                    rows.append((db.encode(), tn.name.encode(), acc,
+                                 n_kvs, n_bytes))
+            finally:
+                txn.rollback()
+            return Result(names=["Db_name", "Table_name", "Checksum_crc64_xor",
+                                 "Total_kvs", "Total_bytes"],
+                          chunk=Chunk.from_rows(
+                              [ft_s, ft_s, ft_i, ft_i, ft_i], rows))
         if stmt.kind == "show_ddl_jobs":
             txn = self.store.begin()
             try:
