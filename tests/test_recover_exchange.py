@@ -1,0 +1,174 @@
+"""Delayed delete-ranges: RECOVER/FLASHBACK TABLE and EXCHANGE PARTITION
+(reference: ddl/delete_range.go, ddl_api.go RecoverTable,
+partition.go onExchangeTablePartition, gc_worker.go:691 deleteRanges)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+class TestRecoverTable:
+    def test_recover_restores_schema_and_data(self, tk):
+        tk.must_exec("create table t (id int primary key, v varchar(6), "
+                     "key iv (v))")
+        tk.must_exec("insert into t values (1,'a'),(2,'b')")
+        tk.must_exec("drop table t")
+        assert "doesn't exist" in str(tk.exec_error("select * from t"))
+        tk.must_exec("recover table t")
+        tk.must_query("select v from t order by id").check([("a",), ("b",)])
+        # index survives too
+        tk.must_query("select id from t where v = 'b'").check([("2",)])
+        # the table is fully writable again
+        tk.must_exec("insert into t values (3, 'c')")
+        tk.must_query("select count(*) from t").check([("3",)])
+
+    def test_flashback_to_new_name(self, tk):
+        tk.must_exec("create table t (id int primary key)")
+        tk.must_exec("insert into t values (7)")
+        tk.must_exec("drop table t")
+        tk.must_exec("flashback table t to t2")
+        tk.must_query("select id from t2").check([("7",)])
+        assert "doesn't exist" in str(tk.exec_error("select * from t"))
+
+    def test_recover_blocked_when_name_taken(self, tk):
+        tk.must_exec("create table t (id int primary key)")
+        tk.must_exec("drop table t")
+        tk.must_exec("create table t (x int)")
+        e = tk.exec_error("recover table t")
+        assert "already exists" in str(e)
+        tk.must_exec("flashback table t to t_old")  # rename form still works
+
+    def test_partitioned_table_recovers(self, tk):
+        tk.must_exec("create table p (a int) partition by hash (a) "
+                     "partitions 2")
+        tk.must_exec("insert into p values (1),(2),(3)")
+        tk.must_exec("drop table p")
+        tk.must_exec("recover table p")
+        tk.must_query("select count(*) from p").check([("3",)])
+
+    def test_gc_makes_recovery_impossible_and_purges(self, tk):
+        tk.must_exec("create table g (id int primary key)")
+        tk.must_exec("insert into g values (9)")
+        tk.must_exec("drop table g")
+        store = tk.session.store
+        res = tk.session.domain.gc_worker.run_once(
+            safe_point=store.next_ts())
+        assert res["delete_ranges"] >= 2  # record + index ranges
+        e = tk.exec_error("recover table g")
+        assert "GC safe point" in str(e)
+
+    def test_drop_before_safepoint_survives_gc(self, tk):
+        """A drop NEWER than the safepoint stays recoverable after a GC
+        round."""
+        tk.must_exec("create table keepme (id int primary key)")
+        tk.must_exec("insert into keepme values (1)")
+        store = tk.session.store
+        sp = store.next_ts()
+        tk.must_exec("drop table keepme")  # drop_ts > sp
+        tk.session.domain.gc_worker.run_once(safe_point=sp)
+        tk.must_exec("recover table keepme")
+        tk.must_query("select id from keepme").check([("1",)])
+
+
+class TestExchangePartition:
+    def test_swap_is_o1_and_bidirectional(self, tk):
+        tk.must_exec("create table pt (a int, v int) "
+                     "partition by range (a) "
+                     "(partition p0 values less than (10), "
+                     "partition p1 values less than (20))")
+        tk.must_exec("insert into pt values (1, 100), (15, 200)")
+        tk.must_exec("create table swap (a int, v int)")
+        tk.must_exec("insert into swap values (5, 999)")
+        tk.must_exec("alter table pt exchange partition p0 with table swap")
+        tk.must_query("select v from pt order by a").check(
+            [("999",), ("200",)])
+        tk.must_query("select v from swap").check([("100",)])
+        # swap back
+        tk.must_exec("alter table pt exchange partition p0 with table swap")
+        tk.must_query("select v from pt order by a").check(
+            [("100",), ("200",)])
+
+    def test_validation_rejects_out_of_range_rows(self, tk):
+        tk.must_exec("create table pt (a int, v int) "
+                     "partition by range (a) "
+                     "(partition p0 values less than (10), "
+                     "partition p1 values less than (20))")
+        tk.must_exec("create table bad (a int, v int)")
+        tk.must_exec("insert into bad values (50, 1)")  # outside p0
+        e = tk.exec_error(
+            "alter table pt exchange partition p0 with table bad")
+        assert "does not match the partition" in str(e)
+        # WITHOUT VALIDATION skips the scan (operator's responsibility)
+        tk.must_exec("alter table pt exchange partition p0 with table bad "
+                     "without validation")
+        # WITH VALIDATION parses too
+        tk.must_exec("alter table pt exchange partition p0 with table bad "
+                     "with validation")
+
+    def test_index_set_must_match(self, tk):
+        tk.must_exec("create table pt (a int, v int) partition by hash (a) "
+                     "partitions 2")
+        tk.must_exec("create table noidx (a int, v int, key iv (v))")
+        e = tk.exec_error(
+            "alter table pt exchange partition p0 with table noidx")
+        assert "different definitions" in str(e)
+
+    def test_exchange_preserves_autoincrement(self, tk):
+        tk.must_exec("create table pt (id int primary key auto_increment, "
+                     "v int) partition by hash (id) partitions 2")
+        tk.must_exec("create table sw (id int primary key auto_increment, "
+                     "v int)")
+        tk.must_exec("insert into sw (v) values (1), (2), (3)")
+        tk.must_exec("alter table pt exchange partition p0 with table sw "
+                     "without validation")
+        # the exchanged-out table keeps allocating past its old rows
+        tk.must_exec("insert into sw (v) values (4)")
+        ids = [int(r[0]) for r in tk.must_query(
+            "select id from sw order by id").rows]
+        assert ids[-1] >= 4 and len(ids) == len(set(ids))
+
+    def test_exchange_requires_privs_on_other_table(self, tk):
+        tk.must_exec("create table pt (a int) partition by hash (a) "
+                     "partitions 2")
+        tk.must_exec("create table victim (a int)")
+        tk.must_exec("create user 'alt'@'%'")
+        tk.must_exec("grant select, alter on test.pt to 'alt'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "alt@%"
+        e = tk2.exec_error(
+            "alter table pt exchange partition p0 with table victim")
+        assert "denied" in str(e).lower()
+
+    def test_recover_requires_privs(self, tk):
+        tk.must_exec("create table secret (id int primary key)")
+        tk.must_exec("drop table secret")
+        tk.must_exec("create user 'nop'@'%'")
+        tk.must_exec("grant select on test.* to 'nop'@'%'")
+        tk2 = tk.new_session()
+        tk2.session.user = "nop@%"
+        e = tk2.exec_error("flashback table secret to mine")
+        assert "denied" in str(e).lower()
+
+    def test_schema_mismatch_rejected(self, tk):
+        tk.must_exec("create table pt (a int) partition by hash (a) "
+                     "partitions 2")
+        tk.must_exec("create table bad (a int, extra varchar(4))")
+        e = tk.exec_error(
+            "alter table pt exchange partition p0 with table bad")
+        assert "different definitions" in str(e)
+
+    def test_partitioned_exchange_target_rejected(self, tk):
+        tk.must_exec("create table pt (a int) partition by hash (a) "
+                     "partitions 2")
+        tk.must_exec("create table pt2 (a int) partition by hash (a) "
+                     "partitions 2")
+        e = tk.exec_error(
+            "alter table pt exchange partition p0 with table pt2")
+        assert "plain base table" in str(e)

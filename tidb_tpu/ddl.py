@@ -317,8 +317,19 @@ class DDLExecutor:
             def fn(m, job, _db=db, _tbl=tbl):
                 m.drop_table(_db.id, _tbl.id)
                 if not _tbl.is_view:
-                    self._delete_table_data(_tbl)
+                    # data deletion is DEFERRED to the GC worker past the
+                    # safepoint; until then RECOVER/FLASHBACK TABLE can
+                    # resurrect catalog + data (reference:
+                    # ddl/delete_range.go + RecoverTable)
+                    self._defer_table_data(m, _tbl, job.start_ts)
+                    m.set_dropped_table(_db.id, _tbl, job.start_ts)
             self._run_job(fn, "drop_table", schema_id=db.id, table_id=tbl.id)
+            # the deferred delete keeps KV data for RECOVER, but the
+            # columnar cache's materialized arrays serve no one anymore
+            ids = [tbl.id] + ([d.id for d in tbl.partition.defs]
+                              if tbl.partition is not None else [])
+            for tid in ids:
+                sess.domain.columnar_cache.invalidate(tid)
 
     def _temp_info(self, tn: ast.TableName):
         sess = self.session
@@ -491,6 +502,10 @@ class DDLExecutor:
                 self._alter_drop_partition(db, tbl, spec[1])
             elif kind == "truncate_partition":
                 self._alter_truncate_partition(db, tbl, spec[1])
+            elif kind == "exchange_partition":
+                self._alter_exchange_partition(db, tbl, spec[1], spec[2],
+                                               spec[3] if len(spec) > 3
+                                               else True)
             else:
                 raise TiDBError(f"unsupported ALTER TABLE action {kind}",
                                 code=ErrCode.UnsupportedDDL)
@@ -762,6 +777,78 @@ class DDLExecutor:
         for d in dropped:
             self._delete_table_data(d.id)
 
+    def _alter_exchange_partition(self, db, tbl, pname, other_tn,
+                                  validate=True):
+        """ALTER TABLE t EXCHANGE PARTITION p WITH TABLE t2: swap the
+        partition's physical id with the plain table's id — O(1), no data
+        movement (reference: ddl/partition.go onExchangeTablePartition).
+        By default every incoming row must satisfy the partition's bound
+        (WITHOUT VALIDATION skips the scan, matching MySQL)."""
+        sess = self.session
+        if tbl.partition is None:
+            raise TiDBError("Partition management on a not partitioned "
+                            "table is not possible",
+                            code=ErrCode.PartitionMgmtOnNonpartitioned)
+        other_db_name = other_tn.schema or sess.current_db()
+        infos = sess.infoschema()
+        other_db = infos.schema_by_name(other_db_name)
+        other = infos.table_by_name(other_db_name, other_tn.name)
+        if other.partition is not None or other.is_view or other.is_sequence:
+            raise TiDBError(
+                "Table to exchange with partition must be a plain base "
+                "table", code=ErrCode.WrongObject)
+
+        def shape(t):
+            return ([(c.name.lower(), c.ftype.tp)
+                     for c in t.public_columns()],
+                    # index IDS must line up too: index keys embed the id,
+                    # so differently-numbered indexes would make the swapped
+                    # data unreadable through the other table's index set
+                    [(i.id, i.name.lower(),
+                      tuple(ic.name.lower() for ic in i.columns), i.unique)
+                     for i in t.indexes])
+        if shape(tbl) != shape(other):
+            raise TiDBError(
+                "Tables have different definitions",
+                code=ErrCode.UnsupportedDDL)
+
+        def fn(m, job):
+            from .partition import locate_partition, make_part_fn
+            from .table import Table as _Table
+            t = m.get_table(db.id, tbl.id)
+            o = m.get_table(other_db.id, other.id)
+            d = t.partition.find_def(pname)
+            if d is None:
+                raise TiDBError(f"Unknown partition '{pname}' in table "
+                                f"'{t.name}'", code=ErrCode.UnknownPartition)
+            if validate:
+                # every incoming row must route to THIS partition
+                # (reference error: ErrRowDoesNotMatchPartition)
+                pf = make_part_fn(t)
+                for _h, row in _Table(o, m.txn).iter_rows():
+                    try:
+                        target = locate_partition(t.partition, pf(row))
+                    except TiDBError:
+                        target = None
+                    if target is None or target.id != d.id:
+                        raise TiDBError(
+                            "Found a row that does not match the partition",
+                            code=ErrCode.RowDoesNotMatchPartition)
+            # the swap IS the exchange: record/index keys stay where they
+            # are, only ownership flips — autoid counters follow the ids
+            a_part, a_other = m.autoid(d.id), m.autoid(o.id)
+            m.set_autoid(d.id, a_other)
+            m.set_autoid(o.id, a_part)
+            d.id, o.id = o.id, d.id
+            m.drop_table(other_db.id, other.id)
+            m.create_table(other_db.id, o)
+            m.update_table(db.id, t)
+        self._run_job(fn, "exchange_partition", schema_id=db.id,
+                      table_id=tbl.id)
+        for tid in (other.id, *(d.id for d in tbl.partition.defs)):
+            sess.domain.columnar_cache.invalidate(tid)
+            sess.store.mvcc.bump_table_version(tid)
+
     def _alter_truncate_partition(self, db, tbl, names):
         if tbl.partition is None:
             raise TiDBError("Partition management on a not partitioned table "
@@ -785,7 +872,55 @@ class DDLExecutor:
         for oid in old_ids:
             self._delete_table_data(oid)
 
+    def recover_table(self, stmt: ast.RecoverTableStmt):
+        """RECOVER/FLASHBACK TABLE: undo a DROP whose delete-ranges the GC
+        worker has not yet processed (reference: ddl/ddl_api.go
+        RecoverTable — same table id, data untouched)."""
+        sess = self.session
+        db_name = stmt.table.schema or sess.current_db()
+        infos = sess.infoschema()
+        db = infos.schema_by_name(db_name)
+        if db is None:
+            raise SchemaError(f"Unknown database '{db_name}'",
+                              code=ErrCode.BadDB)
+        target_name = stmt.new_name or stmt.table.name
+        if infos.has_table(db_name, target_name):
+            raise SchemaError(f"Table '{target_name}' already exists",
+                              code=ErrCode.TableExists)
+
+        def fn(m, job):
+            cands = [(k, dbid, t, ts) for k, dbid, t, ts in
+                     m.dropped_tables()
+                     if dbid == db.id
+                     and t.name.lower() == stmt.table.name.lower()]
+            if not cands:
+                raise TiDBError(
+                    f"Can't find dropped/truncated table '{stmt.table.name}'"
+                    " in GC safe point", code=ErrCode.BadTable)
+            _k, _dbid, tbl, _ts = max(cands, key=lambda c: c[3])
+            tbl.name = target_name
+            m.create_table(db.id, tbl)
+            for key, rec in m.delete_ranges():
+                if rec["owner"] == tbl.id:
+                    m.remove_delete_range(key)
+            m.remove_dropped_table(tbl.id)
+            job.table_id = tbl.id
+        self._run_job(fn, "recover_table", schema_id=db.id)
+
     # -- internals ----------------------------------------------------------
+
+    def _defer_table_data(self, m: Meta, tbl: TableInfo, ts: int):
+        """Queue every physical range of the table for GC-time deletion."""
+        ids = [tbl.id]
+        if tbl.partition is not None:
+            ids += [d.id for d in tbl.partition.defs]
+        for tid in ids:
+            start, end = tablecodec.table_range(tid)
+            m.enqueue_delete_range(tbl.id, start, end, ts)
+            pfx = tablecodec.TABLE_PREFIX + tablecodec._enc_i64(tid)
+            m.enqueue_delete_range(
+                tbl.id, pfx + tablecodec.INDEX_SEP,
+                pfx + tablecodec.INDEX_SEP + b"\xff" * 17, ts)
 
     def _delete_table_data(self, table_or_id):
         """reference: ddl/delete_range.go — here immediate range delete.

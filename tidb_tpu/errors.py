@@ -87,6 +87,7 @@ class ErrCode:
     TableNotLocked = 1100
     TableNotLockedForWrite = 1099
     OptOnCacheTable = 8242
+    RowDoesNotMatchPartition = 1737
     PartitionFunctionIsNotAllowed = 1564
     UnknownPartition = 1735
     OnlyOnRangeListPartition = 1512

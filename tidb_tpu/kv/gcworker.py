@@ -110,6 +110,7 @@ class GCWorker:
             return {"safe_point": self.safe_point, "skipped": True}
         resolved = self._resolve_stale_locks(sp)
         store.mvcc.gc(sp)
+        ranges_done = self._process_delete_ranges(sp)
         with self._lock:
             self.safe_point = sp
             self.last_run = time.time()
@@ -120,7 +121,44 @@ class GCWorker:
             obs.inc("gc_runs_total")
             obs.inc("gc_locks_resolved_total", resolved)
         return {"safe_point": sp, "resolved_locks": resolved,
-                "skipped": False}
+                "delete_ranges": ranges_done, "skipped": False}
+
+    def _process_delete_ranges(self, safe_point: int) -> int:
+        """Physically delete ranges dropped before the safepoint; while an
+        entry is pending, RECOVER/FLASHBACK TABLE can still resurrect the
+        data (reference: gc_worker.go:691 deleteRanges +
+        ddl/delete_range.go)."""
+        from ..meta import Meta
+        store = self.domain.store
+        # serialized against DDL (RECOVER rewrites the same meta keys), and
+        # the meta claim COMMITS BEFORE any physical delete: once committed,
+        # RECOVER can no longer find the entries, so it can never resurrect
+        # a schema whose data this round is about to purge. A crash after
+        # commit leaks orphan KV ranges (space, not correctness).
+        with self.domain.ddl_lock:
+            txn = store.begin()
+            to_delete = []
+            try:
+                m = Meta(txn)
+                gone_owners = set()
+                live_owners = set()
+                for key, rec in m.delete_ranges():
+                    if rec["ts"] < safe_point:
+                        to_delete.append((bytes.fromhex(rec["start"]),
+                                          bytes.fromhex(rec["end"])))
+                        m.remove_delete_range(key)
+                        gone_owners.add(rec["owner"])
+                    else:
+                        live_owners.add(rec["owner"])
+                for owner in gone_owners - live_owners:
+                    m.remove_dropped_table(owner)
+                txn.commit()
+            except Exception:
+                txn.rollback()
+                return 0
+        for start, end in to_delete:
+            store.mvcc.raw_delete_range(start, end)
+        return len(to_delete)
 
     def _resolve_stale_locks(self, safe_point: int) -> int:
         """Percolator crash recovery for locks abandoned before the

@@ -24,6 +24,8 @@ KEY_BOOTSTRAP = M + b":bootstrapped"
 KEY_STATS_PREFIX = M + b":stats:"    # m:stats:{tid} -> stats json
 KEY_BINDING_PREFIX = M + b":bind:"   # m:bind:{digest} -> binding json
 KEY_SEQ_PREFIX = M + b":seq:"        # m:seq:{tid} -> last allocated value
+KEY_DELRANGE_PREFIX = M + b":delrange:"  # m:delrange:{id} -> pending range
+KEY_DROPPED_PREFIX = M + b":dropped:"    # m:dropped:{tid} -> dropped table
 
 
 class Meta:
@@ -246,6 +248,44 @@ class Meta:
         count = max(min(int(want), avail), 1)
         self.set_sequence_value(table_id, first + (count - 1) * inc)
         return first, count
+
+    # -- delayed delete-ranges + dropped tables (reference:
+    #    ddl/delete_range.go gc_delete_range + RecoverTable) ----------------
+
+    def enqueue_delete_range(self, owner_tid: int, start: bytes, end: bytes,
+                             ts: int):
+        rid = self.gen_global_id()
+        self._put_json(KEY_DELRANGE_PREFIX + str(rid).encode(),
+                       {"owner": owner_tid, "start": start.hex(),
+                        "end": end.hex(), "ts": ts})
+
+    def delete_ranges(self):
+        """[(key, {owner, start, end, ts})] pending physical deletions."""
+        out = []
+        for k, v in self.txn.scan(KEY_DELRANGE_PREFIX,
+                                  KEY_DELRANGE_PREFIX + b"\xff"):
+            out.append((k, json.loads(v.decode())))
+        return out
+
+    def remove_delete_range(self, key: bytes):
+        self.txn.delete(key)
+
+    def set_dropped_table(self, db_id: int, tbl: TableInfo, drop_ts: int):
+        self._put_json(KEY_DROPPED_PREFIX + str(tbl.id).encode(),
+                       {"db_id": db_id, "table": tbl.to_json(),
+                        "ts": drop_ts})
+
+    def dropped_tables(self):
+        out = []
+        for k, v in self.txn.scan(KEY_DROPPED_PREFIX,
+                                  KEY_DROPPED_PREFIX + b"\xff"):
+            d = json.loads(v.decode())
+            out.append((k, d["db_id"], TableInfo.from_json(d["table"]),
+                        d["ts"]))
+        return out
+
+    def remove_dropped_table(self, tid: int):
+        self.txn.delete(KEY_DROPPED_PREFIX + str(tid).encode())
 
     # -- plan bindings (reference: mysql.bind_info + bindinfo/handle.go) -----
 

@@ -687,6 +687,23 @@ class DropBindingStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class RecoverTableStmt(StmtNode):
+    """RECOVER TABLE t / FLASHBACK TABLE t [TO new] (reference:
+    ddl/ddl_api.go RecoverTable + FlashbackTable over delayed
+    delete-ranges)."""
+    table: TableName = None
+    new_name: str = ""
+    flashback: bool = False
+
+    def restore(self):
+        kw = "FLASHBACK" if self.flashback else "RECOVER"
+        s = f"{kw} TABLE {self.table.restore()}"
+        if self.new_name:
+            s += f" TO `{self.new_name}`"
+        return s
+
+
+@dataclass(repr=False)
 class LockTablesStmt(StmtNode):
     """LOCK TABLES t READ|WRITE, ... (reference: ddl/table_lock.go)."""
     items: list = field(default_factory=list)  # [(TableName, "read"|"write")]

@@ -156,6 +156,15 @@ class Parser:
             self.pos += 1
             self._accept_kw("table")
             return ast.TruncateTableStmt(table=self._parse_table_name())
+        if kw in ("recover", "flashback"):
+            self.pos += 1
+            self._expect_kw("table")
+            tn = self._parse_table_name()
+            new_name = ""
+            if kw == "flashback" and self._accept_kw("to"):
+                new_name = self._ident()
+            return ast.RecoverTableStmt(table=tn, new_name=new_name,
+                                        flashback=(kw == "flashback"))
         if kw == "lock":
             self.pos += 1
             if not (self._accept_kw("tables") or self._accept_kw("table")):
@@ -2022,6 +2031,20 @@ class Parser:
                     self._accept_kw("to")
                     self._accept_kw("as")
                     stmt.specs.append(("rename", self._parse_table_name()))
+            elif self._accept_kw("exchange"):
+                self._expect_kw("partition")
+                pname = self._ident()
+                self._expect_kw("with")
+                self._expect_kw("table")
+                other = self._parse_table_name()
+                validate = True
+                if self._accept_kw("without"):
+                    self._expect_kw("validation")
+                    validate = False
+                elif self._accept_kw("with"):
+                    self._expect_kw("validation")
+                stmt.specs.append(("exchange_partition", pname, other,
+                                   validate))
             elif self._accept_kw("cache"):
                 stmt.specs.append(("cache", True))
             elif self._accept_kw("nocache"):

@@ -155,6 +155,21 @@ def check_stmt_privileges(session, stmt):
     elif isinstance(stmt, ast.AlterTableStmt):
         priv.verify(user, stmt.table.schema or session.current_db(),
                     stmt.table.name, "alter")
+        for spec in stmt.specs:
+            if spec[0] == "exchange_partition":
+                # the other table's contents are swapped away wholesale
+                # (reference: MySQL requires ALTER/INSERT/CREATE/DROP on
+                # both tables)
+                other = spec[2]
+                odb = other.schema or session.current_db()
+                for p in ("alter", "insert", "drop"):
+                    priv.verify(user, odb, other.name, p)
+    elif isinstance(stmt, ast.RecoverTableStmt):
+        # resurrecting a dropped table is at least as powerful as
+        # CREATE + the DROP it undoes
+        db = stmt.table.schema or session.current_db()
+        priv.verify(user, db, stmt.new_name or stmt.table.name, "create")
+        priv.verify(user, db, stmt.table.name, "drop")
     elif isinstance(stmt, ast.CreateDatabaseStmt):
         priv.verify(user, stmt.name, "", "create")
     elif isinstance(stmt, ast.DropDatabaseStmt):

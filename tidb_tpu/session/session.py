@@ -901,6 +901,10 @@ class Session:
         if isinstance(stmt, ast.DropSequenceStmt):
             self.ddl.drop_sequence(stmt)
             return Result()
+        if isinstance(stmt, ast.RecoverTableStmt):
+            self._implicit_commit()
+            self.ddl.recover_table(stmt)
+            return Result()
         if isinstance(stmt, ast.CreateBindingStmt):
             from ..bindinfo import make_binding
             key, rec = make_binding(stmt.original, stmt.hinted,
