@@ -157,3 +157,13 @@ class TestSafepointReadGuard:
             store.begin(start_ts=old_ts)
         assert ei.value.code == 9006
         store.begin()  # fresh read views still fine
+
+
+def test_gc_status_memtable(tk):
+    tk.session.domain.gc_worker.run_once(
+        safe_point=tk.session.store.next_ts())
+    rows = dict(tk.must_query(
+        "select variable_name, variable_value from "
+        "information_schema.gc_status").rows)
+    assert int(rows["tikv_gc_safe_point"]) > 0
+    assert int(rows["tikv_gc_runs"]) >= 1

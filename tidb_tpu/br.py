@@ -195,7 +195,14 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
                 w = csv.writer(f)
                 w.writerow(res.names)
                 for r in rows:
-                    w.writerow(["\\N" if v is None else v for v in r])
+                    # NULL sentinel is \N; a LITERAL leading backslash is
+                    # escaped by doubling so the reader can tell them apart
+                    # (mydumper-style)
+                    w.writerow([
+                        "\\N" if v is None
+                        else ("\\" + v if isinstance(v, str)
+                              and v.startswith("\\") else v)
+                        for v in r])
         out["tables"].append({"name": info.name, "rows": len(rows)})
     with open(os.path.join(dest, "metadata.json"), "w") as f:
         json.dump(out, f, indent=1)
@@ -236,16 +243,33 @@ def _dump_order(tables):
     return base + ordered
 
 
+_NUMERIC_RE = None
+
+
 def _sql_lit(v) -> str:
     if v is None:
         return "NULL"
+    global _NUMERIC_RE
+    if _NUMERIC_RE is None:
+        import re
+        # canonical numerics only: a float() probe would unquote 'nan',
+        # '12_3' (python underscore literals) and strip '0010' — display
+        # values of NUMERIC columns always match this shape, so anything
+        # else is string data and must be quoted
+        _NUMERIC_RE = re.compile(r"-?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?$")
     s = str(v)
-    try:
-        float(s)
+    if _NUMERIC_RE.fullmatch(s):
         return s
-    except ValueError:
-        pass
     # newlines must be escaped or the ';\n' statement splitter would break
+    s = (s.replace("\\", "\\\\").replace("'", "\\'")
+         .replace("\n", "\\n").replace("\r", "\\r"))
+    return "'" + s + "'"
+
+
+def _str_lit(s: str) -> str:
+    """Always-quoted literal: CSV fields are untyped strings; the INSERT
+    cast converts them into numeric/date columns, so quoting everything is
+    both safe and type-faithful."""
     s = (s.replace("\\", "\\\\").replace("'", "\\'")
          .replace("\n", "\\n").replace("\r", "\\r"))
     return "'" + s + "'"
@@ -285,25 +309,58 @@ def import_dump(session, src: str, db_name: str | None = None,
             ckpt["done_tables"].append(name)
             _write_ckpt(ckpt_path, ckpt)
             continue
+        csv_file = os.path.join(src, f"{meta['db']}.{name}.csv")
+        if not os.path.exists(data_file) and os.path.exists(csv_file):
+            stmts = _csv_to_inserts(csv_file, name)
+        else:
+            with open(data_file) as f:
+                stmts = _split_sql(f.read())
         done = 0
-        with open(data_file) as f:
-            for stmt in _split_sql(f.read()):
-                done += 1
-                if done <= skip:
-                    continue
-                session.execute(stmt)
-                batches += 1
-                ckpt.update({"table": name, "stmts_done": done})
-                _write_ckpt(ckpt_path, ckpt)
-                if (crash_after_batches is not None
-                        and batches >= crash_after_batches):
-                    raise TiDBError("import aborted (injected crash)")
+        for stmt in stmts:
+            done += 1
+            if done <= skip:
+                continue
+            session.execute(stmt)
+            batches += 1
+            ckpt.update({"table": name, "stmts_done": done})
+            _write_ckpt(ckpt_path, ckpt)
+            if (crash_after_batches is not None
+                    and batches >= crash_after_batches):
+                raise TiDBError("import aborted (injected crash)")
         ckpt["done_tables"].append(name)
         ckpt.update({"table": None, "stmts_done": 0})
         _write_ckpt(ckpt_path, ckpt)
     os.unlink(ckpt_path)
     return {"db": target_db,
             "tables": [t["name"] for t in meta["tables"]]}
+
+
+def _csv_to_inserts(path: str, table: str, batch: int = 256):
+    """CSV dump (header row; \\N = NULL) → INSERT statement batches — the
+    csv-format twin of the sql loader (reference: lightning/mydump csv
+    parser)."""
+    import csv
+    with open(path, newline="") as f:
+        rdr = csv.reader(f)
+        try:
+            next(rdr)  # header
+        except StopIteration:
+            return
+        def lit(v: str) -> str:
+            if v == "\\N":
+                return "NULL"
+            if v.startswith("\\\\"):
+                v = v[1:]  # un-escape the doubled leading backslash
+            return _str_lit(v)
+
+        rows = []
+        for r in rdr:
+            rows.append("(" + ", ".join(lit(v) for v in r) + ")")
+            if len(rows) >= batch:
+                yield f"INSERT INTO `{table}` VALUES " + ",".join(rows)
+                rows = []
+        if rows:
+            yield f"INSERT INTO `{table}` VALUES " + ",".join(rows)
 
 
 def _write_ckpt(path: str, ckpt: dict):

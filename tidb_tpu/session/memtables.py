@@ -155,6 +155,30 @@ def _table_constraints(session):
     return cols, rows
 
 
+def _gc_status(session):
+    """GC worker state as rows (the mysql.tidb tikv_gc_* variables role,
+    reference: gc_worker.go saveValueToSysTable)."""
+    cols = [("variable_name", _S), ("variable_value", _S)]
+
+    def rows():
+        import time as _t
+        st = session.domain.gc_worker.status()
+        lr = st["last_run"]
+        return [
+            (b"tikv_gc_safe_point", str(st["safe_point"]).encode()),
+            (b"tikv_gc_last_run_time",
+             (_t.strftime("%Y-%m-%d %H:%M:%S", _t.localtime(lr)).encode()
+              if lr else b"")),
+            (b"tikv_gc_run_interval",
+             f"{int(st['run_interval_s'])}s".encode()),
+            (b"tikv_gc_life_time", f"{int(st['life_time_s'])}s".encode()),
+            (b"tikv_gc_runs", str(st["runs"]).encode()),
+            (b"tikv_gc_locks_resolved",
+             str(st["locks_resolved"]).encode()),
+        ]
+    return cols, rows
+
+
 def _referential_constraints(session):
     cols = [("constraint_catalog", _S), ("constraint_schema", _S),
             ("constraint_name", _S), ("table_name", _S),
@@ -379,4 +403,5 @@ _TABLES = {
     ("information_schema", "table_constraints"): _table_constraints,
     ("information_schema", "referential_constraints"):
         _referential_constraints,
+    ("information_schema", "gc_status"): _gc_status,
 }
