@@ -137,12 +137,12 @@ def _choose(ds: DataSource, ctx):
             continue
         if not _idx_allowed(idx, allowed, excluded):
             continue
-        prefix, consumed = [], []
+        prefix, consumed_eq, consumed_rng = [], [], []
         for icol in idx.columns:
             i = name2idx.get(icol.name)
             if i is not None and i in eq:
                 prefix.append(eq[i])
-                consumed.extend(by_idx[i])
+                consumed_eq.extend(by_idx[i])
             else:
                 break
         lo_b = hi_b = None
@@ -155,10 +155,24 @@ def _choose(ds: DataSource, ctx):
                         lo_b = v if lo_b is None else max(lo_b, v)
                     else:
                         hi_b = v if hi_b is None else min(hi_b, v)
-                consumed.extend(by_idx[i])
+                consumed_rng.extend(by_idx[i])
         if not prefix and lo_b is None and hi_b is None:
             continue
-        sel = estimate_selectivity(stats, ds.col_infos, consumed)
+        consumed = consumed_eq + consumed_rng
+        # multi-column eq-prefix selectivity: prefer the index's own prefix
+        # NDV over the per-column independence product (reference: index
+        # stats in statistics/table.go GetRowCountByIndexRanges). For a
+        # single eq column the per-column TopN/CMSketch estimate is
+        # strictly better (it sees skew; 1/NDV does not).
+        idx_stats = ((stats or {}).get("indexes") or {}).get(str(idx.id))
+        if (len(prefix) >= 2 and idx_stats
+                and len(idx_stats["prefix_ndv"]) >= len(prefix)):
+            eq_sel = 1.0 / max(idx_stats["prefix_ndv"][len(prefix) - 1], 1)
+            sel = eq_sel * (estimate_selectivity(stats, ds.col_infos,
+                                                 consumed_rng)
+                            if consumed_rng else 1.0)
+        else:
+            sel = estimate_selectivity(stats, ds.col_infos, consumed)
         est_rows = max(n * sel, 1.0)
         cost = SEEK_BASE + est_rows * SEEK_COST
         if best is None or cost < best[0]:

@@ -189,6 +189,78 @@ def _shift_join_cond(expr, offset, lw):
     return _shift(expr, offset)
 
 
+def _resolve_base(plan, idx, ctx):
+    """Trace schema position `idx` of `plan` down to the base-table column
+    it forwards, returning (table_stats, ColumnInfo) or None. Used for
+    NDV lookups in join cardinality (reference: statistics/selectivity.go
+    resolves expression columns to their UniqueID-keyed stats)."""
+    if ctx is None or not hasattr(ctx, "table_stats"):
+        return None
+    while True:
+        if isinstance(plan, DataSource):
+            if idx >= len(plan.col_infos):
+                return None
+            stats = ctx.table_stats(plan.table_info.id)
+            if stats is None:
+                return None
+            return stats, plan.col_infos[idx]
+        if isinstance(plan, (Selection, Sort, Limit, TopN)):
+            plan = plan.child
+            continue
+        if isinstance(plan, Projection):
+            if idx >= len(plan.exprs) or not isinstance(plan.exprs[idx],
+                                                        Column):
+                return None
+            idx = plan.exprs[idx].idx
+            plan = plan.child
+            continue
+        if isinstance(plan, Join):
+            nl = len(plan.left.schema)
+            if idx < nl:
+                plan = plan.left
+            else:
+                idx -= nl
+                plan = plan.right
+            continue
+        if isinstance(plan, Aggregation):
+            if (idx < len(plan.group_exprs)
+                    and isinstance(plan.group_exprs[idx], Column)):
+                idx = plan.group_exprs[idx].idx
+                plan = plan.child
+                continue
+            return None
+        return None
+
+
+def _expr_ndv(plan, expr, ctx, est_rows):
+    """NDV of a join-key expression over `plan`'s output, capped at the
+    estimated row count; None when untraceable or no ANALYZE stats."""
+    if not isinstance(expr, Column):
+        return None
+    base = _resolve_base(plan, expr.idx, ctx)
+    if base is None:
+        return None
+    stats, ci = base
+    cs = stats.get("columns", {}).get(str(ci.id))
+    if not cs or not cs.get("ndv"):
+        return None
+    return min(cs["ndv"], max(est_rows, 1))
+
+
+def _join_est(lr, rr, ndv_pairs):
+    """|L ⋈ R| under containment: rows(L)·rows(R) / Π max(ndv_l, ndv_r)
+    per equi-key (reference: statistics join cardinality in
+    planner/core/stats.go; ndv None → pseudo max(ndv)=min(rows), which
+    degenerates to the FK-join guess max(lr, rr))."""
+    denom = 1.0
+    for lndv, rndv in ndv_pairs:
+        if lndv and rndv:
+            denom *= max(lndv, rndv)
+        else:
+            denom *= max(min(lr, rr), 1)
+    return max(int(lr * rr / denom), 1)
+
+
 def _est_rows(plan, ctx):
     if isinstance(plan, DataSource):
         n = 1000
@@ -211,7 +283,17 @@ def _est_rows(plan, ctx):
         base = _est_rows(plan.child, ctx)
         return min(base, plan.count or base)
     if isinstance(plan, Join):
-        return max(_est_rows(plan.left, ctx), _est_rows(plan.right, ctx))
+        lr = _est_rows(plan.left, ctx)
+        rr = _est_rows(plan.right, ctx)
+        if plan.kind in ("semi", "anti", "leftouter_semi"):
+            return lr
+        if plan.left_keys:
+            pairs = [(_expr_ndv(plan.left, lk, ctx, lr),
+                      _expr_ndv(plan.right, rk, ctx, rr))
+                     for lk, rk in zip(plan.left_keys, plan.right_keys)]
+            est = _join_est(lr, rr, pairs)
+            return max(est, lr) if plan.kind == "left" else est
+        return max(lr, rr) if plan.kind != "inner" else lr * rr
     if plan.children:
         return _est_rows(plan.children[0], ctx)
     return 1
@@ -234,13 +316,43 @@ def _greedy_join(items, conds, ctx):
         e.columns_used(used)
         return {g2item[g][0] for g in used}, used
 
+    def global_ndv(e, cap):
+        """NDV of a join-cond side expr (global indices) via its item's
+        base stats; None unless the expr IS a bare column (a transformed
+        key's NDV bears no relation to the underlying column's)."""
+        if not isinstance(e, Column):
+            return None
+        it, inner = g2item[e.idx]
+        return _expr_ndv(items[it][1], Column(inner, e.ftype), ctx, cap)
+
     remaining = set(range(n))
-    start = min(remaining, key=lambda i: sizes[i])
+    # seed with the item from the cheapest eq-connected pair (by estimated
+    # join output), so a small-but-exploding dimension can't anchor the
+    # spine; fall back to smallest-item when nothing connects
+    start = None
+    best_key = None
+    for kind, a, b in conds:
+        if kind != "eq":
+            continue
+        ia, _ = cond_items(a)
+        ib, _ = cond_items(b)
+        if len(ia) == 1 and len(ib) == 1 and ia != ib:
+            (i,), (j,) = ia, ib
+            est = _join_est(sizes[i], sizes[j],
+                           [(global_ndv(a, sizes[i]),
+                             global_ndv(b, sizes[j]))])
+            key = (est, min(sizes[i], sizes[j]))
+            if best_key is None or key < best_key:
+                best_key = key
+                start = i if sizes[i] <= sizes[j] else j
+    if start is None:
+        start = min(remaining, key=lambda i: sizes[i])
     remaining.discard(start)
     joined = {start}
     # current layout: list of item ids in concat order; plan built so far
     layout = [start]
     cur = items[start][1]
+    cur_rows = sizes[start]
     pend = [(kind, a, b) for kind, a, b in conds]
 
     def gmap(g):
@@ -253,8 +365,9 @@ def _greedy_join(items, conds, ctx):
         raise KeyError(g)
 
     while remaining:
-        # candidates connected via an eq cond
-        cand_scores = {}
+        # candidates connected via an eq cond, with the key exprs that
+        # would connect them (joined-side, candidate-side)
+        cand_keys = {}
         for kind, a, b in pend:
             if kind != "eq":
                 continue
@@ -263,15 +376,23 @@ def _greedy_join(items, conds, ctx):
             if ia <= joined and len(ib) == 1:
                 (c,) = ib
                 if c in remaining:
-                    cand_scores.setdefault(c, 0)
+                    cand_keys.setdefault(c, []).append((a, b))
             if ib <= joined and len(ia) == 1:
                 (c,) = ia
                 if c in remaining:
-                    cand_scores.setdefault(c, 0)
-        if cand_scores:
-            nxt = min(cand_scores, key=lambda i: sizes[i])
+                    cand_keys.setdefault(c, []).append((b, a))
+        if cand_keys:
+            # pick the candidate minimizing the estimated join output
+            # (reference: rule_join_reorder.go greedy by estimated rows)
+            def join_score(c):
+                pairs = [(global_ndv(a, cur_rows), global_ndv(b, sizes[c]))
+                         for a, b in cand_keys[c]]
+                return _join_est(cur_rows, sizes[c], pairs)
+            nxt = min(cand_keys, key=lambda c: (join_score(c), sizes[c]))
+            cur_rows = join_score(nxt)
         else:
             nxt = min(remaining, key=lambda i: sizes[i])
+            cur_rows = max(cur_rows * sizes[nxt], 1)
         remaining.discard(nxt)
         right = items[nxt][1]
         new_joined = joined | {nxt}
@@ -317,7 +438,6 @@ def _greedy_join(items, conds, ctx):
         layout.append(nxt)
         joined = new_joined
         cur = j
-        sizes.append(0)
     # leftover conds (e.g. left-only ones missed) -> selection on top
     leftovers = []
     for kind, a, b in pend:

@@ -96,6 +96,42 @@ def _column_stats(col):
     return cs
 
 
+def _index_stats(info, cols, chunk):
+    """Per-index prefix NDVs (reference: index stats built by ANALYZE in
+    statistics/builder.go; consumed by access-path and join cardinality).
+    prefix_ndv[k] = NDV of the first k+1 index columns as a tuple, with
+    NULL counting as one distinct value. Computed by iterative
+    code-densification so intermediate keys never overflow int64."""
+    from ..model import SchemaState
+    name2pos = {ci.name: i for i, ci in enumerate(cols)}
+    out = {}
+    n = chunk.num_rows
+    for idx in info.indexes:
+        if idx.state != SchemaState.PUBLIC:
+            continue
+        combined = np.zeros(n, dtype=np.int64)
+        prefix_ndv = []
+        ok = True
+        for icol in idx.columns:
+            pos = name2pos.get(icol.name)
+            if pos is None:
+                ok = False
+                break
+            col = chunk.columns[pos]
+            if n:
+                u, inv = np.unique(col.data, return_inverse=True)
+                inv = inv.astype(np.int64) + 1
+                inv[col.nulls] = 0
+                combined = combined * (len(u) + 2) + inv
+                _, combined = np.unique(combined, return_inverse=True)
+                prefix_ndv.append(int(combined.max()) + 1)
+            else:
+                prefix_ndv.append(0)
+        if ok and prefix_ndv:
+            out[str(idx.id)] = {"name": idx.name, "prefix_ndv": prefix_ndv}
+    return out
+
+
 def analyze_table(session, info):
     cache = session.columnar_cache()
     cols = info.public_columns()
@@ -109,6 +145,7 @@ def analyze_table(session, info):
     stats = {"row_count": int(chunk.num_rows), "columns": {}}
     for ci, col in zip(cols, chunk.columns):
         stats["columns"][str(ci.id)] = _column_stats(col)
+    stats["indexes"] = _index_stats(info, cols, chunk)
     txn = session.store.begin()
     try:
         m = Meta(txn)
