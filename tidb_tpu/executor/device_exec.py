@@ -482,7 +482,16 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int) -> Chunk:
 
 def device_join_keys(lkeys, rkeys):
     """Combine multi-column join keys into single int64 codes host-side
-    (shared factorization), then match on device. Returns (li, ri)."""
+    (shared factorization), then match on device. Returns (li, ri).
+
+    Single raw-int64 keys skip the factorization pass entirely — the
+    device matcher is sort-based and handles arbitrary int64 values
+    (null rows are masked by the kernel / the keep filter)."""
+    if (len(lkeys) == 1 and lkeys[0][0].dtype == np.int64
+            and rkeys[0][0].dtype == np.int64):
+        (pd, pn), = lkeys
+        (bd, bn), = rkeys
+        return dev.device_join_match((bd, bn), (pd, pn))
     nb = len(rkeys[0][0])
     npr = len(lkeys[0][0])
     from ..ops import host as hops

@@ -56,7 +56,11 @@ class QueryExecutor:
 
 
 def build_executor(plan, ctx, stats=None) -> QueryExecutor:
-    cls = _MAP.get(type(plan))
+    if isinstance(plan, Join):
+        cls = {"merge": MergeJoinExec, "index": IndexJoinExec}.get(
+            plan.join_algo, HashJoinExec)
+    else:
+        cls = _MAP.get(type(plan))
     if cls is None:
         raise TiDBError(f"no executor for {type(plan).__name__}")
     children = [build_executor(c, ctx, stats) for c in plan.children]
@@ -120,25 +124,32 @@ def resolve_access_handles(tbl, access) -> list:
     return tbl.index_scan_handles(idx, lo_vals=lo, hi_vals=hi)
 
 
+def fetch_handles_chunk(tbl, info, col_infos, handles) -> Chunk:
+    """Handle list → visibility-correct Chunk: KV seeks through the txn
+    (membuffer-aware, so uncommitted writes are visible — reference
+    executor/point_get.go + union_scan.go). Shared by the access-path
+    scan and the index-lookup join inner fetch."""
+    from ..table import rows_to_chunk
+    rowdicts = []
+    kept = []
+    for h in handles:
+        row = tbl.get_row(h)
+        if row is not None:
+            kept.append(h)
+            rowdicts.append(row)
+    return rows_to_chunk(info, col_infos, kept, rowdicts)
+
+
 class TableScanExec(QueryExecutor):
     def _access_chunk(self, txn):
         """Row fetch via the planner-chosen access path (PointGet /
-        IndexLookUp): KV seeks through the txn (membuffer-aware, so
-        uncommitted writes are visible — reference executor/point_get.go
-        + union_scan.go), assembled into a Chunk. The pushed conds stay
+        IndexLookUp), assembled into a Chunk. The pushed conds stay
         as post-filters, so path choice never changes semantics."""
-        from ..table import Table, rows_to_chunk
+        from ..table import Table
         p = self.plan
         tbl = Table(p.table_info, txn, parts=p.partitions)
         handles = resolve_access_handles(tbl, p.access)
-        rowdicts = []
-        kept = []
-        for h in handles:
-            row = tbl.get_row(h)
-            if row is not None:
-                kept.append(h)
-                rowdicts.append(row)
-        return rows_to_chunk(p.table_info, p.col_infos, kept, rowdicts)
+        return fetch_handles_chunk(tbl, p.table_info, p.col_infos, handles)
 
     def _scan_partitioned(self, txn):
         """Concat per-partition chunks, each through the columnar cache keyed
@@ -597,9 +608,17 @@ class HashJoinExec(QueryExecutor):
     larger; semantics per kind inner/left/semi/anti."""
 
     def execute(self):
-        p = self.plan
         left = self.children[0].execute()
-        right = self.children[1].execute()
+        right = self._inner_chunk(left)
+        return self._join(left, right)
+
+    def _inner_chunk(self, left):
+        """Materialize the inner (build) side; IndexJoinExec overrides to
+        fetch only key-matching rows through the index."""
+        return self.children[1].execute()
+
+    def _join(self, left, right):
+        p = self.plan
         tracker = self.tracker()
         if tracker is not None:
             # build-side state is the join's memory footprint (reference:
@@ -659,6 +678,9 @@ class HashJoinExec(QueryExecutor):
                 return device_join_keys(probe_keys, build_keys)
             except DeviceUnsupported:
                 pass
+        return self._host_match(build_keys, probe_keys)
+
+    def _host_match(self, build_keys, probe_keys):
         return host.join_match(build_keys, probe_keys)
 
     def _coerce_key(self, expr, other, chunk):
@@ -693,6 +715,53 @@ class HashJoinExec(QueryExecutor):
         if p.kind == "inner":
             return chunk
         raise TiDBError("non-equi outer joins not supported yet")
+
+
+class MergeJoinExec(HashJoinExec):
+    """Single primitive-key join via direct sort+merge (reference:
+    executor/merge_join.go; planner/physical.py picks it for large
+    primitive-keyed joins where the factorization pass is the overhead —
+    on the device path, device_join_keys's raw-int fast path skips the
+    same factorization)."""
+
+    def _host_match(self, build_keys, probe_keys):
+        return host.merge_join_match(build_keys[0], probe_keys[0])
+
+
+class IndexJoinExec(HashJoinExec):
+    """Index-lookup join: the outer side's distinct key values drive
+    index/handle seeks on the inner table, skipping its full scan
+    (reference: executor/index_lookup_join.go; the 3 reference variants
+    collapse to one here because matching is vectorized after the fetch)."""
+
+    #: above this many distinct outer keys, seeks lose to the scan the
+    #: planner expected to avoid — fall back to the plain inner scan
+    MAX_KEYS = 1 << 17
+
+    def _inner_chunk(self, left):
+        p = self.plan
+        data, nulls = p.left_keys[0].eval(left)
+        vals = np.unique(data[~nulls])
+        if len(vals) > self.MAX_KEYS:
+            return self.children[1].execute()
+        from ..table import Table
+        ds = p.right
+        txn = self.ctx.txn_for_read()
+        tbl = Table(ds.table_info, txn)
+        if p.index_join[0] == "pk":
+            handles = [int(v) for v in vals]  # planner gates keys to ints
+        else:
+            idx = p.index_join[1]
+            handles = []
+            for v in vals:
+                key = v.item() if isinstance(v, np.generic) else v
+                handles.extend(tbl.index_scan_handles(
+                    idx, lo_vals=[key], hi_vals=[key]))
+        chunk = fetch_handles_chunk(tbl, ds.table_info, ds.col_infos,
+                                    handles)
+        if ds.pushed_conds:
+            chunk = chunk.filter(eval_conds_mask(ds.pushed_conds, chunk))
+        return chunk
 
 
 def _combine(left: Chunk, right: Chunk, li, ri) -> Chunk:

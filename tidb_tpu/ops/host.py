@@ -176,6 +176,37 @@ def _norm(data):
     return data
 
 
+def merge_join_match(build_key, probe_key):
+    """Single primitive-key equi-join by direct sort + binary search
+    (reference: executor/merge_join.go — the sort-order-exploiting
+    alternative; here the order is produced in-kernel, skipping
+    join_match's factorization pass over the concatenated sides).
+
+    build_key / probe_key: (data, nulls). Returns (probe_idx, build_idx).
+    """
+    (bd, bn), (pd, pn) = build_key, probe_key
+    nb, npr = len(bd), len(pd)
+    if nb == 0 or npr == 0:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    if bd.dtype != pd.dtype:
+        common = np.result_type(bd.dtype, pd.dtype)
+        bd = bd.astype(common)
+        pd = pd.astype(common)
+    order = np.argsort(bd, kind="stable")
+    sorted_b = bd[order]
+    lo = np.searchsorted(sorted_b, pd, side="left")
+    hi = np.searchsorted(sorted_b, pd, side="right")
+    cnt = np.where(pn, 0, hi - lo)
+    total = int(cnt.sum())
+    probe_idx = np.repeat(np.arange(npr, dtype=np.int64), cnt)
+    starts = np.repeat(lo, cnt)
+    cum = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+    within = np.arange(total, dtype=np.int64) - np.repeat(cum, cnt)
+    build_idx = order[starts + within]
+    keep = ~bn[build_idx]
+    return probe_idx[keep], build_idx[keep]
+
+
 def semi_mask(build_keys, probe_keys):
     """-> bool mask over probe rows: has >=1 match."""
     pi, _bi = join_match(build_keys, probe_keys)

@@ -128,6 +128,8 @@ class Join(LogicalPlan):
         self.left_keys = []    # exprs over left schema
         self.right_keys = []   # exprs over right schema
         self.other_conds = []  # exprs over concat schema, applied post-match
+        self.join_algo = "hash"   # hash | merge | index (planner/physical.py)
+        self.index_join = None    # ("pk",) | ("index", IndexInfo) descriptor
 
     @property
     def left(self):
@@ -138,7 +140,10 @@ class Join(LogicalPlan):
         return self.children[1]
 
     def explain_name(self):
-        return "HashJoin" if self.left_keys else "NestedLoopJoin"
+        if not self.left_keys:
+            return "NestedLoopJoin"
+        return {"merge": "MergeJoin", "index": "IndexJoin"}.get(
+            self.join_algo, "HashJoin")
 
     def explain_info(self):
         s = self.kind
@@ -146,6 +151,9 @@ class Join(LogicalPlan):
             pairs = ", ".join(f"{l!r}=={r!r}" for l, r in
                               zip(self.left_keys, self.right_keys))
             s += f", equal:[{pairs}]"
+        if self.index_join is not None:
+            s += (", inner:handle" if self.index_join[0] == "pk"
+                  else f", inner:index:{self.index_join[1].name}")
         if self.other_conds:
             s += ", other:" + " AND ".join(repr(c) for c in self.other_conds)
         return s

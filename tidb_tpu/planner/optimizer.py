@@ -14,11 +14,13 @@ from .logical import (
 
 def optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
     from .access import choose_access_paths
+    from .physical import choose_join_algos
     plan = push_down_predicates(plan, [])
     plan = reorder_joins(plan, ctx)
     plan = prune_columns(plan)
     plan = prune_partitions_rule(plan)
     plan = choose_access_paths(plan, ctx)
+    plan = choose_join_algos(plan, ctx)
     return plan
 
 

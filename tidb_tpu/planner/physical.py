@@ -1,0 +1,116 @@
+"""Physical join-algorithm selection (reference:
+planner/core/exhaust_physical_plans.go:1774 — hash/merge/index-lookup join
+alternatives per logical Join — and find_best_task.go:359 cost choice).
+
+The task model here is the host↔TPU split: every algorithm produces the
+same matched row set, so the chooser is free to pick by cost alone.
+
+  * IndexJoin  — the outer (left) side drives point lookups on the inner
+    table's index or handle, skipping the inner full scan entirely.
+    Wins when est(outer) rows of seeks cost less than scanning the inner
+    table (reference: executor/index_lookup_join.go).
+  * MergeJoin  — single primitive-typed equi-key: argsort both key arrays
+    directly and merge with searchsorted, skipping the dictionary
+    factorization pass the hash matcher needs for arbitrary/composite
+    keys (reference: executor/merge_join.go exploits sort order; here
+    the "order" is produced in-kernel, so it applies to any large
+    primitive join).
+  * HashJoin   — the default; composite or string keys, or small inputs
+    where the factorize pass is noise.
+"""
+
+from __future__ import annotations
+
+from ..expression.core import Column, K_DEC, K_FLOAT, K_INT, phys_kind
+from ..model import SchemaState
+from .access import SCAN_ROW_COST, SEEK_BASE, SEEK_COST
+from .logical import DataSource, Join
+from .optimizer import _est_rows
+
+#: below this many estimated rows on both sides, factorization cost is
+#: noise and hash join keeps the simplest plan
+MERGE_MIN_ROWS = 4096
+#: never index-join when the outer side is estimated bigger than this
+#: fraction of the inner table (seeks would exceed the scan)
+INDEX_JOIN_MAX_KEYS = 65536
+
+
+def choose_join_algos(plan, ctx):
+    if isinstance(plan, Join):
+        _choose(plan, ctx)
+    for c in plan.children:
+        choose_join_algos(c, ctx)
+    return plan
+
+
+def _primitive(ft) -> bool:
+    return phys_kind(ft) in (K_INT, K_FLOAT, K_DEC)
+
+
+def _inner_index(join):
+    """Index-join applicability: the inner (right) side is a plain
+    DataSource scan and the single right key is a bare column that is the
+    row handle or the first column of a public index."""
+    ds = join.right
+    if not isinstance(ds, DataSource) or ds.access is not None:
+        return None
+    if ds.table_info.partition is not None:
+        return None
+    if len(join.right_keys) != 1 or not isinstance(join.right_keys[0],
+                                                   Column):
+        return None
+    # seeks reuse the raw outer key values: both sides must be plain ints
+    # (a decimal/float/collated outer key would encode a different seek key
+    # than the index stores)
+    if (phys_kind(join.right_keys[0].ftype) != K_INT
+            or phys_kind(join.left_keys[0].ftype) != K_INT):
+        return None
+    rcol = join.right_keys[0]
+    if rcol.idx >= len(ds.col_infos):
+        return None
+    ci = ds.col_infos[rcol.idx]
+    info = ds.table_info
+    if info.pk_is_handle and ci.id == info.pk_col_id:
+        return ("pk",)
+    # honor USE/FORCE/IGNORE INDEX on the inner table, same contract as
+    # the access-path chooser
+    from .access import _hint_sets, _idx_allowed
+    allowed, excluded, _forced = _hint_sets(ds)
+    best = None
+    for idx in info.indexes:
+        if idx.state != SchemaState.PUBLIC or not idx.columns:
+            continue
+        if not _idx_allowed(idx, allowed, excluded):
+            continue
+        if idx.columns[0].name != ci.name:
+            continue
+        if idx.unique and len(idx.columns) == 1:
+            return ("index", idx)  # unique single-col: 1 seek per key
+        best = best or ("index", idx)
+    return best
+
+
+def _choose(join: Join, ctx):
+    join.join_algo = "hash"
+    join.index_join = None
+    if not join.left_keys or join.kind not in ("inner", "left", "semi",
+                                               "anti"):
+        return
+    outer_est = _est_rows(join.left, ctx)
+    inner_est = _est_rows(join.right, ctx)
+
+    desc = _inner_index(join)
+    if desc is not None and outer_est <= INDEX_JOIN_MAX_KEYS:
+        inner_n = inner_est
+        if ctx is not None and hasattr(ctx, "table_rows"):
+            inner_n = max(ctx.table_rows(join.right.table_info.id), 1)
+        if SEEK_BASE + outer_est * SEEK_COST < inner_n * SCAN_ROW_COST:
+            join.join_algo = "index"
+            join.index_join = desc
+            return
+
+    if (len(join.left_keys) == 1
+            and _primitive(join.left_keys[0].ftype)
+            and _primitive(join.right_keys[0].ftype)
+            and min(outer_est, inner_est) >= MERGE_MIN_ROWS):
+        join.join_algo = "merge"
