@@ -146,3 +146,64 @@ def test_agg_retry_capacity_overflow(tk):
         from lineitem group by l_orderkey, l_linenumber
         order by l_orderkey, l_linenumber limit 50""")
     assert MPP_STATS["fragments"] > before
+
+
+class TestShuffleJoin:
+    """Hash-shuffle (all_to_all) MPP join, SQL-reachable: when the build
+    side exceeds tidb_broadcast_join_threshold_count, BOTH sides are
+    hash-repartitioned over the mesh by join key before the local join
+    (reference: planner/core/fragment.go Hash exchange type,
+    store/copr/mpp.go:65; exhaust_physical_plans.go broadcast-vs-shuffle
+    by build size)."""
+
+    def _shuffle_vs_host(self, tk, sql, threshold):
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        host = tk.must_query(sql).rows
+        before = MPP_STATS["shuffle_joins"]
+        tk.must_exec(f"set tidb_broadcast_join_threshold_count = {threshold}")
+        tk.must_exec("set tidb_executor_engine = 'tpu-mpp'")
+        try:
+            mpp = tk.must_query(sql).rows
+        finally:
+            tk.must_exec("set tidb_executor_engine = 'auto'")
+            tk.must_exec("set tidb_broadcast_join_threshold_count = 10240")
+        ran = MPP_STATS["shuffle_joins"] - before
+        assert host == mpp, (f"shuffle/host divergence\nhost({len(host)}): "
+                             f"{host[:5]}\nmpp({len(mpp)}): {mpp[:5]}")
+        return ran
+
+    def test_q18_shape_fact_fact_shuffle(self, tk):
+        # lineitem |><| orders, both above the (lowered) threshold: the
+        # Q18 inner join shape the broadcast path cannot afford at scale
+        ran = self._shuffle_vs_host(tk, """
+            select o_orderstatus, count(1), sum(l_quantity)
+            from orders, lineitem where o_orderkey = l_orderkey
+            group by o_orderstatus order by o_orderstatus""", threshold=50)
+        assert ran > 0, "build side above threshold never took shuffle"
+
+    def test_below_threshold_stays_broadcast(self, tk):
+        ran = self._shuffle_vs_host(tk, """
+            select o_orderstatus, count(1), sum(l_quantity)
+            from orders, lineitem where o_orderkey = l_orderkey
+            group by o_orderstatus order by o_orderstatus""",
+            threshold=1000000)
+        assert ran == 0, "tiny build side must stay broadcast"
+
+    def test_shuffle_with_filters_and_dims(self, tk):
+        # shuffle bottom join + broadcast dimension above it + leaf conds
+        # (pre-exchange filters) — the Q3-with-big-orders shape
+        ran = self._shuffle_vs_host(tk, """
+            select c_mktsegment, sum(l_extendedprice * (1 - l_discount))
+            from customer, orders, lineitem
+            where c_custkey = o_custkey and l_orderkey = o_orderkey
+              and l_shipdate > '1995-03-15'
+            group by c_mktsegment order by c_mktsegment""", threshold=50)
+        assert ran > 0
+
+    def test_shuffle_multi_key_join(self, tk):
+        ran = self._shuffle_vs_host(tk, """
+            select count(1), sum(ps_availqty)
+            from partsupp, lineitem
+            where ps_partkey = l_partkey and ps_suppkey = l_suppkey""",
+            threshold=40)
+        assert ran > 0

@@ -49,7 +49,7 @@ _MERGE_OP = {"count": "sum_i", "sum_i": "sum_i", "sum_f": "sum_f",
              "min": "min", "max": "max", "first": "first"}
 
 #: observability: fragments actually executed through the mesh path
-MPP_STATS = {"fragments": 0, "retries": 0}
+MPP_STATS = {"fragments": 0, "retries": 0, "shuffle_joins": 0}
 
 _MESH_CACHE: dict[int, object] = {}
 
@@ -121,30 +121,123 @@ def _valid_array(n_rows, mesh, n_shards):
 
 
 # ---------------------------------------------------------------------------
+# hash-shuffle exchange (the Hash exchange type — reference:
+# planner/core/fragment.go:37,64 ExchangeSender{HashPartition},
+# store/copr/mpp.go:65; here: in-body bucketize + lax.all_to_all over ICI)
+# ---------------------------------------------------------------------------
+
+def _mix64(k):
+    """murmur3 fmix64 over int64 lanes — decorrelates FK-stride keys from
+    the mod-n_shards destination (the reference hashes partition keys with
+    murmur, unistore/cophandler/mpp_exec.go)."""
+    u = k.astype(jnp.uint64)
+    u = u ^ (u >> 33)
+    u = u * jnp.uint64(0xFF51AFD7ED558CCD)
+    u = u ^ (u >> 33)
+    u = u * jnp.uint64(0xC4CEB9FE1A85EC53)
+    u = u ^ (u >> 33)
+    return u
+
+
+def _dest_hash(key_ds, n_shards):
+    """Destination shard per row from the (multi-)column join key. Both
+    join sides use the same fold, so equal keys land on the same shard."""
+    h = jnp.zeros(key_ds[0].shape[0], dtype=jnp.uint64)
+    for d in key_ds:
+        h = _mix64(h ^ _mix64(d.astype(jnp.int64)))
+    return (h % jnp.uint64(n_shards)).astype(jnp.int32)
+
+
+def _exchange_leaf(col_pairs, dest, valid, n_shards, cap):
+    """Repartition one leaf's per-shard rows by `dest`: sort-based
+    bucketize (gather formulation — no scatter) into n_shards buckets of
+    `cap` slots, then one tiled all_to_all per column so each shard ends
+    up holding exactly the rows hashed to it.
+
+    col_pairs: [(data, nulls)] local slices; returns (new_col_pairs,
+    new_valid, overflow) with n_shards*cap rows per shard."""
+    m = valid.shape[0]
+    dest = jnp.where(valid, dest, n_shards)       # invalid rows sort last
+    order = jnp.argsort(dest)
+    sd = dest[order]
+    shard_ids = jnp.arange(n_shards, dtype=sd.dtype)
+    starts = jnp.searchsorted(sd, shard_ids, side="left")
+    cnt = jnp.searchsorted(sd, shard_ids, side="right") - starts
+    ovf = jnp.any(cnt > cap)
+    d_grid = jnp.repeat(shard_ids, cap)
+    c_grid = jnp.tile(jnp.arange(cap, dtype=sd.dtype), n_shards)
+    src = jnp.clip(starts[d_grid] + c_grid, 0, jnp.maximum(m - 1, 0))
+    rows = order[src]
+    slot_valid = c_grid < cnt[d_grid]
+
+    def x(a):
+        return jax.lax.all_to_all(a, AXIS, 0, 0, tiled=True)
+
+    out_cols = [(x(d[rows]), x(nl[rows])) for d, nl in col_pairs]
+    return out_cols, x(slot_valid), ovf
+
+
+# ---------------------------------------------------------------------------
 # the SPMD fragment program
 # ---------------------------------------------------------------------------
 
-def _build_mpp_pipeline(mesh, leaves, joins, root, shard_leaf, leaf_cond_fns,
+def _build_mpp_pipeline(mesh, leaves, joins, root, sharded_ids, leaf_cond_fns,
                         cond_fns, key_fns, n_keys, val_plan, agg_ops,
-                        capacity, key_pack, env_specs):
+                        capacity, key_pack, env_specs, shuffle=None):
     """shard_map + jit the whole fragment: per-shard fused body → partial
     agg → all_gather → replicated final merge. Same body structure as
     device_join.compile_fragment but per-shard shapes come from the traced
-    env and the sharded leaf ANDs its validity mask."""
+    env and each sharded leaf ANDs its validity mask.
+
+    shuffle: None (broadcast join) or (node, left_leaf, right_leaf,
+    cap_l, cap_r) — hash-repartition BOTH sides of `node` by join key
+    over the mesh before the local join (the Hash exchange type)."""
     merge_ops = tuple(_MERGE_OP[o] for o in agg_ops)
     n_joins = len(joins)
+    n_shards = mesh.shape[AXIS]
+    n_xovf = 2 if shuffle is not None else 0
 
-    def body(env, svalid):
+    def body(env, svalids):
         overflows = []
         span_ovfs = []
+        env = dict(env)
+        leaf_valid = dict(zip(sharded_ids, svalids))
+        conds_consumed = set()
+        xovfs = []
+        if shuffle is not None:
+            node, llid, rlid, cap_l, cap_r = shuffle
+            for leaf_id, kfns, xcap in ((llid, node._lk_fns, cap_l),
+                                        (rlid, node._rk_fns, cap_r)):
+                leaf = leaves[leaf_id]
+                n = env[leaf.offset][0].shape[0]
+                valid = leaf_valid.get(leaf_id, jnp.ones(n, dtype=bool))
+                # pre-exchange filter: leaf conds cut exchange volume
+                for f in leaf_cond_fns[leaf_id]:
+                    d, nl = f(env)
+                    valid = valid & jnp.broadcast_to((d != 0) & ~nl, (n,))
+                conds_consumed.add(leaf_id)
+                kds, knulls = zip(*[dev.broadcast_1d(*f(env), n)
+                                    for f in kfns])
+                for nl in knulls:
+                    valid = valid & ~nl    # null keys never match: drop
+                dest = _dest_hash(kds, n_shards)
+                cols = [env[leaf.offset + i] for i in range(leaf.ncols)]
+                out_cols, out_valid, ovf = _exchange_leaf(
+                    cols, dest, valid, n_shards, xcap)
+                for i in range(leaf.ncols):
+                    env[leaf.offset + i] = out_cols[i]
+                leaf_valid[leaf_id] = out_valid
+                xovfs.append(ovf)
 
         def leaf_rel(leaf):
             n = env[leaf.offset][0].shape[0]
-            mask = (svalid if leaf.leaf_id == shard_leaf
-                    else jnp.ones(n, dtype=bool))
-            for f in leaf_cond_fns[leaf.leaf_id]:
-                d, nl = f(env)
-                mask = mask & jnp.broadcast_to((d != 0) & ~nl, (n,))
+            mask = leaf_valid.get(leaf.leaf_id)
+            if mask is None:
+                mask = jnp.ones(n, dtype=bool)
+            if leaf.leaf_id not in conds_consumed:
+                for f in leaf_cond_fns[leaf.leaf_id]:
+                    d, nl = f(env)
+                    mask = mask & jnp.broadcast_to((d != 0) & ~nl, (n,))
             return {leaf.leaf_id: jnp.arange(n)}, mask
 
         def gather_env(idxmap, node):
@@ -239,7 +332,9 @@ def _build_mpp_pipeline(mesh, leaves, joins, root, shard_leaf, leaf_cond_fns,
                      for o in overflows)
         sovfs = tuple(jax.lax.pmax(o.astype(jnp.int32), AXIS)
                       for o in span_ovfs)
-        return f_out, png_max, ovfs, sovfs
+        xovfs_out = tuple(jax.lax.pmax(o.astype(jnp.int32), AXIS)
+                          for o in xovfs)
+        return f_out, png_max, ovfs, sovfs, xovfs_out
 
     n_res = len(val_plan)
     out_specs = (
@@ -248,9 +343,11 @@ def _build_mpp_pipeline(mesh, leaves, joins, root, shard_leaf, leaf_cond_fns,
         P(),
         (P(),) * n_joins,
         (P(),) * n_joins,
+        (P(),) * n_xovf,
     )
     wrapped = shard_map(
-        body, mesh=mesh, in_specs=(env_specs, P(AXIS)),
+        body, mesh=mesh,
+        in_specs=(env_specs, (P(AXIS),) * len(sharded_ids)),
         out_specs=out_specs, check_vma=False)
     return jax.jit(wrapped)
 
@@ -281,6 +378,26 @@ def _leaf_ids(node):
     return _leaf_ids(node.left) | _leaf_ids(node.right)
 
 
+def _build_key_leaf(node, leaves):
+    """The leaf inside `node`'s build (right) subtree holding ALL of the
+    right-key columns — the one a Hash exchange must repartition; None
+    when the keys span leaves (or reference none)."""
+    used = set()
+    for k in node.right_keys:
+        k.columns_used(used)
+    if not used:
+        return None
+    gls = {node.right.offset + u for u in used}
+    for leaf in leaves:
+        if (leaf.offset >= node.right.offset
+                and leaf.offset + leaf.ncols
+                <= node.right.offset + node.right.ncols
+                and all(leaf.offset <= g < leaf.offset + leaf.ncols
+                        for g in gls)):
+            return leaf
+    return None
+
+
 def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
     n_shards = mesh.shape[AXIS]
 
@@ -291,21 +408,50 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
     # are untouched (a node's column range spans both subtrees either
     # way). This also minimizes broadcast volume: big table sharded,
     # dimensions replicated.
+    bottom = None
     if joins:
         target = max(leaves, key=lambda lf: lf.chunk.num_rows).leaf_id
         node = root
+        prev = None
         while isinstance(node, _JoinNode):
             if target in _leaf_ids(node.right):
                 node.left, node.right = node.right, node.left
                 node.left_keys, node.right_keys = (
                     node.right_keys, node.left_keys)
+            prev = node
             node = node.left
         shard_leaf = node.leaf_id
+        bottom = prev  # the spine join directly over the sharded leaf
     else:
         shard_leaf = root.leaf_id
     shard_rows = leaves[shard_leaf].chunk.num_rows
     if shard_rows < n_shards:
         raise DeviceUnsupported("too few rows to shard over the mesh")
+
+    # broadcast-vs-shuffle for the bottom join (reference: the planner
+    # picks Broadcast vs HashPartition exchange by build-side size,
+    # exhaust_physical_plans.go MPP join variants): when the build-key
+    # leaf is itself fact-sized, replicating it per shard would blow
+    # HBM — hash-repartition it (and the probe fact) over the mesh
+    # instead. The exchanged leaf is the one holding ALL the bottom
+    # join's right-key columns; any other build-subtree leaves stay
+    # replicated, so the subtree's local joins remain co-partitioned
+    # by the exchanged key.
+    shuffle_build = None
+    if bottom is not None:
+        bleaf = _build_key_leaf(bottom, leaves)
+        if bleaf is not None:
+            try:
+                bc_rows = int(ctx.get_sysvar(
+                    "tidb_broadcast_join_threshold_count"))
+            except Exception:
+                bc_rows = 10 * 1024
+            build_rows = bleaf.chunk.num_rows
+            if (bc_rows > 0 and build_rows > bc_rows
+                    and build_rows >= n_shards):
+                shuffle_build = bleaf.leaf_id
+    sharded_ids = [shard_leaf] + (
+        [shuffle_build] if shuffle_build is not None else [])
 
     dcols = _global_dcols(leaves)
     key_fns, key_meta, key_pack, val_plan, agg_ops, slots = _plan_agg(
@@ -326,56 +472,88 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                       for c in jn.other_conds]
     cond_fns = [dev.compile_expr(c, dcols) for c in agg_conds]
 
-    # mesh placement: sharded fact columns + replicated dimensions
+    # mesh placement: sharded fact (and shuffled build) columns +
+    # replicated dimensions
     env, env_specs = {}, {}
     for leaf in leaves:
-        sharded = leaf.leaf_id == shard_leaf
+        sharded = leaf.leaf_id in sharded_ids
         spec = (P(AXIS), P(AXIS)) if sharded else (P(), P())
         for i, dc in _leaf_env(leaf).items():
             env[leaf.offset + i] = _place_col(
                 dc.data, dc.nulls, mesh, sharded, n_shards)
             env_specs[leaf.offset + i] = spec
-    svalid = _valid_array(shard_rows, mesh, n_shards)
+    svalids = tuple(_valid_array(leaves[lid].chunk.num_rows, mesh, n_shards)
+                    for lid in sharded_ids)
 
     # static capacities: per-shard probe rows bound the bottom join; each
-    # join's output bounds the next (FK heuristic, doubled on overflow)
+    # join's output bounds the next (FK heuristic, doubled on overflow).
+    # With shuffle, each exchanged side gets a per-destination bucket
+    # capacity (~2x the uniform share), and the bottom join's probe side
+    # becomes the post-exchange n_shards*cap_l rows.
     per_shard = -(-shard_rows // n_shards)
+    xcaps = None
+    if shuffle_build is not None:
+        build_per_shard = -(-leaves[shuffle_build].chunk.num_rows // n_shards)
+        xcaps = [dev.next_pow2(max(2 * (-(-per_shard // n_shards)), 8)),
+                 dev.next_pow2(max(2 * (-(-build_per_shard // n_shards)), 8))]
 
     def probe_rows(nd):
         if isinstance(nd, _Leaf):
+            if xcaps is not None and nd.leaf_id == shard_leaf:
+                return n_shards * xcaps[0]
             return per_shard if nd.leaf_id == shard_leaf else nd.chunk.num_rows
         return nd.cap
 
-    caps = []
-    for jn in joins:
-        jn.cap = dev.next_pow2(max(probe_rows(jn.left), 8))
-        caps.append(jn.cap)
+    def init_caps():
+        caps = []
+        for jn in joins:
+            jn.cap = dev.next_pow2(max(probe_rows(jn.left), 8))
+            caps.append(jn.cap)
+        return caps
+
+    caps = init_caps()
     n_frag = caps[-1] if caps else per_shard
     est = _estimate_groups(plan, n_frag)
     capacity = dev.next_pow2(min(max(n_frag, 16), max(est, 16)))
 
-    sig = ("mpp", n_shards, fragment_sig(leaves, joins, agg_conds, plan))
+    sig = ("mpp", n_shards, fragment_sig(leaves, joins, agg_conds, plan),
+           tuple(sharded_ids))
     dict_refs = tuple(dc.dictionary for dc in dcols.values()
                       if dc.dictionary is not None)
+    bottom_idx = joins.index(bottom) if bottom is not None else -1
 
     for _attempt in range(12):
         for jn, cap in zip(joins, caps):
             jn.cap = cap
-        key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops))
+        shuffle = None
+        if shuffle_build is not None:
+            shuffle = (bottom, shard_leaf, shuffle_build,
+                       xcaps[0], xcaps[1])
+        key = (sig, tuple(caps), tuple(xcaps or ()), capacity, key_pack,
+               tuple(agg_ops))
         fn = _pipe_cache_get(key)
         if fn is None:
             fn = _build_mpp_pipeline(
-                mesh, leaves, joins, root, shard_leaf, leaf_cond_fns,
+                mesh, leaves, joins, root, sharded_ids, leaf_cond_fns,
                 cond_fns, key_fns, n_keys, val_plan, tuple(agg_ops),
-                capacity, key_pack, env_specs)
+                capacity, key_pack, env_specs, shuffle=shuffle)
             _pipe_cache_put(key, fn, dict_refs)
-        out = jax.device_get(fn(env, svalid))
+        out = jax.device_get(fn(env, svalids))
         ((key_out, key_null_out, results, result_nulls, fng, _v),
-         png, ovfs, sovfs) = out
+         png, ovfs, sovfs, xovfs) = out
         if any(int(s) for s in sovfs):
             raise DeviceUnsupported(
                 "multi-key join value ranges exceed int64 packing")
         retry = False
+        for i, o in enumerate(xovfs):
+            if int(o):
+                xcaps[i] *= 2
+                retry = True
+        if retry:
+            # the bottom join's probe side grew with the exchange bucket
+            caps[bottom_idx] = max(
+                caps[bottom_idx],
+                dev.next_pow2(max(n_shards * xcaps[0], 8)))
         for i, o in enumerate(ovfs):
             if int(o):
                 caps[i] *= 2
@@ -389,10 +567,11 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
         MPP_STATS["retries"] += 1
     else:
         raise DeviceUnsupported("mpp fragment capacities did not converge")
-
     ng = int(fng)
     if ng == 0 and not plan.group_exprs:
         raise DeviceUnsupported("empty global aggregate")
     MPP_STATS["fragments"] += 1
+    if shuffle_build is not None:
+        MPP_STATS["shuffle_joins"] += 1
     return _assemble_agg(plan, key_meta, slots, dcols,
                          (key_out, key_null_out, results, result_nulls), ng)

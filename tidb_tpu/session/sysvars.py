@@ -178,6 +178,8 @@ for _v in [
     SysVar("tidb_backoff_weight", SCOPE_BOTH, "2", "int", 1),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
            str(100 * 1024 * 1024), "int", 0),
+    SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH,
+           str(10 * 1024), "int", 0),
     SysVar("tidb_checksum_table_concurrency", SCOPE_BOTH, "4", "int", 1),
     SysVar("tidb_constraint_check_in_place", SCOPE_BOTH, "OFF", "bool"),
     SysVar("tidb_current_ts", SCOPE_SESSION, "0", "int"),
