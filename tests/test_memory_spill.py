@@ -100,8 +100,43 @@ def test_topn_memory_bounded(tk):
         (i * 7919) % 100000 for i in range(20000))[:5]
 
 
-def test_join_over_quota_cancelled(tk):
-    tk.must_exec("set tidb_mem_quota_query = 100000")
+def test_join_over_quota_spills_and_completes(tk):
+    # round-3 contract (reference: executor/join.go build spill): a build
+    # side over quota hash-partitions both sides and completes
+    tk.must_exec("set tidb_mem_quota_query = 0")
+    want = tk.must_query(
+        "select count(*) from s t1, s t2 where t1.a = t2.b").rows
+    assert int(want[0][0]) > 0
+    tk.must_exec("set tidb_mem_quota_query = 300000")
+    got = tk.must_query(
+        "select count(*) from s t1, s t2 where t1.a = t2.b").rows
+    assert got == want
+    rows = tk.must_query(
+        "explain analyze select count(*) from s t1, s t2 "
+        "where t1.a = t2.b").rows
+    join_row = next(r for r in rows if "Join" in r[0])
+    assert "join_spill_partitions:" in join_row[2]
+
+
+def test_hash_agg_over_quota_spills_and_completes(tk):
+    # reference: executor/aggregate.go agg spill — big GROUP BY survives
+    # the quota via hash-partitioned passes and matches the unlimited run
+    tk.must_exec("set tidb_mem_quota_query = 0")
+    want = tk.must_query(
+        "select b, count(*), sum(a) from s group by b order by b").rows
+    tk.must_exec("set tidb_mem_quota_query = 300000")
+    got = tk.must_query(
+        "select b, count(*), sum(a) from s group by b order by b").rows
+    assert got == want
+    rows = tk.must_query(
+        "explain analyze select b, count(*) from s group by b").rows
+    agg_row = next(r for r in rows if "HashAgg" in r[0])
+    assert "agg_spill_partitions:" in agg_row[2]
+
+
+def test_join_under_extreme_quota_cancelled(tk):
+    # even one partition cannot fit: the cancel action still fires
+    tk.must_exec("set tidb_mem_quota_query = 2000")
     with pytest.raises(MemQuotaExceeded) as ei:
         tk.must_query(
             "select count(*) from s t1, s t2 where t1.a = t2.a")
@@ -109,8 +144,25 @@ def test_join_over_quota_cancelled(tk):
 
 
 def test_quota_resets_per_statement(tk):
-    tk.must_exec("set tidb_mem_quota_query = 100000")
+    tk.must_exec("set tidb_mem_quota_query = 2000")
     with pytest.raises(MemQuotaExceeded):
         tk.must_query("select count(*) from s t1, s t2 where t1.a = t2.a")
     # next (small) statement starts from a fresh tracker
     tk.must_query("select count(*) from s where a < 10").check([("10",)])
+
+
+def test_agg_spill_respects_collation(tk):
+    # review regression: _ci case-variants must stay one group when the
+    # spill path partitions by group key
+    tk.must_exec("""create table ci (c varchar(20)
+                    collate utf8mb4_general_ci)""")
+    vals = ",".join(f"('{'abc' if i % 2 else 'ABC'}xyz-{i % 3}')"
+                    for i in range(9000))
+    tk.must_exec(f"insert into ci values {vals}")
+    tk.must_exec("set tidb_mem_quota_query = 0")
+    want = tk.must_query(
+        "select count(*) from ci group by c order by count(*)").rows
+    tk.must_exec("set tidb_mem_quota_query = 200000")
+    got = tk.must_query(
+        "select count(*) from ci group by c order by count(*)").rows
+    assert got == want

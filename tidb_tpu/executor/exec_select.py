@@ -422,7 +422,43 @@ class HashAggExec(QueryExecutor):
                 chunk = chunk.filter(eval_conds_mask(conds, chunk))
         else:
             chunk = self.children[0].execute()
-        return self._execute_host(chunk)
+        return self._execute_host_spillable(chunk)
+
+    #: hash partitions for the quota-pressure spill path (reference:
+    #: executor/aggregate.go parallel agg spill, util/chunk/disk.go:34)
+    SPILL_PARTS = 16
+
+    def _execute_host_spillable(self, chunk):
+        """Group-by under memory pressure: when the input (≈ the agg
+        state's order of magnitude) exceeds the remaining quota, hash-
+        partition rows by group key and aggregate partition-by-partition —
+        group keys are disjoint across partitions, so concatenating the
+        per-partition outputs IS the full result. Each pass consumes and
+        releases ~1/SPILL_PARTS of the input. (The input chunk itself is
+        storage memory — the resident columnar cache — like the reference
+        leaves the TiKV block cache outside the query quota; Sort spills
+        its buffered copy to disk, utils/disk.py.)"""
+        p = self.plan
+        tracker = self.tracker()
+        from ..utils.memory import approx_chunk_bytes
+        if (tracker is None or not p.group_exprs or chunk.num_rows == 0
+                or 2 * approx_chunk_bytes(chunk)
+                <= tracker.remaining_chain()):
+            return self._execute_host(chunk)
+        # collation-aware keys: _ci case-variants must land in ONE
+        # partition, exactly as _execute_host groups them
+        keys = [_collate_eval(e, chunk) for e in p.group_exprs]
+        pid = host.partition_ids(keys, self.SPILL_PARTS)
+        outs = []
+        for q in range(self.SPILL_PARTS):
+            sel = np.nonzero(pid == q)[0]
+            if not len(sel):
+                continue
+            sub = chunk.take(sel)
+            outs.append(self._execute_host(sub))
+            tracker.release(approx_chunk_bytes(sub))
+        self.annotate(agg_spill_partitions=self.SPILL_PARTS)
+        return concat_chunks(outs)
 
     def _mark_fragment(self, engine: str, scan_rows):
         """EXPLAIN ANALYZE annotation for a fused device fragment: the whole
@@ -442,10 +478,14 @@ class HashAggExec(QueryExecutor):
 
     def _execute_host(self, chunk):
         tracker = self.tracker()
+        p = self.plan
         if tracker is not None:
             from ..utils.memory import approx_chunk_bytes
-            tracker.consume(approx_chunk_bytes(chunk))
-        p = self.plan
+            # per-operator accounting (reference: the agg tracker holds
+            # the hash-table state, not the child's chunks): grouped agg
+            # state scales with the input; a global reduction is O(1)
+            tracker.consume(approx_chunk_bytes(chunk)
+                            if p.group_exprs else 1024)
         n = chunk.num_rows
         group_cols = [e.eval(chunk) for e in p.group_exprs]
         if p.group_exprs:
@@ -617,22 +657,69 @@ class HashJoinExec(QueryExecutor):
         fetch only key-matching rows through the index."""
         return self.children[1].execute()
 
+    #: hash partitions for the quota-pressure spill path (reference:
+    #: executor/join.go build-side spill partitioning)
+    SPILL_PARTS = 16
+
     def _join(self, left, right):
         p = self.plan
-        tracker = self.tracker()
-        if tracker is not None:
-            # build-side state is the join's memory footprint (reference:
-            # hash table in executor/join.go; quota breach cancels)
-            from ..utils.memory import approx_chunk_bytes
-            tracker.consume(approx_chunk_bytes(right))
-        nl = len(p.left.schema)
         if not p.left_keys:
+            tracker = self.tracker()
+            if tracker is not None:
+                from ..utils.memory import approx_chunk_bytes
+                tracker.consume(approx_chunk_bytes(right))
             return self._nested_loop(left, right)
-        lkeys = [e.eval(left) for e in p.left_keys]
         rkeys = [self._coerce_key(re_, le_, right)
                  for re_, le_ in zip(p.right_keys, p.left_keys)]
         lkeys = [self._coerce_key(le_, re_, left)
                  for le_, re_ in zip(p.left_keys, p.right_keys)]
+        tracker = self.tracker()
+        from ..utils.memory import approx_chunk_bytes
+        need = approx_chunk_bytes(right)
+        if (tracker is not None
+                and 2 * need > tracker.remaining_chain()):
+            # build side won't fit under the quota: hash-partition both
+            # sides and join partition-by-partition (the spill path —
+            # working set drops to ~1/SPILL_PARTS per pass)
+            return self._join_partitioned(left, right, lkeys, rkeys,
+                                          tracker)
+        if tracker is not None:
+            # build-side state is the join's memory footprint (reference:
+            # hash table in executor/join.go; quota breach cancels)
+            tracker.consume(need)
+        return self._join_kind(left, right, lkeys, rkeys)
+
+    def _join_partitioned(self, left, right, lkeys, rkeys, tracker):
+        from ..utils.memory import approx_chunk_bytes
+        p = self.plan
+        parts = self.SPILL_PARTS
+        lp = host.partition_ids(lkeys, parts)
+        rp = host.partition_ids(rkeys, parts)
+        outs = []
+        for q in range(parts):
+            lsel = np.nonzero(lp == q)[0]
+            if not len(lsel):
+                continue  # no probe/outer rows: nothing can be emitted
+            rsel = np.nonzero(rp == q)[0]
+            if p.kind == "inner" and not len(rsel):
+                continue
+            sub_l = left.take(lsel)
+            sub_r = right.take(rsel)
+            sub_lk = [(d[lsel], n[lsel]) for d, n in lkeys]
+            sub_rk = [(d[rsel], n[rsel]) for d, n in rkeys]
+            b = approx_chunk_bytes(sub_r)
+            tracker.consume(b)
+            try:
+                outs.append(self._join_kind(sub_l, sub_r, sub_lk, sub_rk))
+            finally:
+                tracker.release(b)
+        self.annotate(join_spill_partitions=parts)
+        if not outs:
+            return Chunk.empty([r.ftype for r in p.schema.refs])
+        return concat_chunks(outs)
+
+    def _join_kind(self, left, right, lkeys, rkeys):
+        p = self.plan
         # join_match(build, probe) -> (probe_idx, build_idx); build on the
         # right side, probe with the left (reference builds the smaller side;
         # side choice by size comes with the cost model)

@@ -176,6 +176,38 @@ def _norm(data):
     return data
 
 
+def _mix64_np(u):
+    """murmur3 fmix64 over a uint64 array (silent C wraparound)."""
+    u = u ^ (u >> np.uint64(33))
+    u = u * np.uint64(0xFF51AFD7ED558CCD)
+    u = u ^ (u >> np.uint64(33))
+    u = u * np.uint64(0xC4CEB9FE1A85EC53)
+    u = u ^ (u >> np.uint64(33))
+    return u
+
+
+def partition_ids(key_cols, n_parts):
+    """Deterministic hash-partition id per row over [(data, nulls)] key
+    columns (reference: the spill paths hash-partition build/probe/agg
+    state, executor/aggregate.go + join spill). Equal keys — including
+    across join sides after coercion — get equal ids; NULL key columns
+    hash as one value, so the SQL NULL group stays in one partition."""
+    n = len(key_cols[0][0])
+    h = np.zeros(n, dtype=np.uint64)
+    for d, nl in key_cols:
+        if d.dtype == object:
+            hv = np.fromiter((hash(x) for x in d), dtype=np.int64,
+                             count=n).view(np.uint64)
+        elif d.dtype.kind == "f":
+            dd = np.where(d == 0, 0.0, d).astype(np.float64)  # -0.0 == 0.0
+            hv = dd.view(np.uint64)
+        else:
+            hv = d.astype(np.int64).view(np.uint64)
+        hv = np.where(nl, np.uint64(0), hv)
+        h = _mix64_np(h ^ _mix64_np(hv))
+    return (h % np.uint64(n_parts)).astype(np.int64)
+
+
 def merge_join_match(build_key, probe_key):
     """Single primitive-key equi-join by direct sort + binary search
     (reference: executor/merge_join.go — the sort-order-exploiting

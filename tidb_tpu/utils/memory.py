@@ -82,6 +82,16 @@ class MemTracker:
             return 1 << 62
         return max(self.limit - self.consumed, 0)
 
+    def remaining_chain(self) -> int:
+        """Tightest remaining quota over this tracker and its ancestors —
+        what an operator may still allocate before SOME limit fires."""
+        r = self.remaining()
+        node = self.parent
+        while node is not None:
+            r = min(r, node.remaining())
+            node = node.parent
+        return r
+
 
 def approx_chunk_bytes(chunk) -> int:
     """Cheap per-chunk estimate (exact byte-walks over object columns are
