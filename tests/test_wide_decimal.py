@@ -1,0 +1,144 @@
+"""Wide decimals (precision > 18): exact arbitrary-precision semantics for
+decimal(38,x) columns — storage, arithmetic, SUM/AVG, ordering — with
+parity against Python's decimal module (reference: types/mydecimal.go,
+81-digit fixed point; SURVEY §7 wide-decimal plan)."""
+
+from decimal import Decimal, getcontext
+
+import pytest
+
+getcontext().prec = 80  # exact reference arithmetic (default 28 rounds)
+
+from tidb_tpu.testkit import TestKit
+
+VALS = [
+    "12345678901234567890123456.1234567890",
+    "-9999999999999999999999999.9999999999",
+    "0.0000000001",
+    "31415926535897932384626433.8327950288",
+    "-1.5",
+    "99999999999999999999999999.0000000001",
+]
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("create database wd")
+    tk.must_exec("use wd")
+    tk.must_exec("create table d (id bigint, v decimal(38,10))")
+    for i, v in enumerate(VALS):
+        tk.must_exec(f"insert into d values ({i}, {v})")
+    tk.must_exec("insert into d values (99, null)")
+    return tk
+
+
+def test_roundtrip_exact(tk):
+    rows = tk.must_query("select v from d where id = 0").rows
+    assert rows == [("12345678901234567890123456.1234567890",)]
+
+
+def test_sum_matches_python_decimal(tk):
+    want = sum(Decimal(v) for v in VALS)
+    rows = tk.must_query("select sum(v) from d").rows
+    assert Decimal(rows[0][0]) == want
+
+
+def test_avg_matches_python_decimal(tk):
+    rows = tk.must_query("select avg(v) from d").rows
+    got = Decimal(rows[0][0])
+    want = sum(Decimal(v) for v in VALS) / 6
+    # avg output scale is bounded; compare at the returned scale
+    assert abs(got - want) <= Decimal("0.0001")
+
+
+def test_arithmetic_exact(tk):
+    rows = tk.must_query(
+        "select v + v, v - 1 from d where id = 3").rows
+    v = Decimal(VALS[3])
+    assert Decimal(rows[0][0]) == v + v
+    assert Decimal(rows[0][1]) == v - 1
+
+
+def test_order_and_minmax(tk):
+    rows = tk.must_query(
+        "select min(v), max(v) from d").rows
+    ds = sorted(Decimal(v) for v in VALS)
+    assert Decimal(rows[0][0]) == ds[0]
+    assert Decimal(rows[0][1]) == ds[-1]
+    ordered = tk.must_query(
+        "select id from d where v is not null order by v").rows
+    want = [str(i) for i, _ in sorted(enumerate(VALS),
+                                      key=lambda p: Decimal(p[1]))]
+    assert [r[0] for r in ordered] == [w for w in want]
+
+
+def test_filter_and_group(tk):
+    rows = tk.must_query(
+        "select count(*) from d where v > 0").rows
+    assert rows == [(str(sum(1 for v in VALS if Decimal(v) > 0)),)]
+    rows = tk.must_query(
+        "select v, count(*) from d group by v having count(*) = 1 "
+        "order by v desc limit 1").rows
+    assert Decimal(rows[0][0]) == max(Decimal(v) for v in VALS)
+
+
+def test_narrow_sum_never_wraps(tk):
+    # int64-scaled decimal(18,0) summed past 2^63 must still be exact
+    tk.must_exec("create table nw (v decimal(18,0))")
+    tk.must_exec("insert into nw values " + ",".join(
+        ["(900000000000000000)"] * 12))
+    rows = tk.must_query("select sum(v) from nw").rows
+    assert rows == [("10800000000000000000",)]
+
+
+def test_update_and_join_on_wide(tk):
+    tk.must_exec("create table d2 (v decimal(38,10))")
+    tk.must_exec(f"insert into d2 values ({VALS[0]}), ({VALS[4]})")
+    rows = tk.must_query(
+        "select count(*) from d, d2 where d.v = d2.v").rows
+    assert rows == [("2",)]
+    tk.must_exec(f"update d2 set v = v + 1 where v = {VALS[4]}")
+    rows = tk.must_query("select v from d2 order by v limit 1").rows
+    assert Decimal(rows[0][0]) == Decimal(VALS[4]) + 1
+
+
+def test_tpu_engine_parity_via_fallback(tk):
+    # the device path declines wide-decimal columns; engine='tpu' must
+    # still return identical rows through the host fallback
+    q = "select sum(v), count(*) from d where v > 0"
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    host = tk.must_query(q).rows
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    dev = tk.must_query(q).rows
+    tk.must_exec("set tidb_executor_engine = 'auto'")
+    assert host == dev
+
+
+def test_partitioned_join_wide_narrow_keys(tk):
+    # review regression: wide (object) and narrow (int64) decimal join
+    # keys must hash to the same spill partition
+    import numpy as np
+    from tidb_tpu.ops.host import partition_ids
+    vals = [-1, 5, 2 ** 61, 7, -(2 ** 60)]
+    wide = np.array(vals, dtype=object)
+    narrow = np.array(vals, dtype=np.int64)
+    z = np.zeros(len(vals), dtype=bool)
+    assert list(partition_ids([(wide, z)], 16)) == \
+        list(partition_ids([(narrow, z)], 16))
+
+
+def test_narrow_to_wide_rescale_exact(tk):
+    # review regression: narrow decimal coerced to a wide common scale
+    # must promote to bigints, not wrap
+    tk.must_exec("create table mix (a decimal(12,0), b decimal(38,10))")
+    tk.must_exec("insert into mix values (1000000000, 1000000000.0000000000)")
+    rows = tk.must_query("select count(*) from mix where a = b").rows
+    assert rows == [("1",)]
+
+
+def test_sum_with_int64_min_no_wrap(tk):
+    tk.must_exec("create table mn (v bigint)")
+    tk.must_exec(f"insert into mn values ({-2**63}), ({-5 * 10**18})")
+    rows = tk.must_query("select sum(v) from mn").rows
+    assert rows == [(str(-2**63 - 5 * 10**18),)]

@@ -512,14 +512,12 @@ class HashAggExec(QueryExecutor):
         return Chunk(out_cols)
 
     def _empty_agg(self, desc):
+        from ..expression.core import _null_fill_array
         ft = desc.ftype
-        dt = np_dtype_for(ft)
         if desc.name in ("count", "approx_count_distinct"):
             return Column(ft, np.zeros(1, dtype=np.int64),
                           np.zeros(1, dtype=bool))
-        data = (np.full(1, b"", dtype=object) if dt is object
-                else np.zeros(1, dtype=dt))
-        return Column(ft, data, np.ones(1, dtype=bool))
+        return Column(ft, _null_fill_array(ft, 1), np.ones(1, dtype=bool))
 
     def _eval_agg(self, desc, chunk, gids, n_groups):
         name = desc.name
@@ -553,12 +551,16 @@ class HashAggExec(QueryExecutor):
                 return Column(ft, s / safe, nonnull == 0)
             s_arg = arg.ftype.scale if k == K_DEC else 0
             s = host.seg_sum_int(gids, n_groups, data, nulls).astype(object)
-            shift = POW10[ft.scale - s_arg]
+            shift = int(POW10[ft.scale - s_arg])
             num = s * shift
             den = safe.astype(object)
             sign = np.where(num < 0, -1, 1)
             q = (2 * np.abs(num) + den) // (2 * den)
-            vals = np.array([int(x) for x in sign * q], dtype=np.int64)
+            res = sign * q
+            if np_dtype_for(ft) is object:    # wide decimal: exact bigints
+                vals = res.astype(object)
+            else:
+                vals = np.array([int(x) for x in res], dtype=np.int64)
             return Column(ft, vals, nonnull == 0)
         if name in ("min", "max"):
             fn = host.seg_min if name == "min" else host.seg_max
@@ -862,7 +864,8 @@ def _combine_left_nulls(left: Chunk, right: Chunk, li, right_schema) -> Chunk:
     for rc in right.columns:
         dt = rc.data.dtype
         if dt == object:
-            data = np.full(n, b"", dtype=object)
+            from ..utils.chunk import null_fill_value
+            data = np.full(n, null_fill_value(rc.ftype), dtype=object)
         else:
             data = np.zeros(n, dtype=dt)
         cols.append(Column(rc.ftype, data, np.ones(n, dtype=bool)))

@@ -171,10 +171,13 @@ def literal_to_constant(lit: ast.Literal) -> Constant:
         return Constant(float(lit.val), FieldType(tp=TYPE_DOUBLE))
     if k == "dec":
         text = str(lit.val)
-        frac = text.split(".", 1)[1] if "." in text else ""
+        ip, _, frac = text.partition(".")
         scale = min(len(frac), MAX_DECIMAL_SCALE)
+        # honest precision: digit count decides int64 vs wide-bigint repr
+        prec = max(len(ip.lstrip("+-").lstrip("0")) + scale, scale, 1)
         return Constant(str_to_decimal(text, scale),
-                        FieldType(tp=TYPE_NEWDECIMAL, flen=30, decimal=scale))
+                        FieldType(tp=TYPE_NEWDECIMAL, flen=prec,
+                                  decimal=scale))
     if k == "str":
         v = lit.val
         return Constant(v.encode() if isinstance(v, str) else v,

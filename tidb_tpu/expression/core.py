@@ -101,11 +101,7 @@ class Constant(Expression):
         n = chunk.num_rows if chunk.num_cols else 1
         dt = np_dtype_for(self.ftype)
         if self.value is None:
-            if dt is object:
-                data = np.full(n, b"", dtype=object)
-            else:
-                data = np.zeros(n, dtype=dt)
-            return data, np.ones(n, dtype=bool)
+            return _null_fill_array(self.ftype, n), np.ones(n, dtype=bool)
         if dt is object:
             data = np.full(n, self.value, dtype=object)
         else:
@@ -117,6 +113,16 @@ class Constant(Expression):
 
 
 _EMPTY_ONE = Chunk([])
+
+
+def _null_fill_array(ft, n):
+    """All-null output buffer with a type-safe fill (see
+    chunk.null_fill_value)."""
+    dt = np_dtype_for(ft)
+    if dt is object:
+        from ..utils.chunk import null_fill_value
+        return np.full(n, null_fill_value(ft), dtype=object)
+    return np.zeros(n, dtype=dt)
 
 
 def const_null() -> Constant:
@@ -192,9 +198,7 @@ class SubqueryApply(Expression):
     def eval(self, chunk: Chunk):
         n = chunk.num_rows
         pairs = [c.eval(chunk) for c in self.outer_cols]
-        dt = np_dtype_for(self.ftype)
-        data = (np.empty(n, dtype=object) if dt is object
-                else np.zeros(n, dtype=dt))
+        data = _null_fill_array(self.ftype, n)
         nulls = np.zeros(n, dtype=bool)
         quant = isinstance(self.mode, tuple)
         if self.mode in ("in", "not_in") or quant:
@@ -314,13 +318,25 @@ def _as_float(data, ft: FieldType):
 
 
 def _as_decimal(data, ft: FieldType, to_scale: int):
-    """-> scaled int64 at to_scale."""
+    """-> scaled int64 at to_scale (object array of exact Python ints for
+    wide decimals — precision > 18)."""
     k = phys_kind(ft)
     if k == K_DEC:
         diff = to_scale - ft.scale
+        if getattr(data, "dtype", None) == object:
+            if diff == 0:
+                return data
+            if diff > 0:
+                return data * (10 ** diff)
+            return _div_round(data, 10 ** (-diff))
         if diff == 0:
             return data.astype(np.int64)
         if diff > 0:
+            # promote to exact bigints when the up-scaled value could pass
+            # 18 digits (wide/narrow mixing makes this reachable)
+            prec = ft.flen if ft.flen and ft.flen > 0 else 18
+            if prec + diff > 18:
+                return data.astype(np.int64).astype(object) * (10 ** diff)
             return data.astype(np.int64) * POW10[diff]
         return _div_round(data.astype(np.int64), POW10[-diff])
     if k == K_FLOAT:
@@ -332,7 +348,23 @@ def _as_decimal(data, ft: FieldType, to_scale: int):
 
 
 def _div_round(num, den):
-    """Vectorized round-half-away-from-zero division (MySQL decimal rounding)."""
+    """Vectorized round-half-away-from-zero division (MySQL decimal
+    rounding); exact bigint path for object (wide-decimal) arrays."""
+    if getattr(num, "dtype", None) == object:
+        d = abs(int(den)) if (np.isscalar(den) or
+                              getattr(den, "shape", ()) == ()) else None
+        if d is not None:
+            d = d or 1
+            neg = int(den) < 0
+            sign = np.where((num < 0) != neg, -1, 1)
+            q = (2 * np.abs(num) + d) // (2 * d)
+            return sign * q
+        den = den.astype(object)
+        sign = np.where((num < 0) != (den < 0), -1, 1)
+        a = np.abs(num)
+        dd = np.abs(den)
+        dd = np.where(dd == 0, 1, dd)
+        return sign * ((2 * a + dd) // (2 * dd))
     num = num.astype(np.int64)
     if np.isscalar(den) or getattr(den, "shape", ()) == ():
         den = np.int64(den)
@@ -697,10 +729,7 @@ def _cast_to(data, nulls, from_ft, to_ft):
     """Coerce evaluated (data,nulls) into to_ft's physical representation."""
     fk, tk = phys_kind(from_ft), phys_kind(to_ft)
     if from_ft.tp == TYPE_NULL:
-        dt = np_dtype_for(to_ft)
-        if dt is object:
-            return np.full(len(data), b"", dtype=object), nulls
-        return np.zeros(len(data), dtype=dt), nulls
+        return _null_fill_array(to_ft, len(data)), nulls
     if tk == K_STR:
         if fk == K_STR:
             return data, nulls
@@ -762,11 +791,7 @@ def _eval_case(sf, chunk):
     args = sf.args
     has_else = len(args) % 2 == 1
     pairs = (len(args) - (1 if has_else else 0)) // 2
-    dt = np_dtype_for(sf.ftype)
-    if dt is object:
-        out = np.full(n_rows, b"", dtype=object)
-    else:
-        out = np.zeros(n_rows, dtype=dt)
+    out = _null_fill_array(sf.ftype, n_rows)
     out_nulls = np.ones(n_rows, dtype=bool)
     decided = np.zeros(n_rows, dtype=bool)
     for p in range(pairs):
@@ -795,9 +820,7 @@ def _eval_if(sf, chunk):
 
 def _eval_coalesce(sf, chunk):
     n_rows = chunk.num_rows
-    dt = np_dtype_for(sf.ftype)
-    out = (np.full(n_rows, b"", dtype=object) if dt is object
-           else np.zeros(n_rows, dtype=dt))
+    out = _null_fill_array(sf.ftype, n_rows)
     out_nulls = np.ones(n_rows, dtype=bool)
     remaining = np.ones(n_rows, dtype=bool)
     for a in sf.args:

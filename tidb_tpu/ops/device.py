@@ -73,6 +73,11 @@ def to_device_col(col) -> DeviceCol:
     sits across a fabric/tunnel)."""
     if col._device is None:
         if col.data.dtype == object:
+            from ..sqltypes import TYPE_NEWDECIMAL
+            if col.ftype.tp == TYPE_NEWDECIMAL:
+                # wide decimals (precision > 18) are exact host bigints;
+                # dict-encoding them as strings would break arithmetic
+                raise DeviceUnsupported("wide-decimal column")
             from ..utils.collate import is_ci
             if is_ci(col.ftype.collate):
                 # dict codes are byte-ordered; _ci semantics need the

@@ -47,8 +47,24 @@ def group_ids(key_columns):
 
 
 def seg_sum_int(gids, n_groups, values, nulls):
-    acc = np.zeros(n_groups, dtype=np.int64)
+    """Per-group exact integer sums. Wide decimals (object arrays of
+    Python ints) and int64 inputs whose total could overflow accumulate
+    as arbitrary-precision Python ints (reference: types/mydecimal.go
+    exact decimal arithmetic; SUM never silently wraps)."""
+    if values.dtype == object:
+        acc = np.zeros(n_groups, dtype=object)
+        np.add.at(acc, gids, np.where(nulls, 0, values))
+        return acc
     v = np.where(nulls, 0, values.astype(np.int64))
+    # conservative wrap bound from exact min/max (np.abs would itself wrap
+    # on INT64_MIN): n * max|v| must fit int64 or accumulate as bigints
+    if len(v):
+        max_abs = max(-int(v.min()), int(v.max()), 1)
+        if len(v) * max_abs > (1 << 62):
+            acc = np.zeros(n_groups, dtype=object)
+            np.add.at(acc, gids, v.astype(object))
+            return acc
+    acc = np.zeros(n_groups, dtype=np.int64)
     np.add.at(acc, gids, v)
     return acc
 
@@ -196,8 +212,19 @@ def partition_ids(key_cols, n_parts):
     h = np.zeros(n, dtype=np.uint64)
     for d, nl in key_cols:
         if d.dtype == object:
-            hv = np.fromiter((hash(x) for x in d), dtype=np.int64,
-                             count=n).view(np.uint64)
+            probe = next((x for x in d
+                          if not isinstance(x, (bytes, bytearray, str))),
+                         None)
+            if isinstance(probe, int):
+                # wide-decimal bigints: two's-complement low 64 bits, so a
+                # value in int64 range hashes identically to the int64
+                # representation on the other join side
+                mask = (1 << 64) - 1
+                hv = np.fromiter((x & mask for x in d), dtype=np.uint64,
+                                 count=n)
+            else:
+                hv = np.fromiter((hash(x) for x in d), dtype=np.int64,
+                                 count=n).view(np.uint64)
         elif d.dtype.kind == "f":
             dd = np.where(d == 0, 0.0, d).astype(np.float64)  # -0.0 == 0.0
             hv = dd.view(np.uint64)

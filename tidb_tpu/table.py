@@ -360,13 +360,15 @@ def rows_to_chunk(info: TableInfo, cols, handles, rowdicts, with_handle=False) -
         # column's origin default; an explicit NULL is stored as None
         default = c.default_value if c.has_default else None
         if dt is object:
+            from .utils.chunk import null_fill_value
+            null_fill = null_fill_value(c.ftype)
             data = np.empty(n, dtype=object)
             for i, rd in enumerate(rowdicts):
                 v = rd.get(c.id, _ABSENT)
                 if v is _ABSENT:
                     v = default
                 if v is None:
-                    data[i] = b""
+                    data[i] = null_fill
                     nulls[i] = True
                 else:
                     data[i] = v

@@ -28,10 +28,28 @@ from ..sqltypes import (
 DEFAULT_CHUNK_SIZE = 65536
 
 
+def null_fill_value(ft: FieldType):
+    """Sentinel stored in an object array's NULL slots: 0 for wide
+    decimals (bigint arithmetic runs over masked slots too), b"" for
+    everything byte-like. ONE definition — every object-array producer
+    must use it."""
+    return 0 if ft.tp == TYPE_NEWDECIMAL else b""
+
+
 def np_dtype_for(ft: FieldType):
-    """numpy physical dtype for a field type; object means host-only bytes."""
+    """numpy physical dtype for a field type; object means host-only bytes.
+
+    Wide decimals (precision > 18 digits — reference types/mydecimal.go
+    holds 81 digits) don't fit a scaled int64: they materialize as object
+    arrays of arbitrary-precision Python ints (SURVEY §7's int128-pair
+    plan, realized as exact bigints host-side; the device path declines
+    and falls back)."""
     tp = ft.tp
-    if tp in INT_TYPES or tp == TYPE_NEWDECIMAL or tp == TYPE_DURATION:
+    if tp == TYPE_NEWDECIMAL:
+        if ft.flen is not None and ft.flen > 18:
+            return object
+        return np.int64
+    if tp in INT_TYPES or tp == TYPE_DURATION:
         return np.int64
     if tp == TYPE_FLOAT:
         return np.float32
@@ -73,10 +91,13 @@ class Column:
         n = len(values)
         nulls = np.fromiter((v is None for v in values), dtype=bool, count=n)
         if dt is object:
+            decimal = ftype.tp == TYPE_NEWDECIMAL
             data = np.empty(n, dtype=object)
             for i, v in enumerate(values):
                 if v is None:
-                    data[i] = b""
+                    data[i] = 0 if decimal else b""
+                elif decimal:
+                    data[i] = int(v)   # wide decimal: exact Python int
                 elif isinstance(v, str):
                     data[i] = v.encode("utf-8")
                 else:
@@ -198,7 +219,10 @@ class Chunk:
         total = 0
         for c in self.columns:
             if c.data.dtype == object:
-                total += sum(len(v) + 49 for v in c.data)  # bytes + obj header
+                # bytes + obj header; wide-decimal bigints ~60B each
+                total += sum(
+                    (len(v) + 49) if isinstance(v, (bytes, bytearray, str))
+                    else 60 for v in c.data)
             else:
                 total += c.data.nbytes
             total += c.nulls.nbytes

@@ -167,8 +167,10 @@ def read_uvarint(data: bytes, pos: int):
 
 
 def write_varint(buf: bytearray, v: int) -> None:
-    # zigzag
-    write_uvarint(buf, (v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1)
+    # zigzag, arbitrary precision: v>=0 -> 2v, v<0 -> -2v-1 (the former
+    # `(v << 1) ^ (v >> 63)` corrupted wide-decimal ints >= 2^63, where
+    # the arithmetic shift is no longer a sign smear)
+    write_uvarint(buf, (v << 1) if v >= 0 else ((-v) << 1) - 1)
 
 
 def read_varint(data: bytes, pos: int):
