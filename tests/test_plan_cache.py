@@ -1,0 +1,250 @@
+"""Prepared-plan cache (reference: planner/core/cache.go CacheKey,
+common_plans.go Execute.getPhysicalPlan/rebuildRange,
+planner/core/cacheable_checker.go Cacheable)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t (id int primary key, a int, b int, "
+                 "d date, key ia (a))")
+    tk.must_exec("insert into t values "
+                 + ",".join(f"({i},{i % 50},{i % 7},"
+                            f"'199{i % 9}-0{i % 9 + 1}-11')"
+                            for i in range(500)))
+    tk.must_exec("analyze table t")
+    return tk
+
+
+def _prep(tk, sql):
+    return tk.session.prepare(sql)[0]
+
+
+def _exec(tk, stmt_ast, params):
+    return [tuple(v) for v in
+            tk.session.execute_prepared(stmt_ast, params).internal_rows]
+
+
+class TestPlanCacheHit:
+    def test_repeat_execute_skips_planning(self, tk):
+        s = _prep(tk, "select a, b from t where a = ? order by id")
+        sess = tk.session
+        r1 = _exec(tk, s, [3])
+        built = sess.plan_builds
+        r2 = _exec(tk, s, [3])
+        assert sess.plan_builds == built  # cache hit: no re-plan
+        assert r1 == r2
+        assert sess.plan_cache.hits >= 1
+
+    def test_rebound_params_give_correct_results(self, tk):
+        s = _prep(tk, "select count(1) from t where a = ?")
+        assert _exec(tk, s, [3]) == [(10,)]
+        built = tk.session.plan_builds
+        assert _exec(tk, s, [7]) == [(10,)]
+        assert _exec(tk, s, [999]) == [(0,)]
+        assert tk.session.plan_builds == built
+
+    def test_point_get_rebinds_handle(self, tk):
+        # access path (PointGet handle) must follow the new param, not the
+        # first execution's (rebuildRange analog)
+        s = _prep(tk, "select id, a from t where id = ?")
+        assert _exec(tk, s, [5]) == [(5, 5)]
+        built = tk.session.plan_builds
+        assert _exec(tk, s, [123]) == [(123, 23)]
+        assert _exec(tk, s, [499]) == [(499, 49)]
+        assert tk.session.plan_builds == built
+
+    def test_date_param_refinement_rebinds(self, tk):
+        # string param refined to a date constant at plan time must re-refine
+        # per execution
+        s = _prep(tk, "select count(1) from t where d < ?")
+        all_rows = _exec(tk, s, ["2001-01-01"])[0][0]
+        none_rows = _exec(tk, s, ["1980-01-01"])[0][0]
+        assert all_rows == 500 and none_rows == 0
+
+    def test_data_changes_visible_through_cached_plan(self, tk):
+        s = _prep(tk, "select count(1) from t where a = ?")
+        assert _exec(tk, s, [3]) == [(10,)]
+        tk.must_exec("insert into t values (1000, 3, 0, '1999-01-01')")
+        assert _exec(tk, s, [3]) == [(11,)]
+
+    def test_param_type_change_replans(self, tk):
+        s = _prep(tk, "select count(1) from t where a = ?")
+        assert _exec(tk, s, [3]) == [(10,)]
+        built = tk.session.plan_builds
+        # float param: fresh plan (different coercions), still correct
+        assert _exec(tk, s, [3.0]) == [(10,)]
+        assert tk.session.plan_builds == built + 1
+
+    def test_lru_capacity_bounds_entries(self, tk):
+        tk.must_exec("set tidb_prepared_plan_cache_size = 2")
+        stmts = [_prep(tk, f"select {i}, count(1) from t where a = ?")
+                 for i in range(4)]
+        for s in stmts:
+            _exec(tk, s, [1])
+        assert len(tk.session.plan_cache._lru) <= 2
+
+
+class TestPlanCacheInvalidation:
+    def test_ddl_invalidates(self, tk):
+        s = _prep(tk, "select a from t where id = ?")
+        assert _exec(tk, s, [7]) == [(7,)]
+        built = tk.session.plan_builds
+        tk.must_exec("alter table t add column c int")
+        # schema version changed: re-plan, and the result stays correct
+        assert _exec(tk, s, [7]) == [(7,)]
+        assert tk.session.plan_builds > built
+
+    def test_analyze_invalidates(self, tk):
+        s = _prep(tk, "select count(1) from t where a = ?")
+        _exec(tk, s, [3])
+        built = tk.session.plan_builds
+        tk.must_exec("analyze table t")
+        _exec(tk, s, [3])
+        assert tk.session.plan_builds > built
+
+    def test_binding_invalidates(self, tk):
+        s = _prep(tk, "select * from t where a = ?")
+        _exec(tk, s, [3])
+        built = tk.session.plan_builds
+        tk.must_exec("create session binding for "
+                     "select * from t where a = 3 using "
+                     "select * from t ignore index (ia) where a = 3")
+        _exec(tk, s, [3])
+        assert tk.session.plan_builds > built
+
+    def test_disable_sysvar(self, tk):
+        tk.must_exec("set tidb_enable_prepared_plan_cache = OFF")
+        s = _prep(tk, "select count(1) from t where a = ?")
+        _exec(tk, s, [3])
+        built = tk.session.plan_builds
+        _exec(tk, s, [3])
+        assert tk.session.plan_builds == built + 1  # re-planned
+
+
+class TestUncacheable:
+    def _replans(self, tk, sql, params):
+        s = _prep(tk, sql)
+        _exec(tk, s, params)
+        built = tk.session.plan_builds
+        _exec(tk, s, params)
+        return tk.session.plan_builds == built + 1
+
+    def test_now_is_uncacheable(self, tk):
+        assert self._replans(
+            tk, "select count(1) from t where d < now() and a = ?", [3])
+
+    def test_subquery_is_uncacheable(self, tk):
+        assert self._replans(
+            tk, "select count(1) from t where a = ? and "
+                "id in (select id from t where b = 1)", [3])
+
+    def test_param_in_limit_is_uncacheable(self, tk):
+        s = _prep(tk, "select id from t order by id limit ?")
+        assert _exec(tk, s, [3]) == [(0,), (1,), (2,)]
+        assert _exec(tk, s, [1]) == [(0,)]  # must not freeze first limit
+
+    def test_param_in_in_list_is_uncacheable(self, tk):
+        s = _prep(tk, "select count(1) from t where a in (?, ?)")
+        assert _exec(tk, s, [3, 4]) == [(20,)]
+        assert _exec(tk, s, [5, 6]) == [(20,)]
+        assert _exec(tk, s, [3, 3]) == [(10,)]
+
+    def test_param_like_pattern_is_uncacheable(self, tk):
+        tk.must_exec("create table ts (v varchar(20))")
+        tk.must_exec("insert into ts values ('apple'), ('banana'), ('apri')")
+        s = _prep(tk, "select count(1) from ts where v like ?")
+        assert _exec(tk, s, ["ap%"]) == [(2,)]
+        assert _exec(tk, s, ["ban%"]) == [(1,)]
+
+    def test_uservar_is_uncacheable(self, tk):
+        tk.must_exec("set @x = 3")
+        s = _prep(tk, "select count(1) from t where a = @x and b < ?")
+        assert _exec(tk, s, [100]) == [(10,)]
+        tk.must_exec("set @x = 4")
+        assert _exec(tk, s, [100]) == [(10,)]
+
+
+class TestSeekValueDomains:
+    """Eq/range seek keys must live in the indexed column's value domain
+    (review findings: bytes / decimal-literal / float constants against an
+    int or decimal indexed column must not seek impossible keys)."""
+
+    @pytest.fixture()
+    def utk(self):
+        tk = TestKit()
+        tk.must_exec("use test")
+        tk.must_exec("create table u (id int primary key, a int, "
+                     "unique key ua (a))")
+        tk.must_exec("insert into u values "
+                     + ",".join(f"({i},{i})" for i in range(200)))
+        tk.must_exec("analyze table u")
+        return tk
+
+    def test_string_eq_on_int_unique_index(self, utk):
+        # MySQL coerces 'garbage' to 0.0 → matches a=0
+        assert utk.must_query(
+            "select count(1) from u where a = 'garbage'").rows == [("1",)]
+        assert utk.must_query(
+            "select count(1) from u where a = '3'").rows == [("1",)]
+        assert utk.must_query(
+            "select count(1) from u where a = '3x'").rows == [("1",)]  # →3.0
+
+    def test_decimal_eq_on_int_unique_index(self, utk):
+        assert utk.must_query(
+            "select count(1) from u where a = 3.0").rows == [("1",)]
+        assert utk.must_query(
+            "select count(1) from u where a = 3.5").rows == [("0",)]
+
+    def test_prepared_string_params_order_independent(self, utk):
+        s = _prep(utk, "select count(1) from u where a = ?")
+        assert _exec(utk, s, ["garbage"]) == [(1,)]  # coerces to 0
+        assert _exec(utk, s, ["3"]) == [(1,)]
+        assert _exec(utk, s, ["garbage"]) == [(1,)]
+        assert _exec(utk, s, ["3"]) == [(1,)]
+
+    def test_date_param_garbage_then_valid(self, tk):
+        s = _prep(tk, "select count(1) from t where d < ?")
+        assert _exec(tk, s, ["2001-01-01"]) == [(500,)]
+        _exec(tk, s, ["garbage"])  # unrefinable: re-plans, must not poison
+        assert _exec(tk, s, ["2001-01-01"]) == [(500,)]
+        assert _exec(tk, s, ["1980-01-01"]) == [(0,)]
+
+    def test_int_range_on_decimal_index(self):
+        tk = TestKit()
+        tk.must_exec("use test")
+        tk.must_exec("create table dpr (id int primary key, "
+                     "p decimal(10,2), key ip (p))")
+        tk.must_exec("insert into dpr values "
+                     + ",".join(f"({i},{i}.25)" for i in range(500)))
+        tk.must_exec("analyze table dpr")
+        # hi bound 100 must scale to the decimal key domain (10000), not
+        # cut the scan at scaled key 100 (= 1.00)
+        assert tk.must_query(
+            "select count(1) from dpr where p < 100").rows == [("100",)]
+        assert tk.must_query(
+            "select count(1) from dpr where p > 400.5 and p < 402").rows \
+            == [("1",)]
+
+
+class TestPartitionReprune:
+    def test_partition_pruning_follows_param(self, tk):
+        tk.must_exec("""
+            create table p (id int, v int)
+            partition by range (id) (
+              partition p0 values less than (100),
+              partition p1 values less than (200),
+              partition p2 values less than maxvalue)""")
+        tk.must_exec("insert into p values (50, 1), (150, 2), (250, 3)")
+        s = _prep(tk, "select v from p where id = ?")
+        assert _exec(tk, s, [50]) == [(1,)]
+        built = tk.session.plan_builds
+        # different partitions must be re-pruned per execution on a hit
+        assert _exec(tk, s, [150]) == [(2,)]
+        assert _exec(tk, s, [250]) == [(3,)]
+        assert tk.session.plan_builds == built

@@ -73,6 +73,7 @@ class BindHandle:
         self.domain = domain
         self._lock = threading.Lock()
         self.cache: dict[str, dict] = {}
+        self.version = 0   # bumped per change; invalidates cached plans
         self.load()
 
     def load(self):
@@ -83,6 +84,7 @@ class BindHandle:
             txn.rollback()
         with self._lock:
             self.cache = binds
+            self.version += 1
 
     def match(self, norm_sql: str):
         with self._lock:
@@ -98,6 +100,7 @@ class BindHandle:
             raise
         with self._lock:
             self.cache[norm_sql] = rec
+            self.version += 1
 
     def drop(self, norm_sql: str) -> bool:
         txn = self.domain.store.begin()
@@ -108,6 +111,7 @@ class BindHandle:
             txn.rollback()
             raise
         with self._lock:
+            self.version += 1
             return self.cache.pop(norm_sql, None) is not None
 
     def list(self):

@@ -203,6 +203,7 @@ class DDLExecutor:
             raise
         tbl.temporary = True
         sess.temp_tables[key] = tbl
+        sess.temp_tables_version += 1
         if stmt.select is not None:
             sess.execute(f"INSERT INTO `{db_name}`.`{stmt.table.name}` "
                          + stmt.select.restore())

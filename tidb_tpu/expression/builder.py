@@ -146,16 +146,27 @@ def refine_cmp_const(e, other):
         return e
     tk = other.ftype.tp
     v = e.value.decode() if isinstance(e.value, bytes) else str(e.value)
+
+    def _refined(value, ft, conv):
+        c = Constant(value, ft)
+        if e.param_idx is not None:
+            # keep param provenance + record the conversion so a plan-cache
+            # hit can redo the refinement on the new raw value
+            c.param_idx = e.param_idx
+            c.param_conv = conv
+        return c
+
     try:
         if tk in (TYPE_DATE, TYPE_NEWDATE):
-            return Constant(parse_date_str(v), FieldType(tp=TYPE_DATE))
+            return _refined(parse_date_str(v), FieldType(tp=TYPE_DATE),
+                            "date")
         if tk in (TYPE_DATETIME, TYPE_TIMESTAMP):
-            return Constant(parse_datetime_str(v),
-                            FieldType(tp=TYPE_DATETIME))
+            return _refined(parse_datetime_str(v),
+                            FieldType(tp=TYPE_DATETIME), "datetime")
         if phys_kind(other.ftype) in (K_INT, K_DEC, K_FLOAT):
             # MySQL compares string vs numeric as double; only refine when
             # the whole string parses (prefix-parse semantics stay at eval)
-            return Constant(float(v), FieldType(tp=TYPE_DOUBLE))
+            return _refined(float(v), FieldType(tp=TYPE_DOUBLE), "float")
     except (ValueError, TiDBError):
         pass
     return e
@@ -350,7 +361,9 @@ class ExprBuilder:
                 v = self.ctx.params[node.index]
             except IndexError:
                 raise TiDBError("missing prepared statement parameter")
-            return _python_value_to_constant(v)
+            c = _python_value_to_constant(v)
+            c.param_idx = node.index  # rebindable on plan-cache hits
+            return c
         raise TiDBError("parameter marker outside prepared statement")
 
     def _b_VariableExpr(self, node):
@@ -834,6 +847,10 @@ def fold_constant(expr: Expression) -> Expression:
     if not isinstance(expr, ScalarFunc) or expr.op in _NONDETERMINISTIC:
         return expr
     if not expr.args or not all(isinstance(a, Constant) for a in expr.args):
+        return expr
+    if any(a.param_idx is not None for a in expr.args):
+        # never fold a prepared param into a derived constant — the param
+        # leaf must survive so plan-cache hits can rebind it in place
         return expr
     try:
         v = expr.eval_scalar()

@@ -93,6 +93,13 @@ class Column(Expression):
 
 
 class Constant(Expression):
+    # prepared-statement parameter provenance (planner/plan_cache.py): set
+    # when this constant came from a '?' marker, so a cached plan can rebind
+    # it in place; param_conv records the compare-refinement applied to the
+    # raw value ("date"/"datetime"/"float") so rebinding can redo it.
+    param_idx = None
+    param_conv = None
+
     def __init__(self, value, ftype: FieldType):
         self.value = value
         self.ftype = ftype

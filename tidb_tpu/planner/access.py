@@ -46,6 +46,79 @@ def _int_like(v):
     return isinstance(v, (int, np.integer)) and not isinstance(v, bool)
 
 
+#: sentinel: a comparison constant that cannot be represented as a seek key
+#: in the indexed column's value domain — the cond is then left out of index
+#: classification and the scan+post-filter path preserves eval coercion
+#: semantics (MySQL compares string/decimal/float against int columns as
+#: double; an index key comparison would not).
+_SKIP = object()
+
+
+def _seek_value(const, col_ft, side=None):
+    """Normalize an eq/range comparison Constant into the indexed column's
+    internal value domain (decimal columns store scaled ints, date columns
+    store day numbers, …). `side` is None for eq (conversion must be exact
+    or _SKIP), "lo"/"hi" for range bounds (inexact conversions widen toward
+    including more — the post-filter trims exactly)."""
+    from ..expression.core import (K_DATE, K_DEC, K_FLOAT, K_INT, K_STR,
+                                   phys_kind)
+    from ..sqltypes import TYPE_NEWDECIMAL
+    v = const.value
+    if v is None or isinstance(v, bool):
+        return _SKIP
+    kind = phys_kind(col_ft)
+    const_scale = (const.ftype.scale
+                   if const.ftype.tp == TYPE_NEWDECIMAL else None)
+
+    def _num():
+        """The constant as an exact (int) or approximate (float) number."""
+        if const_scale is not None:
+            return int(v) / (10 ** const_scale) if const_scale else int(v)
+        return v
+
+    def _to_int(x):
+        if isinstance(x, (int, np.integer)):
+            return int(x)
+        x = float(x)
+        if side is None:
+            return int(x) if x.is_integer() else _SKIP
+        return int(np.floor(x)) if side == "lo" else int(np.ceil(x))
+
+    if kind == K_STR:
+        return v if isinstance(v, bytes) else _SKIP
+    if isinstance(v, bytes):
+        return _SKIP  # unrefined string vs non-string column: scan+filter
+    if kind == K_INT:
+        return _to_int(_num())
+    if kind == K_DATE:
+        # refine_cmp_const already parsed date strings to day numbers
+        return _to_int(_num())
+    if kind == K_FLOAT:
+        return float(_num())
+    if kind == K_DEC:
+        scale = col_ft.scale or 0
+        if const_scale is not None:
+            if const_scale == scale:
+                return int(v)
+            if const_scale < scale:
+                return int(v) * 10 ** (scale - const_scale)
+            q, r = divmod(int(v), 10 ** (const_scale - scale))
+            if r == 0:
+                return q
+            if side is None:
+                return _SKIP
+            return q if side == "lo" else q + 1
+        return _to_int(_num() * 10 ** scale if scale else _num())
+    return _SKIP
+
+
+def _cond_const(cond):
+    """The Constant side of cmp(col, const) (parallel to _col_const)."""
+    from ..expression.core import Constant
+    a, b = cond.args
+    return b if isinstance(b, Constant) else a
+
+
 def _hint_sets(ds):
     """USE/FORCE/IGNORE INDEX hints → (allowed | None, excluded, forced)
     (reference: planner/core accessPath hint pruning)."""
@@ -79,13 +152,21 @@ def _choose(ds: DataSource, ctx):
         if cc is None:
             continue
         col, v, op = cc
-        if v is None:
+        if v is None or col.idx >= len(ds.col_infos):
             continue
+        col_ft = ds.col_infos[col.idx].ftype
         if op == "eq":
-            eq.setdefault(col.idx, v)
+            sv = _seek_value(_cond_const(c), col_ft)
+            if sv is _SKIP:
+                continue
+            eq.setdefault(col.idx, sv)
             by_idx.setdefault(col.idx, []).append(c)
-        elif op in ("lt", "le", "gt", "ge") and isinstance(v, (int, float)):
-            rngs.setdefault(col.idx, []).append((op, v))
+        elif op in ("lt", "le", "gt", "ge"):
+            side = "lo" if op in ("gt", "ge") else "hi"
+            sv = _seek_value(_cond_const(c), col_ft, side)
+            if sv is _SKIP or isinstance(sv, bytes):
+                continue  # keep historical behavior: numeric bounds only
+            rngs.setdefault(col.idx, []).append((op, sv))
             by_idx.setdefault(col.idx, []).append(c)
     allowed, excluded, forced = _hint_sets(ds)
     name2idx = {ci.name: i for i, ci in enumerate(ds.col_infos)}
@@ -176,10 +257,10 @@ def _choose(ds: DataSource, ctx):
         est_rows = max(n * sel, 1.0)
         cost = SEEK_BASE + est_rows * SEEK_COST
         if best is None or cost < best[0]:
-            lo = (prefix + ([_idx_bound(lo_b)] if lo_b is not None else [])
-                  ) or None
-            hi = (prefix + ([_idx_bound(hi_b)] if hi_b is not None else [])
-                  ) or None
+            # bounds are already normalized into the column's value domain
+            # by _seek_value at classification time
+            lo = (prefix + ([lo_b] if lo_b is not None else [])) or None
+            hi = (prefix + ([hi_b] if hi_b is not None else [])) or None
             if lo_b is None and prefix:
                 lo = list(prefix)
             if hi_b is None and prefix:
@@ -228,12 +309,3 @@ def _choose_batch(ds, info, name2idx, allowed, excluded):
                 return
 
 
-def _idx_bound(v):
-    """Range bound → index-codec value (floats from histograms/consts may
-    bound an int column; truncate toward -inf so the inclusive scan keeps
-    every candidate — post-filters trim exactly)."""
-    if isinstance(v, float) and float(v).is_integer():
-        return int(v)
-    if isinstance(v, float):
-        return int(np.floor(v))
-    return v

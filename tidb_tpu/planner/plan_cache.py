@@ -1,0 +1,234 @@
+"""Prepared-statement plan cache (reference: planner/core/cache.go CacheKey,
+common_plans.go Execute.getPhysicalPlan + rebuildRange, and the cacheable
+checker planner/core/cacheable_checker.go).
+
+Design: parameters survive planning as leaf Constants tagged with
+``param_idx`` (constant folding and compare-refinement keep the tag —
+refinement records its conversion in ``param_conv`` so a cache hit can redo
+it on the new value). On a hit the session rebinds those constants in place
+and re-runs the two value-dependent physical stages — partition pruning and
+access-path choice — on the cached plan; that is this engine's analog of the
+reference's Execute.rebuildRange. Statements that bake values anywhere else
+(subqueries, IN lists, LIKE patterns, LIMIT ?, variables, now()-family
+functions, CTEs) are rejected up front by :func:`is_cacheable`, mirroring
+the reference's conservative Cacheable() walk.
+
+The cache itself is per-session (the reference's prepared-plan cache is
+session-scoped too) and LRU-bounded by ``tidb_prepared_plan_cache_size``.
+Schema, statistics and plan-binding changes invalidate entries through
+version counters folded into the key, not by eager sweeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+
+from ..expression.core import Constant, Expression, ScalarFunc
+from ..parser import ast
+from .logical import (
+    Aggregation, DataSource, Join, LogicalPlan, Projection, Selection, Sort,
+    TopN, Window,
+)
+
+# Functions whose value is fixed at plan time (folded as constants) but
+# varies per execution — a cached plan would freeze the first execution's
+# value (reference: cacheable_checker.go + expression.unFoldableFunctions).
+UNCACHEABLE_FUNCS = frozenset({
+    "now", "current_timestamp", "sysdate", "curdate", "current_date",
+    "curtime", "current_time", "utc_date", "utc_time", "utc_timestamp",
+    "unix_timestamp", "rand", "uuid", "sleep", "user", "current_user",
+    "session_user", "system_user", "database", "schema", "connection_id",
+    "last_insert_id", "found_rows", "row_count", "version", "benchmark",
+})
+
+
+def _walk_ast(node):
+    """Yield every dataclass AST node reachable from `node`."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (list, tuple)):
+            stack.extend(n)
+            continue
+        if not isinstance(n, ast.Node):
+            continue
+        yield n
+        if dataclasses.is_dataclass(n):
+            for f in dataclasses.fields(n):
+                stack.append(getattr(n, f.name))
+
+
+def is_cacheable(stmt) -> bool:
+    """Conservative statement-level check (reference: Cacheable(), planner/
+    core/cacheable_checker.go): True only when every value the plan bakes in
+    is either a true literal or a rebindable tagged param Constant."""
+    if not isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
+        return False
+    for n in _walk_ast(stmt):
+        if isinstance(n, (ast.SubqueryExpr, ast.ExistsExpr,
+                          ast.CompareSubquery, ast.VariableExpr)):
+            return False
+        if isinstance(n, ast.SelectStmt) and n.with_ctes:
+            return False
+        if isinstance(n, ast.SelectStmt) and n.for_update:
+            return False
+        if isinstance(n, ast.FuncCall) and n.name in UNCACHEABLE_FUNCS:
+            return False
+        if isinstance(n, ast.Limit):
+            # LIMIT/OFFSET are eval'd to ints at build time (builder.py)
+            for sub in _walk_ast([n.count, n.offset]):
+                if isinstance(sub, ast.ParamMarker):
+                    return False
+        if isinstance(n, ast.InExpr):
+            # the IN value set is materialized at build time (build_in_set)
+            for sub in _walk_ast(n.items):
+                if isinstance(sub, ast.ParamMarker):
+                    return False
+        if isinstance(n, ast.LikeExpr):
+            # the regex is precompiled at build time when the pattern is
+            # constant — a param pattern would freeze the first pattern
+            for sub in _walk_ast(n.pattern):
+                if isinstance(sub, ast.ParamMarker):
+                    return False
+    return True
+
+
+def param_kinds(params) -> tuple:
+    """Type-kind signature of the bound parameters: a param whose python
+    type changes between EXECUTEs gets a fresh plan (the baked comparison
+    coercions may differ), mirroring the reference's inclusion of param
+    types in the cache key (cache.go NewPlanCacheKey)."""
+    return tuple(type(p).__name__ for p in params)
+
+
+# ---------------------------------------------------------------------------
+# plan-side: find/rebind tagged param constants
+
+
+def _iter_node_exprs(p: LogicalPlan):
+    if isinstance(p, DataSource):
+        return p.pushed_conds
+    if isinstance(p, Selection):
+        return p.conds
+    if isinstance(p, Projection):
+        return p.exprs
+    if isinstance(p, Join):
+        return (p.left_keys + p.right_keys + p.other_conds)
+    if isinstance(p, Aggregation):
+        out = list(p.group_exprs)
+        for a in p.aggs:
+            out.extend(a.args)
+        return out
+    if isinstance(p, (Sort, TopN)):
+        return [e for e, _d in p.by]
+    if isinstance(p, Window):
+        out = list(p.partition_exprs) + [e for e, _d in p.order_by]
+        for f in p.funcs:
+            out.extend(f.args)
+        return out
+    return ()
+
+
+def collect_param_consts(plan: LogicalPlan):
+    """All param-tagged Constant leaves in the optimized plan, with their
+    recorded refinement conversion. Returns [(const, idx, conv)]."""
+    found = []
+    seen = set()
+
+    def visit_expr(e: Expression):
+        if isinstance(e, Constant):
+            if e.param_idx is not None and id(e) not in seen:
+                seen.add(id(e))
+                found.append((e, e.param_idx, e.param_conv))
+            return
+        if isinstance(e, ScalarFunc):
+            for a in e.args:
+                visit_expr(a)
+
+    def visit_plan(p: LogicalPlan):
+        for e in _iter_node_exprs(p):
+            visit_expr(e)
+        for c in p.children:
+            visit_plan(c)
+
+    visit_plan(plan)
+    return found
+
+
+def rebind_params(entry_consts, params) -> bool:
+    """Rebind new parameter values into a cached plan's tagged constants.
+    Returns False when a recorded refinement no longer applies (e.g. the
+    new string doesn't parse as a date) — the caller then re-plans."""
+    from ..expression.builder import _python_value_to_constant
+    from ..sqltypes import parse_date_str, parse_datetime_str
+
+    for const, idx, conv in entry_consts:
+        if idx >= len(params):
+            return False
+        base = _python_value_to_constant(params[idx])
+        v = base.value
+        if conv is not None and v is not None:
+            s = v.decode() if isinstance(v, bytes) else str(v)
+            try:
+                if conv == "date":
+                    v = parse_date_str(s)
+                elif conv == "datetime":
+                    v = parse_datetime_str(s)
+                elif conv == "float":
+                    v = float(s)
+            except Exception:
+                return False
+        elif conv is not None and v is None:
+            pass  # NULL rebinds as NULL regardless of refinement
+        const.value = v
+    return True
+
+
+def reprune(plan: LogicalPlan, ctx):
+    """Re-run the value-dependent physical stages on a cached plan after
+    rebinding (the reference's Execute.rebuildRange analog): reset and
+    re-prune partitions, re-choose access paths. Both stages re-derive
+    from pushed_conds, so they are idempotent across hits."""
+    from .access import choose_access_paths
+    from .optimizer import prune_partitions_rule
+
+    def reset(p):
+        if isinstance(p, DataSource) and p.table_info.partition is not None:
+            p.partitions = list(p.table_info.partition.defs)
+        for c in p.children:
+            reset(c)
+
+    reset(plan)
+    prune_partitions_rule(plan)
+    choose_access_paths(plan, ctx)
+
+
+class SessionPlanCache:
+    """LRU keyed by (digest, db, schema ver, stats ver, binding ver,
+    param kinds) (reference: planner/core/cache.go NewPlanCacheKey)."""
+
+    def __init__(self):
+        self._lru = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        e = self._lru.get(key)
+        if e is not None:
+            self._lru.move_to_end(key)
+            self.hits += 1
+        else:
+            self.misses += 1
+        return e
+
+    def put(self, key, plan, consts, capacity: int):
+        if capacity <= 0:
+            return
+        self._lru[key] = (plan, consts)
+        self._lru.move_to_end(key)
+        while len(self._lru) > capacity:
+            self._lru.popitem(last=False)
+
+    def clear(self):
+        self._lru.clear()

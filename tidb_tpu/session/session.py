@@ -38,6 +38,8 @@ class Domain:
         self._infoschema: InfoSchema | None = None
         self.global_vars: dict[str, str] = {}
         self.stats: dict[int, dict] = {}      # table_id -> stats blob
+        self.stats_version = 0                # bumped per stats change
+        #                                       (invalidates cached plans)
         self.ddl_lock = threading.RLock()     # single-owner DDL (owner role)
         self.observe = Observability()        # slow log + stmt summary + metrics
         # conn_id -> live session, weakly: embedded users who never close()
@@ -89,6 +91,7 @@ class Domain:
                     s = m.stats(t.id)
                     if s:
                         self.stats[t.id] = s
+                        self.stats_version += 1
         finally:
             txn.rollback()
 
@@ -248,9 +251,14 @@ class Session:
         self._in_txn_retry = False
         self.session_bindings: dict[str, dict] = {}  # SESSION plan bindings
         self.binding_used = None   # normalized sql of the last matched binding
+        self.bindings_version = 0  # session-binding change counter
+        from ..planner.plan_cache import SessionPlanCache
+        self.plan_cache = SessionPlanCache()  # prepared-plan cache
+        self.plan_builds = 0       # full plan builds (test observability)
         # session-local temporary tables: (db, name) -> TableInfo
         # (reference: table/temptable)
         self.temp_tables: dict[tuple, object] = {}
+        self.temp_tables_version = 0  # bumped per create/drop (plan cache)
         self.seq_lastval: dict[int, int] = {}  # sequence id -> LASTVAL
         self.seq_cache: dict[int, tuple] = {}  # sequence id -> (next, left)
         self.user = "root@%"
@@ -286,6 +294,7 @@ class Session:
 
     def drop_temp_table(self, key):
         info = self.temp_tables.pop(key, None)
+        self.temp_tables_version += 1
         if info is not None:
             self.ddl._delete_table_data(info)
 
@@ -913,6 +922,7 @@ class Session:
                 self.domain.bind_handle.create(key, rec)
             else:
                 self.session_bindings[key] = rec
+                self.bindings_version += 1
             return Result()
         if isinstance(stmt, ast.DropBindingStmt):
             from ..bindinfo import binding_key, normalized_sql
@@ -922,6 +932,7 @@ class Session:
                 self.domain.bind_handle.drop(key)
             else:
                 self.session_bindings.pop(key, None)
+                self.bindings_version += 1
             return Result()
         if isinstance(stmt, ast.DropTableStmt):
             self.ddl.drop_table(stmt)
@@ -1179,6 +1190,7 @@ class Session:
                                                ast.SetOprStmt)):
             undo = self._apply_binding(stmt)
         try:
+            self.plan_builds += 1
             builder = PlanBuilder(self._expr_ctx, outer=outer)
             plan = builder.build(stmt)
             return optimize(plan, self._expr_ctx)
@@ -1218,11 +1230,71 @@ class Session:
 
     def run_query(self, stmt, outer=None) -> Result:
         from ..executor import build_executor
-        plan = self.plan_query(stmt, outer=outer)
+        plan = cache_key = None
+        if (outer is None and self._expr_ctx.params is not None
+                and isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt))):
+            plan, cache_key = self._cached_plan(stmt)
+        if plan is None:
+            plan = self.plan_query(stmt, outer=outer)
+            if cache_key is not None:
+                from ..planner.plan_cache import collect_param_consts
+                try:
+                    cap = int(self.get_sysvar(
+                        "tidb_prepared_plan_cache_size"))
+                except Exception:
+                    cap = 0
+                self.plan_cache.put(cache_key, plan,
+                                    collect_param_consts(plan), cap)
         exe = build_executor(plan, self._exec_ctx())
         chunk = exe.execute()
         names = _schema_names(plan)
         return Result(names=names, chunk=chunk)
+
+    def _cached_plan(self, stmt):
+        """Prepared-plan cache lookup (reference: planner/core/
+        common_plans.go Execute.getPhysicalPlan). Returns (plan|None,
+        key|None): a key without a plan means 'cacheable — store after
+        planning'. On a hit, the new params are rebound into the cached
+        plan's tagged constants and the value-dependent physical stages
+        re-run (the rebuildRange analog, planner/plan_cache.py)."""
+        from ..planner import plan_cache as pc
+        try:
+            enabled = str(self.get_sysvar(
+                "tidb_enable_prepared_plan_cache")).upper() in ("ON", "1")
+        except Exception:
+            enabled = False
+        if not enabled:
+            return None, None
+        # the prepared AST is immutable between executions: memoize the
+        # cacheability walk and the digest on it (the text-protocol EXECUTE
+        # path re-parses, so a fresh AST just re-memoizes)
+        cacheable = getattr(stmt, "_pc_cacheable", None)
+        if cacheable is None:
+            cacheable = pc.is_cacheable(stmt)
+            stmt._pc_cacheable = cacheable
+        if not cacheable:
+            return None, None
+        digest = getattr(stmt, "_pc_digest", None)
+        if digest is None:
+            digest = sql_digest(stmt.restore())
+            stmt._pc_digest = digest
+        params = self._expr_ctx.params
+        key = (digest, self._db,
+               self.infoschema().version, self.domain.stats_version,
+               self.domain.bind_handle.version, self.bindings_version,
+               self.temp_tables_version, pc.param_kinds(params))
+        ent = self.plan_cache.get(key)
+        if ent is None:
+            return None, key
+        plan, consts = ent
+        if not pc.rebind_params(consts, params):
+            # a recorded refinement doesn't apply to these param values
+            # (e.g. unparseable date string): re-plan WITHOUT overwriting
+            # the good refined entry — the unrefined plan would downgrade
+            # every later execution under the same key
+            return None, None
+        pc.reprune(plan, self._expr_ctx)
+        return plan, key
 
     def _exec_ctx(self):
         return self

@@ -100,6 +100,10 @@ for _v in [
     SysVar("tidb_txn_mode", SCOPE_BOTH, "pessimistic", "enum",
            choices=("pessimistic", "optimistic")),
     SysVar("tidb_retry_limit", SCOPE_BOTH, "10", "int", 0),
+    # prepared-plan cache (reference: planner/core/cache.go; v5 config
+    # prepared-plan-cache {enabled, capacity})
+    SysVar("tidb_enable_prepared_plan_cache", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_prepared_plan_cache_size", SCOPE_BOTH, "100", "int", 0),
     SysVar("tidb_enable_window_function", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_enable_topn_push_down", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_mesh_shape", SCOPE_BOTH, "1", "str"),

@@ -155,4 +155,5 @@ def analyze_table(session, info):
         txn.rollback()
         raise
     session.domain.stats[info.id] = stats
+    session.domain.stats_version += 1  # invalidate cached plans
     return stats
