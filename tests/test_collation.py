@@ -78,3 +78,123 @@ def test_ci_device_fallback_parity(tk):
     r = tk.must_query("select count(*) from ci group by s order by 1")
     assert [row[0] for row in r.rows] == ["1", "2", "2"]
     tk.must_exec("set tidb_executor_engine = 'auto'")
+
+
+class TestWeightTables:
+    """Real collator semantics (reference: util/collate/unicode_ci_data.go;
+    MySQL docs' documented cases: general_ci ß=s, unicode_ci ß=ss, Ä=A
+    for both)."""
+
+    @pytest.fixture()
+    def wtk(self):
+        tk = TestKit()
+        tk.must_exec(
+            "create table w (id int primary key, "
+            "g varchar(20) collate utf8mb4_general_ci, "
+            "u varchar(20) collate utf8mb4_unicode_ci)")
+        tk.must_exec(
+            "insert into w values (1,'straße','straße'), "
+            "(2,'STRASSE','STRASSE'), (3,'Åpple','Åpple'), "
+            "(4,'apple','apple'), (5,'résumé','résumé')")
+        return tk
+
+    def test_general_ci_sharp_s_equals_s_not_ss(self, wtk):
+        # general_ci: ß weighs as S (no expansion) → straße = strase
+        wtk.must_query("select id from w where g = 'strase'").check([("1",)])
+        wtk.must_query("select id from w where g = 'STRASSE'").check([("2",)])
+
+    def test_unicode_ci_sharp_s_expands_to_ss(self, wtk):
+        # unicode_ci: ß = ss → straße = strasse = STRASSE
+        wtk.must_query("select id from w where u = 'strasse' order by id"
+                       ).check([("1",), ("2",)])
+        wtk.must_query("select id from w where u = 'strase'").check([])
+
+    def test_accent_fold_A_ring(self, wtk):
+        # Å = A in both collations
+        wtk.must_query("select id from w where g = 'APPLE' order by id"
+                       ).check([("3",), ("4",)])
+        wtk.must_query("select id from w where u = 'APPLE' order by id"
+                       ).check([("3",), ("4",)])
+
+    def test_accent_fold_e_acute(self, wtk):
+        wtk.must_query("select id from w where g = 'RESUME'").check([("5",)])
+        wtk.must_query("select id from w where u = 'RESUME'").check([("5",)])
+
+    def test_group_by_merges_weight_equal(self, wtk):
+        r = wtk.must_query("select count(*) from w group by u order by 1 desc")
+        assert [row[0] for row in r.rows] == ["2", "2", "1"]
+
+
+class TestDeviceCI:
+    """_ci columns on the device engine: collation-class dictionary codes
+    (ops/device.py to_device_col via dict_encode_ci) — the round-2 host
+    fallback removed per VERDICT item 7."""
+
+    @pytest.fixture()
+    def dtk(self):
+        tk = TestKit()
+        tk.must_exec(
+            "create table dc (s varchar(20) collate utf8mb4_general_ci, "
+            "v int)")
+        tk.must_exec(
+            "insert into dc values ('Apple',1),('APPLE',2),('banana',3),"
+            "('Banana',4),('cherry',5),('straße',6),('STRASE',7)")
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        return tk
+
+    def test_ci_group_by_on_device(self, dtk):
+        txt = "\n".join(
+            " ".join(map(str, r)) for r in dtk.must_query(
+                "explain analyze select s, count(*), sum(v) from dc "
+                "group by s order by s").rows)
+        assert "engine:tpu" in txt  # the fragment really ran on-device
+        r = dtk.must_query(
+            "select count(*), sum(v) from dc group by s order by 1, 2")
+        assert [tuple(x) for x in r.rows] == [
+            ("1", "5"), ("2", "3"), ("2", "7"), ("2", "13")]
+
+    def test_ci_eq_filter_on_device(self, dtk):
+        r = dtk.must_query(
+            "select sum(v) from dc where s = 'apple'")
+        assert r.rows == [("3",)]
+        r = dtk.must_query("select sum(v) from dc where s = 'STRASE'")
+        assert r.rows == [("13",)]  # straße(6) + STRASE(7) under general_ci
+
+    def test_ci_range_compare_on_device(self, dtk):
+        # class codes are ordered by sort key → ordering comparisons valid
+        r = dtk.must_query("select count(*) from dc where s < 'BANANA'")
+        assert r.rows == [("2",)]
+
+    def test_ci_like_on_device(self, dtk):
+        r = dtk.must_query("select count(*) from dc where s like 'app%'")
+        assert r.rows == [("2",)]
+
+    def test_ci_in_on_device(self, dtk):
+        r = dtk.must_query(
+            "select count(*) from dc where s in ('APPLE', 'Cherry')")
+        assert r.rows == [("3",)]
+
+    def test_device_host_parity(self, dtk):
+        q = ("select s, count(*) c, min(v), max(v) from dc "
+             "group by s order by s, c")
+        dev_rows = dtk.must_query(q).rows
+        dtk.must_exec("set tidb_executor_engine = 'host'")
+        host_rows = dtk.must_query(q).rows
+        # group keys may differ by class representative; compare ci-folded
+        from tidb_tpu.utils.collate import sort_key
+        fold = lambda rows: [(sort_key(r[0].encode(),
+                                       "utf8mb4_general_ci"),) + tuple(r[1:])
+                             for r in rows]
+        assert fold(dev_rows) == fold(host_rows)
+
+
+def test_mixed_ci_collation_join_keys():
+    """Both join sides must fold under ONE canonical collation (review
+    regression: general_ci ⋈ unicode_ci on 'straße' returned 0 rows)."""
+    tk = TestKit()
+    tk.must_exec("create table ja (g varchar(20) collate utf8mb4_general_ci)")
+    tk.must_exec("create table jb (u varchar(20) collate utf8mb4_unicode_ci)")
+    tk.must_exec("insert into ja values ('straße'), ('Apple')")
+    tk.must_exec("insert into jb values ('straße'), ('APPLE')")
+    tk.must_query(
+        "select count(*) from ja join jb on ja.g = jb.u").check([("2",)])

@@ -221,7 +221,7 @@ def _plan_agg(plan, dcols):
             if not isinstance(e, ExprColumn):
                 raise DeviceUnsupported("string group key must be a column")
             dc = dcols[e.idx]
-            key_meta.append((e, dc.dictionary))
+            key_meta.append((e, dc.decode_dict()))
             key_fns.append(dev.compile_expr(e, dcols))
         elif k == K_FLOAT:
             raise DeviceUnsupported("float group keys")
@@ -321,7 +321,7 @@ def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
             _tag, j, col_idx = slot
             codes = np.asarray(results[j][:ng])
             nulls = np.asarray(result_nulls[j][:ng])
-            dictionary = dcols[col_idx].dictionary
+            dictionary = dcols[col_idx].decode_dict()
             data = np.where(nulls, b"", dictionary[np.clip(codes, 0, len(dictionary) - 1)])
             out_cols.append(Column(ft, data, nulls))
             continue
@@ -407,11 +407,15 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int) -> Chunk:
         if col.data.dtype == object:
             from ..utils.collate import is_ci
             if is_ci(col.ftype.collate):
-                raise DeviceUnsupported("case-insensitive collation column")
-            codes, uniq = col.dict_encode()
-            col_arrays[idx] = (codes, col.nulls)
-            dcols[idx] = dev.DeviceCol(None, None, col.ftype,
-                                       dictionary=uniq)
+                codes, key_dict, reps = col.dict_encode_ci(col.ftype.collate)
+                col_arrays[idx] = (codes, col.nulls)
+                dcols[idx] = dev.DeviceCol(None, None, col.ftype,
+                                           dictionary=key_dict, reps=reps)
+            else:
+                codes, uniq = col.dict_encode()
+                col_arrays[idx] = (codes, col.nulls)
+                dcols[idx] = dev.DeviceCol(None, None, col.ftype,
+                                           dictionary=uniq)
         else:
             col_arrays[idx] = (col.data, col.nulls)
             dcols[idx] = dev.DeviceCol(None, None, col.ftype)

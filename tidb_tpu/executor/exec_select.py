@@ -788,9 +788,10 @@ class HashJoinExec(QueryExecutor):
         if data.dtype == np.int32:
             return data.astype(np.int64), nulls
         if k1 == K_STR:
-            from ..utils.collate import is_ci, sort_key_array
-            if is_ci(expr.ftype.collate) or is_ci(other.ftype.collate):
-                return sort_key_array(data), nulls
+            from ..utils.collate import ci_collation, sort_key_array
+            coll = ci_collation(expr.ftype, other.ftype)
+            if coll is not None:
+                return sort_key_array(data, coll), nulls
         return data, nulls
 
     def _nested_loop(self, left, right):

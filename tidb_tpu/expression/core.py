@@ -415,9 +415,11 @@ def _num_common(sf: ScalarFunc, chunk: Chunk):
     if rk == K_DATE:
         rk = K_INT
     if lk == K_STR and rk == K_STR:
-        from ..utils.collate import is_ci, sort_key_array
-        if is_ci(l.ftype.collate) or is_ci(r.ftype.collate):
-            return K_STR, sort_key_array(ld), sort_key_array(rd), nulls, 0
+        from ..utils.collate import ci_collation, sort_key_array
+        coll = ci_collation(l.ftype, r.ftype)
+        if coll is not None:
+            return (K_STR, sort_key_array(ld, coll),
+                    sort_key_array(rd, coll), nulls, 0)
         return K_STR, ld, rd, nulls, 0
     if K_FLOAT in (lk, rk) or K_STR in (lk, rk):
         return K_FLOAT, _as_float(ld, l.ftype), _as_float(rd, r.ftype), nulls, 0
@@ -692,7 +694,8 @@ def _eval_like(sf, chunk):
     d, n = sf.args[0].eval(chunk)
     pat = sf.args[1]
     from ..utils.collate import is_ci, sort_key
-    ci = is_ci(sf.args[0].ftype.collate)
+    coll = sf.args[0].ftype.collate
+    ci = is_ci(coll)
     if isinstance(pat, Constant) and sf.extra is not None and not ci:
         rx = sf.extra
         pd = None
@@ -707,14 +710,22 @@ def _eval_like(sf, chunk):
             if not nulls[i]:
                 out[i] = rx.match(b if isinstance(b, bytes) else str(b).encode()) is not None
     else:
+        const_pat = isinstance(pat, Constant)
+        if const_pat and len(d):
+            # constant pattern: sort-key + compile ONCE, not per row
+            p0 = sort_key(pd[0], coll) if ci else pd[0]
+            rx0 = like_to_regex(p0)
         rx_cache: dict = {}  # compile once per distinct pattern, not per row
         for i, b in enumerate(d):
             if not nulls[i]:
-                p = sort_key(pd[i]) if ci else pd[i]
-                v = sort_key(b) if ci else b
-                rx2 = rx_cache.get(p)
-                if rx2 is None:
-                    rx2 = rx_cache[p] = like_to_regex(p)
+                v = sort_key(b, coll) if ci else b
+                if const_pat:
+                    rx2 = rx0
+                else:
+                    p = sort_key(pd[i], coll) if ci else pd[i]
+                    rx2 = rx_cache.get(p)
+                    if rx2 is None:
+                        rx2 = rx_cache[p] = like_to_regex(p)
                 out[i] = rx2.match(v) is not None
     return out.astype(np.int64), nulls
 

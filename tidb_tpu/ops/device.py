@@ -55,13 +55,21 @@ class DeviceCol:
     """Device representation of one column: data + null mask (+ dictionary
     for strings; data holds int32 codes)."""
 
-    __slots__ = ("data", "nulls", "dictionary", "ftype")
+    __slots__ = ("data", "nulls", "dictionary", "reps", "ftype")
 
-    def __init__(self, data, nulls, ftype, dictionary=None):
+    def __init__(self, data, nulls, ftype, dictionary=None, reps=None):
         self.data = data
         self.nulls = nulls
         self.ftype = ftype
+        # For _ci columns the dictionary holds the sorted collation sort
+        # keys (constants are transformed before lookup) and reps holds a
+        # representative original value per class for output decode.
         self.dictionary = dictionary
+        self.reps = reps
+
+    def decode_dict(self):
+        """The dictionary that maps codes back to OUTPUT strings."""
+        return self.reps if self.reps is not None else self.dictionary
 
 
 def to_device_col(col) -> DeviceCol:
@@ -80,15 +88,23 @@ def to_device_col(col) -> DeviceCol:
                 raise DeviceUnsupported("wide-decimal column")
             from ..utils.collate import is_ci
             if is_ci(col.ftype.collate):
-                # dict codes are byte-ordered; _ci semantics need the
-                # case-folded sort key — host path handles those columns
-                raise DeviceUnsupported("case-insensitive collation column")
-            codes, _uniq = col.dict_encode()
-            col._device = (jnp.asarray(codes), jnp.asarray(col.nulls))
+                # _ci columns encode as collation-class codes: ranks in
+                # sort-key order, so code equality/ordering IS collation
+                # semantics (utils/chunk.py dict_encode_ci)
+                ci_codes, _kd, _reps = col.dict_encode_ci(col.ftype.collate)
+                col._device = (jnp.asarray(ci_codes), jnp.asarray(col.nulls))
+            else:
+                codes, _uniq = col.dict_encode()
+                col._device = (jnp.asarray(codes), jnp.asarray(col.nulls))
         else:
             col._device = (jnp.asarray(col.data), jnp.asarray(col.nulls))
     data, nulls = col._device
     if col.data.dtype == object:
+        from ..utils.collate import is_ci
+        if is_ci(col.ftype.collate):
+            _cc, key_dict, reps = col.dict_encode_ci(col.ftype.collate)
+            return DeviceCol(data, nulls, col.ftype, dictionary=key_dict,
+                             reps=reps)
         _codes, uniq = col.dict_encode()
         return DeviceCol(data, nulls, col.ftype, dictionary=uniq)
     return DeviceCol(data, nulls, col.ftype)
@@ -421,14 +437,24 @@ def _compile_str_pattern(sf, cols):
         def f(env):
             return jnp.zeros((), dtype=jnp.int64), jnp.ones((), dtype=bool)
         return f
+    from ..utils.collate import is_ci
+    ci = is_ci(dc.ftype.collate)
     pv = (pat.value if isinstance(pat.value, bytes)
           else str(pat.value).encode())
     if sf.op == "like":
-        # sf.extra carries the escape-aware regex the builder compiled
-        # (LIKE ... ESCAPE '!'); rebuilding here would drop the escape
-        rx = sf.extra if sf.extra is not None else like_to_regex(pv)
+        if ci:
+            # _ci dictionary holds sort keys: match the sort-keyed pattern
+            # (same as the host ci path, which also uses the default
+            # escape — core.py _eval_like)
+            rx = like_to_regex(_const_key(dc, pv))
+        else:
+            # sf.extra carries the escape-aware regex the builder compiled
+            # (LIKE ... ESCAPE '!'); rebuilding here would drop the escape
+            rx = sf.extra if sf.extra is not None else like_to_regex(pv)
         match = rx.match
     else:
+        if ci:
+            raise DeviceUnsupported("regexp on _ci column")
         rx = _re.compile(pv)
         match = rx.search
     nd = len(dc.dictionary)
@@ -564,12 +590,23 @@ def _compile_cast(sf, cols):
     return g
 
 
-def _str_code_for(const_val, dictionary):
+def _const_key(dc, const_val):
+    """A bytes constant in the column's dictionary key space: raw bytes for
+    binary collations, the collation sort key for _ci columns (whose
+    dictionary holds sort keys)."""
+    from ..utils.collate import is_ci, sort_key
+    v = const_val if isinstance(const_val, bytes) else str(const_val).encode()
+    if is_ci(dc.ftype.collate):
+        v = sort_key(v, dc.ftype.collate)
+    return v
+
+
+def _str_code_for(const_val, dc):
     """Host: map a bytes constant to its dictionary code (or -2 if absent —
     never matches since codes are >= 0 and NULL is -1)."""
-    v = const_val if isinstance(const_val, bytes) else str(const_val).encode()
-    pos = np.searchsorted(dictionary, v)
-    if pos < len(dictionary) and dictionary[pos] == v:
+    v = _const_key(dc, const_val)
+    pos = np.searchsorted(dc.dictionary, v)
+    if pos < len(dc.dictionary) and dc.dictionary[pos] == v:
         return int(pos)
     return -2
 
@@ -599,8 +636,9 @@ def _compile_str_cmp(sf, cols):
             return (jnp.zeros((), dtype=jnp.int64),
                     jnp.ones((), dtype=bool))
         return f
-    # dictionary from np.unique is sorted → order-preserving codes
-    v = const.value if isinstance(const.value, bytes) else str(const.value).encode()
+    # dictionary is sorted (np.unique bytes, or sort-key classes for _ci)
+    # → order-preserving codes
+    v = _const_key(dc, const.value)
     pos = int(np.searchsorted(dc.dictionary, v))
     exact = pos < len(dc.dictionary) and dc.dictionary[pos] == v
     code = pos if exact else pos - 0.5  # between codes for range compares
@@ -623,8 +661,8 @@ def _compile_str_in(sf, cols):
     dc = cols.get(target.idx)
     if dc is None or dc.dictionary is None:
         raise DeviceUnsupported("no dictionary for string column")
-    codes = sorted(c for c in (_str_code_for(v, dc.dictionary) for v in values)
-                   if c >= 0)
+    codes = sorted(set(c for c in (_str_code_for(v, dc) for v in values)
+                       if c >= 0))
     code_arr = jnp.asarray(np.asarray(codes, dtype=np.int64)) if codes else None
     idx = target.idx
 

@@ -70,7 +70,7 @@ def np_dtype_for(ft: FieldType):
 class Column:
     """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
 
-    __slots__ = ("ftype", "data", "nulls", "_dict", "_device")
+    __slots__ = ("ftype", "data", "nulls", "_dict", "_dict_ci", "_device")
 
     def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
         self.ftype = ftype
@@ -79,6 +79,7 @@ class Column:
             nulls = np.zeros(len(data), dtype=bool)
         self.nulls = nulls
         self._dict = None    # cached (codes, uniques) for device encoding
+        self._dict_ci = None  # cached (collation, ci encoding) for _ci cols
         self._device = None  # cached (jnp data, jnp nulls) resident in HBM
 
     def __len__(self):
@@ -154,6 +155,31 @@ class Column:
                 raise ValueError("set_dict requires a sorted, deduplicated "
                                  "dictionary (np.unique order)")
         self._dict = (codes.astype(np.int32), uniques)
+
+    def dict_encode_ci(self, collation: str):
+        """Collation-class dictionary encoding for _ci columns →
+        (ci_codes int32, key_dict, reps).
+
+        Distinct values are grouped by their collation sort key
+        (utils/collate.py); ci_codes are ranks in sort-key order, so device
+        equality/ordering/group-by over the codes IS collation-correct.
+        key_dict holds the sorted unique sort keys (constants are looked up
+        here after the same transform); reps[i] is a representative
+        original value for class i, used to decode group keys back to
+        strings (reference: the collator's RestoreData role)."""
+        if self._dict_ci is None or self._dict_ci[0] != collation:
+            from .collate import sort_key
+            codes, uniq = self.dict_encode()
+            sk = np.empty(len(uniq), dtype=object)
+            for i, u in enumerate(uniq):
+                sk[i] = sort_key(u if isinstance(u, bytes) else
+                                 str(u).encode(), collation)
+            key_dict, first, inv = np.unique(sk, return_index=True,
+                                             return_inverse=True)
+            reps = uniq[first]
+            ci_codes = inv.astype(np.int32)[codes]
+            self._dict_ci = (collation, (ci_codes, key_dict, reps))
+        return self._dict_ci[1]
 
     def prefix64(self) -> np.ndarray:
         """Order-preserving uint64 of the first 8 bytes of each value —

@@ -1,17 +1,35 @@
 """Collation support (reference: util/collate/collate.go — binary,
-utf8mb4_general_ci, utf8mb4_unicode_ci collators behind sort keys).
+utf8mb4_general_ci, utf8mb4_unicode_ci collators behind sort keys;
+util/collate/unicode_ci_data.go weight tables).
 
-Case-insensitive collations compare by a precomputed sort key; this engine
-implements the general_ci family as upper-cased UTF-8 (the dominant effect
-of MySQL's general_ci weight table: simple per-character case folding;
-unicode_ci's multi-char expansions are approximated the same way, which
-matches general_ci exactly and unicode_ci for the common plane). The sort
-key transform is applied wherever string ordering/equality feeds a kernel:
-comparisons, GROUP BY/DISTINCT keys, join keys, ORDER BY, and window
-partition/order keys. Device fragments decline _ci columns (dict codes are
-byte-ordered) and fall back to the host path."""
+Case-insensitive collations compare by a precomputed sort key. Two real
+collators (not the round-2 upper-case shim):
+
+* **general_ci** (utf8mb4_general_ci / utf8_general_ci): per-character
+  weights with no expansions — each character weighs as the uppercased
+  base letter of its canonical decomposition (MySQL's my_unicase "sort"
+  field: Ä→A, é→E, Å→A), and a character whose uppercase expands keeps
+  only the first unit (ß→S, so ß = s but ß ≠ ss — the documented
+  general_ci behavior).
+* **unicode_ci** (utf8mb4_unicode_ci, UCA 4.0 primary strength): full case
+  folding WITH expansions (ß→ss), compatibility decomposition, and
+  combining-mark stripping — so ß = ss, Å = A, ⅓ = 1⁄3-ish compat forms
+  collapse, accents are ignored.
+
+The weights derive from Python's unicodedata (Unicode character database)
+rather than a copied table; the observable semantics match the reference
+collators for the documented cases (see tests/test_collation.py).
+
+The sort key transform is applied wherever string ordering/equality feeds
+a kernel: comparisons, GROUP BY/DISTINCT keys, join keys, ORDER BY, window
+partition/order keys. Device fragments consume _ci columns through
+sort-key-class dictionary codes (utils/chunk.py dict_encode_ci +
+ops/device.py to_device_col), so _ci GROUP BY/filter runs on-device."""
 
 from __future__ import annotations
+
+import unicodedata
+from functools import lru_cache
 
 import numpy as np
 
@@ -20,24 +38,67 @@ def is_ci(collate: str | None) -> bool:
     return bool(collate) and collate.endswith("_ci")
 
 
+def is_unicode_ci(collate: str | None) -> bool:
+    return bool(collate) and collate.endswith("_unicode_ci")
+
+
 def needs_ci(ftype) -> bool:
     from ..expression import phys_kind, K_STR
     return phys_kind(ftype) == K_STR and is_ci(ftype.collate)
 
 
-def sort_key(b: bytes) -> bytes:
-    return b.decode("utf-8", "replace").upper().encode("utf-8")
+@lru_cache(maxsize=None)
+def _general_weight(ch: str) -> str:
+    """One character's general_ci weight: uppercased base letter of the
+    canonical decomposition; multi-unit uppercases keep the first unit
+    (ß→S). Combining marks / caseless characters weigh as themselves."""
+    d = unicodedata.normalize("NFD", ch)
+    base = next((c for c in d if not unicodedata.combining(c)), ch)
+    u = base.upper()
+    return u[0] if u else base
 
 
-def sort_key_array(data: np.ndarray) -> np.ndarray:
+def _general_key(s: str) -> str:
+    return "".join(_general_weight(c) for c in s)
+
+
+def _unicode_key(s: str) -> str:
+    """UCA primary-strength approximation: case fold with expansions
+    (ß→ss), compatibility-decompose, strip combining marks, uppercase."""
+    s = unicodedata.normalize("NFKD", s.casefold())
+    s = "".join(c for c in s if not unicodedata.combining(c))
+    s = unicodedata.normalize("NFKD", s.upper())
+    return "".join(c for c in s if not unicodedata.combining(c))
+
+
+def sort_key(b: bytes, collation: str | None = None) -> bytes:
+    s = b.decode("utf-8", "replace")
+    key = _unicode_key(s) if is_unicode_ci(collation) else _general_key(s)
+    return key.encode("utf-8")
+
+
+def sort_key_array(data: np.ndarray, collation: str | None = None) -> np.ndarray:
     out = np.empty(len(data), dtype=object)
     for i, b in enumerate(data):
-        out[i] = sort_key(b) if isinstance(b, (bytes, bytearray)) else b
+        out[i] = (sort_key(b, collation)
+                  if isinstance(b, (bytes, bytearray)) else b)
     return out
 
 
 def key_for_compare(data: np.ndarray, ftype) -> np.ndarray:
     """data unchanged for binary collations; sort keys for _ci."""
     if needs_ci(ftype):
-        return sort_key_array(data)
+        return sort_key_array(data, ftype.collate)
     return data
+
+
+def ci_collation(*ftypes) -> str | None:
+    """The _ci collation governing a comparison, or None. Deterministic in
+    argument ORDER (min over the operands' _ci collations): both sides of
+    a join key must fold under the SAME collation or equal values would
+    land in different sort-key spaces. (Reference: collation coercion —
+    mixing incompatible collations is a MySQL error we don't model; we
+    pick one canonically instead.)"""
+    cis = [ft.collate for ft in ftypes
+           if ft is not None and is_ci(ft.collate)]
+    return min(cis) if cis else None
