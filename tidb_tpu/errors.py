@@ -134,6 +134,15 @@ class WriteConflictError(TiDBError):
     sqlstate = "HY000"
 
 
+class SchemaChangedError(TiDBError):
+    """The schema a transaction's mutations were built against changed
+    before commit (reference: domain.ErrInfoSchemaChanged, 8028 — the
+    commit-time schema check that upholds the F1 online-DDL invariant)."""
+
+    code = ErrCode.InfoSchemaChanged
+    sqlstate = "HY000"
+
+
 class LockedError(TiDBError):
     """Key is locked by another transaction (reference: kv lock errors)."""
 

@@ -152,7 +152,11 @@ def _join_expand(bk, bvalid, pk, pvalid, cap):
     bpos = lo[pi] + within
     bi = order[jnp.clip(bpos, 0, jnp.maximum(nb - 1, 0))]
     valid = valid & bvalid[bi] & pvalid[pi]
-    return pi, bi, valid, total > cap
+    # report the EXACT required size, not a boolean: an overflow retry can
+    # then jump straight to next_pow2(total) instead of doubling — each
+    # doubling is a full XLA recompile, and starting from a tiny dimension
+    # table the doublings (12+ recompiles at TPC-H scale) dwarf the query
+    return pi, bi, valid, total
 
 
 def _combined_join_keys(lkds, lknulls, lvalid, rkds, rknulls, rvalid):
@@ -276,9 +280,9 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
             pk_d, pvalid, bk_d, bvalid, sovf = _combined_join_keys(
                 lkds, lknulls, lvalid, rkds, rknulls, rvalid)
             span_ovfs.append(sovf)
-            pi, bi, valid, ovf = _join_expand(
+            pi, bi, valid, total = _join_expand(
                 bk_d, bvalid, pk_d, pvalid, node.cap)
-            overflows.append(ovf)
+            overflows.append(total)
             idxmap = {k: v[pi] for k, v in lidx.items()}
             idxmap.update({k: v[bi] for k, v in ridx.items()})
             if node._oc_fns:
@@ -351,38 +355,56 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     dict_refs = tuple(dc.dictionary for dc in dcols.values()
                       if dc.dictionary is not None)
 
-    # initial join capacities: FK-join heuristic — output ≈ probe size
-    def probe_rows(node):
+    # initial join capacities: FK-join heuristic — a key-FK join emits
+    # about as many rows as its LARGER input (TPC-H joins are fact⋈dim),
+    # composed bottom-up over the subtree. Starting from the probe side
+    # alone (round 2) began at the dimension-table size and needed a
+    # recompile per doubling to climb to fact-table scale.
+    def est_rows(node):
         if isinstance(node, _Leaf):
-            return node.chunk.num_rows
-        return node.cap
+            return max(node.chunk.num_rows, 8)
+        return max(est_rows(node.left), est_rows(node.right))
 
     caps = []
     for jn in joins:
-        jn.cap = dev.next_pow2(max(probe_rows(jn.left), 8))
+        jn.cap = dev.next_pow2(est_rows(jn))
         caps.append(jn.cap)
 
     n_frag = caps[-1]
     est = _estimate_groups(agg_plan, n_frag)
     capacity = dev.next_pow2(min(n_frag, max(est, 16)))
 
+    import os as _os
+    import sys as _sys
+    import time as _time
+    _dbg = _os.environ.get("TIDB_TPU_DEBUG_JOIN")
     for _attempt in range(12):
         key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops))
         fn = _pipe_cache_get(key)
+        t0 = _time.perf_counter()
         if fn is None:
             fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
                                   caps, capacity, key_pack, agg_meta)
             _pipe_cache_put(key, fn, dict_refs)
         out, overflows, span_ovfs = jax.device_get(fn(env))
+        if _dbg:
+            print(f"[device_join] attempt {_attempt}: caps={caps} "
+                  f"agg_cap={capacity} totals={[int(o) for o in overflows]} "
+                  f"{_time.perf_counter() - t0:.2f}s",
+                  file=_sys.stderr, flush=True)
         if any(bool(s) for s in span_ovfs):
             raise DeviceUnsupported(
                 "multi-key join value ranges exceed int64 packing")
         key_out, key_null_out, results, result_nulls, n_groups, _valid = out
         ng = int(n_groups)
         retry = False
-        for i, ovf in enumerate(overflows):
-            if bool(ovf):
-                caps[i] *= 2
+        for i, total in enumerate(overflows):
+            if int(total) > caps[i]:
+                # jump straight to the required size (totals downstream of
+                # an overflowed join are lower bounds — the next pass
+                # corrects them, so convergence is O(join depth), not
+                # O(log(need)) recompiles)
+                caps[i] = dev.next_pow2(int(total))
                 retry = True
         if ng > capacity:
             capacity = dev.next_pow2(ng)

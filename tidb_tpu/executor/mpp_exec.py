@@ -328,7 +328,9 @@ def _build_mpp_pipeline(mesh, leaves, joins, root, sharded_ids, leaf_cond_fns,
                               n_keys=n_keys, agg_ops=merge_ops,
                               capacity=capacity, pack=key_pack)
         png_max = jax.lax.pmax(png, AXIS)
-        ovfs = tuple(jax.lax.pmax(o.astype(jnp.int32), AXIS)
+        # exact per-join required totals (pmax: worst shard governs the
+        # static capacity); int64 — totals exceed int32 at TPC-H scale
+        ovfs = tuple(jax.lax.pmax(o.astype(jnp.int64), AXIS)
                      for o in overflows)
         sovfs = tuple(jax.lax.pmax(o.astype(jnp.int32), AXIS)
                       for o in span_ovfs)
@@ -497,17 +499,23 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
         xcaps = [dev.next_pow2(max(2 * (-(-per_shard // n_shards)), 8)),
                  dev.next_pow2(max(2 * (-(-build_per_shard // n_shards)), 8))]
 
-    def probe_rows(nd):
+    def leaf_rows(nd):
+        if xcaps is not None and nd.leaf_id == shard_leaf:
+            return n_shards * xcaps[0]
+        return per_shard if nd.leaf_id == shard_leaf else nd.chunk.num_rows
+
+    def est_rows(nd):
+        # FK-join heuristic: output ≈ larger input, composed over the
+        # subtree (see device_join.py est_rows) — starting from the probe
+        # side alone needed a recompile per doubling to reach fact scale
         if isinstance(nd, _Leaf):
-            if xcaps is not None and nd.leaf_id == shard_leaf:
-                return n_shards * xcaps[0]
-            return per_shard if nd.leaf_id == shard_leaf else nd.chunk.num_rows
-        return nd.cap
+            return max(leaf_rows(nd), 8)
+        return max(est_rows(nd.left), est_rows(nd.right))
 
     def init_caps():
         caps = []
         for jn in joins:
-            jn.cap = dev.next_pow2(max(probe_rows(jn.left), 8))
+            jn.cap = dev.next_pow2(est_rows(jn))
             caps.append(jn.cap)
         return caps
 
@@ -555,8 +563,9 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                 caps[bottom_idx],
                 dev.next_pow2(max(n_shards * xcaps[0], 8)))
         for i, o in enumerate(ovfs):
-            if int(o):
-                caps[i] *= 2
+            if int(o) > caps[i]:
+                # jump to the worst shard's exact requirement in one step
+                caps[i] = dev.next_pow2(int(o))
                 retry = True
         max_ng = max(int(png), int(fng))
         if max_ng > capacity:

@@ -10,8 +10,9 @@ is no RPC; the commit protocol is kept (prewrite → TSO → commit) because DDL
 from __future__ import annotations
 
 import threading
+import time
 
-from ..errors import ErrCode, TiDBError, WriteConflictError
+from ..errors import ErrCode, LockedError, TiDBError, WriteConflictError
 from .mvcc import MVCCStore, OP_DEL, OP_LOCK, OP_PUT
 
 _MISSING = object()
@@ -78,23 +79,62 @@ class MemBuffer:
 
 
 class Snapshot:
-    """Point-in-time read view (reference: kv.Snapshot)."""
+    """Point-in-time read view (reference: kv.Snapshot).
+
+    Reads encountering another transaction's prewrite lock back off and
+    retry until the lock clears (the client-go resolveLocks + backoff
+    role): a committing writer holds its data locks only for the prewrite→
+    commit window, so readers wait it out instead of failing. A lock still
+    held past LOCK_WAIT_S is surfaced (abandoned txn — the GC worker's
+    stale-lock resolution owns those)."""
+
+    #: max seconds a read waits on a prewrite lock before surfacing it
+    LOCK_WAIT_S = 5.0
 
     def __init__(self, store: "Storage", ts: int, own_start_ts: int = 0):
         self.store = store
         self.ts = ts
         self.own_start_ts = own_start_ts
 
+    def _wait_out_lock(self, deadline):
+        """One backoff step of the lock-wait loop; returns the deadline."""
+        now = time.monotonic()
+        if deadline is None:
+            return now + self.LOCK_WAIT_S
+        if now >= deadline:
+            raise
+        time.sleep(0.002)
+        return deadline
+
     def get(self, key: bytes):
-        return self.store.mvcc.get(key, self.ts, own_start_ts=self.own_start_ts)
+        deadline = None
+        while True:
+            try:
+                return self.store.mvcc.get(key, self.ts,
+                                           own_start_ts=self.own_start_ts)
+            except LockedError:
+                deadline = self._wait_out_lock(deadline)
 
     def batch_get(self, keys):
-        return {k: v for k in keys
-                if (v := self.store.mvcc.get(k, self.ts, own_start_ts=self.own_start_ts)) is not None}
+        deadline = None
+        while True:
+            try:
+                return {k: v for k in keys
+                        if (v := self.store.mvcc.get(
+                            k, self.ts, own_start_ts=self.own_start_ts))
+                        is not None}
+            except LockedError:
+                deadline = self._wait_out_lock(deadline)
 
     def scan(self, start: bytes, end: bytes, limit: int = 0):
-        return self.store.mvcc.scan(start, end, self.ts, limit=limit,
-                                    own_start_ts=self.own_start_ts)
+        deadline = None
+        while True:
+            try:
+                return self.store.mvcc.scan(
+                    start, end, self.ts, limit=limit,
+                    own_start_ts=self.own_start_ts)
+            except LockedError:
+                deadline = self._wait_out_lock(deadline)
 
 
 class Transaction:
@@ -108,6 +148,7 @@ class Transaction:
         self.valid = True
         self.locked_keys: set[bytes] = set()
         self.touched_tables: set[int] = set()
+        self.schema_fps: dict[int, tuple] = {}  # tid -> table.schema_fp()
         self.committed_versions: dict[int, int] = {}  # tid -> post-commit ver
         self.for_update_ts = start_ts
 

@@ -15,6 +15,7 @@ import time
 import numpy as np
 
 from ..errors import (ErrCode, SchemaError, TiDBError, WriteConflictError)
+from ..errors import SchemaChangedError as _SchemaChangedError
 from ..infoschema import InfoSchema, build_infoschema
 from ..meta import Meta
 from ..model import DBInfo
@@ -35,6 +36,8 @@ class Domain:
         self.store = store
         self.columnar_cache = ColumnarCache(store)
         self._schema_lock = threading.Lock()
+        from ..utils.rwgate import RWGate
+        self.schema_gate = RWGate()  # commits(shared) vs publication(excl)
         self._infoschema: InfoSchema | None = None
         self.global_vars: dict[str, str] = {}
         self.stats: dict[int, dict] = {}      # table_id -> stats blob
@@ -68,15 +71,19 @@ class Domain:
         self.table_locks_mu = threading.Lock()
 
     def reload_schema(self):
-        """reference: domain.Reload — full load on version change."""
+        """reference: domain.Reload — full load on version change. The
+        exclusive gate drains in-flight [schema-check → commit] sections
+        first, so a commit can never validate against the old schema and
+        land after the new one publishes (rwgate.py)."""
         txn = self.store.begin()
         try:
             m = Meta(txn)
             infos = build_infoschema(m)
         finally:
             txn.rollback()
-        with self._schema_lock:
-            self._infoschema = infos
+        with self.schema_gate.exclusive():
+            with self._schema_lock:
+                self._infoschema = infos
 
     def infoschema(self) -> InfoSchema:
         with self._schema_lock:
@@ -508,28 +515,37 @@ class Session:
                 deltas[tid] = muts
         except Exception:
             deltas = None
-        try:
+        if txn.schema_fps:
+            # F1 schema-lease guard (reference: the commit-time schema
+            # check behind ErrInfoSchemaChanged + schema_amender.go's
+            # role): mutations built against a table whose column/index
+            # states advanced may lack maintenance the new state requires
+            # (e.g. removing a delete-only index's entry) — fail the
+            # commit retriably instead of corrupting the index. The
+            # shared gate keeps [check → commit] atomic w.r.t. schema
+            # publication (reload_schema holds the exclusive side).
+            from ..errors import SchemaChangedError
+            from ..table import schema_fp
+            with self.domain.schema_gate.shared():
+                infos_now = self.domain.infoschema()
+                for tid, fp in txn.schema_fps.items():
+                    info, _stats_tid = self._resolve_physical(infos_now, tid)
+                    if info is None or schema_fp(info) != fp:
+                        txn.rollback()
+                        raise SchemaChangedError(
+                            "Information schema is changed during the "
+                            "execution of the statement (for example, "
+                            "table definition may be updated by other DDL "
+                            "ran in parallel). Try again later")
+                txn.commit()
+        else:
             txn.commit()
-        except Exception:
-            # failed commit mutated nothing: rolled back, version not bumped
-            raise
         # commit succeeded: maintain the columnar cache incrementally
         # (reference analog: TiFlash applies raft log deltas, not rebuilds)
         infos = self.infoschema()
         for tid in txn.touched_tables:
             newv = txn.committed_versions.get(tid)
-            found = infos.table_by_id(tid)
-            info = found[1] if found is not None else None
-            stats_tid = tid
-            if info is None:
-                # partition physical id: cache deltas apply to the partition
-                # view; stats modify-counts roll up to the logical table
-                part = infos.partition_by_id(tid)
-                if part is not None:
-                    from ..partition import partition_view
-                    _db, logical, pdef = part
-                    info = partition_view(logical, pdef)
-                    stats_tid = logical.id
+            info, stats_tid = self._resolve_physical(infos, tid)
             if deltas is not None and tid in deltas:
                 # stats modify-count feed (reference: handle/update.go)
                 self.domain.stats_worker.record_delta(stats_tid,
@@ -541,6 +557,20 @@ class Session:
                 cache.apply_delta(info, deltas[tid], newv)
             except Exception:
                 cache.invalidate(tid)
+
+    def _resolve_physical(self, infos, tid):
+        """tid → (TableInfo view, stats table id): logical tables resolve
+        directly; partition physical ids resolve to a partition view with
+        stats rolling up to the logical table. (None, tid) when dropped."""
+        found = infos.table_by_id(tid)
+        if found is not None:
+            return found[1], tid
+        part = infos.partition_by_id(tid)
+        if part is not None:
+            from ..partition import partition_view
+            _db, logical, pdef = part
+            return partition_view(logical, pdef), logical.id
+        return None, tid
 
     def _implicit_commit(self):
         """DDL and account-management statements implicitly commit the
@@ -563,9 +593,13 @@ class Session:
         self.explicit_txn = False
         history, self.txn_stmt_history = self.txn_stmt_history, []
         if self.txn is not None and self.txn.valid:
+            from ..errors import SchemaChangedError
             try:
                 self._commit_txn()
-            except WriteConflictError:
+            except (WriteConflictError, SchemaChangedError):
+                # both are retriable by statement replay: the fresh attempt
+                # re-resolves tables under the new schema (reference:
+                # doCommitWithRetry, session.go:797)
                 if self._txn_retry_disabled() or not history:
                     raise
                 self._retry_txn(history)
@@ -601,7 +635,7 @@ class Session:
                 self.explicit_txn = False
                 self._commit_txn()
                 return
-            except WriteConflictError as e:
+            except (WriteConflictError, _SchemaChangedError) as e:
                 last = e
                 if self.txn is not None and self.txn.valid:
                     self.txn.rollback()
@@ -1017,7 +1051,7 @@ class Session:
             if not self._in_txn_retry:
                 self.txn_stmt_history.append(stmt)
             return r
-        from ..errors import LockedError
+        from ..errors import LockedError, SchemaChangedError
         try:
             wait_s = float(self.get_sysvar("innodb_lock_wait_timeout"))
         except Exception:
@@ -1028,7 +1062,10 @@ class Session:
         while True:
             try:
                 return run()
-            except WriteConflictError as e:
+            except (WriteConflictError, SchemaChangedError) as e:
+                # schema change mid-statement retries like a conflict: the
+                # fresh attempt re-resolves the table and rebuilds the
+                # mutations under the new column/index states
                 last = e
                 attempts += 1
                 if attempts > max(self._retry_limit(), 0):

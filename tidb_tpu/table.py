@@ -150,6 +150,19 @@ def convert_internal(v, src_ft: FieldType, dst_ft: FieldType):
     return cast_value(v, dst_ft)
 
 
+def schema_fp(info: TableInfo) -> tuple:
+    """Fingerprint of everything the write path's encoding depends on:
+    column set/offsets/states and index set/states. A transaction records
+    it per written table and the commit re-validates it against the then-
+    current schema — if the online-DDL worker advanced an index or column
+    state mid-statement, the buffered mutations may lack maintenance the
+    new state requires (e.g. a delete-only index's entry removal), so the
+    commit must fail retriably instead (reference: the commit-time schema
+    check behind ErrInfoSchemaChanged + session/schema_amender.go)."""
+    return (tuple((c.id, c.offset, c.state) for c in info.columns),
+            tuple((i.id, i.state, i.unique) for i in info.indexes))
+
+
 class Table:
     """Bound (TableInfo, txn) row operations.
 
@@ -204,7 +217,7 @@ class Table:
             if idx.state <= SchemaState.DELETE_ONLY:
                 continue
             self._index_put(idx, row, handle, check_dup)
-        self.txn.touched_tables.add(info.id)
+        self._mark_written(info)
 
     def _index_values(self, idx, row):
         vals = []
@@ -245,7 +258,7 @@ class Table:
         for idx in self.info.indexes:
             if idx.state >= SchemaState.DELETE_ONLY:
                 self._index_delete(idx, row, handle)
-        self.txn.touched_tables.add(self.info.id)
+        self._mark_written(self.info)
 
     def update_record(self, old_row: dict, new_row: dict, handle: int):
         if self.info.partition is not None:
@@ -271,7 +284,12 @@ class Table:
                 self._index_delete(idx, old_row, handle)
                 if idx.state > SchemaState.DELETE_ONLY:
                     self._index_put(idx, new_row, handle)
+        self._mark_written(info)
+
+    def _mark_written(self, info):
         self.txn.touched_tables.add(info.id)
+        if not info.temporary:  # session-local: no shared schema to race
+            self.txn.schema_fps.setdefault(info.id, schema_fp(info))
 
     # -- read path ----------------------------------------------------------
 

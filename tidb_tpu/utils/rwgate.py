@@ -1,0 +1,47 @@
+"""Shared/exclusive gate between transaction commits and schema
+publication (the in-process equivalent of the reference's F1 lease
+discipline: schema states wait out in-flight transactions before becoming
+visible — here commits hold the gate shared across [fingerprint check →
+commit] and reload_schema publishes under the exclusive side, so the
+check-then-commit window can never interleave with a state bump)."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class RWGate:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    @contextmanager
+    def shared(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    @contextmanager
+    def exclusive(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._writer = True
+            while self._readers:
+                self._cond.wait()
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
