@@ -128,3 +128,69 @@ def test_decimal_exactness_on_device(tk):
     tk.must_exec(f"insert into p values {rows}")
     tk.must_query("select sum(d), avg(d) from p").check([
         ("1000000007.99", "250000001.997500")])
+
+
+class TestTopNPushdown:
+    """TopN over a grouped aggregate fetches only candidate groups from
+    the device (planner/optimizer.py push_topn_into_agg + AggFetch topn)."""
+
+    @pytest.fixture()
+    def ttk(self):
+        t = TestKit()
+        t.must_exec("create table g (k bigint, d date, v int, s varchar(8))")
+        rows = []
+        for i in range(4000):
+            rows.append(f"({i % 1900}, '19{90 + i % 9}-01-0{i % 9 + 1}', "
+                        f"{(i * 37) % 1000}, 'x{i % 5}')")
+        t.must_exec("insert into g values " + ",".join(rows))
+        return t
+
+    def _parity(self, t, q):
+        t.must_exec("set tidb_executor_engine = 'tpu'")
+        dev = t.must_query(q).rows
+        t.must_exec("set tidb_executor_engine = 'host'")
+        host = t.must_query(q).rows
+        t.must_exec("set tidb_executor_engine = 'auto'")
+        assert dev == host, (dev[:5], host[:5])
+
+    def test_annotation_set(self, ttk):
+        from tidb_tpu.parser import parse
+        plan = ttk.session.plan_query(parse(
+            "select k, sum(v) sv from g group by k order by sv desc, k "
+            "limit 10")[0])
+        # Sort+Limit becomes TopN; the agg under it must carry the bound
+        def find_agg(p):
+            from tidb_tpu.planner.logical import Aggregation
+            if isinstance(p, Aggregation):
+                return p
+            for c in p.children:
+                a = find_agg(c)
+                if a is not None:
+                    return a
+        agg = find_agg(plan)
+        assert agg is not None and agg.topn_fetch is not None
+        assert agg.topn_fetch[1] >= 10
+
+    def test_sum_desc_key_asc(self, ttk):
+        self._parity(ttk, "select k, sum(v) sv from g group by k "
+                          "order by sv desc, k limit 10")
+
+    def test_key_only_order(self, ttk):
+        self._parity(ttk, "select k, count(*) from g group by k "
+                          "order by k desc limit 7")
+
+    def test_date_key_order(self, ttk):
+        self._parity(ttk, "select d, k, sum(v) from g group by d, k "
+                          "order by d, k limit 25")
+
+    def test_offset(self, ttk):
+        self._parity(ttk, "select k, sum(v) sv from g group by k "
+                          "order by sv desc, k limit 5, 10")
+
+    def test_min_max_order(self, ttk):
+        self._parity(ttk, "select k, min(v) mv, max(v) xv from g group by k "
+                          "order by mv, xv desc, k limit 12")
+
+    def test_avg_not_pushed_but_correct(self, ttk):
+        self._parity(ttk, "select k, avg(v) av from g group by k "
+                          "order by av desc, k limit 10")

@@ -160,7 +160,7 @@ def _agg_sig(plan, conds, dcols) -> tuple:
     return sig, refs
 
 
-def device_agg(plan, chunk: Chunk, conds) -> Chunk:
+def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:
     """Fused filter+group+aggregate on device. Raises DeviceUnsupported to
     trigger host fallback."""
     n = chunk.num_rows
@@ -182,7 +182,7 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
      slots) = _plan_agg(plan, dcols)
     n_keys = max(len(key_fns), 1)
     sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
-    est = _estimate_groups(plan, n)
+    est = _estimate_groups(plan, n, ctx)
     capacity = dev.next_pow2(min(n, max(est, 16)))
     while True:
         key = (sig_exprs, capacity, key_pack, tuple(agg_ops))
@@ -191,12 +191,8 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
             fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
                                  tuple(agg_ops), capacity, key_pack)
             _pipe_cache_put(key, fn, dict_refs)
-        # ONE batched device→host copy for the whole result tree: per-array
-        # reads pay full fabric round-trip latency each (~150ms over a
-        # remote-device tunnel), and there are a dozen small result arrays
-        out = jax.device_get(fn(env))
-        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
-        ng = int(n_groups)
+        f = AggFetch(fn(env), topn=resolve_topn(plan, slots))
+        ng = f.ng
         if ng <= capacity:
             break
         capacity = dev.next_pow2(ng)
@@ -204,8 +200,131 @@ def device_agg(plan, chunk: Chunk, conds) -> Chunk:
         # global aggregate over zero kept rows still yields ONE row
         # (count=0, sum/min/max NULL) — host path has the special case
         raise DeviceUnsupported("empty global aggregate")
-    return _assemble_agg(plan, key_meta, slots, dcols,
-                         (key_out, key_null_out, results, result_nulls), ng)
+    body = f.body()
+    return _assemble_agg(plan, key_meta, slots, dcols, body, f.out_rows)
+
+
+#: below this payload, one batched round trip beats two (tunnel latency
+#: ~150ms dominates small copies)
+_SMALL_FETCH_BYTES = 1 << 18
+
+
+class AggFetch:
+    """Device→host fetch of an _agg_impl result tree, minimizing tunnel
+    bytes: big capacities read the group count (+ any convergence scalars)
+    first and then ONE batched copy of just the live [:ng] prefix — a
+    capacity-sized fetch of a TopN-bound or overflowing result wastes most
+    of the payload. Small results keep the single batched round trip
+    (device_exec historically batched everything for exactly that reason).
+    On a retry (caller sees ng/overflow and recompiles) the body is never
+    fetched at all."""
+
+    def __init__(self, agg_out, extras=(), topn=None):
+        (self._keys, self._key_nulls, self._results, self._result_nulls,
+         n_groups, _valid) = agg_out
+        arrays = (*self._keys, *self._key_nulls, *self._results,
+                  *self._result_nulls)
+        self._cap = int(arrays[0].shape[0]) if arrays else 0
+        row_bytes = sum(a.dtype.itemsize for a in arrays) or 1
+        self._topn = topn
+        self._body = None
+        self.out_rows = None  # rows in body(); set on fetch
+        if self._cap * row_bytes <= _SMALL_FETCH_BYTES:
+            out = jax.device_get(
+                (agg_out[:4], n_groups, tuple(extras)))
+            self._body, ngv, self.extras = out
+            self.ng = self.out_rows = int(ngv)
+        else:
+            out = jax.device_get((n_groups, tuple(extras)))
+            self.ng = int(out[0])
+            self.extras = out[1]
+
+    def body(self):
+        """(key_out, key_null_out, results, result_nulls): the live groups
+        — or, under a TopN annotation, just the top candidate groups in
+        TopN-key order (selected on-device, so the tunnel carries k rows
+        instead of millions)."""
+        if self._body is None:
+            k = min(max(self.ng, 1), self._cap)
+            if self._topn is not None and self.ng > self._topn[1]:
+                specs, kf = self._topn
+                idx = _topk_indices(self._keys, self._key_nulls,
+                                    self._results, self._result_nulls,
+                                    self.ng, self._cap, specs, kf)
+                self._body = jax.device_get(tuple(
+                    tuple(a[idx] for a in t)
+                    for t in (self._keys, self._key_nulls,
+                              self._results, self._result_nulls)))
+                self.out_rows = kf
+                return self._body
+
+            def sl(t):
+                return tuple(a[:k] for a in t)
+            self._body = jax.device_get(
+                (sl(self._keys), sl(self._key_nulls),
+                 sl(self._results), sl(self._result_nulls)))
+            self.out_rows = self.ng
+        return self._body
+
+
+_TOPK_CACHE: dict = {}
+
+
+def _topk_indices(keys, key_nulls, results, result_nulls, ng, cap, specs,
+                  k):
+    """Indices of the top-k live groups ordered by `specs` (device-side).
+    specs: (("key"|"res", j, desc), ...). Null ordering matches the host
+    comparator (ops/host.py sort_indices: NULLs first ASC, last DESC);
+    descending ints use bitwise-not (exact, unlike negation at int64.min);
+    rows past ng sort behind everything."""
+    by = []
+    for src, j, _desc in specs:
+        d = keys[j] if src == "key" else results[j]
+        nl = key_nulls[j] if src == "key" else result_nulls[j]
+        by.append((d, nl))
+    sig = (cap, k, tuple((s[0], s[2]) for s in specs),
+           tuple(d.dtype.str for d, _ in by))
+    fn = _TOPK_CACHE.get(sig)
+    if fn is None:
+        descs = [s[2] for s in specs]
+
+        def run(by_arrays, ng_):
+            lex = []  # jnp.lexsort: minor → major
+            for (d, nl), desc in zip(reversed(by_arrays), reversed(descs)):
+                if jnp.issubdtype(d.dtype, jnp.floating):
+                    v = -d if desc else d
+                else:
+                    v = d.astype(jnp.int64)
+                    if desc:
+                        v = ~v
+                lex.append(jnp.where(nl, 0, v))
+                lex.append(jnp.where(nl, 1 if desc else 0,
+                                     0 if desc else 1))
+            lex.append(jnp.arange(cap) >= ng_)  # live rows first
+            return jnp.lexsort(lex)[:k]
+
+        fn = _TOPK_CACHE[sig] = jax.jit(run)
+    return fn(by, ng)
+
+
+def resolve_topn(plan, slots):
+    """plan.topn_fetch (agg-OUTPUT indices) → AggFetch specs over the
+    device result arrays: group keys map 1:1; aggregate outputs map
+    through their result slot. None when not annotated or unmappable."""
+    tf = getattr(plan, "topn_fetch", None)
+    if not tf or not plan.group_exprs:
+        return None
+    ngk = len(plan.group_exprs)
+    specs = []
+    for oi, desc in tf[0]:
+        if oi < ngk:
+            specs.append(("key", oi, desc))
+        else:
+            slot = slots[oi - ngk]
+            if slot[0] == "avg":
+                return None
+            specs.append(("res", slot[1], desc))
+    return tuple(specs), int(tf[1])
 
 
 def _plan_agg(plan, dcols):
@@ -368,18 +487,33 @@ def _key_pack(group_exprs, dcols):
     return tuple(pack)
 
 
-def _estimate_groups(plan, n):
+def _estimate_groups(plan, n, ctx=None):
+    """Group-count bound for the agg kernel's static capacity: product of
+    the group columns' ANALYZE NDVs (reference: statistics-driven agg
+    cardinality, planner/core/stats.go), falling back to 64 per key, both
+    capped at the input size. With a multi-key GROUP BY the NDV product
+    overshoots the true joint cardinality, but overshoot only pads the
+    sort — undershoot costs a recompile."""
+    if not plan.group_exprs:
+        return 1
+    from ..planner.optimizer import _expr_ndv
     est = 1
     for e in plan.group_exprs:
-        est *= 64  # refined by stats-driven NDV once histograms land
-    return min(est if plan.group_exprs else 1, n)
+        nd = None
+        if ctx is not None:
+            try:
+                nd = _expr_ndv(plan.child, e, ctx, n)
+            except Exception:
+                nd = None
+        est *= int(nd * 2) if nd else 64
+    return min(est, n)
 
 
 _MERGE_OPS = {"count": "sum_i", "sum_i": "sum_i", "sum_f": "sum_f",
               "min": "min", "max": "max", "first": "first"}
 
 
-def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int) -> Chunk:
+def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -> Chunk:
     """Streamed fused filter+group+aggregate: the input is cut into
     `batch_rows` blocks; each block's columns transfer to HBM and run the
     SAME jitted partial-agg program while the next block's transfer is

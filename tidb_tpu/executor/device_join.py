@@ -355,23 +355,26 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     dict_refs = tuple(dc.dictionary for dc in dcols.values()
                       if dc.dictionary is not None)
 
-    # initial join capacities: FK-join heuristic — a key-FK join emits
-    # about as many rows as its LARGER input (TPC-H joins are fact⋈dim),
-    # composed bottom-up over the subtree. Starting from the probe side
-    # alone (round 2) began at the dimension-table size and needed a
-    # recompile per doubling to climb to fact-table scale.
-    def est_rows(node):
+    # initial join capacities: FK-join upper heuristic — a key-FK join
+    # emits about as many rows as its LARGER input (TPC-H joins are
+    # fact⋈dim), composed bottom-up over RAW leaf sizes. Deliberately an
+    # over-estimate: undershoot costs a full recompile per level (minutes
+    # over a device tunnel — CBO-estimate-seeded caps were tried and
+    # converged in 4-5 compiles instead of 1), while overshoot only pads
+    # the kernels. Exact totals from the run correct any overflow in one
+    # jump (O(join depth) compiles worst case).
+    def fk_est(node):
         if isinstance(node, _Leaf):
             return max(node.chunk.num_rows, 8)
-        return max(est_rows(node.left), est_rows(node.right))
+        return max(fk_est(node.left), fk_est(node.right))
 
     caps = []
     for jn in joins:
-        jn.cap = dev.next_pow2(est_rows(jn))
+        jn.cap = dev.next_pow2(fk_est(jn))
         caps.append(jn.cap)
 
     n_frag = caps[-1]
-    est = _estimate_groups(agg_plan, n_frag)
+    est = _estimate_groups(agg_plan, n_frag, ctx)
     capacity = dev.next_pow2(min(n_frag, max(est, 16)))
 
     import os as _os
@@ -386,7 +389,12 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
             fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
                                   caps, capacity, key_pack, agg_meta)
             _pipe_cache_put(key, fn, dict_refs)
-        out, overflows, span_ovfs = jax.device_get(fn(env))
+        agg_out, ovf_d, sovf_d = fn(env)
+        from .device_exec import AggFetch, resolve_topn
+        f = AggFetch(agg_out, extras=(ovf_d, sovf_d),
+                     topn=resolve_topn(agg_plan, slots))
+        overflows, span_ovfs = f.extras
+        ng = f.ng
         if _dbg:
             print(f"[device_join] attempt {_attempt}: caps={caps} "
                   f"agg_cap={capacity} totals={[int(o) for o in overflows]} "
@@ -395,8 +403,6 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
         if any(bool(s) for s in span_ovfs):
             raise DeviceUnsupported(
                 "multi-key join value ranges exceed int64 packing")
-        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
-        ng = int(n_groups)
         retry = False
         for i, total in enumerate(overflows):
             if int(total) > caps[i]:
@@ -415,8 +421,8 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
         raise DeviceUnsupported("join fragment capacities did not converge")
     if ng == 0 and not agg_plan.group_exprs:
         raise DeviceUnsupported("empty global aggregate")
-    return _assemble_agg(agg_plan, key_meta, slots, dcols,
-                         (key_out, key_null_out, results, result_nulls), ng)
+    body = f.body()
+    return _assemble_agg(agg_plan, key_meta, slots, dcols, body, f.out_rows)
 
 
 def fragment_sig(leaves, joins, agg_conds, agg_plan):

@@ -390,13 +390,14 @@ class HashAggExec(QueryExecutor):
             if batch > 0 and raw.num_rows > batch:
                 from .device_exec import device_agg_streaming
                 try:
-                    out = device_agg_streaming(eff_p, raw, conds, batch)
+                    out = device_agg_streaming(eff_p, raw, conds, batch,
+                                               ctx=self.ctx)
                     self._mark_fragment("tpu-stream", raw.num_rows)
                     return out
                 except DeviceUnsupported:
                     pass
             try:
-                out = device_agg(eff_p, raw, conds)
+                out = device_agg(eff_p, raw, conds, ctx=self.ctx)
                 self._mark_fragment("tpu", raw.num_rows)
                 return out
             except DeviceUnsupported:

@@ -521,7 +521,7 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
 
     caps = init_caps()
     n_frag = caps[-1] if caps else per_shard
-    est = _estimate_groups(plan, n_frag)
+    est = _estimate_groups(plan, n_frag, ctx)
     capacity = dev.next_pow2(min(max(n_frag, 16), max(est, 16)))
 
     sig = ("mpp", n_shards, fragment_sig(leaves, joins, agg_conds, plan),
@@ -546,9 +546,11 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                 cond_fns, key_fns, n_keys, val_plan, tuple(agg_ops),
                 capacity, key_pack, env_specs, shuffle=shuffle)
             _pipe_cache_put(key, fn, dict_refs)
-        out = jax.device_get(fn(env, svalids))
-        ((key_out, key_null_out, results, result_nulls, fng, _v),
-         png, ovfs, sovfs, xovfs) = out
+        agg_out, png_d, ovfs_d, sovfs_d, xovfs_d = fn(env, svalids)
+        from .device_exec import AggFetch
+        f = AggFetch(agg_out, extras=(png_d, ovfs_d, sovfs_d, xovfs_d))
+        png, ovfs, sovfs, xovfs = f.extras
+        fng = f.ng
         if any(int(s) for s in sovfs):
             raise DeviceUnsupported(
                 "multi-key join value ranges exceed int64 packing")
@@ -582,5 +584,6 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
     MPP_STATS["fragments"] += 1
     if shuffle_build is not None:
         MPP_STATS["shuffle_joins"] += 1
+    key_out, key_null_out, results, result_nulls = f.body()
     return _assemble_agg(plan, key_meta, slots, dcols,
                          (key_out, key_null_out, results, result_nulls), ng)

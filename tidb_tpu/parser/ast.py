@@ -1041,6 +1041,19 @@ class KillStmt(StmtNode):
 @dataclass(repr=False)
 class TraceStmt(StmtNode):
     stmt: StmtNode = None
+    format: str = "row"   # row (span tree) | opt (optimizer rule trace)
 
     def restore(self):
-        return f"TRACE {self.stmt.restore()}"
+        f = f" FORMAT='{self.format}'" if self.format != "row" else ""
+        return f"TRACE{f} {self.stmt.restore()}"
+
+
+@dataclass(repr=False)
+class PlanReplayerStmt(StmtNode):
+    """PLAN REPLAYER DUMP EXPLAIN <stmt> (reference:
+    executor/plan_replayer.go — capture schema+stats+config+explain into
+    a zip for offline reproduction)."""
+    stmt: StmtNode = None
+
+    def restore(self):
+        return f"PLAN REPLAYER DUMP EXPLAIN {self.stmt.restore()}"

@@ -290,7 +290,21 @@ class Parser:
             return ast.KillStmt(conn_id=t.val, query_only=query_only)
         if kw == "trace":
             self.pos += 1
-            return ast.TraceStmt(stmt=self._parse_statement())
+            fmt = "row"
+            if self._accept_kw("format"):
+                self._expect_op("=")
+                ft = self._cur()
+                fmt = str(ft.val).strip("'\"").lower()
+                self.pos += 1
+            return ast.TraceStmt(stmt=self._parse_statement(), format=fmt)
+        if kw == "plan":
+            # PLAN REPLAYER DUMP EXPLAIN <stmt>
+            # (reference: executor/plan_replayer.go)
+            self.pos += 1
+            self._expect_kw("replayer")
+            self._expect_kw("dump")
+            self._expect_kw("explain")
+            return ast.PlanReplayerStmt(stmt=self._parse_statement())
         raise ParseError(f"unsupported statement starting with {t.val!r}")
 
     # -- SELECT -------------------------------------------------------------

@@ -164,6 +164,10 @@ class Aggregation(LogicalPlan):
         super().__init__([child], schema)
         self.group_exprs = group_exprs
         self.aggs = aggs  # [AggFuncDesc]
+        # set by push_topn_into_agg: ([(output idx, desc)], fetch bound) —
+        # a TopN above only needs this many candidate groups, so the
+        # device fragment fetches just those instead of every group
+        self.topn_fetch = None
 
     def explain_name(self):
         return "HashAgg"

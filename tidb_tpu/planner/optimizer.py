@@ -8,20 +8,91 @@ from ..expression import Column, Schema
 from ..expression.core import ScalarFunc
 from .logical import (
     Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, MemSource,
-    Projection, Selection, SetOp, Sort, TopN, Window,
+    Projection, Selection, SetOp, Sort, TopN, Window, explain_tree,
 )
 
 
-def optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
+def optimize(plan: LogicalPlan, ctx=None, trace=None) -> LogicalPlan:
+    """`trace`, when a list, receives (rule name, rendered plan) per rule —
+    the optimizer trace (reference: planner/core/optimizer.go:93-126
+    logical-rule step tracer + util/tracing/opt_trace.go), surfaced by
+    TRACE FORMAT='opt' SELECT ..."""
     from .access import choose_access_paths
     from .physical import choose_join_algos
+
+    def step(rule, p):
+        if trace is not None:
+            trace.append((rule, "\n".join(
+                f"{name} | {info}" for name, info in explain_tree(p))))
+
+    step("initial", plan)
     plan = push_down_predicates(plan, [])
+    step("predicate_push_down", plan)
     plan = reorder_joins(plan, ctx)
+    step("join_reorder", plan)
     plan = prune_columns(plan)
+    step("column_pruning", plan)
     plan = prune_partitions_rule(plan)
+    step("partition_pruning", plan)
     plan = choose_access_paths(plan, ctx)
+    step("access_path_selection", plan)
     plan = choose_join_algos(plan, ctx)
+    step("physical_join_selection", plan)
+    plan = push_topn_into_agg(plan)
+    step("topn_push_down", plan)
     return plan
+
+
+def push_topn_into_agg(plan: LogicalPlan) -> LogicalPlan:
+    """Annotate Aggregation nodes under a TopN (looking through pure
+    projections) with a candidate-fetch bound (reference: TopN pushdown,
+    planner/core/rule_topn_push_down.go — here the bound tells the device
+    fragment how many grouped rows the host actually needs: a grouped
+    TPC-H Q3/Q18 produces millions of groups but the query keeps 10).
+
+    The device returns an OVERSAMPLED candidate set ordered by the TopN
+    keys; the host TopN above re-sorts it with its exact comparator, so
+    semantics (ties, NULL order, collation) stay identical to the full
+    path. Oversampling covers boundary tie-groups."""
+    def visit(p):
+        if isinstance(p, TopN):
+            _annotate_topn_agg(p)
+        for c in p.children:
+            visit(c)
+    visit(plan)
+    return plan
+
+
+def _annotate_topn_agg(topn: TopN) -> None:
+    from ..expression.core import Column as ExprColumn
+    node = topn.child
+    mappings = []
+    while isinstance(node, Projection):
+        mappings.append(node.exprs)
+        node = node.child
+    if not isinstance(node, Aggregation) or not node.group_exprs:
+        return
+    specs = []
+    for e, desc in topn.by:
+        for exprs in mappings:
+            if not isinstance(e, ExprColumn) or e.idx >= len(exprs):
+                return
+            e = exprs[e.idx]
+        if not isinstance(e, ExprColumn) or e.idx >= len(node.schema):
+            return
+        if e.idx >= len(node.group_exprs):
+            a = node.aggs[e.idx - len(node.group_exprs)]
+            # avg/variance are derived from two slots post-fetch; their
+            # order isn't available on-device — leave those unfetched
+            if a.name not in ("sum", "min", "max", "count"):
+                return
+        specs.append((e.idx, bool(desc)))
+    k = topn.offset + topn.count
+    fetch = 4 * k + 64  # oversample: boundary tie-groups
+    if fetch > 1 << 20:
+        return  # huge LIMIT: candidate fetch wouldn't save anything, and
+        #         a clamped bound would silently truncate the result
+    node.topn_fetch = (tuple(specs), fetch)
 
 
 def prune_partitions_rule(plan: LogicalPlan) -> LogicalPlan:

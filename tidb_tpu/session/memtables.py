@@ -382,7 +382,82 @@ def _key_column_usage(session):
     return cols, rows
 
 
+def _tidb_top_sql(session):
+    """TopSQL per-digest CPU attribution (reference: util/topsql — the
+    pubsub report surface becomes this memtable)."""
+    cols = [("sql_digest", _S), ("sample_sql", _S), ("cpu_time_ms", _F),
+            ("samples", _I), ("last_seen", _F)]
+
+    def rows():
+        return [(e.digest.encode(), e.sample_sql.encode(),
+                 round(e.cpu_ms, 3), e.samples, e.last_seen)
+                for e in session.domain.topsql.top()]
+    return cols, rows
+
+
+def _perf_stmt_summary(session):
+    """performance_schema.events_statements_summary_by_digest (reference:
+    perfschema/tables.go) — MySQL perf-schema shape over the engine's
+    statement summary; latencies in picoseconds like MySQL."""
+    cols = [("schema_name", _S), ("digest", _S), ("digest_text", _S),
+            ("count_star", _I), ("sum_timer_wait", _I),
+            ("min_timer_wait", _I), ("max_timer_wait", _I),
+            ("sum_rows_sent", _I), ("sum_errors", _I),
+            ("first_seen", _F), ("last_seen", _F)]
+    ps = 1_000_000_000_000  # seconds → picoseconds
+
+    def rows():
+        obs = session.domain.observe
+        out = []
+        with obs._lock:
+            items = list(obs.stmt_summary.values())
+        for st in items:
+            out.append((st.db.encode(), st.digest.encode(),
+                        st.sample_sql.encode(), st.exec_count,
+                        int(st.sum_latency * ps),
+                        int((0 if st.min_latency == float("inf")
+                             else st.min_latency) * ps),
+                        int(st.max_latency * ps), st.sum_rows,
+                        st.err_count, st.first_seen, st.last_seen))
+        return out
+    return cols, rows
+
+
+def _metrics_summary(session):
+    """metrics_schema.metrics_summary (reference:
+    infoschema/metrics_schema.go — PromQL-backed there; backed by the
+    engine's counter registry here)."""
+    cols = [("metrics_name", _S), ("sum_value", _F), ("comment", _S)]
+
+    def rows():
+        obs = session.domain.observe
+        with obs._lock:
+            items = sorted(obs.counters.items())
+        return [(k.encode(), float(v), b"engine counter") for k, v in items]
+    return cols, rows
+
+
+def _metrics_tables(session):
+    """information_schema.metrics_tables: the defined-metrics registry
+    (reference: infoschema/tables.go tableMetricTables)."""
+    cols = [("table_name", _S), ("promql", _S), ("labels", _S),
+            ("comment", _S)]
+
+    def rows():
+        obs = session.domain.observe
+        with obs._lock:
+            names = sorted(obs.counters)
+        return [(k.encode(), f"sum({k})".encode(), b"", b"engine counter")
+                for k in names]
+    return cols, rows
+
+
 _TABLES = {
+    ("information_schema", "tidb_top_sql"): _tidb_top_sql,
+    ("information_schema", "metrics_tables"): _metrics_tables,
+    ("performance_schema", "events_statements_summary_by_digest"):
+        _perf_stmt_summary,
+    ("metrics_schema", "metrics_summary"): _metrics_summary,
     ("information_schema", "schemata"): _schemata,
     ("information_schema", "tables"): _tables,
     ("information_schema", "columns"): _columns,

@@ -65,6 +65,8 @@ class Domain:
         self.plugins = PluginRegistry(self)    # audit/auth plugin SPI
         from ..telemetry import Telemetry
         self.telemetry = Telemetry(self)       # local-only usage collector
+        from ..topsql import TopSQL
+        self.topsql = TopSQL(self)             # per-SQL CPU attribution
         # LOCK TABLES state (reference: ddl/table_lock.go, held in-memory
         # per domain): (db, table) -> {"mode": read|write, conn_id: mode}
         self.table_locks: dict[tuple, dict] = {}
@@ -893,7 +895,11 @@ class Session:
             r = self._exec_dml(stmt, lambda: DeleteExec(self, stmt).execute())
             return Result(affected=r.affected)
         if isinstance(stmt, ast.UseStmt):
-            if self.infoschema().schema_by_name(stmt.db) is None:
+            virtual = stmt.db.lower() in ("information_schema",
+                                          "performance_schema",
+                                          "metrics_schema")
+            if not virtual and \
+                    self.infoschema().schema_by_name(stmt.db) is None:
                 raise SchemaError(f"Unknown database '{stmt.db}'",
                                   code=ErrCode.BadDB)
             self._db = stmt.db
@@ -1022,6 +1028,8 @@ class Session:
                           chunk=Chunk.from_rows([ft_s, ft_i], rows))
         if isinstance(stmt, ast.TraceStmt):
             return self._exec_trace(stmt)
+        if isinstance(stmt, ast.PlanReplayerStmt):
+            return self._exec_plan_replayer(stmt)
         raise TiDBError(f"unsupported statement {type(stmt).__name__}")
 
     # -- DML execution with retry (reference: session.go:797
@@ -1356,6 +1364,102 @@ class Session:
             self.set_sysvar(name, v, scope)
         return Result()
 
+    def _exec_opt_trace(self, inner) -> Result:
+        """TRACE FORMAT='opt' SELECT ... — the optimizer trace: one row per
+        logical/physical rule with the plan after that rule (reference:
+        planner/core/optimizer.go:93-126 step tracer, dumped over
+        /optimize_trace/dump there; a resultset here)."""
+        trace: list = []
+        undo = None
+        if isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
+            undo = self._apply_binding(inner)
+        try:
+            self.plan_builds += 1
+            builder = PlanBuilder(self._expr_ctx)
+            plan = builder.build(inner)
+            optimize(plan, self._expr_ctx, trace=trace)
+        finally:
+            if undo:
+                from ..bindinfo import undo_hints
+                undo_hints(undo)
+        ft = FieldType(tp=TYPE_VARCHAR)
+        rows = []
+        for i, (rule, rendered) in enumerate(trace):
+            for line in rendered.splitlines():
+                rows.append((str(i).encode(), rule.encode(), line.encode()))
+        return Result(names=["step", "rule", "plan"],
+                      chunk=Chunk.from_rows([ft, ft, ft], rows))
+
+    def _exec_plan_replayer(self, stmt: ast.PlanReplayerStmt) -> Result:
+        """PLAN REPLAYER DUMP EXPLAIN <stmt> (reference:
+        executor/plan_replayer.go): capture everything needed to reproduce
+        the plan offline — schemas, ANALYZE stats, session/global vars,
+        the SQL, EXPLAIN output and engine version — into one zip; the
+        result row carries the token (file path)."""
+        import io
+        import json
+        import os
+        import tempfile
+        import zipfile
+
+        inner = stmt.stmt
+        if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
+            raise TiDBError("PLAN REPLAYER supports SELECT statements")
+        # referenced base tables (walk TableName nodes in the AST)
+        import dataclasses as _dc
+        tables = []
+        stack = [inner]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (list, tuple)):
+                stack.extend(n)
+                continue
+            if isinstance(n, ast.TableName):
+                tables.append((n.schema or self.current_db(), n.name))
+            if _dc.is_dataclass(n) and isinstance(n, ast.Node):
+                for f in _dc.fields(n):
+                    stack.append(getattr(n, f.name))
+        infos = self.infoschema()
+        schema_sql, stats = [], {}
+        seen = set()
+        for db, name in tables:
+            key = (db.lower(), name.lower())
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                info = infos.table_by_name(db, name)
+            except Exception:
+                continue
+            from .show import render_create_table
+            schema_sql.append(f"USE `{db}`;\n" + render_create_table(info))
+            s = self.domain.stats.get(info.id)
+            if s:
+                stats[f"{db}.{name}"] = s
+        explain_rows = self._exec_explain(
+            ast.ExplainStmt(stmt=inner)).rows
+        sysvars = {"session": dict(self.session_vars),
+                   "global": dict(self.domain.global_vars)}
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("sql/sql_meta.toml", f"sql = '''{inner.restore()}'''\n")
+            z.writestr("schema/schema.sql", ";\n".join(schema_sql) + ";\n")
+            z.writestr("stats/stats.json", json.dumps(stats, default=str))
+            z.writestr("variables.json", json.dumps(sysvars))
+            z.writestr("explain.txt", "\n".join(
+                " | ".join(str(c) for c in r) for r in explain_rows))
+            z.writestr("meta.txt", "tpu-htap plan replayer v1\n")
+        token = f"replayer_{sql_digest(inner.restore())[:16]}_" \
+                f"{int(time.time())}.zip"
+        d = os.path.join(tempfile.gettempdir(), "tidb_tpu_replayer")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, token)
+        with open(path, "wb") as fh:
+            fh.write(buf.getvalue())
+        ft = FieldType(tp=TYPE_VARCHAR)
+        return Result(names=["File_token"],
+                      chunk=Chunk.from_rows([ft], [(path.encode(),)]))
+
     def _exec_explain(self, stmt: ast.ExplainStmt) -> Result:
         inner = stmt.stmt
         if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
@@ -1396,6 +1500,9 @@ class Session:
         executor build, per-operator execution (from the runtime stats
         collector), and the total."""
         inner = stmt.stmt
+        if stmt.format == "opt" and isinstance(
+                inner, (ast.SelectStmt, ast.SetOprStmt)):
+            return self._exec_opt_trace(inner)
         if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
             r = self._dispatch(inner)  # non-SELECT: run it, no spans
             return r

@@ -104,6 +104,8 @@ for _v in [
     # prepared-plan-cache {enabled, capacity})
     SysVar("tidb_enable_prepared_plan_cache", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_prepared_plan_cache_size", SCOPE_BOTH, "100", "int", 0),
+    # TopSQL sampling (reference: tidb_enable_top_sql, default OFF)
+    SysVar("tidb_enable_top_sql", SCOPE_GLOBAL, "OFF", "bool"),
     SysVar("tidb_enable_window_function", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_enable_topn_push_down", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_mesh_shape", SCOPE_BOTH, "1", "str"),
