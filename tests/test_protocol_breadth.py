@@ -1,0 +1,268 @@
+"""Protocol/auth breadth (VERDICT round-2 missing #10): caching_sha2
+auth with AuthSwitch, server-side cursors + COM_STMT_FETCH,
+COM_STMT_SEND_LONG_DATA / COM_STMT_RESET (reference: server/conn.go:810,
+server/conn_stmt.go)."""
+
+import socket
+import struct
+
+import pytest
+
+from tidb_tpu.server import protocol as P
+from tidb_tpu.server.packet import PacketIO, read_nul_str
+from tidb_tpu.server.server import MySQLServer
+from tidb_tpu.session import bootstrap_domain
+
+
+class Client:
+    """Mini client speaking enough of the protocol for these tests."""
+
+    def __init__(self, port, user="root", password="",
+                 plugin="mysql_native_password"):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+        self.io = PacketIO(self.sock)
+        self.fast_auth = False
+        self._handshake(user, password, plugin)
+
+    def _scramble(self, plugin, password, salt):
+        if plugin == "caching_sha2_password":
+            return P.caching_sha2_scramble(password.encode(), salt[:20])
+        return P.native_password_hash(password.encode(), salt[:20])
+
+    def _handshake(self, user, password, plugin):
+        pkt = self.io.read_packet()
+        _ver, pos = read_nul_str(pkt, 1)
+        pos += 4
+        salt = pkt[pos:pos + 8]
+        pos += 9 + 2 + 1 + 2 + 2
+        salt_len = pkt[pos]
+        pos += 1 + 10
+        salt += pkt[pos:pos + max(13, salt_len - 8) - 1]
+        self.salt = salt[:20]
+        caps = (P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
+                | P.CLIENT_PLUGIN_AUTH)
+        auth = self._scramble(plugin, password, self.salt)
+        out = struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+        out += bytes([255]) + b"\x00" * 23
+        out += user.encode() + b"\x00"
+        out += bytes([len(auth)]) + auth
+        out += plugin.encode() + b"\x00"
+        self.io.write_packet(out)
+        while True:
+            resp = self.io.read_packet()
+            if resp[:1] == b"\xfe":  # AuthSwitchRequest
+                new_plugin, p2 = read_nul_str(resp, 1)
+                new_salt = resp[p2:].rstrip(b"\x00")[:20]
+                self.io.write_packet(self._scramble(
+                    new_plugin.decode(), password, new_salt))
+                continue
+            if resp[:2] == P.FAST_AUTH_SUCCESS:
+                self.fast_auth = True
+                continue
+            if resp[0] == 0xFF:
+                code = struct.unpack_from("<H", resp, 1)[0]
+                raise AssertionError(f"auth failed: {code}")
+            assert resp[0] == 0x00
+            return
+
+    def cmd(self, cmd, payload=b"", expect_reply=True):
+        self.io.reset_seq()
+        self.io.write_packet(bytes([cmd]) + payload)
+        return self.io.read_packet() if expect_reply else None
+
+    def query_ok(self, sql):
+        r = self.cmd(P.COM_QUERY, sql.encode())
+        assert r[0] != 0xFF, r
+        if r[0] != 0x00:  # resultset: drain defs + rows to trailing EOF
+            self._drain_resultset()
+        return r
+
+    def _drain_resultset(self):
+        eofs = 0
+        while eofs < 2:
+            pkt = self.io.read_packet()
+            if pkt[:1] == b"\xfe" and len(pkt) < 9:
+                eofs += 1
+
+    def prepare(self, sql):
+        r = self.cmd(P.COM_STMT_PREPARE, sql.encode())
+        assert r[0] == 0x00
+        sid = struct.unpack_from("<I", r, 1)[0]
+        ncols = struct.unpack_from("<H", r, 5)[0]
+        nparams = struct.unpack_from("<H", r, 7)[0]
+        for _ in range(nparams):
+            self.io.read_packet()
+        if nparams:
+            self.io.read_packet()  # eof
+        for _ in range(ncols):
+            self.io.read_packet()
+        if ncols:
+            self.io.read_packet()  # eof
+        return sid, ncols, nparams
+
+    def close(self):
+        try:
+            self.cmd(P.COM_QUIT, expect_reply=False)
+        finally:
+            self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def server():
+    dom = bootstrap_domain()
+    srv = MySQLServer(dom, port=0)
+    srv.start()
+    from tidb_tpu.session import new_session
+    s = new_session(dom)
+    s.execute("create user 'sha2user'@'%' identified with "
+              "'caching_sha2_password' by 'secret2'")
+    s.execute("create user 'nativeuser'@'%' identified by 'secret1'")
+    s.execute("grant all on *.* to 'sha2user'@'%'")
+    s.execute("grant all on *.* to 'nativeuser'@'%'")
+    s.execute("create database pb")
+    s.execute("use pb")
+    s.execute("create table t (id int primary key, v varchar(2000))")
+    s.execute("insert into t values " + ",".join(
+        f"({i}, 'row{i}')" for i in range(25)))
+    yield srv
+    srv.shutdown()
+
+
+class TestCachingSha2:
+    def test_direct_sha2_login_fast_path(self, server):
+        c = Client(server.port, "sha2user", "secret2",
+                   plugin="caching_sha2_password")
+        assert c.fast_auth  # 0x01 0x03 marker seen
+        c.query_ok("select 1")
+        c.close()
+
+    def test_auth_switch_from_native_client(self, server):
+        # client starts with native scramble; server switches it to sha2
+        c = Client(server.port, "sha2user", "secret2",
+                   plugin="mysql_native_password")
+        c.query_ok("select 1")
+        c.close()
+
+    def test_auth_switch_to_native(self, server):
+        # sha2-first client hitting a native account gets switched back
+        c = Client(server.port, "nativeuser", "secret1",
+                   plugin="caching_sha2_password")
+        c.query_ok("select 1")
+        c.close()
+
+    def test_wrong_password_rejected(self, server):
+        with pytest.raises(AssertionError, match="auth failed"):
+            Client(server.port, "sha2user", "wrong",
+                   plugin="caching_sha2_password")
+
+
+class TestCursorFetch:
+    def test_cursor_execute_then_fetch_pages(self, server):
+        c = Client(server.port, "sha2user", "secret2",
+                   plugin="caching_sha2_password")
+        c.query_ok("use pb")
+        sid, ncols, nparams = c.prepare(
+            "select id from t order by id")
+        assert (ncols, nparams) == (1, 0)
+        # execute with CURSOR_TYPE_READ_ONLY: defs + EOF(cursor exists)
+        payload = (struct.pack("<I", sid)
+                   + bytes([P.CURSOR_TYPE_READ_ONLY])
+                   + struct.pack("<I", 1))
+        c.io.reset_seq()
+        c.io.write_packet(bytes([P.COM_STMT_EXECUTE]) + payload)
+        colcount = c.io.read_packet()
+        assert colcount[0] == 1
+        c.io.read_packet()  # column def
+        eof = c.io.read_packet()
+        status = struct.unpack_from("<H", eof, 3)[0]
+        assert status & P.SERVER_STATUS_CURSOR_EXISTS
+
+        got = []
+        last = False
+        while not last:
+            c.io.reset_seq()
+            c.io.write_packet(bytes([P.COM_STMT_FETCH])
+                              + struct.pack("<I", sid)
+                              + struct.pack("<I", 10))
+            while True:
+                pkt = c.io.read_packet()
+                if pkt[:1] == b"\xfe" and len(pkt) < 9:
+                    st = struct.unpack_from("<H", pkt, 3)[0]
+                    last = bool(st & P.SERVER_STATUS_LAST_ROW_SENT)
+                    break
+                # binary row: header 0x00, nullmap, int value
+                got.append(struct.unpack_from(
+                    "<i", pkt, 1 + (1 + 2 + 7) // 8)[0])
+        assert got == list(range(25))
+        c.close()
+
+
+class TestLongData:
+    def test_send_long_data_param(self, server):
+        c = Client(server.port, "sha2user", "secret2",
+                   plugin="caching_sha2_password")
+        c.query_ok("use pb")
+        sid, _nc, nparams = c.prepare("insert into t values (100, ?)")
+        assert nparams == 1
+        big = "A" * 600 + "B" * 600
+        # two chunks, no server response for either
+        c.io.reset_seq()
+        c.io.write_packet(bytes([P.COM_STMT_SEND_LONG_DATA])
+                          + struct.pack("<I", sid) + struct.pack("<H", 0)
+                          + big[:600].encode())
+        c.io.reset_seq()
+        c.io.write_packet(bytes([P.COM_STMT_SEND_LONG_DATA])
+                          + struct.pack("<I", sid) + struct.pack("<H", 0)
+                          + big[600:].encode())
+        # execute: param 0 comes from the long data; types still bound
+        payload = (struct.pack("<I", sid) + bytes([0])
+                   + struct.pack("<I", 1)
+                   + bytes([0])        # null bitmap
+                   + bytes([1])        # new params bound
+                   + bytes([0xFE, 0]))  # MYSQL_TYPE_STRING
+        c.io.reset_seq()
+        c.io.write_packet(bytes([P.COM_STMT_EXECUTE]) + payload)
+        ok = c.io.read_packet()
+        assert ok[0] == 0x00
+        r = c.cmd(P.COM_QUERY,
+                  b"select length(v) from t where id = 100")
+        assert r[0] != 0xFF
+        c._drain_resultset()
+        # verify via a fresh query through another path
+        c2 = Client(server.port, "sha2user", "secret2",
+                    plugin="caching_sha2_password")
+        c2.query_ok("use pb")
+        sid2, _, _ = c2.prepare("select v from t where id = 100")
+        payload = (struct.pack("<I", sid2) + bytes([0])
+                   + struct.pack("<I", 1))
+        c2.io.reset_seq()
+        c2.io.write_packet(bytes([P.COM_STMT_EXECUTE]) + payload)
+        c2.io.read_packet()  # col count
+        c2.io.read_packet()  # def
+        c2.io.read_packet()  # eof
+        row = c2.io.read_packet()
+        assert big.encode() in row
+        c2.close()
+        # reset clears the long data buffer
+        reset = c.cmd(P.COM_STMT_RESET, struct.pack("<I", sid))
+        assert reset[0] == 0x00
+        c.close()
+
+
+def test_alter_user_rejects_unknown_plugin():
+    from tidb_tpu.errors import TiDBError
+    from tidb_tpu.session import bootstrap_domain, new_session
+    s = new_session(bootstrap_domain())
+    s.execute("create user 'pu'@'%' identified by 'x'")
+    try:
+        s.execute("alter user 'pu'@'%' identified with 'bogus_plugin' by 'y'")
+        raise AssertionError("expected error 1524")
+    except TiDBError as e:
+        assert e.code == 1524
+    try:
+        s.execute("create user 'pu2'@'%' identified with "
+                  "'evil'', super_priv=''Y' by 'y'")
+        raise AssertionError("expected error 1524")
+    except TiDBError as e:
+        assert e.code == 1524

@@ -11,6 +11,7 @@ class ErrCode:
     # Subset of MySQL error codes used across the engine (reference: errno/errcode.go).
     DupEntry = 1062
     NoSuchTable = 1146
+    PluginIsNotLoaded = 1524
     BadDB = 1049
     DBCreateExists = 1007
     DBDropExists = 1008

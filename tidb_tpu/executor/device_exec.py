@@ -561,7 +561,7 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
     merge_ops = tuple(_MERGE_OPS[op] for op in agg_ops)
     sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
 
-    est = _estimate_groups(plan, n)
+    est = _estimate_groups(plan, n, ctx)
     capacity = dev.next_pow2(min(batch_rows, max(est, 16)))
     while True:
         key = (sig_exprs, "stream", capacity, key_pack, tuple(agg_ops))

@@ -878,12 +878,12 @@ class BRIEStmt(StmtNode):
 
 @dataclass(repr=False)
 class CreateUserStmt(StmtNode):
-    users: list = field(default_factory=list)  # [(user, host, password|None)]
+    users: list = field(default_factory=list)  # [(user, host, pw, plugin)]
     if_not_exists: bool = False
 
     def restore(self):
         return "CREATE USER " + ", ".join(
-            f"'{u}'@'{h}'" for u, h, _p in self.users)
+            f"'{u[0]}'@'{u[1]}'" for u in self.users)
 
 
 @dataclass(repr=False)
@@ -910,13 +910,13 @@ class GrantStmt(StmtNode):
     privs: list = field(default_factory=list)   # ["select", ...] or ["all"]
     db: str = ""                                # "*" = global
     table: str = ""                             # "*" = whole db
-    users: list = field(default_factory=list)   # [(user, host, password|None)]
+    users: list = field(default_factory=list)   # [(user, host, pw, plugin)]
     with_grant: bool = False
 
     def restore(self):
         return (f"GRANT {', '.join(p.upper() for p in self.privs)} "
                 f"ON {self.db}.{self.table} TO " + ", ".join(
-                    f"'{u}'@'{h}'" for u, h, _p in self.users))
+                    f"'{u[0]}'@'{u[1]}'" for u in self.users))
 
 
 @dataclass(repr=False)

@@ -1267,19 +1267,29 @@ class Parser:
         return user, host
 
     def _parse_user_with_auth(self):
+        """→ (user, host, password|None, plugin|None). IDENTIFIED WITH
+        names the auth plugin (mysql_native_password default,
+        caching_sha2_password supported — reference: server/conn.go:810)."""
         user, host = self._parse_user_spec()
         pw = None
+        plugin = None
         if self._accept_kw("identified"):
             if self._accept_kw("with"):
-                self._ident()  # auth plugin name
+                t = self._cur()
+                if t.kind == STRING:
+                    plugin = t.val.decode() if isinstance(t.val, bytes) \
+                        else t.val
+                    self.pos += 1
+                else:
+                    plugin = self._ident()
                 if not self._peek_kw("by") and not self._peek_kw("as"):
-                    return user, host, pw
+                    return user, host, pw, plugin
             if self._accept_kw("by") or self._accept_kw("as"):
                 t = self._cur()
                 if t.kind == STRING:
                     pw = t.val.decode() if isinstance(t.val, bytes) else t.val
                     self.pos += 1
-        return user, host, pw
+        return user, host, pw, plugin
 
     _PRIV_WORDS = {"select", "insert", "update", "delete", "create", "drop",
                    "index", "alter", "super", "grant", "references",

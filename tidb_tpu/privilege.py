@@ -23,7 +23,7 @@ DB_PRIVS = PRIVS[:8] + ("grant",)
 BOOTSTRAP_SQL = [
     """create table if not exists mysql.user (
         host varchar(255), user varchar(32),
-        authentication_string varchar(128),
+        authentication_string varchar(128), plugin varchar(64),
         select_priv varchar(1), insert_priv varchar(1),
         update_priv varchar(1), delete_priv varchar(1),
         create_priv varchar(1), drop_priv varchar(1),
@@ -45,7 +45,7 @@ BOOTSTRAP_SQL = [
 ]
 
 ROOT_ROW = ("insert into mysql.user values ('%', 'root', '', "
-            + ", ".join(["'Y'"] * 10) + ")")
+            "'mysql_native_password', " + ", ".join(["'Y'"] * 10) + ")")
 
 
 def mysql_native_hash(password: str) -> str:
@@ -56,14 +56,30 @@ def mysql_native_hash(password: str) -> str:
     return "*" + h.upper()
 
 
-class UserRecord:
-    __slots__ = ("host", "user", "auth", "privs")
+#: default when CREATE USER names no plugin
+DEFAULT_AUTH_PLUGIN = "mysql_native_password"
+SUPPORTED_AUTH_PLUGINS = ("mysql_native_password", "caching_sha2_password")
 
-    def __init__(self, host, user, auth, privs):
+
+def auth_string_for(password: str, plugin: str) -> str:
+    """Stored verifier per auth plugin (reference: conn.go:810 — native
+    SHA1 chain vs caching_sha2's SHA256(SHA256(p)) cache entry)."""
+    if plugin == "caching_sha2_password":
+        from .server.protocol import caching_sha2_verifier
+        return caching_sha2_verifier(password)
+    return mysql_native_hash(password)
+
+
+class UserRecord:
+    __slots__ = ("host", "user", "auth", "privs", "plugin")
+
+    def __init__(self, host, user, auth, privs,
+                 plugin="mysql_native_password"):
         self.host = host
         self.user = user
-        self.auth = auth          # *HEX or "" (empty password)
+        self.auth = auth          # *HEX / $S$HEX or "" (empty password)
         self.privs = privs        # set of global privs
+        self.plugin = plugin or "mysql_native_password"
 
 
 class PrivManager:
@@ -95,8 +111,9 @@ class PrivManager:
             uinfo = infos.table_by_name("mysql", "user")
             for _h, row in Table(uinfo, txn).iter_rows():
                 vals = _row_strs(uinfo, row)
-                privs = {p for p, v in zip(PRIVS, vals[3:13]) if v == "Y"}
-                users.append(UserRecord(vals[0], vals[1], vals[2], privs))
+                privs = {p for p, v in zip(PRIVS, vals[4:14]) if v == "Y"}
+                users.append(UserRecord(vals[0], vals[1], vals[2], privs,
+                                        plugin=vals[3]))
             dinfo = infos.table_by_name("mysql", "db")
             for _h, row in Table(dinfo, txn).iter_rows():
                 vals = _row_strs(dinfo, row)
@@ -145,6 +162,10 @@ class PrivManager:
             return None
         if not rec.auth:
             return rec if not response else None  # empty password
+        if rec.plugin == "caching_sha2_password":
+            from .server.protocol import caching_sha2_check
+            return rec if caching_sha2_check(rec.auth, salt, response) \
+                else None
         stored = bytes.fromhex(rec.auth[1:])
         mix = hashlib.sha1(salt + stored).digest()
         if len(response) != len(mix):

@@ -75,6 +75,7 @@ def run_server(cfg, ready_event: threading.Event | None = None):
 
     domain.stats_worker.start()  # auto-analyze loop (domain.go:1270 analog)
     domain.gc_worker.start()     # MVCC safepoint GC (store/gcworker analog)
+    domain.topsql.start()        # CPU attribution sampler (util/topsql)
     sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port).start()
     status_srv = None
     if cfg.status.report_status:
@@ -103,6 +104,7 @@ def run_server(cfg, ready_event: threading.Event | None = None):
     sql_srv.shutdown()
     domain.ddl_worker.stop()
     domain.stats_worker.stop()
+    domain.topsql.stop()
     return 0
 
 

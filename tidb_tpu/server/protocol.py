@@ -48,9 +48,61 @@ COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
 COM_STMT_PREPARE = 0x16
 COM_STMT_EXECUTE = 0x17
+COM_STMT_SEND_LONG_DATA = 0x18
 COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
+COM_STMT_FETCH = 0x1C
+
+SERVER_STATUS_IN_TRANS = 0x0001
+
+#: COM_STMT_EXECUTE cursor flags (reference: server/conn_stmt.go)
+CURSOR_TYPE_READ_ONLY = 0x01
+SERVER_STATUS_CURSOR_EXISTS = 0x0040
+SERVER_STATUS_LAST_ROW_SENT = 0x0080
 
 CHARSET_UTF8MB4 = 255
+
+
+def caching_sha2_scramble(password: bytes, nonce: bytes) -> bytes:
+    """Client-side caching_sha2_password scramble:
+    XOR(SHA256(p), SHA256(SHA256(SHA256(p)) || nonce)) (reference:
+    server/conn.go:810 authCachingSha2; used by tests/minclients)."""
+    import hashlib as _h
+    if not password:
+        return b""
+    p1 = _h.sha256(password).digest()
+    p2 = _h.sha256(_h.sha256(p1).digest() + nonce).digest()
+    return bytes(a ^ b for a, b in zip(p1, p2))
+
+
+def caching_sha2_verifier(password: str) -> str:
+    """Stored verifier S = SHA256(SHA256(p)); the fast-auth check needs
+    only S, which is what the reference's in-memory cache holds."""
+    import hashlib as _h
+    if not password:
+        return ""
+    return "$S$" + _h.sha256(
+        _h.sha256(password.encode()).digest()).hexdigest().upper()
+
+
+def caching_sha2_check(verifier: str, nonce: bytes, response: bytes) -> bool:
+    """Fast-path verify: SHA256(response XOR SHA256(S || nonce)) == S."""
+    import hashlib as _h
+    s = bytes.fromhex(verifier[3:])
+    mix = _h.sha256(s + nonce).digest()
+    if len(response) != len(mix):
+        return False
+    p1 = bytes(a ^ b for a, b in zip(response, mix))
+    return _h.sha256(p1).digest() == s
+
+
+def build_auth_switch(plugin: str, salt: bytes) -> bytes:
+    """AuthSwitchRequest (reference: server/conn.go writeAuthSwitchRequest)."""
+    return b"\xfe" + plugin.encode() + b"\x00" + salt + b"\x00"
+
+
+#: caching_sha2 fast-auth-success marker (0x01 0x03)
+FAST_AUTH_SUCCESS = b"\x01\x03"
 
 
 def native_password_hash(password: bytes, salt: bytes) -> bytes:

@@ -74,7 +74,8 @@ class MySQLServer:
         io.write_packet(P.build_handshake(conn_id, salt))
         try:
             resp = io.read_packet()
-            user, db, auth = self._parse_handshake_response(resp)
+            user, db, auth, client_plugin = \
+                self._parse_handshake_response(resp)
         except ConnectionError:
             return
         except Exception:
@@ -97,7 +98,25 @@ class MySQLServer:
         elif decided is True:
             matched_host = "%"
         else:
+            # when the account's auth plugin differs from what the client
+            # used, ask it to re-scramble (AuthSwitchRequest — reference:
+            # server/conn.go:810 handleAuthPlugin/authSwitchRequest); this
+            # is how caching_sha2_password accounts log in from clients
+            # that defaulted to mysql_native_password and vice versa
+            rec_plugin = self._account_plugin(user, peer)
+            fast_auth = False
+            if rec_plugin is not None and rec_plugin != client_plugin:
+                try:
+                    io.write_packet(P.build_auth_switch(rec_plugin, salt))
+                    auth = io.read_packet()
+                    client_plugin = rec_plugin
+                except Exception:
+                    auth = b""
             matched_host = self._check_auth(user, auth, salt, peer)
+            fast_auth = (matched_host is not None
+                         and client_plugin == "caching_sha2_password")
+            if fast_auth:
+                io.write_packet(P.FAST_AUTH_SUCCESS)
         if matched_host is None:
             if plug:
                 plug.audit_connection({"user": user, "host": peer},
@@ -144,7 +163,21 @@ class MySQLServer:
         db = b""
         if caps & P.CLIENT_CONNECT_WITH_DB and pos < len(buf):
             db, pos = read_nul_str(buf, pos)
-        return user.decode(), db.decode(), auth
+        plugin = b"mysql_native_password"
+        if caps & P.CLIENT_PLUGIN_AUTH and pos < len(buf):
+            plugin, pos = read_nul_str(buf, pos)
+        return user.decode(), db.decode(), auth, plugin.decode()
+
+    def _account_plugin(self, user: str, peer: str) -> str | None:
+        """The grant-table account's auth plugin, or None when auth is
+        driven by the users dict / bootstrap fallback (native only)."""
+        if self.users is not None:
+            return None
+        priv = getattr(self.domain, "priv", None)
+        if priv is not None and priv.enabled:
+            rec = priv.match_user(user, peer)
+            return rec.plugin if rec is not None else None
+        return None
 
     def _check_auth(self, user: str, auth: bytes, salt: bytes,
                     peer: str = "%") -> str | None:
@@ -168,7 +201,9 @@ class MySQLServer:
     # -- command dispatch ---------------------------------------------------
 
     def _command_loop(self, io: PacketIO, session):
-        stmts = {}  # stmt_id -> [sql, n_params, types]
+        stmts = {}  # stmt_id -> [ast, n_params, types]
+        long_data = {}  # (stmt_id, param_idx) -> bytearray
+        cursors = {}  # stmt_id -> [rows, ftypes, pos]
         next_stmt = 0
         while True:
             io.reset_seq()
@@ -217,9 +252,29 @@ class MySQLServer:
                     if col_names:
                         io.write_packet(P.build_eof())
                 elif cmd == P.COM_STMT_EXECUTE:
-                    self._stmt_execute(io, session, stmts, payload)
+                    self._stmt_execute(io, session, stmts, payload,
+                                       long_data, cursors)
+                elif cmd == P.COM_STMT_SEND_LONG_DATA:
+                    # append-only, NO response (reference:
+                    # server/conn_stmt.go handleStmtSendLongData)
+                    sid = struct.unpack_from("<I", payload, 0)[0]
+                    pid = struct.unpack_from("<H", payload, 4)[0]
+                    long_data.setdefault((sid, pid),
+                                         bytearray()).extend(payload[6:])
+                elif cmd == P.COM_STMT_FETCH:
+                    self._stmt_fetch(io, session, cursors, payload)
+                elif cmd == P.COM_STMT_RESET:
+                    sid = struct.unpack_from("<I", payload, 0)[0]
+                    for k in [k for k in long_data if k[0] == sid]:
+                        long_data.pop(k, None)
+                    cursors.pop(sid, None)
+                    io.write_packet(P.build_ok())
                 elif cmd == P.COM_STMT_CLOSE:
-                    stmts.pop(struct.unpack_from("<I", payload, 0)[0], None)
+                    sid = struct.unpack_from("<I", payload, 0)[0]
+                    stmts.pop(sid, None)
+                    cursors.pop(sid, None)
+                    for k in [k for k in long_data if k[0] == sid]:
+                        long_data.pop(k, None)
                 else:
                     io.write_packet(P.build_err(
                         1047, f"Unknown command {cmd:#x}", b"08S01"))
@@ -245,15 +300,34 @@ class MySQLServer:
                 continue
             self._write_resultset(io, res, status)
 
+    @staticmethod
+    def _session_status(session) -> int:
+        """Real connection status flags for EOF/OK packets (reference:
+        server status bits in conn.go writeOK): autocommit + in-txn."""
+        status = 0
+        try:
+            if session.autocommit():
+                status |= P.SERVER_STATUS_AUTOCOMMIT
+            if session.txn is not None and session.txn.valid:
+                status |= P.SERVER_STATUS_IN_TRANS
+        except Exception:
+            status = P.SERVER_STATUS_AUTOCOMMIT
+        return status
+
+    def _write_result_header(self, io, res, status):
+        """column count + defs + EOF — shared by the immediate resultset
+        path and the server-side cursor open."""
+        io.write_packet(lenenc_int(len(res.names)))
+        for name, ft in zip(res.names, res.ftypes):
+            io.write_packet(P.column_def(name, ft))
+        io.write_packet(P.build_eof(status=status))
+
     def _write_resultset(self, io, res, status, binary=False):
         """binary=True after COM_STMT_EXECUTE: the binary protocol requires
         Protocol::BinaryResultsetRow, not text rows (reference:
         server/conn_stmt.go handleStmtExecute → writeResultset(binary))."""
         fts = res.ftypes
-        io.write_packet(lenenc_int(len(res.names)))
-        for name, ft in zip(res.names, fts):
-            io.write_packet(P.column_def(name, ft))
-        io.write_packet(P.build_eof(status=status))
+        self._write_result_header(io, res, status)
         if binary:
             for row in res.rows:
                 io.write_packet(P.binary_row(row, fts))
@@ -262,14 +336,17 @@ class MySQLServer:
                 io.write_packet(P.text_row(row))
         io.write_packet(P.build_eof(status=status))
 
-    def _stmt_execute(self, io, session, stmts, payload):
+    def _stmt_execute(self, io, session, stmts, payload, long_data=None,
+                      cursors=None):
         sid = struct.unpack_from("<I", payload, 0)[0]
         if sid not in stmts:
             io.write_packet(P.build_err(1243, "Unknown prepared statement"))
             return
         ast_stmt, n_params, bound_types = stmts[sid]
+        cursor_flags = payload[4]
         pos = 4 + 1 + 4  # id, flags, iteration count
         args = []
+        long_data = long_data if long_data is not None else {}
         if n_params:
             nullmap_len = (n_params + 7) // 8
             nullmap = payload[pos:pos + nullmap_len]
@@ -288,6 +365,12 @@ class MySQLServer:
                 raise TiDBError("prepared statement executed with no "
                                 "parameter types bound")
             for i in range(n_params):
+                ld = long_data.get((sid, i))
+                if ld is not None:
+                    # long-data params carry no value in the execute
+                    # payload (reference: conn_stmt.go parseExecArgs)
+                    args.append(bytes(ld))
+                    continue
                 if nullmap[i // 8] & (1 << (i % 8)):
                     args.append(None)
                     continue
@@ -295,13 +378,41 @@ class MySQLServer:
                 v, pos = _decode_binary_value(payload, pos, tp, flags)
                 args.append(v)
         res = session.execute_prepared(ast_stmt, args)
-        status = P.SERVER_STATUS_AUTOCOMMIT
+        status = self._session_status(session)
         if res.chunk is None:
             io.write_packet(P.build_ok(
                 affected=res.affected,
                 last_insert_id=res.last_insert_id, status=status))
-        else:
-            self._write_resultset(io, res, status, binary=True)
+            return
+        if (cursor_flags & P.CURSOR_TYPE_READ_ONLY) and cursors is not None:
+            # server-side cursor: column defs now, rows via COM_STMT_FETCH
+            # (reference: server/conn_stmt.go useCursor branch)
+            cursors[sid] = [list(res.rows), res.ftypes, 0]
+            self._write_result_header(
+                io, res, status | P.SERVER_STATUS_CURSOR_EXISTS)
+            return
+        self._write_resultset(io, res, status, binary=True)
+
+    def _stmt_fetch(self, io, session, cursors, payload):
+        """COM_STMT_FETCH: next n rows of an open cursor (reference:
+        server/conn_stmt.go handleStmtFetch)."""
+        sid = struct.unpack_from("<I", payload, 0)[0]
+        n = struct.unpack_from("<I", payload, 4)[0]
+        cur = cursors.get(sid)
+        if cur is None:
+            io.write_packet(P.build_err(
+                1243, "Unknown prepared statement (no open cursor)"))
+            return
+        rows, fts, pos = cur
+        end = min(pos + max(n, 1), len(rows))
+        for row in rows[pos:end]:
+            io.write_packet(P.binary_row(row, fts))
+        cur[2] = end
+        status = self._session_status(session) \
+            | P.SERVER_STATUS_CURSOR_EXISTS
+        if end >= len(rows):
+            status |= P.SERVER_STATUS_LAST_ROW_SENT
+        io.write_packet(P.build_eof(status=status))
 
 
 def _param_ftype():
