@@ -1,0 +1,172 @@
+"""Device engine breadth for string expressions (VERDICT round-2 weak
+#2): string CASE/COALESCE, SUBSTRING/UPPER and friends via dictionary
+pushdown, col=col string compares via dictionary unions, LENGTH/casts as
+code LUTs, YEAR()/MONTH() over DATETIME — all on the device engine with
+host parity (reference: the coprocessor evaluates these per row,
+expression/builtin_string.go; here host-per-distinct + device LUT)."""
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create table s (id int primary key, nation varchar(20), "
+                "phone varchar(15), other varchar(20), v int, amt "
+                "decimal(10,2), ts datetime)")
+    nations = ["BRAZIL", "CANADA", "FRANCE", "PERU", "JAPAN"]
+    rows = []
+    for i in range(600):
+        n = nations[i % 5]
+        rows.append(
+            f"({i}, '{n}', '{11 + i % 25}-{1000 + i}', "
+            f"'{nations[(i * 3) % 5]}', {i % 50}, {i}.25, "
+            f"'199{i % 9}-0{i % 9 + 1}-15 0{i % 9}:30:00')")
+    t.must_exec("insert into s values " + ",".join(rows))
+    t.must_exec("set tidb_executor_engine = 'tpu'")
+    return t
+
+
+def _parity(t, q, order_insensitive=False):
+    t.must_exec("set tidb_executor_engine = 'tpu'")
+    dev = t.must_query(q).rows
+    t.must_exec("set tidb_executor_engine = 'host'")
+    host = t.must_query(q).rows
+    t.must_exec("set tidb_executor_engine = 'tpu'")
+    if order_insensitive:
+        assert sorted(dev) == sorted(host), (dev[:4], host[:4])
+    else:
+        assert dev == host, (dev[:4], host[:4])
+    return dev
+
+
+def _ran_on_device(t, q):
+    txt = "\n".join(" ".join(map(str, r))
+                    for r in t.must_query("explain analyze " + q).rows)
+    assert "engine:tpu" in txt, txt
+
+
+class TestStringCase:
+    def test_q8_shape_numeric_case_over_string_cond(self, tk):
+        q = ("select sum(case when nation = 'BRAZIL' then amt else 0 end),"
+             " sum(amt) from s")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_string_valued_case_as_group_key(self, tk):
+        q = ("select case when v < 10 then 'low' when v < 30 then 'mid' "
+             "else 'high' end as bucket, count(*), sum(v) from s "
+             "group by bucket order by bucket")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_case_over_string_arms_mixed_col_const(self, tk):
+        q = ("select case when v < 25 then nation else 'OTHER' end k, "
+             "count(*) from s group by k order by k")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_string_coalesce_group_key(self, tk):
+        tk.must_exec("insert into s values (9000, null, '99-1', null, 1, "
+                     "1.00, '1995-01-01 00:00:00')")
+        q = ("select coalesce(nation, 'UNKNOWN') k, count(*) from s "
+             "group by k order by k")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+
+class TestDictPushdownFuncs:
+    def test_q22_substring_filter_and_group(self, tk):
+        q = ("select substring(phone, 1, 2) cc, count(*), sum(amt) from s "
+             "where substring(phone, 1, 2) in ('11', '13', '17') "
+             "group by cc order by cc")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_upper_lower_group_key(self, tk):
+        q = ("select lower(nation) k, count(*) from s group by k order by k")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_length_numeric_lut(self, tk):
+        q = "select sum(length(phone)), count(*) from s where length(phone) > 6"
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_concat_with_constant(self, tk):
+        q = ("select concat(nation, '-x') k, count(*) from s "
+             "group by k order by k")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_substring_like(self, tk):
+        q = "select count(*) from s where substring(phone, 4, 8) like '1%'"
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+
+class TestColColCompare:
+    def test_string_col_eq_col_same_table(self, tk):
+        q = "select count(*), sum(v) from s where nation = other"
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_string_col_lt_col(self, tk):
+        q = "select count(*) from s where nation < other"
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_min_max_of_string_expr(self, tk):
+        q = ("select min(nation), max(concat(nation, '!')) from s "
+             "where v > 5")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+
+class TestTemporal:
+    def test_year_month_over_datetime(self, tk):
+        q = ("select year(ts) y, month(ts) m, count(*), sum(v) from s "
+             "group by y, m order by y, m")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+    def test_q9_shape_year_group(self, tk):
+        q = ("select nation, year(ts) o_year, sum(amt) from s "
+             "group by nation, o_year order by nation, o_year desc")
+        _parity(tk, q)
+        _ran_on_device(tk, q)
+
+
+class TestNullHandling:
+    """NULL-input rows must flow through the dictionary LUTs (review
+    finding: nested COALESCE under another function mapped NULL→NULL)."""
+
+    @pytest.fixture()
+    def ntk(self):
+        t = TestKit()
+        t.must_exec("create table n (id int primary key, s varchar(20), "
+                    "v int)")
+        t.must_exec("insert into n values (1,'brazil',1), (2,null,2), "
+                    "(3,'peru',3), (4,null,4), (5,'brazil',5)")
+        t.must_exec("set tidb_executor_engine = 'tpu'")
+        return t
+
+    def test_upper_of_coalesce(self, ntk):
+        q = ("select upper(coalesce(s, 'x')) k, count(*), sum(v) from n "
+             "group by k order by k")
+        _parity(ntk, q)
+        _ran_on_device(ntk, q)
+
+    def test_filter_on_nested_coalesce(self, ntk):
+        q = "select sum(v) from n where upper(coalesce(s, 'x')) = 'X'"
+        assert _parity(ntk, q) == [("6",)]
+
+    def test_length_of_coalesce_numeric_lut(self, ntk):
+        q = "select sum(length(coalesce(s, ''))) from n"
+        _parity(ntk, q)
+
+    def test_null_propagating_func_keeps_null(self, ntk):
+        q = ("select count(*), count(upper(s)) from n")
+        assert _parity(ntk, q) == [("5", "3")]

@@ -333,22 +333,25 @@ def _plan_agg(plan, dcols):
     (global column idx → DeviceCol). Returns
     (key_fns, key_meta, key_pack, val_plan, agg_ops, slots)."""
     key_fns = []
-    key_meta = []  # (expr, dictionary or None)
+    key_meta = []  # (expr, decode dictionary or None)
+    key_sizes = []  # dict size for string keys (packing), None otherwise
     for e in plan.group_exprs:
         k = phys_kind(e.ftype)
         if k == K_STR:
-            if not isinstance(e, ExprColumn):
-                raise DeviceUnsupported("string group key must be a column")
-            dc = dcols[e.idx]
-            key_meta.append((e, dc.decode_dict()))
-            key_fns.append(dev.compile_expr(e, dcols))
+            # any string-valued expression: codes into its key dictionary
+            # (ops/device.py compile_str_expr — CASE/SUBSTRING/… included)
+            fn, key_dict, reps = dev.compile_str_expr(e, dcols)
+            key_meta.append((e, reps))
+            key_fns.append(fn)
+            key_sizes.append(len(key_dict))
         elif k == K_FLOAT:
             raise DeviceUnsupported("float group keys")
         else:
             key_meta.append((e, None))
             key_fns.append(dev.compile_expr(e, dcols))
+            key_sizes.append(None)
     if key_fns:
-        key_pack = _key_pack(plan.group_exprs, dcols)
+        key_pack = _key_pack(plan.group_exprs, key_sizes)
     else:
         key_pack = ((1, 0),)
 
@@ -369,13 +372,12 @@ def _plan_agg(plan, dcols):
             raise DeviceUnsupported(f"agg {name} on device")
         k = phys_kind(arg.ftype)
         if k == K_STR and name in ("min", "max", "first_row"):
-            if not isinstance(arg, ExprColumn):
-                raise DeviceUnsupported("string agg arg must be a column")
-            # dictionary from np.unique is sorted → code order == byte order
-            val_plan.append((dev.compile_expr(arg, dcols), "int"))
+            # key dictionaries are sorted → code order == value order
+            fn, _key_dict, reps = dev.compile_str_expr(arg, dcols)
+            val_plan.append((fn, "int"))
             agg_ops.append({"min": "min", "max": "max",
                             "first_row": "first"}[name])
-            slots.append(("strcol", len(val_plan) - 1, arg.idx))
+            slots.append(("strcol", len(val_plan) - 1, reps))
             continue
         if k == K_STR:
             raise DeviceUnsupported("string sum/avg")
@@ -437,10 +439,9 @@ def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
                 out_cols.append(Column(ft, vals, nulls))
             continue
         if slot[0] == "strcol":
-            _tag, j, col_idx = slot
+            _tag, j, dictionary = slot  # decode dict captured at plan time
             codes = np.asarray(results[j][:ng])
             nulls = np.asarray(result_nulls[j][:ng])
-            dictionary = dcols[col_idx].decode_dict()
             data = np.where(nulls, b"", dictionary[np.clip(codes, 0, len(dictionary) - 1)])
             out_cols.append(Column(ft, data, nulls))
             continue
@@ -461,21 +462,18 @@ def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
 _DATE_PACK = (24, 1 << 22)  # MySQL DATE days: [-354285, 2932896] + margin
 
 
-def _key_pack(group_exprs, dcols):
+def _key_pack(group_exprs, key_sizes):
     """Static (bits, offset) per group key when every key's value range is
-    known a priori — dict codes (cardinality = dictionary size) and DATE
-    days (bounded by MySQL's DATE domain). Enables the single-argsort
-    packed path in _agg_kernel. None when any key is unbounded or the
-    total exceeds 62 bits."""
+    known a priori — dict codes (cardinality = key dictionary size, from
+    _plan_agg) and DATE days (bounded by MySQL's DATE domain). Enables the
+    single-argsort packed path in _agg_kernel. None when any key is
+    unbounded or the total exceeds 62 bits."""
     pack = []
     total = 0
-    for e in group_exprs:
+    for e, size in zip(group_exprs, key_sizes):
         k = phys_kind(e.ftype)
-        if k == K_STR and isinstance(e, ExprColumn):
-            dc = dcols.get(e.idx)
-            if dc is None or dc.dictionary is None:
-                return None
-            bits = max(int(len(dc.dictionary) + 1).bit_length(), 1)
+        if k == K_STR and size is not None:
+            bits = max(int(size + 1).bit_length(), 1)
             pack.append((bits, 0))
         elif k == K_DATE:
             pack.append(_DATE_PACK)

@@ -40,11 +40,14 @@ def create_user(session, stmt):
                 continue
             raise TiDBError(f"Operation CREATE USER failed for "
                             f"'{user}'@'{host}'", code=ErrCode.CannotUser)
-        auth = auth_string_for(pw or "", plugin)
+        if isinstance(pw, tuple):       # IDENTIFIED ... AS '<auth string>'
+            auth = pw[1]                # already a stored verifier
+        else:
+            auth = auth_string_for(pw or "", plugin)
         flags = ", ".join(["'N'"] * len(PRIVS))
         _internal(session,
                   f"insert into mysql.user values ('{_esc(host)}', "
-                  f"'{_esc(user)}', '{auth}', '{plugin}', {flags})")
+                  f"'{_esc(user)}', '{_esc(auth)}', '{plugin}', {flags})")
     session.domain.priv.load()
 
 
@@ -65,9 +68,13 @@ def alter_user(session, stmt):
         if plugin is None:
             rec = session.domain.priv.match_user(user, host)
             plugin = rec.plugin if rec is not None else DEFAULT_AUTH_PLUGIN
-        auth = auth_string_for(pw or "", plugin)
+        if isinstance(pw, tuple):       # IDENTIFIED ... AS '<auth string>'
+            auth = pw[1]
+        else:
+            auth = auth_string_for(pw or "", plugin)
         _internal(session,
-                  f"update mysql.user set authentication_string = '{auth}',"
+                  f"update mysql.user set authentication_string = "
+                  f"'{_esc(auth)}',"
                   f" plugin = '{plugin}' "
                   f"where user = '{_esc(user)}' and host = '{_esc(host)}'")
     session.domain.priv.load()
@@ -98,15 +105,24 @@ def _expand(privs, level_privs):
 
 def grant(session, stmt):
     db = stmt.db or session.current_db()
-    for user, host, pw, _plugin in stmt.users:
+    from ..privilege import (DEFAULT_AUTH_PLUGIN, SUPPORTED_AUTH_PLUGINS,
+                             auth_string_for)
+    for user, host, pw, plugin in stmt.users:
+        plugin = plugin or DEFAULT_AUTH_PLUGIN
+        if plugin not in SUPPORTED_AUTH_PLUGINS:
+            raise TiDBError(f"Plugin '{plugin}' is not loaded",
+                            code=ErrCode.PluginIsNotLoaded)
         if not _user_exists(session, user, host):
             # 5.7-style implicit user creation on GRANT
-            auth = mysql_native_hash(pw or "")
+            if isinstance(pw, tuple):
+                auth = pw[1]
+            else:
+                auth = auth_string_for(pw or "", plugin)
             flags = ", ".join(["'N'"] * len(PRIVS))
             _internal(session,
                       f"insert into mysql.user values ('{_esc(host)}', "
-                      f"'{_esc(user)}', '{auth}', "
-                      f"'mysql_native_password', {flags})")
+                      f"'{_esc(user)}', '{_esc(auth)}', "
+                      f"'{plugin}', {flags})")
         cond = f"user = '{_esc(user)}' and host = '{_esc(host)}'"
         if stmt.db == "*":                     # global level
             sets = [f"{p}_priv = 'Y'" for p in _expand(stmt.privs, PRIVS)]

@@ -228,6 +228,20 @@ _CMP_OPS = {"eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
 
 
 def _compile_func(sf: ScalarFunc, cols):
+    """Dispatch with a dictionary-pushdown fallback: a numeric function
+    of one dict-encoded string column that the direct compiler declines
+    (LENGTH, casts, string arithmetic coercions, …) host-evaluates over
+    the dictionary into a LUT instead of falling back to the host path."""
+    try:
+        return _compile_func_direct(sf, cols)
+    except DeviceUnsupported:
+        f = _try_str_numeric_lut(sf, cols)
+        if f is not None:
+            return f
+        raise
+
+
+def _compile_func_direct(sf: ScalarFunc, cols):
     op = sf.op
     if op in _CMP_OPS:
         # string vs constant → dictionary code comparison (eq/ne only)
@@ -385,25 +399,20 @@ def _compile_func(sf: ScalarFunc, cols):
                 out_n = out_n & n
             return out_d, out_n
         return f
-    if op == "year":
-        fa = compile_expr(sf.args[0], cols)
-        if phys_kind(sf.args[0].ftype) != K_DATE:
-            raise DeviceUnsupported("year() on non-date for device")
+    if op in ("year", "month", "dayofmonth", "day"):
+        arg = sf.args[0]
+        fa = compile_expr(arg, cols)
+        ak = phys_kind(arg.ftype)
+        is_dt = arg.ftype.tp in (TYPE_DATETIME, TYPE_TIMESTAMP)
+        if ak != K_DATE and not is_dt:
+            raise DeviceUnsupported(f"{op}() on non-temporal for device")
+        part = {"year": 0, "month": 1, "dayofmonth": 2, "day": 2}[op]
 
         def f(env):
             d, n = fa(env)
-            y, _m, _dd = _civil_from_days(d.astype(jnp.int64))
-            return y, n
-        return f
-    if op == "month":
-        fa = compile_expr(sf.args[0], cols)
-        if phys_kind(sf.args[0].ftype) != K_DATE:
-            raise DeviceUnsupported("month() on non-date for device")
-
-        def f(env):
-            d, n = fa(env)
-            _y, m, _dd = _civil_from_days(d.astype(jnp.int64))
-            return m, n
+            days = (jnp.floor_divide(d.astype(jnp.int64), 86_400_000_000)
+                    if is_dt else d.astype(jnp.int64))
+            return _civil_from_days(days)[part], n
         return f
     if op == "abs":
         fa = compile_expr(sf.args[0], cols)
@@ -417,6 +426,214 @@ def _compile_func(sf: ScalarFunc, cols):
     raise DeviceUnsupported(f"scalar op {op} not available on device")
 
 
+# ---------------------------------------------------------------------------
+# string-VALUED expressions: everything compiles to CODES into a sorted key
+# dictionary (dictionary pushdown, generalized). A derived string expression
+# — CASE over strings, SUBSTRING, UPPER, CONCAT with constants — either
+# merges its arms' dictionaries (branches) or is evaluated host-side ONCE
+# per distinct dictionary entry and becomes a device code-LUT. The per-
+# distinct-value cost beats per-row for real data, and the device sees only
+# int codes (reference: the coprocessor evaluates these per row over raw
+# bytes — expression/builtin_string.go; per-distinct is the columnar win).
+# ---------------------------------------------------------------------------
+
+_IMPURE_OPS = frozenset({"rand", "uuid", "sleep"})
+
+
+def compile_str_expr(expr, cols):
+    """Compile a string-valued expression → (fn, key_dict, reps): fn(env)
+    yields codes into the sorted `key_dict`; `reps` decodes codes back to
+    output strings. Raises DeviceUnsupported outside the language."""
+    if isinstance(expr, ExprColumn):
+        dc = cols.get(expr.idx)
+        if dc is None or dc.dictionary is None:
+            raise DeviceUnsupported("no dictionary for string column")
+        return compile_expr(expr, cols), dc.dictionary, dc.decode_dict()
+    if isinstance(expr, Constant):
+        if expr.value is None:
+            e = np.array([b""], dtype=object)
+
+            def f(env):
+                return (jnp.zeros((), dtype=jnp.int64),
+                        jnp.ones((), dtype=bool))
+            return f, e, e
+        v = (expr.value if isinstance(expr.value, bytes)
+             else str(expr.value).encode())
+        from ..utils.collate import is_ci, sort_key
+        key = (sort_key(v, expr.ftype.collate)
+               if is_ci(expr.ftype.collate) else v)
+
+        def f(env):
+            return (jnp.zeros((), dtype=jnp.int64),
+                    jnp.zeros((), dtype=bool))
+        return (f, np.array([key], dtype=object),
+                np.array([v], dtype=object))
+    if isinstance(expr, ScalarFunc) and expr.op in ("case", "if",
+                                                    "coalesce"):
+        return _compile_str_branch(expr, cols)
+    if isinstance(expr, ScalarFunc):
+        return _compile_str_dict_pushdown(expr, cols)
+    raise DeviceUnsupported(
+        f"{type(expr).__name__} string expression on device")
+
+
+def _compile_str_branch(sf, cols):
+    """String-valued CASE/IF/COALESCE: arms compile to their own code
+    spaces, merged into one union dictionary via static remap tables."""
+    from ..utils.collate import is_ci
+    args = sf.args
+    if is_ci(sf.ftype.collate) or any(
+            is_ci(a.ftype.collate) for a in args
+            if phys_kind(a.ftype) == K_STR):
+        # arm key spaces would mix raw bytes with per-collation sort keys
+        raise DeviceUnsupported("_ci string branches on device")
+    if sf.op == "coalesce":
+        conds = None
+        arms = list(args)
+    else:
+        has_else = len(args) % 2 == 1
+        pairs = (len(args) - (1 if has_else else 0)) // 2
+        conds = [compile_expr(args[2 * p], cols) for p in range(pairs)]
+        arms = [args[2 * p + 1] for p in range(pairs)]
+        if has_else:
+            arms.append(args[-1])
+    compiled = [compile_str_expr(a, cols) for a in arms]
+    all_keys = np.concatenate([kd for _f, kd, _r in compiled])
+    all_reps = np.concatenate([r for _f, _kd, r in compiled])
+    key_dict, first = np.unique(all_keys, return_index=True)
+    reps = all_reps[first]
+    remaps = [jnp.asarray(np.searchsorted(key_dict, kd).astype(np.int64))
+              for _f, kd, _r in compiled]
+    sizes = [len(kd) for _f, kd, _r in compiled]
+
+    def arm(i, env):
+        d, n = compiled[i][0](env)
+        d = remaps[i][jnp.clip(d.astype(jnp.int64), 0, sizes[i] - 1)]
+        return d, n
+
+    if sf.op == "coalesce":
+        def f(env):
+            out_d, out_n = arm(0, env)
+            for i in range(1, len(compiled)):
+                d, n = arm(i, env)
+                out_d = jnp.where(out_n, d, out_d)
+                out_n = out_n & n
+            return out_d, out_n
+        return f, key_dict, reps
+
+    n_conds = len(conds)
+
+    def f(env):
+        out = jnp.zeros((), dtype=jnp.int64)
+        out_n = jnp.ones((), dtype=bool)
+        decided = jnp.zeros((), dtype=bool)
+        for p in range(n_conds):
+            cd, cn = conds[p](env)
+            cond = (cd != 0) & ~cn & ~decided
+            rd, rn = arm(p, env)
+            out = jnp.where(cond, rd, out)
+            out_n = jnp.where(cond, rn, out_n)
+            decided = decided | cond
+        if len(arms) > n_conds:  # ELSE
+            rd, rn = arm(len(arms) - 1, env)
+            out = jnp.where(decided, out, rd)
+            out_n = jnp.where(decided, out_n, rn)
+        return out, out_n
+    return f, key_dict, reps
+
+
+def _single_str_col(expr, cols):
+    """The one dict-encoded string column an expression reads, or raise."""
+    used: set = set()
+    expr.columns_used(used)
+    if len(used) != 1:
+        raise DeviceUnsupported(
+            "dictionary pushdown needs exactly one column input")
+    idx = next(iter(used))
+    dc = cols.get(idx)
+    if dc is None or dc.dictionary is None or phys_kind(dc.ftype) != K_STR:
+        raise DeviceUnsupported("dictionary pushdown needs a string column")
+    return idx, dc
+
+
+def _host_eval_over_dict(expr, dc):
+    """Evaluate `expr` host-side once per distinct dictionary entry PLUS
+    one NULL input row → (values, nulls) of length len(dict)+1, where the
+    last slot is the expression's output FOR NULL INPUT. Null-handling
+    subexpressions (COALESCE/IFNULL/CASE) may map NULL to a value, so the
+    LUT must carry the null slot instead of blindly propagating input
+    nulls."""
+    def check(e):
+        if isinstance(e, ScalarFunc):
+            if e.op in _IMPURE_OPS:
+                raise DeviceUnsupported(f"impure {e.op} on device")
+            for a in e.args:
+                check(a)
+    check(expr)
+    from ..utils.chunk import Chunk as HChunk, Column as HColumn
+    src = dc.decode_dict()
+    n = len(src)
+    data = np.empty(n + 1, dtype=object)
+    data[:n] = np.asarray(src, dtype=object)
+    data[n] = b""
+    nulls = np.zeros(n + 1, dtype=bool)
+    nulls[n] = True
+    col = HColumn(dc.ftype, data, nulls)
+    local = expr.transform_columns(lambda c: ExprColumn(0, c.ftype))
+    return local.eval(HChunk([col]))
+
+
+def _compile_str_dict_pushdown(sf, cols):
+    """String→string function of one dict column: host-evaluate over the
+    dictionary, build the output dictionary, device op = code LUT."""
+    from ..utils.collate import is_ci
+    if is_ci(sf.ftype.collate):
+        raise DeviceUnsupported("_ci derived string on device")
+    idx, dc = _single_str_col(sf, cols)
+    data, nulls = _host_eval_over_dict(sf, dc)
+    vals = np.array([v if isinstance(v, bytes) else str(v).encode()
+                     for v in data], dtype=object)
+    key_dict, inv = np.unique(vals, return_inverse=True)
+    code_map = jnp.asarray(inv.astype(np.int64))
+    null_lut = jnp.asarray(np.asarray(nulls, dtype=bool))
+    nd = len(dc.dictionary)
+
+    def f(env):
+        d, n = env[idx]
+        # NULL input rows read the null slot (index nd) — the expression
+        # may map NULL to a value (COALESCE etc.)
+        c = jnp.where(n, nd, jnp.clip(d.astype(jnp.int64), 0, nd - 1))
+        return code_map[c], null_lut[c]
+    return f, key_dict, key_dict
+
+
+def _try_str_numeric_lut(sf, cols):
+    """Numeric-valued function of one dict string column (LENGTH, casts,
+    string→number …): host-evaluate over the dictionary → numeric LUT.
+    Returns None when the shape doesn't apply."""
+    k = phys_kind(sf.ftype)
+    if k == K_STR:
+        return None
+    try:
+        idx, dc = _single_str_col(sf, cols)
+    except DeviceUnsupported:
+        return None
+    data, nulls = _host_eval_over_dict(sf, dc)
+    if k == K_FLOAT:
+        arr = np.asarray(data, dtype=np.float64)
+    else:
+        arr = np.asarray(data).astype(np.int64)
+    lut = jnp.asarray(arr)
+    null_lut = jnp.asarray(np.asarray(nulls, dtype=bool))
+    nd = len(dc.dictionary)
+
+    def f(env):
+        d, n = env[idx]
+        c = jnp.where(n, nd, jnp.clip(d.astype(jnp.int64), 0, nd - 1))
+        return lut[c], null_lut[c]
+    return f
+
+
 def _compile_str_pattern(sf, cols):
     """LIKE / REGEXP on a dict-encoded string column against a constant
     pattern: evaluate the predicate HOST-SIDE over the (small, distinct)
@@ -426,19 +643,17 @@ def _compile_str_pattern(sf, cols):
     from ..expression.core import like_to_regex
     import re as _re
     target, pat = sf.args[0], sf.args[1]
-    if not isinstance(target, ExprColumn) or phys_kind(target.ftype) != K_STR:
-        raise DeviceUnsupported(f"{sf.op} target must be a string column")
+    if phys_kind(target.ftype) != K_STR:
+        raise DeviceUnsupported(f"{sf.op} target must be a string value")
     if not isinstance(pat, Constant):
         raise DeviceUnsupported(f"{sf.op} pattern must be a constant")
-    dc = cols.get(target.idx)
-    if dc is None or dc.dictionary is None:
-        raise DeviceUnsupported("no dictionary for string column")
+    ft, key_dict, _reps = compile_str_expr(target, cols)
     if pat.value is None:
         def f(env):
             return jnp.zeros((), dtype=jnp.int64), jnp.ones((), dtype=bool)
         return f
     from ..utils.collate import is_ci
-    ci = is_ci(dc.ftype.collate)
+    ci = is_ci(target.ftype.collate)
     pv = (pat.value if isinstance(pat.value, bytes)
           else str(pat.value).encode())
     if sf.op == "like":
@@ -446,7 +661,7 @@ def _compile_str_pattern(sf, cols):
             # _ci dictionary holds sort keys: match the sort-keyed pattern
             # (same as the host ci path, which also uses the default
             # escape — core.py _eval_like)
-            rx = like_to_regex(_const_key(dc, pv))
+            rx = like_to_regex(_expr_const_key(target, pv))
         else:
             # sf.extra carries the escape-aware regex the builder compiled
             # (LIKE ... ESCAPE '!'); rebuilding here would drop the escape
@@ -457,17 +672,16 @@ def _compile_str_pattern(sf, cols):
             raise DeviceUnsupported("regexp on _ci column")
         rx = _re.compile(pv)
         match = rx.search
-    nd = len(dc.dictionary)
+    nd = len(key_dict)
     bits = np.zeros(nd, dtype=bool)
-    for i, v in enumerate(dc.dictionary):
+    for i, v in enumerate(key_dict):
         b = v if isinstance(v, bytes) else str(v).encode()
         bits[i] = match(b) is not None
     lut = jnp.asarray(bits)
-    idx = target.idx
 
     def f(env):
-        d, n = env[idx]
-        hit = lut[jnp.clip(d, 0, nd - 1)]
+        d, n = ft(env)
+        hit = lut[jnp.clip(d.astype(jnp.int64), 0, nd - 1)]
         return hit.astype(jnp.int64), n
     return f
 
@@ -590,84 +804,99 @@ def _compile_cast(sf, cols):
     return g
 
 
-def _const_key(dc, const_val):
-    """A bytes constant in the column's dictionary key space: raw bytes for
-    binary collations, the collation sort key for _ci columns (whose
-    dictionary holds sort keys)."""
-    from ..utils.collate import is_ci, sort_key
-    v = const_val if isinstance(const_val, bytes) else str(const_val).encode()
-    if is_ci(dc.ftype.collate):
-        v = sort_key(v, dc.ftype.collate)
-    return v
-
-
-def _str_code_for(const_val, dc):
-    """Host: map a bytes constant to its dictionary code (or -2 if absent —
-    never matches since codes are >= 0 and NULL is -1)."""
-    v = _const_key(dc, const_val)
-    pos = np.searchsorted(dc.dictionary, v)
-    if pos < len(dc.dictionary) and dc.dictionary[pos] == v:
-        return int(pos)
-    return -2
-
-
 def _compile_str_cmp(sf, cols):
     a, b = sf.args
-    if sf.op not in ("eq", "ne"):
-        # ordering comparisons on dictionary codes are invalid unless the
-        # dictionary is sorted — np.unique IS sorted, so allow them
-        pass
-    if isinstance(a, ExprColumn) and isinstance(b, Constant):
-        col, const = a, b
-    elif isinstance(b, ExprColumn) and isinstance(a, Constant):
-        col, const = b, a
+    # ordering comparisons on dictionary codes are valid because every key
+    # dictionary is sorted (np.unique bytes / sort-key classes for _ci)
+    if isinstance(b, Constant) and not isinstance(a, Constant):
+        lhs, const = a, b
+    elif isinstance(a, Constant) and not isinstance(b, Constant):
+        lhs, const = b, a
         # flip comparison direction
         sf = ScalarFunc({"lt": "gt", "gt": "lt", "le": "ge", "ge": "le"}.get(
             sf.op, sf.op), [b, a], sf.ftype)
     else:
-        if (isinstance(a, ExprColumn) and isinstance(b, ExprColumn)):
-            raise DeviceUnsupported("string col=col compare needs shared dict")
-        raise DeviceUnsupported("string comparison shape unsupported")
-    dc = cols.get(col.idx)
-    if dc is None or dc.dictionary is None:
-        raise DeviceUnsupported("no dictionary for string column")
+        return _compile_str_cmp_exprs(sf, cols)
+    fl, key_dict, _reps = compile_str_expr(lhs, cols)
     if const.value is None:
         def f(env):
             return (jnp.zeros((), dtype=jnp.int64),
                     jnp.ones((), dtype=bool))
         return f
-    # dictionary is sorted (np.unique bytes, or sort-key classes for _ci)
-    # → order-preserving codes
-    v = _const_key(dc, const.value)
-    pos = int(np.searchsorted(dc.dictionary, v))
-    exact = pos < len(dc.dictionary) and dc.dictionary[pos] == v
-    code = pos if exact else pos - 0.5  # between codes for range compares
-    idx = col.idx
+    v = _expr_const_key(lhs, const.value)
+    code = _key_code_for(key_dict, v)
+    exact = code >= 0
+    pos = code if exact else int(np.searchsorted(key_dict, v))
+    if not exact:
+        code = pos - 0.5  # between codes for range compares
     op = sf.op
     cmp = _CMP_OPS[op]
 
     def f(env):
-        d, n = env[idx]
+        d, n = fl(env)
         res = cmp(d.astype(jnp.float64), code) if not exact else cmp(d, pos)
         return res.astype(jnp.int64), n
+    return f
+
+
+def _expr_const_key(expr, const_val):
+    """A bytes constant in a string EXPRESSION's key space (its collation
+    decides whether the key is the raw bytes or the sort key)."""
+    from ..utils.collate import is_ci, sort_key
+    v = const_val if isinstance(const_val, bytes) else str(const_val).encode()
+    if is_ci(expr.ftype.collate):
+        v = sort_key(v, expr.ftype.collate)
+    return v
+
+
+def _key_code_for(key_dict, key):
+    """Exact code of `key` in a sorted key dictionary, or -2 (never
+    matches: codes are >= 0)."""
+    pos = int(np.searchsorted(key_dict, key))
+    if pos < len(key_dict) and key_dict[pos] == key:
+        return pos
+    return -2
+
+
+def _compile_str_cmp_exprs(sf, cols):
+    """expr-vs-expr string comparison (col=col included): both sides map
+    into the UNION of their key dictionaries, where code order is value
+    order for both — then it's an int compare."""
+    from ..utils.collate import is_ci
+    a, b = sf.args
+    ca, cb = a.ftype.collate, b.ftype.collate
+    if (is_ci(ca) or is_ci(cb)) and ca != cb:
+        # different sort-key spaces cannot union consistently
+        raise DeviceUnsupported("mixed-collation string compare on device")
+    fa, kda, _ra = compile_str_expr(a, cols)
+    fb, kdb, _rb = compile_str_expr(b, cols)
+    union = np.unique(np.concatenate([kda, kdb]))
+    mapa = jnp.asarray(np.searchsorted(union, kda).astype(np.int64))
+    mapb = jnp.asarray(np.searchsorted(union, kdb).astype(np.int64))
+    na, nb = len(kda), len(kdb)
+    cmp = _CMP_OPS[sf.op]
+
+    def f(env):
+        da, nla = fa(env)
+        db, nlb = fb(env)
+        ua = mapa[jnp.clip(da.astype(jnp.int64), 0, na - 1)]
+        ub = mapb[jnp.clip(db.astype(jnp.int64), 0, nb - 1)]
+        return cmp(ua, ub).astype(jnp.int64), nla | nlb
     return f
 
 
 def _compile_str_in(sf, cols):
     target = sf.args[0]
     values, has_null = sf.extra
-    if not isinstance(target, ExprColumn):
-        raise DeviceUnsupported("string IN target must be a column")
-    dc = cols.get(target.idx)
-    if dc is None or dc.dictionary is None:
-        raise DeviceUnsupported("no dictionary for string column")
-    codes = sorted(set(c for c in (_str_code_for(v, dc) for v in values)
-                       if c >= 0))
+    ft, key_dict, _reps = compile_str_expr(target, cols)
+
+    codes = sorted(set(
+        c for c in (_key_code_for(key_dict, _expr_const_key(target, v))
+                    for v in values) if c >= 0))
     code_arr = jnp.asarray(np.asarray(codes, dtype=np.int64)) if codes else None
-    idx = target.idx
 
     def f(env):
-        d, n = env[idx]
+        d, n = ft(env)
         if code_arr is None:
             hit = jnp.zeros(d.shape[0], dtype=bool)
         else:

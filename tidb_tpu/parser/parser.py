@@ -1284,11 +1284,17 @@ class Parser:
                     plugin = self._ident()
                 if not self._peek_kw("by") and not self._peek_kw("as"):
                     return user, host, pw, plugin
-            if self._accept_kw("by") or self._accept_kw("as"):
-                t = self._cur()
-                if t.kind == STRING:
-                    pw = t.val.decode() if isinstance(t.val, bytes) else t.val
-                    self.pos += 1
+            hashed = False
+            if self._accept_kw("by"):
+                pass
+            elif self._accept_kw("as"):
+                hashed = True  # AS carries the stored auth string verbatim
+            t = self._cur()
+            if t.kind == STRING:
+                pw = t.val.decode() if isinstance(t.val, bytes) else t.val
+                self.pos += 1
+                if hashed:
+                    pw = ("hash", pw)
         return user, host, pw, plugin
 
     _PRIV_WORDS = {"select", "insert", "update", "delete", "create", "drop",

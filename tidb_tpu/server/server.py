@@ -104,7 +104,6 @@ class MySQLServer:
             # is how caching_sha2_password accounts log in from clients
             # that defaulted to mysql_native_password and vice versa
             rec_plugin = self._account_plugin(user, peer)
-            fast_auth = False
             if rec_plugin is not None and rec_plugin != client_plugin:
                 try:
                     io.write_packet(P.build_auth_switch(rec_plugin, salt))
@@ -343,6 +342,10 @@ class MySQLServer:
             io.write_packet(P.build_err(1243, "Unknown prepared statement"))
             return
         ast_stmt, n_params, bound_types = stmts[sid]
+        if cursors is not None:
+            # a new execution supersedes any open cursor on this stmt id
+            # (the reference closes the prior cursor on execute)
+            cursors.pop(sid, None)
         cursor_flags = payload[4]
         pos = 4 + 1 + 4  # id, flags, iteration count
         args = []
