@@ -351,7 +351,7 @@ def _plan_agg(plan, dcols):
             key_fns.append(dev.compile_expr(e, dcols))
             key_sizes.append(None)
     if key_fns:
-        key_pack = _key_pack(plan.group_exprs, key_sizes)
+        key_pack = _key_pack(plan.group_exprs, key_sizes, dcols)
     else:
         key_pack = ((1, 0),)
 
@@ -461,11 +461,43 @@ def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
 
 _DATE_PACK = (24, 1 << 22)  # MySQL DATE days: [-354285, 2932896] + margin
 
+_EPOCH_DATE = np.datetime64("1970-01-01")
 
-def _key_pack(group_exprs, key_sizes):
+
+def _expr_bounds(e, dcols):
+    """Host-known (min, max) of an integer-kinded group expression, from
+    the cached column min/max (utils/chunk.py Column.minmax). Bare columns
+    read it directly; YEAR(col) maps bounds through the monotone
+    conversion. None when unknown — the caller falls back to the generic
+    (multi-sort) agg path."""
+    if dcols is None:
+        return None
+    from ..expression.core import ScalarFunc as _SF
+    if isinstance(e, ExprColumn):
+        dc = dcols.get(e.idx)
+        if dc is None or dc.host_col is None or dc.dictionary is not None:
+            return None
+        return dc.host_col.minmax()
+    if (isinstance(e, _SF) and e.op == "year"
+            and isinstance(e.args[0], ExprColumn)
+            and phys_kind(e.args[0].ftype) == K_DATE):
+        b = _expr_bounds(e.args[0], dcols)
+        if b is None:
+            return None
+
+        def to_year(days):
+            return int(str((_EPOCH_DATE + np.timedelta64(days, "D")
+                            ).astype("datetime64[Y]")))
+        return to_year(b[0]), to_year(b[1])
+    return None
+
+
+def _key_pack(group_exprs, key_sizes, dcols=None):
     """Static (bits, offset) per group key when every key's value range is
     known a priori — dict codes (cardinality = key dictionary size, from
-    _plan_agg) and DATE days (bounded by MySQL's DATE domain). Enables the
+    _plan_agg), host column min/max for bare keys and YEAR() (cached on
+    the Column, so the bound is exact per table version), and DATE days
+    (bounded by MySQL's DATE domain) as the date fallback. Enables the
     single-argsort packed path in _agg_kernel. None when any key is
     unbounded or the total exceeds 62 bits."""
     pack = []
@@ -475,10 +507,16 @@ def _key_pack(group_exprs, key_sizes):
         if k == K_STR and size is not None:
             bits = max(int(size + 1).bit_length(), 1)
             pack.append((bits, 0))
-        elif k == K_DATE:
-            pack.append(_DATE_PACK)
         else:
-            return None
+            b = _expr_bounds(e, dcols)
+            if b is not None:
+                mn, mx = b
+                span = mx - mn + 1
+                pack.append((max((span + 1).bit_length(), 1), -mn))
+            elif k == K_DATE:
+                pack.append(_DATE_PACK)
+            else:
+                return None
         total += pack[-1][0]
     if total > 62:
         return None
@@ -542,15 +580,17 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
                 codes, key_dict, reps = col.dict_encode_ci(col.ftype.collate)
                 col_arrays[idx] = (codes, col.nulls)
                 dcols[idx] = dev.DeviceCol(None, None, col.ftype,
-                                           dictionary=key_dict, reps=reps)
+                                           dictionary=key_dict, reps=reps,
+                                           host_col=col)
             else:
                 codes, uniq = col.dict_encode()
                 col_arrays[idx] = (codes, col.nulls)
                 dcols[idx] = dev.DeviceCol(None, None, col.ftype,
-                                           dictionary=uniq)
+                                           dictionary=uniq, host_col=col)
         else:
             col_arrays[idx] = (col.data, col.nulls)
-            dcols[idx] = dev.DeviceCol(None, None, col.ftype)
+            dcols[idx] = dev.DeviceCol(None, None, col.ftype,
+                                        host_col=col)
 
     cond_fns = [dev.compile_expr(c, dcols) for c in conds]
     (key_fns, key_meta, key_pack, val_plan, agg_ops,

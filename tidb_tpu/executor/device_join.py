@@ -4,19 +4,31 @@ exchange boundaries; unistore/cophandler/mpp_exec.go runs join/agg
 fragments storage-side). Here the whole scan→filter→join→…→aggregate tree
 compiles into ONE jitted XLA program over HBM-resident base tables:
 
-- joins are sort + searchsorted two-sided expansions with STATIC output
-  capacities (pow2-quantized); overflow is detected on device and the host
-  retries with a doubled capacity — one extra compile, never wrong results
-  (the standard XLA answer to data-dependent shapes).
+- joins whose build side is a base-table leaf use HOST-BUILT indexes
+  (executor/join_index.py): the ordering work runs once per table version
+  in numpy and the compiled program only gathers / binary-searches. A
+  UNIQUE build side (every TPC-H fact⋈dim join) adds nothing to the
+  output shape — the join is a gather with the probe side's exact
+  capacity, no expansion pass and no overflow retry at all.
+- non-unique indexed builds expand through a static-capacity CSR walk
+  (cnt → cumsum → searchsorted), still sort-free on device.
+- joins outside the index language (bushy subtrees, computed keys) fall
+  back to the in-program lexsort + searchsorted expansion.
 - intermediate results are row-index vectors into the base tables, not
   materialized rows: each join composes gathers lazily, and only the
   aggregate at the top reads actual column values.
 - ONE host↔device round trip per execution (batched device_get of the
-  aggregate outputs + overflow flags).
+  aggregate outputs + overflow/validity scalars).
+- expansion capacities and the aggregate group capacity are LEARNED: the
+  exact totals observed on a run are remembered per fragment signature,
+  so the overflow (or shrink-to-fit) recompile happens once per fragment
+  ever, not once per session — and repeat executions jump straight to
+  tight shapes (reference analog: the plan cache reusing learned sizes,
+  planner/core/cache.go).
 
-Supported fragment shape: inner equi-joins (single join key pair) over
-table scans with pushed-down filters, topped by a group-by aggregate.
-Anything else raises DeviceUnsupported and falls back to the host path.
+Supported fragment shape: inner equi-joins over table scans with
+pushed-down filters, topped by a group-by aggregate. Anything else raises
+DeviceUnsupported and falls back to the host path.
 """
 
 from __future__ import annotations
@@ -32,6 +44,7 @@ from ..ops.device import DeviceUnsupported
 from .device_exec import (
     _assemble_agg, _estimate_groups, _expr_sig, _pipe_cache_get,
     _pipe_cache_put, _plan_agg)
+from .join_index import build_join_index
 
 
 class _Leaf:
@@ -56,7 +69,10 @@ class _JoinNode:
         self.other_conds = other_conds
         self.offset = offset
         self.ncols = left.ncols + right.ncols
-        self.cap = 0                  # static output capacity (set later)
+        self.cap = 0            # static output capacity (set by _fill_caps)
+        self.pos = 0            # index into the fragment's join list
+        self.strategy = None    # None | (kind, side, JoinIndex)
+        self.exp_cap = None     # requested capacity for expansion joins
 
 
 def collect_tree(node):
@@ -97,6 +113,7 @@ def collect_tree(node):
                     raise DeviceUnsupported("mismatched decimal key scales")
             jn = _JoinNode(left, right, list(p.left_keys),
                            list(p.right_keys), list(p.other_conds), offset)
+            jn.pos = len(joins)
             joins.append(jn)
             return jn
         raise DeviceUnsupported(
@@ -125,10 +142,83 @@ def _global_dcols(leaves):
     return out
 
 
+# ---------------------------------------------------------------------------
+# join strategy planning (host-side, once per fragment)
+# ---------------------------------------------------------------------------
+
+def _leaf_key_cols(side, keys):
+    """Host Columns for `keys` when `side` is a leaf and every key is a
+    bare integer column of it; None otherwise."""
+    if not isinstance(side, _Leaf):
+        return None
+    cols = []
+    for k in keys:
+        if not isinstance(k, ExprColumn) or not 0 <= k.idx < side.ncols:
+            return None
+        c = side.chunk.columns[k.idx]
+        if (c.data.dtype == object
+                or not np.issubdtype(c.data.dtype, np.integer)):
+            return None
+        cols.append(c)
+    return cols
+
+
+def _plan_strategy(jn):
+    """Pick the cheapest build layout: a UNIQUE host index wins outright
+    (gather join, probe-shaped output); a non-unique one still beats the
+    in-program sort (CSR expansion); neither → device lexsort. The right
+    (conventional build) side indexes first, and a unique hit returns
+    before the left index is ever built — indexing the probe side would
+    argsort the (typically huge) fact table for nothing."""
+    rcols = _leaf_key_cols(jn.right, jn.right_keys)
+    ridx = build_join_index(rcols) if rcols else None
+    if ridx is not None and ridx.unique:
+        return ("uniq", "right", ridx)
+    lcols = _leaf_key_cols(jn.left, jn.left_keys)
+    lidx = build_join_index(lcols) if lcols else None
+    if lidx is not None and lidx.unique:
+        return ("uniq", "left", lidx)
+    if ridx is not None:
+        return ("expand", "right", ridx)
+    if lidx is not None:
+        return ("expand", "left", lidx)
+    return None
+
+
+def _strategy_sig(jn):
+    st = jn.strategy
+    if st is None:
+        return f"S{jn.pos}:-"
+    kind, side, idx = st
+    # n_valid is load-bearing: the compiled fragment bakes it into clip
+    # bounds and the lo < n_valid guard, so two indexes differing only in
+    # their null count must never share a pipeline
+    return (f"S{jn.pos}:{kind}/{side}/{idx.kind}/{idx.packs}/"
+            f"{int(idx.unique)}/{idx.n_rows}/{idx.n_valid}")
+
+
+#: learned exact sizes per fragment: (sig, join_pos) → last observed match
+#: total; (sig, "agg") → last observed group count. In-process, LRU-bounded
+#: like _PIPE_CACHE (sig strings embed data-dependent packs, so stale data
+#: versions must age out); repeat fragments (bench steady state, plan-cache
+#: hits) start tight and never pay a discovery recompile again.
+import collections as _collections
+
+_CAP_STORE: "_collections.OrderedDict" = _collections.OrderedDict()
+_CAP_STORE_MAX = 4096
+
+
+def _cap_store_put(key, val):
+    _CAP_STORE[key] = val
+    _CAP_STORE.move_to_end(key)
+    if len(_CAP_STORE) > _CAP_STORE_MAX:
+        _CAP_STORE.popitem(last=False)
+
+
 def _join_expand(bk, bvalid, pk, pvalid, cap):
-    """Static-capacity inner equi-join expansion. Returns (probe_slot,
-    build_slot, valid, overflow): slot arrays index the *input relations*
-    (length cap; garbage where ~valid).
+    """Static-capacity inner equi-join expansion (device-sort fallback).
+    Returns (probe_slot, build_slot, valid, total): slot arrays index the
+    *input relations* (length cap; garbage where ~valid).
 
     Join keys are arbitrary user int64 columns, so invalid rows are pushed
     behind ALL valid rows by a (validity, key) lexsort and the searchsorted
@@ -204,11 +294,27 @@ def _combined_join_keys(lkds, lknulls, lvalid, rkds, rknulls, rvalid):
     return pk, pvalid, bk, bvalid, total > jnp.asarray(2.0**62)
 
 
+def _pack_probe(kds, knulls, pvalid, packs):
+    """Probe-side key folding with the BUILD index's static (min, span)
+    per column. Rows whose key falls outside the build range (or is NULL)
+    can't match; they're excluded via `ok` and clamped so the packing
+    arithmetic never overflows."""
+    ok = pvalid
+    key = jnp.zeros(pvalid.shape, dtype=jnp.int64)
+    for d, nl, (mn, span) in zip(kds, knulls, packs):
+        v = d.astype(jnp.int64) - mn
+        ok = ok & ~nl & (v >= 0) & (v < span)
+        key = key * span + jnp.clip(v, 0, span - 1)
+    return key, ok
+
+
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                      capacity, key_pack, agg_meta):
     """Build the jitted end-to-end program. caps: per-join static
-    capacities aligned with `joins`. Returns jitted fn(env) where env is
-    {(leaf_id, col): (data, nulls)}."""
+    capacities aligned with `joins`. Returns jitted fn(env, jidx) where
+    env is {global_col: (data, nulls)} and jidx is a per-join tuple of
+    host-index device arrays (passed as arguments, not baked, so a data
+    refresh with unchanged shapes reuses the compiled program)."""
     for jn, cap in zip(joins, caps):
         jn.cap = cap
 
@@ -231,7 +337,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
     cond_fns = [dev.compile_expr(c, dcols) for c in agg_conds]
     key_fns, val_plan, agg_ops, slots = agg_meta
 
-    def run(env):
+    def run(env, jidx):
         # env keyed by global column index → (data, nulls) on device
         def leaf_rel(leaf):
             n = next(iter(_leaf_env(leaf).values())).data.shape[0]
@@ -264,27 +370,91 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                         out[leaf.offset + i] = (d[idx], nl[idx])
             return out
 
+        def eval_indexed(node, lidx_map, lvalid, ridx_map, rvalid):
+            """Host-indexed join paths ('uniq' gather / 'expand' CSR)."""
+            kind, side, idx = node.strategy
+            if side == "right":
+                pidx_map, pvalid, pside = lidx_map, lvalid, node.left
+                bidx_map, bvalid = ridx_map, rvalid
+                key_fns_p = node._lk_fns
+            else:
+                pidx_map, pvalid, pside = ridx_map, rvalid, node.right
+                bidx_map, bvalid = lidx_map, lvalid
+                key_fns_p = node._rk_fns
+            penv = gather_env(pidx_map, pvalid, pside)
+            n_probe = pvalid.shape[0]
+            kds, knulls = zip(*[
+                dev.broadcast_1d(*f(penv), n_probe) for f in key_fns_p])
+            key, ok = _pack_probe(kds, knulls, pvalid, idx.packs)
+            a0, a1 = jidx[node.pos]
+            nv = idx.n_valid
+            safe_hi = max(nv - 1, 0)
+            if idx.kind == "dense":
+                k_c = jnp.clip(key, 0, idx.span - 1)
+                pos0 = a0[k_c].astype(jnp.int64)
+                cnt = jnp.where(ok, (a0[k_c + 1] - a0[k_c]).astype(jnp.int64),
+                                0)
+            else:
+                lo = jnp.searchsorted(a0, key, side="left")
+                lo_c = jnp.clip(lo, 0, a0.shape[0] - 1)
+                pos0 = jnp.minimum(lo, nv).astype(jnp.int64)
+                if kind == "uniq":
+                    cnt = jnp.where(ok & (lo < nv) & (a0[lo_c] == key), 1, 0)
+                else:
+                    hi = jnp.searchsorted(a0, key, side="right")
+                    cnt = jnp.where(
+                        ok, jnp.minimum(hi, nv) - jnp.minimum(lo, nv), 0)
+            if kind == "uniq":
+                bi = a1[jnp.clip(pos0, 0, safe_hi)].astype(jnp.int64)
+                valid = pvalid & (cnt > 0) & bvalid[bi]
+                out = dict(pidx_map)
+                for lid, v in bidx_map.items():
+                    out[lid] = v[bi]
+                overflows.append(jnp.sum(valid))  # ≤ cap by construction
+                return out, valid
+            cap = node.cap
+            cum = jnp.concatenate(
+                [jnp.zeros(1, dtype=jnp.int64), jnp.cumsum(cnt)])
+            total = cum[-1]
+            posn = jnp.arange(cap)
+            pi = jnp.clip(jnp.searchsorted(cum, posn, side="right") - 1,
+                          0, n_probe - 1)
+            within = posn - cum[pi]
+            bi = a1[jnp.clip(pos0[pi] + within, 0, safe_hi)].astype(jnp.int64)
+            valid = (posn < total) & bvalid[bi] & pvalid[pi]
+            overflows.append(total)
+            out = {k: v[pi] for k, v in pidx_map.items()}
+            for lid, v in bidx_map.items():
+                out[lid] = v[bi]
+            return out, valid
+
         def eval_node(node):
             if isinstance(node, _Leaf):
                 return leaf_rel(node)
+            # children always evaluate left-then-right so the overflow
+            # list order matches the `joins` list (postorder walk)
             lidx, lvalid = eval_node(node.left)
             ridx, rvalid = eval_node(node.right)
-            lenv = gather_env(lidx, lvalid, node.left)
-            renv = gather_env(ridx, rvalid, node.right)
-            lkds, lknulls = zip(*[
-                dev.broadcast_1d(*f(lenv), lvalid.shape[0])
-                for f in node._lk_fns])
-            rkds, rknulls = zip(*[
-                dev.broadcast_1d(*f(renv), rvalid.shape[0])
-                for f in node._rk_fns])
-            pk_d, pvalid, bk_d, bvalid, sovf = _combined_join_keys(
-                lkds, lknulls, lvalid, rkds, rknulls, rvalid)
-            span_ovfs.append(sovf)
-            pi, bi, valid, total = _join_expand(
-                bk_d, bvalid, pk_d, pvalid, node.cap)
-            overflows.append(total)
-            idxmap = {k: v[pi] for k, v in lidx.items()}
-            idxmap.update({k: v[bi] for k, v in ridx.items()})
+            if node.strategy is not None:
+                idxmap, valid = eval_indexed(node, lidx, lvalid, ridx,
+                                             rvalid)
+            else:
+                lenv = gather_env(lidx, lvalid, node.left)
+                renv = gather_env(ridx, rvalid, node.right)
+                lkds, lknulls = zip(*[
+                    dev.broadcast_1d(*f(lenv), lvalid.shape[0])
+                    for f in node._lk_fns])
+                rkds, rknulls = zip(*[
+                    dev.broadcast_1d(*f(renv), rvalid.shape[0])
+                    for f in node._rk_fns])
+                pk_d, pvalid, bk_d, bvalid, sovf = _combined_join_keys(
+                    lkds, lknulls, lvalid, rkds, rknulls, rvalid)
+                span_ovfs.append(sovf)
+                pi, bi, valid, total = _join_expand(
+                    bk_d, bvalid, pk_d, pvalid, node.cap)
+                overflows.append(total)
+                idxmap = {k: v[pi] for k, v in lidx.items()}
+                idxmap.update({k: v[bi] for k, v in ridx.items()})
             if node._oc_fns:
                 jenv = gather_env(idxmap, valid, node)
                 for f in node._oc_fns:
@@ -332,6 +502,43 @@ def _shift_expr(e, offset):
         lambda c: ExprColumn(c.idx + offset, c.ftype, name=c.name))
 
 
+def _fill_caps(node, sig):
+    """Bottom-up static output capacities. Unique-indexed joins inherit
+    the probe side's capacity exactly. Expansion joins take (in order):
+    the retry-adjusted/learned size, a stats-free estimate from the build
+    index's average match count, or (device-sort fallback) the FK-join
+    upper heuristic — a key-FK join emits about as many rows as its
+    LARGER input, composed bottom-up over RAW leaf sizes. Estimates
+    deliberately overshoot: undershoot costs a full recompile (minutes
+    over a device tunnel), overshoot only pads the kernels; the learned
+    store tightens the shapes from the second compile on."""
+    if isinstance(node, _Leaf):
+        return node.chunk.num_rows
+
+    lc = _fill_caps(node.left, sig)
+    rc = _fill_caps(node.right, sig)
+    st = node.strategy
+    if st is not None and st[0] == "uniq":
+        node.cap = lc if st[1] == "right" else rc
+        return node.cap
+    if node.exp_cap is None:
+        learned = _CAP_STORE.get((sig, node.pos))
+        if learned is not None:
+            node.exp_cap = dev.next_pow2(max(learned, 8))
+        elif st is not None:
+            probe_cap = lc if st[1] == "right" else rc
+            node.exp_cap = dev.next_pow2(
+                max(int(probe_cap * st[2].avg_cnt * 1.5), 1024))
+        else:
+            def fk_est(nd):
+                if isinstance(nd, _Leaf):
+                    return max(nd.chunk.num_rows, 8)
+                return max(fk_est(nd.left), fk_est(nd.right))
+            node.exp_cap = dev.next_pow2(fk_est(node))
+    node.cap = node.exp_cap
+    return node.cap
+
+
 def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     """Entry: compile + run the fused join+agg fragment for a HashAgg whose
     child is a join tree over table scans. Raises DeviceUnsupported when
@@ -340,6 +547,8 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     root, leaves, joins = collect_tree(child_exec)
     if not want_device(ctx, max(leaf.chunk.num_rows for leaf in leaves)):
         raise DeviceUnsupported("below device threshold")
+    for jn in joins:
+        jn.strategy = _plan_strategy(jn)
     dcols = _global_dcols(leaves)
     agg_meta_full = _plan_agg(agg_plan, dcols)
     key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
@@ -354,34 +563,23 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     sig = fragment_sig(leaves, joins, agg_conds, agg_plan)
     dict_refs = tuple(dc.dictionary for dc in dcols.values()
                       if dc.dictionary is not None)
+    jidx = tuple(jn.strategy[2].device_arrays() if jn.strategy is not None
+                 else () for jn in joins)
 
-    # initial join capacities: FK-join upper heuristic — a key-FK join
-    # emits about as many rows as its LARGER input (TPC-H joins are
-    # fact⋈dim), composed bottom-up over RAW leaf sizes. Deliberately an
-    # over-estimate: undershoot costs a full recompile per level (minutes
-    # over a device tunnel — CBO-estimate-seeded caps were tried and
-    # converged in 4-5 compiles instead of 1), while overshoot only pads
-    # the kernels. Exact totals from the run correct any overflow in one
-    # jump (O(join depth) compiles worst case).
-    def fk_est(node):
-        if isinstance(node, _Leaf):
-            return max(node.chunk.num_rows, 8)
-        return max(fk_est(node.left), fk_est(node.right))
-
-    caps = []
-    for jn in joins:
-        jn.cap = dev.next_pow2(fk_est(jn))
-        caps.append(jn.cap)
-
-    n_frag = caps[-1]
-    est = _estimate_groups(agg_plan, n_frag, ctx)
-    capacity = dev.next_pow2(min(n_frag, max(est, 16)))
+    n_frag = _fill_caps(root, sig)
+    learned_ng = _CAP_STORE.get((sig, "agg"))
+    if learned_ng is not None:
+        capacity = dev.next_pow2(max(learned_ng, 16))
+    else:
+        est = _estimate_groups(agg_plan, n_frag, ctx)
+        capacity = dev.next_pow2(min(n_frag, max(est, 16)))
 
     import os as _os
     import sys as _sys
     import time as _time
     _dbg = _os.environ.get("TIDB_TPU_DEBUG_JOIN")
     for _attempt in range(12):
+        caps = [jn.cap for jn in joins]
         key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops))
         fn = _pipe_cache_get(key)
         t0 = _time.perf_counter()
@@ -389,7 +587,7 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
             fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
                                   caps, capacity, key_pack, agg_meta)
             _pipe_cache_put(key, fn, dict_refs)
-        agg_out, ovf_d, sovf_d = fn(env)
+        agg_out, ovf_d, sovf_d = fn(env, jidx)
         from .device_exec import AggFetch, resolve_topn
         f = AggFetch(agg_out, extras=(ovf_d, sovf_d),
                      topn=resolve_topn(agg_plan, slots))
@@ -404,19 +602,37 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
             raise DeviceUnsupported(
                 "multi-key join value ranges exceed int64 packing")
         retry = False
-        for i, total in enumerate(overflows):
-            if int(total) > caps[i]:
+        for jn, total in zip(joins, overflows):
+            if jn.strategy is not None and jn.strategy[0] == "uniq":
+                continue  # total = matched rows, bounded by the probe cap
+            total = int(total)
+            tight = dev.next_pow2(max(total, 8))
+            if total > jn.cap:
                 # jump straight to the required size (totals downstream of
                 # an overflowed join are lower bounds — the next pass
                 # corrects them, so convergence is O(join depth), not
                 # O(log(need)) recompiles)
-                caps[i] = dev.next_pow2(int(total))
+                jn.exp_cap = tight
                 retry = True
+            elif jn.cap > 4 * tight and jn.cap > 8192:
+                # shrink-to-fit: a fat discovery capacity pads every
+                # downstream operator on every future execution; one more
+                # compile now buys tight steady-state shapes forever
+                jn.exp_cap = tight
+                retry = True
+            _cap_store_put((sig, jn.pos), total)
+        tight_ng = dev.next_pow2(max(ng, 16))
         if ng > capacity:
-            capacity = dev.next_pow2(ng)
+            capacity = tight_ng
             retry = True
-        if not retry:
-            break
+        elif capacity > 4 * tight_ng and capacity > 8192:
+            capacity = tight_ng
+            retry = True
+        _cap_store_put((sig, "agg"), ng)
+        if retry:
+            _fill_caps(root, sig)
+            continue
+        break
     else:
         raise DeviceUnsupported("join fragment capacities did not converge")
     if ng == 0 and not agg_plan.group_exprs:
@@ -438,6 +654,7 @@ def fragment_sig(leaves, joins, agg_conds, agg_plan):
                         for lk, rk in zip(jn.left_keys, jn.right_keys))
         parts.append(f"J{jn.offset}:{keys}|"
                      + ";".join(_expr_sig(c) for c in jn.other_conds))
+        parts.append(_strategy_sig(jn))
     parts.append("|c|" + ";".join(_expr_sig(c) for c in agg_conds))
     parts.append("|g|" + ";".join(_expr_sig(e) for e in agg_plan.group_exprs))
     parts.append("|a|" + ";".join(

@@ -1,0 +1,154 @@
+"""Host-built join indexes for the device join path.
+
+The reference probes a hash table built per query execution
+(executor/join.go:192 build workers, hash_table.go). On XLA that design
+loses twice: hash tables need data-dependent shapes, and the sort-based
+replacement re-sorts the build side on EVERY execution. But a join whose
+build side is a BASE TABLE scan has a data-dependent part that only
+changes when the table version changes — so the expensive part (ordering
+the build rows by key) moves to the host, runs ONCE per table version in
+numpy, and is cached on the Column exactly like the HBM upload
+(utils/chunk.py Column._device). The device-side lookup degenerates to
+gathers and searchsorteds — no sort in the compiled program at all.
+
+Two layouts:
+- ``dense`` — CSR over the key span (``starts`` of size span+1, ``rows``
+  listing valid row ids in key order). Applies when the packed key span
+  is within a small factor of the row count: TPC-H keys are dense
+  1..N, so every PK/FK join takes this path. Lookup = 2 gathers.
+- ``sorted`` — row ids argsorted by packed key + the sorted key array.
+  Applies to sparse/composite keys (e.g. partsupp's (partkey, suppkey)
+  whose packed span is ~nb²). Lookup = binary search into the
+  host-sorted array.
+
+Either layout knows whether the (non-null) build keys are UNIQUE. A
+unique build side makes the join output shape the PROBE side's shape —
+the expansion pass, its output capacity, and the overflow/recompile
+machinery all disappear (TPC-H joins are fact⋈dim = FK⋈unique-PK, so
+this is the common case on every north-star query).
+
+Multi-column keys fold into one int64 by range packing with host-known
+(min, span) per column — unlike the device-side data-dependent packing
+(device_join._combined_join_keys), these are static at trace time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: dense CSR is worth it while the span stays within this factor of the
+#: row count (beyond that the starts array dwarfs the table)
+_DENSE_SLACK = 4
+_DENSE_FLOOR = 65536
+
+
+class JoinIndex:
+    """Host index over one ordered key-column tuple of a base chunk."""
+
+    __slots__ = ("kind", "packs", "unique", "n_rows", "n_valid", "span",
+                 "starts", "rows", "sorted_keys", "avg_cnt", "_dev")
+
+    def __init__(self):
+        self._dev = None
+
+    def device_arrays(self):
+        """Upload (lazily, once) and return the jnp lookup arrays."""
+        if self._dev is None:
+            import jax.numpy as jnp
+            if self.kind == "dense":
+                self._dev = (jnp.asarray(self.starts), jnp.asarray(self.rows))
+            else:
+                self._dev = (jnp.asarray(self.sorted_keys),
+                             jnp.asarray(self.rows))
+        return self._dev
+
+
+def _pack_host(datas, valid, packs):
+    """Fold key columns into one int64 per row (valid rows only are
+    meaningful; invalid rows fold to arbitrary in-range values)."""
+    packed = np.zeros(len(datas[0]), dtype=np.int64)
+    for d, (mn, span) in zip(datas, packs):
+        v = d.astype(np.int64) - mn
+        np.clip(v, 0, span - 1, out=v)
+        packed = packed * span + v
+    return packed
+
+
+def build_join_index(columns) -> "JoinIndex | None":
+    """Index over `columns` (utils.chunk.Column tuple, int-kinded numpy
+    data), cached on columns[0]. None when the keys can't range-pack into
+    int64 (caller falls back to the device-side sort join)."""
+    host = columns[0]
+    # the cached tuple PINS the column objects: a live reference can never
+    # share its id with a newly allocated Column, which is what makes the
+    # id()-keyed composite lookup sound (same convention as the pipeline
+    # cache's dict_refs, executor/device_exec.py)
+    cache_key = tuple(id(c) for c in columns)
+    cached = getattr(host, "_join_index", None)
+    if cached is not None and cached[0] == cache_key:
+        return cached[1]
+
+    datas = [c.data for c in columns]
+    nulls = columns[0].nulls
+    for c in columns[1:]:
+        nulls = nulls | c.nulls
+    valid = ~nulls
+    nb = len(datas[0])
+    n_valid = int(valid.sum())
+
+    packs = []
+    total_span = 1.0
+    for d in datas:
+        dv = d[valid]
+        if dv.size == 0:
+            mn, mx = 0, 0
+        else:
+            mn, mx = int(dv.min()), int(dv.max())
+        span = mx - mn + 1
+        total_span *= span
+        packs.append((mn, span))
+    if total_span > 2.0**62:
+        host._join_index = (cache_key, None)
+        return None
+
+    idx = JoinIndex()
+    idx.packs = tuple(packs)
+    idx.n_rows = nb
+    idx.n_valid = n_valid
+    span_total = int(total_span)
+    idx.span = span_total
+    packed = _pack_host(datas, valid, packs)
+
+    row_dt = np.int32 if nb < (1 << 31) else np.int64
+    if span_total <= max(_DENSE_SLACK * nb, _DENSE_FLOOR):
+        idx.kind = "dense"
+        counts = np.bincount(packed[valid], minlength=span_total)
+        starts = np.empty(span_total + 1, dtype=row_dt)
+        starts[0] = 0
+        np.cumsum(counts, out=starts[1:])
+        # row ids grouped by key: stable argsort with invalid rows parked
+        # past every real key
+        sort_key = np.where(valid, packed, np.int64(span_total))
+        order = np.argsort(sort_key, kind="stable")
+        idx.starts = starts
+        idx.rows = (order[:n_valid] if n_valid else
+                    np.zeros(1, dtype=np.int64)).astype(row_dt)
+        idx.unique = bool(counts.max(initial=0) <= 1)
+        idx.sorted_keys = None
+        idx.avg_cnt = n_valid / max(int(np.count_nonzero(counts)), 1)
+    else:
+        idx.kind = "sorted"
+        sort_key = np.where(valid, packed, np.iinfo(np.int64).max)
+        order = np.argsort(sort_key, kind="stable")
+        sk = sort_key[order[:n_valid]] if n_valid else np.zeros(
+            1, dtype=np.int64)
+        idx.sorted_keys = sk
+        idx.rows = (order[:n_valid] if n_valid else
+                    np.zeros(1, dtype=np.int64)).astype(row_dt)
+        idx.starts = None
+        idx.unique = bool(n_valid <= 1 or not np.any(sk[1:] == sk[:-1]))
+        n_distinct = (1 + int(np.count_nonzero(sk[1:] != sk[:-1]))
+                      if n_valid else 1)
+        idx.avg_cnt = n_valid / max(n_distinct, 1)
+    host._join_index = (cache_key, idx, tuple(columns))
+    return idx

@@ -70,7 +70,8 @@ def np_dtype_for(ft: FieldType):
 class Column:
     """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
 
-    __slots__ = ("ftype", "data", "nulls", "_dict", "_dict_ci", "_device")
+    __slots__ = ("ftype", "data", "nulls", "_dict", "_dict_ci", "_device",
+                 "_join_index", "_minmax")
 
     def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
         self.ftype = ftype
@@ -81,6 +82,8 @@ class Column:
         self._dict = None    # cached (codes, uniques) for device encoding
         self._dict_ci = None  # cached (collation, ci encoding) for _ci cols
         self._device = None  # cached (jnp data, jnp nulls) resident in HBM
+        self._join_index = None  # cached host join index (executor/join_index)
+        self._minmax = None  # cached (min, max) over non-null int rows
 
     def __len__(self):
         return len(self.data)
@@ -127,6 +130,22 @@ class Column:
 
     def is_device_friendly(self) -> bool:
         return self.data.dtype != object
+
+    def minmax(self):
+        """(min, max) over non-null rows of an integer-kinded column, cached
+        (feeds static key-range packing in the device agg/join planners).
+        None for empty/all-null/non-integer columns."""
+        if self._minmax is None:
+            if (self.data.dtype == object
+                    or not np.issubdtype(self.data.dtype, np.integer)):
+                self._minmax = (None,)
+            else:
+                d = self.data[~self.nulls] if self.nulls.any() else self.data
+                if d.size == 0:
+                    self._minmax = (None,)
+                else:
+                    self._minmax = (int(d.min()), int(d.max()))
+        return None if self._minmax[0] is None else self._minmax
 
     # -- string device encodings -------------------------------------------
 
