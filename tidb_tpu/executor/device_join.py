@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ..expression import phys_kind, K_FLOAT, K_STR
-from ..expression.core import Column as ExprColumn
+from ..expression.core import Column as ExprColumn, ScalarFunc as _SF
 from ..ops import device as dev
 from ..ops.device import DeviceUnsupported
 from .device_exec import (
@@ -48,7 +48,8 @@ from .join_index import build_join_index
 
 
 class _Leaf:
-    __slots__ = ("leaf_id", "chunk", "conds", "offset", "ncols", "dcols")
+    __slots__ = ("leaf_id", "chunk", "conds", "offset", "ncols", "dcols",
+                 "leaf_ids")
 
     def __init__(self, leaf_id, chunk, conds, offset):
         self.leaf_id = leaf_id
@@ -57,6 +58,7 @@ class _Leaf:
         self.offset = offset
         self.ncols = chunk.num_cols
         self.dcols = None  # {local_idx: DeviceCol}
+        self.leaf_ids = frozenset((leaf_id,))
 
 
 class _JoinNode:
@@ -69,10 +71,12 @@ class _JoinNode:
         self.other_conds = other_conds
         self.offset = offset
         self.ncols = left.ncols + right.ncols
+        self.leaf_ids = left.leaf_ids | right.leaf_ids
         self.cap = 0            # static output capacity (set by _fill_caps)
         self.pos = 0            # index into the fragment's join list
         self.strategy = None    # None | (kind, side, JoinIndex)
         self.exp_cap = None     # requested capacity for expansion joins
+        self.global_keys = False  # keys/conds already in global indices
 
 
 def collect_tree(node):
@@ -163,6 +167,28 @@ def _leaf_key_cols(side, keys):
     return cols
 
 
+def _leaf_index(side, keys):
+    """Host join index for `side` (a leaf with bare int keys), built over
+    the rows passing the leaf's pushed-down filters — evaluated host-side
+    with the host engine's own predicate path, so index membership matches
+    the device mask exactly. None when out of the index language."""
+    cols = _leaf_key_cols(side, keys)
+    if cols is None:
+        return None
+    tag = ""
+    mask_fn = None
+    if side.conds:
+        try:
+            tag = ";".join(_expr_sig(c) for c in side.conds)
+        except DeviceUnsupported:
+            tag = ""
+        if tag:
+            def mask_fn():
+                from .exec_select import eval_conds_mask
+                return eval_conds_mask(side.conds, side.chunk)
+    return build_join_index(cols, mask_fn=mask_fn, cache_tag=tag)
+
+
 def _plan_strategy(jn):
     """Pick the cheapest build layout: a UNIQUE host index wins outright
     (gather join, probe-shaped output); a non-unique one still beats the
@@ -170,12 +196,10 @@ def _plan_strategy(jn):
     (conventional build) side indexes first, and a unique hit returns
     before the left index is ever built — indexing the probe side would
     argsort the (typically huge) fact table for nothing."""
-    rcols = _leaf_key_cols(jn.right, jn.right_keys)
-    ridx = build_join_index(rcols) if rcols else None
+    ridx = _leaf_index(jn.right, jn.right_keys)
     if ridx is not None and ridx.unique:
         return ("uniq", "right", ridx)
-    lcols = _leaf_key_cols(jn.left, jn.left_keys)
-    lidx = build_join_index(lcols) if lcols else None
+    lidx = _leaf_index(jn.left, jn.left_keys)
     if lidx is not None and lidx.unique:
         return ("uniq", "left", lidx)
     if ridx is not None:
@@ -183,6 +207,128 @@ def _plan_strategy(jn):
     if lidx is not None:
         return ("expand", "left", lidx)
     return None
+
+
+def _reorder_fact_first(leaves, joins):
+    """Rebuild the fragment's inner-join tree as a FACT-FIRST left-deep
+    chain of unique-build gather joins. The device cost model inverts the
+    host planner's greedy smallest-intermediate order (optimizer.py
+    _greedy_join, reference rule_join_reorder.go): starting from the
+    LARGEST leaf and attaching each dimension through its unique key makes
+    every join a probe-shaped gather — the 'intermediate result' never
+    grows, selectivity lives in the validity mask, and no expansion
+    capacity (or overflow recompile) exists anywhere in the program. Inner
+    equi-joins reorder freely, so this is pure engine-side physical
+    planning.
+
+    Returns (root, new_joins) with strategies assigned, or None when the
+    chain can't be built expansion-free (multi-leaf key exprs, a
+    disconnected graph, or a non-unique build somewhere) — the caller
+    keeps the planner's tree and per-join strategy planning."""
+    if len(joins) < 2:
+        return None
+    from ..sqltypes import FieldType, TYPE_LONGLONG
+    by_id = {leaf.leaf_id: leaf for leaf in leaves}
+
+    def cover_of(e):
+        used = set()
+        e.columns_used(used)
+        ids = set()
+        for g in used:
+            for leaf in leaves:
+                if leaf.offset <= g < leaf.offset + leaf.ncols:
+                    ids.add(leaf.leaf_id)
+                    break
+        return ids
+
+    pairs = []   # [gl_expr, gr_expr, l_leaf, r_leaf]
+    others = []  # [g_expr, cover_set]
+    for jn in joins:
+        off_l = 0 if jn.global_keys else jn.left.offset
+        off_r = 0 if jn.global_keys else jn.right.offset
+        off_o = 0 if jn.global_keys else jn.offset
+        for lk, rk in zip(jn.left_keys, jn.right_keys):
+            gl = _shift_expr(lk, off_l)
+            gr = _shift_expr(rk, off_r)
+            cl, cr = cover_of(gl), cover_of(gr)
+            if len(cl) != 1 or len(cr) != 1:
+                return None
+            pairs.append((gl, gr, next(iter(cl)), next(iter(cr))))
+        for c in jn.other_conds:
+            g = _shift_expr(c, off_o)
+            others.append((g, cover_of(g)))
+
+    remaining = set(by_id)
+    start = max(remaining, key=lambda i: by_id[i].chunk.num_rows)
+    remaining.discard(start)
+    spine_ids = {start}
+    cur = by_id[start]
+    new_joins = []
+    pend_pairs = list(pairs)
+    pend_others = list(others)
+    bool_ft = FieldType(tp=TYPE_LONGLONG)
+    while remaining:
+        cands = {}  # leaf_id -> [(pair, spine_expr, leaf_expr)]
+        for p in pend_pairs:
+            gl, gr, cl, cr = p
+            if cl in spine_ids and cr in remaining:
+                cands.setdefault(cr, []).append((p, gl, gr))
+            elif cr in spine_ids and cl in remaining:
+                cands.setdefault(cl, []).append((p, gr, gl))
+        if not cands:
+            return None
+        best = None
+        for lid, kps in cands.items():
+            leaf = by_id[lid]
+            # the index builder addresses the leaf's LOCAL schema; the
+            # chain's key exprs are global — rebase before the lookup
+            idx = _leaf_index(leaf, [_shift_expr(lx, -leaf.offset)
+                                     for _p, _s, lx in kps])
+            if idx is None or not idx.unique:
+                continue
+            key = (leaf.chunk.num_rows, lid)
+            if best is None or key < best[0]:
+                best = (key, lid, kps, idx)
+        if best is None:
+            return None  # a non-unique build would expand: keep the
+            #              planner's tree instead
+        _key, lid, kps, idx = best
+        leaf = by_id[lid]
+        jn = _JoinNode(cur, leaf,
+                       [s for _p, s, _l in kps], [l for _p, _s, l in kps],
+                       [], 0)
+        jn.global_keys = True
+        jn.strategy = ("uniq", "right", idx)
+        spine_ids.add(lid)
+        remaining.discard(lid)
+        consumed = {id(p) for p, _s, _l in kps}
+        rest = []
+        for p in pend_pairs:
+            gl, gr, cl, cr = p
+            if id(p) in consumed:
+                continue
+            if cl in spine_ids and cr in spine_ids:
+                # an equi-cond between two already-joined leaves (Q5's
+                # c_nationkey = s_nationkey shape) becomes a plain filter
+                # at the first node covering both sides
+                jn.other_conds.append(_SF("eq", [gl, gr], bool_ft))
+            else:
+                rest.append(p)
+        pend_pairs = rest
+        keep_o = []
+        for o in pend_others:
+            g, cov = o
+            if cov <= spine_ids:
+                jn.other_conds.append(g)
+            else:
+                keep_o.append(o)
+        pend_others = keep_o
+        jn.pos = len(new_joins)
+        new_joins.append(jn)
+        cur = jn
+    if pend_pairs or pend_others:
+        return None  # anything unplaced means the rewrite lost a predicate
+    return cur, new_joins
 
 
 def _strategy_sig(jn):
@@ -309,12 +455,18 @@ def _pack_probe(kds, knulls, pvalid, packs):
 
 
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
-                     capacity, key_pack, agg_meta):
+                     capacity, key_pack, agg_meta, compact_cap=None):
     """Build the jitted end-to-end program. caps: per-join static
     capacities aligned with `joins`. Returns jitted fn(env, jidx) where
     env is {global_col: (data, nulls)} and jidx is a per-join tuple of
     host-index device arrays (passed as arguments, not baked, so a data
-    refresh with unchanged shapes reuses the compiled program)."""
+    refresh with unchanged shapes reuses the compiled program).
+
+    compact_cap: when set (CPU backend, learned from a prior run), the
+    post-join/filter rows are scatter-compacted to this static width
+    before the aggregate — a fact-shaped fragment output with a sparse
+    validity mask (the price of the gather-join design) would otherwise
+    drag the full fact length through the group-by sort."""
     for jn, cap in zip(joins, caps):
         jn.cap = cap
 
@@ -327,12 +479,16 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                            for i, dc in _leaf_env(leaf).items()})
          for c in leaf.conds] for leaf in leaves]
     # key/other-cond/agg expressions are compiled against global offsets
+    # (reordered nodes carry globally-indexed exprs already)
     for jn in joins:
-        jn._lk_fns = [dev.compile_expr(_shift_expr(k, jn.left.offset), dcols)
+        off_l = 0 if jn.global_keys else jn.left.offset
+        off_r = 0 if jn.global_keys else jn.right.offset
+        off_o = 0 if jn.global_keys else jn.offset
+        jn._lk_fns = [dev.compile_expr(_shift_expr(k, off_l), dcols)
                       for k in jn.left_keys]
-        jn._rk_fns = [dev.compile_expr(_shift_expr(k, jn.right.offset), dcols)
+        jn._rk_fns = [dev.compile_expr(_shift_expr(k, off_r), dcols)
                       for k in jn.right_keys]
-        jn._oc_fns = [dev.compile_expr(_shift_expr(c, jn.offset), dcols)
+        jn._oc_fns = [dev.compile_expr(_shift_expr(c, off_o), dcols)
                       for c in jn.other_conds]
     cond_fns = [dev.compile_expr(c, dcols) for c in agg_conds]
     key_fns, val_plan, agg_ops, slots = agg_meta
@@ -357,13 +513,11 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
 
         def gather_env(idxmap, valid, node):
             """env of gathered (relation-space) columns for `node`'s
-            subtree, keyed by global column index."""
+            subtree, keyed by global column index. Unused columns' gathers
+            are dead code XLA eliminates — laziness here is free."""
             out = {}
             for leaf in leaves:
-                if leaf.leaf_id in idxmap:
-                    if not (leaf.offset >= node.offset
-                            and leaf.offset < node.offset + node.ncols):
-                        continue
+                if leaf.leaf_id in idxmap and leaf.leaf_id in node.leaf_ids:
                     idx = idxmap[leaf.leaf_id]
                     for i in range(leaf.ncols):
                         d, nl = env[leaf.offset + i]
@@ -468,6 +622,18 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
         for f in cond_fns:
             d, nl = f(fenv)
             mask = mask & (d != 0) & ~nl
+        kept_total = jnp.sum(mask)
+        if compact_cap is not None:
+            # scatter-compact kept rows to the front: the aggregate then
+            # sorts/buckets compact_cap rows instead of the fact length.
+            # kept_total > compact_cap is detected host-side (extras) and
+            # recompiled — same contract as a join-capacity overflow.
+            cidx = jnp.cumsum(mask) - 1
+            tgt = jnp.where(mask, cidx, compact_cap)
+            sel = jnp.zeros(compact_cap, dtype=jnp.int64).at[tgt].set(
+                jnp.arange(mask.shape[0]), mode="drop")
+            fenv = {k: (d[sel], nl[sel]) for k, (d, nl) in fenv.items()}
+            mask = jnp.arange(compact_cap) < kept_total
         n_out = mask.shape[0]
         key_cols, key_nulls = [], []
         for f in key_fns:
@@ -489,7 +655,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                                 n_keys=len(key_cols),
                                 agg_ops=tuple(agg_ops),
                                 capacity=capacity, pack=key_pack)
-        return agg_out, tuple(overflows), tuple(span_ovfs)
+        return agg_out, tuple(overflows), tuple(span_ovfs), kept_total
 
     return jax.jit(run)
 
@@ -547,8 +713,12 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     root, leaves, joins = collect_tree(child_exec)
     if not want_device(ctx, max(leaf.chunk.num_rows for leaf in leaves)):
         raise DeviceUnsupported("below device threshold")
-    for jn in joins:
-        jn.strategy = _plan_strategy(jn)
+    reordered = _reorder_fact_first(leaves, joins)
+    if reordered is not None:
+        root, joins = reordered  # strategies assigned (all uniq)
+    else:
+        for jn in joins:
+            jn.strategy = _plan_strategy(jn)
     dcols = _global_dcols(leaves)
     agg_meta_full = _plan_agg(agg_plan, dcols)
     key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
@@ -573,6 +743,15 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     else:
         est = _estimate_groups(agg_plan, n_frag, ctx)
         capacity = dev.next_pow2(min(n_frag, max(est, 16)))
+    # post-join compaction (CPU backend only — scatter-cheap there): learn
+    # the kept-row count and re-shape the aggregate input to it
+    on_cpu = jax.default_backend() == "cpu"
+    compact_cap = None
+    if on_cpu and n_frag > 65536:
+        learned_kept = _CAP_STORE.get((sig, "compact"))
+        if learned_kept is not None and dev.next_pow2(
+                max(learned_kept, 8)) * 2 <= n_frag:
+            compact_cap = dev.next_pow2(max(learned_kept, 8))
 
     import os as _os
     import sys as _sys
@@ -580,22 +759,26 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     _dbg = _os.environ.get("TIDB_TPU_DEBUG_JOIN")
     for _attempt in range(12):
         caps = [jn.cap for jn in joins]
-        key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops))
+        key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops),
+               compact_cap)
         fn = _pipe_cache_get(key)
         t0 = _time.perf_counter()
         if fn is None:
             fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
-                                  caps, capacity, key_pack, agg_meta)
+                                  caps, capacity, key_pack, agg_meta,
+                                  compact_cap=compact_cap)
             _pipe_cache_put(key, fn, dict_refs)
-        agg_out, ovf_d, sovf_d = fn(env, jidx)
+        agg_out, ovf_d, sovf_d, kept_d = fn(env, jidx)
         from .device_exec import AggFetch, resolve_topn
-        f = AggFetch(agg_out, extras=(ovf_d, sovf_d),
+        f = AggFetch(agg_out, extras=(ovf_d, sovf_d, kept_d),
                      topn=resolve_topn(agg_plan, slots))
-        overflows, span_ovfs = f.extras
+        overflows, span_ovfs, kept = f.extras
+        kept = int(kept)
         ng = f.ng
         if _dbg:
             print(f"[device_join] attempt {_attempt}: caps={caps} "
-                  f"agg_cap={capacity} totals={[int(o) for o in overflows]} "
+                  f"agg_cap={capacity} compact={compact_cap} kept={kept} "
+                  f"totals={[int(o) for o in overflows]} "
                   f"{_time.perf_counter() - t0:.2f}s",
                   file=_sys.stderr, flush=True)
         if any(bool(s) for s in span_ovfs):
@@ -621,6 +804,28 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
                 jn.exp_cap = tight
                 retry = True
             _cap_store_put((sig, jn.pos), total)
+        # profitability gates below compare against the CURRENT root cap
+        # (node caps move under shrink-to-fit; the pre-loop n_frag is stale
+        # after the first retry)
+        root_cap = root.cap if isinstance(root, _JoinNode) else n_frag
+        compact_ovf = compact_cap is not None and kept > compact_cap
+        if compact_ovf:
+            # truncated aggregate input: results (and ng) are invalid —
+            # recompile with the real kept count before anything else
+            compact_cap = dev.next_pow2(max(kept, 8))
+            if compact_cap * 2 > root_cap:
+                compact_cap = None  # not worth compacting
+            _cap_store_put((sig, "compact"), kept)
+            _fill_caps(root, sig)
+            continue
+        _cap_store_put((sig, "compact"), kept)
+        if (on_cpu and compact_cap is None
+                and dev.next_pow2(max(kept, 8)) * 2 <= root_cap
+                and root_cap > 65536):
+            # compaction newly profitable: one recompile buys an agg that
+            # works on kept rows instead of the fact length, forever
+            compact_cap = dev.next_pow2(max(kept, 8))
+            retry = True
         tight_ng = dev.next_pow2(max(ng, 16))
         if ng > capacity:
             capacity = tight_ng

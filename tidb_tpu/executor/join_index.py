@@ -74,16 +74,25 @@ def _pack_host(datas, valid, packs):
     return packed
 
 
-def build_join_index(columns) -> "JoinIndex | None":
+def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
     """Index over `columns` (utils.chunk.Column tuple, int-kinded numpy
     data), cached on columns[0]. None when the keys can't range-pack into
-    int64 (caller falls back to the device-side sort join)."""
+    int64 (caller falls back to the device-side sort join).
+
+    mask_fn/cache_tag: optional build-side FILTER — the leaf's pushed-down
+    predicates evaluated host-side (lazily, only on cache miss). A
+    filtered index drops non-qualifying rows from the CSR counts, so an
+    expansion join's capacity tracks the SELECTED rows, not the raw table
+    (TPC-H Q5's orders⋈customer leg shrinks ~7x: the date filter keeps
+    15% of orders but an unfiltered count expands all of them). The tag
+    keys the cache per predicate set; one Column can hold one index at a
+    time (queries alternating predicate sets rebuild — numpy, cheap)."""
     host = columns[0]
     # the cached tuple PINS the column objects: a live reference can never
     # share its id with a newly allocated Column, which is what makes the
     # id()-keyed composite lookup sound (same convention as the pipeline
     # cache's dict_refs, executor/device_exec.py)
-    cache_key = tuple(id(c) for c in columns)
+    cache_key = (tuple(id(c) for c in columns), cache_tag)
     cached = getattr(host, "_join_index", None)
     if cached is not None and cached[0] == cache_key:
         return cached[1]
@@ -93,6 +102,10 @@ def build_join_index(columns) -> "JoinIndex | None":
     for c in columns[1:]:
         nulls = nulls | c.nulls
     valid = ~nulls
+    if mask_fn is not None:
+        m = mask_fn()
+        if m is not None:
+            valid = valid & m
     nb = len(datas[0])
     n_valid = int(valid.sum())
 

@@ -374,12 +374,6 @@ def mpp_join_agg(agg_plan, agg_conds, child_exec, ctx, mesh):
     return _run_mpp(agg_plan, agg_conds, root, leaves, joins, ctx, mesh)
 
 
-def _leaf_ids(node):
-    if isinstance(node, _Leaf):
-        return {node.leaf_id}
-    return _leaf_ids(node.left) | _leaf_ids(node.right)
-
-
 def _build_key_leaf(node, leaves):
     """The leaf inside `node`'s build (right) subtree holding ALL of the
     right-key columns — the one a Hash exchange must repartition; None
@@ -416,7 +410,7 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
         node = root
         prev = None
         while isinstance(node, _JoinNode):
-            if target in _leaf_ids(node.right):
+            if target in node.right.leaf_ids:
                 node.left, node.right = node.right, node.left
                 node.left_keys, node.right_keys = (
                     node.right_keys, node.left_keys)
