@@ -1,0 +1,27 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import tidb_tpu
+import numpy as np, jax.numpy as jnp
+
+n, ndv, cap = 600_000, 150_000, 262_144
+rng = np.random.default_rng(0)
+key = jnp.asarray(rng.integers(1, ndv+1, n))
+val = jnp.asarray(rng.integers(100, 5000, n))
+valf = jnp.asarray(rng.random(n))
+
+def timeit(label, f, *a):
+    f(*a)
+    t0 = time.perf_counter(); r = [f(*a) for _ in range(5)]
+    jax.block_until_ready(r)
+    print(f"{label}: {(time.perf_counter()-t0)/5*1000:.1f} ms")
+
+timeit("argsort unstable", jax.jit(lambda k: jnp.argsort(k, stable=False)), key)
+timeit("argsort stable", jax.jit(lambda k: jnp.argsort(k, stable=True)), key)
+timeit("sort only", jax.jit(lambda k: jnp.sort(k)), key)
+timeit("segsum i64", jax.jit(lambda v, k: jax.ops.segment_sum(v, k, num_segments=cap)), val, key)
+timeit("segsum f64", jax.jit(lambda v, k: jax.ops.segment_sum(v, k, num_segments=cap)), valf, key)
+timeit("scatter add", jax.jit(lambda v, k: jnp.zeros(cap, jnp.int64).at[k].add(v)), val, key)
+timeit("scatter min", jax.jit(lambda v, k: jnp.full(cap, 2**62, dtype=jnp.int64).at[k].min(v)), val, key)
+timeit("scatter set", jax.jit(lambda v, k: jnp.zeros(cap, jnp.int64).at[k].set(v)), val, key)

@@ -1,0 +1,37 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import tidb_tpu
+import numpy as np, jax.numpy as jnp
+from tidb_tpu.ops import device as dev
+
+n, ndv, cap = 600_000, 150_000, 262_144
+rng = np.random.default_rng(0)
+key = jnp.asarray(rng.integers(1, ndv+1, n))
+knull = jnp.zeros(n, dtype=bool)
+val = jnp.asarray(rng.integers(100, 5000, n))
+mask = jnp.ones(n, dtype=bool)
+
+def timeit(label, f):
+    f()  # compile
+    t0 = time.perf_counter(); r = [f() for _ in range(5)]
+    jax.block_until_ready(r)
+    print(f"{label}: {(time.perf_counter()-t0)/5*1000:.1f} ms")
+
+# full kernel, packed (18 bits) vs unpacked
+timeit("agg packed", lambda: dev._agg_kernel((key,), (knull,), (val,), (knull,), mask,
+        n_keys=1, agg_ops=("sum_i",), capacity=cap, pack=((18, 0),)))
+timeit("agg unpacked", lambda: dev._agg_kernel((key,), (knull,), (val,), (knull,), mask,
+        n_keys=1, agg_ops=("sum_i",), capacity=cap, pack=None))
+# pieces
+timeit("argsort i32", lambda: jnp.argsort(key.astype(jnp.int32), stable=True))
+timeit("argsort i64", lambda: jnp.argsort(key, stable=True))
+f_topk = jax.jit(lambda x: jax.lax.top_k(-x, cap)[0])
+timeit("top_k cap", lambda: f_topk(key))
+gid = jnp.sort(key)
+f_ss = jax.jit(lambda g: (jnp.searchsorted(g, jnp.arange(cap), side="left"),
+                          jnp.searchsorted(g, jnp.arange(cap), side="right")))
+timeit("2x searchsorted cap", lambda: f_ss(gid))
+timeit("cumsum", lambda: jnp.cumsum(val))
+timeit("gather n", lambda: val[key % n])

@@ -1,0 +1,27 @@
+import os, sys, time
+import sys; sys.path.insert(0, "/root/repo")
+sys.argv = ["prof"]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import importlib
+b = importlib.import_module("bench")
+from tidb_tpu.testkit import TestKit
+tk = TestKit()
+tk.must_exec("set tidb_mem_quota_query = 0")
+b.gen_all(tk, 0.1)
+q = sys.argv[1] if len(sys.argv) > 1 else None
+for qn in (os.environ.get("PROF_Q", "q5").split(",")):
+    sql = b.QUERIES[qn]
+    print(f"===== {qn} EXPLAIN")
+    for r in tk.must_query("explain " + sql).rows:
+        print("  ", r)
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    for i in range(4):
+        t0 = time.perf_counter()
+        tk.must_query(sql)
+        print(f"  tpu run {i}: {time.perf_counter()-t0:.4f}s")
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    for i in range(2):
+        t0 = time.perf_counter()
+        tk.must_query(sql)
+        print(f"  host run {i}: {time.perf_counter()-t0:.4f}s")

@@ -1,0 +1,16 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import importlib
+b = importlib.import_module("bench")
+from tidb_tpu.testkit import TestKit
+tk = TestKit()
+tk.must_exec("set tidb_mem_quota_query = 0")
+b.gen_all(tk, 0.1)
+tk.must_exec("set tidb_executor_engine = 'tpu'")
+qn = os.environ.get("PROF_Q", "q18")
+sql = b.QUERIES[qn]
+tk.must_query(sql); tk.must_query(sql)  # warm
+for r in tk.must_query("explain analyze " + sql).rows:
+    print(r)

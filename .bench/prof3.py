@@ -1,0 +1,18 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import importlib
+b = importlib.import_module("bench")
+from tidb_tpu.testkit import TestKit
+tk = TestKit()
+tk.must_exec("set tidb_mem_quota_query = 0")
+b.gen_all(tk, 0.1)
+sub = ("select l_orderkey from lineitem group by l_orderkey "
+       "having sum(l_quantity) > 300")
+for eng in ("tpu", "host"):
+    tk.must_exec(f"set tidb_executor_engine = '{eng}'")
+    for i in range(3):
+        t0 = time.perf_counter()
+        r = tk.must_query(sub)
+        print(f"{eng} run {i}: {time.perf_counter()-t0:.4f}s rows={len(r.rows)}", flush=True)

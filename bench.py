@@ -20,8 +20,10 @@ BENCH_r02.json silently fell back to CPU after a single failed probe):
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -46,40 +48,122 @@ def _emit(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def _probe_backend(timeout_s: int, attempts: int, backoff_s: int):
-    """Initialize the default jax backend in a subprocess under a timeout.
+# The accelerator reaches this process through the axon PJRT plugin: a
+# loopback relay/tunnel serves the terminal's stateless port (8083) and
+# session port (8082). When nothing listens there, the Rust client retries
+# the dial forever — jax.devices() hangs with no error and no timeout
+# (r01-r03 burned 3x300s per round on exactly this). So the go/no-go is a
+# millisecond TCP preflight, and only a listening relay earns the (long,
+# single-shot) real init. The captured socket errors are the environmental
+# evidence the bench JSON carries either way.
 
-    Returns (platform, attempts_used): platform is 'tpu'/'axon'/... or ''
-    when every attempt errored or hung — in which case the parent process
-    must force the CPU platform before touching jax, or it would hit the
-    same failure. The tunnel is known to recover after idling, hence the
-    retry loop with backoff instead of round 2's single-shot probe.
+_RELAY_PORTS = (8083, 8082)
+
+
+def _relay_host() -> str:
+    return (os.environ.get("AXON_POOL_SVC_OVERRIDE")
+            or (os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
+                if os.environ.get("PALLAS_AXON_POOL_IPS") else "")
+            or "127.0.0.1")
+
+
+def _tcp_check(host: str, port: int, timeout_s: float = 3.0) -> dict:
+    t0 = time.time()
+    try:
+        s = socket.create_connection((host, port), timeout=timeout_s)
+        s.close()
+        return {"port": port, "open": True,
+                "ms": round((time.time() - t0) * 1000)}
+    except OSError as exc:
+        return {"port": port, "open": False, "err": f"{exc}"[:120]}
+
+
+def _probe_backend(timeout_s: int, attempts: int, backoff_s: int):
+    """Decide + initialize the accelerator backend.
+
+    Returns (platform, diag): platform '' means fall back to CPU; diag is
+    the full decision evidence for the bench JSON. Flow:
+      1. TCP preflight of the relay ports (ms, never hangs).
+      2. Ports closed → immediate CPU fallback with the refusal errors as
+         proof the failure is environmental (no relay), not the engine's.
+      3. Ports open → subprocess init probe under a generous deadline
+         (catches a half-up relay without wedging this process), then the
+         real in-process init — jax is only touched here after the probe
+         proved the path works.
     """
-    code = ("import jax; jax.device_put(1).block_until_ready(); "
-            "print('PLATFORM=' + jax.default_backend())")
+    host = _relay_host()
+    diag = {
+        "relay_host": host,
+        "env": {k: os.environ.get(k) for k in
+                ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                 "AXON_POOL_SVC_OVERRIDE", "PALLAS_AXON_TPU_GEN",
+                 "PALLAS_AXON_REMOTE_COMPILE") if os.environ.get(k)},
+    }
+    # Only an EXPLICIT cpu pin skips the preflight: with JAX_PLATFORMS
+    # unset the axon PJRT plugin is still auto-discovered and wins
+    # (tests/conftest.py documents exactly this), so an empty env var
+    # must not be read as "no accelerator"
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        diag["verdict"] = "JAX_PLATFORMS=cpu pinned; accelerator disabled"
+        return "", diag
+    _stage(f"relay preflight: {host}:{_RELAY_PORTS}")
+    checks = [_tcp_check(host, p) for p in _RELAY_PORTS]
+    diag["tcp"] = checks
+    if not any(c["open"] for c in checks):
+        diag["verdict"] = (
+            "relay ports refused — axon tunnel not serving; backend init "
+            "would hang in the client's connect-retry loop (environmental; "
+            "r01-r03 failure mode)")
+        return "", diag
+    code = ("import jax, time; t0=time.time(); "
+            "jax.device_put(1).block_until_ready(); "
+            "print('PLATFORM=%s INIT_S=%.1f' % "
+            "(jax.default_backend(), time.time()-t0))")
     for attempt in range(1, attempts + 1):
-        _stage(f"backend probe attempt {attempt}/{attempts} "
-               f"(timeout {timeout_s}s)")
+        _stage(f"backend init probe {attempt}/{attempts} "
+               f"(deadline {timeout_s}s)")
         try:
-            out = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout_s)
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            out = None
-        if out is not None and out.returncode == 0:
+            diag.setdefault("probe", []).append(
+                {"attempt": attempt, "hung_after_s": timeout_s})
+            continue
+        tail = (out.stderr or "").strip().splitlines()[-3:]
+        rec = {"attempt": attempt, "rc": out.returncode,
+               "stderr_tail": [ln[:200] for ln in tail]}
+        diag.setdefault("probe", []).append(rec)
+        if out.returncode == 0:
             for line in out.stdout.splitlines():
                 if line.startswith("PLATFORM="):
-                    return line.split("=", 1)[1], attempt
-            _stage(f"probe attempt {attempt}: rc=0 but no PLATFORM= in "
-                   f"stdout ({out.stdout.strip()[:200]!r})")
-        elif out is not None:
-            tail = (out.stderr or "").strip().splitlines()[-1:] or [""]
-            _stage(f"probe attempt {attempt} failed: {tail[0][:200]}")
-        else:
-            _stage(f"probe attempt {attempt} hung past {timeout_s}s")
+                    plat = line.split()[0].split("=", 1)[1]
+                    rec["init"] = line.strip()
+                    diag["verdict"] = "backend up"
+                    return plat, diag
         if attempt < attempts:
             time.sleep(backoff_s)
-    return "", attempts
+    diag["verdict"] = ("relay port open but backend init failed/hung — "
+                       "see probe records")
+    return "", diag
+
+
+def _start_keepwarm():
+    """Background thread dispatching a trivial op periodically so the
+    tunnel doesn't idle out between datagen and the timed runs."""
+    import jax
+
+    def loop():
+        while True:
+            try:
+                jax.device_put(1).block_until_ready()
+            except Exception:
+                return
+            time.sleep(30)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
 
 
 # ---------------------------------------------------------------------------
@@ -347,6 +431,11 @@ def time_query(tk, sql, repeats=3):
     return best, rows
 
 
+def _peak_rss_mb() -> int:
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+
 def main():
     watchdog_s = int(os.environ.get("BENCH_TIMEOUT_S", "2700"))
 
@@ -360,25 +449,37 @@ def main():
     signal.signal(signal.SIGALRM, _on_alarm)
     signal.alarm(watchdog_s)
 
-    probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "300"))
-    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
-    probe_backoff = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "90"))
-    platform, attempts_used = _probe_backend(
-        probe_s, probe_attempts, probe_backoff)
+    probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "600"))
+    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+    probe_backoff = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "60"))
+    platform, diag = _probe_backend(probe_s, probe_attempts, probe_backoff)
     fallback = False
     if not platform:
-        # Backend init failed/hung on every attempt; force the XLA CPU
-        # platform for THIS process (config.update is authoritative over
-        # plugin discovery).
+        # No working accelerator path; force the XLA CPU platform for THIS
+        # process (config.update is authoritative over plugin discovery).
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")
         platform, fallback = "cpu", True
-    _stage(f"backend: {platform}{' (fallback)' if fallback else ''} "
-           f"after {attempts_used} probe attempt(s)")
+    else:
+        # the subprocess probe proved the path; now init HERE, once, early
+        _stage(f"initializing {platform} backend in-process")
+        import jax
+        t0 = time.perf_counter()
+        jax.device_put(1).block_until_ready()
+        diag["main_init_s"] = round(time.perf_counter() - t0, 1)
+        _start_keepwarm()
+    _stage(f"backend: {platform}{' (fallback)' if fallback else ''} — "
+           f"{diag.get('verdict', '')}")
+    _emit({"metric": "bench_backend", "value": 0 if fallback else 1,
+           "unit": "device_up", "vs_baseline": 0 if fallback else 1,
+           "platform": platform, "fallback": fallback, "diag": diag})
 
-    default_sf = "1" if not fallback else "0.1"
-    sf = float(os.environ.get("BENCH_SF", default_sf))
+    # SF1 default everywhere (r03 ran SF0.1 and was flagged for it); the
+    # CPU-fallback SF1 run fits the watchdog with >15min to spare, and
+    # per-query lines stream out as they complete either way. SF10 is one
+    # BENCH_SF=10 away.
+    sf = float(os.environ.get("BENCH_SF", "1"))
     qnames = [q.strip().lower() for q in os.environ.get(
         "BENCH_QUERIES", "q1,q3,q5,q9,q18").split(",") if q.strip()]
     unknown = [q for q in qnames if q not in QUERIES]
@@ -393,15 +494,14 @@ def main():
     tk.must_exec("set tidb_mem_quota_query = 0")
     n = gen_all(tk, sf)
 
-    meta = {"platform": platform, "fallback": fallback,
-            "probe_attempts": attempts_used, "sf": sf}
+    meta = {"platform": platform, "fallback": fallback, "sf": sf}
     failures = 0
     for qname in qnames:
         sql = QUERIES[qname]
         try:
             _stage(f"{qname}: device warmup (compile + materialize)")
             tk.must_exec("set tidb_executor_engine = 'tpu'")
-            time_query(tk, sql, repeats=1)
+            warm_t, _rows = time_query(tk, sql, repeats=1)
             _stage(f"{qname}: device timed runs")
             dev_t, dev_rows = time_query(tk, sql, repeats=2)
 
@@ -430,6 +530,10 @@ def main():
             "vs_baseline": round(host_t / dev_t, 3),
             "device_s": round(dev_t, 4),
             "host_s": round(host_t, 4),
+            # warmup − steady ≈ compile + first-materialization cost; the
+            # split r03 lacked, which hid where the device seconds went
+            "compile_s": round(max(warm_t - dev_t, 0.0), 4),
+            "peak_rss_mb": _peak_rss_mb(),
             **meta,
         })
 

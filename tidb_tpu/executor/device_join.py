@@ -199,7 +199,14 @@ def _plan_strategy(jn):
     ridx = _leaf_index(jn.right, jn.right_keys)
     if ridx is not None and ridx.unique:
         return ("uniq", "right", ridx)
-    lidx = _leaf_index(jn.left, jn.left_keys)
+    lidx = None
+    if (not isinstance(jn.right, _Leaf) or not isinstance(jn.left, _Leaf)
+            or ridx is None
+            or jn.left.chunk.num_rows <= jn.right.chunk.num_rows):
+        # only index the left side when it could plausibly win: a left
+        # leaf LARGER than a non-unique right leaf is the fact side — a
+        # fact-sized argsort would buy nothing over ('expand', 'right')
+        lidx = _leaf_index(jn.left, jn.left_keys)
     if lidx is not None and lidx.unique:
         return ("uniq", "left", lidx)
     if ridx is not None:

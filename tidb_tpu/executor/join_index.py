@@ -46,7 +46,8 @@ class JoinIndex:
     """Host index over one ordered key-column tuple of a base chunk."""
 
     __slots__ = ("kind", "packs", "unique", "n_rows", "n_valid", "span",
-                 "starts", "rows", "sorted_keys", "avg_cnt", "_dev")
+                 "starts", "rows", "sorted_keys", "avg_cnt", "max_cnt",
+                 "_dev")
 
     def __init__(self):
         self._dev = None
@@ -121,7 +122,9 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
         total_span *= span
         packs.append((mn, span))
     if total_span > 2.0**62:
-        host._join_index = (cache_key, None)
+        # the negative entry must pin the columns too — id() keys are
+        # only sound while the referenced objects stay alive
+        host._join_index = (cache_key, None, tuple(columns))
         return None
 
     idx = JoinIndex()
@@ -146,7 +149,8 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
         idx.starts = starts
         idx.rows = (order[:n_valid] if n_valid else
                     np.zeros(1, dtype=np.int64)).astype(row_dt)
-        idx.unique = bool(counts.max(initial=0) <= 1)
+        idx.max_cnt = int(counts.max(initial=0))
+        idx.unique = idx.max_cnt <= 1
         idx.sorted_keys = None
         idx.avg_cnt = n_valid / max(int(np.count_nonzero(counts)), 1)
     else:
@@ -163,5 +167,12 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
         n_distinct = (1 + int(np.count_nonzero(sk[1:] != sk[:-1]))
                       if n_valid else 1)
         idx.avg_cnt = n_valid / max(n_distinct, 1)
+        if n_valid:
+            # longest equal-key run = the hottest key's row count
+            bounds = np.flatnonzero(np.concatenate(
+                ([True], sk[1:] != sk[:-1], [True])))
+            idx.max_cnt = int(np.diff(bounds).max())
+        else:
+            idx.max_cnt = 0
     host._join_index = (cache_key, idx, tuple(columns))
     return idx

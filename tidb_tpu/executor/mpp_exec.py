@@ -49,7 +49,8 @@ _MERGE_OP = {"count": "sum_i", "sum_i": "sum_i", "sum_f": "sum_f",
              "min": "min", "max": "max", "first": "first"}
 
 #: observability: fragments actually executed through the mesh path
-MPP_STATS = {"fragments": 0, "retries": 0, "shuffle_joins": 0}
+MPP_STATS = {"fragments": 0, "retries": 0, "shuffle_joins": 0,
+             "skew_broadcasts": 0}
 
 _MESH_CACHE: dict[int, object] = {}
 
@@ -446,6 +447,28 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
             if (bc_rows > 0 and build_rows > bc_rows
                     and build_rows >= n_shards):
                 shuffle_build = bleaf.leaf_id
+                # skew guard (SURVEY §7 "MPP shuffle skew"): a Hash
+                # exchange sends every row of a key to ONE shard, so a
+                # hot key turns balanced buckets into one overflowing
+                # bucket — capacity doubles chase the hottest key while
+                # the other shards idle. The host knows the hottest
+                # key's row count from the build-side join index
+                # (numpy, cached per table version); when it dwarfs the
+                # uniform share, fall back to the Broadcast exchange
+                # (reference: the planner picks Broadcast vs
+                # HashPartition by cost, exhaust_physical_plans.go MPP
+                # variants — skew is a cost input here)
+                from .device_join import _leaf_index
+                # right_keys are subtree-relative; rebase to bleaf-local
+                local = [_shift_expr(k, bottom.right.offset - bleaf.offset)
+                         for k in bottom.right_keys]
+                bidx = _leaf_index(bleaf, local)
+                if bidx is not None:
+                    even_share = max(build_rows // n_shards, 1)
+                    if bidx.max_cnt > 4 * even_share:
+                        shuffle_build = None
+                        MPP_STATS["skew_broadcasts"] = (
+                            MPP_STATS.get("skew_broadcasts", 0) + 1)
     sharded_ids = [shard_leaf] + (
         [shuffle_build] if shuffle_build is not None else [])
 

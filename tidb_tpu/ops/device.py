@@ -372,6 +372,16 @@ def _compile_func_direct(sf: ScalarFunc, cols):
         if phys_kind(target.ftype) == K_STR:
             return _compile_str_in(sf, cols)
         fa = compile_expr(target, cols)
+        if len(values) == 0:
+            # empty IN list (e.g. a HAVING-filtered subquery with no
+            # qualifying rows): constant FALSE, NULL if the list's only
+            # content was NULL — gathering from a 0-length array is a
+            # trace error
+            def f(env):
+                d, n = fa(env)
+                hit = jnp.zeros_like(d, dtype=jnp.int64)
+                return hit, n | bool(has_null)
+            return f
         sorted_vals = jnp.asarray(np.sort(np.asarray(values)))
 
         def f(env):
