@@ -103,7 +103,7 @@ class TestBindingValidation:
         e = tk.exec_error("create session binding for "
                           "select * from t where a = 3 using "
                           "select * from t where a = 3")
-        assert "no index hints" in str(e)
+        assert "no hints" in str(e)
 
     def test_mismatched_statements_rejected(self, tk):
         tk.must_exec("create table x (b int, key ib (b))")
@@ -181,3 +181,111 @@ class TestBindingPrivileges:
         tk2.must_exec("create session binding for "
                       "select * from t where a = 3 using "
                       "select * from t ignore index (ia) where a = 3")
+
+
+class TestOptimizerHints:
+    """/*+ ... */ hint comments (reference: parser/hintparser.y grammar;
+    planner honors them before cost, exhaust_physical_plans.go)."""
+
+    def setup_join(self, tk):
+        tk.must_exec("create table j1 (a bigint, b bigint, key (a))")
+        tk.must_exec("create table j2 (a bigint, c bigint)")
+        tk.must_exec("insert into j1 values " + ",".join(
+            f"({i},{i})" for i in range(1, 40)))
+        tk.must_exec("insert into j2 values " + ",".join(
+            f"({i % 20},{i})" for i in range(80)))
+
+    def test_merge_join_hint_changes_plan(self, tk):
+        self.setup_join(tk)
+        sql = ("select j1.a, sum(c) from j1, j2 where j1.a = j2.a "
+               "group by j1.a")
+        assert "MergeJoin" not in _explain(tk, sql)
+        assert "MergeJoin" in _explain(
+            tk, sql.replace("select ", "select /*+ MERGE_JOIN(j2) */ ", 1))
+
+    def test_stream_agg_hint(self, tk):
+        self.setup_join(tk)
+        sql = ("select /*+ STREAM_AGG() */ j1.a, count(*) from j1, j2 "
+               "where j1.a = j2.a group by j1.a")
+        assert "StreamAgg" in _explain(tk, sql)
+        # parity with the unhinted plan
+        plain = tk.must_query(
+            "select j1.a, count(*) from j1, j2 where j1.a = j2.a "
+            "group by j1.a order by j1.a").rows
+        hinted = tk.must_query(
+            "select /*+ STREAM_AGG() */ j1.a, count(*) from j1, j2 "
+            "where j1.a = j2.a group by j1.a order by j1.a").rows
+        assert plain == hinted
+
+    def test_unknown_hint_ignored(self, tk):
+        self.setup_join(tk)
+        rows = tk.must_query(
+            "select /*+ NO_SUCH_HINT(x) */ count(*) from j1").rows
+        assert rows == [("39",)]
+
+    def test_hints_do_not_change_digest(self, tk):
+        from tidb_tpu.parser import normalize
+        a = normalize("select /*+ HASH_JOIN(t) */ a from t")
+        b = normalize("select a from t")
+        assert a == b
+
+    def test_engine_pin_hint(self, tk):
+        self.setup_join(tk)
+        rows = tk.must_query(
+            "select /*+ READ_FROM_STORAGE(HOST(j1)) */ j1.a, sum(c) "
+            "from j1, j2 where j1.a = j2.a group by j1.a "
+            "order by j1.a").rows
+        plain = tk.must_query(
+            "select j1.a, sum(c) from j1, j2 where j1.a = j2.a "
+            "group by j1.a order by j1.a").rows
+        assert rows == plain
+
+    def test_binding_with_optimizer_hints(self, tk):
+        self.setup_join(tk)
+        sql = ("select j1.a, sum(c) from j1, j2 where j1.a = j2.a "
+               "group by j1.a")
+        tk.must_exec(
+            f"create global binding for {sql} using "
+            + sql.replace("select ",
+                          "select /*+ MERGE_JOIN(j2) STREAM_AGG() */ ", 1))
+        try:
+            plan = _explain(tk, sql)
+            assert "MergeJoin" in plan and "StreamAgg" in plan
+        finally:
+            tk.must_exec(f"drop global binding for {sql}")
+        assert "MergeJoin" not in _explain(tk, sql)
+
+
+class TestBaselineCapture:
+    def test_capture_on_second_execution_and_persistence(self, tk):
+        """reference: bindinfo/handle.go:749 auto-capture; the captured
+        record persists in the catalog, so a fresh BindHandle (restart
+        analog) still serves it."""
+        tk.must_exec("create table cap1 (a bigint, b bigint, key (a))")
+        tk.must_exec("create table cap2 (a bigint, c bigint)")
+        tk.must_exec("insert into cap1 values (1,1),(2,2)")
+        tk.must_exec("insert into cap2 values (1,5),(2,6)")
+        tk.must_exec("set global tidb_capture_plan_baselines = ON")
+        try:
+            sql = ("select cap1.a, sum(c) from cap1, cap2 "
+                   "where cap1.a = cap2.a group by cap1.a")
+            tk.must_query(sql)
+            assert not any("cap1" in str(r[0]).lower()
+                           for r in tk.must_query(
+                               "show global bindings").rows)
+            tk.must_query(sql)  # second planning triggers capture
+            binds = tk.must_query("show global bindings").rows
+            assert any("cap1" in str(r[0]).lower() for r in binds), binds
+            captured = next(r for r in binds
+                            if "cap1" in str(r[0]).lower())
+            assert "/*+" in str(captured[1])  # hinted bind text
+            # persistence: a fresh handle over the same store (restart)
+            from tidb_tpu.bindinfo import BindHandle, binding_key
+            from tidb_tpu.parser import parse
+            fresh = BindHandle(tk.session.domain)
+            fresh.load()
+            from tidb_tpu.bindinfo import normalized_sql
+            key = binding_key("test", normalized_sql(parse(sql)[0]))
+            assert fresh.match(key) is not None
+        finally:
+            tk.must_exec("set global tidb_capture_plan_baselines = OFF")

@@ -1,0 +1,100 @@
+"""EXPLAIN plan regression tests — the cmd/explaintest analog (reference:
+cmd/explaintest/t/*.test + r/*.result golden files, run-tests.sh runner).
+
+Golden plans live in tests/golden_plans/<name>.result as the exact
+EXPLAIN output. Regenerate after an intended planner change with:
+
+    GOLDEN_RECORD=1 python -m pytest tests/test_explain_golden.py
+
+(the reference regenerates with `-record` through testdata.LoadTestCases).
+A diff here means the optimizer changed a plan — deliberate changes
+update the golden file in the same commit, accidental ones are caught.
+"""
+
+import os
+import pathlib
+
+import pytest
+
+from test_tpch import make_tpch_tk
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden_plans"
+RECORD = os.environ.get("GOLDEN_RECORD") == "1"
+
+
+@pytest.fixture(scope="module")
+def tk():
+    t = make_tpch_tk(db="tpch_golden")
+    for tbl in ("lineitem", "orders", "customer", "supplier", "part",
+                "partsupp", "nation", "region"):
+        t.must_exec(f"analyze table {tbl}")
+    return t
+
+
+CASES = {
+    "q3": """
+        select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as rev,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+          and l_orderkey = o_orderkey and o_orderdate < '1995-03-15'
+          and l_shipdate > '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by rev desc, o_orderdate limit 10""",
+    "q5": """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+        from customer, orders, lineitem, supplier, nation, region
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'ASIA'
+        group by n_name order by revenue desc""",
+    "q9_shape": """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as profit
+        from part, supplier, lineitem, partsupp, nation
+        where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+          and ps_partkey = l_partkey and p_partkey = l_partkey
+          and s_nationkey = n_nationkey and p_name like '%green%'
+        group by n_name order by n_name""",
+    "point_get": "select * from region where r_regionkey = 2",
+    "index_range": """
+        select o_orderkey from orders
+        where o_custkey = 7 and o_orderdate > '1995-01-01'""",
+    "outer_join_eliminated": """
+        select o_orderkey, o_totalprice from orders
+        left join customer on o_custkey = c_custkey""",
+    "outer_join_kept": """
+        select o_orderkey, c_name from orders
+        left join customer on o_custkey = c_custkey""",
+    "max_min_topn": "select max(o_totalprice) from orders",
+    "hint_merge_join": """
+        select /*+ MERGE_JOIN(orders) */ count(1)
+        from customer, orders where c_custkey = o_custkey""",
+    "hint_stream_agg": """
+        select /*+ STREAM_AGG() */ o_custkey, count(1)
+        from orders group by o_custkey""",
+    "topn_pushdown_agg": """
+        select l_orderkey, sum(l_quantity) q from lineitem
+        group by l_orderkey order by q desc limit 5""",
+}
+
+
+def _plan_text(tk, sql):
+    rows = tk.must_query("explain " + " ".join(sql.split())).rows
+    return "\n".join(f"{name} | {info}" for name, info in rows)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_plan_golden(tk, name):
+    got = _plan_text(tk, CASES[name])
+    path = GOLDEN_DIR / f"{name}.result"
+    if RECORD or not path.exists():
+        GOLDEN_DIR.mkdir(exist_ok=True)
+        path.write_text(got + "\n")
+        if not RECORD:
+            pytest.skip(f"golden recorded: {path.name}")
+        return
+    want = path.read_text().rstrip("\n")
+    assert got == want, (
+        f"plan changed for {name!r}:\n--- golden\n{want}\n--- got\n{got}\n"
+        f"(GOLDEN_RECORD=1 regenerates if intended)")

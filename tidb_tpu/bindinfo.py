@@ -34,14 +34,17 @@ def extract_hints(stmt) -> list:
     return [(tn.name.lower(), list(tn.index_hints)) for tn in tabs]
 
 
-def apply_hints(stmt, hints: list):
+def apply_hints(stmt, hints: list, sql_hints=None):
     """Overwrite index hints positionally on the statement's TableNames
     from a binding's hint list (reference: BindHint in
     planner/optimize.go). Both statements normalize identically, so their
     traversal orders agree; names are still checked defensively. Returns
     an undo list [(TableName, original hints)] — callers must restore
     after planning, or a cached prepared AST keeps the transplant
-    forever."""
+    forever.
+
+    sql_hints: the binding's /*+ ... */ optimizer hints (join algorithm,
+    agg mode, engine pin) transplanted onto the statement head."""
     tabs = []
     _collect_tables(stmt, tabs)
     undo = []
@@ -50,12 +53,20 @@ def apply_hints(stmt, hints: list):
             continue  # structure drifted: skip rather than mis-hint
         undo.append((tn, tn.index_hints))
         tn.index_hints = [(verb, list(names)) for verb, names in h]
+    if sql_hints and hasattr(stmt, "hints"):
+        undo.append(("sql_hints", stmt, stmt.hints))
+        stmt.hints = [(n, list(a)) for n, a in sql_hints]
     return undo
 
 
 def undo_hints(undo):
-    for tn, hints in undo:
-        tn.index_hints = hints
+    for entry in undo:
+        if entry[0] == "sql_hints":
+            _tag, stmt, hints = entry
+            stmt.hints = hints
+        else:
+            tn, hints = entry
+            tn.index_hints = hints
 
 
 def binding_key(db: str, norm_sql: str) -> str:
@@ -123,8 +134,9 @@ def make_binding(original_stmt, bind_stmt, db: str = "") -> tuple[str, dict]:
     """Validate a CREATE BINDING pair and build the stored record."""
     norm_o = normalized_sql(original_stmt)
     hints = extract_hints(bind_stmt)
-    if not any(h for _t, h in hints):
-        raise TiDBError("the bound statement carries no index hints")
+    sql_hints = list(getattr(bind_stmt, "hints", []) or [])
+    if not any(h for _t, h in hints) and not sql_hints:
+        raise TiDBError("the bound statement carries no hints")
     # the hinted statement must be the same query modulo hints (reference:
     # bindinfo checks original/bind digest equality after hint stripping)
     undo = apply_hints(bind_stmt, [(t, []) for t, _h in hints])
@@ -138,6 +150,7 @@ def make_binding(original_stmt, bind_stmt, db: str = "") -> tuple[str, dict]:
            "bind": bind_stmt.restore(),
            "db": (db or "").lower(),
            "hints": [[t, [[v, list(n)] for v, n in hs]] for t, hs in hints],
+           "sql_hints": [[n, list(a)] for n, a in sql_hints],
            "created": time.strftime("%Y-%m-%d %H:%M:%S"),
            "status": "enabled"}
     return binding_key(db, norm_o), rec
@@ -148,5 +161,42 @@ def hints_from_record(rec: dict) -> list:
     if isinstance(h, dict):  # legacy by-name record
         return [(t, [(v, list(n)) for v, n in hs]) for t, hs in h.items()]
     return [(t, [(v, list(n)) for v, n in hs]) for t, hs in h]
+
+
+def sql_hints_from_record(rec: dict) -> list:
+    return [(n, list(a)) for n, a in rec.get("sql_hints", [])]
+
+
+def plan_hints(plan) -> list:
+    """Synthesize the /*+ ... */ hint set that would reproduce `plan`'s
+    physical choices — the capture payload (reference: bindinfo capture
+    stores the executed plan's hint set, handle.go:749). One hint per
+    join keyed by a build-side table name, plus the agg mode when
+    pinned."""
+    from .planner.logical import Aggregation, DataSource, Join
+    hints = []
+
+    def first_table(p):
+        if isinstance(p, DataSource):
+            return (p.alias or p.table_info.name).lower()
+        for c in p.children:
+            t = first_table(c)
+            if t:
+                return t
+        return None
+
+    def walk(p):
+        if isinstance(p, Join) and p.left_keys:
+            t = first_table(p.right)
+            if t:
+                hints.append(({"hash": "hash_join", "merge": "merge_join",
+                               "index": "inl_join"}[p.join_algo], [t]))
+        if isinstance(p, Aggregation) and p.agg_hint:
+            hints.append(("stream_agg" if p.agg_hint == "stream"
+                          else "hash_agg", []))
+        for c in p.children:
+            walk(c)
+    walk(plan)
+    return hints
 
 

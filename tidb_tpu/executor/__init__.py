@@ -2,6 +2,18 @@
 here build_executor maps logical operators to chunk-at-a-time executors whose
 hot kernels run on host numpy or device jax per the session's engine flag)."""
 
-from .exec_select import build_executor, QueryExecutor
+from .exec_select import build_executor as _build_executor_tree
+from .exec_select import QueryExecutor
+
+
+def build_executor(plan, ctx, stats=None) -> QueryExecutor:
+    """Root entry: (re)sets the statement-scoped engine pin from the
+    plan's /*+ READ_FROM_STORAGE(...) */ hint before building the tree —
+    unconditionally, so a previous statement's pin never leaks into an
+    unhinted one (the attr survives plan-cache hits because it lives on
+    the cached plan)."""
+    ctx.stmt_engine_hint = getattr(plan, "engine_hint", None)
+    return _build_executor_tree(plan, ctx, stats)
+
 
 __all__ = ["build_executor", "QueryExecutor"]

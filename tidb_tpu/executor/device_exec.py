@@ -26,6 +26,11 @@ from ..utils.chunk import Chunk, Column, np_dtype_for
 
 
 def engine_mode(ctx) -> str:
+    # a statement-scoped /*+ READ_FROM_STORAGE(...) */ pin outranks the
+    # session sysvar (set per root executor build; see executor/__init__)
+    eh = getattr(ctx, "stmt_engine_hint", None)
+    if eh:
+        return eh
     try:
         return ctx.get_sysvar("tidb_executor_engine")
     except Exception:
@@ -38,7 +43,11 @@ def want_device(ctx, n_rows: int) -> bool:
         return False
     if mode == "tpu":
         return True
-    return n_rows >= 65536  # auto: device dispatch overhead beneath this
+    try:  # auto: device dispatch overhead beneath this row floor
+        floor = int(ctx.get_sysvar("tidb_device_dispatch_rows"))
+    except Exception:
+        floor = 65536
+    return n_rows >= floor
 
 
 #: jitted fused pipelines keyed by plan signature — the whole

@@ -340,6 +340,12 @@ class HashAggExec(QueryExecutor):
         # fused device pipeline: HashAgg directly over a TableScan compiles
         # scan-filter + grouping + aggregation into one XLA program
         from .device_exec import want_device, device_agg, DeviceUnsupported
+        if getattr(p, "agg_hint", None) == "stream":
+            # /*+ STREAM_AGG() */ pins the host streaming/spillable path
+            # (reference: stream agg enforced by hint,
+            # exhaust_physical_plans.go)
+            self._mark_fragment("host", None)
+            return self._execute_host_spillable(self.children[0].execute())
         child = self.children[0]
         # look through pure projections (they fuse into the fragment)
         eff_p = p

@@ -425,6 +425,7 @@ class SelectStmt(StmtNode):
     lock_in_share_mode: bool = False
     with_ctes: list = field(default_factory=list)    # [(name, [cols], stmt)]
     with_recursive: bool = False
+    hints: list = field(default_factory=list)        # [(name, [args])] from /*+ */
 
     def restore(self):
         s = ""
@@ -435,7 +436,16 @@ class SelectStmt(StmtNode):
                 parts.append(f"`{name}`{c} AS ({stmt.restore()})")
             s += ("WITH RECURSIVE " if self.with_recursive else "WITH ") \
                 + ", ".join(parts) + " "
-        s += "SELECT " + ("DISTINCT " if self.distinct else "")
+        s += "SELECT "
+        if self.hints:
+            def arg(a):  # bracket groups re-render as parens to reparse
+                return a.replace("[", "(").replace("]", ")")
+            rendered = " ".join(
+                f"{name.upper()}({', '.join(arg(a) for a in args)})"
+                if args else f"{name.upper()}()"
+                for name, args in self.hints)
+            s += f"/*+ {rendered} */ "
+        s += "DISTINCT " if self.distinct else ""
         s += ", ".join(f.restore() for f in self.fields)
         if self.from_ is not None:
             s += " FROM " + self.from_.restore()

@@ -7,8 +7,8 @@ from __future__ import annotations
 import hashlib
 
 from .lexer import (
-    EOF, IDENT, NUM_DEC, NUM_FLOAT, NUM_INT, OP, PARAM, QIDENT, STRING,
-    SYSVAR, USERVAR, tokenize,
+    EOF, HINT, IDENT, NUM_DEC, NUM_FLOAT, NUM_INT, OP, PARAM, QIDENT,
+    STRING, SYSVAR, USERVAR, tokenize,
 )
 
 
@@ -22,6 +22,11 @@ def normalize(sql: str) -> str:
     for t in toks:
         if t.kind == EOF:
             break
+        if t.kind == HINT:
+            # hints never key the digest: a hinted and an unhinted form
+            # are the SAME statement for binding/plan-cache/summary
+            # purposes (reference: digester strips hint comments)
+            continue
         if t.kind in (NUM_INT, NUM_DEC, NUM_FLOAT, STRING, PARAM):
             # collapse IN (?, ?, ?) lists into (...)
             if prev_lit:

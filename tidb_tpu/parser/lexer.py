@@ -16,6 +16,7 @@ OP = "op"
 PARAM = "param"          # ? placeholder
 SYSVAR = "sysvar"        # @@name / @@global.name
 USERVAR = "uservar"      # @name
+HINT = "hint"            # /*+ ... */ optimizer-hint comment (raw text)
 
 _OPS = [
     "->>", "->", "<=>", "<<", ">>", "<=", ">=", "<>", "!=", ":=", "||", "&&",
@@ -54,6 +55,14 @@ def tokenize(sql: str) -> list[Token]:
             j = sql.find("*/", i + 2)
             if j < 0:
                 raise ParseError("unterminated comment")
+            if sql[i + 2:i + 3] == "+":
+                # optimizer-hint comment (reference: parser/hintparser.y;
+                # the grammar proper lives in parser._parse_hint_text) —
+                # surfaced as a token so statements can attach it; plain
+                # comments still vanish here
+                toks.append(Token(HINT, sql[i + 3:j].strip(), i))
+                i = j + 2
+                continue
             # executable comment /*! ... */ — treat contents as SQL? keep simple: skip
             i = j + 2
             continue

@@ -14,8 +14,8 @@ from ..sqltypes import (
 )
 from . import ast
 from .lexer import (
-    EOF, IDENT, NUM_DEC, NUM_FLOAT, NUM_INT, OP, PARAM, QIDENT, STRING,
-    SYSVAR, USERVAR, Token, tokenize,
+    EOF, HINT, IDENT, NUM_DEC, NUM_FLOAT, NUM_INT, OP, PARAM, QIDENT,
+    STRING, SYSVAR, USERVAR, Token, tokenize,
 )
 
 AGG_FUNCS = {
@@ -51,6 +51,66 @@ RESERVED_STOP = {
     "collate", "interval", "exists", "select", "by", "with", "window", "over",
     "duplicate", "partition", "use", "force", "ignore",
 }
+
+
+
+def _parse_hint_text(text: str):
+    """/*+ ... */ body -> [(name_lower, [arg strings])] (reference:
+    parser/hintparser.y — a separate grammar there; a hand parser over
+    the main lexer here). Args keep bracket groups intact:
+    READ_FROM_STORAGE(TPU[t1, t2]) -> ("read_from_storage", ["tpu[t1,t2]"]).
+    Malformed hint text degrades to no hints — hints must never break a
+    statement that would otherwise parse."""
+    try:
+        toks = tokenize(text)
+    except Exception:
+        return []
+    out = []
+    i = 0
+
+    def word(j):
+        t = toks[j]
+        if t.kind in (IDENT, QIDENT):
+            return str(t.val).lower()
+        if t.kind in (NUM_INT, NUM_DEC, NUM_FLOAT):
+            return str(t.val)
+        return None
+
+    n = len(toks)
+    while i < n and toks[i].kind != EOF:
+        name = word(i)
+        if name is None:
+            i += 1
+            continue
+        i += 1
+        args = []
+        if i < n and toks[i].kind == OP and toks[i].val == "(":
+            i += 1
+            depth = 1
+            cur = []
+            while i < n and toks[i].kind != EOF:
+                t = toks[i]
+                if t.kind == OP and t.val == "(":
+                    depth += 1
+                    cur.append("[")
+                elif t.kind == OP and t.val == ")":
+                    depth -= 1
+                    if depth == 0:
+                        i += 1
+                        break
+                    cur.append("]")
+                elif t.kind == OP and t.val == "," and depth == 1:
+                    if cur:
+                        args.append("".join(cur))
+                    cur = []
+                else:
+                    w = word(i)
+                    cur.append(w if w is not None else str(t.val))
+                i += 1
+            if cur:
+                args.append("".join(cur))
+        out.append((name, args))
+    return out
 
 
 class Parser:
@@ -115,7 +175,15 @@ class Parser:
     # -- entry --------------------------------------------------------------
 
     def parse(self, sql: str) -> list[ast.StmtNode]:
-        self.toks = tokenize(sql)
+        toks = tokenize(sql)
+        # hint comments only bind directly after SELECT (reference: the
+        # hint grammar hangs off specific statement heads); anywhere else
+        # they behave like plain comments — drop them so expression/DDL
+        # paths never see the token kind
+        self.toks = [t for i, t in enumerate(toks)
+                     if t.kind != HINT
+                     or (i > 0 and toks[i - 1].kind == IDENT
+                         and toks[i - 1].val.lower() == "select")]
         self.pos = 0
         self.param_count = 0
         stmts = []
@@ -383,6 +451,9 @@ class Parser:
             return sel
         self._expect_kw("select")
         sel = ast.SelectStmt()
+        if self._cur().kind == HINT:
+            sel.hints = _parse_hint_text(self._cur().val)
+            self.pos += 1
         sel.with_ctes = ctes
         sel.with_recursive = recursive
         # modifiers

@@ -657,6 +657,11 @@ class PlanBuilder:
     def build_select(self, sel: ast.SelectStmt) -> LogicalPlan:
         plan = self.build_from(sel.from_)
         from_schema = plan.schema
+        if sel.hints:
+            # optimizer hints ride on the query block's plan subtree; the
+            # optimizer collects them tree-wide (reference: hint scopes,
+            # planner/core/logical_plan_builder.go hint tables)
+            plan.sql_hints = list(sel.hints)
 
         if sel.where is not None:
             b = ExprBuilder(from_schema, self.ctx, outer=self.outer)

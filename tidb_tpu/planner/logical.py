@@ -168,9 +168,10 @@ class Aggregation(LogicalPlan):
         # a TopN above only needs this many candidate groups, so the
         # device fragment fetches just those instead of every group
         self.topn_fetch = None
+        self.agg_hint = None  # 'hash' | 'stream' from /*+ HASH_AGG/STREAM_AGG */
 
     def explain_name(self):
-        return "HashAgg"
+        return "StreamAgg" if self.agg_hint == "stream" else "HashAgg"
 
     def explain_info(self):
         return (f"group by:[{', '.join(map(repr, self.group_exprs))}], "

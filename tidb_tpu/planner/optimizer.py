@@ -25,9 +25,14 @@ def optimize(plan: LogicalPlan, ctx=None, trace=None) -> LogicalPlan:
             trace.append((rule, "\n".join(
                 f"{name} | {info}" for name, info in explain_tree(p))))
 
+    hints = collect_sql_hints(plan)
     step("initial", plan)
     plan = push_down_predicates(plan, [])
     step("predicate_push_down", plan)
+    plan = eliminate_outer_joins(plan)
+    step("outer_join_elimination", plan)
+    plan = eliminate_max_min(plan)
+    step("max_min_elimination", plan)
     plan = reorder_joins(plan, ctx)
     step("join_reorder", plan)
     plan = prune_columns(plan)
@@ -36,11 +41,194 @@ def optimize(plan: LogicalPlan, ctx=None, trace=None) -> LogicalPlan:
     step("partition_pruning", plan)
     plan = choose_access_paths(plan, ctx)
     step("access_path_selection", plan)
-    plan = choose_join_algos(plan, ctx)
+    plan = choose_join_algos(plan, ctx, hints=hints)
     step("physical_join_selection", plan)
     plan = push_topn_into_agg(plan)
     step("topn_push_down", plan)
+    if hints:
+        apply_agg_hints(plan, hints)
+        eng = engine_from_hints(hints)
+        if eng:
+            plan.engine_hint = eng
+        step("hint_application", plan)
     return plan
+
+
+#: READ_FROM_STORAGE engine names, with reference-dialect aliases so
+#: ported SQL keeps working: TiKV was the row/host engine, TiFlash the
+#: columnar accelerator engine
+_ENGINE_ALIAS = {"tpu": "tpu", "host": "host", "tpu-mpp": "tpu-mpp",
+                 "tpu_mpp": "tpu-mpp", "mpp": "tpu-mpp",
+                 "tikv": "host", "tiflash": "tpu"}
+
+
+def collect_sql_hints(plan) -> list:
+    """Union of /*+ ... */ hint lists attached by the builder across the
+    statement's query blocks (reference: planner/optimize.go hint
+    collection before rule application)."""
+    out = []
+
+    def walk(p):
+        h = getattr(p, "sql_hints", None)
+        if h:
+            out.extend(h)
+        for c in p.children:
+            walk(c)
+    walk(plan)
+    return out
+
+
+def apply_agg_hints(plan, hints):
+    """HASH_AGG / STREAM_AGG: annotate every Aggregation in scope. The
+    executor reads agg_hint — 'stream' pins the host (streaming/spillable)
+    path, 'hash' the default hash/device path (reference:
+    planner/core/exhaust_physical_plans.go agg hint enforcement)."""
+    mode = None
+    for name, _args in hints:
+        if name == "hash_agg":
+            mode = "hash"
+        elif name == "stream_agg":
+            mode = "stream"
+    if mode is None:
+        return
+
+    def walk(p):
+        if isinstance(p, Aggregation):
+            p.agg_hint = mode
+        for c in p.children:
+            walk(c)
+    walk(plan)
+
+
+def engine_from_hints(hints):
+    """READ_FROM_STORAGE(ENGINE[tables...]) → a statement-scoped engine
+    pin ('tpu' | 'host' | 'tpu-mpp'). Table lists are accepted for
+    reference-syntax compatibility; the pin applies statement-wide (the
+    engine here is a per-statement execution mode, not a per-table
+    replica choice)."""
+    for name, args in hints:
+        if name != "read_from_storage":
+            continue
+        for a in args:
+            eng = _ENGINE_ALIAS.get(a.split("[", 1)[0].strip().lower())
+            if eng:
+                return eng
+    return None
+
+
+def eliminate_max_min(plan: LogicalPlan) -> LogicalPlan:
+    """Global MAX/MIN rewrite (reference: rule_max_min_eliminate.go): a
+    group-less aggregate whose ONLY function is one MAX or MIN feeds from
+    TopN(1) over the non-null arg instead of the full input. The
+    Aggregation stays on top — over ≤1 row it still produces the NULL row
+    for empty input — so only the scan volume changes, not semantics. The
+    ordered access path (or the device TopN candidate fetch) then serves
+    the single row."""
+    from ..sqltypes import FieldType, TYPE_LONGLONG
+    from .logical import Selection as _Sel, TopN as _TopN
+
+    def visit(p):
+        for i, c in enumerate(p.children):
+            p.children[i] = visit(c)
+        if (isinstance(p, Aggregation) and not p.group_exprs
+                and len(p.aggs) == 1 and p.aggs[0].name in ("max", "min")
+                and p.aggs[0].args
+                and not isinstance(p.children[0], TopN)):
+            arg = p.aggs[0].args[0]
+            ll = FieldType(tp=TYPE_LONGLONG)
+            notnull = ScalarFunc(
+                "not", [ScalarFunc("isnull", [arg], ll)], ll)
+            inner = _Sel(p.children[0], [notnull])
+            p.children[0] = _TopN(
+                inner, [(arg, p.aggs[0].name == "max")], 0, 1)
+        return p
+
+    return visit(plan)
+
+
+def eliminate_outer_joins(plan: LogicalPlan) -> LogicalPlan:
+    """Outer-join elimination (reference: rule_join_elimination.go): a
+    LEFT join whose right side contributes no columns to anything above
+    it, and whose right keys are unique on the right table, can't change
+    the left side's rows (every left row matches at most once and
+    survives regardless) — drop the join, keep the left child. Runs
+    before join reorder/pruning; prune_columns rebuilds the schemas the
+    removal narrows."""
+
+    def right_unique(join):
+        ds = join.right
+        if not isinstance(ds, DataSource):
+            return False
+        names = set()
+        for k in join.right_keys:
+            if not isinstance(k, Column) or k.idx >= len(ds.col_infos):
+                return False
+            names.add(ds.col_infos[k.idx].name)
+        info = ds.table_info
+        if info.pk_is_handle:
+            pk = next((c.name for c in info.columns
+                       if c.id == info.pk_col_id), None)
+            if pk is not None and pk in names:
+                return True
+        for idx in info.indexes:
+            if (idx.unique and idx.columns
+                    and all(c.name in names for c in idx.columns)):
+                return True
+        return False
+
+    def visit(p, needed):
+        if isinstance(p, Join):
+            L = len(p.left.schema)
+            if (p.kind == "left" and not p.other_conds
+                    and all(i < L for i in needed)
+                    and right_unique(p)):
+                return visit(p.left, needed)
+            oc = _used(p.other_conds)
+            left_needed = ({i for i in needed if i < L}
+                           | {u for u in oc if u < L} | _used(p.left_keys))
+            right_needed = ({i - L for i in needed if i >= L}
+                            | {u - L for u in oc if u >= L}
+                            | _used(p.right_keys))
+            p.children[0] = visit(p.left, left_needed)
+            p.children[1] = visit(p.right, right_needed)
+            return p
+        if isinstance(p, Projection):
+            child_needed = set()
+            for i in needed:
+                if i < len(p.exprs):
+                    p.exprs[i].columns_used(child_needed)
+            p.children[0] = visit(p.children[0], child_needed)
+            return p
+        if isinstance(p, Selection):
+            child_needed = set(needed) | _used(p.conds)
+            p.children[0] = visit(p.children[0], child_needed)
+            return p
+        if isinstance(p, (Sort, TopN)):
+            child_needed = set(needed) | _used(
+                [e for e, _d in p.by])
+            p.children[0] = visit(p.children[0], child_needed)
+            return p
+        if isinstance(p, Limit):
+            p.children[0] = visit(p.children[0], set(needed))
+            return p
+        if isinstance(p, Aggregation):
+            child_needed = _used(p.group_exprs)
+            for d in p.aggs:
+                child_needed |= _used(d.args)
+            p.children[0] = visit(p.children[0], child_needed)
+            return p
+        # unknown operators: conservatively require every child column
+        for i, c in enumerate(p.children):
+            p.children[i] = visit(c, set(range(len(c.schema))))
+        return p
+
+    def _used(exprs):
+        s: set = set()
+        for e in exprs:
+            e.columns_used(s)
+        return s
+
+    return visit(plan, set(range(len(plan.schema))))
 
 
 def push_topn_into_agg(plan: LogicalPlan) -> LogicalPlan:

@@ -35,12 +35,56 @@ MERGE_MIN_ROWS = 4096
 INDEX_JOIN_MAX_KEYS = 65536
 
 
-def choose_join_algos(plan, ctx):
+def choose_join_algos(plan, ctx, hints=None):
     if isinstance(plan, Join):
-        _choose(plan, ctx)
+        _choose(plan, ctx, hints)
     for c in plan.children:
-        choose_join_algos(c, ctx)
+        choose_join_algos(c, ctx, hints)
     return plan
+
+
+_HINT_ALGO = {"hash_join": "hash", "merge_join": "merge",
+              "inl_join": "index", "index_join": "index"}
+
+
+def _ds_direct(plan) -> set:
+    """Lowercased name + alias when this child IS a table scan (looking
+    through filters/projections but NOT into nested joins): a join hint
+    only applies to the join the named table directly participates in
+    (reference: hints bind to their query block's join, not ancestors)."""
+    from .logical import Projection, Selection
+    p = plan
+    while isinstance(p, (Selection, Projection)):
+        p = p.children[0]
+    out = set()
+    if isinstance(p, DataSource):
+        out.add(p.table_info.name.lower())
+        if p.alias:
+            out.add(p.alias.lower())
+    return out
+
+
+def _hint_algo(join, hints):
+    """First join-algorithm hint naming a DIRECT child table of this join
+    wins (reference: planner/core/exhaust_physical_plans.go honors
+    HASH_JOIN/MERGE_JOIN/INL_JOIN before cost). Returns (algo, matched
+    names on right side, matched on left) or None."""
+    if not hints:
+        return None
+    left_names = right_names = None
+    for name, args in hints:
+        algo = _HINT_ALGO.get(name)
+        if algo is None:
+            continue
+        if left_names is None:
+            left_names = _ds_direct(join.left)
+            right_names = _ds_direct(join.right)
+        wanted = {a.split("[", 1)[0] for a in args}
+        mr = wanted & right_names
+        ml = wanted & left_names
+        if mr or ml:
+            return algo, mr, ml
+    return None
 
 
 def _primitive(ft) -> bool:
@@ -90,12 +134,38 @@ def _inner_index(join):
     return best
 
 
-def _choose(join: Join, ctx):
+def _choose(join: Join, ctx, hints=None):
     join.join_algo = "hash"
     join.index_join = None
     if not join.left_keys or join.kind not in ("inner", "left", "semi",
                                                "anti"):
         return
+    hit = _hint_algo(join, hints)
+    if hit is not None:
+        forced, matched_right, _matched_left = hit
+        if forced == "hash":
+            return
+        if forced == "merge":
+            # executor constraint: the merge matcher needs one primitive
+            # key; an ineligible hint degrades to hash rather than
+            # erroring (reference: a non-applicable hint warns, drops)
+            if (len(join.left_keys) == 1
+                    and _primitive(join.left_keys[0].ftype)
+                    and _primitive(join.right_keys[0].ftype)):
+                join.join_algo = "merge"
+            return
+        if forced == "index":
+            # INL_JOIN(t) makes t the lookup (inner) side; that side is
+            # structurally the right child here, so a hint naming only
+            # the left table degrades like other non-applicable hints
+            # (reference warns and drops them too) — forcing it on the
+            # wrong side would invert the hint's meaning
+            if matched_right:
+                desc = _inner_index(join)
+                if desc is not None:
+                    join.join_algo = "index"
+                    join.index_join = desc
+            return
     outer_est = _est_rows(join.left, ctx)
     inner_est = _est_rows(join.right, ctx)
 

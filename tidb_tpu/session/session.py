@@ -61,6 +61,7 @@ class Domain:
         self.reload_schema()
         from ..bindinfo import BindHandle
         self.bind_handle = BindHandle(self)    # global plan bindings
+        self.capture_counts: dict[str, int] = {}  # baseline capture tally
         from ..plugin import PluginRegistry
         self.plugins = PluginRegistry(self)    # audit/auth plugin SPI
         from ..telemetry import Telemetry
@@ -1238,13 +1239,63 @@ class Session:
             self.plan_builds += 1
             builder = PlanBuilder(self._expr_ctx, outer=outer)
             plan = builder.build(stmt)
-            return optimize(plan, self._expr_ctx)
+            plan = optimize(plan, self._expr_ctx)
+            if outer is None and isinstance(stmt, ast.SelectStmt):
+                self._maybe_capture_baseline(stmt, plan)
+            return plan
         finally:
             if undo:
                 from ..bindinfo import undo_hints
                 # restore the AST: prepared statements re-plan the same
                 # object, and a dropped binding must stop applying
                 undo_hints(undo)
+
+    def _maybe_capture_baseline(self, stmt, plan):
+        """Plan-baseline auto capture (reference: bindinfo/handle.go:749
+        via the statement summary): with tidb_capture_plan_baselines on, a
+        SELECT planned twice gets a GLOBAL binding recording the plan's
+        synthesized hint set, so the choice survives restarts and stats
+        drift."""
+        try:
+            if self._internal or self.binding_used is not None:
+                return
+            if str(self.get_sysvar(
+                    "tidb_capture_plan_baselines")).upper() not in (
+                        "ON", "1"):
+                return
+            if stmt.from_ is None:
+                return
+            from ..bindinfo import binding_key, normalized_sql, plan_hints
+            norm = normalized_sql(stmt)
+            key = binding_key(self.current_db(), norm)
+            if self.domain.bind_handle.match(key) is not None:
+                return
+            seen = self.domain.capture_counts
+            if len(seen) > 4096 and key not in seen:
+                seen.clear()  # bounded tally; a cleared count just delays
+                #               a capture by one extra planning
+            seen[key] = seen.get(key, 0) + 1
+            if seen[key] < 2:  # reference captures on the second execution
+                return
+            hints = plan_hints(plan)
+            if not hints:
+                return
+            orig_text = stmt.restore()
+            saved = stmt.hints
+            try:  # render the bind text WITH the captured hints
+                stmt.hints = hints
+                bind_text = stmt.restore()
+            finally:
+                stmt.hints = saved
+            rec = {"original": orig_text, "bind": bind_text,
+                   "db": self.current_db().lower(),
+                   "hints": [], "sql_hints": [[n, list(a)]
+                                              for n, a in hints],
+                   "created": time.strftime("%Y-%m-%d %H:%M:%S"),
+                   "status": "enabled", "source": "capture"}
+            self.domain.bind_handle.create(key, rec)
+        except Exception:
+            pass  # capture must never fail the statement
 
     def _apply_binding(self, stmt):
         """Plan-binding match at optimize time (reference:
@@ -1262,7 +1313,9 @@ class Session:
             rec = self.domain.bind_handle.match(key)
         if rec is not None and rec.get("status") == "enabled":
             self.binding_used = key
-            return apply_hints(stmt, hints_from_record(rec))
+            from ..bindinfo import sql_hints_from_record
+            return apply_hints(stmt, hints_from_record(rec),
+                               sql_hints_from_record(rec))
         return None
 
     def run_built_query(self, logical_plan) -> Result:
@@ -1324,7 +1377,13 @@ class Session:
             digest = sql_digest(stmt.restore())
             stmt._pc_digest = digest
         params = self._expr_ctx.params
-        key = (digest, self._db,
+        # the digest deliberately strips /*+ ... */ (bindings match the
+        # unhinted form), so the cache key must carry the hint set
+        # explicitly — otherwise a hinted and an unhinted prepared
+        # statement share one entry and the hint leaks across them
+        hint_fp = tuple(
+            (n, tuple(a)) for n, a in getattr(stmt, "hints", []) or [])
+        key = (digest, self._db, hint_fp,
                self.infoschema().version, self.domain.stats_version,
                self.domain.bind_handle.version, self.bindings_version,
                self.temp_tables_version, pc.param_kinds(params))
