@@ -436,7 +436,52 @@ def _peak_rss_mb() -> int:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
 
 
+def quick_main():
+    """`python bench.py --quick` — the bench-daily analog (reference:
+    Makefile:275-282 bench-daily + util/benchdaily): SF0.01 Q1+Q3 on the
+    CPU backend in ~30s, one JSON line per query APPENDED to
+    bench_history.jsonl (committed), so per-commit regressions like
+    r03's Q1 dip are visible in-round from the file's history."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import subprocess
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    tk = TestKit()
+    tk.must_exec("set tidb_mem_quota_query = 0")
+    n = gen_all(tk, 0.01)
+    git_rev = ""
+    try:
+        git_rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:
+        pass
+    hist = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_history.jsonl")
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(hist, "a") as f:
+        for qname in ("q1", "q3"):
+            sql = QUERIES[qname]
+            tk.must_exec("set tidb_executor_engine = 'tpu'")
+            time_query(tk, sql, repeats=1)           # compile
+            dev_t, dev_rows = time_query(tk, sql, repeats=3)
+            tk.must_exec("set tidb_executor_engine = 'host'")
+            host_t, host_rows = time_query(tk, sql, repeats=2)
+            line = {"metric": f"quick_{qname}", "value": round(n / dev_t),
+                    "unit": "lineitem_rows/s",
+                    "vs_baseline": round(host_t / dev_t, 3),
+                    "device_s": round(dev_t, 4), "host_s": round(host_t, 4),
+                    "parity": dev_rows == host_rows,
+                    "rev": git_rev, "at": stamp}
+            _emit(line)
+            f.write(json.dumps(line) + "\n")
+
+
 def main():
+    if "--quick" in sys.argv:
+        quick_main()
+        return
     watchdog_s = int(os.environ.get("BENCH_TIMEOUT_S", "2700"))
 
     def _on_alarm(signum, frame):

@@ -19,8 +19,9 @@ def q1(tk, expr):
 
 
 def test_registry_size():
-    # VERDICT round-1 target: >= 150 registered builtins
-    assert len(supported_scalar_ops()) >= 150
+    # VERDICT round-3 target: >= 250 registered builtins
+    # (reference registry: 281, expression/builtin.go:573)
+    assert len(supported_scalar_ops()) >= 250
 
 
 # -- string ------------------------------------------------------------------
@@ -429,3 +430,204 @@ def test_regexp_replace_pos_occurrence(tk):
     assert q1(tk, "regexp_replace('abcabc', 'b', 'X', 1)") == "aXcaXc"
     assert q1(tk, "regexp_replace('abcabc', 'b', 'X', 1, 2)") == "abcaXc"
     assert q1(tk, "regexp_replace('abcabc', 'b', 'X', 4)") == "abcaXc"
+
+
+# -- breadth batch r4 --------------------------------------------------------
+
+def test_truncate(tk):
+    assert q1(tk, "truncate(123.4567, 2)") == "123.45"
+    assert q1(tk, "truncate(-123.4567, 1)") == "-123.4"
+
+
+def test_interval_fn(tk):
+    # the bare INTERVAL keyword is claimed by the date-arith grammar;
+    # exercise the function through the dispatch layer directly
+    # (reference: MySQL disambiguates in its grammar too)
+    import numpy as np
+    from tidb_tpu.expression.core import _DISPATCH, Constant, ScalarFunc
+    from tidb_tpu.sqltypes import FieldType, TYPE_LONGLONG
+    from tidb_tpu.utils.chunk import Chunk, Column
+    ll = FieldType(tp=TYPE_LONGLONG)
+    one = Chunk([Column(ll, np.zeros(1, dtype=np.int64))])
+    sf = ScalarFunc("interval", [Constant(v, ll)
+                                 for v in (23, 1, 15, 17, 30, 44, 200)], ll)
+    d, n = _DISPATCH["interval"](sf, one)
+    assert int(d[0]) == 3 and not n[0]
+
+
+def test_convert_tz(tk):
+    assert q1(tk, "convert_tz('2004-01-01 12:00:00', '+00:00', '+10:00')"
+              ) == "2004-01-01 22:00:00"
+    assert q1(tk, "convert_tz('2004-01-01 12:00:00', 'bogus', '+10:00')"
+              ) is None
+
+
+def test_to_seconds(tk):
+    assert q1(tk, "to_seconds('1970-01-01 00:00:01')") == "62167219201"
+
+
+def test_json_search(tk):
+    assert q1(tk, "json_search('[\"abc\", {\"x\": \"abc\"}]', 'one', 'abc')"
+              ) == '"$[0]"'
+    assert q1(tk, "json_search('[\"q\"]', 'one', 'abc')") is None
+
+
+def test_json_overlaps(tk):
+    assert q1(tk, "json_overlaps('[1,3,5]', '[2,5,7]')") == "1"
+    assert q1(tk, "json_overlaps('[1,3]', '[2,7]')") == "0"
+
+
+def test_json_pretty(tk):
+    assert "\n" in q1(tk, "json_pretty('{\"a\": 1}')")
+
+
+def test_json_storage_size(tk):
+    assert int(q1(tk, "json_storage_size('{\"a\": 1}')")) > 0
+
+
+def test_json_merge_preserve(tk):
+    assert q1(tk, "json_merge_preserve('[1]', '[2]')") == "[1, 2]"
+    assert q1(tk, "json_merge('{\"a\": 1}', '{\"a\": 2}')"
+              ) == '{"a": [1, 2]}'
+
+
+def test_json_array_insert(tk):
+    assert q1(tk, "json_array_insert('[1, 3]', '$[1]', 2)") == "[1, 2, 3]"
+
+
+def test_json_member_of(tk):
+    assert q1(tk, "json_member_of('3', '[1, 3, 5]')") == "1"
+
+
+def test_json_value(tk):
+    assert q1(tk, "json_value('{\"a\": {\"b\": 7}}', '$.a.b')") == "7"
+
+
+def test_name_const_any_value(tk):
+    assert q1(tk, "name_const('k', 42)") == "42"
+    assert q1(tk, "any_value(9)") == "9"
+
+
+def test_load_file(tk):
+    assert q1(tk, "load_file('/etc/passwd')") is None
+
+
+def test_validate_password_strength(tk):
+    assert int(q1(tk, "validate_password_strength('Ab1!efgh')")) == 100
+    assert int(q1(tk, "validate_password_strength('ab')")) == 0
+
+
+def test_charset_collation_coercibility(tk):
+    assert q1(tk, "charset('x')") == "utf8mb4"
+    assert q1(tk, "collation('x')") == "utf8mb4_bin"
+    assert q1(tk, "coercibility('x')") == "2"
+
+
+def test_advisory_locks(tk):
+    assert q1(tk, "get_lock('l1', 0)") == "1"
+    assert q1(tk, "is_free_lock('l1')") == "0"
+    assert q1(tk, "is_used_lock('l1')") is not None
+    assert q1(tk, "release_lock('l1')") == "1"
+    assert q1(tk, "is_free_lock('l1')") == "1"
+    assert q1(tk, "release_lock('l1')") is None
+
+
+def test_date_add_sub_fn(tk):
+    assert q1(tk, "date_add('2020-01-31', interval 1 month)"
+              ).startswith("2020-02-29")
+    assert q1(tk, "date_sub('2020-03-01', interval 1 day)"
+              ).startswith("2020-02-29")
+    assert q1(tk, "adddate('2020-01-01', 5)").startswith("2020-01-06")
+    assert q1(tk, "subdate('2020-01-06', 5)").startswith("2020-01-01")
+    assert q1(tk, "date_arith_fn('2020-01-31', 1, 'month')"
+              ) == "2020-02-29"
+
+
+def test_localtime_shapes(tk):
+    assert len(q1(tk, "localtime()")) == 19
+    assert len(q1(tk, "current_time()")) == 8
+    assert len(q1(tk, "utc_date()")) == 10
+    assert len(q1(tk, "utc_time()")) == 8
+
+
+def test_position(tk):
+    assert q1(tk, "position('b' in 'abc')") == "2"
+
+
+def test_gtid_functions(tk):
+    assert q1(tk, "gtid_subset('a:1-3', 'a:1-5')") == "1"
+    assert q1(tk, "gtid_subset('a:7', 'a:1-5')") == "0"
+    assert q1(tk, "gtid_subtract('a:1-5', 'a:2-3')") == "a:1:4-5"
+    assert q1(tk, "wait_for_executed_gtid_set('a:1', 0)") == "0"
+    d = q1(tk, "tidb_encode_sql_digest('select 1')")
+    assert len(d) == 64
+
+
+def test_tidb_info_funcs(tk):
+    assert "tpu-htap" in q1(tk, "tidb_version()")
+    assert q1(tk, "tidb_is_ddl_owner()") == "1"
+    assert q1(tk, "tidb_parse_tso(0)") is None
+    assert q1(tk, "tidb_parse_tso(449348000000000000)").startswith("2")
+    assert 0 <= int(q1(tk, "tidb_shard(99)")) < 256
+    assert q1(tk, "master_pos_wait('f', 'p', 0)") is None
+
+
+def test_format_nano_time(tk):
+    assert q1(tk, "format_nano_time(1500000)") == "1.50ms"
+
+
+def test_tidb_decode_key(tk):
+    from tidb_tpu.tablecodec import record_key
+    import binascii
+    hexkey = binascii.hexlify(record_key(11, 7)).decode()
+    assert '"table_id": 11' in q1(tk, f"tidb_decode_key('{hexkey}')")
+
+
+def test_aliases(tk):
+    assert q1(tk, "ceiling(1.2)") == q1(tk, "ceil(1.2)")
+    assert q1(tk, "power(2, 10)") == "1024"
+    assert q1(tk, "substr('hello', 2, 3)") == "ell"
+    assert q1(tk, "sha('x')") == q1(tk, "sha1('x')")
+
+
+def test_truncate_exact_decimal(tk):
+    assert q1(tk, "truncate(0.29, 2)") == "0.29"
+
+
+def test_json_search_literal_star(tk):
+    assert q1(tk, "json_search('[\"ab\"]', 'one', 'a*')") is None
+    assert q1(tk, "json_search('[\"a*\"]', 'one', 'a*')") == '"$[0]"'
+
+
+def test_json_overlaps_objects(tk):
+    assert q1(tk, 'json_overlaps(\'{"a":1,"b":2}\', \'{"a":1}\')') == "1"
+    assert q1(tk, 'json_overlaps(\'{"a":1}\', \'{"a":2}\')') == "0"
+
+
+def test_convert_tz_unsigned_rejected(tk):
+    assert q1(tk, "convert_tz('2004-01-01 12:00:00', '+00:00', '10:00')"
+              ) is None
+
+
+def test_advisory_locks_per_session(tk):
+    from tidb_tpu.session import new_session
+    s2 = new_session(tk.session.domain)
+    assert q1(tk, "get_lock('xs', 0)") == "1"
+    r2 = None
+    for r in s2.execute("select get_lock('xs', 0)"):
+        r2 = r.rows[0][0]
+    assert r2 == "0"  # a DIFFERENT session on the same thread can't take it
+    for r in s2.execute("select release_lock('xs')"):
+        assert r.rows[0][0] == "0"  # nor release it
+    assert q1(tk, "release_lock('xs')") == "1"
+
+
+def test_release_all_locks(tk):
+    assert q1(tk, "get_lock('ra1', 0)") == "1"
+    assert q1(tk, "get_lock('ra2', 0)") == "1"
+    assert int(q1(tk, "release_all_locks()")) >= 2
+    assert q1(tk, "is_free_lock('ra1')") == "1"
+
+
+def test_ps_current_thread_id(tk):
+    assert int(q1(tk, "ps_current_thread_id()")) > 0

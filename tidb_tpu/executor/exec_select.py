@@ -120,6 +120,14 @@ def resolve_access_handles(tbl, access) -> list:
             if h is not None:
                 out.append(h)
         return out
+    if kind == "index_merge":
+        # UNION of the partial paths' handle sets (reference:
+        # executor/index_merge_reader.go union mode); sorted-unique keeps
+        # the fetch order deterministic
+        seen = set()
+        for sub in access[1]:
+            seen.update(resolve_access_handles(tbl, sub))
+        return sorted(seen)
     _k, idx, lo, hi = access
     return tbl.index_scan_handles(idx, lo_vals=lo, hi_vals=hi)
 

@@ -224,7 +224,15 @@ _STR_FUNCS = {"concat", "concat_ws", "upper", "lower", "substring", "trim",
               "password", "make_set", "export_set", "timediff",
               "timestampadd", "time", "timestamp", "time_format",
               "get_format", "uuid_to_bin", "bin_to_uuid", "format_bytes",
-              "inet6_aton", "inet6_ntoa", "weight_string"}
+              "inet6_aton", "inet6_ntoa", "weight_string",
+              "convert_tz", "json_search", "json_pretty",
+              "json_merge_preserve", "json_merge", "json_array_insert",
+              "json_append", "json_value", "load_file", "charset",
+              "collation", "localtime", "localtimestamp", "current_time",
+              "curtime", "utc_date", "utc_time", "tidb_version",
+              "tidb_parse_tso", "tidb_decode_key", "format_nano_time",
+              "master_pos_wait", "date_arith_fn", "substr", "sha",
+              "gtid_subtract", "tidb_encode_sql_digest"}
 _INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
               "dayofmonth", "hour", "minute", "second", "quarter", "week",
               "dayofweek", "dayofyear", "extract", "datediff", "sign",
@@ -238,7 +246,14 @@ _INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
               "json_contains_path", "regexp_like", "regexp_instr",
               "octet_length", "uncompressed_length", "uuid_short",
               "is_uuid", "benchmark", "is_ipv4_compat", "is_ipv4_mapped",
-              "is_ipv4", "is_ipv6", "inet_aton", "sleep"}
+              "is_ipv4", "is_ipv6", "inet_aton", "sleep",
+              "interval", "to_seconds", "json_overlaps",
+              "json_storage_size", "json_member_of",
+              "validate_password_strength", "coercibility", "get_lock",
+              "release_lock", "is_free_lock", "is_used_lock",
+              "tidb_is_ddl_owner", "tidb_shard", "gtid_subset",
+              "release_all_locks", "ps_current_thread_id",
+              "wait_for_executed_gtid_set"}
 _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "pow", "power", "rand",
                 "radians", "degrees", "sin", "cos", "tan", "atan", "asin",
                 "acos", "pi", "atan2", "cot", "log"}
@@ -742,13 +757,14 @@ class ExprBuilder:
             return ScalarFunc(name, args, ft)
         if name in ("truncate",):
             args = [self.build(a) for a in node.args]
-            nd = args[1].value if isinstance(args[1], Constant) else 0
-            src_ft = args[0].ftype
-            if phys_kind(src_ft) == K_DEC:
-                ft = FieldType(tp=TYPE_NEWDECIMAL, flen=30, decimal=max(min(nd, src_ft.scale), 0))
-            else:
-                ft = src_ft.clone()
-            return ScalarFunc("round", args, ft)  # close enough for now
+            return ScalarFunc("truncate", args, FieldType(tp=TYPE_DOUBLE))
+        if name == "name_const":
+            # NAME_CONST(name, value) evaluates to its value with the
+            # value's own type (reference: builtin_miscellaneous.go)
+            args = [self.build(a) for a in node.args]
+            return args[1]
+        if name == "any_value":
+            return self.build(node.args[0])
         if name == "round":
             args = [self.build(a) for a in node.args]
             nd = 0

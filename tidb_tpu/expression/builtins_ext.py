@@ -1068,11 +1068,419 @@ _GET_FORMATS = {
 }
 
 
+# -- breadth batch 2 (r4): the remaining registry gap vs builtin.go:573 ------
+
+def _truncate_num(v, places):
+    # Decimal, not float: trunc(0.29 * 100) is 28 in binary floating
+    # point — digit-exact truncation needs exact decimal scaling
+    from decimal import Decimal, ROUND_DOWN
+    q = Decimal(1).scaleb(-int(places))
+    return float(Decimal(repr(float(v))).quantize(q, rounding=ROUND_DOWN))
+
+
+def _interval_fn(n, *bounds):
+    if n is None:
+        return -1
+    i = 0
+    for b in bounds:
+        if b is not None and float(n) < float(b):
+            break
+        i += 1
+    return i
+
+
+def _convert_tz(dt, frm, to):
+    def off(z):
+        z = _u(z).strip().upper()
+        if z in ("SYSTEM", "UTC", "GMT"):
+            return _dt.timedelta(0)
+        if not z.startswith(("+", "-")):
+            return None  # offsets must be signed; named zones unsupported
+        sign = 1 if z.startswith("+") else -1
+        try:
+            hh, mm = z[1:].split(":")
+            return sign * _dt.timedelta(hours=int(hh), minutes=int(mm))
+        except Exception:
+            return None
+    a, b = off(frm), off(to)
+    if a is None or b is None:
+        return None
+    return (dt - a + b).strftime("%Y-%m-%d %H:%M:%S").encode()
+
+
+def _to_seconds(dt):
+    return ((dt.date() - _dt.date(1, 1, 1)).days + 366) * 86400 + \
+        dt.hour * 3600 + dt.minute * 60 + dt.second
+
+
+def _json_search(doc_b, one_all, target, *rest):
+    doc = _json_load(doc_b)
+    mode = _u(one_all).lower()
+    if mode not in ("one", "all"):
+        raise ValueError("json_search mode")
+    import re as _re
+    # MySQL wildcard semantics: ONLY % and _ are wildcards; everything
+    # else (incl. * ? [ ]) is literal
+    pat = _re.compile("^" + "".join(
+        ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+        for ch in _u(target)) + "$", _re.S)
+    hits = []
+
+    def rec(v, path):
+        if isinstance(v, str) and pat.match(v):
+            hits.append(path)
+        elif isinstance(v, dict):
+            for k, c in v.items():
+                rec(c, f'{path}."{k}"' if ("." in k or " " in k)
+                    else f"{path}.{k}")
+        elif isinstance(v, list):
+            for i, c in enumerate(v):
+                rec(c, f"{path}[{i}]")
+    rec(doc, "$")
+    if not hits:
+        return None
+    if mode == "one":
+        return _json.dumps(hits[0]).encode()
+    return _json_dump(hits if len(hits) > 1 else hits[0])
+
+
+def _json_overlaps(a_b, b_b):
+    a, b = _json_load(a_b), _json_load(b_b)
+    if isinstance(a, dict) and isinstance(b, dict):
+        # two objects overlap on any shared key-value PAIR
+        return int(any(k in b and b[k] == v for k, v in a.items()))
+    la = a if isinstance(a, list) else [a]
+    lb = b if isinstance(b, list) else [b]
+    return int(any(x == y for x in la for y in lb))
+
+
+def _json_merge_preserve(*docs):
+    def merge(a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = merge(out[k], v) if k in out else v
+            return out
+        la = a if isinstance(a, list) else [a]
+        lb = b if isinstance(b, list) else [b]
+        return la + lb
+    cur = _json_load(docs[0])
+    for d in docs[1:]:
+        cur = merge(cur, _json_load(d))
+    return _json_dump(cur)
+
+
+def _json_array_insert(doc_b, *pairs):
+    doc = _json_load(doc_b)
+    for i in range(0, len(pairs), 2):
+        toks = _json_path_tokens(pairs[i])
+        if not toks or toks[-1][0] != "idx":
+            raise ValueError("json_array_insert needs an array-cell path")
+        val = _to_json_value(pairs[i + 1])
+        parent_toks, (_k, pos) = toks[:-1], toks[-1]
+        cur = doc
+        ok = True
+        for t, v in parent_toks:
+            if t == "key" and isinstance(cur, dict) and v in cur:
+                cur = cur[v]
+            elif t == "idx" and isinstance(cur, list) and v < len(cur):
+                cur = cur[v]
+            else:
+                ok = False
+                break
+        if ok and isinstance(cur, list):
+            cur.insert(min(pos, len(cur)), val)
+    return _json_dump(doc)
+
+
+def _json_value(doc_b, path_b):
+    doc = _json_load(doc_b)
+    v, ok = _json_path_get(doc, path_b)
+    if not ok or v is None:
+        return None
+    if isinstance(v, (dict, list)):
+        return _json_dump(v)
+    if isinstance(v, bool):
+        return b"true" if v else b"false"
+    return str(v).encode()
+
+
+def _password_strength(p):
+    s = _u(p)
+    if len(s) < 4:
+        return 0
+    if len(s) < 8:
+        return 25
+    score = 25
+    if any(c.isdigit() for c in s):
+        score += 25
+    if any(c.islower() for c in s) and any(c.isupper() for c in s):
+        score += 25
+    if any(not c.isalnum() for c in s):
+        score += 25
+    return score
+
+
+_MORE_FUNCS = {
+    "truncate": _pyfn("fi", _truncate_num, out="f"),
+    "interval": _pyfn("ff*", _interval_fn, out="i",
+                      null_propagate=False),
+    "convert_tz": _pyfn("dss", _convert_tz),
+    "to_seconds": _pyfn("d", _to_seconds, out="i"),
+    "utc_date": _pyfn("", lambda: _dt.datetime.utcnow().strftime(
+        "%Y-%m-%d").encode()),
+    "utc_time": _pyfn("", lambda: _dt.datetime.utcnow().strftime(
+        "%H:%M:%S").encode()),
+    "json_search": _pyfn("sss*", _json_search),
+    "json_overlaps": _pyfn("ss", _json_overlaps, out="i"),
+    "json_pretty": _pyfn("s", lambda b: _json.dumps(
+        _json_load(b), indent=2, ensure_ascii=False).encode()),
+    "json_storage_size": _pyfn("s", lambda b: len(_json.dumps(
+        _json_load(b), separators=(",", ":"))), out="i"),
+    "json_merge_preserve": _pyfn("ss*", _json_merge_preserve),
+    "json_array_insert": _pyfn("ssr*", _json_array_insert),
+    "json_member_of": _pyfn("ss", lambda v, arr: int(
+        _json_load(v) in (lambda a: a if isinstance(a, list) else [a])(
+            _json_load(arr))), out="i"),
+    "json_value": _pyfn("ss", _json_value),
+    # name_const/any_value resolve in the BUILDER (to the value
+    # expression itself) — no dispatch entries, one implementation
+    "load_file": _pyfn("s", lambda _p: None),  # FILE priv never granted
+    "validate_password_strength": _pyfn("s", _password_strength, out="i"),
+    "charset": _pyfn("r", lambda _v: b"utf8mb4"),
+    "collation": _pyfn("r", lambda _v: b"utf8mb4_bin"),
+    "coercibility": _pyfn("r", lambda _v: 2, out="i"),
+}
+
+# -- advisory locks (reference: builtin_miscellaneous.go GET_LOCK et al.;
+# single-process engine = the cross-session lock table IS process-global) --
+
+import threading as _threading
+
+_USER_LOCKS: dict = {}          # name -> (owner token, count)
+_USER_LOCKS_MU = _threading.Lock()
+
+#: current lock owner: the SESSION sets its identity here around each
+#: statement (session.execute) — advisory locks are per-connection in
+#: MySQL, and an in-process embedding serves many sessions per thread
+_LOCK_OWNER = _threading.local()
+
+
+def set_lock_owner(token):
+    _LOCK_OWNER.token = token
+
+
+def _owner():
+    return getattr(_LOCK_OWNER, "token", None) or _threading.get_ident()
+
+
+def _get_lock(name, _timeout):
+    me = _owner()
+    with _USER_LOCKS_MU:
+        cur = _USER_LOCKS.get(_u(name))
+        if cur is None or cur[0] == me:
+            _USER_LOCKS[_u(name)] = (me, (cur[1] + 1) if cur else 1)
+            return 1
+    return 0  # held elsewhere; no blocking wait (timeout honored as 0)
+
+
+def _release_lock(name):
+    me = _owner()
+    with _USER_LOCKS_MU:
+        cur = _USER_LOCKS.get(_u(name))
+        if cur is None:
+            return None
+        if cur[0] != me:
+            return 0
+        if cur[1] > 1:
+            _USER_LOCKS[_u(name)] = (me, cur[1] - 1)
+        else:
+            del _USER_LOCKS[_u(name)]
+        return 1
+
+
+def _release_all_locks():
+    me = _owner()
+    with _USER_LOCKS_MU:
+        mine = [k for k, (o, _c) in _USER_LOCKS.items() if o == me]
+        n = sum(_USER_LOCKS[k][1] for k in mine)
+        for k in mine:
+            del _USER_LOCKS[k]
+    return n
+
+
+def _is_free_lock(name):
+    with _USER_LOCKS_MU:
+        return int(_u(name) not in _USER_LOCKS)
+
+
+def _is_used_lock(name):
+    with _USER_LOCKS_MU:
+        cur = _USER_LOCKS.get(_u(name))
+        return cur[0] if cur else None
+
+
+def _date_arith_std(dt, n, unit, sign):
+    """DATE_ADD/DATE_SUB as standalone registry entries (the parser's
+    INTERVAL syntax routes through core._eval_date_arith; these serve the
+    function-call forms)."""
+    unit = _u(unit).lower() if isinstance(unit, (bytes, bytearray)) else unit
+    days = {"day": 1, "week": 7}.get(unit)
+    if days is not None:
+        out = dt + _dt.timedelta(days=sign * int(n) * days)
+    elif unit in ("hour", "minute", "second"):
+        out = dt + _dt.timedelta(**{unit + "s": sign * int(n)})
+    elif unit in ("month", "quarter", "year"):
+        months = sign * int(n) * {"month": 1, "quarter": 3, "year": 12}[unit]
+        y = dt.year + (dt.month - 1 + months) // 12
+        m = (dt.month - 1 + months) % 12 + 1
+        d = min(dt.day, calendar.monthrange(y, m)[1])
+        out = dt.replace(year=y, month=m, day=d)
+    else:
+        return None
+    if (dt.hour, dt.minute, dt.second) == (0, 0, 0) and unit in (
+            "day", "week", "month", "quarter", "year"):
+        return out.strftime("%Y-%m-%d").encode()
+    return out.strftime("%Y-%m-%d %H:%M:%S").encode()
+
+
+def _gtid_parse(s):
+    """'uuid:1-5:8,uuid2:3' → {uuid: set of txn ids} (reference:
+    builtin_miscellaneous.go gtidSubset — MySQL GTID set algebra)."""
+    out = {}
+    for part in _u(s).replace("\n", "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        sid = bits[0].strip().lower()
+        ids = out.setdefault(sid, set())
+        for rng in bits[1:]:
+            if "-" in rng:
+                lo, hi = rng.split("-")
+                ids.update(range(int(lo), int(hi) + 1))
+            else:
+                ids.add(int(rng))
+    return out
+
+
+def _gtid_subset(a, b):
+    ga, gb = _gtid_parse(a), _gtid_parse(b)
+    return int(all(ids <= gb.get(sid, set()) for sid, ids in ga.items()))
+
+
+def _gtid_format(g):
+    parts = []
+    for sid in sorted(g):
+        ids = sorted(g[sid])
+        if not ids:
+            continue
+        rngs = []
+        lo = prev = ids[0]
+        for v in ids[1:] + [None]:
+            if v is not None and v == prev + 1:
+                prev = v
+                continue
+            rngs.append(f"{lo}-{prev}" if prev > lo else f"{lo}")
+            if v is not None:
+                lo = prev = v
+        parts.append(sid + ":" + ":".join(rngs))
+    return ",".join(parts).encode()
+
+
+def _gtid_subtract(a, b):
+    ga, gb = _gtid_parse(a), _gtid_parse(b)
+    return _gtid_format({sid: ids - gb.get(sid, set())
+                         for sid, ids in ga.items()})
+
+
+def _tidb_decode_key(hexkey):
+    """Hex-encoded engine key → JSON description (reference:
+    expression/builtin_info.go tidbDecodeKey over tablecodec layouts)."""
+    from ..tablecodec import (INDEX_SEP, _dec_i64, decode_index_values,
+                              decode_record_key)
+    raw = binascii.unhexlify(hexkey)
+    try:
+        tid, h = decode_record_key(raw)
+        return _json.dumps({"table_id": tid, "handle": h}).encode()
+    except Exception:
+        pass
+    try:
+        if INDEX_SEP in raw:
+            tid = _dec_i64(raw[1:9])
+            iid = _dec_i64(raw[11:19])
+            vals = decode_index_values(raw)
+            return _json.dumps({
+                "table_id": tid, "index_id": iid,
+                "index_vals": [repr(v) for v in vals]}).encode()
+    except Exception:
+        pass
+    return hexkey
+
+
+_TIDB_FUNCS = {
+    # reference-dialect admin builtins (expression/builtin_info.go)
+    "tidb_version": _pyfn("", lambda: b"8.0.11-tpu-htap"),
+    "tidb_is_ddl_owner": _pyfn("", lambda: 1, out="i"),
+    # TSO = (ms since epoch) << 18 | logical (reference:
+    # builtin_info.go tidbParseTso)
+    "tidb_parse_tso": _pyfn("i", lambda tso: None if tso <= 0 else
+                            _dt.datetime.fromtimestamp(
+                                (int(tso) >> 18) / 1000.0).strftime(
+                                "%Y-%m-%d %H:%M:%S.%f").encode()),
+    "tidb_decode_key": _pyfn("s", lambda k: _tidb_decode_key(k)),
+    "master_pos_wait": _pyfn("ssi", lambda _f, _p, _t: None,
+                             null_propagate=False),
+    "tidb_shard": _pyfn("i", lambda v: hash(int(v)) % 256, out="i"),
+    "format_nano_time": _pyfn("f", lambda ns: (
+        f"{ns:.0f}ns" if ns < 1e3 else f"{ns / 1e3:.2f}µs" if ns < 1e6
+        else f"{ns / 1e6:.2f}ms" if ns < 1e9
+        else f"{ns / 1e9:.2f}s").encode()),
+    "gtid_subset": _pyfn("ss", _gtid_subset, out="i"),
+    "gtid_subtract": _pyfn("ss", _gtid_subtract),
+    "wait_for_executed_gtid_set": _pyfn("sf", lambda _g, *_t: 0, out="i"),
+    "tidb_encode_sql_digest": _pyfn("s", lambda sql: __import__(
+        "tidb_tpu.parser.digester", fromlist=["digest"]).digest(
+        _u(sql)).encode()),
+    "get_lock": _pyfn("si", _get_lock, out="i"),
+    "release_lock": _pyfn("s", _release_lock, out="i"),
+    "release_all_locks": _pyfn("", _release_all_locks, out="i"),
+    "ps_current_thread_id": _pyfn("", lambda: _threading.get_ident()
+                                  & 0xFFFFFFFF, out="i"),
+    "is_free_lock": _pyfn("s", _is_free_lock, out="i"),
+    "is_used_lock": _pyfn("s", _is_used_lock, out="i"),
+    # date_add/date_sub/adddate/subdate reach the engine through the
+    # parser's INTERVAL grammar -> core date_arith; _date_arith_std backs
+    # the month-clamp tests directly
+    "date_arith_fn": _pyfn("dis", lambda dt, n, u: _date_arith_std(
+        dt, n, u, 1)),
+    "localtime": _pyfn("", lambda: _dt.datetime.now().strftime(
+        "%Y-%m-%d %H:%M:%S").encode()),
+    "localtimestamp": _pyfn("", lambda: _dt.datetime.now().strftime(
+        "%Y-%m-%d %H:%M:%S").encode()),
+    "current_time": _pyfn("", lambda: _dt.datetime.now().strftime(
+        "%H:%M:%S").encode()),
+}
+
+#: pure aliases — separate registry entries in the reference too
+#: (builtin.go maps lcase/ucase/... onto the same function classes)
+_ALIASES = {
+    "ceiling": "ceil", "power": "pow", "lcase": "lower", "ucase": "upper",
+    "mid": "substring", "substr": "substring", "sha": "sha1",
+    "json_merge": "json_merge_preserve", "day": "dayofmonth",
+    "json_append": "json_array_append", "curtime": "current_time",
+}
+
+
 def register_all():
     for table in (_STRING_FUNCS, _MATH_FUNCS, _DATE_FUNCS, _JSON_FUNCS,
-                  _MISC_FUNCS, _REGEXP_FUNCS, _CRYPTO_FUNCS, _EXTRA_FUNCS):
+                  _MISC_FUNCS, _REGEXP_FUNCS, _CRYPTO_FUNCS, _EXTRA_FUNCS,
+                  _MORE_FUNCS, _TIDB_FUNCS):
         for name, fn in table.items():
             _DISPATCH.setdefault(name, fn)
+    for alias, target in _ALIASES.items():
+        if target is not None and target in _DISPATCH:
+            _DISPATCH.setdefault(alias, _DISPATCH[target])
 
 
 register_all()

@@ -172,6 +172,13 @@ def _choose(ds: DataSource, ctx):
     name2idx = {ci.name: i for i, ci in enumerate(ds.col_infos)}
     if not eq and not rngs:
         _choose_batch(ds, info, name2idx, allowed, excluded)
+        if ds.access is None:
+            stats = (ctx.table_stats(info.id)
+                     if ctx is not None and hasattr(ctx, "table_stats")
+                     else None)
+            n = max((stats or {}).get("row_count", 0), 1)
+            _choose_index_merge(ds, info, name2idx, allowed, excluded,
+                                stats, n)
         return
 
     # 1. PointGet on the integer primary key stored as the row handle
@@ -266,12 +273,114 @@ def _choose(ds: DataSource, ctx):
             if hi_b is None and prefix:
                 hi = list(prefix)
             best = (cost, ("index_range", idx, lo, hi), est_rows)
-    if best is None:
+    if best is not None:
+        cost_full = n * SCAN_ROW_COST
+        if forced or best[0] < cost_full:
+            ds.access = best[1]
+            ds.access_est = int(best[2])
+            return
+    _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n)
+
+
+def _flatten_or(cond):
+    """OR-tree → flat disjunct list, or None when not an OR."""
+    from ..expression.core import ScalarFunc
+    if not isinstance(cond, ScalarFunc) or cond.op != "or":
+        return None
+    out = []
+
+    def rec(e):
+        if isinstance(e, ScalarFunc) and e.op == "or":
+            rec(e.args[0])
+            rec(e.args[1])
+        else:
+            out.append(e)
+    rec(cond)
+    return out
+
+
+def _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n):
+    """IndexMerge (reference: executor/index_merge_reader.go,
+    planner/core/indexmerge_path.go): an OR of per-column indexable
+    predicates — which no single index path can consume — becomes a UNION
+    of index-range handle sets. The OR stays a post-filter, so path
+    choice never changes semantics; the union only pre-selects
+    candidates."""
+    if stats is None or max(stats.get("row_count", 0), 1) < 2:
         return
-    cost_full = n * SCAN_ROW_COST
-    if forced or best[0] < cost_full:
-        ds.access = best[1]
-        ds.access_est = int(best[2])
+    pk_idx_pos = None
+    if info.pk_is_handle:
+        pk_idx_pos = next((i for i, ci in enumerate(ds.col_infos)
+                           if ci.id == info.pk_col_id), None)
+
+    def index_for(pos):
+        for idx in info.indexes:
+            if idx.state != SchemaState.PUBLIC or not idx.columns:
+                continue
+            if not _idx_allowed(idx, allowed, excluded):
+                continue
+            if name2idx.get(idx.columns[0].name) == pos:
+                return idx
+        return None
+
+    best = None
+    for cond in ds.pushed_conds:
+        parts = _flatten_or(cond)
+        if parts is None or len(parts) < 2:
+            continue
+        subpaths = []
+        est_total = 0.0
+        cost = 0.0
+        ok = True
+        for d in parts:
+            cc = _col_const(d)
+            if cc is None:
+                ok = False
+                break
+            col, v, op = cc
+            if v is None or col.idx >= len(ds.col_infos):
+                ok = False
+                break
+            col_ft = ds.col_infos[col.idx].ftype
+            if op == "eq":
+                sv = _seek_value(_cond_const(d), col_ft)
+                if sv is _SKIP:
+                    ok = False
+                    break
+                if col.idx == pk_idx_pos and _int_like(sv):
+                    subpaths.append(("point_pk", int(sv)))
+                else:
+                    idx = index_for(col.idx)
+                    if idx is None:
+                        ok = False
+                        break
+                    subpaths.append(("index_range", idx, [sv], [sv]))
+            elif op in ("lt", "le", "gt", "ge"):
+                side = "lo" if op in ("gt", "ge") else "hi"
+                sv = _seek_value(_cond_const(d), col_ft, side)
+                if sv is _SKIP or isinstance(sv, bytes):
+                    ok = False
+                    break
+                idx = index_for(col.idx)
+                if idx is None:
+                    ok = False
+                    break
+                lo = [sv] if side == "lo" else None
+                hi = [sv] if side == "hi" else None
+                subpaths.append(("index_range", idx, lo, hi))
+            else:
+                ok = False
+                break
+            est = max(n * estimate_selectivity(stats, ds.col_infos, [d]), 1.0)
+            est_total += est
+            cost += SEEK_BASE + est * SEEK_COST
+        if not ok:
+            continue
+        if best is None or cost < best[0]:
+            best = (cost, subpaths, est_total)
+    if best is not None and best[0] < n * SCAN_ROW_COST:
+        ds.access = ("index_merge", best[1])
+        ds.access_est = int(min(best[2], n))
 
 
 def _choose_batch(ds, info, name2idx, allowed, excluded):

@@ -44,6 +44,8 @@ class DataSource(LogicalPlan):
                 return "PointGet"
             if kind in ("batch_pk", "batch_index"):
                 return "BatchPointGet"
+            if kind == "index_merge":
+                return "IndexMerge"
             return "IndexLookUp"
         return "TableScan"
 
@@ -66,6 +68,11 @@ class DataSource(LogicalPlan):
                 s += f", handles:{len(self.access[1])}"
             elif kind == "batch_index":
                 s += f", index:{self.access[1].name}, keys:{len(self.access[2])}"
+            elif kind == "index_merge":
+                parts = ",".join(
+                    ("handle" if sub[0] == "point_pk" else sub[1].name)
+                    for sub in self.access[1])
+                s += f", union:[{parts}], est_rows:{self.access_est}"
             else:
                 _k, idx, lo, hi = self.access
                 s += (f", index:{idx.name}, range:[{lo},{hi}]"

@@ -801,6 +801,10 @@ class Session:
             sql = type(stmt).__name__
         self.current_sql = sql
         self.stmt_start = time.time()
+        # advisory-lock owner identity: per-SESSION, not per-thread (an
+        # in-process embedding serves many sessions on one thread)
+        from ..expression.builtins_ext import set_lock_owner
+        set_lock_owner(id(self))
         # per-statement memory quota (reference: stmtctx MemTracker under
         # the session tracker; tidb_mem_quota_query)
         from ..utils.memory import MemTracker
