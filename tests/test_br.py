@@ -95,7 +95,8 @@ def test_import_crash_resume(tk, tmp_path):
         br.import_dump(tk.session, d, db_name="t4", crash_after_batches=2)
     ck = os.path.join(d, "_import_checkpoint.json")
     assert os.path.exists(ck)
-    assert json.load(open(ck))["stmts_done"] >= 1
+    ckd = json.load(open(ck))
+    assert any(v >= 1 for v in ckd["progress"].values())
     br.import_dump(tk.session, d, db_name="t4")  # resume
     assert not os.path.exists(ck)
     tk.must_query("select count(*), sum(b) from t4.big").check(
@@ -149,3 +150,71 @@ def test_sql_dump_quotes_float_lookalikes(tk, tmp_path):
     br.import_dump(tk.session, str(tmp_path / "d2"), "tqr")
     tk.must_query("select s from tqr.tq order by id").check(
         [("nan",), ("0010",)])
+
+
+def test_storage_backends_roundtrip(tk):
+    """A backup written to the memory:// object store restores from it —
+    the ExternalStorage seam (reference: br/pkg/storage backends)."""
+    tk.must_exec("create table ms (a bigint primary key, b varchar(10))")
+    tk.must_exec("insert into ms values (1, 'x'), (2, 'y')")
+    br.backup_database(tk.session, "test", "memory://bk1")
+    br.restore_database(tk.session, "memory://bk1", db_name="memdb")
+    tk.must_query("select a, b from memdb.ms order by a").check(
+        [("1", "x"), ("2", "y")])
+
+
+def test_cloud_scheme_rejected(tk):
+    from tidb_tpu.br_storage import open_storage
+    with pytest.raises(TiDBError) as e:
+        open_storage("s3://bucket/prefix")
+    assert "credentials" in str(e.value)
+
+
+def test_parallel_import(tk, tmp_path):
+    """Table-level parallel import (lightning table concurrency): several
+    tables load on worker sessions; results match the source."""
+    tk.must_exec("create database pmany")
+    for i in range(6):
+        tk.must_exec(f"create table pmany.pt{i} (a bigint primary key, "
+                     f"b bigint)")
+        vals = ",".join(f"({j}, {j * (i + 1)})" for j in range(300))
+        tk.must_exec(f"insert into pmany.pt{i} values {vals}")
+    d = str(tmp_path / "pdump")
+    br.dump_database(tk.session, "pmany", d, fmt="sql")
+    res = br.import_dump(tk.session, d, db_name="pmany2", workers=4)
+    assert res["conflicts"] == 0
+    for i in range(6):
+        tk.must_query(
+            f"select count(*), sum(b) from pmany2.pt{i}").check(
+            [(str(300), str(sum(j * (i + 1) for j in range(300))))])
+
+
+def test_import_duplicate_detection(tk, tmp_path):
+    """on_duplicate='record': conflicting rows land in the conflict log
+    and the rest of the data loads (reference: lightning/errormanager)."""
+    import json as _json
+    import os as _os
+    tk.must_exec("create database dups")
+    tk.must_exec("create table dups.d (a bigint primary key, b bigint)")
+    tk.must_exec("insert into dups.d values (1, 10), (2, 20), (3, 30)")
+    d = str(tmp_path / "ddump")
+    br.dump_database(tk.session, "dups", d, fmt="sql")
+    # pre-seed the target with a conflicting row
+    tk.must_exec("create database dups2")
+    tk.must_exec("create table dups2.d (a bigint primary key, b bigint)")
+    tk.must_exec("insert into dups2.d values (2, 999)")
+    # default mode fails
+    with pytest.raises(TiDBError):
+        br.import_dump(tk.session, d, db_name="dups2")
+    ck = _os.path.join(d, "_import_checkpoint.json")
+    if _os.path.exists(ck):
+        _os.remove(ck)
+    # record mode loads the non-conflicting rows and logs the clash
+    res = br.import_dump(tk.session, d, db_name="dups2",
+                         on_duplicate="record")
+    assert res["conflicts"] == 1
+    tk.must_query("select a, b from dups2.d order by a").check(
+        [("1", "10"), ("2", "999"), ("3", "30")])
+    log = _os.path.join(d, "_import_conflicts.jsonl")
+    recs = [_json.loads(ln) for ln in open(log)]
+    assert recs and recs[0]["table"] == "d"

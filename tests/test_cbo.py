@@ -311,3 +311,36 @@ class TestIndexMerge:
             "select id from im where a = 3 or b = 7").rows)
         mtk.must_exec("rollback")
         assert 5000 in got and 10 in got
+
+
+class TestAggElimination:
+    """rule_aggregation_elimination.go analog: unique-keyed GROUP BY
+    collapses to a projection — and the two traps the rewrite must dodge."""
+
+    def test_unique_group_key_eliminates(self, tk):
+        tk.must_exec("create table ae1 (id bigint primary key, v bigint)")
+        tk.must_exec("insert into ae1 values (1, 10), (2, 20)")
+        plan = "\n".join(r[0] for r in tk.must_query(
+            "explain select id, sum(v) from ae1 group by id").rows)
+        assert "Agg" not in plan, plan
+        tk.must_query("select id, sum(v), count(v) from ae1 group by id "
+                      "order by id").check(
+            [("1", "10", "1"), ("2", "20", "1")])
+
+    def test_nullable_unique_key_not_eliminated(self, tk):
+        # unique indexes admit many NULL rows: the NULL group aggregates
+        tk.must_exec("create table ae2 (id bigint primary key, a bigint, "
+                     "b bigint, unique key ua (a))")
+        tk.must_exec("insert into ae2 values (1, null, 1), (2, null, 2), "
+                     "(3, 5, 10)")
+        plan = "\n".join(r[0] for r in tk.must_query(
+            "explain select a, sum(b) from ae2 group by a").rows)
+        assert "Agg" in plan, plan
+        tk.must_query("select a, sum(b) from ae2 group by a "
+                      "order by a").check([(None, "3"), ("5", "10")])
+
+    def test_count_null_constant(self, tk):
+        tk.must_exec("create table ae3 (id bigint primary key)")
+        tk.must_exec("insert into ae3 values (1), (2)")
+        tk.must_query("select id, count(null) from ae3 group by id "
+                      "order by id").check([("1", "0"), ("2", "0")])

@@ -1,8 +1,14 @@
 """Backup / restore + logical dump + checkpointed import — the BR,
 Dumpling and Lightning roles (reference: br/pkg/task/backup.go:221,
-restore.go:216, dumpling/export/dump.go, br/pkg/lightning/checkpoints/).
+restore.go:216, dumpling/export/dump.go, br/pkg/lightning/checkpoints/,
+br/pkg/lightning/errormanager/ duplicate detection).
 
-Backup format (one directory per run):
+All file IO routes through the external-storage abstraction
+(br_storage.py — the br/pkg/storage role): a backup written to
+``local://`` restores from ``memory://`` and vice versa, and a cloud
+backend is one ExternalStorage subclass away.
+
+Backup format (one prefix per run):
     backupmeta.json                 run metadata + per-table stats
     {db}.{table}.schema.json       TableInfo (exact catalog state)
     {db}.{table}.data.jsonl        rows as {"h": handle, "v": hex(rowcodec)}
@@ -13,18 +19,24 @@ Dump format (mydumper-style, reference dumpling/export):
     {db}.{table}-schema.sql        CREATE TABLE
     {db}.{table}.sql | .csv        INSERT statements / CSV rows
 
-Import reads a dump directory with a progress checkpoint
-(_import_checkpoint.json) updated after every committed batch: a crashed
-import resumes at the first unfinished table/offset instead of redoing or
-duplicating work (reference: lightning checkpoints)."""
+Import reads a dump with a progress checkpoint (_import_checkpoint.json)
+updated after every committed batch: a crashed import resumes at the
+first unfinished table/offset instead of redoing or duplicating work
+(reference: lightning checkpoints). `workers` > 1 imports tables in
+parallel on their own sessions (lightning's table-concurrency);
+`on_duplicate="record"` logs conflicting rows to _import_conflicts.jsonl
+and continues (lightning's errormanager) instead of failing the run.
+"""
 
 from __future__ import annotations
 
+import io
 import json
-import os
+import threading
 import time
 
 from . import tablecodec
+from .br_storage import open_storage
 from .errors import TiDBError
 from .model import TableInfo
 from .table import Table
@@ -38,52 +50,61 @@ def backup_database(session, db_name: str, dest: str) -> dict:
     infos = session.infoschema()
     if infos.schema_by_name(db_name) is None:
         raise TiDBError(f"Unknown database '{db_name}'")
-    os.makedirs(dest, exist_ok=True)
+    st = open_storage(dest)
     txn = session.store.begin()  # one snapshot: a consistent backup
+    coord = getattr(session.domain, "coordinator", None)
+    # one pin PER RUN (keyed by snapshot ts): concurrent backups must not
+    # raise or clear each other's GC floor — set_safepoint only moves
+    # forward and clear would drop a foreign pin (reference: BR registers
+    # a unique service safepoint id per task, br/pkg/task/backup.go)
+    pin_key = f"br-{txn.start_ts}"
+    if coord is not None:
+        coord.set_safepoint(pin_key, txn.start_ts)
     meta = {"db": db_name, "ts": txn.start_ts,
             "created": time.strftime("%Y-%m-%d %H:%M:%S"), "tables": []}
     try:
         for info in infos.tables_in_schema(db_name):
-            base = os.path.join(dest, f"{db_name}.{info.name}")
-            with open(base + ".schema.json", "w") as f:
-                payload = info.to_json()
-                f.write(payload if isinstance(payload, str)
-                        else json.dumps(payload))
+            base = f"{db_name}.{info.name}"
+            payload = info.to_json()
+            st.write_text(base + ".schema.json",
+                          payload if isinstance(payload, str)
+                          else json.dumps(payload))
             n = 0
             phys_ids = [info.id]
             if info.partition is not None:
                 # rows live under partition physical ids; restore re-routes
                 # by value so the dump is just (handle, row) pairs
                 phys_ids = [d.id for d in info.partition.defs]
-            with open(base + ".data.jsonl", "w") as f:
+            with st.open_write(base + ".data.jsonl") as f:
                 for pid in phys_ids:
                     rec_end = tablecodec.record_prefix(pid) + b"\xff" * 9
                     for key, value in txn.scan(
                             tablecodec.record_prefix(pid), rec_end):
                         _tid, h = tablecodec.decode_record_key(key)
-                        f.write(json.dumps({"h": h, "v": value.hex()}) + "\n")
+                        f.write(json.dumps(
+                            {"h": h, "v": value.hex()}) + "\n")
                         n += 1
             meta["tables"].append({"name": info.name, "rows": n})
     finally:
         txn.rollback()
-    with open(os.path.join(dest, "backupmeta.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+        if coord is not None:
+            coord.clear_safepoint(pin_key)
+    st.write_text("backupmeta.json", json.dumps(meta, indent=1))
     return meta
 
 
 # -- restore (reference: br/pkg/task/restore.go) -----------------------------
 
 def restore_database(session, src: str, db_name: str | None = None) -> dict:
-    with open(os.path.join(src, "backupmeta.json")) as f:
-        meta = json.load(f)
+    st = open_storage(src)
+    meta = json.loads(st.read_text("backupmeta.json"))
     target_db = db_name or meta["db"]
     if session.infoschema().schema_by_name(target_db) is None:
         session.execute(f"create database `{target_db}`")
     restored = []
     for t in meta["tables"]:
-        base = os.path.join(src, f"{meta['db']}.{t['name']}")
-        with open(base + ".schema.json") as f:
-            raw = f.read()
+        base = f"{meta['db']}.{t['name']}"
+        raw = st.read_text(base + ".schema.json")
         info = TableInfo.from_json(json.loads(raw)
                                    if raw.lstrip().startswith("{")
                                    else raw)
@@ -92,7 +113,8 @@ def restore_database(session, src: str, db_name: str | None = None) -> dict:
                             f"exists; drop it before RESTORE")
         _create_from_info(session, target_db, info)
         new_info = session.infoschema().table_by_name(target_db, info.name)
-        n = _restore_rows(session, new_info, base + ".data.jsonl")
+        with st.open_read(base + ".data.jsonl") as f:
+            n = _restore_rows(session, new_info, f)
         restored.append({"name": info.name, "rows": n})
     return {"db": target_db, "tables": restored}
 
@@ -101,7 +123,6 @@ def _create_from_info(session, db_name: str, info: TableInfo):
     """Recreate the table from the backed-up TableInfo via the catalog
     (new table id; column/index ids preserved from the source)."""
     from .meta import Meta
-    ddl = session.ddl
     with session.domain.ddl_lock:
         txn = session.store.begin()
         try:
@@ -123,17 +144,18 @@ def _create_from_info(session, db_name: str, info: TableInfo):
     session.domain.reload_schema()
 
 
-def _restore_rows(session, info: TableInfo, path: str) -> int:
+def _restore_rows(session, info: TableInfo, lines) -> int:
     n = 0
     batch = []
-    with open(path) as f:
-        for line in f:
-            rec = json.loads(line)
-            batch.append((rec["h"], bytes.fromhex(rec["v"])))
-            if len(batch) >= BATCH:
-                _write_batch(session, info, batch)
-                n += len(batch)
-                batch = []
+    for line in lines:
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        batch.append((rec["h"], bytes.fromhex(rec["v"])))
+        if len(batch) >= BATCH:
+            _write_batch(session, info, batch)
+            n += len(batch)
+            batch = []
     if batch:
         _write_batch(session, info, batch)
         n += len(batch)
@@ -162,18 +184,17 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
     infos = session.infoschema()
     if infos.schema_by_name(db_name) is None:
         raise TiDBError(f"Unknown database '{db_name}'")
-    os.makedirs(dest, exist_ok=True)
+    st = open_storage(dest)
     out = {"db": db_name, "tables": []}
     # base tables first, then views in dependency order, so view DDL
     # (which plans its select) can resolve its sources on import; views
     # carry schema only, never INSERT data
     all_infos = _dump_order(infos.tables_in_schema(db_name))
     for info in all_infos:
-        base = os.path.join(dest, f"{db_name}.{info.name}")
+        base = f"{db_name}.{info.name}"
         create = session.execute(
             f"show create table `{db_name}`.`{info.name}`")[-1].rows[0][1]
-        with open(base + "-schema.sql", "w") as f:
-            f.write(create + ";\n")
+        st.write_text(base + "-schema.sql", create + ";\n")
         if info.is_view:
             out["tables"].append({"name": info.name, "rows": 0,
                                   "is_view": True})
@@ -182,7 +203,7 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
             f"select * from `{db_name}`.`{info.name}`")[-1]
         rows = res.rows  # display strings (None = NULL)
         if fmt == "sql":
-            with open(base + ".sql", "w") as f:
+            with st.open_write(base + ".sql") as f:
                 for i in range(0, len(rows), 256):
                     chunk = rows[i:i + 256]
                     vals = ",\n".join(
@@ -191,21 +212,20 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
                     f.write(f"INSERT INTO `{info.name}` VALUES\n{vals};\n")
         else:
             import csv
-            with open(base + ".csv", "w", newline="") as f:
+            with st.open_write(base + ".csv") as f:
                 w = csv.writer(f)
                 w.writerow(res.names)
                 for r in rows:
                     # NULL sentinel is \N; a LITERAL leading backslash is
-                    # escaped by doubling so the reader can tell them apart
-                    # (mydumper-style)
+                    # escaped by doubling so the reader can tell them
+                    # apart (mydumper-style)
                     w.writerow([
                         "\\N" if v is None
                         else ("\\" + v if isinstance(v, str)
                               and v.startswith("\\") else v)
                         for v in r])
         out["tables"].append({"name": info.name, "rows": len(rows)})
-    with open(os.path.join(dest, "metadata.json"), "w") as f:
-        json.dump(out, f, indent=1)
+    st.write_text("metadata.json", json.dumps(out, indent=1))
     return out
 
 
@@ -277,97 +297,212 @@ def _str_lit(s: str) -> str:
 
 # -- import with checkpoint/resume (reference: lightning checkpoints) ---------
 
+class _ImportState:
+    """Shared, locked import progress: checkpoint + conflict log."""
+
+    def __init__(self, st):
+        self.st = st
+        self.mu = threading.Lock()
+        self.ckpt = {"done_tables": [], "progress": {}}
+        if st.exists("_import_checkpoint.json"):
+            old = json.loads(st.read_text("_import_checkpoint.json"))
+            self.ckpt["done_tables"] = old.get("done_tables", [])
+            if "progress" in old:
+                self.ckpt["progress"] = old["progress"]
+            elif old.get("table"):  # pre-parallel single-cursor format
+                self.ckpt["progress"] = {old["table"]: old["stmts_done"]}
+        self.batches = 0
+        self.conflicts = 0
+        self._conflict_lines = []
+        st.delete("_import_conflicts.jsonl")  # per-run log
+
+    def write(self):
+        self.st.write_text("_import_checkpoint.json",
+                           json.dumps(self.ckpt))
+
+    def advance(self, name, done):
+        with self.mu:
+            self.ckpt["progress"][name] = done
+            self.batches += 1
+            self.write()
+            return self.batches
+
+    def finish_table(self, name):
+        with self.mu:
+            self.ckpt["done_tables"].append(name)
+            self.ckpt["progress"].pop(name, None)
+            self.write()
+
+    def record_conflict(self, name, row_sql, err):
+        with self.mu:
+            self.conflicts += 1
+            self._conflict_lines.append(json.dumps(
+                {"table": name, "row": row_sql, "error": str(err)}))
+
+    def flush_conflicts(self):
+        with self.mu:
+            if self._conflict_lines:
+                self.st.write_text("_import_conflicts.jsonl",
+                                   "\n".join(self._conflict_lines) + "\n")
+
+
+def _exec_with_dup_handling(session, state, name, stmt, on_duplicate):
+    """Run one INSERT batch; on a duplicate-key error under
+    on_duplicate='record', retry row-by-row, logging each conflicting row
+    (reference: lightning/errormanager — conflicts are data, not crashes)."""
+    from .errors import ErrCode
+    try:
+        session.execute(stmt)
+        return
+    except TiDBError as e:
+        if on_duplicate != "record" or getattr(
+                e, "code", None) != ErrCode.DupEntry:
+            raise
+    from .parser import ast, parse
+    parsed = parse(stmt)[0]
+    if not isinstance(parsed, ast.InsertStmt):
+        raise TiDBError("duplicate in a non-INSERT import statement")
+    for row in parsed.values:
+        single = ast.InsertStmt(table=parsed.table,
+                                columns=list(parsed.columns), values=[row])
+        sql = single.restore()
+        try:
+            session.execute(sql)
+        except TiDBError as e2:
+            if getattr(e2, "code", None) != ErrCode.DupEntry:
+                raise
+            state.record_conflict(name, sql, e2)
+
+
+def _import_one_table(session, st, state, meta, target_db, t, on_duplicate,
+                      crash_after_batches):
+    name = t["name"]
+    session.execute(f"use `{target_db}`")
+    with state.mu:
+        skip = state.ckpt["progress"].get(name, 0)
+    if skip == 0 and not session.infoschema().has_table(target_db, name):
+        session.execute(st.read_text(f"{meta['db']}.{name}-schema.sql"))
+    if t.get("is_view"):
+        state.finish_table(name)
+        return
+    data_name = f"{meta['db']}.{name}.sql"
+    csv_name = f"{meta['db']}.{name}.csv"
+    if not st.exists(data_name) and st.exists(csv_name):
+        stmts = _csv_to_inserts(st.read_text(csv_name), name)
+    else:
+        stmts = _split_sql(st.read_text(data_name))
+    done = 0
+    for stmt in stmts:
+        done += 1
+        if done <= skip:
+            continue
+        _exec_with_dup_handling(session, state, name, stmt, on_duplicate)
+        batches = state.advance(name, done)
+        if (crash_after_batches is not None
+                and batches >= crash_after_batches):
+            raise TiDBError("import aborted (injected crash)")
+    state.finish_table(name)
+
+
 def import_dump(session, src: str, db_name: str | None = None,
-                crash_after_batches: int | None = None) -> dict:
-    """Load a dump directory produced by dump_database (sql format).
-    Progress is checkpointed per committed batch; re-running after a crash
-    resumes from the checkpoint. `crash_after_batches` is a test hook that
-    aborts mid-import (reference: failpoint-style injection)."""
-    with open(os.path.join(src, "metadata.json")) as f:
-        meta = json.load(f)
+                crash_after_batches: int | None = None, workers: int = 1,
+                on_duplicate: str = "error") -> dict:
+    """Load a dump produced by dump_database (sql or csv format).
+
+    workers: table-level parallelism — each worker drives its own session
+    over the shared domain (reference: lightning's table/index
+    concurrency); the checkpoint file is shared and locked.
+    on_duplicate: 'error' fails the run on a duplicate key (default);
+    'record' logs conflicting rows to _import_conflicts.jsonl and keeps
+    going (reference: lightning/errormanager). Known limit: a crash in
+    the middle of a row-by-row conflict retry makes the RESUMED run see
+    its own previously-inserted rows as conflicts (the checkpoint is
+    per-statement); the log may then over-report — it never loses real
+    conflicts."""
+    if on_duplicate not in ("error", "record"):
+        raise TiDBError("on_duplicate must be 'error' or 'record'")
+    st = open_storage(src)
+    meta = json.loads(st.read_text("metadata.json"))
     target_db = db_name or meta["db"]
     if session.infoschema().schema_by_name(target_db) is None:
         session.execute(f"create database `{target_db}`")
-    ckpt_path = os.path.join(src, "_import_checkpoint.json")
-    ckpt = {"done_tables": [], "table": None, "stmts_done": 0}
-    if os.path.exists(ckpt_path):
-        with open(ckpt_path) as f:
-            ckpt = json.load(f)
-    session.execute(f"use `{target_db}`")
-    batches = 0
-    for t in meta["tables"]:
-        name = t["name"]
-        if name in ckpt["done_tables"]:
-            continue
-        schema_file = os.path.join(src, f"{meta['db']}.{name}-schema.sql")
-        data_file = os.path.join(src, f"{meta['db']}.{name}.sql")
-        skip = ckpt["stmts_done"] if ckpt.get("table") == name else 0
-        if skip == 0 and not session.infoschema().has_table(target_db, name):
-            with open(schema_file) as f:
-                session.execute(f.read())
-        if t.get("is_view"):
-            ckpt["done_tables"].append(name)
-            _write_ckpt(ckpt_path, ckpt)
-            continue
-        csv_file = os.path.join(src, f"{meta['db']}.{name}.csv")
-        if not os.path.exists(data_file) and os.path.exists(csv_file):
-            stmts = _csv_to_inserts(csv_file, name)
-        else:
-            with open(data_file) as f:
-                stmts = _split_sql(f.read())
-        done = 0
-        for stmt in stmts:
-            done += 1
-            if done <= skip:
-                continue
-            session.execute(stmt)
-            batches += 1
-            ckpt.update({"table": name, "stmts_done": done})
-            _write_ckpt(ckpt_path, ckpt)
-            if (crash_after_batches is not None
-                    and batches >= crash_after_batches):
-                raise TiDBError("import aborted (injected crash)")
-        ckpt["done_tables"].append(name)
-        ckpt.update({"table": None, "stmts_done": 0})
-        _write_ckpt(ckpt_path, ckpt)
-    os.unlink(ckpt_path)
+    state = _ImportState(st)
+    pending = [t for t in meta["tables"]
+               if t["name"] not in state.ckpt["done_tables"]]
+    # views depend on base tables: create them LAST, serially
+    views = [t for t in pending if t.get("is_view")]
+    tables = [t for t in pending if not t.get("is_view")]
+
+    if workers <= 1 or len(tables) <= 1:
+        for t in tables + views:
+            _import_one_table(session, st, state, meta, target_db, t,
+                              on_duplicate, crash_after_batches)
+    else:
+        from .session import new_session
+        errs = []
+        emu = threading.Lock()
+        it = iter(tables)
+        imu = threading.Lock()
+
+        def worker():
+            ws = new_session(session.domain)
+            while True:
+                with imu:
+                    t = next(it, None)
+                if t is None:
+                    return
+                try:
+                    _import_one_table(ws, st, state, meta, target_db, t,
+                                      on_duplicate, crash_after_batches)
+                except Exception as e:
+                    with emu:
+                        errs.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(min(workers, len(tables)))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errs:
+            raise errs[0]
+        for t in views:
+            _import_one_table(session, st, state, meta, target_db, t,
+                              on_duplicate, crash_after_batches)
+    state.flush_conflicts()
+    st.delete("_import_checkpoint.json")
     return {"db": target_db,
-            "tables": [t["name"] for t in meta["tables"]]}
+            "tables": [t["name"] for t in meta["tables"]],
+            "conflicts": state.conflicts}
 
 
-def _csv_to_inserts(path: str, table: str, batch: int = 256):
+def _csv_to_inserts(text: str, table: str, batch: int = 256):
     """CSV dump (header row; \\N = NULL) → INSERT statement batches — the
     csv-format twin of the sql loader (reference: lightning/mydump csv
     parser)."""
     import csv
-    with open(path, newline="") as f:
-        rdr = csv.reader(f)
-        try:
-            next(rdr)  # header
-        except StopIteration:
-            return
-        def lit(v: str) -> str:
-            if v == "\\N":
-                return "NULL"
-            if v.startswith("\\\\"):
-                v = v[1:]  # un-escape the doubled leading backslash
-            return _str_lit(v)
+    rdr = csv.reader(io.StringIO(text))
+    try:
+        next(rdr)  # header
+    except StopIteration:
+        return
 
-        rows = []
-        for r in rdr:
-            rows.append("(" + ", ".join(lit(v) for v in r) + ")")
-            if len(rows) >= batch:
-                yield f"INSERT INTO `{table}` VALUES " + ",".join(rows)
-                rows = []
-        if rows:
+    def lit(v: str) -> str:
+        if v == "\\N":
+            return "NULL"
+        if v.startswith("\\\\"):
+            v = v[1:]  # un-escape the doubled leading backslash
+        return _str_lit(v)
+
+    rows = []
+    for r in rdr:
+        rows.append("(" + ", ".join(lit(v) for v in r) + ")")
+        if len(rows) >= batch:
             yield f"INSERT INTO `{table}` VALUES " + ",".join(rows)
-
-
-def _write_ckpt(path: str, ckpt: dict):
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(ckpt, f)
-    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+            rows = []
+    if rows:
+        yield f"INSERT INTO `{table}` VALUES " + ",".join(rows)
 
 
 def _split_sql(text: str):

@@ -1,0 +1,207 @@
+"""Cluster coordination — the single-host analog of PD + etcd (reference:
+the placement driver's TSO service `tidb-server/main.go:74` pd.Client,
+etcd leader election `owner/manager.go:48,94`, the server registry
+`domain/infosync/`, and the GC safepoint store `store/gcworker`).
+
+The reference splits these roles across external services because its
+nodes are separate processes; here the cluster is one process, so the
+roles collapse into one in-memory, thread-safe coordinator with the SAME
+API shape the distributed version needs:
+
+- **TSO** — strictly monotonic timestamp allocation with batched leases
+  (PD hands out ranges so callers don't round-trip per ts; the in-process
+  version keeps that shape so a future cross-process client is a drop-in).
+- **Election** — named leader campaigns with TTL leases and resignation
+  (owner.Manager: DDL owner, stats owner, GC leader all campaign on keys).
+- **Registry** — live server/topology records with TTL heartbeats
+  (infosync's etcd registration backing CLUSTER_* memtables).
+- **Safepoints** — monotonic named watermarks (service safepoints: GC,
+  BR, CDC each hold one; the minimum governs collection).
+- **Watch** — key-prefix watchers with event callbacks (the etcd watch
+  primitive schema-version broadcast rides on, ddl/util).
+
+Domain wires one Coordinator per store; the DDL owner loop, stats
+worker, and GC worker act through it rather than ad-hoc locks, which is
+exactly the seam a multi-process deployment would re-implement over
+gRPC.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Lease:
+    """A granted TTL lease; expired leases lose their role silently
+    (the holder discovers on renew — same contract as an etcd lease)."""
+
+    __slots__ = ("key", "holder", "deadline", "ttl_s")
+
+    def __init__(self, key, holder, ttl_s):
+        self.key = key
+        self.holder = holder
+        self.ttl_s = ttl_s
+        self.deadline = time.monotonic() + ttl_s
+
+    def alive(self) -> bool:
+        return time.monotonic() < self.deadline
+
+
+class Coordinator:
+    def __init__(self, tso_batch: int = 4096):
+        self._mu = threading.RLock()
+        # TSO: high-water + leased ceiling (PD batches allocations)
+        self._ts = int(time.time() * 1000) << 18
+        self._ts_ceiling = self._ts
+        self._tso_batch = tso_batch
+        self._leaders: dict[str, Lease] = {}
+        self._registry: dict[str, tuple[dict, Lease]] = {}
+        self._safepoints: dict[str, int] = {}
+        self._watchers: dict[str, list] = {}
+        self._kv: dict[str, object] = {}
+
+    # -- TSO (pd.Client.GetTS) --------------------------------------------
+
+    def tso(self) -> int:
+        """One strictly-monotonic timestamp."""
+        with self._mu:
+            if self._ts >= self._ts_ceiling:
+                # lease a fresh range anchored to wall time so timestamps
+                # stay roughly physical (PD's physical<<18 | logical form)
+                phys = int(time.time() * 1000) << 18
+                self._ts = max(self._ts, phys)
+                self._ts_ceiling = self._ts + self._tso_batch
+            self._ts += 1
+            return self._ts
+
+    def tso_range(self, n: int) -> tuple[int, int]:
+        """[lo, hi) batch for a client-side allocator."""
+        with self._mu:
+            lo = self.tso()
+            self._ts += n - 1
+            self._ts_ceiling = max(self._ts_ceiling, self._ts)
+            return lo, self._ts + 1
+
+    # -- leader election (owner/manager.go campaign/resign) ----------------
+
+    def campaign(self, key: str, holder: str, ttl_s: float = 45.0) -> bool:
+        """Try to become leader for `key`; holders renew by re-campaigning
+        before the lease lapses (renewal extends; a live foreign lease
+        rejects)."""
+        with self._mu:
+            cur = self._leaders.get(key)
+            if cur is not None and cur.alive() and cur.holder != holder:
+                return False
+            self._leaders[key] = Lease(key, holder, ttl_s)
+            if cur is None or cur.holder != holder or not cur.alive():
+                self._notify(f"leader/{key}", holder)
+            return True
+
+    def leader(self, key: str):
+        with self._mu:
+            cur = self._leaders.get(key)
+            return cur.holder if cur is not None and cur.alive() else None
+
+    def resign(self, key: str, holder: str) -> bool:
+        with self._mu:
+            cur = self._leaders.get(key)
+            if cur is None or cur.holder != holder:
+                return False
+            del self._leaders[key]
+            self._notify(f"leader/{key}", None)
+            return True
+
+    # -- server registry (domain/infosync) ---------------------------------
+
+    def register_server(self, server_id: str, info: dict,
+                        ttl_s: float = 60.0):
+        with self._mu:
+            self._registry[server_id] = (dict(info),
+                                         Lease(server_id, server_id, ttl_s))
+            self._notify(f"server/{server_id}", info)
+
+    def heartbeat(self, server_id: str) -> bool:
+        with self._mu:
+            ent = self._registry.get(server_id)
+            if ent is None:
+                return False
+            ent[1].deadline = time.monotonic() + ent[1].ttl_s
+            return True
+
+    def servers(self) -> dict:
+        with self._mu:
+            return {sid: dict(info) for sid, (info, lease)
+                    in self._registry.items() if lease.alive()}
+
+    def unregister_server(self, server_id: str):
+        with self._mu:
+            self._registry.pop(server_id, None)
+            self._notify(f"server/{server_id}", None)
+
+    # -- service safepoints (gc_worker safepoint upload) -------------------
+
+    def set_safepoint(self, service: str, ts: int) -> int:
+        """Advance `service`'s safepoint (never moves backward); returns
+        the GLOBAL safepoint = min over services — the watermark GC may
+        collect below (reference: PD service safepoints; BR/CDC pin one
+        so backups never lose versions mid-flight)."""
+        with self._mu:
+            cur = self._safepoints.get(service, 0)
+            self._safepoints[service] = max(cur, int(ts))
+            return self.global_safepoint()
+
+    def global_safepoint(self) -> int:
+        with self._mu:
+            return min(self._safepoints.values(), default=0)
+
+    def clear_safepoint(self, service: str):
+        """Drop a service's pin (a finished BR/CDC task releases its
+        hold so GC can advance past it)."""
+        with self._mu:
+            self._safepoints.pop(service, None)
+
+    def min_pin_excluding(self, service: str):
+        """The lowest safepoint held by OTHER services, or None — the
+        ceiling `service` may advance to without invalidating them."""
+        with self._mu:
+            vals = [v for k, v in self._safepoints.items() if k != service]
+            return min(vals) if vals else None
+
+    def safepoints(self) -> dict:
+        with self._mu:
+            return dict(self._safepoints)
+
+    # -- kv + watch (the etcd get/put/watch triple) ------------------------
+
+    def put(self, key: str, value):
+        with self._mu:
+            self._kv[key] = value
+            self._notify(key, value)
+
+    def get(self, key: str, default=None):
+        with self._mu:
+            return self._kv.get(key, default)
+
+    def watch(self, prefix: str, fn):
+        """fn(key, value) fires on every put/notify under `prefix`
+        (value None = deletion/resignation). Returns an unsubscribe
+        callable."""
+        with self._mu:
+            self._watchers.setdefault(prefix, []).append(fn)
+
+        def cancel():
+            with self._mu:
+                lst = self._watchers.get(prefix, [])
+                if fn in lst:
+                    lst.remove(fn)
+        return cancel
+
+    def _notify(self, key: str, value):
+        for prefix, fns in list(self._watchers.items()):
+            if key.startswith(prefix):
+                for fn in list(fns):
+                    try:
+                        fn(key, value)
+                    except Exception:
+                        pass  # a broken watcher must not poison the bus

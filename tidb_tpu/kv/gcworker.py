@@ -105,7 +105,19 @@ class GCWorker:
                ).upper() in ("OFF", "0"):
             return {"safe_point": self.safe_point, "skipped": True}
         store = self.domain.store
+        coord = getattr(self.domain, "coordinator", None)
+        if coord is not None and not coord.campaign("gc", "tidb-0"):
+            # another GC leader holds the lease (reference: gc_worker.go
+            # leader election via the owner manager)
+            return {"safe_point": self.safe_point, "skipped": True}
         sp = self.compute_safepoint() if safe_point is None else safe_point
+        if coord is not None:
+            # service safepoints pin GC: BR/CDC hold a watermark while a
+            # task runs; collecting past it would tear their snapshots
+            # (reference: PD service safepoints, br/pkg/task)
+            pin = coord.min_pin_excluding("gc")
+            if pin is not None:
+                sp = min(sp, pin)
         if sp <= self.safe_point:
             return {"safe_point": self.safe_point, "skipped": True}
         resolved = self._resolve_stale_locks(sp)
@@ -113,6 +125,8 @@ class GCWorker:
         ranges_done = self._process_delete_ranges(sp)
         with self._lock:
             self.safe_point = sp
+            if coord is not None:
+                coord.set_safepoint("gc", sp)
             self.last_run = time.time()
             self.runs += 1
             self.locks_resolved += resolved

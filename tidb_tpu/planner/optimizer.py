@@ -31,6 +31,8 @@ def optimize(plan: LogicalPlan, ctx=None, trace=None) -> LogicalPlan:
     step("predicate_push_down", plan)
     plan = eliminate_outer_joins(plan)
     step("outer_join_elimination", plan)
+    plan = eliminate_aggregation(plan, ctx)
+    step("aggregation_elimination", plan)
     plan = eliminate_max_min(plan)
     step("max_min_elimination", plan)
     plan = reorder_joins(plan, ctx)
@@ -114,6 +116,91 @@ def engine_from_hints(hints):
             if eng:
                 return eng
     return None
+
+
+#: aggregate functions the single-row-group rewrite knows how to project
+_ELIM_AGGS = frozenset({"sum", "avg", "max", "min", "first_row", "count"})
+
+
+def eliminate_aggregation(plan: LogicalPlan, ctx=None) -> LogicalPlan:
+    """Aggregation elimination (reference: rule_aggregation_elimination.go):
+    when the GROUP BY keys contain a unique key of the single underlying
+    table, every group holds exactly one row — the aggregate collapses to
+    a projection: sum/avg/max/min/first_row(x) → cast(x), count(x) →
+    x IS NOT NULL, count(const) → 1.
+
+    (Aggregation PUSHDOWN through joins is deliberately absent: only a
+    partial/final split is sound through an inner join, and this engine's
+    device path already fuses the whole join+aggregate tree into one
+    program — the fusion IS the pushdown, reference
+    rule_aggregation_push_down.go's benefit shape.)"""
+    from ..sqltypes import FieldType, TYPE_LONGLONG
+
+    def key_cols_of(agg):
+        """Bare DataSource columns among the group keys + the source, when
+        the child chain is DataSource (± Selection)."""
+        child = agg.children[0]
+        while isinstance(child, Selection):
+            child = child.children[0]
+        if not isinstance(child, DataSource):
+            return None, None
+        cols = {e.idx for e in agg.group_exprs if isinstance(e, Column)}
+        return child, cols
+
+    def has_unique_key(ds, col_idxs):
+        names = {ds.col_infos[i].name for i in col_idxs
+                 if i < len(ds.col_infos)}
+        info = ds.table_info
+        if info.pk_is_handle:
+            pk = next((c.name for c in info.columns
+                       if c.id == info.pk_col_id), None)
+            if pk in names:
+                return True
+        from ..model import SchemaState
+        # NULLABLE unique columns don't prove single-row groups: unique
+        # indexes admit any number of NULL rows (SQL semantics; the dup
+        # check skips NULL keys), so every key column must be NOT NULL
+        not_null = {c.name for c in info.columns
+                    if c.ftype is not None and c.ftype.not_null}
+        for idx in info.indexes:
+            if (idx.unique and idx.columns
+                    and idx.state == SchemaState.PUBLIC
+                    and all(c.name in names and c.name in not_null
+                            for c in idx.columns)):
+                return True
+        return False
+
+    def visit(p):
+        for i, c in enumerate(p.children):
+            p.children[i] = visit(c)
+        if not isinstance(p, Aggregation) or not p.group_exprs:
+            return p
+        if any(d.name not in _ELIM_AGGS for d in p.aggs):
+            return p
+        if getattr(p, "topn_fetch", None):
+            return p
+        ds, cols = key_cols_of(p)
+        if ds is None or not cols or not has_unique_key(ds, cols):
+            return p
+        ll = FieldType(tp=TYPE_LONGLONG)
+        exprs = list(p.group_exprs)
+        for d in p.aggs:
+            arg = d.args[0] if d.args else None
+            if d.name == "count":
+                from ..expression.core import Constant as _Const
+                if arg is None or (isinstance(arg, _Const)
+                                   and arg.value is not None):
+                    exprs.append(_Const(1, ll))
+                elif isinstance(arg, _Const):  # count(NULL) is 0
+                    exprs.append(_Const(0, ll))
+                else:
+                    exprs.append(ScalarFunc(
+                        "not", [ScalarFunc("isnull", [arg], ll)], ll))
+            else:
+                exprs.append(ScalarFunc("cast", [arg], d.ftype))
+        return Projection(p.children[0], exprs, p.schema)
+
+    return visit(plan)
 
 
 def eliminate_max_min(plan: LogicalPlan) -> LogicalPlan:

@@ -60,6 +60,11 @@ class Domain:
         self.gc_worker = GCWorker(self)        # MVCC safepoint GC
         self.reload_schema()
         from ..bindinfo import BindHandle
+        from ..coordinator import Coordinator
+        self.coordinator = Coordinator()       # PD/etcd role (TSO, election,
+        #                                        registry, safepoints, watch)
+        self.coordinator.register_server(
+            "tidb-0", {"version": "8.0.11-tpu-htap", "status_port": 10080})
         self.bind_handle = BindHandle(self)    # global plan bindings
         self.capture_counts: dict[str, int] = {}  # baseline capture tally
         from ..plugin import PluginRegistry
