@@ -550,6 +550,21 @@ def main():
             _stage(f"{qname}: device timed runs")
             dev_t, dev_rows = time_query(tk, sql, repeats=2)
 
+            if sf >= 10:
+                # the host (numpy) reference engine is the memory limiter
+                # at this scale — its join intermediates can OOM-kill the
+                # process (observed: Q9 SF10). Emit the measured device
+                # number FIRST so a host-side death can't erase it.
+                _emit({
+                    "metric": f"tpch_{qname}_sf{sf:g}_device_provisional",
+                    "value": round(n / dev_t),
+                    "unit": "lineitem_rows/s", "vs_baseline": 0,
+                    "device_s": round(dev_t, 4),
+                    "compile_s": round(max(warm_t - dev_t, 0.0), 4),
+                    "host_pending": True,
+                    "peak_rss_mb": _peak_rss_mb(), **meta,
+                })
+
             _stage(f"{qname}: host reference run")
             tk.must_exec("set tidb_executor_engine = 'host'")
             host_t, host_rows = time_query(tk, sql, repeats=1)
