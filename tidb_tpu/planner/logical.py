@@ -137,6 +137,8 @@ class Join(LogicalPlan):
         self.other_conds = []  # exprs over concat schema, applied post-match
         self.join_algo = "hash"   # hash | merge | index (planner/physical.py)
         self.index_join = None    # ("pk",) | ("index", IndexInfo) descriptor
+        self.join_cost = None         # chosen variant's estimated cost
+        self.cost_candidates = None   # {algo: cost} the chooser compared
 
     @property
     def left(self):

@@ -30,6 +30,12 @@ from .optimizer import _est_rows
 #: below this many estimated rows on both sides, factorization cost is
 #: noise and hash join keeps the simplest plan
 MERGE_MIN_ROWS = 4096
+#: per-build-row hash table constant (insert + key factorization) and
+#: per-comparison constant of the in-kernel merge sort, in the same
+#: per-row units as access.py's SCAN_ROW_COST/SEEK_COST so access and
+#: join decisions share one cost currency
+HASH_BUILD_COST = 2.0
+MERGE_SORT_COST = 0.05
 #: never index-join when the outer side is estimated bigger than this
 #: fraction of the inner table (seeks would exceed the scan)
 INDEX_JOIN_MAX_KEYS = 65536
@@ -169,18 +175,39 @@ def _choose(join: Join, ctx, hints=None):
     outer_est = _est_rows(join.left, ctx)
     inner_est = _est_rows(join.right, ctx)
 
-    desc = _inner_index(join)
-    if desc is not None and outer_est <= INDEX_JOIN_MAX_KEYS:
-        inner_n = inner_est
-        if ctx is not None and hasattr(ctx, "table_rows"):
-            inner_n = max(ctx.table_rows(join.right.table_info.id), 1)
-        if SEEK_BASE + outer_est * SEEK_COST < inner_n * SCAN_ROW_COST:
-            join.join_algo = "index"
-            join.index_join = desc
-            return
-
+    # ---- explicit variant enumeration (reference: every eligible
+    # physical join is costed and the cheapest wins —
+    # exhaust_physical_plans.go:1774 emits the candidates,
+    # find_best_task.go:359 compares task costs). Costs are in the same
+    # per-row units the access-path chooser uses, so seek-vs-scan and
+    # join-variant decisions share one currency.
+    #   hash : build a table over the inner rows, probe with the outer —
+    #          both sides pass once, plus a per-build-row table constant
+    #   merge: order both sides (the in-kernel sort the merge matcher
+    #          runs) — n·log n on each side, cheap constants
+    #   index: one KV seek per outer row instead of reading the inner
+    #          side at all — wins only under selective outer estimates
+    candidates = {"hash": (outer_est + inner_est) * SCAN_ROW_COST
+                  + inner_est * HASH_BUILD_COST}
     if (len(join.left_keys) == 1
             and _primitive(join.left_keys[0].ftype)
             and _primitive(join.right_keys[0].ftype)
             and min(outer_est, inner_est) >= MERGE_MIN_ROWS):
-        join.join_algo = "merge"
+        import math
+        candidates["merge"] = MERGE_SORT_COST * (
+            outer_est * math.log2(max(outer_est, 2))
+            + inner_est * math.log2(max(inner_est, 2)))
+    desc = _inner_index(join)
+    if desc is not None and outer_est <= INDEX_JOIN_MAX_KEYS:
+        # the index join still reads the outer side once; seeks replace
+        # the inner-side read entirely. Every variant prices the inner
+        # side from the SAME post-filter estimate — re-costing hash from
+        # raw table rows here would flip plans on index existence rather
+        # than on cost
+        candidates["index"] = (outer_est * SCAN_ROW_COST
+                               + SEEK_BASE + outer_est * SEEK_COST)
+    join.join_algo = min(candidates, key=candidates.get)
+    join.join_cost = round(candidates[join.join_algo], 1)
+    join.cost_candidates = {k: round(v, 1) for k, v in candidates.items()}
+    if join.join_algo == "index":
+        join.index_join = desc

@@ -1535,6 +1535,29 @@ class Session:
         plan = self.plan_query(inner)
         ft = FieldType(tp=TYPE_VARCHAR)
         if not stmt.analyze:
+            if stmt.format in ("verbose", "cost"):
+                # cost column: the physical chooser's estimate for the
+                # chosen operator variant plus the candidate set it
+                # compared (reference: EXPLAIN FORMAT='verbose' prints
+                # estCost, planner/core/explain.go)
+                from ..planner.logical import explain_nodes
+                rows = []
+                for name, info, node in explain_nodes(plan):
+                    cost = getattr(node, "join_cost", None)
+                    cands = getattr(node, "cost_candidates", None)
+                    if cost is not None and cands:
+                        ctext = (f"{cost:g} "
+                                 + "{" + ", ".join(
+                                     f"{k}:{v:g}" for k, v in
+                                     sorted(cands.items())) + "}")
+                    else:
+                        # scan est_rows already renders in the info
+                        # column (DataSource.explain_info) — no duplicate
+                        ctext = "-"
+                    rows.append((name.encode(), ctext.encode(),
+                                 info.encode()))
+                return Result(names=["id", "estCost", "info"],
+                              chunk=Chunk.from_rows([ft, ft, ft], rows))
             rows = [(name.encode(), info.encode())
                     for name, info in explain_tree(plan)]
             return Result(names=["id", "info"],
