@@ -369,6 +369,26 @@ def _plan_agg(plan, dcols):
     slots = []  # per desc: ("plain", j) | ("avg", j_sum, j_cnt) | ("strcol", j, col)
     for desc in plan.aggs:
         if desc.distinct:
+            # COUNT(DISTINCT x): the sorted kernel counts value runs per
+            # group (ops/device.py cnt_dist). Other distinct aggs (and
+            # multi-arg forms) stay host-side.
+            if (desc.name == "count" and len(desc.args) == 1
+                    and phys_kind(desc.args[0].ftype)
+                    not in (K_FLOAT, K_STR)):
+                val_plan.append((dev.compile_expr(desc.args[0], dcols),
+                                 "int"))
+                agg_ops.append("cnt_dist")
+                slots.append(("plain", len(val_plan) - 1))
+                continue
+            if (desc.name == "count" and len(desc.args) == 1
+                    and phys_kind(desc.args[0].ftype) == K_STR):
+                # dict codes are value-faithful: distinct codes ==
+                # distinct strings
+                fn, _kd, _reps = dev.compile_str_expr(desc.args[0], dcols)
+                val_plan.append((fn, "int"))
+                agg_ops.append("cnt_dist")
+                slots.append(("plain", len(val_plan) - 1))
+                continue
             raise DeviceUnsupported("distinct agg on device")
         arg = desc.args[0] if desc.args else None
         name = desc.name
@@ -605,6 +625,10 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
     (key_fns, key_meta, key_pack, val_plan, agg_ops,
      slots) = _plan_agg(plan, dcols)
     n_keys = max(len(key_fns), 1)
+    if any(op not in _MERGE_OPS for op in agg_ops):
+        # cnt_dist partial states are counts, not sets — they can't merge
+        # across blocks; the whole-input kernel handles distinct
+        raise DeviceUnsupported("non-mergeable agg in streamed pipeline")
     merge_ops = tuple(_MERGE_OPS[op] for op in agg_ops)
     sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
 

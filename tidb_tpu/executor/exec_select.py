@@ -635,11 +635,18 @@ class HashAggExec(QueryExecutor):
         raise TiDBError(f"unsupported aggregate {name}")
 
     def _eval_agg_distinct(self, desc, chunk, gids, n_groups, force_count=False):
-        """DISTINCT aggregates: dedup (group, value) then re-aggregate."""
+        """DISTINCT aggregates: dedup (group, value) then re-aggregate.
+        _ci string values dedup by their collation SORT KEY — 'abc' and
+        'ABC' are one distinct value under utf8mb4_general_ci (MySQL
+        semantics; the device kernel's ci-class codes agree)."""
         arg = desc.args[0]
         data, nulls = arg.eval(chunk)
+        from ..utils.collate import key_for_compare
+        # _ci strings dedup by collation sort key (same comparison-key
+        # helper every other host comparison site uses)
+        dedup_data = key_for_compare(data, arg.ftype)
         sub_gids, _n, first_idx = host.group_ids(
-            [(gids, np.zeros(len(gids), dtype=bool)), (data, nulls)])
+            [(gids, np.zeros(len(gids), dtype=bool)), (dedup_data, nulls)])
         d_gids = gids[first_idx]
         d_data = data[first_idx]
         d_nulls = nulls[first_idx]

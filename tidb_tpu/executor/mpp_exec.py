@@ -476,6 +476,10 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
     key_fns, key_meta, key_pack, val_plan, agg_ops, slots = _plan_agg(
         plan, dcols)
     n_keys = max(len(key_fns), 1)
+    if any(op not in _MERGE_OP for op in agg_ops):
+        # cnt_dist partial states don't merge across shards (counts, not
+        # sets) — single-chip kernel handles distinct
+        raise DeviceUnsupported("non-mergeable agg on the mesh path")
 
     leaf_cond_fns = [
         [dev.compile_expr(_shift_expr(c, leaf.offset),

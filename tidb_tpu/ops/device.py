@@ -1091,6 +1091,7 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
     """
     if (pack is not None
             and sum(b for b, _o in pack) <= _SCATTER_AGG_BITS
+            and "cnt_dist" not in agg_ops
             and jax.default_backend() == "cpu"):
         # backend-adaptive lowering: dense-bucket scatters beat the XLA CPU
         # backend's (slow, serial) sort by ~100x; on TPU scatters serialize
@@ -1121,12 +1122,17 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
         is_new = is_new & in_range
     else:
         # combined sort: minor-to-major stable argsort over keys, then
-        # kept-first. Each key is the compound (null_flag, value) — null is
-        # its own most-significant bit so a NULL never collides with any
-        # real value (NULL ≠ -1; GROUP BY groups NULLs apart from values)
+        # kept-first. Each key is the compound (null_flag, masked value) —
+        # null is its own most-significant bit so a NULL never collides
+        # with any real value (NULL ≠ -1; GROUP BY groups NULLs apart from
+        # values). The value is NULL-MASKED to 0: NULL rows carry
+        # arbitrary raw data (join-gather garbage), and sorting by it
+        # would interleave rows of distinct groups that differ only in
+        # minor keys, splintering the group blocks.
         order = jnp.arange(n)
         for i in range(n_keys - 1, -1, -1):
-            order = order[jnp.argsort(key_cols[i][order], stable=True)]
+            mk = jnp.where(key_nulls[i], 0, key_cols[i])
+            order = order[jnp.argsort(mk[order], stable=True)]
             order = order[jnp.argsort(key_nulls[i][order], stable=True)]
         order = order[jnp.argsort(~mask[order], stable=True)]
         # boundary flags on the sorted, kept prefix
@@ -1190,6 +1196,45 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
             # a NULL in the representative row must stay NULL)
             results.append(val_cols[j][rep_safe])
             result_nulls.append(val_nulls[j][rep_safe])
+            continue
+        if opn == "cnt_dist":
+            # COUNT(DISTINCT v): re-sort with the value as the MINOR key
+            # — the group blocks land on the SAME positional spans (equal
+            # multiset of group keys, stable order), so the order-1 span
+            # machinery applies unchanged; distinct = run starts among
+            # kept non-null rows (NULLs sort last per group and never
+            # start a run). Reference: executor/aggfuncs count distinct
+            # via a per-group hash set; sorted runs are the static-shape
+            # equivalent.
+            v64 = val_cols[j].astype(jnp.int64)
+            if pack is not None:
+                order2 = jnp.lexsort((v64, val_nulls[j], sort_val))
+            else:
+                order2 = jnp.arange(n)
+                order2 = order2[jnp.argsort(v64[order2], stable=True)]
+                order2 = order2[jnp.argsort(val_nulls[j][order2],
+                                            stable=True)]
+                for i in range(n_keys - 1, -1, -1):
+                    # NULL-MASKED key: a NULL group's rows carry garbage
+                    # raw key values; sorting by them would cluster the
+                    # group internally and restart value runs at every
+                    # cluster boundary (overcounting distinct). Masking
+                    # to 0 keeps the whole null group one value-sorted
+                    # block; the null-flag stage still separates it from
+                    # a real 0-keyed group.
+                    mk = jnp.where(key_nulls[i], 0, key_cols[i])
+                    order2 = order2[jnp.argsort(mk[order2], stable=True)]
+                    order2 = order2[jnp.argsort(key_nulls[i][order2],
+                                                stable=True)]
+                order2 = order2[jnp.argsort(~mask[order2], stable=True)]
+            v2 = v64[order2]
+            vn2 = val_nulls[j][order2]
+            prev_v2 = jnp.concatenate([v2[:1], v2[:-1]])
+            new_run = is_new | (v2 != prev_v2)
+            live = ~vn2 & in_range & mask[order2]
+            results.append(span_sum(jnp.where(live & new_run, 1, 0)
+                                    .astype(jnp.int64)))
+            result_nulls.append(jnp.zeros(capacity, dtype=bool))
             continue
         if opn == "count":
             _tag, nn_row = slot_plan[j]
