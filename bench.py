@@ -148,6 +148,14 @@ def _probe_backend(timeout_s: int, attempts: int, backoff_s: int):
     return "", diag
 
 
+#: held for the duration of every timed run: the keep-warm thread must not
+#: interleave its device_put with timed dispatches over the tunnel (jitter
+#: in the very numbers the bench exists to produce). A lock (not a flag)
+#: closes the check-then-dispatch race: a warm dispatch already in flight
+#: finishes before the timed section starts.
+_WARM_LOCK = threading.Lock()
+
+
 def _start_keepwarm():
     """Background thread dispatching a trivial op periodically so the
     tunnel doesn't idle out between datagen and the timed runs."""
@@ -155,10 +163,11 @@ def _start_keepwarm():
 
     def loop():
         while True:
-            try:
-                jax.device_put(1).block_until_ready()
-            except Exception:
-                return
+            with _WARM_LOCK:
+                try:
+                    jax.device_put(1).block_until_ready()
+                except Exception:
+                    return
             time.sleep(30)
 
     t = threading.Thread(target=loop, daemon=True)
@@ -424,10 +433,11 @@ def gen_all(tk, sf: float):
 def time_query(tk, sql, repeats=3):
     best = float("inf")
     rows = None
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        rows = tk.must_query(sql).rows
-        best = min(best, time.perf_counter() - t0)
+    with _WARM_LOCK:
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            rows = tk.must_query(sql).rows
+            best = min(best, time.perf_counter() - t0)
     return best, rows
 
 

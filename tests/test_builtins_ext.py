@@ -594,6 +594,23 @@ def test_truncate_exact_decimal(tk):
     assert q1(tk, "truncate(0.29, 2)") == "0.29"
 
 
+def test_truncate_decimal_keeps_decimal_type(tk):
+    # advisor r4: TRUNCATE on decimal input must keep the exact NEWDECIMAL
+    # type (MySQL: DECIMAL in → DECIMAL out), not collapse to double
+    tk.must_exec("create table trdec (d decimal(30, 6))")
+    tk.must_exec("insert into trdec values "
+                 "(123456789012345678901.654321), (-9.876543)")
+    r = tk.must_query("select truncate(d, 2) from trdec order by d").rows
+    assert [x[0] for x in r] == ["-9.87", "123456789012345678901.65"]
+    r = tk.must_query("select truncate(d, 0) from trdec order by d").rows
+    assert [x[0] for x in r] == ["-9", "123456789012345678901"]
+    r = tk.must_query("select truncate(d, -1) from trdec order by d").rows
+    assert [x[0] for x in r] == ["0", "123456789012345678900"]
+    # int input, negative digits
+    assert q1(tk, "truncate(1999, -2)") == "1900"
+    assert q1(tk, "truncate(-1999, -2)") == "-1900"
+
+
 def test_json_search_literal_star(tk):
     assert q1(tk, "json_search('[\"ab\"]', 'one', 'a*')") is None
     assert q1(tk, "json_search('[\"a*\"]', 'one', 'a*')") == '"$[0]"'
@@ -631,3 +648,15 @@ def test_release_all_locks(tk):
 
 def test_ps_current_thread_id(tk):
     assert int(q1(tk, "ps_current_thread_id()")) > 0
+
+
+def test_truncate_column_digits_and_overflow(tk):
+    # review r5: non-constant digit argument truncates per row; huge
+    # negative digits must not overflow int64
+    tk.must_exec("create table trn (x decimal(10,2), f double, n int)")
+    tk.must_exec("insert into trn values (1.23, 1.29, 1), (9.87, 9.87, 0)")
+    r = tk.must_query("select truncate(x, n), truncate(f, n) "
+                      "from trn order by x").rows
+    assert [tuple(row) for row in r] == [("1.2", "1.2"), ("9", "9")]
+    assert q1(tk, "truncate(cast(1.23 as decimal(10,2)), -19)") == "0"
+    assert q1(tk, "truncate(5, null)") is None

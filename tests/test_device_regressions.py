@@ -116,3 +116,28 @@ class TestCountDistinctDevice:
         tk.must_exec(f"insert into ng values {vals}")
         self._parity(tk, "select k, count(distinct v), count(*) from ng "
                          "group by k order by k")
+
+
+def test_engine_hint_survives_nested_subquery_eval():
+    """Advisor r4 (medium): a correlated/EXISTS subquery executed
+    mid-statement goes through Session.run_query -> build_executor, which
+    resets the statement-scoped READ_FROM_STORAGE pin on the shared
+    session; the outer statement's pin must be restored so fragments built
+    after the first subquery evaluation still honor the hint."""
+    from tidb_tpu.testkit import TestKit
+    tk = TestKit()
+    tk.must_exec("create table eh (a int, b int)")
+    tk.must_exec("insert into eh values (1, 10), (2, 20)")
+    sess = tk.session
+    sess.stmt_engine_hint = "host"  # outer statement's pin
+    from tidb_tpu.parser import parse_one
+    stmt = parse_one("select min(a) from eh")
+    rows, _fts = sess._expr_ctx.eval_subquery(stmt)
+    assert rows
+    assert sess.stmt_engine_hint == "host"
+    # and the built-plan path (uncorrelated subquery reuse)
+    plan = sess.plan_query(parse_one("select max(a) from eh"))
+    sess.stmt_engine_hint = "host"
+    rows, _fts = sess._expr_ctx.eval_built_plan(plan)
+    assert rows
+    assert sess.stmt_engine_hint == "host"

@@ -12,8 +12,39 @@ Import side effect: enables jax x64 so decimal aggregation (scaled int64) is
 exact on device — the north star requires bit-exact parity (BASELINE.md).
 """
 
+import os as _os
+
+# XLA:CPU's AOT loader logs a ~3KB ERROR line per cached program because the
+# compile-time machine string carries XLA-internal tuning pseudo-features
+# (+prefer-no-scatter/+prefer-no-gather) the loader doesn't recognize; the
+# real ISA features match (same machine). Silence the C++ log stream unless
+# the operator asked for it. Must be set before the first jax backend init.
+_os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache: fused fragment programs (a TPC-H query is ONE
+# XLA program; Q18 costs ~30s to build) are compiled once per MACHINE, not
+# once per process — the reference's prepared-plan amortization idea
+# (planner/core/cache.go) applied at the XLA layer. Opt out with
+# TIDB_TPU_JAX_CACHE=off; override the location with TIDB_TPU_JAX_CACHE=<dir>.
+_cache_dir = _os.environ.get("TIDB_TPU_JAX_CACHE", "")
+if _cache_dir != "off":
+    if not _cache_dir:
+        _cache_dir = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            ".jaxcache")
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # cache every fragment: the default 1s/small-entry filters would
+        # skip the many sub-second shrink-to-fit recompiles
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # cache is an optimization; never block startup on it
+
 __version__ = "0.1.0"
+

@@ -466,6 +466,11 @@ def import_dump(session, src: str, db_name: str | None = None,
         for th in threads:
             th.join()
         if errs:
+            # conflicts recorded before the failure must survive it: the
+            # log is the operator's record of what on_duplicate='record'
+            # skipped (the checkpoint makes the import resumable, the
+            # conflict log is not rebuilt on resume)
+            state.flush_conflicts()
             raise errs[0]
         for t in views:
             _import_one_table(session, st, state, meta, target_db, t,

@@ -470,8 +470,11 @@ class HashAggExec(QueryExecutor):
             if not len(sel):
                 continue
             sub = chunk.take(sel)
-            outs.append(self._execute_host(sub))
-            tracker.release(approx_chunk_bytes(sub))
+            out = self._execute_host(sub)
+            outs.append(out)
+            # the pass's hash-state charge is returned once its groups are
+            # handed to the parent (delivery is the parent's accounting)
+            tracker.release(approx_chunk_bytes(out))
         self.annotate(agg_spill_partitions=self.SPILL_PARTS)
         return concat_chunks(outs)
 
@@ -494,13 +497,6 @@ class HashAggExec(QueryExecutor):
     def _execute_host(self, chunk):
         tracker = self.tracker()
         p = self.plan
-        if tracker is not None:
-            from ..utils.memory import approx_chunk_bytes
-            # per-operator accounting (reference: the agg tracker holds
-            # the hash-table state, not the child's chunks): grouped agg
-            # state scales with the input; a global reduction is O(1)
-            tracker.consume(approx_chunk_bytes(chunk)
-                            if p.group_exprs else 1024)
         n = chunk.num_rows
         group_cols = [e.eval(chunk) for e in p.group_exprs]
         if p.group_exprs:
@@ -524,7 +520,18 @@ class HashAggExec(QueryExecutor):
             out_cols = []
             for desc in p.aggs:
                 out_cols.append(self._empty_agg(desc))
-        return Chunk(out_cols)
+        out = Chunk(out_cols)
+        if tracker is not None:
+            from ..utils.memory import approx_chunk_bytes
+            # per-operator accounting (reference: the agg tracker holds the
+            # hash-table STATE — one entry per group — not the child's
+            # chunks, which are the storage layer's resident columns): the
+            # state is the size of the grouped output, so a low-cardinality
+            # GROUP BY over a huge partition charges its 3 groups, not its
+            # 6000 input rows. A global reduction is O(1).
+            tracker.consume(approx_chunk_bytes(out)
+                            if p.group_exprs else 1024)
+        return out
 
     def _empty_agg(self, desc):
         from ..expression.core import _null_fill_array

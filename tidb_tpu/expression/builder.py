@@ -756,8 +756,26 @@ class ExprBuilder:
             ft = unify_types([a.ftype for a in args])
             return ScalarFunc(name, args, ft)
         if name in ("truncate",):
+            # result typing mirrors ROUND (reference: builtin_math.go —
+            # decimal in, decimal out): TRUNCATE on a wide decimal column
+            # must not collapse to binary float
             args = [self.build(a) for a in node.args]
-            return ScalarFunc("truncate", args, FieldType(tp=TYPE_DOUBLE))
+            nd_const = (len(args) <= 1
+                        or isinstance(args[1], Constant))
+            nd = 0
+            if (len(args) > 1 and isinstance(args[1], Constant)
+                    and args[1].value is not None):
+                nd = int(args[1].value)
+            src_ft = args[0].ftype
+            if phys_kind(src_ft) == K_DEC and nd_const:
+                ft = FieldType(tp=TYPE_NEWDECIMAL, flen=30,
+                               decimal=max(min(nd, src_ft.scale), 0))
+            elif phys_kind(src_ft) == K_FLOAT or not nd_const:
+                # a column-valued digit count has no static scale: double
+                ft = FieldType(tp=TYPE_DOUBLE)
+            else:
+                ft = FieldType(tp=TYPE_LONGLONG)
+            return ScalarFunc("truncate", args, ft)
         if name == "name_const":
             # NAME_CONST(name, value) evaluates to its value with the
             # value's own type (reference: builtin_miscellaneous.go)

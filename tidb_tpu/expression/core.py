@@ -1275,6 +1275,85 @@ def _eval_round(sf, chunk):
     return _div_round(d, p) * p, n
 
 
+def _eval_truncate(sf, chunk):
+    """TRUNCATE(x, d): drop digits past position d toward zero. Decimal
+    inputs stay exact scaled-int decimals (reference:
+    expression/builtin_math.go truncate keeps the decimal type); float
+    inputs go through exact decimal scaling to avoid binary-float digit
+    drift (trunc(0.29*100) is 28 in pure float64)."""
+    src = sf.args[0]
+    d, n = src.eval(chunk)
+    k = phys_kind(src.ftype)
+    if len(sf.args) > 1 and not isinstance(sf.args[1], Constant):
+        # column-valued digit count: per-row exact truncation; the result
+        # type is DOUBLE (no static scale exists — builder contract)
+        nd_d, nd_n = sf.args[1].eval(chunk)
+        from decimal import Decimal, ROUND_DOWN
+        s = src.ftype.scale if k == K_DEC else 0
+
+        def one(v, places):
+            places = max(min(int(places), 60), -60)
+            if k == K_DEC:
+                dec = Decimal(int(v)).scaleb(-s)
+            elif k == K_FLOAT:
+                if not np.isfinite(v):
+                    return float(v)
+                dec = Decimal(repr(float(v)))
+            else:
+                dec = Decimal(int(v))
+            q = dec.quantize(Decimal(1).scaleb(-places),
+                             rounding=ROUND_DOWN) if places < 60 else dec
+            return float(q)
+        out = np.array([one(v, p) if not (bool(nn) or bool(vn)) else 0.0
+                        for v, p, vn, nn in zip(d, nd_d, n, nd_n)],
+                       dtype=np.float64)
+        return out, n | nd_n
+    if (len(sf.args) > 1 and isinstance(sf.args[1], Constant)
+            and sf.args[1].value is None):
+        return d, np.ones_like(n)  # TRUNCATE(x, NULL) is NULL
+    nd = int(sf.args[1].value) if len(sf.args) > 1 else 0
+
+    def p10(e):  # exact power; POW10 covers the decimal domain, int past it
+        return POW10[e] if e < len(POW10) else 10 ** e
+
+    _I64MAX = np.iinfo(np.int64).max
+
+    def trunc_div(a, p):
+        if a.dtype != object and p > _I64MAX:
+            return np.zeros_like(a)  # |a| < p always: quotient is 0
+        return np.where(a >= 0, a // p, -((-a) // p))
+
+    def rescale(q, e):
+        p = p10(e)
+        if q.dtype != object and p > _I64MAX:
+            return np.zeros_like(q)  # q is already all-zero here
+        return q * p
+
+    if k == K_DEC:
+        s = src.ftype.scale
+        if nd >= s:
+            return d, n
+        p = p10(s - nd) if nd >= 0 else p10(s) * p10(-nd)
+        q = trunc_div(d, p)
+        if nd < 0:
+            return rescale(q, -nd), n  # output scale 0
+        out_s = sf.ftype.scale if phys_kind(sf.ftype) == K_DEC else nd
+        return (rescale(q, out_s - nd) if out_s > nd else q), n
+    if k == K_FLOAT:
+        from decimal import Decimal, ROUND_DOWN
+        qd = Decimal(1).scaleb(-nd)
+        out = np.array([
+            float(Decimal(repr(float(v))).quantize(qd, rounding=ROUND_DOWN))
+            if np.isfinite(v) else float(v)
+            for v in np.asarray(d, dtype=np.float64)], dtype=np.float64)
+        return out, n
+    if nd >= 0:
+        return d, n
+    p = p10(-nd)
+    q = trunc_div(d, p)
+    return (q * p if p <= _I64MAX else q), n
+
+
 def _eval_ceil(sf, chunk):
     d, n = sf.args[0].eval(chunk)
     k = phys_kind(sf.args[0].ftype)
@@ -1385,7 +1464,8 @@ _DISPATCH = {
     "date_arith": _eval_date_arith,
     "datediff": _eval_datediff, "date": _eval_date,
     "date_format": _eval_date_format,
-    "abs": _eval_abs, "round": _eval_round, "ceil": _eval_ceil,
+    "abs": _eval_abs, "round": _eval_round, "truncate": _eval_truncate,
+    "ceil": _eval_ceil,
     "floor": _eval_floor, "sign": _eval_sign, "pow": _eval_pow,
     "sqrt": _float_fn(np.sqrt), "exp": _float_fn(np.exp),
     "ln": _float_fn(np.log), "log2": _float_fn(np.log2),

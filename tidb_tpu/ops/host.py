@@ -8,6 +8,8 @@ sort + searchsorted joins) so host/device parity is structural.
 
 from __future__ import annotations
 
+import zlib
+
 import numpy as np
 
 
@@ -202,6 +204,18 @@ def _mix64_np(u):
     return u
 
 
+def _stable_obj_hash(x):
+    """Process-stable hash for object keys (str/bytes). Python's hash() is
+    randomized per process (PYTHONHASHSEED), which made spill partition
+    layout — and therefore whether a pass fit its quota — nondeterministic
+    across runs. crc32 is stable, C-speed, and feeds a 64-bit mixer."""
+    if isinstance(x, str):
+        x = x.encode("utf-8", "surrogatepass")
+    elif isinstance(x, bytearray):
+        x = bytes(x)
+    return zlib.crc32(x)
+
+
 def partition_ids(key_cols, n_parts):
     """Deterministic hash-partition id per row over [(data, nulls)] key
     columns (reference: the spill paths hash-partition build/probe/agg
@@ -223,8 +237,8 @@ def partition_ids(key_cols, n_parts):
                 hv = np.fromiter((x & mask for x in d), dtype=np.uint64,
                                  count=n)
             else:
-                hv = np.fromiter((hash(x) for x in d), dtype=np.int64,
-                                 count=n).view(np.uint64)
+                hv = np.fromiter((_stable_obj_hash(x) for x in d),
+                                 dtype=np.int64, count=n).view(np.uint64)
         elif d.dtype.kind == "f":
             dd = np.where(d == 0, 0.0, d).astype(np.float64)  # -0.0 == 0.0
             hv = dd.view(np.uint64)

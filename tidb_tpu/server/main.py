@@ -73,6 +73,14 @@ def run_server(cfg, ready_event: threading.Event | None = None):
         domain.priv.disabled = True
         domain.priv.enabled = False
 
+    # server mode: liveness is real — re-register with a finite TTL so a
+    # wedged process ages out of the registry; the stats worker's periodic
+    # sweep heartbeats the lease (domain/infosync keepalive analog). The
+    # embedded deployment keeps the infinite-TTL registration from
+    # bootstrap (nothing heartbeats an idle library user).
+    domain.coordinator.register_server(
+        "tidb-0", {"version": "8.0.11-tpu-htap",
+                   "status_port": cfg.status.status_port}, ttl_s=60.0)
     domain.stats_worker.start()  # auto-analyze loop (domain.go:1270 analog)
     domain.gc_worker.start()     # MVCC safepoint GC (store/gcworker analog)
     domain.topsql.start()        # CPU attribution sampler (util/topsql)

@@ -63,8 +63,14 @@ class Domain:
         from ..coordinator import Coordinator
         self.coordinator = Coordinator()       # PD/etcd role (TSO, election,
         #                                        registry, safepoints, watch)
+        # infinite TTL for the embedded single-process deployment: nothing
+        # would heartbeat an idle embedded domain, and a registry that
+        # forgets its only server after 60s idle is wrong there. Server
+        # mode keeps liveness real: the stats worker loop heartbeats, so a
+        # wedged process still ages out of a (future) shared registry.
         self.coordinator.register_server(
-            "tidb-0", {"version": "8.0.11-tpu-htap", "status_port": 10080})
+            "tidb-0", {"version": "8.0.11-tpu-htap", "status_port": 10080},
+            ttl_s=float("inf"))
         self.bind_handle = BindHandle(self)    # global plan bindings
         self.capture_counts: dict[str, int] = {}  # baseline capture tally
         from ..plugin import PluginRegistry
@@ -185,7 +191,16 @@ class _ExprCtx:
         self.params = None
 
     def eval_subquery(self, select, limit_one=False, outer=None):
-        res = self.session.run_query(select, outer=outer)
+        # mid-statement nested execution: the inner build_executor resets
+        # the statement-scoped READ_FROM_STORAGE pin on the (shared)
+        # session, so restore the OUTER statement's pin afterwards —
+        # fragments built after the first subquery evaluation must still
+        # honor the outer hint
+        saved = getattr(self.session, "stmt_engine_hint", None)
+        try:
+            res = self.session.run_query(select, outer=outer)
+        finally:
+            self.session.stmt_engine_hint = saved
         fts = res.ftypes
         rows = res.internal_rows
         if limit_one:
@@ -195,7 +210,11 @@ class _ExprCtx:
     def eval_built_plan(self, plan, limit_one=False):
         """Execute an already-built logical plan (uncorrelated subquery
         whose analysis plan is reusable)."""
-        res = self.session.run_built_query(plan)
+        saved = getattr(self.session, "stmt_engine_hint", None)
+        try:
+            res = self.session.run_built_query(plan)
+        finally:
+            self.session.stmt_engine_hint = saved
         rows = res.internal_rows
         if limit_one:
             rows = rows[:1]

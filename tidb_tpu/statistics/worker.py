@@ -60,6 +60,12 @@ class StatsWorker:
         """One auto-analyze sweep; returns the table ids re-analyzed."""
         dom = self.domain
         try:
+            # piggyback the server-registry heartbeat on the periodic sweep
+            # (reference: domain/infosync keepalive loop)
+            dom.coordinator.heartbeat("tidb-0")
+        except Exception:
+            pass
+        try:
             ratio = float(dom.global_vars.get("tidb_auto_analyze_ratio",
                                               "0.5"))
             enabled = dom.global_vars.get("tidb_enable_auto_analyze",
