@@ -578,7 +578,8 @@ _MERGE_OPS = {"count": "sum_i", "sum_i": "sum_i", "sum_f": "sum_f",
               "min": "min", "max": "max", "first": "first"}
 
 
-def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -> Chunk:
+def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
+                         ctx=None, allow_single=False) -> Chunk:
     """Streamed fused filter+group+aggregate: the input is cut into
     `batch_rows` blocks; each block's columns transfer to HBM and run the
     SAME jitted partial-agg program while the next block's transfer is
@@ -591,7 +592,9 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
     n = chunk.num_rows
     if n == 0:
         raise DeviceUnsupported("empty input")
-    if batch_rows <= 0 or n <= batch_rows:
+    if batch_rows <= 0 or (n <= batch_rows and not allow_single):
+        # whole-input kernel is cheaper — except for paged inputs, whose
+        # memmap slices must flow through here regardless of block count
         raise DeviceUnsupported("input fits one batch")
     used = _agg_used_columns(plan, conds)
     if not used:
@@ -603,7 +606,7 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
     dcols = {}
     for idx in used:
         col = chunk.columns[idx]
-        if col.data.dtype == object:
+        if col.is_object():
             from ..utils.collate import is_ci
             if is_ci(col.ftype.collate):
                 codes, key_dict, reps = col.dict_encode_ci(col.ftype.collate)
@@ -634,14 +637,19 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
 
     est = _estimate_groups(plan, n, ctx)
     capacity = dev.next_pow2(min(batch_rows, max(est, 16)))
-    while True:
+    merge_cap = capacity  # grows to the true total on merge overflow
+    for _attempt in range(8):
         key = (sig_exprs, "stream", capacity, key_pack, tuple(agg_ops))
         fn = _pipe_cache_get(key)
         if fn is None:
             fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
                                  tuple(agg_ops), capacity, key_pack)
             _pipe_cache_put(key, fn, dict_refs)
-        partials = []
+        k_flush = max(1, _MERGE_BUDGET_ROWS // capacity)
+        state = None
+        buffered = []
+        max_ng = 0
+        overflow = False
         for lo in range(0, n, batch_rows):
             hi = min(lo + batch_rows, n)
             # the asarray calls enqueue this block's host→HBM copies; the
@@ -649,44 +657,76 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int, ctx=None) -
             # overlaps block k's compute
             env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
                    for idx, (d, nl) in col_arrays.items()}
-            partials.append(fn(env))
-        # one sync point: every block's group count
-        counts = jax.device_get([p[4] for p in partials])
-        if all(int(c) <= capacity for c in counts):
-            break
-        capacity = dev.next_pow2(max(int(c) for c in counts))
-
-    # merge partial states: valid partial slots re-aggregate by key
-    key_cat = tuple(
-        jnp.concatenate([p[0][k] for p in partials])
-        for k in range(n_keys))
-    key_null_cat = tuple(
-        jnp.concatenate([p[1][k] for p in partials])
-        for k in range(n_keys))
-    val_cat = tuple(
-        jnp.concatenate([p[2][j] for p in partials])
-        for j in range(len(val_plan)))
-    val_null_cat = tuple(
-        jnp.concatenate([p[3][j] for p in partials])
-        for j in range(len(val_plan)))
-    mask = jnp.concatenate([
-        jnp.arange(capacity) < p[4] for p in partials])
-    total = int(mask.shape[0])
-    merge_cap = dev.next_pow2(max(max(int(c) for c in counts), 16))
-    while True:
-        out = jax.device_get(dev._agg_impl(
-            key_cat, key_null_cat, val_cat, val_null_cat, mask,
-            n_keys=n_keys, agg_ops=merge_ops,
-            capacity=min(merge_cap, dev.next_pow2(total)), pack=key_pack))
-        key_out, key_null_out, results, result_nulls, n_groups, _valid = out
-        ng = int(n_groups)
-        if ng <= merge_cap:
-            break
-        merge_cap = dev.next_pow2(ng)
+            buffered.append(fn(env))
+            if len(buffered) >= k_flush:
+                # incremental fold: HBM holds at most k_flush partials +
+                # the running state, never all n/batch_rows of them
+                ngs = [int(g) for g in
+                       jax.device_get([p[4] for p in buffered])]
+                max_ng = max(max_ng, *ngs)
+                if max_ng > capacity:
+                    overflow = True
+                    break
+                state, merge_cap = merge_partial_states(
+                    state, buffered, merge_cap, n_keys, len(val_plan),
+                    merge_ops, key_pack)
+                buffered = []
+        if not overflow and buffered:
+            ngs = [int(g) for g in jax.device_get([p[4] for p in buffered])]
+            max_ng = max(max_ng, *ngs)
+            if max_ng <= capacity:
+                state, merge_cap = merge_partial_states(
+                    state, buffered, merge_cap, n_keys, len(val_plan),
+                    merge_ops, key_pack)
+                buffered = []
+        if overflow or max_ng > capacity:
+            capacity = dev.next_pow2(max_ng)
+            continue
+        break
+    else:
+        raise DeviceUnsupported("streamed agg capacity did not converge")
+    if state is None:
+        raise DeviceUnsupported("empty streamed input")
+    out = jax.device_get(state[:5])
+    key_out, key_null_out, results, result_nulls, n_groups = out
+    ng = int(n_groups)
     if ng == 0 and not plan.group_exprs:
         raise DeviceUnsupported("empty global aggregate")
     return _assemble_agg(plan, key_meta, slots, dcols,
                          (key_out, key_null_out, results, result_nulls), ng)
+
+
+#: partial-aggregate rows buffered on device before a merge flush (shared
+#: by the streamed scan-agg and the paged probe join)
+_MERGE_BUDGET_ROWS = 1 << 25
+
+
+def merge_partial_states(state, parts, merge_cap, n_keys, nvals, merge_ops,
+                         key_pack):
+    """Fold buffered partial-agg states (+ the running state) into ONE
+    merged state of `merge_cap` output slots via the mergeable-agg kernel;
+    grows merge_cap on overflow (inputs stay alive, so the retry is
+    exact). Returns (state, merge_cap) — state is an _agg_impl output
+    tuple whose [4] is the live group count."""
+    alls = ([state] if state is not None else []) + list(parts)
+    key_cat = tuple(jnp.concatenate([p[0][k] for p in alls])
+                    for k in range(n_keys))
+    key_null_cat = tuple(jnp.concatenate([p[1][k] for p in alls])
+                         for k in range(n_keys))
+    val_cat = tuple(jnp.concatenate([p[2][j] for p in alls])
+                    for j in range(nvals))
+    val_null_cat = tuple(jnp.concatenate([p[3][j] for p in alls])
+                         for j in range(nvals))
+    mask = jnp.concatenate([
+        jnp.arange(p[0][0].shape[0]) < p[4] for p in alls])
+    while True:
+        out = dev._agg_impl(key_cat, key_null_cat, val_cat, val_null_cat,
+                            mask, n_keys=n_keys, agg_ops=merge_ops,
+                            capacity=merge_cap, pack=key_pack)
+        ng = int(jax.device_get(out[4]))
+        if ng <= merge_cap:
+            return out, merge_cap
+        merge_cap = dev.next_pow2(ng)
 
 
 def device_join_keys(lkeys, rkeys):

@@ -137,11 +137,24 @@ def _leaf_env(leaf):
     return leaf.dcols
 
 
-def _global_dcols(leaves):
-    """DeviceCol lookup keyed by global (join-output) column index."""
+def _leaf_meta(leaf):
+    """Metadata-only DeviceCols for one leaf (no HBM transfer): what the
+    expression compiler and agg planner read. The actual arrays reach the
+    compiled program through `env` — whole columns on the resident path,
+    page slices on the paged path."""
+    return {i: dev.meta_device_col(c)[0]
+            for i, c in enumerate(leaf.chunk.columns)}
+
+
+def _global_dcols(leaves, meta_leaf_ids=frozenset()):
+    """DeviceCol lookup keyed by global (join-output) column index.
+    Leaves in `meta_leaf_ids` contribute metadata-only DeviceCols —
+    their columns must never be uploaded whole (paged probe side)."""
     out = {}
     for leaf in leaves:
-        for i, dc in _leaf_env(leaf).items():
+        dcs = (_leaf_meta(leaf) if leaf.leaf_id in meta_leaf_ids
+               else _leaf_env(leaf))
+        for i, dc in dcs.items():
             out[leaf.offset + i] = dc
     return out
 
@@ -160,8 +173,14 @@ def _leaf_key_cols(side, keys):
         if not isinstance(k, ExprColumn) or not 0 <= k.idx < side.ncols:
             return None
         c = side.chunk.columns[k.idx]
-        if (c.data.dtype == object
+        if (c.is_object()
                 or not np.issubdtype(c.data.dtype, np.integer)):
+            return None
+        from ..storage.paged import is_paged
+        if is_paged(c) and side.chunk.num_rows * 16 > _DIM_RESIDENT_BUDGET:
+            # indexing (argsort + order arrays) a fact-sized memmap would
+            # materialize it into RAM at PLAN time — a paged fact is only
+            # ever the streamed probe, never a build index
             return None
         cols.append(c)
     return cols
@@ -462,28 +481,38 @@ def _pack_probe(kds, knulls, pvalid, packs):
 
 
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
-                     capacity, key_pack, agg_meta, compact_cap=None):
+                     capacity, key_pack, agg_meta, compact_cap=None,
+                     paged_leaf=None):
     """Build the jitted end-to-end program. caps: per-join static
-    capacities aligned with `joins`. Returns jitted fn(env, jidx) where
-    env is {global_col: (data, nulls)} and jidx is a per-join tuple of
-    host-index device arrays (passed as arguments, not baked, so a data
+    capacities aligned with `joins`. Returns jitted fn(env, jidx[, n_live])
+    where env is {global_col: (data, nulls)} and jidx is a per-join tuple
+    of host-index device arrays (passed as arguments, not baked, so a data
     refresh with unchanged shapes reuses the compiled program).
 
     compact_cap: when set (CPU backend, learned from a prior run), the
     post-join/filter rows are scatter-compacted to this static width
     before the aggregate — a fact-shaped fragment output with a sparse
     validity mask (the price of the gather-join design) would otherwise
-    drag the full fact length through the group-by sort."""
+    drag the full fact length through the group-by sort.
+
+    paged_leaf: leaf_id whose env arrays are PAGE SLICES of the fact
+    table; the program takes an extra traced scalar `n_live` and masks
+    that leaf's rows past it (the last page is padded to the static page
+    shape — padding rows must not survive the scan filter)."""
     for jn, cap in zip(joins, caps):
         jn.cap = cap
 
-    dcols = _global_dcols(leaves)
+    # metadata-only planning view: compiling expressions must not upload
+    # any column (the paged probe's columns never transfer whole)
+    leaf_metas = [_leaf_meta(leaf) for leaf in leaves]
+    dcols = {leaf.offset + i: dc
+             for leaf, m in zip(leaves, leaf_metas) for i, dc in m.items()}
     # compile every expression up-front (host-side planning); leaf conds
     # are written against the scan's LOCAL schema → rebase to global
     leaf_cond_fns = [
         [dev.compile_expr(_shift_expr(c, leaf.offset),
                           {leaf.offset + i: dc
-                           for i, dc in _leaf_env(leaf).items()})
+                           for i, dc in leaf_metas[leaf.leaf_id].items()})
          for c in leaf.conds] for leaf in leaves]
     # key/other-cond/agg expressions are compiled against global offsets
     # (reordered nodes carry globally-indexed exprs already)
@@ -500,10 +529,14 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
     cond_fns = [dev.compile_expr(c, dcols) for c in agg_conds]
     key_fns, val_plan, agg_ops, slots = agg_meta
 
-    def run(env, jidx):
+    def run(env, jidx, n_live=None):
         # env keyed by global column index → (data, nulls) on device
         def leaf_rel(leaf):
-            n = next(iter(_leaf_env(leaf).values())).data.shape[0]
+            # row count off the leaf's first env-present column (a pruned
+            # env — paged path — carries only the fragment's used columns)
+            n = next(env[leaf.offset + i][0].shape[0]
+                     for i in range(leaf.ncols)
+                     if leaf.offset + i in env)
             if leaf_cond_fns[leaf.leaf_id]:
                 mask = None
                 for f in leaf_cond_fns[leaf.leaf_id]:
@@ -513,6 +546,8 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                 mask = jnp.broadcast_to(mask, (n,))
             else:
                 mask = jnp.ones(n, dtype=bool)
+            if paged_leaf is not None and leaf.leaf_id == paged_leaf:
+                mask = mask & (jnp.arange(n) < n_live)
             return {leaf.leaf_id: jnp.arange(n)}, mask
 
         overflows = []
@@ -527,7 +562,10 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                 if leaf.leaf_id in idxmap and leaf.leaf_id in node.leaf_ids:
                     idx = idxmap[leaf.leaf_id]
                     for i in range(leaf.ncols):
-                        d, nl = env[leaf.offset + i]
+                        hit = env.get(leaf.offset + i)
+                        if hit is None:  # pruned (unused) column
+                            continue
+                        d, nl = hit
                         out[leaf.offset + i] = (d[idx], nl[idx])
             return out
 
@@ -726,6 +764,42 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     else:
         for jn in joins:
             jn.strategy = _plan_strategy(jn)
+
+    # paged-probe dispatch: a disk-backed (or huge) fact side must stream
+    # pages — uploading it whole would exceed HBM (and at SF100, RAM)
+    from ..storage.paged import chunk_is_paged, DEFAULT_PAGE_ROWS
+    probe = _probe_spine(root)
+    any_paged = any(chunk_is_paged(leaf.chunk) for leaf in leaves)
+    pageable = (isinstance(probe, _Leaf) and all(
+        jn.strategy is not None and jn.strategy[0] == "uniq"
+        and jn.strategy[1] == "right" for jn in joins))
+    if any_paged and not pageable:
+        # the resident path would read entire memmaps into RAM + HBM; a
+        # fragment shape outside the paged language goes to the host
+        # executors, which stream
+        raise DeviceUnsupported("paged leaf outside streamed-probe language")
+    if pageable:
+        paged = chunk_is_paged(probe.chunk)
+        if any_paged and not paged:
+            raise DeviceUnsupported("paged build-side leaf (resident "
+                                    "uploads of a disk table are barred)")
+        try:
+            page_rows = int(ctx.get_sysvar("tidb_device_stream_rows"))
+        except Exception:
+            page_rows = 0
+        stream_off = page_rows < 0  # -1: resident inputs never auto-page
+        if page_rows <= 0:
+            page_rows = DEFAULT_PAGE_ROWS
+        if paged or (not stream_off and probe.chunk.num_rows
+                     > max(_PAGED_MIN_ROWS, page_rows * 4)):
+            try:
+                return _paged_join_agg(root, leaves, joins, probe, agg_plan,
+                                       agg_conds, ctx, page_rows)
+            except DeviceUnsupported:
+                if paged:
+                    # whole-table upload of a disk-resident fact is not a
+                    # fallback — let the host path stream it instead
+                    raise
     dcols = _global_dcols(leaves)
     agg_meta_full = _plan_agg(agg_plan, dcols)
     key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
@@ -853,13 +927,216 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     return _assemble_agg(agg_plan, key_meta, slots, dcols, body, f.out_rows)
 
 
+#: resident probe tables larger than this stream through pages even
+#: without a disk-backed store (bounds HBM at big scale factors)
+_PAGED_MIN_ROWS = 1 << 24
+
+
+def _probe_spine(root):
+    node = root
+    while isinstance(node, _JoinNode):
+        node = node.left
+    return node
+
+
+#: a paged BUILD-side table may be deliberately materialized into HBM up
+#: to this many bytes (needed columns only): SF100 orders as a Q3 build
+#: side is ~5GB of used columns — resident is the right call on a 16GB
+#: chip, but an unbounded upload would defeat the paged memory bound
+_DIM_RESIDENT_BUDGET = 6 << 30
+
+
+def _fragment_used_cols(leaves, joins, agg_plan, agg_conds):
+    """Global column indices the fragment actually reads — per-page probe
+    transfers and dim uploads carry only these (a 16-wide fact scanned
+    for 4 columns must not pay 4x the tunnel bytes)."""
+    used = set()
+    for leaf in leaves:
+        for c in leaf.conds:
+            s = set()
+            c.columns_used(s)
+            used.update(leaf.offset + i for i in s)
+    for jn in joins:
+        off_l = 0 if jn.global_keys else jn.left.offset
+        off_r = 0 if jn.global_keys else jn.right.offset
+        off_o = 0 if jn.global_keys else jn.offset
+        for k in jn.left_keys:
+            s = set()
+            k.columns_used(s)
+            used.update(off_l + i for i in s)
+        for k in jn.right_keys:
+            s = set()
+            k.columns_used(s)
+            used.update(off_r + i for i in s)
+        for c in jn.other_conds:
+            s = set()
+            c.columns_used(s)
+            used.update(off_o + i for i in s)
+    for e in agg_plan.group_exprs:
+        s = set()
+        e.columns_used(s)
+        used.update(s)
+    for d in agg_plan.aggs:
+        for a in d.args:
+            s = set()
+            a.columns_used(s)
+            used.update(s)
+    for c in agg_conds:
+        s = set()
+        c.columns_used(s)
+        used.update(s)
+    return used
+
+
+def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
+                    page_rows):
+    """Streamed-probe execution of an all-unique-build join chain: the
+    fact leaf is cut into `page_rows` pages; each page runs the SAME
+    compiled scan→gather-joins→partial-agg program (dimension tables and
+    their join indexes stay HBM-resident across pages); per-page partial
+    states buffer on device and fold into one running merged state via
+    the mergeable-agg kernel. Device memory is bounded by
+    page + buffered partials + merge state — never the fact table. This
+    is the engine's cop-paging analog (reference kv/kv.go:349-350: the
+    coprocessor streams a large scan in pages; here each page carries the
+    whole join+agg fragment with it)."""
+    if any(jn.strategy is None or jn.strategy[0] != "uniq" for jn in joins):
+        raise DeviceUnsupported("paged probe requires all-unique builds")
+    # planning view is metadata-only for EVERY leaf: the only uploads are
+    # the pruned env_dim ones below, AFTER the resident-budget check
+    dcols = _global_dcols(leaves, meta_leaf_ids=frozenset(
+        leaf.leaf_id for leaf in leaves))
+    agg_meta_full = _plan_agg(agg_plan, dcols)
+    key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
+    from .device_exec import (
+        _MERGE_BUDGET_ROWS, _MERGE_OPS, AggFetch, resolve_topn)
+    if any(op not in _MERGE_OPS for op in agg_ops):
+        raise DeviceUnsupported("non-mergeable agg in paged fragment")
+    merge_ops = tuple(_MERGE_OPS[op] for op in agg_ops)
+    agg_meta = (key_fns, val_plan, agg_ops, slots)
+
+    used = _fragment_used_cols(leaves, joins, agg_plan, agg_conds)
+    # leaf_rel reads each leaf's row count off its first env entry — keep
+    # at least one column per leaf alive
+    for leaf in leaves:
+        if not any(leaf.offset + i in used for i in range(leaf.ncols)):
+            used.add(leaf.offset)
+    from ..storage.paged import chunk_is_paged
+    env_dim = {}
+    for leaf in leaves:
+        if leaf.leaf_id == probe.leaf_id:
+            continue
+        lused = [i for i in range(leaf.ncols) if leaf.offset + i in used]
+        if chunk_is_paged(leaf.chunk):
+            est = 8 * leaf.chunk.num_rows * len(lused)
+            if est > _DIM_RESIDENT_BUDGET:
+                raise DeviceUnsupported(
+                    "paged build-side leaf exceeds resident budget")
+        for i in lused:
+            dc = dev.to_device_col(leaf.chunk.columns[i])
+            env_dim[leaf.offset + i] = (dc.data, dc.nulls)
+    probe_arrays = {
+        probe.offset + i: dev.meta_device_col(c)[1]
+        for i, c in enumerate(probe.chunk.columns)
+        if probe.offset + i in used}
+    jidx = tuple(jn.strategy[2].device_arrays() for jn in joins)
+    sig = fragment_sig(leaves, joins, agg_conds, agg_plan) + f"|pg{page_rows}"
+    dict_refs = tuple(dc.dictionary for dc in dcols.values()
+                      if dc.dictionary is not None)
+
+    n = probe.chunk.num_rows
+    n_keys = max(len(key_fns), 1)
+    nvals = len(val_plan)
+    learned = _CAP_STORE.get((sig, "agg"))
+    if learned is not None:
+        capacity = dev.next_pow2(max(learned, 16))
+    else:
+        est = _estimate_groups(agg_plan, min(n, page_rows), ctx)
+        capacity = dev.next_pow2(min(page_rows, max(est, 16)))
+    learned_total = _CAP_STORE.get((sig, "groups"))
+    merge_cap = dev.next_pow2(max(learned_total or capacity, 16))
+
+    def pad_page(arr, lo, hi):
+        blk = np.asarray(arr[lo:hi])
+        if hi - lo < page_rows:
+            blk = np.concatenate(
+                [blk, np.zeros(page_rows - (hi - lo), dtype=blk.dtype)])
+        return jnp.asarray(blk)
+
+    from .device_exec import merge_partial_states
+
+    def merge_flush(state, buffered, merge_cap):
+        return merge_partial_states(state, buffered, merge_cap, n_keys,
+                                    nvals, merge_ops, key_pack)
+
+    for jn in joins:
+        jn.cap = page_rows  # every join is a probe-shaped gather
+    for _attempt in range(4):
+        caps = [page_rows] * len(joins)
+        key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops), None,
+               "paged")
+        fn = _pipe_cache_get(key)
+        if fn is None:
+            fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
+                                  caps, capacity, key_pack, agg_meta,
+                                  paged_leaf=probe.leaf_id)
+            _pipe_cache_put(key, fn, dict_refs)
+        k_flush = max(1, _MERGE_BUDGET_ROWS // capacity)
+        state = None
+        buffered = []
+        max_ng = 0
+        overflow = False
+        for lo in range(0, n, page_rows):
+            hi = min(lo + page_rows, n)
+            env = dict(env_dim)
+            for gidx, (d, nl) in probe_arrays.items():
+                env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi))
+            agg_out, _ovf, _sovf, _kept = fn(env, jidx, hi - lo)
+            buffered.append(agg_out)
+            if len(buffered) >= k_flush:
+                ngs = [int(g) for g in
+                       jax.device_get([p[4] for p in buffered])]
+                max_ng = max(max_ng, *ngs)
+                if max_ng > capacity:
+                    overflow = True
+                    break
+                state, merge_cap = merge_flush(state, buffered, merge_cap)
+                buffered = []
+        if not overflow and buffered:
+            ngs = [int(g) for g in jax.device_get([p[4] for p in buffered])]
+            max_ng = max(max_ng, *ngs)
+            if max_ng <= capacity:
+                state, merge_cap = merge_flush(state, buffered, merge_cap)
+                buffered = []
+        if overflow or max_ng > capacity:
+            # a page's group count exceeded the partial capacity: restart
+            # the pass at the observed size (remembered, so the discovery
+            # restart happens once per fragment ever)
+            capacity = dev.next_pow2(max_ng)
+            _cap_store_put((sig, "agg"), max_ng)
+            continue
+        _cap_store_put((sig, "agg"), max(max_ng, 1))
+        break
+    else:
+        raise DeviceUnsupported("paged fragment capacity did not converge")
+    if state is None:
+        raise DeviceUnsupported("empty paged fragment input")
+    f = AggFetch(state, topn=resolve_topn(agg_plan, slots))
+    ng = f.ng
+    _cap_store_put((sig, "groups"), ng)
+    if ng == 0 and not agg_plan.group_exprs:
+        raise DeviceUnsupported("empty global aggregate")
+    body = f.body()
+    return _assemble_agg(agg_plan, key_meta, slots, dcols, body, f.out_rows)
+
+
 def fragment_sig(leaves, joins, agg_conds, agg_plan):
     parts = []
     for leaf in leaves:
         parts.append(f"L{leaf.leaf_id}@{leaf.offset}x{leaf.ncols}:"
                      + ";".join(_expr_sig(c) for c in leaf.conds))
         for c in leaf.chunk.columns:
-            if c.data.dtype == object:
+            if c.is_object():
                 parts.append(str(id(c.dict_encode()[1])))
     for jn in joins:
         keys = ",".join(f"{_expr_sig(lk)}={_expr_sig(rk)}"

@@ -379,7 +379,11 @@ class HashAggExec(QueryExecutor):
         # MPP: the same fused fragment, SPMD over the session's device mesh
         # (partition-parallel partial agg / broadcast join + collectives)
         from .mpp_exec import mpp_mesh, mpp_agg, mpp_join_agg
+        from ..storage.paged import chunk_is_paged, DEFAULT_PAGE_ROWS
         mesh = mpp_mesh(self.ctx)
+        if mesh is not None and raw is not None and chunk_is_paged(raw):
+            mesh = None  # MPP shards whole columns; a disk table must
+            #              stream through the paged single-chip pipeline
         if mesh is not None:
             try:
                 if raw is not None:
@@ -401,21 +405,38 @@ class HashAggExec(QueryExecutor):
                 batch = int(self.ctx.get_sysvar("tidb_device_stream_rows"))
             except Exception:
                 batch = 0
-            if batch > 0 and raw.num_rows > batch:
+            paged_in = chunk_is_paged(raw)
+            if batch == 0:
+                # auto: a paged (disk-resident) input MUST stream — its
+                # columns exceed what one transfer (or one chip's HBM)
+                # should hold; very large RAM-resident inputs stream too,
+                # bounding HBM by the page size instead of the table.
+                # batch=-1 opts resident inputs out of auto-streaming
+                # (debug/bench escape hatch); paged inputs always stream.
+                if paged_in or raw.num_rows > 4 * DEFAULT_PAGE_ROWS:
+                    batch = DEFAULT_PAGE_ROWS
+            elif batch < 0:
+                batch = DEFAULT_PAGE_ROWS if paged_in else 0
+            if batch > 0 and (paged_in or raw.num_rows > batch):
                 from .device_exec import device_agg_streaming
                 try:
                     out = device_agg_streaming(eff_p, raw, conds, batch,
-                                               ctx=self.ctx)
+                                               ctx=self.ctx,
+                                               allow_single=paged_in)
                     self._mark_fragment("tpu-stream", raw.num_rows)
                     return out
                 except DeviceUnsupported:
                     pass
-            try:
-                out = device_agg(eff_p, raw, conds, ctx=self.ctx)
-                self._mark_fragment("tpu", raw.num_rows)
-                return out
-            except DeviceUnsupported:
-                pass
+            if not paged_in:
+                # a paged chunk must NOT fall through to the whole-input
+                # pipeline: to_device_col would read the entire memmap into
+                # RAM + HBM — the exact failure paging exists to prevent
+                try:
+                    out = device_agg(eff_p, raw, conds, ctx=self.ctx)
+                    self._mark_fragment("tpu", raw.num_rows)
+                    return out
+                except DeviceUnsupported:
+                    pass
         # join fragment: HashAgg over an (inner equi-)join tree of scans
         # fuses scans+filters+joins+aggregate into one device program
         if raw is None and isinstance(join_child, HashJoinExec):

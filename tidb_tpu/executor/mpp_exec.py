@@ -372,6 +372,11 @@ def mpp_join_agg(agg_plan, agg_conds, child_exec, ctx, mesh):
     """join-tree→group-by fragment over the mesh: probe spine sharded,
     build sides broadcast (the broadcast hash join MPP variant)."""
     root, leaves, joins = collect_tree(child_exec)
+    from ..storage.paged import chunk_is_paged
+    if any(chunk_is_paged(leaf.chunk) for leaf in leaves):
+        # MPP shards whole resident columns across the mesh; a disk-backed
+        # table must stream through the paged single-chip pipeline instead
+        raise DeviceUnsupported("paged leaf in MPP fragment")
     return _run_mpp(agg_plan, agg_conds, root, leaves, joins, ctx, mesh)
 
 

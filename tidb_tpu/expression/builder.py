@@ -770,8 +770,10 @@ class ExprBuilder:
             if phys_kind(src_ft) == K_DEC and nd_const:
                 ft = FieldType(tp=TYPE_NEWDECIMAL, flen=30,
                                decimal=max(min(nd, src_ft.scale), 0))
-            elif phys_kind(src_ft) == K_FLOAT or not nd_const:
-                # a column-valued digit count has no static scale: double
+            elif (phys_kind(src_ft) in (K_FLOAT, K_STR) or not nd_const):
+                # a column-valued digit count has no static scale; string
+                # inputs coerce to a numeric double (MySQL: TRUNCATE
+                # ('1.999', 1) -> 1.9, not an integer)
                 ft = FieldType(tp=TYPE_DOUBLE)
             else:
                 ft = FieldType(tp=TYPE_LONGLONG)

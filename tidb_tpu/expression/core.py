@@ -1291,6 +1291,8 @@ def _eval_truncate(sf, chunk):
         from decimal import Decimal, ROUND_DOWN
         s = src.ftype.scale if k == K_DEC else 0
 
+        from decimal import localcontext
+
         def one(v, places):
             places = max(min(int(places), 60), -60)
             if k == K_DEC:
@@ -1301,8 +1303,10 @@ def _eval_truncate(sf, chunk):
                 dec = Decimal(repr(float(v)))
             else:
                 dec = Decimal(int(v))
-            q = dec.quantize(Decimal(1).scaleb(-places),
-                             rounding=ROUND_DOWN) if places < 60 else dec
+            with localcontext() as lctx:
+                lctx.prec = 400  # int digits + 60 kept places, with room
+                q = dec.quantize(Decimal(1).scaleb(-places),
+                                 rounding=ROUND_DOWN)
             return float(q)
         out = np.array([one(v, p) if not (bool(nn) or bool(vn)) else 0.0
                         for v, p, vn, nn in zip(d, nd_d, n, nd_n)],
@@ -1311,7 +1315,17 @@ def _eval_truncate(sf, chunk):
     if (len(sf.args) > 1 and isinstance(sf.args[1], Constant)
             and sf.args[1].value is None):
         return d, np.ones_like(n)  # TRUNCATE(x, NULL) is NULL
-    nd = int(sf.args[1].value) if len(sf.args) > 1 else 0
+    # MySQL clamps the digit count (TRUNCATE(x, 2000000) is a no-op,
+    # TRUNCATE(x, -2000000) is 0); without the clamp Decimal.scaleb
+    # overflows its context and p10() computes astronomically wide ints
+    nd = max(min(int(sf.args[1].value), 60), -60) if len(sf.args) > 1 else 0
+    if k not in (K_DEC, K_FLOAT) and (d.dtype == object
+                                      or not np.issubdtype(d.dtype,
+                                                           np.integer)):
+        # string (or other coercible) input: MySQL truncates the numeric
+        # value and returns a double
+        d = _as_float(d, src.ftype)
+        k = K_FLOAT
 
     def p10(e):  # exact power; POW10 covers the decimal domain, int past it
         return POW10[e] if e < len(POW10) else 10 ** e
@@ -1340,12 +1354,17 @@ def _eval_truncate(sf, chunk):
         out_s = sf.ftype.scale if phys_kind(sf.ftype) == K_DEC else nd
         return (rescale(q, out_s - nd) if out_s > nd else q), n
     if k == K_FLOAT:
-        from decimal import Decimal, ROUND_DOWN
+        from decimal import Decimal, ROUND_DOWN, localcontext
         qd = Decimal(1).scaleb(-nd)
-        out = np.array([
-            float(Decimal(repr(float(v))).quantize(qd, rounding=ROUND_DOWN))
-            if np.isfinite(v) else float(v)
-            for v in np.asarray(d, dtype=np.float64)], dtype=np.float64)
+        with localcontext() as lctx:
+            # float64 spans ~±1e308 with up to 60 kept fraction digits:
+            # the default 28-digit context would raise InvalidOperation
+            lctx.prec = 400
+            out = np.array([
+                float(Decimal(repr(float(v))).quantize(qd,
+                                                       rounding=ROUND_DOWN))
+                if np.isfinite(v) else float(v)
+                for v in np.asarray(d, dtype=np.float64)], dtype=np.float64)
         return out, n
     if nd >= 0:
         return d, n

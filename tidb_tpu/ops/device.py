@@ -84,7 +84,7 @@ def to_device_col(col) -> DeviceCol:
     queries (the transfer — not the kernel — dominates when the device
     sits across a fabric/tunnel)."""
     if col._device is None:
-        if col.data.dtype == object:
+        if col.is_object():
             from ..sqltypes import TYPE_NEWDECIMAL
             if col.ftype.tp == TYPE_NEWDECIMAL:
                 # wide decimals (precision > 18) are exact host bigints;
@@ -103,7 +103,7 @@ def to_device_col(col) -> DeviceCol:
         else:
             col._device = (jnp.asarray(col.data), jnp.asarray(col.nulls))
     data, nulls = col._device
-    if col.data.dtype == object:
+    if col.is_object():
         from ..utils.collate import is_ci
         if is_ci(col.ftype.collate):
             _cc, key_dict, reps = col.dict_encode_ci(col.ftype.collate)
@@ -113,6 +113,31 @@ def to_device_col(col) -> DeviceCol:
         return DeviceCol(data, nulls, col.ftype, dictionary=uniq,
                          host_col=col)
     return DeviceCol(data, nulls, col.ftype, host_col=col)
+
+
+def meta_device_col(col):
+    """(DeviceCol with data=None, (host_data, host_nulls)) — the streamed/
+    paged protocol: the DeviceCol carries only what the expression compiler
+    reads (ftype, dictionaries, host_col for min/max packing); the host
+    arrays are sliced into pages and uploaded per block by the caller.
+    Never touches device memory, and never materializes a LazyDictColumn's
+    object view (codes come straight off the memmap)."""
+    if col.is_object():
+        from ..sqltypes import TYPE_NEWDECIMAL
+        if col.ftype.tp == TYPE_NEWDECIMAL:
+            raise DeviceUnsupported("wide-decimal column")
+        from ..utils.collate import is_ci
+        if is_ci(col.ftype.collate):
+            ci_codes, key_dict, reps = col.dict_encode_ci(col.ftype.collate)
+            return (DeviceCol(None, None, col.ftype, dictionary=key_dict,
+                              reps=reps, host_col=col),
+                    (ci_codes, col.nulls))
+        codes, uniq = col.dict_encode()
+        return (DeviceCol(None, None, col.ftype, dictionary=uniq,
+                          host_col=col),
+                (codes, col.nulls))
+    return (DeviceCol(None, None, col.ftype, host_col=col),
+            (col.data, col.nulls))
 
 
 # ---------------------------------------------------------------------------

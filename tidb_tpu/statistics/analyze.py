@@ -132,6 +132,49 @@ def _index_stats(info, cols, chunk):
     return out
 
 
+#: paged tables larger than this are analyzed from evenly-spaced sample
+#: blocks (reference: ANALYZE samples per region rather than full-scanning
+#: — statistics/builder.go; a 600M-row memmap must not be np.unique'd)
+SAMPLE_CAP = 1 << 22
+_SAMPLE_BLOCKS = 16
+
+
+def _sampled_chunk(chunk, cap):
+    """Evenly-spaced contiguous blocks totaling ~cap rows: contiguous
+    slices read whole memmap pages (sequential IO), and spacing the blocks
+    over the file keeps generation-order skew out of the sample."""
+    from ..utils.chunk import concat_chunks
+    n = chunk.num_rows
+    block = max(cap // _SAMPLE_BLOCKS, 1)
+    stride = max(n // _SAMPLE_BLOCKS, block)
+    parts = []
+    for b in range(_SAMPLE_BLOCKS):
+        lo = min(b * stride, n)
+        hi = min(lo + block, n)
+        if hi > lo:
+            parts.append(chunk.slice(lo, hi))
+    return concat_chunks(parts)
+
+
+def _rescale_column_stats(cs, factor, n):
+    """Scale sampled per-column stats to the full table. NDV scaling uses
+    the key-vs-category heuristic: a sample whose values are mostly
+    distinct extrapolates linearly (key-like); a saturated small domain
+    stays as observed."""
+    if factor <= 1.0:
+        return cs
+    sample_nonnull = cs.pop("_sample_rows", None)
+    cs["null_count"] = int(cs["null_count"] * factor)
+    ndv = cs.get("ndv", 0)
+    if sample_nonnull and ndv > 0.1 * sample_nonnull:
+        cs["ndv"] = min(int(ndv * factor), n)
+    if "topn" in cs:
+        cs["topn"] = [[v, int(c * factor)] for v, c in cs["topn"]]
+    if "hist" in cs:
+        cs["hist"]["cum"] = [int(c * factor) for c in cs["hist"]["cum"]]
+    return cs
+
+
 def analyze_table(session, info):
     cache = session.columnar_cache()
     cols = info.public_columns()
@@ -142,10 +185,31 @@ def analyze_table(session, info):
         from ..table import Table
         chunk = Table(info, session.store.begin()).scan_columnar(
             col_infos=cols)
-    stats = {"row_count": int(chunk.num_rows), "columns": {}}
+    n = chunk.num_rows
+    from ..storage.paged import chunk_is_paged
+    factor = 1.0
+    if n > SAMPLE_CAP and chunk_is_paged(chunk):
+        chunk = _sampled_chunk(chunk, SAMPLE_CAP)
+        factor = n / max(chunk.num_rows, 1)
+    stats = {"row_count": int(n), "columns": {}}
+    if factor > 1.0:
+        stats["sampled_rows"] = int(chunk.num_rows)
     for ci, col in zip(cols, chunk.columns):
-        stats["columns"][str(ci.id)] = _column_stats(col)
+        cs = _column_stats(col)
+        cs["_sample_rows"] = chunk.num_rows - cs["null_count"]
+        stats["columns"][str(ci.id)] = _rescale_column_stats(
+            cs, factor, int(n))
+        stats["columns"][str(ci.id)].pop("_sample_rows", None)
     stats["indexes"] = _index_stats(info, cols, chunk)
+    if factor > 1.0:
+        # index prefix NDVs share the column key-vs-category extrapolation
+        # (a unique index's sampled NDV ~= sample size must scale to the
+        # table, or per-key row estimates inflate by the sample factor)
+        sample_n = chunk.num_rows
+        for ix in stats["indexes"].values():
+            ix["prefix_ndv"] = [
+                min(int(v * factor), int(n)) if v > 0.1 * sample_n else v
+                for v in ix["prefix_ndv"]]
     txn = session.store.begin()
     try:
         m = Meta(txn)

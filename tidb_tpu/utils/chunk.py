@@ -131,6 +131,12 @@ class Column:
     def is_device_friendly(self) -> bool:
         return self.data.dtype != object
 
+    def is_object(self) -> bool:
+        """String/wide-decimal physical layout? (LazyDictColumn answers
+        without materializing its object view — use this instead of
+        ``col.data.dtype == object`` anywhere a paged column may flow.)"""
+        return self.data.dtype == object
+
     def minmax(self):
         """(min, max) over non-null rows of an integer-kinded column, cached
         (feeds static key-range packing in the device agg/join planners).
@@ -212,6 +218,120 @@ class Column:
         return out
 
 
+class _PageRemapCodes:
+    """Sliceable view `remap[codes[...]]` evaluated per access: the
+    collation-class codes of a paged string column, without ever holding
+    the full remapped array in RAM. Whole-array use (__array__) is the
+    resident-dim path, bounded by the caller's budget check."""
+
+    __slots__ = ("codes", "remap")
+
+    def __init__(self, codes, remap):
+        self.codes = codes
+        self.remap = remap
+
+    def __len__(self):
+        return len(self.codes)
+
+    @property
+    def shape(self):
+        return (len(self.codes),)
+
+    @property
+    def dtype(self):
+        return self.remap.dtype
+
+    def __getitem__(self, sl):
+        return self.remap[np.asarray(self.codes[sl], dtype=np.int64)]
+
+    def __array__(self, dtype=None, copy=None):
+        out = self.remap[np.asarray(self.codes, dtype=np.int64)]
+        return out if dtype is None else out.astype(dtype)
+
+
+def false_nulls(n: int) -> np.ndarray:
+    """An all-False null mask backed by ONE byte (np.broadcast_to view):
+    paged tables would otherwise pay n bytes of RAM per column just to say
+    'no NULLs'. Read-only; slicing/indexing yields normal views."""
+    return np.broadcast_to(np.zeros(1, dtype=bool), (n,))
+
+
+class LazyDictColumn(Column):
+    """Dictionary-encoded string column whose object `data` materializes
+    only on first host access.
+
+    The paged store keeps string columns as int32 code files + a sorted
+    dictionary sidecar (storage/paged.py). Device paths consume the codes
+    via dict_encode() without ever touching `data`; the object-array view
+    (`uniques[codes]`) is built lazily for host-side row access and then
+    cached. slice()/take() stay in code space so host streaming over a
+    paged table materializes only the rows it touches."""
+
+    __slots__ = ("_mat",)
+
+    def __init__(self, ftype: FieldType, codes: np.ndarray, uniques,
+                 nulls: np.ndarray | None = None):
+        # bypass Column.__init__: `data` is a property here
+        self.ftype = ftype
+        self.nulls = nulls if nulls is not None else false_nulls(len(codes))
+        self._dict = (codes, np.asarray(uniques, dtype=object))
+        self._dict_ci = None
+        self._device = None
+        self._join_index = None
+        self._minmax = (None,)
+        self._mat = None
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._mat is None:
+            codes, uniques = self._dict
+            self._mat = uniques[np.asarray(codes, dtype=np.int64)]
+        return self._mat
+
+    def __len__(self):
+        return len(self._dict[0])
+
+    def is_device_friendly(self) -> bool:
+        return False
+
+    def is_object(self) -> bool:
+        return True
+
+    def minmax(self):
+        return None
+
+    def dict_encode(self):
+        return self._dict
+
+    def dict_encode_ci(self, collation: str):
+        """Collation-class encoding WITHOUT materializing a table-sized
+        ci_codes array: returns a _PageRemapCodes view that applies the
+        uniq→class remap per requested slice, so paged streaming reads
+        stay page-bounded (Column.dict_encode_ci would fancy-index the
+        whole memmap into RAM)."""
+        if self._dict_ci is None or self._dict_ci[0] != collation:
+            from .collate import sort_key
+            codes, uniq = self._dict
+            sk = np.empty(len(uniq), dtype=object)
+            for i, u in enumerate(uniq):
+                sk[i] = sort_key(u if isinstance(u, bytes) else
+                                 str(u).encode(), collation)
+            key_dict, first, inv = np.unique(sk, return_index=True,
+                                             return_inverse=True)
+            reps = uniq[first]
+            lazy = _PageRemapCodes(codes, inv.astype(np.int32))
+            self._dict_ci = (collation, (lazy, key_dict, reps))
+        return self._dict_ci[1]
+
+    def take(self, idx: np.ndarray) -> "LazyDictColumn":
+        return LazyDictColumn(self.ftype, np.asarray(self._dict[0])[idx],
+                              self._dict[1], np.asarray(self.nulls)[idx])
+
+    def slice(self, start: int, end: int) -> "LazyDictColumn":
+        return LazyDictColumn(self.ftype, self._dict[0][start:end],
+                              self._dict[1], self.nulls[start:end])
+
+
 class Chunk:
     """A batch of rows in columnar layout."""
 
@@ -263,14 +383,28 @@ class Chunk:
         feeds the memory tracker and EXPLAIN ANALYZE's memory column)."""
         total = 0
         for c in self.columns:
+            if isinstance(c, LazyDictColumn):
+                # codes + dictionary, NOT the (possibly unmaterialized)
+                # object view — and memmap codes are disk, not RAM
+                codes, uniques = c.dict_encode()
+                if not isinstance(codes, np.memmap):
+                    total += codes.nbytes
+                total += sum(len(v) + 49 for v in uniques)
+                if c.nulls.strides != (0,):
+                    total += c.nulls.nbytes
+                continue
             if c.data.dtype == object:
                 # bytes + obj header; wide-decimal bigints ~60B each
                 total += sum(
                     (len(v) + 49) if isinstance(v, (bytes, bytearray, str))
                     else 60 for v in c.data)
-            else:
+            elif not isinstance(c.data, np.memmap):
+                # memmap columns are disk pages, not query RAM (the
+                # reference likewise keeps block-cache bytes outside the
+                # query quota)
                 total += c.data.nbytes
-            total += c.nulls.nbytes
+            if c.nulls.strides != (0,):  # stride-0 = broadcast false mask
+                total += c.nulls.nbytes
         return total
 
     def to_display_rows(self) -> list[tuple]:
