@@ -241,6 +241,18 @@ order by o_totalprice desc, o_orderdate limit 100
 }
 
 
+#: transient tunnel/relay failures (remote-compile endpoint drops, stream
+#: resets) are environmental, not engine errors — retry the query once
+#: after a short backoff before recording a failure
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "Connection refused", "transport:",
+                      "DEADLINE_EXCEEDED", "Socket closed")
+
+
+def _is_transient(exc) -> bool:
+    s = f"{type(exc).__name__}: {exc}"
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
 # ---------------------------------------------------------------------------
 # Data generators: synthetic TPC-H-shaped data, bulk-installed through the
 # Lightning-role columnar loader (no per-row encode). Shapes/distributions
@@ -342,40 +354,116 @@ def gen_all(tk, sf: float):
         create table region (
             r_regionkey bigint, r_name varchar(25))""")
 
+    # Paged generation (disk-backed memmap columns) for the big tables at
+    # sf >= 5 or BENCH_PAGED=1: the generator writes page batches straight
+    # to column files — neither datagen nor the scans ever hold a big
+    # table's columns resident (SF100 lineitem is ~41GB of columns).
+    paged = os.environ.get("BENCH_PAGED") == "1" or sf >= 5
+
+    def _paged_table(table, n_rows, dicts, gen_page):
+        from tidb_tpu.storage.paged import (
+            DEFAULT_PAGE_ROWS, PagedTableWriter, open_paged_columns)
+        from tidb_tpu.storage.paged import LazyRangeHandles
+        info = tk.domain.infoschema().table_by_name("tpch", table)
+        pdir = os.environ.get("BENCH_PAGED_DIR", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_paged"))
+        root = os.path.join(pdir, f"sf{sf:g}", table)
+        manifest = os.path.join(root, "MANIFEST.json")
+        if os.path.exists(manifest):  # reuse across bench runs
+            cols = open_paged_columns(root, info)
+            if len(next(iter(cols.values()))) == n_rows:
+                tk.domain.columnar_cache.install_bulk(
+                    info, cols, LazyRangeHandles(n_rows))
+                return
+            # stale cache: drop the manifest FIRST so a crash mid-rewrite
+            # can't leave a valid manifest over truncated column files
+            os.remove(manifest)
+        w = PagedTableWriter(root, info)
+        for name, d in dicts.items():
+            w.set_dictionary(name, d)
+        name2id = {c.name: c.id for c in info.public_columns()}
+        for pi, lo in enumerate(range(0, n_rows, DEFAULT_PAGE_ROWS)):
+            m = min(DEFAULT_PAGE_ROWS, n_rows - lo)
+            w.append(gen_page(pi, lo, m))
+        cols, handles = w.finalize()
+        assert set(cols) <= set(name2id.values())
+        tk.domain.columnar_cache.install_bulk(info, cols, handles)
+
     # --- lineitem -----------------------------------------------------
-    _stage(f"generating lineitem ({n_line} rows)")
-    orderkey = rng.integers(1, n_orders + 1, n_line)
-    partkey = rng.integers(1, n_part + 1, n_line)
-    # one of each part's 4 partsupp suppliers, so the Q9 join always hits
-    supp_slot = rng.integers(0, 4, n_line)
-    suppkey = (partkey - 1 + supp_slot * supp_stride) % n_supp + 1
-    qty = rng.integers(1, 51, n_line) * 100              # 1.00-50.00
-    price = rng.integers(900_00, 105_000_00, n_line)     # ~dbgen price range
-    disc = rng.integers(0, 11, n_line)                   # 0.00-0.10
-    tax = rng.integers(0, 9, n_line)                     # 0.00-0.08
-    shipdate = rng.integers(_days("1992-01-01"), _days("1998-12-01"),
-                            n_line).astype(np.int32)
-    flag_codes = rng.integers(0, 3, n_line).astype(np.int32)
-    status_codes = rng.integers(0, 2, n_line).astype(np.int32)
-    _install(tk, "lineitem", {
-        "l_orderkey": orderkey, "l_partkey": partkey, "l_suppkey": suppkey,
-        "l_quantity": qty, "l_extendedprice": price, "l_discount": disc,
-        "l_tax": tax, "l_shipdate": shipdate,
-        "l_returnflag": (flag_codes, [b"A", b"N", b"R"]),
-        "l_linestatus": (status_codes, [b"F", b"O"]),
-    }, n_line)
+    _stage(f"generating lineitem ({n_line} rows, paged={paged})")
+
+    def _line_page(pi, lo, m):
+        prng = np.random.default_rng((42, pi))
+        partkey = prng.integers(1, n_part + 1, m)
+        supp_slot = prng.integers(0, 4, m)
+        return {
+            "l_orderkey": prng.integers(1, n_orders + 1, m),
+            "l_partkey": partkey,
+            "l_suppkey": (partkey - 1 + supp_slot * supp_stride) % n_supp + 1,
+            "l_quantity": prng.integers(1, 51, m) * 100,
+            "l_extendedprice": prng.integers(900_00, 105_000_00, m),
+            "l_discount": prng.integers(0, 11, m),
+            "l_tax": prng.integers(0, 9, m),
+            "l_shipdate": prng.integers(_days("1992-01-01"),
+                                        _days("1998-12-01"), m).astype(np.int32),
+            "l_returnflag": prng.integers(0, 3, m).astype(np.int32),
+            "l_linestatus": prng.integers(0, 2, m).astype(np.int32),
+        }
+
+    if paged:
+        _paged_table("lineitem", n_line,
+                     {"l_returnflag": [b"A", b"N", b"R"],
+                      "l_linestatus": [b"F", b"O"]}, _line_page)
+    else:
+        orderkey = rng.integers(1, n_orders + 1, n_line)
+        partkey = rng.integers(1, n_part + 1, n_line)
+        # one of each part's 4 partsupp suppliers, so the Q9 join always hits
+        supp_slot = rng.integers(0, 4, n_line)
+        suppkey = (partkey - 1 + supp_slot * supp_stride) % n_supp + 1
+        qty = rng.integers(1, 51, n_line) * 100              # 1.00-50.00
+        price = rng.integers(900_00, 105_000_00, n_line)     # ~dbgen prices
+        disc = rng.integers(0, 11, n_line)                   # 0.00-0.10
+        tax = rng.integers(0, 9, n_line)                     # 0.00-0.08
+        shipdate = rng.integers(_days("1992-01-01"), _days("1998-12-01"),
+                                n_line).astype(np.int32)
+        flag_codes = rng.integers(0, 3, n_line).astype(np.int32)
+        status_codes = rng.integers(0, 2, n_line).astype(np.int32)
+        _install(tk, "lineitem", {
+            "l_orderkey": orderkey, "l_partkey": partkey,
+            "l_suppkey": suppkey,
+            "l_quantity": qty, "l_extendedprice": price, "l_discount": disc,
+            "l_tax": tax, "l_shipdate": shipdate,
+            "l_returnflag": (flag_codes, [b"A", b"N", b"R"]),
+            "l_linestatus": (status_codes, [b"F", b"O"]),
+        }, n_line)
 
     # --- orders / customer -------------------------------------------
     _stage(f"generating orders ({n_orders}) + customer ({n_cust})")
     rng2 = np.random.default_rng(7)
-    _install(tk, "orders", {
-        "o_orderkey": np.arange(1, n_orders + 1),
-        "o_custkey": rng2.integers(1, n_cust + 1, n_orders),
-        "o_orderdate": rng2.integers(_days("1992-01-01"), _days("1998-08-02"),
-                                     n_orders).astype(np.int32),
-        "o_shippriority": np.zeros(n_orders, dtype=np.int64),
-        "o_totalprice": rng2.integers(1000_00, 400_000_00, n_orders),
-    }, n_orders)
+
+    def _orders_page(pi, lo, m):
+        prng = np.random.default_rng((7, pi))
+        return {
+            "o_orderkey": np.arange(lo + 1, lo + m + 1, dtype=np.int64),
+            "o_custkey": prng.integers(1, n_cust + 1, m),
+            "o_orderdate": prng.integers(_days("1992-01-01"),
+                                         _days("1998-08-02"), m).astype(np.int32),
+            "o_shippriority": np.zeros(m, dtype=np.int64),
+            "o_totalprice": prng.integers(1000_00, 400_000_00, m),
+        }
+
+    if paged:
+        _paged_table("orders", n_orders, {}, _orders_page)
+    else:
+        _install(tk, "orders", {
+            "o_orderkey": np.arange(1, n_orders + 1),
+            "o_custkey": rng2.integers(1, n_cust + 1, n_orders),
+            "o_orderdate": rng2.integers(_days("1992-01-01"),
+                                         _days("1998-08-02"),
+                                         n_orders).astype(np.int32),
+            "o_shippriority": np.zeros(n_orders, dtype=np.int64),
+            "o_totalprice": rng2.integers(1000_00, 400_000_00, n_orders),
+        }, n_orders)
 
     cname = np.array([f"Customer#{i:09d}".encode() for i in
                       range(1, n_cust + 1)], dtype=object)
@@ -504,6 +592,27 @@ def main():
     signal.signal(signal.SIGALRM, _on_alarm)
     signal.alarm(watchdog_s)
 
+    # SIGALRM only fires when the GIL is available — a dead tunnel leaves
+    # the axon client blocking INSIDE a C call holding the GIL forever
+    # (observed: q9 warmup hung 50+ min past the alarm). A detached
+    # subprocess sharing our stdout is immune: it emits the watchdog JSON
+    # line and SIGKILLs this process unconditionally.
+    killer = (
+        "import json,os,signal,sys,time\n"
+        "pid, t = int(sys.argv[1]), int(sys.argv[2])\n"
+        "end = time.time() + t\n"
+        "while time.time() < end:\n"
+        "    time.sleep(10)\n"
+        "    try: os.kill(pid, 0)\n"
+        "    except OSError: sys.exit(0)  # bench exited; release stdout\n"
+        "print(json.dumps({'metric': 'tpch_bench_watchdog', 'value': 0,"
+        " 'unit': 'queries_completed', 'vs_baseline': 0,"
+        " 'error': 'hard watchdog: process hung %ss (GIL-blocked backend"
+        " call)' % t}), flush=True)\n"
+        "os.kill(pid, signal.SIGKILL)\n")
+    subprocess.Popen([sys.executable, "-c", killer, str(os.getpid()),
+                      str(watchdog_s + 120)])
+
     probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "600"))
     probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
     probe_backoff = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "60"))
@@ -554,13 +663,26 @@ def main():
     for qname in qnames:
         sql = QUERIES[qname]
         try:
-            _stage(f"{qname}: device warmup (compile + materialize)")
-            tk.must_exec("set tidb_executor_engine = 'tpu'")
-            warm_t, _rows = time_query(tk, sql, repeats=1)
-            _stage(f"{qname}: device timed runs")
-            dev_t, dev_rows = time_query(tk, sql, repeats=2)
+            for attempt in (1, 2):
+                try:
+                    _stage(f"{qname}: device warmup (compile + materialize)")
+                    tk.must_exec("set tidb_executor_engine = 'tpu'")
+                    warm_t, _rows = time_query(tk, sql, repeats=1)
+                    _stage(f"{qname}: device timed runs")
+                    dev_t, dev_rows = time_query(tk, sql, repeats=2)
+                    break
+                except Exception as exc:
+                    # a dropped relay/remote-compile endpoint is
+                    # environmental — give it one recovery window
+                    if attempt == 2 or not _is_transient(exc):
+                        raise
+                    _stage(f"{qname}: transient backend error, retrying "
+                           f"({exc})")
+                    time.sleep(30)
 
-            if sf >= 10:
+            host_skip = (os.environ.get("BENCH_HOST_SKIP") == "1"
+                         or sf >= 50)
+            if sf >= 10 or host_skip:
                 # the host (numpy) reference engine is the memory limiter
                 # at this scale — its join intermediates can OOM-kill the
                 # process (observed: Q9 SF10). Emit the measured device
@@ -575,6 +697,12 @@ def main():
                     "peak_rss_mb": _peak_rss_mb(), **meta,
                 })
 
+            if host_skip:
+                # the single-threaded numpy reference cannot execute at
+                # SF100 in any useful time; the provisional device line
+                # above is the recorded number
+                _COMPLETED[0] += 1
+                continue
             _stage(f"{qname}: host reference run")
             tk.must_exec("set tidb_executor_engine = 'host'")
             host_t, host_rows = time_query(tk, sql, repeats=1)

@@ -1,0 +1,204 @@
+"""Subquery decorrelation (reference: planner/core/optimizer.go:73-91
+decorrelate rule + expression_rewriter.go): correlated EXISTS / [NOT] IN
+whose correlation is equality-only plan as semi/anti joins — reaching the
+hash-join executors (and the device fragment path) instead of per-outer-row
+SubqueryApply re-execution."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table orders_d (o_orderkey bigint, o_custkey bigint,"
+                 " o_orderdate date, o_comment varchar(40))")
+    tk.must_exec("create table lineitem_d (l_orderkey bigint, "
+                 "l_commitdate date, l_receiptdate date, l_suppkey bigint)")
+    tk.must_exec("create table customer_d (c_custkey bigint, "
+                 "c_acctbal decimal(12,2), c_phone varchar(15))")
+    rows_o, rows_l, rows_c = [], [], []
+    rng = np.random.default_rng(9)
+    for i in range(1, 401):
+        rows_o.append(f"({i}, {i % 37 + 1}, '199{i % 7}-0{i % 9 + 1}-15', "
+                      f"'c{i}')")
+    for i in range(1, 1201):
+        ok = i % 400 + 1
+        c = int(rng.integers(0, 2000))
+        r = c + int(rng.integers(-500, 1500))
+        rows_l.append(f"({ok}, '1995-01-{c % 28 + 1:02d}', "
+                      f"'1995-02-{r % 28 + 1:02d}', {i % 50 + 1})")
+    for i in range(1, 38):
+        bal = round(float(rng.uniform(-500, 5000)), 2)
+        rows_c.append(f"({i}, {bal}, '{i % 30 + 10}-000')")
+    tk.must_exec("insert into orders_d values " + ",".join(rows_o))
+    tk.must_exec("insert into lineitem_d values " + ",".join(rows_l))
+    tk.must_exec("insert into customer_d values " + ",".join(rows_c))
+    return tk
+
+
+def _plan(tk, sql):
+    return "\n".join(r[0] + "|" + r[1] for r in
+                     tk.must_query("explain " + sql).rows)
+
+
+class TestDecorrelatePlans:
+    def test_q4_shape_exists_plans_semi_join(self, tk):
+        """TPC-H Q4: EXISTS over lineitem correlated on orderkey."""
+        sql = ("select o_orderkey from orders_d where exists ("
+               "select 1 from lineitem_d where l_orderkey = o_orderkey "
+               "and l_commitdate < l_receiptdate) order by o_orderkey")
+        p = _plan(tk, sql)
+        assert "semi" in p and "apply" not in p
+
+    def test_q21_shape_exists_plus_not_exists(self, tk):
+        """TPC-H Q21: both EXISTS and NOT EXISTS correlated conjuncts."""
+        sql = ("select o_orderkey from orders_d where exists ("
+               "select 1 from lineitem_d where l_orderkey = o_orderkey and "
+               "l_suppkey = 7) and not exists (select 1 from lineitem_d "
+               "where l_orderkey = o_orderkey and l_suppkey = 9) "
+               "order by o_orderkey")
+        p = _plan(tk, sql)
+        assert "semi" in p and "anti" in p and "apply" not in p
+
+    def test_q22_shape_not_exists(self, tk):
+        """TPC-H Q22 inner: NOT EXISTS orders per customer."""
+        sql = ("select c_custkey from customer_d where c_acctbal > 0 and "
+               "not exists (select 1 from orders_d "
+               "where o_custkey = c_custkey) order by c_custkey")
+        p = _plan(tk, sql)
+        assert "anti" in p and "apply" not in p
+
+    def test_correlated_in_plans_semi(self, tk):
+        sql = ("select o_orderkey from orders_d where o_custkey in ("
+               "select c_custkey from customer_d where c_custkey = o_custkey "
+               "and c_acctbal > 100)")
+        p = _plan(tk, sql)
+        assert "semi" in p and "apply" not in p
+
+    def test_non_equality_correlation_falls_back(self, tk):
+        sql = ("select c_custkey from customer_d where exists ("
+               "select 1 from orders_d where o_custkey > c_custkey)")
+        assert "apply" in _plan(tk, sql)
+
+    def test_correlated_under_aggregate_falls_back(self, tk):
+        sql = ("select c_custkey from customer_d where exists ("
+               "select o_custkey from orders_d where o_custkey = c_custkey "
+               "group by o_custkey having count(*) > 1)")
+        assert "apply" in _plan(tk, sql)
+
+
+class TestDecorrelateResults:
+    def _parity(self, tk, decorrelated_sql, apply_sql):
+        a = tk.must_query(decorrelated_sql).rows
+        b = tk.must_query(apply_sql).rows
+        assert a == b
+        return a
+
+    def test_exists_parity_with_apply_fallback(self, tk):
+        """Same query through the join path and (forced via non-eq shape
+        that keeps semantics) the apply path."""
+        dec = ("select o_orderkey from orders_d where exists ("
+               "select 1 from lineitem_d where l_orderkey = o_orderkey "
+               "and l_commitdate < l_receiptdate) order by o_orderkey")
+        # + 0 on the correlated side defeats the bare-OuterRef pattern →
+        # apply fallback with identical semantics
+        app = ("select o_orderkey from orders_d where exists ("
+               "select 1 from lineitem_d where l_orderkey = o_orderkey + 0 "
+               "and l_commitdate < l_receiptdate) order by o_orderkey")
+        rows = self._parity(tk, dec, app)
+        assert len(rows) > 0
+
+    def test_not_exists_parity(self, tk):
+        dec = ("select c_custkey from customer_d where not exists ("
+               "select 1 from orders_d where o_custkey = c_custkey and "
+               "o_orderdate < '1993-01-01') order by c_custkey")
+        app = ("select c_custkey from customer_d where not exists ("
+               "select 1 from orders_d where o_custkey = c_custkey + 0 and "
+               "o_orderdate < '1993-01-01') order by c_custkey")
+        self._parity(tk, dec, app)
+
+    def test_not_in_null_semantics(self, tk):
+        tk.must_exec("create table tn (a bigint)")
+        tk.must_exec("create table sn (g bigint, b bigint)")
+        tk.must_exec("insert into tn values (1),(2),(null)")
+        tk.must_exec("insert into sn values (1,1),(1,null),(2,5),(3,7)")
+        # a NOT IN {b : g = a}: a=1 -> set {1,NULL}: match -> drop;
+        # a=2 -> {5}: no match, no null -> keep; NULL a with non-empty set
+        # (never: g=NULL matches nothing -> empty set -> keep)
+        rows = tk.must_query(
+            "select a from tn where a not in (select b from sn where "
+            "sn.g = tn.a) order by a").rows
+        assert rows == [(None,), ("2",)]
+        # and the plan is the null-aware anti join, not apply
+        p = _plan(tk, "select a from tn where a not in (select b from sn "
+                      "where sn.g = tn.a)")
+        assert "anti" in p and "apply" not in p
+
+    def test_q17_shape_scalar_avg_cmp(self, tk):
+        """x < (SELECT 0.2*avg(...) WHERE k = outer.k) → semi join against
+        the re-grouped aggregate."""
+        tk.must_exec("create table li17 (l_partkey bigint, "
+                     "l_quantity bigint, l_price bigint)")
+        rng = np.random.default_rng(4)
+        tk.must_exec("insert into li17 values " + ",".join(
+            f"({int(rng.integers(1, 20))}, {int(rng.integers(1, 50))}, "
+            f"{int(rng.integers(100, 900))})" for _ in range(300)))
+        dec = ("select sum(l_price) from li17 where l_quantity < ("
+               "select 0.2 * avg(l_quantity) from li17 l2 "
+               "where l2.l_partkey = li17.l_partkey)")
+        app = dec.replace("l2.l_partkey = li17.l_partkey",
+                          "l2.l_partkey = li17.l_partkey + 0")
+        assert tk.must_query(dec).rows == tk.must_query(app).rows
+        p = _plan(tk, dec)
+        assert "semi" in p and "apply" not in p
+
+    def test_q20_shape_two_key_sum_cmp(self, tk):
+        tk.must_exec("create table ps20 (pk bigint, sk bigint, av bigint)")
+        tk.must_exec("create table li20 (pk bigint, sk bigint, q bigint)")
+        rng = np.random.default_rng(6)
+        tk.must_exec("insert into ps20 values " + ",".join(
+            f"({int(rng.integers(1, 15))}, {i % 5 + 1}, "
+            f"{int(rng.integers(10, 900))})" for i in range(80)))
+        tk.must_exec("insert into li20 values " + ",".join(
+            f"({int(rng.integers(1, 15))}, {int(rng.integers(1, 6))}, "
+            f"{int(rng.integers(1, 40))})" for _ in range(200)))
+        dec = ("select count(*) from ps20 where av > (select 0.5 * sum(q) "
+               "from li20 where li20.pk = ps20.pk and li20.sk = ps20.sk)")
+        app = dec.replace("li20.pk = ps20.pk", "li20.pk = ps20.pk + 0")
+        assert tk.must_query(dec).rows == tk.must_query(app).rows
+        assert "semi" in _plan(tk, dec)
+
+    def test_scalar_count_cmp_falls_back(self, tk):
+        """COUNT's empty-group scalar is 0 (not NULL): must NOT rewrite to
+        a semi join (which drops no-match rows)."""
+        tk.must_exec("create table tc (a bigint)")
+        tk.must_exec("create table sc (g bigint)")
+        tk.must_exec("insert into tc values (1),(2)")
+        tk.must_exec("insert into sc values (1)")
+        q = ("select a from tc where 0 = (select count(*) from sc "
+             "where sc.g = tc.a) order by a")
+        assert tk.must_query(q).rows == [("2",)]
+        assert "apply" in _plan(tk, q)
+
+    def test_scaling_not_quadratic(self, tk):
+        """10k-outer-row correlated EXISTS must run as one join, not 10k
+        subquery re-plans (the O(N) replan pathology the VERDICT cites)."""
+        tk.must_exec("create table big_o (k bigint)")
+        tk.must_exec("create table big_i (k bigint)")
+        vals = ",".join(f"({i})" for i in range(10_000))
+        tk.must_exec("insert into big_o values " + vals)
+        tk.must_exec("insert into big_i values " +
+                     ",".join(f"({i})" for i in range(0, 10_000, 2)))
+        t0 = time.perf_counter()
+        rows = tk.must_query(
+            "select count(*) from big_o where exists ("
+            "select 1 from big_i where big_i.k = big_o.k)").rows
+        dt = time.perf_counter() - t0
+        assert rows == [("5000",)]
+        assert dt < 5.0  # apply-per-row took minutes at this size

@@ -268,11 +268,16 @@ class OuterScope:
       resolution yields that value as a typed Constant.
     `parent` chains scopes for multi-level nesting."""
 
-    def __init__(self, schema: Schema, bindings=None, parent=None):
+    def __init__(self, schema: Schema, bindings=None, parent=None,
+                 mark=False):
         self.schema = schema
         self.bindings = bindings
         self.parent = parent
         self.used: dict = {}  # idx -> ftype (analysis phase)
+        #: decorrelation-analysis mode: outer refs resolve to OuterRef
+        #: markers (instead of NULL constants), so the planner can turn
+        #: eq(outer, inner) predicates into join keys
+        self.mark = mark
 
     def resolve(self, node):
         idx = self.schema.find(node)
@@ -281,6 +286,10 @@ class OuterScope:
             if self.bindings is not None:
                 return Constant(self.bindings.get(idx), ft.clone())
             self.used[idx] = ft
+            if self.mark:
+                from .core import OuterRef
+                return OuterRef(idx, ft.clone(),
+                                name=self.schema.refs[idx].name)
             return Constant(None, ft.clone())
         if self.parent is not None:
             return self.parent.resolve(node)
@@ -834,7 +843,18 @@ class ExprBuilder:
         """Analysis pass for a subquery: build its plan with this SELECT's
         schema as the outer scope; the scope records which outer columns the
         subquery references (correlation). The plan is reused for execution
-        when no correlation was found (avoids planning twice)."""
+        when no correlation was found (avoids planning twice).
+
+        `sub_memo` (installed by the planner's decorrelation rule) caches
+        the rule's own analysis per AST node: without it, a decorrelation
+        bail would re-analyze — and analysis EXECUTES eager nested
+        uncorrelated subqueries, so the re-run would evaluate them twice
+        per statement."""
+        memo = getattr(self, "sub_memo", None)
+        if memo is not None:
+            hit = memo.get(id(select))
+            if hit is not None:
+                return hit
         if self.ctx is None or not hasattr(self.ctx, "analyze_subquery"):
             return None, None
         scope = OuterScope(self.schema, parent=self.outer)

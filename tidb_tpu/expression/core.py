@@ -136,6 +136,35 @@ def const_null() -> Constant:
     return Constant(None, FieldType(tp=TYPE_NULL))
 
 
+class OuterRef(Expression):
+    """Marker for a correlated reference to an ENCLOSING query's column,
+    produced only during a decorrelation-analysis pass (OuterScope with
+    mark=True). The planner's decorrelation rule (reference:
+    planner/core/optimizer.go:73-91 decorrelate + expression_rewriter.go)
+    rewrites eq(OuterRef, inner_expr) predicates into semi/anti join keys;
+    any OuterRef that survives planning means the rewrite bailed and the
+    Apply fallback runs instead — evaluating one is always a bug."""
+
+    __slots__ = ("idx", "ftype", "name")
+
+    def __init__(self, idx, ftype, name=""):
+        self.idx = idx        # column position in the OUTER schema
+        self.ftype = ftype
+        self.name = name
+
+    def eval(self, chunk):
+        raise TiDBError("internal: OuterRef survived decorrelation")
+
+    def columns_used(self, acc: set):
+        pass  # refers to the outer schema, not this one
+
+    def transform_columns(self, fn):
+        return self
+
+    def __repr__(self):
+        return f"outer({self.name or self.idx})"
+
+
 class SubqueryApply(Expression):
     """Correlated subquery evaluated per distinct outer binding — the
     reference's Apply operator (planner/core/logical_plans.go LogicalApply,

@@ -328,6 +328,7 @@ class PlanBuilder:
     def __init__(self, ctx, outer=None):
         self.ctx = ctx
         self.outer = outer  # OuterScope of the enclosing SELECT (subqueries)
+        self._sub_memo = None  # decorrelation-analysis cache (build_select)
         self.ctes = {}      # WITH name -> SelectStmt AST
 
     # -- entry points -------------------------------------------------------
@@ -654,6 +655,274 @@ class PlanBuilder:
 
     # -- SELECT -------------------------------------------------------------
 
+    def _try_decorrelate(self, conj, from_schema):
+        """Correlated EXISTS / [NOT] IN conjunct → decorrelated join spec
+        (kind, right_child_plan, left_keys, right_keys, other_conds), or
+        None to take the normal expression path.
+
+        The subquery is analyzed once with outer refs surfacing as OuterRef
+        markers; the rewrite accepts the canonical shape — [Sort] [Limit≥1,
+        EXISTS only] [Projection] Selection(from-tree) — where every
+        OuterRef sits in a top-Selection conjunct of the form
+        eq(OuterRef, inner_expr). Anything else (correlation under an
+        aggregate, non-equality correlation, nested Apply) bails to the
+        SubqueryApply fallback. NOT IN compiles to a NULL-AWARE anti join:
+        the membership key matches when equal OR either side is NULL
+        (reference: null-aware anti join, planner/core/
+        expression_rewriter.go handleInSubquery)."""
+        from ..expression.builder import OuterScope
+        from ..expression.core import OuterRef
+        from ..expression import phys_kind
+        if self.outer is not None:
+            # nested scopes would mix marked and NULL-constant analysis
+            return None
+        negate = False
+        while (isinstance(conj, ast.UnaryOp) and conj.op == "not"
+               and isinstance(conj.operand, (ast.ExistsExpr, ast.UnaryOp))):
+            negate = not negate
+            conj = conj.operand
+        if isinstance(conj, ast.ExistsExpr):
+            sub_ast = conj.query.query
+            kind = "anti" if (conj.negated ^ negate) else "semi"
+            target_ast = None
+        elif negate:
+            return None
+        elif (isinstance(conj, ast.InExpr) and len(conj.items) == 1
+                and isinstance(conj.items[0], ast.SubqueryExpr)):
+            sub_ast = conj.items[0].query
+            kind = "anti" if conj.negated else "semi"
+            target_ast = conj.expr
+        elif (isinstance(conj, ast.BinaryOp)
+                and conj.op in ("=", "!=", "<", "<=", ">", ">=")
+                and (isinstance(conj.left, ast.SubqueryExpr)
+                     != isinstance(conj.right, ast.SubqueryExpr))):
+            # expr <op> (correlated scalar-aggregate subquery) — the TPC-H
+            # Q17/Q20 shape — rewrites to a semi join against the subquery
+            # re-grouped by its correlation keys
+            return self._try_decorrelate_scalar_cmp(conj, from_schema)
+        else:
+            return None
+        scope = OuterScope(from_schema, mark=True)
+        try:
+            subplan = self.ctx.analyze_subquery(sub_ast, scope)
+        except Exception:
+            return None
+        if self._sub_memo is not None:
+            # a bail below must not re-analyze (analysis executes eager
+            # nested subqueries); the ExprBuilder fallback reuses this
+            self._sub_memo[id(sub_ast)] = (scope, subplan)
+        if not scope.used:
+            return None  # uncorrelated: eager materialization handles it
+
+        node = subplan
+        if isinstance(node, Sort):
+            node = node.child  # ORDER BY cannot affect existence/membership
+        if isinstance(node, (Limit, TopN)):
+            if target_ast is not None:
+                return None  # LIMIT changes the membership set
+            if not node.count or (node.offset or 0) > 0:
+                return None
+            node = node.child
+            if isinstance(node, Sort):
+                node = node.child
+        proj = None
+        if isinstance(node, Projection):
+            proj = node
+            node = node.child
+        if not isinstance(node, Selection):
+            return None
+        sel_node = node
+        base = sel_node.child
+
+        # every correlated expression must be a top-Selection conjunct
+        for nd in _walk_plan(subplan, []):
+            if nd is sel_node:
+                continue
+            for e in _node_exprs(nd):
+                acc = []
+                _collect_outer_refs(e, acc)
+                if acc:
+                    return None
+
+        residual, lkeys, rkeys = [], [], []
+        for c in sel_node.conds:
+            acc = []
+            _collect_outer_refs(c, acc)
+            if not acc:
+                residual.append(c)
+                continue
+            if not (isinstance(c, ScalarFunc) and c.op == "eq"
+                    and len(c.args) == 2):
+                return None
+            a, b2 = c.args
+            a_acc, b_acc = [], []
+            _collect_outer_refs(a, a_acc)
+            _collect_outer_refs(b2, b_acc)
+            if isinstance(a, OuterRef) and not b_acc:
+                outer_ref, inner = a, b2
+            elif isinstance(b2, OuterRef) and not a_acc:
+                outer_ref, inner = b2, a
+            else:
+                return None
+            if phys_kind(outer_ref.ftype) != phys_kind(inner.ftype):
+                return None
+            lkeys.append(Column(outer_ref.idx, outer_ref.ftype,
+                                name=outer_ref.name))
+            rkeys.append(inner)
+
+        oconds = []
+        if target_ast is not None:
+            out_len = len(proj.exprs) if proj else len(base.schema)
+            if out_len != 1:
+                raise TiDBError("Operand should contain 1 column(s)",
+                                code=ErrCode.OperandColumns)
+            y = proj.exprs[0] if proj else Column(
+                0, base.schema.refs[0].ftype)
+            b = ExprBuilder(from_schema, self.ctx, outer=self.outer)
+            x = b.build(target_ast)
+            x_acc = []
+            _collect_outer_refs(x, x_acc)
+            if x_acc or phys_kind(x.ftype) != phys_kind(y.ftype):
+                return None
+            if kind == "semi":
+                # IN match: plain equality (NULLs never match — correct in
+                # WHERE context, where NULL filters like FALSE)
+                lkeys.append(x)
+                rkeys.append(y)
+            else:
+                # NOT IN: null-aware residual — a build row "blocks" the
+                # probe row when the values match OR either side is NULL
+                nl = len(from_schema)
+                ys = _shift(y, nl)
+                oconds.append(ScalarFunc("or", [
+                    ScalarFunc("or", [
+                        ScalarFunc("eq", [x, ys], _BOOL_FT.clone()),
+                        ScalarFunc("isnull", [ys], _BOOL_FT.clone()),
+                    ], _BOOL_FT.clone()),
+                    ScalarFunc("isnull", [x], _BOOL_FT.clone()),
+                ], _BOOL_FT.clone()))
+        if not lkeys:
+            return None  # no equi keys: a cartesian semi join would be
+            #              worse than the memoized Apply
+        right_child = Selection(base, residual) if residual else base
+        return kind, right_child, lkeys, rkeys, oconds
+
+    _MIRROR_OP = {"=": "=", "!=": "!=", "<": ">", "<=": ">=", ">": "<",
+                  ">=": "<="}
+
+    def _try_decorrelate_scalar_cmp(self, conj, from_schema):
+        """`x <op> (SELECT f(agg) FROM s WHERE s.k = x.k ...)` → semi join
+        against `SELECT k, f(agg) FROM s ... GROUP BY k` with the
+        comparison as the join residual (reference: the aggregate
+        decorrelation in planner/core/rule_decorrelate.go pulls the
+        correlated filter above the agg by injecting its columns into
+        GROUP BY). Grouping by k yields exactly one row per key, so the
+        semi-join residual equals the scalar comparison; a missing group
+        means the scalar is NULL and the comparison filters the row —
+        which the semi join's no-match case reproduces. COUNT bails: its
+        empty-group scalar is 0, not NULL, and a semi join would wrongly
+        drop the row."""
+        from ..expression.builder import OuterScope, _OP_MAP
+        from ..expression.core import OuterRef
+        from ..expression import phys_kind
+        if isinstance(conj.left, ast.SubqueryExpr):
+            sub_ast, target_ast = conj.left.query, conj.right
+            op = self._MIRROR_OP[conj.op]
+        else:
+            sub_ast, target_ast = conj.right.query, conj.left
+            op = conj.op
+        scope = OuterScope(from_schema, mark=True)
+        try:
+            subplan = self.ctx.analyze_subquery(sub_ast, scope)
+        except Exception:
+            return None
+        if self._sub_memo is not None:
+            self._sub_memo[id(sub_ast)] = (scope, subplan)
+        if not scope.used:
+            return None
+
+        node = subplan
+        proj = None
+        if isinstance(node, Projection):
+            proj = node
+            node = node.child
+        if not (isinstance(node, Aggregation) and not node.group_exprs):
+            return None
+        agg = node
+        if any(d.name not in ("sum", "avg", "min", "max") or d.distinct
+               for d in agg.aggs):
+            return None
+        if not isinstance(agg.child, Selection):
+            return None
+        sel_node = agg.child
+        base = sel_node.child
+        if proj is not None and len(proj.exprs) != 1:
+            raise TiDBError("Operand should contain 1 column(s)",
+                            code=ErrCode.OperandColumns)
+
+        for nd in _walk_plan(subplan, []):
+            if nd is sel_node:
+                continue
+            for e in _node_exprs(nd):
+                acc = []
+                _collect_outer_refs(e, acc)
+                if acc:
+                    return None
+
+        residual, lkeys, ikeys = [], [], []
+        for c in sel_node.conds:
+            acc = []
+            _collect_outer_refs(c, acc)
+            if not acc:
+                residual.append(c)
+                continue
+            if not (isinstance(c, ScalarFunc) and c.op == "eq"
+                    and len(c.args) == 2):
+                return None
+            a, b2 = c.args
+            a_acc, b_acc = [], []
+            _collect_outer_refs(a, a_acc)
+            _collect_outer_refs(b2, b_acc)
+            if isinstance(a, OuterRef) and not b_acc:
+                outer_ref, inner = a, b2
+            elif isinstance(b2, OuterRef) and not a_acc:
+                outer_ref, inner = b2, a
+            else:
+                return None
+            if phys_kind(outer_ref.ftype) != phys_kind(inner.ftype):
+                return None
+            lkeys.append(Column(outer_ref.idx, outer_ref.ftype,
+                                name=outer_ref.name))
+            ikeys.append(inner)
+        if not lkeys:
+            return None
+
+        # regroup the aggregate by its correlation keys: output schema is
+        # [keys..., original agg outputs...] (group keys lead — executor
+        # contract), so the projection's column refs shift by len(keys)
+        nk = len(lkeys)
+        child = Selection(base, residual) if residual else base
+        key_refs = [ColumnRef(getattr(e, "name", "") or f"dk{i}", "", "",
+                              e.ftype)
+                    for i, e in enumerate(ikeys)]
+        new_agg = Aggregation(child, ikeys, agg.aggs,
+                              Schema(key_refs + list(agg.schema.refs)))
+        scalar = (proj.exprs[0] if proj is not None
+                  else Column(0, agg.schema.refs[0].ftype))
+        scalar = _shift(scalar, nk)
+
+        b = ExprBuilder(from_schema, self.ctx, outer=self.outer)
+        x = b.build(target_ast)
+        acc = []
+        _collect_outer_refs(x, acc)
+        if acc:
+            return None
+        nl = len(from_schema)
+        cmp_cond = ScalarFunc(_OP_MAP[op], [x, _shift(scalar, nl)],
+                              _BOOL_FT.clone())
+        rkeys = [Column(i, e.ftype) for i, e in enumerate(ikeys)]
+        return "semi", new_agg, lkeys, rkeys, [cmp_cond]
+
     def build_select(self, sel: ast.SelectStmt) -> LogicalPlan:
         plan = self.build_from(sel.from_)
         from_schema = plan.schema
@@ -664,9 +933,35 @@ class PlanBuilder:
             plan.sql_hints = list(sel.hints)
 
         if sel.where is not None:
-            b = ExprBuilder(from_schema, self.ctx, outer=self.outer)
-            conds = split_cnf(b.build(sel.where))
-            plan = Selection(plan, conds)
+            # decorrelation first (reference: optimizer.go:73-91 decorrelate
+            # + expression_rewriter.go): correlated EXISTS/IN conjuncts whose
+            # correlation is equality-only become semi/anti joins — they hit
+            # the (device-capable) join executors instead of the per-outer-
+            # row Apply re-execution
+            conjuncts = []
+            _split_ast_and(sel.where, conjuncts)
+            plain_ast, joins = [], []
+            self._sub_memo = {}  # decorrelation-analysis reuse on bail
+            for c in conjuncts:
+                spec = self._try_decorrelate(c, from_schema)
+                if spec is None:
+                    plain_ast.append(c)
+                else:
+                    joins.append(spec)
+            if plain_ast:
+                b = ExprBuilder(from_schema, self.ctx, outer=self.outer)
+                b.sub_memo = self._sub_memo
+                conds = []
+                for c in plain_ast:
+                    conds.extend(split_cnf(b.build(c)))
+                plan = Selection(plan, conds)
+            self._sub_memo = None
+            for kind, right_child, lkeys, rkeys, oconds in joins:
+                j = Join(plan, right_child, kind, plan.schema)
+                j.left_keys = lkeys
+                j.right_keys = rkeys
+                j.other_conds = oconds
+                plan = j
 
         # -- aggregate detection
         agg_map = {}
@@ -917,6 +1212,51 @@ class PlanBuilder:
 def _shift(expr, delta):
     return expr.transform_columns(
         lambda c: Column(c.idx + delta, c.ftype, name=c.name))
+
+
+def _split_ast_and(e, out):
+    if isinstance(e, ast.BinaryOp) and e.op == "and":
+        _split_ast_and(e.left, out)
+        _split_ast_and(e.right, out)
+    else:
+        out.append(e)
+
+
+def _collect_outer_refs(e, acc):
+    """OuterRef markers (and nested Apply expressions, which also pin the
+    conjunct to the fallback path) anywhere under `e`."""
+    from ..expression.core import OuterRef, SubqueryApply
+    if isinstance(e, (OuterRef, SubqueryApply)):
+        acc.append(e)
+        return
+    for a in getattr(e, "args", None) or ():
+        _collect_outer_refs(a, acc)
+
+
+def _node_exprs(p):
+    if isinstance(p, Selection):
+        return list(p.conds)
+    if isinstance(p, Projection):
+        return list(p.exprs)
+    if isinstance(p, Join):
+        return list(p.left_keys) + list(p.right_keys) + list(p.other_conds)
+    if isinstance(p, Aggregation):
+        return list(p.group_exprs) + [a for d in p.aggs for a in d.args]
+    if isinstance(p, (Sort, TopN)):
+        return [e for e, _d in p.by]
+    if isinstance(p, Window):
+        return (list(p.partition_exprs) + [e for e, _d in p.order_by]
+                + [a for f in p.funcs for a in f.args])
+    if isinstance(p, DataSource):
+        return list(p.pushed_conds)
+    return []
+
+
+def _walk_plan(p, out):
+    out.append(p)
+    for c in p.children:
+        _walk_plan(c, out)
+    return out
 
 
 def _schema_table(schema: Schema, colname: str):
