@@ -729,6 +729,238 @@ def merge_partial_states(state, parts, merge_cap, n_keys, nvals, merge_ops,
         merge_cap = dev.next_pow2(ng)
 
 
+#: window functions the device kernel computes (reference:
+#: executor/window.go; unistore runs window fragments storage-side)
+_WIN_RANKS = {"row_number", "rank", "dense_rank", "percent_rank",
+              "cume_dist"}
+_WIN_AGGS = {"sum", "count", "avg", "min", "max"}
+
+_WIN_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
+    """Window functions as ONE jitted program: a single stable lexsort by
+    (partition, order), then log-depth prefix scans for every function —
+    no per-partition host loop (the host path iterates partitions in
+    Python; reference executor/window.go processes them serially too).
+    Default frames only: with ORDER BY, RANGE UNBOUNDED PRECEDING..CURRENT
+    ROW (peer-aware); without, the whole partition. Raises
+    DeviceUnsupported outside that language (ntile/lead/lag, explicit
+    frames, distinct args) — the host executor covers the rest."""
+    n = chunk.num_rows
+    if n == 0:
+        raise DeviceUnsupported("empty window input")
+    for f in p.funcs:
+        if f.frame is not None:
+            raise DeviceUnsupported("explicit window frame")
+        if f.name in _WIN_RANKS:
+            continue
+        if f.name not in _WIN_AGGS or len(f.args) != 1:
+            raise DeviceUnsupported(f"window func {f.name}")
+        if phys_kind(f.args[0].ftype) == K_STR and f.name not in ("count",):
+            raise DeviceUnsupported("string window aggregate")
+
+    used = set()
+    for e in p.partition_exprs:
+        e.columns_used(used)
+    for e, _d in p.order_by:
+        e.columns_used(used)
+    for f in p.funcs:
+        for a in f.args:
+            a.columns_used(used)
+    dcols = {}
+    env = {}
+    for idx_ in used:
+        dc = dev.to_device_col(chunk.columns[idx_])
+        dcols[idx_] = dc
+        env[idx_] = (dc.data, dc.nulls)
+
+    part_fns = [dev.compile_expr(e, dcols) for e in p.partition_exprs]
+    order_fns = [(dev.compile_expr(e, dcols), d) for e, d in p.order_by]
+    agg_fns = [dev.compile_expr(f.args[0], dcols)
+               if f.name in _WIN_AGGS else None for f in p.funcs]
+    has_order = bool(p.order_by)
+    names = tuple(f.name for f in p.funcs)
+    kinds = tuple(phys_kind(f.args[0].ftype) if f.name in _WIN_AGGS else None
+                  for f in p.funcs)
+
+    def run(env):
+        i = jnp.arange(n)
+        lex = []  # minor → major: tiebreak, order keys reversed, partition
+
+        def push_key(d, nl, desc):
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                v = -d if desc else d
+            else:
+                v = d.astype(jnp.int64)
+                if desc:
+                    v = ~v
+            lex.append(jnp.where(nl, 0, v))
+            # MySQL: NULLs first ASC, last DESC
+            lex.append(jnp.where(nl, 1 if desc else 0, 0 if desc else 1))
+
+        order_kvs = []
+        for fn, desc in order_fns:
+            d, nl = dev.broadcast_1d(*fn(env), n)
+            order_kvs.append((d, nl))
+        part_kvs = []
+        for fn in part_fns:
+            d, nl = dev.broadcast_1d(*fn(env), n)
+            part_kvs.append((d, nl))
+        for (d, nl), (_f, desc) in zip(reversed(order_kvs),
+                                       reversed(order_fns)):
+            push_key(d, nl, desc)
+        for d, nl in reversed(part_kvs):
+            push_key(d, nl, False)
+        idx = jnp.lexsort(lex) if lex else i
+        inv = jnp.argsort(idx)
+
+        def change(kvs):
+            ch = jnp.zeros(n, dtype=bool).at[0].set(True)
+            for d, nl in kvs:
+                # NULL rows carry arbitrary raw data (_agg_impl invariant,
+                # ops/device.py): value-mask before comparing, or NULL runs
+                # split on garbage and every rank/agg restarts mid-group
+                dm = jnp.where(nl, jnp.zeros((), dtype=d.dtype), d)
+                ds, ns = dm[idx], nl[idx]
+                delta = jnp.concatenate([
+                    jnp.ones(1, dtype=bool),
+                    (ds[1:] != ds[:-1]) | (ns[1:] != ns[:-1])])
+                ch = ch | delta
+            return ch
+
+        part_change = (change(part_kvs) if part_kvs
+                       else jnp.zeros(n, dtype=bool).at[0].set(True))
+        peer_change = part_change | (change(order_kvs) if order_kvs
+                                     else jnp.zeros(n, dtype=bool))
+        spos = jax.lax.cummax(jnp.where(part_change, i, -1))
+        ppos = jax.lax.cummax(jnp.where(peer_change, i, -1))
+
+        def seg_end(chg):
+            # smallest later index starting a new segment, minus one
+            nxt = jnp.concatenate([
+                jnp.where(chg[1:], i[1:], n), jnp.full(1, n)])
+            fut = jnp.flip(jax.lax.cummin(jnp.flip(nxt)))
+            return fut - 1
+
+        epos = seg_end(part_change)
+        pe = seg_end(peer_change) if has_order else epos
+        m = epos - spos + 1
+
+        outs = []
+        for name, fn, k in zip(names, agg_fns, kinds):
+            if name == "row_number":
+                outs.append(((i - spos + 1)[inv], jnp.zeros(n, dtype=bool)))
+                continue
+            if name == "rank":
+                outs.append(((ppos - spos + 1)[inv],
+                             jnp.zeros(n, dtype=bool)))
+                continue
+            if name == "dense_rank":
+                c = jnp.cumsum(peer_change)
+                outs.append(((c - c[spos] + 1)[inv],
+                             jnp.zeros(n, dtype=bool)))
+                continue
+            if name == "percent_rank":
+                r = (ppos - spos).astype(jnp.float64)
+                outs.append((jnp.where(m > 1, r / jnp.maximum(m - 1, 1),
+                                       0.0)[inv],
+                             jnp.zeros(n, dtype=bool)))
+                continue
+            if name == "cume_dist":
+                v = (pe - spos + 1).astype(jnp.float64) / m
+                outs.append((v[inv], jnp.zeros(n, dtype=bool)))
+                continue
+            d, nl = dev.broadcast_1d(*fn(env), n)
+            ds, ns = d[idx], nl[idx]
+            end = pe  # default frame: through the current peer group
+            cnt_v = (~ns).astype(jnp.int64)
+            ccs = jnp.cumsum(cnt_v)
+            cnt_run = ccs[end] - ccs[spos] + cnt_v[spos]
+            if name == "count":
+                outs.append((cnt_run[inv], jnp.zeros(n, dtype=bool)))
+                continue
+            if name in ("sum", "avg"):
+                z = jnp.where(ns, 0, ds) if k != K_FLOAT else jnp.where(
+                    ns, 0.0, ds)
+                cs = jnp.cumsum(z)
+                s = cs[end] - cs[spos] + z[spos]
+                outs.append((s[inv], (cnt_run == 0)[inv]))
+                if name == "avg":  # host assembly divides sum by count
+                    outs.append((cnt_run[inv], jnp.zeros(n, dtype=bool)))
+                continue
+            # min / max: flagged segmented running scan, read at `end`
+            big = (jnp.inf if k == K_FLOAT
+                   else jnp.iinfo(jnp.int64).max)
+            ident = big if name == "min" else (
+                -jnp.inf if k == K_FLOAT else jnp.iinfo(jnp.int64).min)
+            z = jnp.where(ns, ident, ds)
+            comb = jnp.minimum if name == "min" else jnp.maximum
+            scan = dev._seg_running(comb, part_change, z)
+            v = scan[end]
+            outs.append((v[inv], (cnt_run == 0)[inv]))
+        return tuple(outs)
+
+    # dictionary identity is load-bearing in the key (and the refs must be
+    # pinned): compiled str-expr LUTs bake the dictionary's codes, exactly
+    # like the agg pipeline cache (_agg_sig / _pipe_cache_put)
+    dict_refs = tuple(dc.dictionary for dc in dcols.values()
+                      if dc.dictionary is not None)
+    sig = (n, names, kinds, has_order,
+           tuple(_expr_sig(e) for e in p.partition_exprs),
+           tuple((_expr_sig(e), d) for e, d in p.order_by),
+           tuple(_expr_sig(f.args[0]) if f.name in _WIN_AGGS else None
+                 for f in p.funcs),
+           tuple(str(id(d)) for d in dict_refs))
+    hit = _WIN_CACHE.get(sig)
+    if hit is None:
+        fn = jax.jit(run)
+        _WIN_CACHE[sig] = (fn, dict_refs)
+        if len(_WIN_CACHE) > 64:
+            _WIN_CACHE.popitem(last=False)
+    else:
+        fn = hit[0]
+    outs = jax.device_get(fn(env))
+
+    out_cols = list(chunk.columns)
+    oi = 0
+    for f in p.funcs:
+        ft = f.ftype
+        if f.name == "avg":
+            s = np.asarray(outs[oi][0])
+            s_null = np.asarray(outs[oi][1])
+            c = np.asarray(outs[oi + 1][0])
+            oi += 2
+            arg = f.args[0]
+            if phys_kind(ft) == K_FLOAT:
+                vals = s / np.maximum(c, 1)
+                if phys_kind(arg.ftype) == K_DEC:
+                    # decimal args evaluate as scaled ints — unscale for
+                    # the double-typed window AVG
+                    vals = vals / POW10[arg.ftype.scale]
+                out_cols.append(Column(ft, vals, s_null))
+            else:
+                s_arg = (arg.ftype.scale
+                         if phys_kind(arg.ftype) == K_DEC else 0)
+                shift = POW10[ft.scale - s_arg]
+                num = s.astype(object) * shift
+                den = np.maximum(c, 1).astype(object)
+                sign = np.where(num < 0, -1, 1)
+                q = (2 * np.abs(num) + den) // (2 * den)
+                vals = np.array([int(x) for x in sign * q], dtype=np.int64)
+                out_cols.append(Column(ft, vals, s_null))
+            continue
+        vals, nulls = outs[oi]
+        oi += 1
+        vals = np.asarray(vals)
+        nulls = np.asarray(nulls)
+        dt = np_dtype_for(ft)
+        if dt is not object and vals.dtype != dt:
+            vals = vals.astype(dt)
+        out_cols.append(Column(ft, vals, nulls))
+    return Chunk(out_cols)
+
+
 def device_join_keys(lkeys, rkeys):
     """Combine multi-column join keys into single int64 codes host-side
     (shared factorization), then match on device. Returns (li, ri).

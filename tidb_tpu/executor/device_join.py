@@ -26,9 +26,15 @@ compiles into ONE jitted XLA program over HBM-resident base tables:
   tight shapes (reference analog: the plan cache reusing learned sizes,
   planner/core/cache.go).
 
-Supported fragment shape: inner equi-joins over table scans with
-pushed-down filters, topped by a group-by aggregate. Anything else raises
-DeviceUnsupported and falls back to the host path.
+Supported fragment shape: equi-joins over table scans with pushed-down
+filters, topped by a group-by aggregate. Join kinds:
+- inner: anywhere in the tree (reorderable, any strategy);
+- left outer: anywhere, with an indexed build side — the build side
+  null-extends in-program (nullmaps thread the ~matched flags through the
+  gathers), ON-residuals fold into the match on the unique-gather path;
+- semi / anti: at the fragment ROOT only (probe-shaped existence counts —
+  exactly the decorrelated EXISTS/IN plans), no residual conds.
+Anything else raises DeviceUnsupported and falls back to the host path.
 """
 
 from __future__ import annotations
@@ -63,13 +69,14 @@ class _Leaf:
 
 class _JoinNode:
     def __init__(self, left, right, left_keys, right_keys, other_conds,
-                 offset):
+                 offset, kind="inner"):
         self.left = left
         self.right = right
         self.left_keys = left_keys    # exprs over left subtree schema
         self.right_keys = right_keys  # exprs over right subtree schema
         self.other_conds = other_conds
         self.offset = offset
+        self.kind = kind          # inner | left | semi | anti
         self.ncols = left.ncols + right.ncols
         self.leaf_ids = left.leaf_ids | right.leaf_ids
         self.cap = 0            # static output capacity (set by _fill_caps)
@@ -102,8 +109,9 @@ def collect_tree(node):
             return leaf
         if isinstance(n, HashJoinExec):
             p = n.plan
-            if p.kind != "inner":
-                raise DeviceUnsupported("only inner joins in device fragment")
+            if p.kind not in ("inner", "left", "semi", "anti"):
+                raise DeviceUnsupported(
+                    f"{p.kind} join in device fragment")
             if not p.left_keys:
                 raise DeviceUnsupported(
                     "cartesian join (no equi keys) in device fragment")
@@ -116,7 +124,8 @@ def collect_tree(node):
                 if (lk.ftype.scale or 0) != (rk.ftype.scale or 0):
                     raise DeviceUnsupported("mismatched decimal key scales")
             jn = _JoinNode(left, right, list(p.left_keys),
-                           list(p.right_keys), list(p.other_conds), offset)
+                           list(p.right_keys), list(p.other_conds), offset,
+                           kind=p.kind)
             jn.pos = len(joins)
             joins.append(jn)
             return jn
@@ -126,6 +135,17 @@ def collect_tree(node):
     root = walk(node, 0)
     if not joins:
         raise DeviceUnsupported("no joins in fragment")
+    # semi/anti joins expose only their probe (left) schema, so upstream
+    # column indices stay valid only when such a join is the fragment ROOT
+    # (the aggregate's direct child — exactly the decorrelated-subquery
+    # shape); anywhere deeper, sibling offsets would collide
+    for jn in joins:
+        if jn.kind in ("semi", "anti") and jn is not root:
+            raise DeviceUnsupported("semi/anti join below fragment root")
+        if jn.kind in ("semi", "anti") and jn.other_conds:
+            # probe-shaped existence checks cannot evaluate residuals over
+            # build columns (null-aware NOT IN etc.) — host path instead
+            raise DeviceUnsupported("semi/anti join with residual conds")
     return root, leaves, joins
 
 
@@ -214,8 +234,15 @@ def _plan_strategy(jn):
     in-program sort (CSR expansion); neither → device lexsort. The right
     (conventional build) side indexes first, and a unique hit returns
     before the left index is ever built — indexing the probe side would
-    argsort the (typically huge) fact table for nothing."""
+    argsort the (typically huge) fact table for nothing.
+
+    Non-inner kinds (left/semi/anti) preserve their LEFT side: the probe
+    must be the left relation, so only right-side builds qualify."""
     ridx = _leaf_index(jn.right, jn.right_keys)
+    if jn.kind != "inner":
+        if ridx is None:
+            return None
+        return ("uniq" if ridx.unique else "expand", "right", ridx)
     if ridx is not None and ridx.unique:
         return ("uniq", "right", ridx)
     lidx = None
@@ -387,6 +414,15 @@ def _cap_store_put(key, val):
         _CAP_STORE.popitem(last=False)
 
 
+def _null_extend(nulls, bidx_map, hit):
+    """Left-join null extension: every build-side leaf's columns read as
+    NULL on rows without a surviving match (shared by the uniq-gather and
+    CSR-expand paths so their semantics can never diverge)."""
+    for lid in bidx_map:
+        prev = nulls.get(lid)
+        nulls[lid] = ~hit if prev is None else (prev | ~hit)
+
+
 def _join_expand(bk, bvalid, pk, pvalid, cap):
     """Static-capacity inner equi-join expansion (device-sort fallback).
     Returns (probe_slot, build_slot, valid, total): slot arrays index the
@@ -553,34 +589,47 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
         overflows = []
         span_ovfs = []
 
-        def gather_env(idxmap, valid, node):
+        def gather_env(idxmap, valid, node, nullmaps=None):
             """env of gathered (relation-space) columns for `node`'s
             subtree, keyed by global column index. Unused columns' gathers
-            are dead code XLA eliminates — laziness here is free."""
+            are dead code XLA eliminates — laziness here is free.
+            nullmaps[leaf_id] marks rows where that leaf contributed no
+            match (left-join null extension): its columns read as NULL."""
             out = {}
             for leaf in leaves:
                 if leaf.leaf_id in idxmap and leaf.leaf_id in node.leaf_ids:
                     idx = idxmap[leaf.leaf_id]
+                    ext = (nullmaps or {}).get(leaf.leaf_id)
                     for i in range(leaf.ncols):
                         hit = env.get(leaf.offset + i)
                         if hit is None:  # pruned (unused) column
                             continue
                         d, nl = hit
-                        out[leaf.offset + i] = (d[idx], nl[idx])
+                        nli = nl[idx]
+                        if ext is not None:
+                            nli = nli | ext
+                        out[leaf.offset + i] = (d[idx], nli)
             return out
 
-        def eval_indexed(node, lidx_map, lvalid, ridx_map, rvalid):
-            """Host-indexed join paths ('uniq' gather / 'expand' CSR)."""
+        def eval_indexed(node, lidx_map, lvalid, lnull, ridx_map, rvalid,
+                         rnull):
+            """Host-indexed join paths ('uniq' gather / 'expand' CSR), for
+            inner / left / semi / anti kinds. Output row space:
+            probe-shaped for uniq and for semi/anti (existence is a count,
+            never an expansion), CSR-expanded otherwise."""
             kind, side, idx = node.strategy
+            jkind = node.kind
             if side == "right":
                 pidx_map, pvalid, pside = lidx_map, lvalid, node.left
                 bidx_map, bvalid = ridx_map, rvalid
+                pnull, bnull = lnull, rnull
                 key_fns_p = node._lk_fns
             else:
                 pidx_map, pvalid, pside = ridx_map, rvalid, node.right
                 bidx_map, bvalid = lidx_map, lvalid
+                pnull, bnull = rnull, lnull
                 key_fns_p = node._rk_fns
-            penv = gather_env(pidx_map, pvalid, pside)
+            penv = gather_env(pidx_map, pvalid, pside, pnull)
             n_probe = pvalid.shape[0]
             kds, knulls = zip(*[
                 dev.broadcast_1d(*f(penv), n_probe) for f in key_fns_p])
@@ -603,43 +652,101 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                     hi = jnp.searchsorted(a0, key, side="right")
                     cnt = jnp.where(
                         ok, jnp.minimum(hi, nv) - jnp.minimum(lo, nv), 0)
+
+            if jkind in ("semi", "anti") and kind != "uniq":
+                # existence only: probe-shaped regardless of match counts
+                hit = cnt > 0
+                valid = pvalid & (hit if jkind == "semi" else ~hit)
+                overflows.append(jnp.sum(valid))
+                return dict(pidx_map), valid, dict(pnull)
+
             if kind == "uniq":
                 bi = a1[jnp.clip(pos0, 0, safe_hi)].astype(jnp.int64)
-                valid = pvalid & (cnt > 0) & bvalid[bi]
+                hit = (cnt > 0) & bvalid[bi]
+                if node._oc_fns and jkind == "left":
+                    # ON-clause residuals are part of the MATCH for outer
+                    # joins — evaluate on the joined candidate row first
+                    cand_idx = dict(pidx_map)
+                    for lid, v in bidx_map.items():
+                        cand_idx[lid] = v[bi]
+                    cand_null = dict(pnull)
+                    for lid, v in bnull.items():
+                        cand_null[lid] = v[bi]
+                    jenv = gather_env(cand_idx, hit, node, cand_null)
+                    for f in node._oc_fns:
+                        d, nl = f(jenv)
+                        hit = hit & (d != 0) & ~nl
+                if jkind == "semi":
+                    overflows.append(jnp.sum(pvalid & hit))
+                    return dict(pidx_map), pvalid & hit, dict(pnull)
+                if jkind == "anti":
+                    overflows.append(jnp.sum(pvalid & ~hit))
+                    return dict(pidx_map), pvalid & ~hit, dict(pnull)
+                valid = pvalid if jkind == "left" else (pvalid & hit)
                 out = dict(pidx_map)
+                nulls = dict(pnull)
                 for lid, v in bidx_map.items():
                     out[lid] = v[bi]
+                for lid, v in bnull.items():
+                    nulls[lid] = v[bi]
+                if jkind == "left":
+                    _null_extend(nulls, bidx_map, hit)
                 overflows.append(jnp.sum(valid))  # ≤ cap by construction
-                return out, valid
+                return out, valid, nulls
+
+            # CSR expansion (non-unique build)
             cap = node.cap
+            if jkind == "left":
+                # unmatched probe rows emit exactly one null-extended row
+                cnt_eff = jnp.where(pvalid, jnp.maximum(cnt, 1), 0)
+            else:
+                cnt_eff = cnt
             cum = jnp.concatenate(
-                [jnp.zeros(1, dtype=jnp.int64), jnp.cumsum(cnt)])
+                [jnp.zeros(1, dtype=jnp.int64), jnp.cumsum(cnt_eff)])
             total = cum[-1]
             posn = jnp.arange(cap)
             pi = jnp.clip(jnp.searchsorted(cum, posn, side="right") - 1,
                           0, n_probe - 1)
             within = posn - cum[pi]
-            bi = a1[jnp.clip(pos0[pi] + within, 0, safe_hi)].astype(jnp.int64)
-            valid = (posn < total) & bvalid[bi] & pvalid[pi]
+            real = within < cnt[pi]  # False on a left join's null emission
+            bpos = pos0[pi] + jnp.minimum(within,
+                                          jnp.maximum(cnt[pi] - 1, 0))
+            bi = a1[jnp.clip(bpos, 0, safe_hi)].astype(jnp.int64)
+            hit = real & bvalid[bi]
+            if jkind == "left":
+                valid = (posn < total) & pvalid[pi]
+            else:
+                valid = (posn < total) & hit & pvalid[pi]
             overflows.append(total)
             out = {k: v[pi] for k, v in pidx_map.items()}
+            nulls = {k: v[pi] for k, v in pnull.items()}
             for lid, v in bidx_map.items():
                 out[lid] = v[bi]
-            return out, valid
+            for lid, v in bnull.items():
+                nulls[lid] = v[bi]
+            if jkind == "left":
+                _null_extend(nulls, bidx_map, hit)
+            return out, valid, nulls
 
         def eval_node(node):
             if isinstance(node, _Leaf):
-                return leaf_rel(node)
+                idxmap, mask = leaf_rel(node)
+                return idxmap, mask, {}
             # children always evaluate left-then-right so the overflow
             # list order matches the `joins` list (postorder walk)
-            lidx, lvalid = eval_node(node.left)
-            ridx, rvalid = eval_node(node.right)
+            lidx, lvalid, lnull = eval_node(node.left)
+            ridx, rvalid, rnull = eval_node(node.right)
             if node.strategy is not None:
-                idxmap, valid = eval_indexed(node, lidx, lvalid, ridx,
-                                             rvalid)
+                idxmap, valid, nullmaps = eval_indexed(
+                    node, lidx, lvalid, lnull, ridx, rvalid, rnull)
+                if node.kind == "left":
+                    return idxmap, valid, nullmaps  # conds folded already
             else:
-                lenv = gather_env(lidx, lvalid, node.left)
-                renv = gather_env(ridx, rvalid, node.right)
+                if node.kind != "inner":
+                    raise DeviceUnsupported(
+                        f"{node.kind} join needs an indexed build side")
+                lenv = gather_env(lidx, lvalid, node.left, lnull)
+                renv = gather_env(ridx, rvalid, node.right, rnull)
                 lkds, lknulls = zip(*[
                     dev.broadcast_1d(*f(lenv), lvalid.shape[0])
                     for f in node._lk_fns])
@@ -654,15 +761,17 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                 overflows.append(total)
                 idxmap = {k: v[pi] for k, v in lidx.items()}
                 idxmap.update({k: v[bi] for k, v in ridx.items()})
-            if node._oc_fns:
-                jenv = gather_env(idxmap, valid, node)
+                nullmaps = {k: v[pi] for k, v in lnull.items()}
+                nullmaps.update({k: v[bi] for k, v in rnull.items()})
+            if node._oc_fns and node.kind == "inner":
+                jenv = gather_env(idxmap, valid, node, nullmaps)
                 for f in node._oc_fns:
                     d, nl = f(jenv)
                     valid = valid & (d != 0) & ~nl
-            return idxmap, valid
+            return idxmap, valid, nullmaps
 
-        idxmap, valid = eval_node(root)
-        fenv = gather_env(idxmap, valid, root)
+        idxmap, valid, nullmaps = eval_node(root)
+        fenv = gather_env(idxmap, valid, root, nullmaps)
         mask = valid
         for f in cond_fns:
             d, nl = f(fenv)
@@ -729,8 +838,11 @@ def _fill_caps(node, sig):
     lc = _fill_caps(node.left, sig)
     rc = _fill_caps(node.right, sig)
     st = node.strategy
-    if st is not None and st[0] == "uniq":
-        node.cap = lc if st[1] == "right" else rc
+    if node.kind in ("semi", "anti") or (
+            st is not None and st[0] == "uniq"):
+        # probe-shaped: semi/anti are existence counts; uniq is a gather
+        node.cap = lc if (node.kind != "inner"
+                          or st[1] == "right") else rc
         return node.cap
     if node.exp_cap is None:
         learned = _CAP_STORE.get((sig, node.pos))
@@ -738,8 +850,10 @@ def _fill_caps(node, sig):
             node.exp_cap = dev.next_pow2(max(learned, 8))
         elif st is not None:
             probe_cap = lc if st[1] == "right" else rc
-            node.exp_cap = dev.next_pow2(
-                max(int(probe_cap * st[2].avg_cnt * 1.5), 1024))
+            est = int(probe_cap * st[2].avg_cnt * 1.5)
+            if node.kind == "left":
+                est += probe_cap  # every unmatched probe row still emits
+            node.exp_cap = dev.next_pow2(max(est, 1024))
         else:
             def fk_est(nd):
                 if isinstance(nd, _Leaf):
@@ -758,12 +872,25 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     root, leaves, joins = collect_tree(child_exec)
     if not want_device(ctx, max(leaf.chunk.num_rows for leaf in leaves)):
         raise DeviceUnsupported("below device threshold")
-    reordered = _reorder_fact_first(leaves, joins)
+    all_inner = all(jn.kind == "inner" for jn in joins)
+    reordered = _reorder_fact_first(leaves, joins) if all_inner else None
     if reordered is not None:
         root, joins = reordered  # strategies assigned (all uniq)
     else:
         for jn in joins:
             jn.strategy = _plan_strategy(jn)
+        for jn in joins:
+            if jn.kind == "inner":
+                continue
+            if jn.strategy is None:
+                raise DeviceUnsupported(
+                    f"{jn.kind} join needs an indexed build side")
+            if (jn.kind == "left" and jn.other_conds
+                    and jn.strategy[0] != "uniq"):
+                # ON-residuals fold into the match only on the gather
+                # path; dropping them on the CSR path would change results
+                raise DeviceUnsupported(
+                    "left join residual conds need a unique build")
 
     # paged-probe dispatch: a disk-backed (or huge) fact side must stream
     # pages — uploading it whole would exceed HBM (and at SF100, RAM)
@@ -771,7 +898,8 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     probe = _probe_spine(root)
     any_paged = any(chunk_is_paged(leaf.chunk) for leaf in leaves)
     pageable = (isinstance(probe, _Leaf) and all(
-        jn.strategy is not None and jn.strategy[0] == "uniq"
+        jn.kind == "inner" and jn.strategy is not None
+        and jn.strategy[0] == "uniq"
         and jn.strategy[1] == "right" for jn in joins))
     if any_paged and not pageable:
         # the resident path would read entire memmaps into RAM + HBM; a
@@ -867,8 +995,9 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
                 "multi-key join value ranges exceed int64 packing")
         retry = False
         for jn, total in zip(joins, overflows):
-            if jn.strategy is not None and jn.strategy[0] == "uniq":
-                continue  # total = matched rows, bounded by the probe cap
+            if jn.kind in ("semi", "anti") or (
+                    jn.strategy is not None and jn.strategy[0] == "uniq"):
+                continue  # probe-shaped: total ≤ probe cap by construction
             total = int(total)
             tight = dev.next_pow2(max(total, 8))
             if total > jn.cap:
@@ -1141,7 +1270,7 @@ def fragment_sig(leaves, joins, agg_conds, agg_plan):
     for jn in joins:
         keys = ",".join(f"{_expr_sig(lk)}={_expr_sig(rk)}"
                         for lk, rk in zip(jn.left_keys, jn.right_keys))
-        parts.append(f"J{jn.offset}:{keys}|"
+        parts.append(f"J{jn.offset}/{jn.kind}:{keys}|"
                      + ";".join(_expr_sig(c) for c in jn.other_conds))
         parts.append(_strategy_sig(jn))
     parts.append("|c|" + ";".join(_expr_sig(c) for c in agg_conds))

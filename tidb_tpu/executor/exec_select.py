@@ -1086,6 +1086,15 @@ class WindowExec(QueryExecutor):
                         else np.zeros(0, dtype=dt))
                 cols.append(Column(f.ftype, data, np.zeros(0, dtype=bool)))
             return Chunk(cols)
+        from .device_exec import want_device, device_window
+        from .device_exec import DeviceUnsupported as _DU
+        if want_device(self.ctx, n):
+            try:
+                out = device_window(p, chunk, self.ctx)
+                self.annotate(engine="tpu")
+                return out
+            except _DU:
+                pass
         if p.partition_exprs:
             pk = [_collate_eval(e, chunk) for e in p.partition_exprs]
             gids, ng, _fi = host.group_ids(pk)

@@ -372,6 +372,9 @@ def mpp_join_agg(agg_plan, agg_conds, child_exec, ctx, mesh):
     """join-tree→group-by fragment over the mesh: probe spine sharded,
     build sides broadcast (the broadcast hash join MPP variant)."""
     root, leaves, joins = collect_tree(child_exec)
+    if any(jn.kind != "inner" for jn in joins):
+        # the mesh fragment compiler shards/broadcasts inner joins only
+        raise DeviceUnsupported("non-inner join in MPP fragment")
     from ..storage.paged import chunk_is_paged
     if any(chunk_is_paged(leaf.chunk) for leaf in leaves):
         # MPP shards whole resident columns across the mesh; a disk-backed

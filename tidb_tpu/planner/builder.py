@@ -648,7 +648,11 @@ class PlanBuilder:
                     continue
             if join.kind == "inner" and left_only:
                 join.children[0] = Selection(join.left, [cond])
-            elif join.kind == "inner" and right_only:
+            elif join.kind in ("inner", "left") and right_only:
+                # a LEFT join's inner-side-only ON cond restricts which
+                # rows can MATCH — pushing it into the inner child is
+                # equivalent (unmatched probe rows still null-extend);
+                # a left-only ON cond is NOT pushable for outer joins
                 join.children[1] = Selection(join.right, [_shift(cond, -nl)])
             else:
                 join.other_conds.append(cond)
