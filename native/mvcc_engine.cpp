@@ -26,6 +26,15 @@ namespace {
 
 enum Op : int32_t { OP_PUT = 0, OP_DEL = 1, OP_LOCK = 2, OP_ROLLBACK = 3 };
 
+// Flag bit OR'd onto a prewrite op: skip the write-conflict check for this
+// key. Used by the schema amender's injected index mutations — they are
+// logically sequenced AFTER the concurrent ADD INDEX backfill the
+// transaction just observed (the amendment reads the post-DDL schema), so
+// "committed after my start_ts" on exactly these keys is not a conflict
+// (reference: the amended-mutation commit path of session/schema_amender.go
+// + client-go's special handling for amended keys).
+static const int32_t OP_AMEND_FLAG = 16;
+
 enum Status : int32_t {
   ST_OK = 0,
   ST_LOCKED = 1,
@@ -150,6 +159,7 @@ int32_t mvcc_prewrite(void* h, int32_t n, const char** keys,
       // at lock-acquisition time (TiKV pessimistic-prewrite semantics)
       continue;
     }
+    if (ops[i] & OP_AMEND_FLAG) continue;  // amended key: no ts conflict
     uint64_t conflict = e->has_commit_after(key, start_ts);
     if (conflict) {
       *out_ts = conflict;
@@ -164,7 +174,7 @@ int32_t mvcc_prewrite(void* h, int32_t n, const char** keys,
   for (int32_t i = 0; i < n; i++) {
     LockRec l;
     l.start_ts = start_ts;
-    l.op = ops[i];
+    l.op = ops[i] & ~OP_AMEND_FLAG;  // store the base op
     l.primary = mkstr(primary, plen);
     l.has_value = vlens[i] >= 0;
     if (l.has_value && vlens[i] > 0) l.value.assign(vals[i], vlens[i]);

@@ -111,7 +111,7 @@ class TestDeviceWindow:
                      "(1,2,40),(1,3,50)")
         rows = _both(tk, (
             "select k, sum(v) over (partition by g order by k) from wp "
-            "order by k, 2"), expect_device=False)
+            "order by k, 2"))
         assert rows == [("1", "30"), ("1", "30"), ("2", "100"),
                         ("2", "100"), ("3", "150")]
 
@@ -126,6 +126,19 @@ class TestDeviceWindow:
             "select v, row_number() over (order by v, g) from w "
             "order by v, g"))
 
+    def test_min_max_date_with_nulls(self, tk):
+        """MIN/MAX over an int32-backed DATE column with NULLs: the null
+        identity must use the device dtype's extremes (int64 extremes wrap
+        to -1/0 in int32 — regression: device returned 1969-12-31)."""
+        tk.must_exec("create table wd (g bigint, d date)")
+        tk.must_exec("insert into wd values (1, '2024-01-01'),(1, null),"
+                     "(1, '2024-03-05'),(2, null),(2, '1999-09-09')")
+        rows = _both(tk, (
+            "select g, min(d) over (partition by g), "
+            "max(d) over (partition by g) from wd order by g, 2"))
+        assert rows[0][1] == "2024-01-01" and rows[0][2] == "2024-03-05"
+        assert rows[-1][1] == "1999-09-09"
+
     def test_null_computed_partition_key(self, tk):
         """NULL rows of a computed partition key carry arbitrary raw data
         on device — boundary detection must value-mask them or every NULL
@@ -135,7 +148,7 @@ class TestDeviceWindow:
                      "(null, 3, 30),(1, 1, 40),(1, 2, 50)")
         rows = _both(tk, (
             "select v, count(*) over (partition by a + b) from wn "
-            "order by v"), expect_device=False)
+            "order by v"))
         # a+b is NULL on three rows -> ONE null partition of size 3
         assert rows[0][1] == "3" and rows[1][1] == "3" and rows[2][1] == "3"
 

@@ -179,3 +179,88 @@ class TestAmenderMatrix:
         # the finished index serves every concurrent insert
         tk.must_query("select count(*) from am2 use index (ia) "
                       "where a >= 0").check([("40",)])
+
+
+class TestAmenderCommits:
+    """The amender proper (reference session/schema_amender.go
+    amendOperationAddIndex): for a NON-UNIQUE ADD INDEX crossing an open
+    optimistic txn, the commit must now SUCCEED with the membuffer
+    patched — the matrix rows flip from 'retry' to 'commit with a
+    correct index'. Unique additions and column DDL keep the 8028 gate."""
+
+    def _cross(self, tk, setup, dml, ddl):
+        tk.must_exec("drop table if exists amx")
+        tk.must_exec("create table amx (id bigint primary key, a bigint, "
+                     "b varchar(16))")
+        for stmt in setup:
+            tk.must_exec(stmt)
+        tk.must_exec("set session tidb_txn_mode = 'optimistic'")
+        tk.must_exec("begin")
+        for stmt in dml:
+            tk.must_exec(stmt)
+        _run(_other(tk), ddl)
+        tk.must_exec("commit")  # must NOT raise 8028
+        tk.must_exec("set session tidb_txn_mode = 'pessimistic'")
+
+    def test_insert_commits_with_amended_index(self, tk):
+        self._cross(tk, ["insert into amx values (1, 10, 'x')"],
+                    ["insert into amx values (2, 20, 'y')"],
+                    "alter table amx add index ia (a)")
+        tk.must_query("select id from amx use index (ia) where a = 20"
+                      ).check([("2",)])
+        tk.must_query("admin check table amx").check([])
+
+    def test_update_commits_with_amended_index(self, tk):
+        self._cross(tk, ["insert into amx values (1, 10, 'x')"],
+                    ["update amx set a = 99 where id = 1"],
+                    "alter table amx add index ia (a)")
+        tk.must_query("select id from amx use index (ia) where a = 99"
+                      ).check([("1",)])
+        tk.must_query("select count(*) from amx use index (ia) "
+                      "where a = 10").check([("0",)])
+        tk.must_query("admin check table amx").check([])
+
+    def test_delete_commits_with_amended_index(self, tk):
+        self._cross(tk, ["insert into amx values (1, 10, 'x'), "
+                         "(2, 20, 'y')"],
+                    ["delete from amx where id = 1"],
+                    "alter table amx add index ia (a)")
+        tk.must_query("select count(*) from amx use index (ia) "
+                      "where a = 10").check([("0",)])
+        tk.must_query("admin check table amx").check([])
+
+    def test_multi_column_index_amended(self, tk):
+        self._cross(tk, [],
+                    ["insert into amx values (3, 30, 'zz')"],
+                    "alter table amx add index iab (a, b)")
+        tk.must_query("select id from amx use index (iab) "
+                      "where a = 30 and b = 'zz'").check([("3",)])
+        tk.must_query("admin check table amx").check([])
+
+    def test_unique_add_still_gates(self, tk):
+        """UNIQUE additions keep the 8028 abort: the duplicate check
+        needs a global scan the amender cannot do from a membuffer."""
+        tk.must_exec("drop table if exists amu")
+        tk.must_exec("create table amu (id bigint primary key, a bigint)")
+        tk.must_exec("set session tidb_txn_mode = 'optimistic'")
+        tk.must_exec("begin")
+        tk.must_exec("insert into amu values (1, 5)")
+        _run(_other(tk), "alter table amu add unique index ua (a)")
+        with pytest.raises(TiDBError) as ei:
+            tk.must_exec("commit")
+        assert ei.value.code in (ErrCode.InfoSchemaChanged,
+                                 ErrCode.TxnRetryable)
+        tk.must_exec("set session tidb_txn_mode = 'pessimistic'")
+
+    def test_add_column_still_gates(self, tk):
+        tk.must_exec("drop table if exists amc")
+        tk.must_exec("create table amc (id bigint primary key, a bigint)")
+        tk.must_exec("set session tidb_txn_mode = 'optimistic'")
+        tk.must_exec("begin")
+        tk.must_exec("insert into amc values (1, 5)")
+        _run(_other(tk), "alter table amc add column c bigint default 3")
+        with pytest.raises(TiDBError) as ei:
+            tk.must_exec("commit")
+        assert ei.value.code in (ErrCode.InfoSchemaChanged,
+                                 ErrCode.TxnRetryable)
+        tk.must_exec("set session tidb_txn_mode = 'pessimistic'")

@@ -735,9 +735,6 @@ _WIN_RANKS = {"row_number", "rank", "dense_rank", "percent_rank",
               "cume_dist"}
 _WIN_AGGS = {"sum", "count", "avg", "min", "max"}
 
-_WIN_CACHE: "collections.OrderedDict" = collections.OrderedDict()
-
-
 def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
     """Window functions as ONE jitted program: a single stable lexsort by
     (partition, order), then log-depth prefix scans for every function —
@@ -881,19 +878,29 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
                 outs.append((cnt_run[inv], jnp.zeros(n, dtype=bool)))
                 continue
             if name in ("sum", "avg"):
-                z = jnp.where(ns, 0, ds) if k != K_FLOAT else jnp.where(
-                    ns, 0.0, ds)
-                cs = jnp.cumsum(z)
-                s = cs[end] - cs[spos] + z[spos]
+                if k == K_FLOAT:
+                    # segmented scan, NOT prefix-sum differences: the
+                    # global cumsum carries earlier partitions' magnitude
+                    # into this partition's rounding error (same invariant
+                    # as the agg kernel, ops/device.py _agg_impl)
+                    z = jnp.where(ns, 0.0, ds)
+                    s = dev._seg_running(jnp.add, part_change, z)[end]
+                else:
+                    z = jnp.where(ns, 0, ds)
+                    cs = jnp.cumsum(z)  # ints: differences are exact
+                    s = cs[end] - cs[spos] + z[spos]
                 outs.append((s[inv], (cnt_run == 0)[inv]))
                 if name == "avg":  # host assembly divides sum by count
                     outs.append((cnt_run[inv], jnp.zeros(n, dtype=bool)))
                 continue
-            # min / max: flagged segmented running scan, read at `end`
-            big = (jnp.inf if k == K_FLOAT
-                   else jnp.iinfo(jnp.int64).max)
-            ident = big if name == "min" else (
-                -jnp.inf if k == K_FLOAT else jnp.iinfo(jnp.int64).min)
+            # min / max: flagged segmented running scan, read at `end`;
+            # the null identity must match the column's DEVICE dtype —
+            # int64 extremes silently wrap on int32-backed DATE columns
+            if k == K_FLOAT:
+                ident = jnp.inf if name == "min" else -jnp.inf
+            else:
+                info = jnp.iinfo(ds.dtype)
+                ident = info.max if name == "min" else info.min
             z = jnp.where(ns, ident, ds)
             comb = jnp.minimum if name == "min" else jnp.maximum
             scan = dev._seg_running(comb, part_change, z)
@@ -912,14 +919,10 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
            tuple(_expr_sig(f.args[0]) if f.name in _WIN_AGGS else None
                  for f in p.funcs),
            tuple(str(id(d)) for d in dict_refs))
-    hit = _WIN_CACHE.get(sig)
-    if hit is None:
+    fn = _pipe_cache_get(("win",) + sig)
+    if fn is None:
         fn = jax.jit(run)
-        _WIN_CACHE[sig] = (fn, dict_refs)
-        if len(_WIN_CACHE) > 64:
-            _WIN_CACHE.popitem(last=False)
-    else:
-        fn = hit[0]
+        _pipe_cache_put(("win",) + sig, fn, dict_refs)
     outs = jax.device_get(fn(env))
 
     out_cols = list(chunk.columns)

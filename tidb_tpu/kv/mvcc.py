@@ -29,6 +29,14 @@ from ..errors import LockedError, WriteConflictError, DeadlockError
 OP_PUT = 0
 OP_DEL = 1
 OP_LOCK = 2  # lock-only record (SELECT FOR UPDATE)
+
+#: flag bit OR'd onto a prewrite op: skip the write-conflict check for
+#: this key. The schema amender's injected index mutations are logically
+#: sequenced AFTER the ADD INDEX backfill the txn just observed (the
+#: amendment was computed FROM the post-DDL schema), so a backfill commit
+#: past start_ts on exactly these keys is not a conflict (reference:
+#: schema_amender.go's amended-mutation commit handling).
+OP_AMEND_FLAG = 16
 OP_ROLLBACK = 3
 
 
@@ -165,7 +173,8 @@ class MVCCStore:
     # -- transactional API --------------------------------------------------
 
     def prewrite(self, mutations, primary: bytes, start_ts: int):
-        """mutations: [(key, op, value)] with op in {OP_PUT, OP_DEL, OP_LOCK}."""
+        """mutations: [(key, op, value)] with op in {OP_PUT, OP_DEL,
+        OP_LOCK}, optionally OR'd with OP_AMEND_FLAG."""
         with self._lock:
             for key, op, value in mutations:
                 lock = self.locks.get(key)
@@ -178,6 +187,8 @@ class MVCCStore:
                     # TiKV pessimistic prewrite skips the write-conflict
                     # check for DoPessimisticCheck keys)
                     continue
+                if op & OP_AMEND_FLAG:
+                    continue  # amended key: no ts conflict (see flag doc)
                 conflict = self.map.has_commit_after(key, start_ts)
                 if conflict:
                     raise WriteConflictError(
@@ -185,7 +196,8 @@ class MVCCStore:
                 if self.map.has_rollback(key, start_ts):
                     raise WriteConflictError("transaction already rolled back")
             for key, op, value in mutations:
-                self.locks[key] = Lock(start_ts, primary, op, value)
+                self.locks[key] = Lock(start_ts, primary, op & ~OP_AMEND_FLAG,
+                                       value)
 
     def commit(self, keys, start_ts: int, commit_ts: int):
         with self._lock:

@@ -13,7 +13,7 @@ import threading
 import time
 
 from ..errors import ErrCode, LockedError, TiDBError, WriteConflictError
-from .mvcc import MVCCStore, OP_DEL, OP_LOCK, OP_PUT
+from .mvcc import MVCCStore, OP_AMEND_FLAG, OP_DEL, OP_LOCK, OP_PUT
 
 _MISSING = object()
 
@@ -149,6 +149,9 @@ class Transaction:
         self.locked_keys: set[bytes] = set()
         self.touched_tables: set[int] = set()
         self.schema_fps: dict[int, tuple] = {}  # tid -> table.schema_fp()
+        #: keys whose prewrite skips the ts-conflict check (schema-amender
+        #: injected index mutations; see mvcc.OP_AMEND_FLAG)
+        self.amend_keys: set[bytes] = set()
         self.committed_versions: dict[int, int] = {}  # tid -> post-commit ver
         self.for_update_ts = start_ts
 
@@ -217,10 +220,10 @@ class Transaction:
         self.valid = False
         muts = []
         for key, value in self.membuf.items_sorted():
-            if value is None:
-                muts.append((key, OP_DEL, None))
-            else:
-                muts.append((key, OP_PUT, value))
+            op = OP_DEL if value is None else OP_PUT
+            if key in self.amend_keys:
+                op |= OP_AMEND_FLAG
+            muts.append((key, op, value))
         for key in self.locked_keys:
             if key not in self.membuf:
                 muts.append((key, OP_LOCK, None))

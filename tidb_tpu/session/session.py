@@ -557,7 +557,10 @@ class Session:
                 infos_now = self.domain.infoschema()
                 for tid, fp in txn.schema_fps.items():
                     info, _stats_tid = self._resolve_physical(infos_now, tid)
-                    if info is None or schema_fp(info) != fp:
+                    if info is None or (
+                            schema_fp(info) != fp
+                            and not self._try_amend_schema(txn, tid, fp,
+                                                           info)):
                         txn.rollback()
                         raise SchemaChangedError(
                             "Information schema is changed during the "
@@ -584,6 +587,79 @@ class Session:
                 cache.apply_delta(info, deltas[tid], newv)
             except Exception:
                 cache.invalidate(tid)
+
+    def _try_amend_schema(self, txn, tid, old_fp, new_info) -> bool:
+        """Schema amender for the dominant mid-txn DDL case (reference:
+        session/schema_amender.go, 704 LoC — amendOperationAddIndex):
+        when the only schema delta on a written table is NON-UNIQUE
+        indexes gaining write visibility (ADD INDEX reaching write-only/
+        write-reorg/public while this optimistic txn was open), patch the
+        membuffer with the missing index mutations — delete the entry the
+        backfill may have written for the pre-txn row, insert the entry
+        for the new row — and let the commit proceed instead of failing
+        8028. Anything else (column changes, dropped/regressed indexes,
+        unique additions whose duplicate check needs a global scan) keeps
+        the fingerprint gate's retriable abort. Returns True when the
+        txn's mutations now satisfy the CURRENT schema."""
+        from .. import tablecodec
+        from ..model import SchemaState
+        from ..table import Table, schema_fp
+        new_fp = schema_fp(new_info)
+        if old_fp[0] != new_fp[0]:
+            return False  # column layout moved: row encodings may be stale
+        old_idx = {t[0]: t for t in old_fp[1]}
+        to_amend = []
+        for ix in new_info.indexes:
+            prev = old_idx.pop(ix.id, None)
+            prev_state = prev[1] if prev is not None else None
+            if prev is not None and (prev[2] != ix.unique
+                                     or ix.state < prev_state):
+                return False  # changed definition or regressing state
+            prev_writes = (prev_state is not None
+                           and prev_state > SchemaState.DELETE_ONLY)
+            if prev_writes or ix.state <= SchemaState.DELETE_ONLY:
+                continue  # puts already maintained, or none required yet
+            if ix.unique:
+                return False
+            to_amend.append(ix)
+        if old_idx:
+            return False  # an index this txn maintained no longer exists
+        if to_amend:
+            pre = tablecodec.record_prefix(tid)
+            items = list(txn.membuf.range_items(pre, pre + b"\xff" * 9))
+            tbl = Table(new_info, txn)
+
+            def entry_key(ix, row, h):
+                # to_amend is non-unique only: the entry key always
+                # carries the handle (table.py _index_put layout)
+                return tablecodec.index_key(
+                    new_info.id, ix.id, tbl._index_values(ix, row), handle=h)
+
+            for k, v in items:
+                try:
+                    _t, h = tablecodec.decode_record_key(k)
+                except ValueError:
+                    continue
+                r_new = tablecodec.decode_row(v) if v is not None else None
+                old_val = txn.snapshot.get(k)
+                r_old = (tablecodec.decode_row(old_val)
+                         if old_val is not None else None)
+                for ix in to_amend:
+                    if r_old is not None:
+                        # the reorg backfill (running at a later snapshot)
+                        # indexes the pre-txn row; our commit replaces it.
+                        # Amended keys skip the prewrite ts-conflict check
+                        # — the backfill's later commit on exactly these
+                        # keys is the expected interleaving, not a race
+                        key = entry_key(ix, r_old, h)
+                        txn.delete(key)
+                        txn.amend_keys.add(key)
+                    if r_new is not None:
+                        key = entry_key(ix, r_new, h)
+                        txn.put(key, tablecodec.INDEX_VALUE_MARKER)
+                        txn.amend_keys.add(key)
+        txn.schema_fps[tid] = new_fp
+        return True
 
     def _resolve_physical(self, infos, tid):
         """tid → (TableInfo view, stats table id): logical tables resolve
