@@ -1,0 +1,141 @@
+"""Unified cost search (reference: planner/core/find_best_task.go DP +
+the tidb_opt_*_factor sysvars): one calibrated currency prices access
+paths, join variants and engine placement; plans flip by SETting the
+constants — never by editing code."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.planner.cost_model import (
+    COST_VARS, CostModel, apply_calibration, calibrate)
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+def _plan(tk, sql):
+    return "\n".join(r[0] + "|" + r[1] for r in
+                     tk.must_query("explain " + sql).rows)
+
+
+def _vplan(tk, sql):
+    return [(r[0], r[1], r[2]) for r in
+            tk.must_query("explain format='verbose' " + sql).rows]
+
+
+class TestCalibration:
+    def test_calibrate_returns_all_host_constants(self):
+        vals = calibrate(n=1 << 14)
+        for name, _d in COST_VARS:
+            assert name in vals or name == "tidb_opt_scan_row_cost" or \
+                vals.get(name) is not None, name
+        assert vals["tidb_opt_scan_row_cost"] == 1.0
+        # seeks are pointer-chasing; scans are vectorized — any sane
+        # machine measures seeks at least several scan-rows each
+        assert vals["tidb_opt_seek_cost"] > 1.0
+        assert vals["tidb_opt_hash_build_cost"] > 0
+
+    def test_apply_calibration_installs_globals(self, tk):
+        vals = apply_calibration(tk.domain, {"tidb_opt_seek_cost": 123.5})
+        assert vals["tidb_opt_seek_cost"] == 123.5
+        assert tk.must_query(
+            "select @@global.tidb_opt_seek_cost").rows == [("123.5",)]
+        # sessions planning after this read the measured constant
+        cm = CostModel.from_ctx(tk.session)
+        assert cm.seek == 123.5
+
+    def test_breakeven_derives_from_constants(self):
+        cm = CostModel(1.0, 8.0, 30.0, 2.0, 0.05, 2.0, 0.02, 195000.0)
+        assert 60000 < cm.device_breakeven_rows() < 70000
+
+
+class TestPlanFlips:
+    def _setup_join(self, tk):
+        tk.must_exec("create table big (k bigint, v bigint)")
+        tk.must_exec("create table small (k bigint primary key, w bigint)")
+        rng = np.random.default_rng(8)
+        tk.must_exec("insert into big values " + ",".join(
+            f"({int(rng.integers(1, 200))}, {i})" for i in range(2000)))
+        tk.must_exec("insert into small values " + ",".join(
+            f"({i}, {i * 3})" for i in range(1, 5001)))
+        tk.must_exec("analyze table big")
+        tk.must_exec("analyze table small")
+
+    def test_seek_cost_flips_index_join(self, tk):
+        """Same query, same stats: the join variant flips purely on the
+        calibrated seek constant."""
+        self._setup_join(tk)
+        q = ("select count(*) from big, small where big.k = small.k "
+             "and big.v < 100")
+        tk.must_exec("set tidb_opt_seek_cost = 0.001")
+        tk.must_exec("set tidb_opt_seek_base = 0.001")
+        assert "IndexJoin" in _plan(tk, q)
+        tk.must_exec("set tidb_opt_seek_cost = 100000")
+        tk.must_exec("set tidb_opt_seek_base = 100000")
+        assert "IndexJoin" not in _plan(tk, q)
+
+    def test_seek_cost_flips_access_path(self, tk):
+        tk.must_exec("create table ap (a bigint, b bigint, index ia (a))")
+        rng = np.random.default_rng(9)
+        tk.must_exec("insert into ap values " + ",".join(
+            f"({int(rng.integers(0, 500))}, {i})" for i in range(3000)))
+        tk.must_exec("analyze table ap")
+        q = "select sum(b) from ap where a = 7"
+        tk.must_exec("set tidb_opt_seek_cost = 0.001")
+        tk.must_exec("set tidb_opt_seek_base = 0.001")
+        assert "IndexLookUp" in _plan(tk, q)
+        tk.must_exec("set tidb_opt_seek_cost = 1000000")
+        tk.must_exec("set tidb_opt_seek_base = 1000000")
+        assert "IndexLookUp" not in _plan(tk, q)
+
+    def test_engine_placement_flips_on_dispatch_cost(self, tk):
+        """The agg's host-vs-device placement comes from the same
+        currency: a huge dispatch constant pins host, a tiny one pins
+        the device pipeline (auto engine mode consults the choice)."""
+        tk.must_exec("create table ep (g bigint, v bigint)")
+        tk.must_exec("insert into ep values " + ",".join(
+            f"({i % 7}, {i})" for i in range(4000)))
+        tk.must_exec("analyze table ep")
+        q = "select g, sum(v) from ep group by g"
+        tk.must_exec("set tidb_opt_device_dispatch_cost = 1")
+        v = _vplan(tk, q)
+        agg = next(r for r in v if "HashAgg" in r[0])
+        assert "tpu-agg" in agg[1] and "host-agg" in agg[1]
+        import tidb_tpu.planner.physical  # noqa: F401
+        plan = tk.session.plan_query(
+            __import__("tidb_tpu.parser", fromlist=["parse"]).parse(q)[0])
+        from tidb_tpu.planner.logical import Aggregation
+        node = plan
+        while not isinstance(node, Aggregation):
+            node = node.child
+        assert node.engine_choice == "tpu"
+        tk.must_exec("set tidb_opt_device_dispatch_cost = 1e12")
+        plan = tk.session.plan_query(
+            __import__("tidb_tpu.parser", fromlist=["parse"]).parse(q)[0])
+        node = plan
+        while not isinstance(node, Aggregation):
+            node = node.child
+        assert node.engine_choice == "host"
+
+
+class TestVerboseCosts:
+    def test_every_node_priced_in_one_currency(self, tk):
+        tk.must_exec("create table vc1 (k bigint, v bigint)")
+        tk.must_exec("create table vc2 (k bigint, w bigint)")
+        tk.must_exec("insert into vc1 values (1,1),(2,2),(3,3)")
+        tk.must_exec("insert into vc2 values (1,9),(2,8)")
+        rows = _vplan(tk, (
+            "select vc1.k, sum(w) from vc1, vc2 where vc1.k = vc2.k "
+            "group by vc1.k order by vc1.k"))
+        # every operator row carries a numeric estCost
+        for rid, cost, _info in rows:
+            assert cost != "-", f"{rid} has no cost"
+            float(cost.split()[0])
+        # costs accumulate downward: the root is at least its child
+        costs = [float(c.split()[0]) for _r, c, _i in rows]
+        assert costs[0] >= costs[-1]

@@ -24,6 +24,10 @@ class PerformanceConfig:
     executor_engine: str = "auto"      # auto | host | tpu | tpu-mpp
     mesh_shape: str = "1"
     slow_log_threshold_ms: int = 300
+    #: startup cost-model micro-bench (planner/cost_model.py): measures
+    #: seek/hash-build/sort constants relative to the vectorized scan on
+    #: this machine and installs them as the tidb_opt_* globals
+    calibrate_costs: bool = True
 
 
 @dataclasses.dataclass

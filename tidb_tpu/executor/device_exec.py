@@ -47,6 +47,12 @@ def want_device(ctx, n_rows: int) -> bool:
         floor = int(ctx.get_sysvar("tidb_device_dispatch_rows"))
     except Exception:
         floor = 65536
+    if floor <= 0:
+        # derive the floor from the calibrated cost constants (one
+        # currency for planner placement AND runtime gating — with
+        # uncalibrated defaults this is the historical 65536)
+        from ..planner.cost_model import CostModel
+        floor = CostModel.from_ctx(ctx).device_breakeven_rows()
     return n_rows >= floor
 
 

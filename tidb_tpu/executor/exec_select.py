@@ -347,7 +347,8 @@ class HashAggExec(QueryExecutor):
         p = self.plan
         # fused device pipeline: HashAgg directly over a TableScan compiles
         # scan-filter + grouping + aggregation into one XLA program
-        from .device_exec import want_device, device_agg, DeviceUnsupported
+        from .device_exec import (
+            want_device, device_agg, engine_mode, DeviceUnsupported)
         if getattr(p, "agg_hint", None) == "stream":
             # /*+ STREAM_AGG() */ pins the host streaming/spillable path
             # (reference: stream agg enforced by hint,
@@ -397,7 +398,17 @@ class HashAggExec(QueryExecutor):
                     return out
             except DeviceUnsupported:
                 pass
-        if raw is not None and want_device(self.ctx, raw.num_rows):
+        want = raw is not None and want_device(self.ctx, raw.num_rows)
+        if raw is not None and engine_mode(self.ctx) == "auto":
+            # the cost DP priced host-vs-device placement for this agg
+            # from the calibrated constants; in auto mode its choice
+            # replaces the raw row floor (planner/physical.py _best_cost)
+            ec = getattr(p, "engine_choice", None)
+            if ec == "host":
+                want = False
+            elif ec == "tpu":
+                want = True
+        if want:
             # streamed pipeline when the input exceeds the batch bound:
             # blocks transfer to HBM while the previous block computes
             # (reference: the cop-iterator worker pool overlap)

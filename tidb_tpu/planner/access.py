@@ -28,17 +28,21 @@ from ..model import SchemaState
 from ..statistics.selectivity import _col_const, estimate_selectivity
 from .logical import DataSource
 
-#: cost constants: per-row KV seek+decode vs per-row vectorized scan
+#: cost-constant DEFAULTS (the live values come from the calibrated
+#: sysvars via planner/cost_model.py — see CostModel.from_ctx)
 SEEK_COST = 8.0
 SEEK_BASE = 30.0
 SCAN_ROW_COST = 1.0
 
 
-def choose_access_paths(plan, ctx):
+def choose_access_paths(plan, ctx, cm=None):
+    if cm is None:
+        from .cost_model import CostModel
+        cm = CostModel.from_ctx(ctx)
     if isinstance(plan, DataSource):
-        _choose(plan, ctx)
+        _choose(plan, ctx, cm)
     for c in plan.children:
-        choose_access_paths(c, ctx)
+        choose_access_paths(c, ctx, cm)
     return plan
 
 
@@ -139,7 +143,10 @@ def _idx_allowed(idx, allowed, excluded):
     return (allowed is None or n in allowed) and n not in excluded
 
 
-def _choose(ds: DataSource, ctx):
+def _choose(ds: DataSource, ctx, cm=None):
+    if cm is None:
+        from .cost_model import CostModel
+        cm = CostModel.from_ctx(ctx)
     ds.access = None
     ds.access_est = None
     info = ds.table_info
@@ -178,7 +185,7 @@ def _choose(ds: DataSource, ctx):
                      else None)
             n = max((stats or {}).get("row_count", 0), 1)
             _choose_index_merge(ds, info, name2idx, allowed, excluded,
-                                stats, n)
+                                stats, n, cm)
         return
 
     # 1. PointGet on the integer primary key stored as the row handle
@@ -262,7 +269,7 @@ def _choose(ds: DataSource, ctx):
         else:
             sel = estimate_selectivity(stats, ds.col_infos, consumed)
         est_rows = max(n * sel, 1.0)
-        cost = SEEK_BASE + est_rows * SEEK_COST
+        cost = cm.seek_base + est_rows * cm.seek
         if best is None or cost < best[0]:
             # bounds are already normalized into the column's value domain
             # by _seek_value at classification time
@@ -274,12 +281,13 @@ def _choose(ds: DataSource, ctx):
                 hi = list(prefix)
             best = (cost, ("index_range", idx, lo, hi), est_rows)
     if best is not None:
-        cost_full = n * SCAN_ROW_COST
+        cost_full = n * cm.scan_row
         if forced or best[0] < cost_full:
             ds.access = best[1]
             ds.access_est = int(best[2])
             return
-    _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n)
+    _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n,
+                        cm)
 
 
 def _flatten_or(cond):
@@ -299,7 +307,8 @@ def _flatten_or(cond):
     return out
 
 
-def _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n):
+def _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n,
+                        cm):
     """IndexMerge (reference: executor/index_merge_reader.go,
     planner/core/indexmerge_path.go): an OR of per-column indexable
     predicates — which no single index path can consume — becomes a UNION
@@ -373,12 +382,12 @@ def _choose_index_merge(ds, info, name2idx, allowed, excluded, stats, n):
                 break
             est = max(n * estimate_selectivity(stats, ds.col_infos, [d]), 1.0)
             est_total += est
-            cost += SEEK_BASE + est * SEEK_COST
+            cost += cm.seek_base + est * cm.seek
         if not ok:
             continue
         if best is None or cost < best[0]:
             best = (cost, subpaths, est_total)
-    if best is not None and best[0] < n * SCAN_ROW_COST:
+    if best is not None and best[0] < n * cm.scan_row:
         ds.access = ("index_merge", best[1])
         ds.access_est = int(min(best[2], n))
 

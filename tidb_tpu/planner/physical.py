@@ -30,10 +30,8 @@ from .optimizer import _est_rows
 #: below this many estimated rows on both sides, factorization cost is
 #: noise and hash join keeps the simplest plan
 MERGE_MIN_ROWS = 4096
-#: per-build-row hash table constant (insert + key factorization) and
-#: per-comparison constant of the in-kernel merge sort, in the same
-#: per-row units as access.py's SCAN_ROW_COST/SEEK_COST so access and
-#: join decisions share one cost currency
+#: legacy defaults (the live constants come from the calibrated sysvars
+#: via planner/cost_model.py CostModel)
 HASH_BUILD_COST = 2.0
 MERGE_SORT_COST = 0.05
 #: never index-join when the outer side is estimated bigger than this
@@ -42,11 +40,78 @@ INDEX_JOIN_MAX_KEYS = 65536
 
 
 def choose_join_algos(plan, ctx, hints=None):
-    if isinstance(plan, Join):
-        _choose(plan, ctx, hints)
-    for c in plan.children:
-        choose_join_algos(c, ctx, hints)
+    """The physical search: ONE bottom-up DP over the whole plan
+    (reference: planner/core/find_best_task.go — every operator's
+    alternatives costed given its children's best tasks). Each node's
+    candidates are priced in the calibrated cost currency
+    (planner/cost_model.py) INCLUDING its children's chosen costs, so a
+    variant that skips executing a child (index join never reads the
+    inner scan) wins by exactly that child's cost. Alternatives per node:
+      DataSource   — access path (chosen in access.py, priced here)
+      Join         — hash | merge | index-lookup
+      Aggregation  — engine placement: host kernel vs fused device
+                     pipeline (dispatch amortization from the same
+                     constants that set auto-mode's row floor)
+    Every node gets .cost (+ .cost_candidates where alternatives exist)
+    for EXPLAIN FORMAT='verbose'."""
+    from .cost_model import CostModel
+    cm = CostModel.from_ctx(ctx)
+    _best_cost(plan, ctx, cm, hints)
     return plan
+
+
+def _best_cost(node, ctx, cm, hints) -> float:
+    import math
+    child_cost = sum(_best_cost(c, ctx, cm, hints) for c in node.children)
+    if isinstance(node, DataSource):
+        if node.access is not None:
+            est = max(node.access_est or 1, 1)
+            # index_merge pays one seek_base per subpath — the same
+            # pricing access.py used to choose it
+            n_paths = (len(node.access[1])
+                       if node.access[0] == "index_merge" else 1)
+            cost = n_paths * cm.seek_base + est * cm.seek
+        else:
+            stats = (ctx.table_stats(node.table_info.id)
+                     if ctx is not None and hasattr(ctx, "table_stats")
+                     else None)
+            n = max((stats or {}).get("row_count", 0), _est_rows(node, ctx))
+            cost = n * cm.scan_row
+        node.cost = round(cost, 1)
+        return cost
+    if isinstance(node, Join) and node.left_keys and node.kind in (
+            "inner", "left", "semi", "anti"):
+        cost = _choose(node, ctx, hints, cm, child_cost)
+        node.cost = round(cost, 1)
+        return cost
+    from .logical import Aggregation as _Agg, Sort as _Sort, TopN as _TopN
+    if isinstance(node, _Agg):
+        n_in = max(_est_rows(node.child, ctx), 1)
+        candidates = {
+            "host-agg": child_cost + n_in * cm.agg_row,
+            # the fused pipeline replaces the host agg AND the host scan
+            # work of its child subtree with one device dispatch; the
+            # breakeven is therefore dispatch/(agg_row+scan_row-
+            # device_row) — CostModel.device_breakeven_rows, which with
+            # uncalibrated defaults lands on the historical 65536 floor
+            "tpu-agg": max(child_cost - n_in * cm.scan_row, 0.0)
+            + cm.device_dispatch + n_in * cm.device_row,
+        }
+        choice = min(candidates, key=candidates.get)
+        node.engine_choice = "tpu" if choice == "tpu-agg" else "host"
+        node.cost_candidates = {k: round(v, 1)
+                                for k, v in candidates.items()}
+        node.cost = round(candidates[choice], 1)
+        return candidates[choice]
+    if isinstance(node, (_Sort, _TopN)):
+        n = max(_est_rows(node, ctx), 2)
+        cost = child_cost + cm.merge_sort * n * math.log2(n)
+        node.cost = round(cost, 1)
+        return cost
+    n = max(_est_rows(node, ctx), 0)
+    cost = child_cost + 0.2 * cm.scan_row * n  # per-row eval/copy work
+    node.cost = round(cost, 1)
+    return cost
 
 
 _HINT_ALGO = {"hash_join": "hash", "merge_join": "merge",
@@ -140,17 +205,16 @@ def _inner_index(join):
     return best
 
 
-def _choose(join: Join, ctx, hints=None):
+def _choose(join: Join, ctx, hints, cm, child_cost) -> float:
+    """Pick the join variant; returns the node's total cost (children
+    included — `child_cost` is left_cost + right_cost)."""
+    import math
     join.join_algo = "hash"
     join.index_join = None
-    if not join.left_keys or join.kind not in ("inner", "left", "semi",
-                                               "anti"):
-        return
+    right_cost = getattr(join.right, "cost", 0.0) or 0.0
     hit = _hint_algo(join, hints)
     if hit is not None:
         forced, matched_right, _matched_left = hit
-        if forced == "hash":
-            return
         if forced == "merge":
             # executor constraint: the merge matcher needs one primitive
             # key; an ineligible hint degrades to hash rather than
@@ -159,7 +223,7 @@ def _choose(join: Join, ctx, hints=None):
                     and _primitive(join.left_keys[0].ftype)
                     and _primitive(join.right_keys[0].ftype)):
                 join.join_algo = "merge"
-            return
+            return child_cost
         if forced == "index":
             # INL_JOIN(t) makes t the lookup (inner) side; that side is
             # structurally the right child here, so a hint naming only
@@ -171,43 +235,41 @@ def _choose(join: Join, ctx, hints=None):
                 if desc is not None:
                     join.join_algo = "index"
                     join.index_join = desc
-            return
+            return child_cost
+        return child_cost  # forced hash
     outer_est = _est_rows(join.left, ctx)
     inner_est = _est_rows(join.right, ctx)
 
     # ---- explicit variant enumeration (reference: every eligible
     # physical join is costed and the cheapest wins —
     # exhaust_physical_plans.go:1774 emits the candidates,
-    # find_best_task.go:359 compares task costs). Costs are in the same
-    # per-row units the access-path chooser uses, so seek-vs-scan and
-    # join-variant decisions share one currency.
+    # find_best_task.go:359 compares task costs). Child costs are IN the
+    # candidates: the index join omits the inner child's cost entirely —
+    # it never executes that scan (reference: index-lookup task costing).
     #   hash : build a table over the inner rows, probe with the outer —
     #          both sides pass once, plus a per-build-row table constant
     #   merge: order both sides (the in-kernel sort the merge matcher
     #          runs) — n·log n on each side, cheap constants
     #   index: one KV seek per outer row instead of reading the inner
     #          side at all — wins only under selective outer estimates
-    candidates = {"hash": (outer_est + inner_est) * SCAN_ROW_COST
-                  + inner_est * HASH_BUILD_COST}
+    candidates = {"hash": child_cost
+                  + (outer_est + inner_est) * cm.scan_row
+                  + inner_est * cm.hash_build}
     if (len(join.left_keys) == 1
             and _primitive(join.left_keys[0].ftype)
             and _primitive(join.right_keys[0].ftype)
             and min(outer_est, inner_est) >= MERGE_MIN_ROWS):
-        import math
-        candidates["merge"] = MERGE_SORT_COST * (
+        candidates["merge"] = child_cost + cm.merge_sort * (
             outer_est * math.log2(max(outer_est, 2))
             + inner_est * math.log2(max(inner_est, 2)))
     desc = _inner_index(join)
     if desc is not None and outer_est <= INDEX_JOIN_MAX_KEYS:
-        # the index join still reads the outer side once; seeks replace
-        # the inner-side read entirely. Every variant prices the inner
-        # side from the SAME post-filter estimate — re-costing hash from
-        # raw table rows here would flip plans on index existence rather
-        # than on cost
-        candidates["index"] = (outer_est * SCAN_ROW_COST
-                               + SEEK_BASE + outer_est * SEEK_COST)
+        candidates["index"] = (child_cost - right_cost
+                               + outer_est * cm.scan_row
+                               + cm.seek_base + outer_est * cm.seek)
     join.join_algo = min(candidates, key=candidates.get)
     join.join_cost = round(candidates[join.join_algo], 1)
     join.cost_candidates = {k: round(v, 1) for k, v in candidates.items()}
     if join.join_algo == "index":
         join.index_join = desc
+    return candidates[join.join_algo]

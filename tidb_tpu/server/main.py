@@ -61,6 +61,13 @@ def run_server(cfg, ready_event: threading.Event | None = None):
 
     store = new_store(backend=cfg.store)
     domain = bootstrap_domain(store)
+    # calibrate the cost-model constants on THIS machine (reference: the
+    # tidb_opt_*_factor family is hand-tuned there; here a ~50ms startup
+    # micro-bench measures seek/build/sort relative to the vectorized
+    # scan and installs the ratios as globals — planner/cost_model.py)
+    if cfg.performance.calibrate_costs:
+        from ..planner.cost_model import apply_calibration
+        apply_calibration(domain)
     for name, val in (
             ("tidb_mem_quota_query", str(cfg.performance.mem_quota_query)),
             ("tidb_executor_engine", cfg.performance.executor_engine),

@@ -1638,16 +1638,22 @@ class Session:
                 from ..planner.logical import explain_nodes
                 rows = []
                 for name, info, node in explain_nodes(plan):
-                    cost = getattr(node, "join_cost", None)
+                    # one currency end-to-end: every node carries the
+                    # DP's accumulated cost (planner/physical.py
+                    # _best_cost); candidate sets show the alternatives
+                    # the chooser compared at that node
+                    cost = getattr(node, "cost", None)
+                    if cost is None:
+                        cost = getattr(node, "join_cost", None)
                     cands = getattr(node, "cost_candidates", None)
                     if cost is not None and cands:
                         ctext = (f"{cost:g} "
                                  + "{" + ", ".join(
                                      f"{k}:{v:g}" for k, v in
                                      sorted(cands.items())) + "}")
+                    elif cost is not None:
+                        ctext = f"{cost:g}"
                     else:
-                        # scan est_rows already renders in the info
-                        # column (DataSource.explain_info) — no duplicate
                         ctext = "-"
                     rows.append((name.encode(), ctext.encode(),
                                  info.encode()))

@@ -93,7 +93,10 @@ for _v in [
     SysVar("tidb_mpp_devices", SCOPE_BOTH, "0", "int", 0),
     # engine tuning knobs (VERDICT r3: hardcoded thresholds must be
     # bench-time tunable): the auto-mode device dispatch row floor
-    SysVar("tidb_device_dispatch_rows", SCOPE_BOTH, "65536", "int", 0),
+    # 0 = derive the auto-mode dispatch floor from the calibrated cost
+    # constants (planner/cost_model.py device_breakeven_rows); a positive
+    # value overrides it
+    SysVar("tidb_device_dispatch_rows", SCOPE_BOTH, "0", "int", 0),
     # plan-baseline auto capture (reference: bindinfo/handle.go:749)
     SysVar("tidb_capture_plan_baselines", SCOPE_BOTH, "OFF", "bool"),
     SysVar("tidb_mem_quota_query", SCOPE_BOTH, str(1 << 30), "int", 0),
@@ -231,6 +234,19 @@ for _v in [
     SysVar("tidb_mem_quota_apply_cache", SCOPE_BOTH,
            str(32 << 20), "int", 0),
     SysVar("tidb_opt_agg_push_down", SCOPE_BOTH, "OFF", "bool"),
+    # calibrated cost-model constants (planner/cost_model.py): one
+    # currency for access-path, join-variant and engine-placement choice;
+    # apply_calibration() overwrites the globals with measured values
+    # (reference: the tidb_opt_*_factor family, sessionctx/variable)
+    SysVar("tidb_opt_scan_row_cost", SCOPE_BOTH, "1.0", "float"),
+    SysVar("tidb_opt_seek_cost", SCOPE_BOTH, "8.0", "float"),
+    SysVar("tidb_opt_seek_base", SCOPE_BOTH, "30.0", "float"),
+    SysVar("tidb_opt_hash_build_cost", SCOPE_BOTH, "2.0", "float"),
+    SysVar("tidb_opt_merge_sort_cost", SCOPE_BOTH, "0.05", "float"),
+    SysVar("tidb_opt_agg_row_cost", SCOPE_BOTH, "2.0", "float"),
+    SysVar("tidb_opt_device_row_cost", SCOPE_BOTH, "0.02", "float"),
+    SysVar("tidb_opt_device_dispatch_cost", SCOPE_BOTH, "195000.0",
+           "float"),
     SysVar("tidb_opt_correlation_threshold", SCOPE_BOTH, "0.9", "float"),
     SysVar("tidb_opt_distinct_agg_push_down", SCOPE_BOTH, "OFF", "bool"),
     SysVar("tidb_opt_insubq_to_join_and_agg", SCOPE_BOTH, "ON", "bool"),
