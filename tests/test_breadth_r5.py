@@ -1,0 +1,110 @@
+"""Round-5 breadth: new builtins + sysvars behave, not just register
+(reference: expression/builtin.go:573 registry, sessionctx/variable/
+sysvar.go)."""
+
+import json
+
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    return tk
+
+
+class TestNewBuiltins:
+    def test_translate(self, tk):
+        assert tk.must_query(
+            "select translate('abcab', 'ab', 'xy')").rows == [("xycxy",)]
+        # from-chars beyond the to-string are deleted (Oracle semantics)
+        assert tk.must_query(
+            "select translate('abc', 'abc', 'x')").rows == [("x",)]
+        assert tk.must_query(
+            "select translate(null, 'a', 'b')").rows == [(None,)]
+
+    def test_translate_first_occurrence_wins(self, tk):
+        """Duplicate chars in `from`: the FIRST mapping applies
+        (regression: int/str key mismatch made the last win)."""
+        assert tk.must_query(
+            "select translate('a', 'aa', 'xy')").rows == [("x",)]
+
+    def test_temporal_binary_arithmetic(self, tk):
+        assert tk.must_query(
+            "select date('2024-01-10') - interval 3 day").rows == \
+            [("2024-01-07",)]
+        assert tk.must_query(
+            "select interval 1 day + date('2024-01-10')").rows == \
+            [("2024-01-11",)]
+
+    def test_character_length_alias(self, tk):
+        assert tk.must_query(
+            "select character_length('héllo')").rows == [("5",)]
+
+    def test_istrue_with_null(self, tk):
+        assert tk.must_query(
+            "select istrue_with_null(null), istrue_with_null(2), "
+            "istrue_with_null(0)").rows == [(None, "1", "0")]
+
+    def test_session_user_schema_aliases(self, tk):
+        u, s = tk.must_query("select session_user(), schema()").rows[0]
+        assert "@" in u and s == "test"
+
+    def test_decode_sql_digests_roundtrip(self, tk):
+        tk.must_query("select 42")
+        dg = tk.must_query(
+            "select tidb_encode_sql_digest('select 42')").rows[0][0]
+        out = tk.must_query(
+            f"select tidb_decode_sql_digests('[\"{dg}\", \"missing\"]')"
+        ).rows[0][0]
+        decoded = json.loads(out)
+        assert decoded[0] is not None and "42" in decoded[0]
+        assert decoded[1] is None
+
+    def test_bounded_staleness_clamps(self, tk):
+        v = tk.must_query("select tidb_bounded_staleness("
+                          "'2020-01-01', '2020-01-02')").rows[0][0]
+        assert v.startswith("2020-01-02")  # now() clamps to the upper bound
+
+    def test_registry_count(self, tk):
+        from tidb_tpu.expression.builtins_ext import _DISPATCH
+        assert len(_DISPATCH) >= 256
+
+
+class TestNewSysvars:
+    def test_registry_count(self, tk):
+        from tidb_tpu.session import sysvars
+        assert len(sysvars.get_registry()) >= 248  # reference has 248
+
+    def test_last_txn_info_records_commit(self, tk):
+        tk.must_exec("create table lti (a bigint)")
+        tk.must_exec("insert into lti values (1)")
+        info = json.loads(
+            tk.must_query("select @@tidb_last_txn_info").rows[0][0])
+        assert info["commit_ts"] > info["start_ts"] > 0
+
+    def test_use_plan_baselines_gates_binding_match(self, tk):
+        tk.must_exec("create table pbl (a bigint, b bigint, index ia (a))")
+        tk.must_exec("create session binding for select * from pbl "
+                     "where a = 1 using select * from pbl use index (ia) "
+                     "where a = 1")
+        tk.must_query("select * from pbl where a = 1")
+        assert tk.session.binding_used is not None
+        tk.must_exec("set tidb_use_plan_baselines = OFF")
+        tk.must_query("select * from pbl where a = 1")
+        assert tk.session.binding_used is None
+        tk.must_exec("set tidb_use_plan_baselines = ON")
+
+    def test_bare_word_enum_set(self, tk):
+        tk.must_exec("set tidb_partition_prune_mode = dynamic")
+        assert tk.must_query(
+            "select @@tidb_partition_prune_mode").rows == [("dynamic",)]
+        tk.must_exec("set tidb_partition_prune_mode = static")
+
+    def test_enum_validation_rejects_garbage(self, tk):
+        from tidb_tpu.errors import TiDBError
+        with pytest.raises(TiDBError):
+            tk.must_exec("set tidb_read_consistency = 'bogus'")

@@ -1,0 +1,86 @@
+"""KILL + max_execution_time watchdog (reference: util/expensivequery/
+expensivequery.go:34,69 and the KILL dispatch in server/conn.go):
+executors poll a per-session kill flag at their entry checkpoints; the
+watchdog timer flips it past the deadline."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import ErrCode, TiDBError
+from tidb_tpu.session import new_session
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table big (a bigint, b bigint)")
+    rng = np.random.default_rng(1)
+    for lo in range(0, 60_000, 5000):
+        tk.must_exec("insert into big values " + ",".join(
+            f"({int(rng.integers(0, 1000))}, {i})"
+            for i in range(lo, lo + 5000)))
+    return tk
+
+
+HEAVY = "select count(*) from big t1, big t2 where t1.a = t2.a"
+
+
+class TestWatchdog:
+    def test_max_execution_time_interrupts(self, tk):
+        tk.must_exec("set max_execution_time = 20")
+        with pytest.raises(TiDBError) as ei:
+            tk.must_query(HEAVY)
+        assert ei.value.code == ErrCode.QueryInterrupted
+        tk.must_exec("set max_execution_time = 0")
+
+    def test_zero_means_no_limit(self, tk):
+        tk.must_exec("set max_execution_time = 0")
+        rows = tk.must_query("select count(*) from big").rows
+        assert rows == [("60000",)]
+
+    def test_deadline_clears_per_statement(self, tk):
+        """A kill from a previous statement's expired timer must not leak
+        into the next statement."""
+        tk.must_exec("set max_execution_time = 20")
+        try:
+            tk.must_query(HEAVY)
+        except TiDBError:
+            pass
+        tk.must_exec("set max_execution_time = 0")
+        assert tk.must_query("select 1").rows == [("1",)]
+
+
+class TestKill:
+    def test_kill_query_interrupts_running_statement(self, tk):
+        s2 = new_session(tk.domain)
+        out = []
+
+        def victim():
+            try:
+                for _ in range(500):  # until a kill lands mid-statement
+                    tk.must_query(HEAVY)
+                out.append("completed")
+            except TiDBError as e:
+                out.append(e.code)
+
+        th = threading.Thread(target=victim)
+        th.start()
+        deadline = time.time() + 20
+        while th.is_alive() and time.time() < deadline:
+            for _ in s2.execute(f"kill query {tk.session.conn_id}"):
+                pass
+            time.sleep(0.01)
+        th.join(5)
+        assert out == [ErrCode.QueryInterrupted]
+        # the session remains usable
+        assert tk.must_query("select 1").rows == [("1",)]
+
+    def test_kill_unknown_thread(self, tk):
+        with pytest.raises(TiDBError) as ei:
+            tk.must_exec("kill query 99999999")
+        assert ei.value.code == ErrCode.NoSuchThread

@@ -55,6 +55,8 @@ class ErrCode:
     MultiplePriKey = 1068
     TooManyKeys = 1069
     UnsupportedDDL = 8214
+    CantExecuteInReadOnlyTxn = 1792
+    AsOfInTxn = 8135
     InfoSchemaExpired = 8027
     InfoSchemaChanged = 8028
     WriteConflict = 9007
@@ -64,6 +66,7 @@ class ErrCode:
     GCTooEarly = 9006
     UnsupportedType = 8003
     QueryInterrupted = 1317
+    NoSuchThread = 1094
     MemExceedThreshold = 8001
     OOMKill = 8175
     # partitioned tables (MySQL partition error numbers)

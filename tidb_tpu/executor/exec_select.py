@@ -49,6 +49,14 @@ class QueryExecutor:
         stmtctx.MemTracker)."""
         return getattr(self.ctx, "mem_tracker", None)
 
+    def check_killed(self):
+        """Cooperative interruption point (KILL / max_execution_time
+        watchdog, reference: the Next()-loop killed check in
+        executor/executor.go). Raises QueryInterrupted when flagged."""
+        f = getattr(self.ctx, "check_killed", None)
+        if f is not None:
+            f()
+
     def annotate(self, **kv):
         """Record engine/extra info for EXPLAIN ANALYZE (no-op otherwise)."""
         if self.stats is not None:
@@ -190,6 +198,7 @@ class TableScanExec(QueryExecutor):
 
     def execute_raw(self):
         """-> (unfiltered chunk, pushed conds) for fused device pipelines."""
+        self.check_killed()
         p = self.plan
         txn = self.ctx.txn_for_read()
         if p.access is not None:
@@ -344,6 +353,7 @@ class HashAggExec(QueryExecutor):
     agg; here single kernel call — parallelism comes from the device)."""
 
     def execute(self):
+        self.check_killed()
         p = self.plan
         # fused device pipeline: HashAgg directly over a TableScan compiles
         # scan-filter + grouping + aggregation into one XLA program
@@ -725,6 +735,7 @@ class HashJoinExec(QueryExecutor):
     SPILL_PARTS = 16
 
     def _join(self, left, right):
+        self.check_killed()
         p = self.plan
         if not p.left_keys:
             tracker = self.tracker()

@@ -232,8 +232,10 @@ _STR_FUNCS = {"concat", "concat_ws", "upper", "lower", "substring", "trim",
               "curtime", "utc_date", "utc_time", "tidb_version",
               "tidb_parse_tso", "tidb_decode_key", "format_nano_time",
               "master_pos_wait", "date_arith_fn", "substr", "sha",
-              "gtid_subtract", "tidb_encode_sql_digest"}
-_INT_FUNCS = {"length", "char_length", "locate", "year", "month", "day",
+              "gtid_subtract", "tidb_encode_sql_digest", "translate",
+              "tidb_bounded_staleness", "tidb_decode_plan"}
+_INT_FUNCS = {"length", "char_length", "character_length", "locate",
+              "istrue_with_null", "year", "month", "day",
               "dayofmonth", "hour", "minute", "second", "quarter", "week",
               "dayofweek", "dayofyear", "extract", "datediff", "sign",
               "ascii", "instr", "isnull", "istrue", "isfalse", "found_rows",
@@ -415,6 +417,16 @@ class ExprBuilder:
             if node.op in ("&", "|", "^", "<<", ">>"):
                 return self._bitop(node)
             raise TiDBError(f"unsupported operator {node.op}")
+        if (node.op in ("+", "-")
+                and isinstance(node.right, ast.IntervalExpr)):
+            # expr ± INTERVAL n UNIT ≡ DATE_ADD/DATE_SUB (MySQL temporal
+            # arithmetic; reference: ast.DateArith)
+            return self._b_FuncCall(ast.FuncCall(
+                name="date_add" if node.op == "+" else "date_sub",
+                args=[node.left, node.right]))
+        if node.op == "+" and isinstance(node.left, ast.IntervalExpr):
+            return self._b_FuncCall(ast.FuncCall(
+                name="date_add", args=[node.right, node.left]))
         l = self.build(node.left)
         r = self.build(node.right)
         if op in ("eq", "ne", "lt", "le", "gt", "ge", "nulleq"):
@@ -687,14 +699,32 @@ class ExprBuilder:
             if name in ("curdate", "current_date"):
                 return Constant(date_to_days(now.year, now.month, now.day),
                                 FieldType(tp=TYPE_DATE))
-            return Constant(datetime_to_micros(now), FieldType(tp=TYPE_DATETIME))
-        if name == "database":
+            fsp = 0
+            if node.args and isinstance(node.args[0], ast.Literal):
+                try:
+                    fsp = max(0, min(int(node.args[0].val), 6))
+                except (TypeError, ValueError):
+                    fsp = 0
+            micros = datetime_to_micros(now)
+            micros -= micros % (10 ** (6 - fsp))  # MySQL truncates to fsp
+            return Constant(micros,
+                            FieldType(tp=TYPE_DATETIME, decimal=fsp))
+        if name in ("database", "schema"):
             db = self.ctx.current_db() if self.ctx is not None else ""
             return (Constant(db.encode(), FieldType(tp=TYPE_VARCHAR))
                     if db else const_null())
+        if name == "tidb_decode_sql_digests":
+            # runtime eval needs the domain's statements summary; attach
+            # it as extra (builtins_ext._eval_decode_sql_digests)
+            args = [self.build(a) for a in node.args]
+            sf = ScalarFunc(name, args, FieldType(tp=TYPE_VARCHAR))
+            sess = getattr(self.ctx, "session", None)
+            obs = getattr(getattr(sess, "domain", None), "observe", None)
+            sf.extra = getattr(obs, "stmt_summary", None)
+            return sf
         if name == "version":
             return Constant(b"8.0.11-tpu-htap", FieldType(tp=TYPE_VARCHAR))
-        if name == "user" or name == "current_user":
+        if name in ("user", "current_user", "session_user", "system_user"):
             u = self.ctx.current_user() if self.ctx is not None else "root@%"
             return Constant(u.encode(), FieldType(tp=TYPE_VARCHAR))
         if name == "unix_timestamp" and not node.args:

@@ -1418,6 +1418,48 @@ def _tidb_decode_key(hexkey):
     return hexkey
 
 
+def _translate(s, frm, to):
+    """Per-character mapping; characters in `frm` beyond len(to) are
+    DELETED (Oracle semantics the reference implements)."""
+    src = _u(s)
+    f = _u(frm)
+    t = _u(to)
+    table = {}
+    for i, ch in enumerate(f):
+        if ord(ch) not in table:  # first occurrence in `from` wins
+            table[ord(ch)] = t[i] if i < len(t) else None
+    return src.translate(table).encode()
+
+
+def _eval_decode_sql_digests(sf, chunk):
+    """JSON array of digests → JSON array of normalized sample SQL (null
+    for unknown digests), resolved via the statements summary the builder
+    attached as extra (reference: builtin_info.go tidbDecodeSQLDigests)."""
+    import json as _json
+    d, nl = sf.args[0].eval(chunk)
+    n = len(d)
+    out = np.empty(n, dtype=object)
+    out[:] = b""
+    nulls = np.array(nl, dtype=bool, copy=True)
+    summary = getattr(sf, "extra", None)  # digest -> StmtSummary
+    for i in range(n):
+        if nulls[i]:
+            continue
+        try:
+            digests = _json.loads(_u(d[i]))
+            if not isinstance(digests, list):
+                raise ValueError
+        except Exception:
+            nulls[i] = True
+            continue
+        res = []
+        for dg in digests:
+            st = summary.get(str(dg)) if summary is not None else None
+            res.append(st.sample_sql if st is not None else None)
+        out[i] = _json.dumps(res).encode()
+    return out, nulls
+
+
 _TIDB_FUNCS = {
     # reference-dialect admin builtins (expression/builtin_info.go)
     "tidb_version": _pyfn("", lambda: b"8.0.11-tpu-htap"),
@@ -1460,6 +1502,23 @@ _TIDB_FUNCS = {
         "%Y-%m-%d %H:%M:%S").encode()),
     "current_time": _pyfn("", lambda: _dt.datetime.now().strftime(
         "%H:%M:%S").encode()),
+    # TRANSLATE(str, from, to) — per-character mapping (reference:
+    # builtin_string.go translate, Oracle-compat mode)
+    "translate": _pyfn("sss", _translate),
+    # bounded-staleness resolver (reference: builtin_time.go
+    # tidb_bounded_staleness): the freshest safe ts within [lo, hi] — a
+    # single-node store is always resolved, so clamp now() into the range
+    "tidb_bounded_staleness": _pyfn("dd", lambda lo, hi: max(
+        lo, min(hi, _dt.datetime.now())).strftime(
+        "%Y-%m-%d %H:%M:%S.%f").encode()),
+    # plan/digest decoders (reference: builtin_info.go tidbDecodePlan /
+    # tidbDecodeSQLDigests) — plans are stored plain here, so decode is
+    # identity; digests resolve through the statements summary
+    "tidb_decode_plan": _pyfn("s", lambda p: p),
+    "tidb_decode_sql_digests": _eval_decode_sql_digests,
+    # IS TRUE with NULL propagation (reference: builtin_op.go
+    # isTrueWithNull — unlike IS TRUE, NULL stays NULL)
+    "istrue_with_null": _pyfn("f", lambda v: 1 if v != 0 else 0, out="i"),
 }
 
 #: pure aliases — separate registry entries in the reference too
@@ -1469,6 +1528,7 @@ _ALIASES = {
     "mid": "substring", "substr": "substring", "sha": "sha1",
     "json_merge": "json_merge_preserve", "day": "dayofmonth",
     "json_append": "json_array_append", "curtime": "current_time",
+    "character_length": "char_length",
 }
 
 

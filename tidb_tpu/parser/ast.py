@@ -314,9 +314,12 @@ class TableName(Node):
     as_name: str = ""
     index_hints: list = field(default_factory=list)
     partition_names: list = field(default_factory=list)
+    as_of: object = None  # AS OF TIMESTAMP expr (stale read)
 
     def restore(self):
         s = (f"`{self.schema}`." if self.schema else "") + f"`{self.name}`"
+        if self.as_of is not None:
+            s += f" AS OF TIMESTAMP {self.as_of.restore()}"
         if self.partition_names:
             s += " PARTITION (" + ", ".join(
                 f"`{p}`" for p in self.partition_names) + ")"
@@ -969,9 +972,16 @@ class ExplainStmt(StmtNode):
 @dataclass(repr=False)
 class BeginStmt(StmtNode):
     pessimistic: bool = None  # None = session default
+    read_only: bool = False
+    as_of: object = None  # AS OF TIMESTAMP expr (stale-read txn)
 
     def restore(self):
-        return "START TRANSACTION"
+        s = "START TRANSACTION"
+        if self.read_only:
+            s += " READ ONLY"
+        if self.as_of is not None:
+            s += f" AS OF TIMESTAMP {self.as_of.restore()}"
+        return s
 
 
 @dataclass(repr=False)

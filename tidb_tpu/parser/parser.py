@@ -282,7 +282,21 @@ class Parser:
         if kw == "start":
             self.pos += 1
             self._expect_kw("transaction")
-            return ast.BeginStmt()
+            read_only = False
+            as_of = None
+            if self._accept_kw("read"):
+                if not self._accept_kw("only"):
+                    self._expect_kw("write")
+                else:
+                    read_only = True
+            if read_only and self._accept_kw("as"):
+                # START TRANSACTION READ ONLY AS OF TIMESTAMP expr
+                # (reference: sessiontxn/interface.go:48 stale-read
+                # providers; parser ast.StartTSBound)
+                self._expect_kw("of")
+                self._expect_kw("timestamp")
+                as_of = self._parse_expr(0)
+            return ast.BeginStmt(read_only=read_only, as_of=as_of)
         if kw == "commit":
             self.pos += 1
             return ast.CommitStmt()
@@ -648,6 +662,16 @@ class Parser:
                 tn.partition_names.append(self._ident())
             self._expect_op(")")
         if allow_alias:
+            # t AS OF TIMESTAMP expr (stale read, reference:
+            # sessiontxn/interface.go:48) — disambiguated from `AS alias`
+            # by the OF keyword
+            if self._peek_kws("as", "of"):
+                self.pos += 2
+                self._expect_kw("timestamp")
+                # full expression: NOW() - INTERVAL n SECOND is the
+                # idiomatic stale-read bound; a following alias identifier
+                # is not an operator, so bp 0 cannot swallow it
+                tn.as_of = self._parse_expr(0)
             if self._accept_kw("as"):
                 tn.as_name = self._ident()
             else:

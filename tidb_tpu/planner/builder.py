@@ -508,6 +508,14 @@ class PlanBuilder:
         return MemSource("", node.name, Schema(refs), lambda: result)
 
     def _build_table(self, tn: ast.TableName):
+        if tn.as_of is not None:
+            # stale read: pin the statement's read view at that instant
+            # (reference: sessiontxn/interface.go:48 staleness providers)
+            sess = getattr(self.ctx, "session", None)
+            if sess is None or not hasattr(sess, "set_stmt_as_of"):
+                raise TiDBError(
+                    "AS OF TIMESTAMP is not available in this context")
+            sess.set_stmt_as_of(tn.as_of)
         # an in-flight recursive CTE iteration binds its name to the
         # previous iteration's rows (reference: cteutil working table)
         bindings = getattr(self.ctx, "cte_bindings", None)

@@ -73,6 +73,10 @@ def is_cacheable(stmt) -> bool:
             return False
         if isinstance(n, ast.SelectStmt) and n.for_update:
             return False
+        if isinstance(n, ast.TableName) and n.as_of is not None:
+            # stale reads pin a session ts at PLAN time (set_stmt_as_of);
+            # a cache hit would skip that and silently read live data
+            return False
         if isinstance(n, ast.FuncCall) and n.name in UNCACHEABLE_FUNCS:
             return False
         if isinstance(n, ast.Limit):

@@ -282,6 +282,8 @@ class MySQLServer:
                     getattr(e, "code", 1105) or 1105, str(e)))
             except Exception as e:  # never kill the conn loop on a bug
                 io.write_packet(P.build_err(1105, f"internal: {e}"))
+            if getattr(session, "kill_conn", False):
+                return  # KILL CONNECTION: drop the wire connection
 
     def _run_query(self, io, session, sql: str):
         results = session.execute(sql)

@@ -281,6 +281,11 @@ class Session:
         self.user_vars: dict[str, object] = {}
         self.txn = None            # explicit or statement txn
         self.explicit_txn = False
+        self._stmt_as_of_ts = None  # statement-level AS OF TIMESTAMP
+        self._txn_as_of_ts = None   # stale READ ONLY txn's historical ts
+        self.killed = False  # KILL / max_execution_time watchdog flag
+        self.kill_conn = False  # KILL CONNECTION: refuse further stmts
+        self.txn_read_only = False  # START TRANSACTION READ ONLY
         self.txn_stmt_history = []  # DML asts for optimistic-commit retry
         self._in_txn_retry = False
         self.session_bindings: dict[str, dict] = {}  # SESSION plan bindings
@@ -490,17 +495,85 @@ class Session:
     # -- txn management (reference: session/txn.go LazyTxn) ------------------
 
     def txn_for_read(self):
+        ts = self.stale_read_ts()
+        if ts is not None:
+            # stale read (reference: sessiontxn/interface.go:48 stale-read
+            # providers): a historical snapshot, never the live txn
+            return self.store.get_snapshot(ts)
         if self.txn is not None and self.txn.valid:
             return self.txn
         # read-only statement txn: snapshot view, nothing to commit
         return self.store.begin()
 
     def txn_for_write(self):
+        if self.stale_read_ts() is not None or self.txn_read_only:
+            raise TiDBError(
+                "can not execute write statement in a read-only "
+                "transaction or stale read ('tidb_snapshot'/AS OF)",
+                code=ErrCode.CantExecuteInReadOnlyTxn)
         if self.txn is None or not self.txn.valid:
             self.txn = self.store.begin()
             if not self.explicit_txn and not self.autocommit():
                 self.explicit_txn = True
         return self.txn
+
+    def stale_read_ts(self):
+        """The active historical read ts, or None. Priority (reference:
+        sessiontxn staleness providers): statement-level AS OF TIMESTAMP >
+        stale READ ONLY txn > tidb_snapshot sysvar > tidb_read_staleness."""
+        if self._stmt_as_of_ts is not None:
+            return self._stmt_as_of_ts
+        if self._txn_as_of_ts is not None:
+            return self._txn_as_of_ts
+        try:
+            snap = self.get_sysvar("tidb_snapshot")
+        except Exception:
+            snap = ""
+        if snap:
+            return self._datetime_to_ts(snap)
+        try:
+            stale_s = int(self.get_sysvar("tidb_read_staleness"))
+        except Exception:
+            stale_s = 0
+        if stale_s < 0:
+            import time as _time
+            return (int((_time.time() + stale_s) * 1000) << 18) | 0x3ffff
+        return None
+
+    def set_stmt_as_of(self, expr_ast):
+        """Statement-scoped AS OF TIMESTAMP from a table factor (cleared
+        by run_query's finally). Mixing with an explicit txn is an error,
+        like the reference."""
+        if (self.txn is not None and self.txn.valid) or self.explicit_txn:
+            raise TiDBError("as of timestamp can't be set in transaction",
+                            code=ErrCode.AsOfInTxn)
+        ts = self._eval_as_of_ts(expr_ast)
+        if self._stmt_as_of_ts is not None and self._stmt_as_of_ts != ts:
+            raise TiDBError(
+                "can not set different time in the as of",
+                code=ErrCode.AsOfInTxn)
+        self._stmt_as_of_ts = ts
+
+    def _eval_as_of_ts(self, expr_ast) -> int:
+        from ..expression.builder import ExprBuilder, Schema
+        b = ExprBuilder(Schema([]), self._expr_ctx)
+        v = b.build(expr_ast).eval_scalar()
+        if v is None:
+            raise TiDBError("invalid AS OF TIMESTAMP value")
+        if isinstance(v, (bytes, bytearray)):
+            v = v.decode()
+        return self._datetime_to_ts(v)
+
+    def _datetime_to_ts(self, v) -> int:
+        """Datetime (string or internal micros) → TSO upper bound for that
+        wall instant (PD layout: unix-ms << 18 | logical)."""
+        from ..sqltypes import TYPE_DATETIME, FieldType
+        from ..table import cast_value
+        if isinstance(v, str):
+            v = cast_value(v, FieldType(tp=TYPE_DATETIME, decimal=6))
+        micros = int(v)
+        ms = micros // 1000
+        return (ms << 18) | 0x3ffff
 
     def txn_dirty(self, table_id) -> bool:
         """True if the current txn holds uncommitted writes for this table
@@ -567,9 +640,14 @@ class Session:
                             "execution of the statement (for example, "
                             "table definition may be updated by other DDL "
                             "ran in parallel). Try again later")
-                txn.commit()
+                commit_ts = txn.commit()
         else:
-            txn.commit()
+            commit_ts = txn.commit()
+        import json as _json
+        # readonly observability var (reference: tidb_last_txn_info)
+        self.session_vars["tidb_last_txn_info"] = _json.dumps(
+            {"txn_scope": "global", "start_ts": txn.start_ts,
+             "commit_ts": commit_ts})
         # commit succeeded: maintain the columnar cache incrementally
         # (reference analog: TiFlash applies raft log deltas, not rebuilds)
         infos = self.infoschema()
@@ -688,12 +766,15 @@ class Session:
     def begin(self):
         if self.txn is not None and self.txn.valid:
             self._commit_txn()
+        self._txn_as_of_ts = None
         self.txn = self.store.begin()
         self.explicit_txn = True
         self.txn_stmt_history = []
 
     def commit(self):
         self.explicit_txn = False
+        self._txn_as_of_ts = None
+        self.txn_read_only = False
         history, self.txn_stmt_history = self.txn_stmt_history, []
         if self.txn is not None and self.txn.valid:
             from ..errors import SchemaChangedError
@@ -757,6 +838,8 @@ class Session:
 
     def rollback(self):
         self.explicit_txn = False
+        self._txn_as_of_ts = None
+        self.txn_read_only = False
         self.txn_stmt_history = []
         if self.txn is not None and self.txn.valid:
             self.txn.rollback()
@@ -894,6 +977,13 @@ class Session:
 
     def _execute_stmt(self, stmt) -> Result:
         self.warnings = []
+        self.killed = False  # a KILL targets the CURRENT statement only
+        if self.kill_conn:
+            raise TiDBError("connection was killed",
+                            code=ErrCode.QueryInterrupted)
+        # a previous statement that only PLANNED (EXPLAIN, CTAS) may have
+        # pinned a stale-read ts without a run_query finally to clear it
+        self._stmt_as_of_ts = None
         t0 = time.perf_counter()
         try:
             sql = stmt.restore()
@@ -1012,6 +1102,17 @@ class Session:
         if isinstance(stmt, ast.SetStmt):
             return self._exec_set(stmt)
         if isinstance(stmt, ast.BeginStmt):
+            self.txn_read_only = stmt.read_only
+            if stmt.as_of is not None:
+                # stale READ ONLY txn: a pinned historical read view,
+                # no write txn at all (reference: sessiontxn staleness
+                # provider for START TRANSACTION READ ONLY AS OF)
+                if self.txn is not None and self.txn.valid:
+                    self._commit_txn()
+                self._txn_as_of_ts = self._eval_as_of_ts(stmt.as_of)
+                self.explicit_txn = True
+                self.txn_stmt_history = []
+                return Result()
             self.begin()
             return Result()
         if isinstance(stmt, ast.CommitStmt):
@@ -1116,6 +1217,11 @@ class Session:
         if isinstance(stmt, ast.FlushStmt):
             return Result()
         if isinstance(stmt, ast.KillStmt):
+            target = self.domain.sessions.get(stmt.conn_id)
+            if target is None:
+                raise TiDBError(f"Unknown thread id: {stmt.conn_id}",
+                                code=ErrCode.NoSuchThread)
+            target.kill(query_only=stmt.query_only)
             return Result()
         if isinstance(stmt, ast.BRIEStmt):
             self._implicit_commit()
@@ -1409,6 +1515,12 @@ class Session:
                                 normalized_sql)
         self.binding_used = None
         try:
+            if self.get_sysvar("tidb_use_plan_baselines").upper() not in (
+                    "ON", "1"):
+                return None  # baselines disabled for this session
+        except Exception:
+            pass
+        try:
             key = binding_key(self.current_db(), normalized_sql(stmt))
         except Exception:
             return None
@@ -1432,25 +1544,46 @@ class Session:
 
     def run_query(self, stmt, outer=None) -> Result:
         from ..executor import build_executor
-        plan = cache_key = None
-        if (outer is None and self._expr_ctx.params is not None
-                and isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt))):
-            plan, cache_key = self._cached_plan(stmt)
-        if plan is None:
-            plan = self.plan_query(stmt, outer=outer)
-            if cache_key is not None:
-                from ..planner.plan_cache import collect_param_consts
-                try:
-                    cap = int(self.get_sysvar(
-                        "tidb_prepared_plan_cache_size"))
-                except Exception:
-                    cap = 0
-                self.plan_cache.put(cache_key, plan,
-                                    collect_param_consts(plan), cap)
-        exe = build_executor(plan, self._exec_ctx())
-        chunk = exe.execute()
-        names = _schema_names(plan)
-        return Result(names=names, chunk=chunk)
+        # expensive-query watchdog (reference: util/expensivequery/
+        # expensivequery.go:34,69 + MySQL max_execution_time semantics —
+        # read-only statements only): past the deadline the kill flag
+        # flips and the next executor checkpoint raises 1317
+        timer = None
+        if outer is None:
+            try:
+                timeout_ms = int(self.get_sysvar("max_execution_time"))
+            except Exception:
+                timeout_ms = 0
+            if timeout_ms > 0:
+                import threading as _threading
+                timer = _threading.Timer(timeout_ms / 1000.0, self.kill)
+                timer.daemon = True
+                timer.start()
+        try:
+            plan = cache_key = None
+            if (outer is None and self._expr_ctx.params is not None
+                    and isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt))):
+                plan, cache_key = self._cached_plan(stmt)
+            if plan is None:
+                plan = self.plan_query(stmt, outer=outer)
+                if cache_key is not None:
+                    from ..planner.plan_cache import collect_param_consts
+                    try:
+                        cap = int(self.get_sysvar(
+                            "tidb_prepared_plan_cache_size"))
+                    except Exception:
+                        cap = 0
+                    self.plan_cache.put(cache_key, plan,
+                                        collect_param_consts(plan), cap)
+            exe = build_executor(plan, self._exec_ctx())
+            chunk = exe.execute()
+            names = _schema_names(plan)
+            return Result(names=names, chunk=chunk)
+        finally:
+            if timer is not None:
+                timer.cancel()
+            # a table factor's AS OF TIMESTAMP scopes to its statement
+            self._stmt_as_of_ts = None
 
     def _cached_plan(self, stmt):
         """Prepared-plan cache lookup (reference: planner/core/
@@ -1507,6 +1640,24 @@ class Session:
     def _exec_ctx(self):
         return self
 
+    # -- kill / watchdog (reference: util/expensivequery + the KILL
+    #    dispatch in server/conn.go) ----------------------------------------
+
+    def kill(self, query_only: bool = True):
+        """Interrupt the in-flight statement; executors poll check_killed
+        at their entry points and long loops. KILL CONNECTION also marks
+        the session dead — further statements are refused and the wire
+        server drops the connection."""
+        self.killed = True
+        if not query_only:
+            self.kill_conn = True
+
+    def check_killed(self):
+        if self.killed:
+            from ..errors import QueryInterruptedError
+            raise QueryInterruptedError(
+                "Query execution was interrupted")
+
     # -- misc statements -----------------------------------------------------
 
     def _exec_set(self, stmt: ast.SetStmt) -> Result:
@@ -1521,7 +1672,12 @@ class Session:
             if isinstance(node, ast.DefaultExpr):
                 self.set_sysvar(name, None, scope)
                 continue
-            v = b.build(node).eval_scalar()
+            if isinstance(node, ast.ColumnName) and not node.table:
+                # SET var = bare_word — MySQL treats the identifier as a
+                # string value (SET tidb_partition_prune_mode = dynamic)
+                v = node.name
+            else:
+                v = b.build(node).eval_scalar()
             if isinstance(v, bytes):
                 v = v.decode()
             self.set_sysvar(name, v, scope)

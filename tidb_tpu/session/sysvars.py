@@ -248,13 +248,170 @@ for _v in [
     SysVar("tidb_opt_device_dispatch_cost", SCOPE_BOTH, "195000.0",
            "float"),
     SysVar("tidb_opt_correlation_threshold", SCOPE_BOTH, "0.9", "float"),
+    # reference cost-factor family (sessionctx/variable/sysvar.go) — kept
+    # alongside the calibrated tidb_opt_*_cost constants for SQL compat
+    SysVar("tidb_opt_cpu_factor", SCOPE_BOTH, "3.0", "float"),
+    SysVar("tidb_opt_copcpu_factor", SCOPE_BOTH, "3.0", "float"),
+    SysVar("tidb_opt_scan_factor", SCOPE_BOTH, "1.5", "float"),
+    SysVar("tidb_opt_desc_factor", SCOPE_BOTH, "3.0", "float"),
+    SysVar("tidb_opt_seek_factor", SCOPE_BOTH, "20.0", "float"),
+    SysVar("tidb_opt_memory_factor", SCOPE_BOTH, "0.001", "float"),
+    SysVar("tidb_opt_disk_factor", SCOPE_BOTH, "1.5", "float"),
+    SysVar("tidb_opt_network_factor", SCOPE_BOTH, "1.0", "float"),
+    SysVar("tidb_opt_concurrency_factor", SCOPE_BOTH, "3.0", "float"),
+    SysVar("tidb_opt_tiflash_concurrency_factor", SCOPE_BOTH, "24.0",
+           "float"),
+    SysVar("tidb_opt_correlation_exp_factor", SCOPE_BOTH, "1", "int", 0),
+    SysVar("tidb_opt_enable_correlation_adjustment", SCOPE_BOTH, "ON",
+           "bool"),
+    SysVar("tidb_opt_limit_push_down_threshold", SCOPE_BOTH, "100", "int",
+           0),
+    SysVar("tidb_opt_prefer_range_scan", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_opt_broadcast_join", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_opt_broadcast_cartesian_join", SCOPE_BOTH, "1", "int", 0,
+           2),
+    SysVar("tidb_opt_mpp_outer_join_fixed_build_side", SCOPE_BOTH, "OFF",
+           "bool"),
+    SysVar("tidb_optimizer_selectivity_level", SCOPE_SESSION, "0", "int",
+           0),
+    SysVar("tidb_regard_null_as_point", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_opt_distinct_agg_push_down", SCOPE_BOTH, "OFF", "bool"),
     SysVar("tidb_opt_insubq_to_join_and_agg", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_opt_join_reorder_threshold", SCOPE_BOTH, "0", "int", 0, 63),
     SysVar("tidb_opt_write_row_id", SCOPE_SESSION, "OFF", "bool"),
     SysVar("tidb_projection_concurrency", SCOPE_BOTH, "-1", "int", -1),
+    # breadth batch (reference sessionctx/variable/sysvar.go, matching
+    # scopes/defaults; consumed where the engine has the corresponding
+    # subsystem, SELECT/SET-compatible knobs otherwise)
+    SysVar("allow_auto_random_explicit_insert", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("ddl_slow_threshold", SCOPE_GLOBAL, "300", "int", 0),
+    SysVar("identity", SCOPE_SESSION, "0", "int"),
+    SysVar("last_plan_from_binding", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("last_plan_from_cache", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("plugin_dir", SCOPE_GLOBAL, "/data/deploy/plugin", "str"),
+    SysVar("plugin_load", SCOPE_GLOBAL, "", "str"),
+    SysVar("rand_seed1", SCOPE_SESSION, "0", "int", 0),
+    SysVar("rand_seed2", SCOPE_SESSION, "0", "int", 0),
+    SysVar("skip_name_resolve", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_allow_fallback_to_tikv", SCOPE_BOTH, "", "str"),
+    SysVar("tidb_allow_function_for_expression_index", SCOPE_GLOBAL,
+           "json_extract, lower, md5, reverse, upper", "str"),
+    SysVar("tidb_allow_remove_auto_inc", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_analyze_version", SCOPE_BOTH, "2", "int", 1, 2),
+    SysVar("tidb_backoff_lock_fast", SCOPE_BOTH, "10", "int", 1),
+    SysVar("tidb_batch_commit", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_batch_delete", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_batch_insert", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_check_mb4_value_in_utf8", SCOPE_GLOBAL, "ON", "bool"),
+    SysVar("tidb_config", SCOPE_SESSION, "", "str"),
+    SysVar("tidb_ddl_reorg_priority", SCOPE_SESSION, "PRIORITY_LOW",
+           "str"),
+    SysVar("tidb_dml_batch_size", SCOPE_BOTH, "0", "int", 0),
+    SysVar("tidb_enable_1pc", SCOPE_GLOBAL, "ON", "bool"),
+    SysVar("tidb_enable_amend_pessimistic_txn", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_async_commit", SCOPE_GLOBAL, "ON", "bool"),
+    SysVar("tidb_enable_auto_increment_in_generated", SCOPE_BOTH, "OFF",
+           "bool"),
+    SysVar("tidb_enable_change_multi_schema", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_enable_column_tracking", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_enable_exchange_partition", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_enable_extended_stats", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_enable_historical_stats", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_enable_index_merge_join", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_list_partition", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_ordered_result_mode", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_enable_paging", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_pipelined_window_function", SCOPE_BOTH, "ON",
+           "bool"),
+    SysVar("tidb_enable_point_get_cache", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_enable_pseudo_for_outdated_stats", SCOPE_BOTH, "ON",
+           "bool"),
+    SysVar("tidb_enable_rate_limit_action", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_enable_strict_double_type_check", SCOPE_BOTH, "ON",
+           "bool"),
+    SysVar("tidb_enforce_mpp", SCOPE_SESSION, "OFF", "bool"),
+    SysVar("tidb_evolve_plan_baselines", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_evolve_plan_task_end_time", SCOPE_GLOBAL, "23:59 +0000",
+           "str"),
+    SysVar("tidb_evolve_plan_task_max_time", SCOPE_GLOBAL, "600", "int",
+           0),
+    SysVar("tidb_evolve_plan_task_start_time", SCOPE_GLOBAL,
+           "00:00 +0000", "str"),
+    SysVar("tidb_expensive_query_time_threshold", SCOPE_GLOBAL, "60",
+           "int", 10),
+    SysVar("tidb_gc_concurrency", SCOPE_GLOBAL, "-1", "int", -1, 256),
+    SysVar("tidb_gc_scan_lock_mode", SCOPE_GLOBAL, "LEGACY", "str"),
+    SysVar("tidb_guarantee_linearizability", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_hash_exchange_with_new_collation", SCOPE_BOTH, "ON",
+           "bool"),
+    SysVar("tidb_index_lookup_join_concurrency", SCOPE_BOTH, "-1", "int",
+           -1),
+    SysVar("tidb_last_query_info", SCOPE_SESSION, "", "str"),
+    SysVar("tidb_last_txn_info", SCOPE_SESSION, "", "str"),
+    SysVar("tidb_log_file_max_days", SCOPE_GLOBAL, "0", "int", 0),
+    SysVar("tidb_mem_quota_hashjoin", SCOPE_SESSION, str(32 << 30),
+           "int", 0),
+    SysVar("tidb_mem_quota_indexlookupjoin", SCOPE_SESSION, str(32 << 30),
+           "int", 0),
+    SysVar("tidb_mem_quota_indexlookupreader", SCOPE_SESSION,
+           str(32 << 30), "int", 0),
+    SysVar("tidb_mem_quota_mergejoin", SCOPE_SESSION, str(32 << 30),
+           "int", 0),
+    SysVar("tidb_mem_quota_sort", SCOPE_SESSION, str(32 << 30), "int", 0),
+    SysVar("tidb_mem_quota_topn", SCOPE_SESSION, str(32 << 30), "int", 0),
+    SysVar("tidb_memory_usage_alarm_ratio", SCOPE_SESSION, "0.8", "float"),
+    SysVar("tidb_merge_join_concurrency", SCOPE_BOTH, "1", "int", 1),
+    SysVar("tidb_metric_query_range_duration", SCOPE_SESSION, "60", "int",
+           10),
+    SysVar("tidb_metric_query_step", SCOPE_SESSION, "60", "int", 10),
+    SysVar("tidb_mpp_store_fail_ttl", SCOPE_BOTH, "60s", "str"),
+    SysVar("tidb_multi_statement_mode", SCOPE_BOTH, "OFF", "enum",
+           choices=("off", "on", "warn")),
+    SysVar("tidb_partition_prune_mode", SCOPE_BOTH, "static", "enum",
+           choices=("static", "dynamic", "static-only", "dynamic-only")),
+    SysVar("tidb_persist_analyze_options", SCOPE_GLOBAL, "ON", "bool"),
+    SysVar("tidb_placement_mode", SCOPE_BOTH, "STRICT", "enum",
+           choices=("strict", "ignore")),
+    SysVar("tidb_pprof_sql_cpu", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_read_consistency", SCOPE_SESSION, "strict", "enum",
+           choices=("strict", "weak")),
+    SysVar("tidb_redact_log", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_restricted_read_only", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_shard_allocate_step", SCOPE_SESSION, str(1 << 30), "int",
+           1),
+    SysVar("tidb_skip_ascii_check", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_stats_load_pseudo_timeout", SCOPE_GLOBAL, "OFF", "bool"),
+    SysVar("tidb_stats_load_sync_wait", SCOPE_SESSION, "0", "int", 0),
+    SysVar("tidb_stmt_summary_history_size", SCOPE_BOTH, "24", "int", 0,
+           255),
+    SysVar("tidb_stmt_summary_internal_query", SCOPE_BOTH, "OFF", "bool"),
+    SysVar("tidb_stmt_summary_max_sql_length", SCOPE_BOTH, "4096", "int",
+           0),
+    SysVar("tidb_stmt_summary_refresh_interval", SCOPE_BOTH, "1800",
+           "int", 1),
+    SysVar("tidb_streamagg_concurrency", SCOPE_BOTH, "1", "int", 1),
+    SysVar("tidb_table_cache_lease", SCOPE_GLOBAL, "3", "int", 1, 10),
+    SysVar("tidb_tmp_table_max_size", SCOPE_SESSION, str(64 << 20), "int",
+           1 << 20),
+    SysVar("tidb_top_sql_max_collect", SCOPE_GLOBAL, "10000", "int", 1),
+    SysVar("tidb_top_sql_max_statement_count", SCOPE_GLOBAL, "200", "int",
+           0, 5000),
+    SysVar("tidb_top_sql_precision_seconds", SCOPE_GLOBAL, "1", "int", 1),
+    SysVar("tidb_top_sql_report_interval_seconds", SCOPE_GLOBAL, "60",
+           "int", 1),
+    SysVar("tidb_track_aggregate_memory_usage", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tidb_tso_client_batch_max_wait_time", SCOPE_GLOBAL, "0.0",
+           "float"),
+    SysVar("tidb_use_plan_baselines", SCOPE_BOTH, "ON", "bool"),
+    SysVar("tx_isolation_one_shot", SCOPE_SESSION, "", "str"),
+    SysVar("tx_read_ts", SCOPE_SESSION, "0", "int", 0),
+    SysVar("txn_scope", SCOPE_SESSION, "global", "str"),
+    SysVar("windowing_use_high_precision", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_query_log_max_len", SCOPE_GLOBAL, "4096", "int", 0),
     SysVar("tidb_read_staleness", SCOPE_SESSION, "0", "int"),
+    # historical read view: every read runs at this datetime until unset
+    # (reference: sessionctx/variable tidb_snapshot + stale-read txns)
+    SysVar("tidb_snapshot", SCOPE_SESSION, "", "str"),
     SysVar("tidb_replica_read", SCOPE_SESSION, "leader"),
     SysVar("tidb_row_format_version", SCOPE_GLOBAL, "2", "int", 1, 2),
     SysVar("tidb_scatter_region", SCOPE_GLOBAL, "OFF", "bool"),
