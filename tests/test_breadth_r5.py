@@ -74,6 +74,47 @@ class TestNewBuiltins:
         assert len(_DISPATCH) >= 256
 
 
+class TestGBK:
+    """gbk charset + gbk_bin / gbk_chinese_ci collations (reference:
+    parser/charset/, util/collate/gbk_chinese_ci.go, gbk_bin.go)."""
+
+    def test_gbk_chinese_ci_hanzi_order(self, tk):
+        tk.must_exec("create table gh (s varchar(10) collate "
+                     "gbk_chinese_ci)")
+        for ch in ("从", "啊", "吧"):
+            tk.must_exec(f"insert into gh values ('{ch}')")
+        rows = [r[0] for r in
+                tk.must_query("select s from gh order by s").rows]
+        # GBK code order sorts roughly by pinyin: 啊(a) < 吧(ba) < 从(cong)
+        assert rows == ["啊", "吧", "从"]
+        # utf8 byte order would be 从 < 吧 < 啊 — must NOT be that
+        assert rows != ["从", "吧", "啊"]
+
+    def test_gbk_ci_case_folds_bin_does_not(self, tk):
+        tk.must_exec("create table gc (s varchar(10) collate "
+                     "gbk_chinese_ci, b varchar(10) collate gbk_bin)")
+        tk.must_exec("insert into gc values ('Ab', 'Ab'), ('aB', 'aB')")
+        assert tk.must_query(
+            "select count(*) from gc where s = 'AB'").rows == [("2",)]
+        assert tk.must_query(
+            "select count(*) from gc where b = 'AB'").rows == [("0",)]
+        assert tk.must_query(
+            "select count(distinct s) from gc").rows == [("1",)]
+        assert tk.must_query(
+            "select count(distinct b) from gc").rows == [("2",)]
+
+    def test_table_default_charset_gbk(self, tk):
+        tk.must_exec("create table gt (s varchar(10)) charset = gbk")
+        info = tk.domain.infoschema().table_by_name("test", "gt")
+        assert info.columns[0].ftype.collate == "gbk_chinese_ci"
+
+    def test_show_includes_gbk(self, tk):
+        cs = {r[0] for r in tk.must_query("show character set").rows}
+        assert "gbk" in cs
+        col = {r[0] for r in tk.must_query("show collation").rows}
+        assert {"gbk_chinese_ci", "gbk_bin"} <= col
+
+
 class TestNewSysvars:
     def test_registry_count(self, tk):
         from tidb_tpu.session import sysvars

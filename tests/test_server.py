@@ -310,3 +310,78 @@ def test_two_connections_share_domain(server):
     c1.query("delete from t where a = 3")
     c1.close()
     c2.close()
+
+
+class TestTLS:
+    """In-handshake TLS upgrade (reference: server/conn.go:256
+    upgradeToTLS): the server advertises CLIENT_SSL, the client sends an
+    SSLRequest, the socket wraps, and the full handshake + queries run
+    encrypted."""
+
+    @pytest.fixture(scope="class")
+    def tls_server(self, tmp_path_factory):
+        from tidb_tpu.server.main import make_tls_context
+        d = str(tmp_path_factory.mktemp("tls"))
+        ctx = make_tls_context(auto_dir=d)
+        if ctx is None:
+            pytest.skip("openssl unavailable for auto-TLS")
+        domain = bootstrap_domain()
+        srv = MySQLServer(domain, port=0, users={}, ssl_ctx=ctx).start()
+        yield srv
+        srv.shutdown()
+
+    def _tls_client(self, port):
+        import ssl
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        io = PacketIO(sock)
+        pkt = io.read_packet()
+        assert pkt[0] == 10
+        _ver, pos = read_nul_str(pkt, 1)
+        pos += 4
+        salt1 = pkt[pos:pos + 8]
+        pos += 9
+        caps_lo = struct.unpack_from("<H", pkt, pos)[0]
+        pos += 2 + 1 + 2
+        caps_hi = struct.unpack_from("<H", pkt, pos)[0]
+        pos += 2
+        server_caps = caps_lo | (caps_hi << 16)
+        assert server_caps & P.CLIENT_SSL, "server must advertise TLS"
+        salt_len = pkt[pos]
+        pos += 1 + 10
+        salt = salt1 + pkt[pos:pos + max(13, salt_len - 8) - 1]
+        caps = (P.CLIENT_PROTOCOL_41 | P.CLIENT_SECURE_CONNECTION
+                | P.CLIENT_PLUGIN_AUTH | P.CLIENT_SSL)
+        # SSLRequest: caps + max packet + charset + 23 filler, NO user
+        io.write_packet(struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+                        + bytes([255]) + b"\x00" * 23)
+        cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        cctx.check_hostname = False
+        cctx.verify_mode = ssl.CERT_NONE
+        tls = cctx.wrap_socket(sock)
+        io.sock = tls
+        auth = P.native_password_hash(b"", salt[:20])
+        out = struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+        out += bytes([255]) + b"\x00" * 23
+        out += b"root\x00" + bytes([len(auth)]) + auth
+        out += b"mysql_native_password\x00"
+        io.write_packet(out)
+        resp = io.read_packet()
+        assert resp[0] != 0xFF, resp
+        return io, tls
+
+    def test_query_over_tls(self, tls_server):
+        io, tls = self._tls_client(tls_server.port)
+        assert tls.version() is not None  # really encrypted
+        c = MiniClient.__new__(MiniClient)
+        c.io = io
+        c.sock = tls
+        kind, payload = c.query("select 1+1")
+        assert kind == "rows"
+        _cols, rows = payload
+        assert rows[0][0] in (b"2", "2")
+        tls.close()
+
+    def test_plaintext_still_works_alongside(self, tls_server):
+        c = MiniClient(tls_server.port)
+        kind, payload = c.query("select 2+2")
+        assert kind == "rows" and payload[1][0][0] in (b"4", "4")

@@ -33,6 +33,12 @@ class PerformanceConfig:
 @dataclasses.dataclass
 class SecurityConfig:
     skip_grant_table: bool = False
+    #: PEM cert/key enabling the wire protocol's in-handshake TLS upgrade
+    ssl_cert: str = ""
+    ssl_key: str = ""
+    #: generate a self-signed cert at startup when no cert is configured
+    #: (reference: security.auto-tls)
+    auto_tls: bool = False
 
 
 @dataclasses.dataclass

@@ -947,6 +947,20 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
     tbl = TableInfo(id=m.gen_global_id(), name=stmt.table.name)
     pk_count = 0
     auto_random_req = None
+    #: table-level DEFAULT CHARSET → the charset's default collation for
+    #: string columns without their own COLLATE (reference:
+    #: parser/charset/charset.go GetDefaultCollation)
+    _CHARSET_DEFAULT_COLLATE = {
+        "utf8mb4": "utf8mb4_bin", "utf8": "utf8mb4_bin",
+        "gbk": "gbk_chinese_ci", "binary": "binary",
+        "latin1": "latin1_bin", "ascii": "ascii_bin",
+    }
+    tbl_collate = None
+    opt_cs = (stmt.options.get("charset") or "").lower()
+    if opt_cs:
+        tbl_collate = _CHARSET_DEFAULT_COLLATE.get(opt_cs)
+    if stmt.options.get("collate"):
+        tbl_collate = stmt.options["collate"]
     for off, cd in enumerate(stmt.columns):
         tbl.max_col_id += 1
         default = None
@@ -958,6 +972,10 @@ def build_table_info(stmt: ast.CreateTableStmt, m: Meta) -> TableInfo:
             has_default = True
         if "collate" in cd.options:
             cd.ftype.collate = cd.options["collate"]
+        elif tbl_collate is not None:
+            from .expression import phys_kind as _pk, K_STR as _KS
+            if _pk(cd.ftype) == _KS:
+                cd.ftype.collate = tbl_collate
         ci = ColumnInfo(id=tbl.max_col_id, name=cd.name, offset=off,
                         ftype=cd.ftype, default_value=default,
                         has_default=has_default,

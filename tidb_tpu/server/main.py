@@ -8,6 +8,7 @@ Run:  python -m tidb_tpu.server [--port 4000] [--config cfg.toml]
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -52,6 +53,54 @@ def resolve_config(args):
     return cfg
 
 
+def make_tls_context(cert_path: str = "", key_path: str = "",
+                     auto_dir: str | None = None):
+    """ssl.SSLContext for the wire server's in-handshake upgrade
+    (reference: server/conn.go:256 upgradeToTLS + security.auto-tls).
+    With no cert configured and auto_dir set, generates a self-signed
+    RSA cert via the openssl CLI (the reference generates one in-process
+    at startup). Returns None when TLS cannot be enabled."""
+    import ssl
+    import subprocess as sp
+    import os as _os
+    explicit = bool(cert_path)
+    if not cert_path and auto_dir is not None:
+        # per-user 0700 directory, ownership-verified: a fixed path in a
+        # world-writable tmp would let another local user pre-plant the
+        # server's TLS identity
+        _os.makedirs(auto_dir, mode=0o700, exist_ok=True)
+        st = _os.stat(auto_dir)
+        if st.st_uid != _os.getuid() or (st.st_mode & 0o077):
+            print(f"[tls] refusing auto-TLS dir {auto_dir}: not owned by "
+                  f"this user or too permissive", file=sys.stderr)
+            return None
+        cert_path = _os.path.join(auto_dir, "auto-tls-cert.pem")
+        key_path = _os.path.join(auto_dir, "auto-tls-key.pem")
+        if not (_os.path.exists(cert_path) and _os.path.exists(key_path)):
+            try:
+                sp.run(["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                        "-nodes", "-keyout", key_path, "-out", cert_path,
+                        "-days", "365", "-subj", "/CN=tidb-tpu"],
+                       check=True, capture_output=True, timeout=60)
+                _os.chmod(key_path, 0o600)
+            except Exception as e:
+                print(f"[tls] auto-TLS generation failed: {e}",
+                      file=sys.stderr)
+                return None
+    if not cert_path or not key_path:
+        return None
+    try:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_path, key_path)
+        return ctx
+    except Exception:
+        if explicit:
+            # a configured cert that fails to load must not silently
+            # degrade the server to plaintext
+            raise
+        return None
+
+
 def run_server(cfg, ready_event: threading.Event | None = None):
     """Bootstrap and serve until SIGINT/SIGTERM. Returns the exit code."""
     from ..kv import new_store
@@ -91,7 +140,16 @@ def run_server(cfg, ready_event: threading.Event | None = None):
     domain.stats_worker.start()  # auto-analyze loop (domain.go:1270 analog)
     domain.gc_worker.start()     # MVCC safepoint GC (store/gcworker analog)
     domain.topsql.start()        # CPU attribution sampler (util/topsql)
-    sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port).start()
+    ssl_ctx = None
+    if cfg.security.ssl_cert or cfg.security.auto_tls:
+        import tempfile
+        ssl_ctx = make_tls_context(
+            cfg.security.ssl_cert, cfg.security.ssl_key,
+            auto_dir=(os.path.join(tempfile.gettempdir(),
+                                   f"tidb_tpu_tls_{os.getuid()}")
+                      if cfg.security.auto_tls else None))
+    sql_srv = MySQLServer(domain, host=cfg.host, port=cfg.port,
+                          ssl_ctx=ssl_ctx).start()
     status_srv = None
     if cfg.status.report_status:
         status_srv = StatusServer(domain, sql_srv,

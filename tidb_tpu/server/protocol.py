@@ -25,6 +25,7 @@ CLIENT_FOUND_ROWS = 0x2
 CLIENT_LONG_FLAG = 0x4
 CLIENT_CONNECT_WITH_DB = 0x8
 CLIENT_PROTOCOL_41 = 0x200
+CLIENT_SSL = 0x800
 CLIENT_TRANSACTIONS = 0x2000
 CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_MULTI_STATEMENTS = 0x10000
@@ -115,8 +116,8 @@ def native_password_hash(password: bytes, salt: bytes) -> bytes:
     return bytes(a ^ b for a, b in zip(h1, h3))
 
 
-def build_handshake(conn_id: int, salt: bytes) -> bytes:
-    caps = SERVER_CAPABILITIES
+def build_handshake(conn_id: int, salt: bytes, extra_caps: int = 0) -> bytes:
+    caps = SERVER_CAPABILITIES | extra_caps
     out = bytes([PROTOCOL_VERSION]) + SERVER_VERSION + b"\x00"
     out += struct.pack("<I", conn_id)
     out += salt[:8] + b"\x00"

@@ -22,13 +22,18 @@ from .packet import (PacketIO, lenenc_int, read_lenenc_int, read_nul_str)
 
 
 class MySQLServer:
-    def __init__(self, domain, host="127.0.0.1", port=4000, users=None):
+    def __init__(self, domain, host="127.0.0.1", port=4000, users=None,
+                 ssl_ctx=None):
         """users: optional static {user: password} map override. Default
         (None) authenticates against the mysql.user grant tables (falling
         back to empty-password root when the domain has no grant tables).
-        Pass users={} to explicitly accept any login (hermetic tests)."""
+        Pass users={} to explicitly accept any login (hermetic tests).
+        ssl_ctx: an ssl.SSLContext enabling the in-handshake TLS upgrade
+        (reference: server/conn.go:256 upgradeToTLS; see make_tls_context
+        / auto-TLS in server/main.py)."""
         self.domain = domain
         self.users = users
+        self.ssl_ctx = ssl_ctx
         self._next_conn_id = 0
         self._lock = threading.Lock()
         self.connections = {}
@@ -69,14 +74,30 @@ class MySQLServer:
 
     def _handle_conn(self, sock: socket.socket):
         io = PacketIO(sock)
-        conn_id = self._conn_id()
+        # the session is created BEFORE the handshake so the conn id the
+        # client displays is the one KILL resolves in domain.sessions —
+        # two counters here meant KILL <shown id> hit the wrong session
+        session = new_session(self.domain)
+        conn_id = session.conn_id
         salt = P.new_salt()
-        io.write_packet(P.build_handshake(conn_id, salt))
+        extra = P.CLIENT_SSL if self.ssl_ctx is not None else 0
+        io.write_packet(P.build_handshake(conn_id, salt, extra))
         try:
             resp = io.read_packet()
+            caps0 = (struct.unpack_from("<I", resp, 0)[0]
+                     if len(resp) >= 4 else 0)
+            if (self.ssl_ctx is not None and (caps0 & P.CLIENT_SSL)
+                    and len(resp) <= 32):
+                # SSLRequest: upgrade the conn IN the handshake, then the
+                # client resends the full response encrypted (reference:
+                # server/conn.go:256 upgradeToTLS)
+                sock = self.ssl_ctx.wrap_socket(sock, server_side=True)
+                io.sock = sock
+                resp = io.read_packet()
             user, db, auth, client_plugin = \
                 self._parse_handshake_response(resp)
         except ConnectionError:
+            session.close()
             return
         except Exception:
             # garbage from a non-MySQL client (port scan, HTTP, TLS probe)
@@ -84,6 +105,7 @@ class MySQLServer:
                 io.write_packet(P.build_err(1043, "Bad handshake", b"08S01"))
             except Exception:
                 pass
+            session.close()
             return
         try:
             peer = sock.getpeername()[0]
@@ -122,8 +144,8 @@ class MySQLServer:
                                       "ConnectionReject")
             io.write_packet(P.build_err(
                 1045, f"Access denied for user '{user}'", b"28000"))
+            session.close()
             return
-        session = new_session(self.domain)
         session.user = f"{user}@{matched_host}"
         if plug:
             plug.audit_connection(
@@ -135,6 +157,7 @@ class MySQLServer:
             except TiDBError as e:
                 io.write_packet(P.build_err(
                     getattr(e, "code", 1049) or 1049, str(e)))
+                session.close()
                 return
         io.write_packet(P.build_ok())
         self.connections[conn_id] = session

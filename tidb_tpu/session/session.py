@@ -472,6 +472,16 @@ class Session:
             raise TiDBError(f"Unknown system variable '{name}'",
                             code=ErrCode.UnknownSystemVariable)
         v = reg.validate(value) if value is not None else reg.default
+        if name == "tidb_snapshot" and v:
+            # reject an unparseable snapshot NOW — accepting it would
+            # wedge every later read behind cast errors (the reference
+            # validates at SET time too, variable/varsutil.go)
+            try:
+                self._datetime_to_ts(v)
+            except Exception:
+                raise TiDBError(
+                    f"Incorrect argument type to variable 'tidb_snapshot'"
+                    f": '{v}'")
         if scope == "global":
             self.domain.global_vars[name] = v
         else:
@@ -984,6 +994,20 @@ class Session:
         # a previous statement that only PLANNED (EXPLAIN, CTAS) may have
         # pinned a stale-read ts without a run_query finally to clear it
         self._stmt_as_of_ts = None
+        # expensive-query watchdog (reference: util/expensivequery/
+        # expensivequery.go:34,69 + MySQL semantics: TOP-LEVEL read-only
+        # SELECTs only — a DML's embedded SELECT must not arm it)
+        timer = None
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt)):
+            try:
+                timeout_ms = int(self.get_sysvar("max_execution_time"))
+            except Exception:
+                timeout_ms = 0
+            if timeout_ms > 0:
+                import threading as _threading
+                timer = _threading.Timer(timeout_ms / 1000.0, self.kill)
+                timer.daemon = True
+                timer.start()
         t0 = time.perf_counter()
         try:
             sql = stmt.restore()
@@ -1021,6 +1045,8 @@ class Session:
                 self.txn = None
             raise
         finally:
+            if timer is not None:
+                timer.cancel()
             self.current_sql = None
             el = time.perf_counter() - t0
             try:
@@ -1544,21 +1570,6 @@ class Session:
 
     def run_query(self, stmt, outer=None) -> Result:
         from ..executor import build_executor
-        # expensive-query watchdog (reference: util/expensivequery/
-        # expensivequery.go:34,69 + MySQL max_execution_time semantics —
-        # read-only statements only): past the deadline the kill flag
-        # flips and the next executor checkpoint raises 1317
-        timer = None
-        if outer is None:
-            try:
-                timeout_ms = int(self.get_sysvar("max_execution_time"))
-            except Exception:
-                timeout_ms = 0
-            if timeout_ms > 0:
-                import threading as _threading
-                timer = _threading.Timer(timeout_ms / 1000.0, self.kill)
-                timer.daemon = True
-                timer.start()
         try:
             plan = cache_key = None
             if (outer is None and self._expr_ctx.params is not None
@@ -1580,10 +1591,11 @@ class Session:
             names = _schema_names(plan)
             return Result(names=names, chunk=chunk)
         finally:
-            if timer is not None:
-                timer.cancel()
-            # a table factor's AS OF TIMESTAMP scopes to its statement
-            self._stmt_as_of_ts = None
+            if outer is None:
+                # a table factor's AS OF TIMESTAMP scopes to its
+                # STATEMENT: a nested subquery run must not un-pin the
+                # outer statement's historical read view mid-flight
+                self._stmt_as_of_ts = None
 
     def _cached_plan(self, stmt):
         """Prepared-plan cache lookup (reference: planner/core/

@@ -71,8 +71,37 @@ def _unicode_key(s: str) -> str:
     return "".join(c for c in s if not unicodedata.combining(c))
 
 
+def is_gbk(collate: str | None) -> bool:
+    return bool(collate) and collate.startswith("gbk")
+
+
 def sort_key(b: bytes, collation: str | None = None) -> bytes:
     s = b.decode("utf-8", "replace")
+    if is_gbk(collation):
+        # gbk_chinese_ci: order by the GBK code of the UPPERCASED text
+        # (reference: util/collate/gbk_chinese_ci.go — the weight table is
+        # the GBK code point order, which sorts Hanzi roughly by pinyin;
+        # case folds like the reference's gbkChineseCICollator).
+        # gbk_bin reaches here through key_for_compare: GBK byte order,
+        # no case fold (util/collate/gbk_bin.go).
+        if collation.endswith("_ci"):
+            s = s.upper()
+        try:
+            return s.encode("gbk")
+        except UnicodeEncodeError:
+            # GBK-unencodable characters (the reference errors at INSERT;
+            # this engine stores utf8 regardless): escape each as
+            # \xff\xff + utf8 bytes — \xff never starts a valid GBK
+            # sequence, so escapes sort after all GBK text and DISTINCT
+            # values stay distinct (a plain 'replace' collapsed them all
+            # to '?')
+            out = bytearray()
+            for ch in s:
+                try:
+                    out += ch.encode("gbk")
+                except UnicodeEncodeError:
+                    out += b"\xff\xff" + ch.encode("utf-8")
+            return bytes(out)
     key = _unicode_key(s) if is_unicode_ci(collation) else _general_key(s)
     return key.encode("utf-8")
 
@@ -86,8 +115,11 @@ def sort_key_array(data: np.ndarray, collation: str | None = None) -> np.ndarray
 
 
 def key_for_compare(data: np.ndarray, ftype) -> np.ndarray:
-    """data unchanged for binary collations; sort keys for _ci."""
-    if needs_ci(ftype):
+    """data unchanged for binary collations; sort keys for _ci — and for
+    gbk_bin, whose BYTE order is the GBK encoding's, not utf8's."""
+    from ..expression import phys_kind, K_STR
+    if needs_ci(ftype) or (phys_kind(ftype) == K_STR
+                           and ftype.collate == "gbk_bin"):
         return sort_key_array(data, ftype.collate)
     return data
 
