@@ -77,6 +77,16 @@ def build_executor(plan, ctx, stats=None) -> QueryExecutor:
         from .execdetails import timed_execute
         exe.stats = stats
         exe.execute = timed_execute(exe, stats)
+    if getattr(ctx, "check_killed", None) is not None:
+        # every operator boundary is an interruption point (reference:
+        # the killed check in each Next() call, executor/executor.go)
+        inner = exe.execute
+
+        def checked_execute():
+            exe.check_killed()
+            return inner()
+
+        exe.execute = checked_execute
     return exe
 
 

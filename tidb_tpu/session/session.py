@@ -1588,6 +1588,11 @@ class Session:
                                         collect_param_consts(plan), cap)
             exe = build_executor(plan, self._exec_ctx())
             chunk = exe.execute()
+            # a kill that landed after the LAST operator checkpoint still
+            # cancels the statement (the result is discarded) — without
+            # this, a kill during the final operator's long tail is
+            # silently swallowed at the next statement's flag reset
+            self.check_killed()
             names = _schema_names(plan)
             return Result(names=names, chunk=chunk)
         finally:
