@@ -954,9 +954,17 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
         capacity = dev.next_pow2(min(n_frag, max(est, 16)))
     # post-join compaction (CPU backend only — scatter-cheap there): learn
     # the kept-row count and re-shape the aggregate input to it
-    on_cpu = jax.default_backend() == "cpu"
+    # post-join compaction backend gate: 'auto' = CPU only (scatter-cheap
+    # there; TPU scatters serialize), 'on'/'off' override — flippable at
+    # runtime so a TPU window can A/B it without code edits
+    try:
+        _cmode = ctx.get_sysvar("tidb_device_compact")
+    except Exception:
+        _cmode = "auto"
+    compact_enabled = (_cmode == "on" or (_cmode != "off"
+                                 and jax.default_backend() == "cpu"))
     compact_cap = None
-    if on_cpu and n_frag > 65536:
+    if compact_enabled and n_frag > 65536:
         learned_kept = _CAP_STORE.get((sig, "compact"))
         if learned_kept is not None and dev.next_pow2(
                 max(learned_kept, 8)) * 2 <= n_frag:
@@ -1029,7 +1037,7 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
             _fill_caps(root, sig)
             continue
         _cap_store_put((sig, "compact"), kept)
-        if (on_cpu and compact_cap is None
+        if (compact_enabled and compact_cap is None
                 and dev.next_pow2(max(kept, 8)) * 2 <= root_cap
                 and root_cap > 65536):
             # compaction newly profitable: one recompile buys an agg that

@@ -1036,6 +1036,14 @@ class Session:
             self.domain.plugins.audit_general(self, sql, EVENT_STMT)
         try:
             res = self._dispatch(stmt)
+            if isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt,
+                                 ast.ExplainStmt, ast.TraceStmt,
+                                 ast.ShowStmt)):
+                # read-only statements: a kill landing after the last
+                # operator checkpoint still cancels (result discarded).
+                # Write statements are exempt — their txn may already be
+                # committed, and "interrupted" after a commit would lie
+                self.check_killed()
             return res
         except Exception:
             # statement-level rollback of the autocommit txn — ANY escaping

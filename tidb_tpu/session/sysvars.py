@@ -47,7 +47,9 @@ class SysVar:
         if self.kind == "enum":
             if self.choices and v.lower() not in self.choices:
                 raise TiDBError(f"Variable '{self.name}' can't be set to the value of '{v}'")
-            return v
+            # store normalized: every reader compares lowercase literals
+            # (SET tidb_device_compact = OFF must actually disable it)
+            return v.lower()
         return v
 
 
@@ -121,6 +123,9 @@ for _v in [
     # for larger-than-memory inputs at the cost of re-transfer per run
     # (0 = off: whole-table transfers, HBM-resident column cache)
     SysVar("tidb_device_stream_rows", SCOPE_BOTH, "0", "int", 0),
+    # post-join compaction in device fragments: auto = CPU backend only
+    SysVar("tidb_device_compact", SCOPE_BOTH, "auto", "enum",
+           choices=("auto", "on", "off")),
     SysVar("tidb_slow_log_threshold", SCOPE_BOTH, "300", "int", 0),
     SysVar("cte_max_recursion_depth", SCOPE_BOTH, "1000", "int", 0, 4294967295),
     SysVar("tidb_auto_analyze_ratio", SCOPE_GLOBAL, "0.5", "float"),
