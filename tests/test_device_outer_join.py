@@ -92,6 +92,32 @@ class TestDeviceSemiAnti:
             "select 1 from cust where cust.ck = ords.ck) "
             "group by cmt order by cmt"), ["anti"])
 
+    def test_in_subquery_over_left_join_probe(self, tk):
+        """WHERE x IN (agg subquery) above a LEFT JOIN: the membership is
+        a WHERE filter — folding it into the outer join's ON-residuals
+        would null-extend instead of drop (regression: device fragment
+        falls back to host for non-inner probes)."""
+        sql = ("select seg, count(*), count(ok) from cust left join ords "
+               "on cust.ck = ords.ck where cust.ck in ("
+               "select ords.ck from ords group by ords.ck "
+               "having sum(amt) > 50) group by seg order by seg")
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        dev = tk.must_query(sql).rows
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        host = tk.must_query(sql).rows
+        assert dev == host and len(dev) > 0
+
+    def test_q18_shape_semi_absorbed_into_fragment(self, tk):
+        """Uncorrelated IN (agg subquery) over an inner join chain fuses
+        back into ONE device fragment (the membership becomes an in-set
+        scan filter; the build side runs through its own executor)."""
+        _run_both(tk, (
+            "select seg, count(*), sum(amt) from cust, ords "
+            "where cust.ck = ords.ck and ords.ck in ("
+            "select ords.ck from ords group by ords.ck "
+            "having sum(amt) > 50) group by seg order by seg"),
+            ["inner"])
+
     def test_semi_over_inner_join_chain(self, tk):
         """semi at fragment root over an inner join below it."""
         _run_both(tk, (

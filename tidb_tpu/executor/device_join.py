@@ -115,6 +115,46 @@ def collect_tree(node):
             if not p.left_keys:
                 raise DeviceUnsupported(
                     "cartesian join (no equi keys) in device fragment")
+            _scan_shaped = (isinstance(n.children[1], TableScanExec)
+                            or (isinstance(n.children[1], SelectionExec)
+                                and isinstance(n.children[1].children[0],
+                                               TableScanExec)))
+            if (p.kind == "semi" and not _scan_shaped
+                    and len(p.left_keys) == 1 and not p.other_conds):
+                # mid-tree semi join over a non-scan build (the
+                # uncorrelated IN→semi rewrite: an aggregate subquery):
+                # materialize the build side — through its own
+                # (device-capable) executor — and fold the membership
+                # into an in-set filter on the probe subtree, restoring
+                # the fused single-program fragment (Q18's shape).
+                # Anti is excluded: NOT IN's NULL semantics differ from
+                # a negated in-set.
+                # Probe walks FIRST: a DeviceUnsupported below must not
+                # discard an already-executed aggregate subquery (the
+                # fallback would run it again — and tpu-mpp a third time)
+                lnode = walk(n.children[0], offset)
+                if (isinstance(lnode, _JoinNode)
+                        and lnode.kind != "inner"):
+                    # other_conds on an outer join are ON-residuals (part
+                    # of the MATCH), not a WHERE filter — attaching the
+                    # membership there would null-extend instead of drop
+                    raise DeviceUnsupported(
+                        "semi membership over a non-inner probe")
+                values_chunk = n.children[1].execute()
+                from .exec_select import eval_expr_to_column
+                col = eval_expr_to_column(p.right_keys[0], values_chunk)
+                vals = [None if col.nulls[i] else col.value_at(i)
+                        for i in range(len(col.data))]
+                from ..expression.builder import build_in_set
+                cond = build_in_set(p.left_keys[0], vals,
+                                    p.right_keys[0].ftype)
+                if isinstance(lnode, _Leaf):
+                    lnode.conds.append(cond)  # left-local schema == leaf's
+                else:
+                    # over the left subtree's schema, which starts at the
+                    # node's own offset — exactly other_conds' convention
+                    lnode.other_conds.append(cond)
+                return lnode
             left = walk(n.children[0], offset)
             right = walk(n.children[1], offset + left.ncols)
             for lk, rk in zip(p.left_keys, p.right_keys):

@@ -470,7 +470,10 @@ class HashAggExec(QueryExecutor):
                     pass
         # join fragment: HashAgg over an (inner equi-)join tree of scans
         # fuses scans+filters+joins+aggregate into one device program
-        if raw is None and isinstance(join_child, HashJoinExec):
+        if (raw is None and isinstance(join_child, HashJoinExec)
+                and engine_mode(self.ctx) != "host"):
+            # collect_tree may MATERIALIZE a semi build side; in host mode
+            # that work would be thrown away and re-done by the host path
             from .device_join import device_join_agg
             try:
                 out = device_join_agg(eff_p, agg_conds, join_child,

@@ -724,7 +724,24 @@ class PlanBuilder:
             # nested subqueries); the ExprBuilder fallback reuses this
             self._sub_memo[id(sub_ast)] = (scope, subplan)
         if not scope.used:
-            return None  # uncorrelated: eager materialization handles it
+            # UNCORRELATED positive IN → semi join (reference:
+            # tidb_opt_insubq_to_join_and_agg, expression_rewriter.go
+            # handleInSubquery): the subquery becomes a plan child
+            # executed at RUN time — the in-set path materializes it at
+            # expression-build time, so even EXPLAIN executed it. NOT IN
+            # stays on build_in_set (its three-valued NULL semantics need
+            # the set form without correlation keys to hang them on).
+            if (target_ast is None or kind != "semi"):
+                return None
+            try:
+                on = self.ctx.get_sysvar(
+                    "tidb_opt_insubq_to_join_and_agg", "session")
+            except Exception:
+                on = "ON"
+            if str(on).upper() not in ("ON", "1"):
+                return None
+            return self._uncorrelated_in_semi(subplan, target_ast,
+                                              from_schema)
 
         node = subplan
         if isinstance(node, Sort):
@@ -818,6 +835,31 @@ class PlanBuilder:
             #              worse than the memoized Apply
         right_child = Selection(base, residual) if residual else base
         return kind, right_child, lkeys, rkeys, oconds
+
+    def _uncorrelated_in_semi(self, subplan, target_ast, from_schema):
+        """`x IN (SELECT e FROM ...)` (uncorrelated) → semi join with the
+        subquery plan as the build child. The subquery keeps its whole
+        shape (DISTINCT/LIMIT/aggregates included — they restrict the
+        membership set and must survive)."""
+        from ..expression import phys_kind
+        proj = subplan if isinstance(subplan, Projection) else None
+        if proj is not None and len(proj.exprs) == 1:
+            right_child = proj.child
+            y = proj.exprs[0]
+        else:
+            if len(subplan.schema) != 1:
+                raise TiDBError("Operand should contain 1 column(s)",
+                                code=ErrCode.OperandColumns)
+            right_child = subplan
+            y = Column(0, subplan.schema.refs[0].ftype)
+        b = ExprBuilder(from_schema, self.ctx, outer=self.outer)
+        b.sub_memo = self._sub_memo
+        x = b.build(target_ast)
+        acc = []
+        _collect_outer_refs(x, acc)
+        if acc or phys_kind(x.ftype) != phys_kind(y.ftype):
+            return None
+        return "semi", right_child, [x], [y], []
 
     _MIRROR_OP = {"=": "=", "!=": "!=", "<": ">", "<=": ">=", ">": "<",
                   ">=": "<="}

@@ -39,6 +39,8 @@ def optimize(plan: LogicalPlan, ctx=None, trace=None) -> LogicalPlan:
     step("join_reorder", plan)
     plan = prune_columns(plan)
     step("column_pruning", plan)
+    plan = pull_proj_through_semi(plan)
+    step("semi_join_projection_pull", plan)
     plan = prune_partitions_rule(plan)
     step("partition_pruning", plan)
     plan = choose_access_paths(plan, ctx)
@@ -819,6 +821,34 @@ def _remap_inner(expr, g2item, item_id):
 def _remap_final(expr, gmap):
     return expr.transform_columns(
         lambda c: Column(gmap(c.idx), c.ftype, name=c.name))
+
+
+def pull_proj_through_semi(plan):
+    """Projection(pure columns) under a semi/anti join's PROBE side pulls
+    above the join (the join's output IS its left schema, so the pull is
+    a pure rotation). Join reorder inserts such projections to restore
+    column order; leaving one between the aggregate and the join blocks
+    the fused device fragment (collect_tree sees ProjectionExec), while
+    above the join it inlines into the aggregate
+    (_inline_agg_projection)."""
+    for i, c in enumerate(plan.children):
+        plan.children[i] = pull_proj_through_semi(c)
+    if (isinstance(plan, Join) and plan.kind in ("semi", "anti")
+            and not plan.other_conds  # residuals index the concat schema
+            #                           whose left half IS the projection's
+            #                           output — rotating would misalign
+            #                           them (null-aware NOT IN, Q17/Q20)
+            and isinstance(plan.left, Projection)
+            and all(isinstance(e, Column) for e in plan.left.exprs)):
+        proj = plan.left
+        plan.children[0] = proj.child
+        plan.left_keys = [
+            e.transform_columns(lambda c: proj.exprs[c.idx])
+            for e in plan.left_keys]
+        plan.schema = proj.child.schema
+        proj.children[0] = plan
+        return proj
+    return plan
 
 
 # ---------------------------------------------------------------------------
