@@ -53,6 +53,55 @@ QUERY = ("select grp, cat, count(*), sum(amount), min(amount), max(amount), "
          "order by grp, cat")
 
 
+class TestStreamedCountDistinct:
+    """Streamed COUNT(DISTINCT x): per-block (group, x) pair dedup +
+    one final cnt_dist over the concatenated pairs (the two-phase
+    distinct agg, reference executor/aggregate.go)."""
+
+    def _both(self, tk, sql):
+        import tidb_tpu.executor.device_exec as de
+        calls = []
+        orig = de._stream_count_distinct
+
+        def spy(*a, **k):
+            r = orig(*a, **k)
+            calls.append(1)
+            return r
+
+        de._stream_count_distinct = spy
+        try:
+            tk.must_exec("set tidb_executor_engine = 'tpu'")
+            tk.must_exec(f"set tidb_device_stream_rows = {BATCH}")
+            stream = tk.must_query(sql).rows
+        finally:
+            de._stream_count_distinct = orig
+            tk.must_exec("set tidb_device_stream_rows = 0")
+        assert calls, "streamed count-distinct path did not run"
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        host = tk.must_query(sql).rows
+        assert stream == host, sql
+        return stream
+
+    def test_grouped(self, tk):
+        self._both(tk, "select grp, count(distinct amount) from s "
+                       "group by grp order by grp")
+
+    def test_global(self, tk):
+        rows = self._both(tk, "select count(distinct amount) from s")
+        assert rows[0][0] == "97"
+
+    def test_nulls_ignored(self, tk):
+        tk.must_exec("create table cdn (g bigint, v bigint)")
+        tk.must_exec("insert into cdn values (1,1),(1,null),(1,1),(1,2),"
+                     "(2,null),(2,null)")
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        tk.must_exec("set tidb_device_stream_rows = 2")
+        rows = tk.must_query("select g, count(distinct v) from cdn "
+                             "group by g order by g").rows
+        tk.must_exec("set tidb_device_stream_rows = 0")
+        assert rows == [("1", "2"), ("2", "0")]
+
+
 class TestStreamedAgg:
     def test_parity_stream_vs_whole_vs_host(self, tk):
         tk.must_exec("set tidb_executor_engine = 'tpu'")

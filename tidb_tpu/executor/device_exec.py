@@ -634,9 +634,21 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
     (key_fns, key_meta, key_pack, val_plan, agg_ops,
      slots) = _plan_agg(plan, dcols)
     n_keys = max(len(key_fns), 1)
+    if tuple(agg_ops) == ("cnt_dist",):
+        # COUNT(DISTINCT x) streams through pair dedup: each block
+        # deduplicates (group, x) PAIRS (an agg whose keys are
+        # group+value), and the final cnt_dist over the concatenated
+        # pair rows is exact even with cross-block duplicates — the
+        # sorted kernel counts distinct value runs per group (reference:
+        # the two-phase distinct agg, executor/aggregate.go partial
+        # dedup + final count)
+        return _stream_count_distinct(plan, conds, chunk, col_arrays,
+                                      dcols, cond_fns, key_fns, key_meta,
+                                      key_pack, val_plan, slots,
+                                      batch_rows, ctx)
     if any(op not in _MERGE_OPS for op in agg_ops):
-        # cnt_dist partial states are counts, not sets — they can't merge
-        # across blocks; the whole-input kernel handles distinct
+        # other distinct/non-mergeable partial states can't merge across
+        # blocks; the whole-input kernel handles them
         raise DeviceUnsupported("non-mergeable agg in streamed pipeline")
     merge_ops = tuple(_MERGE_OPS[op] for op in agg_ops)
     sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
@@ -705,6 +717,83 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
 #: partial-aggregate rows buffered on device before a merge flush (shared
 #: by the streamed scan-agg and the paged probe join)
 _MERGE_BUDGET_ROWS = 1 << 25
+
+
+def _stream_count_distinct(plan, conds, chunk, col_arrays, dcols, cond_fns,
+                           key_fns, key_meta, key_pack, val_plan, slots,
+                           batch_rows, ctx):
+    """Streamed COUNT(DISTINCT x): per-block dedup of (group, x) pairs,
+    then one cnt_dist aggregate over the concatenated pair rows."""
+    n = chunk.num_rows
+    val_fn = val_plan[0][0]
+    # block program: group keys + value as ONE key set, dedup via 'first'
+    pair_fns = list(key_fns) + [val_fn]
+    n_pair_keys = len(pair_fns)
+    est = _estimate_groups(plan, n, ctx)
+    # distinct pairs per block bounded by the block; estimate via group
+    # est * a small per-group distinct factor, discovered on overflow
+    capacity = dev.next_pow2(min(batch_rows, max(est * 4, 64)))
+    n_blocks = (n + batch_rows - 1) // batch_rows
+    sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
+    for _attempt in range(8):
+        if n_blocks * capacity > 4 * _MERGE_BUDGET_ROWS:
+            # unlike the mergeable path this buffers EVERY block's pair
+            # state — past the budget, degrade to the fallback instead of
+            # exhausting device memory
+            raise DeviceUnsupported(
+                "distinct pair state exceeds the stream budget")
+        key = (sig_exprs, "cntd", capacity)
+        fn = _pipe_cache_get(key)
+        if fn is None:
+            fn = _build_pipeline(cond_fns, pair_fns, n_pair_keys,
+                                 [(val_fn, "int")], ("first",), capacity,
+                                 None)
+            _pipe_cache_put(key, fn, dict_refs)
+        partials = []
+        for lo in range(0, n, batch_rows):
+            hi = min(lo + batch_rows, n)
+            env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
+                   for idx, (d, nl) in col_arrays.items()}
+            partials.append(fn(env))
+        counts = [int(c) for c in jax.device_get([p[4] for p in partials])]
+        if max(counts) <= capacity:
+            break
+        capacity = dev.next_pow2(max(counts))
+    else:
+        raise DeviceUnsupported("distinct pair capacity did not converge")
+
+    n_keys = max(len(key_fns), 1)
+    # concatenated pair rows: group keys back apart from the value key
+    if key_fns:
+        key_cat = tuple(jnp.concatenate([p[0][k] for p in partials])
+                        for k in range(n_keys))
+        key_null_cat = tuple(jnp.concatenate([p[1][k] for p in partials])
+                             for k in range(n_keys))
+    else:
+        # global COUNT(DISTINCT): one group — constant key, NOT the value
+        tot = sum(int(p[0][0].shape[0]) for p in partials)
+        key_cat = (jnp.zeros(tot, dtype=jnp.int64),)
+        key_null_cat = (jnp.zeros(tot, dtype=bool),)
+    val_cat = (jnp.concatenate([p[0][n_pair_keys - 1] for p in partials]),)
+    val_null_cat = (jnp.concatenate([p[1][n_pair_keys - 1]
+                                     for p in partials]),)
+    mask = jnp.concatenate([jnp.arange(capacity) < p[4] for p in partials])
+    total = int(mask.shape[0])
+    final_cap = dev.next_pow2(max(est, 16))
+    while True:
+        out = jax.device_get(dev._agg_impl(
+            key_cat, key_null_cat, val_cat, val_null_cat, mask,
+            n_keys=n_keys, agg_ops=("cnt_dist",),
+            capacity=min(final_cap, dev.next_pow2(total)), pack=key_pack))
+        key_out, key_null_out, results, result_nulls, n_groups, _v = out
+        ng = int(n_groups)
+        if ng <= final_cap:
+            break
+        final_cap = dev.next_pow2(ng)
+    if ng == 0 and not plan.group_exprs:
+        raise DeviceUnsupported("empty global aggregate")
+    return _assemble_agg(plan, key_meta, slots, dcols,
+                         (key_out, key_null_out, results, result_nulls), ng)
 
 
 def merge_partial_states(state, parts, merge_cap, n_keys, nvals, merge_ops,

@@ -347,8 +347,9 @@ def _character_sets(session):
             ("description", _S), ("maxlen", _I)]
 
     def rows():
-        return [(b"utf8mb4", b"utf8mb4_bin", b"UTF-8 Unicode", 4),
-                (b"binary", b"binary", b"Binary pseudo charset", 1)]
+        from ..utils.collate import CHARSETS
+        return [(name, dflt, desc, maxlen)
+                for name, desc, dflt, maxlen in CHARSETS]
     return cols, rows
 
 
@@ -357,8 +358,8 @@ def _collations(session):
             ("is_default", _S), ("is_compiled", _S), ("sortlen", _I)]
 
     def rows():
-        return [(b"utf8mb4_bin", b"utf8mb4", 46, b"Yes", b"Yes", 1),
-                (b"binary", b"binary", 63, b"Yes", b"Yes", 1)]
+        from ..utils.collate import COLLATIONS
+        return list(COLLATIONS)
     return cols, rows
 
 

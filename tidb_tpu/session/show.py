@@ -153,24 +153,18 @@ def exec_show(session, stmt: ast.ShowStmt):
                       chunk=Chunk.from_rows([_S] * 6, rows))
 
     if stmt.kind == "charset":
-        rows = [(b"utf8mb4", b"UTF-8 Unicode", b"utf8mb4_bin", 4),
-                (b"gbk", b"Chinese Internal Code Specification",
-                 b"gbk_chinese_ci", 2),
-                (b"binary", b"binary", b"binary", 1)]
+        from ..utils.collate import CHARSETS
         return Result(names=["Charset", "Description", "Default collation",
                              "Maxlen"],
-                      chunk=Chunk.from_rows([_S, _S, _S, _I], rows))
+                      chunk=Chunk.from_rows([_S, _S, _S, _I],
+                                            list(CHARSETS)))
 
     if stmt.kind == "collation":
-        rows = [(b"utf8mb4_bin", b"utf8mb4", 46, b"Yes", b"Yes", 1),
-                (b"utf8mb4_general_ci", b"utf8mb4", 45, b"", b"Yes", 1),
-                (b"utf8mb4_unicode_ci", b"utf8mb4", 224, b"", b"Yes", 8),
-                (b"gbk_chinese_ci", b"gbk", 28, b"Yes", b"Yes", 1),
-                (b"gbk_bin", b"gbk", 87, b"", b"Yes", 1),
-                (b"binary", b"binary", 63, b"Yes", b"Yes", 1)]
+        from ..utils.collate import COLLATIONS
         return Result(names=["Collation", "Charset", "Id", "Default",
                              "Compiled", "Sortlen"],
-                      chunk=Chunk.from_rows([_S, _S, _I, _S, _S, _I], rows))
+                      chunk=Chunk.from_rows([_S, _S, _I, _S, _S, _I],
+                                            list(COLLATIONS)))
 
     if stmt.kind == "processlist":
         rows = [(session.conn_id, session.user.encode(), b"localhost",

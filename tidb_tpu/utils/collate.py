@@ -34,6 +34,26 @@ from functools import lru_cache
 import numpy as np
 
 
+#: ONE registry for SHOW CHARACTER SET / SHOW COLLATION and the
+#: information_schema memtables (reference: parser/charset/charset.go) —
+#: (name, description, default collation, maxlen)
+CHARSETS = (
+    (b"utf8mb4", b"UTF-8 Unicode", b"utf8mb4_bin", 4),
+    (b"gbk", b"Chinese Internal Code Specification", b"gbk_chinese_ci", 2),
+    (b"binary", b"binary", b"binary", 1),
+)
+
+#: (collation, charset, id, is_default, is_compiled, sortlen)
+COLLATIONS = (
+    (b"utf8mb4_bin", b"utf8mb4", 46, b"Yes", b"Yes", 1),
+    (b"utf8mb4_general_ci", b"utf8mb4", 45, b"", b"Yes", 1),
+    (b"utf8mb4_unicode_ci", b"utf8mb4", 224, b"", b"Yes", 8),
+    (b"gbk_chinese_ci", b"gbk", 28, b"Yes", b"Yes", 1),
+    (b"gbk_bin", b"gbk", 87, b"", b"Yes", 1),
+    (b"binary", b"binary", 63, b"Yes", b"Yes", 1),
+)
+
+
 def is_ci(collate: str | None) -> bool:
     return bool(collate) and collate.endswith("_ci")
 
