@@ -160,3 +160,79 @@ def test_non_public_index_invisible_to_planner(tk):
     while not w.step_add_index(job.id):
         pass
     tk.must_exec("admin check index t idx_part")
+
+
+# -- online DROP INDEX / ADD COLUMN (reference: ddl/index.go onDropIndex,
+#    ddl/column.go onAddColumn) ---------------------------------------------
+
+
+def test_drop_index_walks_states_down(tk):
+    tk.must_exec("create index idx_b on t (b)")
+    events = []
+    tk.session.domain.ddl_worker.on_event(
+        lambda ev, job: events.append((job.type, ev)))
+    tk.must_exec("drop index idx_b on t")
+    walked = [ev for ty, ev in events if ty == "drop_index"]
+    assert walked == ["write only", "delete only", "none"]
+    assert _tbl(tk).find_index("idx_b") is None
+    # the key range is purged
+    tk.must_query("admin check table t").check([])
+
+
+def test_drop_index_mid_state_dml_stays_consistent(tk):
+    """DML landing while the dropping index is write-only/delete-only must
+    not corrupt anything — entries stop mattering once the object is gone,
+    and a fresh same-name index sees none of them."""
+    tk.must_exec("create index idx_b on t (b)")
+    w = tk.session.domain.ddl_worker
+
+    def hook(ev, job):
+        if job.type == "drop_index" and ev == "write only":
+            tk.must_exec("insert into t values (900, 77, 'w')")
+        if job.type == "drop_index" and ev == "delete only":
+            tk.must_exec("insert into t values (901, 78, 'd')")
+            tk.must_exec("delete from t where a = 900")
+
+    w.on_event(hook)
+    tk.must_exec("drop index idx_b on t")
+    tk.must_exec("create index idx_b on t (b)")
+    tk.must_query("admin check table t").check([])
+    tk.must_query("select a from t use index (idx_b) where b = 78"
+                  ).check([("901",)])
+
+
+def test_add_column_walks_states_up(tk):
+    events = []
+    tk.session.domain.ddl_worker.on_event(
+        lambda ev, job: events.append((job.type, ev)))
+    tk.must_exec("alter table t add column d bigint default 42")
+    walked = [ev for ty, ev in events if ty == "add_column"]
+    assert walked == ["delete only", "write only", "public"]
+    tk.must_query("select d from t where a = 1").check([("42",)])
+
+
+def test_add_column_mid_state_dml(tk):
+    """Rows inserted while the column is delete-only/write-only decode
+    under the final schema (write-only inserts store the value; earlier
+    rows materialize the default)."""
+    w = tk.session.domain.ddl_worker
+    seen = []
+
+    def hook(ev, job):
+        if job.type != "add_column":
+            return
+        if ev == "delete only":
+            tk.must_exec("insert into t values (910, 1, 'x')")
+            seen.append(ev)
+        elif ev == "write only":
+            # the column accepts writes but is not yet readable
+            tk.must_exec("insert into t values (911, 2, 'y')")
+            seen.append(ev)
+
+    w.on_event(hook)
+    tk.must_exec("alter table t add column e bigint default 7")
+    assert seen == ["delete only", "write only"]
+    rows = tk.must_query(
+        "select a, e from t where a in (910, 911) order by a").rows
+    assert rows == [("910", "7"), ("911", "7")]
+    tk.must_query("admin check table t").check([])

@@ -439,16 +439,13 @@ class DDLExecutor:
             raise TiDBError(f"Can't DROP '{stmt.index_name}'; check that column/key exists",
                             code=ErrCode.CantDropFieldOrKey)
 
-        def fn(m, job):
-            from .partition import index_phys_ids
-            t = m.get_table(db.id, tbl.id)
-            idx = t.find_index(stmt.index_name)
-            t.indexes = [i for i in t.indexes if i.id != idx.id]
-            m.update_table(db.id, t)
-            for pid in index_phys_ids(t):
-                start, end = tablecodec.index_range(pid, idx.id)
-                sess.store.mvcc.raw_delete_range(start, end)
-        self._run_job(fn, "drop_index", schema_id=db.id, table_id=tbl.id)
+        # ONLINE drop: the worker walks public → write-only → delete-only
+        # → none (ddl_worker.step_drop_index; reference ddl/index.go
+        # onDropIndex) so concurrent txns always see a maintainable state
+        job = self.enqueue_job(
+            "drop_index", schema_id=db.id, table_id=tbl.id,
+            args={"index_name": stmt.index_name})
+        sess.domain.ddl_worker.run_job(job.id)
 
     def alter_table(self, stmt: ast.AlterTableStmt):
         sess = self.session
@@ -536,32 +533,23 @@ class DDLExecutor:
         if tbl.find_column(coldef.name) is not None:
             raise TiDBError(f"Duplicate column name '{coldef.name}'",
                             code=ErrCode.WrongFieldSpec)
-
-        def fn(m, job):
-            t = m.get_table(db.id, tbl.id)
-            t.max_col_id += 1
-            default = None
-            has_default = False
-            if "default" in coldef.options:
-                from .expression import ExprBuilder, Schema
-                e = ExprBuilder(Schema([])).build(coldef.options["default"])
-                default = cast_value(e.eval_scalar(), coldef.ftype)
-                has_default = True
-            ci = ColumnInfo(id=t.max_col_id, name=coldef.name,
-                            offset=len(t.columns), ftype=coldef.ftype,
-                            default_value=default, has_default=has_default)
-            if pos == ("first",):
-                t.columns.insert(0, ci)
-            elif pos and pos[0] == "after":
-                ref = t.find_column(pos[1])
-                t.columns.insert(t.columns.index(ref) + 1, ci)
-            else:
-                t.columns.append(ci)
-            for off, c in enumerate(t.columns):
-                c.offset = off
-            m.update_table(db.id, t)
-        self._run_job(fn, "add_column", schema_id=db.id, table_id=tbl.id)
-        self.session.store.mvcc.bump_table_version(tbl.id)
+        default = None
+        has_default = False
+        if "default" in coldef.options:
+            from .expression import ExprBuilder, Schema
+            e = ExprBuilder(Schema([])).build(coldef.options["default"])
+            default = cast_value(e.eval_scalar(), coldef.ftype)
+            has_default = True
+        ci = ColumnInfo(id=0, name=coldef.name, offset=0,
+                        ftype=coldef.ftype, default_value=default,
+                        has_default=has_default)
+        # ONLINE add: none → delete-only → write-only → public
+        # (ddl_worker.step_add_column; reference ddl/column.go
+        # onAddColumn — no backfill, defaults materialize at read)
+        job = self.enqueue_job(
+            "add_column", schema_id=db.id, table_id=tbl.id,
+            args={"column": ci.to_json(), "pos": list(pos) if pos else None})
+        self.session.domain.ddl_worker.run_job(job.id)
 
     def _alter_modify_column(self, db, tbl, coldef, old_name):
         """MODIFY/CHANGE COLUMN with a synchronous data reorg: every stored

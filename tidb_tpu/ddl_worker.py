@@ -143,12 +143,15 @@ class DDLWorker:
             txn.rollback()
 
     def _run_one(self, job: Job):
-        if job.type == "add_index":
-            while not self.step_add_index(job.id):
-                pass
-            self._signal(job.id, self._job_error(job.id))
-        else:
+        steppers = {"add_index": self.step_add_index,
+                    "drop_index": self.step_drop_index,
+                    "add_column": self.step_add_column}
+        step = steppers.get(job.type)
+        if step is None:
             raise TiDBError(f"worker cannot run job type {job.type}")
+        while not step(job.id):
+            pass
+        self._signal(job.id, self._job_error(job.id))
 
     def _signal(self, job_id: int, err: str | None):
         with self._lock:
@@ -187,6 +190,33 @@ class DDLWorker:
                     if idx is not None and idx.state != SchemaState.PUBLIC:
                         idx_id = idx.id
                         t.indexes = [i for i in t.indexes if i.id != idx.id]
+                        m.update_table(job.schema_id, t)
+                        m.bump_schema_version()
+            elif job.type == "drop_index":
+                # roll FORWARD: past write-only the entries are already
+                # missing for new rows — restoring PUBLIC would serve a
+                # corrupt index, so a failed drop completes the removal
+                t = m.get_table(job.schema_id, job.table_id)
+                if t is not None:
+                    from .partition import index_phys_ids
+                    phys_ids = index_phys_ids(t)
+                    idx = t.find_index(job.args.get("index_name", ""))
+                    if idx is not None:
+                        idx_id = idx.id
+                        t.indexes = [i for i in t.indexes if i.id != idx_id]
+                        m.update_table(job.schema_id, t)
+                        m.bump_schema_version()
+            elif job.type == "add_column":
+                # a half-added (non-public) column must not survive the
+                # cancel — it would be maintained by DML yet unreadable
+                t = m.get_table(job.schema_id, job.table_id)
+                if t is not None:
+                    name = (job.args.get("column") or {}).get("name", "")
+                    col = t.find_column(name)
+                    if col is not None and col.state != SchemaState.PUBLIC:
+                        t.columns = [c for c in t.columns if c is not col]
+                        for off, c in enumerate(t.columns):
+                            c.offset = off
                         m.update_table(job.schema_id, t)
                         m.bump_schema_version()
             job.state = JobState.CANCELLED
@@ -251,6 +281,135 @@ class DDLWorker:
             # or run_pending would peek it forever
             self._cancel_locked(
                 m, job, f"Duplicate key name '{name}'")
+            txn.commit()
+            self.domain.reload_schema()
+            return True
+        except Exception:
+            if txn.valid:
+                txn.rollback()
+            raise
+
+    # -- DROP INDEX state machine (reference: ddl/index.go onDropIndex:
+    #    public → write-only → delete-only → none + delete-range) ---------
+
+    def step_drop_index(self, job_id: int) -> bool:
+        """One state transition of an online DROP INDEX. The walk DOWN the
+        F1 ladder mirrors ADD INDEX's walk up: at write-only the index
+        stops serving reads, at delete-only DML stops inserting entries,
+        then the object disappears and the key range is purged. A drop
+        past write-only only rolls FORWARD (entries are already missing
+        for new rows — restoring PUBLIC would serve a corrupt index)."""
+        store = self.domain.store
+        txn = store.begin()
+        m = Meta(txn)
+        job = next((j for j in m.queued_jobs() if j.id == job_id), None)
+        if job is None:
+            txn.rollback()
+            return True
+        t = m.get_table(job.schema_id, job.table_id)
+        if t is None:
+            self._cancel_locked(m, job, "table dropped during DDL")
+            txn.commit()
+            self.domain.reload_schema()
+            return True
+        idx = t.find_index(job.args["index_name"])
+        if idx is None:  # re-entry after the final step, or never existed
+            job.state = JobState.SYNCED
+            job.schema_state = SchemaState.NONE
+            job.schema_version = m.bump_schema_version()
+            m.finish_job(job)
+            txn.commit()
+            self.domain.reload_schema()
+            return True
+        try:
+            if idx.state == SchemaState.PUBLIC:
+                idx.state = SchemaState.WRITE_ONLY
+                return self._transition(m, txn, job, t,
+                                        SchemaState.WRITE_ONLY)
+            if idx.state == SchemaState.WRITE_ONLY:
+                idx.state = SchemaState.DELETE_ONLY
+                return self._transition(m, txn, job, t,
+                                        SchemaState.DELETE_ONLY)
+            # delete-only → gone: drop the object, purge the key range
+            from .partition import index_phys_ids
+            phys_ids = index_phys_ids(t)
+            idx_id = idx.id
+            t.indexes = [i for i in t.indexes if i.id != idx_id]
+            m.update_table(job.schema_id, t)
+            job.state = JobState.SYNCED
+            job.schema_state = SchemaState.NONE
+            job.schema_version = m.bump_schema_version()
+            m.finish_job(job)
+            txn.commit()
+            for pid in phys_ids:
+                start, end = tablecodec.index_range(pid, idx_id)
+                store.mvcc.raw_delete_range(start, end)
+            self.domain.reload_schema()
+            self._fire("none", job)
+            return True
+        except Exception:
+            if txn.valid:
+                txn.rollback()
+            raise
+
+    # -- ADD COLUMN state machine (reference: ddl/column.go onAddColumn:
+    #    none → delete-only → write-only → public, no backfill — defaults
+    #    materialize at read) --------------------------------------------
+
+    def step_add_column(self, job_id: int) -> bool:
+        from .model import ColumnInfo
+        store = self.domain.store
+        txn = store.begin()
+        m = Meta(txn)
+        job = next((j for j in m.queued_jobs() if j.id == job_id), None)
+        if job is None:
+            txn.rollback()
+            return True
+        t = m.get_table(job.schema_id, job.table_id)
+        if t is None:
+            self._cancel_locked(m, job, "table dropped during DDL")
+            txn.commit()
+            self.domain.reload_schema()
+            return True
+        name = job.args["column"]["name"]
+        col = t.find_column(name)
+        try:
+            if col is None:
+                ci = ColumnInfo.from_json(job.args["column"])
+                t.max_col_id += 1
+                ci.id = t.max_col_id
+                ci.state = SchemaState.DELETE_ONLY
+                pos = job.args.get("pos")
+                if pos == ["first"]:
+                    t.columns.insert(0, ci)
+                elif pos and pos[0] == "after":
+                    ref = t.find_column(pos[1])
+                    t.columns.insert(t.columns.index(ref) + 1, ci)
+                else:
+                    t.columns.append(ci)
+                for off, c in enumerate(t.columns):
+                    c.offset = off
+                return self._transition(m, txn, job, t,
+                                        SchemaState.DELETE_ONLY)
+            if col.state == SchemaState.DELETE_ONLY:
+                col.state = SchemaState.WRITE_ONLY
+                return self._transition(m, txn, job, t,
+                                        SchemaState.WRITE_ONLY)
+            if col.state == SchemaState.WRITE_ONLY:
+                col.state = SchemaState.PUBLIC
+                m.update_table(job.schema_id, t)
+                job.state = JobState.SYNCED
+                job.schema_state = SchemaState.PUBLIC
+                job.schema_version = m.bump_schema_version()
+                m.finish_job(job)
+                txn.commit()
+                store.mvcc.bump_table_version(t.id)
+                self.domain.reload_schema()
+                self._fire("public", job)
+                return True
+            # PUBLIC already (e.g. raced duplicate): leave the queue
+            self._cancel_locked(m, job,
+                                f"Duplicate column name '{name}'")
             txn.commit()
             self.domain.reload_schema()
             return True

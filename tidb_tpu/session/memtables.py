@@ -254,22 +254,34 @@ def _engines(session):
     return cols, rows
 
 
+def processlist_rows(session, max_info=0):
+    """One row per live session of the domain — the single source for
+    SHOW [FULL] PROCESSLIST and information_schema.processlist
+    (reference: executor/show.go fetchShowProcessList)."""
+    import time as _t
+    out = []
+    for s in sorted(session.domain.sessions.values(),
+                    key=lambda s: s.conn_id):
+        running = s.current_sql is not None
+        info = s.current_sql or ""
+        if max_info:
+            info = info[:max_info]
+        out.append((
+            s.conn_id, s.user.encode(), b"localhost",
+            s.current_db().encode(),
+            b"Query" if running else b"Sleep",
+            int(_t.time() - s.stmt_start) if running else 0,
+            b"autocommit" if s.txn is None else b"in transaction",
+            info.encode()))
+    return out
+
+
 def _processlist(session):
     cols = [("id", _I), ("user", _S), ("host", _S), ("db", _S),
             ("command", _S), ("time", _I), ("state", _S), ("info", _S)]
 
     def rows():
-        import time as _t
-        out = []
-        for s in list(session.domain.sessions.values()):
-            running = s.current_sql is not None
-            out.append((
-                s.conn_id, s.user.encode(), b"localhost",
-                s.current_db().encode(),
-                b"Query" if running else b"Sleep",
-                int(_t.time() - s.stmt_start) if running else 0,
-                b"autocommit" if s.txn is None else b"in transaction",
-                (s.current_sql or "").encode()))
+        out = processlist_rows(session)
         return out
     return cols, rows
 

@@ -167,23 +167,10 @@ def exec_show(session, stmt: ast.ShowStmt):
                                             list(COLLATIONS)))
 
     if stmt.kind == "processlist":
-        # same row source as information_schema.processlist: every live
-        # session of the domain, with the in-flight statement (reference:
-        # executor/show.go fetchShowProcessList)
-        import time as _t
-        rows = []
-        for s in sorted(session.domain.sessions.values(),
-                        key=lambda s: s.conn_id):
-            running = s.current_sql is not None
-            info = (s.current_sql or "")
-            if not getattr(stmt, "full", False):
-                info = info[:100]
-            rows.append((s.conn_id, s.user.encode(), b"localhost",
-                         s.current_db().encode(),
-                         b"Query" if running else b"Sleep",
-                         int(_t.time() - s.stmt_start) if running else 0,
-                         b"autocommit" if s.txn is None
-                         else b"in transaction", info.encode()))
+        # same row source as information_schema.processlist
+        from .memtables import processlist_rows
+        rows = processlist_rows(
+            session, max_info=0 if getattr(stmt, "full", False) else 100)
         return Result(names=["Id", "User", "Host", "db", "Command", "Time",
                              "State", "Info"],
                       chunk=Chunk.from_rows([_I, _S, _S, _S, _S, _I, _S, _S],
