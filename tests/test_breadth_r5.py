@@ -74,6 +74,47 @@ class TestNewBuiltins:
         assert len(_DISPATCH) >= 256
 
 
+class TestPlacementPolicies:
+    """Placement policy DDL (reference: ddl/placement_policy.go) —
+    catalog-persisted; with one embedded store the constraints are
+    metadata, not scheduling."""
+
+    def test_create_alter_drop_roundtrip(self, tk):
+        from tidb_tpu.errors import TiDBError, ErrCode
+        tk.must_exec("create placement policy pp1 "
+                     "primary_region='us-east-1' "
+                     "regions='us-east-1,us-west-1' followers=2")
+        rows = tk.must_query(
+            "select policy_name, primary_region, followers from "
+            "information_schema.placement_policies").rows
+        assert ("pp1", "us-east-1", "2") in rows
+        tk.must_exec("alter placement policy pp1 followers=4")
+        rows = tk.must_query(
+            "select followers from information_schema.placement_policies "
+            "where policy_name = 'pp1'").rows
+        assert rows == [("4",)]
+        with pytest.raises(TiDBError) as ei:
+            tk.must_exec("create placement policy pp1 followers=1")
+        assert ei.value.code == ErrCode.PlacementPolicyExists
+        tk.must_exec("create placement policy if not exists pp1 "
+                     "followers=1")  # no-op
+        tk.must_exec("drop placement policy pp1")
+        tk.must_exec("drop placement policy if exists pp1")
+        with pytest.raises(TiDBError) as ei:
+            tk.must_exec("drop placement policy pp1")
+        assert ei.value.code == ErrCode.PlacementPolicyNotExists
+
+    def test_policies_survive_reload(self, tk):
+        tk.must_exec("create placement policy pp2 constraints="
+                     "'[+disk=ssd]'")
+        tk.domain.reload_schema()
+        rows = tk.must_query(
+            "select constraints from information_schema."
+            "placement_policies where policy_name = 'pp2'").rows
+        assert rows == [("[+disk=ssd]",)]
+        tk.must_exec("drop placement policy pp2")
+
+
 class TestGBK:
     """gbk charset + gbk_bin / gbk_chinese_ci collations (reference:
     parser/charset/, util/collate/gbk_chinese_ci.go, gbk_bin.go)."""

@@ -543,6 +543,9 @@ class DDLExecutor:
         ci = ColumnInfo(id=0, name=coldef.name, offset=0,
                         ftype=coldef.ftype, default_value=default,
                         has_default=has_default)
+        if pos and pos[0] == "after" and tbl.find_column(pos[1]) is None:
+            raise TiDBError(f"Unknown column '{pos[1]}' in '{tbl.name}'",
+                            code=ErrCode.BadField)
         # ONLINE add: none → delete-only → write-only → public
         # (ddl_worker.step_add_column; reference ddl/column.go
         # onAddColumn — no backfill, defaults materialize at read)

@@ -55,6 +55,8 @@ class ErrCode:
     MultiplePriKey = 1068
     TooManyKeys = 1069
     UnsupportedDDL = 8214
+    PlacementPolicyExists = 8238
+    PlacementPolicyNotExists = 8239
     CantExecuteInReadOnlyTxn = 1792
     AsOfInTxn = 8135
     InfoSchemaExpired = 8027

@@ -26,6 +26,7 @@ KEY_BINDING_PREFIX = M + b":bind:"   # m:bind:{digest} -> binding json
 KEY_SEQ_PREFIX = M + b":seq:"        # m:seq:{tid} -> last allocated value
 KEY_DELRANGE_PREFIX = M + b":delrange:"  # m:delrange:{id} -> pending range
 KEY_DROPPED_PREFIX = M + b":dropped:"    # m:dropped:{tid} -> dropped table
+KEY_POLICY_PREFIX = M + b":policy:"      # m:policy:{name} -> options json
 
 
 class Meta:
@@ -206,6 +207,31 @@ class Meta:
 
     def set_stats(self, table_id: int, obj):
         self._put_json(KEY_STATS_PREFIX + str(table_id).encode(), obj)
+
+    # -- placement policies (reference: ddl/placement_policy.go; policies
+    #    persist in meta and tables reference them by name — with one
+    #    embedded store the constraints are catalog state, not scheduling)
+
+    def set_placement_policy(self, name: str, options: dict):
+        # lookup is case-insensitive (lowercased key); the created
+        # spelling is preserved for display
+        self._put_json(KEY_POLICY_PREFIX + name.lower().encode(),
+                       {"display": name, "options": options})
+
+    def get_placement_policy(self, name: str):
+        return self._get_json(KEY_POLICY_PREFIX + name.lower().encode(),
+                              None)
+
+    def drop_placement_policy(self, name: str):
+        self.txn.delete(KEY_POLICY_PREFIX + name.lower().encode())
+
+    def placement_policies(self) -> dict:
+        out = {}
+        end = KEY_POLICY_PREFIX + b"\xff"
+        for k, v in self.txn.scan(KEY_POLICY_PREFIX, end):
+            import json as _json
+            out[k[len(KEY_POLICY_PREFIX):].decode()] = _json.loads(v)
+        return out
 
     # -- sequences (reference: meta/autoid SequenceAllocator) ----------------
 

@@ -690,6 +690,29 @@ class CreateBindingStmt(StmtNode):
 
 
 @dataclass(repr=False)
+class CreatePlacementPolicyStmt(StmtNode):
+    name: str = ""
+    if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+    or_alter: bool = False  # ALTER PLACEMENT POLICY reuses the node
+
+    def restore(self):
+        opts = " ".join(f"{k.upper()}={v!r}" for k, v in
+                        self.options.items())
+        verb = "ALTER" if self.or_alter else "CREATE"
+        return f"{verb} PLACEMENT POLICY `{self.name}` {opts}"
+
+
+@dataclass(repr=False)
+class DropPlacementPolicyStmt(StmtNode):
+    name: str = ""
+    if_exists: bool = False
+
+    def restore(self):
+        return f"DROP PLACEMENT POLICY `{self.name}`"
+
+
+@dataclass(repr=False)
 class DropBindingStmt(StmtNode):
     original: object = None
     is_global: bool = False

@@ -1457,6 +1457,17 @@ class Parser:
 
     def _parse_create(self):
         self._expect_kw("create")
+        if self._peek_kws("placement", "policy"):
+            self.pos += 2
+            ine = False
+            if self._accept_kw("if"):
+                self._expect_kw("not")
+                self._expect_kw("exists")
+                ine = True
+            name = self._ident()
+            return ast.CreatePlacementPolicyStmt(
+                name=name, if_not_exists=ine,
+                options=self._parse_placement_options())
         if (self._peek_kw("binding")
                 or self._peek_kws("global", "binding")
                 or self._peek_kws("session", "binding")):
@@ -2012,8 +2023,46 @@ class Parser:
             return ft
         raise ParseError(f"unsupported data type {name!r}")
 
+    def _parse_placement_options(self) -> dict:
+        """PRIMARY_REGION/REGIONS/FOLLOWERS/LEARNERS/SCHEDULE/CONSTRAINTS
+        ... = value pairs (reference: parser placement options grammar)."""
+        opts = {}
+        keys = {"primary_region", "regions", "followers", "learners",
+                "voters", "schedule", "constraints", "leader_constraints",
+                "follower_constraints", "learner_constraints"}
+        while True:
+            t = self._cur()
+            if t.kind != IDENT or t.val.lower() not in keys:
+                break
+            key = t.val.lower()
+            self.pos += 1
+            self._accept_op("=")
+            v = self._cur()
+            if v.kind == STRING:
+                opts[key] = v.val.decode() if isinstance(v.val, bytes) \
+                    else str(v.val)
+            elif v.kind == NUM_INT:
+                opts[key] = int(v.val)
+            else:
+                raise ParseError(f"bad placement option value near {v.val}")
+            self.pos += 1
+            self._accept_op(",")
+        if not opts:
+            # a bare ALTER would otherwise silently wipe every setting
+            raise ParseError(
+                "placement policy requires at least one placement option")
+        return opts
+
     def _parse_drop(self):
         self._expect_kw("drop")
+        if self._peek_kws("placement", "policy"):
+            self.pos += 2
+            ie = False
+            if self._accept_kw("if"):
+                self._expect_kw("exists")
+                ie = True
+            return ast.DropPlacementPolicyStmt(name=self._ident(),
+                                               if_exists=ie)
         if (self._peek_kw("binding")
                 or self._peek_kws("global", "binding")
                 or self._peek_kws("session", "binding")):
@@ -2071,6 +2120,12 @@ class Parser:
 
     def _parse_alter(self):
         self._expect_kw("alter")
+        if self._peek_kws("placement", "policy"):
+            self.pos += 2
+            name = self._ident()
+            return ast.CreatePlacementPolicyStmt(
+                name=name, or_alter=True,
+                options=self._parse_placement_options())
         if self._accept_kw("user"):
             ie = False
             if self._accept_kw("if"):

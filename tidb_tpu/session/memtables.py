@@ -276,6 +276,33 @@ def processlist_rows(session, max_info=0):
     return out
 
 
+def _placement_policies(session):
+    """reference: infoschema placement_policies (ddl/placement_policy.go)."""
+    cols = [("policy_name", _S), ("primary_region", _S), ("regions", _S),
+            ("followers", _I), ("learners", _I), ("schedule", _S),
+            ("constraints", _S)]
+
+    def rows():
+        from ..meta import Meta
+        txn = session.domain.store.begin()
+        try:
+            pols = Meta(txn).placement_policies()
+        finally:
+            txn.rollback()
+        out = []
+        for key, rec in sorted(pols.items()):
+            o = rec.get("options", rec)  # tolerate pre-display records
+            out.append((rec.get("display", key).encode(),
+                        str(o.get("primary_region", "")).encode(),
+                        str(o.get("regions", "")).encode(),
+                        int(o.get("followers", 0) or 0),
+                        int(o.get("learners", 0) or 0),
+                        str(o.get("schedule", "")).encode(),
+                        str(o.get("constraints", "")).encode()))
+        return out
+    return cols, rows
+
+
 def _processlist(session):
     cols = [("id", _I), ("user", _S), ("host", _S), ("db", _S),
             ("command", _S), ("time", _I), ("state", _S), ("info", _S)]
@@ -480,6 +507,7 @@ _TABLES = {
     ("information_schema", "tidb_indexes"): _tidb_indexes,
     ("information_schema", "character_sets"): _character_sets,
     ("information_schema", "collations"): _collations,
+    ("information_schema", "placement_policies"): _placement_policies,
     ("information_schema", "key_column_usage"): _key_column_usage,
     ("information_schema", "slow_query"): _slow_query,
     ("information_schema", "statements_summary"): _statements_summary,

@@ -1250,6 +1250,55 @@ class Session:
             return Result()
         if isinstance(stmt, ast.FlushStmt):
             return Result()
+        if isinstance(stmt, ast.CreatePlacementPolicyStmt):
+            # placement policies persist in meta; tables reference them by
+            # name (reference: ddl/placement_policy.go). With ONE embedded
+            # store the constraints are catalog state — the scheduler role
+            # needs multiple stores — but the DDL surface round-trips.
+            self._implicit_commit()
+            txn = self.store.begin()
+            try:
+                from ..meta import Meta as _Meta
+                m = _Meta(txn)
+                exists = m.get_placement_policy(stmt.name) is not None
+                if exists and not stmt.or_alter:
+                    if stmt.if_not_exists:
+                        txn.rollback()
+                        return Result()
+                    raise TiDBError(
+                        f"Placement policy '{stmt.name}' already exists",
+                        code=ErrCode.PlacementPolicyExists)
+                if stmt.or_alter and not exists:
+                    raise TiDBError(
+                        f"Unknown placement policy '{stmt.name}'",
+                        code=ErrCode.PlacementPolicyNotExists)
+                m.set_placement_policy(stmt.name, stmt.options)
+                txn.commit()
+            except Exception:
+                if txn.valid:
+                    txn.rollback()
+                raise
+            return Result()
+        if isinstance(stmt, ast.DropPlacementPolicyStmt):
+            self._implicit_commit()
+            txn = self.store.begin()
+            try:
+                from ..meta import Meta as _Meta
+                m = _Meta(txn)
+                if m.get_placement_policy(stmt.name) is None:
+                    if stmt.if_exists:
+                        txn.rollback()
+                        return Result()
+                    raise TiDBError(
+                        f"Unknown placement policy '{stmt.name}'",
+                        code=ErrCode.PlacementPolicyNotExists)
+                m.drop_placement_policy(stmt.name)
+                txn.commit()
+            except Exception:
+                if txn.valid:
+                    txn.rollback()
+                raise
+            return Result()
         if isinstance(stmt, ast.KillStmt):
             target = self.domain.sessions.get(stmt.conn_id)
             if target is None:
