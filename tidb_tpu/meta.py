@@ -212,11 +212,13 @@ class Meta:
     #    persist in meta and tables reference them by name — with one
     #    embedded store the constraints are catalog state, not scheduling)
 
-    def set_placement_policy(self, name: str, options: dict):
-        # lookup is case-insensitive (lowercased key); the created
-        # spelling is preserved for display
+    def set_placement_policy(self, name: str, options: dict,
+                             display: str | None = None):
+        # lookup is case-insensitive (lowercased key); the CREATED
+        # spelling is preserved for display (an ALTER passes the existing
+        # record's display so it cannot silently re-case the name)
         self._put_json(KEY_POLICY_PREFIX + name.lower().encode(),
-                       {"display": name, "options": options})
+                       {"display": display or name, "options": options})
 
     def get_placement_policy(self, name: str):
         return self._get_json(KEY_POLICY_PREFIX + name.lower().encode(),
@@ -229,8 +231,7 @@ class Meta:
         out = {}
         end = KEY_POLICY_PREFIX + b"\xff"
         for k, v in self.txn.scan(KEY_POLICY_PREFIX, end):
-            import json as _json
-            out[k[len(KEY_POLICY_PREFIX):].decode()] = _json.loads(v)
+            out[k[len(KEY_POLICY_PREFIX):].decode()] = json.loads(v)
         return out
 
     # -- sequences (reference: meta/autoid SequenceAllocator) ----------------

@@ -993,10 +993,15 @@ def _group_spans(is_new, kept, n, capacity):
     return starts, ends, end_idx, span_sum
 
 
-#: dense-bucket aggregation bound: bucket arrays up to 2^22 slots (the
+#: dense-bucket aggregation bound: bucket arrays up to 2^25 slots (the
 #: packed-key space) are cheaper than one 100k+-element sort on the XLA CPU
-#: backend, where sort lowers to a slow single-threaded path
-_SCATTER_AGG_BITS = 22
+#: backend, where sort lowers to a slow single-threaded path. Bucket
+#: memory scales with the ACTUAL key span (≤ 32M slots ≈ 256MB/array
+#: transient) — a 15M-orderkey GROUP BY (TPC-H Q18's inner agg at SF10)
+#: stays on scatters instead of falling onto the serial sort
+_SCATTER_AGG_BITS = 25
+#: peak bytes the scatter path may hold in bucket arrays at once
+_SCATTER_AGG_BUDGET_BYTES = 1 << 30
 
 
 def _agg_scatter_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
@@ -1116,6 +1121,12 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
     """
     if (pack is not None
             and sum(b for b, _o in pack) <= _SCATTER_AGG_BITS
+            # live bucket arrays scale with the aggregate count (cnt +
+            # rep + per-agg acc + nullable nn caches): bound total BYTES,
+            # not just key bits — five nullable SUMs at 25 bits would
+            # otherwise pin ~2GB of 32M-slot arrays at once
+            and (1 << sum(b for b, _o in pack)) * (len(val_cols) + 3) * 8
+            <= _SCATTER_AGG_BUDGET_BYTES
             and "cnt_dist" not in agg_ops
             and jax.default_backend() == "cpu"):
         # backend-adaptive lowering: dense-bucket scatters beat the XLA CPU

@@ -2027,22 +2027,27 @@ class Parser:
         """PRIMARY_REGION/REGIONS/FOLLOWERS/LEARNERS/SCHEDULE/CONSTRAINTS
         ... = value pairs (reference: parser placement options grammar)."""
         opts = {}
-        keys = {"primary_region", "regions", "followers", "learners",
-                "voters", "schedule", "constraints", "leader_constraints",
-                "follower_constraints", "learner_constraints"}
+        int_keys = {"followers", "learners", "voters"}
+        str_keys = {"primary_region", "regions", "schedule", "constraints",
+                    "leader_constraints", "follower_constraints",
+                    "learner_constraints"}
         while True:
             t = self._cur()
-            if t.kind != IDENT or t.val.lower() not in keys:
+            if t.kind != IDENT or t.val.lower() not in (int_keys | str_keys):
                 break
             key = t.val.lower()
             self.pos += 1
             self._accept_op("=")
             v = self._cur()
-            if v.kind == STRING:
+            if key in int_keys:
+                if v.kind != NUM_INT:
+                    raise ParseError(
+                        f"placement option {key.upper()} requires an "
+                        f"integer value")
+                opts[key] = int(v.val)
+            elif v.kind == STRING:
                 opts[key] = v.val.decode() if isinstance(v.val, bytes) \
                     else str(v.val)
-            elif v.kind == NUM_INT:
-                opts[key] = int(v.val)
             else:
                 raise ParseError(f"bad placement option value near {v.val}")
             self.pos += 1

@@ -1250,55 +1250,14 @@ class Session:
             return Result()
         if isinstance(stmt, ast.FlushStmt):
             return Result()
-        if isinstance(stmt, ast.CreatePlacementPolicyStmt):
+        if isinstance(stmt, (ast.CreatePlacementPolicyStmt,
+                             ast.DropPlacementPolicyStmt)):
             # placement policies persist in meta; tables reference them by
             # name (reference: ddl/placement_policy.go). With ONE embedded
             # store the constraints are catalog state — the scheduler role
             # needs multiple stores — but the DDL surface round-trips.
             self._implicit_commit()
-            txn = self.store.begin()
-            try:
-                from ..meta import Meta as _Meta
-                m = _Meta(txn)
-                exists = m.get_placement_policy(stmt.name) is not None
-                if exists and not stmt.or_alter:
-                    if stmt.if_not_exists:
-                        txn.rollback()
-                        return Result()
-                    raise TiDBError(
-                        f"Placement policy '{stmt.name}' already exists",
-                        code=ErrCode.PlacementPolicyExists)
-                if stmt.or_alter and not exists:
-                    raise TiDBError(
-                        f"Unknown placement policy '{stmt.name}'",
-                        code=ErrCode.PlacementPolicyNotExists)
-                m.set_placement_policy(stmt.name, stmt.options)
-                txn.commit()
-            except Exception:
-                if txn.valid:
-                    txn.rollback()
-                raise
-            return Result()
-        if isinstance(stmt, ast.DropPlacementPolicyStmt):
-            self._implicit_commit()
-            txn = self.store.begin()
-            try:
-                from ..meta import Meta as _Meta
-                m = _Meta(txn)
-                if m.get_placement_policy(stmt.name) is None:
-                    if stmt.if_exists:
-                        txn.rollback()
-                        return Result()
-                    raise TiDBError(
-                        f"Unknown placement policy '{stmt.name}'",
-                        code=ErrCode.PlacementPolicyNotExists)
-                m.drop_placement_policy(stmt.name)
-                txn.commit()
-            except Exception:
-                if txn.valid:
-                    txn.rollback()
-                raise
-            return Result()
+            return self._exec_placement_policy(stmt)
         if isinstance(stmt, ast.KillStmt):
             target = self.domain.sessions.get(stmt.conn_id)
             if target is None:
@@ -1733,6 +1692,44 @@ class Session:
                 "Query execution was interrupted")
 
     # -- misc statements -----------------------------------------------------
+
+    def _exec_placement_policy(self, stmt) -> Result:
+        from ..meta import Meta
+        txn = self.store.begin()
+        try:
+            m = Meta(txn)
+            rec = m.get_placement_policy(stmt.name)
+            if isinstance(stmt, ast.DropPlacementPolicyStmt):
+                if rec is None:
+                    if stmt.if_exists:
+                        txn.rollback()
+                        return Result()
+                    raise TiDBError(
+                        f"Unknown placement policy '{stmt.name}'",
+                        code=ErrCode.PlacementPolicyNotExists)
+                m.drop_placement_policy(stmt.name)
+            else:
+                if rec is not None and not stmt.or_alter:
+                    if stmt.if_not_exists:
+                        txn.rollback()
+                        return Result()
+                    raise TiDBError(
+                        f"Placement policy '{stmt.name}' already exists",
+                        code=ErrCode.PlacementPolicyExists)
+                if stmt.or_alter and rec is None:
+                    raise TiDBError(
+                        f"Unknown placement policy '{stmt.name}'",
+                        code=ErrCode.PlacementPolicyNotExists)
+                display = (rec or {}).get("display") if stmt.or_alter \
+                    else None
+                m.set_placement_policy(stmt.name, stmt.options,
+                                       display=display)
+            txn.commit()
+        except Exception:
+            if txn.valid:
+                txn.rollback()
+            raise
+        return Result()
 
     def _exec_set(self, stmt: ast.SetStmt) -> Result:
         from ..expression import ExprBuilder, Schema
