@@ -218,3 +218,45 @@ def test_import_duplicate_detection(tk, tmp_path):
     log = _os.path.join(d, "_import_conflicts.jsonl")
     recs = [_json.loads(ln) for ln in open(log)]
     assert recs and recs[0]["table"] == "d"
+
+
+def test_dump_snapshot_consistency(tk, tmp_path):
+    """consistency='snapshot' (dumpling's default mode): a write landing
+    MID-DUMP is invisible — every table reads at the one pinned ts."""
+    import time
+    from tidb_tpu.session import new_session
+    tk.must_exec("create table tcons (a bigint)")
+    tk.must_exec("insert into tcons values (1)")
+    time.sleep(0.02)
+
+    def hooked(session, st, db, infos, fmt, out):
+        time.sleep(0.01)
+        s2 = new_session(tk.domain)
+        for _ in s2.execute("use test"):
+            pass
+        for _ in s2.execute("insert into tcons values (99)"):
+            pass
+        return _orig(session, st, db, infos, fmt, out)
+
+    _orig = br._dump_tables
+    br._dump_tables = hooked
+    try:
+        meta = br.dump_database(tk.session, "test", str(tmp_path / "dc"),
+                                fmt="sql")
+    finally:
+        br._dump_tables = _orig
+    t = next(x for x in meta["tables"] if x["name"] == "tcons")
+    assert meta["consistency"] == "snapshot" and meta["snapshot"]
+    assert t["rows"] == 1  # the mid-dump insert is invisible
+    # live reads see both afterwards; the session's snapshot pin is gone
+    tk.must_query("select count(*) from tcons").check([("2",)])
+
+    br._dump_tables = hooked
+    try:
+        meta2 = br.dump_database(tk.session, "test",
+                                 str(tmp_path / "dc2"), fmt="sql",
+                                 consistency="none")
+    finally:
+        br._dump_tables = _orig
+    t2 = next(x for x in meta2["tables"] if x["name"] == "tcons")
+    assert t2["rows"] == 3  # 'none' reads live per statement

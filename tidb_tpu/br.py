@@ -178,18 +178,58 @@ def _write_batch(session, info, batch):
 
 # -- logical dump (reference: dumpling/export/dump.go) ------------------------
 
-def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
+def dump_database(session, db_name: str, dest: str, fmt: str = "sql",
+                  consistency: str = "snapshot") -> dict:
+    """Logical dump (the dumpling role). consistency modes (reference:
+    dumpling/export/consistency.go):
+    - 'snapshot' (default): every table's data SELECT runs at ONE
+      historical read ts (the engine's tidb_snapshot stale-read view) —
+      writes landing mid-dump are invisible, the dump is transactionally
+      consistent across tables;
+    - 'none': each table reads at its own statement snapshot (fastest,
+      per-table consistent only)."""
     if fmt not in ("sql", "csv"):
         raise TiDBError("dump format must be 'sql' or 'csv'")
+    if consistency not in ("snapshot", "none"):
+        raise TiDBError("dump consistency must be 'snapshot' or 'none'")
     infos = session.infoschema()
     if infos.schema_by_name(db_name) is None:
         raise TiDBError(f"Unknown database '{db_name}'")
     st = open_storage(dest)
-    out = {"db": db_name, "tables": []}
+    snap_ts = None
+    prev_snap = None
+    pin_key = None
+    if consistency == "snapshot":
+        snap_ts = session.execute("select now(6)")[-1].rows[0][0]
+        prev_snap = session.get_sysvar("tidb_snapshot")
+        session.execute(f"set tidb_snapshot = '{snap_ts}'")
+        # pin the GC safepoint like backup_database: the stale read holds
+        # no live txn, so without the pin GC could prune the dump's read
+        # view mid-run (error 9006 partway through)
+        read_ts = session.stale_read_ts()
+        coord = session.domain.coordinator
+        pin_key = f"dump-{read_ts}"
+        coord.set_safepoint(pin_key, read_ts)
+    out = {"db": db_name, "tables": [], "consistency": consistency,
+           "snapshot": snap_ts}
     # base tables first, then views in dependency order, so view DDL
     # (which plans its select) can resolve its sources on import; views
     # carry schema only, never INSERT data
     all_infos = _dump_order(infos.tables_in_schema(db_name))
+    try:
+        _dump_tables(session, st, db_name, all_infos, fmt, out)
+    finally:
+        if snap_ts is not None:
+            # restore the CALLER's view, not '' — the session may itself
+            # be inside an explicit stale-read window
+            session.set_sysvar("tidb_snapshot", prev_snap or "")
+        if pin_key is not None:
+            session.domain.coordinator.clear_safepoint(pin_key)
+    st.write_text("metadata.json", json.dumps(out, indent=1))
+    return out
+
+
+def _dump_tables(session, st, db_name, all_infos, fmt, out):
     for info in all_infos:
         base = f"{db_name}.{info.name}"
         create = session.execute(
@@ -225,8 +265,6 @@ def dump_database(session, db_name: str, dest: str, fmt: str = "sql") -> dict:
                               and v.startswith("\\") else v)
                         for v in r])
         out["tables"].append({"name": info.name, "rows": len(rows)})
-    st.write_text("metadata.json", json.dumps(out, indent=1))
-    return out
 
 
 def _dump_order(tables):

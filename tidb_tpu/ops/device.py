@@ -1121,12 +1121,13 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
     """
     if (pack is not None
             and sum(b for b, _o in pack) <= _SCATTER_AGG_BITS
-            # live bucket arrays scale with the aggregate count (cnt +
-            # rep + per-agg acc + nullable nn caches): bound total BYTES,
-            # not just key bits — five nullable SUMs at 25 bits would
-            # otherwise pin ~2GB of 32M-slot arrays at once
-            and (1 << sum(b for b, _o in pack)) * (len(val_cols) + 3) * 8
-            <= _SCATTER_AGG_BUDGET_BYTES
+            # live bucket arrays scale with the aggregate count: cnt +
+            # rep + rank + tgt + live + per-agg acc + nullable nn caches
+            # all stay resident through compaction — bound total BYTES,
+            # not just key bits, or a many-column agg at 25 bits pins
+            # gigabytes of 32M-slot arrays at once
+            and (1 << sum(b for b, _o in pack)) * (2 * len(val_cols) + 6)
+            * 8 <= _SCATTER_AGG_BUDGET_BYTES
             and "cnt_dist" not in agg_ops
             and jax.default_backend() == "cpu"):
         # backend-adaptive lowering: dense-bucket scatters beat the XLA CPU
