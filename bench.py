@@ -331,28 +331,30 @@ def gen_all(tk, sf: float):
             l_linestatus varchar(1), l_shipdate date)""")
     tk.must_exec("""
         create table orders (
-            o_orderkey bigint, o_custkey bigint, o_orderdate date,
+            o_orderkey bigint primary key, o_custkey bigint,
+            o_orderdate date,
             o_shippriority bigint, o_totalprice decimal(15,2))""")
     tk.must_exec("""
         create table customer (
-            c_custkey bigint, c_name varchar(25),
+            c_custkey bigint primary key, c_name varchar(25),
             c_mktsegment varchar(10), c_nationkey bigint)""")
     tk.must_exec("""
         create table supplier (
-            s_suppkey bigint, s_nationkey bigint)""")
+            s_suppkey bigint primary key, s_nationkey bigint)""")
     tk.must_exec("""
         create table part (
-            p_partkey bigint, p_name varchar(55))""")
+            p_partkey bigint primary key, p_name varchar(55))""")
     tk.must_exec("""
         create table partsupp (
             ps_partkey bigint, ps_suppkey bigint,
             ps_supplycost decimal(15,2))""")
     tk.must_exec("""
         create table nation (
-            n_nationkey bigint, n_name varchar(25), n_regionkey bigint)""")
+            n_nationkey bigint primary key, n_name varchar(25),
+            n_regionkey bigint)""")
     tk.must_exec("""
         create table region (
-            r_regionkey bigint, r_name varchar(25))""")
+            r_regionkey bigint primary key, r_name varchar(25))""")
 
     # Paged generation (disk-backed memmap columns) for the big tables at
     # sf >= 5 or BENCH_PAGED=1: the generator writes page batches straight

@@ -1,0 +1,180 @@
+"""Functional-dependency group-key pruning (reference: planner/funcdep/
+fd_graph.go feeding rule_aggregation_elimination.go): GROUP BY keys that
+the remaining keys determine — via a unique key of a joined base table
+plus the inner-join equality closure — demote to first_row() aggregates.
+The Q3/Q18 shapes shrink to a single group key, which keeps the device
+aggregation inside its packed dense-scatter span."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.testkit import TestKit
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec(
+        "create table fo (o_ok bigint primary key, o_ck bigint,"
+        " o_date date, o_prio bigint)")
+    tk.must_exec(
+        "create table fl (l_ok bigint, l_price decimal(15,2),"
+        " l_qty bigint)")
+    tk.must_exec(
+        "create table fc (c_ck bigint primary key, c_name varchar(20),"
+        " c_seg varchar(10))")
+    # no unique key at all on this one
+    tk.must_exec("create table fn (n_id bigint, n_name varchar(20))")
+    # nullable unique: must NOT count as a determining key
+    tk.must_exec(
+        "create table fu (u_id bigint, u_tag bigint,"
+        " unique key uk (u_tag))")
+    rng = np.random.default_rng(3)
+    rows_o, rows_l, rows_c = [], [], []
+    for i in range(1, 101):
+        rows_o.append(
+            f"({i}, {i % 17 + 1}, '199{i % 5}-0{i % 9 + 1}-11', {i % 3})")
+    for i in range(1, 601):
+        ok = int(rng.integers(1, 101))
+        rows_l.append(f"({ok}, {int(rng.integers(100, 9999))}.25,"
+                      f" {int(rng.integers(1, 50))})")
+    for i in range(1, 18):
+        rows_c.append(f"({i}, 'Cust#{i:05d}', 'SEG{i % 4}')")
+    tk.must_exec("insert into fo values " + ",".join(rows_o))
+    tk.must_exec("insert into fl values " + ",".join(rows_l))
+    tk.must_exec("insert into fc values " + ",".join(rows_c))
+    tk.must_exec("insert into fn values (1,'a'),(1,'a'),(2,'b')")
+    tk.must_exec("insert into fu values (1, 10),(2, 20),(3, null),(4, null)")
+    return tk
+
+
+def _agg_line(tk, sql):
+    for name, info in tk.must_query("explain " + sql).rows:
+        if "HashAgg" in name or "StreamAgg" in name:
+            return info
+    return ""
+
+
+Q3ISH = ("select l_ok, sum(l_price) rev, o_date, o_prio "
+         "from fo, fl where l_ok = o_ok "
+         "group by l_ok, o_date, o_prio")
+
+
+def test_q3_shape_prunes_to_one_key(tk):
+    info = _agg_line(tk, Q3ISH)
+    assert "first_row" in info, info
+    # one group key: the orders PK through the join equivalence
+    assert info.count("group by:[") == 1
+    head = info.split("funcs:")[0]
+    assert "o_date" not in head and "o_prio" not in head, info
+
+
+def test_q3_shape_results_match_unpruned(tk):
+    got = sorted(tk.must_query(Q3ISH).rows)
+    # force the unpruned semantics through a no-FD rewrite: group on the
+    # lineitem side only (fl has no unique key, so nothing prunes) and
+    # carry the orders columns through min() — equal because o_ok is
+    # actually unique in the data
+    ref = sorted(tk.must_query(
+        "select l_ok, sum(l_price) rev, min(o_date), min(o_prio) "
+        "from fo, fl where l_ok = o_ok group by l_ok").rows)
+    assert got == ref
+
+
+def test_five_key_q18_shape_prunes_to_pk(tk):
+    sql = ("select c_name, c_ck, o_ok, o_date, sum(l_qty) "
+           "from fc, fo, fl "
+           "where c_ck = o_ck and o_ok = l_ok "
+           "group by c_name, c_ck, o_ok, o_date")
+    info = _agg_line(tk, sql)
+    # o_ok determines o_* (PK), o_ck == c_ck via the join (PK of fc) →
+    # c_name; a single key remains
+    assert info.count("first_row") == 3, info
+    got = sorted(tk.must_query(sql).rows)
+    ref = sorted(tk.must_query(
+        "select min(c_name), min(c_ck), o_ok, min(o_date), sum(l_qty) "
+        "from fc, fo, fl where c_ck = o_ck and o_ok = l_ok "
+        "group by o_ok").rows)
+    assert got == ref
+
+
+def test_no_unique_key_no_pruning(tk):
+    info = _agg_line(
+        tk, "select n_id, n_name, count(1) from fn group by n_id, n_name")
+    assert "first_row" not in info, info
+
+
+def test_nullable_unique_not_determining(tk):
+    # u_tag is unique but nullable: two NULL-tag rows with different u_id
+    # must stay separate groups, so u_id cannot demote
+    sql = "select u_tag, u_id, count(1) from fu group by u_tag, u_id"
+    info = _agg_line(tk, sql)
+    assert "first_row" not in info, info
+    rows = tk.must_query(sql).rows
+    assert len(rows) == 4
+
+
+def test_left_join_condition_adds_no_equivalence(tk):
+    # LEFT JOIN: l_ok = o_ok fails to hold on null-extended rows, so l_ok
+    # must NOT demote through the orders PK; but o_date (right side,
+    # PK-determined on its own table) still may when o_ok is kept
+    sql = ("select l_ok, o_ok, o_date, count(1) from fl "
+           "left join fo on l_ok = o_ok and o_prio = 99 "
+           "group by l_ok, o_ok, o_date")
+    info = _agg_line(tk, sql)
+    head = info.split("funcs:")[0]
+    assert "l_ok" in head and "o_ok" in head, info
+    assert "o_date" not in head, info
+    # parity against the three-key grouping without pruning surface:
+    # o_prio = 99 matches nothing, so every row is null-extended
+    rows = tk.must_query(sql).rows
+    ref = tk.must_query(
+        "select l_ok, count(1) from fl group by l_ok").rows
+    assert sorted((r[0], r[3]) for r in rows) == sorted(ref)
+    assert all(r[1] is None and r[2] is None for r in rows)
+
+
+def test_expression_key_demotes(tk):
+    # year(o_date) is determined by o_ok even though it's an expression
+    sql = ("select l_ok, year(o_date), sum(l_qty) from fo, fl "
+           "where l_ok = o_ok group by l_ok, year(o_date)")
+    info = _agg_line(tk, sql)
+    assert "first_row" in info, info
+    got = sorted(tk.must_query(sql).rows)
+    ref = sorted(tk.must_query(
+        "select l_ok, min(year(o_date)), sum(l_qty) from fo, fl "
+        "where l_ok = o_ok group by l_ok").rows)
+    assert got == ref
+
+
+def test_nondeterministic_key_never_demotes(tk):
+    # rand() is a fresh value per row: no FD determines it, and a
+    # column-free expression must not be vacuously "determined"
+    sql = "select o_date, count(1) from fo group by o_date, rand()"
+    info = _agg_line(tk, sql)
+    assert "first_row" not in info, info
+    assert len(tk.must_query(sql).rows) == 100
+    # deterministic expression over a determined column still may demote
+    sql2 = ("select o_ok, o_prio + 1, count(1) from fo, fl "
+            "where l_ok = o_ok group by o_ok, o_prio + 1")
+    info2 = _agg_line(tk, sql2)
+    assert "first_row" in info2, info2
+    # ...but rand()-tainted expressions never do, even over determined
+    # columns
+    sql3 = ("select o_ok, count(1) from fo, fl where l_ok = o_ok "
+            "group by o_ok, o_prio + rand()")
+    info3 = _agg_line(tk, sql3)
+    assert "first_row" not in info3, info3
+
+
+def test_having_and_order_by_still_work(tk):
+    sql = ("select l_ok, o_date, sum(l_qty) s from fo, fl "
+           "where l_ok = o_ok group by l_ok, o_date "
+           "having sum(l_qty) > 100 order by s desc, l_ok limit 5")
+    rows = tk.must_query(sql).rows
+    ref = tk.must_query(
+        "select l_ok, min(o_date), sum(l_qty) s from fo, fl "
+        "where l_ok = o_ok group by l_ok "
+        "having sum(l_qty) > 100 order by s desc, l_ok limit 5").rows
+    assert rows == ref

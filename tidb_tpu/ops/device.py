@@ -22,6 +22,7 @@ expression/*_vec.go → compile_expr tracing numpy-identical semantics.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -993,15 +994,33 @@ def _group_spans(is_new, kept, n, capacity):
     return starts, ends, end_idx, span_sum
 
 
-#: dense-bucket aggregation bound: bucket arrays up to 2^25 slots (the
+#: dense-bucket aggregation bound: bucket arrays up to 2^26 slots (the
 #: packed-key space) are cheaper than one 100k+-element sort on the XLA CPU
 #: backend, where sort lowers to a slow single-threaded path. Bucket
-#: memory scales with the ACTUAL key span (≤ 32M slots ≈ 256MB/array
-#: transient) — a 15M-orderkey GROUP BY (TPC-H Q18's inner agg at SF10)
-#: stays on scatters instead of falling onto the serial sort
-_SCATTER_AGG_BITS = 25
-#: peak bytes the scatter path may hold in bucket arrays at once
-_SCATTER_AGG_BUDGET_BYTES = 1 << 30
+#: memory scales with the ACTUAL key span, capped by the BYTE budget
+#: below (26 bits + one value column ≈ 4.3GB transient — the budget, not
+#: this constant, is usually the binding bound). A 60M-value l_orderkey
+#: GROUP BY (TPC-H Q18's inner agg at SF10, 26-bit span) stays on O(n)
+#: scatters instead of falling onto the serial sort (measured: the sort
+#: path made SF10 Q18 7x slower than host; the path only exists on the
+#: CPU backend, so the budget sizes against host RAM, not HBM)
+_SCATTER_AGG_BITS = 26
+
+
+def _host_ram_bytes() -> int:
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        return 8 << 30
+
+
+#: peak bytes the scatter path may hold in bucket arrays at once —
+#: a quarter of physical RAM, capped at 6GB: the buckets live inside XLA
+#: where the engine's quota tracker can't see them, so the bound must
+#: come from the machine, not a constant (a 26-bit span with one value
+#: column pins ~4.3GB transient; on a small host that must divert to
+#: the sort path instead of inviting the OOM killer)
+_SCATTER_AGG_BUDGET_BYTES = min(6 << 30, max(_host_ram_bytes() // 4, 1 << 30))
 
 
 def _agg_scatter_impl(key_cols, key_nulls, val_cols, val_nulls, mask,

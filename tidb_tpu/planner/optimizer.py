@@ -5,6 +5,7 @@ reorder, column pruning; constant folding happens at expression build time)."""
 from __future__ import annotations
 
 from ..expression import Column, Schema
+from ..expression.aggregation import AggFuncDesc
 from ..expression.core import ScalarFunc
 from .logical import (
     Aggregation, DataSource, Dual, Join, Limit, LogicalPlan, MemSource,
@@ -37,6 +38,8 @@ def optimize(plan: LogicalPlan, ctx=None, trace=None) -> LogicalPlan:
     step("max_min_elimination", plan)
     plan = reorder_joins(plan, ctx)
     step("join_reorder", plan)
+    plan = prune_group_keys(plan, ctx)
+    step("group_key_pruning", plan)
     plan = prune_columns(plan)
     step("column_pruning", plan)
     plan = pull_proj_through_semi(plan)
@@ -152,25 +155,7 @@ def eliminate_aggregation(plan: LogicalPlan, ctx=None) -> LogicalPlan:
     def has_unique_key(ds, col_idxs):
         names = {ds.col_infos[i].name for i in col_idxs
                  if i < len(ds.col_infos)}
-        info = ds.table_info
-        if info.pk_is_handle:
-            pk = next((c.name for c in info.columns
-                       if c.id == info.pk_col_id), None)
-            if pk in names:
-                return True
-        from ..model import SchemaState
-        # NULLABLE unique columns don't prove single-row groups: unique
-        # indexes admit any number of NULL rows (SQL semantics; the dup
-        # check skips NULL keys), so every key column must be NOT NULL
-        not_null = {c.name for c in info.columns
-                    if c.ftype is not None and c.ftype.not_null}
-        for idx in info.indexes:
-            if (idx.unique and idx.columns
-                    and idx.state == SchemaState.PUBLIC
-                    and all(c.name in names and c.name in not_null
-                            for c in idx.columns)):
-                return True
-        return False
+        return any(ks <= names for ks in _unique_keysets(ds.table_info))
 
     def visit(p):
         for i, c in enumerate(p.children):
@@ -201,6 +186,250 @@ def eliminate_aggregation(plan: LogicalPlan, ctx=None) -> LogicalPlan:
             else:
                 exprs.append(ScalarFunc("cast", [arg], d.ftype))
         return Projection(p.children[0], exprs, p.schema)
+
+    return visit(plan)
+
+
+def _unique_keysets(info, require_not_null=True):
+    """Frozenset column-name sets each proven unique on the table: the
+    int handle PK, and PUBLIC unique indexes (non-PUBLIC ones may still
+    hold duplicates mid-backfill). With require_not_null (the FD /
+    agg-elimination case) every index column must be NOT NULL — a
+    nullable unique index admits any number of all-NULL rows, which are
+    distinct groups. Join-match uniqueness (right_unique) doesn't need
+    it: NULL keys never equi-match, so duplicate NULL rows can't fan
+    out a join. Shared by eliminate_aggregation, eliminate_outer_joins
+    and prune_group_keys so uniqueness semantics stay in one place."""
+    from .. import model as _model
+    out = []
+    if info.pk_is_handle:
+        pk = next((c.name for c in info.columns
+                   if c.id == info.pk_col_id), None)
+        if pk:
+            out.append(frozenset([pk]))
+    not_null = {c.name for c in info.columns
+                if c.ftype is not None and c.ftype.not_null}
+    for idx in info.indexes:
+        if (idx.unique and idx.columns
+                and idx.state == _model.SchemaState.PUBLIC
+                and (not require_not_null
+                     or all(c.name in not_null for c in idx.columns))):
+            out.append(frozenset(c.name for c in idx.columns))
+    return out
+
+
+def _col_eq_pair(cond, colmap):
+    """(base_a, base_b) when `cond` is eq(Column, Column) with both sides
+    resolving to base-table columns; else None."""
+    if (not isinstance(cond, ScalarFunc) or cond.op != "eq"
+            or len(cond.args) != 2):
+        return None
+    a, b = cond.args
+    if not (isinstance(a, Column) and isinstance(b, Column)):
+        return None
+    if a.idx >= len(colmap) or b.idx >= len(colmap):
+        return None
+    ba, bb = colmap[a.idx], colmap[b.idx]
+    return (ba, bb) if ba is not None and bb is not None else None
+
+
+def _base_col_info(node):
+    """Walk `node`'s tree collecting (colmap, tables, equivs):
+    colmap[i] = (id(ds), col_name) when output position i forwards a base
+    column unchanged (None otherwise); tables = {id(ds): ds} for every
+    DataSource whose rows survive into the output row-wise (so per-table
+    FDs hold on the output); equivs = [(base, base)] pairs equal on every
+    output row (INNER-join equi keys and selection col=col filters only —
+    an outer join's null-extended rows break condition equalities, but not
+    either side's own key→column dependencies)."""
+    if isinstance(node, DataSource):
+        dsid = id(node)
+        return ([(dsid, ci.name) for ci in node.col_infos],
+                {dsid: node}, [])
+    if isinstance(node, Selection):
+        colmap, tables, eq = _base_col_info(node.child)
+        for c in node.conds:
+            pr = _col_eq_pair(c, colmap)
+            if pr:
+                eq.append(pr)
+        return colmap, tables, eq
+    if isinstance(node, Projection):
+        cm, tables, eq = _base_col_info(node.child)
+        colmap = [cm[e.idx] if isinstance(e, Column) and e.idx < len(cm)
+                  else None for e in node.exprs]
+        return colmap, tables, eq
+    if isinstance(node, Join):
+        lcm, lt, leq = _base_col_info(node.left)
+        if node.kind in ("semi", "anti", "leftouter_semi"):
+            # right side absent from the output schema (the mark column
+            # of leftouter_semi pads with None)
+            pad = len(node.schema) - len(lcm)
+            return lcm + [None] * max(pad, 0), lt, leq
+        rcm, rt, req = _base_col_info(node.right)
+        colmap = lcm + rcm
+        tables = {**lt, **rt}
+        eq = leq + req
+        if node.kind == "inner":
+            for le, re_ in zip(node.left_keys, node.right_keys):
+                if (isinstance(le, Column) and le.idx < len(lcm)
+                        and isinstance(re_, Column) and re_.idx < len(rcm)):
+                    a, b = lcm[le.idx], rcm[re_.idx]
+                    if a is not None and b is not None:
+                        eq.append((a, b))
+            for c in node.other_conds:
+                pr = _col_eq_pair(c, colmap)
+                if pr:
+                    eq.append(pr)
+        return colmap, tables, eq
+    # Aggregation / set ops / window / …: opaque boundary
+    return [None] * len(node.schema), {}, []
+
+
+def _det_cols(e):
+    """Column idx set of `e` when every node is a deterministic
+    Column/Constant/ScalarFunc; None when any node is nondeterministic
+    (rand()/uuid() — a fresh value per row that no FD determines) or of
+    an unknown kind (subquery apply, outer ref)."""
+    from ..expression.builder import _NONDETERMINISTIC
+    from ..expression.core import Constant
+    out = set()
+
+    def walk(x):
+        if isinstance(x, Column):
+            out.add(x.idx)
+            return True
+        if isinstance(x, Constant):
+            return True
+        if isinstance(x, ScalarFunc):
+            if x.op in _NONDETERMINISTIC:
+                return False
+            return all(walk(a) for a in x.args)
+        return False
+
+    return out if walk(e) else None
+
+
+def _fd_closure(seed, tables, equivs, keysets):
+    """Fixpoint of: equivalence propagation + (unique keyset covered →
+    every column of that table is determined)."""
+    det = set(seed)
+    changed = True
+    while changed:
+        changed = False
+        for a, b in equivs:
+            if a in det and b not in det:
+                det.add(b)
+                changed = True
+            if b in det and a not in det:
+                det.add(a)
+                changed = True
+        for dsid, ds in tables.items():
+            names = {n for (i, n) in det if i == dsid}
+            for ks in keysets.get(dsid, ()):
+                if ks <= names:
+                    new = {(dsid, c.name) for c in ds.table_info.columns}
+                    if not new <= det:
+                        det |= new
+                        changed = True
+                    break
+    return det
+
+
+def prune_group_keys(plan: LogicalPlan, ctx=None) -> LogicalPlan:
+    """Functional-dependency group-key pruning (reference: the FD engine
+    planner/funcdep/fd_graph.go feeding rule_aggregation_elimination.go):
+    a GROUP BY key whose value is determined by the remaining keys —
+    through a base table's unique key plus the inner-join equality
+    closure — cannot split any group, so it demotes to a first_row()
+    aggregate and the key set shrinks.
+
+    TPC-H Q3 groups by (l_orderkey, o_orderdate, o_shippriority): with
+    o_orderkey the orders handle PK and l_orderkey ≡ o_orderkey from the
+    join, both orders columns demote — the device kernel then packs ONE
+    26-bit key instead of a 39-bit triple, which keeps the dense-scatter
+    aggregation path in range. Q18's five keys shrink to o_orderkey alone.
+
+    Output positions are preserved by a Projection over the rewritten
+    Aggregation (kept keys first, then original aggs, then the demoted
+    first_rows), so HAVING/TopN above see an identical schema; TopN's
+    candidate-fetch annotation already looks through pure projections."""
+    def visit(p):
+        for i, c in enumerate(p.children):
+            p.children[i] = visit(c)
+        if not isinstance(p, Aggregation) or len(p.group_exprs) < 2:
+            return p
+        child = p.children[0]
+        colmap, tables, equivs = _base_col_info(child)
+        if not tables:
+            return p
+        keysets = {dsid: _unique_keysets(ds.table_info)
+                   for dsid, ds in tables.items()}
+        if not any(keysets.values()):
+            return p
+
+        def key_bases(e):
+            """Base columns a group key needs determined to be droppable:
+            [base] for a bare column, every referenced column's base for
+            an expression; None when any part is untraceable — including
+            nondeterministic or opaque nodes (rand() yields a fresh value
+            per row, so no FD can ever determine it; subquery applies and
+            outer refs are equally beyond the closure) and column-free
+            expressions (conservative: folding already turned genuine
+            constants into Constant nodes)."""
+            if isinstance(e, Column):
+                b = colmap[e.idx] if e.idx < len(colmap) else None
+                return None if b is None else [b]
+            idxs = _det_cols(e)
+            if not idxs:
+                return None
+            out = []
+            for i in idxs:
+                b = colmap[i] if i < len(colmap) else None
+                if b is None:
+                    return None
+                out.append(b)
+            return out
+
+        bases = [key_bases(e) for e in p.group_exprs]
+        kept = list(range(len(p.group_exprs)))
+        dropped = []
+        for j in range(len(p.group_exprs)):
+            if bases[j] is None or len(kept) <= 1:
+                continue
+            rest = [k for k in kept if k != j]
+            # only bare-column keys seed the closure: knowing f(x)
+            # does not determine x
+            seed = {bases[k][0] for k in rest
+                    if bases[k] and isinstance(p.group_exprs[k], Column)}
+            det = _fd_closure(seed, tables, equivs, keysets)
+            if all(b in det for b in bases[j]):
+                kept = rest
+                dropped.append(j)
+        if not dropped:
+            return p
+        new_keys = [p.group_exprs[k] for k in kept]
+        new_aggs = list(p.aggs) + [
+            AggFuncDesc("first_row", [p.group_exprs[j]]) for j in dropped]
+        refs = ([p.schema.refs[k] for k in kept]
+                + p.schema.refs[len(p.group_exprs):]
+                + [p.schema.refs[j] for j in dropped])
+        new_agg = Aggregation(child, new_keys, new_aggs, Schema(refs))
+        new_agg.agg_hint = p.agg_hint
+        s, a = len(kept), len(p.aggs)
+        pos = {}
+        for np_, j in enumerate(kept):
+            pos[j] = np_
+        for np_, j in enumerate(dropped):
+            pos[j] = s + a + np_
+        exprs = []
+        for old in range(len(p.schema)):
+            if old < len(p.group_exprs):
+                new_idx = pos[old]
+            else:
+                new_idx = s + (old - len(p.group_exprs))
+            r = p.schema.refs[old]
+            exprs.append(Column(new_idx, r.ftype, r.name))
+        return Projection(new_agg, exprs, p.schema)
 
     return visit(plan)
 
@@ -253,17 +482,10 @@ def eliminate_outer_joins(plan: LogicalPlan) -> LogicalPlan:
             if not isinstance(k, Column) or k.idx >= len(ds.col_infos):
                 return False
             names.add(ds.col_infos[k.idx].name)
-        info = ds.table_info
-        if info.pk_is_handle:
-            pk = next((c.name for c in info.columns
-                       if c.id == info.pk_col_id), None)
-            if pk is not None and pk in names:
-                return True
-        for idx in info.indexes:
-            if (idx.unique and idx.columns
-                    and all(c.name in names for c in idx.columns)):
-                return True
-        return False
+        # NULL right keys never equi-match, so nullable unique still
+        # caps the match count at one — require_not_null off
+        return any(ks <= names for ks in
+                   _unique_keysets(ds.table_info, require_not_null=False))
 
     def visit(p, needed):
         if isinstance(p, Join):
@@ -360,8 +582,10 @@ def _annotate_topn_agg(topn: TopN) -> None:
         if e.idx >= len(node.group_exprs):
             a = node.aggs[e.idx - len(node.group_exprs)]
             # avg/variance are derived from two slots post-fetch; their
-            # order isn't available on-device — leave those unfetched
-            if a.name not in ("sum", "min", "max", "count"):
+            # order isn't available on-device — leave those unfetched.
+            # first_row (incl. group keys prune_group_keys demoted) IS a
+            # materialized per-group slot, so ordering by it works
+            if a.name not in ("sum", "min", "max", "count", "first_row"):
                 return
         specs.append((e.idx, bool(desc)))
     k = topn.offset + topn.count
