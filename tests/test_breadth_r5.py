@@ -17,6 +17,27 @@ def tk():
 
 
 class TestNewBuiltins:
+    def test_vitess_hash(self, tk):
+        # vitess' published shard-hash vectors (util/vitess/vitess_hash.go:
+        # DES-ECB, null key, big-endian uint64)
+        assert tk.must_query("select vitess_hash(1)").rows == [
+            ("1615456034434468822",)]  # 0x166b40b44aba4bd6
+        assert tk.must_query("select vitess_hash(0)").rows == [
+            ("10134873677816210343",)]  # uint64 render, not negative
+        assert tk.must_query("select vitess_hash(null)").rows == [(None,)]
+
+    def test_encode_decode_roundtrip(self, tk):
+        rows = tk.must_query(
+            "select decode(encode('secret stuff', 'pw'), 'pw'),"
+            " encode('abc', 'k') = 'abc'").rows
+        assert rows == [("secret stuff", "0")]
+        assert tk.must_query(
+            "select decode(null, 'pw'), encode('a', null)").rows == [
+                (None, None)]
+
+    def test_current_role_without_set_role(self, tk):
+        assert tk.must_query("select current_role()").rows == [("NONE",)]
+
     def test_translate(self, tk):
         assert tk.must_query(
             "select translate('abcab', 'ab', 'xy')").rows == [("xycxy",)]

@@ -33,9 +33,23 @@ _jax.config.update("jax_enable_x64", True)
 _cache_dir = _os.environ.get("TIDB_TPU_JAX_CACHE", "")
 if _cache_dir != "off":
     if not _cache_dir:
+        # the XLA:CPU cache key ignores host CPU features: an AOT entry
+        # compiled on a different machine (or by a different jax) loads
+        # here with a "could lead to SIGILL" warning and mis-tuned code.
+        # Scope the default dir by a host fingerprint so such entries
+        # can never be picked up.
+        try:
+            import hashlib as _hl
+            with open("/proc/cpuinfo") as _f:
+                _flags = next((ln for ln in _f if ln.startswith("flags")),
+                              "")
+            _fp = _hl.sha1(
+                (_flags + _jax.__version__).encode()).hexdigest()[:12]
+        except OSError:
+            _fp = "default"
         _cache_dir = _os.path.join(
             _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-            ".jaxcache")
+            ".jaxcache", _fp)
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)

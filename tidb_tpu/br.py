@@ -93,6 +93,172 @@ def backup_database(session, db_name: str, dest: str) -> dict:
     return meta
 
 
+# -- physical backup / restore (reference: br/pkg/backup's SST export +
+#    br/pkg/lightning/backend/local pebble-SST build-and-ingest). The
+#    engine's native on-disk unit is the MVCC KV snapshot itself: backup
+#    streams every committed (key, value) under the table prefix —
+#    records AND index entries — as length-prefixed binary with a
+#    per-file sha256; restore rewrites the 8-byte table/partition id in
+#    each key (BR's rewrite rules, br/pkg/restore/util.go) and ingests
+#    via raw_batch_put, bypassing SQL, rowcodec decode and index
+#    rebuild entirely. ----------------------------------------------------
+
+def physical_backup_database(session, db_name: str, dest: str) -> dict:
+    import hashlib
+    import struct
+    infos = session.infoschema()
+    if infos.schema_by_name(db_name) is None:
+        raise TiDBError(f"Unknown database '{db_name}'")
+    st = open_storage(dest)
+    txn = session.store.begin()
+    coord = getattr(session.domain, "coordinator", None)
+    pin_key = f"br-{txn.start_ts}"
+    if coord is not None:
+        coord.set_safepoint(pin_key, txn.start_ts)
+    meta = {"db": db_name, "ts": txn.start_ts, "mode": "physical",
+            "created": time.strftime("%Y-%m-%d %H:%M:%S"), "tables": []}
+    try:
+        for info in infos.tables_in_schema(db_name):
+            base = f"{db_name}.{info.name}"
+            payload = info.to_json()
+            st.write_text(base + ".schema.json",
+                          payload if isinstance(payload, str)
+                          else json.dumps(payload))
+            ids = [info.id]
+            if info.partition is not None:
+                ids += [d.id for d in info.partition.defs]
+            n = 0
+            n_rows = 0
+            sha = hashlib.sha256()
+            nbytes = 0
+            with st.open_write_bytes(base + ".kv.bin") as f:
+                for pid in sorted(set(ids)):
+                    # the full physical-id prefix covers record AND index
+                    # keyspaces in one ordered scan
+                    p = tablecodec.TABLE_PREFIX + tablecodec._enc_i64(pid)
+                    for key, value in txn.scan(p, p + b"\xff" * 24):
+                        rec = struct.pack("<II", len(key), len(value))
+                        f.write(rec)
+                        f.write(key)
+                        f.write(value)
+                        sha.update(rec)
+                        sha.update(key)
+                        sha.update(value)
+                        nbytes += 8 + len(key) + len(value)
+                        n += 1
+                        if key[9:11] == tablecodec.RECORD_SEP:
+                            n_rows += 1
+            meta["tables"].append({"name": info.name, "rows": n_rows,
+                                   "kv": n, "bytes": nbytes,
+                                   "sha256": sha.hexdigest(),
+                                   "ids": sorted(set(ids))})
+    finally:
+        txn.rollback()
+        if coord is not None:
+            coord.clear_safepoint(pin_key)
+    st.write_text("backupmeta.json", json.dumps(meta, indent=1))
+    return meta
+
+
+#: keys ingested per raw_batch_put call (bounds peak batch memory)
+_INGEST_BATCH = 4096
+
+
+def physical_restore_database(session, src: str,
+                              db_name: str | None = None,
+                              meta: dict | None = None) -> dict:
+    import hashlib
+    import struct
+    st = open_storage(src)
+    if meta is None:  # the session layer passes its already-parsed copy
+        meta = json.loads(st.read_text("backupmeta.json"))
+    if meta.get("mode") != "physical":
+        raise TiDBError("backup at this path is not a physical backup")
+    target_db = db_name or meta["db"]
+    if session.infoschema().schema_by_name(target_db) is None:
+        session.execute(f"create database `{target_db}`")
+    mvcc = session.store.mvcc
+    restored = []
+    for t in meta["tables"]:
+        base = f"{meta['db']}.{t['name']}"
+        raw = st.read_text(base + ".schema.json")
+        info = TableInfo.from_json(json.loads(raw)
+                                   if raw.lstrip().startswith("{")
+                                   else raw)
+        if session.infoschema().has_table(target_db, info.name):
+            raise TiDBError(f"table '{target_db}.{info.name}' already "
+                            f"exists; drop it before RESTORE")
+        # pass 1 — verify the stream checksum BEFORE any ingest: corrupt
+        # data must never become readable, even transiently (reference:
+        # BR validates SST checksums before ingest)
+        sha = hashlib.sha256()
+        with st.open_read_bytes(base + ".kv.bin") as f:
+            while True:
+                blk = f.read(1 << 20)
+                if not blk:
+                    break
+                sha.update(blk)
+        if sha.hexdigest() != t["sha256"]:
+            raise TiDBError(f"checksum mismatch restoring {base}: "
+                            f"backup is corrupt")
+        _create_from_info(session, target_db, info)
+        new_info = session.infoschema().table_by_name(target_db, info.name)
+        # rewrite rules: source physical id -> restored physical id
+        # (partition defs keep their order through the catalog round-trip)
+        id_map = {info.id: new_info.id}
+        if info.partition is not None:
+            for od, nd in zip(info.partition.defs,
+                              new_info.partition.defs):
+                id_map[od.id] = nd.id
+        commit_ts = session.store.next_ts()
+        n = 0
+        batch = []
+        try:
+            with st.open_read_bytes(base + ".kv.bin") as f:
+                while True:
+                    hdr = f.read(8)
+                    if not hdr:
+                        break
+                    klen, vlen = struct.unpack("<II", hdr)
+                    key = f.read(klen)
+                    value = f.read(vlen)
+                    if len(key) != klen or len(value) != vlen:
+                        raise TiDBError(
+                            f"truncated kv stream in {base}.kv.bin")
+                    old_id = tablecodec._dec_i64(key[1:9])
+                    new_id = id_map.get(old_id)
+                    if new_id is None:
+                        raise TiDBError(f"kv key for unknown physical id "
+                                        f"{old_id} in {base}.kv.bin")
+                    batch.append((tablecodec.TABLE_PREFIX
+                                  + tablecodec._enc_i64(new_id) + key[9:],
+                                  value))
+                    if key[9:11] == tablecodec.RECORD_SEP:
+                        n += 1
+                    if len(batch) >= _INGEST_BATCH:
+                        mvcc.raw_batch_put(batch, commit_ts)
+                        batch = []
+            if batch:
+                mvcc.raw_batch_put(batch, commit_ts)
+        except Exception:
+            # sweep ingested versions AND the table the failed restore
+            # itself created, so a retry isn't blocked by 'already
+            # exists' (reference: restore rolls back downloaded-SST
+            # state)
+            for nid in id_map.values():
+                p = tablecodec.TABLE_PREFIX + tablecodec._enc_i64(nid)
+                mvcc.raw_delete_range(p, p + b"\xff" * 24)
+            try:
+                session.execute(
+                    f"drop table `{target_db}`.`{info.name}`")
+            except Exception:
+                pass  # surfacing the original failure matters more
+            raise
+        mvcc.bump_table_version(new_info.id, commit_ts)
+        restored.append({"name": info.name, "rows": n})
+    return {"db": target_db, "tables": restored, "mode": "physical"}
+
+
 # -- restore (reference: br/pkg/task/restore.go) -----------------------------
 
 def restore_database(session, src: str, db_name: str | None = None) -> dict:

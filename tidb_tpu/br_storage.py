@@ -60,14 +60,80 @@ class ExternalStorage:
         outer = self
 
         class _Buf(__import__("io").StringIO):
+            _aborted = False
+
+            def __exit__(self, et, ev, tb):
+                self._aborted = et is not None
+                return super().__exit__(et, ev, tb)
+
             def close(self):
-                outer.write_text(name, self.getvalue())
+                if not self._aborted:
+                    outer.write_text(name, self.getvalue())
                 super().close()
         return _Buf()
 
     def open_read(self, name: str):
         import io as _io
         return _io.StringIO(self.read_text(name))
+
+    # binary streaming (physical backup payloads): same buffering default,
+    # byte-typed. Publish-on-clean-exit: leaving the with-block on an
+    # exception must NOT commit a truncated object over a previous good
+    # one (write_file's atomic-publish contract).
+    def open_write_bytes(self, name: str):
+        outer = self
+
+        class _Buf(__import__("io").BytesIO):
+            _aborted = False
+
+            def __exit__(self, et, ev, tb):
+                self._aborted = et is not None
+                return super().__exit__(et, ev, tb)
+
+            def close(self):
+                if not self._aborted:
+                    outer.write_file(name, self.getvalue())
+                super().close()
+        return _Buf()
+
+    def open_read_bytes(self, name: str):
+        import io as _io
+        return _io.BytesIO(self.read_file(name))
+
+
+class _PublishOnClose:
+    """File proxy: atomic-publish on clean close, discard on aborted
+    with-block."""
+
+    def __init__(self, f, tmp, path):
+        self._f, self._tmp, self._path = f, tmp, path
+        self._aborted = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        self._aborted = et is not None
+        self.close()
+        return False
+
+    def write(self, data):
+        return self._f.write(data)
+
+    def __getattr__(self, attr):
+        return getattr(self._f, attr)
+
+    def close(self):
+        if self._f.closed:
+            return
+        self._f.close()
+        if self._aborted:
+            try:
+                os.remove(self._tmp)
+            except FileNotFoundError:
+                pass
+        else:
+            os.replace(self._tmp, self._path)
 
 
 class LocalStorage(ExternalStorage):
@@ -108,21 +174,29 @@ class LocalStorage(ExternalStorage):
         except FileNotFoundError:
             pass
 
-    def open_write(self, name):
+    def _open_write_publish(self, name, mode):
+        """Stream into name.tmp; os.replace to the final name ONLY on a
+        clean close — a with-block unwinding on an exception discards the
+        partial file instead of clobbering a previous good object. A
+        wrapper class, not instance monkey-patching: `with` looks
+        __exit__ up on the TYPE, so an instance attribute would never
+        fire."""
         path = self._p(name)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
-        f = open(tmp, "w")
-        orig_close = f.close
+        return _PublishOnClose(open(tmp, mode), tmp, path)
 
-        def close():
-            orig_close()
-            os.replace(tmp, path)
-        f.close = close
-        return f
+    def open_write(self, name):
+        return self._open_write_publish(name, "w")
 
     def open_read(self, name):
         return open(self._p(name), "r")
+
+    def open_write_bytes(self, name):
+        return self._open_write_publish(name, "wb")
+
+    def open_read_bytes(self, name):
+        return open(self._p(name), "rb")
 
 
 class MemStorage(ExternalStorage):

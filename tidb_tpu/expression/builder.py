@@ -233,7 +233,8 @@ _STR_FUNCS = {"concat", "concat_ws", "upper", "lower", "substring", "trim",
               "tidb_parse_tso", "tidb_decode_key", "format_nano_time",
               "master_pos_wait", "date_arith_fn", "substr", "sha",
               "gtid_subtract", "tidb_encode_sql_digest", "translate",
-              "tidb_bounded_staleness", "tidb_decode_plan"}
+              "tidb_bounded_staleness", "tidb_decode_plan",
+              "encode", "decode"}
 _INT_FUNCS = {"length", "char_length", "character_length", "locate",
               "istrue_with_null", "year", "month", "day",
               "dayofmonth", "hour", "minute", "second", "quarter", "week",
@@ -255,7 +256,7 @@ _INT_FUNCS = {"length", "char_length", "character_length", "locate",
               "release_lock", "is_free_lock", "is_used_lock",
               "tidb_is_ddl_owner", "tidb_shard", "gtid_subset",
               "release_all_locks", "ps_current_thread_id",
-              "wait_for_executed_gtid_set"}
+              "wait_for_executed_gtid_set", "vitess_hash"}
 _FLOAT_FUNCS = {"sqrt", "exp", "ln", "log2", "log10", "pow", "power", "rand",
                 "radians", "degrees", "sin", "cos", "tan", "atan", "asin",
                 "acos", "pi", "atan2", "cot", "log"}
@@ -727,6 +728,11 @@ class ExprBuilder:
         if name in ("user", "current_user", "session_user", "system_user"):
             u = self.ctx.current_user() if self.ctx is not None else "root@%"
             return Constant(u.encode(), FieldType(tp=TYPE_VARCHAR))
+        if name == "current_role":
+            # no SET ROLE support: the active-role list is always empty,
+            # which MySQL renders as NONE (reference:
+            # expression/builtin_info.go builtinCurrentRoleSig)
+            return Constant(b"NONE", FieldType(tp=TYPE_VARCHAR))
         if name == "unix_timestamp" and not node.args:
             import datetime as _dt2
             now = (self.ctx.now() if self.ctx is not None
@@ -852,6 +858,11 @@ class ExprBuilder:
             ft = FieldType(tp=TYPE_VARCHAR)
         elif name in _INT_FUNCS:
             ft = FieldType(tp=TYPE_LONGLONG)
+            if name == "vitess_hash":
+                # a uint64 shard hash: stored wrapped in int64, rendered
+                # back through the unsigned flag
+                from ..sqltypes import FLAG_UNSIGNED
+                ft.flag |= FLAG_UNSIGNED
         elif name in _FLOAT_FUNCS:
             ft = FieldType(tp=TYPE_DOUBLE)
         elif name == "date":

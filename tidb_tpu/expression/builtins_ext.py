@@ -147,6 +147,78 @@ def _insert_fn(s, pos, ln, news):
     return s[:pos - 1] + news + s[pos - 1 + max(ln, 0):]
 
 
+class _SqlCrypt:
+    """ENCODE()/DECODE() stream cipher (reference: util/encrypt/crypt.go —
+    MySQL's pre-8.0 obfuscation: a password-seeded pair of LCGs drives a
+    255-entry substitution box plus a running xor shift). Kept for SQL
+    compatibility only; not secure."""
+
+    def __init__(self, password: bytes):
+        nr, add, nr2 = 1345345333, 7, 0x12345671
+        for ch in password:
+            if ch in (0x20, 0x09):
+                continue
+            nr ^= (((nr & 63) + add) * ch + (nr << 8)) & 0xFFFFFFFF
+            nr &= 0xFFFFFFFF
+            nr2 = (nr2 + ((nr2 << 8) ^ nr)) & 0xFFFFFFFF
+            add = (add + ch) & 0xFFFFFFFF
+        self.max_value = 0x3FFFFFFF
+        self.seed1 = (nr & 0x7FFFFFFF) % self.max_value
+        self.seed2 = (nr2 & 0x7FFFFFFF) % self.max_value
+        dec = bytearray(range(256))
+        for i in range(256):
+            idx = int(self._rand() * 255.0)
+            dec[idx], dec[i] = dec[i], dec[idx]
+        enc = bytearray(256)
+        for i in range(256):
+            enc[dec[i]] = i
+        self.dec, self.enc = bytes(dec), bytes(enc)
+        self.shift = 0
+
+    def _rand(self) -> float:
+        self.seed1 = (self.seed1 * 3 + self.seed2) % self.max_value
+        self.seed2 = (self.seed1 + self.seed2 + 33) % self.max_value
+        return self.seed1 / self.max_value
+
+    def encode(self, data: bytes) -> bytes:
+        out = bytearray(len(data))
+        for i, ch in enumerate(data):
+            self.shift ^= int(self._rand() * 255.0)
+            out[i] = self.enc[ch] ^ (self.shift & 0xFF)
+            self.shift ^= ch
+        return bytes(out)
+
+    def decode(self, data: bytes) -> bytes:
+        out = bytearray(len(data))
+        for i, ch in enumerate(data):
+            self.shift ^= int(self._rand() * 255.0)
+            out[i] = self.dec[ch ^ (self.shift & 0xFF)]
+            self.shift ^= out[i]
+        return bytes(out)
+
+
+def _vitess_hash(v) -> int:
+    """VITESS_HASH(shard_key) (reference: util/vitess/vitess_hash.go):
+    DES-ECB over the big-endian uint64 with an all-zero key — expressed
+    here as 3DES with three null keys (K1=K2=K3 degenerates to DES)."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        try:
+            from cryptography.hazmat.decrepit.ciphers.algorithms import (
+                TripleDES)
+        except ImportError:  # older library layout
+            from cryptography.hazmat.primitives.ciphers.algorithms import (
+                TripleDES)
+        from cryptography.hazmat.primitives.ciphers import Cipher, modes
+    enc = Cipher(TripleDES(b"\0" * 24), modes.ECB()).encryptor()
+    h = enc.update(struct.pack(">Q", int(v) & (2**64 - 1)))
+    u = struct.unpack(">Q", h)[0]
+    # wrap into int64 storage; the builder's UNSIGNED flag restores the
+    # uint64 on render
+    return u - (1 << 64) if u >= 1 << 63 else u
+
+
 def _conv_base(s, from_b, to_b):
     try:
         v = int(_u(s).strip() or "0", int(from_b))
@@ -177,6 +249,9 @@ _STRING_FUNCS = {
     "unhex": _pyfn("s", lambda s: binascii.unhexlify(
         (b"0" + s) if len(s) % 2 else s)),
     "md5": _pyfn("s", lambda s: hashlib.md5(s).hexdigest().encode()),
+    "encode": _pyfn("ss", lambda s, pw: _SqlCrypt(pw).encode(s)),
+    "decode": _pyfn("ss", lambda s, pw: _SqlCrypt(pw).decode(s)),
+    "vitess_hash": _pyfn("i", _vitess_hash, out="i"),
     "sha1": _pyfn("s", lambda s: hashlib.sha1(s).hexdigest().encode()),
     "sha2": _pyfn("si", lambda s, n: hashlib.new(
         {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384",

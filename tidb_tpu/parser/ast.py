@@ -906,10 +906,14 @@ class BRIEStmt(StmtNode):
     kind: str = ""      # backup | restore
     db: str = ""
     path: str = ""
+    mode: str = ""      # '' (logical default) | physical | logical
 
     def restore(self):
         prep = "TO" if self.kind == "backup" else "FROM"
-        return f"{self.kind.upper()} DATABASE `{self.db}` {prep} '{self.path}'"
+        s = f"{self.kind.upper()} DATABASE `{self.db}` {prep} '{self.path}'"
+        if self.mode:
+            s += f" MODE {self.mode.upper()}"
+        return s
 
 
 @dataclass(repr=False)

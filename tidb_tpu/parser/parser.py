@@ -326,7 +326,16 @@ class Parser:
                 raise ParseError(f"expected path string near {self._near()}")
             self.pos += 1
             path = t.val.decode() if isinstance(t.val, bytes) else t.val
-            return ast.BRIEStmt(kind=kw, db=db, path=path)
+            mode = ""
+            if self._accept_kw("mode"):
+                if self._peek_op("="):
+                    self.pos += 1
+                mode = self._ident().lower()
+                if mode not in ("physical", "logical"):
+                    raise ParseError(
+                        f"BACKUP/RESTORE MODE must be PHYSICAL or "
+                        f"LOGICAL, got '{mode}'")
+            return ast.BRIEStmt(kind=kw, db=db, path=path, mode=mode)
         if kw == "prepare":
             self.pos += 1
             name = self._ident()

@@ -9,12 +9,14 @@ same matched row set, so the chooser is free to pick by cost alone.
     table's index or handle, skipping the inner full scan entirely.
     Wins when est(outer) rows of seeks cost less than scanning the inner
     table (reference: executor/index_lookup_join.go).
-  * MergeJoin  — single primitive-typed equi-key: argsort both key arrays
-    directly and merge with searchsorted, skipping the dictionary
-    factorization pass the hash matcher needs for arbitrary/composite
-    keys (reference: executor/merge_join.go exploits sort order; here
-    the "order" is produced in-kernel, so it applies to any large
-    primitive join).
+  * MergeJoin  — single primitive-typed equi-key whose BOTH sides stream
+    in key order for free (handle-ordered scans on the int PK): one
+    ordered pass per side, no build table (reference:
+    executor/merge_join.go exploits existing index order). Unsorted
+    sides are NOT enforced by cost — a 10^7-row host sort dwarfs what
+    small-sample calibration prices it at, and a merge shape forfeits
+    the device fragment (hash-join trees only); /*+ MERGE_JOIN */ still
+    forces the in-kernel-sorted variant.
   * HashJoin   — the default; composite or string keys, or small inputs
     where the factorize pass is noise.
 """
@@ -24,8 +26,38 @@ from __future__ import annotations
 from ..expression.core import Column, K_DEC, K_FLOAT, K_INT, phys_kind
 from ..model import SchemaState
 from .access import SCAN_ROW_COST, SEEK_BASE, SEEK_COST
-from .logical import DataSource, Join
+from .logical import DataSource, Join, Projection, Selection
 from .optimizer import _est_rows
+
+
+def _scan_pk_ordered(plan, key) -> bool:
+    """True when `plan` emits rows in `key` order for free: the key is a
+    bare column forwarding (through filters/projections, which preserve
+    scan order) to a DataSource's int-handle PK column, and the access
+    path is a plain scan — scans stream in handle order, and handle ==
+    PK value when pk_is_handle. Index/point paths return index order,
+    which is NOT handle order in general."""
+    e = key
+    node = plan
+    while True:
+        if not isinstance(e, Column):
+            return False
+        if isinstance(node, Selection):
+            node = node.child
+            continue
+        if isinstance(node, Projection):
+            if e.idx >= len(node.exprs):
+                return False
+            e = node.exprs[e.idx]
+            node = node.child
+            continue
+        break
+    if not isinstance(node, DataSource) or node.access is not None:
+        return False
+    info = node.table_info
+    if not info.pk_is_handle or e.idx >= len(node.col_infos):
+        return False
+    return node.col_infos[e.idx].id == info.pk_col_id
 
 #: below this many estimated rows on both sides, factorization cost is
 #: noise and hash join keeps the simplest plan
@@ -258,10 +290,22 @@ def _choose(join: Join, ctx, hints, cm, child_cost) -> float:
     if (len(join.left_keys) == 1
             and _primitive(join.left_keys[0].ftype)
             and _primitive(join.right_keys[0].ftype)
-            and min(outer_est, inner_est) >= MERGE_MIN_ROWS):
-        candidates["merge"] = child_cost + cm.merge_sort * (
-            outer_est * math.log2(max(outer_est, 2))
-            + inner_est * math.log2(max(inner_est, 2)))
+            and min(outer_est, inner_est) >= MERGE_MIN_ROWS
+            # merge is a candidate only when BOTH sides already stream in
+            # key order (handle-ordered scans on the int PK) — then it
+            # reads each side once with no build table. An unsorted side
+            # would need a full sort whose true cost the small-sample
+            # calibration badly underestimates at the 10^7-row scale
+            # (measured: SF10 Q18 host 64s→166s when merge was priced by
+            # n·log n constants), and a merge shape also forfeits the
+            # device fragment, which only compiles hash-join trees.
+            # Reference: merge join exploits existing index order
+            # (executor/merge_join.go); enforcer-sorted merge remains
+            # reachable via /*+ MERGE_JOIN */.
+            and _scan_pk_ordered(join.left, join.left_keys[0])
+            and _scan_pk_ordered(join.right, join.right_keys[0])):
+        candidates["merge"] = child_cost + (
+            outer_est + inner_est) * cm.scan_row
     desc = _inner_index(join)
     if desc is not None and outer_est <= INDEX_JOIN_MAX_KEYS:
         candidates["index"] = (child_cost - right_cost

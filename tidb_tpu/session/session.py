@@ -9,6 +9,7 @@ parse → plan → optimize → execute, with lazy autocommit transactions
 from __future__ import annotations
 
 import datetime as _dt
+import json
 import threading
 import time
 
@@ -1270,12 +1271,27 @@ class Session:
             from .. import br
             from ..sqltypes import TYPE_LONGLONG, TYPE_VARCHAR
             if stmt.kind == "backup":
-                meta = br.backup_database(self, stmt.db, stmt.path)
+                meta = (br.physical_backup_database
+                        if stmt.mode == "physical"
+                        else br.backup_database)(self, stmt.db, stmt.path)
             else:
-                meta = br.restore_database(self, stmt.path, stmt.db)
+                # mode auto-detects from backupmeta; an explicit MODE
+                # must match what the backup actually is
+                bm = json.loads(br.open_storage(
+                    stmt.path).read_text("backupmeta.json"))
+                physical = bm.get("mode") == "physical"
+                if stmt.mode and (stmt.mode == "physical") != physical:
+                    raise TiDBError(
+                        f"backup at '{stmt.path}' is "
+                        f"{'physical' if physical else 'logical'}, not "
+                        f"{stmt.mode}")
+                meta = (br.physical_restore_database(
+                            self, stmt.path, stmt.db, meta=bm)
+                        if physical
+                        else br.restore_database(self, stmt.path, stmt.db))
             ft_s = FieldType(tp=TYPE_VARCHAR)
             ft_i = FieldType(tp=TYPE_LONGLONG)
-            rows = [(t["name"].encode(), t["rows"])
+            rows = [(t["name"].encode(), t.get("rows", t.get("kv", 0)))
                     for t in meta["tables"]]
             return Result(names=["table", "rows"],
                           chunk=Chunk.from_rows([ft_s, ft_i], rows))
