@@ -37,7 +37,12 @@ if _cache_dir != "off":
         # compiled on a different machine (or by a different jax) loads
         # here with a "could lead to SIGILL" warning and mis-tuned code.
         # Scope the default dir by a host fingerprint so such entries
-        # can never be picked up.
+        # can never be picked up. NOTE: same-host entries still print the
+        # loader's mismatch warning — XLA bakes option pseudo-features
+        # (+prefer-no-scatter/+prefer-no-gather) into the compile target
+        # and the loader's naive comparison flags them against the real
+        # host flag set; those entries ARE this machine's and are safe.
+        # The fingerprint guards the cross-machine case only.
         try:
             import hashlib as _hl
             with open("/proc/cpuinfo") as _f:

@@ -261,9 +261,11 @@ def physical_restore_database(session, src: str,
 
 # -- restore (reference: br/pkg/task/restore.go) -----------------------------
 
-def restore_database(session, src: str, db_name: str | None = None) -> dict:
+def restore_database(session, src: str, db_name: str | None = None,
+                     meta: dict | None = None) -> dict:
     st = open_storage(src)
-    meta = json.loads(st.read_text("backupmeta.json"))
+    if meta is None:  # the session layer passes its already-parsed copy
+        meta = json.loads(st.read_text("backupmeta.json"))
     target_db = db_name or meta["db"]
     if session.infoschema().schema_by_name(target_db) is None:
         session.execute(f"create database `{target_db}`")

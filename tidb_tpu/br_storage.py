@@ -56,10 +56,12 @@ class ExternalStorage:
     # streaming seam: big table payloads must not materialize wholesale
     # (reference: br streams SST/row batches). Defaults buffer through the
     # whole-object API; LocalStorage overrides with real files.
-    def open_write(self, name: str):
-        outer = self
-
-        class _Buf(__import__("io").StringIO):
+    # Publish-on-clean-exit: leaving the with-block on an exception must
+    # NOT commit a truncated object over a previous good one (write_file's
+    # atomic-publish contract). One parametrized wrapper serves the text
+    # and bytes variants so the abort contract lives in one place.
+    def _buffered_writer(self, io_cls, publish):
+        class _Buf(io_cls):
             _aborted = False
 
             def __exit__(self, et, ev, tb):
@@ -68,33 +70,23 @@ class ExternalStorage:
 
             def close(self):
                 if not self._aborted:
-                    outer.write_text(name, self.getvalue())
+                    publish(self.getvalue())
                 super().close()
         return _Buf()
+
+    def open_write(self, name: str):
+        import io as _io
+        return self._buffered_writer(
+            _io.StringIO, lambda s: self.write_text(name, s))
 
     def open_read(self, name: str):
         import io as _io
         return _io.StringIO(self.read_text(name))
 
-    # binary streaming (physical backup payloads): same buffering default,
-    # byte-typed. Publish-on-clean-exit: leaving the with-block on an
-    # exception must NOT commit a truncated object over a previous good
-    # one (write_file's atomic-publish contract).
     def open_write_bytes(self, name: str):
-        outer = self
-
-        class _Buf(__import__("io").BytesIO):
-            _aborted = False
-
-            def __exit__(self, et, ev, tb):
-                self._aborted = et is not None
-                return super().__exit__(et, ev, tb)
-
-            def close(self):
-                if not self._aborted:
-                    outer.write_file(name, self.getvalue())
-                super().close()
-        return _Buf()
+        import io as _io
+        return self._buffered_writer(
+            _io.BytesIO, lambda b: self.write_file(name, b))
 
     def open_read_bytes(self, name: str):
         import io as _io

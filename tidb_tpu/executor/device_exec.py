@@ -100,10 +100,16 @@ def _expr_sig(e) -> str:
 
 
 def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
-                    capacity, pack):
+                    capacity, pack, raw_tail=False):
     """Close the compiled expression fns over one traceable program and jit
     it: mask, keys, values and the aggregate all fuse into a single XLA
-    executable — no eager op dispatch between operators."""
+    executable — no eager op dispatch between operators.
+
+    raw_tail: stop before the in-kernel aggregate and return the
+    evaluated (key_cols, key_nulls, val_cols, val_nulls, mask) rows —
+    the CPU-backend streamed path aggregates them in numpy (see
+    _merge_states_host: the XLA-CPU group-by pays in the packed key
+    span; a host reduceat over one block is row-proportional)."""
 
     def pipeline(env):
         first = next(iter(env.values()))[0]
@@ -140,6 +146,9 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
                 d = d.astype(jnp.int64)
             val_cols.append(d)
             val_nulls.append(nl)
+        if raw_tail:
+            return (tuple(key_cols), tuple(key_nulls), tuple(val_cols),
+                    tuple(val_nulls), mask)
         return dev._agg_impl(tuple(key_cols), tuple(key_nulls),
                              tuple(val_cols), tuple(val_nulls), mask,
                              n_keys=n_keys, agg_ops=agg_ops,
@@ -652,6 +661,11 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
         raise DeviceUnsupported("non-mergeable agg in streamed pipeline")
     merge_ops = tuple(_MERGE_OPS[op] for op in agg_ops)
     sig_exprs, dict_refs = _agg_sig(plan, conds, dcols)
+    if _want_host_tail(key_pack, batch_rows):
+        return _stream_agg_host_tail(
+            plan, chunk, conds, batch_rows, ctx, col_arrays, dcols,
+            (key_fns, key_meta, key_pack, val_plan, agg_ops, slots),
+            merge_ops, sig_exprs, dict_refs, cond_fns)
 
     est = _estimate_groups(plan, n, ctx)
     capacity = dev.next_pow2(min(batch_rows, max(est, 16)))
@@ -705,6 +719,65 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
         raise DeviceUnsupported("streamed agg capacity did not converge")
     if state is None:
         raise DeviceUnsupported("empty streamed input")
+    out = jax.device_get(state[:5])
+    key_out, key_null_out, results, result_nulls, n_groups = out
+    ng = int(n_groups)
+    if ng == 0 and not plan.group_exprs:
+        raise DeviceUnsupported("empty global aggregate")
+    return _assemble_agg(plan, key_meta, slots, dcols,
+                         (key_out, key_null_out, results, result_nulls), ng)
+
+
+def _want_host_tail(key_pack, block_rows: int) -> bool:
+    """CPU backend only: aggregate blocks in numpy when the packed key
+    SPAN dwarfs the block — the in-kernel dense-bucket agg pays O(span)
+    per block there (SF10 Q18: 67M-slot orderkey space over 4M-row
+    pages). A small span (Q1's 6-group flag pair) stays in-kernel, where
+    the scatter agg is O(rows) with tiny buckets and the raw rows never
+    leave the program."""
+    if key_pack is None or jax.default_backend() != "cpu":
+        return False
+    bits = sum(b for b, _o in key_pack)
+    # span > block rows: the dense-bucket pass would touch more slots
+    # than there are rows (Q18's 24-bit orderkey space over 4M pages);
+    # below that the in-kernel scatter is O(rows) and keeps the raw rows
+    # inside the program
+    return (1 << bits) > max(block_rows, 1)
+
+
+def _stream_agg_host_tail(plan, chunk, conds, batch_rows, ctx, col_arrays,
+                          dcols, agg_meta_full, merge_ops, sig_exprs,
+                          dict_refs, cond_fns):
+    """CPU-backend streamed scan-agg: raw-tail pipeline per block + numpy
+    partial aggregation + one numpy fold (same shape as the paged join's
+    host tail — XLA keeps the fused filter/expression work, the host does
+    the row-proportional group-by)."""
+    key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
+    n = chunk.num_rows
+    n_keys = max(len(key_fns), 1)
+    nvals = len(val_plan)
+    key = (sig_exprs, "stream-rawtail", key_pack, tuple(agg_ops))
+    fn = _pipe_cache_get(key)
+    if fn is None:
+        fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                             tuple(agg_ops), 1, key_pack, raw_tail=True)
+        _pipe_cache_put(key, fn, dict_refs)
+    states = []
+    for lo in range(0, n, batch_rows):
+        hi = min(lo + batch_rows, n)
+        env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
+               for idx, (d, nl) in col_arrays.items()}
+        raw = fn(env)
+        page = page_singleton_state(raw[0], raw[1], raw[2], raw[3],
+                                    raw[4], agg_ops)
+        state, _cap = _merge_states_host([page], 16, n_keys, nvals,
+                                         merge_ops, key_pack)
+        states.append(state)
+    if not states:
+        raise DeviceUnsupported("empty streamed input")
+    state, _cap = (_merge_states_host(states, 16, n_keys, nvals,
+                                      merge_ops, key_pack)
+                   if len(states) > 1 else (states[0], 0))
     out = jax.device_get(state[:5])
     key_out, key_null_out, results, result_nulls, n_groups = out
     ng = int(n_groups)
@@ -802,8 +875,19 @@ def merge_partial_states(state, parts, merge_cap, n_keys, nvals, merge_ops,
     merged state of `merge_cap` output slots via the mergeable-agg kernel;
     grows merge_cap on overflow (inputs stay alive, so the retry is
     exact). Returns (state, merge_cap) — state is an _agg_impl output
-    tuple whose [4] is the live group count."""
+    tuple whose [4] is the live group count.
+
+    On the XLA-CPU backend with a packable key the fold runs in numpy
+    instead: partial states are small and COMPACT (a few hundred k rows
+    per flush), where the backend's serial sort and the dense-bucket
+    scatter both pay in the key SPAN (measured: 13.5s of SF10 Q3's 45s
+    device time was one 3.9M-row merge over a 67M-slot orderkey space);
+    numpy's multiway argsort does the same fold in row-proportional
+    time. On TPU the states stay in HBM and the sort kernel merges."""
     alls = ([state] if state is not None else []) + list(parts)
+    if key_pack is not None and jax.default_backend() == "cpu":
+        return _merge_states_host(alls, merge_cap, n_keys, nvals,
+                                  merge_ops, key_pack)
     key_cat = tuple(jnp.concatenate([p[0][k] for p in alls])
                     for k in range(n_keys))
     key_null_cat = tuple(jnp.concatenate([p[1][k] for p in alls])
@@ -822,6 +906,117 @@ def merge_partial_states(state, parts, merge_cap, n_keys, nvals, merge_ops,
         if ng <= merge_cap:
             return out, merge_cap
         merge_cap = dev.next_pow2(ng)
+
+
+def page_singleton_state(key_cols, key_nulls, val_cols, val_nulls, mask,
+                         agg_ops):
+    """A raw fragment page (see compile_fragment raw_tail) viewed as a
+    partial-agg state of SINGLETON groups, mergeable by
+    _merge_states_host: a count op's singleton value is its 0/1 pre-count
+    (its merge op is sum_i, and a count result is 0, never NULL); every
+    other op's singleton value is the row's own value + null flag."""
+    vals, vnulls = [], []
+    for j, op in enumerate(agg_ops):
+        v = np.asarray(val_cols[j])
+        vn = np.asarray(val_nulls[j])
+        if op == "count":
+            vals.append((~vn).astype(np.int64))
+            vnulls.append(np.zeros(vn.shape[0], dtype=bool))
+        else:
+            vals.append(v)
+            vnulls.append(vn)
+    m = np.asarray(mask)
+    return (tuple(np.asarray(k) for k in key_cols),
+            tuple(np.asarray(kn) for kn in key_nulls),
+            tuple(vals), tuple(vnulls),
+            int(np.count_nonzero(m)), m)
+
+
+def _merge_states_host(alls, merge_cap, n_keys, nvals, merge_ops, key_pack):
+    """numpy fold of partial-agg states (CPU backend only). Packs the key
+    tuple EXACTLY like _agg_impl (null -> slot 0, value+offset+1), stable
+    argsort so the first-occurrence row of every group is the earliest
+    partial's representative (matching the kernel's stable-sort 'first'
+    semantics), then reduceat per aggregate. Output layout mirrors an
+    _agg_impl return: (keys, key_nulls, results, result_nulls, n_groups,
+    valid)."""
+    keys = [np.concatenate([np.asarray(p[0][k]) for p in alls])
+            for k in range(n_keys)]
+    knulls = [np.concatenate([np.asarray(p[1][k]) for p in alls])
+              for k in range(n_keys)]
+    vals = [np.concatenate([np.asarray(p[2][j]) for p in alls])
+            for j in range(nvals)]
+    vnulls = [np.concatenate([np.asarray(p[3][j]) for p in alls])
+              for j in range(nvals)]
+    # p[5] is each state's validity mask: arange<ng for compact kernel
+    # states, an arbitrary row mask for raw singleton pages
+    live = np.concatenate([np.asarray(p[5]) for p in alls])
+    packed = np.zeros(live.shape[0], dtype=np.int64)
+    for (bits, offset), k, kn in zip(key_pack, keys, knulls):
+        shifted = k.astype(np.int64) + np.int64(offset + 1)
+        packed = (packed << np.int64(bits)) | np.where(kn, 0, shifted)
+    idx = np.nonzero(live)[0]
+    order = np.argsort(packed[idx], kind="stable")
+    sidx = idx[order]
+    sk = packed[idx][order]
+    m = sk.shape[0]
+    new = np.empty(m, dtype=bool)
+    if m:
+        new[0] = True
+        np.not_equal(sk[1:], sk[:-1], out=new[1:])
+    bounds = np.nonzero(new)[0]
+    ng = int(bounds.shape[0])
+    cap = merge_cap
+    while ng > cap:
+        cap *= 2
+    rep = sidx[bounds]
+
+    def pad(a):
+        out = np.zeros(cap, dtype=a.dtype)
+        out[:ng] = a
+        return out
+
+    key_out = tuple(jnp.asarray(pad(k[rep])) for k in keys)
+    key_null_out = tuple(jnp.asarray(pad(kn[rep])) for kn in knulls)
+    results = []
+    result_nulls = []
+    for j, opn in enumerate(merge_ops):
+        v = vals[j]
+        vn = vnulls[j]
+        if opn == "first":
+            results.append(jnp.asarray(pad(v[rep])))
+            result_nulls.append(jnp.asarray(pad(vn[rep])))
+            continue
+        svn = vn[sidx]
+        nonnull = np.add.reduceat(
+            (~svn).astype(np.int64), bounds) if ng else np.zeros(
+                0, dtype=np.int64)
+        if opn == "sum_i":
+            sv = np.where(vn, 0, v.astype(np.int64))[sidx]
+            seg = (np.add.reduceat(sv, bounds) if ng
+                   else np.zeros(0, dtype=np.int64))
+        elif opn == "sum_f":
+            sv = np.where(vn, 0.0, v.astype(np.float64))[sidx]
+            seg = (np.add.reduceat(sv, bounds) if ng
+                   else np.zeros(0, dtype=np.float64))
+        elif opn in ("min", "max"):
+            if np.issubdtype(v.dtype, np.floating):
+                sent = np.inf if opn == "min" else -np.inf
+            else:
+                ii = np.iinfo(v.dtype)
+                sent = ii.max if opn == "min" else ii.min
+            sv = np.where(vn, sent, v)[sidx]
+            red = np.minimum if opn == "min" else np.maximum
+            seg = (red.reduceat(sv, bounds) if ng
+                   else np.zeros(0, dtype=v.dtype))
+        else:
+            raise ValueError(opn)
+        results.append(jnp.asarray(pad(seg)))
+        result_nulls.append(jnp.asarray(pad(nonnull == 0)
+                                        if ng else np.zeros(cap, bool)))
+    valid = jnp.arange(cap) < ng
+    return (key_out, key_null_out, tuple(results), tuple(result_nulls),
+            jnp.asarray(ng), valid), cap
 
 
 #: window functions the device kernel computes (reference:

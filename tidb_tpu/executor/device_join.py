@@ -39,6 +39,8 @@ Anything else raises DeviceUnsupported and falls back to the host path.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -558,7 +560,7 @@ def _pack_probe(kds, knulls, pvalid, packs):
 
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                      capacity, key_pack, agg_meta, compact_cap=None,
-                     paged_leaf=None):
+                     paged_leaf=None, raw_tail=False):
     """Build the jitted end-to-end program. caps: per-join static
     capacities aligned with `joins`. Returns jitted fn(env, jidx[, n_live])
     where env is {global_col: (data, nulls)} and jidx is a per-join tuple
@@ -574,7 +576,16 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
     paged_leaf: leaf_id whose env arrays are PAGE SLICES of the fact
     table; the program takes an extra traced scalar `n_live` and masks
     that leaf's rows past it (the last page is padded to the static page
-    shape — padding rows must not survive the scan filter)."""
+    shape — padding rows must not survive the scan filter).
+
+    raw_tail: stop BEFORE the in-kernel aggregate and return the evaluated
+    (key_cols, key_nulls, val_cols, val_nulls, mask) row arrays instead.
+    CPU-backend paged paths aggregate those in numpy: the XLA-CPU
+    group-by pays in the packed-key SPAN (dense buckets) or a serial
+    sort, both dwarfing a host reduceat over one page (measured: 26s of
+    SF10 Q3's device time was 15 pages of in-kernel scatter-agg against
+    a 67M-slot orderkey space). The join/filter/expression work — the
+    part XLA is good at — stays fused in the program."""
     for jn, cap in zip(joins, caps):
         jn.cap = cap
 
@@ -844,6 +855,10 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                 d = d.astype(jnp.int64)
             val_cols.append(d)
             val_nulls.append(nl)
+        if raw_tail:
+            raw = (tuple(key_cols), tuple(key_nulls), tuple(val_cols),
+                   tuple(val_nulls), mask)
+            return raw, tuple(overflows), tuple(span_ovfs), kept_total
         agg_out = dev._agg_impl(tuple(key_cols), tuple(key_nulls),
                                 tuple(val_cols), tuple(val_nulls), mask,
                                 n_keys=len(key_cols),
@@ -1165,6 +1180,35 @@ def _fragment_used_cols(leaves, joins, agg_plan, agg_conds):
     return used
 
 
+class _PagedStats(threading.local):
+    """Stage timing of the thread's most recent paged fragment run —
+    EXPLAIN ANALYZE surfaces it on the HashAgg line (reference: executor
+    runtime stats, util/execdetails), so "where do the seconds go" is
+    answerable without a profiler: slice_s = host page slicing + transfer
+    enqueue, sync_s = device compute drained at merge barriers, merge_s =
+    partial-state folds, fetch_s = final TopN-candidate fetch + host
+    assembly. Thread-local: concurrent sessions each annotate their own
+    run, never a neighbor's."""
+
+    def __init__(self):
+        self.stats = {}
+
+    def clear(self):
+        self.stats.clear()
+
+    def update(self, kv):
+        self.stats.update(kv)
+
+    def __bool__(self):
+        return bool(self.stats)
+
+    def items(self):
+        return self.stats.items()
+
+
+LAST_PAGED_STATS = _PagedStats()
+
+
 def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
                     page_rows):
     """Streamed-probe execution of an all-unique-build join chain: the
@@ -1248,6 +1292,16 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
 
     for jn in joins:
         jn.cap = page_rows  # every join is a probe-shaped gather
+    from .device_exec import _want_host_tail
+    if _want_host_tail(key_pack, page_rows):
+        # raw-tail path: XLA keeps the fused scan->gather-join->expression
+        # work; the per-page group-by runs in numpy, which is
+        # row-proportional where the XLA-CPU aggregate pays in the packed
+        # key SPAN. No capacity discovery, no restarts.
+        return _paged_join_agg_host_tail(
+            root, leaves, joins, probe, agg_plan, agg_conds, ctx,
+            page_rows, dcols, agg_meta_full, merge_ops, sig, dict_refs,
+            env_dim, probe_arrays, jidx, n)
     for _attempt in range(4):
         caps = [page_rows] * len(joins)
         key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops), None,
@@ -1263,27 +1317,44 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
         buffered = []
         max_ng = 0
         overflow = False
+        import time as _time
+        stats = {"pages": 0, "slice_s": 0.0, "dispatch_s": 0.0,
+                 "sync_s": 0.0, "merge_s": 0.0, "capacity": capacity}
         for lo in range(0, n, page_rows):
             hi = min(lo + page_rows, n)
             env = dict(env_dim)
+            t0 = _time.perf_counter()
             for gidx, (d, nl) in probe_arrays.items():
                 env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi))
+            t1 = _time.perf_counter()
             agg_out, _ovf, _sovf, _kept = fn(env, jidx, hi - lo)
+            t2 = _time.perf_counter()
+            stats["pages"] += 1
+            stats["slice_s"] += t1 - t0
+            stats["dispatch_s"] += t2 - t1
             buffered.append(agg_out)
             if len(buffered) >= k_flush:
+                t3 = _time.perf_counter()
                 ngs = [int(g) for g in
                        jax.device_get([p[4] for p in buffered])]
+                stats["sync_s"] += _time.perf_counter() - t3
                 max_ng = max(max_ng, *ngs)
                 if max_ng > capacity:
                     overflow = True
                     break
+                t4 = _time.perf_counter()
                 state, merge_cap = merge_flush(state, buffered, merge_cap)
+                stats["merge_s"] += _time.perf_counter() - t4
                 buffered = []
         if not overflow and buffered:
+            t3 = _time.perf_counter()
             ngs = [int(g) for g in jax.device_get([p[4] for p in buffered])]
+            stats["sync_s"] += _time.perf_counter() - t3
             max_ng = max(max_ng, *ngs)
             if max_ng <= capacity:
+                t4 = _time.perf_counter()
                 state, merge_cap = merge_flush(state, buffered, merge_cap)
+                stats["merge_s"] += _time.perf_counter() - t4
                 buffered = []
         if overflow or max_ng > capacity:
             # a page's group count exceeded the partial capacity: restart
@@ -1298,13 +1369,98 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
         raise DeviceUnsupported("paged fragment capacity did not converge")
     if state is None:
         raise DeviceUnsupported("empty paged fragment input")
+    t5 = _time.perf_counter()
     f = AggFetch(state, topn=resolve_topn(agg_plan, slots))
     ng = f.ng
     _cap_store_put((sig, "groups"), ng)
     if ng == 0 and not agg_plan.group_exprs:
         raise DeviceUnsupported("empty global aggregate")
     body = f.body()
-    return _assemble_agg(agg_plan, key_meta, slots, dcols, body, f.out_rows)
+    out = _assemble_agg(agg_plan, key_meta, slots, dcols, body, f.out_rows)
+    stats["fetch_s"] = _time.perf_counter() - t5
+    stats["groups"] = ng
+    LAST_PAGED_STATS.clear()
+    LAST_PAGED_STATS.update(
+        {k: (round(v, 2) if isinstance(v, float) else v)
+         for k, v in stats.items()})
+    return out
+
+
+def _paged_join_agg_host_tail(root, leaves, joins, probe, agg_plan,
+                              agg_conds, ctx, page_rows, dcols,
+                              agg_meta_full, merge_ops, sig, dict_refs,
+                              env_dim, probe_arrays, jidx, n):
+    """CPU-backend paged fragment: raw-tail program per page + numpy
+    partial aggregation + one numpy fold at the end (see
+    compile_fragment raw_tail / device_exec._merge_states_host)."""
+    import time as _time
+    from .device_exec import (AggFetch, _merge_states_host,
+                              page_singleton_state, resolve_topn)
+    key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
+    agg_meta = (key_fns, val_plan, agg_ops, slots)
+    n_keys = max(len(key_fns), 1)
+    nvals = len(val_plan)
+    key = (sig, key_pack, tuple(agg_ops), "rawtail")
+    fn = _pipe_cache_get(key)
+    if fn is None:
+        fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
+                              [page_rows] * len(joins), 1, key_pack,
+                              agg_meta, paged_leaf=probe.leaf_id,
+                              raw_tail=True)
+        _pipe_cache_put(key, fn, dict_refs)
+
+    def pad_page(arr, lo, hi):
+        blk = np.asarray(arr[lo:hi])
+        if hi - lo < page_rows:
+            blk = np.concatenate(
+                [blk, np.zeros(page_rows - (hi - lo), dtype=blk.dtype)])
+        return jnp.asarray(blk)
+
+    stats = {"pages": 0, "slice_s": 0.0, "dispatch_s": 0.0, "sync_s": 0.0,
+             "merge_s": 0.0}
+    states = []
+    for lo in range(0, n, page_rows):
+        hi = min(lo + page_rows, n)
+        env = dict(env_dim)
+        t0 = _time.perf_counter()
+        for gidx, (d, nl) in probe_arrays.items():
+            env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi))
+        t1 = _time.perf_counter()
+        raw, _ovf, _sovf, _kept = fn(env, jidx, hi - lo)
+        t2 = _time.perf_counter()
+        # per-page compaction keeps at most one compact state per page in
+        # RAM (zero-copy views of the page's buffers drop right after)
+        page = page_singleton_state(raw[0], raw[1], raw[2], raw[3],
+                                    raw[4], agg_ops)
+        state, _cap = _merge_states_host([page], 16, n_keys, nvals,
+                                         merge_ops, key_pack)
+        states.append(state)
+        t3 = _time.perf_counter()
+        stats["pages"] += 1
+        stats["slice_s"] += t1 - t0
+        stats["dispatch_s"] += t2 - t1
+        stats["sync_s"] += t3 - t2
+    if not states:
+        raise DeviceUnsupported("empty paged fragment input")
+    t4 = _time.perf_counter()
+    state, _cap = (_merge_states_host(states, 16, n_keys, nvals,
+                                      merge_ops, key_pack)
+                   if len(states) > 1 else (states[0], 0))
+    stats["merge_s"] = _time.perf_counter() - t4
+    t5 = _time.perf_counter()
+    f = AggFetch(state, topn=resolve_topn(agg_plan, slots))
+    ng = f.ng
+    if ng == 0 and not agg_plan.group_exprs:
+        raise DeviceUnsupported("empty global aggregate")
+    body = f.body()
+    out = _assemble_agg(agg_plan, key_meta, slots, dcols, body, f.out_rows)
+    stats["fetch_s"] = _time.perf_counter() - t5
+    stats["groups"] = ng
+    LAST_PAGED_STATS.clear()
+    LAST_PAGED_STATS.update(
+        {k: (round(v, 2) if isinstance(v, float) else v)
+         for k, v in stats.items()})
+    return out
 
 
 def fragment_sig(leaves, joins, agg_conds, agg_plan):

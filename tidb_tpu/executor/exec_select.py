@@ -474,11 +474,14 @@ class HashAggExec(QueryExecutor):
                 and engine_mode(self.ctx) != "host"):
             # collect_tree may MATERIALIZE a semi build side; in host mode
             # that work would be thrown away and re-done by the host path
-            from .device_join import device_join_agg
+            from .device_join import LAST_PAGED_STATS, device_join_agg
             try:
+                LAST_PAGED_STATS.clear()
                 out = device_join_agg(eff_p, agg_conds, join_child,
                                       self.ctx)
                 self._mark_fragment("tpu", None)
+                if LAST_PAGED_STATS:
+                    self.annotate(**dict(LAST_PAGED_STATS.items()))
                 return out
             except DeviceUnsupported:
                 pass

@@ -1285,10 +1285,9 @@ class Session:
                         f"backup at '{stmt.path}' is "
                         f"{'physical' if physical else 'logical'}, not "
                         f"{stmt.mode}")
-                meta = (br.physical_restore_database(
-                            self, stmt.path, stmt.db, meta=bm)
-                        if physical
-                        else br.restore_database(self, stmt.path, stmt.db))
+                meta = (br.physical_restore_database if physical
+                        else br.restore_database)(
+                    self, stmt.path, stmt.db, meta=bm)
             ft_s = FieldType(tp=TYPE_VARCHAR)
             ft_i = FieldType(tp=TYPE_LONGLONG)
             rows = [(t["name"].encode(), t.get("rows", t.get("kv", 0)))
