@@ -361,3 +361,23 @@ def test_dates(tk):
         ("1995-04-15", "1995-03-01")])
     tk.must_query("select d < '1995-04-01', d > date '1996-01-01' from t").check([
         ("1", "0")])
+
+
+def test_join_null_keys_never_match_raw_fast_path(tk):
+    # ops/host.py join_match's single-int-key fast path skips
+    # factorization and matches on RAW values; a NULL key row carries
+    # arbitrary buffer data that may EQUAL a live probe value — the
+    # null guards must still drop it (SQL: NULL = x is never true)
+    tk.must_exec("create table jn_l (k bigint, tag varchar(8))")
+    tk.must_exec("create table jn_r (k bigint, v bigint)")
+    tk.must_exec("insert into jn_l values (7, 'a'), (null, 'b'), (8, 'c')")
+    # null build row: engines hold some concrete int under the null flag
+    tk.must_exec("insert into jn_r values (7, 70), (null, 700), (9, 90)")
+    r = tk.must_query(
+        "select tag, v from jn_l, jn_r where jn_l.k = jn_r.k")
+    assert sorted(r.rows) == [("a", "70")]
+    # null probe side too: inner join drops it, left join null-extends
+    r2 = tk.must_query(
+        "select tag, v from jn_l left join jn_r on jn_l.k = jn_r.k "
+        "order by tag")
+    assert r2.rows == [("a", "70"), ("b", None), ("c", None)]
