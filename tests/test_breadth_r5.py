@@ -38,6 +38,40 @@ class TestNewBuiltins:
     def test_current_role_without_set_role(self, tk):
         assert tk.must_query("select current_role()").rows == [("NONE",)]
 
+    def test_default_func_in_select(self, tk):
+        # reference: expression_rewriter.go evalDefaultExpr — the
+        # column's catalog default as a constant; NOT NULL without a
+        # default errors 1364
+        tk.must_exec("create table dft (a int default 7, "
+                     "b varchar(6) default 'x', c int, d int not null)")
+        tk.must_exec("insert into dft values (1,'y',2,3)")
+        assert tk.must_query(
+            "select default(a), default(b), default(c) from dft"
+        ).rows == [("7", "x", None)]
+        e = tk.exec_error("select default(d) from dft")
+        assert getattr(e, "code", None) == 1364
+        tk.must_exec("drop table dft")
+
+    def test_default_func_alias_and_named_col(self, tk):
+        # an alias shadowing a real table must NOT leak that table's
+        # default (origin-table resolution); mixed-case column names
+        # resolve; DEFAULT(named) in INSERT/UPDATE uses the NAMED
+        # column's default, not the assignment target's
+        tk.must_exec("create table du (a int default 1)")
+        tk.must_exec("create table dv (Abc int default 2)")
+        tk.must_exec("insert into dv values (9)")
+        assert tk.must_query(
+            "select default(Abc) from dv as du").rows == [("2",)]
+        tk.must_exec("create table dt2 (a int default 5, b int default 8)")
+        tk.must_exec("insert into dt2 (a, b) values (default(b), 1)")
+        tk.must_query("select * from dt2").check([("8", "1")])
+        tk.must_exec("update dt2 set a = default(b)")
+        tk.must_query("select * from dt2").check([("8", "1")])
+        tk.must_exec("update dt2 set a = default")
+        tk.must_query("select * from dt2").check([("5", "1")])
+        for t in ("du", "dv", "dt2"):
+            tk.must_exec(f"drop table {t}")
+
     def test_translate(self, tk):
         assert tk.must_query(
             "select translate('abcab', 'ab', 'xy')").rows == [("xycxy",)]

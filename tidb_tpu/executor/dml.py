@@ -90,7 +90,23 @@ class InsertExec:
                 vals = []
                 for node, col in zip(value_row, target_cols):
                     if isinstance(node, ast.DefaultExpr):
-                        vals.append(_DEFAULT)
+                        if node.col is None:
+                            vals.append(_DEFAULT)
+                            continue
+                        # DEFAULT(other_col): the NAMED column's default,
+                        # not the positional target's (MySQL semantics)
+                        src = info.find_column(node.col.name)
+                        if src is None:
+                            raise TiDBError(
+                                f"Unknown column '{node.col.name}' in "
+                                f"'field list'", code=ErrCode.BadField)
+                        d = _col_default(sess, info, src)
+                        if d is _MISSING:
+                            raise TiDBError(
+                                f"Field '{src.name}' doesn't have a "
+                                f"default value",
+                                code=ErrCode.NoDefaultValue)
+                        vals.append((d, src.ftype))
                     else:
                         e = b.build(node)
                         vals.append((e.eval_scalar(), e.ftype))
@@ -332,9 +348,11 @@ class MultiUpdateExec:
                 raise TiDBError(
                     f"The target table {a} of the UPDATE is not updatable",
                     code=ErrCode.NonUpdatableTable)
-        # SET col = DEFAULT resolves from the column, not the join query
-        is_default = [isinstance(e, ast.DefaultExpr)
-                      for _c, e in stmt.assignments]
+        # SET col = DEFAULT resolves from the column, not the join query;
+        # the col-form names another column of the SAME target table
+        is_default = [((e.col.name if e.col is not None else cn.name)
+                       if isinstance(e, ast.DefaultExpr) else None)
+                      for cn, e in stmt.assignments]
         fields = [ast.SelectField(expr=(ast.Literal("null", None)
                                         if isinstance(e, ast.DefaultExpr)
                                         else e))
@@ -375,8 +393,15 @@ class MultiUpdateExec:
                         raise TiDBError(f"Unknown column '{cn.name}'",
                                         code=ErrCode.BadField)
                     if is_default[ai]:
-                        d = _col_default(sess, info, col)
+                        src = info.find_column(is_default[ai])
+                        if src is None:
+                            raise TiDBError(
+                                f"Unknown column '{is_default[ai]}'",
+                                code=ErrCode.BadField)
+                        d = _col_default(sess, info, src)
                         nv = None if d is _MISSING else d
+                        if nv is not None and src is not col:
+                            nv = convert_internal(nv, src.ftype, col.ftype)
                         if nv is None and col.ftype.not_null:
                             raise TiDBError(
                                 f"Column '{col.name}' cannot be null",
@@ -500,7 +525,21 @@ class UpdateExec:
                 raise TiDBError(f"Unknown column '{cn.name}' in 'field list'",
                                 code=ErrCode.BadField)
             if isinstance(expr_node, ast.DefaultExpr):
-                vals = [_col_default(sess, info, col)] * len(sel)
+                src = col
+                if expr_node.col is not None:
+                    src = info.find_column(expr_node.col.name)
+                    if src is None:
+                        raise TiDBError(
+                            f"Unknown column '{expr_node.col.name}' in "
+                            f"'field list'", code=ErrCode.BadField)
+                d = _col_default(sess, info, src)
+                if d is _MISSING:
+                    raise TiDBError(
+                        f"Field '{src.name}' doesn't have a default value",
+                        code=ErrCode.NoDefaultValue)
+                if d is not None and src is not col:
+                    d = convert_internal(d, src.ftype, col.ftype)
+                vals = [d] * len(sel)
                 nulls = [v is None for v in vals]
                 assigns.append((col, vals, nulls, col.ftype))
                 continue

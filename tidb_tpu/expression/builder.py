@@ -30,16 +30,21 @@ _OP_MAP = {
 
 
 class ColumnRef:
-    """One name-resolvable output column of a plan node."""
+    """One name-resolvable output column of a plan node.
 
-    __slots__ = ("name", "table", "db", "ftype", "uid")
+    origin: the CATALOG table name when it differs from `table` (a
+    FROM-clause alias) — DEFAULT(col) must resolve the real table, not
+    an alias that may shadow an unrelated one."""
 
-    def __init__(self, name, table, db, ftype, uid=0):
+    __slots__ = ("name", "table", "db", "ftype", "uid", "origin")
+
+    def __init__(self, name, table, db, ftype, uid=0, origin=""):
         self.name = name.lower() if name else ""
         self.table = table.lower() if table else ""
         self.db = db.lower() if db else ""
         self.ftype = ftype
         self.uid = uid
+        self.origin = origin.lower() if origin else ""
 
     def __repr__(self):
         return f"{self.table + '.' if self.table else ''}{self.name}"
@@ -408,7 +413,39 @@ class ExprBuilder:
         return _python_value_to_constant(self.ctx.get_uservar(node.name))
 
     def _b_DefaultExpr(self, node):
-        raise TiDBError("DEFAULT is only valid in INSERT/UPDATE")
+        # SELECT DEFAULT(col): the column's catalog default as a constant
+        # (reference: planner/core/expression_rewriter.go evalDefaultExpr).
+        # Bare DEFAULT in INSERT/UPDATE value lists never reaches here —
+        # the DML executors resolve it positionally first.
+        if node.col is None or self.ctx is None:
+            raise TiDBError("DEFAULT is only valid in INSERT/UPDATE")
+        ref_i = self.schema.find(node.col)
+        if ref_i is None:
+            raise ColumnError(
+                f"Unknown column '{node.col.name}' in 'field list'")
+        r = self.schema.refs[ref_i]
+        sess = getattr(self.ctx, "session", None)
+        # r.origin names the CATALOG table even when r.table is a
+        # FROM-clause alias (which may shadow an unrelated real table);
+        # view-expanded / derived columns carry no origin → no default
+        src = getattr(r, "origin", "") or ""
+        if sess is None or not src:
+            raise TiDBError("DEFAULT is only valid in INSERT/UPDATE")
+        try:
+            info = sess.infoschema().table_by_name(
+                r.db or sess.current_db(), src)
+        except Exception:
+            raise TiDBError("DEFAULT is only valid in INSERT/UPDATE")
+        ci = info.find_column(r.name)
+        if ci is None:
+            raise TiDBError("DEFAULT is only valid in INSERT/UPDATE")
+        if ci.default_value is None:
+            if ci.ftype is not None and ci.ftype.not_null:
+                raise TiDBError(
+                    f"Field '{ci.name}' doesn't have a default value",
+                    code=ErrCode.NoDefaultValue)
+            return Constant(None, ci.ftype)
+        return Constant(ci.default_value, ci.ftype)
 
     # -- operators ----------------------------------------------------------
 

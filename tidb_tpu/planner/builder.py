@@ -544,7 +544,8 @@ class PlanBuilder:
                 f"'{db}.{tn.name}' is a SEQUENCE; use NEXTVAL/LASTVAL",
                 code=ErrCode.WrongObjectSequence)
         cols = info.public_columns()
-        refs = [ColumnRef(c.name, alias, db, c.ftype) for c in cols]
+        refs = [ColumnRef(c.name, alias, db, c.ftype, origin=info.name)
+                for c in cols]
         ds = DataSource(db, info, cols, Schema(refs), alias=alias)
         ds.index_hints = list(tn.index_hints)
         if tn.partition_names:
