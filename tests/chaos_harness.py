@@ -1,0 +1,194 @@
+"""Seeded chaos harness: a query corpus under deterministic random fault
+schedules (reference: the failpoint-driven chaos suites wired through 103
+files of the reference codebase; Jepsen-style invariants, in-process).
+
+Every run is driven by ONE integer seed: `random.Random(seed)` picks which
+failpoints fire, with which actions, against which query and engine.  The
+contract asserted for every operation:
+
+  * a read either matches the fault-free golden result BIT-FOR-BIT, or
+    fails with a CLEAN CLASSIFIED error (TiDBError with a code, or the
+    injected FailpointError itself) — never a hang, never a silently
+    wrong result;
+  * a write either commits fully or not at all — the transfer invariant
+    (SUM over the ledger is constant) holds after every fault;
+  * the cluster recovers: after `failpoint.disable_all()` the corpus
+    runs fault-free and exact again.
+
+Usage:  run_seed(seed) -> dict of counters; raises AssertionError on any
+invariant violation.  tests/test_chaos.py drives a fixed-seed smoke in
+tier-1 and a deeper sweep (CHAOS_SEEDS=n, marked slow) locally.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.failpoint import FailpointError
+
+#: wall-clock ceiling for any single chaos operation — the "never a hang"
+#: invariant made checkable (budgeted backoff keeps real runs far below)
+OP_TIMEOUT_S = 60.0
+
+# -- fixed, deterministic workload -----------------------------------------
+
+N_ROWS = 384  # small enough to stay fast, large enough to group/join
+
+QUERIES = [
+    # fused scan→filter→agg (the device/MPP fragment shape)
+    "select grp, sum(val), count(*) from t1 group by grp order by grp",
+    "select grp, min(val), max(val) from t1 where val % 3 = 0 "
+    "group by grp order by grp",
+    # join + agg (device join fragment / broadcast MPP shape)
+    "select t1.grp, sum(t2.amt) from t1 join t2 on t1.id = t2.ref "
+    "group by t1.grp order by t1.grp",
+    # window over partition
+    "select id, rank() over (partition by grp order by val) from t1 "
+    "where id < 40 order by id",
+    # plain row reads
+    "select id, val from t1 where grp = 3 order by id",
+    "select count(*) from t1 join t2 on t1.id = t2.ref where t2.amt > 50",
+]
+
+ENGINES = ["auto", "host", "tpu", "tpu-mpp"]
+
+#: read-path fault catalog: failpoint name -> candidate actions.  N*panic
+#: actions are TRANSIENT (retries should absorb them); plain panic is
+#: PERSISTENT (the run must degrade or fail classified — never hang).
+READ_FAULTS = {
+    "device-agg-exec": ["panic", "1*panic", "2*panic"],
+    "mpp-exchange-send": ["1*panic", "2*panic", "panic"],
+    "mpp-exchange-recv": ["1*panic", "panic"],
+    "coordinator-tso-skew": ["return(262144)"],
+    "coordinator-campaign-loss": ["return(1)"],
+    "coordinator-heartbeat-lost": ["return(1)"],
+}
+
+#: write-path fault catalog: 2PC crash windows
+WRITE_FAULTS = {
+    "txn-before-prewrite": ["1*panic", "panic"],
+    "txn-after-prewrite": ["1*panic", "panic"],
+    "txn-before-commit": ["1*panic", "panic"],
+}
+
+
+def _setup(tk: TestKit):
+    tk.must_exec("use test")
+    tk.must_exec("create table t1 (id int primary key, grp int, val int, "
+                 "s varchar(16))")
+    tk.must_exec("create table t2 (id int primary key, ref int, amt int)")
+    rows1 = ",".join(f"({i},{i % 7},{(i * 37) % 101},'s{i % 11}')"
+                     for i in range(N_ROWS))
+    rows2 = ",".join(f"({i},{(i * 3) % N_ROWS},{(i * 13) % 97})"
+                     for i in range(N_ROWS))
+    tk.must_exec(f"insert into t1 values {rows1}")
+    tk.must_exec(f"insert into t2 values {rows2}")
+    # the transfer ledger for write-atomicity checks
+    tk.must_exec("create table ledger (acct int primary key, bal int)")
+    tk.must_exec("insert into ledger values (1, 500), (2, 500)")
+    # any lock orphaned by an injected crash must surface fast, not eat
+    # the schedule's wall clock (the "never a hang" invariant)
+    tk.must_exec("set innodb_lock_wait_timeout = 2")
+
+
+def _goldens(tk: TestKit) -> list:
+    """Fault-free reference results, host engine (always-correct path)."""
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    out = [tuple(map(tuple, tk.must_query(q).rows)) for q in QUERIES]
+    tk.must_exec("set tidb_executor_engine = 'auto'")
+    return out
+
+
+def _is_clean(err: Exception) -> bool:
+    """A *classified* failure: carries an error code or is the injected
+    fault itself.  Anything else (KeyError, AssertionError, ...) is a bug
+    the harness must surface."""
+    return isinstance(err, (TiDBError, FailpointError))
+
+
+def run_seed(seed: int, n_ops: int = 10) -> dict:
+    """One deterministic chaos schedule; returns counters for reporting."""
+    rng = random.Random(seed)
+    tk = TestKit()  # fresh embedded cluster: no cross-seed contamination
+    failpoint.disable_all()
+    stats = {"exact": 0, "clean_errors": 0, "writes_ok": 0,
+             "writes_failed": 0}
+    try:
+        _setup(tk)
+        goldens = _goldens(tk)
+
+        # fast breaker so the schedule can see a full open→probe cycle
+        tk.must_exec("set global tidb_device_circuit_threshold = 3")
+        tk.must_exec("set global tidb_device_circuit_cooldown = 0.05")
+
+        for _op in range(n_ops):
+            qi = rng.randrange(len(QUERIES))
+            engine = rng.choice(ENGINES)
+            # 1-2 simultaneous faults from the read catalog
+            names = rng.sample(sorted(READ_FAULTS), k=rng.choice([1, 1, 2]))
+            tk.must_exec(f"set tidb_executor_engine = '{engine}'")
+            for name in names:
+                failpoint.enable(name, rng.choice(READ_FAULTS[name]))
+            t0 = time.monotonic()
+            try:
+                rows = tuple(map(tuple, tk.must_query(QUERIES[qi]).rows))
+            except Exception as e:  # noqa: BLE001 — the assertion IS the point
+                assert _is_clean(e), (
+                    f"seed {seed}: unclassified failure {type(e).__name__}: "
+                    f"{e} (faults {failpoint.list_active()})")
+                stats["clean_errors"] += 1
+            else:
+                assert rows == goldens[qi], (
+                    f"seed {seed}: WRONG RESULT under faults "
+                    f"{failpoint.list_active()} engine={engine} "
+                    f"query={QUERIES[qi]!r}")
+                stats["exact"] += 1
+            finally:
+                failpoint.disable_all()
+            assert time.monotonic() - t0 < OP_TIMEOUT_S, (
+                f"seed {seed}: op exceeded {OP_TIMEOUT_S}s — hang-adjacent")
+
+        # -- write atomicity under 2PC crash windows -----------------------
+        tk.must_exec("set tidb_executor_engine = 'auto'")
+        for _w in range(4):
+            name = rng.choice(sorted(WRITE_FAULTS) + [None])
+            if name is not None:
+                failpoint.enable(name, rng.choice(WRITE_FAULTS[name]))
+            amt = rng.randrange(1, 50)
+            try:
+                tk.must_exec("begin")
+                tk.must_exec(
+                    f"update ledger set bal = bal - {amt} where acct = 1")
+                tk.must_exec(
+                    f"update ledger set bal = bal + {amt} where acct = 2")
+                tk.must_exec("commit")
+                stats["writes_ok"] += 1
+            except Exception as e:  # noqa: BLE001
+                assert _is_clean(e), (
+                    f"seed {seed}: unclassified write failure "
+                    f"{type(e).__name__}: {e}")
+                stats["writes_failed"] += 1
+                try:
+                    tk.session.rollback()
+                except Exception:
+                    pass
+            finally:
+                failpoint.disable_all()
+            total = tk.must_query(
+                "select sum(bal) from ledger").rows[0][0]
+            assert str(total) == "1000", (
+                f"seed {seed}: ATOMICITY VIOLATION after {name}: "
+                f"ledger sum {total} != 1000")
+
+        # -- recovery: fault-free corpus is exact again --------------------
+        for qi, q in enumerate(QUERIES):
+            rows = tuple(map(tuple, tk.must_query(q).rows))
+            assert rows == goldens[qi], (
+                f"seed {seed}: no recovery after faults cleared: {q!r}")
+    finally:
+        failpoint.disable_all()
+    return stats

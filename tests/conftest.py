@@ -18,3 +18,26 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from tidb_tpu.utils import failpoint  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection runs (tests/test_chaos.py;"
+        " deepen locally with CHAOS_SEEDS=n)")
+
+
+@pytest.fixture(autouse=True)
+def _no_failpoint_leaks():
+    """A test that leaks an active failpoint corrupts every test after it;
+    fail loudly at the source instead (satellite: failpoint hygiene)."""
+    yield
+    leaked = failpoint.list_active()
+    if leaked:
+        failpoint.disable_all()
+        pytest.fail(f"test leaked active failpoints: {leaked}")

@@ -1,0 +1,35 @@
+"""Chaos harness entry points (see chaos_harness.py for the contract).
+
+Tier-1 runs a small fixed-seed smoke; the deeper sweep is marked `slow`
+and sized by CHAOS_SEEDS (default 20) for local runs:
+
+    CHAOS_SEEDS=50 pytest tests/test_chaos.py -m chaos
+"""
+
+import os
+
+import pytest
+
+from chaos_harness import run_seed
+
+SMOKE_SEEDS = [0, 1, 2, 3]
+_DEEP = int(os.environ.get("CHAOS_SEEDS", "20"))
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", SMOKE_SEEDS)
+def test_chaos_smoke(seed):
+    """Fixed-seed tier-1 smoke: exact-or-classified under faults, ledger
+    atomicity, recovery after the schedule."""
+    stats = run_seed(seed)
+    # the schedule must actually exercise both outcomes over the corpus
+    assert stats["exact"] + stats["clean_errors"] > 0
+    assert stats["writes_ok"] + stats["writes_failed"] == 4
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(len(SMOKE_SEEDS), _DEEP))
+def test_chaos_sweep(seed):
+    """Deeper deterministic sweep (excluded from tier-1 by `slow`)."""
+    run_seed(seed)

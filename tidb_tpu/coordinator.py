@@ -28,8 +28,13 @@ gRPC.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+
+from .utils import failpoint
+
+_log = logging.getLogger("tidb_tpu.coordinator")
 
 
 class Lease:
@@ -66,6 +71,13 @@ class Coordinator:
     def tso(self) -> int:
         """One strictly-monotonic timestamp."""
         with self._mu:
+            # chaos hook: a PD-restart-style clock jump. Only FORWARD skew
+            # is modeled — TSO stays strictly monotonic by contract, and
+            # consumers must survive arbitrary gaps between grants
+            skew = failpoint.inject("coordinator-tso-skew")
+            if isinstance(skew, int) and skew > 0:
+                self._ts += skew
+                self._ts_ceiling = max(self._ts_ceiling, self._ts)
             if self._ts >= self._ts_ceiling:
                 # lease a fresh range anchored to wall time so timestamps
                 # stay roughly physical (PD's physical<<18 | logical form)
@@ -90,7 +102,17 @@ class Coordinator:
         before the lease lapses (renewal extends; a live foreign lease
         rejects)."""
         with self._mu:
+            # chaos hooks: losing a campaign / an etcd lease lapsing out
+            # from under its holder (owner/manager.go watches for both)
+            if failpoint.inject("coordinator-campaign-loss"):
+                _log.warning("campaign lost (injected): key=%s holder=%s",
+                             key, holder)
+                return False
             cur = self._leaders.get(key)
+            if cur is not None and failpoint.inject("coordinator-lease-expire"):
+                _log.warning("lease expired (injected): key=%s holder=%s",
+                             key, cur.holder)
+                cur.deadline = time.monotonic() - 1
             if cur is not None and cur.alive() and cur.holder != holder:
                 return False
             self._leaders[key] = Lease(key, holder, ttl_s)
@@ -123,6 +145,10 @@ class Coordinator:
 
     def heartbeat(self, server_id: str) -> bool:
         with self._mu:
+            if failpoint.inject("coordinator-heartbeat-lost"):
+                _log.warning("heartbeat lost (injected): server=%s",
+                             server_id)
+                return False
             ent = self._registry.get(server_id)
             if ent is None:
                 return False
@@ -203,5 +229,12 @@ class Coordinator:
                 for fn in list(fns):
                     try:
                         fn(key, value)
-                    except Exception:
-                        pass  # a broken watcher must not poison the bus
+                    except Exception as e:
+                        # a broken watcher must not poison the bus — but a
+                        # silently vanishing lease/election event was how
+                        # failures disappeared entirely (satellite fix):
+                        # classify and log so the slow log / operator sees
+                        from .utils.backoff import classify
+                        _log.warning(
+                            "watcher failed (%s): key=%s err=%s",
+                            classify(e), key, e)

@@ -17,16 +17,19 @@ maintaining the index according to its state — keeps the reference shape.
 
 from __future__ import annotations
 
+import logging
 import threading
 
 from . import tablecodec
-from .errors import DupEntryError, TiDBError, WriteConflictError
+from .errors import DupEntryError, ErrCode, TiDBError, WriteConflictError
 from .meta import Meta
 from .model import Job, JobState, SchemaState
 from .table import Table
 
 MIN_HANDLE = -(1 << 63)
 DEFAULT_REORG_BATCH = 256
+
+_log = logging.getLogger("tidb_tpu.ddl")
 
 
 class DDLWorker:
@@ -117,8 +120,13 @@ class DDLWorker:
             self._wake.clear()
             try:
                 self.run_pending()
-            except Exception:
-                pass  # job-level errors are recorded on the job itself
+            except Exception as e:
+                # job-level errors are recorded on the job itself; errors
+                # escaping the queue drain are worker-health signals and
+                # must not vanish (satellite: classified, logged swallows)
+                from .utils.backoff import classify
+                _log.warning("ddl worker queue drain failed (%s): %s",
+                             classify(e), e)
 
     # -- queue processing ----------------------------------------------------
 
@@ -446,7 +454,10 @@ class DDLWorker:
             phys = [partition_view(t, d) for d in t.partition.defs]
         else:
             phys = [t]
-        for _attempt in range(20):
+        from .errors import BackoffExhaustedError
+        from .utils.backoff import Backoffer
+        bo = Backoffer()
+        while True:
             failpoint.inject("ddl-backfill-batch")
             txn = store.begin()
             try:
@@ -489,9 +500,14 @@ class DDLWorker:
                 txn.commit()
                 self._fire("reorg_batch", job)
                 return False
-            except WriteConflictError:
+            except WriteConflictError as e:
                 txn.rollback()
-                continue  # concurrent DML touched a scanned row: retry batch
+                try:  # concurrent DML touched a scanned row: retry batch
+                    bo.backoff("ddlBackfill", e)
+                except BackoffExhaustedError as be:
+                    raise TiDBError(
+                        "backfill batch: too many write conflicts",
+                        code=ErrCode.BackoffExhausted) from be
             except DupEntryError as e:
                 txn.rollback()
                 self._rollback_index(job, t, idx, str(e))
@@ -500,7 +516,6 @@ class DDLWorker:
                 if txn.valid:
                     txn.rollback()
                 raise
-        raise TiDBError("backfill batch: too many write conflicts")
 
     @staticmethod
     def _backfill_put(txn, tbl: Table, idx, row, handle):

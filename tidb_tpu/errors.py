@@ -63,6 +63,9 @@ class ErrCode:
     InfoSchemaChanged = 8028
     WriteConflict = 9007
     TxnRetryable = 8002
+    TiKVServerTimeout = 9002
+    BackoffExhausted = 9005  # reference: ErrRegionUnavailable family —
+    #                          the budgeted Backoffer ran out of retries
     LazyUniquenessCheckFailure = 8147
     ResolveLockTimeout = 9004
     GCTooEarly = 9006
@@ -189,3 +192,17 @@ class QueryInterruptedError(TiDBError):
 class MemoryQuotaExceeded(TiDBError):
     code = ErrCode.MemExceedThreshold
     sqlstate = "HY000"
+
+
+class BackoffExhaustedError(TiDBError):
+    """A budgeted retry loop ran out of budget (reference: client-go
+    "backoffer.maxSleep exceeded" — surfaced as a region-unavailable
+    class timeout, never an unbounded loop).
+
+    Carries `retry_kind` (which curve exhausted) and `error_class` (the
+    taxonomy label of the last triggering error, utils/backoff.classify)."""
+
+    code = ErrCode.BackoffExhausted
+    sqlstate = "HY000"
+    retry_kind = ""
+    error_class = ""

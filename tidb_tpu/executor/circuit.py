@@ -1,0 +1,181 @@
+"""Device-engine circuit breaker: graceful degradation to the host engine.
+
+Round-5 reality (BENCH_TPU_LIVE.json): the real-TPU bench lost Q5–Q18 to a
+dead tunnel ("Connection refused") because every fragment kept re-dialing
+the dead device, and Q3 shipped a 0.562× device *regression* with no policy
+to stop paying for it.  The breaker formalizes the informal host fallback
+hinted at in device_exec.py: after N classified device failures the device
+engine OPENS for a cooldown window — fragments degrade to the (always
+correct) host engine immediately instead of timing out one by one — then a
+HALF_OPEN probe re-admits one fragment and a success closes the breaker.
+
+States (the classic Nygard breaker, per-Domain so embedded test clusters
+stay isolated):
+
+    CLOSED     normal: device dispatch allowed, failures counted
+    OPEN       cooling down: allow() is False, everything runs host-side
+    HALF_OPEN  cooldown elapsed: ONE probe runs device-side; success
+               closes, failure re-opens
+
+Knobs (session/sysvars.py): tidb_device_circuit_threshold (failures to
+open; 0 disables), tidb_device_circuit_cooldown (seconds OPEN)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("tidb_tpu.circuit")
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+
+class CircuitBreaker:
+    def __init__(self, threshold: int = 5, cooldown_s: float = 30.0,
+                 clock=time.monotonic):
+        self._mu = threading.Lock()
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self._probe_owner = None  # thread ident holding the probe slot
+        self.stats = {"opened": 0, "degraded": 0, "failures": 0,
+                      "probes": 0}
+        self.last_error = ""
+
+    def configure(self, threshold: int | None = None,
+                  cooldown_s: float | None = None):
+        with self._mu:
+            if threshold is not None:
+                self.threshold = int(threshold)
+            if cooldown_s is not None:
+                self.cooldown_s = float(cooldown_s)
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._peek_state()
+
+    def _peek_state(self) -> str:
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            return HALF_OPEN
+        return self._state
+
+    def allow(self) -> bool:
+        """May a fragment dispatch to the device right now?  In HALF_OPEN
+        exactly one caller wins the probe slot; the rest stay host-side
+        until the probe's verdict is in."""
+        with self._mu:
+            if self.threshold <= 0:  # breaker disabled
+                return True
+            st = self._peek_state()
+            if st == CLOSED:
+                return True
+            if st == HALF_OPEN and not self._probing:
+                self._state = HALF_OPEN
+                self._probing = True
+                self._probe_owner = threading.get_ident()
+                self.stats["probes"] += 1
+                return True
+            self.stats["degraded"] += 1
+            return False
+
+    def release_probe(self):
+        """The probe fragment exited WITHOUT a health verdict (it raised
+        DeviceUnsupported / a user error before touching the device) —
+        free the HALF_OPEN probe slot so another fragment can probe,
+        instead of wedging the breaker with _probing stuck True.
+        Ownership-checked: a stale fragment admitted before the breaker
+        opened must not free a live probe's slot (one probe at a time)."""
+        with self._mu:
+            if (self._peek_state() == HALF_OPEN and self._probing
+                    and self._probe_owner == threading.get_ident()):
+                self._probing = False
+                self._probe_owner = None
+
+    def record_success(self):
+        with self._mu:
+            if self._state in (HALF_OPEN, OPEN):
+                log.info("device circuit closed (probe succeeded)")
+            self._state = CLOSED
+            self._failures = 0
+            self._probing = False
+            self._probe_owner = None
+
+    def record_failure(self, err=None):
+        from ..utils.backoff import classify
+        with self._mu:
+            self.stats["failures"] += 1
+            if err is not None:
+                self.last_error = f"{classify(err)}: {err}"
+            if self.threshold <= 0:
+                return
+            if self._state == HALF_OPEN:
+                # failed probe: back to a full cooldown
+                self._reopen()
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._reopen()
+
+    def _reopen(self):
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._failures = 0
+        self._probing = False
+        self._probe_owner = None
+        self.stats["opened"] += 1
+        log.warning("device circuit OPEN for %.1fs (last error: %s)",
+                    self.cooldown_s, self.last_error)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"state": self._peek_state(), "failures": self._failures,
+                    "threshold": self.threshold,
+                    "cooldown_s": self.cooldown_s,
+                    "last_error": self.last_error, **self.stats}
+
+
+#: process-wide fallback for contexts with no Domain (bare device calls)
+_GLOBAL = CircuitBreaker()
+
+
+def get_breaker(ctx=None) -> CircuitBreaker:
+    """The device breaker for this execution context: one per Domain (so
+    embedded test clusters are isolated), the module global otherwise.
+
+    Knobs are read from the breaker's OWN scope — the Domain's GLOBAL
+    variables (`SET GLOBAL tidb_device_circuit_*`) — on every fetch, so
+    SET GLOBAL takes effect on the next fragment.  A session-scoped SET
+    must NOT reconfigure the shared breaker: concurrent sessions would
+    clobber each other's threshold/cooldown mid-OPEN."""
+    dom = getattr(ctx, "domain", None)
+    if dom is not None:
+        br = getattr(dom, "_device_breaker", None)
+        if br is None:
+            br = CircuitBreaker()
+            dom._device_breaker = br
+        try:
+            gv = dom.global_vars
+            br.configure(
+                threshold=int(gv.get("tidb_device_circuit_threshold", 5)),
+                cooldown_s=float(
+                    gv.get("tidb_device_circuit_cooldown", 30.0)))
+        except Exception:
+            pass
+        return br
+    br = _GLOBAL
+    if ctx is not None:  # bare context: its own view is the only scope
+        try:
+            br.configure(
+                threshold=int(ctx.get_sysvar("tidb_device_circuit_threshold")),
+                cooldown_s=float(
+                    ctx.get_sysvar("tidb_device_circuit_cooldown")))
+        except Exception:
+            pass
+    return br

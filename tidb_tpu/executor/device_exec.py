@@ -37,6 +37,48 @@ def engine_mode(ctx) -> str:
         return "auto"
 
 
+def run_device(ctx, fn, /, *args, **kw):
+    """Dispatch one device fragment through the circuit breaker
+    (executor/circuit.py): an OPEN breaker degrades to the host engine
+    up front (DeviceUnsupported → the caller's existing fallback), and a
+    classified device/transport failure — an XLA runtime error, a dead
+    remote-compile tunnel, an injected fault — records into the breaker
+    and ALSO degrades instead of killing the query.  DeviceUnsupported
+    and TiDBError pass through untouched: "this fragment doesn't fit the
+    device" and genuine user errors are not health signals."""
+    from ..utils.backoff import (classify, CLASS_DEVICE, CLASS_EXCHANGE,
+                                 CLASS_FAULT, CLASS_TRANSPORT)
+    from .circuit import get_breaker
+    br = get_breaker(ctx)
+    if not br.allow():
+        raise DeviceUnsupported("device circuit open (cooling down; "
+                                "fragment degraded to host engine)")
+    try:
+        out = fn(*args, **kw)
+    except (DeviceUnsupported, TiDBError):
+        # no health verdict: if this fragment held the HALF_OPEN probe
+        # slot, free it — otherwise the breaker wedges with no prober
+        br.release_probe()
+        raise
+    except (KeyboardInterrupt, SystemExit):
+        # Ctrl-C mid-probe must not wedge the breaker in HALF_OPEN
+        br.release_probe()
+        raise
+    except Exception as e:
+        cls = classify(e)
+        if cls not in (CLASS_DEVICE, CLASS_TRANSPORT, CLASS_FAULT,
+                       CLASS_EXCHANGE):
+            # an UNCLASSIFIED error is a programming bug, not a device
+            # health signal: surface it instead of silently degrading
+            br.release_probe()
+            raise
+        br.record_failure(e)
+        raise DeviceUnsupported(
+            f"device failure ({cls}): {e}") from e
+    br.record_success()
+    return out
+
+
 def want_device(ctx, n_rows: int) -> bool:
     mode = engine_mode(ctx)
     if mode == "host":
@@ -187,6 +229,10 @@ def _agg_sig(plan, conds, dcols) -> tuple:
 def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:
     """Fused filter+group+aggregate on device. Raises DeviceUnsupported to
     trigger host fallback."""
+    from ..utils import failpoint
+    # chaos/breaker hook: a `panic` here models a device runtime failure
+    # (dead tunnel, OOM) at the fragment boundary
+    failpoint.inject("device-agg-exec")
     n = chunk.num_rows
     if n == 0:
         raise DeviceUnsupported("empty input")

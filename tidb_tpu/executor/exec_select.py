@@ -368,7 +368,8 @@ class HashAggExec(QueryExecutor):
         # fused device pipeline: HashAgg directly over a TableScan compiles
         # scan-filter + grouping + aggregation into one XLA program
         from .device_exec import (
-            want_device, device_agg, engine_mode, DeviceUnsupported)
+            want_device, device_agg, engine_mode, run_device,
+            DeviceUnsupported)
         if getattr(p, "agg_hint", None) == "stream":
             # /*+ STREAM_AGG() */ pins the host streaming/spillable path
             # (reference: stream agg enforced by hint,
@@ -408,12 +409,13 @@ class HashAggExec(QueryExecutor):
         if mesh is not None:
             try:
                 if raw is not None:
-                    out = mpp_agg(eff_p, raw, conds, self.ctx, mesh)
+                    out = run_device(self.ctx, mpp_agg, eff_p, raw, conds,
+                                     self.ctx, mesh)
                     self._mark_fragment("tpu-mpp", raw.num_rows)
                     return out
                 if isinstance(join_child, HashJoinExec):
-                    out = mpp_join_agg(eff_p, agg_conds, join_child,
-                                       self.ctx, mesh)
+                    out = run_device(self.ctx, mpp_join_agg, eff_p,
+                                     agg_conds, join_child, self.ctx, mesh)
                     self._mark_fragment("tpu-mpp", None)
                     return out
             except DeviceUnsupported:
@@ -451,9 +453,9 @@ class HashAggExec(QueryExecutor):
             if batch > 0 and (paged_in or raw.num_rows > batch):
                 from .device_exec import device_agg_streaming
                 try:
-                    out = device_agg_streaming(eff_p, raw, conds, batch,
-                                               ctx=self.ctx,
-                                               allow_single=paged_in)
+                    out = run_device(self.ctx, device_agg_streaming,
+                                     eff_p, raw, conds, batch,
+                                     ctx=self.ctx, allow_single=paged_in)
                     self._mark_fragment("tpu-stream", raw.num_rows)
                     return out
                 except DeviceUnsupported:
@@ -463,7 +465,8 @@ class HashAggExec(QueryExecutor):
                 # pipeline: to_device_col would read the entire memmap into
                 # RAM + HBM — the exact failure paging exists to prevent
                 try:
-                    out = device_agg(eff_p, raw, conds, ctx=self.ctx)
+                    out = run_device(self.ctx, device_agg, eff_p, raw,
+                                     conds, ctx=self.ctx)
                     self._mark_fragment("tpu", raw.num_rows)
                     return out
                 except DeviceUnsupported:
@@ -477,8 +480,8 @@ class HashAggExec(QueryExecutor):
             from .device_join import LAST_PAGED_STATS, device_join_agg
             try:
                 LAST_PAGED_STATS.clear()
-                out = device_join_agg(eff_p, agg_conds, join_child,
-                                      self.ctx)
+                out = run_device(self.ctx, device_join_agg, eff_p,
+                                 agg_conds, join_child, self.ctx)
                 self._mark_fragment("tpu", None)
                 if LAST_PAGED_STATS:
                     self.annotate(**dict(LAST_PAGED_STATS.items()))
@@ -847,12 +850,13 @@ class HashJoinExec(QueryExecutor):
 
     def _match(self, build_keys, probe_keys):
         """Dispatch the match kernel to device or host by engine mode."""
-        from .device_exec import want_device, device_join_keys
+        from .device_exec import want_device, device_join_keys, run_device
         from .device_exec import DeviceUnsupported
         n = max(len(build_keys[0][0]), len(probe_keys[0][0])) if build_keys else 0
         if want_device(self.ctx, n):
             try:
-                return device_join_keys(probe_keys, build_keys)
+                return run_device(self.ctx, device_join_keys,
+                                  probe_keys, build_keys)
             except DeviceUnsupported:
                 pass
         return self._host_match(build_keys, probe_keys)
@@ -1124,11 +1128,12 @@ class WindowExec(QueryExecutor):
                         else np.zeros(0, dtype=dt))
                 cols.append(Column(f.ftype, data, np.zeros(0, dtype=bool)))
             return Chunk(cols)
-        from .device_exec import want_device, device_window
+        from .device_exec import want_device, device_window, run_device
         from .device_exec import DeviceUnsupported as _DU
         if want_device(self.ctx, n):
             try:
-                out = device_window(p, chunk, self.ctx)
+                out = run_device(self.ctx, device_window, p, chunk,
+                                 self.ctx)
                 self.annotate(engine="tpu")
                 return out
             except _DU:

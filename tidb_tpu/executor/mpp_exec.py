@@ -29,8 +29,9 @@ import collections
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils.jaxcompat import shard_map
 
 from ..ops import device as dev
 from ..ops.device import DeviceUnsupported
@@ -50,7 +51,7 @@ _MERGE_OP = {"count": "sum_i", "sum_i": "sum_i", "sum_f": "sum_f",
 
 #: observability: fragments actually executed through the mesh path
 MPP_STATS = {"fragments": 0, "retries": 0, "shuffle_joins": 0,
-             "skew_broadcasts": 0}
+             "skew_broadcasts": 0, "exchange_retries": 0}
 
 _MESH_CACHE: dict[int, object] = {}
 
@@ -559,7 +560,19 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                       if dc.dictionary is not None)
     bottom_idx = joins.index(bottom) if bottom is not None else -1
 
-    for _attempt in range(12):
+    # retry discipline (reference: the Backoffer every coprocessor/MPP
+    # dispatch carries, store/tikv/backoff.go): exchange transport faults
+    # back off and retry on the SAME capacities; bucket/group overflow
+    # "retries" are recompiles at larger capacity and draw from a separate
+    # attempt budget.  Exhausting the transport budget surfaces a
+    # classified BackoffExhaustedError (and trips the device breaker);
+    # exhausting the growth budget degrades to the host engine.
+    from ..utils import failpoint
+    from ..utils.backoff import (Backoffer, ExchangeError)
+    from ..utils.failpoint import FailpointError
+    from ..errors import BackoffExhaustedError
+    bo = Backoffer.for_session(ctx)
+    while True:
         for jn, cap in zip(joins, caps):
             jn.cap = cap
         shuffle = None
@@ -575,9 +588,26 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                 cond_fns, key_fns, n_keys, val_plan, tuple(agg_ops),
                 capacity, key_pack, env_specs, shuffle=shuffle)
             _pipe_cache_put(key, fn, dict_refs)
-        agg_out, png_d, ovfs_d, sovfs_d, xovfs_d = fn(env, svalids)
-        from .device_exec import AggFetch
-        f = AggFetch(agg_out, extras=(png_d, ovfs_d, sovfs_d, xovfs_d))
+        try:
+            failpoint.inject("mpp-exchange-send")
+            agg_out, png_d, ovfs_d, sovfs_d, xovfs_d = fn(env, svalids)
+            from .device_exec import AggFetch
+            f = AggFetch(agg_out, extras=(png_d, ovfs_d, sovfs_d, xovfs_d))
+            failpoint.inject("mpp-exchange-recv")
+        except (FailpointError, ExchangeError, ConnectionError,
+                TimeoutError) as e:
+            # narrow on purpose: FileNotFoundError-class OSErrors are
+            # bugs, not transient exchange weather — they must surface
+            exc = (e if isinstance(e, ExchangeError)
+                   else ExchangeError(f"mpp exchange failed: {e}"))
+            try:
+                bo.backoff("exchangeRetry", exc)
+            except BackoffExhaustedError:
+                from .circuit import get_breaker
+                get_breaker(ctx).record_failure(exc)
+                raise
+            MPP_STATS["exchange_retries"] += 1
+            continue
         png, ovfs, sovfs, xovfs = f.extras
         fng = f.ng
         if any(int(s) for s in sovfs):
@@ -605,8 +635,11 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
         if not retry:
             break
         MPP_STATS["retries"] += 1
-    else:
-        raise DeviceUnsupported("mpp fragment capacities did not converge")
+        try:
+            bo.backoff("exchangeGrow")
+        except BackoffExhaustedError as e:
+            raise DeviceUnsupported(
+                "mpp fragment capacities did not converge") from e
     ng = int(fng)
     if ng == 0 and not plan.group_exprs:
         raise DeviceUnsupported("empty global aggregate")

@@ -13,8 +13,11 @@ Each GC round:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+
+_log = logging.getLogger("tidb_tpu.coordinator")
 
 
 def parse_duration(s: str) -> float:
@@ -108,7 +111,10 @@ class GCWorker:
         coord = getattr(self.domain, "coordinator", None)
         if coord is not None and not coord.campaign("gc", "tidb-0"):
             # another GC leader holds the lease (reference: gc_worker.go
-            # leader election via the owner manager)
+            # leader election via the owner manager) — skipping is the
+            # graceful-degrade path, but losing leadership is still an
+            # event the operator should see (satellite: no silent swallow)
+            _log.info("gc leader campaign lost; round skipped")
             return {"safe_point": self.safe_point, "skipped": True}
         sp = self.compute_safepoint() if safe_point is None else safe_point
         if coord is not None:

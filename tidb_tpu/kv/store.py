@@ -10,7 +10,6 @@ is no RPC; the commit protocol is kept (prewrite → TSO → commit) because DDL
 from __future__ import annotations
 
 import threading
-import time
 
 from ..errors import ErrCode, LockedError, TiDBError, WriteConflictError
 from .mvcc import MVCCStore, OP_AMEND_FLAG, OP_DEL, OP_LOCK, OP_PUT
@@ -96,45 +95,52 @@ class Snapshot:
         self.ts = ts
         self.own_start_ts = own_start_ts
 
-    def _wait_out_lock(self, deadline):
-        """One backoff step of the lock-wait loop; returns the deadline."""
-        now = time.monotonic()
-        if deadline is None:
-            return now + self.LOCK_WAIT_S
-        if now >= deadline:
-            raise
-        time.sleep(0.002)
-        return deadline
+    def _wait_out_lock(self, bo, err):
+        """One budgeted backoff step of the lock-wait loop (reference:
+        boTxnLockFast through the per-request Backoffer).  Budget
+        exhaustion re-raises the LOCK error, not a generic timeout: a
+        lock still held past the budget is an abandoned txn, and the GC
+        worker's stale-lock resolution owns those."""
+        if bo is None:
+            from ..utils.backoff import Backoffer
+            bo = Backoffer(budget_ms=self.LOCK_WAIT_S * 1000,
+                           wall_clock=True)
+        from ..errors import BackoffExhaustedError
+        try:
+            bo.backoff("txnLockFast", err)
+        except BackoffExhaustedError:
+            raise err
+        return bo
 
     def get(self, key: bytes):
-        deadline = None
+        bo = None
         while True:
             try:
                 return self.store.mvcc.get(key, self.ts,
                                            own_start_ts=self.own_start_ts)
-            except LockedError:
-                deadline = self._wait_out_lock(deadline)
+            except LockedError as e:
+                bo = self._wait_out_lock(bo, e)
 
     def batch_get(self, keys):
-        deadline = None
+        bo = None
         while True:
             try:
                 return {k: v for k in keys
                         if (v := self.store.mvcc.get(
                             k, self.ts, own_start_ts=self.own_start_ts))
                         is not None}
-            except LockedError:
-                deadline = self._wait_out_lock(deadline)
+            except LockedError as e:
+                bo = self._wait_out_lock(bo, e)
 
     def scan(self, start: bytes, end: bytes, limit: int = 0):
-        deadline = None
+        bo = None
         while True:
             try:
                 return self.store.mvcc.scan(
                     start, end, self.ts, limit=limit,
                     own_start_ts=self.own_start_ts)
-            except LockedError:
-                deadline = self._wait_out_lock(deadline)
+            except LockedError as e:
+                bo = self._wait_out_lock(bo, e)
 
 
 class Transaction:
@@ -188,22 +194,26 @@ class Transaction:
         self.locked_keys.update(keys)
 
     def lock_keys_wait(self, keys, for_update_ts: int, timeout_s: float = 50.0):
-        """Pessimistic lock with blocking wait: poll while another txn holds
-        a lock, raising LockWaitTimeout past the deadline (reference:
-        client-go pessimistic lock waiting + innodb_lock_wait_timeout).
-        Deadlocks and write conflicts propagate immediately."""
-        import time as _time
-        from ..errors import LockedError, TiDBError, ErrCode
+        """Pessimistic lock with budgeted backoff while another txn holds
+        a lock, raising LockWaitTimeout once the budget is spent
+        (reference: client-go pessimistic lock waiting through boTxnLock +
+        innodb_lock_wait_timeout).  Deadlocks and write conflicts
+        propagate immediately."""
+        from ..errors import (BackoffExhaustedError, LockedError, TiDBError,
+                              ErrCode)
+        from ..utils.backoff import Backoffer
         keys = list(keys)
         if not keys:
             return
-        deadline = _time.monotonic() + timeout_s
+        bo = Backoffer(budget_ms=timeout_s * 1000, wall_clock=True)
         while True:
             try:
                 self.lock_keys(keys, for_update_ts)
                 return
-            except LockedError:
-                if _time.monotonic() >= deadline:
+            except LockedError as e:
+                try:
+                    bo.backoff("txnLock", e)
+                except BackoffExhaustedError:
                     # drop our wait-for edge: a timed-out waiter is no
                     # longer waiting, and a stale edge would make the
                     # detector see phantom cycles for innocent sessions
@@ -211,7 +221,6 @@ class Transaction:
                     raise TiDBError(
                         "Lock wait timeout exceeded; try restarting "
                         "transaction", code=ErrCode.LockWaitTimeout)
-                _time.sleep(0.005)
 
     def commit(self) -> int:
         """2PC: prewrite all → get commit_ts → commit. Returns commit_ts."""
@@ -232,8 +241,13 @@ class Transaction:
             return self.start_ts
         from ..utils import failpoint
         primary = muts[0][0]
-        failpoint.inject("txn-before-prewrite")
         try:
+            # the inject must sit INSIDE the rollback guard: self.valid is
+            # already False, so a failure here that skipped the rollback
+            # would orphan the txn's pessimistic locks forever (the caller's
+            # rollback() no-ops) — the next writer would wait out its whole
+            # lock budget against a dead txn
+            failpoint.inject("txn-before-prewrite")
             self.store.mvcc.prewrite(muts, primary, self.start_ts)
         except Exception:
             self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
@@ -245,6 +259,9 @@ class Transaction:
         try:
             failpoint.inject("txn-after-prewrite")
             commit_ts = self.store.next_ts()
+            # fault point between TSO grant and the commit write — the
+            # widest crash window of the 2PC protocol (chaos harness)
+            failpoint.inject("txn-before-commit")
         except BaseException:
             self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
             raise

@@ -817,10 +817,15 @@ class Session:
     def _retry_txn(self, history):
         """Optimistic-txn retry: replay the statement history on a fresh
         snapshot and re-commit (reference: session.go:797 doCommitWithRetry
-        → retry with schema check)."""
-        limit = self._retry_limit()
+        → retry with schema check).  Retries draw from the session's
+        unified backoff budget (utils/backoff.Backoffer): bounded attempts
+        with jittered sleeps between replays, interruptible by KILL."""
+        from ..errors import BackoffExhaustedError
+        from ..utils.backoff import Backoffer
+        limit = max(self._retry_limit(), 1)
+        bo = Backoffer.for_session(self)
         last = None
-        for _attempt in range(max(limit, 1)):
+        for attempt in range(limit):
             self.txn = self.store.begin()
             self._in_txn_retry = True
             self.explicit_txn = True  # replayed DML must not autocommit
@@ -835,6 +840,12 @@ class Session:
                 if self.txn is not None and self.txn.valid:
                     self.txn.rollback()
                 self.txn = None
+                if attempt + 1 < limit:
+                    try:
+                        bo.backoff("txnRetry", e)
+                    except BackoffExhaustedError as be:
+                        last = be
+                        break
                 continue
             except Exception:
                 if self.txn is not None and self.txn.valid:
@@ -856,23 +867,38 @@ class Session:
             self.txn.rollback()
         self.txn = None
 
-    def alloc_autoid(self, table_id, n=1) -> int:
-        """Independent meta txn for id allocation
-        (reference: meta/autoid — batched, outside the user txn)."""
-        for _attempt in range(20):
+    def _meta_txn_retry(self, body, exhaust_msg: str):
+        """Run one independent meta txn (autoid/sequence allocation —
+        outside the user txn) with unified conflict retry: WriteConflict
+        backs off through the session's budget ("autoid" curve) and
+        exhaustion surfaces as a NAMED classified error.  `body(txn)`
+        commits (or rolls back a no-op) itself and returns the result."""
+        from ..errors import BackoffExhaustedError
+        from ..utils.backoff import Backoffer
+        bo = Backoffer.for_session(self)
+        while True:
             txn = self.store.begin()
             try:
-                m = Meta(txn)
-                base, _end = m.alloc_autoid_batch(table_id, n)
-                txn.commit()
-                return base
-            except WriteConflictError:
+                return body(txn)
+            except WriteConflictError as e:
                 txn.rollback()
-                continue
+                try:
+                    bo.backoff("autoid", e)
+                except BackoffExhaustedError as be:
+                    raise TiDBError(exhaust_msg,
+                                    code=ErrCode.BackoffExhausted) from be
             except Exception:
                 txn.rollback()
                 raise
-        raise TiDBError("autoid allocation conflict")
+
+    def alloc_autoid(self, table_id, n=1) -> int:
+        """Independent meta txn for id allocation
+        (reference: meta/autoid — batched, outside the user txn)."""
+        def body(txn):
+            base, _end = Meta(txn).alloc_autoid_batch(table_id, n)
+            txn.commit()
+            return base
+        return self._meta_txn_retry(body, "autoid allocation conflict")
 
     def seq_next(self, info) -> int:
         """NEXTVAL: serve from the session's cached batch; refill with one
@@ -882,23 +908,13 @@ class Session:
         st = self.seq_cache.get(info.id)
         if st is None or st[1] <= 0:
             k = max(int(info.sequence.get("cache", 1) or 1), 1)
-            for _attempt in range(20):
-                txn = self.store.begin()
-                try:
-                    m = Meta(txn)
-                    first, count = m.sequence_next_batch(info.id,
-                                                         info.sequence, k)
-                    txn.commit()
-                    st = (first, count)
-                    break
-                except WriteConflictError:
-                    txn.rollback()
-                    continue
-                except Exception:
-                    txn.rollback()
-                    raise
-            else:
-                raise TiDBError("sequence allocation conflict")
+
+            def body(txn):
+                first, count = Meta(txn).sequence_next_batch(
+                    info.id, info.sequence, k)
+                txn.commit()
+                return (first, count)
+            st = self._meta_txn_retry(body, "sequence allocation conflict")
         v, remaining = st
         self.seq_cache[info.id] = (v + inc, remaining - 1)
         self.seq_lastval[info.id] = v
@@ -906,37 +922,22 @@ class Session:
 
     def seq_setval(self, info, v: int) -> int:
         self.seq_cache.pop(info.id, None)  # cached batch is now stale
-        for _attempt in range(20):
-            txn = self.store.begin()
-            try:
-                Meta(txn).set_sequence_value(info.id, int(v))
-                txn.commit()
-                return int(v)
-            except WriteConflictError:
-                txn.rollback()
-                continue
-            except Exception:
-                txn.rollback()
-                raise
-        raise TiDBError("sequence setval conflict")
+
+        def body(txn):
+            Meta(txn).set_sequence_value(info.id, int(v))
+            txn.commit()
+            return int(v)
+        return self._meta_txn_retry(body, "sequence setval conflict")
 
     def rebase_autoid(self, table_id, new_base: int):
-        for _attempt in range(20):
-            txn = self.store.begin()
-            try:
-                m = Meta(txn)
-                if m.autoid(table_id) < new_base:
-                    m.set_autoid(table_id, new_base)
-                    txn.commit()
-                else:
-                    txn.rollback()
-                return
-            except WriteConflictError:
+        def body(txn):
+            m = Meta(txn)
+            if m.autoid(table_id) < new_base:
+                m.set_autoid(table_id, new_base)
+                txn.commit()
+            else:
                 txn.rollback()
-                continue
-            except Exception:
-                txn.rollback()
-                raise
+        self._meta_txn_retry(body, "autoid rebase conflict")
 
     # -- columnar cache accessor used by executors ---------------------------
 
@@ -1327,12 +1328,18 @@ class Session:
             if not self._in_txn_retry:
                 self.txn_stmt_history.append(stmt)
             return r
-        from ..errors import LockedError, SchemaChangedError
+        from ..errors import (BackoffExhaustedError, LockedError,
+                              SchemaChangedError)
+        from ..utils.backoff import Backoffer
         try:
             wait_s = float(self.get_sysvar("innodb_lock_wait_timeout"))
         except Exception:
             wait_s = 50.0
-        deadline = time.monotonic() + wait_s
+        # wall-clock Backoffer: innodb_lock_wait_timeout is a hard user-
+        # facing deadline — tidb_backoff_weight must not stretch it and
+        # slow statement re-executions count against it, not just sleeps
+        bo = Backoffer(budget_ms=wait_s * 1000, wall_clock=True,
+                       check_killed=self.check_killed)
         last = None
         attempts = 0
         while True:
@@ -1347,14 +1354,15 @@ class Session:
                 if attempts > max(self._retry_limit(), 0):
                     raise
             except LockedError as e:
-                # a pessimistic txn holds the key: wait it out like the
-                # reference's lock-wait backoff (client-go)
+                # a pessimistic txn holds the key: wait it out through the
+                # budgeted lock-wait curve (reference: client-go boTxnLock)
                 last = e
-                if time.monotonic() >= deadline:
+                try:
+                    bo.backoff("txnLock", e)
+                except BackoffExhaustedError:
                     raise TiDBError(
                         "Lock wait timeout exceeded; try restarting "
                         "transaction", code=ErrCode.LockWaitTimeout)
-                time.sleep(0.005)
             if self.txn is not None and self.txn.valid:
                 self.txn.rollback()
             self.txn = None
@@ -1366,15 +1374,18 @@ class Session:
         our for_update_ts, undo the statement's buffered writes and
         re-execute on a newer snapshot (reference: adapter.go:435
         handlePessimisticDML + UpdateForUpdateTS)."""
-        from ..errors import LockedError
+        from ..errors import BackoffExhaustedError, LockedError
         from ..kv.store import Snapshot
+        from ..utils.backoff import Backoffer
         txn = self.txn_for_write()
         try:
             wait_s = float(self.get_sysvar("innodb_lock_wait_timeout"))
         except Exception:
             wait_s = 50.0
         orig_snapshot = txn.snapshot
-        deadline = time.monotonic() + wait_s
+        # hard wall-clock deadline, not weight-scaled (see _exec_dml)
+        bo = Backoffer(budget_ms=wait_s * 1000, wall_clock=True,
+                       check_killed=self.check_killed)
         last = None
         try:
             while True:
@@ -1389,11 +1400,12 @@ class Session:
                     # to our read): wait it out like the lock-wait path
                     last = e
                     txn.membuf.rollback_to(sp)
-                    if time.monotonic() >= deadline:
+                    try:
+                        bo.backoff("txnLock", e)
+                    except BackoffExhaustedError:
                         raise TiDBError(
                             "Lock wait timeout exceeded; try restarting "
                             "transaction", code=ErrCode.LockWaitTimeout)
-                    time.sleep(0.005)
                     continue
                 except Exception:
                     txn.membuf.rollback_to(sp)
@@ -1402,13 +1414,15 @@ class Session:
                 try:
                     txn.lock_keys_wait(
                         keys, for_update_ts,
-                        timeout_s=max(deadline - time.monotonic(), 0.001))
+                        timeout_s=max(bo.remaining_ms() / 1000, 0.001))
                     return r
                 except WriteConflictError as e:
                     last = e
                     txn.membuf.rollback_to(sp)
-                    if time.monotonic() >= deadline:
-                        raise
+                    try:
+                        bo.backoff("txnRetry", e)
+                    except BackoffExhaustedError:
+                        raise e
                     continue
                 except Exception:
                     # lock-wait timeout / deadlock: the statement failed —
@@ -1762,6 +1776,11 @@ class Session:
                 # SET var = bare_word — MySQL treats the identifier as a
                 # string value (SET tidb_partition_prune_mode = dynamic)
                 v = node.name
+            elif (isinstance(node, ast.Literal)
+                    and getattr(node, "kind", None) == "dec"):
+                # decimal literal: eval_scalar yields the SCALED int
+                # (0.3 → 3); the sysvar wants the literal text
+                v = node.val
             else:
                 v = b.build(node).eval_scalar()
             if isinstance(v, bytes):

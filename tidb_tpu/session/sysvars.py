@@ -44,6 +44,24 @@ class SysVar:
             if self.max is not None and i > self.max:
                 i = self.max
             return str(i)
+        if self.kind == "float":
+            import math
+            try:
+                f = float(v)
+            except ValueError:
+                raise TiDBError(
+                    f"Incorrect argument type to variable '{self.name}'")
+            if not math.isfinite(f):
+                # nan compares False against any bound, sailing past the
+                # clamp — and a NaN cooldown wedges the circuit breaker
+                raise TiDBError(
+                    f"Variable '{self.name}' can't be set to the value "
+                    f"of '{v}'")
+            if self.min is not None and f < self.min:
+                return str(self.min)
+            if self.max is not None and f > self.max:
+                return str(self.max)
+            return v  # keep the user's spelling (SHOW round-trips)
         if self.kind == "enum":
             if self.choices and v.lower() not in self.choices:
                 raise TiDBError(f"Variable '{self.name}' can't be set to the value of '{v}'")
@@ -195,6 +213,13 @@ for _v in [
     SysVar("tidb_auto_analyze_start_time", SCOPE_GLOBAL, "00:00 +0000"),
     SysVar("tidb_auto_analyze_end_time", SCOPE_GLOBAL, "23:59 +0000"),
     SysVar("tidb_backoff_weight", SCOPE_BOTH, "2", "int", 1),
+    # -- resilience layer (utils/backoff.py + executor/circuit.py) ------
+    # classified device failures before the device→host breaker OPENs
+    # (0 disables the breaker entirely)
+    SysVar("tidb_device_circuit_threshold", SCOPE_BOTH, "5", "int", 0,
+           10000),
+    # seconds the breaker stays OPEN before a HALF_OPEN probe fragment
+    SysVar("tidb_device_circuit_cooldown", SCOPE_BOTH, "30", "float", 0),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
            str(100 * 1024 * 1024), "int", 0),
     SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH,

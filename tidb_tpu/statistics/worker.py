@@ -10,7 +10,10 @@ fraction exceeds the ratio."""
 from __future__ import annotations
 
 import collections
+import logging
 import threading
+
+_log = logging.getLogger("tidb_tpu.coordinator")
 
 AUTO_ANALYZE_MIN_ROWS = 1000
 
@@ -62,9 +65,12 @@ class StatsWorker:
         try:
             # piggyback the server-registry heartbeat on the periodic sweep
             # (reference: domain/infosync keepalive loop)
-            dom.coordinator.heartbeat("tidb-0")
-        except Exception:
-            pass
+            if not dom.coordinator.heartbeat("tidb-0"):
+                _log.warning("server heartbeat rejected: registration "
+                             "lapsed")
+        except Exception as e:
+            from ..utils.backoff import classify
+            _log.warning("server heartbeat failed (%s): %s", classify(e), e)
         try:
             ratio = float(dom.global_vars.get("tidb_auto_analyze_ratio",
                                               "0.5"))

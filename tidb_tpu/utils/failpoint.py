@@ -2,17 +2,21 @@
 103 reference files; kv/fault_injection.go).
 
 Production code calls ``inject("name")`` at interesting points; tests
-activate behaviors with ``enable``:
+activate behaviors with ``enable`` — or, better, the ``enabled`` context
+manager, which cannot leak an active failpoint past the test:
 
     failpoint.enable("commit-after-prewrite", "panic")     # raise
     failpoint.enable("backfill-batch", "sleep(0.05)")
     failpoint.enable("scan-rows", "return(7)")
+    with failpoint.enabled("txn-before-commit", "2*panic"):
+        ...
 
 Disabled failpoints cost one dict lookup. ``inject`` returns the
 ``return(...)`` payload (or None), raises FailpointError for ``panic``."""
 
 from __future__ import annotations
 
+import contextlib
 import re
 import threading
 import time
@@ -43,16 +47,39 @@ def disable_all():
         _active.clear()
 
 
+@contextlib.contextmanager
+def enabled(name: str, action: str):
+    """Scoped activation: the failpoint is disabled on exit even when the
+    body raises, so tests can't leak active failpoints into each other."""
+    enable(name, action)
+    try:
+        yield
+    finally:
+        disable(name)
+
+
+def list_active() -> dict[str, str]:
+    """Snapshot of the currently enabled failpoints (name -> action)."""
+    with _lock:
+        return dict(_active)
+
+
 def hits(name: str) -> int:
-    return _hits.get(name, 0)
+    with _lock:
+        return _hits.get(name, 0)
 
 
 def inject(name: str):
-    action = _active.get(name)
-    if action is None:
-        return None
+    # read + count under the SAME lock acquisition: the old lock-free
+    # probe could tear against a concurrent disable() and count a hit
+    # for a failpoint that no longer exists (satellite: utils/failpoint
+    # race); the uncontended-lock cost is ~100ns, fine for fault points
     with _lock:
+        action = _active.get(name)
+        if action is None:
+            return None
         _hits[name] = _hits.get(name, 0) + 1
+        hit = _hits[name]
     if action == "panic":
         raise FailpointError(f"failpoint {name} triggered")
     m = re.fullmatch(r"sleep\(([\d.]+)\)", action)
@@ -68,7 +95,16 @@ def inject(name: str):
             return raw.strip("'\"")
     m = re.fullmatch(r"(\d+)\*panic", action)
     if m:  # N*panic: raise for the first N hits, then no-op
-        if _hits.get(name, 0) <= int(m.group(1)):
+        if hit <= int(m.group(1)):
             raise FailpointError(f"failpoint {name} triggered")
+        return None
+    m = re.fullmatch(r"(\d+)\*return\((.*)\)", action)
+    if m:  # N*return(v): payload for the first N hits, then no-op
+        if hit <= int(m.group(1)):
+            raw = m.group(2)
+            try:
+                return int(raw)
+            except ValueError:
+                return raw.strip("'\"")
         return None
     raise ValueError(f"unknown failpoint action {action!r}")

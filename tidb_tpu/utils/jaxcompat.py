@@ -1,0 +1,36 @@
+"""JAX version compatibility for the distributed execution path.
+
+The MPP layer is written against the modern `jax.shard_map` API
+(`check_vma=` relaxation flag).  Older jax releases (<= 0.4.x, the
+pinned toolchain on some hosts) ship the same primitive as
+`jax.experimental.shard_map.shard_map` with the flag spelled
+`check_rep=`.  A bare import error here used to take down EVERY
+aggregate query — the executor imports mpp_exec unconditionally — which
+is exactly the ungraceful-death mode this resilience layer exists to
+remove, so the shim degrades across versions instead.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.6: top-level export, `check_vma` flag
+    from jax import shard_map as _shard_map
+except ImportError:  # jax <= 0.4.x: experimental module, `check_rep` flag
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kw):
+    if "check_vma" in kw and "check_vma" not in _PARAMS:
+        relaxed = kw.pop("check_vma")
+        if "check_rep" in _PARAMS:
+            kw["check_rep"] = relaxed
+    elif "check_rep" in kw and "check_rep" not in _PARAMS:
+        relaxed = kw.pop("check_rep")
+        if "check_vma" in _PARAMS:
+            kw["check_vma"] = relaxed
+    return _shard_map(*args, **kw)
