@@ -253,6 +253,45 @@ def _is_transient(exc) -> bool:
     return any(m in s for m in _TRANSIENT_MARKERS)
 
 
+class _QueryTimeout(Exception):
+    """Raised by SIGALRM inside a query that exceeded its per-query
+    budget — the bench SKIPS that query and continues, instead of the
+    whole run dying and losing every query after it (BENCH_TPU_LIVE lost
+    Q5–Q18 to exactly that)."""
+
+
+#: per-query watchdog state shared with the SIGALRM handler:
+#: _QUERY_GUARD flags that an alarm should raise (skip one query) rather
+#: than emit-and-exit (global watchdog); _ALARM_READY gates arming on the
+#: handler actually being installed (a test calling _bench_loop without
+#: main()'s signal setup must not arm SIGALRM's default action).
+_QUERY_GUARD = [False]
+_ALARM_READY = [False]
+_GLOBAL_DEADLINE = [0.0]
+
+
+def _arm_query_alarm(budget_s: int):
+    """Start the per-query deadline. Best effort: SIGALRM only interrupts
+    Python-level waits — a backend call blocked inside C holding the GIL
+    still falls to the hard subprocess killer, which is why that stays."""
+    if budget_s <= 0 or not _ALARM_READY[0]:
+        return
+    remaining = (_GLOBAL_DEADLINE[0] - time.time()
+                 if _GLOBAL_DEADLINE[0] else budget_s)
+    _QUERY_GUARD[0] = True
+    signal.alarm(max(1, int(min(budget_s, max(remaining, 1)))))
+
+
+def _disarm_query_alarm():
+    if not _ALARM_READY[0]:
+        return
+    _QUERY_GUARD[0] = False
+    if _GLOBAL_DEADLINE[0]:
+        signal.alarm(max(1, int(_GLOBAL_DEADLINE[0] - time.time())))
+    else:
+        signal.alarm(0)
+
+
 # ---------------------------------------------------------------------------
 # Data generators: synthetic TPC-H-shaped data, bulk-installed through the
 # Lightning-role columnar loader (no per-row encode). Shapes/distributions
@@ -638,6 +677,16 @@ def main():
     watchdog_s = int(os.environ.get("BENCH_TIMEOUT_S", "2700"))
 
     def _on_alarm(signum, frame):
+        global_up = (_GLOBAL_DEADLINE[0]
+                     and time.time() >= _GLOBAL_DEADLINE[0] - 1)
+        if _QUERY_GUARD[0] and not global_up:
+            # per-query deadline: skip THIS query, keep the run alive.
+            # The global deadline always wins — an expiry mid-query must
+            # still emit the tpch_bench_watchdog line and exit, not be
+            # laundered into an endless chain of per-query skips.
+            _QUERY_GUARD[0] = False
+            raise _QueryTimeout(
+                f"per-query watchdog fired (stage: {_STAGE[0]})")
         _emit({"metric": "tpch_bench_watchdog", "value": _COMPLETED[0],
                "unit": "queries_completed", "vs_baseline": 0,
                "error": f"watchdog after {watchdog_s}s",
@@ -646,6 +695,8 @@ def main():
 
     signal.signal(signal.SIGALRM, _on_alarm)
     signal.alarm(watchdog_s)
+    _ALARM_READY[0] = True
+    _GLOBAL_DEADLINE[0] = time.time() + watchdog_s
 
     # SIGALRM only fires when the GIL is available — a dead tunnel leaves
     # the axon client blocking INSIDE a C call holding the GIL forever
@@ -714,18 +765,63 @@ def main():
     n = gen_all(tk, sf)
 
     meta = {"platform": platform, "fallback": fallback, "sf": sf}
+    qbudget = int(os.environ.get("BENCH_QUERY_TIMEOUT_S", "900"))
+    failures = _bench_loop(tk, qnames, sf, n, meta, query_budget_s=qbudget)
+
+    signal.alarm(0)
+    _ALARM_READY[0] = False
+    if failures:
+        sys.exit(1)
+
+
+def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
+    """Per-query benchmark loop with a per-QUERY watchdog: a dead tunnel,
+    a remote-compile refusal, or an injected failure (BENCH_FAIL_QUERY=q3
+    — the chaos hook) costs only that query — an error JSON line is
+    emitted and the run continues with the next one, instead of one bad
+    query losing everything after it (BENCH_TPU_LIVE lost Q5–Q18 that
+    way). Returns the failure count.
+
+    compile_s is MEASURED engine compile time (device_exec
+    pipe_cache_stats: wall seconds of dispatches that triggered an XLA
+    trace) during the warmup run, no longer the warmup-minus-steady
+    difference; warm_compile_s is the same meter over the timed runs —
+    ~0 when the compiled-fragment cache and shape buckets are doing
+    their job."""
+    from tidb_tpu.executor.device_exec import pipe_cache_stats
+    inject = set(q.strip().lower() for q in
+                 os.environ.get("BENCH_FAIL_QUERY", "").split(",")
+                 if q.strip())
     failures = 0
     for qname in qnames:
         sql = QUERIES[qname]
+        _stage(f"{qname}: begin")
         try:
+            _arm_query_alarm(query_budget_s)
+            if qname in inject:
+                raise RuntimeError(
+                    f"injected backend failure for {qname} "
+                    "(BENCH_FAIL_QUERY)")
             for attempt in (1, 2):
                 try:
                     _stage(f"{qname}: device warmup (compile + materialize)")
                     tk.must_exec("set tidb_executor_engine = 'tpu'")
+                    st0 = pipe_cache_stats(thread_local=True)
+                    # two warmup runs, timed SEPARATELY: warm_t is the
+                    # FIRST (cold) run so warmup_minus_steady_s keeps its
+                    # historical meaning; the second run absorbs the
+                    # learned-size shrink-to-fit recompile (device_join
+                    # _CAP_STORE) so the timed window measures pure
+                    # dispatch
                     warm_t, _rows = time_query(tk, sql, repeats=1)
+                    time_query(tk, sql, repeats=1)
+                    st1 = pipe_cache_stats(thread_local=True)
                     _stage(f"{qname}: device timed runs")
                     dev_t, dev_rows = time_query(tk, sql, repeats=2)
+                    st2 = pipe_cache_stats(thread_local=True)
                     break
+                except _QueryTimeout:
+                    raise
                 except Exception as exc:
                     # a dropped relay/remote-compile endpoint is
                     # environmental — give it one recovery window
@@ -734,6 +830,14 @@ def main():
                     _stage(f"{qname}: transient backend error, retrying "
                            f"({exc})")
                     time.sleep(30)
+            compile_cold = st1["compile_s"] - st0["compile_s"]
+            compile_warm = st2["compile_s"] - st1["compile_s"]
+            compile_info = {
+                "compile_s": round(compile_cold, 4),
+                "warm_compile_s": round(compile_warm, 4),
+                "warmup_minus_steady_s": round(max(warm_t - dev_t, 0.0), 4),
+                "xla_compiles": st2["compiles"] - st0["compiles"],
+            }
 
             host_skip = (os.environ.get("BENCH_HOST_SKIP") == "1"
                          or sf >= 50)
@@ -747,7 +851,7 @@ def main():
                     "value": round(n / dev_t),
                     "unit": "lineitem_rows/s", "vs_baseline": 0,
                     "device_s": round(dev_t, 4),
-                    "compile_s": round(max(warm_t - dev_t, 0.0), 4),
+                    **compile_info,
                     "host_pending": True,
                     "peak_rss_mb": _peak_rss_mb(), **meta,
                 })
@@ -761,13 +865,32 @@ def main():
             _stage(f"{qname}: host reference run")
             tk.must_exec("set tidb_executor_engine = 'host'")
             host_t, host_rows = time_query(tk, sql, repeats=1)
-        except Exception as exc:
+        except _QueryTimeout as exc:
+            # also catches an alarm landing in the handler below or in
+            # the post-try tail: wherever the one-shot SIGALRM fires, it
+            # costs THIS query only
+            _disarm_query_alarm()
             failures += 1
             _emit({"metric": f"tpch_{qname}_sf{sf:g}", "value": 0,
                    "unit": "rows/s", "vs_baseline": 0,
                    "error": f"{type(exc).__name__}: {exc}"[:300],
+                   "skipped_by_watchdog": True,
                    "stage": _STAGE[0], **meta})
             continue
+        except Exception as exc:
+            # cancel the pending per-query alarm FIRST: it firing inside
+            # this handler would escape the loop and lose every query
+            # after this one (the exact failure the watchdog prevents)
+            _disarm_query_alarm()
+            failures += 1
+            _emit({"metric": f"tpch_{qname}_sf{sf:g}", "value": 0,
+                   "unit": "rows/s", "vs_baseline": 0,
+                   "error": f"{type(exc).__name__}: {exc}"[:300],
+                   "skipped_by_watchdog": False,
+                   "stage": _STAGE[0], **meta})
+            continue
+        finally:
+            _disarm_query_alarm()
 
         if dev_rows != host_rows:
             failures += 1
@@ -783,16 +906,13 @@ def main():
             "vs_baseline": round(host_t / dev_t, 3),
             "device_s": round(dev_t, 4),
             "host_s": round(host_t, 4),
-            # warmup − steady ≈ compile + first-materialization cost; the
-            # split r03 lacked, which hid where the device seconds went
-            "compile_s": round(max(warm_t - dev_t, 0.0), 4),
+            # engine-measured compile seconds (cold vs warm) — the split
+            # r03 lacked, which hid where the device seconds went
+            **compile_info,
             "peak_rss_mb": _peak_rss_mb(),
             **meta,
         })
-
-    signal.alarm(0)
-    if failures:
-        sys.exit(1)
+    return failures
 
 
 if __name__ == "__main__":

@@ -9,8 +9,10 @@ engine OPENS for a cooldown window — fragments degrade to the (always
 correct) host engine immediately instead of timing out one by one — then a
 HALF_OPEN probe re-admits one fragment and a success closes the breaker.
 
-States (the classic Nygard breaker, per-Domain so embedded test clusters
-stay isolated):
+States (the classic Nygard breaker, per-(Domain, fragment shape): embedded
+test clusters stay isolated, and a failure mode specific to one fragment
+class — agg vs join vs window — cools down only that class while healthy
+shapes keep running on-device):
 
     CLOSED     normal: device dispatch allowed, failures counted
     OPEN       cooling down: allow() is False, everything runs host-side
@@ -33,8 +35,9 @@ CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
 class CircuitBreaker:
     def __init__(self, threshold: int = 5, cooldown_s: float = 30.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, shape: str = "agg"):
         self._mu = threading.Lock()
+        self.shape = shape  # fragment class this breaker guards
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
@@ -130,24 +133,32 @@ class CircuitBreaker:
         self._probing = False
         self._probe_owner = None
         self.stats["opened"] += 1
-        log.warning("device circuit OPEN for %.1fs (last error: %s)",
-                    self.cooldown_s, self.last_error)
+        log.warning("device circuit OPEN for %s fragments for %.1fs "
+                    "(last error: %s)",
+                    self.shape, self.cooldown_s, self.last_error)
 
     def snapshot(self) -> dict:
         with self._mu:
-            return {"state": self._peek_state(), "failures": self._failures,
+            return {"state": self._peek_state(), "shape": self.shape,
+                    "failures": self._failures,
                     "threshold": self.threshold,
                     "cooldown_s": self.cooldown_s,
                     "last_error": self.last_error, **self.stats}
 
 
-#: process-wide fallback for contexts with no Domain (bare device calls)
-_GLOBAL = CircuitBreaker()
+#: process-wide fallback for contexts with no Domain (bare device calls),
+#: one breaker per fragment shape
+_GLOBALS: dict = {}
 
 
-def get_breaker(ctx=None) -> CircuitBreaker:
-    """The device breaker for this execution context: one per Domain (so
-    embedded test clusters are isolated), the module global otherwise.
+def get_breaker(ctx=None, shape: str = "agg") -> CircuitBreaker:
+    """The device breaker for this execution context and fragment SHAPE
+    (agg / join / window): one per (Domain, shape) so embedded test
+    clusters are isolated AND one failing fragment class cools down
+    without degrading healthy paths — a join-shape XLA bug must not push
+    scan-aggregates off the device (ROADMAP: finer per-fragment-shape
+    breaker). Falls back to a module-global per-shape breaker when the
+    context has no Domain.
 
     Knobs are read from the breaker's OWN scope — the Domain's GLOBAL
     variables (`SET GLOBAL tidb_device_circuit_*`) — on every fetch, so
@@ -156,10 +167,12 @@ def get_breaker(ctx=None) -> CircuitBreaker:
     clobber each other's threshold/cooldown mid-OPEN."""
     dom = getattr(ctx, "domain", None)
     if dom is not None:
-        br = getattr(dom, "_device_breaker", None)
+        brs = getattr(dom, "_device_breakers", None)
+        if brs is None:
+            brs = dom._device_breakers = {}
+        br = brs.get(shape)
         if br is None:
-            br = CircuitBreaker()
-            dom._device_breaker = br
+            br = brs[shape] = CircuitBreaker(shape=shape)
         try:
             gv = dom.global_vars
             br.configure(
@@ -169,7 +182,9 @@ def get_breaker(ctx=None) -> CircuitBreaker:
         except Exception:
             pass
         return br
-    br = _GLOBAL
+    br = _GLOBALS.get(shape)
+    if br is None:
+        br = _GLOBALS[shape] = CircuitBreaker(shape=shape)
     if ctx is not None:  # bare context: its own view is the only scope
         try:
             br.configure(

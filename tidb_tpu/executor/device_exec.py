@@ -37,7 +37,7 @@ def engine_mode(ctx) -> str:
         return "auto"
 
 
-def run_device(ctx, fn, /, *args, **kw):
+def run_device(ctx, fn, /, *args, shape="agg", **kw):
     """Dispatch one device fragment through the circuit breaker
     (executor/circuit.py): an OPEN breaker degrades to the host engine
     up front (DeviceUnsupported → the caller's existing fallback), and a
@@ -45,14 +45,18 @@ def run_device(ctx, fn, /, *args, **kw):
     remote-compile tunnel, an injected fault — records into the breaker
     and ALSO degrades instead of killing the query.  DeviceUnsupported
     and TiDBError pass through untouched: "this fragment doesn't fit the
-    device" and genuine user errors are not health signals."""
+    device" and genuine user errors are not health signals.
+
+    `shape` scopes the breaker per fragment class (agg / join / window):
+    one failing shape cools down without degrading healthy paths."""
     from ..utils.backoff import (classify, CLASS_DEVICE, CLASS_EXCHANGE,
                                  CLASS_FAULT, CLASS_TRANSPORT)
     from .circuit import get_breaker
-    br = get_breaker(ctx)
+    br = get_breaker(ctx, shape=shape)
     if not br.allow():
-        raise DeviceUnsupported("device circuit open (cooling down; "
-                                "fragment degraded to host engine)")
+        raise DeviceUnsupported(
+            f"device circuit open for {shape} fragments (cooling down; "
+            "fragment degraded to host engine)")
     try:
         out = fn(*args, **kw)
     except (DeviceUnsupported, TiDBError):
@@ -102,17 +106,60 @@ def want_device(ctx, n_rows: int) -> bool:
 #: filter→keys→values→aggregate program is ONE XLA computation, traced once
 #: and re-dispatched on later executions (reference analog: coprocessor DAG
 #: compiled per plan digest). LRU-bounded; each entry pins strong refs to
-#: the string dictionaries whose codes are baked into the traced program,
-#: which makes the id()-based key component sound: a live referenced object
-#: can never share its id with a newly allocated dictionary.
+#: the string dictionaries whose codes are baked into the traced program.
+#: Key components that depend on a dictionary use its CONTENT signature
+#: (utils/chunk.py dict_content_sig), not its id: a delta append re-encodes
+#: into new dictionary objects whose content — and therefore every baked
+#: code LUT — is usually unchanged, and shape bucketing (ops/device.py
+#: bucket_rows) keeps the traced array shapes stable too, so the compiled
+#: program survives the delta.
 _PIPE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _PIPE_CACHE_MAX = 256
+
+#: compiled-fragment cache observability: hits/misses are _PIPE_CACHE
+#: lookups; traces counts actual jax retraces (one per XLA compile);
+#: compile_s is wall time of dispatches that triggered a trace. Surfaced
+#: per query through EXPLAIN ANALYZE annotations and bench.py compile_s.
+#: Process totals are lock-guarded; a THREAD-LOCAL mirror gives per-query
+#: delta attribution that concurrent sessions can't cross-charge.
+import threading as _threading
+
+_PIPE_STATS = {"hits": 0, "misses": 0, "traces": 0, "compiles": 0,
+               "compile_s": 0.0}
+_PIPE_LOCK = _threading.Lock()
+_PIPE_TLS = _threading.local()
+
+
+def _tls_stats() -> dict:
+    st = getattr(_PIPE_TLS, "stats", None)
+    if st is None:
+        st = _PIPE_TLS.stats = {"hits": 0, "misses": 0, "traces": 0,
+                                "compiles": 0, "compile_s": 0.0}
+    return st
+
+
+def _bump(key, amt=1):
+    with _PIPE_LOCK:
+        _PIPE_STATS[key] += amt
+    _tls_stats()[key] += amt
+
+
+def pipe_cache_stats(thread_local: bool = False) -> dict:
+    """Cache/compile counters: process-wide totals by default, or this
+    thread's own (for before/after deltas around one dispatch — the
+    process totals would charge a concurrent session's compile here)."""
+    if thread_local:
+        return dict(_tls_stats())
+    with _PIPE_LOCK:
+        return dict(_PIPE_STATS)
 
 
 def _pipe_cache_get(key):
     hit = _PIPE_CACHE.get(key)
     if hit is None:
+        _bump("misses")
         return None
+    _bump("hits")
     _PIPE_CACHE.move_to_end(key)
     return hit[0]
 
@@ -121,6 +168,53 @@ def _pipe_cache_put(key, fn, dict_refs):
     _PIPE_CACHE[key] = (fn, dict_refs)
     if len(_PIPE_CACHE) > _PIPE_CACHE_MAX:
         _PIPE_CACHE.popitem(last=False)
+
+
+def _count_trace():
+    """Called from INSIDE a traced pipeline body: runs once per jax
+    retrace (i.e. per XLA compile), never on a cached dispatch — and on
+    the thread that dispatched, so the thread-local mirror attributes the
+    compile to the right query."""
+    _bump("traces")
+
+
+def _charge_compile_s(seconds):
+    _bump("compiles")
+    _bump("compile_s", seconds)
+
+
+# kernel-layer observability hooks: installing these makes
+# ops/device.observed_jit meter retraces and compile seconds into the
+# stats above — for the fused pipelines here AND the standalone
+# join-match / topk / graft-agg kernels (one wrapper implementation,
+# hook-wired so ops/device never imports the executor layer)
+dev._trace_cb = _count_trace
+dev._tls_traces = lambda: _tls_stats()["traces"]
+dev._charge_compile = _charge_compile_s
+
+
+def _timed_jit(fn):
+    """jax.jit with compile accounting (ops/device.observed_jit with the
+    hooks above installed): a dispatch that triggered a retrace — the
+    body calls _count_trace — charges its wall time (trace + XLA compile
+    + dispatch) to compile_s; cached dispatches pay only a counter
+    read."""
+    return dev.observed_jit(fn)
+
+
+def _dc_sig(dc) -> str:
+    """Content signature of a DeviceCol's dictionary for cache keys (falls
+    back to id() only when no backing host column exists)."""
+    if dc.dictionary is None:
+        return ""
+    hc = dc.host_col
+    if hc is not None:
+        try:
+            return hc.dict_sig()
+        except Exception:
+            pass
+    from ..utils.chunk import dict_content_sig
+    return dict_content_sig(dc.dictionary)
 
 
 def _expr_sig(e) -> str:
@@ -147,24 +241,33 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
     it: mask, keys, values and the aggregate all fuse into a single XLA
     executable — no eager op dispatch between operators.
 
+    The program takes `(env, n_live)` where env arrays may be BUCKET-PADDED
+    past the live rows (ops/device.py bucket_rows): rows at positions >=
+    n_live are masked out before the aggregate, so padding can never
+    survive a filter or contribute to any group. n_live is a traced
+    scalar — within-bucket row-count changes re-dispatch without a
+    retrace.
+
     raw_tail: stop before the in-kernel aggregate and return the
     evaluated (key_cols, key_nulls, val_cols, val_nulls, mask) rows —
     the CPU-backend streamed path aggregates them in numpy (see
     _merge_states_host: the XLA-CPU group-by pays in the packed key
     span; a host reduceat over one block is row-proportional)."""
 
-    def pipeline(env):
+    def pipeline(env, n_live):
+        _count_trace()
         first = next(iter(env.values()))[0]
         n = first.shape[0]
+        live = jnp.arange(n) < n_live
         if cond_fns:
             mask = None
             for f in cond_fns:
                 d, nl = f(env)
                 m = (d != 0) & ~nl
                 mask = m if mask is None else (mask & m)
-            mask = jnp.broadcast_to(mask, (n,))
+            mask = jnp.broadcast_to(mask, (n,)) & live
         else:
-            mask = jnp.ones(n, dtype=bool)
+            mask = live
         key_cols, key_nulls = [], []
         for f in key_fns:
             d, nl = dev.broadcast_1d(*f(env), n)
@@ -196,7 +299,7 @@ def _build_pipeline(cond_fns, key_fns, n_keys, val_plan, agg_ops,
                              n_keys=n_keys, agg_ops=agg_ops,
                              capacity=capacity, pack=pack)
 
-    return jax.jit(pipeline)
+    return _timed_jit(pipeline)
 
 
 def _agg_used_columns(plan, conds) -> set:
@@ -213,13 +316,15 @@ def _agg_used_columns(plan, conds) -> set:
 
 def _agg_sig(plan, conds, dcols) -> tuple:
     """(signature string, dictionary refs) for the pipeline cache — shared
-    by the whole-table and streamed paths so their caches never diverge."""
+    by the whole-table and streamed paths so their caches never diverge.
+    Dictionaries contribute their CONTENT signature: a delta append that
+    re-encodes the same value set must hit the cached pipeline."""
     sig = ";".join(
         [_expr_sig(c) for c in conds] + ["|g|"] +
         [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
         [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
          for d in plan.aggs] +
-        [str(id(dc.dictionary)) for dc in dcols.values()
+        [f"{idx}:{_dc_sig(dc)}" for idx, dc in sorted(dcols.items())
          if dc.dictionary is not None])
     refs = tuple(dc.dictionary for dc in dcols.values()
                  if dc.dictionary is not None)
@@ -236,11 +341,14 @@ def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:
     n = chunk.num_rows
     if n == 0:
         raise DeviceUnsupported("empty input")
+    # canonicalize the traced shape: upload at the row bucket, mask live
+    # rows in-program — a within-bucket delta reuses the compiled pipeline
+    nb = dev.bucket_rows(n, dev.shape_buckets(ctx))
     used = _agg_used_columns(plan, conds)
     dcols = {}
     env = {}
     for idx in used:
-        dc = dev.to_device_col(chunk.columns[idx])
+        dc = dev.to_device_col(chunk.columns[idx], bucket=nb)
         dcols[idx] = dc
         env[idx] = (dc.data, dc.nulls)
     if not env:
@@ -261,7 +369,7 @@ def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:
             fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
                                  tuple(agg_ops), capacity, key_pack)
             _pipe_cache_put(key, fn, dict_refs)
-        f = AggFetch(fn(env), topn=resolve_topn(plan, slots))
+        f = AggFetch(fn(env, np.int64(n)), topn=resolve_topn(plan, slots))
         ng = f.ng
         if ng <= capacity:
             break
@@ -359,6 +467,7 @@ def _topk_indices(keys, key_nulls, results, result_nulls, ng, cap, specs,
         descs = [s[2] for s in specs]
 
         def run(by_arrays, ng_):
+            _count_trace()
             lex = []  # jnp.lexsort: minor → major
             for (d, nl), desc in zip(reversed(by_arrays), reversed(descs)):
                 if jnp.issubdtype(d.dtype, jnp.floating):
@@ -373,7 +482,7 @@ def _topk_indices(keys, key_nulls, results, result_nulls, ng, cap, specs,
             lex.append(jnp.arange(cap) >= ng_)  # live rows first
             return jnp.lexsort(lex)[:k]
 
-        fn = _TOPK_CACHE[sig] = jax.jit(run)
+        fn = _TOPK_CACHE[sig] = _timed_jit(run)
     return fn(by, ng)
 
 
@@ -732,10 +841,15 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
             hi = min(lo + batch_rows, n)
             # the asarray calls enqueue this block's host→HBM copies; the
             # kernel dispatch below is async, so block k+1's transfer
-            # overlaps block k's compute
-            env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
+            # overlaps block k's compute. Every block — the tail included —
+            # pads to the SAME batch_rows shape (live rows masked by the
+            # traced n_live), so one compiled program serves the whole
+            # stream at any input size
+            env = {idx: (jnp.asarray(dev.pad_host(d[lo:hi], batch_rows)),
+                         jnp.asarray(dev.pad_host(nl[lo:hi], batch_rows,
+                                                  True)))
                    for idx, (d, nl) in col_arrays.items()}
-            buffered.append(fn(env))
+            buffered.append(fn(env, np.int64(hi - lo)))
             if len(buffered) >= k_flush:
                 # incremental fold: HBM holds at most k_flush partials +
                 # the running state, never all n/batch_rows of them
@@ -811,9 +925,10 @@ def _stream_agg_host_tail(plan, chunk, conds, batch_rows, ctx, col_arrays,
     states = []
     for lo in range(0, n, batch_rows):
         hi = min(lo + batch_rows, n)
-        env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
+        env = {idx: (jnp.asarray(dev.pad_host(d[lo:hi], batch_rows)),
+                     jnp.asarray(dev.pad_host(nl[lo:hi], batch_rows, True)))
                for idx, (d, nl) in col_arrays.items()}
-        raw = fn(env)
+        raw = fn(env, np.int64(hi - lo))
         page = page_singleton_state(raw[0], raw[1], raw[2], raw[3],
                                     raw[4], agg_ops)
         state, _cap = _merge_states_host([page], 16, n_keys, nvals,
@@ -871,9 +986,11 @@ def _stream_count_distinct(plan, conds, chunk, col_arrays, dcols, cond_fns,
         partials = []
         for lo in range(0, n, batch_rows):
             hi = min(lo + batch_rows, n)
-            env = {idx: (jnp.asarray(d[lo:hi]), jnp.asarray(nl[lo:hi]))
+            env = {idx: (jnp.asarray(dev.pad_host(d[lo:hi], batch_rows)),
+                         jnp.asarray(dev.pad_host(nl[lo:hi], batch_rows,
+                                                  True)))
                    for idx, (d, nl) in col_arrays.items()}
-            partials.append(fn(env))
+            partials.append(fn(env, np.int64(hi - lo)))
         counts = [int(c) for c in jax.device_get([p[4] for p in partials])]
         if max(counts) <= capacity:
             break
@@ -1101,10 +1218,14 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
     for f in p.funcs:
         for a in f.args:
             a.columns_used(used)
+    # bucketed upload: padding rows sort behind every live row (validity is
+    # the most-major sort key) and form their own trailing partition, so no
+    # rank/aggregate of a real partition ever sees them
+    nb = dev.bucket_rows(n, dev.shape_buckets(ctx))
     dcols = {}
     env = {}
     for idx_ in used:
-        dc = dev.to_device_col(chunk.columns[idx_])
+        dc = dev.to_device_col(chunk.columns[idx_], bucket=nb)
         dcols[idx_] = dc
         env[idx_] = (dc.data, dc.nulls)
 
@@ -1117,8 +1238,14 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
     kinds = tuple(phys_kind(f.args[0].ftype) if f.name in _WIN_AGGS else None
                   for f in p.funcs)
 
-    def run(env):
+    def run(env, n_live):
+        _count_trace()
+        # padded (bucket) length from the closure, NOT an env array: a
+        # window over no columns at all (count(*) OVER ()) has an empty
+        # env, and the cache key already pins nb
+        n = nb
         i = jnp.arange(n)
+        in_live = i < n_live
         lex = []  # minor → major: tiebreak, order keys reversed, partition
 
         def push_key(d, nl, desc):
@@ -1145,7 +1272,10 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
             push_key(d, nl, desc)
         for d, nl in reversed(part_kvs):
             push_key(d, nl, False)
-        idx = jnp.lexsort(lex) if lex else i
+        # validity is the MOST-major key: bucket-padding rows sort behind
+        # every live row (stable, so a keyless window keeps input order)
+        lex.append(~in_live)
+        idx = jnp.lexsort(lex)
         inv = jnp.argsort(idx)
 
         def change(kvs):
@@ -1164,6 +1294,10 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
 
         part_change = (change(part_kvs) if part_kvs
                        else jnp.zeros(n, dtype=bool).at[0].set(True))
+        # sorted position n_live is the first padding row (validity-major
+        # sort): force a partition boundary there so padding forms its own
+        # trailing segment and never extends a real partition's frame
+        part_change = part_change | (i == n_live)
         peer_change = part_change | (change(order_kvs) if order_kvs
                                      else jnp.zeros(n, dtype=bool))
         spos = jax.lax.cummax(jnp.where(part_change, i, -1))
@@ -1244,23 +1378,28 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
             outs.append((v[inv], (cnt_run == 0)[inv]))
         return tuple(outs)
 
-    # dictionary identity is load-bearing in the key (and the refs must be
-    # pinned): compiled str-expr LUTs bake the dictionary's codes, exactly
-    # like the agg pipeline cache (_agg_sig / _pipe_cache_put)
+    # dictionary CONTENT is the load-bearing key component: compiled
+    # str-expr LUTs bake the dictionary's codes, exactly like the agg
+    # pipeline cache (_agg_sig / _pipe_cache_put); the shape key is the
+    # BUCKET, so a within-bucket delta re-dispatches the compiled program
     dict_refs = tuple(dc.dictionary for dc in dcols.values()
                       if dc.dictionary is not None)
-    sig = (n, names, kinds, has_order,
+    sig = (nb, names, kinds, has_order,
            tuple(_expr_sig(e) for e in p.partition_exprs),
            tuple((_expr_sig(e), d) for e, d in p.order_by),
            tuple(_expr_sig(f.args[0]) if f.name in _WIN_AGGS else None
                  for f in p.funcs),
-           tuple(str(id(d)) for d in dict_refs))
+           tuple(f"{idx_}:{_dc_sig(dc)}" for idx_, dc in sorted(dcols.items())
+                 if dc.dictionary is not None))
     fn = _pipe_cache_get(("win",) + sig)
     if fn is None:
-        fn = jax.jit(run)
+        fn = _timed_jit(run)
         _pipe_cache_put(("win",) + sig, fn, dict_refs)
-    outs = jax.device_get(fn(env))
+    outs = jax.device_get(fn(env, np.int64(n)))
 
+    # outputs are padded to the bucket; positions past the live rows belong
+    # to the trailing padding partition — slice them away
+    outs = tuple((np.asarray(d)[:n], np.asarray(nl)[:n]) for d, nl in outs)
     out_cols = list(chunk.columns)
     oi = 0
     for f in p.funcs:

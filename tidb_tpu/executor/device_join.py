@@ -50,14 +50,14 @@ from ..expression.core import Column as ExprColumn, ScalarFunc as _SF
 from ..ops import device as dev
 from ..ops.device import DeviceUnsupported
 from .device_exec import (
-    _assemble_agg, _estimate_groups, _expr_sig, _pipe_cache_get,
-    _pipe_cache_put, _plan_agg)
+    _assemble_agg, _count_trace, _estimate_groups, _expr_sig,
+    _pipe_cache_get, _pipe_cache_put, _plan_agg, _timed_jit)
 from .join_index import build_join_index
 
 
 class _Leaf:
     __slots__ = ("leaf_id", "chunk", "conds", "offset", "ncols", "dcols",
-                 "leaf_ids")
+                 "dcols_bucket", "leaf_ids", "bucket")
 
     def __init__(self, leaf_id, chunk, conds, offset):
         self.leaf_id = leaf_id
@@ -66,7 +66,9 @@ class _Leaf:
         self.offset = offset
         self.ncols = chunk.num_cols
         self.dcols = None  # {local_idx: DeviceCol}
+        self.dcols_bucket = None  # bucket the cached dcols were built at
         self.leaf_ids = frozenset((leaf_id,))
+        self.bucket = None  # padded upload rows (ops/device.py bucket_rows)
 
 
 class _JoinNode:
@@ -191,11 +193,18 @@ def collect_tree(node):
     return root, leaves, joins
 
 
-def _leaf_env(leaf):
-    """Device columns for one leaf, cached on the host Columns."""
-    if leaf.dcols is None:
-        leaf.dcols = {i: dev.to_device_col(c)
+def _leaf_env(leaf, bucket=None):
+    """Device columns for one leaf, cached on the host Columns. `bucket`
+    pads the upload to a canonical row bucket (ops/device.py bucket_rows);
+    the compiled fragment masks rows past the leaf's traced live count.
+    The cache is keyed by the bucket it was built at: a declined earlier
+    attempt (mpp, paged) must not leave exact-shape dcols that the
+    bucketed resident path would silently trace against (to_device_col
+    reuses/slices the underlying column upload, so a rebuild is cheap)."""
+    if leaf.dcols is None or leaf.dcols_bucket != bucket:
+        leaf.dcols = {i: dev.to_device_col(c, bucket=bucket)
                       for i, c in enumerate(leaf.chunk.columns)}
+        leaf.dcols_bucket = bucket
     return leaf.dcols
 
 
@@ -208,14 +217,14 @@ def _leaf_meta(leaf):
             for i, c in enumerate(leaf.chunk.columns)}
 
 
-def _global_dcols(leaves, meta_leaf_ids=frozenset()):
+def _global_dcols(leaves, meta_leaf_ids=frozenset(), buckets=None):
     """DeviceCol lookup keyed by global (join-output) column index.
     Leaves in `meta_leaf_ids` contribute metadata-only DeviceCols —
     their columns must never be uploaded whole (paged probe side)."""
     out = {}
     for leaf in leaves:
         dcs = (_leaf_meta(leaf) if leaf.leaf_id in meta_leaf_ids
-               else _leaf_env(leaf))
+               else _leaf_env(leaf, (buckets or {}).get(leaf.leaf_id)))
         for i, dc in dcs.items():
             out[leaf.offset + i] = dc
     return out
@@ -560,23 +569,25 @@ def _pack_probe(kds, knulls, pvalid, packs):
 
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                      capacity, key_pack, agg_meta, compact_cap=None,
-                     paged_leaf=None, raw_tail=False):
+                     raw_tail=False):
     """Build the jitted end-to-end program. caps: per-join static
-    capacities aligned with `joins`. Returns jitted fn(env, jidx[, n_live])
+    capacities aligned with `joins`. Returns jitted fn(env, jidx, n_lives)
     where env is {global_col: (data, nulls)} and jidx is a per-join tuple
     of host-index device arrays (passed as arguments, not baked, so a data
     refresh with unchanged shapes reuses the compiled program).
+
+    n_lives: per-leaf traced live-row counts, ordered by leaf_id. Env
+    arrays may be padded past them — bucket-padded resident uploads, the
+    paged probe's last page — and every leaf masks its rows at
+    `arange(n) < n_lives[leaf_id]`, so padding can never survive the scan
+    filter, probe a join, or reach the aggregate. Traced scalars: a
+    within-bucket row-count change re-dispatches without recompiling.
 
     compact_cap: when set (CPU backend, learned from a prior run), the
     post-join/filter rows are scatter-compacted to this static width
     before the aggregate — a fact-shaped fragment output with a sparse
     validity mask (the price of the gather-join design) would otherwise
     drag the full fact length through the group-by sort.
-
-    paged_leaf: leaf_id whose env arrays are PAGE SLICES of the fact
-    table; the program takes an extra traced scalar `n_live` and masks
-    that leaf's rows past it (the last page is padded to the static page
-    shape — padding rows must not survive the scan filter).
 
     raw_tail: stop BEFORE the in-kernel aggregate and return the evaluated
     (key_cols, key_nulls, val_cols, val_nulls, mask) row arrays instead.
@@ -616,7 +627,9 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
     cond_fns = [dev.compile_expr(c, dcols) for c in agg_conds]
     key_fns, val_plan, agg_ops, slots = agg_meta
 
-    def run(env, jidx, n_live=None):
+    def run(env, jidx, n_lives):
+        _count_trace()
+
         # env keyed by global column index → (data, nulls) on device
         def leaf_rel(leaf):
             # row count off the leaf's first env-present column (a pruned
@@ -631,10 +644,9 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                     m = (d != 0) & ~nl
                     mask = m if mask is None else mask & m
                 mask = jnp.broadcast_to(mask, (n,))
+                mask = mask & (jnp.arange(n) < n_lives[leaf.leaf_id])
             else:
-                mask = jnp.ones(n, dtype=bool)
-            if paged_leaf is not None and leaf.leaf_id == paged_leaf:
-                mask = mask & (jnp.arange(n) < n_live)
+                mask = jnp.arange(n) < n_lives[leaf.leaf_id]
             return {leaf.leaf_id: jnp.arange(n)}, mask
 
         overflows = []
@@ -866,7 +878,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                                 capacity=capacity, pack=key_pack)
         return agg_out, tuple(overflows), tuple(span_ovfs), kept_total
 
-    return jax.jit(run)
+    return _timed_jit(run)
 
 
 def _shift_expr(e, offset):
@@ -888,7 +900,10 @@ def _fill_caps(node, sig):
     over a device tunnel), overshoot only pads the kernels; the learned
     store tightens the shapes from the second compile on."""
     if isinstance(node, _Leaf):
-        return node.chunk.num_rows
+        # BUCKET space, not the live row count: probe-shaped capacities
+        # flow into the compiled program's static shapes and the pipeline
+        # cache key, and must stay stable across within-bucket deltas
+        return node.bucket or node.chunk.num_rows
 
     lc = _fill_caps(node.left, sig)
     rc = _fill_caps(node.right, sig)
@@ -983,16 +998,25 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
                     # whole-table upload of a disk-resident fact is not a
                     # fallback — let the host path stream it instead
                     raise
-    dcols = _global_dcols(leaves)
+    # canonical row buckets per leaf: uploads pad to the bucket, the
+    # program masks each leaf at its traced live count — a delta append
+    # that stays inside the bucket reuses the compiled fragment
+    per_double = dev.shape_buckets(ctx)
+    buckets = {}
+    for leaf in leaves:
+        leaf.bucket = buckets[leaf.leaf_id] = dev.bucket_rows(
+            leaf.chunk.num_rows, per_double)
+    dcols = _global_dcols(leaves, buckets=buckets)
     agg_meta_full = _plan_agg(agg_plan, dcols)
     key_fns, key_meta, key_pack, val_plan, agg_ops, slots = agg_meta_full
     agg_meta = (key_fns, val_plan, agg_ops, slots)
 
-    # env: every base column once, device-resident
+    # env: every base column once, device-resident (bucket-padded)
     env = {}
     for leaf in leaves:
-        for i, dc in _leaf_env(leaf).items():
+        for i, dc in _leaf_env(leaf, buckets[leaf.leaf_id]).items():
             env[leaf.offset + i] = (dc.data, dc.nulls)
+    n_lives = tuple(np.int64(leaf.chunk.num_rows) for leaf in leaves)
 
     sig = fragment_sig(leaves, joins, agg_conds, agg_plan)
     dict_refs = tuple(dc.dictionary for dc in dcols.values()
@@ -1040,7 +1064,7 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
                                   caps, capacity, key_pack, agg_meta,
                                   compact_cap=compact_cap)
             _pipe_cache_put(key, fn, dict_refs)
-        agg_out, ovf_d, sovf_d, kept_d = fn(env, jidx)
+        agg_out, ovf_d, sovf_d, kept_d = fn(env, jidx, n_lives)
         from .device_exec import AggFetch, resolve_topn
         f = AggFetch(agg_out, extras=(ovf_d, sovf_d, kept_d),
                      topn=resolve_topn(agg_plan, slots))
@@ -1243,6 +1267,7 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
         if not any(leaf.offset + i in used for i in range(leaf.ncols)):
             used.add(leaf.offset)
     from ..storage.paged import chunk_is_paged
+    per_double = dev.shape_buckets(ctx)
     env_dim = {}
     for leaf in leaves:
         if leaf.leaf_id == probe.leaf_id:
@@ -1253,8 +1278,10 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
             if est > _DIM_RESIDENT_BUDGET:
                 raise DeviceUnsupported(
                     "paged build-side leaf exceeds resident budget")
+        dim_bucket = dev.bucket_rows(leaf.chunk.num_rows, per_double)
         for i in lused:
-            dc = dev.to_device_col(leaf.chunk.columns[i])
+            dc = dev.to_device_col(leaf.chunk.columns[i],
+                                   bucket=dim_bucket)
             env_dim[leaf.offset + i] = (dc.data, dc.nulls)
     probe_arrays = {
         probe.offset + i: dev.meta_device_col(c)[1]
@@ -1277,12 +1304,15 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
     learned_total = _CAP_STORE.get((sig, "groups"))
     merge_cap = dev.next_pow2(max(learned_total or capacity, 16))
 
-    def pad_page(arr, lo, hi):
-        blk = np.asarray(arr[lo:hi])
-        if hi - lo < page_rows:
-            blk = np.concatenate(
-                [blk, np.zeros(page_rows - (hi - lo), dtype=blk.dtype)])
-        return jnp.asarray(blk)
+    def pad_page(arr, lo, hi, null_pad=False):
+        return jnp.asarray(dev.pad_host(arr[lo:hi], page_rows, null_pad))
+
+    base_lives = [np.int64(leaf.chunk.num_rows) for leaf in leaves]
+
+    def page_lives(hi, lo):
+        lives = list(base_lives)
+        lives[probe.leaf_id] = np.int64(hi - lo)
+        return tuple(lives)
 
     from .device_exec import merge_partial_states
 
@@ -1309,8 +1339,7 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
         fn = _pipe_cache_get(key)
         if fn is None:
             fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
-                                  caps, capacity, key_pack, agg_meta,
-                                  paged_leaf=probe.leaf_id)
+                                  caps, capacity, key_pack, agg_meta)
             _pipe_cache_put(key, fn, dict_refs)
         k_flush = max(1, _MERGE_BUDGET_ROWS // capacity)
         state = None
@@ -1325,9 +1354,9 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
             env = dict(env_dim)
             t0 = _time.perf_counter()
             for gidx, (d, nl) in probe_arrays.items():
-                env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi))
+                env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi, True))
             t1 = _time.perf_counter()
-            agg_out, _ovf, _sovf, _kept = fn(env, jidx, hi - lo)
+            agg_out, _ovf, _sovf, _kept = fn(env, jidx, page_lives(hi, lo))
             t2 = _time.perf_counter()
             stats["pages"] += 1
             stats["slice_s"] += t1 - t0
@@ -1405,17 +1434,13 @@ def _paged_join_agg_host_tail(root, leaves, joins, probe, agg_plan,
     if fn is None:
         fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
                               [page_rows] * len(joins), 1, key_pack,
-                              agg_meta, paged_leaf=probe.leaf_id,
-                              raw_tail=True)
+                              agg_meta, raw_tail=True)
         _pipe_cache_put(key, fn, dict_refs)
 
-    def pad_page(arr, lo, hi):
-        blk = np.asarray(arr[lo:hi])
-        if hi - lo < page_rows:
-            blk = np.concatenate(
-                [blk, np.zeros(page_rows - (hi - lo), dtype=blk.dtype)])
-        return jnp.asarray(blk)
+    def pad_page(arr, lo, hi, null_pad=False):
+        return jnp.asarray(dev.pad_host(arr[lo:hi], page_rows, null_pad))
 
+    base_lives = [np.int64(leaf.chunk.num_rows) for leaf in leaves]
     stats = {"pages": 0, "slice_s": 0.0, "dispatch_s": 0.0, "sync_s": 0.0,
              "merge_s": 0.0}
     states = []
@@ -1424,9 +1449,11 @@ def _paged_join_agg_host_tail(root, leaves, joins, probe, agg_plan,
         env = dict(env_dim)
         t0 = _time.perf_counter()
         for gidx, (d, nl) in probe_arrays.items():
-            env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi))
+            env[gidx] = (pad_page(d, lo, hi), pad_page(nl, lo, hi, True))
         t1 = _time.perf_counter()
-        raw, _ovf, _sovf, _kept = fn(env, jidx, hi - lo)
+        lives = list(base_lives)
+        lives[probe.leaf_id] = np.int64(hi - lo)
+        raw, _ovf, _sovf, _kept = fn(env, jidx, tuple(lives))
         t2 = _time.perf_counter()
         # per-page compaction keeps at most one compact state per page in
         # RAM (zero-copy views of the page's buffers drop right after)
@@ -1470,7 +1497,11 @@ def fragment_sig(leaves, joins, agg_conds, agg_plan):
                      + ";".join(_expr_sig(c) for c in leaf.conds))
         for c in leaf.chunk.columns:
             if c.is_object():
-                parts.append(str(id(c.dict_encode()[1])))
+                # CONTENT signature, not id(): a delta append re-encodes
+                # the same value set into a new dictionary object, and the
+                # compiled fragment (whose LUTs bake the content) must
+                # still hit
+                parts.append(c.dict_sig())
     for jn in joins:
         keys = ",".join(f"{_expr_sig(lk)}={_expr_sig(rk)}"
                         for lk, rk in zip(jn.left_keys, jn.right_keys))

@@ -109,7 +109,9 @@ class InsertExec:
                         vals.append((d, src.ftype))
                     else:
                         e = b.build(node)
-                        vals.append((e.eval_scalar(), e.ftype))
+                        # internal repr: the (value, ftype) pair feeds
+                        # convert_internal, which is scale-aware
+                        vals.append((e.eval_scalar_internal(), e.ftype))
                 rows.append(vals)
 
         txn = sess.txn_for_write()

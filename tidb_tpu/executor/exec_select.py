@@ -62,6 +62,23 @@ class QueryExecutor:
         if self.stats is not None:
             self.stats.annotate(self.plan, **kv)
 
+    def _with_pipe_stats(self, fn, /, *args, **kw):
+        """Run a device dispatch and annotate the compiled-fragment cache
+        delta — hits/misses, XLA compiles triggered, compile seconds — so
+        EXPLAIN ANALYZE answers "did this query pay a compile" directly
+        (the TPU analog of cop-task build info)."""
+        from .device_exec import pipe_cache_stats
+        st0 = pipe_cache_stats(thread_local=True)
+        out = fn(*args, **kw)
+        if self.stats is not None:
+            st1 = pipe_cache_stats(thread_local=True)
+            self.annotate(
+                pipe_hits=st1["hits"] - st0["hits"],
+                pipe_misses=st1["misses"] - st0["misses"],
+                xla_compiles=st1["compiles"] - st0["compiles"],
+                compile_s=round(st1["compile_s"] - st0["compile_s"], 3))
+        return out
+
 
 def build_executor(plan, ctx, stats=None) -> QueryExecutor:
     if isinstance(plan, Join):
@@ -409,13 +426,16 @@ class HashAggExec(QueryExecutor):
         if mesh is not None:
             try:
                 if raw is not None:
-                    out = run_device(self.ctx, mpp_agg, eff_p, raw, conds,
-                                     self.ctx, mesh)
+                    out = self._with_pipe_stats(
+                        run_device, self.ctx, mpp_agg, eff_p, raw, conds,
+                        self.ctx, mesh, shape="agg")
                     self._mark_fragment("tpu-mpp", raw.num_rows)
                     return out
                 if isinstance(join_child, HashJoinExec):
-                    out = run_device(self.ctx, mpp_join_agg, eff_p,
-                                     agg_conds, join_child, self.ctx, mesh)
+                    out = self._with_pipe_stats(
+                        run_device, self.ctx, mpp_join_agg, eff_p,
+                        agg_conds, join_child, self.ctx, mesh,
+                        shape="join")
                     self._mark_fragment("tpu-mpp", None)
                     return out
             except DeviceUnsupported:
@@ -453,9 +473,10 @@ class HashAggExec(QueryExecutor):
             if batch > 0 and (paged_in or raw.num_rows > batch):
                 from .device_exec import device_agg_streaming
                 try:
-                    out = run_device(self.ctx, device_agg_streaming,
-                                     eff_p, raw, conds, batch,
-                                     ctx=self.ctx, allow_single=paged_in)
+                    out = self._with_pipe_stats(
+                        run_device, self.ctx, device_agg_streaming,
+                        eff_p, raw, conds, batch,
+                        ctx=self.ctx, allow_single=paged_in, shape="agg")
                     self._mark_fragment("tpu-stream", raw.num_rows)
                     return out
                 except DeviceUnsupported:
@@ -465,8 +486,9 @@ class HashAggExec(QueryExecutor):
                 # pipeline: to_device_col would read the entire memmap into
                 # RAM + HBM — the exact failure paging exists to prevent
                 try:
-                    out = run_device(self.ctx, device_agg, eff_p, raw,
-                                     conds, ctx=self.ctx)
+                    out = self._with_pipe_stats(
+                        run_device, self.ctx, device_agg, eff_p, raw,
+                        conds, ctx=self.ctx, shape="agg")
                     self._mark_fragment("tpu", raw.num_rows)
                     return out
                 except DeviceUnsupported:
@@ -480,8 +502,9 @@ class HashAggExec(QueryExecutor):
             from .device_join import LAST_PAGED_STATS, device_join_agg
             try:
                 LAST_PAGED_STATS.clear()
-                out = run_device(self.ctx, device_join_agg, eff_p,
-                                 agg_conds, join_child, self.ctx)
+                out = self._with_pipe_stats(
+                    run_device, self.ctx, device_join_agg, eff_p,
+                    agg_conds, join_child, self.ctx, shape="join")
                 self._mark_fragment("tpu", None)
                 if LAST_PAGED_STATS:
                     self.annotate(**dict(LAST_PAGED_STATS.items()))
@@ -855,8 +878,9 @@ class HashJoinExec(QueryExecutor):
         n = max(len(build_keys[0][0]), len(probe_keys[0][0])) if build_keys else 0
         if want_device(self.ctx, n):
             try:
-                return run_device(self.ctx, device_join_keys,
-                                  probe_keys, build_keys)
+                return self._with_pipe_stats(
+                    run_device, self.ctx, device_join_keys,
+                    probe_keys, build_keys, shape="join")
             except DeviceUnsupported:
                 pass
         return self._host_match(build_keys, probe_keys)
@@ -1132,8 +1156,9 @@ class WindowExec(QueryExecutor):
         from .device_exec import DeviceUnsupported as _DU
         if want_device(self.ctx, n):
             try:
-                out = run_device(self.ctx, device_window, p, chunk,
-                                 self.ctx)
+                out = self._with_pipe_stats(
+                    run_device, self.ctx, device_window, p, chunk,
+                    self.ctx, shape="window")
                 self.annotate(engine="tpu")
                 return out
             except _DU:

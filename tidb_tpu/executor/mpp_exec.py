@@ -353,7 +353,15 @@ def _build_mpp_pipeline(mesh, leaves, joins, root, sharded_ids, leaf_cond_fns,
         body, mesh=mesh,
         in_specs=(env_specs, (P(AXIS),) * len(sharded_ids)),
         out_specs=out_specs, check_vma=False)
-    return jax.jit(wrapped)
+
+    def entry(env, svalids):
+        # trace marker OUTSIDE the shard_map body (which tracing may
+        # evaluate more than once): mpp fragment compiles meter into the
+        # same pipe-cache stats as the single-chip pipelines
+        dev._note_trace()
+        return wrapped(env, svalids)
+
+    return dev.observed_jit(entry)
 
 
 # ---------------------------------------------------------------------------

@@ -987,7 +987,10 @@ def fold_constant(expr: Expression) -> Expression:
         # leaf must survive so plan-cache hits can rebind it in place
         return expr
     try:
-        v = expr.eval_scalar()
+        # INTERNAL repr: a folded Constant's value contract is the
+        # physical one (scaled int for decimals), same as
+        # literal_to_constant
+        v = expr.eval_scalar_internal()
     except Exception:
         return expr
     if v is None:
@@ -1024,10 +1027,21 @@ def build_in_set(target: Expression, values, values_ft: FieldType = None) -> Sca
 
 
 def _python_value_to_constant(v):
+    import decimal
     if v is None:
         return const_null()
     if isinstance(v, bool):
         return Constant(int(v), FieldType(tp=TYPE_LONGLONG))
+    if isinstance(v, decimal.Decimal):
+        # user-var decimals (eval_scalar products) re-enter as exact
+        # decimal constants: internal scaled int at the value's own scale
+        text = format(v, "f")
+        ip, _, frac = text.partition(".")
+        scale = min(len(frac), MAX_DECIMAL_SCALE)
+        prec = max(len(ip.lstrip("+-").lstrip("0")) + scale, scale, 1)
+        return Constant(str_to_decimal(text, scale),
+                        FieldType(tp=TYPE_NEWDECIMAL, flen=prec,
+                                  decimal=scale))
     if isinstance(v, int):
         return Constant(v, FieldType(tp=TYPE_LONGLONG))
     if isinstance(v, float):

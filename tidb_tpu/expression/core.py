@@ -51,13 +51,29 @@ class Expression:
     def eval(self, chunk: Chunk):
         raise NotImplementedError
 
-    def eval_scalar(self, row=None):
-        """Evaluate as a constant (no column refs) -> python value."""
+    def eval_scalar_internal(self, row=None):
+        """Evaluate as a constant (no column refs) -> value in the
+        INTERNAL physical representation (decimals are scaled ints at
+        ftype.scale, dates are day counts). For consumers that pair the
+        value with the ftype (DML conversion, constant folding)."""
         data, nulls = self.eval(_EMPTY_ONE)
         if nulls[0]:
             return None
         v = data[0]
         return v.item() if isinstance(v, np.generic) else v
+
+    def eval_scalar(self, row=None):
+        """Evaluate as a constant (no column refs) -> user-facing python
+        value. Decimals carry their scale as decimal.Decimal — the
+        internal scaled int (0.3 stored as 3 at scale 1) must never leak
+        to consumers that drop the ftype (user variables, SET, defaults);
+        that leak was the historical `SET @r = 0.3` → '3' bug."""
+        v = self.eval_scalar_internal(row)
+        if (v is not None and self.ftype is not None
+                and phys_kind(self.ftype) == K_DEC):
+            import decimal
+            return decimal.Decimal(int(v)).scaleb(-(self.ftype.scale or 0))
+        return v
 
     def columns_used(self, acc: set):
         pass

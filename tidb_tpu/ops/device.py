@@ -49,6 +49,63 @@ def next_pow2(n: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# shape canonicalization: geometric row buckets
+# ---------------------------------------------------------------------------
+#
+# XLA programs are compiled per SHAPE: tracing against the exact row count
+# means any delta append, different table, or different scale factor forces a
+# full recompile — the dominant cost on a remote device (BENCH_TPU_LIVE: Q3
+# spent 378s compiling for 45s of compute). Device arrays are therefore
+# padded up to a small set of geometric buckets (`bucket_rows`), with the
+# live row count threaded through the jitted program as a TRACED scalar:
+# padding rows carry null=True and are masked by `arange(n) < n_live` before
+# any filter/join/aggregate, extending the existing "padding must not
+# survive the scan filter" invariant of the paged path. A within-bucket
+# delta then re-dispatches the already-compiled program.
+
+import math as _math
+
+
+def bucket_rows(n: int, per_double: int = 2) -> int:
+    """Smallest geometric bucket >= n: `per_double` buckets per doubling
+    (2 = powers of sqrt(2): 8, 12, 16, 23, 32, 46, 64, ...). per_double <= 0
+    disables bucketing (exact shapes). Worst-case padding overhead is
+    2^(1/per_double) - 1 (~41% at 1, ~19% at 2)."""
+    if per_double <= 0 or n <= 0:
+        return n
+    b, k = 8, 0
+    while b < n:
+        k += 1
+        b = _math.ceil(2 ** (3 + k / per_double))
+    return b
+
+
+def shape_buckets(ctx) -> int:
+    """The session's bucket granularity (sysvar tidb_device_shape_buckets;
+    default 2 buckets per doubling, 0 = exact shapes)."""
+    try:
+        return int(ctx.get_sysvar("tidb_device_shape_buckets"))
+    except Exception:
+        return 2
+
+
+def pad_host(arr, n_to: int, null_pad: bool = False):
+    """Pad a host array to `n_to` rows. Data pads with zeros (any value is
+    fine — padding is masked), null masks pad with True (`null_pad`) so a
+    padding row reads as NULL even before the n_live mask applies."""
+    arr = np.asarray(arr)
+    n = arr.shape[0]
+    if n_to <= n:
+        return arr
+    if null_pad:
+        out = np.ones(n_to, dtype=arr.dtype)
+    else:
+        out = np.zeros(n_to, dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+# ---------------------------------------------------------------------------
 # column transfer
 # ---------------------------------------------------------------------------
 
@@ -77,14 +134,29 @@ class DeviceCol:
         return self.reps if self.reps is not None else self.dictionary
 
 
-def to_device_col(col) -> DeviceCol:
+def to_device_col(col, bucket: int | None = None) -> DeviceCol:
     """utils.chunk.Column → DeviceCol. Strings are dict-encoded host-side.
 
     The device arrays are cached on the Column: a table's working set is
     uploaded to HBM once per columnar-cache version and reused across
     queries (the transfer — not the kernel — dominates when the device
-    sits across a fabric/tunnel)."""
-    if col._device is None:
+    sits across a fabric/tunnel).
+
+    `bucket` (> len) pads the uploaded arrays to that static row count:
+    padding rows carry null=True and zeroed data, and the consuming
+    pipeline must mask them via its traced live-row count. One padded
+    length is cached per column: a LONGER cached upload serves shorter
+    requests as a device-side slice (no host re-transfer — an
+    exact-shape consumer like the mpp path must not thrash a bucketed
+    HBM-resident cache); only a grow evicts and re-uploads."""
+    want = bucket if bucket is not None and bucket > len(col) else len(col)
+    # read ONCE and publish in a single store: a concurrent reader must
+    # never observe a half-built cache (the pre-bucketing cache was
+    # write-once; growing it must keep that property)
+    cached = col._device
+    if cached is not None and int(cached[0].shape[0]) < want:
+        cached = None  # grow: rebuild locally, then swap
+    if cached is None:
         if col.is_object():
             from ..sqltypes import TYPE_NEWDECIMAL
             if col.ftype.tp == TYPE_NEWDECIMAL:
@@ -97,13 +169,20 @@ def to_device_col(col) -> DeviceCol:
                 # sort-key order, so code equality/ordering IS collation
                 # semantics (utils/chunk.py dict_encode_ci)
                 ci_codes, _kd, _reps = col.dict_encode_ci(col.ftype.collate)
-                col._device = (jnp.asarray(ci_codes), jnp.asarray(col.nulls))
+                cached = (jnp.asarray(pad_host(ci_codes, want)),
+                          jnp.asarray(pad_host(col.nulls, want, True)))
             else:
                 codes, _uniq = col.dict_encode()
-                col._device = (jnp.asarray(codes), jnp.asarray(col.nulls))
+                cached = (jnp.asarray(pad_host(codes, want)),
+                          jnp.asarray(pad_host(col.nulls, want, True)))
         else:
-            col._device = (jnp.asarray(col.data), jnp.asarray(col.nulls))
-    data, nulls = col._device
+            cached = (jnp.asarray(pad_host(col.data, want)),
+                      jnp.asarray(pad_host(col.nulls, want, True)))
+        col._device = cached  # atomic publish (racing builders: last wins)
+    data, nulls = cached
+    if int(data.shape[0]) > want:
+        # cached at a larger bucket: on-device slice (HBM-local, cheap)
+        data, nulls = data[:want], nulls[:want]
     if col.is_object():
         from ..utils.collate import is_ci
         if is_ci(col.ftype.collate):
@@ -1330,19 +1409,62 @@ def _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
     return key_out, key_null_out, tuple(results), tuple(result_nulls), n_groups, valid
 
 
+#: compile observability hooks, installed by executor.device_exec at
+#: import so the standalone kernels below (join match, topk, graft agg
+#: entry) meter retraces and compile seconds into the same pipe-cache
+#: stats as the fused pipelines. All None → unobserved plain jit.
+_trace_cb = None        # () -> None, called once per retrace
+_tls_traces = None      # () -> this thread's trace count
+_charge_compile = None  # seconds -> None
+
+
+def _note_trace():
+    if _trace_cb is not None:
+        _trace_cb()
+
+
+def observed_jit(fn, **jit_kw):
+    """jax.jit + compile accounting (mirror of device_exec._timed_jit for
+    kernels living below the executor layer): the body must call
+    _note_trace(); a dispatch whose trace count moved charges its wall
+    time as compile seconds."""
+    import time as _time
+    jfn = jax.jit(fn, **jit_kw)
+
+    def run(*args, **kw):
+        if _tls_traces is None:
+            return jfn(*args, **kw)
+        before = _tls_traces()
+        t0 = _time.perf_counter()
+        out = jfn(*args, **kw)
+        if _tls_traces() > before and _charge_compile is not None:
+            _charge_compile(_time.perf_counter() - t0)
+        return out
+    return run
+
+
+def _agg_entry(key_cols, key_nulls, val_cols, val_nulls, mask,
+               n_keys, agg_ops, capacity, pack=None):
+    # thin wrapper: _agg_impl itself also traces INSIDE fused pipelines,
+    # which count their own traces — only the standalone entry notes here
+    _note_trace()
+    return _agg_impl(key_cols, key_nulls, val_cols, val_nulls, mask,
+                     n_keys=n_keys, agg_ops=agg_ops, capacity=capacity,
+                     pack=pack)
+
+
 #: jitted standalone entry (graft entry / direct kernel callers); the SQL
 #: executor instead traces _agg_impl inside its own fused pipeline jit
-_agg_kernel = functools.partial(
-    jax.jit, static_argnames=("n_keys", "agg_ops", "capacity", "pack"))(
-        _agg_impl)
+_agg_kernel = observed_jit(
+    _agg_entry, static_argnames=("n_keys", "agg_ops", "capacity", "pack"))
 
 # ---------------------------------------------------------------------------
 # two-pass sort join kernels
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _join_count_kernel(build_key, probe_key, build_null, probe_null):
+def _join_count_impl(build_key, probe_key, build_null, probe_null):
     """Pass 1: sort build side, count matches per probe row."""
+    _note_trace()
     order = jnp.argsort(build_key, stable=True)
     sb = build_key[order]
     lo = jnp.searchsorted(sb, probe_key, side="left")
@@ -1351,9 +1473,12 @@ def _join_count_kernel(build_key, probe_key, build_null, probe_null):
     return order, sb, lo, cnt
 
 
-@functools.partial(jax.jit, static_argnames=("total",))
-def _join_expand_kernel(order, lo, cnt, build_null, total):
+_join_count_kernel = observed_jit(_join_count_impl)
+
+
+def _join_expand_impl(order, lo, cnt, build_null, total):
     """Pass 2 (static total): expand match pairs."""
+    _note_trace()
     cum = jnp.cumsum(cnt)
     pos = jnp.arange(total, dtype=jnp.int64)
     probe_idx = jnp.searchsorted(cum, pos, side="right")
@@ -1364,6 +1489,10 @@ def _join_expand_kernel(order, lo, cnt, build_null, total):
     build_idx = order[jnp.clip(bpos, 0, order.shape[0] - 1)]
     keep = ~build_null[build_idx]
     return probe_idx, build_idx, keep
+
+
+_join_expand_kernel = observed_jit(_join_expand_impl,
+                                   static_argnames=("total",))
 
 
 def device_join_match(build_keys, probe_keys):

@@ -1776,12 +1776,10 @@ class Session:
                 # SET var = bare_word — MySQL treats the identifier as a
                 # string value (SET tidb_partition_prune_mode = dynamic)
                 v = node.name
-            elif (isinstance(node, ast.Literal)
-                    and getattr(node, "kind", None) == "dec"):
-                # decimal literal: eval_scalar yields the SCALED int
-                # (0.3 → 3); the sysvar wants the literal text
-                v = node.val
             else:
+                # eval_scalar is scale-faithful (decimals come back as
+                # decimal.Decimal), so decimal literals and expressions
+                # need no special case
                 v = b.build(node).eval_scalar()
             if isinstance(v, bytes):
                 v = v.decode()

@@ -141,6 +141,12 @@ for _v in [
     # for larger-than-memory inputs at the cost of re-transfer per run
     # (0 = off: whole-table transfers, HBM-resident column cache)
     SysVar("tidb_device_stream_rows", SCOPE_BOTH, "0", "int", 0),
+    # shape-canonicalization granularity: geometric row buckets per
+    # doubling that device uploads pad to (ops/device.py bucket_rows) so
+    # compiled XLA programs are reusable across deltas/tables/scale
+    # factors. 2 = powers of sqrt(2) (<=19% padding), 1 = powers of 2,
+    # 0 = exact shapes (recompile per row count)
+    SysVar("tidb_device_shape_buckets", SCOPE_BOTH, "2", "int", 0, 8),
     # post-join compaction in device fragments: auto = CPU backend only
     SysVar("tidb_device_compact", SCOPE_BOTH, "auto", "enum",
            choices=("auto", "on", "off")),

@@ -102,8 +102,46 @@ class _View:
                     nulls.append(sc.nulls[li])
             col = Column(base.ftype, np.concatenate(datas),
                          np.concatenate(nulls))
+            self._carry_dictionary(base, col, idx, col_id)
             self._merged[col_id] = col
             return col
+
+    def _carry_dictionary(self, base: Column, col: Column, idx, col_id):
+        """Re-key the merged string column against the BASE dictionary when
+        no delta row introduced a new value (the overwhelmingly common
+        case): base codes slice + per-segment searchsorted beats a full
+        np.unique over the merged object array, and the dictionary OBJECT
+        (and its content signature) stays identical — which is what lets
+        the compiled-fragment cache survive a delta append."""
+        if base._dict is None or not base.is_object():
+            return
+        from ..sqltypes import TYPE_NEWDECIMAL
+        if base.ftype.tp == TYPE_NEWDECIMAL:
+            return
+        codes, uniq = base._dict
+        if len(uniq) == 0:
+            return  # empty base dictionary: any delta value is new
+        parts = [np.asarray(codes) if idx is None
+                 else np.asarray(codes)[idx]]
+        for s in self.segs:
+            sc = s.columns.get(col_id)
+            if sc is None:
+                return
+            vals = (sc.data if s.live.all()
+                    else sc.data[np.nonzero(s.live)[0]])
+            if len(vals):
+                pos = np.clip(np.searchsorted(uniq, vals), 0,
+                              len(uniq) - 1)
+                # vectorized membership check (object-array equality runs
+                # in C): this guards the hot per-delta merge path
+                if not np.all(uniq[pos] == np.asarray(vals, dtype=object)):
+                    return  # new distinct value: let dict_encode re-unique
+                parts.append(pos.astype(np.int32))
+        # bypass set_dict's O(dict) sortedness re-check: `uniq` is the
+        # base's already-validated np.unique output, reused as-is
+        col._dict = (np.concatenate(parts) if len(parts) > 1 else parts[0],
+                     uniq)
+        col._dict_sig = base._dict_sig
 
     def merged_handles(self) -> np.ndarray:
         with self.lock:

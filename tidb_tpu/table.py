@@ -31,20 +31,29 @@ def cast_value(v, ft: FieldType, truncate_as_error: bool = True):
     """
     if v is None:
         return None
+    import decimal as _decimal
     tp = ft.tp
     if tp in INT_TYPES:
+        # MySQL rounds half AWAY FROM ZERO on fractional→int, whatever
+        # the carrier (string literal, double, Decimal) — python's
+        # round()/int(float()) banker's/truncation semantics differ
+        def _half_away(d):
+            return int(d.to_integral_value(_decimal.ROUND_HALF_UP))
         if isinstance(v, bool):
             v = int(v)
         elif isinstance(v, (bytes, str)):
             s = v.decode() if isinstance(v, bytes) else v
             try:
-                v = int(float(s)) if ("." in s or "e" in s.lower()) else int(s)
-            except ValueError:
+                v = (_half_away(_decimal.Decimal(s))
+                     if ("." in s or "e" in s.lower()) else int(s))
+            except (ValueError, _decimal.InvalidOperation):
                 if truncate_as_error:
                     raise TypeError_(f"Truncated incorrect INTEGER value: '{s}'")
                 v = 0
         elif isinstance(v, float):
-            v = int(round(v))
+            v = _half_away(_decimal.Decimal(repr(v)))
+        elif isinstance(v, _decimal.Decimal):
+            v = _half_away(v)
         else:
             v = int(v)
         lo, hi, uhi = INT_RANGES.get(tp, INT_RANGES[TYPE_LONGLONG])
@@ -64,6 +73,8 @@ def cast_value(v, ft: FieldType, truncate_as_error: bool = True):
                 raise TypeError_(f"Truncated incorrect DECIMAL value: '{s}'")
         if isinstance(v, float):
             return str_to_decimal(repr(v), scale)
+        if isinstance(v, _decimal.Decimal):
+            return str_to_decimal(format(v, "f"), scale)
         if isinstance(v, tuple) and len(v) == 2:  # (scaled, scale) internal
             return dec_rescale(v[0], v[1], scale)
         return int(v) * 10 ** scale
@@ -129,6 +140,11 @@ def convert_internal(v, src_ft: FieldType, dst_ft: FieldType):
     (INSERT ... SELECT, UPDATE SET, reference: types/convert.go)."""
     if v is None:
         return None
+    import decimal as _decimal
+    if isinstance(v, _decimal.Decimal):
+        # user-facing decimal (eval_scalar product): already unscaled —
+        # the exact string cast is correct at any target scale
+        return cast_value(format(v, "f"), dst_ft)
     from .expression.core import phys_kind, K_DEC, K_DATE
     from .sqltypes import decimal_to_str
     sk = phys_kind(src_ft)

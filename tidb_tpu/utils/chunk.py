@@ -67,11 +67,24 @@ def np_dtype_for(ft: FieldType):
     return object
 
 
+def dict_content_sig(uniques) -> str:
+    """Stable content hash of a sorted dictionary (bytes / sort keys):
+    equal content → equal signature, across re-encodes and processes."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=12)
+    h.update(str(len(uniques)).encode())
+    for v in uniques:
+        b = v if isinstance(v, bytes) else str(v).encode()
+        h.update(len(b).to_bytes(4, "little"))
+        h.update(b)
+    return h.hexdigest()
+
+
 class Column:
     """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
 
     __slots__ = ("ftype", "data", "nulls", "_dict", "_dict_ci", "_device",
-                 "_join_index", "_minmax")
+                 "_join_index", "_minmax", "_dict_sig")
 
     def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
         self.ftype = ftype
@@ -84,6 +97,7 @@ class Column:
         self._device = None  # cached (jnp data, jnp nulls) resident in HBM
         self._join_index = None  # cached host join index (executor/join_index)
         self._minmax = None  # cached (min, max) over non-null int rows
+        self._dict_sig = None  # cached content hash of the dictionary
 
     def __len__(self):
         return len(self.data)
@@ -206,6 +220,23 @@ class Column:
             self._dict_ci = (collation, (ci_codes, key_dict, reps))
         return self._dict_ci[1]
 
+    def dict_sig(self) -> str:
+        """Content hash of the column's key dictionary (sort keys for _ci
+        columns, byte uniques otherwise) — the compiled-fragment cache key
+        component. id()-based keys can never survive a delta: the merged
+        view re-encodes into NEW dictionary objects whose CONTENT is
+        usually identical, and a compiled program's baked code LUTs stay
+        valid exactly when the content matches. Cached per column."""
+        if self._dict_sig is None:
+            from .collate import is_ci
+            if is_ci(self.ftype.collate):
+                _codes, key_dict, _reps = self.dict_encode_ci(
+                    self.ftype.collate)
+            else:
+                _codes, key_dict = self.dict_encode()
+            self._dict_sig = dict_content_sig(key_dict)
+        return self._dict_sig
+
     def prefix64(self) -> np.ndarray:
         """Order-preserving uint64 of the first 8 bytes of each value —
         enough to sort/compare most real keys on device; ties are broken
@@ -279,6 +310,7 @@ class LazyDictColumn(Column):
         self._device = None
         self._join_index = None
         self._minmax = (None,)
+        self._dict_sig = None
         self._mat = None
 
     @property
