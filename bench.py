@@ -15,6 +15,22 @@ BENCH_r02.json silently fell back to CPU after a single failed probe):
   * a SIGALRM watchdog guarantees at least one JSON line even on a hang,
     and per-query lines are emitted as each query completes so a late hang
     still leaves earlier results on stdout.
+
+Watchdog layering (innermost fires first; each outer layer covers the
+failure mode the inner one cannot):
+  1. device-runtime SUPERVISOR (tidb_tpu/executor/supervisor.py): each
+     benchmarked query runs on a supervised worker thread under the
+     BENCH_QUERY_TIMEOUT_S deadline — a backend hung inside a GIL-holding
+     C call (the BENCH_TPU_LIVE failure that lost Q5–Q18) costs ONE query:
+     the call is abandoned, an error JSON line is emitted, the backend is
+     fenced, and the run continues on a fresh session.
+  2. per-query SIGALRM (same budget + slack): catches a MAIN-thread stall
+     outside the supervised body (datagen, host reference run) — only
+     works while the GIL is droppable.
+  3. global SIGALRM (BENCH_TIMEOUT_S): bounds the whole run.
+  4. detached SUBPROCESS hard killer: immune to the GIL entirely; emits
+     the final watchdog line and SIGKILLs a process that even layers 1-3
+     could not unwedge.
 """
 
 import json
@@ -43,9 +59,18 @@ def _stage(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+#: serializes JSONL emission AND the abandoned-flag handoff: an orphaned
+#: supervised worker re-checks its query's abandoned flag under this lock
+#: right before emitting, and the hang handler sets the flag under it —
+#: so the stream can never carry both a hang record and a stale
+#: provisional line for one query, nor interleaved partial lines
+_EMIT_LOCK = threading.RLock()
+
+
 def _emit(obj) -> None:
-    _EMITTED[0] += 1
-    print(json.dumps(obj), flush=True)
+    with _EMIT_LOCK:
+        _EMITTED[0] += 1
+        print(json.dumps(obj), flush=True)
 
 
 # The accelerator reaches this process through the axon PJRT plugin: a
@@ -155,6 +180,11 @@ def _probe_backend(timeout_s: int, attempts: int, backoff_s: int):
 #: finishes before the timed section starts.
 _WARM_LOCK = threading.Lock()
 
+#: times time_query had to proceed WITHOUT the keep-warm lock (a stuck
+#: holder outlived the timed acquire) — per-query deltas mark the emitted
+#: record, so contended numbers are never mistaken for clean ones
+_WARM_LOCK_MISSES = [0]
+
 
 def _start_keepwarm():
     """Background thread dispatching a trivial op periodically so the
@@ -251,6 +281,26 @@ _TRANSIENT_MARKERS = ("UNAVAILABLE", "Connection refused", "transport:",
 def _is_transient(exc) -> bool:
     s = f"{type(exc).__name__}: {exc}"
     return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+class _PinnedTk:
+    """TestKit view pinned to ONE session object.  The per-query closure
+    runs on a supervised worker thread; when the supervisor abandons it,
+    the loop swaps in a fresh session for the NEXT query — the orphan
+    must keep talking to ITS session (via this pin), not race the new
+    one through a live `tk.session` attribute read."""
+
+    def __init__(self, tk):
+        self.domain = tk.domain
+        self.session = tk.session
+
+    def must_exec(self, sql):
+        results = self.session.execute(sql)
+        return results[-1] if results else None
+
+    def must_query(self, sql):
+        from tidb_tpu.testkit import QueryResult
+        return QueryResult(self.session.execute(sql)[-1])
 
 
 class _QueryTimeout(Exception):
@@ -615,11 +665,23 @@ def gen_all(tk, sf: float):
 def time_query(tk, sql, repeats=3):
     best = float("inf")
     rows = None
-    with _WARM_LOCK:
+    # capture the lock OBJECT: after a supervisor-abandoned query the
+    # loop swaps _WARM_LOCK for a fresh one (the orphaned worker may hold
+    # the old lock for as long as its hung call blocks), and this frame
+    # must release the lock it actually acquired. The timed acquire is a
+    # second backstop against a stuck holder.
+    lock = _WARM_LOCK
+    locked = lock.acquire(timeout=10)
+    if not locked:
+        _WARM_LOCK_MISSES[0] += 1
+    try:
         for _ in range(repeats):
             t0 = time.perf_counter()
             rows = tk.must_query(sql).rows
             best = min(best, time.perf_counter() - t0)
+    finally:
+        if locked:
+            lock.release()
     return best, rows
 
 
@@ -788,6 +850,8 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
     difference; warm_compile_s is the same meter over the timed runs —
     ~0 when the compiled-fragment cache and shape buckets are doing
     their job."""
+    from tidb_tpu.errors import DeviceHangError
+    from tidb_tpu.executor import supervisor as _sup
     from tidb_tpu.executor.device_exec import pipe_cache_stats
     inject = set(q.strip().lower() for q in
                  os.environ.get("BENCH_FAIL_QUERY", "").split(",")
@@ -796,15 +860,33 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
     for qname in qnames:
         sql = QUERIES[qname]
         _stage(f"{qname}: begin")
-        try:
-            _arm_query_alarm(query_budget_s)
+        r = {}
+        qtk = _PinnedTk(tk)  # this query's session, pinned for its worker
+
+        def _one(tk=qtk, qname=qname, sql=sql, r=r,
+                 budget_s=query_budget_s):
+            """The whole per-query measurement, run on a SUPERVISED worker
+            thread (layer 1) so a GIL-blocked backend call costs this one
+            query. Results land in `r`; `r['host_skip']` replaces the old
+            inline `continue`.  EVERY loop variable is pinned via default
+            args (like the session): an abandoned worker that unblocks
+            after the loop advanced must write its stale results into ITS
+            OWN r/qname, never the next query's bindings."""
+            def stage(msg):
+                # an orphan's stage updates must not overwrite the LIVE
+                # query's _STAGE — watchdog lines would blame the wrong
+                # stage in exactly the triage path this stack serves
+                if not r.get("abandoned"):
+                    _stage(msg)
             if qname in inject:
                 raise RuntimeError(
                     f"injected backend failure for {qname} "
                     "(BENCH_FAIL_QUERY)")
+            wm0 = _WARM_LOCK_MISSES[0]
+            t_start = time.monotonic()
             for attempt in (1, 2):
                 try:
-                    _stage(f"{qname}: device warmup (compile + materialize)")
+                    stage(f"{qname}: device warmup (compile + materialize)")
                     tk.must_exec("set tidb_executor_engine = 'tpu'")
                     st0 = pipe_cache_stats(thread_local=True)
                     # two warmup runs, timed SEPARATELY: warm_t is the
@@ -816,7 +898,7 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                     warm_t, _rows = time_query(tk, sql, repeats=1)
                     time_query(tk, sql, repeats=1)
                     st1 = pipe_cache_stats(thread_local=True)
-                    _stage(f"{qname}: device timed runs")
+                    stage(f"{qname}: device timed runs")
                     dev_t, dev_rows = time_query(tk, sql, repeats=2)
                     st2 = pipe_cache_stats(thread_local=True)
                     break
@@ -827,8 +909,16 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                     # environmental — give it one recovery window
                     if attempt == 2 or not _is_transient(exc):
                         raise
-                    _stage(f"{qname}: transient backend error, retrying "
-                           f"({exc})")
+                    if (budget_s and time.monotonic() - t_start + 35
+                            > budget_s):
+                        # no room for the 30s recovery sleep inside the
+                        # supervised budget: surface the transient error
+                        # as a plain per-query skip — sleeping into the
+                        # deadline would be misread as a backend HANG
+                        # (fence + session kill) for a network blip
+                        raise
+                    stage(f"{qname}: transient backend error, retrying "
+                          f"({exc})")
                     time.sleep(30)
             compile_cold = st1["compile_s"] - st0["compile_s"]
             compile_warm = st2["compile_s"] - st1["compile_s"]
@@ -838,6 +928,12 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                 "warmup_minus_steady_s": round(max(warm_t - dev_t, 0.0), 4),
                 "xla_compiles": st2["compiles"] - st0["compiles"],
             }
+            if _WARM_LOCK_MISSES[0] > wm0:
+                # a timed run raced the keep-warm dispatch: the numbers
+                # are contended — mark them so history comparisons skip
+                compile_info["warm_lock_timeout"] = True
+            r["dev"] = (dev_t, dev_rows)
+            r["compile_info"] = compile_info
 
             host_skip = (os.environ.get("BENCH_HOST_SKIP") == "1"
                          or sf >= 50)
@@ -845,26 +941,80 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                 # the host (numpy) reference engine is the memory limiter
                 # at this scale — its join intermediates can OOM-kill the
                 # process (observed: Q9 SF10). Emit the measured device
-                # number FIRST so a host-side death can't erase it.
-                _emit({
-                    "metric": f"tpch_{qname}_sf{sf:g}_device_provisional",
-                    "value": round(n / dev_t),
-                    "unit": "lineitem_rows/s", "vs_baseline": 0,
-                    "device_s": round(dev_t, 4),
-                    **compile_info,
-                    "host_pending": True,
-                    "peak_rss_mb": _peak_rss_mb(), **meta,
-                })
+                # number FIRST so a host-side death can't erase it. The
+                # abandoned re-check happens INSIDE the emit lock (the
+                # hang handler sets the flag under the same lock), so an
+                # orphan can never race a stale provisional line past it.
+                with _EMIT_LOCK:
+                    if r.get("abandoned"):
+                        return
+                    _emit({
+                        "metric":
+                            f"tpch_{qname}_sf{sf:g}_device_provisional",
+                        "value": round(n / dev_t),
+                        "unit": "lineitem_rows/s", "vs_baseline": 0,
+                        "device_s": round(dev_t, 4),
+                        **compile_info,
+                        "host_pending": True,
+                        "peak_rss_mb": _peak_rss_mb(), **meta,
+                    })
 
             if host_skip:
                 # the single-threaded numpy reference cannot execute at
                 # SF100 in any useful time; the provisional device line
                 # above is the recorded number
-                _COMPLETED[0] += 1
-                continue
-            _stage(f"{qname}: host reference run")
-            tk.must_exec("set tidb_executor_engine = 'host'")
-            host_t, host_rows = time_query(tk, sql, repeats=1)
+                r["host_skip"] = True
+
+        try:
+            # SIGALRM (layer 2) arms with slack so the supervisor (layer
+            # 1, able to interrupt even a GIL-blocked backend wait) fires
+            # first; the alarm still covers main-thread stalls
+            _arm_query_alarm(query_budget_s + 30 if query_budget_s else 0)
+            if query_budget_s > 0:
+                _sup.supervised_call(_one, deadline_s=query_budget_s,
+                                     label=f"bench:{qname}")
+            else:
+                _one()
+            if not r.get("host_skip"):
+                # the host (numpy) reference runs on the MAIN thread,
+                # outside the supervised body: a slow host run is a
+                # SIGALRM _QueryTimeout skip (layer 2), never a false
+                # "backend hang" that would fence a healthy device
+                _stage(f"{qname}: host reference run")
+                tk.must_exec("set tidb_executor_engine = 'host'")
+                r["host"] = time_query(tk, sql, repeats=1)
+        except DeviceHangError as exc:
+            _disarm_query_alarm()
+            with _EMIT_LOCK:
+                r["abandoned"] = True  # gates the orphan's late _emit
+            failures += 1
+            _emit({"metric": f"tpch_{qname}_sf{sf:g}", "value": 0,
+                   "unit": "rows/s", "vs_baseline": 0,
+                   "error": f"{type(exc).__name__}: {exc}"[:300],
+                   "skipped_by_watchdog": True, "watchdog": "supervisor",
+                   "abandoned_calls": _sup.abandoned_calls(),
+                   "stage": _STAGE[0], **meta})
+            # the abandoned worker may still be executing against its
+            # (pinned) session and may hold the keep-warm lock; kill the
+            # CONNECTION so its remaining statements are refused, swap in
+            # a fresh lock + session for later queries
+            global _WARM_LOCK
+            _WARM_LOCK = threading.Lock()
+            try:
+                from tidb_tpu.session import new_session
+                tk.session.kill(query_only=False)
+                tk.session = new_session(tk.domain)
+                tk.must_exec("use tpch")
+                tk.must_exec("set tidb_mem_quota_query = 0")
+            except Exception as rexc:  # noqa: BLE001
+                # recovery failed with the killed session still installed:
+                # say so — otherwise every later query fails with refused
+                # statements and no explanation (the exact silent-cascade
+                # mode this watchdog exists to prevent)
+                _stage(f"{qname}: session recovery after hang FAILED "
+                       f"({type(rexc).__name__}: {rexc}); later queries "
+                       "may be refused")
+            continue
         except _QueryTimeout as exc:
             # also catches an alarm landing in the handler below or in
             # the post-try tail: wherever the one-shot SIGALRM fires, it
@@ -892,6 +1042,12 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
         finally:
             _disarm_query_alarm()
 
+        if r.get("host_skip"):
+            _COMPLETED[0] += 1
+            continue
+        dev_t, dev_rows = r["dev"]
+        host_t, host_rows = r["host"]
+        compile_info = r["compile_info"]
         if dev_rows != host_rows:
             failures += 1
             _emit({"metric": f"tpch_{qname}_sf{sf:g}_parity", "value": 0,

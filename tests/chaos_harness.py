@@ -18,11 +18,30 @@ contract asserted for every operation:
 Usage:  run_seed(seed) -> dict of counters; raises AssertionError on any
 invariant violation.  tests/test_chaos.py drives a fixed-seed smoke in
 tier-1 and a deeper sweep (CHAOS_SEEDS=n, marked slow) locally.
+
+THREADED MODE (`run_threaded_seed`): N worker threads issue concurrent
+queries + transfer DML against ONE Domain while a seeded schedule flips
+failpoints — including backend-HANG injection (sleep actions under a
+small `tidb_device_call_timeout`, exercising the device-runtime
+supervisor) — closing the ROADMAP "multi-core interleaving fuzzing"
+item.  Interleavings are nondeterministic, so the contract is
+INVARIANT-ONLY (no bit-for-bit goldens):
+
+  * every operation either succeeds or fails with a CLEAN classified
+    error — never an unclassified exception, never a wedge;
+  * ledger atomicity: SUM(bal) reads 1000 in every successful snapshot;
+  * no leaked failpoints once the threads join;
+  * no stuck threads (bounded joins) and no abandoned device calls left
+    outstanding after the grace window;
+  * breaker-state sanity, and the corpus runs clean on the quiesced
+    domain (the process survives and recovers).
 """
 
 from __future__ import annotations
 
+import contextlib
 import random
+import threading
 import time
 
 from tidb_tpu.errors import TiDBError
@@ -191,4 +210,174 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
                 f"seed {seed}: no recovery after faults cleared: {q!r}")
     finally:
         failpoint.disable_all()
+    return stats
+
+
+# -- threaded mode -----------------------------------------------------------
+
+#: read-path fault catalog for the threaded mode: adds the join/MPP
+#: fragment hooks and HANG actions (sleep under a small
+#: tidb_device_call_timeout → DeviceHangError through the supervisor)
+THREADED_FAULTS = {
+    "device-agg-exec": ["panic", "1*panic", "sleep(0.05)"],
+    "device-join-exec": ["panic", "1*panic", "sleep(0.05)"],
+    "device-mpp-exec": ["1*panic", "sleep(0.05)"],
+    "mpp-exchange-send": ["1*panic", "panic"],
+    "mpp-exchange-recv": ["1*panic"],
+    "coordinator-tso-skew": ["return(262144)"],
+    "coordinator-heartbeat-lost": ["return(1)"],
+    "txn-before-prewrite": ["1*panic"],
+    "txn-after-prewrite": ["1*panic"],
+    "txn-before-commit": ["1*panic"],
+}
+
+#: join budget per worker thread — a thread alive past this is STUCK
+THREAD_JOIN_TIMEOUT_S = 120.0
+
+
+def run_threaded_seed(seed: int, n_threads: int = 4,
+                      n_ops: int = 8) -> dict:
+    """One seeded concurrent chaos schedule (invariant-only checks; see
+    the module docstring).  Returns aggregate counters."""
+    from tidb_tpu.executor import supervisor
+
+    tk = TestKit()
+    failpoint.disable_all()
+    _setup(tk)
+    # fast breaker + a visible half-open cycle under contention
+    tk.must_exec("set global tidb_device_circuit_threshold = 3")
+    tk.must_exec("set global tidb_device_circuit_cooldown = 0.05")
+    sup_before = supervisor.snapshot()
+
+    stats = {"reads_ok": 0, "clean_errors": 0, "writes_ok": 0,
+             "writes_failed": 0, "ledger_checks": 0}
+    mu = threading.Lock()
+    violations: list = []
+    start = threading.Barrier(n_threads)
+
+    def bump(key, n=1):
+        with mu:
+            stats[key] += n
+
+    def violate(tid, what, exc=None):
+        with mu:
+            violations.append(
+                f"seed {seed} thread {tid}: {what}"
+                + (f" ({type(exc).__name__}: {exc})" if exc else ""))
+
+    def worker(tid):
+        try:
+            _worker_body(tid)
+        except Exception as e:  # noqa: BLE001 — a dead worker IS a finding
+            violate(tid, "worker thread died", e)
+
+    def _worker_body(tid):
+        rng = random.Random((seed << 8) ^ tid)
+        wtk = tk.new_session()
+        wtk.must_exec("use test")
+        wtk.must_exec("set innodb_lock_wait_timeout = 2")
+        start.wait(timeout=30)
+        for _op in range(n_ops):
+            engine = rng.choice(ENGINES)
+            wtk.must_exec(f"set tidb_executor_engine = '{engine}'")
+            # half the ops run supervised with a deadline SMALLER than the
+            # injected sleep: the hang path must fire concurrently
+            wtk.must_exec("set tidb_device_call_timeout = "
+                          + ("0.02" if rng.random() < 0.5 else "0"))
+            names = rng.sample(sorted(THREADED_FAULTS),
+                               k=rng.choice([1, 1, 2]))
+            with contextlib.ExitStack() as st:
+                for name in names:
+                    st.enter_context(failpoint.enabled(
+                        name, rng.choice(THREADED_FAULTS[name])))
+                if rng.random() < 0.6:  # read op
+                    q = QUERIES[rng.randrange(len(QUERIES))]
+                    try:
+                        wtk.must_query(q)
+                        bump("reads_ok")
+                    except Exception as e:  # noqa: BLE001
+                        if _is_clean(e):
+                            bump("clean_errors")
+                        else:
+                            violate(tid, f"unclassified read failure "
+                                    f"on {q!r}", e)
+                else:  # transfer write (both updates in acct order: no
+                    #     deadlock cycles — lock waits are the chaos)
+                    amt = rng.randrange(1, 40)
+                    try:
+                        wtk.must_exec("begin")
+                        wtk.must_exec(f"update ledger set bal = bal - {amt}"
+                                      " where acct = 1")
+                        wtk.must_exec(f"update ledger set bal = bal + {amt}"
+                                      " where acct = 2")
+                        wtk.must_exec("commit")
+                        bump("writes_ok")
+                    except Exception as e:  # noqa: BLE001
+                        if _is_clean(e):
+                            bump("writes_failed")
+                        else:
+                            violate(tid, "unclassified write failure", e)
+                        try:
+                            wtk.session.rollback()
+                        except Exception:
+                            pass
+            # ledger atomicity in THIS thread's next snapshot (host
+            # engine: the invariant read must not ride the faulty path)
+            try:
+                wtk.must_exec("set tidb_executor_engine = 'host'")
+                total = wtk.must_query(
+                    "select sum(bal) from ledger").rows[0][0]
+            except Exception as e:  # noqa: BLE001
+                if not _is_clean(e):
+                    violate(tid, "unclassified ledger read failure", e)
+            else:
+                bump("ledger_checks")
+                if str(total) != "1000":
+                    violate(tid, f"ATOMICITY VIOLATION: ledger sum {total}")
+
+    threads = [threading.Thread(target=worker, args=(tid,), daemon=True,
+                                name=f"chaos-{seed}-{tid}")
+               for tid in range(n_threads)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(THREAD_JOIN_TIMEOUT_S)
+        stuck = [t.name for t in threads if t.is_alive()]
+        assert not stuck, (
+            f"seed {seed}: STUCK THREADS after "
+            f"{THREAD_JOIN_TIMEOUT_S}s: {stuck}")
+        # no leaked failpoints: every enabled() context unwound
+        leaked = failpoint.list_active()
+        assert not leaked, f"seed {seed}: leaked failpoints {leaked}"
+        assert not violations, "\n".join(violations)
+    finally:
+        failpoint.disable_all()
+
+    # abandoned device calls drain: the injected hangs are short sleeps,
+    # so every orphaned worker must unblock and decrement the gauge
+    deadline = time.monotonic() + 10.0
+    while supervisor.abandoned_calls() > 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert supervisor.abandoned_calls() == 0, (
+        f"seed {seed}: {supervisor.abandoned_calls()} abandoned device "
+        "calls never completed")
+    stats["hangs"] = (supervisor.snapshot()["hangs"]
+                      - sup_before["hangs"])
+
+    # breaker-state sanity: legal state, probe slot not wedged
+    for shape, br in getattr(tk.domain, "_device_breakers", {}).items():
+        snap = br.snapshot()
+        assert snap["state"] in ("closed", "open", "half-open"), (
+            f"seed {seed}: breaker[{shape}] in bad state {snap}")
+
+    # recovery: the quiesced domain serves the whole corpus cleanly
+    tk.must_exec("set tidb_executor_engine = 'auto'")
+    tk.must_exec("set tidb_device_call_timeout = 0")
+    time.sleep(0.06)  # cooldowns elapse; half-open probes may close
+    for q in QUERIES:
+        tk.must_query(q)
+    total = tk.must_query("select sum(bal) from ledger").rows[0][0]
+    assert str(total) == "1000", (
+        f"seed {seed}: final ledger sum {total} != 1000")
     return stats

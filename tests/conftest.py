@@ -30,6 +30,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: seeded fault-injection runs (tests/test_chaos.py;"
         " deepen locally with CHAOS_SEEDS=n)")
+    config.addinivalue_line(
+        "markers", "chaos_threads: concurrent (multi-threaded) chaos runs"
+        " with invariant-only checks (tests/test_chaos.py; deepen locally"
+        " with CHAOS_THREAD_SEEDS=n CHAOS_THREADS=n)")
 
 
 @pytest.fixture(autouse=True)

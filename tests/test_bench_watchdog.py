@@ -24,7 +24,7 @@ def tpch_tk():
     return tk, n
 
 
-def _run(tk, n, qnames, monkeypatch, fail=""):
+def _run(tk, n, qnames, monkeypatch, fail="", budget_s=0):
     emitted = []
     monkeypatch.setattr(bench, "_emit", lambda obj: emitted.append(obj))
     monkeypatch.setattr(bench, "_COMPLETED", [0])
@@ -34,7 +34,7 @@ def _run(tk, n, qnames, monkeypatch, fail=""):
         monkeypatch.delenv("BENCH_FAIL_QUERY", raising=False)
     failures = bench._bench_loop(
         tk, qnames, 0.001, n, {"platform": "cpu", "fallback": True,
-                               "sf": 0.001})
+                               "sf": 0.001}, query_budget_s=budget_s)
     return failures, emitted
 
 
@@ -67,6 +67,40 @@ def test_warm_compile_s_amortized(tpch_tk, monkeypatch):
         # programs
         assert rec["compile_s"] > 0, rec
         assert rec["warm_compile_s"] < 0.1 * rec["compile_s"], rec
+
+
+def test_supervisor_skips_hung_query_and_run_continues(tpch_tk,
+                                                       monkeypatch):
+    """Layer 1 of the watchdog stack: a backend HANG (GIL-blocked in the
+    real failure; an injected sleep here) inside one benchmarked query is
+    abandoned by the device-runtime supervisor at the per-query budget —
+    error JSON line, fresh session, and the NEXT query completes."""
+    import time
+
+    from tidb_tpu.executor import supervisor
+    from tidb_tpu.utils import failpoint
+
+    tk, n = tpch_tk
+    # hang only q1's first device dispatch (past the budget); q3 must run
+    # clean after — its post-fence COLD compile (~3s on XLA-CPU) must fit
+    # the budget, hence 8s/12s rather than something snappier
+    failpoint.enable("device-agg-exec", "1*sleep(12)")
+    try:
+        failures, emitted = _run(tk, n, ["q1", "q3"], monkeypatch,
+                                 budget_s=8)
+    finally:
+        failpoint.disable("device-agg-exec")
+    assert failures == 1
+    q1 = [e for e in emitted if e["metric"].startswith("tpch_q1")]
+    assert len(q1) == 1 and q1[0].get("watchdog") == "supervisor", q1
+    assert "DeviceHangError" in q1[0]["error"]
+    q3 = [e for e in emitted if e["metric"].startswith("tpch_q3")]
+    assert q3 and q3[-1]["value"] > 0 and "error" not in q3[-1]
+    # the abandoned worker drains once its sleep ends
+    deadline = time.monotonic() + 10.0
+    while supervisor.abandoned_calls() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert supervisor.abandoned_calls() == 0
 
 
 def test_query_timeout_exception_is_skippable():

@@ -1,19 +1,23 @@
 """Chaos harness entry points (see chaos_harness.py for the contract).
 
-Tier-1 runs a small fixed-seed smoke; the deeper sweep is marked `slow`
-and sized by CHAOS_SEEDS (default 20) for local runs:
+Tier-1 runs a small fixed-seed smoke; the deeper sweeps are marked
+`slow` and sized by env for local runs:
 
     CHAOS_SEEDS=50 pytest tests/test_chaos.py -m chaos
+    CHAOS_THREAD_SEEDS=20 CHAOS_THREADS=4 pytest tests/test_chaos.py \
+        -m chaos_threads
 """
 
 import os
 
 import pytest
 
-from chaos_harness import run_seed
+from chaos_harness import run_seed, run_threaded_seed
 
 SMOKE_SEEDS = [0, 1, 2, 3]
 _DEEP = int(os.environ.get("CHAOS_SEEDS", "20"))
+_THREAD_DEEP = int(os.environ.get("CHAOS_THREAD_SEEDS", "20"))
+_THREADS = int(os.environ.get("CHAOS_THREADS", "4"))
 
 
 @pytest.mark.chaos
@@ -33,3 +37,24 @@ def test_chaos_smoke(seed):
 def test_chaos_sweep(seed):
     """Deeper deterministic sweep (excluded from tier-1 by `slow`)."""
     run_seed(seed)
+
+
+@pytest.mark.chaos_threads
+def test_threaded_chaos_smoke():
+    """Fixed-seed tier-1 smoke of the CONCURRENT chaos mode: 4 threads,
+    bounded ops, invariant-only checks (ledger atomicity, no leaked
+    failpoints, no stuck threads, breaker sanity, recovery)."""
+    stats = run_threaded_seed(0, n_threads=4, n_ops=5)
+    # the schedule must actually exercise concurrency, not no-op through
+    assert stats["reads_ok"] + stats["clean_errors"] > 0
+    assert stats["writes_ok"] + stats["writes_failed"] > 0
+    assert stats["ledger_checks"] > 0
+
+
+@pytest.mark.chaos_threads
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(1, max(_THREAD_DEEP, 2)))
+def test_threaded_chaos_sweep(seed):
+    """Seeded concurrent sweep (≥ 20 seeds × ≥ 4 threads locally;
+    excluded from tier-1 by `slow`)."""
+    run_threaded_seed(seed, n_threads=max(_THREADS, 4), n_ops=8)

@@ -108,3 +108,61 @@ def test_join_agg_bucket_overflow_reported():
     k, v, o = arrs
     _total, _pairs, dropped = step(k, o, v & pad, k, o, v & pad)
     assert int(dropped) > 0
+
+
+class _SupCtx:
+    """Minimal embedder context for the dist_* ctx= hook: just the
+    sysvars effective_deadline reads (no Domain, no session)."""
+
+    def __init__(self, timeout_s):
+        self._t = timeout_s
+
+    def get_sysvar(self, name, *a, **kw):
+        if name == "tidb_device_call_timeout":
+            return self._t
+        if name == "max_execution_time":
+            return 0
+        raise KeyError(name)
+
+
+def test_dist_agg_step_supervised_ctx_matches_inline():
+    """ctx= routes the exchange dispatch through the device-runtime
+    supervisor (worker thread + deadline) with identical results — the
+    library embedder's hang guard (executor/supervisor.py)."""
+    rng = np.random.default_rng(11)
+    n = 4096
+    keys = rng.integers(0, 17, n)
+    vals = rng.integers(-50, 50, n)
+    mesh = make_mesh(8)
+    plain = dist_agg_step(mesh, ("sum",), capacity=32)
+    sup = dist_agg_step(mesh, ("sum",), capacity=32,
+                        ctx=_SupCtx(timeout_s=30.0))
+    (arrs, pad) = shard_batch(mesh, keys, np.ones(n, bool), vals)
+    k, v, s = arrs
+    a = plain(k, v & pad, s)
+    b = sup(k, v & pad, s)
+    assert np.asarray(a[0]).tolist() == np.asarray(b[0]).tolist()
+    assert np.asarray(a[1][0]).tolist() == np.asarray(b[1][0]).tolist()
+    assert int(a[3]) == int(b[3])
+
+
+def test_dist_agg_step_supervised_ctx_hang_deadline():
+    """A stalled supervised dispatch raises DeviceHangError instead of
+    blocking the embedder forever (stall injected at the wrapper level —
+    a real PJRT hang blocks the same worker thread the same way)."""
+    import time as _time
+
+    import pytest as _pytest
+
+    from tidb_tpu.errors import DeviceHangError
+    from tidb_tpu.parallel.mpp import _supervised_step
+
+    def stalls(*_a):
+        _time.sleep(0.5)
+        return "never used"
+
+    wrapped = _supervised_step(stalls, _SupCtx(timeout_s=0.05))
+    t0 = _time.monotonic()
+    with _pytest.raises(DeviceHangError):
+        wrapped()
+    assert _time.monotonic() - t0 < 0.4

@@ -225,6 +225,139 @@ class TestCircuitBreakerUnit:
         br.record_failure(RuntimeError("x"))
         assert br.state == "closed"
 
+    def test_stale_verdicts_leave_live_probe_alone(self):
+        """A fragment admitted while CLOSED that reports its verdict after
+        the breaker opened and ANOTHER thread won the probe must neither
+        close the breaker nor free/kill the live probe slot (the
+        half-open race the threaded chaos mode exercises)."""
+        import threading as _t
+        now = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=5.0,
+                            clock=lambda: now[0])
+        br.record_failure(RuntimeError("x"))
+        now[0] += 5.0
+        probed = _t.Event()
+        release = _t.Event()
+
+        def prober():
+            assert br.allow()  # wins the single probe slot
+            probed.set()
+            release.wait(5.0)
+            br.record_success()
+
+        t = _t.Thread(target=prober)
+        t.start()
+        assert probed.wait(5.0)
+        # stale verdicts from THIS thread while the probe is in flight:
+        br.record_success()
+        assert br.state == "half-open" and not br.allow(), (
+            "stale success must not close the breaker mid-probe")
+        br.record_failure(RuntimeError("late straggler"))
+        assert br.state == "half-open" and not br.allow(), (
+            "stale failure must not reopen/steal the live probe's slot")
+        release.set()
+        t.join(5.0)
+        assert br.state == "closed" and br.allow()
+
+    def test_stale_success_does_not_close_open_breaker(self):
+        """A fragment admitted before the breaker tripped that succeeds
+        mid-cooldown (no probe in flight) must not close the breaker —
+        waiting fragments would re-dispatch to the hung backend and each
+        pay a full deadline; recovery goes through the probe."""
+        now = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=10.0,
+                            clock=lambda: now[0])
+        br.record_failure(RuntimeError("hang"))
+        assert br.state == "open"
+        br.record_success()  # the stale straggler
+        assert br.state == "open" and not br.allow()
+        now[0] += 10.0       # cooldown elapses → probe recovers normally
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_stale_success_after_released_probe_keeps_half_open(self):
+        """A prober that exits via release_probe (no verdict) leaves the
+        slot free in HALF_OPEN; a straggler's stale success must not
+        close the breaker — the next PROBE's verdict decides."""
+        now = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=5.0,
+                            clock=lambda: now[0])
+        br.record_failure(RuntimeError("hang"))
+        now[0] += 5.0
+        assert br.allow()          # probe admitted...
+        br.release_probe()         # ...exits with no verdict
+        br.record_success()        # straggler from before the open
+        assert br.state == "half-open", (
+            "stale success must not close a probe-less half-open breaker")
+        assert br.allow()          # a real probe still recovers it
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_vanished_probe_slot_is_reclaimed(self):
+        """A probe owner that died without any verdict (no success, no
+        failure, no release) must not wedge the breaker host-side
+        forever: allow() reclaims the slot after the grace window."""
+        now = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=1.0,
+                            clock=lambda: now[0])
+        br.record_failure(RuntimeError("x"))
+        now[0] += 1.0
+        assert br.allow()          # probe taken ... and its owner vanishes
+        assert not br.allow()      # slot held
+        now[0] += 600.0            # past cooldown but INSIDE the reclaim
+        assert not br.allow(), (   # floor: a slow live probe keeps its slot
+            "a probe within the reclaim floor must not be robbed")
+        now[0] += 600.0            # way past max(cooldown, reclaim floor)
+        assert br.allow(), "stale probe slot must be reclaimable"
+        assert br.snapshot()["probe_reclaims"] == 1
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_concurrent_allow_single_probe_slot(self):
+        """N threads hammering allow()/record_* concurrently: at most ONE
+        probe admission per half-open window, every exit path releases,
+        and the breaker is never wedged at the end."""
+        import threading as _t
+        br = CircuitBreaker(threshold=1, cooldown_s=0.01)
+        br.record_failure(RuntimeError("x"))
+        time.sleep(0.02)  # → half-open
+        admitted = []
+        mu = _t.Lock()
+        start = _t.Barrier(8)
+
+        def hammer(tid):
+            start.wait(5.0)
+            for i in range(200):
+                if br.allow():
+                    with mu:
+                        admitted.append(tid)
+                    # alternate every exit path run_device uses
+                    if i % 3 == 0:
+                        br.record_failure(RuntimeError("probe failed"))
+                        time.sleep(0.011)  # let the cooldown elapse
+                    elif i % 3 == 1:
+                        br.release_probe()  # no-verdict exit
+                    else:
+                        br.record_success()
+
+        threads = [_t.Thread(target=hammer, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not any(t.is_alive() for t in threads)
+        snap = br.snapshot()
+        assert snap["state"] in ("closed", "open", "half-open")
+        # not wedged: after the cooldown the breaker must admit a probe
+        # and a success must close it
+        time.sleep(0.02)
+        deadline = time.monotonic() + 2.0
+        while not br.allow() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        br.record_success()
+        assert br.state == "closed" and br.allow()
+
 
 class TestCircuitBreakerEndToEnd:
     def test_device_faults_flip_to_host_and_recover(self, tk):

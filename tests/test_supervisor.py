@@ -1,0 +1,302 @@
+"""Device-runtime supervision (executor/supervisor.py): hang detection
+under a hard deadline, classified DeviceHangError (errno 9008 next to
+BackoffExhausted 9005), breaker integration, backend fencing, the
+abandoned-calls gauge across EXPLAIN ANALYZE / observe / HTTP status,
+KILL responsiveness while a hang is pending, and the run_device
+`shape=` call-site lint."""
+
+import ast
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tidb_tpu.errors import DeviceHangError, ErrCode, QueryInterruptedError
+from tidb_tpu.executor import supervisor
+from tidb_tpu.executor.circuit import get_breaker
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.backoff import CLASS_HANG, classify
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t1 (id int primary key, grp int, val int)")
+    tk.must_exec("create table t2 (id int primary key, ref int, amt int)")
+    tk.must_exec("insert into t1 values " + ",".join(
+        f"({i},{i % 5},{i * 3 % 97})" for i in range(200)))
+    tk.must_exec("insert into t2 values " + ",".join(
+        f"({i},{i % 200},{i * 7 % 89})" for i in range(200)))
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    tk.must_exec("set tidb_device_dispatch_rows = 1")
+    yield tk
+    # drain any short injected hangs so later tests see a clean gauge
+    deadline = time.monotonic() + 5.0
+    while supervisor.abandoned_calls() and time.monotonic() < deadline:
+        time.sleep(0.01)
+
+
+AGG_Q = "select grp, sum(val) from t1 group by grp order by grp"
+JOIN_Q = ("select t1.grp, sum(t2.amt) from t1 join t2 on t1.id = t2.ref "
+          "group by t1.grp order by t1.grp")
+
+
+def _drain():
+    deadline = time.monotonic() + 5.0
+    while supervisor.abandoned_calls() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert supervisor.abandoned_calls() == 0
+
+
+# -- unit behavior -----------------------------------------------------------
+
+class TestSupervisedCall:
+    def test_inline_when_no_deadline(self):
+        tid = threading.get_ident()
+        out = supervisor.supervised_call(
+            lambda: threading.get_ident(), deadline_s=0)
+        assert out == tid  # no worker thread hop on the unsupervised path
+
+    def test_worker_thread_and_result(self):
+        tid = threading.get_ident()
+        out = supervisor.supervised_call(
+            lambda: threading.get_ident(), deadline_s=5.0)
+        assert out != tid
+
+    def test_exceptions_pass_through(self):
+        with pytest.raises(KeyError):
+            supervisor.supervised_call(
+                lambda: (_ for _ in ()).throw(KeyError("x")),
+                deadline_s=5.0)
+
+    def test_deadline_raises_hang_and_reclaims(self):
+        t0 = time.monotonic()
+        with pytest.raises(DeviceHangError) as ei:
+            supervisor.supervised_call(time.sleep, 0.5, deadline_s=0.05,
+                                       label="unit-hang")
+        el = time.monotonic() - t0
+        assert el < 0.4, "detection must fire at the deadline, not fn end"
+        assert ei.value.code == ErrCode.DeviceHang == 9008
+        assert classify(ei.value) == CLASS_HANG
+        assert supervisor.abandoned_calls() >= 1
+        _drain()  # the sleeping worker completes and rejoins the pool
+
+    def test_tls_stats_bridged_to_caller(self):
+        """Compile stats accrued on the worker thread must show in the
+        CALLER's thread-local view (EXPLAIN ANALYZE / bench attribution)."""
+        from tidb_tpu.executor.device_exec import _bump, pipe_cache_stats
+        st0 = pipe_cache_stats(thread_local=True)
+        supervisor.supervised_call(_bump, "traces", 3, deadline_s=5.0)
+        st1 = pipe_cache_stats(thread_local=True)
+        assert st1["traces"] - st0["traces"] == 3
+
+    def test_fence_roundtrip(self):
+        supervisor.fence("unit test")
+        assert supervisor.quarantined()
+        supervisor._maybe_reinit()
+        assert not supervisor.quarantined()
+
+    def test_effective_deadline_sysvar_and_met(self, tk):
+        assert supervisor.effective_deadline(tk.session) == 0.0
+        tk.must_exec("set tidb_device_call_timeout = 2.5")
+        assert supervisor.effective_deadline(tk.session) == 2.5
+        tk.must_exec("set max_execution_time = 1000")
+        d = supervisor.effective_deadline(tk.session)
+        assert 0 < d <= 1.0  # the tighter (remaining-met) window wins
+        tk.must_exec("set max_execution_time = 0")
+        tk.must_exec("set tidb_device_call_timeout = 0")
+
+
+# -- hang injection in every fragment shape (satellite) ----------------------
+
+class TestFragmentHangs:
+    @pytest.mark.parametrize("fp,query,shape", [
+        ("device-agg-exec", AGG_Q, "agg"),
+        ("device-join-exec", JOIN_Q, "join"),
+    ])
+    def test_hang_detected_classified_and_counted(self, tk, fp, query,
+                                                  shape):
+        tk.must_exec("set tidb_device_call_timeout = 0.05")
+        br = get_breaker(tk.session, shape=shape)
+        before = br.snapshot()["failures"]
+        t0 = time.monotonic()
+        with failpoint.enabled(fp, "sleep(0.5)"):
+            e = tk.exec_error(query)
+        el = time.monotonic() - t0
+        assert isinstance(e, DeviceHangError), e
+        assert e.code == 9008
+        assert el < 0.4, f"hang detection took {el:.2f}s past the deadline"
+        assert br.snapshot()["failures"] == before + 1
+        # the backend is usable by the IMMEDIATELY following query in the
+        # same process (fence + reinit ran before its first fragment) —
+        # still supervised, but with room for the post-fence recompile
+        tk.must_exec("set tidb_device_call_timeout = 30")
+        rows = tk.must_query(query).rows
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        assert rows == tk.must_query(query).rows
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        _drain()
+
+    def test_mpp_fragment_hang(self, tk):
+        tk.must_exec("set tidb_executor_engine = 'tpu-mpp'")
+        tk.must_exec("set tidb_device_call_timeout = 0.05")
+        with failpoint.enabled("device-mpp-exec", "sleep(0.5)"):
+            e = tk.exec_error(AGG_Q)
+        assert isinstance(e, DeviceHangError), e
+        # next query (fault cleared) succeeds in the same process —
+        # supervised with room for the post-fence recompile
+        tk.must_exec("set tidb_device_call_timeout = 30")
+        assert tk.must_query(AGG_Q).rows
+        _drain()
+
+    def test_repeated_hangs_trip_breaker_to_host(self, tk):
+        """Once the breaker opens on hangs, fragments degrade to the host
+        engine up front — queries SUCCEED again even with the hang
+        failpoint still active (the degrade half of the contract)."""
+        tk.must_exec("set global tidb_device_circuit_threshold = 2")
+        tk.must_exec("set global tidb_device_circuit_cooldown = 30")
+        tk.must_exec("set tidb_device_call_timeout = 0.05")
+        br = get_breaker(tk.session, shape="agg")
+        try:
+            with failpoint.enabled("device-agg-exec", "sleep(0.5)"):
+                for _ in range(2):
+                    e = tk.exec_error(AGG_Q)
+                    assert isinstance(e, DeviceHangError)
+                assert br.state == "open"
+                rows = tk.must_query(AGG_Q).rows  # degraded, still right
+            tk.must_exec("set tidb_executor_engine = 'host'")
+            assert rows == tk.must_query(AGG_Q).rows
+        finally:
+            tk.must_exec("set global tidb_device_circuit_threshold = 5")
+            br.record_success()  # close for later tests
+        _drain()
+
+    def test_met_expiry_is_user_limit_not_hang(self, tk):
+        """When max_execution_time is the binding deadline, expiry is a
+        STATEMENT limit: QueryInterrupted (1317), no breaker charge, no
+        backend fence — the device earned no hang verdict."""
+        tk.must_exec("set max_execution_time = 150")
+        br = get_breaker(tk.session, shape="agg")
+        before = br.snapshot()["failures"]
+        fences0 = supervisor.snapshot()["hangs"]
+        with failpoint.enabled("device-agg-exec", "sleep(1.0)"):
+            e = tk.exec_error(AGG_Q)
+        tk.must_exec("set max_execution_time = 0")
+        assert isinstance(e, QueryInterruptedError), e
+        assert br.snapshot()["failures"] == before
+        assert supervisor.snapshot()["hangs"] == fences0
+        _drain()
+
+    def test_kill_interrupts_pending_hang(self, tk):
+        """KILL lands while the hung device call is still pending: the
+        query returns QueryInterrupted promptly — the supervisor's wait
+        is the interruption point the GIL-blocked call can't offer."""
+        tk.must_exec("set tidb_device_call_timeout = 10")
+        out = {}
+
+        def run():
+            t0 = time.monotonic()
+            try:
+                tk.session.execute(AGG_Q)
+                out["exc"] = None
+            except Exception as e:  # noqa: BLE001
+                out["exc"] = e
+            out["el"] = time.monotonic() - t0
+
+        with failpoint.enabled("device-agg-exec", "sleep(1.0)"):
+            t = threading.Thread(target=run)
+            t.start()
+            time.sleep(0.2)
+            tk.session.kill()
+            t.join(5.0)
+        assert not t.is_alive()
+        assert isinstance(out["exc"], QueryInterruptedError), out["exc"]
+        assert out["el"] < 0.9, (
+            f"KILL took {out['el']:.2f}s — must interrupt the wait, not "
+            "ride out the hung call")
+        _drain()
+
+
+# -- gauge surfacing ---------------------------------------------------------
+
+class TestAbandonedGauge:
+    def test_observe_explain_and_status_api(self, tk):
+        tk.must_exec("set tidb_device_call_timeout = 0.05")
+        # the sleep must outlive the EXPLAIN ANALYZE below (post-fence
+        # cold recompile can take >1s) so the gauge is still live at the
+        # /status fetch; _drain's window comfortably covers the rest
+        with failpoint.enabled("device-agg-exec", "sleep(3.0)"):
+            e = tk.exec_error(AGG_Q)
+        assert isinstance(e, DeviceHangError)
+        # the call is still blocked on its worker: gauge is live
+        assert supervisor.abandoned_calls() >= 1
+        obs = tk.domain.observe
+        assert obs.gauge_snapshot().get("device_abandoned_calls", 0) >= 1
+
+        # EXPLAIN ANALYZE of a (now unsupervised) device query annotates
+        # the outstanding gauge on its fragment line
+        tk.must_exec("set tidb_device_call_timeout = 0")
+        rows = tk.must_query(f"explain analyze {AGG_Q}").rows
+        blob = "\n".join(" ".join(str(c) for c in r) for r in rows)
+        assert "abandoned_device_calls" in blob
+
+        # HTTP status API: /status JSON field + /metrics gauge line
+        from tidb_tpu.server.http_status import StatusServer
+        srv = StatusServer(tk.domain, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status = json.load(urllib.request.urlopen(f"{base}/status"))
+            assert status["device_abandoned_calls"] >= 1
+            assert status["device_supervisor"]["hangs"] >= 1
+            metrics = urllib.request.urlopen(f"{base}/metrics").read()
+            assert b"device_abandoned_calls" in metrics
+        finally:
+            srv.shutdown()
+        _drain()
+        # drained: the worker completed, the gauge went back to zero
+        supervisor._publish()
+        assert obs.gauge_snapshot().get("device_abandoned_calls") == 0
+
+
+# -- lint: every run_device call site names its breaker shape (satellite) ----
+
+class TestRunDeviceShapeLint:
+    def test_all_call_sites_pass_explicit_shape(self):
+        """A run_device call without shape= silently shares the 'agg'
+        breaker — a new fragment class must never piggyback unnoticed.
+        AST-walk the whole package: direct calls AND the
+        `_with_pipe_stats(run_device, ...)` indirection both count."""
+        root = os.path.join(os.path.dirname(__file__), "..", "tidb_tpu")
+        offenders = []
+        for dirpath, _dirs, files in os.walk(os.path.abspath(root)):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    func = node.func
+                    direct = (isinstance(func, ast.Name)
+                              and func.id == "run_device") or (
+                                  isinstance(func, ast.Attribute)
+                                  and func.attr == "run_device")
+                    indirect = (isinstance(func, ast.Attribute)
+                                and func.attr == "_with_pipe_stats"
+                                and node.args
+                                and isinstance(node.args[0], ast.Name)
+                                and node.args[0].id == "run_device")
+                    if not (direct or indirect):
+                        continue
+                    if not any(kw.arg == "shape" for kw in node.keywords):
+                        offenders.append(f"{path}:{node.lineno}")
+        assert not offenders, (
+            "run_device call sites missing explicit shape= "
+            f"(breaker scoping): {offenders}")

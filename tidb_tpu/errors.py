@@ -66,6 +66,8 @@ class ErrCode:
     TiKVServerTimeout = 9002
     BackoffExhausted = 9005  # reference: ErrRegionUnavailable family —
     #                          the budgeted Backoffer ran out of retries
+    DeviceHang = 9008  # reserved next to 9005: a supervised device call
+    #                    blew its wall-clock deadline (the backend hung)
     LazyUniquenessCheckFailure = 8147
     ResolveLockTimeout = 9004
     GCTooEarly = 9006
@@ -192,6 +194,25 @@ class QueryInterruptedError(TiDBError):
 class MemoryQuotaExceeded(TiDBError):
     code = ErrCode.MemExceedThreshold
     sqlstate = "HY000"
+
+
+class DeviceHangError(TiDBError):
+    """A supervised device call exceeded its hard wall-clock deadline
+    (`tidb_device_call_timeout` / the remaining `max_execution_time`
+    window): the backend is presumed hung inside a GIL-holding C call the
+    engine cannot interrupt.  The call is ABANDONED on its worker thread,
+    the JAX backend is fenced (compiled-executable caches quarantined and
+    reinitialized before the next fragment), and the hang is recorded
+    against the per-shape circuit breaker so repeated hangs degrade the
+    fragment class to the host engine.
+
+    `shape` names the fragment class that hung (agg / join / window /
+    mpp), `deadline_s` the budget that expired."""
+
+    code = ErrCode.DeviceHang
+    sqlstate = "HY000"
+    shape = ""
+    deadline_s = 0.0
 
 
 class BackoffExhaustedError(TiDBError):

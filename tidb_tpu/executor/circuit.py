@@ -32,6 +32,17 @@ log = logging.getLogger("tidb_tpu.circuit")
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
+#: a HALF_OPEN probe outstanding longer than max(cooldown, this) without
+#: any verdict is presumed lost (its thread died or was abandoned on a
+#: path that skipped release_probe) — allow() reclaims the slot so the
+#: breaker can never wedge host-side forever.  Minutes-scale on purpose:
+#: a LIVE probe may legitimately sit in a post-fence cold XLA compile
+#: far past the cooldown (the live-TPU bench has measured ~6min compiles
+#: over the remote-compile tunnel), and stealing its slot would admit a
+#: second probe and orphan the first one's verdict; the floor only needs
+#: to be finite, not snappy
+_PROBE_RECLAIM_FLOOR_S = 900.0
+
 
 class CircuitBreaker:
     def __init__(self, threshold: int = 5, cooldown_s: float = 30.0,
@@ -46,8 +57,9 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probing = False
         self._probe_owner = None  # thread ident holding the probe slot
+        self._probe_started = 0.0
         self.stats = {"opened": 0, "degraded": 0, "failures": 0,
-                      "probes": 0}
+                      "probes": 0, "probe_reclaims": 0}
         self.last_error = ""
 
     def configure(self, threshold: int | None = None,
@@ -72,19 +84,29 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May a fragment dispatch to the device right now?  In HALF_OPEN
         exactly one caller wins the probe slot; the rest stay host-side
-        until the probe's verdict is in."""
+        until the probe's verdict is in.  A probe whose owner vanished
+        without any verdict (thread died on a path outside run_device's
+        release discipline) is reclaimed after a grace window instead of
+        wedging every future caller host-side."""
         with self._mu:
             if self.threshold <= 0:  # breaker disabled
                 return True
             st = self._peek_state()
             if st == CLOSED:
                 return True
-            if st == HALF_OPEN and not self._probing:
-                self._state = HALF_OPEN
-                self._probing = True
-                self._probe_owner = threading.get_ident()
-                self.stats["probes"] += 1
-                return True
+            if st == HALF_OPEN:
+                if (self._probing and self._clock() - self._probe_started
+                        > max(self.cooldown_s, _PROBE_RECLAIM_FLOOR_S)):
+                    self.stats["probe_reclaims"] += 1
+                    self._probing = False
+                    self._probe_owner = None
+                if not self._probing:
+                    self._state = HALF_OPEN
+                    self._probing = True
+                    self._probe_owner = threading.get_ident()
+                    self._probe_started = self._clock()
+                    self.stats["probes"] += 1
+                    return True
             self.stats["degraded"] += 1
             return False
 
@@ -103,6 +125,23 @@ class CircuitBreaker:
 
     def record_success(self):
         with self._mu:
+            if self._probing and self._probe_owner != threading.get_ident():
+                # a STALE fragment (admitted while CLOSED, finishing after
+                # the breaker opened) succeeds while another thread's probe
+                # is in flight: good news, but the probe owns the verdict —
+                # reset the failure streak without touching the probe slot
+                # or closing the breaker out from under the prober
+                self._failures = 0
+                return
+            if self._state in (OPEN, HALF_OPEN) and not self._probing:
+                # stale success with no probe in flight (a fragment
+                # admitted before the breaker tripped, finishing
+                # mid-cooldown — or after a prober released its slot with
+                # no verdict): recovery goes through a HALF_OPEN probe's
+                # OWN verdict, not through stragglers racing the hangs
+                # that opened the breaker
+                self._failures = 0
+                return
             if self._state in (HALF_OPEN, OPEN):
                 log.info("device circuit closed (probe succeeded)")
             self._state = CLOSED
@@ -117,6 +156,11 @@ class CircuitBreaker:
             if err is not None:
                 self.last_error = f"{classify(err)}: {err}"
             if self.threshold <= 0:
+                return
+            if self._probing and self._probe_owner != threading.get_ident():
+                # stale verdict during a live probe (see record_success):
+                # count it, but the slot and the state belong to the probe
+                self._failures += 1
                 return
             if self._state == HALF_OPEN:
                 # failed probe: back to a full cooldown
@@ -167,12 +211,13 @@ def get_breaker(ctx=None, shape: str = "agg") -> CircuitBreaker:
     clobber each other's threshold/cooldown mid-OPEN."""
     dom = getattr(ctx, "domain", None)
     if dom is not None:
-        brs = getattr(dom, "_device_breakers", None)
-        if brs is None:
-            brs = dom._device_breakers = {}
+        # dict.setdefault is atomic under the GIL: concurrent sessions
+        # (threaded chaos, server connections) racing the first fetch must
+        # converge on ONE breaker per shape, not each keep their own
+        brs = dom.__dict__.setdefault("_device_breakers", {})
         br = brs.get(shape)
         if br is None:
-            br = brs[shape] = CircuitBreaker(shape=shape)
+            br = brs.setdefault(shape, CircuitBreaker(shape=shape))
         try:
             gv = dom.global_vars
             br.configure(
@@ -184,7 +229,7 @@ def get_breaker(ctx=None, shape: str = "agg") -> CircuitBreaker:
         return br
     br = _GLOBALS.get(shape)
     if br is None:
-        br = _GLOBALS[shape] = CircuitBreaker(shape=shape)
+        br = _GLOBALS.setdefault(shape, CircuitBreaker(shape=shape))
     if ctx is not None:  # bare context: its own view is the only scope
         try:
             br.configure(

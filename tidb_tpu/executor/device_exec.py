@@ -39,7 +39,8 @@ def engine_mode(ctx) -> str:
 
 def run_device(ctx, fn, /, *args, shape="agg", **kw):
     """Dispatch one device fragment through the circuit breaker
-    (executor/circuit.py): an OPEN breaker degrades to the host engine
+    (executor/circuit.py) and the device-runtime supervisor
+    (executor/supervisor.py): an OPEN breaker degrades to the host engine
     up front (DeviceUnsupported → the caller's existing fallback), and a
     classified device/transport failure — an XLA runtime error, a dead
     remote-compile tunnel, an injected fault — records into the breaker
@@ -47,18 +48,39 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
     and TiDBError pass through untouched: "this fragment doesn't fit the
     device" and genuine user errors are not health signals.
 
+    When a deadline is in force (`tidb_device_call_timeout` sysvar or a
+    running `max_execution_time` window) the fragment executes on a
+    supervised worker thread: a backend HANG raises a classified
+    DeviceHangError into the query (recorded against the breaker, so
+    repeated hangs trip degradation), the abandoned call is fenced, and
+    the wait stays KILL-interruptible even while the backend blocks
+    inside a GIL-holding C call.
+
     `shape` scopes the breaker per fragment class (agg / join / window):
     one failing shape cools down without degrading healthy paths."""
+    from ..errors import DeviceHangError
     from ..utils.backoff import (classify, CLASS_DEVICE, CLASS_EXCHANGE,
                                  CLASS_FAULT, CLASS_TRANSPORT)
+    from . import supervisor
     from .circuit import get_breaker
     br = get_breaker(ctx, shape=shape)
     if not br.allow():
         raise DeviceUnsupported(
             f"device circuit open for {shape} fragments (cooling down; "
             "fragment degraded to host engine)")
+    deadline_s, fence_on_expiry = supervisor.deadline_for(ctx)
     try:
-        out = fn(*args, **kw)
+        out = supervisor.call_supervised(
+            fn, args, kw, deadline_s=deadline_s, ctx=ctx, shape=shape,
+            fence_on_expiry=fence_on_expiry)
+    except DeviceHangError as e:
+        # the hang IS a health verdict: count it toward opening the
+        # breaker, then surface the classified error — the query fails
+        # (its device call is still in flight; a silent host fallback
+        # would hide that the deadline fired) but the NEXT queries
+        # degrade once the breaker trips
+        br.record_failure(e)
+        raise
     except (DeviceUnsupported, TiDBError):
         # no health verdict: if this fragment held the HALF_OPEN probe
         # slot, free it — otherwise the breaker wedges with no prober
@@ -155,19 +177,25 @@ def pipe_cache_stats(thread_local: bool = False) -> dict:
 
 
 def _pipe_cache_get(key):
-    hit = _PIPE_CACHE.get(key)
+    # OrderedDict LRU mutation is NOT thread-safe; concurrent sessions
+    # (threaded chaos, server connections) share this cache, so every
+    # structural touch happens under the stats lock
+    with _PIPE_LOCK:
+        hit = _PIPE_CACHE.get(key)
+        if hit is not None:
+            _PIPE_CACHE.move_to_end(key)
     if hit is None:
         _bump("misses")
         return None
     _bump("hits")
-    _PIPE_CACHE.move_to_end(key)
     return hit[0]
 
 
 def _pipe_cache_put(key, fn, dict_refs):
-    _PIPE_CACHE[key] = (fn, dict_refs)
-    if len(_PIPE_CACHE) > _PIPE_CACHE_MAX:
-        _PIPE_CACHE.popitem(last=False)
+    with _PIPE_LOCK:
+        _PIPE_CACHE[key] = (fn, dict_refs)
+        if len(_PIPE_CACHE) > _PIPE_CACHE_MAX:
+            _PIPE_CACHE.popitem(last=False)
 
 
 def _count_trace():

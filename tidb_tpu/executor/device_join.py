@@ -938,6 +938,10 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
     """Entry: compile + run the fused join+agg fragment for a HashAgg whose
     child is a join tree over table scans. Raises DeviceUnsupported when
     out of scope (caller falls back to the host executors)."""
+    from ..utils import failpoint
+    # chaos/supervisor hook: a `sleep(...)` here models a backend hang at
+    # the join-fragment boundary, `panic` a runtime failure
+    failpoint.inject("device-join-exec")
     from .device_exec import want_device
     root, leaves, joins = collect_tree(child_exec)
     if not want_device(ctx, max(leaf.chunk.num_rows for leaf in leaves)):

@@ -77,6 +77,13 @@ class QueryExecutor:
                 pipe_misses=st1["misses"] - st0["misses"],
                 xla_compiles=st1["compiles"] - st0["compiles"],
                 compile_s=round(st1["compile_s"] - st0["compile_s"], 3))
+            from .supervisor import abandoned_calls
+            n_abandoned = abandoned_calls()
+            if n_abandoned:
+                # the supervisor's "abandoned calls outstanding" gauge:
+                # a prior fragment's hung device call is still blocked on
+                # its worker thread while this plan runs
+                self.annotate(abandoned_device_calls=n_abandoned)
         return out
 
 

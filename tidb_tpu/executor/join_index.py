@@ -30,6 +30,19 @@ this is the common case on every north-star query).
 Multi-column keys fold into one int64 by range packing with host-known
 (min, span) per column — unlike the device-side data-dependent packing
 (device_join._combined_join_keys), these are static at trace time.
+
+Version tolerance (ROADMAP "version-tolerant pack"): the per-column
+(min, span) is QUANTIZED to a geometric grid (`_quantize_range`) instead
+of being exact.  The packs are baked into compiled-fragment signatures
+and dense-CSR array shapes (`device_join._strategy_sig`,
+`JoinIndex.starts`), so with exact bounds ANY dimension-table delta that
+nudged a key's min/max — one UPDATE widening a range by 1 — changed the
+signature and forced a full XLA recompile.  With ~1/16-of-magnitude
+slack on each end, a delta that stays inside the widened range rebuilds
+only the (cheap, numpy) host index and re-uses the compiled fragment:
+the lookup arrays are passed as runtime arguments, so same shapes ⇒ same
+program.  Correctness is unaffected — probe keys in the slack region
+simply find zero matches, exactly like any other unmatched key.
 """
 
 from __future__ import annotations
@@ -40,6 +53,21 @@ import numpy as np
 #: row count (beyond that the starts array dwarfs the table)
 _DENSE_SLACK = 4
 _DENSE_FLOOR = 65536
+
+#: pack quantization: grid = 2^(bit_length(span)-1-SLACK_BITS) ≈ span/16
+#: (min floors to the grid, max ceils) — ≤ ~12.5% span overshoot buys
+#: signature stability across small dimension-table range drifts
+_PACK_SLACK_BITS = 4
+
+
+def _quantize_range(mn: int, mx: int) -> tuple[int, int]:
+    """Widen [mn, mx] to a geometric grid so slightly-shifted bounds from
+    a future table version land on the SAME packed range."""
+    span = mx - mn + 1
+    g = 1 << max((span - 1).bit_length() - _PACK_SLACK_BITS, 0)
+    mn_q = (mn // g) * g                # floor toward -inf
+    mx_q = ((mx // g) + 1) * g - 1      # ceil to the next grid edge - 1
+    return mn_q, mx_q
 
 
 class JoinIndex:
@@ -118,6 +146,9 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
             mn, mx = 0, 0
         else:
             mn, mx = int(dv.min()), int(dv.max())
+        # slack-quantized range: within-slack deltas keep the pack — and
+        # therefore the fragment signature and compiled program — stable
+        mn, mx = _quantize_range(mn, mx)
         span = mx - mn + 1
         total_span *= span
         packs.append((mn, span))

@@ -413,6 +413,11 @@ def _build_key_leaf(node, leaves):
 
 
 def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
+    from ..utils import failpoint as _fp
+    # chaos/supervisor hook: a `sleep(...)` here models a hung collective
+    # at the MPP fragment boundary (the exchange-dispatch analog of
+    # device-agg-exec / device-join-exec)
+    _fp.inject("device-mpp-exec")
     n_shards = mesh.shape[AXIS]
 
     # The shard leaf must sit on the probe (left) spine: every join's

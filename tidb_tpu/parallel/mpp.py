@@ -123,7 +123,35 @@ def _local_agg(keys, valid, vals, kinds, capacity):
     return group_keys, tuple(outs), out_valid, n_groups
 
 
-def dist_agg_step(mesh: Mesh, kinds: tuple, capacity: int, axis: str = "part"):
+def _supervised_step(step, ctx):
+    """Route a jitted exchange-dispatch step through the device-runtime
+    supervisor (executor/supervisor.py) when the caller's context carries
+    a deadline (`tidb_device_call_timeout` / `max_execution_time`): a
+    collective hung inside the PJRT client raises a classified
+    DeviceHangError instead of freezing the caller.  With no context (or
+    no deadline) the step dispatches inline, unchanged.
+
+    Note the SQL path's MPP fragments don't come through here — they are
+    built by executor/mpp_exec.py and supervised one level up, inside
+    run_device.  The `ctx=` hook exists for direct library embedders of
+    dist_agg_step / dist_join_agg_step, who otherwise have no supervised
+    wrapper between them and a hung collective (tests/test_mpp.py
+    exercises it)."""
+    if ctx is None:
+        return step
+
+    def call(*args, **kw):
+        from ..executor.supervisor import call_supervised, deadline_for
+        deadline_s, fence = deadline_for(ctx)
+        return call_supervised(step, args, kw, deadline_s=deadline_s,
+                               ctx=ctx, shape="mpp", label="mpp exchange",
+                               fence_on_expiry=fence)
+
+    return call
+
+
+def dist_agg_step(mesh: Mesh, kinds: tuple, capacity: int,
+                  axis: str = "part", ctx=None):
     """Build the jitted distributed group-by step (partial → all_gather →
     final). Inputs are row-sharded over `axis`:
         keys  int64[N]      group key codes
@@ -155,7 +183,7 @@ def dist_agg_step(mesh: Mesh, kinds: tuple, capacity: int, axis: str = "part"):
                                fng) > capacity
         return fk, fouts, fvalid, fng, overflow
 
-    return jax.jit(step)
+    return _supervised_step(jax.jit(step), ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -194,7 +222,7 @@ def _exchange_hash(keys, vals, valid, axis, n_dest, cap):
     return (a2a(bk), tuple(a2a(v) for v in bvals), a2a(bvalid), dropped)
 
 
-def dist_join_agg_step(mesh: Mesh, cap: int, axis: str = "part"):
+def dist_join_agg_step(mesh: Mesh, cap: int, axis: str = "part", ctx=None):
     """Build the jitted distributed shuffled-hash-join + aggregate step
     (the MPP shuffle join fragment: Q3-shaped `SUM(probe_val *
     matched_build_sum)` — e.g. revenue over lineitem ⋈ filtered orders).
@@ -239,4 +267,4 @@ def dist_join_agg_step(mesh: Mesh, cap: int, axis: str = "part"):
         dropped = jax.lax.psum(bdrop + pdrop, axis)
         return total, pairs, dropped
 
-    return jax.jit(step)
+    return _supervised_step(jax.jit(step), ctx)

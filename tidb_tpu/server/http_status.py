@@ -87,18 +87,33 @@ class StatusServer:
     # -- payloads ------------------------------------------------------------
 
     def _status(self):
+        from ..executor import supervisor
         return {
             "version": "8.0.11-tpu-htap",
             "connections": len(self.domain.sessions),
             "kv_engine": self.domain.store.backend,
+            # device-runtime supervision (executor/supervisor.py): the
+            # abandoned-calls gauge plus hang/fence counters, so a hung
+            # backend is diagnosable from the status port alone
+            "device_abandoned_calls": supervisor.abandoned_calls(),
+            "device_supervisor": supervisor.snapshot(),
         }
 
     def _metrics(self):
         """Prometheus text exposition of the domain counters (reference:
         metrics/metrics.go registry served on the status port)."""
+        from ..executor import supervisor
         lines = []
         for name, val in sorted(self.domain.observe.counters.items()):
             lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {val}")
+        gauges = dict(self.domain.observe.gauge_snapshot())
+        # the supervisor gauge is process-wide; surface it even when no
+        # supervised call has registered this domain's sink yet
+        gauges.setdefault("device_abandoned_calls",
+                          supervisor.abandoned_calls())
+        for name, val in sorted(gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {val}")
         lines.append("# TYPE server_connections gauge")
         lines.append(f"server_connections {len(self.domain.sessions)}")

@@ -72,10 +72,22 @@ class Observability:
         self._summary_cap = summary_cap
         # metrics: flat counter/gauge registry (reference: metrics/metrics.go)
         self.counters = collections.Counter()
+        # gauges are SET, not incremented: point-in-time values like the
+        # supervisor's "abandoned device calls outstanding"
+        # (executor/supervisor.py publishes into every registered sink)
+        self.gauges: dict = {}
 
     def inc(self, name, n=1):
         with self._lock:
             self.counters[name] += n
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            self.gauges[name] = value
+
+    def gauge_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.gauges)
 
     def observe_stmt(self, *, user, db, sql, digest, latency_s, rows, succ,
                      slow_threshold_s, plan=""):

@@ -226,6 +226,16 @@ for _v in [
            10000),
     # seconds the breaker stays OPEN before a HALF_OPEN probe fragment
     SysVar("tidb_device_circuit_cooldown", SCOPE_BOTH, "30", "float", 0),
+    # hard wall-clock deadline (seconds) for ONE device call through the
+    # supervisor (executor/supervisor.py): expiry raises DeviceHangError
+    # (errno 9008), abandons the call, fences/reinitializes the backend
+    # and counts toward the circuit breaker. 0 = unsupervised inline
+    # dispatch (the remaining max_execution_time window still supervises,
+    # but ITS expiry is QueryInterrupted — a user limit, not a hang).
+    # Set it ABOVE the workload's worst-case cold-compile time: off-CPU
+    # the deadline covers compilation, and a too-small value re-fences
+    # (re-colds) the very compile it then times out again
+    SysVar("tidb_device_call_timeout", SCOPE_BOTH, "0", "float", 0),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
            str(100 * 1024 * 1024), "int", 0),
     SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH,

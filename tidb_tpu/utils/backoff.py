@@ -22,6 +22,9 @@ Error taxonomy (classify()): the classes the distributed path can see —
     device     accelerator compile/OOM/runtime failure
     transport  remote-compile / tunnel transport errors (the dead-tunnel
                "Connection refused" mode from BENCH_TPU_LIVE.json)
+    hang       a supervised device call blew its wall-clock deadline
+               (executor/supervisor.py — the backend froze inside a
+               GIL-holding C call, distinct from a device that ERRORS)
     fault      an injected failpoint fired
     other      anything unclassified
 """
@@ -45,6 +48,7 @@ CLASS_LEASE = "lease"
 CLASS_EXCHANGE = "exchange"
 CLASS_DEVICE = "device"
 CLASS_TRANSPORT = "transport"
+CLASS_HANG = "hang"
 CLASS_FAULT = "fault"
 CLASS_OTHER = "other"
 
@@ -53,6 +57,9 @@ def classify(err) -> str:
     """Map an exception to its resilience class (one label the breaker,
     the backoffer and the slow log all agree on)."""
     from .failpoint import FailpointError
+    from ..errors import DeviceHangError
+    if isinstance(err, DeviceHangError):
+        return CLASS_HANG
     if isinstance(err, (LockedError, WriteConflictError, DeadlockError,
                         SchemaChangedError)):
         return CLASS_REGION

@@ -98,6 +98,12 @@ def inject(name: str):
         if hit <= int(m.group(1)):
             raise FailpointError(f"failpoint {name} triggered")
         return None
+    m = re.fullmatch(r"(\d+)\*sleep\(([\d.]+)\)", action)
+    if m:  # N*sleep(s): stall the first N hits (hang injection), then
+        #   no-op — lets a schedule hang ONE dispatch and run clean after
+        if hit <= int(m.group(1)):
+            time.sleep(float(m.group(2)))
+        return None
     m = re.fullmatch(r"(\d+)\*return\((.*)\)", action)
     if m:  # N*return(v): payload for the first N hits, then no-op
         if hit <= int(m.group(1)):
