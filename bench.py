@@ -928,6 +928,11 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                 "warmup_minus_steady_s": round(max(warm_t - dev_t, 0.0), 4),
                 "xla_compiles": st2["compiles"] - st0["compiles"],
             }
+            # HBM residency (ops/residency.py): cached-bytes ledger after
+            # the timed runs; eviction/OOM counters only when they fired —
+            # a bench line that ran under device-memory pressure says so
+            from tidb_tpu.ops import residency as _res
+            compile_info.update(_res.report_gauges())
             if _WARM_LOCK_MISSES[0] > wm0:
                 # a timed run raced the keep-warm dispatch: the numbers
                 # are contended — mark them so history comparisons skip

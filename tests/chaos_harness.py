@@ -19,11 +19,18 @@ Usage:  run_seed(seed) -> dict of counters; raises AssertionError on any
 invariant violation.  tests/test_chaos.py drives a fixed-seed smoke in
 tier-1 and a deeper sweep (CHAOS_SEEDS=n, marked slow) locally.
 
+Both modes inject synthetic HBM exhaustion (`device-upload-oom` with
+`oom`/`N*oom` actions): a transient OOM must be absorbed by the
+evict-all → retry ladder and a persistent one must degrade to the host
+engine (ops/residency.py) — reads stay exact either way, and the
+residency byte ledger must show ZERO drift afterwards.
+
 THREADED MODE (`run_threaded_seed`): N worker threads issue concurrent
 queries + transfer DML against ONE Domain while a seeded schedule flips
 failpoints — including backend-HANG injection (sleep actions under a
 small `tidb_device_call_timeout`, exercising the device-runtime
-supervisor) — closing the ROADMAP "multi-core interleaving fuzzing"
+supervisor) and HBM-OOM injection interleaving with the hangs and DML —
+closing the ROADMAP "multi-core interleaving fuzzing"
 item.  Interleavings are nondeterministic, so the contract is
 INVARIANT-ONLY (no bit-for-bit goldens):
 
@@ -80,6 +87,11 @@ ENGINES = ["auto", "host", "tpu", "tpu-mpp"]
 #: PERSISTENT (the run must degrade or fail classified — never hang).
 READ_FAULTS = {
     "device-agg-exec": ["panic", "1*panic", "2*panic"],
+    # synthetic HBM RESOURCE_EXHAUSTED at the upload boundary: transient
+    # (N*oom) must be absorbed by the evict-all → retry ladder, persistent
+    # (oom) must degrade to the host engine — either way the read stays
+    # EXACT (ops/residency.py + device_exec.run_device)
+    "device-upload-oom": ["oom", "1*oom", "2*oom"],
     "mpp-exchange-send": ["1*panic", "2*panic", "panic"],
     "mpp-exchange-recv": ["1*panic", "panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -208,6 +220,12 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
             rows = tuple(map(tuple, tk.must_query(q).rows))
             assert rows == goldens[qi], (
                 f"seed {seed}: no recovery after faults cleared: {q!r}")
+
+        # -- HBM residency ledger: no budget-counter drift -----------------
+        from tidb_tpu.ops import residency
+        led = residency.verify_ledger()
+        assert led["ok"], (
+            f"seed {seed}: HBM LEDGER DRIFT after OOM chaos: {led}")
     finally:
         failpoint.disable_all()
     return stats
@@ -222,6 +240,10 @@ THREADED_FAULTS = {
     "device-agg-exec": ["panic", "1*panic", "sleep(0.05)"],
     "device-join-exec": ["panic", "1*panic", "sleep(0.05)"],
     "device-mpp-exec": ["1*panic", "sleep(0.05)"],
+    # HBM OOM interleaving with hangs and DML: concurrent evict-all /
+    # retry / host-degradation must keep the residency byte ledger
+    # drift-free (checked after the joins below)
+    "device-upload-oom": ["oom", "1*oom", "2*oom"],
     "mpp-exchange-send": ["1*panic", "panic"],
     "mpp-exchange-recv": ["1*panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -364,6 +386,15 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
         "calls never completed")
     stats["hangs"] = (supervisor.snapshot()["hangs"]
                       - sup_before["hangs"])
+
+    # HBM residency ledger: concurrent upload/evict/OOM-recovery/fence
+    # must leave hbm_bytes_cached consistent with the live entries (no
+    # budget-counter drift) — THE invariant the residency lock exists for
+    from tidb_tpu.ops import residency
+    led = residency.verify_ledger()
+    assert led["ok"], (
+        f"seed {seed}: HBM LEDGER DRIFT after threaded OOM chaos: {led}")
+    stats["oom_recoveries"] = residency.snapshot()["hbm_oom_recoveries"]
 
     # breaker-state sanity: legal state, probe slot not wedged
     for shape, br in getattr(tk.domain, "_device_breakers", {}).items():

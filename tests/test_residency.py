@@ -1,0 +1,417 @@
+"""HBM residency manager (ops/residency.py): byte-accounted, budgeted,
+epoch-scoped device caches; the OOM recovery ladder (evict-all → single
+retry → host degradation); the hardened device-OOM taxonomy; gauge
+surfacing in EXPLAIN ANALYZE / observe / HTTP status; and the
+``._device`` containment AST lint."""
+
+import ast
+import gc
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tidb_tpu.executor import supervisor
+from tidb_tpu.executor.circuit import get_breaker
+from tidb_tpu.ops import device as dev
+from tidb_tpu.ops import residency
+from tidb_tpu.sqltypes import FieldType, TYPE_LONG
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.backoff import (
+    CLASS_DEVICE, CLASS_FAULT, CLASS_TRANSPORT, classify, is_device_oom)
+from tidb_tpu.utils.chunk import Column
+from tidb_tpu.utils.failpoint import FailpointError, InjectedOOMError
+
+
+def _int_col(n, seed=0):
+    return Column(FieldType(TYPE_LONG),
+                  np.arange(seed, seed + n, dtype=np.int64))
+
+
+@pytest.fixture()
+def clean_budget():
+    residency.set_budget(0)
+    yield
+    residency.set_budget(0)
+
+
+@pytest.fixture()
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t1 (id int primary key, grp int, val int)")
+    tk.must_exec("create table t2 (id int primary key, ref int, amt int)")
+    tk.must_exec("insert into t1 values " + ",".join(
+        f"({i},{i % 5},{i * 3 % 97})" for i in range(200)))
+    tk.must_exec("insert into t2 values " + ",".join(
+        f"({i},{i % 200},{i * 7 % 89})" for i in range(200)))
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    tk.must_exec("set tidb_device_dispatch_rows = 1")
+    yield tk
+    deadline = time.monotonic() + 5.0
+    while supervisor.abandoned_calls() and time.monotonic() < deadline:
+        time.sleep(0.01)
+
+
+AGG_Q = "select grp, sum(val) from t1 group by grp order by grp"
+JOIN_Q = ("select t1.grp, sum(t2.amt) from t1 join t2 on t1.id = t2.ref "
+          "group by t1.grp order by t1.grp")
+
+
+# -- device-OOM taxonomy (satellite: hardened classify) ----------------------
+
+class TestDeviceOOMTaxonomy:
+    #: (exception factory, expected class, expected is_device_oom) — THE
+    #: taxonomy table for the OOM ladder's admission test
+    TABLE = [
+        # jaxlib's canonical phrasing
+        (lambda: RuntimeError("RESOURCE_EXHAUSTED: Out of memory "
+                              "allocating 1073741824 bytes"),
+         CLASS_DEVICE, True),
+        # PJRT / TFRT allocator phrasings
+        (lambda: RuntimeError("Resource exhausted: Failed to allocate "
+                              "request for 2.0GiB"),
+         CLASS_DEVICE, True),
+        (lambda: RuntimeError("Allocation failure: OUT_OF_MEMORY on "
+                              "device ordinal 0"),
+         CLASS_DEVICE, True),
+        (lambda: RuntimeError("Attempting to reserve 5.1G at the bottom "
+                              "of memory. That was not possible. "
+                              "Exceeds the amount of memory available"),
+         CLASS_DEVICE, True),
+        # the injected failpoint OOM mimics the canonical phrasing
+        (lambda: InjectedOOMError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 8 bytes "
+            "(injected by failpoint device-upload-oom)"),
+         CLASS_DEVICE, True),
+        # a device error that is NOT memory pressure: no evict/retry
+        (lambda: _XlaLike("INTERNAL: during context [pre-optimization]: "
+                          "Invalid argument"),
+         CLASS_DEVICE, False),
+        # a SUBCLASS of XlaRuntimeError whose leaf name says nothing —
+        # the MRO walk must still classify it `device`
+        (lambda: _XlaSubclass("something broke"), CLASS_DEVICE, False),
+        # non-device classes never admit the OOM ladder
+        (lambda: FailpointError("failpoint device-agg-exec triggered"),
+         CLASS_FAULT, False),
+        (lambda: ConnectionRefusedError("Connection refused"),
+         CLASS_TRANSPORT, False),
+    ]
+
+    def test_taxonomy_table(self):
+        for factory, want_cls, want_oom in self.TABLE:
+            err = factory()
+            assert classify(err) == want_cls, err
+            assert is_device_oom(err) == want_oom, err
+
+    def test_failpoint_oom_action_raises_classified_oom(self):
+        with failpoint.enabled("unit-oom", "1*oom"):
+            with pytest.raises(InjectedOOMError) as ei:
+                failpoint.inject("unit-oom")
+            assert is_device_oom(ei.value)
+            assert failpoint.inject("unit-oom") is None  # N exhausted
+
+
+# dynamic stand-ins for jaxlib's error types (importing jaxlib's actual
+# XlaRuntimeError would couple the test to the installed jax version)
+_XlaLike = type("XlaRuntimeError", (Exception,), {})
+_XlaSubclass = type("BackendDiedError", (_XlaLike,), {})
+
+
+# -- ledger / budget / publish-race units ------------------------------------
+
+class TestResidencyLedger:
+    def test_upload_registers_and_hits(self, clean_budget):
+        residency.evict_all("test isolation")
+        col = _int_col(128)
+        s0 = residency.snapshot()
+        dc = dev.to_device_col(col)
+        s1 = residency.snapshot()
+        assert s1["uploads"] == s0["uploads"] + 1
+        want = dc.data.nbytes + dc.nulls.nbytes
+        assert s1["hbm_bytes_cached"] - s0["hbm_bytes_cached"] == want
+        dev.to_device_col(col)  # second read: cache hit, no new upload
+        s2 = residency.snapshot()
+        assert s2["uploads"] == s1["uploads"]
+        assert s2["hits"] > s1["hits"]
+        assert residency.verify_ledger()["ok"]
+
+    def test_budget_evicts_lru_first(self, clean_budget):
+        residency.evict_all("test isolation")
+        cold, warm = _int_col(256), _int_col(256, seed=9)
+        dev.to_device_col(cold)
+        dev.to_device_col(warm)
+        dev.to_device_col(cold)  # touch: `warm` is now the LRU victim
+        both = residency.resident_bytes()
+        s0 = residency.snapshot()
+        residency.set_budget(both)  # next upload must push someone out
+        newest = _int_col(256, seed=77)
+        dev.to_device_col(newest)
+        s1 = residency.snapshot()
+        assert s1["hbm_evictions"] > s0["hbm_evictions"]
+        assert residency.resident_bytes() <= both
+        # LRU order: the untouched `warm` went first; `cold` survived
+        assert cold._device is not None
+        assert warm._device is None
+        assert residency.verify_ledger()["ok"]
+
+    def test_oversized_single_entry_is_kept(self, clean_budget):
+        residency.evict_all("test isolation")
+        residency.set_budget(16)  # smaller than any real upload
+        col = _int_col(64)
+        dc = dev.to_device_col(col)  # must not livelock or raise
+        assert int(dc.data.shape[0]) == 64
+        assert residency.resident_bytes() > 16
+        assert residency.verify_ledger()["ok"]
+
+    def test_publish_race_compare_and_keep(self, clean_budget):
+        """The loser of a racing publish is discarded AND accounted as
+        immediately evicted — never a silent untracked HBM leak (the
+        pre-residency `col._device = cached` was last-wins)."""
+        residency.evict_all("test isolation")
+        col = _int_col(64)
+        dc = dev.to_device_col(col)
+        s0 = residency.snapshot()
+        import jax.numpy as jnp
+        loser = (jnp.zeros(64, dtype=jnp.int64), jnp.zeros(64, dtype=bool))
+        kept_d, _kept_n = residency.publish(col, *loser)
+        s1 = residency.snapshot()
+        assert kept_d is dc.data  # incumbent wins
+        assert s1["publish_races"] == s0["publish_races"] + 1
+        assert s1["hbm_evictions"] == s0["hbm_evictions"] + 1
+        assert s1["hbm_bytes_cached"] == s0["hbm_bytes_cached"]
+        assert residency.verify_ledger()["ok"]
+
+    def test_grow_evicts_and_reuploads(self, clean_budget):
+        residency.evict_all("test isolation")
+        col = _int_col(64)
+        dev.to_device_col(col)
+        small = residency.resident_bytes()
+        dc = dev.to_device_col(col, bucket=256)
+        assert int(dc.data.shape[0]) == 256
+        assert residency.resident_bytes() > small
+        assert residency.verify_ledger()["ok"]
+
+    def test_grow_keeps_old_entry_until_swap(self, clean_budget):
+        """A grow request misses WITHOUT evicting: the smaller cached
+        entry keeps serving shorter-bucket readers until publish() swaps
+        it, so a rebuild failing mid-flight (the OOM failpoint) leaves
+        the column still cached."""
+        residency.evict_all("test isolation")
+        col = _int_col(64)
+        dev.to_device_col(col)
+        small = residency.resident_bytes()
+        assert residency.lookup(col, 256) is None  # grow: a miss...
+        assert residency.resident_bytes() == small  # ...but no evict
+        assert residency.lookup(col, 64) is not None  # still serving
+        with failpoint.enabled("device-upload-oom", "oom"):
+            with pytest.raises(Exception):
+                dev.to_device_col(col, bucket=256)  # rebuild dies
+        assert residency.lookup(col, 64) is not None  # cache survived
+        dc = dev.to_device_col(col, bucket=256)  # clean grow swaps
+        assert int(dc.data.shape[0]) == 256
+        assert residency.verify_ledger()["ok"]
+
+    def test_recover_oom_bumps_epoch(self, clean_budget):
+        """OOM recovery must invalidate epoch-stamped consumers (join
+        leaf dcols) too — without the bump, a mid-flight leaf dict would
+        re-pin the very buffers the evict-all freed."""
+        e0 = residency.device_epoch()
+        residency.recover_oom(RuntimeError("RESOURCE_EXHAUSTED: test"))
+        assert residency.device_epoch() == e0 + 1
+        assert residency.resident_bytes() == 0
+
+    def test_budget_reads_global_scope(self, tk):
+        """The ledger is process-wide: attach() takes the budget from the
+        Domain's GLOBAL vars; a session-scoped SET must not clobber it
+        (same discipline as the circuit-breaker knobs)."""
+        try:
+            tk.must_exec("set global tidb_device_mem_budget = 2048")
+            residency.attach(tk.session)
+            assert residency.effective_budget() == 2048
+            tk.must_exec("set tidb_device_mem_budget = 7")  # session only
+            residency.attach(tk.session)
+            assert residency.effective_budget() == 2048  # global wins
+        finally:
+            tk.must_exec("set global tidb_device_mem_budget = 0")
+            residency.set_budget(0)
+
+    def test_gc_releases_ledger_bytes(self, clean_budget):
+        residency.evict_all("test isolation")
+        col = _int_col(64)
+        dev.to_device_col(col)
+        assert residency.resident_bytes() > 0
+        del col
+        gc.collect()
+        assert residency.resident_bytes() == 0
+        assert residency.verify_ledger()["ok"]
+
+
+# -- epoch fence regression (satellite: test coverage) -----------------------
+
+class TestEpochFence:
+    def test_fence_invalidates_column_caches(self, tk):
+        """Populate Column._device via a device aggregate, fence, assert
+        the next query RE-UPLOADS (epoch mismatch — no pre-fence buffer
+        is ever reused) and still returns correct results."""
+        tk.must_query(AGG_Q)  # populate
+        u_warm = residency.snapshot()["uploads"]
+        tk.must_query(AGG_Q)  # warm: cached uploads serve the re-run
+        assert residency.snapshot()["uploads"] == u_warm
+        assert residency.resident_bytes() > 0
+
+        epoch0 = residency.device_epoch()
+        supervisor.fence("epoch regression test")
+        assert residency.device_epoch() == epoch0 + 1
+        assert residency.resident_bytes() == 0  # ledger cleared at fence
+
+        rows = tk.must_query(AGG_Q).rows
+        assert residency.snapshot()["uploads"] > u_warm, (
+            "post-fence query served a pre-fence device buffer")
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        assert rows == tk.must_query(AGG_Q).rows
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+
+    def test_fence_invalidates_join_leaf_caches(self, tk):
+        tk.must_query(JOIN_Q)
+        u_warm = residency.snapshot()["uploads"]
+        tk.must_query(JOIN_Q)
+        assert residency.snapshot()["uploads"] == u_warm
+        supervisor.fence("join epoch regression test")
+        rows = tk.must_query(JOIN_Q).rows
+        assert residency.snapshot()["uploads"] > u_warm
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        assert rows == tk.must_query(JOIN_Q).rows
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+
+
+# -- OOM recovery ladder (tentpole acceptance) -------------------------------
+
+class TestOOMLadder:
+    def test_transient_oom_recovers_via_evict_and_retry(self, tk):
+        """ONE injected upload OOM: evict-all + single retry completes the
+        query on-device — no error, no breaker charge."""
+        residency.evict_all("force re-upload so the failpoint fires")
+        br = get_breaker(tk.session, shape="agg")
+        fail0 = br.snapshot()["failures"]
+        rec0 = residency.snapshot()["hbm_oom_recoveries"]
+        with failpoint.enabled("device-upload-oom", "1*oom"):
+            rows = tk.must_query(AGG_Q).rows
+        assert residency.snapshot()["hbm_oom_recoveries"] == rec0 + 1
+        assert br.snapshot()["failures"] == fail0  # absorbed, not charged
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        assert rows == tk.must_query(AGG_Q).rows
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        assert residency.verify_ledger()["ok"]
+
+    def test_persistent_oom_degrades_to_host(self, tk):
+        """A persistent upload OOM walks the whole ladder: evict-all →
+        retry (fails again) → breaker charge → host degradation.  The
+        query COMPLETES with correct rows — never an unhandled error."""
+        residency.evict_all("force re-upload so the failpoint fires")
+        br = get_breaker(tk.session, shape="agg")
+        fail0 = br.snapshot()["failures"]
+        with failpoint.enabled("device-upload-oom", "oom"):
+            rows = tk.must_query(AGG_Q).rows  # degraded, still succeeds
+        assert br.snapshot()["failures"] == fail0 + 1
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        assert rows == tk.must_query(AGG_Q).rows
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        # after the chaos: ledger consistent, and the next clean run
+        # re-populates the cache
+        assert residency.verify_ledger()["ok"]
+        assert tk.must_query(AGG_Q).rows == rows
+        assert residency.resident_bytes() > 0
+
+    def test_join_upload_oom_recovers(self, tk):
+        residency.evict_all("force re-upload so the failpoint fires")
+        rec0 = residency.snapshot()["hbm_oom_recoveries"]
+        with failpoint.enabled("device-upload-oom", "1*oom"):
+            rows = tk.must_query(JOIN_Q).rows
+        assert residency.snapshot()["hbm_oom_recoveries"] == rec0 + 1
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        assert rows == tk.must_query(JOIN_Q).rows
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+
+
+# -- gauge surfacing ---------------------------------------------------------
+
+class TestGaugesSurfaced:
+    def test_explain_observe_status_and_metrics(self, tk):
+        residency.evict_all("force re-upload so the failpoint fires")
+        with failpoint.enabled("device-upload-oom", "1*oom"):
+            tk.must_query(AGG_Q)  # one recovery: counters all nonzero
+
+        # EXPLAIN ANALYZE annotates the gauges on the device fragment
+        rows = tk.must_query(f"explain analyze {AGG_Q}").rows
+        blob = "\n".join(" ".join(str(c) for c in r) for r in rows)
+        assert "hbm_bytes_cached" in blob
+        assert "hbm_oom_recoveries" in blob
+
+        # observe gauges (the Domain sink run_device registered)
+        g = tk.domain.observe.gauge_snapshot()
+        assert g.get("hbm_bytes_cached", 0) > 0
+        assert g.get("hbm_oom_recoveries", 0) >= 1
+
+        # HTTP /status JSON + /metrics exposition
+        from tidb_tpu.server.http_status import StatusServer
+        srv = StatusServer(tk.domain, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status = json.load(urllib.request.urlopen(f"{base}/status"))
+            res = status["device_residency"]
+            assert res["hbm_bytes_cached"] > 0
+            assert res["hbm_oom_recoveries"] >= 1
+            assert res["epoch"] == residency.device_epoch()
+            metrics = urllib.request.urlopen(f"{base}/metrics").read()
+            assert b"hbm_bytes_cached" in metrics
+            assert b"hbm_evictions" in metrics
+            assert b"hbm_oom_recoveries" in metrics
+        finally:
+            srv.shutdown()
+
+
+# -- lint: every ._device access lives in the residency module ---------------
+
+class TestDeviceCacheLint:
+    def test_device_slot_access_confined_to_residency(self):
+        """Any direct read/write of ``._device`` outside ops/residency.py
+        is unaccounted HBM caching — the ledger (budget, epoch, OOM
+        eviction) only works if every cached upload goes through the
+        manager.  Sole exception: ``self._device = None`` slot
+        initialization in utils/chunk.py constructors (a fresh Column has
+        no cache to account)."""
+        root = os.path.join(os.path.dirname(__file__), "..", "tidb_tpu")
+        offenders = []
+        for dirpath, _dirs, files in os.walk(os.path.abspath(root)):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, os.path.abspath(root))
+                if rel == os.path.join("ops", "residency.py"):
+                    continue
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+                allowed = set()
+                if rel == os.path.join("utils", "chunk.py"):
+                    for node in ast.walk(tree):
+                        if (isinstance(node, ast.Assign)
+                                and isinstance(node.value, ast.Constant)
+                                and node.value.value is None):
+                            for tgt in node.targets:
+                                if (isinstance(tgt, ast.Attribute)
+                                        and tgt.attr == "_device"):
+                                    allowed.add(id(tgt))
+                for node in ast.walk(tree):
+                    if (isinstance(node, ast.Attribute)
+                            and node.attr == "_device"
+                            and id(node) not in allowed):
+                        offenders.append(f"{rel}:{node.lineno}")
+        assert not offenders, (
+            "._device accessed outside ops/residency.py (unaccounted HBM "
+            f"caching): {offenders}")

@@ -56,11 +56,20 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
     the wait stays KILL-interruptible even while the backend blocks
     inside a GIL-holding C call.
 
+    A classified device OUT-OF-MEMORY walks the recovery ladder before
+    degrading: evict every residency-tracked HBM upload
+    (ops/residency.recover_oom) → retry the fragment ONCE against the
+    emptied device → only then record the failure and degrade to host.
+    Transient HBM pressure (another session's working set, a one-off
+    giant intermediate) costs one re-upload instead of a cooldown.
+
     `shape` scopes the breaker per fragment class (agg / join / window):
     one failing shape cools down without degrading healthy paths."""
     from ..errors import DeviceHangError
-    from ..utils.backoff import (classify, CLASS_DEVICE, CLASS_EXCHANGE,
-                                 CLASS_FAULT, CLASS_TRANSPORT)
+    from ..ops import residency
+    from ..utils.backoff import (classify, is_device_oom, CLASS_DEVICE,
+                                 CLASS_EXCHANGE, CLASS_FAULT,
+                                 CLASS_TRANSPORT)
     from . import supervisor
     from .circuit import get_breaker
     br = get_breaker(ctx, shape=shape)
@@ -68,41 +77,52 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
         raise DeviceUnsupported(
             f"device circuit open for {shape} fragments (cooling down; "
             "fragment degraded to host engine)")
+    residency.attach(ctx)  # budget sysvar + observe gauge sink
     deadline_s, fence_on_expiry = supervisor.deadline_for(ctx)
-    try:
-        out = supervisor.call_supervised(
-            fn, args, kw, deadline_s=deadline_s, ctx=ctx, shape=shape,
-            fence_on_expiry=fence_on_expiry)
-    except DeviceHangError as e:
-        # the hang IS a health verdict: count it toward opening the
-        # breaker, then surface the classified error — the query fails
-        # (its device call is still in flight; a silent host fallback
-        # would hide that the deadline fired) but the NEXT queries
-        # degrade once the breaker trips
-        br.record_failure(e)
-        raise
-    except (DeviceUnsupported, TiDBError):
-        # no health verdict: if this fragment held the HALF_OPEN probe
-        # slot, free it — otherwise the breaker wedges with no prober
-        br.release_probe()
-        raise
-    except (KeyboardInterrupt, SystemExit):
-        # Ctrl-C mid-probe must not wedge the breaker in HALF_OPEN
-        br.release_probe()
-        raise
-    except Exception as e:
-        cls = classify(e)
-        if cls not in (CLASS_DEVICE, CLASS_TRANSPORT, CLASS_FAULT,
-                       CLASS_EXCHANGE):
-            # an UNCLASSIFIED error is a programming bug, not a device
-            # health signal: surface it instead of silently degrading
+    oom_retried = False
+    while True:
+        try:
+            out = supervisor.call_supervised(
+                fn, args, kw, deadline_s=deadline_s, ctx=ctx, shape=shape,
+                fence_on_expiry=fence_on_expiry)
+        except DeviceHangError as e:
+            # the hang IS a health verdict: count it toward opening the
+            # breaker, then surface the classified error — the query fails
+            # (its device call is still in flight; a silent host fallback
+            # would hide that the deadline fired) but the NEXT queries
+            # degrade once the breaker trips
+            br.record_failure(e)
+            raise
+        except (DeviceUnsupported, TiDBError):
+            # no health verdict: if this fragment held the HALF_OPEN probe
+            # slot, free it — otherwise the breaker wedges with no prober
             br.release_probe()
             raise
-        br.record_failure(e)
-        raise DeviceUnsupported(
-            f"device failure ({cls}): {e}") from e
-    br.record_success()
-    return out
+        except (KeyboardInterrupt, SystemExit):
+            # Ctrl-C mid-probe must not wedge the breaker in HALF_OPEN
+            br.release_probe()
+            raise
+        except Exception as e:
+            cls = classify(e)
+            if cls not in (CLASS_DEVICE, CLASS_TRANSPORT, CLASS_FAULT,
+                           CLASS_EXCHANGE):
+                # an UNCLASSIFIED error is a programming bug, not a device
+                # health signal: surface it instead of silently degrading
+                br.release_probe()
+                raise
+            if not oom_retried and is_device_oom(e):
+                # OOM ladder step 1+2: evict all cached HBM, ONE retry.
+                # No breaker charge yet — an OOM the eviction absorbs is
+                # pressure, not device ill-health; a SECOND failure of any
+                # class takes the normal degrade path below.
+                oom_retried = True
+                residency.recover_oom(e)
+                continue
+            br.record_failure(e)
+            raise DeviceUnsupported(
+                f"device failure ({cls}): {e}") from e
+        br.record_success()
+        return out
 
 
 def want_device(ctx, n_rows: int) -> bool:

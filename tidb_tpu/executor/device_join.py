@@ -57,7 +57,7 @@ from .join_index import build_join_index
 
 class _Leaf:
     __slots__ = ("leaf_id", "chunk", "conds", "offset", "ncols", "dcols",
-                 "dcols_bucket", "leaf_ids", "bucket")
+                 "dcols_bucket", "dcols_epoch", "leaf_ids", "bucket")
 
     def __init__(self, leaf_id, chunk, conds, offset):
         self.leaf_id = leaf_id
@@ -67,6 +67,7 @@ class _Leaf:
         self.ncols = chunk.num_cols
         self.dcols = None  # {local_idx: DeviceCol}
         self.dcols_bucket = None  # bucket the cached dcols were built at
+        self.dcols_epoch = None  # device epoch the dcols were built under
         self.leaf_ids = frozenset((leaf_id,))
         self.bucket = None  # padded upload rows (ops/device.py bucket_rows)
 
@@ -200,11 +201,20 @@ def _leaf_env(leaf, bucket=None):
     The cache is keyed by the bucket it was built at: a declined earlier
     attempt (mpp, paged) must not leave exact-shape dcols that the
     bucketed resident path would silently trace against (to_device_col
-    reuses/slices the underlying column upload, so a rebuild is cheap)."""
-    if leaf.dcols is None or leaf.dcols_bucket != bucket:
+    reuses/slices the underlying column upload, so a rebuild is cheap).
+    It is also stamped with the DEVICE EPOCH (ops/residency.py): a
+    backend fence or OOM evict-all mid-query invalidates the dict, so no
+    pre-fence DeviceCol array can reach a post-fence dispatch.  The byte
+    accounting rides on the underlying Column entries — the dict holds
+    views/slices of the residency-tracked uploads, no extra HBM."""
+    from ..ops import residency
+    epoch = residency.device_epoch()
+    if (leaf.dcols is None or leaf.dcols_bucket != bucket
+            or leaf.dcols_epoch != epoch):
         leaf.dcols = {i: dev.to_device_col(c, bucket=bucket)
                       for i, c in enumerate(leaf.chunk.columns)}
         leaf.dcols_bucket = bucket
+        leaf.dcols_epoch = epoch
     return leaf.dcols
 
 

@@ -84,6 +84,12 @@ class QueryExecutor:
                 # a prior fragment's hung device call is still blocked on
                 # its worker thread while this plan runs
                 self.annotate(abandoned_device_calls=n_abandoned)
+            # HBM residency (ops/residency.py): bytes this process holds
+            # cached on-device after the dispatch, plus the eviction /
+            # OOM-recovery counters when they have ever fired — "did this
+            # query run under memory pressure" answered from the plan
+            from ..ops import residency
+            self.annotate(**residency.report_gauges())
         return out
 
 

@@ -248,9 +248,21 @@ def _register_sink(ctx):
 
 def _quarantine_locked():
     """Mark the backend suspect (caller holds _LOCK) — the ONE mutation
-    both fence() and the hang-abandon path share."""
+    both fence() and the hang-abandon path share.  Bumping the DEVICE
+    EPOCH here (ops/residency.py) invalidates every cached HBM upload
+    (`Column._device`, join-leaf dcols) at the same instant the backend
+    becomes suspect: a restarted PJRT client can never serve a stale
+    pre-fence buffer (ROADMAP "device-epoch on Column caches" — DONE).
+    Lock order is supervisor._LOCK → residency._LOCK; residency never
+    calls back into the supervisor."""
     _QUARANTINED[0] = True
     _QUAR_GEN[0] += 1
+    try:
+        from ..ops import residency
+        residency.bump_epoch("backend quarantined")
+    except Exception:
+        log.warning("device-epoch bump failed during quarantine",
+                    exc_info=True)
 
 
 def fence(reason: str = ""):

@@ -7,4 +7,9 @@
                host signatures so the executor can switch engines per-operator
                (the reference's root/cop/mpp task model becomes host/tpu,
                SURVEY.md §7 step 5).
+``residency.py`` — the HBM residency manager: every cached device upload is
+               byte-accounted against ``tidb_device_mem_budget``,
+               LRU-evictable under pressure, stamped with the device epoch
+               (bumped on backend fences) and checked on read; device OOMs
+               walk evict-all → retry → host degradation.
 """

@@ -137,10 +137,14 @@ class DeviceCol:
 def to_device_col(col, bucket: int | None = None) -> DeviceCol:
     """utils.chunk.Column → DeviceCol. Strings are dict-encoded host-side.
 
-    The device arrays are cached on the Column: a table's working set is
-    uploaded to HBM once per columnar-cache version and reused across
-    queries (the transfer — not the kernel — dominates when the device
-    sits across a fabric/tunnel).
+    The device arrays are cached on the Column THROUGH the residency
+    manager (ops/residency.py): a table's working set is uploaded to HBM
+    once per columnar-cache version and reused across queries (the
+    transfer — not the kernel — dominates when the device sits across a
+    fabric/tunnel), with every cached upload byte-accounted against
+    `tidb_device_mem_budget`, LRU-evictable under pressure, and stamped
+    with the device epoch so a fenced/restarted backend never serves a
+    stale buffer.
 
     `bucket` (> len) pads the uploaded arrays to that static row count:
     padding rows carry null=True and zeroed data, and the consuming
@@ -149,14 +153,15 @@ def to_device_col(col, bucket: int | None = None) -> DeviceCol:
     requests as a device-side slice (no host re-transfer — an
     exact-shape consumer like the mpp path must not thrash a bucketed
     HBM-resident cache); only a grow evicts and re-uploads."""
+    from . import residency
     want = bucket if bucket is not None and bucket > len(col) else len(col)
-    # read ONCE and publish in a single store: a concurrent reader must
-    # never observe a half-built cache (the pre-bucketing cache was
-    # write-once; growing it must keep that property)
-    cached = col._device
-    if cached is not None and int(cached[0].shape[0]) < want:
-        cached = None  # grow: rebuild locally, then swap
+    cached = residency.lookup(col, want)
     if cached is None:
+        # chaos hook: a synthetic RESOURCE_EXHAUSTED at the upload
+        # boundary (classified device OOM → run_device's evict-all →
+        # retry → host-degradation ladder)
+        from ..utils import failpoint
+        failpoint.inject("device-upload-oom")
         if col.is_object():
             from ..sqltypes import TYPE_NEWDECIMAL
             if col.ftype.tp == TYPE_NEWDECIMAL:
@@ -169,16 +174,19 @@ def to_device_col(col, bucket: int | None = None) -> DeviceCol:
                 # sort-key order, so code equality/ordering IS collation
                 # semantics (utils/chunk.py dict_encode_ci)
                 ci_codes, _kd, _reps = col.dict_encode_ci(col.ftype.collate)
-                cached = (jnp.asarray(pad_host(ci_codes, want)),
-                          jnp.asarray(pad_host(col.nulls, want, True)))
+                built = (jnp.asarray(pad_host(ci_codes, want)),
+                         jnp.asarray(pad_host(col.nulls, want, True)))
             else:
                 codes, _uniq = col.dict_encode()
-                cached = (jnp.asarray(pad_host(codes, want)),
-                          jnp.asarray(pad_host(col.nulls, want, True)))
+                built = (jnp.asarray(pad_host(codes, want)),
+                         jnp.asarray(pad_host(col.nulls, want, True)))
         else:
-            cached = (jnp.asarray(pad_host(col.data, want)),
-                      jnp.asarray(pad_host(col.nulls, want, True)))
-        col._device = cached  # atomic publish (racing builders: last wins)
+            built = (jnp.asarray(pad_host(col.data, want)),
+                     jnp.asarray(pad_host(col.nulls, want, True)))
+        # compare-and-keep publish under the residency lock: a racing
+        # builder's loser arrays are accounted as immediately evicted,
+        # never leaked outside the ledger
+        cached = residency.publish(col, *built)
     data, nulls = cached
     if int(data.shape[0]) > want:
         # cached at a larger bucket: on-device slice (HBM-local, cheap)

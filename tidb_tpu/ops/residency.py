@@ -1,0 +1,429 @@
+"""HBM residency manager: device memory as a tracked, evictable,
+epoch-scoped resource.
+
+Why this exists (ROADMAP "Open items", ISSUE 5): the host side has a full
+memory-quota tree (`utils/memory.py MemTracker` with spill actions and
+`tidb_mem_oom_action`), but device memory had NOTHING — `Column._device`
+uploads accumulated in HBM unaccounted and were never epoch-invalidated
+after a backend fence, and an HBM ``RESOURCE_EXHAUSTED`` was merely
+classified and charged to the circuit breaker with no eviction or retry.
+The memory-adaptive-operator lesson of "Design Trade-offs for a Robust
+Dynamic Hybrid Hash Join" (PAPERS.md) applies verbatim: an operator that
+degrades gracefully under memory pressure beats one that dies.
+
+Three jobs, one lock:
+
+1. **Accounting + budget** — every cached device upload
+   (`ops/device.to_device_col`) registers its byte size here.  The budget
+   is the ``tidb_device_mem_budget`` sysvar (bytes; 0 = auto: the
+   jax-reported device memory limit off-CPU, unlimited on the in-process
+   CPU backend).  Crossing the budget evicts cold entries LRU-first —
+   clearing the owning ``Column._device`` slot so the arrays (and their
+   HBM buffers) become collectible.  The newest entry is never evicted
+   for its own arrival: a single working column larger than the budget
+   must still be usable (one-pass semantics beat a livelock).
+
+2. **Device epoch** — a monotonically increasing counter bumped by every
+   backend quarantine (`executor/supervisor.fence` / the hang-abandon
+   path).  Every cached value is stamped with the epoch it was uploaded
+   under and checked on read, so a restarted PJRT client can never serve
+   a stale pre-fence buffer (the ROADMAP "device-epoch on Column caches"
+   open item).  `executor/device_join._leaf_env` stamps its ``leaf.dcols``
+   caches with the same epoch; their byte accounting rides on the
+   underlying Column entries (the leaf dict holds views/slices of them).
+
+3. **OOM recovery** — `recover_oom()` is step one of the ladder
+   ``evict-all → single retry → host degradation`` that
+   `executor/device_exec.run_device` walks when a classified device OOM
+   (`utils/backoff.is_device_oom`) surfaces: drop every cached device
+   value (freeing the HBM they pin), retry the fragment once against the
+   emptied device, and only then let the existing per-shape circuit
+   breaker degrade to the host engine.
+
+All ``._device`` reads/writes live in THIS module (AST-linted in
+tests/test_residency.py) so HBM caching can never silently escape the
+ledger.  Gauges — ``hbm_bytes_cached``, ``hbm_evictions``,
+``hbm_oom_recoveries`` — surface in EXPLAIN ANALYZE, observe gauges, the
+HTTP ``/status`` + ``/metrics`` endpoints, and bench.py lines.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import weakref
+
+log = logging.getLogger("tidb_tpu.residency")
+
+#: one reentrant lock guards the ledger, the LRU order and the epoch —
+#: reentrant because a weakref GC callback can fire while this module
+#: already holds the lock on the same thread
+_LOCK = threading.RLock()
+
+#: the device epoch: bumped on every backend quarantine/fence.  Cached
+#: device values are stamped with it and checked on read.
+_EPOCH = [0]
+
+#: resident bytes ledger (sum of every live entry's nbytes)
+_BYTES = [0]
+
+#: configured budget in bytes (from tidb_device_mem_budget); 0 = auto
+_BUDGET = [0]
+#: memoized auto-derived budget (None = not yet probed)
+_AUTO_BUDGET = [None]
+
+_SEQ = itertools.count(1)
+
+#: LRU of live cached uploads: token -> _Entry (insertion order = age;
+#: move_to_end on every cache hit)
+_ENTRIES: "collections.OrderedDict[int, _Entry]" = collections.OrderedDict()
+
+STATS = {
+    "uploads": 0,          # publishes that installed a new cached value
+    "hits": 0,             # lookups served from cache
+    "hbm_evictions": 0,    # entries evicted (budget, grow, epoch, OOM)
+    "hbm_evicted_bytes": 0,
+    "hbm_oom_recoveries": 0,  # evict-all passes taken for a device OOM
+    "epoch_bumps": 0,
+    "publish_races": 0,    # racing publish lost to an existing entry
+    "gc_releases": 0,      # owners collected with their entry still live
+}
+
+#: Observability sinks (session/observe.py) mirroring the gauges —
+#: registered from the contexts device dispatches run under
+_SINKS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class _Resident:
+    """The value stored on ``Column._device``: the padded device arrays
+    plus the stamps the manager checks on every read."""
+
+    __slots__ = ("data", "nulls", "rows", "epoch", "nbytes", "token")
+
+    def __init__(self, data, nulls, rows, epoch, nbytes, token):
+        self.data = data
+        self.nulls = nulls
+        self.rows = rows
+        self.epoch = epoch
+        self.nbytes = nbytes
+        self.token = token
+
+
+class _Entry:
+    """Ledger entry for one cached upload: a weakref back to the owning
+    Column (to clear its slot on eviction, and to release the bytes when
+    the owner is garbage-collected) plus the byte charge."""
+
+    __slots__ = ("ref", "nbytes", "token")
+
+    def __init__(self, ref, nbytes, token):
+        self.ref = ref
+        self.nbytes = nbytes
+        self.token = token
+
+
+def _nbytes(arr) -> int:
+    try:
+        return int(arr.nbytes)
+    except Exception:
+        try:
+            return int(arr.size) * int(arr.dtype.itemsize)
+        except Exception:
+            return 0
+
+
+# -- epoch -------------------------------------------------------------------
+
+def device_epoch() -> int:
+    """The current device epoch.  Caches stamped with an older epoch are
+    stale (their buffers may belong to a torn-down PJRT client)."""
+    return _EPOCH[0]
+
+
+def bump_epoch(reason: str = "") -> int:
+    """Invalidate every cached device value: bump the epoch and clear the
+    ledger.  Called by the supervisor on every backend quarantine
+    (fence / hang-abandon) BEFORE the reinit, so nothing uploaded against
+    the suspect client can survive into the re-dialed one."""
+    with _LOCK:
+        _EPOCH[0] += 1
+        epoch = _EPOCH[0]
+        STATS["epoch_bumps"] += 1
+        n = _evict_all_locked()
+    if n:
+        log.info("device epoch -> %d (%s): %d cached uploads invalidated",
+                 epoch, reason or "fence", n)
+    _publish_gauges()
+    return epoch
+
+
+# -- budget ------------------------------------------------------------------
+
+def attach(ctx):
+    """Per-dispatch hookup (called by run_device): resolve the budget
+    from ``tidb_device_mem_budget`` and register the Domain's observe
+    registry as a gauge sink.
+
+    The budget is read from the Domain's GLOBAL variables (`SET GLOBAL
+    tidb_device_mem_budget`), same discipline as the circuit-breaker
+    knobs: the ledger is process-wide, so a session-scoped SET must not
+    clobber the budget another session configured (last-dispatcher-wins
+    on a shared resource).  Only a bare context with no Domain falls
+    back to its own session view."""
+    if ctx is None:
+        return
+    dom = getattr(ctx, "domain", None)
+    try:
+        if dom is not None:
+            _BUDGET[0] = max(
+                int(dom.global_vars.get("tidb_device_mem_budget", 0)), 0)
+        else:
+            _BUDGET[0] = max(
+                int(ctx.get_sysvar("tidb_device_mem_budget")), 0)
+    except Exception:
+        pass
+    obs = getattr(dom, "observe", None)
+    if obs is not None and hasattr(obs, "set_gauge"):
+        with _LOCK:
+            _SINKS.add(obs)
+
+
+def set_budget(n: int):
+    """Set the budget in bytes directly (tests / embedders); 0 = auto."""
+    _BUDGET[0] = max(int(n), 0)
+
+
+def _auto_budget() -> int:
+    """jax-reported device memory limit, or 0 (unlimited) when the
+    backend is the in-process CPU client (host RAM is governed by the
+    MemTracker quota tree, not this manager) or unreported."""
+    if _AUTO_BUDGET[0] is None:
+        budget = 0
+        try:
+            import jax
+            if jax.default_backend() != "cpu":
+                stats = jax.devices()[0].memory_stats() or {}
+                budget = int(stats.get("bytes_limit", 0))
+        except Exception:
+            budget = 0
+        _AUTO_BUDGET[0] = budget
+    return _AUTO_BUDGET[0]
+
+
+def effective_budget() -> int:
+    """Resolved budget in bytes (0 = unlimited)."""
+    return _BUDGET[0] if _BUDGET[0] > 0 else _auto_budget()
+
+
+# -- the cache protocol (ops/device.to_device_col) ---------------------------
+
+def lookup(col, want_rows: int):
+    """Cached ``(data, nulls)`` for `col` if present, epoch-current and at
+    least `want_rows` long; else None (any stale/short entry is evicted
+    so the caller rebuilds).  A hit touches the LRU."""
+    with _LOCK:
+        res = col._device
+        if res is None:
+            return None
+        if res.epoch != _EPOCH[0]:
+            # stale pre-fence buffer: evict eagerly — it must never be
+            # served again NOR keep its bytes on the ledger
+            _evict_token_locked(res.token)
+            return None
+        if res.rows < want_rows:
+            # grow: miss WITHOUT evicting — the old entry keeps serving
+            # shorter-bucket readers until publish() swaps it (the cache
+            # stays write-once for concurrent consumers, and a rebuild
+            # that fails mid-flight leaves the column still cached)
+            return None
+        ent = _ENTRIES.get(res.token)
+        if ent is not None:
+            _ENTRIES.move_to_end(res.token)
+        STATS["hits"] += 1
+        return res.data, res.nulls
+
+
+def publish(col, data, nulls):
+    """Install a freshly built upload as `col`'s cached device value and
+    charge its bytes; returns the arrays to use.
+
+    Compare-and-keep under the ledger lock: when a RACING builder already
+    published an epoch-current entry at least as long, the existing entry
+    WINS and this caller's arrays are discarded — the loser's bytes are
+    counted as immediately evicted, never silently leaked outside the
+    ledger (the pre-residency "last wins" publish leaked the loser's HBM
+    buffer untracked until GC)."""
+    nbytes = _nbytes(data) + _nbytes(nulls)
+    rows = int(data.shape[0])
+    with _LOCK:
+        cur = col._device
+        if (cur is not None and cur.epoch == _EPOCH[0]
+                and cur.rows >= rows and cur.token in _ENTRIES):
+            # lost the publish race: keep the incumbent, account the loser
+            STATS["publish_races"] += 1
+            STATS["hbm_evictions"] += 1
+            STATS["hbm_evicted_bytes"] += nbytes
+            _ENTRIES.move_to_end(cur.token)
+            out = cur.data, cur.nulls
+        else:
+            if cur is not None:
+                _evict_token_locked(cur.token)
+            token = next(_SEQ)
+            res = _Resident(data, nulls, rows, _EPOCH[0], nbytes, token)
+            col._device = res
+            try:
+                ref = weakref.ref(col, _make_gc_cb(token))
+            except TypeError:
+                ref = None  # owner not weakref-able: entry lives forever
+            _ENTRIES[token] = _Entry(ref, nbytes, token)
+            _BYTES[0] += nbytes
+            STATS["uploads"] += 1
+            _enforce_budget_locked(keep_token=token)
+            out = data, nulls
+    _publish_gauges()
+    return out
+
+
+def _make_gc_cb(token):
+    def _cb(_ref, _token=token):
+        with _LOCK:
+            ent = _ENTRIES.pop(_token, None)
+            if ent is not None:
+                _BYTES[0] -= ent.nbytes
+                STATS["gc_releases"] += 1
+    return _cb
+
+
+# -- eviction ----------------------------------------------------------------
+
+def _evict_token_locked(token: int):
+    ent = _ENTRIES.pop(token, None)
+    if ent is None:
+        return
+    _BYTES[0] -= ent.nbytes
+    STATS["hbm_evictions"] += 1
+    STATS["hbm_evicted_bytes"] += ent.nbytes
+    col = ent.ref() if ent.ref is not None else None
+    if col is not None:
+        res = col._device
+        if res is not None and res.token == token:
+            col._device = None
+
+
+def _enforce_budget_locked(keep_token: int):
+    """Evict LRU-first until under budget.  `keep_token` (the entry just
+    published) is exempt: the working set of the CURRENT fragment must
+    not be evicted out from under its own dispatch."""
+    budget = effective_budget()
+    if budget <= 0:
+        return
+    while _BYTES[0] > budget:
+        victim = None
+        for token in _ENTRIES:  # oldest first
+            if token != keep_token:
+                victim = token
+                break
+        if victim is None:
+            if _BYTES[0] > budget:
+                log.warning(
+                    "device upload of %d bytes exceeds "
+                    "tidb_device_mem_budget=%d alone; kept (single "
+                    "working column beats a livelock)", _BYTES[0], budget)
+            return
+        _evict_token_locked(victim)
+
+
+def _evict_all_locked() -> int:
+    n = len(_ENTRIES)
+    for token in list(_ENTRIES):
+        _evict_token_locked(token)
+    return n
+
+
+def evict_all(reason: str = "") -> int:
+    """Drop every cached device value (ledger goes to zero).  Returns the
+    number of entries evicted."""
+    with _LOCK:
+        n = _evict_all_locked()
+    if n:
+        log.info("evicted all %d cached device uploads (%s)",
+                 n, reason or "explicit")
+    _publish_gauges()
+    return n
+
+
+def recover_oom(err=None) -> int:
+    """Step one of the OOM ladder (evict-all → retry → degrade): free
+    every byte this manager pins so the retry dispatches against an
+    emptied device.  The epoch is bumped TOO: a mid-flight join-leaf
+    ``dcols`` dict holds references to the evicted arrays, and without an
+    epoch change the retry's `_leaf_env` would hand the same dict back —
+    re-pinning the very buffers this eviction freed.  The epoch mismatch
+    forces every consumer to re-derive its device state from Columns."""
+    with _LOCK:
+        STATS["hbm_oom_recoveries"] += 1
+        _EPOCH[0] += 1
+        STATS["epoch_bumps"] += 1
+        n = _evict_all_locked()
+    log.warning("device OOM (%s): evicted %d cached uploads, retrying once "
+                "before host degradation", err, n)
+    _publish_gauges()
+    return n
+
+
+# -- introspection -----------------------------------------------------------
+
+def resident_bytes() -> int:
+    """The ``hbm_bytes_cached`` gauge."""
+    with _LOCK:
+        return _BYTES[0]
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        return {
+            "epoch": _EPOCH[0],
+            "hbm_bytes_cached": _BYTES[0],
+            "entries": len(_ENTRIES),
+            "budget_bytes": effective_budget(),
+            **STATS,
+        }
+
+
+def report_gauges() -> dict:
+    """The surfacing policy shared by EXPLAIN ANALYZE annotations and
+    bench.py lines: ``hbm_bytes_cached`` always; the eviction /
+    OOM-recovery counters only once they have ever fired (pressure is
+    the exception, not annotation noise on every healthy plan)."""
+    s = snapshot()
+    out = {"hbm_bytes_cached": s["hbm_bytes_cached"]}
+    if s["hbm_evictions"]:
+        out["hbm_evictions"] = s["hbm_evictions"]
+    if s["hbm_oom_recoveries"]:
+        out["hbm_oom_recoveries"] = s["hbm_oom_recoveries"]
+    return out
+
+
+def verify_ledger() -> dict:
+    """Recompute the ledger from live entries (chaos-harness invariant:
+    no budget-counter drift).  Returns {"ok", "ledger", "recomputed"}."""
+    with _LOCK:
+        recomputed = sum(e.nbytes for e in _ENTRIES.values())
+        return {"ok": recomputed == _BYTES[0] and _BYTES[0] >= 0,
+                "ledger": _BYTES[0], "recomputed": recomputed}
+
+
+def _publish_gauges():
+    with _LOCK:
+        sinks = list(_SINKS)
+        vals = {"hbm_bytes_cached": _BYTES[0],
+                "hbm_evictions": STATS["hbm_evictions"],
+                "hbm_oom_recoveries": STATS["hbm_oom_recoveries"]}
+    for obs in sinks:
+        try:
+            for k, v in vals.items():
+                obs.set_gauge(k, v)
+        except Exception:
+            pass

@@ -88,6 +88,7 @@ class StatusServer:
 
     def _status(self):
         from ..executor import supervisor
+        from ..ops import residency
         return {
             "version": "8.0.11-tpu-htap",
             "connections": len(self.domain.sessions),
@@ -97,6 +98,10 @@ class StatusServer:
             # backend is diagnosable from the status port alone
             "device_abandoned_calls": supervisor.abandoned_calls(),
             "device_supervisor": supervisor.snapshot(),
+            # HBM residency (ops/residency.py): cached-bytes ledger,
+            # budget, epoch and the eviction / OOM-recovery counters —
+            # device memory pressure diagnosable from the status port
+            "device_residency": residency.snapshot(),
         }
 
     def _metrics(self):
@@ -108,10 +113,15 @@ class StatusServer:
             lines.append(f"# TYPE {name} counter")
             lines.append(f"{name} {val}")
         gauges = dict(self.domain.observe.gauge_snapshot())
-        # the supervisor gauge is process-wide; surface it even when no
-        # supervised call has registered this domain's sink yet
+        # the supervisor/residency gauges are process-wide; surface them
+        # even when no device dispatch has registered this domain's sink
+        from ..ops import residency
+        rs = residency.snapshot()
         gauges.setdefault("device_abandoned_calls",
                           supervisor.abandoned_calls())
+        gauges.setdefault("hbm_bytes_cached", rs["hbm_bytes_cached"])
+        gauges.setdefault("hbm_evictions", rs["hbm_evictions"])
+        gauges.setdefault("hbm_oom_recoveries", rs["hbm_oom_recoveries"])
         for name, val in sorted(gauges.items()):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {val}")

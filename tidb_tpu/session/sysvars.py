@@ -236,6 +236,15 @@ for _v in [
     # the deadline covers compilation, and a too-small value re-fences
     # (re-colds) the very compile it then times out again
     SysVar("tidb_device_call_timeout", SCOPE_BOTH, "0", "float", 0),
+    # HBM residency budget in BYTES (ops/residency.py): cached device
+    # uploads (Column._device, join-leaf dcols) are byte-accounted against
+    # it and evicted LRU-first under pressure. 0 = auto: the jax-reported
+    # device memory limit off-CPU, unlimited on the in-process CPU
+    # backend (host RAM is governed by tidb_mem_quota_query/MemTracker).
+    # Read from GLOBAL scope (SET GLOBAL), same discipline as the
+    # breaker knobs: the ledger is process-wide, so a session-scoped SET
+    # must not clobber the budget another session configured
+    SysVar("tidb_device_mem_budget", SCOPE_BOTH, "0", "int", 0),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
            str(100 * 1024 * 1024), "int", 0),
     SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH,

@@ -53,6 +53,34 @@ CLASS_FAULT = "fault"
 CLASS_OTHER = "other"
 
 
+#: message substrings (lowercased match) that mark a device runtime error
+#: as OUT-OF-MEMORY — the jaxlib/XLA phrasings seen across backends:
+#: "RESOURCE_EXHAUSTED: Out of memory allocating 12345 bytes", PJRT's
+#: "Resource exhausted: Failed to allocate request for ...", the TPU
+#: runtime's "Attempting to allocate ... exceeds ... memory available",
+#: plus the allocator's generic failure lines. One table so the
+#: classifier, the OOM-recovery ladder (executor/device_exec.run_device)
+#: and the taxonomy unit test all agree.
+DEVICE_OOM_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "out_of_memory",
+    "failed to allocate",
+    "allocation failure",
+    "exceeds the amount of memory available",
+)
+
+#: exception TYPE NAMES (matched anywhere in the MRO — jaxlib moves and
+#: subclasses its runtime error across versions) that mark a device
+#: runtime failure
+DEVICE_ERROR_TYPE_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+
+
+def _mro_names(err) -> set:
+    return {c.__name__ for c in type(err).__mro__}
+
+
 def classify(err) -> str:
     """Map an exception to its resilience class (one label the breaker,
     the backoffer and the slow log all agree on)."""
@@ -74,14 +102,27 @@ def classify(err) -> str:
     # be retried or fed to the breaker as device-health signals
     if isinstance(err, (ConnectionError, BrokenPipeError, TimeoutError)):
         return CLASS_TRANSPORT
-    name = type(err).__name__
     msg = str(err)
-    if ("XlaRuntimeError" in name or "JaxRuntimeError" in name
-            or "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower()):
+    low = msg.lower()
+    # the MRO walk (not just the leaf type name) catches jaxlib subclasses
+    # of XlaRuntimeError whose leaf name says nothing about the runtime
+    if (any(n in _mro_names(err) for n in DEVICE_ERROR_TYPE_NAMES)
+            or any(m in low for m in DEVICE_OOM_MARKERS)):
         return CLASS_DEVICE
-    if "Connection refused" in msg or "tunnel" in msg.lower():
+    if "Connection refused" in msg or "tunnel" in low:
         return CLASS_TRANSPORT
     return CLASS_OTHER
+
+
+def is_device_oom(err) -> bool:
+    """Is this a device OUT-OF-MEMORY specifically (the errors worth an
+    evict-all + retry before host degradation), as opposed to any other
+    classified device failure (compile bug, dead tunnel) where retrying
+    against an emptied HBM would change nothing?"""
+    if classify(err) != CLASS_DEVICE:
+        return False
+    low = str(err).lower()
+    return any(m in low for m in DEVICE_OOM_MARKERS)
 
 
 class ExchangeError(TiDBError):

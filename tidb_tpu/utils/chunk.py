@@ -83,8 +83,11 @@ def dict_content_sig(uniques) -> str:
 class Column:
     """One column: `data` (numpy array) + `nulls` (bool mask, True = NULL)."""
 
+    # __weakref__: the HBM residency manager (ops/residency.py) holds a
+    # weak back-reference per cached device upload so a collected Column
+    # releases its bytes from the ledger
     __slots__ = ("ftype", "data", "nulls", "_dict", "_dict_ci", "_device",
-                 "_join_index", "_minmax", "_dict_sig")
+                 "_join_index", "_minmax", "_dict_sig", "__weakref__")
 
     def __init__(self, ftype: FieldType, data: np.ndarray, nulls: np.ndarray | None = None):
         self.ftype = ftype
@@ -94,7 +97,9 @@ class Column:
         self.nulls = nulls
         self._dict = None    # cached (codes, uniques) for device encoding
         self._dict_ci = None  # cached (collation, ci encoding) for _ci cols
-        self._device = None  # cached (jnp data, jnp nulls) resident in HBM
+        self._device = None  # HBM-resident cache slot; ALL access goes
+        #                      through ops/residency.py (epoch-stamped,
+        #                      byte-accounted, evictable — AST-linted)
         self._join_index = None  # cached host join index (executor/join_index)
         self._minmax = None  # cached (min, max) over non-null int rows
         self._dict_sig = None  # cached content hash of the dictionary

@@ -8,11 +8,16 @@ manager, which cannot leak an active failpoint past the test:
     failpoint.enable("commit-after-prewrite", "panic")     # raise
     failpoint.enable("backfill-batch", "sleep(0.05)")
     failpoint.enable("scan-rows", "return(7)")
+    failpoint.enable("device-upload-oom", "2*oom")
     with failpoint.enabled("txn-before-commit", "2*panic"):
         ...
 
 Disabled failpoints cost one dict lookup. ``inject`` returns the
-``return(...)`` payload (or None), raises FailpointError for ``panic``."""
+``return(...)`` payload (or None), raises FailpointError for ``panic``
+and InjectedOOMError for ``oom`` / ``N*oom`` (a synthetic device
+RESOURCE_EXHAUSTED that utils/backoff.classify labels ``device`` and
+is_device_oom recognizes — NOT a FailpointError, which would classify
+``fault`` and skip the OOM-recovery ladder)."""
 
 from __future__ import annotations
 
@@ -24,6 +29,21 @@ import time
 
 class FailpointError(Exception):
     """Raised by an enabled `panic` failpoint."""
+
+
+class InjectedOOMError(Exception):
+    """Raised by an enabled ``oom`` / ``N*oom`` failpoint: a synthetic
+    device out-of-memory whose MESSAGE mimics jaxlib's XlaRuntimeError
+    RESOURCE_EXHAUSTED phrasing, so the error taxonomy
+    (utils/backoff.classify → ``device``, is_device_oom → True) treats it
+    exactly like a real HBM exhaustion.  Deliberately NOT a subclass of
+    FailpointError: that would classify ``fault`` and bypass the
+    evict-all → retry → degrade ladder this failpoint exists to test."""
+
+
+def _oom_message(name: str) -> str:
+    return ("RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 "
+            f"bytes (injected by failpoint {name})")
 
 
 _lock = threading.Lock()
@@ -82,6 +102,14 @@ def inject(name: str):
         hit = _hits[name]
     if action == "panic":
         raise FailpointError(f"failpoint {name} triggered")
+    if action == "oom":
+        raise InjectedOOMError(_oom_message(name))
+    m = re.fullmatch(r"(\d+)\*oom", action)
+    if m:  # N*oom: synthetic device OOM for the first N hits, then no-op
+        #   — models transient HBM pressure the evict+retry ladder absorbs
+        if hit <= int(m.group(1)):
+            raise InjectedOOMError(_oom_message(name))
+        return None
     m = re.fullmatch(r"sleep\(([\d.]+)\)", action)
     if m:
         time.sleep(float(m.group(1)))
