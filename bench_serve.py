@@ -1,0 +1,345 @@
+"""Serving benchmark: N concurrent client threads multiplexing ONE
+Domain's device through the admission scheduler (ISSUE 6 / ROADMAP open
+item 2).
+
+Where bench.py measures one query at a time as fast as the hardware
+allows, THIS bench measures the serving story: mixed TPC-H reads
+(analytical tenant, forced device engine) + transfer-DML and point reads
+(OLTP tenant, auto engine) from N client threads, with per-tenant
+p50/p99 latency, queries/s, admission waits, batched fragments and
+degradations on the report — optionally under the threaded chaos
+catalog (seeded failpoints: backend hangs beneath a small
+`tidb_device_call_timeout`, synthetic HBM OOM, admission refusals and
+stalls), so SLO behavior under faults is pinned, not hoped for.
+
+Invariants enforced (exit code 1 on violation):
+  * every operation succeeds or fails with a CLEAN classified error —
+    never an unclassified exception;
+  * zero incorrect results: analytical reads match a fault-free host
+    golden bit-for-bit; the transfer ledger sums to its seed total in
+    every snapshot and at the end;
+  * the admission queue drains to zero (no leaked tickets) and the
+    residency ledger shows no drift.
+
+Output: one JSON line per metric (same convention as bench.py):
+  {"metric": "serve_latency_ms", "group": "olap", "p50": ..., "p99": ...}
+  {"metric": "serve_qps", "value": ..., "threads": N, ...}
+  {"metric": "serve_sched", "sched_queue_depth": 0, ...}
+
+Usage:
+  python bench_serve.py                  # 8 threads, default mix
+  python bench_serve.py --smoke          # small fixed-seed tier-1 run
+  python bench_serve.py --threads 16 --ops 40 --sf 0.01 --chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import random
+import sys
+import threading
+import time
+
+import tidb_tpu  # noqa: F401  (x64 on)
+
+from tidb_tpu.errors import TiDBError
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.failpoint import FailpointError
+
+import bench  # repo-root sibling: TPC-H datagen + the north-star queries
+
+#: transfer-ledger seed state (the write-atomicity invariant)
+N_ACCTS = 8
+SEED_BAL = 1000
+LEDGER_TOTAL = N_ACCTS * SEED_BAL
+
+#: analytical corpus: the north-star shapes that fit a serving mix
+#: (Q1 scan-agg, Q3 join-agg — bench.py's exact SQL, so the serving and
+#: single-query benches measure the same fragments)
+OLAP_QUERIES = ("q1", "q3")
+
+#: chaos catalog for --chaos runs: the threaded-chaos failure families
+#: (hang + OOM + admission) at serving-friendly rates
+CHAOS_FAULTS = {
+    "device-agg-exec": ["1*panic", "sleep(0.05)"],
+    "device-join-exec": ["1*panic", "sleep(0.05)"],
+    "device-upload-oom": ["1*oom", "2*oom", "oom"],
+    "device-admission": ["admission-queue-full", "1*admission-wait(0.05)",
+                         "2*admission-wait(0.02)"],
+    "txn-before-commit": ["1*panic"],
+    "txn-before-prewrite": ["1*panic"],
+}
+
+_EMIT_LOCK = threading.Lock()
+
+
+def _emit(obj) -> None:
+    with _EMIT_LOCK:
+        print(json.dumps(obj), flush=True)
+
+
+def _is_clean(err: Exception) -> bool:
+    return isinstance(err, (TiDBError, FailpointError))
+
+
+def _pctl(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return round(sorted_vals[i], 2)
+
+
+def _setup(sf: float) -> tuple:
+    """One Domain: TPC-H tables at `sf` (tpch db) + the transfer ledger
+    (test db).  Returns (tk, goldens) — goldens are the fault-free HOST
+    engine results for the analytical corpus."""
+    tk = TestKit()
+    failpoint.disable_all()
+    bench.gen_all(tk, sf)
+    tk.must_exec("use test")
+    tk.must_exec("create table ledger (acct int primary key, bal int)")
+    tk.must_exec("insert into ledger values " + ",".join(
+        f"({i}, {SEED_BAL})" for i in range(1, N_ACCTS + 1)))
+    tk.must_exec("use tpch")
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    goldens = {q: tuple(map(tuple, tk.must_query(bench.QUERIES[q]).rows))
+               for q in OLAP_QUERIES}
+    tk.must_exec("set tidb_executor_engine = 'auto'")
+    return tk, goldens
+
+
+def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
+              seed: int = 0, chaos: bool = False, emit=_emit) -> dict:
+    """Drive the serving workload; returns the summary dict (also
+    emitted as JSON lines).  Raises AssertionError on any invariant
+    violation — tests call this in-process, the CLI exits 1."""
+    from tidb_tpu.executor import scheduler, supervisor
+    from tidb_tpu.ops import residency
+
+    tk, goldens = _setup(sf)
+    t_start = time.monotonic()
+
+    mu = threading.Lock()
+    lat = {}          # group -> [latency_ms]
+    counts = {"ok": 0, "clean_errors": 0, "writes_ok": 0,
+              "writes_failed": 0}
+    violations: list = []
+    start = threading.Barrier(n_threads)
+
+    def record(group, ms):
+        with mu:
+            lat.setdefault(group, []).append(ms)
+
+    def bump(key):
+        with mu:
+            counts[key] += 1
+
+    def violate(tid, what, exc=None):
+        with mu:
+            violations.append(
+                f"thread {tid}: {what}"
+                + (f" ({type(exc).__name__}: {exc})" if exc else ""))
+
+    def _olap_op(wtk, rng, tid):
+        qname = OLAP_QUERIES[rng.randrange(len(OLAP_QUERIES))]
+        t0 = time.monotonic()
+        try:
+            rows = tuple(map(tuple,
+                             wtk.must_query(bench.QUERIES[qname]).rows))
+        except Exception as e:  # noqa: BLE001 — classification IS the check
+            if _is_clean(e):
+                bump("clean_errors")
+            else:
+                violate(tid, f"unclassified analytical failure on "
+                        f"{qname}", e)
+            return
+        record("olap", (time.monotonic() - t0) * 1000.0)
+        bump("ok")
+        if rows != goldens[qname]:
+            violate(tid, f"WRONG RESULT for {qname} (device path diverged"
+                    " from host golden)")
+
+    def _oltp_op(wtk, rng, tid):
+        kind = rng.random()
+        t0 = time.monotonic()
+        try:
+            if kind < 0.45:  # point read
+                acct = rng.randrange(1, N_ACCTS + 1)
+                wtk.must_query(
+                    f"select bal from ledger where acct = {acct}")
+            elif kind < 0.65:  # ledger-sum snapshot (atomicity check)
+                total = wtk.must_query(
+                    "select sum(bal) from ledger").rows[0][0]
+                if str(total) != str(LEDGER_TOTAL):
+                    violate(tid, f"ATOMICITY VIOLATION: ledger sum "
+                            f"{total} != {LEDGER_TOTAL}")
+            else:  # transfer write (acct order: no deadlock cycles)
+                a, b = sorted(rng.sample(range(1, N_ACCTS + 1), 2))
+                amt = rng.randrange(1, 40)
+                wtk.must_exec("begin")
+                wtk.must_exec(
+                    f"update ledger set bal = bal - {amt} where acct={a}")
+                wtk.must_exec(
+                    f"update ledger set bal = bal + {amt} where acct={b}")
+                wtk.must_exec("commit")
+                bump("writes_ok")
+        except Exception as e:  # noqa: BLE001
+            if _is_clean(e):
+                bump("clean_errors")
+                if kind >= 0.65:
+                    with mu:
+                        counts["writes_failed"] += 1
+                        counts["clean_errors"] -= 1
+                try:
+                    wtk.session.rollback()
+                except Exception:
+                    pass
+            else:
+                violate(tid, "unclassified OLTP failure", e)
+            return
+        record("oltp", (time.monotonic() - t0) * 1000.0)
+        bump("ok")
+
+    def worker(tid):
+        try:
+            _worker_body(tid)
+        except Exception as e:  # noqa: BLE001 — a dead worker IS a finding
+            violate(tid, "worker thread died", e)
+
+    def _worker_body(tid):
+        rng = random.Random((seed << 8) ^ tid)
+        olap = tid % 2 == 0  # even threads analytical, odd threads OLTP
+        wtk = tk.new_session()
+        group = "olap" if olap else "oltp"
+        wtk.must_exec(f"set tidb_resource_group = '{group}'")
+        wtk.must_exec("set innodb_lock_wait_timeout = 2")
+        if olap:
+            wtk.must_exec("use tpch")
+            # analytical tenants force the device engine: they are the
+            # traffic the admission queue exists to schedule
+            wtk.must_exec("set tidb_executor_engine = 'tpu'")
+        else:
+            wtk.must_exec("use test")
+        start.wait(timeout=60)
+        for _op in range(n_ops):
+            with contextlib.ExitStack() as st:
+                if chaos:
+                    # half the ops run supervised with a deadline smaller
+                    # than the injected sleeps: the hang path fires live
+                    wtk.must_exec("set tidb_device_call_timeout = "
+                                  + ("0.02" if rng.random() < 0.5 else "0"))
+                    if rng.random() < 0.5:
+                        for name in rng.sample(sorted(CHAOS_FAULTS),
+                                               k=rng.choice([1, 1, 2])):
+                            st.enter_context(failpoint.enabled(
+                                name, rng.choice(CHAOS_FAULTS[name])))
+                if olap:
+                    _olap_op(wtk, rng, tid)
+                else:
+                    _oltp_op(wtk, rng, tid)
+
+    threads = [threading.Thread(target=worker, args=(tid,), daemon=True,
+                                name=f"serve-{tid}")
+               for tid in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300.0)
+    stuck = [t.name for t in threads if t.is_alive()]
+    failpoint.disable_all()
+    wall_s = time.monotonic() - t_start
+
+    # -- invariants ----------------------------------------------------------
+    assert not stuck, f"STUCK CLIENT THREADS: {stuck}"
+    assert not violations, "\n".join(violations)
+    tk.must_exec("use test")
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    total = tk.must_query("select sum(bal) from ledger").rows[0][0]
+    assert str(total) == str(LEDGER_TOTAL), (
+        f"final ledger sum {total} != {LEDGER_TOTAL}")
+    # abandoned supervised calls drain (chaos hangs are short sleeps),
+    # then the admission queue must show zero leaked tickets
+    deadline = time.monotonic() + 15.0
+    while ((supervisor.abandoned_calls() > 0
+            or not scheduler.verify_drained()["ok"])
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    drained = scheduler.verify_drained()
+    assert drained["ok"], f"LEAKED ADMISSION TICKETS: {drained}"
+    led = residency.verify_ledger()
+    assert led["ok"], f"HBM LEDGER DRIFT: {led}"
+
+    # -- report --------------------------------------------------------------
+    n_queries = counts["ok"]
+    sched = scheduler.snapshot()
+    summary = {
+        "threads": n_threads, "ops_per_thread": n_ops, "sf": sf,
+        "seed": seed, "chaos": chaos, "wall_s": round(wall_s, 2),
+        "qps": round(n_queries / wall_s, 2) if wall_s > 0 else 0.0,
+        **counts,
+        "violations": 0,
+    }
+    emit({"metric": "serve_clients", "value": n_threads,
+          "unit": "threads", "chaos": chaos, "sf": sf, "seed": seed})
+    for group, vals in sorted(lat.items()):
+        vals.sort()
+        emit({"metric": "serve_latency_ms", "group": group,
+              "p50": _pctl(vals, 0.50), "p99": _pctl(vals, 0.99),
+              "n": len(vals)})
+        summary[f"p50_{group}"] = _pctl(vals, 0.50)
+        summary[f"p99_{group}"] = _pctl(vals, 0.99)
+    emit({"metric": "serve_qps", "value": summary["qps"],
+          "unit": "queries/s", "threads": n_threads,
+          "wall_s": summary["wall_s"], "ok": counts["ok"],
+          "clean_errors": counts["clean_errors"],
+          "writes_ok": counts["writes_ok"],
+          "writes_failed": counts["writes_failed"]})
+    emit({"metric": "serve_sched",
+          "sched_queue_depth": sched["sched_queue_depth"],
+          "sched_admission_waits_ms": sched["sched_admission_waits_ms"],
+          "sched_batched_fragments": sched["sched_batched_fragments"],
+          "sched_degradations": sched["degradations_by_group"],
+          "admitted": sched["admitted"], "queued": sched["queued"],
+          "rejected_full": sched["rejected_full"],
+          "rejected_timeout": sched["rejected_timeout"],
+          "rejected_injected": sched["rejected_injected"],
+          "hbm_bytes_cached": residency.resident_bytes(),
+          "supervisor_hangs": supervisor.snapshot()["hangs"]})
+    summary.update({k: sched[k] for k in
+                    ("admitted", "queued", "sched_batched_fragments",
+                     "rejected_full", "rejected_timeout",
+                     "rejected_injected")})
+    summary["degradations_by_group"] = sched["degradations_by_group"]
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--ops", type=int, default=20,
+                    help="operations per client thread")
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chaos", action="store_true",
+                    help="run under the seeded chaos catalog "
+                         "(hang + OOM + admission failpoints)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small fixed-seed run for CI (8 threads, "
+                         "tiny SF, chaos on)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.threads, args.ops, args.sf, args.chaos = 8, 4, 0.002, True
+    try:
+        run_serve(n_threads=args.threads, n_ops=args.ops, sf=args.sf,
+                  seed=args.seed, chaos=args.chaos)
+    except AssertionError as e:
+        _emit({"metric": "serve_violation", "error": str(e)[:2000]})
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,7 +41,12 @@ INVARIANT-ONLY (no bit-for-bit goldens):
   * no stuck threads (bounded joins) and no abandoned device calls left
     outstanding after the grace window;
   * breaker-state sanity, and the corpus runs clean on the quiesced
-    domain (the process survives and recovers).
+    domain (the process survives and recovers);
+  * admission hygiene (executor/scheduler.py): injected queue-full
+    refusals degrade reads to the host engine EXACTLY, injected
+    admission stalls are absorbed as queue wait, and every ticket is
+    completed, degraded or cleanly rejected — the queue drains to zero
+    once the schedule ends (both modes assert `verify_drained`).
 """
 
 from __future__ import annotations
@@ -92,6 +97,12 @@ READ_FAULTS = {
     # (oom) must degrade to the host engine — either way the read stays
     # EXACT (ops/residency.py + device_exec.run_device)
     "device-upload-oom": ["oom", "1*oom", "2*oom"],
+    # serving admission (executor/scheduler.py): a refused ticket must
+    # degrade the fragment to the host engine (exact result, classified),
+    # an injected admission stall must be absorbed as queue wait — and
+    # the queue must drain to zero by seed end (asserted below)
+    "device-admission": ["admission-queue-full", "1*admission-wait(0.05)",
+                         "2*admission-wait(0.02)"],
     "mpp-exchange-send": ["1*panic", "2*panic", "panic"],
     "mpp-exchange-recv": ["1*panic", "panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -226,6 +237,13 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         led = residency.verify_ledger()
         assert led["ok"], (
             f"seed {seed}: HBM LEDGER DRIFT after OOM chaos: {led}")
+
+        # -- admission queue drained: every ticket completed, degraded or
+        #    cleanly rejected — no leaked tickets once the schedule ends
+        from tidb_tpu.executor import scheduler
+        drained = scheduler.verify_drained()
+        assert drained["ok"], (
+            f"seed {seed}: LEAKED ADMISSION TICKETS: {drained}")
     finally:
         failpoint.disable_all()
     return stats
@@ -244,6 +262,10 @@ THREADED_FAULTS = {
     # retry / host-degradation must keep the residency byte ledger
     # drift-free (checked after the joins below)
     "device-upload-oom": ["oom", "1*oom", "2*oom"],
+    # admission refusals/stalls interleaving with hangs, OOM and DML:
+    # tickets must never leak (verify_drained asserted after the joins)
+    "device-admission": ["admission-queue-full", "1*admission-wait(0.05)",
+                         "2*admission-wait(0.02)"],
     "mpp-exchange-send": ["1*panic", "panic"],
     "mpp-exchange-recv": ["1*panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -395,6 +417,20 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     assert led["ok"], (
         f"seed {seed}: HBM LEDGER DRIFT after threaded OOM chaos: {led}")
     stats["oom_recoveries"] = residency.snapshot()["hbm_oom_recoveries"]
+
+    # admission queue drained: no ticket left queued or running once the
+    # worker threads have joined — every admit() was paired with a
+    # release() or a clean classified rejection (a small grace window:
+    # an abandoned supervised call can hold its ticket until it unblocks)
+    from tidb_tpu.executor import scheduler
+    deadline = time.monotonic() + 10.0
+    while (not scheduler.verify_drained()["ok"]
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    drained = scheduler.verify_drained()
+    assert drained["ok"], (
+        f"seed {seed}: LEAKED ADMISSION TICKETS after threaded chaos: "
+        f"{drained}")
 
     # breaker-state sanity: legal state, probe slot not wedged
     for shape, br in getattr(tk.domain, "_device_breakers", {}).items():

@@ -79,3 +79,26 @@ class TestExchangeFaults:
         tk.must_exec("set tidb_executor_engine = 'tpu-mpp'")
         with failpoint.enabled("mpp-exchange-send", "1*panic"):
             assert tk.must_query(qj).rows == golden
+
+    def test_join_exhaustion_charges_join_breaker_not_agg(self, tk):
+        """A join-tree MPP fragment's exchange exhaustion must charge the
+        JOIN-shape breaker — charging "agg" (the pre-fix default) would
+        open the healthy agg breaker from join faults and orphan a join
+        probe's verdict."""
+        from tidb_tpu.executor.circuit import get_breaker
+        tk.must_exec("create table o2 (id int, ref int, amt int)")
+        tk.must_exec("insert into o2 values " + ",".join(
+            f"({i},{i % 400},{i % 50})" for i in range(300)))
+        qj = ("select t.a, sum(o2.amt) from t join o2 on t.b = o2.ref "
+              "group by t.a order by t.a")
+        tk.must_exec("set tidb_executor_engine = 'tpu-mpp'")
+        tk.must_query(qj)  # warm: the fragment must reach the exchange
+        agg0 = get_breaker(tk.session, shape="agg").snapshot()["failures"]
+        join0 = get_breaker(tk.session, shape="join").snapshot()["failures"]
+        with failpoint.enabled("mpp-exchange-send", "panic"):
+            e = tk.exec_error(qj)
+        assert isinstance(e, BackoffExhaustedError)
+        assert get_breaker(tk.session, shape="join").snapshot()[
+            "failures"] == join0 + 1
+        assert get_breaker(tk.session, shape="agg").snapshot()[
+            "failures"] == agg0

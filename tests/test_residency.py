@@ -415,3 +415,138 @@ class TestDeviceCacheLint:
         assert not offenders, (
             "._device accessed outside ops/residency.py (unaccounted HBM "
             f"caching): {offenders}")
+
+
+# -- per-tenant residency accounting (ISSUE 6 satellite) ---------------------
+
+@pytest.fixture()
+def tenant_sandbox(clean_budget):
+    """Empty ledger + default group restored around each tenant test."""
+    residency.evict_all("tenant test isolation")
+    residency.set_group(residency.DEFAULT_GROUP)
+    yield
+    residency.set_group(residency.DEFAULT_GROUP)
+    residency.evict_all("tenant test isolation")
+
+
+class TestTenantResidency:
+    def test_entries_charged_to_uploading_group(self, tenant_sandbox):
+        residency.set_group("olap")
+        a = _int_col(64)
+        dev.to_device_col(a)
+        residency.set_group("oltp")
+        b = _int_col(64, seed=9)
+        dev.to_device_col(b)
+        s = residency.snapshot()
+        assert set(s["by_group"]) == {"olap", "oltp"}
+        assert s["by_group"]["olap"] > 0 and s["by_group"]["oltp"] > 0
+        led = residency.verify_ledger()
+        assert led["ok"] and led["by_group"] == led["by_group_recomputed"]
+
+    def test_budget_share_enforced_per_group(self, tenant_sandbox):
+        """Two active tenants split the budget: a tenant uploading past
+        its share is evicted back toward it while the other tenant's
+        resident set is untouched."""
+        residency.set_group("hog")
+        hog_cols = [_int_col(256, seed=i) for i in range(3)]
+        for c in hog_cols:
+            dev.to_device_col(c)
+        hog_bytes = residency.resident_bytes()
+        residency.set_group("meek")
+        meek_col = _int_col(64, seed=99)
+        dev.to_device_col(meek_col)
+        meek_bytes = residency.resident_bytes() - hog_bytes
+        # budget: room for meek + ~half of hog's set → hog must shrink
+        residency.set_budget(hog_bytes // 2 + meek_bytes)
+        residency.set_group("hog")
+        dev.to_device_col(_int_col(256, seed=7))
+        s = residency.snapshot()
+        assert s["hbm_bytes_cached"] <= hog_bytes // 2 + meek_bytes
+        # the protected tenant survived intact; the hog paid its own bill
+        assert meek_col._device is not None
+        assert s["by_group"].get("meek", 0) == meek_bytes
+        assert residency.verify_ledger()["ok"]
+
+    def test_self_first_eviction_order(self, tenant_sandbox):
+        """An over-share uploader evicts its OWN LRU entries before
+        another tenant's — even when the other tenant's entry is the
+        globally oldest (plain global LRU would evict it first)."""
+        residency.set_group("other")
+        oldest = _int_col(128, seed=1)
+        dev.to_device_col(oldest)  # globally oldest entry
+        residency.set_group("self")
+        mine = [_int_col(128, seed=10 + i) for i in range(3)]
+        for c in mine:
+            dev.to_device_col(c)
+        total = residency.resident_bytes()
+        per_entry = total // 4
+        # room for three entries: the NEXT self upload must evict one
+        residency.set_budget(total - per_entry // 2)
+        dev.to_device_col(_int_col(128, seed=50))
+        # the self tenant's own LRU (mine[0]) went; `other` survived
+        assert oldest._device is not None, "neighbor's entry was evicted"
+        assert mine[0]._device is None, "uploader's own LRU was spared"
+        assert residency.verify_ledger()["ok"]
+
+    def test_group_bytes_released_on_gc(self, tenant_sandbox):
+        residency.set_group("ephemeral")
+        col = _int_col(64)
+        dev.to_device_col(col)
+        assert residency.snapshot()["by_group"].get("ephemeral", 0) > 0
+        del col
+        gc.collect()
+        assert residency.snapshot()["by_group"].get("ephemeral", 0) == 0
+        assert residency.verify_ledger()["ok"]
+
+    def test_concurrent_multitenant_ledger_invariant(self, tenant_sandbox):
+        """Concurrent upload / evict / budget pressure from multiple
+        tenants must leave the global AND per-group ledgers exactly
+        recomputable from the live entries (the lock exists for this)."""
+        import random
+        import threading as th
+        residency.set_budget(64 * 1024)
+        errs = []
+
+        def worker(tid):
+            rng = random.Random(tid)
+            group = f"tenant-{tid % 3}"
+            residency.set_group(group)
+            kept = []
+            try:
+                for i in range(40):
+                    c = _int_col(rng.choice([32, 64, 128]),
+                                 seed=tid * 1000 + i)
+                    dev.to_device_col(c)
+                    kept.append(c)
+                    if rng.random() < 0.2 and kept:
+                        kept.pop(rng.randrange(len(kept)))  # GC release
+                    if rng.random() < 0.05:
+                        residency.evict_all(f"chaos {tid}")
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [th.Thread(target=worker, args=(i,)) for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        gc.collect()
+        assert not errs
+        led = residency.verify_ledger()
+        assert led["ok"], f"multi-tenant ledger drift: {led}"
+
+    def test_tenant_flows_through_real_dispatch(self, tenant_sandbox, tk):
+        """The session's tidb_resource_group reaches the ledger through a
+        real query dispatch (attach() bridging), including a SUPERVISED
+        dispatch (worker-thread group bridging)."""
+        tk.must_exec("set tidb_resource_group = 'analytics'")
+        tk.must_query(AGG_Q)
+        assert residency.snapshot()["by_group"].get("analytics", 0) > 0
+        residency.evict_all("re-upload under supervision")
+        tk.must_exec("set tidb_device_call_timeout = 30")
+        try:
+            tk.must_query(AGG_Q)
+        finally:
+            tk.must_exec("set tidb_device_call_timeout = 0")
+        assert residency.snapshot()["by_group"].get("analytics", 0) > 0
+        assert residency.verify_ledger()["ok"]

@@ -1,0 +1,553 @@
+"""Serving front end (executor/scheduler.py): fragment admission, WFQ
+across tenants, per-tenant running caps, batch-key coalescing, classified
+DeviceAdmissionError (9009, taxonomy class `admission`) degrading to the
+host engine, gauge surfacing across EXPLAIN ANALYZE / observe / HTTP
+status, the multi-tenant breaker probe-owner fix, and the
+no-direct-dispatch AST lint."""
+
+import ast
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tidb_tpu.errors import DeviceAdmissionError
+from tidb_tpu.executor import scheduler
+from tidb_tpu.executor.circuit import CircuitBreaker, get_breaker
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+from tidb_tpu.utils.backoff import classify
+
+AGG_Q = "select g, sum(v), count(*) from t group by g order by g"
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table t (id int primary key, g int, v int)")
+    tk.must_exec("insert into t values " + ",".join(
+        f"({i},{i % 5},{(i * 3) % 17})" for i in range(300)))
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    return tk
+
+
+@pytest.fixture()
+def sched_sandbox():
+    """Isolated scheduler state for policy-level tests (no live traffic
+    in-process while unit tests drive the queues by hand)."""
+    scheduler.reset_for_tests()
+    saved = dict(scheduler._CFG)
+    yield scheduler
+    scheduler.reset_for_tests()
+    scheduler._CFG.update(saved)
+
+
+# -- classification / error surface ------------------------------------------
+
+class TestAdmissionError:
+    def test_errno_and_taxonomy(self):
+        e = DeviceAdmissionError("queue full")
+        assert e.code == 9009
+        assert classify(e) == "admission"
+
+    def test_injected_refusal_classifies_admission_not_fault(self):
+        from tidb_tpu.utils.failpoint import InjectedAdmissionError
+        with failpoint.enabled("device-admission", "admission-queue-full"):
+            with pytest.raises(InjectedAdmissionError):
+                failpoint.inject("device-admission")
+
+
+# -- admission through real queries ------------------------------------------
+
+class TestAdmissionPath:
+    def test_normal_query_admits_and_releases(self, tk):
+        before = scheduler.snapshot()["admitted"]
+        rows = tk.must_query(AGG_Q).rows
+        assert len(rows) == 5
+        snap = scheduler.snapshot()
+        assert snap["admitted"] > before
+        assert scheduler.verify_drained()["ok"]
+
+    def test_queue_full_degrades_to_host_exact(self, tk):
+        """An admission refusal must not error: the fragment runs on the
+        host engine, the result matches, and the per-tenant degradation
+        gauge records it — the breaker is NOT charged (load != health)."""
+        br = get_breaker(tk.session, shape="agg")
+        fail0 = br.snapshot()["failures"]
+        deg0 = scheduler.snapshot()["degradations_by_group"].get(
+            "default", 0)
+        with failpoint.enabled("device-admission", "admission-queue-full"):
+            rows = tuple(map(tuple, tk.must_query(AGG_Q).rows))
+        tk.must_exec("set tidb_executor_engine = 'host'")
+        host = tuple(map(tuple, tk.must_query(AGG_Q).rows))
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        assert rows == host
+        assert br.snapshot()["failures"] == fail0
+        snap = scheduler.snapshot()
+        assert snap["degradations_by_group"]["default"] > deg0
+        assert snap["rejected_injected"] >= 1
+
+    def test_admission_wait_absorbed_and_counted(self, tk):
+        waits0 = scheduler.snapshot()["sched_admission_waits_ms"]
+        with failpoint.enabled("device-admission",
+                               "1*admission-wait(0.05)"):
+            rows = tk.must_query(AGG_Q).rows
+        assert len(rows) == 5
+        assert (scheduler.snapshot()["sched_admission_waits_ms"]
+                >= waits0 + 40.0)
+
+    def test_tenant_attribution(self, tk):
+        wtk = tk.new_session()
+        wtk.must_exec("use test")
+        wtk.must_exec("set tidb_executor_engine = 'tpu'")
+        wtk.must_exec("set tidb_resource_group = 'analytics'")
+        with failpoint.enabled("device-admission", "admission-queue-full"):
+            wtk.must_query(AGG_Q)
+        assert scheduler.snapshot()["degradations_by_group"].get(
+            "analytics", 0) >= 1
+
+    def test_disabled_scheduler_passes_through(self, tk):
+        tk.must_exec("set global tidb_device_sched_queue_depth = 0")
+        try:
+            admitted0 = scheduler.snapshot()["admitted"]
+            rows = tk.must_query(AGG_Q).rows
+            assert len(rows) == 5
+            assert scheduler.snapshot()["admitted"] == admitted0
+        finally:
+            tk.must_exec("set global tidb_device_sched_queue_depth = 64")
+
+
+# -- queueing policy (deterministic, by-hand queue state) --------------------
+
+def _mk_ticket(group, batch_key=None):
+    return scheduler.Ticket(group, "agg", batch_key)
+
+
+def _enqueue(t):
+    import collections
+    scheduler._QUEUES.setdefault(
+        t.group, collections.deque()).append(t)
+    scheduler._QUEUED_N[0] += 1
+
+
+class TestWFQPolicy:
+    def test_equal_weights_interleave(self, sched_sandbox):
+        """Starved-tenant regression at the policy level: a light tenant
+        arriving AFTER a heavy tenant's backlog is granted interleaved,
+        not behind the whole backlog (a FIFO queue would grant the light
+        tickets last)."""
+        scheduler._CFG.update({"cap": 0, "weights": {}})
+        heavy = [_mk_ticket("heavy") for _ in range(8)]
+        light = [_mk_ticket("light") for _ in range(2)]
+        for t in heavy:
+            _enqueue(t)
+        for t in light:
+            _enqueue(t)
+        order = []
+        with scheduler._LOCK:
+            while scheduler._QUEUED_N[0]:
+                assert scheduler._grant_some_locked()
+                granted = [t for t in heavy + light
+                           if t.granted.is_set() and t not in order]
+                order.extend(granted)
+        light_pos = [order.index(t) for t in light]
+        # both light tickets granted within the first 4 grants (FIFO
+        # would put them at positions 8 and 9)
+        assert max(light_pos) <= 3, [t.group for t in order]
+
+    def test_weights_bias_grant_share(self, sched_sandbox):
+        """A 3x-weighted tenant gets ~3x the grants while both queues
+        are backlogged (virtual time advances by 1/weight)."""
+        scheduler._CFG.update({"cap": 0, "weights": {"gold": 3.0}})
+        gold = [_mk_ticket("gold") for _ in range(9)]
+        iron = [_mk_ticket("iron") for _ in range(9)]
+        for t in gold + iron:
+            _enqueue(t)
+        order = []
+        with scheduler._LOCK:
+            for _ in range(8):  # first 8 grants while both backlogged
+                assert scheduler._grant_some_locked()
+                order.extend([t for t in gold + iron
+                              if t.granted.is_set() and t not in order])
+        n_gold = sum(1 for t in order if t.group == "gold")
+        assert n_gold >= 5, f"gold got {n_gold}/8 grants"
+
+    def test_tenant_running_cap_blocks_only_that_tenant(self,
+                                                        sched_sandbox):
+        scheduler._CFG.update({"cap": 2, "weights": {}})
+        scheduler._RUNNING["busy"] = 2  # tenant at cap
+        b = _mk_ticket("busy")
+        o = _mk_ticket("other")
+        _enqueue(b)
+        _enqueue(o)
+        with scheduler._LOCK:
+            assert scheduler._grant_some_locked()
+        assert o.granted.is_set() and not b.granted.is_set()
+        # freeing one of busy's slots unblocks its queued ticket
+        scheduler._RUNNING["busy"] = 1
+        with scheduler._LOCK:
+            assert scheduler._grant_some_locked()
+        assert b.granted.is_set()
+
+    def test_batch_key_followers_granted_together(self, sched_sandbox):
+        """Queued tickets sharing the leader's compiled-pipeline identity
+        coalesce onto one grant (small-fragment batching) — including
+        followers from ANOTHER tenant's queue."""
+        scheduler._CFG.update({"cap": 0, "weights": {}})
+        key = ("agg", "sig", 512)
+        lead = _mk_ticket("a", key)
+        f1 = _mk_ticket("a", key)
+        f2 = _mk_ticket("b", key)
+        other = _mk_ticket("b", ("agg", "different", 512))
+        for t in (lead, f1, f2, other):
+            _enqueue(t)
+        with scheduler._LOCK:
+            assert scheduler._grant_some_locked()
+        assert lead.granted.is_set() and not lead.batched
+        assert f1.granted.is_set() and f1.batched
+        assert f2.granted.is_set() and f2.batched
+        assert not other.granted.is_set()
+        assert scheduler.STATS["sched_batched_fragments"] == 2
+
+
+class TestAdmitConcurrency:
+    def test_timeout_rejects_cleanly(self, sched_sandbox):
+        """A ticket that cannot be granted inside the admission timeout
+        is refused with the classified error and leaves no queue residue."""
+        scheduler._CFG.update({"depth": 8, "timeout_s": 0.05, "cap": 1,
+                               "weights": {}})
+        # the default tenant pinned at cap: the admit below must queue
+        scheduler._RUNNING[scheduler.DEFAULT_GROUP] = 1
+        with pytest.raises(DeviceAdmissionError):
+            # ctx=None keeps the pinned config (no GLOBAL refresh)
+            scheduler.admit(None, shape="agg")
+        scheduler._RUNNING.clear()
+        assert scheduler.verify_drained()["ok"]
+        assert scheduler.STATS["rejected_timeout"] == 1
+
+    def test_queue_full_rejects_excess(self, sched_sandbox):
+        """At the global bound a group at/over its share of the depth is
+        refused — here the backlog belongs to the refused group itself
+        (the single-tenant case: share == the whole depth)."""
+        scheduler._CFG.update({"depth": 2, "timeout_s": 0.05, "cap": 1,
+                               "weights": {}})
+        scheduler._RUNNING[scheduler.DEFAULT_GROUP] = 1
+        for t in (_mk_ticket(scheduler.DEFAULT_GROUP),
+                  _mk_ticket(scheduler.DEFAULT_GROUP)):
+            _enqueue(t)
+        with pytest.raises(DeviceAdmissionError) as ei:
+            scheduler.admit(None, shape="agg")
+        assert "queue full" in str(ei.value)
+        assert scheduler.STATS["rejected_full"] == 1
+
+    def test_queue_full_spares_under_share_group(self, sched_sandbox):
+        """One tenant's backlog at the global depth must not refuse an
+        under-share tenant's ticket: WFQ can only protect tickets that
+        got INTO the queue, so the depth bound is per-group fair at the
+        margin (the light ticket enqueues and is granted — its group has
+        a free running slot — while the hog stays capped)."""
+        scheduler._CFG.update({"depth": 2, "timeout_s": 5.0, "cap": 1,
+                               "weights": {}})
+        scheduler._RUNNING["hog"] = 1  # hog at cap: its backlog can't move
+        for t in (_mk_ticket("hog"), _mk_ticket("hog")):
+            _enqueue(t)
+        t = scheduler.admit(None, shape="agg")  # default group, 0 queued
+        assert t is not None and t.granted.is_set()
+        scheduler.release(t)
+        assert scheduler.STATS["rejected_full"] == 0
+
+    def test_queue_backstop_bounds_total(self, sched_sandbox):
+        """The fairness margin is itself bounded: at 2*depth the queue
+        refuses EVERY group, share or not."""
+        scheduler._CFG.update({"depth": 2, "timeout_s": 0.05, "cap": 1,
+                               "weights": {}})
+        scheduler._RUNNING["a"] = 1
+        scheduler._RUNNING["b"] = 1
+        for g in ("a", "a", "b", "b"):
+            _enqueue(_mk_ticket(g))  # total = 4 = 2*depth
+        with pytest.raises(DeviceAdmissionError):
+            scheduler.admit(None, shape="agg")  # fresh group, 0 queued
+        assert scheduler.STATS["rejected_full"] == 1
+
+    def test_group_stat_cardinality_capped(self, sched_sandbox):
+        """Group names are a free-form session sysvar: a client SETting a
+        fresh name per connection must not grow the per-group stat lines
+        (and their observe//metrics series) forever — past the cap, new
+        names fold into one overflow bucket."""
+        for i in range(scheduler.GROUP_STATS_CAP + 10):
+            scheduler.note_degradation(f"ephemeral-{i}")
+        degs = scheduler.snapshot()["degradations_by_group"]
+        assert len(degs) <= scheduler.GROUP_STATS_CAP + 1
+        assert degs[scheduler.OVERFLOW_GROUP] == 10
+        # the breaker's per-group reporting obeys the same cap
+        br = CircuitBreaker(clock=time.monotonic)
+        for i in range(scheduler.GROUP_STATS_CAP + 5):
+            br.record_failure(ValueError("x"), group=f"eph-{i}")
+        by_group = br.snapshot()["by_group"]
+        assert len(by_group) <= scheduler.GROUP_STATS_CAP + 1
+        assert by_group[scheduler.OVERFLOW_GROUP]["failures"] == 5
+
+    def test_concurrent_admit_release_drains(self, sched_sandbox):
+        """N threads admit/release in a storm; afterwards nothing is
+        queued or running (the chaos no-leaked-tickets invariant)."""
+        scheduler._CFG.update({"depth": 64, "timeout_s": 5.0, "cap": 2,
+                               "weights": {}})
+        errs = []
+
+        def worker(tid):
+            try:
+                for _ in range(25):
+                    t = scheduler.admit(None, shape="agg")
+                    time.sleep(0.0005)
+                    scheduler.release(t)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert not errs
+        deadline = time.monotonic() + 5
+        while (not scheduler.verify_drained()["ok"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert scheduler.verify_drained()["ok"]
+
+    def test_kill_interrupts_queued_wait(self, sched_sandbox):
+        """KILL answers within ~a poll tick while the ticket is QUEUED
+        (the PR 3 responsiveness discipline) — even with
+        tidb_device_admission_timeout=0 (wait forever) — and the
+        interrupted ticket leaves no queue residue."""
+        scheduler._CFG.update({"depth": 8, "timeout_s": 0.0, "cap": 1,
+                               "weights": {}})
+        scheduler._RUNNING[scheduler.DEFAULT_GROUP] = 1  # force queueing
+
+        class _Killed(Exception):
+            pass
+
+        class _Ctx:
+            killed = False
+
+            def check_killed(self):
+                if self.killed:
+                    raise _Killed()
+
+        ctx = _Ctx()
+        out = {}
+
+        def waiter():
+            try:
+                scheduler.admit(ctx, shape="agg")
+                out["r"] = "granted"
+            except _Killed:
+                out["r"] = "killed"
+            except Exception as e:  # noqa: BLE001
+                out["r"] = e
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.monotonic() + 5
+        while scheduler.queue_depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        ctx.killed = True
+        t.join(5)
+        assert not t.is_alive()
+        assert out["r"] == "killed"
+        assert time.monotonic() - t0 < 1.0  # ~poll-tick, not wait-long
+        scheduler._RUNNING.clear()
+        assert scheduler.verify_drained()["ok"]
+
+    def test_cross_session_batching_live(self, tk):
+        """Two sessions queue the SAME agg fragment behind a saturated
+        tenant; when the slot frees, the scheduler grants them as one
+        batch (the second rides the first's grant — and both reuse the
+        shared compiled pipeline)."""
+        tk.must_exec("set global tidb_device_tenant_running_cap = 1")
+        try:
+            batched0 = scheduler.snapshot()["sched_batched_fragments"]
+            # occupy the 'default' tenant's single slot so both queries
+            # below must QUEUE (the batching window)
+            blocker = scheduler.admit(tk.session, shape="agg")
+            assert blocker is not None
+            results, errors = [], []
+
+            def q():
+                s = tk.new_session()
+                s.must_exec("use test")
+                s.must_exec("set tidb_executor_engine = 'tpu'")
+                try:
+                    results.append(tuple(map(tuple,
+                                             s.must_query(AGG_Q).rows)))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            ts = [threading.Thread(target=q) for _ in range(2)]
+            for t in ts:
+                t.start()
+            # let both enqueue behind the blocker, then free the slot
+            deadline = time.monotonic() + 5
+            while (scheduler.queue_depth() < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert scheduler.queue_depth() >= 2
+            scheduler.release(blocker)
+            for t in ts:
+                t.join(30)
+            assert not errors
+            assert len(results) == 2 and results[0] == results[1]
+            assert (scheduler.snapshot()["sched_batched_fragments"]
+                    > batched0)
+        finally:
+            tk.must_exec("set global tidb_device_tenant_running_cap = 4")
+
+
+# -- gauges across the observability surfaces --------------------------------
+
+class TestSchedulerObservability:
+    def test_explain_analyze_and_observe_and_http(self, tk):
+        with failpoint.enabled("device-admission", "admission-queue-full"):
+            tk.must_query(AGG_Q)
+        rows = tk.must_query(f"explain analyze {AGG_Q}").rows
+        blob = "\n".join(" ".join(str(c) for c in r) for r in rows)
+        assert "sched_queue_depth" in blob
+        assert "sched_degradations" in blob
+
+        g = tk.domain.observe.gauge_snapshot()
+        assert "sched_queue_depth" in g
+        assert any(k.startswith("sched_degradations:") for k in g)
+
+        from tidb_tpu.server.http_status import StatusServer
+        srv = StatusServer(tk.domain, port=0).start()
+        try:
+            st = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/status", timeout=5).read())
+            assert "device_scheduler" in st
+            assert st["device_scheduler"]["admitted"] >= 1
+            assert "device_breakers" in st
+            for snap in st["device_breakers"].values():
+                assert "by_group" in snap
+            met = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5).read().decode()
+            assert "sched_queue_depth" in met
+            assert 'sched_degradations{resource_group=' in met
+            # valid text exposition: at most ONE TYPE line per metric
+            # (duplicates fail the entire Prometheus scrape)
+            type_lines = [ln for ln in met.splitlines()
+                          if ln.startswith("# TYPE ")]
+            assert len(type_lines) == len(set(type_lines)), type_lines
+        finally:
+            srv.shutdown()
+
+
+# -- multi-tenant breaker probe ownership ------------------------------------
+
+class TestBreakerMultiTenantProbe:
+    def test_two_sessions_one_thread_single_probe(self):
+        """Two sessions multiplexed on ONE thread (the embedded-server
+        shape): after cooldown, session A wins the probe slot; session
+        B's allow() on the same thread must NOT be granted a second
+        probe, and B's STALE success must not close the breaker out from
+        under A's probe (the cross-session half-open race)."""
+        clock = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=10.0,
+                            clock=lambda: clock[0], shape="agg")
+        br.record_failure(RuntimeError("XlaRuntimeError: boom"),
+                          session=7, group="a")
+        assert br.state == "open"
+        clock[0] = 11.0  # cooldown elapsed -> HALF_OPEN
+        assert br.allow(session=1, group="a") is True      # A probes
+        assert br.allow(session=2, group="b") is False     # B degrades
+        # B's stale verdicts (same THREAD, different session) must not
+        # resolve A's probe either way
+        br.record_success(session=2)
+        assert br.state == "half-open"
+        br.record_failure(RuntimeError("XlaRuntimeError: boom"),
+                          session=2, group="b")
+        assert br.state == "half-open"
+        # B cannot free A's probe slot
+        br.release_probe(session=2)
+        assert br.allow(session=3, group="c") is False
+        # A's own verdict closes
+        br.record_success(session=1)
+        assert br.state == "closed"
+
+    def test_worker_thread_verdict_resolves_probe(self):
+        """A SUPERVISED probe fragment records its verdict from a worker
+        thread (mpp_exec's exchange-exhaustion path): the session-keyed
+        owner token must still match, re-opening the breaker — a
+        (thread, session) token would misread it as stale and let the
+        sick device be probed again immediately."""
+        clock = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=10.0,
+                            clock=lambda: clock[0], shape="agg")
+        br.record_failure(RuntimeError("XlaRuntimeError: boom"), session=5)
+        clock[0] = 11.0
+        assert br.allow(session=5) is True  # probe won on THIS thread
+        t = threading.Thread(target=br.record_failure, args=(
+            RuntimeError("XlaRuntimeError: boom"),), kwargs={"session": 5})
+        t.start()
+        t.join(5)
+        assert br.state == "open", (
+            "worker-thread probe verdict read as stale; breaker not "
+            "re-opened")
+
+    def test_per_group_stat_lines(self):
+        br = CircuitBreaker(threshold=0, shape="agg")
+        br.record_failure(RuntimeError("XlaRuntimeError: x"), group="t1")
+        br.record_failure(RuntimeError("XlaRuntimeError: x"), group="t2")
+        br.record_failure(RuntimeError("XlaRuntimeError: x"), group="t2")
+        snap = br.snapshot()
+        assert snap["by_group"]["t1"]["failures"] == 1
+        assert snap["by_group"]["t2"]["failures"] == 2
+
+
+# -- lint: no direct device dispatch bypassing admission ---------------------
+
+#: files allowed to touch the supervisor dispatch directly: the
+#: supervisor itself, the admission-aware run_device, the scheduler, and
+#: parallel/mpp.py's library-embedder hook (_supervised_step — audited:
+#: it holds its own admission ticket around the supervised call)
+_SUPERVISED_ALLOWED = {"supervisor.py", "device_exec.py", "scheduler.py",
+                       "mpp.py"}
+
+
+class TestNoDirectDispatchLint:
+    def test_call_supervised_confined_to_admission_layer(self):
+        """Every device dispatch must pass the admission queue: direct
+        `call_supervised` / `supervised_call` use inside tidb_tpu is
+        confined to run_device (which admits first) and the scheduler —
+        a new dispatch path must not silently bypass per-tenant
+        scheduling.  (bench.py's whole-query watchdog wraps full
+        statements, whose fragments admit individually inside.)"""
+        root = os.path.abspath(os.path.join(
+            os.path.dirname(__file__), "..", "tidb_tpu"))
+        offenders = []
+        for dirpath, _dirs, files in os.walk(root):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                if fname in _SUPERVISED_ALLOWED:
+                    continue
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    func = node.func
+                    name = (func.id if isinstance(func, ast.Name)
+                            else func.attr
+                            if isinstance(func, ast.Attribute) else "")
+                    if name in ("call_supervised", "supervised_call"):
+                        offenders.append(f"{path}:{node.lineno}")
+        assert not offenders, (
+            "direct supervised dispatch bypasses the admission queue "
+            f"(route through device_exec.run_device): {offenders}")

@@ -68,6 +68,8 @@ class ErrCode:
     #                          the budgeted Backoffer ran out of retries
     DeviceHang = 9008  # reserved next to 9005: a supervised device call
     #                    blew its wall-clock deadline (the backend hung)
+    DeviceAdmission = 9009  # the serving scheduler refused a fragment a
+    #                         device slot (queue full / wait timed out)
     LazyUniquenessCheckFailure = 8147
     ResolveLockTimeout = 9004
     GCTooEarly = 9006
@@ -213,6 +215,22 @@ class DeviceHangError(TiDBError):
     sqlstate = "HY000"
     shape = ""
     deadline_s = 0.0
+
+
+class DeviceAdmissionError(TiDBError):
+    """The serving scheduler (executor/scheduler.py) refused this
+    fragment a device slot: the admission queue is at
+    ``tidb_device_sched_queue_depth``, the queued wait exceeded
+    ``tidb_device_admission_timeout``, or an admission failpoint fired.
+
+    This is LOAD, not ill-health: run_device converts the refusal into
+    ``DeviceUnsupported`` so the fragment degrades to the host engine
+    (counted in the per-tenant ``sched_degradations`` gauge) without
+    charging the circuit breaker — the co-processing answer to overload
+    is host+device serving different work concurrently, not an error."""
+
+    code = ErrCode.DeviceAdmission
+    sqlstate = "HY000"
 
 
 class BackoffExhaustedError(TiDBError):

@@ -56,11 +56,48 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
-        self._probe_owner = None  # thread ident holding the probe slot
+        # the probe slot's owner token: the SESSION when one is known,
+        # else the thread ident.  Thread ident alone is not enough
+        # cross-session — an embedded server runs many sessions on one
+        # thread, and a stale verdict from session B must not pass the
+        # owner check and resolve session A's probe (the multi-tenant
+        # half-open race).  Keying on the session (not (thread, session))
+        # also keeps the verdict valid when a SUPERVISED dispatch records
+        # it from a worker thread (mpp_exec's exchange-exhaustion path):
+        # a session runs one statement at a time, so one session = at
+        # most one fragment verdict in flight.
+        self._probe_owner = None
         self._probe_started = 0.0
         self.stats = {"opened": 0, "degraded": 0, "failures": 0,
                       "probes": 0, "probe_reclaims": 0}
+        #: per-resource-group reporting (stat lines keyed by tenant):
+        #: which tenants are paying the degradations/failures.  Reporting
+        #: ONLY — breaker state stays per (Domain, shape): device health
+        #: is a property of the hardware path, not of who dispatched
+        self.stats_by_group: dict = {}
         self.last_error = ""
+
+    @staticmethod
+    def _token(session):
+        if session is not None:
+            return ("sid", session)
+        return ("tid", threading.get_ident())
+
+    def _group_stats(self, group):
+        st = self.stats_by_group.get(group)
+        if st is None:
+            # group names are a free-form session sysvar: cap the stat
+            # lines, folding new names into one overflow bucket (same
+            # rule as scheduler.GROUP_STATS_CAP) so a fresh-name-per-
+            # connection client cannot grow the snapshot forever
+            from .scheduler import GROUP_STATS_CAP, OVERFLOW_GROUP
+            if len(self.stats_by_group) >= GROUP_STATS_CAP:
+                group = OVERFLOW_GROUP
+                st = self.stats_by_group.get(group)
+            if st is None:
+                st = self.stats_by_group[group] = {"degraded": 0,
+                                                   "failures": 0}
+        return st
 
     def configure(self, threshold: int | None = None,
                   cooldown_s: float | None = None):
@@ -81,13 +118,18 @@ class CircuitBreaker:
             return HALF_OPEN
         return self._state
 
-    def allow(self) -> bool:
+    def allow(self, session=None, group=None) -> bool:
         """May a fragment dispatch to the device right now?  In HALF_OPEN
         exactly one caller wins the probe slot; the rest stay host-side
-        until the probe's verdict is in.  A probe whose owner vanished
-        without any verdict (thread died on a path outside run_device's
-        release discipline) is reclaimed after a grace window instead of
-        wedging every future caller host-side."""
+        until the probe's verdict is in.  The slot is owned by the
+        SESSION (thread ident only as the no-session fallback — see the
+        _probe_owner field comment), so two sessions' simultaneous probe
+        grants on the same shape resolve to one probe even when an
+        embedded server multiplexes both onto one thread, while a
+        supervised dispatch's worker-thread verdict still matches.  A
+        probe whose owner vanished without any verdict (thread died on a
+        path outside run_device's release discipline) is reclaimed after
+        a grace window instead of wedging every future caller host-side."""
         with self._mu:
             if self.threshold <= 0:  # breaker disabled
                 return True
@@ -103,14 +145,16 @@ class CircuitBreaker:
                 if not self._probing:
                     self._state = HALF_OPEN
                     self._probing = True
-                    self._probe_owner = threading.get_ident()
+                    self._probe_owner = self._token(session)
                     self._probe_started = self._clock()
                     self.stats["probes"] += 1
                     return True
             self.stats["degraded"] += 1
+            if group is not None:
+                self._group_stats(group)["degraded"] += 1
             return False
 
-    def release_probe(self):
+    def release_probe(self, session=None):
         """The probe fragment exited WITHOUT a health verdict (it raised
         DeviceUnsupported / a user error before touching the device) —
         free the HALF_OPEN probe slot so another fragment can probe,
@@ -119,13 +163,13 @@ class CircuitBreaker:
         opened must not free a live probe's slot (one probe at a time)."""
         with self._mu:
             if (self._peek_state() == HALF_OPEN and self._probing
-                    and self._probe_owner == threading.get_ident()):
+                    and self._probe_owner == self._token(session)):
                 self._probing = False
                 self._probe_owner = None
 
-    def record_success(self):
+    def record_success(self, session=None):
         with self._mu:
-            if self._probing and self._probe_owner != threading.get_ident():
+            if self._probing and self._probe_owner != self._token(session):
                 # a STALE fragment (admitted while CLOSED, finishing after
                 # the breaker opened) succeeds while another thread's probe
                 # is in flight: good news, but the probe owns the verdict —
@@ -149,15 +193,17 @@ class CircuitBreaker:
             self._probing = False
             self._probe_owner = None
 
-    def record_failure(self, err=None):
+    def record_failure(self, err=None, session=None, group=None):
         from ..utils.backoff import classify
         with self._mu:
             self.stats["failures"] += 1
+            if group is not None:
+                self._group_stats(group)["failures"] += 1
             if err is not None:
                 self.last_error = f"{classify(err)}: {err}"
             if self.threshold <= 0:
                 return
-            if self._probing and self._probe_owner != threading.get_ident():
+            if self._probing and self._probe_owner != self._token(session):
                 # stale verdict during a live probe (see record_success):
                 # count it, but the slot and the state belong to the probe
                 self._failures += 1
@@ -187,7 +233,10 @@ class CircuitBreaker:
                     "failures": self._failures,
                     "threshold": self.threshold,
                     "cooldown_s": self.cooldown_s,
-                    "last_error": self.last_error, **self.stats}
+                    "last_error": self.last_error,
+                    "by_group": {g: dict(st) for g, st
+                                 in self.stats_by_group.items()},
+                    **self.stats}
 
 
 #: process-wide fallback for contexts with no Domain (bare device calls),

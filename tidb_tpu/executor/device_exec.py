@@ -37,11 +37,28 @@ def engine_mode(ctx) -> str:
         return "auto"
 
 
-def run_device(ctx, fn, /, *args, shape="agg", **kw):
-    """Dispatch one device fragment through the circuit breaker
-    (executor/circuit.py) and the device-runtime supervisor
-    (executor/supervisor.py): an OPEN breaker degrades to the host engine
-    up front (DeviceUnsupported → the caller's existing fallback), and a
+def run_device(ctx, fn, /, *args, shape="agg", batch_key=None, **kw):
+    """Dispatch one device fragment through the serving admission layer
+    (executor/scheduler.py), the circuit breaker (executor/circuit.py)
+    and the device-runtime supervisor (executor/supervisor.py) — the four
+    layers every fragment passes, in order: ADMISSION (may this fragment
+    occupy the shared device now?) → SUPERVISOR deadline → BREAKER →
+    RESIDENCY budget.
+
+    Admission: the fragment holds a scheduler ticket for the duration of
+    the device call — weighted fair queueing across resource groups
+    (`tidb_resource_group`), bounded queue depth, per-tenant running
+    caps.  A refusal (queue full / wait timeout, classified
+    DeviceAdmissionError 9009) degrades this fragment to the host engine
+    exactly like an OPEN breaker — overload means host and device serve
+    DIFFERENT work concurrently, not an error.  `batch_key` (the
+    compiled-pipeline identity of the fragment, when the dispatch site
+    can compute it cheaply) lets queued same-shaped fragments coalesce
+    onto one scheduling slot, sharing the compiled program and resident
+    uploads cross-session.
+
+    An OPEN breaker degrades to the host engine up front
+    (DeviceUnsupported → the caller's existing fallback), and a
     classified device/transport failure — an XLA runtime error, a dead
     remote-compile tunnel, an injected fault — records into the breaker
     and ALSO degrades instead of killing the query.  DeviceUnsupported
@@ -65,6 +82,29 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
 
     `shape` scopes the breaker per fragment class (agg / join / window):
     one failing shape cools down without degrading healthy paths."""
+    from ..errors import DeviceAdmissionError
+    from . import scheduler
+    group = scheduler.resource_group(ctx)
+    scheduler.attach(ctx)
+    try:
+        ticket = scheduler.admit(ctx, shape=shape, batch_key=batch_key)
+    except DeviceAdmissionError as e:
+        # load pressure, not device ill-health: no breaker charge — the
+        # fragment runs on the host engine (per-tenant gauge records it)
+        scheduler.note_degradation(group)
+        raise DeviceUnsupported(
+            f"device admission refused for {shape} fragment "
+            f"(resource group '{group}'; degraded to host engine): "
+            f"{e}") from e
+    try:
+        return _run_device_admitted(ctx, fn, args, kw, shape, group)
+    finally:
+        scheduler.release(ticket)
+
+
+def _run_device_admitted(ctx, fn, args, kw, shape, group):
+    """Layers 2-4 (supervisor deadline → breaker → residency) for a
+    fragment that holds its admission ticket."""
     from ..errors import DeviceHangError
     from ..ops import residency
     from ..utils.backoff import (classify, is_device_oom, CLASS_DEVICE,
@@ -73,11 +113,12 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
     from . import supervisor
     from .circuit import get_breaker
     br = get_breaker(ctx, shape=shape)
-    if not br.allow():
+    sid = getattr(ctx, "conn_id", None)
+    if not br.allow(session=sid, group=group):
         raise DeviceUnsupported(
             f"device circuit open for {shape} fragments (cooling down; "
             "fragment degraded to host engine)")
-    residency.attach(ctx)  # budget sysvar + observe gauge sink
+    residency.attach(ctx)  # budget sysvar + tenant + observe gauge sink
     deadline_s, fence_on_expiry = supervisor.deadline_for(ctx)
     oom_retried = False
     while True:
@@ -91,16 +132,16 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
             # (its device call is still in flight; a silent host fallback
             # would hide that the deadline fired) but the NEXT queries
             # degrade once the breaker trips
-            br.record_failure(e)
+            br.record_failure(e, session=sid, group=group)
             raise
         except (DeviceUnsupported, TiDBError):
             # no health verdict: if this fragment held the HALF_OPEN probe
             # slot, free it — otherwise the breaker wedges with no prober
-            br.release_probe()
+            br.release_probe(session=sid)
             raise
         except (KeyboardInterrupt, SystemExit):
             # Ctrl-C mid-probe must not wedge the breaker in HALF_OPEN
-            br.release_probe()
+            br.release_probe(session=sid)
             raise
         except Exception as e:
             cls = classify(e)
@@ -108,7 +149,7 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
                            CLASS_EXCHANGE):
                 # an UNCLASSIFIED error is a programming bug, not a device
                 # health signal: surface it instead of silently degrading
-                br.release_probe()
+                br.release_probe(session=sid)
                 raise
             if not oom_retried and is_device_oom(e):
                 # OOM ladder step 1+2: evict all cached HBM, ONE retry.
@@ -118,10 +159,10 @@ def run_device(ctx, fn, /, *args, shape="agg", **kw):
                 oom_retried = True
                 residency.recover_oom(e)
                 continue
-            br.record_failure(e)
+            br.record_failure(e, session=sid, group=group)
             raise DeviceUnsupported(
                 f"device failure ({cls}): {e}") from e
-        br.record_success()
+        br.record_success(session=sid)
         return out
 
 
@@ -362,21 +403,48 @@ def _agg_used_columns(plan, conds) -> set:
     return used
 
 
+def _agg_struct_parts(plan, conds) -> list:
+    """The STRUCTURAL part of a scan-agg fragment's signature (conds,
+    group exprs, agg descs — everything except dictionary content).  One
+    helper feeds both _agg_sig and agg_batch_key so the admission batch
+    key can never silently diverge from the compiled-pipeline identity
+    it claims to prefix."""
+    return (
+        [_expr_sig(c) for c in conds] + ["|g|"] +
+        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
+        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
+         for d in plan.aggs])
+
+
 def _agg_sig(plan, conds, dcols) -> tuple:
     """(signature string, dictionary refs) for the pipeline cache — shared
     by the whole-table and streamed paths so their caches never diverge.
     Dictionaries contribute their CONTENT signature: a delta append that
     re-encodes the same value set must hit the cached pipeline."""
     sig = ";".join(
-        [_expr_sig(c) for c in conds] + ["|g|"] +
-        [_expr_sig(e) for e in plan.group_exprs] + ["|a|"] +
-        [f"{d.name}:{_expr_sig(d.args[0]) if d.args else ''}"
-         for d in plan.aggs] +
+        _agg_struct_parts(plan, conds) +
         [f"{idx}:{_dc_sig(dc)}" for idx, dc in sorted(dcols.items())
          if dc.dictionary is not None])
     refs = tuple(dc.dictionary for dc in dcols.values()
                  if dc.dictionary is not None)
     return sig, refs
+
+
+def agg_batch_key(plan, conds, n_rows: int, ctx=None):
+    """Cheap admission-batching identity for a scan-agg fragment: the
+    structural (plan sig, bucket shape) prefix of the compiled-pipeline
+    cache key — dictionary CONTENT sigs are deliberately omitted (they
+    require the columns in hand; admission runs before the upload).
+    Queued fragments sharing this key coalesce onto one scheduling slot
+    (executor/scheduler.py): identical keys re-dispatch the same cached
+    XLA program against the same bucket, so N concurrent same-shaped
+    queries cost ~one device call.  None when the fragment contains
+    expressions the device can't sign (it won't batch, just queue)."""
+    try:
+        sig = ";".join(_agg_struct_parts(plan, conds))
+        return ("agg", sig, dev.bucket_rows(n_rows, dev.shape_buckets(ctx)))
+    except Exception:
+        return None
 
 
 def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:

@@ -90,6 +90,11 @@ class QueryExecutor:
             # query run under memory pressure" answered from the plan
             from ..ops import residency
             self.annotate(**residency.report_gauges())
+            # serving scheduler (executor/scheduler.py): queue depth plus
+            # the admission-wait / batching / degradation counters once
+            # they have fired — "did this query contend for the device"
+            from . import scheduler
+            self.annotate(**scheduler.report_gauges())
         return out
 
 
@@ -483,13 +488,19 @@ class HashAggExec(QueryExecutor):
                     batch = DEFAULT_PAGE_ROWS
             elif batch < 0:
                 batch = DEFAULT_PAGE_ROWS if paged_in else 0
+            # admission-batching identity: concurrent same-shaped agg
+            # fragments coalesce onto one scheduler slot and re-dispatch
+            # the shared compiled pipeline (executor/scheduler.py)
+            from .device_exec import agg_batch_key
+            bkey = agg_batch_key(eff_p, conds, raw.num_rows, self.ctx)
             if batch > 0 and (paged_in or raw.num_rows > batch):
                 from .device_exec import device_agg_streaming
                 try:
                     out = self._with_pipe_stats(
                         run_device, self.ctx, device_agg_streaming,
                         eff_p, raw, conds, batch,
-                        ctx=self.ctx, allow_single=paged_in, shape="agg")
+                        ctx=self.ctx, allow_single=paged_in, shape="agg",
+                        batch_key=bkey)
                     self._mark_fragment("tpu-stream", raw.num_rows)
                     return out
                 except DeviceUnsupported:
@@ -501,7 +512,7 @@ class HashAggExec(QueryExecutor):
                 try:
                     out = self._with_pipe_stats(
                         run_device, self.ctx, device_agg, eff_p, raw,
-                        conds, ctx=self.ctx, shape="agg")
+                        conds, ctx=self.ctx, shape="agg", batch_key=bkey)
                     self._mark_fragment("tpu", raw.num_rows)
                     return out
                 except DeviceUnsupported:

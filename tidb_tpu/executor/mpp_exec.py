@@ -617,7 +617,15 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                 bo.backoff("exchangeRetry", exc)
             except BackoffExhaustedError:
                 from .circuit import get_breaker
-                get_breaker(ctx).record_failure(exc)
+                # same SESSION owner token AND the same fragment shape
+                # run_device's allow() used (join trees dispatch under
+                # shape="join" — charging "agg" would open the healthy
+                # agg breaker and orphan the join probe's verdict); the
+                # session token stays valid even though a supervised
+                # dispatch runs this on a worker thread
+                get_breaker(ctx,
+                            shape="join" if joins else "agg").record_failure(
+                    exc, session=getattr(ctx, "conn_id", None))
                 raise
             MPP_STATS["exchange_retries"] += 1
             continue

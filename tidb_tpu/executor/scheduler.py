@@ -1,0 +1,606 @@
+"""Serving front end: fragment-level device admission + weighted fair
+scheduling across tenants.
+
+Why this exists (ROADMAP open item 2, ISSUE 6): everything through PR 5
+hardens ONE query at a time — breaker, supervisor, residency all assume a
+fragment that already owns the device.  "Millions of users" means hundreds
+of concurrent sessions multiplexing one device, and without an admission
+layer they contend by luck: a heavy analytical session can occupy every
+dispatch slot while a point-read tenant starves, and overload surfaces as
+interleaved slowness instead of a classified, bounded queue.  The
+scheduling move follows "Revisiting Co-Processing for Hash Joins on the
+Coupled CPU-GPU Architecture" (PAPERS.md): under load the host and the
+device should serve DIFFERENT work concurrently — an admission refusal
+degrades that fragment to the (always correct) host engine instead of
+queueing forever or erroring.
+
+The four layers a device fragment now passes through
+(`device_exec.run_device` drives them in this order):
+
+    1. ADMISSION (this module)    may this fragment occupy the device now?
+    2. SUPERVISOR deadline        is the backend still responsive?
+    3. CIRCUIT BREAKER            is this fragment shape healthy?
+    4. RESIDENCY                  do its uploads fit the HBM budget?
+
+Model — ticket, grant, release:
+
+* Every `run_device` dispatch calls :func:`admit`, which returns a
+  granted ``Ticket`` (released in run_device's ``finally``) or raises
+  :class:`~tidb_tpu.errors.DeviceAdmissionError` (errno 9009, taxonomy
+  class ``admission``).  run_device converts the refusal into
+  ``DeviceUnsupported`` so the caller's existing fallback runs the
+  fragment on the host engine — admission pressure degrades, never
+  errors.
+* **Fast path**: with no ticket queued anywhere and the tenant under its
+  running cap, admission is one mutex acquire — the single-session hot
+  path pays ~a lock, no thread handoff.
+* **Queued path**: tickets enqueue per-tenant; a scheduler thread
+  dequeues with WEIGHTED FAIR QUEUEING (virtual-time WFQ: each grant
+  advances the tenant's virtual clock by 1/weight, the lowest clock
+  eligible tenant goes next), so a tenant flooding the queue cannot
+  starve another's point reads.  The queue is bounded
+  (``tidb_device_sched_queue_depth``) and each wait is bounded
+  (``tidb_device_admission_timeout``); both refusals are classified
+  admission errors.
+* **Per-tenant running caps** (``tidb_device_tenant_running_cap``): at
+  most N fragments of one resource group occupy the device concurrently,
+  so one tenant's heavy analytics cannot occupy every slot.
+* **Small-fragment batching**: queued tickets that share a ``batch_key``
+  — the (plan sig, pack sig, bucket shape) compiled-pipeline identity
+  computed by the dispatch site — are granted TOGETHER with the leader as
+  one scheduling charge.  The followers' dispatches hit the process-wide
+  compiled-fragment cache (PR 2) and the residency upload cache
+  cross-session, so N same-shaped small fragments cost one compile + one
+  upload + N cheap dispatches against the shared bucket instead of N
+  queue waits.
+
+Tenancy: the session sysvar ``tidb_resource_group`` (default
+``default``).  WFQ weights come from ``tidb_device_wfq_weights``
+(``"grp:weight,grp2:weight"``, unlisted groups weigh 1).  Config is read
+from the Domain's GLOBAL variables on every admit, same discipline as the
+breaker/residency knobs: the device is process-wide, so a session-scoped
+SET must not reconfigure the shared queue.
+
+Invariant (chaos-asserted, `verify_drained`): every admitted ticket is
+eventually COMPLETED, DEGRADED or cleanly REJECTED — no leaked tickets,
+and the queue drains to zero once the traffic stops.
+
+Gauges — ``sched_queue_depth``, ``sched_admission_waits_ms``,
+``sched_batched_fragments``, per-tenant ``sched_degradations`` — surface
+in EXPLAIN ANALYZE annotations, observe gauges, HTTP ``/status`` +
+``/metrics``, and bench_serve.py lines (same plumbing as the PR 5
+``hbm_*`` gauges).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import threading
+import time
+import weakref
+
+from ..errors import DeviceAdmissionError
+
+log = logging.getLogger("tidb_tpu.scheduler")
+
+DEFAULT_GROUP = "default"
+
+#: ticket states (the lifecycle the chaos invariant checks)
+QUEUED, RUNNING, DONE, REJECTED = "queued", "running", "done", "rejected"
+
+_LOCK = threading.Lock()
+#: wakes the scheduler thread when a ticket enqueues or a slot frees
+_WAKE = threading.Condition(_LOCK)
+
+#: queued-waiter poll period — bounds KILL detection latency while a
+#: ticket waits for its grant (same discipline as supervisor._POLL_S)
+_POLL_S = 0.02
+
+_SEQ = itertools.count(1)
+
+#: per-group FIFO of queued tickets (insertion order preserved)
+_QUEUES: "dict[str, collections.deque]" = {}
+#: total queued tickets across groups (bounded by the depth knob)
+_QUEUED_N = [0]
+#: per-group count of tickets currently RUNNING (granted, not released)
+_RUNNING: "collections.Counter" = collections.Counter()
+#: WFQ virtual clocks, one per group that ever queued
+_VTIME: "dict[str, float]" = {}
+
+#: batch-key followers may overshoot the per-tenant running cap by this
+#: factor (they share the leader's compiled program + uploads, so modest
+#: overshoot is the price of coalescing) — but no further: each batched
+#: fragment still dispatches individually, so an unbounded identical-key
+#: flood must not occupy unbounded device slots
+_BATCH_CAP_HEADROOM = 4
+
+#: resolved config (refreshed from GLOBAL vars on every admit)
+_CFG = {"depth": 64, "timeout_s": 5.0, "cap": 4, "weights": {}}
+_CFG_RAW_WEIGHTS = [""]
+
+STATS = {
+    "admitted": 0,          # tickets granted (fast path + scheduled)
+    "fast_grants": 0,       # granted inline without queueing
+    "queued": 0,            # tickets that had to wait in the queue
+    "sched_batched_fragments": 0,  # followers granted on a leader's slot
+    "rejected_full": 0,     # refused: queue at depth
+    "rejected_timeout": 0,  # refused: admission wait expired
+    "rejected_injected": 0,  # refused: admission failpoint fired
+    "sched_admission_waits_ms": 0.0,  # cumulative queued wait
+}
+
+#: per-tenant degradations: admission refusals that sent the fragment to
+#: the host engine (run_device reports the degradation here after it
+#: converts the refusal into DeviceUnsupported)
+_DEGRADATIONS: "collections.Counter" = collections.Counter()
+
+#: bound on tracked per-group STAT lines (this counter and its observe /
+#: /metrics mirrors): group names come from a free-form session sysvar,
+#: so a client SETting a fresh name per connection must not grow process
+#: memory or one metric series per name forever — beyond the cap, new
+#: names fold into one overflow bucket.  Scheduling state itself
+#: (_QUEUES/_VTIME/_RUNNING) is pruned on drain and needs no cap.
+GROUP_STATS_CAP = 64
+OVERFLOW_GROUP = "__other__"
+
+
+def _stats_key(counter, group: str) -> str:
+    """`group`, or the overflow bucket once the counter is at cap."""
+    if group in counter or len(counter) < GROUP_STATS_CAP:
+        return group
+    return OVERFLOW_GROUP
+
+#: observe sinks mirroring the gauges (same pattern as ops/residency.py)
+_SINKS: "weakref.WeakSet" = weakref.WeakSet()
+
+_SCHED_THREAD = [None]
+
+
+class Ticket:
+    """One admitted-or-queued device fragment."""
+
+    __slots__ = ("seq", "group", "shape", "batch_key", "state",
+                 "granted", "batched", "enqueued_at")
+
+    def __init__(self, group, shape, batch_key):
+        self.seq = next(_SEQ)
+        self.group = group
+        self.shape = shape
+        self.batch_key = batch_key
+        self.state = QUEUED
+        self.granted = threading.Event()
+        self.batched = False      # granted as a follower on a shared key
+        self.enqueued_at = 0.0
+
+
+# -- config ------------------------------------------------------------------
+
+def resource_group(ctx) -> str:
+    """The session's resource group (``tidb_resource_group`` sysvar,
+    SESSION scope — tenancy is per connection, not per process)."""
+    if ctx is None:
+        return DEFAULT_GROUP
+    try:
+        g = str(ctx.get_sysvar("tidb_resource_group")).strip()
+    except Exception:
+        return DEFAULT_GROUP
+    return g or DEFAULT_GROUP
+
+
+def _parse_weights(raw: str) -> dict:
+    out = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, w = part.rpartition(":")
+        try:
+            wf = float(w)
+        except ValueError:
+            continue
+        if name.strip() and wf > 0:
+            out[name.strip()] = wf
+    return out
+
+
+def _refresh_cfg(ctx):
+    """Resolve the scheduling knobs from the Domain's GLOBAL variables
+    (shared resource: session SETs must not reconfigure the queue other
+    sessions are waiting in).  Bare contexts fall back to their own
+    view; no context keeps the current config."""
+    src = None
+    dom = getattr(ctx, "domain", None)
+    if dom is not None:
+        gv = dom.global_vars
+        src = lambda name, d: gv.get(name, d)  # noqa: E731
+    elif ctx is not None:
+        src = lambda name, d: ctx.get_sysvar(name)  # noqa: E731
+    if src is None:
+        return
+    try:
+        _CFG["depth"] = max(int(src("tidb_device_sched_queue_depth", 64)), 0)
+    except Exception:
+        pass
+    try:
+        _CFG["timeout_s"] = max(
+            float(src("tidb_device_admission_timeout", 5.0)), 0.0)
+    except Exception:
+        pass
+    try:
+        _CFG["cap"] = max(int(src("tidb_device_tenant_running_cap", 4)), 0)
+    except Exception:
+        pass
+    try:
+        raw = str(src("tidb_device_wfq_weights", ""))
+        if raw != _CFG_RAW_WEIGHTS[0]:
+            _CFG_RAW_WEIGHTS[0] = raw
+            _CFG["weights"] = _parse_weights(raw)
+    except Exception:
+        pass
+
+
+def _weight(group: str) -> float:
+    return _CFG["weights"].get(group, 1.0)
+
+
+def _cap() -> int:
+    """Per-tenant running-fragment cap (0 = unlimited)."""
+    return _CFG["cap"]
+
+
+# -- admission ---------------------------------------------------------------
+
+def admit(ctx, shape: str = "agg", batch_key=None) -> "Ticket | None":
+    """Admit one device fragment for the calling session.
+
+    Returns a granted :class:`Ticket` (the caller MUST pass it to
+    :func:`release` when the fragment finishes — run_device does this in
+    its ``finally``), or ``None`` when scheduling is disabled
+    (``tidb_device_sched_queue_depth = 0``).  Raises
+    :class:`DeviceAdmissionError` when the queue is full, the admission
+    wait times out, or the ``device-admission`` failpoint injects a
+    refusal — run_device degrades the fragment to the host engine."""
+    from ..utils import failpoint
+    from ..utils.failpoint import InjectedAdmissionError
+    _refresh_cfg(ctx)
+    if _CFG["depth"] <= 0:
+        return None
+    group = resource_group(ctx)
+    t_fp0 = time.monotonic()
+    try:
+        # chaos hook: `admission-queue-full` models a saturated queue,
+        # `N*admission-wait(s)` stalls admission (counted as wait time)
+        failpoint.inject("device-admission")
+    except InjectedAdmissionError as e:
+        with _LOCK:
+            STATS["rejected_injected"] += 1
+        raise DeviceAdmissionError(
+            f"device admission refused for resource group '{group}': {e}",
+            ) from e
+    fp_wait_ms = (time.monotonic() - t_fp0) * 1000.0
+    ticket = Ticket(group, shape, batch_key)
+    check_killed = getattr(ctx, "check_killed", None)
+    with _LOCK:
+        if fp_wait_ms >= 1.0:
+            STATS["sched_admission_waits_ms"] += fp_wait_ms
+        cap = _cap()
+        if _QUEUED_N[0] == 0 and (cap <= 0 or _RUNNING[group] < cap):
+            # fast path: nothing waiting anywhere and the tenant has a
+            # free slot — grant inline, no scheduler-thread handoff
+            ticket.state = RUNNING
+            ticket.granted.set()
+            _RUNNING[group] += 1
+            STATS["admitted"] += 1
+            STATS["fast_grants"] += 1
+            return ticket
+        if _QUEUED_N[0] >= _CFG["depth"]:
+            # the depth bound is per-group FAIR at the margin (the same
+            # share rule as the residency budget): one tenant's backlog
+            # filling the queue must not refuse every OTHER tenant's
+            # tickets before WFQ can interleave them.  A group still
+            # under its share of the depth (depth split across the
+            # groups queued right now) enqueues past the global bound;
+            # the hard 2*depth backstop keeps the total bounded
+            # regardless of how many groups arrive at once.
+            n_groups = len(_QUEUES) + (0 if group in _QUEUES else 1)
+            share = max(_CFG["depth"] // max(n_groups, 1), 1)
+            if (_QUEUED_N[0] >= 2 * _CFG["depth"]
+                    or len(_QUEUES.get(group, ())) >= share):
+                STATS["rejected_full"] += 1
+                ticket.state = REJECTED
+                raise DeviceAdmissionError(
+                    f"device admission queue full ({_QUEUED_N[0]} tickets "
+                    f">= tidb_device_sched_queue_depth={_CFG['depth']}, "
+                    f"resource group '{group}' at its share of the depth)")
+        ticket.enqueued_at = time.monotonic()
+        _QUEUES.setdefault(group, collections.deque()).append(ticket)
+        _QUEUED_N[0] += 1
+        STATS["queued"] += 1
+        _ensure_thread()
+        _WAKE.notify_all()
+        timeout_s = _CFG["timeout_s"]
+    _publish_gauges()
+    try:
+        # sliced wait polling the session's KILL flag (the PR 3
+        # responsiveness discipline: a queued session must answer KILL
+        # within ~a poll tick, not after the whole admission wait —
+        # check_killed raises QueryInterrupted, cleaned up below)
+        deadline = (ticket.enqueued_at + timeout_s if timeout_s > 0
+                    else None)
+        while True:
+            granted = ticket.granted.wait(_POLL_S)
+            if granted:
+                break
+            if check_killed is not None:
+                check_killed()
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        waited_ms = (time.monotonic() - ticket.enqueued_at) * 1000.0
+        with _LOCK:
+            STATS["sched_admission_waits_ms"] += waited_ms
+            # on timeout the ticket may STILL be granted in the race
+            # window — the scheduler grants under this same lock, so the
+            # is_set re-check here is authoritative
+            if granted or ticket.granted.is_set():
+                return ticket
+            try:
+                _QUEUES[ticket.group].remove(ticket)
+                _QUEUED_N[0] -= 1
+            except (KeyError, ValueError):
+                pass
+            _prune_group_locked(ticket.group)
+            ticket.state = REJECTED
+            STATS["rejected_timeout"] += 1
+    except BaseException:
+        # KILL / Ctrl-C while queued — or an async exception landing
+        # AFTER the grant but before admit returns: the ticket must not
+        # leak either way.  Return the slot a racing grant gave it, or
+        # dequeue it.
+        with _LOCK:
+            if ticket.granted.is_set():
+                if ticket.state == RUNNING:
+                    ticket.state = DONE
+                    _RUNNING[ticket.group] -= 1
+                    if _RUNNING[ticket.group] <= 0:
+                        del _RUNNING[ticket.group]
+                        _prune_group_locked(ticket.group)
+                    _WAKE.notify_all()
+            else:
+                try:
+                    _QUEUES[ticket.group].remove(ticket)
+                    _QUEUED_N[0] -= 1
+                except (KeyError, ValueError):
+                    pass
+                _prune_group_locked(ticket.group)
+                ticket.state = REJECTED
+        raise
+    _publish_gauges()
+    raise DeviceAdmissionError(
+        f"device admission wait exceeded tidb_device_admission_timeout="
+        f"{timeout_s:g}s ({waited_ms:.0f}ms queued) for resource group "
+        f"'{ticket.group}'")
+
+
+def release(ticket: "Ticket | None"):
+    """Return a granted ticket's device slot (run_device ``finally``).
+    No gauge publish here: release changes only the running counts,
+    which no published gauge carries — the uncontended fragment path
+    stays one mutex acquire on each side."""
+    if ticket is None:
+        return
+    with _LOCK:
+        if ticket.state != RUNNING:
+            return
+        ticket.state = DONE
+        _RUNNING[ticket.group] -= 1
+        if _RUNNING[ticket.group] <= 0:
+            del _RUNNING[ticket.group]
+            _prune_group_locked(ticket.group)
+        _WAKE.notify_all()
+
+
+def note_degradation(group: str):
+    """run_device reports an admission refusal it degraded to the host
+    engine (the per-tenant ``sched_degradations`` gauge)."""
+    with _LOCK:
+        _DEGRADATIONS[_stats_key(_DEGRADATIONS, group)] += 1
+    _publish_gauges()
+
+
+# -- the scheduler thread ----------------------------------------------------
+
+def _ensure_thread():
+    t = _SCHED_THREAD[0]
+    if t is not None and t.is_alive():
+        return
+    t = threading.Thread(target=_sched_loop, daemon=True,
+                         name="device-scheduler")
+    _SCHED_THREAD[0] = t
+    t.start()
+
+
+def _sched_loop():
+    while True:
+        with _WAKE:
+            while not _grant_some_locked():
+                _WAKE.wait(1.0)
+        _publish_gauges()
+
+
+def _eligible_locked():
+    """Groups with queued tickets and a free running slot, ordered by WFQ
+    virtual time (lowest first)."""
+    cap = _cap()
+    out = []
+    for g, q in _QUEUES.items():
+        if q and (cap <= 0 or _RUNNING[g] < cap):
+            out.append((_VTIME.get(g, 0.0), g))
+    out.sort()
+    return [g for _vt, g in out]
+
+
+def _grant_some_locked() -> bool:
+    """Grant the WFQ-next queued ticket (plus its batch-key followers).
+    Returns True when anything was granted (caller re-loops), False when
+    the queue is empty or every queued group is at its cap."""
+    elig = _eligible_locked()
+    if not elig:
+        return False
+    group = elig[0]
+    leader = _QUEUES[group].popleft()
+    _QUEUED_N[0] -= 1
+    _prune_group_locked(group)
+    # virtual-time WFQ: one grant advances the tenant's clock by
+    # 1/weight; an idle tenant re-enters at the current floor so a long
+    # sleep never banks unbounded credit against the active tenants
+    floor = min((_VTIME.get(g, 0.0) for g, q in _QUEUES.items() if q),
+                default=_VTIME.get(group, 0.0))
+    _VTIME[group] = max(_VTIME.get(group, 0.0), floor) + 1.0 / _weight(group)
+    _grant_locked(leader, batched=False)
+    if leader.batch_key is not None:
+        # small-fragment batching: queued tickets sharing the leader's
+        # compiled-pipeline identity ride this grant — their dispatches
+        # reuse the shared compiled fragment + resident uploads, so
+        # admitting them together costs ~one device call.  Bounded:
+        # batched fragments still dispatch individually, so followers
+        # stop at a small headroom over the tenant cap — a 50-deep flood
+        # of identical fragments must not occupy 50 device slots
+        cap = _cap()
+        for g, q in list(_QUEUES.items()):
+            followers = [t for t in q if t.batch_key == leader.batch_key]
+            for t in followers:
+                if (cap > 0 and _RUNNING[t.group]
+                        >= cap * _BATCH_CAP_HEADROOM):
+                    break
+                q.remove(t)
+                _QUEUED_N[0] -= 1
+                _grant_locked(t, batched=True)
+            _prune_group_locked(g)
+    return True
+
+
+def _prune_group_locked(group: str):
+    """Drop a group's empty queue (and its virtual clock once nothing of
+    it runs either): group names come from a free-form session sysvar,
+    so per-group state must not accumulate for every name ever seen —
+    the WFQ floor re-entry in _grant_some_locked makes dropping an idle
+    group's clock semantically free."""
+    q = _QUEUES.get(group)
+    if q is not None and not q:
+        del _QUEUES[group]
+        q = None
+    if q is None and group not in _RUNNING:
+        _VTIME.pop(group, None)
+
+
+def _grant_locked(ticket: Ticket, batched: bool):
+    ticket.state = RUNNING
+    ticket.batched = batched
+    _RUNNING[ticket.group] += 1
+    STATS["admitted"] += 1
+    if batched:
+        STATS["sched_batched_fragments"] += 1
+    ticket.granted.set()
+
+
+# -- introspection / gauges --------------------------------------------------
+
+def queue_depth() -> int:
+    """The ``sched_queue_depth`` gauge (tickets waiting right now)."""
+    with _LOCK:
+        return _QUEUED_N[0]
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        return {
+            "sched_queue_depth": _QUEUED_N[0],
+            "running": dict(_RUNNING),
+            "degradations_by_group": dict(_DEGRADATIONS),
+            "vtime": dict(_VTIME),
+            "depth_cfg": _CFG["depth"],
+            "cap_cfg": _CFG["cap"],
+            **{k: (round(v, 3) if isinstance(v, float) else v)
+               for k, v in STATS.items()},
+        }
+
+
+def report_gauges() -> dict:
+    """Surfacing policy shared by EXPLAIN ANALYZE and bench lines:
+    ``sched_queue_depth`` always; waits / batched / degradations only
+    once they have ever fired (pressure is the exception, not annotation
+    noise on every healthy plan)."""
+    s = snapshot()
+    out = {"sched_queue_depth": s["sched_queue_depth"]}
+    if s["sched_admission_waits_ms"]:
+        out["sched_admission_waits_ms"] = round(
+            s["sched_admission_waits_ms"], 1)
+    if s["sched_batched_fragments"]:
+        out["sched_batched_fragments"] = s["sched_batched_fragments"]
+    total_deg = sum(s["degradations_by_group"].values())
+    if total_deg:
+        out["sched_degradations"] = total_deg
+    return out
+
+
+def attach(ctx):
+    """Register the Domain's observe registry as a gauge sink (called by
+    run_device alongside residency.attach)."""
+    dom = getattr(ctx, "domain", None)
+    obs = getattr(dom, "observe", None)
+    if obs is not None and hasattr(obs, "set_gauge"):
+        with _LOCK:
+            _SINKS.add(obs)
+
+
+def _publish_gauges():
+    with _LOCK:
+        if not _SINKS:
+            return
+        sinks = list(_SINKS)
+        vals = {
+            "sched_queue_depth": _QUEUED_N[0],
+            "sched_admission_waits_ms": round(
+                STATS["sched_admission_waits_ms"], 1),
+            "sched_batched_fragments": STATS["sched_batched_fragments"],
+        }
+        per_group = {f"sched_degradations:{g}": n
+                     for g, n in _DEGRADATIONS.items()}
+    vals.update(per_group)
+    for obs in sinks:
+        try:
+            for k, v in vals.items():
+                obs.set_gauge(k, v)
+        except Exception:
+            pass
+
+
+def verify_drained() -> dict:
+    """Chaos invariant: once traffic stops, no ticket is leaked — the
+    queue is empty and nothing is left RUNNING (every admit() was paired
+    with a release() or a clean rejection)."""
+    with _LOCK:
+        queued = _QUEUED_N[0]
+        running = dict(_RUNNING)
+        accounted = (STATS["rejected_full"] + STATS["rejected_timeout"]
+                     + STATS["rejected_injected"] + STATS["admitted"])
+        started = STATS["fast_grants"] + STATS["queued"] \
+            + STATS["rejected_full"] + STATS["rejected_injected"]
+        return {"ok": queued == 0 and not running,
+                "queued": queued, "running": running,
+                "admitted": STATS["admitted"], "accounted": accounted,
+                "started": started}
+
+
+def reset_for_tests():
+    """Drop queues/counters (unit tests only — never under live traffic)."""
+    with _LOCK:
+        _QUEUES.clear()
+        _QUEUED_N[0] = 0
+        _RUNNING.clear()
+        _VTIME.clear()
+        _DEGRADATIONS.clear()
+        for k in STATS:
+            STATS[k] = 0.0 if k == "sched_admission_waits_ms" else 0

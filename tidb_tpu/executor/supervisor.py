@@ -99,7 +99,7 @@ _SINKS: "weakref.WeakSet" = weakref.WeakSet()
 
 class _Job:
     __slots__ = ("fn", "args", "kw", "done", "result", "exc", "orphaned",
-                 "tls", "label")
+                 "tls", "label", "group")
 
     def __init__(self, fn, args, kw, label):
         self.fn = fn
@@ -111,6 +111,10 @@ class _Job:
         self.orphaned = False  # waiter gave up: discard result, re-pool
         self.tls = None        # worker-thread stats bridged to the waiter
         self.label = label
+        # the dispatching session's resource group: bridged onto the
+        # worker thread so residency charges supervised uploads to the
+        # right tenant (ops/residency per-group shares), not "default"
+        self.group = "default"
 
 
 class _Worker(threading.Thread):
@@ -138,6 +142,11 @@ class _Worker(threading.Thread):
                 st0 = _tls_begin()
             except Exception:
                 st0 = None
+            try:
+                from ..ops import residency
+                residency.set_group(job.group)
+            except Exception:
+                pass
             try:
                 job.result = job.fn(*job.args, **job.kw)
             except BaseException as e:  # noqa: BLE001 — re-raised in waiter
@@ -429,6 +438,11 @@ def call_supervised(fn, args=(), kw=None, *, deadline_s: float = 0.0,
     _register_sink(ctx)
     label = label or getattr(fn, "__name__", "device call")
     job = _Job(fn, args, kw, label)
+    try:
+        from ..ops import residency
+        job.group = residency.current_group()
+    except Exception:
+        pass
     with _LOCK:
         STATS["supervised"] += 1
     _get_worker().inbox.put(job)

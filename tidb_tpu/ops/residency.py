@@ -40,6 +40,14 @@ Three jobs, one lock:
    emptied device, and only then let the existing per-shape circuit
    breaker degrade to the host engine.
 
+**Tenancy (ISSUE 6)**: every entry is charged to the resource group of
+the session that uploaded it (``tidb_resource_group``, bridged onto
+supervisor worker threads), and ``tidb_device_mem_budget`` is enforced
+as per-group SHARES under pressure: a tenant over its share evicts its
+OWN cold entries before touching another tenant's (see
+`_enforce_budget_locked`), so one tenant's upload storm cannot flush a
+well-behaved neighbor's working set.
+
 All ``._device`` reads/writes live in THIS module (AST-linted in
 tests/test_residency.py) so HBM caching can never silently escape the
 ledger.  Gauges — ``hbm_bytes_cached``, ``hbm_evictions``,
@@ -68,6 +76,19 @@ _EPOCH = [0]
 
 #: resident bytes ledger (sum of every live entry's nbytes)
 _BYTES = [0]
+
+#: per-tenant slice of the ledger: resource group -> resident bytes.
+#: Each entry is charged to the group that uploaded it (the session's
+#: ``tidb_resource_group``, bridged per-dispatch via attach()), so the
+#: budget can be enforced as per-group SHARES: a tenant over its share
+#: evicts its OWN cold entries before touching another tenant's.
+_GROUP_BYTES: "collections.Counter" = collections.Counter()
+
+DEFAULT_GROUP = "default"
+
+#: the uploading thread's resource group (set by attach() before each
+#: dispatch; worker threads inherit "default" when nothing attached)
+_TLS = threading.local()
 
 #: configured budget in bytes (from tidb_device_mem_budget); 0 = auto
 _BUDGET = [0]
@@ -114,14 +135,16 @@ class _Resident:
 class _Entry:
     """Ledger entry for one cached upload: a weakref back to the owning
     Column (to clear its slot on eviction, and to release the bytes when
-    the owner is garbage-collected) plus the byte charge."""
+    the owner is garbage-collected) plus the byte charge and the resource
+    group it is charged to."""
 
-    __slots__ = ("ref", "nbytes", "token")
+    __slots__ = ("ref", "nbytes", "token", "group")
 
-    def __init__(self, ref, nbytes, token):
+    def __init__(self, ref, nbytes, token, group=DEFAULT_GROUP):
         self.ref = ref
         self.nbytes = nbytes
         self.token = token
+        self.group = group
 
 
 def _nbytes(arr) -> int:
@@ -188,6 +211,23 @@ def attach(ctx):
     if obs is not None and hasattr(obs, "set_gauge"):
         with _LOCK:
             _SINKS.add(obs)
+    # tenant identity for the uploads this dispatch will publish (the
+    # session's tidb_resource_group, SESSION scope — tenancy is per
+    # connection; the supervisor bridges it onto worker threads)
+    try:
+        set_group(str(ctx.get_sysvar("tidb_resource_group")).strip()
+                  or DEFAULT_GROUP)
+    except Exception:
+        set_group(DEFAULT_GROUP)
+
+
+def set_group(group: str):
+    """Charge subsequent publishes on THIS thread to `group`."""
+    _TLS.group = group or DEFAULT_GROUP
+
+
+def current_group() -> str:
+    return getattr(_TLS, "group", DEFAULT_GROUP)
 
 
 def set_budget(n: int):
@@ -271,16 +311,18 @@ def publish(col, data, nulls):
             if cur is not None:
                 _evict_token_locked(cur.token)
             token = next(_SEQ)
+            group = current_group()
             res = _Resident(data, nulls, rows, _EPOCH[0], nbytes, token)
             col._device = res
             try:
                 ref = weakref.ref(col, _make_gc_cb(token))
             except TypeError:
                 ref = None  # owner not weakref-able: entry lives forever
-            _ENTRIES[token] = _Entry(ref, nbytes, token)
+            _ENTRIES[token] = _Entry(ref, nbytes, token, group)
             _BYTES[0] += nbytes
+            _GROUP_BYTES[group] += nbytes
             STATS["uploads"] += 1
-            _enforce_budget_locked(keep_token=token)
+            _enforce_budget_locked(keep_token=token, group=group)
             out = data, nulls
     _publish_gauges()
     return out
@@ -292,8 +334,15 @@ def _make_gc_cb(token):
             ent = _ENTRIES.pop(_token, None)
             if ent is not None:
                 _BYTES[0] -= ent.nbytes
+                _drop_group_bytes_locked(ent.group, ent.nbytes)
                 STATS["gc_releases"] += 1
     return _cb
+
+
+def _drop_group_bytes_locked(group: str, nbytes: int):
+    _GROUP_BYTES[group] -= nbytes
+    if _GROUP_BYTES[group] <= 0:
+        del _GROUP_BYTES[group]
 
 
 # -- eviction ----------------------------------------------------------------
@@ -303,6 +352,7 @@ def _evict_token_locked(token: int):
     if ent is None:
         return
     _BYTES[0] -= ent.nbytes
+    _drop_group_bytes_locked(ent.group, ent.nbytes)
     STATS["hbm_evictions"] += 1
     STATS["hbm_evicted_bytes"] += ent.nbytes
     col = ent.ref() if ent.ref is not None else None
@@ -312,19 +362,62 @@ def _evict_token_locked(token: int):
             col._device = None
 
 
-def _enforce_budget_locked(keep_token: int):
-    """Evict LRU-first until under budget.  `keep_token` (the entry just
-    published) is exempt: the working set of the CURRENT fragment must
-    not be evicted out from under its own dispatch."""
+def group_share() -> int:
+    """Each active tenant's slice of the budget in bytes (0 = no budget):
+    the budget divided equally among the groups that currently hold
+    resident entries.  A lone tenant keeps the whole budget — shares are
+    a pressure-time fairness rule, not a static partition."""
+    with _LOCK:
+        return _group_share_locked()
+
+
+def _group_share_locked() -> int:
+    budget = effective_budget()
+    if budget <= 0:
+        return 0
+    return budget // max(len(_GROUP_BYTES), 1)
+
+
+def _enforce_budget_locked(keep_token: int, group: str = DEFAULT_GROUP):
+    """Evict until under budget — SELF-FIRST, then over-share, then
+    global LRU.  `keep_token` (the entry just published) is exempt: the
+    working set of the CURRENT fragment must not be evicted out from
+    under its own dispatch.
+
+    Tenancy rule (ISSUE 6): one tenant's uploads evict its OWN cold
+    entries before touching another tenant's — as long as the uploader
+    holds more than its per-group share of the budget, its own LRU pays
+    first.  Only when every group is back within its share (or the
+    uploader has nothing left to give) does eviction fall back to the
+    over-share groups and finally plain global LRU."""
     budget = effective_budget()
     if budget <= 0:
         return
-    while _BYTES[0] > budget:
+    share = _group_share_locked()
+    # phase 1 — self-first: the uploading tenant over its share evicts
+    # its own cold entries (other tenants' working sets are protected)
+    while (_BYTES[0] > budget and _GROUP_BYTES.get(group, 0) > share):
         victim = None
-        for token in _ENTRIES:  # oldest first
-            if token != keep_token:
+        for token, ent in _ENTRIES.items():  # oldest first
+            if token != keep_token and ent.group == group:
                 victim = token
                 break
+        if victim is None:
+            break
+        _evict_token_locked(victim)
+    # phase 2 — over-share tenants LRU-first, then global LRU
+    while _BYTES[0] > budget:
+        victim = None
+        fallback = None
+        for token, ent in _ENTRIES.items():  # oldest first
+            if token == keep_token:
+                continue
+            if fallback is None:
+                fallback = token
+            if _GROUP_BYTES.get(ent.group, 0) > share:
+                victim = token
+                break
+        victim = victim if victim is not None else fallback
         if victim is None:
             if _BYTES[0] > budget:
                 log.warning(
@@ -388,6 +481,8 @@ def snapshot() -> dict:
             "hbm_bytes_cached": _BYTES[0],
             "entries": len(_ENTRIES),
             "budget_bytes": effective_budget(),
+            "by_group": dict(_GROUP_BYTES),
+            "group_share_bytes": _group_share_locked(),
             **STATS,
         }
 
@@ -408,11 +503,23 @@ def report_gauges() -> dict:
 
 def verify_ledger() -> dict:
     """Recompute the ledger from live entries (chaos-harness invariant:
-    no budget-counter drift).  Returns {"ok", "ledger", "recomputed"}."""
+    no budget-counter drift), INCLUDING the per-tenant slices: the group
+    counters must sum from the live entries exactly, and their total must
+    equal the global ledger.  Returns {"ok", "ledger", "recomputed",
+    "by_group", "by_group_recomputed"}."""
+    import collections as _c
     with _LOCK:
         recomputed = sum(e.nbytes for e in _ENTRIES.values())
-        return {"ok": recomputed == _BYTES[0] and _BYTES[0] >= 0,
-                "ledger": _BYTES[0], "recomputed": recomputed}
+        by_group_rec = _c.Counter()
+        for e in _ENTRIES.values():
+            by_group_rec[e.group] += e.nbytes
+        groups_ok = (dict(by_group_rec) == dict(_GROUP_BYTES)
+                     and sum(_GROUP_BYTES.values()) == _BYTES[0])
+        return {"ok": (recomputed == _BYTES[0] and _BYTES[0] >= 0
+                       and groups_ok),
+                "ledger": _BYTES[0], "recomputed": recomputed,
+                "by_group": dict(_GROUP_BYTES),
+                "by_group_recomputed": dict(by_group_rec)}
 
 
 def _publish_gauges():

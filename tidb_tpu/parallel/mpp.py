@@ -132,20 +132,34 @@ def _supervised_step(step, ctx):
     no deadline) the step dispatches inline, unchanged.
 
     Note the SQL path's MPP fragments don't come through here — they are
-    built by executor/mpp_exec.py and supervised one level up, inside
-    run_device.  The `ctx=` hook exists for direct library embedders of
-    dist_agg_step / dist_join_agg_step, who otherwise have no supervised
-    wrapper between them and a hung collective (tests/test_mpp.py
-    exercises it)."""
+    built by executor/mpp_exec.py and admitted + supervised one level up,
+    inside run_device.  The `ctx=` hook exists for direct library
+    embedders of dist_agg_step / dist_join_agg_step, who otherwise have
+    no supervised wrapper between them and a hung collective
+    (tests/test_mpp.py exercises it).  The embedder path holds an
+    ADMISSION ticket too (executor/scheduler.py — every MPP dispatch
+    enqueues a fragment ticket): a refusal surfaces as the classified
+    DeviceAdmissionError (9009) since there is no host fallback at this
+    level to degrade to."""
     if ctx is None:
         return step
 
     def call(*args, **kw):
+        from ..executor import scheduler
         from ..executor.supervisor import call_supervised, deadline_for
-        deadline_s, fence = deadline_for(ctx)
-        return call_supervised(step, args, kw, deadline_s=deadline_s,
-                               ctx=ctx, shape="mpp", label="mpp exchange",
-                               fence_on_expiry=fence)
+        ticket = scheduler.admit(ctx, shape="mpp")
+        try:
+            # deadline AFTER the admission wait (run_device's ordering):
+            # the supervised window must reflect what remains of
+            # max_execution_time once the ticket is granted, or a queued
+            # step runs past the statement bound by the whole wait
+            deadline_s, fence = deadline_for(ctx)
+            return call_supervised(step, args, kw, deadline_s=deadline_s,
+                                   ctx=ctx, shape="mpp",
+                                   label="mpp exchange",
+                                   fence_on_expiry=fence)
+        finally:
+            scheduler.release(ticket)
 
     return call
 

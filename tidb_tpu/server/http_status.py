@@ -87,7 +87,7 @@ class StatusServer:
     # -- payloads ------------------------------------------------------------
 
     def _status(self):
-        from ..executor import supervisor
+        from ..executor import scheduler, supervisor
         from ..ops import residency
         return {
             "version": "8.0.11-tpu-htap",
@@ -102,6 +102,13 @@ class StatusServer:
             # budget, epoch and the eviction / OOM-recovery counters —
             # device memory pressure diagnosable from the status port
             "device_residency": residency.snapshot(),
+            # serving scheduler (executor/scheduler.py): admission queue
+            # depth, per-tenant running counts / degradations, WFQ state
+            "device_scheduler": scheduler.snapshot(),
+            # breaker stat lines keyed by (shape, resource group)
+            "device_breakers": {
+                shape: br.snapshot() for shape, br in
+                getattr(self.domain, "_device_breakers", {}).items()},
         }
 
     def _metrics(self):
@@ -122,6 +129,32 @@ class StatusServer:
         gauges.setdefault("hbm_bytes_cached", rs["hbm_bytes_cached"])
         gauges.setdefault("hbm_evictions", rs["hbm_evictions"])
         gauges.setdefault("hbm_oom_recoveries", rs["hbm_oom_recoveries"])
+        from ..executor import scheduler
+        ss = scheduler.snapshot()
+        gauges.setdefault("sched_queue_depth", ss["sched_queue_depth"])
+        gauges.setdefault("sched_admission_waits_ms",
+                          ss["sched_admission_waits_ms"])
+        gauges.setdefault("sched_batched_fragments",
+                          ss["sched_batched_fragments"])
+        # per-tenant degradations as ONE labeled series (a single TYPE
+        # header — duplicate TYPE lines are invalid text exposition and
+        # fail the whole scrape); the observe-sink mirror keys them
+        # "sched_degradations:<group>", folded in here
+        per_group = dict(ss["degradations_by_group"])
+        for name in [k for k in gauges if
+                     k.startswith("sched_degradations:")]:
+            per_group.setdefault(name.split(":", 1)[1], gauges[name])
+            del gauges[name]
+        if per_group:
+            lines.append("# TYPE sched_degradations gauge")
+            for g, n in sorted(per_group.items()):
+                # label escaping per the exposition format: the group
+                # name is a free-form session sysvar, and one raw quote
+                # or newline would invalidate the WHOLE scrape
+                esc = (str(g).replace("\\", r"\\").replace('"', r'\"')
+                       .replace("\n", r"\n"))
+                lines.append(
+                    f'sched_degradations{{resource_group="{esc}"}} {n}')
         for name, val in sorted(gauges.items()):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {val}")

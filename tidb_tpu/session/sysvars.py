@@ -245,6 +245,28 @@ for _v in [
     # breaker knobs: the ledger is process-wide, so a session-scoped SET
     # must not clobber the budget another session configured
     SysVar("tidb_device_mem_budget", SCOPE_BOTH, "0", "int", 0),
+    # -- serving front end (executor/scheduler.py) ----------------------
+    # the session's tenant identity for device admission, WFQ scheduling,
+    # per-tenant residency shares and breaker/scheduler stat lines
+    SysVar("tidb_resource_group", SCOPE_SESSION, "default", "str"),
+    # bounded fragment-admission queue depth (total queued tickets across
+    # all tenants); a full queue refuses admission with a classified
+    # DeviceAdmissionError (9009) and the fragment degrades to the host
+    # engine. 0 disables the admission layer entirely (pass-through).
+    # GLOBAL-scope read, same discipline as the breaker/residency knobs
+    SysVar("tidb_device_sched_queue_depth", SCOPE_BOTH, "64", "int", 0,
+           100000),
+    # seconds a fragment may wait in the admission queue before the
+    # refusal (9009) degrades it to the host engine; 0 = wait forever
+    SysVar("tidb_device_admission_timeout", SCOPE_BOTH, "5", "float", 0),
+    # max fragments of ONE resource group running on the device at once
+    # (0 = unlimited): a heavy analytical tenant cannot occupy every slot
+    SysVar("tidb_device_tenant_running_cap", SCOPE_BOTH, "4", "int", 0,
+           10000),
+    # WFQ weights, "group:weight,group2:weight" (unlisted groups weigh 1):
+    # each grant advances the tenant's virtual clock by 1/weight, lowest
+    # clock goes next — heavier tenants get proportionally more slots
+    SysVar("tidb_device_wfq_weights", SCOPE_BOTH, "", "str"),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
            str(100 * 1024 * 1024), "int", 0),
     SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH,

@@ -25,6 +25,9 @@ Error taxonomy (classify()): the classes the distributed path can see —
     hang       a supervised device call blew its wall-clock deadline
                (executor/supervisor.py — the backend froze inside a
                GIL-holding C call, distinct from a device that ERRORS)
+    admission  the serving scheduler refused the fragment a device slot
+               (executor/scheduler.py — load pressure, not ill-health:
+               the fragment degrades to the host engine)
     fault      an injected failpoint fired
     other      anything unclassified
 """
@@ -49,6 +52,7 @@ CLASS_EXCHANGE = "exchange"
 CLASS_DEVICE = "device"
 CLASS_TRANSPORT = "transport"
 CLASS_HANG = "hang"
+CLASS_ADMISSION = "admission"
 CLASS_FAULT = "fault"
 CLASS_OTHER = "other"
 
@@ -85,9 +89,11 @@ def classify(err) -> str:
     """Map an exception to its resilience class (one label the breaker,
     the backoffer and the slow log all agree on)."""
     from .failpoint import FailpointError
-    from ..errors import DeviceHangError
+    from ..errors import DeviceAdmissionError, DeviceHangError
     if isinstance(err, DeviceHangError):
         return CLASS_HANG
+    if isinstance(err, DeviceAdmissionError):
+        return CLASS_ADMISSION
     if isinstance(err, (LockedError, WriteConflictError, DeadlockError,
                         SchemaChangedError)):
         return CLASS_REGION

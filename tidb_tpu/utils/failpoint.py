@@ -9,6 +9,8 @@ manager, which cannot leak an active failpoint past the test:
     failpoint.enable("backfill-batch", "sleep(0.05)")
     failpoint.enable("scan-rows", "return(7)")
     failpoint.enable("device-upload-oom", "2*oom")
+    failpoint.enable("device-admission", "admission-queue-full")
+    failpoint.enable("device-admission", "2*admission-wait(0.05)")
     with failpoint.enabled("txn-before-commit", "2*panic"):
         ...
 
@@ -29,6 +31,15 @@ import time
 
 class FailpointError(Exception):
     """Raised by an enabled `panic` failpoint."""
+
+
+class InjectedAdmissionError(Exception):
+    """Raised by an enabled ``admission-queue-full`` failpoint: a
+    synthetic scheduler refusal.  The admission layer
+    (executor/scheduler.py) converts it into the real classified
+    DeviceAdmissionError so the injected refusal walks the genuine
+    degrade-to-host ladder.  Deliberately NOT a FailpointError: that
+    would classify ``fault`` instead of ``admission``."""
 
 
 class InjectedOOMError(Exception):
@@ -109,6 +120,16 @@ def inject(name: str):
         #   — models transient HBM pressure the evict+retry ladder absorbs
         if hit <= int(m.group(1)):
             raise InjectedOOMError(_oom_message(name))
+        return None
+    if action == "admission-queue-full":
+        raise InjectedAdmissionError(
+            f"admission queue full (injected by failpoint {name})")
+    m = re.fullmatch(r"(?:(\d+)\*)?admission-wait\(([\d.]+)\)", action)
+    if m:  # [N*]admission-wait(s): stall admission for the first N hits
+        #   (all hits when N omitted) — models a contended queue; the
+        #   scheduler counts the stall into sched_admission_waits_ms
+        if m.group(1) is None or hit <= int(m.group(1)):
+            time.sleep(float(m.group(2)))
         return None
     m = re.fullmatch(r"sleep\(([\d.]+)\)", action)
     if m:
