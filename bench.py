@@ -933,6 +933,11 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
             # a bench line that ran under device-memory pressure says so
             from tidb_tpu.ops import residency as _res
             compile_info.update(_res.report_gauges())
+            # MPP mesh gauges (executor/mpp_exec.py): placement-cache
+            # bytes + fragment/retry counters once the mesh path has run
+            # — a bench line that paid an exchange recompile says so
+            from tidb_tpu.executor import mpp_exec as _mpp
+            compile_info.update(_mpp.report_gauges())
             if _WARM_LOCK_MISSES[0] > wm0:
                 # a timed run raced the keep-warm dispatch: the numbers
                 # are contended — mark them so history comparisons skip

@@ -34,6 +34,10 @@ def pytest_configure(config):
         "markers", "chaos_threads: concurrent (multi-threaded) chaos runs"
         " with invariant-only checks (tests/test_chaos.py; deepen locally"
         " with CHAOS_THREAD_SEEDS=n CHAOS_THREADS=n)")
+    config.addinivalue_line(
+        "markers", "multichip: MPP mesh-path tests that need the 8-device"
+        " virtual CPU platform this conftest forces"
+        " (XLA_FLAGS=--xla_force_host_platform_device_count=8)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,53 @@
+"""AOT compile-cache host fingerprinting (satellite, MULTICHIP_r05
+finding): XLA:CPU's persistent-cache key ignores host CPU features, so an
+artifact compiled on another machine loads with a ~3KB "could lead to
+SIGILL" warning per program and mis-tuned code. The cache directory —
+default AND explicit TIDB_TPU_JAX_CACHE=<dir> — is scoped by a
+(cpu-flags, machine-arch, jax-version) fingerprint subdirectory, making
+mismatched artifacts unreachable: they are skipped silently, never loaded
+with a warning flood."""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+import tidb_tpu
+
+
+class TestHostFingerprint:
+    def test_stable_and_hexish(self):
+        fp = tidb_tpu._host_fingerprint()
+        assert fp == tidb_tpu._host_fingerprint()
+        assert len(fp) == 12
+        assert all(c in "0123456789abcdef" for c in fp)
+
+    def test_this_process_cache_dir_is_fingerprint_scoped(self):
+        cache_dir = jax.config.jax_compilation_cache_dir
+        if not cache_dir:
+            # operator opted out (TIDB_TPU_JAX_CACHE=off) or config
+            # failed: nothing to scope
+            assert os.environ.get("TIDB_TPU_JAX_CACHE") == "off"
+            return
+        assert os.path.basename(cache_dir) == tidb_tpu._host_fingerprint()
+
+    def test_explicit_dir_is_scoped_too(self, tmp_path):
+        """A SHARED explicit cache dir (network mount) must still key by
+        host fingerprint: artifacts a different machine wrote land in a
+        sibling subdirectory and can never be picked up here."""
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import tidb_tpu, jax; "
+             "print(jax.config.jax_compilation_cache_dir); "
+             "print(tidb_tpu._host_fingerprint())"],
+            env={**os.environ, "TIDB_TPU_JAX_CACHE": str(tmp_path),
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120, check=True)
+        cache_dir, fp = out.stdout.strip().splitlines()[-2:]
+        assert cache_dir == os.path.join(str(tmp_path), fp)
+        # a foreign machine's artifacts would sit in a DIFFERENT subdir:
+        # same parent, disjoint leaf — unreachable by construction
+        foreign = os.path.join(str(tmp_path), "0" * 12)
+        assert foreign != cache_dir
+        assert os.path.dirname(foreign) == os.path.dirname(cache_dir)

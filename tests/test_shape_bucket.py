@@ -166,6 +166,45 @@ class TestRecompileRegression:
         assert _traces() == t0, \
             "fact-side within-bucket delta re-traced the join fragment"
 
+    def test_build_side_delta_zero_recompile_within_bucket(self):
+        """The LAST recompile trigger (ROADMAP item 1): a build-side
+        INSERT changes the join index's row count — n_valid now rides as
+        a TRACED scalar over bucket-padded index arrays, so a
+        within-bucket (and within-quantized-pack-range) build delta
+        rebuilds only the cheap numpy index and reuses the compiled
+        fragment."""
+        tk = TestKit()
+        _install_fact(tk, "jb", 2000, n_keys=50)
+        # SPARSE dim keys (2..100 even): a later odd-key INSERT stays
+        # inside the quantized pack range AND keeps the build unique
+        tk.must_exec("create table jbd (k bigint primary key, "
+                     "g varchar(8))")
+        for i in range(1, 51):
+            tk.must_exec(f"insert into jbd values ({2 * i}, 'g{i % 5}')")
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+        q = ("select jbd.g, sum(jb.v) from jb join jbd on jb.k = jbd.k "
+             "group by jbd.g order by jbd.g")
+        cold = tk.must_query(q).rows
+        assert tk.must_query(q).rows == cold  # learned-size settle
+        t0 = _traces()
+        assert tk.must_query(q).rows == cold  # steady state
+        assert _traces() == t0
+        # BUILD-side delta: key 31 is absent, odd, inside [2,100] (the
+        # quantized pack range), 'g1' already in the dictionary; 50→51
+        # index entries stays inside the rows bucket (64) and the leaf
+        # bucket — the index rebuilds host-side, the program re-dispatches
+        tk.must_exec("insert into jbd values (31, 'g1')")
+        host = None
+        try:
+            tk.must_exec("set tidb_executor_engine = 'host'")
+            host = tk.must_query(q).rows
+        finally:
+            tk.must_exec("set tidb_executor_engine = 'tpu'")
+        got = tk.must_query(q).rows
+        assert got == host and got != cold
+        assert _traces() == t0, \
+            "build-side within-bucket delta re-traced the join fragment"
+
 
 # ---------------------------------------------------------------------------
 # padding invariants: padded rows never escape

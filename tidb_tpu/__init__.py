@@ -30,32 +30,51 @@ _jax.config.update("jax_enable_x64", True)
 # once per process — the reference's prepared-plan amortization idea
 # (planner/core/cache.go) applied at the XLA layer. Opt out with
 # TIDB_TPU_JAX_CACHE=off; override the location with TIDB_TPU_JAX_CACHE=<dir>.
+
+
+def _host_fingerprint() -> str:
+    """Host-machine-feature fingerprint scoping the AOT compile cache.
+
+    The XLA:CPU cache key ignores host CPU features: an AOT entry
+    compiled on a different machine (or by a different jax) loads with a
+    ~3KB "could lead to SIGILL" warning PER PROGRAM and mis-tuned code
+    (observed cross-machine in MULTICHIP_r05: mismatched feature sets on
+    every load). Keying the cache directory by (cpu flags, machine arch,
+    jax version) makes a mismatched artifact UNREACHABLE — stale entries
+    are skipped silently because another host simply writes to a
+    different subdirectory. NOTE: same-host entries can still print the
+    loader's mismatch warning — XLA bakes option pseudo-features
+    (+prefer-no-scatter/+prefer-no-gather) into the compile target and
+    the loader's naive comparison flags them against the real host flag
+    set; those entries ARE this machine's and are safe (and the warning
+    stream is silenced via TF_CPP_MIN_LOG_LEVEL above). The fingerprint
+    guards the cross-machine case only."""
+    import hashlib as _hl
+    import platform as _pl
+    try:
+        with open("/proc/cpuinfo") as _f:
+            _flags = next((ln for ln in _f if ln.startswith("flags")), "")
+    except OSError:
+        _flags = ""
+    return _hl.sha1(
+        (_flags + _pl.machine() + _jax.__version__).encode()
+    ).hexdigest()[:12]
+
+
 _cache_dir = _os.environ.get("TIDB_TPU_JAX_CACHE", "")
 if _cache_dir != "off":
+    # EVERY cache location — the default AND an explicit
+    # TIDB_TPU_JAX_CACHE=<dir> (typically a network share) — is scoped by
+    # the host fingerprint subdirectory: a shared dir populated by a
+    # machine with a different feature set can never serve its artifacts
+    # here (they'd load "could lead to SIGILL"-style), they are skipped
+    # silently by construction.
     if not _cache_dir:
-        # the XLA:CPU cache key ignores host CPU features: an AOT entry
-        # compiled on a different machine (or by a different jax) loads
-        # here with a "could lead to SIGILL" warning and mis-tuned code.
-        # Scope the default dir by a host fingerprint so such entries
-        # can never be picked up. NOTE: same-host entries still print the
-        # loader's mismatch warning — XLA bakes option pseudo-features
-        # (+prefer-no-scatter/+prefer-no-gather) into the compile target
-        # and the loader's naive comparison flags them against the real
-        # host flag set; those entries ARE this machine's and are safe.
-        # The fingerprint guards the cross-machine case only.
-        try:
-            import hashlib as _hl
-            with open("/proc/cpuinfo") as _f:
-                _flags = next((ln for ln in _f if ln.startswith("flags")),
-                              "")
-            _fp = _hl.sha1(
-                (_flags + _jax.__version__).encode()).hexdigest()[:12]
-        except OSError:
-            _fp = "default"
         _cache_dir = _os.path.join(
             _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-            ".jaxcache", _fp)
+            ".jaxcache")
     try:
+        _cache_dir = _os.path.join(_cache_dir, _host_fingerprint())
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         # cache every fragment: the default 1s/small-entry filters would

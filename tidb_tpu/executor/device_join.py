@@ -450,11 +450,15 @@ def _strategy_sig(jn):
     if st is None:
         return f"S{jn.pos}:-"
     kind, side, idx = st
-    # n_valid is load-bearing: the compiled fragment bakes it into clip
-    # bounds and the lo < n_valid guard, so two indexes differing only in
-    # their null count must never share a pipeline
+    # n_valid is a TRACED runtime input (it rides in jidx next to the
+    # lookup arrays) and the arrays pad to geometric buckets, so the
+    # signature carries only the BUCKETED shape identity (rows_len +
+    # dtype) and the structural unique flag — a within-bucket build-side
+    # INSERT rebuilds the cheap numpy index and reuses the compiled
+    # program with zero new XLA compiles (the last recompile trigger,
+    # ROADMAP item 1)
     return (f"S{jn.pos}:{kind}/{side}/{idx.kind}/{idx.packs}/"
-            f"{int(idx.unique)}/{idx.n_rows}/{idx.n_valid}")
+            f"{int(idx.unique)}/{idx.rows_len}/{idx.rows.dtype}")
 
 
 #: learned exact sizes per fragment: (sig, join_pos) → last observed match
@@ -707,9 +711,10 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
             kds, knulls = zip(*[
                 dev.broadcast_1d(*f(penv), n_probe) for f in key_fns_p])
             key, ok = _pack_probe(kds, knulls, pvalid, idx.packs)
-            a0, a1 = jidx[node.pos]
-            nv = idx.n_valid
-            safe_hi = max(nv - 1, 0)
+            # nv is TRACED (a same-bucket index refresh re-dispatches
+            # without retracing); every bound derived from it is traced
+            a0, a1, nv = jidx[node.pos]
+            safe_hi = jnp.maximum(nv - 1, 0)
             if idx.kind == "dense":
                 k_c = jnp.clip(key, 0, idx.span - 1)
                 pos0 = a0[k_c].astype(jnp.int64)

@@ -95,6 +95,12 @@ class QueryExecutor:
             # they have fired — "did this query contend for the device"
             from . import scheduler
             self.annotate(**scheduler.report_gauges())
+            # MPP mesh path (executor/mpp_exec.py): placement-cache bytes
+            # plus fragment/retry counters (incl. the radix-exchange
+            # overflow retries) once the mesh path has ever run — "did
+            # this query pay an exchange capacity recompile"
+            from . import mpp_exec
+            self.annotate(**mpp_exec.report_gauges())
         return out
 
 

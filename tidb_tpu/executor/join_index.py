@@ -43,11 +43,27 @@ only the (cheap, numpy) host index and re-uses the compiled fragment:
 the lookup arrays are passed as runtime arguments, so same shapes ⇒ same
 program.  Correctness is unaffected — probe keys in the slack region
 simply find zero matches, exactly like any other unmatched key.
+
+Bucketed shapes + traced n_valid (ROADMAP item 1, the LAST recompile
+trigger): the row-id array (and the sorted-key array) pads to a
+geometric bucket (ops/device.py bucket_rows) and the live entry count
+``n_valid`` rides to the device as a TRACED scalar in the ``jidx``
+runtime arguments — never baked into the compiled program.  A build-side
+INSERT that stays inside the bucket (and inside the quantized pack
+range) rebuilds only this cheap numpy index: same array shapes, same
+fragment signature, same compiled executable, zero new XLA compiles.
+Padding is inert by construction: ``rows`` pads with 0 (only reachable
+behind a ``cnt`` guard that is 0 there) and ``sorted_keys`` pads with
+int64 max (sorts after every real key, so probe searchsorted results
+for real keys are unchanged and the ``lo < n_valid`` guard kills the
+sentinel region).
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from ..ops.device import bucket_rows
 
 #: dense CSR is worth it while the span stays within this factor of the
 #: row count (beyond that the starts array dwarfs the table)
@@ -75,20 +91,23 @@ class JoinIndex:
 
     __slots__ = ("kind", "packs", "unique", "n_rows", "n_valid", "span",
                  "starts", "rows", "sorted_keys", "avg_cnt", "max_cnt",
-                 "_dev")
+                 "rows_len", "_dev")
 
     def __init__(self):
         self._dev = None
 
     def device_arrays(self):
-        """Upload (lazily, once) and return the jnp lookup arrays."""
+        """Upload (lazily, once) and return the (a0, a1, n_valid) lookup
+        tuple the compiled fragment takes as runtime arguments: the CSR
+        starts / sorted keys, the bucket-padded row ids, and the live
+        entry count as a TRACED scalar (np.int64, the n_lives
+        convention) — a same-shape index refresh re-dispatches the
+        compiled program without retracing."""
         if self._dev is None:
             import jax.numpy as jnp
-            if self.kind == "dense":
-                self._dev = (jnp.asarray(self.starts), jnp.asarray(self.rows))
-            else:
-                self._dev = (jnp.asarray(self.sorted_keys),
-                             jnp.asarray(self.rows))
+            a0 = self.starts if self.kind == "dense" else self.sorted_keys
+            self._dev = (jnp.asarray(a0), jnp.asarray(self.rows),
+                         np.int64(self.n_valid))
         return self._dev
 
 
@@ -167,6 +186,18 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
     packed = _pack_host(datas, valid, packs)
 
     row_dt = np.int32 if nb < (1 << 31) else np.int64
+    # geometric BUCKET for the row-id (and sorted-key) array shapes: a
+    # within-bucket build delta keeps every traced shape — the default
+    # granularity (2 buckets per doubling) is fixed here because the
+    # index is cached per table version, not per session
+    pad_len = bucket_rows(max(n_valid, 1))
+    idx.rows_len = pad_len
+
+    def _pad_rows(arr):
+        out = np.zeros(pad_len, dtype=row_dt)
+        out[:len(arr)] = arr
+        return out
+
     if span_total <= max(_DENSE_SLACK * nb, _DENSE_FLOOR):
         idx.kind = "dense"
         counts = np.bincount(packed[valid], minlength=span_total)
@@ -178,8 +209,7 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
         sort_key = np.where(valid, packed, np.int64(span_total))
         order = np.argsort(sort_key, kind="stable")
         idx.starts = starts
-        idx.rows = (order[:n_valid] if n_valid else
-                    np.zeros(1, dtype=np.int64)).astype(row_dt)
+        idx.rows = _pad_rows(order[:n_valid])
         idx.max_cnt = int(counts.max(initial=0))
         idx.unique = idx.max_cnt <= 1
         idx.sorted_keys = None
@@ -189,10 +219,14 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
         sort_key = np.where(valid, packed, np.iinfo(np.int64).max)
         order = np.argsort(sort_key, kind="stable")
         sk = sort_key[order[:n_valid]] if n_valid else np.zeros(
-            1, dtype=np.int64)
-        idx.sorted_keys = sk
-        idx.rows = (order[:n_valid] if n_valid else
-                    np.zeros(1, dtype=np.int64)).astype(row_dt)
+            0, dtype=np.int64)
+        # int64-max sentinels on the pad tail sort after every real key:
+        # probe searchsorted positions for real keys are unchanged, and
+        # the traced lo < n_valid guard excludes the sentinel region
+        idx.sorted_keys = np.concatenate(
+            [sk, np.full(pad_len - n_valid, np.iinfo(np.int64).max,
+                         dtype=np.int64)])
+        idx.rows = _pad_rows(order[:n_valid])
         idx.starts = None
         idx.unique = bool(n_valid <= 1 or not np.any(sk[1:] == sk[:-1]))
         n_distinct = (1 + int(np.count_nonzero(sk[1:] != sk[:-1]))

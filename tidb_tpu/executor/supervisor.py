@@ -335,7 +335,10 @@ def _reinit_backend():
         pass
     try:
         from . import mpp_exec
-        mpp_exec._PLACE_CACHE.clear()
+        # under the placement lock: _place_col's locked check/popitem
+        # pair must never interleave with this clear
+        with mpp_exec._PLACE_LOCK:
+            mpp_exec._MPP_PLACE_CACHE.clear()
     except Exception:
         pass
     try:

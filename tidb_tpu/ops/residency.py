@@ -147,6 +147,22 @@ class _Entry:
         self.group = group
 
 
+class CacheOwner:
+    """Weakref-able owner object for cached device values whose natural
+    owner is NOT a utils.chunk.Column — e.g. the MPP mesh placement cache
+    (executor/mpp_exec.py), whose values are mesh-sharded global arrays.
+    Holding the managed ``_device`` slot HERE keeps every HBM cache
+    inside this module's lint boundary: lookup()/publish() work on a
+    CacheOwner exactly as on a Column, so placement entries are
+    byte-accounted, LRU-evictable, epoch-stamped and part of the OOM
+    evict-all ladder like any other upload."""
+
+    __slots__ = ("_device", "__weakref__")
+
+    def __init__(self):
+        self._device = None
+
+
 def _nbytes(arr) -> int:
     try:
         return int(arr.nbytes)
@@ -467,6 +483,31 @@ def recover_oom(err=None) -> int:
 
 
 # -- introspection -----------------------------------------------------------
+
+def resident_nbytes(owner) -> int:
+    """Byte charge of `owner`'s cached value if it is live, epoch-current
+    and still on the ledger; else 0.  Pure introspection: no LRU touch,
+    no stats — gauge plumbing (e.g. the MPP placement-cache bytes gauge)
+    must not look like cache traffic."""
+    return resident_nbytes_total((owner,))
+
+
+def resident_nbytes_total(owners) -> int:
+    """Sum of resident_nbytes over `owners` under ONE ledger-lock
+    acquisition — gauge plumbing runs on every query and every
+    /status//metrics scrape, and must not contend the upload/evict lock
+    once per cached owner."""
+    total = 0
+    with _LOCK:
+        for owner in owners:
+            res = owner._device
+            if res is None or res.epoch != _EPOCH[0]:
+                continue
+            ent = _ENTRIES.get(res.token)
+            if ent is not None:
+                total += ent.nbytes
+    return total
+
 
 def resident_bytes() -> int:
     """The ``hbm_bytes_cached`` gauge."""

@@ -204,18 +204,62 @@ def dist_agg_step(mesh: Mesh, kinds: tuple, capacity: int,
 # hash-partition shuffle join (+ aggregate) over the mesh
 # ---------------------------------------------------------------------------
 
-def _bucketize(keys, vals, valid, n_dest, cap):
-    """Scatter rows into [n_dest, cap] hash buckets (dest = key mod n_dest).
+#: sub-buckets per destination shard in the two-level radix partition
+#: (power of two: the sub index is a low-bit mask of the mixed hash)
+RADIX_SUB = 4
+
+
+def _mix64(k):
+    """murmur3 fmix64 over int64 lanes — decorrelates FK-stride keys from
+    the destination-shard choice (the reference hashes partition keys with
+    murmur, unistore/cophandler/mpp_exec.go). Shared by the library-level
+    steps here and the SQL-path exchange (executor/mpp_exec.py)."""
+    u = k.astype(jnp.uint64)
+    u = u ^ (u >> 33)
+    u = u * jnp.uint64(0xFF51AFD7ED558CCD)
+    u = u ^ (u >> 33)
+    u = u * jnp.uint64(0xC4CEB9FE1A85EC53)
+    u = u ^ (u >> 33)
+    return u
+
+
+def _radix_bucket(h, valid, n_dest, n_sub):
+    """The two-level radix split, shared by the library-level steps here
+    and the SQL-path exchange (executor/mpp_exec.py) so the two partition
+    layouts can never diverge: the mixed hash's HIGH bits pick the
+    destination, its LOW bits one of `n_sub` sub-buckets. Returns
+    (flattened bucket id per row, n_buckets); invalid rows park at
+    n_buckets, past every real bucket."""
+    dest = ((h >> jnp.uint64(32)) % jnp.uint64(n_dest)).astype(jnp.int64)
+    sub = (h & jnp.uint64(n_sub - 1)).astype(jnp.int64)
+    nb = n_dest * n_sub
+    return jnp.where(valid, dest * n_sub + sub, nb), nb
+
+
+def _bucketize(keys, vals, valid, n_dest, cap, n_sub=RADIX_SUB):
+    """Two-level RADIX partition ("Efficient Multiway Hash Join on
+    Reconfigurable Hardware", PAPERS.md): the mix64 hash's HIGH bits pick
+    the destination shard, its LOW bits pick one of `n_sub` sub-buckets,
+    and each (dest, sub) bucket is `cap`-bounded.  Layout is
+    [n_dest, n_sub, cap] flattened, so each destination's region is
+    contiguous and equal-sized — exactly what a tiled all_to_all splits.
+
+    vs the old single-pass ``key % n_dest``: stride-correlated FK keys no
+    longer pile onto one shard, and overflow is measured per SUB-bucket as
+    an exact max count, so a retry jumps straight to the required
+    capacity instead of doubling blind.
+
     Returns flattened (keys, vals tuple, valid, n_dropped)."""
     n = keys.shape[0]
-    dest = jnp.where(valid, keys % n_dest, n_dest)
-    order = jnp.argsort(dest, stable=True)
-    sd = dest[order]
-    start = jnp.searchsorted(sd, jnp.arange(n_dest))
-    pos = jnp.arange(n) - start[jnp.clip(sd, 0, n_dest - 1)]
-    ok = (sd < n_dest) & (pos < cap)
-    slot = jnp.where(ok, sd * cap + pos, n_dest * cap)
-    size = n_dest * cap + 1
+    h = _mix64(keys.astype(jnp.int64))
+    bucket, nb = _radix_bucket(h, valid, n_dest, n_sub)
+    order = jnp.argsort(bucket, stable=True)
+    sb = bucket[order]
+    start = jnp.searchsorted(sb, jnp.arange(nb))
+    pos = jnp.arange(n) - start[jnp.clip(sb, 0, nb - 1)]
+    ok = (sb < nb) & (pos < cap)
+    slot = jnp.where(ok, sb * cap + pos, nb * cap)
+    size = nb * cap + 1
     bk = jnp.zeros(size, dtype=keys.dtype).at[slot].set(
         jnp.where(ok, keys[order], 0))[:-1]
     bvalid = jnp.zeros(size, dtype=bool).at[slot].set(ok)[:-1]
@@ -223,13 +267,15 @@ def _bucketize(keys, vals, valid, n_dest, cap):
         jnp.zeros(size, dtype=v.dtype).at[slot].set(
             jnp.where(ok, v[order], jnp.zeros((), dtype=v.dtype)))[:-1]
         for v in vals)
-    dropped = jnp.sum((sd < n_dest) & (pos >= cap))
+    dropped = jnp.sum((sb < nb) & (pos >= cap))
     return bk, bvals, bvalid, dropped
 
 
 def _exchange_hash(keys, vals, valid, axis, n_dest, cap):
-    """Hash-partition exchange: bucketize locally, all_to_all over ICI.
-    After this, every row on shard i satisfies key % n_shards == i."""
+    """Radix-partition exchange: two-level bucketize locally, one tiled
+    all_to_all over ICI.  After this, every row on shard i satisfies
+    mix64(key) high bits mod n_shards == i (both join sides use the same
+    fold, so equal keys meet on the same shard)."""
     bk, bvals, bvalid, dropped = _bucketize(keys, vals, valid, n_dest, cap)
     a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
                             split_axis=0, concat_axis=0, tiled=True)
@@ -248,6 +294,9 @@ def dist_join_agg_step(mesh: Mesh, cap: int, axis: str = "part", ctx=None):
         total  = Σ over join pairs of pv * bv
         n_pairs = join cardinality
         dropped = rows lost to bucket overflow (retry bigger cap if > 0)
+    `cap` bounds each RADIX SUB-bucket of the exchange ([n_shards,
+    RADIX_SUB, cap] per side, see _bucketize) — per destination shard the
+    exchange holds RADIX_SUB * cap rows.
     """
     n_shards = mesh.shape[axis]
 

@@ -12,6 +12,13 @@ from ..meta import Meta
 from ..model import JobState, SchemaState
 
 
+def _mpp_snapshot() -> dict:
+    """MPP mesh-path gauges for /status and /metrics (process-wide, like
+    the supervisor/residency gauges)."""
+    from ..executor import mpp_exec
+    return mpp_exec.snapshot()
+
+
 class StatusServer:
     def __init__(self, domain, sql_server=None, host="127.0.0.1", port=10080):
         self.domain = domain
@@ -105,6 +112,10 @@ class StatusServer:
             # serving scheduler (executor/scheduler.py): admission queue
             # depth, per-tenant running counts / degradations, WFQ state
             "device_scheduler": scheduler.snapshot(),
+            # MPP mesh path (executor/mpp_exec.py): fragments, retries
+            # (capacity growth / transport / radix-exchange overflow),
+            # placement-cache entries + residency-ledgered bytes
+            "device_mpp": _mpp_snapshot(),
             # breaker stat lines keyed by (shape, resource group)
             "device_breakers": {
                 shape: br.snapshot() for shape, br in
@@ -136,6 +147,12 @@ class StatusServer:
                           ss["sched_admission_waits_ms"])
         gauges.setdefault("sched_batched_fragments",
                           ss["sched_batched_fragments"])
+        ms = _mpp_snapshot()
+        gauges.setdefault("mpp_place_bytes", ms["mpp_place_bytes"])
+        gauges.setdefault("mpp_fragments", ms["fragments"])
+        gauges.setdefault("mpp_retries", ms["retries"])
+        gauges.setdefault("mpp_exchange_overflow_retries",
+                          ms["exchange_overflow_retries"])
         # per-tenant degradations as ONE labeled series (a single TYPE
         # header — duplicate TYPE lines are invalid text exposition and
         # fail the whole scrape); the observe-sink mirror keys them
