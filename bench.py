@@ -889,6 +889,10 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                     stage(f"{qname}: device warmup (compile + materialize)")
                     tk.must_exec("set tidb_executor_engine = 'tpu'")
                     st0 = pipe_cache_stats(thread_local=True)
+                    # process-wide snapshot for the per-query bg delta
+                    # (the bg meter lives on worker threads, so the
+                    # thread-local view above never sees it)
+                    bg0 = pipe_cache_stats()["bg_compile_s"]
                     # two warmup runs, timed SEPARATELY: warm_t is the
                     # FIRST (cold) run so warmup_minus_steady_s keeps its
                     # historical meaning; the second run absorbs the
@@ -927,7 +931,23 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                 "warm_compile_s": round(compile_warm, 4),
                 "warmup_minus_steady_s": round(max(warm_t - dev_t, 0.0), 4),
                 "xla_compiles": st2["compiles"] - st0["compiles"],
+                # compile attribution split (executor/compile_service.py):
+                # sync_compile_s is what THIS query's dispatches paid on
+                # the query path (the thread-local meter above);
+                # bg_compile_s is this query's window of the process-wide
+                # background-worker meter — compile work the host-first
+                # serving kept OFF the query path. The next live-TPU run
+                # reads wall-clock = execute + sync_compile, with
+                # bg_compile overlapped.
+                "sync_compile_s": round(compile_cold + compile_warm, 4),
+                "bg_compile_s": round(
+                    pipe_cache_stats()["bg_compile_s"] - bg0, 4),
             }
+            # compile-service gauges: pending fragments / persistent-index
+            # hits / prewarm counts once they fired — a bench line whose
+            # first run was host-served says so
+            from tidb_tpu.executor import compile_service as _csvc
+            compile_info.update(_csvc.report_gauges())
             # HBM residency (ops/residency.py): cached-bytes ledger after
             # the timed runs; eviction/OOM counters only when they fired —
             # a bench line that ran under device-memory pressure says so

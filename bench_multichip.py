@@ -62,6 +62,14 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def _compile_gauges() -> dict:
+    """Compile-service gauges for the record (executor/compile_service):
+    pending fragments / persistent-index hits / prewarm counts — a round
+    whose first execution was host-served says so."""
+    from tidb_tpu.executor import compile_service
+    return compile_service.report_gauges()
+
+
 def _write_record():
     with open(OUT_PATH, "w") as f:
         json.dump(RECORD, f, indent=1)
@@ -129,7 +137,14 @@ def _round(tk, q, engine="tpu-mpp"):
     return rows, {"wall_s": round(wall, 4),
                   "traces": s1["traces"] - s0["traces"],
                   "compiles": s1["compiles"] - s0["compiles"],
+                  # query-path (sync) vs compile-service background split
+                  # (executor/compile_service.py): mesh rounds compile
+                  # sync today, so bg stays 0 unless prewarm/async ran
+                  "sync_compile_s": round(
+                      s1["compile_s"] - s0["compile_s"], 4),
                   "compile_s": round(s1["compile_s"] - s0["compile_s"], 4),
+                  "bg_compile_s": round(
+                      s1["bg_compile_s"] - s0["bg_compile_s"], 4),
                   "pipe_misses": s1["misses"] - s0["misses"],
                   "pipe_hits": s1["hits"] - s0["hits"]}
 
@@ -158,6 +173,7 @@ def phase_warm_rounds():
         "round3_post_insert_within_bucket": r3,
         "zero_recompile_ok": ok,
         "mpp_gauges": mpp_exec.report_gauges(),
+        "compile_gauges": _compile_gauges(),
         # r05 ran the mesh path with EXACT shard shapes and no MPP-layer
         # pipeline cache: every round re-traced the SPMD program (warm
         # trace count == cold trace count). The carry-over's warm

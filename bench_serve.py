@@ -308,11 +308,24 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
           "rejected_injected": sched["rejected_injected"],
           "hbm_bytes_cached": residency.resident_bytes(),
           "supervisor_hangs": supervisor.snapshot()["hangs"]})
+    # compile-service attribution (executor/compile_service.py): how much
+    # compile the serving run paid on the query path vs in the background
+    # pool, plus the pending/persist/prewarm counters — a chaos run with
+    # injected compile faults also reports bg_failed here
+    from tidb_tpu.executor import compile_service
+    from tidb_tpu.executor.device_exec import pipe_cache_stats
+    ps = pipe_cache_stats()
+    emit({"metric": "serve_compile",
+          "sync_compile_s": round(ps["compile_s"], 4),
+          "bg_compile_s": round(ps["bg_compile_s"], 4),
+          **compile_service.report_gauges()})
     summary.update({k: sched[k] for k in
                     ("admitted", "queued", "sched_batched_fragments",
                      "rejected_full", "rejected_timeout",
                      "rejected_injected")})
     summary["degradations_by_group"] = sched["degradations_by_group"]
+    summary["sync_compile_s"] = round(ps["compile_s"], 4)
+    summary["bg_compile_s"] = round(ps["bg_compile_s"], 4)
     return summary
 
 

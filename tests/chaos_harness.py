@@ -103,6 +103,13 @@ READ_FAULTS = {
     # the queue must drain to zero by seed end (asserted below)
     "device-admission": ["admission-queue-full", "1*admission-wait(0.05)",
                          "2*admission-wait(0.02)"],
+    # compile service (executor/compile_service.py): an injected compile
+    # failure must degrade the fragment to the host engine (exact result,
+    # classified — the compile breaker, not the fragment breakers,
+    # absorbs it), a compile stall is absorbed as build time — and no
+    # compile job may leak (compile_service.verify_drained below)
+    "device-compile": ["compile-fail", "1*compile-fail", "2*compile-fail",
+                       "1*compile-slow(0.02)"],
     "mpp-exchange-send": ["1*panic", "2*panic", "panic"],
     "mpp-exchange-recv": ["1*panic", "panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -244,6 +251,15 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         drained = scheduler.verify_drained()
         assert drained["ok"], (
             f"seed {seed}: LEAKED ADMISSION TICKETS: {drained}")
+
+        # -- compile jobs drained: every background compile submitted by
+        #    the schedule is accounted completed, failed or discarded —
+        #    no job leaked in flight (mirrors the ticket invariant)
+        from tidb_tpu.executor import compile_service
+        compile_service.wait_idle(timeout_s=10.0)
+        cdrained = compile_service.verify_drained()
+        assert cdrained["ok"], (
+            f"seed {seed}: LEAKED COMPILE JOBS: {cdrained}")
     finally:
         failpoint.disable_all()
     return stats
@@ -266,6 +282,11 @@ THREADED_FAULTS = {
     # tickets must never leak (verify_drained asserted after the joins)
     "device-admission": ["admission-queue-full", "1*admission-wait(0.05)",
                          "2*admission-wait(0.02)"],
+    # compile failures/stalls interleaving with hangs, OOM and DML: the
+    # fragment degrades to host classified, and no compile job may leak
+    # (compile_service.verify_drained asserted after the joins)
+    "device-compile": ["compile-fail", "1*compile-fail",
+                       "1*compile-slow(0.02)"],
     "mpp-exchange-send": ["1*panic", "panic"],
     "mpp-exchange-recv": ["1*panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -328,6 +349,11 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
             # injected sleep: the hang path must fire concurrently
             wtk.must_exec("set tidb_device_call_timeout = "
                           + ("0.02" if rng.random() < 0.5 else "0"))
+            # a third of the ops compile ASYNC: background compile jobs
+            # race the injected compile failures/stalls, hangs and DML —
+            # the drain invariant below must still hold
+            wtk.must_exec("set tidb_compile_async = "
+                          + ("'ON'" if rng.random() < 0.35 else "'OFF'"))
             names = rng.sample(sorted(THREADED_FAULTS),
                                k=rng.choice([1, 1, 2]))
             with contextlib.ExitStack() as st:
@@ -431,6 +457,17 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     assert drained["ok"], (
         f"seed {seed}: LEAKED ADMISSION TICKETS after threaded chaos: "
         f"{drained}")
+
+    # compile jobs drained: concurrent background compiles racing the
+    # injected failures/stalls must all land, fail classified, or be
+    # discarded — never leak in flight (the PR 6 ticket invariant,
+    # applied to the compile service)
+    from tidb_tpu.executor import compile_service
+    compile_service.wait_idle(timeout_s=10.0)
+    cdrained = compile_service.verify_drained()
+    assert cdrained["ok"], (
+        f"seed {seed}: LEAKED COMPILE JOBS after threaded chaos: "
+        f"{cdrained}")
 
     # breaker-state sanity: legal state, probe slot not wedged
     for shape, br in getattr(tk.domain, "_device_breakers", {}).items():

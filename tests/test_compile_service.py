@@ -1,0 +1,440 @@
+"""Compile service (executor/compile_service.py): async background
+compilation with host-first serving, prewarmed bucket ladders, the
+persistent signature index, classified compile-failure chaos with
+breaker recovery, gauge surfacing, and the jax.jit confinement lint.
+
+The tier-1 acceptance pins (ISSUE 8):
+  * with ``tidb_compile_async=ON`` a cold-cache query returns a correct
+    HOST-served result without blocking on XLA, and a repeat of the same
+    bucket shape executes on device with ZERO new traces;
+  * injected ``compile-fail`` chaos yields exact-or-classified results
+    only, and the compile breaker recovers via half-open;
+  * no compile job leaks (``verify_drained``).
+"""
+
+import ast
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from tidb_tpu.executor import compile_service
+from tidb_tpu.executor.device_exec import pipe_cache_stats
+from tidb_tpu.testkit import TestKit
+from tidb_tpu.utils import failpoint
+
+
+@pytest.fixture(scope="module")
+def tk():
+    tk = TestKit()
+    tk.must_exec("use test")
+    tk.must_exec("create table cs (id int primary key, g int, v int, "
+                 "w int)")
+    rows = ",".join(f"({i},{i % 7},{(i * 37) % 101},{(i * 13) % 89})"
+                    for i in range(300))
+    tk.must_exec(f"insert into cs values {rows}")
+    tk.must_exec("set tidb_executor_engine = 'tpu'")
+    yield tk
+    failpoint.disable_all()
+    tk.must_exec("set tidb_executor_engine = 'auto'")
+    tk.must_exec("set tidb_compile_async = 'OFF'")
+
+
+def _host_rows(tk, q):
+    tk.must_exec("set tidb_executor_engine = 'host'")
+    try:
+        return tk.must_query(q).rows
+    finally:
+        tk.must_exec("set tidb_executor_engine = 'tpu'")
+
+
+# -- unit helpers -------------------------------------------------------------
+
+class TestHelpers:
+    def test_next_buckets_geometric(self):
+        # the ladder climbs the ops/device bucket_rows curve (powers of
+        # sqrt(2) at per_double=2), strictly increasing
+        out = compile_service.next_buckets(363, 3)
+        assert out == sorted(set(out)) and len(out) == 3
+        assert out[0] > 363
+        from tidb_tpu.ops.device import bucket_rows
+        for b in out:
+            assert bucket_rows(b) == b
+
+    def test_spec_roundtrip_preserves_weak_scalars(self):
+        import jax
+        import numpy as np
+        args = ({"x": (np.arange(8), np.zeros(8, bool))}, np.int64(5), 3)
+        spec = compile_service._spec_of(args)
+        env, n_live, lit = spec
+        assert isinstance(env["x"][0], jax.ShapeDtypeStruct)
+        assert n_live.shape == () and n_live.dtype == np.int64
+        assert lit == 0  # python scalar stays a weak-typed literal
+        zeros = compile_service._zeros_of(spec)
+        assert zeros[0]["x"][0].shape == (8,)
+        assert zeros[2] == 0
+
+    def test_base_bucket_and_scale(self):
+        import numpy as np
+        spec = compile_service._spec_of(
+            ({"x": (np.zeros(16), np.zeros(16, bool))}, np.int64(3)))
+        assert compile_service._base_bucket(spec) == 16
+        scaled = compile_service._scale_spec(spec, 16, 23)
+        assert compile_service._base_bucket(scaled) == 23
+        # disagreeing leading dims: no single fragment bucket, no ladder
+        spec2 = compile_service._spec_of(
+            ({"x": (np.zeros(16), np.zeros(16, bool)),
+              "y": (np.zeros(8), np.zeros(8, bool))},))
+        assert compile_service._base_bucket(spec2) is None
+
+
+# -- async compile, host-first serving (tier-1 acceptance) --------------------
+
+class TestAsyncFlip:
+    def test_cold_query_host_served_then_flips_to_device(self, tk,
+                                                           monkeypatch):
+        # a populated persistent index would (correctly) compile this
+        # signature INLINE as a warm deserialize — disable it so the
+        # test pins the cold-miss async path deterministically
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", "off")
+        q = ("select g, sum(v), min(w) from cs where v > 5 "
+             "group by g order by g")
+        golden = _host_rows(tk, q)
+        tk.must_exec("set tidb_compile_async = 'ON'")
+        try:
+            st0 = pipe_cache_stats(thread_local=True)
+            snap0 = compile_service.snapshot()
+            rows = tk.must_query(q).rows
+            st1 = pipe_cache_stats(thread_local=True)
+            # correct result, and the query path paid ZERO XLA compiles:
+            # the executable is building in the background while the
+            # host engine served this execution
+            assert rows == golden
+            assert st1["traces"] - st0["traces"] == 0
+            assert st1["compile_s"] - st0["compile_s"] == 0.0
+            assert st1["mode_async_pending"] - st0["mode_async_pending"] >= 1
+            assert compile_service.snapshot()["bg_submitted"] \
+                > snap0["bg_submitted"]
+
+            assert compile_service.wait_idle(60.0), "bg compile stuck"
+            snap1 = compile_service.snapshot()
+            assert snap1["bg_completed"] > snap0["bg_completed"]
+            assert snap1["compile_bg_seconds"] > 0
+
+            # the flip: same bucket shape now executes ON DEVICE with
+            # zero new traces (the background warm absorbed the compile)
+            st0 = pipe_cache_stats(thread_local=True)
+            rows2 = tk.must_query(q).rows
+            st1 = pipe_cache_stats(thread_local=True)
+            assert rows2 == golden
+            assert st1["traces"] - st0["traces"] == 0
+            assert st1["hits"] - st0["hits"] >= 1
+            assert st1["mode_async_pending"] == st0["mode_async_pending"]
+        finally:
+            tk.must_exec("set tidb_compile_async = 'OFF'")
+
+    def test_pending_compile_serves_host_without_resubmit(self, tk,
+                                                            monkeypatch):
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", "off")
+        q = ("select g, max(v), count(w) from cs where w > 3 "
+             "group by g order by g")
+        golden = _host_rows(tk, q)
+        tk.must_exec("set tidb_compile_async = 'ON'")
+        try:
+            with failpoint.enabled("device-compile",
+                                   "1*compile-slow(0.4)"):
+                snap0 = compile_service.snapshot()
+                rows = tk.must_query(q).rows          # submits, host serves
+                assert rows == golden
+                rows2 = tk.must_query(q).rows         # still in flight
+                assert rows2 == golden
+                snap1 = compile_service.snapshot()
+                # ONE job submitted; the second execution counted as a
+                # pending-fragment degrade, not a duplicate submit
+                assert snap1["bg_submitted"] == snap0["bg_submitted"] + 1
+                assert snap1["compile_pending_fragments"] \
+                    >= snap0["compile_pending_fragments"] + 2
+            assert compile_service.wait_idle(60.0)
+            st0 = pipe_cache_stats(thread_local=True)
+            assert tk.must_query(q).rows == golden
+            st1 = pipe_cache_stats(thread_local=True)
+            assert st1["traces"] - st0["traces"] == 0
+        finally:
+            tk.must_exec("set tidb_compile_async = 'OFF'")
+
+
+# -- prewarm ladder -----------------------------------------------------------
+
+class TestPrewarmLadder:
+    def test_admin_compile_prewarms_next_buckets(self, tk):
+        # drop recipes accumulated by earlier suites: ADMIN COMPILE
+        # prewarms EVERY hot recipe, and this test times its own
+        compile_service.reset_for_tests()
+        q = ("select g, sum(w), count(*) from cs where v < 90 "
+             "group by g order by g")
+        tk.must_query(q)  # registers the recipe at the 300-row bucket
+        rep = tk.must_query("admin compile").rows
+        assert len(rep) == 1 and int(rep[0][0]) >= 1  # submitted
+        # INSERT across the bucket boundary, inside the warmed ladder
+        # (300 rows sit in bucket 363; 600 lands in 725 — two rungs up)
+        more = ",".join(
+            f"({i},{i % 7},{(i * 37) % 101},{(i * 13) % 89})"
+            for i in range(300, 600))
+        tk.must_exec(f"insert into cs values {more}")
+        golden = _host_rows(tk, q)
+        st0 = pipe_cache_stats(thread_local=True)
+        rows = tk.must_query(q).rows
+        st1 = pipe_cache_stats(thread_local=True)
+        assert rows == golden
+        # the prewarmed rung serves the grown shape: ZERO sync compiles
+        assert st1["traces"] - st0["traces"] == 0
+        assert st1["compile_s"] - st0["compile_s"] == 0.0
+        # restore the module fixture's row count for later tests
+        tk.must_exec("delete from cs where id >= 300")
+
+    def test_prewarm_reports_counts(self, tk):
+        rep = compile_service.prewarm(ctx=tk.session, ladder_up=1,
+                                      max_recipes=4, wait=True,
+                                      timeout_s=60.0)
+        assert rep["submitted"] >= 0
+        assert compile_service.verify_drained()["ok"]
+
+
+# -- classified compile failures + breaker ------------------------------------
+
+class TestCompileFailChaos:
+    def test_sync_compile_fail_degrades_exact(self, tk):
+        q = ("select g, min(v), max(w) from cs where v > 50 "
+             "group by g order by g")
+        golden = _host_rows(tk, q)
+        agg_br = tk.domain._device_breakers.get("agg")
+        agg_fail0 = agg_br.snapshot()["failures"] if agg_br else 0
+        with failpoint.enabled("device-compile", "compile-fail"):
+            rows = tk.must_query(q).rows
+        assert rows == golden
+        br = tk.domain._device_breakers["compile"]
+        assert br.snapshot()["failures"] >= 1
+        # the COMPILE breaker absorbed it — the agg fragment breaker
+        # must not be charged for a compile-path failure
+        if agg_br is not None:
+            assert agg_br.snapshot()["failures"] == agg_fail0
+
+    def test_bg_transient_fail_absorbed_by_retry(self, tk, monkeypatch):
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", "off")
+        q = ("select g, sum(v + w) from cs where w > 42 "
+             "group by g order by g")
+        golden = _host_rows(tk, q)
+        tk.must_exec("set tidb_compile_async = 'ON'")
+        try:
+            snap0 = compile_service.snapshot()
+            with failpoint.enabled("device-compile", "1*compile-fail"):
+                assert tk.must_query(q).rows == golden
+                assert compile_service.wait_idle(60.0)
+            snap1 = compile_service.snapshot()
+            # the first build attempt failed injected; the compileRetry
+            # curve absorbed it — the job still LANDED
+            assert snap1["bg_completed"] == snap0["bg_completed"] + 1
+            assert snap1["bg_failed"] == snap0["bg_failed"]
+            st0 = pipe_cache_stats(thread_local=True)
+            assert tk.must_query(q).rows == golden
+            assert pipe_cache_stats(
+                thread_local=True)["traces"] == st0["traces"]
+        finally:
+            tk.must_exec("set tidb_compile_async = 'OFF'")
+
+    def test_breaker_opens_and_recovers_half_open(self, tk):
+        tk.must_exec("set global tidb_device_circuit_threshold = 2")
+        # cooldown long enough that the open-state degrade below cannot
+        # race into a premature HALF_OPEN probe
+        tk.must_exec("set global tidb_device_circuit_cooldown = 0.5")
+        try:
+            qs = [(f"select g, count(*) from cs where v > {k} "
+                   "group by g order by g") for k in (71, 72, 73, 74)]
+            goldens = [_host_rows(tk, q) for q in qs]
+            with failpoint.enabled("device-compile", "compile-fail"):
+                for q, g in zip(qs[:2], goldens[:2]):
+                    assert tk.must_query(q).rows == g  # host degrade
+            br = tk.domain._device_breakers["compile"]
+            assert br.snapshot()["state"] == "open"
+            # open breaker: a cold obtain degrades WITHOUT queueing
+            deg0 = compile_service.snapshot()["breaker_degrades"]
+            assert tk.must_query(qs[2]).rows == goldens[2]
+            assert compile_service.snapshot()["breaker_degrades"] \
+                == deg0 + 1
+            # failpoint cleared + cooldown elapsed: the half-open probe
+            # compiles for real and CLOSES the breaker
+            time.sleep(0.55)
+            assert tk.must_query(qs[3]).rows == goldens[3]
+            assert br.snapshot()["state"] == "closed"
+        finally:
+            tk.must_exec("set global tidb_device_circuit_threshold = 5")
+            tk.must_exec("set global tidb_device_circuit_cooldown = 30")
+
+    def test_no_leaked_compile_jobs(self, tk):
+        assert compile_service.wait_idle(30.0)
+        drained = compile_service.verify_drained()
+        assert drained["ok"], drained
+
+
+# -- persistent signature index ----------------------------------------------
+
+class TestPersistIndex:
+    def test_record_then_lookup(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", str(tmp_path))
+        key = ("sig-a", 64, None, ("sum",))
+        assert not compile_service._persist_lookup(key)
+        compile_service._persist_record(key, "agg", "sig-a", "sync")
+        assert compile_service._persist_lookup(key)
+        assert not compile_service._persist_lookup(("sig-b", 64))
+        # the index entry is valid JSON with the recorded metadata
+        fname = compile_service._persist_hash(key) + ".json"
+        blob = json.loads((tmp_path / fname).read_text())
+        assert blob["shape"] == "agg" and blob["origin"] == "sync"
+
+    def test_off_disables(self, monkeypatch):
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", "off")
+        assert compile_service._persist_dir() is None
+        compile_service._persist_record(("k",), "agg", "", "sync")
+        assert not compile_service._persist_lookup(("k",))
+
+    def test_backend_identity_in_hash(self):
+        # same signature on a different backend/mesh is a DIFFERENT
+        # executable: the hash must not collide across device counts
+        h1 = compile_service._persist_hash(("sig", 64))
+        assert h1 == compile_service._persist_hash(("sig", 64))
+        assert h1 != compile_service._persist_hash(("sig", 128))
+
+
+# -- gauges / annotations -----------------------------------------------------
+
+class TestGaugesSurfaced:
+    def test_explain_observe_status_and_metrics(self, tk, monkeypatch):
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", "off")
+        q = ("select g, sum(v), max(v + w) from cs where w < 80 "
+             "group by g order by g")
+        tk.must_exec("set tidb_compile_async = 'ON'")
+        try:
+            tk.must_query(q)                       # async submit
+            assert compile_service.wait_idle(60.0)
+            tk.must_query(q)                       # device flip
+        finally:
+            tk.must_exec("set tidb_compile_async = 'OFF'")
+
+        # EXPLAIN ANALYZE annotates the service gauges + compile_mode
+        rows = tk.must_query(f"explain analyze {q}").rows
+        blob = "\n".join(" ".join(str(c) for c in r) for r in rows)
+        assert "compile_queue_depth" in blob
+        assert "compile_mode" in blob
+
+        # observe gauges (the sink obtain() registered for this Domain)
+        g = tk.domain.observe.gauge_snapshot()
+        assert "compile_queue_depth" in g
+        assert g.get("compile_bg_seconds", 0) > 0
+
+        # HTTP /status JSON + /metrics exposition
+        from tidb_tpu.server.http_status import StatusServer
+        srv = StatusServer(tk.domain, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status = json.load(urllib.request.urlopen(f"{base}/status"))
+            cs = status["device_compiler"]
+            assert cs["bg_completed"] >= 1
+            assert cs["compile_bg_seconds"] > 0
+            assert "compile" in status["device_breakers"]
+            metrics = urllib.request.urlopen(f"{base}/metrics").read()
+            assert b"compile_queue_depth" in metrics
+            assert b"compile_bg_seconds" in metrics
+            assert b"compile_pending_fragments" in metrics
+        finally:
+            srv.shutdown()
+
+    def test_bg_attribution_survives_supervisor_deadline(self, tk,
+                                                         monkeypatch):
+        """With tidb_compile_timeout > 0 the background build runs on a
+        REUSED supervisor worker thread — its compile charges must still
+        route to the bg_* mirror (scoped mark in _do_compile), and the
+        worker must not stay marked when it serves query fragments
+        next."""
+        monkeypatch.setenv("TIDB_TPU_COMPILE_INDEX", "off")
+        tk.must_exec("set tidb_compile_async = 'ON'")
+        tk.must_exec("set global tidb_compile_timeout = 30")
+        try:
+            q = ("select g, sum(w + 2) from cs where v < 77 "
+                 "group by g order by g")
+            s0 = pipe_cache_stats()
+            tk.must_query(q)
+            assert compile_service.wait_idle(60.0)
+            s1 = pipe_cache_stats()
+            assert s1["bg_compile_s"] > s0["bg_compile_s"]
+            assert s1["compile_s"] == s0["compile_s"]
+        finally:
+            tk.must_exec("set global tidb_compile_timeout = 0")
+            tk.must_exec("set tidb_compile_async = 'OFF'")
+        # the same supervisor worker now serves a supervised QUERY
+        # dispatch: its sync compile must hit the sync meter
+        tk.must_exec("set tidb_device_call_timeout = 5")
+        try:
+            q2 = ("select g, min(w + 3) from cs where v < 76 "
+                  "group by g order by g")
+            s0 = pipe_cache_stats()
+            tk.must_query(q2)
+            s1 = pipe_cache_stats()
+            assert s1["compile_s"] > s0["compile_s"]
+        finally:
+            tk.must_exec("set tidb_device_call_timeout = 0")
+
+    def test_bg_compile_charged_to_bg_mirror(self, tk):
+        # process totals split sync vs background compile seconds: the
+        # flip test above compiled in the BACKGROUND, so the bg mirror
+        # is nonzero and per-query compile_s stayed the sync cost
+        st = pipe_cache_stats()
+        assert st["bg_compile_s"] > 0
+        assert st["bg_traces"] >= 1
+
+
+# -- lint: jax.jit of query pipelines is confined -----------------------------
+
+class TestJitConfinementLint:
+    ALLOWED = {
+        os.path.join("executor", "compile_service.py"),
+        os.path.join("ops", "device.py"),
+    }
+
+    def test_direct_jit_confined_to_compile_layer(self):
+        """Any raw ``jax.jit`` (or AOT ``.lower()``/``.compile()`` chained
+        off a jit call) outside the compile layer bypasses async
+        compilation, the compile breaker and the trace accounting —
+        every query pipeline must build through
+        device_exec.acquire_pipeline -> compile_service.obtain, and every
+        kernel jit through ops/device.observed_jit."""
+        root = os.path.join(os.path.dirname(__file__), "..", "tidb_tpu")
+        offenders = []
+        for dirpath, _dirs, files in os.walk(os.path.abspath(root)):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, os.path.abspath(root))
+                if rel in self.ALLOWED:
+                    continue
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Attribute):
+                        continue
+                    if (node.attr == "jit"
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == "jax"):
+                        offenders.append(f"{rel}:{node.lineno} jax.jit")
+                    # AOT chain: jax.jit(...).lower(...) / .compile()
+                    if (node.attr in ("lower", "compile")
+                            and isinstance(node.value, ast.Call)
+                            and isinstance(node.value.func, ast.Attribute)
+                            and node.value.func.attr == "jit"):
+                        offenders.append(
+                            f"{rel}:{node.lineno} .{node.attr}")
+        assert not offenders, (
+            "query pipelines compiled outside the compile service "
+            f"(use acquire_pipeline / observed_jit): {offenders}")

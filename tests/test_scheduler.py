@@ -512,11 +512,15 @@ class TestBreakerMultiTenantProbe:
 # -- lint: no direct device dispatch bypassing admission ---------------------
 
 #: files allowed to touch the supervisor dispatch directly: the
-#: supervisor itself, the admission-aware run_device, the scheduler, and
+#: supervisor itself, the admission-aware run_device, the scheduler,
 #: parallel/mpp.py's library-embedder hook (_supervised_step — audited:
-#: it holds its own admission ticket around the supervised call)
+#: it holds its own admission ticket around the supervised call), and
+#: the compile service (audited: its BACKGROUND builds never serve a
+#: query — the bounded worker pool IS their admission, and the warm
+#: dispatch must run even while query admission is saturated, or a
+#: congested device could never finish the compiles that relieve it)
 _SUPERVISED_ALLOWED = {"supervisor.py", "device_exec.py", "scheduler.py",
-                       "mpp.py"}
+                       "mpp.py", "compile_service.py"}
 
 
 class TestNoDirectDispatchLint:

@@ -70,6 +70,10 @@ class ErrCode:
     #                    blew its wall-clock deadline (the backend hung)
     DeviceAdmission = 9009  # the serving scheduler refused a fragment a
     #                         device slot (queue full / wait timed out)
+    DeviceCompile = 9010  # the compile service could not build a device
+    #                       executable (remote-compile RPC/transport
+    #                       failure, injected compile fault, retry budget
+    #                       exhausted) — the fragment degrades to host
     LazyUniquenessCheckFailure = 8147
     ResolveLockTimeout = 9004
     GCTooEarly = 9006
@@ -230,6 +234,22 @@ class DeviceAdmissionError(TiDBError):
     is host+device serving different work concurrently, not an error."""
 
     code = ErrCode.DeviceAdmission
+    sqlstate = "HY000"
+
+
+class DeviceCompileError(TiDBError):
+    """The compile service (executor/compile_service.py) failed to build a
+    device executable for a fragment signature: the remote-compile
+    RPC/transport died mid-compile, an injected ``compile-fail`` failpoint
+    fired, or the ``compileRetry`` backoff budget ran out.
+
+    This is a COMPILE-path failure, not an execution failure: it charges
+    the compile-scoped circuit breaker (shape="compile") — never the
+    fragment-shape breakers — and the fragment degrades to the host
+    engine (the executable may still land on a later attempt, flipping
+    subsequent executions back to device)."""
+
+    code = ErrCode.DeviceCompile
     sqlstate = "HY000"
 
 

@@ -208,23 +208,58 @@ _PIPE_CACHE_MAX = 256
 import threading as _threading
 
 _PIPE_STATS = {"hits": 0, "misses": 0, "traces": 0, "compiles": 0,
-               "compile_s": 0.0}
+               "compile_s": 0.0,
+               # background mirror: compile work done on compile-service
+               # worker threads lands here instead, so per-query compile_s
+               # stays the SYNC cost (bench splits sync_compile_s vs
+               # bg_compile_s from these)
+               "bg_traces": 0, "bg_compiles": 0, "bg_compile_s": 0.0,
+               # compile-mode counters (executor/compile_service.py):
+               # how each pipeline resolution was served — drives the
+               # per-fragment compile_mode EXPLAIN ANALYZE annotation
+               "mode_cached": 0, "mode_prewarmed": 0,
+               "mode_async_pending": 0, "mode_sync": 0}
 _PIPE_LOCK = _threading.Lock()
 _PIPE_TLS = _threading.local()
+
+#: process-total keys a compile-service worker thread redirects into the
+#: bg_* mirror (its own TLS keeps the plain names so observed_jit's
+#: trace-delta compile detection still works on that thread)
+_BG_ROUTED = frozenset({"traces", "compiles", "compile_s"})
+
+
+def mark_bg_thread(on: bool = True) -> bool:
+    """Mark the CALLING thread as a background compile worker: its
+    trace/compile charges route to the process bg_* keys (query-path
+    compile accounting must not absorb background work).  Returns the
+    previous mark so a SCOPED marking (compile_service._do_compile under
+    a supervisor deadline runs on a REUSED supervisor worker thread)
+    can restore it — a lingering mark would mis-route that worker's
+    later query-fragment compiles into the bg mirror."""
+    prev = getattr(_PIPE_TLS, "bg", False)
+    _PIPE_TLS.bg = on
+    return prev
 
 
 def _tls_stats() -> dict:
     st = getattr(_PIPE_TLS, "stats", None)
     if st is None:
         st = _PIPE_TLS.stats = {"hits": 0, "misses": 0, "traces": 0,
-                                "compiles": 0, "compile_s": 0.0}
+                                "compiles": 0, "compile_s": 0.0,
+                                "mode_cached": 0, "mode_prewarmed": 0,
+                                "mode_async_pending": 0, "mode_sync": 0}
     return st
 
 
 def _bump(key, amt=1):
+    pkey = key
+    if key in _BG_ROUTED and getattr(_PIPE_TLS, "bg", False):
+        pkey = "bg_" + key
     with _PIPE_LOCK:
-        _PIPE_STATS[key] += amt
-    _tls_stats()[key] += amt
+        _PIPE_STATS[pkey] += amt
+    st = _tls_stats()
+    if key in st:
+        st[key] += amt
 
 
 def pipe_cache_stats(thread_local: bool = False) -> dict:
@@ -257,6 +292,29 @@ def _pipe_cache_put(key, fn, dict_refs):
         _PIPE_CACHE[key] = (fn, dict_refs)
         if len(_PIPE_CACHE) > _PIPE_CACHE_MAX:
             _PIPE_CACHE.popitem(last=False)
+
+
+def acquire_pipeline(key, build, dict_refs, *, ctx=None, args=None,
+                     spec=None, shape="agg", sig="", ladder=True):
+    """THE pipeline resolution chokepoint: every compiled query pipeline
+    (scan-agg, streamed, window, join fragment, MPP) resolves through
+    here — cache hit, or the compile service (async background compile /
+    persistent-index warm start / sync build; executor/compile_service).
+
+    `build` is a zero-arg builder returning the jitted fn; `args` the
+    concrete call arguments (shapes recorded for background warming and
+    the prewarm ladder — pass them whenever the dispatch site has them).
+    Raises DeviceUnsupported when the fragment should run host-side
+    while its executable compiles in the background."""
+    fn = _pipe_cache_get(key)
+    if fn is not None:
+        from . import compile_service
+        compile_service.note_hit(key)
+        return fn
+    from . import compile_service
+    return compile_service.obtain(key, build, dict_refs, ctx=ctx,
+                                  args=args, spec=spec, shape=shape,
+                                  sig=sig, ladder=ladder)
 
 
 def _count_trace():
@@ -480,11 +538,14 @@ def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:
     capacity = dev.next_pow2(min(n, max(est, 16)))
     while True:
         key = (sig_exprs, capacity, key_pack, tuple(agg_ops))
-        fn = _pipe_cache_get(key)
-        if fn is None:
-            fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
-                                 tuple(agg_ops), capacity, key_pack)
-            _pipe_cache_put(key, fn, dict_refs)
+        cap = capacity
+
+        def build(cap=cap):
+            return _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                                   tuple(agg_ops), cap, key_pack)
+        fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                              args=(env, np.int64(n)), shape="agg",
+                              sig=sig_exprs)
         f = AggFetch(fn(env, np.int64(n)), topn=resolve_topn(plan, slots))
         ng = f.ng
         if ng <= capacity:
@@ -943,11 +1004,14 @@ def device_agg_streaming(plan, chunk: Chunk, conds, batch_rows: int,
     merge_cap = capacity  # grows to the true total on merge overflow
     for _attempt in range(8):
         key = (sig_exprs, "stream", capacity, key_pack, tuple(agg_ops))
-        fn = _pipe_cache_get(key)
-        if fn is None:
-            fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
-                                 tuple(agg_ops), capacity, key_pack)
-            _pipe_cache_put(key, fn, dict_refs)
+        cap = capacity
+
+        def build(cap=cap):
+            return _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                                   tuple(agg_ops), cap, key_pack)
+        fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                              spec=_stream_spec(col_arrays, batch_rows),
+                              shape="agg", sig=sig_exprs, ladder=False)
         k_flush = max(1, _MERGE_BUDGET_ROWS // capacity)
         state = None
         buffered = []
@@ -1033,11 +1097,13 @@ def _stream_agg_host_tail(plan, chunk, conds, batch_rows, ctx, col_arrays,
     n_keys = max(len(key_fns), 1)
     nvals = len(val_plan)
     key = (sig_exprs, "stream-rawtail", key_pack, tuple(agg_ops))
-    fn = _pipe_cache_get(key)
-    if fn is None:
-        fn = _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
-                             tuple(agg_ops), 1, key_pack, raw_tail=True)
-        _pipe_cache_put(key, fn, dict_refs)
+
+    def build():
+        return _build_pipeline(cond_fns, key_fns, n_keys, val_plan,
+                               tuple(agg_ops), 1, key_pack, raw_tail=True)
+    fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                          spec=_stream_spec(col_arrays, batch_rows),
+                          shape="agg", sig=sig_exprs, ladder=False)
     states = []
     for lo in range(0, n, batch_rows):
         hi = min(lo + batch_rows, n)
@@ -1062,6 +1128,19 @@ def _stream_agg_host_tail(plan, chunk, conds, batch_rows, ctx, col_arrays,
         raise DeviceUnsupported("empty global aggregate")
     return _assemble_agg(plan, key_meta, slots, dcols,
                          (key_out, key_null_out, results, result_nulls), ng)
+
+
+def _stream_spec(col_arrays, batch_rows: int):
+    """Arg-shape spec of one streamed block dispatch — (env, n_live)
+    with every column padded to `batch_rows` — for the compile service's
+    background warm (the env itself is built per block in the loop, so
+    the shapes are described instead of materialized)."""
+    import jax
+    env_spec = {idx: (jax.ShapeDtypeStruct((batch_rows,),
+                                           np.asarray(d).dtype),
+                      jax.ShapeDtypeStruct((batch_rows,), np.bool_))
+                for idx, (d, _nl) in col_arrays.items()}
+    return (env_spec, jax.ShapeDtypeStruct((), np.int64))
 
 
 #: partial-aggregate rows buffered on device before a merge flush (shared
@@ -1093,12 +1172,14 @@ def _stream_count_distinct(plan, conds, chunk, col_arrays, dcols, cond_fns,
             raise DeviceUnsupported(
                 "distinct pair state exceeds the stream budget")
         key = (sig_exprs, "cntd", capacity)
-        fn = _pipe_cache_get(key)
-        if fn is None:
-            fn = _build_pipeline(cond_fns, pair_fns, n_pair_keys,
-                                 [(val_fn, "int")], ("first",), capacity,
-                                 None)
-            _pipe_cache_put(key, fn, dict_refs)
+
+        def build(cap=capacity):
+            return _build_pipeline(cond_fns, pair_fns, n_pair_keys,
+                                   [(val_fn, "int")], ("first",), cap,
+                                   None)
+        fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                              spec=_stream_spec(col_arrays, batch_rows),
+                              shape="agg", sig=sig_exprs, ladder=False)
         partials = []
         for lo in range(0, n, batch_rows):
             hi = min(lo + batch_rows, n)
@@ -1507,10 +1588,9 @@ def device_window(p, chunk: Chunk, ctx=None) -> Chunk:
                  for f in p.funcs),
            tuple(f"{idx_}:{_dc_sig(dc)}" for idx_, dc in sorted(dcols.items())
                  if dc.dictionary is not None))
-    fn = _pipe_cache_get(("win",) + sig)
-    if fn is None:
-        fn = _timed_jit(run)
-        _pipe_cache_put(("win",) + sig, fn, dict_refs)
+    fn = acquire_pipeline(("win",) + sig, lambda: _timed_jit(run),
+                          dict_refs, ctx=ctx, args=(env, np.int64(n)),
+                          shape="window", sig=sig)
     outs = jax.device_get(fn(env, np.int64(n)))
 
     # outputs are padded to the bucket; positions past the live rows belong

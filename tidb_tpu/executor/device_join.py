@@ -51,7 +51,7 @@ from ..ops import device as dev
 from ..ops.device import DeviceUnsupported
 from .device_exec import (
     _assemble_agg, _count_trace, _estimate_groups, _expr_sig,
-    _pipe_cache_get, _pipe_cache_put, _plan_agg, _timed_jit)
+    _plan_agg, _timed_jit, acquire_pipeline)
 from .join_index import build_join_index
 
 
@@ -1076,13 +1076,18 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
         caps = [jn.cap for jn in joins]
         key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops),
                compact_cap)
-        fn = _pipe_cache_get(key)
         t0 = _time.perf_counter()
-        if fn is None:
-            fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
-                                  caps, capacity, key_pack, agg_meta,
-                                  compact_cap=compact_cap)
-            _pipe_cache_put(key, fn, dict_refs)
+
+        def build(caps=tuple(caps), cap=capacity, ccap=compact_cap):
+            # the leaves/joins/plan objects are OWNED by this execution;
+            # when the compile service defers this builder to a worker the
+            # query has already degraded to host, so nothing mutates them
+            return compile_fragment(root, leaves, joins, agg_plan,
+                                    agg_conds, list(caps), cap, key_pack,
+                                    agg_meta, compact_cap=ccap)
+        fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                              args=(env, jidx, n_lives), shape="join",
+                              sig=sig)
         agg_out, ovf_d, sovf_d, kept_d = fn(env, jidx, n_lives)
         from .device_exec import AggFetch, resolve_topn
         f = AggFetch(agg_out, extras=(ovf_d, sovf_d, kept_d),
@@ -1355,11 +1360,16 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
         caps = [page_rows] * len(joins)
         key = (sig, tuple(caps), capacity, key_pack, tuple(agg_ops), None,
                "paged")
-        fn = _pipe_cache_get(key)
-        if fn is None:
-            fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
-                                  caps, capacity, key_pack, agg_meta)
-            _pipe_cache_put(key, fn, dict_refs)
+
+        def build(caps=tuple(caps), cap=capacity):
+            return compile_fragment(root, leaves, joins, agg_plan,
+                                    agg_conds, list(caps), cap, key_pack,
+                                    agg_meta)
+        # per-page env is assembled inside the loop below, so there is no
+        # whole-call arg spec to record: the paged fragment compiles sync
+        # (still breaker-guarded + persisted through the compile service)
+        fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                              shape="join", sig=sig)
         k_flush = max(1, _MERGE_BUDGET_ROWS // capacity)
         state = None
         buffered = []
@@ -1449,12 +1459,13 @@ def _paged_join_agg_host_tail(root, leaves, joins, probe, agg_plan,
     n_keys = max(len(key_fns), 1)
     nvals = len(val_plan)
     key = (sig, key_pack, tuple(agg_ops), "rawtail")
-    fn = _pipe_cache_get(key)
-    if fn is None:
-        fn = compile_fragment(root, leaves, joins, agg_plan, agg_conds,
-                              [page_rows] * len(joins), 1, key_pack,
-                              agg_meta, raw_tail=True)
-        _pipe_cache_put(key, fn, dict_refs)
+
+    def build():
+        return compile_fragment(root, leaves, joins, agg_plan, agg_conds,
+                                [page_rows] * len(joins), 1, key_pack,
+                                agg_meta, raw_tail=True)
+    fn = acquire_pipeline(key, build, dict_refs, ctx=ctx, shape="join",
+                          sig=sig)
 
     def pad_page(arr, lo, hi, null_pad=False):
         return jnp.asarray(dev.pad_host(arr[lo:hi], page_rows, null_pad))

@@ -77,6 +77,17 @@ class QueryExecutor:
                 pipe_misses=st1["misses"] - st0["misses"],
                 xla_compiles=st1["compiles"] - st0["compiles"],
                 compile_s=round(st1["compile_s"] - st0["compile_s"], 3))
+            # how the compile service resolved this fragment's pipeline
+            # (executor/compile_service.py): the WORST mode that fired
+            # wins the label — a fragment that paid a sync compile or
+            # degraded on a pending background compile must not read
+            # `cached` because a later lookup hit
+            mode = next(
+                (m for m in ("async_pending", "sync", "prewarmed",
+                             "cached")
+                 if st1["mode_" + m] - st0["mode_" + m] > 0), None)
+            if mode is not None:
+                self.annotate(compile_mode=mode)
             from .supervisor import abandoned_calls
             n_abandoned = abandoned_calls()
             if n_abandoned:
@@ -101,6 +112,12 @@ class QueryExecutor:
             # this query pay an exchange capacity recompile"
             from . import mpp_exec
             self.annotate(**mpp_exec.report_gauges())
+            # compile service (executor/compile_service.py): background
+            # queue depth plus pending-fragment / persistent-cache-hit /
+            # prewarm counters once they have fired — "is this query's
+            # executable still compiling behind the host result"
+            from . import compile_service
+            self.annotate(**compile_service.report_gauges())
         return out
 
 

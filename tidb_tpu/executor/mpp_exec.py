@@ -62,8 +62,8 @@ from ..ops import device as dev
 from ..ops.device import DeviceUnsupported
 from ..parallel.mpp import RADIX_SUB, _mix64, _radix_bucket
 from .device_exec import (
-    _assemble_agg, _estimate_groups, _pipe_cache_get, _pipe_cache_put,
-    _plan_agg, engine_mode)
+    _assemble_agg, _estimate_groups, _plan_agg, acquire_pipeline,
+    engine_mode)
 from .device_join import (
     _CAP_STORE, _JoinNode, _Leaf, _cap_store_put, _combined_join_keys,
     _join_expand, _shift_expr, collect_tree, fragment_sig)
@@ -757,13 +757,19 @@ def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
                        xcaps[0], xcaps[1])
         key = (sig, tuple(caps), tuple(xcaps or ()), capacity, key_pack,
                tuple(agg_ops))
-        fn = _pipe_cache_get(key)
-        if fn is None:
-            fn = _build_mpp_pipeline(
+
+        def build(shuffle=shuffle, cap=capacity):
+            return _build_mpp_pipeline(
                 mesh, leaves, joins, root, sharded_ids, leaf_cond_fns,
                 cond_fns, key_fns, n_keys, val_plan, tuple(agg_ops),
-                capacity, key_pack, env_specs, shuffle=shuffle)
-            _pipe_cache_put(key, fn, dict_refs)
+                cap, key_pack, env_specs, shuffle=shuffle)
+        # mesh pipelines compile SYNC through the service (no arg spec):
+        # a background warm would dispatch zero-filled HOST arrays against
+        # a shard_map program traced for mesh-placed shardings — a
+        # different program than the one traffic dispatches.  The compile
+        # still gets the breaker/persist/failpoint ladder.
+        fn = acquire_pipeline(key, build, dict_refs, ctx=ctx,
+                              shape="mpp", sig=sig)
         try:
             failpoint.inject("mpp-exchange-send")
             agg_out, png_d, ovfs_d, sovfs_d, xneeds_d = fn(env, n_lives)

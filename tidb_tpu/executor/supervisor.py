@@ -287,6 +287,16 @@ def quarantined() -> bool:
     return _QUARANTINED[0]
 
 
+def fence_generation() -> int:
+    """Monotonic count of backend quarantines (bumps on every NEW fence
+    request).  The compile service stamps each background job with the
+    generation at submit time: a job whose build straddled an off-CPU
+    fence produced an executable pinning the DEAD client — comparing
+    generations at landing time makes that discard exact."""
+    with _LOCK:
+        return _QUAR_GEN[0]
+
+
 def _maybe_reinit():
     """If the backend is quarantined, drop every cache pinning compiled
     executables / placements of the suspect client and reinitialize.
@@ -339,6 +349,14 @@ def _reinit_backend():
         # pair must never interleave with this clear
         with mpp_exec._PLACE_LOCK:
             mpp_exec._MPP_PLACE_CACHE.clear()
+    except Exception:
+        pass
+    try:
+        # the compile service's origin map described entries of the pipe
+        # cache just cleared above; its RECIPES survive — they are how
+        # the prewarm ladder rebuilds against the fresh client
+        from . import compile_service
+        compile_service.on_backend_reinit()
     except Exception:
         pass
     try:

@@ -29,6 +29,17 @@ from ..utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _observed_jit(fn):
+    """jit with compile accounting (ops/device.observed_jit): the
+    library-embedder dist_* steps meter their traces/compile seconds into
+    the shared pipe stats, and the AST lint in tests/test_compile_service
+    confines raw ``jax.jit`` of query programs to the compile service +
+    kernel layer."""
+    from ..ops.device import observed_jit
+    return observed_jit(fn)
+
+
+
 def make_mesh(n_devices: int | None = None, axis: str = "part") -> Mesh:
     """1-D device mesh over the partition axis. Regions (the reference's
     ~100MiB shards) map to equal row-slices over this axis."""
@@ -197,7 +208,7 @@ def dist_agg_step(mesh: Mesh, kinds: tuple, capacity: int,
                                fng) > capacity
         return fk, fouts, fvalid, fng, overflow
 
-    return _supervised_step(jax.jit(step), ctx)
+    return _supervised_step(_observed_jit(step), ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -330,4 +341,4 @@ def dist_join_agg_step(mesh: Mesh, cap: int, axis: str = "part", ctx=None):
         dropped = jax.lax.psum(bdrop + pdrop, axis)
         return total, pairs, dropped
 
-    return _supervised_step(jax.jit(step), ctx)
+    return _supervised_step(_observed_jit(step), ctx)

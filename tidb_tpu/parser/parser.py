@@ -2465,6 +2465,10 @@ class Parser:
             while self._accept_op(","):
                 ids.append(self._int_lit())
             return ast.AdminStmt(kind="cancel_ddl_jobs", job_ids=ids)
+        if self._accept_kw("compile"):
+            # ADMIN COMPILE: prewarm the compile service's bucket ladder
+            # for every hot fragment recipe (executor/compile_service.py)
+            return ast.AdminStmt(kind="compile")
         raise ParseError("unsupported ADMIN statement")
 
 

@@ -19,6 +19,12 @@ def _mpp_snapshot() -> dict:
     return mpp_exec.snapshot()
 
 
+def _compiler_snapshot() -> dict:
+    """Compile-service gauges for /status and /metrics (process-wide)."""
+    from ..executor import compile_service
+    return compile_service.snapshot()
+
+
 class StatusServer:
     def __init__(self, domain, sql_server=None, host="127.0.0.1", port=10080):
         self.domain = domain
@@ -116,6 +122,12 @@ class StatusServer:
             # (capacity growth / transport / radix-exchange overflow),
             # placement-cache entries + residency-ledgered bytes
             "device_mpp": _mpp_snapshot(),
+            # compile service (executor/compile_service.py): background
+            # queue depth, worker pool, sync/bg compile counters, the
+            # persistent-index hits and the last classified compile error
+            # — a flaky remote-compile tunnel is diagnosable from the
+            # status port alone (the BENCH_TPU_LIVE Q5 failure mode)
+            "device_compiler": _compiler_snapshot(),
             # breaker stat lines keyed by (shape, resource group)
             "device_breakers": {
                 shape: br.snapshot() for shape, br in
@@ -153,6 +165,13 @@ class StatusServer:
         gauges.setdefault("mpp_retries", ms["retries"])
         gauges.setdefault("mpp_exchange_overflow_retries",
                           ms["exchange_overflow_retries"])
+        cs = _compiler_snapshot()
+        gauges.setdefault("compile_queue_depth", cs["compile_queue_depth"])
+        gauges.setdefault("compile_pending_fragments",
+                          cs["compile_pending_fragments"])
+        gauges.setdefault("compile_bg_seconds", cs["compile_bg_seconds"])
+        gauges.setdefault("compile_persist_hits",
+                          cs["compile_persist_hits"])
         # per-tenant degradations as ONE labeled series (a single TYPE
         # header — duplicate TYPE lines are invalid text exposition and
         # fail the whole scrape); the observe-sink mirror keys them

@@ -84,6 +84,13 @@ class Domain:
         # per domain): (db, table) -> {"mode": read|write, conn_id: mode}
         self.table_locks: dict[tuple, dict] = {}
         self.table_locks_mu = threading.Lock()
+        # compile-service prewarm (executor/compile_service.py): globals
+        # are in-memory only, so at Domain start the opt-in is the
+        # TIDB_TPU_COMPILE_PREWARM env var — recipes survive Domain
+        # churn, so a re-created embedded Domain starts its ladder warm;
+        # the sysvar path kicks from SET GLOBAL tidb_compile_prewarm
+        from ..executor import compile_service
+        compile_service.maybe_prewarm_on_start(self)
 
     def reload_schema(self):
         """reference: domain.Reload — full load on version change. The
@@ -485,6 +492,14 @@ class Session:
                     f": '{v}'")
         if scope == "global":
             self.domain.global_vars[name] = v
+            if (name == "tidb_compile_prewarm"
+                    and str(v).upper() in ("ON", "1")):
+                # globals are in-memory only, so the Domain-start hook
+                # reads an empty dict on every boot — SET GLOBAL is the
+                # moment the operator's intent actually exists; kick the
+                # background prewarm NOW (executor/compile_service.py)
+                from ..executor import compile_service
+                compile_service.maybe_prewarm_on_start(self.domain)
         else:
             self.session_vars[name] = v
 
@@ -2080,6 +2095,18 @@ class Session:
             info = self.infoschema().table_by_name(db, tn.name)
             check_index(self, info, stmt.index_name)
             return Result()
+        if stmt.kind == "compile":
+            # ADMIN COMPILE: background-compile the geometric bucket
+            # ladder for every hot fragment recipe and WAIT, so the
+            # statement returns a final count (executor/compile_service)
+            from ..executor import compile_service
+            rep = compile_service.prewarm(ctx=self, wait=True)
+            ft_i = FieldType(tp=TYPE_LONGLONG)
+            return Result(
+                names=["submitted", "prewarmed", "failed"],
+                chunk=Chunk.from_rows(
+                    [ft_i, ft_i, ft_i],
+                    [(rep["submitted"], rep["prewarmed"], rep["failed"])]))
         raise TiDBError(f"unsupported ADMIN {stmt.kind}")
 
     def _exec_execute(self, stmt: ast.ExecuteStmt) -> Result:

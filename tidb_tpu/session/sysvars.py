@@ -267,6 +267,29 @@ for _v in [
     # each grant advances the tenant's virtual clock by 1/weight, lowest
     # clock goes next — heavier tenants get proportionally more slots
     SysVar("tidb_device_wfq_weights", SCOPE_BOTH, "", "str"),
+    # -- compile service (executor/compile_service.py) ------------------
+    # ON: a cold compiled-pipeline cache miss submits the fragment
+    # signature to the background compile pool and THIS execution serves
+    # from the host engine (no breaker charge) — first-query latency is
+    # bounded by host speed, never by XLA; when the executable lands,
+    # same-shaped queries flip to the device with zero new traces.
+    # OFF (default): cache misses compile inline as before (still
+    # breaker-guarded + persisted through the compile service)
+    SysVar("tidb_compile_async", SCOPE_BOTH, "OFF", "bool"),
+    # SET GLOBAL ... = ON kicks a background prewarm of every registered
+    # fragment recipe's bucket ladder, immediately and on any later
+    # Domain start in this process (globals are in-memory, so the SET is
+    # when the intent exists; see ADMIN COMPILE for the waiting form)
+    SysVar("tidb_compile_prewarm", SCOPE_BOTH, "OFF", "bool"),
+    # background compile worker threads (process-wide pool, GLOBAL-scope
+    # read: a session SET must not resize the shared pool)
+    SysVar("tidb_compile_workers", SCOPE_BOTH, "2", "int", 1, 64),
+    # wall-clock deadline (seconds) for ONE background compile attempt,
+    # enforced by the device-runtime supervisor: a hung remote compile is
+    # abandoned + fenced like any device hang, then retried on the
+    # compileRetry curve. 0 = no deadline (the default: CPU-backend
+    # builds are in-process and cannot tunnel-hang)
+    SysVar("tidb_compile_timeout", SCOPE_BOTH, "0", "float", 0),
     SysVar("tidb_broadcast_join_threshold_size", SCOPE_BOTH,
            str(100 * 1024 * 1024), "int", 0),
     SysVar("tidb_broadcast_join_threshold_count", SCOPE_BOTH,

@@ -22,6 +22,10 @@ Error taxonomy (classify()): the classes the distributed path can see —
     device     accelerator compile/OOM/runtime failure
     transport  remote-compile / tunnel transport errors (the dead-tunnel
                "Connection refused" mode from BENCH_TPU_LIVE.json)
+    compile    the compile service could not BUILD a device executable
+               (executor/compile_service.py — a remote-compile RPC died
+               mid-build or an injected compile fault fired; distinct
+               from `device`, which is an executable that RAN and failed)
     hang       a supervised device call blew its wall-clock deadline
                (executor/supervisor.py — the backend froze inside a
                GIL-holding C call, distinct from a device that ERRORS)
@@ -51,6 +55,7 @@ CLASS_LEASE = "lease"
 CLASS_EXCHANGE = "exchange"
 CLASS_DEVICE = "device"
 CLASS_TRANSPORT = "transport"
+CLASS_COMPILE = "compile"
 CLASS_HANG = "hang"
 CLASS_ADMISSION = "admission"
 CLASS_FAULT = "fault"
@@ -88,12 +93,15 @@ def _mro_names(err) -> set:
 def classify(err) -> str:
     """Map an exception to its resilience class (one label the breaker,
     the backoffer and the slow log all agree on)."""
-    from .failpoint import FailpointError
-    from ..errors import DeviceAdmissionError, DeviceHangError
+    from .failpoint import FailpointError, InjectedCompileError
+    from ..errors import (DeviceAdmissionError, DeviceCompileError,
+                          DeviceHangError)
     if isinstance(err, DeviceHangError):
         return CLASS_HANG
     if isinstance(err, DeviceAdmissionError):
         return CLASS_ADMISSION
+    if isinstance(err, (DeviceCompileError, InjectedCompileError)):
+        return CLASS_COMPILE
     if isinstance(err, (LockedError, WriteConflictError, DeadlockError,
                         SchemaChangedError)):
         return CLASS_REGION
@@ -182,6 +190,11 @@ KINDS = {k.name: k for k in [
     # MPP exchange send/recv transport failure (boTiFlashRPC)
     Kind("exchangeRetry", base_ms=2, cap_ms=40, jitter="equal",
          max_attempts=6),
+    # background-compile RPC/transport failure (executor/compile_service):
+    # a flaky remote-compile tunnel is retried on a short curve before the
+    # job fails classified and charges the compile-scoped breaker
+    Kind("compileRetry", base_ms=5, cap_ms=100, jitter="equal",
+         max_attempts=4),
 ]}
 # (no "lease"/"device" kinds yet: campaign losses degrade by skipping the
 # round, and device failures route through the circuit breaker, not a

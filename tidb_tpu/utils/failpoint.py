@@ -42,6 +42,18 @@ class InjectedAdmissionError(Exception):
     would classify ``fault`` instead of ``admission``."""
 
 
+class InjectedCompileError(Exception):
+    """Raised by an enabled ``compile-fail`` / ``N*compile-fail``
+    failpoint: a synthetic remote-compile failure (the dead-tunnel
+    "Connection refused" mode from BENCH_TPU_LIVE.json, at the COMPILE
+    boundary instead of the dispatch boundary).  The compile service
+    (executor/compile_service.py) retries it on the ``compileRetry``
+    backoff curve, then charges the compile-scoped circuit breaker and
+    degrades the fragment to the host engine.  Deliberately NOT a
+    FailpointError: that would classify ``fault`` instead of ``compile``
+    and skip the retry/breaker ladder this failpoint exists to test."""
+
+
 class InjectedOOMError(Exception):
     """Raised by an enabled ``oom`` / ``N*oom`` failpoint: a synthetic
     device out-of-memory whose MESSAGE mimics jaxlib's XlaRuntimeError
@@ -120,6 +132,25 @@ def inject(name: str):
         #   — models transient HBM pressure the evict+retry ladder absorbs
         if hit <= int(m.group(1)):
             raise InjectedOOMError(_oom_message(name))
+        return None
+    if action == "compile-fail":
+        raise InjectedCompileError(
+            "Connection refused: remote compile service unreachable "
+            f"(injected by failpoint {name})")
+    m = re.fullmatch(r"(\d+)\*compile-fail", action)
+    if m:  # N*compile-fail: fail the first N compiles, then succeed —
+        #   models a flaky remote-compile tunnel the retry curve absorbs
+        if hit <= int(m.group(1)):
+            raise InjectedCompileError(
+                "Connection refused: remote compile service unreachable "
+                f"(injected by failpoint {name})")
+        return None
+    m = re.fullmatch(r"(?:(\d+)\*)?compile-slow\(([\d.]+)\)", action)
+    if m:  # [N*]compile-slow(s): stall the first N compiles (all when N
+        #   omitted) — models a slow remote compile; under
+        #   tidb_compile_timeout the supervisor abandons it like a hang
+        if m.group(1) is None or hit <= int(m.group(1)):
+            time.sleep(float(m.group(2)))
         return None
     if action == "admission-queue-full":
         raise InjectedAdmissionError(
