@@ -114,6 +114,10 @@ READ_FAULTS = {
     "mpp-exchange-recv": ["1*panic", "panic"],
     "coordinator-tso-skew": ["return(262144)"],
     "coordinator-campaign-loss": ["return(1)"],
+    # a held lease lapsing out from under its owner: the next campaign
+    # (any holder) wins and the watchers re-notify — reads must stay
+    # exact through the ownership churn
+    "coordinator-lease-expire": ["return(1)"],
     "coordinator-heartbeat-lost": ["return(1)"],
 }
 
@@ -290,6 +294,7 @@ THREADED_FAULTS = {
     "mpp-exchange-send": ["1*panic", "panic"],
     "mpp-exchange-recv": ["1*panic"],
     "coordinator-tso-skew": ["return(262144)"],
+    "coordinator-lease-expire": ["return(1)"],
     "coordinator-heartbeat-lost": ["return(1)"],
     "txn-before-prewrite": ["1*panic"],
     "txn-after-prewrite": ["1*panic"],

@@ -397,44 +397,11 @@ class TestGaugesSurfaced:
 # -- lint: jax.jit of query pipelines is confined -----------------------------
 
 class TestJitConfinementLint:
-    ALLOWED = {
-        os.path.join("executor", "compile_service.py"),
-        os.path.join("ops", "device.py"),
-    }
-
     def test_direct_jit_confined_to_compile_layer(self):
-        """Any raw ``jax.jit`` (or AOT ``.lower()``/``.compile()`` chained
-        off a jit call) outside the compile layer bypasses async
-        compilation, the compile breaker and the trace accounting —
-        every query pipeline must build through
-        device_exec.acquire_pipeline -> compile_service.obtain, and every
-        kernel jit through ops/device.observed_jit."""
-        root = os.path.join(os.path.dirname(__file__), "..", "tidb_tpu")
-        offenders = []
-        for dirpath, _dirs, files in os.walk(os.path.abspath(root)):
-            for fname in files:
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                rel = os.path.relpath(path, os.path.abspath(root))
-                if rel in self.ALLOWED:
-                    continue
-                with open(path) as f:
-                    tree = ast.parse(f.read(), filename=path)
-                for node in ast.walk(tree):
-                    if not isinstance(node, ast.Attribute):
-                        continue
-                    if (node.attr == "jit"
-                            and isinstance(node.value, ast.Name)
-                            and node.value.id == "jax"):
-                        offenders.append(f"{rel}:{node.lineno} jax.jit")
-                    # AOT chain: jax.jit(...).lower(...) / .compile()
-                    if (node.attr in ("lower", "compile")
-                            and isinstance(node.value, ast.Call)
-                            and isinstance(node.value.func, ast.Attribute)
-                            and node.value.func.attr == "jit"):
-                        offenders.append(
-                            f"{rel}:{node.lineno} .{node.attr}")
-        assert not offenders, (
-            "query pipelines compiled outside the compile service "
-            f"(use acquire_pipeline / observed_jit): {offenders}")
+        """Registry rule (tidb_tpu/lint rules/confinement.py): raw
+        jax.jit (and AOT .lower()/.compile() chains) outside the compile
+        layer bypass async compilation, the compile breaker and trace
+        accounting."""
+        from tidb_tpu.lint import run_rule
+        findings = run_rule("jit-confinement")
+        assert not findings, [f.to_json() for f in findings]

@@ -1,0 +1,622 @@
+"""Project lint engine (tidb_tpu/lint): synthetic-source fixtures per
+rule (positive + negative + allowlisted), allowlist/baseline round-trip,
+and the tier-1 full-repo run — CI fails on any new unallowlisted finding.
+"""
+
+import ast
+import json
+import subprocess
+import sys
+
+import pytest
+
+import tidb_tpu.lint.rules  # noqa: F401 — populate the registry
+from tidb_tpu.lint import (Allowlist, Context, RULES, run_repo, run_rules,
+                           write_baseline)
+from tidb_tpu.lint.engine import SourceFile
+
+
+def make_ctx(files: dict, aux: dict | None = None) -> Context:
+    """In-memory fixture tree: rel-path -> source text."""
+    fs = [SourceFile(rel, rel, text, ast.parse(text))
+          for rel, text in files.items()]
+    fs += [SourceFile(rel, rel, text, ast.parse(text), aux=True)
+           for rel, text in (aux or {}).items()]
+    return Context(fs)
+
+
+def run_one(rule: str, files: dict, aux: dict | None = None):
+    return RULES[rule].run(make_ctx(files, aux))
+
+
+# -- engine: allowlist + baseline ---------------------------------------------
+
+class TestAllowlist:
+    def test_reason_required(self, tmp_path):
+        p = tmp_path / "al.txt"
+        p.write_text("some-rule pat:* \n")
+        with pytest.raises(ValueError):
+            Allowlist.load(str(p))
+        p.write_text("some-rule pat:* -- \n")
+        with pytest.raises(ValueError):
+            Allowlist.load(str(p))
+
+    def test_match_suppresses_and_stale_reported(self, tmp_path):
+        files = {"a.py": "try:\n    pass\nexcept Exception:\n    pass\n"}
+        p = tmp_path / "al.txt"
+        p.write_text(
+            "exception-swallow a.py:swallow@* -- fixture reason\n"
+            "exception-swallow never.py:* -- stale entry\n")
+        al = Allowlist.load(str(p))
+        report = run_rules(make_ctx(files), al,
+                           rules=["exception-swallow"])
+        assert not report.findings
+        assert len(report.allowlisted) == 1
+        assert report.allowlisted[0][1].reason == "fixture reason"
+        assert len(report.stale) == 1
+        assert not report.ok  # stale entries fail the run
+
+    def test_stale_only_for_rules_that_ran(self, tmp_path):
+        p = tmp_path / "al.txt"
+        p.write_text("lock-order x:* -- other rule's entry\n")
+        al = Allowlist.load(str(p))
+        report = run_rules(make_ctx({"a.py": "x = 1\n"}), al,
+                           rules=["exception-swallow"])
+        assert report.ok  # the lock-order entry is not stale-checked
+
+    def test_baseline_round_trip(self, tmp_path):
+        files = {
+            "a.py": "try:\n    pass\nexcept Exception:\n    pass\n",
+            "b.py": "try:\n    pass\nexcept:\n    pass\n",
+        }
+        p = tmp_path / "al.txt"
+        report = run_rules(make_ctx(files), Allowlist(),
+                           rules=["exception-swallow"])
+        assert len(report.findings) == 2
+        write_baseline(report, str(p))
+        al = Allowlist.load(str(p))
+        report2 = run_rules(make_ctx(files), al,
+                            rules=["exception-swallow"])
+        assert report2.ok
+        assert len(report2.allowlisted) == 2
+
+    def test_identity_is_line_independent(self):
+        src1 = "def f():\n    try:\n        pass\n" \
+               "    except Exception:\n        pass\n"
+        src2 = "# moved\n\n\n" + src1
+        (f1,) = run_one("exception-swallow", {"a.py": src1})
+        (f2,) = run_one("exception-swallow", {"a.py": src2})
+        assert f1.key == f2.key
+        assert f1.line != f2.line
+
+
+# -- exception-swallow --------------------------------------------------------
+
+SWALLOW = """
+import logging
+log = logging.getLogger("x")
+
+def swallowed():
+    try:
+        work()
+    except Exception:
+        pass
+
+def bare():
+    try:
+        work()
+    except:
+        return 0
+
+def reraised():
+    try:
+        work()
+    except Exception:
+        raise
+
+def logged():
+    try:
+        work()
+    except Exception as e:
+        log.warning("failed: %%s", e)
+
+def classified():
+    try:
+        work()
+    except Exception as e:
+        label = classify(e)
+
+def handed_on():
+    try:
+        work()
+    except Exception as e:
+        job.fail(str(e))
+
+def handed_on_kw():
+    try:
+        work()
+    except Exception as e:
+        job.fail(error=str(e))
+
+def typed():
+    try:
+        work()
+    except ValueError:
+        pass
+"""
+
+
+class TestExceptionSwallow:
+    def test_positive_negative(self):
+        out = run_one("exception-swallow", {"m.py": SWALLOW})
+        idents = {f.ident for f in out}
+        assert idents == {"swallow@swallowed", "swallow@bare"}
+
+    def test_multiple_handlers_disambiguated(self):
+        src = ("def f():\n"
+               "    try:\n        a()\n    except Exception:\n"
+               "        pass\n"
+               "    try:\n        b()\n    except Exception:\n"
+               "        pass\n")
+        out = run_one("exception-swallow", {"m.py": src})
+        assert {f.ident for f in out} == {"swallow@f", "swallow@f#1"}
+
+
+# -- lock rules ---------------------------------------------------------------
+
+CYCLE = """
+import threading
+_A = threading.Lock()
+_B = threading.Lock()
+
+def one():
+    with _A:
+        with _B:
+            pass
+
+def two():
+    with _B:
+        with _A:
+            pass
+"""
+
+NO_CYCLE = """
+import threading
+_A = threading.Lock()
+_B = threading.Lock()
+
+def one():
+    with _A:
+        with _B:
+            pass
+
+def two():
+    with _A:
+        with _B:
+            pass
+"""
+
+SELF_DEADLOCK = """
+import threading
+_A = threading.Lock()
+_R = threading.RLock()
+
+def bad():
+    with _A:
+        with _A:
+            pass
+
+def fine():
+    with _R:
+        with _R:
+            pass
+"""
+
+CROSS_CALL_CYCLE = """
+import threading
+_A = threading.Lock()
+_B = threading.Lock()
+
+def takes_b():
+    with _B:
+        helper()
+
+def helper():
+    with _A:
+        pass
+
+def takes_a():
+    with _A:
+        with _B:
+            pass
+"""
+
+
+class TestLockOrder:
+    def test_cycle_detected(self):
+        out = run_one("lock-order", {"m.py": CYCLE})
+        assert len(out) == 1
+        assert out[0].ident.startswith("cycle:")
+        assert "m._A" in out[0].ident and "m._B" in out[0].ident
+
+    def test_consistent_order_clean(self):
+        assert run_one("lock-order", {"m.py": NO_CYCLE}) == []
+
+    def test_self_deadlock_plain_lock_only(self):
+        out = run_one("lock-order", {"m.py": SELF_DEADLOCK})
+        assert [f.ident for f in out] == ["self-deadlock:m._A"]
+
+    def test_cycle_through_call_graph(self):
+        out = run_one("lock-order", {"m.py": CROSS_CALL_CYCLE})
+        assert len(out) == 1 and out[0].ident.startswith("cycle:")
+
+    def test_multi_item_with_orders(self):
+        src = ("import threading\n"
+               "_A = threading.Lock()\n_B = threading.Lock()\n"
+               "def one():\n    with _A, _B:\n        pass\n"
+               "def two():\n    with _B:\n        with _A:\n"
+               "            pass\n")
+        out = run_one("lock-order", {"m.py": src})
+        assert len(out) == 1 and out[0].ident.startswith("cycle:")
+
+    def test_uninventoried_self_lock_not_guessed(self):
+        # class A's lock comes from a helper (not inventoried); its
+        # nested with must NOT bind to class B's same-named plain Lock
+        src = ("import threading\n"
+               "class A:\n"
+               "    def __init__(self):\n"
+               "        self._mu = make_rlock()\n"
+               "    def reenter(self):\n"
+               "        with self._mu:\n"
+               "            with self._mu:\n"
+               "                pass\n"
+               "class B:\n"
+               "    def __init__(self):\n"
+               "        self._mu = threading.Lock()\n")
+        assert run_one("lock-order", {"m.py": src}) == []
+
+
+BLOCKING = """
+import threading
+import time
+_LOCK = threading.Lock()
+
+def bad():
+    with _LOCK:
+        time.sleep(0.1)
+
+def bad2(fn):
+    with _LOCK:
+        call_supervised(fn)
+
+def fine():
+    with _LOCK:
+        x = 1
+    time.sleep(0.1)
+
+class C:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def inst_lock_ok(self):
+        with self._mu:
+            time.sleep(0.1)  # instance lock: out of scope for this rule
+"""
+
+
+class TestBlockingWhileLocked:
+    def test_positive_negative(self):
+        out = run_one("blocking-while-locked", {"m.py": BLOCKING})
+        assert {f.ident for f in out} == {
+            "blocking:sleep@bad", "blocking:call_supervised@bad2"}
+
+
+# -- traced-value hazard ------------------------------------------------------
+
+TRACED = """
+import jax
+from functools import partial
+
+def body(x, n):
+    if n > 0:
+        return x
+    return x * 2
+
+_k = observed_jit(body)
+
+def shaped(x):
+    if x.shape[0] > 4:
+        return x
+    return int(x.shape[0]) + len(x)
+
+_k2 = observed_jit(shaped)
+
+@partial(jax.jit, static_argnames=("cap",))
+def bucketed(x, cap):
+    if cap > 8:
+        return x
+    return x
+
+@jax.jit
+def concretizes(x):
+    return int(x)
+
+def plain(x):
+    if x > 0:
+        return 1
+    return 0
+"""
+
+
+class TestTracedValueHazard:
+    def test_findings(self):
+        out = run_one("traced-value-hazard", {"m.py": TRACED})
+        idents = {f.ident for f in out}
+        # body branches on traced n; concretizes int()s its arg; the
+        # shape-derived branch, static_argnames branch and the un-jitted
+        # plain() are all clean
+        assert idents == {"branch@body", "concretize-int@concretizes"}
+
+    def test_range_and_iteration(self):
+        src = ("import jax\n"
+               "@jax.jit\n"
+               "def f(n, xs):\n"
+               "    for i in range(n):\n"
+               "        pass\n"
+               "    for v in xs:\n"
+               "        pass\n")
+        out = run_one("traced-value-hazard", {"m.py": src})
+        assert {f.ident for f in out} == {"iterate@f", "iterate@f#1"}
+
+
+# -- taxonomy -----------------------------------------------------------------
+
+ERRORS_OK = """
+class ErrCode:
+    BackoffExhausted = 9005
+    DeviceHang = 9008
+
+class BackoffExhaustedError(Exception):
+    code = ErrCode.BackoffExhausted
+
+class DeviceHangError(Exception):
+    code = ErrCode.DeviceHang
+"""
+
+BACKOFF_OK = """
+CLASS_HANG = "hang"
+CLASS_OTHER = "other"
+
+def classify(err):
+    from ..errors import DeviceHangError
+    if isinstance(err, DeviceHangError):
+        return CLASS_HANG
+    return CLASS_OTHER
+"""
+
+
+class TestTaxonomy:
+    def test_clean(self):
+        out = run_one("taxonomy-consistency",
+                      {"errors.py": ERRORS_OK,
+                       "utils/backoff.py": BACKOFF_OK})
+        assert out == []
+
+    def test_duplicate_engine_code(self):
+        errors = ERRORS_OK + "\nclass OtherError(Exception):\n" \
+            "    code = 9008\n"
+        out = run_one("taxonomy-consistency",
+                      {"errors.py": errors,
+                       "utils/backoff.py": BACKOFF_OK})
+        assert any(f.ident == "dup-code:9008" for f in out)
+
+    def test_orphan_code(self):
+        errors = ERRORS_OK.replace(
+            "    DeviceHang = 9008",
+            "    DeviceHang = 9008\n    Reserved = 9011")
+        out = run_one("taxonomy-consistency",
+                      {"errors.py": errors,
+                       "utils/backoff.py": BACKOFF_OK})
+        assert any(f.ident == "orphan-code:Reserved" for f in out)
+
+    def test_dead_class_constant(self):
+        backoff = BACKOFF_OK + '\nCLASS_GHOST = "ghost"\n'
+        out = run_one("taxonomy-consistency",
+                      {"errors.py": ERRORS_OK,
+                       "utils/backoff.py": backoff})
+        assert any(f.ident == "dead-class:CLASS_GHOST" for f in out)
+
+    def test_unclassified_device_error(self):
+        errors = ERRORS_OK + "\nclass DeviceGhostError(Exception):\n" \
+            "    code = 9013\n"
+        out = run_one("taxonomy-consistency",
+                      {"errors.py": errors,
+                       "utils/backoff.py": BACKOFF_OK})
+        assert any(f.ident == "unclassified:DeviceGhostError"
+                   for f in out)
+
+
+# -- failpoint coverage -------------------------------------------------------
+
+HARNESS = """
+READ_FAULTS = {"known-point": ["panic"]}
+WRITE_FAULTS = {"txn-point": ["1*panic"]}
+THREADED_FAULTS = {"threaded-point": ["sleep(0.01)"]}
+"""
+
+INJECTS = """
+from .utils import failpoint
+
+def covered():
+    failpoint.inject("known-point")
+    failpoint.inject("txn-point")
+    failpoint.inject("threaded-point")
+
+def uncovered():
+    failpoint.inject("ghost-point")
+
+def nonliteral(name):
+    failpoint.inject(name)
+"""
+
+
+class TestFailpointCoverage:
+    def test_positive_negative(self):
+        out = run_one("failpoint-coverage", {"m.py": INJECTS},
+                      aux={"tests/chaos_harness.py": HARNESS})
+        idents = {f.ident for f in out}
+        assert idents == {"uncataloged:ghost-point",
+                          "inject-nonliteral@nonliteral"}
+
+    def test_no_harness_no_coverage_check(self):
+        out = run_one("failpoint-coverage", {"m.py": INJECTS})
+        assert {f.ident for f in out} == {"inject-nonliteral@nonliteral"}
+
+
+# -- gauge consistency --------------------------------------------------------
+
+GAUGE_STATUS = """
+def _status(self):
+    from ..executor import widget
+    return {"device_widget": widget.snapshot()}
+"""
+
+GAUGE_WIDGET = """
+STATS = {"widget_hits": 0, "widget_lost": 0}
+
+def snapshot():
+    return {"widget_hits": STATS["widget_hits"]}
+
+def report_gauges():
+    return {"widget_hits": STATS["widget_hits"]}
+
+def _publish_gauges():
+    vals = {"widget_hits": STATS["widget_hits"],
+            "widget_lost": STATS["widget_lost"]}
+    for obs in []:
+        for k, v in vals.items():
+            obs.set_gauge(k, v)
+"""
+
+GAUGE_EXEC = """
+from . import widget
+
+class Exec:
+    def execute(self):
+        self.annotate(**widget.report_gauges())
+"""
+
+
+class TestGaugeConsistency:
+    def test_unsurfaced_found_surfaced_clean(self):
+        out = run_one("gauge-consistency",
+                      {"server/http_status.py": GAUGE_STATUS,
+                       "executor/widget.py": GAUGE_WIDGET,
+                       "executor/exec_select.py": GAUGE_EXEC})
+        idents = {f.ident for f in out}
+        # widget_hits reaches /status via snapshot() and EXPLAIN via the
+        # report_gauges splat; widget_lost reaches neither
+        assert idents == {"unsurfaced-status:widget_lost",
+                          "unsurfaced-explain:widget_lost"}
+
+    def test_annotate_kwarg_counts_as_surfaced(self):
+        exec_src = GAUGE_EXEC + (
+            "\n\ndef annotate_direct(self, n):\n"
+            "    self.annotate(widget_lost=n)\n")
+        status = GAUGE_STATUS.replace(
+            '"device_widget": widget.snapshot()',
+            '"device_widget": widget.snapshot(), "widget_lost": 0')
+        out = run_one("gauge-consistency",
+                      {"server/http_status.py": status,
+                       "executor/widget.py": GAUGE_WIDGET,
+                       "executor/exec_select.py": exec_src})
+        assert out == []
+
+
+# -- migrated confinement rules ----------------------------------------------
+
+class TestConfinementRules:
+    def test_jit_confinement(self):
+        src = "import jax\n\ndef f(fn):\n    return jax.jit(fn)\n"
+        out = run_one("jit-confinement", {"executor/rogue.py": src})
+        assert [f.ident for f in out] == ["jax.jit@f"]
+        # the sanctioned compile layer is rule config, not a finding
+        assert run_one("jit-confinement",
+                       {"executor/compile_service.py": src}) == []
+
+    def test_jit_aot_chain(self):
+        src = "import jax\nk = jax.jit(f).lower(x).compile()\n"
+        out = run_one("jit-confinement", {"m.py": src})
+        idents = {f.ident for f in out}
+        assert "jax.jit@<module>" in idents
+        assert any(i.startswith("jit-aot-") for i in idents)
+
+    def test_device_slot_confinement(self):
+        src = ("def f(col):\n    col._device = thing\n"
+               "\ndef g(col):\n    col._device = None\n")
+        out = run_one("device-slot-confinement", {"m.py": src})
+        assert {f.ident for f in out} == {"_device@f", "_device=None@g"}
+        assert run_one("device-slot-confinement",
+                       {"ops/residency.py": src}) == []
+        # chunk.py may None-init the slot but not otherwise touch it
+        out = run_one("device-slot-confinement", {"utils/chunk.py": src})
+        assert {f.ident for f in out} == {"_device@f"}
+
+    def test_supervised_confinement(self):
+        src = "def f(fn):\n    return call_supervised(fn, deadline_s=1)\n"
+        out = run_one("supervised-confinement", {"m.py": src})
+        assert [f.ident for f in out] == ["call_supervised@f"]
+        assert run_one("supervised-confinement",
+                       {"executor/scheduler.py": src}) == []
+
+    def test_confinement_not_allowlistable(self, tmp_path):
+        """An allowlist line can never quietly neutralize an
+        architectural gate: the finding stays AND the entry is stale."""
+        src = "import jax\n\ndef f(fn):\n    return jax.jit(fn)\n"
+        p = tmp_path / "al.txt"
+        p.write_text("jit-confinement executor/rogue.py:* -- nope\n")
+        report = run_rules(make_ctx({"executor/rogue.py": src}),
+                           Allowlist.load(str(p)),
+                           rules=["jit-confinement"])
+        assert len(report.findings) == 1
+        assert len(report.stale) == 1
+        assert not report.ok
+
+    def test_run_device_shape(self):
+        src = ("def a(ctx, fn):\n    return run_device(ctx, fn)\n"
+               "\ndef b(ctx, fn):\n"
+               "    return run_device(ctx, fn, shape='join')\n"
+               "\ndef c(ctx, fn):\n"
+               "    return x._with_pipe_stats(run_device, ctx, fn)\n")
+        out = run_one("run-device-shape", {"m.py": src})
+        assert {f.ident for f in out} == {
+            "run_device@a", "_with_pipe_stats@c"}
+
+
+# -- the tier-1 gate: full-repo run is clean ----------------------------------
+
+class TestFullRepo:
+    def test_repo_clean(self):
+        report = run_repo()
+        assert len(report.rules_run) >= 10
+        assert not report.findings, report.human()
+        assert not report.stale, report.human()
+        # the burn-down inventory is real: allowlisted findings exist and
+        # every entry carries a reason
+        assert report.allowlisted
+        assert all(e.reason for _f, e in report.allowlisted)
+
+    def test_cli_json_exit_zero(self):
+        import os
+        import tidb_tpu
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(tidb_tpu.__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "tidb_tpu.lint", "--json"],
+            capture_output=True, text=True, timeout=300, cwd=repo_root,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["ok"] is True
+        assert payload["counts"]["findings"] == 0
+        assert payload["counts"]["allowlisted"] > 0

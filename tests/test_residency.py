@@ -379,42 +379,13 @@ class TestGaugesSurfaced:
 
 class TestDeviceCacheLint:
     def test_device_slot_access_confined_to_residency(self):
-        """Any direct read/write of ``._device`` outside ops/residency.py
-        is unaccounted HBM caching — the ledger (budget, epoch, OOM
-        eviction) only works if every cached upload goes through the
-        manager.  Sole exception: ``self._device = None`` slot
-        initialization in utils/chunk.py constructors (a fresh Column has
-        no cache to account)."""
-        root = os.path.join(os.path.dirname(__file__), "..", "tidb_tpu")
-        offenders = []
-        for dirpath, _dirs, files in os.walk(os.path.abspath(root)):
-            for fname in files:
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                rel = os.path.relpath(path, os.path.abspath(root))
-                if rel == os.path.join("ops", "residency.py"):
-                    continue
-                with open(path) as f:
-                    tree = ast.parse(f.read(), filename=path)
-                allowed = set()
-                if rel == os.path.join("utils", "chunk.py"):
-                    for node in ast.walk(tree):
-                        if (isinstance(node, ast.Assign)
-                                and isinstance(node.value, ast.Constant)
-                                and node.value.value is None):
-                            for tgt in node.targets:
-                                if (isinstance(tgt, ast.Attribute)
-                                        and tgt.attr == "_device"):
-                                    allowed.add(id(tgt))
-                for node in ast.walk(tree):
-                    if (isinstance(node, ast.Attribute)
-                            and node.attr == "_device"
-                            and id(node) not in allowed):
-                        offenders.append(f"{rel}:{node.lineno}")
-        assert not offenders, (
-            "._device accessed outside ops/residency.py (unaccounted HBM "
-            f"caching): {offenders}")
+        """Registry rule (tidb_tpu/lint rules/confinement.py): any direct
+        ._device access outside ops/residency.py is unaccounted HBM
+        caching; the Column constructors' = None slot inits are the one
+        sanctioned exception."""
+        from tidb_tpu.lint import run_rule
+        findings = run_rule("device-slot-confinement")
+        assert not findings, [f.to_json() for f in findings]
 
 
 # -- per-tenant residency accounting (ISSUE 6 satellite) ---------------------

@@ -515,43 +515,13 @@ class TestBreakerMultiTenantProbe:
 #: supervisor itself, the admission-aware run_device, the scheduler,
 #: parallel/mpp.py's library-embedder hook (_supervised_step — audited:
 #: it holds its own admission ticket around the supervised call), and
-#: the compile service (audited: its BACKGROUND builds never serve a
-#: query — the bounded worker pool IS their admission, and the warm
-#: dispatch must run even while query admission is saturated, or a
-#: congested device could never finish the compiles that relieve it)
-_SUPERVISED_ALLOWED = {"supervisor.py", "device_exec.py", "scheduler.py",
-                       "mpp.py", "compile_service.py"}
-
-
 class TestNoDirectDispatchLint:
     def test_call_supervised_confined_to_admission_layer(self):
-        """Every device dispatch must pass the admission queue: direct
-        `call_supervised` / `supervised_call` use inside tidb_tpu is
-        confined to run_device (which admits first) and the scheduler —
-        a new dispatch path must not silently bypass per-tenant
-        scheduling.  (bench.py's whole-query watchdog wraps full
-        statements, whose fragments admit individually inside.)"""
-        root = os.path.abspath(os.path.join(
-            os.path.dirname(__file__), "..", "tidb_tpu"))
-        offenders = []
-        for dirpath, _dirs, files in os.walk(root):
-            for fname in files:
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                if fname in _SUPERVISED_ALLOWED:
-                    continue
-                with open(path) as f:
-                    tree = ast.parse(f.read(), filename=path)
-                for node in ast.walk(tree):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    func = node.func
-                    name = (func.id if isinstance(func, ast.Name)
-                            else func.attr
-                            if isinstance(func, ast.Attribute) else "")
-                    if name in ("call_supervised", "supervised_call"):
-                        offenders.append(f"{path}:{node.lineno}")
-        assert not offenders, (
-            "direct supervised dispatch bypasses the admission queue "
-            f"(route through device_exec.run_device): {offenders}")
+        """Registry rule (tidb_tpu/lint rules/confinement.py): direct
+        call_supervised / supervised_call is confined to the admission
+        layer (run_device admits first; the compile service's bounded
+        worker pool is its own admission) — a new dispatch path must not
+        silently bypass per-tenant scheduling."""
+        from tidb_tpu.lint import run_rule
+        findings = run_rule("supervised-confinement")
+        assert not findings, [f.to_json() for f in findings]

@@ -243,7 +243,7 @@ class TestAbandonedGauge:
         tk.must_exec("set tidb_device_call_timeout = 0")
         rows = tk.must_query(f"explain analyze {AGG_Q}").rows
         blob = "\n".join(" ".join(str(c) for c in r) for r in rows)
-        assert "abandoned_device_calls" in blob
+        assert "device_abandoned_calls" in blob
 
         # HTTP status API: /status JSON field + /metrics gauge line
         from tidb_tpu.server.http_status import StatusServer
@@ -267,36 +267,9 @@ class TestAbandonedGauge:
 
 class TestRunDeviceShapeLint:
     def test_all_call_sites_pass_explicit_shape(self):
-        """A run_device call without shape= silently shares the 'agg'
-        breaker — a new fragment class must never piggyback unnoticed.
-        AST-walk the whole package: direct calls AND the
-        `_with_pipe_stats(run_device, ...)` indirection both count."""
-        root = os.path.join(os.path.dirname(__file__), "..", "tidb_tpu")
-        offenders = []
-        for dirpath, _dirs, files in os.walk(os.path.abspath(root)):
-            for fname in files:
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                with open(path) as f:
-                    tree = ast.parse(f.read(), filename=path)
-                for node in ast.walk(tree):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    func = node.func
-                    direct = (isinstance(func, ast.Name)
-                              and func.id == "run_device") or (
-                                  isinstance(func, ast.Attribute)
-                                  and func.attr == "run_device")
-                    indirect = (isinstance(func, ast.Attribute)
-                                and func.attr == "_with_pipe_stats"
-                                and node.args
-                                and isinstance(node.args[0], ast.Name)
-                                and node.args[0].id == "run_device")
-                    if not (direct or indirect):
-                        continue
-                    if not any(kw.arg == "shape" for kw in node.keywords):
-                        offenders.append(f"{path}:{node.lineno}")
-        assert not offenders, (
-            "run_device call sites missing explicit shape= "
-            f"(breaker scoping): {offenders}")
+        """Registry rule (tidb_tpu/lint rules/confinement.py): a
+        run_device call without shape= silently shares the 'agg' breaker
+        — direct calls AND the _with_pipe_stats indirection count."""
+        from tidb_tpu.lint import run_rule
+        findings = run_rule("run-device-shape")
+        assert not findings, [f.to_json() for f in findings]

@@ -231,8 +231,14 @@ class DDLWorker:
             job.error = err
             m.finish_job(job)
             txn.commit()
-        except Exception:
+        except Exception as e:
             txn.rollback()
+            # the cancel record could not persist: the job will be
+            # re-peeked and re-failed next drain — log so a cancel stuck
+            # in a persist-fail loop is visible
+            from .utils.backoff import classify
+            _log.warning("ddl job %s cancel persist failed (%s): %s",
+                         job.id, classify(e), e)
         if idx_id is not None:
             for pid in phys_ids:
                 start, end = tablecodec.index_range(pid, idx_id)

@@ -94,7 +94,7 @@ class QueryExecutor:
                 # the supervisor's "abandoned calls outstanding" gauge:
                 # a prior fragment's hung device call is still blocked on
                 # its worker thread while this plan runs
-                self.annotate(abandoned_device_calls=n_abandoned)
+                self.annotate(device_abandoned_calls=n_abandoned)
             # HBM residency (ops/residency.py): bytes this process holds
             # cached on-device after the dispatch, plus the eviction /
             # OOM-recovery counters when they have ever fired — "did this

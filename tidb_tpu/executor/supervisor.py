@@ -32,7 +32,7 @@ Model (deadline → classify → fence → breaker → degrade):
    backend, where the arrays behind those caches are dead anyway —
    attempts a full PJRT client teardown so the next dispatch re-dials.
 4. **Account** — "abandoned calls outstanding" is an explicit gauge:
-   surfaced in EXPLAIN ANALYZE (``abandoned_device_calls``),
+   surfaced in EXPLAIN ANALYZE (``device_abandoned_calls``),
    ``session/observe.py`` gauges (``device_abandoned_calls``) and the
    HTTP status API (``/status`` + ``/metrics``).  A worker whose
    abandoned call eventually unblocks decrements the gauge and rejoins
@@ -334,6 +334,7 @@ def _reinit_backend():
         return
     # compiled-executable caches first: they pin jitted programs (and the
     # dictionaries/arrays they close over) against the suspect client
+    from ..utils.backoff import classify
     try:
         from . import device_exec
         # under the pipe-stats lock: _pipe_cache_get's locked
@@ -341,28 +342,34 @@ def _reinit_backend():
         with device_exec._PIPE_LOCK:
             device_exec._PIPE_CACHE.clear()
         device_exec._TOPK_CACHE.clear()
-    except Exception:
-        pass
+    except Exception as e:
+        # best-effort: the fence proceeds, but a cache that would not
+        # clear may still pin dead-client executables — log it
+        log.warning("fence: pipe-cache clear failed (%s): %s",
+                    classify(e), e)
     try:
         from . import mpp_exec
         # under the placement lock: _place_col's locked check/popitem
         # pair must never interleave with this clear
         with mpp_exec._PLACE_LOCK:
             mpp_exec._MPP_PLACE_CACHE.clear()
-    except Exception:
-        pass
+    except Exception as e:
+        log.warning("fence: mpp placement-cache clear failed (%s): %s",
+                    classify(e), e)
     try:
         # the compile service's origin map described entries of the pipe
         # cache just cleared above; its RECIPES survive — they are how
         # the prewarm ladder rebuilds against the fresh client
         from . import compile_service
         compile_service.on_backend_reinit()
-    except Exception:
-        pass
+    except Exception as e:
+        log.warning("fence: compile-service reinit hook failed (%s): %s",
+                    classify(e), e)
     try:
         jax.clear_caches()
-    except Exception:
-        pass
+    except Exception as e:
+        log.warning("fence: jax.clear_caches failed (%s): %s",
+                    classify(e), e)
     # hard teardown: a hung PJRT tunnel's arrays are dead anyway, so
     # re-dialing the client is the only road back
     for clear in ("clear_backends",):

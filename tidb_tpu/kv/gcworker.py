@@ -173,8 +173,13 @@ class GCWorker:
                 for owner in gone_owners - live_owners:
                     m.remove_dropped_table(owner)
                 txn.commit()
-            except Exception:
+            except Exception as e:
                 txn.rollback()
+                # a failed claim round retries next tick; classify so a
+                # persistently-failing purge shows up in the logs
+                from ..utils.backoff import classify
+                _log.warning("gc delete-range claim failed (%s): %s",
+                             classify(e), e)
                 return 0
         for start, end in to_delete:
             store.mvcc.raw_delete_range(start, end)
@@ -213,8 +218,12 @@ class GCWorker:
             while not self._stop.wait(interval or self.run_interval_s()):
                 try:
                     self.run_once()
-                except Exception:
-                    pass  # background GC must never crash the server
+                except Exception as e:
+                    # background GC must never crash the server, but a GC
+                    # round that dies every tick means unbounded MVCC
+                    # garbage — classify and log
+                    from ..utils.backoff import classify
+                    _log.warning("gc round failed (%s): %s", classify(e), e)
         self._thread = threading.Thread(target=loop, name="gc-worker",
                                         daemon=True)
         self._thread.start()

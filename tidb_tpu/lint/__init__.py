@@ -1,0 +1,5 @@
+"""Project static analysis: ``python -m tidb_tpu.lint`` (see engine.py)."""
+
+from .engine import (Allowlist, Context, Finding, Report, Rule, RULES,  # noqa: F401
+                     collect, default_allowlist_path, register, run_repo,
+                     run_rule, run_rules, write_baseline)

@@ -1,0 +1,10 @@
+"""Rule registry population: importing this package registers every rule
+with the engine (tidb_tpu.lint.engine.RULES)."""
+
+from . import confinement  # noqa: F401
+from . import exceptions  # noqa: F401
+from . import failpoints  # noqa: F401
+from . import gauges  # noqa: F401
+from . import locks  # noqa: F401
+from . import taxonomy  # noqa: F401
+from . import traced  # noqa: F401

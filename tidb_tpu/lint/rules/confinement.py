@@ -1,0 +1,178 @@
+"""The four confinement lints migrated from their test-file copies
+(tests/test_compile_service.py, test_residency.py, test_scheduler.py,
+test_supervisor.py) into registry rules sharing the engine's one parse.
+
+Each rule carries its sanctioned-layer file set as rule config (these
+are permanent architecture facts, not burn-down debt — the allowlist
+file is reserved for entries that are supposed to shrink).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule, register
+from ._util import call_name
+
+
+@register
+class JitConfinement(Rule):
+    """Raw ``jax.jit`` (or AOT ``.lower()``/``.compile()`` chained off a
+    jit call) outside the compile layer bypasses async compilation, the
+    compile breaker and trace accounting: every query pipeline must build
+    through device_exec.acquire_pipeline -> compile_service.obtain, and
+    every kernel jit through ops/device.observed_jit."""
+
+    name = "jit-confinement"
+    allowlistable = False
+    title = "raw jax.jit confined to the compile layer"
+
+    #: the sanctioned compile layer (device_exec routes through these)
+    ALLOWED = ("executor/compile_service.py", "ops/device.py")
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if sf.rel in self.ALLOWED:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                if (node.attr == "jit" and isinstance(node.value, ast.Name)
+                        and node.value.id == "jax"):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"jax.jit@{sf.qualname(node)}",
+                        "raw jax.jit outside the compile layer (use "
+                        "acquire_pipeline / observed_jit)"))
+                # AOT chain: jax.jit(...).lower(...) / .compile()
+                if (node.attr in ("lower", "compile")
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "jit"):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"jit-aot-{node.attr}@{sf.qualname(node)}",
+                        f"AOT .{node.attr}() chained off a raw jit outside "
+                        "the compile layer"))
+        return out
+
+
+@register
+class DeviceSlotConfinement(Rule):
+    """Any direct read/write of ``._device`` outside ops/residency.py is
+    unaccounted HBM caching — the ledger (budget, epoch, OOM eviction)
+    only works if every cached upload goes through the manager.  The
+    ``self._device = None`` slot inits in the Column constructors
+    (NONE_INIT_ALLOWED) are the one sanctioned exception."""
+
+    name = "device-slot-confinement"
+    allowlistable = False
+    title = "._device access confined to the residency manager"
+
+    #: the residency manager owns the slot; the Column constructors may
+    #: initialize it to None (a fresh column has no cache to account)
+    ALLOWED = ("ops/residency.py",)
+    NONE_INIT_ALLOWED = ("utils/chunk.py",)
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if sf.rel in self.ALLOWED:
+                continue
+            none_inits = set()
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is None):
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and tgt.attr == "_device"):
+                            none_inits.add(id(tgt))
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Attribute)
+                        and node.attr == "_device"):
+                    continue
+                if id(node) in none_inits:
+                    if sf.rel in self.NONE_INIT_ALLOWED:
+                        continue
+                    ident = f"_device=None@{sf.qualname(node)}"
+                    msg = ("._device = None slot init outside "
+                           "ops/residency.py")
+                else:
+                    ident = f"_device@{sf.qualname(node)}"
+                    msg = ("._device accessed outside ops/residency.py "
+                           "(unaccounted HBM caching)")
+                out.append(self.finding(sf.rel, node.lineno, ident, msg))
+        return out
+
+
+@register
+class SupervisedConfinement(Rule):
+    """Every device dispatch must pass the admission queue: direct
+    ``call_supervised`` / ``supervised_call`` use is confined to
+    run_device (which admits first), the scheduler, and the compile
+    service's bounded worker pool — a new dispatch path must not silently
+    bypass per-tenant scheduling."""
+
+    name = "supervised-confinement"
+    allowlistable = False
+    title = "supervised dispatch confined to the admission layer"
+
+    #: the admission layer: run_device admits before dispatching, the
+    #: scheduler/supervisor are the mechanism itself, mpp.py's embedder
+    #: hook admits per dist_* step, and the compile service's bounded
+    #: worker pool is the bg builds' own admission
+    ALLOWED = ("executor/supervisor.py", "executor/device_exec.py",
+               "executor/scheduler.py", "parallel/mpp.py",
+               "executor/compile_service.py")
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if sf.rel in self.ALLOWED:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node).rsplit(".", 1)[-1]
+                if name in ("call_supervised", "supervised_call"):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"{name}@{sf.qualname(node)}",
+                        "direct supervised dispatch bypasses the admission "
+                        "queue (route through device_exec.run_device)"))
+        return out
+
+
+@register
+class RunDeviceShape(Rule):
+    """A run_device call without ``shape=`` silently shares the 'agg'
+    breaker — a new fragment class must never piggyback unnoticed.
+    Direct calls AND the ``_with_pipe_stats(run_device, ...)``
+    indirection both count."""
+
+    name = "run-device-shape"
+    allowlistable = False
+    title = "run_device call sites name their breaker shape"
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node).rsplit(".", 1)[-1]
+                direct = name == "run_device"
+                indirect = (name == "_with_pipe_stats" and node.args
+                            and isinstance(node.args[0], ast.Name)
+                            and node.args[0].id == "run_device")
+                if not (direct or indirect):
+                    continue
+                if not any(kw.arg == "shape" for kw in node.keywords):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"{name}@{sf.qualname(node)}",
+                        "run_device call site missing explicit shape= "
+                        "(breaker scoping)"))
+        return out

@@ -1,0 +1,220 @@
+"""Traced-value hazards inside jit-built fragment bodies — the static
+counterpart of the zero-recompile regression tests.
+
+A function handed to ``observed_jit`` / ``jax.jit`` (directly, via
+decorator, or via ``partial(jax.jit, ...)``) runs under trace: its
+non-static parameters are tracers.  Python-level control flow on a
+tracer's VALUE either raises ConcretizationTypeError at trace time or —
+when the value sneaks in as a Python scalar — silently bakes the value
+into the compiled program and recompiles on every change (the exact
+regression class PRs 2/7/8 burned down: live counts, n_valid,
+capacities must ride as traced operands, not cache keys).
+
+Flagged inside jit bodies, for any non-static parameter ``p``:
+
+  * ``if p`` / ``while p`` / ``assert p`` / ternary tests referencing
+    ``p``'s value (``p.shape``/``p.ndim``/``p.dtype``/``p.size`` and
+    ``len(p)`` are static and fine),
+  * ``int(p)`` / ``float(p)`` / ``bool(p)`` / ``p.item()`` concretization,
+  * ``range(p)`` / ``for ... in p`` Python iteration,
+  * ``np.asarray(p)`` / ``np.array(p)`` host materialization.
+
+Parameters named by ``static_argnames``/``static_argnums`` are excluded
+(they are compile-time constants by contract — branching on them is the
+bucketing design working as intended).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule, register
+from ._util import call_name, const_str
+
+JIT_WRAPPERS = {"observed_jit", "_observed_jit"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type",
+                "sharding"}
+NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in JIT_WRAPPERS or name in ("jax.jit", "jit")
+
+
+def _static_params(call_kwargs, fn: ast.FunctionDef) -> set:
+    """Parameter names excluded by static_argnames/static_argnums."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out = set()
+    for kw in call_kwargs:
+        if kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                s = const_str(v)
+                if s:
+                    out.add(s)
+        elif kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(
+                        v.value, int) and v.value < len(params):
+                    out.add(params[v.value])
+    return out
+
+
+def _jit_targets(sf) -> list:
+    """(FunctionDef, static_param_names) for every jit-built body in the
+    file: decorator forms and name-passed-to-wrapper forms."""
+    defs_by_name: dict[str, list] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+    out = []
+    seen = set()
+
+    def add(fn, statics):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append((fn, statics))
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, (ast.Name, ast.Attribute)) and \
+                        _is_jit_call(ast.Call(func=dec, args=[],
+                                              keywords=[])):
+                    add(node, set())
+                elif isinstance(dec, ast.Call):
+                    dn = call_name(dec)
+                    if _is_jit_call(dec):
+                        add(node, _static_params(dec.keywords, node))
+                    elif dn.rsplit(".", 1)[-1] == "partial" and dec.args \
+                            and isinstance(dec.args[0],
+                                           (ast.Name, ast.Attribute)) \
+                            and _is_jit_call(ast.Call(
+                                func=dec.args[0], args=[], keywords=[])):
+                        add(node, _static_params(dec.keywords, node))
+        if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Name):
+                for fn in defs_by_name.get(arg0.id, []):
+                    add(fn, _static_params(node.keywords, fn))
+    return out
+
+
+def _refs_value(node, traced: set) -> bool:
+    """Does this expression depend on a traced parameter's VALUE (shape/
+    dtype/len derivations are static and do not count)?"""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return _refs_value(node.value, traced)
+    if isinstance(node, ast.Call):
+        leaf = call_name(node).rsplit(".", 1)[-1]
+        if leaf == "len":
+            return False
+        return _refs_value(node.func, traced) or \
+            any(_refs_value(a, traced) for a in node.args) or \
+            any(_refs_value(kw.value, traced) for kw in node.keywords)
+    return any(_refs_value(c, traced)
+               for c in ast.iter_child_nodes(node))
+
+
+@register
+class TracedValueHazard(Rule):
+    name = "traced-value-hazard"
+    title = "no Python control flow on traced values in jit bodies"
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            for fn, statics in _jit_targets(sf):
+                traced = {a.arg for a in (fn.args.posonlyargs
+                                          + fn.args.args
+                                          + fn.args.kwonlyargs)}
+                traced -= statics
+                traced.discard("self")
+                if not traced:
+                    continue
+                out.extend(self._scan(sf, fn, traced))
+        return out
+
+    def _scan(self, sf, fn, traced):
+        out = []
+        seen: dict[str, int] = {}
+
+        def emit(node, kind, msg):
+            qn = f"{sf.qualname(fn)}"
+            base = f"{kind}@{qn}"
+            k = seen.get(base, 0)
+            seen[base] = k + 1
+            ident = base + (f"#{k}" if k else "")
+            out.append(self.finding(sf.rel, node.lineno, ident, msg))
+
+        def visit(node, traced):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                # nested def: shadowed names are its own params
+                inner = traced - {a.arg for a in (
+                    node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs)}
+                for c in node.body:
+                    visit(c, inner)
+                return
+            if isinstance(node, (ast.If, ast.While)) and _refs_value(
+                    node.test, traced):
+                emit(node, "branch",
+                     "Python control flow on a traced value inside a jit "
+                     "body — concretization error or silent recompile "
+                     "per value (mask with jnp.where / lax.cond)")
+            if isinstance(node, ast.IfExp) and _refs_value(
+                    node.test, traced):
+                emit(node, "branch",
+                     "ternary on a traced value inside a jit body — use "
+                     "jnp.where")
+            if isinstance(node, ast.Assert) and _refs_value(
+                    node.test, traced):
+                emit(node, "branch",
+                     "assert on a traced value inside a jit body")
+            if isinstance(node, ast.Call):
+                leaf = call_name(node).rsplit(".", 1)[-1]
+                head = call_name(node).split(".", 1)[0]
+                if leaf in ("int", "float", "bool") and "." not in \
+                        call_name(node) and any(
+                            _refs_value(a, traced) for a in node.args):
+                    emit(node, f"concretize-{leaf}",
+                         f"{leaf}() on a traced value inside a jit body "
+                         "— concretization; keep it a traced operand")
+                if leaf == "item" and _refs_value(node.func, traced):
+                    emit(node, "item",
+                         ".item() on a traced value inside a jit body")
+                if leaf == "range" and any(
+                        _refs_value(a, traced) for a in node.args):
+                    emit(node, "iterate",
+                         "range() over a traced value inside a jit body "
+                         "— loop bound becomes a compile-time constant")
+                if leaf in ("asarray", "array") and head in \
+                        NUMPY_ALIASES and any(
+                            _refs_value(a, traced) for a in node.args):
+                    emit(node, "asarray",
+                         "numpy materialization of a traced value inside "
+                         "a jit body")
+            if isinstance(node, ast.For) and _refs_value(
+                    node.iter, traced) and not (
+                    isinstance(node.iter, ast.Call)
+                    and call_name(node.iter).rsplit(".", 1)[-1] ==
+                    "range"):
+                # (a traced range() bound is the Call check's finding)
+                emit(node, "iterate",
+                     "Python iteration over a traced value inside a jit "
+                     "body")
+            for c in ast.iter_child_nodes(node):
+                visit(c, traced)
+
+        for stmt in fn.body:
+            visit(stmt, traced)
+        return out

@@ -102,7 +102,14 @@ class PrivManager:
             infos = self.domain.infoschema()
             if infos.table_by_name("mysql", "user") is None:
                 return
-        except Exception:
+        except Exception as e:
+            # a failed reload keeps the previously-loaded grant tables;
+            # log it — silently serving stale privileges must be visible
+            import logging
+            from .utils.backoff import classify
+            logging.getLogger("tidb_tpu.privilege").warning(
+                "privilege reload failed, keeping cached grant tables "
+                "(%s): %s", classify(e), e)
             return
         users, dbs, tables = [], [], []
         txn = self.domain.store.begin()

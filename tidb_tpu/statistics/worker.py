@@ -47,8 +47,13 @@ class StatsWorker:
             while not self._stop.wait(interval):
                 try:
                     self.run_once()
-                except Exception:
-                    pass  # background maintenance must never crash the server
+                except Exception as e:
+                    # background maintenance must never crash the server,
+                    # but a failing auto-analyze pass must not be
+                    # invisible either — classify and log
+                    from ..utils.backoff import classify
+                    _log.warning("auto-analyze pass failed (%s): %s",
+                                 classify(e), e)
         self._thread = threading.Thread(target=loop, name="stats-worker",
                                         daemon=True)
         self._thread.start()

@@ -95,8 +95,13 @@ class TopSQL:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.sample_once()
-                except Exception:
-                    pass  # sampling must never hurt the server
+                except Exception as e:
+                    # sampling must never hurt the server, but a sampler
+                    # that dies every tick must be diagnosable
+                    import logging
+                    from .utils.backoff import classify
+                    logging.getLogger("tidb_tpu.topsql").warning(
+                        "top-sql sample failed (%s): %s", classify(e), e)
 
         self._thread = threading.Thread(target=loop, name="topsql",
                                         daemon=True)
