@@ -73,6 +73,16 @@ def _emit(obj) -> None:
         print(json.dumps(obj), flush=True)
 
 
+def _last_trace_text(conn_id=None, cap=4000) -> str:
+    """The most recent finished query-lifecycle trace, rendered — the
+    post-mortem artifact BENCH_TPU_LIVE never had: a watchdog-skipped or
+    failed query's error JSON line carries WHERE inside the query the
+    time went (admission / compile / supervisor / backoff / dispatch).
+    Empty when tracing was off (see BENCH_TRACE) or nothing finished."""
+    from tidb_tpu.session import tracing
+    return tracing.last_trace_text(conn_id, cap=cap)
+
+
 # The accelerator reaches this process through the axon PJRT plugin: a
 # loopback relay/tunnel serves the terminal's stateless port (8083) and
 # session port (8082). When nothing listens there, the Rust client retries
@@ -856,6 +866,15 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
     inject = set(q.strip().lower() for q in
                  os.environ.get("BENCH_FAIL_QUERY", "").split(",")
                  if q.strip())
+    # span tracing OPT-IN (BENCH_TRACE=1): with it on, a failed/skipped
+    # query's error line carries its full trace — set it on live-TPU
+    # runs, where the post-mortem matters and the recorder's cost is
+    # noise next to 100s+ compiles.  Default OFF: sampling also wires a
+    # per-operator runtime-stats collector through every traced query,
+    # and the bench_history/vs_baseline records must stay comparable
+    # with the pre-tracing rounds (same rule as bench_serve.py's p99s)
+    if os.environ.get("BENCH_TRACE", "") == "1":
+        tk.must_exec("set tidb_trace_sampling_rate = 1")
     failures = 0
     for qname in qnames:
         sql = QUERIES[qname]
@@ -1023,6 +1042,7 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                    "error": f"{type(exc).__name__}: {exc}"[:300],
                    "skipped_by_watchdog": True, "watchdog": "supervisor",
                    "abandoned_calls": _sup.abandoned_calls(),
+                   "trace": _last_trace_text(),
                    "stage": _STAGE[0], **meta})
             # the abandoned worker may still be executing against its
             # (pinned) session and may hold the keep-warm lock; kill the
@@ -1055,6 +1075,7 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                    "unit": "rows/s", "vs_baseline": 0,
                    "error": f"{type(exc).__name__}: {exc}"[:300],
                    "skipped_by_watchdog": True,
+                   "trace": _last_trace_text(),
                    "stage": _STAGE[0], **meta})
             continue
         except Exception as exc:
@@ -1067,6 +1088,7 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                    "unit": "rows/s", "vs_baseline": 0,
                    "error": f"{type(exc).__name__}: {exc}"[:300],
                    "skipped_by_watchdog": False,
+                   "trace": _last_trace_text(),
                    "stage": _STAGE[0], **meta})
             continue
         finally:

@@ -62,6 +62,13 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def _last_trace_text(cap=4000) -> str:
+    """Most recent finished span trace, rendered (the failed phase's
+    post-mortem timeline; see bench.py); "" when tracing never ran."""
+    from tidb_tpu.session import tracing
+    return tracing.last_trace_text(cap=cap)
+
+
 def _compile_gauges() -> dict:
     """Compile-service gauges for the record (executor/compile_service):
     pending fragments / persistent-index hits / prewarm counts — a round
@@ -79,6 +86,7 @@ def _write_record():
 def _watchdog(signum, frame):
     RECORD["rc"] = 1
     RECORD["error"] = f"global watchdog fired after {TIMEOUT_S}s"
+    RECORD["trace"] = _last_trace_text()
     _emit({"metric": "multichip_watchdog", "value": 0, **RECORD})
     _write_record()
     os._exit(1)
@@ -97,6 +105,10 @@ def _mk_q3_tk(n_cust=64, n_ord=256, n_line=1000):
     tk.must_exec("create database mc")
     tk.must_exec("use mc")
     tk.must_exec("set tidb_mpp_devices = 8")
+    if os.environ.get("BENCH_TRACE", "") == "1":
+        # opt-in (same comparability rule as bench.py): a failed phase's
+        # error line then carries the query's span trace
+        tk.must_exec("set tidb_trace_sampling_rate = 1")
     tk.must_exec("""create table customer (
         c_custkey bigint primary key, c_mktsegment varchar(10))""")
     tk.must_exec("""create table orders (
@@ -276,9 +288,11 @@ def main():
             _emit({"metric": f"multichip_{name}", "value": 1, **res})
         except Exception as e:  # noqa: BLE001 — record and continue
             failures += 1
-            RECORD["phases"][name] = {"error": f"{type(e).__name__}: {e}"}
+            trace = _last_trace_text()
+            RECORD["phases"][name] = {"error": f"{type(e).__name__}: {e}",
+                                      "trace": trace}
             _emit({"metric": f"multichip_{name}", "value": 0,
-                   "error": str(e)})
+                   "error": str(e), "trace": trace})
     RECORD["ok"] = failures == 0
     RECORD["rc"] = 0 if failures == 0 else 1
     _write_record()

@@ -37,6 +37,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import os
 import random
 import sys
 import threading
@@ -137,11 +138,18 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
         with mu:
             counts[key] += 1
 
-    def violate(tid, what, exc=None):
+    def violate(tid, what, exc=None, conn_id=None):
+        # a violation's post-mortem: the OFFENDING session's most recent
+        # finished span trace (conn_id-filtered — with N concurrent
+        # workers, a healthy thread's timeline must never be
+        # misattributed to the failure), when the run samples
+        from tidb_tpu.session import tracing
+        trace = tracing.last_trace_text(conn_id, cap=2000)
         with mu:
             violations.append(
                 f"thread {tid}: {what}"
-                + (f" ({type(exc).__name__}: {exc})" if exc else ""))
+                + (f" ({type(exc).__name__}: {exc})" if exc else "")
+                + (("\n" + trace) if trace else ""))
 
     def _olap_op(wtk, rng, tid):
         qname = OLAP_QUERIES[rng.randrange(len(OLAP_QUERIES))]
@@ -154,13 +162,13 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
                 bump("clean_errors")
             else:
                 violate(tid, f"unclassified analytical failure on "
-                        f"{qname}", e)
+                        f"{qname}", e, conn_id=wtk.session.conn_id)
             return
         record("olap", (time.monotonic() - t0) * 1000.0)
         bump("ok")
         if rows != goldens[qname]:
             violate(tid, f"WRONG RESULT for {qname} (device path diverged"
-                    " from host golden)")
+                    " from host golden)", conn_id=wtk.session.conn_id)
 
     def _oltp_op(wtk, rng, tid):
         kind = rng.random()
@@ -198,7 +206,8 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
                 except Exception:
                     pass
             else:
-                violate(tid, "unclassified OLTP failure", e)
+                violate(tid, "unclassified OLTP failure", e,
+                        conn_id=wtk.session.conn_id)
             return
         record("oltp", (time.monotonic() - t0) * 1000.0)
         bump("ok")
@@ -215,6 +224,11 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
         wtk = tk.new_session()
         group = "olap" if olap else "oltp"
         wtk.must_exec(f"set tidb_resource_group = '{group}'")
+        if os.environ.get("BENCH_TRACE", "") == "1":
+            # opt-in, same BENCH_TRACE=1 gate as bench.py: the serving
+            # bench measures contended p99s, and N threads × sampling
+            # every op would skew exactly the latencies under test
+            wtk.must_exec("set tidb_trace_sampling_rate = 1")
         wtk.must_exec("set innodb_lock_wait_timeout = 2")
         if olap:
             wtk.must_exec("use tpch")

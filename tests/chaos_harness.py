@@ -181,6 +181,11 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         for _op in range(n_ops):
             qi = rng.randrange(len(QUERIES))
             engine = rng.choice(ENGINES)
+            # ~1/3 of ops run with span tracing sampled (the recorder
+            # rides every failure path; the drain invariant below then
+            # actually bites)
+            tk.must_exec("set tidb_trace_sampling_rate = "
+                         + ("1" if rng.random() < 0.34 else "0"))
             # 1-2 simultaneous faults from the read catalog
             names = rng.sample(sorted(READ_FAULTS), k=rng.choice([1, 1, 2]))
             tk.must_exec(f"set tidb_executor_engine = '{engine}'")
@@ -264,6 +269,14 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         cdrained = compile_service.verify_drained()
         assert cdrained["ok"], (
             f"seed {seed}: LEAKED COMPILE JOBS: {cdrained}")
+
+        # -- span traces drained: every trace begun (sampled statements,
+        #    TRACE, bg-compile children) was finished — no trace object
+        #    left holding span refs after the schedule ends
+        from tidb_tpu.session import tracing
+        tdrained = tracing.verify_drained()
+        assert tdrained["ok"], (
+            f"seed {seed}: LEAKED TRACES: {tdrained}")
     finally:
         failpoint.disable_all()
     return stats
@@ -359,6 +372,12 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
             # the drain invariant below must still hold
             wtk.must_exec("set tidb_compile_async = "
                           + ("'ON'" if rng.random() < 0.35 else "'OFF'"))
+            # a third of the ops run SPAN-TRACED: the recorder rides the
+            # hang/OOM/admission/compile failure paths concurrently
+            # (incl. bg-compile child traces), and the trace drain
+            # invariant below must still hold
+            wtk.must_exec("set tidb_trace_sampling_rate = "
+                          + ("1" if rng.random() < 0.34 else "0"))
             names = rng.sample(sorted(THREADED_FAULTS),
                                k=rng.choice([1, 1, 2]))
             with contextlib.ExitStack() as st:
@@ -473,6 +492,15 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     assert cdrained["ok"], (
         f"seed {seed}: LEAKED COMPILE JOBS after threaded chaos: "
         f"{cdrained}")
+
+    # span traces drained: every sampled statement's trace AND every
+    # bg-compile child trace begun by the schedule was finished — no
+    # trace object leaked holding span refs (compile wait_idle above
+    # already drained the jobs whose _finish_job retires the children)
+    from tidb_tpu.session import tracing
+    tdrained = tracing.verify_drained()
+    assert tdrained["ok"], (
+        f"seed {seed}: LEAKED TRACES after threaded chaos: {tdrained}")
 
     # breaker-state sanity: legal state, probe slot not wedged
     for shape, br in getattr(tk.domain, "_device_breakers", {}).items():

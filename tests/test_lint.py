@@ -533,6 +533,71 @@ class TestGaugeConsistency:
         assert out == []
 
 
+# -- trace-coverage -----------------------------------------------------------
+
+TRACE_COV_BAD = """
+from ..ops.device import DeviceUnsupported
+from ..session import tracing
+
+def run_device(ctx, fn):
+    if bad():
+        raise DeviceUnsupported("degraded silently")
+
+def _run_device_admitted(ctx):
+    raise DeviceUnsupported("also silent")
+
+def helper_not_audited(ctx):
+    raise DeviceUnsupported("feature gap — out of scope")
+"""
+
+TRACE_COV_OK = """
+from ..ops.device import DeviceUnsupported
+from ..session import tracing
+
+def run_device(ctx, fn):
+    with tracing.span("device.dispatch"):
+        if bad():
+            raise DeviceUnsupported("span-wrapped")
+
+def _run_device_admitted(ctx):
+    if bad():
+        tracing.event("host_degraded", reason="breaker_open")
+        raise DeviceUnsupported("event precedes the raise")
+    raise OtherError("not a degradation exception")
+"""
+
+TRACE_COV_EVENT_AFTER = """
+from ..ops.device import DeviceUnsupported
+from ..session import tracing
+
+def run_device(ctx):
+    if bad():
+        raise DeviceUnsupported("event comes too late")
+    tracing.event("host_degraded", reason="x")
+"""
+
+
+class TestTraceCoverage:
+    def test_unmarked_degradation_found(self):
+        out = run_one("trace-coverage",
+                      {"executor/device_exec.py": TRACE_COV_BAD})
+        assert len(out) == 2, out  # audited fns only, helper exempt
+        assert all(f.ident.startswith("degrade@") for f in out)
+
+    def test_span_wrap_and_event_comply(self):
+        assert run_one("trace-coverage",
+                       {"executor/device_exec.py": TRACE_COV_OK}) == []
+
+    def test_event_after_raise_does_not_count(self):
+        out = run_one("trace-coverage",
+                      {"executor/device_exec.py": TRACE_COV_EVENT_AFTER})
+        assert len(out) == 1
+
+    def test_unaudited_file_ignored(self):
+        assert run_one("trace-coverage",
+                       {"executor/rogue.py": TRACE_COV_BAD}) == []
+
+
 # -- migrated confinement rules ----------------------------------------------
 
 class TestConfinementRules:

@@ -172,7 +172,7 @@ class _Recipe:
 class _Job:
     __slots__ = ("jkey", "cache_key", "build", "spec", "dict_refs",
                  "shape", "sig", "br", "sid", "origin", "done", "error",
-                 "fence_gen")
+                 "fence_gen", "tchild")
 
     def __init__(self, jkey, cache_key, build, spec, dict_refs, shape,
                  sig, br, sid, origin):
@@ -189,6 +189,11 @@ class _Job:
         self.done = threading.Event()
         self.error = None
         self.fence_gen = _fence_gen()
+        # linked child trace (session/tracing.py link_child): a bg job
+        # submitted by a TRACED statement runs under its own trace whose
+        # parent_id is the statement's — the async compile's lifetime
+        # stays attributable to the query that triggered it
+        self.tchild = None
 
 
 # -- config / small helpers --------------------------------------------------
@@ -431,7 +436,19 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
     ``DeviceUnsupported`` when the fragment should run on the host
     engine instead: compile pending in the background, compile breaker
     open, or the build itself failed classified."""
+    from ..session import tracing
+    # the statement's span tracer: the compile span carries the MODE the
+    # service resolved this fragment with (sync / async_pending /
+    # persist_hit / breaker_open) — one branch when sampling is off
+    with tracing.span("compile.obtain", shape=shape) as _tsp:
+        return _obtain_impl(key, build, dict_refs, ctx, args, spec, shape,
+                            sig, ladder, _tsp)
+
+
+def _obtain_impl(key, build, dict_refs, ctx, args, spec, shape, sig,
+                 ladder, _tsp):
     from ..ops.device import DeviceUnsupported
+    from ..session import tracing
     from ..utils import failpoint
     from ..utils.backoff import classify, CLASS_COMPILE, CLASS_TRANSPORT
     from .circuit import get_breaker
@@ -444,6 +461,8 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
     fn = _cached_fn(key)
     if fn is not None:
         note_hit(key)
+        if _tsp is not None:
+            _tsp.tags["mode"] = "cached"
         return fn
     if spec is None and args is not None:
         spec = _spec_of(args)
@@ -481,6 +500,10 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
             STATS["compile_pending_fragments"] += 1
         _mode("async_pending")
         _publish_gauges()
+        if _tsp is not None:
+            _tsp.tags["mode"] = "async_pending"
+        tracing.event("host_degraded", reason="compile_pending",
+                      shape=shape)
         raise DeviceUnsupported(
             f"device executable for this {shape} fragment is compiling "
             "in the background (fragment served by the host engine)")
@@ -490,6 +513,10 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
         # queue — degrade instantly, recover via the half-open probe
         with _LOCK:
             STATS["breaker_degrades"] += 1
+        if _tsp is not None:
+            _tsp.tags["mode"] = "breaker_open"
+        tracing.event("host_degraded", reason="compile_breaker_open",
+                      shape=shape)
         raise DeviceUnsupported(
             f"compile circuit open for device executables (cooling "
             f"down; {shape} fragment degraded to host engine)")
@@ -498,6 +525,8 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
     if persist_warm:
         with _LOCK:
             STATS["compile_persist_hits"] += 1
+        if _tsp is not None:
+            _tsp.tags["persist_hit"] = True
 
     if _async_on(ctx) and spec is not None and not persist_warm:
         # async path: submit and serve this execution host-side.  The
@@ -531,14 +560,28 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
             br.release_probe(session=sid)
             _mode("async_pending")
             _publish_gauges()
+            if _tsp is not None:
+                _tsp.tags["mode"] = "async_pending"
+            tracing.event("host_degraded", reason="compile_pending",
+                          shape=shape)
             raise DeviceUnsupported(
                 f"device executable for this {shape} fragment is "
                 "compiling in the background (fragment served by the "
                 "host engine)")
+        # linked child trace: the background build's own timeline, tied
+        # back to this statement's trace by parent_id (the async
+        # compile's lifetime is attributable to the query it serves)
+        job.tchild = tracing.link_child("compile.bg", shape=shape)
         _ensure_workers()
         _JOB_Q.put(job)
         _mode("async_pending")
         _publish_gauges()
+        if _tsp is not None:
+            _tsp.tags["mode"] = "async_submitted"
+            if job.tchild is not None:
+                _tsp.tags["bg_trace_id"] = job.tchild.trace_id
+        tracing.event("host_degraded", reason="compile_submitted",
+                      shape=shape)
         raise DeviceUnsupported(
             f"device executable for this {shape} fragment submitted for "
             "background compilation (fragment served by the host engine)")
@@ -568,6 +611,10 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
         err.__cause__ = e
         br.record_failure(err, session=sid, group=group)
         _LAST_ERROR[0] = f"{cls}: {e}"
+        if _tsp is not None:
+            _tsp.tags["mode"] = "sync_failed"
+        tracing.event("host_degraded", reason="compile_" + cls,
+                      shape=shape)
         raise DeviceUnsupported(
             f"device compile failed ({cls}): {e} "
             f"({shape} fragment degraded to host engine)") from err
@@ -577,6 +624,8 @@ def obtain(key, build, dict_refs, *, ctx=None, args=None, spec=None,
     with _LOCK:
         STATS["sync_compiles"] += 1
     _mode("sync")
+    if _tsp is not None:
+        _tsp.tags["mode"] = "sync"
     _persist_record(key, shape, sig, "sync")
     return fn
 
@@ -645,7 +694,17 @@ def _run_job(job: "_Job"):
     """Build + warm one executable with the full resilience ladder:
     supervisor deadline (a hung remote compile is abandoned + fenced like
     any device hang), compileRetry backoff on classified failures, then
-    a terminal verdict into the compile-scoped breaker."""
+    a terminal verdict into the compile-scoped breaker.  A job carrying a
+    linked child trace runs UNDER it, so its supervisor/backoff spans and
+    events land on the timeline attributed to the submitting query."""
+    if job.tchild is not None:
+        from ..session import tracing
+        with tracing.adopt(job.tchild):
+            return _run_job_traced(job)
+    return _run_job_traced(job)
+
+
+def _run_job_traced(job: "_Job"):
     from ..utils.backoff import (Backoffer, classify, CLASS_COMPILE,
                                  CLASS_DEVICE, CLASS_EXCHANGE, CLASS_HANG,
                                  CLASS_TRANSPORT)
@@ -760,6 +819,11 @@ def _finish_job(job: "_Job", failed: bool = False, discarded: bool = False,
         # wedges host-side until the grace reclaim; ownership-checked
         # and a no-op when record_success/failure already resolved it
         job.br.release_probe(session=job.sid)
+    if job.tchild is not None:
+        # retire the linked child trace on EVERY job outcome (finish is
+        # idempotent — the worker-loop catch-all may land here twice)
+        from ..session import tracing
+        tracing.finish(job.tchild, succ=not failed and not discarded)
     job.done.set()
     _publish_gauges()
 
@@ -911,6 +975,18 @@ def attach(ctx):
     if obs is not None and hasattr(obs, "set_gauge"):
         with _LOCK:
             _SINKS.add(obs)
+
+
+def observe_hist(name, value):
+    """Record one latency sample into every attached observe registry
+    (device_exec._charge_compile_s feeds `sync_compile_seconds` through
+    here — the compile-layer histogram in /metrics)."""
+    with _LOCK:
+        sinks = list(_SINKS)
+    for obs in sinks:
+        f = getattr(obs, "observe_hist", None)
+        if f is not None:
+            f(name, value)
 
 
 def _publish_gauges():

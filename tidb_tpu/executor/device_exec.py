@@ -11,6 +11,7 @@ storage-side there, device-side here).
 from __future__ import annotations
 
 import collections
+import time as _time
 
 import numpy as np
 import jax
@@ -83,23 +84,37 @@ def run_device(ctx, fn, /, *args, shape="agg", batch_key=None, **kw):
     `shape` scopes the breaker per fragment class (agg / join / window):
     one failing shape cools down without degrading healthy paths."""
     from ..errors import DeviceAdmissionError
+    from ..session import tracing
     from . import scheduler
     group = scheduler.resource_group(ctx)
     scheduler.attach(ctx)
-    try:
-        ticket = scheduler.admit(ctx, shape=shape, batch_key=batch_key)
-    except DeviceAdmissionError as e:
-        # load pressure, not device ill-health: no breaker charge — the
-        # fragment runs on the host engine (per-tenant gauge records it)
-        scheduler.note_degradation(group)
-        raise DeviceUnsupported(
-            f"device admission refused for {shape} fragment "
-            f"(resource group '{group}'; degraded to host engine): "
-            f"{e}") from e
-    try:
-        return _run_device_admitted(ctx, fn, args, kw, shape, group)
-    finally:
-        scheduler.release(ticket)
+    with tracing.span("device.dispatch", shape=shape, group=group):
+        try:
+            ticket = scheduler.admit(ctx, shape=shape, batch_key=batch_key)
+        except DeviceAdmissionError as e:
+            # load pressure, not device ill-health: no breaker charge —
+            # the fragment runs on the host engine (per-tenant gauge
+            # records it; the trace carries the classified reason)
+            scheduler.note_degradation(group)
+            tracing.event("host_degraded", reason="admission", shape=shape)
+            raise DeviceUnsupported(
+                f"device admission refused for {shape} fragment "
+                f"(resource group '{group}'; degraded to host engine): "
+                f"{e}") from e
+        t0 = _time.perf_counter()
+        try:
+            return _run_device_admitted(ctx, fn, args, kw, shape, group)
+        finally:
+            scheduler.release(ticket)
+            # per-fragment latency histogram (session/observe.py
+            # HIST_BUCKETS): one admitted dispatch end-to-end — in the
+            # finally so FAILED dispatches (supervisor-deadline hangs,
+            # post-OOM degrades) contribute too; the pathological
+            # latencies are exactly the p99 this series exists to show
+            obs = getattr(getattr(ctx, "domain", None), "observe", None)
+            if obs is not None and hasattr(obs, "observe_hist"):
+                obs.observe_hist("device_dispatch_seconds",
+                                 _time.perf_counter() - t0)
 
 
 def _run_device_admitted(ctx, fn, args, kw, shape, group):
@@ -107,6 +122,7 @@ def _run_device_admitted(ctx, fn, args, kw, shape, group):
     fragment that holds its admission ticket."""
     from ..errors import DeviceHangError
     from ..ops import residency
+    from ..session import tracing
     from ..utils.backoff import (classify, is_device_oom, CLASS_DEVICE,
                                  CLASS_EXCHANGE, CLASS_FAULT,
                                  CLASS_TRANSPORT)
@@ -115,6 +131,7 @@ def _run_device_admitted(ctx, fn, args, kw, shape, group):
     br = get_breaker(ctx, shape=shape)
     sid = getattr(ctx, "conn_id", None)
     if not br.allow(session=sid, group=group):
+        tracing.event("host_degraded", reason="breaker_open", shape=shape)
         raise DeviceUnsupported(
             f"device circuit open for {shape} fragments (cooling down; "
             "fragment degraded to host engine)")
@@ -133,6 +150,7 @@ def _run_device_admitted(ctx, fn, args, kw, shape, group):
             # would hide that the deadline fired) but the NEXT queries
             # degrade once the breaker trips
             br.record_failure(e, session=sid, group=group)
+            tracing.event("breaker.recorded", cls="hang", shape=shape)
             raise
         except (DeviceUnsupported, TiDBError):
             # no health verdict: if this fragment held the HALF_OPEN probe
@@ -157,9 +175,12 @@ def _run_device_admitted(ctx, fn, args, kw, shape, group):
                 # pressure, not device ill-health; a SECOND failure of any
                 # class takes the normal degrade path below.
                 oom_retried = True
+                tracing.event("oom_ladder", step="evict_all_retry",
+                              shape=shape)
                 residency.recover_oom(e)
                 continue
             br.record_failure(e, session=sid, group=group)
+            tracing.event("host_degraded", reason=cls, shape=shape)
             raise DeviceUnsupported(
                 f"device failure ({cls}): {e}") from e
         br.record_success(session=sid)
@@ -310,6 +331,8 @@ def acquire_pipeline(key, build, dict_refs, *, ctx=None, args=None,
     if fn is not None:
         from . import compile_service
         compile_service.note_hit(key)
+        from ..session import tracing
+        tracing.event("compile.cached", shape=shape)
         return fn
     from . import compile_service
     return compile_service.obtain(key, build, dict_refs, ctx=ctx,
@@ -328,6 +351,14 @@ def _count_trace():
 def _charge_compile_s(seconds):
     _bump("compiles")
     _bump("compile_s", seconds)
+    from ..session import tracing
+    tracing.event("compile.xla", s=round(seconds, 4))
+    if not getattr(_PIPE_TLS, "bg", False):
+        # sync compiles only: the query path PAID this wall time, so it
+        # belongs in the scrapeable per-layer histogram — background
+        # builds overlap host serving and would poison the p99
+        from . import compile_service
+        compile_service.observe_hist("sync_compile_seconds", seconds)
 
 
 # kernel-layer observability hooks: installing these makes
@@ -527,6 +558,8 @@ def device_agg(plan, chunk: Chunk, conds, ctx=None) -> Chunk:
         env[idx] = (dc.data, dc.nulls)
     if not env:
         raise DeviceUnsupported("no columns")
+    from ..session import tracing
+    tracing.event("device.upload", cols=len(env), bucket=nb, rows=n)
 
     # --- host-side planning only below (no device ops until dispatch) ---
     cond_fns = [dev.compile_expr(c, dcols) for c in conds]

@@ -517,6 +517,19 @@ def _build_key_leaf(node, leaves):
 
 
 def _run_mpp(plan, agg_conds, root, leaves, joins, ctx, mesh):
+    # span tracing (session/tracing.py): one span per MPP fragment
+    # dispatch, tagged with the mesh width — per-shard placement, the
+    # radix exchange and the SPMD dispatch all happen inside it, and the
+    # supervisor's thread-hop propagation keeps worker-side events
+    # (backoff sleeps, exchange retries) on this timeline
+    from ..session import tracing
+    with tracing.span("mpp.fragment", shards=mesh.shape[AXIS],
+                      leaves=len(leaves), joins=len(joins)):
+        return _run_mpp_impl(plan, agg_conds, root, leaves, joins, ctx,
+                             mesh)
+
+
+def _run_mpp_impl(plan, agg_conds, root, leaves, joins, ctx, mesh):
     from ..utils import failpoint as _fp
     # chaos/supervisor hook: a `sleep(...)` here models a hung collective
     # at the MPP fragment boundary (the exchange-dispatch analog of

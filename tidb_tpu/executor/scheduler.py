@@ -262,6 +262,14 @@ def admit(ctx, shape: str = "agg", batch_key=None) -> "Ticket | None":
     :class:`DeviceAdmissionError` when the queue is full, the admission
     wait times out, or the ``device-admission`` failpoint injects a
     refusal — run_device degrades the fragment to the host engine."""
+    from ..session import tracing
+    # the statement's span tracer (one branch when sampling is off):
+    # queue waits and batch-coalesce grants tag this span
+    with tracing.span("scheduler.acquire", shape=shape) as _tsp:
+        return _admit_impl(ctx, shape, batch_key, _tsp)
+
+
+def _admit_impl(ctx, shape, batch_key, _tsp):
     from ..utils import failpoint
     from ..utils.failpoint import InjectedAdmissionError
     _refresh_cfg(ctx)
@@ -294,6 +302,8 @@ def admit(ctx, shape: str = "agg", batch_key=None) -> "Ticket | None":
             _RUNNING[group] += 1
             STATS["admitted"] += 1
             STATS["fast_grants"] += 1
+            if _tsp is not None:
+                _tsp.tags["fast"] = True
             return ticket
         if _QUEUED_N[0] >= _CFG["depth"]:
             # the depth bound is per-group FAIR at the margin (the same
@@ -338,12 +348,22 @@ def admit(ctx, shape: str = "agg", batch_key=None) -> "Ticket | None":
             if deadline is not None and time.monotonic() >= deadline:
                 break
         waited_ms = (time.monotonic() - ticket.enqueued_at) * 1000.0
+        # queue-wait attribution: the p99-scrapeable histogram and the
+        # statement's trace span (both outside _LOCK — the recorder and
+        # the observe registry are never touched under the queue mutex)
+        _observe_hist("admission_wait_seconds", waited_ms / 1000.0)
+        if _tsp is not None:
+            _tsp.tags["queued_ms"] = round(waited_ms, 1)
         with _LOCK:
             STATS["sched_admission_waits_ms"] += waited_ms
             # on timeout the ticket may STILL be granted in the race
             # window — the scheduler grants under this same lock, so the
             # is_set re-check here is authoritative
             if granted or ticket.granted.is_set():
+                if _tsp is not None and ticket.batched:
+                    # granted as a follower on a shared batch key: this
+                    # fragment rode another ticket's scheduling slot
+                    _tsp.tags["batched"] = True
                 return ticket
             try:
                 _QUEUES[ticket.group].remove(ticket)
@@ -553,6 +573,18 @@ def attach(ctx):
     if obs is not None and hasattr(obs, "set_gauge"):
         with _LOCK:
             _SINKS.add(obs)
+
+
+def _observe_hist(name, value):
+    """Record one latency sample into every attached observe registry
+    (session/observe.py HIST_BUCKETS — the /metrics `_bucket` series).
+    Runs OUTSIDE _LOCK except for the sink-list snapshot."""
+    with _LOCK:
+        sinks = list(_SINKS)
+    for obs in sinks:
+        f = getattr(obs, "observe_hist", None)
+        if f is not None:
+            f(name, value)
 
 
 def _publish_gauges():

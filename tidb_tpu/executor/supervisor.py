@@ -99,7 +99,7 @@ _SINKS: "weakref.WeakSet" = weakref.WeakSet()
 
 class _Job:
     __slots__ = ("fn", "args", "kw", "done", "result", "exc", "orphaned",
-                 "tls", "label", "group")
+                 "tls", "label", "group", "trace")
 
     def __init__(self, fn, args, kw, label):
         self.fn = fn
@@ -115,6 +115,10 @@ class _Job:
         # worker thread so residency charges supervised uploads to the
         # right tenant (ops/residency per-group shares), not "default"
         self.group = "default"
+        # the dispatching thread's (trace, span) — adopted by the worker
+        # so spans/events recorded inside the supervised call still nest
+        # under the statement's supervisor.call span (session/tracing.py)
+        self.trace = None
 
 
 class _Worker(threading.Thread):
@@ -148,7 +152,12 @@ class _Worker(threading.Thread):
             except Exception:
                 pass
             try:
-                job.result = job.fn(*job.args, **job.kw)
+                if job.trace is not None:
+                    from ..session import tracing
+                    with tracing.adopt(*job.trace):
+                        job.result = job.fn(*job.args, **job.kw)
+                else:
+                    job.result = job.fn(*job.args, **job.kw)
             except BaseException as e:  # noqa: BLE001 — re-raised in waiter
                 job.exc = e
             if st0 is not None:
@@ -459,13 +468,28 @@ def call_supervised(fn, args=(), kw=None, *, deadline_s: float = 0.0,
     unhealthy — its verdict simply stopped mattering)."""
     kw = kw or {}
     _maybe_reinit()
+    from ..session import tracing
     if deadline_s is None or deadline_s <= 0:
         # the unsupervised hot path stays a bool check + plain call —
         # sink registration only matters once supervision can fire
-        return fn(*args, **kw)
+        # (tracing off adds exactly the one active() branch)
+        if tracing.active() is None:
+            return fn(*args, **kw)
+        with tracing.span("supervisor.call", inline=True, shape=shape):
+            return fn(*args, **kw)
+    with tracing.span("supervisor.call", deadline_s=round(deadline_s, 3),
+                      shape=shape):
+        return _call_on_worker(fn, args, kw, deadline_s, ctx, shape,
+                               label, fence_on_expiry)
+
+
+def _call_on_worker(fn, args, kw, deadline_s, ctx, shape, label,
+                    fence_on_expiry):
+    from ..session import tracing
     _register_sink(ctx)
     label = label or getattr(fn, "__name__", "device call")
     job = _Job(fn, args, kw, label)
+    job.trace = tracing.capture()
     try:
         from ..ops import residency
         job.group = residency.current_group()
@@ -492,6 +516,9 @@ def call_supervised(fn, args=(), kw=None, *, deadline_s: float = 0.0,
             if job.exc is not None:
                 raise job.exc
             return job.result
+        tracing.event("supervisor.abandoned", label=label,
+                      deadline_s=round(deadline_s, 3),
+                      fenced=fence_on_expiry)
         if not fence_on_expiry:
             # the binding deadline was the user's max_execution_time: a
             # statement-time limit, not a backend-health verdict — no

@@ -7,4 +7,5 @@ from . import failpoints  # noqa: F401
 from . import gauges  # noqa: F401
 from . import locks  # noqa: F401
 from . import taxonomy  # noqa: F401
+from . import trace_cov  # noqa: F401
 from . import traced  # noqa: F401

@@ -169,6 +169,58 @@ class GaugeConsistency(Rule):
                     rel, line, f"unsurfaced-explain:{name}",
                     f"gauge '{name}' is published but never annotated "
                     "into EXPLAIN ANALYZE"))
+        out += self._check_histograms(ctx)
+        return out
+
+    def _check_histograms(self, ctx):
+        """The histogram analog of the gauge check: every `observe_hist`
+        call must name a key of the HIST_BUCKETS registry
+        (session/observe.py — the literal dict /metrics renders as
+        `_bucket`/`_sum`/`_count` series), and every registry key must
+        have a caller — a documented-but-dead histogram name is the same
+        drift the gauge rule pins."""
+        obs_sf = ctx.file("session/observe.py")
+        if obs_sf is None:
+            return []
+        registry = {}
+        for node in obs_sf.tree.body:
+            if (isinstance(node, ast.Assign) and node.targets
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "HIST_BUCKETS"
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    s = const_str(k)
+                    if s:
+                        registry[s] = node.lineno
+        observed = []  # (name, rel, line)
+        for sf in ctx.package_files:
+            if sf.rel.startswith("lint/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Call)
+                        and call_name(node).rsplit(".", 1)[-1] in
+                        ("observe_hist", "_observe_hist") and node.args):
+                    s = const_str(node.args[0])
+                    if s:
+                        observed.append((s, sf.rel, node.lineno))
+        out = []
+        seen = set()
+        for name, rel, line in sorted(observed):
+            if rel == "session/observe.py" or name in seen:
+                continue  # the registry's own recorder method
+            seen.add(name)
+            if name not in registry:
+                out.append(self.finding(
+                    rel, line, f"unregistered-hist:{name}",
+                    f"histogram '{name}' is observed but not a key of "
+                    "session/observe.py HIST_BUCKETS (the /metrics "
+                    "bucket registry)"))
+        for name, line in sorted(registry.items()):
+            if name not in seen:
+                out.append(self.finding(
+                    obs_sf.rel, line, f"unobserved-hist:{name}",
+                    f"histogram '{name}' is registered in HIST_BUCKETS "
+                    "but nothing ever observes it"))
         return out
 
 

@@ -313,6 +313,7 @@ def publish(col, data, nulls):
     buffer untracked until GC)."""
     nbytes = _nbytes(data) + _nbytes(nulls)
     rows = int(data.shape[0])
+    budget_evicted = 0
     with _LOCK:
         cur = col._device
         if (cur is not None and cur.epoch == _EPOCH[0]
@@ -338,9 +339,16 @@ def publish(col, data, nulls):
             _BYTES[0] += nbytes
             _GROUP_BYTES[group] += nbytes
             STATS["uploads"] += 1
+            ev0 = STATS["hbm_evictions"]
             _enforce_budget_locked(keep_token=token, group=group)
+            budget_evicted = STATS["hbm_evictions"] - ev0
             out = data, nulls
     _publish_gauges()
+    if budget_evicted:
+        # span tracing (session/tracing.py): budget-pressure evictions on
+        # the statement's timeline — recorded OUTSIDE the ledger lock
+        from ..session.tracing import event as _trace_event
+        _trace_event("residency.evict", n=budget_evicted, reason="budget")
     return out
 
 
@@ -459,6 +467,9 @@ def evict_all(reason: str = "") -> int:
     if n:
         log.info("evicted all %d cached device uploads (%s)",
                  n, reason or "explicit")
+        from ..session.tracing import event as _trace_event
+        _trace_event("residency.evict", n=n,
+                     reason=reason or "explicit")
     _publish_gauges()
     return n
 
@@ -479,6 +490,8 @@ def recover_oom(err=None) -> int:
     log.warning("device OOM (%s): evicted %d cached uploads, retrying once "
                 "before host degradation", err, n)
     _publish_gauges()
+    from ..session.tracing import event as _trace_event
+    _trace_event("residency.evict", n=n, reason="oom")
     return n
 
 

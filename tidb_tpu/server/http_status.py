@@ -25,6 +25,12 @@ def _compiler_snapshot() -> dict:
     return compile_service.snapshot()
 
 
+def _tracing_snapshot() -> dict:
+    """Span-tracer ring stats for /status (process-wide)."""
+    from ..session import tracing
+    return tracing.snapshot()
+
+
 class StatusServer:
     def __init__(self, domain, sql_server=None, host="127.0.0.1", port=10080):
         self.domain = domain
@@ -132,6 +138,11 @@ class StatusServer:
             "device_breakers": {
                 shape: br.snapshot() for shape, br in
                 getattr(self.domain, "_device_breakers", {}).items()},
+            # span tracing (session/tracing.py): finished-trace ring
+            # occupancy, started/finished/outstanding trace counts and
+            # the per-trace span-bound drop counter — whether the
+            # recorder is keeping up is diagnosable from the status port
+            "device_tracing": _tracing_snapshot(),
         }
 
     def _metrics(self):
@@ -194,6 +205,21 @@ class StatusServer:
         for name, val in sorted(gauges.items()):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {val}")
+        # per-layer latency histograms (session/observe.py HIST_BUCKETS)
+        # as proper Prometheus cumulative `_bucket`/`_sum`/`_count`
+        # series — statement / admission-wait / sync-compile / dispatch
+        # p99s are scrapeable without bench.py
+        for name, (bounds, counts, hsum, _cnt) in sorted(
+                self.domain.observe.hist_snapshot().items()):
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for b, c in zip(bounds, counts):
+                cum += c
+                lines.append(f'{name}_bucket{{le="{b:g}"}} {cum}')
+            cum += counts[-1]
+            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{name}_sum {hsum:g}")
+            lines.append(f"{name}_count {cum}")
         lines.append("# TYPE server_connections gauge")
         lines.append(f"server_connections {len(self.domain.sessions)}")
         return "\n".join(lines) + "\n"

@@ -317,7 +317,7 @@ def _slow_query(session):
     """reference: executor/slow_query.go reading the slow log back as SQL."""
     cols = [("time", _S), ("user", _S), ("db", _S), ("query_time", _F),
             ("digest", _S), ("query", _S), ("result_rows", _I),
-            ("succ", _I), ("plan", _S)]
+            ("succ", _I), ("plan", _S), ("trace", _S)]
 
     def rows():
         import datetime as _dt
@@ -327,7 +327,34 @@ def _slow_query(session):
                 "%Y-%m-%d %H:%M:%S.%f")
             out.append((ts.encode(), it.user.encode(), it.db.encode(),
                         it.duration_s, it.digest.encode(), it.sql.encode(),
-                        it.rows, 1 if it.succ else 0, it.plan.encode()))
+                        it.rows, 1 if it.succ else 0, it.plan.encode(),
+                        getattr(it, "trace", "").encode()))
+        return out
+    return cols, rows
+
+
+def _trace_records(session):
+    """Recent query-lifecycle traces (session/tracing.py ring): one row
+    per finished trace with its rendered span tree — the reference's
+    trace memtable shape over the bounded process-wide ring."""
+    from . import tracing
+    cols = [("trace_id", _I), ("parent_id", _I), ("origin", _S),
+            ("name", _S), ("start_ts", _S), ("duration_s", _F),
+            ("spans", _I), ("dropped", _I), ("conn_id", _I), ("succ", _I),
+            ("tree", _S)]
+
+    def rows():
+        import datetime as _dt
+        out = []
+        for tr in tracing.recent_traces():
+            ts = _dt.datetime.fromtimestamp(tr.started_at).strftime(
+                "%Y-%m-%d %H:%M:%S.%f")
+            out.append((tr.trace_id, tr.parent_id or 0,
+                        tr.origin.encode(), tr.name.encode(), ts.encode(),
+                        tr.dur_s if tr.dur_s is not None else 0.0,
+                        len(tr.spans), tr.dropped, tr.conn_id or 0,
+                        1 if tr.succ else 0,
+                        tracing.render_tree(tr).encode()))
         return out
     return cols, rows
 
@@ -510,6 +537,7 @@ _TABLES = {
     ("information_schema", "placement_policies"): _placement_policies,
     ("information_schema", "key_column_usage"): _key_column_usage,
     ("information_schema", "slow_query"): _slow_query,
+    ("information_schema", "trace_records"): _trace_records,
     ("information_schema", "statements_summary"): _statements_summary,
     ("information_schema", "cluster_slow_query"): _slow_query,
     ("information_schema", "metrics"): _metrics,

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import random as _random
 import threading
 import time
 
@@ -26,6 +27,7 @@ from ..planner.logical import explain_tree
 from ..sqltypes import (TYPE_LONGLONG, TYPE_VARCHAR, FieldType, format_value)
 from ..utils.chunk import Chunk
 from . import sysvars as sv
+from . import tracing
 
 
 class Domain:
@@ -1025,6 +1027,20 @@ class Session:
                 timer = _threading.Timer(timeout_ms / 1000.0, self.kill)
                 timer.daemon = True
                 timer.start()
+        # span tracing (session/tracing.py): sample this statement's
+        # lifecycle per tidb_trace_sampling_rate (TRACE statements force
+        # their own trace in _exec_trace).  Sampling off costs exactly
+        # this one sysvar read + branch; no Trace is ever allocated.
+        tr = None
+        if not self._internal and tracing.active() is None:
+            try:
+                rate = float(self.get_sysvar("tidb_trace_sampling_rate"))
+            except (TiDBError, ValueError, TypeError):
+                rate = 0.0
+            if rate > 0 and (rate >= 1.0 or _random.random() < rate):
+                tr = tracing.begin("statement", origin="sampled",
+                                   conn_id=self.conn_id,
+                                   stmt=type(stmt).__name__)
         t0 = time.perf_counter()
         try:
             sql = stmt.restore()
@@ -1075,13 +1091,30 @@ class Session:
             self.current_sql = None
             el = time.perf_counter() - t0
             try:
+                if tr is not None:
+                    tracing.finish(tr, succ=res is not None)
                 thr_ms = int(self.get_sysvar("tidb_slow_log_threshold"))
                 rows = (res.affected if res is not None and res.chunk is None
                         else (res.chunk.num_rows if res is not None else 0))
+                # a sampled statement crossing the slow threshold keeps
+                # its rendered span tree on the SlowQueryItem — the
+                # causal timeline lands NEXT TO the slow entry instead
+                # of needing a separate trace lookup
+                trace_text = ""
+                if tr is not None and el >= thr_ms / 1000.0:
+                    trace_text = tracing.render_tree(tr)
+                try:
+                    slow_file = str(
+                        self.get_sysvar("tidb_slow_query_file")).strip()
+                except TiDBError:
+                    slow_file = ""
                 self.domain.observe.observe_stmt(
                     user=self.user, db=self._db, sql=sql,
                     digest=sql_digest(sql), latency_s=el, rows=rows,
-                    succ=res is not None, slow_threshold_s=thr_ms / 1000.0)
+                    succ=res is not None, slow_threshold_s=thr_ms / 1000.0,
+                    trace=trace_text, slow_query_file=slow_file)
+                self.domain.observe.observe_hist(
+                    "statement_duration_seconds", el)
             except Exception:
                 pass  # observability must never fail the statement
 
@@ -1636,7 +1669,8 @@ class Session:
                     and isinstance(stmt, (ast.SelectStmt, ast.SetOprStmt))):
                 plan, cache_key = self._cached_plan(stmt)
             if plan is None:
-                plan = self.plan_query(stmt, outer=outer)
+                with tracing.span("session.plan_query"):
+                    plan = self.plan_query(stmt, outer=outer)
                 if cache_key is not None:
                     from ..planner.plan_cache import collect_param_consts
                     try:
@@ -1646,8 +1680,27 @@ class Session:
                         cap = 0
                     self.plan_cache.put(cache_key, plan,
                                         collect_param_consts(plan), cap)
-            exe = build_executor(plan, self._exec_ctx())
-            chunk = exe.execute()
+            # when this statement is traced, wire a runtime-stats
+            # collector through the executor tree so per-operator times
+            # land in the span tree as events (the TRACE statement's
+            # operator rows; reference: executor/trace.go reading the
+            # runtime stats back into the span collector)
+            coll = None
+            if outer is None and tracing.active() is not None:
+                from ..executor.execdetails import RuntimeStatsColl
+                coll = RuntimeStatsColl()
+            with tracing.span("executor.build"):
+                exe = build_executor(plan, self._exec_ctx(), stats=coll)
+            with tracing.span("executor.run"):
+                chunk = exe.execute()
+            if coll is not None:
+                from ..planner.logical import explain_nodes
+                for name, _info, node in explain_nodes(plan):
+                    if coll.has(node):
+                        st = coll.get(node)
+                        tracing.event(
+                            "operator." + name.strip().replace("└─", ""),
+                            time_s=round(st.time_s, 6), rows=st.rows)
             # a kill that landed after the LAST operator checkpoint still
             # cancels the statement (the result is discarded) — without
             # this, a kill during the final operator's long tail is
@@ -1961,48 +2014,43 @@ class Session:
                              "operator info", "memory"], chunk=out)
 
     def _exec_trace(self, stmt: ast.TraceStmt) -> Result:
-        """TRACE SELECT ... — renders the span tree of one execution as a
-        table (reference: executor/trace.go:50). Spans: plan build/optimize,
-        executor build, per-operator execution (from the runtime stats
-        collector), and the total."""
+        """TRACE [FORMAT='row'|'json'] <stmt> — run the statement under a
+        FORCED lifecycle trace (session/tracing.py, sampling-independent)
+        and render its span tree: the statement root, plan/build/run,
+        and every resilience-layer chokepoint the execution crossed —
+        admission, compile service (with mode), supervisor deadline,
+        device dispatch, backoff sleeps, residency evictions (reference:
+        executor/trace.go:50 + util/tracing).  FORMAT='opt' keeps the
+        optimizer rule trace."""
         inner = stmt.stmt
         if stmt.format == "opt" and isinstance(
                 inner, (ast.SelectStmt, ast.SetOprStmt)):
             return self._exec_opt_trace(inner)
-        if not isinstance(inner, (ast.SelectStmt, ast.SetOprStmt)):
-            r = self._dispatch(inner)  # non-SELECT: run it, no spans
-            return r
-        from ..executor import build_executor
-        from ..executor.execdetails import RuntimeStatsColl, _fmt_dur
-        from ..planner.logical import explain_nodes
-        spans = []
-        t_total = time.perf_counter()
-        t0 = time.perf_counter()
-        plan = self.plan_query(inner)
-        spans.append(("session.plan_query", t0 - t_total,
-                      time.perf_counter() - t0))
-        coll = RuntimeStatsColl()
-        t0 = time.perf_counter()
-        exe = build_executor(plan, self._exec_ctx(), stats=coll)
-        spans.append(("executor.build", t0 - t_total,
-                      time.perf_counter() - t0))
-        t0 = time.perf_counter()
-        exe.execute()
-        spans.append(("executor.run", t0 - t_total,
-                      time.perf_counter() - t0))
-        for name, _info, node in explain_nodes(plan):
-            if coll.has(node):
-                st = coll.get(node)
-                spans.append((f"  operator.{name.strip().replace('└─', '')}",
-                              None, st.time_s))
-        total = time.perf_counter() - t_total
+        tr = tracing.active()
+        if tr is None:
+            # always-on: a TRACE statement never depends on the sampler
+            tr = tracing.begin("statement", origin="trace_stmt",
+                               conn_id=self.conn_id,
+                               stmt=type(inner).__name__)
+        succ = False
+        try:
+            with tracing.span("statement.dispatch"):
+                self._dispatch(inner)
+            succ = True
+        finally:
+            # finish UNCONDITIONALLY before rendering: when the sampler
+            # already traced this TRACE statement, rendering the live
+            # trace would show a '-' root duration and a succ flag that
+            # can never be false.  finish() is idempotent, so the
+            # statement loop's own finish in _execute_stmt stays a no-op
+            tracing.finish(tr, succ=succ)
         ft = FieldType(tp=TYPE_VARCHAR)
-        rows = [(b"trace.total", b"0s", _fmt_dur(total).encode())]
-        for op, start, dur in spans:
-            rows.append((op.encode(),
-                         (_fmt_dur(start) if start is not None else "-"
-                          ).encode(),
-                         _fmt_dur(dur).encode()))
+        if stmt.format == "json":
+            payload = json.dumps(tr.to_dict(), default=str)
+            return Result(names=["trace"],
+                          chunk=Chunk.from_rows([ft], [(payload.encode(),)]))
+        rows = [(op.encode(), start.encode(), dur.encode())
+                for op, start, dur in tracing.tree_rows(tr)]
         return Result(names=["operation", "startTS", "duration"],
                       chunk=Chunk.from_rows([ft, ft, ft], rows))
 

@@ -151,6 +151,11 @@ for _v in [
     SysVar("tidb_device_compact", SCOPE_BOTH, "auto", "enum",
            choices=("auto", "on", "off")),
     SysVar("tidb_slow_log_threshold", SCOPE_BOTH, "300", "int", 0),
+    # query-lifecycle span tracing (session/tracing.py): fraction of
+    # statements sampled into a full span trace (0 = off, the default —
+    # one branch per chokepoint; 1 = every statement).  TRACE statements
+    # are always-on regardless of this rate.
+    SysVar("tidb_trace_sampling_rate", SCOPE_BOTH, "0", "float", 0, 1),
     SysVar("cte_max_recursion_depth", SCOPE_BOTH, "1000", "int", 0, 4294967295),
     SysVar("tidb_auto_analyze_ratio", SCOPE_GLOBAL, "0.5", "float"),
     SysVar("tidb_enable_auto_analyze", SCOPE_GLOBAL, "ON", "bool"),

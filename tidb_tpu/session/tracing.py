@@ -1,0 +1,485 @@
+"""Query-lifecycle span tracing: a low-overhead hierarchical span
+recorder for one statement's causal timeline (reference: util/tracing —
+TiDB's opentracing shim behind ``TRACE <stmt>`` and the trace memtables).
+
+Why this exists (ISSUE 10, BENCH_TPU_LIVE.json): when the live-TPU run
+died (Q5's dead-tunnel remote compile, 147-379s compiles dominating) the
+gauges said *that* things were slow but never *where inside one query*
+the time went — admission wait vs compile vs supervisor deadline vs
+backoff sleeps vs device dispatch vs host degradation.  This module is
+the per-query instrument: every resilience-layer chokepoint
+(scheduler.admit, compile_service.obtain, supervisor.call_supervised,
+device_exec.run_device, Backoffer.backoff, residency evictions) records
+a span or event into the statement's trace when one is active, and
+stays a SINGLE BRANCH when none is (sampling off ⇒ near-zero cost —
+micro-checked in tier-1).
+
+Model:
+
+* A :class:`Trace` is one statement's span tree — monotonic-clock spans
+  with tags and point events, bounded per-trace (``MAX_SPANS`` /
+  ``MAX_EVENTS``; overflow counts ``dropped``, never grows).
+* The ACTIVE trace is thread-local.  :func:`span` / :func:`event` read
+  one TLS slot and return the shared no-op when nothing is active.
+* **Thread hops**: :func:`capture` + :func:`adopt` carry the (trace,
+  current span) pair onto supervisor worker threads (``_Job``), so a
+  span opened inside a supervised device call still nests under the
+  dispatching statement's ``supervisor.call`` span.
+* **Linked child traces**: a background compile job gets its OWN trace
+  (:func:`link_child`) carrying ``parent_id`` — an async compile's
+  lifetime is attributable to the query that triggered it even though
+  it outlives the statement.
+* Finished traces land in a bounded process-wide ring, read back through
+  ``information_schema.trace_records``, the ``TRACE`` statement, slow-log
+  items and the bench error lines; ring stats surface in ``/status``
+  (``device_tracing``).
+
+Sampling: ``tidb_trace_sampling_rate`` (session/session.py decides per
+statement); ``TRACE <stmt>`` is always-on, and a sampled statement that
+crosses the slow-log threshold always keeps its rendered tree on the
+:class:`~tidb_tpu.session.observe.SlowQueryItem`.
+
+Locking: each trace has its own tiny lock (span/event appends from
+worker threads); the ring has one.  Neither is ever held across a
+blocking call, and no serving mutex (scheduler/supervisor/residency/
+compile-service) is ever taken by this module — the recorder appends,
+full stop (the ``blocking-while-locked`` lint audits tracing.py like
+every other module-level lock owner).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+#: per-trace bounds: spans/events beyond these count `dropped` instead of
+#: growing the trace (a pathological plan must not turn the recorder into
+#: a memory leak)
+MAX_SPANS = 256
+MAX_EVENTS = 1024
+
+#: finished traces retained for information_schema.trace_records / the
+#: bench post-mortem dumps (process-wide, like the supervisor STATS)
+RING_CAP = 64
+
+_TLS = threading.local()
+_RING: "collections.deque" = collections.deque(maxlen=RING_CAP)
+_RING_LOCK = threading.Lock()
+_SEQ = itertools.count(1)
+
+STATS = {
+    "started": 0,       # traces begun (statements sampled + TRACE + children)
+    "finished": 0,      # traces finished (ring candidates)
+    "spans_dropped": 0,  # spans/events lost to the per-trace bounds
+    "child_links": 0,   # background jobs linked as child traces
+}
+
+
+class Span:
+    __slots__ = ("sid", "parent_sid", "name", "t0", "_m0", "dur_s", "tags",
+                 "events")
+
+    def __init__(self, sid, parent_sid, name, t0, m0, tags):
+        self.sid = sid
+        self.parent_sid = parent_sid
+        self.name = name
+        self.t0 = t0          # seconds since trace start
+        self._m0 = m0         # monotonic at open (duration source)
+        self.dur_s = None     # None until the span closes
+        self.tags = tags
+        self.events = []      # (t_offset_s, name, tags)
+
+
+class Trace:
+    """One statement's (or background job's) span tree."""
+
+    __slots__ = ("trace_id", "parent_id", "origin", "name", "conn_id",
+                 "started_at", "_t0", "spans", "dropped", "_lock", "root",
+                 "finished", "dur_s", "succ", "n_events")
+
+    def __init__(self, name, origin="sampled", conn_id=None, parent_id=None,
+                 tags=None):
+        self.trace_id = next(_SEQ)
+        self.parent_id = parent_id    # linking trace id (bg compile jobs)
+        self.origin = origin          # sampled | trace_stmt | child
+        self.name = name
+        self.conn_id = conn_id
+        self.started_at = time.time()
+        self._t0 = time.monotonic()
+        self.spans: list[Span] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self.finished = False
+        self.dur_s = None
+        self.succ = True
+        self.n_events = 0
+        self.root = self._start_span(name, -1, dict(tags or ()))
+
+    # -- recording (any thread holding this trace via TLS) -------------------
+
+    def _start_span(self, name, parent_sid, tags) -> "Span | None":
+        now = time.monotonic()
+        with self._lock:
+            if self.finished:
+                # an abandoned supervisor worker unsticking AFTER the
+                # statement's trace finished must not mutate a trace
+                # already published to the ring (renders would drift,
+                # and its drops were already tallied into STATS)
+                return None
+            if len(self.spans) >= MAX_SPANS:
+                self.dropped += 1
+                return None
+            sp = Span(len(self.spans), parent_sid, name, now - self._t0,
+                      now, tags)
+            self.spans.append(sp)
+            return sp
+
+    def _end_span(self, sp: Span, error: "str | None" = None):
+        # only the opening _SpanCtx closes a span; the finished-gate
+        # (under the lock, like _start_span/add_event) keeps an
+        # abandoned worker's late exit from mutating a ring-published
+        # trace — its span stays open-ended ('-') exactly as the slow
+        # log and bench error line already rendered it
+        with self._lock:
+            if self.finished:
+                return
+            if error is not None:
+                sp.tags["error"] = error
+            sp.dur_s = time.monotonic() - sp._m0
+
+    def add_event(self, sp: "Span | None", name, tags):
+        now = time.monotonic() - self._t0
+        with self._lock:
+            if self.finished:
+                return  # see _start_span: ring-published traces freeze
+            if self.n_events >= MAX_EVENTS:
+                self.dropped += 1
+                return
+            self.n_events += 1
+            (sp if sp is not None else self.root).events.append(
+                (now, name, tags))
+
+    def _finish(self, succ: bool):
+        with self._lock:
+            if self.finished:
+                return False
+            self.finished = True
+            self.succ = succ
+            self.dur_s = time.monotonic() - self._t0
+            if self.root.dur_s is None:
+                self.root.dur_s = self.dur_s
+            return True
+
+    # -- read-back (finished traces; mid-flight reads tolerate None durs) ----
+
+    def children_of(self) -> dict:
+        out: dict[int, list] = {}
+        with self._lock:
+            spans = list(self.spans)
+        for sp in spans:
+            out.setdefault(sp.parent_sid, []).append(sp)
+        return out
+
+    def to_dict(self) -> dict:
+        kids = self.children_of()
+
+        def node(sp):
+            d = {"name": sp.name, "start_s": round(sp.t0, 6),
+                 "duration_s": (round(sp.dur_s, 6)
+                                if sp.dur_s is not None else None)}
+            if sp.tags:
+                d["tags"] = dict(sp.tags)
+            if sp.events:
+                d["events"] = [
+                    {"at_s": round(t, 6), "name": n, **({"tags": tg}
+                                                        if tg else {})}
+                    for t, n, tg in sp.events]
+            ch = [node(c) for c in kids.get(sp.sid, ())]
+            if ch:
+                d["children"] = ch
+            return d
+
+        return {"trace_id": self.trace_id, "parent_id": self.parent_id,
+                "origin": self.origin, "conn_id": self.conn_id,
+                "started_at": self.started_at,
+                "duration_s": (round(self.dur_s, 6)
+                               if self.dur_s is not None else None),
+                "succ": self.succ, "spans": len(self.spans),
+                "dropped": self.dropped, "root": node(self.root)}
+
+
+# -- the hot-path API ---------------------------------------------------------
+
+class _NoopCtx:
+    """The shared do-nothing span: sampling off costs one TLS read + this
+    singleton — no Trace, no Span, no lock (micro-checked in tier-1)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+_NOOP = _NoopCtx()
+
+
+class _SpanCtx:
+    __slots__ = ("tr", "name", "tags", "sp", "prev")
+
+    def __init__(self, tr, name, tags):
+        self.tr = tr
+        self.name = name
+        self.tags = tags
+
+    def __enter__(self):
+        parent = getattr(_TLS, "span", None)
+        sp = self.tr._start_span(
+            self.name, parent.sid if parent is not None else 0, self.tags)
+        self.sp = sp
+        self.prev = parent
+        if sp is not None:
+            _TLS.span = sp
+        return sp
+
+    def __exit__(self, et, ev, tb):
+        sp = self.sp
+        if sp is not None:
+            self.tr._end_span(
+                sp, error=et.__name__ if et is not None else None)
+            _TLS.span = self.prev
+        return False
+
+
+def active() -> "Trace | None":
+    """The calling thread's live trace, or None (THE one-branch check
+    every chokepoint reduces to when sampling is off)."""
+    return getattr(_TLS, "trace", None)
+
+
+def span(name, **tags):
+    """Context manager opening a child span of the calling thread's
+    current span — or the shared no-op when no trace is active."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return _NOOP
+    return _SpanCtx(tr, name, tags)
+
+
+def event(name, **tags):
+    """Record a point event on the current span (one branch when off)."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return
+    tr.add_event(getattr(_TLS, "span", None), name, tags)
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+def begin(name, *, origin="sampled", conn_id=None, parent_id=None,
+          **tags) -> Trace:
+    """Start a trace and bind it to the calling thread."""
+    tr = Trace(name, origin, conn_id, parent_id, tags)
+    _TLS.trace = tr
+    _TLS.span = tr.root
+    with _RING_LOCK:
+        STATS["started"] += 1
+    return tr
+
+
+def finish(tr: Trace, succ: bool = True):
+    """Finish a trace (idempotent), unbind it from this thread if bound,
+    and retain it in the ring."""
+    if getattr(_TLS, "trace", None) is tr:
+        _TLS.trace = None
+        _TLS.span = None
+    if not tr._finish(succ):
+        return
+    with _RING_LOCK:
+        STATS["finished"] += 1
+        STATS["spans_dropped"] += tr.dropped
+        _RING.append(tr)
+
+
+def link_child(name, **tags) -> "Trace | None":
+    """A NEW unbound trace linked under the calling thread's active trace
+    (``parent_id`` = the active trace's id) — how a background compile
+    job stays attributable to the query that submitted it.  The worker
+    binds it with :func:`adopt`; :func:`finish` retires it.  None when
+    no trace is active."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None or tr.finished:
+        # finished: the binding thread is an ABANDONED supervisor worker
+        # unsticking after its statement's trace was published — the
+        # parent can no longer record the link, so a child would be an
+        # orphan that misattributes ring lookups (and the straggler's
+        # spans are noise, not a query's timeline)
+        return None
+    child = Trace(name, "child", tr.conn_id, tr.trace_id, tags)
+    with _RING_LOCK:
+        STATS["started"] += 1
+        STATS["child_links"] += 1
+    event("linked_child_trace", trace_id=child.trace_id, child=name)
+    return child
+
+
+def capture():
+    """(trace, current span) of the calling thread, or None — recorded at
+    a thread-hop submit site (supervisor ``_Job``) and re-bound on the
+    worker with :func:`adopt`."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return None
+    return tr, getattr(_TLS, "span", None)
+
+
+class adopt:
+    """Bind (trace, span) on the CURRENT thread for a scope (worker-side
+    half of the thread hop; also used by bg-compile workers to run under
+    their linked child trace)."""
+
+    __slots__ = ("tr", "sp", "_prev")
+
+    def __init__(self, tr, sp=None):
+        self.tr = tr
+        self.sp = sp if sp is not None else tr.root
+
+    def __enter__(self):
+        self._prev = (getattr(_TLS, "trace", None),
+                      getattr(_TLS, "span", None))
+        _TLS.trace = self.tr
+        _TLS.span = self.sp
+        return self.tr
+
+    def __exit__(self, *a):
+        _TLS.trace, _TLS.span = self._prev
+        return False
+
+
+# -- rendering ----------------------------------------------------------------
+
+def _fmt_s(s) -> str:
+    if s is None:
+        return "-"
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    if s >= 0.001:
+        return f"{s * 1e3:.3f}ms"
+    return f"{s * 1e6:.0f}µs"
+
+
+def tree_rows(tr: Trace) -> list:
+    """Depth-first (operation, startTS, duration) rows — the TRACE
+    FORMAT='row' resultset shape (reference: executor/trace.go).  Events
+    render as zero-duration rows prefixed ``@``."""
+    kids = tr.children_of()
+    rows = []
+
+    def walk(sp, depth):
+        pad = "  " * depth
+        rows.append((pad + sp.name, _fmt_s(sp.t0), _fmt_s(sp.dur_s)))
+        items = [("s", c.t0, c) for c in kids.get(sp.sid, ())]
+        items += [("e", t, (t, n, tg)) for t, n, tg in sp.events]
+        for kind, _at, payload in sorted(items, key=lambda x: x[1]):
+            if kind == "s":
+                walk(payload, depth + 1)
+            else:
+                t, n, tg = payload
+                tag_s = (" " + ",".join(f"{k}={v}" for k, v in tg.items())
+                         if tg else "")
+                rows.append((f"{pad}  @{n}{tag_s}", _fmt_s(t), "-"))
+
+    walk(tr.root, 0)
+    return rows
+
+
+def render_tree(tr: Trace) -> str:
+    """One text block per trace — what slow-log items and the bench error
+    lines carry (the Q5 post-mortem artifact)."""
+    lines = [f"trace {tr.trace_id}"
+             + (f" (child of {tr.parent_id})" if tr.parent_id else "")
+             + f" [{tr.origin}] dur={_fmt_s(tr.dur_s)}"
+             + ("" if tr.succ else " FAILED")
+             + (f" dropped={tr.dropped}" if tr.dropped else "")]
+    for op, start, dur in tree_rows(tr):
+        lines.append(f"  {dur:>10}  {start:>10}  {op}")
+    return "\n".join(lines)
+
+
+# -- ring / introspection -----------------------------------------------------
+
+def recent_traces() -> list:
+    """Newest-last snapshot of the finished-trace ring."""
+    with _RING_LOCK:
+        return list(_RING)
+
+
+def last_trace(conn_id=None, include_children=False) -> "Trace | None":
+    """The most recent finished STATEMENT trace (optionally for one
+    connection) — the bench watchdog's post-mortem lookup.  Background
+    ``compile.bg`` child traces are skipped unless asked for: a child
+    finishing after the failed statement must not shadow it."""
+    with _RING_LOCK:
+        for tr in reversed(_RING):
+            if not include_children and tr.origin == "child":
+                continue
+            if conn_id is None or tr.conn_id == conn_id:
+                return tr
+    return None
+
+
+def last_trace_text(conn_id=None, cap: int = 4000) -> str:
+    """Rendered post-mortem timeline, capped — THE bench-error helper
+    (one implementation for bench.py / bench_multichip.py /
+    bench_serve.py; pass the failing session's ``conn_id`` so a
+    concurrent healthy session's timeline is never misattributed to the
+    failure).  The CALLING thread's still-open trace wins over the ring:
+    a watchdog firing MID-statement (SIGALRM on the main thread) renders
+    the hung query's live timeline instead of the previous statement's
+    finished one.  "" when nothing matches; never raises (the
+    post-mortem extra must not mask the error line)."""
+    try:
+        tr = active()
+        if tr is not None and conn_id is not None \
+                and tr.conn_id != conn_id:
+            # live trace belongs to ANOTHER session multiplexed on this
+            # thread: the conn filter applies to the live path too
+            tr = None
+        if tr is None:
+            tr = last_trace(conn_id)
+        return render_tree(tr)[:cap] if tr is not None else ""
+    except Exception:  # noqa: BLE001 — diagnostics-only sink
+        return ""
+
+
+def snapshot() -> dict:
+    """The ``/status`` ``device_tracing`` payload."""
+    with _RING_LOCK:
+        return {"ring_traces": len(_RING), "ring_cap": RING_CAP,
+                "max_spans": MAX_SPANS, "outstanding":
+                    STATS["started"] - STATS["finished"], **STATS}
+
+
+def verify_drained() -> dict:
+    """Chaos invariant (mirrors scheduler/compile_service
+    verify_drained): once traffic stops, every begun trace was finished
+    — no trace object left bound/unfinished holding span refs."""
+    with _RING_LOCK:
+        out = {"ok": STATS["started"] == STATS["finished"],
+               "outstanding": STATS["started"] - STATS["finished"],
+               **STATS}
+    return out
+
+
+def reset_for_tests():
+    """Drop the ring/counters and this thread's binding (unit tests)."""
+    _TLS.trace = None
+    _TLS.span = None
+    with _RING_LOCK:
+        _RING.clear()
+        for k in STATS:
+            STATS[k] = 0

@@ -277,8 +277,10 @@ class Backoffer:
         k = KINDS[kind]
         n = self.attempts.get(kind, 0) + 1
         self.attempts[kind] = n
+        cls = ""
         if err is not None:
-            self.errors.append((kind, classify(err), str(err)))
+            cls = classify(err)
+            self.errors.append((kind, cls, str(err)))
         if self._check_killed is not None:
             self._check_killed()
         if k.max_attempts and n >= k.max_attempts:
@@ -293,6 +295,14 @@ class Backoffer:
         if self.slept_ms + sleep_ms > self.budget_ms:
             raise self._exhausted(kind, err, "sleep budget "
                                   f"{self.budget_ms:.0f}ms exhausted")
+        # span tracing (session/tracing.py): each backoff sleep is an
+        # event on the statement's trace with its errno CLASS — "where
+        # did the time go" includes retry waits, not just device work
+        # (lazy import: this module sits under the session package in
+        # the import graph; one branch inside event() when not tracing)
+        from ..session.tracing import event as _trace_event
+        _trace_event("backoff.sleep", kind=kind, cls=cls,
+                     ms=round(sleep_ms, 2), attempt=n)
         if sleep_ms > 0 and self._sleep:
             time.sleep(sleep_ms / 1000.0)
         self.slept_ms += sleep_ms
