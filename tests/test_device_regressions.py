@@ -3,6 +3,8 @@
 Each case was a reproduced divergence (code review round 1): empty global
 aggregate, NULL-vs--1 group key collision, first_row NULL preservation."""
 
+import time
+
 import pytest
 
 from tidb_tpu.testkit import TestKit
@@ -141,3 +143,103 @@ def test_engine_hint_survives_nested_subquery_eval():
     rows, _fts = sess._expr_ctx.eval_built_plan(plan)
     assert rows
     assert sess.stmt_engine_hint == "host"
+
+
+class TestTopkCacheGuard:
+    """Regression (ISSUE 11 guarded-state): _TOPK_CACHE was a bare dict.
+    The fence path (supervisor._reinit_backend) cleared it UNLOCKED
+    while executor threads installed kernels into it, so an install
+    racing the clear could re-publish a kernel pinning the torn-down
+    PJRT client.  Structural access now happens under _PIPE_LOCK."""
+
+    @staticmethod
+    def _topk(device_exec, vals, k=2):
+        import jax.numpy as jnp
+        keys = [jnp.asarray(vals, dtype=jnp.int64)]
+        nulls = [jnp.zeros(len(vals), dtype=bool)]
+        return device_exec._topk_indices(
+            keys, nulls, [], [], len(vals) - 1, len(vals),
+            (("key", 0, False),), k)
+
+    def test_lookup_and_install_hold_pipe_lock(self, monkeypatch):
+        from tidb_tpu.executor import device_exec
+
+        class AssertingDict(dict):
+            def get(self, *a, **k):
+                assert device_exec._PIPE_LOCK.locked()
+                return dict.get(self, *a, **k)
+
+            def setdefault(self, *a, **k):
+                assert device_exec._PIPE_LOCK.locked()
+                return dict.setdefault(self, *a, **k)
+
+        monkeypatch.setattr(device_exec, "_TOPK_CACHE", AssertingDict())
+        # cold install, then a cache hit: both sides locked
+        for _ in range(2):
+            idx = self._topk(device_exec, [3, 1, 2, 0])
+            assert [int(i) for i in idx] == [1, 2]
+        assert len(device_exec._TOPK_CACHE) == 1
+
+    def test_fence_clear_runs_under_pipe_lock(self, monkeypatch):
+        import jax
+        from tidb_tpu.executor import device_exec, supervisor
+
+        cleared = []
+
+        class AssertingDict(dict):
+            def clear(self):
+                cleared.append(device_exec._PIPE_LOCK.locked())
+                return dict.clear(self)
+
+        monkeypatch.setattr(device_exec, "_TOPK_CACHE",
+                            AssertingDict(stale="kernel"))
+        # pretend off-CPU so the fence takes the real clear path, but
+        # neutralize the client teardown (the in-process CPU client must
+        # survive for the rest of the suite)
+        monkeypatch.setattr(jax, "default_backend", lambda: "faketpu")
+        monkeypatch.setattr(jax, "clear_caches", lambda: None)
+        be = getattr(getattr(jax, "extend", None), "backend", None)
+        if be is not None and hasattr(be, "clear_backends"):
+            monkeypatch.setattr(be, "clear_backends", lambda: None)
+        if hasattr(jax, "clear_backends"):
+            monkeypatch.setattr(jax, "clear_backends", lambda: None)
+        supervisor._reinit_backend()
+        assert cleared == [True]
+        assert dict(device_exec._TOPK_CACHE) == {}
+
+    def test_concurrent_install_and_clear_consistent(self):
+        """Threaded chaos assertion: installs racing clears corrupt
+        nothing — every call returns the right indices and the cache
+        ends structurally sound."""
+        import threading
+        from tidb_tpu.executor import device_exec
+
+        errs = []
+
+        def hammer(vals, want):
+            try:
+                for _ in range(12):
+                    idx = self._topk(device_exec, vals)
+                    assert [int(i) for i in idx] == want
+            except Exception as e:  # pragma: no cover - fail loudly
+                errs.append(e)
+
+        def clearer():
+            try:
+                for _ in range(30):
+                    with device_exec._PIPE_LOCK:
+                        device_exec._TOPK_CACHE.clear()
+                    time.sleep(0.002)
+            except Exception as e:  # pragma: no cover - fail loudly
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=([3, 1, 2, 0], [1, 2])),
+            threading.Thread(target=hammer, args=([9, 5, 7, 0], [1, 2])),
+            threading.Thread(target=clearer),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []

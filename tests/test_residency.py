@@ -521,3 +521,65 @@ class TestTenantResidency:
             tk.must_exec("set tidb_device_call_timeout = 0")
         assert residency.snapshot()["by_group"].get("analytics", 0) > 0
         assert residency.verify_ledger()["ok"]
+
+
+class _TrackingLock:
+    """Context-manager proxy over the real ledger lock that counts
+    acquisitions (regression instrumentation)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.entries = 0
+
+    def __enter__(self):
+        self.inner.acquire()
+        self.entries += 1
+        return self
+
+    def __exit__(self, *a):
+        self.inner.release()
+        return False
+
+    def acquire(self, *a, **k):
+        self.entries += 1
+        return self.inner.acquire(*a, **k)
+
+    def release(self):
+        return self.inner.release()
+
+
+class TestBudgetPublishUnderLock:
+    """Regression (ISSUE 11 guarded-state): set_budget / attach wrote
+    _BUDGET[0] with no lock while _enforce_budget_locked read it under
+    _LOCK; the budget publish now happens inside the ledger lock."""
+
+    def test_set_budget_acquires_ledger_lock(self, monkeypatch):
+        tracking = _TrackingLock(residency._LOCK)
+        before = residency._BUDGET[0]
+        monkeypatch.setattr(residency, "_LOCK", tracking)
+        try:
+            residency.set_budget(12345)
+            assert tracking.entries >= 1
+            assert residency._BUDGET[0] == 12345
+            n0 = tracking.entries
+            assert residency.effective_budget() == 12345
+            assert tracking.entries > n0  # reads are locked too
+        finally:
+            residency.set_budget(before)
+
+    def test_attach_publishes_global_budget_under_lock(self, monkeypatch):
+        class Dom:
+            global_vars = {"tidb_device_mem_budget": 777}
+
+        class Ctx:
+            domain = Dom()
+
+        tracking = _TrackingLock(residency._LOCK)
+        before = residency._BUDGET[0]
+        monkeypatch.setattr(residency, "_LOCK", tracking)
+        try:
+            residency.attach(Ctx())
+            assert residency._BUDGET[0] == 777
+            assert tracking.entries >= 1
+        finally:
+            residency.set_budget(before)

@@ -410,6 +410,87 @@ class TestAdmitConcurrency:
 
 # -- gauges across the observability surfaces --------------------------------
 
+class _FakeDom:
+    def __init__(self, **gv):
+        self.global_vars = dict(gv)
+
+
+class _FakeCtx:
+    def __init__(self, **gv):
+        self.domain = _FakeDom(**gv)
+
+
+class TestCfgRefreshAtomicity:
+    """Regression for the ISSUE-11 guarded-state race: _refresh_cfg used
+    to write the raw-weights memo and the parsed weights OUTSIDE _LOCK.
+    Two concurrent refreshes could interleave the `raw != memo` check
+    with the two writes, leaving the memo naming config X while the
+    weights held the parse of config Y — and because the memo matched,
+    the stale weights STUCK until the sysvar changed again."""
+
+    def _restore_raw(self):
+        saved = scheduler._CFG_RAW_WEIGHTS[0]
+
+        def fin():
+            scheduler._CFG_RAW_WEIGHTS[0] = saved
+        return fin
+
+    def test_parse_and_publish_run_under_lock(self, sched_sandbox,
+                                              monkeypatch, request):
+        """The fixed interleaving, proven deterministically: the weight
+        parse and both publishes happen inside one _LOCK hold, so no
+        second refresh can slip between the memo check and the writes."""
+        request.addfinalizer(self._restore_raw())
+        scheduler._CFG_RAW_WEIGHTS[0] = ""
+        held_during_parse = []
+        real = scheduler._parse_weights
+
+        def instrumented(raw):
+            held_during_parse.append(scheduler._LOCK.locked())
+            return real(raw)
+
+        monkeypatch.setattr(scheduler, "_parse_weights", instrumented)
+        depth = scheduler._refresh_cfg(
+            _FakeCtx(tidb_device_wfq_weights="a:2,b:3"))
+        assert depth == 64  # the caller's disabled-check snapshot
+        assert held_during_parse == [True]
+        assert scheduler._CFG["weights"] == {"a": 2.0, "b": 3.0}
+        assert scheduler._CFG_RAW_WEIGHTS[0] == "a:2,b:3"
+
+    def test_memo_never_splits_from_weights_threaded(self, sched_sandbox,
+                                                     request):
+        """Chaos-visible invariant: after any storm of concurrent
+        refreshes against different weight configs, the published
+        weights are exactly the parse of the published memo."""
+        request.addfinalizer(self._restore_raw())
+        scheduler._CFG_RAW_WEIGHTS[0] = ""
+        ctxs = [_FakeCtx(tidb_device_wfq_weights=w)
+                for w in ("a:2,b:1", "a:1,b:4", "c:9")]
+        stop = threading.Event()
+        errs = []
+
+        def worker(i):
+            k = 0
+            try:
+                while not stop.is_set():
+                    scheduler._refresh_cfg(ctxs[(i + k) % len(ctxs)])
+                    k += 1
+            except Exception as e:  # pragma: no cover - fail loudly
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert scheduler._parse_weights(scheduler._CFG_RAW_WEIGHTS[0]) \
+            == scheduler._CFG["weights"]
+
+
 class TestSchedulerObservability:
     def test_explain_analyze_and_observe_and_http(self, tk):
         with failpoint.enabled("device-admission", "admission-queue-full"):

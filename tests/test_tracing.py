@@ -543,3 +543,46 @@ class TestDrain:
             assert tracing.active() is None
         finally:
             s2.close()
+
+
+class TestToDictSnapshot:
+    """Regression (ISSUE 11 guarded-state): Trace.to_dict read spans /
+    dropped bare while supervisor workers appended — it now takes one
+    locked snapshot, so a mid-flight render (the bench watchdog path) is
+    internally consistent."""
+
+    def test_render_while_spans_append(self):
+        tr = tracing.Trace("hammer", origin="trace_stmt")
+        stop = threading.Event()
+        errs = []
+
+        def appender():
+            try:
+                while not stop.is_set():
+                    sp = tr._start_span("s", 0, {})
+                    if sp is not None:
+                        tr._end_span(sp)
+            except Exception as e:  # pragma: no cover - fail loudly
+                errs.append(e)
+
+        threads = [threading.Thread(target=appender) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(60):
+                d = tr.to_dict()
+                # snapshot consistency: the reported span count is the
+                # rendered snapshot's, never a later value
+                assert d["spans"] <= tracing.MAX_SPANS
+                assert d["dropped"] >= 0
+                tracing.render_tree(tr)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert errs == []
+        # _finish directly: finish() would append to the process ring
+        # and skew the drain invariant other tests assert on
+        tr._finish(True)
+        done = tr.to_dict()
+        assert done["spans"] == len(tr.spans)

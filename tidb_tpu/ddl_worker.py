@@ -260,7 +260,7 @@ class DDLWorker:
             return True  # finished (or cancelled) already
         t = m.get_table(job.schema_id, job.table_id)
         if t is None:
-            self._cancel_locked(m, job, "table dropped during DDL")
+            self._cancel_job(m, job, "table dropped during DDL")
             txn.commit()
             self.domain.reload_schema()
             return True
@@ -293,7 +293,7 @@ class DDLWorker:
             # unexpected state (e.g. a racing CREATE INDEX already drove an
             # index of this name to PUBLIC): the job MUST leave the queue,
             # or run_pending would peek it forever
-            self._cancel_locked(
+            self._cancel_job(
                 m, job, f"Duplicate key name '{name}'")
             txn.commit()
             self.domain.reload_schema()
@@ -322,7 +322,7 @@ class DDLWorker:
             return True
         t = m.get_table(job.schema_id, job.table_id)
         if t is None:
-            self._cancel_locked(m, job, "table dropped during DDL")
+            self._cancel_job(m, job, "table dropped during DDL")
             txn.commit()
             self.domain.reload_schema()
             return True
@@ -381,7 +381,7 @@ class DDLWorker:
             return True
         t = m.get_table(job.schema_id, job.table_id)
         if t is None:
-            self._cancel_locked(m, job, "table dropped during DDL")
+            self._cancel_job(m, job, "table dropped during DDL")
             txn.commit()
             self.domain.reload_schema()
             return True
@@ -422,8 +422,8 @@ class DDLWorker:
                 self._fire("public", job)
                 return True
             # PUBLIC already (e.g. raced duplicate): leave the queue
-            self._cancel_locked(m, job,
-                                f"Duplicate column name '{name}'")
+            self._cancel_job(m, job,
+                             f"Duplicate column name '{name}'")
             txn.commit()
             self.domain.reload_schema()
             return True
@@ -584,7 +584,12 @@ class DDLWorker:
         self.domain.reload_schema()
         self._fire("rollback_done", job)
 
-    def _cancel_locked(self, m: Meta, job: Job, err: str):
+    def _cancel_job(self, m: Meta, job: Job, err: str):
+        """Cancel under the caller's open meta TXN (which the caller
+        commits).  Formerly `_cancel_locked` — renamed because the
+        `_locked` suffix is reserved for "caller holds the threading
+        guard" (lint: locked-suffix-contract); the exclusivity here is
+        txn ownership, not a mutex."""
         job.state = JobState.CANCELLED
         job.error = err
         m.finish_job(job)

@@ -208,15 +208,21 @@ def _refresh_cfg(ctx):
         src = lambda name, d: ctx.get_sysvar(name)  # noqa: E731
     if src is None:
         return
+    # resolve outside _LOCK (sysvar reads do arbitrary session work),
+    # publish under it (the pool size and deadline are read under _LOCK
+    # by _ensure_workers and the worker loop)
+    vals = {}
     try:
-        _CFG["workers"] = max(int(src("tidb_compile_workers", 2)), 1)
+        vals["workers"] = max(int(src("tidb_compile_workers", 2)), 1)
     except Exception:
         pass
     try:
-        _CFG["timeout_s"] = max(float(src("tidb_compile_timeout", 0.0)),
+        vals["timeout_s"] = max(float(src("tidb_compile_timeout", 0.0)),
                                 0.0)
     except Exception:
         pass
+    with _LOCK:
+        _CFG.update(vals)
 
 
 def _async_on(ctx) -> bool:
@@ -610,7 +616,8 @@ def _obtain_impl(key, build, dict_refs, ctx, args, spec, shape, sig,
             f"device compile failed ({cls}): {e}")
         err.__cause__ = e
         br.record_failure(err, session=sid, group=group)
-        _LAST_ERROR[0] = f"{cls}: {e}"
+        with _LOCK:
+            _LAST_ERROR[0] = f"{cls}: {e}"
         if _tsp is not None:
             _tsp.tags["mode"] = "sync_failed"
         tracing.event("host_degraded", reason="compile_" + cls,
@@ -715,7 +722,8 @@ def _run_job_traced(job: "_Job"):
     fn = None
     while True:
         try:
-            deadline = _CFG["timeout_s"]
+            with _LOCK:
+                deadline = _CFG["timeout_s"]
             fn = supervisor.call_supervised(
                 _do_compile, (job,), deadline_s=deadline, ctx=None,
                 shape="compile", label=f"bg compile ({job.shape})")
@@ -729,7 +737,8 @@ def _run_job_traced(job: "_Job"):
             return
         except Exception as e:  # noqa: BLE001 — classified below
             cls = classify(e)
-            _LAST_ERROR[0] = f"{cls}: {e}"
+            with _LOCK:
+                _LAST_ERROR[0] = f"{cls}: {e}"
             if cls not in (CLASS_COMPILE, CLASS_TRANSPORT, CLASS_DEVICE,
                            CLASS_EXCHANGE, CLASS_HANG):
                 log.warning("background compile failed unclassified: %s",

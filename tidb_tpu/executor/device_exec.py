@@ -655,6 +655,11 @@ class AggFetch:
         return self._body
 
 
+#: jitted top-k kernels by (cap, k, spec, dtype) signature.  Structural
+#: access happens under _PIPE_LOCK, same as _PIPE_CACHE: the fence path
+#: (supervisor._reinit_backend) clears this cache while executor threads
+#: install into it, and an install racing the clear unlocked would
+#: re-publish an executable pinning the torn-down PJRT client
 _TOPK_CACHE: dict = {}
 
 
@@ -672,7 +677,8 @@ def _topk_indices(keys, key_nulls, results, result_nulls, ng, cap, specs,
         by.append((d, nl))
     sig = (cap, k, tuple((s[0], s[2]) for s in specs),
            tuple(d.dtype.str for d, _ in by))
-    fn = _TOPK_CACHE.get(sig)
+    with _PIPE_LOCK:
+        fn = _TOPK_CACHE.get(sig)
     if fn is None:
         descs = [s[2] for s in specs]
 
@@ -692,7 +698,10 @@ def _topk_indices(keys, key_nulls, results, result_nulls, ng, cap, specs,
             lex.append(jnp.arange(cap) >= ng_)  # live rows first
             return jnp.lexsort(lex)[:k]
 
-        fn = _TOPK_CACHE[sig] = _timed_jit(run)
+        with _PIPE_LOCK:
+            # setdefault: a racing builder's kernel wins once installed
+            # (both are valid; one object keeps jit's internal cache hot)
+            fn = _TOPK_CACHE.setdefault(sig, _timed_jit(run))
     return fn(by, ng)
 
 

@@ -205,11 +205,22 @@ def _parse_weights(raw: str) -> dict:
     return out
 
 
-def _refresh_cfg(ctx):
+def _refresh_cfg(ctx) -> int:
     """Resolve the scheduling knobs from the Domain's GLOBAL variables
     (shared resource: session SETs must not reconfigure the queue other
     sessions are waiting in).  Bare contexts fall back to their own
-    view; no context keeps the current config."""
+    view; no context keeps the current config.  Returns the resolved
+    queue depth so the caller's disabled-check reads a value consistent
+    with what was just published.
+
+    The sysvar reads happen OUTSIDE _LOCK (get_sysvar may do arbitrary
+    session work); the publish happens UNDER it.  The raw-weights memo
+    and the parsed weights in particular must move together: two
+    concurrent refreshes interleaving the `raw != memo` check with the
+    two writes could otherwise leave the memo naming config X while the
+    parsed weights are config Y — and because the memo matches, the
+    stale weights would STICK until the sysvar changed again
+    (regression-tested in tests/test_scheduler.py)."""
     src = None
     dom = getattr(ctx, "domain", None)
     if dom is not None:
@@ -218,27 +229,32 @@ def _refresh_cfg(ctx):
     elif ctx is not None:
         src = lambda name, d: ctx.get_sysvar(name)  # noqa: E731
     if src is None:
-        return
+        with _LOCK:
+            return _CFG["depth"]
+    vals = {}
     try:
-        _CFG["depth"] = max(int(src("tidb_device_sched_queue_depth", 64)), 0)
+        vals["depth"] = max(int(src("tidb_device_sched_queue_depth", 64)), 0)
     except Exception:
         pass
     try:
-        _CFG["timeout_s"] = max(
+        vals["timeout_s"] = max(
             float(src("tidb_device_admission_timeout", 5.0)), 0.0)
     except Exception:
         pass
     try:
-        _CFG["cap"] = max(int(src("tidb_device_tenant_running_cap", 4)), 0)
+        vals["cap"] = max(int(src("tidb_device_tenant_running_cap", 4)), 0)
     except Exception:
         pass
     try:
         raw = str(src("tidb_device_wfq_weights", ""))
-        if raw != _CFG_RAW_WEIGHTS[0]:
+    except Exception:
+        raw = None
+    with _LOCK:
+        _CFG.update(vals)
+        if raw is not None and raw != _CFG_RAW_WEIGHTS[0]:
             _CFG_RAW_WEIGHTS[0] = raw
             _CFG["weights"] = _parse_weights(raw)
-    except Exception:
-        pass
+        return _CFG["depth"]
 
 
 def _weight(group: str) -> float:
@@ -272,8 +288,7 @@ def admit(ctx, shape: str = "agg", batch_key=None) -> "Ticket | None":
 def _admit_impl(ctx, shape, batch_key, _tsp):
     from ..utils import failpoint
     from ..utils.failpoint import InjectedAdmissionError
-    _refresh_cfg(ctx)
-    if _CFG["depth"] <= 0:
+    if _refresh_cfg(ctx) <= 0:
         return None
     group = resource_group(ctx)
     t_fp0 = time.monotonic()

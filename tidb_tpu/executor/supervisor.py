@@ -347,10 +347,12 @@ def _reinit_backend():
     try:
         from . import device_exec
         # under the pipe-stats lock: _pipe_cache_get's locked
-        # get/move_to_end pair must never interleave with this clear
+        # get/move_to_end pair must never interleave with this clear,
+        # and a _topk_indices install racing it unlocked could
+        # re-publish a kernel pinning the dead client
         with device_exec._PIPE_LOCK:
             device_exec._PIPE_CACHE.clear()
-        device_exec._TOPK_CACHE.clear()
+            device_exec._TOPK_CACHE.clear()
     except Exception as e:
         # best-effort: the fence proceeds, but a cache that would not
         # clear may still pin dead-client executables — log it

@@ -189,6 +189,12 @@ class Rule:
     title = ""
     allowlistable = True
 
+    def prepare(self, ctx: "Context") -> None:
+        """Build (and memoize on ctx) any shared analysis model this
+        rule needs.  Timed separately by run_rules so --stats charges
+        the model fixpoints to a dedicated ``shared-models`` row instead
+        of whichever model-using rule happens to run first."""
+
     def run(self, ctx: "Context") -> list:  # pragma: no cover - interface
         raise NotImplementedError
 
@@ -265,11 +271,13 @@ def collect(repo_root: str | None = None, package: str = "tidb_tpu",
 
 
 class Report:
-    def __init__(self, findings, allowlisted, stale, rules_run):
+    def __init__(self, findings, allowlisted, stale, rules_run,
+                 timings=None):
         self.findings = findings          # list[Finding] (unallowlisted)
         self.allowlisted = allowlisted    # list[(Finding, AllowEntry)]
         self.stale = stale                # list[AllowEntry]
         self.rules_run = rules_run        # list[str]
+        self.timings = timings or {}      # rule -> seconds
 
     @property
     def ok(self) -> bool:
@@ -279,6 +287,8 @@ class Report:
         return {
             "ok": self.ok,
             "rules": self.rules_run,
+            "timings_s": {k: round(v, 4)
+                          for k, v in sorted(self.timings.items())},
             "findings": [f.to_json() for f in self.findings],
             "allowlisted": [
                 {**f.to_json(), "reason": e.reason}
@@ -314,22 +324,43 @@ def default_allowlist_path() -> str:
 
 
 def run_rules(ctx: Context, allowlist: Allowlist,
-              rules: list | None = None) -> Report:
+              rules: list | None = None,
+              paths: list | None = None) -> Report:
+    """Run `rules` (default: all) over `ctx`.  `paths` is an optional
+    list of globs over the finding's package-relative file: findings
+    outside it are dropped BEFORE allowlist matching, and the stale-
+    entry check is skipped (a filtered run cannot tell a stale entry
+    from one whose findings were filtered out)."""
+    import time as _time
     names = sorted(RULES) if rules is None else list(rules)
     findings, allowlisted = [], []
+    timings = {}
     for name in names:
         rule = RULES[name]
-        for f in rule.run(ctx):
+        t0 = _time.perf_counter()
+        rule.prepare(ctx)
+        t1 = _time.perf_counter()
+        if t1 - t0 >= 0.0005:  # model actually built (not a cache hit)
+            timings["shared-models"] = timings.get(
+                "shared-models", 0.0) + (t1 - t0)
+        found = rule.run(ctx)
+        timings[name] = _time.perf_counter() - t1
+        for f in found:
             assert f.rule == name, (f.rule, name)
+            if paths and not any(fnmatch.fnmatchcase(f.rel, p)
+                                 for p in paths):
+                continue
             e = allowlist.match(f) if rule.allowlistable else None
             if e is None:
                 findings.append(f)
             else:
                 allowlisted.append((f, e))
-    # stale entries only meaningful for rules that actually ran
+    # stale entries only meaningful for rules that actually ran, and
+    # only when no path filter hid their findings
     ran = set(names)
-    stale = [e for e in allowlist.stale() if e.rule in ran]
-    return Report(findings, allowlisted, stale, names)
+    stale = ([] if paths
+             else [e for e in allowlist.stale() if e.rule in ran])
+    return Report(findings, allowlisted, stale, names, timings)
 
 
 #: collected Contexts memoized per repo root — the migrated test-file

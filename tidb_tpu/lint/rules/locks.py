@@ -83,6 +83,10 @@ class _Model:
         self.direct: dict[str, set] = {}
         self.calls_all: dict[str, set] = {}
         self.calls_under: dict[str, list] = {}  # (held, callee, line, name)
+        # EVERY resolved call with the locks held at the site (held may be
+        # empty) — the guard-inference layer (rules/guards.py) derives
+        # entry-held lock sets and the *_locked call contract from this
+        self.call_records: dict[str, list] = {}  # (held, callee, line)
         self.blocking: list = []  # findings raw (rel, line, qn, call, lock)
         self.nest_edges: list = []  # (a, b, rel, line, note)
         # name -> [fn keys] for unique-method resolution
@@ -117,10 +121,17 @@ class _Model:
                             locals_[tgt.id] = ident
                 if isinstance(node, ast.ClassDef):
                     classes.add(node.name)
-            # instance locks + nested classes, full walk
+            # ONE full walk: instance locks, nested classes, and the
+            # function index for unique-leaf call resolution
             for node in ast.walk(sf.tree):
                 if isinstance(node, ast.ClassDef):
                     classes.add(node.name)
+                    continue
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    key = f"{sf.rel}::{self._defqual(sf, node)}"
+                    self.fn_by_leaf.setdefault(node.name, []).append(key)
+                    continue
                 if not isinstance(node, ast.Assign):
                     continue
                 ctor = _lock_ctor(node.value)
@@ -141,12 +152,6 @@ class _Model:
                                 node.lineno)
                             self.attr_locks.setdefault(
                                 tgt.attr, []).append(ident)
-            # function index for unique-leaf call resolution
-            for node in ast.walk(sf.tree):
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    key = f"{sf.rel}::{self._defqual(sf, node)}"
-                    self.fn_by_leaf.setdefault(node.name, []).append(key)
 
     def _defqual(self, sf, node):
         # a def node's engine qualname already includes its own name
@@ -172,6 +177,14 @@ class _Model:
         if "." in name:
             head, attr = name.split(".", 1)
             if "." in attr:
+                # deep chain (self.domain.table_locks_mu): the receiver is
+                # some OTHER object, so the class-local rules below do not
+                # apply — a unique package-wide attr match is the only
+                # safe resolution (ambiguity stays unresolved, never
+                # guessed)
+                cands = self.attr_locks.get(name.rsplit(".", 1)[-1], [])
+                if len(cands) == 1:
+                    return cands[0]
                 return None
             if head == "self":
                 cls = self._enclosing_class(sf, expr)
@@ -252,6 +265,7 @@ class _Model:
                     self.direct.setdefault(key, set())
                     self.calls_all.setdefault(key, set())
                     self.calls_under.setdefault(key, [])
+                    self.call_records.setdefault(key, [])
         for sf in self.ctx.package_files:
             for node in ast.walk(sf.tree):
                 if isinstance(node, (ast.FunctionDef,
@@ -285,6 +299,8 @@ class _Model:
                 callee = self.resolve_call(sf, node)
                 if callee is not None:
                     self.calls_all[key].add(callee)
+                    self.call_records[key].append(
+                        (tuple(held), callee, node.lineno))
                     if held:
                         self.calls_under[key].append(
                             (tuple(held), callee, node.lineno,
@@ -307,6 +323,49 @@ class _Model:
             visit(stmt, [])
 
     # -- phase 3: closure + edges -----------------------------------------
+
+    def entry_held(self) -> dict:
+        """Lock set statically held at ENTRY of every function: the meet
+        (intersection) over each resolved call site of (locks held at the
+        site ∪ the caller's own entry set), iterated to a fixpoint — the
+        call-propagation that makes a ``*_locked`` helper's body count as
+        guarded when every caller takes the lock first.  A function with
+        no resolved call sites (an entry point, a thread target, anything
+        reached only through unresolvable indirection) gets the empty
+        set: this analysis under-approximates, it must never guess."""
+        # call sites grouped per callee
+        sites: dict[str, list] = {}
+        for caller, recs in self.call_records.items():
+            for held, callee, _line in recs:
+                if callee in self.direct:
+                    sites.setdefault(callee, []).append((caller, held))
+        # no resolved callers = entry point: nothing held.  Called
+        # functions start at TOP (None — "every lock", the identity of
+        # the meet) and shrink monotonically; a distinct sentinel, not
+        # frozenset(all locks), so a function legitimately entered with
+        # every lock of a small module held is never mistaken for TOP.
+        entry: dict = {k: (None if k in sites else frozenset())
+                       for k in self.direct}
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for callee, recs in sites.items():
+                acc = None
+                for caller, held in recs:
+                    ce = entry.get(caller, frozenset())
+                    if ce is None:
+                        continue  # TOP caller: identity for the meet
+                    eff = ce | frozenset(held)
+                    acc = eff if acc is None else (acc & eff)
+                if acc is not None and acc != entry[callee]:
+                    entry[callee] = acc
+                    changed = True
+        # anything still TOP is reachable only from a closed call cycle
+        # with no outside entry — assume nothing held
+        return {k: (frozenset() if v is None else v)
+                for k, v in entry.items()}
 
     def effective(self) -> dict:
         eff = {k: set(v) for k, v in self.direct.items()}
@@ -402,6 +461,9 @@ class LockOrder(Rule):
     name = "lock-order"
     title = "no cycles in the static lock-acquisition graph"
 
+    def prepare(self, ctx):
+        _model_for(ctx)
+
     def run(self, ctx):
         model = _model_for(ctx)
         edges = model.edges()
@@ -451,6 +513,9 @@ class LockOrder(Rule):
 class BlockingWhileLocked(Rule):
     name = "blocking-while-locked"
     title = "no blocking ops while holding a module-level lock"
+
+    def prepare(self, ctx):
+        _model_for(ctx)
 
     def run(self, ctx):
         model = _model_for(ctx)

@@ -216,11 +216,13 @@ def attach(ctx):
     dom = getattr(ctx, "domain", None)
     try:
         if dom is not None:
-            _BUDGET[0] = max(
+            budget = max(
                 int(dom.global_vars.get("tidb_device_mem_budget", 0)), 0)
         else:
-            _BUDGET[0] = max(
+            budget = max(
                 int(ctx.get_sysvar("tidb_device_mem_budget")), 0)
+        with _LOCK:
+            _BUDGET[0] = budget
     except Exception:
         pass
     obs = getattr(dom, "observe", None)
@@ -248,29 +250,44 @@ def current_group() -> str:
 
 def set_budget(n: int):
     """Set the budget in bytes directly (tests / embedders); 0 = auto."""
-    _BUDGET[0] = max(int(n), 0)
+    with _LOCK:
+        _BUDGET[0] = max(int(n), 0)
 
 
 def _auto_budget() -> int:
     """jax-reported device memory limit, or 0 (unlimited) when the
     backend is the in-process CPU client (host RAM is governed by the
-    MemTracker quota tree, not this manager) or unreported."""
-    if _AUTO_BUDGET[0] is None:
+    MemTracker quota tree, not this manager) or unreported.
+
+    Same discipline as every config refresh: the memo check and the
+    publish happen under _LOCK, the device probe runs OUTSIDE it (a
+    one-time PJRT memory_stats call must not serialize every concurrent
+    lookup/evict behind it; a racing double-probe is idempotent and the
+    first publish wins).  A caller already holding the reentrant ledger
+    lock — _enforce_budget_locked's first-ever budget resolution —
+    still probes under its own hold, once."""
+    with _LOCK:
+        if _AUTO_BUDGET[0] is not None:
+            return _AUTO_BUDGET[0]
+    budget = 0
+    try:
+        import jax
+        if jax.default_backend() != "cpu":
+            stats = jax.devices()[0].memory_stats() or {}
+            budget = int(stats.get("bytes_limit", 0))
+    except Exception:
         budget = 0
-        try:
-            import jax
-            if jax.default_backend() != "cpu":
-                stats = jax.devices()[0].memory_stats() or {}
-                budget = int(stats.get("bytes_limit", 0))
-        except Exception:
-            budget = 0
-        _AUTO_BUDGET[0] = budget
-    return _AUTO_BUDGET[0]
+    with _LOCK:
+        if _AUTO_BUDGET[0] is None:
+            _AUTO_BUDGET[0] = budget
+        return _AUTO_BUDGET[0]
 
 
 def effective_budget() -> int:
     """Resolved budget in bytes (0 = unlimited)."""
-    return _BUDGET[0] if _BUDGET[0] > 0 else _auto_budget()
+    with _LOCK:
+        override = _BUDGET[0]
+    return override if override > 0 else _auto_budget()
 
 
 # -- the cache protocol (ops/device.to_device_col) ---------------------------

@@ -174,23 +174,50 @@ class Trace:
 
     # -- read-back (finished traces; mid-flight reads tolerate None durs) ----
 
-    def children_of(self) -> dict:
-        out: dict[int, list] = {}
+    class _SpanSnap:
+        """Immutable copy of one span for render-time reads: a LIVE
+        trace (the bench watchdog renders mid-statement) may still be
+        appending spans/events — and _end_span may be inserting an
+        error tag — from supervisor workers while a renderer iterates,
+        so every renderer works from copies taken under the lock."""
+
+        __slots__ = ("sid", "parent_sid", "name", "t0", "dur_s", "tags",
+                     "events")
+
+        def __init__(self, sp):
+            self.sid = sp.sid
+            self.parent_sid = sp.parent_sid
+            self.name = sp.name
+            self.t0 = sp.t0
+            self.dur_s = sp.dur_s
+            self.tags = dict(sp.tags)
+            self.events = list(sp.events)
+
+    def _snapshot(self):
+        """(span copies, kids-by-parent, root, dropped, dur_s) under one
+        lock hold — the single source every renderer works from.  The
+        root is always spans[0]: __init__ creates it before the trace is
+        shared."""
         with self._lock:
-            spans = list(self.spans)
+            spans = [Trace._SpanSnap(sp) for sp in self.spans]
+            dropped, dur_s = self.dropped, self.dur_s
+        kids: dict[int, list] = {}
         for sp in spans:
-            out.setdefault(sp.parent_sid, []).append(sp)
-        return out
+            kids.setdefault(sp.parent_sid, []).append(sp)
+        return spans, kids, spans[0], dropped, dur_s
+
+    def children_of(self) -> dict:
+        return self._snapshot()[1]
 
     def to_dict(self) -> dict:
-        kids = self.children_of()
+        spans, kids, root, dropped, dur_s = self._snapshot()
 
         def node(sp):
             d = {"name": sp.name, "start_s": round(sp.t0, 6),
                  "duration_s": (round(sp.dur_s, 6)
                                 if sp.dur_s is not None else None)}
             if sp.tags:
-                d["tags"] = dict(sp.tags)
+                d["tags"] = sp.tags
             if sp.events:
                 d["events"] = [
                     {"at_s": round(t, 6), "name": n, **({"tags": tg}
@@ -204,10 +231,10 @@ class Trace:
         return {"trace_id": self.trace_id, "parent_id": self.parent_id,
                 "origin": self.origin, "conn_id": self.conn_id,
                 "started_at": self.started_at,
-                "duration_s": (round(self.dur_s, 6)
-                               if self.dur_s is not None else None),
-                "succ": self.succ, "spans": len(self.spans),
-                "dropped": self.dropped, "root": node(self.root)}
+                "duration_s": (round(dur_s, 6)
+                               if dur_s is not None else None),
+                "succ": self.succ, "spans": len(spans),
+                "dropped": dropped, "root": node(root)}
 
 
 # -- the hot-path API ---------------------------------------------------------
@@ -375,8 +402,10 @@ def _fmt_s(s) -> str:
 def tree_rows(tr: Trace) -> list:
     """Depth-first (operation, startTS, duration) rows — the TRACE
     FORMAT='row' resultset shape (reference: executor/trace.go).  Events
-    render as zero-duration rows prefixed ``@``."""
-    kids = tr.children_of()
+    render as zero-duration rows prefixed ``@``.  Works entirely on the
+    locked span snapshot: the watchdog renders LIVE traces whose spans
+    and tags are still being written from worker threads."""
+    _spans, kids, root, _dropped, _dur = tr._snapshot()
     rows = []
 
     def walk(sp, depth):
@@ -393,7 +422,7 @@ def tree_rows(tr: Trace) -> list:
                          if tg else "")
                 rows.append((f"{pad}  @{n}{tag_s}", _fmt_s(t), "-"))
 
-    walk(tr.root, 0)
+    walk(root, 0)
     return rows
 
 
