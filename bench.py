@@ -837,6 +837,22 @@ def main():
     n = gen_all(tk, sf)
 
     meta = {"platform": platform, "fallback": fallback, "sf": sf}
+    # --mem-budget=BYTES (or BENCH_MEM_BUDGET): the memory-constrained
+    # mode — cap tidb_device_mem_budget so oversized build sides go
+    # through the hybrid hash join (radix spill + host/device
+    # co-processing) instead of degrading the whole fragment to host;
+    # per-query lines then carry the hj_* gauges
+    mem_budget = 0
+    for a in sys.argv[1:]:
+        if a.startswith("--mem-budget="):
+            mem_budget = int(float(a.split("=", 1)[1]))
+    env_budget = os.environ.get("BENCH_MEM_BUDGET", "").strip()
+    if env_budget:  # an exported-but-empty var must not discard the flag
+        mem_budget = int(float(env_budget))
+    if mem_budget > 0:
+        tk.must_exec(f"set global tidb_device_mem_budget = {mem_budget}")
+        meta["mem_budget"] = mem_budget
+        _stage(f"memory-constrained mode: device budget {mem_budget} B")
     qbudget = int(os.environ.get("BENCH_QUERY_TIMEOUT_S", "900"))
     failures = _bench_loop(tk, qnames, sf, n, meta, query_budget_s=qbudget)
 
@@ -901,6 +917,8 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
                 raise RuntimeError(
                     f"injected backend failure for {qname} "
                     "(BENCH_FAIL_QUERY)")
+            from tidb_tpu.executor import hybrid_join as _hj0
+            hj_runs0 = _hj0.STATS["hj_runs"]
             wm0 = _WARM_LOCK_MISSES[0]
             t_start = time.monotonic()
             for attempt in (1, 2):
@@ -977,6 +995,13 @@ def _bench_loop(tk, qnames, sf, n, meta, query_budget_s=0) -> int:
             # — a bench line that paid an exchange recompile says so
             from tidb_tpu.executor import mpp_exec as _mpp
             compile_info.update(_mpp.report_gauges())
+            # hybrid hash join gauges (executor/hybrid_join.py): fanout /
+            # spilled partitions / spill bytes / co-processed host rows —
+            # only when THIS query's runs took the hybrid path (another
+            # query's split on this line would misattribute the spill)
+            from tidb_tpu.executor import hybrid_join as _hj
+            if _hj.STATS["hj_runs"] > hj_runs0:
+                compile_info.update(_hj.report_gauges())
             if _WARM_LOCK_MISSES[0] > wm0:
                 # a timed run raced the keep-warm dispatch: the numbers
                 # are contended — mark them so history comparisons skip

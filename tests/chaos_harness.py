@@ -110,6 +110,11 @@ READ_FAULTS = {
     # compile job may leak (compile_service.verify_drained below)
     "device-compile": ["compile-fail", "1*compile-fail", "2*compile-fail",
                        "1*compile-slow(0.02)"],
+    # hybrid-join spill writes (storage/paged.SpillSet via
+    # executor/hybrid_join.py): an injected spill failure mid-join must
+    # degrade the fragment classified with NO spilled pages left on disk
+    # (spill_outstanding drained below) and no ledger drift
+    "device-join-spill": ["spill-fail", "1*spill-fail"],
     "mpp-exchange-send": ["1*panic", "2*panic", "panic"],
     "mpp-exchange-recv": ["1*panic", "panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -277,6 +282,13 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         tdrained = tracing.verify_drained()
         assert tdrained["ok"], (
             f"seed {seed}: LEAKED TRACES: {tdrained}")
+
+        # -- hybrid-join spill pages drained: an injected spill failure
+        #    (or any abort mid-probe) must delete every partition page
+        from tidb_tpu.storage.paged import spill_outstanding
+        sp = spill_outstanding()
+        assert sp["open_sets"] == 0, (
+            f"seed {seed}: LEAKED SPILL PAGES: {sp}")
     finally:
         failpoint.disable_all()
     return stats
@@ -304,6 +316,9 @@ THREADED_FAULTS = {
     # (compile_service.verify_drained asserted after the joins)
     "device-compile": ["compile-fail", "1*compile-fail",
                        "1*compile-slow(0.02)"],
+    # spill-write failures interleaving with the rest: the hybrid join
+    # aborts classified and drains its pages (spill_outstanding below)
+    "device-join-spill": ["spill-fail", "1*spill-fail"],
     "mpp-exchange-send": ["1*panic", "panic"],
     "mpp-exchange-recv": ["1*panic"],
     "coordinator-tso-skew": ["return(262144)"],
@@ -467,6 +482,13 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     assert led["ok"], (
         f"seed {seed}: HBM LEDGER DRIFT after threaded OOM chaos: {led}")
     stats["oom_recoveries"] = residency.snapshot()["hbm_oom_recoveries"]
+
+    # hybrid-join spill pages drained under concurrency: every worker's
+    # spill set (incl. aborted ones) must be closed by schedule end
+    from tidb_tpu.storage.paged import spill_outstanding
+    sp = spill_outstanding()
+    assert sp["open_sets"] == 0, (
+        f"seed {seed}: LEAKED SPILL PAGES after threaded chaos: {sp}")
 
     # admission queue drained: no ticket left queued or running once the
     # worker threads have joined — every admit() was paired with a

@@ -258,10 +258,12 @@ def _leaf_key_cols(side, keys):
                 or not np.issubdtype(c.data.dtype, np.integer)):
             return None
         from ..storage.paged import is_paged
-        if is_paged(c) and side.chunk.num_rows * 16 > _DIM_RESIDENT_BUDGET:
+        if (is_paged(c)
+                and side.chunk.num_rows * 16 > _dim_resident_budget()):
             # indexing (argsort + order arrays) a fact-sized memmap would
             # materialize it into RAM at PLAN time — a paged fact is only
-            # ever the streamed probe, never a build index
+            # ever the streamed probe, never a build index (an oversized
+            # build instead goes through the hybrid partitioned path)
             return None
         cols.append(c)
     return cols
@@ -323,7 +325,7 @@ def _plan_strategy(jn):
     return None
 
 
-def _reorder_fact_first(leaves, joins):
+def _reorder_fact_first(leaves, joins, assume_unique=frozenset()):
     """Rebuild the fragment's inner-join tree as a FACT-FIRST left-deep
     chain of unique-build gather joins. The device cost model inverts the
     host planner's greedy smallest-intermediate order (optimizer.py
@@ -335,11 +337,20 @@ def _reorder_fact_first(leaves, joins):
     equi-joins reorder freely, so this is pure engine-side physical
     planning.
 
+    assume_unique: leaf ids whose whole-table index must NOT be built at
+    plan time (it would exceed the residency budget — exactly the hybrid
+    hash join's partitioned build, executor/hybrid_join.py).  Such a leaf
+    joins the chain with a DEFERRED strategy ``("uniq", "right", None)``
+    on bare-integer-column keys; the hybrid path builds per-partition
+    indexes at execution and verifies uniqueness there.  A deferred node
+    must never reach the resident/paged dispatch paths — device_join_agg
+    raises if the hybrid attempt falls through.
+
     Returns (root, new_joins) with strategies assigned, or None when the
     chain can't be built expansion-free (multi-leaf key exprs, a
     disconnected graph, or a non-unique build somewhere) — the caller
     keeps the planner's tree and per-join strategy planning."""
-    if len(joins) < 2:
+    if len(joins) < 2 and not assume_unique:
         return None
     from ..sqltypes import FieldType, TYPE_LONGLONG
     by_id = {leaf.leaf_id: leaf for leaf in leaves}
@@ -394,6 +405,23 @@ def _reorder_fact_first(leaves, joins):
         best = None
         for lid, kps in cands.items():
             leaf = by_id[lid]
+            if lid in assume_unique:
+                # deferred partition-indexed build: accept on bare int
+                # leaf columns without materializing the whole index
+                local = [_shift_expr(lx, -leaf.offset)
+                         for _p, _s, lx in kps]
+                if any(not isinstance(e, ExprColumn)
+                       or not 0 <= e.idx < leaf.ncols
+                       or leaf.chunk.columns[e.idx].is_object()
+                       or not np.issubdtype(
+                           leaf.chunk.columns[e.idx].data.dtype,
+                           np.integer)
+                       for e in local):
+                    continue
+                key = (leaf.chunk.num_rows, lid)
+                if best is None or key < best[0]:
+                    best = (key, lid, kps, None)
+                continue
             # the index builder addresses the leaf's LOCAL schema; the
             # chain's key exprs are global — rebase before the lookup
             idx = _leaf_index(leaf, [_shift_expr(lx, -leaf.offset)
@@ -583,7 +611,7 @@ def _pack_probe(kds, knulls, pvalid, packs):
 
 def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
                      capacity, key_pack, agg_meta, compact_cap=None,
-                     raw_tail=False):
+                     raw_tail=False, strategies=None):
     """Build the jitted end-to-end program. caps: per-join static
     capacities aligned with `joins`. Returns jitted fn(env, jidx, n_lives)
     where env is {global_col: (data, nulls)} and jidx is a per-join tuple
@@ -613,6 +641,13 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
     part XLA is good at — stays fused in the program."""
     for jn, cap in zip(joins, caps):
         jn.cap = cap
+    if strategies is None:
+        # snapshot NOW: the traced body must never read the mutable
+        # .strategy slot at dispatch/trace time — a deferred background
+        # build (compile service) can trace long after the originating
+        # execution restored or replaced it (the hybrid join swaps a
+        # partition-shaped stub in and out around its run)
+        strategies = tuple(jn.strategy for jn in joins)
 
     # metadata-only planning view: compiling expressions must not upload
     # any column (the paged probe's columns never transfer whole)
@@ -694,7 +729,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
             inner / left / semi / anti kinds. Output row space:
             probe-shaped for uniq and for semi/anti (existence is a count,
             never an expansion), CSR-expanded otherwise."""
-            kind, side, idx = node.strategy
+            kind, side, idx = strategies[node.pos]
             jkind = node.kind
             if side == "right":
                 pidx_map, pvalid, pside = lidx_map, lvalid, node.left
@@ -814,7 +849,7 @@ def compile_fragment(root, leaves, joins, agg_plan, agg_conds, caps,
             # list order matches the `joins` list (postorder walk)
             lidx, lvalid, lnull = eval_node(node.left)
             ridx, rvalid, rnull = eval_node(node.right)
-            if node.strategy is not None:
+            if strategies[node.pos] is not None:
                 idxmap, valid, nullmaps = eval_indexed(
                     node, lidx, lvalid, lnull, ridx, rvalid, rnull)
                 if node.kind == "left":
@@ -963,6 +998,17 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
         raise DeviceUnsupported("below device threshold")
     all_inner = all(jn.kind == "inner" for jn in joins)
     reordered = _reorder_fact_first(leaves, joins) if all_inner else None
+    hybrid_deferred = None
+    if reordered is None and all_inner:
+        # a build side too big to index whole (the paged-budget guard in
+        # _leaf_key_cols) may still chain with a DEFERRED strategy — the
+        # hybrid hash join partitions it at execution time
+        over = _over_budget_builds(leaves, joins, agg_plan, agg_conds)
+        if len(over) == 1:
+            reordered = _reorder_fact_first(leaves, joins,
+                                            assume_unique=over)
+            if reordered is not None:
+                hybrid_deferred = next(iter(over))
     if reordered is not None:
         root, joins = reordered  # strategies assigned (all uniq)
     else:
@@ -995,6 +1041,21 @@ def device_join_agg(agg_plan, agg_conds, child_exec, ctx):
         # fragment shape outside the paged language goes to the host
         # executors, which stream
         raise DeviceUnsupported("paged leaf outside streamed-probe language")
+    if pageable:
+        # hybrid hash join: a build side larger than the residency budget
+        # radix-partitions — fitting partitions stay device-resident,
+        # overflow spills to host pages and probes CONCURRENTLY on a
+        # supervisor worker — instead of surrendering the whole fragment
+        hj = _maybe_hybrid(root, leaves, joins, probe, agg_plan,
+                           agg_conds, ctx, deferred=hybrid_deferred)
+        if hj is not None:
+            return hj
+    if hybrid_deferred is not None:
+        # the deferred (partition-indexed) strategy exists ONLY for the
+        # hybrid path; the resident/paged dispatchers would crash on its
+        # None index — degrade to the host engine instead
+        raise DeviceUnsupported(
+            "over-budget build side outside the hybrid join language")
     if pageable:
         paged = chunk_is_paged(probe.chunk)
         if any_paged and not paged:
@@ -1179,11 +1240,84 @@ def _probe_spine(root):
     return node
 
 
+def _col_row_bytes(c) -> int:
+    """Resident bytes per row of one column: dict columns place their
+    int32 codes (4B), everything else its dtype width, +1B null mask.
+    THE estimate every budget gate shares (hybrid trigger, paged-build
+    refusal, mesh paged gate) — one formula, or the gates disagree about
+    the same leaf's footprint."""
+    return (4 if c.is_object() else c.data.dtype.itemsize) + 1
+
+
+def _leaf_used_bytes(leaf, used) -> int:
+    """Estimated resident bytes of a leaf's fragment-used columns."""
+    per_row = sum(_col_row_bytes(leaf.chunk.columns[i])
+                  for i in range(leaf.ncols) if leaf.offset + i in used)
+    return per_row * leaf.chunk.num_rows
+
+
+def _over_budget_builds(leaves, joins, agg_plan, agg_conds,
+                        exclude_id=None) -> set:
+    """Leaf ids whose fragment-used resident estimate exceeds the
+    effective budget — candidates for the hybrid join's partitioned
+    build.  `exclude_id` names the probe (never a build): the REAL probe
+    leaf when the chain shape is known, else the largest-leaf guess.
+    ONE implementation for both the deferred-reorder trigger and the
+    execution-time trigger, or the two would drift."""
+    budget = _dim_resident_budget()
+    if budget <= 0 or len(leaves) < 2:
+        return set()
+    used = _fragment_used_cols(leaves, joins, agg_plan, agg_conds)
+    if exclude_id is None:
+        exclude_id = max(leaves, key=lambda lf: lf.chunk.num_rows).leaf_id
+    return {leaf.leaf_id for leaf in leaves
+            if leaf.leaf_id != exclude_id
+            and _leaf_used_bytes(leaf, used) > budget}
+
+
+def _maybe_hybrid(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
+                  deferred=None):
+    """Route an over-budget build side to the hybrid hash join
+    (executor/hybrid_join.py).  Returns the result Chunk, or None when
+    the fragment has no over-budget build (the resident/paged paths
+    proceed) or the hybrid language rejects it (fallthrough — unless the
+    strategy was DEFERRED, where only the hybrid path can run it and the
+    caller must degrade)."""
+    big_id = deferred
+    if big_id is None:
+        over = _over_budget_builds(leaves, joins, agg_plan, agg_conds,
+                                   exclude_id=probe.leaf_id)
+        if len(over) != 1:
+            return None  # nothing over budget (or >1: out of language)
+        big_id = next(iter(over))
+    from .hybrid_join import hybrid_join_agg
+    try:
+        return hybrid_join_agg(root, leaves, joins, probe, big_id,
+                               agg_plan, agg_conds, ctx)
+    except DeviceUnsupported:
+        if deferred is not None:
+            raise
+        return None
+
+
 #: a paged BUILD-side table may be deliberately materialized into HBM up
 #: to this many bytes (needed columns only): SF100 orders as a Q3 build
 #: side is ~5GB of used columns — resident is the right call on a 16GB
-#: chip, but an unbounded upload would defeat the paged memory bound
-_DIM_RESIDENT_BUDGET = 6 << 30
+#: chip, but an unbounded upload would defeat the paged memory bound.
+#: This constant is only the fallback when NO budget is configured —
+#: see _dim_resident_budget().
+_DIM_RESIDENT_BUDGET_DEFAULT = 6 << 30
+
+
+def _dim_resident_budget() -> int:
+    """The effective resident-build threshold in bytes: the residency
+    ledger's live per-tenant share (so the paged-build refusal — and the
+    hybrid-join trigger — track ``tidb_device_mem_budget`` instead of a
+    hard-coded constant), falling back to the historical 6GB default
+    when no budget is configured (CPU backend with auto budget)."""
+    from ..ops import residency
+    share = residency.group_share() or residency.effective_budget()
+    return share if share > 0 else _DIM_RESIDENT_BUDGET_DEFAULT
 
 
 def _fragment_used_cols(leaves, joins, agg_plan, agg_conds):
@@ -1299,7 +1433,7 @@ def _paged_join_agg(root, leaves, joins, probe, agg_plan, agg_conds, ctx,
         lused = [i for i in range(leaf.ncols) if leaf.offset + i in used]
         if chunk_is_paged(leaf.chunk):
             est = 8 * leaf.chunk.num_rows * len(lused)
-            if est > _DIM_RESIDENT_BUDGET:
+            if est > _dim_resident_budget():
                 raise DeviceUnsupported(
                     "paged build-side leaf exceeds resident budget")
         dim_bucket = dev.bucket_rows(leaf.chunk.num_rows, per_double)

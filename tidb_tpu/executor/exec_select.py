@@ -68,6 +68,12 @@ class QueryExecutor:
         EXPLAIN ANALYZE answers "did this query pay a compile" directly
         (the TPU analog of cop-task build info)."""
         from .device_exec import pipe_cache_stats
+        from .device_join import LAST_PAGED_STATS
+        # fresh per dispatch: a PREVIOUS statement's paged/hybrid stats on
+        # this thread must not leak into this one's annotations (only the
+        # join path used to clear, so a later scan-agg could re-annotate
+        # a stale hybrid split)
+        LAST_PAGED_STATS.clear()
         st0 = pipe_cache_stats(thread_local=True)
         out = fn(*args, **kw)
         if self.stats is not None:
@@ -462,8 +468,14 @@ class HashAggExec(QueryExecutor):
         from ..storage.paged import chunk_is_paged, DEFAULT_PAGE_ROWS
         mesh = mpp_mesh(self.ctx)
         if mesh is not None and raw is not None and chunk_is_paged(raw):
-            mesh = None  # MPP shards whole columns; a disk table must
-            #              stream through the paged single-chip pipeline
+            # paged scans ARE mesh-legal within the residency budget now
+            # (placement materializes the pages per shard); a bigger disk
+            # table still streams through the single-chip paged pipeline
+            from .device_join import _col_row_bytes, _dim_resident_budget
+            est = sum(_col_row_bytes(c)
+                      for c in raw.columns) * raw.num_rows
+            if est > _dim_resident_budget():
+                mesh = None
         if mesh is not None:
             try:
                 if raw is not None:
@@ -554,7 +566,19 @@ class HashAggExec(QueryExecutor):
                     agg_conds, join_child, self.ctx, shape="join")
                 self._mark_fragment("tpu", None)
                 if LAST_PAGED_STATS:
-                    self.annotate(**dict(LAST_PAGED_STATS.items()))
+                    st = dict(LAST_PAGED_STATS.items())
+                    self.annotate(**st)
+                    if "hj_partitions" in st:
+                        # explicit keywords: the gauge-consistency rule
+                        # reads annotate kwargs, and the hybrid gauges
+                        # must surface on the EXPLAIN plane with THIS
+                        # query's per-run values (hybrid_join.py)
+                        self.annotate(
+                            hj_partitions=st["hj_partitions"],
+                            hj_spilled_partitions=st[
+                                "hj_spilled_partitions"],
+                            hj_spill_bytes=st["hj_spill_bytes"],
+                            hj_coproc_host_rows=st["hj_coproc_host_rows"])
                 return out
             except DeviceUnsupported:
                 pass

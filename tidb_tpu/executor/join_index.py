@@ -122,7 +122,9 @@ def _pack_host(datas, valid, packs):
     return packed
 
 
-def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
+def build_join_index(columns, mask_fn=None, cache_tag="", packs=None,
+                     force_sorted=False,
+                     pad_rows=None) -> "JoinIndex | None":
     """Index over `columns` (utils.chunk.Column tuple, int-kinded numpy
     data), cached on columns[0]. None when the keys can't range-pack into
     int64 (caller falls back to the device-side sort join).
@@ -134,13 +136,26 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
     (TPC-H Q5's orders⋈customer leg shrinks ~7x: the date filter keeps
     15% of orders but an unfiltered count expands all of them). The tag
     keys the cache per predicate set; one Column can hold one index at a
-    time (queries alternating predicate sets rebuild — numpy, cheap)."""
+    time (queries alternating predicate sets rebuild — numpy, cheap).
+
+    packs / force_sorted / pad_rows override the shape-determining
+    choices for PARTITIONED builds (executor/hybrid_join.py): every radix
+    partition of one hybrid join must carry the SAME per-column (min,
+    span) packs, the same layout kind and the same padded array length —
+    otherwise each partition would bake its own shapes into the fragment
+    signature and the zero-recompile invariant would die P ways.  `packs`
+    are the whole-table quantized ranges (probe keys outside a
+    partition's narrower true range simply find no match); force_sorted
+    skips the dense-CSR layout (a per-partition `starts` array spans the
+    WHOLE key range — P copies of it would dwarf the data); `pad_rows`
+    floors the bucket so all partitions pad to the largest one's."""
     host = columns[0]
     # the cached tuple PINS the column objects: a live reference can never
     # share its id with a newly allocated Column, which is what makes the
     # id()-keyed composite lookup sound (same convention as the pipeline
     # cache's dict_refs, executor/device_exec.py)
-    cache_key = (tuple(id(c) for c in columns), cache_tag)
+    cache_key = (tuple(id(c) for c in columns), cache_tag, packs,
+                 force_sorted, pad_rows)
     cached = getattr(host, "_join_index", None)
     if cached is not None and cached[0] == cache_key:
         return cached[1]
@@ -157,20 +172,27 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
     nb = len(datas[0])
     n_valid = int(valid.sum())
 
-    packs = []
-    total_span = 1.0
-    for d in datas:
-        dv = d[valid]
-        if dv.size == 0:
-            mn, mx = 0, 0
-        else:
-            mn, mx = int(dv.min()), int(dv.max())
-        # slack-quantized range: within-slack deltas keep the pack — and
-        # therefore the fragment signature and compiled program — stable
-        mn, mx = _quantize_range(mn, mx)
-        span = mx - mn + 1
-        total_span *= span
-        packs.append((mn, span))
+    if packs is not None:
+        total_span = 1.0
+        for _mn, span in packs:
+            total_span *= span
+        packs = list(packs)
+    else:
+        packs = []
+        total_span = 1.0
+        for d in datas:
+            dv = d[valid]
+            if dv.size == 0:
+                mn, mx = 0, 0
+            else:
+                mn, mx = int(dv.min()), int(dv.max())
+            # slack-quantized range: within-slack deltas keep the pack —
+            # and therefore the fragment signature and compiled program —
+            # stable
+            mn, mx = _quantize_range(mn, mx)
+            span = mx - mn + 1
+            total_span *= span
+            packs.append((mn, span))
     if total_span > 2.0**62:
         # the negative entry must pin the columns too — id() keys are
         # only sound while the referenced objects stay alive
@@ -190,7 +212,7 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
     # within-bucket build delta keeps every traced shape — the default
     # granularity (2 buckets per doubling) is fixed here because the
     # index is cached per table version, not per session
-    pad_len = bucket_rows(max(n_valid, 1))
+    pad_len = bucket_rows(max(n_valid, pad_rows or 1, 1))
     idx.rows_len = pad_len
 
     def _pad_rows(arr):
@@ -198,7 +220,8 @@ def build_join_index(columns, mask_fn=None, cache_tag="") -> "JoinIndex | None":
         out[:len(arr)] = arr
         return out
 
-    if span_total <= max(_DENSE_SLACK * nb, _DENSE_FLOOR):
+    if not force_sorted and span_total <= max(_DENSE_SLACK * nb,
+                                              _DENSE_FLOOR):
         idx.kind = "dense"
         counts = np.bincount(packed[valid], minlength=span_total)
         starts = np.empty(span_total + 1, dtype=row_dt)

@@ -489,10 +489,24 @@ def mpp_join_agg(agg_plan, agg_conds, child_exec, ctx, mesh):
         # the mesh fragment compiler shards/broadcasts inner joins only
         raise DeviceUnsupported("non-inner join in MPP fragment")
     from ..storage.paged import chunk_is_paged
-    if any(chunk_is_paged(leaf.chunk) for leaf in leaves):
-        # MPP shards whole resident columns across the mesh; a disk-backed
-        # table must stream through the paged single-chip pipeline instead
-        raise DeviceUnsupported("paged leaf in MPP fragment")
+    from .device_join import _col_row_bytes
+    paged_est = 0
+    for leaf in leaves:
+        if not chunk_is_paged(leaf.chunk):
+            continue
+        paged_est += sum(_col_row_bytes(c)
+                         for c in leaf.chunk.columns) * leaf.chunk.num_rows
+    if paged_est:
+        # paged leaves ARE legal on the mesh now (the last PR 7 gap) —
+        # placement materializes their pages into per-shard slices, so
+        # the whole placed footprint must fit the residency budget (the
+        # same threshold the single-chip resident-build path uses); a
+        # bigger disk table still streams through the single-chip paged
+        # pipeline or the hybrid partitioned join instead
+        from .device_join import _dim_resident_budget
+        if paged_est > _dim_resident_budget():
+            raise DeviceUnsupported(
+                "paged leaves exceed the mesh residency budget")
     return _run_mpp(agg_plan, agg_conds, root, leaves, joins, ctx, mesh)
 
 

@@ -84,6 +84,7 @@ _QUAR_GEN = [0]
 
 STATS = {
     "supervised": 0,   # calls dispatched through a worker thread
+    "coproc": 0,       # hybrid-join host passes run via submit_coproc
     "hangs": 0,        # deadline expiries (DeviceHangError raised)
     "kills": 0,        # waits abandoned by KILL/external interrupt
     "abandoned": 0,    # total calls ever abandoned (hangs + kills)
@@ -548,6 +549,53 @@ def _call_on_worker(fn, args, kw, deadline_s, ctx, shape, label,
     if job.exc is not None:
         raise job.exc
     return job.result
+
+
+def submit_coproc(fn, args=(), kw=None, *, label: str = ""):
+    """Dispatch ``fn`` on a pooled supervisor worker WITHOUT blocking the
+    caller — the host half of a hybrid-join co-processing pass
+    (executor/hybrid_join.py): the calling thread keeps driving the
+    device partitions while the worker joins the spilled partitions in
+    numpy.  The pair runs under the caller's ONE admission ticket (the
+    WFQ already governs the dispatch this pass belongs to — this is one
+    admitted fragment using host and device at once, not a second
+    dispatch, so no new ticket and no breaker interaction here).
+
+    Trace context and residency tenant group bridge onto the worker like
+    any supervised call.  Returns ``join(ctx=None)``: wait for
+    completion (KILL-interruptible through ``ctx.check_killed``),
+    re-raise the worker's exception, or return its result.  A waiter
+    that gives up (kill/exception) abandons the job kill-style: no fence
+    — the worker is running numpy, not a suspect backend."""
+    kw = kw or {}
+    job = _Job(fn, args, kw, label or getattr(fn, "__name__", "coproc"))
+    from ..session import tracing
+    job.trace = tracing.capture()
+    try:
+        from ..ops import residency
+        job.group = residency.current_group()
+    except Exception:
+        pass
+    with _LOCK:
+        STATS["supervised"] += 1
+        STATS["coproc"] += 1
+    _get_worker().inbox.put(job)
+
+    def join(ctx=None):
+        check = getattr(ctx, "check_killed", None)
+        try:
+            while not job.done.wait(_POLL_S):
+                if check is not None:
+                    check()
+        except BaseException:
+            _abandon(job, hang=False)
+            raise
+        _tls_apply(job.tls)
+        if job.exc is not None:
+            raise job.exc
+        return job.result
+
+    return join
 
 
 def _abandon(job: _Job, hang: bool) -> bool:

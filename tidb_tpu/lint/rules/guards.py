@@ -61,6 +61,7 @@ AUDITED = (
     "executor/compile_service.py",
     "executor/circuit.py",
     "executor/device_exec.py",
+    "executor/hybrid_join.py",
     "executor/mpp_exec.py",
     "ops/residency.py",
     "session/tracing.py",

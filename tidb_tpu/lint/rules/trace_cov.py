@@ -34,6 +34,11 @@ from ._util import call_name
 AUDITED = {
     "executor/device_exec.py": ("run_device", "_run_device_admitted"),
     "executor/compile_service.py": ("obtain", "_obtain_impl"),
+    # the hybrid hash join's spill/split decisions: every language-gate
+    # or partition-shape DeviceUnsupported inside the entry is a
+    # degradation decision and must land on the statement's trace (the
+    # join.partition span / join.spill_decision event)
+    "executor/hybrid_join.py": ("hybrid_join_agg",),
 }
 
 #: an exception raise counts as a degradation site when its constructor

@@ -412,6 +412,25 @@ def group_share() -> int:
         return _group_share_locked()
 
 
+def free_share_bytes(group: str | None = None) -> int:
+    """The LIVE headroom of `group`'s budget share (calling thread's
+    group when None): share minus the bytes the group already holds
+    resident, floored at a quarter of the share — a tenant whose cache
+    is momentarily full must still be able to place a working set (the
+    LRU will evict its own cold entries to make room), so the floor
+    keeps memory-adaptive operators (the hybrid hash join's partition
+    sizing, executor/hybrid_join.py) from collapsing to all-spill just
+    because the previous query's uploads are still warm.  0 = no budget
+    configured (unlimited)."""
+    with _LOCK:
+        share = _group_share_locked()
+        if share <= 0:
+            return 0
+        g = group if group is not None else current_group()
+        held = _GROUP_BYTES.get(g, 0)
+        return max(share - held, share // 4)
+
+
 def _group_share_locked() -> int:
     budget = effective_budget()
     if budget <= 0:

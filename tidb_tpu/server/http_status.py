@@ -25,6 +25,12 @@ def _compiler_snapshot() -> dict:
     return compile_service.snapshot()
 
 
+def _hybrid_join_snapshot() -> dict:
+    """Lazy import: the hybrid join pulls the executor stack in."""
+    from ..executor import hybrid_join
+    return hybrid_join.snapshot()
+
+
 def _tracing_snapshot() -> dict:
     """Span-tracer ring stats for /status (process-wide)."""
     from ..session import tracing
@@ -143,6 +149,11 @@ class StatusServer:
             # the per-trace span-bound drop counter — whether the
             # recorder is keeping up is diagnosable from the status port
             "device_tracing": _tracing_snapshot(),
+            # hybrid hash join (executor/hybrid_join.py): partition
+            # fanout, spilled partitions/bytes, co-processed host rows
+            # and the open-spill-set drain gauge — whether a build side
+            # is spilling (and leaking) is diagnosable from the port
+            "device_hybrid_join": _hybrid_join_snapshot(),
         }
 
     def _metrics(self):
@@ -183,6 +194,12 @@ class StatusServer:
         gauges.setdefault("compile_bg_seconds", cs["compile_bg_seconds"])
         gauges.setdefault("compile_persist_hits",
                           cs["compile_persist_hits"])
+        hs = _hybrid_join_snapshot()
+        gauges.setdefault("hj_partitions", hs["hj_partitions"])
+        gauges.setdefault("hj_spilled_partitions",
+                          hs["hj_spilled_partitions"])
+        gauges.setdefault("hj_spill_bytes", hs["hj_spill_bytes"])
+        gauges.setdefault("hj_coproc_host_rows", hs["hj_coproc_host_rows"])
         # per-tenant degradations as ONE labeled series (a single TYPE
         # header — duplicate TYPE lines are invalid text exposition and
         # fail the whole scrape); the observe-sink mirror keys them

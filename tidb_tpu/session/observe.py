@@ -49,6 +49,14 @@ HIST_BUCKETS = {
     # run_device: supervisor + breaker + upload + dispatch)
     "device_dispatch_seconds": (
         0.0005, 0.002, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0),
+    # hybrid hash join probe halves (executor/hybrid_join.py): the
+    # device partitions' pipelined pass vs the supervisor worker's
+    # concurrent numpy pass over the spilled partitions — the measured
+    # inputs of the cost-based device/host split point
+    "hj_probe_device_seconds": (
+        0.001, 0.005, 0.02, 0.1, 0.5, 2.5, 10.0, 60.0),
+    "hj_probe_host_seconds": (
+        0.001, 0.005, 0.02, 0.1, 0.5, 2.5, 10.0, 60.0),
 }
 
 

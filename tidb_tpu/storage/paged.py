@@ -23,9 +23,11 @@ the codes directly; only a host-side row access materializes bytes.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import pickle
+import threading
 
 import numpy as np
 
@@ -190,6 +192,110 @@ def open_paged_columns(root: str, info) -> dict:
         else:
             out[c.id] = Column(c.ftype, mm, false_nulls(spec["rows"]))
     return out
+
+
+# ---------------------------------------------------------------------------
+# hybrid-join spill pages (executor/hybrid_join.py)
+# ---------------------------------------------------------------------------
+
+#: process-wide registry of open spill sets: the chaos invariant is that
+#: this drains to ZERO after every query — a fence/OOM/injected fault
+#: mid-probe must not leak partition pages on disk (tests/chaos_harness
+#: asserts spill_outstanding() between seeds)
+_SPILL_LOCK = threading.Lock()
+_SPILL_OPEN: dict[int, "SpillSet"] = {}
+_SPILL_SEQ = itertools.count(1)
+
+SPILL_STATS = {
+    "spill_sets_opened": 0,   # lifetime SpillSets created
+    "spill_writes": 0,        # partition pages written
+    "spill_bytes_written": 0,  # lifetime bytes through the spill path
+}
+
+
+class SpillSet:
+    """Host columnar pages for the hybrid hash join's OVERFLOW build
+    partitions: the radix partitions that do not fit the residency
+    ledger's free share are gathered column-by-column into per-partition
+    binary page files (one compact sequential file per column — a
+    memmap-backed fact's random partition rows become sequential reads
+    for the host probe pass) and read back as read-only memmaps.
+
+    Dictionary-encoded string columns spill their int CODES (the caller
+    keeps the dictionary — same contract as the paged table format
+    above).  ``close()`` deletes every page and unregisters the set; the
+    drained invariant (spill_outstanding) is chaos-checked."""
+
+    def __init__(self, tag: str = ""):
+        import tempfile
+        self.root = tempfile.mkdtemp(prefix=f"tidb-hj-spill-{tag}-")
+        self.token = next(_SPILL_SEQ)
+        self.bytes = 0
+        self._parts: dict[int, dict] = {}  # pid -> {key: (path, dtype, n)}
+        self._closed = False
+        with _SPILL_LOCK:
+            _SPILL_OPEN[self.token] = self
+            SPILL_STATS["spill_sets_opened"] += 1
+
+    def write(self, pid: int, arrays: dict):
+        """Spill one partition: arrays maps a caller key (the leaf-local
+        column index) -> (data, nulls) numpy arrays (codes for dict
+        columns — object arrays are a caller bug and refused)."""
+        from ..utils import failpoint
+        # chaos hook: a `spill-fail` action models a disk-full / IO error
+        # mid-spill — the join must abort classified with pages drained
+        failpoint.inject("device-join-spill")
+        part = self._parts.setdefault(pid, {})
+        written = 0
+        for key, (data, nulls) in arrays.items():
+            d = np.ascontiguousarray(data)
+            if d.dtype == object:
+                raise ValueError(
+                    "object array reached the spill writer (dictionary "
+                    "columns must spill their codes)")
+            nl = np.ascontiguousarray(nulls, dtype=bool)
+            dp = os.path.join(self.root, f"p{pid}c{key}.bin")
+            npth = os.path.join(self.root, f"p{pid}c{key}.null")
+            d.tofile(dp)
+            nl.tofile(npth)
+            part[key] = (dp, npth, d.dtype.str, len(d))
+            written += d.nbytes + nl.nbytes
+        self.bytes += written
+        with _SPILL_LOCK:
+            SPILL_STATS["spill_writes"] += 1
+            SPILL_STATS["spill_bytes_written"] += written
+
+    def read(self, pid: int) -> dict:
+        """{key: (data, nulls)} read-only memmaps of one spilled
+        partition's pages."""
+        out = {}
+        for key, (dp, npth, dt, n) in self._parts.get(pid, {}).items():
+            d = np.memmap(dp, mode="r", dtype=np.dtype(dt), shape=(n,))
+            nl = np.memmap(npth, mode="r", dtype=np.bool_, shape=(n,))
+            out[key] = (d, nl)
+        return out
+
+    def close(self):
+        """Delete every page and unregister (idempotent).  Called from
+        the hybrid join's ``finally`` so an abort at ANY point — fence,
+        OOM, injected spill failure, kill — drains the pages."""
+        if self._closed:
+            return
+        self._closed = True
+        import shutil
+        shutil.rmtree(self.root, ignore_errors=True)
+        self._parts.clear()
+        with _SPILL_LOCK:
+            _SPILL_OPEN.pop(self.token, None)
+
+
+def spill_outstanding() -> dict:
+    """{"open_sets": n, "open_bytes": b} — the drained invariant reads
+    zero/zero between queries."""
+    with _SPILL_LOCK:
+        sets = list(_SPILL_OPEN.values())
+    return {"open_sets": len(sets),
+            "open_bytes": sum(s.bytes for s in sets)}
 
 
 def is_paged(col: Column) -> bool:

@@ -93,7 +93,8 @@ def _mro_names(err) -> set:
 def classify(err) -> str:
     """Map an exception to its resilience class (one label the breaker,
     the backoffer and the slow log all agree on)."""
-    from .failpoint import FailpointError, InjectedCompileError
+    from .failpoint import (FailpointError, InjectedCompileError,
+                            InjectedSpillError)
     from ..errors import (DeviceAdmissionError, DeviceCompileError,
                           DeviceHangError)
     if isinstance(err, DeviceHangError):
@@ -109,7 +110,9 @@ def classify(err) -> str:
         return CLASS_EXCHANGE
     if isinstance(err, LeaseExpiredError):
         return CLASS_LEASE
-    if isinstance(err, FailpointError):
+    if isinstance(err, (FailpointError, InjectedSpillError)):
+        # a spill-write failure mid-hybrid-join degrades to host like any
+        # other injected fault (breaker-charged, spill pages drained)
         return CLASS_FAULT
     # deliberately NOT all of OSError: FileNotFoundError/PermissionError
     # and friends are programming/environment bugs that must surface, not

@@ -54,6 +54,19 @@ class InjectedCompileError(Exception):
     and skip the retry/breaker ladder this failpoint exists to test."""
 
 
+class InjectedSpillError(Exception):
+    """Raised by an enabled ``spill-fail`` / ``N*spill-fail`` failpoint:
+    a synthetic host-columnar-page spill failure (disk full / IO error
+    while the hybrid hash join writes an overflow partition,
+    executor/hybrid_join.py via storage/paged.SpillSet).  classify labels
+    it ``fault`` so run_device records it against the join breaker and
+    degrades the fragment to the host engine — and the chaos invariant
+    is that the abort leaks NO spilled pages (spill_outstanding() drains
+    to zero) and no residency-ledger bytes.  Deliberately NOT a
+    FailpointError subclass so tests can assert the spill path
+    specifically fired."""
+
+
 class InjectedOOMError(Exception):
     """Raised by an enabled ``oom`` / ``N*oom`` failpoint: a synthetic
     device out-of-memory whose MESSAGE mimics jaxlib's XlaRuntimeError
@@ -132,6 +145,16 @@ def inject(name: str):
         #   — models transient HBM pressure the evict+retry ladder absorbs
         if hit <= int(m.group(1)):
             raise InjectedOOMError(_oom_message(name))
+        return None
+    if action == "spill-fail":
+        raise InjectedSpillError(
+            f"spill write failed (injected by failpoint {name})")
+    m = re.fullmatch(r"(\d+)\*spill-fail", action)
+    if m:  # N*spill-fail: fail the first N partition spills, then
+        #   succeed — models a transient disk hiccup mid-spill
+        if hit <= int(m.group(1)):
+            raise InjectedSpillError(
+                f"spill write failed (injected by failpoint {name})")
         return None
     if action == "compile-fail":
         raise InjectedCompileError(
