@@ -343,6 +343,398 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
     return summary
 
 
+# -- fleet mode (--procs N): the cross-process serving fabric ----------------
+#
+# Where run_serve drives N THREADS against one Domain, run_fleet drives
+# N PROCESSES (tidb_tpu/fabric): a parent-supervised worker fleet behind
+# one SO_REUSEPORT port, coordinated through the shared-memory segment
+# (fleet-wide WFQ + per-tenant caps + fragment dedup), with the
+# separated compile server owning the XLA compiles.  The parent is a
+# pure wire CLIENT — every measured operation crosses the real MySQL
+# protocol, and per-process latency attribution comes from the
+# fleet-unique conn-id slot prefix, no side channel.
+#
+# Phases (each emits JSON lines; --smoke pins all three as regressions):
+#   mix        shared-port mixed OLAP/OLTP load, per-process AND
+#              fleet-aggregate p50/p99/qps
+#   wfq        the CROSS-PROCESS starved-tenant regression: a heavy
+#              tenant floods worker A (+ one pinned heavy client on B,
+#              so the fleet-wide cap actually crosses processes) while a
+#              light tenant runs on worker B — light p99 must stay
+#              below heavy p50, and the segment's peak_running for the
+#              heavy tenant must never exceed the fleet cap
+#   dedup      barrier-synchronized identical OLAP fragments on TWO
+#              different workers — the fleet fragment-dedup counter
+#              must move (one device call served both)
+#   kill       (--chaos) the seeded FLEET_FAULTS catalog SIGKILLs one
+#              worker mid-query: clean classified client error, parent
+#              respawn within the backoff budget, segment lease
+#              reclaimed, survivors serving, zero leaked leases/tickets
+#              at drain
+
+#: queries for the fleet phases (bench.QUERIES keys)
+FLEET_OLAP = ("q1", "q3")
+
+#: the WFQ phase's heavy corpus: q1-shaped scans with PER-CLIENT filter
+#: constants.  Distinct constants give each client a distinct compiled
+#: pipeline identity, so the fabric's fragment dedup cannot collapse the
+#: flood into one device call — the phase must measure device-TIME
+#: fairness, and a flood the dedup serves from one page is (correctly!)
+#: not a flood.  The dedicated dedup phase uses identical queries on
+#: purpose; this one must not.
+FLEET_WFQ_DATES = ("1998-09-02", "1998-06-02", "1998-03-02",
+                   "1997-12-02", "1997-09-02", "1997-06-02")
+
+
+def _wfq_heavy_q(i: int) -> str:
+    return bench.QUERIES["q1"].replace(
+        "'1998-09-02'", f"'{FLEET_WFQ_DATES[i % len(FLEET_WFQ_DATES)]}'")
+#: respawn must land within this budget (fleet backoff base 0.2s,
+#: worker boot ~a second — generous for a loaded CI machine)
+RESPAWN_BUDGET_S = 30.0
+
+
+def _fabric_seed(domain):
+    """Worker-side data init (TIDB_TPU_FABRIC_INIT hook): TPC-H at
+    BENCH_FABRIC_SF + the transfer ledger.  Deterministic (bench.gen_all
+    is fixed-seeded), so every worker holds IDENTICAL data — the
+    property the content-hashed fragment dedup keys rely on."""
+    from tidb_tpu.testkit import TestKit
+    sf = float(os.environ.get("BENCH_FABRIC_SF", "0.002"))
+    tk = TestKit(domain)
+    bench.gen_all(tk, sf)
+    tk.must_exec("use test")
+    tk.must_exec("create table ledger (acct int primary key, bal int)")
+    tk.must_exec("insert into ledger values " + ",".join(
+        f"({i}, {SEED_BAL})" for i in range(1, N_ACCTS + 1)))
+
+
+def _fleet_conn(port, db="tpch", group=None, engine=None):
+    from tidb_tpu.fabric.client import FleetClient
+    c = FleetClient(port)
+    c.must_exec(f"use {db}")
+    if group:
+        c.must_exec(f"set tidb_resource_group = '{group}'")
+    if engine:
+        c.must_exec(f"set tidb_executor_engine = '{engine}'")
+    return c
+
+
+def run_fleet(procs: int = 4, n_threads: int = 8, n_ops: int = 6,
+              sf: float = 0.002, seed: int = 0, chaos: bool = False,
+              emit=_emit) -> dict:
+    """Drive the fleet serving workload; returns the summary dict.
+    Raises AssertionError on any invariant violation (tests call this
+    in-process; the CLI exits 1)."""
+    from tests.chaos_harness import FLEET_FAULTS
+    from tidb_tpu.fabric.fleet import Fleet
+
+    assert procs >= 2, "fleet mode needs at least 2 workers"
+    assert not chaos or procs >= 3, (
+        "fleet chaos needs >= 3 workers: the WFQ/dedup phases require "
+        "two DISTINCT surviving processes")
+    rng = random.Random(seed)
+    doomed = rng.randrange(procs) if chaos else -1
+    slot_env = {}
+    if chaos:
+        action = rng.choice(FLEET_FAULTS["fabric-kill-worker"])
+        slot_env[doomed] = {
+            "TIDB_TPU_FABRIC_FAILPOINTS": f"fabric-kill-worker={action}"}
+    fleet = Fleet(
+        procs, init="bench_serve:_fabric_seed",
+        sysvars={"tidb_device_tenant_running_cap": "1"},
+        env_extra={"BENCH_FABRIC_SF": str(sf)}, slot_env=slot_env)
+    t_start = time.monotonic()
+    fleet.start(timeout_s=300.0)
+    emit({"metric": "fleet_up", "procs": procs, "port": fleet.port,
+          "boot_s": round(time.monotonic() - t_start, 2), "sf": sf,
+          "seed": seed, "chaos": chaos,
+          "compile_server": bool(fleet.compile_server_addr)})
+    try:
+        return _run_fleet_phases(fleet, procs, n_threads, n_ops, seed,
+                                 chaos, doomed, emit)
+    finally:
+        drained = fleet.shutdown()
+        emit({"metric": "fleet_drained", **(drained or {"ok": False})})
+        for s in fleet.slots:
+            if s.summary is not None:
+                emit(s.summary)
+        assert drained and drained["ok"], (
+            f"FLEET DRAIN LEAK (leases/running/dedup): {drained}")
+
+
+def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
+                      doomed, emit) -> dict:
+    from tidb_tpu.fabric.client import FleetClient, WireError
+
+    survivors = [s for s in range(procs) if s != doomed]
+    golden_slot = survivors[0]
+    # the ORIGINAL pids: the kill-chaos respawn check must compare
+    # against the first incarnation even when the doomed worker dies
+    # early (a shared-port mix client may trip its failpoint first)
+    first_pids = {s: fleet.worker_pid(s) for s in range(procs)}
+
+    # goldens over the wire (host engine) from ONE worker: the seeding
+    # is deterministic, so one worker's host answer is the fleet's
+    gc = _fleet_conn(fleet.direct_port(golden_slot), engine="host")
+    goldens = {q: gc.must_query(bench.QUERIES[q])[1] for q in FLEET_OLAP}
+    gc.close()
+
+    mu = threading.Lock()
+    lat = {}          # (phase, group, slot) -> [ms]
+    counts = {"ok": 0, "clean_errors": 0, "writes_ok": 0,
+              "writes_failed": 0, "wire_drops": 0}
+    violations: list = []
+
+    def record(phase, group, slot, ms):
+        with mu:
+            lat.setdefault((phase, group, slot), []).append(ms)
+
+    def bump(key, n=1):
+        with mu:
+            counts[key] += n
+
+    def violate(what):
+        with mu:
+            violations.append(what)
+
+    # -- phase: mixed load over the shared port ------------------------------
+
+    def mix_worker(tid):
+        wrng = random.Random((seed << 8) ^ tid)
+        olap = tid % 2 == 0
+        try:
+            c = _fleet_conn(fleet.port,
+                            db="tpch" if olap else "test",
+                            group="olap" if olap else "oltp",
+                            engine="tpu" if olap else None)
+        except WireError:
+            # with chaos a shared-port connection may land on the doomed
+            # worker and trip its kill failpoint during setup — a CLEAN
+            # classified drop; without chaos it is a finding
+            if chaos:
+                bump("wire_drops")
+            else:
+                violate(f"thread {tid}: wire failure without chaos")
+            return
+        slot = c.slot
+        try:
+            for _op in range(n_ops):
+                t0 = time.monotonic()
+                try:
+                    if olap:
+                        q = FLEET_OLAP[wrng.randrange(len(FLEET_OLAP))]
+                        rows = c.must_query(bench.QUERIES[q])[1]
+                        if rows != goldens[q]:
+                            violate(f"WRONG RESULT {q} on slot {slot}")
+                    elif wrng.random() < 0.5:
+                        total = c.must_query(
+                            "select sum(bal) from ledger")[1][0][0]
+                        if str(total) != str(LEDGER_TOTAL):
+                            violate(f"ATOMICITY: ledger {total} on "
+                                    f"slot {slot}")
+                    else:
+                        a, b = sorted(wrng.sample(
+                            range(1, N_ACCTS + 1), 2))
+                        amt = wrng.randrange(1, 40)
+                        c.must_exec("begin")
+                        c.must_exec(f"update ledger set bal = bal - "
+                                    f"{amt} where acct = {a}")
+                        c.must_exec(f"update ledger set bal = bal + "
+                                    f"{amt} where acct = {b}")
+                        c.must_exec("commit")
+                        bump("writes_ok")
+                except WireError as e:
+                    # a dropped connection is CLEAN only when chaos is
+                    # killing workers; otherwise it is a finding
+                    if chaos:
+                        bump("wire_drops")
+                        return
+                    violate(f"wire failure without chaos: {e}")
+                    return
+                record("mix", "olap" if olap else "oltp", slot,
+                       (time.monotonic() - t0) * 1000.0)
+                bump("ok")
+        finally:
+            c.close()
+
+    t_mix = time.monotonic()
+    threads = [threading.Thread(target=mix_worker, args=(t,),
+                                daemon=True) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600.0)
+    assert not any(t.is_alive() for t in threads), "STUCK mix clients"
+    mix_wall = time.monotonic() - t_mix
+
+    # -- phase: cross-process starved-tenant WFQ regression ------------------
+    slot_a, slot_b = survivors[0], survivors[1 % len(survivors)]
+    wfq_lat = {"heavy": [], "light": []}
+    wfq_mu = threading.Lock()
+    n_flood = 5
+    wfq_start = threading.Barrier(n_flood + 2)
+    wfq_errs = []
+
+    def wfq_client(group, port, query, n):
+        try:
+            c = _fleet_conn(port, group=group, engine="tpu")
+            c.must_query(query)  # absorb cold compile outside the clock
+            wfq_start.wait(timeout=300)
+            for _ in range(n):
+                t0 = time.monotonic()
+                c.must_query(query)
+                with wfq_mu:
+                    wfq_lat[group].append(time.monotonic() - t0)
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            wfq_errs.append(e)
+
+    light_q = ("select r_regionkey, count(*) from region "
+               "group by r_regionkey order by r_regionkey")
+    wfq_threads = (
+        # the flood on process A (distinct per-client heavy variants —
+        # see FLEET_WFQ_DATES: dedup must not collapse the flood)...
+        [threading.Thread(target=wfq_client, daemon=True,
+                          args=("heavy", fleet.direct_port(slot_a),
+                                _wfq_heavy_q(i), 5)) for i in range(n_flood)]
+        # ...plus ONE heavy client on process B: the fleet-wide cap=1
+        # must serialize it behind A's flood THROUGH THE SEGMENT —
+        # without cross-process coordination B would run it in parallel
+        + [threading.Thread(target=wfq_client, daemon=True,
+                            args=("heavy", fleet.direct_port(slot_b),
+                                  _wfq_heavy_q(n_flood), 3))]
+        # the light tenant on process B must not starve
+        + [threading.Thread(target=wfq_client, daemon=True,
+                            args=("light", fleet.direct_port(slot_b),
+                                  light_q, 8))])
+    # barrier is sized for heavy+light = 6 clients
+    for t in wfq_threads:
+        t.start()
+    for t in wfq_threads:
+        t.join(600.0)
+    assert not wfq_errs, f"WFQ phase errors: {wfq_errs}"
+    heavy = sorted(wfq_lat["heavy"])
+    light = sorted(wfq_lat["light"])
+    p99_light = light[-1]
+    p50_heavy = heavy[len(heavy) // 2]
+    peak_heavy = fleet.coord.peak_running("heavy")
+    emit({"metric": "fleet_wfq", "p99_light_s": round(p99_light, 4),
+          "p50_heavy_s": round(p50_heavy, 4),
+          "peak_running_heavy": peak_heavy,
+          "slot_heavy": slot_a, "slot_light": slot_b})
+
+    # -- phase: fleet fragment dedup -----------------------------------------
+    ded_start = threading.Barrier(2)
+    ded_errs = []
+
+    def dedup_client(port):
+        try:
+            c = _fleet_conn(port, group="olap", engine="tpu")
+            c.must_query(bench.QUERIES["q1"])  # warm the compiled path
+            for _ in range(4):
+                ded_start.wait(timeout=300)
+                rows = c.must_query(bench.QUERIES["q1"])[1]
+                if rows != goldens["q1"]:
+                    ded_errs.append("dedup WRONG RESULT")
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            ded_errs.append(e)
+
+    dt = [threading.Thread(target=dedup_client, daemon=True,
+                           args=(fleet.direct_port(slot_a),)),
+          threading.Thread(target=dedup_client, daemon=True,
+                           args=(fleet.direct_port(slot_b),))]
+    for t in dt:
+        t.start()
+    for t in dt:
+        t.join(600.0)
+    assert not ded_errs, f"dedup phase errors: {ded_errs}"
+    ctrs = fleet.coord.counters()
+    emit({"metric": "fleet_dedup",
+          **{k: v for k, v in ctrs.items() if k.startswith("fabric_")}})
+
+    # -- phase: process-kill chaos -------------------------------------------
+    respawn_s = None
+    if chaos:
+        t0 = time.monotonic()
+        if fleet.respawns == 0:
+            # nothing tripped the failpoint yet: aim a query at the
+            # doomed worker's direct port — it dies MID-QUERY and the
+            # client must see a clean classified drop, never a hang
+            try:
+                dc = FleetClient(fleet.direct_port(doomed))
+                dc.must_exec("use tpch")
+                dc.must_query("select count(*) from region")  # boom
+                violations.append("fabric-kill-worker armed but the "
+                                  "doomed worker survived its query")
+            except WireError:
+                counts["wire_drops"] += 1  # the CLEAN classified outcome
+        assert fleet.wait_respawn(doomed, first_pids[doomed],
+                                  RESPAWN_BUDGET_S), (
+            f"worker {doomed} not respawned within {RESPAWN_BUDGET_S}s")
+        respawn_s = time.monotonic() - t0
+        # survivors kept serving while the corpse was reclaimed
+        sc = _fleet_conn(fleet.direct_port(slot_a))
+        assert sc.must_query("select count(*) from region")[1]
+        sc.close()
+        ctrs = fleet.coord.counters()
+        assert ctrs["fabric_lease_reclaims"] >= 1, ctrs
+        assert fleet.respawns >= 1
+        emit({"metric": "fleet_kill_chaos", "slot": doomed,
+              "respawn_s": round(respawn_s, 2),
+              "lease_reclaims": ctrs["fabric_lease_reclaims"]})
+
+    # -- report --------------------------------------------------------------
+    assert not violations, "\n".join(str(v) for v in violations)
+    by_slot = {}
+    fleet_all = {}
+    for (phase, group, slot), vals in lat.items():
+        if phase != "mix":
+            continue
+        by_slot.setdefault((group, slot), []).extend(vals)
+        fleet_all.setdefault(group, []).extend(vals)
+    for (group, slot), vals in sorted(by_slot.items()):
+        vals.sort()
+        emit({"metric": "fleet_latency_ms", "group": group,
+              "slot": slot, "p50": _pctl(vals, 0.50),
+              "p99": _pctl(vals, 0.99), "n": len(vals)})
+    summary = {"procs": procs, "threads": n_threads, "seed": seed,
+               "chaos": chaos, "violations": 0, **counts,
+               "p99_light_s": p99_light, "p50_heavy_s": p50_heavy,
+               "peak_running_heavy": peak_heavy,
+               "dedup_hits": ctrs["fabric_dedup_hits"],
+               "respawn_s": respawn_s}
+    for group, vals in sorted(fleet_all.items()):
+        vals.sort()
+        emit({"metric": "fleet_latency_ms", "group": group,
+              "slot": "all", "p50": _pctl(vals, 0.50),
+              "p99": _pctl(vals, 0.99), "n": len(vals)})
+        summary[f"p50_{group}"] = _pctl(vals, 0.50)
+        summary[f"p99_{group}"] = _pctl(vals, 0.99)
+    qps = round(counts["ok"] / mix_wall, 2) if mix_wall > 0 else 0.0
+    summary["qps"] = qps
+    emit({"metric": "fleet_qps", "value": qps, "ok": counts["ok"],
+          "wall_s": round(mix_wall, 2),
+          "clean_errors": counts["clean_errors"],
+          "wire_drops": counts["wire_drops"],
+          "writes_ok": counts["writes_ok"]})
+
+    # the acceptance regressions, asserted LAST so the report above is
+    # emitted even when one trips
+    assert p99_light < max(p50_heavy, 0.05), (
+        f"CROSS-PROCESS WFQ REGRESSION: light p99 {p99_light:.3f}s on "
+        f"slot {slot_b} >= heavy p50 {p50_heavy:.3f}s flooding slot "
+        f"{slot_a} — light tenant starved across the process boundary")
+    assert peak_heavy <= 1, (
+        f"FLEET CAP VIOLATION: heavy tenant peaked at {peak_heavy} "
+        "concurrent fragments fleet-wide (cap 1)")
+    assert ctrs["fabric_dedup_hits"] > 0, (
+        "FLEET DEDUP INERT: identical concurrent OLAP fragments on two "
+        f"workers produced zero dedup hits ({ctrs})")
+    return summary
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--threads", type=int, default=8)
@@ -350,18 +742,29 @@ def main(argv=None) -> int:
                     help="operations per client thread")
     ap.add_argument("--sf", type=float, default=0.01)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--procs", type=int, default=1,
+                    help="worker PROCESSES (>1 = fleet mode over the "
+                         "serving fabric; tidb_tpu/fabric)")
     ap.add_argument("--chaos", action="store_true",
                     help="run under the seeded chaos catalog "
-                         "(hang + OOM + admission failpoints)")
+                         "(threads: hang + OOM + admission failpoints; "
+                         "fleet: + process-kill)")
     ap.add_argument("--smoke", action="store_true",
-                    help="small fixed-seed run for CI (8 threads, "
-                         "tiny SF, chaos on)")
+                    help="small fixed-seed run for CI (tiny SF, chaos "
+                         "on; with --procs N the fleet smoke preset)")
     args = ap.parse_args(argv)
     if args.smoke:
         args.threads, args.ops, args.sf, args.chaos = 8, 4, 0.002, True
+        if args.procs > 1:
+            args.ops = 3
     try:
-        run_serve(n_threads=args.threads, n_ops=args.ops, sf=args.sf,
-                  seed=args.seed, chaos=args.chaos)
+        if args.procs > 1:
+            run_fleet(procs=args.procs, n_threads=args.threads,
+                      n_ops=args.ops, sf=args.sf, seed=args.seed,
+                      chaos=args.chaos)
+        else:
+            run_serve(n_threads=args.threads, n_ops=args.ops, sf=args.sf,
+                      seed=args.seed, chaos=args.chaos)
     except AssertionError as e:
         _emit({"metric": "serve_violation", "error": str(e)[:2000]})
         return 1

@@ -133,6 +133,21 @@ WRITE_FAULTS = {
     "txn-before-commit": ["1*panic", "panic"],
 }
 
+#: FLEET-mode fault catalog (process-level faults — these cannot run in
+#: the in-process modes above, which ARE the process they would kill):
+#: bench_serve's --procs chaos passes them to individual workers via
+#: spawn env (TIDB_TPU_FABRIC_FAILPOINTS), seeded by the same rng
+#: discipline.  `fabric-kill-worker` with a truthy return payload
+#: SIGKILLs the worker MID-QUERY (tidb_tpu/fabric/worker.py); the
+#: invariants are the fleet's: parent respawn within the backoff budget,
+#: coordination-segment lease reclaim with zero orphaned running
+#: counts, a clean classified connection error at the client, and
+#: survivors serving throughout (tests/test_fabric.py + bench_serve
+#: fleet smoke).
+FLEET_FAULTS = {
+    "fabric-kill-worker": ["1*return(1)", "2*return(1)"],
+}
+
 
 def _setup(tk: TestKit):
     tk.must_exec("use test")

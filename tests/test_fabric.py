@@ -1,0 +1,333 @@
+"""Cross-process serving fabric (tidb_tpu/fabric, ISSUE 14): the
+coordination segment's admission/dedup/lease mechanics, fleet-unique
+connection ids across forked servers, fragment dedup through real
+dispatches, the fleet-aware residency shares, and the process-kill chaos
+invariants (respawn within the backoff budget, lease reclaim with zero
+orphaned counts, clean classified client errors, survivors serving)."""
+
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.fabric import (CONN_SLOT_SHIFT, conn_id_base,
+                             slot_of_conn_id)
+from tidb_tpu.fabric.coord import Coordinator
+
+
+@pytest.fixture()
+def coord(tmp_path):
+    c = Coordinator.create(str(tmp_path / "coord.json"), nslots=4)
+    yield c
+    c.unlink()
+
+
+class TestCoordinator:
+    def test_create_attach_roundtrip(self, coord, tmp_path):
+        c2 = Coordinator.attach(str(tmp_path / "coord.json"))
+        try:
+            assert c2.nslots == coord.nslots
+            coord.claim_slot(0)
+            c2.claim_slot(1)
+            assert coord.live_slots(5.0) == [0, 1]
+        finally:
+            c2.close()
+
+    def test_fleet_running_cap_is_atomic_across_attachments(
+            self, coord, tmp_path):
+        """Two attachments = two processes' views: the SECOND acquire of
+        a cap-1 tenant must refuse even though it runs through a
+        different attachment (the in-process scheduler alone would have
+        granted it)."""
+        c2 = Coordinator.attach(str(tmp_path / "coord.json"))
+        try:
+            assert coord.try_acquire_running(0, "t", cap=1)
+            assert not c2.try_acquire_running(1, "t", cap=1)
+            coord.release_running(0, "t")
+            assert c2.try_acquire_running(1, "t", cap=1)
+            assert coord.peak_running("t") == 1
+            c2.release_running(1, "t")
+        finally:
+            c2.close()
+
+    def test_vtime_shared_and_floor_reentry(self, coord):
+        coord.vtime_advance("a", 1.0)
+        coord.vtime_advance("a", 1.0)
+        # an idle tenant re-enters at the floor, not at zero credit
+        coord.vtime_advance("b", 0.5, floor=2.0)
+        vts = coord.vtimes(["a", "b"])
+        assert vts["a"] == pytest.approx(2.0)
+        assert vts["b"] == pytest.approx(2.5)
+
+    def test_lease_reclaim_zeroes_dead_slot_columns(self, coord):
+        """The crash invariant: a dead worker's running counts and HBM
+        charges are reclaimed by lease expiry — no orphaned WFQ weight
+        or tenant running-cap leak."""
+        coord.claim_slot(0)
+        assert coord.try_acquire_running(0, "t", cap=2)
+        coord.charge_hbm(0, "t", 4096)
+        time.sleep(0.02)
+        n = coord.reclaim_expired(0.01)
+        assert n == 1
+        assert coord.running_total("t") == 0
+        assert coord.hbm_remote_bytes("t", exclude_slot=3) == 0
+        assert coord.verify_drained()["ok"]
+        assert coord.counters()["fabric_lease_reclaims"] == 1
+
+    def test_dedup_lifecycle(self, coord):
+        kh = b"k" * 16
+        kind, idx, _ = coord.dedup_claim(kh, ttl_s=5.0)
+        assert kind == "lead"
+        assert coord.dedup_claim(kh, ttl_s=5.0)[0] == "wait"
+        rid = coord.next_result_id()
+        coord.dedup_publish(idx, kh, rid)
+        k2, _i2, r2 = coord.dedup_claim(kh, ttl_s=5.0)
+        assert (k2, r2) == ("hit", rid)
+        assert coord.dedup_poll(idx, kh) == ("done", rid)
+
+    def test_dedup_failed_lead_frees_waiters(self, coord):
+        kh = b"f" * 16
+        kind, idx, _ = coord.dedup_claim(kh, ttl_s=5.0)
+        coord.dedup_fail(idx, kh)
+        assert coord.dedup_poll(idx, kh)[0] == "gone"
+        # the next claimant takes the slot over
+        assert coord.dedup_claim(kh, ttl_s=5.0)[0] == "lead"
+
+    def test_dead_leader_building_slot_reclaimed(self, coord):
+        """A building entry owned by a crashed slot flips to FAILED on
+        reclaim, so waiters fall back to a local dispatch instead of
+        waiting out the full build lease."""
+        coord.claim_slot(2)
+        coord.set_claim_owner(2)
+        kh = b"d" * 16
+        kind, idx, _ = coord.dedup_claim(kh, ttl_s=5.0)
+        assert kind == "lead"
+        time.sleep(0.02)
+        coord.reclaim_expired(0.01)
+        assert coord.dedup_poll(idx, kh)[0] == "gone"
+        assert coord.verify_drained()["ok"]
+
+    def test_prewarm_claim_at_most_once(self, coord, tmp_path):
+        c2 = Coordinator.attach(str(tmp_path / "coord.json"))
+        try:
+            kh = b"p" * 16
+            assert coord.prewarm_claim(kh)
+            assert not c2.prewarm_claim(kh)
+            assert c2.counters()["fabric_prewarm_dedup"] == 1
+            # the claim is not a dedup lead/hit in the gauge sense
+            assert c2.counters()["fabric_dedup_hits"] == 0
+            assert c2.counters()["fabric_dedup_leads"] == 0
+        finally:
+            c2.close()
+
+
+class TestConnIds:
+    #: two "forked servers": each subprocess plays one fleet worker slot
+    #: and mints session ids through the REAL allocator
+    _WORKLOAD = r"""
+import json, sys
+from tidb_tpu.fabric import conn_id_base
+from tidb_tpu.session.session import Session
+from tidb_tpu.session import bootstrap_domain
+from tidb_tpu.kv import new_store
+
+slot = int(sys.argv[1])
+Session.set_conn_id_base(conn_id_base(slot))
+dom = bootstrap_domain(new_store())
+ids = []
+for _ in range(3):
+    s = dom.sessions and None
+from tidb_tpu.session import new_session
+for _ in range(3):
+    ids.append(new_session(dom).conn_id)
+print(json.dumps(ids))
+"""
+
+    def test_two_forked_servers_mint_disjoint_ids(self):
+        """The satellite acceptance: two worker processes can never
+        allocate the same conn id (KILL / slow-log attribution resolve
+        by id), and the minting slot is recoverable from any id."""
+        out = {}
+        for slot in (0, 1):
+            r = subprocess.run(
+                [sys.executable, "-c", self._WORKLOAD, str(slot)],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                capture_output=True, text=True, timeout=240, check=True)
+            import json
+            out[slot] = json.loads(r.stdout.strip().splitlines()[-1])
+        assert not set(out[0]) & set(out[1]), out
+        for slot, ids in out.items():
+            assert all(slot_of_conn_id(i) == slot for i in ids), out
+
+    def test_base_arithmetic(self):
+        assert conn_id_base(0) == 1 << CONN_SLOT_SHIFT
+        assert slot_of_conn_id(conn_id_base(3) + 17) == 3
+        assert slot_of_conn_id(42) is None  # non-fabric id
+        # the whole id must fit the MySQL handshake's u32 field
+        assert conn_id_base(200) + (1 << 23) < 2 ** 32
+
+
+class TestDedup:
+    def _mk_chunk(self, vals):
+        from tidb_tpu.sqltypes import FieldType, TYPE_LONG
+        from tidb_tpu.utils.chunk import Chunk, Column
+        return Chunk([Column(FieldType(tp=TYPE_LONG),
+                             np.asarray(vals, dtype=np.int64))])
+
+    def test_key_hash_binds_data_content(self, coord):
+        from tidb_tpu.fabric.dedup import Dedup
+        d = Dedup(coord, 0)
+        bk = ("agg", "sig", 1024)
+        h1 = d.key_hash(bk, (None, self._mk_chunk([1, 2, 3]), []))
+        h2 = d.key_hash(bk, (None, self._mk_chunk([1, 2, 3]), []))
+        h3 = d.key_hash(bk, (None, self._mk_chunk([1, 2, 4]), []))
+        assert h1 == h2
+        assert h1 != h3  # an INSERTed delta can never reuse a stale page
+        assert d.key_hash(("other", "sig", 1024),
+                          (None, self._mk_chunk([1, 2, 3]), [])) != h1
+        # no chunk in the args -> no data identity -> no dedup
+        assert d.key_hash(bk, (None, [], 7)) is None
+
+    def test_leader_publishes_follower_reuses(self, coord, tmp_path):
+        """Two attachments, one compute: the follower's compute fn must
+        NEVER run — it maps the leader's result page."""
+        from tidb_tpu.fabric.dedup import Dedup
+        c2 = Coordinator.attach(str(tmp_path / "coord.json"))
+        try:
+            d1, d2 = Dedup(coord, 0), Dedup(c2, 1)
+            res_chunk = self._mk_chunk([7, 8, 9])
+            kh = d1.key_hash(("agg", "s", 64),
+                             (self._mk_chunk([1, 2]),))
+            calls = []
+
+            def compute_leader():
+                calls.append("lead")
+                return res_chunk
+
+            def compute_follower():
+                calls.append("follow")
+                return self._mk_chunk([0])
+
+            out1 = d1.coalesce(None, "agg", kh, compute_leader)
+            out2 = d2.coalesce(None, "agg", kh, compute_follower)
+            assert calls == ["lead"]
+            assert out1.columns[0].data.tolist() == [7, 8, 9]
+            assert out2.columns[0].data.tolist() == [7, 8, 9]
+            assert c2.counters()["fabric_dedup_hits"] == 1
+            assert coord.verify_drained()["ok"]
+        finally:
+            c2.close()
+
+    def test_failing_leader_frees_the_slot(self, coord):
+        from tidb_tpu.fabric.dedup import Dedup
+        from tidb_tpu.ops.device import DeviceUnsupported
+        d = Dedup(coord, 0)
+        kh = b"x" * 16
+        with pytest.raises(DeviceUnsupported):
+            d.coalesce(None, "agg", kh,
+                       lambda: (_ for _ in ()).throw(
+                           DeviceUnsupported("degrade")))
+        # the slot is reclaimable, not wedged building
+        assert coord.verify_drained()["ok"]
+
+    def test_result_chunk_pickle_strips_device_slot(self):
+        """Fabric result pages must never smuggle another process's HBM
+        handles: the pickled Column carries material only."""
+        from tidb_tpu.sqltypes import FieldType, TYPE_LONG
+        from tidb_tpu.utils.chunk import Column
+        col = Column(FieldType(tp=TYPE_LONG), np.arange(4, dtype=np.int64))
+        state = col.__getstate__()
+        assert set(state) == {"ftype", "data", "nulls"}
+        col2 = pickle.loads(pickle.dumps(col))
+        assert col2.data.tolist() == [0, 1, 2, 3]
+        assert col2.value_at(2) == 2
+
+
+class TestSchedulerFleetHook:
+    def test_fleet_cap_crosses_scheduler_instances(self, coord):
+        """The in-process scheduler consults the segment: with the hook
+        installed and a fleet-wide cap of 1, a second admit for the same
+        tenant queues even though THIS process runs nothing."""
+        from tidb_tpu.executor import scheduler
+        from tidb_tpu.fabric.state import _SchedFleet
+        scheduler.set_fleet(_SchedFleet(coord, 0))
+        try:
+            # a peer process (slot 1) holds the tenant's only slot
+            assert coord.try_acquire_running(1, "default", cap=1)
+            with scheduler._LOCK:
+                assert not scheduler._try_acquire_locked("default", 1)
+            coord.release_running(1, "default")
+            with scheduler._LOCK:
+                assert scheduler._try_acquire_locked("default", 1)
+                scheduler._fleet_release_locked("default")
+        finally:
+            scheduler.set_fleet(None)
+        assert coord.verify_drained()["ok"]
+
+
+class TestResidencyFleetHook:
+    def test_remote_bytes_shrink_free_share(self, coord):
+        """free_share_bytes must see a tenant's bytes in SIBLING workers
+        (the hybrid join's partition sizing reads this)."""
+        from tidb_tpu.ops import residency
+        from tidb_tpu.fabric.state import _ResidencyFleet
+        residency.set_fleet(_ResidencyFleet(coord, 0))
+        try:
+            residency.set_budget(1 << 20)
+            base = residency.free_share_bytes("g")
+            assert base > 0
+            # the same tenant holds 512KB on ANOTHER worker (slot 1)
+            coord.charge_hbm(1, "g", 512 << 10)
+            shrunk = residency.free_share_bytes("g")
+            assert shrunk < base
+        finally:
+            residency.set_fleet(None)
+            residency.set_budget(0)
+
+
+@pytest.mark.chaos_threads
+class TestFleetProcessKill:
+    """The fabric-kill-worker chaos satellite, end to end with real
+    processes: SIGKILL mid-query -> clean classified client error,
+    parent respawn within the backoff budget, segment lease reclaimed
+    (zero orphaned counts), survivors serving throughout."""
+
+    def test_kill_respawn_reclaim_survivors(self, tmp_path):
+        from tidb_tpu.fabric.client import FleetClient, WireError
+        from tidb_tpu.fabric.fleet import Fleet
+        fleet = Fleet(
+            2, compile_server=False, run_dir=str(tmp_path / "fleet"),
+            slot_env={0: {"TIDB_TPU_FABRIC_FAILPOINTS":
+                          "fabric-kill-worker=1*return(1)"}})
+        fleet.start(timeout_s=240.0)
+        try:
+            old_pid = fleet.worker_pid(0)
+            c0 = FleetClient(fleet.direct_port(0))
+            t0 = time.monotonic()
+            with pytest.raises(WireError):
+                # the first query trips the failpoint: SIGKILL mid-query
+                c0.must_query("select 1")
+            # survivor serves while the corpse is reclaimed
+            c1 = FleetClient(fleet.direct_port(1))
+            assert c1.must_query("select 41+1")[1] == [("42",)]
+            c1.close()
+            assert fleet.wait_respawn(0, old_pid, 30.0), (
+                "no respawn within the backoff budget")
+            respawn_s = time.monotonic() - t0
+            assert respawn_s < 30.0
+            assert fleet.respawns == 1
+            # the respawned incarnation serves (failpoint NOT re-armed)
+            c0b = FleetClient(fleet.direct_port(0))
+            assert c0b.must_query("select 2")[1] == [("2",)]
+            assert c0b.slot == 0
+            c0b.close()
+            counters = fleet.coord.counters()
+            assert counters["fabric_lease_reclaims"] >= 1
+        finally:
+            drained = fleet.shutdown()
+        assert drained and drained["ok"], drained

@@ -1052,6 +1052,31 @@ class TestConfinementRules:
         assert {f.ident for f in out} == {
             "run_device@a", "_with_pipe_stats@c"}
 
+    def test_shared_memory_confinement(self):
+        """Every way of reaching multiprocessing.shared_memory outside
+        tidb_tpu/fabric/ is a finding; the fabric package itself is the
+        sanctioned layer (rule config, like the other confinements)."""
+        imp_from = ("from multiprocessing import shared_memory\n"
+                    "def f():\n"
+                    "    return shared_memory.SharedMemory(name='x')\n")
+        imp_mod = ("import multiprocessing.shared_memory\n"
+                   "def g():\n"
+                   "    return multiprocessing.shared_memory\n")
+        ctor = ("def h():\n    return SharedMemory(name='x', create=True)\n")
+        out = run_one("shared-memory-confinement",
+                      {"executor/rogue.py": imp_from})
+        assert any(f.ident.startswith("shm-import@") for f in out)
+        assert any(f.ident.startswith("shm-ctor@") for f in out)
+        out = run_one("shared-memory-confinement", {"ops/x.py": imp_mod})
+        assert any(f.ident.startswith("shm-import@") for f in out)
+        assert any(f.ident.startswith("shm-attr@") for f in out)
+        out = run_one("shared-memory-confinement", {"session/y.py": ctor})
+        assert [f.ident for f in out] == ["shm-ctor@h"]
+        # the fabric package is the sanctioned coordination layer
+        assert run_one("shared-memory-confinement",
+                       {"fabric/coord.py": imp_from + imp_mod + ctor}) \
+            == []
+
 
 # -- the tier-1 gate: full-repo run is clean ----------------------------------
 

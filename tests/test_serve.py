@@ -19,6 +19,34 @@ from tidb_tpu.testkit import TestKit  # noqa: E402
 
 
 @pytest.mark.chaos_threads
+def test_bench_serve_fleet_smoke():
+    """The ISSUE 14 fleet acceptance: `bench_serve.py --procs 4 --smoke`
+    green — 4 worker processes + the separated compile server behind one
+    SO_REUSEPORT port, with (a) the CROSS-process starved-tenant WFQ
+    regression (light tenant p99 on worker B below the heavy tenant's
+    p50 flooding worker A, fleet-wide cap never exceeded), (b) the fleet
+    fragment-dedup counter moving under concurrent identical OLAP
+    fragments on two workers, and (c) a process-kill chaos seed
+    completing with respawn and ZERO coordination-segment lease/ticket
+    leaks.  run_fleet raises on any violation; assertions here pin the
+    summary shape."""
+    emitted = []
+    summary = bench_serve.run_fleet(procs=4, n_threads=8, n_ops=3,
+                                    sf=0.002, seed=0, chaos=True,
+                                    emit=emitted.append)
+    assert summary["violations"] == 0
+    assert summary["dedup_hits"] > 0
+    assert summary["peak_running_heavy"] <= 1
+    assert summary["p99_light_s"] < max(summary["p50_heavy_s"], 0.05)
+    drained = [e for e in emitted if e["metric"] == "fleet_drained"]
+    assert drained and drained[0]["ok"]
+    # per-process AND fleet-aggregate latency lines were emitted
+    lat = [e for e in emitted if e["metric"] == "fleet_latency_ms"]
+    assert any(e["slot"] == "all" for e in lat)
+    assert any(isinstance(e["slot"], int) for e in lat)
+
+
+@pytest.mark.chaos_threads
 def test_bench_serve_smoke_fixed_seed():
     """Fixed-seed tier-1 smoke of the full serving bench: 8 client
     threads (the acceptance floor), chaos ON — run_serve raises on any

@@ -243,6 +243,28 @@ def _fence_gen() -> int:
         return 0
 
 
+def _remote_serve(key, build, spec, shape, sig, _tsp=None) -> tuple:
+    """Resolve a cold pipeline through the separated compile server
+    (tidb_tpu/fabric/compile_client.py) when one is configured:
+    ``(fn, None)`` on success (artifact deserialize or remote compile),
+    ``(None, classified_error)`` when the remote path failed — the
+    caller builds inline and charges the compile breaker — and
+    ``(None, None)`` when there is no server / nothing exportable."""
+    try:
+        from ..fabric.compile_client import get_client
+        cli = get_client()
+    except Exception as e:  # noqa: BLE001 — remote is an optimization
+        log.warning("fabric compile client unavailable (building "
+                    "locally): %s", e)
+        return None, None
+    if cli is None:
+        return None, None
+    fn, err = cli.serve(key, build, spec, shape, sig)
+    if _tsp is not None and fn is not None:
+        _tsp.tags["remote"] = True
+    return fn, err
+
+
 def _spec_of(args):
     """args pytree (concrete arrays / scalars) → ShapeDtypeStruct pytree.
     Derived at submit time so the job never pins the query's real data.
@@ -594,11 +616,18 @@ def _obtain_impl(key, build, dict_refs, ctx, args, spec, shape, sig,
 
     # sync path (async off, no shape spec, or a persistent-index hit —
     # the XLA artifact comes off disk, so inline is a deserialize)
+    remote_err = None
     try:
         # chaos hook: a compile-fail here models the remote-compile
         # service refusing/failing the build on the query path
         failpoint.inject("device-compile")
-        fn = build()
+        fn, remote_err = _remote_serve(key, build, spec, shape, sig, _tsp)
+        if fn is None:
+            # no compile server, its shape can't export, or the remote
+            # path just failed (remote_err set): build INLINE — the
+            # separated compile server degrades to local compilation,
+            # never to a failed query
+            fn = build()
     except DeviceUnsupported:
         br.release_probe(session=sid)
         raise
@@ -625,7 +654,17 @@ def _obtain_impl(key, build, dict_refs, ctx, args, spec, shape, sig,
         raise DeviceUnsupported(
             f"device compile failed ({cls}): {e} "
             f"({shape} fragment degraded to host engine)") from err
-    br.record_success(session=sid)
+    if remote_err is not None:
+        # the inline build saved the query, but the 9010 breaker must
+        # still see the REMOTE failure: enough of these open the compile
+        # circuit and obtains degrade up front until the half-open probe
+        # finds the server again — a dead compile server degrades
+        # workers to inline/host compile, never to failed queries
+        br.record_failure(remote_err, session=sid, group=group)
+        with _LOCK:
+            _LAST_ERROR[0] = f"remote: {remote_err}"
+    else:
+        br.record_success(session=sid)
     from .device_exec import _pipe_cache_put
     _pipe_cache_put(key, fn, dict_refs)
     with _LOCK:
@@ -679,6 +718,22 @@ def _do_compile(job: "_Job"):
     prev = mark_bg_thread()
     try:
         failpoint.inject("device-compile")
+        if job.build is not None and job.spec is not None:
+            # separated compile server first (when configured): the
+            # worker traces, the server pays the XLA compile.  A remote
+            # failure logs + counts and falls through to the local
+            # build — background jobs already serve host-side, so the
+            # right degradation is inline compile, not a failed job.
+            fn, rerr = _remote_serve(job.cache_key, job.build, job.spec,
+                                     job.shape, job.sig)
+            if fn is not None:
+                fn(*_zeros_of(job.spec))
+                return fn
+            if rerr is not None:
+                log.warning("bg compile: remote path failed, building "
+                            "inline: %s", rerr)
+                with _LOCK:
+                    _LAST_ERROR[0] = f"remote: {rerr}"
         fn = (job.build() if job.build is not None
               else _cached_fn(job.cache_key))
         if fn is None:
@@ -839,6 +894,26 @@ def _finish_job(job: "_Job", failed: bool = False, discarded: bool = False,
 
 # -- prewarm ------------------------------------------------------------------
 
+def _prewarm_claim_fleet(jkey) -> bool:
+    """Fleet-wide prewarm dedup (ISSUE 14): N workers prewarming the
+    same recipe ladder should trace each rung ONCE across the fleet —
+    the persistent pipe-index already dedupes the XLA work, but the
+    trace + warm dispatch are per-process; the coordination segment's
+    claim makes the submission itself at-most-once.  Always True outside
+    a fleet."""
+    try:
+        from ..fabric import state as fabric_state
+        coord = fabric_state.coordinator()
+        if coord is None:
+            return True
+        ident = hashlib.blake2b(repr(jkey).encode(),
+                                digest_size=16).digest()
+        return coord.prewarm_claim(ident)
+    except Exception as e:  # noqa: BLE001 — dedup is best-effort
+        log.warning("fleet prewarm claim failed (warming locally): %s", e)
+        return True
+
+
 def prewarm(ctx=None, ladder_up: int = 2, max_recipes: int = 32,
             wait: bool = False, timeout_s: float = 120.0) -> dict:
     """Background-compile the bucket ladder for the hot recipes: for each
@@ -883,6 +958,8 @@ def prewarm(ctx=None, ladder_up: int = 2, max_recipes: int = 32,
             # install a new fn) keep a ladder-scoped key per bucket.
             jkey = (rec.key if build is not None
                     else (rec.key, ("ladder", _base_bucket(spec))))
+            if not _prewarm_claim_fleet(jkey):
+                continue  # another worker is already warming this rung
             with _LOCK:
                 if jkey in _JOBS or rec.key in _JOBS:
                     continue

@@ -82,7 +82,32 @@ def run_device(ctx, fn, /, *args, shape="agg", batch_key=None, **kw):
     giant intermediate) costs one re-upload instead of a cooldown.
 
     `shape` scopes the breaker per fragment class (agg / join / window):
-    one failing shape cools down without degrading healthy paths."""
+    one failing shape cools down without degrading healthy paths.
+
+    Under the serving fabric (tidb_tpu/fabric) a batch_key'd dispatch
+    first consults the FLEET fragment-dedup table: identical concurrent
+    fragments — same structural batch key AND same input-chunk content
+    hash — anywhere in the fleet dispatch ONE device call; followers
+    wait (before admission, so they hold no device slot) and map the
+    leader's result page back in.  No fleet, no batch key, or no
+    hashable input -> the plain dispatch below."""
+    if batch_key is not None:
+        from ..fabric import state as fabric_state
+        ded = fabric_state.dedup_handle()
+        if ded is not None:
+            kh = ded.key_hash(batch_key, args)
+            if kh is not None:
+                return ded.coalesce(
+                    ctx, shape, kh,
+                    lambda: _run_device_dispatch(ctx, fn, args, kw, shape,
+                                                 batch_key))
+    return _run_device_dispatch(ctx, fn, args, kw, shape, batch_key)
+
+
+def _run_device_dispatch(ctx, fn, args, kw, shape, batch_key):
+    """The admitted dispatch (layer 1 onward) for one fragment — the
+    fabric dedup leader's compute path, and the whole of run_device
+    outside a fleet."""
     from ..errors import DeviceAdmissionError
     from ..session import tracing
     from . import scheduler

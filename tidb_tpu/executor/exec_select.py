@@ -124,6 +124,12 @@ class QueryExecutor:
             # executable still compiling behind the host result"
             from . import compile_service
             self.annotate(**compile_service.report_gauges())
+            # serving fabric (tidb_tpu/fabric/state.py): live worker
+            # count plus fragment-dedup / remote-compile counters —
+            # "did this query's fragment ride a fleet peer's device
+            # call".  Empty (no annotation noise) outside a fleet.
+            from ..fabric import state
+            self.annotate(**state.report_gauges())
         return out
 
 

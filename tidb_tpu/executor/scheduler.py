@@ -120,6 +120,62 @@ _BATCH_CAP_HEADROOM = 4
 _CFG = {"depth": 64, "timeout_s": 5.0, "cap": 4, "weights": {}}
 _CFG_RAW_WEIGHTS = [""]
 
+#: the serving fabric's fleet hook (tidb_tpu/fabric/state.py installs a
+#: _SchedFleet at worker boot): per-tenant running caps become
+#: FLEET-wide (an atomic check+charge against the coordination segment)
+#: and the WFQ virtual clocks are read from / advanced in the segment,
+#: so a tenant flooding process A yields device time to a light tenant
+#: on process B.  None in the ordinary single-process deployment — every
+#: path below degrades to the local state.  Lock order: the segment's
+#: flock nests INSIDE _LOCK; the segment layer never calls back out.
+_FLEET = [None]
+
+
+def set_fleet(hook):
+    """Install (or clear, with None) the fleet coordination hook."""
+    with _LOCK:
+        _FLEET[0] = hook
+
+
+#: _try_acquire_locked outcomes: refused / granted from local caps only
+#: / granted WITH a fleet segment charge (the release side must mirror
+#: exactly — releasing a charge this grant never took would eat another
+#: in-flight fragment's, and the fleet cap would silently overshoot)
+ACQ_NO, ACQ_LOCAL, ACQ_FLEET = 0, 1, 2
+
+
+def _try_acquire_locked(group: str, cap: int) -> int:
+    """One admission slot for `group` under the effective cap — local
+    counts alone without a fleet, atomic segment check+charge with one.
+    The local pre-filter keeps the common saturated case off the
+    cross-process lock.  Returns ACQ_NO / ACQ_LOCAL / ACQ_FLEET; a
+    ticket granted ACQ_FLEET must release the segment charge too
+    (Ticket.fleet_charged drives release())."""
+    fleet = _FLEET[0]
+    if fleet is None:
+        return (ACQ_LOCAL if cap <= 0 or _RUNNING[group] < cap
+                else ACQ_NO)
+    if cap > 0 and _RUNNING[group] >= cap:
+        return ACQ_NO
+    try:
+        return ACQ_FLEET if fleet.try_acquire(group, cap) else ACQ_NO
+    except Exception:
+        log.warning("fleet admission hook failed; using local caps",
+                    exc_info=True)
+        return (ACQ_LOCAL if cap <= 0 or _RUNNING[group] < cap
+                else ACQ_NO)
+
+
+def _fleet_release_locked(group: str):
+    fleet = _FLEET[0]
+    if fleet is not None:
+        try:
+            fleet.release(group)
+        except Exception as e:  # noqa: BLE001 — lease expiry reclaims it
+            log.warning("fleet release hook failed for group %r "
+                        "(segment lease reclaim will zero it): %s",
+                        group, e)
+
 STATS = {
     "admitted": 0,          # tickets granted (fast path + scheduled)
     "fast_grants": 0,       # granted inline without queueing
@@ -162,7 +218,7 @@ class Ticket:
     """One admitted-or-queued device fragment."""
 
     __slots__ = ("seq", "group", "shape", "batch_key", "state",
-                 "granted", "batched", "enqueued_at")
+                 "granted", "batched", "enqueued_at", "fleet_charged")
 
     def __init__(self, group, shape, batch_key):
         self.seq = next(_SEQ)
@@ -173,6 +229,7 @@ class Ticket:
         self.granted = threading.Event()
         self.batched = False      # granted as a follower on a shared key
         self.enqueued_at = 0.0
+        self.fleet_charged = False  # this grant charged the segment
 
 
 # -- config ------------------------------------------------------------------
@@ -309,9 +366,13 @@ def _admit_impl(ctx, shape, batch_key, _tsp):
         if fp_wait_ms >= 1.0:
             STATS["sched_admission_waits_ms"] += fp_wait_ms
         cap = _cap()
-        if _QUEUED_N[0] == 0 and (cap <= 0 or _RUNNING[group] < cap):
+        acq = (_try_acquire_locked(group, cap) if _QUEUED_N[0] == 0
+               else ACQ_NO)
+        if acq:
             # fast path: nothing waiting anywhere and the tenant has a
-            # free slot — grant inline, no scheduler-thread handoff
+            # free slot (FLEET-wide under the fabric) — grant inline, no
+            # scheduler-thread handoff
+            ticket.fleet_charged = acq == ACQ_FLEET
             ticket.state = RUNNING
             ticket.granted.set()
             _RUNNING[group] += 1
@@ -401,6 +462,8 @@ def _admit_impl(ctx, shape, batch_key, _tsp):
                     if _RUNNING[ticket.group] <= 0:
                         del _RUNNING[ticket.group]
                         _prune_group_locked(ticket.group)
+                    if ticket.fleet_charged:
+                        _fleet_release_locked(ticket.group)
                     _WAKE.notify_all()
             else:
                 try:
@@ -433,6 +496,8 @@ def release(ticket: "Ticket | None"):
         if _RUNNING[ticket.group] <= 0:
             del _RUNNING[ticket.group]
             _prune_group_locked(ticket.group)
+        if ticket.fleet_charged:
+            _fleet_release_locked(ticket.group)
         _WAKE.notify_all()
 
 
@@ -460,39 +525,72 @@ def _sched_loop():
     while True:
         with _WAKE:
             while not _grant_some_locked():
-                _WAKE.wait(1.0)
+                # under the fabric a peer process's release() cannot
+                # notify this condition — poll on a short tick so a
+                # freed fleet-wide slot is granted within ~one tick
+                _WAKE.wait(0.05 if _FLEET[0] is not None else 1.0)
         _publish_gauges()
 
 
 def _eligible_locked():
     """Groups with queued tickets and a free running slot, ordered by WFQ
-    virtual time (lowest first)."""
+    virtual time (lowest first).  Under the fabric the ordering clock is
+    the FLEET's (coordination segment), so two processes draining the
+    same tenants interleave as one fair queue; the local-cap check stays
+    a pre-filter and the authoritative fleet-cap check happens at grant
+    time (_try_acquire_locked)."""
     cap = _cap()
-    out = []
-    for g, q in _QUEUES.items():
-        if q and (cap <= 0 or _RUNNING[g] < cap):
-            out.append((_VTIME.get(g, 0.0), g))
-    out.sort()
-    return [g for _vt, g in out]
+    cands = [g for g, q in _QUEUES.items()
+             if q and (cap <= 0 or _RUNNING[g] < cap)]
+    fleet = _FLEET[0]
+    if fleet is not None and cands:
+        try:
+            vts = fleet.vtimes(cands)
+        except Exception as e:  # noqa: BLE001 — fall back to local clocks
+            log.warning("fleet vtimes unavailable (local WFQ order): %s",
+                        e)
+            vts = {g: _VTIME.get(g, 0.0) for g in cands}
+    else:
+        vts = {g: _VTIME.get(g, 0.0) for g in cands}
+    return [g for _vt, g in sorted((vts[g], g) for g in cands)], vts
 
 
 def _grant_some_locked() -> bool:
     """Grant the WFQ-next queued ticket (plus its batch-key followers).
     Returns True when anything was granted (caller re-loops), False when
-    the queue is empty or every queued group is at its cap."""
-    elig = _eligible_locked()
-    if not elig:
+    the queue is empty or every queued group is at its (fleet-wide) cap."""
+    elig, vts = _eligible_locked()
+    cap = _cap()
+    group = None
+    acq = ACQ_NO
+    for g in elig:
+        # WFQ order, but the grant only lands if the group clears the
+        # fleet-wide cap (a peer process may hold every slot) — the next
+        # eligible group gets its chance rather than head-of-line block
+        acq = _try_acquire_locked(g, cap)
+        if acq:
+            group = g
+            break
+    if group is None:
         return False
-    group = elig[0]
     leader = _QUEUES[group].popleft()
+    leader.fleet_charged = acq == ACQ_FLEET
     _QUEUED_N[0] -= 1
     _prune_group_locked(group)
     # virtual-time WFQ: one grant advances the tenant's clock by
     # 1/weight; an idle tenant re-enters at the current floor so a long
     # sleep never banks unbounded credit against the active tenants
-    floor = min((_VTIME.get(g, 0.0) for g, q in _QUEUES.items() if q),
-                default=_VTIME.get(group, 0.0))
-    _VTIME[group] = max(_VTIME.get(group, 0.0), floor) + 1.0 / _weight(group)
+    floor = min((vts.get(g, _VTIME.get(g, 0.0))
+                 for g, q in _QUEUES.items() if q),
+                default=vts.get(group, _VTIME.get(group, 0.0)))
+    delta = 1.0 / _weight(group)
+    fleet = _FLEET[0]
+    if fleet is not None:
+        try:
+            fleet.advance(group, delta, floor)
+        except Exception as e:  # noqa: BLE001 — local clock still moves
+            log.warning("fleet vtime advance failed for %r: %s", group, e)
+    _VTIME[group] = max(_VTIME.get(group, 0.0), floor) + delta
     _grant_locked(leader, batched=False)
     if leader.batch_key is not None:
         # small-fragment batching: queued tickets sharing the leader's
@@ -502,13 +600,17 @@ def _grant_some_locked() -> bool:
         # batched fragments still dispatch individually, so followers
         # stop at a small headroom over the tenant cap — a 50-deep flood
         # of identical fragments must not occupy 50 device slots
-        cap = _cap()
         for g, q in list(_QUEUES.items()):
             followers = [t for t in q if t.batch_key == leader.batch_key]
             for t in followers:
                 if (cap > 0 and _RUNNING[t.group]
                         >= cap * _BATCH_CAP_HEADROOM):
                     break
+                facq = _try_acquire_locked(
+                    t.group, cap * _BATCH_CAP_HEADROOM if cap > 0 else 0)
+                if not facq:
+                    break
+                t.fleet_charged = facq == ACQ_FLEET
                 q.remove(t)
                 _QUEUED_N[0] -= 1
                 _grant_locked(t, batched=True)

@@ -1,0 +1,621 @@
+"""The fleet coordination segment: one shared-memory block + a tiny
+lease-stamped coordinator file, giving N serving processes a common view
+of admission state, tenant budgets and in-flight fragment dedup.
+
+Why shared memory and not a coordination service: the hot operations are
+admission-rate (one per device fragment) — a socket round trip per
+admission would put a second serving queue in front of the scheduler.
+A pinned struct layout over ``multiprocessing.shared_memory`` plus an
+``fcntl.flock`` critical section costs ~a syscall pair per operation and
+survives any worker's death: the flock drops with the process, and the
+lease stamps let survivors reclaim the dead slot's counters.
+
+Layout (little-endian, fixed offsets — no allocation after create):
+
+    HEADER    magic, nslots, ntenants, ndedup, created
+    COUNTERS  fleet-global u64 counters (dedup hits/leads/timeouts,
+              lease reclaims, respawns, prewarm dedup, result-id seq)
+    SLOTS     per-worker lease: pid, lease_ts, generation
+    TENANTS   per-tenant row: name, WFQ virtual clock, peak running,
+              running[slot] and hbm_bytes[slot] COLUMNS — per-slot
+              attribution is what makes crash reclaim exact: zeroing a
+              dead slot's column cannot touch a survivor's counts
+    DEDUP     fragment-dedup slots: key hash, state, owner slot,
+              timestamp, result page id
+
+Every mutation happens under the sidecar lock file (``<path>.lock``,
+``fcntl.flock``) plus an in-process mutex (flock is per open file
+description, so two THREADS of one process sharing the fd would not
+exclude each other).  Lock order: callers holding subsystem locks
+(scheduler._LOCK, the residency ledger lock) may take the segment lock;
+the segment layer never calls back out, so no cycle can form.
+
+The coordinator FILE is the discovery root: it names the segment and the
+result-page directory, so any process (workers, the parent, a bench
+verifier) can ``attach`` by path alone.  Invariant (chaos-asserted,
+:meth:`Coordinator.verify_drained`): once the fleet drains, no lease is
+live, every per-tenant running count is zero and no dedup slot is stuck
+``building`` — a crashed worker's contributions are reclaimed by lease
+expiry, never leaked.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import logging
+import os
+import secrets
+import struct
+import threading
+import time
+from multiprocessing import shared_memory
+
+log = logging.getLogger("tidb_tpu.fabric.coord")
+
+MAGIC = b"TPUFAB1\0"
+
+#: segment geometry defaults (fixed at create; attach reads them from the
+#: coordinator file)
+NSLOTS_DEFAULT = 16
+NTENANTS_DEFAULT = 48
+NDEDUP_DEFAULT = 128
+
+#: fleet-global counter names, in segment order
+COUNTER_NAMES = (
+    "fabric_dedup_hits",        # follower served from a leader's page
+    "fabric_dedup_leads",       # fragments that led a dedup slot
+    "fabric_dedup_timeouts",    # waits that gave up and computed locally
+    "fabric_lease_reclaims",    # dead-slot reclaims (leases expired)
+    "fabric_respawns",          # parent worker respawns
+    "fabric_prewarm_dedup",     # prewarm submissions skipped fleet-wide
+    "_result_id_seq",           # monotonic dedup result-page id
+)
+
+#: dedup slot states
+DFREE, DBUILDING, DDONE, DFAILED = 0, 1, 2, 3
+
+#: a building dedup entry whose leader lease is older than this is
+#: considered abandoned (leader crashed mid-build) and can be taken over
+BUILD_LEASE_S = 10.0
+
+_HDR = struct.Struct("<8sIIIId")                         # + created f64
+_SLOT = struct.Struct("<QdQ")                            # pid, lease, gen
+_DED = struct.Struct("<16sIIdQ")                         # hash,state,owner,ts,rid
+_TEN_FIXED = struct.Struct("<40sdII")                    # name,vtime,peak,pad
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+_NAME_SZ = 40
+
+
+class Coordinator:
+    """One attached view of the fleet coordination segment."""
+
+    def __init__(self, path: str, shm, meta: dict, created: bool):
+        self.path = path
+        self._shm = shm
+        self._buf = shm.buf
+        self.nslots = meta["nslots"]
+        self.ntenants = meta["ntenants"]
+        self.ndedup = meta["ndedup"]
+        self.pages_dir = meta["pages_dir"]
+        self._created = created
+        self._tlock = threading.Lock()
+        self._lockf = open(path + ".lock", "a+b")  # noqa: SIM115 (held open)
+        # offsets
+        self._o_counters = _HDR.size
+        self._o_slots = self._o_counters + 8 * len(COUNTER_NAMES)
+        self._o_tenants = self._o_slots + self.nslots * _SLOT.size
+        self._ten_sz = (_TEN_FIXED.size + 4 * self.nslots
+                        + 8 * self.nslots)
+        self._o_dedup = self._o_tenants + self.ntenants * self._ten_sz
+        self.size = self._o_dedup + self.ndedup * _DED.size
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def create(cls, path: str, nslots: int = NSLOTS_DEFAULT,
+               ntenants: int = NTENANTS_DEFAULT,
+               ndedup: int = NDEDUP_DEFAULT,
+               pages_dir: "str | None" = None) -> "Coordinator":
+        """Create the segment + coordinator file (the fleet parent)."""
+        if pages_dir is None:
+            pages_dir = path + ".pages"
+        os.makedirs(pages_dir, exist_ok=True)
+        name = f"tpufab-{os.getpid()}-{secrets.token_hex(4)}"
+        meta = {"segment": name, "nslots": nslots, "ntenants": ntenants,
+                "ndedup": ndedup, "pages_dir": pages_dir,
+                "created": time.time()}
+        size = (_HDR.size + 8 * len(COUNTER_NAMES) + nslots * _SLOT.size
+                + ntenants * (_TEN_FIXED.size + 12 * nslots)
+                + ndedup * _DED.size)
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        _untrack(shm)
+        shm.buf[:size] = b"\0" * size
+        _HDR.pack_into(shm.buf, 0, MAGIC, nslots, ntenants, ndedup, 0,
+                       meta["created"])
+        tmp = path + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+        return cls(path, shm, meta, created=True)
+
+    @classmethod
+    def attach(cls, path: str) -> "Coordinator":
+        """Attach to an existing segment by coordinator-file path."""
+        with open(path) as f:
+            meta = json.load(f)
+        shm = shared_memory.SharedMemory(name=meta["segment"])
+        _untrack(shm)
+        if bytes(shm.buf[:8]) != MAGIC:
+            shm.close()
+            raise ValueError(f"{path}: segment {meta['segment']} has no "
+                             "fabric magic (stale coordinator file?)")
+        return cls(path, shm, meta, created=False)
+
+    def close(self):
+        try:
+            self._buf = None
+            self._shm.close()
+        finally:
+            with contextlib.suppress(Exception):
+                self._lockf.close()
+
+    def unlink(self):
+        """Destroy the segment + coordinator file (parent, at shutdown).
+        Raw shm_unlink, not SharedMemory.unlink(): every attachment was
+        untracked (see _untrack), so the tracker holds no entry for its
+        unregister call to find."""
+        name = self._shm._name
+        self.close()
+        with contextlib.suppress(Exception):
+            shared_memory._posixshmem.shm_unlink(name)
+        for p in (self.path, self.path + ".lock"):
+            with contextlib.suppress(OSError):
+                os.remove(p)
+        # every remaining result page goes with the segment (pages that
+        # expired in place were GC'd at slot reuse; this is the tail)
+        with contextlib.suppress(OSError):
+            for f in os.listdir(self.pages_dir):
+                if f.startswith("dedup-"):
+                    with contextlib.suppress(OSError):
+                        os.remove(os.path.join(self.pages_dir, f))
+            os.rmdir(self.pages_dir)
+
+    @contextlib.contextmanager
+    def _locked(self):
+        with self._tlock:
+            fcntl.flock(self._lockf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(self._lockf, fcntl.LOCK_UN)
+
+    # -- counters ------------------------------------------------------------
+
+    def _ctr_off(self, name: str) -> int:
+        return self._o_counters + 8 * COUNTER_NAMES.index(name)
+
+    def bump(self, name: str, n: int = 1) -> int:
+        with self._locked():
+            return self._bump_locked(name, n)
+
+    def _bump_locked(self, name: str, n: int = 1) -> int:
+        off = self._ctr_off(name)
+        v = _U64.unpack_from(self._buf, off)[0] + n
+        _U64.pack_into(self._buf, off, v)
+        return v
+
+    def counters(self) -> dict:
+        with self._locked():
+            return {name: _U64.unpack_from(self._buf, self._ctr_off(name))[0]
+                    for name in COUNTER_NAMES if not name.startswith("_")}
+
+    # -- worker slots / leases -----------------------------------------------
+
+    def _slot_off(self, slot: int) -> int:
+        if not 0 <= slot < self.nslots:
+            raise IndexError(f"slot {slot} out of range 0..{self.nslots - 1}")
+        return self._o_slots + slot * _SLOT.size
+
+    def claim_slot(self, slot: int, pid: "int | None" = None):
+        """A worker takes its slot: stamps pid + lease, bumps the
+        incarnation generation, and zeroes any leftovers from a previous
+        incarnation (self-reclaim on respawn)."""
+        pid = pid if pid is not None else os.getpid()
+        with self._locked():
+            off = self._slot_off(slot)
+            _pid, _lease, gen = _SLOT.unpack_from(self._buf, off)
+            self._zero_slot_columns_locked(slot)
+            _SLOT.pack_into(self._buf, off, pid, time.time(), gen + 1)
+
+    def heartbeat(self, slot: int):
+        with self._locked():
+            off = self._slot_off(slot)
+            pid, _lease, gen = _SLOT.unpack_from(self._buf, off)
+            if pid:
+                _SLOT.pack_into(self._buf, off, pid, time.time(), gen)
+
+    def release_slot(self, slot: int):
+        """Clean worker exit: drop the lease and every per-slot count."""
+        with self._locked():
+            self._zero_slot_columns_locked(slot)
+            _SLOT.pack_into(self._buf, self._slot_off(slot), 0, 0.0, 0)
+
+    def live_slots(self, lease_timeout_s: float = 2.0) -> list:
+        now = time.time()
+        with self._locked():
+            out = []
+            for s in range(self.nslots):
+                pid, lease, _g = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))
+                if pid and now - lease <= lease_timeout_s:
+                    out.append(s)
+            return out
+
+    def reclaim_expired(self, lease_timeout_s: float = 2.0) -> int:
+        """Reclaim every slot whose lease lapsed: zero its running/HBM
+        columns (no orphaned WFQ weight or tenant running-cap leak), free
+        its building dedup slots, drop the lease.  Any process may call
+        this — the parent does on child death, workers do periodically."""
+        now = time.time()
+        n = 0
+        with self._locked():
+            for s in range(self.nslots):
+                off = self._slot_off(s)
+                pid, lease, _g = _SLOT.unpack_from(self._buf, off)
+                if pid and now - lease > lease_timeout_s:
+                    self._zero_slot_columns_locked(s)
+                    _SLOT.pack_into(self._buf, off, 0, 0.0, 0)
+                    self._bump_locked("fabric_lease_reclaims")
+                    n += 1
+        return n
+
+    def _zero_slot_columns_locked(self, slot: int):
+        for t in range(self.ntenants):
+            base = self._o_tenants + t * self._ten_sz
+            name = bytes(self._buf[base:base + _NAME_SZ]).rstrip(b"\0")
+            if not name:
+                continue
+            _U32.pack_into(self._buf, base + _TEN_FIXED.size + 4 * slot, 0)
+            _U64.pack_into(self._buf, base + _TEN_FIXED.size
+                           + 4 * self.nslots + 8 * slot, 0)
+        for d in range(self.ndedup):
+            off = self._o_dedup + d * _DED.size
+            h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
+            if state == DBUILDING and owner == slot:
+                _DED.pack_into(self._buf, off, h, DFAILED, owner, ts, rid)
+
+    # -- tenants -------------------------------------------------------------
+
+    def _ten_name(self, t: int) -> bytes:
+        base = self._o_tenants + t * self._ten_sz
+        return bytes(self._buf[base:base + _NAME_SZ]).rstrip(b"\0")
+
+    def _tenant_idx_locked(self, group: str, alloc: bool) -> int:
+        key = group.encode("utf-8")[:_NAME_SZ - 1]
+        free = -1
+        for t in range(self.ntenants):
+            name = self._ten_name(t)
+            if name == key:
+                return t
+            if not name and free < 0:
+                free = t
+        if not alloc:
+            return -1
+        if free < 0:
+            return -1  # table full: callers fall back to local-only state
+        base = self._o_tenants + free * self._ten_sz
+        _TEN_FIXED.pack_into(self._buf, base, key, 0.0, 0, 0)
+        return free
+
+    def _run_off(self, t: int, slot: int) -> int:
+        return (self._o_tenants + t * self._ten_sz + _TEN_FIXED.size
+                + 4 * slot)
+
+    def _hbm_off(self, t: int, slot: int) -> int:
+        return (self._o_tenants + t * self._ten_sz + _TEN_FIXED.size
+                + 4 * self.nslots + 8 * slot)
+
+    def _running_total_locked(self, t: int) -> int:
+        return sum(_U32.unpack_from(self._buf, self._run_off(t, s))[0]
+                   for s in range(self.nslots))
+
+    # admission: fleet-wide per-tenant running caps --------------------------
+
+    def try_acquire_running(self, slot: int, group: str,
+                            cap: int) -> bool:
+        """Atomically check the FLEET-wide running count of `group`
+        against `cap` and charge one fragment to `slot` when under it.
+        cap <= 0 means unlimited (still counted, for gauges)."""
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=True)
+            if t < 0:
+                return True  # tenant table full: degrade to local caps
+            total = self._running_total_locked(t)
+            if cap > 0 and total >= cap:
+                return False
+            off = self._run_off(t, slot)
+            _U32.pack_into(self._buf, off,
+                           _U32.unpack_from(self._buf, off)[0] + 1)
+            base = self._o_tenants + t * self._ten_sz
+            _n, vt, peak, pad = _TEN_FIXED.unpack_from(self._buf, base)
+            if total + 1 > peak:
+                _TEN_FIXED.pack_into(self._buf, base, _n, vt, total + 1,
+                                     pad)
+            return True
+
+    def release_running(self, slot: int, group: str):
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=False)
+            if t < 0:
+                return
+            off = self._run_off(t, slot)
+            cur = _U32.unpack_from(self._buf, off)[0]
+            if cur > 0:
+                _U32.pack_into(self._buf, off, cur - 1)
+
+    def running_total(self, group: str) -> int:
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=False)
+            return self._running_total_locked(t) if t >= 0 else 0
+
+    def peak_running(self, group: str) -> int:
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=False)
+            if t < 0:
+                return 0
+            base = self._o_tenants + t * self._ten_sz
+            return _TEN_FIXED.unpack_from(self._buf, base)[2]
+
+    # WFQ virtual clocks ------------------------------------------------------
+
+    def vtimes(self, groups) -> dict:
+        """The fleet virtual clocks for `groups` (0.0 for unknown)."""
+        with self._locked():
+            out = {}
+            for g in groups:
+                t = self._tenant_idx_locked(g, alloc=False)
+                if t < 0:
+                    out[g] = 0.0
+                else:
+                    base = self._o_tenants + t * self._ten_sz
+                    out[g] = _TEN_FIXED.unpack_from(self._buf, base)[1]
+            return out
+
+    def vtime_advance(self, group: str, delta: float,
+                      floor: float = 0.0) -> float:
+        """One WFQ grant: the tenant's fleet clock advances by `delta`
+        (1/weight) from max(current, floor) — the same floor re-entry
+        rule as the in-process scheduler, but against the clock every
+        process shares, so a tenant flooding process A is charged the
+        virtual time its grants on A consumed when it next competes on
+        process B."""
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=True)
+            if t < 0:
+                return 0.0
+            base = self._o_tenants + t * self._ten_sz
+            name, vt, peak, pad = _TEN_FIXED.unpack_from(self._buf, base)
+            vt = max(vt, floor) + delta
+            _TEN_FIXED.pack_into(self._buf, base, name, vt, peak, pad)
+            return vt
+
+    # per-tenant HBM charges --------------------------------------------------
+
+    def charge_hbm(self, slot: int, group: str, delta: int):
+        """Publish a residency-ledger delta for (slot, group) — the
+        fleet-visible mirror of the in-process per-group byte counts."""
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=True)
+            if t < 0:
+                return
+            off = self._hbm_off(t, slot)
+            cur = _U64.unpack_from(self._buf, off)[0]
+            _U64.pack_into(self._buf, off, max(cur + delta, 0))
+
+    def hbm_remote_bytes(self, group: str, exclude_slot: int) -> int:
+        """Bytes `group` holds resident in OTHER workers' ledgers."""
+        with self._locked():
+            t = self._tenant_idx_locked(group, alloc=False)
+            if t < 0:
+                return 0
+            return sum(
+                _U64.unpack_from(self._buf, self._hbm_off(t, s))[0]
+                for s in range(self.nslots) if s != exclude_slot)
+
+    # -- fragment dedup -------------------------------------------------------
+
+    def _ded_off(self, i: int) -> int:
+        return self._o_dedup + i * _DED.size
+
+    def dedup_claim(self, key_hash: bytes, ttl_s: float) -> tuple:
+        """Claim or join the dedup slot for `key_hash` (16 bytes).
+
+        Returns one of::
+
+            ("lead", idx, result_id)   # this process dispatches + publishes
+            ("hit",  idx, result_id)   # a fresh result page already exists
+            ("wait", idx, 0)           # another process is building: poll
+            ("miss", -1, 0)            # table full — just dispatch locally
+        """
+        now = time.time()
+        with self._locked():
+            free = -1
+            for i in range(self.ndedup):
+                off = self._ded_off(i)
+                h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
+                if h == key_hash and state != DFREE:
+                    if state == DBUILDING:
+                        if now - ts <= BUILD_LEASE_S:
+                            return ("wait", i, 0)
+                        # leader died mid-build: take the slot over
+                        _DED.pack_into(self._buf, off, key_hash, DBUILDING,
+                                       self._claim_owner, now, 0)
+                        self._bump_locked("fabric_dedup_leads")
+                        return ("lead", i, 0)
+                    if state == DDONE and now - ts <= ttl_s:
+                        self._bump_locked("fabric_dedup_hits")
+                        return ("hit", i, rid)
+                    # stale done / failed: re-lead (and GC the expired
+                    # page — nothing can serve it again, and pages left
+                    # behind are unbounded disk growth)
+                    self._unlink_page(rid)
+                    _DED.pack_into(self._buf, off, key_hash, DBUILDING,
+                                   self._claim_owner, now, 0)
+                    self._bump_locked("fabric_dedup_leads")
+                    return ("lead", i, 0)
+                if free < 0 and (state == DFREE
+                                 or (state == DDONE and now - ts > ttl_s)
+                                 or state == DFAILED):
+                    free = i
+            if free < 0:
+                return ("miss", -1, 0)
+            off = self._ded_off(free)
+            _h, _state, _owner, _ts, old_rid = _DED.unpack_from(
+                self._buf, off)
+            self._unlink_page(old_rid)  # the reused slot's expired page
+            _DED.pack_into(self._buf, off, key_hash,
+                           DBUILDING, self._claim_owner, now, 0)
+            self._bump_locked("fabric_dedup_leads")
+            return ("lead", free, 0)
+
+    #: the owner id stamped on dedup claims.  Workers set their real
+    #: slot via set_claim_owner (state.activate); any attachment that
+    #: never does — the parent, a bench verifier, tests — claims as
+    #: EXTERNAL_OWNER, a sentinel that matches no worker slot: a real
+    #: slot's crash reclaim must never fail an external claimant's
+    #: in-progress entry, and vice versa (an abandoned external claim is
+    #: recovered by the BUILD_LEASE_S takeover, not by slot reclaim)
+    EXTERNAL_OWNER = 0xFFFFFFFF
+    _claim_owner = EXTERNAL_OWNER
+
+    def set_claim_owner(self, slot: int):
+        self._claim_owner = int(slot)
+
+    def dedup_publish(self, idx: int, key_hash: bytes,
+                      result_id: int) -> None:
+        with self._locked():
+            off = self._ded_off(idx)
+            h, state, owner, _ts, _rid = _DED.unpack_from(self._buf, off)
+            if h == key_hash and state == DBUILDING:
+                _DED.pack_into(self._buf, off, h, DDONE, owner,
+                               time.time(), result_id)
+
+    def dedup_fail(self, idx: int, key_hash: bytes) -> None:
+        with self._locked():
+            off = self._ded_off(idx)
+            h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
+            if h == key_hash and state == DBUILDING:
+                _DED.pack_into(self._buf, off, h, DFAILED, owner, ts, rid)
+
+    def dedup_poll(self, idx: int, key_hash: bytes) -> tuple:
+        """-> ("building"|"done"|"gone", result_id)."""
+        with self._locked():
+            h, state, owner, ts, rid = _DED.unpack_from(
+                self._buf, self._ded_off(idx))
+            if h != key_hash or state in (DFREE, DFAILED):
+                return ("gone", 0)
+            if state == DDONE:
+                return ("done", rid)
+            if time.time() - ts > BUILD_LEASE_S:
+                return ("gone", 0)  # leader presumed dead
+            return ("building", 0)
+
+    def next_result_id(self) -> int:
+        with self._locked():
+            return self._bump_locked("_result_id_seq")
+
+    def result_page_path(self, result_id: int) -> str:
+        return os.path.join(self.pages_dir, f"dedup-{result_id}.bin")
+
+    def _unlink_page(self, result_id: int):
+        if result_id:
+            with contextlib.suppress(OSError):
+                os.remove(self.result_page_path(result_id))
+
+    def prewarm_claim(self, key_hash: bytes, ttl_s: float = 60.0) -> bool:
+        """Fleet-wide prewarm dedup: True when THIS process should warm
+        the signature, False when another worker claimed it within the
+        window (counted ``fabric_prewarm_dedup``)."""
+        kind, idx, _rid = self.dedup_claim(key_hash, ttl_s)
+        if kind == "lead":
+            # mark done immediately: the claim itself is the dedup —
+            # prewarm needs no result page, only at-most-once submission
+            self.dedup_publish(idx, key_hash, 0)
+            with self._locked():
+                # a claim is not a dedup LEAD in the gauge sense
+                self._bump_locked("fabric_dedup_leads", -1)
+            return True
+        if kind in ("hit", "wait"):
+            with self._locked():
+                if kind == "hit":
+                    self._bump_locked("fabric_dedup_hits", -1)
+                self._bump_locked("fabric_prewarm_dedup")
+            return False
+        return True  # table full: warm locally rather than skip
+
+    # -- introspection / drain ------------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = time.time()
+        with self._locked():
+            slots = []
+            for s in range(self.nslots):
+                pid, lease, gen = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))
+                if pid:
+                    slots.append({"slot": s, "pid": pid, "gen": gen,
+                                  "lease_age_s": round(now - lease, 3)})
+            tenants = {}
+            for t in range(self.ntenants):
+                name = self._ten_name(t)
+                if not name:
+                    continue
+                base = self._o_tenants + t * self._ten_sz
+                _n, vt, peak, _pad = _TEN_FIXED.unpack_from(self._buf, base)
+                tenants[name.decode("utf-8", "replace")] = {
+                    "running": self._running_total_locked(t),
+                    "peak_running": peak,
+                    "vtime": round(vt, 4),
+                    "hbm_bytes": sum(
+                        _U64.unpack_from(self._buf, self._hbm_off(t, s))[0]
+                        for s in range(self.nslots))}
+            building = sum(
+                1 for i in range(self.ndedup)
+                if _DED.unpack_from(self._buf, self._ded_off(i))[1]
+                == DBUILDING)
+            ctrs = {name: _U64.unpack_from(
+                self._buf, self._ctr_off(name))[0]
+                for name in COUNTER_NAMES if not name.startswith("_")}
+        return {"slots": slots, "tenants": tenants,
+                "dedup_building": building, **ctrs}
+
+    def verify_drained(self) -> dict:
+        """Fleet drain invariant (the cross-process analog of
+        scheduler.verify_drained): no live lease, zero running counts in
+        every tenant row, no dedup slot stuck building."""
+        snap = self.snapshot()
+        running = {g: t["running"] for g, t in snap["tenants"].items()
+                   if t["running"]}
+        return {"ok": not snap["slots"] and not running
+                and snap["dedup_building"] == 0,
+                "live_slots": [s["slot"] for s in snap["slots"]],
+                "running": running,
+                "dedup_building": snap["dedup_building"],
+                "lease_reclaims": snap["fabric_lease_reclaims"]}
+
+
+def _untrack(shm) -> None:
+    """Detach a SharedMemory from this process's resource tracker: this
+    CPython registers segments on ATTACH too, and the tracker UNLINKS
+    everything it tracks when its process exits — the first worker to
+    die would tear the fleet's segment out from under the survivors.
+    The fleet owns the lifecycle explicitly (Coordinator.unlink)."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception as e:  # noqa: BLE001 — tracker API drifts by version
+        log.debug("resource-tracker unregister skipped: %s", e)

@@ -1,0 +1,181 @@
+"""One fleet worker process: Domain + MySQL wire listener behind the
+fleet's advertised port, coordinated through the shared segment.
+
+Spawned by fabric/fleet.py as ``python -m tidb_tpu.fabric.worker`` with
+env config (the fleet's spawn contract — env, not argv, so a respawn is
+a bit-identical re-exec):
+
+    TIDB_TPU_FABRIC_COORD       coordinator-file path (required)
+    TIDB_TPU_FABRIC_SLOT        this worker's slot (required)
+    TIDB_TPU_FABRIC_PORT        the advertised SO_REUSEPORT port
+    TIDB_TPU_FABRIC_INIT        "module:callable" data-seeding hook(domain)
+    TIDB_TPU_FABRIC_GLOBALS     "name=value;..." GLOBAL sysvars at boot
+    TIDB_TPU_FABRIC_FAILPOINTS  "name=action;..." chaos failpoints
+    TIDB_TPU_COMPILE_SERVER     the separated compile server's socket
+
+Boot order matters: the conn-id base installs BEFORE the Domain
+bootstraps (internal sessions must already mint fleet-unique ids), the
+coordination hooks install before the listeners open (the first admitted
+fragment must already see fleet caps).  Besides the shared listener,
+each worker opens a DIRECT port (ephemeral) — the operator/bench door to
+one specific process: health checks, per-worker SET GLOBAL, and pinning
+load in the cross-process WFQ regression.
+
+Shutdown: SIGTERM → drain (stop accepting, wait for in-flight
+connections up to the grace window, emit the worker-summary JSON line,
+release the lease) → exit 0.  SIGKILL (crash, or the
+``fabric-kill-worker`` chaos action) skips all of it — that is the
+point: the parent respawns, the lease expires, and the segment reclaim
+must make the fleet whole without this process's cooperation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+#: lease heartbeat period; the fleet treats a lease older than
+#: HEARTBEAT_S * 8 as dead (fleet.py LEASE_TIMEOUT_S)
+HEARTBEAT_S = 0.25
+#: drain grace for in-flight wire connections on SIGTERM
+DRAIN_GRACE_S = 5.0
+
+
+def _parse_kv(raw: str) -> list:
+    out = []
+    for part in (raw or "").split(";"):
+        part = part.strip()
+        if part and "=" in part:
+            k, _, v = part.partition("=")
+            out.append((k.strip(), v.strip()))
+    return out
+
+
+def main() -> int:
+    coord_path = os.environ.get("TIDB_TPU_FABRIC_COORD", "")
+    slot = int(os.environ.get("TIDB_TPU_FABRIC_SLOT", "0"))
+    port = int(os.environ.get("TIDB_TPU_FABRIC_PORT", "0"))
+    init_spec = os.environ.get("TIDB_TPU_FABRIC_INIT", "")
+    if not coord_path:
+        print("worker: TIDB_TPU_FABRIC_COORD not set", file=sys.stderr)
+        return 2
+
+    import tidb_tpu  # noqa: F401 — x64 + fingerprint-scoped AOT cache
+    from . import conn_id_base, state
+    from .coord import Coordinator
+    from ..session.session import Session
+
+    # fleet-unique conn ids BEFORE any session exists (bootstrap runs
+    # internal sessions; their ids must be fleet-unique too)
+    Session.set_conn_id_base(conn_id_base(slot))
+
+    coordinator = Coordinator.attach(coord_path)
+    coordinator.claim_slot(slot)
+    state.activate(coordinator, slot,
+                   os.environ.get("TIDB_TPU_COMPILE_SERVER") or None)
+
+    from ..utils import failpoint
+    for name, action in _parse_kv(
+            os.environ.get("TIDB_TPU_FABRIC_FAILPOINTS", "")):
+        failpoint.enable(name, action)
+
+    from ..kv import new_store
+    from ..session import bootstrap_domain
+    from ..server.server import MySQLServer
+
+    domain = bootstrap_domain(new_store())
+    for name, val in _parse_kv(os.environ.get("TIDB_TPU_FABRIC_GLOBALS",
+                                              "")):
+        domain.global_vars[name] = val
+    if init_spec:
+        mod_name, _, fn_name = init_spec.partition(":")
+        import importlib
+        getattr(importlib.import_module(mod_name), fn_name)(domain)
+
+    class FabricMySQLServer(MySQLServer):
+        def _run_query(self, io, session, sql):
+            # the process-kill chaos hook: `fabric-kill-worker` with a
+            # truthy return payload SIGKILLs this worker MID-QUERY — the
+            # client must see a clean connection error, the parent must
+            # respawn us, and the segment reclaim must free every count
+            # this process held (bench_serve fleet chaos + test_fabric)
+            if failpoint.inject("fabric-kill-worker"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            return super()._run_query(io, session, sql)
+
+    shared = FabricMySQLServer(domain, port=port, users={},
+                               reuse_port=True).start()
+    direct = FabricMySQLServer(domain, port=0, users={}).start()
+
+    stop = threading.Event()
+
+    import logging
+    hb_log = logging.getLogger("tidb_tpu.fabric.worker")
+
+    def heartbeat():
+        n = 0
+        while not stop.is_set():
+            try:
+                coordinator.heartbeat(slot)
+                n += 1
+                if n % 8 == 0:
+                    # peer-reclaim sweep: a crashed sibling's lease is
+                    # reclaimed by whoever notices first (the parent
+                    # usually wins; this covers a dead parent too)
+                    coordinator.reclaim_expired(HEARTBEAT_S * 8)
+            except Exception as e:  # noqa: BLE001 — a missed beat is
+                #   recoverable; a dead segment means the fleet is gone
+                hb_log.warning("lease heartbeat failed: %s", e)
+            stop.wait(HEARTBEAT_S)
+
+    threading.Thread(target=heartbeat, daemon=True,
+                     name="fabric-heartbeat").start()
+
+    def on_term(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    print(json.dumps({"metric": "fabric_worker_ready", "slot": slot,
+                      "pid": os.getpid(), "port": shared.port,
+                      "direct_port": direct.port}), flush=True)
+    stop.wait()
+
+    # -- drain ---------------------------------------------------------------
+    shared.shutdown()
+    direct.shutdown()
+    deadline = time.monotonic() + DRAIN_GRACE_S
+    while ((shared.connections or direct.connections)
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    from ..executor import compile_service, scheduler
+    summary = {
+        "metric": "fabric_worker_summary", "slot": slot,
+        "pid": os.getpid(),
+        "drained_conns": not (shared.connections or direct.connections),
+        "sched": {k: v for k, v in scheduler.snapshot().items()
+                  if k in ("admitted", "queued", "fast_grants",
+                           "sched_batched_fragments", "rejected_full",
+                           "rejected_timeout",
+                           "sched_admission_waits_ms")},
+        "compile": compile_service.report_gauges(),
+        "fabric": {k: v for k, v in state.snapshot().items()
+                   if isinstance(v, (int, float))},
+    }
+    print(json.dumps(summary), flush=True)
+    # hooks OFF before the segment closes: session teardown + interpreter
+    # exit still run residency GC callbacks, and a charge against a
+    # closed coordinator would only log noise
+    state.deactivate()
+    coordinator.release_slot(slot)
+    coordinator.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

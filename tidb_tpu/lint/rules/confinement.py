@@ -27,8 +27,11 @@ class JitConfinement(Rule):
     allowlistable = False
     title = "raw jax.jit confined to the compile layer"
 
-    #: the sanctioned compile layer (device_exec routes through these)
-    ALLOWED = ("executor/compile_service.py", "ops/device.py")
+    #: the sanctioned compile layer (device_exec routes through these;
+    #: fabric/compile_client.py is the separated compile server's
+    #: export wrapper — the jit there exists to TRACE for the server)
+    ALLOWED = ("executor/compile_service.py", "ops/device.py",
+               "fabric/compile_client.py")
 
     def run(self, ctx):
         out = []
@@ -142,6 +145,58 @@ class SupervisedConfinement(Rule):
                         f"{name}@{sf.qualname(node)}",
                         "direct supervised dispatch bypasses the admission "
                         "queue (route through device_exec.run_device)"))
+        return out
+
+
+@register
+class SharedMemoryConfinement(Rule):
+    """Direct ``multiprocessing.shared_memory`` use outside
+    ``tidb_tpu/fabric/`` bypasses the fleet coordination layer: the
+    segment's struct layout, the flock critical sections, the lease
+    reclaim and the drain invariant only hold if every cross-process
+    byte goes through fabric/coord.py.  Any other layer coordinates via
+    the typed hooks fabric/state.py installs (scheduler.set_fleet,
+    residency.set_fleet, dedup_handle) — the same pattern as the
+    ``._device`` confinement to the residency manager."""
+
+    name = "shared-memory-confinement"
+    allowlistable = False
+    title = "multiprocessing.shared_memory confined to tidb_tpu/fabric/"
+
+    ALLOWED_PREFIX = "fabric/"
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if sf.rel.startswith(self.ALLOWED_PREFIX):
+                continue
+            for node in ast.walk(sf.tree):
+                hit = None
+                if isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if mod.endswith("shared_memory") or (
+                            mod == "multiprocessing" and any(
+                            a.name == "shared_memory"
+                            for a in node.names)):
+                        hit = "import"
+                elif isinstance(node, ast.Import):
+                    if any(a.name.endswith(".shared_memory")
+                           for a in node.names):
+                        hit = "import"
+                elif (isinstance(node, ast.Attribute)
+                        and node.attr == "shared_memory"):
+                    hit = "attr"
+                elif (isinstance(node, ast.Call)
+                        and call_name(node).rsplit(".", 1)[-1]
+                        == "SharedMemory"):
+                    hit = "ctor"
+                if hit is not None:
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"shm-{hit}@{sf.qualname(node)}",
+                        "multiprocessing.shared_memory used outside "
+                        "tidb_tpu/fabric/ (coordinate through the "
+                        "fabric/state.py hooks)"))
         return out
 
 

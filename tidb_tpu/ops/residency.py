@@ -116,6 +116,43 @@ STATS = {
 #: registered from the contexts device dispatches run under
 _SINKS: "weakref.WeakSet" = weakref.WeakSet()
 
+#: the serving fabric's fleet hook (tidb_tpu/fabric/state.py installs a
+#: _ResidencyFleet at worker boot): per-group byte DELTAS publish to the
+#: coordination segment, and a group's share consumption reads
+#: fleet-wide — a tenant filling worker A's HBM share is over-share on
+#: worker B too, so its uploads there self-evict first instead of
+#: squeezing B's light tenants.  None (all paths local) outside a fleet.
+#: Lock order: the segment's flock nests inside the ledger _LOCK.
+_FLEET = [None]
+
+
+def set_fleet(hook):
+    """Install (or clear, with None) the fleet residency hook."""
+    with _LOCK:
+        _FLEET[0] = hook
+
+
+def _fleet_charge_locked(group: str, delta: int):
+    fleet = _FLEET[0]
+    if fleet is not None:
+        try:
+            fleet.charge(group, delta)
+        except Exception as e:  # noqa: BLE001 — segment mirror only
+            log.warning("fleet HBM charge failed for %r (%+d bytes; "
+                        "local ledger stays exact): %s", group, delta, e)
+
+
+def _fleet_remote_bytes(group: str) -> int:
+    fleet = _FLEET[0]
+    if fleet is None:
+        return 0
+    try:
+        return fleet.remote_bytes(group)
+    except Exception as e:  # noqa: BLE001 — degrade to local shares
+        log.warning("fleet HBM read failed for %r (local share only): %s",
+                    group, e)
+        return 0
+
 
 class _Resident:
     """The value stored on ``Column._device``: the padded device arrays
@@ -355,6 +392,7 @@ def publish(col, data, nulls):
             _ENTRIES[token] = _Entry(ref, nbytes, token, group)
             _BYTES[0] += nbytes
             _GROUP_BYTES[group] += nbytes
+            _fleet_charge_locked(group, nbytes)
             STATS["uploads"] += 1
             ev0 = STATS["hbm_evictions"]
             _enforce_budget_locked(keep_token=token, group=group)
@@ -384,6 +422,7 @@ def _drop_group_bytes_locked(group: str, nbytes: int):
     _GROUP_BYTES[group] -= nbytes
     if _GROUP_BYTES[group] <= 0:
         del _GROUP_BYTES[group]
+    _fleet_charge_locked(group, -nbytes)
 
 
 # -- eviction ----------------------------------------------------------------
@@ -427,7 +466,10 @@ def free_share_bytes(group: str | None = None) -> int:
         if share <= 0:
             return 0
         g = group if group is not None else current_group()
-        held = _GROUP_BYTES.get(g, 0)
+        # under the serving fabric a tenant's consumption is FLEET-wide:
+        # the share headroom that sizes memory-adaptive operators must
+        # see the bytes this tenant holds in every sibling worker too
+        held = _GROUP_BYTES.get(g, 0) + _fleet_remote_bytes(g)
         return max(share - held, share // 4)
 
 
@@ -455,8 +497,14 @@ def _enforce_budget_locked(keep_token: int, group: str = DEFAULT_GROUP):
         return
     share = _group_share_locked()
     # phase 1 — self-first: the uploading tenant over its share evicts
-    # its own cold entries (other tenants' working sets are protected)
-    while (_BYTES[0] > budget and _GROUP_BYTES.get(group, 0) > share):
+    # its own cold entries (other tenants' working sets are protected).
+    # Under the fabric "over its share" counts the tenant's bytes in
+    # EVERY worker (one segment read per enforce, constant across the
+    # loop — local evictions are what shrink the left side); phase 2's
+    # per-entry checks stay local to keep eviction off the segment lock.
+    remote = _fleet_remote_bytes(group)
+    while (_BYTES[0] > budget
+           and _GROUP_BYTES.get(group, 0) + remote > share):
         victim = None
         for token, ent in _ENTRIES.items():  # oldest first
             if token != keep_token and ent.group == group:

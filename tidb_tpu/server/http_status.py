@@ -37,6 +37,14 @@ def _tracing_snapshot() -> dict:
     return tracing.snapshot()
 
 
+def _fabric_snapshot() -> dict:
+    """Serving-fabric gauges (tidb_tpu/fabric/state.py): this worker's
+    slot + dedup/remote-compile counters, and the fleet-global view
+    (live workers, respawns) when a coordination segment is attached."""
+    from ..fabric import state
+    return state.snapshot()
+
+
 class StatusServer:
     def __init__(self, domain, sql_server=None, host="127.0.0.1", port=10080):
         self.domain = domain
@@ -154,6 +162,11 @@ class StatusServer:
             # and the open-spill-set drain gauge — whether a build side
             # is spilling (and leaking) is diagnosable from the port
             "device_hybrid_join": _hybrid_join_snapshot(),
+            # serving fabric (tidb_tpu/fabric): worker slot, live fleet
+            # size, respawns, fragment-dedup hits/waits, compile-server
+            # RTT + remote errors — which worker this is and whether the
+            # fleet is whole, diagnosable from any worker's status port
+            "device_fabric": _fabric_snapshot(),
         }
 
     def _metrics(self):
@@ -200,6 +213,12 @@ class StatusServer:
                           hs["hj_spilled_partitions"])
         gauges.setdefault("hj_spill_bytes", hs["hj_spill_bytes"])
         gauges.setdefault("hj_coproc_host_rows", hs["hj_coproc_host_rows"])
+        fs = _fabric_snapshot()
+        gauges.setdefault("fabric_workers", fs.get("fabric_workers", 0))
+        gauges.setdefault("fabric_respawns", fs.get("fabric_respawns", 0))
+        gauges.setdefault("fabric_dedup_hits", fs["fabric_dedup_hits"])
+        gauges.setdefault("fabric_compile_rtt_ms",
+                          fs["fabric_compile_rtt_ms"])
         # per-tenant degradations as ONE labeled series (a single TYPE
         # header — duplicate TYPE lines are invalid text exposition and
         # fail the whole scrape); the observe-sink mirror keys them

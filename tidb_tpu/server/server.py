@@ -23,18 +23,26 @@ from .packet import (PacketIO, lenenc_int, read_lenenc_int, read_nul_str)
 
 class MySQLServer:
     def __init__(self, domain, host="127.0.0.1", port=4000, users=None,
-                 ssl_ctx=None):
+                 ssl_ctx=None, reuse_port=False):
         """users: optional static {user: password} map override. Default
         (None) authenticates against the mysql.user grant tables (falling
         back to empty-password root when the domain has no grant tables).
         Pass users={} to explicitly accept any login (hermetic tests).
         ssl_ctx: an ssl.SSLContext enabling the in-handshake TLS upgrade
         (reference: server/conn.go:256 upgradeToTLS; see make_tls_context
-        / auto-TLS in server/main.py)."""
+        / auto-TLS in server/main.py).
+        reuse_port: bind with SO_REUSEPORT so N fabric worker processes
+        (tidb_tpu/fabric) can listen behind ONE advertised port — the
+        kernel load-balances incoming connections across the fleet.
+
+        Connection ids come from the Session allocator (session.py),
+        which a fabric worker prefixes with its process-slot base —
+        fleet-UNIQUE ids, so KILL and information_schema attribution
+        resolve to the owning process (a per-server counter here would
+        let two workers mint the same id)."""
         self.domain = domain
         self.users = users
         self.ssl_ctx = ssl_ctx
-        self._next_conn_id = 0
         self._lock = threading.Lock()
         self.connections = {}
 
@@ -47,6 +55,12 @@ class MySQLServer:
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
+
+            def server_bind(self):
+                if reuse_port:
+                    self.socket.setsockopt(socket.SOL_SOCKET,
+                                           socket.SO_REUSEPORT, 1)
+                super().server_bind()
 
         self._server = Server((host, port), Handler)
         self.port = self._server.server_address[1]
@@ -66,11 +80,6 @@ class MySQLServer:
         self._server.server_close()
 
     # -- connection ---------------------------------------------------------
-
-    def _conn_id(self):
-        with self._lock:
-            self._next_conn_id += 1
-            return self._next_conn_id
 
     def _handle_conn(self, sock: socket.socket):
         io = PacketIO(sock)

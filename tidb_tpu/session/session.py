@@ -282,6 +282,21 @@ class Session:
     """reference: session.session — one connection's state."""
 
     _next_conn_id = [1]
+    #: the wire server creates Sessions from per-connection threads, so
+    #: the read-increment below must be atomic — an unguarded `x[0] += 1`
+    #: lets two simultaneous handshakes mint the SAME id, colliding in
+    #: server.connections and misrouting KILL
+    _conn_id_lock = threading.Lock()
+    #: fleet-unique conn ids (tidb_tpu/fabric): a fabric worker sets its
+    #: slot base — ``(slot + 1) << CONN_SLOT_SHIFT`` — so two serving
+    #: processes can NEVER mint the same id.  KILL, processlist and
+    #: slow-log attribution all resolve by conn id; with a per-process
+    #: counter alone, "KILL 7" on worker B could name worker A's session.
+    _conn_id_base = [0]
+
+    @classmethod
+    def set_conn_id_base(cls, base: int):
+        cls._conn_id_base[0] = int(base)
 
     def __init__(self, domain: Domain):
         self.domain = domain
@@ -316,8 +331,10 @@ class Session:
         self.affected_rows = 0
         self.warnings: list[str] = []
         self.prepared: dict[str, str] = {}
-        self.conn_id = Session._next_conn_id[0]
-        Session._next_conn_id[0] += 1
+        with Session._conn_id_lock:
+            self.conn_id = (Session._conn_id_base[0]
+                            + Session._next_conn_id[0])
+            Session._next_conn_id[0] += 1
         self._expr_ctx = _ExprCtx(self)
         from ..ddl import DDLExecutor
         self.ddl = DDLExecutor(self)

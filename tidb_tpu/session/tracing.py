@@ -228,16 +228,29 @@ class Trace:
                 d["children"] = ch
             return d
 
-        return {"trace_id": self.trace_id, "parent_id": self.parent_id,
-                "origin": self.origin, "conn_id": self.conn_id,
-                "started_at": self.started_at,
-                "duration_s": (round(dur_s, 6)
-                               if dur_s is not None else None),
-                "succ": self.succ, "spans": len(spans),
-                "dropped": dropped, "root": node(root)}
+        out = {"trace_id": self.trace_id, "parent_id": self.parent_id,
+               "origin": self.origin, "conn_id": self.conn_id,
+               "started_at": self.started_at,
+               "duration_s": (round(dur_s, 6)
+                              if dur_s is not None else None),
+               "succ": self.succ, "spans": len(spans),
+               "dropped": dropped, "root": node(root)}
+        if _PROC_LABEL[0]:
+            out["process"] = _PROC_LABEL[0]
+        return out
 
 
 # -- the hot-path API ---------------------------------------------------------
+
+#: this process's fabric identity ("slot3"), stamped into rendered trace
+#: headers and to_dict payloads — set once at worker boot
+#: (fabric/state.activate), empty outside a fleet
+_PROC_LABEL = [""]
+
+
+def set_process_label(label: str):
+    _PROC_LABEL[0] = str(label or "")
+
 
 class _NoopCtx:
     """The shared do-nothing span: sampling off costs one TLS read + this
@@ -428,9 +441,14 @@ def tree_rows(tr: Trace) -> list:
 
 def render_tree(tr: Trace) -> str:
     """One text block per trace — what slow-log items and the bench error
-    lines carry (the Q5 post-mortem artifact)."""
+    lines carry (the Q5 post-mortem artifact).  Under the serving fabric
+    the header names the WORKER PROCESS that served the statement (the
+    tracing context across process hops: a fleet post-mortem's first
+    question is "which worker"), and dedup/remote-compile events inside
+    tag the peer slot they crossed to."""
     lines = [f"trace {tr.trace_id}"
              + (f" (child of {tr.parent_id})" if tr.parent_id else "")
+             + (f" @{_PROC_LABEL[0]}" if _PROC_LABEL[0] else "")
              + f" [{tr.origin}] dur={_fmt_s(tr.dur_s)}"
              + ("" if tr.succ else " FAILED")
              + (f" dropped={tr.dropped}" if tr.dropped else "")]

@@ -107,6 +107,24 @@ class Column:
     def __len__(self):
         return len(self.data)
 
+    # -- pickling (fabric result pages, tidb_tpu/fabric/dedup.py) ----------
+    # Only the material survives: ftype + data + nulls.  Every other slot
+    # is a PROCESS-LOCAL cache — above all the `_device` HBM slot, whose
+    # handle must never ship to another process (its bytes are accounted
+    # in THIS process's residency ledger), plus the join-index/dict/ci/
+    # minmax caches, which the consumer rebuilds lazily.  setattr-by-name
+    # below is the Column constructor's None slot init in pickle form.
+
+    _PICKLE_SLOTS = ("ftype", "data", "nulls")
+
+    def __getstate__(self):
+        return {s: getattr(self, s) for s in self._PICKLE_SLOTS}
+
+    def __setstate__(self, st):
+        for s in ("ftype", "data", "nulls", "_dict", "_dict_ci", "_device",
+                  "_join_index", "_minmax", "_dict_sig"):
+            setattr(self, s, st.get(s))
+
     @classmethod
     def from_values(cls, ftype: FieldType, values) -> "Column":
         """Build from python values (None = NULL)."""
@@ -324,6 +342,23 @@ class LazyDictColumn(Column):
             codes, uniques = self._dict
             self._mat = uniques[np.asarray(codes, dtype=np.int64)]
         return self._mat
+
+    # pickling: the codes+dictionary ARE the material here (`data` is a
+    # derived view — serializing it would materialize the whole object
+    # array); same process-local-cache exclusions as Column.__getstate__
+
+    def __getstate__(self):
+        return {"ftype": self.ftype, "nulls": self.nulls,
+                "_dict": (np.asarray(self._dict[0]), self._dict[1])}
+
+    def __setstate__(self, st):
+        self.ftype = st["ftype"]
+        self.nulls = st["nulls"]
+        self._dict = st["_dict"]
+        for s in ("_dict_ci", "_device", "_join_index", "_dict_sig",
+                  "_mat"):
+            setattr(self, s, None)
+        self._minmax = (None,)
 
     def __len__(self):
         return len(self._dict[0])
