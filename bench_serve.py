@@ -343,6 +343,100 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
     return summary
 
 
+# -- durability phase (ISSUE 15): WAL cost + kill-recover round trip ---------
+
+#: the kill-recover child: ack K committed rows, then die by SIGKILL at
+#: the widest 2PC crash window.  The parent times reopen+recovery and
+#: requires every acked row back.
+_DUR_CHILD = r"""
+import json, sys
+from tidb_tpu.utils import failpoint
+from tidb_tpu.kv import new_store
+st = new_store(wal_dir=sys.argv[1])
+n = int(sys.argv[2])
+for i in range(n):
+    t = st.begin(); t.put(b"dur%06d" % i, b"v"); t.commit()
+    print(json.dumps({"acked": i}), flush=True)
+failpoint.enable("txn-before-commit", "1*return(kill)")
+t = st.begin(); t.put(b"doomed", b"x"); t.commit()
+"""
+
+
+def run_durability(n_txns: int = 150, emit=_emit) -> dict:
+    """The durability phase of the smoke: transfer-DML-shaped KV txn
+    qps with WAL off / ``fsync=never`` / ``fsync=commit`` (the
+    group-commit overhead, measured not guessed), plus one SIGKILL-mid-
+    commit → reopen → recovery round trip timed end to end with
+    committed-visible / uncommitted-gone asserted.  One JSON line:
+    ``{"metric": "serve_durability", ...}``."""
+    import shutil
+    import subprocess
+    import tempfile
+    from tidb_tpu.kv import new_store
+
+    def dml_qps(wal_dir, policy):
+        if wal_dir:
+            st = new_store(wal_dir=wal_dir)
+            st.mvcc.wal.policy_source = lambda: policy
+        else:
+            # the WAL-OFF baseline must be genuinely in-memory: plain
+            # Storage, NOT new_store(None) — that falls through to the
+            # TIDB_TPU_WAL_DIR env fallback and would both skew the
+            # comparison and write bench keys into a real WAL dir
+            from tidb_tpu.kv.store import Storage
+            st = Storage()
+        t0 = time.monotonic()
+        for i in range(n_txns):
+            t = st.begin()
+            t.put(b"q%06d" % i, b"a")
+            t.put(b"r%06d" % i, b"b")
+            t.commit()
+        dt = max(time.monotonic() - t0, 1e-9)
+        st.close()
+        return round(n_txns / dt, 1)
+
+    tmp = tempfile.mkdtemp(prefix="serve-dur-")
+    out = {"metric": "serve_durability", "n_txns": n_txns}
+    try:
+        out["qps_wal_off"] = dml_qps(None, None)
+        out["qps_fsync_never"] = dml_qps(os.path.join(tmp, "nv"), "never")
+        out["qps_fsync_commit"] = dml_qps(os.path.join(tmp, "cm"),
+                                          "commit")
+        out["group_commit_overhead_pct"] = round(
+            100.0 * (1.0 - out["qps_fsync_commit"]
+                     / max(out["qps_wal_off"], 1e-9)), 1)
+        # kill-recover round trip
+        kdir = os.path.join(tmp, "kill")
+        acked = 8
+        r = subprocess.run(
+            [sys.executable, "-c", _DUR_CHILD, kdir, str(acked)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": os.pathsep.join(
+                     [p for p in sys.path if p]
+                     + [os.environ.get("PYTHONPATH", "")])},
+            capture_output=True, text=True, timeout=240)
+        assert r.returncode == -9, (
+            f"kill child exited {r.returncode}: {r.stderr[-500:]}")
+        t0 = time.monotonic()
+        st = new_store(wal_dir=kdir)  # reopen = recover
+        out["kill_recover_s"] = round(time.monotonic() - t0, 4)
+        snap = st.get_snapshot()
+        recovered = sum(1 for i in range(acked)
+                        if snap.get(b"dur%06d" % i) == b"v")
+        assert recovered == acked, (
+            f"LOST COMMITTED ROWS: {recovered}/{acked} after recovery")
+        assert snap.get(b"doomed") is None, (
+            "un-acked mid-kill txn visible after recovery")
+        st.close()
+        out["acked"] = acked
+        out["recovered"] = recovered
+    finally:
+        with contextlib.suppress(OSError):
+            shutil.rmtree(tmp)
+    emit(out)
+    return out
+
+
 # -- fleet mode (--procs N): the cross-process serving fabric ----------------
 #
 # Where run_serve drives N THREADS against one Domain, run_fleet drives
@@ -394,19 +488,25 @@ def _wfq_heavy_q(i: int) -> str:
 RESPAWN_BUDGET_S = 30.0
 
 
-def _fabric_seed(domain):
+def _fabric_seed(domain, seeded: bool = False):
     """Worker-side data init (TIDB_TPU_FABRIC_INIT hook): TPC-H at
     BENCH_FABRIC_SF + the transfer ledger.  Deterministic (bench.gen_all
     is fixed-seeded), so every worker holds IDENTICAL data — the
-    property the content-hashed fragment dedup keys rely on."""
+    property the content-hashed fragment dedup keys rely on.  Under the
+    durable shared store the KV half (schema, ledger, stats) replicates
+    through the log and only the FIRST worker writes it (`seeded` is
+    True for the rest); the bulk-installed columnar caches are
+    process-local and rebuild in every worker (gen_all detects the
+    replayed schema and skips its DDL/KV writes)."""
     from tidb_tpu.testkit import TestKit
     sf = float(os.environ.get("BENCH_FABRIC_SF", "0.002"))
     tk = TestKit(domain)
     bench.gen_all(tk, sf)
-    tk.must_exec("use test")
-    tk.must_exec("create table ledger (acct int primary key, bal int)")
-    tk.must_exec("insert into ledger values " + ",".join(
-        f"({i}, {SEED_BAL})" for i in range(1, N_ACCTS + 1)))
+    if not seeded:
+        tk.must_exec("use test")
+        tk.must_exec("create table ledger (acct int primary key, bal int)")
+        tk.must_exec("insert into ledger values " + ",".join(
+            f"({i}, {SEED_BAL})" for i in range(1, N_ACCTS + 1)))
 
 
 def _fleet_conn(port, db="tpch", group=None, engine=None):
@@ -765,6 +865,10 @@ def main(argv=None) -> int:
         else:
             run_serve(n_threads=args.threads, n_ops=args.ops, sf=args.sf,
                       seed=args.seed, chaos=args.chaos)
+        if args.smoke:
+            # durability phase (ISSUE 15): WAL-off/never/commit DML qps
+            # + the SIGKILL-mid-commit recover round trip
+            run_durability()
     except AssertionError as e:
         _emit({"metric": "serve_violation", "error": str(e)[:2000]})
         return 1

@@ -126,11 +126,18 @@ READ_FAULTS = {
     "coordinator-heartbeat-lost": ["return(1)"],
 }
 
-#: write-path fault catalog: 2PC crash windows
+#: write-path fault catalog: 2PC crash windows + WAL failure windows
+#: (the chaos store IS durable — see _durable_kit below — so these hit
+#: the real append/fsync path: a torn append (`1*return(torn)` writes
+#: half a frame, heals and fails the commit) or a failed fsync must
+#: roll the txn back CLEANLY, and the end-of-seed recovery-equivalence
+#: check proves the log agrees with the live store)
 WRITE_FAULTS = {
     "txn-before-prewrite": ["1*panic", "panic"],
     "txn-after-prewrite": ["1*panic", "panic"],
     "txn-before-commit": ["1*panic", "panic"],
+    "wal-append-torn": ["1*panic", "1*return(torn)"],
+    "wal-fsync-fail": ["1*panic"],
 }
 
 #: FLEET-mode fault catalog (process-level faults — these cannot run in
@@ -146,6 +153,17 @@ WRITE_FAULTS = {
 #: fleet smoke).
 FLEET_FAULTS = {
     "fabric-kill-worker": ["1*return(1)", "2*return(1)"],
+    # kill-at-stage process deaths for the durable store (a `kill`
+    # payload SIGKILLs the worker AT the WAL/2PC stage; recovery on
+    # respawn must show committed-visible / uncommitted-gone, torn
+    # tails CRC-truncated — tests/test_wal.py runs the full matrix,
+    # tests/test_fabric.py loops it against a live 4-worker fleet)
+    "wal-append-torn": ["1*return(kill)"],
+    "wal-fsync-fail": ["1*return(kill)"],
+    "store-recover-replay": ["1*return(kill)"],
+    "txn-before-commit": ["1*return(kill)"],
+    "txn-after-prewrite": ["1*return(kill)"],
+    "txn-before-prewrite": ["1*return(kill)"],
 }
 
 
@@ -183,10 +201,48 @@ def _is_clean(err: Exception) -> bool:
     return isinstance(err, (TiDBError, FailpointError))
 
 
+def _durable_kit():
+    """A TestKit over a WAL-backed durable store (kv/shared_store.py):
+    the write-fault catalog's wal-* failpoints hit the REAL append /
+    fsync path, and _assert_recovery_equivalent can prove at seed end
+    that a crash at that instant would lose nothing committed.
+    Returns (kit, wal_dir)."""
+    import tempfile
+    from tidb_tpu.kv import new_store
+    from tidb_tpu.session import bootstrap_domain
+    wal_dir = tempfile.mkdtemp(prefix="chaos-wal-")
+    store = new_store(wal_dir=wal_dir)
+    return TestKit(bootstrap_domain(store)), wal_dir
+
+
+def _assert_recovery_equivalent(tk: TestKit, wal_dir: str, seed: int):
+    """THE durability invariant: open a SECOND store on the same WAL
+    dir (exactly what a post-SIGKILL restart would do — checkpoint +
+    tail replay + CRC truncation) and compare a full live-range scan at
+    one snapshot ts against the serving store.  Bit-for-bit equal means
+    the log is a faithful journal of everything the store acked."""
+    from tidb_tpu.kv import new_store
+    live = tk.domain.store
+    ts = live.next_ts()
+    live_rows = live.get_snapshot(ts).scan(b"", b"")
+    recovered = new_store(wal_dir=wal_dir)
+    try:
+        rec_rows = recovered.get_snapshot(ts).scan(b"", b"")
+    finally:
+        recovered.close()
+    assert rec_rows == live_rows, (
+        f"seed {seed}: RECOVERY DIVERGENCE: replayed store has "
+        f"{len(rec_rows)} live rows vs {len(live_rows)} in the serving "
+        "store — the WAL is not a faithful journal")
+
+
 def run_seed(seed: int, n_ops: int = 10) -> dict:
     """One deterministic chaos schedule; returns counters for reporting."""
     rng = random.Random(seed)
-    tk = TestKit()  # fresh embedded cluster: no cross-seed contamination
+    # fresh embedded cluster (no cross-seed contamination), DURABLE:
+    # the 2PC/WAL write faults hit the real commit path and the seed
+    # ends with a crash-equivalent recovery comparison
+    tk, wal_dir = _durable_kit()
     failpoint.disable_all()
     stats = {"exact": 0, "clean_errors": 0, "writes_ok": 0,
              "writes_failed": 0}
@@ -304,8 +360,18 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         sp = spill_outstanding()
         assert sp["open_sets"] == 0, (
             f"seed {seed}: LEAKED SPILL PAGES: {sp}")
+
+        # -- durability: a crash RIGHT NOW would lose nothing — reopen
+        #    the WAL dir (checkpoint + tail replay + CRC truncation)
+        #    and require bit-for-bit equality with the serving store
+        _assert_recovery_equivalent(tk, wal_dir, seed)
     finally:
         failpoint.disable_all()
+        with contextlib.suppress(Exception):
+            tk.domain.store.close()
+        import shutil
+        with contextlib.suppress(OSError):
+            shutil.rmtree(wal_dir)
     return stats
 
 
@@ -315,6 +381,12 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
 #: fragment hooks and HANG actions (sleep under a small
 #: tidb_device_call_timeout → DeviceHangError through the supervisor)
 THREADED_FAULTS = {
+    # WAL write faults under concurrency: group-commit waiters racing a
+    # torn/failed append must all fail classified (or absorb a
+    # transient), the ledger stays atomic, and the recovery-equivalence
+    # check after the joins must still hold
+    "wal-append-torn": ["1*panic", "1*return(torn)"],
+    "wal-fsync-fail": ["1*panic"],
     "device-agg-exec": ["panic", "1*panic", "sleep(0.05)"],
     "device-join-exec": ["panic", "1*panic", "sleep(0.05)"],
     "device-mpp-exec": ["1*panic", "sleep(0.05)"],
@@ -354,7 +426,7 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     the module docstring).  Returns aggregate counters."""
     from tidb_tpu.executor import supervisor
 
-    tk = TestKit()
+    tk, wal_dir = _durable_kit()
     failpoint.disable_all()
     _setup(tk)
     # fast breaker + a visible half-open cycle under contention
@@ -554,4 +626,16 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     total = tk.must_query("select sum(bal) from ledger").rows[0][0]
     assert str(total) == "1000", (
         f"seed {seed}: final ledger sum {total} != 1000")
+
+    # durability under concurrency: the log written by N racing threads
+    # (group commits interleaving torn/failed appends) must replay to
+    # exactly the serving store's state
+    try:
+        _assert_recovery_equivalent(tk, wal_dir, seed)
+    finally:
+        with contextlib.suppress(Exception):
+            tk.domain.store.close()
+        import shutil
+        with contextlib.suppress(OSError):
+            shutil.rmtree(wal_dir)
     return stats

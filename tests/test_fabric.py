@@ -291,6 +291,126 @@ class TestResidencyFleetHook:
 
 
 @pytest.mark.chaos_threads
+class TestFleetDurability:
+    """ISSUE 15 acceptance: the fleet serves ONE durable store.  A
+    committed INSERT on any worker is readable on every other worker; a
+    worker SIGKILLed at a randomized WAL/2PC stage loses ZERO acked
+    commits and surfaces ZERO un-acked rows after respawn+recovery
+    (torn tails CRC-truncated); and a full fleet restart over the same
+    run dir recovers everything from the log."""
+
+    def test_cross_worker_visibility(self, tmp_path):
+        """The satellite: INSERT on slot 0, SELECT on slot 1."""
+        from tidb_tpu.fabric.client import FleetClient
+        from tidb_tpu.fabric.fleet import Fleet
+        fleet = Fleet(2, compile_server=False,
+                      run_dir=str(tmp_path / "fleet"))
+        fleet.start(timeout_s=240.0)
+        try:
+            c0 = FleetClient(fleet.direct_port(0))
+            c0.must_exec("use test")
+            c0.must_exec("create table viz (id int primary key, v int)")
+            c0.must_exec("insert into viz values (1, 11), (2, 22)")
+            c0.close()
+            c1 = FleetClient(fleet.direct_port(1))
+            c1.must_exec("use test")
+            assert c1.must_query(
+                "select id, v from viz order by id")[1] == \
+                [("1", "11"), ("2", "22")]
+            # and the reverse direction, post-DDL
+            c1.must_exec("insert into viz values (3, 33)")
+            c1.close()
+            c0b = FleetClient(fleet.direct_port(0))
+            c0b.must_exec("use test")
+            assert c0b.must_query(
+                "select count(*) from viz")[1] == [("3",)]
+            c0b.close()
+        finally:
+            drained = fleet.shutdown()
+        assert drained and drained["ok"], drained
+
+    def test_sigkill_mid_commit_loop_recovers(self, tmp_path):
+        """SIGKILL workers at randomized WAL/2PC stage failpoints while
+        clients insert; after respawn + recovery: every ACKED row
+        visible on EVERY worker, the un-acked mid-kill row GONE (the
+        armed stages all precede the commit record), then a cold fleet
+        restart over the same run dir replays the log and still serves
+        everything."""
+        import random
+        from tests.chaos_harness import FLEET_FAULTS
+        from tidb_tpu.fabric.client import FleetClient, WireError
+        from tidb_tpu.fabric.fleet import Fleet
+        rng = random.Random(15)
+        stages = ["txn-before-commit", "txn-after-prewrite",
+                  "wal-append-torn"]
+        doomed = {1: rng.choice(stages), 2: rng.choice(stages)}
+        for s in doomed.values():
+            assert s in FLEET_FAULTS  # catalogued kill stages only
+        run_dir = str(tmp_path / "fleet")
+        fleet = Fleet(4, compile_server=False, run_dir=run_dir,
+                      slot_env={
+                          s: {"TIDB_TPU_FABRIC_FAILPOINTS":
+                              f"{stage}=1*return(kill)"}
+                          for s, stage in doomed.items()})
+        fleet.start(timeout_s=300.0)
+        acked = []
+        try:
+            c = FleetClient(fleet.direct_port(0))
+            c.must_exec("use test")
+            c.must_exec("create table dur (id int primary key, v int)")
+            c.close()
+            row_id = 0
+            for slot in (0, 1, 2, 3, 1, 2):
+                row_id += 1
+                old_pid = fleet.worker_pid(slot)
+                try:
+                    cw = FleetClient(fleet.direct_port(slot))
+                    cw.must_exec("use test")
+                    cw.must_exec(
+                        f"insert into dur values ({row_id}, {row_id})")
+                    acked.append(row_id)
+                    cw.close()
+                except WireError:
+                    # the armed stage SIGKILLed this worker mid-commit:
+                    # a clean classified drop, never an ack — the row
+                    # must be GONE fleet-wide (all stages pre-commit-
+                    # record)
+                    assert fleet.wait_respawn(slot, old_pid, 30.0), (
+                        f"slot {slot} not respawned")
+            assert len(acked) >= 4, acked
+            # every worker (incl. the recovered ones) serves every
+            # acked row and nothing else
+            for slot in range(4):
+                cv = FleetClient(fleet.direct_port(slot))
+                cv.must_exec("use test")
+                rows = cv.must_query(
+                    "select id from dur order by id")[1]
+                assert rows == [(str(i),) for i in acked], (
+                    f"slot {slot}: {rows} != acked {acked}")
+                cv.close()
+            assert fleet.respawns >= 1, "no kill stage ever fired"
+        finally:
+            drained = fleet.shutdown()
+        assert drained and drained["ok"], drained
+        # cold restart: a fresh fleet over the same run dir must
+        # recover the acked rows from the checkpoint + log alone
+        fleet2 = Fleet(2, compile_server=False, run_dir=run_dir)
+        fleet2.start(timeout_s=240.0)
+        try:
+            for slot in range(2):
+                cv = FleetClient(fleet2.direct_port(slot))
+                cv.must_exec("use test")
+                rows = cv.must_query(
+                    "select id from dur order by id")[1]
+                assert rows == [(str(i),) for i in acked], (
+                    f"restarted slot {slot}: {rows}")
+                cv.close()
+        finally:
+            drained2 = fleet2.shutdown()
+        assert drained2 and drained2["ok"], drained2
+
+
+@pytest.mark.chaos_threads
 class TestFleetProcessKill:
     """The fabric-kill-worker chaos satellite, end to end with real
     processes: SIGKILL mid-query -> clean classified client error,

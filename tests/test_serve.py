@@ -72,6 +72,25 @@ def test_bench_serve_smoke_fixed_seed():
         or sched_lines[0]["supervisor_hangs"] >= 1
 
 
+@pytest.mark.chaos_threads
+def test_bench_serve_durability_phase():
+    """ISSUE 15 smoke: the durability phase measures DML qps with WAL
+    off / fsync=never / fsync=commit (the group-commit overhead the
+    acceptance requires reported) and runs one SIGKILL-mid-commit →
+    recover round trip — zero lost acked rows, the mid-kill txn gone.
+    run_durability raises on any violation; the JSON line is pinned."""
+    emitted = []
+    out = bench_serve.run_durability(n_txns=60, emit=emitted.append)
+    assert out["recovered"] == out["acked"]
+    assert out["kill_recover_s"] >= 0
+    for key in ("qps_wal_off", "qps_fsync_never", "qps_fsync_commit",
+                "group_commit_overhead_pct"):
+        assert key in out, out
+    assert out["qps_fsync_commit"] > 0
+    assert [e for e in emitted
+            if e["metric"] == "serve_durability"] == [out]
+
+
 def test_starved_tenant_p99_bounded():
     """The WFQ acceptance regression: a light tenant's p99 stays bounded
     while a heavy tenant floods the device with analytics.  With

@@ -85,12 +85,81 @@ def backup_database(session, db_name: str, dest: str) -> dict:
                             {"h": h, "v": value.hex()}) + "\n")
                         n += 1
             meta["tables"].append({"name": info.name, "rows": n})
+        meta["wal"] = _backup_wal_tail(session, st, txn.start_ts)
     finally:
         txn.rollback()
         if coord is not None:
             coord.clear_safepoint(pin_key)
     st.write_text("backupmeta.json", json.dumps(meta, indent=1))
     return meta
+
+
+def _backup_wal_tail(session, st, backup_ts: int) -> "dict | None":
+    """Durable-store half of the backup (kv/wal.py): ship the LAST
+    checkpoint (when one exists) plus the log tail since it, filtered
+    to records at or below the backup snapshot ts — so a physical
+    restore replays to EXACTLY the backup point, commits that raced
+    past the snapshot excluded the same way the scan excluded them.
+    THEN checkpoint (bounding future recovery; the truncation must not
+    eat the tail we just shipped, so ship-first).  None when the store
+    is not durable (in-memory deployments carry no wal)."""
+    eng = session.store.mvcc
+    wal = getattr(eng, "wal", None)
+    if wal is None or not hasattr(eng, "dump_state"):
+        return None
+    import pickle
+    from .kv.shared_store import _record_ts
+    ck = wal.read_checkpoint()
+    from_lsn = wal.base_lsn
+    if ck is not None and ck[0] >= wal.base_lsn:
+        st.write_file("wal.ckpt.bin", ck[1])
+        from_lsn = ck[0]
+    tail = [rec for rec, _lsn in wal.read_records(from_lsn)
+            if _record_ts(rec) <= backup_ts]
+    st.write_file("wal.tail.bin", pickle.dumps(tail, protocol=4))
+    ck_lsn = wal.checkpoint(eng.dump_state())
+    return {"checkpoint_lsn": ck_lsn, "tail_records": len(tail),
+            "has_checkpoint": ck is not None, "backup_ts": backup_ts}
+
+
+def restore_wal_tail(storage, src: str) -> int:
+    """Replay a backup's WAL tail into a DURABLE ``storage``
+    (kv.Storage over kv/shared_store.DurableMVCCStore): the
+    physical-restore path to the exact backup ts.  Records walk the
+    engine's own journal-apply path (prewrite → locks, commit →
+    conversion, last-disposition-wins), and the oracle advances past
+    the replayed high-water so post-restore snapshots see everything.
+    Returns the number of records applied (0 when the backup carried
+    no tail or the target store is not durable)."""
+    import pickle
+    from .kv.shared_store import _record_ts
+    st = open_storage(src)
+    if not st.exists("wal.tail.bin"):
+        return 0
+    eng = storage.mvcc
+    apply_rec = getattr(eng, "_apply", None)
+    if apply_rec is None:
+        return 0
+    if st.exists("wal.ckpt.bin"):
+        eng.load_state(st.read_file("wal.ckpt.bin"))
+    records = pickle.loads(st.read_file("wal.tail.bin"))
+    max_ts = 0
+    for rec in records:
+        apply_rec(rec, replay=True)
+        max_ts = max(max_ts, _record_ts(rec))
+    if max_ts:
+        eng.tso.advance_to(max_ts)
+    # locks left over are txns that had not committed at backup_ts
+    # (their commit record was filtered out): not part of the backup
+    with eng._lock:
+        leftovers = list(eng.locks.items())
+    for key, lk in leftovers:
+        from .kv.mvcc import MVCCStore
+        MVCCStore.rollback(eng, [key], lk.start_ts)
+    # the journal-apply path writes replica state, not the target's own
+    # log — checkpoint so the restored state is durable in ONE step
+    eng.wal.checkpoint(eng.dump_state())
+    return len(records)
 
 
 # -- physical backup / restore (reference: br/pkg/backup's SST export +
@@ -263,6 +332,11 @@ def physical_restore_database(session, src: str,
 
 def restore_database(session, src: str, db_name: str | None = None,
                      meta: dict | None = None) -> dict:
+    # restore into a running FLEET propagates by construction: every
+    # _create_from_info commit bumps the meta schema version, which the
+    # durable store publishes to the segment's schema-version cell —
+    # sibling workers' schema leases reload and their replicas tail the
+    # restored rows (kv/shared_store.py)
     st = open_storage(src)
     if meta is None:  # the session layer passes its already-parsed copy
         meta = json.loads(st.read_text("backupmeta.json"))

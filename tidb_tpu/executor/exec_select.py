@@ -130,6 +130,12 @@ class QueryExecutor:
             # call".  Empty (no annotation noise) outside a fleet.
             from ..fabric import state
             self.annotate(**state.report_gauges())
+            # durable shared store (kv/wal.py): append/fsync/group-
+            # commit/recovery counters once a WAL has ever fired in
+            # this process — "what did durability cost this query's
+            # session" from the plan.  Empty on in-memory stores.
+            from ..kv import wal
+            self.annotate(**wal.report_gauges())
         return out
 
 

@@ -15,13 +15,30 @@ Layout (little-endian, fixed offsets — no allocation after create):
     HEADER    magic, nslots, ntenants, ndedup, created
     COUNTERS  fleet-global u64 counters (dedup hits/leads/timeouts,
               lease reclaims, respawns, prewarm dedup, result-id seq)
-    SLOTS     per-worker lease: pid, lease_ts, generation
+              plus the durable-store CELLS: the fleet TSO high-water
+              (``_tso`` — batched leases make every worker's timestamps
+              fleet-monotonic), the published schema version
+              (``_schema_ver`` — the schema-lease propagation cell) and
+              the committed WAL length (``_wal_len`` — appenders
+              truncate any torn garbage a SIGKILLed writer left past it
+              before writing, and tailers never read beyond it)
+    SLOTS     per-worker lease: pid, lease_ts, generation, plus the
+              slot's MIN READ TS (oldest live snapshot — the fleet GC
+              floor) and its APPLIED WAL LSN (how far its replica
+              tailed — the log-truncation floor)
     TENANTS   per-tenant row: name, WFQ virtual clock, peak running,
               running[slot] and hbm_bytes[slot] COLUMNS — per-slot
               attribution is what makes crash reclaim exact: zeroing a
               dead slot's column cannot touch a survivor's counts
     DEDUP     fragment-dedup slots: key hash, state, owner slot,
               timestamp, result page id
+    LOCKS     the shared 2PC lock/primary table (kv/shared_store.py):
+              key-HASH entries stamped (start_ts, owner slot) make
+              cross-worker write-write conflict detection synchronous —
+              a prewrite claims here BEFORE its local checks, so two
+              workers can never prewrite the same key concurrently; a
+              dead slot's claims are freed by lease reclaim (the data
+              locks themselves are resolved by WAL recovery)
 
 Every mutation happens under the sidecar lock file (``<path>.lock``,
 ``fcntl.flock``) plus an in-process mutex (flock is per open file
@@ -54,13 +71,14 @@ from multiprocessing import shared_memory
 
 log = logging.getLogger("tidb_tpu.fabric.coord")
 
-MAGIC = b"TPUFAB1\0"
+MAGIC = b"TPUFAB2\0"
 
 #: segment geometry defaults (fixed at create; attach reads them from the
 #: coordinator file)
 NSLOTS_DEFAULT = 16
 NTENANTS_DEFAULT = 48
 NDEDUP_DEFAULT = 128
+NLOCKS_DEFAULT = 256
 
 #: fleet-global counter names, in segment order
 COUNTER_NAMES = (
@@ -71,6 +89,9 @@ COUNTER_NAMES = (
     "fabric_respawns",          # parent worker respawns
     "fabric_prewarm_dedup",     # prewarm submissions skipped fleet-wide
     "_result_id_seq",           # monotonic dedup result-page id
+    "_tso",                     # fleet TSO high-water (batched leases)
+    "_schema_ver",              # published schema version (schema lease)
+    "_wal_len",                 # committed WAL length (torn-tail fence)
 )
 
 #: dedup slot states
@@ -81,9 +102,12 @@ DFREE, DBUILDING, DDONE, DFAILED = 0, 1, 2, 3
 BUILD_LEASE_S = 10.0
 
 _HDR = struct.Struct("<8sIIIId")                         # + created f64
-_SLOT = struct.Struct("<QdQ")                            # pid, lease, gen
+_SLOT = struct.Struct("<QdQQQ")                          # pid, lease, gen,
+#                                                          min_read_ts,
+#                                                          wal_applied
 _DED = struct.Struct("<16sIIdQ")                         # hash,state,owner,ts,rid
 _TEN_FIXED = struct.Struct("<40sdII")                    # name,vtime,peak,pad
+_LCK = struct.Struct("<16sQId")                          # hash,start_ts,slot,ts
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
@@ -100,6 +124,7 @@ class Coordinator:
         self.nslots = meta["nslots"]
         self.ntenants = meta["ntenants"]
         self.ndedup = meta["ndedup"]
+        self.nlocks = meta.get("nlocks", NLOCKS_DEFAULT)
         self.pages_dir = meta["pages_dir"]
         self._created = created
         self._tlock = threading.Lock()
@@ -111,7 +136,8 @@ class Coordinator:
         self._ten_sz = (_TEN_FIXED.size + 4 * self.nslots
                         + 8 * self.nslots)
         self._o_dedup = self._o_tenants + self.ntenants * self._ten_sz
-        self.size = self._o_dedup + self.ndedup * _DED.size
+        self._o_locks = self._o_dedup + self.ndedup * _DED.size
+        self.size = self._o_locks + self.nlocks * _LCK.size
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -119,6 +145,7 @@ class Coordinator:
     def create(cls, path: str, nslots: int = NSLOTS_DEFAULT,
                ntenants: int = NTENANTS_DEFAULT,
                ndedup: int = NDEDUP_DEFAULT,
+               nlocks: int = NLOCKS_DEFAULT,
                pages_dir: "str | None" = None) -> "Coordinator":
         """Create the segment + coordinator file (the fleet parent)."""
         if pages_dir is None:
@@ -126,11 +153,11 @@ class Coordinator:
         os.makedirs(pages_dir, exist_ok=True)
         name = f"tpufab-{os.getpid()}-{secrets.token_hex(4)}"
         meta = {"segment": name, "nslots": nslots, "ntenants": ntenants,
-                "ndedup": ndedup, "pages_dir": pages_dir,
+                "ndedup": ndedup, "nlocks": nlocks, "pages_dir": pages_dir,
                 "created": time.time()}
         size = (_HDR.size + 8 * len(COUNTER_NAMES) + nslots * _SLOT.size
                 + ntenants * (_TEN_FIXED.size + 12 * nslots)
-                + ndedup * _DED.size)
+                + ndedup * _DED.size + nlocks * _LCK.size)
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         _untrack(shm)
         shm.buf[:size] = b"\0" * size
@@ -227,30 +254,32 @@ class Coordinator:
         pid = pid if pid is not None else os.getpid()
         with self._locked():
             off = self._slot_off(slot)
-            _pid, _lease, gen = _SLOT.unpack_from(self._buf, off)
+            _pid, _lease, gen, _mrt, _wa = _SLOT.unpack_from(self._buf, off)
             self._zero_slot_columns_locked(slot)
-            _SLOT.pack_into(self._buf, off, pid, time.time(), gen + 1)
+            _SLOT.pack_into(self._buf, off, pid, time.time(), gen + 1, 0, 0)
 
     def heartbeat(self, slot: int):
         with self._locked():
             off = self._slot_off(slot)
-            pid, _lease, gen = _SLOT.unpack_from(self._buf, off)
+            pid, _lease, gen, mrt, wa = _SLOT.unpack_from(self._buf, off)
             if pid:
-                _SLOT.pack_into(self._buf, off, pid, time.time(), gen)
+                _SLOT.pack_into(self._buf, off, pid, time.time(), gen,
+                                mrt, wa)
 
     def release_slot(self, slot: int):
         """Clean worker exit: drop the lease and every per-slot count."""
         with self._locked():
             self._zero_slot_columns_locked(slot)
-            _SLOT.pack_into(self._buf, self._slot_off(slot), 0, 0.0, 0)
+            _SLOT.pack_into(self._buf, self._slot_off(slot), 0, 0.0, 0,
+                            0, 0)
 
     def live_slots(self, lease_timeout_s: float = 2.0) -> list:
         now = time.time()
         with self._locked():
             out = []
             for s in range(self.nslots):
-                pid, lease, _g = _SLOT.unpack_from(
-                    self._buf, self._slot_off(s))
+                pid, lease = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))[:2]
                 if pid and now - lease <= lease_timeout_s:
                     out.append(s)
             return out
@@ -265,10 +294,10 @@ class Coordinator:
         with self._locked():
             for s in range(self.nslots):
                 off = self._slot_off(s)
-                pid, lease, _g = _SLOT.unpack_from(self._buf, off)
+                pid, lease = _SLOT.unpack_from(self._buf, off)[:2]
                 if pid and now - lease > lease_timeout_s:
                     self._zero_slot_columns_locked(s)
-                    _SLOT.pack_into(self._buf, off, 0, 0.0, 0)
+                    _SLOT.pack_into(self._buf, off, 0, 0.0, 0, 0, 0)
                     self._bump_locked("fabric_lease_reclaims")
                     n += 1
         return n
@@ -287,6 +316,14 @@ class Coordinator:
             h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
             if state == DBUILDING and owner == slot:
                 _DED.pack_into(self._buf, off, h, DFAILED, owner, ts, rid)
+        # free the dead slot's shared 2PC lock claims: the DATA locks
+        # (the replicas' prewrite locks) are resolved by WAL recovery via
+        # their primary; the claim entries only serialize live prewrites
+        for i in range(self.nlocks):
+            off = self._o_locks + i * _LCK.size
+            h, start_ts, owner, _ts = _LCK.unpack_from(self._buf, off)
+            if start_ts and owner == slot:
+                _LCK.pack_into(self._buf, off, b"\0" * 16, 0, 0, 0.0)
 
     # -- tenants -------------------------------------------------------------
 
@@ -426,6 +463,149 @@ class Coordinator:
                 _U64.unpack_from(self._buf, self._hbm_off(t, s))[0]
                 for s in range(self.nslots) if s != exclude_slot)
 
+    # -- durable shared store (kv/wal.py + kv/shared_store.py) ----------------
+
+    def tso_lease(self, n: int, floor: int = 0) -> tuple:
+        """Allocate a batch of ``n`` fleet-monotonic timestamps: returns
+        ``(base, base + n]`` — the caller hands them out locally without
+        touching the segment again.  ``floor`` keeps the counter
+        wall-clock anchored (the hybrid physical part), so GC's
+        now-based safepoint arithmetic stays meaningful."""
+        with self._locked():
+            off = self._ctr_off("_tso")
+            base = max(_U64.unpack_from(self._buf, off)[0], floor)
+            _U64.pack_into(self._buf, off, base + n)
+            return (base, base + n)
+
+    def publish_schema_version(self, version: int) -> int:
+        """Forward-only schema-version cell (the fleet schema lease):
+        a DDL commit publishes here; workers whose local infoschema lags
+        reload before serving, and a commit planned against an older
+        version fails retriably (ErrInfoSchemaChanged)."""
+        with self._locked():
+            off = self._ctr_off("_schema_ver")
+            cur = _U64.unpack_from(self._buf, off)[0]
+            if version > cur:
+                _U64.pack_into(self._buf, off, version)
+                return version
+            return cur
+
+    def schema_version(self) -> int:
+        with self._locked():
+            return _U64.unpack_from(
+                self._buf, self._ctr_off("_schema_ver"))[0]
+
+    def wal_len(self) -> int:
+        with self._locked():
+            return _U64.unpack_from(self._buf, self._ctr_off("_wal_len"))[0]
+
+    def set_wal_len(self, n: int):
+        with self._locked():
+            _U64.pack_into(self._buf, self._ctr_off("_wal_len"), n)
+
+    def set_min_read_ts(self, slot: int, ts: int):
+        """Publish this worker's oldest live snapshot ts (0 = none): the
+        fleet GC safepoint floors at the minimum over live slots, so GC
+        on any worker can never drop a version a sibling still reads."""
+        with self._locked():
+            off = self._slot_off(slot)
+            pid, lease, gen, _mrt, wa = _SLOT.unpack_from(self._buf, off)
+            if pid:
+                _SLOT.pack_into(self._buf, off, pid, lease, gen,
+                                max(int(ts), 0), wa)
+
+    def fleet_min_read_ts(self, lease_timeout_s: float = 2.0) -> int:
+        """min over LIVE slots' nonzero min-read-ts columns (0 = no
+        reader pins the floor anywhere in the fleet)."""
+        now = time.time()
+        with self._locked():
+            best = 0
+            for s in range(self.nslots):
+                pid, lease, _g, mrt, _wa = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))
+                if pid and now - lease <= lease_timeout_s and mrt:
+                    best = mrt if not best else min(best, mrt)
+            return best
+
+    def set_wal_applied(self, slot: int, lsn: int):
+        with self._locked():
+            off = self._slot_off(slot)
+            pid, lease, gen, mrt, _wa = _SLOT.unpack_from(self._buf, off)
+            if pid:
+                _SLOT.pack_into(self._buf, off, pid, lease, gen, mrt,
+                                int(lsn))
+
+    def min_wal_applied(self) -> "int | None":
+        """The truncation floor: the smallest applied-LSN over every
+        CLAIMED slot (pid stamped), or None when none is claimed.  A
+        stalled-but-alive worker (lease momentarily old — a GIL-holding
+        compile) still holds its slot, and truncating past its applied
+        frontier would leave it permanently missing the records only
+        the checkpoint now holds; a genuinely dead worker's slot is
+        reclaimed (pid zeroed) and stops gating truncation then."""
+        with self._locked():
+            vals = []
+            for s in range(self.nslots):
+                pid, _lease, _g, _mrt, wa = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))
+                if pid:
+                    vals.append(wa)
+            return min(vals) if vals else None
+
+    # the shared 2PC lock/primary table ---------------------------------------
+
+    def _lck_off(self, i: int) -> int:
+        return self._o_locks + i * _LCK.size
+
+    def lock_claim(self, hashes, start_ts: int, slot: int) -> tuple:
+        """All-or-nothing claim of key-hash entries for ``start_ts``.
+        Returns ``(0, -1)`` on success, ``(holder_start_ts, idx)`` on a
+        conflict with a foreign claim (idx = position in ``hashes``; the
+        caller raises LockedError and walks the normal lock-wait
+        ladder), or ``(-1, -1)`` when the table is too full to claim
+        (the caller degrades to local-only conflict detection, the same
+        graceful shape as a full tenant table)."""
+        want = list(hashes)
+        if not self.nlocks:
+            return (-1, -1)
+        with self._locked():
+            by_hash = {}
+            free = []
+            for i in range(self.nlocks):
+                off = self._lck_off(i)
+                h, sts, owner, _ts = _LCK.unpack_from(self._buf, off)
+                if sts:
+                    by_hash[h] = sts
+                else:
+                    free.append(i)
+            need = []
+            for idx, h in enumerate(want):
+                held = by_hash.get(h)
+                if held is not None:
+                    if held != start_ts:
+                        return (held, idx)  # conflict: foreign claim
+                    continue                # ours already (pessimistic)
+                need.append(h)
+            if len(need) > len(free):
+                return (-1, -1)
+            now = time.time()
+            for h, i in zip(need, free):
+                _LCK.pack_into(self._buf, self._lck_off(i), h,
+                               start_ts, slot, now)
+            return (0, -1)
+
+    def lock_release(self, start_ts: int, hashes=None):
+        """Free entries claimed by ``start_ts`` — all of them
+        (commit/rollback), or only ``hashes`` (a failed claim batch of a
+        txn that still holds earlier pessimistic claims)."""
+        only = None if hashes is None else set(hashes)
+        with self._locked():
+            for i in range(self.nlocks):
+                off = self._lck_off(i)
+                h, sts, _owner, _ts = _LCK.unpack_from(self._buf, off)
+                if sts == start_ts and (only is None or h in only):
+                    _LCK.pack_into(self._buf, off, b"\0" * 16, 0, 0, 0.0)
+
     # -- fragment dedup -------------------------------------------------------
 
     def _ded_off(self, i: int) -> int:
@@ -564,11 +744,12 @@ class Coordinator:
         with self._locked():
             slots = []
             for s in range(self.nslots):
-                pid, lease, gen = _SLOT.unpack_from(
+                pid, lease, gen, mrt, wa = _SLOT.unpack_from(
                     self._buf, self._slot_off(s))
                 if pid:
                     slots.append({"slot": s, "pid": pid, "gen": gen,
-                                  "lease_age_s": round(now - lease, 3)})
+                                  "lease_age_s": round(now - lease, 3),
+                                  "min_read_ts": mrt, "wal_applied": wa})
             tenants = {}
             for t in range(self.ntenants):
                 name = self._ten_name(t)
@@ -587,24 +768,37 @@ class Coordinator:
                 1 for i in range(self.ndedup)
                 if _DED.unpack_from(self._buf, self._ded_off(i))[1]
                 == DBUILDING)
+            held_locks = sum(
+                1 for i in range(self.nlocks)
+                if _LCK.unpack_from(self._buf,
+                                    self._o_locks + i * _LCK.size)[1])
             ctrs = {name: _U64.unpack_from(
                 self._buf, self._ctr_off(name))[0]
                 for name in COUNTER_NAMES if not name.startswith("_")}
+            ctrs["schema_version"] = _U64.unpack_from(
+                self._buf, self._ctr_off("_schema_ver"))[0]
         return {"slots": slots, "tenants": tenants,
-                "dedup_building": building, **ctrs}
+                "dedup_building": building, "held_locks": held_locks,
+                **ctrs}
 
     def verify_drained(self) -> dict:
         """Fleet drain invariant (the cross-process analog of
         scheduler.verify_drained): no live lease, zero running counts in
-        every tenant row, no dedup slot stuck building."""
+        every tenant row, no dedup slot stuck building, no shared 2PC
+        lock claim held, and every slot's min-read-ts column zeroed (an
+        exited worker must not pin the fleet GC floor forever)."""
         snap = self.snapshot()
         running = {g: t["running"] for g, t in snap["tenants"].items()
                    if t["running"]}
+        pinned = [s["slot"] for s in snap["slots"] if s["min_read_ts"]]
         return {"ok": not snap["slots"] and not running
-                and snap["dedup_building"] == 0,
+                and snap["dedup_building"] == 0
+                and snap["held_locks"] == 0 and not pinned,
                 "live_slots": [s["slot"] for s in snap["slots"]],
                 "running": running,
                 "dedup_building": snap["dedup_building"],
+                "held_locks": snap["held_locks"],
+                "min_read_pinned": pinned,
                 "lease_reclaims": snap["fabric_lease_reclaims"]}
 
 

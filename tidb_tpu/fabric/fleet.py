@@ -73,14 +73,20 @@ class Fleet:
                  compile_server: bool = True,
                  run_dir: "str | None" = None,
                  env_extra: "dict | None" = None,
-                 slot_env: "dict | None" = None):
-        """`init`: a "module:callable" data-seeding hook run by each
-        worker against its fresh Domain.  `sysvars`: GLOBAL sysvars every
-        worker applies at boot.  `slot_env`: {slot: {ENV: val}} extras
-        for individual workers (the chaos schedule's door: e.g.
+                 slot_env: "dict | None" = None,
+                 durable: bool = True):
+        """`init`: a "module:callable" data-seeding hook — under the
+        durable store (the default) it runs ONCE fleet-wide (the first
+        worker seeds, the rest replay the shared log); with
+        ``durable=False`` every worker runs it against an independent
+        in-memory Domain (the pre-ISSUE-15 topology).  `sysvars`:
+        GLOBAL sysvars every worker applies at boot.  `slot_env`:
+        {slot: {ENV: val}} extras for individual workers (the chaos
+        schedule's door: e.g.
         ``{2: {"TIDB_TPU_FABRIC_FAILPOINTS": "fabric-kill-worker=1*return(1)"}}``)."""
         self.procs = procs
         self.init = init
+        self.durable = durable
         self.sysvars = dict(sysvars or {})
         self.with_compile_server = compile_server
         self.run_dir = run_dir or tempfile.mkdtemp(prefix="tpufab-")
@@ -185,6 +191,12 @@ class Fleet:
         env["TIDB_TPU_FABRIC_COORD"] = self.coord.path
         env["TIDB_TPU_FABRIC_SLOT"] = str(s.idx)
         env["TIDB_TPU_FABRIC_PORT"] = str(self.port)
+        if self.durable:
+            # the shared durable store: one WAL + checkpoint dir for the
+            # whole fleet (kv/shared_store.py picks up the coordination
+            # segment for TSO/locks/tailing from the worker's fabric
+            # activation)
+            env["TIDB_TPU_WAL_DIR"] = os.path.join(self.run_dir, "wal")
         if self.init:
             env["TIDB_TPU_FABRIC_INIT"] = self.init
         if self.sysvars:

@@ -78,23 +78,58 @@ def main() -> int:
     state.activate(coordinator, slot,
                    os.environ.get("TIDB_TPU_COMPILE_SERVER") or None)
 
-    from ..utils import failpoint
-    for name, action in _parse_kv(
-            os.environ.get("TIDB_TPU_FABRIC_FAILPOINTS", "")):
-        failpoint.enable(name, action)
-
     from ..kv import new_store
     from ..session import bootstrap_domain
     from ..server.server import MySQLServer
 
-    domain = bootstrap_domain(new_store())
-    for name, val in _parse_kv(os.environ.get("TIDB_TPU_FABRIC_GLOBALS",
-                                              "")):
-        domain.global_vars[name] = val
-    if init_spec:
-        mod_name, _, fn_name = init_spec.partition(":")
-        import importlib
-        getattr(importlib.import_module(mod_name), fn_name)(domain)
+    wal_dir = os.environ.get("TIDB_TPU_WAL_DIR", "")
+
+    def _boot():
+        """[open store → recover → bootstrap → seed], one worker at a
+        time under the durable store's init lock: the FIRST worker in
+        pays the genesis writes (bootstrap + the init hook's seed
+        data), later workers replay them from the shared log and skip —
+        the paper's one-storage-layer bootstrap, not N independent
+        Domains that merely agree by seeding discipline."""
+        d = bootstrap_domain(new_store())
+        for name, val in _parse_kv(
+                os.environ.get("TIDB_TPU_FABRIC_GLOBALS", "")):
+            d.global_vars[name] = val
+        if init_spec:
+            mod_name, _, fn_name = init_spec.partition(":")
+            import importlib
+            import inspect
+            hook = getattr(importlib.import_module(mod_name), fn_name)
+            seeded_key = b"m:fabric_seeded"
+            seeded = bool(
+                wal_dir
+                and d.store.get_snapshot().get(seeded_key) is not None)
+            # the hook ALWAYS runs: KV-backed seed data replicates via
+            # the shared log (the hook must skip it when `seeded`), but
+            # process-LOCAL state — bulk-installed columnar caches —
+            # must be rebuilt in every worker
+            if "seeded" in inspect.signature(hook).parameters:
+                hook(d, seeded=seeded)
+            else:
+                hook(d)
+            if wal_dir and not seeded:
+                d.store.mvcc.raw_put(seeded_key, b"1")
+        return d
+
+    if wal_dir:
+        from ..kv.shared_store import store_init_lock
+        with store_init_lock(wal_dir):
+            domain = _boot()
+    else:
+        domain = _boot()
+
+    # chaos failpoints arm AFTER bootstrap/seed: a kill-at-2PC-stage
+    # schedule targets SERVED traffic, not the genesis writes (and the
+    # fabric-kill-worker hook only ever fires inside _run_query anyway)
+    from ..utils import failpoint
+    for name, action in _parse_kv(
+            os.environ.get("TIDB_TPU_FABRIC_FAILPOINTS", "")):
+        failpoint.enable(name, action)
 
     class FabricMySQLServer(MySQLServer):
         def _run_query(self, io, session, sql):
@@ -116,11 +151,20 @@ def main() -> int:
     import logging
     hb_log = logging.getLogger("tidb_tpu.fabric.worker")
 
+    def _min_read_ts() -> int:
+        """This worker's oldest live snapshot (0 = none): the fleet GC
+        floor column (kv/gcworker._fleet_min_read_ts reads the min)."""
+        starts = [
+            s.txn.start_ts for s in list(domain.sessions.values())
+            if getattr(s, "txn", None) is not None and s.txn.valid]
+        return min(starts) if starts else 0
+
     def heartbeat():
         n = 0
         while not stop.is_set():
             try:
                 coordinator.heartbeat(slot)
+                coordinator.set_min_read_ts(slot, _min_read_ts())
                 n += 1
                 if n % 8 == 0:
                     # peer-reclaim sweep: a crashed sibling's lease is
@@ -167,11 +211,17 @@ def main() -> int:
         "fabric": {k: v for k, v in state.snapshot().items()
                    if isinstance(v, (int, float))},
     }
+    from ..kv import wal as wal_mod
+    summary["wal"] = {k: v for k, v in wal_mod.snapshot().items() if v}
     print(json.dumps(summary), flush=True)
     # hooks OFF before the segment closes: session teardown + interpreter
     # exit still run residency GC callbacks, and a charge against a
     # closed coordinator would only log noise
     state.deactivate()
+    # flush + close the durable store BEFORE releasing the lease: the
+    # lease drop is the "my applied column no longer gates truncation"
+    # signal, so the log handle must already be quiesced
+    domain.store.close()
     coordinator.release_slot(slot)
     coordinator.close()
     return 0

@@ -85,14 +85,37 @@ class GCWorker:
     def compute_safepoint(self) -> int:
         """now - life_time as a TSO timestamp, floored at the oldest live
         transaction so open snapshots keep their read views (reference:
-        gc_worker.go calcNewSafePoint + minStartTS guard)."""
+        gc_worker.go calcNewSafePoint + minStartTS guard).  Under the
+        serving fabric the floor is FLEET-wide: every worker publishes
+        its oldest live read-ts into its segment slot column, and GC on
+        any worker floors below the minimum — a version a SIBLING
+        worker still reads is never dropped."""
         now_ms = int(time.time() * 1000)
         life_ms = int(self.life_time_s() * 1000)
         sp = max(now_ms - life_ms, 0) << 18
         min_start = self._min_active_start_ts()
         if min_start is not None:
             sp = min(sp, min_start - 1)
+        fleet_min = self._fleet_min_read_ts()
+        if fleet_min:
+            sp = min(sp, fleet_min - 1)
         return max(sp, 0)
+
+    def _fleet_min_read_ts(self) -> int:
+        """min over live fleet slots' published min-read-ts (0 = no
+        fabric, or no sibling pins the floor)."""
+        try:
+            from ..fabric import state as fabric_state
+            if not fabric_state.active():
+                return 0
+            return fabric_state.coordinator().fleet_min_read_ts()
+        except Exception as e:
+            # a torn-down segment must not fail a GC round — but a GC
+            # running blind to sibling readers is worth a classified log
+            from ..utils.backoff import classify
+            _log.warning("fleet min-read-ts unreadable (%s): %s",
+                         classify(e), e)
+            return 0
 
     def _min_active_start_ts(self):
         starts = [

@@ -44,6 +44,14 @@ class TSOracle:
     """Timestamp oracle (the PD TSO role, reference: tidb-server/main.go:74).
 
     Hybrid physical/logical like TiDB: ts = physical_ms << 18 | logical.
+
+    THE oracle abstraction: everything that needs a timestamp — 2PC
+    start/commit ts, raw_put's self-allocated commit_ts, snapshot read
+    views — calls ``next_ts()`` on the engine's ``tso`` slot.  Engines
+    accept an injected oracle so fleet mode
+    (kv/shared_store.SegmentTSOracle: batched leases off the shared
+    segment counter, fleet-monotonic) and solo mode (this class) share
+    one code path; nothing may mint a timestamp any other way.
     """
 
     def __init__(self):
@@ -65,6 +73,18 @@ class TSOracle:
                 self._logical = 0
                 phys += 1
             return (phys << 18) | self._logical
+
+    def advance_to(self, ts: int):
+        """Never issue a timestamp <= ``ts`` again.  Recovery calls this
+        with the log's high-water: a restarted process in the same
+        millisecond as the crash must not mint timestamps below versions
+        it just replayed (they would be invisible to new snapshots)."""
+        with self._lock:
+            phys, logical = ts >> 18, ts & 0x3FFFF
+            if phys > self._last_phys:
+                self._last_phys, self._logical = phys, logical
+            elif phys == self._last_phys and logical > self._logical:
+                self._logical = logical
 
 
 class Lock:
@@ -157,11 +177,11 @@ class MVCCStore:
     single-process control plane; scan hot paths hand out columnar data
     through the columnar cache, not per-key reads."""
 
-    def __init__(self):
+    def __init__(self, oracle=None):
         self._lock = threading.RLock()
         self.map = _SortedMap()
         self.locks: dict[bytes, Lock] = {}
-        self.tso = TSOracle()
+        self.tso = oracle if oracle is not None else TSOracle()
         self.regions: list[Region] = [Region(b"", b"", region_id=1)]
         self.safe_point = 0  # GC safe point (reference: store/gcworker)
         # deadlock detection: start_ts -> start_ts it waits for
@@ -362,6 +382,53 @@ class MVCCStore:
 
     def key_count(self) -> int:
         return len(self.map.keys)
+
+    def unwind_commit(self, keys, start_ts: int):
+        """Remove committed versions stamped ``start_ts`` (the WAL's
+        last-disposition-wins rule, kv/shared_store.py: a commit whose
+        record landed but whose fsync FAILED was rolled back by its
+        owner — a replica or recovery replaying commit-then-rollback
+        must converge on the rollback, not resurrect the commit)."""
+        with self._lock:
+            for key in keys:
+                chain = self.map.vals.get(key)
+                if not chain:
+                    continue
+                chain[:] = [v for v in chain
+                            if v[1] != start_ts or v[2] == OP_ROLLBACK]
+
+    # -- durable snapshot (kv/wal.py checkpoint payload) ---------------------
+
+    def dump_state(self) -> bytes:
+        """Pickle the full engine state — version chains INCLUDING
+        in-flight locks (a checkpoint taken mid-2PC keeps the locks; the
+        WAL tail's commit/rollback record resolves them on replay)."""
+        import pickle
+        with self._lock:
+            locks = {k: (l.start_ts, l.primary, l.op, l.value, l.ttl)
+                     for k, l in self.locks.items()}
+            return pickle.dumps({
+                "keys": self.map.keys, "vals": self.map.vals,
+                "locks": locks, "safe_point": self.safe_point,
+                "table_versions": self.table_versions,
+                "table_version_ts": self.table_version_ts,
+                # TSO high-water: a restore must never mint below it
+                "last_ts": self.tso.next_ts(),
+            }, protocol=4)
+
+    def load_state(self, blob: bytes):
+        import pickle
+        st = pickle.loads(blob)
+        with self._lock:
+            self.map.keys = list(st["keys"])
+            self.map.vals = dict(st["vals"])
+            self.locks = {k: Lock(*v) for k, v in st["locks"].items()}
+            self.safe_point = st["safe_point"]
+            self.table_versions = dict(st["table_versions"])
+            self.table_version_ts = dict(st["table_version_ts"])
+        adv = getattr(self.tso, "advance_to", None)
+        if adv is not None and st.get("last_ts"):
+            adv(st["last_ts"])
 
     def debug_chain(self, key: bytes):
         """[(commit_ts, start_ts, op, value)] newest-first (reference:

@@ -161,12 +161,15 @@ def _key_arrays(keys):
 class NativeMVCCStore:
     """Drop-in for kv.mvcc.MVCCStore backed by the C++ engine."""
 
-    def __init__(self):
+    def __init__(self, oracle=None):
         self._lib = load_engine()
         if self._lib is None:
             raise TiDBError(f"native engine unavailable: {_lib_err}")
         self._h = ctypes.c_void_p(self._lib.mvcc_new())
-        self.tso = TSOracle()
+        # the shared oracle abstraction (kv/mvcc.TSOracle): injected in
+        # fleet mode so raw_put/raw_batch_put's self-allocated commit_ts
+        # is fleet-monotonic through the same code path as solo mode
+        self.tso = oracle if oracle is not None else TSOracle()
         self.regions: list[Region] = [Region(b"", b"", region_id=1)]
         self.safe_point = 0
         self.table_versions: dict[int, int] = {}

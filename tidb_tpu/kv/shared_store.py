@@ -1,0 +1,621 @@
+"""Durable, fleet-coherent MVCC store: the paper's "one storage layer
+under many SQL servers", built from the embedded python engine
+(kv/mvcc.py) + a shared write-ahead log (kv/wal.py) + the fabric
+coordination segment (fabric/coord.py).
+
+The pieces and who owns what:
+
+* **WAL on the commit path** — every logical mutation (prewrite /
+  commit / rollback / raw puts / raw delete-range) appends a framed
+  record stamped with the origin slot.  Commit records are the
+  durability point: ``commit()`` appends (and group-fsyncs under
+  ``tidb_wal_fsync = commit``) BEFORE applying locally, so an acked
+  commit survives SIGKILL and an un-acked one is simply absent.
+* **Recovery** (:meth:`DurableMVCCStore.recover`) — Percolator
+  semantics: load the checkpoint snapshot, replay the log tail in
+  order, CRC-truncate the first torn record, then resolve orphaned
+  prewrites via their primary's disposition (a commit record for the
+  txn's start_ts means commit the leftovers; none means roll back).
+  In a live fleet, leftovers owned by a LIVE sibling slot are its
+  in-flight 2PC — left alone.
+* **Fleet TSO** (:class:`SegmentTSOracle`) — batched leases off the
+  segment's ``_tso`` cell make every worker's timestamps
+  fleet-monotonic through the same ``next_ts()`` abstraction solo mode
+  uses (kv/mvcc.TSOracle), closing the per-process-oracle collision.
+* **Shared lock table** — prewrite/pessimistic-lock claims key hashes
+  in the segment BEFORE local checks, so cross-worker write-write
+  conflicts are detected synchronously (LockedError → the normal
+  lock-wait ladder), not after the fact.  A full table degrades to
+  local-only detection; a dead slot's claims are freed by lease
+  reclaim.
+* **Tailing** — each worker replays every OTHER slot's records into its
+  local replica (foreign prewrites become visible locks; commits
+  convert them and bump table versions so the columnar cache
+  invalidates), at snapshot/txn creation (synchronous catch-up: a
+  statement begun after a peer's commit returned ALWAYS sees it) and
+  from a background tailer thread.
+* **Schema propagation** — a commit that writes the meta
+  schema-version key publishes the segment's ``_schema_ver`` cell; the
+  Domain's schema lease (session/session.py) reloads on a newer cell
+  and stale commits fail retriably with ErrInfoSchemaChanged.
+
+Failure semantics: a failed commit-record append (torn injection,
+fsync failure) rolls the local txn back, best-effort logs a rollback
+record, and re-raises — recovery honors the LAST disposition per
+start_ts, so live state and recovered state agree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import hashlib
+import json
+import logging
+import os
+import signal
+import threading
+import time
+
+from ..utils import failpoint
+from .mvcc import Lock, MVCCStore, TSOracle
+from . import wal as wal_mod
+
+log = logging.getLogger("tidb_tpu.kv.shared_store")
+
+#: timestamps per segment lease (one segment round-trip per BATCH ts)
+TSO_BATCH = 64
+
+#: background tailer poll period
+TAIL_INTERVAL_S = 0.01
+
+#: meta key whose commit publishes the fleet schema-version cell
+SCHEMA_VERSION_KEY = b"m:schema_version"
+
+
+def key_hash(key: bytes) -> bytes:
+    return hashlib.blake2b(key, digest_size=16).digest()
+
+
+class SegmentTSOracle:
+    """The fleet timestamp oracle: batched leases off the coordination
+    segment's monotonic ``_tso`` cell, wall-clock anchored so GC's
+    now-based safepoint arithmetic stays meaningful.  Same ``next_ts``
+    surface as kv/mvcc.TSOracle — engines cannot tell them apart."""
+
+    def __init__(self, coordinator, batch: int = TSO_BATCH):
+        self._c = coordinator
+        self._batch = max(int(batch), 1)
+        self._lock = threading.Lock()
+        self._next = 0
+        self._end = 0
+        self._local = TSOracle()  # post-unlink teardown fallback
+
+    def next_ts(self) -> int:
+        with self._lock:
+            if self._next < self._end:
+                self._next += 1
+                return self._next
+            # the lease floors at BOTH wall clock (GC arithmetic) and
+            # our own high-water (advance_to may have pushed _next past
+            # the old lease — e.g. after tailing a peer's commit)
+            floor = max(int(time.time() * 1000) << 18, self._next)
+            try:
+                base, end = self._c.tso_lease(self._batch, floor)
+            except Exception as e:  # noqa: BLE001 — segment unlinked at
+                #   teardown: stay monotonic past everything we issued
+                log.debug("segment tso lease failed (%s); local fallback",
+                          e)
+                ts = max(self._local.next_ts(), self._next + 1)
+                self._next = ts
+                return ts
+            self._next = base + 1
+            self._end = end
+            return self._next
+
+    def advance_to(self, ts: int):
+        """Never issue a timestamp <= ``ts``: a replica's clock may not
+        lag a commit it has applied (read-your-peers'-committed-writes
+        — batched leases otherwise leave this worker's snapshot ts
+        BELOW a peer's fresher commit_ts), nor a recovery high-water.
+        Local-only: the segment cell is already past any commit_ts it
+        ever granted, and the lease floor covers the recovery case."""
+        with self._lock:
+            self._local.advance_to(ts)
+            self._next = max(self._next, int(ts))
+
+
+def _table_id_of(key: bytes) -> "int | None":
+    """Best-effort table id from a record/index key (None for meta)."""
+    if len(key) >= 9 and key[:1] == b"t":
+        from .. import tablecodec
+        try:
+            return tablecodec._dec_i64(key[1:9])
+        except Exception as e:  # noqa: BLE001 — non-table 't' key: the
+            #   caller only loses a cache-invalidation bump
+            log.debug("table-id decode failed for %r: %s", key[:16], e)
+            return None
+    return None
+
+
+def _maybe_kill(payload):
+    if payload == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _record_ts(rec: tuple) -> int:
+    """The timestamp a log record carries (0 when none) — the replay
+    high-water the recovered oracle must resume above."""
+    kind = rec[0]
+    if kind == "commit":
+        return max(rec[2], rec[3])
+    if kind in ("prewrite", "rollback", "raw", "rawdel"):
+        return rec[2]
+    return 0
+
+
+class DurableMVCCStore(MVCCStore):
+    """kv/mvcc.MVCCStore + WAL durability + fleet coherence.
+
+    Solo (no coordinator): WAL append/recovery only — a single durable
+    process.  Fleet (coordinator + slot): adds the segment TSO, the
+    shared lock table, tailing and schema publication.
+    """
+
+    def __init__(self, wal: "wal_mod.WAL", *, coordinator=None,
+                 slot: int = -1, oracle=None):
+        super().__init__(oracle=oracle)
+        self.wal = wal
+        self._coord = coordinator
+        self._slot = int(slot)
+        self._tail_lock = threading.RLock()
+        self._applied_lsn = wal.base_lsn
+        #: start_ts values holding >=1 shared lock-table claim
+        self._claimed: set[int] = set()
+        self._claim_mu = threading.Lock()
+        self._lock_degrades = 0  # lock-table-full local-only fallbacks
+        self._tail_stop = threading.Event()
+        self._tail_thread = None
+        self._recovered = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def recover(self) -> dict:
+        """Checkpoint + tail replay + torn-tail truncation + orphan
+        resolution.  Idempotent; runs under the cross-process WAL lock
+        (boot of a fresh replica into a live fleet replays the whole
+        log while peers keep appending — the tailer picks up the rest).
+        """
+        from ..session import tracing
+        t0 = time.monotonic()
+        with tracing.span("store.recover"):
+            torn = self.wal.truncate_torn_tail()
+            start = self.wal.base_lsn
+            ck = self.wal.read_checkpoint()
+            if ck is not None and ck[0] >= self.wal.base_lsn:
+                self.load_state(ck[1])
+                start = ck[0]
+            end = self.wal.scan_valid_end()
+            replayed = 0
+            max_ts = 0
+            lock_owner: dict[int, int] = {}   # start_ts -> origin slot
+            disposition: dict[int, tuple] = {}  # start_ts -> last fate
+            for rec, lsn in self.wal.read_records(start, end):
+                fp = failpoint.inject("store-recover-replay")
+                _maybe_kill(fp)
+                self._apply(rec, replay=True, lock_owner=lock_owner,
+                            disposition=disposition)
+                max_ts = max(max_ts, _record_ts(rec))
+                replayed += 1
+            self._applied_lsn = end
+            if max_ts:
+                # the oracle must resume ABOVE every replayed version:
+                # a same-millisecond restart could otherwise mint
+                # timestamps below them (invisible to new snapshots)
+                self.tso.advance_to(max_ts)
+            # resolve orphaned prewrites via their primary: a commit
+            # record for the start_ts is the primary's committed proof;
+            # none means the txn died before its commit point.  Locks
+            # owned by a LIVE sibling slot are in-flight 2PC, not
+            # orphans.
+            live = set()
+            if self._coord is not None:
+                with contextlib.suppress(Exception):
+                    live = set(self._coord.live_slots())
+            resolved = 0
+            with self._lock:
+                leftovers = list(self.locks.items())
+            for key, lk in leftovers:
+                owner = lock_owner.get(lk.start_ts, -2)
+                if owner in live and owner != self._slot:
+                    continue
+                fate = disposition.get(lk.start_ts)
+                tid = _table_id_of(key)
+                if fate is not None and fate[0] == "commit":
+                    MVCCStore.commit(self, [key], lk.start_ts, fate[1])
+                    rec = ("commit", self._slot, lk.start_ts, fate[1],
+                           [key], [tid] if tid is not None else [])
+                else:
+                    MVCCStore.rollback(self, [key], lk.start_ts)
+                    rec = ("rollback", self._slot, lk.start_ts, [key])
+                # the resolution is logged so every replica (live peers
+                # tailing now, future recoveries) converges on one fate
+                with contextlib.suppress(Exception):
+                    self.wal.append(rec)
+                resolved += 1
+            self._publish_after_recovery()
+            self._recovered = True
+            wal_mod._bump("wal_recoveries")
+            wal_mod._bump("wal_replayed_records", replayed)
+            out = {"replayed": replayed, "torn_bytes": torn,
+                   "resolved_orphans": resolved,
+                   "from_checkpoint": ck is not None,
+                   "recover_s": round(time.monotonic() - t0, 4)}
+            log.info("store recovered: %s", out)
+            return out
+
+    def _publish_after_recovery(self):
+        if self._coord is None:
+            return
+        with contextlib.suppress(Exception):
+            if self._slot >= 0:
+                self._coord.set_wal_applied(self._slot, self._applied_lsn)
+            v = self._local_schema_version()
+            if v:
+                self._coord.publish_schema_version(v)
+
+    def _local_schema_version(self) -> int:
+        res = self.map.read(SCHEMA_VERSION_KEY, 1 << 62)
+        if res is None or res[1] is None:
+            return 0
+        with contextlib.suppress(Exception):
+            return int(json.loads(res[1]))
+        return 0
+
+    def fleet_schema_version(self) -> int:
+        """The published schema-version cell (0 solo / unreadable)."""
+        if self._coord is None:
+            return 0
+        try:
+            return self._coord.schema_version()
+        except Exception as e:  # noqa: BLE001 — segment may be unlinked
+            log.debug("schema cell unreadable: %s", e)
+            return 0
+
+    def start_tailer(self):
+        if self._coord is None or self._tail_thread is not None:
+            return
+
+        def loop():
+            while not self._tail_stop.wait(TAIL_INTERVAL_S):
+                try:
+                    self.catch_up()
+                except Exception as e:  # noqa: BLE001 — a tail hiccup
+                    #   retries next tick; persistent failure is visible
+                    #   as a stuck wal_applied column
+                    log.warning("wal tailer catch-up failed: %s", e)
+
+        self._tail_thread = threading.Thread(
+            target=loop, daemon=True, name="wal-tailer")
+        self._tail_thread.start()
+
+    def close(self):
+        self._tail_stop.set()
+        if self._tail_thread is not None:
+            self._tail_thread.join(timeout=2.0)
+        self.wal.close()
+
+    # -- tailing --------------------------------------------------------------
+
+    def catch_up(self):
+        """Apply every committed record past our applied frontier.  No
+        coordinator → solo: nothing ever appears we did not write."""
+        if self._coord is None:
+            return
+        with self._tail_lock:
+            self.wal.reopen_if_truncated()
+            if self._applied_lsn < self.wal.base_lsn:
+                # a peer truncated past us.  Legal only when our applied
+                # column said so (we had applied everything below the
+                # new base) or we are a slot the fleet reclaimed as dead
+                # — a zombie in that state may be missing checkpoint-
+                # only records, which is worth a loud log, not silence
+                log.warning(
+                    "wal truncated past applied frontier (%d < %d): "
+                    "records below the new base live only in the "
+                    "checkpoint", self._applied_lsn, self.wal.base_lsn)
+                self._applied_lsn = self.wal.base_lsn
+            end = self.wal.committed_lsn()
+            if end <= self._applied_lsn:
+                return
+            n = 0
+            for rec, lsn in self.wal.read_records(self._applied_lsn, end):
+                self._apply(rec)
+                self._applied_lsn = lsn
+                n += 1
+            if n and self._slot >= 0:
+                with contextlib.suppress(Exception):
+                    self._coord.set_wal_applied(self._slot,
+                                                self._applied_lsn)
+
+    def _apply(self, rec: tuple, replay: bool = False,
+               lock_owner: "dict | None" = None,
+               disposition: "dict | None" = None):
+        """Apply one log record to the local replica.  Outside replay,
+        records from OUR OWN slot are skipped (already applied live)."""
+        kind, origin = rec[0], rec[1]
+        own = (not replay and self._slot >= 0 and origin == self._slot)
+        if kind == "prewrite":
+            _k, _o, start_ts, primary, muts = rec
+            if lock_owner is not None:
+                lock_owner[start_ts] = origin
+            if own:
+                return
+            with self._lock:
+                for key, op, value in muts:
+                    cur = self.locks.get(key)
+                    if cur is not None and cur.start_ts != start_ts:
+                        # both sides degraded past the shared lock
+                        # table (table-full) and raced: keep ours, the
+                        # foreign txn's commit/rollback still applies
+                        log.warning(
+                            "foreign prewrite overlaps local lock "
+                            "(ts %d vs %d) — shared lock table was "
+                            "full", start_ts, cur.start_ts)
+                        continue
+                    self.locks[key] = Lock(start_ts, primary, op, value)
+        elif kind == "commit":
+            _k, _o, start_ts, commit_ts, keys, tids = rec
+            if disposition is not None:
+                disposition[start_ts] = ("commit", commit_ts)
+            if own:
+                return
+            # the local clock must pass the applied commit BEFORE it is
+            # readable, so the very next local snapshot includes it
+            self.tso.advance_to(commit_ts)
+            try:
+                MVCCStore.commit(self, keys, start_ts, commit_ts)
+            except Exception as e:  # noqa: BLE001 — a tailed commit for
+                #   a txn this replica resolved differently (degraded
+                #   lock-table race) must not wedge the tailer; the
+                #   divergence is logged, not swallowed
+                log.warning("tailed commit apply failed for ts %d: %s",
+                            start_ts, e)
+            for tid in tids:
+                self.bump_table_version(tid, commit_ts)
+            if not replay:
+                wal_mod._bump("wal_tail_records")
+        elif kind == "rollback":
+            _k, _o, start_ts, keys = rec
+            if disposition is not None:
+                disposition[start_ts] = ("rollback",)
+            if own:
+                return
+            # last disposition wins: a commit record followed by a
+            # rollback record for the same start_ts (its fsync failed
+            # and the owner rolled back) must UNWIND, not coexist
+            self.unwind_commit(keys, start_ts)
+            MVCCStore.rollback(self, keys, start_ts)
+            if not replay:
+                wal_mod._bump("wal_tail_records")
+        elif kind == "raw":
+            _k, _o, commit_ts, pairs, tids = rec
+            if own:
+                return
+            self.tso.advance_to(commit_ts)
+            MVCCStore.raw_batch_put(self, pairs, commit_ts)
+            for tid in tids:
+                self.bump_table_version(tid, commit_ts)
+        elif kind == "rawdel":
+            _k, _o, _ts, start, end = rec
+            if own:
+                return
+            MVCCStore.raw_delete_range(self, start, end)
+        else:
+            log.warning("unknown wal record kind %r skipped", kind)
+
+    # -- the shared lock table ------------------------------------------------
+
+    def _claim_shared(self, keys, start_ts: int):
+        from ..errors import LockedError
+        if self._coord is None:
+            return
+        keys = list(keys)
+        hashes = [key_hash(k) for k in keys]
+        try:
+            holder, idx = self._coord.lock_claim(hashes, start_ts,
+                                                 max(self._slot, 0))
+        except Exception as e:  # noqa: BLE001 — segment gone: local-only
+            log.debug("shared lock claim failed (%s); local-only", e)
+            return
+        if holder == -1:
+            self._lock_degrades += 1
+            return
+        if holder:
+            raise LockedError(
+                f"key locked by fleet txn {holder}",
+                key=keys[idx], lock_ts=holder)
+        with self._claim_mu:
+            self._claimed.add(start_ts)
+
+    def _release_shared(self, start_ts: int):
+        if self._coord is None:
+            return
+        with self._claim_mu:
+            if start_ts not in self._claimed:
+                return
+            self._claimed.discard(start_ts)
+        with contextlib.suppress(Exception):
+            self._coord.lock_release(start_ts)
+
+    # -- transactional overrides ----------------------------------------------
+
+    def prewrite(self, mutations, primary: bytes, start_ts: int):
+        self._claim_shared([m[0] for m in mutations], start_ts)
+        try:
+            self.catch_up()  # conflicts committed on peers must be seen
+            super().prewrite(mutations, primary, start_ts)
+        except BaseException:
+            self._release_shared(start_ts)
+            raise
+        # the prewrite record makes foreign locks visible to peers and
+        # gives recovery its orphan inventory; its durability rides the
+        # commit record's fsync (same file)
+        self.wal.append(("prewrite", self._slot, start_ts, primary,
+                         [(k, op, v) for k, op, v in mutations]))
+
+    def commit(self, keys, start_ts: int, commit_ts: int):
+        keys = list(keys)
+        tids = sorted({t for t in (_table_id_of(k) for k in keys)
+                       if t is not None})
+        schema_ver = self._pending_schema_version(keys, start_ts)
+        try:
+            # WAL discipline: the commit record lands (and fsyncs under
+            # policy `commit`) BEFORE the local apply — an acked commit
+            # is always recoverable
+            self.wal.append(("commit", self._slot, start_ts, commit_ts,
+                             keys, tids), sync=True)
+        except BaseException:
+            # the commit never reached its durability point: roll back
+            # (recovery honors the LAST disposition per start_ts, so a
+            # half-appended commit record is overridden)
+            with contextlib.suppress(Exception):
+                super().rollback(keys, start_ts)
+            with contextlib.suppress(Exception):
+                self.wal.append(("rollback", self._slot, start_ts, keys))
+            self._release_shared(start_ts)
+            raise
+        try:
+            super().commit(keys, start_ts, commit_ts)
+        finally:
+            self._release_shared(start_ts)
+        if schema_ver and self._coord is not None:
+            with contextlib.suppress(Exception):
+                self._coord.publish_schema_version(schema_ver)
+
+    def _pending_schema_version(self, keys, start_ts: int) -> int:
+        """The schema version this commit publishes (0 = not a DDL)."""
+        if self._coord is None or SCHEMA_VERSION_KEY not in keys:
+            return 0
+        with self._lock:
+            lk = self.locks.get(SCHEMA_VERSION_KEY)
+            if lk is None or lk.start_ts != start_ts or lk.value is None:
+                return 0
+            with contextlib.suppress(Exception):
+                return int(json.loads(lk.value))
+        return 0
+
+    def rollback(self, keys, start_ts: int):
+        keys = list(keys)
+        try:
+            super().rollback(keys, start_ts)
+            self.wal.append(("rollback", self._slot, start_ts, keys))
+        finally:
+            self._release_shared(start_ts)
+
+    def acquire_pessimistic_lock(self, keys, primary: bytes,
+                                 start_ts: int, for_update_ts: int):
+        keys = list(keys)
+        self._claim_shared(keys, start_ts)
+        try:
+            self.catch_up()
+            super().acquire_pessimistic_lock(keys, primary, start_ts,
+                                             for_update_ts)
+        except BaseException:
+            # free only THIS batch's claims: earlier statements of the
+            # txn still hold theirs until commit/rollback
+            if self._coord is not None:
+                with contextlib.suppress(Exception):
+                    self._coord.lock_release(
+                        start_ts, [key_hash(k) for k in keys])
+            raise
+
+    def resolve_lock(self, key: bytes, committed: bool, commit_ts: int = 0):
+        with self._lock:
+            lk = self.locks.get(key)
+        if lk is None:
+            return
+        super().resolve_lock(key, committed, commit_ts)
+        # the resolution must be fleet-visible: peers holding the same
+        # tailed lock converge on the same fate
+        rec = (("commit", self._slot, lk.start_ts, commit_ts, [key],
+                [t for t in (_table_id_of(key),) if t is not None])
+               if committed else
+               ("rollback", self._slot, lk.start_ts, [key]))
+        with contextlib.suppress(Exception):
+            self.wal.append(rec)
+
+    # -- raw overrides --------------------------------------------------------
+
+    def raw_put(self, key: bytes, value: bytes, commit_ts: int | None = None):
+        ts = commit_ts if commit_ts is not None else self.tso.next_ts()
+        super().raw_put(key, value, commit_ts=ts)
+        tid = _table_id_of(key)
+        self.wal.append(("raw", self._slot, ts, [(key, value)],
+                         [tid] if tid is not None else []))
+
+    def raw_batch_put(self, pairs, commit_ts: int | None = None):
+        pairs = list(pairs)
+        if not pairs:
+            return
+        ts = commit_ts if commit_ts is not None else self.tso.next_ts()
+        super().raw_batch_put(pairs, commit_ts=ts)
+        tids = sorted({t for t in (_table_id_of(k) for k, _v in pairs)
+                       if t is not None})
+        self.wal.append(("raw", self._slot, ts, pairs, tids))
+
+    def raw_delete_range(self, start: bytes, end: bytes):
+        super().raw_delete_range(start, end)
+        # ts-stamped so BR's backup-ts tail filter excludes a delete
+        # that raced PAST the backup snapshot (its rows are in the
+        # backup; replaying the delete would erase backed-up data)
+        self.wal.append(("rawdel", self._slot, self.tso.next_ts(),
+                         start, end))
+
+    # -- introspection --------------------------------------------------------
+
+    def wal_status(self) -> dict:
+        return {"applied_lsn": self._applied_lsn,
+                "end_lsn": self.wal.end_lsn(),
+                "base_lsn": self.wal.base_lsn,
+                "slot": self._slot,
+                "fleet": self._coord is not None,
+                "lock_degrades": self._lock_degrades,
+                "fsync_policy": self.wal.fsync_policy()}
+
+
+# -- construction -------------------------------------------------------------
+
+def open_durable_mvcc(wal_dir: str) -> DurableMVCCStore:
+    """Build (and recover) the durable engine for this process.  Fleet
+    context (coordinator + slot) is taken from fabric/state when a
+    worker activated it; otherwise the store is solo-durable."""
+    from ..fabric import state as fabric_state
+    coordinator = fabric_state.coordinator()
+    slot = fabric_state.slot() if coordinator is not None else -1
+    w = wal_mod.WAL(wal_dir, coordinator=coordinator)
+    oracle = (SegmentTSOracle(coordinator)
+              if coordinator is not None else None)
+    eng = DurableMVCCStore(w, coordinator=coordinator, slot=slot,
+                           oracle=oracle)
+    eng.recover()
+    if coordinator is not None:
+        eng.start_tailer()
+    return eng
+
+
+@contextlib.contextmanager
+def store_init_lock(wal_dir: str):
+    """Cross-process serialization of [open store → recover → bootstrap
+    → seed]: the first worker in pays the genesis writes, later workers
+    replay them from the log and skip (fabric/worker.py)."""
+    os.makedirs(wal_dir, exist_ok=True)
+    f = open(os.path.join(wal_dir, "init.lock"), "a+b")  # noqa: SIM115
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+    finally:
+        f.close()

@@ -17,6 +17,19 @@ from .mvcc import MVCCStore, OP_AMEND_FLAG, OP_DEL, OP_LOCK, OP_PUT
 _MISSING = object()
 
 
+def _inject_2pc(name: str):
+    """2PC-stage failpoint with process-kill payloads: the usual
+    actions (panic / N*panic / sleep) behave as before; a
+    ``return(kill)`` payload SIGKILLs the process AT the stage — the
+    crash-recovery matrix's per-stage death hook (tests/test_wal.py,
+    the fleet durability chaos)."""
+    from ..utils import failpoint
+    if failpoint.inject(name) == "kill":
+        import os
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 class MemBuffer:
     """Ordered txn-local write buffer with savepoints ("staging" in the
     reference, kv/memdb). dict + bisect-maintained sorted key list so range
@@ -239,7 +252,6 @@ class Transaction:
         if not muts:
             self.store.mvcc.clear_wait(self.start_ts)
             return self.start_ts
-        from ..utils import failpoint
         primary = muts[0][0]
         try:
             # the inject must sit INSIDE the rollback guard: self.valid is
@@ -247,7 +259,7 @@ class Transaction:
             # would orphan the txn's pessimistic locks forever (the caller's
             # rollback() no-ops) — the next writer would wait out its whole
             # lock budget against a dead txn
-            failpoint.inject("txn-before-prewrite")
+            _inject_2pc("txn-before-prewrite")
             self.store.mvcc.prewrite(muts, primary, self.start_ts)
         except Exception:
             self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
@@ -257,11 +269,11 @@ class Transaction:
         # so the caller's rollback would no-op and orphan them); a real
         # process crash instead leaves them for the resolve-lock path.
         try:
-            failpoint.inject("txn-after-prewrite")
+            _inject_2pc("txn-after-prewrite")
             commit_ts = self.store.next_ts()
             # fault point between TSO grant and the commit write — the
             # widest crash window of the 2PC protocol (chaos harness)
-            failpoint.inject("txn-before-commit")
+            _inject_2pc("txn-before-commit")
         except BaseException:
             self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
             raise
@@ -287,25 +299,54 @@ class Storage:
 
     backend: "native" (C++ engine, native/mvcc_engine.cpp), "python"
     (kv/mvcc.py), or "auto" (native when buildable, else python) — the
-    reference's store registry role (store.Register/New)."""
+    reference's store registry role (store.Register/New).
 
-    def __init__(self, backend: str = "auto"):
-        self.mvcc = _new_engine(backend)
+    ``wal_dir`` (or env ``TIDB_TPU_WAL_DIR``) makes the store DURABLE:
+    the python engine wrapped in kv/shared_store.DurableMVCCStore —
+    write-ahead logged, crash-recovered, and fleet-coherent when the
+    fabric coordination segment is active (the durable substrate owns
+    the version-chain format, so it pins the python engine; a native
+    checkpoint codec is an open ROADMAP corner)."""
+
+    def __init__(self, backend: str = "auto",
+                 wal_dir: "str | None" = None):
+        if wal_dir:
+            from .shared_store import open_durable_mvcc
+            self.mvcc = open_durable_mvcc(wal_dir)
+        else:
+            self.mvcc = _new_engine(backend)
         self.backend = type(self.mvcc).__name__
         self._lock = threading.Lock()
 
     def next_ts(self) -> int:
         return self.mvcc.tso.next_ts()
 
+    def _catch_up(self):
+        """Fleet read coherence: a new read view first applies every
+        peer commit already in the log, so a statement begun after a
+        sibling worker's commit returned always sees it."""
+        cu = getattr(self.mvcc, "catch_up", None)
+        if cu is not None:
+            cu()
+
     def begin(self, start_ts: int | None = None) -> Transaction:
+        self._catch_up()
         if start_ts is not None:
             self._check_safepoint(start_ts)
         return Transaction(self, start_ts if start_ts is not None else self.next_ts())
 
     def get_snapshot(self, ts: int | None = None) -> Snapshot:
+        self._catch_up()
         if ts is not None:
             self._check_safepoint(ts)
         return Snapshot(self, ts if ts is not None else self.next_ts())
+
+    def close(self):
+        """Release durable-store resources (tailer thread + WAL fds);
+        a plain in-memory engine has nothing to release."""
+        c = getattr(self.mvcc, "close", None)
+        if c is not None:
+            c()
 
     def _check_safepoint(self, ts: int):
         """A read view below the GC safepoint would see a history that GC
@@ -333,6 +374,12 @@ def _new_engine(backend: str):
     return NativeMVCCStore() if load_engine() is not None else MVCCStore()
 
 
-def new_store(backend: str = "auto") -> Storage:
-    """reference: store.New("unistore://...")"""
-    return Storage(backend=backend)
+def new_store(backend: str = "auto",
+              wal_dir: "str | None" = None) -> Storage:
+    """reference: store.New("unistore://...").  ``wal_dir`` (or env
+    ``TIDB_TPU_WAL_DIR``, the fabric worker's spawn contract) opens the
+    durable write-ahead-logged store instead of the in-memory engine."""
+    if wal_dir is None:
+        import os
+        wal_dir = os.environ.get("TIDB_TPU_WAL_DIR") or None
+    return Storage(backend=backend, wal_dir=wal_dir)

@@ -1,0 +1,561 @@
+"""Write-ahead log for the embedded MVCC store (the durable half of the
+paper's "many SQL servers over ONE storage layer").
+
+One append-only file of CRC-framed records::
+
+    FILE HEADER   <8s magic><Q base_lsn>          (16 bytes)
+    RECORD        <I payload_len><I crc32(payload)><payload>
+
+``lsn`` is the logical byte position since log birth (monotonic across
+truncations): ``physical offset = lsn - base_lsn``.  Payloads are small
+pickled tuples — the logical MVCC operations of kv/shared_store.py
+(prewrite / commit / rollback / raw puts / delete-range), each stamped
+with its origin slot so a fleet worker tailing the log skips its own
+records.
+
+Durability contract:
+
+* a record is WRITTEN (OS-buffered) at append time — that is what makes
+  it visible to fleet tailers — and DURABLE once fsynced;
+* the fsync policy is the ``tidb_wal_fsync`` GLOBAL sysvar:
+  ``commit`` (default) — every commit append joins a GROUP fsync: one
+  leader fsyncs the file once for every append that landed before it
+  took the flush lock, followers whose offset is already covered return
+  without syncing; ``interval`` — a background flusher fsyncs every
+  ``INTERVAL_S``; ``never`` — no fsync (crash loses the OS buffer tail,
+  torn/unsynced records are CRC-truncated at recovery);
+* recovery scans from the checkpoint (or base), verifies each frame's
+  CRC and TRUNCATES the file at the first torn/short/corrupt record —
+  later garbage can never be replayed as data.
+
+Torn-tail fencing in the SHARED (fleet) deployment: appends happen
+under the cross-process file lock, and the committed length lives in a
+segment cell (fabric/coord.py ``_wal_len``) — every appender first
+truncates any garbage a SIGKILLed writer left past the cell, so a torn
+record from a dead peer can never sit UNDER a survivor's appends, and
+tailers never read past the cell.
+
+Checkpoint: ``checkpoint(state_blob)`` writes the engine snapshot
+(tmp + atomic rename) stamped with the current LSN, then truncates the
+log tail up to the smallest LSN every live fleet replica has applied —
+recovery becomes "load snapshot, replay the short tail".
+
+Failpoints (chaos + crash-matrix hooks): ``wal-append-torn`` (payload
+``torn``: write half the frame, heal by truncating back, fail the
+append; payload ``kill``: write half the frame and SIGKILL — the torn
+bytes stay for recovery to CRC-truncate; ``panic`` action: fail before
+writing), ``wal-fsync-fail`` (``panic``: the fsync raises — the commit
+fails classified; ``kill``: SIGKILL before the fsync).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import pickle
+import signal
+import struct
+import threading
+import zlib
+
+from ..utils import failpoint
+from ..utils.failpoint import FailpointError
+
+log = logging.getLogger("tidb_tpu.kv.wal")
+
+WAL_MAGIC = b"TPUWAL1\0"
+_FHDR = struct.Struct("<8sQ")     # magic, base_lsn
+_RHDR = struct.Struct("<II")      # payload_len, crc32
+#: sanity bound on one record (a corrupt length field must not allocate)
+MAX_RECORD = 64 << 20
+
+#: fsync cadence for the ``interval`` policy
+INTERVAL_S = 0.02
+
+#: process-wide gauges (every WAL instance bumps these; snapshot() /
+#: report_gauges() follow the fabric/state.py surfacing pattern)
+STATS = {
+    "wal_appends": 0,            # records appended by this process
+    "wal_bytes": 0,              # payload+frame bytes appended
+    "wal_fsyncs": 0,             # physical fsync calls
+    "wal_group_commits": 0,      # commit appends served by a PEER's fsync
+    "wal_checkpoints": 0,        # checkpoints written
+    "wal_recoveries": 0,         # recovery passes run
+    "wal_replayed_records": 0,   # records applied during recovery
+    "wal_truncated_records": 0,  # torn/CRC-bad tail records dropped
+    "wal_tail_records": 0,       # foreign records applied by the tailer
+    "wal_fsync_errors": 0,       # failed fsyncs (commit failed classified)
+}
+_STATS_LOCK = threading.Lock()
+
+
+def _bump(key: str, n: int = 1):
+    with _STATS_LOCK:
+        STATS[key] += n
+
+
+def snapshot() -> dict:
+    with _STATS_LOCK:
+        return dict(STATS)
+
+
+def report_gauges() -> dict:
+    """EXPLAIN ANALYZE surfacing (fired-only, like fabric/state.py):
+    empty when no WAL has ever appended in this process, so ordinary
+    in-memory deployments carry zero annotation noise."""
+    s = snapshot()
+    if not (s["wal_appends"] or s["wal_recoveries"]):
+        return {}
+    out = {"wal_appends": s["wal_appends"], "wal_fsyncs": s["wal_fsyncs"]}
+    for k in ("wal_group_commits", "wal_replayed_records",
+              "wal_truncated_records", "wal_tail_records",
+              "wal_fsync_errors", "wal_checkpoints"):
+        if s[k]:
+            out[k] = s[k]
+    return out
+
+
+def reset_for_tests():
+    with _STATS_LOCK:
+        for k in STATS:
+            STATS[k] = 0
+
+
+class WAL:
+    """One process's handle on the log directory.
+
+    Files: ``wal.log`` (the framed log), ``checkpoint.bin`` (engine
+    snapshot + its LSN), ``wal.lock`` (the cross-process append flock —
+    per open file description, so it excludes sibling PROCESSES; the
+    in-process ``_lock`` mutex excludes sibling threads).
+    """
+
+    def __init__(self, dirpath: str, *, coordinator=None,
+                 fsync_default: str = "commit"):
+        os.makedirs(dirpath, exist_ok=True)
+        self.dir = dirpath
+        self.path = os.path.join(dirpath, "wal.log")
+        self.ckpt_path = os.path.join(dirpath, "checkpoint.bin")
+        self._coord = coordinator
+        self._lock = threading.RLock()
+        self._flush_cv = threading.Condition(threading.Lock())
+        self._synced_lsn = 0
+        self._flushing = False
+        self._closed = False
+        #: resolved at each decision point: a callable returning the
+        #: sysvar string (Domain installs one reading GLOBAL scope);
+        #: until then the env/ctor default applies
+        self.policy_source = None
+        self._fsync_default = os.environ.get("TIDB_TPU_WAL_FSYNC",
+                                             fsync_default)
+        self._lockf = open(os.path.join(dirpath, "wal.lock"),  # noqa: SIM115
+                           "a+b")
+        if not os.path.exists(self.path):
+            with self._flocked():
+                if not os.path.exists(self.path):
+                    tmp = self.path + f".{os.getpid()}.init"
+                    with open(tmp, "wb") as f:
+                        f.write(_FHDR.pack(WAL_MAGIC, 0))
+                    os.replace(tmp, self.path)
+        self._f = open(self.path, "r+b")  # noqa: SIM115 (held open)
+        hdr = self._f.read(_FHDR.size)
+        magic, self.base_lsn = _FHDR.unpack(hdr)
+        if magic != WAL_MAGIC:
+            raise ValueError(f"{self.path}: bad WAL magic {magic!r}")
+        self._f.seek(0, os.SEEK_END)
+        self._interval_stop = threading.Event()
+        self._interval_thread = None
+
+    # -- policy ---------------------------------------------------------------
+
+    def fsync_policy(self) -> str:
+        src = self.policy_source
+        if src is not None:
+            try:
+                v = str(src()).lower()
+                if v in ("never", "interval", "commit"):
+                    return v
+            except Exception as e:  # noqa: BLE001 — a torn-down domain
+                #   must not fail commits; fall through to the default
+                log.debug("wal fsync policy source failed: %s", e)
+        return self._fsync_default
+
+    # -- lsn bookkeeping ------------------------------------------------------
+
+    def end_lsn(self) -> int:
+        with self._lock:
+            self._f.seek(0, os.SEEK_END)
+            return self.base_lsn + self._f.tell() - _FHDR.size
+
+    def committed_lsn(self) -> int:
+        """The readable frontier: the segment's committed-length cell in
+        fleet mode (a torn tail from a dead peer sits past it), the file
+        end solo."""
+        if self._coord is not None:
+            try:
+                n = self._coord.wal_len()
+                if n:
+                    return n
+            except Exception as e:  # noqa: BLE001 — segment may be gone
+                log.debug("wal committed-length cell unreadable: %s", e)
+        return self.end_lsn()
+
+    @contextlib.contextmanager
+    def _flocked(self):
+        import fcntl
+        fcntl.flock(self._lockf, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(self._lockf, fcntl.LOCK_UN)
+
+    # -- append ---------------------------------------------------------------
+
+    def append(self, record: tuple, sync: "bool | None" = None) -> int:
+        """Frame + write one record; returns its END lsn.  ``sync=True``
+        (commit records under policy ``commit``) blocks until the bytes
+        are fsynced via the group protocol; ``sync=None`` derives from
+        the policy."""
+        from ..session import tracing
+        payload = pickle.dumps(record, protocol=4)
+        if len(payload) > MAX_RECORD:
+            raise ValueError(f"wal record too large: {len(payload)}")
+        frame = _RHDR.pack(len(payload), zlib.crc32(payload)) + payload
+        policy = self.fsync_policy()
+        if sync is None:
+            sync = False
+        with tracing.span("store.wal_append", bytes=len(frame),
+                          sync=bool(sync and policy == "commit")):
+            with self._lock, self._flocked():
+                if self._closed:
+                    raise FailpointError("wal closed")
+                end = self._repair_tail_locked()
+                fp = failpoint.inject("wal-append-torn")
+                if fp:
+                    # write HALF the frame — the torn-record shape the
+                    # recovery CRC scan must truncate
+                    self._f.seek(end - self.base_lsn + _FHDR.size)
+                    self._f.write(frame[:max(len(frame) // 2, 1)])
+                    self._f.flush()
+                    if fp == "kill":
+                        os.fsync(self._f.fileno())
+                        os.kill(os.getpid(), signal.SIGKILL)
+                    # in-process injection: HEAL (truncate back) so later
+                    # appends land on a clean tail, then fail the append
+                    self._f.truncate(end - self.base_lsn + _FHDR.size)
+                    raise FailpointError(
+                        "failpoint wal-append-torn triggered")
+                self._f.seek(end - self.base_lsn + _FHDR.size)
+                self._f.write(frame)
+                self._f.flush()
+                new_end = end + len(frame)
+                if self._coord is not None:
+                    self._coord.set_wal_len(new_end)
+            _bump("wal_appends")
+            _bump("wal_bytes", len(frame))
+            if policy == "commit" and sync:
+                self._sync_to(new_end)
+            elif policy == "interval":
+                self._ensure_interval_flusher()
+            return new_end
+
+    def _revalidate_handle_locked(self):
+        """A peer's checkpoint truncation rewrites wal.log via
+        os.replace: writing through a handle on the OLD (unlinked)
+        inode would durably 'commit' a record no reader can ever see.
+        Called under the flock before any write through ``_f``."""
+        try:
+            if os.stat(self.path).st_ino == os.fstat(
+                    self._f.fileno()).st_ino:
+                return
+        except OSError:
+            return
+        with contextlib.suppress(OSError):
+            self._f.close()
+        self._f = open(self.path, "r+b")  # noqa: SIM115 (held open)
+        hdr = self._f.read(_FHDR.size)
+        _magic, self.base_lsn = _FHDR.unpack(hdr)
+        self._f.seek(0, os.SEEK_END)
+
+    def _repair_tail_locked(self) -> int:
+        """The shared-log torn-tail fence: truncate any garbage past the
+        fleet's committed-length cell (a SIGKILLed peer died mid-append)
+        and return the clean end lsn.  Solo (no segment): the file end
+        IS the committed end — torn bytes there are handled at
+        recovery, and in-process injected tears heal in append()."""
+        self._revalidate_handle_locked()
+        self._f.seek(0, os.SEEK_END)
+        file_end = self.base_lsn + self._f.tell() - _FHDR.size
+        if self._coord is None:
+            return file_end
+        try:
+            cell = self._coord.wal_len()
+        except Exception as e:  # noqa: BLE001 — segment may be unlinked
+            log.debug("wal len cell unreadable at append: %s", e)
+            return file_end
+        if not cell:
+            return file_end
+        if file_end > cell:
+            self._f.truncate(cell - self.base_lsn + _FHDR.size)
+            _bump("wal_truncated_records")
+            return cell
+        if file_end < cell:
+            # a peer wrote the bytes but we raced its cell update, or
+            # the file was truncated behind the cell: trust the file
+            self._coord.set_wal_len(file_end)
+        return file_end
+
+    # -- group fsync ----------------------------------------------------------
+
+    def _sync_to(self, lsn: int):
+        """Group commit: one leader fsyncs for every append that landed
+        before it took over; followers whose lsn is already covered
+        return without a syscall (counted ``wal_group_commits``)."""
+        while True:
+            with self._flush_cv:
+                if self._synced_lsn >= lsn:
+                    _bump("wal_group_commits")
+                    return
+                if self._flushing:
+                    self._flush_cv.wait(timeout=1.0)
+                    continue
+                self._flushing = True
+            try:
+                self._fsync_once()
+            finally:
+                with self._flush_cv:
+                    self._flushing = False
+                    self._flush_cv.notify_all()
+            with self._flush_cv:
+                if self._synced_lsn >= lsn:
+                    return  # leader: own fsync covered it (not a group hit)
+            # loop: another append raced past; wait for the next flush
+
+    def _fsync_once(self):
+        # capture the frontier FIRST: the fsync covers at least this
+        cover = self.end_lsn()
+        fp = failpoint.inject("wal-fsync-fail")
+        if fp == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:
+            _bump("wal_fsync_errors")
+            raise
+        _bump("wal_fsyncs")
+        with self._flush_cv:
+            if cover > self._synced_lsn:
+                self._synced_lsn = cover
+
+    def _ensure_interval_flusher(self):
+        if self._interval_thread is not None \
+                and self._interval_thread.is_alive():
+            return
+        with self._lock:
+            if self._interval_thread is not None \
+                    and self._interval_thread.is_alive():
+                return
+
+            def loop():
+                while not self._interval_stop.wait(INTERVAL_S):
+                    try:
+                        with self._flush_cv:
+                            if self._flushing:
+                                continue
+                            self._flushing = True
+                        try:
+                            self._fsync_once()
+                        finally:
+                            with self._flush_cv:
+                                self._flushing = False
+                                self._flush_cv.notify_all()
+                    except Exception as e:  # noqa: BLE001 — background
+                        #   flush failure is surfaced via the gauge; the
+                        #   next commit-path fsync re-raises for real
+                        log.warning("wal interval fsync failed: %s", e)
+
+            self._interval_thread = threading.Thread(
+                target=loop, daemon=True, name="wal-interval-fsync")
+            self._interval_thread.start()
+
+    # -- read side ------------------------------------------------------------
+
+    def read_records(self, from_lsn: int, upto_lsn: "int | None" = None):
+        """Yield ``(record, end_lsn)`` from ``from_lsn`` to the
+        committed frontier (or ``upto_lsn``), stopping CLEANLY at the
+        first torn/corrupt frame (the caller decides whether that is a
+        recovery-truncation point or simply the current end)."""
+        end = self.committed_lsn() if upto_lsn is None else upto_lsn
+        if from_lsn >= end:
+            return
+        with open(self.path, "rb") as f:
+            hdr = f.read(_FHDR.size)
+            magic, base = _FHDR.unpack(hdr)
+            if magic != WAL_MAGIC:
+                return
+            pos = from_lsn
+            if pos < base:
+                raise ValueError(
+                    f"wal tail starts at {base}, reader wants {pos}: "
+                    "replica predates the last truncation")
+            f.seek(pos - base + _FHDR.size)
+            while pos < end:
+                rh = f.read(_RHDR.size)
+                if len(rh) < _RHDR.size:
+                    return
+                plen, crc = _RHDR.unpack(rh)
+                if plen > MAX_RECORD or pos + _RHDR.size + plen > end:
+                    return
+                payload = f.read(plen)
+                if len(payload) < plen or zlib.crc32(payload) != crc:
+                    return
+                try:
+                    rec = pickle.loads(payload)
+                except Exception as e:  # noqa: BLE001 — crc passed but
+                    #   the pickle is bad: treat as torn (stop cleanly)
+                    log.warning("wal record at lsn %d undecodable "
+                                "(treated as torn tail): %s", pos, e)
+                    return
+                pos += _RHDR.size + plen
+                yield rec, pos
+
+    def scan_valid_end(self) -> int:
+        """CRC-scan the physical file and return the lsn of the last
+        frame-complete record (the recovery truncation point)."""
+        with open(self.path, "rb") as f:
+            hdr = f.read(_FHDR.size)
+            _magic, base = _FHDR.unpack(hdr)
+            f.seek(0, os.SEEK_END)
+            file_end = base + f.tell() - _FHDR.size
+            pos = base
+            f.seek(_FHDR.size)
+            while pos < file_end:
+                rh = f.read(_RHDR.size)
+                if len(rh) < _RHDR.size:
+                    break
+                plen, crc = _RHDR.unpack(rh)
+                if plen > MAX_RECORD or pos + _RHDR.size + plen > file_end:
+                    break
+                payload = f.read(plen)
+                if len(payload) < plen or zlib.crc32(payload) != crc:
+                    break
+                pos += _RHDR.size + plen
+            return pos
+
+    def truncate_torn_tail(self) -> int:
+        """Recovery-time torn-tail truncation: cut the file at the last
+        valid frame; returns the number of torn bytes dropped."""
+        with self._lock, self._flocked():
+            self._revalidate_handle_locked()
+            good = self.scan_valid_end()
+            self._f.seek(0, os.SEEK_END)
+            file_end = self.base_lsn + self._f.tell() - _FHDR.size
+            torn = file_end - good
+            if torn > 0:
+                self._f.truncate(good - self.base_lsn + _FHDR.size)
+                _bump("wal_truncated_records")
+            if self._coord is not None:
+                with contextlib.suppress(Exception):
+                    cell = self._coord.wal_len()
+                    if not cell or cell > good:
+                        self._coord.set_wal_len(good)
+            return max(torn, 0)
+
+    # -- checkpoint -----------------------------------------------------------
+
+    def read_checkpoint(self) -> "tuple[int, bytes] | None":
+        """-> (lsn, engine-state blob) or None.  CRC-guarded like the
+        log itself: a torn checkpoint (crash mid-rename never happens —
+        rename is atomic — but a corrupt disk read might) falls back to
+        full-log replay."""
+        try:
+            with open(self.ckpt_path, "rb") as f:
+                hdr = f.read(_FHDR.size + _RHDR.size)
+                magic, lsn = _FHDR.unpack_from(hdr, 0)
+                plen, crc = _RHDR.unpack_from(hdr, _FHDR.size)
+                if magic != WAL_MAGIC or plen > (1 << 31):
+                    return None
+                blob = f.read(plen)
+                if len(blob) != plen or zlib.crc32(blob) != crc:
+                    return None
+                return (lsn, blob)
+        except OSError:
+            return None
+
+    def checkpoint(self, state_blob: bytes, *, truncate: bool = True) -> int:
+        """Write the snapshot at the current committed frontier, then
+        truncate the log tail up to the smallest LSN every live fleet
+        replica has applied (solo: the checkpoint lsn itself).  Returns
+        the checkpoint lsn."""
+        with self._lock, self._flocked():
+            lsn = self._repair_tail_locked()
+            tmp = self.ckpt_path + f".{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(_FHDR.pack(WAL_MAGIC, lsn))
+                f.write(_RHDR.pack(len(state_blob), zlib.crc32(state_blob)))
+                f.write(state_blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.ckpt_path)
+            _bump("wal_checkpoints")
+            if truncate:
+                floor = lsn
+                if self._coord is not None:
+                    with contextlib.suppress(Exception):
+                        applied = self._coord.min_wal_applied()
+                        if applied is not None:
+                            floor = min(floor, applied)
+                self._truncate_upto_locked(floor)
+            return lsn
+
+    def _truncate_upto_locked(self, lsn: int):
+        """Drop log records below ``lsn``: rewrite the file as
+        header(base_lsn=lsn) + tail, atomic rename.  The held flock
+        keeps appenders out; tailers re-resolve offsets from base_lsn."""
+        if lsn <= self.base_lsn:
+            return
+        self._f.seek(0, os.SEEK_END)
+        file_end = self.base_lsn + self._f.tell() - _FHDR.size
+        lsn = min(lsn, file_end)
+        self._f.seek(lsn - self.base_lsn + _FHDR.size)
+        tail = self._f.read()
+        tmp = self.path + f".{os.getpid()}.trunc"
+        with open(tmp, "wb") as f:
+            f.write(_FHDR.pack(WAL_MAGIC, lsn))
+            f.write(tail)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._f.close()
+        self._f = open(self.path, "r+b")  # noqa: SIM115 (held open)
+        self._f.seek(0, os.SEEK_END)
+        self.base_lsn = lsn
+
+    def reopen_if_truncated(self):
+        """Tailer hook: a peer's checkpoint may have rewritten the file
+        (new base_lsn).  Cheap stat check; reopen when the inode moved."""
+        try:
+            if os.stat(self.path).st_ino == os.fstat(
+                    self._f.fileno()).st_ino:
+                return
+        except OSError:
+            return
+        with self._lock:
+            with contextlib.suppress(OSError):
+                self._f.close()
+            self._f = open(self.path, "r+b")  # noqa: SIM115 (held open)
+            hdr = self._f.read(_FHDR.size)
+            _magic, self.base_lsn = _FHDR.unpack(hdr)
+            self._f.seek(0, os.SEEK_END)
+
+    def close(self):
+        self._interval_stop.set()
+        with self._lock:
+            self._closed = True
+            with contextlib.suppress(OSError):
+                self._f.flush()
+            with contextlib.suppress(OSError):
+                self._f.close()
+            with contextlib.suppress(OSError):
+                self._lockf.close()

@@ -45,6 +45,19 @@ def _fabric_snapshot() -> dict:
     return state.snapshot()
 
 
+def _wal_snapshot(domain) -> dict:
+    """Durable-store gauges (kv/wal.py + kv/shared_store.py): append /
+    fsync / group-commit / recovery / torn-truncation counters, plus
+    this replica's applied-vs-end LSN when the store is durable — WAL
+    lag and recovery history diagnosable from the status port."""
+    from ..kv import wal as wal_mod
+    out = wal_mod.snapshot()
+    status = getattr(domain.store.mvcc, "wal_status", None)
+    if status is not None:
+        out.update(status())
+    return out
+
+
 class StatusServer:
     def __init__(self, domain, sql_server=None, host="127.0.0.1", port=10080):
         self.domain = domain
@@ -167,6 +180,10 @@ class StatusServer:
             # RTT + remote errors — which worker this is and whether the
             # fleet is whole, diagnosable from any worker's status port
             "device_fabric": _fabric_snapshot(),
+            # durable shared store (kv/wal.py): appends, fsync policy +
+            # counts, group commits, recoveries, torn-tail truncations,
+            # and this replica's applied WAL frontier
+            "storage_wal": _wal_snapshot(self.domain),
         }
 
     def _metrics(self):
@@ -219,6 +236,15 @@ class StatusServer:
         gauges.setdefault("fabric_dedup_hits", fs["fabric_dedup_hits"])
         gauges.setdefault("fabric_compile_rtt_ms",
                           fs["fabric_compile_rtt_ms"])
+        ws = _wal_snapshot(self.domain)
+        gauges.setdefault("wal_appends", ws["wal_appends"])
+        gauges.setdefault("wal_fsyncs", ws["wal_fsyncs"])
+        gauges.setdefault("wal_group_commits", ws["wal_group_commits"])
+        gauges.setdefault("wal_replayed_records",
+                          ws["wal_replayed_records"])
+        gauges.setdefault("wal_truncated_records",
+                          ws["wal_truncated_records"])
+        gauges.setdefault("wal_tail_records", ws["wal_tail_records"])
         # per-tenant degradations as ONE labeled series (a single TYPE
         # header — duplicate TYPE lines are invalid text exposition and
         # fail the whole scrape); the observe-sink mirror keys them

@@ -93,6 +93,38 @@ class Domain:
         # the sysvar path kicks from SET GLOBAL tidb_compile_prewarm
         from ..executor import compile_service
         compile_service.maybe_prewarm_on_start(self)
+        # durable-store hookups (kv/wal.py + kv/shared_store.py): the
+        # WAL reads its fsync policy from GLOBAL scope through this
+        # domain, and the schema LEASE window bounds how stale this
+        # worker's infoschema may run behind the fleet's published
+        # schema-version cell before a statement triggers a reload
+        wal = getattr(self.store.mvcc, "wal", None)
+        if wal is not None:
+            gv = self.global_vars
+            wal.policy_source = lambda: gv.get("tidb_wal_fsync", "commit")
+        self._schema_lease_next = 0.0
+
+    #: seconds an infoschema may serve past the fleet's published
+    #: version before the lease check re-reads the cell (the
+    #: reference's schema-lease staleness bound, scaled to the segment)
+    SCHEMA_LEASE_S = 0.05
+
+    def maybe_reload_schema(self, force: bool = False):
+        """Fleet schema lease: when the coordination segment's
+        schema-version cell is ahead of this worker's infoschema, catch
+        up the log tail (the DDL's meta writes ride it) and reload.
+        One attribute check when the store has no fleet cell; at most
+        one cell read per SCHEMA_LEASE_S otherwise."""
+        fleet_v = getattr(self.store.mvcc, "fleet_schema_version", None)
+        if fleet_v is None:
+            return
+        now = time.monotonic()
+        if not force and now < self._schema_lease_next:
+            return
+        self._schema_lease_next = now + self.SCHEMA_LEASE_S
+        v = fleet_v()
+        if v and v > self.infoschema().version:
+            self.reload_schema()
 
     def reload_schema(self):
         """reference: domain.Reload — full load on version change. The
@@ -661,6 +693,13 @@ class Session:
         except Exception:
             deltas = None
         if txn.schema_fps:
+            # fleet half of the schema lease: a sibling worker's DDL
+            # published a newer schema-version cell — reload FIRST
+            # (outside the shared gate: reload takes the exclusive
+            # side), then let the fingerprint check below decide whether
+            # this txn's tables actually moved (ErrInfoSchemaChanged,
+            # retriable) or the DDL was elsewhere (commit proceeds)
+            self.domain.maybe_reload_schema(force=True)
             # F1 schema-lease guard (reference: the commit-time schema
             # check behind ErrInfoSchemaChanged + schema_amender.go's
             # role): mutations built against a table whose column/index
@@ -982,6 +1021,10 @@ class Session:
 
     def execute(self, sql: str) -> list[Result]:
         """reference: session.ExecuteStmt (session.go:1637)."""
+        # fleet schema lease (no-op outside a durable shared store): a
+        # sibling worker's DDL must be visible before this statement
+        # plans against the local infoschema
+        self.domain.maybe_reload_schema()
         stmts = self.parser.parse(sql)
         return [self._execute_stmt(s) for s in stmts]
 

@@ -160,6 +160,15 @@ for _v in [
     SysVar("tidb_auto_analyze_ratio", SCOPE_GLOBAL, "0.5", "float"),
     SysVar("tidb_enable_auto_analyze", SCOPE_GLOBAL, "ON", "bool"),
     SysVar("tidb_record_plan_in_slow_log", SCOPE_BOTH, "ON", "bool"),
+    # write-ahead-log fsync policy (kv/wal.py, durable stores only):
+    # `commit` (default) = every commit joins a GROUP fsync before it
+    # acks; `interval` = a background flusher fsyncs every ~20ms (a
+    # crash loses at most the unsynced window); `never` = OS-buffered
+    # only (the fleet still replicates via the log, but a host crash
+    # loses the buffer tail).  GLOBAL: the log is process-wide, so a
+    # session SET must not weaken durability another session relies on
+    SysVar("tidb_wal_fsync", SCOPE_GLOBAL, "commit", "enum",
+           choices=("never", "interval", "commit")),
     # MVCC GC (reference: gc_worker.go gcLifeTimeKey/gcRunIntervalKey)
     SysVar("tidb_gc_life_time", SCOPE_GLOBAL, "10m0s"),
     SysVar("tidb_gc_run_interval", SCOPE_GLOBAL, "10m0s"),
