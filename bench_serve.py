@@ -437,6 +437,283 @@ def run_durability(n_txns: int = 150, emit=_emit) -> dict:
     return out
 
 
+# -- multi-host failover (--hosts N, ISSUE 16): region failover --------------
+#
+# Where run_fleet kills ONE worker process (its siblings keep the same
+# shared WAL), run_failover kills a whole simulated HOST — its private
+# process group and every region it owned — and requires the REGION
+# layer (tidb_tpu/fabric/region.py) to turn that into a failover, not
+# data loss: surviving hosts claim the dead host's expired region
+# leases, restore checkpoint+tail from the blob store, replay, resume.
+# Coordination rides the NETWORK coordinator (fabric/coord_net.py) so
+# the failover path is exercised over real TCP frames, not the
+# same-machine segment shortcut.
+
+#: one simulated host: claims its share of the region grid over the
+#: network coordinator, serves 2PC writes with replicate-on-ack (a row
+#: is "acked" only after its region's checkpoint+tail landed in the
+#: blob store), and — if doomed — dies by the fabric-kill-host
+#: failpoint mid-commit: prewrite replicated, commit never written, the
+#: whole host process group SIGKILLed (same contract as
+#: tidb_tpu/fabric/worker.py: TIDB_TPU_FABRIC_HOST set means my
+#: process group IS my host).
+_FAILOVER_CHILD = r"""
+import json, os, signal, sys, threading, time
+root, addr, host_id, hosts, n_ack, doomed = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]), int(sys.argv[6]))
+from tidb_tpu.fabric.blob import LocalDirBlobStore
+from tidb_tpu.fabric.coord_net import NetCoordinator
+from tidb_tpu.fabric.region import RegionStore
+from tidb_tpu.kv.store import OP_PUT, Storage
+from tidb_tpu.utils import failpoint
+
+def say(**kw):
+    print(json.dumps(kw), flush=True)
+
+net = NetCoordinator(addr)
+net.claim_slot(host_id)
+blob = LocalDirBlobStore(os.path.join(root, "blob"))
+rs = RegionStore(os.path.join(root, "h%d" % host_id), net, host_id,
+                 blob=blob)
+mine = [r for r in range(rs.region_map.n) if r % hosts == host_id]
+got = rs.open_regions(mine)
+st = Storage(mvcc=rs)
+say(phase="up", host=host_id, regions=got)
+
+stop_path = os.path.join(root, "stop")
+
+def beat():
+    n = 0
+    while not os.path.exists(stop_path):
+        try:
+            net.heartbeat(host_id)
+            rs.heartbeat()
+            n += 1
+            if n % 3 == 0:
+                rs.failover_expired()
+        except Exception:
+            pass
+        time.sleep(0.25)
+
+threading.Thread(target=beat, daemon=True).start()
+
+def rkey(rid, i):
+    lo = (rid << 64) // rs.region_map.n
+    return lo.to_bytes(8, "big") + (b"h%d-%06d" % (host_id, i))
+
+for i in range(n_ack):
+    rid = got[i % len(got)]
+    k, v = rkey(rid, i), b"val-%d-%d" % (host_id, i)
+    t = st.begin(); t.put(k, v); t.commit()
+    rs.replicate([rid])   # the ack point: durable in the blob store
+    say(phase="ack", k=k.hex(), v=v.hex())
+say(phase="acked_all", host=host_id)
+
+if host_id == doomed:
+    # die mid-commit at the widest 2PC crash window: prewrite lands in
+    # the replicated log, the commit never does — failover must roll
+    # the orphan back (un-acked rows gone)
+    failpoint.enable("fabric-kill-host", "1*return(1)")
+    t = st.begin()
+    kd = rkey(got[0], 999999)
+    rs.prewrite([(kd, OP_PUT, b"doomed")], kd, t.start_ts)
+    rs.replicate()
+    say(phase="doomed_prewrite", k=kd.hex())
+    if failpoint.inject("fabric-kill-host"):
+        if os.environ.get("TIDB_TPU_FABRIC_HOST") is not None:
+            os.killpg(os.getpgid(0), signal.SIGKILL)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+while not os.path.exists(stop_path):
+    time.sleep(0.1)
+ts = rs.tso.next_ts()
+pairs = []
+for rid in sorted(rs.stores):
+    s, e = rs.region_map.bounds(rid)
+    pairs += [[k.hex(), v.hex()] for k, v in rs.scan(s, e, ts)]
+owned = sorted(rs.stores)
+rs.close()
+net.release_slot(host_id)
+say(phase="final", host=host_id, owned=owned, pairs=pairs)
+"""
+
+#: host failover must land within this budget (region lease 2s +
+#: heartbeat period + restore/replay — generous for a loaded CI box)
+FAILOVER_BUDGET_S = 30.0
+
+
+def run_failover(hosts: int = 3, n_ack: int = 4, nregions: int = 6,
+                 seed: int = 0, emit=_emit) -> dict:
+    """SIGKILL one simulated host mid-commit; assert region failover
+    within the lease budget, every acked row readable fleet-wide,
+    un-acked rows gone, and a cold restart from the blob store ALONE
+    bit-equal.  Emits one ``serve_failover`` JSON line."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    from tidb_tpu.fabric.blob import LocalDirBlobStore
+    from tidb_tpu.fabric.coord import Coordinator
+    from tidb_tpu.fabric.coord_net import CoordServer
+    from tidb_tpu.fabric.region import RegionStore, \
+        verify_region_invariants
+
+    assert hosts >= 3, "failover mode needs >= 3 hosts (2 survivors)"
+    rng = random.Random(seed)
+    doomed = rng.randrange(hosts)
+    root = tempfile.mkdtemp(prefix="serve-failover-")
+    coord = Coordinator.create(os.path.join(root, "coord"),
+                               nregions=nregions)
+    srv = CoordServer(coord)
+    addr = srv.start()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join(
+               [p for p in sys.path if p]
+               + [os.environ.get("PYTHONPATH", "")])}
+    lines = {h: [] for h in range(hosts)}
+    errs = {h: [] for h in range(hosts)}
+    procs = {}
+    readers = []
+    out = {"metric": "serve_failover", "hosts": hosts,
+           "nregions": nregions, "doomed_host": doomed, "seed": seed}
+
+    def read_json(h, pipe):
+        for ln in pipe:
+            with contextlib.suppress(ValueError):
+                lines[h].append(json.loads(ln))
+
+    def read_err(h, pipe):
+        for ln in pipe:
+            errs[h].append(ln)
+
+    def wait_phase(h, phase, budget=FAILOVER_BUDGET_S):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < budget:
+            for obj in list(lines[h]):
+                if obj.get("phase") == phase:
+                    return obj
+            time.sleep(0.02)
+        raise AssertionError(
+            f"host {h} never reached phase {phase!r} (rc="
+            f"{procs[h].poll()}, saw="
+            f"{[o.get('phase') for o in lines[h]]}, stderr="
+            f"{''.join(errs[h])[-500:]!r})")
+
+    try:
+        for h in range(hosts):
+            p = subprocess.Popen(
+                [sys.executable, "-c", _FAILOVER_CHILD, root, addr,
+                 str(h), str(hosts), str(n_ack), str(doomed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=dict(env, TIDB_TPU_FABRIC_HOST=str(h)),
+                preexec_fn=os.setpgrp)
+            procs[h] = p
+            for target, pipe in ((read_json, p.stdout),
+                                 (read_err, p.stderr)):
+                t = threading.Thread(target=target, args=(h, pipe),
+                                     daemon=True)
+                t.start()
+                readers.append(t)
+        # every host acks its rows (ack = replicated to the blob store)
+        for h in range(hosts):
+            wait_phase(h, "acked_all", budget=240.0)
+        acked = {o["k"]: o["v"] for h in range(hosts)
+                 for o in lines[h] if o.get("phase") == "ack"}
+        assert len(acked) == hosts * n_ack, (
+            f"expected {hosts * n_ack} acked rows, saw {len(acked)}")
+        # the doomed host dies mid-commit, whole process group at once
+        dk = wait_phase(doomed, "doomed_prewrite")["k"]
+        rc = procs[doomed].wait(timeout=FAILOVER_BUDGET_S)
+        t_dead = time.monotonic()
+        assert rc == -signal.SIGKILL, (
+            f"doomed host exited {rc}, not SIGKILL — the "
+            f"fabric-kill-host failpoint did not fire")
+        # surviving hosts must claim every region within the budget
+        failover_s = None
+        while time.monotonic() - t_dead < FAILOVER_BUDGET_S:
+            owners = coord.region_owners()
+            if len(owners) == nregions and doomed not in owners.values():
+                failover_s = time.monotonic() - t_dead
+                break
+            time.sleep(0.05)
+        assert failover_s is not None, (
+            f"regions not failed over within {FAILOVER_BUDGET_S}s: "
+            f"owners={coord.region_owners()}")
+        # quiesce: survivors report their full served state and drain
+        with open(os.path.join(root, "stop"), "w"):
+            pass
+        for h in range(hosts):
+            if h != doomed:
+                rc = procs[h].wait(timeout=FAILOVER_BUDGET_S)
+                assert rc == 0, (
+                    f"survivor {h} exited {rc}: "
+                    f"{''.join(errs[h])[-500:]!r}")
+        for t in readers:
+            t.join(5.0)
+        finals = {o["host"]: o for h in range(hosts) if h != doomed
+                  for o in lines[h] if o.get("phase") == "final"}
+        assert len(finals) == hosts - 1, (
+            f"missing survivor final reports: got {sorted(finals)}")
+        merged = {k: v for f in finals.values() for k, v in f["pairs"]}
+        missing = [k for k in acked if merged.get(k) != acked[k]]
+        assert not missing, (
+            f"ACKED ROWS LOST after host failover: {len(missing)} of "
+            f"{len(acked)} ({missing[:4]})")
+        assert dk not in merged, (
+            "un-acked mid-kill row visible fleet-wide after failover")
+        covered = sorted(set().union(
+            *(set(f["owned"]) for f in finals.values())))
+        assert covered == list(range(nregions)), (
+            f"survivors cover regions {covered}, want 0..{nregions - 1}")
+        # reap the dead host's slot lease + its shared 2PC lock claims
+        # (what fleet.Fleet does on child death), then the segment must
+        # drain clean and the blob manifests must be honest
+        coord.reclaim_expired(0.0)
+        blob = LocalDirBlobStore(os.path.join(root, "blob"))
+        inv = verify_region_invariants(coord, blob)
+        assert inv["ok"], f"REGION INVARIANT VIOLATION: {inv}"
+        drained = coord.verify_drained()
+        assert drained["ok"], f"coordinator not drained: {drained}"
+        # cold restart from the blob store ALONE: fresh segment, fresh
+        # WAL dirs — must serve bit-equal data
+        coord2 = Coordinator.create(os.path.join(root, "coord2"),
+                                    nregions=nregions)
+        try:
+            coord2.claim_slot(0)
+            cold = RegionStore(os.path.join(root, "cold"), coord2, 0,
+                               blob=blob)
+            cold.open_regions(restore=True)
+            ts = cold.tso.next_ts()
+            cold_pairs = {k.hex(): v.hex()
+                          for k, v in cold.scan(b"", b"", ts)}
+            cold.close(replicate=False)
+        finally:
+            with contextlib.suppress(Exception):
+                coord2.unlink()
+        assert cold_pairs == merged, (
+            f"COLD RESTORE DIVERGENCE: {len(cold_pairs)} rows from "
+            f"blobs vs {len(merged)} served by the survivors")
+        out.update({"failover_s": round(failover_s, 3),
+                    "acked": len(acked), "recovered": len(acked),
+                    "survivor_rows": len(merged),
+                    "cold_restore_rows": len(cold_pairs),
+                    "unacked_gone": True, "cold_restore_ok": True})
+        emit(out)
+        return out
+    finally:
+        import signal as _sig
+        for p in procs.values():
+            if p.poll() is None:
+                with contextlib.suppress(OSError):
+                    os.killpg(p.pid, _sig.SIGKILL)
+        srv.stop()
+        with contextlib.suppress(Exception):
+            coord.unlink()
+        with contextlib.suppress(OSError):
+            shutil.rmtree(root)
+
+
 # -- fleet mode (--procs N): the cross-process serving fabric ----------------
 #
 # Where run_serve drives N THREADS against one Domain, run_fleet drives
@@ -845,6 +1122,11 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", type=int, default=1,
                     help="worker PROCESSES (>1 = fleet mode over the "
                          "serving fabric; tidb_tpu/fabric)")
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="simulated HOSTS (>1 = region-failover mode: "
+                         "SIGKILL one whole host mid-commit, surviving "
+                         "hosts fail its regions over from the blob "
+                         "store; tidb_tpu/fabric/region.py)")
     ap.add_argument("--chaos", action="store_true",
                     help="run under the seeded chaos catalog "
                          "(threads: hang + OOM + admission failpoints; "
@@ -858,16 +1140,20 @@ def main(argv=None) -> int:
         if args.procs > 1:
             args.ops = 3
     try:
-        if args.procs > 1:
+        if args.hosts > 1:
+            run_failover(hosts=args.hosts, seed=args.seed)
+        elif args.procs > 1:
             run_fleet(procs=args.procs, n_threads=args.threads,
                       n_ops=args.ops, sf=args.sf, seed=args.seed,
                       chaos=args.chaos)
         else:
             run_serve(n_threads=args.threads, n_ops=args.ops, sf=args.sf,
                       seed=args.seed, chaos=args.chaos)
-        if args.smoke:
+        if args.smoke and args.hosts <= 1:
             # durability phase (ISSUE 15): WAL-off/never/commit DML qps
-            # + the SIGKILL-mid-commit recover round trip
+            # + the SIGKILL-mid-commit recover round trip (the --hosts
+            # mode is its own durability story: replicate-on-ack +
+            # region failover + cold blob restore)
             run_durability()
     except AssertionError as e:
         _emit({"metric": "serve_violation", "error": str(e)[:2000]})

@@ -166,6 +166,23 @@ FLEET_FAULTS = {
     "txn-before-prewrite": ["1*return(kill)"],
 }
 
+#: HOST-mode fault catalog (whole-host faults — a step above
+#: FLEET_FAULTS: `fabric-kill-host` with a truthy payload SIGKILLs the
+#: worker's entire simulated-host PROCESS GROUP mid-query, i.e. every
+#: worker the host was running dies at once).  Only bench_serve's
+#: multi-host failover mode (--hosts N) may inject it, via spawn env on
+#: a fleet started with hosts>1 — each simulated host gets a private
+#: process group (fleet.Fleet._popen_worker) so the killpg can never
+#: reach the bench itself; in-process seeds cannot run it for the same
+#: reason FLEET_FAULTS are bench-only.  The invariants are region
+#: failover's: surviving hosts claim the dead host's expired region
+#: leases within the lease budget, restore checkpoint+tail from the
+#: blob store, and every acked row stays readable fleet-wide
+#: (bench_serve.run_failover + tests/test_serve.py).
+HOST_FAULTS = {
+    "fabric-kill-host": ["1*return(1)"],
+}
+
 
 def _setup(tk: TestKit):
     tk.must_exec("use test")
@@ -234,6 +251,76 @@ def _assert_recovery_equivalent(tk: TestKit, wal_dir: str, seed: int):
         f"seed {seed}: RECOVERY DIVERGENCE: replayed store has "
         f"{len(rec_rows)} live rows vs {len(live_rows)} in the serving "
         "store — the WAL is not a faithful journal")
+
+
+def _assert_region_invariants(seed: int):
+    """The REGION layer's drain + replication invariants, exercised
+    per-seed at the end of both chaos modes: a seeded mini region fleet
+    (sharded keyspace over a blob store) must survive a simulated host
+    loss — the survivor claims the expired leases, restores
+    checkpoint+tail from blobs alone, serves bit-equal data, and fences
+    the zombie — then drain clean: no orphaned region lease in the
+    coordination segment, and every MANIFEST in the blob store agrees
+    with the sealed bytes it references (verify_region_invariants)."""
+    import os
+    import shutil
+    import tempfile
+    from tidb_tpu.fabric.blob import LocalDirBlobStore
+    from tidb_tpu.fabric.coord import Coordinator
+    from tidb_tpu.fabric.region import RegionEpochError, RegionStore, \
+        verify_region_invariants
+    rng = random.Random(seed ^ 0x5EED)
+    root = tempfile.mkdtemp(prefix="chaos-region-")
+    coord = Coordinator.create(os.path.join(root, "coord"),
+                               nregions=rng.choice([2, 4, 8]))
+    try:
+        blob = LocalDirBlobStore(os.path.join(root, "blob"))
+        coord.claim_slot(0)
+        dead = RegionStore(os.path.join(root, "h0"), coord, 0, blob=blob)
+        dead.open_regions()
+        rows = {rng.randrange(1 << 32).to_bytes(8, "big"):
+                b"v%d" % i for i in range(24)}
+        for k, v in rows.items():
+            dead.raw_put(k, v)
+        dead.replicate()
+        ts = dead.tso.next_ts()
+        before = dead.scan(b"", b"", ts)
+        # host 0 "dies": a survivor (lease budget already elapsed from
+        # its point of view) fails every region over from the blob
+        # store alone and must serve the identical snapshot
+        coord.claim_slot(1)
+        surv = RegionStore(os.path.join(root, "h1"), coord, 1,
+                           blob=blob, lease_timeout_s=0.0)
+        took = surv.failover_expired()
+        assert took, f"seed {seed}: survivor claimed no expired regions"
+        after = surv.scan(b"", b"", ts)
+        assert after == before, (
+            f"seed {seed}: REGION FAILOVER DIVERGENCE: survivor serves "
+            f"{len(after)} rows vs {len(before)} pre-failover")
+        # the dead host's appender is a zombie now: epoch-fenced
+        try:
+            dead.raw_put(next(iter(rows)), b"zombie")
+            raise AssertionError(
+                f"seed {seed}: zombie write into a failed-over region "
+                "was NOT fenced")
+        except RegionEpochError:
+            pass
+        dead.close()   # replicate skips fenced regions (no clobber)
+        surv.close()
+        coord.release_slot(0)
+        coord.release_slot(1)
+        inv = verify_region_invariants(coord, blob)
+        assert inv["ok"], (
+            f"seed {seed}: REGION INVARIANT VIOLATION: {inv}")
+        drained = coord.verify_drained()
+        assert drained["ok"], (
+            f"seed {seed}: region coordinator not drained: {drained}")
+    finally:
+        with contextlib.suppress(Exception):
+            coord.unlink()
+        with contextlib.suppress(Exception):
+            coord.close()
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def run_seed(seed: int, n_ops: int = 10) -> dict:
@@ -365,6 +452,12 @@ def run_seed(seed: int, n_ops: int = 10) -> dict:
         #    the WAL dir (checkpoint + tail replay + CRC truncation)
         #    and require bit-for-bit equality with the serving store
         _assert_recovery_equivalent(tk, wal_dir, seed)
+
+        # -- region layer: a seeded mini region fleet must fail over a
+        #    dead host from the blob store alone, fence the zombie, and
+        #    drain with no orphaned region lease and every blob MANIFEST
+        #    matching its sealed bytes
+        _assert_region_invariants(seed)
     finally:
         failpoint.disable_all()
         with contextlib.suppress(Exception):
@@ -632,6 +725,9 @@ def run_threaded_seed(seed: int, n_threads: int = 4,
     # exactly the serving store's state
     try:
         _assert_recovery_equivalent(tk, wal_dir, seed)
+        # region layer invariants hold after threaded chaos too: failover
+        # from blobs, zombie fencing, no orphaned lease, manifests honest
+        _assert_region_invariants(seed)
     finally:
         with contextlib.suppress(Exception):
             tk.domain.store.close()

@@ -1,0 +1,354 @@
+"""Region-sharded multi-host fleet (ISSUE 16): the RegionMap keyspace
+partition, the object-store-shaped blob API (rename-last uploads, torn
+uploads invisible), per-region WAL replication (checkpoint + committed
+tail + MANIFEST-last), region failover (survivor claims an expired
+lease, restores from blobs alone, replays, resolves orphans), epoch
+fencing (a zombie host's appender can never write into a failed-over
+region), and the network coordinator's parity / degrade discipline."""
+
+import contextlib
+import os
+import time
+
+import pytest
+
+from tidb_tpu.fabric.blob import (BlobError, LocalDirBlobStore,
+                                  open_blob_store)
+from tidb_tpu.fabric.coord import Coordinator
+from tidb_tpu.fabric.coord_net import (CoordRemoteError, CoordServer,
+                                       CoordUnavailableError,
+                                       NetCoordinator)
+from tidb_tpu.fabric.region import (RegionEpochError, RegionMap,
+                                    RegionStore,
+                                    verify_region_invariants)
+from tidb_tpu.kv import wal as wal_mod
+from tidb_tpu.kv.store import OP_PUT, Storage
+
+NREGIONS = 4
+
+
+@pytest.fixture()
+def coord(tmp_path):
+    c = Coordinator.create(str(tmp_path / "coord"), nregions=NREGIONS)
+    yield c
+    with contextlib.suppress(Exception):
+        c.unlink()
+
+
+@pytest.fixture()
+def blob(tmp_path):
+    return LocalDirBlobStore(str(tmp_path / "blob"))
+
+
+def rkey(rid: int, suffix: bytes = b"k", n: int = NREGIONS) -> bytes:
+    """A key guaranteed to land in region ``rid`` of an n-region map."""
+    return ((rid << 64) // n).to_bytes(8, "big") + suffix
+
+
+# -- keyspace partition -------------------------------------------------------
+
+class TestRegionMap:
+    def test_regions_partition_the_keyspace(self):
+        m = RegionMap(NREGIONS)
+        for rid in range(NREGIONS):
+            assert m.region_of(rkey(rid)) == rid
+        assert m.region_of(b"") == 0
+        assert m.region_of(b"\xff" * 16) == NREGIONS - 1
+
+    def test_bounds_are_contiguous_and_open_ended(self):
+        m = RegionMap(NREGIONS)
+        assert m.bounds(0)[0] == b""
+        assert m.bounds(NREGIONS - 1)[1] == b""
+        for rid in range(NREGIONS - 1):
+            assert m.bounds(rid)[1] == m.bounds(rid + 1)[0]
+        with pytest.raises(IndexError):
+            m.bounds(NREGIONS)
+
+    def test_split_range_fans_out_and_clamps(self):
+        m = RegionMap(NREGIONS)
+        spans = m.split_range(b"", b"")
+        assert [s[0] for s in spans] == list(range(NREGIONS))
+        # a range inside one region stays one span with its own bounds
+        one = m.split_range(rkey(2, b"a"), rkey(2, b"z"))
+        assert one == [(2, rkey(2, b"a"), rkey(2, b"z"))]
+        # a straddling range clamps each span to the region grid
+        two = m.split_range(rkey(1, b"x"), rkey(2, b"x"))
+        assert [s[0] for s in two] == [1, 2]
+        assert two[0][1] == rkey(1, b"x") and two[1][2] == rkey(2, b"x")
+
+
+# -- blob store (satellite 3) -------------------------------------------------
+
+class TestBlobStore:
+    def test_upload_list_fetch_round_trip(self, blob):
+        blob.put("region-0/a.bin", b"alpha")
+        blob.put("region-0/b.bin", b"beta")
+        blob.put("region-1/c.bin", b"gamma")
+        assert blob.get("region-0/a.bin") == b"alpha"
+        assert blob.list("region-0/") == ["region-0/a.bin",
+                                          "region-0/b.bin"]
+        assert blob.exists("region-1/c.bin")
+        blob.delete("region-0/a.bin")
+        assert not blob.exists("region-0/a.bin")
+        with pytest.raises(BlobError):
+            blob.get("region-0/a.bin")
+
+    def test_torn_upload_invisible(self, blob, tmp_path):
+        """rename-last: a crash mid-upload leaves only a tmp file, which
+        list() skips and get() refuses — a reader can never fetch half
+        an object."""
+        blob.put("region-0/whole.bin", b"x" * 64)
+        torn = os.path.join(str(tmp_path / "blob"), "region-0",
+                            ".tmp-crashed")
+        with open(torn, "wb") as f:
+            f.write(b"half an uplo")
+        assert blob.list("region-0/") == ["region-0/whole.bin"]
+        # and a COMPLETED put leaves no tmp residue behind
+        names = os.listdir(os.path.join(str(tmp_path / "blob"),
+                                        "region-0"))
+        assert [n for n in names if n.startswith(".tmp-")] == \
+            [".tmp-crashed"]
+
+    def test_open_blob_store_schemes(self, tmp_path):
+        d = str(tmp_path / "x")
+        assert isinstance(open_blob_store(d), LocalDirBlobStore)
+        assert isinstance(open_blob_store("file://" + d),
+                          LocalDirBlobStore)
+        with pytest.raises(NotImplementedError):
+            open_blob_store("gs://bucket/prefix")
+
+
+# -- coordination-segment region cells ----------------------------------------
+
+class TestRegionCells:
+    def test_claim_fences_foreign_live_lease(self, coord):
+        coord.claim_slot(0)
+        coord.claim_slot(1)
+        e1 = coord.region_claim(0, 0)
+        assert e1 > 0
+        # a live foreign lease is not up for grabs
+        assert coord.region_claim(0, 1) == 0
+        assert coord.region_heartbeat(0, 0, e1)
+        assert coord.region_check(0, e1)
+        # release -> next claim bumps the epoch (fencing token)
+        coord.region_release(0, 0)
+        e2 = coord.region_claim(0, 1)
+        assert e2 > e1
+        assert not coord.region_check(0, e1)
+        assert not coord.region_heartbeat(0, 0, e1)
+        assert not coord.region_set_committed(0, e1, 128)
+        assert coord.region_set_committed(0, e2, 128)
+        assert coord.region_committed_len(0) == 128
+
+    def test_expiry_and_drain_listing(self, coord):
+        coord.claim_slot(0)
+        e = coord.region_claim(2, 0, lease_timeout_s=0.05)
+        assert e > 0
+        assert coord.regions_expired(60.0) == []
+        time.sleep(0.08)
+        assert 2 in coord.regions_expired(0.05)
+        d = coord.verify_drained()
+        assert not d["ok"] and 2 in d["region_leases"]
+        coord.region_release_all(0)
+        coord.release_slot(0)
+        assert coord.verify_drained()["ok"]
+
+
+# -- the router ---------------------------------------------------------------
+
+class TestRegionStoreRouting:
+    def test_cross_region_txn_and_ordered_scan(self, tmp_path, coord):
+        coord.claim_slot(0)
+        rs = RegionStore(str(tmp_path / "h0"), coord, 0)
+        assert rs.open_regions() == list(range(NREGIONS))
+        st = Storage(mvcc=rs)
+        # ONE txn spanning three regions: Percolator primary in region 0
+        t = st.begin()
+        for rid in (0, 1, 3):
+            t.put(rkey(rid, b"row"), b"v%d" % rid)
+        t.commit()
+        ts = rs.tso.next_ts()
+        assert rs.get(rkey(1, b"row"), ts) == b"v1"
+        # full-range scan fans out per region and concatenates ordered
+        rows = rs.scan(b"", b"", ts)
+        assert [v for _k, v in rows] == [b"v0", b"v1", b"v3"]
+        assert [k for k, _v in rows] == sorted(k for k, _v in rows)
+        assert rs.scan(b"", b"", ts, limit=2) == rows[:2]
+        rs.close()
+
+    def test_unowned_region_raises_not_serves(self, tmp_path, coord):
+        coord.claim_slot(0)
+        rs = RegionStore(str(tmp_path / "h0"), coord, 0)
+        rs.open_regions([0, 1])   # regions 2,3 belong to nobody here
+        with pytest.raises(RegionEpochError):
+            rs.raw_put(rkey(3), b"x")
+        rs.close()
+
+
+# -- replication + failover ---------------------------------------------------
+
+class TestReplicationFailover:
+    def test_restore_is_bit_equal(self, tmp_path, coord, blob):
+        coord.claim_slot(0)
+        rs = RegionStore(str(tmp_path / "h0"), coord, 0, blob=blob)
+        rs.open_regions()
+        st = Storage(mvcc=rs)
+        for i in range(8):
+            t = st.begin()
+            t.put(rkey(i % NREGIONS, b"k%03d" % i), b"v%d" % i)
+            t.commit()
+        rs.checkpoint_region(0)   # one region restores via checkpoint
+        manifests = rs.replicate()
+        assert sorted(manifests) == list(range(NREGIONS))
+        ts = rs.tso.next_ts()
+        before = rs.scan(b"", b"", ts)
+        rs.close()
+        coord.release_slot(0)
+        # cold restart from the blob store ALONE: fresh segment + dirs
+        c2 = Coordinator.create(str(tmp_path / "coord2"),
+                                nregions=NREGIONS)
+        try:
+            c2.claim_slot(0)
+            cold = RegionStore(str(tmp_path / "cold"), c2, 0, blob=blob)
+            cold.open_regions(restore=True)
+            assert cold.scan(b"", b"", ts) == before
+            cold.close(replicate=False)
+        finally:
+            with contextlib.suppress(Exception):
+                c2.unlink()
+
+    def test_failover_fences_zombie_and_rolls_back_orphan(
+            self, tmp_path, coord, blob):
+        coord.claim_slot(0)
+        coord.claim_slot(1)
+        dead = RegionStore(str(tmp_path / "h0"), coord, 0, blob=blob)
+        dead.open_regions()
+        st = Storage(mvcc=dead)
+        t = st.begin(); t.put(rkey(1, b"acked"), b"safe"); t.commit()
+        dead.replicate()
+        # the mid-kill crash window: prewrite in the replicated log,
+        # commit never written
+        t2 = st.begin()
+        orphan = rkey(1, b"orphan")
+        dead.prewrite([(orphan, OP_PUT, b"doomed")], orphan, t2.start_ts)
+        dead.replicate()
+        ts = dead.tso.next_ts()
+        # the survivor treats the leases as expired and takes over from
+        # the blob store alone
+        surv = RegionStore(str(tmp_path / "h1"), coord, 1, blob=blob,
+                           lease_timeout_s=0.0)
+        assert sorted(surv.failover_expired()) == list(range(NREGIONS))
+        assert surv.get(rkey(1, b"acked"), ts) == b"safe"
+        assert surv.get(orphan, surv.tso.next_ts()) is None  # rolled back
+        # the zombie is epoch-fenced before any byte hits its log
+        with pytest.raises(RegionEpochError):
+            dead.raw_put(rkey(1, b"zombie"), b"x")
+        # and its close-time replicate must not clobber the survivor's
+        # MANIFEST (epoch check skips fenced regions)
+        dead.close()
+        surv_epoch = surv.epochs[1]
+        man = surv._replicator.manifest(1)
+        surv.replicate()
+        man2 = surv._replicator.manifest(1)
+        assert man2["epoch"] == surv_epoch >= man["epoch"]
+        surv.close()
+        coord.release_slot(0)
+        coord.release_slot(1)
+        inv = verify_region_invariants(coord, blob)
+        assert inv["ok"], inv
+        assert coord.verify_drained()["ok"]
+
+    def test_lost_heartbeat_drops_the_store(self, tmp_path, coord, blob):
+        """A host that misses its lease renewal must DROP the region the
+        moment a heartbeat is rejected — keeping serving would split-
+        brain against the failover owner."""
+        coord.claim_slot(0)
+        coord.claim_slot(1)
+        a = RegionStore(str(tmp_path / "h0"), coord, 0, blob=blob)
+        a.open_regions([2])
+        b = RegionStore(str(tmp_path / "h1"), coord, 1, blob=blob,
+                        lease_timeout_s=0.0)
+        assert b.failover_expired() == [2]
+        assert a.heartbeat() == [2]          # rejected -> dropped
+        assert 2 not in a.stores
+        a.close()
+        b.close()
+
+    def test_invariants_catch_a_lying_manifest(self, tmp_path, coord,
+                                               blob):
+        coord.claim_slot(0)
+        rs = RegionStore(str(tmp_path / "h0"), coord, 0, blob=blob)
+        rs.open_regions([0])
+        rs.raw_put(rkey(0), b"v")
+        man = rs.replicate()[0]
+        rs.close()
+        coord.release_slot(0)
+        assert verify_region_invariants(coord, blob)["ok"]
+        blob.delete(man["tail"])   # manifest now references a ghost
+        inv = verify_region_invariants(coord, blob)
+        assert not inv["ok"] and inv["manifest_errors"]
+
+    def test_region_wal_dir_layout(self, tmp_path):
+        root = str(tmp_path / "w")
+        for rid in (0, 3, 7):
+            os.makedirs(wal_mod.region_dir(root, rid))
+        assert wal_mod.region_ids(root) == [0, 3, 7]
+
+
+# -- the network coordinator --------------------------------------------------
+
+class TestNetCoordinator:
+    def test_parity_and_remote_errors(self, tmp_path, coord):
+        srv = CoordServer(coord)
+        addr = srv.start()
+        try:
+            net = NetCoordinator(addr)
+            assert net.nregions == NREGIONS
+            net.claim_slot(3)
+            e = net.region_claim(1, 3)
+            assert e > 0 and coord.region_check(1, e)
+            assert net.region_info(1)["owner"] == 3
+            assert net.tso_lease(8)[1] > 0
+            # a semantic error crosses the wire typed, not as a hang
+            with pytest.raises(CoordRemoteError) as ei:
+                net.region_claim(NREGIONS + 9, 3)
+            assert ei.value.err_type == "IndexError"
+            # ops outside the allowlist don't exist on the client
+            with pytest.raises(AttributeError):
+                net.unlink
+            net.region_release(1, 3)
+            net.release_slot(3)
+        finally:
+            srv.stop()
+
+    def test_region_store_over_the_wire(self, tmp_path, coord):
+        srv = CoordServer(coord)
+        addr = srv.start()
+        try:
+            net = NetCoordinator(addr)
+            net.claim_slot(2)
+            rs = RegionStore(str(tmp_path / "net"), net, 2)
+            rs.open_regions([0, 1])
+            rs.raw_put(rkey(0), b"over-tcp")
+            assert rs.get(rkey(0), rs.tso.next_ts()) == b"over-tcp"
+            rs.close()
+            net.release_slot(2)
+            assert coord.verify_drained()["ok"]
+        finally:
+            srv.stop()
+
+    def test_down_window_degrades_admission_not_correctness(self):
+        """With the coordinator unreachable, admission-shaped ops
+        degrade to local-only (never a failed query) while
+        correctness-critical ops (TSO) raise CoordUnavailableError
+        FAST inside the down-window instead of re-paying the budget."""
+        net = NetCoordinator("127.0.0.1:9", down_cooldown_s=60.0)
+        t0 = time.monotonic()
+        assert net.try_acquire_running(0, "g", 4) is True
+        assert not net.healthy()
+        assert net.vtimes(["g"]) == {"g": 0.0}
+        assert net.live_slots() == []
+        with pytest.raises(CoordUnavailableError):
+            net.tso_lease(8)
+        # one budgeted retry burst + instant short-circuits afterwards
+        assert time.monotonic() - t0 < 5.0

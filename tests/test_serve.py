@@ -91,6 +91,28 @@ def test_bench_serve_durability_phase():
             if e["metric"] == "serve_durability"] == [out]
 
 
+@pytest.mark.chaos_threads
+def test_bench_serve_host_failover():
+    """ISSUE 16 acceptance: `bench_serve.py --hosts 3 --smoke` green —
+    a 3-host simulated fleet (each host a private process group over
+    the NETWORK coordinator), one host SIGKILLed mid-commit by the
+    fabric-kill-host failpoint.  run_failover raises on any violation:
+    surviving hosts must claim the dead host's region leases within the
+    budget, every acked row stays readable fleet-wide, the un-acked
+    mid-kill row is gone, the segment drains with zero orphaned region
+    leases, and a cold restart from the blob store ALONE serves
+    bit-equal data.  The serve_failover JSON line shape is pinned."""
+    emitted = []
+    out = bench_serve.run_failover(hosts=3, n_ack=4, nregions=6,
+                                   seed=0, emit=emitted.append)
+    assert out["recovered"] == out["acked"] == 12
+    assert out["failover_s"] <= bench_serve.FAILOVER_BUDGET_S
+    assert out["unacked_gone"] and out["cold_restore_ok"]
+    assert out["cold_restore_rows"] == out["survivor_rows"]
+    assert [e for e in emitted
+            if e["metric"] == "serve_failover"] == [out]
+
+
 def test_starved_tenant_p99_bounded():
     """The WFQ acceptance regression: a light tenant's p99 stays bounded
     while a heavy tenant floods the device with analytics.  With

@@ -152,6 +152,49 @@ class TestWalFraming:
         st2.close()
         st.close()
 
+    def test_fsync_eio_retried_within_budget(self, wal_dir):
+        """ISSUE 16 satellite: a TRANSIENT fsync error (one EIO) gets
+        one budgeted Backoffer retry (kind walSyncRetry) before the
+        owner aborts — the commit succeeds, and the stats show exactly
+        one error absorbed by one retry."""
+        wal_mod.reset_for_tests()
+        st = new_store(wal_dir=wal_dir)
+        st.mvcc.wal.policy_source = lambda: "commit"
+        with failpoint.enabled("wal-fsync-fail", "1*return(eio)"):
+            t = st.begin()
+            t.put(b"survives", b"v")
+            t.commit()          # the retry absorbs the EIO
+        assert st.get_snapshot().get(b"survives") == b"v"
+        s = wal_mod.snapshot()
+        assert s["wal_fsync_errors"] >= 1, s
+        assert s["wal_fsync_retries"] >= 1, s
+        # durable for real: recovery sees the retried commit
+        st2 = new_store(wal_dir=wal_dir)
+        assert st2.get_snapshot().get(b"survives") == b"v"
+        st2.close()
+        st.close()
+
+    def test_fsync_eio_persistent_aborts_cleanly(self, wal_dir):
+        """A PERSISTENT fsync failure exhausts the walSyncRetry budget
+        and aborts the txn with the original OSError — never an ack on
+        storage that cannot sync, and recovery agrees the row is gone."""
+        wal_mod.reset_for_tests()
+        st = new_store(wal_dir=wal_dir)
+        st.mvcc.wal.policy_source = lambda: "commit"
+        t = st.begin(); t.put(b"base", b"1"); t.commit()
+        with failpoint.enabled("wal-fsync-fail", "return(eio)"):
+            t = st.begin()
+            t.put(b"doomed", b"x")
+            with pytest.raises(OSError):
+                t.commit()
+        assert st.get_snapshot().get(b"doomed") is None
+        assert wal_mod.snapshot()["wal_fsync_errors"] >= 2
+        st2 = new_store(wal_dir=wal_dir)
+        assert st2.get_snapshot().get(b"doomed") is None
+        assert st2.get_snapshot().get(b"base") == b"1"
+        st2.close()
+        st.close()
+
     def test_torn_append_heals_in_process(self, wal_dir):
         st = new_store(wal_dir=wal_dir)
         with failpoint.enabled("wal-append-torn", "1*return(torn)"):

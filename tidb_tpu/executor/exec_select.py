@@ -286,6 +286,7 @@ class TableScanExec(QueryExecutor):
         """-> (unfiltered chunk, pushed conds) for fused device pipelines."""
         self.check_killed()
         p = self.plan
+        self._annotate_region_fanout()
         txn = self.ctx.txn_for_read()
         if p.access is not None:
             return self._access_chunk(txn), p.pushed_conds
@@ -332,7 +333,24 @@ class TableScanExec(QueryExecutor):
         if p.pushed_conds:
             mask = eval_conds_mask(p.pushed_conds, chunk)
             chunk = chunk.filter(mask)
+        self._annotate_region_fanout()
         return chunk
+
+    def _annotate_region_fanout(self):
+        """EXPLAIN ANALYZE visibility for region-sharded stores: how
+        many regions this table's record range spans (the scan fans out
+        to that many per-region stores and concatenates in region
+        order; cross-region results merge through the same ordered-
+        concat the MPP partial-state machinery relies on)."""
+        store = getattr(self.ctx, "store", None)
+        rmap = getattr(getattr(store, "mvcc", None), "region_map", None)
+        if rmap is None:
+            return
+        from .. import tablecodec
+        start = tablecodec.record_prefix(self.plan.table_info.id)
+        spans = rmap.split_range(start, start + b"\xff" * 9)
+        if len(spans) > 1:
+            self.annotate(region_fanout=len(spans))
 
     def execute_stream(self, batch_rows: int):
         """Slice the resident columnar view into bounded batches (zero-copy

@@ -31,13 +31,24 @@ The pieces:
   serve the fragment with zero new local traces.
 * :mod:`~tidb_tpu.fabric.state` — this process's fabric identity (slot,
   coordinator handle, compile-server address) + the ``fabric_*`` gauges.
+* :mod:`~tidb_tpu.fabric.region` / :mod:`~tidb_tpu.fabric.blob` /
+  :mod:`~tidb_tpu.fabric.coord_net` — the multi-host region layer
+  (ISSUE 16): the keyspace sharded into N regions, each with its own
+  WAL, epoch-fenced lease cells, and blob-store replication so a HOST
+  loss is a region failover (a survivor restores checkpoint + tail and
+  replays) instead of data loss; ``coord_net`` puts the segment's
+  lease/epoch/claim/TSO surface behind a TCP service for cross-host
+  callers.
 
-The six-layer resilience stack a fragment now passes: FABRIC (process
-fleet + dedup) → ADMISSION (fleet-coordinated WFQ) → COMPILE SERVICE →
+The seven-layer resilience stack a fragment now passes: REGION (epoch-
+fenced keyspace shards + blob failover) → FABRIC (process fleet +
+dedup) → ADMISSION (fleet-coordinated WFQ) → COMPILE SERVICE →
 SUPERVISOR deadline → BREAKER → RESIDENCY (fleet-aware tenant shares).
 
 Confinement: direct ``multiprocessing.shared_memory`` use is lint-pinned
-to this package (tidb_tpu/lint/rules/confinement.py) — every other layer
+to this package (tidb_tpu/lint/rules/confinement.py), and so is raw
+``socket`` use for coordination (the MySQL wire protocol in ``server/``
+is the one other legitimate socket owner) — every other layer
 coordinates through :mod:`state`'s typed hooks.
 """
 

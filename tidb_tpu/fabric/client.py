@@ -41,6 +41,14 @@ class FleetClient:
         from . import slot_of_conn_id
         return slot_of_conn_id(self.conn_id)
 
+    def host(self, hosts: int) -> "int | None":
+        """The simulated host serving this connection, per the fleet's
+        slot->host convention (fleet.Fleet.host_of: ``slot % hosts``) —
+        how the bench proves a query landed on a SURVIVING host after a
+        kill-host fault."""
+        s = self.slot
+        return None if s is None else s % max(int(hosts), 1)
+
     def _handshake(self, user, password, db) -> int:
         pkt = self.io.read_packet()
         if not pkt or pkt[0] != 10:

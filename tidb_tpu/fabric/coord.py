@@ -39,6 +39,14 @@ Layout (little-endian, fixed offsets — no allocation after create):
               workers can never prewrite the same key concurrently; a
               dead slot's claims are freed by lease reclaim (the data
               locks themselves are resolved by WAL recovery)
+    REGIONS   per-region ownership rows (fabric/region.py): epoch,
+              owner slot (+1; 0 = unowned), lease_ts, committed WAL
+              length and applied LSN — the per-region mirror of the
+              global ``_wal_len``/slot cells.  The EPOCH is the fencing
+              token: it bumps on every claim, every committed-length
+              write carries it, and a stale epoch's write is rejected —
+              a zombie host's appender can never land bytes in a region
+              that failed over behind its back
 
 Every mutation happens under the sidecar lock file (``<path>.lock``,
 ``fcntl.flock``) plus an in-process mutex (flock is per open file
@@ -79,6 +87,9 @@ NSLOTS_DEFAULT = 16
 NTENANTS_DEFAULT = 48
 NDEDUP_DEFAULT = 128
 NLOCKS_DEFAULT = 256
+#: regions default to 0: a single-host fleet pays nothing for the
+#: section, and a region-sharded one sizes it explicitly at create
+NREGIONS_DEFAULT = 0
 
 #: fleet-global counter names, in segment order
 COUNTER_NAMES = (
@@ -108,6 +119,10 @@ _SLOT = struct.Struct("<QdQQQ")                          # pid, lease, gen,
 _DED = struct.Struct("<16sIIdQ")                         # hash,state,owner,ts,rid
 _TEN_FIXED = struct.Struct("<40sdII")                    # name,vtime,peak,pad
 _LCK = struct.Struct("<16sQId")                          # hash,start_ts,slot,ts
+_REG = struct.Struct("<QQdQQ")                           # epoch, owner+1,
+#                                                          lease_ts,
+#                                                          committed_len,
+#                                                          applied_lsn
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
@@ -125,6 +140,7 @@ class Coordinator:
         self.ntenants = meta["ntenants"]
         self.ndedup = meta["ndedup"]
         self.nlocks = meta.get("nlocks", NLOCKS_DEFAULT)
+        self.nregions = meta.get("nregions", NREGIONS_DEFAULT)
         self.pages_dir = meta["pages_dir"]
         self._created = created
         self._tlock = threading.Lock()
@@ -137,7 +153,8 @@ class Coordinator:
                         + 8 * self.nslots)
         self._o_dedup = self._o_tenants + self.ntenants * self._ten_sz
         self._o_locks = self._o_dedup + self.ndedup * _DED.size
-        self.size = self._o_locks + self.nlocks * _LCK.size
+        self._o_regions = self._o_locks + self.nlocks * _LCK.size
+        self.size = self._o_regions + self.nregions * _REG.size
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -146,6 +163,7 @@ class Coordinator:
                ntenants: int = NTENANTS_DEFAULT,
                ndedup: int = NDEDUP_DEFAULT,
                nlocks: int = NLOCKS_DEFAULT,
+               nregions: int = NREGIONS_DEFAULT,
                pages_dir: "str | None" = None) -> "Coordinator":
         """Create the segment + coordinator file (the fleet parent)."""
         if pages_dir is None:
@@ -153,11 +171,12 @@ class Coordinator:
         os.makedirs(pages_dir, exist_ok=True)
         name = f"tpufab-{os.getpid()}-{secrets.token_hex(4)}"
         meta = {"segment": name, "nslots": nslots, "ntenants": ntenants,
-                "ndedup": ndedup, "nlocks": nlocks, "pages_dir": pages_dir,
-                "created": time.time()}
+                "ndedup": ndedup, "nlocks": nlocks, "nregions": nregions,
+                "pages_dir": pages_dir, "created": time.time()}
         size = (_HDR.size + 8 * len(COUNTER_NAMES) + nslots * _SLOT.size
                 + ntenants * (_TEN_FIXED.size + 12 * nslots)
-                + ndedup * _DED.size + nlocks * _LCK.size)
+                + ndedup * _DED.size + nlocks * _LCK.size
+                + nregions * _REG.size)
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         _untrack(shm)
         shm.buf[:size] = b"\0" * size
@@ -606,6 +625,130 @@ class Coordinator:
                 if sts == start_ts and (only is None or h in only):
                     _LCK.pack_into(self._buf, off, b"\0" * 16, 0, 0, 0.0)
 
+    # -- region ownership / epoch fencing (fabric/region.py) ------------------
+
+    def _reg_off(self, rid: int) -> int:
+        if not 0 <= rid < self.nregions:
+            raise IndexError(
+                f"region {rid} out of range 0..{self.nregions - 1}")
+        return self._o_regions + rid * _REG.size
+
+    def region_claim(self, rid: int, slot: int,
+                     lease_timeout_s: float = 2.0) -> int:
+        """Claim region ``rid`` for ``slot``: succeeds when the region is
+        unowned, already ours, or the current owner's lease has lapsed
+        (the failover case).  Every successful claim BUMPS THE EPOCH and
+        returns it — the fencing token the owner must present on every
+        committed-length write.  Returns 0 while a foreign owner's lease
+        is still live (the claimant backs off and re-scans)."""
+        now = time.time()
+        with self._locked():
+            off = self._reg_off(rid)
+            epoch, owner_p1, lease, clen, alsn = _REG.unpack_from(
+                self._buf, off)
+            if owner_p1 and owner_p1 != slot + 1 \
+                    and now - lease <= lease_timeout_s:
+                return 0
+            epoch += 1
+            _REG.pack_into(self._buf, off, epoch, slot + 1, now, clen,
+                           alsn)
+            return epoch
+
+    def region_heartbeat(self, rid: int, slot: int, epoch: int) -> bool:
+        """Refresh the region lease; False when the caller no longer owns
+        the region at this epoch (it failed over — stop serving it)."""
+        with self._locked():
+            off = self._reg_off(rid)
+            cur_epoch, owner_p1, _lease, clen, alsn = _REG.unpack_from(
+                self._buf, off)
+            if owner_p1 != slot + 1 or cur_epoch != epoch:
+                return False
+            _REG.pack_into(self._buf, off, cur_epoch, owner_p1,
+                           time.time(), clen, alsn)
+            return True
+
+    def region_release(self, rid: int, slot: int):
+        """Clean handoff: drop ownership (the epoch stays — it is
+        monotonic for the region's lifetime, never reused)."""
+        with self._locked():
+            off = self._reg_off(rid)
+            epoch, owner_p1, _lease, clen, alsn = _REG.unpack_from(
+                self._buf, off)
+            if owner_p1 == slot + 1:
+                _REG.pack_into(self._buf, off, epoch, 0, 0.0, clen, alsn)
+
+    def region_release_all(self, slot: int):
+        for rid in range(self.nregions):
+            self.region_release(rid, slot)
+
+    def region_check(self, rid: int, epoch: int) -> bool:
+        """Is ``epoch`` still the region's current epoch?  The fence a
+        zombie appender fails after a failover bumped past it."""
+        with self._locked():
+            return _REG.unpack_from(
+                self._buf, self._reg_off(rid))[0] == epoch
+
+    def region_set_committed(self, rid: int, epoch: int, n: int) -> bool:
+        """Epoch-fenced committed-length publish (the per-region
+        ``set_wal_len``): False — and NO write — when ``epoch`` is stale,
+        so a failed-over region's old owner cannot move the fence."""
+        with self._locked():
+            off = self._reg_off(rid)
+            cur_epoch, owner_p1, lease, _clen, alsn = _REG.unpack_from(
+                self._buf, off)
+            if cur_epoch != epoch:
+                return False
+            _REG.pack_into(self._buf, off, cur_epoch, owner_p1, lease,
+                           int(n), alsn)
+            return True
+
+    def region_committed_len(self, rid: int) -> int:
+        with self._locked():
+            return _REG.unpack_from(self._buf, self._reg_off(rid))[3]
+
+    def region_set_applied(self, rid: int, epoch: int, lsn: int) -> bool:
+        with self._locked():
+            off = self._reg_off(rid)
+            cur_epoch, owner_p1, lease, clen, _alsn = _REG.unpack_from(
+                self._buf, off)
+            if cur_epoch != epoch:
+                return False
+            _REG.pack_into(self._buf, off, cur_epoch, owner_p1, lease,
+                           clen, int(lsn))
+            return True
+
+    def region_info(self, rid: int) -> dict:
+        with self._locked():
+            epoch, owner_p1, lease, clen, alsn = _REG.unpack_from(
+                self._buf, self._reg_off(rid))
+        return {"region": rid, "epoch": epoch, "owner": owner_p1 - 1,
+                "lease_age_s": (round(time.time() - lease, 3)
+                                if owner_p1 else None),
+                "committed_len": clen, "applied_lsn": alsn}
+
+    def regions_expired(self, lease_timeout_s: float = 2.0) -> list:
+        """Owned regions whose lease lapsed — the failover work list."""
+        now = time.time()
+        with self._locked():
+            out = []
+            for rid in range(self.nregions):
+                _e, owner_p1, lease, _c, _a = _REG.unpack_from(
+                    self._buf, self._reg_off(rid))
+                if owner_p1 and now - lease > lease_timeout_s:
+                    out.append(rid)
+            return out
+
+    def region_owners(self) -> dict:
+        """{rid: owner slot} over currently owned regions."""
+        with self._locked():
+            out = {}
+            for rid in range(self.nregions):
+                owner_p1 = _REG.unpack_from(
+                    self._buf, self._reg_off(rid))[1]
+                if owner_p1:
+                    out[rid] = owner_p1 - 1
+            return out
+
     # -- fragment dedup -------------------------------------------------------
 
     def _ded_off(self, i: int) -> int:
@@ -777,28 +920,44 @@ class Coordinator:
                 for name in COUNTER_NAMES if not name.startswith("_")}
             ctrs["schema_version"] = _U64.unpack_from(
                 self._buf, self._ctr_off("_schema_ver"))[0]
+            regions = []
+            for rid in range(self.nregions):
+                epoch, owner_p1, _lease, clen, alsn = _REG.unpack_from(
+                    self._buf, self._reg_off(rid))
+                regions.append({"region": rid, "epoch": epoch,
+                                "owner": owner_p1 - 1,
+                                "committed_len": clen,
+                                "applied_lsn": alsn})
         return {"slots": slots, "tenants": tenants,
                 "dedup_building": building, "held_locks": held_locks,
-                **ctrs}
+                "regions": regions, **ctrs}
 
     def verify_drained(self) -> dict:
         """Fleet drain invariant (the cross-process analog of
         scheduler.verify_drained): no live lease, zero running counts in
         every tenant row, no dedup slot stuck building, no shared 2PC
-        lock claim held, and every slot's min-read-ts column zeroed (an
-        exited worker must not pin the fleet GC floor forever)."""
+        lock claim held, every slot's min-read-ts column zeroed (an
+        exited worker must not pin the fleet GC floor forever), and NO
+        ORPHANED REGION LEASE — every region a worker owned was released
+        on drain or failed over and then released; an owner entry at
+        drain is a region no survivor can claim without waiting out a
+        dead lease."""
         snap = self.snapshot()
         running = {g: t["running"] for g, t in snap["tenants"].items()
                    if t["running"]}
         pinned = [s["slot"] for s in snap["slots"] if s["min_read_ts"]]
+        region_leases = [r["region"] for r in snap["regions"]
+                         if r["owner"] >= 0]
         return {"ok": not snap["slots"] and not running
                 and snap["dedup_building"] == 0
-                and snap["held_locks"] == 0 and not pinned,
+                and snap["held_locks"] == 0 and not pinned
+                and not region_leases,
                 "live_slots": [s["slot"] for s in snap["slots"]],
                 "running": running,
                 "dedup_building": snap["dedup_building"],
                 "held_locks": snap["held_locks"],
                 "min_read_pinned": pinned,
+                "region_leases": region_leases,
                 "lease_reclaims": snap["fabric_lease_reclaims"]}
 
 

@@ -91,6 +91,15 @@ class Dedup:
                for col in a.columns) > MAX_ARG_BYTES:
             return None
         h = hashlib.blake2b(repr(batch_key).encode(), digest_size=16)
+        # region-sharded stores fold the owned-region epoch vector into
+        # the key: a page computed before a region failover can never
+        # serve after it (defense-in-depth — the data signature below
+        # already changes with the data, but an epoch bump is the
+        # cheaper, earlier invalidation signal)
+        from . import state
+        rs = state.region_store()
+        if rs is not None:
+            h.update(repr(sorted(rs.epochs.items())).encode())
         for a in args:
             if isinstance(a, Chunk):
                 for col in a.columns:

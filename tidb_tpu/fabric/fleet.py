@@ -25,6 +25,13 @@ lifecycles:
   SIGKILLed after the grace window and force-reclaimed.  The segment's
   :meth:`~tidb_tpu.fabric.coord.Coordinator.verify_drained` is captured
   before unlink so callers can assert zero leaked leases/tickets.
+* **simulated hosts** (``hosts=N``): workers are partitioned into N
+  process groups, one per "host" (slot `i` lives on host ``i % N``); the
+  first live worker of a host is its group leader.  :meth:`Fleet.kill_host`
+  SIGKILLs the whole group at once — the chaos shape where an entire
+  machine (every region lease it held) vanishes mid-commit, which is
+  what region failover (fabric/region.py) must survive.  ``nregions``
+  sizes the segment's region table so those leases exist to lose.
 """
 
 from __future__ import annotations
@@ -74,7 +81,9 @@ class Fleet:
                  run_dir: "str | None" = None,
                  env_extra: "dict | None" = None,
                  slot_env: "dict | None" = None,
-                 durable: bool = True):
+                 durable: bool = True,
+                 hosts: int = 1,
+                 nregions: int = 0):
         """`init`: a "module:callable" data-seeding hook — under the
         durable store (the default) it runs ONCE fleet-wide (the first
         worker seeds, the rest replay the shared log); with
@@ -83,8 +92,14 @@ class Fleet:
         GLOBAL sysvars every worker applies at boot.  `slot_env`:
         {slot: {ENV: val}} extras for individual workers (the chaos
         schedule's door: e.g.
-        ``{2: {"TIDB_TPU_FABRIC_FAILPOINTS": "fabric-kill-worker=1*return(1)"}}``)."""
+        ``{2: {"TIDB_TPU_FABRIC_FAILPOINTS": "fabric-kill-worker=1*return(1)"}}``).
+        `hosts`: partition workers into this many per-host process
+        groups (1 = the classic single-host fleet, no extra groups).
+        `nregions`: region cells to allocate in the segment."""
         self.procs = procs
+        self.hosts = max(int(hosts), 1)
+        self.nregions = int(nregions)
+        self._host_pgid: dict[int, int] = {}
         self.init = init
         self.durable = durable
         self.sysvars = dict(sysvars or {})
@@ -111,7 +126,7 @@ class Fleet:
         os.makedirs(self.run_dir, exist_ok=True)
         self.coord = Coordinator.create(
             os.path.join(self.run_dir, "coord.json"),
-            nslots=max(self.procs, 2))
+            nslots=max(self.procs, 2), nregions=self.nregions)
         self._reserve_port()
         if self.with_compile_server:
             self._spawn_compile_server(timeout_s)
@@ -211,11 +226,62 @@ class Fleet:
         env.update(self.slot_env.pop(s.idx, {}))
         s.ready.clear()
         s.started_at = time.monotonic()
-        s.proc = subprocess.Popen(
-            [sys.executable, "-m", "tidb_tpu.fabric.worker"],
-            env=env, stdout=subprocess.PIPE, text=True, cwd=os.getcwd())
+        s.proc = self._popen_worker(s, env)
         threading.Thread(target=self._read_worker, args=(s, s.proc),
                          daemon=True, name=f"fabric-read-{s.idx}").start()
+
+    def _popen_worker(self, s: _Slot, env: dict):
+        argv = [sys.executable, "-m", "tidb_tpu.fabric.worker"]
+        if self.hosts <= 1:
+            return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                                    text=True, cwd=os.getcwd())
+        # multi-host: the worker joins its host's process group (the
+        # first live worker of the host leads a fresh group), so
+        # kill_host / the fabric-kill-host failpoint can take out the
+        # whole "machine" with one killpg
+        host = self.host_of(s.idx)
+        env["TIDB_TPU_FABRIC_HOST"] = str(host)
+        pgid = self._host_pgid.get(host, 0)
+        if pgid and not _pg_alive(pgid):
+            pgid = 0  # the old leader's group is gone: lead a new one
+        try:
+            proc = subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE, text=True,
+                cwd=os.getcwd(),
+                preexec_fn=_setpgid_fn(pgid))  # noqa: PLW1509 — single-
+            #   threaded child pre-exec; only setpgid runs
+        except (OSError, subprocess.SubprocessError):
+            if not pgid:
+                raise
+            # the leader died between the aliveness probe and the fork:
+            # this worker becomes the host's new group leader
+            proc = subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE, text=True,
+                cwd=os.getcwd(), preexec_fn=_setpgid_fn(0))  # noqa: PLW1509
+            pgid = 0
+        if not pgid:
+            self._host_pgid[host] = proc.pid
+        return proc
+
+    def host_of(self, slot: int) -> int:
+        return slot % self.hosts
+
+    def host_slots(self, host: int) -> list:
+        return [s.idx for s in self.slots if self.host_of(s.idx) == host]
+
+    def kill_host(self, host: int, sig=signal.SIGKILL):
+        """The host-loss chaos primitive: SIGKILL the whole simulated
+        host's process group — every worker on it dies at once, leases
+        and all, exactly like a machine losing power."""
+        pgid = self._host_pgid.get(host)
+        if pgid and _pg_alive(pgid):
+            with _suppress():
+                os.killpg(pgid, sig)
+            return
+        # no live group (group leader already gone): kill stragglers
+        # individually so the semantic stays "the host is down"
+        for idx in self.host_slots(host):
+            self.kill_worker(idx, sig)
 
     def _read_worker(self, s: _Slot, proc):
         for line in proc.stdout:
@@ -372,3 +438,23 @@ class Fleet:
 def _suppress():
     import contextlib
     return contextlib.suppress(Exception)
+
+
+def _pg_alive(pgid: int) -> bool:
+    """Is any process left in this group?  Signal 0 probes without
+    delivering."""
+    try:
+        os.killpg(pgid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _setpgid_fn(pgid: int):
+    """Child-side pre-exec: join (or, with 0, lead) a process group —
+    Python 3.10 has no Popen(process_group=...) yet."""
+    def fn():
+        os.setpgid(0, pgid)
+    return fn

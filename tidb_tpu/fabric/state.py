@@ -37,6 +37,10 @@ _LOCK = threading.Lock()
 #: the active fabric context: [coordinator, slot, compile_server_addr]
 _CTX = [None, -1, None]
 _DEDUP = [None]
+#: the process's region router (fabric/region.RegionStore) when the
+#: keyspace is region-sharded; worker heartbeats drive its lease
+#: renewal + expired-lease failover sweep through this handle
+_REGIONS = [None]
 
 #: process-local fabric counters (the segment holds the fleet-global
 #: ones; these attribute THIS worker's share for its /status payload)
@@ -78,6 +82,7 @@ def deactivate():
         _CTX[1] = -1
         _CTX[2] = None
         _DEDUP[0] = None
+        _REGIONS[0] = None
     from ..executor import scheduler
     scheduler.set_fleet(None)
     from ..ops import residency
@@ -110,6 +115,26 @@ def dedup_handle():
     """The fragment-dedup handle (device_exec.run_device consults this
     for batch_key'd dispatches), or None outside a fleet."""
     return _DEDUP[0]
+
+
+def set_region_store(rs):
+    """Register (or clear, with None) this process's region router —
+    the worker heartbeat thread then renews its leases and sweeps
+    expired siblings' regions for failover."""
+    with _LOCK:
+        _REGIONS[0] = rs
+
+
+def region_store():
+    return _REGIONS[0]
+
+
+def host() -> "int | None":
+    """The simulated host id this worker runs on (fleet.py spawns
+    multi-host fleets with TIDB_TPU_FABRIC_HOST), or None."""
+    import os
+    h = os.environ.get("TIDB_TPU_FABRIC_HOST")
+    return int(h) if h is not None else None
 
 
 def bump(key: str, n=1):

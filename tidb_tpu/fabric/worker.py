@@ -11,6 +11,9 @@ a bit-identical re-exec):
     TIDB_TPU_FABRIC_INIT        "module:callable" data-seeding hook(domain)
     TIDB_TPU_FABRIC_GLOBALS     "name=value;..." GLOBAL sysvars at boot
     TIDB_TPU_FABRIC_FAILPOINTS  "name=action;..." chaos failpoints
+    TIDB_TPU_FABRIC_HOST        simulated host id (multi-host fleets;
+                                presence means "my process group IS my
+                                host" — the fabric-kill-host contract)
     TIDB_TPU_COMPILE_SERVER     the separated compile server's socket
 
 Boot order matters: the conn-id base installs BEFORE the Domain
@@ -140,6 +143,19 @@ def main() -> int:
             # this process held (bench_serve fleet chaos + test_fabric)
             if failpoint.inject("fabric-kill-worker"):
                 os.kill(os.getpid(), signal.SIGKILL)
+            # `fabric-kill-host` takes out the whole simulated HOST: the
+            # worker's process group holds every sibling on this host
+            # (fleet.py spawns multi-host fleets that way), so one
+            # killpg is a machine losing power mid-commit — every
+            # region lease the host held expires and must fail over.
+            # Outside a multi-host fleet (no TIDB_TPU_FABRIC_HOST) the
+            # group may be the test runner's own, so only this process
+            # dies — same failpoint, blast radius scoped to what the
+            # topology actually isolates.
+            if failpoint.inject("fabric-kill-host"):
+                if os.environ.get("TIDB_TPU_FABRIC_HOST") is not None:
+                    os.killpg(os.getpgid(0), signal.SIGKILL)
+                os.kill(os.getpid(), signal.SIGKILL)
             return super()._run_query(io, session, sql)
 
     shared = FabricMySQLServer(domain, port=port, users={},
@@ -171,6 +187,16 @@ def main() -> int:
                     # reclaimed by whoever notices first (the parent
                     # usually wins; this covers a dead parent too)
                     coordinator.reclaim_expired(HEARTBEAT_S * 8)
+                rs = state.region_store()
+                if rs is not None:
+                    # region leases ride the same beat: renew ours
+                    # (losing one closes that store before a stale
+                    # write can race the new owner), and every 8th
+                    # beat sweep for a dead host's expired regions —
+                    # the survivor side of host-loss failover
+                    rs.heartbeat()
+                    if n % 8 == 0:
+                        rs.failover_expired()
             except Exception as e:  # noqa: BLE001 — a missed beat is
                 #   recoverable; a dead segment means the fleet is gone
                 hb_log.warning("lease heartbeat failed: %s", e)

@@ -177,14 +177,26 @@ class DurableMVCCStore(MVCCStore):
         self._tail_stop = threading.Event()
         self._tail_thread = None
         self._recovered = False
+        # replayed txn fates, stashed by recover() for deferred
+        # cross-region orphan resolution (fabric/region.py)
+        self._recover_lock_owner: dict[int, int] = {}
+        self._recover_disposition: dict[int, tuple] = {}
 
     # -- lifecycle ------------------------------------------------------------
 
-    def recover(self) -> dict:
+    def recover(self, *, defer_orphans: bool = False) -> dict:
         """Checkpoint + tail replay + torn-tail truncation + orphan
         resolution.  Idempotent; runs under the cross-process WAL lock
         (boot of a fresh replica into a live fleet replays the whole
         log while peers keep appending — the tailer picks up the rest).
+
+        ``defer_orphans=True`` skips the resolution pass and stashes the
+        replayed disposition/owner maps on the instance: a region-
+        sharded store (fabric/region.py) recovers EVERY region first,
+        merges their dispositions, and only then resolves — a
+        cross-region txn's commit point may live in another region's
+        log (the primary key's region), and resolving from one region's
+        log alone would roll back a committed txn's secondaries.
         """
         from ..session import tracing
         t0 = time.monotonic()
@@ -213,36 +225,11 @@ class DurableMVCCStore(MVCCStore):
                 # a same-millisecond restart could otherwise mint
                 # timestamps below them (invisible to new snapshots)
                 self.tso.advance_to(max_ts)
-            # resolve orphaned prewrites via their primary: a commit
-            # record for the start_ts is the primary's committed proof;
-            # none means the txn died before its commit point.  Locks
-            # owned by a LIVE sibling slot are in-flight 2PC, not
-            # orphans.
-            live = set()
-            if self._coord is not None:
-                with contextlib.suppress(Exception):
-                    live = set(self._coord.live_slots())
+            self._recover_lock_owner = lock_owner
+            self._recover_disposition = disposition
             resolved = 0
-            with self._lock:
-                leftovers = list(self.locks.items())
-            for key, lk in leftovers:
-                owner = lock_owner.get(lk.start_ts, -2)
-                if owner in live and owner != self._slot:
-                    continue
-                fate = disposition.get(lk.start_ts)
-                tid = _table_id_of(key)
-                if fate is not None and fate[0] == "commit":
-                    MVCCStore.commit(self, [key], lk.start_ts, fate[1])
-                    rec = ("commit", self._slot, lk.start_ts, fate[1],
-                           [key], [tid] if tid is not None else [])
-                else:
-                    MVCCStore.rollback(self, [key], lk.start_ts)
-                    rec = ("rollback", self._slot, lk.start_ts, [key])
-                # the resolution is logged so every replica (live peers
-                # tailing now, future recoveries) converges on one fate
-                with contextlib.suppress(Exception):
-                    self.wal.append(rec)
-                resolved += 1
+            if not defer_orphans:
+                resolved = self.resolve_orphans(disposition, lock_owner)
             self._publish_after_recovery()
             self._recovered = True
             wal_mod._bump("wal_recoveries")
@@ -253,6 +240,51 @@ class DurableMVCCStore(MVCCStore):
                    "recover_s": round(time.monotonic() - t0, 4)}
             log.info("store recovered: %s", out)
             return out
+
+    def resolve_orphans(self, disposition: "dict[int, tuple]",
+                        lock_owner: "dict[int, int] | None" = None,
+                        *, assume_fenced: bool = False) -> int:
+        """Resolve orphaned prewrites via their primary: a commit record
+        for the start_ts is the primary's committed proof; none means
+        the txn died before its commit point.  Locks owned by a LIVE
+        sibling slot are in-flight 2PC, not orphans — UNLESS
+        ``assume_fenced``: a region-failover owner holds the new epoch,
+        so the old owner (even one still heartbeating: a partitioned
+        zombie) can never append its commit record past the fence, and
+        deferring to it would leave its locks blocking reads forever.
+
+        ``disposition`` may be wider than this store's own log: the
+        region router merges every region's replayed dispositions so a
+        secondary in region B finds its primary's commit from region
+        A's log (Percolator's commit point is per-txn, not per-region).
+        """
+        lock_owner = lock_owner if lock_owner is not None else {}
+        live = set()
+        if self._coord is not None and not assume_fenced:
+            with contextlib.suppress(Exception):
+                live = set(self._coord.live_slots())
+        resolved = 0
+        with self._lock:
+            leftovers = list(self.locks.items())
+        for key, lk in leftovers:
+            owner = lock_owner.get(lk.start_ts, -2)
+            if owner in live and owner != self._slot:
+                continue
+            fate = disposition.get(lk.start_ts)
+            tid = _table_id_of(key)
+            if fate is not None and fate[0] == "commit":
+                MVCCStore.commit(self, [key], lk.start_ts, fate[1])
+                rec = ("commit", self._slot, lk.start_ts, fate[1],
+                       [key], [tid] if tid is not None else [])
+            else:
+                MVCCStore.rollback(self, [key], lk.start_ts)
+                rec = ("rollback", self._slot, lk.start_ts, [key])
+            # the resolution is logged so every replica (live peers
+            # tailing now, future recoveries) converges on one fate
+            with contextlib.suppress(Exception):
+                self.wal.append(rec)
+            resolved += 1
+        return resolved
 
     def _publish_after_recovery(self):
         if self._coord is None:

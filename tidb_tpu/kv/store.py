@@ -306,11 +306,17 @@ class Storage:
     write-ahead logged, crash-recovered, and fleet-coherent when the
     fabric coordination segment is active (the durable substrate owns
     the version-chain format, so it pins the python engine; a native
-    checkpoint codec is an open ROADMAP corner)."""
+    checkpoint codec is an open ROADMAP corner).
+
+    ``mvcc`` injects a prebuilt engine directly — the region-sharded
+    router (fabric/region.RegionStore) plugs in here so Transaction /
+    Snapshot run unchanged over a keyspace split across region WALs."""
 
     def __init__(self, backend: str = "auto",
-                 wal_dir: "str | None" = None):
-        if wal_dir:
+                 wal_dir: "str | None" = None, mvcc=None):
+        if mvcc is not None:
+            self.mvcc = mvcc
+        elif wal_dir:
             from .shared_store import open_durable_mvcc
             self.mvcc = open_durable_mvcc(wal_dir)
         else:

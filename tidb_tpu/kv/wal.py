@@ -40,12 +40,24 @@ Checkpoint: ``checkpoint(state_blob)`` writes the engine snapshot
 log tail up to the smallest LSN every live fleet replica has applied —
 recovery becomes "load snapshot, replay the short tail".
 
+Region sharding (fabric/region.py): a region-sharded store holds one
+WAL PER REGION under ``<root>/region-<rid>/`` (:func:`region_dir` /
+:func:`region_ids` name the layout), each wired to a
+``RegionCoordView`` whose committed-length/applied-LSN cells are the
+region's own segment row — epoch-fenced, so a zombie host's appender
+fails loudly (``check_fence`` hook below) instead of writing into a
+region that failed over.  :meth:`WAL.tail_bytes` and
+:func:`write_wal_files` are the replication unit: the physical framed
+tail ships to the blob store and is materialized verbatim on restore.
+
 Failpoints (chaos + crash-matrix hooks): ``wal-append-torn`` (payload
 ``torn``: write half the frame, heal by truncating back, fail the
 append; payload ``kill``: write half the frame and SIGKILL — the torn
 bytes stay for recovery to CRC-truncate; ``panic`` action: fail before
 writing), ``wal-fsync-fail`` (``panic``: the fsync raises — the commit
-fails classified; ``kill``: SIGKILL before the fsync).
+fails classified; ``kill``: SIGKILL before the fsync; payload ``eio``:
+the fsync itself fails OSError — ``N*return(eio)`` makes the failure
+transient, the shape the budgeted ``walSyncRetry`` attempt absorbs).
 """
 
 from __future__ import annotations
@@ -86,6 +98,7 @@ STATS = {
     "wal_truncated_records": 0,  # torn/CRC-bad tail records dropped
     "wal_tail_records": 0,       # foreign records applied by the tailer
     "wal_fsync_errors": 0,       # failed fsyncs (commit failed classified)
+    "wal_fsync_retries": 0,      # budgeted walSyncRetry attempts that ran
 }
 _STATS_LOCK = threading.Lock()
 
@@ -120,6 +133,50 @@ def reset_for_tests():
     with _STATS_LOCK:
         for k in STATS:
             STATS[k] = 0
+
+
+def region_dir(root: str, rid: int) -> str:
+    """The per-region WAL directory under a sharded store's root."""
+    return os.path.join(root, f"region-{rid}")
+
+
+def region_ids(root: str) -> "list[int]":
+    """Region ids with a WAL directory under ``root`` (sorted)."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("region-"):
+            with contextlib.suppress(ValueError):
+                out.append(int(name[len("region-"):]))
+    out.sort()
+    return out
+
+
+def write_wal_files(dirpath: str, base_lsn: int, tail: bytes,
+                    checkpoint: "bytes | None" = None) -> None:
+    """Materialize a WAL directory from replicated parts (the restore
+    half of region failover): ``wal.log`` = header(base_lsn) + the
+    physical framed tail, ``checkpoint.bin`` verbatim (it carries its
+    own header + CRC).  Atomic renames + fsync, so a crash mid-restore
+    leaves no half-written log for recovery to misread."""
+    os.makedirs(dirpath, exist_ok=True)
+    if checkpoint is not None:
+        tmp = os.path.join(dirpath, f"checkpoint.{os.getpid()}.rst")
+        with open(tmp, "wb") as f:
+            f.write(checkpoint)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(dirpath, "checkpoint.bin"))
+    tmp = os.path.join(dirpath, f"wal.{os.getpid()}.rst")
+    with open(tmp, "wb") as f:
+        f.write(_FHDR.pack(WAL_MAGIC, base_lsn))
+        f.write(tail)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirpath, "wal.log"))
 
 
 class WAL:
@@ -230,6 +287,12 @@ class WAL:
             with self._lock, self._flocked():
                 if self._closed:
                     raise FailpointError("wal closed")
+                # region fencing: a RegionCoordView checks its epoch is
+                # still current BEFORE any byte lands — a stale appender
+                # (zombie host whose region failed over) dies loudly here
+                fence = getattr(self._coord, "check_fence", None)
+                if fence is not None:
+                    fence()
                 end = self._repair_tail_locked()
                 fp = failpoint.inject("wal-append-torn")
                 if fp:
@@ -333,16 +396,34 @@ class WAL:
             # loop: another append raced past; wait for the next flush
 
     def _fsync_once(self):
+        from ..utils.backoff import Backoffer, BackoffExhaustedError
         # capture the frontier FIRST: the fsync covers at least this
         cover = self.end_lsn()
-        fp = failpoint.inject("wal-fsync-fail")
-        if fp == "kill":
-            os.kill(os.getpid(), signal.SIGKILL)
-        try:
-            os.fsync(self._f.fileno())
-        except OSError:
-            _bump("wal_fsync_errors")
-            raise
+        bo = None
+        while True:
+            fp = failpoint.inject("wal-fsync-fail")
+            if fp == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            try:
+                if fp == "eio":
+                    raise OSError(
+                        5, "Input/output error (injected by failpoint "
+                        "wal-fsync-fail)")
+                os.fsync(self._f.fileno())
+            except OSError as e:
+                _bump("wal_fsync_errors")
+                # one budgeted walSyncRetry attempt: a transient
+                # EIO/ENOSPC blip must not abort a durable commit, a
+                # sick disk must still fail fast (budget, not a spin)
+                if bo is None:
+                    bo = Backoffer(budget_ms=100.0)
+                try:
+                    bo.backoff("walSyncRetry", e)
+                except BackoffExhaustedError:
+                    raise e from None
+                _bump("wal_fsync_retries")
+                continue
+            break
         _bump("wal_fsyncs")
         with self._flush_cv:
             if cover > self._synced_lsn:
@@ -461,6 +542,24 @@ class WAL:
                     if not cell or cell > good:
                         self._coord.set_wal_len(good)
             return max(torn, 0)
+
+    def tail_bytes(self, from_lsn: "int | None" = None) -> tuple:
+        """The physical framed bytes from ``from_lsn`` (default: the
+        file base) to the committed frontier, as ``(start_lsn, bytes)``
+        — the unit RegionReplicator ships to the blob store.  Reading
+        stops at the COMMITTED length, so a torn tail a dying peer left
+        past the cell never replicates."""
+        with self._lock, self._flocked():
+            self._revalidate_handle_locked()
+            end = self.committed_lsn()
+            start = (self.base_lsn if from_lsn is None
+                     else max(from_lsn, self.base_lsn))
+            if end <= start:
+                return (start, b"")
+            self._f.seek(start - self.base_lsn + _FHDR.size)
+            data = self._f.read(end - start)
+            self._f.seek(0, os.SEEK_END)
+            return (start, data)
 
     # -- checkpoint -----------------------------------------------------------
 

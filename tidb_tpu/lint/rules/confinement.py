@@ -201,6 +201,52 @@ class SharedMemoryConfinement(Rule):
 
 
 @register
+class SocketConfinement(Rule):
+    """Raw ``socket``/``socketserver`` use for COORDINATION is confined
+    to ``tidb_tpu/fabric/``: the length-prefixed frame codec
+    (fabric/codec.py), its torn-frame discipline, the down-window retry
+    budgets and the drain invariants only hold if every coordination
+    byte rides the fabric's transports (coord_net, compile server,
+    fleet port reservation, the bench wire client).  The ONE other
+    sanctioned socket owner is ``server/`` — the MySQL wire protocol IS
+    a socket listener; that is its job, not coordination.  A new layer
+    that wants cross-process bytes goes through fabric/state.py hooks
+    or a fabric service, never its own ad-hoc socket."""
+
+    name = "socket-confinement"
+    allowlistable = False
+    title = "raw socket use confined to fabric/ (and the MySQL wire in server/)"
+
+    ALLOWED_PREFIXES = ("fabric/", "server/")
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if sf.rel.startswith(self.ALLOWED_PREFIXES):
+                continue
+            for node in ast.walk(sf.tree):
+                hit = None
+                if isinstance(node, ast.Import):
+                    if any(a.name in ("socket", "socketserver")
+                           for a in node.names):
+                        hit = "import"
+                elif isinstance(node, ast.ImportFrom):
+                    if (node.module or "") in ("socket", "socketserver"):
+                        hit = "import"
+                elif (isinstance(node, ast.Call)
+                        and call_name(node) in (
+                            "socket.socket", "socket.create_connection")):
+                    hit = "ctor"
+                if hit is not None:
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"socket-{hit}@{sf.qualname(node)}",
+                        "raw socket use outside fabric/ and server/ "
+                        "(coordination goes through a fabric transport)"))
+        return out
+
+
+@register
 class RunDeviceShape(Rule):
     """A run_device call without ``shape=`` silently shares the 'agg'
     breaker — a new fragment class must never piggyback unnoticed.

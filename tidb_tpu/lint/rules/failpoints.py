@@ -1,8 +1,8 @@
 """Failpoint catalog coverage: every ``failpoint.inject("name")`` site in
 the package must appear in at least one chaos catalog
 (tests/chaos_harness.py READ_FAULTS / WRITE_FAULTS / THREADED_FAULTS /
-FLEET_FAULTS) — an uncataloged failpoint is a fault hook no chaos seed
-ever exercises, i.e. a recovery path with zero coverage.
+FLEET_FAULTS / HOST_FAULTS) — an uncataloged failpoint is a fault hook
+no chaos seed ever exercises, i.e. a recovery path with zero coverage.
 """
 
 from __future__ import annotations
@@ -14,9 +14,10 @@ from ._util import call_name, const_str
 
 #: the catalog dict names in the chaos harness (FLEET_FAULTS holds the
 #: process-level faults bench_serve's --procs mode injects via worker
-#: spawn env — in-process seeds cannot SIGKILL themselves)
+#: spawn env — in-process seeds cannot SIGKILL themselves; HOST_FAULTS
+#: holds the whole-host kills the multi-host failover bench injects)
 CATALOG_NAMES = ("READ_FAULTS", "WRITE_FAULTS", "THREADED_FAULTS",
-                 "FLEET_FAULTS")
+                 "FLEET_FAULTS", "HOST_FAULTS")
 HARNESS_REL = "tests/chaos_harness.py"
 
 

@@ -198,6 +198,16 @@ KINDS = {k.name: k for k in [
     # job fails classified and charges the compile-scoped breaker
     Kind("compileRetry", base_ms=5, cap_ms=100, jitter="equal",
          max_attempts=4),
+    # WAL fsync failure (kv/wal.py): ONE budgeted retry before the owner
+    # aborts the commit — a transient EIO/ENOSPC blip should not abort a
+    # durable txn, but a sick disk must fail fast, not spin
+    Kind("walSyncRetry", base_ms=5, cap_ms=50, jitter="equal",
+         max_attempts=2),
+    # network-coordinator transport failure (fabric/coord_net.py): a few
+    # short attempts before the client opens its down-window and degrades
+    # to local-only admission
+    Kind("coordRetry", base_ms=2, cap_ms=50, jitter="equal",
+         max_attempts=4),
 ]}
 # (no "lease"/"device" kinds yet: campaign losses degrade by skipping the
 # round, and device failures route through the circuit breaker, not a
