@@ -405,8 +405,12 @@ def _install(tk, table, data, n):
             columns[c.id] = _dict_col(codes, dictionary, c.ftype)
         else:
             columns[c.id] = Column(c.ftype, arr, z)
+    # the content tag makes the fixed-seeded generator's determinism an
+    # EXPLICIT declaration: (table, row count, generator version) is the
+    # content identity the fleet result cache keys bulk data under
     tk.domain.columnar_cache.install_bulk(
-        info, columns, np.arange(1, n + 1, dtype=np.int64))
+        info, columns, np.arange(1, n + 1, dtype=np.int64),
+        content_tag=f"bench.gen_all/{table}/n{n}/v1")
 
 
 def gen_all(tk, sf: float):
@@ -483,7 +487,8 @@ def gen_all(tk, sf: float):
             cols = open_paged_columns(root, info)
             if len(next(iter(cols.values()))) == n_rows:
                 tk.domain.columnar_cache.install_bulk(
-                    info, cols, LazyRangeHandles(n_rows))
+                    info, cols, LazyRangeHandles(n_rows),
+                    content_tag=f"bench.gen_all/{table}/n{n_rows}/v1")
                 return
             # stale cache: drop the manifest FIRST so a crash mid-rewrite
             # can't leave a valid manifest over truncated column files
@@ -497,7 +502,9 @@ def gen_all(tk, sf: float):
             w.append(gen_page(pi, lo, m))
         cols, handles = w.finalize()
         assert set(cols) <= set(name2id.values())
-        tk.domain.columnar_cache.install_bulk(info, cols, handles)
+        tk.domain.columnar_cache.install_bulk(
+            info, cols, handles,
+            content_tag=f"bench.gen_all/{table}/n{n_rows}/v1")
 
     # --- lineitem -----------------------------------------------------
     _stage(f"generating lineitem ({n_line} rows, paged={paged})")

@@ -124,6 +124,13 @@ READ_FAULTS = {
     # exact through the ownership churn
     "coordinator-lease-expire": ["return(1)"],
     "coordinator-heartbeat-lost": ["return(1)"],
+    # versioned result cache (fabric/dedup.claim_versioned): skip the
+    # claim-time version-vector check once, deliberately serving a
+    # version-STALE page into the in-page verify — which must refuse it
+    # loudly (cache_stale_reads bumps, local recompute) so the read
+    # stays exact; a silent wrong answer here is the one unforgivable
+    # cache failure (tests/test_result_cache.py pins the refusal)
+    "cache-stale-read": ["1*return(1)", "2*return(1)"],
 }
 
 #: write-path fault catalog: 2PC crash windows + WAL failure windows

@@ -26,10 +26,12 @@ def test_bench_serve_fleet_smoke():
     regression (light tenant p99 on worker B below the heavy tenant's
     p50 flooding worker A, fleet-wide cap never exceeded), (b) the fleet
     fragment-dedup counter moving under concurrent identical OLAP
-    fragments on two workers, and (c) a process-kill chaos seed
-    completing with respawn and ZERO coordination-segment lease/ticket
-    leaks.  run_fleet raises on any violation; assertions here pin the
-    summary shape."""
+    fragments on two workers, (c) the version-stamped result cache
+    serving a pure repeat loop with ZERO admissions, invalidating on a
+    committed INSERT and delta-folding bit-equal to a from-scratch run,
+    and (d) a process-kill chaos seed completing with respawn and ZERO
+    coordination-segment lease/ticket leaks.  run_fleet raises on any
+    violation; assertions here pin the summary shape."""
     emitted = []
     summary = bench_serve.run_fleet(procs=4, n_threads=8, n_ops=3,
                                     sf=0.002, seed=0, chaos=True,
@@ -38,6 +40,13 @@ def test_bench_serve_fleet_smoke():
     assert summary["dedup_hits"] > 0
     assert summary["peak_running_heavy"] <= 1
     assert summary["p99_light_s"] < max(summary["p50_heavy_s"], 0.05)
+    # the result-cache acceptance: every repeat served from the page
+    # (hit rate 1.0), no admission during the repeat loop, and the
+    # post-INSERT read folded the delta instead of recomputing
+    assert summary["cache_hit_rate"] >= 1.0
+    assert summary["cache_delta_folds"] >= 1
+    cache = [e for e in emitted if e["metric"] == "serve_cache"]
+    assert cache and cache[0]["admissions_during_repeat"] == 0
     drained = [e for e in emitted if e["metric"] == "fleet_drained"]
     assert drained and drained[0]["ok"]
     # per-process AND fleet-aggregate latency lines were emitted

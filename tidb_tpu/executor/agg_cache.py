@@ -1,0 +1,642 @@
+"""Version-stamped fragment result cache + materialized agg deltas.
+
+This is the executor side of the fleet result cache (the claim table
+and page store live in fabric/coord.py + fabric/dedup.py; the per-table
+fleet version vector is fed by kv/shared_store.py).  A HashAgg over a
+single-table scan — the Q1 shape — resolves the referenced table's
+CURRENT fleet version, stamps its dedup key with a ``vcache`` prefix
+and probes the versioned claim table:
+
+* **hit** — every referenced table's fleet version still matches the
+  vector the page was computed under.  The cached chunk is returned
+  directly: no WFQ ticket, no HBM charge, no device dispatch — the
+  probe runs BEFORE admission, so a hit bypasses the scheduler
+  entirely (bench_serve --smoke pins the ``fabric_admissions`` delta
+  to zero across a pure repeat loop).
+* **invalidated / delta-fold** — the version advanced under the page.
+  The claim comes back as a lead WITH the superseded page, and when
+  the plan's aggregates are mergeable (non-distinct count / sum / min /
+  max / avg over non-float args) the WAL-tailed delta rows since the
+  cached version (kv/shared_store.delta_keys_since) are folded through
+  the cached per-group partials instead of recomputing from scratch.
+  ``avg`` keeps its exact (sum, count) integer partials alongside the
+  chunk precisely so a fold is BIT-EQUAL to a from-scratch run (the
+  shared rounding lives in exec_select._avg_exact).
+* **miss** — this process computes (through the ordinary engine
+  paths), then publishes the chunk + vector + partials as a page.
+
+Soundness:
+
+* eligibility demands the reader see exactly the fleet version's data:
+  a durable store whose local applied version EQUALS the fleet version
+  (one forced catch_up retry), no dirty txn state on the table, no
+  stale-read clock, a read snapshot at/after the fleet version;
+* a never-SQL-written table has no version to stamp; it caches at
+  "version 0" ONLY when its bulk install declared a content tag
+  (ColumnarCache.install_bulk) — bulk columns are process-local, so
+  the tag (folded into the key) is what makes cross-worker identity
+  explicit rather than assumed.  The first committed write gives the
+  table a real fleet version and invalidates every version-0 page;
+* the fold only trusts a delta the ring can PROVE complete, and only
+  pure inserts (a row with any committed version at the cached ts
+  aborts the fold — updates/deletes can't be folded through partials);
+* publish re-reads the fleet vector and drops the page if a commit
+  raced the compute;
+* every hit re-verifies the vector stored INSIDE the page (the
+  ``cache-stale-read`` failpoint forces this path: a deliberately
+  version-stale page is a loud ``cache_stale_reads`` refusal and a
+  local recompute, never a wrong answer).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import threading
+
+import numpy as np
+
+log = logging.getLogger("tidb_tpu.executor.agg_cache")
+
+#: cached pages larger than this many groups are not folded (the python
+#: merge loop is per matched group; past this a recompute wins anyway)
+FOLD_MAX_GROUPS = 65536
+#: delta windows wider than this many row keys recompute from scratch
+FOLD_MAX_DELTA_ROWS = 4096
+#: aggregates mergeable through per-group partials
+FOLD_FNS = frozenset({"count", "sum", "min", "max", "avg"})
+
+
+# -- partial capture ----------------------------------------------------------
+#
+# The compute paths (exec_select._execute_host, device_exec._assemble_agg)
+# note their exact integer avg partials here while a publish-bound compute
+# runs, so the page can carry (sum, count) per group.  Thread-local: the
+# capture must never see a CONCURRENT statement's partials.
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def capture_partials():
+    cap = {"passes": 0, "avg": []}
+    prev = getattr(_TLS, "cap", None)
+    _TLS.cap = cap
+    try:
+        yield cap
+    finally:
+        _TLS.cap = prev
+
+
+def note_agg_pass():
+    """One final-assembly pass ran (host group-by or device assemble).
+    A multi-pass compute (spill partitions, per-batch assembles) yields
+    partials that don't align with the output rows; the publish gate
+    requires exactly one pass."""
+    cap = getattr(_TLS, "cap", None)
+    if cap is not None:
+        cap["passes"] += 1
+
+
+def note_avg_partial(s, counts):
+    """The exact integer (per-group sum, per-group non-null count) pair
+    behind one decimal AVG column, in output-row order."""
+    cap = getattr(_TLS, "cap", None)
+    if cap is not None:
+        cap["avg"].append((np.asarray(s, dtype=object),
+                           np.asarray(counts, dtype=np.int64)))
+
+
+# -- the cache spec -----------------------------------------------------------
+
+def _concat(a, b):
+    """Concatenate preserving the left side's dtype (object stays
+    object; int64 stays int64 — a folded chunk must be layout-identical
+    to a from-scratch one)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.dtype == object or b.dtype == object:
+        out = np.empty(len(a) + len(b), dtype=object)
+        out[:len(a)] = a
+        out[len(a):] = b
+        return out
+    return np.concatenate([a, b.astype(a.dtype, copy=False)])
+
+
+def _norm_key(v, isnull: bool):
+    """Group-key value → a dict-able python scalar (np scalars unify
+    with their python equivalents via .item(); NULL groups key as
+    None — distinct from any value)."""
+    if isnull:
+        return None
+    if isinstance(v, np.generic):
+        v = v.item()
+    return v
+
+
+class AggCacheSpec:
+    """Per-statement cache plan for one HashAgg fragment.  Built before
+    any engine work; ``probe()`` may serve/fold a page, ``publish()``
+    stamps the computed chunk, ``annotate()`` writes the EXPLAIN
+    ANALYZE ``cache:`` line."""
+
+    def __init__(self, agg_exec):
+        self._agg = agg_exec
+        self._ctx = agg_exec.ctx
+        self.eligible = False
+        self.outcome = "miss"
+        self.why = None
+        self._plan = None
+        self._sp = None
+        self._conds = ()
+        self._tid = 0
+        self._mvcc = None
+        self._coord = None
+        self._ded = None
+        self._vv = {}
+        self._vv_hash = 0
+        self._key = b""
+        self._idx = None
+        self._old = None
+        self._bulk_tag = None
+
+    # -- eligibility ---------------------------------------------------------
+
+    @classmethod
+    def build(cls, agg_exec):
+        """None outside a fleet (zero overhead and zero EXPLAIN noise in
+        the single-process deployment); otherwise a spec, possibly
+        ineligible with ``why`` set."""
+        from ..fabric import state
+        if not state.active():
+            return None
+        ded = state.dedup_handle()
+        coord = state.coordinator()
+        if ded is None or coord is None:
+            return None
+        try:
+            on = str(agg_exec.ctx.get_sysvar("tidb_result_cache")).upper()
+        except Exception:  # noqa: BLE001 — unknown sysvar: default on
+            on = "ON"
+        if on not in ("ON", "1"):
+            return None
+        spec = cls(agg_exec)
+        spec._ded = ded
+        spec._coord = coord
+        spec.why = spec._resolve()
+        spec.eligible = spec.why is None
+        return spec
+
+    def _resolve(self) -> "str | None":
+        """Work out shape + versions; returns the ineligibility reason
+        or None (eligible, with _vv/_key populated)."""
+        from .exec_select import (ProjectionExec, SelectionExec,
+                                  TableScanExec, _inline_agg_projection)
+        agg = self._agg
+        p = agg.plan
+        if getattr(p, "agg_hint", None) == "stream":
+            return "stream-hint"
+        eff_p, child = p, agg.children[0]
+        while isinstance(child, ProjectionExec):
+            r = _inline_agg_projection(eff_p, child)
+            if r is None:
+                break
+            eff_p, child = r
+        if isinstance(child, TableScanExec):
+            sp, conds = child.plan, list(child.plan.pushed_conds)
+        elif (isinstance(child, SelectionExec)
+              and isinstance(child.children[0], TableScanExec)):
+            sp = child.children[0].plan
+            conds = (list(sp.pushed_conds) + list(child.plan.conds))
+        else:
+            return "not-scan-agg"
+        if sp.access is not None:
+            return "access-path"
+        if sp.table_info.partition is not None:
+            return "partitioned"
+        self._plan, self._sp, self._conds = eff_p, sp, conds
+        tid = sp.table_info.id
+        self._tid = tid
+        ctx = self._ctx
+        if ctx.txn_dirty(tid):
+            return "txn-dirty"
+        if ctx.stale_read_ts() is not None:
+            return "stale-read"
+        mvcc = getattr(getattr(ctx, "store", None), "mvcc", None)
+        from ..kv.shared_store import DurableMVCCStore
+        if not isinstance(mvcc, DurableMVCCStore):
+            return "store-not-shared"
+        self._mvcc = mvcc
+        try:
+            self._bulk_tag = ctx.columnar_cache().bulk_tag(tid)
+        except Exception:  # noqa: BLE001 — no columnar cache on ctx
+            self._bulk_tag = None
+        try:
+            sig = self._signature(eff_p, conds)
+        except Exception as e:  # noqa: BLE001 — unsignable expression
+            log.debug("fragment unsignable for cache: %s", e)
+            return "unsignable"
+        fleet_ts = self._resolve_version()
+        if fleet_ts is None:
+            return "no-fleet-version"
+        if fleet_ts == 0 and self._bulk_tag is None:
+            # a never-SQL-written table has no version to stamp; only a
+            # bulk install with a DECLARED content identity (the tag is
+            # folded into the key) may cache at "version 0" — the first
+            # committed write gives it a real version fleet-wide
+            return "no-fleet-version"
+        # coherence: this replica must have applied exactly through the
+        # fleet version (behind -> one forced tail catch-up; still
+        # behind -> ineligible, a page would mismatch what we'd compute)
+        local_ts = mvcc.table_version_info(tid)[1]
+        if local_ts != fleet_ts:
+            with contextlib.suppress(Exception):
+                mvcc.catch_up()
+            local_ts = mvcc.table_version_info(tid)[1]
+            fleet_ts = self._resolve_version() or fleet_ts
+            if local_ts > fleet_ts:
+                # our commit outran a coordinator down-window: repair
+                # the fleet cell (forward-only max, idempotent)
+                with contextlib.suppress(Exception):
+                    self._coord.table_version_advance([(tid, local_ts)])
+                    fleet_ts = self._resolve_version() or fleet_ts
+            if local_ts != fleet_ts:
+                return "replica-behind"
+        txn = ctx.txn_for_read()
+        if getattr(txn, "start_ts", 0) < fleet_ts:
+            return "snapshot-behind"
+        self._vv = {tid: int(fleet_ts)}
+        self._vv_hash = int.from_bytes(
+            hashlib.blake2b(repr(sorted(self._vv.items())).encode(),
+                            digest_size=8).digest(), "big")
+        self._key = hashlib.blake2b(
+            b"vcache|" + sig, digest_size=16).digest()
+        return None
+
+    def _resolve_version(self) -> "int | None":
+        """The table's current fleet version, seeding the cell from the
+        local applied version on first touch.  0 = never SQL-written
+        anywhere (cacheable only for tagged bulk installs); None =
+        unknown (coordinator down-window) — cache-ineligible, never
+        stale."""
+        tid = self._tid
+        try:
+            fleet = self._coord.table_versions([tid])
+            if tid not in fleet:
+                local_ts = self._mvcc.table_version_info(tid)[1]
+                if not local_ts:
+                    return 0
+                self._coord.table_version_advance([(tid, local_ts)])
+                fleet = self._coord.table_versions([tid])
+            return int(fleet.get(tid, 0))
+        except Exception as e:  # noqa: BLE001 — coordinator blip
+            log.debug("fleet version unavailable: %s", e)
+            return None
+
+    def _signature(self, eff_p, conds) -> bytes:
+        """Structural identity beyond _agg_struct_parts (which feeds the
+        admission batch key and deliberately under-signs): the versioned
+        key adds per-agg distinct flags + ALL args + output types, group
+        output types, the column set and the store identity — a cache
+        key must never collide across semantically different fragments
+        or across fleets sharing a pages dir."""
+        from .device_exec import _agg_struct_parts, _expr_sig
+        parts = _agg_struct_parts(eff_p, conds)
+        for d in eff_p.aggs:
+            parts.append("%s/%d/%s/%s.%s.%s" % (
+                d.name, 1 if d.distinct else 0,
+                ",".join(_expr_sig(a) for a in d.args),
+                d.ftype.tp, d.ftype.flen, d.ftype.scale))
+        for e in eff_p.group_exprs:
+            parts.append("%s.%s.%s" % (e.ftype.tp, e.ftype.flen,
+                                       e.ftype.scale))
+        sp = self._sp
+        cols = ",".join(str(c.id) for c in sp.col_infos)
+        store = getattr(getattr(self._mvcc, "wal", None), "dir", "")
+        parts.append(f"t{sp.table_info.id}|{cols}|{store}")
+        if self._bulk_tag is not None:
+            # bulk columns are process-local: the installed content's
+            # declared identity is part of the fragment's result
+            # identity (see ColumnarCache.install_bulk)
+            parts.append(f"bulk:{self._bulk_tag}")
+        return ";".join(parts).encode()
+
+    # -- probe / publish -----------------------------------------------------
+
+    def probe(self):
+        """A served chunk (hit or delta-fold), or None — compute, then
+        publish()/release()."""
+        if not self.eligible:
+            return None
+        res = self._ded.claim_versioned(self._ctx, self._key,
+                                        self._vv_hash, self._vv)
+        kind = res[0]
+        if kind == "hit":
+            chunk = res[1].get("chunk") if isinstance(res[1], dict) else None
+            if chunk is None:
+                return None
+            self.outcome = "hit"
+            return chunk
+        if kind == "lead":
+            self._idx = res[1]
+            return None
+        if kind == "lead_delta":
+            self._idx = res[1]
+            self._old = res[2]
+            folded = None
+            try:
+                folded = self._try_fold(res[2])
+            except Exception as e:  # noqa: BLE001 — a fold bug must
+                #   degrade to a recompute, never fail the statement
+                log.warning("delta fold failed (recomputing): %s", e)
+                self.why = "fold-error"
+            if folded is not None:
+                self.outcome = "delta-fold"
+                return folded
+            self.outcome = "invalidated"
+            return None
+        return None
+
+    def publish(self, out, cap):
+        """Stamp + publish a computed chunk under the held claim."""
+        idx, self._idx = self._idx, None
+        if idx is None:
+            return
+        from ..utils.chunk import Chunk
+        if not isinstance(out, Chunk):
+            self._ded.fail(idx, self._key)
+            return
+        # a commit may have raced the compute: the vector must still
+        # hold at publish time, else the page would serve rows the
+        # version says it can't have.  A missing cell IS version 0
+        # (the never-written state); a coordinator error means the
+        # vector can't be verified, so nothing is cached.
+        try:
+            cur = self._coord.table_versions([self._tid])
+        except Exception:  # noqa: BLE001 — can't verify -> don't cache
+            cur = None
+        if cur is None or cur.get(self._tid, 0) != self._vv[self._tid]:
+            self._ded.fail(idx, self._key)
+            self.why = "raced-commit"
+            return
+        payload = {"chunk": out, "vv": dict(self._vv),
+                   "partial": self._partial_from_capture(out, cap)}
+        self._ded.publish_versioned(idx, self._key, payload,
+                                    self._vv_hash)
+
+    def release(self):
+        """Free a held claim (compute raised) so waiters fall back."""
+        idx, self._idx = self._idx, None
+        if idx is not None:
+            self._ded.fail(idx, self._key)
+
+    def annotate(self, agg_exec):
+        kv = {"cache": self.outcome}
+        if self._vv:
+            kv["cache_vv"] = ",".join(
+                f"{t}@{ts}" for t, ts in sorted(self._vv.items()))
+        if self.why:
+            kv["cache_why"] = self.why
+        agg_exec.annotate(**kv)
+
+    def _partial_from_capture(self, out, cap):
+        """Validated avg partials for the page, or None.  Exactly one
+        assembly pass must have produced exactly one (sum, count) pair
+        per foldable avg column, each aligned with the output rows."""
+        if not self._foldable():
+            return None
+        n_avg = sum(1 for d in self._plan.aggs if d.name == "avg")
+        if not n_avg:
+            return {"avg": []}
+        avgs = cap.get("avg", [])
+        if (cap.get("passes") != 1 or len(avgs) != n_avg
+                or any(len(s) != out.num_rows or len(c) != out.num_rows
+                       for s, c in avgs)):
+            return None
+        return {"avg": avgs}
+
+    # -- the delta fold ------------------------------------------------------
+
+    def _foldable(self) -> bool:
+        from ..expression import phys_kind, K_FLOAT, K_STR
+        for d in self._plan.aggs:
+            if d.distinct or d.name not in FOLD_FNS:
+                return False
+            if phys_kind(d.ftype) == K_FLOAT:
+                return False
+            for a in d.args:
+                if phys_kind(a.ftype) == K_FLOAT:
+                    return False
+            if d.name == "avg":
+                if not d.args or phys_kind(d.args[0].ftype) == K_STR:
+                    return False
+        return True
+
+    def _try_fold(self, old):
+        """Fold the committed delta (cached version, current version]
+        through the cached page.  None -> recompute from scratch (the
+        held claim still publishes the fresh page)."""
+        if not isinstance(old, dict):
+            self.why = "no-prior-page"
+            return None
+        old_vv = old.get("vv")
+        old_chunk = old.get("chunk")
+        old_ts = (old_vv or {}).get(self._tid)
+        if not old_ts or old_chunk is None:
+            self.why = "no-prior-page"
+            return None
+        if not self._foldable():
+            self.why = "agg-not-mergeable"
+            return None
+        if old_chunk.num_rows > FOLD_MAX_GROUPS:
+            self.why = "too-many-groups"
+            return None
+        n_avg = sum(1 for d in self._plan.aggs if d.name == "avg")
+        old_avg = []
+        if n_avg:
+            avgs = (old.get("partial") or {}).get("avg")
+            if (not avgs or len(avgs) != n_avg
+                    or any(len(s) != old_chunk.num_rows
+                           or len(c) != old_chunk.num_rows
+                           for s, c in avgs)):
+                self.why = "no-avg-partial"
+                return None
+            old_avg = [(np.asarray(s, dtype=object),
+                        np.asarray(c, dtype=np.int64)) for s, c in avgs]
+        new_ts = self._vv[self._tid]
+        keys = self._mvcc.delta_keys_since(self._tid, int(old_ts),
+                                           int(new_ts))
+        if keys is None:
+            self.why = "delta-unprovable"
+            return None
+        keys = sorted(set(keys))
+        if len(keys) > FOLD_MAX_DELTA_ROWS:
+            self.why = "delta-too-large"
+            return None
+        dchunk = self._delta_chunk(keys, int(old_ts), int(new_ts))
+        if dchunk is None:
+            return None  # why set by _delta_chunk
+        merged, partial = self._merge(old_chunk, old_avg, dchunk)
+        payload = {"chunk": merged, "vv": dict(self._vv),
+                   "partial": partial}
+        idx, self._idx = self._idx, None
+        if not self._ded.publish_versioned(idx, self._key, payload,
+                                           self._vv_hash):
+            # unpublishable (page too big): still serve the fold — the
+            # merge is already done and correct
+            log.debug("folded page not republished (size gate)")
+        from ..fabric import state
+        state.bump("cache_delta_folds")
+        with contextlib.suppress(Exception):
+            self._coord.bump("fabric_cache_delta_folds")
+        from ..session import tracing
+        tracing.event("fabric.cache", role="delta_fold",
+                      rows=dchunk.num_rows)
+        return merged
+
+    def _delta_chunk(self, keys, old_ts: int, new_ts: int):
+        """Materialize the delta rows as a scan-schema chunk, filtered
+        by the fragment's conds.  None (with why) when any delta key is
+        not a pure insert — a fold through partials can only ADD."""
+        from .. import tablecodec
+        from ..table import rows_to_chunk
+        mvcc = self._mvcc
+        handles, rowdicts = [], []
+        for k in keys:
+            before = mvcc.map.read(k, old_ts)
+            if before is not None and before[1] is not None:
+                # the row already existed at the cached version: an
+                # update/delete, not an insert — partials can't unfold
+                self.why = "non-insert-delta"
+                return None
+            cur = mvcc.map.read(k, new_ts)
+            if cur is None or cur[1] is None:
+                continue  # inserted then deleted inside the window
+            try:
+                _t, h = tablecodec.decode_record_key(k)
+                rowdicts.append(tablecodec.decode_row(cur[1]))
+                handles.append(h)
+            except Exception as e:  # noqa: BLE001 — undecodable row
+                log.debug("delta row undecodable (recomputing): %s", e)
+                self.why = "undecodable-delta"
+                return None
+        dchunk = rows_to_chunk(self._sp.table_info, self._sp.col_infos,
+                               handles, rowdicts)
+        if self._conds:
+            from .exec_select import eval_conds_mask
+            dchunk = dchunk.filter(eval_conds_mask(self._conds, dchunk))
+        return dchunk
+
+    def _merge(self, old_chunk, old_avg, dchunk):
+        """Aggregate the delta chunk and merge it into the cached page:
+        matched groups combine per aggregate semantics, new groups
+        append.  Returns (merged chunk, merged partials)."""
+        from ..ops import host
+        from ..utils.chunk import Chunk, Column
+        from ..utils.collate import key_for_compare
+        from .exec_select import _avg_exact
+        from ..expression import phys_kind, K_DEC
+        p = self._plan
+        ngk = len(p.group_exprs)
+        n = dchunk.num_rows
+        group_cols = [e.eval(dchunk) for e in p.group_exprs]
+        if ngk:
+            key_cols = [(key_for_compare(d, e.ftype), nl)
+                        for (d, nl), e in zip(group_cols, p.group_exprs)]
+            gids, n_groups, first_idx = host.group_ids(key_cols)
+        else:
+            key_cols = []
+            gids = np.zeros(n, dtype=np.int64)
+            n_groups = 1 if n > 0 else 0
+            first_idx = np.zeros(min(1, n), dtype=np.int64)
+        # group-key identity on BOTH sides through key_for_compare, so
+        # _ci case-variants land in the group the page already holds
+        pos = {}
+        old_keys = [(key_for_compare(old_chunk.columns[c].data,
+                                     p.group_exprs[c].ftype),
+                     old_chunk.columns[c].nulls) for c in range(ngk)]
+        for j in range(old_chunk.num_rows):
+            pos[tuple(_norm_key(old_keys[c][0][j], bool(old_keys[c][1][j]))
+                      for c in range(ngk))] = j
+        match, fresh = [], []
+        for g in range(n_groups):
+            i = int(first_idx[g])
+            k = tuple(_norm_key(key_cols[c][0][i],
+                                bool(key_cols[c][1][i]))
+                      for c in range(ngk))
+            j = pos.get(k)
+            (match.append((g, j)) if j is not None
+             else fresh.append(g))
+        fr = np.asarray(fresh, dtype=np.int64)
+        # delta-side aggregate finals (and avg partials) per delta group
+        delta_cols, delta_avg, avg_meta = [], [], []
+        for d in p.aggs:
+            if d.name == "avg":
+                arg = d.args[0]
+                data, nulls = arg.eval(dchunk)
+                nonnull = host.seg_count(gids, n_groups, nulls)
+                s = host.seg_sum_int(gids, n_groups, data,
+                                     nulls).astype(object)
+                delta_avg.append((s, np.asarray(nonnull,
+                                                dtype=np.int64)))
+                s_arg = (arg.ftype.scale
+                         if phys_kind(arg.ftype) == K_DEC else 0)
+                avg_meta.append((d.ftype, s_arg))
+                delta_cols.append(_avg_exact(s, nonnull, d.ftype, s_arg))
+            else:
+                delta_cols.append(
+                    self._agg._eval_agg(d, dchunk, gids, n_groups))
+        # merged group-key columns: page rows keep their representatives
+        out_cols = []
+        for c in range(ngk):
+            oc = old_chunk.columns[c]
+            data, nulls = group_cols[c]
+            out_cols.append(Column(
+                oc.ftype,
+                _concat(oc.data, data[first_idx[fr]] if len(fr)
+                        else np.asarray(data)[:0]),
+                np.concatenate([np.asarray(oc.nulls),
+                                np.asarray(nulls)[first_idx[fr]]
+                                if len(fr) else np.zeros(0, dtype=bool)])))
+        # merged aggregates
+        avg_i = 0
+        merged_avg = []
+        for ai, d in enumerate(p.aggs):
+            oc = old_chunk.columns[ngk + ai]
+            dc = delta_cols[ai]
+            base_d = _concat(oc.data, np.asarray(dc.data)[fr])
+            base_n = np.concatenate([np.asarray(oc.nulls),
+                                     np.asarray(dc.nulls)[fr]])
+            if d.name == "avg":
+                s_o, c_o = old_avg[avg_i]
+                s_d, c_d = delta_avg[avg_i]
+                ms = _concat(s_o, s_d[fr])
+                mc = np.concatenate([c_o, c_d[fr]])
+                for g, j in match:
+                    ms[j] = ms[j] + s_d[g]
+                    mc[j] = mc[j] + c_d[g]
+                ft, s_arg = avg_meta[avg_i]
+                col = _avg_exact(ms, mc, ft, s_arg)
+                merged_avg.append((ms, mc))
+                out_cols.append(col)
+                avg_i += 1
+                continue
+            for g, j in match:
+                dn = bool(dc.nulls[g])
+                on = bool(base_n[j])
+                if d.name == "count":
+                    base_d[j] = base_d[j] + dc.data[g]
+                elif dn:
+                    pass  # all-null delta group: page value stands
+                elif on:
+                    base_d[j] = dc.data[g]
+                    base_n[j] = False
+                elif d.name == "sum":
+                    base_d[j] = base_d[j] + dc.data[g]
+                elif d.name == "min":
+                    base_d[j] = min(base_d[j], dc.data[g])
+                else:  # max
+                    base_d[j] = max(base_d[j], dc.data[g])
+            out_cols.append(Column(oc.ftype, base_d, base_n))
+        return Chunk(out_cols), {"avg": merged_avg}

@@ -847,6 +847,8 @@ def _plan_agg(plan, dcols):
 
 def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
     """Device agg outputs (already copied to host) → result Chunk."""
+    from .agg_cache import note_agg_pass
+    note_agg_pass()
     key_out, key_null_out, results, result_nulls = out_host
     out_cols = []
     for (e, dictionary), kd, kn in zip(key_meta, key_out, key_null_out):
@@ -872,6 +874,8 @@ def _assemble_agg(plan, key_meta, slots, dcols, out_host, ng):
                 out_cols.append(Column(ft, vals, nulls))
             else:
                 arg = desc.args[0]
+                from .agg_cache import note_avg_partial
+                note_avg_partial(s.astype(object), c)
                 s_arg = arg.ftype.scale if phys_kind(arg.ftype) == K_DEC else 0
                 shift = POW10[ft.scale - s_arg]
                 num = s.astype(object) * shift

@@ -348,6 +348,18 @@ def _admit_impl(ctx, shape, batch_key, _tsp):
     if _refresh_cfg(ctx) <= 0:
         return None
     group = resource_group(ctx)
+    if _FLEET[0] is not None:
+        # fleet-wide admissions odometer: the result cache's
+        # admission-bypass proof (bench_serve --smoke pins this delta to
+        # ZERO across a pure repeated-fragment loop — a cache hit never
+        # reaches this line)
+        try:
+            from ..fabric import state as fabric_state
+            c = fabric_state.coordinator()
+            if c is not None:
+                c.bump("fabric_admissions")
+        except Exception:  # noqa: BLE001 — odometer only
+            pass
     t_fp0 = time.monotonic()
     try:
         # chaos hook: `admission-queue-full` models a saturated queue,

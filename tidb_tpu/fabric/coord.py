@@ -30,8 +30,13 @@ Layout (little-endian, fixed offsets — no allocation after create):
               running[slot] and hbm_bytes[slot] COLUMNS — per-slot
               attribution is what makes crash reclaim exact: zeroing a
               dead slot's column cannot touch a survivor's counts
-    DEDUP     fragment-dedup slots: key hash, state, owner slot,
-              timestamp, result page id
+    DEDUP     fragment result-cache slots: key hash, state, owner slot,
+              timestamp, result page id and the VERSION-VECTOR hash the
+              page was computed under (0 = unversioned in-flight dedup).
+              A versioned hit requires the claimant's current version-
+              vector hash to match; a mismatch invalidates the entry and
+              hands the claimant the OLD page id so it can fold only the
+              delta since the cached version (dedup_claim "lead_delta")
     LOCKS     the shared 2PC lock/primary table (kv/shared_store.py):
               key-HASH entries stamped (start_ts, owner slot) make
               cross-worker write-write conflict detection synchronous —
@@ -47,6 +52,14 @@ Layout (little-endian, fixed offsets — no allocation after create):
               write carries it, and a stale epoch's write is rejected —
               a zombie host's appender can never land bytes in a region
               that failed over behind its back
+    TABLEVERS per-table fleet version cells: (table id, version ts) —
+              the CURRENT fleet version of each table, advanced forward-
+              only (max) on every committed write by the committing
+              worker and re-published by every tailer as it applies the
+              log (a coordinator down-window on the writer is repaired
+              by the first survivor to tail the record).  The result
+              cache stamps pages with these and a hit requires every
+              referenced table's cell to still match
 
 Every mutation happens under the sidecar lock file (``<path>.lock``,
 ``fcntl.flock``) plus an in-process mutex (flock is per open file
@@ -79,7 +92,7 @@ from multiprocessing import shared_memory
 
 log = logging.getLogger("tidb_tpu.fabric.coord")
 
-MAGIC = b"TPUFAB2\0"
+MAGIC = b"TPUFAB3\0"
 
 #: segment geometry defaults (fixed at create; attach reads them from the
 #: coordinator file)
@@ -90,6 +103,10 @@ NLOCKS_DEFAULT = 256
 #: regions default to 0: a single-host fleet pays nothing for the
 #: section, and a region-sharded one sizes it explicitly at create
 NREGIONS_DEFAULT = 0
+#: per-table version cells; a fleet serving more distinct tables than
+#: this simply stops version-stamping the overflow (cache-ineligible,
+#: never stale)
+NTABLEVERS_DEFAULT = 256
 
 #: fleet-global counter names, in segment order
 COUNTER_NAMES = (
@@ -99,6 +116,11 @@ COUNTER_NAMES = (
     "fabric_lease_reclaims",    # dead-slot reclaims (leases expired)
     "fabric_respawns",          # parent worker respawns
     "fabric_prewarm_dedup",     # prewarm submissions skipped fleet-wide
+    "fabric_cache_hits",        # version-stamped result-cache hits
+    "fabric_cache_invalidations",  # cached pages dropped on version advance
+    "fabric_cache_delta_folds",    # hits served by folding the WAL delta
+    "fabric_cache_stale_reads",    # version-stale pages caught at serve
+    "fabric_admissions",        # device admissions granted fleet-wide
     "_result_id_seq",           # monotonic dedup result-page id
     "_tso",                     # fleet TSO high-water (batched leases)
     "_schema_ver",              # published schema version (schema lease)
@@ -116,13 +138,15 @@ _HDR = struct.Struct("<8sIIIId")                         # + created f64
 _SLOT = struct.Struct("<QdQQQ")                          # pid, lease, gen,
 #                                                          min_read_ts,
 #                                                          wal_applied
-_DED = struct.Struct("<16sIIdQ")                         # hash,state,owner,ts,rid
+_DED = struct.Struct("<16sIIdQQ")                        # hash,state,owner,ts,
+#                                                          rid, vv_hash
 _TEN_FIXED = struct.Struct("<40sdII")                    # name,vtime,peak,pad
 _LCK = struct.Struct("<16sQId")                          # hash,start_ts,slot,ts
 _REG = struct.Struct("<QQdQQ")                           # epoch, owner+1,
 #                                                          lease_ts,
 #                                                          committed_len,
 #                                                          applied_lsn
+_TVER = struct.Struct("<QQ")                             # table_id, version_ts
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
@@ -141,6 +165,7 @@ class Coordinator:
         self.ndedup = meta["ndedup"]
         self.nlocks = meta.get("nlocks", NLOCKS_DEFAULT)
         self.nregions = meta.get("nregions", NREGIONS_DEFAULT)
+        self.ntablevers = meta.get("ntablevers", NTABLEVERS_DEFAULT)
         self.pages_dir = meta["pages_dir"]
         self._created = created
         self._tlock = threading.Lock()
@@ -154,7 +179,8 @@ class Coordinator:
         self._o_dedup = self._o_tenants + self.ntenants * self._ten_sz
         self._o_locks = self._o_dedup + self.ndedup * _DED.size
         self._o_regions = self._o_locks + self.nlocks * _LCK.size
-        self.size = self._o_regions + self.nregions * _REG.size
+        self._o_tvers = self._o_regions + self.nregions * _REG.size
+        self.size = self._o_tvers + self.ntablevers * _TVER.size
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -164,6 +190,7 @@ class Coordinator:
                ndedup: int = NDEDUP_DEFAULT,
                nlocks: int = NLOCKS_DEFAULT,
                nregions: int = NREGIONS_DEFAULT,
+               ntablevers: int = NTABLEVERS_DEFAULT,
                pages_dir: "str | None" = None) -> "Coordinator":
         """Create the segment + coordinator file (the fleet parent)."""
         if pages_dir is None:
@@ -172,11 +199,12 @@ class Coordinator:
         name = f"tpufab-{os.getpid()}-{secrets.token_hex(4)}"
         meta = {"segment": name, "nslots": nslots, "ntenants": ntenants,
                 "ndedup": ndedup, "nlocks": nlocks, "nregions": nregions,
+                "ntablevers": ntablevers,
                 "pages_dir": pages_dir, "created": time.time()}
         size = (_HDR.size + 8 * len(COUNTER_NAMES) + nslots * _SLOT.size
                 + ntenants * (_TEN_FIXED.size + 12 * nslots)
                 + ndedup * _DED.size + nlocks * _LCK.size
-                + nregions * _REG.size)
+                + nregions * _REG.size + ntablevers * _TVER.size)
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         _untrack(shm)
         shm.buf[:size] = b"\0" * size
@@ -332,9 +360,10 @@ class Coordinator:
                            + 4 * self.nslots + 8 * slot, 0)
         for d in range(self.ndedup):
             off = self._o_dedup + d * _DED.size
-            h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
+            h, state, owner, ts, rid, vv = _DED.unpack_from(self._buf, off)
             if state == DBUILDING and owner == slot:
-                _DED.pack_into(self._buf, off, h, DFAILED, owner, ts, rid)
+                _DED.pack_into(self._buf, off, h, DFAILED, owner, ts,
+                               rid, vv)
         # free the dead slot's shared 2PC lock claims: the DATA locks
         # (the replicas' prewrite locks) are resolved by WAL recovery via
         # their primary; the claim entries only serialize live prewrites
@@ -749,59 +778,142 @@ class Coordinator:
                     out[rid] = owner_p1 - 1
             return out
 
+    # -- per-table fleet versions (the result cache's invalidation feed) -----
+
+    def _tver_off(self, i: int) -> int:
+        return self._o_tvers + i * _TVER.size
+
+    def table_version_advance(self, pairs) -> None:
+        """Advance table-version cells forward-only: for each
+        ``(table_id, version_ts)`` the cell becomes ``max(cell, ts)``.
+        Idempotent — the committing worker publishes at commit and every
+        tailer re-publishes as it applies the log, so a down-window on
+        any single worker is repaired by the next.  A full section drops
+        the advance: the table simply has no fleet version (callers see
+        it as cache-ineligible, never as stale)."""
+        if not self.ntablevers:
+            return
+        with self._locked():
+            for tid, ts in pairs:
+                tid, ts = int(tid), int(ts)
+                if tid <= 0 or ts <= 0:
+                    continue
+                free = -1
+                for i in range(self.ntablevers):
+                    off = self._tver_off(i)
+                    cell_tid, cell_ts = _TVER.unpack_from(self._buf, off)
+                    if cell_tid == tid:
+                        if ts > cell_ts:
+                            _TVER.pack_into(self._buf, off, tid, ts)
+                        break
+                    if not cell_tid and free < 0:
+                        free = i
+                else:
+                    if free >= 0:
+                        _TVER.pack_into(self._buf, self._tver_off(free),
+                                        tid, ts)
+
+    def table_versions(self, tids) -> dict:
+        """{table_id: version_ts} for every requested table that has a
+        cell (missing tables are absent — cache-ineligible)."""
+        if not self.ntablevers:
+            return {}
+        want = {int(t) for t in tids}
+        out = {}
+        with self._locked():
+            for i in range(self.ntablevers):
+                cell_tid, cell_ts = _TVER.unpack_from(
+                    self._buf, self._tver_off(i))
+                if cell_tid in want:
+                    out[cell_tid] = cell_ts
+                    if len(out) == len(want):
+                        break
+        return out
+
     # -- fragment dedup -------------------------------------------------------
 
     def _ded_off(self, i: int) -> int:
         return self._o_dedup + i * _DED.size
 
-    def dedup_claim(self, key_hash: bytes, ttl_s: float) -> tuple:
-        """Claim or join the dedup slot for `key_hash` (16 bytes).
+    #: a versioned (vv_hash != 0) DDONE entry is evictable for slot reuse
+    #: only after this long — a plain in-flight claimant's short ttl must
+    #: not evict a live cache page (invalidation, not time, retires it)
+    VERSIONED_EVICT_S = 120.0
 
-        Returns one of::
+    def dedup_claim(self, key_hash: bytes, ttl_s: float,
+                    vv_hash: int = 0, check_vv: bool = True) -> tuple:
+        """Claim or join the result-cache slot for `key_hash` (16 bytes).
 
-            ("lead", idx, result_id)   # this process dispatches + publishes
-            ("hit",  idx, result_id)   # a fresh result page already exists
-            ("wait", idx, 0)           # another process is building: poll
-            ("miss", -1, 0)            # table full — just dispatch locally
+        ``vv_hash`` is the claimant's version-vector hash (0 = plain
+        in-flight dedup, no version stamping).  Returns one of::
+
+            ("lead", idx, 0)            # this process computes + publishes
+            ("lead_delta", idx, rid)    # version advanced: old page `rid`
+                                        # is kept for a delta fold
+            ("hit",  idx, result_id)    # a matching result page exists
+            ("wait", idx, 0)            # another process is building: poll
+            ("miss", -1, 0)             # table full — just compute locally
+
+        A versioned entry hits only when its stored vv_hash equals the
+        claimant's (``check_vv=False`` — the cache-stale-read failpoint —
+        skips that check; the page-level verify downstream must catch it).
         """
         now = time.time()
         with self._locked():
             free = -1
             for i in range(self.ndedup):
                 off = self._ded_off(i)
-                h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
+                h, state, owner, ts, rid, vv = _DED.unpack_from(
+                    self._buf, off)
                 if h == key_hash and state != DFREE:
                     if state == DBUILDING:
                         if now - ts <= BUILD_LEASE_S:
                             return ("wait", i, 0)
-                        # leader died mid-build: take the slot over
+                        # leader died mid-build: take the slot over (a
+                        # kept old page rides along for the delta fold)
                         _DED.pack_into(self._buf, off, key_hash, DBUILDING,
-                                       self._claim_owner, now, 0)
+                                       self._claim_owner, now, rid, vv)
                         self._bump_locked("fabric_dedup_leads")
+                        if rid and vv and vv_hash:
+                            return ("lead_delta", i, rid)
                         return ("lead", i, 0)
-                    if state == DDONE and now - ts <= ttl_s:
+                    eff_ttl = self.VERSIONED_EVICT_S if vv else ttl_s
+                    if state == DDONE and now - ts <= eff_ttl:
+                        if vv and check_vv and vv != vv_hash:
+                            # version advanced under the page: invalidate,
+                            # but KEEP the page — the new leader folds the
+                            # delta since the cached version through it
+                            self._bump_locked("fabric_cache_invalidations")
+                            self._bump_locked("fabric_dedup_leads")
+                            _DED.pack_into(self._buf, off, key_hash,
+                                           DBUILDING, self._claim_owner,
+                                           now, rid, vv)
+                            return ("lead_delta", i, rid)
                         self._bump_locked("fabric_dedup_hits")
+                        if vv:
+                            self._bump_locked("fabric_cache_hits")
                         return ("hit", i, rid)
                     # stale done / failed: re-lead (and GC the expired
                     # page — nothing can serve it again, and pages left
                     # behind are unbounded disk growth)
                     self._unlink_page(rid)
                     _DED.pack_into(self._buf, off, key_hash, DBUILDING,
-                                   self._claim_owner, now, 0)
+                                   self._claim_owner, now, 0, 0)
                     self._bump_locked("fabric_dedup_leads")
                     return ("lead", i, 0)
                 if free < 0 and (state == DFREE
-                                 or (state == DDONE and now - ts > ttl_s)
+                                 or (state == DDONE and now - ts
+                                     > (self.VERSIONED_EVICT_S if vv
+                                        else ttl_s))
                                  or state == DFAILED):
                     free = i
             if free < 0:
                 return ("miss", -1, 0)
             off = self._ded_off(free)
-            _h, _state, _owner, _ts, old_rid = _DED.unpack_from(
-                self._buf, off)
+            old_rid = _DED.unpack_from(self._buf, off)[4]
             self._unlink_page(old_rid)  # the reused slot's expired page
             _DED.pack_into(self._buf, off, key_hash,
-                           DBUILDING, self._claim_owner, now, 0)
+                           DBUILDING, self._claim_owner, now, 0, 0)
             self._bump_locked("fabric_dedup_leads")
             return ("lead", free, 0)
 
@@ -819,25 +931,30 @@ class Coordinator:
         self._claim_owner = int(slot)
 
     def dedup_publish(self, idx: int, key_hash: bytes,
-                      result_id: int) -> None:
+                      result_id: int, vv_hash: int = 0) -> None:
         with self._locked():
             off = self._ded_off(idx)
-            h, state, owner, _ts, _rid = _DED.unpack_from(self._buf, off)
+            h, state, owner, _ts, old_rid, _vv = _DED.unpack_from(
+                self._buf, off)
             if h == key_hash and state == DBUILDING:
+                if old_rid and old_rid != result_id:
+                    # the delta fold's source page: superseded now
+                    self._unlink_page(old_rid)
                 _DED.pack_into(self._buf, off, h, DDONE, owner,
-                               time.time(), result_id)
+                               time.time(), result_id, vv_hash)
 
     def dedup_fail(self, idx: int, key_hash: bytes) -> None:
         with self._locked():
             off = self._ded_off(idx)
-            h, state, owner, ts, rid = _DED.unpack_from(self._buf, off)
+            h, state, owner, ts, rid, vv = _DED.unpack_from(self._buf, off)
             if h == key_hash and state == DBUILDING:
-                _DED.pack_into(self._buf, off, h, DFAILED, owner, ts, rid)
+                _DED.pack_into(self._buf, off, h, DFAILED, owner, ts,
+                               rid, vv)
 
     def dedup_poll(self, idx: int, key_hash: bytes) -> tuple:
         """-> ("building"|"done"|"gone", result_id)."""
         with self._locked():
-            h, state, owner, ts, rid = _DED.unpack_from(
+            h, state, owner, ts, rid, _vv = _DED.unpack_from(
                 self._buf, self._ded_off(idx))
             if h != key_hash or state in (DFREE, DFAILED):
                 return ("gone", 0)

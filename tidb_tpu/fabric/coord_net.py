@@ -64,6 +64,7 @@ OPS = frozenset({
     "regions_expired", "region_owners",
     "dedup_claim", "dedup_publish", "dedup_fail", "dedup_poll",
     "next_result_id", "prewarm_claim",
+    "table_version_advance", "table_versions",
     "snapshot", "verify_drained",
 })
 
@@ -86,6 +87,13 @@ _DEGRADE = {
     "fleet_min_read_ts": lambda args, kwargs: 0,
     "bump": lambda args, kwargs: 0,
     "counters": lambda args, kwargs: {},
+    # result cache during a down-window: version advances are dropped
+    # (the committing worker's tailer peers re-publish on apply, and the
+    # cache TTL backstops the remainder) and version READS answer empty —
+    # "no fleet version known" makes every fragment cache-ineligible,
+    # which degrades to plain in-flight dedup, never to a stale hit
+    "table_version_advance": lambda args, kwargs: None,
+    "table_versions": lambda args, kwargs: {},
 }
 
 

@@ -32,6 +32,22 @@ Results ship as pickled Chunks with process-local caches stripped
 (utils/chunk.py ``__getstate__`` drops the HBM ``_device`` slot and
 host-side index caches), so a page can never smuggle another process's
 device handles.
+
+On top of the in-flight coalescer sits the **version-stamped result
+cache** (``claim_versioned`` / ``publish_versioned``, driven by
+executor/agg_cache.py): pages whose claim carries a non-zero ``vv_hash``
+are stamped with the (table → fleet version) vector they were computed
+under and keep serving for as long as every referenced table's CURRENT
+fleet version still matches — across statements, sessions and workers,
+with the TTL demoted to a backstop (coord.VERSIONED_EVICT_S).  A version
+advance (any committed write to a referenced table, tailed fleet-wide by
+kv/shared_store) invalidates the entry on its next claim; the holder of
+the invalidated claim receives the SUPERSEDED page back and may fold
+just the WAL delta through the cached aggregate partials instead of
+recomputing.  Every versioned hit re-verifies the vector INSIDE the
+page against the one the claim matched — a stale page (hash collision,
+or the ``cache-stale-read`` failpoint) is a loud ``cache_stale_reads``
+error and a local recompute, never a wrong answer.
 """
 
 from __future__ import annotations
@@ -51,9 +67,11 @@ log = logging.getLogger("tidb_tpu.fabric.dedup")
 #: "concurrent identical fragments" window.  Content-hashed keys make a
 #: reuse inside the window SOUND for any length, but the window is kept
 #: short deliberately: this is in-flight coalescing (one device call for
-#: fragments racing each other), not a result cache — a long TTL would
-#: quietly become one and deserve its own invalidation story.  Override
-#: with TIDB_TPU_FABRIC_DEDUP_TTL (seconds).
+#: fragments racing each other).  The RESULT CACHE with a real
+#: invalidation story is the version-stamped tier (claim_versioned):
+#: its pages ignore this TTL and live on version-vector match, with
+#: coord.VERSIONED_EVICT_S as the backstop.  Override with
+#: TIDB_TPU_FABRIC_DEDUP_TTL (seconds).
 TTL_S = float(os.environ.get("TIDB_TPU_FABRIC_DEDUP_TTL", "0.2") or 0.2)
 #: bound on a follower's wait for a building leader
 WAIT_S = 5.0
@@ -148,6 +166,128 @@ class Dedup:
             raise
         self._publish(idx, key_hash, res)
         return res
+
+    # -- the version-stamped result cache ------------------------------------
+
+    def claim_versioned(self, ctx, key_hash: bytes, vv_hash: int,
+                        vv: dict):
+        """Probe/claim the versioned cache for a fragment computed under
+        version vector ``vv`` (whose 64-bit digest is ``vv_hash``).
+
+        Returns one of::
+
+            ("hit", payload)        page dict, vector verified in-page
+            ("lead", idx)           caller computes, then
+                                    publish_versioned(...) or fail(...)
+            ("lead_delta", idx, old_payload)
+                                    entry invalidated by a version
+                                    advance; the superseded page is
+                                    handed back for a delta fold (the
+                                    caller still publishes or fails)
+            ("none", None)          serve/claim nothing — run uncached
+
+        A ``cache-stale-read`` failpoint skips the claim-time vector
+        check; the in-page verify below then catches the mismatch
+        loudly (cache_stale_reads) and degrades to a local compute."""
+        from ..session import tracing
+        from ..utils import failpoint
+        from . import state
+        check_vv = not failpoint.inject("cache-stale-read")
+        try:
+            kind, idx, rid = self._c.dedup_claim(
+                key_hash, TTL_S, vv_hash=vv_hash, check_vv=check_vv)
+        except Exception as e:  # noqa: BLE001 — coordinator down/unlinked:
+            #   the cache degrades to "no cache", never to a failed query
+            log.debug("versioned claim unavailable: %s", e)
+            return ("none", None)
+        if kind == "hit":
+            payload = self._load(rid)
+            payload = self._verify_payload(payload, vv)
+            if payload is not None:
+                state.bump("fabric_dedup_hits")
+                state.bump("cache_hits")
+                tracing.event("fabric.cache", role="hit",
+                              slot=self._slot)
+                return ("hit", payload)
+            return ("none", None)
+        if kind == "wait":
+            state.bump("fabric_dedup_waits")
+            payload = self._verify_payload(
+                self._wait(ctx, idx, key_hash), vv)
+            if payload is not None:
+                state.bump("fabric_dedup_hits")
+                state.bump("cache_hits")
+                tracing.event("fabric.cache", role="wait_hit",
+                              slot=self._slot)
+                return ("hit", payload)
+            state.bump("fabric_dedup_timeouts")
+            return ("none", None)
+        if kind == "lead_delta":
+            state.bump("cache_invalidations")
+            tracing.event("fabric.cache", role="invalidated",
+                          slot=self._slot)
+            old = self._load(rid)
+            if not isinstance(old, dict):
+                old = None
+            return ("lead_delta", idx, old)
+        if kind == "lead":
+            state.bump("fabric_dedup_leads")
+            return ("lead", idx)
+        return ("none", None)
+
+    def _verify_payload(self, payload, vv: dict):
+        """The in-page vector must equal the one the claim matched —
+        the last line of defense against a stale serve (claim-level
+        hash collision, or the cache-stale-read failpoint)."""
+        from . import state
+        if not isinstance(payload, dict):
+            return None
+        if payload.get("vv") != vv:
+            log.error(
+                "STALE CACHE PAGE refused: page vector %s != current %s "
+                "(recomputing locally)", payload.get("vv"), vv)
+            state.bump("cache_stale_reads")
+            try:
+                self._c.bump("fabric_cache_stale_reads")
+            except Exception as e:  # noqa: BLE001 — counter only
+                log.debug("stale-read counter bump failed: %s", e)
+            return None
+        return payload
+
+    def publish_versioned(self, idx: int, key_hash: bytes,
+                          payload: dict, vv_hash: int) -> bool:
+        """Publish a version-stamped page ``{"chunk":, "vv":,
+        "partial":}`` under an owned claim.  False → the slot was freed
+        (waiters compute locally) and nothing was cached."""
+        try:
+            blob = pickle.dumps(payload, protocol=4)
+        except Exception as e:  # noqa: BLE001 — unshippable payload
+            log.warning("versioned page not serializable: %s", e)
+            self.fail(idx, key_hash)
+            return False
+        if len(blob) > MAX_PAGE_BYTES:
+            self.fail(idx, key_hash)
+            return False
+        rid = self._c.next_result_id()
+        path = self._c.result_page_path(rid)
+        try:
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            self.fail(idx, key_hash)
+            return False
+        self._c.dedup_publish(idx, key_hash, rid, vv_hash=vv_hash)
+        return True
+
+    def fail(self, idx: int, key_hash: bytes):
+        """Free an owned claim (compute failed / result not cacheable)
+        so waiters fall back to local dispatch."""
+        try:
+            self._c.dedup_fail(idx, key_hash)
+        except Exception as e:  # noqa: BLE001 — lease reclaim covers it
+            log.debug("dedup_fail failed (lease will reclaim): %s", e)
 
     # -- pages ----------------------------------------------------------------
 

@@ -53,6 +53,10 @@ STATS = {
     "fabric_artifact_hits": 0,     # pipelines deserialized from artifacts
     "fabric_remote_errors": 0,     # compile-server transport failures
     "fabric_compile_rtt_ms": 0.0,  # last compile-server round trip
+    "cache_hits": 0,               # versioned result-cache serves
+    "cache_invalidations": 0,      # hits refused on a version advance
+    "cache_delta_folds": 0,        # hits served by folding the WAL delta
+    "cache_stale_reads": 0,        # page-level vv verify caught staleness
 }
 
 
@@ -203,6 +207,13 @@ def snapshot() -> dict:
             out["fleet_dedup_hits"] = fleet["fabric_dedup_hits"]
             out["fabric_lease_reclaims"] = fleet["fabric_lease_reclaims"]
             out["fabric_prewarm_dedup"] = fleet["fabric_prewarm_dedup"]
+            out["fleet_cache_hits"] = fleet.get("fabric_cache_hits", 0)
+            out["fleet_cache_invalidations"] = fleet.get(
+                "fabric_cache_invalidations", 0)
+            out["fleet_cache_delta_folds"] = fleet.get(
+                "fabric_cache_delta_folds", 0)
+            out["fleet_cache_stale_reads"] = fleet.get(
+                "fabric_cache_stale_reads", 0)
         except Exception as e:  # noqa: BLE001 — segment may be unlinked
             log.debug("fleet counters unreadable: %s", e)
             out["fabric_workers"] = 0
@@ -219,7 +230,9 @@ def report_gauges() -> dict:
     out = {"fabric_workers": s.get("fabric_workers", 0)}
     for k in ("fabric_dedup_hits", "fabric_dedup_waits",
               "fabric_artifact_hits", "fabric_remote_compiles",
-              "fabric_remote_errors", "fabric_respawns"):
+              "fabric_remote_errors", "fabric_respawns",
+              "cache_hits", "cache_invalidations",
+              "cache_delta_folds", "cache_stale_reads"):
         if s.get(k):
             out[k] = s[k]
     if s.get("fabric_compile_rtt_ms"):

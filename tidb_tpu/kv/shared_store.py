@@ -57,8 +57,10 @@ import signal
 import threading
 import time
 
+from collections import deque
+
 from ..utils import failpoint
-from .mvcc import Lock, MVCCStore, TSOracle
+from .mvcc import Lock, MVCCStore, OP_ROLLBACK, TSOracle
 from . import wal as wal_mod
 
 log = logging.getLogger("tidb_tpu.kv.shared_store")
@@ -71,6 +73,12 @@ TAIL_INTERVAL_S = 0.01
 
 #: meta key whose commit publishes the fleet schema-version cell
 SCHEMA_VERSION_KEY = b"m:schema_version"
+
+#: committed-delta ring entries kept per table — the fold source for
+#: the versioned result cache (executor/agg_cache.py).  Evicting past a
+#: cached page's version only downgrades its next hit to a full
+#: recompute, never to a wrong answer
+DELTA_RING_CAP = 512
 
 
 def key_hash(key: bytes) -> bytes:
@@ -174,6 +182,16 @@ class DurableMVCCStore(MVCCStore):
         self._claimed: set[int] = set()
         self._claim_mu = threading.Lock()
         self._lock_degrades = 0  # lock-table-full local-only fallbacks
+        # per-table committed-delta ring: tid -> deque[(commit_ts,
+        # row keys)], the versioned result cache's fold source.  The
+        # floor is the ts BELOW which completeness is unproven; noted
+        # holds commit ts the matching bump_table_version consumes (a
+        # bump the ring never saw — DDL, BR, unwind — poisons folds)
+        self._delta_ring: "dict[int, deque]" = {}
+        self._delta_floor: "dict[int, int]" = {}
+        self._delta_noted: "dict[int, set]" = {}
+        self._delta_min_after = 0  # boot-time poison: checkpoint rows
+        self._delta_mu = threading.Lock()
         self._tail_stop = threading.Event()
         self._tail_thread = None
         self._recovered = False
@@ -225,6 +243,10 @@ class DurableMVCCStore(MVCCStore):
                 # a same-millisecond restart could otherwise mint
                 # timestamps below them (invisible to new snapshots)
                 self.tso.advance_to(max_ts)
+            # fold completeness cannot extend below this boot: rows
+            # restored from the checkpoint never passed through the
+            # delta ring
+            self._delta_min_after = max(self._delta_min_after, max_ts)
             self._recover_lock_owner = lock_owner
             self._recover_disposition = disposition
             resolved = 0
@@ -413,6 +435,7 @@ class DurableMVCCStore(MVCCStore):
                 #   divergence is logged, not swallowed
                 log.warning("tailed commit apply failed for ts %d: %s",
                             start_ts, e)
+            self._note_delta(commit_ts, keys)
             for tid in tids:
                 self.bump_table_version(tid, commit_ts)
             if not replay:
@@ -426,8 +449,22 @@ class DurableMVCCStore(MVCCStore):
             # last disposition wins: a commit record followed by a
             # rollback record for the same start_ts (its fsync failed
             # and the owner rolled back) must UNWIND, not coexist
+            regressed = self._unwindable(keys, start_ts)
             self.unwind_commit(keys, start_ts)
             MVCCStore.rollback(self, keys, start_ts)
+            if regressed:
+                # visible rows just regressed WITHOUT a commit record:
+                # advance the touched tables' versions under a fresh ts
+                # (never noted, so the fold ring poisons itself) so
+                # every stamped cache page over them invalidates rather
+                # than serving the resurrected state
+                ts = 0
+                with contextlib.suppress(Exception):
+                    ts = self.tso.next_ts()
+                for tid in sorted({t for t in (_table_id_of(k)
+                                               for k in regressed)
+                                   if t is not None}):
+                    self.bump_table_version(tid, ts)
             if not replay:
                 wal_mod._bump("wal_tail_records")
         elif kind == "raw":
@@ -436,6 +473,7 @@ class DurableMVCCStore(MVCCStore):
                 return
             self.tso.advance_to(commit_ts)
             MVCCStore.raw_batch_put(self, pairs, commit_ts)
+            self._note_delta(commit_ts, [k for k, _v in pairs])
             for tid in tids:
                 self.bump_table_version(tid, commit_ts)
         elif kind == "rawdel":
@@ -443,6 +481,7 @@ class DurableMVCCStore(MVCCStore):
             if own:
                 return
             MVCCStore.raw_delete_range(self, start, end)
+            self._poison_range(start, end)
         else:
             log.warning("unknown wal record kind %r skipped", kind)
 
@@ -521,6 +560,10 @@ class DurableMVCCStore(MVCCStore):
             super().commit(keys, start_ts, commit_ts)
         finally:
             self._release_shared(start_ts)
+        # the Transaction layer bumps table versions right after this
+        # returns (kv/store.py); noting first lets those bumps consume
+        # the ts instead of poisoning the fold ring
+        self._note_delta(commit_ts, keys)
         if schema_ver and self._coord is not None:
             with contextlib.suppress(Exception):
                 self._coord.publish_schema_version(schema_ver)
@@ -582,6 +625,7 @@ class DurableMVCCStore(MVCCStore):
     def raw_put(self, key: bytes, value: bytes, commit_ts: int | None = None):
         ts = commit_ts if commit_ts is not None else self.tso.next_ts()
         super().raw_put(key, value, commit_ts=ts)
+        self._note_delta(ts, [key])
         tid = _table_id_of(key)
         self.wal.append(("raw", self._slot, ts, [(key, value)],
                          [tid] if tid is not None else []))
@@ -592,17 +636,149 @@ class DurableMVCCStore(MVCCStore):
             return
         ts = commit_ts if commit_ts is not None else self.tso.next_ts()
         super().raw_batch_put(pairs, commit_ts=ts)
+        self._note_delta(ts, [k for k, _v in pairs])
         tids = sorted({t for t in (_table_id_of(k) for k, _v in pairs)
                        if t is not None})
         self.wal.append(("raw", self._slot, ts, pairs, tids))
 
     def raw_delete_range(self, start: bytes, end: bytes):
         super().raw_delete_range(start, end)
+        self._poison_range(start, end)
         # ts-stamped so BR's backup-ts tail filter excludes a delete
         # that raced PAST the backup snapshot (its rows are in the
         # backup; replaying the delete would erase backed-up data)
         self.wal.append(("rawdel", self._slot, self.tso.next_ts(),
                          start, end))
+
+    # -- committed-delta ring (versioned result cache fold source) ------------
+
+    def _note_delta(self, commit_ts: int, keys):
+        """Record which row keys a committed mutation touched, per
+        table.  Only record keys are kept (index keys re-derive from
+        the row); every tid seen in ``keys`` marks ``commit_ts`` noted
+        so the matching :meth:`bump_table_version` knows the ring
+        covers that advance."""
+        if not commit_ts:
+            return
+        from .. import tablecodec
+        by_tid: "dict[int, list]" = {}
+        for k in keys:
+            tid = _table_id_of(k)
+            if tid is None:
+                continue
+            lst = by_tid.setdefault(tid, [])
+            if len(k) >= 19 and k[9:11] == tablecodec.RECORD_SEP:
+                lst.append(k)
+        if not by_tid:
+            return
+        with self._delta_mu:
+            for tid, ks in by_tid.items():
+                ring = self._delta_ring.get(tid)
+                if ring is None:
+                    ring = self._delta_ring[tid] = deque()
+                    # completeness starts here: commit timestamps are
+                    # unique, so (commit_ts - 1, commit_ts] holds only
+                    # this commit — but never lift an earlier poison
+                    self._delta_floor[tid] = max(
+                        commit_ts - 1, self._delta_floor.get(tid, 0))
+                ring.append((commit_ts, tuple(ks)))
+                while len(ring) > DELTA_RING_CAP:
+                    old_ts, _old = ring.popleft()
+                    self._delta_floor[tid] = max(
+                        self._delta_floor[tid], old_ts)
+                noted = self._delta_noted.setdefault(tid, set())
+                noted.add(commit_ts)
+                if len(noted) > 1024:
+                    # unconsumed ts leak only from bump-less raw writes;
+                    # clearing risks one spurious poison, which merely
+                    # costs a full recompute
+                    noted.clear()
+
+    def bump_table_version(self, table_id: int, commit_ts: int = 0) -> int:
+        """Local watermark bump + fleet publication: every advance lands
+        in the segment's table-version vector so stamped cache pages on
+        EVERY worker invalidate.  An advance the delta ring never noted
+        (DDL reorg, BR restore, a rollback unwind) poisons folds across
+        it — the data changed through a path the ring cannot replay."""
+        v = super().bump_table_version(table_id, commit_ts)
+        if table_id is None or table_id <= 0:
+            return v
+        noted = False
+        with self._delta_mu:
+            s = self._delta_noted.get(table_id)
+            if s is not None and commit_ts in s:
+                s.discard(commit_ts)
+                noted = True
+        ts = commit_ts
+        if not ts:
+            with contextlib.suppress(Exception):
+                ts = self.tso.next_ts()
+        if not noted and ts:
+            with self._delta_mu:
+                self._delta_floor[table_id] = max(
+                    self._delta_floor.get(table_id, 0), ts)
+        if ts and self._coord is not None:
+            with contextlib.suppress(Exception):
+                self._coord.table_version_advance([(table_id, ts)])
+        return v
+
+    def _poison_range(self, start: bytes, end: bytes):
+        """A range delete cannot say which committed rows it removed:
+        kill fold eligibility for whatever it may cover (one ring for a
+        same-table range, everything for a cross-table one)."""
+        tid_a, tid_b = _table_id_of(start), _table_id_of(end)
+        ts = 0
+        with contextlib.suppress(Exception):
+            ts = self.tso.next_ts()
+        if not ts:
+            return
+        with self._delta_mu:
+            if tid_a is not None and tid_a == tid_b:
+                self._delta_floor[tid_a] = max(
+                    self._delta_floor.get(tid_a, 0), ts)
+            else:
+                self._delta_min_after = max(self._delta_min_after, ts)
+
+    def _unwindable(self, keys, start_ts: int) -> "list[bytes]":
+        """Keys holding a COMMITTED version stamped ``start_ts`` — the
+        set a commit-then-rollback unwind will actually regress."""
+        out = []
+        with self._lock:
+            for key in keys:
+                chain = self.map.vals.get(key)
+                if chain and any(v[1] == start_ts and v[2] != OP_ROLLBACK
+                                 for v in chain):
+                    out.append(key)
+        return out
+
+    def delta_keys_since(self, table_id: int, after_ts: int,
+                         upto_ts: int) -> "list[bytes] | None":
+        """Row keys committed to ``table_id`` in (after_ts, upto_ts] —
+        the fold set for a versioned cache hit at a newer version — or
+        None when the ring cannot PROVE completeness for that range:
+        entries evicted past ``after_ts``, an un-noted advance poisoned
+        the table, the range predates this boot's replay, or our
+        replica has not applied through ``upto_ts`` yet.  None always
+        means "recompute from scratch", never "no delta rows"."""
+        if after_ts >= upto_ts:
+            return []
+        with self._lock:
+            applied_ts = self.table_version_ts.get(table_id, 0)
+        if applied_ts < upto_ts:
+            return None
+        with self._delta_mu:
+            if after_ts < self._delta_min_after:
+                return None
+            ring = self._delta_ring.get(table_id)
+            if ring is None:
+                return None
+            if after_ts < self._delta_floor.get(table_id, 1 << 62):
+                return None
+            out: "list[bytes]" = []
+            for ts, ks in ring:
+                if after_ts < ts <= upto_ts:
+                    out.extend(ks)
+            return out
 
     # -- introspection --------------------------------------------------------
 

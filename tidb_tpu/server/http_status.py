@@ -236,6 +236,17 @@ class StatusServer:
         gauges.setdefault("fabric_dedup_hits", fs["fabric_dedup_hits"])
         gauges.setdefault("fabric_compile_rtt_ms",
                           fs["fabric_compile_rtt_ms"])
+        # versioned result cache (executor/agg_cache.py): this worker's
+        # share + the fleet-global segment counters when attached
+        gauges.setdefault("cache_hits", fs.get("cache_hits", 0))
+        gauges.setdefault("cache_invalidations",
+                          fs.get("cache_invalidations", 0))
+        gauges.setdefault("cache_delta_folds",
+                          fs.get("cache_delta_folds", 0))
+        gauges.setdefault("cache_stale_reads",
+                          fs.get("cache_stale_reads", 0))
+        gauges.setdefault("fleet_cache_hits",
+                          fs.get("fleet_cache_hits", 0))
         ws = _wal_snapshot(self.domain)
         gauges.setdefault("wal_appends", ws["wal_appends"])
         gauges.setdefault("wal_fsyncs", ws["wal_fsyncs"])

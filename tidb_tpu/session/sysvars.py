@@ -122,6 +122,10 @@ for _v in [
     SysVar("tidb_mem_quota_query", SCOPE_BOTH, str(1 << 30), "int", 0),
     SysVar("tidb_max_chunk_size", SCOPE_BOTH, "65536", "int", 32),
     SysVar("tidb_snapshot_isolation", SCOPE_BOTH, "ON", "bool"),
+    # the fleet's version-stamped fragment result cache
+    # (executor/agg_cache.py); OFF pins every agg to a fresh compute —
+    # the bench's bit-equality oracle for a delta-folded page
+    SysVar("tidb_result_cache", SCOPE_BOTH, "ON", "bool"),
     SysVar("tidb_build_stats_concurrency", SCOPE_BOTH, "4", "int", 1),
     SysVar("tidb_distsql_scan_concurrency", SCOPE_BOTH, "15", "int", 1),
     SysVar("tidb_executor_concurrency", SCOPE_BOTH, "5", "int", 1),

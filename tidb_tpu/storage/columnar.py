@@ -204,6 +204,7 @@ class ColumnarCache:
         self.storage = storage
         self._lock = threading.Lock()
         self._entries: dict[int, _Entry] = {}
+        self._bulk_tags: dict[int, str] = {}
 
     def invalidate(self, table_id: int):
         with self._lock:
@@ -362,9 +363,20 @@ class ColumnarCache:
                                    col.nulls[order])
         return _View(new_cols, handles[order], None, (), len(handles))
 
-    def install_bulk(self, info: TableInfo, columns: dict, handles: np.ndarray):
+    def install_bulk(self, info: TableInfo, columns: dict, handles: np.ndarray,
+                     content_tag: "str | None" = None):
         """Bulk-load path (the Lightning physical-import role): install
-        column arrays directly and mark the table version as current."""
+        column arrays directly and mark the table version as current.
+
+        ``content_tag`` is the caller's declaration of the installed
+        CONTENT's identity (e.g. "tpch/lineitem/sf0.002/v1" for a
+        fixed-seeded generator).  Bulk columns are process-local — they
+        never travel through the shared log — so the fleet result cache
+        (executor/agg_cache.py) only caches a never-SQL-written bulk
+        table when a tag vouches for cross-worker content identity, and
+        folds the tag into the cache key: two fleets (or two workers)
+        installing different content can never share a page.  None
+        (default) keeps such tables cache-ineligible."""
         tid = info.id
         version = self.storage.mvcc.table_version(tid)
         col_sig = tuple(c.id for c in info.public_columns())
@@ -372,7 +384,15 @@ class ColumnarCache:
                    _View(columns, handles, None, (), len(handles)))
         with self._lock:
             self._entries[tid] = e
+            if content_tag is not None:
+                self._bulk_tags[tid] = str(content_tag)
         return e.view
+
+    def bulk_tag(self, table_id: int) -> "str | None":
+        """The content_tag a bulk install declared for this table, if
+        any (see install_bulk)."""
+        with self._lock:
+            return self._bulk_tags.get(table_id)
 
     def project(self, view: _View, col_infos, info: TableInfo) -> Chunk:
         out = []
