@@ -93,6 +93,40 @@ def _pctl(sorted_vals, q: float):
     return round(sorted_vals[i], 2)
 
 
+#: span-ring stat keys surfaced on every per-phase line (a bench
+#: regression names its phase AND whether the tracer was dropping)
+_RING_KEYS = ("ring_traces", "started", "finished", "spans_dropped",
+              "ring_dropped", "remote_hops", "remote_traces")
+
+
+def _phase(emit, phase: str, t0: float, ring: "dict | None" = None,
+           **extra) -> None:
+    """One ``serve_phase`` JSON line: the phase's wall clock plus
+    span-ring stats (this process's ring for thread-mode runs; pass a
+    worker's DIAG-fetched snapshot for fleet phases)."""
+    if ring is None:
+        from tidb_tpu.session import tracing
+        ring = tracing.snapshot()
+    emit({"metric": "serve_phase", "phase": phase,
+          "wall_s": round(time.monotonic() - t0, 3),
+          **{k: ring.get(k, 0) for k in _RING_KEYS}, **extra})
+
+
+def _fleet_ring(port: int) -> dict:
+    """One worker's span-ring stats over its DIAG endpoint (zeros when
+    the peer is unreachable — phase lines must never fail a run)."""
+    try:
+        from tidb_tpu.fabric.client import FleetClient
+        c = FleetClient(port, timeout=5.0)
+        try:
+            _cols, rows = c.must_query("DIAG metrics")
+            return json.loads(rows[0][0]).get("tracing", {})
+        finally:
+            c.close()
+    except Exception:  # noqa: BLE001 — diagnostics-only feed
+        return {}
+
+
 def _setup(sf: float) -> tuple:
     """One Domain: TPC-H tables at `sf` (tpch db) + the transfer ledger
     (test db).  Returns (tk, goldens) — goldens are the fault-free HOST
@@ -340,6 +374,7 @@ def run_serve(n_threads: int = 8, n_ops: int = 20, sf: float = 0.01,
     summary["degradations_by_group"] = sched["degradations_by_group"]
     summary["sync_compile_s"] = round(ps["compile_s"], 4)
     summary["bg_compile_s"] = round(ps["bg_compile_s"], 4)
+    _phase(emit, "serve", t_start)
     return summary
 
 
@@ -396,6 +431,7 @@ def run_durability(n_txns: int = 150, emit=_emit) -> dict:
         return round(n_txns / dt, 1)
 
     tmp = tempfile.mkdtemp(prefix="serve-dur-")
+    t_dur = time.monotonic()
     out = {"metric": "serve_durability", "n_txns": n_txns}
     try:
         out["qps_wal_off"] = dml_qps(None, None)
@@ -434,6 +470,7 @@ def run_durability(n_txns: int = 150, emit=_emit) -> dict:
         with contextlib.suppress(OSError):
             shutil.rmtree(tmp)
     emit(out)
+    _phase(emit, "durability", t_dur)
     return out
 
 
@@ -560,6 +597,7 @@ def run_failover(hosts: int = 3, n_ack: int = 4, nregions: int = 6,
         verify_region_invariants
 
     assert hosts >= 3, "failover mode needs >= 3 hosts (2 survivors)"
+    t_fo = time.monotonic()
     rng = random.Random(seed)
     doomed = rng.randrange(hosts)
     root = tempfile.mkdtemp(prefix="serve-failover-")
@@ -700,6 +738,7 @@ def run_failover(hosts: int = 3, n_ack: int = 4, nregions: int = 6,
                     "cold_restore_rows": len(cold_pairs),
                     "unacked_gone": True, "cold_restore_ok": True})
         emit(out)
+        _phase(emit, "failover", t_fo)
         return out
     finally:
         import signal as _sig
@@ -824,7 +863,11 @@ def run_fleet(procs: int = 4, n_threads: int = 8, n_ops: int = 6,
     fleet = Fleet(
         procs, init="bench_serve:_fabric_seed",
         sysvars={"tidb_device_tenant_running_cap": "1"},
-        env_extra={"BENCH_FABRIC_SF": str(sf)}, slot_env=slot_env)
+        env_extra={"BENCH_FABRIC_SF": str(sf)}, slot_env=slot_env,
+        # workers coordinate over TCP: every segment op becomes a
+        # traced hop into the parent, the topology the trace phase's
+        # >=3-process stitching assertion rides on
+        net_coord=True)
     t_start = time.monotonic()
     fleet.start(timeout_s=300.0)
     emit({"metric": "fleet_up", "procs": procs, "port": fleet.port,
@@ -958,8 +1001,13 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
         t.join(600.0)
     assert not any(t.is_alive() for t in threads), "STUCK mix clients"
     mix_wall = time.monotonic() - t_mix
+    # per-phase line: wall clock + the golden worker's span-ring stats,
+    # so a bench regression is attributable to its phase without rerun
+    ring_port = fleet.direct_port(golden_slot)
+    _phase(emit, "fleet_mix", t_mix, _fleet_ring(ring_port))
 
     # -- phase: cross-process starved-tenant WFQ regression ------------------
+    t_wfq = time.monotonic()
     slot_a, slot_b = survivors[0], survivors[1 % len(survivors)]
     wfq_lat = {"heavy": [], "light": []}
     wfq_mu = threading.Lock()
@@ -1019,8 +1067,10 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
           "p50_heavy_s": round(p50_heavy, 4),
           "peak_running_heavy": peak_heavy,
           "slot_heavy": slot_a, "slot_light": slot_b})
+    _phase(emit, "fleet_wfq", t_wfq, _fleet_ring(ring_port))
 
     # -- phase: fleet fragment dedup -----------------------------------------
+    t_ded = time.monotonic()
     ded_start = threading.Barrier(2)
     ded_errs = []
 
@@ -1053,6 +1103,7 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
     ctrs = fleet.coord.counters()
     emit({"metric": "fleet_dedup",
           **{k: v for k, v in ctrs.items() if k.startswith("fabric_")}})
+    _phase(emit, "fleet_dedup", t_ded, _fleet_ring(ring_port))
 
     # -- phase: version-stamped fragment result cache ------------------------
     # a pure repeat loop of one Q1-shape fragment must serve from the
@@ -1066,6 +1117,7 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
     # worker's columnar delta-tree stays maintained (bulk-installed TPC-H
     # columns are process-local; a remote worker rebuilding them from KV
     # is a separate, pre-existing limitation).
+    t_cache = time.monotonic()
     cq = bench.QUERIES["q1"]
     cc = _fleet_conn(fleet.direct_port(slot_a), group="olap",
                      engine="tpu")
@@ -1124,6 +1176,7 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
         "stale_reads": post.get("fabric_cache_stale_reads", 0),
     }
     emit({"metric": "serve_cache", **cache_stats})
+    _phase(emit, "fleet_cache", t_cache, _fleet_ring(ring_port))
 
     # -- phase: process-kill chaos -------------------------------------------
     respawn_s = None
@@ -1155,6 +1208,83 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
         emit({"metric": "fleet_kill_chaos", "slot": doomed,
               "respawn_s": round(respawn_s, 2),
               "lease_reclaims": ctrs["fabric_lease_reclaims"]})
+        _phase(emit, "fleet_kill", t0, _fleet_ring(ring_port))
+
+    # -- phase: distributed trace stitching + fleet observability ------------
+    # runs LAST so every worker is live again (the kill phase ends with
+    # the doomed worker respawned).  Three regressions in one pass:
+    #   * one statement's stitched trace must carry spans from >= 3
+    #     distinct PROCESSES (worker + compile server + the parent's
+    #     network coordinator);
+    #   * cluster_statements_summary must return ok rows from EVERY
+    #     live worker (the DIAG fan-out path);
+    #   * the shared fragment-perf store must hold strictly more
+    #     samples than any single worker contributed, and EXPLAIN
+    #     ANALYZE must render the fleet perf line from it.
+    t_trace = time.monotonic()
+    for s in range(procs):
+        # every worker needs statement history before the cluster
+        # summary fan-out is asserted on row coverage
+        pc = _fleet_conn(fleet.direct_port(s))
+        pc.must_query("select count(*) from region")
+        pc.close()
+    tc = _fleet_conn(fleet.direct_port(slot_a), group="olap",
+                     engine="tpu")
+    tc.must_exec("set tidb_result_cache = 'OFF'")
+    # a filter constant no run has ever compiled: the persistent
+    # signature index survives across bench invocations, and a warm
+    # pipeline would skip the compile-server hop under test
+    uniq = time.time_ns() % 10**9
+    tq = bench.QUERIES["q1"].replace(
+        "'1998-09-02'", f"'1998-09-02' and l_tax > -{uniq}")
+    tree = json.loads(
+        tc.must_query("trace format='json' " + tq)[1][0][0])
+
+    def _trace_pids(node, acc):
+        # every span subtree (local or hop-grafted) carries its
+        # process's pid in the gid prefix
+        if isinstance(node, dict):
+            gid = node.get("gid")
+            if isinstance(gid, str) and "-" in gid:
+                acc.add(int(gid.split("-")[0], 16))
+            for v in node.values():
+                _trace_pids(v, acc)
+        elif isinstance(node, list):
+            for v in node:
+                _trace_pids(v, acc)
+        return acc
+
+    trace_pids = _trace_pids(tree, set())
+    scols, srows = tc.must_query(
+        "select * from information_schema.cluster_statements_summary")
+    i_inst, i_err = scols.index("instance"), scols.index("error")
+    sum_ok = {r[i_inst] for r in srows if not r[i_err]}
+
+    def _perf_totals(port):
+        c = FleetClient(port)
+        try:
+            c.must_exec("use tpch")
+            pn, pr = c.must_query(
+                "select * from information_schema.tidb_fragment_perf")
+        finally:
+            c.close()
+        ic, il = pn.index("count"), pn.index("local_count")
+        return (sum(int(r[ic]) for r in pr),
+                sum(int(r[il]) for r in pr))
+
+    perf_fleet_a, perf_local_a = _perf_totals(fleet.direct_port(slot_a))
+    perf_fleet_b, perf_local_b = _perf_totals(fleet.direct_port(slot_b))
+    _ecols, erows = tc.must_query("explain analyze " + tq)
+    ea_text = "\n".join(" ".join(str(cell) for cell in row)
+                        for row in erows)
+    tc.close()
+    emit({"metric": "fleet_trace", "procs_in_trace": len(trace_pids),
+          "summary_instances_ok": len(sum_ok),
+          "summary_rows": len(srows),
+          "perf_fleet_samples": max(perf_fleet_a, perf_fleet_b),
+          "perf_local_samples": [perf_local_a, perf_local_b],
+          "explain_fleet_line": "fleet:" in ea_text})
+    _phase(emit, "fleet_trace", t_trace, _fleet_ring(ring_port))
 
     # -- report --------------------------------------------------------------
     assert not violations, "\n".join(str(v) for v in violations)
@@ -1216,6 +1346,21 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
     assert cache_stats["delta_folds"] >= 1, (
         "DELTA FOLD INERT: the invalidated read recomputed from scratch "
         f"instead of folding the WAL delta ({cache_stats})")
+    assert len(trace_pids) >= 3, (
+        f"TRACE STITCHING REGRESSION: one statement's stitched trace "
+        f"crossed only {len(trace_pids)} process(es) ({sorted(trace_pids)})"
+        " — want worker + compile server + coordinator")
+    assert len(sum_ok) == procs, (
+        f"CLUSTER SUMMARY GAP: ok rows from {len(sum_ok)}/{procs} live "
+        f"workers (instances {sorted(sum_ok)})")
+    assert (perf_fleet_a > max(perf_local_a, perf_local_b)
+            and perf_fleet_b > max(perf_local_a, perf_local_b)), (
+        f"FLEET PERF STORE INERT: fleet sample totals "
+        f"{perf_fleet_a}/{perf_fleet_b} not strictly above every single "
+        f"worker's local share ({perf_local_a}/{perf_local_b})")
+    assert "fleet:" in ea_text, (
+        "EXPLAIN ANALYZE missing the fleet perf line (fabric/perf.py "
+        "lookup produced nothing for a just-dispatched fragment)")
     return summary
 
 

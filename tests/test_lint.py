@@ -532,6 +532,34 @@ class TestGaugeConsistency:
                        "executor/exec_select.py": exec_src})
         assert out == []
 
+    # -- the ISSUE 18 fleet-inventory extension: snapshot()-fed fields
+    # pinned on both the publishing module and the /metrics side
+
+    FLEET_PERF_SRC = ('def stats():\n'
+                      '    return {"perf_notes": 1, "perf_merged": 2}\n')
+    FLEET_STATUS_OK = ('FLEET_KEYS = ("perf_notes", "perf_merged")\n')
+
+    def test_fleet_inventory_both_sides_clean(self):
+        assert run_one("gauge-consistency",
+                       {"server/http_status.py": self.FLEET_STATUS_OK,
+                        "fabric/perf.py": self.FLEET_PERF_SRC}) == []
+
+    def test_fleet_inventory_missing_status_side(self):
+        out = run_one("gauge-consistency",
+                      {"server/http_status.py":
+                       'FLEET_KEYS = ("perf_notes",)\n',
+                       "fabric/perf.py": self.FLEET_PERF_SRC})
+        assert ({f.ident for f in out}
+                == {"fleet-inventory-status:perf_merged"}), out
+
+    def test_fleet_inventory_missing_source_side(self):
+        out = run_one("gauge-consistency",
+                      {"server/http_status.py": self.FLEET_STATUS_OK,
+                       "fabric/perf.py":
+                       'def stats():\n    return {"perf_notes": 1}\n'})
+        assert ({f.ident for f in out}
+                == {"fleet-inventory-source:perf_merged"}), out
+
 
 # -- trace-coverage -----------------------------------------------------------
 
@@ -596,6 +624,53 @@ class TestTraceCoverage:
     def test_unaudited_file_ignored(self):
         assert run_one("trace-coverage",
                        {"executor/rogue.py": TRACE_COV_BAD}) == []
+
+
+# -- codec-rpc-trace ----------------------------------------------------------
+
+CODEC_RPC_BAD = """
+from . import codec
+
+def call(sock, req):
+    codec.write_frame(sock, req)
+    return codec.read_frame(sock)
+"""
+
+CODEC_RPC_OK = """
+from . import codec
+from ..session import tracing
+
+def call(sock, req):
+    ctx = tracing.wire_ctx()
+    if ctx is not None:
+        req["trace"] = ctx
+    codec.write_frame(sock, req)
+    resp = codec.read_frame(sock)
+    tracing.attach_remote(resp.pop("_trace", None))
+    return resp
+
+def serve(sock, coord):
+    req = codec.read_frame(sock)
+    rtr = tracing.begin_remote(req.pop("trace", None), "op")
+    codec.write_frame(sock, {"ok": True})
+    return rtr
+"""
+
+
+class TestCodecRpcTrace:
+    def test_unpropagated_rpc_found(self):
+        out = run_one("codec-rpc-trace",
+                      {"fabric/widget_net.py": CODEC_RPC_BAD})
+        assert len(out) == 1 and out[0].ident.startswith("rpc@"), out
+
+    def test_client_and_server_forms_comply(self):
+        assert run_one("codec-rpc-trace",
+                       {"fabric/widget_net.py": CODEC_RPC_OK}) == []
+
+    def test_codec_transport_and_non_fabric_exempt(self):
+        assert run_one("codec-rpc-trace",
+                       {"fabric/codec.py": CODEC_RPC_BAD,
+                        "executor/widget.py": CODEC_RPC_BAD}) == []
 
 
 # -- guard inference + guarded-state ------------------------------------------

@@ -1,0 +1,386 @@
+"""Fleet observability plane (ISSUE 18): the shared fragment
+performance store math + merge (fabric/perf.py, coord PERF section),
+the DIAG statement, the cluster memtables with their ``peer-lost``
+contract, the information_schema.tidb_fragment_perf surface, and trace
+propagation under process chaos (a killed + a wedged worker must show
+up as tagged rows and trace marks, never as a hang)."""
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from tidb_tpu.fabric import perf  # noqa: E402
+from tidb_tpu.fabric import state as fabric_state  # noqa: E402
+from tidb_tpu.fabric.coord import (PERF_BASE_S,  # noqa: E402
+                                   PERF_SKETCH_N, Coordinator)
+from tidb_tpu.testkit import TestKit  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_perf():
+    perf.reset_for_tests()
+    yield
+    perf.reset_for_tests()
+
+
+class TestFragmentPerfMath:
+    """The store's pure math: sketch buckets, percentiles, dispatch
+    keys, the describe() line — no coordinator involved."""
+
+    def test_sketch_bucket_edges(self):
+        assert perf.sketch_bucket(0.0) == 0
+        assert perf.sketch_bucket(PERF_BASE_S) == 0
+        assert perf.sketch_bucket(PERF_BASE_S * 1.01) == 1
+        assert perf.sketch_bucket(1e9) == PERF_SKETCH_N - 1
+
+    def test_percentile_upper_bounds(self):
+        sketch = [0] * PERF_SKETCH_N
+        sketch[2] = 50
+        sketch[5] = 50
+        assert perf.percentile(sketch, 100, 0.50) == PERF_BASE_S * 4
+        assert perf.percentile(sketch, 100, 0.99) == PERF_BASE_S * 32
+        assert perf.percentile(sketch, 0, 0.5) is None
+
+    def test_dispatch_key_forms(self):
+        # batch key with an int row-bucket tail: structural prefix
+        # hashes, the tail IS the bucket
+        sig, bucket = perf.dispatch_key(("agg", ("sum",), 128))
+        assert bucket == 128 and sig == perf.sig_hash(("agg", ("sum",)))
+        # no int tail: whole key hashes, bucket 0
+        assert perf.dispatch_key(("agg", "x")) == (
+            perf.sig_hash(("agg", "x")), 0)
+        # keyless dispatch degrades to the fragment shape
+        assert perf.dispatch_key(None, shape="join") == (
+            perf.sig_hash(("shape", "join")), 0)
+
+    def test_note_accumulates_and_describe_renders(self):
+        for d in (0.01, 0.02, 0.04):
+            perf.note("sigA", 64, "device", "dispatch", d)
+        perf.note("sigA", 64, "host", "dispatch", 0.5)
+        rows = perf.local_rows()
+        dev = [r for r in rows if r["backend"] == 0]
+        assert len(dev) == 1 and dev[0]["count"] == 3
+        assert abs(dev[0]["sum_s"] - 0.07) < 1e-9
+        assert dev[0]["max_s"] == 0.04
+        line = perf.describe(perf.lookup("sigA", 64))
+        assert line.startswith("n=4")
+        assert "device p50/p99" in line and "host p50/p99" in line
+        # compile/admission samples don't count into the dispatch line
+        perf.note("sigA", 64, "device", "compile", 9.0)
+        assert perf.describe(perf.lookup("sigA", 64)).startswith("n=4")
+        assert perf.describe([]) == ""
+
+    def test_flush_without_fleet_keeps_local_mirror(self):
+        perf.note("sigB", 0, "device", "dispatch", 0.01)
+        assert perf.flush() == 0  # no coordinator: local-only
+        st = perf.stats()
+        assert st["perf_notes"] == 1 and st["perf_flushes"] == 1
+        assert st["perf_buffered_rows"] == 0
+        assert st["perf_local_rows"] == 1
+        # the read surface still answers from the mirror
+        assert perf.fleet_rows()[0]["count"] == 1
+
+    def test_unknown_backend_or_kind_is_dropped(self):
+        perf.note("sigC", 0, "gpu", "dispatch", 0.1)
+        perf.note("sigC", 0, "device", "teleport", 0.1)
+        assert perf.local_rows() == []
+
+
+class TestFragmentPerfFleet:
+    """Merge semantics against a real segment: two workers' samples
+    aggregate; the fleet row strictly exceeds any single worker's."""
+
+    def test_two_slot_merge_exceeds_any_local(self, tmp_path):
+        coord = Coordinator.create(str(tmp_path / "coord.json"), nslots=4)
+        coord.claim_slot(0)
+        fabric_state.activate(coord, 0, lease_hbm=False)
+        try:
+            for _ in range(3):
+                perf.note("sigF", 32, "device", "dispatch", 0.02)
+            assert perf.flush() == 1  # one row merged
+            # the other worker's share arrives through the same op the
+            # segment serves every peer with
+            key = (perf.sig_hash("sigF"), 32, 0, perf.KINDS.index(
+                "dispatch"))
+            sk = [0] * PERF_SKETCH_N
+            sk[perf.sketch_bucket(0.08)] = 2
+            assert coord.perf_merge([key + (2, 0.16, 0.08, sk)]) == 1
+            rows = perf.fleet_rows()
+            assert len(rows) == 1
+            r = rows[0]
+            assert r["count"] == 5                 # 3 local + 2 remote
+            assert abs(r["sum_s"] - 0.22) < 1e-6
+            assert abs(r["max_s"] - 0.08) < 1e-9
+            local = perf.local_rows()[0]["count"]
+            assert r["count"] > local == 3
+            assert perf.stats()["perf_merged"] >= 1
+        finally:
+            fabric_state.deactivate()
+            coord.unlink()
+
+    def test_fragment_perf_memtable_rows(self, tmp_path):
+        coord = Coordinator.create(str(tmp_path / "coord.json"), nslots=4)
+        coord.claim_slot(0)
+        fabric_state.activate(coord, 0, lease_hbm=False)
+        try:
+            tk = TestKit()
+            perf.note("sigM", 16, "device", "dispatch", 0.01)
+            perf.note("sigM", 16, "device", "dispatch", 0.03)
+            key = (perf.sig_hash("sigM"), 16, 0, perf.KINDS.index(
+                "dispatch"))
+            sk = [0] * PERF_SKETCH_N
+            sk[perf.sketch_bucket(0.05)] = 4
+            coord.perf_merge([key + (4, 0.2, 0.05, sk)])
+            r = tk.must_query(
+                "select sig_hash, backend, kind, count, local_count, "
+                "p99_s from information_schema.tidb_fragment_perf")
+            assert len(r.rows) == 1
+            sig_hex, backend, kind, count, local, p99 = r.rows[0]
+            assert sig_hex == f"{perf.sig_hash('sigM'):016x}"
+            assert (backend, kind) == ("device", "dispatch")
+            assert int(count) == 6 and int(local) == 2
+            assert int(count) > int(local)  # fleet > this worker alone
+            assert float(p99) > 0.0
+        finally:
+            fabric_state.deactivate()
+            coord.unlink()
+
+
+class TestDiagStatement:
+    """DIAG over a plain session: every kind answers one JSON cell."""
+
+    def _diag(self, tk, stmt):
+        r = tk.must_query(stmt)
+        assert r.result.names == ["diag"]
+        return json.loads(r.rows[0][0])
+
+    def test_metrics_kind(self):
+        tk = TestKit()
+        out = self._diag(tk, "DIAG metrics")
+        assert out["kind"] == "metrics"
+        assert "counters" in out
+        assert "ring_dropped" in out["tracing"]
+
+    def test_table_kinds_mirror_memtables(self):
+        tk = TestKit()
+        tk.must_exec("set tidb_trace_sampling_rate = 1")
+        tk.must_query("select 1")
+        tk.must_exec("set tidb_trace_sampling_rate = 0")
+        out = self._diag(tk, "DIAG statements")
+        assert out["kind"] == "statements"
+        assert out["rows"], "no statement history after a query"
+        # cols and rows stay aligned with the base memtable schema
+        assert all(len(r) == len(out["cols"]) for r in out["rows"])
+        traces = self._diag(tk, "DIAG traces")
+        assert traces["kind"] == "traces" and traces["rows"]
+
+    def test_perf_kind_and_status_kind(self):
+        tk = TestKit()
+        perf.note("sigD", 8, "host", "dispatch", 0.2)
+        out = self._diag(tk, "DIAG perf")
+        assert out["kind"] == "perf"
+        assert out["local"][0]["count"] == 1
+        assert out["stats"]["perf_notes"] == 1
+        st = self._diag(tk, "DIAG status")
+        assert st["kind"] == "status" and "fabric" in st
+
+    def test_unknown_kind_is_a_clean_error(self):
+        from tidb_tpu.errors import TiDBError
+        tk = TestKit()
+        with pytest.raises(TiDBError):
+            tk.must_query("DIAG warp")
+
+    def test_non_diag_text_passes_through(self):
+        tk = TestKit()
+        # a table named diagnostics must not trip the intercept
+        tk.must_exec("use test")
+        tk.must_exec("create table diagnostics (a int primary key)")
+        tk.must_exec("insert into diagnostics values (7)")
+        assert tk.must_query(
+            "select a from diagnostics").rows == [("7",)]
+
+
+class TestClusterMemtables:
+    """The fan-out contract: live peers contribute their rows, a dead
+    peer contributes exactly one ``peer-lost`` row within the budget,
+    and the statement's trace carries the hop marks."""
+
+    def test_no_fleet_answers_local(self):
+        tk = TestKit()
+        tk.must_query("select 1")
+        rows = tk.must_query(
+            "select instance, error from "
+            "information_schema.cluster_statements_summary").rows
+        assert rows
+        assert all(r[0] == "local" and not r[1] for r in rows)
+
+    def test_dead_peer_tagged_and_traced(self, tmp_path):
+        from tidb_tpu.server.server import MySQLServer
+        from tidb_tpu.session.diag import PEER_TIMEOUT_S
+        coord = Coordinator.create(str(tmp_path / "coord.json"), nslots=4)
+        tk = TestKit()
+        srv = None
+        try:
+            # slot 0: THIS process, reachable on a real direct port
+            coord.claim_slot(0)
+            fabric_state.activate(coord, 0, lease_hbm=False)
+            srv = MySQLServer(tk.domain, port=0, users={}).start()
+            coord.set_direct_port(0, srv.port)
+            # slot 1: a peer that died mid-statement — lease still
+            # fresh, direct port refusing connections
+            coord.claim_slot(1)
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+            s.close()
+            coord.set_direct_port(1, dead_port)
+            tk.must_query("select 1")  # statement history to serve
+            coord.heartbeat(0)
+            coord.heartbeat(1)
+            t0 = time.monotonic()
+            tree = json.loads(tk.must_query(
+                "trace format='json' select instance, error from "
+                "information_schema.cluster_statements_summary"
+            ).rows[0][0])
+            wall = time.monotonic() - t0
+            assert wall < PEER_TIMEOUT_S + 3.0, (
+                f"cluster query took {wall:.1f}s — a dead peer must "
+                "cost its budget, not a hang")
+            blob = json.dumps(tree)
+            assert "cluster.fanout" in blob
+            assert "peer-lost" in blob, (
+                "dead peer's hop left no mark on the stitched trace")
+            # ...and the memtable rows carry the tagged error cell
+            rows = tk.must_query(
+                "select instance, error from "
+                "information_schema.cluster_statements_summary").rows
+            by_inst = {}
+            for inst, err in rows:
+                by_inst.setdefault(inst, []).append(err or "")
+            live = by_inst[f"slot0:{srv.port}"]
+            assert live and all(not e for e in live)
+            lost = by_inst[f"slot1:{dead_port}"]
+            assert len(lost) == 1
+            assert lost[0].startswith("peer-lost:"), lost
+        finally:
+            fabric_state.deactivate()
+            if srv is not None:
+                srv.shutdown()
+            coord.unlink()
+
+
+@pytest.mark.chaos_threads
+class TestClusterChaosTrace:
+    """Trace propagation under real process chaos (the ISSUE 18
+    satellite): one worker SIGKILLed mid-statement via the
+    chaos-harness fleet fault, one wedged (SIGSTOP — alive socket,
+    dead service).  The survivor's cluster query must complete within
+    the per-peer budget with the lost peer as a ``peer-lost`` row AND
+    a peer-lost mark on the stitched trace — never a hang, never a
+    dropped trace."""
+
+    def test_survivor_trace_marks_lost_peers(self, tmp_path):
+        from tests.chaos_harness import FLEET_FAULTS
+        from tidb_tpu.fabric.client import FleetClient, WireError
+        from tidb_tpu.fabric.fleet import Fleet
+        from tidb_tpu.session.diag import PEER_TIMEOUT_S
+        kill_action = FLEET_FAULTS["fabric-kill-worker"][0]
+        fleet = Fleet(
+            3, compile_server=False, run_dir=str(tmp_path / "fleet"),
+            slot_env={0: {"TIDB_TPU_FABRIC_FAILPOINTS":
+                          f"fabric-kill-worker={kill_action}"}})
+        fleet.start(timeout_s=240.0)
+        stopped_pid = None
+        try:
+            # statement history on the workers that will answer (slot
+            # 0's armed failpoint fires on its FIRST query — don't
+            # spend it on the warm-up)
+            for slot in (1, 2):
+                c = FleetClient(fleet.direct_port(slot))
+                c.must_query("select 1")
+                c.close()
+            old_pid = fleet.worker_pid(0)
+            # worker 1 wedges: process alive (no respawn, lease goes
+            # stale on its own clock), service dead — its direct port
+            # still connects (kernel backlog) but DIAG never answers
+            stopped_pid = fleet.worker_pid(1)
+            os.kill(stopped_pid, signal.SIGSTOP)
+            # worker 0 dies MID-STATEMENT on its armed fault
+            with pytest.raises(WireError):
+                FleetClient(fleet.direct_port(0)).must_query("select 1")
+            # the survivor's cluster view, traced — within the wedged
+            # peer's lease window so its port is still advertised
+            c2 = FleetClient(fleet.direct_port(2))
+            t0 = time.monotonic()
+            tree = json.loads(c2.must_query(
+                "trace format='json' select instance, error from "
+                "information_schema.cluster_statements_summary"
+            )[1][0][0])
+            wall = time.monotonic() - t0
+            assert wall < 2 * PEER_TIMEOUT_S + 4.0, (
+                f"survivor's cluster query took {wall:.1f}s with dead "
+                "peers — the per-peer budget did not hold")
+            assert tree["duration_s"] is not None, (
+                "survivor's trace not finished")
+
+            # the fan-out's span events are the statement's own record
+            # of which peers answered: the wedged worker must be a
+            # peer-lost mark, the survivor an ok one
+            def _fanout_events(node, acc):
+                if isinstance(node, dict):
+                    for ev in node.get("events", []):
+                        if ev.get("name") == "cluster.fanout":
+                            acc.append(ev.get("tags", {}))
+                    for ch in node.get("children", []):
+                        _fanout_events(ch, acc)
+                return acc
+
+            evs = _fanout_events(tree.get("root", {}), [])
+            assert evs, "no cluster.fanout events on the stitched trace"
+            assert any(t.get("status") == "peer-lost" for t in evs), (
+                f"no peer-lost mark on the survivor's trace: {evs}")
+            assert any(t.get("status") == "ok"
+                       and t.get("instance", "").startswith("slot2:")
+                       for t in evs), evs
+            # a later plain query still answers (lost peers may have
+            # aged out of the peer list by now — any that remain must
+            # be tagged, never silently absent rows mid-list)
+            rows = c2.must_query(
+                "select instance, error from "
+                "information_schema.cluster_statements_summary")[1]
+            ok_insts = {r[0] for r in rows if not r[1]}
+            assert any(i.startswith("slot2:") for i in ok_insts), rows
+            assert all((e or "").startswith("peer-lost:")
+                       for _i, e in rows if e)
+            c2.close()
+            os.kill(stopped_pid, signal.SIGCONT)
+            stopped_pid = None
+            assert fleet.wait_respawn(0, old_pid, 30.0), (
+                "no respawn within the backoff budget")
+            # the fleet converges: every slot serves again (the
+            # respawned incarnation's failpoint is NOT re-armed)
+            deadline = time.monotonic() + 30.0
+            for slot in range(3):
+                while True:
+                    try:
+                        c = FleetClient(fleet.direct_port(slot))
+                        c.must_query("select 1")
+                        c.close()
+                        break
+                    except (WireError, OSError):
+                        assert time.monotonic() < deadline, (
+                            f"slot {slot} never recovered")
+                        time.sleep(0.25)
+        finally:
+            if stopped_pid is not None:
+                os.kill(stopped_pid, signal.SIGCONT)
+            drained = fleet.shutdown()
+        assert drained and drained["ok"], drained

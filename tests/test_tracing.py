@@ -74,6 +74,20 @@ class TestOverheadWhenOff:
         assert tracing.capture() is None
         tracing.event("nothing", x=1)  # must not raise nor allocate
 
+    def test_propagation_helpers_are_single_branch_noops(self):
+        """The cross-process hop helpers keep the same off-path contract
+        as span/event: no active trace (or None in) ⇒ one branch out,
+        nothing allocated, no STATS movement."""
+        assert tracing.active() is None
+        s0 = dict(tracing.STATS)
+        assert tracing.wire_ctx() is None
+        assert tracing.begin_remote(None, "rpc.op") is None
+        assert tracing.finish_remote(None) is None
+        tracing.attach_remote(None)  # must not raise
+        tracing.attach_remote({"gid": "dead-1", "name": "orphan"})
+        assert dict(tracing.STATS) == s0, \
+            "off-path propagation must never touch the tracer"
+
     def test_statement_allocates_no_trace_when_unsampled(self, tk):
         s0 = dict(tracing.STATS)
         tk.must_query("select count(*) from t")
@@ -449,6 +463,28 @@ class TestHistograms:
             assert re.search(rf"{name}_sum \d", txt)
         assert "device_tracing" in status
         assert status["device_tracing"]["ring_cap"] == tracing.RING_CAP
+
+    def test_trace_ring_dropped_counter(self, tk):
+        """/metrics pins trace_ring_dropped_total: a proper counter
+        series that moves exactly when finished traces age out of the
+        bounded ring unread."""
+        from tidb_tpu.server.http_status import StatusServer
+        srv = StatusServer(tk.session.domain, port=0)
+        try:
+            txt = srv._metrics()
+            assert "# TYPE trace_ring_dropped_total counter" in txt
+            base = int(re.search(
+                r"trace_ring_dropped_total (\d+)", txt).group(1))
+            for i in range(tracing.RING_CAP + 3):
+                tracing.finish(tracing.begin(f"overflow{i}",
+                                             origin="test"))
+            txt2 = srv._metrics()
+            cur = int(re.search(
+                r"trace_ring_dropped_total (\d+)", txt2).group(1))
+        finally:
+            srv._server.server_close()
+        assert cur >= base + 3, (base, cur)
+        assert tracing.snapshot()["ring_dropped"] == cur
 
     def test_sync_compile_histogram_observed(self, tk):
         tk.must_exec("set tidb_executor_engine = 'tpu'")

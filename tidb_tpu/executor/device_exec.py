@@ -109,11 +109,18 @@ def _run_device_dispatch(ctx, fn, args, kw, shape, batch_key):
     fabric dedup leader's compute path, and the whole of run_device
     outside a fleet."""
     from ..errors import DeviceAdmissionError
+    from ..fabric import perf as fabric_perf
     from ..session import tracing
     from . import scheduler
     group = scheduler.resource_group(ctx)
     scheduler.attach(ctx)
+    # shared fragment-perf store feed (fabric/perf.py): this dispatch's
+    # admission wait, sync-compile share and device wall time accumulate
+    # under the fragment's (sig, bucket) — fleet-mergeable observe-only
+    # data, buffered locally and flushed off the hot path
+    psig, pbucket = fabric_perf.dispatch_key(batch_key, shape)
     with tracing.span("device.dispatch", shape=shape, group=group):
+        ta0 = _time.perf_counter()
         try:
             ticket = scheduler.admit(ctx, shape=shape, batch_key=batch_key)
         except DeviceAdmissionError as e:
@@ -126,7 +133,13 @@ def _run_device_dispatch(ctx, fn, args, kw, shape, batch_key):
                 f"device admission refused for {shape} fragment "
                 f"(resource group '{group}'; degraded to host engine): "
                 f"{e}") from e
+        finally:
+            # refusals contribute too: the timeout wait a refused
+            # fragment paid is exactly the tail this series exists for
+            fabric_perf.note(psig, pbucket, "device", "admission_wait",
+                             _time.perf_counter() - ta0)
         t0 = _time.perf_counter()
+        c0 = _tls_stats()["compile_s"]
         try:
             return _run_device_admitted(ctx, fn, args, kw, shape, group)
         finally:
@@ -136,10 +149,17 @@ def _run_device_dispatch(ctx, fn, args, kw, shape, batch_key):
             # finally so FAILED dispatches (supervisor-deadline hangs,
             # post-OOM degrades) contribute too; the pathological
             # latencies are exactly the p99 this series exists to show
+            dt = _time.perf_counter() - t0
+            # the TLS pipe-stats mirror attributes exactly this thread's
+            # sync-compile seconds to this dispatch (concurrent sessions
+            # can't cross-charge — same contract as pipe_cache_stats)
+            dc = _tls_stats()["compile_s"] - c0
+            if dc > 0:
+                fabric_perf.note(psig, pbucket, "device", "compile", dc)
+            fabric_perf.note(psig, pbucket, "device", "dispatch", dt)
             obs = getattr(getattr(ctx, "domain", None), "observe", None)
             if obs is not None and hasattr(obs, "observe_hist"):
-                obs.observe_hist("device_dispatch_seconds",
-                                 _time.perf_counter() - t0)
+                obs.observe_hist("device_dispatch_seconds", dt)
 
 
 def _run_device_admitted(ctx, fn, args, kw, shape, group):

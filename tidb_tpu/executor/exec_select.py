@@ -572,6 +572,13 @@ class HashAggExec(QueryExecutor):
             except DeviceUnsupported:
                 pass
         want = raw is not None and want_device(self.ctx, raw.num_rows)
+        # fragment identity for admission batching AND the shared perf
+        # store: computed once here so the device dispatches, the host
+        # tail's timing and the EXPLAIN fleet line all key the same rows
+        from .device_exec import agg_batch_key
+        bkey = (agg_batch_key(eff_p, conds, raw.num_rows, self.ctx)
+                if raw is not None else None)
+        self._perf_bkey = bkey
         if raw is not None and engine_mode(self.ctx) == "auto":
             # the cost DP priced host-vs-device placement for this agg
             # from the calibrated constants; in auto mode its choice
@@ -601,11 +608,6 @@ class HashAggExec(QueryExecutor):
                     batch = DEFAULT_PAGE_ROWS
             elif batch < 0:
                 batch = DEFAULT_PAGE_ROWS if paged_in else 0
-            # admission-batching identity: concurrent same-shaped agg
-            # fragments coalesce onto one scheduler slot and re-dispatch
-            # the shared compiled pipeline (executor/scheduler.py)
-            from .device_exec import agg_batch_key
-            bkey = agg_batch_key(eff_p, conds, raw.num_rows, self.ctx)
             if batch > 0 and (paged_in or raw.num_rows > batch):
                 from .device_exec import device_agg_streaming
                 try:
@@ -660,6 +662,8 @@ class HashAggExec(QueryExecutor):
                 return out
             except DeviceUnsupported:
                 pass
+        import time as _t
+        t_host = _t.perf_counter()
         if raw is not None and eff_p is p:
             # reuse the materialized chunk on the host path (only valid
             # when no projection was inlined: self.plan's expressions are
@@ -670,7 +674,16 @@ class HashAggExec(QueryExecutor):
                 chunk = chunk.filter(eval_conds_mask(conds, chunk))
         else:
             chunk = self.children[0].execute()
-        return self._execute_host_spillable(chunk)
+        out = self._execute_host_spillable(chunk)
+        if bkey is not None:
+            # the host-side dispatch row for this fragment: the same
+            # (sig, bucket) key as its device dispatches, so the perf
+            # store can rank device vs host for the SAME fragment —
+            # whether the host ran it by choice or as a fallback
+            from ..fabric import perf as fabric_perf
+            fabric_perf.note(*fabric_perf.dispatch_key(bkey), "host",
+                             "dispatch", _t.perf_counter() - t_host)
+        return out
 
     #: hash partitions for the quota-pressure spill path (reference:
     #: executor/aggregate.go parallel agg spill, util/chunk/disk.go:34)
@@ -718,6 +731,16 @@ class HashAggExec(QueryExecutor):
         if self.stats is None:
             return
         self.annotate(engine=engine)
+        bkey = getattr(self, "_perf_bkey", None)
+        if bkey is not None:
+            # fleet perf line (ISSUE 18, observe-only): what the WHOLE
+            # fleet has seen for this fragment — "fleet: n=…, device
+            # p50/p99 …, host p50/p99 …" — next to this run's engine
+            from ..fabric import perf as fabric_perf
+            line = fabric_perf.describe(
+                fabric_perf.lookup(*fabric_perf.dispatch_key(bkey)))
+            if line:
+                self.annotate(fleet_perf=f"fleet: {line}")
 
         def walk(p):
             for c in p.children:

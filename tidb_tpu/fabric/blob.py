@@ -19,6 +19,8 @@ from __future__ import annotations
 import os
 import tempfile
 
+from ..session import tracing
+
 
 class BlobError(Exception):
     """A blob-store operation failed (missing object, bad name)."""
@@ -62,6 +64,7 @@ class LocalDirBlobStore(BlobStore):
         return os.path.join(self.root, *name.split("/"))
 
     def put(self, name: str, data: bytes) -> None:
+        tracing.event("blob.put", blob=name, bytes=len(data))
         path = self._path(name)
         d = os.path.dirname(path)
         os.makedirs(d, exist_ok=True)
@@ -85,6 +88,7 @@ class LocalDirBlobStore(BlobStore):
             os.close(dirfd)
 
     def get(self, name: str) -> bytes:
+        tracing.event("blob.get", blob=name)
         try:
             with open(self._path(name), "rb") as f:
                 return f.read()

@@ -84,7 +84,11 @@ class CompileClient:
         taxonomy class ``compile``) on any transport/frame/server
         failure — the caller's breaker records exactly that class."""
         from ..errors import DeviceCompileError
+        from ..session import tracing
         from . import state
+        ctx = tracing.wire_ctx()
+        if ctx is not None:  # propagate the statement's trace across the hop
+            obj["trace"] = ctx
         t0 = time.perf_counter()
         try:
             with self._mu:  # one in-flight request per client: the
@@ -104,6 +108,10 @@ class CompileClient:
                 f"compile server {self.address} unreachable/torn: "
                 f"{type(e).__name__}: {e}") from e
         state.note_rtt((time.perf_counter() - t0) * 1000.0)
+        # stitch the server's recorded subtree (attached even on a
+        # server-side error reply: the failed hop still belongs in the
+        # statement's timeline)
+        tracing.attach_remote(resp.pop("_trace", None))
         if not resp.get("ok"):
             state.bump("fabric_remote_errors")
             raise DeviceCompileError(

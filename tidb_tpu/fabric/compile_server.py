@@ -181,12 +181,18 @@ class CompileServer:
     # -- per-connection loop -------------------------------------------------
 
     def _serve_conn(self, conn):
+        from ..session import tracing
         with contextlib.suppress(Exception), conn:
             while True:
                 try:
                     req = codec.read_frame(conn)
                 except codec.FrameError:
                     return  # torn frame / disconnect: drop the conn
+                # record this hop into OUR ring on behalf of the caller's
+                # trace (one branch when the request carries no context)
+                rtr = tracing.begin_remote(
+                    req.pop("trace", None),
+                    f"compile_server.{req.get('op', '?')}")
                 try:
                     resp = self._handle(req)
                 except Exception as e:  # noqa: BLE001 — reply, never die
@@ -194,6 +200,9 @@ class CompileServer:
                     log.warning("compile server: %s failed: %s",
                                 req.get("op"), e, exc_info=True)
                     resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                sub = tracing.finish_remote(rtr, succ=bool(resp.get("ok")))
+                if sub is not None:
+                    resp["_trace"] = sub
                 codec.write_frame(conn, resp)
                 if req.get("op") == "shutdown":
                     self.shutdown()
@@ -225,17 +234,21 @@ class CompileServer:
         call populates the shared AOT cache), persist the artifact +
         signature-index entry."""
         from jax import export
+        from ..session import tracing
         key_hash = req["key_hash"]
         with self._compile_lock:
             if key_hash in self._known:
                 # fleet-wide compile dedup: N workers racing the same
                 # cold signature pay ONE server compile
                 self.stats["dedup_served"] += 1
+                tracing.event("compile.dedup", key=key_hash[:12])
                 return {"ok": True, "compile_s": self._known[key_hash],
                         "dedup": True}
             t0 = time.perf_counter()
-            exp = export.deserialize(bytearray(req["module"]))
-            exp.call(*exported_zeros(exp))
+            with tracing.span("xla.compile", key=key_hash[:12],
+                              shape=req.get("shape", "")):
+                exp = export.deserialize(bytearray(req["module"]))
+                exp.call(*exported_zeros(exp))
             elapsed = time.perf_counter() - t0
             store_artifact(key_hash, bytes(req["module"]))
             _record_index(key_hash, req.get("shape", ""),
@@ -274,6 +287,8 @@ def main(argv=None) -> int:
                     help="unix socket path, or host:port")
     args = ap.parse_args(argv)
     import tidb_tpu  # noqa: F401 — x64 + the fingerprint-scoped AOT cache
+    from tidb_tpu.session import tracing
+    tracing.set_process_label("compile-server")
     srv = CompileServer(args.socket)
     print(json.dumps({"metric": "compile_server_ready",
                       "pid": os.getpid(), "address": args.socket,

@@ -92,7 +92,7 @@ from multiprocessing import shared_memory
 
 log = logging.getLogger("tidb_tpu.fabric.coord")
 
-MAGIC = b"TPUFAB3\0"
+MAGIC = b"TPUFAB4\0"
 
 #: segment geometry defaults (fixed at create; attach reads them from the
 #: coordinator file)
@@ -107,6 +107,11 @@ NREGIONS_DEFAULT = 0
 #: this simply stops version-stamping the overflow (cache-ineligible,
 #: never stale)
 NTABLEVERS_DEFAULT = 256
+#: fragment performance-store rows (ISSUE 18): keyed
+#: (fragment sig hash, row bucket, backend, duration kind); a full
+#: table drops the overflow (counted fabric_perf_dropped) — the store
+#: observes, it must never become a serving bottleneck or a leak
+NPERF_DEFAULT = 512
 
 #: fleet-global counter names, in segment order
 COUNTER_NAMES = (
@@ -121,6 +126,7 @@ COUNTER_NAMES = (
     "fabric_cache_delta_folds",    # hits served by folding the WAL delta
     "fabric_cache_stale_reads",    # version-stale pages caught at serve
     "fabric_admissions",        # device admissions granted fleet-wide
+    "fabric_perf_dropped",      # perf samples dropped (store full)
     "_result_id_seq",           # monotonic dedup result-page id
     "_tso",                     # fleet TSO high-water (batched leases)
     "_schema_ver",              # published schema version (schema lease)
@@ -147,8 +153,21 @@ _REG = struct.Struct("<QQdQQ")                           # epoch, owner+1,
 #                                                          committed_len,
 #                                                          applied_lsn
 _TVER = struct.Struct("<QQ")                             # table_id, version_ts
+#: perf-store row: sig_hash, bucket, backend, kind, count, sum_s, max_s,
+#: 16-bucket log2 duration sketch.  A row is FREE iff count == 0.
+#: Crash-safety is by construction, not by reclaim: every update is one
+#: commutative merge under the segment lock (no per-slot intermediate
+#: state a dead worker could leak — unlike running counts, a crashed
+#: worker's already-merged samples are real measurements and stay)
+_PERF = struct.Struct("<QIIIQdd16I")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+#: perf sketch geometry: bucket i counts durations <= PERF_BASE_S * 2**i
+#: (i = 15 is the +Inf tail).  100µs .. ~3.3s in 16 power-of-two steps —
+#: spans admission waits through live-TPU compiles
+PERF_BASE_S = 1e-4
+PERF_SKETCH_N = 16
 
 _NAME_SZ = 40
 
@@ -166,6 +185,7 @@ class Coordinator:
         self.nlocks = meta.get("nlocks", NLOCKS_DEFAULT)
         self.nregions = meta.get("nregions", NREGIONS_DEFAULT)
         self.ntablevers = meta.get("ntablevers", NTABLEVERS_DEFAULT)
+        self.nperf = meta.get("nperf", NPERF_DEFAULT)
         self.pages_dir = meta["pages_dir"]
         self._created = created
         self._tlock = threading.Lock()
@@ -180,7 +200,11 @@ class Coordinator:
         self._o_locks = self._o_dedup + self.ndedup * _DED.size
         self._o_regions = self._o_locks + self.nlocks * _LCK.size
         self._o_tvers = self._o_regions + self.nregions * _REG.size
-        self.size = self._o_tvers + self.ntablevers * _TVER.size
+        # per-slot direct-port cells (u64): each worker publishes its
+        # diagnostics door so peers can fan cluster memtables out to it
+        self._o_ports = self._o_tvers + self.ntablevers * _TVER.size
+        self._o_perf = self._o_ports + self.nslots * 8
+        self.size = self._o_perf + self.nperf * _PERF.size
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -191,6 +215,7 @@ class Coordinator:
                nlocks: int = NLOCKS_DEFAULT,
                nregions: int = NREGIONS_DEFAULT,
                ntablevers: int = NTABLEVERS_DEFAULT,
+               nperf: int = NPERF_DEFAULT,
                pages_dir: "str | None" = None) -> "Coordinator":
         """Create the segment + coordinator file (the fleet parent)."""
         if pages_dir is None:
@@ -199,12 +224,13 @@ class Coordinator:
         name = f"tpufab-{os.getpid()}-{secrets.token_hex(4)}"
         meta = {"segment": name, "nslots": nslots, "ntenants": ntenants,
                 "ndedup": ndedup, "nlocks": nlocks, "nregions": nregions,
-                "ntablevers": ntablevers,
+                "ntablevers": ntablevers, "nperf": nperf,
                 "pages_dir": pages_dir, "created": time.time()}
         size = (_HDR.size + 8 * len(COUNTER_NAMES) + nslots * _SLOT.size
                 + ntenants * (_TEN_FIXED.size + 12 * nslots)
                 + ndedup * _DED.size + nlocks * _LCK.size
-                + nregions * _REG.size + ntablevers * _TVER.size)
+                + nregions * _REG.size + ntablevers * _TVER.size
+                + nslots * 8 + nperf * _PERF.size)
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         _untrack(shm)
         shm.buf[:size] = b"\0" * size
@@ -303,6 +329,7 @@ class Coordinator:
             off = self._slot_off(slot)
             _pid, _lease, gen, _mrt, _wa = _SLOT.unpack_from(self._buf, off)
             self._zero_slot_columns_locked(slot)
+            _U64.pack_into(self._buf, self._o_ports + 8 * slot, 0)
             _SLOT.pack_into(self._buf, off, pid, time.time(), gen + 1, 0, 0)
 
     def heartbeat(self, slot: int):
@@ -317,6 +344,7 @@ class Coordinator:
         """Clean worker exit: drop the lease and every per-slot count."""
         with self._locked():
             self._zero_slot_columns_locked(slot)
+            _U64.pack_into(self._buf, self._o_ports + 8 * slot, 0)
             _SLOT.pack_into(self._buf, self._slot_off(slot), 0, 0.0, 0,
                             0, 0)
 
@@ -329,6 +357,32 @@ class Coordinator:
                     self._buf, self._slot_off(s))[:2]
                 if pid and now - lease <= lease_timeout_s:
                     out.append(s)
+            return out
+
+    def set_direct_port(self, slot: int, port: int):
+        """Publish a worker's DIRECT (per-process) wire port — the
+        diagnostics door cluster memtables fan out to.  Zeroed whenever
+        the slot's lease drops (release/reclaim/re-claim): a dead
+        worker's port must read as absent, never as a connectable peer."""
+        with self._locked():
+            self._slot_off(slot)  # range check
+            _U64.pack_into(self._buf, self._o_ports + 8 * slot, int(port))
+
+    def direct_ports(self, lease_timeout_s: float = 2.0) -> dict:
+        """{slot: direct_port} for every LIVE slot that has published
+        one (a worker between claim and publish is simply absent)."""
+        now = time.time()
+        with self._locked():
+            out = {}
+            for s in range(self.nslots):
+                pid, lease = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))[:2]
+                if not pid or now - lease > lease_timeout_s:
+                    continue
+                port = _U64.unpack_from(self._buf,
+                                        self._o_ports + 8 * s)[0]
+                if port:
+                    out[s] = port
             return out
 
     def reclaim_expired(self, lease_timeout_s: float = 2.0) -> int:
@@ -344,6 +398,7 @@ class Coordinator:
                 pid, lease = _SLOT.unpack_from(self._buf, off)[:2]
                 if pid and now - lease > lease_timeout_s:
                     self._zero_slot_columns_locked(s)
+                    _U64.pack_into(self._buf, self._o_ports + 8 * s, 0)
                     _SLOT.pack_into(self._buf, off, 0, 0.0, 0, 0, 0)
                     self._bump_locked("fabric_lease_reclaims")
                     n += 1
@@ -841,7 +896,8 @@ class Coordinator:
     VERSIONED_EVICT_S = 120.0
 
     def dedup_claim(self, key_hash: bytes, ttl_s: float,
-                    vv_hash: int = 0, check_vv: bool = True) -> tuple:
+                    vv_hash: int = 0, check_vv: bool = True,
+                    owner: "int | None" = None) -> tuple:
         """Claim or join the result-cache slot for `key_hash` (16 bytes).
 
         ``vv_hash`` is the claimant's version-vector hash (0 = plain
@@ -859,6 +915,10 @@ class Coordinator:
         skips that check; the page-level verify downstream must catch it).
         """
         now = time.time()
+        # net workers pass their slot explicitly: the server-side
+        # Coordinator instance is shared by every TCP client, so
+        # set_claim_owner's instance attribute cannot carry their identity
+        who = self._claim_owner if owner is None else int(owner)
         with self._locked():
             free = -1
             for i in range(self.ndedup):
@@ -872,7 +932,7 @@ class Coordinator:
                         # leader died mid-build: take the slot over (a
                         # kept old page rides along for the delta fold)
                         _DED.pack_into(self._buf, off, key_hash, DBUILDING,
-                                       self._claim_owner, now, rid, vv)
+                                       who, now, rid, vv)
                         self._bump_locked("fabric_dedup_leads")
                         if rid and vv and vv_hash:
                             return ("lead_delta", i, rid)
@@ -886,7 +946,7 @@ class Coordinator:
                             self._bump_locked("fabric_cache_invalidations")
                             self._bump_locked("fabric_dedup_leads")
                             _DED.pack_into(self._buf, off, key_hash,
-                                           DBUILDING, self._claim_owner,
+                                           DBUILDING, who,
                                            now, rid, vv)
                             return ("lead_delta", i, rid)
                         self._bump_locked("fabric_dedup_hits")
@@ -898,7 +958,7 @@ class Coordinator:
                     # behind are unbounded disk growth)
                     self._unlink_page(rid)
                     _DED.pack_into(self._buf, off, key_hash, DBUILDING,
-                                   self._claim_owner, now, 0, 0)
+                                   who, now, 0, 0)
                     self._bump_locked("fabric_dedup_leads")
                     return ("lead", i, 0)
                 if free < 0 and (state == DFREE
@@ -913,7 +973,7 @@ class Coordinator:
             old_rid = _DED.unpack_from(self._buf, off)[4]
             self._unlink_page(old_rid)  # the reused slot's expired page
             _DED.pack_into(self._buf, off, key_hash,
-                           DBUILDING, self._claim_owner, now, 0, 0)
+                           DBUILDING, who, now, 0, 0)
             self._bump_locked("fabric_dedup_leads")
             return ("lead", free, 0)
 
@@ -997,6 +1057,85 @@ class Coordinator:
             return False
         return True  # table full: warm locally rather than skip
 
+    # -- fragment performance store (ISSUE 18, observe-only) ------------------
+
+    def _perf_off(self, i: int) -> int:
+        return self._o_perf + i * _PERF.size
+
+    def perf_merge(self, rows) -> int:
+        """Merge worker-local span-duration accumulators into the fleet
+        store.  ``rows`` is a list of
+        ``(sig_hash, bucket, backend, kind, count, sum_s, max_s, sketch)``
+        deltas (sketch: PERF_SKETCH_N ints).  Linear probe by the 4-part
+        key; a full table drops the row (counted fabric_perf_dropped).
+        Returns rows merged.  Merge-only commutative math — there is no
+        per-slot state here to crash-reclaim (see _PERF)."""
+        if not self.nperf:
+            return 0
+        merged = 0
+        with self._locked():
+            for sig, bucket, backend, kind, cnt, s, mx, sketch in rows:
+                if cnt <= 0:
+                    continue
+                key = (int(sig) & (2**64 - 1), int(bucket),
+                       int(backend), int(kind))
+                free = -1
+                for i in range(self.nperf):
+                    off = self._perf_off(i)
+                    row = _PERF.unpack_from(self._buf, off)
+                    if row[4] == 0:  # free (count == 0)
+                        if free < 0:
+                            free = i
+                        continue
+                    if row[:4] == key:
+                        new_sketch = [a + b for a, b in
+                                      zip(row[7:], sketch)]
+                        _PERF.pack_into(
+                            self._buf, off, *key, row[4] + int(cnt),
+                            row[5] + float(s), max(row[6], float(mx)),
+                            *new_sketch)
+                        merged += 1
+                        break
+                else:
+                    if free >= 0:
+                        _PERF.pack_into(
+                            self._buf, self._perf_off(free), *key,
+                            int(cnt), float(s), float(mx),
+                            *[int(x) for x in sketch])
+                        merged += 1
+                    else:
+                        self._bump_locked("fabric_perf_dropped", int(cnt))
+        return merged
+
+    def perf_rows(self) -> list:
+        """Every live perf row as a dict — the
+        information_schema.tidb_fragment_perf / /status feed."""
+        out = []
+        with self._locked():
+            for i in range(self.nperf):
+                row = _PERF.unpack_from(self._buf, self._perf_off(i))
+                if row[4] == 0:
+                    continue
+                out.append({"sig_hash": row[0], "bucket": row[1],
+                            "backend": row[2], "kind": row[3],
+                            "count": row[4], "sum_s": row[5],
+                            "max_s": row[6], "sketch": list(row[7:])})
+        return out
+
+    def perf_lookup(self, sig_hash: int, bucket: int) -> list:
+        """The perf rows for one (fragment sig, bucket) — what EXPLAIN
+        ANALYZE renders as the fleet line."""
+        want = (int(sig_hash) & (2**64 - 1), int(bucket))
+        out = []
+        with self._locked():
+            for i in range(self.nperf):
+                row = _PERF.unpack_from(self._buf, self._perf_off(i))
+                if row[4] and row[0] == want[0] and row[1] == want[1]:
+                    out.append({"backend": row[2], "kind": row[3],
+                                "count": row[4], "sum_s": row[5],
+                                "max_s": row[6], "sketch": list(row[7:])})
+        return out
+
     # -- introspection / drain ------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -1045,9 +1184,16 @@ class Coordinator:
                                 "owner": owner_p1 - 1,
                                 "committed_len": clen,
                                 "applied_lsn": alsn})
+            perf_rows_used = perf_samples = 0
+            for i in range(self.nperf):
+                row = _PERF.unpack_from(self._buf, self._perf_off(i))
+                if row[4]:
+                    perf_rows_used += 1
+                    perf_samples += row[4]
         return {"slots": slots, "tenants": tenants,
                 "dedup_building": building, "held_locks": held_locks,
-                "regions": regions, **ctrs}
+                "regions": regions, "perf_rows_used": perf_rows_used,
+                "perf_samples": perf_samples, **ctrs}
 
     def verify_drained(self) -> dict:
         """Fleet drain invariant (the cross-process analog of

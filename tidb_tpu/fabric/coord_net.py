@@ -33,6 +33,7 @@ import threading
 import time
 
 from . import codec
+from ..session import tracing
 from ..utils.backoff import Backoffer, BackoffExhaustedError
 
 log = logging.getLogger("tidb_tpu.fabric.coord_net")
@@ -63,8 +64,10 @@ OPS = frozenset({
     "region_committed_len", "region_set_applied", "region_info",
     "regions_expired", "region_owners",
     "dedup_claim", "dedup_publish", "dedup_fail", "dedup_poll",
-    "next_result_id", "prewarm_claim",
+    "next_result_id", "prewarm_claim", "result_page_path",
     "table_version_advance", "table_versions",
+    "set_direct_port", "direct_ports",
+    "perf_merge", "perf_rows", "perf_lookup",
     "snapshot", "verify_drained",
 })
 
@@ -94,6 +97,18 @@ _DEGRADE = {
     # which degrades to plain in-flight dedup, never to a stale hit
     "table_version_advance": lambda args, kwargs: None,
     "table_versions": lambda args, kwargs: {},
+    # observability during a down-window: perf samples drop (observe-
+    # only data, recomputed forever), peer discovery answers empty (a
+    # cluster memtable degrades to local rows, never a failed query)
+    "set_direct_port": lambda args, kwargs: None,
+    "direct_ports": lambda args, kwargs: {},
+    "perf_merge": lambda args, kwargs: 0,
+    "perf_rows": lambda args, kwargs: [],
+    "perf_lookup": lambda args, kwargs: [],
+    # dedup during a down-window: "miss" is the solo answer — compute
+    # locally, no claim held, nothing to publish or leak
+    "dedup_claim": lambda args, kwargs: ("miss", -1, 0),
+    "prewarm_claim": lambda args, kwargs: True,
 }
 
 
@@ -123,6 +138,10 @@ class _Handler(socketserver.BaseRequestHandler):
             except OSError:
                 return
             op = req.get("op")
+            # record the hop into THIS process's ring on the caller's
+            # behalf (one branch for untraced requests)
+            rtr = tracing.begin_remote(req.pop("trace", None),
+                                       f"coord.{op}")
             if op not in OPS:
                 resp = {"ok": False, "err": f"op {op!r} not allowed"}
             else:
@@ -134,6 +153,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     #   wire by type name; the client re-raises loudly
                     resp = {"ok": False, "err": f"{type(e).__name__}: {e}",
                             "err_type": type(e).__name__}
+            sub = tracing.finish_remote(rtr, succ=bool(resp.get("ok")))
+            if sub is not None:
+                resp["_trace"] = sub
             try:
                 codec.write_frame(sock, resp)
             except OSError:
@@ -208,15 +230,21 @@ class NetCoordinator:
                                         timeout=CONNECT_TIMEOUT_S)
 
     def _roundtrip(self, req: dict):
+        ctx = tracing.wire_ctx()
+        if ctx is not None:  # propagate the active trace across the hop
+            req["trace"] = ctx
         with self._mu:
             sock = self._connect()
             try:
                 sock.settimeout(REQUEST_TIMEOUT_S)
                 codec.write_frame(sock, req)
-                return codec.read_frame(sock)
+                resp = codec.read_frame(sock)
             finally:
                 with contextlib.suppress(OSError):
                     sock.close()
+        # graft the coordinator's recorded subtree under the current span
+        tracing.attach_remote(resp.pop("_trace", None))
+        return resp
 
     def _call(self, op: str, *args, **kwargs):
         req = {"op": op, "args": args, "kwargs": kwargs}
@@ -251,6 +279,22 @@ class NetCoordinator:
             raise CoordRemoteError(resp.get("err", "unknown error"),
                                    resp.get("err_type"))
         return resp.get("ret")
+
+    #: dedup-claim owner slot (fabric/state.activate).  The server-side
+    #: Coordinator instance is SHARED by every TCP client, so claim
+    #: ownership cannot live in its instance attribute: remember the
+    #: slot here and stamp it onto each dedup_claim request instead —
+    #: crash reclaim needs the true owner on every claimed entry
+    _owner_slot: "int | None" = None
+
+    def set_claim_owner(self, slot: int):
+        self._owner_slot = int(slot)
+
+    def dedup_claim(self, key_hash, ttl_s, vv_hash: int = 0,
+                    check_vv: bool = True):
+        return self._call("dedup_claim", key_hash, ttl_s,
+                          vv_hash=vv_hash, check_vv=check_vv,
+                          owner=self._owner_slot)
 
     def __getattr__(self, name):
         if name.startswith("_") or name not in OPS:

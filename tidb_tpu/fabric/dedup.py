@@ -207,7 +207,8 @@ class Dedup:
                 state.bump("fabric_dedup_hits")
                 state.bump("cache_hits")
                 tracing.event("fabric.cache", role="hit",
-                              slot=self._slot)
+                              slot=self._slot,
+                              **_leader_tag(payload))
                 return ("hit", payload)
             return ("none", None)
         if kind == "wait":
@@ -218,7 +219,8 @@ class Dedup:
                 state.bump("fabric_dedup_hits")
                 state.bump("cache_hits")
                 tracing.event("fabric.cache", role="wait_hit",
-                              slot=self._slot)
+                              slot=self._slot,
+                              **_leader_tag(payload))
                 return ("hit", payload)
             state.bump("fabric_dedup_timeouts")
             return ("none", None)
@@ -258,7 +260,18 @@ class Dedup:
                           payload: dict, vv_hash: int) -> bool:
         """Publish a version-stamped page ``{"chunk":, "vv":,
         "partial":}`` under an owned claim.  False → the slot was freed
-        (waiters compute locally) and nothing was cached."""
+        (waiters compute locally) and nothing was cached.
+
+        The page is stamped with the publishing statement's trace
+        context (when one is active): a follower's hit on another
+        worker's page names the LEADER's fleet-global trace id in its
+        own timeline — the publisher→follower half of cross-process
+        stitching (there is no RPC response to piggyback on here; the
+        page itself is the message)."""
+        from ..session import tracing
+        ctx = tracing.wire_ctx()
+        if ctx is not None:
+            payload = {**payload, "trace": ctx}
         try:
             blob = pickle.dumps(payload, protocol=4)
         except Exception as e:  # noqa: BLE001 — unshippable payload
@@ -333,6 +346,7 @@ class Dedup:
             return None
 
     def _wait(self, ctx, idx: int, key_hash: bytes):
+        from ..session import tracing
         check = getattr(ctx, "check_killed", None)
         deadline = time.monotonic() + WAIT_S
         while time.monotonic() < deadline:
@@ -340,11 +354,28 @@ class Dedup:
             if st == "done":
                 return self._load(rid)
             if st == "gone":
+                # the leader died mid-build (lease reclaim freed the
+                # slot): the hop lands in the trace as a PEER-LOST
+                # marker, never a hang or a silently dropped wait
+                tracing.event("fabric.dedup", status="peer-lost",
+                              slot=self._slot)
                 return None
             if check is not None:
                 check()
             time.sleep(POLL_S)
         return None
+
+
+def _leader_tag(payload) -> dict:
+    """Event tags naming the worker/trace that PUBLISHED a served page
+    (empty when the leader ran unsampled)."""
+    t = payload.get("trace") if isinstance(payload, dict) else None
+    if not isinstance(t, dict) or not t.get("gid"):
+        return {}
+    out = {"leader_gid": t["gid"]}
+    if t.get("proc"):
+        out["leader"] = t["proc"]
+    return out
 
 
 def _col_est_bytes(col) -> int:

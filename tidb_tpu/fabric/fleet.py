@@ -83,7 +83,8 @@ class Fleet:
                  slot_env: "dict | None" = None,
                  durable: bool = True,
                  hosts: int = 1,
-                 nregions: int = 0):
+                 nregions: int = 0,
+                 net_coord: bool = False):
         """`init`: a "module:callable" data-seeding hook — under the
         durable store (the default) it runs ONCE fleet-wide (the first
         worker seeds, the rest replay the shared log); with
@@ -95,7 +96,12 @@ class Fleet:
         ``{2: {"TIDB_TPU_FABRIC_FAILPOINTS": "fabric-kill-worker=1*return(1)"}}``).
         `hosts`: partition workers into this many per-host process
         groups (1 = the classic single-host fleet, no extra groups).
-        `nregions`: region cells to allocate in the segment."""
+        `nregions`: region cells to allocate in the segment.
+        `net_coord`: serve the segment over a CoordServer and point the
+        workers at it (TIDB_TPU_FABRIC_COORD_ADDR) — every coordinator
+        op becomes a traced TCP hop into the parent process, the
+        topology the distributed-trace stitching bench asserts on.  The
+        parent keeps its direct segment handle either way."""
         self.procs = procs
         self.hosts = max(int(hosts), 1)
         self.nregions = int(nregions)
@@ -110,6 +116,9 @@ class Fleet:
                          (slot_env or {}).items()}
         self.slots = [_Slot(i) for i in range(procs)]
         self.lines: list = []      # non-protocol worker stdout lines
+        self.net_coord = bool(net_coord)
+        self.coord_server = None
+        self.coord_addr = ""
         self.coord: "Coordinator | None" = None
         self.compile_server_proc = None
         self.compile_server_addr = ""
@@ -127,6 +136,10 @@ class Fleet:
         self.coord = Coordinator.create(
             os.path.join(self.run_dir, "coord.json"),
             nslots=max(self.procs, 2), nregions=self.nregions)
+        if self.net_coord:
+            from .coord_net import CoordServer
+            self.coord_server = CoordServer(self.coord)
+            self.coord_addr = self.coord_server.start()
         self._reserve_port()
         if self.with_compile_server:
             self._spawn_compile_server(timeout_s)
@@ -204,6 +217,8 @@ class Fleet:
     def _spawn(self, s: _Slot):
         env = self._base_env()
         env["TIDB_TPU_FABRIC_COORD"] = self.coord.path
+        if self.coord_addr:
+            env["TIDB_TPU_FABRIC_COORD_ADDR"] = self.coord_addr
         env["TIDB_TPU_FABRIC_SLOT"] = str(s.idx)
         env["TIDB_TPU_FABRIC_PORT"] = str(self.port)
         if self.durable:
@@ -430,6 +445,9 @@ class Fleet:
         if self._reserve_sock is not None:
             with _suppress():
                 self._reserve_sock.close()
+        if self.coord_server is not None:
+            with _suppress():
+                self.coord_server.stop()
         with _suppress():
             self.coord.unlink()
         return self.final_drained

@@ -1,0 +1,264 @@
+"""Worker side of the fleet fragment performance store (ISSUE 18).
+
+The coordination segment holds the fleet accumulators
+(fabric/coord.py PERF section: count / sum / max / log2 duration sketch
+per ``(fragment sig, row bucket, backend, duration kind)``).  This
+module is everything around it:
+
+* :func:`note` — the chokepoint feed.  Each timed span at a dispatch
+  chokepoint (sync compile, admission wait, device dispatch, host
+  fallback) adds its duration to a PROCESS-LOCAL buffer: one small dict
+  update under a local lock, no segment round trip on the hot path.
+* :func:`flush` — drains the buffer into the segment (one locked merge
+  for all rows), driven by the worker heartbeat.  Outside a fleet the
+  buffer drains into the local mirror only — the single-process
+  deployment keeps the same EXPLAIN/memtable surface over its own
+  samples.
+* :func:`lookup` / :func:`fleet_rows` — the read side EXPLAIN ANALYZE,
+  ``/status`` and ``information_schema.tidb_fragment_perf`` render.
+* :func:`percentile` — sketch → seconds.  The sketch is 16 power-of-two
+  buckets over ``coord.PERF_BASE_S``; a percentile answers with the
+  bucket's upper bound, so p50/p99 are ~2× granular — plenty to rank
+  device vs host, which is all ROADMAP item 4 will ask of it.
+
+Observe-only by design: nothing in this module makes or influences a
+routing decision.  The numbers a future cost-based router will use
+become visible and regression-tested first.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+
+from .coord import PERF_BASE_S, PERF_SKETCH_N
+
+log = logging.getLogger("tidb_tpu.fabric.perf")
+
+#: duration kinds, in segment-encoding order
+KINDS = ("compile", "admission_wait", "dispatch")
+#: backends, in segment-encoding order.  Host fallback is
+#: (backend="host", kind="dispatch") — the same fragment's host and
+#: device dispatch rows sit side by side, which is exactly the
+#: comparison EXPLAIN ANALYZE renders
+BACKENDS = ("device", "host")
+
+_LOCK = threading.Lock()
+#: pending deltas: key -> [count, sum_s, max_s, sketch list]
+_BUF: dict = {}
+#: process-local cumulative mirror (same row shape the segment serves):
+#: the read surface outside a fleet, and the "this worker's share"
+#: column next to the fleet aggregate inside one
+_LOCAL: dict = {}
+
+STATS = {
+    "perf_notes": 0,     # samples recorded at chokepoints
+    "perf_flushes": 0,   # buffer drains (heartbeat-driven)
+    "perf_merged": 0,    # rows merged into the segment
+}
+
+
+def sig_hash(sig) -> int:
+    """64-bit stable hash of a fragment signature (any repr-able key —
+    callers pass the compiled-pipeline batch key's structural prefix)."""
+    if isinstance(sig, int):
+        return sig & (2**64 - 1)
+    return int.from_bytes(
+        hashlib.blake2b(repr(sig).encode(), digest_size=8).digest(),
+        "little")
+
+
+def dispatch_key(batch_key, shape: str = "agg"):
+    """(sig, bucket) for the perf store from a dispatch site's admission
+    batch key: the structural prefix hashes to the fragment sig, the
+    trailing row bucket (device_exec.agg_batch_key's last element) is
+    the bucket.  Batch-key-less dispatches key by fragment shape —
+    coarser, but every dispatch still lands in the store."""
+    if (isinstance(batch_key, tuple) and batch_key
+            and isinstance(batch_key[-1], int)):
+        return sig_hash(batch_key[:-1]), batch_key[-1]
+    if batch_key is not None:
+        return sig_hash(batch_key), 0
+    return sig_hash(("shape", shape)), 0
+
+
+def sketch_bucket(dur_s: float) -> int:
+    """The sketch bucket a duration lands in: bucket i counts durations
+    <= PERF_BASE_S * 2**i (the last bucket is the +Inf tail)."""
+    edge = PERF_BASE_S
+    for i in range(PERF_SKETCH_N - 1):
+        if dur_s <= edge:
+            return i
+        edge *= 2.0
+    return PERF_SKETCH_N - 1
+
+
+def percentile(sketch, count: int, q: float) -> "float | None":
+    """The q-quantile (0..1) upper-bound in seconds, or None when the
+    sketch is empty."""
+    if count <= 0:
+        return None
+    rank = max(1, int(q * count + 0.999999))
+    seen = 0
+    for i, c in enumerate(sketch):
+        seen += c
+        if seen >= rank:
+            return PERF_BASE_S * (2.0 ** i)
+    return PERF_BASE_S * (2.0 ** (PERF_SKETCH_N - 1))
+
+
+def note(sig, bucket: int, backend: str, kind: str, dur_s: float):
+    """Record one span duration.  Hot-path cost: one hash + one dict
+    update under the process-local lock — the segment is never touched
+    here (flush() batches that)."""
+    try:
+        key = (sig_hash(sig), int(bucket) & (2**32 - 1),
+               BACKENDS.index(backend), KINDS.index(kind))
+    except ValueError:
+        log.debug("perf.note: unknown backend/kind (%s, %s)", backend,
+                  kind)
+        return
+    d = float(dur_s)
+    sb = sketch_bucket(d)
+    with _LOCK:
+        STATS["perf_notes"] += 1
+        for table in (_BUF, _LOCAL):
+            row = table.get(key)
+            if row is None:
+                row = table[key] = [0, 0.0, 0.0, [0] * PERF_SKETCH_N]
+            row[0] += 1
+            row[1] += d
+            row[2] = max(row[2], d)
+            row[3][sb] += 1
+
+
+def flush() -> int:
+    """Drain the buffer into the segment (when a fleet is active).
+    Heartbeat-driven; never raises — a coordinator blip drops this
+    beat's deltas back into the buffer for the next one."""
+    from . import state
+    with _LOCK:
+        if not _BUF:
+            return 0
+        pending = dict(_BUF)
+        _BUF.clear()
+        STATS["perf_flushes"] += 1
+    coord = state.coordinator()
+    if coord is None:
+        return 0  # local-only deployment: the _LOCAL mirror is the store
+    rows = [(k[0], k[1], k[2], k[3], r[0], r[1], r[2], r[3])
+            for k, r in pending.items()]
+    try:
+        n = coord.perf_merge(rows)
+    except Exception as e:  # noqa: BLE001 — observe-only: drop back
+        log.debug("perf flush failed (rebuffering): %s", e)
+        with _LOCK:
+            for k, r in pending.items():
+                row = _BUF.get(k)
+                if row is None:
+                    _BUF[k] = r
+                else:
+                    row[0] += r[0]
+                    row[1] += r[1]
+                    row[2] = max(row[2], r[2])
+                    row[3] = [a + b for a, b in zip(row[3], r[3])]
+        return 0
+    with _LOCK:
+        STATS["perf_merged"] += n
+    return n
+
+
+def _rows_from(table: dict) -> list:
+    return [{"sig_hash": k[0], "bucket": k[1], "backend": k[2],
+             "kind": k[3], "count": r[0], "sum_s": r[1], "max_s": r[2],
+             "sketch": list(r[3])}
+            for k, r in sorted(table.items())]
+
+
+def local_rows() -> list:
+    """This process's cumulative samples (buffered + flushed)."""
+    with _LOCK:
+        return _rows_from(_LOCAL)
+
+
+def fleet_rows() -> list:
+    """The fleet store's rows — segment-backed inside a fleet, the
+    local mirror outside one (same shape either way)."""
+    from . import state
+    coord = state.coordinator()
+    if coord is not None:
+        try:
+            return coord.perf_rows()
+        except Exception as e:  # noqa: BLE001 — segment may be unlinked
+            log.debug("fleet perf rows unreadable: %s", e)
+    return local_rows()
+
+
+def lookup(sig, bucket: int) -> list:
+    """Perf rows for one (fragment sig, row bucket) — the EXPLAIN
+    ANALYZE fleet-line feed.  Flushes first so the asking statement's
+    own just-recorded samples are visible."""
+    flush()
+    h = sig_hash(sig)
+    from . import state
+    coord = state.coordinator()
+    if coord is not None:
+        try:
+            return coord.perf_lookup(h, int(bucket))
+        except Exception as e:  # noqa: BLE001
+            log.debug("fleet perf lookup failed: %s", e)
+    with _LOCK:
+        return [{"backend": k[2], "kind": k[3], "count": r[0],
+                 "sum_s": r[1], "max_s": r[2], "sketch": list(r[3])}
+                for k, r in sorted(_LOCAL.items())
+                if k[0] == h and k[1] == int(bucket)]
+
+
+def describe(rows) -> str:
+    """One EXPLAIN ANALYZE line from lookup() rows:
+    ``fleet: n=…, device p50/p99 …/…, host p50/p99 …/…`` (only the
+    backends that have dispatch samples appear)."""
+    parts = []
+    total = 0
+    for bi, bname in enumerate(BACKENDS):
+        agg = [r for r in rows
+               if r["backend"] == bi and r["kind"] == KINDS.index(
+                   "dispatch")]
+        if not agg:
+            continue
+        count = sum(r["count"] for r in agg)
+        sketch = [sum(r["sketch"][i] for r in agg)
+                  for i in range(PERF_SKETCH_N)]
+        total += count
+        p50 = percentile(sketch, count, 0.50)
+        p99 = percentile(sketch, count, 0.99)
+        parts.append(f"{bname} p50/p99 {_fmt(p50)}/{_fmt(p99)}")
+    if not parts:
+        return ""
+    return f"n={total}, " + ", ".join(parts)
+
+
+def _fmt(s: "float | None") -> str:
+    if s is None:
+        return "-"
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    return f"{s * 1e3:.1f}ms"
+
+
+def stats() -> dict:
+    """The /status ``device_perf_store`` payload."""
+    with _LOCK:
+        out = dict(STATS)
+        out["perf_local_rows"] = len(_LOCAL)
+        out["perf_buffered_rows"] = len(_BUF)
+    return out
+
+
+def reset_for_tests():
+    with _LOCK:
+        _BUF.clear()
+        _LOCAL.clear()
+        for k in STATS:
+            STATS[k] = 0

@@ -52,6 +52,7 @@ from bisect import bisect_right
 
 from ..kv import wal as wal_mod
 from ..kv.shared_store import DurableMVCCStore, SegmentTSOracle
+from ..session import tracing
 from ..utils.backoff import LeaseExpiredError
 
 log = logging.getLogger("tidb_tpu.fabric.region")
@@ -203,6 +204,10 @@ class RegionReplicator:
 
     def replicate(self, rid: int, wal: "wal_mod.WAL", epoch: int) -> dict:
         """Upload checkpoint + committed tail, then the MANIFEST."""
+        with tracing.span("region.replicate", region=rid, epoch=epoch):
+            return self._replicate(rid, wal, epoch)
+
+    def _replicate(self, rid: int, wal: "wal_mod.WAL", epoch: int) -> dict:
         pre = self._prefix(rid)
         ck_name = None
         ck_lsn = 0
@@ -236,18 +241,22 @@ class RegionReplicator:
         (from blob.get / the CRC check) rather than restoring a torn
         copy — recovery must never replay a log it cannot trust."""
         from .blob import BlobError
-        man = self.manifest(rid)
-        if man is None:
-            raise BlobError(f"region {rid}: no MANIFEST in blob store")
-        ck = self.blob.get(man["checkpoint"]) if man["checkpoint"] else None
-        tail = self.blob.get(man["tail"]) if man["tail"] else b""
-        if zlib.crc32(tail) != man["tail_crc"]:
-            raise BlobError(
-                f"region {rid}: tail CRC mismatch "
-                f"(manifest {man['tail_crc']}, blob {zlib.crc32(tail)})")
-        wal_mod.write_wal_files(dest_dir, man["base_lsn"], tail,
-                                checkpoint=ck)
-        return man
+        with tracing.span("region.restore", region=rid):
+            man = self.manifest(rid)
+            if man is None:
+                raise BlobError(f"region {rid}: no MANIFEST in blob store")
+            ck = (self.blob.get(man["checkpoint"])
+                  if man["checkpoint"] else None)
+            tail = self.blob.get(man["tail"]) if man["tail"] else b""
+            if zlib.crc32(tail) != man["tail_crc"]:
+                raise BlobError(
+                    f"region {rid}: tail CRC mismatch "
+                    f"(manifest {man['tail_crc']}, blob {zlib.crc32(tail)})")
+            tracing.event("region.restore.blobs", epoch=man["epoch"],
+                          bytes=len(tail) + (len(ck) if ck else 0))
+            wal_mod.write_wal_files(dest_dir, man["base_lsn"], tail,
+                                    checkpoint=ck)
+            return man
 
 
 # ---------------------------------------------------------------------------
@@ -297,23 +306,24 @@ class RegionStore:
         return claimed
 
     def _open_one(self, rid: int, *, restore: bool) -> bool:
-        epoch = self.coord.region_claim(rid, self.slot,
-                                        self.lease_timeout_s)
-        if not epoch:
-            return False  # a live foreign lease — not ours to take
-        rdir = wal_mod.region_dir(self.root, rid)
-        if restore and self._replicator is not None:
-            man = self._replicator.manifest(rid)
-            if man is not None:
-                self._replicator.restore(rid, rdir)
-        view = RegionCoordView(self.coord, rid, epoch)
-        w = wal_mod.WAL(rdir, coordinator=view)
-        st = DurableMVCCStore(w, coordinator=view, slot=self.slot,
-                              oracle=self.tso)
-        st.recover(defer_orphans=True)
-        self.stores[rid] = st
-        self.epochs[rid] = epoch
-        return True
+        with tracing.span("region.claim", region=rid, slot=self.slot):
+            epoch = self.coord.region_claim(rid, self.slot,
+                                            self.lease_timeout_s)
+            if not epoch:
+                return False  # a live foreign lease — not ours to take
+            rdir = wal_mod.region_dir(self.root, rid)
+            if restore and self._replicator is not None:
+                man = self._replicator.manifest(rid)
+                if man is not None:
+                    self._replicator.restore(rid, rdir)
+            view = RegionCoordView(self.coord, rid, epoch)
+            w = wal_mod.WAL(rdir, coordinator=view)
+            st = DurableMVCCStore(w, coordinator=view, slot=self.slot,
+                                  oracle=self.tso)
+            st.recover(defer_orphans=True)
+            self.stores[rid] = st
+            self.epochs[rid] = epoch
+            return True
 
     def _resolve_cross_region(self):
         """Percolator commit-point resolution across region logs: merge
@@ -362,7 +372,7 @@ class RegionStore:
         store (checkpoint + tail), replays, resolves orphans against
         the merged disposition map, resumes serving."""
         took = []
-        with self._mu:
+        with tracing.span("region.failover", slot=self.slot), self._mu:
             for rid in self.coord.regions_expired(self.lease_timeout_s):
                 if rid in self.stores:
                     continue

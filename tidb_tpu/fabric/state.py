@@ -217,6 +217,16 @@ def snapshot() -> dict:
         except Exception as e:  # noqa: BLE001 — segment may be unlinked
             log.debug("fleet counters unreadable: %s", e)
             out["fabric_workers"] = 0
+        try:
+            seg = c.snapshot()
+            out["fabric_perf_rows"] = seg.get("perf_rows_used", 0)
+            out["fabric_perf_samples"] = seg.get("perf_samples", 0)
+            out["fabric_perf_dropped"] = seg.get("fabric_perf_dropped", 0)
+        except Exception as e:  # noqa: BLE001 — same degrade as above
+            log.debug("perf-store counters unreadable: %s", e)
+    # this process's share of the shared fragment-perf store
+    from . import perf as _perf
+    out["perf_store"] = _perf.stats()
     return out
 
 

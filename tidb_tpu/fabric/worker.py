@@ -5,7 +5,13 @@ Spawned by fabric/fleet.py as ``python -m tidb_tpu.fabric.worker`` with
 env config (the fleet's spawn contract — env, not argv, so a respawn is
 a bit-identical re-exec):
 
-    TIDB_TPU_FABRIC_COORD       coordinator-file path (required)
+    TIDB_TPU_FABRIC_COORD       coordinator-file path (required unless
+                                COORD_ADDR is set)
+    TIDB_TPU_FABRIC_COORD_ADDR  host:port of a CoordServer — the worker
+                                coordinates over TCP (fabric/coord_net)
+                                instead of attaching the segment; every
+                                coordinator op becomes a traced
+                                cross-process hop
     TIDB_TPU_FABRIC_SLOT        this worker's slot (required)
     TIDB_TPU_FABRIC_PORT        the advertised SO_REUSEPORT port
     TIDB_TPU_FABRIC_INIT        "module:callable" data-seeding hook(domain)
@@ -60,10 +66,11 @@ def _parse_kv(raw: str) -> list:
 
 def main() -> int:
     coord_path = os.environ.get("TIDB_TPU_FABRIC_COORD", "")
+    coord_addr = os.environ.get("TIDB_TPU_FABRIC_COORD_ADDR", "")
     slot = int(os.environ.get("TIDB_TPU_FABRIC_SLOT", "0"))
     port = int(os.environ.get("TIDB_TPU_FABRIC_PORT", "0"))
     init_spec = os.environ.get("TIDB_TPU_FABRIC_INIT", "")
-    if not coord_path:
+    if not coord_path and not coord_addr:
         print("worker: TIDB_TPU_FABRIC_COORD not set", file=sys.stderr)
         return 2
 
@@ -76,7 +83,14 @@ def main() -> int:
     # internal sessions; their ids must be fleet-unique too)
     Session.set_conn_id_base(conn_id_base(slot))
 
-    coordinator = Coordinator.attach(coord_path)
+    if coord_addr:
+        # TCP coordination: same op surface, every call a traced hop
+        # into the CoordServer process (the bench trace phase runs the
+        # fleet this way to prove cross-process stitching)
+        from .coord_net import NetCoordinator
+        coordinator = NetCoordinator(coord_addr)
+    else:
+        coordinator = Coordinator.attach(coord_path)
     coordinator.claim_slot(slot)
     state.activate(coordinator, slot,
                    os.environ.get("TIDB_TPU_COMPILE_SERVER") or None)
@@ -161,6 +175,13 @@ def main() -> int:
     shared = FabricMySQLServer(domain, port=port, users={},
                                reuse_port=True).start()
     direct = FabricMySQLServer(domain, port=0, users={}).start()
+    try:
+        # publish the direct port for peer discovery: cluster memtables
+        # (session/diag.py cluster_fanout) reach this worker's DIAG op
+        # through the segment's port column; release/reclaim zero it
+        coordinator.set_direct_port(slot, direct.port)
+    except Exception as e:  # noqa: BLE001 — observe-only surface
+        print(f"worker: direct-port publish failed: {e}", file=sys.stderr)
 
     stop = threading.Event()
 
@@ -175,12 +196,17 @@ def main() -> int:
             if getattr(s, "txn", None) is not None and s.txn.valid]
         return min(starts) if starts else 0
 
+    from . import perf as fabric_perf
+
     def heartbeat():
         n = 0
         while not stop.is_set():
             try:
                 coordinator.heartbeat(slot)
                 coordinator.set_min_read_ts(slot, _min_read_ts())
+                # drain buffered fragment-perf deltas into the shared
+                # store (one locked merge; a no-op when nothing queued)
+                fabric_perf.flush()
                 n += 1
                 if n % 8 == 0:
                     # peer-reclaim sweep: a crashed sibling's lease is
@@ -240,6 +266,14 @@ def main() -> int:
     from ..kv import wal as wal_mod
     summary["wal"] = {k: v for k, v in wal_mod.snapshot().items() if v}
     print(json.dumps(summary), flush=True)
+    # last perf drain while the coordinator is still attached — the
+    # samples this worker buffered since the final heartbeat
+    try:
+        fabric_perf.flush()
+    except Exception as e:  # noqa: BLE001 — observe-only, never blocks
+        #   the drain
+        logging.getLogger("tidb_tpu.fabric.worker").debug(
+            "final perf drain failed: %s", e)
     # hooks OFF before the segment closes: session teardown + interpreter
     # exit still run residency GC callbacks, and a charge against a
     # closed coordinator would only log noise
@@ -249,7 +283,8 @@ def main() -> int:
     # signal, so the log handle must already be quiesced
     domain.store.close()
     coordinator.release_slot(slot)
-    coordinator.close()
+    if hasattr(coordinator, "close"):  # NetCoordinator has no segment
+        coordinator.close()
     return 0
 
 

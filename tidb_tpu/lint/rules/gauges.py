@@ -28,6 +28,23 @@ from ._util import call_name, const_str
 PUBLISH_FNS = ("_publish_gauges", "report_gauges")
 STATUS_REL = "server/http_status.py"
 
+#: fleet-era observability inventory (ISSUE 18): counters and
+#: perf-store fields that flow through the fabric snapshot()/stats()
+#: payloads rather than set_gauge / report_gauges, so the inference
+#: below cannot see them.  Each name must appear as a literal BOTH in
+#: its publishing module and in server/http_status.py — adding a field
+#: to one side without the other is exactly the name-by-name drift this
+#: rule exists to stop.
+FLEET_INVENTORY = {
+    "fabric/state.py": (
+        "fabric_workers", "fabric_respawns", "fabric_dedup_hits",
+        "fabric_compile_rtt_ms", "fleet_cache_hits",
+        "fabric_perf_rows", "fabric_perf_samples"),
+    "fabric/perf.py": ("perf_notes", "perf_merged"),
+    # the span-ring eviction counter behind trace_ring_dropped_total
+    "session/tracing.py": ("ring_dropped",),
+}
+
 
 def _base(name: str) -> str:
     return name.split(":", 1)[0]
@@ -170,6 +187,34 @@ class GaugeConsistency(Rule):
                     f"gauge '{name}' is published but never annotated "
                     "into EXPLAIN ANALYZE"))
         out += self._check_histograms(ctx)
+        out += self._check_fleet_inventory(ctx, status_sf)
+        return out
+
+    def _check_fleet_inventory(self, ctx, status_sf):
+        """Pin the FLEET_INVENTORY names on both ends: the publishing
+        module must still emit each field, and /metrics must still
+        surface it."""
+        out = []
+        status_lits = _all_literals(status_sf)
+        for rel, names in sorted(FLEET_INVENTORY.items()):
+            sf = ctx.file(rel)
+            if sf is None:
+                continue  # fixture tree without the fabric modules
+            lits = _all_literals(sf)
+            for name in names:
+                if name not in lits:
+                    out.append(self.finding(
+                        rel, 1, f"fleet-inventory-source:{name}",
+                        f"fleet observability field '{name}' is in the "
+                        "lint inventory but its publishing module no "
+                        "longer mentions it"))
+                if name not in status_lits:
+                    out.append(self.finding(
+                        status_sf.rel, 1,
+                        f"fleet-inventory-status:{name}",
+                        f"fleet observability field '{name}' (published "
+                        f"by {rel}) is absent from /metrics "
+                        "(server/http_status.py)"))
         return out
 
     def _check_histograms(self, ctx):

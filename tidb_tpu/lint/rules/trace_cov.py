@@ -138,3 +138,58 @@ class TraceCoverage(Rule):
                 if _is_trace_call(sub, ("event",)):
                     return True
         return False
+
+
+#: the propagation helpers (session/tracing.py) a codec-RPC chokepoint
+#: must touch: wire_ctx/attach_remote on the client side of a frame,
+#: begin_remote on the server side
+_PROPAGATE_HELPERS = ("wire_ctx", "begin_remote", "attach_remote")
+
+
+@register
+class CodecRpcTrace(Rule):
+    """Every fabric function that writes a codec frame is a
+    cross-process RPC chokepoint — it must carry trace context
+    (ISSUE 18): attach :func:`tracing.wire_ctx` to outgoing requests /
+    graft the response via :func:`tracing.attach_remote` (client side),
+    or record the hop with :func:`tracing.begin_remote` (server side).
+    A new RPC op added without propagation is a merge-gating finding —
+    the exact blind spot the fleet observability plane exists to close.
+    ``fabric/codec.py`` itself (the transport, below the op layer) is
+    exempt by construction."""
+
+    name = "codec-rpc-trace"
+    title = "codec RPC chokepoints propagate trace context"
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if (not sf.rel.startswith("fabric/")
+                    or sf.rel == "fabric/codec.py"):
+                continue
+            for top in ast.walk(sf.tree):
+                if not isinstance(top, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    continue
+                writes = propagates = False
+                for node in ast.walk(top):
+                    if (isinstance(node, ast.Call)
+                            and call_name(node).rsplit(".", 1)[-1]
+                            == "write_frame"):
+                        writes = True
+                    if (isinstance(node, ast.Attribute)
+                            and node.attr in _PROPAGATE_HELPERS) or \
+                            (isinstance(node, ast.Name)
+                             and node.id in _PROPAGATE_HELPERS):
+                        propagates = True
+                if writes and not propagates:
+                    qn = sf.qualname(top)
+                    out.append(self.finding(
+                        sf.rel, top.lineno, f"rpc@{qn}",
+                        "codec RPC chokepoint without trace propagation: "
+                        "attach tracing.wire_ctx() to the request and "
+                        "tracing.attach_remote() the response (client), "
+                        "or tracing.begin_remote(req.pop('trace', None), "
+                        "...) around the handler (server) — or allowlist "
+                        "with a reason"))
+        return out

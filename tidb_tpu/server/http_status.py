@@ -194,6 +194,14 @@ class StatusServer:
         for name, val in sorted(self.domain.observe.counters.items()):
             lines.append(f"# TYPE {name} counter")
             lines.append(f"{name} {val}")
+        # span-ring eviction pressure: finished traces aged out of the
+        # bounded ring before a reader pulled them — when this moves, the
+        # cluster memtables / TRACE post-mortems are losing history and
+        # RING_CAP (session/tracing.py) needs a look
+        ts = _tracing_snapshot()
+        lines.append("# TYPE trace_ring_dropped_total counter")
+        lines.append(
+            f"trace_ring_dropped_total {ts.get('ring_dropped', 0)}")
         gauges = dict(self.domain.observe.gauge_snapshot())
         # the supervisor/residency gauges are process-wide; surface them
         # even when no device dispatch has registered this domain's sink
@@ -247,6 +255,16 @@ class StatusServer:
                           fs.get("cache_stale_reads", 0))
         gauges.setdefault("fleet_cache_hits",
                           fs.get("fleet_cache_hits", 0))
+        # shared fragment-perf store (fabric/perf.py + the segment's
+        # TPUFAB4 PERF section): fleet row/sample totals when attached,
+        # this process's feed counters always
+        gauges.setdefault("fabric_perf_rows",
+                          fs.get("fabric_perf_rows", 0))
+        gauges.setdefault("fabric_perf_samples",
+                          fs.get("fabric_perf_samples", 0))
+        ps = fs.get("perf_store", {})
+        gauges.setdefault("perf_notes", ps.get("perf_notes", 0))
+        gauges.setdefault("perf_merged", ps.get("perf_merged", 0))
         ws = _wal_snapshot(self.domain)
         gauges.setdefault("wal_appends", ws["wal_appends"])
         gauges.setdefault("wal_fsyncs", ws["wal_fsyncs"])

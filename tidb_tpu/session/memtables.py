@@ -519,6 +519,86 @@ def _metrics_tables(session):
     return cols, rows
 
 
+def _zero(ft):
+    if ft is _I:
+        return 0
+    if ft is _F:
+        return 0.0
+    return b""
+
+
+def _coerce(v, ft):
+    try:
+        if ft is _I:
+            return int(v or 0)
+        if ft is _F:
+            return float(v or 0.0)
+        return ("" if v is None else str(v)).encode()
+    except (TypeError, ValueError):
+        return _zero(ft)
+
+
+def _cluster(base_builder, kind: str):
+    """A cluster_* memtable: the base table's rows from EVERY live
+    worker, fetched as ``DIAG <kind>`` over each peer's direct port
+    (session/diag.py cluster_fanout).  Two columns prefix the base
+    schema: ``instance`` (which worker answered) and ``error`` — a dead
+    peer contributes exactly one row with error="peer-lost: ..." and
+    zero-valued base columns, after a bounded per-peer timeout.  Outside
+    a fleet the local process answers alone as instance "local"."""
+    def build(session):
+        base_cols, _ = base_builder(session)
+        cols = [("instance", _S), ("error", _S)] + base_cols
+
+        def rows():
+            from .diag import cluster_fanout
+            out = []
+            for inst, payload, err in cluster_fanout(session, kind):
+                if err:
+                    out.append((inst.encode(), err.encode()) + tuple(
+                        _zero(ft) for _n, ft in base_cols))
+                    continue
+                for r in payload.get("rows", ()):
+                    out.append((inst.encode(), b"") + tuple(
+                        _coerce(v, ft)
+                        for v, (_n, ft) in zip(r, base_cols)))
+            return out
+        return cols, rows
+    return build
+
+
+def _fragment_perf(session):
+    """information_schema.tidb_fragment_perf: the shared fragment
+    performance store (fabric/coord.py PERF section via fabric/perf.py)
+    — fleet-aggregated count/sum/max and sketch percentiles per
+    (fragment sig, row bucket, backend, duration kind), with this
+    worker's own sample count alongside so "fleet > any single worker"
+    is visible in one row.  Observe-only: nothing reads this to make a
+    routing decision (ROADMAP item 4 is the PR that will)."""
+    from ..fabric import perf
+    cols = [("sig_hash", _S), ("bucket", _I), ("backend", _S),
+            ("kind", _S), ("count", _I), ("sum_s", _F), ("max_s", _F),
+            ("p50_s", _F), ("p99_s", _F), ("local_count", _I)]
+
+    def rows():
+        perf.flush()
+        local = {(r["sig_hash"], r["bucket"], r["backend"], r["kind"]):
+                 r["count"] for r in perf.local_rows()}
+        out = []
+        for r in perf.fleet_rows():
+            key = (r["sig_hash"], r["bucket"], r["backend"], r["kind"])
+            out.append((
+                f"{r['sig_hash']:016x}".encode(), r["bucket"],
+                perf.BACKENDS[r["backend"]].encode(),
+                perf.KINDS[r["kind"]].encode(),
+                r["count"], r["sum_s"], r["max_s"],
+                perf.percentile(r["sketch"], r["count"], 0.50) or 0.0,
+                perf.percentile(r["sketch"], r["count"], 0.99) or 0.0,
+                local.get(key, 0)))
+        return out
+    return cols, rows
+
+
 _TABLES = {
     ("information_schema", "tidb_top_sql"): _tidb_top_sql,
     ("information_schema", "metrics_tables"): _metrics_tables,
@@ -539,7 +619,15 @@ _TABLES = {
     ("information_schema", "slow_query"): _slow_query,
     ("information_schema", "trace_records"): _trace_records,
     ("information_schema", "statements_summary"): _statements_summary,
-    ("information_schema", "cluster_slow_query"): _slow_query,
+    ("information_schema", "cluster_slow_query"):
+        _cluster(_slow_query, "slow"),
+    ("information_schema", "cluster_trace_records"):
+        _cluster(_trace_records, "traces"),
+    ("information_schema", "cluster_statements_summary"):
+        _cluster(_statements_summary, "statements"),
+    ("information_schema", "cluster_processlist"):
+        _cluster(_processlist, "processlist"),
+    ("information_schema", "tidb_fragment_perf"): _fragment_perf,
     ("information_schema", "metrics"): _metrics,
     ("information_schema", "views"): _views,
     ("information_schema", "partitions"): _partitions,

@@ -1021,6 +1021,14 @@ class Session:
 
     def execute(self, sql: str) -> list[Result]:
         """reference: session.ExecuteStmt (session.go:1637)."""
+        # DIAG <kind> (session/diag.py): the direct-port diagnostics op
+        # behind the cluster memtables — a diagnostics verb, not SQL
+        # grammar, so it intercepts before the parser
+        if sql.lstrip()[:4].upper() == "DIAG":
+            from . import diag
+            r = diag.maybe_handle(self, sql)
+            if r is not None:
+                return [r]
         # fleet schema lease (no-op outside a durable shared store): a
         # sibling worker's DDL must be visible before this statement
         # plans against the local infoschema

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 
@@ -64,6 +65,10 @@ MAX_EVENTS = 1024
 #: bench post-mortem dumps (process-wide, like the supervisor STATS)
 RING_CAP = 64
 
+#: remote subtrees grafted into one trace (cross-process hops piggy-
+#: backed on RPC responses) beyond this count `dropped`
+MAX_REMOTE = 32
+
 _TLS = threading.local()
 _RING: "collections.deque" = collections.deque(maxlen=RING_CAP)
 _RING_LOCK = threading.Lock()
@@ -73,7 +78,11 @@ STATS = {
     "started": 0,       # traces begun (statements sampled + TRACE + children)
     "finished": 0,      # traces finished (ring candidates)
     "spans_dropped": 0,  # spans/events lost to the per-trace bounds
+    "ring_dropped": 0,  # finished traces evicted from the bounded ring
+    #   before any reader pulled them (/metrics trace_ring_dropped_total)
     "child_links": 0,   # background jobs linked as child traces
+    "remote_hops": 0,   # remote subtrees grafted across process hops
+    "remote_traces": 0,  # traces recorded on BEHALF of a remote origin
 }
 
 
@@ -97,18 +106,28 @@ class Trace:
 
     __slots__ = ("trace_id", "parent_id", "origin", "name", "conn_id",
                  "started_at", "_t0", "spans", "dropped", "_lock", "root",
-                 "finished", "dur_s", "succ", "n_events")
+                 "finished", "dur_s", "succ", "n_events", "gid",
+                 "origin_gid", "remote")
 
     def __init__(self, name, origin="sampled", conn_id=None, parent_id=None,
                  tags=None):
         self.trace_id = next(_SEQ)
+        #: fleet-global trace id: _SEQ is per-process, so cross-process
+        #: stitching keys on pid-qualified ids (one machine hosts the
+        #: whole simulated fleet — the pid disambiguates)
+        self.gid = f"{os.getpid():x}-{self.trace_id:x}"
         self.parent_id = parent_id    # linking trace id (bg compile jobs)
-        self.origin = origin          # sampled | trace_stmt | child
+        self.origin = origin          # sampled | trace_stmt | child | remote
+        #: the ORIGIN trace's gid when this trace was recorded on behalf
+        #: of a remote caller (origin == "remote"), else None
+        self.origin_gid = None
         self.name = name
         self.conn_id = conn_id
         self.started_at = time.time()
         self._t0 = time.monotonic()
         self.spans: list[Span] = []
+        #: remote subtrees grafted under local spans: (span sid, dict)
+        self.remote: list = []
         self.dropped = 0
         self._lock = threading.Lock()
         self.finished = False
@@ -161,6 +180,20 @@ class Trace:
             (sp if sp is not None else self.root).events.append(
                 (now, name, tags))
 
+    def add_remote(self, sp: "Span | None", subtree: dict):
+        """Graft a remote process's finished trace dict under a local
+        span (the RPC span the hop crossed on).  Same freeze/bound rules
+        as spans: a ring-published trace never mutates, overflow counts
+        ``dropped``."""
+        with self._lock:
+            if self.finished:
+                return
+            if len(self.remote) >= MAX_REMOTE:
+                self.dropped += 1
+                return
+            self.remote.append(
+                (sp.sid if sp is not None else 0, subtree))
+
     def _finish(self, succ: bool):
         with self._lock:
             if self.finished:
@@ -194,23 +227,27 @@ class Trace:
             self.events = list(sp.events)
 
     def _snapshot(self):
-        """(span copies, kids-by-parent, root, dropped, dur_s) under one
-        lock hold — the single source every renderer works from.  The
-        root is always spans[0]: __init__ creates it before the trace is
-        shared."""
+        """(span copies, kids-by-parent, root, dropped, dur_s, remote
+        grafts by span sid) under one lock hold — the single source
+        every renderer works from.  The root is always spans[0]:
+        __init__ creates it before the trace is shared."""
         with self._lock:
             spans = [Trace._SpanSnap(sp) for sp in self.spans]
             dropped, dur_s = self.dropped, self.dur_s
+            remote = list(self.remote)
         kids: dict[int, list] = {}
         for sp in spans:
             kids.setdefault(sp.parent_sid, []).append(sp)
-        return spans, kids, spans[0], dropped, dur_s
+        hops: dict[int, list] = {}
+        for sid, subtree in remote:
+            hops.setdefault(sid, []).append(subtree)
+        return spans, kids, spans[0], dropped, dur_s, hops
 
     def children_of(self) -> dict:
         return self._snapshot()[1]
 
     def to_dict(self) -> dict:
-        spans, kids, root, dropped, dur_s = self._snapshot()
+        spans, kids, root, dropped, dur_s, hops = self._snapshot()
 
         def node(sp):
             d = {"name": sp.name, "start_s": round(sp.t0, 6),
@@ -224,17 +261,23 @@ class Trace:
                                                         if tg else {})}
                     for t, n, tg in sp.events]
             ch = [node(c) for c in kids.get(sp.sid, ())]
+            # stitched cross-process subtrees hang under the RPC span
+            # they crossed on, marked as hops
+            ch += [{**sub, "hop": True} for sub in hops.get(sp.sid, ())]
             if ch:
                 d["children"] = ch
             return d
 
-        out = {"trace_id": self.trace_id, "parent_id": self.parent_id,
+        out = {"trace_id": self.trace_id, "gid": self.gid,
+               "parent_id": self.parent_id,
                "origin": self.origin, "conn_id": self.conn_id,
                "started_at": self.started_at,
                "duration_s": (round(dur_s, 6)
                               if dur_s is not None else None),
                "succ": self.succ, "spans": len(spans),
                "dropped": dropped, "root": node(root)}
+        if self.origin_gid:
+            out["origin_gid"] = self.origin_gid
         if _PROC_LABEL[0]:
             out["process"] = _PROC_LABEL[0]
         return out
@@ -342,6 +385,8 @@ def finish(tr: Trace, succ: bool = True):
     with _RING_LOCK:
         STATS["finished"] += 1
         STATS["spans_dropped"] += tr.dropped
+        if len(_RING) >= RING_CAP:
+            STATS["ring_dropped"] += 1
         _RING.append(tr)
 
 
@@ -400,6 +445,87 @@ class adopt:
         return False
 
 
+# -- cross-process propagation ------------------------------------------------
+#
+# The fleet hops on the framed codec (compile server, net coordinator,
+# worker diag ports).  Propagation is dict-shaped so it rides inside the
+# existing pickled request/response dicts — the codec itself is untouched:
+#
+#   client:  obj["trace"] = wire_ctx()          (None when sampling off)
+#   server:  rtr = begin_remote(obj.get("trace"), "rpc.op")
+#            ... handle, recording spans ...
+#            resp["_trace"] = finish_remote(rtr)
+#   client:  attach_remote(resp.pop("_trace", None))
+#
+# The remote side records a FULL trace into ITS OWN ring tagged with the
+# origin's gid (``origin_gid`` — queryable via traces_for_origin / the
+# diag endpoint even when the response is lost), AND the finished subtree
+# piggybacks on the response so the caller's TRACE FORMAT='json' renders
+# the stitched tree synchronously.  Every helper is one branch when no
+# trace is active (micro-checked in tier-1 like span/event).
+
+def wire_ctx() -> "dict | None":
+    """The calling thread's trace context for an outgoing RPC request
+    dict, or None when no trace is active (the one-branch off path —
+    callers attach it as ``obj["trace"]`` only when non-None)."""
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return None
+    sp = getattr(_TLS, "span", None)
+    return {"gid": tr.gid,
+            "span": sp.name if sp is not None else tr.name,
+            "sampled": True,
+            "proc": _PROC_LABEL[0]}
+
+
+def begin_remote(ctx: "dict | None", name, **tags) -> "Trace | None":
+    """Server-side half: start a trace on BEHALF of the remote caller
+    described by ``ctx`` (a :func:`wire_ctx` dict from the request), bind
+    it to this thread, and tag it with the origin's gid.  None in → None
+    out (unsampled request: one branch, nothing recorded)."""
+    if not ctx:
+        return None
+    if ctx.get("proc"):
+        tags.setdefault("origin_proc", ctx["proc"])
+    tr = begin(name, origin="remote", **tags)
+    tr.origin_gid = ctx.get("gid")
+    with _RING_LOCK:
+        STATS["remote_traces"] += 1
+    return tr
+
+
+def finish_remote(tr: "Trace | None", succ: bool = True) -> "dict | None":
+    """Finish a :func:`begin_remote` trace and return its dict form for
+    response piggybacking (``resp["_trace"]``).  None in → None out."""
+    if tr is None:
+        return None
+    finish(tr, succ)
+    return tr.to_dict()
+
+
+def attach_remote(subtree: "dict | None"):
+    """Client-side half: graft a remote process's finished trace dict
+    (a response's ``_trace`` payload) under the calling thread's current
+    span.  One branch when no trace is active or the response carried
+    none."""
+    if subtree is None:
+        return
+    tr = getattr(_TLS, "trace", None)
+    if tr is None:
+        return
+    tr.add_remote(getattr(_TLS, "span", None), subtree)
+    with _RING_LOCK:
+        STATS["remote_hops"] += 1
+
+
+def traces_for_origin(gid: str) -> list:
+    """Finished traces THIS process recorded on behalf of origin ``gid``
+    — the diag-endpoint lookup that stitches a hop even when the RPC
+    response (and its piggybacked subtree) was lost."""
+    with _RING_LOCK:
+        return [tr for tr in _RING if tr.origin_gid == gid]
+
+
 # -- rendering ----------------------------------------------------------------
 
 def _fmt_s(s) -> str:
@@ -418,8 +544,23 @@ def tree_rows(tr: Trace) -> list:
     render as zero-duration rows prefixed ``@``.  Works entirely on the
     locked span snapshot: the watchdog renders LIVE traces whose spans
     and tags are still being written from worker threads."""
-    _spans, kids, root, _dropped, _dur = tr._snapshot()
+    _spans, kids, root, _dropped, _dur, hops = tr._snapshot()
     rows = []
+
+    def walk_hop(d, depth):
+        """Render a grafted remote subtree (dict form) — hop rows are
+        marked with the remote process so a stitched fleet trace reads
+        'which worker' at a glance."""
+        pad = "  " * depth
+        node = d.get("root") or {}
+        proc = d.get("process") or "remote"
+        rows.append((f"{pad}[hop:{proc}] {node.get('name', '?')}",
+                     _fmt_s(node.get("start_s")),
+                     _fmt_s(node.get("duration_s"))))
+        for c in node.get("children", ()):
+            rows.append((f"{pad}  [hop:{proc}] {c.get('name', '?')}",
+                         _fmt_s(c.get("start_s")),
+                         _fmt_s(c.get("duration_s"))))
 
     def walk(sp, depth):
         pad = "  " * depth
@@ -434,6 +575,8 @@ def tree_rows(tr: Trace) -> list:
                 tag_s = (" " + ",".join(f"{k}={v}" for k, v in tg.items())
                          if tg else "")
                 rows.append((f"{pad}  @{n}{tag_s}", _fmt_s(t), "-"))
+        for sub in hops.get(sp.sid, ()):
+            walk_hop(sub, depth + 1)
 
     walk(root, 0)
     return rows
