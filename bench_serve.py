@@ -952,18 +952,13 @@ def _run_fleet_phases(fleet, procs, n_threads, n_ops, seed, chaos,
                         if rows != goldens[q]:
                             violate(f"WRONG RESULT {q} on slot {slot}")
                     elif wrng.random() < 0.5:
+                        # STRICT single read: ts acquisition waits on
+                        # the fleet committed frontier (fresh_read_ts),
+                        # so the snapshot covers every acked transfer —
+                        # no re-read deflake, any mismatch is a real
+                        # atomicity/consistency break
                         total = c.must_query(
                             "select sum(bal) from ledger")[1][0][0]
-                        if str(total) != str(LEDGER_TOTAL):
-                            # a scan can land an instant before this
-                            # worker's log tail applies a transfer; a
-                            # FRESH statement forces a synchronous
-                            # catch-up (Storage.begin), so one strict
-                            # re-read separates tail lag from a real
-                            # atomicity break — the assert itself
-                            # stays exact
-                            total = c.must_query(
-                                "select sum(bal) from ledger")[1][0][0]
                         if str(total) != str(LEDGER_TOTAL):
                             violate(f"ATOMICITY: ledger {total} on "
                                     f"slot {slot}")

@@ -160,6 +160,23 @@ WRITE_FAULTS = {
 #: fleet smoke).
 FLEET_FAULTS = {
     "fabric-kill-worker": ["1*return(1)", "2*return(1)"],
+    # consistency-contract faults (kv/shared_store.fresh_read_ts):
+    # `tail-lag` delays the WAL tailer's apply loop — a reader behind a
+    # peer's acked commit must BLOCK on the fleet frontier (bounded
+    # freshnessWait budget), never serve a value older than its
+    # snapshot's frontier; `frontier-stall` freezes this worker's
+    # frontier publication — peers keep reading (the heartbeat
+    # republish repairs it), and any wait that exhausts the budget
+    # must refuse LOUDLY (FreshnessWaitError 9011) / downgrade to an
+    # explicit stale_ok, never answer silently stale
+    # (bench_oltp.py asserts read-your-peers'-writes every round)
+    "tail-lag": ["sleep(0.05)", "1*sleep(0.2)"],
+    "frontier-stall": ["return(1)", "1*return(1)"],
+    # stall the leased DDL owner mid-job past the lease timeout: a
+    # sibling claims the cell at a newer epoch and the stalled owner's
+    # commit-point fence must abort its txn (LeaseExpiredError 8229,
+    # tests/test_consistency.py pins the failover)
+    "ddl-mid-job": ["1*sleep(2.5)"],
     # kill-at-stage process deaths for the durable store (a `kill`
     # payload SIGKILLs the worker AT the WAL/2PC stage; recovery on
     # respawn must show committed-visible / uncommitted-gone, torn
@@ -514,6 +531,13 @@ THREADED_FAULTS = {
     "txn-before-prewrite": ["1*panic"],
     "txn-after-prewrite": ["1*panic"],
     "txn-before-commit": ["1*panic"],
+    # freshness faults under concurrency (inert against the solo-durable
+    # kit — catch_up/publish return before the inject without a
+    # coordinator — but live in any fleet-attached in-process store;
+    # the full cross-worker semantics run under FLEET_FAULTS in
+    # bench_oltp / the bench_serve fleet smoke)
+    "tail-lag": ["1*sleep(0.05)"],
+    "frontier-stall": ["1*return(1)"],
 }
 
 #: join budget per worker thread — a thread alive past this is STUCK

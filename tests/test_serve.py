@@ -13,6 +13,7 @@ import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
+import bench_oltp  # noqa: E402
 import bench_serve  # noqa: E402
 from tidb_tpu.executor import scheduler  # noqa: E402
 from tidb_tpu.testkit import TestKit  # noqa: E402
@@ -53,6 +54,35 @@ def test_bench_serve_fleet_smoke():
     lat = [e for e in emitted if e["metric"] == "fleet_latency_ms"]
     assert any(e["slot"] == "all" for e in lat)
     assert any(isinstance(e["slot"], int) for e in lat)
+
+
+def test_bench_oltp_fleet_smoke():
+    """The ISSUE 19 OLTP acceptance: `bench_oltp.py --smoke` green —
+    a TPC-C-shaped NewOrder/Payment mix across 3 workers under
+    group-commit (tidb_wal_fsync=interval) with kill + SIGSTOP-stall
+    chaos rounds.  run_oltp itself raises on any violation (money-sum
+    ledger drift, order/sequence split, acked-row loss, silent stale
+    read, fleet drain leak); assertions here pin the serve_oltp
+    summary shape the bench history records."""
+    emitted = []
+    summary = bench_oltp.run_oltp(procs=3, n_threads=6, n_ops=6,
+                                  seed=0, chaos=True,
+                                  emit=emitted.append)
+    assert summary["violations"] == 0
+    assert summary["txns_ok"] > 0 and summary["tpmC"] > 0
+    assert summary["acked_orders"] > 0
+    # every error was classified: retryable conflict, loud freshness
+    # refusal, or a chaos-window wire drop — never an unknown
+    assert summary["clean_errors"] == 0
+    assert 0.0 <= summary["conflict_rate"] < 1.0
+    # the freshness histogram made it from worker /metrics into the
+    # fleet-merged summary (p50 <= p99, both finite)
+    assert summary["freshness_wait_p99_ms"] >= \
+        summary["freshness_wait_p50_ms"] >= 0.0
+    # chaos rounds ran: the SIGKILLed worker respawned inside budget
+    assert 0.0 < summary["kill_recover_s"] < bench_oltp.RESPAWN_BUDGET_S
+    drained = [e for e in emitted if e["metric"] == "oltp_fleet_drained"]
+    assert drained and drained[0]["ok"]
 
 
 @pytest.mark.chaos_threads

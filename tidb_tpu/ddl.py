@@ -10,6 +10,9 @@ paths added with ADD INDEX backfill.
 
 from __future__ import annotations
 
+import contextlib
+import logging
+
 from .errors import SchemaError, TiDBError, ErrCode
 from .meta import KEY_SEQ_PREFIX, Meta
 from .model import (
@@ -20,6 +23,102 @@ from .parser import ast
 from .sqltypes import FLAG_PRI_KEY, FLAG_UNSIGNED, TYPE_LONGLONG
 from . import tablecodec
 from .table import cast_value, convert_internal
+from .utils import failpoint
+
+log = logging.getLogger("tidb_tpu.ddl")
+
+#: wall-clock budget for waiting out a foreign DDL owner's lease
+DDL_CLAIM_BUDGET_MS = 10_000.0
+
+
+@contextlib.contextmanager
+def ddl_owner_lease():
+    """Fleet DDL ownership: claim the coordination segment's
+    epoch-fenced DDL owner cell (fabric/coord.ddl_claim) for the scope
+    of one job/drain, replacing serialize-by-write-conflict as the
+    cross-worker DDL serialization point.  Yields the claimed epoch
+    (0 = solo / no fleet: the in-process domain ddl_lock is the only
+    serialization needed).
+
+    A live foreign lease is waited out under the bounded
+    ``ddlOwnerWait`` budget; a dead owner's cell is reclaimable
+    immediately after its lease times out (same liveness rule as
+    region owners).  An unreachable coordinator degrades — loudly —
+    to the old conflict-serialized behavior: the meta job-queue key
+    is still rewritten by every DDL txn, so racing writers abort on
+    conflict rather than corrupt the queue."""
+    from .fabric import state as fabric_state
+    from .utils.backoff import Backoffer
+    from .errors import BackoffExhaustedError
+    coord = fabric_state.coordinator()
+    slot = fabric_state.slot() if coord is not None else -1
+    if coord is None or slot < 0:
+        yield 0
+        return
+    epoch = 0
+    try:
+        epoch = coord.ddl_claim(slot)
+        if not epoch:
+            bo = Backoffer(budget_ms=DDL_CLAIM_BUDGET_MS,
+                           wall_clock=True)
+            while not epoch:
+                bo.backoff("ddlOwnerWait")
+                epoch = coord.ddl_claim(slot)
+    except BackoffExhaustedError:
+        raise
+    except Exception as e:  # noqa: BLE001 — segment unlinked /
+        #   coordinator down-window: fall back to conflict serialization
+        log.warning("ddl owner claim degraded (%s): "
+                    "conflict-serialized only", e)
+        yield 0
+        return
+    try:
+        yield epoch
+    finally:
+        with contextlib.suppress(Exception):
+            coord.ddl_release(slot)
+
+
+def ddl_fence_check(epoch: int):
+    """The commit-point fence of a leased DDL job: called immediately
+    before the job txn commits.  If our lease was reclaimed while the
+    job ran (we stalled past the lease timeout and another worker
+    claimed a newer epoch), the commit must NOT land — two owners
+    interleaving one schema state machine is exactly what the lease
+    exists to prevent.  Unprovable (coordinator unreachable) counts as
+    lost: abort rather than guess."""
+    if not epoch:
+        return
+    from .fabric import state as fabric_state
+    from .utils.backoff import LeaseExpiredError
+    coord = fabric_state.coordinator()
+    ok = False
+    if coord is not None:
+        with contextlib.suppress(Exception):
+            ok = bool(coord.ddl_check(epoch))
+    if not ok:
+        raise LeaseExpiredError(
+            f"ddl owner lease lost (epoch {epoch} reclaimed); "
+            "job aborted before commit")
+
+
+def ddl_lease_heartbeat(epoch: int) -> bool:
+    """Renew leased DDL ownership mid-drain (long job queues,
+    backfills).  Returns False when the lease is lost — the caller
+    must stop driving jobs and yield to the new owner."""
+    if not epoch:
+        return True
+    from .fabric import state as fabric_state
+    coord = fabric_state.coordinator()
+    slot = fabric_state.slot() if coord is not None else -1
+    if coord is None or slot < 0:
+        return True
+    try:
+        return bool(coord.ddl_heartbeat(slot, epoch))
+    except Exception as e:  # noqa: BLE001 — unprovable = lost: the
+        #   drain aborts loudly rather than run unfenced
+        log.warning("ddl lease heartbeat unprovable: %s", e)
+        return False
 
 
 class DDLExecutor:
@@ -36,9 +135,12 @@ class DDLExecutor:
         handleDDLJobQueue). Serialized against the async online-DDL worker
         via the domain DDL lock — both rewrite the meta job-queue key, and
         interleaving (e.g. DROP INDEX racing an in-flight ADD INDEX state
-        machine) must not happen."""
+        machine) must not happen.  Across workers the job runs under the
+        segment-leased DDL owner cell: the epoch fence immediately before
+        commit guarantees a stalled owner whose lease was reclaimed can
+        never land its txn on top of the new owner's."""
         store = self.session.store
-        with self.session.domain.ddl_lock:
+        with self.session.domain.ddl_lock, ddl_owner_lease() as epoch:
             txn = store.begin()
             m = Meta(txn)
             job = Job(id=m.gen_job_id(), type=job_type, schema_id=schema_id,
@@ -46,11 +148,15 @@ class DDLExecutor:
                       start_ts=txn.start_ts)
             m.enqueue_job(job)
             try:
+                # chaos door: stall the owner mid-job (past the DDL
+                # lease timeout another worker claims; our fence trips)
+                failpoint.inject("ddl-mid-job")
                 fn(m, job)
                 job.state = JobState.SYNCED
                 job.schema_state = SchemaState.PUBLIC
                 job.schema_version = m.bump_schema_version()
                 m.finish_job(job)
+                ddl_fence_check(epoch)
                 txn.commit()
             except Exception:
                 txn.rollback()
@@ -413,7 +519,7 @@ class DDLExecutor:
         one meta KV key also rewritten by the worker's transition/batch
         txns — unserialized writers would abort each other on conflict."""
         store = self.session.store
-        with self.session.domain.ddl_lock:
+        with self.session.domain.ddl_lock, ddl_owner_lease() as epoch:
             txn = store.begin()
             try:
                 m = Meta(txn)
@@ -421,6 +527,7 @@ class DDLExecutor:
                           schema_id=schema_id, table_id=table_id,
                           args=args or {}, start_ts=txn.start_ts)
                 m.enqueue_job(job)
+                ddl_fence_check(epoch)
                 txn.commit()
             except Exception:
                 txn.rollback()

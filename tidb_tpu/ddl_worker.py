@@ -131,9 +131,19 @@ class DDLWorker:
     # -- queue processing ----------------------------------------------------
 
     def run_pending(self):
-        """Drain the queue (each step is its own txn; re-entrant)."""
-        with self.domain.ddl_lock:
+        """Drain the queue (each step is its own txn; re-entrant).
+        Fleet: the drain holds the segment-leased DDL owner cell,
+        renewed per job — a lost lease aborts the drain loudly (the
+        new owner re-drives the queue; steps are re-entrant) instead
+        of letting two owners interleave one state machine."""
+        from .ddl import ddl_lease_heartbeat, ddl_owner_lease
+        with self.domain.ddl_lock, ddl_owner_lease() as epoch:
             while True:
+                if not ddl_lease_heartbeat(epoch):
+                    from .utils.backoff import LeaseExpiredError
+                    raise LeaseExpiredError(
+                        "ddl owner lease lost mid-drain; remaining "
+                        "jobs yield to the new owner")
                 job = self._peek()
                 if job is None:
                     return

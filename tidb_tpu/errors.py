@@ -74,6 +74,12 @@ class ErrCode:
     #                       executable (remote-compile RPC/transport
     #                       failure, injected compile fault, retry budget
     #                       exhausted) — the fragment degrades to host
+    FreshnessWaitTimeout = 9011  # a snapshot's fleet-frontier wait blew
+    #                              its budget: the read is REFUSED loudly
+    #                              (never silently served stale), and the
+    #                              lagging origin's freshness breaker
+    #                              trips so one wedged worker cannot
+    #                              freeze fleet reads (kv/shared_store)
     LazyUniquenessCheckFailure = 8147
     ResolveLockTimeout = 9004
     GCTooEarly = 9006
@@ -250,6 +256,22 @@ class DeviceCompileError(TiDBError):
     subsequent executions back to device)."""
 
     code = ErrCode.DeviceCompile
+    sqlstate = "HY000"
+
+
+class FreshnessWaitError(TiDBError):
+    """A snapshot's fleet-frontier wait (kv/shared_store.fresh_read_ts)
+    exhausted its ``freshnessWait`` budget: some live origin published a
+    durable commit frontier this replica could not apply up to in time.
+
+    This is the LOUD stale-read refusal of the consistency ladder — the
+    engine never silently serves a snapshot older than the fleet
+    frontier.  The lagging origin's per-origin freshness breaker trips
+    with the raise, so subsequent reads degrade to an explicit
+    ``stale_ok`` downgrade (surfaced in EXPLAIN ANALYZE and the
+    ``freshness_stale_ok`` gauge) instead of re-paying the budget."""
+
+    code = ErrCode.FreshnessWaitTimeout
     sqlstate = "HY000"
 
 

@@ -60,6 +60,16 @@ Layout (little-endian, fixed offsets — no allocation after create):
               by the first survivor to tail the record).  The result
               cache stamps pages with these and a hit requires every
               referenced table's cell to still match
+    FRONTIERS per-slot committed-frontier cells: (frontier_ts,
+              frontier_lsn) — the max commit_ts the slot's appender has
+              made durable and the log length covering it.  Snapshot
+              begin waits until the local applied LSN covers every live
+              origin's frontier <= its ts: the fleet-wide
+              snapshot-isolation fence (kv/shared_store.py)
+    DDL       the single fleet DDL owner cell (epoch, owner+1,
+              lease_ts): the region-ownership shape applied to the DDL
+              job queue — one epoch-fenced owner at a time, failover by
+              lease expiry, a deposed owner's commit fails the fence
 
 Every mutation happens under the sidecar lock file (``<path>.lock``,
 ``fcntl.flock``) plus an in-process mutex (flock is per open file
@@ -92,7 +102,7 @@ from multiprocessing import shared_memory
 
 log = logging.getLogger("tidb_tpu.fabric.coord")
 
-MAGIC = b"TPUFAB4\0"
+MAGIC = b"TPUFAB5\0"
 
 #: segment geometry defaults (fixed at create; attach reads them from the
 #: coordinator file)
@@ -153,6 +163,17 @@ _REG = struct.Struct("<QQdQQ")                           # epoch, owner+1,
 #                                                          committed_len,
 #                                                          applied_lsn
 _TVER = struct.Struct("<QQ")                             # table_id, version_ts
+#: per-slot committed-frontier cell: the max commit_ts this slot's WAL
+#: appender has made DURABLE (fsync-acked) and the log length that
+#: covers it.  Readers wait until their local applied LSN reaches every
+#: live origin's frontier_lsn whose frontier_ts <= their snapshot ts —
+#: the fleet-wide snapshot-isolation fence (ISSUE 19)
+_FRONT = struct.Struct("<QQ")                            # frontier_ts,
+#                                                          frontier_lsn
+#: the single fleet DDL owner cell: epoch, owner slot (+1; 0 = unowned),
+#: lease_ts — the region-ownership shape applied to the DDL job queue,
+#: replacing serialize-by-conflict with an epoch-fenced lease
+_DDL = struct.Struct("<QQd")
 #: perf-store row: sig_hash, bucket, backend, kind, count, sum_s, max_s,
 #: 16-bucket log2 duration sketch.  A row is FREE iff count == 0.
 #: Crash-safety is by construction, not by reclaim: every update is one
@@ -203,7 +224,10 @@ class Coordinator:
         # per-slot direct-port cells (u64): each worker publishes its
         # diagnostics door so peers can fan cluster memtables out to it
         self._o_ports = self._o_tvers + self.ntablevers * _TVER.size
-        self._o_perf = self._o_ports + self.nslots * 8
+        # per-slot committed-frontier cells + the fleet DDL owner cell
+        self._o_front = self._o_ports + self.nslots * 8
+        self._o_ddl = self._o_front + self.nslots * _FRONT.size
+        self._o_perf = self._o_ddl + _DDL.size
         self.size = self._o_perf + self.nperf * _PERF.size
 
     # -- lifecycle -----------------------------------------------------------
@@ -230,7 +254,8 @@ class Coordinator:
                 + ntenants * (_TEN_FIXED.size + 12 * nslots)
                 + ndedup * _DED.size + nlocks * _LCK.size
                 + nregions * _REG.size + ntablevers * _TVER.size
-                + nslots * 8 + nperf * _PERF.size)
+                + nslots * 8 + nslots * _FRONT.size + _DDL.size
+                + nperf * _PERF.size)
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         _untrack(shm)
         shm.buf[:size] = b"\0" * size
@@ -329,7 +354,7 @@ class Coordinator:
             off = self._slot_off(slot)
             _pid, _lease, gen, _mrt, _wa = _SLOT.unpack_from(self._buf, off)
             self._zero_slot_columns_locked(slot)
-            _U64.pack_into(self._buf, self._o_ports + 8 * slot, 0)
+            self._drop_slot_published_locked(slot)
             _SLOT.pack_into(self._buf, off, pid, time.time(), gen + 1, 0, 0)
 
     def heartbeat(self, slot: int):
@@ -344,7 +369,7 @@ class Coordinator:
         """Clean worker exit: drop the lease and every per-slot count."""
         with self._locked():
             self._zero_slot_columns_locked(slot)
-            _U64.pack_into(self._buf, self._o_ports + 8 * slot, 0)
+            self._drop_slot_published_locked(slot)
             _SLOT.pack_into(self._buf, self._slot_off(slot), 0, 0.0, 0,
                             0, 0)
 
@@ -398,7 +423,7 @@ class Coordinator:
                 pid, lease = _SLOT.unpack_from(self._buf, off)[:2]
                 if pid and now - lease > lease_timeout_s:
                     self._zero_slot_columns_locked(s)
-                    _U64.pack_into(self._buf, self._o_ports + 8 * s, 0)
+                    self._drop_slot_published_locked(s)
                     _SLOT.pack_into(self._buf, off, 0, 0.0, 0, 0, 0)
                     self._bump_locked("fabric_lease_reclaims")
                     n += 1
@@ -427,6 +452,21 @@ class Coordinator:
             h, start_ts, owner, _ts = _LCK.unpack_from(self._buf, off)
             if start_ts and owner == slot:
                 _LCK.pack_into(self._buf, off, b"\0" * 16, 0, 0, 0.0)
+
+    def _drop_slot_published_locked(self, slot: int):
+        """Zero the slot's published cells on any lease transition
+        (claim/release/reclaim): the direct port (a dead worker must not
+        read as a connectable peer), the commit frontier (a dead origin
+        must stop gating fleet reads — its durable records are already
+        behind the committed WAL length), and its DDL ownership (the
+        epoch stays: monotonic for the cell's lifetime, so a reclaimed
+        owner's in-flight job fails the epoch fence at commit)."""
+        _U64.pack_into(self._buf, self._o_ports + 8 * slot, 0)
+        _FRONT.pack_into(self._buf, self._o_front + slot * _FRONT.size,
+                         0, 0)
+        epoch, owner_p1, _lease = _DDL.unpack_from(self._buf, self._o_ddl)
+        if owner_p1 == slot + 1:
+            _DDL.pack_into(self._buf, self._o_ddl, epoch, 0, 0.0)
 
     # -- tenants -------------------------------------------------------------
 
@@ -708,6 +748,89 @@ class Coordinator:
                 h, sts, _owner, _ts = _LCK.unpack_from(self._buf, off)
                 if sts == start_ts and (only is None or h in only):
                     _LCK.pack_into(self._buf, off, b"\0" * 16, 0, 0, 0.0)
+
+    # -- per-origin committed frontiers (kv/shared_store.py reads) ------------
+
+    def set_commit_frontier(self, slot: int, ts: int, lsn: int):
+        """Publish this slot's durable commit frontier: the max commit_ts
+        its appender has fsync-acked and the log length covering it.
+        Forward-only and pid-guarded — a reclaimed slot's late publish
+        (a zombie appender's final fsync) must not resurrect a gate the
+        reclaim already dropped."""
+        with self._locked():
+            off = self._slot_off(slot)
+            if not _SLOT.unpack_from(self._buf, off)[0]:
+                return
+            foff = self._o_front + slot * _FRONT.size
+            cur_ts, cur_lsn = _FRONT.unpack_from(self._buf, foff)
+            _FRONT.pack_into(self._buf, foff, max(int(ts), cur_ts),
+                             max(int(lsn), cur_lsn))
+
+    def commit_frontiers(self, lease_timeout_s: float = 2.0) -> dict:
+        """{slot: (frontier_ts, frontier_lsn)} over LIVE slots with a
+        published frontier.  A dead/reclaimed slot is absent — the
+        dead-slot ungating rule: its durable records sit behind the
+        committed WAL length, so the plain catch-up already covers them
+        and no reader should block on a lease that cannot renew."""
+        now = time.time()
+        with self._locked():
+            out = {}
+            for s in range(self.nslots):
+                pid, lease = _SLOT.unpack_from(
+                    self._buf, self._slot_off(s))[:2]
+                if not pid or now - lease > lease_timeout_s:
+                    continue
+                ts, lsn = _FRONT.unpack_from(
+                    self._buf, self._o_front + s * _FRONT.size)
+                if ts:
+                    out[s] = (ts, lsn)
+            return out
+
+    # -- the fleet DDL owner lease (ddl.py _run_job) --------------------------
+
+    def ddl_claim(self, slot: int, lease_timeout_s: float = 2.0) -> int:
+        """Claim the single DDL owner cell for ``slot``: succeeds when
+        unowned, already ours, or the owner's lease lapsed (failover —
+        an owner SIGKILLed mid-CREATE).  Bumps and returns the epoch
+        (the fence a deposed owner's commit fails); returns 0 while a
+        foreign owner's lease is live (the caller backs off and
+        retries)."""
+        now = time.time()
+        with self._locked():
+            epoch, owner_p1, lease = _DDL.unpack_from(
+                self._buf, self._o_ddl)
+            if owner_p1 and owner_p1 != slot + 1 \
+                    and now - lease <= lease_timeout_s:
+                return 0
+            epoch += 1
+            _DDL.pack_into(self._buf, self._o_ddl, epoch, slot + 1, now)
+            return epoch
+
+    def ddl_heartbeat(self, slot: int, epoch: int) -> bool:
+        """Refresh the DDL lease; False when ``slot`` no longer owns the
+        cell at ``epoch`` (it failed over — abandon the job)."""
+        with self._locked():
+            cur_epoch, owner_p1, _lease = _DDL.unpack_from(
+                self._buf, self._o_ddl)
+            if owner_p1 != slot + 1 or cur_epoch != epoch:
+                return False
+            _DDL.pack_into(self._buf, self._o_ddl, cur_epoch, owner_p1,
+                           time.time())
+            return True
+
+    def ddl_release(self, slot: int):
+        """Clean handoff after a job: drop ownership, keep the epoch."""
+        with self._locked():
+            epoch, owner_p1, _lease = _DDL.unpack_from(
+                self._buf, self._o_ddl)
+            if owner_p1 == slot + 1:
+                _DDL.pack_into(self._buf, self._o_ddl, epoch, 0, 0.0)
+
+    def ddl_check(self, epoch: int) -> bool:
+        """Is ``epoch`` still the DDL cell's current epoch?  The fence a
+        deposed owner fails immediately before committing its job."""
+        with self._locked():
+            return _DDL.unpack_from(self._buf, self._o_ddl)[0] == epoch
 
     # -- region ownership / epoch fencing (fabric/region.py) ------------------
 
@@ -1146,9 +1269,13 @@ class Coordinator:
                 pid, lease, gen, mrt, wa = _SLOT.unpack_from(
                     self._buf, self._slot_off(s))
                 if pid:
+                    fts, flsn = _FRONT.unpack_from(
+                        self._buf, self._o_front + s * _FRONT.size)
                     slots.append({"slot": s, "pid": pid, "gen": gen,
                                   "lease_age_s": round(now - lease, 3),
-                                  "min_read_ts": mrt, "wal_applied": wa})
+                                  "min_read_ts": mrt, "wal_applied": wa,
+                                  "frontier_ts": fts,
+                                  "frontier_lsn": flsn})
             tenants = {}
             for t in range(self.ntenants):
                 name = self._ten_name(t)
@@ -1190,10 +1317,14 @@ class Coordinator:
                 if row[4]:
                     perf_rows_used += 1
                     perf_samples += row[4]
+            ddl_epoch, ddl_owner_p1, _dl = _DDL.unpack_from(
+                self._buf, self._o_ddl)
         return {"slots": slots, "tenants": tenants,
                 "dedup_building": building, "held_locks": held_locks,
                 "regions": regions, "perf_rows_used": perf_rows_used,
-                "perf_samples": perf_samples, **ctrs}
+                "perf_samples": perf_samples,
+                "ddl_epoch": ddl_epoch, "ddl_owner": ddl_owner_p1 - 1,
+                **ctrs}
 
     def verify_drained(self) -> dict:
         """Fleet drain invariant (the cross-process analog of
@@ -1211,16 +1342,20 @@ class Coordinator:
         pinned = [s["slot"] for s in snap["slots"] if s["min_read_ts"]]
         region_leases = [r["region"] for r in snap["regions"]
                          if r["owner"] >= 0]
+        # the DDL cell must be unowned at drain: a held lease here is a
+        # dead owner no survivor can claim without waiting out its lease
+        ddl_owner = snap["ddl_owner"]
         return {"ok": not snap["slots"] and not running
                 and snap["dedup_building"] == 0
                 and snap["held_locks"] == 0 and not pinned
-                and not region_leases,
+                and not region_leases and ddl_owner < 0,
                 "live_slots": [s["slot"] for s in snap["slots"]],
                 "running": running,
                 "dedup_building": snap["dedup_building"],
                 "held_locks": snap["held_locks"],
                 "min_read_pinned": pinned,
                 "region_leases": region_leases,
+                "ddl_owner": ddl_owner,
                 "lease_reclaims": snap["fabric_lease_reclaims"]}
 
 

@@ -58,6 +58,8 @@ OPS = frozenset({
     "tso_lease", "publish_schema_version", "schema_version",
     "wal_len", "set_wal_len", "set_min_read_ts", "fleet_min_read_ts",
     "set_wal_applied", "min_wal_applied",
+    "set_commit_frontier", "commit_frontiers",
+    "ddl_claim", "ddl_heartbeat", "ddl_release", "ddl_check",
     "lock_claim", "lock_release",
     "region_claim", "region_heartbeat", "region_release",
     "region_release_all", "region_check", "region_set_committed",
@@ -88,6 +90,15 @@ _DEGRADE = {
     "heartbeat": lambda args, kwargs: None,
     "set_min_read_ts": lambda args, kwargs: None,
     "fleet_min_read_ts": lambda args, kwargs: 0,
+    # frontier publish during a down-window: drop it — the appender's
+    # frontier is forward-only and the next fsync (or heartbeat
+    # republish) repairs the cell.  commit_frontiers is deliberately NOT
+    # degradable: an empty answer would read as "nothing to wait for"
+    # and turn a down-window into a silent stale read; the reader's
+    # entry point catches CoordUnavailableError and downgrades LOUDLY
+    # (stale_ok surfaced in EXPLAIN ANALYZE).  The ddl_* lease ops are
+    # not degradable either — a lease minted locally fences nothing
+    "set_commit_frontier": lambda args, kwargs: None,
     "bump": lambda args, kwargs: 0,
     "counters": lambda args, kwargs: {},
     # result cache during a down-window: version advances are dropped

@@ -163,6 +163,14 @@ class RegionCoordView:
                 f"region {self.rid} epoch {self.epoch} is stale; "
                 "append fenced")
 
+    def set_commit_frontier(self, slot: int, ts: int, lsn: int):
+        # region WALs have disjoint LSN spaces, so the slot's single
+        # frontier-LSN cell is meaningless across them: publish the ts
+        # fence only (lsn stays 0 → readers' LSN wait degenerates to
+        # the fast path; cross-region visibility keeps the synchronous
+        # catch-up contract, see RegionStore.fresh_read_ts)
+        self._c.set_commit_frontier(slot, ts, 0)
+
     # -- everything else passes through -------------------------------------
 
     def __getattr__(self, name):
@@ -481,7 +489,14 @@ class RegionStore:
                 return out[:limit]
         return out
 
-    def prewrite(self, mutations, primary: bytes, start_ts: int):
+    def prewrite(self, mutations, primary: bytes, start_ts: int,
+                 view_seq: "int | None" = None):
+        # view_seq is accepted but not forwarded: the anchor is a
+        # per-store scalar and region WALs apply independently, so a
+        # single sequence cannot cover a multi-region write set.  The
+        # region view (RegionStore has no read_view_seq) always hands
+        # writers None — region-mode conflict detection stays on the
+        # ts comparison it had before the anchor existed.
         groups: dict[int, list] = {}
         for m in mutations:
             groups.setdefault(self.region_map.region_of(m[0]),
@@ -521,7 +536,9 @@ class RegionStore:
             self._require(rid).rollback(ks, start_ts)
 
     def acquire_pessimistic_lock(self, keys, primary: bytes,
-                                 start_ts: int, for_update_ts: int):
+                                 start_ts: int, for_update_ts: int,
+                                 view_seq: "int | None" = None):
+        # view_seq unused for the same reason as in prewrite
         for rid, ks in sorted(self._group(keys).items()):
             self._require(rid).acquire_pessimistic_lock(
                 ks, primary, start_ts, for_update_ts)
@@ -565,6 +582,31 @@ class RegionStore:
     def catch_up(self):
         for st in list(self.stores.values()):
             st.catch_up()
+
+    def fresh_read_ts(self) -> int:
+        """Region-fleet ts fence: order every new snapshot ABOVE every
+        live peer's acked durable commit_ts (the frontier cells carry
+        ts only here — RegionCoordView publishes lsn=0 because region
+        WAL LSN spaces are disjoint).  Visibility of those commits
+        rides the synchronous per-region catch_up Storage.begin already
+        performs."""
+        try:
+            fronts = self.coord.commit_frontiers()
+        except Exception as e:  # noqa: BLE001 — segment gone at
+            #   teardown / coordinator down-window: plain monotonic ts
+            log.debug("commit_frontiers unreadable (%s); plain ts", e)
+            fronts = {}
+        need = max((fts for s, (fts, _lsn) in fronts.items()
+                    if s != self.slot), default=0)
+        if need:
+            self.tso.advance_to(need)
+        return self.tso.next_ts()
+
+    def publish_frontier(self):
+        """Heartbeat republish funnel (fabric/worker.py): forward to
+        every owned region's store."""
+        for st in list(self.stores.values()):
+            st.publish_frontier()
 
     def _require(self, rid: int) -> DurableMVCCStore:
         st = self.stores.get(rid)

@@ -57,6 +57,9 @@ STATS = {
     "cache_invalidations": 0,      # hits refused on a version advance
     "cache_delta_folds": 0,        # hits served by folding the WAL delta
     "cache_stale_reads": 0,        # page-level vv verify caught staleness
+    "freshness_waits": 0,          # reads that blocked on the fleet frontier
+    "freshness_timeouts": 0,       # waits that blew the budget (9011 raised)
+    "freshness_stale_ok": 0,       # reads explicitly downgraded to stale_ok
 }
 
 
@@ -242,7 +245,9 @@ def report_gauges() -> dict:
               "fabric_artifact_hits", "fabric_remote_compiles",
               "fabric_remote_errors", "fabric_respawns",
               "cache_hits", "cache_invalidations",
-              "cache_delta_folds", "cache_stale_reads"):
+              "cache_delta_folds", "cache_stale_reads",
+              "freshness_waits", "freshness_timeouts",
+              "freshness_stale_ok"):
         if s.get(k):
             out[k] = s[k]
     if s.get("fabric_compile_rtt_ms"):

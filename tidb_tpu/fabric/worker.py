@@ -204,6 +204,13 @@ def main() -> int:
             try:
                 coordinator.heartbeat(slot)
                 coordinator.set_min_read_ts(slot, _min_read_ts())
+                # republish the durable commit frontier each beat: a
+                # publish swallowed by a coordinator down-window is
+                # repaired here, so peers' freshness waits never gate
+                # on a stale column longer than one beat
+                pf = getattr(domain.store.mvcc, "publish_frontier", None)
+                if pf is not None:
+                    pf()
                 # drain buffered fragment-perf deltas into the shared
                 # store (one locked merge; a no-op when nothing queued)
                 fabric_perf.flush()

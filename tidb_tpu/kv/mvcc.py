@@ -192,9 +192,13 @@ class MVCCStore:
 
     # -- transactional API --------------------------------------------------
 
-    def prewrite(self, mutations, primary: bytes, start_ts: int):
+    def prewrite(self, mutations, primary: bytes, start_ts: int,
+                 view_seq: "int | None" = None):
         """mutations: [(key, op, value)] with op in {OP_PUT, OP_DEL,
-        OP_LOCK}, optionally OR'd with OP_AMEND_FLAG."""
+        OP_LOCK}, optionally OR'd with OP_AMEND_FLAG.  ``view_seq`` is
+        the fleet read-view anchor (kv/shared_store overrides consume
+        it); the solo store applies commits synchronously with ts
+        order, so commit_ts comparison alone is already sound here."""
         with self._lock:
             for key, op, value in mutations:
                 lock = self.locks.get(key)
@@ -242,9 +246,11 @@ class MVCCStore:
             self._waits.pop(start_ts, None)
 
     def acquire_pessimistic_lock(self, keys, primary: bytes, start_ts: int,
-                                 for_update_ts: int):
+                                 for_update_ts: int,
+                                 view_seq: "int | None" = None):
         """Pessimistic lock: conflict check against for_update_ts
-        (reference: unistore PessimisticLock)."""
+        (reference: unistore PessimisticLock).  ``view_seq`` as in
+        :meth:`prewrite` — solo stores ignore it."""
         with self._lock:
             for key in keys:
                 lock = self.locks.get(key)

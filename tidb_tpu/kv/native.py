@@ -183,7 +183,11 @@ class NativeMVCCStore:
 
     # -- transactional API --------------------------------------------------
 
-    def prewrite(self, mutations, primary: bytes, start_ts: int):
+    def prewrite(self, mutations, primary: bytes, start_ts: int,
+                 view_seq: "int | None" = None):
+        # view_seq accepted for interface parity: the native engine is
+        # single-replica, so commits apply in ts order and the plain
+        # commit_ts-vs-start_ts conflict check below is already sound.
         n = len(mutations)
         keys = (ctypes.c_char_p * n)(*[m[0] for m in mutations])
         klens = (ctypes.c_int32 * n)(*[len(m[0]) for m in mutations])
@@ -222,7 +226,9 @@ class NativeMVCCStore:
         self._lib.mvcc_rollback(self._h, n, arr, lens, start_ts)
 
     def acquire_pessimistic_lock(self, keys, primary: bytes, start_ts: int,
-                                 for_update_ts: int):
+                                 for_update_ts: int,
+                                 view_seq: "int | None" = None):
+        # accepted, unused — see prewrite()
         keys = list(keys)
         n, arr, lens = _key_arrays(keys)
         out_ts = ctypes.c_uint64()

@@ -60,7 +60,8 @@ import time
 from collections import deque
 
 from ..utils import failpoint
-from .mvcc import Lock, MVCCStore, OP_ROLLBACK, TSOracle
+from ..errors import WriteConflictError
+from .mvcc import Lock, MVCCStore, OP_LOCK, OP_ROLLBACK, TSOracle
 from . import wal as wal_mod
 
 log = logging.getLogger("tidb_tpu.kv.shared_store")
@@ -70,6 +71,15 @@ TSO_BATCH = 64
 
 #: background tailer poll period
 TAIL_INTERVAL_S = 0.01
+
+#: wall-clock budget a snapshot may spend blocked on the fleet
+#: committed frontier (fresh_read_ts) before REFUSING the read loudly
+FRESHNESS_BUDGET_MS = 1000.0
+
+#: how long a lagging origin's freshness breaker stays open after a
+#: wait timeout — reads degrade to explicit stale_ok instead of
+#: re-paying the budget against a wedged-but-alive worker
+FRESHNESS_BREAKER_S = 5.0
 
 #: meta key whose commit publishes the fleet schema-version cell
 SCHEMA_VERSION_KEY = b"m:schema_version"
@@ -178,6 +188,37 @@ class DurableMVCCStore(MVCCStore):
         self._slot = int(slot)
         self._tail_lock = threading.RLock()
         self._applied_lsn = wal.base_lsn
+        # view-anchored write-conflict detection: a peer's commit can
+        # carry a commit_ts BELOW a later-minted local read ts (the
+        # shared oracle hands out the cts first) while its APPLY lands
+        # only after that reader's statement already ran — so
+        # has_commit_after(for_update_ts) alone can never see the
+        # conflict and the write becomes a cross-worker lost update.
+        # Every applied foreign commit bumps this sequence and stamps
+        # its keys; lock/prewrite conflict any txn whose captured read
+        # view (kv/store.Snapshot.view_seq) predates a written key's
+        # stamp.
+        self._foreign_apply_seq = 0
+        self._key_apply_seq: dict = {}
+        # durable commit frontier this worker publishes: forward-only
+        # maxes fed by the WAL's durable-ack hook; the worker heartbeat
+        # republishes (repairs a coordinator down-window) through the
+        # same publish_frontier funnel
+        self._frontier_mu = threading.Lock()
+        self._frontier_ts = 0
+        self._frontier_lsn = 0
+        # per-origin freshness breaker: slot -> monotonic expiry.  A
+        # stalled-but-alive origin that blew the freshness budget stops
+        # gating reads until the window closes (reads carry stale_ok)
+        self._breaker: "dict[int, float]" = {}
+        self._stale_reads = 0
+        self._last_stale_reason = ""
+        self._last_stale_warn = 0.0
+        #: observation hook: wait seconds per fleet ts acquisition
+        #: (the Domain wires observe_hist("freshness_wait_seconds"))
+        self.on_freshness_wait = None
+        if coordinator is not None and self._slot >= 0:
+            wal.on_durable = self._on_durable
         #: start_ts values holding >=1 shared lock-table claim
         self._claimed: set[int] = set()
         self._claim_mu = threading.Lock()
@@ -367,6 +408,9 @@ class DurableMVCCStore(MVCCStore):
         if self._coord is None:
             return
         with self._tail_lock:
+            # chaos door: delay tail application — the freshness wait
+            # in fresh_read_ts must cover the gap, never a stale answer
+            failpoint.inject("tail-lag")
             self.wal.reopen_if_truncated()
             if self._applied_lsn < self.wal.base_lsn:
                 # a peer truncated past us.  Legal only when our applied
@@ -435,6 +479,15 @@ class DurableMVCCStore(MVCCStore):
                 #   divergence is logged, not swallowed
                 log.warning("tailed commit apply failed for ts %d: %s",
                             start_ts, e)
+            # stamp the keys AFTER the values landed: any local
+            # statement whose read view was captured before this point
+            # computed from the pre-commit values and must conflict
+            # when it tries to write these keys (see _view_conflict)
+            with self._lock:
+                self._foreign_apply_seq += 1
+                seq = self._foreign_apply_seq
+                for key in keys:
+                    self._key_apply_seq[key] = seq
             self._note_delta(commit_ts, keys)
             for tid in tids:
                 self.bump_table_version(tid, commit_ts)
@@ -485,6 +538,142 @@ class DurableMVCCStore(MVCCStore):
         else:
             log.warning("unknown wal record kind %r skipped", kind)
 
+    # -- the fleet committed frontier -----------------------------------------
+
+    def _on_durable(self, commit_ts: int, cover_lsn: int):
+        """WAL durable-ack hook: ``commit_ts`` is fsync-acked and the
+        sync covers through ``cover_lsn``.  Runs on whatever thread paid
+        the fsync, BEFORE that commit's append returns to its caller —
+        so by the time any client sees an ack, the frontier the fleet
+        gates reads on already includes it (the linearizability edge)."""
+        with self._frontier_mu:
+            self._frontier_ts = max(self._frontier_ts, int(commit_ts))
+            self._frontier_lsn = max(self._frontier_lsn, int(cover_lsn))
+        self.publish_frontier()
+
+    def publish_frontier(self):
+        """Publish this worker's durable commit frontier to the segment
+        (forward-only there too).  Also called each worker heartbeat so
+        a publish lost to a coordinator down-window is repaired within
+        a beat.  The ``frontier-stall`` failpoint freezes publication —
+        the chaos shape for a worker whose fsyncs complete but whose
+        frontier column wedges."""
+        if self._coord is None or self._slot < 0:
+            return
+        if failpoint.inject("frontier-stall"):
+            return
+        with self._frontier_mu:
+            ts, lsn = self._frontier_ts, self._frontier_lsn
+        if not ts:
+            return
+        with contextlib.suppress(Exception):
+            self._coord.set_commit_frontier(self._slot, ts, lsn)
+
+    def _note_stale(self, reason: str):
+        """A read is proceeding WITHOUT fleet-freshness proof.  Loud by
+        contract: counted (``freshness_stale_ok``, surfaced in EXPLAIN
+        ANALYZE via the fabric gauges and /metrics) and rate-limit
+        logged — never silent."""
+        self._stale_reads += 1
+        self._last_stale_reason = reason
+        from ..fabric import state as fabric_state
+        with contextlib.suppress(Exception):
+            fabric_state.bump("freshness_stale_ok")
+        now = time.monotonic()
+        if now - self._last_stale_warn >= 1.0:
+            self._last_stale_warn = now
+            log.warning("stale_ok read downgrade: %s", reason)
+
+    def fresh_read_ts(self) -> int:
+        """Fleet-linearizable timestamp acquisition: the paper's
+        strong-consistency contract — a query observes every
+        transaction acked before it began — enforced ACROSS workers.
+
+        Reads every live origin's published durable frontier
+        (commit_ts, covering LSN) at ts-acquisition.  The returned ts
+        is fenced above every frontier commit_ts (``advance_to`` +
+        ``next_ts``), then we block — targeted catch-up under the
+        bounded ``freshnessWait`` budget — until the local replica has
+        applied through every gating origin's frontier LSN.
+
+        Degradations are explicit, never silent: a dead/reclaimed slot
+        stops gating at lease reclaim (``commit_frontiers`` filters to
+        live leases); an unreachable coordinator or a breaker-open
+        origin downgrades the read to stale_ok (counted + logged); a
+        stalled-but-alive origin that exhausts the budget raises
+        :class:`~tidb_tpu.errors.FreshnessWaitError` (9011) and trips
+        its per-origin breaker for FRESHNESS_BREAKER_S."""
+        if self._coord is None:
+            return self.tso.next_ts()
+        t0 = time.monotonic()
+        waited = False
+        try:
+            try:
+                fronts = self._coord.commit_frontiers()
+            except Exception as e:  # noqa: BLE001 — coordinator gone:
+                #   freshness is unprovable; degrade LOUDLY, not
+                #   silently (a plain next_ts read may miss peers)
+                self._note_stale(f"coordinator unreachable ({e})")
+                return self.tso.next_ts()
+            now = time.monotonic()
+            need_ts = 0
+            need_lsn = 0
+            gating: "dict[int, int]" = {}
+            for slot, (fts, flsn) in fronts.items():
+                if slot == self._slot:
+                    continue
+                if self._breaker.get(slot, 0.0) > now:
+                    self._note_stale(
+                        f"origin slot {slot} freshness breaker open")
+                    continue
+                need_ts = max(need_ts, fts)
+                need_lsn = max(need_lsn, flsn)
+                gating[slot] = flsn
+            if need_ts:
+                # ts fence: never issue a snapshot ts at-or-below a
+                # peer's acked durable commit
+                self.tso.advance_to(need_ts)
+            ts = self.tso.next_ts()
+            if self._applied_lsn >= need_lsn:
+                return ts
+            # LSN fence: block until the tail is applied through every
+            # gating origin's frontier
+            from ..errors import BackoffExhaustedError, FreshnessWaitError
+            from ..fabric import state as fabric_state
+            from ..utils.backoff import Backoffer
+            waited = True
+            with contextlib.suppress(Exception):
+                fabric_state.bump("freshness_waits")
+            bo = Backoffer(budget_ms=FRESHNESS_BUDGET_MS, wall_clock=True)
+            while True:
+                try:
+                    self.catch_up()
+                except Exception as e:  # noqa: BLE001 — a tail hiccup
+                    #   retries inside the budget like any other lag
+                    log.debug("freshness catch-up failed: %s", e)
+                if self._applied_lsn >= need_lsn:
+                    return ts
+                try:
+                    bo.backoff("freshnessWait")
+                except BackoffExhaustedError as e:
+                    lagging = sorted(s for s, lsn in gating.items()
+                                     if lsn > self._applied_lsn)
+                    expiry = time.monotonic() + FRESHNESS_BREAKER_S
+                    for s in lagging:
+                        self._breaker[s] = expiry
+                    with contextlib.suppress(Exception):
+                        fabric_state.bump("freshness_timeouts")
+                    raise FreshnessWaitError(
+                        "snapshot freshness wait exhausted: applied "
+                        f"lsn {self._applied_lsn} < fleet frontier "
+                        f"{need_lsn} (lagging origin slots {lagging}); "
+                        "refusing stale read") from e
+        finally:
+            hook = self.on_freshness_wait
+            if hook is not None:
+                with contextlib.suppress(Exception):
+                    hook(time.monotonic() - t0 if waited else 0.0)
+
     # -- the shared lock table ------------------------------------------------
 
     def _claim_shared(self, keys, start_ts: int):
@@ -521,10 +710,47 @@ class DurableMVCCStore(MVCCStore):
 
     # -- transactional overrides ----------------------------------------------
 
-    def prewrite(self, mutations, primary: bytes, start_ts: int):
+    def read_view_seq(self) -> int:
+        """Anchor for a new read view (captured by kv/store.Snapshot):
+        the count of foreign commits this replica has applied.  A write
+        conflicts when any of its keys carries a HIGHER per-key stamp —
+        the statement computed from values older than an already-applied
+        peer commit, the lost-update window that commit_ts comparison
+        cannot close (a peer's cts may be below a later-minted local ts
+        while its apply trails both)."""
+        return self._foreign_apply_seq
+
+    def _view_conflict(self, keys, view_seq, start_ts=None):
+        """Raise WriteConflictError when a foreign commit touching one
+        of ``keys`` was applied AFTER the writing statement's read view
+        was captured.  Keys the txn already holds its OWN pessimistic
+        lock on are exempt: their conflict was checked at lock time and
+        the held claim has excluded foreign applies since (mirrors the
+        base prewrite's DoPessimisticCheck skip)."""
+        if view_seq is None:
+            return
+        with self._lock:
+            for key in keys:
+                stamp = self._key_apply_seq.get(key, 0)
+                if stamp <= view_seq:
+                    continue
+                lk = self.locks.get(key)
+                if (start_ts is not None and lk is not None
+                        and lk.start_ts == start_ts
+                        and lk.op == OP_LOCK):
+                    continue
+                raise WriteConflictError(
+                    "write conflict: key rewritten by a peer commit "
+                    f"applied after this statement's read view "
+                    f"(view seq {view_seq} < key stamp {stamp})")
+
+    def prewrite(self, mutations, primary: bytes, start_ts: int,
+                 view_seq: "int | None" = None):
         self._claim_shared([m[0] for m in mutations], start_ts)
         try:
             self.catch_up()  # conflicts committed on peers must be seen
+            self._view_conflict([m[0] for m in mutations], view_seq,
+                                start_ts=start_ts)
             super().prewrite(mutations, primary, start_ts)
         except BaseException:
             self._release_shared(start_ts)
@@ -545,7 +771,7 @@ class DurableMVCCStore(MVCCStore):
             # policy `commit`) BEFORE the local apply — an acked commit
             # is always recoverable
             self.wal.append(("commit", self._slot, start_ts, commit_ts,
-                             keys, tids), sync=True)
+                             keys, tids), sync=True, commit_ts=commit_ts)
         except BaseException:
             # the commit never reached its durability point: roll back
             # (recovery honors the LAST disposition per start_ts, so a
@@ -589,11 +815,13 @@ class DurableMVCCStore(MVCCStore):
             self._release_shared(start_ts)
 
     def acquire_pessimistic_lock(self, keys, primary: bytes,
-                                 start_ts: int, for_update_ts: int):
+                                 start_ts: int, for_update_ts: int,
+                                 view_seq: "int | None" = None):
         keys = list(keys)
         self._claim_shared(keys, start_ts)
         try:
             self.catch_up()
+            self._view_conflict(keys, view_seq, start_ts=start_ts)
             super().acquire_pessimistic_lock(keys, primary, start_ts,
                                              for_update_ts)
         except BaseException:
@@ -628,7 +856,8 @@ class DurableMVCCStore(MVCCStore):
         self._note_delta(ts, [key])
         tid = _table_id_of(key)
         self.wal.append(("raw", self._slot, ts, [(key, value)],
-                         [tid] if tid is not None else []))
+                         [tid] if tid is not None else []),
+                        commit_ts=ts)
 
     def raw_batch_put(self, pairs, commit_ts: int | None = None):
         pairs = list(pairs)
@@ -639,7 +868,8 @@ class DurableMVCCStore(MVCCStore):
         self._note_delta(ts, [k for k, _v in pairs])
         tids = sorted({t for t in (_table_id_of(k) for k, _v in pairs)
                        if t is not None})
-        self.wal.append(("raw", self._slot, ts, pairs, tids))
+        self.wal.append(("raw", self._slot, ts, pairs, tids),
+                        commit_ts=ts)
 
     def raw_delete_range(self, start: bytes, end: bytes):
         super().raw_delete_range(start, end)
@@ -789,7 +1019,11 @@ class DurableMVCCStore(MVCCStore):
                 "slot": self._slot,
                 "fleet": self._coord is not None,
                 "lock_degrades": self._lock_degrades,
-                "fsync_policy": self.wal.fsync_policy()}
+                "fsync_policy": self.wal.fsync_policy(),
+                "frontier_ts": self._frontier_ts,
+                "frontier_lsn": self._frontier_lsn,
+                "stale_reads": self._stale_reads,
+                "last_stale_reason": self._last_stale_reason}
 
 
 # -- construction -------------------------------------------------------------

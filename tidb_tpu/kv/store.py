@@ -107,6 +107,14 @@ class Snapshot:
         self.store = store
         self.ts = ts
         self.own_start_ts = own_start_ts
+        # fleet read-view anchor: how many foreign commits the replica
+        # had applied when this view was captured.  Writers hand it to
+        # lock/prewrite so a peer commit applied AFTER these reads (but
+        # with a commit_ts a pure ts comparison would pass) still
+        # raises a write conflict (kv/shared_store._view_conflict).
+        # None on engines without the hazard (solo / region view).
+        rvs = getattr(store.mvcc, "read_view_seq", None)
+        self.view_seq = rvs() if rvs is not None else None
 
     def _wait_out_lock(self, bo, err):
         """One budgeted backoff step of the lock-wait loop (reference:
@@ -203,7 +211,8 @@ class Transaction:
         if primary is None:
             return
         self.store.mvcc.acquire_pessimistic_lock(
-            list(keys), primary, self.start_ts, for_update_ts)
+            list(keys), primary, self.start_ts, for_update_ts,
+            view_seq=getattr(self.snapshot, "view_seq", None))
         self.locked_keys.update(keys)
 
     def lock_keys_wait(self, keys, for_update_ts: int, timeout_s: float = 50.0):
@@ -260,7 +269,14 @@ class Transaction:
             # rollback() no-ops) — the next writer would wait out its whole
             # lock budget against a dead txn
             _inject_2pc("txn-before-prewrite")
-            self.store.mvcc.prewrite(muts, primary, self.start_ts)
+            # the view anchor is the txn's begin snapshot: optimistic
+            # writes computed from it must conflict with any peer commit
+            # applied since; pessimistically locked keys are exempt
+            # inside the check (their anchor was the lock-time
+            # for-update view)
+            self.store.mvcc.prewrite(
+                muts, primary, self.start_ts,
+                view_seq=getattr(self.snapshot, "view_seq", None))
         except Exception:
             self.store.mvcc.rollback([m[0] for m in muts], self.start_ts)
             raise
@@ -335,17 +351,29 @@ class Storage:
         if cu is not None:
             cu()
 
+    def _fresh_read_ts(self) -> int:
+        """Default-ts acquisition for a new read view.  A fleet-attached
+        durable engine routes through kv/shared_store.fresh_read_ts —
+        the ts is fenced above every live peer's durable commit frontier
+        and the call blocks until the local replica applied through it
+        (the cross-worker linearizability point).  Engines without the
+        method (solo / in-memory / native) just mint a ts."""
+        fresh = getattr(self.mvcc, "fresh_read_ts", None)
+        if fresh is not None:
+            return fresh()
+        return self.next_ts()
+
     def begin(self, start_ts: int | None = None) -> Transaction:
         self._catch_up()
         if start_ts is not None:
             self._check_safepoint(start_ts)
-        return Transaction(self, start_ts if start_ts is not None else self.next_ts())
+        return Transaction(self, start_ts if start_ts is not None else self._fresh_read_ts())
 
     def get_snapshot(self, ts: int | None = None) -> Snapshot:
         self._catch_up()
         if ts is not None:
             self._check_safepoint(ts)
-        return Snapshot(self, ts if ts is not None else self.next_ts())
+        return Snapshot(self, ts if ts is not None else self._fresh_read_ts())
 
     def close(self):
         """Release durable-store resources (tailer thread + WAL fds);

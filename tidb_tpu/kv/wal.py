@@ -200,6 +200,14 @@ class WAL:
         self._synced_lsn = 0
         self._flushing = False
         self._closed = False
+        #: durable-commit frontier plumbing (ISSUE 19): append(...,
+        #: commit_ts=) notes (end_lsn, commit_ts) marks here; the fsync
+        #: that covers a mark fires ``on_durable(max_commit_ts, lsn)``
+        #: exactly once — the hook kv/shared_store.py wires to the
+        #: segment's per-slot frontier cell
+        self._marks_lock = threading.Lock()
+        self._pending_marks = []   # [(end_lsn, commit_ts)], lsn-ordered
+        self.on_durable = None
         #: resolved at each decision point: a callable returning the
         #: sysvar string (Domain installs one reading GLOBAL scope);
         #: until then the env/ctor default applies
@@ -269,11 +277,16 @@ class WAL:
 
     # -- append ---------------------------------------------------------------
 
-    def append(self, record: tuple, sync: "bool | None" = None) -> int:
+    def append(self, record: tuple, sync: "bool | None" = None,
+               commit_ts: int = 0) -> int:
         """Frame + write one record; returns its END lsn.  ``sync=True``
         (commit records under policy ``commit``) blocks until the bytes
         are fsynced via the group protocol; ``sync=None`` derives from
-        the policy."""
+        the policy.  ``commit_ts`` (commit records) marks the record for
+        the durable-frontier hook: the fsync that covers it fires
+        ``on_durable`` — under policy ``commit`` that publish therefore
+        precedes the client's ack; under ``interval`` it trails by at
+        most one flush period (the group-commit window)."""
         from ..session import tracing
         payload = pickle.dumps(record, protocol=4)
         if len(payload) > MAX_RECORD:
@@ -315,6 +328,10 @@ class WAL:
                 new_end = end + len(frame)
                 if self._coord is not None:
                     self._coord.set_wal_len(new_end)
+                if commit_ts and self.on_durable is not None:
+                    with self._marks_lock:
+                        self._pending_marks.append((new_end,
+                                                    int(commit_ts)))
             _bump("wal_appends")
             _bump("wal_bytes", len(frame))
             if policy == "commit" and sync:
@@ -428,6 +445,27 @@ class WAL:
         with self._flush_cv:
             if cover > self._synced_lsn:
                 self._synced_lsn = cover
+        self._fire_durable(cover)
+
+    def _fire_durable(self, cover: int):
+        """Resolve the commit marks an fsync just covered and fire the
+        frontier hook once with their max commit_ts.  A hook failure is
+        logged, never allowed to fail the commit that drove the fsync —
+        the worker heartbeat republishes the frontier every beat, so a
+        dropped publish is a lag blip, not a lost gate."""
+        if self.on_durable is None:
+            return
+        with self._marks_lock:
+            done = [ts for lsn, ts in self._pending_marks if lsn <= cover]
+            if not done:
+                return
+            self._pending_marks = [(lsn, ts) for lsn, ts
+                                   in self._pending_marks if lsn > cover]
+        try:
+            self.on_durable(max(done), cover)
+        except Exception as e:  # noqa: BLE001 — observe/coordination
+            #   surface; the durable bytes themselves are already safe
+            log.warning("wal durable-frontier hook failed: %s", e)
 
     def _ensure_interval_flusher(self):
         if self._interval_thread is not None \

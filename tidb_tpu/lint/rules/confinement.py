@@ -247,6 +247,44 @@ class SocketConfinement(Rule):
 
 
 @register
+class SnapshotConfinement(Rule):
+    """Every read view over the durable fleet store must acquire its
+    timestamp through the frontier-waiting entry points —
+    kv/store.Storage.begin / get_snapshot routing ``_fresh_read_ts()``
+    — so the ts is fenced above every live peer's durable commit
+    frontier and the replica has applied through it.  A ``Snapshot``
+    constructed anywhere else inside kv/ would mint a read view that
+    skips that wait: exactly the silent stale read the consistency
+    contract forbids.  Layers above kv/ may build snapshots only from
+    an already-acquired ts (AS OF / stale-read paths own their
+    staleness explicitly)."""
+
+    name = "snapshot-confinement"
+    allowlistable = False
+    title = "Snapshot construction confined to the frontier-waiting entry point"
+
+    #: Storage.begin/get_snapshot (and Transaction, same file) are the
+    #: sanctioned constructors — both route _fresh_read_ts
+    ALLOWED = ("kv/store.py",)
+
+    def run(self, ctx):
+        out = []
+        for sf in ctx.package_files:
+            if not sf.rel.startswith("kv/") or sf.rel in self.ALLOWED:
+                continue
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Call)
+                        and call_name(node).rsplit(".", 1)[-1]
+                        == "Snapshot"):
+                    out.append(self.finding(
+                        sf.rel, node.lineno,
+                        f"Snapshot@{sf.qualname(node)}",
+                        "Snapshot constructed outside kv/store.py "
+                        "(bypasses the fleet-frontier freshness wait)"))
+        return out
+
+
+@register
 class RunDeviceShape(Rule):
     """A run_device call without ``shape=`` silently shares the 'agg'
     breaker — a new fragment class must never piggyback unnoticed.

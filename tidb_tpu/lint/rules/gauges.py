@@ -39,7 +39,11 @@ FLEET_INVENTORY = {
     "fabric/state.py": (
         "fabric_workers", "fabric_respawns", "fabric_dedup_hits",
         "fabric_compile_rtt_ms", "fleet_cache_hits",
-        "fabric_perf_rows", "fabric_perf_samples"),
+        "fabric_perf_rows", "fabric_perf_samples",
+        # fleet-frontier freshness (ISSUE 19): bumped by
+        # kv/shared_store.fresh_read_ts, surfaced via report_gauges
+        # (EXPLAIN ANALYZE) and /metrics
+        "freshness_waits", "freshness_timeouts", "freshness_stale_ok"),
     "fabric/perf.py": ("perf_notes", "perf_merged"),
     # the span-ring eviction counter behind trace_ring_dropped_total
     "session/tracing.py": ("ring_dropped",),

@@ -255,6 +255,16 @@ class StatusServer:
                           fs.get("cache_stale_reads", 0))
         gauges.setdefault("fleet_cache_hits",
                           fs.get("fleet_cache_hits", 0))
+        # fleet-frontier freshness (kv/shared_store.fresh_read_ts):
+        # waits that blocked, budget blowups (9011 refusals) and
+        # explicit stale_ok downgrades — the zero-silent-staleness
+        # contract's scrapeable evidence
+        gauges.setdefault("freshness_waits",
+                          fs.get("freshness_waits", 0))
+        gauges.setdefault("freshness_timeouts",
+                          fs.get("freshness_timeouts", 0))
+        gauges.setdefault("freshness_stale_ok",
+                          fs.get("freshness_stale_ok", 0))
         # shared fragment-perf store (fabric/perf.py + the segment's
         # TPUFAB4 PERF section): fleet row/sample totals when attached,
         # this process's feed counters always

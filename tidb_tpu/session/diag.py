@@ -89,8 +89,16 @@ def payload(session, kind: str, arg: str = "") -> dict:
         obs = session.domain.observe
         with obs._lock:
             counters = dict(obs.counters)
+        # histograms ride along so a fleet parent can aggregate e.g.
+        # freshness_wait_seconds percentiles across workers without
+        # scraping each /metrics port (hist_snapshot takes obs._lock
+        # itself — must not be called inside the block above)
+        hists = {name: {"bounds": list(bounds), "counts": list(counts),
+                        "sum": hsum, "count": count}
+                 for name, (bounds, counts, hsum, count)
+                 in obs.hist_snapshot().items()}
         from . import tracing
-        return {"kind": kind, "counters": counters,
+        return {"kind": kind, "counters": counters, "hists": hists,
                 "tracing": tracing.snapshot()}
     if kind == "perf":
         from ..fabric import perf

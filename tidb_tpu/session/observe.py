@@ -57,6 +57,12 @@ HIST_BUCKETS = {
         0.001, 0.005, 0.02, 0.1, 0.5, 2.5, 10.0, 60.0),
     "hj_probe_host_seconds": (
         0.001, 0.005, 0.02, 0.1, 0.5, 2.5, 10.0, 60.0),
+    # fleet-frontier freshness wait at ts acquisition (session/session.py
+    # Domain hookup of kv/shared_store.fresh_read_ts): 0 on the fast
+    # path, up to the FRESHNESS_BUDGET_MS refusal ceiling when blocked
+    # behind a lagging origin's durable commit frontier
+    "freshness_wait_seconds": (
+        0.0005, 0.002, 0.01, 0.05, 0.25, 1.0, 5.0),
 }
 
 

@@ -102,6 +102,13 @@ class Domain:
         if wal is not None:
             gv = self.global_vars
             wal.policy_source = lambda: gv.get("tidb_wal_fsync", "commit")
+        if hasattr(self.store.mvcc, "on_freshness_wait"):
+            # every fleet ts acquisition lands in the freshness
+            # histogram (p99 is the paper's measured consistency cost;
+            # /metrics renders the buckets, bench_oltp reports it)
+            obs = self.observe
+            self.store.mvcc.on_freshness_wait = (
+                lambda s: obs.observe_hist("freshness_wait_seconds", s))
         self._schema_lease_next = 0.0
 
     #: seconds an infoschema may serve past the fleet's published
@@ -1506,7 +1513,15 @@ class Session:
         try:
             while True:
                 sp = txn.membuf.savepoint()
-                for_update_ts = self.store.next_ts()
+                # frontier-fresh, not a raw TSO tick: the shared oracle
+                # orders a raw ts ABOVE a peer's commit_ts even when the
+                # local replica has not applied that commit yet, so a
+                # raw-ts for-update read would compute from the stale
+                # value while has_commit_after(for_update_ts) stays
+                # silent — a cross-worker lost update.  fresh_read_ts
+                # blocks until the applied LSN covers every live peer's
+                # durable frontier <= ts (kv/shared_store.fresh_read_ts)
+                for_update_ts = self.store._fresh_read_ts()
                 txn.snapshot = Snapshot(self.store, for_update_ts,
                                         own_start_ts=txn.start_ts)
                 try:
@@ -1572,7 +1587,11 @@ class Session:
         last = None
         try:
             for _attempt in range(max(self._retry_limit(), 1)):
-                for_update_ts = self.store.next_ts()
+                # frontier-fresh for the same reason as
+                # _exec_dml_pessimistic: FOR UPDATE promises the latest
+                # committed versions, which in a fleet means waiting out
+                # peers' durable frontiers, not just minting a ts
+                for_update_ts = self.store._fresh_read_ts()
                 txn.snapshot = Snapshot(self.store, for_update_ts,
                                         own_start_ts=txn.start_ts)
                 keys = []

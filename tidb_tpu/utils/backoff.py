@@ -208,6 +208,17 @@ KINDS = {k.name: k for k in [
     # to local-only admission
     Kind("coordRetry", base_ms=2, cap_ms=50, jitter="equal",
          max_attempts=4),
+    # fleet-frontier freshness wait (kv/shared_store.fresh_read_ts): a
+    # snapshot blocking until the local replica applies through every
+    # live origin's durable commit frontier.  Short sleeps — the tailer
+    # normally closes the gap in one TAIL_INTERVAL_S tick; exhaustion is
+    # the LOUD stale-read refusal (FreshnessWaitError 9011) and trips
+    # the lagging origin's freshness breaker
+    Kind("freshnessWait", base_ms=2, cap_ms=40, jitter="equal"),
+    # waiting out a foreign DDL owner lease (ddl.ddl_owner_lease): the
+    # segment's epoch-fenced DDL cell is held by another worker running
+    # a job; poll until it releases or its lease dies
+    Kind("ddlOwnerWait", base_ms=20, cap_ms=200, jitter="equal"),
 ]}
 # (no "lease"/"device" kinds yet: campaign losses degrade by skipping the
 # round, and device failures route through the circuit breaker, not a
